@@ -1,0 +1,120 @@
+"""Benchmark: batched history verification throughput on the default JAX
+backend (the driver runs this on one real TPU chip).
+
+Workload (north star, BASELINE.md): quorum-queue histories of ~1000 op rows
+each, checked with the combined TPU verdict (total-queue set reconciliation
++ per-value queue linearizability), ``jax.vmap``-batched.  A base set of
+distinct synthetic histories is packed host-side, tiled to the bench batch
+on device, and the steady-state check rate is measured over several timed
+iterations.
+
+Baseline: the same verdict computed by the single-threaded CPU reference
+checkers (the stand-in for single-threaded Knossos/`checker/total-queue` —
+the reference publishes no numbers of its own, BASELINE.md).  Prints ONE
+JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+from jepsen_tpu.checkers.queue_lin import (
+    check_queue_lin_cpu,
+    queue_lin_tensor_check,
+)
+from jepsen_tpu.checkers.total_queue import (
+    check_total_queue_cpu,
+    total_queue_tensor_check,
+)
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+BASE_HISTORIES = 128  # distinct synthetic histories
+N_OPS = 470  # invocations per history → ~1000 packed rows with completions
+LENGTH = 1024  # packed rows per history ("1k-op histories")
+TILE = 32  # device batch = BASE_HISTORIES * TILE
+TIMED_ITERS = 5
+CPU_BASELINE_SAMPLES = 6
+
+
+def _tile(packed: PackedHistories, k: int) -> PackedHistories:
+    return jax.tree.map(
+        lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
+    )
+
+
+def _check(packed: PackedHistories):
+    return (
+        total_queue_tensor_check(packed),
+        queue_lin_tensor_check(packed),
+    )
+
+
+def main() -> None:
+    t0 = time.perf_counter()
+    base = synth_batch(
+        BASE_HISTORIES,
+        SynthSpec(n_ops=N_OPS, n_processes=5),
+        lost=1,
+        duplicated=1,
+    )
+    histories = [sh.ops for sh in base]
+    packed = pack_histories(histories, length=LENGTH)
+    print(
+        f"# packed {BASE_HISTORIES} histories (L={LENGTH}, "
+        f"V={packed.value_space}) in {time.perf_counter() - t0:.1f}s; "
+        f"backend={jax.default_backend()}",
+        file=sys.stderr,
+    )
+
+    big = _tile(packed, TILE)
+    batch = big.batch
+
+    # warmup / compile
+    jax.block_until_ready(_check(big))
+
+    times = []
+    for _ in range(TIMED_ITERS):
+        t1 = time.perf_counter()
+        jax.block_until_ready(_check(big))
+        times.append(time.perf_counter() - t1)
+    dt = min(times)
+    rate = batch / dt
+    print(
+        f"# device check: batch={batch} best={dt * 1e3:.1f}ms "
+        f"median={sorted(times)[len(times) // 2] * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+
+    # single-threaded CPU reference baseline on a sample
+    t2 = time.perf_counter()
+    for h in histories[:CPU_BASELINE_SAMPLES]:
+        check_total_queue_cpu(h)
+        check_queue_lin_cpu(h)
+    cpu_per_history = (time.perf_counter() - t2) / CPU_BASELINE_SAMPLES
+    cpu_rate = 1.0 / cpu_per_history
+    print(
+        f"# cpu reference: {cpu_per_history * 1e3:.2f} ms/history "
+        f"({cpu_rate:.1f} hist/s)",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": "histories_verified_per_sec@1k_ops",
+                "value": round(rate, 1),
+                "unit": "histories/s",
+                "vs_baseline": round(rate / cpu_rate, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

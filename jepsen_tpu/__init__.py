@@ -1,0 +1,26 @@
+"""jepsen_tpu — a TPU-native distributed-systems correctness-testing framework.
+
+A brand-new framework with the capabilities of ``rabbitmq/jepsen`` (a Jepsen
+test suite + the Jepsen framework surface it consumes): generator-driven
+concurrent workloads against real RabbitMQ quorum-queue clusters, network
+partition nemeses, an SSH control plane, per-run history recording — and a
+history-analysis phase ("checkers") that is a JAX/XLA program running on TPU:
+histories are packed into ``int32`` tensors, checked with ``jax.vmap`` across
+histories, sharded across chips with ``jax.sharding`` meshes, anomaly counts
+reduced with ``lax.psum``.
+
+Layer map (mirrors SURVEY.md §1 for the reference):
+
+- ``jepsen_tpu.history``   — op schema, JSONL/EDN store, int32 tensor packing
+- ``jepsen_tpu.checkers``  — total-queue / linearizability / perf checkers,
+  protocol + compose + cpu/tpu backend dispatch
+- ``jepsen_tpu.ops``       — JAX kernels (masked scatter counts, scans, bitsets)
+- ``jepsen_tpu.parallel``  — device mesh, shardings, shard_map'd checking
+- ``jepsen_tpu.models``    — sequential data-type models for linearizability
+- ``jepsen_tpu.generators``— generator algebra (mix, delay, phases, nemesis…)
+- ``jepsen_tpu.client``    — queue client protocol + native C++ AMQP driver
+- ``jepsen_tpu.control``   — SSH exec DSL, DB lifecycle, nemesis engine
+- ``jepsen_tpu.cli``       — ``test`` / ``check`` / ``bench-check`` commands
+"""
+
+__version__ = "0.1.0"

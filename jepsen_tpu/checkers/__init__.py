@@ -1,0 +1,22 @@
+"""Checkers: pure functions of a recorded history.
+
+Mirrors the surface the reference consumes from ``jepsen.checker``
+(``/root/reference/rabbitmq/src/main/clojure/jepsen/rabbitmq.clj:263-266``):
+``compose``, ``total-queue``, ``perf`` — plus the Knossos-style queue
+linearizability capability of the legacy test
+(``rabbitmq/test/jepsen/rabbitmq_test.clj:55-58``).  Each checker has a CPU
+reference implementation and a TPU (JAX) backend selected by
+``backend='cpu'|'tpu'``.
+"""
+
+from jepsen_tpu.checkers.protocol import Checker, compose  # noqa: F401
+from jepsen_tpu.checkers.total_queue import (  # noqa: F401
+    TotalQueue,
+    check_total_queue_cpu,
+    total_queue_tensor_check,
+)
+from jepsen_tpu.checkers.queue_lin import (  # noqa: F401
+    QueueLinearizability,
+    check_queue_lin_cpu,
+    queue_lin_tensor_check,
+)

@@ -1,0 +1,51 @@
+"""The ``Checker`` protocol and ``compose``.
+
+Matches the ``jepsen.checker/Checker`` contract as used by the reference:
+``check(test, history, opts) -> result-map`` where the result map carries a
+``"valid?"`` key, and ``compose`` runs a named map of checkers returning a
+map of named results whose overall ``"valid?"`` is the AND of the parts
+(result shape visible in ``/root/reference/README.md:38-57``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.history.ops import Op
+
+VALID = "valid?"
+
+
+class Checker(abc.ABC):
+    """A pure function of a recorded history."""
+
+    name: str = "checker"
+
+    @abc.abstractmethod
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        """Analyze ``history`` and return a result map with ``"valid?"``."""
+
+
+class ComposedChecker(Checker):
+    name = "compose"
+
+    def __init__(self, checkers: Mapping[str, Checker]):
+        self.checkers = dict(checkers)
+
+    def check(self, test, history, opts=None):
+        results = {
+            name: c.check(test, history, opts) for name, c in self.checkers.items()
+        }
+        results[VALID] = all(r.get(VALID, False) for r in results.values())
+        return results
+
+
+def compose(checkers: Mapping[str, Checker]) -> Checker:
+    """``{:perf (perf), :queue (total-queue)}``-style composition."""
+    return ComposedChecker(checkers)

@@ -1,0 +1,236 @@
+"""Linearizability of unordered-queue histories, per-value decomposed.
+
+The reference's legacy test checks histories against Knossos's
+``model/unordered-queue`` with a Wing-Gong search
+(``/root/reference/rabbitmq/test/jepsen/rabbitmq_test.clj:55-58``).  A DFS
+over interleavings is hostile to XLA's static-shape model — but it is not
+needed for this model:
+
+**P-compositionality** (Horn & Kroening, arXiv:1504.00204; see PAPERS.md):
+if an object is a product of independent sub-objects, a history is
+linearizable iff each per-key subhistory is.  A multiset ("unordered queue")
+over *distinct* values is exactly such a product: an operation on value ``v``
+neither enables nor disables operations on ``w ≠ v`` (enqueue is always
+legal; dequeue returning ``v`` depends only on ``v``'s presence).  The
+workload guarantees distinct values (single incrementing counter,
+``rabbitmq.clj:245-247``).  So linearizability decomposes into an
+embarrassingly-parallel per-value feasibility check — a scatter/compare
+program, not a search:
+
+Per value ``v`` — with enqueue-invoke count ``a``, definite-failure count
+``x``, earliest enqueue-invoke time ``s``, ok-read count ``r``, earliest
+ok-read completion time ``t``:
+
+- **duplicate**: ``r > 1`` — ``v`` removed more times than it was added.
+- **phantom**:   ``r ≥ 1`` and (``a == 0`` or ``x ≥ a``) — read though never
+  attempted, or though every attempt definitely failed (``fail`` means "did
+  not happen"; ``info`` means "may have happened" and is *not* a phantom —
+  the same indeterminacy rule total-queue's ``recovered`` relies on).
+- **causality**: ``r ≥ 1``, ``a ≥ 1``, and ``t < s`` — the read *completed*
+  before the enqueue was *invoked*: no linearization points
+  ``p_enq < p_deq`` can exist inside the op intervals.  (Conversely if
+  ``s ≤ t`` points always exist, since enqueue intervals extend to ∞ for
+  indeterminate ops.)  ``s``/``t`` are **history positions**, not wall-clock
+  timestamps: the recorded history is ordered (completion entries are
+  appended when the op completes), so position order *is* real-time order,
+  with none of the precision loss of truncated timestamps — a read appended
+  before its enqueue's invocation entry is exactly "completed before it
+  was invoked".
+
+Un-read acknowledged enqueues are linearizable (the value simply remains in
+the queue) — *loss* is total-queue's concern.  Failed/indeterminate dequeues
+impose no constraints (Knossos treats ``fail`` as not-happened and ``info``
+as free to take effect or not).
+
+The general-model Wing-Gong engine (for models that do NOT decompose, e.g.
+FIFO queues or CAS registers) lives in ``jepsen_tpu.checkers.wgl``.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.ops.counts import masked_value_counts, masked_value_reduce_min
+
+_INF = 2**31 - 1
+
+
+# ---------------------------------------------------------------------------
+# CPU reference
+# ---------------------------------------------------------------------------
+
+
+def check_queue_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
+    enq_invokes: dict[int, int] = {}
+    enq_fails: dict[int, int] = {}
+    enq_start: dict[int, int] = {}  # earliest history position of an invoke
+    read_count: dict[int, int] = {}
+    read_end: dict[int, int] = {}  # earliest history position of an ok read
+    for pos, op in enumerate(history):
+        if op.f == OpF.ENQUEUE and isinstance(op.value, int):
+            v = op.value
+            if op.type == OpType.INVOKE:
+                enq_invokes[v] = enq_invokes.get(v, 0) + 1
+                enq_start[v] = min(enq_start.get(v, pos), pos)
+            elif op.type == OpType.FAIL:
+                enq_fails[v] = enq_fails.get(v, 0) + 1
+        elif op.f in (OpF.DEQUEUE, OpF.DRAIN) and op.type == OpType.OK:
+            vals = op.value if isinstance(op.value, (list, tuple)) else [op.value]
+            for v in vals:
+                if isinstance(v, int):
+                    read_count[v] = read_count.get(v, 0) + 1
+                    read_end[v] = min(read_end.get(v, pos), pos)
+
+    dup, phantom, causal = set(), set(), set()
+    for v, r in read_count.items():
+        a = enq_invokes.get(v, 0)
+        x = enq_fails.get(v, 0)
+        if r > 1:
+            dup.add(v)
+        if a == 0 or x >= a:
+            phantom.add(v)
+        elif read_end[v] < enq_start[v]:
+            causal.add(v)
+
+    return {
+        VALID: not (dup or phantom or causal),
+        "duplicate-count": len(dup),
+        "duplicate": dup,
+        "phantom-count": len(phantom),
+        "phantom": phantom,
+        "causality-count": len(causal),
+        "causality": causal,
+        "read-value-count": len(read_count),
+    }
+
+
+# ---------------------------------------------------------------------------
+# TPU kernel
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QueueLinTensors:
+    valid: jax.Array  # [B] bool
+    duplicate: jax.Array  # [B, V] bool
+    phantom: jax.Array  # [B, V] bool
+    causality: jax.Array  # [B, V] bool
+    read_value_count: jax.Array  # [B] i32
+
+
+def queue_lin_count_vectors(f, type_, value, pos, mask, value_space: int):
+    """Per-history ``(a, x, s, r, t)`` vectors over the value space for one
+    ``[L]`` row block: enqueue-invoke count, enqueue-fail count, earliest
+    enqueue-invoke position, ok-read count, earliest ok-read position.
+    ``pos`` is the *global* history position of each row (exact ordering —
+    no timestamp truncation).  Counts combine across an op-axis shard with
+    ``psum``; the two position mins with ``pmin``."""
+    has_val = value >= 0
+    is_enq = (f == int(OpF.ENQUEUE)) & has_val & mask
+    is_read = (
+        ((f == int(OpF.DEQUEUE)) | (f == int(OpF.DRAIN)))
+        & has_val
+        & mask
+        & (type_ == int(OpType.OK))
+    )
+    enq_inv = is_enq & (type_ == int(OpType.INVOKE))
+    a = masked_value_counts(value, enq_inv, value_space)
+    x = masked_value_counts(
+        value, is_enq & (type_ == int(OpType.FAIL)), value_space
+    )
+    s = masked_value_reduce_min(value, enq_inv, pos, value_space, init=_INF)
+    r = masked_value_counts(value, is_read, value_space)
+    t = masked_value_reduce_min(value, is_read, pos, value_space, init=_INF)
+    return a, x, s, r, t
+
+
+def queue_lin_classify(a, x, s, r, t) -> QueueLinTensors:
+    """Vectors ``[..., V]`` → results; runs on full combined vectors."""
+    read = r >= 1
+    dup = r > 1
+    phantom = read & ((a == 0) | (x >= a))
+    causal = read & ~phantom & (s != _INF) & (t != _INF) & (t < s)
+    valid = ~(dup.any(-1) | phantom.any(-1) | causal.any(-1))
+    return QueueLinTensors(
+        valid=valid,
+        duplicate=dup,
+        phantom=phantom,
+        causality=causal,
+        read_value_count=read.sum(-1).astype(jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("value_space",))
+def _queue_lin_batch(f, type_, value, mask, value_space: int):
+    pos = jnp.broadcast_to(
+        jnp.arange(f.shape[-1], dtype=jnp.int32), f.shape
+    )
+    a, x, s, r, t = jax.vmap(
+        lambda ff, tt, vv, pp, mm: queue_lin_count_vectors(
+            ff, tt, vv, pp, mm, value_space
+        )
+    )(f, type_, value, pos, mask)
+    return queue_lin_classify(a, x, s, r, t)
+
+
+def queue_lin_tensor_check(packed: PackedHistories) -> QueueLinTensors:
+    return _queue_lin_batch(
+        packed.f, packed.type, packed.value, packed.mask, packed.value_space
+    )
+
+
+def check_queue_lin_batch(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    value_space: int | None = None,
+) -> list[dict[str, Any]]:
+    packed = pack_histories(histories, length=length, value_space=value_space)
+    t = queue_lin_tensor_check(packed)
+    valid = np.asarray(t.valid)
+    masks = {
+        "duplicate": np.asarray(t.duplicate),
+        "phantom": np.asarray(t.phantom),
+        "causality": np.asarray(t.causality),
+    }
+    rvc = np.asarray(t.read_value_count)
+    out = []
+    for b in range(valid.shape[0]):
+        r: dict[str, Any] = {VALID: bool(valid[b])}
+        for k, arr in masks.items():
+            vals = set(np.nonzero(arr[b])[0].tolist())
+            r[k] = vals
+            r[f"{k}-count"] = len(vals)
+        r["read-value-count"] = int(rvc[b])
+        out.append(r)
+    return out
+
+
+class QueueLinearizability(Checker):
+    """Knossos ``checker/queue`` + ``model/unordered-queue`` equivalent."""
+
+    name = "queue-linearizability"
+
+    def __init__(self, backend: str = "tpu"):
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        if self.backend == "cpu":
+            return check_queue_lin_cpu(history)
+        return check_queue_lin_batch([history])[0]

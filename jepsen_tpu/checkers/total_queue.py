@@ -1,0 +1,246 @@
+"""``total-queue``: what goes in must come out.
+
+The checker the reference's active path runs on every history
+(``rabbitmq.clj:263-266``; result shape ``/root/reference/README.md:41-52``).
+It reconciles three multisets over the op history:
+
+- **attempts**  — values of ``invoke``-type enqueues
+- **acknowledged** — values of ``ok``-type enqueues (publish confirmed)
+- **reads**     — values of ``ok``-type dequeues and drains
+
+Because values are dense unique ints (one incrementing counter,
+``rabbitmq.clj:245-247``), the multisets are integer count vectors over the
+value space and the reconciliation is per-value arithmetic.  Per value ``v``
+with ``a`` attempts, ``e`` acks (``e ≤ a``), ``d`` reads:
+
+- ``ok[v]         = min(d, a)``       — reads of values we tried to enqueue
+- ``unexpected[v] = d`` if ``a == 0`` — reads of values never even attempted
+- ``duplicated[v] = max(d - a, 0)`` if ``a > 0`` — read more times than
+  enqueued (at-least-once delivery; does not invalidate by default)
+- ``lost[v]       = max(e - d, 0)``   — acknowledged but never read
+- ``recovered[v]  = max(min(d, a) - e, 0)`` — read, attempted, but the
+  enqueue was indeterminate (``info``, e.g. confirm timeout) or failed-open.
+  This is why the client maps timeouts to ``info`` not ``fail``
+  (``rabbitmq.clj:197-200``): an indeterminate write that surfaces later is
+  *recovered*, not *unexpected*.
+
+``valid? = (no lost) and (no unexpected)`` — duplicates and recovered values
+are legal for an at-least-once quorum queue (the README example run counts a
+recovered value and stays valid).  Checks against the README sample:
+attempt 727 / acked 725 / ok 726 = 725 acked + 1 recovered.  ✓
+
+The TPU backend packs histories to int32 tensors and evaluates the count
+vectors with masked scatter-adds, ``jax.vmap``-batched across histories; the
+CPU backend is the single-threaded reference implementation used for
+differential testing.
+"""
+
+from __future__ import annotations
+
+import functools
+from collections import Counter
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.ops.counts import masked_value_counts
+
+
+# ---------------------------------------------------------------------------
+# CPU reference implementation (single-threaded, dict-based — the
+# differential-testing baseline, SURVEY.md §4.5)
+# ---------------------------------------------------------------------------
+
+
+def check_total_queue_cpu(history: Sequence[Op]) -> dict[str, Any]:
+    """Reference implementation over raw ``Op`` lists."""
+    attempts: Counter = Counter()
+    acked: Counter = Counter()
+    reads: Counter = Counter()
+    for op in history:
+        if op.f == OpF.ENQUEUE and isinstance(op.value, int):
+            if op.type == OpType.INVOKE:
+                attempts[op.value] += 1
+            elif op.type == OpType.OK:
+                acked[op.value] += 1
+        elif op.f in (OpF.DEQUEUE, OpF.DRAIN) and op.type == OpType.OK:
+            vals = op.value if isinstance(op.value, (list, tuple)) else [op.value]
+            for v in vals:
+                if isinstance(v, int):
+                    reads[v] += 1
+
+    values = set(attempts) | set(acked) | set(reads)
+    ok = lost = dup = unexp = recov = 0
+    lost_s, dup_s, unexp_s, recov_s = set(), set(), set(), set()
+    for v in values:
+        a, e, d = attempts[v], acked[v], reads[v]
+        ok += min(d, a)
+        if a == 0 and d > 0:
+            unexp += d
+            unexp_s.add(v)
+        if a > 0 and d > a:
+            dup += d - a
+            dup_s.add(v)
+        if e > d:
+            lost += e - d
+            lost_s.add(v)
+        if min(d, a) > e:
+            recov += min(d, a) - e
+            recov_s.add(v)
+
+    return {
+        VALID: lost == 0 and unexp == 0,
+        "attempt-count": sum(attempts.values()),
+        "acknowledged-count": sum(acked.values()),
+        "ok-count": ok,
+        "lost-count": lost,
+        "lost": lost_s,
+        "unexpected-count": unexp,
+        "unexpected": unexp_s,
+        "duplicated-count": dup,
+        "duplicated": dup_s,
+        "recovered-count": recov,
+        "recovered": recov_s,
+    }
+
+
+# ---------------------------------------------------------------------------
+# TPU kernel
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TotalQueueTensors:
+    """Device-side results: scalar counts ``[B]`` + per-value class masks
+    ``[B, V]`` (counts per value, so hosts can recover the anomaly sets)."""
+
+    valid: jax.Array  # [B] bool
+    attempt_count: jax.Array  # [B] i32
+    acknowledged_count: jax.Array  # [B] i32
+    ok_count: jax.Array  # [B] i32
+    lost: jax.Array  # [B, V] i32
+    unexpected: jax.Array  # [B, V] i32
+    duplicated: jax.Array  # [B, V] i32
+    recovered: jax.Array  # [B, V] i32
+
+
+def total_queue_count_vectors(
+    f: jax.Array,
+    type_: jax.Array,
+    value: jax.Array,
+    mask: jax.Array,
+    value_space: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-history ``(attempts, acks, reads)`` count vectors over the value
+    space; inputs are ``[L]`` rows.  Linear in the ops, so an op axis sharded
+    under ``shard_map`` combines with a plain ``psum`` (see
+    ``jepsen_tpu.parallel``) — the long-history sequence-parallel path."""
+    has_val = value >= 0
+    is_enq = (f == int(OpF.ENQUEUE)) & has_val & mask
+    is_read = ((f == int(OpF.DEQUEUE)) | (f == int(OpF.DRAIN))) & has_val & mask
+    a = masked_value_counts(value, is_enq & (type_ == int(OpType.INVOKE)), value_space)
+    e = masked_value_counts(value, is_enq & (type_ == int(OpType.OK)), value_space)
+    d = masked_value_counts(value, is_read & (type_ == int(OpType.OK)), value_space)
+    return a, e, d
+
+
+def total_queue_classify(
+    a: jax.Array, e: jax.Array, d: jax.Array
+) -> TotalQueueTensors:
+    """Count vectors ``[..., V]`` → results.  Nonlinear: must run on *full*
+    (already-combined) counts."""
+    ok = jnp.minimum(d, a)
+    unexpected = jnp.where(a == 0, d, 0)
+    duplicated = jnp.where(a > 0, jnp.maximum(d - a, 0), 0)
+    lost = jnp.maximum(e - d, 0)
+    recovered = jnp.maximum(ok - e, 0)
+    return TotalQueueTensors(
+        valid=(lost.sum(-1) == 0) & (unexpected.sum(-1) == 0),
+        attempt_count=a.sum(-1),
+        acknowledged_count=e.sum(-1),
+        ok_count=ok.sum(-1),
+        lost=lost,
+        unexpected=unexpected,
+        duplicated=duplicated,
+        recovered=recovered,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("value_space",))
+def _total_queue_batch(
+    f, type_, value, mask, value_space: int
+) -> TotalQueueTensors:
+    a, e, d = jax.vmap(
+        lambda ff, tt, vv, mm: total_queue_count_vectors(ff, tt, vv, mm, value_space)
+    )(f, type_, value, mask)
+    return total_queue_classify(a, e, d)
+
+
+def total_queue_tensor_check(packed: PackedHistories) -> TotalQueueTensors:
+    """Jittable batched check over packed histories (``vmap`` across B)."""
+    return _total_queue_batch(
+        packed.f, packed.type, packed.value, packed.mask, packed.value_space
+    )
+
+
+def _tensors_to_results(t: TotalQueueTensors) -> list[dict[str, Any]]:
+    """Device tensors → reference-shaped result maps (one per history)."""
+    valid = np.asarray(t.valid)
+    scalars = {
+        k: np.asarray(getattr(t, k))
+        for k in ("attempt_count", "acknowledged_count", "ok_count")
+    }
+    per_value = {
+        k: np.asarray(getattr(t, k))
+        for k in ("lost", "unexpected", "duplicated", "recovered")
+    }
+    out = []
+    for b in range(valid.shape[0]):
+        r: dict[str, Any] = {VALID: bool(valid[b])}
+        r["attempt-count"] = int(scalars["attempt_count"][b])
+        r["acknowledged-count"] = int(scalars["acknowledged_count"][b])
+        r["ok-count"] = int(scalars["ok_count"][b])
+        for k, arr in per_value.items():
+            row = arr[b]
+            r[f"{k}-count"] = int(row.sum())
+            r[k] = set(np.nonzero(row)[0].tolist())
+        out.append(r)
+    return out
+
+
+def check_total_queue_batch(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    value_space: int | None = None,
+) -> list[dict[str, Any]]:
+    """Pack + check a batch of histories on the default JAX backend."""
+    packed = pack_histories(histories, length=length, value_space=value_space)
+    return _tensors_to_results(total_queue_tensor_check(packed))
+
+
+class TotalQueue(Checker):
+    """``checker/total-queue`` equivalent with ``cpu``/``tpu`` backends."""
+
+    name = "total-queue"
+
+    def __init__(self, backend: str = "tpu"):
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        if self.backend == "cpu":
+            return check_total_queue_cpu(history)
+        return check_total_queue_batch([history])[0]

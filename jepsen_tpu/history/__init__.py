@@ -1,0 +1,14 @@
+"""History substrate: op schema, serialization, and int32 tensor packing."""
+
+from jepsen_tpu.history.ops import (  # noqa: F401
+    Op,
+    OpType,
+    OpF,
+    NO_VALUE,
+    NEMESIS_PROCESS,
+)
+from jepsen_tpu.history.encode import (  # noqa: F401
+    PackedHistories,
+    pack_histories,
+    pack_history,
+)

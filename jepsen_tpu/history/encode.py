@@ -1,0 +1,177 @@
+"""Packing histories into fixed-shape ``int32`` tensors for TPU checking.
+
+Design (SURVEY.md §7.1): an op becomes a row of int32 columns
+``(index, process, type, f, value, time_ms, latency_ms)``; a batch of
+histories is a struct-of-arrays of shape ``[B, L]`` per column plus a
+``mask``.  Struct-of-arrays (not an ``[B, L, 7]`` array-of-structs) so each
+column lays out contiguously along the TPU lane dimension and kernels touch
+only the columns they need.
+
+Two encoding rules make irregular Jepsen histories regular:
+
+1. **Drain explosion.**  A drain completion carries a *list* of values
+   (reference: ``Utils.java:140-145`` returns a vector of ints).  The packer
+   explodes it into one row per drained value (same process/time, ``f=DRAIN``,
+   ``type=OK``) so every row has a scalar value.  An empty drain becomes a
+   single row with ``value = NO_VALUE``.
+2. **Padding/bucketing.**  Histories are padded to a fixed length ``L``
+   (rounded up to a multiple of 128 — the TPU lane width — by default);
+   padded rows have ``mask=False`` and must be no-ops in every kernel.
+
+``latency_ms`` is precomputed host-side on completion rows (completion time −
+invocation time, per process) so the perf checker is pure tensor math; it is
+``-1`` on invocations, pads, and unmatched completions.
+
+Values are dense small ints from a single incrementing counter (reference:
+``rabbitmq.clj:245-247``), so a per-history value-space of size ``V ≈ L`` is
+enough: no enqueue attempt can exist without occupying an op slot.  ``V`` is
+recorded on the packed batch and is the scatter width of the count kernels.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from jepsen_tpu.history.ops import NO_VALUE, Op, OpF, OpType
+
+LANE = 128  # TPU lane width; default padding granule
+
+
+def _round_up(n: int, k: int) -> int:
+    return ((max(n, 1) + k - 1) // k) * k
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PackedHistories:
+    """A batch of histories as ``[B, L]`` int32 columns (+ bool mask).
+
+    ``value_space`` (static): scatter width V of per-value count kernels.
+    All values are either in ``[0, V)`` or ``NO_VALUE``.
+    """
+
+    index: jax.Array  # [B, L] int32 — original history index of the row
+    process: jax.Array  # [B, L] int32
+    type: jax.Array  # [B, L] int32 — OpType codes
+    f: jax.Array  # [B, L] int32 — OpF codes
+    value: jax.Array  # [B, L] int32 — scalar value or NO_VALUE
+    time_ms: jax.Array  # [B, L] int32 — ms since history start
+    latency_ms: jax.Array  # [B, L] int32 — completion latency or -1
+    mask: jax.Array  # [B, L] bool
+    value_space: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def batch(self) -> int:
+        return self.type.shape[0]
+
+    @property
+    def length(self) -> int:
+        return self.type.shape[1]
+
+
+_COLUMNS = ("index", "process", "type", "f", "value", "time_ms", "latency_ms")
+
+
+def _rows_for(history: Sequence[Op]) -> np.ndarray:
+    """Explode one history into an ``[n, 7]`` int32 row matrix."""
+    open_invoke_time: dict[int, int] = {}
+    rows: list[tuple[int, int, int, int, int, int, int]] = []
+    for op in history:
+        t_ms = op.time // 1_000_000 if op.time >= 0 else -1
+        latency = -1
+        if op.type == OpType.INVOKE:
+            open_invoke_time[op.process] = op.time
+        else:
+            inv_t = open_invoke_time.pop(op.process, -1)
+            if inv_t >= 0 and op.time >= 0:
+                latency = (op.time - inv_t) // 1_000_000
+        values = op.value if isinstance(op.value, (list, tuple)) else [op.value]
+        if len(values) == 0:
+            values = [None]
+        first = True
+        for v in values:
+            vi = v if isinstance(v, int) else NO_VALUE
+            rows.append(
+                (
+                    op.index,
+                    op.process,
+                    int(op.type),
+                    int(op.f),
+                    vi,
+                    t_ms,
+                    latency if first else -1,
+                )
+            )
+            first = False
+    return np.asarray(rows, dtype=np.int32).reshape(-1, len(_COLUMNS))
+
+
+def pack_histories(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    value_space: int | None = None,
+) -> PackedHistories:
+    """Pack a batch of histories into one ``PackedHistories``.
+
+    ``length``: target L; default = max exploded length rounded up to 128.
+    ``value_space``: scatter width V; default = max(value)+1 across the batch
+    rounded up to 128 (at least 128).
+    """
+    if not histories:
+        raise ValueError("cannot pack an empty batch of histories")
+    mats = [_rows_for(h) for h in histories]
+    n_max = max(m.shape[0] for m in mats)
+    L = length if length is not None else _round_up(n_max, LANE)
+    if n_max > L:
+        raise ValueError(f"history of exploded length {n_max} exceeds L={L}")
+    B = len(mats)
+
+    cols = {c: np.full((B, L), -1, dtype=np.int32) for c in _COLUMNS}
+    cols["value"][:] = NO_VALUE
+    mask = np.zeros((B, L), dtype=bool)
+    vmax = 0
+    for b, m in enumerate(mats):
+        n = m.shape[0]
+        for ci, c in enumerate(_COLUMNS):
+            cols[c][b, :n] = m[:, ci]
+        mask[b, :n] = True
+        if n:
+            vmax = max(vmax, int(m[:, 4].max(initial=0)))
+    V = (
+        value_space
+        if value_space is not None
+        else _round_up(vmax + 1, LANE)
+    )
+    if vmax >= V:
+        # values outside [0, V) would be silently dropped by the scatter
+        # kernels — exactly the values an "unexpected" anomaly produces
+        raise ValueError(
+            f"history contains value {vmax} >= value_space {V}; "
+            "raise value_space (or omit it to size automatically)"
+        )
+
+    return PackedHistories(
+        index=jax.numpy.asarray(cols["index"]),
+        process=jax.numpy.asarray(cols["process"]),
+        type=jax.numpy.asarray(cols["type"]),
+        f=jax.numpy.asarray(cols["f"]),
+        value=jax.numpy.asarray(cols["value"]),
+        time_ms=jax.numpy.asarray(cols["time_ms"]),
+        latency_ms=jax.numpy.asarray(cols["latency_ms"]),
+        mask=jax.numpy.asarray(mask),
+        value_space=V,
+    )
+
+
+def pack_history(
+    history: Sequence[Op],
+    length: int | None = None,
+    value_space: int | None = None,
+) -> PackedHistories:
+    """Pack a single history (batch dim of 1)."""
+    return pack_histories([history], length=length, value_space=value_space)

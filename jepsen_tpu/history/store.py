@@ -1,0 +1,100 @@
+"""Per-run results store.
+
+Mirrors the reference's ``store/`` contract consumed by its CI triage
+(``/root/reference/ci/jepsen-test.sh:157-162,180``): each run gets a
+timestamped directory under ``store/<test-name>/``, with ``current`` and
+``latest`` symlinks pointing at it; the run dir holds the recorded history
+(``history.jsonl``), the run log (``jepsen.log``), analysis results
+(``results.json``), and any node logs collected at teardown.
+
+The recorded history is the framework's checkpoint: analysis is a pure
+function of it, so stored histories can be re-checked (and batch-replayed on
+TPU) at any time without a cluster (SURVEY.md §5 checkpoint/resume).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time as _time
+from pathlib import Path
+from typing import Any, Iterable, Sequence
+
+from jepsen_tpu.history.ops import Op
+
+
+HISTORY_FILE = "history.jsonl"
+RESULTS_FILE = "results.json"
+LOG_FILE = "jepsen.log"
+
+
+def write_history_jsonl(path: str | Path, history: Iterable[Op]) -> None:
+    with open(path, "w") as fh:
+        for op in history:
+            fh.write(json.dumps(op.to_json()) + "\n")
+
+
+def read_history_jsonl(path: str | Path) -> list[Op]:
+    out = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                out.append(Op.from_json(json.loads(line)))
+    return out
+
+
+class Store:
+    """``store/<test-name>/<timestamp>/`` with ``current``/``latest`` links."""
+
+    def __init__(self, root: str | Path = "store"):
+        self.root = Path(root)
+
+    def run_dir(self, test_name: str, timestamp: str | None = None) -> Path:
+        ts = timestamp or _time.strftime("%Y%m%dT%H%M%S")
+        d = self.root / test_name / ts
+        n = 1
+        while d.exists():  # uniquify: two runs in the same second must not
+            d = self.root / test_name / f"{ts}-{n}"  # share (and overwrite)
+            n += 1  # each other's artifacts
+        d.mkdir(parents=True)
+        self._relink(self.root / test_name / "current", d)
+        self._relink(self.root / "current", d)
+        self._relink(self.root / "latest", d)
+        return d
+
+    @staticmethod
+    def _relink(link: Path, target: Path) -> None:
+        link.parent.mkdir(parents=True, exist_ok=True)
+        if link.is_symlink() or link.exists():
+            link.unlink()
+        os.symlink(target.resolve(), link)
+
+    # ---- artifacts -------------------------------------------------------
+    def save_history(self, run_dir: Path, history: Sequence[Op]) -> Path:
+        p = run_dir / HISTORY_FILE
+        write_history_jsonl(p, history)
+        return p
+
+    def save_results(self, run_dir: Path, results: dict[str, Any]) -> Path:
+        p = run_dir / RESULTS_FILE
+        with open(p, "w") as fh:
+            json.dump(results, fh, indent=2, default=_json_default)
+        return p
+
+    def load_history(self, run_dir: str | Path) -> list[Op]:
+        return read_history_jsonl(Path(run_dir) / HISTORY_FILE)
+
+    def latest(self) -> Path | None:
+        link = self.root / "latest"
+        return link.resolve() if link.exists() else None
+
+
+def _json_default(o: Any):
+    if isinstance(o, (set, frozenset)):
+        return sorted(o)
+    if hasattr(o, "tolist"):
+        return o.tolist()
+    if hasattr(o, "item"):
+        return o.item()
+    raise TypeError(f"not JSON serializable: {type(o)}")

@@ -1,0 +1,6 @@
+"""JAX kernels shared by the TPU checkers."""
+
+from jepsen_tpu.ops.counts import (  # noqa: F401
+    masked_value_counts,
+    masked_value_reduce_min,
+)

@@ -1,0 +1,59 @@
+"""Masked per-value scatter kernels.
+
+The total-queue and per-value-linearizability checkers reduce a history to
+per-value statistics over a dense value space of width ``V`` (values come
+from a single incrementing counter — reference ``rabbitmq.clj:245-247`` — so
+the space is dense and small).  The core primitive is a masked scatter-add /
+scatter-min / scatter-max into a ``[V]`` vector; unselected rows are routed
+to index ``V`` — genuinely out of bounds, so ``mode='drop'`` discards them
+(note ``-1`` would *wrap* to ``V-1``, not drop) — making padded rows no-ops
+by construction.  The scattered payload is additionally neutralized with
+``where(select, …)`` as defense in depth.
+
+These are plain XLA scatters: on TPU they lower to efficient sorted-scatter
+loops, and under ``shard_map`` the op axis can be sharded with a ``psum``
+combining step (see ``jepsen_tpu.parallel``) — the long-history analog of
+sequence parallelism.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _routed(values: jax.Array, select: jax.Array, value_space: int) -> jax.Array:
+    """Scatter indices: the value where selected, else ``V`` (out of bounds,
+    dropped by ``mode='drop'``)."""
+    return jnp.where(select, values, value_space)
+
+
+def masked_value_counts(
+    values: jax.Array,  # [L] int32
+    select: jax.Array,  # [L] bool
+    value_space: int,
+    weights: jax.Array | None = None,  # [L] int32, default 1
+) -> jax.Array:
+    """``out[v] = sum(weights[i] for i where select[i] and values[i]==v)``."""
+    w = jnp.ones_like(values) if weights is None else weights
+    return (
+        jnp.zeros((value_space,), jnp.int32)
+        .at[_routed(values, select, value_space)]
+        .add(jnp.where(select, w, 0), mode="drop")
+    )
+
+
+def masked_value_reduce_min(
+    values: jax.Array,  # [L] int32
+    select: jax.Array,  # [L] bool
+    payload: jax.Array,  # [L] int32 — quantity to min-reduce per value
+    value_space: int,
+    init: int = 2**31 - 1,
+) -> jax.Array:
+    """``out[v] = min(payload[i] for i where select[i] and values[i]==v)``,
+    ``init`` where no row matched."""
+    return (
+        jnp.full((value_space,), init, jnp.int32)
+        .at[_routed(values, select, value_space)]
+        .min(jnp.where(select, payload, init), mode="drop")
+    )

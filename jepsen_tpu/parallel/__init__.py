@@ -1,0 +1,11 @@
+"""Device-mesh sharding for batched history checking."""
+
+from jepsen_tpu.parallel.mesh import (  # noqa: F401
+    HIST_AXIS,
+    SEQ_AXIS,
+    checker_mesh,
+    shard_packed,
+    sharded_check,
+    sharded_queue_lin,
+    sharded_total_queue,
+)

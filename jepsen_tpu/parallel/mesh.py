@@ -1,0 +1,169 @@
+"""Sharded checking over a 2-D device mesh.
+
+The checker plane is the part of the reference with *no* distributed story
+(single-threaded, in-process — SURVEY.md §2.4); this module is its TPU-native
+replacement.  Two mesh axes map the two scaling dimensions of history
+checking:
+
+- ``hist`` — data parallelism across histories.  Each history is checked
+  independently (``jax.vmap``), so the batch axis shards with **zero**
+  communication; this is the primary axis and rides ICI (and DCN across
+  hosts via ``jax.distributed``).
+- ``seq`` — sequence parallelism *within* a history, for long histories
+  (the long-context analog, SURVEY.md §5).  The count-vector stage of each
+  checker is linear in ops, so the op axis shards freely: every device
+  scatters its op block into a full local ``[V]`` count vector, a
+  ``lax.psum`` over ``seq`` combines them (one all-reduce of a few small
+  int vectors — tiny on the wire), and the nonlinear classify stage runs on
+  the combined counts, replicated over ``seq``.
+
+This is the "pick a mesh, annotate shardings, let XLA insert collectives"
+recipe: the only hand-placed collectives are the ``psum``/``pmin`` combines
+in the ``shard_map`` bodies.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from jepsen_tpu.checkers.queue_lin import (
+    QueueLinTensors,
+    queue_lin_classify,
+    queue_lin_count_vectors,
+)
+from jepsen_tpu.checkers.total_queue import (
+    TotalQueueTensors,
+    total_queue_classify,
+    total_queue_count_vectors,
+)
+from jepsen_tpu.history.encode import PackedHistories
+
+HIST_AXIS = "hist"
+SEQ_AXIS = "seq"
+
+try:  # jax ≥ 0.4.35 exposes shard_map at top level
+    shard_map = jax.shard_map
+except AttributeError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+def checker_mesh(
+    devices=None, seq: int = 1, hist: int | None = None
+) -> Mesh:
+    """A ``(hist, seq)`` mesh over ``devices`` (default: all devices).
+
+    ``seq=1`` puts every device on the embarrassingly-parallel ``hist``
+    axis — the right default until single histories outgrow one chip.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if hist is None:
+        if n % seq:
+            raise ValueError(f"{n} devices not divisible by seq={seq}")
+        hist = n // seq
+    from jax.experimental import mesh_utils
+
+    arr = mesh_utils.create_device_mesh((hist, seq), devices=devices)
+    return Mesh(arr, (HIST_AXIS, SEQ_AXIS))
+
+
+def _row_spec() -> P:
+    return P(HIST_AXIS, SEQ_AXIS)
+
+
+def shard_packed(packed: PackedHistories, mesh: Mesh) -> PackedHistories:
+    """Place a packed batch on the mesh: ``[B, L]`` → (hist, seq) sharded."""
+    sh = NamedSharding(mesh, _row_spec())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), packed)
+
+
+# ---------------------------------------------------------------------------
+# shard_map'd checkers — jitted programs memoized per (mesh, value_space)
+# so repeated batch checks hit the compile cache
+# ---------------------------------------------------------------------------
+
+
+def _vmap_counts(count_fn, value_space, *cols):
+    return jax.vmap(lambda *row: count_fn(*row, value_space))(*cols)
+
+
+@functools.lru_cache(maxsize=64)
+def _total_queue_program(mesh: Mesh, value_space: int):
+    def body(f, ty, v, m):
+        a, e, d = _vmap_counts(total_queue_count_vectors, value_space, f, ty, v, m)
+        a, e, d = jax.lax.psum((a, e, d), SEQ_AXIS)
+        return total_queue_classify(a, e, d)
+
+    out_specs = TotalQueueTensors(
+        valid=P(HIST_AXIS),
+        attempt_count=P(HIST_AXIS),
+        acknowledged_count=P(HIST_AXIS),
+        ok_count=P(HIST_AXIS),
+        lost=P(HIST_AXIS, None),
+        unexpected=P(HIST_AXIS, None),
+        duplicated=P(HIST_AXIS, None),
+        recovered=P(HIST_AXIS, None),
+    )
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(_row_spec(),) * 4, out_specs=out_specs
+        )
+    )
+
+
+def sharded_total_queue(
+    packed: PackedHistories, mesh: Mesh
+) -> TotalQueueTensors:
+    """total-queue over the mesh: local scatter → psum(seq) → classify."""
+    fn = _total_queue_program(mesh, packed.value_space)
+    return fn(packed.f, packed.type, packed.value, packed.mask)
+
+
+@functools.lru_cache(maxsize=64)
+def _queue_lin_program(mesh: Mesh, value_space: int):
+    def body(f, ty, v, m):
+        # global history position of each local row: shard offset + iota
+        n_local = f.shape[-1]
+        offset = jax.lax.axis_index(SEQ_AXIS).astype(jnp.int32) * n_local
+        pos = jnp.broadcast_to(
+            offset + jnp.arange(n_local, dtype=jnp.int32), f.shape
+        )
+        a, x, s, r, t = _vmap_counts(
+            queue_lin_count_vectors, value_space, f, ty, v, pos, m
+        )
+        a, x, r = jax.lax.psum((a, x, r), SEQ_AXIS)
+        s = jax.lax.pmin(s, SEQ_AXIS)
+        t = jax.lax.pmin(t, SEQ_AXIS)
+        return queue_lin_classify(a, x, s, r, t)
+
+    out_specs = QueueLinTensors(
+        valid=P(HIST_AXIS),
+        duplicate=P(HIST_AXIS, None),
+        phantom=P(HIST_AXIS, None),
+        causality=P(HIST_AXIS, None),
+        read_value_count=P(HIST_AXIS),
+    )
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(_row_spec(),) * 4, out_specs=out_specs
+        )
+    )
+
+
+def sharded_queue_lin(
+    packed: PackedHistories, mesh: Mesh
+) -> QueueLinTensors:
+    """queue linearizability over the mesh: psum counts, pmin positions."""
+    fn = _queue_lin_program(mesh, packed.value_space)
+    return fn(packed.f, packed.type, packed.value, packed.mask)
+
+
+def sharded_check(
+    packed: PackedHistories, mesh: Mesh
+) -> tuple[TotalQueueTensors, QueueLinTensors]:
+    """The full per-history verdict (both checkers) over the mesh."""
+    return sharded_total_queue(packed, mesh), sharded_queue_lin(packed, mesh)

@@ -1,0 +1,28 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
+
+The environment pins ``JAX_PLATFORMS=axon`` (a single tunneled TPU chip) via
+``sitecustomize``, which imports jax at interpreter start.  The backend is
+not *initialized* until first use, so flipping ``jax_platforms`` to ``cpu``
+and appending ``--xla_force_host_platform_device_count=8`` here — before any
+test touches jax — gives every test the 8-device virtual CPU mesh that the
+sharding tests (and the driver's ``dryrun_multichip``) expect.
+"""
+
+import os
+
+import jax
+import pytest
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices()
+    assert jax.default_backend() == "cpu" and len(devs) == 8, (
+        "tests expect the 8-device virtual CPU mesh"
+    )
+    return devs

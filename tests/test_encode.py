@@ -1,0 +1,149 @@
+"""History substrate tests: op schema, packing, store round-trips."""
+
+import numpy as np
+
+from jepsen_tpu.history import NO_VALUE, Op, OpF, OpType, pack_histories
+from jepsen_tpu.history.ops import reindex
+from jepsen_tpu.history.store import Store, read_history_jsonl, write_history_jsonl
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+def _small_history():
+    t = 1_000_000  # 1 ms in ns
+    ops = [
+        Op.invoke(OpF.ENQUEUE, 0, 0, time=1 * t),
+        Op(OpType.OK, OpF.ENQUEUE, 0, 0, time=3 * t),
+        Op.invoke(OpF.DEQUEUE, 1, time=2 * t),
+        Op(OpType.OK, OpF.DEQUEUE, 1, 0, time=6 * t),
+        Op.invoke(OpF.DRAIN, 0, time=10 * t),
+        Op(OpType.OK, OpF.DRAIN, 0, [1, 2, 3], time=14 * t),
+    ]
+    return reindex(ops)
+
+
+def test_pack_shapes_and_mask():
+    h = _small_history()
+    p = pack_histories([h])
+    assert p.batch == 1
+    assert p.length % 128 == 0
+    # drain [1,2,3] explodes into 3 rows: 6 ops -> 8 rows
+    assert int(np.asarray(p.mask).sum()) == 8
+    assert p.value_space % 128 == 0 and p.value_space >= 4
+
+
+def test_drain_explosion_values():
+    h = _small_history()
+    p = pack_histories([h])
+    f = np.asarray(p.f)[0]
+    v = np.asarray(p.value)[0]
+    ty = np.asarray(p.type)[0]
+    drain_rows = (f == int(OpF.DRAIN)) & (ty == int(OpType.OK))
+    assert sorted(v[drain_rows].tolist()) == [1, 2, 3]
+
+
+def test_latency_computed_on_completions():
+    h = _small_history()
+    p = pack_histories([h])
+    lat = np.asarray(p.latency_ms)[0]
+    ty = np.asarray(p.type)[0]
+    f = np.asarray(p.f)[0]
+    enq_ok = (ty == int(OpType.OK)) & (f == int(OpF.ENQUEUE))
+    deq_ok = (ty == int(OpType.OK)) & (f == int(OpF.DEQUEUE))
+    assert lat[enq_ok].tolist() == [2]  # 3ms - 1ms
+    assert lat[deq_ok].tolist() == [4]  # 6ms - 2ms
+    assert (lat[ty == int(OpType.INVOKE)] == -1).all()
+
+
+def test_pack_batch_padding():
+    h1 = _small_history()
+    h2 = _small_history()[:2]
+    p = pack_histories([h1, h2], length=256)
+    assert p.type.shape == (2, 256)
+    m = np.asarray(p.mask)
+    assert m[0].sum() == 8 and m[1].sum() == 2
+
+
+def test_empty_drain_row_is_masked_no_value():
+    ops = reindex(
+        [
+            Op.invoke(OpF.DRAIN, 0, time=0),
+            Op(OpType.OK, OpF.DRAIN, 0, [], time=1),
+        ]
+    )
+    p = pack_histories([ops])
+    v = np.asarray(p.value)[0]
+    m = np.asarray(p.mask)[0]
+    assert m.sum() == 2
+    f = np.asarray(p.f)[0]
+    ty = np.asarray(p.type)[0]
+    row = m & (f == int(OpF.DRAIN)) & (ty == int(OpType.OK))
+    assert v[row].tolist() == [NO_VALUE]
+
+
+def test_jsonl_roundtrip(tmp_path):
+    h = synth_history(SynthSpec(n_ops=50, seed=3)).ops
+    path = tmp_path / "history.jsonl"
+    write_history_jsonl(path, h)
+    h2 = read_history_jsonl(path)
+    assert len(h2) == len(h)
+    for a, b in zip(h, h2):
+        assert (a.type, a.f, a.process, a.value, a.time, a.index) == (
+            b.type,
+            b.f,
+            b.process,
+            b.value,
+            b.time,
+            b.index,
+        )
+
+
+def test_store_layout_and_symlinks(tmp_path):
+    st = Store(tmp_path / "store")
+    d = st.run_dir("rabbitmq-simple-partition", "20260729T000000")
+    h = synth_history(SynthSpec(n_ops=20, seed=1)).ops
+    st.save_history(d, h)
+    st.save_results(d, {"valid?": True, "lost": set()})
+    assert (tmp_path / "store" / "latest").resolve() == d.resolve()
+    assert (tmp_path / "store" / "current").resolve() == d.resolve()
+    assert st.load_history(st.latest())[0].index == 0
+    d2 = st.run_dir("rabbitmq-simple-partition", "20260729T000001")
+    assert (tmp_path / "store" / "latest").resolve() == d2.resolve()
+
+
+def test_value_overflow_raises():
+    import pytest
+
+    ops = reindex(
+        [
+            Op.invoke(OpF.ENQUEUE, 0, 500, time=0),
+            Op(OpType.OK, OpF.ENQUEUE, 0, 500, time=1),
+        ]
+    )
+    with pytest.raises(ValueError, match="value_space"):
+        pack_histories([ops], value_space=128)
+    # automatic sizing covers the value
+    assert pack_histories([ops]).value_space >= 501
+
+
+def test_unindexed_history_not_masked_out():
+    # ops recorded without reindex() (index = -1) must still be checked
+    from jepsen_tpu.checkers.total_queue import (
+        check_total_queue_batch,
+        check_total_queue_cpu,
+    )
+
+    ops = [
+        Op.invoke(OpF.DEQUEUE, 0, time=0),
+        Op(OpType.OK, OpF.DEQUEUE, 0, 7, time=1),  # unexpected read
+    ]
+    cpu = check_total_queue_cpu(ops)
+    tpu = check_total_queue_batch([ops])[0]
+    assert cpu == tpu
+    assert not tpu["valid?"] and tpu["unexpected"] == {7}
+
+
+def test_empty_batch_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="empty batch"):
+        pack_histories([])

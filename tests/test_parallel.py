@@ -1,0 +1,54 @@
+"""Sharded checking on the virtual 8-device CPU mesh: results must be
+identical to the single-device path for every mesh shape."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.queue_lin import queue_lin_tensor_check
+from jepsen_tpu.checkers.total_queue import total_queue_tensor_check
+from jepsen_tpu.history.encode import pack_histories
+from jepsen_tpu.history.synth import SynthSpec, synth_batch
+from jepsen_tpu.parallel import (
+    checker_mesh,
+    shard_packed,
+    sharded_queue_lin,
+    sharded_total_queue,
+)
+
+
+def _tree_equal(a, b):
+    fa = {k: np.asarray(getattr(a, k)) for k in a.__dataclass_fields__}
+    fb = {k: np.asarray(getattr(b, k)) for k in b.__dataclass_fields__}
+    for k in fa:
+        np.testing.assert_array_equal(fa[k], fb[k], err_msg=k)
+
+
+@pytest.fixture(scope="module")
+def packed():
+    batch = synth_batch(16, SynthSpec(n_ops=200), lost=1, duplicated=1)
+    return pack_histories([sh.ops for sh in batch], length=512)
+
+
+@pytest.mark.parametrize("seq", [1, 2, 4])
+def test_sharded_total_queue_matches(cpu_devices, packed, seq):
+    mesh = checker_mesh(cpu_devices, seq=seq)
+    sharded = shard_packed(packed, mesh)
+    _tree_equal(
+        sharded_total_queue(sharded, mesh), total_queue_tensor_check(packed)
+    )
+
+
+@pytest.mark.parametrize("seq", [1, 2, 4])
+def test_sharded_queue_lin_matches(cpu_devices, packed, seq):
+    mesh = checker_mesh(cpu_devices, seq=seq)
+    sharded = shard_packed(packed, mesh)
+    _tree_equal(
+        sharded_queue_lin(sharded, mesh), queue_lin_tensor_check(packed)
+    )
+
+
+def test_mesh_shapes(cpu_devices):
+    m = checker_mesh(cpu_devices, seq=2)
+    assert m.shape == {"hist": 4, "seq": 2}
+    m1 = checker_mesh(cpu_devices)
+    assert m1.shape == {"hist": 8, "seq": 1}

@@ -1,0 +1,113 @@
+"""Per-value queue linearizability: anomaly detection + CPU≡TPU."""
+
+import pytest
+
+from jepsen_tpu.checkers.queue_lin import (
+    check_queue_lin_batch,
+    check_queue_lin_cpu,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+def both(history):
+    cpu = check_queue_lin_cpu(history)
+    tpu = check_queue_lin_batch([history])[0]
+    assert cpu == tpu, f"cpu/tpu divergence:\n{cpu}\n{tpu}"
+    return cpu
+
+
+def test_clean_history_linearizable():
+    sh = synth_history(SynthSpec(n_ops=300, seed=11))
+    assert both(sh.ops)["valid?"]
+
+
+def test_lost_values_still_linearizable():
+    # loss is total-queue's concern; the value just never came out
+    sh = synth_history(SynthSpec(n_ops=300, seed=12, lost=2))
+    assert both(sh.ops)["valid?"]
+
+
+def test_duplicate_delivery_not_linearizable():
+    sh = synth_history(SynthSpec(n_ops=300, seed=13, duplicated=2))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["duplicate"] == sh.duplicated
+
+
+def test_phantom_from_nowhere():
+    sh = synth_history(SynthSpec(n_ops=300, seed=14, unexpected=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert sh.unexpected <= r["phantom"]
+
+
+def test_phantom_from_failed_enqueue():
+    sh = synth_history(SynthSpec(n_ops=300, seed=15, phantom_fail=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert sh.phantom_fail <= r["phantom"]
+
+
+def test_causality_violation():
+    sh = synth_history(SynthSpec(n_ops=200, seed=16, causality=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["causality"] == sh.causality
+
+
+def test_indeterminate_enqueue_read_is_linearizable():
+    ops = reindex(
+        [
+            Op.invoke(OpF.ENQUEUE, 0, 3, time=0),
+            Op(OpType.INFO, OpF.ENQUEUE, 0, 3, time=1_000_000, error="timeout"),
+            Op.invoke(OpF.DEQUEUE, 1, time=5_000_000),
+            Op(OpType.OK, OpF.DEQUEUE, 1, 3, time=6_000_000),
+        ]
+    )
+    assert both(ops)["valid?"]
+
+
+def test_overlapping_enqueue_dequeue_is_linearizable():
+    # dequeue completes after enqueue *starts* but before it completes:
+    # points p_enq < p_deq exist inside both intervals
+    ops = reindex(
+        [
+            Op.invoke(OpF.ENQUEUE, 0, 5, time=0),
+            Op.invoke(OpF.DEQUEUE, 1, time=1_000_000),
+            Op(OpType.OK, OpF.DEQUEUE, 1, 5, time=2_000_000),
+            Op(OpType.OK, OpF.ENQUEUE, 0, 5, time=3_000_000),
+        ]
+    )
+    assert both(ops)["valid?"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_differential_random(seed):
+    sh = synth_history(
+        SynthSpec(
+            n_ops=400,
+            seed=200 + seed,
+            duplicated=seed % 2,
+            unexpected=(seed + 1) % 2,
+        )
+    )
+    r = both(sh.ops)
+    assert r["valid?"] == (not sh.duplicated and not sh.unexpected)
+
+
+def test_sub_ms_causality_detected():
+    # read completes 300us before the enqueue is invoked: both land in the
+    # same millisecond, so ordering must come from history order, not
+    # truncated timestamps
+    ops = reindex(
+        [
+            Op.invoke(OpF.DEQUEUE, 1, time=100_000),
+            Op(OpType.OK, OpF.DEQUEUE, 1, 0, time=200_000),
+            Op.invoke(OpF.ENQUEUE, 0, 0, time=500_000),
+            Op(OpType.OK, OpF.ENQUEUE, 0, 0, time=600_000),
+        ]
+    )
+    r = both(ops)
+    assert not r["valid?"]
+    assert r["causality"] == {0}

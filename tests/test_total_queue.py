@@ -1,0 +1,116 @@
+"""total-queue checker: anomaly detection + CPU≡TPU differential tests."""
+
+import pytest
+
+from jepsen_tpu.checkers.total_queue import (
+    check_total_queue_batch,
+    check_total_queue_cpu,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import SynthSpec, synth_batch, synth_history
+
+
+def both(history):
+    cpu = check_total_queue_cpu(history)
+    tpu = check_total_queue_batch([history])[0]
+    assert cpu == tpu, f"cpu/tpu divergence:\n{cpu}\n{tpu}"
+    return cpu
+
+
+def test_clean_history_valid():
+    sh = synth_history(SynthSpec(n_ops=300, seed=1))
+    r = both(sh.ops)
+    assert r["valid?"]
+    assert r["lost-count"] == 0 and r["unexpected-count"] == 0
+    assert r["attempt-count"] >= r["acknowledged-count"]
+
+
+def test_lost_detected():
+    sh = synth_history(SynthSpec(n_ops=300, seed=2, lost=3))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["lost"] == sh.lost
+
+
+def test_duplicates_reported_but_valid():
+    sh = synth_history(SynthSpec(n_ops=300, seed=3, duplicated=2))
+    r = both(sh.ops)
+    assert r["valid?"]  # at-least-once delivery is legal
+    assert r["duplicated"] == sh.duplicated
+    assert r["duplicated-count"] == 2
+
+
+def test_unexpected_detected():
+    sh = synth_history(SynthSpec(n_ops=300, seed=4, unexpected=2))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["unexpected"] == sh.unexpected
+
+
+def test_recovered_from_indeterminate_enqueue():
+    # an :info enqueue whose value surfaces later is recovered, and valid
+    ops = reindex(
+        [
+            Op.invoke(OpF.ENQUEUE, 0, 7, time=0),
+            Op(OpType.INFO, OpF.ENQUEUE, 0, 7, time=1_000_000, error="timeout"),
+            Op.invoke(OpF.DEQUEUE, 1, time=2_000_000),
+            Op(OpType.OK, OpF.DEQUEUE, 1, 7, time=3_000_000),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"]
+    assert r["recovered"] == {7}
+    assert r["ok-count"] == 1 and r["acknowledged-count"] == 0
+
+
+def test_phantom_fail_is_recovered_not_unexpected():
+    # total-queue counts attempts (invokes), so a read of a *failed* enqueue
+    # still matched an attempt: recovered here, flagged by queue-lin instead
+    sh = synth_history(SynthSpec(n_ops=200, seed=5, phantom_fail=1))
+    r = both(sh.ops)
+    assert r["valid?"]
+    assert sh.phantom_fail <= r["recovered"]
+
+
+def test_readme_shape_keys():
+    r = both(synth_history(SynthSpec(n_ops=100, seed=6)).ops)
+    expect = {
+        "valid?",
+        "attempt-count",
+        "acknowledged-count",
+        "ok-count",
+        "lost",
+        "lost-count",
+        "unexpected",
+        "unexpected-count",
+        "duplicated",
+        "duplicated-count",
+        "recovered",
+        "recovered-count",
+    }
+    assert set(r) == expect
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_differential_random_mixed_anomalies(seed):
+    sh = synth_history(
+        SynthSpec(
+            n_ops=400,
+            seed=100 + seed,
+            lost=seed % 3,
+            duplicated=(seed + 1) % 2,
+            unexpected=seed % 2,
+        )
+    )
+    r = both(sh.ops)
+    assert r["lost"] == sh.lost
+    assert sh.unexpected == r["unexpected"]
+    assert r["valid?"] == (not sh.lost and not sh.unexpected)
+
+
+def test_batched_matches_per_history():
+    batch = synth_batch(8, SynthSpec(n_ops=150), lost=1)
+    histories = [sh.ops for sh in batch]
+    rs = check_total_queue_batch(histories)
+    for sh, r in zip(batch, rs):
+        assert r == check_total_queue_cpu(sh.ops)

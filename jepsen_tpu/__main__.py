@@ -1,0 +1,7 @@
+"""``python -m jepsen_tpu`` entry point."""
+
+import sys
+
+from jepsen_tpu.cli.main import main
+
+sys.exit(main())

@@ -20,3 +20,4 @@ from jepsen_tpu.checkers.queue_lin import (  # noqa: F401
     check_queue_lin_cpu,
     queue_lin_tensor_check,
 )
+from jepsen_tpu.checkers.perf import Perf, perf_tensor_check  # noqa: F401

@@ -1,0 +1,224 @@
+"""``perf``: latency-over-time and throughput graphs + windowed statistics.
+
+Equivalent of the reference's ``checker/perf`` (``rabbitmq.clj:264``; always
+``{:valid? true}`` — it renders graphs rather than judging correctness;
+result shape ``/root/reference/README.md:38-40``).  The reference shells out
+to gnuplot on the controller (provisioned at
+``docker/shared/init-control.sh:13``); here the *statistics* are a JAX
+kernel over the packed tensors — windowed completion rates per op function
+and outcome, and windowed latency quantiles from log-spaced histograms —
+and only the final rendering is host-side matplotlib.
+
+Quantiles via histogram: latencies land in ``NBUCKETS`` log-spaced buckets
+per window (a masked scatter-add), and p50/p95/p99 are read off the bucket
+CDF.  Exact order statistics would need per-window sorts of dynamic-length
+groups; the histogram version is one scatter + one scan, error bounded by
+the bucket width (≈12% with 48 buckets over 0.1ms–100s), and batches
+cleanly under ``vmap``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+N_WINDOWS = 64
+N_BUCKETS = 48
+# log-spaced latency bucket edges: 0.1 ms … 100 s
+_EDGES_MS = np.logspace(-1, 5, N_BUCKETS - 1)
+_QUANTILES = (0.5, 0.95, 0.99)
+
+_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN)
+_TYPES = (OpType.OK, OpType.FAIL, OpType.INFO)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class PerfTensors:
+    """Windowed stats per history.
+
+    ``rates``:     [B, W, |F|, |T|] completions per window
+    ``lat_hist``:  [B, W, |F|, NB]  ok-latency histogram
+    ``quantiles``: [B, W, |F|, 3]   p50/p95/p99 ok-latency (ms, bucket edge)
+    ``window_ms``: [B]              window width
+    """
+
+    rates: jax.Array
+    lat_hist: jax.Array
+    quantiles: jax.Array
+    window_ms: jax.Array
+
+
+def _perf_one(f, type_, time_ms, latency_ms, mask, first):
+    """[L] rows → windowed stats for one history."""
+    is_completion = mask & (type_ != int(OpType.INVOKE)) & (time_ms >= 0)
+    t_max = jnp.max(jnp.where(is_completion, time_ms, 0))
+    window_ms = jnp.maximum(t_max // N_WINDOWS + 1, 1)
+    win = jnp.clip(time_ms // window_ms, 0, N_WINDOWS - 1)
+
+    edges = jnp.asarray(_EDGES_MS, jnp.float32)
+    bucket = jnp.searchsorted(edges, latency_ms.astype(jnp.float32))
+
+    def count_grid(select):
+        """Scatter selected rows into [W, |F|, |T|] by (window, f, type)."""
+        fi = f  # OpF codes 0..2 used directly
+        ti = type_ - int(OpType.OK)  # OK/FAIL/INFO → 0..2
+        flat = (win * len(_FS) + fi) * len(_TYPES) + ti
+        flat = jnp.where(select, flat, N_WINDOWS * len(_FS) * len(_TYPES))
+        out = jnp.zeros((N_WINDOWS * len(_FS) * len(_TYPES),), jnp.int32)
+        out = out.at[flat].add(jnp.where(select, 1, 0), mode="drop")
+        return out.reshape(N_WINDOWS, len(_FS), len(_TYPES))
+
+    sel = (
+        is_completion
+        & first  # one count per op, not per drain-exploded row
+        & (f >= int(OpF.ENQUEUE))
+        & (f <= int(OpF.DRAIN))
+        & (type_ >= int(OpType.OK))
+        & (type_ <= int(OpType.INFO))
+    )
+    rates = count_grid(sel)
+
+    ok_lat = sel & (type_ == int(OpType.OK)) & (latency_ms >= 0)
+    flat = (win * len(_FS) + f) * N_BUCKETS + bucket
+    flat = jnp.where(ok_lat, flat, N_WINDOWS * len(_FS) * N_BUCKETS)
+    lat_hist = jnp.zeros((N_WINDOWS * len(_FS) * N_BUCKETS,), jnp.int32)
+    lat_hist = lat_hist.at[flat].add(jnp.where(ok_lat, 1, 0), mode="drop")
+    lat_hist = lat_hist.reshape(N_WINDOWS, len(_FS), N_BUCKETS)
+
+    # quantiles from the bucket CDF (upper edge of the quantile bucket)
+    cdf = jnp.cumsum(lat_hist, axis=-1)
+    total = cdf[..., -1:]
+    uppers = jnp.asarray(
+        np.concatenate([_EDGES_MS, [_EDGES_MS[-1] * 10]]), jnp.float32
+    )
+    qs = []
+    for q in _QUANTILES:
+        need = jnp.ceil(total * q)
+        idx = jnp.argmax(cdf >= jnp.maximum(need, 1), axis=-1)
+        qs.append(jnp.where(total[..., 0] > 0, uppers[idx], -1.0))
+    quantiles = jnp.stack(qs, axis=-1)
+
+    return dict(
+        rates=rates, lat_hist=lat_hist, quantiles=quantiles, window_ms=window_ms
+    )
+
+
+@jax.jit
+def _perf_batch(f, type_, time_ms, latency_ms, mask, first) -> PerfTensors:
+    r = jax.vmap(_perf_one)(f, type_, time_ms, latency_ms, mask, first)
+    return PerfTensors(
+        rates=r["rates"],
+        lat_hist=r["lat_hist"],
+        quantiles=r["quantiles"],
+        window_ms=r["window_ms"],
+    )
+
+
+def perf_tensor_check(packed: PackedHistories) -> PerfTensors:
+    return _perf_batch(
+        packed.f,
+        packed.type,
+        packed.time_ms,
+        packed.latency_ms,
+        packed.mask,
+        packed.first,
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side rendering
+# ---------------------------------------------------------------------------
+
+
+def render_perf_plots(
+    t: PerfTensors, out_dir: str | Path, history_idx: int = 0
+) -> dict[str, str]:
+    """Write ``latency-raw.png`` and ``rate.png`` (reference store artifact
+    names) for one history; returns {plot-name: path}."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    out_dir = Path(out_dir)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    b = history_idx
+    window_s = float(np.asarray(t.window_ms)[b]) / 1e3
+    xs = np.arange(N_WINDOWS) * window_s
+    rates = np.asarray(t.rates)[b]  # [W, F, T]
+    quant = np.asarray(t.quantiles)[b]  # [W, F, 3]
+
+    paths = {}
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for fi, fname in enumerate(("enqueue", "dequeue")):
+        for qi, qname in enumerate(("p50", "p95", "p99")):
+            ys = quant[:, fi, qi]
+            ok = ys > 0
+            ax.plot(xs[ok], ys[ok], marker=".", lw=1, label=f"{fname} {qname}")
+    ax.set_yscale("log")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("latency (ms)")
+    ax.set_title("completion latency quantiles")
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(loc="upper right", fontsize=7)
+    p = out_dir / "latency-raw.png"
+    fig.savefig(p, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    paths["latency-graph"] = str(p)
+
+    fig, ax = plt.subplots(figsize=(9, 4.5))
+    for fi, fname in enumerate(("enqueue", "dequeue")):
+        for ti, tname in enumerate(("ok", "fail", "info")):
+            ys = rates[:, fi, ti] / max(window_s, 1e-9)
+            if ys.sum() == 0:
+                continue
+            ax.plot(xs, ys, lw=1, marker=".", label=f"{fname} {tname}")
+    ax.set_xlabel("time (s)")
+    ax.set_ylabel("ops/s")
+    ax.set_title("completion rate")
+    if ax.get_legend_handles_labels()[0]:
+        ax.legend(loc="upper right", fontsize=7)
+    p = out_dir / "rate.png"
+    fig.savefig(p, dpi=110, bbox_inches="tight")
+    plt.close(fig)
+    paths["rate-graph"] = str(p)
+    return paths
+
+
+class Perf(Checker):
+    """``checker/perf`` equivalent: windowed stats + graphs, always valid."""
+
+    name = "perf"
+
+    def __init__(self, out_dir: str | Path | None = None):
+        self.out_dir = out_dir
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        packed = pack_histories([history])
+        t = perf_tensor_check(packed)
+        result: dict[str, Any] = {
+            VALID: True,
+            "latency-graph": {VALID: True},
+            "rate-graph": {VALID: True},
+        }
+        out_dir = self.out_dir or (opts or {}).get("out_dir")
+        if out_dir is not None:
+            paths = render_perf_plots(t, out_dir)
+            for k, p in paths.items():
+                result[k] = {VALID: True, "file": p}
+        return result

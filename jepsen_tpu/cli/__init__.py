@@ -1,1 +1,3 @@
-"""(built in a later milestone this round)"""
+"""CLI: test assembly and subcommand dispatch."""
+
+from jepsen_tpu.cli.main import build_parser, main  # noqa: F401

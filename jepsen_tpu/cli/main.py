@@ -1,0 +1,214 @@
+"""Command-line interface.
+
+Mirrors the surface the reference gets from ``jepsen.cli``
+(``rabbitmq.clj:329-334``): subcommand dispatch, merged opt specs, and a
+run/analysis lifecycle whose console output the CI triage greps —
+``Analysis invalid`` marks a genuine consistency violation
+(``ci/jepsen-test.sh:180-184``), and a valid run prints the reference's
+"Everything looks good!" banner (``README.md:55``).
+
+Subcommands (this milestone):
+
+- ``check``       — re-check a recorded history (``--checker tpu|cpu``);
+                    the ``--checker`` dispatch point is the north-star seam.
+- ``bench-check`` — batched replay: verify many stored/synthetic histories
+                    at once on the device mesh, report histories/sec.
+- ``synth``       — generate synthetic histories (with injectable
+                    anomalies) into a store, for demos and differential
+                    testing.
+
+The ``test`` subcommand (run a live cluster test) arrives with the control
+plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from jepsen_tpu.checkers.perf import Perf
+from jepsen_tpu.checkers.protocol import VALID, compose
+from jepsen_tpu.checkers.queue_lin import QueueLinearizability
+from jepsen_tpu.checkers.total_queue import TotalQueue
+from jepsen_tpu.history.store import (
+    HISTORY_FILE,
+    Store,
+    read_history_jsonl,
+    save_results,
+    _json_default,
+)
+
+GOOD_BANNER = "Everything looks good! ヽ('ー`)ノ"
+INVALID_BANNER = "Analysis invalid! ಠ~ಠ"
+
+
+def _resolve_history_path(path: Path) -> Path:
+    """Accept a history file, a run dir, or a store root (→ latest run)."""
+    if path.is_file():
+        return path
+    if (path / HISTORY_FILE).is_file():
+        return path / HISTORY_FILE
+    latest = path / "latest"
+    if latest.exists() and (latest / HISTORY_FILE).is_file():
+        return (latest / HISTORY_FILE).resolve()
+    raise FileNotFoundError(f"no {HISTORY_FILE} under {path}")
+
+
+def _checker_for(args, out_dir=None):
+    backend = args.checker
+    return compose(
+        {
+            "perf": Perf(out_dir=out_dir),
+            "queue": TotalQueue(backend=backend),
+            "linear": QueueLinearizability(backend=backend),
+        }
+    )
+
+
+def cmd_check(args) -> int:
+    hpath = _resolve_history_path(Path(args.history)).resolve()
+    history = read_history_jsonl(hpath)
+    out_dir = hpath.parent
+    checker = _checker_for(args, out_dir=out_dir)
+    t0 = time.perf_counter()
+    result = checker.check({}, history)
+    dt = time.perf_counter() - t0
+    print(json.dumps(result, indent=1, default=_json_default))
+    print(
+        f"# checked {len(history)} ops with backend={args.checker} "
+        f"in {dt * 1e3:.1f} ms",
+        file=sys.stderr,
+    )
+    save_results(out_dir, result)
+    if result[VALID]:
+        print(GOOD_BANNER)
+        return 0
+    print(INVALID_BANNER)
+    return 1
+
+
+def cmd_bench_check(args) -> int:
+    from jepsen_tpu.checkers.queue_lin import queue_lin_tensor_check
+    from jepsen_tpu.checkers.total_queue import total_queue_tensor_check
+    from jepsen_tpu.history.encode import pack_histories
+    import jax
+
+    if args.histories:
+        paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}"))
+        if not paths:
+            print(f"no histories under {args.histories}", file=sys.stderr)
+            return 2
+        histories = [read_history_jsonl(p) for p in paths]
+        print(f"# loaded {len(histories)} stored histories", file=sys.stderr)
+    else:
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+        histories = [
+            sh.ops
+            for sh in synth_batch(
+                args.count, SynthSpec(n_ops=args.ops), lost=1
+            )
+        ]
+        print(f"# generated {len(histories)} synthetic histories", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    packed = pack_histories(histories)
+    t_pack = time.perf_counter() - t0
+
+    jax.block_until_ready(
+        (total_queue_tensor_check(packed), queue_lin_tensor_check(packed))
+    )  # compile
+    t1 = time.perf_counter()
+    tq, ql = total_queue_tensor_check(packed), queue_lin_tensor_check(packed)
+    jax.block_until_ready((tq, ql))
+    t_check = time.perf_counter() - t1
+
+    n_invalid = int((~(tq.valid & ql.valid)).sum())
+    print(
+        json.dumps(
+            {
+                "histories": packed.batch,
+                "ops_per_history": packed.length,
+                "pack_s": round(t_pack, 3),
+                "check_s": round(t_check, 5),
+                "histories_per_sec": round(packed.batch / max(t_check, 1e-9), 1),
+                "invalid": n_invalid,
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+    return 0
+
+
+def cmd_synth(args) -> int:
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+    store = Store(args.store)
+    shs = synth_batch(
+        args.count,
+        SynthSpec(n_ops=args.ops),
+        lost=args.lost,
+        duplicated=args.duplicated,
+        unexpected=args.unexpected,
+    )
+    for i, sh in enumerate(shs):
+        d = store.run_dir("synth", f"{time.strftime('%Y%m%dT%H%M%S')}-{i:04d}")
+        store.save_history(d, sh.ops)
+    print(f"wrote {len(shs)} histories under {args.store}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="jepsen_tpu",
+        description="TPU-native distributed-systems correctness testing",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("check", help="re-check a recorded history")
+    c.add_argument("history", help="history.jsonl, run dir, or store root")
+    c.add_argument(
+        "--checker",
+        choices=("tpu", "cpu"),
+        default="tpu",
+        help="analysis backend (the north-star dispatch seam)",
+    )
+    c.set_defaults(fn=cmd_check)
+
+    b = sub.add_parser(
+        "bench-check", help="batched replay of stored/synthetic histories"
+    )
+    b.add_argument("--histories", help="dir tree containing history.jsonl files")
+    b.add_argument("--count", type=int, default=256, help="synthetic histories")
+    b.add_argument("--ops", type=int, default=470, help="invocations per history")
+    b.set_defaults(fn=cmd_bench_check)
+
+    s = sub.add_parser("synth", help="generate synthetic histories into a store")
+    s.add_argument("--store", default="store", help="store root dir")
+    s.add_argument("--count", type=int, default=16)
+    s.add_argument("--ops", type=int, default=470)
+    s.add_argument("--lost", type=int, default=0)
+    s.add_argument("--duplicated", type=int, default=0)
+    s.add_argument("--unexpected", type=int, default=0)
+    s.set_defaults(fn=cmd_synth)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    from jepsen_tpu.utils.jaxenv import ensure_backend
+
+    ensure_backend()
+    try:
+        return args.fn(args)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,10 @@ Two encoding rules make irregular Jepsen histories regular:
    (rounded up to a multiple of 128 — the TPU lane width — by default);
    padded rows have ``mask=False`` and must be no-ops in every kernel.
 
+A ``first`` flag marks the first row of every op (False on the 2nd..kth rows
+of an exploded drain), so per-op statistics — e.g. perf completion rates —
+can count ops rather than rows.
+
 ``latency_ms`` is precomputed host-side on completion rows (completion time −
 invocation time, per process) so the perf checker is pure tensor math; it is
 ``-1`` on invocations, pads, and unmatched completions.
@@ -63,6 +67,7 @@ class PackedHistories:
     time_ms: jax.Array  # [B, L] int32 — ms since history start
     latency_ms: jax.Array  # [B, L] int32 — completion latency or -1
     mask: jax.Array  # [B, L] bool
+    first: jax.Array  # [B, L] bool — first exploded row of its op
     value_space: int = dataclasses.field(metadata=dict(static=True), default=0)
 
     @property
@@ -74,11 +79,12 @@ class PackedHistories:
         return self.type.shape[1]
 
 
-_COLUMNS = ("index", "process", "type", "f", "value", "time_ms", "latency_ms")
+_COLUMNS = ("index", "process", "type", "f", "value", "time_ms", "latency_ms", "first")
 
 
 def _rows_for(history: Sequence[Op]) -> np.ndarray:
-    """Explode one history into an ``[n, 7]`` int32 row matrix."""
+    """Explode one history into an ``[n, 8]`` int32 row matrix (the last
+    column is the 0/1 first-row flag)."""
     open_invoke_time: dict[int, int] = {}
     rows: list[tuple[int, int, int, int, int, int, int]] = []
     for op in history:
@@ -105,6 +111,7 @@ def _rows_for(history: Sequence[Op]) -> np.ndarray:
                     vi,
                     t_ms,
                     latency if first else -1,
+                    1 if first else 0,
                 )
             )
             first = False
@@ -164,6 +171,7 @@ def pack_histories(
         time_ms=jax.numpy.asarray(cols["time_ms"]),
         latency_ms=jax.numpy.asarray(cols["latency_ms"]),
         mask=jax.numpy.asarray(mask),
+        first=jax.numpy.asarray(cols["first"].astype(bool)),
         value_space=V,
     )
 

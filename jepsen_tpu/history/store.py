@@ -77,10 +77,7 @@ class Store:
         return p
 
     def save_results(self, run_dir: Path, results: dict[str, Any]) -> Path:
-        p = run_dir / RESULTS_FILE
-        with open(p, "w") as fh:
-            json.dump(results, fh, indent=2, default=_json_default)
-        return p
+        return save_results(run_dir, results)
 
     def load_history(self, run_dir: str | Path) -> list[Op]:
         return read_history_jsonl(Path(run_dir) / HISTORY_FILE)
@@ -88,6 +85,14 @@ class Store:
     def latest(self) -> Path | None:
         link = self.root / "latest"
         return link.resolve() if link.exists() else None
+
+
+def save_results(run_dir: str | Path, results: dict[str, Any]) -> Path:
+    """Write ``results.json`` into a run dir (sets/arrays serialized)."""
+    p = Path(run_dir) / RESULTS_FILE
+    with open(p, "w") as fh:
+        json.dump(results, fh, indent=2, default=_json_default)
+    return p
 
 
 def _json_default(o: Any):

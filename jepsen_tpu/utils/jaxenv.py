@@ -1,0 +1,17 @@
+"""JAX backend bootstrap guards."""
+
+from __future__ import annotations
+
+
+def ensure_backend() -> str:
+    """Initialize the JAX backend, falling back to auto-selection when the
+    env-pinned platform (e.g. a plugin named in ``JAX_PLATFORMS``) is not
+    actually registered in this process.  Returns the backend name."""
+    import jax
+
+    try:
+        jax.devices()
+    except RuntimeError:
+        jax.config.update("jax_platforms", "")
+        jax.devices()
+    return jax.default_backend()

@@ -1,0 +1,74 @@
+"""CLI: check / bench-check / synth subcommands end-to-end."""
+
+import json
+
+import pytest
+
+from jepsen_tpu.cli.main import GOOD_BANNER, INVALID_BANNER, main
+from jepsen_tpu.history.store import Store
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+@pytest.fixture()
+def run_dir(tmp_path):
+    def make(**anomalies):
+        sh = synth_history(SynthSpec(n_ops=200, seed=31, **anomalies))
+        st = Store(tmp_path / "store")
+        d = st.run_dir("t")
+        st.save_history(d, sh.ops)
+        return d
+
+    return make
+
+
+def test_check_valid_run(run_dir, capsys):
+    d = run_dir()
+    rc = main(["check", str(d), "--checker", "tpu"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert GOOD_BANNER in out
+    assert (d / "results.json").is_file()
+    saved = json.loads((d / "results.json").read_text())
+    assert saved["valid?"] and saved["queue"]["valid?"]
+
+
+def test_check_invalid_run_exit_code_and_banner(run_dir, capsys):
+    d = run_dir(lost=2)
+    rc = main(["check", str(d), "--checker", "cpu"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert INVALID_BANNER in out
+    assert json.loads((d / "results.json").read_text())["queue"]["lost-count"] == 2
+
+
+def test_check_resolves_store_root(run_dir, capsys):
+    d = run_dir()
+    rc = main(["check", str(d.parent.parent)])  # store root via latest link
+    assert rc == 0
+
+
+def test_check_missing_path(tmp_path, capsys):
+    rc = main(["check", str(tmp_path / "nope")])
+    assert rc == 2
+    assert "error:" in capsys.readouterr().err
+
+
+def test_bench_check_synthetic(capsys):
+    rc = main(["bench-check", "--count", "8", "--ops", "60"])
+    assert rc == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    stats = json.loads(line)
+    assert stats["histories"] == 8
+    assert stats["invalid"] >= 1  # bench injects one lost value per history
+    assert stats["histories_per_sec"] > 0
+
+
+def test_synth_then_bench_on_store(tmp_path, capsys):
+    rc = main(
+        ["synth", "--store", str(tmp_path), "--count", "4", "--ops", "50"]
+    )
+    assert rc == 0
+    rc = main(["bench-check", "--histories", str(tmp_path)])
+    assert rc == 0
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert stats["histories"] == 4

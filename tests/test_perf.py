@@ -1,0 +1,81 @@
+"""perf checker: windowed rates/quantiles and plot rendering."""
+
+import numpy as np
+
+from jepsen_tpu.checkers.perf import (
+    N_WINDOWS,
+    Perf,
+    perf_tensor_check,
+    render_perf_plots,
+)
+from jepsen_tpu.history.encode import pack_histories
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+def test_rates_count_every_completion():
+    sh = synth_history(SynthSpec(n_ops=300, seed=21))
+    packed = pack_histories([sh.ops])
+    t = perf_tensor_check(packed)
+    rates = np.asarray(t.rates)[0]  # [W, F, T]
+    n_completions = sum(
+        1 for op in sh.ops if op.type != OpType.INVOKE and op.time >= 0
+    )
+    assert rates.sum() == n_completions
+
+
+def test_quantiles_match_known_latencies():
+    # all enqueues complete in exactly 5ms -> every quantile bucket edge >= 5
+    ms = 1_000_000
+    ops = []
+    for i in range(20):
+        ops.append(Op.invoke(OpF.ENQUEUE, 0, i, time=i * 100 * ms))
+        ops.append(Op(OpType.OK, OpF.ENQUEUE, 0, i, time=(i * 100 + 5) * ms))
+    packed = pack_histories([reindex(ops)])
+    t = perf_tensor_check(packed)
+    q = np.asarray(t.quantiles)[0]  # [W, F, 3]
+    enq = q[:, 0, :]
+    present = enq[enq[:, 0] > 0]
+    assert len(present) > 0
+    # 5ms falls in a log bucket whose upper edge is within ~35% of 5ms
+    assert (present >= 5).all() and (present <= 7).all()
+
+
+def test_window_covers_history_span():
+    sh = synth_history(SynthSpec(n_ops=200, seed=22))
+    packed = pack_histories([sh.ops])
+    t = perf_tensor_check(packed)
+    t_max_ms = max(op.time for op in sh.ops) // 1_000_000
+    w = int(np.asarray(t.window_ms)[0])
+    assert w * N_WINDOWS >= t_max_ms
+
+
+def test_perf_checker_and_plots(tmp_path):
+    sh = synth_history(SynthSpec(n_ops=200, seed=23))
+    res = Perf(out_dir=tmp_path).check({}, sh.ops)
+    assert res["valid?"]
+    assert (tmp_path / "latency-raw.png").stat().st_size > 1000
+    assert (tmp_path / "rate.png").stat().st_size > 1000
+    assert res["latency-graph"]["valid?"] and res["rate-graph"]["valid?"]
+
+
+def test_render_without_latencies(tmp_path):
+    # histories with no ok completions must not crash rendering
+    ops = reindex([Op.invoke(OpF.DEQUEUE, 0, time=0)])
+    packed = pack_histories([ops])
+    t = perf_tensor_check(packed)
+    paths = render_perf_plots(t, tmp_path)
+    assert set(paths) == {"latency-graph", "rate-graph"}
+
+
+def test_drain_counts_once_in_rates():
+    # a drain of k values must count as ONE completion, not k
+    ms = 1_000_000
+    ops = reindex(
+        [
+            Op.invoke(OpF.DRAIN, 0, time=1 * ms),
+            Op(OpType.OK, OpF.DRAIN, 0, [1, 2, 3, 4], time=2 * ms),
+        ]
+    )
+    t = perf_tensor_check(pack_histories([ops]))
+    assert np.asarray(t.rates)[0].sum() == 1

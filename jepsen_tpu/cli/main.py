@@ -7,8 +7,11 @@ run/analysis lifecycle whose console output the CI triage greps —
 (``ci/jepsen-test.sh:180-184``), and a valid run prints the reference's
 "Everything looks good!" banner (``README.md:55``).
 
-Subcommands (this milestone):
+Subcommands:
 
+- ``test``        — run a quorum-queue partition test (all the reference's
+                    flags; ``--db sim`` for the in-process cluster,
+                    ``--db rabbitmq`` once the SSH control plane lands).
 - ``check``       — re-check a recorded history (``--checker tpu|cpu``);
                     the ``--checker`` dispatch point is the north-star seam.
 - ``bench-check`` — batched replay: verify many stored/synthetic histories
@@ -16,9 +19,6 @@ Subcommands (this milestone):
 - ``synth``       — generate synthetic histories (with injectable
                     anomalies) into a store, for demos and differential
                     testing.
-
-The ``test`` subcommand (run a live cluster test) arrives with the control
-plane.
 """
 
 from __future__ import annotations
@@ -143,6 +143,52 @@ def cmd_bench_check(args) -> int:
     return 0
 
 
+def cmd_test(args) -> int:
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.suite import build_sim_test
+
+    if args.db != "sim":
+        print(
+            "error: only --db sim is wired up so far; the RabbitMQ SSH DB "
+            "arrives with the control plane",
+            file=sys.stderr,
+        )
+        return 2
+    opts = {
+        "rate": args.rate,
+        "time-limit": args.time_limit,
+        "time-before-partition": args.time_before_partition,
+        "partition-duration": args.partition_duration,
+        "network-partition": args.network_partition,
+        "publish-confirm-timeout": args.publish_confirm_timeout / 1000.0,
+        "recovery-sleep": args.recovery_sleep,
+        "consumer-type": args.consumer_type,
+        "net-ticktime": args.net_ticktime,
+        "quorum-initial-group-size": args.quorum_initial_group_size,
+        "dead-letter": args.dead_letter,
+    }
+    test, _cluster = build_sim_test(
+        opts=opts,
+        nodes=args.nodes.split(","),
+        concurrency=args.concurrency,
+        checker_backend=args.checker,
+        store_root=args.store,
+    )
+    run = run_test(test)
+    print(json.dumps(run.results, indent=1, default=_json_default))
+    if run.valid:
+        print(GOOD_BANNER)
+        return 0
+    print(INVALID_BANNER)
+    return 1
+
+
 def cmd_synth(args) -> int:
     from jepsen_tpu.history.synth import SynthSpec, synth_batch
 
@@ -185,6 +231,43 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--count", type=int, default=256, help="synthetic histories")
     b.add_argument("--ops", type=int, default=470, help="invocations per history")
     b.set_defaults(fn=cmd_bench_check)
+
+    t = sub.add_parser(
+        "test", help="run a quorum-queue partition test (reference flags)"
+    )
+    t.add_argument("--nodes", default="n1,n2,n3", help="comma-separated nodes")
+    t.add_argument("--concurrency", type=int, default=5)
+    t.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
+    t.add_argument("--store", default="store")
+    t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
+    # the reference's cli-opts (rabbitmq.clj:288-327)
+    t.add_argument("--rate", type=float, default=50.0, help="ops/sec")
+    t.add_argument("--time-limit", type=float, default=30.0)
+    t.add_argument("--time-before-partition", type=float, default=10.0)
+    t.add_argument("--partition-duration", type=float, default=10.0)
+    t.add_argument(
+        "--network-partition",
+        default="partition-random-halves",
+        choices=(
+            "partition-random-halves",
+            "partition-halves",
+            "partition-majorities-ring",
+            "partition-random-node",
+        ),
+    )
+    t.add_argument(
+        "--publish-confirm-timeout", type=float, default=5000.0, help="ms"
+    )
+    t.add_argument("--recovery-sleep", type=float, default=20.0)
+    t.add_argument(
+        "--consumer-type",
+        default="polling",
+        choices=("asynchronous", "polling", "mixed"),
+    )
+    t.add_argument("--net-ticktime", type=int, default=15)
+    t.add_argument("--quorum-initial-group-size", type=int, default=0)
+    t.add_argument("--dead-letter", action="store_true")
+    t.set_defaults(fn=cmd_test)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument("--store", default="store", help="store root dir")

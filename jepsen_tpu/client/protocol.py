@@ -1,0 +1,128 @@
+"""Client protocol: per-process SUT clients and the queue-client semantics.
+
+Mirrors ``jepsen.client/Client`` as the reference uses it
+(``rabbitmq.clj:174-215``) and the driver ABI of the reference's Java layer
+(``Utils.java:154-167``): a *driver* exposes
+``setup/enqueue/dequeue/drain/close/reconnect``; the *queue client* maps
+driver results and exceptions onto op completions:
+
+- enqueue: ``True → ok``, ``False → fail``, timeout → ``info :timeout``
+  (indeterminate — the publish may have been committed;
+  ``rabbitmq.clj:197-200``), other error → ``fail`` + reconnect
+  (``rabbitmq.clj:210-213``).
+- dequeue: value → ``ok``, ``None → fail :exhausted``
+  (``rabbitmq.clj:151-153``), timeout → ``fail :timeout`` (reads are safe
+  to fail), other error → ``fail`` + reconnect.
+- drain: list of values → ``ok`` (``Utils.java:140-145``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+
+class DriverTimeout(Exception):
+    """An operation timed out (outcome unknown for writes)."""
+
+
+class QueueDriver(abc.ABC):
+    """The native driver ABI (= ``Utils.Client``, ``Utils.java:154-167``)."""
+
+    @abc.abstractmethod
+    def setup(self) -> None:
+        """Declare/purge queues (once per cluster; idempotent)."""
+
+    @abc.abstractmethod
+    def enqueue(self, value: int, timeout_s: float) -> bool:
+        """Publish + wait for confirm.  True=confirmed, False=nacked;
+        raises DriverTimeout if the confirm didn't arrive in time."""
+
+    @abc.abstractmethod
+    def dequeue(self, timeout_s: float) -> int | None:
+        """One message (acked), or None if none available."""
+
+    @abc.abstractmethod
+    def drain(self) -> list[int]:
+        """Close all clients, reconnect to every host, empty the queues."""
+
+    @abc.abstractmethod
+    def reconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class Client(abc.ABC):
+    """Per-process client lifecycle (= ``jepsen.client/Client``)."""
+
+    @abc.abstractmethod
+    def open(self, test: Mapping[str, Any], node: str) -> "Client":
+        """A connected clone bound to ``node``."""
+
+    def setup(self, test: Mapping[str, Any]) -> None: ...
+
+    @abc.abstractmethod
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        """Apply ``op``, returning its completion."""
+
+    def close(self, test: Mapping[str, Any]) -> None: ...
+
+    def teardown(self, test: Mapping[str, Any]) -> None: ...
+
+
+class QueueClient(Client):
+    """The reference's queue client over any :class:`QueueDriver`."""
+
+    def __init__(self, driver_factory, publish_confirm_timeout_s: float = 5.0,
+                 dequeue_timeout_s: float = 5.0):
+        self.driver_factory = driver_factory
+        self.publish_confirm_timeout_s = publish_confirm_timeout_s
+        self.dequeue_timeout_s = dequeue_timeout_s
+        self.driver: QueueDriver | None = None
+
+    def open(self, test, node):
+        c = QueueClient(
+            self.driver_factory,
+            self.publish_confirm_timeout_s,
+            self.dequeue_timeout_s,
+        )
+        c.driver = self.driver_factory(test, node)
+        return c
+
+    def setup(self, test):
+        assert self.driver is not None
+        self.driver.setup()
+
+    def invoke(self, test, op: Op) -> Op:
+        d = self.driver
+        assert d is not None
+        try:
+            if op.f == OpF.ENQUEUE:
+                ok = d.enqueue(op.value, self.publish_confirm_timeout_s)
+                return op.complete(OpType.OK if ok else OpType.FAIL)
+            if op.f == OpF.DEQUEUE:
+                v = d.dequeue(self.dequeue_timeout_s)
+                if v is None:
+                    return op.complete(OpType.FAIL, error="exhausted")
+                return op.complete(OpType.OK, value=v)
+            if op.f == OpF.DRAIN:
+                return op.complete(OpType.OK, value=d.drain())
+            raise ValueError(f"unknown client op {op.f}")
+        except DriverTimeout:
+            if op.f == OpF.ENQUEUE:
+                # indeterminate: the publish may have been committed
+                return op.complete(OpType.INFO, error="timeout")
+            return op.complete(OpType.FAIL, error="timeout")
+        except Exception as e:  # noqa: BLE001 — any driver error fails the op
+            try:
+                d.reconnect()
+            except Exception:  # noqa: BLE001 — reconnect best-effort
+                pass
+            return op.complete(OpType.FAIL, error=f"{type(e).__name__}: {e}")
+
+    def close(self, test):
+        if self.driver is not None:
+            self.driver.close()

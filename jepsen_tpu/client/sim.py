@@ -1,0 +1,155 @@
+"""In-process quorum-queue cluster simulator.
+
+The reference tests only against *real* clusters (SURVEY.md §4.3) — its
+determinism lever is that analysis is a pure function of the recorded
+history.  This simulator is the framework's complement: a deterministic SUT
+that exercises the *entire* run pipeline (clients, generators, nemesis,
+recorder, checkers) in-process, with injectable broker bugs so end-to-end
+tests can assert the checker catches real SUT misbehavior — not just
+synthetic tensor anomalies.  It is also the test double for the native AMQP
+driver's choreography until a live broker is present.
+
+Model: one replicated queue with Raft-like majority semantics.
+
+- A publish from node X commits iff X's connected component (under the
+  current partition) contains a majority of nodes.  A publish from a
+  minority node times out; with probability ½ it is *committed anyway*
+  (models a confirm lost in flight — the indeterminacy `total-queue`'s
+  ``recovered`` classification exists for).
+- A dequeue from a minority node times out; from a majority node it pops an
+  arbitrary committed message (unordered-queue view of a quorum queue under
+  redelivery).
+- Fault injection: ``drop_acked_every=k`` silently discards every k-th
+  confirmed message (a data-loss bug the checker must flag as ``lost``);
+  ``duplicate_every=k`` redelivers every k-th dequeued message once (an
+  at-least-once duplicate, reported but legal).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.client.protocol import DriverTimeout, QueueDriver
+
+
+class SimCluster:
+    def __init__(
+        self,
+        nodes: Sequence[str],
+        seed: int = 0,
+        drop_acked_every: int = 0,
+        duplicate_every: int = 0,
+    ):
+        self.nodes = list(nodes)
+        self.lock = threading.Lock()
+        self.rng = random.Random(seed)
+        self.queue: list[int] = []  # committed, undelivered messages
+        self.blocked: set[frozenset[str]] = set()  # undirected blocked links
+        self.drop_acked_every = drop_acked_every
+        self.duplicate_every = duplicate_every
+        self._acked = 0
+        self._delivered = 0
+
+    # ---- network control (driven by the nemesis via SimNet) --------------
+    def set_blocked(self, blocked: set[frozenset[str]]) -> None:
+        with self.lock:
+            self.blocked = set(blocked)
+
+    def heal(self) -> None:
+        self.set_blocked(set())
+
+    def component_of(self, node: str) -> set[str]:
+        """Nodes reachable from ``node`` over unblocked links."""
+        seen = {node}
+        frontier = [node]
+        while frontier:
+            a = frontier.pop()
+            for b in self.nodes:
+                if b not in seen and frozenset((a, b)) not in self.blocked:
+                    seen.add(b)
+                    frontier.append(b)
+        return seen
+
+    def _has_majority(self, node: str) -> bool:
+        return len(self.component_of(node)) * 2 > len(self.nodes)
+
+    # ---- queue ops --------------------------------------------------------
+    def publish(self, node: str, value: int) -> bool:
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.5:  # confirm lost, commit happened
+                    self._commit(value)
+                raise DriverTimeout("publish confirm timed out (minority)")
+            self._commit(value)
+            return True
+
+    def _commit(self, value: int) -> None:
+        self._acked += 1
+        if self.drop_acked_every and self._acked % self.drop_acked_every == 0:
+            return  # injected data-loss bug: confirmed but discarded
+        self.queue.append(value)
+
+    def get(self, node: str) -> int | None:
+        with self.lock:
+            if not self._has_majority(node):
+                raise DriverTimeout("basic.get timed out (minority)")
+            if not self.queue:
+                return None
+            i = self.rng.randrange(len(self.queue))
+            v = self.queue.pop(i)
+            self._delivered += 1
+            if (
+                self.duplicate_every
+                and self._delivered % self.duplicate_every == 0
+            ):
+                self.queue.append(v)  # injected redelivery duplicate
+            return v
+
+    def drain_from_all(self) -> list[int]:
+        """The drain choreography's final read: empty the queue regardless
+        of partitions (runs after the final heal)."""
+        out = []
+        with self.lock:
+            while self.queue:
+                out.append(self.queue.pop())
+        return out
+
+    def queue_length(self) -> int:
+        with self.lock:
+            return len(self.queue)
+
+
+class SimQueueDriver(QueueDriver):
+    """Driver ABI over :class:`SimCluster` — the sim twin of the native
+    AMQP driver."""
+
+    def __init__(self, cluster: SimCluster, node: str):
+        self.cluster = cluster
+        self.node = node
+
+    def setup(self) -> None:
+        pass  # queue declaration is implicit in the sim
+
+    def enqueue(self, value: int, timeout_s: float) -> bool:
+        return self.cluster.publish(self.node, value)
+
+    def dequeue(self, timeout_s: float) -> int | None:
+        return self.cluster.get(self.node)
+
+    def drain(self) -> list[int]:
+        return self.cluster.drain_from_all()
+
+    def reconnect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def sim_driver_factory(cluster: SimCluster):
+    def factory(test: Mapping[str, Any], node: str) -> SimQueueDriver:
+        return SimQueueDriver(cluster, node)
+
+    return factory

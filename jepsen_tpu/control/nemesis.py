@@ -1,0 +1,117 @@
+"""Nemeses: fault injectors driven by ``{:f :start}`` / ``{:f :stop}`` ops.
+
+The four network-partition strategies the reference selects by flag
+(``rabbitmq.clj:219-243``), rebuilt over the :class:`~jepsen_tpu.control.net.Net`
+interface so one implementation drives both the simulator and real nodes
+(iptables over SSH):
+
+- ``partition-random-halves``  — shuffle nodes, cut into two halves
+- ``partition-halves``         — first half vs rest, deterministic
+- ``partition-majorities-ring``— each node keeps links only to its ring
+  neighbors: every node still *sees* a majority, but no two nodes agree on
+  which majority (the nastiest case for leader election)
+- ``partition-random-node``    — isolate one random node
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+from typing import Any, Callable, Mapping, Sequence
+
+from jepsen_tpu.control.net import Net, complete_grudges
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+logger = logging.getLogger("jepsen_tpu.nemesis")
+
+
+def random_halves(nodes: Sequence[str], rng: random.Random):
+    ns = list(nodes)
+    rng.shuffle(ns)
+    mid = (len(ns) + 1) // 2
+    return complete_grudges([ns[:mid], ns[mid:]])
+
+
+def halves(nodes: Sequence[str], rng: random.Random):
+    mid = (len(nodes) + 1) // 2
+    return complete_grudges([nodes[:mid], nodes[mid:]])
+
+
+def majorities_ring(nodes: Sequence[str], rng: random.Random):
+    """Node i keeps links only to its nearest ring neighbors (enough that
+    its local view is a majority); everything further is cut.  With ≤3
+    nodes every pair is ring-adjacent, so no link is cut — the interesting
+    regime (as in the reference's CI) is 5 nodes, where each node sees a
+    different 3-node majority."""
+    ns = list(nodes)
+    rng.shuffle(ns)
+    n = len(ns)
+    keep = (n // 2 + 1) // 2  # ring neighbors kept per side
+    grudges: dict[str, set[str]] = {m: set() for m in ns}
+    for i, a in enumerate(ns):
+        for j, b in enumerate(ns):
+            if i == j:
+                continue
+            dist = min((i - j) % n, (j - i) % n)
+            if dist > keep:
+                grudges[a].add(b)
+    return grudges
+
+
+def random_node(nodes: Sequence[str], rng: random.Random):
+    lone = rng.choice(list(nodes))
+    rest = [m for m in nodes if m != lone]
+    return complete_grudges([[lone], rest])
+
+
+STRATEGIES: dict[str, Callable] = {
+    "partition-random-halves": random_halves,
+    "partition-halves": halves,
+    "partition-majorities-ring": majorities_ring,
+    "partition-random-node": random_node,
+}
+
+
+class PartitionNemesis:
+    """Applies a partition strategy on ``start``, heals on ``stop``."""
+
+    def __init__(self, strategy: str, net: Net, nodes: Sequence[str],
+                 seed: int | None = None):
+        if strategy not in STRATEGIES:
+            raise ValueError(
+                f"unknown partition {strategy!r}; one of {sorted(STRATEGIES)}"
+            )
+        self.strategy = strategy
+        self.net = net
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        self.net.heal()
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            grudges = STRATEGIES[self.strategy](self.nodes, self.rng)
+            self.net.partition(grudges)
+            desc = {a: sorted(bs) for a, bs in grudges.items() if bs}
+            logger.info("nemesis: cut links %s", desc)
+            return op.complete(OpType.INFO, value=str(desc))
+        if op.f == OpF.STOP:
+            self.net.heal()
+            logger.info("nemesis: healed")
+            return op.complete(OpType.INFO, value="healed")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        self.net.heal()
+
+
+class NoopNemesis:
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        return op.complete(OpType.INFO, value="noop")
+
+    def teardown(self, test):
+        pass

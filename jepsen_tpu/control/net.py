@@ -1,0 +1,55 @@
+"""Network manipulation interface.
+
+The nemesis strategies compute *which links to cut* (grudges); a ``Net``
+applies them to an actual network: :class:`SimNet` flips the simulator's
+blocked-link set, and the SSH net (``jepsen_tpu.control.ssh``) installs
+iptables DROP rules on real nodes the way ``jepsen.nemesis``'s partitioners
+do ``[dep: jepsen 0.3.12]``.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Sequence
+
+
+def undirected(grudges: dict[str, set[str]]) -> set[frozenset[str]]:
+    """Collapse directed grudges to undirected blocked links."""
+    out: set[frozenset[str]] = set()
+    for a, peers in grudges.items():
+        for b in peers:
+            if a != b:
+                out.add(frozenset((a, b)))
+    return out
+
+
+class Net(abc.ABC):
+    @abc.abstractmethod
+    def partition(self, grudges: dict[str, set[str]]) -> None:
+        """Apply blocked links (``grudges[a] ∋ b`` = a drops traffic from b)."""
+
+    @abc.abstractmethod
+    def heal(self) -> None:
+        """Remove all blocks."""
+
+
+class SimNet(Net):
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def partition(self, grudges):
+        self.cluster.set_blocked(undirected(grudges))
+
+    def heal(self):
+        self.cluster.heal()
+
+
+def complete_grudges(groups: Sequence[Iterable[str]]) -> dict[str, set[str]]:
+    """Block every cross-group link (jepsen ``complete-grudge``)."""
+    groups = [list(g) for g in groups]
+    out: dict[str, set[str]] = {}
+    for i, g in enumerate(groups):
+        others = {n for j, o in enumerate(groups) if j != i for n in o}
+        for n in g:
+            out[n] = set(others)
+    return out

@@ -1,0 +1,283 @@
+"""The test runner: setup → concurrent run → drain → analysis → store.
+
+Equivalent of ``jepsen.core/run!`` as the reference drives it (call stack in
+SURVEY.md §3.1): build a test map, set up the DB on every node, open one
+client per worker, interpret the generator with worker threads + a nemesis
+thread while recording every invocation and completion into an immutable
+history, tear down, then hand the history to the composed checker and
+persist everything in the store.
+
+Worker semantics (matching Jepsen's process model):
+
+- each worker thread owns a logical *process*; ops are recorded with that
+  process id;
+- an ``info`` (indeterminate) completion poisons the process — its op stays
+  logically open forever, so the thread retires the process id and continues
+  as ``process + concurrency`` with a fresh client (Jepsen's rule; without
+  it a linearizability checker would wrongly close the op's interval);
+- the nemesis runs as pseudo-process ``-1`` and never retires.
+
+History timestamps are monotonic ns since test start (Jepsen convention).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Mapping, Sequence
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.generators.core import Generator, Pending, Scheduler
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpType
+from jepsen_tpu.history.store import Store
+
+logger = logging.getLogger("jepsen_tpu.runner")
+
+
+class DB:
+    """Per-node database lifecycle (= ``jepsen.db/DB`` + ``LogFiles``)."""
+
+    def setup(self, test: Mapping[str, Any], node: str) -> None: ...
+
+    def teardown(self, test: Mapping[str, Any], node: str) -> None: ...
+
+    def log_files(self, test: Mapping[str, Any], node: str) -> list[str]:
+        return []
+
+
+@dataclass
+class Test:
+    """The test map (= the reference's ``rabbit-test`` merge,
+    ``rabbitmq.clj:250-286``)."""
+
+    name: str
+    nodes: Sequence[str]
+    client: Any  # Client prototype (open() per worker)
+    generator: Generator
+    checker: Checker
+    db: DB = field(default_factory=DB)
+    nemesis: Any = None
+    concurrency: int = 5
+    store_root: str = "store"
+    opts: dict[str, Any] = field(default_factory=dict)
+
+    def as_map(self) -> dict[str, Any]:
+        return {
+            "name": self.name,
+            "nodes": list(self.nodes),
+            "concurrency": self.concurrency,
+            **self.opts,
+        }
+
+
+@dataclass
+class TestRun:
+    test: Test
+    history: list[Op]
+    results: dict[str, Any]
+    run_dir: Path | None
+
+    @property
+    def valid(self) -> bool:
+        return bool(self.results.get(VALID))
+
+
+class _Recorder:
+    """Appends ops to the history with sequential indices + timestamps."""
+
+    def __init__(self, start_ns: int):
+        self.lock = threading.Lock()
+        self.history: list[Op] = []
+        self.start_ns = start_ns
+
+    def record(self, op: Op) -> Op:
+        with self.lock:
+            op.index = len(self.history)
+            op.time = _time.monotonic_ns() - self.start_ns
+            self.history.append(op)
+        return op
+
+
+class _DeadClient:
+    """Stand-in when a client can't connect: fails every op (rather than
+    deadlocking the run — phase barriers and ``EachThread`` need every
+    thread alive)."""
+
+    def __init__(self, error: str):
+        self.error = error
+
+    def invoke(self, test, op: Op) -> Op:
+        return op.complete(OpType.FAIL, error=f"client-dead: {self.error}")
+
+    def close(self, test):
+        pass
+
+
+_BARRIER_TIMEOUT_S = 120.0
+_MAX_SLEEP_S = 0.25  # cap single sleeps so threads notice aborts promptly
+
+
+def _worker(
+    test: Test,
+    test_map: Mapping[str, Any],
+    scheduler: Scheduler,
+    recorder: _Recorder,
+    thread_id: int,
+    barrier: threading.Barrier,
+):
+    """One client worker thread: ask → invoke → record, until exhausted."""
+    process = thread_id
+    node = test.nodes[thread_id % len(test.nodes)]
+
+    def fresh_client():
+        try:
+            c = test.client.open(test_map, node)
+            c.setup(test_map)
+            return c
+        except Exception as e:  # noqa: BLE001 — keep the thread alive
+            logger.exception("client open/setup failed on %s", node)
+            return _DeadClient(str(e))
+
+    client = fresh_client()
+    try:
+        barrier.wait(_BARRIER_TIMEOUT_S)
+        while True:
+            got = scheduler.next_op(thread_id, process)
+            if got is None:
+                break
+            if isinstance(got, Pending):
+                _time.sleep(
+                    min(
+                        max((got.wake - scheduler.now()) / 1e9, 0.0005),
+                        _MAX_SLEEP_S,
+                    )
+                )
+                continue
+            got.process = process
+            invoke = recorder.record(got)
+            try:
+                completion = client.invoke(test_map, invoke)
+            except Exception as e:  # noqa: BLE001 — client bug: indeterminate
+                logger.exception("client.invoke crashed")
+                completion = invoke.complete(
+                    OpType.INFO, error=f"client-crash: {e}"
+                )
+            recorder.record(completion)
+            if completion.type == OpType.INFO:
+                # indeterminate op: retire this process id (Jepsen rule)
+                process += test.concurrency
+                try:
+                    client.close(test_map)
+                except Exception:  # noqa: BLE001
+                    pass
+                client = fresh_client()
+    except Exception:  # noqa: BLE001 — never leave peers waiting on us
+        logger.exception("worker %d aborting the run", thread_id)
+        scheduler.abort()
+    finally:
+        try:
+            client.close(test_map)
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def _nemesis_worker(
+    test: Test,
+    test_map: Mapping[str, Any],
+    scheduler: Scheduler,
+    recorder: _Recorder,
+    barrier: threading.Barrier,
+):
+    nemesis = test.nemesis
+    try:
+        if nemesis is not None:
+            nemesis.setup(test_map)
+        barrier.wait(_BARRIER_TIMEOUT_S)
+        while True:
+            got = scheduler.next_op(NEMESIS_PROCESS, NEMESIS_PROCESS)
+            if got is None:
+                break
+            if isinstance(got, Pending):
+                _time.sleep(
+                    min(
+                        max((got.wake - scheduler.now()) / 1e9, 0.0005),
+                        _MAX_SLEEP_S,
+                    )
+                )
+                continue
+            got.process = NEMESIS_PROCESS
+            invoke = recorder.record(got)
+            if nemesis is None:
+                recorder.record(
+                    invoke.complete(OpType.INFO, value="no-nemesis")
+                )
+                continue
+            try:
+                completion = nemesis.invoke(test_map, invoke)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("nemesis.invoke crashed")
+                completion = invoke.complete(OpType.INFO, error=str(e))
+            recorder.record(completion)
+    except Exception:  # noqa: BLE001 — never leave clients waiting on us
+        logger.exception("nemesis thread aborting the run")
+        scheduler.abort()
+
+
+def run_test(test: Test, store: Store | None = None) -> TestRun:
+    """The full lifecycle.  Returns the run (history + analysis results)."""
+    test_map = test.as_map()
+    logger.info("setup: %d nodes", len(test.nodes))
+    with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
+        list(pool.map(lambda n: test.db.setup(test_map, n), test.nodes))
+
+    start_ns = _time.monotonic_ns()
+    scheduler = Scheduler(
+        test.generator, n_threads=test.concurrency, start_ns=start_ns
+    )
+    recorder = _Recorder(start_ns)
+    barrier = threading.Barrier(test.concurrency + 1)
+
+    threads = [
+        threading.Thread(
+            target=_worker,
+            args=(test, test_map, scheduler, recorder, t, barrier),
+            name=f"worker-{t}",
+            daemon=True,
+        )
+        for t in range(test.concurrency)
+    ]
+    threads.append(
+        threading.Thread(
+            target=_nemesis_worker,
+            args=(test, test_map, scheduler, recorder, barrier),
+            name="nemesis",
+            daemon=True,
+        )
+    )
+    logger.info("run: %d workers + nemesis", test.concurrency)
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    logger.info("teardown")
+    if test.nemesis is not None:
+        test.nemesis.teardown(test_map)
+    with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
+        list(pool.map(lambda n: test.db.teardown(test_map, n), test.nodes))
+
+    history = recorder.history
+    st = store or Store(test.store_root)
+    run_dir = st.run_dir(test.name)
+    st.save_history(run_dir, history)
+
+    logger.info("analysis: %d history entries", len(history))
+    results = test.checker.check(
+        test_map, history, {"out_dir": run_dir}
+    )
+    st.save_results(run_dir, results)
+    return TestRun(test=test, history=history, results=results, run_dir=run_dir)

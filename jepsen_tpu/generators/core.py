@@ -1,0 +1,357 @@
+"""Generator algebra: the workload program.
+
+Replicates the ``jepsen.generator`` combinators the reference composes
+(``/root/reference/rabbitmq/src/main/clojure/jepsen/rabbitmq.clj:267-284``):
+``mix``, ``delay`` (rate limiting), ``nemesis`` (op routing), ``phases``,
+``time-limit``, ``once``, ``log``, ``sleep``, ``clients``, ``each-thread``,
+plus ``cycle`` (used for the partition start/stop loop).
+
+Execution model: worker threads (one per logical process, plus the nemesis)
+ask a shared :class:`Scheduler` for their next op.  The scheduler serializes
+access to the generator tree with one lock and hands each thread either an
+invoke op, a wake-up deadline (rate limit / sleep), or exhaustion.  This
+mirrors Jepsen's pure-generator interpreter semantics at the points the
+reference exercises:
+
+- ``mix`` draws each op from a random sub-generator;
+- ``delay 1/rate`` spaces *global* op emission, giving ``rate`` ops/sec
+  across all client threads combined per ``gen/delay``'s contract;
+- ``phases`` advances when the current phase generator is exhausted
+  (in-flight ops from the previous phase may still be completing);
+- ``each-thread`` gives every client thread its own copy and exhausts when
+  all copies do;
+- ``nemesis``/``clients`` route by thread class.
+
+Generators are stateful objects mutated only under the scheduler lock.
+"""
+
+from __future__ import annotations
+
+import abc
+import logging
+import random
+import threading
+import time as _time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Sequence
+
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+logger = logging.getLogger("jepsen_tpu.generator")
+
+
+@dataclass
+class Ctx:
+    """What a generator may consult when asked for an op."""
+
+    time: int  # ns since test start
+    thread: int  # worker thread id (NEMESIS_PROCESS for the nemesis)
+    process: int  # current logical process of that thread
+    n_threads: int  # number of client threads
+
+
+@dataclass
+class Pending:
+    """No op yet — ask again at ``wake`` (ns since test start)."""
+
+    wake: int
+
+
+EXHAUSTED = None
+
+
+class Generator(abc.ABC):
+    @abc.abstractmethod
+    def next_for(self, ctx: Ctx) -> Op | Pending | None:
+        """An invoke op for this thread, a wake-up time, or EXHAUSTED."""
+
+
+class FnGen(Generator):
+    """Wraps an ``(ctx) -> Op`` function; never exhausts (bound it with
+    ``TimeLimit``).  The reference's ``enqueue``/``dequeue`` fns."""
+
+    def __init__(self, fn: Callable[[Ctx], Op]):
+        self.fn = fn
+
+    def next_for(self, ctx):
+        return self.fn(ctx)
+
+
+class OpGen(Generator):
+    """A bare op map used directly as a generator (emitted indefinitely)."""
+
+    def __init__(self, f: OpF, type: OpType = OpType.INVOKE, value: Any = None):
+        self.f, self.type, self.value = f, type, value
+
+    def next_for(self, ctx):
+        return Op(self.type, self.f, ctx.process, self.value)
+
+
+class Once(Generator):
+    """``gen/once`` — emit a single op then exhaust."""
+
+    def __init__(self, gen: Generator | Op):
+        self.gen = gen
+        self.done = False
+
+    def next_for(self, ctx):
+        if self.done:
+            return EXHAUSTED
+        got = (
+            self.gen.next_for(ctx)
+            if isinstance(self.gen, Generator)
+            else Op(self.gen.type, self.gen.f, ctx.process, self.gen.value)
+        )
+        if isinstance(got, (Pending, type(None))):
+            return got
+        self.done = True
+        return got
+
+
+class Mix(Generator):
+    """``gen/mix`` — each op from a uniformly random sub-generator."""
+
+    def __init__(self, gens: Sequence[Generator], seed: int | None = None):
+        self.gens = list(gens)
+        self.rng = random.Random(seed)
+
+    def next_for(self, ctx):
+        order = list(range(len(self.gens)))
+        self.rng.shuffle(order)
+        soonest: Pending | None = None
+        dead: set[int] = set()
+        for i in order:
+            got = self.gens[i].next_for(ctx)
+            if isinstance(got, Op):
+                return got
+            if isinstance(got, Pending):
+                if soonest is None or got.wake < soonest.wake:
+                    soonest = got
+            else:
+                dead.add(i)
+        if len(dead) == len(self.gens):
+            return EXHAUSTED
+        if dead:
+            self.gens = [g for i, g in enumerate(self.gens) if i not in dead]
+        return soonest
+
+
+class Delay(Generator):
+    """``gen/delay dt`` — at most one op per ``dt`` seconds globally."""
+
+    def __init__(self, gen: Generator, dt_s: float):
+        self.gen = gen
+        self.dt_ns = int(dt_s * 1e9)
+        self.next_at = 0
+
+    def next_for(self, ctx):
+        if ctx.time < self.next_at:
+            return Pending(self.next_at)
+        got = self.gen.next_for(ctx)
+        if isinstance(got, Op):
+            self.next_at = max(self.next_at + self.dt_ns, ctx.time)
+        return got
+
+
+class TimeLimit(Generator):
+    """``gen/time-limit t`` — exhausted once ``t`` seconds have elapsed."""
+
+    def __init__(self, gen: Generator, limit_s: float):
+        self.gen = gen
+        self.deadline_ns = int(limit_s * 1e9)
+
+    def next_for(self, ctx):
+        if ctx.time >= self.deadline_ns:
+            return EXHAUSTED
+        got = self.gen.next_for(ctx)
+        if isinstance(got, Pending) and got.wake > self.deadline_ns:
+            # don't let a thread oversleep the deadline (e.g. a nemesis
+            # mid-cycle Sleep): wake it at the limit so it sees exhaustion
+            # and the next phase (the final heal) can start on time
+            return Pending(self.deadline_ns)
+        return got
+
+
+class Sleep(Generator):
+    """``gen/sleep t`` — emit nothing for ``t`` seconds, then exhaust."""
+
+    def __init__(self, dt_s: float):
+        self.dt_ns = int(dt_s * 1e9)
+        self.until: int | None = None
+
+    def next_for(self, ctx):
+        if self.until is None:
+            self.until = ctx.time + self.dt_ns
+        if ctx.time < self.until:
+            return Pending(self.until)
+        return EXHAUSTED
+
+
+class Log(Generator):
+    """``gen/log`` — log a message once, exhaust immediately."""
+
+    def __init__(self, message: str):
+        self.message = message
+        self.done = False
+
+    def next_for(self, ctx):
+        if not self.done:
+            logger.info(self.message)
+            self.done = True
+        return EXHAUSTED
+
+
+class Seq(Generator):
+    """Run sub-generators in order (building block for ``cycle``)."""
+
+    def __init__(self, gens: Sequence[Generator]):
+        self.gens = list(gens)
+        self.i = 0
+
+    def next_for(self, ctx):
+        while self.i < len(self.gens):
+            got = self.gens[self.i].next_for(ctx)
+            if got is not EXHAUSTED:
+                return got
+            self.i += 1
+        return EXHAUSTED
+
+
+class Cycle(Generator):
+    """``(cycle [...])`` — endlessly instantiate a sequence of generators
+    from a factory.  Bound it with ``TimeLimit``."""
+
+    def __init__(self, factory: Callable[[], Sequence[Generator]]):
+        self.factory = factory
+        self.current = Seq(list(factory()))
+
+    def next_for(self, ctx):
+        got = self.current.next_for(ctx)
+        if got is not EXHAUSTED:
+            return got
+        self.current = Seq(list(self.factory()))
+        return self.current.next_for(ctx)
+
+
+class Phases(Generator):
+    """``gen/phases`` — run each phase to exhaustion, in order."""
+
+    def __init__(self, phases: Sequence[Generator]):
+        self.phases = list(phases)
+        self.i = 0
+
+    def next_for(self, ctx):
+        while self.i < len(self.phases):
+            got = self.phases[self.i].next_for(ctx)
+            if got is not EXHAUSTED:
+                return got
+            self.i += 1
+        return EXHAUSTED
+
+
+class Nothing(Generator):
+    """Immediately exhausted."""
+
+    def next_for(self, ctx):
+        return EXHAUSTED
+
+
+_POLL_NS = 20_000_000  # 20 ms — how often an idle thread re-asks a
+# generator that is waiting on *other* threads to finish
+
+
+class NemesisRoute(Generator):
+    """``gen/nemesis`` — clients draw from ``client_gen``, the nemesis
+    thread from ``nemesis_gen``.  The combined generator is exhausted only
+    when BOTH sides are: a thread whose side finished idles (Pending) until
+    the other side finishes too, so phase advancement stays global (a
+    nemesis-only phase blocks clients from skipping ahead, and vice versa)."""
+
+    def __init__(self, nemesis_gen: Generator, client_gen: Generator):
+        self.nemesis_gen = nemesis_gen
+        self.client_gen = client_gen
+        self.nemesis_done = False
+        self.client_done = False
+
+    def next_for(self, ctx):
+        mine = ctx.thread == NEMESIS_PROCESS
+        if (self.nemesis_done if mine else self.client_done):
+            got = EXHAUSTED
+        else:
+            got = (self.nemesis_gen if mine else self.client_gen).next_for(ctx)
+        if got is EXHAUSTED:
+            if mine:
+                self.nemesis_done = True
+            else:
+                self.client_done = True
+            if self.nemesis_done and self.client_done:
+                return EXHAUSTED
+            return Pending(ctx.time + _POLL_NS)
+        return got
+
+
+def Clients(gen: Generator) -> Generator:
+    """``gen/clients`` — only client threads draw ops; the nemesis waits."""
+    return NemesisRoute(Nothing(), gen)
+
+
+def NemesisOnly(gen: Generator) -> Generator:
+    """``(gen/nemesis g)`` with no client generator."""
+    return NemesisRoute(gen, Nothing())
+
+
+class EachThread(Generator):
+    """``gen/each-thread`` — every client thread gets its own copy;
+    exhausted only when all ``ctx.n_threads`` copies are."""
+
+    def __init__(self, factory: Callable[[], Generator]):
+        self.factory = factory
+        self.per_thread: dict[int, Generator] = {}
+        self.done: set[int] = set()
+
+    def next_for(self, ctx):
+        if ctx.thread not in self.per_thread:
+            self.per_thread[ctx.thread] = self.factory()
+        got = self.per_thread[ctx.thread].next_for(ctx)
+        if got is EXHAUSTED:
+            self.done.add(ctx.thread)
+            if len(self.done) >= ctx.n_threads:
+                return EXHAUSTED
+            return Pending(ctx.time + _POLL_NS)
+        return got
+
+
+class Scheduler:
+    """Hands ops from one generator tree to many worker threads.
+
+    The single lock is the concurrency-correctness boundary: generator state
+    only changes inside ``next_op``.  ``abort()`` poisons the scheduler so
+    every thread sees exhaustion and exits — the escape hatch when a worker
+    hits an unrecoverable error (otherwise combinators like ``EachThread``
+    would wait forever for the dead thread)."""
+
+    def __init__(self, gen: Generator, n_threads: int, start_ns: int | None = None):
+        self.gen = gen
+        self.n_threads = n_threads
+        self.lock = threading.Lock()
+        self.start_ns = start_ns if start_ns is not None else _time.monotonic_ns()
+        self.aborted = False
+
+    def now(self) -> int:
+        return _time.monotonic_ns() - self.start_ns
+
+    def abort(self) -> None:
+        with self.lock:
+            self.aborted = True
+
+    def next_op(self, thread: int, process: int) -> Op | Pending | None:
+        with self.lock:
+            if self.aborted:
+                return EXHAUSTED
+            ctx = Ctx(
+                time=self.now(),
+                thread=thread,
+                process=process,
+                n_threads=self.n_threads,
+            )
+            return self.gen.next_for(ctx)

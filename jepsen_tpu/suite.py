@@ -1,0 +1,147 @@
+"""Test assembly: the quorum-queue partition test.
+
+Equivalent of the reference's ``rabbit-test`` (``rabbitmq.clj:250-286``):
+compose client, nemesis, checkers, and the four-phase generator program —
+
+1. a rate-limited mix of enqueues (values from one incrementing counter)
+   and dequeues, with the nemesis cycling sleep→start→sleep→stop, bounded
+   by ``time_limit``;
+2. a final nemesis ``stop`` (heal);
+3. a logged recovery sleep;
+4. one ``drain`` per client thread (the final read the verdict hinges on).
+
+``build_sim_test`` wires it to the in-process simulator (no cluster
+needed); ``build_rabbitmq_test`` (control-plane milestone) wires the same
+program to a real RabbitMQ cluster over SSH + AMQP.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Mapping
+
+from jepsen_tpu.checkers.perf import Perf
+from jepsen_tpu.checkers.protocol import compose
+from jepsen_tpu.checkers.queue_lin import QueueLinearizability
+from jepsen_tpu.checkers.total_queue import TotalQueue
+from jepsen_tpu.client.protocol import QueueClient
+from jepsen_tpu.client.sim import SimCluster, sim_driver_factory
+from jepsen_tpu.control.net import SimNet
+from jepsen_tpu.control.nemesis import PartitionNemesis
+from jepsen_tpu.control.runner import DB, Test
+from jepsen_tpu.generators.core import (
+    Clients,
+    Cycle,
+    Delay,
+    EachThread,
+    FnGen,
+    Log,
+    Mix,
+    NemesisOnly,
+    NemesisRoute,
+    Once,
+    OpGen,
+    Phases,
+    Sleep,
+    TimeLimit,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+DEFAULT_OPTS: dict[str, Any] = {
+    # the reference's CLI defaults (rabbitmq.clj:288-327)
+    "rate": 50.0,  # ops/sec
+    "time-limit": 30.0,  # seconds of phase-1 load
+    "time-before-partition": 10.0,
+    "partition-duration": 10.0,
+    "network-partition": "partition-random-halves",
+    "publish-confirm-timeout": 5.0,  # seconds (5000 ms in the reference)
+    "recovery-sleep": 20.0,  # gen/sleep 20 before drain
+    "consumer-type": "polling",
+    "net-ticktime": 15,
+    "quorum-initial-group-size": 0,
+    "dead-letter": False,
+}
+
+
+def queue_generator(opts: Mapping[str, Any]):
+    """The four-phase generator program (``rabbitmq.clj:267-284``)."""
+    counter = itertools.count()
+    enqueue = FnGen(
+        lambda ctx: Op.invoke(OpF.ENQUEUE, ctx.process, next(counter))
+    )
+    dequeue = FnGen(lambda ctx: Op.invoke(OpF.DEQUEUE, ctx.process))
+
+    nemesis_cycle = Cycle(
+        lambda: [
+            Sleep(opts["time-before-partition"]),
+            Once(OpGen(OpF.START, OpType.INFO)),
+            Sleep(opts["partition-duration"]),
+            Once(OpGen(OpF.STOP, OpType.INFO)),
+        ]
+    )
+    phase_load = TimeLimit(
+        NemesisRoute(
+            nemesis_cycle,
+            Delay(Mix([enqueue, dequeue]), 1.0 / opts["rate"]),
+        ),
+        opts["time-limit"],
+    )
+    return Phases(
+        [
+            phase_load,
+            NemesisOnly(Once(OpGen(OpF.STOP, OpType.INFO))),
+            Log("waiting for recovery"),
+            Sleep(opts["recovery-sleep"]),
+            Clients(EachThread(lambda: Once(OpGen(OpF.DRAIN)))),
+        ]
+    )
+
+
+def queue_checker(backend: str = "tpu", with_perf: bool = True):
+    checkers = {
+        "queue": TotalQueue(backend=backend),
+        "linear": QueueLinearizability(backend=backend),
+    }
+    if with_perf:
+        checkers["perf"] = Perf()
+    return compose(checkers)
+
+
+def build_sim_test(
+    opts: Mapping[str, Any] | None = None,
+    nodes=("n1", "n2", "n3"),
+    concurrency: int = 5,
+    checker_backend: str = "tpu",
+    sim_seed: int = 0,
+    drop_acked_every: int = 0,
+    duplicate_every: int = 0,
+    store_root: str = "store",
+) -> tuple[Test, SimCluster]:
+    """The reference test wired to the in-process simulator."""
+    o = {**DEFAULT_OPTS, **(opts or {})}
+    cluster = SimCluster(
+        nodes,
+        seed=sim_seed,
+        drop_acked_every=drop_acked_every,
+        duplicate_every=duplicate_every,
+    )
+    nemesis = PartitionNemesis(
+        o["network-partition"], SimNet(cluster), nodes, seed=sim_seed
+    )
+    client = QueueClient(
+        sim_driver_factory(cluster),
+        publish_confirm_timeout_s=o["publish-confirm-timeout"],
+    )
+    test = Test(
+        name="rabbitmq-simple-partition-sim",
+        nodes=list(nodes),
+        client=client,
+        generator=queue_generator(o),
+        checker=queue_checker(checker_backend),
+        db=DB(),
+        nemesis=nemesis,
+        concurrency=concurrency,
+        store_root=store_root,
+        opts=o,
+    )
+    return test, cluster

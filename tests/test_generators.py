@@ -1,0 +1,104 @@
+"""Generator algebra semantics (single-threaded, driven by hand)."""
+
+from jepsen_tpu.generators.core import (
+    Clients,
+    Ctx,
+    Cycle,
+    Delay,
+    EachThread,
+    FnGen,
+    Mix,
+    NemesisOnly,
+    NemesisRoute,
+    Once,
+    OpGen,
+    Pending,
+    Phases,
+    Sleep,
+    TimeLimit,
+)
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+
+def ctx(t=0, thread=0, n=2):
+    return Ctx(time=t, thread=thread, process=thread, n_threads=n)
+
+
+def test_once_emits_exactly_one():
+    g = Once(OpGen(OpF.DRAIN))
+    assert isinstance(g.next_for(ctx()), Op)
+    assert g.next_for(ctx()) is None
+
+
+def test_time_limit_cuts_off():
+    g = TimeLimit(OpGen(OpF.DEQUEUE), 1.0)
+    assert isinstance(g.next_for(ctx(t=0)), Op)
+    assert g.next_for(ctx(t=int(2e9))) is None
+
+
+def test_delay_rate_limits_globally():
+    g = Delay(OpGen(OpF.DEQUEUE), 0.5)
+    assert isinstance(g.next_for(ctx(t=0, thread=0)), Op)
+    got = g.next_for(ctx(t=int(0.1e9), thread=1))
+    assert isinstance(got, Pending) and got.wake == int(0.5e9)
+    assert isinstance(g.next_for(ctx(t=int(0.6e9), thread=1)), Op)
+
+
+def test_mix_draws_from_all(monkeypatch):
+    a = FnGen(lambda c: Op.invoke(OpF.ENQUEUE, c.process, 1))
+    b = FnGen(lambda c: Op.invoke(OpF.DEQUEUE, c.process))
+    g = Mix([a, b], seed=4)
+    fs = {g.next_for(ctx()).f for _ in range(50)}
+    assert fs == {OpF.ENQUEUE, OpF.DEQUEUE}
+
+
+def test_sleep_pends_then_exhausts():
+    g = Sleep(1.0)
+    got = g.next_for(ctx(t=int(0.5e9)))
+    assert isinstance(got, Pending) and got.wake == int(1.5e9)
+    assert g.next_for(ctx(t=int(1.6e9))) is None
+
+
+def test_nemesis_route_waits_for_both_sides():
+    g = NemesisOnly(Once(OpGen(OpF.STOP, OpType.INFO)))
+    # client asks first: its side (Nothing) exhausts, but nemesis is alive
+    got = g.next_for(ctx(thread=0))
+    assert isinstance(got, Pending)
+    # nemesis emits its op, then the generator is exhausted for everyone
+    op = g.next_for(ctx(thread=NEMESIS_PROCESS))
+    assert isinstance(op, Op) and op.f == OpF.STOP
+    assert g.next_for(ctx(thread=NEMESIS_PROCESS)) is None
+    assert g.next_for(ctx(thread=0)) is None
+
+
+def test_each_thread_waits_for_all_threads():
+    g = Clients(EachThread(lambda: Once(OpGen(OpF.DRAIN))))
+    assert isinstance(g.next_for(ctx(thread=0, n=2)), Op)
+    # thread 0 done, but thread 1 hasn't drained yet
+    assert isinstance(g.next_for(ctx(thread=0, n=2)), Pending)
+    assert isinstance(g.next_for(ctx(thread=NEMESIS_PROCESS, n=2)), Pending)
+    assert isinstance(g.next_for(ctx(thread=1, n=2)), Op)
+    assert g.next_for(ctx(thread=1, n=2)) is None
+    assert g.next_for(ctx(thread=NEMESIS_PROCESS, n=2)) is None
+
+
+def test_phases_advance_in_order():
+    g = Phases(
+        [
+            Once(OpGen(OpF.ENQUEUE, value=1)),
+            Once(OpGen(OpF.DEQUEUE)),
+        ]
+    )
+    assert g.next_for(ctx()).f == OpF.ENQUEUE
+    assert g.next_for(ctx()).f == OpF.DEQUEUE
+    assert g.next_for(ctx()) is None
+
+
+def test_cycle_repeats_factory():
+    g = TimeLimit(Cycle(lambda: [Once(OpGen(OpF.START, OpType.INFO))]), 1.0)
+    ops = []
+    for _ in range(5):
+        got = g.next_for(ctx(t=0))
+        ops.append(got)
+    assert all(isinstance(o, Op) and o.f == OpF.START for o in ops)
+    assert g.next_for(ctx(t=int(2e9))) is None

@@ -1,0 +1,150 @@
+"""End-to-end: the full test lifecycle against the in-process simulator.
+
+This is the framework's answer to the reference's cluster tests
+(``rabbitmq_test.clj:46-77``) without needing a broker: run the real
+generator program, real clients, real nemesis, record a real history, and
+check it on the TPU path.  Timescales are compressed (seconds → tens of
+milliseconds) so the suite stays fast.
+"""
+
+import pytest
+
+from jepsen_tpu.control.runner import run_test
+from jepsen_tpu.history.ops import OpF, OpType
+from jepsen_tpu.suite import build_sim_test
+
+FAST_OPTS = {
+    "rate": 400.0,
+    "time-limit": 1.5,
+    "time-before-partition": 0.3,
+    "partition-duration": 0.4,
+    "recovery-sleep": 0.2,
+}
+
+
+def _run(tmp_path, **kw):
+    test, cluster = build_sim_test(
+        opts=FAST_OPTS, store_root=str(tmp_path / "store"), **kw
+    )
+    run = run_test(test)
+    return run, cluster
+
+
+def test_healthy_cluster_is_valid(tmp_path):
+    run, cluster = _run(tmp_path)
+    assert run.results["queue"]["valid?"], run.results["queue"]
+    assert run.results["linear"]["valid?"], run.results["linear"]
+    assert run.valid
+    # drain emptied the queue (the CI cross-check, ci/jepsen-test.sh:144-155)
+    assert cluster.queue_length() == 0
+
+
+def test_history_structure(tmp_path):
+    run, _ = _run(tmp_path)
+    h = run.history
+    # indices sequential, times monotonic
+    assert [op.index for op in h] == list(range(len(h)))
+    assert all(
+        h[i].time <= h[i + 1].time for i in range(len(h) - 1)
+    )
+    fs = {op.f for op in h}
+    assert OpF.ENQUEUE in fs and OpF.DEQUEUE in fs and OpF.DRAIN in fs
+    # the nemesis actually cut and healed
+    assert any(op.f == OpF.START for op in h)
+    assert any(op.f == OpF.STOP for op in h)
+    # partitions produced at least some failed/indeterminate ops
+    assert any(op.type in (OpType.FAIL, OpType.INFO) for op in h)
+    # one drain per worker thread
+    drains = [
+        op for op in h if op.f == OpF.DRAIN and op.type == OpType.INVOKE
+    ]
+    assert len(drains) == run.test.concurrency
+
+
+def test_lossy_broker_is_caught(tmp_path):
+    # a broker bug that drops every 5th confirmed message MUST fail the run
+    run, _ = _run(tmp_path, drop_acked_every=5)
+    q = run.results["queue"]
+    assert not q["valid?"]
+    assert q["lost-count"] > 0
+    assert not run.valid
+
+
+def test_duplicating_broker_reported_but_valid(tmp_path):
+    run, _ = _run(tmp_path, duplicate_every=4)
+    q = run.results["queue"]
+    assert q["duplicated-count"] > 0
+    assert q["valid?"]  # at-least-once is legal for total-queue
+    # but duplicates ARE a linearizability violation for the queue model
+    assert not run.results["linear"]["valid?"]
+    assert run.results["linear"]["duplicate-count"] > 0
+
+
+def test_store_artifacts_written(tmp_path):
+    run, _ = _run(tmp_path)
+    d = run.run_dir
+    assert (d / "history.jsonl").is_file()
+    assert (d / "results.json").is_file()
+    assert (d / "latency-raw.png").is_file()
+    assert (d / "rate.png").is_file()
+
+
+@pytest.mark.parametrize(
+    "strategy",
+    [
+        "partition-halves",
+        "partition-majorities-ring",
+        "partition-random-node",
+    ],
+)
+def test_all_partition_strategies_run_clean(tmp_path, strategy):
+    test, cluster = build_sim_test(
+        opts={**FAST_OPTS, "network-partition": strategy, "time-limit": 1.0},
+        store_root=str(tmp_path / "store"),
+    )
+    run = run_test(test)
+    assert run.results["queue"]["valid?"], run.results["queue"]
+
+
+def test_unconnectable_client_fails_ops_but_run_completes(tmp_path):
+    # a client that cannot connect must not deadlock the run: its ops fail
+    from jepsen_tpu.suite import build_sim_test
+
+    test, _ = build_sim_test(
+        opts={**FAST_OPTS, "time-limit": 0.5, "recovery-sleep": 0.1},
+        store_root=str(tmp_path / "store"),
+    )
+
+    class BrokenClient:
+        def open(self, t, node):
+            raise ConnectionRefusedError("nope")
+
+    test.client = BrokenClient()
+    run = run_test(test)
+    client_ops = [op for op in run.history if op.process >= 0]
+    assert client_ops, "run recorded no client ops"
+    completions = [op for op in client_ops if op.type != OpType.INVOKE]
+    assert all(op.type == OpType.FAIL for op in completions)
+
+
+def test_time_limit_clamps_nemesis_sleep():
+    # a nemesis mid-cycle sleep must not outlive the time limit
+    from jepsen_tpu.generators.core import (
+        Ctx,
+        Cycle,
+        Once,
+        OpGen,
+        Pending,
+        Sleep,
+        TimeLimit,
+    )
+    from jepsen_tpu.history.ops import NEMESIS_PROCESS
+
+    g = TimeLimit(
+        Cycle(lambda: [Sleep(100.0), Once(OpGen(OpF.START, OpType.INFO))]),
+        1.0,
+    )
+    got = g.next_for(
+        Ctx(time=0, thread=NEMESIS_PROCESS, process=-1, n_threads=1)
+    )
+    assert isinstance(got, Pending) and got.wake == int(1e9)

@@ -21,3 +21,8 @@ from jepsen_tpu.checkers.queue_lin import (  # noqa: F401
     queue_lin_tensor_check,
 )
 from jepsen_tpu.checkers.perf import Perf, perf_tensor_check  # noqa: F401
+from jepsen_tpu.checkers.wgl import (  # noqa: F401
+    QueueWgl,
+    check_wgl_cpu,
+    wgl_tensor_check,
+)

@@ -1,0 +1,377 @@
+"""Wing-Gong linearizability: the general-model search engine.
+
+The Knossos capability of the reference's legacy test
+(``rabbitmq_test.clj:55-58``: ``checker/queue`` over
+``model/unordered-queue``), rebuilt twice:
+
+- ``check_wgl_cpu`` — the classic search (Wing & Gong 1993, with Lowe's
+  just-in-time refinement): explore sets of "linearized so far" ops,
+  forcing each op into every surviving configuration by the time it
+  returns.  Configurations are ``(linearized-op-set, model-state)`` pairs,
+  deduplicated; exponential worst case, capped.
+
+- ``wgl_tensor_check`` — the same search recast for XLA's static-shape
+  model (SURVEY.md §7 "hard parts" #1): a **frontier-bitset BFS**.  A
+  configuration is one row of ``uint32``: ``K`` words of linearized-op
+  bitset + the model's fixed-width state words.  The frontier is a
+  fixed-capacity ``[F, K+SW]`` matrix.  Per return event (a ``lax.scan``),
+  a ``lax.while_loop`` closes the frontier under single-op linearizations
+  (``[F] × [W]`` candidate expansion → lexicographic sort → neighbor
+  dedup → truncate to ``F``), then rows missing the returning op are
+  culled.  Empty frontier ⇒ not linearizable; frontier overflow ⇒
+  *unknown*, and the checker falls back to the CPU engine (the escape
+  hatch the survey calls for).  ``jax.vmap`` batches across histories.
+
+Why this shape: the branching factor is bounded by the number of
+concurrently open ops (≤ client concurrency, plus accumulated
+indeterminate ops), so frontiers stay small for real histories; all
+shapes are static, so the whole search compiles to one XLA program.
+
+Indeterminate (``info``) ops follow Knossos semantics: they may linearize
+at any point after their invocation — they join every later event's
+candidate set — or never (no return event forces them).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.models.core import Call, Model, UnorderedQueue
+
+INF = 2**31 - 1
+
+
+@dataclass(frozen=True)
+class WglOp:
+    """One operation for the search: its model call + history interval.
+    ``ret == INF`` marks an indeterminate op (open forever)."""
+
+    call: Call
+    inv: int
+    ret: int
+
+
+# ---------------------------------------------------------------------------
+# history → WglOps (quorum-queue mapping)
+# ---------------------------------------------------------------------------
+
+
+def queue_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
+    """Map a queue history onto unordered-queue model calls.
+
+    - ok/info enqueues become ENQUEUE calls (info ⇒ ret=INF);
+    - ok dequeues/drain values become DEQUEUE calls (one per drained value,
+      sharing the drain's interval);
+    - failed ops never happened; indeterminate dequeues carry no value and
+      therefore no constraint (Knossos drops unknown-value reads too).
+    """
+    out: list[WglOp] = []
+    open_inv: dict[int, int] = {}
+    for pos, op in enumerate(history):
+        if op.type == OpType.INVOKE:
+            open_inv[op.process] = pos
+            continue
+        # a completion with no recorded INVOKE (truncated log) is treated as
+        # invoked at some unknown earlier point (-1) — sound, never
+        # impossible-to-linearize
+        inv = open_inv.pop(op.process, -1)
+        if op.f == OpF.ENQUEUE and isinstance(op.value, int):
+            if op.type == OpType.OK:
+                out.append(WglOp(Call(UnorderedQueue.ENQUEUE, op.value), inv, pos))
+            elif op.type == OpType.INFO:
+                out.append(WglOp(Call(UnorderedQueue.ENQUEUE, op.value), inv, INF))
+        elif op.f in (OpF.DEQUEUE, OpF.DRAIN) and op.type == OpType.OK:
+            vals = op.value if isinstance(op.value, (list, tuple)) else [op.value]
+            for v in vals:
+                if isinstance(v, int):
+                    out.append(WglOp(Call(UnorderedQueue.DEQUEUE, v), inv, pos))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CPU engine
+# ---------------------------------------------------------------------------
+
+
+def check_wgl_cpu(
+    ops: Sequence[WglOp], model: Model, max_configs: int = 200_000
+) -> dict[str, Any]:
+    """Returns ``{"valid?", "unknown", "final-op", "configs-explored"}``."""
+    n = len(ops)
+    configs: set[tuple[frozenset, Any]] = {(frozenset(), model.initial())}
+    rets = sorted(
+        (i for i in range(n) if ops[i].ret != INF), key=lambda i: ops[i].ret
+    )
+    explored = 1
+    for j in rets:
+        r = ops[j].ret
+        cands = [
+            q
+            for q in range(n)
+            if ops[q].inv < r and (ops[q].ret >= r)
+        ]
+        frontier = configs
+        while frontier:
+            new: set = set()
+            for S, st in frontier:
+                for q in cands:
+                    if q in S:
+                        continue
+                    st2, legal = model.step(st, ops[q].call)
+                    if legal:
+                        c = (S | {q}, st2)
+                        if c not in configs and c not in new:
+                            new.add(c)
+            configs |= new
+            explored += len(new)
+            if len(configs) > max_configs:
+                return {
+                    VALID: False,
+                    "unknown": True,
+                    "final-op": j,
+                    "configs-explored": explored,
+                }
+            frontier = new
+        configs = {(S, st) for S, st in configs if j in S}
+        if not configs:
+            return {
+                VALID: False,
+                "unknown": False,
+                "final-op": j,
+                "configs-explored": explored,
+            }
+    return {VALID: True, "unknown": False, "final-op": None,
+            "configs-explored": explored}
+
+
+# ---------------------------------------------------------------------------
+# TPU engine
+# ---------------------------------------------------------------------------
+
+_U32_MAX = np.uint32(0xFFFFFFFF)
+
+
+@dataclass
+class WglBatch:
+    """Host-packed search inputs (all ``[B, …]``)."""
+
+    f: jax.Array  # [B, N] int32 call function codes
+    a0: jax.Array  # [B, N] int32
+    a1: jax.Array  # [B, N] int32
+    ret_op: jax.Array  # [B, R] int32 — op index returning at event j (-1 pad)
+    cands: jax.Array  # [B, R, W] int32 — candidate op indices (-1 pad)
+    cand_overflow: np.ndarray  # [B] bool — host flag: W was too small
+    n: int  # ops per history (padded)
+
+
+def pack_wgl_batch(
+    batches: Sequence[Sequence[WglOp]], max_cands: int = 24
+) -> WglBatch:
+    B = len(batches)
+    N = max(1, max(len(ops) for ops in batches))
+    R = N
+    W = max_cands
+    f = np.zeros((B, N), np.int32)
+    a0 = np.zeros((B, N), np.int32)
+    a1 = np.zeros((B, N), np.int32)
+    ret_op = np.full((B, R), -1, np.int32)
+    cands = np.full((B, R, W), -1, np.int32)
+    overflow = np.zeros((B,), bool)
+    for b, ops in enumerate(batches):
+        for i, o in enumerate(ops):
+            f[b, i], a0[b, i], a1[b, i] = o.call.f, o.call.a0, o.call.a1
+        rets = sorted(
+            (i for i in range(len(ops)) if ops[i].ret != INF),
+            key=lambda i: ops[i].ret,
+        )
+        for j, i in enumerate(rets):
+            ret_op[b, j] = i
+            r = ops[i].ret
+            cs = [
+                q
+                for q in range(len(ops))
+                if ops[q].inv < r and ops[q].ret >= r
+            ]
+            if len(cs) > W:
+                overflow[b] = True
+                cs = cs[:W]
+            cands[b, j, : len(cs)] = cs
+    return WglBatch(
+        f=jnp.asarray(f),
+        a0=jnp.asarray(a0),
+        a1=jnp.asarray(a1),
+        ret_op=jnp.asarray(ret_op),
+        cands=jnp.asarray(cands),
+        cand_overflow=overflow,
+        n=N,
+    )
+
+
+def _dedup_truncate(rows, valid, capacity):
+    """Sort rows lexicographically (invalid last), mark first-of-kind, and
+    scatter the first ``capacity`` unique rows into a fresh frontier."""
+    m, d = rows.shape
+    sort_ops = [(~valid).astype(jnp.uint32)] + [rows[:, c] for c in range(d)]
+    sorted_cols = jax.lax.sort(tuple(sort_ops), num_keys=d + 1)
+    svalid = sorted_cols[0] == 0
+    srows = jnp.stack(sorted_cols[1:], axis=1)
+    differs = jnp.any(srows != jnp.roll(srows, 1, axis=0), axis=1)
+    is_new = svalid & differs.at[0].set(True)
+    rank = jnp.cumsum(is_new) - 1
+    total = jnp.where(is_new, 1, 0).sum()
+    keep = is_new & (rank < capacity)
+    idx = jnp.where(keep, rank, capacity)
+    out = jnp.zeros((capacity, d), jnp.uint32).at[idx].set(srows, mode="drop")
+    out_valid = jnp.zeros((capacity,), bool).at[idx].set(keep, mode="drop")
+    return out, out_valid, total
+
+
+def _make_wgl_program(model: Model, n_ops: int, capacity: int, n_cands: int):
+    """Build the jitted per-history search (then vmapped over the batch)."""
+    K = (n_ops + 31) // 32
+    SW = model.state_words
+    D = K + SW
+    step_batch = jax.vmap(model.tensor_step, in_axes=(0, None, None, None))
+
+    def search(f, a0, a1, ret_op, cands):
+        init_state = jnp.asarray(model.initial_tensor(), jnp.uint32)
+        rows0 = jnp.zeros((capacity, D), jnp.uint32).at[0, K:].set(init_state)
+        valid0 = jnp.zeros((capacity,), bool).at[0].set(True)
+
+        def expand(rows, valid, cand_row, active):
+            """One closure step: try linearizing each candidate onto each
+            config; returns the deduped union."""
+
+            def per_cand(q):
+                live = valid & active & (q >= 0)
+                qc = jnp.clip(q, 0, n_ops - 1)
+                word = qc // 32
+                bit = jnp.uint32(1) << jnp.uint32(qc % 32)
+                already = (rows[:, word] & bit) != 0
+                st2, legal = step_batch(rows[:, K:], f[qc], a0[qc], a1[qc])
+                ok = live & ~already & legal
+                nr = jnp.concatenate(
+                    [rows[:, :K].at[:, word].set(rows[:, word] | bit), st2],
+                    axis=1,
+                )
+                return nr, ok
+
+            new_rows, new_valid = jax.vmap(per_cand)(cand_row)
+            all_rows = jnp.concatenate(
+                [rows[None], new_rows], axis=0
+            ).reshape(-1, D)
+            all_valid = jnp.concatenate(
+                [valid[None], new_valid], axis=0
+            ).reshape(-1)
+            return _dedup_truncate(all_rows, all_valid, capacity)
+
+        def event_step(carry, inputs):
+            rows, valid, fail, overflow = carry
+            ret_q, cand_row = inputs
+            active = (ret_q >= 0) & ~fail
+
+            def closure_cond(c):
+                _, _, count, changed, ovf = c
+                return changed & ~ovf
+
+            def closure_body(c):
+                rows, valid, count, _, ovf = c
+                rows2, valid2, total = expand(rows, valid, cand_row, active)
+                ovf2 = ovf | (total > capacity)
+                return rows2, valid2, total, total > count, ovf2
+
+            count0 = valid.sum()
+            rows, valid, _, _, ovf = jax.lax.while_loop(
+                closure_cond,
+                closure_body,
+                (rows, valid, count0, active, jnp.bool_(False)),
+            )
+            overflow = overflow | ovf
+
+            qc = jnp.clip(ret_q, 0, n_ops - 1)
+            word = qc // 32
+            bit = jnp.uint32(1) << jnp.uint32(qc % 32)
+            has = (rows[:, word] & bit) != 0
+            keep = jnp.where(active, valid & has, valid)
+            fail = fail | (active & ~keep.any())
+            return (rows, keep, fail, overflow), None
+
+        (rows, valid, fail, overflow), _ = jax.lax.scan(
+            event_step,
+            (rows0, valid0, jnp.bool_(False), jnp.bool_(False)),
+            (ret_op, cands),
+        )
+        return ~fail & ~overflow, overflow
+
+    return search
+
+
+@functools.lru_cache(maxsize=32)
+def _wgl_program_cached(model_key, n_ops, capacity, n_cands):
+    cls, args = model_key
+    search = _make_wgl_program(cls(*args), n_ops, capacity, n_cands)
+    return jax.jit(jax.vmap(search))
+
+
+def wgl_tensor_check(
+    batch: WglBatch, model_key, capacity: int = 128
+) -> tuple[np.ndarray, np.ndarray]:
+    """Returns ``(linearizable[B], unknown[B])`` numpy bools.
+    ``model_key`` is ``(ModelClass, ctor_args_tuple)`` — hashable, so the
+    compiled search program is cached per model/shape."""
+    prog = _wgl_program_cached(
+        model_key, batch.n, capacity, int(batch.cands.shape[-1])
+    )
+    ok, overflow = prog(batch.f, batch.a0, batch.a1, batch.ret_op, batch.cands)
+    ok = np.asarray(ok)
+    unknown = np.asarray(overflow) | batch.cand_overflow
+    return ok & ~unknown, unknown
+
+
+# ---------------------------------------------------------------------------
+# checker wrapper (quorum-queue / unordered-queue)
+# ---------------------------------------------------------------------------
+
+
+class QueueWgl(Checker):
+    """Knossos-style ``checker/queue``: full Wing-Gong search against the
+    unordered-queue model.  TPU backend with CPU fallback on overflow."""
+
+    name = "queue-wgl"
+
+    def __init__(self, backend: str = "tpu", capacity: int = 128):
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+        self.capacity = capacity
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        ops = queue_wgl_ops(history)
+        value_space = 32 * max(
+            1, math.ceil((max((o.call.a0 for o in ops), default=0) + 1) / 32)
+        )
+        model_key = (UnorderedQueue, (value_space,))
+
+        if self.backend == "tpu":
+            batch = pack_wgl_batch([ops])
+            ok, unknown = wgl_tensor_check(batch, model_key, self.capacity)
+            if not unknown[0]:
+                return {VALID: bool(ok[0]), "unknown": False, "engine": "tpu"}
+            # frontier overflow: escape-hatch to the exact CPU search
+        r = check_wgl_cpu(ops, UnorderedQueue(value_space))
+        r["engine"] = "cpu"
+        return r

@@ -59,13 +59,16 @@ def _resolve_history_path(path: Path) -> Path:
 
 def _checker_for(args, out_dir=None):
     backend = args.checker
-    return compose(
-        {
-            "perf": Perf(out_dir=out_dir),
-            "queue": TotalQueue(backend=backend),
-            "linear": QueueLinearizability(backend=backend),
-        }
-    )
+    checkers = {
+        "perf": Perf(out_dir=out_dir),
+        "queue": TotalQueue(backend=backend),
+        "linear": QueueLinearizability(backend=backend),
+    }
+    if getattr(args, "wgl", False):
+        from jepsen_tpu.checkers.wgl import QueueWgl
+
+        checkers["wgl"] = QueueWgl(backend=backend)
+    return compose(checkers)
 
 
 def cmd_check(args) -> int:
@@ -221,6 +224,12 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("tpu", "cpu"),
         default="tpu",
         help="analysis backend (the north-star dispatch seam)",
+    )
+    c.add_argument(
+        "--wgl",
+        action="store_true",
+        help="also run the full Wing-Gong linearizability search "
+        "(in addition to the per-value decomposition)",
     )
     c.set_defaults(fn=cmd_check)
 

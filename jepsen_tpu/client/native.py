@@ -1,0 +1,171 @@
+"""ctypes binding for the native C++ AMQP driver (``native/``).
+
+The native layer implements the reference's Java driver ABI
+(``Utils.java:154-167``: setup/enqueue/dequeue/drain/close/reconnect) over a
+from-scratch AMQP 0-9-1 codec; this module adapts it to
+:class:`jepsen_tpu.client.protocol.QueueDriver` so the same
+:class:`QueueClient` drives the simulator, a mini-broker, or a real
+RabbitMQ cluster.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.client.protocol import DriverTimeout, QueueDriver
+
+_LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libamqp_driver.so"
+
+CONSUMER_TYPES = {"polling": 0, "asynchronous": 1, "mixed": 2}
+
+_lib = None
+
+
+def load_library(path: str | Path | None = None) -> ctypes.CDLL:
+    global _lib
+    if _lib is not None and path is None:
+        return _lib
+    p = Path(path or _LIB_PATH)
+    if not p.exists():
+        raise FileNotFoundError(
+            f"{p} not built — run `make -C native` first"
+        )
+    lib = ctypes.CDLL(str(p))
+    lib.amqp_client_create.restype = ctypes.c_void_p
+    lib.amqp_client_create.argtypes = [
+        ctypes.c_char_p,  # hosts csv
+        ctypes.c_char_p,  # host
+        ctypes.c_int,  # port
+        ctypes.c_char_p,  # user
+        ctypes.c_char_p,  # pass
+        ctypes.c_int,  # consumer type
+        ctypes.c_int,  # quorum group size
+        ctypes.c_int,  # dead letter
+        ctypes.c_int,  # connect retry ms
+    ]
+    lib.amqp_client_setup.argtypes = [ctypes.c_void_p]
+    lib.amqp_client_enqueue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.amqp_client_dequeue.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+    ]
+    lib.amqp_client_drain.restype = ctypes.c_long
+    lib.amqp_client_drain.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int), ctypes.c_long,
+    ]
+    lib.amqp_client_reconnect.argtypes = [ctypes.c_void_p]
+    lib.amqp_client_close.argtypes = [ctypes.c_void_p]
+    lib.amqp_client_destroy.argtypes = [ctypes.c_void_p]
+    lib.amqp_reset.argtypes = [ctypes.c_int]
+    lib.amqp_set_logging.argtypes = [ctypes.c_int]
+    if path is None:
+        _lib = lib
+    return lib
+
+
+def reset(drain_wait_ms: int = -1) -> None:
+    """Clear the driver's global client registry/latches (test support,
+    = ``Utils.reset()``)."""
+    load_library().amqp_reset(drain_wait_ms)
+
+
+class NativeQueueDriver(QueueDriver):
+    """One AMQP client bound to one node."""
+
+    DRAIN_CAP = 1_000_000
+
+    def __init__(
+        self,
+        hosts: Sequence[str],
+        node: str,
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        consumer_type: str = "polling",
+        quorum_group_size: int = 0,
+        dead_letter: bool = False,
+        connect_retry_ms: int = 30000,
+    ):
+        self.lib = load_library()
+        self.handle = self.lib.amqp_client_create(
+            ",".join(hosts).encode(),
+            node.encode(),
+            port,
+            user.encode(),
+            password.encode(),
+            CONSUMER_TYPES[consumer_type],
+            quorum_group_size,
+            1 if dead_letter else 0,
+            connect_retry_ms,
+        )
+        if not self.handle:
+            raise ConnectionError(f"amqp_client_create failed for {node}")
+
+    def setup(self) -> None:
+        if self.lib.amqp_client_setup(self.handle) != 0:
+            raise ConnectionError("queue setup failed")
+
+    def enqueue(self, value: int, timeout_s: float) -> bool:
+        r = self.lib.amqp_client_enqueue(
+            self.handle, value, int(timeout_s * 1000)
+        )
+        if r == 1:
+            return True
+        if r == 0:
+            return False
+        if r == -1:
+            raise DriverTimeout("publish confirm timeout")
+        raise ConnectionError("enqueue failed (connection error)")
+
+    def dequeue(self, timeout_s: float) -> int | None:
+        out = ctypes.c_int(0)
+        status = self.lib.amqp_client_dequeue(
+            self.handle, int(timeout_s * 1000), ctypes.byref(out)
+        )
+        if status == 1:
+            if out.value < 0:
+                raise ConnectionError("unparseable message body")
+            return out.value
+        if status == 0:
+            return None
+        if status == -1:
+            raise DriverTimeout("dequeue timeout")
+        raise ConnectionError("dequeue failed (connection error)")
+
+    def drain(self) -> list[int]:
+        buf = (ctypes.c_int * self.DRAIN_CAP)()
+        n = self.lib.amqp_client_drain(self.handle, buf, self.DRAIN_CAP)
+        if n < 0:
+            raise ConnectionError("drain failed")
+        return list(buf[:n])
+
+    def reconnect(self) -> None:
+        if self.lib.amqp_client_reconnect(self.handle) != 0:
+            raise ConnectionError("reconnect failed")
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.amqp_client_close(self.handle)
+
+
+def native_driver_factory(
+    hosts: Sequence[str], port: int = 5672, **kw: Any
+):
+    """Factory for :class:`QueueClient`: ``(test, node) -> driver``."""
+
+    def factory(test: Mapping[str, Any], node: str) -> NativeQueueDriver:
+        return NativeQueueDriver(
+            hosts,
+            node,
+            port=port,
+            consumer_type=test.get("consumer-type", "polling"),
+            quorum_group_size=test.get("quorum-initial-group-size", 0),
+            dead_letter=test.get("dead-letter", False),
+            **kw,
+        )
+
+    return factory

@@ -1,1 +1,10 @@
-"""(built in a later milestone this round)"""
+"""Sequential data-type models for linearizability checking."""
+
+from jepsen_tpu.models.core import (  # noqa: F401
+    Call,
+    CasRegister,
+    FifoQueue,
+    Model,
+    Mutex,
+    UnorderedQueue,
+)

@@ -1,0 +1,202 @@
+"""Sequential data-type models for linearizability checking.
+
+Equivalent of ``knossos.model`` as the reference's legacy test uses it
+(``rabbitmq_test.clj:55-58``: ``model/unordered-queue``; the commented-out
+mutex variant at ``:18-44`` uses ``model/mutex``).  A model defines which
+operation is legal in which state; the Wing-Gong search
+(``jepsen_tpu.checkers.wgl``) explores linearization orders against it.
+
+Each model provides two step functions over the same *int-encoded* state:
+
+- ``step(state, call) -> (state', legal)`` in Python, for the CPU engine
+  (state is a hashable tuple);
+- ``tensor_step(state_vec, f, a0, a1) -> (state_vec', legal)`` in jnp over
+  a fixed-width ``uint32`` state vector, for the TPU frontier search.
+
+Calls are normalized to ``Call(f, a0, a1)`` int triples so both engines and
+the packed encoding agree:
+
+============== ==== ======================= =====================
+model          f    a0                      a1
+============== ==== ======================= =====================
+queue enqueue  0    value                   —
+queue dequeue  1    returned value          —
+reg write      0    value                   —
+reg read       1    returned value          —
+reg cas(o,n)   2    expected (old)          new
+mutex acquire  0    —                       —
+mutex release  1    —                       —
+============== ==== ======================= =====================
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Any, Hashable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Call:
+    """One linearizable operation as the model sees it."""
+
+    f: int
+    a0: int = 0
+    a1: int = 0
+
+
+class Model(abc.ABC):
+    """A sequential specification."""
+
+    name: str = "model"
+    #: uint32 words of tensor state (0 = model has no TPU step)
+    state_words: int = 0
+
+    @abc.abstractmethod
+    def initial(self) -> Hashable:
+        """Initial state (hashable, for the CPU engine)."""
+
+    @abc.abstractmethod
+    def step(self, state: Hashable, call: Call) -> tuple[Hashable, bool]:
+        """Apply ``call``; returns ``(state', legal)``."""
+
+    # ---- tensor side ------------------------------------------------------
+    def initial_tensor(self) -> np.ndarray:
+        """Initial state vector ``[state_words] uint32``."""
+        return np.zeros((self.state_words,), np.uint32)
+
+    def tensor_step(self, state, f, a0, a1):
+        """jnp twin of ``step`` over the state vector; must be vmappable.
+
+        Returns ``(state', legal)``; an illegal step may return any state."""
+        raise NotImplementedError(f"{self.name} has no tensor step")
+
+
+class UnorderedQueue(Model):
+    """Multiset queue (= ``knossos.model/unordered-queue``): enqueue adds a
+    value, dequeue removes *some* present value.  With the workload's
+    distinct values, state is the set of present values — a bitset over the
+    value space for the tensor engine."""
+
+    name = "unordered-queue"
+    ENQUEUE, DEQUEUE = 0, 1
+
+    def __init__(self, value_space: int = 1024):
+        self.value_space = value_space
+        self.state_words = (value_space + 31) // 32
+
+    def initial(self):
+        return frozenset()
+
+    def step(self, state, call):
+        if not (0 <= call.a0 < self.value_space):
+            # out-of-range values don't fit the bitset; reject them in BOTH
+            # engines so verdicts stay equivalent (size value_space to cover
+            # the history, as QueueWgl.check does)
+            return state, False
+        if call.f == self.ENQUEUE:
+            # distinct-value workload: re-enqueueing a present value is
+            # illegal (the bitset can't hold multiplicity — and the tensor
+            # step agrees)
+            return state | {call.a0}, call.a0 not in state
+        if call.a0 in state:
+            return state - {call.a0}, True
+        return state, False
+
+    def tensor_step(self, state, f, a0, a1):
+        in_range = (a0 >= 0) & (a0 < self.value_space)
+        word = jnp.clip(a0 // 32, 0, self.state_words - 1)
+        bit = jnp.uint32(1) << jnp.uint32(a0 % 32)
+        has = (state[word] & bit) != 0
+        is_enq = f == self.ENQUEUE
+        legal = jnp.where(is_enq, ~has, has) & in_range
+        new_word = jnp.where(is_enq, state[word] | bit, state[word] & ~bit)
+        state = state.at[word].set(jnp.where(legal, new_word, state[word]))
+        return state, legal
+
+
+class CasRegister(Model):
+    """Compare-and-set register (= ``knossos.model/cas-register``)."""
+
+    name = "cas-register"
+    WRITE, READ, CAS = 0, 1, 2
+    state_words = 1
+
+    def __init__(self, initial_value: int = 0):
+        self.initial_value = initial_value
+
+    def initial(self):
+        return self.initial_value
+
+    def step(self, state, call):
+        if call.f == self.WRITE:
+            return call.a0, True
+        if call.f == self.READ:
+            return state, state == call.a0
+        if state == call.a0:  # CAS hit
+            return call.a1, True
+        return state, False
+
+    def initial_tensor(self):
+        return np.asarray([self.initial_value], np.uint32)
+
+    def tensor_step(self, state, f, a0, a1):
+        cur = state[0]
+        a0u = jnp.uint32(a0)
+        is_write = f == self.WRITE
+        is_read = f == self.READ
+        hit = cur == a0u
+        # writes always legal; reads and CAS require a value match
+        legal = is_write | hit
+        new = jnp.where(
+            is_write, a0u, jnp.where(is_read, cur, jnp.uint32(a1))
+        )
+        state = state.at[0].set(jnp.where(legal, new, cur))
+        return state, legal
+
+
+class Mutex(Model):
+    """Lock (= ``knossos.model/mutex``)."""
+
+    name = "mutex"
+    ACQUIRE, RELEASE = 0, 1
+    state_words = 1
+
+    def initial(self):
+        return 0
+
+    def step(self, state, call):
+        if call.f == self.ACQUIRE:
+            return 1, state == 0
+        return 0, state == 1
+
+    def tensor_step(self, state, f, a0, a1):
+        cur = state[0]
+        is_acq = f == self.ACQUIRE
+        legal = jnp.where(is_acq, cur == 0, cur == 1)
+        new = jnp.where(is_acq, jnp.uint32(1), jnp.uint32(0))
+        state = state.at[0].set(jnp.where(legal, new, cur))
+        return state, legal
+
+
+class FifoQueue(Model):
+    """Ordered FIFO queue (CPU engine only: sequence state doesn't fit the
+    fixed-width tensor encoding; the quorum-queue tests use the unordered
+    model anyway, matching the reference)."""
+
+    name = "fifo-queue"
+    ENQUEUE, DEQUEUE = 0, 1
+    state_words = 0
+
+    def initial(self):
+        return ()
+
+    def step(self, state, call):
+        if call.f == self.ENQUEUE:
+            return state + (call.a0,), True
+        if state and state[0] == call.a0:
+            return state[1:], True
+        return state, False

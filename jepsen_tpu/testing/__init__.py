@@ -1,0 +1,3 @@
+"""Test doubles: the in-memory AMQP mini-broker."""
+
+from jepsen_tpu.testing.broker import MiniAmqpBroker  # noqa: F401

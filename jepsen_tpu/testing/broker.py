@@ -1,0 +1,429 @@
+"""A minimal in-memory AMQP 0-9-1 broker for driver tests.
+
+The reference tests its Java driver against a *real* broker on localhost
+(``UtilsTest.java:50``); this image has no RabbitMQ, so the framework
+ships a protocol-level stand-in: a threaded TCP server speaking the AMQP
+subset the native driver uses (handshake, channel, queue declare/purge,
+publisher confirms, basic publish/get/consume/ack/reject, heartbeat).  It
+is an *independent* implementation of the wire grammar (Python ``struct``
+vs the driver's C++ codec), so framing bugs on either side surface as
+protocol errors rather than silently agreeing.
+
+Fault injection mirrors what the checker must catch end-to-end:
+
+- ``drop_confirms``      — accept publishes but never confirm (client
+  publish-confirm timeouts → indeterminate ops);
+- ``lose_acked_every=k`` — confirm every k-th publish but drop the message
+  (data loss: ``total-queue`` must report ``lost``);
+- ``duplicate_every=k``  — deliver every k-th message twice (at-least-once
+  duplicates).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+
+
+FRAME_METHOD, FRAME_HEADER, FRAME_BODY, FRAME_HEARTBEAT = 1, 2, 3, 8
+FRAME_END = 0xCE
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def u8(self):
+        v = self.data[self.off]
+        self.off += 1
+        return v
+
+    def u16(self):
+        v = struct.unpack_from(">H", self.data, self.off)[0]
+        self.off += 2
+        return v
+
+    def u32(self):
+        v = struct.unpack_from(">I", self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def u64(self):
+        v = struct.unpack_from(">Q", self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def shortstr(self):
+        n = self.u8()
+        v = self.data[self.off : self.off + n].decode()
+        self.off += n
+        return v
+
+    def table(self):
+        n = self.u32()
+        self.off += n  # contents ignored — queue args don't matter in-memory
+
+    def rest(self):
+        return self.data[self.off :]
+
+
+@dataclass
+class _Message:
+    value: bytes
+
+
+@dataclass
+class _ConnState:
+    sock: socket.socket
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    publish_seq: int = 0
+    next_tag: int = 1
+    unacked: dict = field(default_factory=dict)  # tag -> (queue, _Message)
+    consuming_queue: str | None = None
+    confirms: bool = False
+    open: bool = True
+
+
+class MiniAmqpBroker:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        drop_confirms: bool = False,
+        lose_acked_every: int = 0,
+        duplicate_every: int = 0,
+    ):
+        self.host = host
+        self._server = socket.create_server((host, port))
+        self.port = self._server.getsockname()[1]
+        self.queues: dict[str, deque] = {}
+        self.state_lock = threading.Lock()
+        self.drop_confirms = drop_confirms
+        self.lose_acked_every = lose_acked_every
+        self.duplicate_every = duplicate_every
+        self._published = 0
+        self._delivered = 0
+        self._conns: list[_ConnState] = []
+        self._accept_thread: threading.Thread | None = None
+        self._running = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "MiniAmqpBroker":
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self.state_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    def queue_depth(self, name: str = "jepsen.queue") -> int:
+        with self.state_lock:
+            return len(self.queues.get(name, ()))
+
+    # ---- internals -------------------------------------------------------
+    def _accept_loop(self):
+        while self._running:
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                break
+            conn = _ConnState(sock=sock)
+            with self.state_lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _send_frame(self, conn: _ConnState, ftype: int, ch: int, payload: bytes):
+        with conn.lock:
+            try:
+                conn.sock.sendall(
+                    struct.pack(">BHI", ftype, ch, len(payload))
+                    + payload
+                    + bytes([FRAME_END])
+                )
+            except OSError:
+                conn.open = False
+
+    def _send_method(self, conn, ch, cls, mth, args: bytes = b""):
+        self._send_frame(
+            conn, FRAME_METHOD, ch, struct.pack(">HH", cls, mth) + args
+        )
+
+    def _recv_exact(self, sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _read_frame(self, sock):
+        hdr = self._recv_exact(sock, 7)
+        ftype, ch, size = struct.unpack(">BHI", hdr)
+        payload = self._recv_exact(sock, size) if size else b""
+        end = self._recv_exact(sock, 1)
+        if end[0] != FRAME_END:
+            raise ConnectionError("bad frame end")
+        return ftype, ch, payload
+
+    def _serve(self, conn: _ConnState):
+        sock = conn.sock
+        try:
+            proto = self._recv_exact(sock, 8)
+            if not proto.startswith(b"AMQP"):
+                return
+            # Start
+            args = (
+                bytes([0, 9])
+                + _longstr(b"")  # server properties (empty table)
+                + _longstr(b"PLAIN")
+                + _longstr(b"en_US")
+            )
+            self._send_method(conn, 0, 10, 10, args)
+            self._expect(sock, 10, 11)  # Start-Ok
+            self._send_method(
+                conn, 0, 10, 30, struct.pack(">HIH", 2047, 131072, 0)
+            )  # Tune
+            self._expect(sock, 10, 31)  # Tune-Ok
+            self._expect(sock, 10, 40)  # Open
+            self._send_method(conn, 0, 10, 41, _shortstr(""))  # Open-Ok
+
+            pending_publish_queue = None
+            pending_body = b""
+            pending_size = 0
+
+            while conn.open:
+                ftype, ch, payload = self._read_frame(sock)
+                if ftype == FRAME_HEARTBEAT:
+                    self._send_frame(conn, FRAME_HEARTBEAT, 0, b"")
+                    continue
+                if ftype == FRAME_HEADER:
+                    r = _Reader(payload)
+                    r.u16()
+                    r.u16()
+                    pending_size = r.u64()
+                    pending_body = b""
+                    if pending_size == 0 and pending_publish_queue:
+                        self._finish_publish(conn, pending_publish_queue, b"")
+                        pending_publish_queue = None
+                    continue
+                if ftype == FRAME_BODY:
+                    pending_body += payload
+                    if (
+                        len(pending_body) >= pending_size
+                        and pending_publish_queue is not None
+                    ):
+                        self._finish_publish(
+                            conn, pending_publish_queue, pending_body
+                        )
+                        pending_publish_queue = None
+                    continue
+                r = _Reader(payload)
+                cls, mth = r.u16(), r.u16()
+                if cls == 20 and mth == 10:  # Channel.Open
+                    self._send_method(conn, ch, 20, 11, _longstr(b""))
+                elif cls == 50 and mth == 10:  # Queue.Declare
+                    r.u16()
+                    qname = r.shortstr()
+                    with self.state_lock:
+                        self.queues.setdefault(qname, deque())
+                    self._send_method(
+                        conn,
+                        ch,
+                        50,
+                        11,
+                        _shortstr(qname) + struct.pack(">II", 0, 0),
+                    )
+                elif cls == 50 and mth == 30:  # Queue.Purge
+                    r.u16()
+                    qname = r.shortstr()
+                    with self.state_lock:
+                        n = len(self.queues.get(qname, ()))
+                        self.queues[qname] = deque()
+                    self._send_method(conn, ch, 50, 31, struct.pack(">I", n))
+                elif cls == 85 and mth == 10:  # Confirm.Select
+                    conn.confirms = True
+                    self._send_method(conn, ch, 85, 11)
+                elif cls == 60 and mth == 10:  # Basic.Qos
+                    self._send_method(conn, ch, 60, 11)
+                elif cls == 60 and mth == 40:  # Basic.Publish
+                    r.u16()
+                    r.shortstr()  # exchange
+                    routing_key = r.shortstr()
+                    pending_publish_queue = routing_key
+                elif cls == 60 and mth == 70:  # Basic.Get
+                    r.u16()
+                    qname = r.shortstr()
+                    self._handle_get(conn, ch, qname)
+                elif cls == 60 and mth == 20:  # Basic.Consume
+                    r.u16()
+                    qname = r.shortstr()
+                    conn.consuming_queue = qname
+                    self._send_method(conn, ch, 60, 21, _shortstr("ctag-1"))
+                    self._try_deliver(conn, ch)
+                elif cls == 60 and mth == 80:  # Basic.Ack (client)
+                    tag = r.u64()
+                    with self.state_lock:
+                        conn.unacked.pop(tag, None)
+                    self._try_deliver(conn, ch)
+                elif cls == 60 and mth == 90:  # Basic.Reject
+                    tag = r.u64()
+                    requeue = r.u8()
+                    with self.state_lock:
+                        item = conn.unacked.pop(tag, None)
+                        if item and requeue:
+                            qname, msg = item
+                            self.queues.setdefault(qname, deque()).append(msg)
+                    self._deliver_all()
+                elif cls == 10 and mth == 50:  # Connection.Close
+                    self._send_method(conn, 0, 10, 51)
+                    break
+                elif cls == 20 and mth == 40:  # Channel.Close
+                    self._send_method(conn, ch, 20, 41)
+                else:
+                    pass  # ignore anything else
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.open = False
+            # requeue un-acked deliveries (broker semantics on conn loss)
+            with self.state_lock:
+                for qname, msg in conn.unacked.values():
+                    self.queues.setdefault(qname, deque()).append(msg)
+                conn.unacked.clear()
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._deliver_all()
+
+    def _expect(self, sock, cls, mth):
+        while True:
+            ftype, _ch, payload = self._read_frame(sock)
+            if ftype != FRAME_METHOD:
+                continue
+            r = _Reader(payload)
+            c, m = r.u16(), r.u16()
+            if (c, m) == (cls, mth):
+                return payload
+            raise ConnectionError(f"expected {cls}.{mth}, got {c}.{m}")
+
+    def _finish_publish(self, conn: _ConnState, queue: str, body: bytes):
+        conn.publish_seq += 1
+        lose = False
+        with self.state_lock:
+            self._published += 1
+            if (
+                self.lose_acked_every
+                and self._published % self.lose_acked_every == 0
+            ):
+                lose = True  # confirm but drop: injected data loss
+            if not lose:
+                self.queues.setdefault(queue, deque()).append(_Message(body))
+        if conn.confirms and not self.drop_confirms:
+            self._send_method(
+                conn, 1, 60, 80, struct.pack(">QB", conn.publish_seq, 0)
+            )
+        self._deliver_all()
+
+    def _content_frames(self, conn, ch, body: bytes, method: bytes):
+        self._send_frame(conn, FRAME_METHOD, ch, method)
+        header = struct.pack(">HHQH", 60, 0, len(body), 0)
+        self._send_frame(conn, FRAME_HEADER, ch, header)
+        if body:
+            self._send_frame(conn, FRAME_BODY, ch, body)
+
+    def _handle_get(self, conn: _ConnState, ch: int, qname: str):
+        with self.state_lock:
+            q = self.queues.setdefault(qname, deque())
+            if not q:
+                msg = None
+            else:
+                msg = q.popleft()
+                self._delivered += 1
+                if (
+                    self.duplicate_every
+                    and self._delivered % self.duplicate_every == 0
+                ):
+                    q.append(_Message(msg.value))
+                tag = conn.next_tag
+                conn.next_tag += 1
+                conn.unacked[tag] = (qname, msg)
+        if msg is None:
+            self._send_method(conn, ch, 60, 72, _shortstr(""))
+            return
+        method = (
+            struct.pack(">HH", 60, 71)
+            + struct.pack(">QB", tag, 0)
+            + _shortstr("")
+            + _shortstr(qname)
+            + struct.pack(">I", 0)
+        )
+        self._content_frames(conn, ch, msg.value, method)
+
+    def _try_deliver(self, conn: _ConnState, ch: int = 1):
+        """QoS-1 push: deliver one message if the consumer has none in
+        flight."""
+        if conn.consuming_queue is None or not conn.open:
+            return
+        with self.state_lock:
+            if conn.unacked:
+                return
+            q = self.queues.setdefault(conn.consuming_queue, deque())
+            if not q:
+                return
+            msg = q.popleft()
+            self._delivered += 1
+            if (
+                self.duplicate_every
+                and self._delivered % self.duplicate_every == 0
+            ):
+                q.append(_Message(msg.value))
+            tag = conn.next_tag
+            conn.next_tag += 1
+            conn.unacked[tag] = (conn.consuming_queue, msg)
+        method = (
+            struct.pack(">HH", 60, 60)
+            + _shortstr("ctag-1")
+            + struct.pack(">QB", tag, 0)
+            + _shortstr("")
+            + _shortstr(conn.consuming_queue)
+        )
+        self._content_frames(conn, ch, msg.value, method)
+
+    def _deliver_all(self):
+        with self.state_lock:
+            conns = list(self._conns)
+        for c in conns:
+            self._try_deliver(c)

@@ -1,0 +1,1036 @@
+// AMQP 0-9-1 queue-client driver: the framework's native layer.
+//
+// Re-implements the behavior of the reference's Java driver
+// (/root/reference/rabbitmq/src/main/java/com/rabbitmq/jepsen/Utils.java)
+// as a C++ library with a C ABI for Python ctypes:
+//
+// - connection with a bounded retry loop, automatic recovery OFF — the test
+//   controls reconnection explicitly (Utils.java:289-317)
+// - lazy per-client initialization; once-guarded quorum-queue declaration
+//   (x-queue-type=quorum, optional initial group size, optional dead-letter
+//   topology with at-least-once strategy / reject-publish overflow / 1s TTL)
+//   followed by a purge (Utils.java:319-374)
+// - enqueue = persistent+mandatory publish + wait-for-confirms with timeout
+//   (Utils.java:376-385)
+// - dequeue with a hard deadline: polling basic.get+ack (Utils.java:563-630)
+//   or an async consumer (QoS 1) feeding an in-memory deque
+//   (Utils.java:473-561); "mixed" alternates per client (Utils.java:88-94)
+// - drain choreography: global once-latch; close ALL clients so un-acked
+//   messages requeue, wait, then connect to EVERY known host and
+//   basic.get-loop the queue (and dead-letter queue) until empty, acking
+//   each message (Utils.java:413-470)
+//
+// Concurrency design: one reader thread per connection routes inbound
+// frames — publisher confirms update a seqno watermark, deliveries feed the
+// consumer deque, synchronous method responses land in an RPC mailbox; all
+// guarded by one mutex + condvars per connection.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstdarg>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "amqp_wire.hpp"
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+using std::chrono::milliseconds;
+
+constexpr const char* QUEUE_NAME = "jepsen.queue";
+constexpr const char* DLQ_NAME = "jepsen.queue.dead.letter";
+constexpr int MESSAGE_TTL_MS = 1000;  // Utils.java:55
+
+int g_log_enabled = 1;
+
+void logf(const char* fmt, ...) {
+  if (!g_log_enabled) return;
+  va_list ap;
+  va_start(ap, fmt);
+  std::fprintf(stderr, "[amqp-driver] ");
+  std::vfprintf(stderr, fmt, ap);
+  std::fprintf(stderr, "\n");
+  va_end(ap);
+}
+
+// ---------------------------------------------------------------------------
+// TCP socket
+// ---------------------------------------------------------------------------
+
+class Socket {
+ public:
+  ~Socket() { close_fd(); }
+  bool connect_to(const std::string& host, int port, int timeout_ms) {
+    close_fd();
+    struct addrinfo hints = {};
+    hints.ai_family = AF_UNSPEC;
+    hints.ai_socktype = SOCK_STREAM;
+    struct addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    if (getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) != 0)
+      return false;
+    bool ok = false;
+    for (auto* ai = res; ai; ai = ai->ai_next) {
+      fd_ = socket(ai->ai_family, ai->ai_socktype, ai->ai_protocol);
+      if (fd_ < 0) continue;
+      struct timeval tv = {timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+      setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+      setsockopt(fd_, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+      if (connect(fd_, ai->ai_addr, ai->ai_addrlen) == 0) {
+        ok = true;
+        break;
+      }
+      close_fd();
+    }
+    freeaddrinfo(res);
+    return ok;
+  }
+  void set_recv_timeout(int ms) {
+    if (fd_ < 0) return;
+    struct timeval tv = {ms / 1000, (ms % 1000) * 1000};
+    setsockopt(fd_, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  bool send_all(const uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t k = send(fd_, p, n, MSG_NOSIGNAL);
+      if (k <= 0) return false;
+      p += k;
+      n -= k;
+    }
+    return true;
+  }
+  // 1 = got all, 0 = timeout, -1 = closed/error
+  int recv_all(uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t k = recv(fd_, p, n, 0);
+      if (k == 0) return -1;
+      if (k < 0) {
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return 0;
+        return -1;
+      }
+      p += k;
+      n -= k;
+    }
+    return 1;
+  }
+  void close_fd() {
+    if (fd_ >= 0) {
+      ::shutdown(fd_, SHUT_RDWR);
+      ::close(fd_);
+      fd_ = -1;
+    }
+  }
+  bool valid() const { return fd_ >= 0; }
+
+ private:
+  int fd_ = -1;
+};
+
+// ---------------------------------------------------------------------------
+// Connection: handshake + reader thread + RPC mailbox
+// ---------------------------------------------------------------------------
+
+struct Delivery {
+  uint64_t tag;
+  int32_t value;
+};
+
+class Connection {
+ public:
+  Connection(std::string host, int port, std::string user, std::string pass)
+      : host_(std::move(host)), port_(port), user_(std::move(user)),
+        pass_(std::move(pass)) {}
+
+  ~Connection() { close(); }
+
+  bool open(int timeout_ms) {
+    std::lock_guard<std::mutex> lk(write_mu_);
+    if (!sock_.connect_to(host_, port_, timeout_ms)) return false;
+    static const uint8_t proto[8] = {'A', 'M', 'Q', 'P', 0, 0, 9, 1};
+    if (!sock_.send_all(proto, 8)) return false;
+    try {
+      // Connection.Start / Start-Ok (PLAIN)
+      amqp::Frame f = read_frame_sync();
+      expect_method(f, amqp::CLS_CONNECTION, amqp::M_CONN_START);
+      {
+        auto w = amqp::method_writer(amqp::CLS_CONNECTION,
+                                     amqp::M_CONN_START_OK);
+        amqp::Table props;
+        props.put_str("product", "jepsen-tpu-driver");
+        props.serialize(w);
+        w.shortstr("PLAIN");
+        std::string resp;
+        resp.push_back('\0');
+        resp += user_;
+        resp.push_back('\0');
+        resp += pass_;
+        w.longstr(resp);
+        w.shortstr("en_US");
+        send_frame_locked(amqp::FRAME_METHOD, 0, w.buf);
+      }
+      // Tune / Tune-Ok (heartbeat 0: the test layer owns liveness)
+      f = read_frame_sync();
+      expect_method(f, amqp::CLS_CONNECTION, amqp::M_CONN_TUNE);
+      {
+        amqp::Reader r(f.payload.data(), f.payload.size());
+        r.u16();
+        r.u16();
+        uint16_t channel_max = r.u16();
+        uint32_t frame_max = r.u32();
+        (void)channel_max;
+        frame_max_ = frame_max ? std::min(frame_max, 131072u) : 131072u;
+        auto w =
+            amqp::method_writer(amqp::CLS_CONNECTION, amqp::M_CONN_TUNE_OK);
+        w.u16(2047);
+        w.u32(frame_max_);
+        w.u16(0);
+        send_frame_locked(amqp::FRAME_METHOD, 0, w.buf);
+      }
+      // Open / Open-Ok
+      {
+        auto w = amqp::method_writer(amqp::CLS_CONNECTION, amqp::M_CONN_OPEN);
+        w.shortstr("/");
+        w.shortstr("");
+        w.u8(0);
+        send_frame_locked(amqp::FRAME_METHOD, 0, w.buf);
+      }
+      f = read_frame_sync();
+      expect_method(f, amqp::CLS_CONNECTION, amqp::M_CONN_OPEN_OK);
+      // Channel.Open / Open-Ok
+      {
+        auto w = amqp::method_writer(amqp::CLS_CHANNEL, amqp::M_CH_OPEN);
+        w.shortstr("");
+        send_frame_locked(amqp::FRAME_METHOD, 1, w.buf);
+      }
+      f = read_frame_sync();
+      expect_method(f, amqp::CLS_CHANNEL, amqp::M_CH_OPEN_OK);
+    } catch (const std::exception& e) {
+      logf("handshake with %s failed: %s", host_.c_str(), e.what());
+      sock_.close_fd();
+      return false;
+    }
+    sock_.set_recv_timeout(250);  // reader thread poll granularity
+    closed_ = false;
+    reader_ = std::thread([this] { reader_loop(); });
+    return true;
+  }
+
+  void close() {
+    {
+      std::lock_guard<std::mutex> lk(write_mu_);
+      if (!closed_ && sock_.valid()) {
+        try {
+          auto w =
+              amqp::method_writer(amqp::CLS_CONNECTION, amqp::M_CONN_CLOSE);
+          w.u16(200);
+          w.shortstr("bye");
+          w.u16(0);
+          w.u16(0);
+          send_frame_locked(amqp::FRAME_METHOD, 0, w.buf);
+        } catch (...) {
+        }
+      }
+      closed_ = true;
+      sock_.close_fd();
+    }
+    signal_state();
+    if (reader_.joinable()) reader_.join();
+  }
+
+  bool alive() { return !closed_ && !broken_; }
+
+  // ---- RPC: send a method on channel 1, wait for (cls, mth) ------------
+  bool rpc(const amqp::Writer& w, uint16_t cls, uint16_t mth,
+           amqp::Frame* out, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(state_mu_);
+    rpc_expect_cls_ = cls;
+    rpc_expect_mth_ = mth;
+    rpc_have_ = false;
+    lk.unlock();
+    {
+      std::lock_guard<std::mutex> wlk(write_mu_);
+      if (closed_ || broken_) return false;
+      if (!send_frame_locked(amqp::FRAME_METHOD, 1, w.buf)) return false;
+    }
+    lk.lock();
+    bool ok = state_cv_.wait_for(lk, milliseconds(timeout_ms), [&] {
+      return rpc_have_ || broken_ || closed_;
+    });
+    if (!ok || !rpc_have_) return false;
+    if (out) *out = rpc_frame_;
+    rpc_expect_cls_ = 0;
+    return true;
+  }
+
+  // ---- publish + confirm -------------------------------------------------
+  void enable_confirms() {
+    auto w = amqp::method_writer(amqp::CLS_CONFIRM, amqp::M_CF_SELECT);
+    w.u8(0);
+    amqp::Frame f;
+    if (!rpc(w, amqp::CLS_CONFIRM, amqp::M_CF_SELECT_OK, &f, 5000))
+      throw std::runtime_error("confirm.select failed");
+    confirms_on_ = true;
+  }
+
+  // 1 confirmed, 0 nacked/returned, -1 timeout, -2 connection error
+  int publish_confirm(const std::string& queue, int32_t value,
+                      int timeout_ms) {
+    uint64_t seq;
+    {
+      std::lock_guard<std::mutex> wlk(write_mu_);
+      if (closed_ || broken_) return -2;
+      seq = ++publish_seq_;
+      std::string body = std::to_string(value);
+      auto m = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_PUBLISH);
+      m.u16(0);
+      m.shortstr("");       // default exchange
+      m.shortstr(queue);    // routing key = queue
+      m.u8(1);              // mandatory
+      amqp::Writer out;
+      amqp::serialize_frame(out, amqp::FRAME_METHOD, 1, m.buf);
+      amqp::serialize_frame(out, amqp::FRAME_HEADER, 1,
+                            amqp::content_header(body.size()));
+      std::vector<uint8_t> bodyv(body.begin(), body.end());
+      amqp::serialize_frame(out, amqp::FRAME_BODY, 1, bodyv);
+      if (!sock_.send_all(out.buf.data(), out.buf.size())) {
+        broken_ = true;
+        return -2;
+      }
+    }
+    std::unique_lock<std::mutex> lk(state_mu_);
+    bool done = state_cv_.wait_for(lk, milliseconds(timeout_ms), [&] {
+      return confirmed_up_to_ >= seq || nacked_.count(seq) ||
+             returned_since_.load() > 0 || broken_ || closed_;
+    });
+    if (broken_ || closed_) return -2;
+    if (!done) return -1;
+    if (nacked_.count(seq)) {
+      nacked_.erase(seq);
+      return 0;
+    }
+    if (returned_since_.load() > 0) {
+      returned_since_ = 0;
+      return 0;  // mandatory return: unroutable
+    }
+    return 1;
+  }
+
+  // ---- basic.get ---------------------------------------------------------
+  // 1 = message (value+tag set), 0 = empty, -1 = timeout, -2 = error
+  int basic_get(const std::string& queue, int32_t* value, uint64_t* tag,
+                int timeout_ms) {
+    auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_GET);
+    w.u16(0);
+    w.shortstr(queue);
+    w.u8(0);  // manual ack
+    std::unique_lock<std::mutex> lk(state_mu_);
+    get_result_pending_ = true;
+    get_have_ = 0;
+    lk.unlock();
+    {
+      std::lock_guard<std::mutex> wlk(write_mu_);
+      if (closed_ || broken_) return -2;
+      if (!send_frame_locked(amqp::FRAME_METHOD, 1, w.buf)) return -2;
+    }
+    lk.lock();
+    bool done = state_cv_.wait_for(lk, milliseconds(timeout_ms), [&] {
+      return get_have_ != 0 || broken_ || closed_;
+    });
+    get_result_pending_ = false;
+    if (broken_ || closed_) return -2;
+    if (!done) return -1;
+    if (get_have_ == 2) return 0;  // get-empty
+    *value = get_value_;
+    *tag = get_tag_;
+    return 1;
+  }
+
+  bool basic_ack(uint64_t tag) {
+    auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_ACK);
+    w.u64(tag);
+    w.u8(0);
+    std::lock_guard<std::mutex> wlk(write_mu_);
+    if (closed_ || broken_) return false;
+    return send_frame_locked(amqp::FRAME_METHOD, 1, w.buf);
+  }
+
+  bool basic_reject_requeue(uint64_t tag) {
+    auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_REJECT);
+    w.u64(tag);
+    w.u8(1);  // requeue
+    std::lock_guard<std::mutex> wlk(write_mu_);
+    if (closed_ || broken_) return false;
+    return send_frame_locked(amqp::FRAME_METHOD, 1, w.buf);
+  }
+
+  // ---- consumer ----------------------------------------------------------
+  bool start_consumer(const std::string& queue) {
+    {
+      auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_QOS);
+      w.u32(0);
+      w.u16(1);  // prefetch 1 (Utils.java:540)
+      w.u8(0);
+      amqp::Frame f;
+      if (!rpc(w, amqp::CLS_BASIC, amqp::M_B_QOS_OK, &f, 5000)) return false;
+    }
+    auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_CONSUME);
+    w.u16(0);
+    w.shortstr(queue);
+    w.shortstr("");  // server-assigned tag
+    w.u8(0);         // no-local=0 no-ack=0 exclusive=0 no-wait=0
+    amqp::Table t;
+    t.serialize(w);
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_BASIC, amqp::M_B_CONSUME_OK, &f, 5000);
+  }
+
+  // pop one delivery; 1 = got, -1 = timeout, -2 = error
+  int pop_delivery(Delivery* d, int timeout_ms) {
+    std::unique_lock<std::mutex> lk(state_mu_);
+    bool ok = state_cv_.wait_for(lk, milliseconds(timeout_ms), [&] {
+      return !deliveries_.empty() || broken_ || closed_;
+    });
+    if (!deliveries_.empty()) {
+      *d = deliveries_.front();
+      deliveries_.pop_front();
+      return 1;
+    }
+    if (broken_ || closed_) return -2;
+    (void)ok;
+    return -1;
+  }
+
+  // ---- queue management --------------------------------------------------
+  bool declare_queue(const std::string& queue, const amqp::Table& args) {
+    auto w = amqp::method_writer(amqp::CLS_QUEUE, amqp::M_Q_DECLARE);
+    w.u16(0);
+    w.shortstr(queue);
+    w.u8(0x02);  // durable only
+    args.serialize(w);
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_QUEUE, amqp::M_Q_DECLARE_OK, &f, 10000);
+  }
+
+  bool purge_queue(const std::string& queue) {
+    auto w = amqp::method_writer(amqp::CLS_QUEUE, amqp::M_Q_PURGE);
+    w.u16(0);
+    w.shortstr(queue);
+    w.u8(0);
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_QUEUE, amqp::M_Q_PURGE_OK, &f, 10000);
+  }
+
+  const std::string& host() const { return host_; }
+
+ private:
+  // store-flag → empty state_mu_ critical section → notify: guarantees a
+  // waiter that checked the predicate before the store sees the wakeup
+  void signal_state() {
+    { std::lock_guard<std::mutex> s(state_mu_); }
+    state_cv_.notify_all();
+  }
+
+  bool send_frame_locked(uint8_t type, uint16_t ch,
+                         const std::vector<uint8_t>& payload) {
+    amqp::Writer out;
+    amqp::serialize_frame(out, type, ch, payload);
+    if (!sock_.send_all(out.buf.data(), out.buf.size())) {
+      broken_ = true;
+      signal_state();
+      return false;
+    }
+    return true;
+  }
+
+  // blocking single-frame read (handshake only, before reader starts)
+  amqp::Frame read_frame_sync() {
+    amqp::Frame f;
+    uint8_t hdr[7];
+    int r = sock_.recv_all(hdr, 7);
+    if (r != 1) throw std::runtime_error("read frame header failed");
+    f.type = hdr[0];
+    f.channel = (uint16_t(hdr[1]) << 8) | hdr[2];
+    uint32_t size = 0;
+    for (int i = 3; i < 7; ++i) size = (size << 8) | hdr[i];
+    if (size > 16 * 1024 * 1024) throw std::runtime_error("frame too large");
+    f.payload.resize(size);
+    if (size && sock_.recv_all(f.payload.data(), size) != 1)
+      throw std::runtime_error("read frame payload failed");
+    uint8_t end;
+    if (sock_.recv_all(&end, 1) != 1 || end != amqp::FRAME_END)
+      throw std::runtime_error("bad frame end");
+    return f;
+  }
+
+  static void expect_method(const amqp::Frame& f, uint16_t cls,
+                            uint16_t mth) {
+    if (f.type != amqp::FRAME_METHOD)
+      throw std::runtime_error("expected method frame");
+    amqp::Reader r(f.payload.data(), f.payload.size());
+    uint16_t c = r.u16(), m = r.u16();
+    if (c != cls || m != mth)
+      throw std::runtime_error("unexpected method " + std::to_string(c) +
+                               "." + std::to_string(m));
+  }
+
+  enum class ContentFor { NONE, DELIVER, GET };
+
+  void reader_loop() {
+    // pending content state (deliver / get-ok)
+    ContentFor pending = ContentFor::NONE;
+    uint64_t pending_tag = 0;
+    std::string body_acc;
+    uint64_t body_expected = 0;
+
+    while (true) {
+      {
+        std::lock_guard<std::mutex> lk(state_mu_);
+        if (closed_ || broken_) break;
+      }
+      amqp::Frame f;
+      uint8_t hdr[7];
+      int r = sock_.recv_all(hdr, 7);
+      if (r == 0) continue;  // poll timeout
+      if (r < 0) {
+        mark_broken();
+        break;
+      }
+      f.type = hdr[0];
+      f.channel = (uint16_t(hdr[1]) << 8) | hdr[2];
+      uint32_t size = 0;
+      for (int i = 3; i < 7; ++i) size = (size << 8) | hdr[i];
+      if (size > 16 * 1024 * 1024) {
+        mark_broken();
+        break;
+      }
+      f.payload.resize(size);
+      if (size) {
+        sock_.set_recv_timeout(5000);
+        if (sock_.recv_all(f.payload.data(), size) != 1) {
+          mark_broken();
+          break;
+        }
+      }
+      uint8_t end;
+      if (sock_.recv_all(&end, 1) != 1 || end != amqp::FRAME_END) {
+        mark_broken();
+        break;
+      }
+      sock_.set_recv_timeout(250);
+
+      try {
+        if (f.type == amqp::FRAME_HEARTBEAT) {
+          std::lock_guard<std::mutex> wlk(write_mu_);
+          std::vector<uint8_t> empty;
+          send_frame_locked(amqp::FRAME_HEARTBEAT, 0, empty);
+          continue;
+        }
+        if (f.type == amqp::FRAME_HEADER) {
+          amqp::Reader rd(f.payload.data(), f.payload.size());
+          rd.u16();
+          rd.u16();
+          body_expected = rd.u64();
+          body_acc.clear();
+          if (body_expected == 0) finish_content(pending, pending_tag, "");
+          if (body_expected == 0) pending = ContentFor::NONE;
+          continue;
+        }
+        if (f.type == amqp::FRAME_BODY) {
+          body_acc.append(reinterpret_cast<char*>(f.payload.data()),
+                          f.payload.size());
+          if (body_acc.size() >= body_expected) {
+            finish_content(pending, pending_tag, body_acc);
+            pending = ContentFor::NONE;
+          }
+          continue;
+        }
+        // method frame
+        amqp::Reader rd(f.payload.data(), f.payload.size());
+        uint16_t cls = rd.u16(), mth = rd.u16();
+        if (cls == amqp::CLS_BASIC && mth == amqp::M_B_ACK) {
+          uint64_t tag = rd.u64();
+          uint8_t multiple = rd.u8();
+          std::lock_guard<std::mutex> lk(state_mu_);
+          if (multiple)
+            confirmed_up_to_ = std::max(confirmed_up_to_, tag);
+          else if (tag == confirmed_up_to_ + 1)
+            confirmed_up_to_ = tag;
+          else
+            acked_single_.insert(tag);
+          while (acked_single_.count(confirmed_up_to_ + 1)) {
+            acked_single_.erase(confirmed_up_to_ + 1);
+            ++confirmed_up_to_;
+          }
+          state_cv_.notify_all();
+        } else if (cls == amqp::CLS_BASIC && mth == amqp::M_B_NACK) {
+          uint64_t tag = rd.u64();
+          uint8_t bits = rd.u8();
+          std::lock_guard<std::mutex> lk(state_mu_);
+          if (bits & 1) {  // multiple
+            for (uint64_t t = confirmed_up_to_ + 1; t <= tag; ++t)
+              nacked_.insert(t);
+            confirmed_up_to_ = std::max(confirmed_up_to_, tag);
+          } else {
+            nacked_.insert(tag);
+          }
+          state_cv_.notify_all();
+        } else if (cls == amqp::CLS_BASIC && mth == amqp::M_B_RETURN) {
+          returned_since_++;
+          state_cv_.notify_all();
+        } else if (cls == amqp::CLS_BASIC && mth == amqp::M_B_DELIVER) {
+          rd.shortstr();              // consumer tag
+          pending_tag = rd.u64();     // delivery tag
+          pending = ContentFor::DELIVER;
+        } else if (cls == amqp::CLS_BASIC && mth == amqp::M_B_GET_OK) {
+          pending_tag = rd.u64();
+          pending = ContentFor::GET;
+        } else if (cls == amqp::CLS_BASIC && mth == amqp::M_B_GET_EMPTY) {
+          std::lock_guard<std::mutex> lk(state_mu_);
+          if (get_result_pending_) get_have_ = 2;
+          state_cv_.notify_all();
+        } else if (cls == amqp::CLS_CONNECTION &&
+                   mth == amqp::M_CONN_CLOSE) {
+          {
+            std::lock_guard<std::mutex> wlk(write_mu_);
+            auto w = amqp::method_writer(amqp::CLS_CONNECTION,
+                                         amqp::M_CONN_CLOSE_OK);
+            send_frame_locked(amqp::FRAME_METHOD, 0, w.buf);
+          }
+          mark_broken();
+          break;
+        } else if (cls == amqp::CLS_CHANNEL && mth == amqp::M_CH_CLOSE) {
+          {
+            std::lock_guard<std::mutex> wlk(write_mu_);
+            auto w = amqp::method_writer(amqp::CLS_CHANNEL,
+                                         amqp::M_CH_CLOSE_OK);
+            send_frame_locked(amqp::FRAME_METHOD, 1, w.buf);
+          }
+          mark_broken();
+          break;
+        } else {
+          // RPC response?
+          std::lock_guard<std::mutex> lk(state_mu_);
+          if (rpc_expect_cls_ == cls && rpc_expect_mth_ == mth) {
+            rpc_frame_ = f;
+            rpc_have_ = true;
+            state_cv_.notify_all();
+          }
+          // anything else: ignore
+        }
+      } catch (const std::exception& e) {
+        logf("reader error on %s: %s", host_.c_str(), e.what());
+        mark_broken();
+        break;
+      }
+    }
+  }
+
+  void finish_content(ContentFor pending_kind, uint64_t tag,
+                      const std::string& body) {
+    int32_t value = -1;
+    try {
+      if (!body.empty()) value = std::stoi(body);
+    } catch (...) {
+      value = -1;
+    }
+    std::lock_guard<std::mutex> lk(state_mu_);
+    if (pending_kind == ContentFor::DELIVER) {
+      deliveries_.push_back({tag, value});
+    } else if (pending_kind == ContentFor::GET) {
+      if (get_result_pending_) {
+        get_value_ = value;
+        get_tag_ = tag;
+        get_have_ = 1;
+      }
+    }
+    state_cv_.notify_all();
+  }
+
+  void mark_broken() {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    broken_ = true;
+    state_cv_.notify_all();
+  }
+
+  std::string host_;
+  int port_;
+  std::string user_, pass_;
+  Socket sock_;
+  uint32_t frame_max_ = 131072;
+  std::thread reader_;
+
+  std::mutex write_mu_;  // serializes socket writes
+  std::mutex state_mu_;  // guards everything below
+  std::condition_variable state_cv_;
+  // closed_/broken_ are atomics: written under write_mu_ or state_mu_ but
+  // read from cv predicates under state_mu_ — signal_state() pairs every
+  // store with a state_mu_ acquire/release so waiters can't miss the wakeup
+  std::atomic<bool> closed_{true};
+  std::atomic<bool> broken_{false};
+
+  // confirms
+  bool confirms_on_ = false;
+  uint64_t publish_seq_ = 0;
+  uint64_t confirmed_up_to_ = 0;
+  std::set<uint64_t> acked_single_;
+  std::set<uint64_t> nacked_;
+  std::atomic<int> returned_since_{0};
+
+  // rpc mailbox
+  uint16_t rpc_expect_cls_ = 0, rpc_expect_mth_ = 0;
+  bool rpc_have_ = false;
+  amqp::Frame rpc_frame_;
+
+  // basic.get state
+  bool get_result_pending_ = false;
+  int get_have_ = 0;  // 1 = message, 2 = empty
+  int32_t get_value_ = -1;
+  uint64_t get_tag_ = 0;
+
+  // consumer deque
+  std::deque<Delivery> deliveries_;
+
+ public:
+  void clear_deliveries() {
+    std::lock_guard<std::mutex> lk(state_mu_);
+    deliveries_.clear();
+  }
+};
+
+}  // namespace
+
+// ===========================================================================
+// Client layer + C ABI
+// ===========================================================================
+
+namespace {
+
+struct ClientConfig {
+  std::vector<std::string> hosts;  // every cluster node (drain visits all)
+  std::string host;                // this client's node
+  int port = 5672;
+  std::string user = "guest", pass = "guest";
+  int consumer_type = 0;  // 0 polling, 1 async, 2 resolved from mixed
+  int quorum_group_size = 0;
+  bool dead_letter = false;
+  int connect_retry_ms = 30000;  // Utils.java:294-304
+};
+
+class Client;
+std::mutex g_registry_mu;
+std::vector<Client*> g_clients;       // Utils.java CLIENTS (:256)
+std::set<std::string> g_hosts;        // Utils.java HOSTS (:257)
+std::atomic<int> g_mixed_counter{0};  // alternates consumer types (:88-94)
+bool g_queues_declared = false;       // QUEUES_DECLARED latch (:259)
+bool g_drained = false;               // DRAINED latch (:258)
+bool g_drain_done = false;
+std::vector<int32_t> g_drain_result;
+std::condition_variable g_drain_cv;
+int g_drain_wait_ms = 5000;  // redelivery settle time (Utils.java:427)
+
+class Client {
+ public:
+  explicit Client(ClientConfig cfg) : cfg_(std::move(cfg)) {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    g_clients.push_back(this);
+    for (auto& h : cfg_.hosts) g_hosts.insert(h);
+    if (cfg_.consumer_type == 2)
+      async_ = (g_mixed_counter++ % 2) == 1;
+    else
+      async_ = cfg_.consumer_type == 1;
+  }
+
+  bool connect() {
+    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
+    while (Clock::now() < deadline) {
+      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
+                                               cfg_.user, cfg_.pass);
+      if (conn->open(5000)) {
+        std::lock_guard<std::mutex> lk(mu_);
+        conn_ = conn;
+        initialized_ = false;
+        return true;
+      }
+      std::this_thread::sleep_for(milliseconds(1000));
+    }
+    logf("connect to %s: retry budget exhausted", cfg_.host.c_str());
+    return false;
+  }
+
+  // lazy channel/consumer init (Utils.java:319-325)
+  bool initialize_if_necessary() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      if (!c) return false;
+      if (initialized_) return c->alive();
+    }
+    try {
+      declare_queues_once(*c);
+      c->enable_confirms();
+      if (async_ && !c->start_consumer(QUEUE_NAME)) return false;
+    } catch (const std::exception& e) {
+      logf("initialize on %s failed: %s", cfg_.host.c_str(), e.what());
+      return false;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    initialized_ = true;
+    return true;
+  }
+
+  void declare_queues_once(Connection& c) {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    if (g_queues_declared) return;
+    // quorum queue args (Utils.java:327-374)
+    amqp::Table args;
+    args.put_str("x-queue-type", "quorum");
+    if (cfg_.quorum_group_size > 0)
+      args.put_int("x-quorum-initial-group-size", cfg_.quorum_group_size);
+    if (cfg_.dead_letter) {
+      args.put_str("x-dead-letter-exchange", "");
+      args.put_str("x-dead-letter-routing-key", DLQ_NAME);
+      args.put_str("x-dead-letter-strategy", "at-least-once");
+      args.put_str("x-overflow", "reject-publish");
+      args.put_int("x-message-ttl", MESSAGE_TTL_MS);
+    }
+    if (!c.declare_queue(QUEUE_NAME, args))
+      throw std::runtime_error("queue.declare failed");
+    if (cfg_.dead_letter) {
+      amqp::Table dlq_args;
+      dlq_args.put_str("x-queue-type", "quorum");
+      if (!c.declare_queue(DLQ_NAME, dlq_args))
+        throw std::runtime_error("dlq declare failed");
+      if (!c.purge_queue(DLQ_NAME)) throw std::runtime_error("dlq purge");
+    }
+    if (!c.purge_queue(QUEUE_NAME)) throw std::runtime_error("purge failed");
+    g_queues_declared = true;
+  }
+
+  // 1 ok, 0 nack, -1 timeout, -2 error
+  int enqueue(int32_t value, int timeout_ms) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    return c->publish_confirm(QUEUE_NAME, value, timeout_ms);
+  }
+
+  // status: 1 = message (value in *out), 0 = empty, -1 = timeout,
+  // -2 = connection error  (hard deadline, Utils.java:387-401)
+  int dequeue(int timeout_ms, int32_t* out) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    if (async_) {
+      Delivery d;
+      int r = c->pop_delivery(&d, timeout_ms);
+      if (r == 1) {
+        c->basic_ack(d.tag);
+        *out = d.value;
+        return 1;
+      }
+      return r == -1 ? -1 : -2;  // deque timeout = op timeout
+    }
+    int32_t value;
+    uint64_t tag;
+    int r = c->basic_get(QUEUE_NAME, &value, &tag, timeout_ms);
+    if (r == 1) {
+      c->basic_ack(tag);
+      *out = value;
+      return 1;
+    }
+    if (r == 0) return 0;
+    return r == -1 ? -1 : -2;
+  }
+
+  void close_connection() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      conn_.reset();
+      initialized_ = false;
+    }
+    if (c) c->close();
+  }
+
+  bool reconnect() {
+    // async consumers clear their local deque so un-acked messages
+    // requeue broker-side (Utils.java:543-555)
+    close_connection();
+    return connect();
+  }
+
+  const ClientConfig& config() const { return cfg_; }
+
+ private:
+  std::shared_ptr<Connection> conn() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return conn_;
+  }
+  ClientConfig cfg_;
+  std::mutex mu_;
+  std::shared_ptr<Connection> conn_;
+  bool initialized_ = false;
+  bool async_ = false;
+};
+
+// drain: the correctness-critical final read (Utils.java:413-470)
+long drain_impl(Client* self, int32_t* out, long cap) {
+  {
+    std::unique_lock<std::mutex> lk(g_registry_mu);
+    if (g_drained) {
+      // someone already drained: wait for completion, return empty
+      g_drain_cv.wait(lk, [] { return g_drain_done; });
+      return 0;
+    }
+    g_drained = true;
+  }
+  // close ALL clients so un-acked deliveries requeue
+  std::vector<Client*> clients;
+  std::set<std::string> hosts;
+  bool dead_letter = false;
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    clients = g_clients;
+    hosts = g_hosts;
+    dead_letter = self->config().dead_letter;
+  }
+  for (auto* c : clients) c->close_connection();
+  std::this_thread::sleep_for(milliseconds(g_drain_wait_ms));
+
+  std::vector<int32_t> values;
+  for (const auto& host : hosts) {
+    Connection conn(host, self->config().port, self->config().user,
+                    self->config().pass);
+    if (!conn.open(5000)) {
+      logf("drain: cannot connect to %s", host.c_str());
+      continue;
+    }
+    std::vector<std::string> queues = {QUEUE_NAME};
+    if (dead_letter) queues.push_back(DLQ_NAME);
+    for (const auto& q : queues) {
+      while (true) {
+        int32_t value;
+        uint64_t tag;
+        int r = conn.basic_get(q, &value, &tag, 5000);
+        if (r != 1) break;
+        conn.basic_ack(tag);
+        values.push_back(value);
+      }
+    }
+    conn.close();
+  }
+  {
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    g_drain_result = values;
+    g_drain_done = true;
+  }
+  g_drain_cv.notify_all();
+  long n = std::min<long>(values.size(), cap);
+  for (long i = 0; i < n; ++i) out[i] = values[i];
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* amqp_client_create(const char* hosts_csv, const char* host, int port,
+                         const char* user, const char* pass,
+                         int consumer_type, int quorum_group_size,
+                         int dead_letter, int connect_retry_ms) {
+  ClientConfig cfg;
+  std::string csv(hosts_csv ? hosts_csv : "");
+  size_t start = 0;
+  while (start <= csv.size() && !csv.empty()) {
+    size_t comma = csv.find(',', start);
+    std::string h = csv.substr(
+        start, comma == std::string::npos ? std::string::npos : comma - start);
+    if (!h.empty()) cfg.hosts.push_back(h);
+    if (comma == std::string::npos) break;
+    start = comma + 1;
+  }
+  cfg.host = host ? host : "localhost";
+  cfg.port = port;
+  if (user) cfg.user = user;
+  if (pass) cfg.pass = pass;
+  cfg.consumer_type = consumer_type;
+  cfg.quorum_group_size = quorum_group_size;
+  cfg.dead_letter = dead_letter != 0;
+  if (connect_retry_ms > 0) cfg.connect_retry_ms = connect_retry_ms;
+  auto* c = new Client(std::move(cfg));
+  if (!c->connect()) {
+    // keep the object (caller may reconnect); report via setup/enqueue codes
+    logf("initial connect failed for %s", c->config().host.c_str());
+  }
+  return c;
+}
+
+int amqp_client_setup(void* p) {
+  auto* c = static_cast<Client*>(p);
+  return c->initialize_if_necessary() ? 0 : -1;
+}
+
+int amqp_client_enqueue(void* p, int value, int timeout_ms) {
+  return static_cast<Client*>(p)->enqueue(value, timeout_ms);
+}
+
+int amqp_client_dequeue(void* p, int timeout_ms, int* value_out) {
+  int32_t v = 0;
+  int status = static_cast<Client*>(p)->dequeue(timeout_ms, &v);
+  if (status == 1 && value_out) *value_out = v;
+  return status;
+}
+
+long amqp_client_drain(void* p, int* out, long cap) {
+  return drain_impl(static_cast<Client*>(p), out, cap);
+}
+
+int amqp_client_reconnect(void* p) {
+  return static_cast<Client*>(p)->reconnect() ? 0 : -1;
+}
+
+void amqp_client_close(void* p) {
+  static_cast<Client*>(p)->close_connection();
+}
+
+void amqp_client_destroy(void* p) {
+  auto* c = static_cast<Client*>(p);
+  c->close_connection();
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  g_clients.erase(std::remove(g_clients.begin(), g_clients.end(), c),
+                  g_clients.end());
+  delete c;
+}
+
+// test support (= Utils.reset(), Utils.java:147-152)
+void amqp_reset(int drain_wait_ms) {
+  std::lock_guard<std::mutex> lk(g_registry_mu);
+  g_clients.clear();
+  g_hosts.clear();
+  g_queues_declared = false;
+  g_drained = false;
+  g_drain_done = false;
+  g_drain_result.clear();
+  g_mixed_counter = 0;
+  if (drain_wait_ms >= 0) g_drain_wait_ms = drain_wait_ms;
+}
+
+void amqp_set_logging(int enabled) { g_log_enabled = enabled; }
+
+}  // extern "C"

@@ -1,0 +1,193 @@
+// AMQP 0-9-1 wire codec: frames, methods, field tables.
+//
+// TPU-native twin of the reference's Java driver layer
+// (/root/reference/rabbitmq/src/main/java/com/rabbitmq/jepsen/Utils.java,
+// which delegates framing to com.rabbitmq:amqp-client 5.34.0).  Here the
+// protocol subset the jepsen workload needs is implemented directly:
+// connection/channel handshake, queue declare/purge with argument tables
+// (x-queue-type=quorum etc.), publisher confirms, basic publish/get/consume/
+// ack/reject/nack, mandatory-return, and heartbeats.
+
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace amqp {
+
+// ---- frame types ----------------------------------------------------------
+constexpr uint8_t FRAME_METHOD = 1;
+constexpr uint8_t FRAME_HEADER = 2;
+constexpr uint8_t FRAME_BODY = 3;
+constexpr uint8_t FRAME_HEARTBEAT = 8;
+constexpr uint8_t FRAME_END = 0xCE;
+
+// ---- class / method ids ---------------------------------------------------
+constexpr uint16_t CLS_CONNECTION = 10;
+constexpr uint16_t M_CONN_START = 10, M_CONN_START_OK = 11, M_CONN_TUNE = 30,
+                   M_CONN_TUNE_OK = 31, M_CONN_OPEN = 40, M_CONN_OPEN_OK = 41,
+                   M_CONN_CLOSE = 50, M_CONN_CLOSE_OK = 51;
+constexpr uint16_t CLS_CHANNEL = 20;
+constexpr uint16_t M_CH_OPEN = 10, M_CH_OPEN_OK = 11, M_CH_CLOSE = 40,
+                   M_CH_CLOSE_OK = 41;
+constexpr uint16_t CLS_QUEUE = 50;
+constexpr uint16_t M_Q_DECLARE = 10, M_Q_DECLARE_OK = 11, M_Q_PURGE = 30,
+                   M_Q_PURGE_OK = 31, M_Q_DELETE = 40, M_Q_DELETE_OK = 41;
+constexpr uint16_t CLS_BASIC = 60;
+constexpr uint16_t M_B_QOS = 10, M_B_QOS_OK = 11, M_B_CONSUME = 20,
+                   M_B_CONSUME_OK = 21, M_B_PUBLISH = 40, M_B_RETURN = 50,
+                   M_B_DELIVER = 60, M_B_GET = 70, M_B_GET_OK = 71,
+                   M_B_GET_EMPTY = 72, M_B_ACK = 80, M_B_REJECT = 90,
+                   M_B_NACK = 120;
+constexpr uint16_t CLS_CONFIRM = 85;
+constexpr uint16_t M_CF_SELECT = 10, M_CF_SELECT_OK = 11;
+
+// ---- buffer writer --------------------------------------------------------
+struct Writer {
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u16(uint16_t v) {
+    buf.push_back(v >> 8);
+    buf.push_back(v & 0xFF);
+  }
+  void u32(uint32_t v) {
+    for (int i = 3; i >= 0; --i) buf.push_back((v >> (8 * i)) & 0xFF);
+  }
+  void u64(uint64_t v) {
+    for (int i = 7; i >= 0; --i) buf.push_back((v >> (8 * i)) & 0xFF);
+  }
+  void bytes(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+  void shortstr(const std::string& s) {
+    if (s.size() > 255) throw std::runtime_error("shortstr too long");
+    u8(static_cast<uint8_t>(s.size()));
+    bytes(s.data(), s.size());
+  }
+  void longstr(const std::string& s) {
+    u32(static_cast<uint32_t>(s.size()));
+    bytes(s.data(), s.size());
+  }
+};
+
+// ---- field table ----------------------------------------------------------
+struct Table {
+  Writer w;  // entries only; serialized with a length prefix
+  Table& put_str(const std::string& k, const std::string& v) {
+    w.shortstr(k);
+    w.u8('S');
+    w.longstr(v);
+    return *this;
+  }
+  Table& put_int(const std::string& k, int32_t v) {
+    w.shortstr(k);
+    w.u8('I');
+    w.u32(static_cast<uint32_t>(v));
+    return *this;
+  }
+  Table& put_bool(const std::string& k, bool v) {
+    w.shortstr(k);
+    w.u8('t');
+    w.u8(v ? 1 : 0);
+    return *this;
+  }
+  void serialize(Writer& out) const {
+    out.u32(static_cast<uint32_t>(w.buf.size()));
+    out.bytes(w.buf.data(), w.buf.size());
+  }
+};
+
+// ---- buffer reader --------------------------------------------------------
+struct Reader {
+  const uint8_t* p;
+  size_t n;
+  size_t off = 0;
+  Reader(const uint8_t* p_, size_t n_) : p(p_), n(n_) {}
+  void need(size_t k) const {
+    if (off + k > n) throw std::runtime_error("frame underflow");
+  }
+  uint8_t u8() {
+    need(1);
+    return p[off++];
+  }
+  uint16_t u16() {
+    need(2);
+    uint16_t v = (uint16_t(p[off]) << 8) | p[off + 1];
+    off += 2;
+    return v;
+  }
+  uint32_t u32() {
+    need(4);
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v = (v << 8) | p[off + i];
+    off += 4;
+    return v;
+  }
+  uint64_t u64() {
+    need(8);
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v = (v << 8) | p[off + i];
+    off += 8;
+    return v;
+  }
+  std::string shortstr() {
+    uint8_t k = u8();
+    need(k);
+    std::string s(reinterpret_cast<const char*>(p + off), k);
+    off += k;
+    return s;
+  }
+  std::string longstr() {
+    uint32_t k = u32();
+    need(k);
+    std::string s(reinterpret_cast<const char*>(p + off), k);
+    off += k;
+    return s;
+  }
+  void skip_table() {
+    uint32_t k = u32();
+    need(k);
+    off += k;
+  }
+};
+
+// ---- frame ----------------------------------------------------------------
+struct Frame {
+  uint8_t type = 0;
+  uint16_t channel = 0;
+  std::vector<uint8_t> payload;
+};
+
+inline void serialize_frame(Writer& w, uint8_t type, uint16_t channel,
+                            const std::vector<uint8_t>& payload) {
+  w.u8(type);
+  w.u16(channel);
+  w.u32(static_cast<uint32_t>(payload.size()));
+  w.bytes(payload.data(), payload.size());
+  w.u8(FRAME_END);
+}
+
+// method payload prefix
+inline Writer method_writer(uint16_t cls, uint16_t mth) {
+  Writer w;
+  w.u16(cls);
+  w.u16(mth);
+  return w;
+}
+
+// content header for basic publish: persistent delivery mode
+inline std::vector<uint8_t> content_header(uint64_t body_size) {
+  Writer w;
+  w.u16(CLS_BASIC);
+  w.u16(0);           // weight
+  w.u64(body_size);   // body size
+  w.u16(0x1000);      // property flags: delivery-mode present
+  w.u8(2);            // delivery-mode = persistent
+  return w.buf;
+}
+
+}  // namespace amqp

@@ -1,0 +1,213 @@
+"""Wing-Gong search: models, CPU engine, TPU frontier BFS, differential."""
+
+import pytest
+
+from jepsen_tpu.checkers.wgl import (
+    INF,
+    Call,
+    QueueWgl,
+    WglOp,
+    check_wgl_cpu,
+    pack_wgl_batch,
+    queue_wgl_ops,
+    wgl_tensor_check,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+from jepsen_tpu.models.core import CasRegister, Mutex, UnorderedQueue
+
+Q = UnorderedQueue
+ENQ, DEQ = Q.ENQUEUE, Q.DEQUEUE
+
+
+def both(ops, model_args=(64,)):
+    cpu = check_wgl_cpu(ops, UnorderedQueue(*model_args))
+    batch = pack_wgl_batch([ops])
+    ok, unknown = wgl_tensor_check(batch, (UnorderedQueue, model_args))
+    assert not unknown[0], "TPU search overflowed on a tiny history"
+    assert bool(ok[0]) == cpu["valid?"], f"cpu={cpu} tpu={bool(ok[0])}"
+    return cpu["valid?"]
+
+
+# ---- hand-built interval histories ---------------------------------------
+
+
+def test_sequential_enq_deq_linearizable():
+    ops = [
+        WglOp(Call(ENQ, 1), 0, 1),
+        WglOp(Call(DEQ, 1), 2, 3),
+    ]
+    assert both(ops)
+
+
+def test_deq_before_enq_not_linearizable():
+    ops = [
+        WglOp(Call(DEQ, 1), 0, 1),
+        WglOp(Call(ENQ, 1), 2, 3),
+    ]
+    assert not both(ops)
+
+
+def test_overlapping_enq_deq_linearizable():
+    ops = [
+        WglOp(Call(ENQ, 1), 0, 3),
+        WglOp(Call(DEQ, 1), 1, 2),
+    ]
+    assert both(ops)
+
+
+def test_double_dequeue_not_linearizable():
+    ops = [
+        WglOp(Call(ENQ, 1), 0, 1),
+        WglOp(Call(DEQ, 1), 2, 3),
+        WglOp(Call(DEQ, 1), 4, 5),
+    ]
+    assert not both(ops)
+
+
+def test_indeterminate_enqueue_allows_later_read():
+    ops = [
+        WglOp(Call(ENQ, 1), 0, INF),  # confirm timed out
+        WglOp(Call(DEQ, 1), 5, 6),
+    ]
+    assert both(ops)
+
+
+def test_indeterminate_enqueue_requires_invocation_order():
+    # the read completes before the enqueue was even invoked
+    ops = [
+        WglOp(Call(DEQ, 1), 0, 1),
+        WglOp(Call(ENQ, 1), 2, INF),
+    ]
+    assert not both(ops)
+
+
+def test_concurrent_swap_linearizable():
+    # two enqueues concurrent with two dequeues reading them crosswise
+    ops = [
+        WglOp(Call(ENQ, 1), 0, 10),
+        WglOp(Call(ENQ, 2), 0, 10),
+        WglOp(Call(DEQ, 2), 1, 9),
+        WglOp(Call(DEQ, 1), 1, 9),
+    ]
+    assert both(ops)
+
+
+# ---- other models (CPU engine) -------------------------------------------
+
+
+def test_cas_register():
+    m = CasRegister(0)
+    W, R, C = CasRegister.WRITE, CasRegister.READ, CasRegister.CAS
+    good = [
+        WglOp(Call(W, 5), 0, 1),
+        WglOp(Call(C, 5, 7), 2, 3),
+        WglOp(Call(R, 7), 4, 5),
+    ]
+    assert check_wgl_cpu(good, m)["valid?"]
+    bad = [
+        WglOp(Call(W, 5), 0, 1),
+        WglOp(Call(R, 9), 2, 3),  # reads a value never written
+    ]
+    assert not check_wgl_cpu(bad, m)["valid?"]
+
+
+def test_cas_register_tensor_matches_cpu():
+    W, R, C = CasRegister.WRITE, CasRegister.READ, CasRegister.CAS
+    cases = [
+        [WglOp(Call(W, 5), 0, 1), WglOp(Call(R, 5), 2, 3)],
+        [WglOp(Call(W, 5), 0, 1), WglOp(Call(R, 9), 2, 3)],
+        [WglOp(Call(W, 1), 0, 5), WglOp(Call(W, 2), 0, 5),
+         WglOp(Call(R, 1), 6, 7)],
+        [WglOp(Call(C, 0, 3), 0, 1), WglOp(Call(R, 3), 2, 3)],
+    ]
+    batch = pack_wgl_batch(cases)
+    ok, unknown = wgl_tensor_check(batch, (CasRegister, (0,)))
+    assert not unknown.any()
+    for i, ops in enumerate(cases):
+        assert bool(ok[i]) == check_wgl_cpu(ops, CasRegister(0))["valid?"]
+
+
+def test_mutex():
+    m = Mutex()
+    A, R = Mutex.ACQUIRE, Mutex.RELEASE
+    good = [
+        WglOp(Call(A), 0, 1),
+        WglOp(Call(R), 2, 3),
+        WglOp(Call(A), 4, 5),
+    ]
+    assert check_wgl_cpu(good, m)["valid?"]
+    # two non-overlapping acquires with no release: impossible
+    bad = [
+        WglOp(Call(A), 0, 1),
+        WglOp(Call(A), 2, 3),
+    ]
+    assert not check_wgl_cpu(bad, m)["valid?"]
+    batch = pack_wgl_batch([good, bad])
+    ok, unknown = wgl_tensor_check(batch, (Mutex, ()))
+    assert not unknown.any()
+    assert bool(ok[0]) and not bool(ok[1])
+
+
+# ---- full histories through the checker wrapper ---------------------------
+
+
+def test_checker_on_clean_synth_history():
+    sh = synth_history(SynthSpec(n_ops=120, seed=41))
+    r = QueueWgl(backend="tpu").check({}, sh.ops)
+    assert r["valid?"], r
+    r2 = QueueWgl(backend="cpu").check({}, sh.ops)
+    assert r2["valid?"]
+
+
+def test_checker_flags_duplicate_delivery():
+    sh = synth_history(SynthSpec(n_ops=120, seed=42, duplicated=1))
+    assert not QueueWgl(backend="tpu").check({}, sh.ops)["valid?"]
+    assert not QueueWgl(backend="cpu").check({}, sh.ops)["valid?"]
+
+
+def test_checker_flags_phantom_read():
+    sh = synth_history(SynthSpec(n_ops=120, seed=43, unexpected=1))
+    assert not QueueWgl(backend="tpu").check({}, sh.ops)["valid?"]
+
+
+def test_checker_accepts_lost_messages():
+    # loss is not a linearizability violation (total-queue's concern)
+    sh = synth_history(SynthSpec(n_ops=120, seed=44, lost=2))
+    assert QueueWgl(backend="tpu").check({}, sh.ops)["valid?"]
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_differential_wgl_vs_per_value_on_synth(seed):
+    from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+
+    sh = synth_history(
+        SynthSpec(
+            n_ops=100,
+            seed=300 + seed,
+            duplicated=seed % 2,
+            unexpected=(seed // 2) % 2,
+        )
+    )
+    wgl = QueueWgl(backend="tpu").check({}, sh.ops)
+    per_value = check_queue_lin_cpu(sh.ops)
+    # P-compositionality: the decomposed check and the full search agree
+    assert wgl["valid?"] == per_value["valid?"]
+
+
+def test_queue_wgl_ops_mapping():
+    ops = reindex(
+        [
+            Op.invoke(OpF.ENQUEUE, 0, 3, time=0),
+            Op(OpType.INFO, OpF.ENQUEUE, 0, 3, time=1),
+            Op.invoke(OpF.ENQUEUE, 1, 4, time=2),
+            Op(OpType.FAIL, OpF.ENQUEUE, 1, 4, time=3),
+            Op.invoke(OpF.DRAIN, 2, time=4),
+            Op(OpType.OK, OpF.DRAIN, 2, [3], time=5),
+        ]
+    )
+    w = queue_wgl_ops(ops)
+    # failed enqueue dropped; info enqueue open forever; drain value = DEQ
+    assert len(w) == 2
+    assert w[0].call == Call(ENQ, 3) and w[0].ret == INF
+    assert w[1].call == Call(DEQ, 3) and w[1].ret == 5

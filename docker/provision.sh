@@ -1,0 +1,11 @@
+#!/bin/sh
+# Bring the topology up and wait for provisioning (reference twin:
+# docker/provision.sh).
+set -eu
+cd "$(dirname "$0")"
+docker compose up -d
+for c in jepsen-tpu-control jepsen-tpu-n1 jepsen-tpu-n2 jepsen-tpu-n3; do
+    echo "waiting for $c..."
+    docker exec "$c" sh -c 'while [ ! -f /root/.control-provisioned ] && [ ! -f /root/.node-provisioned ]; do sleep 2; done'
+done
+echo "topology ready"

@@ -1,0 +1,28 @@
+#!/bin/sh
+# Provision the control container: framework runtime (CPU JAX), toolchain
+# for the native driver, and an SSH keypair for the control plane
+# (reference twin: docker/shared/init-control.sh — jdk/lein/gnuplot there,
+# python/jax/g++ here).
+set -eu
+
+if [ -f /root/.control-provisioned ]; then exit 0; fi
+
+apt-get update -y
+DEBIAN_FRONTEND=noninteractive apt-get install -y \
+    python3 python3-pip python3-venv g++ make openssh-client wget
+
+python3 -m venv /root/venv
+. /root/venv/bin/activate
+pip install -q jax matplotlib numpy pytest
+
+make -C /root/jepsen-tpu/native
+
+if [ ! -f /root/shared/jepsen-bot ]; then
+    ssh-keygen -t ed25519 -N "" -f /root/shared/jepsen-bot
+fi
+
+touch /root/.control-provisioned
+echo "control provisioned; run tests with:"
+echo "  . /root/venv/bin/activate && cd /root/jepsen-tpu && \\"
+echo "  python -m jepsen_tpu test --db rabbitmq --nodes n1,n2,n3 \\"
+echo "      --ssh-private-key /root/shared/jepsen-bot --time-limit 30"

@@ -1,0 +1,18 @@
+#!/bin/sh
+# Provision a broker node: sshd + the control plane's public key
+# (reference twin: docker/shared/init-node.sh).
+set -eu
+
+if [ -f /root/.node-provisioned ]; then exit 0; fi
+
+apt-get update -y
+DEBIAN_FRONTEND=noninteractive apt-get install -y \
+    openssh-server wget xz-utils iptables procps psmisc
+
+mkdir -p /run/sshd /root/.ssh
+while [ ! -f /root/shared/jepsen-bot.pub ]; do sleep 1; done
+cat /root/shared/jepsen-bot.pub >> /root/.ssh/authorized_keys
+chmod 600 /root/.ssh/authorized_keys
+/usr/sbin/sshd
+
+touch /root/.node-provisioned

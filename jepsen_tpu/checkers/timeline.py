@@ -1,0 +1,116 @@
+"""HTML timeline of per-process operations.
+
+Equivalent of ``jepsen.checker.timeline`` (required by the reference at
+``rabbitmq.clj:17``): one row per logical process, one bar per operation
+spanning invocation → completion, colored by outcome (ok/fail/info/open),
+with hover details.  Self-contained HTML, no external assets.
+"""
+
+from __future__ import annotations
+
+import html
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpType
+
+_COLORS = {
+    OpType.OK: "#81b29a",
+    OpType.FAIL: "#e07a5f",
+    OpType.INFO: "#f2cc8f",
+    None: "#cccccc",  # never completed
+}
+
+_STYLE = """
+body { font-family: monospace; background: #fafaf8; }
+.row { position: relative; height: 22px; border-bottom: 1px solid #eee; }
+.label { position: absolute; left: 0; width: 90px; font-size: 11px;
+         line-height: 22px; }
+.lane { position: absolute; left: 100px; right: 0; top: 0; bottom: 0; }
+.op { position: absolute; height: 16px; top: 3px; border-radius: 3px;
+      min-width: 2px; opacity: 0.9; }
+.op:hover { outline: 2px solid #333; z-index: 10; }
+"""
+
+
+def render_timeline(
+    history: Sequence[Op], out_path: str | Path, title: str = "timeline"
+) -> Path:
+    pairs: list[tuple[Op, Op | None]] = []
+    open_by_process: dict[int, Op] = {}
+    for op in history:
+        if op.type == OpType.INVOKE:
+            open_by_process[op.process] = op
+        else:
+            inv = open_by_process.pop(op.process, None)
+            if inv is not None:
+                pairs.append((inv, op))
+    for inv in open_by_process.values():  # never-completed ops
+        pairs.append((inv, None))
+
+    t_max = max((op.time for op in history if op.time >= 0), default=1)
+    processes = sorted(
+        {inv.process for inv, _ in pairs},
+        key=lambda p: (p == NEMESIS_PROCESS, p),
+    )
+    rows = []
+    for p in processes:
+        bars = []
+        for inv, comp in pairs:
+            if inv.process != p:
+                continue
+            left = 100.0 * max(inv.time, 0) / t_max
+            end_t = comp.time if comp is not None and comp.time >= 0 else t_max
+            width = max(100.0 * (end_t - max(inv.time, 0)) / t_max, 0.15)
+            color = _COLORS[comp.type if comp is not None else None]
+            value = comp.value if comp is not None and comp.value is not None else inv.value
+            tip = html.escape(
+                f"{inv.f.name.lower()} {value if value is not None else ''} "
+                f"[{inv.time / 1e9:.3f}s → "
+                f"{(end_t) / 1e9:.3f}s] "
+                f"{comp.type.name.lower() if comp else 'open'}"
+                + (f" {comp.error}" if comp is not None and comp.error else "")
+            )
+            bars.append(
+                f'<div class="op" title="{tip}" style="left:{left:.3f}%;'
+                f"width:{width:.3f}%;background:{color}\"></div>"
+            )
+        label = "nemesis" if p == NEMESIS_PROCESS else f"proc {p}"
+        rows.append(
+            f'<div class="row"><div class="label">{label}</div>'
+            f'<div class="lane">{"".join(bars)}</div></div>'
+        )
+
+    out = Path(out_path)
+    out.write_text(
+        f"<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{html.escape(title)}</title><style>{_STYLE}</style></head>"
+        f"<body><h3>{html.escape(title)}</h3>"
+        f"<p>{len(pairs)} ops · {t_max / 1e9:.1f}s · hover for details · "
+        f"green ok / red fail / yellow info / grey open</p>"
+        f"{''.join(rows)}</body></html>"
+    )
+    return out
+
+
+class Timeline(Checker):
+    """``checker.timeline/html`` equivalent: writes ``timeline.html``."""
+
+    name = "timeline"
+
+    def __init__(self, out_dir: str | Path | None = None):
+        self.out_dir = out_dir
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        out_dir = self.out_dir or (opts or {}).get("out_dir")
+        result: dict[str, Any] = {VALID: True}
+        if out_dir is not None:
+            p = render_timeline(history, Path(out_dir) / "timeline.html")
+            result["file"] = str(p)
+        return result

@@ -154,15 +154,8 @@ def cmd_test(args) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
     from jepsen_tpu.control.runner import run_test
-    from jepsen_tpu.suite import build_sim_test
+    from jepsen_tpu.suite import build_rabbitmq_test, build_sim_test
 
-    if args.db != "sim":
-        print(
-            "error: only --db sim is wired up so far; the RabbitMQ SSH DB "
-            "arrives with the control plane",
-            file=sys.stderr,
-        )
-        return 2
     opts = {
         "rate": args.rate,
         "time-limit": args.time_limit,
@@ -176,13 +169,26 @@ def cmd_test(args) -> int:
         "quorum-initial-group-size": args.quorum_initial_group_size,
         "dead-letter": args.dead_letter,
     }
-    test, _cluster = build_sim_test(
-        opts=opts,
-        nodes=args.nodes.split(","),
-        concurrency=args.concurrency,
-        checker_backend=args.checker,
-        store_root=args.store,
-    )
+    if args.archive_url:
+        opts["archive-url"] = args.archive_url
+    if args.db == "rabbitmq":
+        test = build_rabbitmq_test(
+            opts=opts,
+            nodes=args.nodes.split(","),
+            concurrency=args.concurrency,
+            checker_backend=args.checker,
+            store_root=args.store,
+            ssh_user=args.ssh_user,
+            ssh_private_key=args.ssh_private_key,
+        )
+    else:
+        test, _cluster = build_sim_test(
+            opts=opts,
+            nodes=args.nodes.split(","),
+            concurrency=args.concurrency,
+            checker_backend=args.checker,
+            store_root=args.store,
+        )
     run = run_test(test)
     print(json.dumps(run.results, indent=1, default=_json_default))
     if run.valid:
@@ -190,6 +196,46 @@ def cmd_test(args) -> int:
         return 0
     print(INVALID_BANNER)
     return 1
+
+
+def cmd_matrix(args) -> int:
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.harness.matrix import CI_MATRIX, MatrixRunner
+    from jepsen_tpu.suite import DEFAULT_OPTS, build_sim_test
+
+    scale = args.time_scale
+
+    def run_fn(opts):
+        scaled = dict(opts)
+        for k in ("time-limit", "time-before-partition", "partition-duration"):
+            scaled[k] = opts[k] * scale
+        scaled["recovery-sleep"] = DEFAULT_OPTS["recovery-sleep"] * scale
+        scaled["rate"] = args.rate
+        test, cluster = build_sim_test(
+            opts=scaled, checker_backend=args.checker, store_root=args.store
+        )
+        run = run_test(test)
+        return run.results, {"jepsen.queue": cluster.queue_length()}
+
+    matrix = CI_MATRIX[: args.limit] if args.limit else CI_MATRIX
+    outcomes = MatrixRunner(run_fn, matrix).run()
+    summary = [
+        {
+            "config": o.config_index + 1,
+            "status": o.status,
+            "attempts": o.attempts,
+            "partition": o.opts.get("network-partition"),
+            "notes": o.notes,
+        }
+        for o in outcomes
+    ]
+    print(json.dumps(summary, indent=1))
+    ok = all(o.status == "valid" for o in outcomes)
+    print(GOOD_BANNER if ok else INVALID_BANNER)
+    return 0 if ok else 1
 
 
 def cmd_synth(args) -> int:
@@ -276,7 +322,29 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--net-ticktime", type=int, default=15)
     t.add_argument("--quorum-initial-group-size", type=int, default=0)
     t.add_argument("--dead-letter", action="store_true")
+    t.add_argument(
+        "--archive-url",
+        default=None,
+        help="RabbitMQ generic-unix archive (--db rabbitmq)",
+    )
+    t.add_argument("--ssh-user", default="root")
+    t.add_argument("--ssh-private-key", default=None)
     t.set_defaults(fn=cmd_test)
+
+    m = sub.add_parser(
+        "matrix", help="run the 14-config CI test matrix (sim cluster)"
+    )
+    m.add_argument("--limit", type=int, default=0, help="first N configs only")
+    m.add_argument(
+        "--time-scale",
+        type=float,
+        default=1.0,
+        help="scale factor on all durations (smoke runs: ~0.01)",
+    )
+    m.add_argument("--rate", type=float, default=50.0)
+    m.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
+    m.add_argument("--store", default="store")
+    m.set_defaults(fn=cmd_matrix)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument("--store", default="store", help="store root dir")

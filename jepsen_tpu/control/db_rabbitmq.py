@@ -1,0 +1,248 @@
+"""RabbitMQ DB lifecycle over SSH.
+
+Equivalent of the reference's ``db`` reify (``rabbitmq.clj:28-141``): per
+node — kill stray Erlang VMs, install a pinned Erlang from the RabbitMQ apt
+repo if absent, install the RabbitMQ generic-unix archive, push the config
+templates (debug logging incl. Raft; ``net_ticktime``/aten failure-detector
+settings), set the shared Erlang cookie — then the boot choreography:
+primary boots first and enables the Khepri feature flag, a barrier
+synchronizes all setup threads, and the remaining nodes boot, stop their
+app, ``join_cluster`` the primary (with a randomized stagger), and start
+the app.  Teardown dumps the Raft member status of the queue, its
+dead-letter twin, and the dlx worker; ``log_files`` returns the broker and
+crash logs for collection into the store.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.control.runner import DB
+from jepsen_tpu.control.ssh import Control, RemoteError, Transport
+
+logger = logging.getLogger("jepsen_tpu.db.rabbitmq")
+
+ERLANG_VERSION = "1:27*"
+SERVER_DIR = "/tmp/rabbitmq-server"
+CTL = f"{SERVER_DIR}/sbin/rabbitmqctl"
+COOKIE = "jepsen-rabbitmq"
+
+# config templates (semantics of rabbitmq/resources/rabbitmq/*): debug file
+# logging incl. Raft, open loopback users; tunable net_ticktime + aten
+# poll_interval (Raft failure detector) + DLQ confirm timeout
+RABBITMQ_CONF = """\
+loopback_users = none
+log.file.level = debug
+log.ra.level = debug
+log.connection.level = info
+log.channel.level = info
+log.queue.level = info
+log.default.level = info
+"""
+
+ADVANCED_CONFIG = """\
+[
+  {kernel, [{net_ticktime, $NET_TICKTIME}]},
+  {aten, [{poll_interval, 1000}]},
+  {rabbit, [{dead_letter_worker_publisher_confirm_timeout, 15000}]}
+].
+"""
+
+ERLANG_APT_PIN = """\
+Package: erlang*
+Pin: version $ERLANG_VERSION
+Pin-Priority: 1000
+"""
+
+ERLANG_PACKAGES = (
+    "socat xz-utils erlang-base erlang-asn1 erlang-crypto erlang-eldap "
+    "erlang-ftp erlang-inets erlang-mnesia erlang-os-mon erlang-parsetools "
+    "erlang-public-key erlang-runtime-tools erlang-snmp erlang-ssl "
+    "erlang-syntax-tools erlang-tftp erlang-tools erlang-xmerl"
+)
+
+
+class RabbitMQDB(DB):
+    def __init__(
+        self,
+        transport: Transport,
+        nodes: Sequence[str],
+        primary_wait_s: float = 15.0,
+        secondary_wait_s: float = 20.0,
+        join_stagger_max_s: float = 15.0,
+        seed: int | None = None,
+    ):
+        self.transport = transport
+        self.nodes = list(nodes)
+        self.primary_wait_s = primary_wait_s
+        self.secondary_wait_s = secondary_wait_s
+        self.join_stagger_max_s = join_stagger_max_s
+        self.barrier = threading.Barrier(len(self.nodes))
+        self.rng = random.Random(seed)
+
+    def primary(self) -> str:
+        """The boot-order primary (= ``jepsen.core/primary``: first node)."""
+        return self.nodes[0]
+
+    SETUP_BARRIER_TIMEOUT_S = 900.0
+
+    # ------------------------------------------------------------------
+    def setup(self, test: Mapping[str, Any], node: str) -> None:
+        try:
+            self._setup_pre_barrier(test, node)
+        except BaseException:
+            # never leave peer setup threads blocked on the barrier
+            self.barrier.abort()
+            raise
+        self.barrier.wait(self.SETUP_BARRIER_TIMEOUT_S)  # = core/synchronize
+        self._setup_post_barrier(test, node)
+
+    def _setup_pre_barrier(self, test: Mapping[str, Any], node: str) -> None:
+        c = Control(self.transport, node).su()
+        logger.info("[%s] cleaning previous install", node)
+        c.exec(shell="killall -q -9 beam.smp epmd || true")
+        c.exec("rm", "-rf", SERVER_DIR)
+
+        self._ensure_erlang(c)
+
+        archive_url = test.get("archive-url")
+        if not archive_url:
+            raise ValueError("test map needs an archive-url")
+        logger.info("[%s] installing RabbitMQ from %s", node, archive_url)
+        c.install_archive(archive_url, SERVER_DIR)
+
+        c.exec("mkdir", "-p", f"{SERVER_DIR}/etc/rabbitmq")
+        c.write_file(RABBITMQ_CONF, f"{SERVER_DIR}/etc/rabbitmq/rabbitmq.conf")
+        c.write_file(
+            ADVANCED_CONFIG,
+            f"{SERVER_DIR}/etc/rabbitmq/advanced.config",
+            substitutions={"NET_TICKTIME": test.get("net-ticktime", 15)},
+        )
+        c.write_file(COOKIE, "/root/.erlang.cookie")
+        c.exec("chmod", "600", "/root/.erlang.cookie")
+
+        primary = self.primary()
+        if node == primary:
+            logger.info("[%s] booting primary", node)
+            c.exec(shell=f"{SERVER_DIR}/sbin/rabbitmq-server -detached")
+            time.sleep(self.primary_wait_s)
+            logger.info("[%s] enabling khepri_db", node)
+            c.exec(shell=f"{CTL} enable_feature_flag --opt-in khepri_db")
+        else:
+            time.sleep(self.primary_wait_s)
+
+    def _setup_post_barrier(self, test: Mapping[str, Any], node: str) -> None:
+        c = Control(self.transport, node).su()
+        primary = self.primary()
+        if node != primary:
+            logger.info("[%s] booting secondary", node)
+            c.exec(shell=f"{SERVER_DIR}/sbin/rabbitmq-server -detached")
+            time.sleep(self.secondary_wait_s)
+            c.exec(shell=f"{CTL} enable_feature_flag --opt-in khepri_db")
+            c.exec(shell=f"{CTL} stop_app")
+            time.sleep(self.rng.uniform(0, self.join_stagger_max_s))
+            logger.info("[%s] join_cluster rabbit@%s", node, primary)
+            c.exec(shell=f"{CTL} join_cluster rabbit@{primary}")
+            c.exec(shell=f"{CTL} start_app")
+            logger.info("[%s] joined", node)
+
+    def _ensure_erlang(self, c: Control) -> None:
+        """Install pinned Erlang from the RabbitMQ apt repo if absent
+        (``rabbitmq.clj:41-57``)."""
+        probe = (
+            'erl -noshell -eval "\\$2 /= hd(erlang:system_info(otp_release))'
+            ' andalso halt(2)." -run init stop'
+        )
+        try:
+            c.exec(shell=probe)
+            return
+        except RemoteError:
+            logger.info("[%s] Erlang not detected, installing", c.node)
+        c.exec(
+            shell="echo 'deb https://deb1.rabbitmq.com/rabbitmq-erlang/"
+            "debian/bookworm bookworm main' >> "
+            "/etc/apt/sources.list.d/rabbitmq-erlang.list"
+        )
+        c.exec(
+            shell="echo 'deb https://deb2.rabbitmq.com/rabbitmq-erlang/"
+            "debian/bookworm bookworm main' >> "
+            "/etc/apt/sources.list.d/rabbitmq-erlang.list"
+        )
+        sig = c.wget(
+            "https://keys.openpgp.org/vks/v1/by-fingerprint/"
+            "0A9AF2115F4687BD29803A206B73A36E6026DFCA"
+        )
+        c.exec("apt-key", "add", sig)
+        c.exec("mkdir", "-p", "/etc/apt/preferences.d/")
+        c.write_file(
+            ERLANG_APT_PIN,
+            "/etc/apt/preferences.d/erlang",
+            substitutions={"ERLANG_VERSION": ERLANG_VERSION},
+        )
+        c.exec(shell="apt-get update -y", timeout=600)
+        c.exec(
+            shell=f"DEBIAN_FRONTEND=noninteractive apt-get install -y "
+            f"{ERLANG_PACKAGES}",
+            timeout=1200,
+        )
+
+    # ------------------------------------------------------------------
+    def teardown(self, test: Mapping[str, Any], node: str) -> None:
+        c = Control(self.transport, node).su()
+        if not c.exists(CTL):
+            return
+        # Raft member status dumps (rabbitmq.clj:124-135)
+        for name, probe in (
+            (
+                "jepsen.queue",
+                "case whereis('%2F_jepsen.queue') of undefined -> "
+                "no_local_member; _ -> sys:get_status(whereis("
+                "'%2F_jepsen.queue')) end.",
+            ),
+            (
+                "jepsen.queue.dead.letter",
+                "case whereis('%2F_jepsen.queue.dead.letter') of undefined "
+                "-> no_local_member; _ -> sys:get_status(whereis("
+                "'%2F_jepsen.queue.dead.letter')) end.",
+            ),
+            (
+                "rabbit_fifo_dlx_worker",
+                "try supervisor:which_children(rabbit_fifo_dlx_sup) of [] "
+                "-> no_local_dlx_worker; [{undefined, Pid, worker, _}] -> "
+                "sys:get_status(Pid) catch exit:{noproc, _} -> no_dlx_sup "
+                "end.",
+            ),
+        ):
+            try:
+                status = c.exec(shell=f'{CTL} eval "{probe}"', timeout=30)
+                logger.info("[%s] quorum status %s: %s", node, name, status)
+            except RemoteError as e:
+                logger.info("[%s] status dump %s failed: %s", node, name, e)
+        logger.info("[%s] teardown complete", node)
+
+    def log_files(self, test: Mapping[str, Any], node: str) -> list[str]:
+        return [
+            f"{SERVER_DIR}/var/log/rabbitmq/rabbit@{node}.log",
+            f"{SERVER_DIR}/var/log/rabbitmq/log/crash.log",
+        ]
+
+    def collect_log(self, test, node, path, dest) -> bool:
+        return self.transport.get(node, path, dest)
+
+    # CI cross-check helper (ci/jepsen-test.sh:144-155)
+    def queue_lengths(self, node: str) -> dict[str, int]:
+        c = Control(self.transport, node).su()
+        out = c.exec(
+            shell=f"{CTL} list_queues name messages --no-table-headers -q",
+            timeout=30,
+        )
+        lengths: dict[str, int] = {}
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) >= 2 and parts[-1].isdigit():
+                lengths[" ".join(parts[:-1])] = int(parts[-1])
+        return lengths

@@ -44,6 +44,33 @@ class SimNet(Net):
         self.cluster.heal()
 
 
+class IptablesNet(Net):
+    """Real-cluster partitions: per-node iptables DROP rules over SSH (the
+    mechanism behind ``jepsen.nemesis``'s partitioners; the docker topology
+    grants NET_ADMIN exactly for this, ``docker-compose.yml:9-10``)."""
+
+    def __init__(self, transport, nodes):
+        from jepsen_tpu.control.ssh import Control
+
+        self._controls = {
+            n: Control(transport, n).su() for n in nodes
+        }
+
+    def partition(self, grudges: dict[str, set[str]]) -> None:
+        for node, blocked in grudges.items():
+            c = self._controls[node]
+            for peer in blocked:
+                c.exec(
+                    "iptables", "-A", "INPUT", "-s", peer, "-j", "DROP",
+                    "-w",
+                )
+
+    def heal(self) -> None:
+        for c in self._controls.values():
+            c.exec("iptables", "-F", "-w")
+            c.exec("iptables", "-X", "-w", check=False)
+
+
 def complete_grudges(groups: Sequence[Iterable[str]]) -> dict[str, set[str]]:
     """Block every cross-group link (jepsen ``complete-grudge``)."""
     groups = [list(g) for g in groups]

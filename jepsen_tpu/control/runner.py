@@ -48,6 +48,13 @@ class DB:
     def log_files(self, test: Mapping[str, Any], node: str) -> list[str]:
         return []
 
+    def collect_log(
+        self, test: Mapping[str, Any], node: str, path: str, dest: Path
+    ) -> bool:
+        """Stream ``path`` on ``node`` into local ``dest``; False if
+        absent."""
+        return False
+
 
 @dataclass
 class Test:
@@ -274,6 +281,16 @@ def run_test(test: Test, store: Store | None = None) -> TestRun:
     st = store or Store(test.store_root)
     run_dir = st.run_dir(test.name)
     st.save_history(run_dir, history)
+
+    # collect node logs into the store (= jepsen's db/LogFiles scp)
+    for node in test.nodes:
+        for path in test.db.log_files(test_map, node):
+            dest = run_dir / "nodes" / node / Path(path).name
+            dest.parent.mkdir(parents=True, exist_ok=True)
+            try:
+                test.db.collect_log(test_map, node, path, dest)
+            except Exception:  # noqa: BLE001 — log collection best-effort
+                logger.exception("fetching %s from %s failed", path, node)
 
     logger.info("analysis: %d history entries", len(history))
     results = test.checker.check(

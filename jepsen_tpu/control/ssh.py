@@ -1,0 +1,254 @@
+"""SSH control plane: remote execution DSL.
+
+Equivalent of ``jepsen.control`` (+ ``.util``) as the reference's DB and
+nemesis layers use it (``rabbitmq.clj:32-141``): an exec DSL with ``su``
+semantics, plus the helpers ``wget!``, ``install-archive!``, ``exists?``
+and config-file upload with ``$VAR`` substitution (``rabbitmq.clj:48-72``).
+
+Transports:
+
+- :class:`SshTransport` — drives the system ``ssh``/``scp`` binaries (no
+  extra Python deps in the image), BatchMode, host-key checking off, and a
+  persistent ControlMaster socket per node so each command doesn't pay a
+  new handshake.
+- :class:`FakeTransport` — records the command stream and replays scripted
+  outputs; the unit-test double for DB/nemesis choreography (the reference
+  has no equivalent — its control logic is only tested against live
+  clusters).
+"""
+
+from __future__ import annotations
+
+import abc
+import shlex
+import subprocess
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+from string import Template
+from typing import Any, Mapping, Sequence
+
+
+class RemoteError(RuntimeError):
+    def __init__(self, node: str, cmd: str, rc: int, out: str, err: str):
+        super().__init__(
+            f"[{node}] `{cmd}` exited {rc}\nstdout: {out[-500:]}\n"
+            f"stderr: {err[-500:]}"
+        )
+        self.node, self.cmd, self.rc, self.out, self.err = node, cmd, rc, out, err
+
+
+@dataclass
+class RunResult:
+    rc: int
+    out: str
+    err: str
+
+
+class Transport(abc.ABC):
+    @abc.abstractmethod
+    def run(self, node: str, cmd: str, timeout: float | None = None) -> RunResult:
+        """Run a shell command string on ``node``."""
+
+    @abc.abstractmethod
+    def put(self, node: str, content: bytes, remote_path: str) -> None:
+        """Write ``content`` to ``remote_path`` on ``node``."""
+
+    def get(self, node: str, remote_path: str, local_path: str | Path) -> bool:
+        """Stream ``remote_path`` from ``node`` into ``local_path`` (binary-
+        safe).  Returns False if the file is absent/unreadable."""
+        return False
+
+    def close(self) -> None: ...
+
+
+class SshTransport(Transport):
+    def __init__(
+        self,
+        user: str = "root",
+        private_key: str | None = None,
+        port: int = 22,
+        connect_timeout: int = 10,
+        control_persist: bool = True,
+    ):
+        self.user = user
+        self.private_key = private_key
+        self.port = port
+        self.connect_timeout = connect_timeout
+        self.control_persist = control_persist
+
+    def _ssh_args(self, node: str) -> list[str]:
+        args = [
+            "ssh",
+            "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "LogLevel=ERROR",
+            "-o", f"ConnectTimeout={self.connect_timeout}",
+            "-p", str(self.port),
+        ]
+        if self.control_persist:
+            args += [
+                "-o", "ControlMaster=auto",
+                "-o", f"ControlPath=/tmp/jepsen-tpu-ssh-{self.user}-%h",
+                "-o", "ControlPersist=60",
+            ]
+        if self.private_key:
+            args += ["-i", self.private_key]
+        args.append(f"{self.user}@{node}")
+        return args
+
+    def run(self, node, cmd, timeout=None):
+        p = subprocess.run(
+            self._ssh_args(node) + [cmd],
+            capture_output=True,
+            text=True,
+            timeout=timeout or 300,
+        )
+        return RunResult(p.returncode, p.stdout, p.stderr)
+
+    def put(self, node, content, remote_path):
+        p = subprocess.run(
+            self._ssh_args(node)
+            + [f"cat > {shlex.quote(remote_path)}"],
+            input=content,
+            capture_output=True,
+            timeout=60,
+        )
+        if p.returncode != 0:
+            raise RemoteError(
+                node, f"put {remote_path}", p.returncode, "", p.stderr.decode()
+            )
+
+    def get(self, node, remote_path, local_path):
+        # binary-safe streaming straight to disk (broker logs can be large
+        # at debug level and may contain non-UTF-8 bytes)
+        with open(local_path, "wb") as fh:
+            p = subprocess.run(
+                self._ssh_args(node) + [f"cat {shlex.quote(remote_path)}"],
+                stdout=fh,
+                stderr=subprocess.DEVNULL,
+                timeout=300,
+            )
+        if p.returncode != 0:
+            Path(local_path).unlink(missing_ok=True)
+            return False
+        return True
+
+
+@dataclass
+class FakeTransport(Transport):
+    """Scripted transport for choreography tests: ``responses`` maps a
+    substring of the command to its scripted result; everything else
+    succeeds with empty output.  All calls are recorded in ``log``."""
+
+    responses: dict[str, RunResult] = field(default_factory=dict)
+    log: list[tuple[str, str]] = field(default_factory=list)
+    files: dict[tuple[str, str], bytes] = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def run(self, node, cmd, timeout=None):
+        with self.lock:
+            self.log.append((node, cmd))
+        for key, result in self.responses.items():
+            if key in cmd:
+                return result
+        return RunResult(0, "", "")
+
+    def put(self, node, content, remote_path):
+        with self.lock:
+            self.log.append((node, f"PUT {remote_path}"))
+            self.files[(node, remote_path)] = content
+
+    def get(self, node, remote_path, local_path):
+        with self.lock:
+            self.log.append((node, f"GET {remote_path}"))
+            content = self.files.get((node, remote_path))
+        if content is None:
+            return False
+        Path(local_path).write_bytes(content)
+        return True
+
+    def commands(self, node: str | None = None) -> list[str]:
+        with self.lock:
+            return [c for n, c in self.log if node is None or n == node]
+
+
+class Control:
+    """The per-node exec DSL (``c/exec``, ``c/su``, ``wget!`` …)."""
+
+    def __init__(self, transport: Transport, node: str, sudo: bool = False):
+        self.transport = transport
+        self.node = node
+        self.sudo = sudo
+
+    def su(self) -> "Control":
+        return Control(self.transport, self.node, sudo=True)
+
+    def exec(
+        self,
+        *argv: Any,
+        check: bool = True,
+        timeout: float | None = None,
+        shell: str | None = None,
+    ) -> str:
+        """Run a command (args are shell-quoted) or a raw ``shell`` string;
+        returns trimmed stdout, raising :class:`RemoteError` on failure."""
+        cmd = shell if shell is not None else " ".join(
+            shlex.quote(str(a)) for a in argv
+        )
+        if self.sudo:
+            cmd = f"sudo sh -c {shlex.quote(cmd)}"
+        r = self.transport.run(self.node, cmd, timeout=timeout)
+        if check and r.rc != 0:
+            raise RemoteError(self.node, cmd, r.rc, r.out, r.err)
+        return r.out.strip()
+
+    def exists(self, path: str) -> bool:
+        # goes through exec() so su() privileges apply
+        try:
+            self.exec("test", "-e", path)
+            return True
+        except RemoteError:
+            return False
+
+    def wget(self, url: str, dest_dir: str = "/tmp") -> str:
+        """Download ``url`` into ``dest_dir`` unless present; returns the
+        local path (= ``cu/wget!``)."""
+        name = url.rstrip("/").rsplit("/", 1)[-1]
+        dest = f"{dest_dir}/{name}"
+        if not self.exists(dest):
+            self.exec("wget", "-q", "-O", dest, url, timeout=600)
+        return dest
+
+    def install_archive(self, url: str, dest: str) -> None:
+        """Download + unpack a tarball into ``dest`` with the leading path
+        component stripped (= ``cu/install-archive!``)."""
+        archive = self.wget(url)
+        self.exec("rm", "-rf", dest)
+        self.exec("mkdir", "-p", dest)
+        self.exec(
+            "tar", "xf", archive, "-C", dest, "--strip-components=1",
+            timeout=300,
+        )
+
+    def write_file(
+        self,
+        content: str,
+        remote_path: str,
+        substitutions: Mapping[str, Any] | None = None,
+    ) -> None:
+        """Upload a config file, applying ``$VAR`` template substitution
+        (the reference's pattern at ``rabbitmq.clj:48-52,64-72``).  Under
+        ``su()`` the upload lands in /tmp first and is moved with sudo, so
+        root-owned destinations work for non-root SSH users."""
+        if substitutions:
+            content = Template(content).substitute(
+                {k: str(v) for k, v in substitutions.items()}
+            )
+        if self.sudo:
+            staging = f"/tmp/.jepsen-upload-{abs(hash(remote_path))}"
+            self.transport.put(self.node, content.encode(), staging)
+            self.exec("mv", staging, remote_path)
+        else:
+            self.transport.put(self.node, content.encode(), remote_path)

@@ -1,0 +1,7 @@
+"""Test-matrix harness: the CI driver layer."""
+
+from jepsen_tpu.harness.matrix import (  # noqa: F401
+    CI_MATRIX,
+    MatrixRunner,
+    TestOutcome,
+)

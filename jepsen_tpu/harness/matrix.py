@@ -1,0 +1,170 @@
+"""The CI test matrix and its retry/triage semantics.
+
+Equivalent of ``/root/reference/ci/jepsen-test.sh:92-197``: 14 named
+configurations (partition strategy × duration × consumer type × dead-letter
+× quorum group size), each run with ≤3 attempts, and the reference's triage
+rules:
+
+- run valid → done;
+- run invalid with a genuine consistency violation ("Analysis invalid") →
+  the config FAILS, no retry;
+- run crashed / final read never happened ("Set was never read") → retry,
+  up to the attempt cap;
+- plus the out-of-band invariant: after drain, every queue on every node
+  must be empty (``rabbitmqctl list_queues`` cross-check,
+  ``jepsen-test.sh:144-155``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+logger = logging.getLogger("jepsen_tpu.harness")
+
+
+def _cfg(**kw: Any) -> dict[str, Any]:
+    base = {
+        "time-limit": 180.0,
+        "time-before-partition": 20.0,
+        "net-ticktime": 15,
+        "consumer-type": "mixed",
+    }
+    base.update(kw)
+    return base
+
+
+#: the reference's 14-config matrix (ci/jepsen-test.sh:92-107)
+CI_MATRIX: list[dict[str, Any]] = [
+    _cfg(partition="partition-random-halves", duration=30.0),
+    _cfg(partition="partition-halves", duration=30.0),
+    _cfg(partition="partition-majorities-ring", duration=30.0),
+    _cfg(partition="partition-random-node", duration=30.0),
+    _cfg(partition="partition-random-halves", duration=10.0),
+    _cfg(
+        partition="partition-random-halves",
+        duration=10.0,
+        **{"quorum-initial-group-size": 3},
+    ),
+    _cfg(partition="partition-halves", duration=10.0),
+    _cfg(partition="partition-majorities-ring", duration=10.0),
+    _cfg(partition="partition-random-node", duration=10.0),
+    _cfg(
+        partition="partition-random-node",
+        duration=10.0,
+        **{"consumer-type": "asynchronous"},
+    ),
+    _cfg(
+        partition="partition-random-node",
+        duration=10.0,
+        **{"consumer-type": "asynchronous", "quorum-initial-group-size": 3},
+    ),
+    _cfg(
+        partition="partition-random-node",
+        duration=10.0,
+        **{"consumer-type": "polling"},
+    ),
+    _cfg(
+        partition="partition-random-halves",
+        duration=30.0,
+        **{"dead-letter": True},
+    ),
+    _cfg(partition="partition-halves", duration=30.0, **{"dead-letter": True}),
+]
+
+
+def matrix_opts(cfg: Mapping[str, Any]) -> dict[str, Any]:
+    """Translate a matrix row into test opts."""
+    o = dict(cfg)
+    o["network-partition"] = o.pop("partition")
+    o["partition-duration"] = o.pop("duration")
+    return o
+
+
+@dataclass
+class TestOutcome:
+    config_index: int
+    opts: dict[str, Any]
+    status: str  # "valid" | "invalid" | "error"
+    attempts: int
+    results: dict[str, Any] | None = None
+    notes: list[str] = field(default_factory=list)
+
+
+class MatrixRunner:
+    """Runs a matrix of configs through a ``run_fn`` with the reference's
+    retry/triage rules.
+
+    ``run_fn(opts) -> (results_map, queue_lengths)`` where ``results_map``
+    is the composed checker output (or raises on crash) and
+    ``queue_lengths`` maps queue → outstanding messages after drain.
+    """
+
+    def __init__(
+        self,
+        run_fn: Callable[[dict[str, Any]], tuple[dict[str, Any], Mapping[str, int]]],
+        matrix: Sequence[Mapping[str, Any]] = CI_MATRIX,
+        max_attempts: int = 3,
+    ):
+        self.run_fn = run_fn
+        self.matrix = list(matrix)
+        self.max_attempts = max_attempts
+
+    def run(self) -> list[TestOutcome]:
+        outcomes = []
+        for i, cfg in enumerate(self.matrix):
+            outcomes.append(self._run_config(i, matrix_opts(cfg)))
+        return outcomes
+
+    def _run_config(self, index: int, opts: dict[str, Any]) -> TestOutcome:
+        out = TestOutcome(config_index=index, opts=opts, status="error",
+                          attempts=0)
+        for attempt in range(1, self.max_attempts + 1):
+            out.attempts = attempt
+            logger.info(
+                "matrix config %d/%d attempt %d: %s",
+                index + 1, len(self.matrix), attempt, opts,
+            )
+            try:
+                results, queue_lengths = self.run_fn(opts)
+            except Exception as e:  # noqa: BLE001 — crash ⇒ retry
+                out.notes.append(f"attempt {attempt}: crashed: {e}")
+                logger.exception("run crashed; retrying")
+                continue
+            out.results = results
+
+            leftover = {q: n for q, n in queue_lengths.items() if n != 0}
+            if leftover:
+                # queues must drain to zero (ci/jepsen-test.sh:144-155)
+                out.notes.append(f"attempt {attempt}: not drained: {leftover}")
+                out.status = "invalid"
+                return out
+
+            if results.get("valid?"):
+                if self._final_read_missing(results):
+                    # "Set was never read": invalid run, retry
+                    out.notes.append(
+                        f"attempt {attempt}: final read missing; retrying"
+                    )
+                    continue
+                out.status = "valid"
+                return out
+
+            # invalid verdict = genuine violation ("Analysis invalid"):
+            # no retry — this is the signal the whole harness exists for
+            out.status = "invalid"
+            out.notes.append(f"attempt {attempt}: analysis invalid")
+            return out
+        if out.status == "error":
+            out.notes.append("all attempts exhausted")
+        return out
+
+    @staticmethod
+    def _final_read_missing(results: Mapping[str, Any]) -> bool:
+        """A run whose drain never read anything can't attest loss — the
+        reference's "Set was never read" retry case."""
+        q = results.get("queue", {})
+        return (
+            q.get("attempt-count", 0) > 0 and q.get("ok-count", 0) == 0
+        )

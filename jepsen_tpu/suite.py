@@ -47,6 +47,11 @@ from jepsen_tpu.generators.core import (
 )
 from jepsen_tpu.history.ops import Op, OpF, OpType
 
+DEFAULT_ARCHIVE_URL = (
+    "https://github.com/rabbitmq/rabbitmq-server/releases/download/"
+    "v4.2.1/rabbitmq-server-generic-unix-4.2.1.tar.xz"
+)
+
 DEFAULT_OPTS: dict[str, Any] = {
     # the reference's CLI defaults (rabbitmq.clj:288-327)
     "rate": 50.0,  # ops/sec
@@ -60,6 +65,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "net-ticktime": 15,
     "quorum-initial-group-size": 0,
     "dead-letter": False,
+    "archive-url": DEFAULT_ARCHIVE_URL,
 }
 
 
@@ -97,13 +103,19 @@ def queue_generator(opts: Mapping[str, Any]):
     )
 
 
-def queue_checker(backend: str = "tpu", with_perf: bool = True):
+def queue_checker(
+    backend: str = "tpu", with_perf: bool = True, with_timeline: bool = True
+):
+    from jepsen_tpu.checkers.timeline import Timeline
+
     checkers = {
         "queue": TotalQueue(backend=backend),
         "linear": QueueLinearizability(backend=backend),
     }
     if with_perf:
         checkers["perf"] = Perf()
+    if with_timeline:
+        checkers["timeline"] = Timeline()
     return compose(checkers)
 
 
@@ -145,3 +157,46 @@ def build_sim_test(
         opts=o,
     )
     return test, cluster
+
+
+def build_rabbitmq_test(
+    opts: Mapping[str, Any] | None = None,
+    nodes=("n1", "n2", "n3"),
+    concurrency: int = 5,
+    checker_backend: str = "tpu",
+    store_root: str = "store",
+    ssh_user: str = "root",
+    ssh_private_key: str | None = None,
+    transport=None,
+) -> Test:
+    """The reference test against a real RabbitMQ cluster: SSH DB
+    lifecycle, iptables partitions, native C++ AMQP clients."""
+    from jepsen_tpu.client.native import native_driver_factory
+    from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
+    from jepsen_tpu.control.net import IptablesNet
+    from jepsen_tpu.control.ssh import SshTransport
+
+    o = {**DEFAULT_OPTS, **(opts or {})}
+    transport = transport or SshTransport(
+        user=ssh_user, private_key=ssh_private_key
+    )
+    db = RabbitMQDB(transport, nodes)
+    nemesis = PartitionNemesis(
+        o["network-partition"], IptablesNet(transport, nodes), nodes
+    )
+    client = QueueClient(
+        native_driver_factory(list(nodes)),
+        publish_confirm_timeout_s=o["publish-confirm-timeout"],
+    )
+    return Test(
+        name="rabbitmq-simple-partition",
+        nodes=list(nodes),
+        client=client,
+        generator=queue_generator(o),
+        checker=queue_checker(checker_backend),
+        db=db,
+        nemesis=nemesis,
+        concurrency=concurrency,
+        store_root=store_root,
+        opts=o,
+    )

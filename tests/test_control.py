@@ -1,0 +1,238 @@
+"""Control plane: exec DSL, RabbitMQ DB choreography, iptables nemesis.
+
+The reference only exercises this layer against live clusters; here the
+command *choreography* is unit-tested against a scripted transport (boot
+order, join sequence, iptables rules), which is what the reference's CI
+debugging actually depends on.
+"""
+
+import concurrent.futures
+
+import pytest
+
+from jepsen_tpu.control.db_rabbitmq import CTL, RabbitMQDB, SERVER_DIR
+from jepsen_tpu.control.net import IptablesNet, complete_grudges, undirected
+from jepsen_tpu.control.nemesis import PartitionNemesis, STRATEGIES
+from jepsen_tpu.control.ssh import (
+    Control,
+    FakeTransport,
+    RemoteError,
+    RunResult,
+)
+
+NODES = ["n1", "n2", "n3"]
+TEST_MAP = {
+    "archive-url": "https://example.com/rabbitmq-server-generic-unix.tar.xz",
+    "net-ticktime": 15,
+}
+
+
+def test_exec_quotes_and_raises():
+    t = FakeTransport(responses={"false": RunResult(1, "", "boom")})
+    c = Control(t, "n1")
+    c.exec("echo", "hello world")
+    assert t.commands("n1")[-1] == "echo 'hello world'"
+    with pytest.raises(RemoteError):
+        c.exec(shell="false")
+
+
+def test_su_wraps_with_sudo():
+    t = FakeTransport()
+    Control(t, "n1").su().exec("whoami")
+    assert t.commands("n1")[-1] == "sudo sh -c whoami"
+
+
+def test_write_file_substitutes_vars():
+    t = FakeTransport()
+    Control(t, "n1").write_file(
+        "ticktime = $NET_TICKTIME\n", "/etc/x", {"NET_TICKTIME": 15}
+    )
+    assert t.files[("n1", "/etc/x")] == b"ticktime = 15\n"
+
+
+def _setup_all(db, transport):
+    with concurrent.futures.ThreadPoolExecutor(len(NODES)) as pool:
+        list(pool.map(lambda n: db.setup(TEST_MAP, n), NODES))
+
+
+def _uploaded(t: FakeTransport, node: str, final_path: str) -> bytes | None:
+    """Content written to ``final_path`` — directly, or staged through /tmp
+    and ``mv``'d by a sudo write_file."""
+    direct = t.files.get((node, final_path))
+    if direct is not None:
+        return direct
+    import re
+
+    for cmd in t.commands(node):
+        m = re.search(rf"mv (\S+) {re.escape(final_path)}", cmd)
+        if m:
+            return t.files.get((node, m.group(1)))
+    return None
+
+
+@pytest.fixture()
+def db_and_transport():
+    t = FakeTransport(
+        # Erlang probe succeeds → skip apt installation
+        responses={"erl -noshell": RunResult(0, "", "")}
+    )
+    db = RabbitMQDB(
+        t,
+        NODES,
+        primary_wait_s=0.01,
+        secondary_wait_s=0.01,
+        join_stagger_max_s=0.01,
+        seed=7,
+    )
+    return db, t
+
+
+def test_setup_choreography(db_and_transport):
+    db, t = db_and_transport
+    _setup_all(db, t)
+    # every node: cleanup, archive install, configs, cookie
+    for n in NODES:
+        cmds = t.commands(n)
+        assert any("killall" in c for c in cmds)
+        assert any("tar xf" in c and SERVER_DIR in c for c in cmds)
+        assert _uploaded(t, n, f"{SERVER_DIR}/etc/rabbitmq/rabbitmq.conf")
+        advanced = _uploaded(
+            t, n, f"{SERVER_DIR}/etc/rabbitmq/advanced.config"
+        )
+        assert advanced and b"net_ticktime, 15" in advanced
+        assert _uploaded(t, n, "/root/.erlang.cookie") == b"jepsen-rabbitmq"
+    # primary boots + khepri; secondaries join the primary
+    assert any("rabbitmq-server -detached" in c for c in t.commands("n1"))
+    assert any("khepri_db" in c for c in t.commands("n1"))
+    for n in ("n2", "n3"):
+        cmds = t.commands(n)
+        join = [c for c in cmds if "join_cluster" in c]
+        assert join and "rabbit@n1" in join[0]
+        # stop_app before join, start_app after
+        assert cmds.index(
+            next(c for c in cmds if "stop_app" in c)
+        ) < cmds.index(join[0])
+        assert cmds.index(join[0]) < cmds.index(
+            next(c for c in cmds if "start_app" in c)
+        )
+
+
+def test_primary_boots_before_secondaries_join(db_and_transport):
+    db, t = db_and_transport
+    _setup_all(db, t)
+    full_log = t.log
+    primary_boot = next(
+        i
+        for i, (n, c) in enumerate(full_log)
+        if n == "n1" and "rabbitmq-server -detached" in c
+    )
+    first_join = next(
+        i for i, (_n, c) in enumerate(full_log) if "join_cluster" in c
+    )
+    assert primary_boot < first_join
+
+
+def test_teardown_dumps_quorum_status(db_and_transport):
+    db, t = db_and_transport
+    db.teardown(TEST_MAP, "n1")
+    cmds = t.commands("n1")
+    assert any("jepsen.queue" in c and "sys:get_status" in c for c in cmds)
+    assert any("rabbit_fifo_dlx_sup" in c for c in cmds)
+
+
+def test_log_files_and_collect(db_and_transport, tmp_path):
+    db, t = db_and_transport
+    paths = db.log_files(TEST_MAP, "n2")
+    assert any("rabbit@n2.log" in p for p in paths)
+    t.files[("n2", paths[0])] = b"broker log line"
+    dest = tmp_path / "rabbit.log"
+    assert db.collect_log(TEST_MAP, "n2", paths[0], dest)
+    assert dest.read_bytes() == b"broker log line"
+    assert not db.collect_log(TEST_MAP, "n2", "/nope", tmp_path / "x")
+
+
+def test_setup_failure_aborts_barrier(db_and_transport):
+    # a failing node must not leave peers blocked on the setup barrier
+    import threading
+
+    db, t = db_and_transport
+    t.responses["tar xf"] = RunResult(1, "", "download broken")
+    errors = []
+
+    def run_one(n):
+        try:
+            db.setup(TEST_MAP, n)
+        except Exception as e:
+            errors.append(type(e).__name__)
+
+    threads = [
+        threading.Thread(target=run_one, args=(n,), daemon=True)
+        for n in NODES
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert not any(th.is_alive() for th in threads), "setup deadlocked"
+    assert len(errors) == len(NODES)
+
+
+def test_sudo_write_file_stages_through_tmp():
+    t = FakeTransport()
+    Control(t, "n1").su().write_file("cookie", "/root/.erlang.cookie")
+    put = next(c for c in t.commands("n1") if c.startswith("PUT"))
+    assert "/tmp/.jepsen-upload-" in put
+    assert any(
+        "mv" in c and "/root/.erlang.cookie" in c for c in t.commands("n1")
+    )
+
+
+def test_queue_lengths_parse(db_and_transport):
+    db, t = db_and_transport
+    t.responses["list_queues"] = RunResult(
+        0, "jepsen.queue\t0\njepsen.queue.dead.letter\t3\n", ""
+    )
+    assert db.queue_lengths("n1") == {
+        "jepsen.queue": 0,
+        "jepsen.queue.dead.letter": 3,
+    }
+
+
+def test_iptables_partition_and_heal():
+    t = FakeTransport()
+    net = IptablesNet(t, NODES)
+    net.partition(complete_grudges([["n1"], ["n2", "n3"]]))
+    n1 = t.commands("n1")
+    assert any("iptables -A INPUT -s n2 -j DROP" in c for c in n1)
+    assert any("iptables -A INPUT -s n3 -j DROP" in c for c in n1)
+    assert any("iptables -A INPUT -s n1 -j DROP" in c for c in t.commands("n2"))
+    net.heal()
+    assert any("iptables -F" in c for c in t.commands("n1"))
+
+
+@pytest.mark.parametrize("strategy", sorted(STRATEGIES))
+def test_nemesis_drives_iptables(strategy):
+    from jepsen_tpu.history.ops import Op, OpF, OpType
+
+    nodes5 = [f"n{i}" for i in range(1, 6)]
+    t = FakeTransport()
+    nem = PartitionNemesis(strategy, IptablesNet(t, nodes5), nodes5, seed=3)
+    nem.setup({})
+    start = Op.invoke(OpF.START, -1)
+    done = nem.invoke({}, start)
+    assert done.type == OpType.INFO
+    assert any("iptables -A" in c for _n, c in t.log)
+    nem.invoke({}, Op.invoke(OpF.STOP, -1))
+    assert any("iptables -F" in c for _n, c in t.log)
+
+
+def test_grudges_shapes():
+    g = STRATEGIES["partition-random-node"](NODES, __import__("random").Random(1))
+    blocked = undirected(g)
+    # one node isolated from the other two
+    assert len(blocked) == 2
+    g5 = STRATEGIES["partition-majorities-ring"](
+        [f"n{i}" for i in range(1, 6)], __import__("random").Random(1)
+    )
+    # every node cuts exactly the 2 non-adjacent peers
+    assert all(len(b) == 2 for b in g5.values())

@@ -1,0 +1,89 @@
+"""Matrix runner triage semantics + timeline rendering."""
+
+from jepsen_tpu.checkers.timeline import render_timeline
+from jepsen_tpu.harness.matrix import CI_MATRIX, MatrixRunner, matrix_opts
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+def test_matrix_has_reference_shape():
+    assert len(CI_MATRIX) == 14
+    opts = matrix_opts(CI_MATRIX[0])
+    assert opts["network-partition"] == "partition-random-halves"
+    assert opts["partition-duration"] == 30.0
+    assert opts["time-limit"] == 180.0
+    # dead-letter configs present (12th/13th entries)
+    assert sum(1 for c in CI_MATRIX if c.get("dead-letter")) == 2
+    assert sum(
+        1 for c in CI_MATRIX if c.get("quorum-initial-group-size") == 3
+    ) == 2
+
+
+def _results(valid=True, attempts=10, ok=9):
+    return {
+        "valid?": valid,
+        "queue": {"valid?": valid, "attempt-count": attempts, "ok-count": ok},
+    }
+
+
+def test_valid_run_passes_first_attempt():
+    runner = MatrixRunner(
+        lambda opts: (_results(), {"jepsen.queue": 0}), CI_MATRIX[:2]
+    )
+    outcomes = runner.run()
+    assert all(o.status == "valid" and o.attempts == 1 for o in outcomes)
+
+
+def test_analysis_invalid_fails_without_retry():
+    calls = []
+
+    def run_fn(opts):
+        calls.append(1)
+        return _results(valid=False), {"jepsen.queue": 0}
+
+    outcomes = MatrixRunner(run_fn, CI_MATRIX[:1]).run()
+    assert outcomes[0].status == "invalid"
+    assert len(calls) == 1  # genuine violation: no retry
+
+
+def test_crash_retries_then_errors():
+    calls = []
+
+    def run_fn(opts):
+        calls.append(1)
+        raise RuntimeError("ssh broke")
+
+    outcomes = MatrixRunner(run_fn, CI_MATRIX[:1]).run()
+    assert outcomes[0].status == "error"
+    assert len(calls) == 3
+
+
+def test_final_read_missing_retries_then_succeeds():
+    calls = []
+
+    def run_fn(opts):
+        calls.append(1)
+        if len(calls) == 1:
+            return _results(ok=0), {"jepsen.queue": 0}  # set never read
+        return _results(), {"jepsen.queue": 0}
+
+    outcomes = MatrixRunner(run_fn, CI_MATRIX[:1]).run()
+    assert outcomes[0].status == "valid"
+    assert outcomes[0].attempts == 2
+
+
+def test_undrained_queue_fails():
+    outcomes = MatrixRunner(
+        lambda opts: (_results(), {"jepsen.queue": 4}), CI_MATRIX[:1]
+    ).run()
+    assert outcomes[0].status == "invalid"
+    assert "not drained" in outcomes[0].notes[0]
+
+
+def test_timeline_renders(tmp_path):
+    sh = synth_history(SynthSpec(n_ops=80, seed=51))
+    p = render_timeline(sh.ops, tmp_path / "timeline.html")
+    content = p.read_text()
+    assert content.startswith("<!doctype html>")
+    assert 'class="op"' in content
+    assert "proc 0" in content
+    assert content.count('class="row"') >= 5

@@ -132,8 +132,8 @@ class RabbitMQDB(DB):
             time.sleep(self.primary_wait_s)
             logger.info("[%s] enabling khepri_db", node)
             c.exec(shell=f"{CTL} enable_feature_flag --opt-in khepri_db")
-        else:
-            time.sleep(self.primary_wait_s)
+        # secondaries just fall through to the barrier — it already
+        # guarantees they don't boot before the primary is up
 
     def _setup_post_barrier(self, test: Mapping[str, Any], node: str) -> None:
         c = Control(self.transport, node).su()

@@ -90,7 +90,7 @@ class SshTransport(Transport):
         if self.control_persist:
             args += [
                 "-o", "ControlMaster=auto",
-                "-o", f"ControlPath=/tmp/jepsen-tpu-ssh-{self.user}-%h",
+                "-o", f"ControlPath=/tmp/jepsen-tpu-ssh-{self.user}-%h-%p",
                 "-o", "ControlPersist=60",
             ]
         if self.private_key:
@@ -99,22 +99,35 @@ class SshTransport(Transport):
         return args
 
     def run(self, node, cmd, timeout=None):
-        p = subprocess.run(
-            self._ssh_args(node) + [cmd],
-            capture_output=True,
-            text=True,
-            timeout=timeout or 300,
-        )
+        try:
+            p = subprocess.run(
+                self._ssh_args(node) + [cmd],
+                capture_output=True,
+                text=True,
+                timeout=timeout or 300,
+            )
+        except subprocess.TimeoutExpired as e:
+            # callers treat RemoteError as the sole failure envelope; a hung
+            # remote command (e.g. rabbitmqctl across a partition) must not
+            # crash teardown/log-collection with an unexpected exception type
+            raise RemoteError(
+                node, cmd, -1, "", f"timed out after {e.timeout}s"
+            ) from e
         return RunResult(p.returncode, p.stdout, p.stderr)
 
     def put(self, node, content, remote_path):
-        p = subprocess.run(
-            self._ssh_args(node)
-            + [f"cat > {shlex.quote(remote_path)}"],
-            input=content,
-            capture_output=True,
-            timeout=60,
-        )
+        try:
+            p = subprocess.run(
+                self._ssh_args(node)
+                + [f"cat > {shlex.quote(remote_path)}"],
+                input=content,
+                capture_output=True,
+                timeout=60,
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError(
+                node, f"put {remote_path}", -1, "", f"timed out after {e.timeout}s"
+            ) from e
         if p.returncode != 0:
             raise RemoteError(
                 node, f"put {remote_path}", p.returncode, "", p.stderr.decode()
@@ -123,13 +136,17 @@ class SshTransport(Transport):
     def get(self, node, remote_path, local_path):
         # binary-safe streaming straight to disk (broker logs can be large
         # at debug level and may contain non-UTF-8 bytes)
-        with open(local_path, "wb") as fh:
-            p = subprocess.run(
-                self._ssh_args(node) + [f"cat {shlex.quote(remote_path)}"],
-                stdout=fh,
-                stderr=subprocess.DEVNULL,
-                timeout=300,
-            )
+        try:
+            with open(local_path, "wb") as fh:
+                p = subprocess.run(
+                    self._ssh_args(node) + [f"cat {shlex.quote(remote_path)}"],
+                    stdout=fh,
+                    stderr=subprocess.DEVNULL,
+                    timeout=300,
+                )
+        except subprocess.TimeoutExpired:
+            Path(local_path).unlink(missing_ok=True)
+            return False
         if p.returncode != 0:
             Path(local_path).unlink(missing_ok=True)
             return False
@@ -218,7 +235,16 @@ class Control:
         name = url.rstrip("/").rsplit("/", 1)[-1]
         dest = f"{dest_dir}/{name}"
         if not self.exists(dest):
-            self.exec("wget", "-q", "-O", dest, url, timeout=600)
+            # download to a temp name and mv into place on success only —
+            # `wget -O dest` creates dest even on failure, which would
+            # poison the existence-based cache for every retry
+            tmp = f"{dest}.part"
+            try:
+                self.exec("wget", "-q", "-O", tmp, url, timeout=600)
+            except RemoteError:
+                self.exec("rm", "-f", tmp, check=False)
+                raise
+            self.exec("mv", tmp, dest)
         return dest
 
     def install_archive(self, url: str, dest: str) -> None:

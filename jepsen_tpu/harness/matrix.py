@@ -141,13 +141,18 @@ class MatrixRunner:
                 out.status = "invalid"
                 return out
 
+            if self._final_read_missing(results):
+                # "Set was never read": the drain never observed anything,
+                # so the run can't attest loss either way — invalid run,
+                # retry.  Checked before the verdict because such a run
+                # typically *also* reports lost>0/valid?=false, which must
+                # not be triaged as a genuine violation.
+                out.notes.append(
+                    f"attempt {attempt}: final read missing; retrying"
+                )
+                continue
+
             if results.get("valid?"):
-                if self._final_read_missing(results):
-                    # "Set was never read": invalid run, retry
-                    out.notes.append(
-                        f"attempt {attempt}: final read missing; retrying"
-                    )
-                    continue
                 out.status = "valid"
                 return out
 

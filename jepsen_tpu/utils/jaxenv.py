@@ -15,3 +15,53 @@ def ensure_backend() -> str:
         jax.config.update("jax_platforms", "")
         jax.devices()
     return jax.default_backend()
+
+
+def ensure_device_count(n: int) -> list:
+    """Return ≥``n`` JAX devices, forcing the virtual CPU mesh if needed.
+
+    The environment may pin ``JAX_PLATFORMS`` to a single-chip plugin via
+    ``sitecustomize`` *before* any caller's env vars are seen, so an outer
+    ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    can be silently overridden.  As long as the backend has not been
+    initialized yet in this process, flipping ``jax_platforms`` to ``cpu``
+    and appending the host-device-count flag here still works (both are
+    read at first backend init, not at import).
+    """
+    import os
+
+    import jax
+
+    # XLA parses XLA_FLAGS once, at the process's first backend init — so
+    # the host-device-count flag must be in place *before* we probe the
+    # default backend, or a later fall-back to CPU can't see it.  The flag
+    # only affects the host (CPU) platform, so it's harmless when the
+    # default backend turns out to be a real multi-chip slice.
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + flag
+
+    ensure_backend()
+    devs = jax.devices()
+    if len(devs) >= n:
+        # the real backend (e.g. a multi-chip TPU slice) can supply the
+        # mesh — never silently downgrade it to virtual CPU devices
+        return devs[:n]
+
+    # Too few real devices: rebuild on the virtual CPU mesh.
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        import jax.extend.backend
+
+        jax.extend.backend.clear_backends()
+    except Exception:  # pragma: no cover - API drift across jax versions
+        pass
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} JAX devices, have {len(devs)} on backend "
+            f"{jax.default_backend()!r}; run in a fresh process with "
+            f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={n}"
+        )
+    return devs[:n]

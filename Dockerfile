@@ -1,0 +1,42 @@
+# CI image for the TPU-native Jepsen harness (equivalent of the
+# reference's Dockerfile, which ships terraform + awscli + a pinned Erlang
+# for its CI container).  This image only *drives* the cluster — terraform,
+# awscli, ssh, and a python with the framework's host-side deps; Erlang and
+# RabbitMQ live on the provisioned workers, JAX/TPU on the controller.
+
+FROM debian:bookworm
+
+ENV LANG='C.UTF-8'
+ENV TERRAFORM_VERSION='1.15.8'
+
+RUN apt-get clean && \
+    apt-get update && \
+    apt-get -y upgrade && \
+    apt-get install -y -V --no-install-recommends \
+      ca-certificates \
+      apt-transport-https \
+      gnupg \
+      wget \
+      curl \
+      openssh-client \
+      unzip \
+      lsb-release \
+      make \
+      git \
+      python3 \
+      python3-pip \
+      python3-venv
+
+RUN curl "https://awscli.amazonaws.com/awscli-exe-linux-x86_64.zip" -o "awscliv2.zip" && \
+    unzip awscliv2.zip && \
+    ./aws/install && \
+    rm awscliv2.zip && \
+    rm -rf ./aws && \
+    aws --version
+
+RUN wget https://releases.hashicorp.com/terraform/${TERRAFORM_VERSION}/terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
+    unzip terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
+    mv terraform /usr/bin && \
+    chmod u+x /usr/bin/terraform && \
+    rm terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
+    terraform version

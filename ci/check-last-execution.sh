@@ -1,0 +1,41 @@
+#!/bin/bash
+# CI rate limiter: allow at most one run per branch per 24 h, tracked via a
+# GitHub Actions artifact holding the last-execution epoch (same contract
+# as the reference's ci/check-last-execution.sh; SKIP_CHECK=true forces a
+# run).  Emits `allow_execution=<bool>` to $GITHUB_OUTPUT and exports the
+# artifact name via $GITHUB_ENV for the upload step.
+set -uo pipefail
+
+LIMIT_SECONDS=${LIMIT_SECONDS:-86400}
+CURRENT_TIME=$(date '+%s')
+RABBITMQ_BRANCH=$(ci/extract-rabbitmq-branch-from-binary-url.sh "$BINARY_URL")
+LAST_EXECUTION_ARTIFACT="last-execution-jepsen-tpu-rabbitmq-$RABBITMQ_BRANCH"
+
+echo "UTC is $(date --utc --rfc-3339=seconds --date=@"$CURRENT_TIME")"
+
+gh run --repo "${GITHUB_REPOSITORY:-rabbitmq/jepsen-tpu}" download \
+    --name "$LAST_EXECUTION_ARTIFACT" 2>/dev/null
+
+ALLOW_EXECUTION=true
+if [ -e last-execution.txt ]; then
+    LAST_EXECUTION=$(cat last-execution.txt)
+    DIFF=$((CURRENT_TIME - LAST_EXECUTION))
+    echo "Last execution was ${DIFF}s ago (limit ${LIMIT_SECONDS}s)"
+    if [ "$DIFF" -le "$LIMIT_SECONDS" ]; then
+        ALLOW_EXECUTION=false
+    fi
+fi
+
+if [ "${SKIP_CHECK:-false}" = true ]; then
+    echo "SKIP_CHECK set, forcing execution"
+    ALLOW_EXECUTION=true
+fi
+
+if [ "$ALLOW_EXECUTION" = true ]; then
+    echo "$CURRENT_TIME" > last-execution.txt
+fi
+
+echo "Allow execution? $ALLOW_EXECUTION"
+[ -n "${GITHUB_OUTPUT:-}" ] && echo "allow_execution=$ALLOW_EXECUTION" >> "$GITHUB_OUTPUT"
+[ -n "${GITHUB_ENV:-}" ] && echo "LAST_EXECUTION_ARTIFACT=$LAST_EXECUTION_ARTIFACT" >> "$GITHUB_ENV"
+exit 0

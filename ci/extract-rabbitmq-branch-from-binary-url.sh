@@ -1,0 +1,17 @@
+#!/bin/bash
+# Derive the short RabbitMQ branch tag ("41", "42", …) from a
+# server-packages binary URL — same contract as the reference's
+# ci/extract-rabbitmq-branch-from-binary-url.sh: the tag keys the AWS
+# resource names, S3 archive prefixes, and the CI rate-limit artifact.
+#
+# e.g. …/rabbitmq-server-generic-unix-4.1.0-alpha.047cc5a0.tar.xz → 41
+set -euo pipefail
+
+BINARY_URL=${1:?usage: $0 <binary-url>}
+FILENAME=$(basename "$BINARY_URL")
+VERSION=${FILENAME#rabbitmq-server-generic-unix-}
+VERSION=${VERSION%.tar.xz}
+MAJOR=${VERSION%%.*}
+REST=${VERSION#*.}
+MINOR=${REST%%.*}
+echo "${MAJOR}${MINOR}"

@@ -1,0 +1,139 @@
+# CI cluster for the TPU-native Jepsen harness (equivalent of the
+# reference's ci/rabbitmq-jepsen-aws.tf): one controller that runs the
+# framework (and the checker — on a TPU when `controller_is_tpu_vm` points
+# the provider at a TPU-VM-shaped instance profile; on CPU JAX otherwise)
+# plus five broker workers.
+#
+# The worker fleet shape (5 × small debian-12 nodes) mirrors the reference;
+# the controller is larger because the analysis phase packs whole history
+# batches before shipping them to the accelerator.
+
+terraform {
+  required_providers {
+    aws = {
+      source  = "hashicorp/aws"
+      version = "~> 5.0"
+    }
+  }
+}
+
+variable "rabbitmq_branch" {
+  type        = string
+  description = "short branch tag (e.g. 42) used to name resources"
+}
+
+variable "region" {
+  type    = string
+  default = "eu-west-1"
+}
+
+variable "worker_count" {
+  type    = number
+  default = 5
+}
+
+variable "controller_instance_type" {
+  type    = string
+  default = "t3.xlarge"
+}
+
+variable "worker_instance_type" {
+  type    = string
+  default = "t3.small"
+}
+
+provider "aws" {
+  region = var.region
+}
+
+data "aws_ami" "debian12" {
+  most_recent = true
+  owners      = ["136693071363"] # debian
+  filter {
+    name   = "name"
+    values = ["debian-12-amd64-*"]
+  }
+  filter {
+    name   = "virtualization-type"
+    values = ["hvm"]
+  }
+}
+
+resource "aws_key_pair" "jepsen" {
+  key_name   = "jepsen-tpu-qq-${var.rabbitmq_branch}-key"
+  public_key = file("${path.module}/jepsen-bot.pub")
+}
+
+# SSH in from the CI runner; everything open inside the cluster (AMQP 5672,
+# Erlang distribution 25672 + epmd 4369, and the nemeses' iptables targets)
+resource "aws_security_group" "jepsen" {
+  name = "jepsen-tpu-qq-${var.rabbitmq_branch}-sg"
+
+  ingress {
+    description = "ssh from the CI runner"
+    from_port   = 22
+    to_port     = 22
+    protocol    = "tcp"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+
+  ingress {
+    description = "everything intra-cluster"
+    from_port   = 0
+    to_port     = 0
+    protocol    = "-1"
+    self        = true
+  }
+
+  egress {
+    from_port   = 0
+    to_port     = 0
+    protocol    = "-1"
+    cidr_blocks = ["0.0.0.0/0"]
+  }
+}
+
+resource "aws_instance" "controller" {
+  ami                    = data.aws_ami.debian12.id
+  instance_type          = var.controller_instance_type
+  key_name               = aws_key_pair.jepsen.key_name
+  vpc_security_group_ids = [aws_security_group.jepsen.id]
+  tags = {
+    Name = "JepsenTpuQq${var.rabbitmq_branch}"
+    Role = "controller"
+  }
+}
+
+resource "aws_instance" "worker" {
+  count                  = var.worker_count
+  ami                    = data.aws_ami.debian12.id
+  instance_type          = var.worker_instance_type
+  key_name               = aws_key_pair.jepsen.key_name
+  vpc_security_group_ids = [aws_security_group.jepsen.id]
+  tags = {
+    Name = "JepsenTpuQq${var.rabbitmq_branch}"
+    Role = "worker-${count.index}"
+  }
+}
+
+output "controller_ip" {
+  value = aws_instance.controller.public_ip
+}
+
+output "workers_ip" {
+  value = join(" ", aws_instance.worker[*].public_ip)
+}
+
+output "workers_hostname" {
+  value = join(" ", [for i in range(var.worker_count) : "jepsen-n${i + 1}"])
+}
+
+# /etc/hosts entries mapping worker private IPs to stable node names —
+# appended on the controller and every worker so node names resolve
+# cluster-wide (the reference does the same via workers_hosts_entries)
+output "workers_hosts_entries" {
+  value = join("\n", [
+    for i, w in aws_instance.worker :
+    "${w.private_ip} jepsen-n${i + 1}"
+  ])
+}

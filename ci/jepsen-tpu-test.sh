@@ -1,0 +1,99 @@
+#!/bin/bash
+# CI driver (equivalent of the reference's ci/jepsen-test.sh): provision an
+# AWS cluster with terraform, provision the controller, distribute the
+# RabbitMQ binary under test, run the 14-config matrix, archive artifacts,
+# and report a verdict.
+#
+# Where the reference drives 14 `lein run test …` invocations from bash and
+# triages failures by grepping jepsen.log ("Analysis invalid" = genuine
+# violation, "Set was never read" = retry ≤3), this framework keeps all of
+# that logic in `python -m jepsen_tpu matrix` (jepsen_tpu/harness/matrix.py
+# — same matrix, same retry/triage rules, same rabbitmqctl queue-empty
+# cross-check), so the shell layer only provisions and collects.
+set -exo pipefail
+
+: "${BINARY_URL:?BINARY_URL must point at a rabbitmq-server-generic-unix tarball}"
+
+RABBITMQ_BRANCH=$(ci/extract-rabbitmq-branch-from-binary-url.sh "$BINARY_URL")
+ARCHIVE=$(basename "$BINARY_URL")
+JEPSEN_USER=${JEPSEN_USER:-admin}
+S3_BUCKET=${S3_BUCKET:-s3://jepsen-tests-logs}
+SSH="ssh -o StrictHostKeyChecking=no -i jepsen-bot"
+
+# fresh SSH keypair for the cluster
+ssh-keygen -t ed25519 -m pem -f jepsen-bot -C jepsen-bot -N ''
+
+set +x
+mkdir -p ~/.aws
+echo "$AWS_CONFIG" > ~/.aws/config
+echo "$AWS_CREDENTIALS" > ~/.aws/credentials
+set -x
+
+# tear down leftovers from a previous aborted run, then bring up the cluster
+AWS_TAG="JepsenTpuQq$RABBITMQ_BRANCH"
+AWS_KEY_NAME="jepsen-tpu-qq-$RABBITMQ_BRANCH-key"
+set +e
+aws ec2 terminate-instances --no-cli-pager --instance-ids \
+    "$(aws ec2 describe-instances \
+        --query 'Reservations[].Instances[].InstanceId' \
+        --filters "Name=tag:Name,Values=$AWS_TAG" --output text)"
+aws ec2 delete-key-pair --no-cli-pager --key-name "$AWS_KEY_NAME"
+set -e
+
+cp ./ci/jepsen-tpu-aws.tf .
+terraform init
+terraform apply -auto-approve -var="rabbitmq_branch=$RABBITMQ_BRANCH"
+
+# keep state around so the workflow's always() step can destroy the cluster
+mkdir -p terraform-state
+cp -r jepsen-bot jepsen-bot.pub .terraform terraform.tfstate \
+    jepsen-tpu-aws.tf terraform-state/
+
+CONTROLLER_IP=$(terraform output -raw controller_ip)
+WORKERS=( $(terraform output -raw workers_hostname) )
+WORKERS_IP=( $(terraform output -raw workers_ip) )
+WORKERS_HOSTS_ENTRIES=$(terraform output -raw workers_hosts_entries)
+
+# controller: framework + venv + native driver; node names into /etc/hosts
+$SSH "$JEPSEN_USER@$CONTROLLER_IP" 'bash -s' < ci/provision-jepsen-tpu-controller.sh
+$SSH "$JEPSEN_USER@$CONTROLLER_IP" \
+    "echo '$WORKERS_HOSTS_ENTRIES' | sudo tee --append /etc/hosts"
+scp -o StrictHostKeyChecking=no -i jepsen-bot jepsen-bot \
+    "$JEPSEN_USER@$CONTROLLER_IP:~/jepsen-bot"
+
+# binary under test onto the controller, then fan out to every worker
+$SSH "$JEPSEN_USER@$CONTROLLER_IP" "wget -q '$BINARY_URL'"
+for worker in "${WORKERS[@]}"; do
+  $SSH "$JEPSEN_USER@$CONTROLLER_IP" \
+    "scp -o StrictHostKeyChecking=no -i ~/jepsen-bot ~/${ARCHIVE} $JEPSEN_USER@$worker:/tmp/${ARCHIVE}"
+done
+for worker_ip in "${WORKERS_IP[@]}"; do
+  $SSH "$JEPSEN_USER@$worker_ip" "sudo apt-get update -q"
+  $SSH "$JEPSEN_USER@$worker_ip" \
+    "echo '$WORKERS_HOSTS_ENTRIES' | sudo tee --append /etc/hosts"
+done
+
+NODES=$(IFS=, ; echo "${WORKERS[*]}")
+
+# the matrix: retries, triage, and the queue-empty cross-check all happen
+# inside the runner; matrix-summary.json is the machine-readable verdict
+set +e
+$SSH "$JEPSEN_USER@$CONTROLLER_IP" "source ~/.profile ; cd ~/jepsen-tpu ; \
+  python -m jepsen_tpu matrix --db rabbitmq \
+    --nodes '$NODES' \
+    --ssh-user $JEPSEN_USER --ssh-private-key ~/jepsen-bot \
+    --archive-url 'file:///tmp/${ARCHIVE}' \
+    --store store | tee matrix-summary.json"
+matrix_exit=$?
+set -e
+
+# archive the store (histories, results, perf plots, timelines, node logs)
+the_date=$(date '+%Y%m%d-%H%M%S')
+archive_name="qq-jepsen-tpu-$RABBITMQ_BRANCH-$the_date-logs"
+$SSH "$JEPSEN_USER@$CONTROLLER_IP" "cd ~/jepsen-tpu ; \
+  tar -zcf - store matrix-summary.json --transform='s/^/${archive_name}\//'" \
+  > "$archive_name.tar.gz"
+aws s3 cp "$archive_name.tar.gz" "$S3_BUCKET/" --quiet
+
+echo "Download logs: aws s3 cp $S3_BUCKET/$archive_name.tar.gz ."
+exit $matrix_exit

@@ -1,0 +1,34 @@
+#!/bin/bash
+# Provision the CI controller (equivalent of the reference's
+# ci/provision-jepsen-controller.sh, which installs JDK + lein + gnuplot):
+# the TPU framework needs python + jax + a C++ toolchain for the native
+# AMQP driver, and matplotlib instead of gnuplot for the perf artifacts.
+set -euo pipefail
+
+REPO_URL=${REPO_URL:-https://github.com/rabbitmq/jepsen-tpu.git}
+JAX_EXTRA=${JAX_EXTRA:-jax[tpu]}   # set to plain "jax" for a CPU controller
+
+sudo apt-get update
+sudo apt-get install -y --no-install-recommends \
+    python3 python3-venv python3-pip \
+    g++ make git graphviz openssh-client
+
+git clone "$REPO_URL" "$HOME/jepsen-tpu" || (cd "$HOME/jepsen-tpu" && git pull)
+
+python3 -m venv "$HOME/jepsen-tpu-venv"
+# shellcheck disable=SC1091
+source "$HOME/jepsen-tpu-venv/bin/activate"
+pip install --upgrade pip
+pip install "$JAX_EXTRA" numpy matplotlib
+pip install -e "$HOME/jepsen-tpu"
+
+# native AMQP driver (C++): built on the controller, used by every test run
+make -C "$HOME/jepsen-tpu/native"
+
+# the venv activates for subsequent ssh commands via ~/.profile
+grep -q jepsen-tpu-venv "$HOME/.profile" 2>/dev/null || \
+    echo "source \$HOME/jepsen-tpu-venv/bin/activate" >> "$HOME/.profile"
+
+cd "$HOME/jepsen-tpu"
+python -m jepsen_tpu test --help > /dev/null
+echo "controller provisioned"

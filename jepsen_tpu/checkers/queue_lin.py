@@ -189,13 +189,8 @@ def queue_lin_tensor_check(packed: PackedHistories) -> QueueLinTensors:
     )
 
 
-def check_queue_lin_batch(
-    histories: Sequence[Sequence[Op]],
-    length: int | None = None,
-    value_space: int | None = None,
-) -> list[dict[str, Any]]:
-    packed = pack_histories(histories, length=length, value_space=value_space)
-    t = queue_lin_tensor_check(packed)
+def queue_lin_tensors_to_results(t: QueueLinTensors) -> list[dict[str, Any]]:
+    """Device tensors → result maps (one per history)."""
     valid = np.asarray(t.valid)
     masks = {
         "duplicate": np.asarray(t.duplicate),
@@ -213,6 +208,15 @@ def check_queue_lin_batch(
         r["read-value-count"] = int(rvc[b])
         out.append(r)
     return out
+
+
+def check_queue_lin_batch(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    value_space: int | None = None,
+) -> list[dict[str, Any]]:
+    packed = pack_histories(histories, length=length, value_space=value_space)
+    return queue_lin_tensors_to_results(queue_lin_tensor_check(packed))
 
 
 class QueueLinearizability(Checker):

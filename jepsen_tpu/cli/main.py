@@ -203,8 +203,24 @@ def cmd_matrix(args) -> int:
 
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
     from jepsen_tpu.control.runner import run_test
-    from jepsen_tpu.harness.matrix import CI_MATRIX, MatrixRunner
-    from jepsen_tpu.suite import DEFAULT_OPTS, build_sim_test
+    from jepsen_tpu.harness.matrix import (
+        CI_MATRIX,
+        MatrixRunner,
+        matrix_cli_flags,
+    )
+    from jepsen_tpu.suite import (
+        DEFAULT_OPTS,
+        build_rabbitmq_test,
+        build_sim_test,
+    )
+
+    if args.print_configs:
+        # one line of `test` CLI flags per config — the CI shell layer and
+        # any external driver consume the matrix from this single source
+        # of truth instead of duplicating it
+        for line in matrix_cli_flags():
+            print(line)
+        return 0
 
     scale = args.time_scale
 
@@ -214,6 +230,30 @@ def cmd_matrix(args) -> int:
             scaled[k] = opts[k] * scale
         scaled["recovery-sleep"] = DEFAULT_OPTS["recovery-sleep"] * scale
         scaled["rate"] = args.rate
+        if args.db == "rabbitmq":
+            if args.archive_url:
+                scaled["archive-url"] = args.archive_url
+            nodes = args.nodes.split(",")
+            test = build_rabbitmq_test(
+                opts=scaled,
+                nodes=nodes,
+                checker_backend=args.checker,
+                store_root=args.store,
+                ssh_user=args.ssh_user,
+                ssh_private_key=args.ssh_private_key,
+            )
+            run = run_test(test)
+            # out-of-band queue-empty cross-check straight from the brokers
+            # (= the reference's rabbitmqctl loop, ci/jepsen-test.sh:144-155)
+            lengths: dict[str, int] = {}
+            for node in nodes:
+                try:
+                    for q, n in test.db.queue_lengths(node).items():
+                        lengths[f"{q}@{node}"] = n
+                except Exception as e:  # noqa: BLE001 — node may be down
+                    logging.warning("queue-length check failed on %s: %s",
+                                    node, e)
+            return run.results, lengths
         test, cluster = build_sim_test(
             opts=scaled, checker_backend=args.checker, store_root=args.store
         )
@@ -232,10 +272,26 @@ def cmd_matrix(args) -> int:
         }
         for o in outcomes
     ]
+    # stdout is exactly the JSON summary (the CI driver tees it into
+    # matrix-summary.json); the banner goes to stderr
     print(json.dumps(summary, indent=1))
     ok = all(o.status == "valid" for o in outcomes)
-    print(GOOD_BANNER if ok else INVALID_BANNER)
+    print(GOOD_BANNER if ok else INVALID_BANNER, file=sys.stderr)
     return 0 if ok else 1
+
+
+def cmd_serve(args) -> int:
+    from jepsen_tpu.cli.serve import serve_forever
+
+    serve_forever(args.store, host=args.host, port=args.port)
+    return 0
+
+
+def cmd_serve_checker(args) -> int:
+    from jepsen_tpu.service.server import serve_forever
+
+    serve_forever(host=args.host, port=args.port)
+    return 0
 
 
 def cmd_synth(args) -> int:
@@ -332,7 +388,7 @@ def build_parser() -> argparse.ArgumentParser:
     t.set_defaults(fn=cmd_test)
 
     m = sub.add_parser(
-        "matrix", help="run the 14-config CI test matrix (sim cluster)"
+        "matrix", help="run the 14-config CI test matrix (sim or rabbitmq)"
     )
     m.add_argument("--limit", type=int, default=0, help="first N configs only")
     m.add_argument(
@@ -344,7 +400,31 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--rate", type=float, default=50.0)
     m.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
     m.add_argument("--store", default="store")
+    m.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
+    m.add_argument("--nodes", default="n1,n2,n3")
+    m.add_argument("--archive-url", default=None)
+    m.add_argument("--ssh-user", default="root")
+    m.add_argument("--ssh-private-key", default=None)
+    m.add_argument(
+        "--print-configs",
+        action="store_true",
+        help="print each matrix config as `test` CLI flags and exit",
+    )
     m.set_defaults(fn=cmd_matrix)
+
+    w = sub.add_parser("serve", help="browse recorded runs over the web")
+    w.add_argument("--store", default="store")
+    w.add_argument("--host", default="0.0.0.0")
+    w.add_argument("--port", type=int, default=8080)
+    w.set_defaults(fn=cmd_serve)
+
+    sc = sub.add_parser(
+        "serve-checker",
+        help="run the TPU checker sidecar (RPC over packed int32 tensors)",
+    )
+    sc.add_argument("--host", default="0.0.0.0")
+    sc.add_argument("--port", type=int, default=8640)
+    sc.set_defaults(fn=cmd_serve_checker)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument("--store", default="store", help="store root dir")

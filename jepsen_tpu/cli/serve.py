@@ -1,0 +1,189 @@
+"""Results web server: browse the ``store/`` directory.
+
+Equivalent of the web server ``jepsen.cli/serve-cmd`` runs on the
+controller (the reference points at it in ``rabbitmq.clj:330-331``'s
+docstring — "browse results over the web"): an index of recorded runs with
+their verdicts, plus raw access to every run artifact (history, results,
+``jepsen.log``, perf plots, timelines, node logs).
+
+Stdlib-only (``http.server``); read-only; paths are resolved and checked
+against the store root so the server can't be walked out of it.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from urllib.parse import unquote
+
+from jepsen_tpu.history.store import RESULTS_FILE
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>{title}</title>
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; }}
+ td, th {{ padding: .3em .8em; border: 1px solid #ccc; text-align: left; }}
+ .valid {{ color: #0a0; }} .invalid {{ color: #c00; }}
+ .unknown {{ color: #888; }}
+ a {{ text-decoration: none; }}
+</style></head><body><h1>{title}</h1>{body}</body></html>"""
+
+
+def _runs(root: Path) -> list[dict]:
+    """Every run dir under ``root`` (test-name/timestamp layout), newest
+    first, with its verdict when results.json exists."""
+    runs = []
+    if not root.is_dir():
+        return runs
+    for test_dir in sorted(root.iterdir()):
+        if not test_dir.is_dir() or test_dir.is_symlink():
+            continue
+        for run_dir in sorted(test_dir.iterdir()):
+            if not run_dir.is_dir() or run_dir.is_symlink():
+                continue
+            valid: bool | None = None
+            results = run_dir / RESULTS_FILE
+            if results.is_file():
+                try:
+                    valid = bool(json.loads(results.read_text()).get("valid?"))
+                except (json.JSONDecodeError, OSError):
+                    valid = None
+            runs.append(
+                {
+                    "test": test_dir.name,
+                    "run": run_dir.name,
+                    "rel": f"{test_dir.name}/{run_dir.name}",
+                    "valid": valid,
+                }
+            )
+    runs.sort(key=lambda r: r["run"], reverse=True)
+    return runs
+
+
+def _index_page(root: Path) -> str:
+    rows = []
+    for r in _runs(root):
+        cls, verdict = {
+            True: ("valid", "valid"),
+            False: ("invalid", "INVALID"),
+            None: ("unknown", "?"),
+        }[r["valid"]]
+        rows.append(
+            f'<tr><td><a href="/files/{html.escape(r["rel"])}/">'
+            f'{html.escape(r["test"])}</a></td>'
+            f'<td>{html.escape(r["run"])}</td>'
+            f'<td class="{cls}">{verdict}</td></tr>'
+        )
+    body = (
+        "<table><tr><th>test</th><th>run</th><th>verdict</th></tr>"
+        + "".join(rows)
+        + "</table>"
+        if rows
+        else "<p>no runs recorded yet</p>"
+    )
+    return _PAGE.format(title="jepsen-tpu store", body=body)
+
+
+def _listing_page(root: Path, d: Path) -> str:
+    rel = d.relative_to(root)
+    entries = []
+    for p in sorted(d.iterdir()):
+        name = p.name + ("/" if p.is_dir() else "")
+        entries.append(
+            f'<li><a href="/files/{html.escape(str(rel / p.name))}'
+            f'{"/" if p.is_dir() else ""}">{html.escape(name)}</a></li>'
+        )
+    body = f'<p><a href="/">&larr; index</a></p><ul>{"".join(entries)}</ul>'
+    return _PAGE.format(title=f"store/{rel}", body=body)
+
+
+_CONTENT_TYPES = {
+    ".html": "text/html; charset=utf-8",
+    ".json": "application/json",
+    ".jsonl": "text/plain; charset=utf-8",
+    ".log": "text/plain; charset=utf-8",
+    ".txt": "text/plain; charset=utf-8",
+    ".png": "image/png",
+    ".svg": "image/svg+xml",
+}
+
+
+class StoreHandler(BaseHTTPRequestHandler):
+    store_root: Path  # set by make_server
+
+    def log_message(self, *args):  # quiet by default
+        pass
+
+    def _send_html(self, content: str, status: int = 200) -> None:
+        data = content.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "text/html; charset=utf-8")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        root = self.store_root.resolve()
+        path = unquote(self.path.split("?", 1)[0])
+        if path in ("/", "/index.html"):
+            self._send_html(_index_page(root))
+            return
+        if not path.startswith("/files/"):
+            self._send_html(_PAGE.format(title="404", body="not found"), 404)
+            return
+        target = (root / path[len("/files/"):].lstrip("/")).resolve()
+        if (
+            target != root and not str(target).startswith(str(root) + "/")
+        ) or not target.exists():
+            self._send_html(_PAGE.format(title="404", body="not found"), 404)
+            return
+        if target.is_dir():
+            self._send_html(_listing_page(root, target))
+            return
+        ctype = _CONTENT_TYPES.get(
+            target.suffix, "application/octet-stream"
+        )
+        data = target.read_bytes()
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+
+def make_server(
+    store_root: str | Path, host: str = "0.0.0.0", port: int = 8080
+) -> ThreadingHTTPServer:
+    handler = type(
+        "BoundStoreHandler",
+        (StoreHandler,),
+        {"store_root": Path(store_root)},
+    )
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def serve_forever(
+    store_root: str | Path, host: str = "0.0.0.0", port: int = 8080
+) -> None:
+    srv = make_server(store_root, host, port)
+    print(f"serving {store_root} on http://{host}:{srv.server_address[1]}")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()
+
+
+def start_background(
+    store_root: str | Path, host: str = "127.0.0.1", port: int = 0
+) -> tuple[ThreadingHTTPServer, int]:
+    """Start the server on a daemon thread; returns (server, port)."""
+    srv = make_server(store_root, host, port)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, srv.server_address[1]

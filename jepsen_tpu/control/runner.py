@@ -237,6 +237,32 @@ def _nemesis_worker(
 def run_test(test: Test, store: Store | None = None) -> TestRun:
     """The full lifecycle.  Returns the run (history + analysis results)."""
     test_map = test.as_map()
+    st = store or Store(test.store_root)
+    run_dir = st.run_dir(test.name)
+
+    # everything the framework logs during the run lands in
+    # <run_dir>/jepsen.log — the artifact the reference's CI triage greps
+    # for its verdict lines (ci/jepsen-test.sh:157-195)
+    log_handler = logging.FileHandler(run_dir / "jepsen.log")
+    log_handler.setFormatter(
+        logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+    )
+    pkg_logger = logging.getLogger("jepsen_tpu")
+    prev_level = pkg_logger.level
+    pkg_logger.addHandler(log_handler)
+    if pkg_logger.level > logging.INFO or pkg_logger.level == logging.NOTSET:
+        pkg_logger.setLevel(logging.INFO)
+    try:
+        return _run_test_logged(test, test_map, st, run_dir)
+    finally:
+        pkg_logger.removeHandler(log_handler)
+        pkg_logger.setLevel(prev_level)
+        log_handler.close()
+
+
+def _run_test_logged(
+    test: Test, test_map: dict[str, Any], st: Store, run_dir: Path
+) -> TestRun:
     logger.info("setup: %d nodes", len(test.nodes))
     with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
         list(pool.map(lambda n: test.db.setup(test_map, n), test.nodes))
@@ -278,8 +304,6 @@ def run_test(test: Test, store: Store | None = None) -> TestRun:
         list(pool.map(lambda n: test.db.teardown(test_map, n), test.nodes))
 
     history = recorder.history
-    st = store or Store(test.store_root)
-    run_dir = st.run_dir(test.name)
     st.save_history(run_dir, history)
 
     # collect node logs into the store (= jepsen's db/LogFiles scp)
@@ -297,4 +321,9 @@ def run_test(test: Test, store: Store | None = None) -> TestRun:
         test_map, history, {"out_dir": run_dir}
     )
     st.save_results(run_dir, results)
+    if results.get(VALID):
+        logger.info("Everything looks good! (%d ops)", len(history))
+    else:
+        # the verdict line the reference's CI triage greps for
+        logger.info("Analysis invalid! (%d ops)", len(history))
     return TestRun(test=test, history=history, results=results, run_dir=run_dir)

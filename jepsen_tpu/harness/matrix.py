@@ -82,6 +82,29 @@ def matrix_opts(cfg: Mapping[str, Any]) -> dict[str, Any]:
     return o
 
 
+def matrix_cli_flags(
+    matrix: Sequence[Mapping[str, Any]] = CI_MATRIX,
+) -> list[str]:
+    """Each matrix config as one line of ``test`` subcommand flags — the
+    single source of truth the CI shell layer consumes (the reference
+    hardcodes the same 14 lines in ``ci/jepsen-test.sh:92-107``)."""
+    lines = []
+    for cfg in matrix:
+        opts = matrix_opts(cfg)
+        parts = []
+        for key in sorted(opts):
+            val = opts[key]
+            if isinstance(val, bool):
+                if val:
+                    parts.append(f"--{key}")
+            elif isinstance(val, float) and val == int(val):
+                parts.append(f"--{key} {int(val)}")
+            else:
+                parts.append(f"--{key} {val}")
+        lines.append(" ".join(parts))
+    return lines
+
+
 @dataclass
 class TestOutcome:
     config_index: int
@@ -134,13 +157,6 @@ class MatrixRunner:
                 continue
             out.results = results
 
-            leftover = {q: n for q, n in queue_lengths.items() if n != 0}
-            if leftover:
-                # queues must drain to zero (ci/jepsen-test.sh:144-155)
-                out.notes.append(f"attempt {attempt}: not drained: {leftover}")
-                out.status = "invalid"
-                return out
-
             if self._final_read_missing(results):
                 # "Set was never read": the drain never observed anything,
                 # so the run can't attest loss either way — invalid run,
@@ -151,6 +167,15 @@ class MatrixRunner:
                     f"attempt {attempt}: final read missing; retrying"
                 )
                 continue
+
+            leftover = {q: n for q, n in queue_lengths.items() if n != 0}
+            if leftover:
+                # after a completed drain, queues must be empty
+                # (ci/jepsen-test.sh:144-155); checked only when the final
+                # read actually happened — an aborted drain retries above
+                out.notes.append(f"attempt {attempt}: not drained: {leftover}")
+                out.status = "invalid"
+                return out
 
             if results.get("valid?"):
                 out.status = "valid"

@@ -58,10 +58,15 @@ class Store:
             d = self.root / test_name / f"{ts}-{n}"  # share (and overwrite)
             n += 1  # each other's artifacts
         d.mkdir(parents=True)
+        # current/latest are repointed by save_history, not here — a run
+        # that crashes before recording anything must not steal `latest`
+        # from the last run that actually produced a history
+        return d
+
+    def link_run(self, test_name: str, d: Path) -> None:
         self._relink(self.root / test_name / "current", d)
         self._relink(self.root / "current", d)
         self._relink(self.root / "latest", d)
-        return d
 
     @staticmethod
     def _relink(link: Path, target: Path) -> None:
@@ -74,6 +79,7 @@ class Store:
     def save_history(self, run_dir: Path, history: Sequence[Op]) -> Path:
         p = run_dir / HISTORY_FILE
         write_history_jsonl(p, history)
+        self.link_run(run_dir.parent.name, run_dir)
         return p
 
     def save_results(self, run_dir: Path, results: dict[str, Any]) -> Path:

@@ -1,0 +1,62 @@
+"""Multi-host checking: ``jax.distributed`` over DCN.
+
+The reference's distributed story is SSH + AMQP only; its analysis phase is
+single-threaded on one controller (SURVEY.md §2.4).  The TPU build scales
+the analysis plane the JAX way: every host in a pod slice calls
+``init_multihost`` (process 0 is the coordinator), after which
+``jax.devices()`` spans the whole pod and the same ``checker_mesh`` /
+``sharded_check`` programs from ``jepsen_tpu.parallel.mesh`` run
+pod-wide — the ``hist`` axis shards across hosts over DCN (zero
+cross-history communication, so DCN bandwidth doesn't matter) and the
+``seq`` axis stays within a host's ICI domain.
+
+Single-host (or single-process) use needs no initialization at all; these
+helpers are deliberately thin so the mesh-program code has exactly one code
+path for 1 chip, 8 chips, or a pod.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from jepsen_tpu.parallel.mesh import checker_mesh
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialize ``jax.distributed`` for a multi-host checker fleet.
+
+    All-``None`` arguments auto-detect (TPU pod metadata); no-op when
+    already initialized so callers can run the same entrypoint single- and
+    multi-host.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        if "already initialized" not in str(e):
+            raise
+
+
+def global_checker_mesh(seq: int = 1):
+    """A ``(hist, seq)`` mesh over every device in the (possibly
+    multi-host) runtime.  ``seq`` must divide the global device count; the
+    ``seq`` axis is laid out innermost so it maps to intra-host ICI
+    neighbors, keeping the per-history ``psum`` combines off DCN."""
+    devices = jax.devices()
+    if len(devices) % max(seq, 1) != 0:
+        raise ValueError(
+            f"seq={seq} must divide the global device count {len(devices)}"
+        )
+    return checker_mesh(devices, seq=seq)
+
+
+def is_coordinator() -> bool:
+    """True on the process that should write stores / print verdicts."""
+    return jax.process_index() == 0

@@ -1,0 +1,12 @@
+"""Checker sidecar: RPC service carrying packed int32 history tensors.
+
+The reference's analysis phase runs in-process on the controller (SURVEY.md
+§2.4 "checker-plane communication: none").  The TPU build externalizes it:
+the run controller (or a fleet of them — the CI matrix, batched replay)
+ships packed histories to a long-lived checker process that owns the TPU,
+amortizing backend init and compilation across runs (north star,
+BASELINE.json: "Clojure/Python boundary via a sidecar RPC").
+"""
+
+from jepsen_tpu.service.client import CheckerClient  # noqa: F401
+from jepsen_tpu.service.server import CheckerServer  # noqa: F401

@@ -1,0 +1,93 @@
+"""Client side of the checker sidecar: pack host-side, ship tensors."""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Sequence
+
+import numpy as np
+
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.ops import Op
+from jepsen_tpu.service.protocol import recv_frame, send_frame
+
+
+#: result-map keys that are value *sets* locally and travel as sorted lists
+_SET_KEYS = frozenset(
+    {
+        "lost",
+        "unexpected",
+        "duplicated",
+        "recovered",
+        "duplicate",
+        "phantom",
+        "causality",
+    }
+)
+
+
+def _desetted(result: dict[str, Any]) -> dict[str, Any]:
+    """Restore the local checkers' result shape (lists → value sets)."""
+    out: dict[str, Any] = {}
+    for k, v in result.items():
+        if isinstance(v, dict):
+            out[k] = _desetted(v)
+        elif k in _SET_KEYS and isinstance(v, list):
+            out[k] = set(v)
+        else:
+            out[k] = v
+    return out
+
+
+class CheckerClient:
+    """One TCP connection to a checker sidecar; reusable across calls."""
+
+    def __init__(
+        self, host: str = "127.0.0.1", port: int = 8640, timeout: float = 120.0
+    ):
+        self.sock = socket.create_connection((host, port), timeout=timeout)
+
+    def close(self) -> None:
+        self.sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _call(
+        self, header: dict[str, Any], arrays=None
+    ) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
+        send_frame(self.sock, header, arrays)
+        reply, reply_arrays = recv_frame(self.sock)
+        if reply.get("op") == "error":
+            raise RuntimeError(f"sidecar error: {reply.get('error')}")
+        return reply, reply_arrays
+
+    def ping(self) -> dict[str, Any]:
+        reply, _ = self._call({"op": "ping"})
+        return reply
+
+    def check_packed(self, packed: PackedHistories) -> list[dict[str, Any]]:
+        arrays = {
+            "f": np.asarray(packed.f),
+            "type": np.asarray(packed.type),
+            "value": np.asarray(packed.value),
+            "mask": np.asarray(packed.mask),
+        }
+        reply, _ = self._call(
+            {"op": "check", "value_space": packed.value_space}, arrays
+        )
+        return [_desetted(r) for r in reply["results"]]
+
+    def check_histories(
+        self,
+        histories: Sequence[Sequence[Op]],
+        length: int | None = None,
+        value_space: int | None = None,
+    ) -> list[dict[str, Any]]:
+        packed = pack_histories(
+            histories, length=length, value_space=value_space
+        )
+        return self.check_packed(packed)

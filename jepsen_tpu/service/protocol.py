@@ -1,0 +1,91 @@
+"""Length-prefixed binary framing for the checker sidecar.
+
+One frame = magic ``JTQ1`` + uint32 header length + JSON header + raw array
+payload.  The header describes the op and every array (name, dtype, shape,
+in order); the payload is the arrays' bytes concatenated.  Arrays travel as
+little-endian numpy buffers — the packed ``int32`` history columns go over
+the wire exactly as they'll sit in HBM, no per-op serialization.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from typing import Any, Mapping
+
+import numpy as np
+
+MAGIC = b"JTQ1"
+_HDR = struct.Struct(">4sI")  # magic, header-json length
+
+#: hard cap on a single frame's payload (1 GiB) — a corrupt length prefix
+#: must not make the receiver try to allocate arbitrary memory
+MAX_PAYLOAD = 1 << 30
+
+
+class ProtocolError(RuntimeError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ProtocolError(f"connection closed mid-frame ({got}/{n})")
+        got += r
+    return bytes(buf)
+
+
+def send_frame(
+    sock: socket.socket,
+    header: Mapping[str, Any],
+    arrays: Mapping[str, np.ndarray] | None = None,
+) -> None:
+    arrays = arrays or {}
+    specs = []
+    chunks = []
+    for name, arr in arrays.items():
+        a = np.ascontiguousarray(arr)
+        if a.dtype == bool:
+            a = a.astype(np.uint8)
+        a = a.astype(a.dtype.newbyteorder("<"), copy=False)
+        specs.append(
+            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
+        )
+        chunks.append(a.tobytes())
+    hdr = dict(header)
+    hdr["arrays"] = specs
+    hdr_bytes = json.dumps(hdr).encode()
+    sock.sendall(_HDR.pack(MAGIC, len(hdr_bytes)))
+    sock.sendall(hdr_bytes)
+    for c in chunks:
+        sock.sendall(c)
+
+
+def recv_frame(
+    sock: socket.socket,
+) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
+    magic, hdr_len = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if magic != MAGIC:
+        raise ProtocolError(f"bad magic {magic!r}")
+    if hdr_len > MAX_PAYLOAD:
+        raise ProtocolError(f"oversized header ({hdr_len} bytes)")
+    header = json.loads(_recv_exact(sock, hdr_len))
+    arrays: dict[str, np.ndarray] = {}
+    total = 0
+    for spec in header.get("arrays", []):
+        dtype = np.dtype(spec["dtype"])
+        count = int(np.prod(spec["shape"], dtype=np.int64)) if spec["shape"] else 1
+        nbytes = dtype.itemsize * count
+        total += nbytes
+        if total > MAX_PAYLOAD:
+            raise ProtocolError(f"oversized payload (> {MAX_PAYLOAD} bytes)")
+        buf = _recv_exact(sock, nbytes)
+        arrays[spec["name"]] = np.frombuffer(buf, dtype=dtype).reshape(
+            spec["shape"]
+        )
+    return header, arrays

@@ -1,0 +1,164 @@
+"""The checker sidecar server.
+
+A long-lived process owning the JAX backend (one TPU chip, or a mesh via
+``use_mesh``).  Controllers connect over TCP, send packed histories, and
+get reference-shaped verdicts back.  The jitted check program is cached per
+``(B, L, V)`` shape, so a fleet of runs with bucketed shapes pays one
+compile each.
+
+Ops:
+
+- ``ping``  → backend info (devices, platform)
+- ``check`` → arrays ``f``/``type``/``value``/``mask`` of shape ``[B, L]``
+  + ``value_space`` → per-history ``total-queue`` and queue-linearizability
+  verdicts
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import socketserver
+import threading
+from typing import Any
+
+import numpy as np
+
+from jepsen_tpu.service.protocol import (
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
+
+logger = logging.getLogger("jepsen_tpu.service")
+
+REQUIRED_ARRAYS = ("f", "type", "value", "mask")
+
+
+@functools.lru_cache(maxsize=64)
+def _check_program(value_space: int):
+    """Jitted combined check for one scatter width (shapes weakly cached
+    by jit itself)."""
+    import jax
+
+    from jepsen_tpu.checkers.queue_lin import _queue_lin_batch
+    from jepsen_tpu.checkers.total_queue import _total_queue_batch
+
+    @jax.jit
+    def run(f, type_, value, mask):
+        return (
+            _total_queue_batch(f, type_, value, mask, value_space),
+            _queue_lin_batch(f, type_, value, mask, value_space),
+        )
+
+    return run
+
+
+def _check_arrays(
+    arrays: dict[str, np.ndarray], value_space: int
+) -> dict[str, Any]:
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.queue_lin import queue_lin_tensors_to_results
+    from jepsen_tpu.checkers.total_queue import _tensors_to_results
+
+    missing = [k for k in REQUIRED_ARRAYS if k not in arrays]
+    if missing:
+        raise ProtocolError(f"missing arrays: {missing}")
+    f = jnp.asarray(arrays["f"], jnp.int32)
+    type_ = jnp.asarray(arrays["type"], jnp.int32)
+    value = jnp.asarray(arrays["value"], jnp.int32)
+    mask = jnp.asarray(arrays["mask"].astype(bool))
+    tq, ql = _check_program(value_space)(f, type_, value, mask)
+    tq_results = _tensors_to_results(tq)
+    ql_results = queue_lin_tensors_to_results(ql)
+    out = []
+    for q, l in zip(tq_results, ql_results):
+        out.append(
+            {
+                "queue": _jsonable(q),
+                "linear": _jsonable(l),
+                "valid?": bool(q["valid?"] and l["valid?"]),
+            }
+        )
+    return {"op": "result", "results": out}
+
+
+def _jsonable(d: dict[str, Any]) -> dict[str, Any]:
+    """Result maps hold value sets; the wire header is JSON."""
+    return {
+        k: sorted(v) if isinstance(v, (set, frozenset)) else v
+        for k, v in d.items()
+    }
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: CheckerServer = self.server  # type: ignore[assignment]
+        while True:
+            try:
+                header, arrays = recv_frame(self.request)
+            except (ProtocolError, ConnectionError, OSError):
+                return
+            try:
+                reply = server.dispatch(header, arrays)
+                send_frame(self.request, reply)
+            except ProtocolError as e:
+                send_frame(self.request, {"op": "error", "error": str(e)})
+            except Exception as e:  # noqa: BLE001 — report, keep serving
+                logger.exception("check failed")
+                send_frame(self.request, {"op": "error", "error": repr(e)})
+
+
+class CheckerServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 8640):
+        super().__init__((host, port), _Handler)
+        # one device-compute at a time: connections multiplex onto the
+        # accelerator serially, which is also the fastest way to use it
+        self._device_lock = threading.Lock()
+
+    @property
+    def port(self) -> int:
+        return self.server_address[1]
+
+    def dispatch(
+        self, header: dict[str, Any], arrays: dict[str, np.ndarray]
+    ) -> dict[str, Any]:
+        op = header.get("op")
+        if op == "ping":
+            import jax
+
+            return {
+                "op": "pong",
+                "backend": jax.default_backend(),
+                "device_count": jax.device_count(),
+            }
+        if op == "check":
+            value_space = int(header.get("value_space", 0))
+            if value_space <= 0:
+                raise ProtocolError("value_space must be positive")
+            with self._device_lock:
+                return _check_arrays(arrays, value_space)
+        raise ProtocolError(f"unknown op {op!r}")
+
+    def start_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+
+def serve_forever(host: str = "0.0.0.0", port: int = 8640) -> None:
+    from jepsen_tpu.utils.jaxenv import ensure_backend
+
+    backend = ensure_backend()
+    srv = CheckerServer(host, port)
+    print(f"checker sidecar on {host}:{srv.port} (backend={backend})")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()

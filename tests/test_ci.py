@@ -1,0 +1,154 @@
+"""CI layer: branch extractor, rate limiter, matrix↔shell parity."""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+URL = (
+    "https://github.com/rabbitmq/server-packages/releases/download/"
+    "alphas.1731926502914/rabbitmq-server-generic-unix-4.1.0-alpha."
+    "047cc5a0.tar.xz"
+)
+
+
+def sh(script, *args, env=None, cwd=None):
+    return subprocess.run(
+        ["bash", str(script), *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        cwd=cwd or REPO,
+    )
+
+
+class TestBranchExtractor:
+    SCRIPT = REPO / "ci" / "extract-rabbitmq-branch-from-binary-url.sh"
+
+    def test_alpha_url(self):
+        r = sh(self.SCRIPT, URL)
+        assert r.returncode == 0
+        assert r.stdout.strip() == "41"
+
+    def test_release_url(self):
+        r = sh(
+            self.SCRIPT,
+            "https://example.com/rabbitmq-server-generic-unix-4.2.1.tar.xz",
+        )
+        assert r.stdout.strip() == "42"
+
+    def test_missing_arg_fails(self):
+        r = sh(self.SCRIPT)
+        assert r.returncode != 0
+
+
+class TestRateLimiter:
+    SCRIPT = REPO / "ci" / "check-last-execution.sh"
+
+    def _run(self, tmp_path, last_execution=None, skip_check=None):
+        # the script downloads the artifact via `gh`; in tests `gh` is a
+        # stub and the artifact state is pre-seeded in cwd
+        (tmp_path / "ci").mkdir(exist_ok=True)
+        for f in ("extract-rabbitmq-branch-from-binary-url.sh",):
+            (tmp_path / "ci" / f).write_text((REPO / "ci" / f).read_text())
+        gh = tmp_path / "gh"
+        gh.write_text("#!/bin/sh\nexit 1\n")
+        gh.chmod(0o755)
+        if last_execution is not None:
+            (tmp_path / "last-execution.txt").write_text(str(last_execution))
+        out = tmp_path / "out.txt"
+        out.write_text("")
+        env = {
+            "PATH": f"{tmp_path}:/usr/bin:/bin",
+            "BINARY_URL": URL,
+            "GITHUB_OUTPUT": str(out),
+        }
+        if skip_check is not None:
+            env["SKIP_CHECK"] = skip_check
+        r = sh(self.SCRIPT.resolve(), env=env, cwd=tmp_path)
+        assert r.returncode == 0, r.stderr
+        return dict(
+            line.split("=", 1)
+            for line in out.read_text().splitlines()
+            if "=" in line
+        )
+
+    def test_first_run_allowed(self, tmp_path):
+        assert self._run(tmp_path)["allow_execution"] == "true"
+
+    def test_recent_run_blocked(self, tmp_path):
+        import time
+
+        got = self._run(tmp_path, last_execution=int(time.time()) - 60)
+        assert got["allow_execution"] == "false"
+
+    def test_old_run_allowed(self, tmp_path):
+        import time
+
+        got = self._run(tmp_path, last_execution=int(time.time()) - 90000)
+        assert got["allow_execution"] == "true"
+
+    def test_skip_check_forces(self, tmp_path):
+        import time
+
+        got = self._run(
+            tmp_path,
+            last_execution=int(time.time()) - 60,
+            skip_check="true",
+        )
+        assert got["allow_execution"] == "true"
+
+
+class TestMatrixCliParity:
+    def test_fourteen_configs(self):
+        from jepsen_tpu.harness.matrix import CI_MATRIX, matrix_cli_flags
+
+        lines = matrix_cli_flags()
+        assert len(lines) == len(CI_MATRIX) == 14
+
+    def test_flags_parse_back_through_test_subcommand(self):
+        """Every emitted config line must be accepted verbatim by the
+        ``test`` subcommand's parser (the CI shell contract)."""
+        from jepsen_tpu.cli.main import build_parser
+        from jepsen_tpu.harness.matrix import CI_MATRIX, matrix_cli_flags
+
+        parser = build_parser()
+        for cfg, line in zip(CI_MATRIX, matrix_cli_flags()):
+            ns = parser.parse_args(["test", *line.split()])
+            assert ns.network_partition == cfg["partition"]
+            assert ns.partition_duration == cfg["duration"]
+            assert ns.consumer_type == cfg["consumer-type"]
+            assert ns.dead_letter == bool(cfg.get("dead-letter"))
+
+    def test_print_configs_cli(self):
+        r = subprocess.run(
+            ["python", "-m", "jepsen_tpu", "matrix", "--print-configs"],
+            capture_output=True,
+            text=True,
+            cwd=REPO,
+        )
+        assert r.returncode == 0
+        assert len(r.stdout.strip().splitlines()) == 14
+
+    def test_dead_letter_configs_present(self):
+        from jepsen_tpu.harness.matrix import matrix_cli_flags
+
+        assert sum("--dead-letter" in l for l in matrix_cli_flags()) == 2
+
+
+class TestCiDriverShell:
+    def test_driver_is_syntactically_valid(self):
+        r = subprocess.run(
+            ["bash", "-n", str(REPO / "ci" / "jepsen-tpu-test.sh")],
+            capture_output=True,
+        )
+        assert r.returncode == 0, r.stderr
+
+    def test_provision_script_is_syntactically_valid(self):
+        r = subprocess.run(
+            ["bash", "-n", str(REPO / "ci" / "provision-jepsen-tpu-controller.sh")],
+            capture_output=True,
+        )
+        assert r.returncode == 0, r.stderr

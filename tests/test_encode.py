@@ -106,8 +106,13 @@ def test_store_layout_and_symlinks(tmp_path):
     assert (tmp_path / "store" / "latest").resolve() == d.resolve()
     assert (tmp_path / "store" / "current").resolve() == d.resolve()
     assert st.load_history(st.latest())[0].index == 0
+    # a new run dir does NOT repoint latest until a history is recorded —
+    # a run that crashes before recording must not steal the symlinks
     d2 = st.run_dir("rabbitmq-simple-partition", "20260729T000001")
+    assert (tmp_path / "store" / "latest").resolve() == d.resolve()
+    st.save_history(d2, h)
     assert (tmp_path / "store" / "latest").resolve() == d2.resolve()
+    assert (tmp_path / "store" / "current").resolve() == d2.resolve()
 
 
 def test_value_overflow_raises():

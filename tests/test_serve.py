@@ -1,0 +1,96 @@
+"""Results web server: index, artifact access, traversal guard."""
+
+import json
+import urllib.request
+
+import pytest
+
+from jepsen_tpu.cli.serve import start_background
+from jepsen_tpu.history.store import Store
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+
+@pytest.fixture()
+def populated_store(tmp_path):
+    st = Store(tmp_path / "store")
+    sh = synth_history(SynthSpec(n_ops=40))
+    d = st.run_dir("demo-test", "20260729T000000")
+    st.save_history(d, sh.ops)
+    st.save_results(d, {"valid?": True, "queue": {"ok-count": 3}})
+    (d / "jepsen.log").write_text("Everything looks good!\n")
+    bad = st.run_dir("demo-test", "20260729T000100")
+    st.save_history(bad, sh.ops)
+    st.save_results(bad, {"valid?": False})
+    return st
+
+
+@pytest.fixture()
+def server(populated_store):
+    srv, port = start_background(populated_store.root)
+    yield f"http://127.0.0.1:{port}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def get(url):
+    with urllib.request.urlopen(url) as r:
+        return r.status, r.read().decode()
+
+
+def test_index_lists_runs_with_verdicts(server):
+    status, body = get(server + "/")
+    assert status == 200
+    assert "demo-test" in body
+    assert "INVALID" in body  # the bad run
+    assert ">valid<" in body  # the good run
+
+
+def test_run_dir_listing_and_artifacts(server):
+    status, body = get(server + "/files/demo-test/20260729T000000/")
+    assert status == 200
+    assert "history.jsonl" in body and "results.json" in body
+
+    status, body = get(
+        server + "/files/demo-test/20260729T000000/results.json"
+    )
+    assert status == 200
+    assert json.loads(body)["valid?"] is True
+
+    status, body = get(server + "/files/demo-test/20260729T000000/jepsen.log")
+    assert "Everything looks good" in body
+
+
+def test_traversal_guarded(server):
+    req = urllib.request.Request(server + "/files/../../etc/passwd")
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(req)
+    assert exc_info.value.code == 404
+
+
+def test_unknown_path_404(server):
+    with pytest.raises(urllib.error.HTTPError) as exc_info:
+        urllib.request.urlopen(server + "/files/nope/nothing")
+    assert exc_info.value.code == 404
+
+
+def test_run_test_writes_jepsen_log(tmp_path):
+    """run_test captures the framework log (with the verdict banner line
+    the reference CI greps) into <run_dir>/jepsen.log."""
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.suite import build_sim_test
+
+    test, _cluster = build_sim_test(
+        opts={
+            "time-limit": 0.5,
+            "time-before-partition": 0.1,
+            "partition-duration": 0.1,
+            "recovery-sleep": 0.1,
+            "rate": 200.0,
+        },
+        checker_backend="cpu",
+        store_root=str(tmp_path / "store"),
+    )
+    run = run_test(test)
+    log = (run.run_dir / "jepsen.log").read_text()
+    assert "analysis:" in log
+    assert ("Everything looks good!" in log) or ("Analysis invalid!" in log)

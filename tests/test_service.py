@@ -1,0 +1,156 @@
+"""Checker sidecar: framing, round-trip verdicts, differential parity."""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+from jepsen_tpu.history.synth import SynthSpec, synth_batch, synth_history
+from jepsen_tpu.service import CheckerClient, CheckerServer
+from jepsen_tpu.service.protocol import (
+    MAGIC,
+    ProtocolError,
+    recv_frame,
+    send_frame,
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CheckerServer(host="127.0.0.1", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def client(server):
+    with CheckerClient(port=server.port) as c:
+        yield c
+
+
+class TestProtocol:
+    def test_roundtrip_arrays(self):
+        a, b = socket.socketpair()
+        try:
+            arrays = {
+                "x": np.arange(12, dtype=np.int32).reshape(3, 4),
+                "m": np.array([[True, False]]),
+            }
+            send_frame(a, {"op": "check", "k": 1}, arrays)
+            header, got = recv_frame(b)
+            assert header["op"] == "check" and header["k"] == 1
+            np.testing.assert_array_equal(got["x"], arrays["x"])
+            np.testing.assert_array_equal(
+                got["m"].astype(bool), arrays["m"]
+            )
+        finally:
+            a.close()
+            b.close()
+
+    def test_bad_magic_rejected(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"XXXX" + b"\x00" * 4)
+            with pytest.raises(ProtocolError):
+                recv_frame(b)
+        finally:
+            a.close()
+            b.close()
+
+    def test_magic_constant(self):
+        assert MAGIC == b"JTQ1"
+
+
+class TestSidecar:
+    def test_ping(self, client):
+        pong = client.ping()
+        assert pong["op"] == "pong"
+        assert pong["device_count"] >= 1
+
+    def test_clean_histories_valid(self, client):
+        shs = synth_batch(4, SynthSpec(n_ops=120))
+        results = client.check_histories([s.ops for s in shs])
+        assert len(results) == 4
+        assert all(r["valid?"] for r in results)
+
+    def test_verdicts_match_cpu_reference(self, client):
+        """Differential: sidecar verdicts ≡ local single-threaded CPU
+        checkers, including injected anomalies."""
+        specs = [
+            SynthSpec(n_ops=150, seed=3),
+            SynthSpec(n_ops=150, lost=2, seed=4),
+            SynthSpec(n_ops=150, duplicated=2, seed=5),
+            SynthSpec(n_ops=150, unexpected=1, seed=6),
+        ]
+        histories = [synth_history(s).ops for s in specs]
+        remote = client.check_histories(histories)
+        for h, r in zip(histories, remote):
+            cpu_q = check_total_queue_cpu(h)
+            cpu_l = check_queue_lin_cpu(h)
+            assert r["queue"]["valid?"] == cpu_q["valid?"]
+            for k in ("lost", "duplicated", "unexpected", "recovered"):
+                assert r["queue"][k] == cpu_q[k], k
+            assert r["linear"]["duplicate"] == cpu_l["duplicate"]
+            assert r["valid?"] == (cpu_q["valid?"] and cpu_l["valid?"])
+
+    def test_concurrent_clients(self, server):
+        shs = synth_batch(2, SynthSpec(n_ops=60))
+        histories = [s.ops for s in shs]
+        errors = []
+
+        def worker():
+            try:
+                with CheckerClient(port=server.port) as c:
+                    res = c.check_histories(histories)
+                    assert len(res) == 2
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+
+    def test_unknown_op_is_error_not_disconnect(self, client):
+        with pytest.raises(RuntimeError, match="unknown op"):
+            client._call({"op": "nonsense"})
+        # connection still usable
+        assert client.ping()["op"] == "pong"
+
+    def test_bad_value_space_rejected(self, client):
+        with pytest.raises(RuntimeError, match="value_space"):
+            client._call(
+                {"op": "check", "value_space": 0},
+                {
+                    "f": np.zeros((1, 8), np.int32),
+                    "type": np.zeros((1, 8), np.int32),
+                    "value": np.zeros((1, 8), np.int32),
+                    "mask": np.zeros((1, 8), bool),
+                },
+            )
+
+
+class TestDistributedHelpers:
+    def test_global_mesh_all_devices(self, cpu_devices):
+        from jepsen_tpu.parallel.distributed import global_checker_mesh
+
+        mesh = global_checker_mesh(seq=2)
+        assert mesh.shape["hist"] * mesh.shape["seq"] == len(cpu_devices)
+
+    def test_seq_must_divide(self, cpu_devices):
+        from jepsen_tpu.parallel.distributed import global_checker_mesh
+
+        with pytest.raises(ValueError):
+            global_checker_mesh(seq=3)
+
+    def test_is_coordinator_single_process(self):
+        from jepsen_tpu.parallel.distributed import is_coordinator
+
+        assert is_coordinator() is True

@@ -26,3 +26,8 @@ from jepsen_tpu.checkers.wgl import (  # noqa: F401
     check_wgl_cpu,
     wgl_tensor_check,
 )
+from jepsen_tpu.checkers.stream_lin import (  # noqa: F401
+    StreamLinearizability,
+    check_stream_lin_cpu,
+    stream_lin_tensor_check,
+)

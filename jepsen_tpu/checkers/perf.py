@@ -209,6 +209,14 @@ class Perf(Checker):
         history: Sequence[Op],
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
+        # stream workload ops ride the producer/consumer grid slots
+        remap = {OpF.APPEND: OpF.ENQUEUE, OpF.READ: OpF.DEQUEUE}
+        history = [
+            Op(op.type, remap[op.f], op.process, op.value, op.time, op.index, op.error)
+            if op.f in remap
+            else op
+            for op in history
+        ]
         packed = pack_histories([history])
         t = perf_tensor_check(packed)
         result: dict[str, Any] = {

@@ -1,0 +1,488 @@
+"""Linearizability of single-partition stream (append-only log) histories.
+
+BASELINE.json config #4: "RabbitMQ Streams single-partition append/read,
+linearizability, 10k-op histories".  A RabbitMQ stream (``x-queue-type:
+stream``) is an append-only log: producers ``append`` values (publisher
+confirms, like the quorum-queue enqueue — reference ``Utils.java:376-385``),
+consumers attach at an offset and ``read`` ``(offset, value)`` records
+*non-destructively* — any number of consumers can observe the same record,
+unlike the queue workload's destructive dequeue
+(``rabbitmq.clj:145-217``).
+
+A history is linearizable against the single-partition log model iff there
+is one total log order, consistent with real time, that explains every
+observation.  Because appended values are distinct dense ints (same counter
+discipline as the reference workload, ``rabbitmq.clj:245-247``) the check
+decomposes into per-value / per-offset aggregate constraints — a
+scatter/scan program, not an interleaving search:
+
+- **divergent** (offset ``o``): two reads of ``o`` returned different
+  values — readers disagree on the log, no single order exists.
+- **duplicate** (value ``v``): ``v`` observed at two distinct offsets — a
+  confirmed append materialized twice (e.g. an internal retry).
+- **phantom** (value ``v``): ``v`` read though never attempted, or though
+  every append attempt definitely failed (``fail`` = did not happen;
+  ``info`` = may have happened and is NOT a phantom — the indeterminacy
+  rule the queue checkers share).
+- **reorder** (offset ``o``): real-time order violated — the value at some
+  offset ``o' > o`` had its append *completed* (ok) before the append of
+  the value at ``o`` was *invoked*.  With ``s[o]`` = append-invoke position
+  of ``o``'s value and ``e[o]`` = append-completion position, a violation
+  at ``o`` is ``min(e[o'] for o' > o) < s[o]`` — a reversed cumulative min
+  over the offset axis (``lax.associative_scan``), not an O(n²) pair scan.
+  Positions are *history positions* (append order in the recorded
+  history), which is real-time order without timestamp truncation.
+- **nonmonotonic** (op): offsets must strictly increase *within* one read
+  batch (a consumer reads the log forward; a batch that goes backwards or
+  repeats an offset is a broken delivery).  Separate read ops may rewind
+  freely (re-attach at an earlier offset is legal).
+- **lost** (value ``v``): acknowledged append never observed by any read,
+  *when the history contains a full read* (a read from offset 0 after
+  writes stop — the stream analog of the queue drain,
+  ``Utils.java:413-470``).  Without a full read, unread values are simply
+  unread, and loss is not judged.
+
+CPU reference and TPU kernels are differential-tested on synthetic
+histories with injected anomalies (``jepsen_tpu.history.synth``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.encode import LANE, _round_up
+from jepsen_tpu.history.ops import NO_VALUE, Op, OpF, OpType
+from jepsen_tpu.ops.counts import (
+    masked_value_counts,
+    masked_value_reduce_max,
+    masked_value_reduce_min,
+)
+
+_INF = 2**31 - 1
+_NEG = -(2**31)
+
+# A read invocation whose ``value`` is FULL_READ marks a full read (attach
+# at offset 0, read to the end) — the drain analog.  Loss judgment is armed
+# only when such a read *completes ok*: an aborted full read observed
+# nothing, so unread acked appends are merely unread, not lost.
+FULL_READ = "full"
+
+
+def _is_pair(x: Any) -> bool:
+    return (
+        isinstance(x, (list, tuple))
+        and len(x) == 2
+        and all(isinstance(e, int) for e in x)
+    )
+
+
+def read_pairs(op: Op) -> list[tuple[int, int]]:
+    """``(offset, value)`` pairs carried by a read completion."""
+    v = op.value
+    if v is None:
+        return []
+    if _is_pair(v):
+        return [(v[0], v[1])]
+    if isinstance(v, (list, tuple)):
+        return [(p[0], p[1]) for p in v if _is_pair(p)]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# CPU reference
+# ---------------------------------------------------------------------------
+
+
+def check_stream_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
+    app_invokes: dict[int, int] = {}  # v -> invoke count
+    app_acks: dict[int, int] = {}  # v -> ok count
+    app_fails: dict[int, int] = {}  # v -> definite-fail count
+    s_v: dict[int, int] = {}  # v -> earliest append-invoke position
+    e_v: dict[int, int] = {}  # v -> earliest append-ok position
+    read_vals: dict[int, set[int]] = {}  # v -> offsets observed at
+    off_vals: dict[int, set[int]] = {}  # o -> values observed there
+    nonmono = 0
+    full_read = False
+    full_pending: set[int] = set()  # processes with an open full read
+
+    for pos, op in enumerate(history):
+        if op.f == OpF.APPEND and isinstance(op.value, int):
+            v = op.value
+            if op.type == OpType.INVOKE:
+                app_invokes[v] = app_invokes.get(v, 0) + 1
+                s_v[v] = min(s_v.get(v, pos), pos)
+            elif op.type == OpType.OK:
+                app_acks[v] = app_acks.get(v, 0) + 1
+                e_v[v] = min(e_v.get(v, pos), pos)
+            elif op.type == OpType.FAIL:
+                app_fails[v] = app_fails.get(v, 0) + 1
+        elif op.f == OpF.READ:
+            if op.type == OpType.INVOKE:
+                full_pending.discard(op.process)
+                if op.value == FULL_READ:
+                    full_pending.add(op.process)
+            else:
+                if op.type == OpType.OK and op.process in full_pending:
+                    full_read = True
+                full_pending.discard(op.process)
+            if op.type == OpType.OK:
+                pairs = read_pairs(op)
+                prev = None
+                for o, v in pairs:
+                    read_vals.setdefault(v, set()).add(o)
+                    off_vals.setdefault(o, set()).add(v)
+                    if prev is not None and o <= prev:
+                        nonmono += 1
+                    prev = o
+
+    divergent = {o for o, vs in off_vals.items() if len(vs) > 1}
+    duplicate = {v for v, os_ in read_vals.items() if len(os_) > 1}
+    phantom = {
+        v
+        for v in read_vals
+        if app_invokes.get(v, 0) == 0
+        or app_fails.get(v, 0) >= app_invokes.get(v, 0)
+    }
+
+    # real-time order: offsets ascending, exclusive suffix-min of e.  With
+    # divergent values at one offset the kernel combines across them
+    # (max s — the strictest constraint; min e — the earliest completion),
+    # mirrored exactly here so CPU ≡ TPU on every history.
+    offs = sorted(off_vals)
+    reorder: set[int] = set()
+    suff = _INF
+    for o in reversed(offs):
+        ss = [s_v[v] for v in off_vals[o] if v in s_v]
+        s = max(ss) if ss else _NEG
+        if s != _NEG and suff < s:
+            reorder.add(o)
+        e = min((e_v.get(v, _INF) for v in off_vals[o]), default=_INF)
+        suff = min(suff, e)
+
+    lost = (
+        {v for v, k in app_acks.items() if k >= 1 and v not in read_vals}
+        if full_read
+        else set()
+    )
+
+    return {
+        VALID: not (divergent or duplicate or phantom or reorder or nonmono or lost),
+        "attempt-count": sum(app_invokes.values()),
+        "acknowledged-count": sum(app_acks.values()),
+        "read-value-count": len(read_vals),
+        "divergent": divergent,
+        "divergent-count": len(divergent),
+        "duplicate": duplicate,
+        "duplicate-count": len(duplicate),
+        "phantom": phantom,
+        "phantom-count": len(phantom),
+        "reorder": reorder,
+        "reorder-count": len(reorder),
+        "nonmonotonic-count": nonmono,
+        "lost": lost,
+        "lost-count": len(lost),
+        "full-read": full_read,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Packing: stream histories → [B, L] int32 columns
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StreamBatch:
+    """Packed stream histories.  Read completions are exploded into one row
+    per ``(offset, value)`` pair; appends carry ``offset = -1``.  ``pos`` is
+    the history position of the op (shared by a batch's exploded rows);
+    ``first`` marks each op's first row (batch-monotonicity resets there)."""
+
+    type: jax.Array  # [B, L] int32
+    f: jax.Array  # [B, L] int32
+    value: jax.Array  # [B, L] int32
+    offset: jax.Array  # [B, L] int32
+    pos: jax.Array  # [B, L] int32
+    mask: jax.Array  # [B, L] bool
+    first: jax.Array  # [B, L] bool
+    full_read: jax.Array  # [B] bool — history contains a full read
+    space: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def batch(self) -> int:
+        return self.type.shape[0]
+
+    @property
+    def length(self) -> int:
+        return self.type.shape[1]
+
+
+def _stream_rows(history: Sequence[Op]) -> tuple[np.ndarray, bool]:
+    rows: list[tuple[int, int, int, int, int, int]] = []
+    full = False
+    full_pending: set[int] = set()
+    for pos, op in enumerate(history):
+        if op.f == OpF.APPEND:
+            v = op.value if isinstance(op.value, int) else NO_VALUE
+            rows.append((int(op.type), int(op.f), v, -1, pos, 1))
+        elif op.f == OpF.READ:
+            if op.type == OpType.INVOKE:
+                full_pending.discard(op.process)
+                if op.value == FULL_READ:
+                    full_pending.add(op.process)
+                rows.append((int(op.type), int(op.f), NO_VALUE, -1, pos, 1))
+            else:
+                if op.type == OpType.OK and op.process in full_pending:
+                    full = True
+                full_pending.discard(op.process)
+                pairs = read_pairs(op)
+                if not pairs:
+                    rows.append((int(op.type), int(op.f), NO_VALUE, -1, pos, 1))
+                first = 1
+                for o, v in pairs:
+                    rows.append((int(op.type), int(op.f), v, o, pos, first))
+                    first = 0
+    if not rows:
+        rows = [(int(OpType.INVOKE), int(OpF.LOG), NO_VALUE, -1, 0, 1)]
+    return np.asarray(rows, dtype=np.int32).reshape(-1, 6), full
+
+
+def pack_stream_histories(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    space: int | None = None,
+) -> StreamBatch:
+    """``space`` bounds both values and offsets (dense ints; offsets are
+    bounded by the append count, so one width serves both scatter axes)."""
+    if not histories:
+        raise ValueError("cannot pack an empty batch of histories")
+    packed = [_stream_rows(h) for h in histories]
+    n_max = max(m.shape[0] for m, _ in packed)
+    L = length if length is not None else _round_up(n_max, LANE)
+    if n_max > L:
+        raise ValueError(f"history of exploded length {n_max} exceeds L={L}")
+    B = len(packed)
+    cols = np.full((B, L, 6), -1, dtype=np.int32)
+    mask = np.zeros((B, L), dtype=bool)
+    full = np.zeros((B,), dtype=bool)
+    hi = 0
+    for b, (m, f) in enumerate(packed):
+        n = m.shape[0]
+        cols[b, :n] = m
+        mask[b, :n] = True
+        full[b] = f
+        if n:
+            hi = max(hi, int(m[:, 2].max(initial=0)), int(m[:, 3].max(initial=0)))
+    S = space if space is not None else _round_up(hi + 1, LANE)
+    if hi >= S:
+        raise ValueError(
+            f"history contains value/offset {hi} >= space {S}; "
+            "raise space (or omit it to size automatically)"
+        )
+    j = jnp.asarray
+    return StreamBatch(
+        type=j(cols[:, :, 0]),
+        f=j(cols[:, :, 1]),
+        value=j(cols[:, :, 2]),
+        offset=j(cols[:, :, 3]),
+        pos=j(cols[:, :, 4]),
+        mask=j(mask),
+        first=j(cols[:, :, 5] == 1),
+        full_read=j(full),
+        space=S,
+    )
+
+
+# ---------------------------------------------------------------------------
+# TPU kernel
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StreamLinTensors:
+    valid: jax.Array  # [B] bool
+    divergent: jax.Array  # [B, S] bool (by offset)
+    duplicate: jax.Array  # [B, S] bool (by value)
+    phantom: jax.Array  # [B, S] bool (by value)
+    reorder: jax.Array  # [B, S] bool (by offset)
+    nonmonotonic_count: jax.Array  # [B] i32
+    lost: jax.Array  # [B, S] bool (by value)
+    attempt_count: jax.Array  # [B] i32
+    acknowledged_count: jax.Array  # [B] i32
+    read_value_count: jax.Array  # [B] i32
+
+
+def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
+    is_app = (f == int(OpF.APPEND)) & (value >= 0) & mask
+    app_inv = is_app & (type_ == int(OpType.INVOKE))
+    app_ok = is_app & (type_ == int(OpType.OK))
+    app_fail = is_app & (type_ == int(OpType.FAIL))
+    is_read = (
+        (f == int(OpF.READ))
+        & (type_ == int(OpType.OK))
+        & (value >= 0)
+        & (offset >= 0)
+        & mask
+    )
+
+    a = masked_value_counts(value, app_inv, S)
+    k = masked_value_counts(value, app_ok, S)
+    x = masked_value_counts(value, app_fail, S)
+    s_v = masked_value_reduce_min(value, app_inv, pos, S, init=_INF)
+    e_v = masked_value_reduce_min(value, app_ok, pos, S, init=_INF)
+
+    r = masked_value_counts(value, is_read, S)  # read rows per value
+    omin = masked_value_reduce_min(value, is_read, offset, S, init=_INF)
+    omax = masked_value_reduce_max(value, is_read, offset, S, init=-1)
+    vmin = masked_value_reduce_min(offset, is_read, value, S, init=_INF)
+    vmax = masked_value_reduce_max(offset, is_read, value, S, init=-1)
+    observed = masked_value_counts(offset, is_read, S) >= 1  # by offset
+
+    read = r >= 1
+    duplicate = read & (omin != omax)
+    divergent = observed & (vmin != vmax)
+    phantom = read & ((a == 0) | (x >= a))
+
+    # real-time order over the offset axis: gather per-value append
+    # positions through each read row, scatter to the row's offset, then an
+    # exclusive reversed cumulative min finds any later-offset append that
+    # completed before this offset's append was invoked.
+    s_gathered = s_v[jnp.clip(value, 0, S - 1)]
+    # values whose append was never invoked (s == INF) impose no order
+    has_s = is_read & (s_gathered != _INF)
+    s_row = jnp.where(has_s, s_gathered, _NEG)
+    e_row = jnp.where(is_read, e_v[jnp.clip(value, 0, S - 1)], _INF)
+    s_at = masked_value_reduce_max(offset, has_s, s_row, S, init=_NEG)
+    e_at = masked_value_reduce_min(offset, is_read, e_row, S, init=_INF)
+    suff_incl = jax.lax.associative_scan(jnp.minimum, e_at, reverse=True)
+    suff_excl = jnp.concatenate(
+        [suff_incl[1:], jnp.full((1,), _INF, jnp.int32)]
+    )
+    reorder = observed & (s_at != _NEG) & (suff_excl < s_at)
+
+    # within-op monotonicity: consecutive exploded rows of one read batch
+    # must have strictly increasing offsets (``first`` marks batch starts).
+    nxt_read = jnp.roll(is_read, -1).at[-1].set(False)
+    nxt_first = jnp.roll(first, -1).at[-1].set(True)
+    nxt_off = jnp.roll(offset, -1)
+    nonmono = is_read & nxt_read & ~nxt_first & (nxt_off <= offset)
+    nonmono_count = nonmono.sum().astype(jnp.int32)
+
+    lost = jnp.where(full_read, (k >= 1) & ~read, False)
+
+    valid = ~(
+        divergent.any()
+        | duplicate.any()
+        | phantom.any()
+        | reorder.any()
+        | (nonmono_count > 0)
+        | lost.any()
+    )
+    return StreamLinTensors(
+        valid=valid,
+        divergent=divergent,
+        duplicate=duplicate,
+        phantom=phantom,
+        reorder=reorder,
+        nonmonotonic_count=nonmono_count,
+        lost=lost,
+        attempt_count=a.sum().astype(jnp.int32),
+        acknowledged_count=k.sum().astype(jnp.int32),
+        read_value_count=read.sum().astype(jnp.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("space",))
+def _stream_lin_batch(type_, f, value, offset, pos, mask, first, full_read, space):
+    return jax.vmap(
+        lambda t, ff, v, o, p, m, fr, fl: _stream_lin_one(
+            t, ff, v, o, p, m, fr, fl, space
+        )
+    )(type_, f, value, offset, pos, mask, first, full_read)
+
+
+def stream_lin_tensor_check(batch: StreamBatch) -> StreamLinTensors:
+    return _stream_lin_batch(
+        batch.type,
+        batch.f,
+        batch.value,
+        batch.offset,
+        batch.pos,
+        batch.mask,
+        batch.first,
+        batch.full_read,
+        batch.space,
+    )
+
+
+def stream_lin_tensors_to_results(
+    t: StreamLinTensors, full_read: Sequence[bool] | None = None
+) -> list[dict[str, Any]]:
+    valid = np.asarray(t.valid)
+    sets = {
+        "divergent": np.asarray(t.divergent),
+        "duplicate": np.asarray(t.duplicate),
+        "phantom": np.asarray(t.phantom),
+        "reorder": np.asarray(t.reorder),
+        "lost": np.asarray(t.lost),
+    }
+    scalars = {
+        "attempt-count": np.asarray(t.attempt_count),
+        "acknowledged-count": np.asarray(t.acknowledged_count),
+        "read-value-count": np.asarray(t.read_value_count),
+        "nonmonotonic-count": np.asarray(t.nonmonotonic_count),
+    }
+    out = []
+    for b in range(valid.shape[0]):
+        r: dict[str, Any] = {VALID: bool(valid[b])}
+        for k, arr in sets.items():
+            vals = set(np.nonzero(arr[b])[0].tolist())
+            r[k] = vals
+            r[f"{k}-count"] = len(vals)
+        for k, arr in scalars.items():
+            r[k] = int(arr[b])
+        if full_read is not None:
+            r["full-read"] = bool(full_read[b])
+        out.append(r)
+    return out
+
+
+def check_stream_lin_batch(
+    histories: Sequence[Sequence[Op]],
+    length: int | None = None,
+    space: int | None = None,
+) -> list[dict[str, Any]]:
+    batch = pack_stream_histories(histories, length=length, space=space)
+    return stream_lin_tensors_to_results(
+        stream_lin_tensor_check(batch), np.asarray(batch.full_read).tolist()
+    )
+
+
+class StreamLinearizability(Checker):
+    """Single-partition log linearizability (BASELINE config #4)."""
+
+    name = "stream-linearizability"
+
+    def __init__(self, backend: str = "tpu"):
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        if self.backend == "cpu":
+            return check_stream_lin_cpu(history)
+        return check_stream_lin_batch([history])[0]

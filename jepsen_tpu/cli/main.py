@@ -57,8 +57,30 @@ def _resolve_history_path(path: Path) -> Path:
     raise FileNotFoundError(f"no {HISTORY_FILE} under {path}")
 
 
-def _checker_for(args, out_dir=None):
+def _is_stream_history(history) -> bool:
+    from jepsen_tpu.history.ops import OpF
+
+    return any(op.f in (OpF.APPEND, OpF.READ) for op in history)
+
+
+def _checker_for(args, out_dir=None, history=None):
     backend = args.checker
+    workload = getattr(args, "workload", "auto")
+    if workload == "auto":
+        workload = (
+            "stream"
+            if history is not None and _is_stream_history(history)
+            else "queue"
+        )
+    if workload == "stream":
+        from jepsen_tpu.checkers.stream_lin import StreamLinearizability
+
+        return compose(
+            {
+                "perf": Perf(out_dir=out_dir),
+                "stream": StreamLinearizability(backend=backend),
+            }
+        )
     checkers = {
         "perf": Perf(out_dir=out_dir),
         "queue": TotalQueue(backend=backend),
@@ -75,7 +97,7 @@ def cmd_check(args) -> int:
     hpath = _resolve_history_path(Path(args.history)).resolve()
     history = read_history_jsonl(hpath)
     out_dir = hpath.parent
-    checker = _checker_for(args, out_dir=out_dir)
+    checker = _checker_for(args, out_dir=out_dir, history=history)
     t0 = time.perf_counter()
     result = checker.check({}, history)
     dt = time.perf_counter() - t0
@@ -99,6 +121,7 @@ def cmd_bench_check(args) -> int:
     from jepsen_tpu.history.encode import pack_histories
     import jax
 
+    workload = getattr(args, "workload", "auto")
     if args.histories:
         paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}"))
         if not paths:
@@ -106,30 +129,79 @@ def cmd_bench_check(args) -> int:
             return 2
         histories = [read_history_jsonl(p) for p in paths]
         print(f"# loaded {len(histories)} stored histories", file=sys.stderr)
-    else:
-        from jepsen_tpu.history.synth import SynthSpec, synth_batch
-
-        histories = [
-            sh.ops
-            for sh in synth_batch(
-                args.count, SynthSpec(n_ops=args.ops), lost=1
-            )
+        if workload == "auto":
+            # a store may hold both families; bench the majority and say so
+            n_stream = sum(map(_is_stream_history, histories))
+            workload = "stream" if n_stream > len(histories) // 2 else "queue"
+        keep = [
+            h
+            for h in histories
+            if _is_stream_history(h) == (workload == "stream")
         ]
+        if len(keep) != len(histories):
+            print(
+                f"# mixed store: benching {len(keep)} {workload} "
+                f"histories, skipping {len(histories) - len(keep)} of "
+                "the other family",
+                file=sys.stderr,
+            )
+            histories = keep
+        if not histories:
+            print(f"no {workload} histories under {args.histories}", file=sys.stderr)
+            return 2
+    else:
+        if workload == "stream":
+            from jepsen_tpu.history.synth import (
+                StreamSynthSpec,
+                synth_stream_batch,
+            )
+
+            histories = [
+                sh.ops
+                for sh in synth_stream_batch(
+                    args.count, StreamSynthSpec(n_ops=args.ops), lost=1
+                )
+            ]
+        else:
+            workload = "queue"
+            from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+            histories = [
+                sh.ops
+                for sh in synth_batch(
+                    args.count, SynthSpec(n_ops=args.ops), lost=1
+                )
+            ]
         print(f"# generated {len(histories)} synthetic histories", file=sys.stderr)
 
-    t0 = time.perf_counter()
-    packed = pack_histories(histories)
-    t_pack = time.perf_counter() - t0
+    if workload == "stream":
+        from jepsen_tpu.checkers.stream_lin import (
+            pack_stream_histories,
+            stream_lin_tensor_check,
+        )
 
-    jax.block_until_ready(
-        (total_queue_tensor_check(packed), queue_lin_tensor_check(packed))
-    )  # compile
-    t1 = time.perf_counter()
-    tq, ql = total_queue_tensor_check(packed), queue_lin_tensor_check(packed)
-    jax.block_until_ready((tq, ql))
-    t_check = time.perf_counter() - t1
+        t0 = time.perf_counter()
+        packed = pack_stream_histories(histories)
+        t_pack = time.perf_counter() - t0
+        jax.block_until_ready(stream_lin_tensor_check(packed))  # compile
+        t1 = time.perf_counter()
+        sl = stream_lin_tensor_check(packed)
+        jax.block_until_ready(sl)
+        t_check = time.perf_counter() - t1
+        n_invalid = int((~sl.valid).sum())
+    else:
+        t0 = time.perf_counter()
+        packed = pack_histories(histories)
+        t_pack = time.perf_counter() - t0
 
-    n_invalid = int((~(tq.valid & ql.valid)).sum())
+        jax.block_until_ready(
+            (total_queue_tensor_check(packed), queue_lin_tensor_check(packed))
+        )  # compile
+        t1 = time.perf_counter()
+        tq, ql = total_queue_tensor_check(packed), queue_lin_tensor_check(packed)
+        jax.block_until_ready((tq, ql))
+        t_check = time.perf_counter() - t1
+        n_invalid = int((~(tq.valid & ql.valid)).sum())
     print(
         json.dumps(
             {
@@ -295,16 +367,28 @@ def cmd_serve_checker(args) -> int:
 
 
 def cmd_synth(args) -> int:
-    from jepsen_tpu.history.synth import SynthSpec, synth_batch
-
     store = Store(args.store)
-    shs = synth_batch(
-        args.count,
-        SynthSpec(n_ops=args.ops),
-        lost=args.lost,
-        duplicated=args.duplicated,
-        unexpected=args.unexpected,
-    )
+    if getattr(args, "workload", "queue") == "stream":
+        from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
+
+        shs = synth_stream_batch(
+            args.count,
+            StreamSynthSpec(n_ops=args.ops),
+            lost=args.lost,
+            duplicated=args.duplicated,
+            divergent=args.divergent,
+            reorder=args.reorder,
+        )
+    else:
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+        shs = synth_batch(
+            args.count,
+            SynthSpec(n_ops=args.ops),
+            lost=args.lost,
+            duplicated=args.duplicated,
+            unexpected=args.unexpected,
+        )
     for i, sh in enumerate(shs):
         d = store.run_dir("synth", f"{time.strftime('%Y%m%dT%H%M%S')}-{i:04d}")
         store.save_history(d, sh.ops)
@@ -333,6 +417,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="also run the full Wing-Gong linearizability search "
         "(in addition to the per-value decomposition)",
     )
+    c.add_argument(
+        "--workload",
+        choices=("auto", "queue", "stream"),
+        default="auto",
+        help="checker family; auto-detected from the history's op kinds",
+    )
     c.set_defaults(fn=cmd_check)
 
     b = sub.add_parser(
@@ -341,6 +431,9 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--histories", help="dir tree containing history.jsonl files")
     b.add_argument("--count", type=int, default=256, help="synthetic histories")
     b.add_argument("--ops", type=int, default=470, help="invocations per history")
+    b.add_argument(
+        "--workload", choices=("auto", "queue", "stream"), default="auto"
+    )
     b.set_defaults(fn=cmd_bench_check)
 
     t = sub.add_parser(
@@ -428,11 +521,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument("--store", default="store", help="store root dir")
+    s.add_argument("--workload", choices=("queue", "stream"), default="queue")
     s.add_argument("--count", type=int, default=16)
     s.add_argument("--ops", type=int, default=470)
     s.add_argument("--lost", type=int, default=0)
     s.add_argument("--duplicated", type=int, default=0)
-    s.add_argument("--unexpected", type=int, default=0)
+    s.add_argument("--unexpected", type=int, default=0, help="queue workload")
+    s.add_argument("--divergent", type=int, default=0, help="stream workload")
+    s.add_argument("--reorder", type=int, default=0, help="stream workload")
     s.set_defaults(fn=cmd_synth)
 
     return p

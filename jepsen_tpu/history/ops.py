@@ -53,6 +53,12 @@ class OpF(enum.IntEnum):
     START = 3
     STOP = 4
     LOG = 5
+    # stream workload (BASELINE.json config #4: single-partition append/read).
+    # APPEND publishes a value to the log; READ observes (offset, value)
+    # pairs non-destructively.  A READ completion value is one [offset, v]
+    # pair or a list of pairs (a batch / full read from offset 0).
+    APPEND = 6
+    READ = 7
 
     @classmethod
     def from_name(cls, name: str) -> "OpF":
@@ -62,7 +68,7 @@ class OpF(enum.IntEnum):
 _TYPE_BY_NAME = {t.name.lower(): t for t in OpType}
 _F_BY_NAME = {f.name.lower(): f for f in OpF}
 
-CLIENT_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN)
+CLIENT_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN, OpF.APPEND, OpF.READ)
 
 
 @dataclass

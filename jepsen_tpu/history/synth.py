@@ -202,3 +202,212 @@ def synth_batch(
         kw = {**base.__dict__, **overrides, "seed": base.seed + i}
         out.append(synth_history(SynthSpec(**kw)))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Stream (append-only log) histories — BASELINE.json config #4
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StreamSynthSpec:
+    """Single-partition stream workload: producer processes append distinct
+    values (publisher confirms, same indeterminacy model as enqueue);
+    consumer processes read forward in small batches; each consumer ends
+    with a full read from offset 0 (the drain analog)."""
+
+    n_producers: int = 3
+    n_consumers: int = 2
+    n_ops: int = 200  # producer append invocations
+    p_app_info: float = 0.03
+    p_app_fail: float = 0.02
+    read_batch: int = 4  # records per incremental read
+    full_reads: bool = True
+    mean_latency_ns: int = 2_000_000
+    seed: int = 0
+    # anomaly injection counts
+    lost: int = 0  # acked append missing from the log
+    duplicated: int = 0  # value materialized at two offsets
+    divergent: int = 0  # one offset shown with two different values
+    phantom: int = 0  # read of a never-attempted value
+    reorder: int = 0  # log order contradicts real-time append order
+    nonmonotonic: int = 0  # a read batch going backwards
+
+
+@dataclass
+class StreamSynthHistory:
+    ops: list[Op]
+    # ground truth
+    lost: set[int] = field(default_factory=set)  # values
+    duplicated: set[int] = field(default_factory=set)  # values
+    divergent: set[int] = field(default_factory=set)  # offsets
+    phantom: set[int] = field(default_factory=set)  # values
+    reorder: set[int] = field(default_factory=set)  # offsets
+    nonmonotonic: int = 0
+
+    @property
+    def clean(self) -> bool:
+        return not (
+            self.lost
+            or self.duplicated
+            or self.divergent
+            or self.phantom
+            or self.reorder
+            or self.nonmonotonic
+        )
+
+
+def synth_stream_history(spec: StreamSynthSpec) -> StreamSynthHistory:
+    from jepsen_tpu.checkers.stream_lin import FULL_READ
+
+    rng = random.Random(spec.seed)
+    clock = 0
+    ops: list[Op] = []
+    out = StreamSynthHistory(ops=ops)
+
+    def tick() -> int:
+        nonlocal clock
+        clock += rng.randint(100_000, 2_000_000)
+        return clock
+
+    def lat() -> int:
+        return max(1, int(rng.expovariate(1.0 / spec.mean_latency_ns)))
+
+    def emit(op: Op) -> Op:
+        ops.append(op)
+        return op
+
+    # -- phase 1: appends with interleaved incremental reads ----------------
+    log: list[int] = []  # the committed log, log[o] = value
+    acked: list[int] = []
+    cursor = {c: 0 for c in range(spec.n_consumers)}  # next offset per consumer
+    next_value = 0
+    for _ in range(spec.n_ops):
+        p = rng.randrange(spec.n_producers)
+        v = next_value
+        next_value += 1
+        t0 = tick()
+        inv = emit(Op.invoke(OpF.APPEND, p, v, time=t0))
+        roll = rng.random()
+        if roll < spec.p_app_fail:
+            emit(inv.complete(OpType.FAIL, time=t0 + lat(), error="publish-failed"))
+        elif roll < spec.p_app_fail + spec.p_app_info:
+            emit(inv.complete(OpType.INFO, time=t0 + lat(), error="timeout"))
+            if rng.random() < 0.5:
+                log.append(v)
+        else:
+            emit(inv.complete(OpType.OK, time=t0 + lat()))
+            log.append(v)
+            acked.append(v)
+        # occasionally a consumer reads the next batch
+        if spec.n_consumers and rng.random() < 0.3:
+            c = rng.randrange(spec.n_consumers)
+            proc = spec.n_producers + c
+            lo = cursor[c]
+            batch = [
+                [o, log[o]]
+                for o in range(lo, min(lo + spec.read_batch, len(log)))
+            ]
+            t1 = tick()
+            inv = emit(Op.invoke(OpF.READ, proc, lo, time=t1))
+            if batch:
+                cursor[c] = batch[-1][0] + 1
+                emit(inv.complete(OpType.OK, value=batch, time=t1 + lat()))
+            else:
+                emit(
+                    inv.complete(
+                        OpType.FAIL, value=None, time=t1 + lat(), error="empty"
+                    )
+                )
+
+    # -- anomaly injection: mutate the log / the final full reads -----------
+    # Mutations are confined to log offsets no incremental read observed
+    # (``>= hi``), so already-recorded reads stay consistent and ground
+    # truth is exact.  Appends here are sequential in history order, so any
+    # backward move of a value jumps over later-invoked values — a certain
+    # real-time-order (reorder) violation.  Note the couplings the checker
+    # semantics imply: a duplicated value's early append completion also
+    # makes the offsets it jumped over read as reorder; a divergent offset
+    # shows a never-appended value, which also reads as phantom.  Tests
+    # assert the injected anomaly is detected, not that couplings are absent.
+    acked_set = set(acked)
+    hi = max(cursor.values(), default=0)
+    mutable = [v for v in log[hi:] if v in acked_set]
+    rng.shuffle(mutable)
+    for _ in range(spec.lost):
+        if not mutable:
+            break
+        v = mutable.pop()
+        log.remove(v)
+        out.lost.add(v)
+    for _ in range(spec.duplicated):
+        if not mutable:
+            break
+        v = mutable.pop()
+        log.append(v)  # appears at a second offset
+        out.duplicated.add(v)
+    for _ in range(spec.phantom):
+        v = next_value + 1000 + len(out.phantom)
+        log.append(v)
+        out.phantom.add(v)
+    if spec.reorder:
+        # move an unread acked value to the tail: every offset it jumps
+        # over now holds a later-invoked value below it
+        movable = [v for v in log[hi : max(len(log) - 2, hi)] if v in acked_set]
+        for _ in range(spec.reorder):
+            if not movable:
+                break
+            v = movable.pop(0)
+            log.remove(v)
+            log.append(v)
+            out.reorder.add(len(log) - 1)  # informational: the new offset
+
+    # -- phase 2: full reads (drain analog) ---------------------------------
+    # divergence needs a second, disagreeing observation of the offset:
+    # with ≥2 consumers, consumer 0's full read supplies the true value;
+    # with 1 consumer only offsets an incremental read already saw qualify
+    divergent_offsets: list[int] = []
+    if spec.divergent and log:
+        pool = len(log) if spec.n_consumers >= 2 else min(hi, len(log))
+        if pool:
+            divergent_offsets = rng.sample(
+                range(pool), min(spec.divergent, pool)
+            )
+    if spec.full_reads:
+        for c in range(spec.n_consumers or 1):
+            proc = spec.n_producers + (c if spec.n_consumers else 0)
+            t0 = tick()
+            emit(Op.invoke(OpF.READ, proc, FULL_READ, time=t0))
+            batch = [[o, v] for o, v in enumerate(log)]
+            # one consumer sees a never-appended value at the divergent
+            # offsets (small bump — values must stay dense; the checker
+            # also reads the stand-in value as phantom, see above)
+            if c == 1 or spec.n_consumers <= 1:
+                for o in divergent_offsets:
+                    batch[o] = [o, next_value + 2000 + o]
+                    out.divergent.add(o)
+            if c == 0:
+                # swap disjoint adjacent pairs: each adds exactly one
+                # within-batch inversion, so the count is exact
+                for t in range(spec.nonmonotonic):
+                    i = 2 * t
+                    if i + 1 >= len(batch):
+                        break
+                    batch[i], batch[i + 1] = batch[i + 1], batch[i]
+                    out.nonmonotonic += 1
+            emit(Op(OpType.OK, OpF.READ, proc, batch, time=t0 + lat()))
+
+    reindex(ops)
+    return out
+
+
+def synth_stream_batch(
+    n: int, base: StreamSynthSpec | None = None, **overrides: Any
+) -> list[StreamSynthHistory]:
+    """Generate ``n`` stream histories with varying seeds."""
+    base = base or StreamSynthSpec()
+    out = []
+    for i in range(n):
+        kw = {**base.__dict__, **overrides, "seed": base.seed + i}
+        out.append(synth_stream_history(StreamSynthSpec(**kw)))
+    return out

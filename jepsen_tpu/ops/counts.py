@@ -57,3 +57,19 @@ def masked_value_reduce_min(
         .at[_routed(values, select, value_space)]
         .min(jnp.where(select, payload, init), mode="drop")
     )
+
+
+def masked_value_reduce_max(
+    values: jax.Array,  # [L] int32
+    select: jax.Array,  # [L] bool
+    payload: jax.Array,  # [L] int32 — quantity to max-reduce per value
+    value_space: int,
+    init: int = -(2**31),
+) -> jax.Array:
+    """``out[v] = max(payload[i] for i where select[i] and values[i]==v)``,
+    ``init`` where no row matched."""
+    return (
+        jnp.full((value_space,), init, jnp.int32)
+        .at[_routed(values, select, value_space)]
+        .max(jnp.where(select, payload, init), mode="drop")
+    )

@@ -1,0 +1,255 @@
+"""Stream (append-only log) linearizability: anomaly detection + CPU≡TPU.
+
+BASELINE.json config #4.  Every case runs the CPU reference and the TPU
+kernel and asserts identical result maps (differential testing — SURVEY.md
+§4.5), then asserts the injected ground truth is detected.
+"""
+
+import pytest
+
+from jepsen_tpu.checkers.stream_lin import (
+    FULL_READ,
+    check_stream_lin_batch,
+    check_stream_lin_cpu,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import (
+    StreamSynthSpec,
+    synth_stream_batch,
+    synth_stream_history,
+)
+
+
+def both(history):
+    cpu = check_stream_lin_cpu(history)
+    tpu = check_stream_lin_batch([history])[0]
+    assert cpu == tpu, f"cpu/tpu divergence:\n{cpu}\n{tpu}"
+    return cpu
+
+
+def test_clean_history_linearizable():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=21))
+    assert sh.clean
+    r = both(sh.ops)
+    assert r["valid?"]
+    assert r["full-read"]
+    assert r["acknowledged-count"] <= r["attempt-count"]
+
+
+def test_lost_append_detected():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=22, lost=2))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["lost"] == sh.lost
+
+
+def test_lost_not_judged_without_full_read():
+    spec = StreamSynthSpec(n_ops=300, seed=23, lost=2, full_reads=False)
+    sh = synth_stream_history(spec)
+    r = both(sh.ops)
+    assert not r["full-read"]
+    assert r["lost"] == set()
+    assert r["valid?"]
+
+
+def test_duplicate_offset_detected():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=24, duplicated=2))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["duplicate"] == sh.duplicated
+
+
+def test_divergent_offset_detected():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=25, divergent=2))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert sh.divergent <= r["divergent"]
+
+
+def test_divergent_single_consumer_vs_incremental_read():
+    sh = synth_stream_history(
+        StreamSynthSpec(n_ops=300, seed=26, n_consumers=1, divergent=1)
+    )
+    r = both(sh.ops)
+    if sh.divergent:  # needs an incrementally-read prefix to disagree with
+        assert not r["valid?"]
+        assert sh.divergent <= r["divergent"]
+
+
+def test_phantom_detected():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=27, phantom=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert sh.phantom <= r["phantom"]
+
+
+def test_reorder_detected():
+    sh = synth_stream_history(StreamSynthSpec(n_ops=300, seed=28, reorder=1))
+    r = both(sh.ops)
+    assert sh.reorder, "injection must have materialized"
+    assert not r["valid?"]
+    assert r["reorder-count"] >= 1
+
+
+def test_nonmonotonic_batch_detected():
+    sh = synth_stream_history(
+        StreamSynthSpec(n_ops=300, seed=29, nonmonotonic=2)
+    )
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["nonmonotonic-count"] == sh.nonmonotonic == 2
+
+
+def test_rewind_between_reads_is_legal():
+    # separate read ops may re-attach at an earlier offset; only
+    # within-batch regressions are violations
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op.invoke(OpF.APPEND, 0, 1),
+            Op(OpType.OK, OpF.APPEND, 0, 1),
+            Op.invoke(OpF.READ, 1, 0),
+            Op(OpType.OK, OpF.READ, 1, [[0, 0], [1, 1]]),
+            Op.invoke(OpF.READ, 1, 0),  # rewind to offset 0
+            Op(OpType.OK, OpF.READ, 1, [[0, 0], [1, 1]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"]
+    assert r["nonmonotonic-count"] == 0
+
+
+def test_indeterminate_append_read_is_legal():
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.INFO, OpF.APPEND, 0, 0, error="timeout"),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.OK, OpF.READ, 1, [[0, 0]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"]  # info append may have taken effect — not a phantom
+
+
+def test_indeterminate_append_unread_is_not_lost():
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op.invoke(OpF.APPEND, 0, 1),
+            Op(OpType.INFO, OpF.APPEND, 0, 1, error="timeout"),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.OK, OpF.READ, 1, [[0, 0]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"]  # only *acked* appends must surface in the full read
+    assert r["lost"] == set()
+
+
+def test_failed_append_read_is_phantom():
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 7),
+            Op(OpType.FAIL, OpF.APPEND, 0, 7, error="publish-failed"),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.OK, OpF.READ, 1, [[0, 7]]),
+        ]
+    )
+    r = both(ops)
+    assert not r["valid?"]
+    assert r["phantom"] == {7}
+
+
+def test_real_time_reorder_minimal():
+    # append(0) completes before append(1) is invoked, but 0 lands at the
+    # higher offset — no linearization order exists
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op.invoke(OpF.APPEND, 0, 1),
+            Op(OpType.OK, OpF.APPEND, 0, 1),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.OK, OpF.READ, 1, [[0, 1], [1, 0]]),
+        ]
+    )
+    r = both(ops)
+    assert not r["valid?"]
+    assert r["reorder"] == {0}
+
+
+def test_concurrent_appends_any_order_is_legal():
+    # both appends in flight simultaneously — either log order linearizes
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op.invoke(OpF.APPEND, 1, 1),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 1, 1),
+            Op.invoke(OpF.READ, 2, FULL_READ),
+            Op(OpType.OK, OpF.READ, 2, [[0, 1], [1, 0]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"]
+
+
+def test_batch_of_mixed_histories():
+    shs = synth_stream_batch(6, StreamSynthSpec(n_ops=200))
+    shs += synth_stream_batch(2, StreamSynthSpec(n_ops=200, seed=50), lost=1)
+    rs = check_stream_lin_batch([sh.ops for sh in shs])
+    for sh, r in zip(shs, rs):
+        assert r["valid?"] == sh.clean
+        assert r == check_stream_lin_cpu(sh.ops)
+
+
+def test_aborted_full_read_does_not_judge_loss():
+    # a full read that never completes ok observed nothing — unread acked
+    # appends are merely unread, not lost
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.INFO, OpF.READ, 1, error="connection-lost"),
+        ]
+    )
+    r = both(ops)
+    assert not r["full-read"]
+    assert r["lost"] == set()
+    assert r["valid?"]
+
+
+def test_divergent_offset_with_two_appended_values_cpu_eq_tpu():
+    # both observed values at offset 0 were really appended — the CPU
+    # reference and the kernel must combine them identically (reorder
+    # representative = max s / min e)
+    ops = reindex(
+        [
+            Op.invoke(OpF.APPEND, 0, 0),
+            Op(OpType.OK, OpF.APPEND, 0, 0),
+            Op.invoke(OpF.APPEND, 0, 1),
+            Op(OpType.OK, OpF.APPEND, 0, 1),
+            Op.invoke(OpF.APPEND, 0, 5),
+            Op(OpType.OK, OpF.APPEND, 0, 5),
+            Op.invoke(OpF.READ, 1, FULL_READ),
+            Op(OpType.OK, OpF.READ, 1, [[0, 0], [1, 1]]),
+            Op.invoke(OpF.READ, 2, 0),
+            Op(OpType.OK, OpF.READ, 2, [[0, 5]]),
+        ]
+    )
+    r = both(ops)  # both() asserts CPU == TPU exactly
+    assert not r["valid?"]
+    assert r["divergent"] == {0}
+
+
+def test_ten_k_op_history():
+    # the BASELINE config-#4 scale point: 10k-op single-partition histories
+    sh = synth_stream_history(StreamSynthSpec(n_ops=4000, seed=31, lost=1))
+    assert len(sh.ops) >= 10_000
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["lost"] == sh.lost

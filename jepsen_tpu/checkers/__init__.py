@@ -31,3 +31,8 @@ from jepsen_tpu.checkers.stream_lin import (  # noqa: F401
     check_stream_lin_cpu,
     stream_lin_tensor_check,
 )
+from jepsen_tpu.checkers.elle import (  # noqa: F401
+    ElleListAppend,
+    check_elle_cpu,
+    elle_tensor_check,
+)

@@ -1,0 +1,421 @@
+"""Elle-style list-append serializability checking with a TPU cycle search.
+
+BASELINE.json config #5 (stretch): "Elle list-append serializability over
+AMQP tx (TPU cycle search)".  The workload is Elle's *list-append* register
+test (Kingsbury & Alvaro, "Elle: Inferring Isolation Anomalies from
+Experimental Observations", PAPERS.md): transactions of micro-ops
+
+    ["append", k, v]   — append value ``v`` to the list under key ``k``
+    ["r", k, vs]       — read key ``k``, observing the list ``vs``
+
+recorded as ops with ``f = txn`` whose value is the micro-op list (reads
+carry ``None`` on the invocation, the observed list on the completion).
+Appended values are globally unique dense ints, so each observed list is a
+prefix of one per-key total append order — which lets dependency edges be
+*inferred* rather than assumed:
+
+- the longest observed list per key is the inferred append order; every
+  other read of the key must be a prefix of it (else
+  ``incompatible-order`` — two reads that contradict each other).
+- **ww** edge ``t1 → t2``: ``t1``'s append immediately precedes ``t2``'s
+  in the inferred order.
+- **wr** edge ``t1 → t2``: ``t2`` read a list whose last element was
+  appended by ``t1``.
+- **rw** edge ``t1 → t2`` (anti-dependency): ``t1`` read a list of length
+  ``n`` and ``t2`` appended the order's ``n+1``-th element — ``t1`` did
+  not see the append, so it must serialize before it.
+
+Cycle anomalies are classified per Adya: **G0** — a cycle of ww edges
+alone; **G1c** — a cycle of ww∪wr edges; **G2** — a cycle needing at
+least one rw edge.  Aborted/intermediate reads are **G1a** (a read
+observes a value whose transaction definitely failed) and **G1b** (a read
+ends at a non-final append of some transaction's appends to that key).
+
+**The TPU part — cycle search as MXU work.**  Host-side edge inference is
+a linear parse; the expensive phase is the cycle search over the
+transaction graph.  Here it is dense boolean transitive closure by
+repeated squaring: with ``R₀ = A ∨ I``, ``⌈log₂ T⌉`` squarings give
+all-pairs reachability, and ``diag(A · R)`` marks every transaction on a
+cycle.  Each squaring is a ``[T, T]`` matmul — exactly what the MXU's
+systolic array does at peak, in bf16 with f32 accumulation (a sum of
+< 2¹⁵ ones is exactly representable, and only ``> 0`` is consulted) —
+``vmap``-batched over histories × 3 edge-type graphs.  The CPU reference
+uses iterative Tarjan SCC; both report the same on-cycle transaction sets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+
+APPEND = "append"
+READ = "r"
+
+
+# ---------------------------------------------------------------------------
+# Edge inference (host-side linear parse, shared by CPU and TPU backends)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TxnGraph:
+    """Inferred dependency graph over the committed transactions of one
+    history.  ``txn_index[i]`` is the history position of the i-th
+    committed txn's completion (for reporting)."""
+
+    n: int
+    txn_index: list[int]
+    ww: set[tuple[int, int]] = field(default_factory=set)
+    wr: set[tuple[int, int]] = field(default_factory=set)
+    rw: set[tuple[int, int]] = field(default_factory=set)
+    g1a: set[int] = field(default_factory=set)  # txns reading failed writes
+    g1b: set[int] = field(default_factory=set)  # txns reading intermediates
+    incompatible_order: set[int] = field(default_factory=set)  # keys
+
+
+def _txn_micro_ops(op: Op) -> list[list]:
+    v = op.value
+    return v if isinstance(v, (list, tuple)) else []
+
+
+def infer_txn_graph(history: Sequence[Op]) -> TxnGraph:
+    # collect committed (ok) and failed txns; indeterminate (info) txns'
+    # appends may be visible, so they count as possible writers but their
+    # reads impose no constraints (Elle treats info like Knossos does)
+    committed: list[tuple[int, list[list]]] = []  # (history pos, micro-ops)
+    failed_values: set[int] = set()
+    writer_of: dict[int, int] = {}  # value -> committed txn id
+    appends_of: dict[tuple[int, int], list[int]] = {}  # (txn, key) -> values
+
+    for pos, op in enumerate(history):
+        if op.f != OpF.TXN or op.type == OpType.INVOKE:
+            continue
+        mops = _txn_micro_ops(op)
+        if op.type == OpType.OK:
+            committed.append((pos, mops))
+        elif op.type == OpType.FAIL:
+            for m in mops:
+                if len(m) == 3 and m[0] == APPEND and isinstance(m[2], int):
+                    failed_values.add(m[2])
+        # info (indeterminate) txns: their appends may be visible, but
+        # since they have no writer_of entry, observed values from them
+        # impose no edges and are not G1a — exactly the indeterminacy rule
+
+    g = TxnGraph(n=len(committed), txn_index=[p for p, _ in committed])
+    for t, (_, mops) in enumerate(committed):
+        for m in mops:
+            if len(m) == 3 and m[0] == APPEND and isinstance(m[2], int):
+                writer_of[m[2]] = t
+                appends_of.setdefault((t, m[1]), []).append(m[2])
+
+    # per-key inferred order = longest observed list (prefix-checked)
+    order: dict[int, list[int]] = {}
+    reads: list[tuple[int, int, list[int]]] = []  # (txn, key, observed list)
+    for t, (_, mops) in enumerate(committed):
+        for m in mops:
+            if len(m) == 3 and m[0] == READ and isinstance(m[2], (list, tuple)):
+                vs = [v for v in m[2] if isinstance(v, int)]
+                reads.append((t, m[1], vs))
+                cur = order.get(m[1], [])
+                if len(vs) > len(cur):
+                    order[m[1]] = vs
+
+    for t, k, vs in reads:
+        ref = order.get(k, [])
+        if vs != ref[: len(vs)]:
+            g.incompatible_order.add(k)
+        for v in vs:
+            if v in failed_values:
+                g.g1a.add(t)
+        if vs:
+            w = writer_of.get(vs[-1])
+            if w is not None and w != t:  # own intermediate reads are legal
+                wk = appends_of.get((w, k), [])
+                if vs[-1] in wk and vs[-1] != wk[-1]:
+                    g.g1b.add(t)
+
+    # ww: consecutive appends in each key's inferred order
+    for k, vs in order.items():
+        for a, b in zip(vs, vs[1:]):
+            wa, wb = writer_of.get(a), writer_of.get(b)
+            if wa is not None and wb is not None and wa != wb:
+                g.ww.add((wa, wb))
+    # wr and rw
+    for t, k, vs in reads:
+        ref = order.get(k, [])
+        if vs:
+            w = writer_of.get(vs[-1])
+            if w is not None and w != t:
+                g.wr.add((w, t))
+        nxt = ref[len(vs)] if len(vs) < len(ref) else None
+        if nxt is not None:
+            w = writer_of.get(nxt)
+            if w is not None and w != t:
+                g.rw.add((t, w))
+    return g
+
+
+# ---------------------------------------------------------------------------
+# CPU reference: iterative Tarjan SCC per graph
+# ---------------------------------------------------------------------------
+
+
+def _on_cycle_nodes(n: int, edges: set[tuple[int, int]]) -> set[int]:
+    """Nodes on a directed cycle: members of an SCC of size ≥ 2, plus
+    self-loops.  Iterative Tarjan (histories can have thousands of txns)."""
+    adj: list[list[int]] = [[] for _ in range(n)]
+    for a, b in edges:
+        if 0 <= a < n and 0 <= b < n:
+            adj[a].append(b)
+    index = [-1] * n
+    low = [0] * n
+    on_stack = [False] * n
+    stack: list[int] = []
+    out: set[int] = set()
+    counter = 0
+    for root in range(n):
+        if index[root] != -1:
+            continue
+        work = [(root, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter
+                counter += 1
+                stack.append(v)
+                on_stack[v] = True
+            advanced = False
+            for i in range(pi, len(adj[v])):
+                w = adj[v][i]
+                if index[w] == -1:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    advanced = True
+                    break
+                elif on_stack[w]:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) > 1:
+                    out.update(scc)
+    for a, b in edges:
+        if a == b and 0 <= a < n:
+            out.add(a)
+    return out
+
+
+def _classify(g: TxnGraph, ww_cyc: set, wwr_cyc: set, all_cyc: set) -> dict:
+    return {
+        VALID: not (
+            ww_cyc
+            or wwr_cyc
+            or all_cyc
+            or g.g1a
+            or g.g1b
+            or g.incompatible_order
+        ),
+        "txn-count": g.n,
+        "G0": ww_cyc,
+        "G0-count": len(ww_cyc),
+        "G1c": wwr_cyc,
+        "G1c-count": len(wwr_cyc),
+        "G2": all_cyc,
+        "G2-count": len(all_cyc),
+        "G1a": g.g1a,
+        "G1a-count": len(g.g1a),
+        "G1b": g.g1b,
+        "G1b-count": len(g.g1b),
+        "incompatible-order": g.incompatible_order,
+        "incompatible-order-count": len(g.incompatible_order),
+        "ww-edges": len(g.ww),
+        "wr-edges": len(g.wr),
+        "rw-edges": len(g.rw),
+    }
+
+
+def check_elle_cpu(history: Sequence[Op]) -> dict[str, Any]:
+    g = infer_txn_graph(history)
+    ww_cyc = _on_cycle_nodes(g.n, g.ww)
+    wwr_cyc = _on_cycle_nodes(g.n, g.ww | g.wr)
+    all_cyc = _on_cycle_nodes(g.n, g.ww | g.wr | g.rw)
+    return _classify(g, ww_cyc, wwr_cyc, all_cyc)
+
+
+# ---------------------------------------------------------------------------
+# TPU backend: batched dense transitive closure on the MXU
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ElleBatch:
+    """Adjacency tensors for a batch of histories, one ``[B, T, T]`` per
+    edge type (bf16 0/1 — ready for the MXU), plus per-txn validity mask."""
+
+    ww: jax.Array  # [B, T, T] bf16
+    wr: jax.Array  # [B, T, T] bf16
+    rw: jax.Array  # [B, T, T] bf16
+    txn_mask: jax.Array  # [B, T] bool
+    n_txns: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def batch(self) -> int:
+        return self.ww.shape[0]
+
+    @property
+    def length(self) -> int:
+        return self.ww.shape[-1]
+
+
+def _round_up(n: int, k: int) -> int:
+    return ((max(n, 1) + k - 1) // k) * k
+
+
+def pack_txn_graphs(
+    graphs: Sequence[TxnGraph], n_txns: int | None = None
+) -> ElleBatch:
+    B = len(graphs)
+    if B == 0:
+        raise ValueError("cannot pack an empty batch of graphs")
+    T = n_txns if n_txns is not None else _round_up(max(g.n for g in graphs), 128)
+    if max(g.n for g in graphs) > T:
+        raise ValueError(f"graph with {max(g.n for g in graphs)} txns exceeds T={T}")
+    mats = {k: np.zeros((B, T, T), np.float32) for k in ("ww", "wr", "rw")}
+    mask = np.zeros((B, T), bool)
+    for b, g in enumerate(graphs):
+        mask[b, : g.n] = True
+        for name in ("ww", "wr", "rw"):
+            es = getattr(g, name)
+            if es:
+                idx = np.asarray(sorted(es), np.int32)
+                mats[name][b, idx[:, 0], idx[:, 1]] = 1.0
+    bf = lambda x: jnp.asarray(x, jnp.bfloat16)
+    return ElleBatch(
+        ww=bf(mats["ww"]),
+        wr=bf(mats["wr"]),
+        rw=bf(mats["rw"]),
+        txn_mask=jnp.asarray(mask),
+        n_txns=T,
+    )
+
+
+def _on_cycle_tensor(a: jax.Array, n_squarings: int) -> jax.Array:
+    """``a``: [T, T] bf16 adjacency → [T] bool, True iff the node lies on a
+    directed cycle.  ``R ← R·R`` (bf16 MXU matmuls, f32 accumulation)
+    doubles reachable path length; starting from ``A ∨ I`` and squaring
+    ⌈log₂ T⌉ times yields full reachability ``R``; ``diag(A · R) > 0``
+    marks nodes that reach themselves through ≥ 1 edge."""
+    T = a.shape[-1]
+    eye = jnp.eye(T, dtype=jnp.bfloat16)
+    r0 = jnp.minimum(a + eye, jnp.bfloat16(1))
+
+    def body(_, r):
+        rr = jax.lax.dot_general(
+            r,
+            r,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return (rr > 0).astype(jnp.bfloat16)
+
+    r = jax.lax.fori_loop(0, n_squarings, body, r0)
+    ar = jax.lax.dot_general(
+        a, r, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return jnp.diagonal(ar, axis1=-2, axis2=-1) > 0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ElleTensors:
+    valid: jax.Array  # [B] bool
+    g0: jax.Array  # [B, T] bool — txns on a ww cycle
+    g1c: jax.Array  # [B, T] bool — txns on a ww∪wr cycle
+    g2: jax.Array  # [B, T] bool — txns on a ww∪wr∪rw cycle
+
+
+@functools.partial(jax.jit, static_argnames=("n_txns",))
+def _elle_batch(ww, wr, rw, txn_mask, n_txns: int):
+    k = max(int(np.ceil(np.log2(max(n_txns, 2)))), 1)
+    wwr = jnp.minimum(ww + wr, jnp.bfloat16(1))
+    alle = jnp.minimum(wwr + rw, jnp.bfloat16(1))
+
+    def one(a, m):
+        return _on_cycle_tensor(a, k) & m
+
+    g0 = jax.vmap(one)(ww, txn_mask)
+    g1c = jax.vmap(one)(wwr, txn_mask)
+    g2 = jax.vmap(one)(alle, txn_mask)
+    valid = ~(g0.any(-1) | g1c.any(-1) | g2.any(-1))
+    return ElleTensors(valid=valid, g0=g0, g1c=g1c, g2=g2)
+
+
+def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
+    return _elle_batch(
+        batch.ww, batch.wr, batch.rw, batch.txn_mask, batch.n_txns
+    )
+
+
+def check_elle_batch(
+    histories: Sequence[Sequence[Op]], n_txns: int | None = None
+) -> list[dict[str, Any]]:
+    graphs = [infer_txn_graph(h) for h in histories]
+    batch = pack_txn_graphs(graphs, n_txns=n_txns)
+    t = elle_tensor_check(batch)
+    g0 = np.asarray(t.g0)
+    g1c = np.asarray(t.g1c)
+    g2 = np.asarray(t.g2)
+    out = []
+    for b, g in enumerate(graphs):
+        out.append(
+            _classify(
+                g,
+                set(np.nonzero(g0[b])[0].tolist()),
+                set(np.nonzero(g1c[b])[0].tolist()),
+                set(np.nonzero(g2[b])[0].tolist()),
+            )
+        )
+    return out
+
+
+class ElleListAppend(Checker):
+    """Elle list-append serializability (BASELINE config #5)."""
+
+    name = "elle-list-append"
+
+    def __init__(self, backend: str = "tpu"):
+        if backend not in ("cpu", "tpu"):
+            raise ValueError(f"unknown backend {backend!r}")
+        self.backend = backend
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        if self.backend == "cpu":
+            return check_elle_cpu(history)
+        return check_elle_batch([history])[0]

@@ -209,8 +209,12 @@ class Perf(Checker):
         history: Sequence[Op],
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
-        # stream workload ops ride the producer/consumer grid slots
-        remap = {OpF.APPEND: OpF.ENQUEUE, OpF.READ: OpF.DEQUEUE}
+        # stream/txn workload ops ride the producer/consumer grid slots
+        remap = {
+            OpF.APPEND: OpF.ENQUEUE,
+            OpF.READ: OpF.DEQUEUE,
+            OpF.TXN: OpF.ENQUEUE,
+        }
         history = [
             Op(op.type, remap[op.f], op.process, op.value, op.time, op.index, op.error)
             if op.f in remap

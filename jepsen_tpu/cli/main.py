@@ -57,21 +57,22 @@ def _resolve_history_path(path: Path) -> Path:
     raise FileNotFoundError(f"no {HISTORY_FILE} under {path}")
 
 
-def _is_stream_history(history) -> bool:
+def _workload_of(history) -> str:
     from jepsen_tpu.history.ops import OpF
 
-    return any(op.f in (OpF.APPEND, OpF.READ) for op in history)
+    for op in history:
+        if op.f in (OpF.APPEND, OpF.READ):
+            return "stream"
+        if op.f == OpF.TXN:
+            return "elle"
+    return "queue"
 
 
 def _checker_for(args, out_dir=None, history=None):
     backend = args.checker
     workload = getattr(args, "workload", "auto")
     if workload == "auto":
-        workload = (
-            "stream"
-            if history is not None and _is_stream_history(history)
-            else "queue"
-        )
+        workload = _workload_of(history) if history is not None else "queue"
     if workload == "stream":
         from jepsen_tpu.checkers.stream_lin import StreamLinearizability
 
@@ -79,6 +80,15 @@ def _checker_for(args, out_dir=None, history=None):
             {
                 "perf": Perf(out_dir=out_dir),
                 "stream": StreamLinearizability(backend=backend),
+            }
+        )
+    if workload == "elle":
+        from jepsen_tpu.checkers.elle import ElleListAppend
+
+        return compose(
+            {
+                "perf": Perf(out_dir=out_dir),
+                "elle": ElleListAppend(backend=backend),
             }
         )
     checkers = {
@@ -130,19 +140,17 @@ def cmd_bench_check(args) -> int:
         histories = [read_history_jsonl(p) for p in paths]
         print(f"# loaded {len(histories)} stored histories", file=sys.stderr)
         if workload == "auto":
-            # a store may hold both families; bench the majority and say so
-            n_stream = sum(map(_is_stream_history, histories))
-            workload = "stream" if n_stream > len(histories) // 2 else "queue"
-        keep = [
-            h
-            for h in histories
-            if _is_stream_history(h) == (workload == "stream")
-        ]
+            # a store may hold several families; bench the majority
+            # (sorted → deterministic tie-break, favoring "elle" < "queue"
+            # < "stream" alphabetically on equal counts)
+            kinds = [_workload_of(h) for h in histories]
+            workload = max(sorted(set(kinds)), key=kinds.count)
+        keep = [h for h in histories if _workload_of(h) == workload]
         if len(keep) != len(histories):
             print(
                 f"# mixed store: benching {len(keep)} {workload} "
                 f"histories, skipping {len(histories) - len(keep)} of "
-                "the other family",
+                "other families",
                 file=sys.stderr,
             )
             histories = keep
@@ -160,6 +168,20 @@ def cmd_bench_check(args) -> int:
                 sh.ops
                 for sh in synth_stream_batch(
                     args.count, StreamSynthSpec(n_ops=args.ops), lost=1
+                )
+            ]
+        elif workload == "elle":
+            from jepsen_tpu.history.synth import (
+                ElleSynthSpec,
+                synth_elle_batch,
+            )
+
+            histories = [
+                sh.ops
+                for sh in synth_elle_batch(
+                    args.count,
+                    ElleSynthSpec(n_txns=max(args.ops // 2, 8)),
+                    g2_cycle=1,
                 )
             ]
         else:
@@ -189,6 +211,31 @@ def cmd_bench_check(args) -> int:
         jax.block_until_ready(sl)
         t_check = time.perf_counter() - t1
         n_invalid = int((~sl.valid).sum())
+    elif workload == "elle":
+        import numpy as np
+
+        from jepsen_tpu.checkers.elle import (
+            elle_tensor_check,
+            infer_txn_graph,
+            pack_txn_graphs,
+        )
+
+        t0 = time.perf_counter()
+        graphs = [infer_txn_graph(h) for h in histories]
+        packed = pack_txn_graphs(graphs)
+        t_pack = time.perf_counter() - t0
+        jax.block_until_ready(elle_tensor_check(packed))  # compile
+        t1 = time.perf_counter()
+        el = elle_tensor_check(packed)
+        jax.block_until_ready(el)
+        t_check = time.perf_counter() - t1
+        # a history is invalid on any cycle anomaly (device) OR any of the
+        # host-inferred read anomalies — same verdict `check` reports
+        cyc = np.asarray(el.g0.any(-1) | el.g1c.any(-1) | el.g2.any(-1))
+        host_bad = np.asarray(
+            [bool(g.g1a or g.g1b or g.incompatible_order) for g in graphs]
+        )
+        n_invalid = int((cyc | host_bad).sum())
     else:
         t0 = time.perf_counter()
         packed = pack_histories(histories)
@@ -379,6 +426,18 @@ def cmd_synth(args) -> int:
             divergent=args.divergent,
             reorder=args.reorder,
         )
+    elif getattr(args, "workload", "queue") == "elle":
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        shs = synth_elle_batch(
+            args.count,
+            ElleSynthSpec(n_txns=max(args.ops // 2, 8)),
+            g1a=args.g1a,
+            g1b=args.g1b,
+            g0_cycle=args.g0_cycle,
+            g1c_cycle=args.g1c_cycle,
+            g2_cycle=args.g2_cycle,
+        )
     else:
         from jepsen_tpu.history.synth import SynthSpec, synth_batch
 
@@ -419,7 +478,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--workload",
-        choices=("auto", "queue", "stream"),
+        choices=("auto", "queue", "stream", "elle"),
         default="auto",
         help="checker family; auto-detected from the history's op kinds",
     )
@@ -432,7 +491,9 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--count", type=int, default=256, help="synthetic histories")
     b.add_argument("--ops", type=int, default=470, help="invocations per history")
     b.add_argument(
-        "--workload", choices=("auto", "queue", "stream"), default="auto"
+        "--workload",
+        choices=("auto", "queue", "stream", "elle"),
+        default="auto",
     )
     b.set_defaults(fn=cmd_bench_check)
 
@@ -521,7 +582,9 @@ def build_parser() -> argparse.ArgumentParser:
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument("--store", default="store", help="store root dir")
-    s.add_argument("--workload", choices=("queue", "stream"), default="queue")
+    s.add_argument(
+        "--workload", choices=("queue", "stream", "elle"), default="queue"
+    )
     s.add_argument("--count", type=int, default=16)
     s.add_argument("--ops", type=int, default=470)
     s.add_argument("--lost", type=int, default=0)
@@ -529,6 +592,11 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--unexpected", type=int, default=0, help="queue workload")
     s.add_argument("--divergent", type=int, default=0, help="stream workload")
     s.add_argument("--reorder", type=int, default=0, help="stream workload")
+    s.add_argument("--g1a", type=int, default=0, help="elle workload")
+    s.add_argument("--g1b", type=int, default=0, help="elle workload")
+    s.add_argument("--g0-cycle", type=int, default=0, help="elle workload")
+    s.add_argument("--g1c-cycle", type=int, default=0, help="elle workload")
+    s.add_argument("--g2-cycle", type=int, default=0, help="elle workload")
     s.set_defaults(fn=cmd_synth)
 
     return p

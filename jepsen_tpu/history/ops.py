@@ -59,6 +59,10 @@ class OpF(enum.IntEnum):
     # pair or a list of pairs (a batch / full read from offset 0).
     APPEND = 6
     READ = 7
+    # transactional workload (BASELINE.json config #5: Elle list-append over
+    # AMQP tx).  value is a list of micro-ops: ["append", k, v] or
+    # ["r", k, vs|None] (vs = the observed list on completion).
+    TXN = 8
 
     @classmethod
     def from_name(cls, name: str) -> "OpF":
@@ -68,7 +72,7 @@ class OpF(enum.IntEnum):
 _TYPE_BY_NAME = {t.name.lower(): t for t in OpType}
 _F_BY_NAME = {f.name.lower(): f for f in OpF}
 
-CLIENT_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN, OpF.APPEND, OpF.READ)
+CLIENT_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN, OpF.APPEND, OpF.READ, OpF.TXN)
 
 
 @dataclass

@@ -401,6 +401,222 @@ def synth_stream_history(spec: StreamSynthSpec) -> StreamSynthHistory:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Elle list-append transactional histories — BASELINE.json config #5
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ElleSynthSpec:
+    """Transactions of append/read micro-ops over K list keys, executed
+    serially (hence serializable when clean), with fabricated anomalies on
+    dedicated keys so ground truth is exact."""
+
+    n_txns: int = 100
+    n_keys: int = 8
+    max_micro_ops: int = 4
+    p_append: float = 0.5
+    p_fail: float = 0.03  # txn definitely aborted (appends discarded)
+    p_info: float = 0.02  # indeterminate (appends coin-flipped)
+    mean_latency_ns: int = 2_000_000
+    seed: int = 0
+    # anomaly injection counts (each uses its own fresh keys)
+    g1a: int = 0  # read of an aborted txn's append
+    g1b: int = 0  # read of an intermediate append
+    g0_cycle: int = 0  # write-write cycle (contradictory append orders)
+    g1c_cycle: int = 0  # write-read information cycle
+    g2_cycle: int = 0  # anti-dependency (write-skew) cycle
+
+
+@dataclass
+class ElleSynthHistory:
+    ops: list[Op]
+    # ground truth: committed-txn ids involved per anomaly class
+    g1a: set[int] = field(default_factory=set)
+    g1b: set[int] = field(default_factory=set)
+    g0: set[int] = field(default_factory=set)
+    g1c: set[int] = field(default_factory=set)
+    g2: set[int] = field(default_factory=set)
+
+    @property
+    def clean(self) -> bool:
+        return not (self.g1a or self.g1b or self.g0 or self.g1c or self.g2)
+
+
+def synth_elle_history(spec: ElleSynthSpec) -> ElleSynthHistory:
+    from jepsen_tpu.checkers.elle import APPEND, READ
+
+    rng = random.Random(spec.seed)
+    clock = 0
+    ops: list[Op] = []
+    out = ElleSynthHistory(ops=ops)
+    state: dict[int, list[int]] = {}
+    next_value = 0
+    next_key = spec.n_keys  # injection keys allocated past the regular ones
+    n_committed = 0
+
+    def tick() -> int:
+        nonlocal clock
+        clock += rng.randint(100_000, 2_000_000)
+        return clock
+
+    def lat() -> int:
+        return max(1, int(rng.expovariate(1.0 / spec.mean_latency_ns)))
+
+    def fresh_value() -> int:
+        nonlocal next_value
+        v = next_value
+        next_value += 1
+        return v
+
+    def fresh_key() -> int:
+        nonlocal next_key
+        k = next_key
+        next_key += 1
+        return k
+
+    def commit(mops_invoke: list, mops_complete: list, p: int | None = None) -> int:
+        """Emit an ok txn; returns its committed-txn id."""
+        nonlocal n_committed
+        p = rng.randrange(5) if p is None else p
+        t0 = tick()
+        ops.append(Op.invoke(OpF.TXN, p, mops_invoke, time=t0))
+        ops.append(Op(OpType.OK, OpF.TXN, p, mops_complete, time=t0 + lat()))
+        t = n_committed
+        n_committed += 1
+        return t
+
+    # -- regular serial workload -------------------------------------------
+    for _ in range(spec.n_txns):
+        n_mops = rng.randint(1, spec.max_micro_ops)
+        mops_inv, mops_done, applied = [], [], []
+        for _ in range(n_mops):
+            k = rng.randrange(spec.n_keys)
+            if rng.random() < spec.p_append:
+                v = fresh_value()
+                mops_inv.append([APPEND, k, v])
+                mops_done.append([APPEND, k, v])
+                applied.append((k, v))
+            else:
+                # serial semantics: a read sees the committed state plus
+                # this txn's own earlier appends to the key
+                own = [v2 for (k2, v2) in applied if k2 == k]
+                mops_inv.append([READ, k, None])
+                mops_done.append([READ, k, list(state.get(k, [])) + own])
+        roll = rng.random()
+        p = rng.randrange(5)
+        t0 = tick()
+        ops.append(Op.invoke(OpF.TXN, p, mops_inv, time=t0))
+        if roll < spec.p_fail:
+            ops.append(
+                Op(OpType.FAIL, OpF.TXN, p, mops_inv, time=t0 + lat(), error="aborted")
+            )
+        elif roll < spec.p_fail + spec.p_info:
+            ops.append(
+                Op(OpType.INFO, OpF.TXN, p, mops_inv, time=t0 + lat(), error="timeout")
+            )
+            if rng.random() < 0.5:
+                for k, v in applied:
+                    state.setdefault(k, []).append(v)
+        else:
+            ops.append(Op(OpType.OK, OpF.TXN, p, mops_done, time=t0 + lat()))
+            for k, v in applied:
+                state.setdefault(k, []).append(v)
+            n_committed += 1
+
+    # -- fabricated anomalies on dedicated keys ----------------------------
+    for _ in range(spec.g1a):
+        k = fresh_key()
+        v = fresh_value()
+        p = rng.randrange(5)
+        t0 = tick()
+        ops.append(Op.invoke(OpF.TXN, p, [[APPEND, k, v]], time=t0))
+        ops.append(
+            Op(OpType.FAIL, OpF.TXN, p, [[APPEND, k, v]], time=t0 + lat(), error="aborted")
+        )
+        t = commit([[READ, k, None]], [[READ, k, [v]]])
+        out.g1a.add(t)
+
+    for _ in range(spec.g1b):
+        k = fresh_key()
+        v1, v2 = fresh_value(), fresh_value()
+        tw = commit(
+            [[APPEND, k, v1], [APPEND, k, v2]],
+            [[APPEND, k, v1], [APPEND, k, v2]],
+        )
+        state[k] = [v1, v2]
+        tr = commit([[READ, k, None]], [[READ, k, [v1]]])
+        out.g1b.add(tr)
+
+    for _ in range(spec.g0_cycle):
+        k1, k2 = fresh_key(), fresh_key()
+        a1, a2 = fresh_value(), fresh_value()
+        b1, b2 = fresh_value(), fresh_value()
+        t1 = commit(
+            [[APPEND, k1, a1], [APPEND, k2, a2]],
+            [[APPEND, k1, a1], [APPEND, k2, a2]],
+        )
+        t2 = commit(
+            [[APPEND, k1, b1], [APPEND, k2, b2]],
+            [[APPEND, k1, b1], [APPEND, k2, b2]],
+        )
+        # observed orders contradict: k1 says t1 < t2, k2 says t2 < t1
+        commit(
+            [[READ, k1, None], [READ, k2, None]],
+            [[READ, k1, [a1, b1]], [READ, k2, [b2, a2]]],
+        )
+        out.g0.update((t1, t2))
+
+    for _ in range(spec.g1c_cycle):
+        k1, k2 = fresh_key(), fresh_key()
+        v1, v2 = fresh_value(), fresh_value()
+        # each txn reads the other's append: wr edges both ways
+        t1 = commit(
+            [[APPEND, k1, v1], [READ, k2, None]],
+            [[APPEND, k1, v1], [READ, k2, [v2]]],
+        )
+        t2 = commit(
+            [[APPEND, k2, v2], [READ, k1, None]],
+            [[APPEND, k2, v2], [READ, k1, [v1]]],
+        )
+        out.g1c.update((t1, t2))
+
+    for _ in range(spec.g2_cycle):
+        k1, k2 = fresh_key(), fresh_key()
+        v1, v2 = fresh_value(), fresh_value()
+        # write skew: each reads the key the other appends to, missing the
+        # append — rw edges both ways, no ww/wr cycle
+        t1 = commit(
+            [[READ, k1, None], [APPEND, k2, v1]],
+            [[READ, k1, []], [APPEND, k2, v1]],
+        )
+        t2 = commit(
+            [[READ, k2, None], [APPEND, k1, v2]],
+            [[READ, k2, []], [APPEND, k1, v2]],
+        )
+        # a later observer fixes both append orders so rw targets exist
+        commit(
+            [[READ, k1, None], [READ, k2, None]],
+            [[READ, k1, [v2]], [READ, k2, [v1]]],
+        )
+        out.g2.update((t1, t2))
+
+    reindex(ops)
+    return out
+
+
+def synth_elle_batch(
+    n: int, base: ElleSynthSpec | None = None, **overrides: Any
+) -> list[ElleSynthHistory]:
+    """Generate ``n`` transactional histories with varying seeds."""
+    base = base or ElleSynthSpec()
+    out = []
+    for i in range(n):
+        kw = {**base.__dict__, **overrides, "seed": base.seed + i}
+        out.append(synth_elle_history(ElleSynthSpec(**kw)))
+    return out
+
+
 def synth_stream_batch(
     n: int, base: StreamSynthSpec | None = None, **overrides: Any
 ) -> list[StreamSynthHistory]:

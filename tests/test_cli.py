@@ -72,3 +72,67 @@ def test_synth_then_bench_on_store(tmp_path, capsys):
     assert rc == 0
     stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert stats["histories"] == 4
+
+
+def test_stream_workload_end_to_end(tmp_path, capsys):
+    rc = main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "stream",
+            "--count", "2", "--ops", "80", "--lost", "1",
+        ]
+    )
+    assert rc == 0
+    runs = sorted((tmp_path / "synth").iterdir())
+    rc = main(["check", str(runs[0])])  # workload auto-detected
+    out = capsys.readouterr().out
+    assert rc == 1 and INVALID_BANNER in out
+    rc = main(["bench-check", "--histories", str(tmp_path)])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and stats["histories"] == 2 and stats["invalid"] == 2
+
+
+def test_elle_workload_end_to_end(tmp_path, capsys):
+    rc = main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "elle",
+            "--count", "2", "--ops", "80", "--g2-cycle", "1",
+        ]
+    )
+    assert rc == 0
+    runs = sorted((tmp_path / "synth").iterdir())
+    rc = main(["check", str(runs[0])])
+    out = capsys.readouterr().out
+    assert rc == 1 and INVALID_BANNER in out
+    saved = json.loads((runs[0] / "results.json").read_text())
+    assert saved["elle"]["G2-count"] >= 2
+    rc = main(["bench-check", "--histories", str(tmp_path)])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and stats["histories"] == 2 and stats["invalid"] == 2
+
+
+def test_bench_check_mixed_store_filters_majority(tmp_path, capsys):
+    main(["synth", "--store", str(tmp_path), "--count", "3", "--ops", "40"])
+    main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "stream",
+            "--count", "1", "--ops", "40",
+        ]
+    )
+    rc = main(["bench-check", "--histories", str(tmp_path)])
+    err = capsys.readouterr()
+    stats = json.loads(err.out.strip().splitlines()[-1])
+    assert rc == 0 and stats["histories"] == 3  # queue majority wins
+    assert "mixed store" in err.err
+
+
+def test_bench_check_elle_counts_host_anomalies(tmp_path, capsys):
+    # G1a is inferred host-side (no cycle): bench must still count it
+    main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "elle",
+            "--count", "2", "--ops", "60", "--g1a", "1",
+        ]
+    )
+    rc = main(["bench-check", "--histories", str(tmp_path)])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and stats["invalid"] == 2

@@ -1,0 +1,164 @@
+"""Elle list-append serializability: anomaly detection + CPU≡TPU.
+
+BASELINE.json config #5.  The CPU reference (Tarjan SCC) and the TPU
+backend (MXU transitive closure) must report identical result maps on
+every history; fabricated anomalies must be detected exactly.
+"""
+
+from jepsen_tpu.checkers.elle import (
+    APPEND,
+    READ,
+    check_elle_batch,
+    check_elle_cpu,
+    infer_txn_graph,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    synth_elle_batch,
+    synth_elle_history,
+)
+
+
+def both(history):
+    cpu = check_elle_cpu(history)
+    tpu = check_elle_batch([history])[0]
+    assert cpu == tpu, f"cpu/tpu divergence:\n{cpu}\n{tpu}"
+    return cpu
+
+
+def txn(p, mops, typ=OpType.OK):
+    return [
+        Op.invoke(OpF.TXN, p, mops),
+        Op(typ, OpF.TXN, p, mops),
+    ]
+
+
+def test_clean_serial_history_serializable():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=200, seed=41))
+    assert sh.clean
+    r = both(sh.ops)
+    assert r["valid?"], r
+    assert r["txn-count"] > 150
+
+
+def test_g1a_aborted_read():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=100, seed=42, g1a=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["G1a"] == sh.g1a
+
+
+def test_g1b_intermediate_read():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=100, seed=43, g1b=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["G1b"] == sh.g1b
+
+
+def test_g0_write_cycle():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=100, seed=44, g0_cycle=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["G0"] == sh.g0
+    # a ww cycle is also a cycle of the larger graphs
+    assert sh.g0 <= r["G1c"] and sh.g0 <= r["G2"]
+
+
+def test_g1c_information_cycle():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=100, seed=45, g1c_cycle=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["G0"] == set()  # no pure write cycle
+    assert r["G1c"] == sh.g1c
+    assert sh.g1c <= r["G2"]
+
+
+def test_g2_write_skew():
+    sh = synth_elle_history(ElleSynthSpec(n_txns=100, seed=46, g2_cycle=1))
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert r["G0"] == set() and r["G1c"] == set()  # needs the rw edges
+    assert r["G2"] == sh.g2
+
+
+def test_incompatible_order():
+    ops = reindex(
+        [
+            *txn(0, [[APPEND, 0, 1]]),
+            *txn(0, [[APPEND, 0, 2]]),
+            *txn(1, [[READ, 0, [1, 2]]]),
+            *txn(2, [[READ, 0, [2]]]),  # contradicts [1, 2]
+        ]
+    )
+    r = both(ops)
+    assert not r["valid?"]
+    assert r["incompatible-order"] == {0}
+
+
+def test_own_intermediate_read_is_legal():
+    ops = reindex(
+        [
+            *txn(0, [[APPEND, 0, 1], [READ, 0, [1]], [APPEND, 0, 2]]),
+            *txn(1, [[READ, 0, [1, 2]]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"], r
+    assert r["G1b-count"] == 0
+
+
+def test_read_of_indeterminate_append_imposes_nothing():
+    ops = reindex(
+        [
+            Op.invoke(OpF.TXN, 0, [[APPEND, 0, 1]]),
+            Op(OpType.INFO, OpF.TXN, 0, [[APPEND, 0, 1]], error="timeout"),
+            *txn(1, [[READ, 0, [1]]]),
+        ]
+    )
+    r = both(ops)
+    assert r["valid?"], r  # info append may have happened — not G1a
+
+
+def test_wr_edge_inference():
+    ops = reindex(
+        [
+            *txn(0, [[APPEND, 0, 1]]),
+            *txn(1, [[READ, 0, [1]]]),
+        ]
+    )
+    g = infer_txn_graph(ops)
+    assert g.wr == {(0, 1)}
+    assert g.ww == set() and g.rw == set()
+
+
+def test_rw_edge_inference():
+    ops = reindex(
+        [
+            *txn(0, [[READ, 0, []]]),
+            *txn(1, [[APPEND, 0, 1]]),
+            *txn(2, [[READ, 0, [1]]]),
+        ]
+    )
+    g = infer_txn_graph(ops)
+    assert (0, 1) in g.rw  # the empty read missed txn 1's append
+
+
+def test_batch_of_mixed_histories():
+    shs = synth_elle_batch(4, ElleSynthSpec(n_txns=80))
+    shs += synth_elle_batch(2, ElleSynthSpec(n_txns=80, seed=60), g2_cycle=1)
+    rs = check_elle_batch([sh.ops for sh in shs])
+    for sh, r in zip(shs, rs):
+        assert r["valid?"] == sh.clean
+        assert r == check_elle_cpu(sh.ops)
+
+
+def test_large_history_many_txns():
+    # cycle search at a scale where the closure is real MXU work
+    sh = synth_elle_history(
+        ElleSynthSpec(n_txns=600, seed=47, g1c_cycle=1, g2_cycle=1)
+    )
+    r = both(sh.ops)
+    assert not r["valid?"]
+    assert sh.g1c <= r["G1c"]
+    assert sh.g2 <= r["G2"]

@@ -130,14 +130,17 @@ def infer_txn_graph(history: Sequence[Op]) -> TxnGraph:
                 if len(vs) > len(cur):
                     order[m[1]] = vs
 
+    compatible: list[bool] = []
     for t, k, vs in reads:
         ref = order.get(k, [])
-        if vs != ref[: len(vs)]:
+        ok_prefix = vs == ref[: len(vs)]
+        compatible.append(ok_prefix)
+        if not ok_prefix:
             g.incompatible_order.add(k)
         for v in vs:
             if v in failed_values:
                 g.g1a.add(t)
-        if vs:
+        if vs and ok_prefix:
             w = writer_of.get(vs[-1])
             if w is not None and w != t:  # own intermediate reads are legal
                 wk = appends_of.get((w, k), [])
@@ -150,8 +153,11 @@ def infer_txn_graph(history: Sequence[Op]) -> TxnGraph:
             wa, wb = writer_of.get(a), writer_of.get(b)
             if wa is not None and wb is not None and wa != wb:
                 g.ww.add((wa, wb))
-    # wr and rw
-    for t, k, vs in reads:
+    # wr and rw — only from reads consistent with the inferred order; an
+    # incompatible read's content is unreliable and would fabricate cycles
+    for (t, k, vs), ok_prefix in zip(reads, compatible):
+        if not ok_prefix:
+            continue
         ref = order.get(k, [])
         if vs:
             w = writer_of.get(vs[-1])
@@ -278,6 +284,9 @@ class ElleBatch:
     wr: jax.Array  # [B, T, T] bf16
     rw: jax.Array  # [B, T, T] bf16
     txn_mask: jax.Array  # [B, T] bool
+    # host-inferred non-cycle anomalies (G1a / G1b / incompatible-order),
+    # folded into ``valid`` so the tensor verdict matches ``check``
+    host_bad: jax.Array = None  # [B] bool
     n_txns: int = dataclasses.field(metadata=dict(static=True), default=0)
 
     @property
@@ -289,23 +298,23 @@ class ElleBatch:
         return self.ww.shape[-1]
 
 
-def _round_up(n: int, k: int) -> int:
-    return ((max(n, 1) + k - 1) // k) * k
-
-
 def pack_txn_graphs(
     graphs: Sequence[TxnGraph], n_txns: int | None = None
 ) -> ElleBatch:
+    from jepsen_tpu.history.encode import LANE, _round_up
+
     B = len(graphs)
     if B == 0:
         raise ValueError("cannot pack an empty batch of graphs")
-    T = n_txns if n_txns is not None else _round_up(max(g.n for g in graphs), 128)
+    T = n_txns if n_txns is not None else _round_up(max(g.n for g in graphs), LANE)
     if max(g.n for g in graphs) > T:
         raise ValueError(f"graph with {max(g.n for g in graphs)} txns exceeds T={T}")
     mats = {k: np.zeros((B, T, T), np.float32) for k in ("ww", "wr", "rw")}
     mask = np.zeros((B, T), bool)
+    host_bad = np.zeros((B,), bool)
     for b, g in enumerate(graphs):
         mask[b, : g.n] = True
+        host_bad[b] = bool(g.g1a or g.g1b or g.incompatible_order)
         for name in ("ww", "wr", "rw"):
             es = getattr(g, name)
             if es:
@@ -317,6 +326,7 @@ def pack_txn_graphs(
         wr=bf(mats["wr"]),
         rw=bf(mats["rw"]),
         txn_mask=jnp.asarray(mask),
+        host_bad=jnp.asarray(host_bad),
         n_txns=T,
     )
 
@@ -357,7 +367,7 @@ class ElleTensors:
 
 
 @functools.partial(jax.jit, static_argnames=("n_txns",))
-def _elle_batch(ww, wr, rw, txn_mask, n_txns: int):
+def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int):
     k = max(int(np.ceil(np.log2(max(n_txns, 2)))), 1)
     wwr = jnp.minimum(ww + wr, jnp.bfloat16(1))
     alle = jnp.minimum(wwr + rw, jnp.bfloat16(1))
@@ -368,13 +378,18 @@ def _elle_batch(ww, wr, rw, txn_mask, n_txns: int):
     g0 = jax.vmap(one)(ww, txn_mask)
     g1c = jax.vmap(one)(wwr, txn_mask)
     g2 = jax.vmap(one)(alle, txn_mask)
-    valid = ~(g0.any(-1) | g1c.any(-1) | g2.any(-1))
+    valid = ~(g0.any(-1) | g1c.any(-1) | g2.any(-1) | host_bad)
     return ElleTensors(valid=valid, g0=g0, g1c=g1c, g2=g2)
 
 
 def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
     return _elle_batch(
-        batch.ww, batch.wr, batch.rw, batch.txn_mask, batch.n_txns
+        batch.ww,
+        batch.wr,
+        batch.rw,
+        batch.txn_mask,
+        batch.host_bad,
+        batch.n_txns,
     )
 
 

@@ -1,0 +1,33 @@
+"""Combined quorum-queue verdict from the fused Pallas stats kernel.
+
+One pass over the packed history rows (``jepsen_tpu.ops.pallas_stats``)
+yields every per-value stat both queue checkers need; the classify stages
+are the same tensor programs the scatter path uses
+(``total_queue_classify`` / ``queue_lin_classify``), so the two paths are
+interchangeable and differential-tested against each other.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from jepsen_tpu.checkers.queue_lin import (
+    QueueLinTensors,
+    queue_lin_classify,
+)
+from jepsen_tpu.checkers.total_queue import (
+    TotalQueueTensors,
+    total_queue_classify,
+)
+from jepsen_tpu.history.encode import PackedHistories
+from jepsen_tpu.ops.pallas_stats import fused_queue_stats
+
+
+def fused_tensor_check(
+    packed: PackedHistories, interpret: bool | None = None
+) -> tuple[TotalQueueTensors, QueueLinTensors]:
+    """Batched total-queue + queue-linearizability results, one HBM pass."""
+    st = fused_queue_stats(packed, interpret=interpret)
+    tq = total_queue_classify(st.a, st.e, st.d)
+    ql = queue_lin_classify(st.a, st.x, st.s, st.d, st.t)
+    return tq, ql

@@ -139,13 +139,13 @@ def cmd_bench_check(args) -> int:
             return 2
         histories = [read_history_jsonl(p) for p in paths]
         print(f"# loaded {len(histories)} stored histories", file=sys.stderr)
+        # a store may hold several families; bench the majority on auto
+        # (sorted → deterministic tie-break, favoring "elle" < "queue"
+        # < "stream" alphabetically on equal counts)
+        kinds = [_workload_of(h) for h in histories]
         if workload == "auto":
-            # a store may hold several families; bench the majority
-            # (sorted → deterministic tie-break, favoring "elle" < "queue"
-            # < "stream" alphabetically on equal counts)
-            kinds = [_workload_of(h) for h in histories]
             workload = max(sorted(set(kinds)), key=kinds.count)
-        keep = [h for h in histories if _workload_of(h) == workload]
+        keep = [h for h, kind in zip(histories, kinds) if kind == workload]
         if len(keep) != len(histories):
             print(
                 f"# mixed store: benching {len(keep)} {workload} "
@@ -196,6 +196,12 @@ def cmd_bench_check(args) -> int:
             ]
         print(f"# generated {len(histories)} synthetic histories", file=sys.stderr)
 
+    if getattr(args, "profile", None):
+        # device + host trace of the pack/compile/check phases, viewable
+        # in XProf/TensorBoard (the checker's own tracing story — the
+        # analog of the reference's gnuplot perf artifacts, SURVEY.md §5)
+        jax.profiler.start_trace(args.profile)
+
     if workload == "stream":
         from jepsen_tpu.checkers.stream_lin import (
             pack_stream_histories,
@@ -221,21 +227,15 @@ def cmd_bench_check(args) -> int:
         )
 
         t0 = time.perf_counter()
-        graphs = [infer_txn_graph(h) for h in histories]
-        packed = pack_txn_graphs(graphs)
+        packed = pack_txn_graphs([infer_txn_graph(h) for h in histories])
         t_pack = time.perf_counter() - t0
         jax.block_until_ready(elle_tensor_check(packed))  # compile
         t1 = time.perf_counter()
         el = elle_tensor_check(packed)
         jax.block_until_ready(el)
         t_check = time.perf_counter() - t1
-        # a history is invalid on any cycle anomaly (device) OR any of the
-        # host-inferred read anomalies — same verdict `check` reports
-        cyc = np.asarray(el.g0.any(-1) | el.g1c.any(-1) | el.g2.any(-1))
-        host_bad = np.asarray(
-            [bool(g.g1a or g.g1b or g.incompatible_order) for g in graphs]
-        )
-        n_invalid = int((cyc | host_bad).sum())
+        # ElleTensors.valid folds cycle + host-inferred read anomalies
+        n_invalid = int((~np.asarray(el.valid)).sum())
     else:
         t0 = time.perf_counter()
         packed = pack_histories(histories)
@@ -249,11 +249,23 @@ def cmd_bench_check(args) -> int:
         jax.block_until_ready((tq, ql))
         t_check = time.perf_counter() - t1
         n_invalid = int((~(tq.valid & ql.valid)).sum())
+
+    if getattr(args, "profile", None):
+        jax.profiler.stop_trace()
+        print(f"# wrote profiler trace under {args.profile}", file=sys.stderr)
+    # elle packs txn *graphs*, where .length is padded txn slots, not op
+    # rows — report recorded op rows for every workload so the stat is
+    # comparable across families
+    ops_per_history = (
+        max(len(h) for h in histories)
+        if workload == "elle"
+        else packed.length
+    )
     print(
         json.dumps(
             {
                 "histories": packed.batch,
-                "ops_per_history": packed.length,
+                "ops_per_history": ops_per_history,
                 "pack_s": round(t_pack, 3),
                 "check_s": round(t_check, 5),
                 "histories_per_sec": round(packed.batch / max(t_check, 1e-9), 1),
@@ -494,6 +506,10 @@ def build_parser() -> argparse.ArgumentParser:
         "--workload",
         choices=("auto", "queue", "stream", "elle"),
         default="auto",
+    )
+    b.add_argument(
+        "--profile",
+        help="write a jax.profiler (XProf) trace of the check to this dir",
     )
     b.set_defaults(fn=cmd_bench_check)
 

@@ -1,0 +1,188 @@
+"""Fused per-value history statistics as a Pallas TPU kernel.
+
+The scatter path (``jepsen_tpu.ops.counts``) evaluates the total-queue and
+queue-linearizability checkers with ~9 independent XLA scatter ops.  This
+kernel is the **native-kernel escape hatch** SURVEY.md §7.2 reserves for
+the case where XLA's scheduling of those scatters is poor: it computes all
+six per-value stat vectors in one pass over the rows by materializing a
+value×row comparison tile ``eq[v, l] = (value[l] == v)`` in VMEM and
+reducing it along rows under six predicates (pure VPU work, no scatters):
+
+**Measured verdict (v5e-1, 2026-07)**: XLA's sorted-scatter lowering is
+*good* here — the scatter path beats this kernel 5–10× at every probed
+shape (e.g. B=4096 L=1024 V=384: 0.14 ms vs 1.2 ms; B=8 L=65536: 0.14 ms
+vs 0.75 ms), because the dense comparison does O(L·V/lane) work against
+the scatters' O(L).  The kernel therefore stays an *alternative verified
+backend* (``fused.fused_tensor_check``, differential-tested bit-exact
+against the scatter path) and a working template for future hot ops that
+XLA does schedule poorly — not the default path.  Don't hand-schedule what
+the compiler already does well.
+
+    a[v] — enqueue-invoke count        (total-queue + queue-lin)
+    e[v] — enqueue-ok count            (total-queue)
+    x[v] — enqueue-fail count          (queue-lin)
+    d[v] — ok-read count               (total-queue + queue-lin)
+    s[v] — min history position of an enqueue invoke   (queue-lin)
+    t[v] — min history position of an ok read          (queue-lin)
+
+Layout (Mosaic tiling wants the last two dims ≡ (8·k, 128·k) or full-axis):
+the ``[B, L]`` int32 columns are reshaped to ``[B, L/128, 128]`` so each
+input block is one history with full row axes; the comparison tile puts
+**value ids on sublanes** (``TILE_V = 128``) and the 128-row chunk on
+lanes, so row reductions are lane reductions.  Grid = ``(B, V / TILE_V)``;
+each program scans the history's ``L/128`` chunks with a ``fori_loop``.
+Stat tiles land in an ``[B, 8, V]`` output (rows 6..7 padding) whose
+``(8, TILE_V)`` block is exactly one native tile.
+
+The packer guarantees ``L`` and ``V`` are multiples of 128
+(``jepsen_tpu.history.encode.LANE``); padded rows carry ``mask=0`` and
+``value=-1`` and fail every predicate.
+
+``interpret=True`` (automatic off-TPU) runs the same kernel through the
+Pallas interpreter, which is how the CPU test mesh exercises it.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from jepsen_tpu.history.encode import LANE, PackedHistories
+from jepsen_tpu.history.ops import OpF, OpType
+
+_INF = 2**31 - 1
+TILE_V = 128  # value ids per program (sublane axis of the comparison tile)
+_N_STATS = 8  # 6 used + 2 sublane-padding rows
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QueueStats:
+    """Fused per-value stats, each ``[B, V]`` int32."""
+
+    a: jax.Array  # enqueue invokes
+    e: jax.Array  # enqueue oks
+    x: jax.Array  # enqueue fails
+    d: jax.Array  # ok reads
+    s: jax.Array  # min enqueue-invoke position (INF if none)
+    t: jax.Array  # min ok-read position (INF if none)
+
+
+def _fused_kernel(f_ref, t_ref, v_ref, m_ref, out_ref):
+    j = pl.program_id(1)
+    n_chunks = f_ref.shape[1]
+    col = (
+        jax.lax.broadcasted_iota(jnp.int32, (TILE_V, LANE), 0) + j * TILE_V
+    )  # value id per sublane row
+    lane = jax.lax.broadcasted_iota(jnp.int32, (TILE_V, LANE), 1)
+
+    def body(i, acc):
+        a, e, x, d, s, t = acc
+        sl = pl.ds(i, 1)
+        fv = f_ref[0, sl, :]  # [1, 128] — broadcasts against [TILE_V, 128]
+        tv = t_ref[0, sl, :]
+        vv = v_ref[0, sl, :]
+        mv = m_ref[0, sl, :]
+        pos = lane + i * LANE  # global history position of each row
+
+        live = (vv >= 0) & (mv != 0)
+        is_enq = (fv == int(OpF.ENQUEUE)) & live
+        is_read = (
+            ((fv == int(OpF.DEQUEUE)) | (fv == int(OpF.DRAIN)))
+            & live
+            & (tv == int(OpType.OK))
+        )
+        enq_inv = is_enq & (tv == int(OpType.INVOKE))
+        eq = vv == col  # [TILE_V, 128] comparison tile
+
+        def cnt(sel):
+            return jnp.sum((eq & sel).astype(jnp.int32), axis=1)
+
+        def pmin(sel):
+            return jnp.min(jnp.where(eq & sel, pos, _INF), axis=1)
+
+        return (
+            a + cnt(enq_inv),
+            e + cnt(is_enq & (tv == int(OpType.OK))),
+            x + cnt(is_enq & (tv == int(OpType.FAIL))),
+            d + cnt(is_read),
+            jnp.minimum(s, pmin(enq_inv)),
+            jnp.minimum(t, pmin(is_read)),
+        )
+
+    zero = jnp.zeros((TILE_V,), jnp.int32)
+    inf = jnp.full((TILE_V,), _INF, jnp.int32)
+    a, e, x, d, s, t = jax.lax.fori_loop(
+        0, n_chunks, body, (zero, zero, zero, zero, inf, inf)
+    )
+    out_ref[0, 0, :] = a
+    out_ref[0, 1, :] = e
+    out_ref[0, 2, :] = x
+    out_ref[0, 3, :] = d
+    out_ref[0, 4, :] = s
+    out_ref[0, 5, :] = t
+    out_ref[0, 6, :] = zero
+    out_ref[0, 7, :] = zero
+
+
+@functools.partial(jax.jit, static_argnames=("value_space", "interpret"))
+def _fused_queue_stats(
+    f, type_, value, mask_i32, value_space: int, interpret: bool
+) -> QueueStats:
+    B, L = f.shape
+    if L % LANE:
+        raise ValueError(f"L={L} must be a multiple of {LANE}")
+    if value_space % TILE_V:
+        raise ValueError(f"V={value_space} must be a multiple of {TILE_V}")
+    Lr = L // LANE
+    shape3 = (B, Lr, LANE)
+    in_spec = pl.BlockSpec(
+        (1, Lr, LANE), lambda b, j: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    out = pl.pallas_call(
+        _fused_kernel,
+        grid=(B, value_space // TILE_V),
+        in_specs=[in_spec] * 4,
+        out_specs=pl.BlockSpec(
+            (1, _N_STATS, TILE_V),
+            lambda b, j: (b, 0, j),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, _N_STATS, value_space), jnp.int32),
+        interpret=interpret,
+    )(
+        f.reshape(shape3),
+        type_.reshape(shape3),
+        value.reshape(shape3),
+        mask_i32.reshape(shape3),
+    )
+    return QueueStats(
+        a=out[:, 0],
+        e=out[:, 1],
+        x=out[:, 2],
+        d=out[:, 3],
+        s=out[:, 4],
+        t=out[:, 5],
+    )
+
+
+def fused_queue_stats(
+    packed: PackedHistories, interpret: bool | None = None
+) -> QueueStats:
+    """One-pass fused stats for a packed batch.  ``interpret`` defaults to
+    True off-TPU (Pallas interpreter) and False on TPU (Mosaic)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _fused_queue_stats(
+        packed.f,
+        packed.type,
+        packed.value,
+        packed.mask.astype(jnp.int32),
+        packed.value_space,
+        interpret,
+    )

@@ -94,6 +94,21 @@ def test_incompatible_order():
     r = both(ops)
     assert not r["valid?"]
     assert r["incompatible-order"] == {0}
+    # the contradicting read's content is unreliable — it must not
+    # fabricate dependency cycles
+    assert r["G1c"] == set() and r["G2"] == set()
+
+
+def test_tensor_valid_folds_host_anomalies():
+    from jepsen_tpu.checkers.elle import (
+        elle_tensor_check,
+        infer_txn_graph,
+        pack_txn_graphs,
+    )
+
+    sh = synth_elle_history(ElleSynthSpec(n_txns=60, seed=48, g1a=1))
+    t = elle_tensor_check(pack_txn_graphs([infer_txn_graph(sh.ops)]))
+    assert not bool(t.valid[0])  # no cycle, but G1a must invalidate
 
 
 def test_own_intermediate_read_is_legal():

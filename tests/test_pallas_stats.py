@@ -1,0 +1,67 @@
+"""Fused Pallas stats kernel ≡ scatter path (interpret mode on the CPU mesh)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.fused import fused_tensor_check
+from jepsen_tpu.checkers.queue_lin import queue_lin_tensor_check
+from jepsen_tpu.checkers.total_queue import total_queue_tensor_check
+from jepsen_tpu.history.encode import pack_histories
+from jepsen_tpu.history.synth import SynthSpec, synth_batch
+from jepsen_tpu.ops.pallas_stats import fused_queue_stats
+
+
+def _packed(**overrides):
+    shs = synth_batch(4, SynthSpec(n_ops=200), **overrides)
+    return pack_histories([sh.ops for sh in shs])
+
+
+def assert_tree_equal(x, y):
+    for k in x.__dataclass_fields__:
+        a, b = np.asarray(getattr(x, k)), np.asarray(getattr(y, k))
+        np.testing.assert_array_equal(a, b, err_msg=k)
+
+
+@pytest.mark.parametrize(
+    "anomalies",
+    [
+        {},
+        {"lost": 2},
+        {"duplicated": 1},
+        {"unexpected": 1},
+        {"phantom_fail": 1},
+        {"causality": 1},
+    ],
+)
+def test_fused_equals_scatter_path(anomalies):
+    packed = _packed(**anomalies)
+    tq_f, ql_f = fused_tensor_check(packed, interpret=True)
+    tq_s = total_queue_tensor_check(packed)
+    ql_s = queue_lin_tensor_check(packed)
+    assert_tree_equal(tq_f, tq_s)
+    assert_tree_equal(ql_f, ql_s)
+
+
+def test_fused_stats_shapes_and_padding():
+    packed = _packed()
+    st = fused_queue_stats(packed, interpret=True)
+    V = packed.value_space
+    assert st.a.shape == (packed.batch, V)
+    # padded rows (mask=0) must contribute nothing: total attempts equal
+    # the per-history live enqueue-invoke rows
+    f = np.asarray(packed.f)
+    t = np.asarray(packed.type)
+    m = np.asarray(packed.mask)
+    v = np.asarray(packed.value)
+    want = ((f == 0) & (t == 0) & m & (v >= 0)).sum(axis=1)
+    np.testing.assert_array_equal(np.asarray(st.a).sum(axis=1), want)
+
+
+def test_fused_non_default_tile():
+    # a small non-default L still packs into whole 128-row chunks
+    shs = synth_batch(2, SynthSpec(n_ops=40))
+    packed = pack_histories([sh.ops for sh in shs], length=128)
+    tq_f, ql_f = fused_tensor_check(packed, interpret=True)
+    assert_tree_equal(tq_f, total_queue_tensor_check(packed))
+    assert_tree_equal(ql_f, queue_lin_tensor_check(packed))

@@ -73,7 +73,7 @@ _NEG = -(2**31)
 # at offset 0, read to the end) — the drain analog.  Loss judgment is armed
 # only when such a read *completes ok*: an aborted full read observed
 # nothing, so unread acked appends are merely unread, not lost.
-FULL_READ = "full"
+from jepsen_tpu.history.ops import FULL_READ  # noqa: E402,F401 — canonical home
 
 
 def _is_pair(x: Any) -> bool:

@@ -302,6 +302,13 @@ def cmd_test(args) -> int:
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
+    if args.db == "rabbitmq" and args.workload != "queue":
+        print(
+            f"error: the live {args.workload!r} workload needs stream/tx "
+            "support in the native AMQP driver; use --db sim meanwhile",
+            file=sys.stderr,
+        )
+        return 2
     if args.db == "rabbitmq":
         test = build_rabbitmq_test(
             opts=opts,
@@ -311,6 +318,7 @@ def cmd_test(args) -> int:
             store_root=args.store,
             ssh_user=args.ssh_user,
             ssh_private_key=args.ssh_private_key,
+            workload=args.workload,
         )
     else:
         test, _cluster = build_sim_test(
@@ -319,6 +327,7 @@ def cmd_test(args) -> int:
             concurrency=args.concurrency,
             checker_backend=args.checker,
             store_root=args.store,
+            workload=args.workload,
         )
     run = run_test(test)
     print(json.dumps(run.results, indent=1, default=_json_default))
@@ -519,6 +528,13 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--nodes", default="n1,n2,n3", help="comma-separated nodes")
     t.add_argument("--concurrency", type=int, default=5)
     t.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
+    t.add_argument(
+        "--workload",
+        choices=("queue", "stream", "elle"),
+        default="queue",
+        help="test program: quorum-queue (reference), stream append/read, "
+        "or elle list-append transactions",
+    )
     t.add_argument("--store", default="store")
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
     # the reference's cli-opts (rabbitmq.clj:288-327)

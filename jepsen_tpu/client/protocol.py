@@ -21,7 +21,7 @@ from __future__ import annotations
 import abc
 from typing import Any, Mapping, Sequence
 
-from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.history.ops import FULL_READ, Op, OpF, OpType
 
 
 class DriverTimeout(Exception):
@@ -73,6 +73,26 @@ class Client(abc.ABC):
     def teardown(self, test: Mapping[str, Any]) -> None: ...
 
 
+def _guard(driver, op: Op, apply, indeterminate: bool) -> Op:
+    """Shared error mapping for every driver-backed client: a timeout is
+    ``info`` for indeterminate ops (writes whose effect is unknown —
+    ``rabbitmq.clj:197-200``) and ``fail`` for safe ones; any other driver
+    error fails the op after a best-effort reconnect
+    (``rabbitmq.clj:210-213``)."""
+    try:
+        return apply()
+    except DriverTimeout:
+        return op.complete(
+            OpType.INFO if indeterminate else OpType.FAIL, error="timeout"
+        )
+    except Exception as e:  # noqa: BLE001 — any driver error fails the op
+        try:
+            driver.reconnect()
+        except Exception:  # noqa: BLE001 — reconnect best-effort
+            pass
+        return op.complete(OpType.FAIL, error=f"{type(e).__name__}: {e}")
+
+
 class QueueClient(Client):
     """The reference's queue client over any :class:`QueueDriver`."""
 
@@ -99,7 +119,8 @@ class QueueClient(Client):
     def invoke(self, test, op: Op) -> Op:
         d = self.driver
         assert d is not None
-        try:
+
+        def apply() -> Op:
             if op.f == OpF.ENQUEUE:
                 ok = d.enqueue(op.value, self.publish_confirm_timeout_s)
                 return op.complete(OpType.OK if ok else OpType.FAIL)
@@ -111,17 +132,159 @@ class QueueClient(Client):
             if op.f == OpF.DRAIN:
                 return op.complete(OpType.OK, value=d.drain())
             raise ValueError(f"unknown client op {op.f}")
-        except DriverTimeout:
-            if op.f == OpF.ENQUEUE:
-                # indeterminate: the publish may have been committed
-                return op.complete(OpType.INFO, error="timeout")
-            return op.complete(OpType.FAIL, error="timeout")
-        except Exception as e:  # noqa: BLE001 — any driver error fails the op
-            try:
-                d.reconnect()
-            except Exception:  # noqa: BLE001 — reconnect best-effort
-                pass
-            return op.complete(OpType.FAIL, error=f"{type(e).__name__}: {e}")
+
+        return _guard(d, op, apply, indeterminate=op.f == OpF.ENQUEUE)
+
+    def close(self, test):
+        if self.driver is not None:
+            self.driver.close()
+
+
+class StreamDriver(abc.ABC):
+    """Driver ABI for the stream workload (single-partition append-only
+    log — RabbitMQ ``x-queue-type: stream`` semantics, BASELINE config #4).
+    Reads are non-destructive: any consumer can re-read any offset."""
+
+    @abc.abstractmethod
+    def setup(self) -> None: ...
+
+    @abc.abstractmethod
+    def append(self, value: int, timeout_s: float) -> bool:
+        """Publish + wait for confirm; raises DriverTimeout when unknown."""
+
+    @abc.abstractmethod
+    def read_from(self, offset: int, max_n: int, timeout_s: float) -> list:
+        """Up to ``max_n`` ``(offset, value)`` records starting at
+        ``offset``; empty list when nothing is committed there yet."""
+
+    @abc.abstractmethod
+    def reconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class StreamClient(Client):
+    """Stream client: appends like enqueues (indeterminate on timeout);
+    reads attach at the client's cursor and advance it; a ``FULL_READ``
+    invocation re-reads the whole log from offset 0 (the drain analog)."""
+
+    def __init__(
+        self,
+        driver_factory,
+        publish_confirm_timeout_s: float = 5.0,
+        read_timeout_s: float = 5.0,
+        read_batch: int = 8,
+    ):
+        self.driver_factory = driver_factory
+        self.publish_confirm_timeout_s = publish_confirm_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.read_batch = read_batch
+        self.driver: StreamDriver | None = None
+        self.cursor = 0
+
+    def open(self, test, node):
+        c = StreamClient(
+            self.driver_factory,
+            self.publish_confirm_timeout_s,
+            self.read_timeout_s,
+            self.read_batch,
+        )
+        c.driver = self.driver_factory(test, node)
+        return c
+
+    def setup(self, test):
+        assert self.driver is not None
+        self.driver.setup()
+
+    def invoke(self, test, op: Op) -> Op:
+        d = self.driver
+        assert d is not None
+
+        def apply() -> Op:
+            if op.f == OpF.APPEND:
+                ok = d.append(op.value, self.publish_confirm_timeout_s)
+                return op.complete(OpType.OK if ok else OpType.FAIL)
+            if op.f == OpF.READ:
+                if op.value == FULL_READ:
+                    # offsets need not be dense (chunk boundaries,
+                    # retention): advance by last offset + 1, never count
+                    pairs: list = []
+                    nxt = 0
+                    while True:
+                        batch = d.read_from(nxt, 4096, self.read_timeout_s)
+                        if not batch:
+                            break
+                        pairs.extend([list(p) for p in batch])
+                        nxt = batch[-1][0] + 1
+                    return op.complete(OpType.OK, value=pairs)
+                batch = d.read_from(
+                    self.cursor, self.read_batch, self.read_timeout_s
+                )
+                if not batch:
+                    return op.complete(OpType.FAIL, error="empty")
+                self.cursor = batch[-1][0] + 1
+                return op.complete(
+                    OpType.OK, value=[list(p) for p in batch]
+                )
+            raise ValueError(f"unknown client op {op.f}")
+
+        return _guard(d, op, apply, indeterminate=op.f == OpF.APPEND)
+
+    def close(self, test):
+        if self.driver is not None:
+            self.driver.close()
+
+
+class TxnDriver(abc.ABC):
+    """Driver ABI for the transactional (Elle list-append) workload
+    (BASELINE config #5: transactions over AMQP tx)."""
+
+    @abc.abstractmethod
+    def setup(self) -> None: ...
+
+    @abc.abstractmethod
+    def txn(self, micro_ops: list, timeout_s: float) -> list:
+        """Execute ``[["append", k, v] | ["r", k, None], ...]`` atomically;
+        returns the completed micro-ops (reads carry observed lists).
+        Raises DriverTimeout when the commit outcome is unknown."""
+
+    @abc.abstractmethod
+    def reconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class TxnClient(Client):
+    """Transaction client: the whole txn commits or fails as a unit; a
+    commit timeout is indeterminate (``info``), like a publish confirm."""
+
+    def __init__(self, driver_factory, txn_timeout_s: float = 5.0):
+        self.driver_factory = driver_factory
+        self.txn_timeout_s = txn_timeout_s
+        self.driver: TxnDriver | None = None
+
+    def open(self, test, node):
+        c = TxnClient(self.driver_factory, self.txn_timeout_s)
+        c.driver = self.driver_factory(test, node)
+        return c
+
+    def setup(self, test):
+        assert self.driver is not None
+        self.driver.setup()
+
+    def invoke(self, test, op: Op) -> Op:
+        d = self.driver
+        assert d is not None
+
+        def apply() -> Op:
+            if op.f == OpF.TXN:
+                done = d.txn(op.value, self.txn_timeout_s)
+                return op.complete(OpType.OK, value=done)
+            raise ValueError(f"unknown client op {op.f}")
+
+        return _guard(d, op, apply, indeterminate=True)
 
     def close(self, test):
         if self.driver is not None:

@@ -31,7 +31,12 @@ import random
 import threading
 from typing import Any, Mapping, Sequence
 
-from jepsen_tpu.client.protocol import DriverTimeout, QueueDriver
+from jepsen_tpu.client.protocol import (
+    DriverTimeout,
+    QueueDriver,
+    StreamDriver,
+    TxnDriver,
+)
 
 
 class SimCluster:
@@ -41,6 +46,8 @@ class SimCluster:
         seed: int = 0,
         drop_acked_every: int = 0,
         duplicate_every: int = 0,
+        drop_appended_every: int = 0,
+        duplicate_append_every: int = 0,
     ):
         self.nodes = list(nodes)
         self.lock = threading.Lock()
@@ -51,6 +58,13 @@ class SimCluster:
         self.duplicate_every = duplicate_every
         self._acked = 0
         self._delivered = 0
+        # stream (append-only log) state — BASELINE config #4
+        self.log: list[int] = []
+        self.drop_appended_every = drop_appended_every
+        self.duplicate_append_every = duplicate_append_every
+        self._appended = 0
+        # transactional kv-of-lists state — BASELINE config #5
+        self.kv: dict[int, list[int]] = {}
 
     # ---- network control (driven by the nemesis via SimNet) --------------
     def set_blocked(self, blocked: set[frozenset[str]]) -> None:
@@ -120,6 +134,67 @@ class SimCluster:
         with self.lock:
             return len(self.queue)
 
+    # ---- stream ops (single-partition append-only log) --------------------
+    def stream_append(self, node: str, value: int) -> bool:
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.5:  # confirm lost, commit happened
+                    self._log_commit(value)
+                raise DriverTimeout("append confirm timed out (minority)")
+            self._log_commit(value)
+            return True
+
+    def _log_commit(self, value: int) -> None:
+        self._appended += 1
+        if (
+            self.drop_appended_every
+            and self._appended % self.drop_appended_every == 0
+        ):
+            return  # injected data-loss bug: confirmed but never in the log
+        self.log.append(value)
+        if (
+            self.duplicate_append_every
+            and self._appended % self.duplicate_append_every == 0
+        ):
+            self.log.append(value)  # injected duplicate materialization
+
+    def stream_read(self, node: str, offset: int, max_n: int) -> list:
+        with self.lock:
+            if not self._has_majority(node):
+                raise DriverTimeout("stream read timed out (minority)")
+            return [
+                [o, self.log[o]]
+                for o in range(offset, min(offset + max_n, len(self.log)))
+            ]
+
+    # ---- transactional ops (kv of lists, list-append) ----------------------
+    def txn(self, node: str, micro_ops: list) -> list:
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.5:  # committed, outcome unseen
+                    self._txn_apply(micro_ops)
+                raise DriverTimeout("txn commit timed out (minority)")
+            # execute atomically: reads see committed state plus this
+            # txn's own earlier appends
+            done = []
+            staged: dict[int, list[int]] = {}
+            for m in micro_ops:
+                kind, k = m[0], m[1]
+                if kind == "append":
+                    staged.setdefault(k, []).append(m[2])
+                    done.append(["append", k, m[2]])
+                else:
+                    vs = list(self.kv.get(k, [])) + staged.get(k, [])
+                    done.append(["r", k, vs])
+            for k, vs in staged.items():
+                self.kv.setdefault(k, []).extend(vs)
+            return done
+
+    def _txn_apply(self, micro_ops: list) -> None:
+        for m in micro_ops:
+            if m[0] == "append":
+                self.kv.setdefault(m[1], []).append(m[2])
+
 
 class SimQueueDriver(QueueDriver):
     """Driver ABI over :class:`SimCluster` — the sim twin of the native
@@ -151,5 +226,62 @@ class SimQueueDriver(QueueDriver):
 def sim_driver_factory(cluster: SimCluster):
     def factory(test: Mapping[str, Any], node: str) -> SimQueueDriver:
         return SimQueueDriver(cluster, node)
+
+    return factory
+
+
+class SimStreamDriver(StreamDriver):
+    """Stream-driver ABI over :class:`SimCluster`."""
+
+    def __init__(self, cluster: SimCluster, node: str):
+        self.cluster = cluster
+        self.node = node
+
+    def setup(self) -> None:
+        pass
+
+    def append(self, value: int, timeout_s: float) -> bool:
+        return self.cluster.stream_append(self.node, value)
+
+    def read_from(self, offset: int, max_n: int, timeout_s: float) -> list:
+        return self.cluster.stream_read(self.node, offset, max_n)
+
+    def reconnect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def sim_stream_driver_factory(cluster: SimCluster):
+    def factory(test: Mapping[str, Any], node: str) -> SimStreamDriver:
+        return SimStreamDriver(cluster, node)
+
+    return factory
+
+
+class SimTxnDriver(TxnDriver):
+    """Txn-driver ABI over :class:`SimCluster`."""
+
+    def __init__(self, cluster: SimCluster, node: str):
+        self.cluster = cluster
+        self.node = node
+
+    def setup(self) -> None:
+        pass
+
+    def txn(self, micro_ops: list, timeout_s: float) -> list:
+        return self.cluster.txn(self.node, micro_ops)
+
+    def reconnect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def sim_txn_driver_factory(cluster: SimCluster):
+    def factory(test: Mapping[str, Any], node: str) -> SimTxnDriver:
+        return SimTxnDriver(cluster, node)
 
     return factory

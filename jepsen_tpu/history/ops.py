@@ -29,6 +29,11 @@ from typing import Any, Iterable, Sequence
 NO_VALUE = -1  # packed-tensor sentinel for "no value" (nil)
 NEMESIS_PROCESS = -1
 
+# A READ invocation whose value is FULL_READ re-reads the whole stream from
+# offset 0 (the stream workload's drain analog); loss is only judged when
+# one completes ok (see jepsen_tpu.checkers.stream_lin).
+FULL_READ = "full"
+
 
 class OpType(enum.IntEnum):
     """Op lifecycle phase.  Integer codes are the packed-tensor encoding."""

@@ -45,7 +45,7 @@ from jepsen_tpu.generators.core import (
     Sleep,
     TimeLimit,
 )
-from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.history.ops import FULL_READ, Op, OpF, OpType
 
 DEFAULT_ARCHIVE_URL = (
     "https://github.com/rabbitmq/rabbitmq-server/releases/download/"
@@ -69,14 +69,11 @@ DEFAULT_OPTS: dict[str, Any] = {
 }
 
 
-def queue_generator(opts: Mapping[str, Any]):
-    """The four-phase generator program (``rabbitmq.clj:267-284``)."""
-    counter = itertools.count()
-    enqueue = FnGen(
-        lambda ctx: Op.invoke(OpF.ENQUEUE, ctx.process, next(counter))
-    )
-    dequeue = FnGen(lambda ctx: Op.invoke(OpF.DEQUEUE, ctx.process))
-
+def _four_phase(opts: Mapping[str, Any], load, final_read_factory):
+    """The shared four-phase choreography (``rabbitmq.clj:267-284``):
+    rate-limited load under the nemesis cycle → heal → recovery sleep →
+    one final read per thread.  ``load`` is the client op generator;
+    ``final_read_factory()`` builds each thread's phase-4 generator."""
     nemesis_cycle = Cycle(
         lambda: [
             Sleep(opts["time-before-partition"]),
@@ -86,10 +83,7 @@ def queue_generator(opts: Mapping[str, Any]):
         ]
     )
     phase_load = TimeLimit(
-        NemesisRoute(
-            nemesis_cycle,
-            Delay(Mix([enqueue, dequeue]), 1.0 / opts["rate"]),
-        ),
+        NemesisRoute(nemesis_cycle, Delay(load, 1.0 / opts["rate"])),
         opts["time-limit"],
     )
     return Phases(
@@ -98,8 +92,20 @@ def queue_generator(opts: Mapping[str, Any]):
             NemesisOnly(Once(OpGen(OpF.STOP, OpType.INFO))),
             Log("waiting for recovery"),
             Sleep(opts["recovery-sleep"]),
-            Clients(EachThread(lambda: Once(OpGen(OpF.DRAIN)))),
+            Clients(EachThread(lambda: Once(final_read_factory()))),
         ]
+    )
+
+
+def queue_generator(opts: Mapping[str, Any]):
+    """The four-phase generator program (``rabbitmq.clj:267-284``)."""
+    counter = itertools.count()
+    enqueue = FnGen(
+        lambda ctx: Op.invoke(OpF.ENQUEUE, ctx.process, next(counter))
+    )
+    dequeue = FnGen(lambda ctx: Op.invoke(OpF.DEQUEUE, ctx.process))
+    return _four_phase(
+        opts, Mix([enqueue, dequeue]), lambda: OpGen(OpF.DRAIN)
     )
 
 
@@ -119,6 +125,74 @@ def queue_checker(
     return compose(checkers)
 
 
+def stream_generator(opts: Mapping[str, Any]):
+    """Stream workload program: rate-limited append/read mix under the
+    nemesis cycle, heal, recovery sleep, then one full read per thread
+    (the stream drain analog)."""
+    counter = itertools.count()
+    append = FnGen(
+        lambda ctx: Op.invoke(OpF.APPEND, ctx.process, next(counter))
+    )
+    read = FnGen(lambda ctx: Op.invoke(OpF.READ, ctx.process))
+    return _four_phase(
+        opts,
+        Mix([append, append, read]),
+        lambda: FnGen(
+            lambda ctx: Op.invoke(OpF.READ, ctx.process, FULL_READ)
+        ),
+    )
+
+
+def stream_checker(backend: str = "tpu", with_perf: bool = True):
+    from jepsen_tpu.checkers.stream_lin import StreamLinearizability
+
+    checkers = {"stream": StreamLinearizability(backend=backend)}
+    if with_perf:
+        checkers["perf"] = Perf()
+    return compose(checkers)
+
+
+def elle_generator(opts: Mapping[str, Any], n_keys: int = 8, seed: int = 0):
+    """Transactional workload program: rate-limited random list-append
+    transactions (1–4 micro-ops over ``n_keys`` keys, globally unique
+    append values) under the nemesis cycle, then heal + a final read-only
+    txn per thread so every key's final order is observed."""
+    import random as _random
+
+    from jepsen_tpu.checkers.elle import APPEND, READ
+
+    counter = itertools.count()
+    rng = _random.Random(seed)
+
+    def gen_txn(ctx):
+        mops = []
+        for _ in range(rng.randint(1, 4)):
+            k = rng.randrange(n_keys)
+            if rng.random() < 0.5:
+                mops.append([APPEND, k, next(counter)])
+            else:
+                mops.append([READ, k, None])
+        return Op.invoke(OpF.TXN, ctx.process, mops)
+
+    def gen_final_read(ctx):
+        return Op.invoke(
+            OpF.TXN, ctx.process, [[READ, k, None] for k in range(n_keys)]
+        )
+
+    return _four_phase(
+        opts, FnGen(gen_txn), lambda: FnGen(gen_final_read)
+    )
+
+
+def elle_checker(backend: str = "tpu", with_perf: bool = True):
+    from jepsen_tpu.checkers.elle import ElleListAppend
+
+    checkers = {"elle": ElleListAppend(backend=backend)}
+    if with_perf:
+        checkers["perf"] = Perf()
+    return compose(checkers)
+
+
 def build_sim_test(
     opts: Mapping[str, Any] | None = None,
     nodes=("n1", "n2", "n3"),
@@ -127,29 +201,64 @@ def build_sim_test(
     sim_seed: int = 0,
     drop_acked_every: int = 0,
     duplicate_every: int = 0,
+    drop_appended_every: int = 0,
+    duplicate_append_every: int = 0,
     store_root: str = "store",
+    workload: str = "queue",
 ) -> tuple[Test, SimCluster]:
-    """The reference test wired to the in-process simulator."""
+    """The reference test wired to the in-process simulator.  ``workload``
+    selects the queue (reference active path), stream (config #4), or
+    elle transactional (config #5) program."""
+    from jepsen_tpu.client.protocol import StreamClient, TxnClient
+    from jepsen_tpu.client.sim import (
+        sim_stream_driver_factory,
+        sim_txn_driver_factory,
+    )
+
     o = {**DEFAULT_OPTS, **(opts or {})}
     cluster = SimCluster(
         nodes,
         seed=sim_seed,
         drop_acked_every=drop_acked_every,
         duplicate_every=duplicate_every,
+        drop_appended_every=drop_appended_every,
+        duplicate_append_every=duplicate_append_every,
     )
     nemesis = PartitionNemesis(
         o["network-partition"], SimNet(cluster), nodes, seed=sim_seed
     )
-    client = QueueClient(
-        sim_driver_factory(cluster),
-        publish_confirm_timeout_s=o["publish-confirm-timeout"],
-    )
+    if workload == "stream":
+        client = StreamClient(
+            sim_stream_driver_factory(cluster),
+            publish_confirm_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = stream_generator(o)
+        checker = stream_checker(checker_backend)
+        name = "rabbitmq-stream-partition-sim"
+    elif workload == "elle":
+        client = TxnClient(
+            sim_txn_driver_factory(cluster),
+            txn_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = elle_generator(o, seed=sim_seed)
+        checker = elle_checker(checker_backend)
+        name = "rabbitmq-elle-txn-sim"
+    elif workload == "queue":
+        client = QueueClient(
+            sim_driver_factory(cluster),
+            publish_confirm_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = queue_generator(o)
+        checker = queue_checker(checker_backend)
+        name = "rabbitmq-simple-partition-sim"
+    else:
+        raise ValueError(f"unknown workload {workload!r}")
     test = Test(
-        name="rabbitmq-simple-partition-sim",
+        name=name,
         nodes=list(nodes),
         client=client,
-        generator=queue_generator(o),
-        checker=queue_checker(checker_backend),
+        generator=generator,
+        checker=checker,
         db=DB(),
         nemesis=nemesis,
         concurrency=concurrency,
@@ -168,9 +277,15 @@ def build_rabbitmq_test(
     ssh_user: str = "root",
     ssh_private_key: str | None = None,
     transport=None,
+    workload: str = "queue",
 ) -> Test:
     """The reference test against a real RabbitMQ cluster: SSH DB
     lifecycle, iptables partitions, native C++ AMQP clients."""
+    if workload != "queue":
+        raise NotImplementedError(
+            f"the live {workload!r} workload needs stream/tx support in the "
+            "native AMQP driver; use --db sim (in-process) meanwhile"
+        )
     from jepsen_tpu.client.native import native_driver_factory
     from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
     from jepsen_tpu.control.net import IptablesNet

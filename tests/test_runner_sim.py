@@ -148,3 +148,76 @@ def test_time_limit_clamps_nemesis_sleep():
         Ctx(time=0, thread=NEMESIS_PROCESS, process=-1, n_threads=1)
     )
     assert isinstance(got, Pending) and got.wake == int(1e9)
+
+
+# ---------------------------------------------------------------------------
+# Stream workload (BASELINE config #4) through the live pipeline
+# ---------------------------------------------------------------------------
+
+
+def _run_stream(tmp_path, **kw):
+    from jepsen_tpu.suite import build_sim_test
+
+    test, cluster = build_sim_test(
+        opts=FAST_OPTS,
+        store_root=str(tmp_path / "store"),
+        workload="stream",
+        **kw,
+    )
+    return run_test(test), cluster
+
+
+def test_stream_healthy_cluster_is_valid(tmp_path):
+    run, cluster = _run_stream(tmp_path)
+    assert run.results["stream"]["valid?"], run.results["stream"]
+    assert run.valid
+    assert run.results["stream"]["full-read"]
+    assert run.results["stream"]["attempt-count"] > 0
+
+
+def test_stream_partition_bites(tmp_path):
+    # the partition must actually block minority clients: some append or
+    # read times out (appends indeterminate, reads fail)
+    run, _ = _run_stream(tmp_path)
+    timeouts = [
+        op
+        for op in run.history
+        if op.f in (OpF.APPEND, OpF.READ)
+        and op.type in (OpType.INFO, OpType.FAIL)
+        and op.error == "timeout"
+    ]
+    assert timeouts, "no client op timed out under the partition"
+
+
+def test_stream_lossy_broker_detected(tmp_path):
+    run, _ = _run_stream(tmp_path, drop_appended_every=7)
+    assert not run.results["stream"]["valid?"]
+    assert run.results["stream"]["lost-count"] >= 1
+
+
+def test_stream_duplicating_broker_detected(tmp_path):
+    run, _ = _run_stream(tmp_path, duplicate_append_every=7)
+    assert not run.results["stream"]["valid?"]
+    assert run.results["stream"]["duplicate-count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Elle transactional workload (BASELINE config #5) through the live pipeline
+# ---------------------------------------------------------------------------
+
+
+def test_elle_healthy_cluster_is_serializable(tmp_path):
+    from jepsen_tpu.suite import build_sim_test
+
+    test, _cluster = build_sim_test(
+        opts=FAST_OPTS,
+        store_root=str(tmp_path / "store"),
+        workload="elle",
+    )
+    run = run_test(test)
+    assert run.results["elle"]["valid?"], run.results["elle"]
+    assert run.valid
+    assert run.results["elle"]["txn-count"] > 0
+    # the final read-only txns give every key an observed order, so the
+    # dependency graph is non-trivial
+    assert run.results["elle"]["ww-edges"] > 0

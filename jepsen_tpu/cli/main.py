@@ -302,24 +302,21 @@ def cmd_test(args) -> int:
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
-    if args.db == "rabbitmq" and args.workload != "queue":
-        print(
-            f"error: the live {args.workload!r} workload needs stream/tx "
-            "support in the native AMQP driver; use --db sim meanwhile",
-            file=sys.stderr,
-        )
-        return 2
     if args.db == "rabbitmq":
-        test = build_rabbitmq_test(
-            opts=opts,
-            nodes=args.nodes.split(","),
-            concurrency=args.concurrency,
-            checker_backend=args.checker,
-            store_root=args.store,
-            ssh_user=args.ssh_user,
-            ssh_private_key=args.ssh_private_key,
-            workload=args.workload,
-        )
+        try:
+            test = build_rabbitmq_test(
+                opts=opts,
+                nodes=args.nodes.split(","),
+                concurrency=args.concurrency,
+                checker_backend=args.checker,
+                store_root=args.store,
+                ssh_user=args.ssh_user,
+                ssh_private_key=args.ssh_private_key,
+                workload=args.workload,
+            )
+        except NotImplementedError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     else:
         test, _cluster = build_sim_test(
             opts=opts,

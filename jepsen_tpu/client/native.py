@@ -15,7 +15,11 @@ import os
 from pathlib import Path
 from typing import Any, Mapping, Sequence
 
-from jepsen_tpu.client.protocol import DriverTimeout, QueueDriver
+from jepsen_tpu.client.protocol import (
+    DriverTimeout,
+    QueueDriver,
+    StreamDriver,
+)
 
 _LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libamqp_driver.so"
 
@@ -62,6 +66,31 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
     lib.amqp_client_destroy.argtypes = [ctypes.c_void_p]
     lib.amqp_reset.argtypes = [ctypes.c_int]
     lib.amqp_set_logging.argtypes = [ctypes.c_int]
+    lib.amqp_stream_client_create.restype = ctypes.c_void_p
+    lib.amqp_stream_client_create.argtypes = [
+        ctypes.c_char_p,  # host
+        ctypes.c_int,  # port
+        ctypes.c_char_p,  # user
+        ctypes.c_char_p,  # pass
+        ctypes.c_int,  # connect retry ms
+    ]
+    lib.amqp_stream_client_setup.argtypes = [ctypes.c_void_p]
+    lib.amqp_stream_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.amqp_stream_read_from.restype = ctypes.c_long
+    lib.amqp_stream_read_from.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_longlong,  # offset
+        ctypes.c_long,  # max_n
+        ctypes.c_int,  # timeout ms
+        ctypes.POINTER(ctypes.c_longlong),  # offsets out
+        ctypes.POINTER(ctypes.c_int),  # values out
+        ctypes.c_long,  # cap
+    ]
+    lib.amqp_stream_reconnect.argtypes = [ctypes.c_void_p]
+    lib.amqp_stream_close.argtypes = [ctypes.c_void_p]
+    lib.amqp_stream_destroy.argtypes = [ctypes.c_void_p]
     if path is None:
         _lib = lib
     return lib
@@ -150,6 +179,74 @@ class NativeQueueDriver(QueueDriver):
     def close(self) -> None:
         if self.handle:
             self.lib.amqp_client_close(self.handle)
+
+
+class NativeStreamDriver(StreamDriver):
+    """One AMQP stream client bound to one node (``x-queue-type: stream``,
+    offset reads via the ``x-stream-offset`` consume argument)."""
+
+    READ_CAP = 65536
+
+    def __init__(
+        self,
+        node: str,
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        connect_retry_ms: int = 30000,
+    ):
+        self.lib = load_library()
+        self.handle = self.lib.amqp_stream_client_create(
+            node.encode(), port, user.encode(), password.encode(),
+            connect_retry_ms,
+        )
+        if not self.handle:
+            raise ConnectionError(f"amqp_stream_client_create failed for {node}")
+
+    def setup(self) -> None:
+        if self.lib.amqp_stream_client_setup(self.handle) != 0:
+            raise ConnectionError("stream setup failed")
+
+    def append(self, value: int, timeout_s: float) -> bool:
+        r = self.lib.amqp_stream_append(
+            self.handle, value, int(timeout_s * 1000)
+        )
+        if r == 1:
+            return True
+        if r == 0:
+            return False
+        if r == -1:
+            raise DriverTimeout("append confirm timeout")
+        raise ConnectionError("append failed (connection error)")
+
+    def read_from(self, offset: int, max_n: int, timeout_s: float) -> list:
+        n_cap = min(max_n, self.READ_CAP)
+        offs = (ctypes.c_longlong * n_cap)()
+        vals = (ctypes.c_int * n_cap)()
+        n = self.lib.amqp_stream_read_from(
+            self.handle, offset, n_cap, int(timeout_s * 1000),
+            offs, vals, n_cap,
+        )
+        if n < 0:
+            raise ConnectionError("stream read failed (connection error)")
+        return [[int(offs[i]), int(vals[i])] for i in range(n)]
+
+    def reconnect(self) -> None:
+        if self.lib.amqp_stream_reconnect(self.handle) != 0:
+            raise ConnectionError("reconnect failed")
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.amqp_stream_close(self.handle)
+
+
+def native_stream_driver_factory(port: int = 5672, **kw: Any):
+    """Factory for :class:`StreamClient`: ``(test, node) -> driver``."""
+
+    def factory(test: Mapping[str, Any], node: str) -> NativeStreamDriver:
+        return NativeStreamDriver(node, port=port, **kw)
+
+    return factory
 
 
 def native_driver_factory(

@@ -281,12 +281,16 @@ def build_rabbitmq_test(
 ) -> Test:
     """The reference test against a real RabbitMQ cluster: SSH DB
     lifecycle, iptables partitions, native C++ AMQP clients."""
-    if workload != "queue":
+    if workload == "elle":
         raise NotImplementedError(
-            f"the live {workload!r} workload needs stream/tx support in the "
-            "native AMQP driver; use --db sim (in-process) meanwhile"
+            "the live elle workload needs AMQP-tx support in the native "
+            "driver; use --db sim (in-process) meanwhile"
         )
-    from jepsen_tpu.client.native import native_driver_factory
+    from jepsen_tpu.client.native import (
+        native_driver_factory,
+        native_stream_driver_factory,
+    )
+    from jepsen_tpu.client.protocol import StreamClient
     from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
     from jepsen_tpu.control.net import IptablesNet
     from jepsen_tpu.control.ssh import SshTransport
@@ -299,16 +303,30 @@ def build_rabbitmq_test(
     nemesis = PartitionNemesis(
         o["network-partition"], IptablesNet(transport, nodes), nodes
     )
-    client = QueueClient(
-        native_driver_factory(list(nodes)),
-        publish_confirm_timeout_s=o["publish-confirm-timeout"],
-    )
+    if workload == "stream":
+        client = StreamClient(
+            native_stream_driver_factory(),
+            publish_confirm_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = stream_generator(o)
+        checker = stream_checker(checker_backend)
+        name = "rabbitmq-stream-partition"
+    elif workload == "queue":
+        client = QueueClient(
+            native_driver_factory(list(nodes)),
+            publish_confirm_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = queue_generator(o)
+        checker = queue_checker(checker_backend)
+        name = "rabbitmq-simple-partition"
+    else:
+        raise ValueError(f"unknown workload {workload!r}")
     return Test(
-        name="rabbitmq-simple-partition",
+        name=name,
         nodes=list(nodes),
         client=client,
-        generator=queue_generator(o),
-        checker=queue_checker(checker_backend),
+        generator=generator,
+        checker=checker,
         db=db,
         nemesis=nemesis,
         concurrency=concurrency,

@@ -72,9 +72,37 @@ class _Reader:
         self.off += n
         return v
 
-    def table(self):
+    def table(self) -> dict:
+        """Parse a field table into a dict (the subset of types the driver
+        emits; unknown types abort parsing by skipping to the end)."""
         n = self.u32()
-        self.off += n  # contents ignored — queue args don't matter in-memory
+        end = self.off + n
+        out: dict = {}
+        try:
+            while self.off < end:
+                key = self.shortstr()
+                t = bytes([self.u8()])
+                if t == b"S":
+                    ln = self.u32()
+                    out[key] = self.data[self.off : self.off + ln].decode()
+                    self.off += ln
+                elif t == b"I":
+                    out[key] = struct.unpack(
+                        ">i", self.data[self.off : self.off + 4]
+                    )[0]
+                    self.off += 4
+                elif t == b"l":
+                    out[key] = struct.unpack(
+                        ">q", self.data[self.off : self.off + 8]
+                    )[0]
+                    self.off += 8
+                elif t == b"t":
+                    out[key] = bool(self.u8())
+                else:
+                    break  # unknown type: stop parsing, skip the rest
+        finally:
+            self.off = end
+        return out
 
     def rest(self):
         return self.data[self.off :]
@@ -105,17 +133,23 @@ class MiniAmqpBroker:
         drop_confirms: bool = False,
         lose_acked_every: int = 0,
         duplicate_every: int = 0,
+        lose_appended_every: int = 0,
+        duplicate_append_every: int = 0,
     ):
         self.host = host
         self._server = socket.create_server((host, port))
         self.port = self._server.getsockname()[1]
         self.queues: dict[str, deque] = {}
+        self.streams: dict[str, list] = {}  # x-queue-type=stream → log
         self.state_lock = threading.Lock()
         self.drop_confirms = drop_confirms
         self.lose_acked_every = lose_acked_every
         self.duplicate_every = duplicate_every
+        self.lose_appended_every = lose_appended_every
+        self.duplicate_append_every = duplicate_append_every
         self._published = 0
         self._delivered = 0
+        self._appended = 0
         self._conns: list[_ConnState] = []
         self._accept_thread: threading.Thread | None = None
         self._running = False
@@ -146,6 +180,10 @@ class MiniAmqpBroker:
     def queue_depth(self, name: str = "jepsen.queue") -> int:
         with self.state_lock:
             return len(self.queues.get(name, ()))
+
+    def stream_depth(self, name: str = "jepsen.stream") -> int:
+        with self.state_lock:
+            return len(self.streams.get(name, ()))
 
     # ---- internals -------------------------------------------------------
     def _accept_loop(self):
@@ -254,8 +292,13 @@ class MiniAmqpBroker:
                 elif cls == 50 and mth == 10:  # Queue.Declare
                     r.u16()
                     qname = r.shortstr()
+                    r.u8()  # durable/exclusive/... bit flags
+                    qargs = r.table()
                     with self.state_lock:
-                        self.queues.setdefault(qname, deque())
+                        if qargs.get("x-queue-type") == "stream":
+                            self.streams.setdefault(qname, [])
+                        else:
+                            self.queues.setdefault(qname, deque())
                     self._send_method(
                         conn,
                         ch,
@@ -287,9 +330,19 @@ class MiniAmqpBroker:
                 elif cls == 60 and mth == 20:  # Basic.Consume
                     r.u16()
                     qname = r.shortstr()
-                    conn.consuming_queue = qname
-                    self._send_method(conn, ch, 60, 21, _shortstr("ctag-1"))
-                    self._try_deliver(conn, ch)
+                    ctag = r.shortstr() or "ctag-1"
+                    r.u8()  # no-local/no-ack/exclusive/no-wait bits
+                    cargs = r.table()
+                    self._send_method(conn, ch, 60, 21, _shortstr(ctag))
+                    if qname in self.streams:
+                        offset = int(cargs.get("x-stream-offset", 0))
+                        self._stream_deliver(conn, ch, qname, offset, ctag)
+                    else:
+                        conn.consuming_queue = qname
+                        self._try_deliver(conn, ch)
+                elif cls == 60 and mth == 30:  # Basic.Cancel
+                    ctag = r.shortstr()
+                    self._send_method(conn, ch, 60, 31, _shortstr(ctag))
                 elif cls == 60 and mth == 80:  # Basic.Ack (client)
                     tag = r.u64()
                     with self.state_lock:
@@ -341,16 +394,30 @@ class MiniAmqpBroker:
 
     def _finish_publish(self, conn: _ConnState, queue: str, body: bytes):
         conn.publish_seq += 1
-        lose = False
         with self.state_lock:
-            self._published += 1
-            if (
-                self.lose_acked_every
-                and self._published % self.lose_acked_every == 0
-            ):
-                lose = True  # confirm but drop: injected data loss
-            if not lose:
-                self.queues.setdefault(queue, deque()).append(_Message(body))
+            if queue in self.streams:
+                self._appended += 1
+                lose = (
+                    self.lose_appended_every
+                    and self._appended % self.lose_appended_every == 0
+                )
+                if not lose:
+                    self.streams[queue].append(body)
+                    if (
+                        self.duplicate_append_every
+                        and self._appended % self.duplicate_append_every == 0
+                    ):
+                        self.streams[queue].append(body)
+            else:
+                self._published += 1
+                lose = (
+                    self.lose_acked_every
+                    and self._published % self.lose_acked_every == 0
+                )
+                if not lose:  # confirm-but-drop = injected data loss
+                    self.queues.setdefault(queue, deque()).append(
+                        _Message(body)
+                    )
         if conn.confirms and not self.drop_confirms:
             self._send_method(
                 conn, 1, 60, 80, struct.pack(">QB", conn.publish_seq, 0)
@@ -421,6 +488,37 @@ class MiniAmqpBroker:
             + _shortstr(conn.consuming_queue)
         )
         self._content_frames(conn, ch, msg.value, method)
+
+    def _stream_deliver(
+        self, conn: _ConnState, ch: int, qname: str, offset: int, ctag: str
+    ):
+        """Non-destructive snapshot delivery from ``offset``; each record
+        carries its log offset in the x-stream-offset message header."""
+        with self.state_lock:
+            snapshot = list(enumerate(self.streams.get(qname, ())))[offset:]
+        for off, body in snapshot:
+            with self.state_lock:
+                tag = conn.next_tag
+                conn.next_tag += 1  # stream acks are credit-only: untracked
+            method = (
+                struct.pack(">HH", 60, 60)
+                + _shortstr(ctag)
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(qname)
+            )
+            self._send_frame(conn, FRAME_METHOD, ch, method)
+            table = (
+                _shortstr("x-stream-offset") + b"l" + struct.pack(">q", off)
+            )
+            header = (
+                struct.pack(">HHQH", 60, 0, len(body), 0x2000)
+                + struct.pack(">I", len(table))
+                + table
+            )
+            self._send_frame(conn, FRAME_HEADER, ch, header)
+            if body:
+                self._send_frame(conn, FRAME_BODY, ch, body)
 
     def _deliver_all(self):
         with self.state_lock:

@@ -147,6 +147,7 @@ class Socket {
 struct Delivery {
   uint64_t tag;
   int32_t value;
+  int64_t offset = -1;  // stream log offset (x-stream-offset header)
 };
 
 class Connection {
@@ -378,11 +379,13 @@ class Connection {
   }
 
   // ---- consumer ----------------------------------------------------------
-  bool start_consumer(const std::string& queue) {
+  bool start_consumer(const std::string& queue, int prefetch = 1,
+                      const amqp::Table* args = nullptr,
+                      const std::string& tag = "") {
     {
       auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_QOS);
       w.u32(0);
-      w.u16(1);  // prefetch 1 (Utils.java:540)
+      w.u16(static_cast<uint16_t>(prefetch));  // (Utils.java:540)
       w.u8(0);
       amqp::Frame f;
       if (!rpc(w, amqp::CLS_BASIC, amqp::M_B_QOS_OK, &f, 5000)) return false;
@@ -390,12 +393,22 @@ class Connection {
     auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_CONSUME);
     w.u16(0);
     w.shortstr(queue);
-    w.shortstr("");  // server-assigned tag
-    w.u8(0);         // no-local=0 no-ack=0 exclusive=0 no-wait=0
-    amqp::Table t;
-    t.serialize(w);
+    w.shortstr(tag);  // empty = server-assigned
+    w.u8(0);          // no-local=0 no-ack=0 exclusive=0 no-wait=0
+    if (args)
+      args->serialize(w);
+    else
+      amqp::Table().serialize(w);
     amqp::Frame f;
     return rpc(w, amqp::CLS_BASIC, amqp::M_B_CONSUME_OK, &f, 5000);
+  }
+
+  bool cancel_consumer(const std::string& tag) {
+    auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_CANCEL);
+    w.shortstr(tag);
+    w.u8(0);  // no-wait=0
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_BASIC, amqp::M_B_CANCEL_OK, &f, 5000);
   }
 
   // pop one delivery; 1 = got, -1 = timeout, -2 = error
@@ -493,6 +506,7 @@ class Connection {
     // pending content state (deliver / get-ok)
     ContentFor pending = ContentFor::NONE;
     uint64_t pending_tag = 0;
+    int64_t pending_offset = -1;
     std::string body_acc;
     uint64_t body_expected = 0;
 
@@ -545,15 +559,18 @@ class Connection {
           rd.u16();
           body_expected = rd.u64();
           body_acc.clear();
-          if (body_expected == 0) finish_content(pending, pending_tag, "");
-          if (body_expected == 0) pending = ContentFor::NONE;
+          pending_offset = amqp::header_stream_offset(f.payload);
+          if (body_expected == 0) {
+            finish_content(pending, pending_tag, "", pending_offset);
+            pending = ContentFor::NONE;
+          }
           continue;
         }
         if (f.type == amqp::FRAME_BODY) {
           body_acc.append(reinterpret_cast<char*>(f.payload.data()),
                           f.payload.size());
           if (body_acc.size() >= body_expected) {
-            finish_content(pending, pending_tag, body_acc);
+            finish_content(pending, pending_tag, body_acc, pending_offset);
             pending = ContentFor::NONE;
           }
           continue;
@@ -640,7 +657,7 @@ class Connection {
   }
 
   void finish_content(ContentFor pending_kind, uint64_t tag,
-                      const std::string& body) {
+                      const std::string& body, int64_t offset = -1) {
     int32_t value = -1;
     try {
       if (!body.empty()) value = std::stoi(body);
@@ -649,7 +666,7 @@ class Connection {
     }
     std::lock_guard<std::mutex> lk(state_mu_);
     if (pending_kind == ContentFor::DELIVER) {
-      deliveries_.push_back({tag, value});
+      deliveries_.push_back({tag, value, offset});
     } else if (pending_kind == ContentFor::GET) {
       if (get_result_pending_) {
         get_value_ = value;
@@ -889,6 +906,153 @@ class Client {
   bool async_ = false;
 };
 
+// ---------------------------------------------------------------------------
+// Stream client (BASELINE config #4): append-only log over AMQP 0-9-1 —
+// x-queue-type=stream declaration, confirmed appends, and non-destructive
+// offset reads via basic.consume with the x-stream-offset argument; each
+// delivery's log offset arrives in the x-stream-offset message header.
+// ---------------------------------------------------------------------------
+
+constexpr const char* STREAM_QUEUE_NAME = "jepsen.stream";
+constexpr const char* STREAM_CONSUMER_TAG = "jt-stream-reader";
+bool g_stream_declared = false;  // once-latch, like g_queues_declared
+
+class StreamClient {
+ public:
+  explicit StreamClient(ClientConfig cfg) : cfg_(std::move(cfg)) {}
+
+  bool connect() {
+    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
+    while (Clock::now() < deadline) {
+      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
+                                               cfg_.user, cfg_.pass);
+      if (conn->open(5000)) {
+        std::lock_guard<std::mutex> lk(mu_);
+        conn_ = conn;
+        initialized_ = false;
+        return true;
+      }
+      std::this_thread::sleep_for(milliseconds(1000));
+    }
+    logf("stream connect to %s: retry budget exhausted", cfg_.host.c_str());
+    return false;
+  }
+
+  bool initialize_if_necessary() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      if (!c) return false;
+      if (initialized_) return c->alive();
+    }
+    try {
+      {
+        std::lock_guard<std::mutex> lk(g_registry_mu);
+        if (!g_stream_declared) {
+          amqp::Table args;
+          args.put_str("x-queue-type", "stream");
+          if (!c->declare_queue(STREAM_QUEUE_NAME, args))
+            throw std::runtime_error("stream declare failed");
+          // streams cannot be purged; a fresh run uses reset() + a fresh
+          // broker (CI tears clusters down between runs)
+          g_stream_declared = true;
+        }
+      }
+      c->enable_confirms();
+    } catch (const std::exception& e) {
+      logf("stream initialize on %s failed: %s", cfg_.host.c_str(), e.what());
+      return false;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    initialized_ = true;
+    return true;
+  }
+
+  // 1 ok, 0 nack, -1 timeout, -2 error
+  int append(int32_t value, int timeout_ms) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    return c->publish_confirm(STREAM_QUEUE_NAME, value, timeout_ms);
+  }
+
+  // Read up to max_n records from `offset`: attach a consumer at the
+  // offset, collect deliveries until max_n / overall deadline / a quiet
+  // window after the last delivery (the log end has no explicit marker
+  // over AMQP), then cancel.  Returns the count (≥0) or -2 on error.
+  long read_from(int64_t offset, long max_n, int timeout_ms,
+                 int64_t* offsets_out, int32_t* values_out, long cap) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    c->clear_deliveries();
+    amqp::Table args;
+    args.put_long("x-stream-offset", offset);
+    int prefetch = static_cast<int>(std::min<long>(max_n, 1000));
+    if (!c->start_consumer(STREAM_QUEUE_NAME, prefetch, &args,
+                           STREAM_CONSUMER_TAG))
+      return -2;
+    long n = 0;
+    int64_t next_implicit = offset;  // fallback when no offset header
+    auto deadline = Clock::now() + milliseconds(timeout_ms);
+    const int quiet_ms = 250;
+    while (n < max_n && n < cap) {
+      auto now = Clock::now();
+      if (now >= deadline) break;
+      int wait_ms = static_cast<int>(
+          std::chrono::duration_cast<milliseconds>(deadline - now).count());
+      if (n > 0) wait_ms = std::min(wait_ms, quiet_ms);
+      Delivery d;
+      int r = c->pop_delivery(&d, wait_ms);
+      if (r == 1) {
+        c->basic_ack(d.tag);
+        int64_t off = d.offset >= 0 ? d.offset : next_implicit;
+        next_implicit = off + 1;
+        if (off >= offset) {  // broker may round down to a chunk boundary
+          offsets_out[n] = off;
+          values_out[n] = d.value;
+          ++n;
+        }
+      } else if (r == -1) {
+        break;  // deadline or quiet window elapsed
+      } else {
+        c->cancel_consumer(STREAM_CONSUMER_TAG);
+        return n > 0 ? n : -2;
+      }
+    }
+    c->cancel_consumer(STREAM_CONSUMER_TAG);
+    c->clear_deliveries();
+    return n;
+  }
+
+  void close_connection() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      conn_.reset();
+      initialized_ = false;
+    }
+    if (c) c->close();
+  }
+
+  bool reconnect() {
+    close_connection();
+    return connect();
+  }
+
+ private:
+  std::shared_ptr<Connection> conn() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return conn_;
+  }
+  ClientConfig cfg_;
+  std::mutex mu_;
+  std::shared_ptr<Connection> conn_;
+  bool initialized_ = false;
+};
+
 // drain: the correctness-critical final read (Utils.java:413-470)
 long drain_impl(Client* self, int32_t* out, long cap) {
   {
@@ -1018,12 +1182,59 @@ void amqp_client_destroy(void* p) {
   delete c;
 }
 
+// ---- stream client ABI ----------------------------------------------------
+
+void* amqp_stream_client_create(const char* host, int port, const char* user,
+                                const char* pass, int connect_retry_ms) {
+  ClientConfig cfg;
+  cfg.host = host ? host : "localhost";
+  cfg.port = port;
+  if (user) cfg.user = user;
+  if (pass) cfg.pass = pass;
+  if (connect_retry_ms > 0) cfg.connect_retry_ms = connect_retry_ms;
+  auto* c = new StreamClient(std::move(cfg));
+  if (!c->connect())
+    logf("initial stream connect failed for %s", host ? host : "?");
+  return c;
+}
+
+int amqp_stream_client_setup(void* p) {
+  return static_cast<StreamClient*>(p)->initialize_if_necessary() ? 0 : -1;
+}
+
+int amqp_stream_append(void* p, int value, int timeout_ms) {
+  return static_cast<StreamClient*>(p)->append(value, timeout_ms);
+}
+
+long amqp_stream_read_from(void* p, long long offset, long max_n,
+                           int timeout_ms, long long* offsets_out,
+                           int* values_out, long cap) {
+  return static_cast<StreamClient*>(p)->read_from(
+      offset, max_n, timeout_ms,
+      reinterpret_cast<int64_t*>(offsets_out), values_out, cap);
+}
+
+int amqp_stream_reconnect(void* p) {
+  return static_cast<StreamClient*>(p)->reconnect() ? 0 : -1;
+}
+
+void amqp_stream_close(void* p) {
+  static_cast<StreamClient*>(p)->close_connection();
+}
+
+void amqp_stream_destroy(void* p) {
+  auto* c = static_cast<StreamClient*>(p);
+  c->close_connection();
+  delete c;
+}
+
 // test support (= Utils.reset(), Utils.java:147-152)
 void amqp_reset(int drain_wait_ms) {
   std::lock_guard<std::mutex> lk(g_registry_mu);
   g_clients.clear();
   g_hosts.clear();
   g_queues_declared = false;
+  g_stream_declared = false;
   g_drained = false;
   g_drain_done = false;
   g_drain_result.clear();

@@ -38,7 +38,8 @@ constexpr uint16_t M_Q_DECLARE = 10, M_Q_DECLARE_OK = 11, M_Q_PURGE = 30,
                    M_Q_PURGE_OK = 31, M_Q_DELETE = 40, M_Q_DELETE_OK = 41;
 constexpr uint16_t CLS_BASIC = 60;
 constexpr uint16_t M_B_QOS = 10, M_B_QOS_OK = 11, M_B_CONSUME = 20,
-                   M_B_CONSUME_OK = 21, M_B_PUBLISH = 40, M_B_RETURN = 50,
+                   M_B_CONSUME_OK = 21, M_B_CANCEL = 30, M_B_CANCEL_OK = 31,
+                   M_B_PUBLISH = 40, M_B_RETURN = 50,
                    M_B_DELIVER = 60, M_B_GET = 70, M_B_GET_OK = 71,
                    M_B_GET_EMPTY = 72, M_B_ACK = 80, M_B_REJECT = 90,
                    M_B_NACK = 120;
@@ -93,6 +94,12 @@ struct Table {
     w.shortstr(k);
     w.u8('t');
     w.u8(v ? 1 : 0);
+    return *this;
+  }
+  Table& put_long(const std::string& k, int64_t v) {
+    w.shortstr(k);
+    w.u8('l');
+    w.u64(static_cast<uint64_t>(v));
     return *this;
   }
   void serialize(Writer& out) const {
@@ -188,6 +195,51 @@ inline std::vector<uint8_t> content_header(uint64_t body_size) {
   w.u16(0x1000);      // property flags: delivery-mode present
   w.u8(2);            // delivery-mode = persistent
   return w.buf;
+}
+
+// skip one field-table value by its type octet (RabbitMQ's field grammar)
+inline void skip_field_value(Reader& r, uint8_t type) {
+  switch (type) {
+    case 't': case 'b': case 'B': r.u8(); break;
+    case 's': case 'u': r.u16(); break;
+    case 'I': case 'i': case 'f': r.u32(); break;
+    case 'l': case 'd': case 'T': r.u64(); break;
+    case 'D': r.u8(); r.u32(); break;
+    case 'S': case 'x': r.longstr(); break;
+    case 'F': case 'A': r.skip_table(); break;
+    case 'V': break;
+    default: throw std::runtime_error("unknown table field type");
+  }
+}
+
+// Parse a basic content header and return the long value of the
+// `x-stream-offset` message header (RabbitMQ streams deliver each record's
+// log offset this way over AMQP 0-9-1), or -1 when absent.
+inline int64_t header_stream_offset(const std::vector<uint8_t>& payload) {
+  try {
+    Reader r(payload.data(), payload.size());
+    r.u16();  // class
+    r.u16();  // weight
+    r.u64();  // body size
+    uint16_t flags = r.u16();
+    if (flags & 0x8000) r.shortstr();  // content-type
+    if (flags & 0x4000) r.shortstr();  // content-encoding
+    if (!(flags & 0x2000)) return -1;  // no headers table
+    uint32_t len = r.u32();
+    size_t end = r.off + len;
+    while (r.off < end) {
+      std::string key = r.shortstr();
+      uint8_t type = r.u8();
+      if (key == "x-stream-offset" && (type == 'l' || type == 'T'))
+        return static_cast<int64_t>(r.u64());
+      if (key == "x-stream-offset" && (type == 'I' || type == 'i'))
+        return static_cast<int64_t>(static_cast<int32_t>(r.u32()));
+      skip_field_value(r, type);
+    }
+  } catch (const std::exception&) {
+    return -1;
+  }
+  return -1;
 }
 
 }  // namespace amqp

@@ -175,3 +175,94 @@ def test_full_run_native_driver_lossy_broker_caught(native_lib):
         assert q["lost-count"] >= 1
     finally:
         b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Stream client (x-queue-type=stream over AMQP 0-9-1) — BASELINE config #4
+# ---------------------------------------------------------------------------
+
+
+def _stream_driver(native_lib, broker, **kw):
+    kw.setdefault("connect_retry_ms", 3000)
+    return native_lib.NativeStreamDriver("127.0.0.1", port=broker.port, **kw)
+
+
+def test_stream_append_read_roundtrip(native_lib, broker):
+    d = _stream_driver(native_lib, broker)
+    d.setup()
+    for v in (10, 11, 12):
+        assert d.append(v, 5.0) is True
+    got = d.read_from(0, 10, 2.0)
+    assert got == [[0, 10], [1, 11], [2, 12]]
+    d.close()
+
+
+def test_stream_reads_are_non_destructive(native_lib, broker):
+    d = _stream_driver(native_lib, broker)
+    d.setup()
+    for v in range(5):
+        assert d.append(v, 5.0) is True
+    first = d.read_from(0, 10, 2.0)
+    again = d.read_from(0, 10, 2.0)
+    assert first == again == [[o, o] for o in range(5)]
+    assert broker.stream_depth() == 5  # nothing consumed
+
+
+def test_stream_offset_attach(native_lib, broker):
+    d = _stream_driver(native_lib, broker)
+    d.setup()
+    for v in range(6):
+        assert d.append(v, 5.0) is True
+    got = d.read_from(3, 10, 2.0)
+    assert got == [[3, 3], [4, 4], [5, 5]]
+    got = d.read_from(2, 2, 2.0)  # max_n caps the batch
+    assert got == [[2, 2], [3, 3]]
+
+
+def test_stream_empty_read(native_lib, broker):
+    d = _stream_driver(native_lib, broker)
+    d.setup()
+    assert d.read_from(0, 10, 1.0) == []
+
+
+def test_stream_two_clients_share_the_log(native_lib, broker):
+    a = _stream_driver(native_lib, broker)
+    b = _stream_driver(native_lib, broker)
+    a.setup()
+    b.setup()
+    assert a.append(1, 5.0) is True
+    assert b.append(2, 5.0) is True
+    assert a.read_from(0, 10, 2.0) == b.read_from(0, 10, 2.0)
+
+
+def test_stream_full_pipeline_lossy_broker_caught(native_lib):
+    """End-to-end: StreamClient + native driver + lossy fake broker →
+    the stream checker must report the lost append."""
+    from jepsen_tpu.checkers.stream_lin import check_stream_lin_batch
+    from jepsen_tpu.client.native import native_stream_driver_factory
+    from jepsen_tpu.client.protocol import StreamClient
+    from jepsen_tpu.history.ops import FULL_READ, Op, OpF, reindex
+    from jepsen_tpu.testing.broker import MiniAmqpBroker
+
+    b = MiniAmqpBroker(lose_appended_every=5).start()
+    try:
+        client = StreamClient(
+            native_stream_driver_factory(port=b.port),
+            publish_confirm_timeout_s=2.0,
+            read_timeout_s=2.0,
+        ).open({}, "127.0.0.1")
+        client.setup({})
+        history = []
+        for i in range(12):
+            inv = Op.invoke(OpF.APPEND, 0, i)
+            history.append(inv)
+            history.append(client.invoke({}, inv))
+        inv = Op.invoke(OpF.READ, 0, FULL_READ)
+        history.append(inv)
+        history.append(client.invoke({}, inv))
+        client.close({})
+        r = check_stream_lin_batch([reindex(history)])[0]
+        assert not r["valid?"]
+        assert r["lost-count"] == 2  # appends 5 and 10 dropped
+    finally:
+        b.stop()

@@ -6,6 +6,8 @@ from jepsen_tpu.parallel.mesh import (  # noqa: F401
     checker_mesh,
     shard_packed,
     sharded_check,
+    sharded_elle,
     sharded_queue_lin,
+    sharded_stream_lin,
     sharded_total_queue,
 )

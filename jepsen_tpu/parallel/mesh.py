@@ -167,3 +167,37 @@ def sharded_check(
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
     """The full per-history verdict (both checkers) over the mesh."""
     return sharded_total_queue(packed, mesh), sharded_queue_lin(packed, mesh)
+
+
+# ---------------------------------------------------------------------------
+# Stream and elle checkers: data-parallel over `hist` only.  Each history is
+# independent, so placing the batch axis on the mesh and jitting lets XLA
+# partition with zero communication — no shard_map needed.  (Their classify
+# stages scan *within* a history — suffix-min over offsets, adjacent-row
+# monotonicity, matmul closure — so the op/txn axes don't shard freely the
+# way the count kernels above do; `hist` is the scaling axis that matters:
+# the north-star workload is millions of independent histories.)
+# ---------------------------------------------------------------------------
+
+
+def _hist_sharded(tree, mesh: Mesh):
+    def put(x):
+        spec = P(HIST_AXIS, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(put, tree)
+
+
+def sharded_stream_lin(batch, mesh: Mesh):
+    """Stream-log linearizability, histories sharded over ``hist``."""
+    from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
+
+    return stream_lin_tensor_check(_hist_sharded(batch, mesh))
+
+
+def sharded_elle(batch, mesh: Mesh):
+    """Elle cycle search, histories (and their [T, T] adjacency matrices)
+    sharded over ``hist``; the MXU closure matmuls stay device-local."""
+    from jepsen_tpu.checkers.elle import elle_tensor_check
+
+    return elle_tensor_check(_hist_sharded(batch, mesh))

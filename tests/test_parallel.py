@@ -52,3 +52,49 @@ def test_mesh_shapes(cpu_devices):
     assert m.shape == {"hist": 4, "seq": 2}
     m1 = checker_mesh(cpu_devices)
     assert m1.shape == {"hist": 8, "seq": 1}
+
+
+def test_sharded_stream_lin_matches_single_device(cpu_devices):
+    from jepsen_tpu.checkers.stream_lin import (
+        pack_stream_histories,
+        stream_lin_tensor_check,
+    )
+    from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
+    from jepsen_tpu.parallel import checker_mesh, sharded_stream_lin
+
+    shs = synth_stream_batch(8, StreamSynthSpec(n_ops=60), lost=1)
+    batch = pack_stream_histories([sh.ops for sh in shs])
+    mesh = checker_mesh(cpu_devices)
+    sharded = sharded_stream_lin(batch, mesh)
+    local = stream_lin_tensor_check(batch)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.valid), np.asarray(local.valid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.lost), np.asarray(local.lost)
+    )
+    assert not np.asarray(sharded.valid).any()  # every history lost a value
+
+
+def test_sharded_elle_matches_single_device(cpu_devices):
+    from jepsen_tpu.checkers.elle import (
+        elle_tensor_check,
+        infer_txn_graph,
+        pack_txn_graphs,
+    )
+    from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+    from jepsen_tpu.parallel import checker_mesh, sharded_elle
+
+    shs = synth_elle_batch(4, ElleSynthSpec(n_txns=40))
+    shs += synth_elle_batch(4, ElleSynthSpec(n_txns=40, seed=70), g2_cycle=1)
+    batch = pack_txn_graphs([infer_txn_graph(sh.ops) for sh in shs])
+    mesh = checker_mesh(cpu_devices)
+    sharded = sharded_elle(batch, mesh)
+    local = elle_tensor_check(batch)
+    np.testing.assert_array_equal(
+        np.asarray(sharded.valid), np.asarray(local.valid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(sharded.g2), np.asarray(local.g2)
+    )
+    assert list(np.asarray(sharded.valid)) == [True] * 4 + [False] * 4

@@ -198,6 +198,13 @@ def check_stream_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 
+# the wire/array field names of a packed StreamBatch (sidecar protocol and
+# any other host↔device marshalling derive from this single list)
+STREAM_ARRAYS = (
+    "type", "f", "value", "offset", "pos", "mask", "first", "full_read",
+)
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class StreamBatch:

@@ -22,6 +22,16 @@ _SET_KEYS = frozenset(
         "duplicate",
         "phantom",
         "causality",
+        # stream family
+        "divergent",
+        "reorder",
+        # elle family
+        "G0",
+        "G1c",
+        "G2",
+        "G1a",
+        "G1b",
+        "incompatible-order",
     }
 )
 
@@ -91,3 +101,31 @@ class CheckerClient:
             histories, length=length, value_space=value_space
         )
         return self.check_packed(packed)
+
+    def check_stream_histories(
+        self,
+        histories: Sequence[Sequence[Op]],
+        length: int | None = None,
+        space: int | None = None,
+    ) -> list[dict[str, Any]]:
+        from jepsen_tpu.checkers.stream_lin import (
+            STREAM_ARRAYS,
+            pack_stream_histories,
+        )
+
+        batch = pack_stream_histories(histories, length=length, space=space)
+        arrays = {k: np.asarray(getattr(batch, k)) for k in STREAM_ARRAYS}
+        reply, _ = self._call(
+            {"op": "check-stream", "space": batch.space}, arrays
+        )
+        return [_desetted(r) for r in reply["results"]]
+
+    def check_elle_histories(
+        self, histories: Sequence[Sequence[Op]]
+    ) -> list[dict[str, Any]]:
+        header = {
+            "op": "check-elle",
+            "histories": [[op.to_json() for op in h] for h in histories],
+        }
+        reply, _ = self._call(header)
+        return [_desetted(r) for r in reply["results"]]

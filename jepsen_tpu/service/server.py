@@ -12,6 +12,11 @@ Ops:
 - ``check`` → arrays ``f``/``type``/``value``/``mask`` of shape ``[B, L]``
   + ``value_space`` → per-history ``total-queue`` and queue-linearizability
   verdicts
+- ``check-stream`` → the packed stream columns + ``space`` → per-history
+  stream-log linearizability verdicts
+- ``check-elle`` → histories as op JSON in the header (edge inference is
+  a host-side parse; the server runs it next to the device) → per-history
+  Elle serializability verdicts
 """
 
 from __future__ import annotations
@@ -92,6 +97,82 @@ def _jsonable(d: dict[str, Any]) -> dict[str, Any]:
     }
 
 
+def _prepare_stream_batch(arrays: dict[str, np.ndarray], space: int):
+    """Host-side reconstruction of a StreamBatch (no device lock needed)."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.stream_lin import STREAM_ARRAYS, StreamBatch
+
+    missing = [k for k in STREAM_ARRAYS if k not in arrays]
+    if missing:
+        raise ProtocolError(f"missing arrays: {missing}")
+    full_read = arrays["full_read"].astype(bool)
+    batch = StreamBatch(
+        type=jnp.asarray(arrays["type"], jnp.int32),
+        f=jnp.asarray(arrays["f"], jnp.int32),
+        value=jnp.asarray(arrays["value"], jnp.int32),
+        offset=jnp.asarray(arrays["offset"], jnp.int32),
+        pos=jnp.asarray(arrays["pos"], jnp.int32),
+        mask=jnp.asarray(arrays["mask"].astype(bool)),
+        first=jnp.asarray(arrays["first"].astype(bool)),
+        full_read=jnp.asarray(full_read),
+        space=space,
+    )
+    return batch, full_read
+
+
+def _stream_results(t, full_read) -> dict[str, Any]:
+    from jepsen_tpu.checkers.stream_lin import stream_lin_tensors_to_results
+
+    results = stream_lin_tensors_to_results(t, full_read.tolist())
+    return {
+        "op": "result",
+        "results": [
+            {"stream": _jsonable(r), "valid?": bool(r["valid?"])}
+            for r in results
+        ],
+    }
+
+
+def _prepare_elle_batch(histories_json: list):
+    """Host-side parse + edge inference + packing (the O(total ops) part —
+    runs outside the device lock)."""
+    from jepsen_tpu.checkers.elle import infer_txn_graph, pack_txn_graphs
+    from jepsen_tpu.history.ops import Op
+
+    if not isinstance(histories_json, list) or not histories_json:
+        raise ProtocolError("histories must be a non-empty list")
+    graphs = [
+        infer_txn_graph([Op.from_json(d) for d in history])
+        for history in histories_json
+    ]
+    return graphs, pack_txn_graphs(graphs)
+
+
+def _elle_results(graphs, t) -> dict[str, Any]:
+    from jepsen_tpu.checkers.elle import _classify
+
+    g0 = np.asarray(t.g0)
+    g1c = np.asarray(t.g1c)
+    g2 = np.asarray(t.g2)
+    results = [
+        _classify(
+            g,
+            set(np.nonzero(g0[b])[0].tolist()),
+            set(np.nonzero(g1c[b])[0].tolist()),
+            set(np.nonzero(g2[b])[0].tolist()),
+        )
+        for b, g in enumerate(graphs)
+    ]
+    return {
+        "op": "result",
+        "results": [
+            {"elle": _jsonable(r), "valid?": bool(r["valid?"])}
+            for r in results
+        ],
+    }
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         server: CheckerServer = self.server  # type: ignore[assignment]
@@ -142,6 +223,23 @@ class CheckerServer(socketserver.ThreadingTCPServer):
                 raise ProtocolError("value_space must be positive")
             with self._device_lock:
                 return _check_arrays(arrays, value_space)
+        if op == "check-stream":
+            space = int(header.get("space", 0))
+            if space <= 0:
+                raise ProtocolError("space must be positive")
+            from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
+
+            batch, full_read = _prepare_stream_batch(arrays, space)
+            with self._device_lock:
+                t = stream_lin_tensor_check(batch)
+            return _stream_results(t, full_read)
+        if op == "check-elle":
+            from jepsen_tpu.checkers.elle import elle_tensor_check
+
+            graphs, batch = _prepare_elle_batch(header.get("histories"))
+            with self._device_lock:
+                t = elle_tensor_check(batch)
+            return _elle_results(graphs, t)
         raise ProtocolError(f"unknown op {op!r}")
 
     def start_background(self) -> threading.Thread:

@@ -154,3 +154,40 @@ class TestDistributedHelpers:
         from jepsen_tpu.parallel.distributed import is_coordinator
 
         assert is_coordinator() is True
+
+
+class TestStreamAndElleOps:
+    def test_check_stream_roundtrip(self, client):
+        from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+        from jepsen_tpu.history.synth import (
+            StreamSynthSpec,
+            synth_stream_batch,
+        )
+
+        shs = synth_stream_batch(3, StreamSynthSpec(n_ops=80), lost=1)
+        results = client.check_stream_histories([sh.ops for sh in shs])
+        assert len(results) == 3
+        for sh, r in zip(shs, results):
+            assert not r["valid?"]
+            assert r["stream"] == check_stream_lin_cpu(sh.ops)
+
+    def test_check_elle_roundtrip(self, client):
+        from jepsen_tpu.checkers.elle import check_elle_cpu
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        shs = synth_elle_batch(2, ElleSynthSpec(n_txns=40))
+        shs += synth_elle_batch(
+            1, ElleSynthSpec(n_txns=40, seed=80), g1c_cycle=1
+        )
+        results = client.check_elle_histories([sh.ops for sh in shs])
+        assert [r["valid?"] for r in results] == [True, True, False]
+        for sh, r in zip(shs, results):
+            assert r["elle"] == check_elle_cpu(sh.ops)
+
+    def test_check_stream_requires_space(self, client):
+        with pytest.raises(RuntimeError, match="space"):
+            client._call({"op": "check-stream", "space": 0}, {})
+
+    def test_check_elle_requires_histories(self, client):
+        with pytest.raises(RuntimeError, match="histories"):
+            client._call({"op": "check-elle"})

@@ -30,6 +30,19 @@ shapes are static, so the whole search compiles to one XLA program.
 Indeterminate (``info``) ops follow Knossos semantics: they may linearize
 at any point after their invocation — they join every later event's
 candidate set — or never (no return event forces them).
+
+**Backend guidance (measured 2026-07)**: on the CPU backend the tensor
+engine compiles in seconds and matches the classic search exactly (the
+differential tests in ``tests/test_wgl.py``).  On the tunneled single-chip
+TPU environment this repo develops against, *compiling* this program (the
+``while_loop``-inside-``scan`` nest) took > 9 minutes even for 10-op
+histories — the remote-compile hop amplifies complex control flow — so
+``QueueWgl(backend="tpu")`` is correct but compile-bound there.  For the
+quorum-queue workload this doesn't matter in practice: the per-value
+decomposition (``jepsen_tpu.checkers.queue_lin``, P-compositionality) is
+the TPU-fast linearizability path and covers the model exactly; the WGL
+engine is the general-model fallback (CAS registers, mutexes, FIFO) where
+the CPU engine — or a TPU stack with local compilation — serves.
 """
 
 from __future__ import annotations

@@ -287,6 +287,55 @@ class Connection {
     confirms_on_ = true;
   }
 
+  // ---- tx class (AMQP 0-9-1 transactions) --------------------------------
+  bool tx_select(int timeout_ms = 5000) {
+    auto w = amqp::method_writer(amqp::CLS_TX, amqp::M_TX_SELECT);
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_TX, amqp::M_TX_SELECT_OK, &f, timeout_ms);
+  }
+
+  // 1 committed, -1 timeout (outcome unknown), -2 connection error
+  int tx_commit(int timeout_ms) {
+    auto w = amqp::method_writer(amqp::CLS_TX, amqp::M_TX_COMMIT);
+    amqp::Frame f;
+    {
+      std::lock_guard<std::mutex> slk(state_mu_);
+      if (closed_ || broken_) return -2;
+    }
+    if (rpc(w, amqp::CLS_TX, amqp::M_TX_COMMIT_OK, &f, timeout_ms)) return 1;
+    std::lock_guard<std::mutex> slk(state_mu_);
+    return (closed_ || broken_) ? -2 : -1;
+  }
+
+  bool tx_rollback(int timeout_ms = 5000) {
+    auto w = amqp::method_writer(amqp::CLS_TX, amqp::M_TX_ROLLBACK);
+    amqp::Frame f;
+    return rpc(w, amqp::CLS_TX, amqp::M_TX_ROLLBACK_OK, &f, timeout_ms);
+  }
+
+  // fire-and-forget publish (tx mode: outcome decided at tx.commit)
+  bool publish_plain(const std::string& queue, int32_t value) {
+    std::lock_guard<std::mutex> wlk(write_mu_);
+    if (closed_ || broken_) return false;
+    std::string body = std::to_string(value);
+    auto m = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_PUBLISH);
+    m.u16(0);
+    m.shortstr("");
+    m.shortstr(queue);
+    m.u8(0);  // not mandatory: tx routing errors surface at commit/close
+    amqp::Writer out;
+    amqp::serialize_frame(out, amqp::FRAME_METHOD, 1, m.buf);
+    amqp::serialize_frame(out, amqp::FRAME_HEADER, 1,
+                          amqp::content_header(body.size()));
+    std::vector<uint8_t> bodyv(body.begin(), body.end());
+    amqp::serialize_frame(out, amqp::FRAME_BODY, 1, bodyv);
+    if (!sock_.send_all(out.buf.data(), out.buf.size())) {
+      broken_ = true;
+      return false;
+    }
+    return true;
+  }
+
   // 1 confirmed, 0 nacked/returned, -1 timeout, -2 connection error
   int publish_confirm(const std::string& queue, int32_t value,
                       int timeout_ms) {

@@ -45,6 +45,10 @@ constexpr uint16_t M_B_QOS = 10, M_B_QOS_OK = 11, M_B_CONSUME = 20,
                    M_B_NACK = 120;
 constexpr uint16_t CLS_CONFIRM = 85;
 constexpr uint16_t M_CF_SELECT = 10, M_CF_SELECT_OK = 11;
+constexpr uint16_t CLS_TX = 90;
+constexpr uint16_t M_TX_SELECT = 10, M_TX_SELECT_OK = 11, M_TX_COMMIT = 20,
+                   M_TX_COMMIT_OK = 21, M_TX_ROLLBACK = 30,
+                   M_TX_ROLLBACK_OK = 31;
 
 // ---- buffer writer --------------------------------------------------------
 struct Writer {

@@ -6,12 +6,20 @@ each, checked with the combined TPU verdict (total-queue set reconciliation
 + per-value queue linearizability), ``jax.vmap``-batched.  A base set of
 distinct synthetic histories is packed host-side, tiled to the bench batch
 on device, and the steady-state check rate is measured over several timed
-iterations.
+iterations.  Secondary sections measure the stream (append-only log) and
+elle (list-append serializability) checker families on the same backend —
+BASELINE configs #4/#5 — reported as ``# stream:``/``# elle:`` stderr lines
+and in ``BENCH_DETAILS.json``.
 
 Baseline: the same verdict computed by the single-threaded CPU reference
 checkers (the stand-in for single-threaded Knossos/`checker/total-queue` —
 the reference publishes no numbers of its own, BASELINE.md).  Prints ONE
 JSON line: ``{"metric", "value", "unit", "vs_baseline"}``.
+
+Backend init is guarded: the first device use runs under a watchdog
+deadline with a bounded retry (transient `Unavailable` from a tunneled
+chip, or a hanging plugin init, must not silently kill the round's only
+perf artifact — the round-1 rc=1 failure mode).
 """
 
 from __future__ import annotations
@@ -20,20 +28,6 @@ import json
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
-from jepsen_tpu.checkers.queue_lin import (
-    check_queue_lin_cpu,
-    queue_lin_tensor_check,
-)
-from jepsen_tpu.checkers.total_queue import (
-    check_total_queue_cpu,
-    total_queue_tensor_check,
-)
-from jepsen_tpu.history.encode import PackedHistories, pack_histories
-from jepsen_tpu.history.synth import SynthSpec, synth_batch
-
 BASE_HISTORIES = 128  # distinct synthetic histories
 N_OPS = 470  # invocations per history → ~1000 packed rows with completions
 LENGTH = 1024  # packed rows per history ("1k-op histories")
@@ -41,21 +35,81 @@ TILE = 32  # device batch = BASE_HISTORIES * TILE
 TIMED_ITERS = 5
 CPU_BASELINE_SAMPLES = 6
 
+STREAM_BATCH = 4096  # stream histories per device batch
+STREAM_OPS = 200  # ops per stream history
+ELLE_BATCH = 8192  # txn graphs per device batch
+ELLE_TXNS = 64  # txns per graph
 
-def _tile(packed: PackedHistories, k: int) -> PackedHistories:
-    return jax.tree.map(
-        lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
+INIT_ATTEMPTS = 3
+INIT_PROBE_DEADLINE_S = 60.0
+INIT_RETRY_SLEEP_S = 20.0
+
+
+def _init_backend_with_retry() -> str:
+    """First device use under a deadline, retried a bounded number of
+    times.  Exits with a clear failure line if the backend never comes up
+    — never hangs the bench forever."""
+    import jax
+
+    from jepsen_tpu.utils.jaxenv import ensure_backend
+
+    last_err: Exception | None = None
+    for attempt in range(1, INIT_ATTEMPTS + 1):
+        try:
+            name = ensure_backend(deadline=INIT_PROBE_DEADLINE_S)
+            # a real transfer, not just device enumeration — `Unavailable`
+            # from a held/tunneled chip surfaces here
+            import jax.numpy as jnp
+
+            jax.block_until_ready(jax.device_put(jnp.arange(8)) + 1)
+            return name
+        except Exception as e:  # noqa: BLE001 - retried, then reported
+            last_err = e
+            print(
+                f"# backend init attempt {attempt}/{INIT_ATTEMPTS} failed: "
+                f"{type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            if attempt < INIT_ATTEMPTS:
+                time.sleep(INIT_RETRY_SLEEP_S)
+    print(
+        f"# BENCH FAILED: JAX backend unavailable after {INIT_ATTEMPTS} "
+        f"attempts ({INIT_PROBE_DEADLINE_S:.0f}s probe deadline each): "
+        f"{type(last_err).__name__}: {last_err}",
+        file=sys.stderr,
     )
+    sys.exit(1)
 
 
-def _check(packed: PackedHistories):
-    return (
-        total_queue_tensor_check(packed),
-        queue_lin_tensor_check(packed),
+def _timed_rate(fn, batch: int, iters: int = TIMED_ITERS):
+    """Best-of-N steady-state rate for an already-compiled device fn."""
+    import jax
+
+    times = []
+    for _ in range(iters):
+        t = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t)
+    dt = min(times)
+    return batch / dt, dt, sorted(times)[len(times) // 2]
+
+
+def _bench_queue(details: dict) -> tuple[float, float]:
+    """Headline: combined total-queue + queue-lin verdict @1k-op rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.queue_lin import (
+        check_queue_lin_cpu,
+        queue_lin_tensor_check,
     )
+    from jepsen_tpu.checkers.total_queue import (
+        check_total_queue_cpu,
+        total_queue_tensor_check,
+    )
+    from jepsen_tpu.history.encode import pack_histories
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
 
-
-def main() -> None:
     t0 = time.perf_counter()
     base = synth_batch(
         BASE_HISTORIES,
@@ -72,22 +126,22 @@ def main() -> None:
         file=sys.stderr,
     )
 
-    big = _tile(packed, TILE)
+    big = jax.tree.map(
+        lambda x: jnp.tile(x, (TILE,) + (1,) * (x.ndim - 1)), packed
+    )
     batch = big.batch
 
-    # warmup / compile
-    jax.block_until_ready(_check(big))
+    def check():
+        return (
+            total_queue_tensor_check(big),
+            queue_lin_tensor_check(big),
+        )
 
-    times = []
-    for _ in range(TIMED_ITERS):
-        t1 = time.perf_counter()
-        jax.block_until_ready(_check(big))
-        times.append(time.perf_counter() - t1)
-    dt = min(times)
-    rate = batch / dt
+    jax.block_until_ready(check())  # warmup / compile
+    rate, dt, med = _timed_rate(check, batch)
     print(
         f"# device check: batch={batch} best={dt * 1e3:.1f}ms "
-        f"median={sorted(times)[len(times) // 2] * 1e3:.1f}ms",
+        f"median={med * 1e3:.1f}ms",
         file=sys.stderr,
     )
 
@@ -103,6 +157,130 @@ def main() -> None:
         f"({cpu_rate:.1f} hist/s)",
         file=sys.stderr,
     )
+    details["queue"] = {
+        "batch": batch,
+        "length": LENGTH,
+        "device_histories_per_sec": round(rate, 1),
+        "device_best_ms": round(dt * 1e3, 2),
+        "cpu_histories_per_sec": round(cpu_rate, 2),
+        "speedup": round(rate / cpu_rate, 1),
+    }
+    return rate, cpu_rate
+
+
+def _bench_stream(details: dict) -> None:
+    """BASELINE config #4: stream (append-only log) linearizability."""
+    import jax
+
+    from jepsen_tpu.checkers.stream_lin import (
+        check_stream_lin_cpu,
+        pack_stream_histories,
+        stream_lin_tensor_check,
+    )
+    from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
+
+    base = synth_stream_batch(64, StreamSynthSpec(n_ops=STREAM_OPS))
+    packed = pack_stream_histories([sh.ops for sh in base])
+    import jax.numpy as jnp
+
+    k = STREAM_BATCH // packed.batch
+    big = jax.tree.map(
+        lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
+    )
+
+    def check():
+        return stream_lin_tensor_check(big)
+
+    jax.block_until_ready(check())
+    rate, dt, _ = _timed_rate(check, big.batch)
+
+    t = time.perf_counter()
+    for sh in base[:CPU_BASELINE_SAMPLES]:
+        check_stream_lin_cpu(sh.ops)
+    cpu_rate = CPU_BASELINE_SAMPLES / (time.perf_counter() - t)
+    print(
+        f"# stream: batch={big.batch} ops={STREAM_OPS} "
+        f"device={rate:.0f} hist/s (best {dt * 1e3:.1f}ms) "
+        f"cpu={cpu_rate:.1f} hist/s speedup={rate / cpu_rate:.1f}x",
+        file=sys.stderr,
+    )
+    details["stream"] = {
+        "batch": big.batch,
+        "ops": STREAM_OPS,
+        "device_histories_per_sec": round(rate, 1),
+        "cpu_histories_per_sec": round(cpu_rate, 2),
+        "speedup": round(rate / cpu_rate, 1),
+    }
+
+
+def _bench_elle(details: dict) -> None:
+    """BASELINE config #5: elle list-append serializability (MXU cycle
+    search over txn dependency graphs)."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.elle import (
+        check_elle_cpu,
+        elle_tensor_check,
+        infer_txn_graph,
+        pack_txn_graphs,
+    )
+    from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+    base = synth_elle_batch(64, ElleSynthSpec(n_txns=ELLE_TXNS))
+    packed = pack_txn_graphs([infer_txn_graph(sh.ops) for sh in base])
+    k = ELLE_BATCH // packed.batch
+    big = jax.tree.map(
+        lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
+    )
+
+    def check():
+        return elle_tensor_check(big)
+
+    jax.block_until_ready(check())
+    rate, dt, _ = _timed_rate(check, big.batch)
+
+    t = time.perf_counter()
+    for sh in base[:CPU_BASELINE_SAMPLES]:
+        check_elle_cpu(sh.ops)
+    cpu_rate = CPU_BASELINE_SAMPLES / (time.perf_counter() - t)
+    print(
+        f"# elle: batch={big.batch} txns={ELLE_TXNS} "
+        f"device={rate:.0f} hist/s (best {dt * 1e3:.1f}ms) "
+        f"cpu={cpu_rate:.1f} hist/s speedup={rate / cpu_rate:.1f}x",
+        file=sys.stderr,
+    )
+    details["elle"] = {
+        "batch": big.batch,
+        "txns": ELLE_TXNS,
+        "device_histories_per_sec": round(rate, 1),
+        "cpu_histories_per_sec": round(cpu_rate, 2),
+        "speedup": round(rate / cpu_rate, 1),
+    }
+
+
+def main() -> None:
+    backend = _init_backend_with_retry()
+    print(f"# backend ready: {backend}", file=sys.stderr)
+
+    details: dict = {"backend": backend}
+    rate, cpu_rate = _bench_queue(details)
+
+    # secondary families — never allowed to sink the headline artifact
+    for section in (_bench_stream, _bench_elle):
+        try:
+            section(details)
+        except Exception as e:  # noqa: BLE001 - secondary, reported
+            print(
+                f"# {section.__name__} failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+
+    try:
+        with open("BENCH_DETAILS.json", "w") as fh:
+            json.dump(details, fh, indent=1)
+    except OSError as e:  # pragma: no cover - read-only cwd
+        print(f"# could not write BENCH_DETAILS.json: {e}", file=sys.stderr)
 
     print(
         json.dumps(

@@ -1,45 +1,128 @@
-"""JAX backend bootstrap guards."""
+"""JAX backend bootstrap guards.
+
+Two distinct needs, two entry points:
+
+- ``virtual_cpu_devices(n)`` — the caller wants the *virtual host mesh*
+  (sharding tests, the driver's ``dryrun_multichip``).  Pins the CPU
+  platform **before any backend probe**, so a tunneled single-chip plugin
+  (e.g. ``JAX_PLATFORMS=axon`` injected by ``sitecustomize``) is never
+  initialized — plugin init can hang for minutes in environments where the
+  tunnel does not answer, which is exactly what a dryrun must not do.
+
+- ``ensure_backend(deadline)`` — the caller wants the *real* default
+  backend (bench, checker service).  Probes it in a watchdog thread so a
+  hanging plugin init fails fast with a clear message instead of blocking
+  the process forever.
+"""
 
 from __future__ import annotations
 
+import os
 
-def ensure_backend() -> str:
-    """Initialize the JAX backend, falling back to auto-selection when the
-    env-pinned platform (e.g. a plugin named in ``JAX_PLATFORMS``) is not
-    actually registered in this process.  Returns the backend name."""
+
+def _force_host_device_flag(n: int) -> None:
+    """Add ``--xla_force_host_platform_device_count=n`` to XLA_FLAGS.
+
+    XLA parses XLA_FLAGS once, at the process's first backend init — the
+    flag must be in place before any probe.  It only affects the host (CPU)
+    platform, so it is harmless if a real backend is selected later.
+    """
+    flag = f"--xla_force_host_platform_device_count={n}"
+    if flag not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + flag
+        ).strip()
+
+
+def virtual_cpu_devices(n: int) -> list:
+    """Return ``n`` virtual CPU devices, never touching any other plugin.
+
+    Must run before the process's first backend init to be fully effective;
+    if some earlier import already initialized a backend, the backend cache
+    is cleared and rebuilt on CPU.
+    """
+    _force_host_device_flag(n)
+    # Pin both the env var (read by fresh config state) and the live config
+    # (wins over a sitecustomize pin that already set the env).
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
     import jax
 
-    try:
-        jax.devices()
-    except RuntimeError:
-        jax.config.update("jax_platforms", "")
-        jax.devices()
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if len(devs) < n:
+        # A backend was initialized before the pin (flag unseen) — rebuild.
+        try:
+            import jax.extend.backend
+
+            jax.extend.backend.clear_backends()
+        except Exception:  # pragma: no cover - API drift across jax versions
+            pass
+        devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} virtual CPU devices, have {len(devs)}; a backend was "
+            f"initialized before the pin — run in a fresh process with "
+            f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={n}"
+        )
+    return devs[:n]
+
+
+def ensure_backend(deadline: float = 60.0) -> str:
+    """Initialize the default JAX backend with a watchdog deadline.
+
+    Falls back to auto-selection when the env-pinned platform (e.g. a
+    plugin named in ``JAX_PLATFORMS``) is not actually registered in this
+    process.  A plugin whose init *hangs* (rather than errors) — e.g. a
+    TPU tunnel that never answers — trips the deadline and raises
+    ``TimeoutError`` instead of blocking forever.  Returns the backend
+    name.
+    """
+    import jax
+
+    _probe_with_deadline(jax, deadline)
     return jax.default_backend()
 
 
-def ensure_device_count(n: int) -> list:
-    """Return ≥``n`` JAX devices, forcing the virtual CPU mesh if needed.
+def _probe_with_deadline(jax, deadline: float) -> None:
+    import threading
 
-    The environment may pin ``JAX_PLATFORMS`` to a single-chip plugin via
-    ``sitecustomize`` *before* any caller's env vars are seen, so an outer
-    ``JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=N``
-    can be silently overridden.  As long as the backend has not been
-    initialized yet in this process, flipping ``jax_platforms`` to ``cpu``
-    and appending the host-device-count flag here still works (both are
-    read at first backend init, not at import).
+    result: dict = {}
+
+    def probe() -> None:
+        try:
+            jax.devices()
+            result["ok"] = True
+        except Exception as e:  # noqa: BLE001 - reported to the waiter
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(deadline)
+    if t.is_alive():
+        raise TimeoutError(
+            f"JAX backend init did not complete within {deadline:.0f}s "
+            f"(platform pin: {os.environ.get('JAX_PLATFORMS', '<auto>')!r}) "
+            f"— the platform plugin is hanging, not erroring"
+        )
+    if "error" in result:
+        # Pinned platform not registered / failed: fall back to auto.
+        jax.config.update("jax_platforms", "")
+        jax.devices()
+
+
+def ensure_device_count(n: int) -> list:
+    """Return ≥``n`` JAX devices from the *real* default backend, falling
+    back to the virtual CPU mesh when the backend has fewer devices.
+
+    Unlike :func:`virtual_cpu_devices` this probes the default backend
+    first — use it only when a real multi-chip slice should win if present
+    (never from a dryrun that must avoid plugin init).
     """
-    import os
+    _force_host_device_flag(n)
 
     import jax
-
-    # XLA parses XLA_FLAGS once, at the process's first backend init — so
-    # the host-device-count flag must be in place *before* we probe the
-    # default backend, or a later fall-back to CPU can't see it.  The flag
-    # only affects the host (CPU) platform, so it's harmless when the
-    # default backend turns out to be a real multi-chip slice.
-    flag = f"--xla_force_host_platform_device_count={n}"
-    if flag not in os.environ.get("XLA_FLAGS", ""):
-        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " " + flag
 
     ensure_backend()
     devs = jax.devices()
@@ -47,21 +130,4 @@ def ensure_device_count(n: int) -> list:
         # the real backend (e.g. a multi-chip TPU slice) can supply the
         # mesh — never silently downgrade it to virtual CPU devices
         return devs[:n]
-
-    # Too few real devices: rebuild on the virtual CPU mesh.
-    jax.config.update("jax_platforms", "cpu")
-    try:
-        import jax.extend.backend
-
-        jax.extend.backend.clear_backends()
-    except Exception:  # pragma: no cover - API drift across jax versions
-        pass
-    devs = jax.devices()
-    if len(devs) < n:
-        raise RuntimeError(
-            f"need {n} JAX devices, have {len(devs)} on backend "
-            f"{jax.default_backend()!r}; run in a fresh process with "
-            f"JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_"
-            f"device_count={n}"
-        )
-    return devs[:n]
+    return virtual_cpu_devices(n)

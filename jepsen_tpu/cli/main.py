@@ -29,10 +29,14 @@ import sys
 import time
 from pathlib import Path
 
-from jepsen_tpu.checkers.perf import Perf
-from jepsen_tpu.checkers.protocol import VALID, compose
-from jepsen_tpu.checkers.queue_lin import QueueLinearizability
-from jepsen_tpu.checkers.total_queue import TotalQueue
+# JAX (and the jax-importing checker modules) are imported lazily, inside
+# the subcommands that need them: subcommands that never touch a device
+# (``synth``, ``serve``, ``matrix --print-configs``) must not initialize a
+# JAX backend at all — a tunneled single-chip plugin can hang init for
+# minutes when the tunnel does not answer, and e.g. the CI matrix
+# introspection path is spawned as a subprocess by shell tooling that
+# cannot afford that.
+
 from jepsen_tpu.history.store import (
     HISTORY_FILE,
     Store,
@@ -69,6 +73,11 @@ def _workload_of(history) -> str:
 
 
 def _checker_for(args, out_dir=None, history=None):
+    from jepsen_tpu.checkers.perf import Perf
+    from jepsen_tpu.checkers.protocol import compose
+    from jepsen_tpu.checkers.queue_lin import QueueLinearizability
+    from jepsen_tpu.checkers.total_queue import TotalQueue
+
     backend = args.checker
     workload = getattr(args, "workload", "auto")
     if workload == "auto":
@@ -104,6 +113,8 @@ def _checker_for(args, out_dir=None, history=None):
 
 
 def cmd_check(args) -> int:
+    from jepsen_tpu.checkers.protocol import VALID
+
     hpath = _resolve_history_path(Path(args.history)).resolve()
     history = read_history_jsonl(hpath)
     out_dir = hpath.parent
@@ -336,28 +347,27 @@ def cmd_test(args) -> int:
 
 
 def cmd_matrix(args) -> int:
+    if args.print_configs:
+        # one line of `test` CLI flags per config — the CI shell layer and
+        # any external driver consume the matrix from this single source
+        # of truth instead of duplicating it.  Introspection only: no
+        # logging setup, no runner/suite (and hence no JAX) imports.
+        from jepsen_tpu.harness.matrix import matrix_cli_flags
+
+        for line in matrix_cli_flags():
+            print(line)
+        return 0
+
     import logging
 
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
     from jepsen_tpu.control.runner import run_test
-    from jepsen_tpu.harness.matrix import (
-        CI_MATRIX,
-        MatrixRunner,
-        matrix_cli_flags,
-    )
+    from jepsen_tpu.harness.matrix import CI_MATRIX, MatrixRunner
     from jepsen_tpu.suite import (
         DEFAULT_OPTS,
         build_rabbitmq_test,
         build_sim_test,
     )
-
-    if args.print_configs:
-        # one line of `test` CLI flags per config — the CI shell layer and
-        # any external driver consume the matrix from this single source
-        # of truth instead of duplicating it
-        for line in matrix_cli_flags():
-            print(line)
-        return 0
 
     scale = args.time_scale
 
@@ -631,11 +641,34 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _wants_device_backend(args) -> bool:
+    """True when the subcommand benefits from the real default backend
+    (a TPU if the environment has one)."""
+    if args.command in ("synth", "serve"):
+        return False  # host-only work
+    if args.command in ("bench-check", "serve-checker"):
+        return True  # device-throughput measurement / checker sidecar
+    if getattr(args, "print_configs", False):
+        return False  # matrix introspection runs no checks
+    return getattr(args, "checker", None) == "tpu"
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    from jepsen_tpu.utils.jaxenv import ensure_backend
+    from jepsen_tpu.utils.jaxenv import ensure_backend, pin_cpu_platform
 
-    ensure_backend()
+    if not _wants_device_backend(args):
+        # no device compute on these paths — never touch a chip plugin
+        pin_cpu_platform()
+    elif args.command != "serve-checker":  # sidecar guards its own init
+        try:
+            ensure_backend()
+        except TimeoutError as e:
+            print(
+                f"# warning: {e}; falling back to the CPU backend",
+                file=sys.stderr,
+            )
+            pin_cpu_platform()
     try:
         return args.fn(args)
     except FileNotFoundError as e:

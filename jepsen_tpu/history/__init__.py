@@ -1,4 +1,9 @@
-"""History substrate: op schema, serialization, and int32 tensor packing."""
+"""History substrate: op schema, serialization, and int32 tensor packing.
+
+``encode`` (tensor packing) imports JAX; it is exposed lazily (PEP 562) so
+that jax-free consumers — the store, the CLI's introspection paths — can
+import ``jepsen_tpu.history.*`` without pulling JAX into the process.
+"""
 
 from jepsen_tpu.history.ops import (  # noqa: F401
     Op,
@@ -7,8 +12,13 @@ from jepsen_tpu.history.ops import (  # noqa: F401
     NO_VALUE,
     NEMESIS_PROCESS,
 )
-from jepsen_tpu.history.encode import (  # noqa: F401
-    PackedHistories,
-    pack_histories,
-    pack_history,
-)
+
+_ENCODE_NAMES = ("PackedHistories", "pack_histories", "pack_history")
+
+
+def __getattr__(name):
+    if name in _ENCODE_NAMES:
+        from jepsen_tpu.history import encode
+
+        return getattr(encode, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -249,9 +249,20 @@ class CheckerServer(socketserver.ThreadingTCPServer):
 
 
 def serve_forever(host: str = "0.0.0.0", port: int = 8640) -> None:
-    from jepsen_tpu.utils.jaxenv import ensure_backend
+    import jax
 
-    backend = ensure_backend()
+    from jepsen_tpu.utils.jaxenv import ensure_backend, pin_cpu_platform
+
+    try:
+        backend = ensure_backend()
+    except TimeoutError as e:
+        # a hanging chip-plugin init must not take the sidecar down —
+        # serve on CPU and say so, rather than blocking forever (safe
+        # because ensure_backend probes in a subprocess: this process has
+        # not touched the hanging plugin)
+        print(f"warning: {e}; serving on the CPU backend")
+        pin_cpu_platform()
+        backend = jax.default_backend()
     srv = CheckerServer(host, port)
     print(f"checker sidecar on {host}:{srv.port} (backend={backend})")
     try:

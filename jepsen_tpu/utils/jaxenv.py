@@ -69,47 +69,69 @@ def virtual_cpu_devices(n: int) -> list:
     return devs[:n]
 
 
+def pin_cpu_platform() -> None:
+    """Pin this process (and its children, via the env var) to the CPU
+    platform.  Must run before the process's first backend use."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        pass
+
+
+_probe_succeeded = False
+
+
 def ensure_backend(deadline: float = 60.0) -> str:
     """Initialize the default JAX backend with a watchdog deadline.
 
-    Falls back to auto-selection when the env-pinned platform (e.g. a
-    plugin named in ``JAX_PLATFORMS``) is not actually registered in this
-    process.  A plugin whose init *hangs* (rather than errors) — e.g. a
-    TPU tunnel that never answers — trips the deadline and raises
-    ``TimeoutError`` instead of blocking forever.  Returns the backend
-    name.
+    The probe runs in a **subprocess**, not a thread: jax's backend init
+    holds an internal lock, so an in-process probe that hangs (e.g. a TPU
+    tunnel that never answers) would poison every later backend call in
+    this process, making any CPU fallback impossible.  A hung subprocess
+    is simply killed — the parent's backend state stays untouched, so the
+    caller can still pin CPU and carry on.  Raises ``TimeoutError`` on a
+    hanging plugin; falls back to auto-selection when the pinned platform
+    errors (e.g. is not registered).  Returns the backend name.
     """
+    global _probe_succeeded
     import jax
 
-    _probe_with_deadline(jax, deadline)
-    return jax.default_backend()
-
-
-def _probe_with_deadline(jax, deadline: float) -> None:
-    import threading
-
-    result: dict = {}
-
-    def probe() -> None:
-        try:
-            jax.devices()
-            result["ok"] = True
-        except Exception as e:  # noqa: BLE001 - reported to the waiter
-            result["error"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(deadline)
-    if t.is_alive():
-        raise TimeoutError(
-            f"JAX backend init did not complete within {deadline:.0f}s "
-            f"(platform pin: {os.environ.get('JAX_PLATFORMS', '<auto>')!r}) "
-            f"— the platform plugin is hanging, not erroring"
-        )
-    if "error" in result:
-        # Pinned platform not registered / failed: fall back to auto.
-        jax.config.update("jax_platforms", "")
+    if jax.config.jax_platforms == "cpu":
+        # CPU init cannot hang; also covers in-process pins that a
+        # subprocess (which only inherits the env) would not see
         jax.devices()
+        return jax.default_backend()
+
+    if not _probe_succeeded:
+        import subprocess
+        import sys
+
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                capture_output=True,
+                text=True,
+                timeout=deadline,
+                env=os.environ.copy(),
+            )
+        except subprocess.TimeoutExpired:
+            raise TimeoutError(
+                f"JAX backend init did not complete within {deadline:.0f}s "
+                f"(platform pin: "
+                f"{os.environ.get('JAX_PLATFORMS', '<auto>')!r}) "
+                f"— the platform plugin is hanging, not erroring"
+            ) from None
+        if r.returncode != 0:
+            # Pinned platform not registered / failed: fall back to auto.
+            jax.config.update("jax_platforms", "")
+        _probe_succeeded = True
+
+    # safe: the probe proved init returns promptly in this environment
+    jax.devices()
+    return jax.default_backend()
 
 
 def ensure_device_count(n: int) -> list:

@@ -233,22 +233,23 @@ def _on_cycle_nodes(n: int, edges: set[tuple[int, int]]) -> set[int]:
 
 
 def _classify(g: TxnGraph, ww_cyc: set, wwr_cyc: set, all_cyc: set) -> dict:
+    """Adya classification from the three union-graph on-cycle sets
+    (``ww_cyc ⊆ wwr_cyc ⊆ all_cyc`` — adding edges preserves cycles):
+    G0 = ww cycle; G1c = on a ww∪wr cycle but NOT a pure ww one (needs a
+    wr edge); G2 = needs at least one rw edge."""
+    g1c = wwr_cyc - ww_cyc
+    g2 = all_cyc - wwr_cyc
     return {
         VALID: not (
-            ww_cyc
-            or wwr_cyc
-            or all_cyc
-            or g.g1a
-            or g.g1b
-            or g.incompatible_order
+            all_cyc or g.g1a or g.g1b or g.incompatible_order
         ),
         "txn-count": g.n,
         "G0": ww_cyc,
         "G0-count": len(ww_cyc),
-        "G1c": wwr_cyc,
-        "G1c-count": len(wwr_cyc),
-        "G2": all_cyc,
-        "G2-count": len(all_cyc),
+        "G1c": g1c,
+        "G1c-count": len(g1c),
+        "G2": g2,
+        "G2-count": len(g2),
         "G1a": g.g1a,
         "G1a-count": len(g.g1a),
         "G1b": g.g1b,
@@ -360,6 +361,10 @@ def _on_cycle_tensor(a: jax.Array, n_squarings: int) -> jax.Array:
 @jax.tree_util.register_dataclass
 @dataclass
 class ElleTensors:
+    """Union-graph on-cycle tensors (g0 ⊆ g1c ⊆ g2 — adding edges
+    preserves cycles); ``_classify`` subtracts them into the disjoint
+    Adya classes when rendering results."""
+
     valid: jax.Array  # [B] bool
     g0: jax.Array  # [B, T] bool — txns on a ww cycle
     g1c: jax.Array  # [B, T] bool — txns on a ww∪wr cycle

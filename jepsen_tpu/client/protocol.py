@@ -175,11 +175,14 @@ class StreamClient(Client):
         publish_confirm_timeout_s: float = 5.0,
         read_timeout_s: float = 5.0,
         read_batch: int = 8,
+        full_read_confirm_empties: int = 1,
     ):
         self.driver_factory = driver_factory
         self.publish_confirm_timeout_s = publish_confirm_timeout_s
         self.read_timeout_s = read_timeout_s
         self.read_batch = read_batch
+        # extra empty batches required to conclude end-of-log on FULL_READ
+        self.full_read_confirm_empties = full_read_confirm_empties
         self.driver: StreamDriver | None = None
         self.cursor = 0
 
@@ -189,6 +192,7 @@ class StreamClient(Client):
             self.publish_confirm_timeout_s,
             self.read_timeout_s,
             self.read_batch,
+            self.full_read_confirm_empties,
         )
         c.driver = self.driver_factory(test, node)
         return c
@@ -208,13 +212,21 @@ class StreamClient(Client):
             if op.f == OpF.READ:
                 if op.value == FULL_READ:
                     # offsets need not be dense (chunk boundaries,
-                    # retention): advance by last offset + 1, never count
+                    # retention): advance by last offset + 1, never count.
+                    # End-of-log must be *confirmed*, not inferred from one
+                    # empty batch: a broker stall longer than the read
+                    # timeout mid-log would otherwise truncate the final
+                    # read and turn acked-but-unread values into false
+                    # "lost" verdicts.
                     pairs: list = []
                     nxt = 0
-                    while True:
+                    empties = 0
+                    while empties <= self.full_read_confirm_empties:
                         batch = d.read_from(nxt, 4096, self.read_timeout_s)
                         if not batch:
-                            break
+                            empties += 1
+                            continue
+                        empties = 0
                         pairs.extend([list(p) for p in batch])
                         nxt = batch[-1][0] + 1
                     return op.complete(OpType.OK, value=pairs)

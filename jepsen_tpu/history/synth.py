@@ -352,15 +352,45 @@ def synth_stream_history(spec: StreamSynthSpec) -> StreamSynthHistory:
         out.phantom.add(v)
     if spec.reorder:
         # move an unread acked value to the tail: every offset it jumps
-        # over now holds a later-invoked value below it
+        # over now holds a value invoked after the moved value completed.
+        # Ground truth = those jumped-over offsets — exactly the set the
+        # checker's suffix-min rule flags (an offset o is reorder when its
+        # occupant's append-invoke follows a later offset's completion) —
+        # so reorder-only injections can assert equality.  The occupant
+        # test uses invoke/ok *positions* (an indeterminate append that
+        # landed in the log counts via its invoke, even with no ack).
+        s_pos: dict[int, int] = {}
+        e_pos: dict[int, int] = {}
+        for pos, o_ in enumerate(ops):
+            if o_.f == OpF.APPEND and isinstance(o_.value, int):
+                if o_.type == OpType.INVOKE:
+                    s_pos.setdefault(o_.value, pos)
+                elif o_.type == OpType.OK:
+                    e_pos.setdefault(o_.value, pos)
         movable = [v for v in log[hi : max(len(log) - 2, hi)] if v in acked_set]
+        moved: list[int] = []
         for _ in range(spec.reorder):
             if not movable:
                 break
             v = movable.pop(0)
             log.remove(v)
             log.append(v)
-            out.reorder.add(len(log) - 1)  # informational: the new offset
+            moved.append(v)
+        # flag against the *final* log (per-move offsets would go stale
+        # when a later move shifts the log under them): offset o is
+        # reorder when its occupant's append-invoke follows the completion
+        # of some moved value now sitting at a later offset
+        if moved:
+            pos_of = {v: o for o, v in enumerate(log)}
+            for o, w in enumerate(log):
+                if w not in s_pos:
+                    continue
+                if any(
+                    pos_of[v] > o and e_pos[v] < s_pos[w]
+                    for v in moved
+                    if v in e_pos
+                ):
+                    out.reorder.add(o)
 
     # -- phase 2: full reads (drain analog) ---------------------------------
     # divergence needs a second, disagreeing observation of the offset:

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Teardown: destroy the AWS cluster and scrub credentials. Runs under the
+# workflow's `if: always()` so an aborted matrix never leaks EC2 instances
+# (the always-destroy guarantee of the reference pipeline). Uses the
+# terraform state that ci/jepsen-tpu-test.sh snapshots into
+# terraform-state/ right after `apply`.
+set -uo pipefail
+
+branch=""
+if [ -n "${BINARY_URL:-}" ]; then
+    branch=$(ci/extract-rabbitmq-branch-from-binary-url.sh "$BINARY_URL")
+fi
+
+if [ -d terraform-state ]; then
+    (
+        cd terraform-state &&
+        terraform init &&
+        terraform destroy -auto-approve -var="rabbitmq_branch=$branch"
+    ) || echo "terraform destroy failed — instances may need manual cleanup"
+fi
+if [ -n "$branch" ]; then
+    aws ec2 delete-key-pair --no-cli-pager \
+        --key-name "jepsen-tpu-qq-$branch-key" || true
+fi
+
+rm -rf ~/.aws terraform-state terraform.tfstate

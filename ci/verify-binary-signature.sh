@@ -1,0 +1,23 @@
+#!/bin/bash
+# Gate: GPG-verify the RabbitMQ generic-unix tarball named by $BINARY_URL
+# against the RabbitMQ release signing key before any cluster is built.
+# (Same check the reference performs inline in its workflow,
+# /root/reference/.github/workflows/jepsen.yml:53-60 — here it is a
+# standalone, locally runnable script.)
+set -euo pipefail
+
+: "${BINARY_URL:?BINARY_URL must be set}"
+SIGNING_KEY_URL=${SIGNING_KEY_URL:-https://github.com/rabbitmq/signing-keys/releases/download/3.0/rabbitmq-release-signing-key.asc}
+
+workdir=$(mktemp -d)
+trap 'rm -rf "$workdir"' EXIT
+cd "$workdir"
+
+curl -fsSL "$SIGNING_KEY_URL" -o signing-key.asc
+gpg --import signing-key.asc
+
+tarball=$(basename "$BINARY_URL")
+curl -fsSL -O "$BINARY_URL"
+curl -fsSL -O "$BINARY_URL.asc"
+gpg --verify "$tarball.asc" "$tarball"
+echo "signature OK: $tarball"

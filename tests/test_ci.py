@@ -152,3 +152,11 @@ class TestCiDriverShell:
             capture_output=True,
         )
         assert r.returncode == 0, r.stderr
+
+    def test_workflow_helper_scripts_are_syntactically_valid(self):
+        for name in ("verify-binary-signature.sh", "destroy-cluster.sh"):
+            r = subprocess.run(
+                ["bash", "-n", str(REPO / "ci" / name)],
+                capture_output=True,
+            )
+            assert r.returncode == 0, (name, r.stderr)

@@ -33,9 +33,32 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
     if _lib is not None and path is None:
         return _lib
     p = Path(path or _LIB_PATH)
+    build_err = ""
+    if not p.exists() and path is None:
+        # the shared object is a build product, not a committed artifact —
+        # build it on first use (~3 s), serialized across processes so
+        # concurrent first loads cannot dlopen a half-written file
+        import fcntl
+        import subprocess
+
+        with open(p.parent / ".build.lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            if not p.exists():
+                try:
+                    r = subprocess.run(
+                        ["make", "-C", str(p.parent)],
+                        capture_output=True,
+                        text=True,
+                        timeout=120,
+                    )
+                    if r.returncode != 0:
+                        build_err = (r.stderr or r.stdout)[-500:]
+                except (subprocess.TimeoutExpired, OSError) as e:
+                    build_err = str(e)
     if not p.exists():
+        detail = f": {build_err}" if build_err else ""
         raise FileNotFoundError(
-            f"{p} not built — run `make -C native` first"
+            f"{p} not built — run `make -C native` first{detail}"
         )
     lib = ctypes.CDLL(str(p))
     lib.amqp_client_create.restype = ctypes.c_void_p

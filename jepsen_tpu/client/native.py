@@ -19,6 +19,7 @@ from jepsen_tpu.client.protocol import (
     DriverTimeout,
     QueueDriver,
     StreamDriver,
+    TxnDriver,
 )
 
 _LIB_PATH = Path(__file__).resolve().parent.parent.parent / "native" / "libamqp_driver.so"
@@ -114,6 +115,31 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
     lib.amqp_stream_reconnect.argtypes = [ctypes.c_void_p]
     lib.amqp_stream_close.argtypes = [ctypes.c_void_p]
     lib.amqp_stream_destroy.argtypes = [ctypes.c_void_p]
+    lib.amqp_txn_client_create.restype = ctypes.c_void_p
+    lib.amqp_txn_client_create.argtypes = [
+        ctypes.c_char_p,  # host
+        ctypes.c_int,  # port
+        ctypes.c_char_p,  # user
+        ctypes.c_char_p,  # pass
+        ctypes.c_int,  # connect retry ms
+    ]
+    lib.amqp_txn_client_setup.argtypes = [ctypes.c_void_p]
+    lib.amqp_txn_append.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+    ]
+    lib.amqp_txn_commit.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.amqp_txn_rollback.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.amqp_txn_read_key.restype = ctypes.c_long
+    lib.amqp_txn_read_key.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,  # key
+        ctypes.c_int,  # timeout ms
+        ctypes.POINTER(ctypes.c_int),  # values out
+        ctypes.c_long,  # cap
+    ]
+    lib.amqp_txn_reconnect.argtypes = [ctypes.c_void_p]
+    lib.amqp_txn_close.argtypes = [ctypes.c_void_p]
+    lib.amqp_txn_destroy.argtypes = [ctypes.c_void_p]
     if path is None:
         _lib = lib
     return lib
@@ -268,6 +294,91 @@ def native_stream_driver_factory(port: int = 5672, **kw: Any):
 
     def factory(test: Mapping[str, Any], node: str) -> NativeStreamDriver:
         return NativeStreamDriver(node, port=port, **kw)
+
+    return factory
+
+
+class NativeTxnDriver(TxnDriver):
+    """One transactional AMQP client bound to one node: Elle list-append
+    over the AMQP tx class — each key is a per-key stream queue, a txn's
+    appends become visible atomically at tx.commit, reads re-read the
+    key's stream.  Reads observe committed state plus this txn's own
+    earlier appends (same read-your-writes rule as the sim driver)."""
+
+    READ_CAP = 65536
+
+    def __init__(
+        self,
+        node: str,
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        connect_retry_ms: int = 30000,
+        read_timeout_s: float = 1.0,
+    ):
+        self.lib = load_library()
+        self.read_timeout_s = read_timeout_s
+        self.handle = self.lib.amqp_txn_client_create(
+            node.encode(), port, user.encode(), password.encode(),
+            connect_retry_ms,
+        )
+        if not self.handle:
+            raise ConnectionError(f"amqp_txn_client_create failed for {node}")
+
+    def setup(self) -> None:
+        if self.lib.amqp_txn_client_setup(self.handle) != 0:
+            raise ConnectionError("txn setup (tx.select) failed")
+
+    def txn(self, micro_ops: list, timeout_s: float) -> list:
+        t_ms = int(timeout_s * 1000)
+        done: list = []
+        staged: dict[int, list[int]] = {}
+        for m in micro_ops:
+            kind, k = m[0], int(m[1])
+            if kind == "append":
+                v = int(m[2])
+                if self.lib.amqp_txn_append(self.handle, k, v) != 0:
+                    self.lib.amqp_txn_rollback(self.handle, t_ms)
+                    raise ConnectionError("txn append failed")
+                staged.setdefault(k, []).append(v)
+                done.append(["append", k, v])
+            else:
+                vals = (ctypes.c_int * self.READ_CAP)()
+                n = self.lib.amqp_txn_read_key(
+                    self.handle, k, int(self.read_timeout_s * 1000),
+                    vals, self.READ_CAP,
+                )
+                if n < 0:
+                    self.lib.amqp_txn_rollback(self.handle, t_ms)
+                    raise ConnectionError("txn read failed")
+                observed = [int(vals[i]) for i in range(n)]
+                # read-your-writes: staged appends are invisible broker-side
+                # until commit (skip any already visible via fault injection)
+                observed += [
+                    v for v in staged.get(k, []) if v not in observed
+                ]
+                done.append(["r", k, observed])
+        r = self.lib.amqp_txn_commit(self.handle, t_ms)
+        if r == 1:
+            return done
+        if r == -1:
+            raise DriverTimeout("tx commit timed out (outcome unknown)")
+        raise ConnectionError("tx commit failed")
+
+    def reconnect(self) -> None:
+        if self.lib.amqp_txn_reconnect(self.handle) != 0:
+            raise ConnectionError("reconnect failed")
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.amqp_txn_close(self.handle)
+
+
+def native_txn_driver_factory(port: int = 5672, **kw: Any):
+    """Factory for :class:`TxnClient`: ``(test, node) -> driver``."""
+
+    def factory(test: Mapping[str, Any], node: str) -> NativeTxnDriver:
+        return NativeTxnDriver(node, port=port, **kw)
 
     return factory
 

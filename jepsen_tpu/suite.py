@@ -281,16 +281,12 @@ def build_rabbitmq_test(
 ) -> Test:
     """The reference test against a real RabbitMQ cluster: SSH DB
     lifecycle, iptables partitions, native C++ AMQP clients."""
-    if workload == "elle":
-        raise NotImplementedError(
-            "the live elle workload needs AMQP-tx support in the native "
-            "driver; use --db sim (in-process) meanwhile"
-        )
     from jepsen_tpu.client.native import (
         native_driver_factory,
         native_stream_driver_factory,
+        native_txn_driver_factory,
     )
-    from jepsen_tpu.client.protocol import StreamClient
+    from jepsen_tpu.client.protocol import StreamClient, TxnClient
     from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
     from jepsen_tpu.control.net import IptablesNet
     from jepsen_tpu.control.ssh import SshTransport
@@ -311,6 +307,14 @@ def build_rabbitmq_test(
         generator = stream_generator(o)
         checker = stream_checker(checker_backend)
         name = "rabbitmq-stream-partition"
+    elif workload == "elle":
+        client = TxnClient(
+            native_txn_driver_factory(),
+            txn_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = elle_generator(o)
+        checker = elle_checker(checker_backend)
+        name = "rabbitmq-elle-txn"
     elif workload == "queue":
         client = QueueClient(
             native_driver_factory(list(nodes)),

@@ -121,7 +121,10 @@ class _ConnState:
     next_tag: int = 1
     unacked: dict = field(default_factory=dict)  # tag -> (queue, _Message)
     consuming_queue: str | None = None
+    consuming_noack: bool = False
     confirms: bool = False
+    tx_mode: bool = False  # tx.select seen: publishes buffer until commit
+    tx_buffer: list = field(default_factory=list)  # [(queue, body), ...]
     open: bool = True
 
 
@@ -135,6 +138,7 @@ class MiniAmqpBroker:
         duplicate_every: int = 0,
         lose_appended_every: int = 0,
         duplicate_append_every: int = 0,
+        dirty_tx_reads: bool = False,
     ):
         self.host = host
         self._server = socket.create_server((host, port))
@@ -147,6 +151,7 @@ class MiniAmqpBroker:
         self.duplicate_every = duplicate_every
         self.lose_appended_every = lose_appended_every
         self.duplicate_append_every = duplicate_append_every
+        self.dirty_tx_reads = dirty_tx_reads
         self._published = 0
         self._delivered = 0
         self._appended = 0
@@ -326,12 +331,14 @@ class MiniAmqpBroker:
                 elif cls == 60 and mth == 70:  # Basic.Get
                     r.u16()
                     qname = r.shortstr()
-                    self._handle_get(conn, ch, qname)
+                    no_ack = bool(r.u8() & 1)
+                    self._handle_get(conn, ch, qname, no_ack)
                 elif cls == 60 and mth == 20:  # Basic.Consume
                     r.u16()
                     qname = r.shortstr()
                     ctag = r.shortstr() or "ctag-1"
-                    r.u8()  # no-local/no-ack/exclusive/no-wait bits
+                    cbits = r.u8()  # no-local/no-ack/exclusive/no-wait
+                    conn.consuming_noack = bool(cbits & 2)
                     cargs = r.table()
                     self._send_method(conn, ch, 60, 21, _shortstr(ctag))
                     if qname in self.streams:
@@ -357,6 +364,18 @@ class MiniAmqpBroker:
                             qname, msg = item
                             self.queues.setdefault(qname, deque()).append(msg)
                     self._deliver_all()
+                elif cls == 90 and mth == 10:  # Tx.Select
+                    conn.tx_mode = True
+                    self._send_method(conn, ch, 90, 11)
+                elif cls == 90 and mth == 20:  # Tx.Commit
+                    buffered, conn.tx_buffer = conn.tx_buffer, []
+                    for qname, body in buffered:
+                        self._apply_publish(qname, body)
+                    self._send_method(conn, ch, 90, 21)
+                    self._deliver_all()
+                elif cls == 90 and mth == 30:  # Tx.Rollback
+                    conn.tx_buffer = []
+                    self._send_method(conn, ch, 90, 31)
                 elif cls == 10 and mth == 50:  # Connection.Close
                     self._send_method(conn, 0, 10, 51)
                     break
@@ -393,7 +412,28 @@ class MiniAmqpBroker:
             raise ConnectionError(f"expected {cls}.{mth}, got {c}.{m}")
 
     def _finish_publish(self, conn: _ConnState, queue: str, body: bytes):
+        if conn.tx_mode:
+            # tx publishes stay invisible until tx.commit (no confirms in
+            # tx mode — the commit-ok is the acknowledgement) ... unless
+            # the dirty-visibility fault is injected, which applies them
+            # immediately (read-uncommitted isolation: Elle must flag the
+            # resulting G1a/G1b/G1c anomalies)
+            if self.dirty_tx_reads:
+                self._apply_publish(queue, body)
+                self._deliver_all()
+            else:
+                conn.tx_buffer.append((queue, body))
+            return
         conn.publish_seq += 1
+        self._apply_publish(queue, body)
+        if conn.confirms and not self.drop_confirms:
+            self._send_method(
+                conn, 1, 60, 80, struct.pack(">QB", conn.publish_seq, 0)
+            )
+        self._deliver_all()
+
+    def _apply_publish(self, queue: str, body: bytes):
+        """Make a publish visible (fault injection applies here)."""
         with self.state_lock:
             if queue in self.streams:
                 self._appended += 1
@@ -418,11 +458,6 @@ class MiniAmqpBroker:
                     self.queues.setdefault(queue, deque()).append(
                         _Message(body)
                     )
-        if conn.confirms and not self.drop_confirms:
-            self._send_method(
-                conn, 1, 60, 80, struct.pack(">QB", conn.publish_seq, 0)
-            )
-        self._deliver_all()
 
     def _content_frames(self, conn, ch, body: bytes, method: bytes):
         self._send_frame(conn, FRAME_METHOD, ch, method)
@@ -431,7 +466,8 @@ class MiniAmqpBroker:
         if body:
             self._send_frame(conn, FRAME_BODY, ch, body)
 
-    def _handle_get(self, conn: _ConnState, ch: int, qname: str):
+    def _handle_get(self, conn: _ConnState, ch: int, qname: str,
+                    no_ack: bool = False):
         with self.state_lock:
             q = self.queues.setdefault(qname, deque())
             if not q:
@@ -446,7 +482,8 @@ class MiniAmqpBroker:
                     q.append(_Message(msg.value))
                 tag = conn.next_tag
                 conn.next_tag += 1
-                conn.unacked[tag] = (qname, msg)
+                if not no_ack:  # no-ack gets are auto-acknowledged
+                    conn.unacked[tag] = (qname, msg)
         if msg is None:
             self._send_method(conn, ch, 60, 72, _shortstr(""))
             return
@@ -460,34 +497,37 @@ class MiniAmqpBroker:
         self._content_frames(conn, ch, msg.value, method)
 
     def _try_deliver(self, conn: _ConnState, ch: int = 1):
-        """QoS-1 push: deliver one message if the consumer has none in
-        flight."""
-        if conn.consuming_queue is None or not conn.open:
-            return
-        with self.state_lock:
-            if conn.unacked:
-                return
-            q = self.queues.setdefault(conn.consuming_queue, deque())
-            if not q:
-                return
-            msg = q.popleft()
-            self._delivered += 1
-            if (
-                self.duplicate_every
-                and self._delivered % self.duplicate_every == 0
-            ):
-                q.append(_Message(msg.value))
-            tag = conn.next_tag
-            conn.next_tag += 1
-            conn.unacked[tag] = (conn.consuming_queue, msg)
-        method = (
-            struct.pack(">HH", 60, 60)
-            + _shortstr("ctag-1")
-            + struct.pack(">QB", tag, 0)
-            + _shortstr("")
-            + _shortstr(conn.consuming_queue)
-        )
-        self._content_frames(conn, ch, msg.value, method)
+        """Push deliveries: QoS-1 (one in flight) for acking consumers;
+        no-ack consumers are auto-acknowledged and drain the queue."""
+        while conn.consuming_queue is not None and conn.open:
+            with self.state_lock:
+                if conn.unacked and not conn.consuming_noack:
+                    return
+                q = self.queues.setdefault(conn.consuming_queue, deque())
+                if not q:
+                    return
+                msg = q.popleft()
+                self._delivered += 1
+                if (
+                    self.duplicate_every
+                    and self._delivered % self.duplicate_every == 0
+                ):
+                    q.append(_Message(msg.value))
+                tag = conn.next_tag
+                conn.next_tag += 1
+                noack = conn.consuming_noack
+                if not noack:  # no-ack consumers are auto-acked
+                    conn.unacked[tag] = (conn.consuming_queue, msg)
+            method = (
+                struct.pack(">HH", 60, 60)
+                + _shortstr("ctag-1")
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(conn.consuming_queue)
+            )
+            self._content_frames(conn, ch, msg.value, method)
+            if not noack:
+                return  # QoS-1: wait for the ack before the next push
 
     def _stream_deliver(
         self, conn: _ConnState, ch: int, qname: str, offset: int, ctag: str

@@ -256,7 +256,7 @@ class Connection {
 
   // ---- RPC: send a method on channel 1, wait for (cls, mth) ------------
   bool rpc(const amqp::Writer& w, uint16_t cls, uint16_t mth,
-           amqp::Frame* out, int timeout_ms) {
+           amqp::Frame* out, int timeout_ms, bool* sent_out = nullptr) {
     std::unique_lock<std::mutex> lk(state_mu_);
     rpc_expect_cls_ = cls;
     rpc_expect_mth_ = mth;
@@ -266,6 +266,7 @@ class Connection {
       std::lock_guard<std::mutex> wlk(write_mu_);
       if (closed_ || broken_) return false;
       if (!send_frame_locked(amqp::FRAME_METHOD, 1, w.buf)) return false;
+      if (sent_out) *sent_out = true;
     }
     lk.lock();
     bool ok = state_cv_.wait_for(lk, milliseconds(timeout_ms), [&] {
@@ -294,7 +295,9 @@ class Connection {
     return rpc(w, amqp::CLS_TX, amqp::M_TX_SELECT_OK, &f, timeout_ms);
   }
 
-  // 1 committed, -1 timeout (outcome unknown), -2 connection error
+  // 1 committed, -1 outcome unknown (commit reached the wire but no
+  // commit-ok arrived — timeout OR the connection broke after the send),
+  // -2 determinate failure (the commit never left this process)
   int tx_commit(int timeout_ms) {
     auto w = amqp::method_writer(amqp::CLS_TX, amqp::M_TX_COMMIT);
     amqp::Frame f;
@@ -302,9 +305,10 @@ class Connection {
       std::lock_guard<std::mutex> slk(state_mu_);
       if (closed_ || broken_) return -2;
     }
-    if (rpc(w, amqp::CLS_TX, amqp::M_TX_COMMIT_OK, &f, timeout_ms)) return 1;
-    std::lock_guard<std::mutex> slk(state_mu_);
-    return (closed_ || broken_) ? -2 : -1;
+    bool sent = false;
+    if (rpc(w, amqp::CLS_TX, amqp::M_TX_COMMIT_OK, &f, timeout_ms, &sent))
+      return 1;
+    return sent ? -1 : -2;
   }
 
   bool tx_rollback(int timeout_ms = 5000) {
@@ -966,6 +970,54 @@ constexpr const char* STREAM_QUEUE_NAME = "jepsen.stream";
 constexpr const char* STREAM_CONSUMER_TAG = "jt-stream-reader";
 bool g_stream_declared = false;  // once-latch, like g_queues_declared
 
+// Read up to max_n records of a stream queue from `offset`: attach a
+// consumer at the offset, collect deliveries until max_n / overall
+// deadline / a quiet window after the last delivery (the log end has no
+// explicit marker over AMQP), then cancel.  Returns the count (≥0) or -2
+// on error.  Shared by the stream client and the txn client's per-key
+// reads.
+long read_stream_queue(const std::shared_ptr<Connection>& c,
+                       const std::string& queue, const std::string& ctag,
+                       int64_t offset, long max_n, int timeout_ms,
+                       int64_t* offsets_out, int32_t* values_out, long cap) {
+  c->clear_deliveries();
+  amqp::Table args;
+  args.put_long("x-stream-offset", offset);
+  int prefetch = static_cast<int>(std::min<long>(max_n, 1000));
+  if (!c->start_consumer(queue, prefetch, &args, ctag)) return -2;
+  long n = 0;
+  int64_t next_implicit = offset;  // fallback when no offset header
+  auto deadline = Clock::now() + milliseconds(timeout_ms);
+  const int quiet_ms = 250;
+  while (n < max_n && n < cap) {
+    auto now = Clock::now();
+    if (now >= deadline) break;
+    int wait_ms = static_cast<int>(
+        std::chrono::duration_cast<milliseconds>(deadline - now).count());
+    if (n > 0) wait_ms = std::min(wait_ms, quiet_ms);
+    Delivery d;
+    int r = c->pop_delivery(&d, wait_ms);
+    if (r == 1) {
+      c->basic_ack(d.tag);
+      int64_t off = d.offset >= 0 ? d.offset : next_implicit;
+      next_implicit = off + 1;
+      if (off >= offset) {  // broker may round down to a chunk boundary
+        if (offsets_out) offsets_out[n] = off;
+        values_out[n] = d.value;
+        ++n;
+      }
+    } else if (r == -1) {
+      break;  // deadline or quiet window elapsed
+    } else {
+      c->cancel_consumer(ctag);
+      return n > 0 ? n : -2;
+    }
+  }
+  c->cancel_consumer(ctag);
+  c->clear_deliveries();
+  return n;
+}
+
 class StreamClient {
  public:
   explicit StreamClient(ClientConfig cfg) : cfg_(std::move(cfg)) {}
@@ -1026,53 +1078,15 @@ class StreamClient {
     return c->publish_confirm(STREAM_QUEUE_NAME, value, timeout_ms);
   }
 
-  // Read up to max_n records from `offset`: attach a consumer at the
-  // offset, collect deliveries until max_n / overall deadline / a quiet
-  // window after the last delivery (the log end has no explicit marker
-  // over AMQP), then cancel.  Returns the count (≥0) or -2 on error.
+  // See read_stream_queue above; returns the count (≥0) or -2 on error.
   long read_from(int64_t offset, long max_n, int timeout_ms,
                  int64_t* offsets_out, int32_t* values_out, long cap) {
     if (!initialize_if_necessary()) return -2;
     auto c = conn();
     if (!c) return -2;
-    c->clear_deliveries();
-    amqp::Table args;
-    args.put_long("x-stream-offset", offset);
-    int prefetch = static_cast<int>(std::min<long>(max_n, 1000));
-    if (!c->start_consumer(STREAM_QUEUE_NAME, prefetch, &args,
-                           STREAM_CONSUMER_TAG))
-      return -2;
-    long n = 0;
-    int64_t next_implicit = offset;  // fallback when no offset header
-    auto deadline = Clock::now() + milliseconds(timeout_ms);
-    const int quiet_ms = 250;
-    while (n < max_n && n < cap) {
-      auto now = Clock::now();
-      if (now >= deadline) break;
-      int wait_ms = static_cast<int>(
-          std::chrono::duration_cast<milliseconds>(deadline - now).count());
-      if (n > 0) wait_ms = std::min(wait_ms, quiet_ms);
-      Delivery d;
-      int r = c->pop_delivery(&d, wait_ms);
-      if (r == 1) {
-        c->basic_ack(d.tag);
-        int64_t off = d.offset >= 0 ? d.offset : next_implicit;
-        next_implicit = off + 1;
-        if (off >= offset) {  // broker may round down to a chunk boundary
-          offsets_out[n] = off;
-          values_out[n] = d.value;
-          ++n;
-        }
-      } else if (r == -1) {
-        break;  // deadline or quiet window elapsed
-      } else {
-        c->cancel_consumer(STREAM_CONSUMER_TAG);
-        return n > 0 ? n : -2;
-      }
-    }
-    c->cancel_consumer(STREAM_CONSUMER_TAG);
-    c->clear_deliveries();
-    return n;
+    return read_stream_queue(c, STREAM_QUEUE_NAME, STREAM_CONSUMER_TAG,
+                             offset, max_n, timeout_ms, offsets_out,
+                             values_out, cap);
   }
 
   void close_connection() {
@@ -1100,6 +1114,164 @@ class StreamClient {
   std::mutex mu_;
   std::shared_ptr<Connection> conn_;
   bool initialized_ = false;
+};
+
+// ---------------------------------------------------------------------------
+// Transactional client (BASELINE config #5): Elle list-append over AMQP tx.
+// Each key k lives in its own append-only stream queue ("elle.k<k>"); a
+// txn's appends ride one AMQP transaction (tx.select once per channel,
+// fire-and-forget basic.publish per append, then tx.commit — the commit-ok
+// is the atomic visibility point), and reads re-read the key's whole
+// stream non-destructively from offset 0.  tx wire constants:
+// amqp_wire.hpp CLS_TX/M_TX_*.
+// ---------------------------------------------------------------------------
+
+class TxnClient {
+ public:
+  explicit TxnClient(ClientConfig cfg) : cfg_(std::move(cfg)) {}
+
+  static std::string key_queue(int32_t key) {
+    return "elle.k" + std::to_string(key);
+  }
+
+  bool connect() {
+    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
+    while (Clock::now() < deadline) {
+      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
+                                               cfg_.user, cfg_.pass);
+      if (conn->open(5000)) {
+        std::lock_guard<std::mutex> lk(mu_);
+        conn_ = conn;
+        rconn_.reset();  // lazily reopened by the next read
+        initialized_ = false;
+        declared_.clear();
+        return true;
+      }
+      std::this_thread::sleep_for(milliseconds(1000));
+    }
+    logf("txn connect to %s: retry budget exhausted", cfg_.host.c_str());
+    return false;
+  }
+
+  bool initialize_if_necessary() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      if (!c) return false;
+      if (initialized_) return c->alive();
+    }
+    if (!c->tx_select()) {
+      logf("tx.select on %s failed", cfg_.host.c_str());
+      return false;
+    }
+    std::lock_guard<std::mutex> lk(mu_);
+    initialized_ = true;
+    return true;
+  }
+
+  // 0 staged (visible at commit), -2 error
+  int append(int32_t key, int32_t value) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c || !ensure_declared(c, key)) return -2;
+    return c->publish_plain(key_queue(key), value) ? 0 : -2;
+  }
+
+  // 1 committed, -1 outcome unknown, -2 determinate error.  Anything but
+  // success poisons the connection: AMQP tx replies carry no correlation
+  // id, so a late commit-ok left in flight could otherwise be matched to
+  // the NEXT txn's commit and report it committed prematurely.
+  int commit(int timeout_ms) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    int r = c->tx_commit(timeout_ms);
+    if (r != 1) close_connection();
+    return r;
+  }
+
+  // 0 rolled back, -2 error
+  int rollback(int timeout_ms) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    return c->tx_rollback(timeout_ms) ? 0 : -2;
+  }
+
+  // Committed list for the key, oldest first; count (≥0) or -2 on error.
+  // Reads run on a dedicated NON-tx connection: on a real broker the
+  // tx.select-ed channel buffers basic.acks until commit, so a stream
+  // consumer there would stall at the prefetch window (credit never
+  // replenishes) and silently truncate long reads — and a non-tx
+  // connection also guarantees reads observe committed state only.
+  long read_key(int32_t key, long max_n, int timeout_ms,
+                int32_t* values_out, long cap) {
+    auto c = read_conn();
+    if (!c || !ensure_declared(c, key)) return -2;
+    return read_stream_queue(c, key_queue(key), "jt-txn-reader", 0, max_n,
+                             timeout_ms, nullptr, values_out, cap);
+  }
+
+  void close_connection() {
+    std::shared_ptr<Connection> c, rc;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      rc = rconn_;
+      conn_.reset();
+      rconn_.reset();
+      initialized_ = false;
+      declared_.clear();
+    }
+    if (c) c->close();
+    if (rc) rc->close();
+  }
+
+  bool reconnect() {
+    close_connection();
+    return connect();
+  }
+
+ private:
+  std::shared_ptr<Connection> conn() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return conn_;
+  }
+
+  // lazily-opened plain (non-tx) connection for stream reads
+  std::shared_ptr<Connection> read_conn() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (rconn_ && rconn_->alive()) return rconn_;
+    }
+    auto rc = std::make_shared<Connection>(cfg_.host, cfg_.port, cfg_.user,
+                                           cfg_.pass);
+    if (!rc->open(5000)) return nullptr;
+    std::lock_guard<std::mutex> lk(mu_);
+    rconn_ = rc;
+    return rconn_;
+  }
+
+  bool ensure_declared(const std::shared_ptr<Connection>& c, int32_t key) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (declared_.count(key)) return true;
+    }
+    amqp::Table args;
+    args.put_str("x-queue-type", "stream");
+    if (!c->declare_queue(key_queue(key), args)) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    declared_.insert(key);
+    return true;
+  }
+
+  ClientConfig cfg_;
+  std::mutex mu_;
+  std::shared_ptr<Connection> conn_;
+  std::shared_ptr<Connection> rconn_;
+  bool initialized_ = false;
+  std::set<int32_t> declared_;
 };
 
 // drain: the correctness-critical final read (Utils.java:413-470)
@@ -1273,6 +1445,58 @@ void amqp_stream_close(void* p) {
 
 void amqp_stream_destroy(void* p) {
   auto* c = static_cast<StreamClient*>(p);
+  c->close_connection();
+  delete c;
+}
+
+// ---- txn client ABI (Elle list-append over AMQP tx) -----------------------
+
+void* amqp_txn_client_create(const char* host, int port, const char* user,
+                             const char* pass, int connect_retry_ms) {
+  ClientConfig cfg;
+  cfg.host = host ? host : "localhost";
+  cfg.port = port;
+  if (user) cfg.user = user;
+  if (pass) cfg.pass = pass;
+  if (connect_retry_ms > 0) cfg.connect_retry_ms = connect_retry_ms;
+  auto* c = new TxnClient(std::move(cfg));
+  if (!c->connect())
+    logf("initial txn connect failed for %s", host ? host : "?");
+  return c;
+}
+
+int amqp_txn_client_setup(void* p) {
+  return static_cast<TxnClient*>(p)->initialize_if_necessary() ? 0 : -1;
+}
+
+int amqp_txn_append(void* p, int key, int value) {
+  return static_cast<TxnClient*>(p)->append(key, value);
+}
+
+int amqp_txn_commit(void* p, int timeout_ms) {
+  return static_cast<TxnClient*>(p)->commit(timeout_ms);
+}
+
+int amqp_txn_rollback(void* p, int timeout_ms) {
+  return static_cast<TxnClient*>(p)->rollback(timeout_ms);
+}
+
+long amqp_txn_read_key(void* p, int key, int timeout_ms, int* values_out,
+                       long cap) {
+  return static_cast<TxnClient*>(p)->read_key(key, cap, timeout_ms,
+                                              values_out, cap);
+}
+
+int amqp_txn_reconnect(void* p) {
+  return static_cast<TxnClient*>(p)->reconnect() ? 0 : -1;
+}
+
+void amqp_txn_close(void* p) {
+  static_cast<TxnClient*>(p)->close_connection();
+}
+
+void amqp_txn_destroy(void* p) {
+  auto* c = static_cast<TxnClient*>(p);
   c->close_connection();
   delete c;
 }

@@ -1,0 +1,304 @@
+/* Independent-implementation interop probe.
+ *
+ * The reference validates its driver against a real broker on localhost
+ * (reference UtilsTest.java:50).  This image has no installable broker
+ * (zero egress; see native/BROKER_NOTE.md), so conformance is established
+ * differentially instead: this program drives the framework's mini broker
+ * (jepsen_tpu/testing/broker.py) through librabbitmq (rabbitmq-c, the
+ * system's independently-authored AMQP 0-9-1 client), exercising the same
+ * wire surface the C++ driver uses — handshake, queue.declare,
+ * confirm.select, basic.publish + publisher confirm, basic.get,
+ * basic.consume/deliver, tx.select/commit/rollback.  A shared misreading
+ * of the AMQP spec between the in-tree C++ codec (amqp_wire.hpp) and the
+ * in-tree mini broker cannot survive this probe: rabbitmq-c would refuse
+ * the frames.
+ *
+ * Only the public, soname-stable rabbitmq-c ABI is declared below (the
+ * image ships librabbitmq.so.4 without headers).
+ *
+ * Usage: interop_probe HOST PORT [tx]   — exits 0 iff every step passed.
+ */
+
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/time.h>
+
+/* ---- rabbitmq-c public ABI (librabbitmq.so.4) -------------------------- */
+
+typedef int amqp_boolean_t;
+typedef uint16_t amqp_channel_t;
+typedef uint32_t amqp_flags_t;
+typedef uint32_t amqp_method_number_t;
+
+typedef struct {
+  size_t len;
+  void *bytes;
+} amqp_bytes_t;
+
+typedef struct amqp_connection_state_t_ *amqp_connection_state_t;
+typedef struct amqp_socket_t_ amqp_socket_t;
+
+typedef struct {
+  amqp_method_number_t id;
+  void *decoded;
+} amqp_method_t;
+
+typedef enum {
+  AMQP_RESPONSE_NONE = 0,
+  AMQP_RESPONSE_NORMAL,
+  AMQP_RESPONSE_LIBRARY_EXCEPTION,
+  AMQP_RESPONSE_SERVER_EXCEPTION
+} amqp_response_type_enum;
+
+typedef struct {
+  amqp_response_type_enum reply_type;
+  amqp_method_t reply;
+  int library_error;
+} amqp_rpc_reply_t;
+
+typedef struct {
+  int num_entries;
+  void *entries;
+} amqp_table_t;
+
+typedef struct {
+  int num_blocks;
+  void **blocklist;
+} amqp_pool_blocklist_t;
+
+typedef struct {
+  size_t pagesize;
+  amqp_pool_blocklist_t pages;
+  amqp_pool_blocklist_t large_blocks;
+  int next_page;
+  char *alloc_block;
+  size_t alloc_used;
+} amqp_pool_t;
+
+typedef struct {
+  amqp_flags_t _flags;
+  amqp_bytes_t content_type;
+  amqp_bytes_t content_encoding;
+  amqp_table_t headers;
+  uint8_t delivery_mode;
+  uint8_t priority;
+  amqp_bytes_t correlation_id;
+  amqp_bytes_t reply_to;
+  amqp_bytes_t expiration;
+  amqp_bytes_t message_id;
+  uint64_t timestamp;
+  amqp_bytes_t type;
+  amqp_bytes_t user_id;
+  amqp_bytes_t app_id;
+  amqp_bytes_t cluster_id;
+} amqp_basic_properties_t;
+
+typedef struct {
+  amqp_basic_properties_t properties;
+  amqp_bytes_t body;
+  amqp_pool_t pool;
+} amqp_message_t;
+
+typedef struct {
+  amqp_channel_t channel;
+  amqp_bytes_t consumer_tag;
+  uint64_t delivery_tag;
+  amqp_boolean_t redelivered;
+  amqp_bytes_t exchange;
+  amqp_bytes_t routing_key;
+  amqp_message_t message;
+} amqp_envelope_t;
+
+enum { AMQP_SASL_METHOD_PLAIN = 0 };
+
+#define AMQP_BASIC_ACK_METHOD ((amqp_method_number_t)0x003C0050)
+#define AMQP_BASIC_GET_OK_METHOD ((amqp_method_number_t)0x003C0047)
+#define AMQP_BASIC_GET_EMPTY_METHOD ((amqp_method_number_t)0x003C0048)
+
+extern const amqp_table_t amqp_empty_table;
+extern const amqp_bytes_t amqp_empty_bytes;
+
+amqp_connection_state_t amqp_new_connection(void);
+int amqp_destroy_connection(amqp_connection_state_t);
+amqp_socket_t *amqp_tcp_socket_new(amqp_connection_state_t);
+int amqp_socket_open(amqp_socket_t *, const char *host, int port);
+amqp_rpc_reply_t amqp_login(amqp_connection_state_t, const char *vhost,
+                            int channel_max, int frame_max, int heartbeat,
+                            int sasl_method, ...);
+void *amqp_channel_open(amqp_connection_state_t, amqp_channel_t);
+amqp_rpc_reply_t amqp_get_rpc_reply(amqp_connection_state_t);
+void *amqp_queue_declare(amqp_connection_state_t, amqp_channel_t,
+                         amqp_bytes_t queue, amqp_boolean_t passive,
+                         amqp_boolean_t durable, amqp_boolean_t exclusive,
+                         amqp_boolean_t auto_delete, amqp_table_t args);
+void *amqp_confirm_select(amqp_connection_state_t, amqp_channel_t);
+int amqp_basic_publish(amqp_connection_state_t, amqp_channel_t,
+                       amqp_bytes_t exchange, amqp_bytes_t routing_key,
+                       amqp_boolean_t mandatory, amqp_boolean_t immediate,
+                       const amqp_basic_properties_t *, amqp_bytes_t body);
+int amqp_simple_wait_method(amqp_connection_state_t, amqp_channel_t,
+                            amqp_method_number_t expected,
+                            amqp_method_t *output);
+amqp_rpc_reply_t amqp_basic_get(amqp_connection_state_t, amqp_channel_t,
+                                amqp_bytes_t queue, amqp_boolean_t no_ack);
+amqp_rpc_reply_t amqp_read_message(amqp_connection_state_t, amqp_channel_t,
+                                   amqp_message_t *, int flags);
+void amqp_destroy_message(amqp_message_t *);
+void *amqp_basic_consume(amqp_connection_state_t, amqp_channel_t,
+                         amqp_bytes_t queue, amqp_bytes_t consumer_tag,
+                         amqp_boolean_t no_local, amqp_boolean_t no_ack,
+                         amqp_boolean_t exclusive, amqp_table_t args);
+amqp_rpc_reply_t amqp_consume_message(amqp_connection_state_t,
+                                      amqp_envelope_t *,
+                                      const struct timeval *timeout,
+                                      int flags);
+void amqp_destroy_envelope(amqp_envelope_t *);
+void *amqp_tx_select(amqp_connection_state_t, amqp_channel_t);
+void *amqp_tx_commit(amqp_connection_state_t, amqp_channel_t);
+void *amqp_tx_rollback(amqp_connection_state_t, amqp_channel_t);
+amqp_bytes_t amqp_cstring_bytes(const char *);
+void amqp_maybe_release_buffers(amqp_connection_state_t);
+
+/* ---- probe ------------------------------------------------------------- */
+
+#define CHECK(cond, what)                                   \
+  do {                                                      \
+    if (!(cond)) {                                          \
+      fprintf(stderr, "PROBE FAIL: %s\n", what);            \
+      return 1;                                             \
+    }                                                       \
+  } while (0)
+
+#define CHECK_RPC(r, what)                                               \
+  do {                                                                   \
+    if ((r).reply_type != AMQP_RESPONSE_NORMAL) {                        \
+      fprintf(stderr, "PROBE FAIL: %s (reply_type=%d lib_err=%d)\n",     \
+              what, (int)(r).reply_type, (r).library_error);             \
+      return 1;                                                          \
+    }                                                                    \
+  } while (0)
+
+enum { N_MSGS = 16 };
+
+static int body_int(amqp_bytes_t body) {
+  char buf[32];
+  size_t n = body.len < sizeof buf - 1 ? body.len : sizeof buf - 1;
+  memcpy(buf, body.bytes, n);
+  buf[n] = '\0';
+  return atoi(buf);
+}
+
+static int publish_one(amqp_connection_state_t c, const char *queue, int v,
+                       int want_confirm) {
+  char buf[16];
+  snprintf(buf, sizeof buf, "%d", v);
+  int rc = amqp_basic_publish(c, 1, amqp_cstring_bytes(""),
+                              amqp_cstring_bytes(queue), 1, 0, NULL,
+                              amqp_cstring_bytes(buf));
+  if (rc != 0) return -1;
+  if (want_confirm) {
+    amqp_method_t m;
+    if (amqp_simple_wait_method(c, 1, AMQP_BASIC_ACK_METHOD, &m) != 0)
+      return -2;
+  }
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    fprintf(stderr, "usage: interop_probe HOST PORT [tx]\n");
+    return 2;
+  }
+  const char *host = argv[1];
+  int port = atoi(argv[2]);
+  int with_tx = argc > 3 && strcmp(argv[3], "tx") == 0;
+  const char *queue = "probe.queue";
+
+  amqp_connection_state_t c = amqp_new_connection();
+  amqp_socket_t *sock = amqp_tcp_socket_new(c);
+  CHECK(sock != NULL, "tcp socket");
+  CHECK(amqp_socket_open(sock, host, port) == 0, "connect");
+  amqp_rpc_reply_t r =
+      amqp_login(c, "/", 0, 131072, 0, AMQP_SASL_METHOD_PLAIN, "guest",
+                 "guest");
+  CHECK_RPC(r, "login (handshake: start/tune/open)");
+
+  amqp_channel_open(c, 1);
+  CHECK_RPC(amqp_get_rpc_reply(c), "channel.open");
+  amqp_queue_declare(c, 1, amqp_cstring_bytes(queue), 0, 1, 0, 0,
+                     amqp_empty_table);
+  CHECK_RPC(amqp_get_rpc_reply(c), "queue.declare");
+  amqp_confirm_select(c, 1);
+  CHECK_RPC(amqp_get_rpc_reply(c), "confirm.select");
+
+  int seen[2 * N_MSGS] = {0};
+
+  /* publisher-confirmed publishes */
+  for (int v = 0; v < N_MSGS; ++v)
+    CHECK(publish_one(c, queue, v, 1) == 0, "publish+confirm");
+
+  /* polling reads: basic.get until get-empty */
+  int got = 0;
+  for (;;) {
+    amqp_maybe_release_buffers(c);
+    r = amqp_basic_get(c, 1, amqp_cstring_bytes(queue), 1);
+    CHECK_RPC(r, "basic.get");
+    if (r.reply.id == AMQP_BASIC_GET_EMPTY_METHOD) break;
+    CHECK(r.reply.id == AMQP_BASIC_GET_OK_METHOD, "get-ok method id");
+    amqp_message_t msg;
+    r = amqp_read_message(c, 1, &msg, 0);
+    CHECK_RPC(r, "read message (header+body frames)");
+    int v = body_int(msg.body);
+    CHECK(v >= 0 && v < N_MSGS && !seen[v], "get value unique+known");
+    seen[v] = 1;
+    ++got;
+    amqp_destroy_message(&msg);
+  }
+  CHECK(got == N_MSGS, "all published values read back via basic.get");
+
+  /* push consume: basic.consume + deliver */
+  for (int v = 0; v < N_MSGS; ++v)
+    CHECK(publish_one(c, queue, N_MSGS + v, 1) == 0, "publish round 2");
+  amqp_basic_consume(c, 1, amqp_cstring_bytes(queue), amqp_empty_bytes, 0,
+                     1, 0, amqp_empty_table);
+  CHECK_RPC(amqp_get_rpc_reply(c), "basic.consume");
+  for (int i = 0; i < N_MSGS; ++i) {
+    amqp_envelope_t env;
+    struct timeval tv = {5, 0};
+    amqp_maybe_release_buffers(c);
+    r = amqp_consume_message(c, &env, &tv, 0);
+    CHECK_RPC(r, "consume (basic.deliver + content)");
+    int v = body_int(env.message.body);
+    CHECK(v >= N_MSGS && v < 2 * N_MSGS && !seen[v], "deliver value");
+    seen[v] = 1;
+    amqp_destroy_envelope(&env);
+  }
+
+  if (with_tx) {
+    /* tx class: committed publish is visible, rolled-back one is not */
+    amqp_tx_select(c, 1);
+    CHECK_RPC(amqp_get_rpc_reply(c), "tx.select");
+    CHECK(publish_one(c, queue, 7777, 0) == 0, "tx publish");
+    amqp_tx_rollback(c, 1);
+    CHECK_RPC(amqp_get_rpc_reply(c), "tx.rollback");
+    CHECK(publish_one(c, queue, 8888, 0) == 0, "tx publish 2");
+    amqp_tx_commit(c, 1);
+    CHECK_RPC(amqp_get_rpc_reply(c), "tx.commit");
+    amqp_envelope_t env;
+    struct timeval tv = {5, 0};
+    amqp_maybe_release_buffers(c);
+    r = amqp_consume_message(c, &env, &tv, 0);
+    CHECK_RPC(r, "consume committed tx message");
+    CHECK(body_int(env.message.body) == 8888,
+          "rollback invisible, commit visible");
+    amqp_destroy_envelope(&env);
+  }
+
+  printf("PROBE OK: handshake, declare, %d confirmed publishes, "
+         "%d gets, %d delivers%s\n",
+         2 * N_MSGS, N_MSGS, N_MSGS, with_tx ? ", tx" : "");
+  amqp_destroy_connection(c);
+  return 0;
+}

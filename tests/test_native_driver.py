@@ -266,3 +266,153 @@ def test_stream_full_pipeline_lossy_broker_caught(native_lib):
         assert r["lost-count"] == 2  # appends 5 and 10 dropped
     finally:
         b.stop()
+
+
+class TestInteropProbe:
+    """Independent-implementation conformance: rabbitmq-c (librabbitmq.so.4,
+    shipped with the image) drives the mini broker over TCP.  A shared
+    spec misreading between the in-tree C++ codec and the in-tree broker
+    cannot survive this — see native/BROKER_NOTE.md."""
+
+    @pytest.fixture(scope="class")
+    def probe(self):
+        r = subprocess.run(
+            ["make", "-C", str(NATIVE), "interop_probe"],
+            capture_output=True,
+            text=True,
+        )
+        if r.returncode != 0:
+            pytest.skip(f"probe build failed:\n{r.stderr}")
+        return NATIVE / "interop_probe"
+
+    def test_rabbitmq_c_interop(self, probe, broker):
+        r = subprocess.run(
+            [str(probe), "127.0.0.1", str(broker.port)],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "PROBE OK" in r.stdout
+
+
+class TestNativeTxn:
+    """Elle list-append over AMQP tx (BASELINE config #5 live path)."""
+
+    def _txn_driver(self, native_lib, broker, **kw):
+        from jepsen_tpu.client.native import NativeTxnDriver
+
+        kw.setdefault("connect_retry_ms", 3000)
+        kw.setdefault("read_timeout_s", 0.4)
+        return NativeTxnDriver("127.0.0.1", port=broker.port, **kw)
+
+    def test_txn_commit_roundtrip_and_read_your_writes(
+        self, native_lib, broker
+    ):
+        d = self._txn_driver(native_lib, broker)
+        d.setup()
+        done = d.txn(
+            [["append", 0, 1], ["r", 0, None], ["append", 0, 2]], 5.0
+        )
+        # read-your-writes: the mid-txn read sees the staged append
+        assert done[1] == ["r", 0, [1]]
+        d2 = self._txn_driver(native_lib, broker)
+        d2.setup()
+        done2 = d2.txn([["r", 0, None]], 5.0)
+        assert done2 == [["r", 0, [1, 2]]]  # commit made both visible
+        d.close()
+        d2.close()
+
+    def test_txn_rollback_invisible(self, native_lib, broker):
+        lib = native_lib.load_library()
+        h = lib.amqp_txn_client_create(
+            b"127.0.0.1", broker.port, b"guest", b"guest", 3000
+        )
+        assert lib.amqp_txn_client_setup(h) == 0
+        assert lib.amqp_txn_append(h, 5, 77) == 0
+        assert lib.amqp_txn_rollback(h, 5000) == 0
+        d = self._txn_driver(native_lib, broker)
+        d.setup()
+        assert d.txn([["r", 5, None]], 5.0) == [["r", 5, []]]
+        lib.amqp_txn_destroy(h)
+        d.close()
+
+    def test_live_elle_clean_run_is_valid(self, native_lib, broker):
+        from jepsen_tpu.checkers.elle import check_elle_batch, check_elle_cpu
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+        d = self._txn_driver(native_lib, broker)
+        d.setup()
+        history = []
+        ctr = iter(range(1000))
+        for i in range(8):
+            k = i % 3
+            mops = [["append", k, next(ctr)], ["r", k, None]]
+            inv = Op.invoke(OpF.TXN, 0, mops)
+            history.append(inv)
+            done = d.txn(mops, 5.0)
+            history.append(inv.complete(OpType.OK, value=done))
+        d.close()
+        h = reindex(history)
+        r = check_elle_cpu(h)
+        assert r["valid?"], r
+        assert check_elle_batch([h])[0]["valid?"]
+
+    def test_live_elle_g1c_dirty_reads_caught(self, native_lib):
+        """Two transactions each read the other's *uncommitted* write
+        (broker fault: read-uncommitted visibility) — a wr-cycle the elle
+        checker must classify as G1c, through the real native driver."""
+        from jepsen_tpu.checkers.elle import check_elle_batch, check_elle_cpu
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+        from jepsen_tpu.testing.broker import MiniAmqpBroker
+
+        b = MiniAmqpBroker(dirty_tx_reads=True).start()
+        lib = native_lib.load_library()
+        try:
+            ha = lib.amqp_txn_client_create(
+                b"127.0.0.1", b.port, b"guest", b"guest", 3000
+            )
+            hb = lib.amqp_txn_client_create(
+                b"127.0.0.1", b.port, b"guest", b"guest", 3000
+            )
+            assert lib.amqp_txn_client_setup(ha) == 0
+            assert lib.amqp_txn_client_setup(hb) == 0
+            # interleaved: both append, then both read the other's key
+            assert lib.amqp_txn_append(ha, 0, 100) == 0
+            assert lib.amqp_txn_append(hb, 1, 200) == 0
+
+            def read_key(h, k):
+                import ctypes
+
+                vals = (ctypes.c_int * 64)()
+                n = lib.amqp_txn_read_key(h, k, 400, vals, 64)
+                assert n >= 0
+                return [int(vals[i]) for i in range(n)]
+
+            ra = read_key(ha, 1)  # A observes B's uncommitted append
+            rb = read_key(hb, 0)  # B observes A's uncommitted append
+            assert ra == [200] and rb == [100]
+            assert lib.amqp_txn_commit(ha, 5000) == 1
+            assert lib.amqp_txn_commit(hb, 5000) == 1
+
+            mops_a = [["append", 0, 100], ["r", 1, ra]]
+            mops_b = [["append", 1, 200], ["r", 0, rb]]
+            inv_a = Op.invoke(OpF.TXN, 0, mops_a)
+            inv_b = Op.invoke(OpF.TXN, 1, mops_b)
+            h = reindex(
+                [
+                    inv_a,
+                    inv_b,
+                    inv_a.complete(OpType.OK, value=mops_a),
+                    inv_b.complete(OpType.OK, value=mops_b),
+                ]
+            )
+            r = check_elle_cpu(h)
+            assert not r["valid?"]
+            assert r["G1c-count"] == 2 and r["G0-count"] == 0, r
+            rt = check_elle_batch([h])[0]
+            assert not rt["valid?"] and rt["G1c-count"] == 2
+            lib.amqp_txn_destroy(ha)
+            lib.amqp_txn_destroy(hb)
+        finally:
+            b.stop()

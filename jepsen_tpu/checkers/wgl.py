@@ -31,18 +31,19 @@ Indeterminate (``info``) ops follow Knossos semantics: they may linearize
 at any point after their invocation — they join every later event's
 candidate set — or never (no return event forces them).
 
-**Backend guidance (measured 2026-07)**: on the CPU backend the tensor
-engine compiles in seconds and matches the classic search exactly (the
-differential tests in ``tests/test_wgl.py``).  On the tunneled single-chip
-TPU environment this repo develops against, *compiling* this program (the
-``while_loop``-inside-``scan`` nest) took > 9 minutes even for 10-op
-histories — the remote-compile hop amplifies complex control flow — so
-``QueueWgl(backend="tpu")`` is correct but compile-bound there.  For the
-quorum-queue workload this doesn't matter in practice: the per-value
-decomposition (``jepsen_tpu.checkers.queue_lin``, P-compositionality) is
-the TPU-fast linearizability path and covers the model exactly; the WGL
-engine is the general-model fallback (CAS registers, mutexes, FIFO) where
-the CPU engine — or a TPU stack with local compilation — serves.
+**Backend guidance — measured, see ``WGL_BENCH.md`` (2026-07-29, real
+chip)**: compile cost on the tunneled TPU is ~0.6 s per history-op row
+(23.6 s at 50 ops, 131.5 s at 200 ops — linear, cached per shape after
+the first call); steady-state run time beats the CPU-backend tensor
+engine 4.5–12× but is comparable to the classic host search (32 ms vs 24 ms per
+100-op history at batch 256, where 128-row frontiers overflow to
+*unknown* on the hardest histories — the documented CPU escape hatch).
+So ``QueueWgl(backend="tpu")`` is correct and usable on-chip; for the
+quorum-queue workload the TPU-fast linearizability path remains the
+per-value decomposition (``jepsen_tpu.checkers.queue_lin``,
+P-compositionality), which covers the model exactly at millions of
+histories/s.  The WGL engine is the general-model fallback (CAS
+registers, mutexes, FIFO).
 """
 
 from __future__ import annotations

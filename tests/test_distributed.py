@@ -1,0 +1,105 @@
+"""Multi-process checker plane: ``init_multihost`` over localhost.
+
+Two OS processes each hold 4 virtual CPU devices; ``jax.distributed``
+joins them into one 8-device runtime and the sharded quorum-queue check
+runs pod-style over the global ``(hist, seq)`` mesh.  This is the DCN
+story of SURVEY.md §2.4 exercised for real — process 0 is the
+coordinator, process 1 a worker — with the verdict differentially checked
+against the single-process CPU reference.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+_WORKER = r"""
+import json, os, sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+port, pid = sys.argv[1], int(sys.argv[2])
+
+from jepsen_tpu.parallel.distributed import (
+    global_checker_mesh,
+    init_multihost,
+    is_coordinator,
+)
+
+init_multihost(f"localhost:{port}", num_processes=2, process_id=pid)
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8, len(jax.devices())
+assert is_coordinator() == (pid == 0)
+
+from jepsen_tpu.history.encode import pack_histories
+from jepsen_tpu.history.synth import SynthSpec, synth_batch
+from jepsen_tpu.parallel import shard_packed, sharded_total_queue
+
+# identical data on both processes (same seed) -> consistent global array
+shs = synth_batch(8, SynthSpec(n_ops=40, seed=7), lost=2)
+packed = pack_histories([s.ops for s in shs], length=128)
+mesh = global_checker_mesh(seq=2)
+assert dict(mesh.shape) == {"hist": 4, "seq": 2}
+sharded = shard_packed(packed, mesh)
+tq = sharded_total_queue(sharded, mesh)
+
+# every process sees the same global verdict via process_allgather
+from jax.experimental import multihost_utils
+
+valid = [
+    bool(v) for v in multihost_utils.process_allgather(tq.valid, tiled=True)
+]
+lost = int((multihost_utils.process_allgather(tq.lost, tiled=True) > 0).sum())
+print(json.dumps({"pid": pid, "valid": valid, "lost": lost}), flush=True)
+"""
+
+
+def test_init_multihost_two_process_sharded_check():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=REPO,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+            outs.append(json.loads(out.strip().splitlines()[-1]))
+    finally:
+        # a failed/hung worker must not orphan its sibling (it would sit
+        # inside jax.distributed.initialize holding the coordinator port)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # both processes computed the same global verdict
+    assert outs[0]["valid"] == outs[1]["valid"]
+    assert outs[0]["lost"] == outs[1]["lost"]
+
+    # differential: single-process CPU reference on the same histories
+    from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+    shs = synth_batch(8, SynthSpec(n_ops=40, seed=7), lost=2)
+    ref = [check_total_queue_cpu(s.ops) for s in shs]
+    assert outs[0]["valid"] == [r["valid?"] for r in ref]
+    assert outs[0]["lost"] == sum(r["lost-count"] for r in ref)

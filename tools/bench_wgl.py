@@ -1,0 +1,126 @@
+"""Measure the tensor WGL engine on the current JAX backend.
+
+Backs the backend-guidance claim in ``jepsen_tpu/checkers/wgl.py`` with
+recorded numbers (compile time + steady-state check time per history
+size) instead of a docstring assertion.  Results land in ``WGL_BENCH.md``.
+
+Each size runs in a subprocess with a hard deadline, because the very
+thing under measurement is whether XLA compilation of the
+while_loop-inside-scan search nest is tractable on the target backend —
+a hung compile must produce a row saying so, not hang the bench.
+
+Usage:
+  python tools/bench_wgl.py                 # default backend (TPU if any)
+  python tools/bench_wgl.py --sizes 8 16 24 --deadline 900
+  python tools/bench_wgl.py --one 16        # internal: single measurement
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+# runnable from anywhere: the repo root is this file's parent's parent
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
+    import jax
+
+    if platform:
+        # config pin beats the sitecustomize env override (env vars alone
+        # are too late once the interpreter bootstrapped the plugin path)
+        jax.config.update("jax_platforms", platform)
+
+    from jepsen_tpu.checkers.wgl import (
+        check_wgl_cpu,
+        pack_wgl_batch,
+        queue_wgl_ops,
+        wgl_tensor_check,
+    )
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+    from jepsen_tpu.models.core import UnorderedQueue
+
+    shs = synth_batch(batch, SynthSpec(n_ops=n_ops, n_processes=3))
+    opss = [queue_wgl_ops(sh.ops) for sh in shs]
+    packed = pack_wgl_batch(opss)
+    vs = 32 * max(1, (max(o.call.a0 for ops in opss for o in ops) + 32) // 32)
+    model_key = (UnorderedQueue, (vs,))
+
+    t0 = time.perf_counter()
+    ok, unknown = wgl_tensor_check(packed, model_key)
+    compile_s = time.perf_counter() - t0  # first call: trace + compile + run
+
+    times = []
+    for _ in range(3):
+        t1 = time.perf_counter()
+        ok, unknown = wgl_tensor_check(packed, model_key)
+        times.append(time.perf_counter() - t1)
+    run_s = min(times)  # best-of: a tunnel hiccup must not inflate the row
+
+    t2 = time.perf_counter()
+    for ops in opss:
+        check_wgl_cpu(ops, UnorderedQueue(vs))
+    cpu_s = (time.perf_counter() - t2) / batch
+
+    return {
+        "n_ops": n_ops,
+        "batch": batch,
+        "backend": jax.default_backend(),
+        "compile_s": round(compile_s, 2),
+        "run_s": round(run_s, 4),
+        "run_per_history_ms": round(run_s / batch * 1e3, 3),
+        "cpu_classic_per_history_ms": round(cpu_s * 1e3, 3),
+        "all_linearizable": bool(ok.all()),
+        "any_unknown": bool(unknown.any()),
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", type=int, nargs="+", default=[8, 16, 24])
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--deadline", type=float, default=900.0)
+    p.add_argument("--one", type=int, default=0, help="internal")
+    p.add_argument(
+        "--platform", default="", help="pin backend (e.g. cpu) via jax.config"
+    )
+    args = p.parse_args()
+
+    if args.one:
+        print(json.dumps(measure_one(args.one, args.batch, args.platform)))
+        return
+
+    rows = []
+    for n in args.sizes:
+        cmd = [
+            sys.executable, __file__, "--one", str(n),
+            "--batch", str(args.batch), "--platform", args.platform,
+        ]
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=args.deadline
+            )
+            if r.returncode == 0:
+                row = json.loads(r.stdout.strip().splitlines()[-1])
+            else:
+                row = {"n_ops": n, "error": r.stderr[-300:]}
+        except subprocess.TimeoutExpired:
+            row = {
+                "n_ops": n,
+                "timeout": True,
+                "deadline_s": args.deadline,
+                "note": "compile did not finish before the deadline",
+            }
+        row["wall_s"] = round(time.perf_counter() - t0, 1)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,6 @@ Ops:
 
 from __future__ import annotations
 
-import functools
 import logging
 import socketserver
 import threading
@@ -40,25 +39,6 @@ logger = logging.getLogger("jepsen_tpu.service")
 REQUIRED_ARRAYS = ("f", "type", "value", "mask")
 
 
-@functools.lru_cache(maxsize=64)
-def _check_program(value_space: int):
-    """Jitted combined check for one scatter width (shapes weakly cached
-    by jit itself)."""
-    import jax
-
-    from jepsen_tpu.checkers.queue_lin import _queue_lin_batch
-    from jepsen_tpu.checkers.total_queue import _total_queue_batch
-
-    @jax.jit
-    def run(f, type_, value, mask):
-        return (
-            _total_queue_batch(f, type_, value, mask, value_space),
-            _queue_lin_batch(f, type_, value, mask, value_space),
-        )
-
-    return run
-
-
 def _check_arrays(
     arrays: dict[str, np.ndarray], value_space: int
 ) -> dict[str, Any]:
@@ -74,7 +54,10 @@ def _check_arrays(
     type_ = jnp.asarray(arrays["type"], jnp.int32)
     value = jnp.asarray(arrays["value"], jnp.int32)
     mask = jnp.asarray(arrays["mask"].astype(bool))
-    tq, ql = _check_program(value_space)(f, type_, value, mask)
+    from jepsen_tpu.checkers.fused import _combined_batch
+
+    # the canonical single-program combined check (checkers/fused.py)
+    tq, ql = _combined_batch(f, type_, value, mask, value_space)
     tq_results = _tensors_to_results(tq)
     ql_results = queue_lin_tensors_to_results(ql)
     out = []

@@ -65,3 +65,12 @@ def test_fused_non_default_tile():
     tq_f, ql_f = fused_tensor_check(packed, interpret=True)
     assert_tree_equal(tq_f, total_queue_tensor_check(packed))
     assert_tree_equal(ql_f, queue_lin_tensor_check(packed))
+
+
+def test_combined_single_program_equals_separate_checks():
+    from jepsen_tpu.checkers.fused import combined_tensor_check
+
+    packed = _packed(lost=1, duplicated=1, causality=1)
+    tq_c, ql_c = combined_tensor_check(packed)
+    assert_tree_equal(tq_c, total_queue_tensor_check(packed))
+    assert_tree_equal(ql_c, queue_lin_tensor_check(packed))

@@ -84,16 +84,24 @@ BLOCKS = 3
 BLOCK_ITERS = 6
 
 
-def _roll_variants(tree, n: int):
+def _roll_variants(tree, n: int, period: int):
     """``n`` distinct device copies of a batch: each rolled along the
     batch axis by a different offset.  Same histories (verdicts
     unchanged), different array contents — every timed dispatch must be
     unique, because the tunneled remote-execution service caches repeated
     (program, args) pairs and would otherwise report super-roofline
-    rates (round-2 finding: repeats ran 1.6× faster than fresh inputs)."""
+    rates (round-2 finding: repeats ran 1.6× faster than fresh inputs).
+
+    ``period`` is the batch's repetition period along axis 0 (the base
+    history count before tiling): a roll by a multiple of it is
+    byte-identical, which would silently re-admit the cache."""
     import jax
     import jax.numpy as jnp
 
+    assert n < period, (
+        f"{n} variants would repeat within the tiled batch's period "
+        f"{period} — rolled copies must stay byte-distinct"
+    )
     out = [
         jax.tree.map(lambda x: jnp.roll(x, k + 1, axis=0), tree)
         for k in range(n)
@@ -159,7 +167,9 @@ def _bench_queue(details: dict) -> tuple[float, float]:
 
     # both verdicts as one XLA program: shared scatter passes, one
     # dispatch (see checkers/fused.py combined_tensor_check)
-    variants = _roll_variants(big, 1 + BLOCKS * BLOCK_ITERS)
+    variants = _roll_variants(
+        big, 1 + BLOCKS * BLOCK_ITERS, period=BASE_HISTORIES
+    )
     rate, dt = _timed_rate(combined_tensor_check, variants, batch)
     del variants
     print(
@@ -210,7 +220,9 @@ def _bench_stream(details: dict) -> None:
         lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
     )
 
-    variants = _roll_variants(big, 1 + BLOCKS * BLOCK_ITERS)
+    variants = _roll_variants(
+        big, 1 + BLOCKS * BLOCK_ITERS, period=packed.batch
+    )
     rate, dt = _timed_rate(stream_lin_tensor_check, variants, big.batch)
     del variants
 
@@ -254,7 +266,9 @@ def _bench_elle(details: dict) -> None:
         lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
     )
 
-    variants = _roll_variants(big, 1 + BLOCKS * BLOCK_ITERS)
+    variants = _roll_variants(
+        big, 1 + BLOCKS * BLOCK_ITERS, period=packed.batch
+    )
     rate, dt = _timed_rate(elle_tensor_check, variants, big.batch)
     del variants
 

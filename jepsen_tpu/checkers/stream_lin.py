@@ -328,11 +328,8 @@ class StreamLinTensors:
     read_value_count: jax.Array  # [B] i32
 
 
-def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
+def _stream_row_masks(type_, f, value, offset, mask):
     is_app = (f == int(OpF.APPEND)) & (value >= 0) & mask
-    app_inv = is_app & (type_ == int(OpType.INVOKE))
-    app_ok = is_app & (type_ == int(OpType.OK))
-    app_fail = is_app & (type_ == int(OpType.FAIL))
     is_read = (
         (f == int(OpF.READ))
         & (type_ == int(OpType.OK))
@@ -340,29 +337,48 @@ def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
         & (offset >= 0)
         & mask
     )
+    return is_app, is_read
 
-    a = masked_value_counts(value, app_inv, S)
-    k = masked_value_counts(value, app_ok, S)
-    x = masked_value_counts(value, app_fail, S)
-    s_v = masked_value_reduce_min(value, app_inv, pos, S, init=_INF)
-    e_v = masked_value_reduce_min(value, app_ok, pos, S, init=_INF)
 
-    r = masked_value_counts(value, is_read, S)  # read rows per value
-    omin = masked_value_reduce_min(value, is_read, offset, S, init=_INF)
-    omax = masked_value_reduce_max(value, is_read, offset, S, init=-1)
-    vmin = masked_value_reduce_min(offset, is_read, value, S, init=_INF)
-    vmax = masked_value_reduce_max(offset, is_read, value, S, init=-1)
-    observed = masked_value_counts(offset, is_read, S) >= 1  # by offset
+# how each phase-A stat combines across seq shards (consumed by the
+# seq-parallel program in jepsen_tpu.parallel.mesh — kept here, next to
+# the stat definitions, so adding a stat forces updating its combine kind)
+STREAM_COMBINE = {
+    "a": "sum", "k": "sum", "x": "sum", "r": "sum", "obs": "sum",
+    "s_v": "min", "e_v": "min", "omin": "min", "vmin": "min",
+    "omax": "max", "vmax": "max",
+}
 
-    read = r >= 1
-    duplicate = read & (omin != omax)
-    divergent = observed & (vmin != vmax)
-    phantom = read & ((a == 0) | (x >= a))
 
-    # real-time order over the offset axis: gather per-value append
-    # positions through each read row, scatter to the row's offset, then an
-    # exclusive reversed cumulative min finds any later-offset append that
-    # completed before this offset's append was invoked.
+def _stream_phase_a(type_, f, value, offset, pos, mask, S):
+    """Row-block → per-value/per-offset segment reductions.  Linear in the
+    op axis, so row blocks combine across shards with psum (counts) and
+    pmin/pmax (the reduces) — the seq-parallel lever."""
+    is_app, is_read = _stream_row_masks(type_, f, value, offset, mask)
+    app_inv = is_app & (type_ == int(OpType.INVOKE))
+    app_ok = is_app & (type_ == int(OpType.OK))
+    app_fail = is_app & (type_ == int(OpType.FAIL))
+
+    return dict(
+        a=masked_value_counts(value, app_inv, S),
+        k=masked_value_counts(value, app_ok, S),
+        x=masked_value_counts(value, app_fail, S),
+        s_v=masked_value_reduce_min(value, app_inv, pos, S, init=_INF),
+        e_v=masked_value_reduce_min(value, app_ok, pos, S, init=_INF),
+        r=masked_value_counts(value, is_read, S),  # read rows per value
+        omin=masked_value_reduce_min(value, is_read, offset, S, init=_INF),
+        omax=masked_value_reduce_max(value, is_read, offset, S, init=-1),
+        vmin=masked_value_reduce_min(offset, is_read, value, S, init=_INF),
+        vmax=masked_value_reduce_max(offset, is_read, value, S, init=-1),
+        obs=masked_value_counts(offset, is_read, S),  # reads per offset
+    )
+
+
+def _stream_phase_b(type_, f, value, offset, mask, s_v, e_v, S):
+    """Row-block + *globally combined* ``s_v``/``e_v`` → per-offset
+    real-time stats (max append-invoke ``s_at``, min append-ok ``e_at``).
+    Combines across shards with pmax/pmin."""
+    _, is_read = _stream_row_masks(type_, f, value, offset, mask)
     s_gathered = s_v[jnp.clip(value, 0, S - 1)]
     # values whose append was never invoked (s == INF) impose no order
     has_s = is_read & (s_gathered != _INF)
@@ -370,19 +386,40 @@ def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
     e_row = jnp.where(is_read, e_v[jnp.clip(value, 0, S - 1)], _INF)
     s_at = masked_value_reduce_max(offset, has_s, s_row, S, init=_NEG)
     e_at = masked_value_reduce_min(offset, is_read, e_row, S, init=_INF)
+    return s_at, e_at
+
+
+def _stream_nonmono_local(type_, f, value, offset, mask, first):
+    """Within-op monotonicity over a row block: consecutive exploded rows
+    of one read batch must have strictly increasing offsets (``first``
+    marks batch starts).  Returns the block's pair count (the pair that
+    straddles a shard boundary is the caller's to add — see the seq-
+    sharded body in ``parallel.mesh``)."""
+    _, is_read = _stream_row_masks(type_, f, value, offset, mask)
+    nxt_read = jnp.roll(is_read, -1).at[-1].set(False)
+    nxt_first = jnp.roll(first, -1).at[-1].set(True)
+    nxt_off = jnp.roll(offset, -1)
+    nonmono = is_read & nxt_read & ~nxt_first & (nxt_off <= offset)
+    return nonmono.sum().astype(jnp.int32)
+
+
+def _stream_classify(stats, s_at, e_at, nonmono_count, full_read):
+    """Combined [S] stats → verdict tensors (replicated over seq)."""
+    a, k, x, r = stats["a"], stats["k"], stats["x"], stats["r"]
+    observed = stats["obs"] >= 1
+    read = r >= 1
+    duplicate = read & (stats["omin"] != stats["omax"])
+    divergent = observed & (stats["vmin"] != stats["vmax"])
+    phantom = read & ((a == 0) | (x >= a))
+
+    # real-time order over the offset axis: an exclusive reversed
+    # cumulative min finds any later-offset append that completed before
+    # this offset's append was invoked.
     suff_incl = jax.lax.associative_scan(jnp.minimum, e_at, reverse=True)
     suff_excl = jnp.concatenate(
         [suff_incl[1:], jnp.full((1,), _INF, jnp.int32)]
     )
     reorder = observed & (s_at != _NEG) & (suff_excl < s_at)
-
-    # within-op monotonicity: consecutive exploded rows of one read batch
-    # must have strictly increasing offsets (``first`` marks batch starts).
-    nxt_read = jnp.roll(is_read, -1).at[-1].set(False)
-    nxt_first = jnp.roll(first, -1).at[-1].set(True)
-    nxt_off = jnp.roll(offset, -1)
-    nonmono = is_read & nxt_read & ~nxt_first & (nxt_off <= offset)
-    nonmono_count = nonmono.sum().astype(jnp.int32)
 
     lost = jnp.where(full_read, (k >= 1) & ~read, False)
 
@@ -406,6 +443,15 @@ def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
         acknowledged_count=k.sum().astype(jnp.int32),
         read_value_count=read.sum().astype(jnp.int32),
     )
+
+
+def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
+    stats = _stream_phase_a(type_, f, value, offset, pos, mask, S)
+    s_at, e_at = _stream_phase_b(
+        type_, f, value, offset, mask, stats["s_v"], stats["e_v"], S
+    )
+    nonmono_count = _stream_nonmono_local(type_, f, value, offset, mask, first)
+    return _stream_classify(stats, s_at, e_at, nonmono_count, full_read)
 
 
 @functools.partial(jax.jit, static_argnames=("space",))

@@ -170,13 +170,14 @@ def sharded_check(
 
 
 # ---------------------------------------------------------------------------
-# Stream and elle checkers: data-parallel over `hist` only.  Each history is
-# independent, so placing the batch axis on the mesh and jitting lets XLA
-# partition with zero communication — no shard_map needed.  (Their classify
-# stages scan *within* a history — suffix-min over offsets, adjacent-row
-# monotonicity, matmul closure — so the op/txn axes don't shard freely the
-# way the count kernels above do; `hist` is the scaling axis that matters:
-# the north-star workload is millions of independent histories.)
+# Stream checker: hist × seq, like the queue family.  Phase A (segment
+# reductions over the op axis) shards freely and combines with
+# psum/pmin/pmax; phase B re-reads the rows against the *combined*
+# per-value mins; the one structurally sequential piece — within-read-batch
+# offset monotonicity between adjacent rows — needs exactly one row of
+# state from the next shard, exchanged with a single ppermute.  The elle
+# checker stays data-parallel over `hist` (its per-history work is an MXU
+# matmul closure, not a row scan).
 # ---------------------------------------------------------------------------
 
 
@@ -188,11 +189,147 @@ def _hist_sharded(tree, mesh: Mesh):
     return jax.tree.map(put, tree)
 
 
-def sharded_stream_lin(batch, mesh: Mesh):
-    """Stream-log linearizability, histories sharded over ``hist``."""
-    from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
+@functools.lru_cache(maxsize=64)
+def _stream_lin_program(mesh: Mesh, space: int):
+    from jepsen_tpu.checkers.stream_lin import (
+        STREAM_COMBINE as _STREAM_COMBINE,
+        _stream_classify,
+        _stream_nonmono_local,
+        _stream_phase_a,
+        _stream_phase_b,
+        _stream_row_masks,
+    )
 
-    return stream_lin_tensor_check(_hist_sharded(batch, mesh))
+    n_seq = mesh.shape[SEQ_AXIS]
+
+    def body(type_, f, value, offset, pos, mask, first, full_read):
+        stats = jax.vmap(
+            lambda t, ff, v, o, p, m: _stream_phase_a(t, ff, v, o, p, m, space)
+        )(type_, f, value, offset, pos, mask)
+        combined = {}
+        for key, val in stats.items():
+            kind = _STREAM_COMBINE[key]
+            if kind == "sum":
+                combined[key] = jax.lax.psum(val, SEQ_AXIS)
+            elif kind == "min":
+                combined[key] = jax.lax.pmin(val, SEQ_AXIS)
+            else:
+                combined[key] = jax.lax.pmax(val, SEQ_AXIS)
+
+        s_at, e_at = jax.vmap(
+            lambda t, ff, v, o, m, sv, ev: _stream_phase_b(
+                t, ff, v, o, m, sv, ev, space
+            )
+        )(type_, f, value, offset, mask, combined["s_v"], combined["e_v"])
+        s_at = jax.lax.pmax(s_at, SEQ_AXIS)
+        e_at = jax.lax.pmin(e_at, SEQ_AXIS)
+
+        nm = jax.vmap(_stream_nonmono_local)(
+            type_, f, value, offset, mask, first
+        )
+        # the read-batch pair straddling the shard boundary: fetch the
+        # next shard's first row (three scalars per history) and test it
+        # against this shard's last row.  The right edge receives zeros
+        # (is_read=False), which correctly disables the pair.
+        _, is_read = jax.vmap(_stream_row_masks)(type_, f, value, offset, mask)
+        perm = [(i + 1, i) for i in range(n_seq - 1)]
+        recv_read, recv_first, recv_off = (
+            jax.lax.ppermute(x, SEQ_AXIS, perm)
+            for x in (is_read[:, 0], first[:, 0], offset[:, 0])
+        )
+        boundary = (
+            is_read[:, -1] & recv_read & ~recv_first
+            & (recv_off <= offset[:, -1])
+        )
+        nm = jax.lax.psum(nm + boundary.astype(jnp.int32), SEQ_AXIS)
+
+        return jax.vmap(
+            lambda st, sa, ea, n, fl: _stream_classify(st, sa, ea, n, fl)
+        )(combined, s_at, e_at, nm, full_read)
+
+    from jepsen_tpu.checkers.stream_lin import StreamLinTensors
+
+    out_specs = StreamLinTensors(
+        valid=P(HIST_AXIS),
+        divergent=P(HIST_AXIS, None),
+        duplicate=P(HIST_AXIS, None),
+        phantom=P(HIST_AXIS, None),
+        reorder=P(HIST_AXIS, None),
+        nonmonotonic_count=P(HIST_AXIS),
+        lost=P(HIST_AXIS, None),
+        attempt_count=P(HIST_AXIS),
+        acknowledged_count=P(HIST_AXIS),
+        read_value_count=P(HIST_AXIS),
+    )
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(_row_spec(),) * 7 + (P(HIST_AXIS),),
+            out_specs=out_specs,
+        )
+    )
+
+
+def shard_stream_batch(batch, mesh: Mesh):
+    """Place a StreamBatch on the mesh, padding the op axis so it divides
+    the ``seq`` shard count (pad rows are fully masked)."""
+    from jepsen_tpu.checkers.stream_lin import StreamBatch
+
+    n_seq = mesh.shape[SEQ_AXIS]
+    L = batch.type.shape[-1]
+    pad = (-L) % n_seq
+    if pad:
+        def padcol(x, fill):
+            return jnp.pad(x, ((0, 0), (0, pad)), constant_values=fill)
+
+        batch = StreamBatch(
+            type=padcol(batch.type, 0),
+            f=padcol(batch.f, 0),
+            value=padcol(batch.value, -1),
+            offset=padcol(batch.offset, -1),
+            pos=padcol(batch.pos, 0),
+            mask=padcol(batch.mask, False),
+            first=padcol(batch.first, True),
+            full_read=batch.full_read,
+            space=batch.space,
+        )
+    rows = NamedSharding(mesh, _row_spec())
+    per_hist = NamedSharding(mesh, P(HIST_AXIS))
+    return StreamBatch(
+        type=jax.device_put(batch.type, rows),
+        f=jax.device_put(batch.f, rows),
+        value=jax.device_put(batch.value, rows),
+        offset=jax.device_put(batch.offset, rows),
+        pos=jax.device_put(batch.pos, rows),
+        mask=jax.device_put(batch.mask, rows),
+        first=jax.device_put(batch.first, rows),
+        full_read=jax.device_put(batch.full_read, per_hist),
+        space=batch.space,
+    )
+
+
+def sharded_stream_lin(batch, mesh: Mesh):
+    """Stream-log linearizability over the mesh.  ``seq=1`` meshes take
+    the zero-communication data-parallel path; larger ``seq`` runs the
+    seq-parallel program above (long histories shard across chips — the
+    long-context lever, same shape as the queue family)."""
+    if mesh.shape[SEQ_AXIS] == 1:
+        from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
+
+        return stream_lin_tensor_check(_hist_sharded(batch, mesh))
+    sharded = shard_stream_batch(batch, mesh)
+    fn = _stream_lin_program(mesh, batch.space)
+    return fn(
+        sharded.type,
+        sharded.f,
+        sharded.value,
+        sharded.offset,
+        sharded.pos,
+        sharded.mask,
+        sharded.first,
+        sharded.full_read,
+    )
 
 
 def sharded_elle(batch, mesh: Mesh):

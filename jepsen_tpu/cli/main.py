@@ -437,7 +437,7 @@ def cmd_serve(args) -> int:
 def cmd_serve_checker(args) -> int:
     from jepsen_tpu.service.server import serve_forever
 
-    serve_forever(host=args.host, port=args.port)
+    serve_forever(host=args.host, port=args.port, seq=args.seq)
     return 0
 
 
@@ -617,6 +617,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sc.add_argument("--host", default="0.0.0.0")
     sc.add_argument("--port", type=int, default=8640)
+    sc.add_argument(
+        "--seq",
+        type=int,
+        default=1,
+        help="seq-parallel shards per history on the device mesh "
+        "(multi-device runtimes shard batches across all devices)",
+    )
     sc.set_defaults(fn=cmd_serve_checker)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")

@@ -1,10 +1,14 @@
 """The checker sidecar server.
 
-A long-lived process owning the JAX backend (one TPU chip, or a mesh via
-``use_mesh``).  Controllers connect over TCP, send packed histories, and
-get reference-shaped verdicts back.  The jitted check program is cached per
+A long-lived process owning the JAX backend — one chip, or every device
+the runtime can see sharded through a ``(hist, seq)`` mesh (pass
+``mesh=`` / ``serve_forever(seq=...)``; multi-device runtimes build the
+global mesh automatically, including pod-wide after ``init_multihost``).
+Controllers connect over TCP, send packed histories, and get
+reference-shaped verdicts back.  The jitted check program is cached per
 ``(B, L, V)`` shape, so a fleet of runs with bucketed shapes pays one
-compile each.
+compile each.  Batches whose size doesn't divide the ``hist`` axis are
+padded with fully-masked histories and sliced back on reply.
 
 Ops:
 
@@ -39,8 +43,25 @@ logger = logging.getLogger("jepsen_tpu.service")
 REQUIRED_ARRAYS = ("f", "type", "value", "mask")
 
 
+def _pad_batch_axis(tree, multiple: int):
+    """Zero/False-pad every leaf's axis 0 to a multiple (padded histories
+    are fully masked → ignored); returns ``(padded, original_B)``."""
+    import jax
+    import jax.numpy as jnp
+
+    B = jax.tree.leaves(tree)[0].shape[0]
+    pad = (-B) % multiple
+    if pad == 0:
+        return tree, B
+
+    def p(x):
+        return jnp.pad(x, [(0, pad)] + [(0, 0)] * (x.ndim - 1))
+
+    return jax.tree.map(p, tree), B
+
+
 def _check_arrays(
-    arrays: dict[str, np.ndarray], value_space: int
+    arrays: dict[str, np.ndarray], value_space: int, mesh=None
 ) -> dict[str, Any]:
     import jax.numpy as jnp
 
@@ -54,12 +75,47 @@ def _check_arrays(
     type_ = jnp.asarray(arrays["type"], jnp.int32)
     value = jnp.asarray(arrays["value"], jnp.int32)
     mask = jnp.asarray(arrays["mask"].astype(bool))
-    from jepsen_tpu.checkers.fused import _combined_batch
 
-    # the canonical single-program combined check (checkers/fused.py)
-    tq, ql = _combined_batch(f, type_, value, mask, value_space)
-    tq_results = _tensors_to_results(tq)
-    ql_results = queue_lin_tensors_to_results(ql)
+    if mesh is not None:
+        # mesh-wide check: the same sharded programs the driver dryruns
+        import jax
+        from jax.sharding import NamedSharding
+
+        from jepsen_tpu.parallel.mesh import (
+            HIST_AXIS,
+            SEQ_AXIS,
+            _queue_lin_program,
+            _row_spec,
+            _total_queue_program,
+        )
+
+        (f, type_, value, mask), B = _pad_batch_axis(
+            (f, type_, value, mask), mesh.shape[HIST_AXIS]
+        )
+        # the op axis must divide the seq shards too: pad with masked rows
+        # (appended at the end, so real row positions are unchanged)
+        pad_l = (-f.shape[1]) % mesh.shape[SEQ_AXIS]
+        if pad_l:
+            widths = ((0, 0), (0, pad_l))
+            f = jnp.pad(f, widths)
+            type_ = jnp.pad(type_, widths)
+            value = jnp.pad(value, widths)
+            mask = jnp.pad(mask, widths)
+        # place once; both programs then consume the committed arrays
+        sh = NamedSharding(mesh, _row_spec())
+        f, type_, value, mask = (
+            jax.device_put(x, sh) for x in (f, type_, value, mask)
+        )
+        tq = _total_queue_program(mesh, value_space)(f, type_, value, mask)
+        ql = _queue_lin_program(mesh, value_space)(f, type_, value, mask)
+    else:
+        from jepsen_tpu.checkers.fused import _combined_batch
+
+        # the canonical single-program combined check (checkers/fused.py)
+        tq, ql = _combined_batch(f, type_, value, mask, value_space)
+        B = f.shape[0]
+    tq_results = _tensors_to_results(tq)[:B]
+    ql_results = queue_lin_tensors_to_results(ql)[:B]
     out = []
     for q, l in zip(tq_results, ql_results):
         out.append(
@@ -178,11 +234,14 @@ class CheckerServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 8640):
+    def __init__(self, host: str = "0.0.0.0", port: int = 8640, mesh=None):
         super().__init__((host, port), _Handler)
         # one device-compute at a time: connections multiplex onto the
         # accelerator serially, which is also the fastest way to use it
         self._device_lock = threading.Lock()
+        # optional (hist, seq) mesh: batches shard across every device the
+        # runtime can see (a slice, or a pod via jax.distributed)
+        self._mesh = mesh
 
     @property
     def port(self) -> int:
@@ -205,23 +264,53 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             if value_space <= 0:
                 raise ProtocolError("value_space must be positive")
             with self._device_lock:
-                return _check_arrays(arrays, value_space)
+                return _check_arrays(arrays, value_space, mesh=self._mesh)
         if op == "check-stream":
             space = int(header.get("space", 0))
             if space <= 0:
                 raise ProtocolError("space must be positive")
-            from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
-
             batch, full_read = _prepare_stream_batch(arrays, space)
             with self._device_lock:
-                t = stream_lin_tensor_check(batch)
-            return _stream_results(t, full_read)
-        if op == "check-elle":
-            from jepsen_tpu.checkers.elle import elle_tensor_check
+                if self._mesh is not None:
+                    from jepsen_tpu.parallel.mesh import (
+                        HIST_AXIS,
+                        sharded_stream_lin,
+                    )
 
+                    batch, nb = _pad_batch_axis(
+                        batch, self._mesh.shape[HIST_AXIS]
+                    )
+                    t = sharded_stream_lin(batch, self._mesh)
+                    full_read = np.pad(full_read, (0, batch.batch - nb))
+                else:
+                    from jepsen_tpu.checkers.stream_lin import (
+                        stream_lin_tensor_check,
+                    )
+
+                    nb = len(full_read)
+                    t = stream_lin_tensor_check(batch)
+            reply = _stream_results(t, full_read)
+            reply["results"] = reply["results"][:nb]
+            return reply
+        if op == "check-elle":
             graphs, batch = _prepare_elle_batch(header.get("histories"))
             with self._device_lock:
-                t = elle_tensor_check(batch)
+                if self._mesh is not None:
+                    from jepsen_tpu.parallel.mesh import (
+                        HIST_AXIS,
+                        sharded_elle,
+                    )
+
+                    batch, _nb = _pad_batch_axis(
+                        batch, self._mesh.shape[HIST_AXIS]
+                    )
+                    t = sharded_elle(batch, self._mesh)
+                else:
+                    from jepsen_tpu.checkers.elle import elle_tensor_check
+
+                    t = elle_tensor_check(batch)
+            # _elle_results iterates the (unpadded) graphs, so padded rows
+            # drop out naturally
             return _elle_results(graphs, t)
         raise ProtocolError(f"unknown op {op!r}")
 
@@ -231,7 +320,9 @@ class CheckerServer(socketserver.ThreadingTCPServer):
         return t
 
 
-def serve_forever(host: str = "0.0.0.0", port: int = 8640) -> None:
+def serve_forever(
+    host: str = "0.0.0.0", port: int = 8640, seq: int = 1
+) -> None:
     import jax
 
     from jepsen_tpu.utils.jaxenv import ensure_backend, pin_cpu_platform
@@ -246,8 +337,16 @@ def serve_forever(host: str = "0.0.0.0", port: int = 8640) -> None:
         print(f"warning: {e}; serving on the CPU backend")
         pin_cpu_platform()
         backend = jax.default_backend()
-    srv = CheckerServer(host, port)
-    print(f"checker sidecar on {host}:{srv.port} (backend={backend})")
+    mesh = None
+    if jax.device_count() > 1:
+        from jepsen_tpu.parallel.distributed import global_checker_mesh
+
+        mesh = global_checker_mesh(seq=seq)
+    srv = CheckerServer(host, port, mesh=mesh)
+    print(
+        f"checker sidecar on {host}:{srv.port} (backend={backend}, "
+        f"mesh={dict(mesh.shape) if mesh else None})"
+    )
     try:
         srv.serve_forever()
     except KeyboardInterrupt:

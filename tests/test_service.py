@@ -191,3 +191,76 @@ class TestStreamAndElleOps:
     def test_check_elle_requires_histories(self, client):
         with pytest.raises(RuntimeError, match="histories"):
             client._call({"op": "check-elle"})
+
+
+class TestMeshServer:
+    """The sidecar sharding batches over the full (hist, seq) device mesh
+    — every op must agree with the single-device server, including batch
+    sizes that don't divide the hist axis (masked padding + slice)."""
+
+    @pytest.fixture(scope="class")
+    def mesh_server(self, cpu_devices):
+        from jepsen_tpu.parallel import checker_mesh
+
+        srv = CheckerServer(
+            host="127.0.0.1", port=0, mesh=checker_mesh(cpu_devices, seq=2)
+        )
+        srv.start_background()
+        yield srv
+        srv.shutdown()
+        srv.server_close()
+
+    @pytest.fixture()
+    def mesh_client(self, mesh_server):
+        with CheckerClient(port=mesh_server.port) as c:
+            yield c
+
+    def test_queue_verdicts_match_cpu(self, mesh_client):
+        # B=6 does not divide hist=4: exercises the pad + slice path
+        shs = synth_batch(6, SynthSpec(n_ops=40), lost=1)
+        results = mesh_client.check_histories([sh.ops for sh in shs])
+        assert len(results) == 6
+        for sh, r in zip(shs, results):
+            ref = check_total_queue_cpu(sh.ops)
+            assert r["valid?"] == ref["valid?"]
+            assert r["queue"]["lost-count"] == ref["lost-count"]
+
+    def test_stream_verdicts_match_cpu(self, mesh_client):
+        from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+        from jepsen_tpu.history.synth import (
+            StreamSynthSpec,
+            synth_stream_batch,
+        )
+
+        shs = synth_stream_batch(3, StreamSynthSpec(n_ops=50), lost=1)
+        results = mesh_client.check_stream_histories([sh.ops for sh in shs])
+        assert len(results) == 3
+        for sh, r in zip(shs, results):
+            ref = check_stream_lin_cpu(sh.ops)
+            assert r["valid?"] == ref["valid?"]
+            assert r["stream"]["lost-count"] == ref["lost-count"]
+
+    def test_elle_verdicts_match_cpu(self, mesh_client):
+        from jepsen_tpu.checkers.elle import check_elle_cpu
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        shs = synth_elle_batch(2, ElleSynthSpec(n_txns=30))
+        shs += synth_elle_batch(
+            1, ElleSynthSpec(n_txns=30, seed=9), g2_cycle=1
+        )
+        results = mesh_client.check_elle_histories([sh.ops for sh in shs])
+        assert len(results) == 3
+        for sh, r in zip(shs, results):
+            assert r["valid?"] == check_elle_cpu(sh.ops)["valid?"]
+
+    def test_odd_history_length_pads_to_seq(self, mesh_client):
+        # L=101 does not divide seq=2: the server must pad masked rows,
+        # not error (regression: shard_map rejects indivisible op axes)
+        shs = synth_batch(2, SynthSpec(n_ops=30), lost=1)
+        results = mesh_client.check_histories(
+            [sh.ops for sh in shs], length=101
+        )
+        assert len(results) == 2
+        for sh, r in zip(shs, results):
+            ref = check_total_queue_cpu(sh.ops)
+            assert r["valid?"] == ref["valid?"]

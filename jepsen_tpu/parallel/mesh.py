@@ -176,8 +176,9 @@ def sharded_check(
 # per-value mins; the one structurally sequential piece — within-read-batch
 # offset monotonicity between adjacent rows — needs exactly one row of
 # state from the next shard, exchanged with a single ppermute.  The elle
-# checker stays data-parallel over `hist` (its per-history work is an MXU
-# matmul closure, not a row scan).
+# checker's per-history work is an MXU matmul closure, not a row scan: on
+# seq meshes its adjacency matrices column-shard over `seq` and GSPMD
+# partitions the matmuls (see sharded_elle below).
 # ---------------------------------------------------------------------------
 
 
@@ -333,8 +334,35 @@ def sharded_stream_lin(batch, mesh: Mesh):
 
 
 def sharded_elle(batch, mesh: Mesh):
-    """Elle cycle search, histories (and their [T, T] adjacency matrices)
-    sharded over ``hist``; the MXU closure matmuls stay device-local."""
+    """Elle cycle search over the mesh.  Histories shard over ``hist``;
+    when the mesh has a ``seq`` axis the ``[T, T]`` adjacency matrices
+    additionally shard their column axis over it and the log-squaring
+    closure matmuls run Megatron-style — annotate the shardings and let
+    GSPMD insert the collectives (the scaling lever for transaction
+    graphs too large for one chip's MXU pass)."""
+    import dataclasses
+
     from jepsen_tpu.checkers.elle import elle_tensor_check
 
-    return elle_tensor_check(_hist_sharded(batch, mesh))
+    if mesh.shape[SEQ_AXIS] == 1:
+        return elle_tensor_check(_hist_sharded(batch, mesh))
+
+    if batch.n_txns % mesh.shape[SEQ_AXIS]:
+        raise ValueError(
+            f"seq={mesh.shape[SEQ_AXIS]} must divide n_txns="
+            f"{batch.n_txns} (pack_txn_graphs pads to the lane width, "
+            "so any power-of-two seq up to the lane size divides it)"
+        )
+
+    def put(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    sharded = dataclasses.replace(
+        batch,
+        ww=put(batch.ww, P(HIST_AXIS, None, SEQ_AXIS)),
+        wr=put(batch.wr, P(HIST_AXIS, None, SEQ_AXIS)),
+        rw=put(batch.rw, P(HIST_AXIS, None, SEQ_AXIS)),
+        txn_mask=put(batch.txn_mask, P(HIST_AXIS, None)),
+        host_bad=put(batch.host_bad, P(HIST_AXIS)),
+    )
+    return elle_tensor_check(sharded)

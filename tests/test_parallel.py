@@ -159,3 +159,23 @@ def test_seq_parallel_stream_boundary_pair(cpu_devices):
         np.asarray(sharded.nonmonotonic_count), [1, 1, 1, 1]
     )
     _tree_equal(sharded, local)
+
+
+@pytest.mark.parametrize("seq", [2, 4])
+def test_seq_sharded_elle_matches(cpu_devices, seq):
+    """With a seq axis, the elle adjacency matrices shard their column
+    axis and GSPMD partitions the closure matmuls — verdicts must equal
+    the unsharded check."""
+    from jepsen_tpu.checkers.elle import (
+        elle_tensor_check,
+        infer_txn_graph,
+        pack_txn_graphs,
+    )
+    from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+    from jepsen_tpu.parallel import checker_mesh, sharded_elle
+
+    shs = synth_elle_batch(2, ElleSynthSpec(n_txns=100))
+    shs += synth_elle_batch(2, ElleSynthSpec(n_txns=100, seed=5), g2_cycle=1)
+    batch = pack_txn_graphs([infer_txn_graph(sh.ops) for sh in shs])
+    mesh = checker_mesh(cpu_devices, seq=seq)
+    _tree_equal(sharded_elle(batch, mesh), elle_tensor_check(batch))

@@ -53,19 +53,22 @@ def _round_up(n: int, k: int) -> int:
 @jax.tree_util.register_dataclass
 @dataclass
 class PackedHistories:
-    """A batch of histories as ``[B, L]`` int32 columns (+ bool mask).
+    """A batch of histories as ``[B, L]`` integer columns (+ bool mask).
 
-    ``value_space`` (static): scatter width V of per-value count kernels.
-    All values are either in ``[0, V)`` or ``NO_VALUE``.
+    The checker-hot columns (``type``/``f``/``value``/``mask``) use the
+    narrowest dtype that holds their range — the checkers are
+    HBM-bandwidth-bound, so bytes are throughput.  ``value_space``
+    (static): scatter width V of per-value count kernels.  All values are
+    either in ``[0, V)`` or ``NO_VALUE``.
     """
 
-    index: jax.Array  # [B, L] int32 — original history index of the row
-    process: jax.Array  # [B, L] int32
-    type: jax.Array  # [B, L] int32 — OpType codes
-    f: jax.Array  # [B, L] int32 — OpF codes
-    value: jax.Array  # [B, L] int32 — scalar value or NO_VALUE
-    time_ms: jax.Array  # [B, L] int32 — ms since history start
-    latency_ms: jax.Array  # [B, L] int32 — completion latency or -1
+    index: jax.Array  # [B, L] i32 — original history index of the row
+    process: jax.Array  # [B, L] i32
+    type: jax.Array  # [B, L] i8 — OpType codes
+    f: jax.Array  # [B, L] i8 — OpF codes
+    value: jax.Array  # [B, L] i16 (i32 when V > 32767) — value or NO_VALUE
+    time_ms: jax.Array  # [B, L] i32 — ms since history start
+    latency_ms: jax.Array  # [B, L] i32 — completion latency or -1
     mask: jax.Array  # [B, L] bool
     first: jax.Array  # [B, L] bool — first exploded row of its op
     value_space: int = dataclasses.field(metadata=dict(static=True), default=0)
@@ -162,12 +165,19 @@ def pack_histories(
             "raise value_space (or omit it to size automatically)"
         )
 
+    # The hot checker path is HBM-bandwidth-bound, so the columns it reads
+    # ship in the narrowest dtype that holds their range (measured ~1.8×
+    # on-chip throughput vs all-int32): op codes in i8, values in i16 when
+    # the value space allows (the scatter kernels route selected rows to
+    # index V, so V itself must be representable).  Host-analysis columns
+    # (index/process/times) stay i32.
+    val_dt = np.int16 if V <= np.iinfo(np.int16).max else np.int32
     return PackedHistories(
         index=jax.numpy.asarray(cols["index"]),
         process=jax.numpy.asarray(cols["process"]),
-        type=jax.numpy.asarray(cols["type"]),
-        f=jax.numpy.asarray(cols["f"]),
-        value=jax.numpy.asarray(cols["value"]),
+        type=jax.numpy.asarray(cols["type"].astype(np.int8)),
+        f=jax.numpy.asarray(cols["f"].astype(np.int8)),
+        value=jax.numpy.asarray(cols["value"].astype(val_dt)),
         time_ms=jax.numpy.asarray(cols["time_ms"]),
         latency_ms=jax.numpy.asarray(cols["latency_ms"]),
         mask=jax.numpy.asarray(mask),

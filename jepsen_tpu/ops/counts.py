@@ -35,7 +35,8 @@ def masked_value_counts(
     weights: jax.Array | None = None,  # [L] int32, default 1
 ) -> jax.Array:
     """``out[v] = sum(weights[i] for i where select[i] and values[i]==v)``."""
-    w = jnp.ones_like(values) if weights is None else weights
+    # values may be narrow (i8/i16 packing); the accumulator stays i32
+    w = jnp.ones(values.shape, jnp.int32) if weights is None else weights
     return (
         jnp.zeros((value_space,), jnp.int32)
         .at[_routed(values, select, value_space)]
@@ -52,6 +53,7 @@ def masked_value_reduce_min(
 ) -> jax.Array:
     """``out[v] = min(payload[i] for i where select[i] and values[i]==v)``,
     ``init`` where no row matched."""
+    payload = payload.astype(jnp.int32)  # narrow payloads must not clip init
     return (
         jnp.full((value_space,), init, jnp.int32)
         .at[_routed(values, select, value_space)]
@@ -68,6 +70,7 @@ def masked_value_reduce_max(
 ) -> jax.Array:
     """``out[v] = max(payload[i] for i where select[i] and values[i]==v)``,
     ``init`` where no row matched."""
+    payload = payload.astype(jnp.int32)  # narrow payloads must not clip init
     return (
         jnp.full((value_space,), init, jnp.int32)
         .at[_routed(values, select, value_space)]

@@ -178,10 +178,16 @@ def fused_queue_stats(
     True off-TPU (Pallas interpreter) and False on TPU (Mosaic)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    # The packed columns are narrow (i8/i16); this kernel's tiles are i32,
+    # so the columns are widened up front — which costs an extra HBM pass
+    # and is acceptable only because this path is the *differential twin*
+    # of the XLA scatter path, not the hot path.  If it ever becomes
+    # primary, widen per-tile inside the kernel (load narrow, cast in
+    # VMEM) to keep the narrow-packing bandwidth win.
     return _fused_queue_stats(
-        packed.f,
-        packed.type,
-        packed.value,
+        packed.f.astype(jnp.int32),
+        packed.type.astype(jnp.int32),
+        packed.value.astype(jnp.int32),
         packed.mask.astype(jnp.int32),
         packed.value_space,
         interpret,

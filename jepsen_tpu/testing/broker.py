@@ -4,10 +4,14 @@ The reference tests its Java driver against a *real* broker on localhost
 (``UtilsTest.java:50``); this image has no RabbitMQ, so the framework
 ships a protocol-level stand-in: a threaded TCP server speaking the AMQP
 subset the native driver uses (handshake, channel, queue declare/purge,
-publisher confirms, basic publish/get/consume/ack/reject, heartbeat).  It
-is an *independent* implementation of the wire grammar (Python ``struct``
-vs the driver's C++ codec), so framing bugs on either side surface as
-protocol errors rather than silently agreeing.
+publisher confirms, basic publish/get/consume/ack/reject, tx
+select/commit/rollback, per-queue ``x-message-ttl`` expiry with
+``x-dead-letter-routing-key`` routing, stream queues with offset reads,
+heartbeat).  It is an *independent* implementation of the wire grammar
+(Python ``struct`` vs the driver's C++ codec), so framing bugs on either
+side surface as protocol errors rather than silently agreeing — and the
+broker itself is conformance-checked against rabbitmq-c
+(``native/interop_probe.c``).
 
 Fault injection mirrors what the checker must catch end-to-end:
 
@@ -24,6 +28,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time as _time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -111,6 +116,7 @@ class _Reader:
 @dataclass
 class _Message:
     value: bytes
+    ts: float = 0.0  # publish time (monotonic) — drives x-message-ttl
 
 
 @dataclass
@@ -145,6 +151,8 @@ class MiniAmqpBroker:
         self.port = self._server.getsockname()[1]
         self.queues: dict[str, deque] = {}
         self.streams: dict[str, list] = {}  # x-queue-type=stream → log
+        # per-queue declare args: x-message-ttl / x-dead-letter-routing-key
+        self.queue_meta: dict[str, dict] = {}
         self.state_lock = threading.Lock()
         self.drop_confirms = drop_confirms
         self.lose_acked_every = lose_acked_every
@@ -304,6 +312,12 @@ class MiniAmqpBroker:
                             self.streams.setdefault(qname, [])
                         else:
                             self.queues.setdefault(qname, deque())
+                            self.queue_meta[qname] = {
+                                "ttl_ms": qargs.get("x-message-ttl"),
+                                "dlx_key": qargs.get(
+                                    "x-dead-letter-routing-key"
+                                ),
+                            }
                     self._send_method(
                         conn,
                         ch,
@@ -432,6 +446,26 @@ class MiniAmqpBroker:
             )
         self._deliver_all()
 
+    def _expire_locked(self, qname: str) -> None:
+        """Dead-letter expired messages (x-message-ttl + DLX routing, the
+        reference's dead-letter mode — Utils.java:55, MESSAGE_TTL 1 s).
+        Caller holds ``state_lock``."""
+        meta = self.queue_meta.get(qname) or {}
+        ttl_ms = meta.get("ttl_ms")
+        if ttl_ms is None:  # 0 is a real TTL: expire immediately
+            return
+        q = self.queues.get(qname)
+        if not q:
+            return
+        now = _time.monotonic()
+        dlx = meta.get("dlx_key")
+        while q and (now - q[0].ts) * 1000.0 >= ttl_ms:
+            msg = q.popleft()
+            if dlx:  # at-least-once: re-stamped into the dead-letter queue
+                self.queues.setdefault(dlx, deque()).append(
+                    _Message(msg.value, ts=now)
+                )
+
     def _apply_publish(self, queue: str, body: bytes):
         """Make a publish visible (fault injection applies here)."""
         with self.state_lock:
@@ -456,7 +490,7 @@ class MiniAmqpBroker:
                 )
                 if not lose:  # confirm-but-drop = injected data loss
                     self.queues.setdefault(queue, deque()).append(
-                        _Message(body)
+                        _Message(body, ts=_time.monotonic())
                     )
 
     def _content_frames(self, conn, ch, body: bytes, method: bytes):
@@ -469,6 +503,7 @@ class MiniAmqpBroker:
     def _handle_get(self, conn: _ConnState, ch: int, qname: str,
                     no_ack: bool = False):
         with self.state_lock:
+            self._expire_locked(qname)
             q = self.queues.setdefault(qname, deque())
             if not q:
                 msg = None
@@ -479,7 +514,7 @@ class MiniAmqpBroker:
                     self.duplicate_every
                     and self._delivered % self.duplicate_every == 0
                 ):
-                    q.append(_Message(msg.value))
+                    q.append(_Message(msg.value, ts=_time.monotonic()))
                 tag = conn.next_tag
                 conn.next_tag += 1
                 if not no_ack:  # no-ack gets are auto-acknowledged
@@ -503,6 +538,7 @@ class MiniAmqpBroker:
             with self.state_lock:
                 if conn.unacked and not conn.consuming_noack:
                     return
+                self._expire_locked(conn.consuming_queue)
                 q = self.queues.setdefault(conn.consuming_queue, deque())
                 if not q:
                     return
@@ -512,7 +548,7 @@ class MiniAmqpBroker:
                     self.duplicate_every
                     and self._delivered % self.duplicate_every == 0
                 ):
-                    q.append(_Message(msg.value))
+                    q.append(_Message(msg.value, ts=_time.monotonic()))
                 tag = conn.next_tag
                 conn.next_tag += 1
                 noack = conn.consuming_noack

@@ -416,3 +416,32 @@ class TestNativeTxn:
             lib.amqp_txn_destroy(hb)
         finally:
             b.stop()
+
+
+class TestDeadLetter:
+    """Dead-letter mode (reference Utils.java:55: MESSAGE_TTL 1 s, DLX
+    routing, drain reads both queues): an expired message must leave the
+    main queue, land in jepsen.queue.dead.letter, and still be recovered
+    by the drain — so consumed ∪ drained ≡ published survives expiry."""
+
+    def test_expired_messages_dead_letter_and_drain(self, native_lib, broker):
+        import time
+
+        d = _driver(native_lib, broker, dead_letter=True)
+        d.setup()
+        assert d.enqueue(11, 5.0) is True
+        assert d.enqueue(12, 5.0) is True
+        time.sleep(1.3)  # > MESSAGE_TTL (1 s): both expire to the DLQ
+        assert d.dequeue(0.6) is None  # main queue is empty post-expiry
+        assert broker.queue_depth("jepsen.queue.dead.letter") == 2
+        drained = d.drain()
+        assert sorted(drained) == [11, 12]
+        d.close()
+
+    def test_unexpired_messages_stay_consumable(self, native_lib, broker):
+        d = _driver(native_lib, broker, dead_letter=True)
+        d.setup()
+        assert d.enqueue(21, 5.0) is True
+        assert d.dequeue(2.0) == 21  # consumed before the TTL fires
+        assert broker.queue_depth("jepsen.queue.dead.letter") == 0
+        d.close()

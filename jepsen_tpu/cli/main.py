@@ -376,6 +376,10 @@ def cmd_matrix(args) -> int:
         for k in ("time-limit", "time-before-partition", "partition-duration"):
             scaled[k] = opts[k] * scale
         scaled["recovery-sleep"] = DEFAULT_OPTS["recovery-sleep"] * scale
+        # the dead-letter TTL must shrink with the run, or scaled-down
+        # smoke runs never see an expiry and the two dead-letter configs
+        # degenerate into the plain ones
+        scaled["message-ttl"] = DEFAULT_OPTS["message-ttl"] * scale
         scaled["rate"] = args.rate
         if args.db == "rabbitmq":
             if args.archive_url:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import random
 import threading
+import time
 from typing import Any, Mapping, Sequence
 
 from jepsen_tpu.client.protocol import (
@@ -48,11 +49,23 @@ class SimCluster:
         duplicate_every: int = 0,
         drop_appended_every: int = 0,
         duplicate_append_every: int = 0,
+        dead_letter: bool = False,
+        message_ttl_s: float = 1.0,
+        clock=time.monotonic,
     ):
         self.nodes = list(nodes)
         self.lock = threading.Lock()
         self.rng = random.Random(seed)
-        self.queue: list[int] = []  # committed, undelivered messages
+        self.queue: list[tuple[int, float]] = []  # (value, commit time)
+        # dead-letter mode (reference Utils.java:55): committed messages
+        # older than the TTL move to the DLQ; gets serve only the main
+        # queue, the drain recovers both
+        self.dead_letter = dead_letter
+        self.message_ttl_s = message_ttl_s
+        # injectable for deterministic tests — wall-clock by default, so
+        # dead-letter expiry (alone among sim behaviors) is timing-driven
+        self.clock = clock
+        self.dlq: list[int] = []
         self.blocked: set[frozenset[str]] = set()  # undirected blocked links
         self.drop_acked_every = drop_acked_every
         self.duplicate_every = duplicate_every
@@ -103,22 +116,35 @@ class SimCluster:
         self._acked += 1
         if self.drop_acked_every and self._acked % self.drop_acked_every == 0:
             return  # injected data-loss bug: confirmed but discarded
-        self.queue.append(value)
+        self.queue.append((value, self.clock()))
+
+    def _expire_locked(self) -> None:
+        if not self.dead_letter:
+            return
+        now = self.clock()
+        live, dead = [], []
+        for v, ts in self.queue:
+            (dead if now - ts >= self.message_ttl_s else live).append((v, ts))
+        if dead:
+            self.queue = live
+            self.dlq.extend(v for v, _ in dead)
 
     def get(self, node: str) -> int | None:
         with self.lock:
             if not self._has_majority(node):
                 raise DriverTimeout("basic.get timed out (minority)")
+            self._expire_locked()
             if not self.queue:
                 return None
             i = self.rng.randrange(len(self.queue))
-            v = self.queue.pop(i)
+            v, _ts = self.queue.pop(i)
             self._delivered += 1
             if (
                 self.duplicate_every
                 and self._delivered % self.duplicate_every == 0
             ):
-                self.queue.append(v)  # injected redelivery duplicate
+                # injected redelivery duplicate (fresh timestamp)
+                self.queue.append((v, self.clock()))
             return v
 
     def drain_from_all(self) -> list[int]:
@@ -127,12 +153,14 @@ class SimCluster:
         out = []
         with self.lock:
             while self.queue:
-                out.append(self.queue.pop())
+                out.append(self.queue.pop()[0])
+            out.extend(self.dlq)
+            self.dlq.clear()
         return out
 
     def queue_length(self) -> int:
         with self.lock:
-            return len(self.queue)
+            return len(self.queue) + len(self.dlq)
 
     # ---- stream ops (single-partition append-only log) --------------------
     def stream_append(self, node: str, value: int) -> bool:

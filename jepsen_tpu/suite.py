@@ -65,6 +65,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "net-ticktime": 15,
     "quorum-initial-group-size": 0,
     "dead-letter": False,
+    "message-ttl": 1.0,  # dead-letter mode TTL (MESSAGE_TTL, Utils.java:55)
     "archive-url": DEFAULT_ARCHIVE_URL,
 }
 
@@ -223,6 +224,8 @@ def build_sim_test(
         duplicate_every=duplicate_every,
         drop_appended_every=drop_appended_every,
         duplicate_append_every=duplicate_append_every,
+        dead_letter=bool(o.get("dead-letter")),
+        message_ttl_s=o.get("message-ttl", 1.0),
     )
     nemesis = PartitionNemesis(
         o["network-partition"], SimNet(cluster), nodes, seed=sim_seed

@@ -221,3 +221,29 @@ def test_elle_healthy_cluster_is_serializable(tmp_path):
     # the final read-only txns give every key an observed order, so the
     # dependency graph is non-trivial
     assert run.results["elle"]["ww-edges"] > 0
+
+
+def test_sim_dead_letter_expiry_recovered_by_drain():
+    """Dead-letter mode in the sim: a committed message that outlives the
+    TTL moves to the DLQ, gets stop serving it, and the drain recovers it
+    — consumed ∪ drained ≡ published survives expiry (the reference's
+    MESSAGE_TTL-1s mode, Utils.java:55).  A virtual clock keeps the test
+    deterministic."""
+    from jepsen_tpu.client.sim import SimCluster
+
+    now = [0.0]
+    c = SimCluster(
+        ["n1", "n2", "n3"],
+        dead_letter=True,
+        message_ttl_s=1.0,
+        clock=lambda: now[0],
+    )
+    assert c.publish("n1", 7) is True
+    assert c.publish("n1", 8) is True
+    assert c.get("n1") in (7, 8)  # before the TTL: served normally
+    now[0] = 1.5  # the remaining message outlives the TTL
+    assert c.get("n1") is None  # expired out of the main queue
+    assert c.queue_length() == 1  # still counted: it lives in the DLQ
+    drained = c.drain_from_all()
+    assert len(drained) == 1 and drained[0] in (7, 8)
+    assert c.queue_length() == 0

@@ -59,7 +59,25 @@ valid = [
     bool(v) for v in multihost_utils.process_allgather(tq.valid, tiled=True)
 ]
 lost = int((multihost_utils.process_allgather(tq.lost, tiled=True) > 0).sum())
-print(json.dumps({"pid": pid, "valid": valid, "lost": lost}), flush=True)
+
+# seq-parallel stream program pod-style: its phase combines and boundary
+# ppermute now cross the process boundary (the DCN path for real pods)
+from jepsen_tpu.checkers.stream_lin import pack_stream_histories
+from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
+from jepsen_tpu.parallel import sharded_stream_lin
+
+sshs = synth_stream_batch(4, StreamSynthSpec(n_ops=40, seed=3), lost=1)
+sbatch = pack_stream_histories([s.ops for s in sshs])
+st = sharded_stream_lin(sbatch, mesh)
+svalid = [
+    bool(v) for v in multihost_utils.process_allgather(st.valid, tiled=True)
+]
+print(
+    json.dumps(
+        {"pid": pid, "valid": valid, "lost": lost, "stream_valid": svalid}
+    ),
+    flush=True,
+)
 """
 
 
@@ -94,6 +112,16 @@ def test_init_multihost_two_process_sharded_check():
     # both processes computed the same global verdict
     assert outs[0]["valid"] == outs[1]["valid"]
     assert outs[0]["lost"] == outs[1]["lost"]
+    assert outs[0]["stream_valid"] == outs[1]["stream_valid"]
+
+    # stream differential (the lost append must be flagged pod-wide)
+    from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+    from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
+
+    sshs = synth_stream_batch(4, StreamSynthSpec(n_ops=40, seed=3), lost=1)
+    sref = [check_stream_lin_cpu(s.ops)["valid?"] for s in sshs]
+    assert outs[0]["stream_valid"] == sref
+    assert not all(sref)
 
     # differential: single-process CPU reference on the same histories
     from jepsen_tpu.checkers.total_queue import check_total_queue_cpu

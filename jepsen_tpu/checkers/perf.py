@@ -209,11 +209,14 @@ class Perf(Checker):
         history: Sequence[Op],
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
-        # stream/txn workload ops ride the producer/consumer grid slots
+        # stream/txn/mutex workload ops ride the producer/consumer grid
+        # slots so every family gets latency/rate graphs
         remap = {
             OpF.APPEND: OpF.ENQUEUE,
             OpF.READ: OpF.DEQUEUE,
             OpF.TXN: OpF.ENQUEUE,
+            OpF.ACQUIRE: OpF.ENQUEUE,
+            OpF.RELEASE: OpF.DEQUEUE,
         }
         history = [
             Op(op.type, remap[op.f], op.process, op.value, op.time, op.index, op.error)

@@ -59,7 +59,7 @@ import numpy as np
 
 from jepsen_tpu.checkers.protocol import VALID, Checker
 from jepsen_tpu.history.ops import Op, OpF, OpType
-from jepsen_tpu.models.core import Call, Model, UnorderedQueue
+from jepsen_tpu.models.core import Call, Model, OwnedMutex, UnorderedQueue
 
 INF = 2**31 - 1
 
@@ -108,6 +108,35 @@ def queue_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
             for v in vals:
                 if isinstance(v, int):
                     out.append(WglOp(Call(UnorderedQueue.DEQUEUE, v), inv, pos))
+    return out
+
+
+def mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
+    """Map a mutex history onto lock-model calls (the reference's legacy
+    mutex variant, ``rabbitmq_test.clj:18-44``).
+
+    - ok acquires/releases become model calls over their interval;
+    - info (indeterminate) ops may have taken effect at any later point
+      (``ret=INF``) — a timed-out acquire might still hold the lock;
+    - failed ops never happened (the lock was busy / not held).
+    """
+    out: list[WglOp] = []
+    open_inv: dict[int, int] = {}
+    for pos, op in enumerate(history):
+        if op.f not in (OpF.ACQUIRE, OpF.RELEASE):
+            continue
+        if op.type == OpType.INVOKE:
+            open_inv[op.process] = pos
+            continue
+        inv = open_inv.pop(op.process, -1)
+        call = Call(
+            OwnedMutex.ACQUIRE if op.f == OpF.ACQUIRE else OwnedMutex.RELEASE,
+            a0=op.process,
+        )
+        if op.type == OpType.OK:
+            out.append(WglOp(call, inv, pos))
+        elif op.type == OpType.INFO:
+            out.append(WglOp(call, inv, INF))
     return out
 
 
@@ -356,11 +385,11 @@ def wgl_tensor_check(
 # ---------------------------------------------------------------------------
 
 
-class QueueWgl(Checker):
-    """Knossos-style ``checker/queue``: full Wing-Gong search against the
-    unordered-queue model.  TPU backend with CPU fallback on overflow."""
-
-    name = "queue-wgl"
+class _WglChecker(Checker):
+    """Shared engine choreography for the WGL checker family: map the
+    history to model calls, try the TPU frontier search, and escape-hatch
+    to the exact CPU search on frontier overflow.  Subclasses supply the
+    mapping and the model."""
 
     def __init__(self, backend: str = "tpu", capacity: int = 128):
         if backend not in ("cpu", "tpu"):
@@ -368,17 +397,18 @@ class QueueWgl(Checker):
         self.backend = backend
         self.capacity = capacity
 
+    def _ops_and_model(self, history):
+        """→ ``(wgl_ops, model_key)``; the model instance comes from the
+        key so the compiled program cache stays shared."""
+        raise NotImplementedError
+
     def check(
         self,
         test: Mapping[str, Any],
         history: Sequence[Op],
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
-        ops = queue_wgl_ops(history)
-        value_space = 32 * max(
-            1, math.ceil((max((o.call.a0 for o in ops), default=0) + 1) / 32)
-        )
-        model_key = (UnorderedQueue, (value_space,))
+        ops, model_key = self._ops_and_model(history)
 
         if self.backend == "tpu":
             batch = pack_wgl_batch([ops])
@@ -386,6 +416,31 @@ class QueueWgl(Checker):
             if not unknown[0]:
                 return {VALID: bool(ok[0]), "unknown": False, "engine": "tpu"}
             # frontier overflow: escape-hatch to the exact CPU search
-        r = check_wgl_cpu(ops, UnorderedQueue(value_space))
+        cls, args = model_key
+        r = check_wgl_cpu(ops, cls(*args))
         r["engine"] = "cpu"
         return r
+
+
+class QueueWgl(_WglChecker):
+    """Knossos-style ``checker/queue``: full Wing-Gong search against the
+    unordered-queue model.  TPU backend with CPU fallback on overflow."""
+
+    name = "queue-wgl"
+
+    def _ops_and_model(self, history):
+        ops = queue_wgl_ops(history)
+        value_space = 32 * max(
+            1, math.ceil((max((o.call.a0 for o in ops), default=0) + 1) / 32)
+        )
+        return ops, (UnorderedQueue, (value_space,))
+
+
+class MutexWgl(_WglChecker):
+    """Knossos-style ``checker/linearizable`` over the owned-mutex model —
+    the reference's commented legacy variant (``rabbitmq_test.clj:18-44``)."""
+
+    name = "mutex-wgl"
+
+    def _ops_and_model(self, history):
+        return mutex_wgl_ops(history), (OwnedMutex, ())

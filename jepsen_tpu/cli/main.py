@@ -69,6 +69,8 @@ def _workload_of(history) -> str:
             return "stream"
         if op.f == OpF.TXN:
             return "elle"
+        if op.f in (OpF.ACQUIRE, OpF.RELEASE):
+            return "mutex"
     return "queue"
 
 
@@ -98,6 +100,15 @@ def _checker_for(args, out_dir=None, history=None):
             {
                 "perf": Perf(out_dir=out_dir),
                 "elle": ElleListAppend(backend=backend),
+            }
+        )
+    if workload == "mutex":
+        from jepsen_tpu.checkers.wgl import MutexWgl
+
+        return compose(
+            {
+                "perf": Perf(out_dir=out_dir),
+                "mutex": MutexWgl(backend=backend),
             }
         )
     checkers = {
@@ -156,6 +167,13 @@ def cmd_bench_check(args) -> int:
         kinds = [_workload_of(h) for h in histories]
         if workload == "auto":
             workload = max(sorted(set(kinds)), key=kinds.count)
+        if workload == "mutex":
+            print(
+                "bench-check has no batched path for the mutex family "
+                "(general-model search; use `check --workload mutex`)",
+                file=sys.stderr,
+            )
+            return 2
         keep = [h for h, kind in zip(histories, kinds) if kind == workload]
         if len(keep) != len(histories):
             print(
@@ -325,7 +343,7 @@ def cmd_test(args) -> int:
                 ssh_private_key=args.ssh_private_key,
                 workload=args.workload,
             )
-        except NotImplementedError as e:
+        except (NotImplementedError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
     else:
@@ -510,7 +528,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     c.add_argument(
         "--workload",
-        choices=("auto", "queue", "stream", "elle"),
+        choices=("auto", "queue", "stream", "elle", "mutex"),
         default="auto",
         help="checker family; auto-detected from the history's op kinds",
     )
@@ -541,10 +559,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
     t.add_argument(
         "--workload",
-        choices=("queue", "stream", "elle"),
+        choices=("queue", "stream", "elle", "mutex"),
         default="queue",
         help="test program: quorum-queue (reference), stream append/read, "
-        "or elle list-append transactions",
+        "elle list-append transactions, or the legacy mutex variant "
+        "(--db sim)",
     )
     t.add_argument("--store", default="store")
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")

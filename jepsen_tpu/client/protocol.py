@@ -248,6 +248,76 @@ class StreamClient(Client):
             self.driver.close()
 
 
+class MutexDriver(abc.ABC):
+    """Driver ABI for the mutex workload (the reference's legacy variant:
+    a distributed lock checked with model/mutex linearizability)."""
+
+    @abc.abstractmethod
+    def setup(self) -> None: ...
+
+    @abc.abstractmethod
+    def acquire(self, timeout_s: float) -> bool:
+        """True = lock granted, False = busy; raises DriverTimeout when
+        the outcome is unknown (the grant may have happened)."""
+
+    @abc.abstractmethod
+    def release(self, timeout_s: float) -> bool:
+        """True = released, False = not the holder; DriverTimeout when
+        unknown."""
+
+    @abc.abstractmethod
+    def reconnect(self) -> None: ...
+
+    @abc.abstractmethod
+    def close(self) -> None: ...
+
+
+class MutexClient(Client):
+    """Lock client: acquire/release map to ok/fail; timeouts are
+    indeterminate for BOTH ops (a timed-out acquire may hold the lock, a
+    timed-out release may have freed it) — exactly the ambiguity the
+    linearizability checker must reason through."""
+
+    def __init__(self, driver_factory, op_timeout_s: float = 5.0):
+        self.driver_factory = driver_factory
+        self.op_timeout_s = op_timeout_s
+        self.driver: MutexDriver | None = None
+
+    def open(self, test, node):
+        c = MutexClient(self.driver_factory, self.op_timeout_s)
+        c.driver = self.driver_factory(test, node)
+        return c
+
+    def setup(self, test):
+        assert self.driver is not None
+        self.driver.setup()
+
+    def invoke(self, test, op: Op) -> Op:
+        d = self.driver
+        assert d is not None
+
+        def apply() -> Op:
+            if op.f == OpF.ACQUIRE:
+                ok = d.acquire(self.op_timeout_s)
+                return op.complete(
+                    OpType.OK if ok else OpType.FAIL,
+                    error=None if ok else "held",
+                )
+            if op.f == OpF.RELEASE:
+                ok = d.release(self.op_timeout_s)
+                return op.complete(
+                    OpType.OK if ok else OpType.FAIL,
+                    error=None if ok else "not-held",
+                )
+            raise ValueError(f"unknown client op {op.f}")
+
+        return _guard(d, op, apply, indeterminate=True)
+
+    def close(self, test):
+        if self.driver is not None:
+            self.driver.close()
+
+
 class TxnDriver(abc.ABC):
     """Driver ABI for the transactional (Elle list-append) workload
     (BASELINE config #5: transactions over AMQP tx)."""

@@ -27,6 +27,7 @@ Model: one replicated queue with Raft-like majority semantics.
 
 from __future__ import annotations
 
+import itertools
 import random
 import threading
 import time
@@ -34,6 +35,7 @@ from typing import Any, Mapping, Sequence
 
 from jepsen_tpu.client.protocol import (
     DriverTimeout,
+    MutexDriver,
     QueueDriver,
     StreamDriver,
     TxnDriver,
@@ -52,6 +54,7 @@ class SimCluster:
         dead_letter: bool = False,
         message_ttl_s: float = 1.0,
         clock=time.monotonic,
+        double_grant_every: int = 0,
     ):
         self.nodes = list(nodes)
         self.lock = threading.Lock()
@@ -78,6 +81,10 @@ class SimCluster:
         self._appended = 0
         # transactional kv-of-lists state — BASELINE config #5
         self.kv: dict[int, list[int]] = {}
+        # distributed lock state — the reference's legacy mutex variant
+        self.lock_holder: int | None = None
+        self.double_grant_every = double_grant_every
+        self._acquires = 0
 
     # ---- network control (driven by the nemesis via SimNet) --------------
     def set_blocked(self, blocked: set[frozenset[str]]) -> None:
@@ -146,6 +153,42 @@ class SimCluster:
                 # injected redelivery duplicate (fresh timestamp)
                 self.queue.append((v, self.clock()))
             return v
+
+    # ---- mutex ops (legacy variant: knossos model/mutex) ------------------
+    def acquire(self, node: str, proc: int) -> bool:
+        with self.lock:
+            if not self._has_majority(node):
+                # a linearizable lock service mostly rejects minority
+                # requests cleanly; occasionally the request raced the
+                # partition and its outcome is genuinely unknown
+                if self.rng.random() < 0.85:
+                    raise ConnectionError("minority: request rejected")
+                if self.rng.random() < 0.5 and self.lock_holder is None:
+                    self.lock_holder = proc
+                raise DriverTimeout("acquire timed out (minority)")
+            self._acquires += 1
+            if self.lock_holder is None:
+                self.lock_holder = proc
+                return True
+            if (
+                self.double_grant_every
+                and self._acquires % self.double_grant_every == 0
+            ):
+                return True  # injected split-brain: granted while held
+            return False
+
+    def release(self, node: str, proc: int) -> bool:
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.85:
+                    raise ConnectionError("minority: request rejected")
+                if self.rng.random() < 0.5 and self.lock_holder == proc:
+                    self.lock_holder = None
+                raise DriverTimeout("release timed out (minority)")
+            if self.lock_holder == proc:
+                self.lock_holder = None
+                return True
+            return False
 
     def drain_from_all(self) -> list[int]:
         """The drain choreography's final read: empty the queue regardless
@@ -306,6 +349,40 @@ class SimTxnDriver(TxnDriver):
 
     def close(self) -> None:
         pass
+
+
+class SimMutexDriver(MutexDriver):
+    """Mutex-driver ABI over :class:`SimCluster` (process identity comes
+    from the factory's per-open counter — one logical holder per client)."""
+
+    def __init__(self, cluster: SimCluster, node: str, proc: int):
+        self.cluster = cluster
+        self.node = node
+        self.proc = proc
+
+    def setup(self) -> None:
+        pass
+
+    def acquire(self, timeout_s: float) -> bool:
+        return self.cluster.acquire(self.node, self.proc)
+
+    def release(self, timeout_s: float) -> bool:
+        return self.cluster.release(self.node, self.proc)
+
+    def reconnect(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def sim_mutex_driver_factory(cluster: SimCluster):
+    counter = itertools.count()
+
+    def factory(test: Mapping[str, Any], node: str) -> SimMutexDriver:
+        return SimMutexDriver(cluster, node, next(counter))
+
+    return factory
 
 
 def sim_txn_driver_factory(cluster: SimCluster):

@@ -68,6 +68,10 @@ class OpF(enum.IntEnum):
     # AMQP tx).  value is a list of micro-ops: ["append", k, v] or
     # ["r", k, vs|None] (vs = the observed list on completion).
     TXN = 8
+    # mutex workload (the reference's commented legacy variant,
+    # rabbitmq_test.clj:18-44: knossos model/mutex + checker/linearizable)
+    ACQUIRE = 9
+    RELEASE = 10
 
     @classmethod
     def from_name(cls, name: str) -> "OpF":
@@ -77,7 +81,16 @@ class OpF(enum.IntEnum):
 _TYPE_BY_NAME = {t.name.lower(): t for t in OpType}
 _F_BY_NAME = {f.name.lower(): f for f in OpF}
 
-CLIENT_FS = (OpF.ENQUEUE, OpF.DEQUEUE, OpF.DRAIN, OpF.APPEND, OpF.READ, OpF.TXN)
+CLIENT_FS = (
+    OpF.ENQUEUE,
+    OpF.DEQUEUE,
+    OpF.DRAIN,
+    OpF.APPEND,
+    OpF.READ,
+    OpF.TXN,
+    OpF.ACQUIRE,
+    OpF.RELEASE,
+)
 
 
 @dataclass

@@ -182,6 +182,37 @@ class Mutex(Model):
         return state, legal
 
 
+class OwnedMutex(Model):
+    """Lock with holder identity (``a0`` = the acquiring/releasing
+    process).  Semantically the lock service under test: only the holder
+    can release.  The ownership constraint also prunes the search
+    massively versus the ownerless ``Mutex`` — a pending (indeterminate)
+    release can only linearize while its own process holds, so the
+    partition-era spray of timed-out ops from retired processes stops
+    exploding the frontier."""
+
+    name = "owned-mutex"
+    ACQUIRE, RELEASE = 0, 1
+    state_words = 1  # holder process + 1; 0 = free
+
+    def initial(self):
+        return 0
+
+    def step(self, state, call):
+        if call.f == self.ACQUIRE:
+            return call.a0 + 1, state == 0
+        return 0, state == call.a0 + 1
+
+    def tensor_step(self, state, f, a0, a1):
+        cur = state[0]
+        is_acq = f == self.ACQUIRE
+        owner = (a0 + 1).astype(jnp.uint32)
+        legal = jnp.where(is_acq, cur == 0, cur == owner)
+        new = jnp.where(is_acq, owner, jnp.uint32(0))
+        state = state.at[0].set(jnp.where(legal, new, cur))
+        return state, legal
+
+
 class FifoQueue(Model):
     """Ordered FIFO queue (CPU engine only: sequence state doesn't fit the
     fixed-width tensor encoding; the quorum-queue tests use the unordered

@@ -185,6 +185,29 @@ def elle_generator(opts: Mapping[str, Any], n_keys: int = 8, seed: int = 0):
     )
 
 
+def mutex_generator(opts: Mapping[str, Any]):
+    """Mutex workload program (the reference's legacy commented variant,
+    ``rabbitmq_test.clj:18-44``): random acquire/release mix under the
+    nemesis cycle — busy-lock failures are normal history, timeouts are
+    indeterminate — then heal + one final release per thread."""
+    acquire = FnGen(lambda ctx: Op.invoke(OpF.ACQUIRE, ctx.process))
+    release = FnGen(lambda ctx: Op.invoke(OpF.RELEASE, ctx.process))
+    return _four_phase(
+        opts,
+        Mix([acquire, release]),
+        lambda: FnGen(lambda ctx: Op.invoke(OpF.RELEASE, ctx.process)),
+    )
+
+
+def mutex_checker(backend: str = "tpu", with_perf: bool = True):
+    from jepsen_tpu.checkers.wgl import MutexWgl
+
+    checkers = {"mutex": MutexWgl(backend=backend)}
+    if with_perf:
+        checkers["perf"] = Perf()
+    return compose(checkers)
+
+
 def elle_checker(backend: str = "tpu", with_perf: bool = True):
     from jepsen_tpu.checkers.elle import ElleListAppend
 
@@ -204,6 +227,7 @@ def build_sim_test(
     duplicate_every: int = 0,
     drop_appended_every: int = 0,
     duplicate_append_every: int = 0,
+    double_grant_every: int = 0,
     store_root: str = "store",
     workload: str = "queue",
 ) -> tuple[Test, SimCluster]:
@@ -224,6 +248,7 @@ def build_sim_test(
         duplicate_every=duplicate_every,
         drop_appended_every=drop_appended_every,
         duplicate_append_every=duplicate_append_every,
+        double_grant_every=double_grant_every,
         dead_letter=bool(o.get("dead-letter")),
         message_ttl_s=o.get("message-ttl", 1.0),
     )
@@ -246,6 +271,17 @@ def build_sim_test(
         generator = elle_generator(o, seed=sim_seed)
         checker = elle_checker(checker_backend)
         name = "rabbitmq-elle-txn-sim"
+    elif workload == "mutex":
+        from jepsen_tpu.client.protocol import MutexClient
+        from jepsen_tpu.client.sim import sim_mutex_driver_factory
+
+        client = MutexClient(
+            sim_mutex_driver_factory(cluster),
+            op_timeout_s=o["publish-confirm-timeout"],
+        )
+        generator = mutex_generator(o)
+        checker = mutex_checker(checker_backend)
+        name = "rabbitmq-mutex-sim"
     elif workload == "queue":
         client = QueueClient(
             sim_driver_factory(cluster),
@@ -326,6 +362,11 @@ def build_rabbitmq_test(
         generator = queue_generator(o)
         checker = queue_checker(checker_backend)
         name = "rabbitmq-simple-partition"
+    elif workload == "mutex":
+        raise NotImplementedError(
+            "the mutex workload has no live AMQP mapping (the reference's "
+            "variant is a commented-out legacy test); use --db sim"
+        )
     else:
         raise ValueError(f"unknown workload {workload!r}")
     return Test(

@@ -247,3 +247,41 @@ def test_sim_dead_letter_expiry_recovered_by_drain():
     drained = c.drain_from_all()
     assert len(drained) == 1 and drained[0] in (7, 8)
     assert c.queue_length() == 0
+
+
+# ---------------------------------------------------------------------------
+# Mutex workload (the reference's legacy commented variant) end to end
+# ---------------------------------------------------------------------------
+
+
+def test_mutex_healthy_cluster_is_linearizable(tmp_path):
+    from jepsen_tpu.suite import build_sim_test
+
+    test, _cluster = build_sim_test(
+        opts=FAST_OPTS,
+        store_root=str(tmp_path / "store"),
+        workload="mutex",
+        checker_backend="cpu",
+    )
+    run = run_test(test)
+    assert run.results["mutex"]["valid?"], run.results["mutex"]
+    assert not run.results["mutex"]["unknown"]
+    assert run.valid
+
+
+def test_mutex_double_grant_detected(tmp_path):
+    """Split-brain lock bug: the service grants an acquire while the lock
+    is held — two concurrent ok-acquires with no release between cannot
+    linearize against the owned-mutex model."""
+    from jepsen_tpu.suite import build_sim_test
+
+    test, _cluster = build_sim_test(
+        opts=FAST_OPTS,
+        store_root=str(tmp_path / "store"),
+        workload="mutex",
+        checker_backend="cpu",
+        double_grant_every=3,
+    )
+    run = run_test(test)
+    assert not run.results["mutex"]["valid?"]
+    assert not run.results["mutex"]["unknown"]  # a definite violation

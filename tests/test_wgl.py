@@ -211,3 +211,53 @@ def test_queue_wgl_ops_mapping():
     assert len(w) == 2
     assert w[0].call == Call(ENQ, 3) and w[0].ret == INF
     assert w[1].call == Call(DEQ, 3) and w[1].ret == 5
+
+
+def test_owned_mutex():
+    from jepsen_tpu.models.core import OwnedMutex
+
+    m = OwnedMutex()
+    A, R = OwnedMutex.ACQUIRE, OwnedMutex.RELEASE
+    good = [
+        WglOp(Call(A, a0=1), 0, 1),
+        WglOp(Call(R, a0=1), 2, 3),
+        WglOp(Call(A, a0=2), 4, 5),
+    ]
+    assert check_wgl_cpu(good, m)["valid?"]
+    # only the holder can release: p2 releasing p1's lock is illegal
+    bad = [
+        WglOp(Call(A, a0=1), 0, 1),
+        WglOp(Call(R, a0=2), 2, 3),
+    ]
+    assert not check_wgl_cpu(bad, m)["valid?"]
+    # a pending (indeterminate) release by a non-holder never linearizes,
+    # so it cannot rescue a double grant
+    double = [
+        WglOp(Call(A, a0=1), 0, 1),
+        WglOp(Call(R, a0=2), 2, INF),
+        WglOp(Call(A, a0=3), 4, 5),
+    ]
+    assert not check_wgl_cpu(double, m)["valid?"]
+    batch = pack_wgl_batch([good, bad])
+    ok, unknown = wgl_tensor_check(batch, (OwnedMutex, ()))
+    assert not unknown.any()
+    assert bool(ok[0]) and not bool(ok[1])
+
+
+def test_mutex_wgl_ops_mapping():
+    from jepsen_tpu.checkers.wgl import mutex_wgl_ops
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    a1 = Op.invoke(OpF.ACQUIRE, 1)
+    r1 = Op.invoke(OpF.RELEASE, 1)
+    a2 = Op.invoke(OpF.ACQUIRE, 2)
+    h = reindex(
+        [
+            a1, a1.complete(OpType.OK),
+            a2, a2.complete(OpType.FAIL, error="held"),  # never happened
+            r1, r1.complete(OpType.INFO, error="timeout"),  # maybe freed
+        ]
+    )
+    ops = mutex_wgl_ops(h)
+    assert len(ops) == 2  # the failed acquire is dropped
+    assert ops[0].call.a0 == 1 and ops[1].ret == INF

@@ -7,32 +7,48 @@ linearizability capability of the legacy test
 (``rabbitmq/test/jepsen/rabbitmq_test.clj:55-58``).  Each checker has a CPU
 reference implementation and a TPU (JAX) backend selected by
 ``backend='cpu'|'tpu'``.
+
+The protocol (``Checker``/``compose``/``VALID``/``UNKNOWN``) is jax-free
+and imported eagerly; the concrete checker families import JAX, so they
+are exposed lazily (PEP 562) — jax-free consumers (CLI plumbing, the
+store, the web UI) can import protocol symbols without pulling JAX into
+the process.
 """
 
-from jepsen_tpu.checkers.protocol import Checker, compose  # noqa: F401
-from jepsen_tpu.checkers.total_queue import (  # noqa: F401
-    TotalQueue,
-    check_total_queue_cpu,
-    total_queue_tensor_check,
+from jepsen_tpu.checkers.protocol import (  # noqa: F401
+    UNKNOWN,
+    VALID,
+    Checker,
+    compose,
+    merge_valid,
 )
-from jepsen_tpu.checkers.queue_lin import (  # noqa: F401
-    QueueLinearizability,
-    check_queue_lin_cpu,
-    queue_lin_tensor_check,
-)
-from jepsen_tpu.checkers.perf import Perf, perf_tensor_check  # noqa: F401
-from jepsen_tpu.checkers.wgl import (  # noqa: F401
-    QueueWgl,
-    check_wgl_cpu,
-    wgl_tensor_check,
-)
-from jepsen_tpu.checkers.stream_lin import (  # noqa: F401
-    StreamLinearizability,
-    check_stream_lin_cpu,
-    stream_lin_tensor_check,
-)
-from jepsen_tpu.checkers.elle import (  # noqa: F401
-    ElleListAppend,
-    check_elle_cpu,
-    elle_tensor_check,
-)
+
+_LAZY = {
+    "TotalQueue": "total_queue",
+    "check_total_queue_cpu": "total_queue",
+    "total_queue_tensor_check": "total_queue",
+    "QueueLinearizability": "queue_lin",
+    "check_queue_lin_cpu": "queue_lin",
+    "queue_lin_tensor_check": "queue_lin",
+    "Perf": "perf",
+    "perf_tensor_check": "perf",
+    "QueueWgl": "wgl",
+    "MutexWgl": "wgl",
+    "check_wgl_cpu": "wgl",
+    "wgl_tensor_check": "wgl",
+    "StreamLinearizability": "stream_lin",
+    "check_stream_lin_cpu": "stream_lin",
+    "stream_lin_tensor_check": "stream_lin",
+    "ElleListAppend": "elle",
+    "check_elle_cpu": "elle",
+    "elle_tensor_check": "elle",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f"jepsen_tpu.checkers.{_LAZY[name]}")
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -3,8 +3,14 @@
 Matches the ``jepsen.checker/Checker`` contract as used by the reference:
 ``check(test, history, opts) -> result-map`` where the result map carries a
 ``"valid?"`` key, and ``compose`` runs a named map of checkers returning a
-map of named results whose overall ``"valid?"`` is the AND of the parts
-(result shape visible in ``/root/reference/README.md:38-57``).
+map of named results whose overall ``"valid?"`` merges the parts (result
+shape visible in ``/root/reference/README.md:38-57``).
+
+``"valid?"`` is tri-state, like jepsen's: ``True``, ``False``, or the
+string ``"unknown"`` (jepsen's ``:unknown``) — an analysis that could not
+decide (e.g. a capped linearizability search) is *not* a violation.
+``merge_valid`` implements jepsen's merge rule: any ``False`` wins, then
+any unknown, else ``True``.
 """
 
 from __future__ import annotations
@@ -15,6 +21,18 @@ from typing import Any, Mapping, Sequence
 from jepsen_tpu.history.ops import Op
 
 VALID = "valid?"
+UNKNOWN = "unknown"
+
+
+def merge_valid(values) -> Any:
+    """jepsen ``checker/merge-valid``: False ≺ "unknown" ≺ True."""
+    out: Any = True
+    for v in values:
+        if v is False or v is None:
+            return False
+        if v == UNKNOWN:
+            out = UNKNOWN
+    return out
 
 
 class Checker(abc.ABC):
@@ -42,7 +60,9 @@ class ComposedChecker(Checker):
         results = {
             name: c.check(test, history, opts) for name, c in self.checkers.items()
         }
-        results[VALID] = all(r.get(VALID, False) for r in results.values())
+        results[VALID] = merge_valid(
+            r.get(VALID, False) for r in results.values()
+        )
         return results
 
 

@@ -57,7 +57,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.checkers.protocol import UNKNOWN, VALID, Checker
 from jepsen_tpu.history.ops import Op, OpF, OpType
 from jepsen_tpu.models.core import Call, Model, OwnedMutex, UnorderedQueue
 
@@ -177,8 +177,9 @@ def check_wgl_cpu(
             configs |= new
             explored += len(new)
             if len(configs) > max_configs:
+                # capped, not refuted: jepsen's :unknown verdict
                 return {
-                    VALID: False,
+                    VALID: UNKNOWN,
                     "unknown": True,
                     "final-op": j,
                     "configs-explored": explored,

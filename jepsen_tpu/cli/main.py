@@ -37,6 +37,7 @@ from pathlib import Path
 # introspection path is spawned as a subprocess by shell tooling that
 # cannot afford that.
 
+from jepsen_tpu.checkers.protocol import UNKNOWN
 from jepsen_tpu.history.store import (
     HISTORY_FILE,
     Store,
@@ -47,6 +48,24 @@ from jepsen_tpu.history.store import (
 
 GOOD_BANNER = "Everything looks good! ヽ('ー`)ノ"
 INVALID_BANNER = "Analysis invalid! ಠ~ಠ"
+UNKNOWN_BANNER = "Analysis result unknown ¯\\_(ツ)_/¯"
+
+
+def _verdict_exit(verdict) -> int:
+    """jepsen tri-state → banner + exit code.
+
+    0 = valid, 1 = invalid (genuine violation), 3 = analysis undecided
+    ("unknown", e.g. a capped search).  2 stays the usage/environment
+    error code (missing history, bad config) so CI shells can tell an
+    undecided analysis from a broken run."""
+    if verdict is True:
+        print(GOOD_BANNER)
+        return 0
+    if verdict == UNKNOWN:
+        print(UNKNOWN_BANNER)
+        return 3
+    print(INVALID_BANNER)
+    return 1
 
 
 def _resolve_history_path(path: Path) -> Path:
@@ -140,11 +159,7 @@ def cmd_check(args) -> int:
         file=sys.stderr,
     )
     save_results(out_dir, result)
-    if result[VALID]:
-        print(GOOD_BANNER)
-        return 0
-    print(INVALID_BANNER)
-    return 1
+    return _verdict_exit(result[VALID])
 
 
 def cmd_bench_check(args) -> int:
@@ -357,11 +372,7 @@ def cmd_test(args) -> int:
         )
     run = run_test(test)
     print(json.dumps(run.results, indent=1, default=_json_default))
-    if run.valid:
-        print(GOOD_BANNER)
-        return 0
-    print(INVALID_BANNER)
-    return 1
+    return _verdict_exit(run.verdict)
 
 
 def cmd_matrix(args) -> int:

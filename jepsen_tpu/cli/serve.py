@@ -19,6 +19,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from urllib.parse import unquote
 
+from jepsen_tpu.checkers.protocol import UNKNOWN
 from jepsen_tpu.history.store import RESULTS_FILE
 
 _PAGE = """<!doctype html>
@@ -45,11 +46,12 @@ def _runs(root: Path) -> list[dict]:
         for run_dir in sorted(test_dir.iterdir()):
             if not run_dir.is_dir() or run_dir.is_symlink():
                 continue
-            valid: bool | None = None
+            valid = None  # True | False | "unknown" | None (no results)
             results = run_dir / RESULTS_FILE
             if results.is_file():
                 try:
-                    valid = bool(json.loads(results.read_text()).get("valid?"))
+                    v = json.loads(results.read_text()).get("valid?")
+                    valid = v if v == UNKNOWN else bool(v)
                 except (json.JSONDecodeError, OSError):
                     valid = None
             runs.append(
@@ -70,6 +72,7 @@ def _index_page(root: Path) -> str:
         cls, verdict = {
             True: ("valid", "valid"),
             False: ("invalid", "INVALID"),
+            UNKNOWN: ("unknown", "unknown"),
             None: ("unknown", "?"),
         }[r["valid"]]
         rows.append(

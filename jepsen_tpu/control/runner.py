@@ -30,7 +30,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Callable, Mapping, Sequence
 
-from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.checkers.protocol import UNKNOWN, VALID, Checker
 from jepsen_tpu.generators.core import Generator, Pending, Scheduler
 from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpType
 from jepsen_tpu.history.store import Store
@@ -90,7 +90,12 @@ class TestRun:
 
     @property
     def valid(self) -> bool:
-        return bool(self.results.get(VALID))
+        return self.results.get(VALID) is True
+
+    @property
+    def verdict(self):
+        """jepsen tri-state: True, False, or "unknown"."""
+        return self.results.get(VALID)
 
 
 class _Recorder:
@@ -321,8 +326,12 @@ def _run_test_logged(
         test_map, history, {"out_dir": run_dir}
     )
     st.save_results(run_dir, results)
-    if results.get(VALID):
+    verdict = results.get(VALID)
+    if verdict is True:
         logger.info("Everything looks good! (%d ops)", len(history))
+    elif verdict == UNKNOWN:
+        # undecided (e.g. a capped search) — distinct from a violation
+        logger.info("Analysis unknown (%d ops)", len(history))
     else:
         # the verdict line the reference's CI triage greps for
         logger.info("Analysis invalid! (%d ops)", len(history))

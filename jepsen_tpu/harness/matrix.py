@@ -8,6 +8,8 @@ rules:
 - run valid → done;
 - run invalid with a genuine consistency violation ("Analysis invalid") →
   the config FAILS, no retry;
+- analysis undecided ("Analysis unknown", e.g. a capped search) → retry,
+  like a run that could not attest either way;
 - run crashed / final read never happened ("Set was never read") → retry,
   up to the attempt cap;
 - plus the out-of-band invariant: after drain, every queue on every node
@@ -20,6 +22,8 @@ from __future__ import annotations
 import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable, Mapping, Sequence
+
+from jepsen_tpu.checkers.protocol import UNKNOWN
 
 logger = logging.getLogger("jepsen_tpu.harness")
 
@@ -177,9 +181,15 @@ class MatrixRunner:
                 out.status = "invalid"
                 return out
 
-            if results.get("valid?"):
+            if results.get("valid?") is True:
                 out.status = "valid"
                 return out
+
+            if results.get("valid?") == UNKNOWN:
+                # undecided analysis: like a run that can't attest either
+                # way — retry rather than report a violation
+                out.notes.append(f"attempt {attempt}: analysis unknown; retrying")
+                continue
 
             # invalid verdict = genuine violation ("Analysis invalid"):
             # no retry — this is the signal the whole harness exists for

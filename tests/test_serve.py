@@ -5,7 +5,7 @@ import urllib.request
 
 import pytest
 
-from jepsen_tpu.cli.serve import start_background
+from jepsen_tpu.cli.serve import _index_page, start_background
 from jepsen_tpu.history.store import Store
 from jepsen_tpu.history.synth import SynthSpec, synth_history
 
@@ -94,3 +94,13 @@ def test_run_test_writes_jepsen_log(tmp_path):
     log = (run.run_dir / "jepsen.log").read_text()
     assert "analysis:" in log
     assert ("Everything looks good!" in log) or ("Analysis invalid!" in log)
+
+
+def test_unknown_verdict_renders_as_unknown(tmp_path):
+    """A tri-state "unknown" results.json must not render green."""
+    run = tmp_path / "t" / "r1"
+    run.mkdir(parents=True)
+    (run / "results.json").write_text('{"valid?": "unknown"}')
+    page = _index_page(tmp_path)
+    assert 'class="unknown">unknown' in page
+    assert 'class="valid"' not in page

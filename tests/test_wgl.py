@@ -261,3 +261,20 @@ def test_mutex_wgl_ops_mapping():
     ops = mutex_wgl_ops(h)
     assert len(ops) == 2  # the failed acquire is dropped
     assert ops[0].call.a0 == 1 and ops[1].ret == INF
+
+
+def test_capped_search_reports_unknown_not_invalid():
+    """A search that hits the config cap is undecided — jepsen's :unknown
+    verdict — and must not propagate as a violation through compose."""
+    from jepsen_tpu.checkers.protocol import merge_valid
+    from jepsen_tpu.models.core import OwnedMutex
+
+    # many forever-pending acquires from distinct processes explode the
+    # config space; a tiny cap forces the unknown path deterministically
+    ops = [WglOp(Call(OwnedMutex.ACQUIRE, a0=p), 0, INF) for p in range(12)]
+    ops.append(WglOp(Call(OwnedMutex.ACQUIRE, a0=99), 1, 2))
+    r = check_wgl_cpu(ops, OwnedMutex(), max_configs=8)
+    assert r["valid?"] == "unknown" and r["unknown"]
+    assert merge_valid([True, "unknown", True]) == "unknown"
+    assert merge_valid([True, "unknown", False]) is False
+    assert merge_valid([True, True]) is True

@@ -10,14 +10,15 @@ Two distinct needs, two entry points:
   tunnel does not answer, which is exactly what a dryrun must not do.
 
 - ``ensure_backend(deadline)`` — the caller wants the *real* default
-  backend (bench, checker service).  Probes it in a watchdog thread so a
-  hanging plugin init fails fast with a clear message instead of blocking
-  the process forever.
+  backend (bench, checker service).  Probes it in a killable subprocess
+  so a hanging plugin init fails fast with a clear message instead of
+  blocking the process (or poisoning jax's backend lock) forever.
 """
 
 from __future__ import annotations
 
 import os
+import sys
 
 
 def _force_host_device_flag(n: int) -> None:
@@ -83,8 +84,12 @@ def pin_cpu_platform() -> None:
 
 _probe_succeeded = False
 
+#: env override for the probe deadline (seconds) — lets operators (and
+#: tests) tighten or relax how long a possibly-hanging plugin init may take
+DEADLINE_ENV = "JEPSEN_TPU_BACKEND_DEADLINE"
 
-def ensure_backend(deadline: float = 60.0) -> str:
+
+def ensure_backend(deadline: float | None = None) -> str:
     """Initialize the default JAX backend with a watchdog deadline.
 
     The probe runs in a **subprocess**, not a thread: jax's backend init
@@ -99,6 +104,18 @@ def ensure_backend(deadline: float = 60.0) -> str:
     global _probe_succeeded
     import jax
 
+    if deadline is None:
+        try:
+            deadline = float(os.environ.get(DEADLINE_ENV, 60.0))
+        except ValueError:
+            # a config typo must not crash the CLI — fall back loudly
+            print(
+                f"warning: ignoring malformed {DEADLINE_ENV}="
+                f"{os.environ[DEADLINE_ENV]!r}; using 60s",
+                file=sys.stderr,
+            )
+            deadline = 60.0
+
     if jax.config.jax_platforms == "cpu":
         # CPU init cannot hang; also covers in-process pins that a
         # subprocess (which only inherits the env) would not see
@@ -107,7 +124,6 @@ def ensure_backend(deadline: float = 60.0) -> str:
 
     if not _probe_succeeded:
         import subprocess
-        import sys
 
         try:
             r = subprocess.run(

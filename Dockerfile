@@ -1,42 +1,37 @@
-# CI image for the TPU-native Jepsen harness (equivalent of the
-# reference's Dockerfile, which ships terraform + awscli + a pinned Erlang
-# for its CI container).  This image only *drives* the cluster — terraform,
-# awscli, ssh, and a python with the framework's host-side deps; Erlang and
-# RabbitMQ live on the provisioned workers, JAX/TPU on the controller.
+# CI image for the TPU-native Jepsen harness.
+#
+# This container only *drives* a cluster: it needs terraform + awscli to
+# provision, ssh/git/python to run the matrix orchestration, and nothing
+# else — Erlang and RabbitMQ live on the provisioned workers, JAX/TPU on
+# the controller.  (The reference's CI image additionally bakes a pinned
+# Erlang; here that pin ships as an apt preference pushed to workers by
+# the DB lifecycle instead.)
 
 FROM debian:bookworm
 
-ENV LANG='C.UTF-8'
-ENV TERRAFORM_VERSION='1.15.8'
+ARG TERRAFORM_VERSION=1.15.8
+ENV LANG=C.UTF-8
 
-RUN apt-get clean && \
-    apt-get update && \
-    apt-get -y upgrade && \
-    apt-get install -y -V --no-install-recommends \
-      ca-certificates \
-      apt-transport-https \
-      gnupg \
-      wget \
-      curl \
-      openssh-client \
-      unzip \
-      lsb-release \
-      make \
-      git \
-      python3 \
-      python3-pip \
-      python3-venv
+RUN set -eux; \
+    apt-get update; \
+    apt-get upgrade -y; \
+    apt-get install -y --no-install-recommends \
+        apt-transport-https ca-certificates curl git gnupg lsb-release \
+        make openssh-client python3 python3-pip python3-venv unzip wget; \
+    rm -rf /var/lib/apt/lists/*
 
-RUN curl "https://awscli.amazonaws.com/awscli-exe-linux-x86_64.zip" -o "awscliv2.zip" && \
-    unzip awscliv2.zip && \
-    ./aws/install && \
-    rm awscliv2.zip && \
-    rm -rf ./aws && \
-    aws --version
-
-RUN wget https://releases.hashicorp.com/terraform/${TERRAFORM_VERSION}/terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
-    unzip terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
-    mv terraform /usr/bin && \
-    chmod u+x /usr/bin/terraform && \
-    rm terraform_${TERRAFORM_VERSION}_linux_amd64.zip && \
+# awscli v2 (store/broker-log archival to S3) and terraform (cluster
+# provisioning), both verified by running their version commands
+RUN set -eux; \
+    curl -fsSL "https://awscli.amazonaws.com/awscli-exe-linux-x86_64.zip" \
+        -o /tmp/awscli.zip; \
+    unzip -q /tmp/awscli.zip -d /tmp; \
+    /tmp/aws/install; \
+    rm -rf /tmp/awscli.zip /tmp/aws; \
+    aws --version; \
+    curl -fsSL "https://releases.hashicorp.com/terraform/${TERRAFORM_VERSION}/terraform_${TERRAFORM_VERSION}_linux_amd64.zip" \
+        -o /tmp/terraform.zip; \
+    unzip -q /tmp/terraform.zip -d /tmp/terraform; \
+    install -m 0755 /tmp/terraform/terraform /usr/bin/terraform; \
+    rm -rf /tmp/terraform.zip /tmp/terraform; \
     terraform version

@@ -574,7 +574,7 @@ def build_parser() -> argparse.ArgumentParser:
         default="queue",
         help="test program: quorum-queue (reference), stream append/read, "
         "elle list-append transactions, or the legacy mutex variant "
-        "(--db sim)",
+        "(sim, or live as a single-token quorum-queue lock)",
     )
     t.add_argument("--store", default="store")
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")

@@ -17,6 +17,7 @@ from typing import Any, Mapping, Sequence
 
 from jepsen_tpu.client.protocol import (
     DriverTimeout,
+    MutexDriver,
     QueueDriver,
     StreamDriver,
     TxnDriver,
@@ -140,6 +141,21 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
     lib.amqp_txn_reconnect.argtypes = [ctypes.c_void_p]
     lib.amqp_txn_close.argtypes = [ctypes.c_void_p]
     lib.amqp_txn_destroy.argtypes = [ctypes.c_void_p]
+    lib.amqp_lock_client_create.restype = ctypes.c_void_p
+    lib.amqp_lock_client_create.argtypes = [
+        ctypes.c_char_p,  # host
+        ctypes.c_int,  # port
+        ctypes.c_char_p,  # user
+        ctypes.c_char_p,  # pass
+        ctypes.c_int,  # quorum group size
+        ctypes.c_int,  # connect retry ms
+    ]
+    lib.amqp_lock_client_setup.argtypes = [ctypes.c_void_p]
+    lib.amqp_lock_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.amqp_lock_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.amqp_lock_reconnect.argtypes = [ctypes.c_void_p]
+    lib.amqp_lock_close.argtypes = [ctypes.c_void_p]
+    lib.amqp_lock_destroy.argtypes = [ctypes.c_void_p]
     if path is None:
         _lib = lib
     return lib
@@ -379,6 +395,82 @@ def native_txn_driver_factory(port: int = 5672, **kw: Any):
 
     def factory(test: Mapping[str, Any], node: str) -> NativeTxnDriver:
         return NativeTxnDriver(node, port=port, **kw)
+
+    return factory
+
+
+class NativeMutexDriver(MutexDriver):
+    """One lock client bound to one node: a single-token quorum-queue lock
+    (``jepsen.lock``).  Acquire holds the token un-acked — the broker's own
+    delivery semantics provide mutual exclusion while the connection
+    lives; release rejects it back with requeue.  A connection drop while
+    holding revokes the lock broker-side (the token requeues): the driver
+    surfaces that honestly — after any reconnect this client is not the
+    holder — so an unfenced holder racing the next grantee shows up in the
+    history as a double grant for the linearizability checker to flag."""
+
+    def __init__(
+        self,
+        node: str,
+        port: int = 5672,
+        user: str = "guest",
+        password: str = "guest",
+        quorum_group_size: int = 0,
+        connect_retry_ms: int = 30000,
+    ):
+        self.lib = load_library()
+        self.handle = self.lib.amqp_lock_client_create(
+            node.encode(), port, user.encode(), password.encode(),
+            quorum_group_size, connect_retry_ms,
+        )
+        if not self.handle:
+            raise ConnectionError(f"amqp_lock_client_create failed for {node}")
+
+    def setup(self) -> None:
+        if self.lib.amqp_lock_client_setup(self.handle) != 0:
+            raise ConnectionError("lock setup failed")
+
+    def acquire(self, timeout_s: float) -> bool:
+        r = self.lib.amqp_lock_acquire(self.handle, int(timeout_s * 1000))
+        if r == 1:
+            return True
+        if r == 0:
+            return False
+        if r == -1:
+            raise DriverTimeout("acquire outcome unknown")
+        raise ConnectionError("acquire failed (connection error)")
+
+    def release(self, timeout_s: float) -> bool:
+        r = self.lib.amqp_lock_release(self.handle, int(timeout_s * 1000))
+        if r == 1:
+            return True
+        if r == 0:
+            return False
+        if r == -1:
+            raise DriverTimeout("release outcome unknown")
+        raise ConnectionError("release failed (connection error)")
+
+    def reconnect(self) -> None:
+        if self.lib.amqp_lock_reconnect(self.handle) != 0:
+            raise ConnectionError("reconnect failed")
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.amqp_lock_close(self.handle)
+
+
+def native_mutex_driver_factory(port: int = 5672, **kw: Any):
+    """Factory for :class:`MutexClient`: ``(test, node) -> driver``."""
+
+    def factory(test: Mapping[str, Any], node: str) -> NativeMutexDriver:
+        return NativeMutexDriver(
+            node,
+            port=port,
+            quorum_group_size=int(
+                test.get("quorum-initial-group-size", 0) or 0
+            ),
+            **kw,
+        )
 
     return factory
 

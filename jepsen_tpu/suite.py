@@ -363,10 +363,21 @@ def build_rabbitmq_test(
         checker = queue_checker(checker_backend)
         name = "rabbitmq-simple-partition"
     elif workload == "mutex":
-        raise NotImplementedError(
-            "the mutex workload has no live AMQP mapping (the reference's "
-            "variant is a commented-out legacy test); use --db sim"
+        # the reference's legacy linearizable-lock variant
+        # (rabbitmq_test.clj:18-44), live: a single-token quorum-queue lock
+        # (acquire = hold the token un-acked, release = reject/requeue; a
+        # dropped connection revokes the grant broker-side — the unfenced-
+        # lock hazard the checker must see)
+        from jepsen_tpu.client.protocol import MutexClient
+        from jepsen_tpu.client.native import native_mutex_driver_factory
+
+        client = MutexClient(
+            native_mutex_driver_factory(),
+            op_timeout_s=o["publish-confirm-timeout"],
         )
+        generator = mutex_generator(o)
+        checker = mutex_checker(checker_backend)
+        name = "rabbitmq-mutex"
     else:
         raise ValueError(f"unknown workload {workload!r}")
     return Test(

@@ -812,6 +812,22 @@ std::vector<int32_t> g_drain_result;
 std::condition_variable g_drain_cv;
 int g_drain_wait_ms = 5000;  // redelivery settle time (Utils.java:427)
 
+// shared connect-retry loop (Utils.java:294-304): keep trying within the
+// budget, 1 s between attempts; null when the budget runs out
+std::shared_ptr<Connection> connect_with_retry(const ClientConfig& cfg,
+                                               int budget_ms) {
+  auto deadline = Clock::now() + milliseconds(budget_ms);
+  while (true) {
+    auto conn = std::make_shared<Connection>(cfg.host, cfg.port, cfg.user,
+                                             cfg.pass);
+    if (conn->open(5000)) return conn;
+    if (Clock::now() + milliseconds(1000) >= deadline) break;
+    std::this_thread::sleep_for(milliseconds(1000));
+  }
+  logf("connect to %s: retry budget exhausted", cfg.host.c_str());
+  return nullptr;
+}
+
 class Client {
  public:
   explicit Client(ClientConfig cfg) : cfg_(std::move(cfg)) {
@@ -825,20 +841,12 @@ class Client {
   }
 
   bool connect() {
-    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
-    while (Clock::now() < deadline) {
-      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
-                                               cfg_.user, cfg_.pass);
-      if (conn->open(5000)) {
-        std::lock_guard<std::mutex> lk(mu_);
-        conn_ = conn;
-        initialized_ = false;
-        return true;
-      }
-      std::this_thread::sleep_for(milliseconds(1000));
-    }
-    logf("connect to %s: retry budget exhausted", cfg_.host.c_str());
-    return false;
+    auto conn = connect_with_retry(cfg_, cfg_.connect_retry_ms);
+    if (!conn) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    conn_ = conn;
+    initialized_ = false;
+    return true;
   }
 
   // lazy channel/consumer init (Utils.java:319-325)
@@ -1023,20 +1031,12 @@ class StreamClient {
   explicit StreamClient(ClientConfig cfg) : cfg_(std::move(cfg)) {}
 
   bool connect() {
-    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
-    while (Clock::now() < deadline) {
-      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
-                                               cfg_.user, cfg_.pass);
-      if (conn->open(5000)) {
-        std::lock_guard<std::mutex> lk(mu_);
-        conn_ = conn;
-        initialized_ = false;
-        return true;
-      }
-      std::this_thread::sleep_for(milliseconds(1000));
-    }
-    logf("stream connect to %s: retry budget exhausted", cfg_.host.c_str());
-    return false;
+    auto conn = connect_with_retry(cfg_, cfg_.connect_retry_ms);
+    if (!conn) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    conn_ = conn;
+    initialized_ = false;
+    return true;
   }
 
   bool initialize_if_necessary() {
@@ -1135,22 +1135,14 @@ class TxnClient {
   }
 
   bool connect() {
-    auto deadline = Clock::now() + milliseconds(cfg_.connect_retry_ms);
-    while (Clock::now() < deadline) {
-      auto conn = std::make_shared<Connection>(cfg_.host, cfg_.port,
-                                               cfg_.user, cfg_.pass);
-      if (conn->open(5000)) {
-        std::lock_guard<std::mutex> lk(mu_);
-        conn_ = conn;
-        rconn_.reset();  // lazily reopened by the next read
-        initialized_ = false;
-        declared_.clear();
-        return true;
-      }
-      std::this_thread::sleep_for(milliseconds(1000));
-    }
-    logf("txn connect to %s: retry budget exhausted", cfg_.host.c_str());
-    return false;
+    auto conn = connect_with_retry(cfg_, cfg_.connect_retry_ms);
+    if (!conn) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    conn_ = conn;
+    rconn_.reset();  // lazily reopened by the next read
+    initialized_ = false;
+    declared_.clear();
+    return true;
   }
 
   bool initialize_if_necessary() {
@@ -1272,6 +1264,194 @@ class TxnClient {
   std::shared_ptr<Connection> rconn_;
   bool initialized_ = false;
   std::set<int32_t> declared_;
+};
+
+// ---------------------------------------------------------------------------
+// Lock client (the reference's legacy mutex variant, rabbitmq_test.clj:18-44,
+// made live): a single-token lock over a quorum queue.  Setup publishes ONE
+// token message into "jepsen.lock"; acquire = basic.get with manual ack,
+// holding the delivery un-acked — the broker will not hand the token to any
+// other connection while this one lives; release = basic.reject(requeue),
+// returning the token.  A connection drop while holding REVOKES the lock
+// broker-side (the token requeues) without the holder's consent — the
+// classic unfenced-lock hazard.  The driver does not hide it: a holder that
+// reconnects simply is not the holder any more, and any resulting double
+// grant lands in the history for the linearizability checker to flag.
+// ---------------------------------------------------------------------------
+
+constexpr const char* LOCK_QUEUE_NAME = "jepsen.lock";
+constexpr int32_t LOCK_TOKEN_VALUE = 1;
+bool g_lock_declared = false;  // once-latch, like g_queues_declared
+
+class LockClient {
+ public:
+  explicit LockClient(ClientConfig cfg) : cfg_(std::move(cfg)) {}
+
+  bool connect(int budget_ms = 0) {
+    auto conn = connect_with_retry(
+        cfg_, budget_ms > 0 ? budget_ms : cfg_.connect_retry_ms);
+    if (!conn) return false;
+    std::lock_guard<std::mutex> lk(mu_);
+    conn_ = conn;
+    // a fresh connection cannot hold: any token the old one held
+    // un-acked requeued broker-side when it died
+    holding_ = false;
+    poisoned_ = false;
+    return true;
+  }
+
+  bool initialize_if_necessary() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+    }
+    if (!c || !c->alive()) return false;
+    std::lock_guard<std::mutex> lk(g_registry_mu);
+    if (g_lock_declared) return true;
+    try {
+      amqp::Table args;
+      args.put_str("x-queue-type", "quorum");
+      if (cfg_.quorum_group_size > 0)
+        args.put_int("x-quorum-initial-group-size", cfg_.quorum_group_size);
+      if (!c->declare_queue(LOCK_QUEUE_NAME, args))
+        throw std::runtime_error("lock queue.declare failed");
+      if (!c->purge_queue(LOCK_QUEUE_NAME))
+        throw std::runtime_error("lock purge failed");
+      c->enable_confirms();
+      if (c->publish_confirm(LOCK_QUEUE_NAME, LOCK_TOKEN_VALUE, 5000) != 1)
+        throw std::runtime_error("lock token publish not confirmed");
+    } catch (const std::exception& e) {
+      logf("lock initialize on %s failed: %s", cfg_.host.c_str(), e.what());
+      // tear the connection down: an UNCONFIRMED token publish may still
+      // be in flight on it, and a retry (ours or another client's) would
+      // purge-then-republish, leaving TWO tokens once the stray lands —
+      // a harness-made double grant.  Closing narrows that window to
+      // frames already accepted by the broker's socket.
+      close_connection();
+      return false;
+    }
+    g_lock_declared = true;
+    return true;
+  }
+
+  // 1 granted, 0 busy (or we already hold), -1 outcome unknown, -2 error
+  int acquire(int timeout_ms) {
+    if (!clear_poison(timeout_ms)) return -2;
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (holding_) return 0;  // we hold the token: the queue is empty
+    }
+    int32_t v = 0;
+    uint64_t tag = 0;
+    int r = c->basic_get(LOCK_QUEUE_NAME, &v, &tag, timeout_ms);
+    if (r == 1) {
+      std::lock_guard<std::mutex> lk(mu_);
+      holding_ = true;
+      tag_ = tag;
+      return 1;
+    }
+    if (r == 0) return 0;
+    if (r == -1) {
+      // the get reached the wire but no answer came: the broker may be
+      // handing us the token right now.  Poison the connection — the next
+      // op tears it down (requeueing any in-flight grant) — so an
+      // indeterminate acquire cannot park the token un-acked forever.
+      std::lock_guard<std::mutex> lk(mu_);
+      poisoned_ = true;
+      return -1;
+    }
+    return -2;
+  }
+
+  // 1 released, 0 not the holder, -1 outcome unknown, -2 error
+  int release(int timeout_ms) {
+    // reject carries no *-ok: outcome is known at send; timeout_ms only
+    // bounds the poisoned-path reconnect below
+    bool poisoned, holding;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      poisoned = poisoned_;
+      holding = holding_;
+    }
+    if (poisoned) {
+      // an earlier acquire's outcome is unknown; reconnecting requeues any
+      // token that get left un-acked, but whether WE were the holder is
+      // unknowable — so is this release's outcome.  The reconnect is
+      // bounded by the op's own timeout, never the 30 s connect budget.
+      close_connection();
+      connect(timeout_ms > 0 ? timeout_ms : 1000);
+      return -1;
+    }
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    if (!holding) return 0;
+    uint64_t tag;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      tag = tag_;
+    }
+    if (c->basic_reject_requeue(tag)) {
+      std::lock_guard<std::mutex> lk(mu_);
+      holding_ = false;
+      return 1;
+    }
+    // the reject never left this process and the connection is now broken:
+    // the broker requeues the token when it reaps the connection — the
+    // release happens, at an unknown point
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      holding_ = false;
+    }
+    return -1;
+  }
+
+  void close_connection() {
+    std::shared_ptr<Connection> c;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      c = conn_;
+      conn_.reset();
+      holding_ = false;
+      poisoned_ = false;
+    }
+    if (c) c->close();
+  }
+
+  bool reconnect() {
+    close_connection();
+    return connect();
+  }
+
+ private:
+  std::shared_ptr<Connection> conn() {
+    std::lock_guard<std::mutex> lk(mu_);
+    return conn_;
+  }
+
+  // a poisoned connection (indeterminate basic.get in flight) must be
+  // torn down before the next op; the replacement connect is bounded by
+  // the op's timeout so a partition can't stall a 5 s op for the full
+  // 30 s connect budget (reconnection policy stays with the test layer)
+  bool clear_poison(int timeout_ms) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      if (!poisoned_) return true;
+    }
+    close_connection();
+    return connect(timeout_ms > 0 ? timeout_ms : 1000);
+  }
+
+  ClientConfig cfg_;
+  std::mutex mu_;
+  std::shared_ptr<Connection> conn_;
+  bool holding_ = false;
+  bool poisoned_ = false;
+  uint64_t tag_ = 0;
 };
 
 // drain: the correctness-critical final read (Utils.java:413-470)
@@ -1501,6 +1681,50 @@ void amqp_txn_destroy(void* p) {
   delete c;
 }
 
+// ---- lock client ABI (legacy mutex variant, live) -------------------------
+
+void* amqp_lock_client_create(const char* host, int port, const char* user,
+                              const char* pass, int quorum_group_size,
+                              int connect_retry_ms) {
+  ClientConfig cfg;
+  cfg.host = host ? host : "localhost";
+  cfg.port = port;
+  if (user) cfg.user = user;
+  if (pass) cfg.pass = pass;
+  cfg.quorum_group_size = quorum_group_size;
+  if (connect_retry_ms > 0) cfg.connect_retry_ms = connect_retry_ms;
+  auto* c = new LockClient(std::move(cfg));
+  if (!c->connect())
+    logf("initial lock connect failed for %s", host ? host : "?");
+  return c;
+}
+
+int amqp_lock_client_setup(void* p) {
+  return static_cast<LockClient*>(p)->initialize_if_necessary() ? 0 : -1;
+}
+
+int amqp_lock_acquire(void* p, int timeout_ms) {
+  return static_cast<LockClient*>(p)->acquire(timeout_ms);
+}
+
+int amqp_lock_release(void* p, int timeout_ms) {
+  return static_cast<LockClient*>(p)->release(timeout_ms);
+}
+
+int amqp_lock_reconnect(void* p) {
+  return static_cast<LockClient*>(p)->reconnect() ? 0 : -1;
+}
+
+void amqp_lock_close(void* p) {
+  static_cast<LockClient*>(p)->close_connection();
+}
+
+void amqp_lock_destroy(void* p) {
+  auto* c = static_cast<LockClient*>(p);
+  c->close_connection();
+  delete c;
+}
+
 // test support (= Utils.reset(), Utils.java:147-152)
 void amqp_reset(int drain_wait_ms) {
   std::lock_guard<std::mutex> lk(g_registry_mu);
@@ -1508,6 +1732,7 @@ void amqp_reset(int drain_wait_ms) {
   g_hosts.clear();
   g_queues_declared = false;
   g_stream_declared = false;
+  g_lock_declared = false;
   g_drained = false;
   g_drain_done = false;
   g_drain_result.clear();

@@ -250,3 +250,18 @@ def test_build_rabbitmq_test_elle_constructs():
     )
     assert isinstance(test.client, TxnClient)
     assert test.name == "rabbitmq-elle-txn"
+
+
+def test_build_rabbitmq_test_mutex_constructs():
+    """The live mutex workload is buildable (single-token lock landed in
+    the native driver) — client/generator/checker wired, no
+    NotImplementedError."""
+    from jepsen_tpu.client.protocol import MutexClient
+    from jepsen_tpu.control.ssh import FakeTransport
+    from jepsen_tpu.suite import build_rabbitmq_test
+
+    test = build_rabbitmq_test(
+        workload="mutex", transport=FakeTransport()
+    )
+    assert isinstance(test.client, MutexClient)
+    assert test.name == "rabbitmq-mutex"

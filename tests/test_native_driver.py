@@ -445,3 +445,111 @@ class TestDeadLetter:
         assert d.dequeue(2.0) == 21  # consumed before the TTL fires
         assert broker.queue_depth("jepsen.queue.dead.letter") == 0
         d.close()
+
+
+class TestNativeMutex:
+    """The legacy mutex variant live (``rabbitmq_test.clj:18-44``): a
+    single-token quorum-queue lock.  Mutual exclusion comes from holding
+    the token un-acked; a dropped connection requeues it — the unfenced-
+    lock revocation the checker must see as a double grant."""
+
+    def _lock(self, native_lib, broker, **kw):
+        from jepsen_tpu.client.native import NativeMutexDriver
+
+        kw.setdefault("connect_retry_ms", 3000)
+        return NativeMutexDriver("127.0.0.1", port=broker.port, **kw)
+
+    def test_acquire_release_roundtrip(self, native_lib, broker):
+        a = self._lock(native_lib, broker)
+        b = self._lock(native_lib, broker)
+        a.setup()
+        b.setup()
+        assert a.acquire(2.0) is True
+        assert b.acquire(2.0) is False  # busy: A holds the token
+        assert a.acquire(2.0) is False  # re-acquire by the holder: busy
+        assert b.release(2.0) is False  # not the holder
+        assert a.release(2.0) is True
+        assert b.acquire(2.0) is True  # the token came back
+        assert a.release(2.0) is False  # no longer the holder
+        assert b.release(2.0) is True
+        a.close()
+        b.close()
+
+    def test_reconnect_revokes_grant(self, native_lib, broker):
+        a = self._lock(native_lib, broker)
+        b = self._lock(native_lib, broker)
+        a.setup()
+        b.setup()
+        assert a.acquire(2.0) is True
+        a.reconnect()  # the broker requeues A's un-acked token
+        assert b.acquire(2.0) is True  # granted: the lock was revoked
+        assert a.release(2.0) is False  # A is not the holder any more
+        a.close()
+        b.close()
+
+    def test_live_mutex_clean_history_is_valid(self, native_lib, broker):
+        """Contended acquire/release rounds through the full MutexClient
+        op mapping produce a history both WGL engines call linearizable."""
+        from jepsen_tpu.checkers.wgl import MutexWgl
+        from jepsen_tpu.client.protocol import MutexClient
+        from jepsen_tpu.client.native import native_mutex_driver_factory
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+        factory = native_mutex_driver_factory(
+            port=broker.port, connect_retry_ms=3000
+        )
+        base = MutexClient(factory, op_timeout_s=2.0)
+        test = {"quorum-initial-group-size": 0}
+        clients = [base.open(test, "127.0.0.1") for _ in range(3)]
+        for c in clients:
+            c.setup(test)
+        history = []
+
+        def run(proc, f):
+            inv = Op.invoke(f, proc)
+            history.append(inv)
+            history.append(clients[proc].invoke(test, inv))
+
+        rng = random.Random(7)
+        for _ in range(30):
+            proc = rng.randrange(3)
+            run(proc, rng.choice([OpF.ACQUIRE, OpF.RELEASE]))
+        for proc in range(3):  # final release per thread (the generator's)
+            run(proc, OpF.RELEASE)
+        for c in clients:
+            c.close(test)
+        h = reindex(history)
+        assert any(op.is_ok and op.f == OpF.ACQUIRE for op in h)
+        for backend in ("cpu", "tpu"):
+            r = MutexWgl(backend=backend).check({}, h)
+            assert r["valid?"] is True, (backend, r)
+
+    def test_live_mutex_double_grant_caught(self, native_lib, broker):
+        """End-to-end unfenced-lock hazard: the holder's connection blips
+        (token requeues broker-side), the next contender is granted, and
+        the holder never released — the checker must refute the history."""
+        from jepsen_tpu.checkers.wgl import MutexWgl
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+        a = self._lock(native_lib, broker)
+        b = self._lock(native_lib, broker)
+        a.setup()
+        b.setup()
+        history = []
+        inv_a = Op.invoke(OpF.ACQUIRE, 0)
+        history.append(inv_a)
+        assert a.acquire(2.0) is True
+        history.append(inv_a.complete(OpType.OK))
+        # network blip: A's client survives but its connection does not —
+        # the broker requeues the token; A still believes it holds the lock
+        a.reconnect()
+        inv_b = Op.invoke(OpF.ACQUIRE, 1)
+        history.append(inv_b)
+        assert b.acquire(2.0) is True
+        history.append(inv_b.complete(OpType.OK))
+        a.close()
+        b.close()
+        h = reindex(history)
+        for backend in ("cpu", "tpu"):
+            r = MutexWgl(backend=backend).check({}, h)
+            assert r["valid?"] is False, (backend, r)

@@ -31,19 +31,21 @@ Indeterminate (``info``) ops follow Knossos semantics: they may linearize
 at any point after their invocation — they join every later event's
 candidate set — or never (no return event forces them).
 
-**Backend guidance — measured, see ``WGL_BENCH.md`` (2026-07-29, real
-chip)**: compile cost on the tunneled TPU is ~0.6 s per history-op row
-(23.6 s at 50 ops, 131.5 s at 200 ops — linear, cached per shape after
-the first call); steady-state run time beats the CPU-backend tensor
-engine 4.5–12× but is comparable to the classic host search (32 ms vs 24 ms per
-100-op history at batch 256, where 128-row frontiers overflow to
-*unknown* on the hardest histories — the documented CPU escape hatch).
-So ``QueueWgl(backend="tpu")`` is correct and usable on-chip; for the
-quorum-queue workload the TPU-fast linearizability path remains the
-per-value decomposition (``jepsen_tpu.checkers.queue_lin``,
-P-compositionality), which covers the model exactly at millions of
-histories/s.  The WGL engine is the general-model fallback (CAS
-registers, mutexes, FIFO).
+**Backend guidance — measured, see ``WGL_BENCH.md`` (2026-07-30, real
+chip)**: compile cost on the tunneled TPU is **flat** at ~20 s per shape
+bucket regardless of history length (the dedup orders frontier rows by a
+64-bit row hash instead of a variadic lexicographic sort over every
+state column, which had made XLA's compile time linear at ~0.6 s per op
+row); steady-state run time beats the CPU-backend tensor engine 2.0–5.6×
+but does not beat the classic host search except where its exponential
+tail bites (128-row frontiers overflow to *unknown* on the hardest
+histories — the documented CPU escape hatch).  So
+``QueueWgl(backend="tpu")`` is correct and usable on-chip at a one-off
+~20 s compile; for the quorum-queue workload the TPU-fast
+linearizability path remains the per-value decomposition
+(``jepsen_tpu.checkers.queue_lin``, P-compositionality), which covers
+the model exactly at millions of histories/s.  The WGL engine is the
+general-model fallback (CAS registers, mutexes, FIFO).
 """
 
 from __future__ import annotations
@@ -260,14 +262,44 @@ def pack_wgl_batch(
     )
 
 
+def _row_hashes(rows):
+    """Two independent 32-bit mix-folds per row (``lax.scan`` over the
+    columns, so the compiled program size stays O(1) in row width)."""
+
+    def fold(mult, init):
+        def body(h, col):
+            h = (h ^ col) * jnp.uint32(mult)
+            return h ^ (h >> 15), None
+
+        h0 = jnp.full((rows.shape[0],), init, jnp.uint32)
+        h, _ = jax.lax.scan(body, h0, rows.T)
+        return h
+
+    return fold(0x85EBCA6B, 0x9E3779B9), fold(0xC2B2AE35, 0x27D4EB2F)
+
+
 def _dedup_truncate(rows, valid, capacity):
-    """Sort rows lexicographically (invalid last), mark first-of-kind, and
-    scatter the first ``capacity`` unique rows into a fresh frontier."""
+    """Group identical rows (invalid last), mark first-of-kind, and scatter
+    the first ``capacity`` unique rows into a fresh frontier.
+
+    Rows are ordered by a 64-bit row hash rather than lexicographically: a
+    variadic ``lax.sort`` over all ``D`` state columns makes XLA's compile
+    time linear in history length (the round-2 compile-cost wall), while
+    the hash sort keeps it flat.  Dedup stays **exact** — identical rows
+    share both hash keys, so a stable sort makes them adjacent, and the
+    first-of-kind test compares the actual rows.  A 2⁻⁶⁴ hash collision
+    between *distinct* rows can only interleave a group and let a
+    duplicate survive — wasting one frontier slot, never changing a
+    verdict (worst case: earlier overflow ⇒ *unknown* ⇒ CPU fallback)."""
     m, d = rows.shape
-    sort_ops = [(~valid).astype(jnp.uint32)] + [rows[:, c] for c in range(d)]
-    sorted_cols = jax.lax.sort(tuple(sort_ops), num_keys=d + 1)
-    svalid = sorted_cols[0] == 0
-    srows = jnp.stack(sorted_cols[1:], axis=1)
+    h1, h2 = _row_hashes(rows)
+    s_inval, _, _, sidx = jax.lax.sort(
+        ((~valid).astype(jnp.uint32), h1, h2,
+         jnp.arange(m, dtype=jnp.uint32)),
+        num_keys=3,
+    )
+    svalid = s_inval == 0
+    srows = rows[sidx]
     differs = jnp.any(srows != jnp.roll(srows, 1, axis=0), axis=1)
     is_new = svalid & differs.at[0].set(True)
     rank = jnp.cumsum(is_new) - 1

@@ -818,9 +818,17 @@ std::shared_ptr<Connection> connect_with_retry(const ClientConfig& cfg,
                                                int budget_ms) {
   auto deadline = Clock::now() + milliseconds(budget_ms);
   while (true) {
+    // each attempt is clipped to the remaining budget (a 2 s budget must
+    // not block 5 s in open), floor 250 ms so a dreg of budget still makes
+    // one genuine attempt
+    auto left = std::chrono::duration_cast<milliseconds>(deadline -
+                                                         Clock::now())
+                    .count();
+    int attempt_ms =
+        static_cast<int>(std::max<long long>(250, std::min<long long>(5000, left)));
     auto conn = std::make_shared<Connection>(cfg.host, cfg.port, cfg.user,
                                              cfg.pass);
-    if (conn->open(5000)) return conn;
+    if (conn->open(attempt_ms)) return conn;
     if (Clock::now() + milliseconds(1000) >= deadline) break;
     std::this_thread::sleep_for(milliseconds(1000));
   }

@@ -464,14 +464,14 @@ class TestNativeMutex:
         b = self._lock(native_lib, broker)
         a.setup()
         b.setup()
-        assert a.acquire(2.0) is True
-        assert b.acquire(2.0) is False  # busy: A holds the token
-        assert a.acquire(2.0) is False  # re-acquire by the holder: busy
-        assert b.release(2.0) is False  # not the holder
-        assert a.release(2.0) is True
-        assert b.acquire(2.0) is True  # the token came back
-        assert a.release(2.0) is False  # no longer the holder
-        assert b.release(2.0) is True
+        assert a.acquire(5.0) is True
+        assert b.acquire(5.0) is False  # busy: A holds the token
+        assert a.acquire(5.0) is False  # re-acquire by the holder: busy
+        assert b.release(5.0) is False  # not the holder
+        assert a.release(5.0) is True
+        assert b.acquire(5.0) is True  # the token came back
+        assert a.release(5.0) is False  # no longer the holder
+        assert b.release(5.0) is True
         a.close()
         b.close()
 
@@ -480,10 +480,10 @@ class TestNativeMutex:
         b = self._lock(native_lib, broker)
         a.setup()
         b.setup()
-        assert a.acquire(2.0) is True
+        assert a.acquire(5.0) is True
         a.reconnect()  # the broker requeues A's un-acked token
-        assert b.acquire(2.0) is True  # granted: the lock was revoked
-        assert a.release(2.0) is False  # A is not the holder any more
+        assert b.acquire(5.0) is True  # granted: the lock was revoked
+        assert a.release(5.0) is False  # A is not the holder any more
         a.close()
         b.close()
 
@@ -538,14 +538,14 @@ class TestNativeMutex:
         history = []
         inv_a = Op.invoke(OpF.ACQUIRE, 0)
         history.append(inv_a)
-        assert a.acquire(2.0) is True
+        assert a.acquire(5.0) is True
         history.append(inv_a.complete(OpType.OK))
         # network blip: A's client survives but its connection does not —
         # the broker requeues the token; A still believes it holds the lock
         a.reconnect()
         inv_b = Op.invoke(OpF.ACQUIRE, 1)
         history.append(inv_b)
-        assert b.acquire(2.0) is True
+        assert b.acquire(5.0) is True
         history.append(inv_b.complete(OpType.OK))
         a.close()
         b.close()

@@ -33,6 +33,7 @@ _LAZY = {
     "Perf": "perf",
     "perf_tensor_check": "perf",
     "QueueWgl": "wgl",
+    "FifoWgl": "wgl",
     "MutexWgl": "wgl",
     "check_wgl_cpu": "wgl",
     "wgl_tensor_check": "wgl",

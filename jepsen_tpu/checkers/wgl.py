@@ -61,7 +61,13 @@ import numpy as np
 
 from jepsen_tpu.checkers.protocol import UNKNOWN, VALID, Checker
 from jepsen_tpu.history.ops import Op, OpF, OpType
-from jepsen_tpu.models.core import Call, Model, OwnedMutex, UnorderedQueue
+from jepsen_tpu.models.core import (
+    Call,
+    FifoQueue,
+    Model,
+    OwnedMutex,
+    UnorderedQueue,
+)
 
 INF = 2**31 - 1
 
@@ -467,6 +473,28 @@ class QueueWgl(_WglChecker):
             1, math.ceil((max((o.call.a0 for o in ops), default=0) + 1) / 32)
         )
         return ops, (UnorderedQueue, (value_space,))
+
+
+class FifoWgl(_WglChecker):
+    """Knossos-style ``checker/queue`` against the *ordered* FIFO model.
+
+    Capacity is auto-sized to the history's enqueue count — the model's
+    bounded-queue capacity can never bind, so this checks an effectively
+    unbounded FIFO (the analog of ``QueueWgl`` auto-sizing
+    ``value_space``).  To check *bounded*-queue semantics (RabbitMQ
+    ``x-max-length`` + ``x-overflow=reject-publish``), drive the engine
+    directly with a fixed ``(FifoQueue, (capacity,))`` model key — there
+    the capacity is part of the sequential spec, and refutations against
+    it are genuine."""
+
+    name = "fifo-wgl"
+
+    def _ops_and_model(self, history):
+        ops = queue_wgl_ops(history)
+        n_enq = sum(
+            1 for o in ops if o.call.f == FifoQueue.ENQUEUE
+        )
+        return ops, (FifoQueue, (max(1, n_enq),))
 
 
 class MutexWgl(_WglChecker):

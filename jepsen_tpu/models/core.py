@@ -214,20 +214,53 @@ class OwnedMutex(Model):
 
 
 class FifoQueue(Model):
-    """Ordered FIFO queue (CPU engine only: sequence state doesn't fit the
-    fixed-width tensor encoding; the quorum-queue tests use the unordered
-    model anyway, matching the reference)."""
+    """Ordered FIFO queue.  Tensor state is a canonical ring of the
+    pending values — head pinned at slot 0, each value stored as
+    ``v + 1`` so empty slots are zeros (the all-zero initial state IS the
+    empty queue, and the frontier dedup's raw-word comparison sees one
+    canonical encoding per queue) — plus a count word.
+
+    ``capacity`` is part of the sequential spec in BOTH engines: enqueue
+    on a full queue is illegal, i.e. this is a *bounded* queue (RabbitMQ
+    ``x-max-length`` + ``x-overflow=reject-publish`` semantics).  To
+    check an effectively *unbounded* FIFO, use
+    :class:`jepsen_tpu.checkers.wgl.FifoWgl`, which auto-sizes the
+    capacity from the history so the bound can never bind — an
+    undersized hand-picked capacity would otherwise refute histories
+    that a real unbounded queue allows."""
 
     name = "fifo-queue"
     ENQUEUE, DEQUEUE = 0, 1
-    state_words = 0
+
+    def __init__(self, capacity: int = 1024):
+        self.capacity = capacity
+        self.state_words = capacity + 1
 
     def initial(self):
         return ()
 
     def step(self, state, call):
         if call.f == self.ENQUEUE:
+            if len(state) >= self.capacity:
+                return state, False
             return state + (call.a0,), True
         if state and state[0] == call.a0:
             return state[1:], True
         return state, False
+
+    def tensor_step(self, state, f, a0, a1):
+        C = self.capacity
+        ring, count = state[:C], state[C]
+        v = (a0 + 1).astype(jnp.uint32)
+        is_enq = f == self.ENQUEUE
+        legal_enq = count < C
+        legal_deq = (count > 0) & (ring[0] == v)
+        # enqueue appends at the tail slot; dequeue shifts the ring left
+        # (head stays at slot 0) and the wrapped-around old head is zeroed
+        enq_ring = ring.at[jnp.clip(count, 0, C - 1)].set(v)
+        deq_ring = jnp.roll(ring, -1).at[C - 1].set(jnp.uint32(0))
+        legal = jnp.where(is_enq, legal_enq, legal_deq)
+        new_ring = jnp.where(is_enq, enq_ring, deq_ring)
+        new_count = jnp.where(is_enq, count + 1, count - 1)
+        new_state = jnp.concatenate([new_ring, new_count[None]])
+        return jnp.where(legal, new_state, state), legal

@@ -278,3 +278,95 @@ def test_capped_search_reports_unknown_not_invalid():
     assert merge_valid([True, "unknown", True]) == "unknown"
     assert merge_valid([True, "unknown", False]) is False
     assert merge_valid([True, True]) is True
+
+
+def test_fifo_queue_tensor_matches_cpu():
+    """FIFO model: ordered dequeue enforced by both engines (the tensor
+    ring encoding is canonical — head at slot 0, empty slots zero)."""
+    from jepsen_tpu.models.core import FifoQueue
+
+    F_ENQ, F_DEQ = FifoQueue.ENQUEUE, FifoQueue.DEQUEUE
+    cases = [
+        # in-order: enq 1, enq 2, deq 1, deq 2 — linearizable
+        [WglOp(Call(F_ENQ, 1), 0, 1), WglOp(Call(F_ENQ, 2), 2, 3),
+         WglOp(Call(F_DEQ, 1), 4, 5), WglOp(Call(F_DEQ, 2), 6, 7)],
+        # out-of-order dequeue with sequential intervals — NOT fifo
+        [WglOp(Call(F_ENQ, 1), 0, 1), WglOp(Call(F_ENQ, 2), 2, 3),
+         WglOp(Call(F_DEQ, 2), 4, 5), WglOp(Call(F_DEQ, 1), 6, 7)],
+        # concurrent enqueues: either order works, deq 2 then deq 1 ok
+        [WglOp(Call(F_ENQ, 1), 0, 3), WglOp(Call(F_ENQ, 2), 0, 3),
+         WglOp(Call(F_DEQ, 2), 4, 5), WglOp(Call(F_DEQ, 1), 6, 7)],
+        # dequeue of a value never enqueued
+        [WglOp(Call(F_ENQ, 1), 0, 1), WglOp(Call(F_DEQ, 9), 2, 3)],
+    ]
+    expected = [True, False, True, False]
+    batch = pack_wgl_batch(cases)
+    ok, unknown = wgl_tensor_check(batch, (FifoQueue, (8,)))
+    assert not unknown.any()
+    for i, ops in enumerate(cases):
+        cpu = check_wgl_cpu(ops, FifoQueue(8))["valid?"]
+        assert cpu is expected[i], (i, cpu)
+        assert bool(ok[i]) == cpu, (i, bool(ok[i]), cpu)
+
+
+def test_fifo_vs_unordered_divergence():
+    """The one history family where the models must disagree: unordered
+    admits out-of-order dequeues, FIFO refutes them."""
+    from jepsen_tpu.models.core import FifoQueue
+
+    ops = [
+        WglOp(Call(0, 1), 0, 1), WglOp(Call(0, 2), 2, 3),
+        WglOp(Call(1, 2), 4, 5), WglOp(Call(1, 1), 6, 7),
+    ]
+    assert both(ops)  # unordered-queue: fine
+    batch = pack_wgl_batch([ops])
+    ok, unknown = wgl_tensor_check(batch, (FifoQueue, (8,)))
+    assert not unknown[0] and not bool(ok[0])
+    assert not check_wgl_cpu(ops, FifoQueue(8))["valid?"]
+
+
+def test_fifo_capacity_bound_is_engine_equivalent():
+    """A fixed capacity is bounded-queue (reject-publish) SPEC, not a
+    resource cap: enqueue beyond it is illegal in BOTH engines, verdicts
+    stay equivalent, and the unbounded intent goes through FifoWgl's
+    auto-sizing instead."""
+    from jepsen_tpu.models.core import FifoQueue
+
+    ops = [WglOp(Call(0, v), 2 * v, 2 * v + 1) for v in range(4)]
+    assert check_wgl_cpu(ops, FifoQueue(2))["valid?"] is False
+    batch = pack_wgl_batch([ops])
+    ok, unknown = wgl_tensor_check(batch, (FifoQueue, (2,)))
+    assert not unknown[0] and not bool(ok[0])
+    # and with room, the same history is fine
+    assert check_wgl_cpu(ops, FifoQueue(8))["valid?"] is True
+    ok8, unknown8 = wgl_tensor_check(batch, (FifoQueue, (8,)))
+    assert not unknown8[0] and bool(ok8[0])
+
+
+def test_fifo_wgl_autosizes_capacity():
+    """FifoWgl sizes the model's capacity from the history, so deep
+    pending backlogs can never produce a bounded-queue refutation."""
+    from jepsen_tpu.checkers.wgl import FifoWgl
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    # 40 enqueues all pending, then in-order dequeues — far deeper than
+    # any plausible fixed default would allow
+    hist = []
+    for v in range(40):
+        inv = Op.invoke(OpF.ENQUEUE, 0, v)
+        hist.append(inv)
+        hist.append(inv.complete(OpType.OK))
+    for v in range(40):
+        inv = Op.invoke(OpF.DEQUEUE, 0)
+        hist.append(inv)
+        hist.append(inv.complete(OpType.OK, value=v))
+    h = reindex(hist)
+    for backend in ("cpu", "tpu"):
+        r = FifoWgl(backend=backend).check({}, h)
+        assert r["valid?"] is True, (backend, r)
+    # and a swapped dequeue pair is a genuine FIFO violation
+    bad = list(h)
+    iv1 = Op.invoke(OpF.DEQUEUE, 0)
+    bad[-3:] = [iv1, iv1.complete(OpType.OK, value=40)]  # value never enqueued
+    r = FifoWgl(backend="cpu").check({}, reindex(bad))
+    assert r["valid?"] is False

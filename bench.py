@@ -46,11 +46,14 @@ INIT_RETRY_SLEEP_S = 20.0
 
 def _init_backend_with_retry() -> str:
     """First device use under a deadline, retried a bounded number of
-    times.  Exits with a clear failure line if the backend never comes up
-    — never hangs the bench forever."""
+    times.  If the accelerator never comes up (e.g. the tunneled chip is
+    held by a dead session — observed to wedge for an hour+), fall back
+    to the CPU backend rather than exit: a loudly-labeled CPU measurement
+    is a worse number but a *present* artifact, where rc=1 erases the
+    round's headline entirely (the round-1 failure mode)."""
     import jax
 
-    from jepsen_tpu.utils.jaxenv import ensure_backend
+    from jepsen_tpu.utils.jaxenv import ensure_backend, virtual_cpu_devices
 
     last_err: Exception | None = None
     for attempt in range(1, INIT_ATTEMPTS + 1):
@@ -72,12 +75,21 @@ def _init_backend_with_retry() -> str:
             if attempt < INIT_ATTEMPTS:
                 time.sleep(INIT_RETRY_SLEEP_S)
     print(
-        f"# BENCH FAILED: JAX backend unavailable after {INIT_ATTEMPTS} "
-        f"attempts ({INIT_PROBE_DEADLINE_S:.0f}s probe deadline each): "
-        f"{type(last_err).__name__}: {last_err}",
+        f"# TPU UNAVAILABLE after {INIT_ATTEMPTS} attempts "
+        f"({INIT_PROBE_DEADLINE_S:.0f}s probe deadline each): "
+        f"{type(last_err).__name__}: {last_err}\n"
+        f"# FALLING BACK TO CPU — the headline below is a CPU-backend "
+        f"number, NOT the chip's (see the backend field)",
         file=sys.stderr,
     )
-    sys.exit(1)
+    # virtual_cpu_devices pins the platform AND clears an already-committed
+    # broken backend (a bare config pin is a no-op after first init — the
+    # probe can succeed and still leave device_put raising Unavailable)
+    virtual_cpu_devices(1)
+    import jax.numpy as jnp
+
+    jax.block_until_ready(jax.device_put(jnp.arange(8)) + 1)
+    return jax.default_backend()
 
 
 BLOCKS = 3
@@ -291,9 +303,25 @@ def _bench_elle(details: dict) -> None:
     }
 
 
+def _apply_cpu_scale() -> None:
+    """Shrink device batches for a CPU(-fallback) run: the contract is a
+    present, honest artifact within the driver's time budget — not a
+    TPU-sized batch ground through host XLA for ten minutes."""
+    global TILE, STREAM_BATCH, ELLE_BATCH
+    TILE = 2
+    STREAM_BATCH = 256
+    ELLE_BATCH = 512
+
+
 def main() -> None:
     backend = _init_backend_with_retry()
     print(f"# backend ready: {backend}", file=sys.stderr)
+    if backend != "tpu":
+        _apply_cpu_scale()
+        print(
+            f"# non-TPU backend: batches scaled down (tile={TILE})",
+            file=sys.stderr,
+        )
 
     details: dict = {"backend": backend}
     rate, cpu_rate = _bench_queue(details)
@@ -321,6 +349,7 @@ def main() -> None:
                 "value": round(rate, 1),
                 "unit": "histories/s",
                 "vs_baseline": round(rate / cpu_rate, 1),
+                "backend": backend,
             }
         )
     )

@@ -494,7 +494,11 @@ class FifoWgl(_WglChecker):
         n_enq = sum(
             1 for o in ops if o.call.f == FifoQueue.ENQUEUE
         )
-        return ops, (FifoQueue, (max(1, n_enq),))
+        # bucket to a multiple of 32 (like QueueWgl's value_space): the
+        # capacity feeds state_words, so a raw count would give every
+        # enqueue total its own XLA program (~20 s compile each)
+        capacity = 32 * max(1, math.ceil(n_enq / 32))
+        return ops, (FifoQueue, (capacity,))
 
 
 class MutexWgl(_WglChecker):

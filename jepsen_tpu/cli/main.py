@@ -337,6 +337,7 @@ def cmd_test(args) -> int:
         "time-before-partition": args.time_before_partition,
         "partition-duration": args.partition_duration,
         "network-partition": args.network_partition,
+        "nemesis": args.nemesis,
         "publish-confirm-timeout": args.publish_confirm_timeout / 1000.0,
         "recovery-sleep": args.recovery_sleep,
         "consumer-type": args.consumer_type,
@@ -592,6 +593,13 @@ def build_parser() -> argparse.ArgumentParser:
             "partition-majorities-ring",
             "partition-random-node",
         ),
+    )
+    t.add_argument(
+        "--nemesis",
+        default="partition",
+        choices=("partition", "kill-random-node", "pause-random-node"),
+        help="fault family: the reference's network partitions (shaped by "
+        "--network-partition), or process kill/pause of a random node",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"

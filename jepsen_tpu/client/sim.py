@@ -70,6 +70,7 @@ class SimCluster:
         self.clock = clock
         self.dlq: list[int] = []
         self.blocked: set[frozenset[str]] = set()  # undirected blocked links
+        self.down: set[str] = set()  # killed/paused nodes (no votes, no ops)
         self.drop_acked_every = drop_acked_every
         self.duplicate_every = duplicate_every
         self._acked = 0
@@ -94,19 +95,37 @@ class SimCluster:
     def heal(self) -> None:
         self.set_blocked(set())
 
+    # ---- process control (driven by the nemesis via SimProcs) -------------
+    def set_down(self, node: str) -> None:
+        with self.lock:
+            self.down.add(node)
+
+    def set_up(self, node: str) -> None:
+        with self.lock:
+            self.down.discard(node)
+
     def component_of(self, node: str) -> set[str]:
-        """Nodes reachable from ``node`` over unblocked links."""
+        """Nodes reachable from ``node`` over unblocked links; down nodes
+        neither relay nor vote."""
         seen = {node}
         frontier = [node]
         while frontier:
             a = frontier.pop()
             for b in self.nodes:
-                if b not in seen and frozenset((a, b)) not in self.blocked:
+                if (
+                    b not in seen
+                    and b not in self.down
+                    and frozenset((a, b)) not in self.blocked
+                ):
                     seen.add(b)
                     frontier.append(b)
         return seen
 
     def _has_majority(self, node: str) -> bool:
+        if node in self.down:
+            # the client's own node is dead — connection refused, a
+            # determinate failure (not a timeout)
+            raise ConnectionError(f"{node} is down")
         return len(self.component_of(node)) * 2 > len(self.nodes)
 
     # ---- queue ops --------------------------------------------------------

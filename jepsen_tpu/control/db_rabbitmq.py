@@ -246,3 +246,32 @@ class RabbitMQDB(DB):
             if len(parts) >= 2 and parts[-1].isdigit():
                 lengths[" ".join(parts[:-1])] = int(parts[-1])
         return lengths
+
+
+class RabbitMQProcs:
+    """Process-fault surface for a live cluster (:class:`~jepsen_tpu.control.net.Procs`):
+    SIGKILL/restart and SIGSTOP/SIGCONT of the broker's Erlang VM over
+    SSH — the mechanism behind the kill/pause nemeses.  A killed node's
+    durable Raft state survives under ``SERVER_DIR``; restart simply
+    boots the server again and the node rejoins its cluster.  Pause
+    freezes beam.smp in place (sockets held, zero progress) — the
+    failure-detector stress the ``net_ticktime``/aten knobs exist for."""
+
+    def __init__(self, transport: Transport, nodes: Sequence[str]):
+        self._controls = {n: Control(transport, n).su() for n in nodes}
+
+    def kill(self, node: str) -> None:
+        self._controls[node].exec(
+            shell="killall -q -9 beam.smp epmd || true"
+        )
+
+    def restart(self, node: str) -> None:
+        self._controls[node].exec(
+            shell=f"{SERVER_DIR}/sbin/rabbitmq-server -detached"
+        )
+
+    def pause(self, node: str) -> None:
+        self._controls[node].exec(shell="killall -q -STOP beam.smp || true")
+
+    def resume(self, node: str) -> None:
+        self._controls[node].exec(shell="killall -q -CONT beam.smp || true")

@@ -106,6 +106,72 @@ class PartitionNemesis:
         self.net.heal()
 
 
+class ProcessNemesis:
+    """Kill or pause a random node's DB process on ``start``; restart or
+    resume every victim on ``stop``.  Jepsen's classic process nemeses,
+    beyond the reference's partition-only set: a SIGKILLed node tests
+    durable-state recovery and Raft re-join, a SIGSTOPped one tests the
+    failure detector (the process holds its sockets but goes silent —
+    exactly what ``net_ticktime``/aten tuning is about)."""
+
+    def __init__(self, mode: str, procs, nodes: Sequence[str],
+                 seed: int | None = None):
+        if mode not in ("kill", "pause"):
+            raise ValueError(f"unknown process-nemesis mode {mode!r}")
+        self.mode = mode
+        self.procs = procs
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+        self.victims: list[str] = []
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            victim = self.rng.choice(self.nodes)
+            if victim not in self.victims:
+                (self.procs.kill if self.mode == "kill"
+                 else self.procs.pause)(victim)
+                self.victims.append(victim)
+            logger.info("nemesis: %s %s", self.mode, victim)
+            return op.complete(OpType.INFO, value=f"{self.mode} {victim}")
+        if op.f == OpF.STOP:
+            restored, self.victims = self.victims, []
+            for v in restored:
+                (self.procs.restart if self.mode == "kill"
+                 else self.procs.resume)(v)
+            logger.info("nemesis: restored %s", restored)
+            return op.complete(OpType.INFO, value=f"restored {restored}")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for v in self.victims:
+            (self.procs.restart if self.mode == "kill"
+             else self.procs.resume)(v)
+        self.victims = []
+
+
+NEMESES = ("partition", "kill-random-node", "pause-random-node")
+
+
+def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
+                 nodes: Sequence[str], seed: int | None = None):
+    """Build the nemesis the test opts select: ``partition`` (the
+    reference's four strategies via ``network-partition``), or the
+    process faults ``kill-random-node`` / ``pause-random-node``."""
+    kind = opts.get("nemesis", "partition")
+    if kind == "partition":
+        return PartitionNemesis(
+            opts["network-partition"], net, nodes, seed=seed
+        )
+    if kind == "kill-random-node":
+        return ProcessNemesis("kill", procs, nodes, seed=seed)
+    if kind == "pause-random-node":
+        return ProcessNemesis("pause", procs, nodes, seed=seed)
+    raise ValueError(f"unknown nemesis {kind!r}; one of {NEMESES}")
+
+
 class NoopNemesis:
     def setup(self, test):
         pass

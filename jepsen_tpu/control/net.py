@@ -71,6 +71,49 @@ class IptablesNet(Net):
             c.exec("iptables", "-X", "-w", check=False)
 
 
+class Procs(abc.ABC):
+    """Process-level fault surface: where :class:`Net` acts on links,
+    this acts on the DB process itself (the mechanism behind jepsen's
+    kill/pause nemeses — beyond the reference's partition-only set)."""
+
+    @abc.abstractmethod
+    def kill(self, node: str) -> None:
+        """SIGKILL the DB process (durable state survives; Raft rejoins
+        on restart)."""
+
+    @abc.abstractmethod
+    def restart(self, node: str) -> None:
+        """Start a killed DB process."""
+
+    @abc.abstractmethod
+    def pause(self, node: str) -> None:
+        """SIGSTOP the DB process (it holds state and sockets but stops
+        responding — a 'slow node', nastier than a clean death for
+        failure detectors)."""
+
+    @abc.abstractmethod
+    def resume(self, node: str) -> None:
+        """SIGCONT a paused DB process."""
+
+
+class SimProcs(Procs):
+    """Drives the simulator's down-node set.  Kill and pause coincide in
+    the sim (a down node is simply unreachable and votes in no quorum;
+    durable state is cluster-global, so both come back intact)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def kill(self, node):
+        self.cluster.set_down(node)
+
+    def restart(self, node):
+        self.cluster.set_up(node)
+
+    pause = kill
+    resume = restart
+
+
 def complete_grudges(groups: Sequence[Iterable[str]]) -> dict[str, set[str]]:
     """Block every cross-group link (jepsen ``complete-grudge``)."""
     groups = [list(g) for g in groups]

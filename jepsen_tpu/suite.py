@@ -26,8 +26,8 @@ from jepsen_tpu.checkers.queue_lin import QueueLinearizability
 from jepsen_tpu.checkers.total_queue import TotalQueue
 from jepsen_tpu.client.protocol import QueueClient
 from jepsen_tpu.client.sim import SimCluster, sim_driver_factory
-from jepsen_tpu.control.net import SimNet
-from jepsen_tpu.control.nemesis import PartitionNemesis
+from jepsen_tpu.control.net import SimNet, SimProcs
+from jepsen_tpu.control.nemesis import make_nemesis
 from jepsen_tpu.control.runner import DB, Test
 from jepsen_tpu.generators.core import (
     Clients,
@@ -59,6 +59,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "time-before-partition": 10.0,
     "partition-duration": 10.0,
     "network-partition": "partition-random-halves",
+    "nemesis": "partition",  # or kill-random-node / pause-random-node
     "publish-confirm-timeout": 5.0,  # seconds (5000 ms in the reference)
     "recovery-sleep": 20.0,  # gen/sleep 20 before drain
     "consumer-type": "polling",
@@ -252,8 +253,8 @@ def build_sim_test(
         dead_letter=bool(o.get("dead-letter")),
         message_ttl_s=o.get("message-ttl", 1.0),
     )
-    nemesis = PartitionNemesis(
-        o["network-partition"], SimNet(cluster), nodes, seed=sim_seed
+    nemesis = make_nemesis(
+        o, SimNet(cluster), SimProcs(cluster), nodes, seed=sim_seed
     )
     if workload == "stream":
         client = StreamClient(
@@ -326,7 +327,7 @@ def build_rabbitmq_test(
         native_txn_driver_factory,
     )
     from jepsen_tpu.client.protocol import StreamClient, TxnClient
-    from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
+    from jepsen_tpu.control.db_rabbitmq import RabbitMQDB, RabbitMQProcs
     from jepsen_tpu.control.net import IptablesNet
     from jepsen_tpu.control.ssh import SshTransport
 
@@ -335,8 +336,11 @@ def build_rabbitmq_test(
         user=ssh_user, private_key=ssh_private_key
     )
     db = RabbitMQDB(transport, nodes)
-    nemesis = PartitionNemesis(
-        o["network-partition"], IptablesNet(transport, nodes), nodes
+    nemesis = make_nemesis(
+        o,
+        IptablesNet(transport, nodes),
+        RabbitMQProcs(transport, nodes),
+        nodes,
     )
     if workload == "stream":
         client = StreamClient(

@@ -1404,6 +1404,19 @@ class LockClient {
       tag = tag_;
     }
     if (c->basic_reject_requeue(tag)) {
+      // reject carries no *-ok, so without a barrier a contender's
+      // immediately-following basic.get can race the requeue and see an
+      // empty queue.  A cheap RPC behind it (idempotent re-declare) rides
+      // the channel's in-order processing: once it answers, the reject
+      // was processed and the token is back.  If the barrier fails the
+      // connection broke after the reject was sent — the token returns
+      // either way (processed reject, or requeue when the broker reaps
+      // the connection), so the release still happened.
+      amqp::Table args;
+      args.put_str("x-queue-type", "quorum");
+      if (cfg_.quorum_group_size > 0)
+        args.put_int("x-quorum-initial-group-size", cfg_.quorum_group_size);
+      c->declare_queue(LOCK_QUEUE_NAME, args);
       std::lock_guard<std::mutex> lk(mu_);
       holding_ = false;
       return 1;

@@ -265,3 +265,86 @@ def test_build_rabbitmq_test_mutex_constructs():
     )
     assert isinstance(test.client, MutexClient)
     assert test.name == "rabbitmq-mutex"
+
+
+def test_rabbitmq_procs_command_stream():
+    """Process-fault surface over SSH: kill/restart/pause/resume issue the
+    expected commands on the right node."""
+    from jepsen_tpu.control.db_rabbitmq import RabbitMQProcs
+
+    t = FakeTransport()
+    procs = RabbitMQProcs(t, NODES)
+    procs.kill("n2")
+    procs.restart("n2")
+    procs.pause("n1")
+    procs.resume("n1")
+    cmds = [(n, c) for n, c in t.log]
+    assert any(n == "n2" and "killall -q -9 beam.smp" in c for n, c in cmds)
+    assert any(
+        n == "n2" and "rabbitmq-server -detached" in c for n, c in cmds
+    )
+    assert any(n == "n1" and "killall -q -STOP beam.smp" in c for n, c in cmds)
+    assert any(n == "n1" and "killall -q -CONT beam.smp" in c for n, c in cmds)
+
+
+def test_process_nemesis_start_stop_cycle():
+    """ProcessNemesis: start picks one victim, stop restores every victim;
+    teardown restores leftovers."""
+    from jepsen_tpu.control.nemesis import ProcessNemesis
+    from jepsen_tpu.history.ops import Op, OpF
+
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def kill(self, n):
+            self.calls.append(("kill", n))
+
+        def restart(self, n):
+            self.calls.append(("restart", n))
+
+        def pause(self, n):
+            self.calls.append(("pause", n))
+
+        def resume(self, n):
+            self.calls.append(("resume", n))
+
+    procs = Log()
+    nem = ProcessNemesis("kill", procs, NODES, seed=3)
+    start = Op.invoke(OpF.START, -1)
+    stop = Op.invoke(OpF.STOP, -1)
+    r = nem.invoke({}, start)
+    assert r.value.startswith("kill ")
+    victim = r.value.split()[1]
+    assert procs.calls == [("kill", victim)]
+    nem.invoke({}, stop)
+    assert procs.calls[-1] == ("restart", victim)
+    # teardown restores a victim left behind by an aborted run
+    nem.invoke({}, start)
+    nem.teardown({})
+    assert procs.calls[-1][0] == "restart" and not nem.victims
+
+
+def test_make_nemesis_selection():
+    from jepsen_tpu.control.nemesis import (
+        PartitionNemesis,
+        ProcessNemesis,
+        make_nemesis,
+    )
+    from jepsen_tpu.control.net import SimProcs
+
+    net = IptablesNet(FakeTransport(), NODES)
+    assert isinstance(
+        make_nemesis(
+            {"nemesis": "partition",
+             "network-partition": "partition-halves"},
+            net, None, NODES,
+        ),
+        PartitionNemesis,
+    )
+    nem = make_nemesis(
+        {"nemesis": "pause-random-node"}, net, SimProcs(None), NODES
+    )
+    assert isinstance(nem, ProcessNemesis) and nem.mode == "pause"
+    with pytest.raises(ValueError):
+        make_nemesis({"nemesis": "meteor-strike"}, net, None, NODES)

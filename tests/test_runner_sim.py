@@ -285,3 +285,45 @@ def test_mutex_double_grant_detected(tmp_path):
     run = run_test(test)
     assert not run.results["mutex"]["valid?"]
     assert not run.results["mutex"]["unknown"]  # a definite violation
+
+
+@pytest.mark.parametrize("kind", ["kill-random-node", "pause-random-node"])
+def test_process_nemesis_run_clean(tmp_path, kind):
+    """Kill/pause of a random node mid-run (beyond the reference's
+    partition-only set): the cluster loses a voter, ops on the dead node
+    fail cleanly, the stop restores it, and the verdict stays valid."""
+    test, cluster = build_sim_test(
+        opts={**FAST_OPTS, "nemesis": kind},
+        store_root=str(tmp_path / "store"),
+    )
+    run = run_test(test)
+    assert run.valid, run.results
+    assert cluster.queue_length() == 0
+    assert not cluster.down  # every victim restored
+    # the nemesis actually did something
+    infos = [
+        op for op in run.history
+        if op.f == OpF.START and op.type == OpType.INFO
+    ]
+    assert infos and any(
+        str(op.value).startswith(("kill ", "pause ")) for op in infos
+    )
+
+
+def test_sim_down_node_semantics():
+    """Down nodes neither vote nor serve: killing a majority stalls
+    commits (timeouts), killing a minority does not."""
+    from jepsen_tpu.client.protocol import DriverTimeout
+    from jepsen_tpu.client.sim import SimCluster
+
+    c = SimCluster(["n1", "n2", "n3"])
+    c.set_down("n3")
+    assert c.publish("n1", 1) is True  # 2/3 still a majority
+    with pytest.raises(ConnectionError):
+        c.publish("n3", 2)  # the down node itself refuses
+    c.set_down("n2")
+    with pytest.raises(DriverTimeout):
+        c.publish("n1", 3)  # 1/3 is a minority now
+    c.set_up("n2")
+    c.set_up("n3")
+    assert c.publish("n3", 4) is True

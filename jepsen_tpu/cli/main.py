@@ -382,9 +382,14 @@ def cmd_matrix(args) -> int:
         # any external driver consume the matrix from this single source
         # of truth instead of duplicating it.  Introspection only: no
         # logging setup, no runner/suite (and hence no JAX) imports.
-        from jepsen_tpu.harness.matrix import matrix_cli_flags
+        from jepsen_tpu.harness.matrix import (
+            CI_MATRIX,
+            EXTENDED_MATRIX,
+            matrix_cli_flags,
+        )
 
-        for line in matrix_cli_flags():
+        rows = CI_MATRIX + (EXTENDED_MATRIX if args.extended else [])
+        for line in matrix_cli_flags(rows):
             print(line)
         return 0
 
@@ -392,7 +397,11 @@ def cmd_matrix(args) -> int:
 
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
     from jepsen_tpu.control.runner import run_test
-    from jepsen_tpu.harness.matrix import CI_MATRIX, MatrixRunner
+    from jepsen_tpu.harness.matrix import (
+        CI_MATRIX,
+        EXTENDED_MATRIX,
+        MatrixRunner,
+    )
     from jepsen_tpu.suite import (
         DEFAULT_OPTS,
         build_rabbitmq_test,
@@ -441,14 +450,21 @@ def cmd_matrix(args) -> int:
         run = run_test(test)
         return run.results, {"jepsen.queue": cluster.queue_length()}
 
-    matrix = CI_MATRIX[: args.limit] if args.limit else CI_MATRIX
+    matrix = CI_MATRIX + (EXTENDED_MATRIX if args.extended else [])
+    if args.limit:
+        matrix = matrix[: args.limit]
     outcomes = MatrixRunner(run_fn, matrix).run()
     summary = [
         {
             "config": o.config_index + 1,
             "status": o.status,
             "attempts": o.attempts,
-            "partition": o.opts.get("network-partition"),
+            "nemesis": o.opts.get("nemesis", "partition"),
+            "partition": (
+                o.opts.get("network-partition")
+                if o.opts.get("nemesis", "partition") == "partition"
+                else None
+            ),
             "notes": o.notes,
         }
         for o in outcomes
@@ -623,7 +639,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.set_defaults(fn=cmd_test)
 
     m = sub.add_parser(
-        "matrix", help="run the 14-config CI test matrix (sim or rabbitmq)"
+        "matrix",
+        help="run the CI test matrix (the reference's 14 configs; 18 with "
+        "--extended) against sim or rabbitmq",
     )
     m.add_argument("--limit", type=int, default=0, help="first N configs only")
     m.add_argument(
@@ -644,6 +662,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--print-configs",
         action="store_true",
         help="print each matrix config as `test` CLI flags and exit",
+    )
+    m.add_argument(
+        "--extended",
+        action="store_true",
+        help="append the extended configs (process-fault nemeses) to the "
+        "reference's 14",
     )
     m.set_defaults(fn=cmd_matrix)
 

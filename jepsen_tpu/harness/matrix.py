@@ -77,6 +77,35 @@ CI_MATRIX: list[dict[str, Any]] = [
     _cfg(partition="partition-halves", duration=30.0, **{"dead-letter": True}),
 ]
 
+#: extended configs beyond the reference's matrix: the process-fault
+#: nemeses (kill = durable-state recovery + Raft rejoin; pause = a silent
+#: node, the failure-detector stress).  Opt-in via ``matrix --extended``
+#: so the default stays reference-parity.
+EXTENDED_MATRIX: list[dict[str, Any]] = [
+    _cfg(
+        partition="partition-random-halves",
+        duration=30.0,
+        nemesis="kill-random-node",
+    ),
+    _cfg(
+        partition="partition-random-halves",
+        duration=10.0,
+        nemesis="pause-random-node",
+    ),
+    _cfg(
+        partition="partition-random-node",
+        duration=30.0,
+        nemesis="kill-random-node",
+        **{"consumer-type": "asynchronous"},
+    ),
+    _cfg(
+        partition="partition-random-node",
+        duration=10.0,
+        nemesis="pause-random-node",
+        **{"dead-letter": True},
+    ),
+]
+
 
 def matrix_opts(cfg: Mapping[str, Any]) -> dict[str, Any]:
     """Translate a matrix row into test opts."""

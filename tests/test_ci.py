@@ -137,6 +137,26 @@ class TestMatrixCliParity:
 
         assert sum("--dead-letter" in l for l in matrix_cli_flags()) == 2
 
+    def test_extended_configs_parse_and_stay_opt_in(self):
+        """The extended (process-fault) rows parse through the test
+        parser like every reference row, and never leak into the default
+        14 (reference parity)."""
+        from jepsen_tpu.cli.main import build_parser
+        from jepsen_tpu.harness.matrix import (
+            CI_MATRIX,
+            EXTENDED_MATRIX,
+            matrix_cli_flags,
+        )
+
+        assert len(CI_MATRIX) == 14 and len(EXTENDED_MATRIX) == 4
+        assert not any("--nemesis" in l for l in matrix_cli_flags())
+        parser = build_parser()
+        for cfg, line in zip(
+            EXTENDED_MATRIX, matrix_cli_flags(EXTENDED_MATRIX)
+        ):
+            ns = parser.parse_args(["test", *line.split()])
+            assert ns.nemesis == cfg["nemesis"]
+
 
 class TestCiDriverShell:
     def test_driver_is_syntactically_valid(self):

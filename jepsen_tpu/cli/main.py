@@ -460,11 +460,7 @@ def cmd_matrix(args) -> int:
             "status": o.status,
             "attempts": o.attempts,
             "nemesis": o.opts.get("nemesis", "partition"),
-            "partition": (
-                o.opts.get("network-partition")
-                if o.opts.get("nemesis", "partition") == "partition"
-                else None
-            ),
+            "partition": o.opts.get("network-partition"),
             "notes": o.notes,
         }
         for o in outcomes

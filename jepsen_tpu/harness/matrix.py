@@ -82,24 +82,14 @@ CI_MATRIX: list[dict[str, Any]] = [
 #: node, the failure-detector stress).  Opt-in via ``matrix --extended``
 #: so the default stays reference-parity.
 EXTENDED_MATRIX: list[dict[str, Any]] = [
+    _cfg(duration=30.0, nemesis="kill-random-node"),
+    _cfg(duration=10.0, nemesis="pause-random-node"),
     _cfg(
-        partition="partition-random-halves",
-        duration=30.0,
-        nemesis="kill-random-node",
-    ),
-    _cfg(
-        partition="partition-random-halves",
-        duration=10.0,
-        nemesis="pause-random-node",
-    ),
-    _cfg(
-        partition="partition-random-node",
         duration=30.0,
         nemesis="kill-random-node",
         **{"consumer-type": "asynchronous"},
     ),
     _cfg(
-        partition="partition-random-node",
         duration=10.0,
         nemesis="pause-random-node",
         **{"dead-letter": True},
@@ -108,9 +98,11 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
 
 
 def matrix_opts(cfg: Mapping[str, Any]) -> dict[str, Any]:
-    """Translate a matrix row into test opts."""
+    """Translate a matrix row into test opts.  Process-fault rows carry no
+    partition strategy (their nemesis never reads one)."""
     o = dict(cfg)
-    o["network-partition"] = o.pop("partition")
+    if "partition" in o:
+        o["network-partition"] = o.pop("partition")
     o["partition-duration"] = o.pop("duration")
     return o
 

@@ -123,12 +123,12 @@ class _Message:
 class _ConnState:
     sock: socket.socket
     lock: threading.Lock = field(default_factory=threading.Lock)
-    publish_seq: int = 0
+    publish_seq: dict = field(default_factory=dict)  # channel -> seq
     next_tag: int = 1
     unacked: dict = field(default_factory=dict)  # tag -> (queue, _Message)
     consuming_queue: str | None = None
     consuming_noack: bool = False
-    confirms: bool = False
+    confirm_channels: set = field(default_factory=set)
     tx_mode: bool = False  # tx.select seen: publishes buffer until commit
     tx_buffer: list = field(default_factory=list)  # [(queue, body), ...]
     open: bool = True
@@ -268,9 +268,10 @@ class MiniAmqpBroker:
             self._expect(sock, 10, 40)  # Open
             self._send_method(conn, 0, 10, 41, _shortstr(""))  # Open-Ok
 
-            pending_publish_queue = None
-            pending_body = b""
-            pending_size = 0
+            # in-flight publish content, keyed by channel: method, header,
+            # and body frames of one publish share a channel, and two
+            # channels may interleave their publishes on one connection
+            pending: dict = {}  # ch -> [queue, size, body]
 
             while conn.open:
                 ftype, ch, payload = self._read_frame(sock)
@@ -281,22 +282,21 @@ class MiniAmqpBroker:
                     r = _Reader(payload)
                     r.u16()
                     r.u16()
-                    pending_size = r.u64()
-                    pending_body = b""
-                    if pending_size == 0 and pending_publish_queue:
-                        self._finish_publish(conn, pending_publish_queue, b"")
-                        pending_publish_queue = None
+                    p = pending.get(ch)
+                    if p is not None:
+                        p[1] = r.u64()
+                        p[2] = b""
+                        if p[1] == 0:
+                            self._finish_publish(conn, ch, p[0], b"")
+                            del pending[ch]
                     continue
                 if ftype == FRAME_BODY:
-                    pending_body += payload
-                    if (
-                        len(pending_body) >= pending_size
-                        and pending_publish_queue is not None
-                    ):
-                        self._finish_publish(
-                            conn, pending_publish_queue, pending_body
-                        )
-                        pending_publish_queue = None
+                    p = pending.get(ch)
+                    if p is not None:
+                        p[2] += payload
+                        if len(p[2]) >= p[1]:
+                            self._finish_publish(conn, ch, p[0], p[2])
+                            del pending[ch]
                     continue
                 r = _Reader(payload)
                 cls, mth = r.u16(), r.u16()
@@ -333,7 +333,7 @@ class MiniAmqpBroker:
                         self.queues[qname] = deque()
                     self._send_method(conn, ch, 50, 31, struct.pack(">I", n))
                 elif cls == 85 and mth == 10:  # Confirm.Select
-                    conn.confirms = True
+                    conn.confirm_channels.add(ch)  # per-channel (spec)
                     self._send_method(conn, ch, 85, 11)
                 elif cls == 60 and mth == 10:  # Basic.Qos
                     self._send_method(conn, ch, 60, 11)
@@ -341,7 +341,7 @@ class MiniAmqpBroker:
                     r.u16()
                     r.shortstr()  # exchange
                     routing_key = r.shortstr()
-                    pending_publish_queue = routing_key
+                    pending[ch] = [routing_key, 0, b""]
                 elif cls == 60 and mth == 70:  # Basic.Get
                     r.u16()
                     qname = r.shortstr()
@@ -425,7 +425,9 @@ class MiniAmqpBroker:
                 return payload
             raise ConnectionError(f"expected {cls}.{mth}, got {c}.{m}")
 
-    def _finish_publish(self, conn: _ConnState, queue: str, body: bytes):
+    def _finish_publish(
+        self, conn: _ConnState, ch: int, queue: str, body: bytes
+    ):
         if conn.tx_mode:
             # tx publishes stay invisible until tx.commit (no confirms in
             # tx mode — the commit-ok is the acknowledgement) ... unless
@@ -438,12 +440,13 @@ class MiniAmqpBroker:
             else:
                 conn.tx_buffer.append((queue, body))
             return
-        conn.publish_seq += 1
+        seq = conn.publish_seq.get(ch, 0) + 1
+        conn.publish_seq[ch] = seq
         self._apply_publish(queue, body)
-        if conn.confirms and not self.drop_confirms:
-            self._send_method(
-                conn, 1, 60, 80, struct.pack(">QB", conn.publish_seq, 0)
-            )
+        # confirm mode and delivery-tag sequence are per channel, and the
+        # ack rides the publishing channel (AMQP 0-9-1 confirm semantics)
+        if ch in conn.confirm_channels and not self.drop_confirms:
+            self._send_method(conn, ch, 60, 80, struct.pack(">QB", seq, 0))
         self._deliver_all()
 
     def _expire_locked(self, qname: str) -> None:

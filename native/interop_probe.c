@@ -8,7 +8,10 @@
  * system's independently-authored AMQP 0-9-1 client), exercising the same
  * wire surface the C++ driver uses — handshake, queue.declare,
  * confirm.select, basic.publish + publisher confirm, basic.get,
- * basic.consume/deliver, tx.select/commit/rollback.  A shared misreading
+ * basic.consume/deliver, tx.select/commit/rollback, and the stream
+ * subset (x-queue-type=stream declare args, x-stream-offset consume arg,
+ * per-delivery offset headers — the custom table grammar both in-tree
+ * implementations must agree on with a third party).  A shared misreading
  * of the AMQP spec between the in-tree C++ codec (amqp_wire.hpp) and the
  * in-tree mini broker cannot survive this probe: rabbitmq-c would refuse
  * the frames.
@@ -60,8 +63,46 @@ typedef struct {
 
 typedef struct {
   int num_entries;
-  void *entries;
+  void *entries; /* amqp_table_entry_t[], declared below */
 } amqp_table_t;
+
+typedef struct {
+  uint8_t decimals;
+  uint32_t value;
+} amqp_decimal_t;
+
+struct amqp_field_value_t_;
+
+typedef struct {
+  int num_entries;
+  struct amqp_field_value_t_ *entries;
+} amqp_array_t;
+
+typedef struct amqp_field_value_t_ {
+  uint8_t kind; /* 'S' utf8 longstr, 'l' int64, ... (rabbitmq-c amqp.h) */
+  union {
+    amqp_boolean_t boolean;
+    int8_t i8;
+    uint8_t u8;
+    int16_t i16;
+    uint16_t u16;
+    int32_t i32;
+    uint32_t u32;
+    int64_t i64;
+    uint64_t u64;
+    float f32;
+    double f64;
+    amqp_decimal_t decimal;
+    amqp_bytes_t bytes;
+    amqp_table_t table;
+    amqp_array_t array;
+  } value;
+} amqp_field_value_t;
+
+typedef struct {
+  amqp_bytes_t key;
+  amqp_field_value_t value;
+} amqp_table_entry_t;
 
 typedef struct {
   int num_blocks;
@@ -112,6 +153,8 @@ typedef struct {
 } amqp_envelope_t;
 
 enum { AMQP_SASL_METHOD_PLAIN = 0 };
+
+#define AMQP_BASIC_HEADERS_FLAG (1 << 13)
 
 #define AMQP_BASIC_ACK_METHOD ((amqp_method_number_t)0x003C0050)
 #define AMQP_BASIC_GET_OK_METHOD ((amqp_method_number_t)0x003C0047)
@@ -190,30 +233,39 @@ static int body_int(amqp_bytes_t body) {
   return atoi(buf);
 }
 
-static int publish_one(amqp_connection_state_t c, const char *queue, int v,
-                       int want_confirm) {
+static int publish_one_ch(amqp_connection_state_t c, amqp_channel_t ch,
+                          const char *queue, int v, int want_confirm) {
   char buf[16];
   snprintf(buf, sizeof buf, "%d", v);
-  int rc = amqp_basic_publish(c, 1, amqp_cstring_bytes(""),
+  int rc = amqp_basic_publish(c, ch, amqp_cstring_bytes(""),
                               amqp_cstring_bytes(queue), 1, 0, NULL,
                               amqp_cstring_bytes(buf));
   if (rc != 0) return -1;
   if (want_confirm) {
     amqp_method_t m;
-    if (amqp_simple_wait_method(c, 1, AMQP_BASIC_ACK_METHOD, &m) != 0)
+    if (amqp_simple_wait_method(c, ch, AMQP_BASIC_ACK_METHOD, &m) != 0)
       return -2;
   }
   return 0;
 }
 
+static int publish_one(amqp_connection_state_t c, const char *queue, int v,
+                       int want_confirm) {
+  return publish_one_ch(c, 1, queue, v, want_confirm);
+}
+
 int main(int argc, char **argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: interop_probe HOST PORT [tx]\n");
+    fprintf(stderr, "usage: interop_probe HOST PORT [tx] [stream]\n");
     return 2;
   }
   const char *host = argv[1];
   int port = atoi(argv[2]);
-  int with_tx = argc > 3 && strcmp(argv[3], "tx") == 0;
+  int with_tx = 0, with_stream = 0;
+  for (int i = 3; i < argc; ++i) {
+    if (strcmp(argv[i], "tx") == 0) with_tx = 1;
+    if (strcmp(argv[i], "stream") == 0) with_stream = 1;
+  }
   const char *queue = "probe.queue";
 
   amqp_connection_state_t c = amqp_new_connection();
@@ -276,6 +328,69 @@ int main(int argc, char **argv) {
     amqp_destroy_envelope(&env);
   }
 
+  if (with_stream) {
+    /* stream subset on its own channel — confirm mode, the delivery-tag
+       sequence, and the ack channel are per-channel (spec), so a second
+       channel with its own confirm.select exercises exactly the paths a
+       channel-1-only probe would leave dead: x-queue-type table arg on
+       declare, confirmed publishes whose acks ride channel 2,
+       x-stream-offset table arg on consume, in-order replay from offset
+       0, offset headers parsed by rabbitmq-c's own table decoder */
+    const char *squeue = "probe.stream";
+    amqp_channel_open(c, 2);
+    CHECK_RPC(amqp_get_rpc_reply(c), "channel.open (2)");
+
+    amqp_table_entry_t decl_e[1];
+    decl_e[0].key = amqp_cstring_bytes("x-queue-type");
+    decl_e[0].value.kind = 'S';
+    decl_e[0].value.value.bytes = amqp_cstring_bytes("stream");
+    amqp_table_t decl_args = {1, decl_e};
+    amqp_queue_declare(c, 2, amqp_cstring_bytes(squeue), 0, 1, 0, 0,
+                       decl_args);
+    CHECK_RPC(amqp_get_rpc_reply(c), "stream queue.declare (table arg)");
+
+    amqp_confirm_select(c, 2);
+    CHECK_RPC(amqp_get_rpc_reply(c), "confirm.select (channel 2)");
+    for (int v = 0; v < N_MSGS; ++v)
+      CHECK(publish_one_ch(c, 2, squeue, v, 1) == 0,
+            "stream publish + channel-2 confirm");
+
+    amqp_table_entry_t cons_e[1];
+    cons_e[0].key = amqp_cstring_bytes("x-stream-offset");
+    cons_e[0].value.kind = 'l';
+    cons_e[0].value.value.i64 = 0;
+    amqp_table_t cons_args = {1, cons_e};
+    amqp_basic_consume(c, 2, amqp_cstring_bytes(squeue), amqp_empty_bytes,
+                       0, 1, 0, cons_args);
+    CHECK_RPC(amqp_get_rpc_reply(c),
+              "stream basic.consume (x-stream-offset arg)");
+
+    for (int i = 0; i < N_MSGS; ++i) {
+      amqp_envelope_t env;
+      struct timeval tv = {5, 0};
+      amqp_maybe_release_buffers(c);
+      r = amqp_consume_message(c, &env, &tv, 0);
+      CHECK_RPC(r, "stream consume (deliver + content)");
+      CHECK(body_int(env.message.body) == i,
+            "stream replay in append order from offset 0");
+      CHECK(env.message.properties._flags & AMQP_BASIC_HEADERS_FLAG,
+            "stream delivery carries a headers table");
+      amqp_table_t *h = &env.message.properties.headers;
+      amqp_table_entry_t *es = (amqp_table_entry_t *)h->entries;
+      int found = 0;
+      for (int k = 0; k < h->num_entries; ++k) {
+        if (es[k].key.len == 15 &&
+            memcmp(es[k].key.bytes, "x-stream-offset", 15) == 0) {
+          CHECK(es[k].value.kind == 'l', "offset header kind is int64");
+          CHECK(es[k].value.value.i64 == i, "offset header value");
+          found = 1;
+        }
+      }
+      CHECK(found, "x-stream-offset header present");
+      amqp_destroy_envelope(&env);
+    }
+  }
+
   if (with_tx) {
     /* tx class: committed publish is visible, rolled-back one is not */
     amqp_tx_select(c, 1);
@@ -297,8 +412,9 @@ int main(int argc, char **argv) {
   }
 
   printf("PROBE OK: handshake, declare, %d confirmed publishes, "
-         "%d gets, %d delivers%s\n",
-         2 * N_MSGS, N_MSGS, N_MSGS, with_tx ? ", tx" : "");
+         "%d gets, %d delivers%s%s\n",
+         (2 + with_stream) * N_MSGS, N_MSGS, N_MSGS,
+         with_tx ? ", tx" : "", with_stream ? ", stream" : "");
   amqp_destroy_connection(c);
   return 0;
 }

@@ -295,6 +295,20 @@ class TestInteropProbe:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "PROBE OK" in r.stdout
 
+    def test_rabbitmq_c_interop_tx_and_stream(self, probe, broker):
+        """The tx class and the stream subset (x-queue-type declare arg,
+        x-stream-offset consume arg, per-delivery offset headers — the
+        custom table grammar) conformance-checked through rabbitmq-c's
+        own serializer/parser."""
+        r = subprocess.run(
+            [str(probe), "127.0.0.1", str(broker.port), "tx", "stream"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "tx, stream" in r.stdout
+
 
 class TestNativeTxn:
     """Elle list-append over AMQP tx (BASELINE config #5 live path)."""

@@ -1,0 +1,121 @@
+"""Live (mid-run) anomaly monitor.
+
+The reference analyzes only after teardown (``checker/check`` at the end
+of ``jepsen.core/run!``, SURVEY.md §3.1) — a 180 s CI config that broke
+mutual delivery guarantees in its first seconds still runs to completion
+before anyone knows.  The history-as-pure-input design permits more:
+two of ``total-queue``'s classes are **monotone** — once observed they
+are definitive no matter what the rest of the run does:
+
+- ``unexpected`` — a delivered value whose enqueue was never even
+  *invoked*.  Invocations are recorded before the client call starts
+  (the recorder appends the INVOKE row first), so at the moment a read
+  completes, every enqueue that could explain it is already in the
+  attempt set; a miss can never be healed by later ops.
+- ``duplicated`` — a value delivered twice.  Later ops only add reads.
+
+``lost`` is the opposite: un-read values are merely *outstanding* until
+the final drain, so the live monitor never speculates about loss.  The
+full verdict therefore remains the post-hoc pure function of the
+recorded history — the monitor is an early-warning surface (the
+"surface races, don't hide them" philosophy of SURVEY.md §5 applied
+*during* the run), not a second checker.
+
+Wiring: :class:`LiveTotalQueue` implements the runner's observer hook
+(``observe(op)`` on every recorded op); ``test --live-check`` attaches
+one and reports its findings the moment they happen and again in the
+run summary.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable, Sequence
+
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+logger = logging.getLogger("jepsen_tpu.live")
+
+
+class LiveTotalQueue:
+    """Monotone-anomaly monitor for the quorum-queue workload.
+
+    Thread-safe (the recorder calls ``observe`` from every worker
+    thread).  ``on_anomaly(kind, value, op_index)`` fires at most once
+    per (kind, value) — ``kind`` is ``"unexpected"`` (a genuine
+    violation: ``total-queue`` invalidates on it) or ``"duplicated"``
+    (reported-but-legal at-least-once redelivery, same as the post-hoc
+    checker's classification)."""
+
+    name = "live-total-queue"
+
+    def __init__(
+        self, on_anomaly: Callable[[str, int, int], None] | None = None
+    ):
+        self._lock = threading.Lock()
+        self._attempted: set[int] = set()
+        self._read: set[int] = set()
+        self.duplicated: set[int] = set()
+        self.unexpected: set[int] = set()
+        self.events: list[dict[str, Any]] = []
+        self._on_anomaly = on_anomaly
+
+    # ---- runner observer hook --------------------------------------------
+    def observe(self, op: Op) -> None:
+        if op.f == OpF.ENQUEUE:
+            # the INVOKE alone makes a value explicable (its effect may
+            # exist no matter how the op completes)
+            if op.type == OpType.INVOKE and isinstance(op.value, int):
+                with self._lock:
+                    self._attempted.add(op.value)
+            return
+        if op.f not in (OpF.DEQUEUE, OpF.DRAIN) or op.type != OpType.OK:
+            return
+        values = op.value if isinstance(op.value, (list, tuple)) else [op.value]
+        fired: list[tuple[str, int]] = []
+        with self._lock:
+            for v in values:
+                if not isinstance(v, int):
+                    continue
+                if v not in self._attempted:
+                    # never-attempted values classify as unexpected only —
+                    # the post-hoc checker counts their every delivery
+                    # there, not under duplicated (total_queue.py: a == 0)
+                    if v not in self.unexpected:
+                        self.unexpected.add(v)
+                        fired.append(("unexpected", v))
+                elif v in self._read and v not in self.duplicated:
+                    self.duplicated.add(v)
+                    fired.append(("duplicated", v))
+                self._read.add(v)
+            for kind, v in fired:
+                self.events.append(
+                    {"kind": kind, "value": v, "op-index": op.index}
+                )
+        for kind, v in fired:
+            log = logger.error if kind == "unexpected" else logger.warning
+            log("LIVE ANOMALY: %s value %d (op %d)", kind, v, op.index)
+            if self._on_anomaly is not None:
+                self._on_anomaly(kind, v, op.index)
+
+    # ---- reporting --------------------------------------------------------
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "attempt-count": len(self._attempted),
+                "read-count": len(self._read),
+                "duplicated-count": len(self.duplicated),
+                "unexpected-count": len(self.unexpected),
+                # mirrors total-queue: only `unexpected` is disqualifying
+                # mid-run (`lost` is undecidable before the drain)
+                "violation-so-far": bool(self.unexpected),
+                "events": list(self.events),
+            }
+
+
+def attach_live_monitor(test, monitor=None) -> LiveTotalQueue:
+    """Append a live monitor to ``test.observers`` and return it."""
+    m = monitor or LiveTotalQueue()
+    test.observers.append(m)
+    return m

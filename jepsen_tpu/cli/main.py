@@ -371,7 +371,27 @@ def cmd_test(args) -> int:
             store_root=args.store,
             workload=args.workload,
         )
+    monitor = None
+    if args.live_check:
+        if args.workload == "queue":
+            from jepsen_tpu.checkers.live import attach_live_monitor
+
+            monitor = attach_live_monitor(test)
+        else:
+            print(
+                f"warning: --live-check covers the queue workload only; "
+                f"no monitor attached for {args.workload!r}",
+                file=sys.stderr,
+            )
     run = run_test(test)
+    if monitor is not None:
+        snap = monitor.snapshot()
+        print(
+            f"# live monitor: {snap['unexpected-count']} unexpected, "
+            f"{snap['duplicated-count']} duplicated "
+            f"(of {snap['read-count']} values read)",
+            file=sys.stderr,
+        )
     print(json.dumps(run.results, indent=1, default=_json_default))
     return _verdict_exit(run.verdict)
 
@@ -605,6 +625,14 @@ def build_parser() -> argparse.ArgumentParser:
             "partition-majorities-ring",
             "partition-random-node",
         ),
+    )
+    t.add_argument(
+        "--live-check",
+        action="store_true",
+        help="attach the mid-run anomaly monitor (queue workload only: "
+        "flags monotone total-queue anomalies — unexpected/duplicated "
+        "deliveries — the moment they are recorded, instead of only "
+        "post-hoc)",
     )
     t.add_argument(
         "--nemesis",

@@ -71,6 +71,10 @@ class Test:
     concurrency: int = 5
     store_root: str = "store"
     opts: dict[str, Any] = field(default_factory=dict)
+    #: live observers: each gets ``observe(op)`` for every recorded op
+    #: (invocations AND completions, in history order) — the hook behind
+    #: mid-run anomaly monitoring (checkers/live.py)
+    observers: list = field(default_factory=list)
 
     def as_map(self) -> dict[str, Any]:
         return {
@@ -99,18 +103,29 @@ class TestRun:
 
 
 class _Recorder:
-    """Appends ops to the history with sequential indices + timestamps."""
+    """Appends ops to the history with sequential indices + timestamps,
+    then notifies observers (in recording order; a failing observer is
+    logged and dropped rather than poisoning the run)."""
 
-    def __init__(self, start_ns: int):
+    def __init__(self, start_ns: int, observers: Sequence[Any] = ()):
         self.lock = threading.Lock()
         self.history: list[Op] = []
         self.start_ns = start_ns
+        self.observers = list(observers)
 
     def record(self, op: Op) -> Op:
         with self.lock:
             op.index = len(self.history)
             op.time = _time.monotonic_ns() - self.start_ns
             self.history.append(op)
+            for obs in list(self.observers):
+                try:
+                    obs.observe(op)
+                except Exception:  # noqa: BLE001 - observer must not kill runs
+                    logger.exception(
+                        "observer %r failed; detaching it", obs
+                    )
+                    self.observers.remove(obs)
         return op
 
 
@@ -276,7 +291,7 @@ def _run_test_logged(
     scheduler = Scheduler(
         test.generator, n_threads=test.concurrency, start_ns=start_ns
     )
-    recorder = _Recorder(start_ns)
+    recorder = _Recorder(start_ns, observers=test.observers)
     barrier = threading.Barrier(test.concurrency + 1)
 
     threads = [

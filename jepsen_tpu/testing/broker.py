@@ -129,8 +129,8 @@ class _ConnState:
     consuming_queue: str | None = None
     consuming_noack: bool = False
     confirm_channels: set = field(default_factory=set)
-    tx_mode: bool = False  # tx.select seen: publishes buffer until commit
-    tx_buffer: list = field(default_factory=list)  # [(queue, body), ...]
+    tx_channels: set = field(default_factory=set)  # tx.select per channel
+    tx_buffer: dict = field(default_factory=dict)  # ch -> [(queue, body)]
     open: bool = True
 
 
@@ -378,22 +378,30 @@ class MiniAmqpBroker:
                             qname, msg = item
                             self.queues.setdefault(qname, deque()).append(msg)
                     self._deliver_all()
-                elif cls == 90 and mth == 10:  # Tx.Select
-                    conn.tx_mode = True
+                elif cls == 90 and mth == 10:  # Tx.Select (per channel)
+                    conn.tx_channels.add(ch)
                     self._send_method(conn, ch, 90, 11)
                 elif cls == 90 and mth == 20:  # Tx.Commit
-                    buffered, conn.tx_buffer = conn.tx_buffer, []
+                    buffered = conn.tx_buffer.pop(ch, [])
                     for qname, body in buffered:
                         self._apply_publish(qname, body)
                     self._send_method(conn, ch, 90, 21)
                     self._deliver_all()
                 elif cls == 90 and mth == 30:  # Tx.Rollback
-                    conn.tx_buffer = []
+                    conn.tx_buffer.pop(ch, None)
                     self._send_method(conn, ch, 90, 31)
                 elif cls == 10 and mth == 50:  # Connection.Close
                     self._send_method(conn, 0, 10, 51)
                     break
                 elif cls == 20 and mth == 40:  # Channel.Close
+                    # per-channel state dies with the channel: confirm
+                    # mode, the delivery-tag sequence, tx mode + staged
+                    # publishes, and any half-received publish content
+                    conn.confirm_channels.discard(ch)
+                    conn.publish_seq.pop(ch, None)
+                    conn.tx_channels.discard(ch)
+                    conn.tx_buffer.pop(ch, None)
+                    pending.pop(ch, None)
                     self._send_method(conn, ch, 20, 41)
                 else:
                     pass  # ignore anything else
@@ -428,7 +436,7 @@ class MiniAmqpBroker:
     def _finish_publish(
         self, conn: _ConnState, ch: int, queue: str, body: bytes
     ):
-        if conn.tx_mode:
+        if ch in conn.tx_channels:
             # tx publishes stay invisible until tx.commit (no confirms in
             # tx mode — the commit-ok is the acknowledgement) ... unless
             # the dirty-visibility fault is injected, which applies them
@@ -438,7 +446,7 @@ class MiniAmqpBroker:
                 self._apply_publish(queue, body)
                 self._deliver_all()
             else:
-                conn.tx_buffer.append((queue, body))
+                conn.tx_buffer.setdefault(ch, []).append((queue, body))
             return
         seq = conn.publish_seq.get(ch, 0) + 1
         conn.publish_seq[ch] = seq

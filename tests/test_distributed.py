@@ -1,11 +1,13 @@
 """Multi-process checker plane: ``init_multihost`` over localhost.
 
-Two OS processes each hold 4 virtual CPU devices; ``jax.distributed``
-joins them into one 8-device runtime and the sharded quorum-queue check
-runs pod-style over the global ``(hist, seq)`` mesh.  This is the DCN
-story of SURVEY.md §2.4 exercised for real — process 0 is the
-coordinator, process 1 a worker — with the verdict differentially checked
-against the single-process CPU reference.
+``jax.distributed`` joins N OS processes (each holding its share of
+virtual CPU devices) into one 8-device runtime and the sharded
+quorum-queue check runs pod-style over the global ``(hist, seq)`` mesh.
+This is the DCN story of SURVEY.md §2.4 exercised for real — process 0
+is the coordinator — with the verdict differentially checked against the
+single-process CPU reference.  Parametrized over pod shapes: 2×4 (two
+hosts) and 4×2 (four hosts, every mesh row crossing a process
+boundary).
 """
 
 import json
@@ -20,14 +22,15 @@ _WORKER = r"""
 import json, os, sys
 
 os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={sys.argv[3]}"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-port, pid = sys.argv[1], int(sys.argv[2])
+port, pid, n_procs = sys.argv[1], int(sys.argv[2]), int(sys.argv[4])
 
 from jepsen_tpu.parallel.distributed import (
     global_checker_mesh,
@@ -35,8 +38,8 @@ from jepsen_tpu.parallel.distributed import (
     is_coordinator,
 )
 
-init_multihost(f"localhost:{port}", num_processes=2, process_id=pid)
-assert jax.process_count() == 2, jax.process_count()
+init_multihost(f"localhost:{port}", num_processes=n_procs, process_id=pid)
+assert jax.process_count() == n_procs, jax.process_count()
 assert len(jax.devices()) == 8, len(jax.devices())
 assert is_coordinator() == (pid == 0)
 
@@ -81,20 +84,30 @@ print(
 """
 
 
-def test_init_multihost_two_process_sharded_check():
+import pytest
+
+
+@pytest.mark.parametrize(
+    "n_procs,devices_per_proc", [(2, 4), (4, 2)],
+    ids=["pod2x4", "pod4x2"],
+)
+def test_init_multihost_sharded_check(n_procs, devices_per_proc):
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
 
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(port), str(pid)],
+            [
+                sys.executable, "-c", _WORKER, str(port), str(pid),
+                str(devices_per_proc), str(n_procs),
+            ],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
             cwd=REPO,
         )
-        for pid in range(2)
+        for pid in range(n_procs)
     ]
     outs = []
     try:
@@ -109,10 +122,11 @@ def test_init_multihost_two_process_sharded_check():
             if p.poll() is None:
                 p.kill()
 
-    # both processes computed the same global verdict
-    assert outs[0]["valid"] == outs[1]["valid"]
-    assert outs[0]["lost"] == outs[1]["lost"]
-    assert outs[0]["stream_valid"] == outs[1]["stream_valid"]
+    # every process computed the same global verdict
+    for o in outs[1:]:
+        assert o["valid"] == outs[0]["valid"]
+        assert o["lost"] == outs[0]["lost"]
+        assert o["stream_valid"] == outs[0]["stream_valid"]
 
     # stream differential (the lost append must be flagged pod-wide)
     from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu

@@ -327,3 +327,68 @@ def test_sim_down_node_semantics():
     c.set_up("n2")
     c.set_up("n3")
     assert c.publish("n3", 4) is True
+
+
+class TestLiveMonitor:
+    """Mid-run anomaly monitor (checkers/live.py): monotone total-queue
+    anomalies surface the moment they are recorded."""
+
+    def test_unit_monotone_flags(self):
+        from jepsen_tpu.checkers.live import LiveTotalQueue
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        fired = []
+        m = LiveTotalQueue(on_anomaly=lambda k, v, i: fired.append((k, v)))
+        enq = Op.invoke(OpF.ENQUEUE, 0, 7)
+        m.observe(enq)  # invocation alone makes 7 explicable
+        deq = Op.invoke(OpF.DEQUEUE, 1)
+        m.observe(deq.complete(OpType.OK, value=7))
+        assert not fired  # first read of an attempted value: clean
+        m.observe(deq.complete(OpType.OK, value=7))
+        assert fired == [("duplicated", 7)]
+        m.observe(deq.complete(OpType.OK, value=99))
+        assert fired[-1] == ("unexpected", 99)
+        snap = m.snapshot()
+        assert snap["violation-so-far"] is True
+        assert snap["duplicated-count"] == 1 and snap["unexpected-count"] == 1
+        # monotone: repeats never re-fire
+        m.observe(deq.complete(OpType.OK, value=99))
+        assert len(fired) == 2
+
+    def test_clean_run_stays_silent(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS, store_root=str(tmp_path / "store")
+        )
+        m = attach_live_monitor(test)
+        run = run_test(test)
+        assert run.valid
+        snap = m.snapshot()
+        assert snap["read-count"] > 0
+        assert snap["violation-so-far"] is False and not snap["events"]
+
+    def test_duplicating_broker_flagged_mid_run(self, tmp_path):
+        """The injected at-least-once duplicates are caught DURING the run
+        (event op-indices precede the history's end) and agree with the
+        post-hoc checker's classification."""
+        from jepsen_tpu.checkers.live import attach_live_monitor
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS,
+            store_root=str(tmp_path / "store"),
+            duplicate_every=3,
+        )
+        m = attach_live_monitor(test)
+        run = run_test(test)
+        snap = m.snapshot()
+        assert snap["duplicated-count"] > 0
+        assert snap["unexpected-count"] == 0
+        assert all(
+            e["op-index"] < len(run.history) for e in snap["events"]
+        )
+        assert run.results["queue"]["valid?"]  # duplicates stay legal
+        assert (
+            run.results["queue"]["duplicated-count"]
+            >= snap["duplicated-count"]
+        )

@@ -125,12 +125,17 @@ def pack_histories(
     histories: Sequence[Sequence[Op]],
     length: int | None = None,
     value_space: int | None = None,
+    to_device: bool = True,
 ) -> PackedHistories:
     """Pack a batch of histories into one ``PackedHistories``.
 
     ``length``: target L; default = max exploded length rounded up to 128.
     ``value_space``: scatter width V; default = max(value)+1 across the batch
     rounded up to 128 (at least 128).
+    ``to_device=False`` keeps the columns as host (numpy) arrays — packing
+    then never touches a JAX backend, which callers that must stay
+    backend-neutral (the driver's ``entry()`` contract) rely on; the first
+    jit call places them.
     """
     if not histories:
         raise ValueError("cannot pack an empty batch of histories")
@@ -172,16 +177,17 @@ def pack_histories(
     # index V, so V itself must be representable).  Host-analysis columns
     # (index/process/times) stay i32.
     val_dt = np.int16 if V <= np.iinfo(np.int16).max else np.int32
+    conv = jax.numpy.asarray if to_device else np.asarray
     return PackedHistories(
-        index=jax.numpy.asarray(cols["index"]),
-        process=jax.numpy.asarray(cols["process"]),
-        type=jax.numpy.asarray(cols["type"].astype(np.int8)),
-        f=jax.numpy.asarray(cols["f"].astype(np.int8)),
-        value=jax.numpy.asarray(cols["value"].astype(val_dt)),
-        time_ms=jax.numpy.asarray(cols["time_ms"]),
-        latency_ms=jax.numpy.asarray(cols["latency_ms"]),
-        mask=jax.numpy.asarray(mask),
-        first=jax.numpy.asarray(cols["first"].astype(bool)),
+        index=conv(cols["index"]),
+        process=conv(cols["process"]),
+        type=conv(cols["type"].astype(np.int8)),
+        f=conv(cols["f"].astype(np.int8)),
+        value=conv(cols["value"].astype(val_dt)),
+        time_ms=conv(cols["time_ms"]),
+        latency_ms=conv(cols["latency_ms"]),
+        mask=conv(mask),
+        first=conv(cols["first"].astype(bool)),
         value_space=V,
     )
 
@@ -190,6 +196,12 @@ def pack_history(
     history: Sequence[Op],
     length: int | None = None,
     value_space: int | None = None,
+    to_device: bool = True,
 ) -> PackedHistories:
     """Pack a single history (batch dim of 1)."""
-    return pack_histories([history], length=length, value_space=value_space)
+    return pack_histories(
+        [history],
+        length=length,
+        value_space=value_space,
+        to_device=to_device,
+    )

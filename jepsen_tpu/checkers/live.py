@@ -114,8 +114,117 @@ class LiveTotalQueue:
             }
 
 
-def attach_live_monitor(test, monitor=None) -> LiveTotalQueue:
-    """Append a live monitor to ``test.observers`` and return it."""
-    m = monitor or LiveTotalQueue()
+class LiveStream:
+    """Monotone-anomaly monitor for the stream (append-only log) workload.
+
+    Four of the stream checker's classes are definitive the moment they
+    are observed (and all four invalidate post-hoc, ``stream_lin.py``):
+
+    - ``divergent``     — an offset read back with two different values;
+    - ``duplicated``    — one value observed at two distinct offsets;
+    - ``phantom``       — a value read though its append was never even
+      invoked (same recording-order argument as the queue monitor);
+    - ``nonmonotonic``  — offsets not strictly increasing within one read.
+
+    Phantom-via-definite-failure is deliberately NOT live-flagged: a
+    later retry of the same value could still explain the read, so only
+    the post-hoc pass (which sees the whole history) may claim it.
+    """
+
+    name = "live-stream"
+
+    def __init__(
+        self, on_anomaly: Callable[[str, int, int], None] | None = None
+    ):
+        self._lock = threading.Lock()
+        self._attempted: set[int] = set()
+        self._off_val: dict[int, int] = {}
+        self._val_off: dict[int, int] = {}
+        self.divergent: set[int] = set()
+        self.duplicated: set[int] = set()
+        self.phantom: set[int] = set()
+        self.nonmonotonic = 0
+        self._nonmono_offsets: set[int] = set()
+        self.events: list[dict[str, Any]] = []
+        self._on_anomaly = on_anomaly
+
+    def observe(self, op: Op) -> None:
+        if op.f == OpF.APPEND:
+            if op.type == OpType.INVOKE and isinstance(op.value, int):
+                with self._lock:
+                    self._attempted.add(op.value)
+            return
+        if op.f != OpF.READ or op.type != OpType.OK:
+            return
+        # the checker's own pair parser, so live and post-hoc agree on
+        # every accepted op.value shape (incl. one bare [offset, value])
+        from jepsen_tpu.checkers.stream_lin import read_pairs
+
+        fired: list[tuple[str, int]] = []
+        with self._lock:
+            prev_off = None
+            for o, v in read_pairs(op):
+                if not (isinstance(o, int) and isinstance(v, int)):
+                    continue
+                if prev_off is not None and o <= prev_off:
+                    # count every occurrence (snapshot stays exact) but
+                    # fire/log at most once per offending offset — a
+                    # consumer that reverses every batch must not flood
+                    # the log and the events list from the recorder lock
+                    self.nonmonotonic += 1
+                    if o not in self._nonmono_offsets:
+                        self._nonmono_offsets.add(o)
+                        fired.append(("nonmonotonic", o))
+                prev_off = o
+                seen_v = self._off_val.setdefault(o, v)
+                if seen_v != v and o not in self.divergent:
+                    self.divergent.add(o)
+                    fired.append(("divergent", o))
+                seen_o = self._val_off.setdefault(v, o)
+                if seen_o != o and v not in self.duplicated:
+                    self.duplicated.add(v)
+                    fired.append(("duplicated", v))
+                if v not in self._attempted and v not in self.phantom:
+                    self.phantom.add(v)
+                    fired.append(("phantom", v))
+            for kind, x in fired:
+                self.events.append(
+                    {"kind": kind, "value": x, "op-index": op.index}
+                )
+        for kind, x in fired:
+            logger.error("LIVE ANOMALY: %s %d (op %d)", kind, x, op.index)
+            if self._on_anomaly is not None:
+                self._on_anomaly(kind, x, op.index)
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "attempt-count": len(self._attempted),
+                "offsets-observed": len(self._off_val),
+                "divergent-count": len(self.divergent),
+                "duplicated-count": len(self.duplicated),
+                "phantom-count": len(self.phantom),
+                "nonmonotonic-count": self.nonmonotonic,
+                # every live-flagged stream class invalidates post-hoc too
+                "violation-so-far": bool(
+                    self.divergent
+                    or self.duplicated
+                    or self.phantom
+                    or self.nonmonotonic
+                ),
+                "events": list(self.events),
+            }
+
+
+LIVE_MONITORS = {"queue": LiveTotalQueue, "stream": LiveStream}
+
+
+def attach_live_monitor_for(test, workload: str, **kw):
+    """Attach the live monitor for ``workload`` (None if it has none);
+    ``kw`` (e.g. ``on_anomaly=...``) forwards to the monitor ctor."""
+    cls = LIVE_MONITORS.get(workload)
+    if cls is None:
+        return None
+    m = cls(**kw)
     test.observers.append(m)
     return m

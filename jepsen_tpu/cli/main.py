@@ -373,23 +373,29 @@ def cmd_test(args) -> int:
         )
     monitor = None
     if args.live_check:
-        if args.workload == "queue":
-            from jepsen_tpu.checkers.live import attach_live_monitor
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
 
-            monitor = attach_live_monitor(test)
-        else:
+        monitor = attach_live_monitor_for(test, args.workload)
+        if monitor is None:
             print(
-                f"warning: --live-check covers the queue workload only; "
-                f"no monitor attached for {args.workload!r}",
+                f"warning: --live-check covers the queue and stream "
+                f"workloads; no monitor attached for {args.workload!r}",
                 file=sys.stderr,
             )
     run = run_test(test)
     if monitor is not None:
         snap = monitor.snapshot()
+        counts = ", ".join(
+            f"{v} {k[: -len('-count')]}"
+            for k, v in snap.items()
+            if k.endswith("-count")
+            and not k.startswith(("attempt", "read", "offsets"))
+        )
+        observed = snap.get("read-count", snap.get("offsets-observed", 0))
         print(
-            f"# live monitor: {snap['unexpected-count']} unexpected, "
-            f"{snap['duplicated-count']} duplicated "
-            f"(of {snap['read-count']} values read)",
+            f"# live monitor ({monitor.name}): {counts} "
+            f"(of {observed} observations); "
+            f"violation-so-far={snap['violation-so-far']}",
             file=sys.stderr,
         )
     print(json.dumps(run.results, indent=1, default=_json_default))
@@ -629,10 +635,10 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--live-check",
         action="store_true",
-        help="attach the mid-run anomaly monitor (queue workload only: "
-        "flags monotone total-queue anomalies — unexpected/duplicated "
-        "deliveries — the moment they are recorded, instead of only "
-        "post-hoc)",
+        help="attach the mid-run anomaly monitor (queue and stream "
+        "workloads: flags monotone anomalies — unexpected/duplicated "
+        "deliveries, divergent/phantom/non-monotone stream reads — the "
+        "moment they are recorded, instead of only post-hoc)",
     )
     t.add_argument(
         "--nemesis",

@@ -356,12 +356,12 @@ class TestLiveMonitor:
         assert len(fired) == 2
 
     def test_clean_run_stays_silent(self, tmp_path):
-        from jepsen_tpu.checkers.live import attach_live_monitor
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
 
         test, _cluster = build_sim_test(
             opts=FAST_OPTS, store_root=str(tmp_path / "store")
         )
-        m = attach_live_monitor(test)
+        m = attach_live_monitor_for(test, "queue")
         run = run_test(test)
         assert run.valid
         snap = m.snapshot()
@@ -372,14 +372,14 @@ class TestLiveMonitor:
         """The injected at-least-once duplicates are caught DURING the run
         (event op-indices precede the history's end) and agree with the
         post-hoc checker's classification."""
-        from jepsen_tpu.checkers.live import attach_live_monitor
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
 
         test, _cluster = build_sim_test(
             opts=FAST_OPTS,
             store_root=str(tmp_path / "store"),
             duplicate_every=3,
         )
-        m = attach_live_monitor(test)
+        m = attach_live_monitor_for(test, "queue")
         run = run_test(test)
         snap = m.snapshot()
         assert snap["duplicated-count"] > 0
@@ -392,3 +392,58 @@ class TestLiveMonitor:
             run.results["queue"]["duplicated-count"]
             >= snap["duplicated-count"]
         )
+
+
+class TestLiveStreamMonitor:
+    def test_unit_monotone_flags(self):
+        from jepsen_tpu.checkers.live import LiveStream
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        fired = []
+        m = LiveStream(on_anomaly=lambda k, v, i: fired.append((k, v)))
+        m.observe(Op.invoke(OpF.APPEND, 0, 10))
+        m.observe(Op.invoke(OpF.APPEND, 0, 11))
+        read = Op.invoke(OpF.READ, 1)
+        m.observe(read.complete(OpType.OK, value=[[0, 10], [1, 11]]))
+        assert not fired  # clean prefix
+        # same offset, different value → divergent
+        m.observe(read.complete(OpType.OK, value=[[0, 11]]))
+        assert ("divergent", 0) in fired
+        # same value at a second offset → duplicated
+        m.observe(read.complete(OpType.OK, value=[[2, 10]]))
+        assert ("duplicated", 10) in fired
+        # value never appended → phantom; offsets going backwards → nonmono
+        m.observe(read.complete(OpType.OK, value=[[3, 99], [1, 11]]))
+        assert ("phantom", 99) in fired
+        assert any(k == "nonmonotonic" for k, _ in fired)
+        snap = m.snapshot()
+        assert snap["violation-so-far"] is True
+
+    def test_stream_run_duplicates_flagged_mid_run(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS,
+            store_root=str(tmp_path / "store"),
+            workload="stream",
+            duplicate_append_every=3,
+        )
+        m = attach_live_monitor_for(test, "stream")
+        run = run_test(test)
+        snap = m.snapshot()
+        assert snap["duplicated-count"] > 0
+        assert snap["violation-so-far"] is True
+        assert run.results["stream"]["valid?"] is False  # post-hoc agrees
+
+    def test_clean_stream_run_stays_silent(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS,
+            store_root=str(tmp_path / "store"),
+            workload="stream",
+        )
+        m = attach_live_monitor_for(test, "stream")
+        run = run_test(test)
+        assert run.valid
+        assert m.snapshot()["violation-so-far"] is False

@@ -1,67 +1,144 @@
-"""Live (mid-run) anomaly monitor.
+"""Live (mid-run) anomaly monitors.
 
 The reference analyzes only after teardown (``checker/check`` at the end
 of ``jepsen.core/run!``, SURVEY.md §3.1) — a 180 s CI config that broke
-mutual delivery guarantees in its first seconds still runs to completion
+its delivery guarantees in the first seconds still runs to completion
 before anyone knows.  The history-as-pure-input design permits more:
-two of ``total-queue``'s classes are **monotone** — once observed they
-are definitive no matter what the rest of the run does:
+some anomaly classes are **monotone** — once both contributing events
+are recorded they are definitive no matter what the rest of the run
+does — so an observer riding the recorder can flag them the moment they
+happen.  Classes that a later op could still heal (``lost`` before the
+drain, cycle classes whose edge sets keep growing) stay post-hoc-only:
+the full verdict remains the post-hoc pure function of the recorded
+history, and the monitor is an early-warning surface (the "surface
+races, don't hide them" philosophy of SURVEY.md §5 applied *during* the
+run), not a second checker.
 
-- ``unexpected`` — a delivered value whose enqueue was never even
-  *invoked*.  Invocations are recorded before the client call starts
-  (the recorder appends the INVOKE row first), so at the moment a read
-  completes, every enqueue that could explain it is already in the
-  attempt set; a miss can never be healed by later ops.
-- ``duplicated`` — a value delivered twice.  Later ops only add reads.
+Per family (each mirrors its post-hoc checker's classification):
 
-``lost`` is the opposite: un-read values are merely *outstanding* until
-the final drain, so the live monitor never speculates about loss.  The
-full verdict therefore remains the post-hoc pure function of the
-recorded history — the monitor is an early-warning surface (the
-"surface races, don't hide them" philosophy of SURVEY.md §5 applied
-*during* the run), not a second checker.
+- **queue** (:class:`LiveTotalQueue`): ``unexpected`` — a delivered
+  value whose enqueue was never even *invoked* (invocations are
+  recorded before client calls start, so at read-completion time every
+  enqueue that could explain the value is already in the attempt set);
+  ``duplicated`` — a value delivered twice (reported-but-legal
+  at-least-once redelivery, exactly the post-hoc classification).
+- **stream** (:class:`LiveStream`): ``divergent`` offsets,
+  ``duplicated`` values, ``phantom`` reads of never-invoked appends,
+  and ``nonmonotonic`` within-read offset order — all four invalidate
+  post-hoc.  Phantom-via-definite-failure stays post-hoc-only (a later
+  retry of the value could still explain the read).
+- **elle** (:class:`LiveElle`): ``incompatible-order`` — two committed
+  reads of a key that contradict each other (reads only accumulate, a
+  contradiction never heals); ``G1a`` — a committed read observing a
+  value whose appending transaction definitely failed (FAIL
+  completions are final and values are globally unique, so the pair is
+  decisive whichever lands second; live counts flagged *values*, the
+  post-hoc checker reports reader *txn ids* — same violations,
+  different granularity).
 
-Wiring: :class:`LiveTotalQueue` implements the runner's observer hook
-(``observe(op)`` on every recorded op); ``test --live-check`` attaches
-one and reports its findings the moment they happen and again in the
-run summary.
+Wiring: monitors implement the runner's observer hook (``observe(op)``
+on every recorded op, in recording order — the ordering the
+monotonicity arguments rely on); ``test --live-check`` attaches the
+workload's monitor via :func:`attach_live_monitor_for` and reports its
+findings the moment they happen and again in the run summary.
+
+Snapshot contract (uniform across monitors, consumed by the CLI):
+``observations`` (how many data points were seen), ``anomalies``
+(class → count), ``violation-so-far`` (True iff a post-hoc-invalidating
+class fired), ``events`` (each ``{kind, value, op-index}``).
 """
 
 from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Callable, Sequence
+from typing import Any, Callable
 
 from jepsen_tpu.history.ops import Op, OpF, OpType
 
 logger = logging.getLogger("jepsen_tpu.live")
 
 
-class LiveTotalQueue:
-    """Monotone-anomaly monitor for the quorum-queue workload.
+class _LiveMonitor:
+    """Shared monitor plumbing: the lock, the event log, and the fire
+    path (dedup bookkeeping is per subclass; firing, event recording,
+    logging, and the ``on_anomaly`` callback are identical).
 
-    Thread-safe (the recorder calls ``observe`` from every worker
-    thread).  ``on_anomaly(kind, value, op_index)`` fires at most once
-    per (kind, value) — ``kind`` is ``"unexpected"`` (a genuine
-    violation: ``total-queue`` invalidates on it) or ``"duplicated"``
-    (reported-but-legal at-least-once redelivery, same as the post-hoc
-    checker's classification)."""
+    Subclasses implement ``observe(op)`` — collect ``fired`` pairs under
+    ``self._lock`` and finish with ``self._emit(fired, op)`` (records
+    events inside the lock'd section's tail, then logs/calls back
+    outside it) — plus ``_observations()``, ``_anomaly_counts()``, and
+    ``_violation()`` for the snapshot.  ``_severity(kind)`` picks the
+    log level (error unless overridden)."""
 
-    name = "live-total-queue"
+    name = "live-monitor"
 
     def __init__(
         self, on_anomaly: Callable[[str, int, int], None] | None = None
     ):
         self._lock = threading.Lock()
+        self.events: list[dict[str, Any]] = []
+        self._on_anomaly = on_anomaly
+
+    # ---- fire path --------------------------------------------------------
+    def _record(self, fired: list[tuple[str, int]], op: Op) -> None:
+        """Append events; call while holding ``self._lock``."""
+        for kind, x in fired:
+            self.events.append(
+                {"kind": kind, "value": x, "op-index": op.index}
+            )
+
+    def _notify(self, fired: list[tuple[str, int]], op: Op) -> None:
+        """Log + callback; call after releasing ``self._lock``."""
+        for kind, x in fired:
+            self._severity(kind)(
+                "LIVE ANOMALY: %s %d (op %d)", kind, x, op.index
+            )
+            if self._on_anomaly is not None:
+                self._on_anomaly(kind, x, op.index)
+
+    def _severity(self, kind: str):
+        return logger.error
+
+    # ---- snapshot ---------------------------------------------------------
+    def _observations(self) -> int:
+        raise NotImplementedError
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        raise NotImplementedError
+
+    def _violation(self) -> bool:
+        raise NotImplementedError
+
+    def snapshot(self) -> dict[str, Any]:
+        with self._lock:
+            return {
+                "observations": self._observations(),
+                "anomalies": self._anomaly_counts(),
+                "violation-so-far": self._violation(),
+                "events": list(self.events),
+            }
+
+
+class LiveTotalQueue(_LiveMonitor):
+    """Monotone-anomaly monitor for the quorum-queue workload (see the
+    module docstring).  Thread-safe; fires at most once per
+    (kind, value)."""
+
+    name = "live-total-queue"
+
+    def __init__(self, on_anomaly=None):
+        super().__init__(on_anomaly)
         self._attempted: set[int] = set()
         self._read: set[int] = set()
         self.duplicated: set[int] = set()
         self.unexpected: set[int] = set()
-        self.events: list[dict[str, Any]] = []
-        self._on_anomaly = on_anomaly
 
-    # ---- runner observer hook --------------------------------------------
+    def _severity(self, kind: str):
+        # duplicated is reported-but-legal redelivery (total-queue does
+        # not invalidate on it); unexpected is a genuine violation
+        return logger.warning if kind == "duplicated" else logger.error
+
     def observe(self, op: Op) -> None:
         if op.f == OpF.ENQUEUE:
             # the INVOKE alone makes a value explicable (its effect may
@@ -89,54 +166,32 @@ class LiveTotalQueue:
                     self.duplicated.add(v)
                     fired.append(("duplicated", v))
                 self._read.add(v)
-            for kind, v in fired:
-                self.events.append(
-                    {"kind": kind, "value": v, "op-index": op.index}
-                )
-        for kind, v in fired:
-            log = logger.error if kind == "unexpected" else logger.warning
-            log("LIVE ANOMALY: %s value %d (op %d)", kind, v, op.index)
-            if self._on_anomaly is not None:
-                self._on_anomaly(kind, v, op.index)
+            self._record(fired, op)
+        self._notify(fired, op)
 
-    # ---- reporting --------------------------------------------------------
-    def snapshot(self) -> dict[str, Any]:
-        with self._lock:
-            return {
-                "attempt-count": len(self._attempted),
-                "read-count": len(self._read),
-                "duplicated-count": len(self.duplicated),
-                "unexpected-count": len(self.unexpected),
-                # mirrors total-queue: only `unexpected` is disqualifying
-                # mid-run (`lost` is undecidable before the drain)
-                "violation-so-far": bool(self.unexpected),
-                "events": list(self.events),
-            }
+    def _observations(self) -> int:
+        return len(self._read)
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        return {
+            "duplicated": len(self.duplicated),
+            "unexpected": len(self.unexpected),
+        }
+
+    def _violation(self) -> bool:
+        # mirrors total-queue: only `unexpected` is disqualifying mid-run
+        # (`lost` is undecidable before the drain)
+        return bool(self.unexpected)
 
 
-class LiveStream:
-    """Monotone-anomaly monitor for the stream (append-only log) workload.
-
-    Four of the stream checker's classes are definitive the moment they
-    are observed (and all four invalidate post-hoc, ``stream_lin.py``):
-
-    - ``divergent``     — an offset read back with two different values;
-    - ``duplicated``    — one value observed at two distinct offsets;
-    - ``phantom``       — a value read though its append was never even
-      invoked (same recording-order argument as the queue monitor);
-    - ``nonmonotonic``  — offsets not strictly increasing within one read.
-
-    Phantom-via-definite-failure is deliberately NOT live-flagged: a
-    later retry of the same value could still explain the read, so only
-    the post-hoc pass (which sees the whole history) may claim it.
-    """
+class LiveStream(_LiveMonitor):
+    """Monotone-anomaly monitor for the stream workload (see the module
+    docstring)."""
 
     name = "live-stream"
 
-    def __init__(
-        self, on_anomaly: Callable[[str, int, int], None] | None = None
-    ):
-        self._lock = threading.Lock()
+    def __init__(self, on_anomaly=None):
+        super().__init__(on_anomaly)
         self._attempted: set[int] = set()
         self._off_val: dict[int, int] = {}
         self._val_off: dict[int, int] = {}
@@ -145,8 +200,6 @@ class LiveStream:
         self.phantom: set[int] = set()
         self.nonmonotonic = 0
         self._nonmono_offsets: set[int] = set()
-        self.events: list[dict[str, Any]] = []
-        self._on_anomaly = on_anomaly
 
     def observe(self, op: Op) -> None:
         if op.f == OpF.APPEND:
@@ -187,36 +240,112 @@ class LiveStream:
                 if v not in self._attempted and v not in self.phantom:
                     self.phantom.add(v)
                     fired.append(("phantom", v))
-            for kind, x in fired:
-                self.events.append(
-                    {"kind": kind, "value": x, "op-index": op.index}
-                )
-        for kind, x in fired:
-            logger.error("LIVE ANOMALY: %s %d (op %d)", kind, x, op.index)
-            if self._on_anomaly is not None:
-                self._on_anomaly(kind, x, op.index)
+            self._record(fired, op)
+        self._notify(fired, op)
 
-    def snapshot(self) -> dict[str, Any]:
+    def _observations(self) -> int:
+        return len(self._off_val)
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        return {
+            "divergent": len(self.divergent),
+            "duplicated": len(self.duplicated),
+            "phantom": len(self.phantom),
+            "nonmonotonic": self.nonmonotonic,
+        }
+
+    def _violation(self) -> bool:
+        # every live-flagged stream class invalidates post-hoc too
+        return bool(
+            self.divergent
+            or self.duplicated
+            or self.phantom
+            or self.nonmonotonic
+        )
+
+
+class LiveElle(_LiveMonitor):
+    """Monotone-anomaly monitor for the transactional (list-append)
+    workload (see the module docstring).  Cycle classes (G0/G1c/G2) stay
+    post-hoc: edge sets grow with every txn, and a cycle's absence
+    mid-run proves nothing."""
+
+    name = "live-elle"
+
+    def __init__(self, on_anomaly=None):
+        super().__init__(on_anomaly)
+        self._failed_values: set[int] = set()
+        self._observed_values: set[int] = set()
+        self._key_reads: dict[int, list[int]] = {}  # key -> longest read
+        self.incompatible_order: set[int] = set()
+        self.g1a: set[int] = set()
+
+    @staticmethod
+    def _micro_ops(op: Op) -> list:
+        return op.value if isinstance(op.value, (list, tuple)) else []
+
+    def observe(self, op: Op) -> None:
+        if op.f != OpF.TXN or op.type == OpType.INVOKE:
+            return
+        fired: list[tuple[str, int]] = []
         with self._lock:
-            return {
-                "attempt-count": len(self._attempted),
-                "offsets-observed": len(self._off_val),
-                "divergent-count": len(self.divergent),
-                "duplicated-count": len(self.duplicated),
-                "phantom-count": len(self.phantom),
-                "nonmonotonic-count": self.nonmonotonic,
-                # every live-flagged stream class invalidates post-hoc too
-                "violation-so-far": bool(
-                    self.divergent
-                    or self.duplicated
-                    or self.phantom
-                    or self.nonmonotonic
-                ),
-                "events": list(self.events),
-            }
+            if op.type == OpType.FAIL:
+                for m in self._micro_ops(op):
+                    if (
+                        len(m) == 3
+                        and m[0] == "append"
+                        and isinstance(m[2], int)
+                    ):
+                        self._failed_values.add(m[2])
+                        if (
+                            m[2] in self._observed_values
+                            and m[2] not in self.g1a
+                        ):
+                            self.g1a.add(m[2])
+                            fired.append(("G1a", m[2]))
+            elif op.type == OpType.OK:
+                for m in self._micro_ops(op):
+                    if len(m) != 3 or m[0] != "r":
+                        continue
+                    k, vs = m[1], m[2]
+                    if not isinstance(vs, (list, tuple)):
+                        continue
+                    vs = [v for v in vs if isinstance(v, int)]
+                    for v in vs:
+                        self._observed_values.add(v)
+                        if v in self._failed_values and v not in self.g1a:
+                            self.g1a.add(v)
+                            fired.append(("G1a", v))
+                    cur = self._key_reads.get(k, [])
+                    shorter, longer = sorted([cur, vs], key=len)
+                    if longer[: len(shorter)] != shorter:
+                        if k not in self.incompatible_order:
+                            self.incompatible_order.add(k)
+                            fired.append(("incompatible-order", k))
+                    elif len(vs) > len(cur):
+                        self._key_reads[k] = vs
+            self._record(fired, op)
+        self._notify(fired, op)
+
+    def _observations(self) -> int:
+        return len(self._observed_values)
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        return {
+            "incompatible-order": len(self.incompatible_order),
+            "G1a": len(self.g1a),
+        }
+
+    def _violation(self) -> bool:
+        # both live classes invalidate post-hoc too (elle.py _classify)
+        return bool(self.incompatible_order or self.g1a)
 
 
-LIVE_MONITORS = {"queue": LiveTotalQueue, "stream": LiveStream}
+LIVE_MONITORS = {
+    "queue": LiveTotalQueue,
+    "stream": LiveStream,
+    "elle": LiveElle,
+}
 
 
 def attach_live_monitor_for(test, workload: str, **kw):

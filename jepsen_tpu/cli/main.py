@@ -378,23 +378,19 @@ def cmd_test(args) -> int:
         monitor = attach_live_monitor_for(test, args.workload)
         if monitor is None:
             print(
-                f"warning: --live-check covers the queue and stream "
-                f"workloads; no monitor attached for {args.workload!r}",
+                f"warning: --live-check has no monitor for "
+                f"{args.workload!r}",
                 file=sys.stderr,
             )
     run = run_test(test)
     if monitor is not None:
         snap = monitor.snapshot()
         counts = ", ".join(
-            f"{v} {k[: -len('-count')]}"
-            for k, v in snap.items()
-            if k.endswith("-count")
-            and not k.startswith(("attempt", "read", "offsets"))
+            f"{v} {k}" for k, v in snap["anomalies"].items()
         )
-        observed = snap.get("read-count", snap.get("offsets-observed", 0))
         print(
             f"# live monitor ({monitor.name}): {counts} "
-            f"(of {observed} observations); "
+            f"(of {snap['observations']} observations); "
             f"violation-so-far={snap['violation-so-far']}",
             file=sys.stderr,
         )
@@ -635,10 +631,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--live-check",
         action="store_true",
-        help="attach the mid-run anomaly monitor (queue and stream "
-        "workloads: flags monotone anomalies — unexpected/duplicated "
-        "deliveries, divergent/phantom/non-monotone stream reads — the "
-        "moment they are recorded, instead of only post-hoc)",
+        help="attach the mid-run anomaly monitor (queue, stream, and "
+        "elle workloads: flags monotone anomalies — unexpected/duplicated "
+        "deliveries, divergent/phantom/non-monotone stream reads, "
+        "contradictory or failed-write txn reads — the moment they are "
+        "recorded, instead of only post-hoc)",
     )
     t.add_argument(
         "--nemesis",

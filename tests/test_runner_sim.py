@@ -350,7 +350,7 @@ class TestLiveMonitor:
         assert fired[-1] == ("unexpected", 99)
         snap = m.snapshot()
         assert snap["violation-so-far"] is True
-        assert snap["duplicated-count"] == 1 and snap["unexpected-count"] == 1
+        assert snap["anomalies"] == {"duplicated": 1, "unexpected": 1}
         # monotone: repeats never re-fire
         m.observe(deq.complete(OpType.OK, value=99))
         assert len(fired) == 2
@@ -365,7 +365,7 @@ class TestLiveMonitor:
         run = run_test(test)
         assert run.valid
         snap = m.snapshot()
-        assert snap["read-count"] > 0
+        assert snap["observations"] > 0
         assert snap["violation-so-far"] is False and not snap["events"]
 
     def test_duplicating_broker_flagged_mid_run(self, tmp_path):
@@ -382,15 +382,15 @@ class TestLiveMonitor:
         m = attach_live_monitor_for(test, "queue")
         run = run_test(test)
         snap = m.snapshot()
-        assert snap["duplicated-count"] > 0
-        assert snap["unexpected-count"] == 0
+        assert snap["anomalies"]["duplicated"] > 0
+        assert snap["anomalies"]["unexpected"] == 0
         assert all(
             e["op-index"] < len(run.history) for e in snap["events"]
         )
         assert run.results["queue"]["valid?"]  # duplicates stay legal
         assert (
             run.results["queue"]["duplicated-count"]
-            >= snap["duplicated-count"]
+            >= snap["anomalies"]["duplicated"]
         )
 
 
@@ -431,7 +431,7 @@ class TestLiveStreamMonitor:
         m = attach_live_monitor_for(test, "stream")
         run = run_test(test)
         snap = m.snapshot()
-        assert snap["duplicated-count"] > 0
+        assert snap["anomalies"]["duplicated"] > 0
         assert snap["violation-so-far"] is True
         assert run.results["stream"]["valid?"] is False  # post-hoc agrees
 
@@ -447,3 +447,47 @@ class TestLiveStreamMonitor:
         run = run_test(test)
         assert run.valid
         assert m.snapshot()["violation-so-far"] is False
+
+
+class TestLiveElleMonitor:
+    def test_unit_monotone_flags(self):
+        from jepsen_tpu.checkers.live import LiveElle
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        fired = []
+        m = LiveElle(on_anomaly=lambda k, v, i: fired.append((k, v)))
+        t1 = Op.invoke(OpF.TXN, 0, [["append", 0, 1]])
+        m.observe(t1)
+        m.observe(t1.complete(OpType.OK, value=[["append", 0, 1]]))
+        r = Op.invoke(OpF.TXN, 1, [["r", 0, None]])
+        m.observe(r.complete(OpType.OK, value=[["r", 0, [1]]]))
+        assert not fired
+        # contradictory read of key 0: [2] vs [1]
+        m.observe(r.complete(OpType.OK, value=[["r", 0, [2]]]))
+        assert ("incompatible-order", 0) in fired
+        # G1a, fail-then-read order
+        f = Op.invoke(OpF.TXN, 2, [["append", 1, 50]])
+        m.observe(f.complete(OpType.FAIL, value=[["append", 1, 50]]))
+        m.observe(r.complete(OpType.OK, value=[["r", 1, [50]]]))
+        assert ("G1a", 50) in fired
+        # G1a, read-then-fail order is decisive too
+        m.observe(r.complete(OpType.OK, value=[["r", 2, [60]]]))
+        f2 = Op.invoke(OpF.TXN, 3, [["append", 2, 60]])
+        m.observe(f2.complete(OpType.FAIL, value=[["append", 2, 60]]))
+        assert ("G1a", 60) in fired
+        assert m.snapshot()["violation-so-far"] is True
+
+    def test_clean_elle_run_stays_silent(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS,
+            store_root=str(tmp_path / "store"),
+            workload="elle",
+        )
+        m = attach_live_monitor_for(test, "elle")
+        run = run_test(test)
+        assert run.valid
+        snap = m.snapshot()
+        assert snap["observations"] > 0
+        assert snap["violation-so-far"] is False

@@ -35,6 +35,10 @@ Per family (each mirrors its post-hoc checker's classification):
   decisive whichever lands second; live counts flagged *values*, the
   post-hoc checker reports reader *txn ids* — same violations,
   different granularity).
+- **mutex** (:class:`LiveMutex`): the ``double-grant`` — an acquire-OK
+  completing while another certain hold is open (no release invoked
+  since that grant); see the class docstring for the soundness
+  argument.
 
 Wiring: monitors implement the runner's observer hook (``observe(op)``
 on every recorded op, in recording order — the ordering the
@@ -65,9 +69,9 @@ class _LiveMonitor:
     logging, and the ``on_anomaly`` callback are identical).
 
     Subclasses implement ``observe(op)`` — collect ``fired`` pairs under
-    ``self._lock`` and finish with ``self._emit(fired, op)`` (records
-    events inside the lock'd section's tail, then logs/calls back
-    outside it) — plus ``_observations()``, ``_anomaly_counts()``, and
+    ``self._lock``, call ``self._record(fired, op)`` before releasing it
+    and ``self._notify(fired, op)`` after — plus ``_observations()``,
+    ``_anomaly_counts()``, and
     ``_violation()`` for the snapshot.  ``_severity(kind)`` picks the
     log level (error unless overridden)."""
 
@@ -282,20 +286,24 @@ class LiveElle(_LiveMonitor):
 
     @staticmethod
     def _micro_ops(op: Op) -> list:
-        return op.value if isinstance(op.value, (list, tuple)) else []
+        """Well-formed ``[kind, key, payload]`` micro-ops only — malformed
+        entries are skipped rather than raising (an observer exception
+        would detach the monitor for the rest of the run)."""
+        v = op.value if isinstance(op.value, (list, tuple)) else []
+        return [m for m in v if isinstance(m, (list, tuple)) and len(m) == 3]
 
     def observe(self, op: Op) -> None:
+        # the checker's own micro-op vocabulary, so live and post-hoc
+        # agree on the encoding (same reuse rule as LiveStream)
+        from jepsen_tpu.checkers.elle import APPEND, READ
+
         if op.f != OpF.TXN or op.type == OpType.INVOKE:
             return
         fired: list[tuple[str, int]] = []
         with self._lock:
             if op.type == OpType.FAIL:
                 for m in self._micro_ops(op):
-                    if (
-                        len(m) == 3
-                        and m[0] == "append"
-                        and isinstance(m[2], int)
-                    ):
+                    if m[0] == APPEND and isinstance(m[2], int):
                         self._failed_values.add(m[2])
                         if (
                             m[2] in self._observed_values
@@ -305,7 +313,7 @@ class LiveElle(_LiveMonitor):
                             fired.append(("G1a", m[2]))
             elif op.type == OpType.OK:
                 for m in self._micro_ops(op):
-                    if len(m) != 3 or m[0] != "r":
+                    if m[0] != READ:
                         continue
                     k, vs = m[1], m[2]
                     if not isinstance(vs, (list, tuple)):
@@ -341,10 +349,62 @@ class LiveElle(_LiveMonitor):
         return bool(self.incompatible_order or self.g1a)
 
 
+class LiveMutex(_LiveMonitor):
+    """Monotone-anomaly monitor for the mutex workload: the
+    **double grant**.
+
+    Rule: a *certain hold* starts at any acquire-OK and ends at the next
+    release INVOKE by anyone; an acquire-OK completing during a certain
+    hold is flagged.  Soundness: both grants' linearization points
+    precede the second grant's completion time t, the second of the two
+    (in any candidate order) requires a release between them, and a
+    release's linearization point can never precede its own invocation —
+    of which none exists before t.  So no legal linearization remains:
+    this is exactly the unfenced-lock revocation / split-brain double
+    grant the post-hoc WGL search refutes, caught the moment the second
+    grant is recorded.  Clearing on ANY release invocation (not just the
+    holder's) keeps the rule conservative; subtler shapes stay
+    post-hoc."""
+
+    name = "live-mutex"
+
+    def __init__(self, on_anomaly=None):
+        super().__init__(on_anomaly)
+        self._holder: int | None = None
+        self._grants = 0
+        self.double_grants = 0
+
+    def observe(self, op: Op) -> None:
+        if op.f not in (OpF.ACQUIRE, OpF.RELEASE):
+            return
+        fired: list[tuple[str, int]] = []
+        with self._lock:
+            if op.f == OpF.RELEASE and op.type == OpType.INVOKE:
+                self._holder = None
+            elif op.f == OpF.ACQUIRE and op.type == OpType.OK:
+                self._grants += 1
+                if self._holder is not None:
+                    self.double_grants += 1
+                    fired.append(("double-grant", op.process))
+                self._holder = op.process
+            self._record(fired, op)
+        self._notify(fired, op)
+
+    def _observations(self) -> int:
+        return self._grants
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        return {"double-grant": self.double_grants}
+
+    def _violation(self) -> bool:
+        return bool(self.double_grants)
+
+
 LIVE_MONITORS = {
     "queue": LiveTotalQueue,
     "stream": LiveStream,
     "elle": LiveElle,
+    "mutex": LiveMutex,
 }
 
 

@@ -631,11 +631,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--live-check",
         action="store_true",
-        help="attach the mid-run anomaly monitor (queue, stream, and "
-        "elle workloads: flags monotone anomalies — unexpected/duplicated "
-        "deliveries, divergent/phantom/non-monotone stream reads, "
-        "contradictory or failed-write txn reads — the moment they are "
-        "recorded, instead of only post-hoc)",
+        help="attach the mid-run anomaly monitor (all workloads: flags "
+        "monotone anomalies — unexpected/duplicated deliveries, "
+        "divergent/phantom/non-monotone stream reads, contradictory or "
+        "failed-write txn reads, mutex double grants — the moment they "
+        "are recorded, instead of only post-hoc)",
     )
     t.add_argument(
         "--nemesis",

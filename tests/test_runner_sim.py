@@ -491,3 +491,56 @@ class TestLiveElleMonitor:
         snap = m.snapshot()
         assert snap["observations"] > 0
         assert snap["violation-so-far"] is False
+
+
+class TestLiveMutexMonitor:
+    def test_unit_double_grant_rule(self):
+        from jepsen_tpu.checkers.live import LiveMutex
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        fired = []
+        m = LiveMutex(on_anomaly=lambda k, v, i: fired.append((k, v)))
+        acq_a = Op.invoke(OpF.ACQUIRE, 0)
+        m.observe(acq_a)
+        m.observe(acq_a.complete(OpType.OK))
+        rel_a = Op.invoke(OpF.RELEASE, 0)
+        m.observe(rel_a)  # release INVOKE clears the certain hold...
+        acq_b = Op.invoke(OpF.ACQUIRE, 1)
+        m.observe(acq_b.complete(OpType.OK))
+        assert not fired  # ...so B's grant is explicable
+        # C granted while B certainly holds (no release invoked since)
+        acq_c = Op.invoke(OpF.ACQUIRE, 2)
+        m.observe(acq_c.complete(OpType.OK))
+        assert fired == [("double-grant", 2)]
+        assert m.snapshot()["violation-so-far"] is True
+
+    def test_split_brain_sim_run_flagged_mid_run(self, tmp_path):
+        """The sim's injected split-brain double grant fires DURING the
+        run and the post-hoc WGL verdict agrees it is a violation."""
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts={**FAST_OPTS, "rate": 600.0},
+            store_root=str(tmp_path / "store"),
+            workload="mutex",
+            double_grant_every=3,
+        )
+        m = attach_live_monitor_for(test, "mutex")
+        run = run_test(test)
+        snap = m.snapshot()
+        assert snap["anomalies"]["double-grant"] > 0
+        assert snap["violation-so-far"] is True
+        assert run.results["mutex"]["valid?"] is False
+
+    def test_clean_mutex_run_stays_silent(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts=FAST_OPTS,
+            store_root=str(tmp_path / "store"),
+            workload="mutex",
+        )
+        m = attach_live_monitor_for(test, "mutex")
+        run = run_test(test)
+        assert run.valid
+        assert m.snapshot()["violation-so-far"] is False

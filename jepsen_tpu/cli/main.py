@@ -41,6 +41,7 @@ from jepsen_tpu.checkers.protocol import UNKNOWN
 from jepsen_tpu.history.store import (
     HISTORY_FILE,
     Store,
+    read_history,
     read_history_jsonl,
     save_results,
     _json_default,
@@ -69,15 +70,17 @@ def _verdict_exit(verdict) -> int:
 
 
 def _resolve_history_path(path: Path) -> Path:
-    """Accept a history file, a run dir, or a store root (→ latest run)."""
+    """Accept a history file (JSONL or jepsen EDN), a run dir, or a
+    store root (→ latest run)."""
     if path.is_file():
         return path
-    if (path / HISTORY_FILE).is_file():
-        return path / HISTORY_FILE
-    latest = path / "latest"
-    if latest.exists() and (latest / HISTORY_FILE).is_file():
-        return (latest / HISTORY_FILE).resolve()
-    raise FileNotFoundError(f"no {HISTORY_FILE} under {path}")
+    for name in (HISTORY_FILE, "history.edn"):
+        if (path / name).is_file():
+            return path / name
+        latest = path / "latest"
+        if latest.exists() and (latest / name).is_file():
+            return (latest / name).resolve()
+    raise FileNotFoundError(f"no {HISTORY_FILE} (or history.edn) under {path}")
 
 
 def _workload_of(history) -> str:
@@ -146,7 +149,7 @@ def cmd_check(args) -> int:
     from jepsen_tpu.checkers.protocol import VALID
 
     hpath = _resolve_history_path(Path(args.history)).resolve()
-    history = read_history_jsonl(hpath)
+    history = read_history(hpath)
     out_dir = hpath.parent
     checker = _checker_for(args, out_dir=out_dir, history=history)
     t0 = time.perf_counter()
@@ -170,11 +173,12 @@ def cmd_bench_check(args) -> int:
 
     workload = getattr(args, "workload", "auto")
     if args.histories:
-        paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}"))
+        paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}")) + \
+            sorted(Path(args.histories).glob("**/history.edn"))
         if not paths:
             print(f"no histories under {args.histories}", file=sys.stderr)
             return 2
-        histories = [read_history_jsonl(p) for p in paths]
+        histories = [read_history(p) for p in paths]
         print(f"# loaded {len(histories)} stored histories", file=sys.stderr)
         # a store may hold several families; bench the majority on auto
         # (sorted → deterministic tie-break, favoring "elle" < "queue"

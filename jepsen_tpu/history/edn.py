@@ -1,0 +1,252 @@
+"""Import jepsen ``history.edn`` files.
+
+A reference user's on-disk artifacts are jepsen store directories whose
+history is EDN — a sequence of op maps like
+
+    {:type :invoke, :f :enqueue, :value 302, :process 3, :time 817102,
+     :index 12}
+
+(older jepsen) or tagged records ``#jepsen.history.Op{...}`` (jepsen
+0.3.x with ``jepsen.history``).  ``check``/``bench-check`` accept those
+files directly: this module is a small, dependency-free EDN reader
+covering the grammar such histories use — maps, vectors/lists, sets,
+keywords, symbols, strings, numbers, ``nil``/booleans, comments,
+``#_`` discard, and tagged literals (the tag is dropped, the value
+kept, which is exactly right for record-as-map tags).
+
+The op mapper is deliberately lenient: unknown ``:f`` values raise with
+the offending name (a wrong guess would silently mis-classify ops), the
+``:nemesis`` process maps to the framework's nemesis pseudo-process,
+and ops jepsen adds that have no client meaning here (``:log`` lines
+etc.) pass through via the shared name tables in ``history.ops``.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+from jepsen_tpu.history.ops import (
+    NEMESIS_PROCESS,
+    Op,
+    _F_BY_NAME,
+    _TYPE_BY_NAME,
+)
+
+_WS = set(" \t\r\n,")
+_DELIM = set("()[]{}\"';")
+
+
+class EdnError(ValueError):
+    pass
+
+
+class Keyword(str):
+    """An EDN keyword (``:foo`` → ``Keyword("foo")``) — a str subclass so
+    consumers can treat it as its name."""
+
+    __slots__ = ()
+
+
+def _skip_ws(s: str, i: int) -> int:
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if c in _WS:
+            i += 1
+        elif c == ";":  # comment to end of line
+            while i < n and s[i] != "\n":
+                i += 1
+        elif s.startswith("#_", i):  # discard: skip the next form
+            v, i = _read(s, i + 2)
+            del v
+        else:
+            break
+    return i
+
+
+def _read_string(s: str, i: int) -> tuple[str, int]:
+    out = []
+    i += 1  # opening quote
+    n = len(s)
+    while i < n:
+        c = s[i]
+        if c == '"':
+            return "".join(out), i + 1
+        if c == "\\":
+            i += 1
+            if i >= n:
+                break
+            esc = s[i]
+            if esc == "u" and i + 4 < n:  # \uXXXX (EDN string grammar)
+                try:
+                    out.append(chr(int(s[i + 1 : i + 5], 16)))
+                    i += 5
+                    continue
+                except ValueError:
+                    pass  # not hex: fall through, keep the char bare
+            out.append(
+                {"n": "\n", "t": "\t", "r": "\r", '"': '"', "\\": "\\"}.get(
+                    esc, esc
+                )
+            )
+        else:
+            out.append(c)
+        i += 1
+    raise EdnError("unterminated string")
+
+
+def _read_token(s: str, i: int) -> tuple[str, int]:
+    j = i
+    n = len(s)
+    while j < n and s[j] not in _WS and s[j] not in _DELIM and not (
+        s[j] == "#" and j > i
+    ):
+        j += 1
+    return s[i:j], j
+
+
+def _token_value(tok: str) -> Any:
+    if tok == "nil":
+        return None
+    if tok == "true":
+        return True
+    if tok == "false":
+        return False
+    # numbers (jepsen histories use ints and the odd float; trailing N/M
+    # mark big ints/decimals)
+    body = tok[:-1] if tok and tok[-1] in "NM" and len(tok) > 1 else tok
+    try:
+        return int(body)
+    except ValueError:
+        pass
+    try:
+        return float(body)
+    except ValueError:
+        pass
+    return tok  # a symbol; kept as its name
+
+
+def _read_seq(s: str, i: int, closer: str) -> tuple[list, int]:
+    out = []
+    while True:
+        i = _skip_ws(s, i)
+        if i >= len(s):
+            raise EdnError(f"unterminated sequence (wanted {closer!r})")
+        if s[i] == closer:
+            return out, i + 1
+        v, i = _read(s, i)
+        out.append(v)
+
+
+def _read(s: str, i: int) -> tuple[Any, int]:
+    i = _skip_ws(s, i)
+    if i >= len(s):
+        raise EdnError("unexpected end of input")
+    c = s[i]
+    if c == "{":
+        items, i = _read_seq(s, i + 1, "}")
+        if len(items) % 2:
+            raise EdnError("map with odd number of forms")
+        return dict(zip(items[::2], items[1::2])), i
+    if c == "[":
+        return _read_seq(s, i + 1, "]")
+    if c == "(":
+        return _read_seq(s, i + 1, ")")
+    if c == '"':
+        return _read_string(s, i)
+    if c == ":":
+        tok, i = _read_token(s, i + 1)
+        return Keyword(tok), i
+    if c == "\\":  # character literal
+        tok, i = _read_token(s, i + 1)
+        named = {"newline": "\n", "space": " ", "tab": "\t", "return": "\r"}
+        return named.get(tok, tok[:1]), i
+    if c == "#":
+        if s.startswith("#{", i):
+            items, i = _read_seq(s, i + 2, "}")
+            try:
+                return set(items), i
+            except TypeError:  # unhashable members: keep the list
+                return items, i
+        # tagged literal: #some.tag/Name <form> — drop the tag
+        tag, i = _read_token(s, i + 1)
+        del tag
+        return _read(s, i)
+    tok, i = _read_token(s, i)
+    if not tok:
+        raise EdnError(f"cannot read at position {i}: {s[i:i+10]!r}")
+    return _token_value(tok), i
+
+
+def parse_edn_forms(text: str) -> list[Any]:
+    """Every top-level form in ``text`` (a history file is either one
+    vector of op maps or a bare sequence of them)."""
+    out = []
+    i = 0
+    while True:
+        i = _skip_ws(text, i)
+        if i >= len(text):
+            return out
+        v, i = _read(text, i)
+        out.append(v)
+
+
+def _to_plain(v: Any) -> Any:
+    """Keywords → plain strings (op values like ``:exhausted`` errors)."""
+    if isinstance(v, Keyword):
+        return str(v)
+    if isinstance(v, list):
+        return [_to_plain(x) for x in v]
+    return v
+
+
+def op_from_edn(m: dict) -> Op:
+    """One jepsen op map → :class:`Op`."""
+    # Keyword is a str subclass, so plain string keys look maps up fine
+    get = m.get
+    type_name = str(get("type") or "")
+    f_name = str(get("f") or "").replace("-", "_")
+    if type_name not in _TYPE_BY_NAME:
+        raise EdnError(f"unknown op :type {get('type')!r}")
+    if f_name not in _F_BY_NAME:
+        raise EdnError(f"unknown op :f {get('f')!r}")
+    proc = get("process")
+    if isinstance(proc, Keyword) or proc is None:
+        proc = NEMESIS_PROCESS  # :nemesis
+    value = _to_plain(get("value"))
+    time = get("time")
+    index = get("index")
+    return Op(
+        type=_TYPE_BY_NAME[type_name],
+        f=_F_BY_NAME[f_name],
+        process=int(proc),
+        value=value,
+        time=int(time) if isinstance(time, int) else -1,
+        index=int(index) if isinstance(index, int) else -1,
+        error=_to_plain(get("error")),
+    )
+
+
+def read_history_edn(path: str | Path) -> list[Op]:
+    """Parse a jepsen ``history.edn`` into ops.
+
+    Accepts both layouts: one top-level vector of op maps, or one op map
+    per line (the streaming layout).  Ops jepsen records that this
+    framework has no ``:f`` for raise — silently dropping ops would
+    quietly weaken every checker that consumes the history.
+    """
+    forms = parse_edn_forms(Path(path).read_text())
+    if len(forms) == 1 and isinstance(forms[0], list):
+        forms = forms[0]
+    ops = []
+    for form in forms:
+        if not isinstance(form, dict):
+            raise EdnError(f"expected an op map, got {type(form).__name__}")
+        ops.append(op_from_edn(form))
+    # jepsen histories are index-ordered already; re-index defensively if
+    # absent (all -1) so packing gets sequential rows
+    if ops and all(op.index == -1 for op in ops):
+        for i, op in enumerate(ops):
+            op.index = i
+    return ops

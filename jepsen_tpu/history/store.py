@@ -34,6 +34,17 @@ def write_history_jsonl(path: str | Path, history: Iterable[Op]) -> None:
             fh.write(json.dumps(op.to_json()) + "\n")
 
 
+def read_history(path: str | Path) -> list[Op]:
+    """Read a history file by format: jepsen ``*.edn`` (the reference
+    ecosystem's on-disk artifact) or this framework's JSONL."""
+    p = Path(path)
+    if p.suffix == ".edn":
+        from jepsen_tpu.history.edn import read_history_edn
+
+        return read_history_edn(p)
+    return read_history_jsonl(p)
+
+
 def read_history_jsonl(path: str | Path) -> list[Op]:
     out = []
     with open(path) as fh:

@@ -173,8 +173,13 @@ def cmd_bench_check(args) -> int:
 
     workload = getattr(args, "workload", "auto")
     if args.histories:
-        paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}")) + \
-            sorted(Path(args.histories).glob("**/history.edn"))
+        paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}")) + [
+            # an EDN twin beside a JSONL (e.g. an exported copy) is the
+            # same run — don't load it twice
+            p
+            for p in sorted(Path(args.histories).glob("**/history.edn"))
+            if not (p.parent / HISTORY_FILE).exists()
+        ]
         if not paths:
             print(f"no histories under {args.histories}", file=sys.stderr)
             return 2
@@ -398,6 +403,10 @@ def cmd_test(args) -> int:
             f"violation-so-far={snap['violation-so-far']}",
             file=sys.stderr,
         )
+        if run.run_dir is not None:  # a store artifact, like results.json
+            (run.run_dir / "live.json").write_text(
+                json.dumps({"monitor": monitor.name, **snap}, indent=1)
+            )
     print(json.dumps(run.results, indent=1, default=_json_default))
     return _verdict_exit(run.verdict)
 

@@ -209,12 +209,24 @@ def op_from_edn(m: dict) -> Op:
     f_name = str(get("f") or "").replace("-", "_")
     if type_name not in _TYPE_BY_NAME:
         raise EdnError(f"unknown op :type {get('type')!r}")
-    if f_name not in _F_BY_NAME:
-        raise EdnError(f"unknown op :f {get('f')!r}")
     proc = get("process")
     if isinstance(proc, Keyword) or proc is None:
         proc = NEMESIS_PROCESS  # :nemesis
     value = _to_plain(get("value"))
+    if f_name not in _F_BY_NAME:
+        if int(proc) == NEMESIS_PROCESS:
+            # jepsen's richer nemeses record f's like :start-partition /
+            # :kill; every checker masks nemesis ops out anyway, so keep
+            # them as log rows (f name folded into the value) rather than
+            # refusing the whole file
+            value = f"{get('f')} {value}" if value is not None else str(
+                get("f")
+            )
+            f_name = "log"
+        else:
+            # a client op we cannot classify: silently dropping it would
+            # quietly weaken every checker consuming the history
+            raise EdnError(f"unknown op :f {get('f')!r}")
     time = get("time")
     index = get("index")
     return Op(
@@ -250,3 +262,52 @@ def read_history_edn(path: str | Path) -> list[Op]:
         for i, op in enumerate(ops):
             op.index = i
     return ops
+
+
+# ---------------------------------------------------------------------------
+# Export: our histories as jepsen-style EDN (so jepsen-ecosystem tooling —
+# Elle's CLI, jepsen.history utilities — can consume runs recorded here)
+# ---------------------------------------------------------------------------
+
+
+def _edn_value(v: Any) -> str:
+    if v is None:
+        return "nil"
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if isinstance(v, str):
+        body = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{body}"'
+    if isinstance(v, (list, tuple)):
+        return "[" + " ".join(_edn_value(x) for x in v) + "]"
+    raise TypeError(f"cannot EDN-encode {type(v).__name__}")
+
+
+def op_to_edn(op: Op) -> str:
+    parts = [
+        f":index {op.index}",
+        f":type :{op.type.name.lower()}",
+        f":f :{op.f.name.lower().replace('_', '-')}",
+        (
+            ":process :nemesis"
+            if op.process == NEMESIS_PROCESS
+            else f":process {op.process}"
+        ),
+        f":time {op.time}",
+    ]
+    if op.value is not None:
+        parts.append(f":value {_edn_value(op.value)}")
+    if op.error is not None:
+        parts.append(f":error {_edn_value(op.error)}")
+    return "{" + ", ".join(parts) + "}"
+
+
+def write_history_edn(path: str | Path, history) -> None:
+    """One op map per line (jepsen's streaming layout)."""
+    with open(path, "w") as fh:
+        for op in history:
+            fh.write(op_to_edn(op) + "\n")

@@ -97,7 +97,10 @@ class Store:
         return save_results(run_dir, results)
 
     def load_history(self, run_dir: str | Path) -> list[Op]:
-        return read_history_jsonl(Path(run_dir) / HISTORY_FILE)
+        d = Path(run_dir)
+        if not (d / HISTORY_FILE).exists() and (d / "history.edn").exists():
+            return read_history(d / "history.edn")
+        return read_history(d / HISTORY_FILE)
 
     def latest(self) -> Path | None:
         link = self.root / "latest"

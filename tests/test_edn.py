@@ -163,3 +163,52 @@ class TestHistoryImport:
         )
         assert r.returncode == 0, r.stdout + r.stderr
         assert "Everything looks good" in r.stdout
+
+    def test_export_roundtrip(self, tmp_path):
+        """Our histories export to jepsen-style EDN and re-import equal
+        (so jepsen-ecosystem tooling can consume runs recorded here)."""
+        from jepsen_tpu.history.edn import write_history_edn
+        from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+        h = synth_history(SynthSpec(n_ops=60, seed=4, lost=1))
+        p = tmp_path / "out.edn"
+        write_history_edn(p, h.ops)
+        back = read_history_edn(p)
+        assert back == list(h.ops)
+
+    def test_rich_nemesis_fs_import_as_log_rows(self, tmp_path):
+        """jepsen.nemesis.combined f's (:start-partition, :kill, ...) are
+        kept as nemesis log rows instead of refusing the file; unknown
+        CLIENT f's still raise."""
+        h = read_history_edn(
+            self._write(
+                tmp_path,
+                "{:type :info, :f :start-partition, :process :nemesis, "
+                ':value "majority"}\n'
+                "{:type :info, :f :kill, :process :nemesis}\n"
+                "{:type :invoke, :f :enqueue, :value 1, :process 0}\n"
+                "{:type :ok, :f :enqueue, :value 1, :process 0}\n"
+                "{:type :invoke, :f :drain, :process 1}\n"
+                "{:type :ok, :f :drain, :value [1], :process 1}\n",
+            )
+        )
+        assert len(h) == 6
+        assert h[0].f == OpF.LOG and "start-partition" in str(h[0].value)
+        assert h[1].f == OpF.LOG
+        from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+
+        assert check_total_queue_cpu(h)["valid?"] is True
+        with pytest.raises(EdnError):
+            read_history_edn(
+                self._write(
+                    tmp_path,
+                    "{:type :ok, :f :frobnicate, :process 3}",
+                    name="bad.edn",
+                )
+            )
+
+    @staticmethod
+    def _write(tmp_path, text, name="h.edn"):
+        p = tmp_path / name
+        p.write_text(text)
+        return p

@@ -404,7 +404,9 @@ def cmd_test(args) -> int:
             file=sys.stderr,
         )
         if run.run_dir is not None:  # a store artifact, like results.json
-            (run.run_dir / "live.json").write_text(
+            from jepsen_tpu.history.store import LIVE_FILE
+
+            (run.run_dir / LIVE_FILE).write_text(
                 json.dumps({"monitor": monitor.name, **snap}, indent=1)
             )
     print(json.dumps(run.results, indent=1, default=_json_default))

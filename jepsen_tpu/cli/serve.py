@@ -20,7 +20,7 @@ from pathlib import Path
 from urllib.parse import unquote
 
 from jepsen_tpu.checkers.protocol import UNKNOWN
-from jepsen_tpu.history.store import RESULTS_FILE
+from jepsen_tpu.history.store import LIVE_FILE, RESULTS_FILE
 
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>{title}</title>
@@ -50,16 +50,30 @@ def _runs(root: Path) -> list[dict]:
             results = run_dir / RESULTS_FILE
             if results.is_file():
                 try:
-                    v = json.loads(results.read_text()).get("valid?")
-                    valid = v if v == UNKNOWN else bool(v)
+                    data = json.loads(results.read_text())
+                    if isinstance(data, dict):
+                        v = data.get("valid?")
+                        valid = v if v == UNKNOWN else bool(v)
                 except (json.JSONDecodeError, OSError):
                     valid = None
+            live = None  # None = no monitor ran; else bool violation flag
+            live_file = run_dir / LIVE_FILE
+            if live_file.is_file():
+                try:
+                    data = json.loads(live_file.read_text())
+                    # a truncated/rewritten artifact must not 500 the
+                    # index: anything non-dict counts as "no monitor"
+                    if isinstance(data, dict):
+                        live = bool(data.get("violation-so-far"))
+                except (json.JSONDecodeError, OSError):
+                    live = None
             runs.append(
                 {
                     "test": test_dir.name,
                     "run": run_dir.name,
                     "rel": f"{test_dir.name}/{run_dir.name}",
                     "valid": valid,
+                    "live": live,
                 }
             )
     runs.sort(key=lambda r: r["run"], reverse=True)
@@ -75,14 +89,21 @@ def _index_page(root: Path) -> str:
             UNKNOWN: ("unknown", "unknown"),
             None: ("unknown", "?"),
         }[r["valid"]]
+        live_cls, live_txt = {
+            True: ("invalid", "flagged mid-run"),
+            False: ("valid", "clean"),
+            None: ("unknown", "&mdash;"),
+        }[r["live"]]
         rows.append(
             f'<tr><td><a href="/files/{html.escape(r["rel"])}/">'
             f'{html.escape(r["test"])}</a></td>'
             f'<td>{html.escape(r["run"])}</td>'
-            f'<td class="{cls}">{verdict}</td></tr>'
+            f'<td class="{cls}">{verdict}</td>'
+            f'<td class="{live_cls}">{live_txt}</td></tr>'
         )
     body = (
-        "<table><tr><th>test</th><th>run</th><th>verdict</th></tr>"
+        "<table><tr><th>test</th><th>run</th><th>verdict</th>"
+        "<th>live monitor</th></tr>"
         + "".join(rows)
         + "</table>"
         if rows

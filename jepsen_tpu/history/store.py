@@ -25,6 +25,7 @@ from jepsen_tpu.history.ops import Op
 
 HISTORY_FILE = "history.jsonl"
 RESULTS_FILE = "results.json"
+LIVE_FILE = "live.json"
 LOG_FILE = "jepsen.log"
 
 

@@ -104,3 +104,22 @@ def test_unknown_verdict_renders_as_unknown(tmp_path):
     page = _index_page(tmp_path)
     assert 'class="unknown">unknown' in page
     assert 'class="valid"' not in page
+
+
+def test_index_shows_live_monitor_column(tmp_path):
+    import json
+
+    from jepsen_tpu.cli.serve import _index_page
+
+    d = tmp_path / "t" / "20260730T000000"
+    d.mkdir(parents=True)
+    (d / "results.json").write_text('{"valid?": true}')
+    (d / "live.json").write_text(
+        json.dumps({"monitor": "live-total-queue", "violation-so-far": True})
+    )
+    page = _index_page(tmp_path)
+    assert "live monitor" in page and "flagged mid-run" in page
+    (d / "live.json").write_text(
+        json.dumps({"monitor": "live-total-queue", "violation-so-far": False})
+    )
+    assert "clean" in _index_page(tmp_path)

@@ -40,8 +40,8 @@ ELLE_BATCH = 8192  # txn graphs per device batch
 ELLE_TXNS = 64  # txns per graph
 
 INIT_ATTEMPTS = 3
-INIT_PROBE_DEADLINE_S = 60.0
-INIT_RETRY_SLEEP_S = 20.0
+INIT_PROBE_DEADLINE_S = 45.0  # a healthy tunnel answers devices() in ~5 s
+INIT_RETRY_SLEEP_S = 10.0
 
 
 def _init_backend_with_retry() -> str:

@@ -173,11 +173,13 @@ def cmd_bench_check(args) -> int:
 
     workload = getattr(args, "workload", "auto")
     if args.histories:
+        from jepsen_tpu.history.store import EDN_FILE
+
         paths = sorted(Path(args.histories).glob(f"**/{HISTORY_FILE}")) + [
             # an EDN twin beside a JSONL (e.g. an exported copy) is the
             # same run — don't load it twice
             p
-            for p in sorted(Path(args.histories).glob("**/history.edn"))
+            for p in sorted(Path(args.histories).glob(f"**/{EDN_FILE}"))
             if not (p.parent / HISTORY_FILE).exists()
         ]
         if not paths:
@@ -561,7 +563,11 @@ def cmd_synth(args) -> int:
         )
     for i, sh in enumerate(shs):
         d = store.run_dir("synth", f"{time.strftime('%Y%m%dT%H%M%S')}-{i:04d}")
-        store.save_history(d, sh.ops)
+        if getattr(args, "format", "jsonl") == "edn":
+            # jepsen's own on-disk layout: fixtures for its ecosystem
+            store.save_history_edn(d, sh.ops)
+        else:
+            store.save_history(d, sh.ops)
     print(f"wrote {len(shs)} histories under {args.store}")
     return 0
 
@@ -735,6 +741,13 @@ def build_parser() -> argparse.ArgumentParser:
     sc.set_defaults(fn=cmd_serve_checker)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
+    s.add_argument(
+        "--format",
+        choices=("jsonl", "edn"),
+        default="jsonl",
+        help="history file format (edn = jepsen's own layout, e.g. for "
+        "feeding jepsen-ecosystem tooling)",
+    )
     s.add_argument("--store", default="store", help="store root dir")
     s.add_argument(
         "--workload", choices=("queue", "stream", "elle"), default="queue"

@@ -34,6 +34,18 @@ _PAGE = """<!doctype html>
 </style></head><body><h1>{title}</h1>{body}</body></html>"""
 
 
+def _read_json_dict(path: Path) -> dict | None:
+    """Defensive artifact read: a truncated, rewritten, or non-object
+    JSON file must read as absent, never 500 the index page."""
+    if not path.is_file():
+        return None
+    try:
+        data = json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
 def _runs(root: Path) -> list[dict]:
     """Every run dir under ``root`` (test-name/timestamp layout), newest
     first, with its verdict when results.json exists."""
@@ -47,26 +59,14 @@ def _runs(root: Path) -> list[dict]:
             if not run_dir.is_dir() or run_dir.is_symlink():
                 continue
             valid = None  # True | False | "unknown" | None (no results)
-            results = run_dir / RESULTS_FILE
-            if results.is_file():
-                try:
-                    data = json.loads(results.read_text())
-                    if isinstance(data, dict):
-                        v = data.get("valid?")
-                        valid = v if v == UNKNOWN else bool(v)
-                except (json.JSONDecodeError, OSError):
-                    valid = None
+            data = _read_json_dict(run_dir / RESULTS_FILE)
+            if data is not None and "valid?" in data:
+                v = data["valid?"]
+                valid = v if v == UNKNOWN else bool(v)
             live = None  # None = no monitor ran; else bool violation flag
-            live_file = run_dir / LIVE_FILE
-            if live_file.is_file():
-                try:
-                    data = json.loads(live_file.read_text())
-                    # a truncated/rewritten artifact must not 500 the
-                    # index: anything non-dict counts as "no monitor"
-                    if isinstance(data, dict):
-                        live = bool(data.get("violation-so-far"))
-                except (json.JSONDecodeError, OSError):
-                    live = None
+            data = _read_json_dict(run_dir / LIVE_FILE)
+            if data is not None and "violation-so-far" in data:
+                live = bool(data["violation-so-far"])
             runs.append(
                 {
                     "test": test_dir.name,

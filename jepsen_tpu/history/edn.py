@@ -287,6 +287,20 @@ def _edn_value(v: Any) -> str:
     raise TypeError(f"cannot EDN-encode {type(v).__name__}")
 
 
+def _edn_micro_op(m: Any) -> str:
+    """``["append", k, v]`` → ``[:append k v]`` — jepsen/elle's own
+    micro-op shape (the kind is a keyword there, not a string)."""
+    if (
+        isinstance(m, (list, tuple))
+        and len(m) == 3
+        and isinstance(m[0], str)
+    ):
+        return (
+            f"[:{m[0]} {_edn_value(m[1])} {_edn_value(m[2])}]"
+        )
+    return _edn_value(m)
+
+
 def op_to_edn(op: Op) -> str:
     parts = [
         f":index {op.index}",
@@ -300,7 +314,11 @@ def op_to_edn(op: Op) -> str:
         f":time {op.time}",
     ]
     if op.value is not None:
-        parts.append(f":value {_edn_value(op.value)}")
+        if op.f.name == "TXN" and isinstance(op.value, (list, tuple)):
+            mops = " ".join(_edn_micro_op(m) for m in op.value)
+            parts.append(f":value [{mops}]")
+        else:
+            parts.append(f":value {_edn_value(op.value)}")
     if op.error is not None:
         parts.append(f":error {_edn_value(op.error)}")
     return "{" + ", ".join(parts) + "}"

@@ -26,6 +26,7 @@ from jepsen_tpu.history.ops import Op
 HISTORY_FILE = "history.jsonl"
 RESULTS_FILE = "results.json"
 LIVE_FILE = "live.json"
+EDN_FILE = "history.edn"
 LOG_FILE = "jepsen.log"
 
 
@@ -94,13 +95,22 @@ class Store:
         self.link_run(run_dir.parent.name, run_dir)
         return p
 
+    def save_history_edn(self, run_dir: Path, history: Sequence[Op]) -> Path:
+        """Same write-then-link choreography, jepsen's own layout."""
+        from jepsen_tpu.history.edn import write_history_edn
+
+        p = run_dir / EDN_FILE
+        write_history_edn(p, history)
+        self.link_run(run_dir.parent.name, run_dir)
+        return p
+
     def save_results(self, run_dir: Path, results: dict[str, Any]) -> Path:
         return save_results(run_dir, results)
 
     def load_history(self, run_dir: str | Path) -> list[Op]:
         d = Path(run_dir)
-        if not (d / HISTORY_FILE).exists() and (d / "history.edn").exists():
-            return read_history(d / "history.edn")
+        if not (d / HISTORY_FILE).exists() and (d / EDN_FILE).exists():
+            return read_history(d / EDN_FILE)
         return read_history(d / HISTORY_FILE)
 
     def latest(self) -> Path | None:

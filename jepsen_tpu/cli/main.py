@@ -193,13 +193,6 @@ def cmd_bench_check(args) -> int:
         kinds = [_workload_of(h) for h in histories]
         if workload == "auto":
             workload = max(sorted(set(kinds)), key=kinds.count)
-        if workload == "mutex":
-            print(
-                "bench-check has no batched path for the mutex family "
-                "(general-model search; use `check --workload mutex`)",
-                file=sys.stderr,
-            )
-            return 2
         keep = [h for h, kind in zip(histories, kinds) if kind == workload]
         if len(keep) != len(histories):
             print(
@@ -239,6 +232,20 @@ def cmd_bench_check(args) -> int:
                     g2_cycle=1,
                 )
             ]
+        elif workload == "mutex":
+            from jepsen_tpu.history.synth import (
+                MutexSynthSpec,
+                synth_mutex_batch,
+            )
+
+            histories = [
+                sh.ops
+                for sh in synth_mutex_batch(
+                    args.count,
+                    MutexSynthSpec(n_ops=args.ops),
+                    double_grant=1,
+                )
+            ]
         else:
             workload = "queue"
             from jepsen_tpu.history.synth import SynthSpec, synth_batch
@@ -272,6 +279,25 @@ def cmd_bench_check(args) -> int:
         jax.block_until_ready(sl)
         t_check = time.perf_counter() - t1
         n_invalid = int((~sl.valid).sum())
+    elif workload == "mutex":
+        # the batched frontier-bitset WGL search itself (owned-mutex
+        # model): one vmapped XLA program over all histories
+        from jepsen_tpu.checkers.wgl import (
+            mutex_wgl_ops,
+            pack_wgl_batch,
+            wgl_tensor_check,
+        )
+        from jepsen_tpu.models.core import OwnedMutex
+
+        t0 = time.perf_counter()
+        packed = pack_wgl_batch([mutex_wgl_ops(h) for h in histories])
+        t_pack = time.perf_counter() - t0
+        wgl_tensor_check(packed, (OwnedMutex, ()))  # compile
+        t1 = time.perf_counter()
+        ok, unknown = wgl_tensor_check(packed, (OwnedMutex, ()))
+        t_check = time.perf_counter() - t1
+        n_invalid = int((~ok & ~unknown).sum())
+        n_unknown = int(unknown.sum())
     elif workload == "elle":
         import numpy as np
 
@@ -313,17 +339,24 @@ def cmd_bench_check(args) -> int:
     # comparable across families
     ops_per_history = (
         max(len(h) for h in histories)
-        if workload == "elle"
+        if workload in ("elle", "mutex")
         else packed.length
     )
+    n_hist = len(histories)
+    stats_extra = {}
+    if workload == "mutex":
+        # tri-state honesty: a frontier overflow is undecided, which is
+        # neither a pass nor a violation — surface it
+        stats_extra["unknown"] = n_unknown
     print(
         json.dumps(
             {
-                "histories": packed.batch,
+                "histories": n_hist,
+                **stats_extra,
                 "ops_per_history": ops_per_history,
                 "pack_s": round(t_pack, 3),
                 "check_s": round(t_check, 5),
-                "histories_per_sec": round(packed.batch / max(t_check, 1e-9), 1),
+                "histories_per_sec": round(n_hist / max(t_check, 1e-9), 1),
                 "invalid": n_invalid,
                 "backend": jax.default_backend(),
             }
@@ -551,6 +584,14 @@ def cmd_synth(args) -> int:
             g1c_cycle=args.g1c_cycle,
             g2_cycle=args.g2_cycle,
         )
+    elif getattr(args, "workload", "queue") == "mutex":
+        from jepsen_tpu.history.synth import MutexSynthSpec, synth_mutex_batch
+
+        shs = synth_mutex_batch(
+            args.count,
+            MutexSynthSpec(n_ops=args.ops),
+            double_grant=args.double_grant,
+        )
     else:
         from jepsen_tpu.history.synth import SynthSpec, synth_batch
 
@@ -609,7 +650,7 @@ def build_parser() -> argparse.ArgumentParser:
     b.add_argument("--ops", type=int, default=470, help="invocations per history")
     b.add_argument(
         "--workload",
-        choices=("auto", "queue", "stream", "elle"),
+        choices=("auto", "queue", "stream", "elle", "mutex"),
         default="auto",
     )
     b.add_argument(
@@ -750,7 +791,9 @@ def build_parser() -> argparse.ArgumentParser:
     )
     s.add_argument("--store", default="store", help="store root dir")
     s.add_argument(
-        "--workload", choices=("queue", "stream", "elle"), default="queue"
+        "--workload",
+        choices=("queue", "stream", "elle", "mutex"),
+        default="queue",
     )
     s.add_argument("--count", type=int, default=16)
     s.add_argument("--ops", type=int, default=470)
@@ -764,6 +807,9 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--g0-cycle", type=int, default=0, help="elle workload")
     s.add_argument("--g1c-cycle", type=int, default=0, help="elle workload")
     s.add_argument("--g2-cycle", type=int, default=0, help="elle workload")
+    s.add_argument(
+        "--double-grant", type=int, default=0, help="mutex workload"
+    )
     s.set_defaults(fn=cmd_synth)
 
     return p

@@ -657,3 +657,122 @@ def synth_stream_batch(
         kw = {**base.__dict__, **overrides, "seed": base.seed + i}
         out.append(synth_stream_history(StreamSynthSpec(**kw)))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Mutex (distributed lock) histories — the reference's legacy variant
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class MutexSynthSpec:
+    """Lock-contention workload: processes race acquire/release against a
+    correct lock service; ``double_grant`` injects split-brain grants (an
+    acquire honored while the lock is certainly held — the violation the
+    owned-mutex WGL search must refute)."""
+
+    n_processes: int = 5
+    n_ops: int = 200  # acquire/release invocations
+    p_info: float = 0.03  # indeterminate outcome; effect coin-flipped
+    mean_latency_ns: int = 2_000_000
+    seed: int = 0
+    double_grant: int = 0
+
+
+@dataclass
+class MutexSynthHistory:
+    ops: list[Op]
+    double_grant: int = 0  # ground truth: injected split-brain grants
+
+    @property
+    def clean(self) -> bool:
+        return not self.double_grant
+
+
+def synth_mutex_history(spec: MutexSynthSpec) -> MutexSynthHistory:
+    rng = random.Random(spec.seed)
+    clock = 0
+    holder: int | None = None
+    # a hold is CERTAIN only when established by an OK grant by a process
+    # with NO indeterminate release anywhere in its past: a pending INFO
+    # release (ret = ∞) may linearize at ANY later point — including
+    # inside a hold its process takes afterwards — silently freeing the
+    # lock and making an injected "double grant" legally linearizable
+    # (seed-34 counterexample from review).  INFO acquires never free a
+    # lock, so they only degrade certainty when they may have TAKEN it.
+    certain = False
+    info_release_ever: set[int] = set()
+    ops: list[Op] = []
+    out = MutexSynthHistory(ops=ops)
+    to_inject = spec.double_grant
+
+    def tick() -> int:
+        nonlocal clock
+        clock += rng.randint(100_000, 2_000_000)
+        return clock
+
+    def lat() -> int:
+        return max(1, int(rng.expovariate(1.0 / spec.mean_latency_ns)))
+
+    for _ in range(spec.n_ops):
+        p = rng.randrange(spec.n_processes)
+        f = rng.choice((OpF.ACQUIRE, OpF.RELEASE))
+        t0 = tick()
+        inv = Op.invoke(f, p, time=t0)
+        ops.append(inv)
+        done = t0 + lat()
+        if rng.random() < spec.p_info:
+            # indeterminate: the effect happens on a coin flip; either
+            # way the op MIGHT have happened, so certainty degrades
+            if f == OpF.ACQUIRE:
+                if holder is None:
+                    if rng.random() < 0.5:
+                        holder = p
+                    certain = False
+            else:
+                info_release_ever.add(p)
+                if holder == p:
+                    if rng.random() < 0.5:
+                        holder = None
+                    certain = False
+            ops.append(inv.complete(OpType.INFO, time=done, error="timeout"))
+            continue
+        if f == OpF.ACQUIRE:
+            if holder is None:
+                holder = p
+                certain = p not in info_release_ever
+                ops.append(inv.complete(OpType.OK, time=done))
+            elif to_inject > 0 and holder != p and certain:
+                # injected split-brain: granted while CERTAINLY held —
+                # guaranteed non-linearizable (no pending op can explain
+                # the overlap)
+                to_inject -= 1
+                out.double_grant += 1
+                holder = p
+                certain = p not in info_release_ever
+                ops.append(inv.complete(OpType.OK, time=done))
+            else:
+                ops.append(
+                    inv.complete(OpType.FAIL, time=done, error="held")
+                )
+        else:
+            if holder == p:
+                holder = None
+                ops.append(inv.complete(OpType.OK, time=done))
+            else:
+                ops.append(
+                    inv.complete(OpType.FAIL, time=done, error="not-held")
+                )
+    reindex(ops)
+    return out
+
+
+def synth_mutex_batch(
+    n: int, base: MutexSynthSpec | None = None, **overrides: Any
+) -> list[MutexSynthHistory]:
+    base = base or MutexSynthSpec()
+    out = []
+    for i in range(n):
+        kw = {**base.__dict__, **overrides, "seed": base.seed + i}
+        out.append(synth_mutex_history(MutexSynthSpec(**kw)))
+    return out

@@ -136,3 +136,25 @@ def test_bench_check_elle_counts_host_anomalies(tmp_path, capsys):
     rc = main(["bench-check", "--histories", str(tmp_path)])
     stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0 and stats["invalid"] == 2
+
+
+def test_synth_and_bench_check_mutex(tmp_path, capsys):
+    """The mutex family has the full synth → store → check → bench-check
+    pipeline like every other workload (batched WGL tensor search)."""
+    store = tmp_path / "s"
+    rc = main(
+        [
+            "synth", "--workload", "mutex", "--count", "2", "--ops", "50",
+            "--double-grant", "1", "--store", str(store),
+        ]
+    )
+    assert rc == 0
+    rc = main(["check", "--checker", "cpu", str(store)])
+    out = capsys.readouterr().out
+    # the refutation verdict, not just a nonzero exit
+    assert rc == 1 and '"valid?": false' in out and "Analysis invalid" in out
+    rc = main(["bench-check", "--histories", str(store)])
+    stats = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, stats
+    assert stats["histories"] == 2 and stats["invalid"] >= 1
+    assert stats["unknown"] == 0

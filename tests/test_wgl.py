@@ -370,3 +370,30 @@ def test_fifo_wgl_autosizes_capacity():
     bad[-3:] = [iv1, iv1.complete(OpType.OK, value=40)]  # value never enqueued
     r = FifoWgl(backend="cpu").check({}, reindex(bad))
     assert r["valid?"] is False
+
+
+def test_synth_mutex_differential():
+    """Mutex synth ground truth matches both WGL engines: clean batches
+    are linearizable, injected double grants are refuted."""
+    from jepsen_tpu.checkers.wgl import (
+        MutexWgl,
+        mutex_wgl_ops,
+        pack_wgl_batch,
+        wgl_tensor_check,
+    )
+    from jepsen_tpu.history.synth import MutexSynthSpec, synth_mutex_batch
+    from jepsen_tpu.models.core import OwnedMutex
+
+    clean = synth_mutex_batch(4, MutexSynthSpec(n_ops=80))
+    bad = synth_mutex_batch(4, MutexSynthSpec(n_ops=80), double_grant=1)
+    assert all(s.clean for s in clean)
+    assert all(s.double_grant == 1 for s in bad)
+    batch = pack_wgl_batch(
+        [mutex_wgl_ops(s.ops) for s in clean + bad]
+    )
+    ok, unknown = wgl_tensor_check(batch, (OwnedMutex, ()))
+    for i, s in enumerate(clean + bad):
+        cpu = MutexWgl(backend="cpu").check({}, s.ops)
+        assert cpu["valid?"] is s.clean, (i, cpu)
+        if not unknown[i]:
+            assert bool(ok[i]) is s.clean, i

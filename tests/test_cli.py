@@ -158,3 +158,24 @@ def test_synth_and_bench_check_mutex(tmp_path, capsys):
     assert rc == 0, stats
     assert stats["histories"] == 2 and stats["invalid"] >= 1
     assert stats["unknown"] == 0
+
+
+def test_live_check_flag_reports_and_persists(tmp_path, capsys):
+    """--live-check attaches the workload's monitor, prints the summary
+    line, and persists live.json beside results.json."""
+    rc = main(
+        [
+            "test", "--db", "sim", "--workload", "queue", "--live-check",
+            "--time-limit", "1", "--rate", "100",
+            "--recovery-sleep", "0.1",
+            "--store", str(tmp_path / "s"),
+        ]
+    )
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "live monitor (live-total-queue)" in err
+    live = list((tmp_path / "s").glob("**/live.json"))
+    assert len(live) == 1
+    data = json.loads(live[0].read_text())
+    assert data["monitor"] == "live-total-queue"
+    assert data["violation-so-far"] is False

@@ -337,8 +337,25 @@ def main() -> None:
             )
 
     try:
-        with open("BENCH_DETAILS.json", "w") as fh:
-            json.dump(details, fh, indent=1)
+        keep_existing = False
+        if details["backend"] != "tpu":
+            # a CPU-fallback run must not clobber the last CHIP-measured
+            # details file — the stdout JSON line still records this
+            # run's (labeled) numbers for the round artifact
+            try:
+                with open("BENCH_DETAILS.json") as fh:
+                    keep_existing = json.load(fh).get("backend") == "tpu"
+            except (OSError, ValueError, AttributeError):
+                keep_existing = False
+        if keep_existing:
+            print(
+                "# BENCH_DETAILS.json holds chip-measured numbers; "
+                "leaving it untouched (this run was a CPU fallback)",
+                file=sys.stderr,
+            )
+        else:
+            with open("BENCH_DETAILS.json", "w") as fh:
+                json.dump(details, fh, indent=1)
     except OSError as e:  # pragma: no cover - read-only cwd
         print(f"# could not write BENCH_DETAILS.json: {e}", file=sys.stderr)
 

@@ -9,9 +9,10 @@ run/analysis lifecycle whose console output the CI triage greps —
 
 Subcommands:
 
-- ``test``        — run a quorum-queue partition test (all the reference's
-                    flags; ``--db sim`` for the in-process cluster,
-                    ``--db rabbitmq`` once the SSH control plane lands).
+- ``test``        — run a partition test for any of the four workload
+                    families (all the reference's flags; ``--db sim`` for
+                    the in-process cluster, ``--db rabbitmq`` for a real
+                    cluster over the SSH control plane).
 - ``check``       — re-check a recorded history (``--checker tpu|cpu``);
                     the ``--checker`` dispatch point is the north-star seam.
 - ``bench-check`` — batched replay: verify many stored/synthetic histories
@@ -383,6 +384,7 @@ def cmd_test(args) -> int:
         "network-partition": args.network_partition,
         "nemesis": args.nemesis,
         "publish-confirm-timeout": args.publish_confirm_timeout / 1000.0,
+        "full-read-confirm-empties": args.full_read_confirm_empties,
         "recovery-sleep": args.recovery_sleep,
         "consumer-type": args.consumer_type,
         "net-ticktime": args.net_ticktime,
@@ -708,6 +710,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"
+    )
+    t.add_argument(
+        "--full-read-confirm-empties",
+        type=int,
+        default=1,
+        help="stream workload: extra empty read batches required to "
+        "conclude end-of-log on the final read when no offset proof is "
+        "available (the x-stream-offset=last probe is tried first)",
     )
     t.add_argument("--recovery-sleep", type=float, default=20.0)
     t.add_argument(

@@ -113,6 +113,8 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int),  # values out
         ctypes.c_long,  # cap
     ]
+    lib.amqp_stream_last_offset.restype = ctypes.c_longlong
+    lib.amqp_stream_last_offset.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.amqp_stream_reconnect.argtypes = [ctypes.c_void_p]
     lib.amqp_stream_close.argtypes = [ctypes.c_void_p]
     lib.amqp_stream_destroy.argtypes = [ctypes.c_void_p]
@@ -295,6 +297,16 @@ class NativeStreamDriver(StreamDriver):
         if n < 0:
             raise ConnectionError("stream read failed (connection error)")
         return [[int(offs[i]), int(vals[i])] for i in range(n)]
+
+    def last_offset(self, timeout_s: float) -> int:
+        """Last committed offset via the ``x-stream-offset="last"`` probe;
+        ``-1`` = unknown (empty log or no delivery within the timeout)."""
+        r = self.lib.amqp_stream_last_offset(
+            self.handle, int(timeout_s * 1000)
+        )
+        if r == -2:
+            raise ConnectionError("last-offset probe failed (connection)")
+        return int(r)
 
     def reconnect(self) -> None:
         if self.lib.amqp_stream_reconnect(self.handle) != 0:

@@ -19,6 +19,7 @@ driver results and exceptions onto op completions:
 from __future__ import annotations
 
 import abc
+import time
 from typing import Any, Mapping, Sequence
 
 from jepsen_tpu.history.ops import FULL_READ, Op, OpF, OpType
@@ -157,6 +158,14 @@ class StreamDriver(abc.ABC):
         """Up to ``max_n`` ``(offset, value)`` records starting at
         ``offset``; empty list when nothing is committed there yet."""
 
+    def last_offset(self, timeout_s: float) -> int:
+        """The log's last committed offset (an ``x-stream-offset="last"``
+        consumer probe), or ``-1`` when unknown — empty log, stalled
+        broker, or a driver without the probe (this default).  The
+        full-read path uses it as the end-of-log *proof*; ``-1`` falls
+        back to the confirmed-empties heuristic."""
+        return -1
+
     @abc.abstractmethod
     def reconnect(self) -> None: ...
 
@@ -176,13 +185,19 @@ class StreamClient(Client):
         read_timeout_s: float = 5.0,
         read_batch: int = 8,
         full_read_confirm_empties: int = 1,
+        full_read_stall_timeout_s: float = 60.0,
     ):
         self.driver_factory = driver_factory
         self.publish_confirm_timeout_s = publish_confirm_timeout_s
         self.read_timeout_s = read_timeout_s
         self.read_batch = read_batch
-        # extra empty batches required to conclude end-of-log on FULL_READ
+        # fallback only (no offset proof available): extra empty batches
+        # required to conclude end-of-log on FULL_READ
         self.full_read_confirm_empties = full_read_confirm_empties
+        # with an offset proof pending (cursor short of a known last
+        # offset), how long a stall may hold the full read before it
+        # *fails* — failing is sound (absent final read), truncating is not
+        self.full_read_stall_timeout_s = full_read_stall_timeout_s
         self.driver: StreamDriver | None = None
         self.cursor = 0
 
@@ -193,6 +208,7 @@ class StreamClient(Client):
             self.read_timeout_s,
             self.read_batch,
             self.full_read_confirm_empties,
+            self.full_read_stall_timeout_s,
         )
         c.driver = self.driver_factory(test, node)
         return c
@@ -211,25 +227,7 @@ class StreamClient(Client):
                 return op.complete(OpType.OK if ok else OpType.FAIL)
             if op.f == OpF.READ:
                 if op.value == FULL_READ:
-                    # offsets need not be dense (chunk boundaries,
-                    # retention): advance by last offset + 1, never count.
-                    # End-of-log must be *confirmed*, not inferred from one
-                    # empty batch: a broker stall longer than the read
-                    # timeout mid-log would otherwise truncate the final
-                    # read and turn acked-but-unread values into false
-                    # "lost" verdicts.
-                    pairs: list = []
-                    nxt = 0
-                    empties = 0
-                    while empties <= self.full_read_confirm_empties:
-                        batch = d.read_from(nxt, 4096, self.read_timeout_s)
-                        if not batch:
-                            empties += 1
-                            continue
-                        empties = 0
-                        pairs.extend([list(p) for p in batch])
-                        nxt = batch[-1][0] + 1
-                    return op.complete(OpType.OK, value=pairs)
+                    return op.complete(OpType.OK, value=self._full_read(d))
                 batch = d.read_from(
                     self.cursor, self.read_batch, self.read_timeout_s
                 )
@@ -242,6 +240,76 @@ class StreamClient(Client):
             raise ValueError(f"unknown client op {op.f}")
 
         return _guard(d, op, apply, indeterminate=op.f == OpF.APPEND)
+
+    def _full_read(self, d: StreamDriver) -> list:
+        """Read the whole log from offset 0, with an *offset-proof* end:
+        conclude end-of-log only once the cursor has passed the log's last
+        committed offset (``last_offset`` — the ``x-stream-offset="last"``
+        probe).  A mid-read broker stall, however long, can then never
+        truncate the read and turn acked-but-unread values into false
+        "lost" verdicts: with the proof pending the loop retries until
+        ``full_read_stall_timeout_s`` and then *fails* the op (an absent
+        final read is sound; a truncated one is not).  Offsets need not be
+        dense (chunk boundaries, retention): advance by last offset + 1,
+        never count.  Only when no proof is available (empty log, or a
+        driver without the probe) does the old confirmed-empties
+        heuristic decide."""
+        pairs: list = []
+        nxt = 0
+        empties = 0
+        reprobed = False
+        last = d.last_offset(self.read_timeout_s)  # -1 = unknown
+        # the deadline bounds the current STALL, not the whole read: it is
+        # re-armed on every batch of progress, so a long log can never
+        # exhaust it while still moving
+        deadline = time.monotonic() + self.full_read_stall_timeout_s
+        while True:
+            batch = d.read_from(nxt, 4096, self.read_timeout_s)
+            if batch:
+                empties = 0
+                pairs.extend([list(p) for p in batch])
+                nxt = batch[-1][0] + 1
+                deadline = (
+                    time.monotonic() + self.full_read_stall_timeout_s
+                )
+                continue
+            if last >= 0:
+                if nxt > last:
+                    # proven past the known end — re-probe so appends
+                    # committed mid-read are not silently skipped; an
+                    # unanswered probe (-1) is INCONCLUSIVE, not proof,
+                    # so it retries under the stall deadline
+                    confirm = d.last_offset(self.read_timeout_s)
+                    if 0 <= confirm <= last:
+                        return pairs
+                    if confirm > last:
+                        last = confirm
+                        continue
+                    if time.monotonic() >= deadline:
+                        raise DriverTimeout(
+                            f"full read reached offset {nxt} but the "
+                            f"end-of-log confirm probe never answered"
+                        )
+                    continue
+                # cursor short of the known end: a stall, NOT end-of-log
+                if time.monotonic() >= deadline:
+                    raise DriverTimeout(
+                        f"full read stalled at offset {nxt} with committed "
+                        f"records through {last} still unread"
+                    )
+                continue
+            # no proof available: re-probe once (the upfront probe may
+            # have raced the broker coming back), then let the
+            # confirmed-empties heuristic decide — probing before every
+            # counted empty would multiply empty-log drain latency
+            if not reprobed:
+                reprobed = True
+                last = d.last_offset(self.read_timeout_s)
+                if last >= 0:
+                    continue
+            empties += 1
+            if empties > self.full_read_confirm_empties:
+                return pairs
 
     def close(self, test):
         if self.driver is not None:

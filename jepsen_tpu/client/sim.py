@@ -257,6 +257,15 @@ class SimCluster:
                 for o in range(offset, min(offset + max_n, len(self.log)))
             ]
 
+    def stream_last_offset(self, node: str) -> int:
+        """Last committed offset (the ``x-stream-offset="last"`` probe);
+        ``-1`` when the log is empty or the node cannot answer (minority —
+        the probe is *unknown* there, not an error)."""
+        with self.lock:
+            if not self._has_majority(node):
+                return -1
+            return len(self.log) - 1
+
     # ---- transactional ops (kv of lists, list-append) ----------------------
     def txn(self, node: str, micro_ops: list) -> list:
         with self.lock:
@@ -335,6 +344,9 @@ class SimStreamDriver(StreamDriver):
 
     def read_from(self, offset: int, max_n: int, timeout_s: float) -> list:
         return self.cluster.stream_read(self.node, offset, max_n)
+
+    def last_offset(self, timeout_s: float) -> int:
+        return self.cluster.stream_last_offset(self.node)
 
     def reconnect(self) -> None:
         pass

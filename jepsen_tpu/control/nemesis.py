@@ -129,11 +129,19 @@ class ProcessNemesis:
 
     def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
         if op.f == OpF.START:
-            victim = self.rng.choice(self.nodes)
-            if victim not in self.victims:
-                (self.procs.kill if self.mode == "kill"
-                 else self.procs.pause)(victim)
-                self.victims.append(victim)
+            # pick among nodes still up: consecutive starts must inject a
+            # new fault, and the history must never claim "kill n" for a
+            # node that was already down
+            up = [n for n in self.nodes if n not in self.victims]
+            if not up:
+                logger.info("nemesis: all nodes already %sed", self.mode)
+                return op.complete(
+                    OpType.INFO, value=f"already-down {self.victims}"
+                )
+            victim = self.rng.choice(up)
+            (self.procs.kill if self.mode == "kill"
+             else self.procs.pause)(victim)
+            self.victims.append(victim)
             logger.info("nemesis: %s %s", self.mode, victim)
             return op.complete(OpType.INFO, value=f"{self.mode} {victim}")
         if op.f == OpF.STOP:

@@ -210,8 +210,19 @@ def op_from_edn(m: dict) -> Op:
     if type_name not in _TYPE_BY_NAME:
         raise EdnError(f"unknown op :type {get('type')!r}")
     proc = get("process")
-    if isinstance(proc, Keyword) or proc is None:
-        proc = NEMESIS_PROCESS  # :nemesis
+    if isinstance(proc, Keyword):
+        # only :nemesis names the pseudo-process; any other keyword is a
+        # history this reader does not understand, not a nemesis op
+        if str(proc) != "nemesis":
+            raise EdnError(f"unknown keyword :process :{proc}")
+        proc = NEMESIS_PROCESS
+    elif proc is None:
+        proc = NEMESIS_PROCESS  # jepsen's nemesis rows may omit :process
+    elif isinstance(proc, bool) or not isinstance(proc, int):
+        # the parser yields ints for integer tokens; anything else
+        # (float, symbol/string) is a history this reader must refuse —
+        # int() coercion would silently mis-attribute the op
+        raise EdnError(f"non-integer op :process {proc!r}")
     value = _to_plain(get("value"))
     if f_name not in _F_BY_NAME:
         if int(proc) == NEMESIS_PROCESS:
@@ -280,7 +291,16 @@ def _edn_value(v: Any) -> str:
     if isinstance(v, (int, float)):
         return repr(v)
     if isinstance(v, str):
-        body = v.replace("\\", "\\\\").replace('"', '\\"')
+        # control chars must be escaped or a multi-line error string (e.g.
+        # a client-crash backtrace) breaks write_history_edn's documented
+        # one-op-per-line streaming layout for line-oriented consumers
+        body = (
+            v.replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+            .replace("\r", "\\r")
+            .replace("\t", "\\t")
+        )
         return f'"{body}"'
     if isinstance(v, (list, tuple)):
         return "[" + " ".join(_edn_value(x) for x in v) + "]"

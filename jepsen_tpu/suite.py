@@ -61,6 +61,10 @@ DEFAULT_OPTS: dict[str, Any] = {
     "network-partition": "partition-random-halves",
     "nemesis": "partition",  # or kill-random-node / pause-random-node
     "publish-confirm-timeout": 5.0,  # seconds (5000 ms in the reference)
+    # stream final read: extra empty batches confirming end-of-log when no
+    # offset proof is available (the x-stream-offset="last" probe is the
+    # primary mechanism; this is the fallback heuristic's strictness)
+    "full-read-confirm-empties": 1,
     "recovery-sleep": 20.0,  # gen/sleep 20 before drain
     "consumer-type": "polling",
     "net-ticktime": 15,
@@ -260,6 +264,7 @@ def build_sim_test(
         client = StreamClient(
             sim_stream_driver_factory(cluster),
             publish_confirm_timeout_s=o["publish-confirm-timeout"],
+            full_read_confirm_empties=o["full-read-confirm-empties"],
         )
         generator = stream_generator(o)
         checker = stream_checker(checker_backend)
@@ -346,6 +351,7 @@ def build_rabbitmq_test(
         client = StreamClient(
             native_stream_driver_factory(),
             publish_confirm_timeout_s=o["publish-confirm-timeout"],
+            full_read_confirm_empties=o["full-read-confirm-empties"],
         )
         generator = stream_generator(o)
         checker = stream_checker(checker_backend)

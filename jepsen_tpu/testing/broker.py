@@ -356,7 +356,22 @@ class MiniAmqpBroker:
                     cargs = r.table()
                     self._send_method(conn, ch, 60, 21, _shortstr(ctag))
                     if qname in self.streams:
-                        offset = int(cargs.get("x-stream-offset", 0))
+                        # offset spec: an absolute int64, or the string
+                        # specs "first" (0) / "last" (the final chunk ≡
+                        # the final record here) / "next" (past the
+                        # current end; this broker's stream consumers are
+                        # one-shot snapshots, so "next" delivers nothing —
+                        # unlike real RabbitMQ, which would push appends
+                        # committed after the subscribe)
+                        spec = cargs.get("x-stream-offset", 0)
+                        if spec == "first":
+                            offset = 0
+                        elif spec in ("last", "next"):
+                            with self.state_lock:
+                                n = len(self.streams.get(qname, ()))
+                            offset = n - 1 if spec == "last" and n else n
+                        else:
+                            offset = int(spec)
                         self._stream_deliver(conn, ch, qname, offset, ctag)
                     else:
                         conn.consuming_queue = qname

@@ -1097,6 +1097,59 @@ class StreamClient {
                              values_out, cap);
   }
 
+  // The log's last committed offset, probed with an
+  // x-stream-offset="last" consumer (the string spec attaches at the
+  // final chunk; the max delivered offset is the answer).  Returns the
+  // offset (>=0), -1 when nothing was delivered within the timeout
+  // (empty log OR a stalled broker — the caller must treat -1 as
+  // unknown, never as proof of emptiness), -2 on error.
+  //
+  // Honesty note: AMQP 0-9-1 has no authoritative end-of-log marker, so
+  // a broker that stalls >quiet_ms mid-final-chunk can still understate
+  // the answer.  The proof this provides is therefore probabilistic but
+  // strong: truncating the full read now needs *correlated* stalls at
+  // the same boundary in the read AND both probes (the client probes
+  // before and after), where the old empties heuristic needed a single
+  // stall of ~2x the read timeout anywhere.  quiet_ms is double the
+  // read path's: an understated probe is worse than a slow one.
+  int64_t last_offset(int timeout_ms) {
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    c->clear_deliveries();
+    amqp::Table args;
+    args.put_str("x-stream-offset", "last");
+    if (!c->start_consumer(STREAM_QUEUE_NAME, 100, &args, "jt-stream-last"))
+      return -2;
+    int64_t last = -1;
+    auto deadline = Clock::now() + milliseconds(timeout_ms);
+    const int quiet_ms = 500;
+    for (;;) {
+      auto now = Clock::now();
+      if (now >= deadline) break;
+      int wait_ms = static_cast<int>(
+          std::chrono::duration_cast<milliseconds>(deadline - now).count());
+      if (last >= 0) wait_ms = std::min(wait_ms, quiet_ms);
+      Delivery d;
+      int r = c->pop_delivery(&d, wait_ms);
+      if (r == 1) {
+        c->basic_ack(d.tag);
+        if (d.offset > last) last = d.offset;
+      } else if (r == -1) {
+        break;  // deadline or quiet window elapsed
+      } else {
+        // connection error mid-probe: a partially-collected max is NOT
+        // "the last committed offset" — presenting it would let the
+        // client conclude end-of-log short of the truth
+        c->cancel_consumer("jt-stream-last");
+        return -2;
+      }
+    }
+    c->cancel_consumer("jt-stream-last");
+    c->clear_deliveries();
+    return last;
+  }
+
   void close_connection() {
     std::shared_ptr<Connection> c;
     {
@@ -1634,6 +1687,10 @@ long amqp_stream_read_from(void* p, long long offset, long max_n,
   return static_cast<StreamClient*>(p)->read_from(
       offset, max_n, timeout_ms,
       reinterpret_cast<int64_t*>(offsets_out), values_out, cap);
+}
+
+long long amqp_stream_last_offset(void* p, int timeout_ms) {
+  return static_cast<StreamClient*>(p)->last_offset(timeout_ms);
 }
 
 int amqp_stream_reconnect(void* p) {

@@ -325,6 +325,36 @@ def test_process_nemesis_start_stop_cycle():
     assert procs.calls[-1][0] == "restart" and not nem.victims
 
 
+def test_process_nemesis_consecutive_starts_pick_fresh_victims():
+    """Consecutive starts must each inject a NEW fault (never re-kill an
+    already-down node and claim 'kill n' in the history); once every node
+    is down, the op records 'already-down' instead of a fresh kill."""
+    from jepsen_tpu.control.nemesis import ProcessNemesis
+    from jepsen_tpu.history.ops import Op, OpF
+
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def kill(self, n):
+            self.calls.append(("kill", n))
+
+        def restart(self, n):
+            self.calls.append(("restart", n))
+
+    procs = Log()
+    nem = ProcessNemesis("kill", procs, NODES, seed=0)
+    start = Op.invoke(OpF.START, -1)
+    victims = [nem.invoke({}, start).value.split()[1] for _ in NODES]
+    assert sorted(victims) == sorted(NODES)  # each start hit a fresh node
+    assert [c for c in procs.calls if c[0] == "kill"] == [
+        ("kill", v) for v in victims
+    ]
+    r = nem.invoke({}, start)  # all down now
+    assert r.value.startswith("already-down")
+    assert len([c for c in procs.calls if c[0] == "kill"]) == len(NODES)
+
+
 def test_make_nemesis_selection():
     from jepsen_tpu.control.nemesis import (
         PartitionNemesis,

@@ -90,6 +90,29 @@ class TestOpMapping:
                 parse_edn_forms("{:type :ok, :f :frobnicate, :process 0}")[0]
             )
 
+    def test_non_nemesis_keyword_process_raises(self):
+        """Only :nemesis names the pseudo-process; any other keyword (or a
+        symbol/string) must raise EdnError, not silently become nemesis."""
+        with pytest.raises(EdnError, match="keyword :process"):
+            op_from_edn(
+                parse_edn_forms(
+                    "{:type :ok, :f :enqueue, :value 1, :process :writer}"
+                )[0]
+            )
+        with pytest.raises(EdnError, match="non-integer"):
+            op_from_edn(
+                parse_edn_forms(
+                    '{:type :ok, :f :enqueue, :value 1, :process "w3"}'
+                )[0]
+            )
+        # a float is refused too, never silently truncated to an int
+        with pytest.raises(EdnError, match="non-integer"):
+            op_from_edn(
+                parse_edn_forms(
+                    "{:type :ok, :f :enqueue, :value 1, :process 1.5}"
+                )[0]
+            )
+
 
 JEPSEN_STYLE_HISTORY = """[
  {:type :invoke, :f :enqueue, :value 0, :process 0, :time 10, :index 0}
@@ -175,6 +198,28 @@ class TestHistoryImport:
         write_history_edn(p, h.ops)
         back = read_history_edn(p)
         assert back == list(h.ops)
+
+    def test_export_escapes_control_chars(self, tmp_path):
+        """A multi-line error string (client-crash backtrace) must not
+        break the one-op-per-line streaming layout."""
+        from jepsen_tpu.history.edn import write_history_edn
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        op = Op(
+            type=OpType.FAIL,
+            f=OpF.ENQUEUE,
+            process=0,
+            value=1,
+            time=5,
+            index=0,
+            error="client-crash: boom\n  at line 1\ttab",
+        )
+        p = tmp_path / "out.edn"
+        write_history_edn(p, [op])
+        lines = p.read_text().splitlines()
+        assert len(lines) == 1  # layout intact
+        (back,) = read_history_edn(p)
+        assert back.error == op.error
 
     def test_rich_nemesis_fs_import_as_log_rows(self, tmp_path):
         """jepsen.nemesis.combined f's (:start-partition, :kill, ...) are

@@ -225,6 +225,21 @@ def test_stream_empty_read(native_lib, broker):
     assert d.read_from(0, 10, 1.0) == []
 
 
+def test_stream_last_offset_probe(native_lib, broker):
+    """The x-stream-offset="last" probe (string spec through the C++
+    codec and the broker): -1 on an empty log, the final offset after
+    appends — the offset proof the client's full read relies on."""
+    d = _stream_driver(native_lib, broker)
+    d.setup()
+    assert d.last_offset(1.0) == -1  # empty: unknown, never 0
+    for v in range(4):
+        assert d.append(v, 5.0) is True
+    assert d.last_offset(2.0) == 3
+    # non-destructive: the probe consumed nothing
+    assert broker.stream_depth() == 4
+    assert d.read_from(0, 10, 2.0) == [[o, o] for o in range(4)]
+
+
 def test_stream_two_clients_share_the_log(native_lib, broker):
     a = _stream_driver(native_lib, broker)
     b = _stream_driver(native_lib, broker)

@@ -1,0 +1,629 @@
+"""A minimal in-memory AMQP 0-9-1 broker for driver tests.
+
+The reference tests its Java driver against a *real* broker on localhost
+(``UtilsTest.java:50``); this image has no RabbitMQ, so the framework
+ships a protocol-level stand-in: a threaded TCP server speaking the AMQP
+subset the native driver uses (handshake, channel, queue declare/purge,
+publisher confirms, basic publish/get/consume/ack/reject, tx
+select/commit/rollback, per-queue ``x-message-ttl`` expiry with
+``x-dead-letter-routing-key`` routing, stream queues with offset reads,
+heartbeat).  It is an *independent* implementation of the wire grammar
+(Python ``struct`` vs the driver's C++ codec), so framing bugs on either
+side surface as protocol errors rather than silently agreeing — and the
+broker itself is conformance-checked against rabbitmq-c
+(``native/interop_probe.c``).
+
+Fault injection mirrors what the checker must catch end-to-end:
+
+- ``drop_confirms``      — accept publishes but never confirm (client
+  publish-confirm timeouts → indeterminate ops);
+- ``lose_acked_every=k`` — confirm every k-th publish but drop the message
+  (data loss: ``total-queue`` must report ``lost``);
+- ``duplicate_every=k``  — deliver every k-th message twice (at-least-once
+  duplicates).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time as _time
+from collections import deque
+from dataclasses import dataclass, field
+
+
+FRAME_METHOD, FRAME_HEADER, FRAME_BODY, FRAME_HEARTBEAT = 1, 2, 3, 8
+FRAME_END = 0xCE
+
+
+def _shortstr(s: str) -> bytes:
+    b = s.encode()
+    return bytes([len(b)]) + b
+
+
+def _longstr(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+class _Reader:
+    def __init__(self, data: bytes):
+        self.data = data
+        self.off = 0
+
+    def u8(self):
+        v = self.data[self.off]
+        self.off += 1
+        return v
+
+    def u16(self):
+        v = struct.unpack_from(">H", self.data, self.off)[0]
+        self.off += 2
+        return v
+
+    def u32(self):
+        v = struct.unpack_from(">I", self.data, self.off)[0]
+        self.off += 4
+        return v
+
+    def u64(self):
+        v = struct.unpack_from(">Q", self.data, self.off)[0]
+        self.off += 8
+        return v
+
+    def shortstr(self):
+        n = self.u8()
+        v = self.data[self.off : self.off + n].decode()
+        self.off += n
+        return v
+
+    def table(self) -> dict:
+        """Parse a field table into a dict (the subset of types the driver
+        emits; unknown types abort parsing by skipping to the end)."""
+        n = self.u32()
+        end = self.off + n
+        out: dict = {}
+        try:
+            while self.off < end:
+                key = self.shortstr()
+                t = bytes([self.u8()])
+                if t == b"S":
+                    ln = self.u32()
+                    out[key] = self.data[self.off : self.off + ln].decode()
+                    self.off += ln
+                elif t == b"I":
+                    out[key] = struct.unpack(
+                        ">i", self.data[self.off : self.off + 4]
+                    )[0]
+                    self.off += 4
+                elif t == b"l":
+                    out[key] = struct.unpack(
+                        ">q", self.data[self.off : self.off + 8]
+                    )[0]
+                    self.off += 8
+                elif t == b"t":
+                    out[key] = bool(self.u8())
+                else:
+                    break  # unknown type: stop parsing, skip the rest
+        finally:
+            self.off = end
+        return out
+
+    def rest(self):
+        return self.data[self.off :]
+
+
+@dataclass
+class _Message:
+    value: bytes
+    ts: float = 0.0  # publish time (monotonic) — drives x-message-ttl
+
+
+@dataclass
+class _ConnState:
+    sock: socket.socket
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    publish_seq: dict = field(default_factory=dict)  # channel -> seq
+    next_tag: int = 1
+    unacked: dict = field(default_factory=dict)  # tag -> (queue, _Message)
+    consuming_queue: str | None = None
+    consuming_noack: bool = False
+    confirm_channels: set = field(default_factory=set)
+    tx_channels: set = field(default_factory=set)  # tx.select per channel
+    tx_buffer: dict = field(default_factory=dict)  # ch -> [(queue, body)]
+    open: bool = True
+
+
+class MiniAmqpBroker:
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        drop_confirms: bool = False,
+        lose_acked_every: int = 0,
+        duplicate_every: int = 0,
+        lose_appended_every: int = 0,
+        duplicate_append_every: int = 0,
+        dirty_tx_reads: bool = False,
+    ):
+        self.host = host
+        self._server = socket.create_server((host, port))
+        self.port = self._server.getsockname()[1]
+        self.queues: dict[str, deque] = {}
+        self.streams: dict[str, list] = {}  # x-queue-type=stream → log
+        # per-queue declare args: x-message-ttl / x-dead-letter-routing-key
+        self.queue_meta: dict[str, dict] = {}
+        self.state_lock = threading.Lock()
+        self.drop_confirms = drop_confirms
+        self.lose_acked_every = lose_acked_every
+        self.duplicate_every = duplicate_every
+        self.lose_appended_every = lose_appended_every
+        self.duplicate_append_every = duplicate_append_every
+        self.dirty_tx_reads = dirty_tx_reads
+        self._published = 0
+        self._delivered = 0
+        self._appended = 0
+        self._conns: list[_ConnState] = []
+        self._accept_thread: threading.Thread | None = None
+        self._running = False
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> "MiniAmqpBroker":
+        self._running = True
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._running = False
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self.state_lock:
+            conns = list(self._conns)
+        for c in conns:
+            try:
+                c.sock.close()
+            except OSError:
+                pass
+
+    def queue_depth(self, name: str = "jepsen.queue") -> int:
+        with self.state_lock:
+            return len(self.queues.get(name, ()))
+
+    def stream_depth(self, name: str = "jepsen.stream") -> int:
+        with self.state_lock:
+            return len(self.streams.get(name, ()))
+
+    # ---- internals -------------------------------------------------------
+    def _accept_loop(self):
+        while self._running:
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                break
+            conn = _ConnState(sock=sock)
+            with self.state_lock:
+                self._conns.append(conn)
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def _send_frame(self, conn: _ConnState, ftype: int, ch: int, payload: bytes):
+        with conn.lock:
+            try:
+                conn.sock.sendall(
+                    struct.pack(">BHI", ftype, ch, len(payload))
+                    + payload
+                    + bytes([FRAME_END])
+                )
+            except OSError:
+                conn.open = False
+
+    def _send_method(self, conn, ch, cls, mth, args: bytes = b""):
+        self._send_frame(
+            conn, FRAME_METHOD, ch, struct.pack(">HH", cls, mth) + args
+        )
+
+    def _recv_exact(self, sock, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("peer closed")
+            buf += chunk
+        return buf
+
+    def _read_frame(self, sock):
+        hdr = self._recv_exact(sock, 7)
+        ftype, ch, size = struct.unpack(">BHI", hdr)
+        payload = self._recv_exact(sock, size) if size else b""
+        end = self._recv_exact(sock, 1)
+        if end[0] != FRAME_END:
+            raise ConnectionError("bad frame end")
+        return ftype, ch, payload
+
+    def _serve(self, conn: _ConnState):
+        sock = conn.sock
+        try:
+            proto = self._recv_exact(sock, 8)
+            if not proto.startswith(b"AMQP"):
+                return
+            # Start
+            args = (
+                bytes([0, 9])
+                + _longstr(b"")  # server properties (empty table)
+                + _longstr(b"PLAIN")
+                + _longstr(b"en_US")
+            )
+            self._send_method(conn, 0, 10, 10, args)
+            self._expect(sock, 10, 11)  # Start-Ok
+            self._send_method(
+                conn, 0, 10, 30, struct.pack(">HIH", 2047, 131072, 0)
+            )  # Tune
+            self._expect(sock, 10, 31)  # Tune-Ok
+            self._expect(sock, 10, 40)  # Open
+            self._send_method(conn, 0, 10, 41, _shortstr(""))  # Open-Ok
+
+            # in-flight publish content, keyed by channel: method, header,
+            # and body frames of one publish share a channel, and two
+            # channels may interleave their publishes on one connection
+            pending: dict = {}  # ch -> [queue, size, body]
+
+            while conn.open:
+                ftype, ch, payload = self._read_frame(sock)
+                if ftype == FRAME_HEARTBEAT:
+                    self._send_frame(conn, FRAME_HEARTBEAT, 0, b"")
+                    continue
+                if ftype == FRAME_HEADER:
+                    r = _Reader(payload)
+                    r.u16()
+                    r.u16()
+                    p = pending.get(ch)
+                    if p is not None:
+                        p[1] = r.u64()
+                        p[2] = b""
+                        if p[1] == 0:
+                            self._finish_publish(conn, ch, p[0], b"")
+                            del pending[ch]
+                    continue
+                if ftype == FRAME_BODY:
+                    p = pending.get(ch)
+                    if p is not None:
+                        p[2] += payload
+                        if len(p[2]) >= p[1]:
+                            self._finish_publish(conn, ch, p[0], p[2])
+                            del pending[ch]
+                    continue
+                r = _Reader(payload)
+                cls, mth = r.u16(), r.u16()
+                if cls == 20 and mth == 10:  # Channel.Open
+                    self._send_method(conn, ch, 20, 11, _longstr(b""))
+                elif cls == 50 and mth == 10:  # Queue.Declare
+                    r.u16()
+                    qname = r.shortstr()
+                    r.u8()  # durable/exclusive/... bit flags
+                    qargs = r.table()
+                    with self.state_lock:
+                        if qargs.get("x-queue-type") == "stream":
+                            self.streams.setdefault(qname, [])
+                        else:
+                            self.queues.setdefault(qname, deque())
+                            self.queue_meta[qname] = {
+                                "ttl_ms": qargs.get("x-message-ttl"),
+                                "dlx_key": qargs.get(
+                                    "x-dead-letter-routing-key"
+                                ),
+                            }
+                    self._send_method(
+                        conn,
+                        ch,
+                        50,
+                        11,
+                        _shortstr(qname) + struct.pack(">II", 0, 0),
+                    )
+                elif cls == 50 and mth == 30:  # Queue.Purge
+                    r.u16()
+                    qname = r.shortstr()
+                    with self.state_lock:
+                        n = len(self.queues.get(qname, ()))
+                        self.queues[qname] = deque()
+                    self._send_method(conn, ch, 50, 31, struct.pack(">I", n))
+                elif cls == 85 and mth == 10:  # Confirm.Select
+                    conn.confirm_channels.add(ch)  # per-channel (spec)
+                    self._send_method(conn, ch, 85, 11)
+                elif cls == 60 and mth == 10:  # Basic.Qos
+                    self._send_method(conn, ch, 60, 11)
+                elif cls == 60 and mth == 40:  # Basic.Publish
+                    r.u16()
+                    r.shortstr()  # exchange
+                    routing_key = r.shortstr()
+                    pending[ch] = [routing_key, 0, b""]
+                elif cls == 60 and mth == 70:  # Basic.Get
+                    r.u16()
+                    qname = r.shortstr()
+                    no_ack = bool(r.u8() & 1)
+                    self._handle_get(conn, ch, qname, no_ack)
+                elif cls == 60 and mth == 20:  # Basic.Consume
+                    r.u16()
+                    qname = r.shortstr()
+                    ctag = r.shortstr() or "ctag-1"
+                    cbits = r.u8()  # no-local/no-ack/exclusive/no-wait
+                    conn.consuming_noack = bool(cbits & 2)
+                    cargs = r.table()
+                    self._send_method(conn, ch, 60, 21, _shortstr(ctag))
+                    if qname in self.streams:
+                        # offset spec: an absolute int64, or the string
+                        # specs "first" (0) / "last" (the final chunk ≡
+                        # the final record here) / "next" (past the
+                        # current end; this broker's stream consumers are
+                        # one-shot snapshots, so "next" delivers nothing —
+                        # unlike real RabbitMQ, which would push appends
+                        # committed after the subscribe)
+                        spec = cargs.get("x-stream-offset", 0)
+                        if spec == "first":
+                            offset = 0
+                        elif spec in ("last", "next"):
+                            with self.state_lock:
+                                n = len(self.streams.get(qname, ()))
+                            offset = n - 1 if spec == "last" and n else n
+                        else:
+                            offset = int(spec)
+                        self._stream_deliver(conn, ch, qname, offset, ctag)
+                    else:
+                        conn.consuming_queue = qname
+                        self._try_deliver(conn, ch)
+                elif cls == 60 and mth == 30:  # Basic.Cancel
+                    ctag = r.shortstr()
+                    self._send_method(conn, ch, 60, 31, _shortstr(ctag))
+                elif cls == 60 and mth == 80:  # Basic.Ack (client)
+                    tag = r.u64()
+                    with self.state_lock:
+                        conn.unacked.pop(tag, None)
+                    self._try_deliver(conn, ch)
+                elif cls == 60 and mth == 90:  # Basic.Reject
+                    tag = r.u64()
+                    requeue = r.u8()
+                    with self.state_lock:
+                        item = conn.unacked.pop(tag, None)
+                        if item and requeue:
+                            qname, msg = item
+                            self.queues.setdefault(qname, deque()).append(msg)
+                    self._deliver_all()
+                elif cls == 90 and mth == 10:  # Tx.Select (per channel)
+                    conn.tx_channels.add(ch)
+                    self._send_method(conn, ch, 90, 11)
+                elif cls == 90 and mth == 20:  # Tx.Commit
+                    buffered = conn.tx_buffer.pop(ch, [])
+                    for qname, body in buffered:
+                        self._apply_publish(qname, body)
+                    self._send_method(conn, ch, 90, 21)
+                    self._deliver_all()
+                elif cls == 90 and mth == 30:  # Tx.Rollback
+                    conn.tx_buffer.pop(ch, None)
+                    self._send_method(conn, ch, 90, 31)
+                elif cls == 10 and mth == 50:  # Connection.Close
+                    self._send_method(conn, 0, 10, 51)
+                    break
+                elif cls == 20 and mth == 40:  # Channel.Close
+                    # per-channel state dies with the channel: confirm
+                    # mode, the delivery-tag sequence, tx mode + staged
+                    # publishes, and any half-received publish content
+                    conn.confirm_channels.discard(ch)
+                    conn.publish_seq.pop(ch, None)
+                    conn.tx_channels.discard(ch)
+                    conn.tx_buffer.pop(ch, None)
+                    pending.pop(ch, None)
+                    self._send_method(conn, ch, 20, 41)
+                else:
+                    pass  # ignore anything else
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.open = False
+            # requeue un-acked deliveries (broker semantics on conn loss)
+            with self.state_lock:
+                for qname, msg in conn.unacked.values():
+                    self.queues.setdefault(qname, deque()).append(msg)
+                conn.unacked.clear()
+                if conn in self._conns:
+                    self._conns.remove(conn)
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._deliver_all()
+
+    def _expect(self, sock, cls, mth):
+        while True:
+            ftype, _ch, payload = self._read_frame(sock)
+            if ftype != FRAME_METHOD:
+                continue
+            r = _Reader(payload)
+            c, m = r.u16(), r.u16()
+            if (c, m) == (cls, mth):
+                return payload
+            raise ConnectionError(f"expected {cls}.{mth}, got {c}.{m}")
+
+    def _finish_publish(
+        self, conn: _ConnState, ch: int, queue: str, body: bytes
+    ):
+        if ch in conn.tx_channels:
+            # tx publishes stay invisible until tx.commit (no confirms in
+            # tx mode — the commit-ok is the acknowledgement) ... unless
+            # the dirty-visibility fault is injected, which applies them
+            # immediately (read-uncommitted isolation: Elle must flag the
+            # resulting G1a/G1b/G1c anomalies)
+            if self.dirty_tx_reads:
+                self._apply_publish(queue, body)
+                self._deliver_all()
+            else:
+                conn.tx_buffer.setdefault(ch, []).append((queue, body))
+            return
+        seq = conn.publish_seq.get(ch, 0) + 1
+        conn.publish_seq[ch] = seq
+        self._apply_publish(queue, body)
+        # confirm mode and delivery-tag sequence are per channel, and the
+        # ack rides the publishing channel (AMQP 0-9-1 confirm semantics)
+        if ch in conn.confirm_channels and not self.drop_confirms:
+            self._send_method(conn, ch, 60, 80, struct.pack(">QB", seq, 0))
+        self._deliver_all()
+
+    def _expire_locked(self, qname: str) -> None:
+        """Dead-letter expired messages (x-message-ttl + DLX routing, the
+        reference's dead-letter mode — Utils.java:55, MESSAGE_TTL 1 s).
+        Caller holds ``state_lock``."""
+        meta = self.queue_meta.get(qname) or {}
+        ttl_ms = meta.get("ttl_ms")
+        if ttl_ms is None:  # 0 is a real TTL: expire immediately
+            return
+        q = self.queues.get(qname)
+        if not q:
+            return
+        now = _time.monotonic()
+        dlx = meta.get("dlx_key")
+        while q and (now - q[0].ts) * 1000.0 >= ttl_ms:
+            msg = q.popleft()
+            if dlx:  # at-least-once: re-stamped into the dead-letter queue
+                self.queues.setdefault(dlx, deque()).append(
+                    _Message(msg.value, ts=now)
+                )
+
+    def _apply_publish(self, queue: str, body: bytes):
+        """Make a publish visible (fault injection applies here)."""
+        with self.state_lock:
+            if queue in self.streams:
+                self._appended += 1
+                lose = (
+                    self.lose_appended_every
+                    and self._appended % self.lose_appended_every == 0
+                )
+                if not lose:
+                    self.streams[queue].append(body)
+                    if (
+                        self.duplicate_append_every
+                        and self._appended % self.duplicate_append_every == 0
+                    ):
+                        self.streams[queue].append(body)
+            else:
+                self._published += 1
+                lose = (
+                    self.lose_acked_every
+                    and self._published % self.lose_acked_every == 0
+                )
+                if not lose:  # confirm-but-drop = injected data loss
+                    self.queues.setdefault(queue, deque()).append(
+                        _Message(body, ts=_time.monotonic())
+                    )
+
+    def _content_frames(self, conn, ch, body: bytes, method: bytes):
+        self._send_frame(conn, FRAME_METHOD, ch, method)
+        header = struct.pack(">HHQH", 60, 0, len(body), 0)
+        self._send_frame(conn, FRAME_HEADER, ch, header)
+        if body:
+            self._send_frame(conn, FRAME_BODY, ch, body)
+
+    def _handle_get(self, conn: _ConnState, ch: int, qname: str,
+                    no_ack: bool = False):
+        with self.state_lock:
+            self._expire_locked(qname)
+            q = self.queues.setdefault(qname, deque())
+            if not q:
+                msg = None
+            else:
+                msg = q.popleft()
+                self._delivered += 1
+                if (
+                    self.duplicate_every
+                    and self._delivered % self.duplicate_every == 0
+                ):
+                    q.append(_Message(msg.value, ts=_time.monotonic()))
+                tag = conn.next_tag
+                conn.next_tag += 1
+                if not no_ack:  # no-ack gets are auto-acknowledged
+                    conn.unacked[tag] = (qname, msg)
+        if msg is None:
+            self._send_method(conn, ch, 60, 72, _shortstr(""))
+            return
+        method = (
+            struct.pack(">HH", 60, 71)
+            + struct.pack(">QB", tag, 0)
+            + _shortstr("")
+            + _shortstr(qname)
+            + struct.pack(">I", 0)
+        )
+        self._content_frames(conn, ch, msg.value, method)
+
+    def _try_deliver(self, conn: _ConnState, ch: int = 1):
+        """Push deliveries: QoS-1 (one in flight) for acking consumers;
+        no-ack consumers are auto-acknowledged and drain the queue."""
+        while conn.consuming_queue is not None and conn.open:
+            with self.state_lock:
+                if conn.unacked and not conn.consuming_noack:
+                    return
+                self._expire_locked(conn.consuming_queue)
+                q = self.queues.setdefault(conn.consuming_queue, deque())
+                if not q:
+                    return
+                msg = q.popleft()
+                self._delivered += 1
+                if (
+                    self.duplicate_every
+                    and self._delivered % self.duplicate_every == 0
+                ):
+                    q.append(_Message(msg.value, ts=_time.monotonic()))
+                tag = conn.next_tag
+                conn.next_tag += 1
+                noack = conn.consuming_noack
+                if not noack:  # no-ack consumers are auto-acked
+                    conn.unacked[tag] = (conn.consuming_queue, msg)
+            method = (
+                struct.pack(">HH", 60, 60)
+                + _shortstr("ctag-1")
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(conn.consuming_queue)
+            )
+            self._content_frames(conn, ch, msg.value, method)
+            if not noack:
+                return  # QoS-1: wait for the ack before the next push
+
+    def _stream_deliver(
+        self, conn: _ConnState, ch: int, qname: str, offset: int, ctag: str
+    ):
+        """Non-destructive snapshot delivery from ``offset``; each record
+        carries its log offset in the x-stream-offset message header."""
+        with self.state_lock:
+            snapshot = list(enumerate(self.streams.get(qname, ())))[offset:]
+        for off, body in snapshot:
+            with self.state_lock:
+                tag = conn.next_tag
+                conn.next_tag += 1  # stream acks are credit-only: untracked
+            method = (
+                struct.pack(">HH", 60, 60)
+                + _shortstr(ctag)
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(qname)
+            )
+            self._send_frame(conn, FRAME_METHOD, ch, method)
+            table = (
+                _shortstr("x-stream-offset") + b"l" + struct.pack(">q", off)
+            )
+            header = (
+                struct.pack(">HHQH", 60, 0, len(body), 0x2000)
+                + struct.pack(">I", len(table))
+                + table
+            )
+            self._send_frame(conn, FRAME_HEADER, ch, header)
+            if body:
+                self._send_frame(conn, FRAME_BODY, ch, body)
+
+    def _deliver_all(self):
+        with self.state_lock:
+            conns = list(self._conns)
+        for c in conns:
+            self._try_deliver(c)

@@ -10,4 +10,5 @@ from jepsen_tpu.parallel.mesh import (  # noqa: F401
     sharded_queue_lin,
     sharded_stream_lin,
     sharded_total_queue,
+    sharded_wgl,
 )

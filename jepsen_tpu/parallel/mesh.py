@@ -333,6 +333,25 @@ def sharded_stream_lin(batch, mesh: Mesh):
     )
 
 
+def sharded_wgl(batch, mesh: Mesh, model_key, capacity: int = 128):
+    """General-model WGL frontier search over the mesh (the mutex/FIFO/
+    CAS checker family): pure data parallelism — each history's search is
+    an independent ``lax.scan``+``while_loop`` nest, so the batch axis
+    shards over ``hist`` with zero communication and the ``seq`` axis
+    replicates (a search frontier cannot split along the op axis; long
+    mutex histories are short by construction — lock cycles, not load).
+    Returns ``(linearizable[B], overflow[B])`` device arrays."""
+    from jepsen_tpu.checkers.wgl import _wgl_program_cached
+
+    prog = _wgl_program_cached(
+        model_key, batch.n, capacity, int(batch.cands.shape[-1])
+    )
+    f, a0, a1, ret_op, cands = _hist_sharded(
+        (batch.f, batch.a0, batch.a1, batch.ret_op, batch.cands), mesh
+    )
+    return prog(f, a0, a1, ret_op, cands)
+
+
 def sharded_elle(batch, mesh: Mesh):
     """Elle cycle search over the mesh.  Histories shard over ``hist``;
     when the mesh has a ``seq`` axis the ``[T, T]`` adjacency matrices

@@ -1,3 +1,5 @@
-"""Test doubles: the in-memory AMQP mini-broker."""
+"""Compatibility shim: the mini broker moved to ``jepsen_tpu.harness``
+(it is product infrastructure — the local dev cluster's node processes —
+not a test double; see harness/broker.py)."""
 
-from jepsen_tpu.testing.broker import MiniAmqpBroker  # noqa: F401
+from jepsen_tpu.harness.broker import MiniAmqpBroker  # noqa: F401

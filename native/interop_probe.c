@@ -4,7 +4,7 @@
  * (reference UtilsTest.java:50).  This image has no installable broker
  * (zero egress; see native/BROKER_NOTE.md), so conformance is established
  * differentially instead: this program drives the framework's mini broker
- * (jepsen_tpu/testing/broker.py) through librabbitmq (rabbitmq-c, the
+ * (jepsen_tpu/harness/broker.py) through librabbitmq (rabbitmq-c, the
  * system's independently-authored AMQP 0-9-1 client), exercising the same
  * wire surface the C++ driver uses — handshake, queue.declare,
  * confirm.select, basic.publish + publisher confirm, basic.get,

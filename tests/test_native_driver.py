@@ -33,7 +33,7 @@ def native_lib():
 
 @pytest.fixture()
 def broker():
-    from jepsen_tpu.testing.broker import MiniAmqpBroker
+    from jepsen_tpu.harness.broker import MiniAmqpBroker
 
     b = MiniAmqpBroker().start()
     yield b
@@ -141,7 +141,7 @@ def test_full_run_native_driver_lossy_broker_caught(native_lib):
     from jepsen_tpu.client.native import native_driver_factory
     from jepsen_tpu.control.runner import Test, run_test
     from jepsen_tpu.suite import DEFAULT_OPTS, queue_checker, queue_generator
-    from jepsen_tpu.testing.broker import MiniAmqpBroker
+    from jepsen_tpu.harness.broker import MiniAmqpBroker
     import tempfile
 
     b = MiniAmqpBroker(lose_acked_every=7).start()
@@ -257,7 +257,7 @@ def test_stream_full_pipeline_lossy_broker_caught(native_lib):
     from jepsen_tpu.client.native import native_stream_driver_factory
     from jepsen_tpu.client.protocol import StreamClient
     from jepsen_tpu.history.ops import FULL_READ, Op, OpF, reindex
-    from jepsen_tpu.testing.broker import MiniAmqpBroker
+    from jepsen_tpu.harness.broker import MiniAmqpBroker
 
     b = MiniAmqpBroker(lose_appended_every=5).start()
     try:
@@ -393,7 +393,7 @@ class TestNativeTxn:
         checker must classify as G1c, through the real native driver."""
         from jepsen_tpu.checkers.elle import check_elle_batch, check_elle_cpu
         from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
-        from jepsen_tpu.testing.broker import MiniAmqpBroker
+        from jepsen_tpu.harness.broker import MiniAmqpBroker
 
         b = MiniAmqpBroker(dirty_tx_reads=True).start()
         lib = native_lib.load_library()

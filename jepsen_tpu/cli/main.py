@@ -11,8 +11,9 @@ Subcommands:
 
 - ``test``        — run a partition test for any of the four workload
                     families (all the reference's flags; ``--db sim`` for
-                    the in-process cluster, ``--db rabbitmq`` for a real
-                    cluster over the SSH control plane).
+                    the in-process cluster, ``--db local`` for the full
+                    rabbitmq assembly over local broker OS processes,
+                    ``--db rabbitmq`` for a real cluster over SSH).
 - ``check``       — re-check a recorded history (``--checker tpu|cpu``);
                     the ``--checker`` dispatch point is the north-star seam.
 - ``bench-check`` — batched replay: verify many stored/synthetic histories
@@ -393,6 +394,7 @@ def cmd_test(args) -> int:
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
+    local_cluster = None
     if args.db == "rabbitmq":
         try:
             test = build_rabbitmq_test(
@@ -408,6 +410,41 @@ def cmd_test(args) -> int:
         except (NotImplementedError, ValueError) as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    elif args.db == "local":
+        # the dress rehearsal: the full --db rabbitmq assembly (real
+        # runner, native TCP clients, RabbitMQDB choreography, nemesis)
+        # against local mini-broker OS processes (harness/localcluster.py)
+        from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
+        from jepsen_tpu.harness.localcluster import LocalProcTransport
+
+        n = len(args.nodes.split(",")) if args.nodes else 3
+        if args.workload != "queue" and n > 1:
+            # mini brokers don't replicate: only the queue family's drain
+            # visits every host, so multi-node is meaningful only there —
+            # a 3-node stream/mutex/elle run would manufacture false
+            # anomalies out of the harness, not the SUT
+            print(
+                f"# --db local: {args.workload} workload runs single-node "
+                f"(mini brokers don't replicate); ignoring extra nodes",
+                file=sys.stderr,
+            )
+            n = 1
+        local_cluster = LocalProcTransport(n_nodes=n)
+        nodes = local_cluster.nodes
+        test = build_rabbitmq_test(
+            opts=opts,
+            nodes=nodes,
+            concurrency=args.concurrency,
+            checker_backend=args.checker,
+            store_root=args.store,
+            transport=local_cluster,
+            db=RabbitMQDB(
+                local_cluster, nodes,
+                primary_wait_s=0.3, secondary_wait_s=0.3,
+                join_stagger_max_s=0.2,
+            ),
+            workload=args.workload,
+        )
     else:
         test, _cluster = build_sim_test(
             opts=opts,
@@ -428,7 +465,11 @@ def cmd_test(args) -> int:
                 f"{args.workload!r}",
                 file=sys.stderr,
             )
-    run = run_test(test)
+    try:
+        run = run_test(test)
+    finally:
+        if local_cluster is not None:
+            local_cluster.close()
     if monitor is not None:
         snap = monitor.snapshot()
         counts = ", ".join(
@@ -666,7 +707,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--nodes", default="n1,n2,n3", help="comma-separated nodes")
     t.add_argument("--concurrency", type=int, default=5)
-    t.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
+    t.add_argument("--db", choices=("sim", "local", "rabbitmq"), default="sim")
     t.add_argument(
         "--workload",
         choices=("queue", "stream", "elle", "mutex"),

@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import socket
 import struct
+import random as _random
 import threading
 import time as _time
 from collections import deque
@@ -117,6 +118,11 @@ class _Reader:
 class _Message:
     value: bytes
     ts: float = 0.0  # publish time (monotonic) — drives x-message-ttl
+    # raw content-header properties (property-flags onward) as the
+    # publisher sent them; replayed VERBATIM on deliver/get so arbitrary
+    # header tables pass through byte-identical (the codec-fuzz chain
+    # publishes through here and decodes on the far side)
+    props: bytes = b""
 
 
 @dataclass
@@ -145,8 +151,14 @@ class MiniAmqpBroker:
         lose_appended_every: int = 0,
         duplicate_append_every: int = 0,
         dirty_tx_reads: bool = False,
+        fragment_max: int = 0,
     ):
         self.host = host
+        # fragment_max > 0: every outgoing byte stream is sent in random
+        # 1..fragment_max-byte chunks — clients' frame reassembly must
+        # survive arbitrarily split TCP reads (codec-fuzz surface)
+        self.fragment_max = fragment_max
+        self._frag_rng = _random.Random(1234)
         self._server = socket.create_server((host, port))
         self.port = self._server.getsockname()[1]
         self.queues: dict[str, deque] = {}
@@ -213,13 +225,21 @@ class MiniAmqpBroker:
             ).start()
 
     def _send_frame(self, conn: _ConnState, ftype: int, ch: int, payload: bytes):
+        data = (
+            struct.pack(">BHI", ftype, ch, len(payload))
+            + payload
+            + bytes([FRAME_END])
+        )
         with conn.lock:
             try:
-                conn.sock.sendall(
-                    struct.pack(">BHI", ftype, ch, len(payload))
-                    + payload
-                    + bytes([FRAME_END])
-                )
+                if self.fragment_max:
+                    i = 0
+                    while i < len(data):
+                        k = self._frag_rng.randint(1, self.fragment_max)
+                        conn.sock.sendall(data[i : i + k])
+                        i += k
+                else:
+                    conn.sock.sendall(data)
             except OSError:
                 conn.open = False
 
@@ -286,8 +306,9 @@ class MiniAmqpBroker:
                     if p is not None:
                         p[1] = r.u64()
                         p[2] = b""
+                        p[3] = r.rest()  # property-flags onward, verbatim
                         if p[1] == 0:
-                            self._finish_publish(conn, ch, p[0], b"")
+                            self._finish_publish(conn, ch, p[0], b"", p[3])
                             del pending[ch]
                     continue
                 if ftype == FRAME_BODY:
@@ -295,7 +316,7 @@ class MiniAmqpBroker:
                     if p is not None:
                         p[2] += payload
                         if len(p[2]) >= p[1]:
-                            self._finish_publish(conn, ch, p[0], p[2])
+                            self._finish_publish(conn, ch, p[0], p[2], p[3])
                             del pending[ch]
                     continue
                 r = _Reader(payload)
@@ -341,7 +362,7 @@ class MiniAmqpBroker:
                     r.u16()
                     r.shortstr()  # exchange
                     routing_key = r.shortstr()
-                    pending[ch] = [routing_key, 0, b""]
+                    pending[ch] = [routing_key, 0, b"", b""]
                 elif cls == 60 and mth == 70:  # Basic.Get
                     r.u16()
                     qname = r.shortstr()
@@ -398,8 +419,8 @@ class MiniAmqpBroker:
                     self._send_method(conn, ch, 90, 11)
                 elif cls == 90 and mth == 20:  # Tx.Commit
                     buffered = conn.tx_buffer.pop(ch, [])
-                    for qname, body in buffered:
-                        self._apply_publish(qname, body)
+                    for qname, body, props in buffered:
+                        self._apply_publish(qname, body, props)
                     self._send_method(conn, ch, 90, 21)
                     self._deliver_all()
                 elif cls == 90 and mth == 30:  # Tx.Rollback
@@ -449,7 +470,8 @@ class MiniAmqpBroker:
             raise ConnectionError(f"expected {cls}.{mth}, got {c}.{m}")
 
     def _finish_publish(
-        self, conn: _ConnState, ch: int, queue: str, body: bytes
+        self, conn: _ConnState, ch: int, queue: str, body: bytes,
+        props: bytes = b"",
     ):
         if ch in conn.tx_channels:
             # tx publishes stay invisible until tx.commit (no confirms in
@@ -458,14 +480,14 @@ class MiniAmqpBroker:
             # immediately (read-uncommitted isolation: Elle must flag the
             # resulting G1a/G1b/G1c anomalies)
             if self.dirty_tx_reads:
-                self._apply_publish(queue, body)
+                self._apply_publish(queue, body, props)
                 self._deliver_all()
             else:
-                conn.tx_buffer.setdefault(ch, []).append((queue, body))
+                conn.tx_buffer.setdefault(ch, []).append((queue, body, props))
             return
         seq = conn.publish_seq.get(ch, 0) + 1
         conn.publish_seq[ch] = seq
-        self._apply_publish(queue, body)
+        self._apply_publish(queue, body, props)
         # confirm mode and delivery-tag sequence are per channel, and the
         # ack rides the publishing channel (AMQP 0-9-1 confirm semantics)
         if ch in conn.confirm_channels and not self.drop_confirms:
@@ -489,10 +511,10 @@ class MiniAmqpBroker:
             msg = q.popleft()
             if dlx:  # at-least-once: re-stamped into the dead-letter queue
                 self.queues.setdefault(dlx, deque()).append(
-                    _Message(msg.value, ts=now)
+                    _Message(msg.value, ts=now, props=msg.props)
                 )
 
-    def _apply_publish(self, queue: str, body: bytes):
+    def _apply_publish(self, queue: str, body: bytes, props: bytes = b""):
         """Make a publish visible (fault injection applies here)."""
         with self.state_lock:
             if queue in self.streams:
@@ -516,12 +538,17 @@ class MiniAmqpBroker:
                 )
                 if not lose:  # confirm-but-drop = injected data loss
                     self.queues.setdefault(queue, deque()).append(
-                        _Message(body, ts=_time.monotonic())
+                        _Message(body, ts=_time.monotonic(), props=props)
                     )
 
-    def _content_frames(self, conn, ch, body: bytes, method: bytes):
+    def _content_frames(self, conn, ch, body: bytes, method: bytes,
+                        props: bytes = b""):
         self._send_frame(conn, FRAME_METHOD, ch, method)
-        header = struct.pack(">HHQH", 60, 0, len(body), 0)
+        # publisher properties (flags onward) replay verbatim; otherwise a
+        # minimal no-properties header
+        header = struct.pack(">HHQ", 60, 0, len(body)) + (
+            props or struct.pack(">H", 0)
+        )
         self._send_frame(conn, FRAME_HEADER, ch, header)
         if body:
             self._send_frame(conn, FRAME_BODY, ch, body)
@@ -540,7 +567,13 @@ class MiniAmqpBroker:
                     self.duplicate_every
                     and self._delivered % self.duplicate_every == 0
                 ):
-                    q.append(_Message(msg.value, ts=_time.monotonic()))
+                    q.append(
+                        _Message(
+                            msg.value,
+                            ts=_time.monotonic(),
+                            props=msg.props,
+                        )
+                    )
                 tag = conn.next_tag
                 conn.next_tag += 1
                 if not no_ack:  # no-ack gets are auto-acknowledged
@@ -555,7 +588,7 @@ class MiniAmqpBroker:
             + _shortstr(qname)
             + struct.pack(">I", 0)
         )
-        self._content_frames(conn, ch, msg.value, method)
+        self._content_frames(conn, ch, msg.value, method, msg.props)
 
     def _try_deliver(self, conn: _ConnState, ch: int = 1):
         """Push deliveries: QoS-1 (one in flight) for acking consumers;
@@ -574,7 +607,13 @@ class MiniAmqpBroker:
                     self.duplicate_every
                     and self._delivered % self.duplicate_every == 0
                 ):
-                    q.append(_Message(msg.value, ts=_time.monotonic()))
+                    q.append(
+                        _Message(
+                            msg.value,
+                            ts=_time.monotonic(),
+                            props=msg.props,
+                        )
+                    )
                 tag = conn.next_tag
                 conn.next_tag += 1
                 noack = conn.consuming_noack
@@ -587,7 +626,7 @@ class MiniAmqpBroker:
                 + _shortstr("")
                 + _shortstr(conn.consuming_queue)
             )
-            self._content_frames(conn, ch, msg.value, method)
+            self._content_frames(conn, ch, msg.value, method, msg.props)
             if not noack:
                 return  # QoS-1: wait for the ack before the next push
 
@@ -627,3 +666,74 @@ class MiniAmqpBroker:
             conns = list(self._conns)
         for c in conns:
             self._try_deliver(c)
+
+
+# ---------------------------------------------------------------------------
+# Standalone node process — the local dev cluster's "rabbitmq-server".
+#
+# `python -m jepsen_tpu.harness.broker --port P --admin-port A` runs one
+# broker as its own OS process with real TCP, so the control plane can
+# SIGKILL / SIGSTOP / SIGCONT it like a broker VM (the dress-rehearsal
+# stand-in for the reference's per-node rabbitmq-server — the closest a
+# zero-egress image gets to docker-compose.yml:24-35).  The admin port
+# answers one-line queries ("DEPTHS\n" → "<queue> <count>" per queue —
+# the `rabbitmqctl list_queues` stand-in); state is in-memory only, so a
+# SIGKILL genuinely loses whatever only this node held (the checker is
+# expected to notice — that is the point of the harness).
+# ---------------------------------------------------------------------------
+
+
+def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
+    while True:
+        try:
+            sock, _ = server.accept()
+        except OSError:
+            return
+        try:
+            req = sock.makefile("r").readline().strip()
+            if req == "DEPTHS":
+                with broker.state_lock:
+                    ready = {q: len(v) for q, v in broker.queues.items()}
+                    for conn in broker._conns:
+                        for qname, _m in conn.unacked.values():
+                            ready[qname] = ready.get(qname, 0) + 1
+                    for s, log in broker.streams.items():
+                        ready[s] = len(log)
+                out = "".join(f"{q} {n}\n" for q, n in sorted(ready.items()))
+                sock.sendall(out.encode() or b"\n")
+            else:
+                sock.sendall(b"ERR unknown\n")
+        except OSError:
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def main(argv=None) -> None:
+    import argparse
+    import signal
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--admin-port", type=int, required=True)
+    args = p.parse_args(argv)
+
+    broker = MiniAmqpBroker(port=args.port).start()
+    admin = socket.create_server(("127.0.0.1", args.admin_port))
+    threading.Thread(
+        target=_serve_admin, args=(broker, admin), daemon=True
+    ).start()
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    signal.signal(signal.SIGINT, lambda *_: stop.set())
+    stop.wait()
+    admin.close()
+    broker.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,258 @@
+"""Local process cluster: the dress-rehearsal control plane.
+
+The reference's bar for "the pieces work together" is a real local
+cluster — docker-compose boots three broker containers and the whole
+test runs against them (``docker/docker-compose.yml:24-35``).  This
+image has no docker and no egress, so the closest honest equivalent is
+**mini-broker OS processes as nodes**: each "node" is a
+``python -m jepsen_tpu.harness.broker`` process with real TCP (AMQP +
+admin ports), and :class:`LocalProcTransport` maps the exact command
+strings the SSH control plane would run on a broker VM
+(``control/db_rabbitmq.py``, ``control/net.py``) onto actions on those
+processes:
+
+- ``rabbitmq-server -detached``      → spawn the node's broker process
+- ``killall -9 beam.smp``            → SIGKILL it (in-memory state dies
+  with it — a *non-durable* broker, so the checker must flag what only
+  that node held; real quorum queues would survive via Raft)
+- ``killall -STOP/-CONT beam.smp``   → SIGSTOP / SIGCONT (the pause
+  nemesis: sockets held, zero progress)
+- ``rabbitmqctl list_queues``        → the admin-port DEPTHS query (the
+  CI drained-to-zero cross-check, ``ci/jepsen-test.sh:144-155``)
+- ``iptables -A INPUT -s X`` / ``-F``→ records the blocked link and maps
+  *quorum loss* onto processes: a node that can no longer see a majority
+  of the cluster is SIGSTOPped (stops confirming — the client-visible
+  effect of a minority partition), and healing resumes it.  Node-to-node
+  link semantics beyond quorum loss don't exist here because the mini
+  brokers don't replicate; that residual gap is exactly what the
+  docker/terraform harnesses cover on real clusters.
+
+Everything else (wget, tar, config upload, feature flags, join_cluster,
+status-dump eval) succeeds vacuously, recorded in ``log`` like
+:class:`~jepsen_tpu.control.ssh.FakeTransport` — the choreography is
+asserted by the FakeTransport unit tests; this transport's job is making
+the *live* pieces (runner, native TCP clients, nemesis, drain, checker)
+execute together for real.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+from jepsen_tpu.control.ssh import RunResult, Transport
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent.parent)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Node:
+    def __init__(self, name: str, port: int, admin_port: int):
+        self.name = name
+        self.port = port
+        self.admin_port = admin_port
+        self.proc: subprocess.Popen | None = None
+
+
+class LocalProcTransport(Transport):
+    """A :class:`Transport` whose "nodes" are local mini-broker processes."""
+
+    def __init__(self, n_nodes: int = 3, spawn_timeout_s: float = 10.0):
+        self.spawn_timeout_s = spawn_timeout_s
+        self._nodes: dict[str, _Node] = {}
+        for _ in range(n_nodes):
+            port, admin = _free_port(), _free_port()
+            name = f"127.0.0.1:{port}"
+            self._nodes[name] = _Node(name, port, admin)
+        self.log: list[tuple[str, str]] = []
+        self.files: dict[tuple[str, str], bytes] = {}
+        self.lock = threading.Lock()
+        self._blocked: set[frozenset[str]] = set()
+        self._stopped_by_net: set[str] = set()
+        self._stopped_by_cmd: set[str] = set()
+
+    # ---- the cluster surface ---------------------------------------------
+    @property
+    def nodes(self) -> list[str]:
+        return list(self._nodes)
+
+    def alive(self, node: str) -> bool:
+        p = self._nodes[node].proc
+        return p is not None and p.poll() is None
+
+    # ---- Transport -------------------------------------------------------
+    def run(self, node: str, cmd: str, timeout: float | None = None) -> RunResult:
+        with self.lock:
+            self.log.append((node, cmd))
+        inner = self._unwrap(cmd)
+        if "rabbitmq-server -detached" in inner:
+            self._start(node)
+            return RunResult(0, "", "")
+        if "killall" in inner and "-9" in inner:
+            self._kill(node)
+            return RunResult(0, "", "")
+        if "killall" in inner and "-STOP" in inner:
+            with self.lock:
+                self._stopped_by_cmd.add(node)
+            self._signal(node, signal.SIGSTOP)
+            return RunResult(0, "", "")
+        if "killall" in inner and "-CONT" in inner:
+            with self.lock:
+                self._stopped_by_cmd.discard(node)
+                resume = node not in self._stopped_by_net
+            if resume:
+                self._signal(node, signal.SIGCONT)
+            return RunResult(0, "", "")
+        if "iptables" in inner:
+            self._iptables(node, inner)
+            return RunResult(0, "", "")
+        if "list_queues" in inner:
+            return self._list_queues(node)
+        if "rabbitmqctl" in inner and " eval " in inner:
+            return RunResult(0, "no_local_member", "")
+        # choreography commands with no process-level meaning here:
+        # wget/tar/mkdir/rm/chmod/mv/echo/test -e/feature flags/join_cluster
+        return RunResult(0, "", "")
+
+    def put(self, node, content, remote_path):
+        with self.lock:
+            self.log.append((node, f"PUT {remote_path}"))
+            self.files[(node, remote_path)] = content
+
+    def get(self, node, remote_path, local_path):
+        return False  # broker processes keep no on-disk logs
+
+    def close(self) -> None:
+        for n in self._nodes.values():
+            if n.proc is not None and n.proc.poll() is None:
+                # a SIGSTOPped child ignores SIGTERM until resumed
+                try:
+                    n.proc.send_signal(signal.SIGCONT)
+                    n.proc.kill()
+                    n.proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            n.proc = None
+
+    # ---- command implementations -----------------------------------------
+    @staticmethod
+    def _unwrap(cmd: str) -> str:
+        """Strip the ``sudo sh -c '…'`` envelope Control.su() adds."""
+        if cmd.startswith("sudo sh -c "):
+            try:
+                return shlex.split(cmd)[3]
+            except (ValueError, IndexError):
+                return cmd
+        return cmd
+
+    def _start(self, node: str) -> None:
+        n = self._nodes[node]
+        if n.proc is not None and n.proc.poll() is None:
+            return  # already up (idempotent, like -detached)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        n.proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "jepsen_tpu.harness.broker",
+                "--port", str(n.port), "--admin-port", str(n.admin_port),
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", n.port), 0.25).close()
+                return
+            except OSError:
+                time.sleep(0.05)
+        raise RuntimeError(f"broker process for {node} never listened")
+
+    def _kill(self, node: str) -> None:
+        n = self._nodes[node]
+        if n.proc is not None and n.proc.poll() is None:
+            try:
+                n.proc.send_signal(signal.SIGCONT)  # SIGKILL beats STOP, but
+                n.proc.kill()  # reap deterministically
+                n.proc.wait(timeout=5)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        n.proc = None
+
+    def _signal(self, node: str, sig: int) -> None:
+        n = self._nodes[node]
+        if n.proc is not None and n.proc.poll() is None:
+            try:
+                n.proc.send_signal(sig)
+            except OSError:
+                pass
+
+    def _iptables(self, node: str, inner: str) -> None:
+        parts = shlex.split(inner)
+        if "-F" in parts or "-X" in parts:
+            with self.lock:
+                self._blocked = {
+                    link for link in self._blocked if node not in link
+                }
+        elif "-A" in parts and "-s" in parts:
+            peer = parts[parts.index("-s") + 1]
+            with self.lock:
+                self._blocked.add(frozenset((node, peer)))
+        self._apply_stops()
+
+    def _apply_stops(self) -> None:
+        """Quorum-loss mapping: SIGSTOP every node whose visible set is a
+        minority; resume nodes stopped for no remaining reason."""
+        names = list(self._nodes)
+        majority = len(names) // 2 + 1
+        with self.lock:
+            blocked = set(self._blocked)
+            want_stopped = set()
+            for a in names:
+                visible = 1 + sum(
+                    1
+                    for b in names
+                    if b != a and frozenset((a, b)) not in blocked
+                )
+                if visible < majority:
+                    want_stopped.add(a)
+            newly_stopped = want_stopped - self._stopped_by_net
+            resumable = self._stopped_by_net - want_stopped
+            self._stopped_by_net = want_stopped
+            keep_stopped = self._stopped_by_cmd | self._stopped_by_net
+        for a in newly_stopped:
+            self._signal(a, signal.SIGSTOP)
+        for a in resumable:
+            if a not in keep_stopped:
+                self._signal(a, signal.SIGCONT)
+
+    def _list_queues(self, node: str) -> RunResult:
+        n = self._nodes[node]
+        try:
+            with socket.create_connection(
+                ("127.0.0.1", n.admin_port), 2.0
+            ) as s:
+                s.sendall(b"DEPTHS\n")
+                out = b""
+                while chunk := s.recv(4096):
+                    out += chunk
+            return RunResult(0, out.decode(), "")
+        except OSError as e:
+            return RunResult(1, "", f"admin query failed: {e}")
+
+    def commands(self, node: str | None = None) -> list[str]:
+        with self.lock:
+            return [c for n, c in self.log if node is None or n == node]

@@ -340,7 +340,9 @@ def sharded_wgl(batch, mesh: Mesh, model_key, capacity: int = 128):
     shards over ``hist`` with zero communication and the ``seq`` axis
     replicates (a search frontier cannot split along the op axis; long
     mutex histories are short by construction — lock cycles, not load).
-    Returns ``(linearizable[B], overflow[B])`` device arrays."""
+    Returns ``(linearizable[B], unknown[B])`` with the same semantics as
+    ``wgl_tensor_check``: packing-time candidate truncation
+    (``cand_overflow``) folds into *unknown*, never into a pass."""
     from jepsen_tpu.checkers.wgl import _wgl_program_cached
 
     prog = _wgl_program_cached(
@@ -349,7 +351,9 @@ def sharded_wgl(batch, mesh: Mesh, model_key, capacity: int = 128):
     f, a0, a1, ret_op, cands = _hist_sharded(
         (batch.f, batch.a0, batch.a1, batch.ret_op, batch.cands), mesh
     )
-    return prog(f, a0, a1, ret_op, cands)
+    ok, ovf = prog(f, a0, a1, ret_op, cands)
+    unknown = ovf | jnp.asarray(batch.cand_overflow)
+    return ok & ~unknown, unknown
 
 
 def sharded_elle(batch, mesh: Mesh):

@@ -323,9 +323,14 @@ def build_rabbitmq_test(
     ssh_private_key: str | None = None,
     transport=None,
     workload: str = "queue",
+    db=None,
 ) -> Test:
     """The reference test against a real RabbitMQ cluster: SSH DB
-    lifecycle, iptables partitions, native C++ AMQP clients."""
+    lifecycle, iptables partitions, native C++ AMQP clients.
+
+    ``db`` overrides the DB lifecycle (default: ``RabbitMQDB`` with the
+    reference's boot waits) — the local-process dress rehearsal passes a
+    fast-boot ``RabbitMQDB`` over a :class:`LocalProcTransport`."""
     from jepsen_tpu.client.native import (
         native_driver_factory,
         native_stream_driver_factory,
@@ -340,7 +345,7 @@ def build_rabbitmq_test(
     transport = transport or SshTransport(
         user=ssh_user, private_key=ssh_private_key
     )
-    db = RabbitMQDB(transport, nodes)
+    db = db or RabbitMQDB(transport, nodes)
     nemesis = make_nemesis(
         o,
         IptablesNet(transport, nodes),

@@ -343,12 +343,21 @@ class Connection {
   // 1 confirmed, 0 nacked/returned, -1 timeout, -2 connection error
   int publish_confirm(const std::string& queue, int32_t value,
                       int timeout_ms) {
+    return publish_confirm_props(queue, std::to_string(value), nullptr,
+                                 timeout_ms);
+  }
+
+  // publish_confirm with caller-supplied content-header properties
+  // (property-flags onward); nullptr = the default persistent header.
+  // The codec-fuzz surface publishes arbitrary header tables this way.
+  int publish_confirm_props(const std::string& queue, const std::string& body,
+                            const std::vector<uint8_t>* props,
+                            int timeout_ms) {
     uint64_t seq;
     {
       std::lock_guard<std::mutex> wlk(write_mu_);
       if (closed_ || broken_) return -2;
       seq = ++publish_seq_;
-      std::string body = std::to_string(value);
       auto m = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_PUBLISH);
       m.u16(0);
       m.shortstr("");       // default exchange
@@ -356,8 +365,18 @@ class Connection {
       m.u8(1);              // mandatory
       amqp::Writer out;
       amqp::serialize_frame(out, amqp::FRAME_METHOD, 1, m.buf);
-      amqp::serialize_frame(out, amqp::FRAME_HEADER, 1,
-                            amqp::content_header(body.size()));
+      std::vector<uint8_t> header;
+      if (props) {
+        amqp::Writer h;
+        h.u16(amqp::CLS_BASIC);
+        h.u16(0);
+        h.u64(body.size());
+        h.bytes(props->data(), props->size());
+        header = h.buf;
+      } else {
+        header = amqp::content_header(body.size());
+      }
+      amqp::serialize_frame(out, amqp::FRAME_HEADER, 1, header);
       std::vector<uint8_t> bodyv(body.begin(), body.end());
       amqp::serialize_frame(out, amqp::FRAME_BODY, 1, bodyv);
       if (!sock_.send_all(out.buf.data(), out.buf.size())) {
@@ -812,11 +831,25 @@ std::vector<int32_t> g_drain_result;
 std::condition_variable g_drain_cv;
 int g_drain_wait_ms = 5000;  // redelivery settle time (Utils.java:427)
 
+// "host[:port]" → (host, port).  Local multi-node clusters put every node
+// on 127.0.0.1 with a distinct port, so node names may carry their own
+// port which overrides the config default (IPv4/hostnames only — a
+// non-numeric suffix is treated as part of the host).
+std::pair<std::string, int> split_host_port(const std::string& h, int def) {
+  auto colon = h.rfind(':');
+  if (colon == std::string::npos || colon + 1 >= h.size()) return {h, def};
+  std::string port_s = h.substr(colon + 1);
+  if (port_s.find_first_not_of("0123456789") != std::string::npos)
+    return {h, def};
+  return {h.substr(0, colon), std::atoi(port_s.c_str())};
+}
+
 // shared connect-retry loop (Utils.java:294-304): keep trying within the
 // budget, 1 s between attempts; null when the budget runs out
 std::shared_ptr<Connection> connect_with_retry(const ClientConfig& cfg,
                                                int budget_ms) {
   auto deadline = Clock::now() + milliseconds(budget_ms);
+  auto hp = split_host_port(cfg.host, cfg.port);
   while (true) {
     // each attempt is clipped to the remaining budget (a 2 s budget must
     // not block 5 s in open), floor 250 ms so a dreg of budget still makes
@@ -826,7 +859,7 @@ std::shared_ptr<Connection> connect_with_retry(const ClientConfig& cfg,
                     .count();
     int attempt_ms =
         static_cast<int>(std::max<long long>(250, std::min<long long>(5000, left)));
-    auto conn = std::make_shared<Connection>(cfg.host, cfg.port, cfg.user,
+    auto conn = std::make_shared<Connection>(hp.first, hp.second, cfg.user,
                                              cfg.pass);
     if (conn->open(attempt_ms)) return conn;
     if (Clock::now() + milliseconds(1000) >= deadline) break;
@@ -1298,7 +1331,8 @@ class TxnClient {
       std::lock_guard<std::mutex> lk(mu_);
       if (rconn_ && rconn_->alive()) return rconn_;
     }
-    auto rc = std::make_shared<Connection>(cfg_.host, cfg_.port, cfg_.user,
+    auto hp = split_host_port(cfg_.host, cfg_.port);
+    auto rc = std::make_shared<Connection>(hp.first, hp.second, cfg_.user,
                                            cfg_.pass);
     if (!rc->open(5000)) return nullptr;
     std::lock_guard<std::mutex> lk(mu_);
@@ -1554,7 +1588,8 @@ long drain_impl(Client* self, int32_t* out, long cap) {
 
   std::vector<int32_t> values;
   for (const auto& host : hosts) {
-    Connection conn(host, self->config().port, self->config().user,
+    auto hp = split_host_port(host, self->config().port);
+    Connection conn(hp.first, hp.second, self->config().user,
                     self->config().pass);
     if (!conn.open(5000)) {
       logf("drain: cannot connect to %s", host.c_str());
@@ -1819,5 +1854,156 @@ void amqp_reset(int drain_wait_ms) {
 }
 
 void amqp_set_logging(int enabled) { g_log_enabled = enabled; }
+
+// ---------------------------------------------------------------------------
+// Codec-fuzz surface (round-3 verdict item #4).  The reference leans on a
+// battle-tested client library (com.rabbitmq:amqp-client 5.34.0,
+// project.clj:12); this from-scratch codec earns the same trust by
+// differential fuzzing: random field tables (every type in RabbitMQ's
+// field grammar, nested tables/arrays, boundary-length long strings) are
+// encoded by one implementation, carried verbatim through the mini
+// broker (optionally with fragmented TCP writes), and decoded by
+// another — with rabbitmq-c (native/interop_probe.c fuzzpub/fuzzget) as
+// the independent oracle on either end.  The planted x-stream-offset in
+// each table is the checked invariant: finding it requires correctly
+// skipping every random field before it.
+// ---------------------------------------------------------------------------
+
+static uint64_t fuzz_next(uint64_t* s) {  // splitmix64
+  uint64_t z = (*s += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+static std::string fuzz_string(uint64_t* s, size_t max_len) {
+  size_t n = fuzz_next(s) % (max_len + 1);
+  std::string out;
+  out.reserve(n);
+  for (size_t i = 0; i < n; ++i)
+    out.push_back(static_cast<char>(fuzz_next(s) & 0xFF));
+  return out;
+}
+
+static std::string fuzz_key(uint64_t* s) {
+  size_t n = 1 + fuzz_next(s) % 20;
+  std::string out;
+  for (size_t i = 0; i < n; ++i)
+    out.push_back('a' + static_cast<char>(fuzz_next(s) % 26));
+  return out;
+}
+
+// append one random field value (full RabbitMQ field grammar) to w
+static void fuzz_field_value(amqp::Writer* w, uint64_t* s, int depth) {
+  static const char kinds[] = "tbBsuIifldDSTVxFA";
+  char k = kinds[fuzz_next(s) % (depth > 0 ? 17 : 15)];  // F/A only nested
+  w->u8(static_cast<uint8_t>(k));
+  switch (k) {
+    case 't': case 'b': case 'B': w->u8(fuzz_next(s) & 0xFF); break;
+    case 's': case 'u': w->u16(fuzz_next(s) & 0xFFFF); break;
+    case 'I': case 'i': case 'f': w->u32(fuzz_next(s) & 0xFFFFFFFF); break;
+    case 'l': case 'd': case 'T': w->u64(fuzz_next(s)); break;
+    case 'D': w->u8(fuzz_next(s) & 0xFF); w->u32(fuzz_next(s)); break;
+    case 'S': case 'x': {
+      // mostly short, occasionally boundary-size long strings
+      size_t cap = (fuzz_next(s) % 8 == 0) ? 8192 : 64;
+      w->longstr(fuzz_string(s, cap));
+      break;
+    }
+    case 'V': break;
+    case 'F': {
+      amqp::Writer entries;
+      int n = fuzz_next(s) % 4;
+      for (int i = 0; i < n; ++i) {
+        entries.shortstr(fuzz_key(s));
+        fuzz_field_value(&entries, s, depth - 1);
+      }
+      w->u32(static_cast<uint32_t>(entries.buf.size()));
+      w->bytes(entries.buf.data(), entries.buf.size());
+      break;
+    }
+    case 'A': {
+      amqp::Writer items;
+      int n = fuzz_next(s) % 4;
+      for (int i = 0; i < n; ++i) fuzz_field_value(&items, s, depth - 1);
+      w->u32(static_cast<uint32_t>(items.buf.size()));
+      w->bytes(items.buf.data(), items.buf.size());
+      break;
+    }
+  }
+}
+
+// properties bytes (flags + headers table): random junk fields with
+// x-stream-offset = planted inserted at a random position
+static std::vector<uint8_t> fuzz_props(uint64_t seed, int64_t planted) {
+  uint64_t s = seed;
+  amqp::Writer entries;
+  int n_fields = fuzz_next(&s) % 8;
+  int plant_at = static_cast<int>(fuzz_next(&s) % (n_fields + 1));
+  for (int i = 0; i <= n_fields; ++i) {
+    if (i == plant_at) {
+      entries.shortstr("x-stream-offset");
+      entries.u8('l');
+      entries.u64(static_cast<uint64_t>(planted));
+    } else {
+      entries.shortstr(fuzz_key(&s));
+      fuzz_field_value(&entries, &s, 2);
+    }
+  }
+  amqp::Writer props;
+  props.u16(0x2000);  // headers present
+  props.u32(static_cast<uint32_t>(entries.buf.size()));
+  props.bytes(entries.buf.data(), entries.buf.size());
+  return props.buf;
+}
+
+// Publish n messages with fuzzed header tables (planted offset = base+i,
+// body = i).  Returns the count published+confirmed, or -(i+1) on the
+// first failure.
+long long amqp_fuzz_publish_tables(const char* host, int port,
+                                   const char* queue, long long seed,
+                                   long long base, int n) {
+  Connection conn(host ? host : "127.0.0.1", port, "guest", "guest");
+  if (!conn.open(5000)) return -1000000;
+  amqp::Table args;
+  if (!conn.declare_queue(queue, args)) return -1000001;
+  conn.enable_confirms();
+  for (int i = 0; i < n; ++i) {
+    auto props = fuzz_props(static_cast<uint64_t>(seed) + i, base + i);
+    if (conn.publish_confirm_props(queue, std::to_string(i), &props,
+                                   5000) != 1) {
+      conn.close();
+      return -(i + 1);
+    }
+  }
+  conn.close();
+  return n;
+}
+
+// Consume n messages; decode each header table with OUR reader
+// (header_stream_offset must skip every fuzzed field to find the planted
+// key) and parse the int body.  Fills offs/bodies; returns the count.
+long amqp_fuzz_consume_offsets(const char* host, int port, const char* queue,
+                               long n, long long* offs, int* bodies,
+                               int timeout_ms) {
+  Connection conn(host ? host : "127.0.0.1", port, "guest", "guest");
+  if (!conn.open(5000)) return -1;
+  if (!conn.start_consumer(queue, 200, nullptr, "fuzz-consumer")) {
+    conn.close();
+    return -2;
+  }
+  long got = 0;
+  while (got < n) {
+    Delivery d;
+    int r = conn.pop_delivery(&d, timeout_ms);
+    if (r != 1) break;
+    conn.basic_ack(d.tag);
+    offs[got] = d.offset;
+    bodies[got] = d.value;
+    ++got;
+  }
+  conn.close();
+  return got;
+}
 
 }  // extern "C"

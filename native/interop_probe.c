@@ -254,9 +254,177 @@ static int publish_one(amqp_connection_state_t c, const char *queue, int v,
   return publish_one_ch(c, 1, queue, v, want_confirm);
 }
 
+/* ---- codec fuzz (rabbitmq-c as the oracle end) --------------------------
+ *
+ * fuzzpub N SEED BASE — publish N confirmed messages to fuzz.queue whose
+ *   header tables are random (every field kind librabbitmq encodes,
+ *   nested tables/arrays, boundary-length strings) with a planted
+ *   x-stream-offset = BASE+i; rabbitmq-c is the ENCODER oracle, the far
+ *   side (the in-tree C++ codec) must skip every fuzzed field to find
+ *   the planted value.
+ * fuzzget N BASE — basic.get N messages from fuzz.queue and DECODE the
+ *   properties with librabbitmq (the decoder oracle): a table the
+ *   in-tree encoder produced that librabbitmq cannot parse, or whose
+ *   planted offset/body disagree, is a codec bug.
+ */
+
+static uint64_t fz_state;
+static uint64_t fz_next(void) {
+  uint64_t z = (fz_state += 0x9E3779B97F4A7C15ull);
+  z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ull;
+  z = (z ^ (z >> 27)) * 0x94D049BB133111EBull;
+  return z ^ (z >> 31);
+}
+
+static char fz_arena[262144];
+static size_t fz_off;
+
+static void *fz_alloc(size_t n) {
+  if (fz_off + n > sizeof fz_arena) {
+    fprintf(stderr, "PROBE FAIL: fuzz arena exhausted\n");
+    exit(1);
+  }
+  void *p = fz_arena + fz_off;
+  fz_off += n;
+  return p;
+}
+
+static amqp_bytes_t fz_string(size_t maxlen) {
+  size_t n = fz_next() % (maxlen + 1);
+  char *p = fz_alloc(n ? n : 1);
+  for (size_t i = 0; i < n; ++i) p[i] = (char)(fz_next() & 0xFF);
+  amqp_bytes_t b = {n, p};
+  return b;
+}
+
+static amqp_bytes_t fz_key(void) {
+  size_t n = 1 + fz_next() % 20;
+  char *p = fz_alloc(n);
+  for (size_t i = 0; i < n; ++i) p[i] = 'a' + (char)(fz_next() % 26);
+  amqp_bytes_t b = {n, p};
+  return b;
+}
+
+static void fz_value(amqp_field_value_t *v, int depth) {
+  static const char kinds[] = "tbBsuIilfdDSTVFA";
+  v->kind = (uint8_t)kinds[fz_next() % (depth > 0 ? 16 : 14)];
+  switch (v->kind) {
+    case 't': v->value.boolean = (int)(fz_next() & 1); break;
+    case 'b': v->value.i8 = (int8_t)fz_next(); break;
+    case 'B': v->value.u8 = (uint8_t)fz_next(); break;
+    case 's': v->value.i16 = (int16_t)fz_next(); break;
+    case 'u': v->value.u16 = (uint16_t)fz_next(); break;
+    case 'I': v->value.i32 = (int32_t)fz_next(); break;
+    case 'i': v->value.u32 = (uint32_t)fz_next(); break;
+    case 'l': v->value.i64 = (int64_t)fz_next(); break;
+    case 'f': v->value.f32 = (float)(int32_t)fz_next(); break;
+    case 'd': v->value.f64 = (double)(int64_t)fz_next(); break;
+    case 'D':
+      v->value.decimal.decimals = (uint8_t)(fz_next() % 10);
+      v->value.decimal.value = (uint32_t)fz_next();
+      break;
+    case 'S': v->value.bytes = fz_string(fz_next() % 8 == 0 ? 8192 : 64); break;
+    case 'T': v->value.u64 = fz_next(); break;
+    case 'V': break;
+    case 'F': {
+      int n = (int)(fz_next() % 4);
+      amqp_table_entry_t *es = fz_alloc(sizeof(amqp_table_entry_t) * (n ? n : 1));
+      for (int i = 0; i < n; ++i) {
+        es[i].key = fz_key();
+        fz_value(&es[i].value, depth - 1);
+      }
+      v->value.table.num_entries = n;
+      v->value.table.entries = es;
+      break;
+    }
+    case 'A': {
+      int n = (int)(fz_next() % 4);
+      amqp_field_value_t *is = fz_alloc(sizeof(amqp_field_value_t) * (n ? n : 1));
+      for (int i = 0; i < n; ++i) fz_value(&is[i], depth - 1);
+      v->value.array.num_entries = n;
+      v->value.array.entries = is;
+      break;
+    }
+  }
+}
+
+static int run_fuzzpub(amqp_connection_state_t c, const char *queue, int n,
+                       long long seed, long long base) {
+  for (int i = 0; i < n; ++i) {
+    fz_state = (uint64_t)seed + (uint64_t)i;
+    fz_off = 0;
+    int n_fields = (int)(fz_next() % 8);
+    int plant_at = (int)(fz_next() % (n_fields + 1));
+    amqp_table_entry_t es[9];
+    for (int k = 0; k <= n_fields; ++k) {
+      if (k == plant_at) {
+        es[k].key = amqp_cstring_bytes("x-stream-offset");
+        es[k].value.kind = 'l';
+        es[k].value.value.i64 = base + i;
+      } else {
+        es[k].key = fz_key();
+        fz_value(&es[k].value, 2);
+      }
+    }
+    amqp_basic_properties_t props;
+    memset(&props, 0, sizeof props);
+    props._flags = AMQP_BASIC_HEADERS_FLAG;
+    props.headers.num_entries = n_fields + 1;
+    props.headers.entries = es;
+    char buf[16];
+    snprintf(buf, sizeof buf, "%d", i);
+    int rc = amqp_basic_publish(c, 1, amqp_cstring_bytes(""),
+                                amqp_cstring_bytes(queue), 1, 0, &props,
+                                amqp_cstring_bytes(buf));
+    CHECK(rc == 0, "fuzz publish (librabbitmq encode)");
+    amqp_method_t m;
+    CHECK(amqp_simple_wait_method(c, 1, AMQP_BASIC_ACK_METHOD, &m) == 0,
+          "fuzz publish confirm");
+  }
+  printf("FUZZPUB OK %d\n", n);
+  return 0;
+}
+
+static int run_fuzzget(amqp_connection_state_t c, const char *queue, int n,
+                       long long base) {
+  char *seen = calloc(1, (size_t)n);
+  for (int i = 0; i < n; ++i) {
+    amqp_maybe_release_buffers(c);
+    amqp_rpc_reply_t r = amqp_basic_get(c, 1, amqp_cstring_bytes(queue), 1);
+    CHECK_RPC(r, "fuzz basic.get");
+    CHECK(r.reply.id == AMQP_BASIC_GET_OK_METHOD, "fuzz get-ok (not empty)");
+    amqp_message_t msg;
+    r = amqp_read_message(c, 1, &msg, 0);
+    CHECK_RPC(r, "fuzz read message (librabbitmq decodes the table)");
+    int v = body_int(msg.body);
+    CHECK(v >= 0 && v < n && !seen[v], "fuzz body unique+known");
+    seen[v] = 1;
+    CHECK(msg.properties._flags & AMQP_BASIC_HEADERS_FLAG,
+          "fuzz message carries headers");
+    amqp_table_t *h = &msg.properties.headers;
+    amqp_table_entry_t *es = (amqp_table_entry_t *)h->entries;
+    int found = 0;
+    for (int k = 0; k < h->num_entries; ++k) {
+      if (es[k].key.len == 15 &&
+          memcmp(es[k].key.bytes, "x-stream-offset", 15) == 0) {
+        CHECK(es[k].value.kind == 'l', "fuzz planted kind");
+        CHECK(es[k].value.value.i64 == base + v, "fuzz planted value");
+        found = 1;
+      }
+    }
+    CHECK(found, "fuzz planted key survived the junk fields");
+    amqp_destroy_message(&msg);
+  }
+  free(seen);
+  printf("FUZZGET OK %d\n", n);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: interop_probe HOST PORT [tx] [stream]\n");
+    fprintf(stderr,
+            "usage: interop_probe HOST PORT [tx] [stream] |"
+            " fuzzpub N SEED BASE | fuzzget N BASE\n");
     return 2;
   }
   const char *host = argv[1];
@@ -265,6 +433,35 @@ int main(int argc, char **argv) {
   for (int i = 3; i < argc; ++i) {
     if (strcmp(argv[i], "tx") == 0) with_tx = 1;
     if (strcmp(argv[i], "stream") == 0) with_stream = 1;
+  }
+  if (argc >= 5 && (strcmp(argv[3], "fuzzpub") == 0 ||
+                    strcmp(argv[3], "fuzzget") == 0)) {
+    amqp_connection_state_t fc = amqp_new_connection();
+    amqp_socket_t *fsock = amqp_tcp_socket_new(fc);
+    CHECK(fsock != NULL, "tcp socket");
+    CHECK(amqp_socket_open(fsock, host, port) == 0, "connect");
+    amqp_rpc_reply_t fr = amqp_login(fc, "/", 0, 131072, 0,
+                                     AMQP_SASL_METHOD_PLAIN, "guest",
+                                     "guest");
+    CHECK_RPC(fr, "login");
+    amqp_channel_open(fc, 1);
+    CHECK_RPC(amqp_get_rpc_reply(fc), "channel.open");
+    amqp_queue_declare(fc, 1, amqp_cstring_bytes("fuzz.queue"), 0, 1, 0, 0,
+                       amqp_empty_table);
+    CHECK_RPC(amqp_get_rpc_reply(fc), "queue.declare");
+    int rc;
+    if (strcmp(argv[3], "fuzzpub") == 0) {
+      CHECK(argc >= 6, "fuzzpub needs N SEED BASE");
+      amqp_confirm_select(fc, 1);
+      CHECK_RPC(amqp_get_rpc_reply(fc), "confirm.select");
+      rc = run_fuzzpub(fc, "fuzz.queue", atoi(argv[4]), atoll(argv[5]),
+                       argc >= 7 ? atoll(argv[6]) : 0);
+    } else {
+      CHECK(argc >= 6, "fuzzget needs N BASE");
+      rc = run_fuzzget(fc, "fuzz.queue", atoi(argv[4]), atoll(argv[5]));
+    }
+    amqp_destroy_connection(fc);
+    return rc;
   }
   const char *queue = "probe.queue";
 

@@ -179,3 +179,20 @@ def test_live_check_flag_reports_and_persists(tmp_path, capsys):
     data = json.loads(live[0].read_text())
     assert data["monitor"] == "live-total-queue"
     assert data["violation-so-far"] is False
+
+
+def test_db_local_dress_rehearsal(tmp_path, capsys):
+    """`test --db local`: the full --db rabbitmq assembly against local
+    mini-broker OS processes, straight from the CLI (the operator-facing
+    dress rehearsal surface)."""
+    rc = main([
+        "test", "--db", "local", "--workload", "queue",
+        "--time-limit", "2", "--rate", "100",
+        "--time-before-partition", "0.5", "--partition-duration", "0.8",
+        "--recovery-sleep", "0.6", "--publish-confirm-timeout", "1500",
+        "--concurrency", "3", "--checker", "cpu",
+        "--store", str(tmp_path / "s"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert GOOD_BANNER in out

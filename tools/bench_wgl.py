@@ -28,6 +28,115 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def hard_history(n_ops: int, window: int, seed: int = 0):
+    """A partition-era quorum-queue history: ``window`` indeterminate
+    enqueues (publish confirms lost in the partition) stay open for the
+    whole run while normal traffic continues.
+
+    This is the shape where the classic Wing-Gong search degrades
+    super-linearly: every one of the ``window`` open enqueues may
+    linearize at any later point or never, so the reachable configuration
+    set sustains ~2^window members through EVERY later return event —
+    the classic search re-expands them per event in Python, while the
+    tensor engine's fixed-capacity frontier does the same work in one
+    compiled scan regardless (until 2^window exceeds capacity, where it
+    honestly reports *unknown* and escapes to the CPU).
+    """
+    import random
+
+    from jepsen_tpu.history.ops import Op, OpF, OpType
+
+    rng = random.Random(seed)
+    ops: list = []
+
+    def t() -> int:
+        return len(ops)
+
+    for i in range(window):
+        p = 100 + i
+        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, p, i + 1, time=t()))
+        ops.append(
+            Op(OpType.INFO, OpF.ENQUEUE, p, i + 1, time=t(), error="timeout")
+        )
+    values = list(range(window + 1, window + 1 + (n_ops // 2)))
+    rng.shuffle(values)
+    for v in values:
+        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, 0, v, time=t()))
+        ops.append(Op(OpType.OK, OpF.ENQUEUE, 0, v, time=t()))
+        ops.append(Op(OpType.INVOKE, OpF.DEQUEUE, 1, None, time=t()))
+        ops.append(Op(OpType.OK, OpF.DEQUEUE, 1, v, time=t()))
+    return ops
+
+
+def measure_hard(
+    n_ops: int, window: int, batch: int, capacity: int, platform: str = ""
+) -> dict:
+    """Classic vs tensor on the partition-era shape above."""
+    import jax
+    import jax.numpy as jnp
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+
+    from jepsen_tpu.checkers.wgl import (
+        check_wgl_cpu,
+        pack_wgl_batch,
+        queue_wgl_ops,
+        wgl_tensor_check,
+    )
+    from jepsen_tpu.models.core import UnorderedQueue
+
+    opss = [
+        queue_wgl_ops(hard_history(n_ops, window, seed=s))
+        for s in range(batch)
+    ]
+    packed = pack_wgl_batch(opss)
+    vs = 32 * max(1, (max(o.call.a0 for ops in opss for o in ops) + 32) // 32)
+    model_key = (UnorderedQueue, (vs,))
+
+    t0 = time.perf_counter()
+    ok, unknown = wgl_tensor_check(packed, model_key, capacity=capacity)
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for r in range(3):
+        # distinct inputs per repeat: the tunneled remote-execution layer
+        # caches repeated (program, args) dispatches (see bench.py)
+        rolled = type(packed)(
+            f=jnp.roll(packed.f, r + 1, axis=0),
+            a0=jnp.roll(packed.a0, r + 1, axis=0),
+            a1=jnp.roll(packed.a1, r + 1, axis=0),
+            ret_op=jnp.roll(packed.ret_op, r + 1, axis=0),
+            cands=jnp.roll(packed.cands, r + 1, axis=0),
+            cand_overflow=packed.cand_overflow,
+            n=packed.n,
+        )
+        t1 = time.perf_counter()
+        ok, unknown = wgl_tensor_check(rolled, model_key, capacity=capacity)
+        times.append(time.perf_counter() - t1)
+    run_s = min(times)
+
+    t2 = time.perf_counter()
+    classic = [check_wgl_cpu(ops, UnorderedQueue(vs)) for ops in opss]
+    cpu_s = (time.perf_counter() - t2) / batch
+
+    return {
+        "n_ops": n_ops,
+        "window": window,
+        "expected_configs": 2 ** window,
+        "capacity": capacity,
+        "batch": batch,
+        "backend": jax.default_backend(),
+        "compile_s": round(compile_s, 2),
+        "tensor_per_history_ms": round(run_s / batch * 1e3, 3),
+        "classic_per_history_ms": round(cpu_s * 1e3, 3),
+        "classic_configs_explored": classic[0]["configs-explored"],
+        "all_linearizable": bool(ok.all()),
+        "unknown_frac": round(float(unknown.mean()), 3),
+        "classic_valid": classic[0]["valid?"],
+    }
+
+
 def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
     import jax
 
@@ -87,12 +196,50 @@ def main() -> None:
     p.add_argument("--deadline", type=float, default=900.0)
     p.add_argument("--one", type=int, default=0, help="internal")
     p.add_argument(
+        "--hard",
+        action="store_true",
+        help="partition-era crossover sweep: classic vs tensor over "
+        "indeterminate-window widths (see hard_history)",
+    )
+    p.add_argument("--n-ops", type=int, default=200)
+    p.add_argument("--windows", type=int, nargs="+", default=[0, 2, 4, 6, 8])
+    p.add_argument("--capacity", type=int, default=128)
+    p.add_argument("--one-hard", default="", help="internal: nops,window,cap")
+    p.add_argument(
         "--platform", default="", help="pin backend (e.g. cpu) via jax.config"
     )
     args = p.parse_args()
 
+    if args.one_hard:
+        n, w, cap = (int(x) for x in args.one_hard.split(","))
+        print(json.dumps(measure_hard(n, w, args.batch, cap, args.platform)))
+        return
     if args.one:
         print(json.dumps(measure_one(args.one, args.batch, args.platform)))
+        return
+
+    if args.hard:
+        rows = []
+        for w in args.windows:
+            cmd = [
+                sys.executable, __file__,
+                "--one-hard", f"{args.n_ops},{w},{args.capacity}",
+                "--batch", str(args.batch), "--platform", args.platform,
+            ]
+            t0 = time.perf_counter()
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=args.deadline
+                )
+                if r.returncode == 0:
+                    row = json.loads(r.stdout.strip().splitlines()[-1])
+                else:
+                    row = {"window": w, "error": r.stderr[-300:]}
+            except subprocess.TimeoutExpired:
+                row = {"window": w, "timeout": True, "deadline_s": args.deadline}
+            row["wall_s"] = round(time.perf_counter() - t0, 1)
+            rows.append(row)
+            print(json.dumps(row), flush=True)
         return
 
     rows = []

@@ -31,21 +31,27 @@ Indeterminate (``info``) ops follow Knossos semantics: they may linearize
 at any point after their invocation — they join every later event's
 candidate set — or never (no return event forces them).
 
-**Backend guidance — measured, see ``WGL_BENCH.md`` (2026-07-30, real
-chip)**: compile cost on the tunneled TPU is **flat** at ~20 s per shape
-bucket regardless of history length (the dedup orders frontier rows by a
-64-bit row hash instead of a variadic lexicographic sort over every
-state column, which had made XLA's compile time linear at ~0.6 s per op
-row); steady-state run time beats the CPU-backend tensor engine 2.0–5.6×
-but does not beat the classic host search except where its exponential
-tail bites (128-row frontiers overflow to *unknown* on the hardest
-histories — the documented CPU escape hatch).  So
-``QueueWgl(backend="tpu")`` is correct and usable on-chip at a one-off
-~20 s compile; for the quorum-queue workload the TPU-fast
-linearizability path remains the per-value decomposition
-(``jepsen_tpu.checkers.queue_lin``, P-compositionality), which covers
-the model exactly at millions of histories/s.  The WGL engine is the
-general-model fallback (CAS registers, mutexes, FIFO).
+**Backend guidance — measured, see ``WGL_BENCH.md`` (round 3 settled
+the crossover question)**: compile cost on the tunneled TPU is **flat**
+at ~20 s per shape bucket regardless of history length (the dedup
+orders frontier rows by a 64-bit row hash instead of a variadic
+lexicographic sort over every state column, which had made XLA's
+compile time linear at ~0.6 s per op row); steady-state chip run time
+beats the CPU-backend tensor engine 2.0–5.6×.  Against the classic host
+search the engine does **not** win per history — not on easy histories
+and, measured in round 3, not on partition-era hard ones either: the
+classic search's exponential tail is real (~700× from window 0→8), but
+the frontier capacity the tensor search must carry grows with the same
+2^w, and the classic engine stays 1.7–283× faster on the CPU backend at
+every measured width (WGL_BENCH.md "Partition-era hard histories").
+The engine's role is therefore: (a) the *general-model correctness
+engine* — one compiled program per model×shape for CAS/mutex/FIFO/
+unordered models, exact verdicts, honest *unknown* + CPU escape hatch
+on overflow; (b) the device path for *batched* checking of many
+histories in one dispatch (``bench-check --workload mutex``).  For the
+quorum-queue workload the TPU-fast linearizability path remains the
+per-value decomposition (``jepsen_tpu.checkers.queue_lin``,
+P-compositionality), at millions of histories/s.
 """
 
 from __future__ import annotations

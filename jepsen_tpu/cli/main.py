@@ -414,8 +414,13 @@ def cmd_test(args) -> int:
         # the dress rehearsal: the full --db rabbitmq assembly (real
         # runner, native TCP clients, RabbitMQDB choreography, nemesis)
         # against local mini-broker OS processes (harness/localcluster.py)
-        from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
-        from jepsen_tpu.harness.localcluster import LocalProcTransport
+        from jepsen_tpu.client import native as native_mod
+        from jepsen_tpu.harness.localcluster import build_local_test
+
+        # the drain once-latch (and client registry) is process-global in
+        # the native driver: an earlier native run in this process would
+        # otherwise make this run's drain return instantly empty
+        native_mod.reset()
 
         n = len(args.nodes.split(",")) if args.nodes else 3
         if args.workload != "queue" and n > 1:
@@ -429,20 +434,12 @@ def cmd_test(args) -> int:
                 file=sys.stderr,
             )
             n = 1
-        local_cluster = LocalProcTransport(n_nodes=n)
-        nodes = local_cluster.nodes
-        test = build_rabbitmq_test(
-            opts=opts,
-            nodes=nodes,
+        test, local_cluster = build_local_test(
+            opts,
+            n_nodes=n,
             concurrency=args.concurrency,
             checker_backend=args.checker,
             store_root=args.store,
-            transport=local_cluster,
-            db=RabbitMQDB(
-                local_cluster, nodes,
-                primary_wait_s=0.3, secondary_wait_s=0.3,
-                join_stagger_max_s=0.2,
-            ),
             workload=args.workload,
         )
     else:
@@ -525,6 +522,20 @@ def cmd_matrix(args) -> int:
 
     scale = args.time_scale
 
+    def _collect_queue_lengths(db, nodes):
+        # out-of-band queue-empty cross-check straight from the brokers
+        # (= the reference's rabbitmqctl loop, ci/jepsen-test.sh:144-155)
+        lengths: dict[str, int] = {}
+        for node in nodes:
+            try:
+                for q, n in db.queue_lengths(node).items():
+                    lengths[f"{q}@{node}"] = n
+            except Exception as e:  # noqa: BLE001 — node may be down
+                logging.warning(
+                    "queue-length check failed on %s: %s", node, e
+                )
+        return lengths
+
     def run_fn(opts):
         scaled = dict(opts)
         for k in ("time-limit", "time-before-partition", "partition-duration"):
@@ -548,17 +559,27 @@ def cmd_matrix(args) -> int:
                 ssh_private_key=args.ssh_private_key,
             )
             run = run_test(test)
-            # out-of-band queue-empty cross-check straight from the brokers
-            # (= the reference's rabbitmqctl loop, ci/jepsen-test.sh:144-155)
-            lengths: dict[str, int] = {}
-            for node in nodes:
-                try:
-                    for q, n in test.db.queue_lengths(node).items():
-                        lengths[f"{q}@{node}"] = n
-                except Exception as e:  # noqa: BLE001 — node may be down
-                    logging.warning("queue-length check failed on %s: %s",
-                                    node, e)
-            return run.results, lengths
+            return run.results, _collect_queue_lengths(test.db, nodes)
+        if args.db == "local":
+            # the dress-rehearsal cluster: every config gets a FRESH set
+            # of broker OS processes (like CI's per-run clusters) and a
+            # driver-registry reset (the drain once-latch is per-run)
+            from jepsen_tpu.client import native as native_mod
+            from jepsen_tpu.harness.localcluster import build_local_test
+
+            native_mod.reset(drain_wait_ms=200)
+            test, t = build_local_test(
+                scaled,
+                checker_backend=args.checker,
+                store_root=args.store,
+            )
+            try:
+                run = run_test(test)
+                return run.results, _collect_queue_lengths(
+                    test.db, test.nodes
+                )
+            finally:
+                t.close()
         test, cluster = build_sim_test(
             opts=scaled, checker_backend=args.checker, store_root=args.store
         )
@@ -793,7 +814,7 @@ def build_parser() -> argparse.ArgumentParser:
     m.add_argument("--rate", type=float, default=50.0)
     m.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
     m.add_argument("--store", default="store")
-    m.add_argument("--db", choices=("sim", "rabbitmq"), default="sim")
+    m.add_argument("--db", choices=("sim", "local", "rabbitmq"), default="sim")
     m.add_argument("--nodes", default="n1,n2,n3")
     m.add_argument("--archive-url", default=None)
     m.add_argument("--ssh-user", default="root")

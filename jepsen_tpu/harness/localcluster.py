@@ -64,12 +64,13 @@ class _Node:
         self.port = port
         self.admin_port = admin_port
         self.proc: subprocess.Popen | None = None
+        self.stderr_path: str | None = None
 
 
 class LocalProcTransport(Transport):
     """A :class:`Transport` whose "nodes" are local mini-broker processes."""
 
-    def __init__(self, n_nodes: int = 3, spawn_timeout_s: float = 10.0):
+    def __init__(self, n_nodes: int = 3, spawn_timeout_s: float = 30.0):
         self.spawn_timeout_s = spawn_timeout_s
         self._nodes: dict[str, _Node] = {}
         for _ in range(n_nodes):
@@ -136,6 +137,7 @@ class LocalProcTransport(Transport):
 
     def close(self) -> None:
         for n in self._nodes.values():
+            self._drop_stderr(n)
             if n.proc is not None and n.proc.poll() is None:
                 # a SIGSTOPped child ignores SIGTERM until resumed
                 try:
@@ -158,31 +160,67 @@ class LocalProcTransport(Transport):
         return cmd
 
     def _start(self, node: str) -> None:
+        import tempfile
+
         n = self._nodes[node]
         if n.proc is not None and n.proc.poll() is None:
             return  # already up (idempotent, like -detached)
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
-        n.proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "jepsen_tpu.harness.broker",
-                "--port", str(n.port), "--admin-port", str(n.admin_port),
-            ],
-            env=env,
-            stdout=subprocess.DEVNULL,
-            stderr=subprocess.DEVNULL,
+        fd, n.stderr_path = tempfile.mkstemp(
+            prefix=f"jt-broker-{n.port}-", suffix=".log"
         )
+        err_fh = os.fdopen(fd, "wb")
+        try:
+            n.proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "jepsen_tpu.harness.broker",
+                    "--port", str(n.port), "--admin-port", str(n.admin_port),
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=err_fh,
+            )
+        finally:
+            err_fh.close()
         deadline = time.monotonic() + self.spawn_timeout_s
         while time.monotonic() < deadline:
+            if n.proc.poll() is not None:  # died during startup
+                break
             try:
                 socket.create_connection(("127.0.0.1", n.port), 0.25).close()
+                self._drop_stderr(n)  # only failure paths need the tail
                 return
             except OSError:
                 time.sleep(0.05)
-        raise RuntimeError(f"broker process for {node} never listened")
+        tail = ""
+        try:
+            with open(n.stderr_path, "rb") as fh:
+                tail = fh.read()[-500:].decode(errors="replace")
+        except OSError:
+            pass
+        state = (
+            f"exited rc={n.proc.returncode}"
+            if n.proc.poll() is not None
+            else f"still starting after {self.spawn_timeout_s:.0f}s"
+        )
+        raise RuntimeError(
+            f"broker process for {node} never listened ({state})"
+            + (f"; stderr tail: {tail}" if tail.strip() else "")
+        )
+
+    @staticmethod
+    def _drop_stderr(n: _Node) -> None:
+        if n.stderr_path is not None:
+            try:
+                os.unlink(n.stderr_path)
+            except OSError:
+                pass
+            n.stderr_path = None
 
     def _kill(self, node: str) -> None:
         n = self._nodes[node]
+        self._drop_stderr(n)
         if n.proc is not None and n.proc.poll() is None:
             try:
                 n.proc.send_signal(signal.SIGCONT)  # SIGKILL beats STOP, but
@@ -256,3 +294,40 @@ class LocalProcTransport(Transport):
     def commands(self, node: str | None = None) -> list[str]:
         with self.lock:
             return [c for n, c in self.log if node is None or n == node]
+
+
+def build_local_test(
+    opts,
+    n_nodes: int = 3,
+    concurrency: int = 5,
+    checker_backend: str = "tpu",
+    store_root: str = "store",
+    workload: str = "queue",
+):
+    """The dress-rehearsal assembly in one call: ``build_rabbitmq_test``
+    over a fresh :class:`LocalProcTransport` with the fast-boot
+    ``RabbitMQDB`` waits.  Returns ``(test, transport)`` — the caller owns
+    ``transport.close()``."""
+    from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
+    from jepsen_tpu.suite import build_rabbitmq_test
+
+    t = LocalProcTransport(n_nodes=n_nodes)
+    try:
+        nodes = t.nodes
+        test = build_rabbitmq_test(
+            opts=opts,
+            nodes=nodes,
+            transport=t,
+            db=RabbitMQDB(
+                t, nodes, primary_wait_s=0.3, secondary_wait_s=0.3,
+                join_stagger_max_s=0.2,
+            ),
+            concurrency=concurrency,
+            checker_backend=checker_backend,
+            store_root=store_root,
+            workload=workload,
+        )
+    except BaseException:
+        t.close()
+        raise
+    return test, t

@@ -196,3 +196,19 @@ def test_db_local_dress_rehearsal(tmp_path, capsys):
     out = capsys.readouterr().out
     assert rc == 0
     assert GOOD_BANNER in out
+
+
+def test_matrix_db_local_one_config(tmp_path, capsys):
+    """The CI matrix against the local process cluster: config #1 runs
+    the full rabbitmq assembly on fresh broker OS processes and passes
+    the drained-to-zero cross-check."""
+    rc = main([
+        "matrix", "--db", "local", "--limit", "1",
+        "--time-scale", "0.02", "--rate", "120", "--checker", "cpu",
+        "--store", str(tmp_path / "s"),
+    ])
+    captured = capsys.readouterr()
+    assert rc == 0
+    summary = json.loads(captured.out)
+    assert summary[0]["status"] == "valid"
+    assert GOOD_BANNER in captured.err  # matrix banner rides stderr

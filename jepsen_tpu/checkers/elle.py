@@ -232,17 +232,39 @@ def _on_cycle_nodes(n: int, edges: set[tuple[int, int]]) -> set[int]:
     return out
 
 
-def _classify(g: TxnGraph, ww_cyc: set, wwr_cyc: set, all_cyc: set) -> dict:
+#: Consistency models per Adya / elle's hierarchy: each maps to the
+#: anomaly classes it PROSCRIBES.  ``read-committed`` proscribes the
+#: dirty-read/write classes (G0, G1a, G1b, G1c) but admits G2
+#: anti-dependency cycles — the level an atomic-commit-visibility system
+#: like AMQP tx actually promises; ``serializable`` additionally
+#: proscribes G2.
+CONSISTENCY_MODELS = ("serializable", "read-committed")
+
+
+def _classify(
+    g: TxnGraph,
+    ww_cyc: set,
+    wwr_cyc: set,
+    all_cyc: set,
+    model: str = "serializable",
+) -> dict:
     """Adya classification from the three union-graph on-cycle sets
     (``ww_cyc ⊆ wwr_cyc ⊆ all_cyc`` — adding edges preserves cycles):
     G0 = ww cycle; G1c = on a ww∪wr cycle but NOT a pure ww one (needs a
-    wr edge); G2 = needs at least one rw edge."""
+    wr edge); G2 = needs at least one rw edge.  ``model`` selects which
+    classes invalidate; every class is always *reported*."""
+    if model not in CONSISTENCY_MODELS:
+        raise ValueError(
+            f"unknown consistency model {model!r}; one of {CONSISTENCY_MODELS}"
+        )
     g1c = wwr_cyc - ww_cyc
     g2 = all_cyc - wwr_cyc
+    bad = bool(wwr_cyc or g.g1a or g.g1b or g.incompatible_order)
+    if model == "serializable":
+        bad = bad or bool(all_cyc)
     return {
-        VALID: not (
-            all_cyc or g.g1a or g.g1b or g.incompatible_order
-        ),
+        VALID: not bad,
+        "consistency-model": model,
         "txn-count": g.n,
         "G0": ww_cyc,
         "G0-count": len(ww_cyc),
@@ -262,12 +284,14 @@ def _classify(g: TxnGraph, ww_cyc: set, wwr_cyc: set, all_cyc: set) -> dict:
     }
 
 
-def check_elle_cpu(history: Sequence[Op]) -> dict[str, Any]:
+def check_elle_cpu(
+    history: Sequence[Op], model: str = "serializable"
+) -> dict[str, Any]:
     g = infer_txn_graph(history)
     ww_cyc = _on_cycle_nodes(g.n, g.ww)
     wwr_cyc = _on_cycle_nodes(g.n, g.ww | g.wr)
     all_cyc = _on_cycle_nodes(g.n, g.ww | g.wr | g.rw)
-    return _classify(g, ww_cyc, wwr_cyc, all_cyc)
+    return _classify(g, ww_cyc, wwr_cyc, all_cyc, model=model)
 
 
 # ---------------------------------------------------------------------------
@@ -399,7 +423,9 @@ def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
 
 
 def check_elle_batch(
-    histories: Sequence[Sequence[Op]], n_txns: int | None = None
+    histories: Sequence[Sequence[Op]],
+    n_txns: int | None = None,
+    model: str = "serializable",
 ) -> list[dict[str, Any]]:
     graphs = [infer_txn_graph(h) for h in histories]
     batch = pack_txn_graphs(graphs, n_txns=n_txns)
@@ -415,20 +441,34 @@ def check_elle_batch(
                 set(np.nonzero(g0[b])[0].tolist()),
                 set(np.nonzero(g1c[b])[0].tolist()),
                 set(np.nonzero(g2[b])[0].tolist()),
+                model=model,
             )
         )
     return out
 
 
 class ElleListAppend(Checker):
-    """Elle list-append serializability (BASELINE config #5)."""
+    """Elle list-append transaction checking (BASELINE config #5).
+
+    ``model`` selects the consistency level the SUT *claims* (elle's own
+    practice): ``serializable`` (default) proscribes every cycle class;
+    ``read-committed`` admits G2 anti-dependency cycles — the honest
+    level for AMQP tx, which promises atomic commit visibility but no
+    read isolation across keys (a live broker run WILL produce G2 under
+    concurrency, and that is the SUT's contract, not a bug found)."""
 
     name = "elle-list-append"
 
-    def __init__(self, backend: str = "tpu"):
+    def __init__(self, backend: str = "tpu", model: str = "serializable"):
         if backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {backend!r}")
+        if model not in CONSISTENCY_MODELS:
+            raise ValueError(
+                f"unknown consistency model {model!r}; "
+                f"one of {CONSISTENCY_MODELS}"
+            )
         self.backend = backend
+        self.model = model
 
     def check(
         self,
@@ -437,5 +477,5 @@ class ElleListAppend(Checker):
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
         if self.backend == "cpu":
-            return check_elle_cpu(history)
-        return check_elle_batch([history])[0]
+            return check_elle_cpu(history, model=self.model)
+        return check_elle_batch([history], model=self.model)[0]

@@ -123,7 +123,11 @@ def _checker_for(args, out_dir=None, history=None):
         return compose(
             {
                 "perf": Perf(out_dir=out_dir),
-                "elle": ElleListAppend(backend=backend),
+                "elle": ElleListAppend(
+                    backend=backend,
+                    model=getattr(args, "consistency_model", None)
+                    or "serializable",
+                ),
             }
         )
     if workload == "mutex":
@@ -153,6 +157,17 @@ def cmd_check(args) -> int:
     hpath = _resolve_history_path(Path(args.history)).resolve()
     history = read_history(hpath)
     out_dir = hpath.parent
+    if getattr(args, "consistency_model", None) is None:
+        # inherit the level the run was judged at: a live elle run is
+        # valid at its SUT's contractual level (read-committed for AMQP
+        # tx), and a bare re-check must not silently tighten the verdict
+        try:
+            prev = json.loads((out_dir / "results.json").read_text())
+            args.consistency_model = prev.get("elle", {}).get(
+                "consistency-model"
+            )
+        except (OSError, ValueError):
+            pass
     checker = _checker_for(args, out_dir=out_dir, history=history)
     t0 = time.perf_counter()
     result = checker.check({}, history)
@@ -385,6 +400,7 @@ def cmd_test(args) -> int:
         "network-partition": args.network_partition,
         "nemesis": args.nemesis,
         "publish-confirm-timeout": args.publish_confirm_timeout / 1000.0,
+        "read-timeout": args.read_timeout / 1000.0,
         "full-read-confirm-empties": args.full_read_confirm_empties,
         "recovery-sleep": args.recovery_sleep,
         "consumer-type": args.consumer_type,
@@ -394,6 +410,8 @@ def cmd_test(args) -> int:
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
+    if args.consistency_model:
+        opts["consistency-model"] = args.consistency_model
     local_cluster = None
     if args.db == "rabbitmq":
         try:
@@ -693,6 +711,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="analysis backend (the north-star dispatch seam)",
     )
     c.add_argument(
+        "--consistency-model",
+        choices=("serializable", "read-committed"),
+        default=None,
+        help="elle histories: isolation level to check against "
+        "(default: the level recorded with the run's results, else "
+        "serializable — so re-checking a live run that passed at its "
+        "SUT's contractual level doesn't silently tighten it)",
+    )
+    c.add_argument(
         "--wgl",
         action="store_true",
         help="also run the full Wing-Gong linearizability search "
@@ -772,6 +799,23 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"
+    )
+    t.add_argument(
+        "--consistency-model",
+        choices=("serializable", "read-committed"),
+        default=None,
+        help="elle workload: the isolation level to check the SUT "
+        "against (default: serializable for --db sim, read-committed "
+        "for live brokers — AMQP tx promises atomic commit visibility, "
+        "not read isolation, so G2 cycles are its contract)",
+    )
+    t.add_argument(
+        "--read-timeout",
+        type=float,
+        default=5000.0,
+        help="ms; stream workload: how long a cursor read waits for "
+        "records (a live AMQP read at the log tail holds its consumer "
+        "open this long when nothing arrives)",
     )
     t.add_argument(
         "--full-read-confirm-empties",

@@ -65,6 +65,11 @@ DEFAULT_OPTS: dict[str, Any] = {
     # offset proof is available (the x-stream-offset="last" probe is the
     # primary mechanism; this is the fallback heuristic's strictness)
     "full-read-confirm-empties": 1,
+    # stream cursor reads: how long a read waits for records (a live AMQP
+    # read at the log tail holds its consumer open this long when nothing
+    # arrives — size it to the workload's append cadence, not the 5 s
+    # publish deadline, or read-heavy mixes stall on the empty tail)
+    "read-timeout": 5.0,
     "recovery-sleep": 20.0,  # gen/sleep 20 before drain
     "consumer-type": "polling",
     "net-ticktime": 15,
@@ -213,10 +218,14 @@ def mutex_checker(backend: str = "tpu", with_perf: bool = True):
     return compose(checkers)
 
 
-def elle_checker(backend: str = "tpu", with_perf: bool = True):
+def elle_checker(
+    backend: str = "tpu",
+    with_perf: bool = True,
+    model: str = "serializable",
+):
     from jepsen_tpu.checkers.elle import ElleListAppend
 
-    checkers = {"elle": ElleListAppend(backend=backend)}
+    checkers = {"elle": ElleListAppend(backend=backend, model=model)}
     if with_perf:
         checkers["perf"] = Perf()
     return compose(checkers)
@@ -264,6 +273,7 @@ def build_sim_test(
         client = StreamClient(
             sim_stream_driver_factory(cluster),
             publish_confirm_timeout_s=o["publish-confirm-timeout"],
+            read_timeout_s=o["read-timeout"],
             full_read_confirm_empties=o["full-read-confirm-empties"],
         )
         generator = stream_generator(o)
@@ -275,7 +285,11 @@ def build_sim_test(
             txn_timeout_s=o["publish-confirm-timeout"],
         )
         generator = elle_generator(o, seed=sim_seed)
-        checker = elle_checker(checker_backend)
+        # the sim's txns apply under a global lock — strictly serializable
+        checker = elle_checker(
+            checker_backend,
+            model=o.get("consistency-model", "serializable"),
+        )
         name = "rabbitmq-elle-txn-sim"
     elif workload == "mutex":
         from jepsen_tpu.client.protocol import MutexClient
@@ -356,6 +370,7 @@ def build_rabbitmq_test(
         client = StreamClient(
             native_stream_driver_factory(),
             publish_confirm_timeout_s=o["publish-confirm-timeout"],
+            read_timeout_s=o["read-timeout"],
             full_read_confirm_empties=o["full-read-confirm-empties"],
         )
         generator = stream_generator(o)
@@ -367,7 +382,15 @@ def build_rabbitmq_test(
             txn_timeout_s=o["publish-confirm-timeout"],
         )
         generator = elle_generator(o)
-        checker = elle_checker(checker_backend)
+        # AMQP tx promises atomic commit visibility, NOT read isolation
+        # across keys: a live broker produces genuine G2 anti-dependency
+        # cycles under concurrency, so the honest default level for this
+        # SUT is read-committed (elle practice: check what the system
+        # claims); --consistency-model serializable tightens it
+        checker = elle_checker(
+            checker_backend,
+            model=o.get("consistency-model", "read-committed"),
+        )
         name = "rabbitmq-elle-txn"
     elif workload == "queue":
         client = QueueClient(

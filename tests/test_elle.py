@@ -178,3 +178,34 @@ def test_large_history_many_txns():
     assert not r["valid?"]
     assert sh.g1c <= r["G1c"]
     assert sh.g2 <= r["G2"]
+
+
+def test_consistency_model_levels():
+    """read-committed admits G2 (the AMQP-tx contract: atomic commit
+    visibility without read isolation) but still proscribes G0/G1;
+    serializable proscribes everything.  Every class is reported at
+    every level."""
+    import pytest
+    from jepsen_tpu.checkers.elle import check_elle_batch
+
+    g2h = synth_elle_history(ElleSynthSpec(n_txns=60, seed=46, g2_cycle=1))
+    g1h = synth_elle_history(ElleSynthSpec(n_txns=60, seed=47, g1c_cycle=1))
+
+    strict = check_elle_cpu(g2h.ops)  # default serializable
+    assert strict["valid?"] is False and strict["G2-count"] > 0
+    rc = check_elle_cpu(g2h.ops, model="read-committed")
+    assert rc["valid?"] is True
+    assert rc["G2-count"] == strict["G2-count"]  # reported, not hidden
+    assert rc["consistency-model"] == "read-committed"
+
+    # G1c invalidates at BOTH levels
+    for model in ("serializable", "read-committed"):
+        r = check_elle_cpu(g1h.ops, model=model)
+        assert r["valid?"] is False and r["G1c-count"] > 0, model
+
+    # the tensor path agrees
+    t = check_elle_batch([g2h.ops, g1h.ops], model="read-committed")
+    assert t[0]["valid?"] is True and t[1]["valid?"] is False
+
+    with pytest.raises(ValueError):
+        check_elle_cpu(g2h.ops, model="snapshot-isolation")

@@ -82,127 +82,7 @@ class PackedHistories:
         return self.type.shape[1]
 
 
-_COLUMNS = ("index", "process", "type", "f", "value", "time_ms", "latency_ms", "first")
-
-
-def _rows_for(history: Sequence[Op]) -> np.ndarray:
-    """Explode one history into an ``[n, 8]`` int32 row matrix (the last
-    column is the 0/1 first-row flag).
-
-    Vectorized: one C-level extraction pass over the ops, then numpy for
-    everything else — completion latencies by a stable sort on process
-    (a completion's latency is against the immediately preceding row of
-    its process iff that row is its open INVOKE; this is exactly the
-    open-invoke-table semantics, because a process has at most one open
-    op), and drain explosion by ``np.repeat``.  Packing is the host-side
-    wall-clock term of the batched-replay north star (10k × 1k-op
-    histories), where the previous per-op Python loop dominated
-    end-to-end time.
-    """
-    n = len(history)
-    if n == 0:
-        return np.zeros((0, len(_COLUMNS)), np.int32)
-    idx_l, proc_l, typ_l, f_l, time_l, val_l = zip(
-        *[
-            (op.index, op.process, op.type, op.f, op.time, op.value)
-            for op in history
-        ]
-    )
-    idx = np.asarray(idx_l, np.int32)
-    proc = np.asarray(proc_l, np.int32)
-    typ = np.asarray(typ_l, np.int32)
-    f = np.asarray(f_l, np.int32)
-    times = np.asarray(time_l, np.int64)  # ns: exceeds int32
-    t_ms = np.where(times >= 0, times // 1_000_000, -1)
-
-    # completion latency: stable-sort by process, pair each completion
-    # with its predecessor row of the same process when that row is an
-    # INVOKE with a valid time
-    order = np.argsort(proc, kind="stable")
-    sp, st, s_inv = proc[order], times[order], typ[order] == int(OpType.INVOKE)
-    ok = np.zeros(n, bool)
-    ok[1:] = (
-        ~s_inv[1:]
-        & (sp[1:] == sp[:-1])
-        & s_inv[:-1]
-        & (st[:-1] >= 0)
-        & (st[1:] >= 0)
-    )
-    lat_sorted = np.full(n, -1, np.int64)
-    lat_sorted[1:][ok[1:]] = (st[1:] - st[:-1])[ok[1:]] // 1_000_000
-    lat = np.empty(n, np.int64)
-    lat[order] = lat_sorted
-
-    # values + drain explosion: list values become one row each (an empty
-    # list becomes a single NO_VALUE row).  Single cheap pass: scalars
-    # resolve inline (``type is`` beats isinstance at this volume — the
-    # values pass dominated pack time), lists leave a sentinel and are
-    # exploded below only when present.
-    _LIST = NO_VALUE - 1  # impossible as a real value (values ≥ 0 or NO_VALUE)
-    scalar_vals = [
-        v
-        if type(v) is int  # exact-type fast path; subclasses fall through
-        else (
-            _LIST
-            if isinstance(v, (list, tuple))
-            else (int(v) if isinstance(v, int) else NO_VALUE)  # e.g. bool
-        )
-        for v in val_l
-    ]
-    plain = _LIST not in scalar_vals
-    if plain:
-        flat_vals = scalar_vals
-    else:
-        counts = np.ones(n, np.int64)
-        flat_vals = []
-        for r, v in enumerate(scalar_vals):
-            seq = val_l[r]
-            if v != _LIST or not isinstance(seq, (list, tuple)):
-                # scalar — including a pathological real value equal to
-                # the sentinel, which the type check disambiguates
-                flat_vals.append(v)
-                continue
-            if seq:
-                counts[r] = len(seq)
-                flat_vals.extend(
-                    x if isinstance(x, int) else NO_VALUE for x in seq
-                )
-            else:
-                flat_vals.append(NO_VALUE)
-
-    out = np.empty((len(flat_vals), len(_COLUMNS)), np.int32)
-    if plain:
-        rep = slice(None)
-        first = np.ones(n, np.int32)
-    else:
-        rep = np.repeat(np.arange(n), counts)
-        first = np.zeros(len(rep), np.int32)
-        first[np.cumsum(counts) - counts] = 1
-    v64 = np.asarray(flat_vals, np.int64)
-    i32 = np.iinfo(np.int32)
-    if v64.size and (
-        int(v64.max()) > i32.max
-        or int(v64.min()) < min(i32.min, _LIST)
-        or int(t_ms.max(initial=0)) > i32.max
-    ):
-        # fail LOUDLY: a silently int32-wrapped value would alias onto a
-        # legitimate one and evade pack_histories' value_space guard —
-        # out-of-range values are exactly what an "unexpected" anomaly
-        # produces (the pre-vectorization loop raised here via np.asarray)
-        raise OverflowError(
-            "op value or timestamp exceeds the int32 packing range "
-            f"(value range [{v64.min()}, {v64.max()}], "
-            f"max time_ms {t_ms.max(initial=0)})"
-        )
-    out[:, 0] = idx[rep]
-    out[:, 1] = proc[rep]
-    out[:, 2] = typ[rep]
-    out[:, 3] = f[rep]
-    out[:, 4] = v64.astype(np.int32)
-    out[:, 5] = t_ms[rep].astype(np.int32)
-    out[:, 6] = np.where(first == 1, lat[rep], -1).astype(np.int32)
-    out[:, 7] = first
-    return out
+from jepsen_tpu.history.rows import _COLUMNS, _rows_for  # noqa: E402,F401
 
 
 def pack_histories(
@@ -223,24 +103,36 @@ def pack_histories(
     """
     if not histories:
         raise ValueError("cannot pack an empty batch of histories")
-    mats = [_rows_for(h) for h in histories]
+    return pack_row_matrices(
+        [_rows_for(h) for h in histories],
+        length=length,
+        value_space=value_space,
+        to_device=to_device,
+    )
+
+
+def pack_row_matrices(
+    mats: Sequence[np.ndarray],
+    length: int | None = None,
+    value_space: int | None = None,
+    to_device: bool = True,
+) -> PackedHistories:
+    """Assemble pre-exploded ``[n, 8]`` row matrices (``_rows_for``) into
+    a :class:`PackedHistories`.  Split out of :func:`pack_histories` so
+    row explosion — the per-op half of packing — can run in parallel
+    worker processes (``history.parpack``) while this assembly stays in
+    the parent."""
+    if not mats:
+        raise ValueError("cannot pack an empty batch of histories")
     n_max = max(m.shape[0] for m in mats)
     L = length if length is not None else _round_up(n_max, LANE)
     if n_max > L:
         raise ValueError(f"history of exploded length {n_max} exceeds L={L}")
     B = len(mats)
 
-    cols = {c: np.full((B, L), -1, dtype=np.int32) for c in _COLUMNS}
-    cols["value"][:] = NO_VALUE
-    mask = np.zeros((B, L), dtype=bool)
-    vmax = 0
-    for b, m in enumerate(mats):
-        n = m.shape[0]
-        for ci, c in enumerate(_COLUMNS):
-            cols[c][b, :n] = m[:, ci]
-        mask[b, :n] = True
-        if n:
-            vmax = max(vmax, int(m[:, 4].max(initial=0)))
+    vmax = max(
+        (int(m[:, 4].max(initial=0)) for m in mats if m.shape[0]), default=0
+    )
     V = (
         value_space
         if value_space is not None
@@ -259,19 +151,45 @@ def pack_histories(
     # on-chip throughput vs all-int32): op codes in i8, values in i16 when
     # the value space allows (the scatter kernels route selected rows to
     # index V, so V itself must be representable).  Host-analysis columns
-    # (index/process/times) stay i32.
+    # (index/process/times) stay i32.  Columns are allocated in their
+    # final dtype (no whole-array astype copies — they were ~40% of
+    # assembly time at 10k×1k scale).
     val_dt = np.int16 if V <= np.iinfo(np.int16).max else np.int32
+    dtypes = {
+        "index": np.int32,
+        "process": np.int32,
+        "type": np.int8,
+        "f": np.int8,
+        "value": val_dt,
+        "time_ms": np.int32,
+        "latency_ms": np.int32,
+        "first": bool,
+    }
+    cols = {
+        c: np.full((B, L), -1, dtype=dt)
+        if c != "first"
+        else np.zeros((B, L), dtype=bool)
+        for c, dt in dtypes.items()
+    }
+    cols["value"][:] = NO_VALUE
+    mask = np.zeros((B, L), dtype=bool)
+    for b, m in enumerate(mats):
+        n = m.shape[0]
+        for ci, c in enumerate(_COLUMNS):
+            cols[c][b, :n] = m[:, ci]
+        mask[b, :n] = True
+
     conv = jax.numpy.asarray if to_device else np.asarray
     return PackedHistories(
         index=conv(cols["index"]),
         process=conv(cols["process"]),
-        type=conv(cols["type"].astype(np.int8)),
-        f=conv(cols["f"].astype(np.int8)),
-        value=conv(cols["value"].astype(val_dt)),
+        type=conv(cols["type"]),
+        f=conv(cols["f"]),
+        value=conv(cols["value"]),
         time_ms=conv(cols["time_ms"]),
         latency_ms=conv(cols["latency_ms"]),
         mask=conv(mask),
-        first=conv(cols["first"].astype(bool)),
+        first=conv(cols["first"]),
         value_space=V,
     )
 

@@ -183,3 +183,16 @@ def reindex(history: Iterable[Op]) -> list[Op]:
         op.index = i
         out.append(op)
     return out
+
+
+def workload_of(history) -> str:
+    """Classify a history's workload family by the client op kinds it
+    contains (jax-free — pack workers classify in-process)."""
+    for op in history:
+        if op.f in (OpF.APPEND, OpF.READ):
+            return "stream"
+        if op.f == OpF.TXN:
+            return "elle"
+        if op.f in (OpF.ACQUIRE, OpF.RELEASE):
+            return "mutex"
+    return "queue"

@@ -1,0 +1,113 @@
+"""Parallel host-side packing: row explosion in worker processes.
+
+At batched-replay scale (the north star: 10,000 × 1000-op histories) the
+device check runs at the HBM roofline and HOST packing is the wall
+clock.  Row explosion (``encode._rows_for``) is per-history and
+embarrassingly parallel; this module fans it out over worker processes
+— each worker either synthesizes its seed range or reads its file chunk
+itself (Op objects never cross the process boundary; only the compact
+``[n, 8]`` int32 row matrices come back) — while the single
+``pack_row_matrices`` assembly stays in the parent.
+
+Workers use the ``spawn`` start method (forking after the parent has
+initialized JAX/XLA threads is unsafe) and pin ``JAX_PLATFORMS=cpu``
+before any import so a tunneled chip plugin can never hang a pack
+worker (the round-1/2 failure mode this codebase guards everywhere).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+
+
+
+def _synth_queue_rows(args):  # pragma: no cover - runs in child processes
+    count, start_seed, n_ops, lost = args
+    from jepsen_tpu.history.rows import _rows_for
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+    return [
+        _rows_for(sh.ops)
+        for sh in synth_batch(
+            count, SynthSpec(n_ops=n_ops, seed=start_seed), lost=lost
+        )
+    ]
+
+
+def _read_rows(paths):  # pragma: no cover - runs in child processes
+    from jepsen_tpu.history.ops import workload_of
+    from jepsen_tpu.history.rows import _rows_for
+    from jepsen_tpu.history.store import read_history
+
+    out = []
+    for p in paths:
+        h = read_history(p)
+        out.append((workload_of(h), _rows_for(h)))
+    return out
+
+
+def _fan_out(fn, chunks, workers: int):
+    import multiprocessing as mp
+
+    # spawn-child hygiene, applied via the ENV (sitecustomize runs at the
+    # child's interpreter startup — before any initializer could act):
+    # strip the chip-plugin bootstrap site so children never import JAX
+    # at all (workers touch only numpy modules — history.rows/synth/
+    # store), and pin CPU in case anything pulls JAX in anyway.  spawn
+    # passes the parent's sys.path separately, so imports still resolve.
+    saved = {
+        k: os.environ.get(k) for k in ("PYTHONPATH", "JAX_PLATFORMS")
+    }
+    os.environ["PYTHONPATH"] = os.pathsep.join(
+        p
+        for p in (saved["PYTHONPATH"] or "").split(os.pathsep)
+        if p and "axon_site" not in p
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        ctx = mp.get_context("spawn")
+        with ctx.Pool(workers) as pool:
+            out = []
+            for part in pool.map(fn, chunks):
+                out.extend(part)
+            return out
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def synth_queue_rows_parallel(
+    count: int, n_ops: int, lost: int, workers: int, base_seed: int = 0
+):
+    """Synthesize + explode ``count`` queue histories across ``workers``
+    processes.  Seed-deterministic: identical row matrices to the serial
+    ``synth_batch`` → ``_rows_for`` path (chunk c covers seeds
+    ``base_seed + [start, start+k)``)."""
+    bounds = [
+        (count * w // workers, count * (w + 1) // workers)
+        for w in range(workers)
+    ]
+    chunks = [
+        (hi - lo, base_seed + lo, n_ops, lost)
+        for lo, hi in bounds
+        if hi > lo
+    ]
+    return _fan_out(_synth_queue_rows, chunks, len(chunks))
+
+
+def read_rows_parallel(paths: Sequence, workers: int):
+    """Read + explode stored histories (JSONL or EDN) across workers,
+    preserving order.  Returns ``[(workload, rows_matrix), ...]`` so the
+    caller can apply the same family filter the serial path does."""
+    paths = [str(p) for p in paths]
+    chunks = [
+        paths[len(paths) * w // workers : len(paths) * (w + 1) // workers]
+        for w in range(workers)
+    ]
+    chunks = [c for c in chunks if c]
+    return _fan_out(_read_rows, chunks, len(chunks))

@@ -224,3 +224,55 @@ def test_rows_for_matches_reference_loop():
     hbig = [Op(OpType.OK, OpF.ENQUEUE, 0, 2**40, time=1_000_000, index=0)]
     with pytest.raises(OverflowError):
         _rows_for(hbig)
+
+
+def test_parallel_pack_matches_serial():
+    """Worker-process packing (history.parpack) is seed-deterministic:
+    identical packed tensors to the serial synth->pack path (the workers
+    are spawn-isolated and jax-free; on a core-starved host the CLI caps
+    them, but correctness holds at any worker count)."""
+    import numpy as np
+
+    from jepsen_tpu.history.encode import pack_histories, pack_row_matrices
+    from jepsen_tpu.history.parpack import synth_queue_rows_parallel
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+    count, ops = 12, 120
+    serial = pack_histories(
+        [
+            sh.ops
+            for sh in synth_batch(count, SynthSpec(n_ops=ops), lost=1)
+        ],
+        to_device=False,
+    )
+    mats = synth_queue_rows_parallel(count, ops, lost=1, workers=3)
+    par = pack_row_matrices(mats, to_device=False)
+    assert par.value_space == serial.value_space
+    for field in ("index", "process", "type", "f", "value", "time_ms",
+                  "latency_ms", "mask", "first"):
+        np.testing.assert_array_equal(
+            getattr(par, field), getattr(serial, field), err_msg=field
+        )
+
+
+def test_parallel_read_tags_workload(tmp_path):
+    """read_rows_parallel tags every history with its workload family so
+    the CLI can apply the same mixed-store filter as the serial path."""
+    from jepsen_tpu.history.parpack import read_rows_parallel
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.history.synth import (
+        StreamSynthSpec,
+        SynthSpec,
+        synth_history,
+        synth_stream_history,
+    )
+
+    pq = tmp_path / "q.jsonl"
+    ps = tmp_path / "s.jsonl"
+    write_history_jsonl(pq, synth_history(SynthSpec(n_ops=30)).ops)
+    write_history_jsonl(
+        ps, synth_stream_history(StreamSynthSpec(n_ops=30)).ops
+    )
+    tagged = read_rows_parallel([pq, ps], workers=2)
+    assert [k for k, _ in tagged] == ["queue", "stream"]
+    assert all(m.shape[1] == 8 for _, m in tagged)

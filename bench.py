@@ -25,6 +25,8 @@ perf artifact — the round-1 rc=1 failure mode).
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -400,7 +402,173 @@ def _apply_cpu_scale() -> None:
     MUTEX_OPS = 32
 
 
-def main() -> None:
+def _bench_wgl_hard(details: dict) -> None:
+    """Chip-only: the partition-era WGL hard-history rows at w=6–7,
+    capacity 256 — the one configuration where `WGL_BENCH.md` projects a
+    plausible tensor win (compile-amortized, ratio <2× on host XLA).
+
+    Delegates to ``tools/bench_wgl.py --hard``, which runs each row in a
+    subprocess with a per-row deadline (the measured quantity *includes*
+    whether the while_loop-in-scan nest compiles tractably).  That
+    deadline kill is the known chip-wedge trigger (a client killed
+    mid-dispatch wedges the tunnel, observed round 2) and cannot be made
+    wedge-free — a hung XLA compile has no in-process preemption point —
+    so these rows run LAST, strictly after ``BENCH_DETAILS.json`` holds
+    the captured headline: the worst case costs future probes, never the
+    capture itself.  No outer timeout here for the same reason.
+    """
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "bench_wgl.py"
+    )
+    cmd = [
+        sys.executable, tool, "--hard",
+        "--n-ops", "200", "--windows", "6", "7",
+        "--capacity", "256", "--batch", "16", "--deadline", "1500",
+    ]
+    r = subprocess.run(cmd, capture_output=True, text=True)
+    rows = []
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+    if not rows:
+        rows = [{"error": (r.stderr or r.stdout)[-300:]}]
+    details["wgl_hard"] = rows
+    for row in rows:
+        print(f"# wgl_hard: {json.dumps(row)}", file=sys.stderr)
+
+
+#: always the repo-root copy, regardless of the invoker's cwd — the
+#: committed artifact is what harvest.needs_chip_refresh() reads
+DETAILS_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_DETAILS.json"
+)
+
+
+def _write_details(details: dict) -> None:
+    """Write ``BENCH_DETAILS.json`` (at the repo root); a CPU-fallback run
+    never clobbers an existing chip-measured file (the stdout JSON line
+    still records this run's labeled numbers for the round artifact)."""
+    try:
+        keep_existing = False
+        if details["backend"] != "tpu":
+            try:
+                with open(DETAILS_PATH) as fh:
+                    keep_existing = json.load(fh).get("backend") == "tpu"
+            except (OSError, ValueError, AttributeError):
+                keep_existing = False
+        if keep_existing:
+            print(
+                "# BENCH_DETAILS.json holds chip-measured numbers; "
+                "leaving it untouched (this run was a CPU fallback)",
+                file=sys.stderr,
+            )
+        else:
+            with open(DETAILS_PATH, "w") as fh:
+                json.dump(details, fh, indent=1)
+    except OSError as e:  # pragma: no cover - read-only repo dir
+        print(f"# could not write BENCH_DETAILS.json: {e}", file=sys.stderr)
+
+
+def _probe_chip(deadline: float) -> bool:
+    """One bounded backend probe in a throwaway subprocess (the watch
+    loop itself must never import jax — a hung plugin init would pin the
+    loop).  The kill-on-deadline here targets backend *enumeration*, not
+    an in-flight dispatch — the wedge-safe probe shape jaxenv uses."""
+    # the env pin must be re-applied as a *config* pin inside the probe:
+    # the tunnel's sitecustomize overrides jax_platforms at interpreter
+    # start, so the inherited JAX_PLATFORMS env var alone does not decide
+    # which platform devices() initializes
+    script = (
+        "import os, jax\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p: jax.config.update('jax_platforms', p)\n"
+        "jax.devices()\n"
+    )
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", script],
+            capture_output=True,
+            timeout=deadline,
+            env=os.environ.copy(),
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _watch(interval: float, budget: float) -> int:
+    """Harvest mode (VERDICT r3 #1): retry the probe every ``interval``
+    seconds so any tunnel-up window during the round gets captured; on a
+    healthy probe, run the full bench in a child (never outer-killed — a
+    deadline around real chip dispatches is the known wedge trigger) and
+    stop once it reports a genuine chip measurement.  ``budget``>0 caps
+    the watch in seconds; on exhaustion run one final (fallback-labeled)
+    bench so the round artifact exists either way."""
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        if _probe_chip(INIT_PROBE_DEADLINE_S):
+            # single-flight with CLI-spawned harvest children: two bench
+            # processes on the exclusive chip corrupt both measurements
+            from jepsen_tpu.utils import harvest
+
+            root = os.path.dirname(os.path.abspath(__file__))
+            if harvest._try_lock(root):
+                print(
+                    f"# watch: probe {attempt} healthy — running bench",
+                    file=sys.stderr,
+                )
+                try:
+                    r = subprocess.run(
+                        [sys.executable, os.path.abspath(__file__)],
+                        capture_output=True,
+                        text=True,
+                        env=os.environ.copy(),
+                    )
+                finally:
+                    harvest.release_lock(root)
+                sys.stderr.write(r.stderr)
+                line = (r.stdout.strip().splitlines() or [""])[-1]
+                try:
+                    if not json.loads(line).get("fallback", True):
+                        print(line)  # the chip-measured headline
+                        return 0
+                except ValueError:
+                    pass
+                print(
+                    f"# watch: probe was healthy but the bench fell "
+                    f"back (rc={r.returncode}) — continuing to watch",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"# watch: probe {attempt} healthy but another "
+                    f"harvest holds the lock — skipping this cycle",
+                    file=sys.stderr,
+                )
+        else:
+            print(
+                f"# watch: probe {attempt} unhealthy "
+                f"({time.monotonic() - t0:.0f}s elapsed)",
+                file=sys.stderr,
+            )
+        if budget and time.monotonic() - t0 > budget:
+            print(
+                "# watch: budget exhausted — running one final bench so "
+                "the artifact exists (will be fallback-labeled)",
+                file=sys.stderr,
+            )
+            _run_once()
+            return 0
+        time.sleep(interval)
+
+
+def _run_once() -> None:
     backend = _init_backend_with_retry()
     print(f"# backend ready: {backend}", file=sys.stderr)
     if backend != "tpu":
@@ -423,28 +591,12 @@ def main() -> None:
                 file=sys.stderr,
             )
 
-    try:
-        keep_existing = False
-        if details["backend"] != "tpu":
-            # a CPU-fallback run must not clobber the last CHIP-measured
-            # details file — the stdout JSON line still records this
-            # run's (labeled) numbers for the round artifact
-            try:
-                with open("BENCH_DETAILS.json") as fh:
-                    keep_existing = json.load(fh).get("backend") == "tpu"
-            except (OSError, ValueError, AttributeError):
-                keep_existing = False
-        if keep_existing:
-            print(
-                "# BENCH_DETAILS.json holds chip-measured numbers; "
-                "leaving it untouched (this run was a CPU fallback)",
-                file=sys.stderr,
-            )
-        else:
-            with open("BENCH_DETAILS.json", "w") as fh:
-                json.dump(details, fh, indent=1)
-    except OSError as e:  # pragma: no cover - read-only cwd
-        print(f"# could not write BENCH_DETAILS.json: {e}", file=sys.stderr)
+    _write_details(details)
+
+    if backend == "tpu":
+        # optional chip-only rows, after the details write (see docstring)
+        _bench_wgl_hard(details)
+        _write_details(details)
 
     print(
         json.dumps(
@@ -463,5 +615,75 @@ def main() -> None:
     )
 
 
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument(
+        "--watch",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="harvest mode: probe the chip every SECONDS and run the "
+        "bench whenever the tunnel answers, until a genuine chip "
+        "measurement lands",
+    )
+    p.add_argument(
+        "--watch-budget",
+        type=float,
+        default=0.0,
+        metavar="SECONDS",
+        help="give up watching after this many seconds and run one "
+        "final (fallback-labeled) bench; 0 = watch forever",
+    )
+    p.add_argument(
+        "--harvest-child", action="store_true", help=argparse.SUPPRESS
+    )
+    p.add_argument(
+        # set by utils/harvest.opportunistic: the spawner still holds the
+        # exclusive chip — wait for it to exit before dispatching
+        "--wait-pid", type=int, default=0, help=argparse.SUPPRESS
+    )
+    p.add_argument(
+        "--wait-max", type=float, default=3600.0, help=argparse.SUPPRESS
+    )
+    args = p.parse_args(argv)
+    if args.watch:
+        return _watch(args.watch, args.watch_budget)
+    try:
+        if args.wait_pid and not _await_pid_exit(args.wait_pid, args.wait_max):
+            print(
+                f"# spawner pid {args.wait_pid} still alive after "
+                f"{args.wait_max:.0f}s (a long-running sidecar?) — "
+                f"skipping this harvest rather than contending for the "
+                f"exclusive chip",
+                file=sys.stderr,
+            )
+            return 0
+        _run_once()
+    finally:
+        if args.harvest_child:
+            # spawned by utils/harvest.opportunistic — drop its lock
+            from jepsen_tpu.utils.harvest import release_lock
+
+            release_lock()
+    return 0
+
+
+def _await_pid_exit(pid: int, budget: float, poll_s: float = 5.0) -> bool:
+    """True once ``pid`` has exited; False when it outlives ``budget``."""
+    t0 = time.monotonic()
+    while True:
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return True
+        except OSError:
+            return True  # can't signal it — assume gone/unreachable
+        if time.monotonic() - t0 > budget:
+            return False
+        time.sleep(poll_s)
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -1047,7 +1047,12 @@ def main(argv=None) -> int:
         pin_cpu_platform()
     elif args.command != "serve-checker":  # sidecar guards its own init
         try:
-            ensure_backend()
+            if ensure_backend() == "tpu":
+                # the tunnel answers RIGHT NOW — the moment a chip bench
+                # capture must not be missed (VERDICT r3 #1)
+                from jepsen_tpu.utils.harvest import opportunistic
+
+                opportunistic()
         except TimeoutError as e:
             print(
                 f"# warning: {e}; falling back to the CPU backend",

@@ -327,6 +327,10 @@ def serve_forever(
 
     from jepsen_tpu.utils.jaxenv import ensure_backend, pin_cpu_platform
 
+    # NOTE: no opportunistic harvest here, deliberately — the sidecar
+    # never exits, so a spawned harvest child could never take the
+    # exclusive chip; it would only hold the single-flight lock and
+    # starve real capture windows (see utils/harvest.opportunistic).
     try:
         backend = ensure_backend()
     except TimeoutError as e:

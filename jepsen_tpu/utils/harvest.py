@@ -1,0 +1,204 @@
+"""Opportunistic on-chip benchmark capture.
+
+The round artifact problem (VERDICT r1–r3): ``bench.py`` runs once, at the
+end of a round, and if the tunneled chip happens to be wedged *at that
+moment* the round records a CPU fallback — three rounds running.  The fix
+is to stop treating capture as an event and treat it as a harvest: every
+invocation that already initialized a healthy TPU backend (``check
+--checker tpu``, ``bench-check``, the checker sidecar) calls
+:func:`opportunistic`, which — when the committed ``BENCH_DETAILS.json``
+does not yet hold a provenance-stamped chip measurement — spawns one
+detached ``bench.py`` run to refresh it.  ``bench.py --watch N`` is the
+active form: retry the probe on an interval so any tunnel-up window during
+a round gets harvested without a human at the keyboard.
+
+Safety properties:
+
+- the harvest child never contends with its spawner for the (exclusive)
+  chip: it is told the spawner's pid (``--wait-pid``) and only starts the
+  bench after that process has exited, giving up after a bounded wait;
+- single-flight: a pid lockfile names the harvest *child* (claimed
+  atomically with ``O_EXCL``, then atomically retargeted to the child's
+  pid with ``os.replace``); stale locks (dead pid) are reaped;
+- never spawns from inside ``bench.py`` (env guard) — no fork bombs;
+- the spawned run inherits ``bench.py``'s own guarantees: CPU fallbacks
+  never clobber chip-measured details, provenance is stamped on write.
+
+Replaces the round-3 pattern of a human re-probing the tunnel by hand
+(equivalent capability in the reference's world: a CI cron re-running
+``ci/jepsen-test.sh`` — ``/root/reference/ci/check-last-execution.sh``
+assumes *scheduled* runs, not one-shot luck).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+#: set in the spawned bench process so it never re-triggers a harvest
+GUARD_ENV = "JEPSEN_TPU_HARVEST_CHILD"
+
+
+def _repo_root() -> str:
+    """The directory holding ``bench.py`` — this package's grandparent."""
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def needs_chip_refresh(root: str | None = None) -> bool:
+    """True when ``BENCH_DETAILS.json`` does not hold a provenance-stamped
+    chip measurement (missing, unreadable, CPU-backend, or pre-provenance
+    — the round-2 file the verdict flagged carries numbers but no
+    evidence block)."""
+    import json
+
+    path = os.path.join(root or _repo_root(), "BENCH_DETAILS.json")
+    try:
+        with open(path) as fh:
+            details = json.load(fh)
+    except (OSError, ValueError):
+        return True
+    return not (
+        details.get("backend") == "tpu"
+        and isinstance(details.get("provenance"), dict)
+    )
+
+
+def _lock_path(root: str) -> str:
+    return os.path.join(root, "store", "harvest.lock")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True  # e.g. EPERM: someone owns it — treat as live
+
+
+def _try_lock(root: str) -> bool:
+    """Single-flight claim.  The only acquisition path is the atomic
+    ``O_EXCL`` create.  A stale lock (dead/garbage pid) is reaped by
+    first *renaming* it to a per-reaper name — rename is atomic, so of
+    two racing reapers exactly one wins the reap and retries the create;
+    the loser's rename raises and it just retries the create (losing to
+    the winner).  This closes the unlink/recreate race where a second
+    reaper could unlink the winner's freshly-created lock."""
+    path = _lock_path(root)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    for _ in range(2):
+        try:
+            with open(path, "x") as fh:
+                fh.write(str(os.getpid()))
+            return True
+        except FileExistsError:
+            try:
+                with open(path) as fh:
+                    pid = int(fh.read().strip() or "0")
+                if pid and _pid_alive(pid):
+                    return False  # a live harvest owns the claim
+            except (OSError, ValueError):
+                pass  # garbage contents — reap
+            reaped = f"{path}.reaped.{os.getpid()}"
+            try:
+                os.rename(path, reaped)  # atomic: one reaper wins
+                os.unlink(reaped)
+            except OSError:
+                pass  # lost the reap race — retry the create anyway
+    return False
+
+
+def _retarget_lock(root: str, pid: int) -> None:
+    """Atomically point the held lock at ``pid`` (the spawned child), so
+    liveness checks track the process that actually runs the bench, not
+    the short-lived CLI that spawned it."""
+    path = _lock_path(root)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "w") as fh:
+            fh.write(str(pid))
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def release_lock(root: str | None = None) -> None:
+    """Drop the lock (the detached bench child calls this on exit)."""
+    try:
+        os.unlink(_lock_path(root or _repo_root()))
+    except OSError:
+        pass
+
+
+def opportunistic(root: str | None = None, log_name: str = "harvest.log") -> bool:
+    """If this process holds a healthy TPU backend and the committed
+    details file lacks a chip measurement, spawn one detached ``bench.py``
+    run to capture it.  Returns True when a harvest was launched.
+
+    Call *after* a successful ``ensure_backend()`` that returned ``"tpu"``
+    — the caller has proven the tunnel answers, which is exactly the
+    moment capture must not be missed.  The chip is exclusive-access, so
+    the child is handed this process's pid and waits for it to exit
+    before dispatching anything (``bench.py --wait-pid``).  Do NOT call
+    from a process that never exits (the sidecar): the child would hold
+    the single-flight lock for its whole bounded wait, starving real
+    capture opportunities, and still never run.
+
+    Best-effort by contract: no failure here (read-only checkout,
+    permission errors, fork limits) may ever sink the primary command —
+    every exception is swallowed into ``return False``.
+    """
+    try:
+        return _opportunistic(root, log_name)
+    except Exception as e:  # noqa: BLE001 - harvest must never hurt
+        print(
+            f"# harvest skipped ({type(e).__name__}: {e})", file=sys.stderr
+        )
+        return False
+
+
+def _opportunistic(root: str | None, log_name: str) -> bool:
+    if os.environ.get(GUARD_ENV):
+        return False  # we ARE the harvest
+    root = root or _repo_root()
+    bench = os.path.join(root, "bench.py")
+    if not os.path.exists(bench) or not needs_chip_refresh(root):
+        return False
+    if not _try_lock(root):
+        return False
+    log_path = os.path.join(root, "store", log_name)
+    env = dict(os.environ, **{GUARD_ENV: "1"})
+    try:
+        with open(log_path, "ab") as log:
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    bench,
+                    "--harvest-child",
+                    "--wait-pid",
+                    str(os.getpid()),
+                ],
+                cwd=root,
+                stdout=log,
+                stderr=subprocess.STDOUT,
+                env=env,
+                start_new_session=True,  # outlive the CLI invocation
+            )
+    except OSError:
+        release_lock(root)
+        return False
+    _retarget_lock(root, proc.pid)
+    print(
+        f"# chip healthy and BENCH_DETAILS.json lacks a chip measurement "
+        f"— harvest scheduled for when this process exits "
+        f"(log: {log_path})",
+        file=sys.stderr,
+    )
+    return True

@@ -467,8 +467,12 @@ def _write_details(details: dict) -> None:
                 file=sys.stderr,
             )
         else:
-            with open(DETAILS_PATH, "w") as fh:
+            # atomic: concurrent readers (harvest.needs_chip_refresh on
+            # every chip CLI start) must never see a half-written file
+            tmp = f"{DETAILS_PATH}.{os.getpid()}.tmp"
+            with open(tmp, "w") as fh:
                 json.dump(details, fh, indent=1)
+            os.replace(tmp, DETAILS_PATH)
     except OSError as e:  # pragma: no cover - read-only repo dir
         print(f"# could not write BENCH_DETAILS.json: {e}", file=sys.stderr)
 
@@ -563,7 +567,23 @@ def _watch(interval: float, budget: float) -> int:
                 "the artifact exists (will be fallback-labeled)",
                 file=sys.stderr,
             )
-            _run_once()
+            from jepsen_tpu.utils import harvest
+
+            root = os.path.dirname(os.path.abspath(__file__))
+            # the final run still honors single-flight: if another harvest
+            # is mid-bench right now, IT produces the artifact — benching
+            # beside it on the exclusive chip would corrupt both
+            if not harvest._try_lock(root):
+                print(
+                    "# watch: another harvest is running — it owns the "
+                    "artifact; exiting without a duplicate bench",
+                    file=sys.stderr,
+                )
+                return 0
+            try:
+                _run_once()
+            finally:
+                harvest.release_lock(root)
             return 0
         time.sleep(interval)
 

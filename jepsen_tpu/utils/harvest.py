@@ -81,35 +81,57 @@ def _pid_alive(pid: int) -> bool:
 
 
 def _try_lock(root: str) -> bool:
-    """Single-flight claim.  The only acquisition path is the atomic
-    ``O_EXCL`` create.  A stale lock (dead/garbage pid) is reaped by
-    first *renaming* it to a per-reaper name — rename is atomic, so of
-    two racing reapers exactly one wins the reap and retries the create;
-    the loser's rename raises and it just retries the create (losing to
-    the winner).  This closes the unlink/recreate race where a second
-    reaper could unlink the winner's freshly-created lock."""
+    """Single-flight claim.  The only acquisition path is an atomic
+    hardlink of a pre-written pid file.  A stale lock (dead/garbage pid)
+    is reaped by first *renaming* it to a per-reaper name — rename is
+    atomic, so of two racing reapers exactly one wins the reap and
+    retries the claim; the loser just retries the claim (losing to the
+    winner).  This closes both the unlink/recreate race (a second reaper
+    unlinking the winner's fresh lock) and the empty-lock race (a lock
+    observed between create and pid write reading as reapable)."""
     path = _lock_path(root)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    for _ in range(2):
+    # claim = hardlink of a fully-written pid file: the lock can never be
+    # observed existing-but-empty (an open('x')+write claim can — and an
+    # empty lock reads as pid 0, i.e. reapable garbage, letting a second
+    # claimant destroy a live lock)
+    tmp = f"{path}.claim.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(str(os.getpid()))
+    try:
+        for _ in range(2):
+            try:
+                os.link(tmp, path)
+                return True
+            except FileExistsError:
+                if not _reap_if_stale(path):
+                    return False
+        return False
+    finally:
         try:
-            with open(path, "x") as fh:
-                fh.write(str(os.getpid()))
-            return True
-        except FileExistsError:
-            try:
-                with open(path) as fh:
-                    pid = int(fh.read().strip() or "0")
-                if pid and _pid_alive(pid):
-                    return False  # a live harvest owns the claim
-            except (OSError, ValueError):
-                pass  # garbage contents — reap
-            reaped = f"{path}.reaped.{os.getpid()}"
-            try:
-                os.rename(path, reaped)  # atomic: one reaper wins
-                os.unlink(reaped)
-            except OSError:
-                pass  # lost the reap race — retry the create anyway
-    return False
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def _reap_if_stale(path: str) -> bool:
+    """Remove a dead-holder lock; True if the caller may retry the claim."""
+    try:
+        with open(path) as fh:
+            pid = int(fh.read().strip() or "0")
+        if pid and _pid_alive(pid):
+            return False  # a live harvest owns the claim
+    except FileNotFoundError:
+        return True  # already reaped by someone — retry the claim
+    except (OSError, ValueError):
+        pass  # garbage contents — reap
+    reaped = f"{path}.reaped.{os.getpid()}"
+    try:
+        os.rename(path, reaped)  # atomic: one reaper wins
+        os.unlink(reaped)
+    except OSError:
+        pass  # lost the reap race — the winner's claim stands; retry anyway
+    return True
 
 
 def _retarget_lock(root: str, pid: int) -> None:

@@ -133,19 +133,23 @@ class TestOpportunistic:
         assert not harvest.opportunistic(root)
 
 
+def _load_bench(name="bench_under_test"):
+    """Import bench.py (not a package module) fresh under ``name``."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
 class TestHarvestChild:
     """bench.py's --harvest-child/--wait-pid contract, unit-level."""
 
     def _bench_mod(self):
-        import importlib.util
-
-        spec = importlib.util.spec_from_file_location(
-            "bench_child_under_test",
-            os.path.join(os.path.dirname(__file__), "..", "bench.py"),
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod
+        return _load_bench("bench_child_under_test")
 
     def test_await_pid_exit(self):
         import subprocess
@@ -200,15 +204,7 @@ class TestWatchLoop:
     """Unit-level: the loop's probe/run/stop protocol, fakes for both."""
 
     def _bench_mod(self):
-        import importlib.util
-
-        spec = importlib.util.spec_from_file_location(
-            "bench_under_test",
-            os.path.join(os.path.dirname(__file__), "..", "bench.py"),
-        )
-        mod = importlib.util.module_from_spec(spec)
-        spec.loader.exec_module(mod)
-        return mod
+        return _load_bench()
 
     def _stub_lock(self, monkeypatch, available=True):
         import jepsen_tpu.utils.harvest as hv
@@ -276,6 +272,7 @@ class TestWatchLoop:
 
     def test_budget_exhaustion_runs_fallback_bench(self, monkeypatch):
         bench = self._bench_mod()
+        self._stub_lock(monkeypatch)
         monkeypatch.setattr(bench, "_probe_chip", lambda d: False)
         ran = []
         monkeypatch.setattr(bench, "_run_once", lambda: ran.append(1))

@@ -1,0 +1,331 @@
+"""Raft quorum replication for the mini broker (harness/replication.py).
+
+Covers the state machine's determinism, then live 3-node clusters over
+real TCP: election, majority-commit, per-link partition semantics (leader
+step-down, majority-side failover), heal/catch-up with truncation, the
+restarted-node grace period, and the seeded ``confirm-before-quorum`` bug
+whose confirmed-then-truncated writes are the red-run proof downstream.
+"""
+
+from __future__ import annotations
+
+import base64
+import socket
+import time
+
+import pytest
+
+from jepsen_tpu.harness.replication import (
+    QueueMachine,
+    RaftNode,
+    ReplicatedBackend,
+)
+
+
+def _b64(b: bytes) -> str:
+    return base64.b64encode(b).decode()
+
+
+class TestQueueMachine:
+    def test_enq_deq_settle_roundtrip(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "q"})
+        m.apply(2, {"k": "enq", "q": "q", "body": _b64(b"7"), "ts": 0.0})
+        msg = m.apply(3, {"k": "deq", "q": "q", "owner": "n1|c1", "now": 1.0})
+        assert msg.body == b"7" and msg.mid == "m2"
+        assert m.counts(1.0) == {"q": 1}  # inflight still counts
+        m.apply(4, {"k": "settle", "owner": "n1|c1", "mid": msg.mid})
+        assert m.counts(1.0) == {"q": 0}
+
+    def test_settle_wrong_owner_is_noop(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "q"})
+        m.apply(2, {"k": "enq", "q": "q", "body": _b64(b"x"), "ts": 0.0})
+        msg = m.apply(3, {"k": "deq", "q": "q", "owner": "n1|c1", "now": 0.0})
+        m.apply(4, {"k": "settle", "owner": "n2|c9", "mid": msg.mid})
+        assert m.counts(0.0) == {"q": 1}
+
+    def test_requeue_owner_and_node(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "q"})
+        for i in range(3):
+            m.apply(
+                2 + i, {"k": "enq", "q": "q", "body": _b64(b"%d" % i),
+                        "ts": 0.0}
+            )
+        a = m.apply(5, {"k": "deq", "q": "q", "owner": "n1|c1", "now": 0.0})
+        b = m.apply(6, {"k": "deq", "q": "q", "owner": "n1|c2", "now": 0.0})
+        c = m.apply(7, {"k": "deq", "q": "q", "owner": "n2|c1", "now": 0.0})
+        assert {x.body for x in (a, b, c)} == {b"0", b"1", b"2"}
+        m.apply(8, {"k": "requeue_owner", "owner": "n1|c2"})
+        assert len(m.queues["q"]) == 1
+        m.apply(9, {"k": "requeue_node", "node": "n1"})
+        assert len(m.queues["q"]) == 2  # n1|c1 came back; n2|c1 still out
+
+    def test_deterministic_ttl_expiry_with_dlx(self):
+        m = QueueMachine()
+        m.apply(
+            1,
+            {"k": "declare", "q": "q", "ttl_ms": 100, "dlx": "q.dead"},
+        )
+        m.apply(2, {"k": "declare", "q": "q.dead"})
+        m.apply(3, {"k": "enq", "q": "q", "body": _b64(b"v"), "ts": 0.0})
+        # counts() simulates expiry without mutating (advisor r3 #5)
+        assert m.counts(50.0) == {"q": 1, "q.dead": 0}
+        assert m.counts(150.0) == {"q": 0, "q.dead": 1}
+        assert len(m.queues["q"]) == 1  # still un-mutated
+        # DEQ at now=150 performs the expiry: q empty, dead-letter holds it
+        assert (
+            m.apply(4, {"k": "deq", "q": "q", "owner": "o", "now": 150.0})
+            is None
+        )
+        got = m.apply(
+            5, {"k": "deq", "q": "q.dead", "owner": "o", "now": 150.0}
+        )
+        assert got.body == b"v"
+
+    def test_txn_applies_atomically_in_order(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "q"})
+        m.apply(
+            2,
+            {
+                "k": "txn",
+                "ops": [
+                    {"k": "enq", "q": "q", "body": _b64(b"a"), "ts": 0.0},
+                    {"k": "enq", "q": "q", "body": _b64(b"b"), "ts": 0.0},
+                ],
+            },
+        )
+        assert [x.body for x in m.queues["q"]] == [b"a", b"b"]
+        assert [x.mid for x in m.queues["q"]] == ["m2.0", "m2.1"]
+
+    def test_stream_append_and_snapshot(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "s", "qtype": "stream"})
+        m.apply(2, {"k": "enq", "q": "s", "body": _b64(b"r0"), "ts": 0.0})
+        m.apply(3, {"k": "enq", "q": "s", "body": _b64(b"r1"), "ts": 0.0})
+        assert m.stream_snapshot("s") == [b"r0", b"r1"]
+        assert m.counts(0.0) == {"s": 2}
+
+
+# ---------------------------------------------------------------------------
+# Live clusters
+# ---------------------------------------------------------------------------
+
+FAST = dict(election_timeout=(0.15, 0.3), heartbeat_s=0.04, dead_owner_s=0.8)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _mk_cluster(n=3, seed_bug=None, **kw):
+    names = [f"n{i}" for i in range(n)]
+    peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
+    opts = {**FAST, **kw}
+    nodes = {
+        nm: ReplicatedBackend(
+            nm, peers, seed_bug=seed_bug if nm else None, **opts
+        )
+        for nm in names
+    }
+    return nodes
+
+
+def _wait_leader(nodes, timeout=5.0, among=None):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [
+            nm
+            for nm, b in nodes.items()
+            if (among is None or nm in among) and b.raft.is_leader()
+        ]
+        if leaders:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no leader elected")
+
+
+def _shutdown(nodes):
+    for b in nodes.values():
+        b.stop()
+
+
+@pytest.fixture
+def cluster():
+    nodes = _mk_cluster()
+    try:
+        yield nodes
+    finally:
+        _shutdown(nodes)
+
+
+def _partition(nodes, group_a, group_b):
+    """Cut every cross-group link, both directions (complete grudge)."""
+    for a in group_a:
+        for b in group_b:
+            nodes[a].raft.block(b)
+            nodes[b].raft.block(a)
+
+
+def _heal(nodes):
+    for b in nodes.values():
+        b.raft.unblock_all()
+
+
+class TestRaftCluster:
+    def test_elects_leader_and_commits_everywhere(self, cluster):
+        leader = _wait_leader(cluster)
+        b = cluster[leader]
+        b.declare("q")
+        assert b.enqueue("q", b"v1", b"")
+        # committed state reaches every replica
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if all(
+                len(x.machine.queues.get("q", ())) == 1
+                for x in cluster.values()
+            ):
+                break
+            time.sleep(0.02)
+        for x in cluster.values():
+            assert [m.body for m in x.machine.queues["q"]] == [b"v1"]
+
+    def test_follower_forwards_to_leader(self, cluster):
+        leader = _wait_leader(cluster)
+        follower = next(nm for nm in cluster if nm != leader)
+        fb = cluster[follower]
+        fb.declare("q")
+        assert fb.enqueue("q", b"fwd", b"")
+        msg = fb.dequeue("q", owner=f"{follower}|c1")
+        assert msg is not None and msg.body == b"fwd"
+        fb.settle(f"{follower}|c1", msg.mid)
+        assert cluster[leader].counts()["q"] == 0
+
+    def test_minority_leader_steps_down_majority_elects(self, cluster):
+        leader = _wait_leader(cluster)
+        others = [nm for nm in cluster if nm != leader]
+        _partition(cluster, [leader], others)
+        # majority side elects a fresh leader
+        new_leader = _wait_leader(
+            {nm: cluster[nm] for nm in others}, timeout=5.0
+        )
+        assert new_leader != leader
+        # the isolated ex-leader steps down (cannot confirm)
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            if not cluster[leader].raft.is_leader():
+                break
+            time.sleep(0.02)
+        assert not cluster[leader].raft.is_leader()
+        # and an enqueue at the minority node does NOT confirm
+        assert not cluster[leader].enqueue("q", b"x", b"")
+
+    def test_heal_catches_up_and_truncates_divergence(self, cluster):
+        leader = _wait_leader(cluster)
+        lb = cluster[leader]
+        lb.declare("q")
+        assert lb.enqueue("q", b"before", b"")
+        others = [nm for nm in cluster if nm != leader]
+        _partition(cluster, [leader], others)
+        new_leader = _wait_leader(
+            {nm: cluster[nm] for nm in others}, timeout=5.0
+        )
+        assert cluster[new_leader].enqueue("q", b"majority", b"")
+        _heal(cluster)
+        # the old leader rejoins and converges on the majority's history
+        deadline = time.monotonic() + 4.0
+        while time.monotonic() < deadline:
+            bodies = [m.body for m in lb.machine.queues.get("q", ())]
+            if bodies == [b"before", b"majority"]:
+                break
+            time.sleep(0.05)
+        assert [m.body for m in lb.machine.queues["q"]] == [
+            b"before",
+            b"majority",
+        ]
+
+    def test_confirmed_quorum_write_survives_leader_kill(self):
+        nodes = _mk_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            lb = nodes[leader]
+            lb.declare("q")
+            assert lb.enqueue("q", b"safe", b"")
+            lb.stop()  # SIGKILL stand-in
+            rest = {nm: b for nm, b in nodes.items() if nm != leader}
+            new_leader = _wait_leader(rest, timeout=5.0)
+            msg = rest[new_leader].dequeue("q", owner="x|c")
+            assert msg is not None and msg.body == b"safe"
+        finally:
+            _shutdown(nodes)
+
+
+class TestSeededBug:
+    def test_confirm_before_quorum_loses_confirmed_write(self):
+        """The whole point of the seeded bug: a write confirmed by the
+        buggy leader while isolated is truncated on heal — an
+        acknowledged-then-lost write the checker must catch."""
+        names = ["n0", "n1", "n2"]
+        peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
+        nodes = {
+            nm: ReplicatedBackend(
+                nm, peers, seed_bug="confirm-before-quorum", **FAST
+            )
+            for nm in names
+        }
+        try:
+            leader = _wait_leader(nodes)
+            lb = nodes[leader]
+            lb.declare("q")
+            others = [nm for nm in names if nm != leader]
+            _partition(nodes, [leader], others)
+            # the buggy leader confirms instantly with no quorum (before
+            # step-down kicks in)
+            assert lb.enqueue("q", b"doomed", b"")
+            new_leader = _wait_leader(
+                {nm: nodes[nm] for nm in others}, timeout=5.0
+            )
+            assert nodes[new_leader].enqueue("q", b"kept", b"")
+            _heal(nodes)
+            deadline = time.monotonic() + 4.0
+            while time.monotonic() < deadline:
+                bodies = [
+                    m.body for m in lb.machine.queues.get("q", ())
+                ]
+                if bodies == [b"kept"]:
+                    break
+                time.sleep(0.05)
+            # "doomed" was CONFIRMED to the client yet is gone everywhere
+            for b in nodes.values():
+                assert [m.body for m in b.machine.queues["q"]] == [b"kept"]
+        finally:
+            _shutdown(nodes)
+
+
+class TestDeadOwnerRequeue:
+    def test_inflight_of_dead_node_is_requeued(self):
+        nodes = _mk_cluster()
+        try:
+            leader = _wait_leader(nodes)
+            lb = nodes[leader]
+            lb.declare("q")
+            assert lb.enqueue("q", b"v", b"")
+            victim = next(nm for nm in nodes if nm != leader)
+            msg = nodes[victim].dequeue("q", owner=f"{victim}|c1")
+            assert msg is not None
+            assert lb.counts()["q"] == 1  # inflight
+            nodes[victim].stop()  # node dies holding the delivery
+            deadline = time.monotonic() + 5.0
+            redelivered = None
+            while time.monotonic() < deadline:
+                redelivered = lb.dequeue("q", owner=f"{leader}|c9")
+                if redelivered is not None:
+                    break
+                time.sleep(0.1)
+            assert redelivered is not None and redelivered.body == b"v"
+        finally:
+            _shutdown(nodes)

@@ -131,12 +131,20 @@ class _ConnState:
     lock: threading.Lock = field(default_factory=threading.Lock)
     publish_seq: dict = field(default_factory=dict)  # channel -> seq
     next_tag: int = 1
-    unacked: dict = field(default_factory=dict)  # tag -> (queue, _Message)
+    # tag -> (queue, _Message) locally; (queue, mid:str) in replicated mode
+    unacked: dict = field(default_factory=dict)
     consuming_queue: str | None = None
+    consuming_ch: int = 1  # the channel Basic.Consume arrived on
     consuming_noack: bool = False
+    # delivery serialization: pushes for one conn may be triggered from
+    # its serve thread AND the kick loop; frames of two deliveries must
+    # never interleave on the wire
+    deliver_lock: threading.Lock = field(default_factory=threading.Lock)
+    deliver_again: bool = False
     confirm_channels: set = field(default_factory=set)
     tx_channels: set = field(default_factory=set)  # tx.select per channel
     tx_buffer: dict = field(default_factory=dict)  # ch -> [(queue, body)]
+    owner: str = ""  # replicated-mode delivery owner id ("node|cN")
     open: bool = True
 
 
@@ -152,8 +160,15 @@ class MiniAmqpBroker:
         duplicate_append_every: int = 0,
         dirty_tx_reads: bool = False,
         fragment_max: int = 0,
+        replication=None,
     ):
         self.host = host
+        # replicated mode: a harness.replication.ReplicatedBackend owns
+        # ALL queue/stream state (this broker becomes one cluster node);
+        # the single-broker fault-injection knobs (lose_acked_every, …)
+        # are local-state faults and do not apply — the replicated-mode
+        # seeded fault is the Raft layer's seed_bug instead
+        self.replication = replication
         # fragment_max > 0: every outgoing byte stream is sent in random
         # 1..fragment_max-byte chunks — clients' frame reassembly must
         # survive arbitrarily split TCP reads (codec-fuzz surface)
@@ -175,9 +190,21 @@ class MiniAmqpBroker:
         self._published = 0
         self._delivered = 0
         self._appended = 0
+        self._conn_seq = 0
+        self._owner_salt = f"{_random.Random().getrandbits(32):08x}-"
         self._conns: list[_ConnState] = []
         self._accept_thread: threading.Thread | None = None
+        self._kick = threading.Event()
         self._running = False
+        self._stopped = False
+        if replication is not None:
+            # replicated applies (on any node) may make messages
+            # deliverable HERE; the apply path holds raft locks, so it
+            # only signals — this thread does the actual push delivery
+            replication.on_visible = self._kick.set
+            threading.Thread(
+                target=self._kick_loop, daemon=True
+            ).start()
 
     # ---- lifecycle -------------------------------------------------------
     def start(self) -> "MiniAmqpBroker":
@@ -186,10 +213,40 @@ class MiniAmqpBroker:
             target=self._accept_loop, daemon=True
         )
         self._accept_thread.start()
+        if self.replication is not None:
+            # sweep any inflight deliveries a previous incarnation of
+            # this node left behind: a fast restart (< dead_owner_s)
+            # never trips the leader's dead-node reaper
+            threading.Thread(
+                target=self._requeue_own_ghosts, daemon=True
+            ).start()
         return self
+
+    def _requeue_own_ghosts(self) -> None:
+        name = self.replication.raft.name
+        for _ in range(10):
+            if not self._running:
+                return
+            ok, _r = self.replication.raft.submit(
+                {"k": "requeue_node", "node": name}, timeout_s=2.0
+            )
+            if ok:
+                return
+            _time.sleep(0.5)
+
+    def _kick_loop(self) -> None:
+        while not self._stopped:
+            if self._kick.wait(timeout=0.5):
+                self._kick.clear()
+                if self._running:
+                    self._deliver_all()
 
     def stop(self) -> None:
         self._running = False
+        self._stopped = True
+        self._kick.set()  # unblock the kick loop so it can exit
+        if self.replication is not None:
+            self.replication.stop()
         try:
             self._server.close()
         except OSError:
@@ -203,10 +260,14 @@ class MiniAmqpBroker:
                 pass
 
     def queue_depth(self, name: str = "jepsen.queue") -> int:
+        if self.replication is not None:
+            return self.replication.counts().get(name, 0)
         with self.state_lock:
             return len(self.queues.get(name, ()))
 
     def stream_depth(self, name: str = "jepsen.stream") -> int:
+        if self.replication is not None:
+            return len(self.replication.stream_snapshot(name))
         with self.state_lock:
             return len(self.streams.get(name, ()))
 
@@ -219,6 +280,17 @@ class MiniAmqpBroker:
                 break
             conn = _ConnState(sock=sock)
             with self.state_lock:
+                self._conn_seq += 1
+                node = (
+                    self.replication.raft.name
+                    if self.replication is not None
+                    else "local"
+                )
+                # salted: a restarted process must never mint owner ids
+                # that collide with its previous incarnation's replicated
+                # inflight entries (requeue_node prefix-matching on
+                # "node|" still covers every incarnation)
+                conn.owner = f"{node}|{self._owner_salt}c{self._conn_seq}"
                 self._conns.append(conn)
             threading.Thread(
                 target=self._serve, args=(conn,), daemon=True
@@ -328,17 +400,29 @@ class MiniAmqpBroker:
                     qname = r.shortstr()
                     r.u8()  # durable/exclusive/... bit flags
                     qargs = r.table()
-                    with self.state_lock:
+                    if self.replication is not None:
+                        self.replication.declare(
+                            qname,
+                            qtype=qargs.get("x-queue-type"),
+                            ttl_ms=qargs.get("x-message-ttl"),
+                            dlx=qargs.get("x-dead-letter-routing-key"),
+                        )
+                        # remember stream-ness locally for consume routing
                         if qargs.get("x-queue-type") == "stream":
-                            self.streams.setdefault(qname, [])
-                        else:
-                            self.queues.setdefault(qname, deque())
-                            self.queue_meta[qname] = {
-                                "ttl_ms": qargs.get("x-message-ttl"),
-                                "dlx_key": qargs.get(
-                                    "x-dead-letter-routing-key"
-                                ),
-                            }
+                            with self.state_lock:
+                                self.streams.setdefault(qname, [])
+                    else:
+                        with self.state_lock:
+                            if qargs.get("x-queue-type") == "stream":
+                                self.streams.setdefault(qname, [])
+                            else:
+                                self.queues.setdefault(qname, deque())
+                                self.queue_meta[qname] = {
+                                    "ttl_ms": qargs.get("x-message-ttl"),
+                                    "dlx_key": qargs.get(
+                                        "x-dead-letter-routing-key"
+                                    ),
+                                }
                     self._send_method(
                         conn,
                         ch,
@@ -349,9 +433,12 @@ class MiniAmqpBroker:
                 elif cls == 50 and mth == 30:  # Queue.Purge
                     r.u16()
                     qname = r.shortstr()
-                    with self.state_lock:
-                        n = len(self.queues.get(qname, ()))
-                        self.queues[qname] = deque()
+                    if self.replication is not None:
+                        n = self.replication.purge(qname)
+                    else:
+                        with self.state_lock:
+                            n = len(self.queues.get(qname, ()))
+                            self.queues[qname] = deque()
                     self._send_method(conn, ch, 50, 31, struct.pack(">I", n))
                 elif cls == 85 and mth == 10:  # Confirm.Select
                     conn.confirm_channels.add(ch)  # per-channel (spec)
@@ -388,29 +475,40 @@ class MiniAmqpBroker:
                         if spec == "first":
                             offset = 0
                         elif spec in ("last", "next"):
-                            with self.state_lock:
-                                n = len(self.streams.get(qname, ()))
+                            n = self.stream_depth(qname)
                             offset = n - 1 if spec == "last" and n else n
                         else:
                             offset = int(spec)
                         self._stream_deliver(conn, ch, qname, offset, ctag)
                     else:
+                        # ch first: a concurrent kick-loop delivery keys
+                        # off consuming_queue and must never observe the
+                        # default channel (advisor r3 #1)
+                        conn.consuming_ch = ch
                         conn.consuming_queue = qname
-                        self._try_deliver(conn, ch)
+                        self._try_deliver(conn)
                 elif cls == 60 and mth == 30:  # Basic.Cancel
                     ctag = r.shortstr()
                     self._send_method(conn, ch, 60, 31, _shortstr(ctag))
                 elif cls == 60 and mth == 80:  # Basic.Ack (client)
                     tag = r.u64()
                     with self.state_lock:
-                        conn.unacked.pop(tag, None)
-                    self._try_deliver(conn, ch)
+                        item = conn.unacked.pop(tag, None)
+                    if self.replication is not None and item:
+                        self.replication.settle(conn.owner, item[1])
+                    self._try_deliver(conn)
                 elif cls == 60 and mth == 90:  # Basic.Reject
                     tag = r.u64()
                     requeue = r.u8()
                     with self.state_lock:
                         item = conn.unacked.pop(tag, None)
-                        if item and requeue:
+                    if self.replication is not None and item:
+                        if requeue:
+                            self.replication.requeue_one(conn.owner, item[1])
+                        else:
+                            self.replication.settle(conn.owner, item[1])
+                    elif item and requeue:
+                        with self.state_lock:
                             qname, msg = item
                             self.queues.setdefault(qname, deque()).append(msg)
                     self._deliver_all()
@@ -419,10 +517,22 @@ class MiniAmqpBroker:
                     self._send_method(conn, ch, 90, 11)
                 elif cls == 90 and mth == 20:  # Tx.Commit
                     buffered = conn.tx_buffer.pop(ch, [])
-                    for qname, body, props in buffered:
-                        self._apply_publish(qname, body, props)
-                    self._send_method(conn, ch, 90, 21)
-                    self._deliver_all()
+                    if self.replication is not None:
+                        committed = (
+                            self.replication.enqueue_txn(buffered)
+                            if buffered
+                            else True
+                        )
+                        # commit-ok IS the acknowledgement: withhold it
+                        # when quorum was not reached (client times out →
+                        # indeterminate, the safe verdict)
+                        if committed:
+                            self._send_method(conn, ch, 90, 21)
+                    else:
+                        for qname, body, props in buffered:
+                            self._apply_publish(qname, body, props)
+                        self._send_method(conn, ch, 90, 21)
+                        self._deliver_all()
                 elif cls == 90 and mth == 30:  # Tx.Rollback
                     conn.tx_buffer.pop(ch, None)
                     self._send_method(conn, ch, 90, 31)
@@ -446,12 +556,24 @@ class MiniAmqpBroker:
         finally:
             conn.open = False
             # requeue un-acked deliveries (broker semantics on conn loss)
-            with self.state_lock:
-                for qname, msg in conn.unacked.values():
-                    self.queues.setdefault(qname, deque()).append(msg)
-                conn.unacked.clear()
-                if conn in self._conns:
-                    self._conns.remove(conn)
+            if self.replication is not None:
+                with self.state_lock:
+                    conn.unacked.clear()
+                    if conn in self._conns:
+                        self._conns.remove(conn)
+                if self._running:
+                    # unconditional: a deq can commit cluster-wide while
+                    # the local submit timed out (nothing in conn.unacked
+                    # to witness it) — only the replicated inflight map
+                    # knows, so always sweep this owner
+                    self.replication.requeue_owner(conn.owner)
+            else:
+                with self.state_lock:
+                    for qname, msg in conn.unacked.values():
+                        self.queues.setdefault(qname, deque()).append(msg)
+                    conn.unacked.clear()
+                    if conn in self._conns:
+                        self._conns.remove(conn)
             try:
                 sock.close()
             except OSError:
@@ -487,6 +609,20 @@ class MiniAmqpBroker:
             return
         seq = conn.publish_seq.get(ch, 0) + 1
         conn.publish_seq[ch] = seq
+        if self.replication is not None:
+            # quorum-commit before confirm: the whole point of the
+            # replicated mode (a seed_bug leader lies here — that's the
+            # injected fault the checker must catch downstream)
+            committed = self.replication.enqueue(queue, body, props)
+            if (
+                committed
+                and ch in conn.confirm_channels
+                and not self.drop_confirms
+            ):
+                self._send_method(
+                    conn, ch, 60, 80, struct.pack(">QB", seq, 0)
+                )
+            return  # push deliveries ride the on_visible kick
         self._apply_publish(queue, body, props)
         # confirm mode and delivery-tag sequence are per channel, and the
         # ack rides the publishing channel (AMQP 0-9-1 confirm semantics)
@@ -555,6 +691,29 @@ class MiniAmqpBroker:
 
     def _handle_get(self, conn: _ConnState, ch: int, qname: str,
                     no_ack: bool = False):
+        if self.replication is not None:
+            rmsg = self.replication.dequeue(qname, conn.owner)
+            if rmsg is None:
+                self._send_method(conn, ch, 60, 72, _shortstr(""))
+                return
+            with self.state_lock:
+                tag = conn.next_tag
+                conn.next_tag += 1
+                if no_ack:
+                    pass  # auto-acked: settle below, nothing to track
+                else:
+                    conn.unacked[tag] = (qname, rmsg.mid)
+            if no_ack:
+                self.replication.settle(conn.owner, rmsg.mid)
+            method = (
+                struct.pack(">HH", 60, 71)
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(qname)
+                + struct.pack(">I", 0)
+            )
+            self._content_frames(conn, ch, rmsg.body, method, rmsg.props)
+            return
         with self.state_lock:
             self._expire_locked(qname)
             q = self.queues.setdefault(qname, deque())
@@ -590,9 +749,34 @@ class MiniAmqpBroker:
         )
         self._content_frames(conn, ch, msg.value, method, msg.props)
 
-    def _try_deliver(self, conn: _ConnState, ch: int = 1):
+    def _try_deliver(self, conn: _ConnState):
         """Push deliveries: QoS-1 (one in flight) for acking consumers;
-        no-ack consumers are auto-acknowledged and drain the queue."""
+        no-ack consumers are auto-acknowledged and drain the queue.
+        Deliveries ride the channel the consumer subscribed on
+        (``conn.consuming_ch`` — consumers on channel ≠ 1 must not get
+        their pushes on channel 1, advisor r3 #1).
+
+        One delivering thread per conn: a second caller (serve thread vs
+        kick loop) sets ``deliver_again`` and leaves; the holder re-runs
+        after releasing, so no wake-up is lost and no two deliveries can
+        interleave frames."""
+        while True:
+            if not conn.deliver_lock.acquire(blocking=False):
+                conn.deliver_again = True
+                return
+            try:
+                conn.deliver_again = False
+                self._deliver_pass(conn)
+            finally:
+                conn.deliver_lock.release()
+            if not conn.deliver_again:
+                return
+
+    def _deliver_pass(self, conn: _ConnState):
+        ch = conn.consuming_ch
+        if self.replication is not None:
+            self._try_deliver_replicated(conn, ch)
+            return
         while conn.consuming_queue is not None and conn.open:
             with self.state_lock:
                 if conn.unacked and not conn.consuming_noack:
@@ -630,13 +814,64 @@ class MiniAmqpBroker:
             if not noack:
                 return  # QoS-1: wait for the ack before the next push
 
+    def _try_deliver_replicated(self, conn: _ConnState, ch: int) -> None:
+        """Replicated push path: each delivery is a committed DEQ (moving
+        the message to the replicated inflight map under this conn's
+        owner id); acks settle, conn loss requeues — so leader failover
+        inherits delivery state instead of losing it."""
+        while conn.consuming_queue is not None and conn.open:
+            with self.state_lock:
+                if conn.unacked and not conn.consuming_noack:
+                    return  # QoS-1: one in flight
+            # local ready-check before paying a quorum round trip: an
+            # empty-queue DEQ would still commit a no-op log entry on
+            # every replica, once per consumer per kick (benign races
+            # both ways — a miss is repaired by the next kick)
+            with self.replication.machine.lock:
+                ready = len(
+                    self.replication.machine.queues.get(
+                        conn.consuming_queue, ()
+                    )
+                )
+            if ready == 0:
+                return
+            rmsg = self.replication.dequeue(
+                conn.consuming_queue, conn.owner
+            )
+            if rmsg is None:
+                return
+            with self.state_lock:
+                tag = conn.next_tag
+                conn.next_tag += 1
+                noack = conn.consuming_noack
+                if not noack:
+                    conn.unacked[tag] = (conn.consuming_queue, rmsg.mid)
+            if noack:
+                self.replication.settle(conn.owner, rmsg.mid)
+            method = (
+                struct.pack(">HH", 60, 60)
+                + _shortstr("ctag-1")
+                + struct.pack(">QB", tag, 0)
+                + _shortstr("")
+                + _shortstr(conn.consuming_queue)
+            )
+            self._content_frames(conn, ch, rmsg.body, method, rmsg.props)
+            if not noack:
+                return
+
     def _stream_deliver(
         self, conn: _ConnState, ch: int, qname: str, offset: int, ctag: str
     ):
         """Non-destructive snapshot delivery from ``offset``; each record
         carries its log offset in the x-stream-offset message header."""
-        with self.state_lock:
-            snapshot = list(enumerate(self.streams.get(qname, ())))[offset:]
+        if self.replication is not None:
+            log = self.replication.stream_snapshot(qname)
+            snapshot = list(enumerate(log))[offset:]
+        else:
+            with self.state_lock:
+                snapshot = list(
+                    enumerate(self.streams.get(qname, ()))
+                )[offset:]
         for off, body in snapshot:
             with self.state_lock:
                 tag = conn.next_tag
@@ -683,7 +918,30 @@ class MiniAmqpBroker:
 # ---------------------------------------------------------------------------
 
 
+def _admin_depths(broker: MiniAmqpBroker) -> str:
+    if broker.replication is not None:
+        ready = broker.replication.counts()
+    else:
+        with broker.state_lock:
+            # expire first: TTL-dead messages must not count as queued,
+            # or the drained-to-zero cross-check misreads dead-letter
+            # configs (advisor r3 #5)
+            for q in list(broker.queues):
+                broker._expire_locked(q)
+            ready = {q: len(v) for q, v in broker.queues.items()}
+            for conn in broker._conns:
+                for qname, _m in conn.unacked.values():
+                    ready[qname] = ready.get(qname, 0) + 1
+            for s, log in broker.streams.items():
+                ready[s] = len(log)
+    return "".join(f"{q} {n}\n" for q, n in sorted(ready.items()))
+
+
 def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
+    """One-line admin queries: DEPTHS (rabbitmqctl list_queues stand-in),
+    and in replicated mode the per-link partition surface the control
+    plane maps iptables rules onto — BLOCK <peer> / UNBLOCK_ALL — plus
+    ROLE for failover observability."""
     while True:
         try:
             sock, _ = server.accept()
@@ -692,15 +950,16 @@ def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
         try:
             req = sock.makefile("r").readline().strip()
             if req == "DEPTHS":
-                with broker.state_lock:
-                    ready = {q: len(v) for q, v in broker.queues.items()}
-                    for conn in broker._conns:
-                        for qname, _m in conn.unacked.values():
-                            ready[qname] = ready.get(qname, 0) + 1
-                    for s, log in broker.streams.items():
-                        ready[s] = len(log)
-                out = "".join(f"{q} {n}\n" for q, n in sorted(ready.items()))
-                sock.sendall(out.encode() or b"\n")
+                sock.sendall(_admin_depths(broker).encode() or b"\n")
+            elif req.startswith("BLOCK ") and broker.replication is not None:
+                broker.replication.raft.block(req[len("BLOCK "):].strip())
+                sock.sendall(b"OK\n")
+            elif req == "UNBLOCK_ALL" and broker.replication is not None:
+                broker.replication.raft.unblock_all()
+                sock.sendall(b"OK\n")
+            elif req == "ROLE" and broker.replication is not None:
+                state, term, hint = broker.replication.raft.role()
+                sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
             else:
                 sock.sendall(b"ERR unknown\n")
         except OSError:
@@ -719,9 +978,44 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--admin-port", type=int, required=True)
+    # replicated-cluster mode: this process is one Raft node.  --peer is
+    # repeated once per cluster member as NAME=HOST:REPLPORT (NAME itself
+    # may contain ':'; the last '=' -separated field is split on its last
+    # ':'); --node-id must match one --peer NAME.
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--peer", action="append", default=[])
+    p.add_argument("--seed-bug", default=None)
+    p.add_argument("--election-ms", type=int, nargs=2, default=(250, 500))
+    p.add_argument("--heartbeat-ms", type=int, default=60)
+    p.add_argument("--dead-owner-ms", type=int, default=1500)
+    p.add_argument("--submit-timeout-ms", type=int, default=5000)
     args = p.parse_args(argv)
 
-    broker = MiniAmqpBroker(port=args.port).start()
+    replication = None
+    if args.peer:
+        from jepsen_tpu.harness.replication import ReplicatedBackend
+
+        peers: dict[str, tuple[str, int]] = {}
+        for spec in args.peer:
+            name, addr = spec.rsplit("=", 1)
+            host, rport = addr.rsplit(":", 1)
+            peers[name] = (host, int(rport))
+        if args.node_id not in peers:
+            p.error(f"--node-id {args.node_id!r} is not among --peer names")
+        replication = ReplicatedBackend(
+            args.node_id,
+            peers,
+            election_timeout=(
+                args.election_ms[0] / 1000.0,
+                args.election_ms[1] / 1000.0,
+            ),
+            heartbeat_s=args.heartbeat_ms / 1000.0,
+            dead_owner_s=args.dead_owner_ms / 1000.0,
+            seed_bug=args.seed_bug,
+            submit_timeout_s=args.submit_timeout_ms / 1000.0,
+        )
+
+    broker = MiniAmqpBroker(port=args.port, replication=replication).start()
     admin = socket.create_server(("127.0.0.1", args.admin_port))
     threading.Thread(
         target=_serve_admin, args=(broker, admin), daemon=True

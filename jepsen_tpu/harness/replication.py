@@ -791,10 +791,14 @@ class ReplicatedBackend:
     ):
         self.machine = QueueMachine()
         self.submit_timeout_s = submit_timeout_s
+        #: called (from the apply path, any thread, possibly holding raft
+        #: locks — so implementations must only signal, never re-enter)
+        #: whenever an applied entry may have made messages deliverable
+        self.on_visible: Callable[[], None] | None = None
         self.raft = RaftNode(
             name,
             peers,
-            self.machine.apply,
+            self._apply,
             election_timeout=election_timeout,
             heartbeat_s=heartbeat_s,
             dead_owner_s=dead_owner_s,
@@ -804,6 +808,18 @@ class ReplicatedBackend:
 
     def stop(self) -> None:
         self.raft.stop()
+
+    def _apply(self, index: int, op: dict) -> Any:
+        result = self.machine.apply(index, op)
+        if self.on_visible is not None and op["k"] in (
+            "enq",
+            "txn",
+            "requeue_one",
+            "requeue_owner",
+            "requeue_node",
+        ):
+            self.on_visible()
+        return result
 
     # -- queue ops ----------------------------------------------------------
     def declare(self, q, qtype=None, ttl_ms=None, dlx=None) -> None:

@@ -28,25 +28,37 @@ from jepsen_tpu.ops.pallas_stats import fused_queue_stats
 
 
 def fused_tensor_check(
-    packed: PackedHistories, interpret: bool | None = None
+    packed: PackedHistories,
+    interpret: bool | None = None,
+    delivery: str = "exactly-once",
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
     """Batched total-queue + queue-linearizability results, one HBM pass."""
     st = fused_queue_stats(packed, interpret=interpret)
     tq = total_queue_classify(st.a, st.e, st.d)
-    ql = queue_lin_classify(st.a, st.x, st.s, st.d, st.t)
+    ql = queue_lin_classify(
+        st.a, st.x, st.s, st.d, st.t,
+        dup_invalidates=delivery == "exactly-once",
+    )
     return tq, ql
 
 
-@functools.partial(jax.jit, static_argnames=("value_space",))
-def _combined_batch(f, type_, value, mask, value_space: int):
+@functools.partial(
+    jax.jit, static_argnames=("value_space", "dup_invalidates")
+)
+def _combined_batch(
+    f, type_, value, mask, value_space: int, dup_invalidates: bool = True
+):
     return (
         _total_queue_batch(f, type_, value, mask, value_space),
-        _queue_lin_batch(f, type_, value, mask, value_space),
+        _queue_lin_batch(
+            f, type_, value, mask, value_space,
+            dup_invalidates=dup_invalidates,
+        ),
     )
 
 
 def combined_tensor_check(
-    packed: PackedHistories,
+    packed: PackedHistories, delivery: str = "exactly-once"
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
     """Both quorum-queue verdicts as ONE XLA program (the scatter path).
 
@@ -58,5 +70,10 @@ def combined_tensor_check(
     ``fused_tensor_check`` above is the differential twin (one explicit
     HBM pass, currently ~10× slower than XLA's fusion of this program)."""
     return _combined_batch(
-        packed.f, packed.type, packed.value, packed.mask, packed.value_space
+        packed.f,
+        packed.type,
+        packed.value,
+        packed.mask,
+        packed.value_space,
+        dup_invalidates=delivery == "exactly-once",
     )

@@ -69,7 +69,18 @@ _INF = 2**31 - 1
 # ---------------------------------------------------------------------------
 
 
-def check_queue_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
+def check_queue_lin_cpu(
+    history: Sequence[Op], delivery: str = "exactly-once"
+) -> dict[str, Any]:
+    """``delivery`` is the SUT's contract (mirroring the elle checker's
+    consistency-model selection, r3): ``"exactly-once"`` treats a
+    duplicate read as a linearizability violation (right for the sim
+    broker, which dedups); ``"at-least-once"`` *reports* duplicates but
+    does not invalidate — redelivery after consumer/conn/node failure is
+    contractual for RabbitMQ (classic requeue and quorum-queue Raft
+    checkouts both redeliver), and flagging it would fail the SUT for a
+    guarantee it never claimed.  Phantoms and causality violations always
+    invalidate."""
     enq_invokes: dict[int, int] = {}
     enq_fails: dict[int, int] = {}
     enq_start: dict[int, int] = {}  # earliest history position of an invoke
@@ -101,8 +112,10 @@ def check_queue_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
         elif read_end[v] < enq_start[v]:
             causal.add(v)
 
+    dup_invalidates = delivery == "exactly-once"
     return {
-        VALID: not (dup or phantom or causal),
+        VALID: not ((dup and dup_invalidates) or phantom or causal),
+        "delivery": delivery,
         "duplicate-count": len(dup),
         "duplicate": dup,
         "phantom-count": len(phantom),
@@ -154,13 +167,17 @@ def queue_lin_count_vectors(f, type_, value, pos, mask, value_space: int):
     return a, x, s, r, t
 
 
-def queue_lin_classify(a, x, s, r, t) -> QueueLinTensors:
-    """Vectors ``[..., V]`` → results; runs on full combined vectors."""
+def queue_lin_classify(a, x, s, r, t, dup_invalidates: bool = True) -> QueueLinTensors:
+    """Vectors ``[..., V]`` → results; runs on full combined vectors.
+    ``dup_invalidates=False`` is the at-least-once delivery contract:
+    duplicates are reported in the tensors but do not sink ``valid``."""
     read = r >= 1
     dup = r > 1
     phantom = read & ((a == 0) | (x >= a))
     causal = read & ~phantom & (s != _INF) & (t != _INF) & (t < s)
-    valid = ~(dup.any(-1) | phantom.any(-1) | causal.any(-1))
+    valid = ~(phantom.any(-1) | causal.any(-1))
+    if dup_invalidates:
+        valid &= ~dup.any(-1)
     return QueueLinTensors(
         valid=valid,
         duplicate=dup,
@@ -170,8 +187,12 @@ def queue_lin_classify(a, x, s, r, t) -> QueueLinTensors:
     )
 
 
-@functools.partial(jax.jit, static_argnames=("value_space",))
-def _queue_lin_batch(f, type_, value, mask, value_space: int):
+@functools.partial(
+    jax.jit, static_argnames=("value_space", "dup_invalidates")
+)
+def _queue_lin_batch(
+    f, type_, value, mask, value_space: int, dup_invalidates: bool = True
+):
     pos = jnp.broadcast_to(
         jnp.arange(f.shape[-1], dtype=jnp.int32), f.shape
     )
@@ -180,12 +201,19 @@ def _queue_lin_batch(f, type_, value, mask, value_space: int):
             ff, tt, vv, pp, mm, value_space
         )
     )(f, type_, value, pos, mask)
-    return queue_lin_classify(a, x, s, r, t)
+    return queue_lin_classify(a, x, s, r, t, dup_invalidates)
 
 
-def queue_lin_tensor_check(packed: PackedHistories) -> QueueLinTensors:
+def queue_lin_tensor_check(
+    packed: PackedHistories, delivery: str = "exactly-once"
+) -> QueueLinTensors:
     return _queue_lin_batch(
-        packed.f, packed.type, packed.value, packed.mask, packed.value_space
+        packed.f,
+        packed.type,
+        packed.value,
+        packed.mask,
+        packed.value_space,
+        dup_invalidates=delivery == "exactly-once",
     )
 
 
@@ -214,9 +242,15 @@ def check_queue_lin_batch(
     histories: Sequence[Sequence[Op]],
     length: int | None = None,
     value_space: int | None = None,
+    delivery: str = "exactly-once",
 ) -> list[dict[str, Any]]:
     packed = pack_histories(histories, length=length, value_space=value_space)
-    return queue_lin_tensors_to_results(queue_lin_tensor_check(packed))
+    results = queue_lin_tensors_to_results(
+        queue_lin_tensor_check(packed, delivery=delivery)
+    )
+    for r in results:
+        r["delivery"] = delivery
+    return results
 
 
 class QueueLinearizability(Checker):
@@ -224,10 +258,15 @@ class QueueLinearizability(Checker):
 
     name = "queue-linearizability"
 
-    def __init__(self, backend: str = "tpu"):
+    def __init__(
+        self, backend: str = "tpu", delivery: str = "exactly-once"
+    ):
         if backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {backend!r}")
+        if delivery not in ("exactly-once", "at-least-once"):
+            raise ValueError(f"unknown delivery contract {delivery!r}")
         self.backend = backend
+        self.delivery = delivery
 
     def check(
         self,
@@ -236,5 +275,5 @@ class QueueLinearizability(Checker):
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
         if self.backend == "cpu":
-            return check_queue_lin_cpu(history)
-        return check_queue_lin_batch([history])[0]
+            return check_queue_lin_cpu(history, delivery=self.delivery)
+        return check_queue_lin_batch([history], delivery=self.delivery)[0]

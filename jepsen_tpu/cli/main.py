@@ -148,7 +148,10 @@ def _checker_for(args, out_dir=None, history=None):
     checkers = {
         "perf": Perf(out_dir=out_dir),
         "queue": TotalQueue(backend=backend),
-        "linear": QueueLinearizability(backend=backend),
+        "linear": QueueLinearizability(
+            backend=backend,
+            delivery=getattr(args, "delivery", None) or "exactly-once",
+        ),
     }
     if getattr(args, "wgl", False):
         from jepsen_tpu.checkers.wgl import QueueWgl
@@ -163,17 +166,20 @@ def cmd_check(args) -> int:
     hpath = _resolve_history_path(Path(args.history)).resolve()
     history = read_history(hpath)
     out_dir = hpath.parent
+    # inherit the contract levels the run was judged at: a live run is
+    # valid at its SUT's contractual level (read-committed for AMQP tx;
+    # at-least-once delivery for the queue), and a bare re-check must not
+    # silently tighten the verdict
+    try:
+        prev = json.loads((out_dir / "results.json").read_text())
+    except (OSError, ValueError):
+        prev = {}
     if getattr(args, "consistency_model", None) is None:
-        # inherit the level the run was judged at: a live elle run is
-        # valid at its SUT's contractual level (read-committed for AMQP
-        # tx), and a bare re-check must not silently tighten the verdict
-        try:
-            prev = json.loads((out_dir / "results.json").read_text())
-            args.consistency_model = prev.get("elle", {}).get(
-                "consistency-model"
-            )
-        except (OSError, ValueError):
-            pass
+        args.consistency_model = prev.get("elle", {}).get(
+            "consistency-model"
+        )
+    if getattr(args, "delivery", None) is None:
+        args.delivery = prev.get("linear", {}).get("delivery")
     checker = _checker_for(args, out_dir=out_dir, history=history)
     t0 = time.perf_counter()
     result = checker.check({}, history)
@@ -529,13 +535,15 @@ def cmd_test(args) -> int:
 
         n = len(args.nodes.split(",")) if args.nodes else 3
         if args.workload != "queue" and n > 1:
-            # mini brokers don't replicate: only the queue family's drain
-            # visits every host, so multi-node is meaningful only there —
-            # a 3-node stream/mutex/elle run would manufacture false
-            # anomalies out of the harness, not the SUT
+            # queue ops route through the replicated cluster's leader, so
+            # multi-node is fully meaningful for the queue family; the
+            # stream/mutex/elle mappings still read local replica state
+            # (snapshot reads), so their multi-node runs would blame the
+            # harness's read routing, not the SUT — they stay single-node
             print(
                 f"# --db local: {args.workload} workload runs single-node "
-                f"(mini brokers don't replicate); ignoring extra nodes",
+                f"(only the queue family routes through the replicated "
+                f"leader); ignoring extra nodes",
                 file=sys.stderr,
             )
             n = 1
@@ -546,6 +554,7 @@ def cmd_test(args) -> int:
             checker_backend=args.checker,
             store_root=args.store,
             workload=args.workload,
+            seed_bug=args.seed_bug,
         )
     else:
         test, _cluster = build_sim_test(
@@ -631,9 +640,10 @@ def cmd_matrix(args) -> int:
         # out-of-band queue-empty cross-check straight from the brokers
         # (= the reference's rabbitmqctl loop, ci/jepsen-test.sh:144-155)
         lengths: dict[str, int] = {}
+        read = getattr(db, "queue_lengths_settled", None) or db.queue_lengths
         for node in nodes:
             try:
-                for q, n in db.queue_lengths(node).items():
+                for q, n in read(node).items():
                     lengths[f"{q}@{node}"] = n
             except Exception as e:  # noqa: BLE001 — node may be down
                 logging.warning(
@@ -807,6 +817,14 @@ def build_parser() -> argparse.ArgumentParser:
         "SUT's contractual level doesn't silently tighten it)",
     )
     c.add_argument(
+        "--delivery",
+        choices=("exactly-once", "at-least-once"),
+        default=None,
+        help="queue histories: the SUT's delivery contract (default: the "
+        "contract recorded with the run's results, else exactly-once — "
+        "same no-silent-tightening rule as --consistency-model)",
+    )
+    c.add_argument(
         "--wgl",
         action="store_true",
         help="also run the full Wing-Gong linearizability search "
@@ -861,6 +879,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     t.add_argument("--store", default="store")
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
+    t.add_argument(
+        "--seed-bug",
+        choices=("confirm-before-quorum",),
+        default=None,
+        help="(--db local) inject a replication bug into every broker "
+        "node: confirm-before-quorum acknowledges publishes on leader-"
+        "local append, so a partition+heal truncates confirmed writes — "
+        "the checker must go red (lost)",
+    )
     # the reference's cli-opts (rabbitmq.clj:288-327)
     t.add_argument("--rate", type=float, default=50.0, help="ops/sec")
     t.add_argument("--time-limit", type=float, default=30.0)
@@ -874,7 +901,10 @@ def build_parser() -> argparse.ArgumentParser:
             "partition-halves",
             "partition-majorities-ring",
             "partition-random-node",
+            "partition-leader",
         ),
+        help="the reference's four topologies, plus the targeted "
+        "partition-leader (isolate the current Raft leader; --db local)",
     )
     t.add_argument(
         "--live-check",

@@ -234,6 +234,24 @@ class RabbitMQDB(DB):
         return self.transport.get(node, path, dest)
 
     # CI cross-check helper (ci/jepsen-test.sh:144-155)
+    def queue_lengths_settled(
+        self, node: str, settle_s: float = 3.0
+    ) -> dict[str, int]:
+        """``queue_lengths`` retried briefly while counts drain to zero.
+        On a replicated cluster the final acks settle asynchronously
+        (Raft apply lag on followers), so one instantaneous reading right
+        after a drain can show phantom depth; the reference's own CI
+        empty-check polls rabbitmqctl in a loop for the same reason
+        (``ci/jepsen-test.sh:144-155``)."""
+        deadline = time.monotonic() + settle_s
+        while True:
+            lengths = self.queue_lengths(node)
+            if all(v == 0 for v in lengths.values()):
+                return lengths
+            if time.monotonic() >= deadline:
+                return lengths
+            time.sleep(0.15)
+
     def queue_lengths(self, node: str) -> dict[str, int]:
         c = Control(self.transport, node).su()
         out = c.exec(

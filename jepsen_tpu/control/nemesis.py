@@ -71,27 +71,59 @@ STRATEGIES: dict[str, Callable] = {
     "partition-random-node": random_node,
 }
 
+#: targeted strategy (beyond the reference's four): isolate the CURRENT
+#: consensus leader — jepsen's own nemesis library grew leader-targeting
+#: partitioners because random victims rarely hit the interesting window
+#: (a leader's uncommitted tail).  Requires a ``leader_fn`` (the local
+#: process cluster answers via its nodes' admin ROLE query); falls back
+#: to a random victim when no leader is discoverable.
+PARTITION_LEADER = "partition-leader"
+
 
 class PartitionNemesis:
     """Applies a partition strategy on ``start``, heals on ``stop``."""
 
     def __init__(self, strategy: str, net: Net, nodes: Sequence[str],
-                 seed: int | None = None):
-        if strategy not in STRATEGIES:
+                 seed: int | None = None,
+                 leader_fn: Callable[[], str | None] | None = None):
+        if strategy not in STRATEGIES and strategy != PARTITION_LEADER:
             raise ValueError(
-                f"unknown partition {strategy!r}; one of {sorted(STRATEGIES)}"
+                f"unknown partition {strategy!r}; one of "
+                f"{sorted([*STRATEGIES, PARTITION_LEADER])}"
+            )
+        if strategy == PARTITION_LEADER and leader_fn is None:
+            raise ValueError(
+                "partition-leader needs a leader-discovery hook; this "
+                "cluster's transport does not provide one"
             )
         self.strategy = strategy
         self.net = net
         self.nodes = list(nodes)
         self.rng = random.Random(seed)
+        self.leader_fn = leader_fn
 
     def setup(self, test: Mapping[str, Any]) -> None:
         self.net.heal()
 
+    def _grudges(self):
+        if self.strategy == PARTITION_LEADER:
+            victim = None
+            try:
+                victim = self.leader_fn()
+            except Exception:  # noqa: BLE001 - discovery is best-effort
+                pass
+            if victim is None or victim not in self.nodes:
+                victim = self.rng.choice(self.nodes)
+                logger.info(
+                    "nemesis: no discoverable leader; isolating %s", victim
+                )
+            rest = [m for m in self.nodes if m != victim]
+            return complete_grudges([[victim], rest])
+        return STRATEGIES[self.strategy](self.nodes, self.rng)
+
     def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
         if op.f == OpF.START:
-            grudges = STRATEGIES[self.strategy](self.nodes, self.rng)
+            grudges = self._grudges()
             self.net.partition(grudges)
             desc = {a: sorted(bs) for a, bs in grudges.items() if bs}
             logger.info("nemesis: cut links %s", desc)
@@ -164,14 +196,17 @@ NEMESES = ("partition", "kill-random-node", "pause-random-node")
 
 
 def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
-                 nodes: Sequence[str], seed: int | None = None):
+                 nodes: Sequence[str], seed: int | None = None,
+                 leader_fn=None):
     """Build the nemesis the test opts select: ``partition`` (the
-    reference's four strategies via ``network-partition``), or the
-    process faults ``kill-random-node`` / ``pause-random-node``."""
+    reference's four strategies via ``network-partition``, plus the
+    targeted ``partition-leader``), or the process faults
+    ``kill-random-node`` / ``pause-random-node``."""
     kind = opts.get("nemesis", "partition")
     if kind == "partition":
         return PartitionNemesis(
-            opts["network-partition"], net, nodes, seed=seed
+            opts["network-partition"], net, nodes, seed=seed,
+            leader_fn=leader_fn,
         )
     if kind == "kill-random-node":
         return ProcessNemesis("kill", procs, nodes, seed=seed)

@@ -19,13 +19,17 @@ processes:
   nemesis: sockets held, zero progress)
 - ``rabbitmqctl list_queues``        → the admin-port DEPTHS query (the
   CI drained-to-zero cross-check, ``ci/jepsen-test.sh:144-155``)
-- ``iptables -A INPUT -s X`` / ``-F``→ records the blocked link and maps
-  *quorum loss* onto processes: a node that can no longer see a majority
-  of the cluster is SIGSTOPped (stops confirming — the client-visible
-  effect of a minority partition), and healing resumes it.  Node-to-node
-  link semantics beyond quorum loss don't exist here because the mini
-  brokers don't replicate; that residual gap is exactly what the
-  docker/terraform harnesses cover on real clusters.
+- ``iptables -A INPUT -s X`` / ``-F``→ **per-link socket-level blocks**
+  on the replicated cluster (the default): the rule is forwarded to the
+  node's admin port as ``BLOCK X`` / ``UNBLOCK_ALL`` and enforced inside
+  its Raft RPC layer with INPUT-drop semantics (requests from X dropped
+  unprocessed; replies from X discarded) — so the 4 partition topologies
+  exercise real quorum behavior: leader step-down, majority failover,
+  heal/catch-up, per-link asymmetries (majorities-ring).  In the legacy
+  non-replicated mode (``replicated=False``) the old *quorum-loss
+  mapping* applies instead: a node that can no longer see a majority is
+  SIGSTOPped (the client-visible effect of a minority partition, without
+  any real consensus underneath).
 
 Everything else (wget, tar, config upload, feature flags, join_cluster,
 status-dump eval) succeeds vacuously, recorded in ``log`` like
@@ -59,24 +63,52 @@ def _free_port() -> int:
 
 
 class _Node:
-    def __init__(self, name: str, port: int, admin_port: int):
+    def __init__(self, name: str, port: int, admin_port: int,
+                 repl_port: int = 0):
         self.name = name
         self.port = port
         self.admin_port = admin_port
+        self.repl_port = repl_port
         self.proc: subprocess.Popen | None = None
         self.stderr_path: str | None = None
 
 
 class LocalProcTransport(Transport):
-    """A :class:`Transport` whose "nodes" are local mini-broker processes."""
+    """A :class:`Transport` whose "nodes" are local mini-broker processes.
 
-    def __init__(self, n_nodes: int = 3, spawn_timeout_s: float = 30.0):
+    ``replicated=True`` (default for multi-node clusters) boots each
+    broker as one Raft node (``harness/replication.py``): publishes
+    quorum-commit before confirming, and iptables rules become real
+    per-link blocks.  ``seed_bug`` is forwarded to every node (the
+    ``confirm-before-quorum`` red-run fault)."""
+
+    def __init__(
+        self,
+        n_nodes: int = 3,
+        spawn_timeout_s: float = 30.0,
+        replicated: bool | None = None,
+        seed_bug: str | None = None,
+    ):
         self.spawn_timeout_s = spawn_timeout_s
+        # a 1-node "cluster" needs no consensus; multi-node defaults on
+        self.replicated = (
+            n_nodes > 1 if replicated is None else replicated
+        )
+        if seed_bug and not self.replicated:
+            # a silently-dropped fault would make the red-run proof a
+            # false green: the user would credit the checker for a bug
+            # that was never injected
+            raise ValueError(
+                f"seed_bug={seed_bug!r} needs a replicated cluster "
+                f"(n_nodes>1, replicated not disabled)"
+            )
+        self.seed_bug = seed_bug
         self._nodes: dict[str, _Node] = {}
         for _ in range(n_nodes):
             port, admin = _free_port(), _free_port()
+            repl = _free_port() if self.replicated else 0
             name = f"127.0.0.1:{port}"
-            self._nodes[name] = _Node(name, port, admin)
+            self._nodes[name] = _Node(name, port, admin, repl)
         self.log: list[tuple[str, str]] = []
         self.files: dict[tuple[str, str], bytes] = {}
         self.lock = threading.Lock()
@@ -171,12 +203,23 @@ class LocalProcTransport(Transport):
             prefix=f"jt-broker-{n.port}-", suffix=".log"
         )
         err_fh = os.fdopen(fd, "wb")
+        cmd = [
+            sys.executable, "-m", "jepsen_tpu.harness.broker",
+            "--port", str(n.port), "--admin-port", str(n.admin_port),
+        ]
+        if self.replicated:
+            cmd += ["--node-id", n.name]
+            for peer in self._nodes.values():
+                cmd += ["--peer", f"{peer.name}=127.0.0.1:{peer.repl_port}"]
+            # snappy failover relative to the suite's (possibly
+            # time-scaled) partition windows
+            cmd += ["--election-ms", "150", "300", "--heartbeat-ms", "40",
+                    "--dead-owner-ms", "800"]
+            if self.seed_bug:
+                cmd += ["--seed-bug", self.seed_bug]
         try:
             n.proc = subprocess.Popen(
-                [
-                    sys.executable, "-m", "jepsen_tpu.harness.broker",
-                    "--port", str(n.port), "--admin-port", str(n.admin_port),
-                ],
+                cmd,
                 env=env,
                 stdout=subprocess.DEVNULL,
                 stderr=err_fh,
@@ -240,6 +283,14 @@ class LocalProcTransport(Transport):
 
     def _iptables(self, node: str, inner: str) -> None:
         parts = shlex.split(inner)
+        if self.replicated:
+            # real per-link enforcement inside the node's Raft RPC layer
+            if "-F" in parts or "-X" in parts:
+                self._admin(node, "UNBLOCK_ALL")
+            elif "-A" in parts and "-s" in parts:
+                peer = parts[parts.index("-s") + 1]
+                self._admin(node, f"BLOCK {peer}")
+            return
         if "-F" in parts or "-X" in parts:
             with self.lock:
                 self._blocked = {
@@ -277,19 +328,40 @@ class LocalProcTransport(Transport):
             if a not in keep_stopped:
                 self._signal(a, signal.SIGCONT)
 
-    def _list_queues(self, node: str) -> RunResult:
+    def _admin(self, node: str, line: str) -> RunResult:
+        """One-line admin query to a node; a dead node answers rc=1 —
+        except for iptables mappings, which succeed vacuously (a real
+        iptables rule installs fine on a host whose broker is down)."""
         n = self._nodes[node]
         try:
             with socket.create_connection(
                 ("127.0.0.1", n.admin_port), 2.0
             ) as s:
-                s.sendall(b"DEPTHS\n")
+                s.sendall(line.encode() + b"\n")
                 out = b""
                 while chunk := s.recv(4096):
                     out += chunk
             return RunResult(0, out.decode(), "")
         except OSError as e:
+            if line.startswith(("BLOCK", "UNBLOCK")):
+                return RunResult(0, "", f"(node down: {e})")
             return RunResult(1, "", f"admin query failed: {e}")
+
+    def _list_queues(self, node: str) -> RunResult:
+        return self._admin(node, "DEPTHS")
+
+    def leader(self) -> str | None:
+        """The current Raft leader's node name, per the nodes' admin ROLE
+        answers (None when no node claims leadership — mid-election, or a
+        non-replicated cluster).  The targeted ``partition-leader``
+        nemesis keys off this."""
+        if not self.replicated:
+            return None
+        for name in self._nodes:
+            r = self._admin(name, "ROLE")
+            if r.rc == 0 and r.out.startswith("leader"):
+                return name
+        return None
 
     def commands(self, node: str | None = None) -> list[str]:
         with self.lock:
@@ -303,6 +375,8 @@ def build_local_test(
     checker_backend: str = "tpu",
     store_root: str = "store",
     workload: str = "queue",
+    replicated: bool | None = None,
+    seed_bug: str | None = None,
 ):
     """The dress-rehearsal assembly in one call: ``build_rabbitmq_test``
     over a fresh :class:`LocalProcTransport` with the fast-boot
@@ -311,7 +385,9 @@ def build_local_test(
     from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
     from jepsen_tpu.suite import build_rabbitmq_test
 
-    t = LocalProcTransport(n_nodes=n_nodes)
+    t = LocalProcTransport(
+        n_nodes=n_nodes, replicated=replicated, seed_bug=seed_bug
+    )
     try:
         nodes = t.nodes
         test = build_rabbitmq_test(

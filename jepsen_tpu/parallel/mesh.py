@@ -124,7 +124,9 @@ def sharded_total_queue(
 
 
 @functools.lru_cache(maxsize=64)
-def _queue_lin_program(mesh: Mesh, value_space: int):
+def _queue_lin_program(
+    mesh: Mesh, value_space: int, dup_invalidates: bool = True
+):
     def body(f, ty, v, m):
         # global history position of each local row: shard offset + iota
         n_local = f.shape[-1]
@@ -138,7 +140,7 @@ def _queue_lin_program(mesh: Mesh, value_space: int):
         a, x, r = jax.lax.psum((a, x, r), SEQ_AXIS)
         s = jax.lax.pmin(s, SEQ_AXIS)
         t = jax.lax.pmin(t, SEQ_AXIS)
-        return queue_lin_classify(a, x, s, r, t)
+        return queue_lin_classify(a, x, s, r, t, dup_invalidates)
 
     out_specs = QueueLinTensors(
         valid=P(HIST_AXIS),
@@ -155,18 +157,23 @@ def _queue_lin_program(mesh: Mesh, value_space: int):
 
 
 def sharded_queue_lin(
-    packed: PackedHistories, mesh: Mesh
+    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once"
 ) -> QueueLinTensors:
     """queue linearizability over the mesh: psum counts, pmin positions."""
-    fn = _queue_lin_program(mesh, packed.value_space)
+    fn = _queue_lin_program(
+        mesh, packed.value_space, delivery == "exactly-once"
+    )
     return fn(packed.f, packed.type, packed.value, packed.mask)
 
 
 def sharded_check(
-    packed: PackedHistories, mesh: Mesh
+    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once"
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
     """The full per-history verdict (both checkers) over the mesh."""
-    return sharded_total_queue(packed, mesh), sharded_queue_lin(packed, mesh)
+    return (
+        sharded_total_queue(packed, mesh),
+        sharded_queue_lin(packed, mesh, delivery),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -39,15 +39,18 @@ def _cfg(**kw: Any) -> dict[str, Any]:
     return base
 
 
-#: the reference's 14-config matrix (ci/jepsen-test.sh:92-107)
+#: the reference's 14-config matrix (ci/jepsen-test.sh:92-107), flag
+#: values in the reference's OWN spellings ("random-partition-halves" —
+#: an operator diffing these rows against the CI file sees them match
+#: textually; the nemesis accepts both spellings)
 CI_MATRIX: list[dict[str, Any]] = [
-    _cfg(partition="partition-random-halves", duration=30.0),
+    _cfg(partition="random-partition-halves", duration=30.0),
     _cfg(partition="partition-halves", duration=30.0),
     _cfg(partition="partition-majorities-ring", duration=30.0),
     _cfg(partition="partition-random-node", duration=30.0),
-    _cfg(partition="partition-random-halves", duration=10.0),
+    _cfg(partition="random-partition-halves", duration=10.0),
     _cfg(
-        partition="partition-random-halves",
+        partition="random-partition-halves",
         duration=10.0,
         **{"quorum-initial-group-size": 3},
     ),
@@ -70,7 +73,7 @@ CI_MATRIX: list[dict[str, Any]] = [
         **{"consumer-type": "polling"},
     ),
     _cfg(
-        partition="partition-random-halves",
+        partition="random-partition-halves",
         duration=30.0,
         **{"dead-letter": True},
     ),

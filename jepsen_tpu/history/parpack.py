@@ -9,15 +9,22 @@ itself (Op objects never cross the process boundary; only the compact
 ``[n, 8]`` int32 row matrices come back) — while the single
 ``pack_row_matrices`` assembly stays in the parent.
 
-Workers use the ``spawn`` start method (forking after the parent has
-initialized JAX/XLA threads is unsafe) and pin ``JAX_PLATFORMS=cpu``
-before any import so a tunneled chip plugin can never hang a pack
-worker (the round-1/2 failure mode this codebase guards everywhere).
+Workers are plain subprocesses with an EXPLICITLY sanitized environment
+(chip-plugin bootstrap stripped from PYTHONPATH, ``JAX_PLATFORMS=cpu``)
+so a tunneled chip plugin can never hang a pack worker (the round-1/2
+failure mode this codebase guards everywhere).  Not ``multiprocessing``:
+a Pool can only inherit the PARENT's env, which forced a mutate/restore
+of ``os.environ`` (racy against any other thread spawning a subprocess
+— advisor r3 #4), and a Pool silently *repopulates* dead workers
+mid-map, reviving children under whatever env is current by then.
+Work in, rows out via pickle files; worker crashes are loud errors.
 """
 
 from __future__ import annotations
 
 import os
+import sys
+from pathlib import Path
 from typing import Sequence
 
 
@@ -37,48 +44,89 @@ def _synth_queue_rows(args):  # pragma: no cover - runs in child processes
 
 
 def _read_rows(paths):  # pragma: no cover - runs in child processes
-    from jepsen_tpu.history.ops import workload_of
-    from jepsen_tpu.history.rows import _rows_for
-    from jepsen_tpu.history.store import read_history
+    from jepsen_tpu.history.rows import rows_with_cache
 
-    out = []
-    for p in paths:
-        h = read_history(p)
-        out.append((workload_of(h), _rows_for(h)))
-    return out
+    # load-through rows cache: a fresh rows.npz skips parse+explode
+    # entirely; a miss leaves the cache behind for the next check
+    return [rows_with_cache(p)[:2] for p in paths]
 
 
-def _fan_out(fn, chunks, workers: int):
-    import multiprocessing as mp
+_WORKER_FNS = {}  # name -> callable, filled after the fns are defined
 
-    # spawn-child hygiene, applied via the ENV (sitecustomize runs at the
-    # child's interpreter startup — before any initializer could act):
-    # strip the chip-plugin bootstrap site so children never import JAX
-    # at all (workers touch only numpy modules — history.rows/synth/
-    # store), and pin CPU in case anything pulls JAX in anyway.  spawn
-    # passes the parent's sys.path separately, so imports still resolve.
-    saved = {
-        k: os.environ.get(k) for k in ("PYTHONPATH", "JAX_PLATFORMS")
-    }
-    os.environ["PYTHONPATH"] = os.pathsep.join(
+
+def _worker_env() -> dict:
+    """The sanitized child environment: chip-plugin bootstrap stripped
+    (sitecustomize acts at interpreter start, before any in-child code
+    could), CPU pinned, and the repo root importable."""
+    env = dict(os.environ)
+    repo_root = str(Path(__file__).resolve().parents[2])
+    kept = [
         p
-        for p in (saved["PYTHONPATH"] or "").split(os.pathsep)
-        if p and "axon_site" not in p
-    )
-    os.environ["JAX_PLATFORMS"] = "cpu"
+        for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p and p != repo_root
+    ]
+    env["PYTHONPATH"] = os.pathsep.join([repo_root, *kept])
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _fan_out(fn_name: str, chunks, workers: int):
+    import pickle
+    import shutil
+    import subprocess
+    import tempfile
+
+    env = _worker_env()
+    tmpdir = tempfile.mkdtemp(prefix="jt-parpack-")
+    procs = []
     try:
-        ctx = mp.get_context("spawn")
-        with ctx.Pool(workers) as pool:
-            out = []
-            for part in pool.map(fn, chunks):
-                out.extend(part)
-            return out
+        for i, chunk in enumerate(chunks):
+            fin = os.path.join(tmpdir, f"in{i}.pkl")
+            fout = os.path.join(tmpdir, f"out{i}.pkl")
+            with open(fin, "wb") as fh:
+                pickle.dump((fn_name, chunk), fh)
+            procs.append(
+                (
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-m",
+                            "jepsen_tpu.history.parpack",
+                            fin,
+                            fout,
+                        ],
+                        env=env,
+                    ),
+                    fout,
+                )
+            )
+        out = []
+        for p, fout in procs:
+            rc = p.wait()
+            if rc != 0:
+                raise RuntimeError(
+                    f"pack worker exited rc={rc} (cmd: {p.args})"
+                )
+            with open(fout, "rb") as fh:
+                out.extend(pickle.load(fh))
+        return out
     finally:
-        for k, v in saved.items():
-            if v is None:
-                os.environ.pop(k, None)
-            else:
-                os.environ[k] = v
+        for p, _f in procs:
+            if p.poll() is None:  # an earlier worker's failure aborts us
+                p.kill()
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _worker_main(argv) -> int:  # pragma: no cover - child process entry
+    import pickle
+
+    fin, fout = argv
+    with open(fin, "rb") as fh:
+        fn_name, chunk = pickle.load(fh)
+    result = _WORKER_FNS[fn_name](chunk)
+    with open(fout, "wb") as fh:
+        pickle.dump(result, fh)
+    return 0
 
 
 def synth_queue_rows_parallel(
@@ -97,7 +145,7 @@ def synth_queue_rows_parallel(
         for lo, hi in bounds
         if hi > lo
     ]
-    return _fan_out(_synth_queue_rows, chunks, len(chunks))
+    return _fan_out("synth", chunks, len(chunks))
 
 
 def read_rows_parallel(paths: Sequence, workers: int):
@@ -110,4 +158,11 @@ def read_rows_parallel(paths: Sequence, workers: int):
         for w in range(workers)
     ]
     chunks = [c for c in chunks if c]
-    return _fan_out(_read_rows, chunks, len(chunks))
+    return _fan_out("read", chunks, len(chunks))
+
+
+_WORKER_FNS.update({"synth": _synth_queue_rows, "read": _read_rows})
+
+
+if __name__ == "__main__":  # pragma: no cover - child process entry
+    sys.exit(_worker_main(sys.argv[1:]))

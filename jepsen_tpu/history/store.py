@@ -92,6 +92,16 @@ class Store:
     def save_history(self, run_dir: Path, history: Sequence[Op]) -> Path:
         p = run_dir / HISTORY_FILE
         write_history_jsonl(p, history)
+        try:
+            # cut the packed-row cache at record time so the first
+            # re-check never pays the explode cost (best-effort — the
+            # run's history is already safely on disk)
+            from jepsen_tpu.history.ops import workload_of
+            from jepsen_tpu.history.rows import _rows_for, save_rows_cache
+
+            save_rows_cache(p, workload_of(history), _rows_for(history))
+        except Exception:  # noqa: BLE001 - cache is an optimization only
+            pass
         self.link_run(run_dir.parent.name, run_dir)
         return p
 

@@ -236,3 +236,50 @@ def test_bench_check_workers_mixed_store_filters(tmp_path, capsys):
     # host the CLI caps workers and falls back to the serial path, whose
     # family filter the assertion above just exercised
     assert "produce_s" in stats or "capped to" in captured.err
+
+
+def test_reference_ci_parameter_strings_parse_verbatim():
+    """Drop-in contract (VERDICT r3 missing #3): every parameter string
+    from the reference's CI matrix (ci/jepsen-test.sh:93-107, including
+    the 'random-partition-halves' spelling and '--dead-letter true')
+    parses against `jepsen_tpu test` unchanged, and the partition value
+    resolves to a real nemesis strategy."""
+    import shlex
+
+    from jepsen_tpu.cli.main import build_parser
+    from jepsen_tpu.control.nemesis import STRATEGIES
+
+    ci_lines = [
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition random-partition-halves --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition partition-halves --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition partition-majorities-ring --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition partition-random-node --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition random-partition-halves --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition random-partition-halves --net-ticktime 15 --consumer-type mixed --quorum-initial-group-size 3",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-halves --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-majorities-ring --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-random-node --net-ticktime 15 --consumer-type mixed",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-random-node --net-ticktime 15 --consumer-type asynchronous",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-random-node --net-ticktime 15 --consumer-type asynchronous --quorum-initial-group-size 3",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 10 --network-partition partition-random-node --net-ticktime 15 --consumer-type polling",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition random-partition-halves --net-ticktime 15 --consumer-type mixed --dead-letter true",
+        "--time-limit 180 --time-before-partition 20 --partition-duration 30 --network-partition partition-halves --net-ticktime 15 --consumer-type mixed --dead-letter true",
+    ]
+    parser = build_parser()
+    for line in ci_lines:
+        args = parser.parse_args(["test", *shlex.split(line)])
+        assert args.network_partition in STRATEGIES, line
+        assert args.time_limit == 180
+        if "--dead-letter true" in line:
+            assert args.dead_letter is True
+    # both spellings of the shuffled-halves strategy are the same code
+    assert (
+        STRATEGIES["random-partition-halves"]
+        is STRATEGIES["partition-random-halves"]
+    )
+    # and the reference's -r short flag for rate parses
+    a = parser.parse_args(["test", "-r", "75"])
+    assert a.rate == 75.0
+    # bare --dead-letter (no value) still means True; absent means False
+    assert parser.parse_args(["test", "--dead-letter"]).dead_letter is True
+    assert parser.parse_args(["test"]).dead_letter is False

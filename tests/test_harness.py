@@ -8,7 +8,8 @@ from jepsen_tpu.history.synth import SynthSpec, synth_history
 def test_matrix_has_reference_shape():
     assert len(CI_MATRIX) == 14
     opts = matrix_opts(CI_MATRIX[0])
-    assert opts["network-partition"] == "partition-random-halves"
+    # textually the reference's own spelling (ci/jepsen-test.sh:93)
+    assert opts["network-partition"] == "random-partition-halves"
     assert opts["partition-duration"] == 30.0
     assert opts["time-limit"] == 180.0
     # dead-letter configs present (12th/13th entries)

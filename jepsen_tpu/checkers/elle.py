@@ -118,13 +118,30 @@ def infer_txn_graph(history: Sequence[Op]) -> TxnGraph:
                 writer_of[m[2]] = t
                 appends_of.setdefault((t, m[1]), []).append(m[2])
 
-    # per-key inferred order = longest observed list (prefix-checked)
+    # per-key inferred order = longest observed list (prefix-checked).
+    # A txn's reads are first normalized by stripping values the SAME txn
+    # appended (elle's own-append normalization): intermediate reads see
+    # the txn's staged-but-uncommitted appends merged after the committed
+    # prefix (read-your-writes — client/native.py NativeTxnDriver,
+    # client/sim.py), and that merge fabricates an order the real commit
+    # order may legitimately contradict (an interloper's append commits
+    # between the observed prefix and this txn's own later commit).  The
+    # committed part of the read is the sound observation; the staged
+    # suffix is not an observation of any version at all.
     order: dict[int, list[int]] = {}
     reads: list[tuple[int, int, list[int]]] = []  # (txn, key, observed list)
     for t, (_, mops) in enumerate(committed):
         for m in mops:
             if len(m) == 3 and m[0] == READ and isinstance(m[2], (list, tuple)):
+                own = set(appends_of.get((t, m[1]), ()))
                 vs = [v for v in m[2] if isinstance(v, int)]
+                # strip the trailing own-suffix ONLY: the merge puts own
+                # staged values after the committed prefix, so an own
+                # value observed MID-list is not the merge — it is a
+                # genuine misorder and must stay visible to the
+                # prefix-compatibility check
+                while vs and vs[-1] in own:
+                    vs.pop()
                 reads.append((t, m[1], vs))
                 cur = order.get(m[1], [])
                 if len(vs) > len(cur):

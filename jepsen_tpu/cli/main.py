@@ -444,9 +444,8 @@ def cmd_bench_check(args) -> int:
         t_check = time.perf_counter() - t1
         n_invalid = int((~sl.valid).sum())
     elif workload == "mutex":
-        # the batched frontier-bitset WGL search itself (owned-mutex
-        # model): one vmapped XLA program over all histories
         from jepsen_tpu.checkers.wgl import (
+            check_wgl_cpu,
             mutex_wgl_ops,
             pack_wgl_batch,
             wgl_tensor_check,
@@ -454,14 +453,34 @@ def cmd_bench_check(args) -> int:
         from jepsen_tpu.models.core import OwnedMutex
 
         t0 = time.perf_counter()
-        packed = pack_wgl_batch([mutex_wgl_ops(h) for h in histories])
-        t_pack = time.perf_counter() - t0
-        wgl_tensor_check(packed, (OwnedMutex, ()))  # compile
-        t1 = time.perf_counter()
-        ok, unknown = wgl_tensor_check(packed, (OwnedMutex, ()))
-        t_check = time.perf_counter() - t1
-        n_invalid = int((~ok & ~unknown).sum())
-        n_unknown = int(unknown.sum())
+        opss = [mutex_wgl_ops(h) for h in histories]
+        if getattr(args, "engine", "classic") == "tensor":
+            # opt-in ONLY: the batched frontier-bitset device search —
+            # measured ~650x slower per history than the classic host
+            # search on this family (WGL_BENCH.md re-scope); it exists
+            # for general-model correctness, not throughput
+            packed = pack_wgl_batch(opss)
+            t_pack = time.perf_counter() - t0
+            wgl_tensor_check(packed, (OwnedMutex, ()))  # compile
+            t1 = time.perf_counter()
+            ok, unknown = wgl_tensor_check(packed, (OwnedMutex, ()))
+            t_check = time.perf_counter() - t1
+            n_invalid = int((~ok & ~unknown).sum())
+            n_unknown = int(unknown.sum())
+        else:
+            # the perf path (default): the classic Wing-Gong host search
+            # wins on the mutex family at every measured configuration
+            t_pack = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            results = [check_wgl_cpu(ops, OwnedMutex()) for ops in opss]
+            t_check = time.perf_counter() - t1
+            # tri-state: "valid?" is True / False / the truthy string
+            # "unknown" (config-cap overflow) — an undecided history is
+            # neither passing nor a violation
+            n_invalid = sum(1 for r in results if r["valid?"] is False)
+            n_unknown = sum(
+                1 for r in results if r["valid?"] not in (True, False)
+            )
     elif workload == "elle":
         import numpy as np
 
@@ -514,8 +533,10 @@ def cmd_bench_check(args) -> int:
     stats_extra = {}
     if workload == "mutex":
         # tri-state honesty: a frontier overflow is undecided, which is
-        # neither a pass nor a violation — surface it
+        # neither a pass nor a violation — surface it.  The engine field
+        # keeps classic-vs-tensor numbers from ever being conflated.
         stats_extra["unknown"] = n_unknown
+        stats_extra["engine"] = getattr(args, "engine", "classic")
     print(
         json.dumps(
             {
@@ -567,6 +588,7 @@ def cmd_test(args) -> int:
         "net-ticktime": args.net_ticktime,
         "quorum-initial-group-size": args.quorum_initial_group_size,
         "dead-letter": args.dead_letter,
+        "seed": args.seed,
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
@@ -917,6 +939,15 @@ def build_parser() -> argparse.ArgumentParser:
         default="auto",
     )
     b.add_argument(
+        "--engine",
+        choices=("classic", "tensor"),
+        default="classic",
+        help="mutex workload only: 'classic' (default) is the Wing-Gong "
+        "host search — the measured perf path for this family; 'tensor' "
+        "opts into the batched device frontier search (~650x slower per "
+        "history, kept for general-model correctness; WGL_BENCH.md)",
+    )
+    b.add_argument(
         "--profile",
         help="write a jax.profiler (XProf) trace of the check to this dir",
     )
@@ -1031,6 +1062,13 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("asynchronous", "polling", "mixed"),
     )
     t.add_argument("--net-ticktime", type=int, default=15)
+    t.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="workload-generator seed (elle micro-op mix; distinct "
+        "trials should not replay identical txn programs)",
+    )
     t.add_argument("--quorum-initial-group-size", type=int, default=0)
     t.add_argument(
         "--dead-letter",

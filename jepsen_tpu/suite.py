@@ -393,7 +393,9 @@ def build_rabbitmq_test(
             native_txn_driver_factory(),
             txn_timeout_s=o["publish-confirm-timeout"],
         )
-        generator = elle_generator(o)
+        # seedable micro-op mix ("seed" opt): distinct trials must not
+        # replay byte-identical txn programs (tools/measure_g2.py)
+        generator = elle_generator(o, seed=int(o.get("seed", 0) or 0))
         # AMQP tx promises atomic commit visibility, NOT read isolation
         # across keys: a live broker produces genuine G2 anti-dependency
         # cycles under concurrency, so the honest default level for this

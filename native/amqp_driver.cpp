@@ -833,9 +833,24 @@ int g_drain_wait_ms = 5000;  // redelivery settle time (Utils.java:427)
 
 // "host[:port]" → (host, port).  Local multi-node clusters put every node
 // on 127.0.0.1 with a distinct port, so node names may carry their own
-// port which overrides the config default (IPv4/hostnames only — a
-// non-numeric suffix is treated as part of the host).
+// port which overrides the config default.  A non-numeric suffix is
+// treated as part of the host, and an IPv6 literal (more than one ':',
+// or bracketed) falls through whole to the config default port — rfind
+// on "::1" would otherwise misparse host ":" port 1 (advisor r4).
 std::pair<std::string, int> split_host_port(const std::string& h, int def) {
+  if (!h.empty() && h[0] == '[') {  // [v6literal] or [v6literal]:port
+    auto close = h.find(']');
+    if (close == std::string::npos) return {h, def};  // malformed: as-is
+    std::string host = h.substr(1, close - 1);
+    if (close + 2 < h.size() && h[close + 1] == ':') {
+      std::string port_s = h.substr(close + 2);
+      if (port_s.find_first_not_of("0123456789") == std::string::npos)
+        return {host, std::atoi(port_s.c_str())};
+    }
+    return {host, def};
+  }
+  if (std::count(h.begin(), h.end(), ':') > 1)
+    return {h, def};  // bare IPv6 literal: no port suffix to split
   auto colon = h.rfind(':');
   if (colon == std::string::npos || colon + 1 >= h.size()) return {h, def};
   std::string port_s = h.substr(colon + 1);

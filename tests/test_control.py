@@ -378,3 +378,148 @@ def test_make_nemesis_selection():
     assert isinstance(nem, ProcessNemesis) and nem.mode == "pause"
     with pytest.raises(ValueError):
         make_nemesis({"nemesis": "meteor-strike"}, net, None, NODES)
+
+
+# ---------------------------------------------------------------------------
+# SshTransport against a fake `ssh` on PATH (VERDICT r3 #5: this is the
+# one load-bearing class that would otherwise first run in production —
+# the image has no ssh binary and no network)
+# ---------------------------------------------------------------------------
+
+
+import json as _json
+import os as _os
+import stat as _stat
+import sys as _sys
+
+import pytest as _pytest
+
+from jepsen_tpu.control.ssh import RemoteError, SshTransport
+
+
+@_pytest.fixture
+def fake_ssh(tmp_path, monkeypatch):
+    """A fake `ssh` prepended to PATH: records argv (JSON-per-line) and
+    stdin to files, then behaves per env knobs FAKE_SSH_RC /
+    FAKE_SSH_OUT / FAKE_SSH_ERR / FAKE_SSH_SLEEP."""
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    argv_log = tmp_path / "argv.jsonl"
+    stdin_log = tmp_path / "stdin.bin"
+    script = bindir / "ssh"
+    script.write_text(
+        "#!"
+        + _sys.executable
+        + "\n"
+        + f"""
+import json, os, sys, time
+with open({str(argv_log)!r}, "a") as fh:
+    fh.write(json.dumps(sys.argv[1:]) + "\\n")
+data = sys.stdin.buffer.read() if not sys.stdin.isatty() else b""
+with open({str(stdin_log)!r}, "ab") as fh:
+    fh.write(data)
+time.sleep(float(os.environ.get("FAKE_SSH_SLEEP", "0")))
+sys.stdout.write(os.environ.get("FAKE_SSH_OUT", ""))
+sys.stderr.write(os.environ.get("FAKE_SSH_ERR", ""))
+sys.exit(int(os.environ.get("FAKE_SSH_RC", "0")))
+"""
+    )
+    script.chmod(script.stat().st_mode | _stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{bindir}{_os.pathsep}{_os.environ['PATH']}")
+
+    class Shim:
+        def argv_calls(self):
+            if not argv_log.exists():
+                return []
+            return [
+                _json.loads(line)
+                for line in argv_log.read_text().splitlines()
+            ]
+
+        def stdin_bytes(self):
+            return stdin_log.read_bytes() if stdin_log.exists() else b""
+
+    return Shim()
+
+
+def test_ssh_args_construction_snapshot(fake_ssh, monkeypatch):
+    """The exact argv contract: options, port, key, control-persist,
+    user@host, then the command string as ONE argv element."""
+    t = SshTransport(user="admin", private_key="/k/id", port=2222,
+                     connect_timeout=7)
+    monkeypatch.setenv("FAKE_SSH_OUT", "hi\n")
+    r = t.run("n1.example", "echo hi")
+    assert (r.rc, r.out) == (0, "hi\n")
+    (argv,) = fake_ssh.argv_calls()
+    assert argv == [
+        "-o", "BatchMode=yes",
+        "-o", "StrictHostKeyChecking=no",
+        "-o", "UserKnownHostsFile=/dev/null",
+        "-o", "LogLevel=ERROR",
+        "-o", "ConnectTimeout=7",
+        "-p", "2222",
+        "-o", "ControlMaster=auto",
+        "-o", "ControlPath=/tmp/jepsen-tpu-ssh-admin-%h-%p",
+        "-o", "ControlPersist=60",
+        "-i", "/k/id",
+        "admin@n1.example",
+        "echo hi",
+    ]
+
+
+def test_ssh_args_minimal_no_key_no_persist(fake_ssh):
+    t = SshTransport(control_persist=False)
+    t.run("db1", "true")
+    (argv,) = fake_ssh.argv_calls()
+    assert "-i" not in argv
+    assert not any("ControlMaster" in a for a in argv)
+    assert argv[-2:] == ["root@db1", "true"]
+
+
+def test_run_maps_rc_stdout_stderr(fake_ssh, monkeypatch):
+    monkeypatch.setenv("FAKE_SSH_RC", "3")
+    monkeypatch.setenv("FAKE_SSH_OUT", "partial")
+    monkeypatch.setenv("FAKE_SSH_ERR", "boom")
+    r = SshTransport().run("n1", "failing-cmd")
+    assert (r.rc, r.out, r.err) == (3, "partial", "boom")
+
+
+def test_run_timeout_is_remote_error(fake_ssh, monkeypatch):
+    monkeypatch.setenv("FAKE_SSH_SLEEP", "5")
+    with _pytest.raises(RemoteError) as ei:
+        SshTransport().run("n1", "sleepy", timeout=0.3)
+    assert "timed out" in str(ei.value)
+
+
+def test_put_pipes_content_through_cat(fake_ssh):
+    t = SshTransport()
+    t.put("n1", b"\x00binary\xff", "/etc/rabbitmq/rabbitmq.conf")
+    (argv,) = fake_ssh.argv_calls()
+    assert argv[-1] == "cat > /etc/rabbitmq/rabbitmq.conf"
+    assert fake_ssh.stdin_bytes() == b"\x00binary\xff"
+
+
+def test_put_nonzero_rc_raises(fake_ssh, monkeypatch):
+    monkeypatch.setenv("FAKE_SSH_RC", "1")
+    monkeypatch.setenv("FAKE_SSH_ERR", "read-only fs")
+    with _pytest.raises(RemoteError) as ei:
+        SshTransport().put("n1", b"x", "/nope")
+    assert "read-only fs" in str(ei.value)
+
+
+def test_get_streams_to_local_file(fake_ssh, tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKE_SSH_OUT", "log line\n")
+    dest = tmp_path / "out.log"
+    assert SshTransport().get("n1", "/var/log/rabbit.log", dest) is True
+    assert dest.read_text() == "log line\n"
+    (argv,) = fake_ssh.argv_calls()
+    assert argv[-1] == "cat /var/log/rabbit.log"
+
+
+def test_get_missing_remote_is_false_and_cleans_up(
+    fake_ssh, tmp_path, monkeypatch
+):
+    monkeypatch.setenv("FAKE_SSH_RC", "1")
+    dest = tmp_path / "out.log"
+    assert SshTransport().get("n1", "/gone", dest) is False
+    assert not dest.exists()  # no empty/partial artifact left behind

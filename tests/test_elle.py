@@ -209,3 +209,93 @@ def test_consistency_model_levels():
 
     with pytest.raises(ValueError):
         check_elle_cpu(g2h.ops, model="snapshot-isolation")
+
+
+def test_own_staged_append_in_intermediate_read_is_not_incompatible():
+    """Read-your-writes normalization: a txn's intermediate read merges
+    its own staged (uncommitted) appends after the committed prefix —
+    client/native.py's txn driver and the sim driver both do this.  An
+    interloper committing between that read and the txn's own commit
+    makes the merged list contradict the final order ([2] vs [1, 2]);
+    the checker must strip the txn's own values before order inference
+    instead of flagging incompatible-order (found live: the measured-G2
+    runs were red at read-committed for exactly this)."""
+    from jepsen_tpu.checkers.elle import check_elle_batch, check_elle_cpu
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    k = 0
+    h = []
+    # T1 stages append(k,2), reads k -> sees committed [] + own [2]
+    t1 = Op.invoke(OpF.TXN, 0, [["append", k, 2], ["r", k, [2]]])
+    h.append(t1)
+    # T0 commits append(k,1) while T1 is still open
+    t0 = Op.invoke(OpF.TXN, 1, [["append", k, 1]])
+    h.append(t0)
+    h.append(t0.complete(OpType.OK, value=[["append", k, 1]]))
+    # T1 commits after T0: the real order is [1, 2]
+    h.append(t1.complete(OpType.OK, value=[["append", k, 2], ["r", k, [2]]]))
+    # T2 reads the final committed list
+    t2 = Op.invoke(OpF.TXN, 2, [["r", k, None]])
+    h.append(t2)
+    h.append(t2.complete(OpType.OK, value=[["r", k, [1, 2]]]))
+    hh = reindex(h)
+    r = check_elle_cpu(hh, model="read-committed")
+    assert r["incompatible-order-count"] == 0, r
+    assert r["valid?"], r
+    # the tensor path shares the host inference
+    assert check_elle_batch([hh], model="read-committed")[0]["valid?"]
+
+
+def test_genuinely_incompatible_committed_reads_still_flagged():
+    """The normalization must not swallow real divergence: two COMMITTED
+    reads that disagree on other txns' values remain incompatible."""
+    from jepsen_tpu.checkers.elle import check_elle_cpu
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    k = 0
+    h = []
+    for t_id, (vals, read) in enumerate(
+        [([1], None), ([2], None)]
+    ):
+        t = Op.invoke(OpF.TXN, t_id, [["append", k, vals[0]]])
+        h.append(t)
+        h.append(t.complete(OpType.OK, value=[["append", k, vals[0]]]))
+    # reader A saw [1, 2]; reader B saw [2, 1] — not prefix-compatible
+    for t_id, seen in ((2, [1, 2]), (3, [2, 1])):
+        t = Op.invoke(OpF.TXN, t_id, [["r", k, None]])
+        h.append(t)
+        h.append(t.complete(OpType.OK, value=[["r", k, seen]]))
+    r = check_elle_cpu(reindex(h), model="read-committed")
+    assert r["incompatible-order-count"] == 1
+    assert not r["valid?"]
+
+
+def test_own_value_mid_list_is_still_a_misorder():
+    """The own-append normalization strips the trailing own-suffix ONLY:
+    the read-your-writes merge appends own staged values after the
+    committed prefix, so an own value observed MID-list cannot come from
+    the merge — it is a genuine broker misorder and must stay flagged."""
+    from jepsen_tpu.checkers.elle import check_elle_cpu
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    k = 0
+    h = []
+    t0 = Op.invoke(OpF.TXN, 0, [["append", k, 3]])
+    h.append(t0)
+    h.append(t0.complete(OpType.OK, value=[["append", k, 3]]))
+    t1 = Op.invoke(OpF.TXN, 1, [["append", k, 4]])
+    h.append(t1)
+    h.append(t1.complete(OpType.OK, value=[["append", k, 4]]))
+    # T2's own append 5 observed BETWEEN other txns' committed values —
+    # not the trailing merge position
+    t2 = Op.invoke(OpF.TXN, 2, [["append", k, 5], ["r", k, [3, 5, 4]]])
+    h.append(t2)
+    h.append(
+        t2.complete(OpType.OK, value=[["append", k, 5], ["r", k, [3, 5, 4]]])
+    )
+    t3 = Op.invoke(OpF.TXN, 3, [["r", k, None]])
+    h.append(t3)
+    h.append(t3.complete(OpType.OK, value=[["r", k, [3, 4, 5]]]))
+    r = check_elle_cpu(reindex(h), model="read-committed")
+    assert r["incompatible-order-count"] == 1, r
+    assert not r["valid?"]

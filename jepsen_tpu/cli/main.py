@@ -622,20 +622,11 @@ def cmd_test(args) -> int:
         # otherwise make this run's drain return instantly empty
         native_mod.reset()
 
+        # every family is multi-node-meaningful on the replicated
+        # cluster: queue/mutex ops and stream/elle reads all route
+        # through the Raft leader (stream reads commit through the log —
+        # linearizable even from lagging followers)
         n = len(args.nodes.split(",")) if args.nodes else 3
-        if args.workload != "queue" and n > 1:
-            # queue ops route through the replicated cluster's leader, so
-            # multi-node is fully meaningful for the queue family; the
-            # stream/mutex/elle mappings still read local replica state
-            # (snapshot reads), so their multi-node runs would blame the
-            # harness's read routing, not the SUT — they stay single-node
-            print(
-                f"# --db local: {args.workload} workload runs single-node "
-                f"(only the queue family routes through the replicated "
-                f"leader); ignoring extra nodes",
-                file=sys.stderr,
-            )
-            n = 1
         test, local_cluster = build_local_test(
             opts,
             n_nodes=n,

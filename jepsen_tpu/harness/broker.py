@@ -266,8 +266,10 @@ class MiniAmqpBroker:
             return len(self.queues.get(name, ()))
 
     def stream_depth(self, name: str = "jepsen.stream") -> int:
+        """LOCAL-replica depth (tests/diagnostics; may lag the cluster —
+        the client read path is the linearizable committed read)."""
         if self.replication is not None:
-            return len(self.replication.stream_snapshot(name))
+            return len(self.replication.machine.stream_snapshot(name))
         with self.state_lock:
             return len(self.streams.get(name, ()))
 
@@ -463,7 +465,40 @@ class MiniAmqpBroker:
                     conn.consuming_noack = bool(cbits & 2)
                     cargs = r.table()
                     self._send_method(conn, ch, 60, 21, _shortstr(ctag))
-                    if qname in self.streams:
+                    # stream-ness + snapshot come from ONE read.  In
+                    # replicated mode that read COMMITS through the log:
+                    # it is linearizable (a lagging follower still
+                    # returns every confirmed append) and its committed
+                    # answer — not any local marker, which would race
+                    # the declare's application — decides whether the
+                    # name is a stream at all.
+                    if self.replication is not None:
+                        kind, log = self.replication.stream_read(qname)
+                    else:
+                        with self.state_lock:
+                            if qname in self.streams:
+                                kind, log = "stream", list(
+                                    self.streams[qname]
+                                )
+                            else:
+                                kind, log = "notstream", None
+                    if kind == "noquorum":
+                        # the read cannot commit.  Pure silence here
+                        # would be indistinguishable from a committed
+                        # empty log (a false-loss verdict downstream) —
+                        # close the channel so the client's read FAILS
+                        # (reads are safe to fail) instead of concluding
+                        # end-of-log on nothing
+                        self._send_method(
+                            conn,
+                            ch,
+                            20,
+                            40,
+                            struct.pack(">H", 541)  # internal-error
+                            + _shortstr("stream read lost quorum")
+                            + struct.pack(">HH", 60, 20),
+                        )
+                    elif kind == "stream":
                         # offset spec: an absolute int64, or the string
                         # specs "first" (0) / "last" (the final chunk ≡
                         # the final record here) / "next" (past the
@@ -475,11 +510,13 @@ class MiniAmqpBroker:
                         if spec == "first":
                             offset = 0
                         elif spec in ("last", "next"):
-                            n = self.stream_depth(qname)
+                            n = len(log)
                             offset = n - 1 if spec == "last" and n else n
                         else:
                             offset = int(spec)
-                        self._stream_deliver(conn, ch, qname, offset, ctag)
+                        self._stream_deliver(
+                            conn, ch, qname, log, offset, ctag
+                        )
                     else:
                         # ch first: a concurrent kick-loop delivery keys
                         # off consuming_queue and must never observe the
@@ -860,18 +897,18 @@ class MiniAmqpBroker:
                 return
 
     def _stream_deliver(
-        self, conn: _ConnState, ch: int, qname: str, offset: int, ctag: str
+        self,
+        conn: _ConnState,
+        ch: int,
+        qname: str,
+        log: list,
+        offset: int,
+        ctag: str,
     ):
-        """Non-destructive snapshot delivery from ``offset``; each record
-        carries its log offset in the x-stream-offset message header."""
-        if self.replication is not None:
-            log = self.replication.stream_snapshot(qname)
-            snapshot = list(enumerate(log))[offset:]
-        else:
-            with self.state_lock:
-                snapshot = list(
-                    enumerate(self.streams.get(qname, ()))
-                )[offset:]
+        """Non-destructive snapshot delivery from ``offset`` over the
+        caller-provided snapshot; each record carries its log offset in
+        the x-stream-offset message header."""
+        snapshot = list(enumerate(log))[offset:]
         for off, body in snapshot:
             with self.state_lock:
                 tag = conn.next_tag

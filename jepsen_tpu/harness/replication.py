@@ -83,9 +83,12 @@ class QueueMachine:
 
     Every mutation enters through :meth:`apply` with values (including
     timestamps) taken from the committed log entry, so replicas converge
-    byte-for-byte.  Reads (:meth:`counts`, :meth:`stream_snapshot`) are
-    local and non-mutating — TTL expiry is *simulated* in ``counts`` and
-    *performed* inside DEQ application (the op carries ``now``)."""
+    byte-for-byte.  :meth:`counts` / :meth:`stream_snapshot` are local,
+    non-mutating DIAGNOSTIC views of this replica (DEPTHS, tests) — the
+    client-facing stream read path is the committed ``read_stream`` op
+    (``ReplicatedBackend.stream_read``), which is linearizable.  TTL
+    expiry is *simulated* in ``counts`` and *performed* inside DEQ
+    application (the op carries ``now``)."""
 
     def __init__(self) -> None:
         self.queues: dict[str, deque[_RMsg]] = {}
@@ -151,6 +154,16 @@ class QueueMachine:
             n = len(dq) if dq else 0
             self.queues[op["q"]] = deque()
             return n
+        if k == "read_stream":
+            # linearizable read: committing the read through the log IS
+            # the linearization point — the returned snapshot reflects
+            # every append committed before it, on every node, even when
+            # the node that asked is a lagging follower.  Stream-ness is
+            # part of the committed answer (a local marker would race
+            # the declare's application on lagging replicas).
+            if op["q"] not in self.streams:
+                return {"_notstream": True}
+            return list(self.streams[op["q"]])
         raise ValueError(f"unknown replicated op {k!r}")
 
     def _enq_locked(self, mid: str, op: dict) -> None:
@@ -755,6 +768,12 @@ def _encode_result(result: Any) -> Any:
             "body": base64.b64encode(result.body).decode(),
             "props": base64.b64encode(result.props).decode(),
         }
+    if isinstance(result, list) and all(
+        isinstance(x, bytes) for x in result
+    ):
+        return {
+            "_blist": [base64.b64encode(x).decode() for x in result]
+        }
     return result
 
 
@@ -766,6 +785,8 @@ def _decode_result(result: Any) -> Any:
             base64.b64decode(result["body"]),
             base64.b64decode(result["props"]),
         )
+    if isinstance(result, dict) and "_blist" in result:
+        return [base64.b64decode(x) for x in result["_blist"]]
     return result
 
 
@@ -898,9 +919,29 @@ class ReplicatedBackend:
         )
         return int(n or 0) if ok else 0
 
-    # -- local reads --------------------------------------------------------
+    def stream_read(
+        self, name: str
+    ) -> tuple[str, list[bytes] | None]:
+        """LINEARIZABLE stream read: the read commits through the log
+        (its commit is the linearization point), so it reflects every
+        confirmed append cluster-wide even from a lagging follower, and
+        the committed state — not any local marker — answers whether
+        ``name`` is a stream at all.
+
+        Returns ``("stream", log)``, ``("notstream", None)`` (the name is
+        a classic queue / undeclared), or ``("noquorum", None)`` when the
+        read cannot commit — the caller must surface *failure*, never a
+        stale local view."""
+        ok, result = self.raft.submit(
+            {"k": "read_stream", "q": name},
+            timeout_s=self.submit_timeout_s,
+        )
+        if not ok:
+            return "noquorum", None
+        if isinstance(result, dict) and result.get("_notstream"):
+            return "notstream", None
+        return "stream", result if isinstance(result, list) else []
+
+    # -- local reads (diagnostics only — NOT the client read path) ----------
     def counts(self) -> dict[str, int]:
         return self.machine.counts(time.time() * 1000.0)
-
-    def stream_snapshot(self, name: str) -> list[bytes]:
-        return self.machine.stream_snapshot(name)

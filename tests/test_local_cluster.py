@@ -147,10 +147,10 @@ def test_seeded_confirm_before_quorum_caught_end_to_end(_reset):
 
 
 def test_full_stream_run_single_node(_reset):
-    """The stream family through the same live assembly (single node —
-    stream reads are local snapshots, so only the queue family routes
-    through the replicated leader): native stream client over real TCP,
-    offset-proof full read, stream checker verdict."""
+    """The stream family through the same live assembly on a single
+    non-replicated node (the fast smoke path; the replicated 3-node
+    variant with a partition is below): native stream client over real
+    TCP, offset-proof full read, stream checker verdict."""
     t = LocalProcTransport(n_nodes=1)
     try:
         nodes = t.nodes
@@ -294,5 +294,37 @@ def test_full_elle_run_checks_the_suts_actual_contract(_reset):
         # produced G2 cycles (it usually does), serializable flags them
         strict = check_elle_cpu(run.history)
         assert strict["G2-count"] == run.results["elle"]["G2-count"]
+    finally:
+        t.close()
+
+
+def test_full_stream_run_three_node_replicated(_reset):
+    """The stream family across a 3-node replicated cluster WITH a real
+    partition: appends quorum-commit, reads commit through the log
+    (linearizable even from lagging followers), offset-proof full read,
+    valid verdict."""
+    t = LocalProcTransport(n_nodes=3)
+    try:
+        nodes = t.nodes
+        opts = {
+            **DEFAULT_OPTS,
+            "rate": 80.0,
+            "time-limit": 4.0,
+            "time-before-partition": 1.0,
+            "partition-duration": 1.2,
+            "recovery-sleep": 1.0,
+            "publish-confirm-timeout": 2.5,
+            "read-timeout": 0.8,
+        }
+        test = build_rabbitmq_test(
+            opts=opts, nodes=nodes, transport=t, db=_fast_db(t, nodes),
+            checker_backend="cpu", store_root=tempfile.mkdtemp(),
+            workload="stream", concurrency=3,
+        )
+        run = run_test(test)
+        assert run.results["valid?"] is True, run.results
+        s = run.results["stream"]
+        assert s["attempt-count"] > 20
+        assert s["read-value-count"] > 0
     finally:
         t.close()

@@ -529,7 +529,11 @@ def _watch(interval: float, budget: float) -> int:
                 )
                 try:
                     r = subprocess.run(
-                        [sys.executable, os.path.abspath(__file__)],
+                        [
+                            sys.executable,
+                            os.path.abspath(__file__),
+                            "--locked",  # this loop holds the lock
+                        ],
                         capture_output=True,
                         text=True,
                         env=os.environ.copy(),
@@ -660,6 +664,10 @@ def main(argv=None) -> int:
         "--harvest-child", action="store_true", help=argparse.SUPPRESS
     )
     p.add_argument(
+        # the invoker already holds the harvest lock (watch loop child)
+        "--locked", action="store_true", help=argparse.SUPPRESS
+    )
+    p.add_argument(
         # set by utils/harvest.opportunistic: the spawner still holds the
         # exclusive chip — wait for it to exit before dispatching
         "--wait-pid", type=int, default=0, help=argparse.SUPPRESS
@@ -680,7 +688,10 @@ def main(argv=None) -> int:
                 file=sys.stderr,
             )
             return 0
-        _run_once()
+        if args.harvest_child or args.locked:
+            _run_once()  # the harvest lock is already held for us
+        else:
+            _run_locked()
     finally:
         if args.harvest_child:
             # spawned by utils/harvest.opportunistic — drop its lock
@@ -688,6 +699,37 @@ def main(argv=None) -> int:
 
             release_lock()
     return 0
+
+
+def _run_locked(patience_s: float = 1200.0, poll_s: float = 10.0) -> None:
+    """Direct invocations (e.g. the round driver's `python bench.py`)
+    honor the harvest single-flight lock too: if an opportunistic capture
+    is mid-bench on the exclusive chip, wait for it rather than
+    dispatching beside it — but never longer than ``patience_s``; this
+    run's artifact must exist even if a stale harvest wedged."""
+    from jepsen_tpu.utils import harvest
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    deadline = time.monotonic() + patience_s
+    got = harvest._try_lock(root)
+    while not got and time.monotonic() < deadline:
+        print(
+            "# another harvest holds the bench lock — waiting for it",
+            file=sys.stderr,
+        )
+        time.sleep(poll_s)
+        got = harvest._try_lock(root)
+    if not got:
+        print(
+            f"# lock still held after {patience_s:.0f}s — proceeding "
+            f"anyway (the round artifact must exist)",
+            file=sys.stderr,
+        )
+    try:
+        _run_once()
+    finally:
+        if got:
+            harvest.release_lock(root)
 
 
 def _await_pid_exit(pid: int, budget: float, poll_s: float = 5.0) -> bool:

@@ -192,6 +192,10 @@ class MiniAmqpBroker:
         self._appended = 0
         self._conn_seq = 0
         self._owner_salt = f"{_random.Random().getrandbits(32):08x}-"
+        # names a committed read answered "notstream" for (replicated
+        # mode): later consumes of these classic queues skip the
+        # committed stream-ness probe
+        self._known_queues: set[str] = set()
         self._conns: list[_ConnState] = []
         self._accept_thread: threading.Thread | None = None
         self._kick = threading.Event()
@@ -473,7 +477,19 @@ class MiniAmqpBroker:
                     # the declare's application — decides whether the
                     # name is a stream at all.
                     if self.replication is not None:
-                        kind, log = self.replication.stream_read(qname)
+                        with self.state_lock:
+                            known_queue = qname in self._known_queues
+                        if known_queue:
+                            # committed-answered classic queue: consumes
+                            # need no linearizable snapshot, and skipping
+                            # the read op keeps the uncompacted log from
+                            # growing once per queue consume
+                            kind, log = "notstream", None
+                        else:
+                            kind, log = self.replication.stream_read(qname)
+                            if kind == "notstream":
+                                with self.state_lock:
+                                    self._known_queues.add(qname)
                     else:
                         with self.state_lock:
                             if qname in self.streams:

@@ -931,7 +931,16 @@ class ReplicatedBackend:
         Returns ``("stream", log)``, ``("notstream", None)`` (the name is
         a classic queue / undeclared), or ``("noquorum", None)`` when the
         read cannot commit — the caller must surface *failure*, never a
-        stale local view."""
+        stale local view.
+
+        Cost trade-off, deliberately simple: each read appends one log
+        entry (no compaction; runs are minutes) and every replica
+        materializes the snapshot on apply even though only the
+        submitter's waiter consumes it.  A ReadIndex-style lease read
+        would avoid both at the price of leader-lease machinery; at
+        harness scale the log entry per *actual stream read* is cheap,
+        and the broker caches committed "notstream" answers so classic
+        queue consumes never pay it."""
         ok, result = self.raft.submit(
             {"k": "read_stream", "q": name},
             timeout_s=self.submit_timeout_s,

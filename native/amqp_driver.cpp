@@ -1601,28 +1601,41 @@ long drain_impl(Client* self, int32_t* out, long cap) {
   for (auto* c : clients) c->close_connection();
   std::this_thread::sleep_for(milliseconds(g_drain_wait_ms));
 
+  // Multi-pass: the close() above makes the broker requeue every
+  // un-acked delivery, but those requeues land asynchronously (on a
+  // replicated broker they are quorum commits) — a single pass that
+  // happens to observe get-empty before a late requeue would leave
+  // committed messages behind and read as loss.  Repeat until a FULL
+  // pass over every host drains nothing new (settle sleep between
+  // passes), bounded so a live publisher can't spin us forever.
   std::vector<int32_t> values;
-  for (const auto& host : hosts) {
-    auto hp = split_host_port(host, self->config().port);
-    Connection conn(hp.first, hp.second, self->config().user,
-                    self->config().pass);
-    if (!conn.open(5000)) {
-      logf("drain: cannot connect to %s", host.c_str());
-      continue;
-    }
-    std::vector<std::string> queues = {QUEUE_NAME};
-    if (dead_letter) queues.push_back(DLQ_NAME);
-    for (const auto& q : queues) {
-      while (true) {
-        int32_t value;
-        uint64_t tag;
-        int r = conn.basic_get(q, &value, &tag, 5000);
-        if (r != 1) break;
-        conn.basic_ack(tag);
-        values.push_back(value);
+  for (int pass = 0; pass < 4; ++pass) {
+    if (pass > 0)
+      std::this_thread::sleep_for(milliseconds(g_drain_wait_ms));
+    size_t before = values.size();
+    for (const auto& host : hosts) {
+      auto hp = split_host_port(host, self->config().port);
+      Connection conn(hp.first, hp.second, self->config().user,
+                      self->config().pass);
+      if (!conn.open(5000)) {
+        logf("drain: cannot connect to %s", host.c_str());
+        continue;
       }
+      std::vector<std::string> queues = {QUEUE_NAME};
+      if (dead_letter) queues.push_back(DLQ_NAME);
+      for (const auto& q : queues) {
+        while (true) {
+          int32_t value;
+          uint64_t tag;
+          int r = conn.basic_get(q, &value, &tag, 5000);
+          if (r != 1) break;
+          conn.basic_ack(tag);
+          values.push_back(value);
+        }
+      }
+      conn.close();
     }
-    conn.close();
+    if (pass > 0 && values.size() == before) break;  // quiet full pass
   }
   {
     std::lock_guard<std::mutex> lk(g_registry_mu);

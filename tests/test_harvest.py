@@ -183,6 +183,37 @@ class TestHarvestChild:
         assert bench.main(["--harvest-child", "--wait-pid", "12345"]) == 0
         assert waited == [12345] and ran == [1] and released == [1]
 
+    def test_plain_run_takes_and_releases_the_lock(self, monkeypatch):
+        """A direct `python bench.py` (the round driver) must not bench
+        beside a mid-flight harvest on the exclusive chip."""
+        bench = self._bench_mod()
+        ran = []
+        monkeypatch.setattr(bench, "_run_once", lambda: ran.append(1))
+        import jepsen_tpu.utils.harvest as hv
+
+        calls = []
+        monkeypatch.setattr(
+            hv, "_try_lock", lambda root: calls.append("lock") or True
+        )
+        monkeypatch.setattr(
+            hv, "release_lock", lambda root=None: calls.append("release")
+        )
+        assert bench.main([]) == 0
+        assert ran == [1] and calls == ["lock", "release"]
+
+    def test_locked_flag_skips_lock_handling(self, monkeypatch):
+        bench = self._bench_mod()
+        ran = []
+        monkeypatch.setattr(bench, "_run_once", lambda: ran.append(1))
+        import jepsen_tpu.utils.harvest as hv
+
+        def boom(root):
+            raise AssertionError("--locked must not touch the lock")
+
+        monkeypatch.setattr(hv, "_try_lock", boom)
+        assert bench.main(["--locked"]) == 0
+        assert ran == [1]
+
     def test_child_skips_bench_when_spawner_never_exits(
         self, tmp_path, monkeypatch
     ):

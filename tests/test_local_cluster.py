@@ -328,3 +328,47 @@ def test_full_stream_run_three_node_replicated(_reset):
         assert s["read-value-count"] > 0
     finally:
         t.close()
+
+
+def _three_node_run(workload, extra_opts=None, concurrency=3):
+    t = LocalProcTransport(n_nodes=3)
+    try:
+        nodes = t.nodes
+        opts = {
+            **DEFAULT_OPTS,
+            "rate": 80.0,
+            "time-limit": 4.0,
+            "time-before-partition": 1.0,
+            "partition-duration": 1.2,
+            "recovery-sleep": 1.0,
+            "publish-confirm-timeout": 2.5,
+            **(extra_opts or {}),
+        }
+        test = build_rabbitmq_test(
+            opts=opts, nodes=nodes, transport=t, db=_fast_db(t, nodes),
+            checker_backend="cpu", store_root=tempfile.mkdtemp(),
+            workload=workload, concurrency=concurrency,
+        )
+        return run_test(test).results
+    finally:
+        t.close()
+
+
+def test_full_elle_run_three_node_replicated(_reset):
+    """Elle list-append across a 3-node replicated cluster with a real
+    partition: txn appends quorum-commit atomically (TXN log entries),
+    per-key reads commit through the log — valid at the SUT's
+    contractual read-committed level."""
+    results = _three_node_run("elle")
+    assert results["valid?"] is True, results
+    assert results["elle"]["txn-count"] > 5
+    assert results["elle"]["consistency-model"] == "read-committed"
+
+
+def test_full_mutex_run_three_node_replicated(_reset):
+    """The mutex family (single-token quorum-queue lock) across a 3-node
+    replicated cluster with a real partition: grants/releases are
+    replicated queue ops through the leader."""
+    results = _three_node_run("mutex", {"rate": 40.0})
+    assert results["valid?"] is True, results
+    assert results["mutex"]["configs-explored"] > 0  # the search ran

@@ -286,17 +286,44 @@ def _stream_driver(native_lib, broker):
 def test_stream_append_on_one_node_read_from_lagging_other(
     native_lib, cluster
 ):
-    """Read-your-append across nodes: the read commits through the log,
-    so even a follower that has not applied the append yet returns it."""
+    """Read-your-append across nodes, with GENUINE lag induced: the
+    follower is made to refuse AppendEntries (its local replica provably
+    lacks the records) while its client-facing read still returns them,
+    because the read commits through the log at the leader.  A local-
+    snapshot regression fails this test deterministically."""
     a, b_node = cluster.leader(), cluster.followers()[0]
     wa = _stream_driver(native_lib, cluster.brokers[a])
     rb = _stream_driver(native_lib, cluster.brokers[b_node])
     wa.setup()
     rb.setup()
-    assert wa.append(7, 5.0) is True
-    assert wa.append(9, 5.0) is True
-    vals = [v for _off, v in rb.read_from(0, 100, 3.0)]
-    assert vals == [7, 9]
+
+    raft_b = cluster.brokers[b_node].replication.raft
+
+    def refuse(msg):
+        # stay a quiet follower (reset timers, keep the leader hint) but
+        # apply NOTHING — a lagging replica, not a partitioned one
+        with raft_b.lock:
+            raft_b._last_heartbeat = time.monotonic()
+            raft_b._election_deadline = raft_b._fresh_deadline()
+            raft_b.leader_hint = msg["from"]
+        return {"term": raft_b.term, "ok": False, "have": len(raft_b.log)}
+
+    raft_b.__dict__["_on_append_entries"] = refuse
+    try:
+        assert wa.append(7, 5.0) is True
+        assert wa.append(9, 5.0) is True
+        # the lag is real: b's local replica has neither record
+        assert (
+            cluster.brokers[b_node].replication.machine.stream_snapshot(
+                "jepsen.stream"
+            )
+            == []
+        )
+        vals = [v for _off, v in rb.read_from(0, 100, 3.0)]
+        assert vals == [7, 9]  # ...yet b's served read is complete
+    finally:
+        # drop the instance shadow; the class method resumes, b catches up
+        raft_b.__dict__.pop("_on_append_entries", None)
     wa.close()
     rb.close()
 

@@ -970,12 +970,14 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
     t.add_argument(
         "--seed-bug",
-        choices=("confirm-before-quorum",),
+        choices=("confirm-before-quorum", "drop-unacked-on-close"),
         default=None,
         help="(--db local) inject a replication bug into every broker "
         "node: confirm-before-quorum acknowledges publishes on leader-"
-        "local append, so a partition+heal truncates confirmed writes — "
-        "the checker must go red (lost)",
+        "local append (a partition+heal truncates confirmed writes); "
+        "drop-unacked-on-close discards a dying connection's un-acked "
+        "deliveries instead of requeueing them (the delivery plane's "
+        "loss mode) — either way the checker must go red (lost)",
     )
     # the reference's cli-opts (rabbitmq.clj:288-327)
     t.add_argument(

@@ -227,6 +227,8 @@ class MiniAmqpBroker:
         return self
 
     def _requeue_own_ghosts(self) -> None:
+        if self.replication.raft.seed_bug == "drop-unacked-on-close":
+            return  # seeded: the requeue machinery is broken everywhere
         name = self.replication.raft.name
         for _ in range(10):
             if not self._running:
@@ -614,11 +616,19 @@ class MiniAmqpBroker:
                     conn.unacked.clear()
                     if conn in self._conns:
                         self._conns.remove(conn)
-                if self._running:
+                if (
+                    self._running
+                    and self.replication.raft.seed_bug
+                    != "drop-unacked-on-close"
+                ):
                     # unconditional: a deq can commit cluster-wide while
                     # the local submit timed out (nothing in conn.unacked
                     # to witness it) — only the replicated inflight map
-                    # knows, so always sweep this owner
+                    # knows, so always sweep this owner.  (The seeded
+                    # drop-unacked-on-close bug SKIPS this sweep: the
+                    # delivered-but-unacked messages strand in inflight —
+                    # the delivery plane's loss mode, which the drain +
+                    # total-queue must catch.)
                     self.replication.requeue_owner(conn.owner)
             else:
                 with self.state_lock:

@@ -42,12 +42,19 @@ Replicated ops and the queue state machine live in
 blocks until commit (or times out → no publisher confirm → the client
 records an indeterminate op, which is always safe).
 
-Seeded bug (``seed_bug="confirm-before-quorum"``): the leader reports an
-ENQ as successful immediately after *local* append, before any replica
-has it.  A partition that isolates that leader then heals makes the new
-leader truncate the unreplicated entries: confirmed writes vanish, and
-``total-queue`` must flag them as lost end-to-end (the red-run proof the
-replication mode is actually exercised).
+Seeded bugs (the red-run proofs that the replication mode is actually
+exercised):
+
+- ``confirm-before-quorum`` — the leader reports an ENQ as successful
+  immediately after *local* append, before any replica has it.  A
+  partition that isolates that leader then heals makes the new leader
+  truncate the unreplicated entries: confirmed writes vanish, and
+  ``total-queue`` must flag them as lost end-to-end.
+- ``drop-unacked-on-close`` — enforced by the broker, not this module
+  (``harness/broker.py``): a dying connection's un-acked deliveries are
+  *discarded* instead of requeued, so messages delivered-but-unacked at
+  drain time vanish from the replicated inflight map's reachable set —
+  the delivery/requeue plane's loss mode, also flagged by total-queue.
 """
 
 from __future__ import annotations
@@ -746,6 +753,11 @@ class RaftNode:
                 for p in self.others
                 if now - self.last_peer_ok.get(p, now) > self.dead_owner_s
             ]
+        if self.seed_bug == "drop-unacked-on-close":
+            # the seeded fault is "the requeue machinery is broken":
+            # every resurrection path stays off, or a later reap would
+            # quietly heal the injected loss before the checker sees it
+            return
         for node in dead:
             if now - self._requeued_dead.get(node, 0) < self.dead_owner_s:
                 continue

@@ -181,7 +181,7 @@ def test_majority_side_survives_and_heals(native_lib, cluster):
     # the healed ex-leader catches up and can serve the committed value
     d2 = _driver(native_lib, cluster.brokers[lead])
     d2.setup()
-    deadline = time.monotonic() + 5.0
+    deadline = time.monotonic() + 12.0
     got = None
     while time.monotonic() < deadline and got is None:
         try:
@@ -347,3 +347,55 @@ def test_minority_stream_read_fails_rather_than_stale(native_lib, cluster):
     with pytest.raises(ConnectionError):
         d.read_from(0, 100, 4.0)
     d.close()
+
+
+def test_seeded_drop_unacked_on_close_loses_delivered_message(native_lib):
+    """Second seeded bug class (the delivery/requeue plane): with
+    drop-unacked-on-close, a dying connection's un-acked QoS-1 delivery
+    is stranded instead of requeued — the drain provably misses it.
+    Deterministic at the AMQP level: consume one message (the broker
+    pushes the NEXT one un-acked), close, drain."""
+    c = _Cluster(seed_bug="drop-unacked-on-close")
+    try:
+        lead = c.leader()
+        b = c.brokers[lead]
+        pub = _driver(native_lib, b)
+        pub.setup()
+        for v in (1, 2, 3):
+            assert pub.enqueue(v, 5.0)
+        cons = _driver(native_lib, b, consumer_type="asynchronous")
+        cons.setup()
+        assert cons.dequeue(5.0) == 1  # ack of 1 → broker pushes 2 un-acked
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            with b.replication.machine.lock:
+                if b.replication.machine.inflight:
+                    break
+            time.sleep(0.05)
+        with b.replication.machine.lock:
+            assert b.replication.machine.inflight, "no un-acked push"
+        cons.close()  # THE BUG: the un-acked delivery is not requeued
+        time.sleep(0.8)
+        drained = pub.drain()
+        assert 3 in drained and 2 not in drained  # 2 is lost
+        with b.replication.machine.lock:
+            assert b.replication.machine.inflight  # stranded forever
+    finally:
+        c.stop()
+
+
+def test_unacked_on_close_requeues_without_the_bug(native_lib, cluster):
+    """The green twin: a correct cluster requeues the dying connection's
+    un-acked delivery and the drain recovers every message."""
+    lead = cluster.leader()
+    b = cluster.brokers[lead]
+    pub = _driver(native_lib, b)
+    pub.setup()
+    for v in (1, 2, 3):
+        assert pub.enqueue(v, 5.0)
+    cons = _driver(native_lib, b, consumer_type="asynchronous")
+    cons.setup()
+    assert cons.dequeue(5.0) == 1
+    cons.close()
+    drained = pub.drain()
+    assert sorted(drained) == [2, 3]

@@ -251,7 +251,30 @@ def cmd_bench_check(args) -> int:
             workers = avail if avail > 1 else 0
     mats = None  # pre-exploded row matrices from parallel pack workers
     t_produce = None  # worker phase wall clock (reported as produce_s)
-    if workers and workload in ("auto", "queue") and not args.histories:
+    packed_pre = None  # store-level packed cache hit (no assembly at all)
+    store_cache_dst = None  # (root, paths) to save after a fresh pack
+    pre_paths = None  # one store walk, reused by every branch below
+    if args.histories and workload in ("auto", "queue"):
+        # store-level packed cache: one file holding the ASSEMBLED
+        # columns for the exact (stat-stamped) file set — a hit skips
+        # per-file cache reads AND assembly (history/storecache.py)
+        from jepsen_tpu.history.storecache import load_packed_store_cache
+
+        pre_paths = _history_paths(args.histories)
+        if pre_paths:
+            t0 = time.perf_counter()
+            packed_pre = load_packed_store_cache(args.histories, pre_paths)
+            if packed_pre is not None:
+                workload = "queue"
+                print(
+                    f"# store cache hit: {packed_pre.batch} packed "
+                    f"histories in {time.perf_counter() - t0:.2f}s "
+                    f"(no per-file reads, no assembly)",
+                    file=sys.stderr,
+                )
+    if packed_pre is not None:
+        pass  # nothing to produce
+    elif workers and workload in ("auto", "queue") and not args.histories:
         workload = "queue"  # the synthetic default family
         # parallel host packing (the north-star wall clock): workers
         # synthesize their seed ranges and explode rows; only compact
@@ -272,7 +295,11 @@ def cmd_bench_check(args) -> int:
     elif workers and args.histories and workload in ("auto", "queue"):
         from jepsen_tpu.history.parpack import read_rows_parallel
 
-        paths = _history_paths(args.histories)
+        paths = (
+            pre_paths
+            if pre_paths is not None
+            else _history_paths(args.histories)
+        )
         if not paths:
             print(f"no histories under {args.histories}", file=sys.stderr)
             return 2
@@ -291,6 +318,11 @@ def cmd_bench_check(args) -> int:
             mats = _select_family(tagged, workload, args.histories)
             if mats is None:
                 return 2
+            if len(mats) == len(paths):
+                # same pure-queue condition as the serial path: a first
+                # check with --workers must also leave the store-level
+                # packed cache behind
+                store_cache_dst = (args.histories, paths)
             print(
                 f"# {workers} workers read+exploded {len(tagged)} stored "
                 f"histories in {t_produce:.1f}s",
@@ -308,12 +340,16 @@ def cmd_bench_check(args) -> int:
             f"{workload} serially",
             file=sys.stderr,
         )
-    if mats is not None:
+    if mats is not None or packed_pre is not None:
         pass  # skip serial production entirely
     elif args.histories:
         from jepsen_tpu.history.rows import load_rows_cache, rows_with_cache
 
-        paths = _history_paths(args.histories)
+        paths = (
+            pre_paths
+            if pre_paths is not None
+            else _history_paths(args.histories)
+        )
         if not paths:
             print(f"no histories under {args.histories}", file=sys.stderr)
             return 2
@@ -358,6 +394,12 @@ def cmd_bench_check(args) -> int:
             mats = _select_family(tagged, workload, args.histories)
             if mats is None:
                 return 2
+            if len(mats) == len(paths):
+                # pure-queue store: leave the assembled columns behind so
+                # the next re-check skips per-file reads and assembly
+                # (a mixed store stays per-file — a cached pack of a
+                # subset would be ambiguous under --workload auto)
+                store_cache_dst = (args.histories, paths)
         else:
             # non-queue families pack from Op lists, not row matrices
             pairs = [
@@ -502,12 +544,21 @@ def cmd_bench_check(args) -> int:
         n_invalid = int((~np.asarray(el.valid)).sum())
     else:
         t0 = time.perf_counter()
-        packed = (
-            pack_row_matrices(mats)
-            if mats is not None
-            else pack_histories(histories)
-        )
+        if packed_pre is not None:
+            packed = packed_pre
+        else:
+            packed = (
+                pack_row_matrices(mats)
+                if mats is not None
+                else pack_histories(histories)
+            )
         t_pack = time.perf_counter() - t0
+        if packed_pre is None and store_cache_dst is not None:
+            from jepsen_tpu.history.storecache import (
+                save_packed_store_cache,
+            )
+
+            save_packed_store_cache(*store_cache_dst, packed)
 
         jax.block_until_ready(
             (total_queue_tensor_check(packed), queue_lin_tensor_check(packed))
@@ -529,7 +580,11 @@ def cmd_bench_check(args) -> int:
         if workload in ("elle", "mutex")
         else packed.length
     )
-    n_hist = len(mats) if mats is not None else len(histories)
+    n_hist = (
+        packed.batch
+        if packed_pre is not None
+        else len(mats) if mats is not None else len(histories)
+    )
     stats_extra = {}
     if workload == "mutex":
         # tri-state honesty: a frontier overflow is undecided, which is

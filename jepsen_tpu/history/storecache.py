@@ -1,0 +1,116 @@
+"""Store-level packed-tensor cache: one file per store, zero re-assembly.
+
+The per-run ``rows.npz`` cache (``history/rows.py``) removed row
+explosion from re-checks; what remains of a 10k-history re-check is
+10k small npz opens (~4 s) plus the column assembly (~0.6 s).  Both are
+pure functions of the history set, so the ASSEMBLED ``PackedHistories``
+columns are persisted once per store root as ``packed_store.npz`` —
+a re-check then loads nine arrays from one file and goes straight to
+the device.
+
+Freshness: the cache stamps every member ``(relpath, size, mtime_ns)``;
+a load stats the same files (cheap — no reads) and rejects the cache on
+any difference, including additions, removals, and reordering — AND
+requires the cache file to be strictly newer than every member, so a
+member rewritten in the same mtime tick as its stamp can never be
+served stale (the same guard ``rows.py`` uses; unlike that layer there
+is no content-hash fallback here — a rejected store cache simply falls
+through to the per-file layer, which has one).  Writes are atomic
+(tmp + rename) and best-effort — this is an optimization layer over
+the per-run caches, never a source of truth.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+STORE_CACHE = "packed_store.npz"
+
+#: array-field names of PackedHistories, in constructor order
+_FIELDS = (
+    "index",
+    "process",
+    "type",
+    "f",
+    "value",
+    "time_ms",
+    "latency_ms",
+    "mask",
+    "first",
+)
+
+
+def _fingerprint(paths: Sequence[str | Path], root: Path) -> np.ndarray:
+    rows = []
+    for p in paths:
+        p = Path(p)
+        st = os.stat(p)
+        try:
+            rel = str(p.resolve().relative_to(root.resolve()))
+        except ValueError:
+            rel = str(p.resolve())
+        rows.append(f"{rel}\x00{st.st_size}\x00{st.st_mtime_ns}")
+    return np.array(rows)
+
+
+def save_packed_store_cache(
+    store_root: str | Path, paths: Sequence[str | Path], packed
+) -> None:
+    """Persist the assembled columns for this exact file set."""
+    root = Path(store_root)
+    target = root / STORE_CACHE
+    tmp = root / f"{STORE_CACHE}.{os.getpid()}.tmp"
+    try:
+        arrays = {
+            name: np.asarray(getattr(packed, name)) for name in _FIELDS
+        }
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                fingerprint=_fingerprint(paths, root),
+                value_space=np.int64(packed.value_space),
+                **arrays,
+            )
+        os.replace(tmp, target)
+    except (OSError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_packed_store_cache(
+    store_root: str | Path, paths: Sequence[str | Path]
+):
+    """The cached :class:`PackedHistories` when fresh for exactly this
+    file set (order included), else None."""
+    from jepsen_tpu.history.encode import PackedHistories
+
+    root = Path(store_root)
+    target = root / STORE_CACHE
+    try:
+        cache_mtime = os.stat(target).st_mtime_ns
+        for p in paths:
+            if os.stat(p).st_mtime_ns >= cache_mtime:
+                return None  # member as-new-as cache: possible same-tick
+        with np.load(target, allow_pickle=False) as z:
+            stamp = z["fingerprint"]
+            current = _fingerprint(paths, root)
+            if stamp.shape != current.shape or not (
+                stamp == current
+            ).all():
+                return None
+            import jax.numpy as jnp
+
+            cols = {
+                name: jnp.asarray(z[name]) for name in _FIELDS
+            }
+            return PackedHistories(
+                **cols, value_space=int(z["value_space"])
+            )
+    except (OSError, ValueError, KeyError):
+        return None

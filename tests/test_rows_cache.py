@@ -103,6 +103,11 @@ def test_cli_bench_check_uses_cache(tmp_path, capsys):
     args = ["bench-check", "--histories", str(tmp_path / "s")]
     assert main(args) == 0
     first = capsys.readouterr()
+    # drop the store-level cache so this exercises the PER-FILE layer
+    # (TestStoreCache covers the store-level hit separately)
+    from jepsen_tpu.history.storecache import STORE_CACHE
+
+    (tmp_path / "s" / STORE_CACHE).unlink()
     assert main(args) == 0
     second = capsys.readouterr()
     assert "(3 from the packed-row cache)" in second.err
@@ -114,3 +119,112 @@ def test_cli_bench_check_uses_cache(tmp_path, capsys):
     assert (v1["invalid"], v1["histories"]) == (
         v2["invalid"], v2["histories"],
     )
+
+
+# ---------------------------------------------------------------------------
+# Store-level packed cache (history/storecache.py)
+# ---------------------------------------------------------------------------
+
+
+class TestStoreCache:
+    def _mk_store(self, tmp_path, n=3):
+        from jepsen_tpu.cli.main import main
+
+        assert main(
+            ["synth", "--count", str(n), "--ops", "40", "--lost", "1",
+             "--store", str(tmp_path / "s")]
+        ) == 0
+        import glob
+
+        return str(tmp_path / "s"), sorted(
+            glob.glob(str(tmp_path / "s" / "synth" / "*" / "history.jsonl"))
+        )
+
+    def test_roundtrip_identical_columns(self, tmp_path):
+        import jax.numpy as jnp
+
+        from jepsen_tpu.history.encode import pack_histories
+        from jepsen_tpu.history.storecache import (
+            load_packed_store_cache,
+            save_packed_store_cache,
+        )
+        from jepsen_tpu.history.store import read_history
+
+        root, paths = self._mk_store(tmp_path)
+        packed = pack_histories([read_history(p) for p in paths])
+        save_packed_store_cache(root, paths, packed)
+        got = load_packed_store_cache(root, paths)
+        assert got is not None
+        assert got.value_space == packed.value_space
+        for name in ("index", "process", "type", "f", "value", "mask"):
+            assert bool(
+                jnp.array_equal(getattr(got, name), getattr(packed, name))
+            ), name
+
+    def test_stale_on_any_member_change(self, tmp_path):
+        from jepsen_tpu.history.encode import pack_histories
+        from jepsen_tpu.history.storecache import (
+            load_packed_store_cache,
+            save_packed_store_cache,
+        )
+        from jepsen_tpu.history.store import read_history, write_history_jsonl
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+        root, paths = self._mk_store(tmp_path)
+        packed = pack_histories([read_history(p) for p in paths])
+        save_packed_store_cache(root, paths, packed)
+        assert load_packed_store_cache(root, paths) is not None
+        # rewrite one member → reject
+        write_history_jsonl(
+            paths[1], synth_batch(1, SynthSpec(n_ops=44, seed=7))[0].ops
+        )
+        assert load_packed_store_cache(root, paths) is None
+        # different member set (drop one) → reject
+        assert load_packed_store_cache(root, paths[:-1]) is None
+
+    def test_missing_or_corrupt_is_none(self, tmp_path):
+        from jepsen_tpu.history.storecache import (
+            STORE_CACHE,
+            load_packed_store_cache,
+        )
+
+        root, paths = self._mk_store(tmp_path)
+        assert load_packed_store_cache(root, paths) is None
+        (tmp_path / "s" / STORE_CACHE).write_bytes(b"junk")
+        assert load_packed_store_cache(root, paths) is None
+
+    def test_cli_second_run_hits_and_verdict_matches(self, tmp_path, capsys):
+        import json
+
+        from jepsen_tpu.cli.main import main
+
+        root, _paths = self._mk_store(tmp_path)
+        args = ["bench-check", "--histories", root]
+        assert main(args) == 0
+        first = capsys.readouterr()
+        assert main(args) == 0
+        second = capsys.readouterr()
+        assert "store cache hit" in second.err
+        v1 = json.loads(first.out.strip().splitlines()[-1])
+        v2 = json.loads(second.out.strip().splitlines()[-1])
+        assert (v1["invalid"], v1["histories"]) == (
+            v2["invalid"], v2["histories"],
+        )
+
+    def test_mixed_store_is_not_cached(self, tmp_path, capsys):
+        from jepsen_tpu.cli.main import main
+        from jepsen_tpu.history.storecache import STORE_CACHE
+
+        root, _paths = self._mk_store(tmp_path)
+        assert main(
+            ["synth", "--workload", "stream", "--count", "2", "--ops",
+             "40", "--store", root]
+        ) == 0
+        capsys.readouterr()
+        args = ["bench-check", "--histories", root, "--workload", "queue"]
+        assert main(args) == 0
+        # a subset pack must not be cached: ambiguous under auto
+        assert not (tmp_path / "s" / STORE_CACHE).exists()
+        assert main(args) == 0
+        second = capsys.readouterr()
+        assert "store cache hit" not in second.err

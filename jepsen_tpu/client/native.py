@@ -38,25 +38,11 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
     build_err = ""
     if not p.exists() and path is None:
         # the shared object is a build product, not a committed artifact —
-        # build it on first use (~3 s), serialized across processes so
-        # concurrent first loads cannot dlopen a half-written file
-        import fcntl
-        import subprocess
+        # build it on first use (~3 s; utils/nativebuild.py owns the
+        # cross-process serialization protocol)
+        from jepsen_tpu.utils.nativebuild import ensure_built
 
-        with open(p.parent / ".build.lock", "w") as lk:
-            fcntl.flock(lk, fcntl.LOCK_EX)
-            if not p.exists():
-                try:
-                    r = subprocess.run(
-                        ["make", "-C", str(p.parent)],
-                        capture_output=True,
-                        text=True,
-                        timeout=120,
-                    )
-                    if r.returncode != 0:
-                        build_err = (r.stderr or r.stdout)[-500:]
-                except (subprocess.TimeoutExpired, OSError) as e:
-                    build_err = str(e)
+        build_err = ensure_built(p, target=p.name)
     if not p.exists():
         detail = f": {build_err}" if build_err else ""
         raise FileNotFoundError(

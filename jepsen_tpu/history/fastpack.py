@@ -1,0 +1,102 @@
+"""Native fast path for history packing: JSONL -> [n, 8] int32 rows.
+
+Binding for ``native/rows_packer.cpp`` (built on first use like the AMQP
+driver), which fuses the JSONL parse, the workload classification, and
+the row explosion of ``rows._rows_for`` into one C++ streaming pass.
+JSON parsing is the 1-core bottleneck of the batched-replay north star's
+fresh-pack phase (~95% of wall clock before caching); the native packer
+reads the same bytes at native speed with bit-identical output
+(differential contract in ``tests/test_fastpack.py``).
+
+Strictly an accelerator: :func:`pack_file` returns None whenever the
+library is unavailable or the file contains anything the C parser flags
+(malformed JSON, unknown enum names, out-of-range values) — callers then
+fall back to the Python packer, which raises the canonical error.  The
+Python path stays the single source of truth for all error behavior.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+_LIB_PATH = (
+    Path(__file__).resolve().parent.parent.parent
+    / "native"
+    / "librows_packer.so"
+)
+
+#: workload codes of the C ABI, in order
+_WORKLOADS = ("queue", "stream", "elle", "mutex")
+
+_lib = None
+_lib_failed = False
+
+
+class _JtPackResult(ctypes.Structure):
+    _fields_ = [
+        ("rows", ctypes.POINTER(ctypes.c_int32)),
+        ("n_rows", ctypes.c_int64),
+        ("workload", ctypes.c_int32),
+        ("err", ctypes.c_int32),
+        ("err_line", ctypes.c_int64),
+    ]
+
+
+def _load() -> ctypes.CDLL | None:
+    """The packer library, building it on first use; None (sticky) when
+    it cannot be built/loaded — packing then stays pure-Python."""
+    global _lib, _lib_failed
+    if _lib is not None:
+        return _lib
+    if _lib_failed:
+        return None
+    p = _LIB_PATH
+    from jepsen_tpu.utils.nativebuild import ensure_built
+
+    ensure_built(p, target=p.name)  # error text irrelevant: pure fallback
+    try:
+        lib = ctypes.CDLL(str(p))
+    except OSError:
+        _lib_failed = True
+        return None
+    lib.jt_pack_file.restype = ctypes.POINTER(_JtPackResult)
+    lib.jt_pack_file.argtypes = [ctypes.c_char_p]
+    lib.jt_pack_free.restype = None
+    lib.jt_pack_free.argtypes = [ctypes.POINTER(_JtPackResult)]
+    _lib = lib
+    return lib
+
+
+def pack_file(jsonl_path: str | Path) -> tuple[str, np.ndarray] | None:
+    """``(workload, rows)`` for a JSONL history via the native packer,
+    or None when the fast path doesn't apply (no library, ``.edn``
+    input, or anything the C parser flags) — the caller falls back to
+    the Python packer and its canonical error messages."""
+    import os
+
+    if os.environ.get("JEPSEN_TPU_NO_FASTPACK"):
+        return None  # measurement/debug escape hatch: pure-Python packing
+    p = Path(jsonl_path)
+    if p.suffix == ".edn":
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    res = lib.jt_pack_file(str(p).encode())
+    if not res:
+        return None
+    try:
+        r = res.contents
+        if r.err != 0:
+            return None
+        n = int(r.n_rows)
+        if n == 0:
+            rows = np.zeros((0, 8), np.int32)
+        else:
+            rows = np.ctypeslib.as_array(r.rows, shape=(n, 8)).copy()
+        return _WORKLOADS[r.workload], rows
+    finally:
+        lib.jt_pack_free(res)

@@ -263,6 +263,17 @@ def rows_with_cache(
     cached = load_rows_cache(jsonl_path)
     if cached is not None:
         return (*cached, True)
+    if history is None:
+        # native fast path: parse+classify+explode in one C++ pass
+        # (history/fastpack.py); None falls through to the Python packer,
+        # which owns all error behavior
+        from jepsen_tpu.history.fastpack import pack_file
+
+        fast = pack_file(jsonl_path)
+        if fast is not None:
+            workload, rows = fast
+            save_rows_cache(jsonl_path, workload, rows)
+            return workload, rows, False
     from jepsen_tpu.history.ops import workload_of
     from jepsen_tpu.history.store import read_history
 

@@ -1,0 +1,49 @@
+"""Build-on-first-use for the native shared libraries.
+
+The ``.so`` files under ``native/`` are build products, not committed
+artifacts; each ctypes binding builds its own on first load.  The
+protocol lives here once so the AMQP driver and the rows packer cannot
+drift: the build is serialized across processes with an exclusive flock
+on ``.build.lock`` (concurrent first loads must not ``dlopen`` a
+half-written file — make writes the output atomically enough only
+because the lock makes the race impossible), re-checked under the lock,
+and bounded by a timeout.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+
+def ensure_built(
+    lib_path: Path, target: str | None = None, timeout: float = 120.0
+) -> str:
+    """Build ``lib_path`` via ``make -C <dir> [target]`` if absent.
+
+    Returns an empty string on success (or when the file already
+    exists), else a short build-error description.  Never raises."""
+    p = Path(lib_path)
+    if p.exists():
+        return ""
+    import fcntl
+    import subprocess
+
+    try:
+        with open(p.parent / ".build.lock", "w") as lk:
+            fcntl.flock(lk, fcntl.LOCK_EX)
+            if p.exists():  # a peer built it while we waited
+                return ""
+            cmd = ["make", "-C", str(p.parent)]
+            if target:
+                cmd.append(target)
+            try:
+                r = subprocess.run(
+                    cmd, capture_output=True, text=True, timeout=timeout
+                )
+                if r.returncode != 0:
+                    return (r.stderr or r.stdout)[-500:]
+            except (subprocess.TimeoutExpired, OSError) as e:
+                return str(e)
+    except OSError as e:
+        return str(e)
+    return "" if p.exists() else "build produced no output"

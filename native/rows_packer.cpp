@@ -1,0 +1,613 @@
+// Native history packer: history.jsonl -> [n, 8] int32 row matrix.
+//
+// C++ twin of jepsen_tpu/history/rows.py::_rows_for composed with the
+// JSONL reader (store.py::read_history_jsonl) and the workload
+// classifier (ops.py::workload_of), fused into one streaming pass so a
+// fresh pack never materializes Python op objects at all.  The hot cost
+// of the batched-replay north star's fresh path is JSON parsing on the
+// host (the reference's analogue is jepsen's EDN history read before
+// checker/check runs); this parser reads ~2 GB of JSONL at native
+// speed where Python's json module is the 1-core bottleneck.
+//
+// Semantics contract (differential-tested in tests/test_fastpack.py
+// against the Python packer on every workload family):
+//   - row schema: index, process, type, f, value, time_ms, latency_ms,
+//     first  (int32 each)
+//   - completion latency: against the immediately preceding op of the
+//     same process iff that op is an INVOKE and both timestamps are
+//     valid; floor division to ms (matches numpy int64 //)
+//   - value explosion: scalar int -> one row; bool -> 1/0; null/absent/
+//     float/string/object -> NO_VALUE; list -> one row per element
+//     (elements: int or bool kept, anything else NO_VALUE); empty
+//     list -> a single NO_VALUE row; `first` flags the first row of
+//     each op, and latency_ms is -1 on non-first rows
+//   - any value or time_ms outside int32 -> OVERFLOW error (the Python
+//     packer raises OverflowError; the binding falls back so the
+//     Python error path stays the single source of truth)
+//   - any parse irregularity (unknown type/f string, non-object line,
+//     malformed JSON, non-int process) -> PARSE error; the binding
+//     falls back to the Python packer, which raises its own exception
+//   - workload: first op whose f is append/read -> stream, txn ->
+//     elle, acquire/release -> mutex; else queue
+//
+// Reference tie-in (same as rows.py): the op schema mirrors jepsen op
+// maps (rabbitmq.clj:191-215,245-248); dense-int values are what make
+// histories tensorizable (Utils.java:443,496,532,584).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int32_t NO_VALUE = -1;
+
+// OpType / OpF integer codes (ops.py enums)
+constexpr int T_INVOKE = 0;
+
+enum Err : int32_t { OK = 0, ERR_IO = 1, ERR_PARSE = 2, ERR_OVERFLOW = 3 };
+
+enum class VKind { NONE, INT, OTHER, LIST };
+
+struct JVal {
+  VKind kind = VKind::NONE;
+  long long i = 0;
+  // list elements: (is_int, value) pairs; non-int elements carry NO_VALUE
+  std::vector<long long> elems;
+  std::vector<uint8_t> elem_is_int;
+};
+
+struct Cursor {
+  const char* p;
+  const char* end;
+  bool fail = false;
+  bool overflow = false;
+};
+
+inline void skip_ws(Cursor& c) {
+  while (c.p < c.end &&
+         (*c.p == ' ' || *c.p == '\t' || *c.p == '\r' || *c.p == '\n'))
+    ++c.p;
+}
+
+inline bool is_hex(char ch) {
+  return (ch >= '0' && ch <= '9') || (ch >= 'a' && ch <= 'f') ||
+         (ch >= 'A' && ch <= 'F');
+}
+
+// Scan a JSON string (cursor on the opening quote); returns the raw
+// (still-escaped) span in [*s, *e) excluding quotes.  Validates what
+// Python's json module validates — legal escapes only, no raw control
+// characters — so a file the canonical parser rejects is never
+// silently accepted here.
+bool scan_string(Cursor& c, const char** s, const char** e) {
+  if (c.p >= c.end || *c.p != '"') return false;
+  ++c.p;
+  *s = c.p;
+  while (c.p < c.end) {
+    char ch = *c.p;
+    if (ch == '\\') {
+      if (c.p + 1 >= c.end) return false;
+      char esc = c.p[1];
+      if (esc == 'u') {
+        if (c.p + 5 >= c.end || !is_hex(c.p[2]) || !is_hex(c.p[3]) ||
+            !is_hex(c.p[4]) || !is_hex(c.p[5]))
+          return false;
+        c.p += 6;
+        continue;
+      }
+      if (esc != '"' && esc != '\\' && esc != '/' && esc != 'b' &&
+          esc != 'f' && esc != 'n' && esc != 'r' && esc != 't')
+        return false;
+      c.p += 2;
+      continue;
+    }
+    if (ch == '"') {
+      *e = c.p;
+      ++c.p;
+      return true;
+    }
+    if (static_cast<unsigned char>(ch) < 0x20) return false;  // raw control
+    ++c.p;
+  }
+  return false;
+}
+
+// Parse a number with the exact JSON grammar (RFC 8259: '-'? int frac?
+// exp?, no leading zeros, no leading '+') — anything the canonical
+// Python parser rejects must set c.fail so the binding falls back.
+// int_ok=false when it is a float (or out of int64 range -> overflow).
+long long scan_number(Cursor& c, bool* int_ok) {
+  const char* start = c.p;
+  *int_ok = false;
+  if (c.p < c.end && *c.p == '-') ++c.p;
+  // int part: '0' | [1-9][0-9]*
+  if (c.p >= c.end || *c.p < '0' || *c.p > '9') {
+    c.fail = true;
+    return 0;
+  }
+  if (*c.p == '0') {
+    ++c.p;
+    if (c.p < c.end && *c.p >= '0' && *c.p <= '9') {
+      c.fail = true;  // leading zero: json.loads rejects "01"
+      return 0;
+    }
+  } else {
+    while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+  }
+  const char* int_end = c.p;
+  bool is_float = false;
+  if (c.p < c.end && *c.p == '.') {
+    is_float = true;
+    ++c.p;
+    if (c.p >= c.end || *c.p < '0' || *c.p > '9') {
+      c.fail = true;  // "1." is not JSON
+      return 0;
+    }
+    while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+  }
+  if (c.p < c.end && (*c.p == 'e' || *c.p == 'E')) {
+    is_float = true;
+    ++c.p;
+    if (c.p < c.end && (*c.p == '-' || *c.p == '+')) ++c.p;
+    if (c.p >= c.end || *c.p < '0' || *c.p > '9') {
+      c.fail = true;  // "1e" / "1e+" are not JSON
+      return 0;
+    }
+    while (c.p < c.end && *c.p >= '0' && *c.p <= '9') ++c.p;
+  }
+  if (is_float) return 0;
+  errno = 0;
+  char* endp = nullptr;
+  std::string tmp(start, int_end - start);  // bounded copy for strtoll
+  long long v = std::strtoll(tmp.c_str(), &endp, 10);
+  if (errno == ERANGE) {
+    c.overflow = true;  // int beyond int64: Python raises OverflowError
+    return 0;           // at np.asarray — binding falls back to raise
+  }
+  if (endp == nullptr || *endp != '\0') {
+    c.fail = true;
+    return 0;
+  }
+  *int_ok = true;
+  return v;
+}
+
+void skip_value(Cursor& c);
+
+// Parse (and discard) a JSON object with full structural validation —
+// a malformed nested object must fall back to the canonical parser,
+// never be skipped over.
+void parse_object(Cursor& c) {
+  ++c.p;  // cursor was on '{'
+  skip_ws(c);
+  if (c.p < c.end && *c.p == '}') {
+    ++c.p;
+    return;
+  }
+  while (c.p < c.end && !c.fail) {
+    skip_ws(c);
+    const char *ks, *ke;
+    if (!scan_string(c, &ks, &ke)) {
+      c.fail = true;
+      return;
+    }
+    skip_ws(c);
+    if (c.p >= c.end || *c.p != ':') {
+      c.fail = true;
+      return;
+    }
+    ++c.p;
+    skip_value(c);
+    if (c.fail) return;
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ',') {
+      ++c.p;
+      continue;
+    }
+    if (c.p < c.end && *c.p == '}') {
+      ++c.p;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  c.fail = true;
+}
+
+// Parse one JSON value into a JVal (only as much structure as the
+// packer needs: scalar int/bool vs list-of-scalars vs everything-else).
+void parse_value(Cursor& c, JVal& out) {
+  skip_ws(c);
+  if (c.p >= c.end) {
+    c.fail = true;
+    return;
+  }
+  char ch = *c.p;
+  if (ch == 'n') {  // null
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) {
+      c.p += 4;
+      out.kind = VKind::NONE;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == 't') {
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) {
+      c.p += 4;
+      out.kind = VKind::INT;  // isinstance(True, int) in the Python twin
+      out.i = 1;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == 'f') {
+    if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) {
+      c.p += 5;
+      out.kind = VKind::INT;
+      out.i = 0;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == '"') {
+    const char *s, *e;
+    if (!scan_string(c, &s, &e)) {
+      c.fail = true;
+      return;
+    }
+    out.kind = VKind::OTHER;
+    return;
+  }
+  if (ch == '{') {
+    parse_object(c);
+    out.kind = VKind::OTHER;
+    return;
+  }
+  if (ch == '[') {
+    ++c.p;
+    out.kind = VKind::LIST;
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ']') {
+      ++c.p;
+      return;  // empty list
+    }
+    while (c.p < c.end && !c.fail) {
+      JVal elem;
+      parse_value(c, elem);
+      if (c.fail) return;
+      if (elem.kind == VKind::INT) {
+        out.elems.push_back(elem.i);
+        out.elem_is_int.push_back(1);
+      } else {
+        out.elems.push_back(NO_VALUE);
+        out.elem_is_int.push_back(0);
+      }
+      skip_ws(c);
+      if (c.p < c.end && *c.p == ',') {
+        ++c.p;
+        continue;
+      }
+      if (c.p < c.end && *c.p == ']') {
+        ++c.p;
+        return;
+      }
+      c.fail = true;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  // number
+  bool int_ok = false;
+  long long v = scan_number(c, &int_ok);
+  if (c.fail || c.overflow) return;
+  if (int_ok) {
+    out.kind = VKind::INT;
+    out.i = v;
+  } else {
+    out.kind = VKind::OTHER;  // float: not isinstance(v, int) -> NO_VALUE
+  }
+}
+
+void skip_value(Cursor& c) {
+  JVal scratch;
+  parse_value(c, scratch);
+}
+
+int type_code(const char* s, size_t n) {
+  if (n == 6 && std::memcmp(s, "invoke", 6) == 0) return 0;
+  if (n == 2 && std::memcmp(s, "ok", 2) == 0) return 1;
+  if (n == 4 && std::memcmp(s, "fail", 4) == 0) return 2;
+  if (n == 4 && std::memcmp(s, "info", 4) == 0) return 3;
+  return -1;
+}
+
+int f_code(const char* s, size_t n) {
+  switch (n) {
+    case 7:
+      if (std::memcmp(s, "enqueue", 7) == 0) return 0;
+      if (std::memcmp(s, "dequeue", 7) == 0) return 1;
+      if (std::memcmp(s, "acquire", 7) == 0) return 9;
+      if (std::memcmp(s, "release", 7) == 0) return 10;
+      break;
+    case 5:
+      if (std::memcmp(s, "drain", 5) == 0) return 2;
+      if (std::memcmp(s, "start", 5) == 0) return 3;
+      break;
+    case 4:
+      if (std::memcmp(s, "stop", 4) == 0) return 4;
+      if (std::memcmp(s, "read", 4) == 0) return 7;
+      break;
+    case 3:
+      if (std::memcmp(s, "log", 3) == 0) return 5;
+      if (std::memcmp(s, "txn", 3) == 0) return 8;
+      break;
+    case 6:
+      if (std::memcmp(s, "append", 6) == 0) return 6;
+      break;
+  }
+  return -1;
+}
+
+inline long long floordiv_ms(long long ns) {
+  long long q = ns / 1000000;
+  if (ns % 1000000 != 0 && ns < 0) --q;  // numpy // floors; C trunc's
+  return q;
+}
+
+struct PerProc {
+  int last_type = -1;
+  long long last_time = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+typedef struct {
+  int32_t* rows;     // n_rows * 8, row-major; owned by the result
+  int64_t n_rows;
+  int32_t workload;  // 0 queue, 1 stream, 2 elle, 3 mutex
+  int32_t err;       // Err enum; non-zero => rows is NULL
+  int64_t err_line;  // 1-based line of the first error (0 if n/a)
+} JtPackResult;
+
+// Pack one history.jsonl into rows.  Caller frees with jt_pack_free.
+JtPackResult* jt_pack_file(const char* path) {
+  auto* res = static_cast<JtPackResult*>(std::calloc(1, sizeof(JtPackResult)));
+  if (!res) return nullptr;
+
+  FILE* fh = std::fopen(path, "rb");
+  if (!fh) {
+    res->err = ERR_IO;
+    return res;
+  }
+
+  std::vector<int32_t> rows;
+  rows.reserve(1 << 14);
+  std::unordered_map<long long, PerProc> last;
+  int workload = 0;
+
+  std::string buf;
+  buf.reserve(1 << 20);
+  char chunk[1 << 16];
+  size_t got;
+  int64_t line_no = 0;
+  bool done_reading = false;
+  size_t pos = 0;  // consumed prefix of buf — lines are read in place and
+                   // the buffer compacted once per refill, not per line
+
+  auto fail = [&](int32_t err) {
+    std::fclose(fh);
+    res->err = err;
+    res->err_line = line_no;
+    return res;
+  };
+
+  while (true) {
+    // refill until we hold at least one full line past `pos` (or EOF)
+    size_t nl = buf.find('\n', pos);
+    while (nl == std::string::npos && !done_reading) {
+      if (pos > 0) {  // compact once per refill, not per line
+        buf.erase(0, pos);
+        pos = 0;
+      }
+      size_t scan_from = buf.size();
+      got = std::fread(chunk, 1, sizeof(chunk), fh);
+      if (got == 0) {
+        if (std::ferror(fh)) return fail(ERR_IO);
+        done_reading = true;
+        break;
+      }
+      buf.append(chunk, got);
+      nl = buf.find('\n', scan_from);
+    }
+    size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
+    if (line_end <= pos && done_reading) break;
+
+    // one line in buf[pos, line_end)
+    ++line_no;
+    const char* ls = buf.data() + pos;
+    const char* le = buf.data() + line_end;
+    // strip()
+    while (ls < le && (*ls == ' ' || *ls == '\t' || *ls == '\r')) ++ls;
+    while (le > ls &&
+           (le[-1] == ' ' || le[-1] == '\t' || le[-1] == '\r'))
+      --le;
+    if (ls < le) {
+      Cursor c{ls, le};
+      skip_ws(c);
+      if (c.p >= c.end || *c.p != '{') return fail(ERR_PARSE);
+      ++c.p;
+
+      long long op_index = -1, op_process = -1, op_time = -1;
+      int op_type = -1, op_f = -1;
+      JVal value;
+      bool saw_type = false, saw_f = false;
+
+      skip_ws(c);
+      if (c.p < c.end && *c.p == '}') {
+        ++c.p;  // empty object: missing "type" -> Python KeyError
+        return fail(ERR_PARSE);
+      }
+      while (c.p < c.end && !c.fail) {
+        skip_ws(c);
+        const char *ks, *ke;
+        if (!scan_string(c, &ks, &ke)) return fail(ERR_PARSE);
+        skip_ws(c);
+        if (c.p >= c.end || *c.p != ':') return fail(ERR_PARSE);
+        ++c.p;
+        size_t klen = static_cast<size_t>(ke - ks);
+        // keys are matched on their RAW span; a \u-escaped spelling of
+        // "value"/"process"/… would dodge the match and yield a wrong
+        // matrix — any escaped key falls back to the canonical parser
+        if (std::memchr(ks, '\\', klen) != nullptr) return fail(ERR_PARSE);
+        skip_ws(c);
+        if (klen == 4 && std::memcmp(ks, "type", 4) == 0) {
+          const char *vs, *ve;
+          if (!scan_string(c, &vs, &ve)) return fail(ERR_PARSE);
+          op_type = type_code(vs, static_cast<size_t>(ve - vs));
+          if (op_type < 0) return fail(ERR_PARSE);
+          saw_type = true;
+        } else if (klen == 1 && *ks == 'f') {
+          const char *vs, *ve;
+          if (!scan_string(c, &vs, &ve)) return fail(ERR_PARSE);
+          op_f = f_code(vs, static_cast<size_t>(ve - vs));
+          if (op_f < 0) return fail(ERR_PARSE);
+          saw_f = true;
+        } else if (klen == 7 && std::memcmp(ks, "process", 7) == 0) {
+          JVal v;
+          parse_value(c, v);
+          if (c.overflow) return fail(ERR_OVERFLOW);
+          if (c.fail || v.kind != VKind::INT) return fail(ERR_PARSE);
+          op_process = v.i;
+        } else if (klen == 4 && std::memcmp(ks, "time", 4) == 0) {
+          JVal v;
+          parse_value(c, v);
+          if (c.overflow) return fail(ERR_OVERFLOW);
+          if (c.fail || v.kind != VKind::INT) return fail(ERR_PARSE);
+          op_time = v.i;
+        } else if (klen == 5 && std::memcmp(ks, "index", 5) == 0) {
+          JVal v;
+          parse_value(c, v);
+          if (c.overflow) return fail(ERR_OVERFLOW);
+          if (c.fail || v.kind != VKind::INT) return fail(ERR_PARSE);
+          op_index = v.i;
+        } else if (klen == 5 && std::memcmp(ks, "value", 5) == 0) {
+          value = JVal{};  // duplicate "value" keys: last wins, like
+          parse_value(c, value);  // json.loads — never accumulate
+          if (c.overflow) return fail(ERR_OVERFLOW);
+          if (c.fail) return fail(ERR_PARSE);
+        } else {
+          skip_value(c);  // e.g. "error"
+          if (c.overflow) return fail(ERR_OVERFLOW);
+          if (c.fail) return fail(ERR_PARSE);
+        }
+        skip_ws(c);
+        if (c.p < c.end && *c.p == ',') {
+          ++c.p;
+          continue;
+        }
+        if (c.p < c.end && *c.p == '}') {
+          ++c.p;
+          break;
+        }
+        return fail(ERR_PARSE);
+      }
+      if (c.fail) return fail(ERR_PARSE);
+      skip_ws(c);
+      if (c.p != c.end) return fail(ERR_PARSE);  // trailing junk
+      if (!saw_type || !saw_f) return fail(ERR_PARSE);  // Python KeyError
+
+      // ---- the op is parsed; now the _rows_for semantics ------------
+      if (workload == 0) {
+        if (op_f == 6 || op_f == 7)
+          workload = 1;  // stream
+        else if (op_f == 8)
+          workload = 2;  // elle
+        else if (op_f == 9 || op_f == 10)
+          workload = 3;  // mutex
+      }
+
+      long long t_ms = op_time >= 0 ? op_time / 1000000 : -1;
+      if (t_ms > INT32_MAX) return fail(ERR_OVERFLOW);
+      if (op_index > INT32_MAX || op_index < INT32_MIN ||
+          op_process > INT32_MAX || op_process < INT32_MIN)
+        return fail(ERR_PARSE);  // Python: np.asarray(..., np.int32) raises
+
+      long long lat = -1;
+      auto it = last.find(op_process);
+      if (op_type != T_INVOKE && it != last.end() &&
+          it->second.last_type == T_INVOKE && it->second.last_time >= 0 &&
+          op_time >= 0)
+        lat = floordiv_ms(op_time - it->second.last_time);
+      last[op_process] = PerProc{op_type, op_time};
+
+      auto push_row = [&](long long v, int first) {
+        if (v > INT32_MAX || v < INT32_MIN) {
+          return false;  // value outside int32: OverflowError in Python
+        }
+        rows.push_back(static_cast<int32_t>(op_index));
+        rows.push_back(static_cast<int32_t>(op_process));
+        rows.push_back(static_cast<int32_t>(op_type));
+        rows.push_back(static_cast<int32_t>(op_f));
+        rows.push_back(static_cast<int32_t>(v));
+        rows.push_back(static_cast<int32_t>(t_ms));
+        // latency is int64 in the Python packer and narrowed with
+        // .astype(np.int32), which wraps — static_cast matches
+        rows.push_back(first ? static_cast<int32_t>(lat) : -1);
+        rows.push_back(first);
+        return true;
+      };
+      bool ok;
+      if (value.kind == VKind::LIST) {
+        if (value.elems.empty()) {
+          ok = push_row(NO_VALUE, 1);
+        } else {
+          ok = true;
+          for (size_t k = 0; ok && k < value.elems.size(); ++k)
+            ok = push_row(value.elems[k], k == 0 ? 1 : 0);
+        }
+      } else if (value.kind == VKind::INT) {
+        ok = push_row(value.i, 1);
+      } else {  // NONE / OTHER
+        ok = push_row(NO_VALUE, 1);
+      }
+      if (!ok) return fail(ERR_OVERFLOW);
+    }
+
+    if (nl == std::string::npos) break;  // consumed the final line
+    pos = nl + 1;
+  }
+  std::fclose(fh);
+
+  res->n_rows = static_cast<int64_t>(rows.size() / 8);
+  if (res->n_rows > 0) {
+    res->rows = static_cast<int32_t*>(
+        std::malloc(rows.size() * sizeof(int32_t)));
+    if (!res->rows) {
+      res->err = ERR_IO;
+      return res;
+    }
+    std::memcpy(res->rows, rows.data(), rows.size() * sizeof(int32_t));
+  }
+  res->workload = workload;
+  return res;
+}
+
+void jt_pack_free(JtPackResult* r) {
+  if (!r) return;
+  std::free(r->rows);
+  std::free(r);
+}
+
+}  // extern "C"

@@ -1,0 +1,407 @@
+"""Differential tests for the native history packer (history/fastpack.py
++ native/rows_packer.cpp) against the Python packer.
+
+The native packer must be BIT-IDENTICAL to ``read_history`` +
+``workload_of`` + ``_rows_for`` on everything it accepts, and must
+return None (never a wrong matrix) on anything it doesn't — the Python
+path is the single source of truth for all error behavior.  Coverage:
+every synth workload family with anomalies injected, the value-shape
+edge cases (bool/null/float/string/object/nested/empty-list/negative),
+missing fields, blank lines, and the int32 overflow contract.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.fastpack import pack_file
+from jepsen_tpu.history.ops import workload_of
+from jepsen_tpu.history.rows import _rows_for
+from jepsen_tpu.history.store import read_history, write_history_jsonl
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    MutexSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_elle_batch,
+    synth_mutex_batch,
+    synth_stream_batch,
+)
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    # the library builds on first use; if the toolchain is absent these
+    # tests skip rather than silently passing through the fallback
+    from jepsen_tpu.history import fastpack
+
+    if fastpack._load() is None:
+        pytest.skip("native rows packer unavailable")
+
+
+def _assert_identical(path):
+    fast = pack_file(path)
+    assert fast is not None
+    history = read_history(path)
+    assert fast[0] == workload_of(history)
+    np.testing.assert_array_equal(fast[1], _rows_for(history))
+
+
+def _write(tmp_path, dicts, name="history.jsonl"):
+    p = tmp_path / name
+    with open(p, "w") as fh:
+        for d in dicts:
+            fh.write(json.dumps(d) + "\n")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# Synth families: the native packer must reproduce the Python matrices
+# exactly on realistic histories, anomalies included
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_queue_family_identical(tmp_path, seed):
+    spec = SynthSpec(
+        n_ops=120, seed=seed, lost=2, duplicated=1, unexpected=1
+    )
+    for i, sh in enumerate(synth_batch(3, spec)):
+        p = tmp_path / f"h{i}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        _assert_identical(p)
+
+
+def test_stream_family_identical(tmp_path):
+    for i, sh in enumerate(
+        synth_stream_batch(3, StreamSynthSpec(n_ops=80), lost=1)
+    ):
+        p = tmp_path / f"s{i}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        _assert_identical(p)
+
+
+def test_elle_family_identical(tmp_path):
+    for i, sh in enumerate(
+        synth_elle_batch(3, ElleSynthSpec(), g1a=1)
+    ):
+        p = tmp_path / f"e{i}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        _assert_identical(p)
+
+
+def test_mutex_family_identical(tmp_path):
+    for i, sh in enumerate(
+        synth_mutex_batch(3, MutexSynthSpec(), double_grant=1)
+    ):
+        p = tmp_path / f"m{i}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        _assert_identical(p)
+
+
+# ---------------------------------------------------------------------------
+# Value-shape and field edge cases
+# ---------------------------------------------------------------------------
+
+
+def test_value_shapes_identical(tmp_path):
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "invoke", "f": "enqueue", "process": 0,
+             "time": 1_500_000, "value": 5},
+            # bool values: isinstance(True, int) -> 1/0
+            {"index": 1, "type": "ok", "f": "enqueue", "process": 0,
+             "time": 3_700_001, "value": True},
+            {"index": 2, "type": "ok", "f": "enqueue", "process": 4,
+             "time": 3_700_001, "value": False},
+            # null and absent -> NO_VALUE
+            {"index": 3, "type": "ok", "f": "enqueue", "process": 5,
+             "time": -1, "value": None},
+            {"index": 4, "type": "invoke", "f": "dequeue", "process": 6},
+            # float / string / object -> NO_VALUE
+            {"index": 5, "type": "ok", "f": "enqueue", "process": 7,
+             "time": 9, "value": 3.5},
+            {"index": 6, "type": "ok", "f": "enqueue", "process": 8,
+             "time": 9, "value": "surprise"},
+            {"index": 7, "type": "ok", "f": "enqueue", "process": 9,
+             "time": 9, "value": {"k": [1, 2]}},
+            # drain explosion, incl. empty list -> single NO_VALUE row
+            {"index": 8, "type": "ok", "f": "drain", "process": 1,
+             "time": 20_000_000, "value": [7, 8, 9]},
+            {"index": 9, "type": "ok", "f": "drain", "process": 2,
+             "time": 21_000_000, "value": []},
+            # nested lists (stream read pairs) -> NO_VALUE elements;
+            # bools inside lists stay ints
+            {"index": 10, "type": "ok", "f": "drain", "process": 3,
+             "time": 22_000_000, "value": [[0, 5], 11, True, "x", None]},
+            # a real value equal to the explode sentinel (-2) survives
+            {"index": 11, "type": "ok", "f": "enqueue", "process": 10,
+             "time": 23_000_000, "value": -2},
+            # negative times stay -1 ms; nemesis ops lack "process"
+            {"index": 12, "type": "info", "f": "start", "time": -1},
+            {"index": 13, "type": "info", "f": "stop", "time": -1},
+        ],
+    )
+    _assert_identical(p)
+
+
+def test_latency_pairing_identical(tmp_path):
+    # interleaved processes; a completion pairs with its own process's
+    # open invoke only, and only when both timestamps are valid
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "invoke", "f": "enqueue", "process": 0,
+             "time": 1_000_000, "value": 1},
+            {"index": 1, "type": "invoke", "f": "enqueue", "process": 1,
+             "time": 2_000_000, "value": 2},
+            {"index": 2, "type": "ok", "f": "enqueue", "process": 1,
+             "time": 5_999_999, "value": 2},  # floor((5999999-2e6)/1e6)=3
+            {"index": 3, "type": "ok", "f": "enqueue", "process": 0,
+             "time": 10_000_000, "value": 1},
+            # completion with no preceding invoke (reconnect info)
+            {"index": 4, "type": "info", "f": "enqueue", "process": 0,
+             "time": 11_000_000, "value": 9},
+            # invoke with missing time -> its completion gets no latency
+            {"index": 5, "type": "invoke", "f": "enqueue", "process": 2,
+             "value": 3},
+            {"index": 6, "type": "ok", "f": "enqueue", "process": 2,
+             "time": 12_000_000, "value": 3},
+            # completion earlier than invoke (clock skew): negative
+            # latency, floor-divided
+            {"index": 7, "type": "invoke", "f": "enqueue", "process": 3,
+             "time": 20_000_000, "value": 4},
+            {"index": 8, "type": "ok", "f": "enqueue", "process": 3,
+             "time": 19_500_000, "value": 4},
+        ],
+    )
+    _assert_identical(p)
+
+
+def test_blank_lines_and_whitespace(tmp_path):
+    p = tmp_path / "history.jsonl"
+    with open(p, "w") as fh:
+        fh.write("\n")
+        fh.write(
+            '  {"index": 0, "type": "invoke", "f": "enqueue", '
+            '"process": 0, "time": 1000000, "value": 3}  \n'
+        )
+        fh.write("   \n")
+        fh.write(
+            '{"index": 1, "type": "ok", "f": "enqueue", '
+            '"process": 0, "time": 2000000, "value": 3}'
+        )  # no trailing newline
+    _assert_identical(p)
+
+
+def test_error_field_and_unknown_keys_skipped(tmp_path):
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "invoke", "f": "dequeue", "process": 0,
+             "time": 1_000_000},
+            {"index": 1, "type": "fail", "f": "dequeue", "process": 0,
+             "time": 2_000_000, "error": "exhausted",
+             "extra": {"nested": ["deep", {"x": 1}]},
+             "harmless-unknown-key": [1, 2]},
+        ],
+    )
+    _assert_identical(p)
+
+
+def test_workload_classification_first_match(tmp_path):
+    # txn appears before acquire: elle wins (first non-queue f in order)
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "invoke", "f": "enqueue", "process": 0,
+             "time": 1},
+            {"index": 1, "type": "invoke", "f": "txn", "process": 1,
+             "time": 2},
+            {"index": 2, "type": "invoke", "f": "acquire", "process": 2,
+             "time": 3},
+        ],
+    )
+    fast = pack_file(p)
+    assert fast is not None and fast[0] == "elle"
+    _assert_identical(p)
+
+
+def test_empty_file(tmp_path):
+    p = tmp_path / "history.jsonl"
+    p.write_text("")
+    fast = pack_file(p)
+    assert fast is not None
+    assert fast[0] == "queue"
+    assert fast[1].shape == (0, 8)
+
+
+# ---------------------------------------------------------------------------
+# Fallback contract: anything irregular -> None, Python raises
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "line",
+    [
+        '{"index": 0, "f": "enqueue", "process": 0}',  # missing type
+        '{"index": 0, "type": "ok", "process": 0}',  # missing f
+        '{"type": "levitate", "f": "enqueue"}',  # unknown type name
+        '{"type": "ok", "f": "teleport"}',  # unknown f name
+        '{"type": "ok", "f": "enqueue", "process": "zero"}',  # str process
+        '{"type": "ok", "f": "enqueue", "process": 0',  # truncated JSON
+        "42",  # non-object line
+        '{"type": "ok", "f": "enqueue"} trailing',  # trailing junk
+        # malformed JSON the canonical parser rejects (review r4: the
+        # native parser must never accept what json.loads refuses)
+        '{"type": "ok", "f": "enqueue", "index": 01}',  # leading zero
+        '{"type": "ok", "f": "enqueue", "value": +5}',  # leading plus
+        '{"type": "ok", "f": "enqueue", "value": 1e}',  # bare exponent
+        '{"type": "ok", "f": "enqueue", "value": 1.}',  # bare fraction
+        '{"type": "ok", "f": "enqueue", "value": 5abc}',  # trailing junk
+        '{"type": "ok", "f": "enqueue", "extra": {oops!!}}',  # bad nested
+        '{"type": "ok", "f": "enqueue", "error": "bad \\q escape"}',
+        '{"type": "ok", "f": "enqueue", "value": [1, 2,]}',  # trailing ,
+        # \u-escaped key spelling of "value": raw-span key matching
+        # would skip it and emit a wrong matrix (review r4) — any
+        # escaped key must fall back to the canonical parser
+        '{"type": "ok", "f": "enqueue", "process": 3, '
+        '"\\u0076alue": 7}',
+        '{"type": "ok", "f": "enqueue", "proc\\u0065ss": 3, "value": 7}',
+    ],
+)
+def test_irregular_input_falls_back(tmp_path, line):
+    p = tmp_path / "history.jsonl"
+    p.write_text(line + "\n")
+    assert pack_file(p) is None
+
+
+def test_duplicate_value_keys_last_wins(tmp_path):
+    """json.loads resolves duplicate keys last-wins; the native packer
+    must not accumulate list elements across duplicates (review r4)."""
+    p = tmp_path / "history.jsonl"
+    p.write_text(
+        '{"index": 0, "type": "ok", "f": "drain", "process": 0, '
+        '"time": 1000000, "value": [1], "value": [2, 3]}\n'
+        '{"index": 1, "type": "ok", "f": "enqueue", "process": 1, '
+        '"time": 2000000, "value": [4], "value": 9}\n'
+    )
+    _assert_identical(p)
+
+
+def test_valid_json_the_parser_must_accept(tmp_path):
+    """The strict grammar must not over-reject: escapes, \\uXXXX,
+    nested structures, zero, negative zero, exponents in skipped
+    fields."""
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "ok", "f": "enqueue", "process": 0,
+             "time": 1_000_000, "value": 0,
+             "error": 'quote " backslash \\ tab \t unicode é'},
+            {"index": 1, "type": "ok", "f": "enqueue", "process": -0,
+             "time": 2_000_000, "value": -5,
+             "extra": {"deep": [{"er": 1.5e-3}, []]}},
+        ],
+    )
+    _assert_identical(p)
+
+
+def test_value_overflow_falls_back_and_python_raises(tmp_path):
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "ok", "f": "enqueue", "process": 0,
+             "time": 1_000_000, "value": 2**33},
+        ],
+    )
+    assert pack_file(p) is None
+    with pytest.raises(OverflowError):
+        _rows_for(read_history(p))
+
+
+def test_time_overflow_falls_back(tmp_path):
+    # time_ms beyond int32 (year ~2038 in ms since epoch... here: ns
+    # value whose //1e6 exceeds int32)
+    p = _write(
+        tmp_path,
+        [
+            {"index": 0, "type": "ok", "f": "enqueue", "process": 0,
+             "time": (2**31 + 5) * 1_000_000, "value": 1},
+        ],
+    )
+    assert pack_file(p) is None
+    with pytest.raises(OverflowError):
+        _rows_for(read_history(p))
+
+
+def test_missing_file_falls_back(tmp_path):
+    assert pack_file(tmp_path / "nope.jsonl") is None
+
+
+def test_edn_suffix_falls_back(tmp_path):
+    p = tmp_path / "history.edn"
+    p.write_text("[]")
+    assert pack_file(p) is None
+
+
+# ---------------------------------------------------------------------------
+# Integration: rows_with_cache uses the native path and cuts the cache
+# ---------------------------------------------------------------------------
+
+
+def test_rows_with_cache_native_miss_then_hit(tmp_path):
+    from jepsen_tpu.history.rows import cache_path_for, rows_with_cache
+
+    sh = synth_batch(1, SynthSpec(n_ops=60, seed=3, lost=1))[0]
+    p = tmp_path / "history.jsonl"
+    write_history_jsonl(p, sh.ops)
+    wl, rows, hit = rows_with_cache(p)
+    assert not hit and wl == "queue"
+    assert cache_path_for(p).exists()
+    np.testing.assert_array_equal(rows, _rows_for(read_history(p)))
+    wl2, rows2, hit2 = rows_with_cache(p)
+    assert hit2 and wl2 == wl
+    np.testing.assert_array_equal(rows2, rows)
+
+
+def test_random_fuzz_identical(tmp_path):
+    """Randomized op soup across every field shape the recorder can
+    produce (plus shapes it can't — the packer sees files, not the
+    recorder)."""
+    import random
+
+    rng = random.Random(1234)
+    types = ["invoke", "ok", "fail", "info"]
+    fs = ["enqueue", "dequeue", "drain", "start", "stop", "log",
+          "append", "read", "txn", "acquire", "release"]
+    for trial in range(10):
+        dicts = []
+        for i in range(rng.randrange(0, 120)):
+            d = {"index": i, "type": rng.choice(types),
+                 "f": rng.choice(fs)}
+            if rng.random() < 0.9:
+                d["process"] = rng.randrange(-1, 6)
+            if rng.random() < 0.9:
+                d["time"] = rng.randrange(-2, 10**9)
+            r = rng.random()
+            if r < 0.4:
+                d["value"] = rng.randrange(-5, 2**31 - 1)
+            elif r < 0.6:
+                d["value"] = [
+                    rng.randrange(0, 1000)
+                    for _ in range(rng.randrange(0, 5))
+                ]
+            elif r < 0.7:
+                d["value"] = rng.choice(
+                    [None, True, False, "s", 1.25, {"k": 1}, [[1, 2]]]
+                )
+            if rng.random() < 0.2:
+                d["error"] = rng.choice(["timeout", ["nested", 1]])
+            dicts.append(d)
+        p = _write(tmp_path, dicts, name=f"fuzz{trial}.jsonl")
+        _assert_identical(p)

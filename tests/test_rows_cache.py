@@ -110,7 +110,7 @@ def test_cli_bench_check_uses_cache(tmp_path, capsys):
     (tmp_path / "s" / STORE_CACHE).unlink()
     assert main(args) == 0
     second = capsys.readouterr()
-    assert "(3 from the packed-row cache)" in second.err
+    assert "(3 from the packed-row cache, 0 native-packed)" in second.err
     # identical verdict either way (timings differ, the counts must not)
     import json
 

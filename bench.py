@@ -403,9 +403,12 @@ def _apply_cpu_scale() -> None:
 
 
 def _bench_wgl_hard(details: dict) -> None:
-    """Chip-only: the partition-era WGL hard-history rows at w=6–7,
-    capacity 256 — the one configuration where `WGL_BENCH.md` projects a
-    plausible tensor win (compile-amortized, ratio <2× on host XLA).
+    """Chip-only: the partition-era WGL hard-history rows — w=6–7 at
+    capacity 256 (the configuration where `WGL_BENCH.md` projected, and
+    the 2026-07-31 capture confirmed, a genuine tensor win) plus w=8 at
+    capacity 1024 (probing whether the win extends once capacity, not
+    time, is the growing cost; adds up to ~25 min worst-case via the
+    per-row deadline).
 
     Delegates to ``tools/bench_wgl.py --hard``, which runs each row in a
     subprocess with a per-row deadline (the measured quantity *includes*
@@ -420,23 +423,34 @@ def _bench_wgl_hard(details: dict) -> None:
     tool = os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "tools", "bench_wgl.py"
     )
-    cmd = [
-        sys.executable, tool, "--hard",
-        "--n-ops", "200", "--windows", "6", "7",
-        "--capacity", "256", "--batch", "16", "--deadline", "1500",
-    ]
-    r = subprocess.run(cmd, capture_output=True, text=True)
     rows = []
-    for line in r.stdout.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                rows.append(json.loads(line))
-            except ValueError:
-                pass
-    if not rows:
-        rows = [{"error": (r.stderr or r.stdout)[-300:]}]
-    details["wgl_hard"] = rows
+    # (windows, capacity) pairs from WGL_BENCH.md's measured table:
+    # w≤7 completes inside a 256-row frontier; w=8 overflows 128/256
+    # and needs 1024
+    for windows, capacity in ((["6", "7"], "256"), (["8"], "1024")):
+        cmd = [
+            sys.executable, tool, "--hard",
+            "--n-ops", "200", "--windows", *windows,
+            "--capacity", capacity, "--batch", "16", "--deadline", "1500",
+        ]
+        r = subprocess.run(cmd, capture_output=True, text=True)
+        got = []
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    got.append(json.loads(line))
+                except ValueError:
+                    pass
+        if not got:
+            got = [{"error": (r.stderr or r.stdout)[-300:],
+                    "windows": windows, "capacity": capacity}]
+        rows.extend(got)
+        # persist after EACH group: an interrupt/tunnel death during the
+        # long w=8 probe must not discard the already-captured w=6–7
+        # rows (a scarce future tunnel window would re-pay them)
+        details["wgl_hard"] = rows
+        _write_details(details)
     for row in rows:
         print(f"# wgl_hard: {json.dumps(row)}", file=sys.stderr)
 

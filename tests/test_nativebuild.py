@@ -1,0 +1,73 @@
+"""The shared build-on-first-use protocol (utils/nativebuild.py) used by
+both ctypes bindings (client/native.py, history/fastpack.py)."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from jepsen_tpu.utils.nativebuild import ensure_built
+
+
+def test_existing_file_is_a_noop(tmp_path):
+    lib = tmp_path / "libx.so"
+    lib.write_bytes(b"present")
+    # no Makefile in tmp_path: would fail loudly if a build were attempted
+    assert ensure_built(lib) == ""
+
+
+def test_successful_build(tmp_path):
+    (tmp_path / "Makefile").write_text(
+        "libx.so:\n\techo built > libx.so\n"
+    )
+    lib = tmp_path / "libx.so"
+    assert ensure_built(lib, target="libx.so") == ""
+    assert lib.exists()
+
+
+def test_failing_build_returns_error_text(tmp_path):
+    (tmp_path / "Makefile").write_text(
+        "libx.so:\n\t@echo the-compiler-exploded >&2; exit 1\n"
+    )
+    err = ensure_built(tmp_path / "libx.so", target="libx.so")
+    assert "the-compiler-exploded" in err
+    assert not (tmp_path / "libx.so").exists()
+
+
+def test_build_producing_no_output_is_an_error(tmp_path):
+    (tmp_path / "Makefile").write_text("libx.so:\n\t@true\n")
+    err = ensure_built(tmp_path / "libx.so", target="libx.so")
+    assert err == "build produced no output"
+
+
+def test_missing_makefile_reports_error(tmp_path):
+    err = ensure_built(tmp_path / "libx.so", target="libx.so")
+    assert err != ""
+
+
+def test_build_serialized_under_lock(tmp_path):
+    """A peer that built the library while we waited on the lock is
+    detected under the lock — no rebuild, no error."""
+    import fcntl
+    import threading
+    import time
+
+    (tmp_path / "Makefile").write_text(
+        "libx.so:\n\t@echo should-not-run >&2; exit 1\n"
+    )
+    lib = tmp_path / "libx.so"
+    lock = open(tmp_path / ".build.lock", "w")
+    fcntl.flock(lock, fcntl.LOCK_EX)
+
+    result = {}
+
+    def contender():
+        result["err"] = ensure_built(lib, target="libx.so")
+
+    t = threading.Thread(target=contender)
+    t.start()
+    time.sleep(0.2)  # contender is blocked on the flock
+    lib.write_bytes(b"peer built it")  # the lock holder produces the lib
+    fcntl.flock(lock, fcntl.LOCK_UN)
+    lock.close()
+    t.join(10)
+    assert result["err"] == ""  # detected the peer's build, didn't run make

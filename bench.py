@@ -38,6 +38,7 @@ CPU_BASELINE_SAMPLES = 6
 
 STREAM_BATCH = 4096  # stream histories per device batch
 STREAM_OPS = 200  # ops per stream history
+STREAM_LONG_BATCH = 256  # 10k-op stream row (BASELINE config #4 length)
 ELLE_BATCH = 8192  # txn graphs per device batch
 ELLE_TXNS = 64  # txns per graph
 MUTEX_BATCH = 256  # mutex histories per device batch (WGL frontier search)
@@ -98,6 +99,7 @@ def _init_backend_with_retry() -> str:
 
 BLOCKS = 3
 BLOCK_ITERS = 6
+STREAM_LONG_BLOCKS = BLOCKS  # timed blocks for the 10k-op stream row
 
 
 def _roll_variants(tree, n: int, period: int):
@@ -216,9 +218,24 @@ def _bench_queue(details: dict) -> tuple[float, float]:
     return rate, cpu_rate
 
 
-def _bench_stream(details: dict) -> None:
-    """BASELINE config #4: stream (append-only log) linearizability."""
+
+
+def _bench_stream_sized(
+    details: dict,
+    key: str,
+    n_ops: int,
+    batch: int,
+    blocks: int,
+    base_n: int,
+    cpu_samples: int,
+) -> None:
+    """One stream-linearizability row at a given history length: synth →
+    pack → tile to ``batch`` → roll-variant timed blocks → CPU baseline.
+    Shared by the short (dispatch-bound) and 10k-op (scan-bound) rows so
+    timing-protocol fixes land once.  ``base_n`` must exceed the variant
+    count (every timed dispatch byte-distinct within the roll period)."""
     import jax
+    import jax.numpy as jnp
 
     from jepsen_tpu.checkers.stream_lin import (
         check_stream_lin_cpu,
@@ -227,38 +244,57 @@ def _bench_stream(details: dict) -> None:
     )
     from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
 
-    base = synth_stream_batch(64, StreamSynthSpec(n_ops=STREAM_OPS))
+    n_variants = 1 + blocks * BLOCK_ITERS
+    assert base_n > n_variants, "roll period must exceed variant count"
+    base = synth_stream_batch(base_n, StreamSynthSpec(n_ops=n_ops))
     packed = pack_stream_histories([sh.ops for sh in base])
-    import jax.numpy as jnp
-
-    k = STREAM_BATCH // packed.batch
+    k = max(1, batch // packed.batch)
     big = jax.tree.map(
         lambda x: jnp.tile(x, (k,) + (1,) * (x.ndim - 1)), packed
     )
-
-    variants = _roll_variants(
-        big, 1 + BLOCKS * BLOCK_ITERS, period=packed.batch
+    variants = _roll_variants(big, n_variants, period=packed.batch)
+    rate, dt = _timed_rate(
+        stream_lin_tensor_check, variants, big.batch, blocks=blocks
     )
-    rate, dt = _timed_rate(stream_lin_tensor_check, variants, big.batch)
     del variants
 
     t = time.perf_counter()
-    for sh in base[:CPU_BASELINE_SAMPLES]:
+    for sh in base[:cpu_samples]:
         check_stream_lin_cpu(sh.ops)
-    cpu_rate = CPU_BASELINE_SAMPLES / (time.perf_counter() - t)
+    cpu_rate = cpu_samples / (time.perf_counter() - t)
     print(
-        f"# stream: batch={big.batch} ops={STREAM_OPS} "
+        f"# {key}: batch={big.batch} ops={n_ops} "
         f"device={rate:.0f} hist/s (best {dt * 1e3:.1f}ms) "
         f"cpu={cpu_rate:.1f} hist/s speedup={rate / cpu_rate:.1f}x",
         file=sys.stderr,
     )
-    details["stream"] = {
+    details[key] = {
         "batch": big.batch,
-        "ops": STREAM_OPS,
+        "ops": n_ops,
         "device_histories_per_sec": round(rate, 1),
         "cpu_histories_per_sec": round(cpu_rate, 2),
         "speedup": round(rate / cpu_rate, 1),
     }
+
+
+def _bench_stream(details: dict) -> None:
+    """BASELINE config #4: stream (append-only log) linearizability."""
+    _bench_stream_sized(
+        details, "stream", STREAM_OPS, STREAM_BATCH, BLOCKS,
+        base_n=64, cpu_samples=CPU_BASELINE_SAMPLES,
+    )
+
+
+def _bench_stream_long(details: dict) -> None:
+    """BASELINE config #4 at its stated length: 10k-op stream histories
+    (the short-history row above measures dispatch-bound throughput;
+    this one measures the scan at the config's own sequence length)."""
+    blocks = STREAM_LONG_BLOCKS
+    _bench_stream_sized(
+        details, "stream_10k", 10_000, STREAM_LONG_BATCH, blocks,
+        base_n=1 + blocks * BLOCK_ITERS + 1,
+        cpu_samples=2,  # 10k-op CPU reference checks are slow
+    )
 
 
 def _bench_elle(details: dict) -> None:
@@ -394,9 +430,12 @@ def _apply_cpu_scale() -> None:
     """Shrink device batches for a CPU(-fallback) run: the contract is a
     present, honest artifact within the driver's time budget — not a
     TPU-sized batch ground through host XLA for ten minutes."""
-    global TILE, STREAM_BATCH, ELLE_BATCH, MUTEX_BATCH, MUTEX_OPS
+    global TILE, STREAM_BATCH, STREAM_LONG_BATCH, STREAM_LONG_BLOCKS
+    global ELLE_BATCH, MUTEX_BATCH, MUTEX_OPS
     TILE = 2
     STREAM_BATCH = 256
+    STREAM_LONG_BATCH = 16
+    STREAM_LONG_BLOCKS = 1  # fewer variants => smaller base-history floor
     ELLE_BATCH = 512
     MUTEX_BATCH = 64
     MUTEX_OPS = 32
@@ -620,7 +659,9 @@ def _run_once() -> None:
     rate, cpu_rate = _bench_queue(details)
 
     # secondary families — never allowed to sink the headline artifact
-    for section in (_bench_stream, _bench_elle, _bench_mutex):
+    for section in (
+        _bench_stream, _bench_stream_long, _bench_elle, _bench_mutex
+    ):
         try:
             section(details)
         except Exception as e:  # noqa: BLE001 - secondary, reported
@@ -632,9 +673,9 @@ def _run_once() -> None:
     _write_details(details)
 
     if backend == "tpu":
-        # optional chip-only rows, after the details write (see docstring)
+        # optional chip-only rows, after the details write (see
+        # docstring); the function persists details after each row group
         _bench_wgl_hard(details)
-        _write_details(details)
 
     print(
         json.dumps(

@@ -37,22 +37,22 @@ def fused_tensor_check(
     tq = total_queue_classify(st.a, st.e, st.d)
     ql = queue_lin_classify(
         st.a, st.x, st.s, st.d, st.t,
-        dup_invalidates=delivery == "exactly-once",
+        exactly_once=delivery == "exactly-once",
     )
     return tq, ql
 
 
 @functools.partial(
-    jax.jit, static_argnames=("value_space", "dup_invalidates")
+    jax.jit, static_argnames=("value_space", "exactly_once")
 )
 def _combined_batch(
-    f, type_, value, mask, value_space: int, dup_invalidates: bool = True
+    f, type_, value, mask, value_space: int, exactly_once: bool = True
 ):
     return (
         _total_queue_batch(f, type_, value, mask, value_space),
         _queue_lin_batch(
             f, type_, value, mask, value_space,
-            dup_invalidates=dup_invalidates,
+            exactly_once=exactly_once,
         ),
     )
 
@@ -75,5 +75,5 @@ def combined_tensor_check(
         packed.value,
         packed.mask,
         packed.value_space,
-        dup_invalidates=delivery == "exactly-once",
+        exactly_once=delivery == "exactly-once",
     )

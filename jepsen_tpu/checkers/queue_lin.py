@@ -22,10 +22,21 @@ Per value ``v`` — with enqueue-invoke count ``a``, definite-failure count
 ok-read completion time ``t``:
 
 - **duplicate**: ``r > 1`` — ``v`` removed more times than it was added.
-- **phantom**:   ``r ≥ 1`` and (``a == 0`` or ``x ≥ a``) — read though never
-  attempted, or though every attempt definitely failed (``fail`` means "did
-  not happen"; ``info`` means "may have happened" and is *not* a phantom —
-  the same indeterminacy rule total-queue's ``recovered`` relies on).
+- **phantom**:   ``r ≥ 1`` and ``a == 0`` — read though never attempted.
+  Always invalidates.  Under the ``exactly-once`` contract (the sim
+  broker: in-process transport, a ``fail`` completion is authoritative),
+  ``x ≥ a`` — every attempt definitely failed — is also a phantom.
+- **recovered**: ``r ≥ 1``, ``a ≥ 1``, ``x ≥ a`` under ``at-least-once``
+  (live SUTs over real connections): a client-side enqueue *fail* there
+  is a connection-layer verdict, not the broker's — the publish may have
+  committed before the connection died (observed live: a paused node, a
+  ``ConnectionError`` mid-confirm-wait, the value drains fine).  Reported,
+  never invalidating — exactly the bucket ``checker/total-queue`` calls
+  ``recovered`` (reads of attempted-but-unacknowledged values), and the
+  reference's own driver maps connection errors to ``:fail`` the same way
+  (``rabbitmq.clj:210-213``), so its checker absorbs this case identically.
+  (``info`` means "may have happened" and is not a phantom under either
+  contract — the same indeterminacy rule.)
 - **causality**: ``r ≥ 1``, ``a ≥ 1``, and ``t < s`` — the read *completed*
   before the enqueue was *invoked*: no linearization points
   ``p_enq < p_deq`` can exist inside the op intervals.  (Conversely if
@@ -79,8 +90,9 @@ def check_queue_lin_cpu(
     does not invalidate — redelivery after consumer/conn/node failure is
     contractual for RabbitMQ (classic requeue and quorum-queue Raft
     checkouts both redeliver), and flagging it would fail the SUT for a
-    guarantee it never claimed.  Phantoms and causality violations always
-    invalidate."""
+    guarantee it never claimed — and treats a read of an all-attempts-
+    failed value as *recovered* (see the module docstring), not phantom.
+    Phantoms and causality violations always invalidate."""
     enq_invokes: dict[int, int] = {}
     enq_fails: dict[int, int] = {}
     enq_start: dict[int, int] = {}  # earliest history position of an invoke
@@ -101,20 +113,24 @@ def check_queue_lin_cpu(
                     read_count[v] = read_count.get(v, 0) + 1
                     read_end[v] = min(read_end.get(v, pos), pos)
 
-    dup, phantom, causal = set(), set(), set()
+    exactly_once = delivery == "exactly-once"
+    dup, phantom, causal, recovered = set(), set(), set(), set()
     for v, r in read_count.items():
         a = enq_invokes.get(v, 0)
         x = enq_fails.get(v, 0)
         if r > 1:
             dup.add(v)
-        if a == 0 or x >= a:
+        if a == 0:
+            phantom.add(v)
+        elif x >= a and exactly_once:
             phantom.add(v)
         elif read_end[v] < enq_start[v]:
             causal.add(v)
+        elif x >= a:
+            recovered.add(v)
 
-    dup_invalidates = delivery == "exactly-once"
     return {
-        VALID: not ((dup and dup_invalidates) or phantom or causal),
+        VALID: not ((dup and exactly_once) or phantom or causal),
         "delivery": delivery,
         "duplicate-count": len(dup),
         "duplicate": dup,
@@ -122,6 +138,8 @@ def check_queue_lin_cpu(
         "phantom": phantom,
         "causality-count": len(causal),
         "causality": causal,
+        "recovered-count": len(recovered),
+        "recovered": recovered,
         "read-value-count": len(read_count),
     }
 
@@ -138,6 +156,7 @@ class QueueLinTensors:
     duplicate: jax.Array  # [B, V] bool
     phantom: jax.Array  # [B, V] bool
     causality: jax.Array  # [B, V] bool
+    recovered: jax.Array  # [B, V] bool (at-least-once: fail-read values)
     read_value_count: jax.Array  # [B] i32
 
 
@@ -167,31 +186,46 @@ def queue_lin_count_vectors(f, type_, value, pos, mask, value_space: int):
     return a, x, s, r, t
 
 
-def queue_lin_classify(a, x, s, r, t, dup_invalidates: bool = True) -> QueueLinTensors:
+def queue_lin_classify(a, x, s, r, t, exactly_once: bool = True) -> QueueLinTensors:
     """Vectors ``[..., V]`` → results; runs on full combined vectors.
-    ``dup_invalidates=False`` is the at-least-once delivery contract:
-    duplicates are reported in the tensors but do not sink ``valid``."""
+    ``exactly_once=False`` is the at-least-once delivery contract:
+    duplicates are reported but do not sink ``valid``, and a read of an
+    all-attempts-failed value is *recovered* (reported, never
+    invalidating — a live connection-layer ``fail`` is not the broker's
+    verdict) rather than phantom."""
     read = r >= 1
     dup = r > 1
-    phantom = read & ((a == 0) | (x >= a))
-    causal = read & ~phantom & (s != _INF) & (t != _INF) & (t < s)
+    never_attempted = read & (a == 0)
+    all_failed = read & (a > 0) & (x >= a)
+    causal_base = (
+        read & ~never_attempted & (s != _INF) & (t != _INF) & (t < s)
+    )
+    if exactly_once:
+        phantom = never_attempted | all_failed
+        causal = causal_base & ~all_failed
+        recovered = jnp.zeros_like(phantom)
+    else:
+        phantom = never_attempted
+        causal = causal_base
+        recovered = all_failed & ~causal_base
     valid = ~(phantom.any(-1) | causal.any(-1))
-    if dup_invalidates:
+    if exactly_once:
         valid &= ~dup.any(-1)
     return QueueLinTensors(
         valid=valid,
         duplicate=dup,
         phantom=phantom,
         causality=causal,
+        recovered=recovered,
         read_value_count=read.sum(-1).astype(jnp.int32),
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("value_space", "dup_invalidates")
+    jax.jit, static_argnames=("value_space", "exactly_once")
 )
 def _queue_lin_batch(
-    f, type_, value, mask, value_space: int, dup_invalidates: bool = True
+    f, type_, value, mask, value_space: int, exactly_once: bool = True
 ):
     pos = jnp.broadcast_to(
         jnp.arange(f.shape[-1], dtype=jnp.int32), f.shape
@@ -201,7 +235,7 @@ def _queue_lin_batch(
             ff, tt, vv, pp, mm, value_space
         )
     )(f, type_, value, pos, mask)
-    return queue_lin_classify(a, x, s, r, t, dup_invalidates)
+    return queue_lin_classify(a, x, s, r, t, exactly_once)
 
 
 def queue_lin_tensor_check(
@@ -213,7 +247,7 @@ def queue_lin_tensor_check(
         packed.value,
         packed.mask,
         packed.value_space,
-        dup_invalidates=delivery == "exactly-once",
+        exactly_once=delivery == "exactly-once",
     )
 
 
@@ -224,6 +258,7 @@ def queue_lin_tensors_to_results(t: QueueLinTensors) -> list[dict[str, Any]]:
         "duplicate": np.asarray(t.duplicate),
         "phantom": np.asarray(t.phantom),
         "causality": np.asarray(t.causality),
+        "recovered": np.asarray(t.recovered),
     }
     rvc = np.asarray(t.read_value_count)
     out = []

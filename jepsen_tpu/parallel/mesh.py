@@ -125,7 +125,7 @@ def sharded_total_queue(
 
 @functools.lru_cache(maxsize=64)
 def _queue_lin_program(
-    mesh: Mesh, value_space: int, dup_invalidates: bool = True
+    mesh: Mesh, value_space: int, exactly_once: bool = True
 ):
     def body(f, ty, v, m):
         # global history position of each local row: shard offset + iota
@@ -140,13 +140,14 @@ def _queue_lin_program(
         a, x, r = jax.lax.psum((a, x, r), SEQ_AXIS)
         s = jax.lax.pmin(s, SEQ_AXIS)
         t = jax.lax.pmin(t, SEQ_AXIS)
-        return queue_lin_classify(a, x, s, r, t, dup_invalidates)
+        return queue_lin_classify(a, x, s, r, t, exactly_once)
 
     out_specs = QueueLinTensors(
         valid=P(HIST_AXIS),
         duplicate=P(HIST_AXIS, None),
         phantom=P(HIST_AXIS, None),
         causality=P(HIST_AXIS, None),
+        recovered=P(HIST_AXIS, None),
         read_value_count=P(HIST_AXIS),
     )
     return jax.jit(

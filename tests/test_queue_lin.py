@@ -49,6 +49,60 @@ def test_phantom_from_failed_enqueue():
     assert sh.phantom_fail <= r["phantom"]
 
 
+def test_failed_enqueue_read_is_recovered_under_at_least_once():
+    """Regression (round-4 live matrix, config pause-random-node +
+    dead-letter): an enqueue completed ``fail`` with a connection error
+    — the publish had committed broker-side before the connection died —
+    and the value drained normally.  Under the live at-least-once
+    contract this is jepsen total-queue's ``recovered`` bucket (the
+    reference's driver maps connection errors to ``:fail`` identically,
+    ``rabbitmq.clj:210-213``), NOT a phantom; flagging it failed a valid
+    run.  Under exactly-once (sim: in-process ``fail`` is authoritative)
+    it stays a phantom."""
+    sh = synth_history(SynthSpec(n_ops=300, seed=15, phantom_fail=1))
+
+    cpu = check_queue_lin_cpu(sh.ops, delivery="at-least-once")
+    tpu = check_queue_lin_batch([sh.ops], delivery="at-least-once")[0]
+    assert cpu == tpu, f"cpu/tpu divergence:\n{cpu}\n{tpu}"
+    assert cpu["valid?"]
+    assert cpu["phantom-count"] == 0
+    assert sh.phantom_fail <= cpu["recovered"]
+
+    # the strict contract still invalidates the same history
+    strict = check_queue_lin_cpu(sh.ops, delivery="exactly-once")
+    assert not strict["valid?"]
+    assert strict["recovered-count"] == 0
+
+
+def test_never_attempted_read_is_phantom_under_both_contracts():
+    sh = synth_history(SynthSpec(n_ops=300, seed=14, unexpected=1))
+    for delivery in ("exactly-once", "at-least-once"):
+        cpu = check_queue_lin_cpu(sh.ops, delivery=delivery)
+        tpu = check_queue_lin_batch([sh.ops], delivery=delivery)[0]
+        assert cpu == tpu
+        assert not cpu["valid?"]
+        assert cpu["phantom-count"] >= 1
+
+
+def test_fail_read_before_any_attempt_is_causal_under_at_least_once():
+    # a recovered candidate whose read COMPLETED before any attempt was
+    # even invoked came from nowhere — still invalid under at-least-once
+    ops = reindex(
+        [
+            Op(OpType.INVOKE, OpF.DEQUEUE, 1, None, 100),
+            Op(OpType.OK, OpF.DEQUEUE, 1, 7, 200),  # reads 7 first
+            Op(OpType.INVOKE, OpF.ENQUEUE, 0, 7, 300),
+            Op(OpType.FAIL, OpF.ENQUEUE, 0, 7, 400),
+        ]
+    )
+    cpu = check_queue_lin_cpu(ops, delivery="at-least-once")
+    tpu = check_queue_lin_batch([ops], delivery="at-least-once")[0]
+    assert cpu == tpu
+    assert not cpu["valid?"]
+    assert 7 in cpu["causality"]
+    assert cpu["recovered-count"] == 0
+
+
 def test_causality_violation():
     sh = synth_history(SynthSpec(n_ops=200, seed=16, causality=1))
     r = both(sh.ops)

@@ -399,3 +399,95 @@ def test_unacked_on_close_requeues_without_the_bug(native_lib, cluster):
     cons.close()
     drained = pub.drain()
     assert sorted(drained) == [2, 3]
+
+
+def test_orphaned_inflight_requeued_after_lost_close_sweep(
+    native_lib, cluster
+):
+    """Round-4 matrix find (config random-partition-halves, scaled):
+    a consumer's connection died during a partition/election window, the
+    close handler's one-shot ``requeue_owner`` submit timed out
+    uncommitted — while the node itself stayed in the majority, so the
+    leader's dead-NODE reaper never fired — and the delivered-but-unacked
+    message sat inflight through the entire drain: depth 1 on every
+    replica, ``total-queue`` lost.  The broker now runs a continuous
+    orphan sweep: an inflight entry owned by a connection that no longer
+    exists is re-proposed until it commits.
+
+    The lost submit is injected (drop the close path's requeue_owner
+    call once) so the orphan state the matrix reached through timing is
+    reproduced deterministically on a healthy cluster; with the sweep
+    disabled this test strands the entry forever and fails."""
+    lead = cluster.leader()
+    f = cluster.followers()[0]
+    fb = cluster.brokers[f]
+
+    pub = _driver(native_lib, cluster.brokers[lead])
+    pub.setup()
+    cons = _driver(native_lib, fb, consumer_type="asynchronous")
+    cons.setup()
+    assert pub.enqueue(55, 5.0) is True
+
+    # the QoS-1 push lands on the consumer un-acked: wait for the
+    # replicated inflight entry owned by f's connection
+    deadline = time.monotonic() + 5.0
+    prefix = f + "|"
+    owners: set = set()
+    while time.monotonic() < deadline:
+        with fb.replication.machine.lock:
+            owners = {
+                o
+                for o, _q, _m in fb.replication.machine.inflight.values()
+            }
+        if any(o.startswith(prefix) for o in owners):
+            break
+        time.sleep(0.02)
+    else:
+        raise AssertionError(f"no inflight entry owned by {f}: {owners}")
+
+    # inject the lost close-time sweep: the serve thread's
+    # requeue_owner vanishes exactly as a partition-window submit
+    # timeout would, leaving the orphaned-inflight state behind
+    import threading as _threading
+
+    real = fb.replication.requeue_owner
+    dropped = []
+    fb.replication.requeue_owner = lambda owner: dropped.append(
+        (_threading.current_thread().name, owner)
+    )
+
+    def _close_path_dropped():
+        # the orphan-sweep thread may also hit the patch while it's in
+        # place (its submits are dropped too — later unpatched ticks
+        # re-propose, which is the feature under test); the injection is
+        # only complete once the CLOSE handler's own call was swallowed
+        return any(name != "orphan-sweep" for name, _ in dropped)
+
+    try:
+        cons.close()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not _close_path_dropped():
+            time.sleep(0.02)
+        assert _close_path_dropped(), (
+            f"close path never tried the sweep: {dropped}"
+        )
+    finally:
+        fb.replication.requeue_owner = real
+
+    # the orphan sweep must re-propose: the message returns to the
+    # READY queue and a fresh client can read it
+    deadline = time.monotonic() + 8.0
+    still = None
+    while time.monotonic() < deadline:
+        with fb.replication.machine.lock:
+            still = [
+                o
+                for o, _q, _m in fb.replication.machine.inflight.values()
+                if o.startswith(prefix)
+            ]
+        if not still:
+            break
+        time.sleep(0.05)
+    assert not still, f"inflight entry stranded after lost sweep: {still}"
+    assert pub.dequeue(5.0) == 55
+    pub.close()

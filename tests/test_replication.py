@@ -319,7 +319,10 @@ class TestDeadOwnerRequeue:
             assert msg is not None
             assert lb.counts()["q"] == 1  # inflight
             nodes[victim].stop()  # node dies holding the delivery
-            deadline = time.monotonic() + 5.0
+            # generous: dead-owner detection rides heartbeat-gap timing,
+            # and on a loaded 1-core host scheduling can stretch the
+            # reaper's window well past the nominal dead_owner_s
+            deadline = time.monotonic() + 15.0
             redelivered = None
             while time.monotonic() < deadline:
                 redelivered = lb.dequeue("q", owner=f"{leader}|c9")

@@ -580,30 +580,44 @@ def _watch(interval: float, budget: float) -> int:
                     f"# watch: probe {attempt} healthy — running bench",
                     file=sys.stderr,
                 )
+                # stream the child's stdout line by line and relay the
+                # headline THE MOMENT it appears: the child's wgl_hard
+                # tail can grind for tens of minutes after the headline
+                # prints, and a driver that times this watch process out
+                # there must already have seen the one-line artifact on
+                # its stdout (capture-then-relay-at-exit would lose it)
+                captured = False
                 try:
-                    r = subprocess.run(
+                    p = subprocess.Popen(
                         [
                             sys.executable,
                             os.path.abspath(__file__),
                             "--locked",  # this loop holds the lock
                         ],
-                        capture_output=True,
+                        stdout=subprocess.PIPE,
+                        stderr=sys.stderr,  # diagnostics stream live too
                         text=True,
                         env=os.environ.copy(),
                     )
+                    assert p.stdout is not None
+                    for line in p.stdout:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            if not json.loads(line).get("fallback", True):
+                                captured = True
+                        except ValueError:
+                            pass
+                        print(line, flush=True)
+                    rc = p.wait()
                 finally:
                     harvest.release_lock(root)
-                sys.stderr.write(r.stderr)
-                line = (r.stdout.strip().splitlines() or [""])[-1]
-                try:
-                    if not json.loads(line).get("fallback", True):
-                        print(line)  # the chip-measured headline
-                        return 0
-                except ValueError:
-                    pass
+                if captured:
+                    return 0  # the chip-measured headline is out
                 print(
                     f"# watch: probe was healthy but the bench fell "
-                    f"back (rc={r.returncode}) — continuing to watch",
+                    f"back (rc={rc}) — continuing to watch",
                     file=sys.stderr,
                 )
             else:
@@ -672,11 +686,10 @@ def _run_once() -> None:
 
     _write_details(details)
 
-    if backend == "tpu":
-        # optional chip-only rows, after the details write (see
-        # docstring); the function persists details after each row group
-        _bench_wgl_hard(details)
-
+    # the headline JSON line prints BEFORE the chip-only wgl_hard rows:
+    # their worst case (compile-hang rows killed at the per-row deadline)
+    # can take tens of minutes, and a driver that times the whole run out
+    # there must still find the round's one-line artifact on stdout
     print(
         json.dumps(
             {
@@ -690,8 +703,15 @@ def _run_once() -> None:
                 # run for a chip measurement (advisor r2)
                 "fallback": backend != "tpu",
             }
-        )
+        ),
+        flush=True,
     )
+
+    if backend == "tpu":
+        # optional chip-only rows, after the details write AND the
+        # headline line (see docstring); the function persists details
+        # after each row group
+        _bench_wgl_hard(details)
 
 
 def main(argv=None) -> int:

@@ -231,6 +231,21 @@ class TestHarvestChild:
         assert ran == [] and released == [1]  # lock freed either way
 
 
+
+
+class _FakePopen:
+    """Stands in for the watch loop's streaming bench child: `.stdout`
+    iterates the scripted lines (the real object is a pipe), `.wait()`
+    returns the exit code."""
+
+    def __init__(self, lines, returncode=0):
+        self.stdout = iter(lines)
+        self.returncode = returncode
+
+    def wait(self):
+        return self.returncode
+
+
 class TestWatchLoop:
     """Unit-level: the loop's probe/run/stop protocol, fakes for both."""
 
@@ -251,13 +266,11 @@ class TestWatchLoop:
             bench, "_probe_chip", lambda d: next(probes)
         )
 
-        class R:
-            returncode = 0
-            stderr = ""
-            stdout = json.dumps({"metric": "m", "fallback": False}) + "\n"
-
+        line = json.dumps({"metric": "m", "fallback": False}) + "\n"
         monkeypatch.setattr(
-            bench.subprocess, "run", lambda *a, **k: R()
+            bench.subprocess,
+            "Popen",
+            lambda *a, **k: _FakePopen([line]),
         )
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
         assert bench._watch(interval=1.0, budget=0.0) == 0
@@ -288,16 +301,11 @@ class TestWatchLoop:
                 json.dumps({"metric": "m", "fallback": False}),
             ]
         )
-
-        def fake_run(*a, **k):
-            class R:
-                returncode = 0
-                stderr = ""
-                stdout = next(results) + "\n"
-
-            return R()
-
-        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        monkeypatch.setattr(
+            bench.subprocess,
+            "Popen",
+            lambda *a, **k: _FakePopen([next(results) + "\n"]),
+        )
         monkeypatch.setattr(bench.time, "sleep", lambda s: None)
         assert bench._watch(interval=1.0, budget=0.0) == 0
 

@@ -707,6 +707,7 @@ def cmd_test(args) -> int:
             store_root=args.store,
             workload=args.workload,
             seed_bug=args.seed_bug,
+            durable=args.durable,
         )
     else:
         test, _cluster = build_sim_test(
@@ -839,6 +840,7 @@ def cmd_matrix(args) -> int:
                 scaled,
                 checker_backend=args.checker,
                 store_root=args.store,
+                durable=bool(scaled.get("durable")),
             )
             try:
                 run = run_test(test)
@@ -1042,14 +1044,29 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--checker", choices=("tpu", "cpu"), default="tpu")
     t.add_argument(
         "--seed-bug",
-        choices=("confirm-before-quorum", "drop-unacked-on-close"),
+        choices=(
+            "confirm-before-quorum",
+            "drop-unacked-on-close",
+            "ack-before-fsync",
+        ),
         default=None,
         help="(--db local) inject a replication bug into every broker "
         "node: confirm-before-quorum acknowledges publishes on leader-"
         "local append (a partition+heal truncates confirmed writes); "
         "drop-unacked-on-close discards a dying connection's un-acked "
         "deliveries instead of requeueing them (the delivery plane's "
-        "loss mode) — either way the checker must go red (lost)",
+        "loss mode); ack-before-fsync commits against the in-memory log "
+        "while the WAL falls behind (needs --durable + --nemesis "
+        "crash-restart-cluster to surface) — either way the checker "
+        "must go red (lost)",
+    )
+    t.add_argument(
+        "--durable",
+        action="store_true",
+        help="(--db local) persist each broker node's Raft log + "
+        "term/vote to a per-node data dir that survives SIGKILL — the "
+        "real quorum-queue durability contract; enables the "
+        "crash-restart-cluster power-failure nemesis to run green",
     )
     # the reference's cli-opts (rabbitmq.clj:288-327)
     t.add_argument(
@@ -1086,9 +1103,16 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument(
         "--nemesis",
         default="partition",
-        choices=("partition", "kill-random-node", "pause-random-node"),
+        choices=(
+            "partition",
+            "kill-random-node",
+            "pause-random-node",
+            "crash-restart-cluster",
+        ),
         help="fault family: the reference's network partitions (shaped by "
-        "--network-partition), or process kill/pause of a random node",
+        "--network-partition), process kill/pause of a random node, or "
+        "the whole-cluster power failure (SIGKILL every node, restart — "
+        "pair with --durable or the checker will rightly flag loss)",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"

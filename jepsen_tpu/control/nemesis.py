@@ -197,7 +197,50 @@ class ProcessNemesis:
         self.victims = []
 
 
-NEMESES = ("partition", "kill-random-node", "pause-random-node")
+class CrashRestartNemesis:
+    """Power failure: SIGKILL **every** node on ``start``, restart them
+    all on ``stop``.  The strictest durability test there is — nothing
+    survives except what reached stable storage, so it only makes sense
+    against a durable SUT (a memory-only cluster correctly loses
+    everything and the checker correctly goes red).  Exposes write-path
+    durability bugs (ack-before-fsync) that no partition can, because a
+    partition always leaves a correct in-memory majority running."""
+
+    def __init__(self, procs, nodes: Sequence[str]):
+        self.procs = procs
+        self.nodes = list(nodes)
+        self.down = False
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            for n in self.nodes:
+                self.procs.kill(n)
+            self.down = True
+            logger.info("nemesis: crash-restart killed all of %s", self.nodes)
+            return op.complete(OpType.INFO, value=f"crashed {self.nodes}")
+        if op.f == OpF.STOP:
+            if self.down:
+                for n in self.nodes:
+                    self.procs.restart(n)
+                self.down = False
+            logger.info("nemesis: cluster restarted")
+            return op.complete(OpType.INFO, value=f"restarted {self.nodes}")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        if self.down:
+            for n in self.nodes:
+                self.procs.restart(n)
+            self.down = False
+
+
+NEMESES = (
+    "partition", "kill-random-node", "pause-random-node",
+    "crash-restart-cluster",
+)
 
 
 def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
@@ -217,6 +260,8 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         return ProcessNemesis("kill", procs, nodes, seed=seed)
     if kind == "pause-random-node":
         return ProcessNemesis("pause", procs, nodes, seed=seed)
+    if kind == "crash-restart-cluster":
+        return CrashRestartNemesis(procs, nodes)
     raise ValueError(f"unknown nemesis {kind!r}; one of {NEMESES}")
 
 

@@ -1096,6 +1096,9 @@ def main(argv=None) -> None:
     p.add_argument("--node-id", default=None)
     p.add_argument("--peer", action="append", default=[])
     p.add_argument("--seed-bug", default=None)
+    p.add_argument("--data-dir", default=None,
+                   help="durable Raft state (WAL + term/vote) directory; "
+                        "survives SIGKILL-and-restart")
     p.add_argument("--election-ms", type=int, nargs=2, default=(250, 500))
     p.add_argument("--heartbeat-ms", type=int, default=60)
     p.add_argument("--dead-owner-ms", type=int, default=1500)
@@ -1124,6 +1127,7 @@ def main(argv=None) -> None:
             dead_owner_s=args.dead_owner_ms / 1000.0,
             seed_bug=args.seed_bug,
             submit_timeout_s=args.submit_timeout_ms / 1000.0,
+            data_dir=args.data_dir,
         )
 
     broker = MiniAmqpBroker(port=args.port, replication=replication).start()

@@ -12,9 +12,13 @@ strings the SSH control plane would run on a broker VM
 processes:
 
 - ``rabbitmq-server -detached``      → spawn the node's broker process
-- ``killall -9 beam.smp``            → SIGKILL it (in-memory state dies
-  with it — a *non-durable* broker, so the checker must flag what only
-  that node held; real quorum queues would survive via Raft)
+- ``killall -9 beam.smp``            → SIGKILL it.  Default clusters are
+  in-memory: the node's state dies with it (amnesiac rejoin + catch-up
+  from the leader, under a startup grace).  With ``durable=True`` each
+  node persists its Raft log + term/vote to a per-node data dir that
+  survives the kill, so a restarted node — or the WHOLE restarted
+  cluster, the power-failure case — recovers everything confirmed,
+  matching real quorum queues' durability contract
 - ``killall -STOP/-CONT beam.smp``   → SIGSTOP / SIGCONT (the pause
   nemesis: sockets held, zero progress)
 - ``rabbitmqctl list_queues``        → the admin-port DEPTHS query (the
@@ -88,6 +92,7 @@ class LocalProcTransport(Transport):
         spawn_timeout_s: float = 30.0,
         replicated: bool | None = None,
         seed_bug: str | None = None,
+        durable: bool = False,
     ):
         self.spawn_timeout_s = spawn_timeout_s
         # a 1-node "cluster" needs no consensus; multi-node defaults on
@@ -102,7 +107,19 @@ class LocalProcTransport(Transport):
                 f"seed_bug={seed_bug!r} needs a replicated cluster "
                 f"(n_nodes>1, replicated not disabled)"
             )
+        if durable and not self.replicated:
+            raise ValueError("durable=True needs a replicated cluster")
+        if seed_bug == "ack-before-fsync" and not durable:
+            # without a WAL there is nothing to skip fsyncing — the
+            # fault would silently not exist (false-green red run)
+            raise ValueError("seed_bug='ack-before-fsync' needs durable=True")
         self.seed_bug = seed_bug
+        self.durable = durable
+        self._data_root: str | None = None
+        if durable:
+            import tempfile
+
+            self._data_root = tempfile.mkdtemp(prefix="jt-cluster-data-")
         self._nodes: dict[str, _Node] = {}
         for _ in range(n_nodes):
             port, admin = _free_port(), _free_port()
@@ -179,6 +196,11 @@ class LocalProcTransport(Transport):
                 except (OSError, subprocess.TimeoutExpired):
                     pass
             n.proc = None
+        if self._data_root is not None:
+            import shutil
+
+            shutil.rmtree(self._data_root, ignore_errors=True)
+            self._data_root = None
 
     # ---- command implementations -----------------------------------------
     @staticmethod
@@ -221,6 +243,11 @@ class LocalProcTransport(Transport):
                     "--dead-owner-ms", "2000"]
             if self.seed_bug:
                 cmd += ["--seed-bug", self.seed_bug]
+            if self._data_root is not None:
+                # per-node dir keyed by port — SURVIVES kill/restart, so a
+                # rebooted node recovers its Raft log (durable SUT)
+                cmd += ["--data-dir",
+                        os.path.join(self._data_root, f"n{n.port}")]
         try:
             n.proc = subprocess.Popen(
                 cmd,
@@ -381,6 +408,7 @@ def build_local_test(
     workload: str = "queue",
     replicated: bool | None = None,
     seed_bug: str | None = None,
+    durable: bool = False,
 ):
     """The dress-rehearsal assembly in one call: ``build_rabbitmq_test``
     over a fresh :class:`LocalProcTransport` with the fast-boot
@@ -390,7 +418,8 @@ def build_local_test(
     from jepsen_tpu.suite import build_rabbitmq_test
 
     t = LocalProcTransport(
-        n_nodes=n_nodes, replicated=replicated, seed_bug=seed_bug
+        n_nodes=n_nodes, replicated=replicated, seed_bug=seed_bug,
+        durable=durable,
     )
     try:
         nodes = t.nodes

@@ -97,6 +97,10 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
         nemesis="pause-random-node",
         **{"dead-letter": True},
     ),
+    # the power-failure config: whole-cluster SIGKILL + restart against
+    # a DURABLE cluster (WAL-recovered Raft) — nothing confirmed may be
+    # lost.  `durable` is consumed by the --db local assembly.
+    _cfg(duration=10.0, nemesis="crash-restart-cluster", durable=True),
 ]
 
 

@@ -18,16 +18,26 @@ Ra's election timeouts):
 
 The implementation is textbook Raft (Ongaro & Ousterhout; terms, votes
 with the log-up-to-date check, AppendEntries consistency check + conflict
-truncation, commit = majority match in the current term) minus
-persistence: nodes here are in-memory by design (the whole point of the
-harness is that the *checker* must notice anything a crash genuinely
-loses), so a restarted node rejoins empty with a startup grace period —
-it neither votes nor campaigns until it has heard from a live leader or
-sat out several election timeouts.  That grace closes the classic
-re-vote-after-restart hole a memory-only Raft would otherwise have; runs
-are short and the nemesis kills at most one node per cycle
-(``control/nemesis.py:130-146``), so the majority always retains every
-committed entry.
+truncation, commit = majority match in the current term) with two
+persistence modes:
+
+- **In-memory (default)**: nodes rejoin empty after a kill, with a
+  startup grace period — they neither vote nor campaign until they have
+  heard from a live leader or sat out several election timeouts.  That
+  grace closes the classic re-vote-after-restart hole a memory-only Raft
+  would otherwise have; runs are short and the nemesis kills at most one
+  node per cycle (``control/nemesis.py:130-146``), so the majority
+  always retains every committed entry.
+- **Durable (``data_dir=``)**: term/vote in ``meta.json`` and the log in
+  an append-only ``wal.jsonl`` (truncations recorded as ``{"trunc": i}``
+  markers), each fsync'd *before* the corresponding RPC answer or
+  commit count — the Raft persistence contract, matching real quorum
+  queues (RabbitMQ's Ra log).  A restarted node recovers its full log
+  and needs no grace (its vote survived the crash), so even a
+  whole-cluster power failure — SIGKILL every node, restart — loses
+  nothing that was confirmed.  Leaders append a no-op entry on election
+  so recovered prior-term entries commit without waiting for client
+  traffic (§5.4.2's counting rule never applies to them directly).
 
 Partitions are **per-link and socket-level**: each node keeps a
 ``blocked`` set of peer names, mirroring an ``iptables -A INPUT -s peer``
@@ -50,6 +60,13 @@ exercised):
   partition that isolates that leader then heals makes the new leader
   truncate the unreplicated entries: confirmed writes vanish, and
   ``total-queue`` must flag them as lost end-to-end.
+- ``ack-before-fsync`` — durable mode only: log entries are buffered in
+  process memory and never reach the WAL, while everything else
+  (replication, commit, confirms) proceeds normally — the classic
+  "fsync lies" durability bug.  Partitions can't expose it (the
+  in-memory majority stays correct); a whole-cluster crash-restart
+  does: every node recovers a log missing the buffered tail, confirmed
+  writes vanish, and ``total-queue`` must flag them as lost.
 - ``drop-unacked-on-close`` — enforced by the broker, not this module
   (``harness/broker.py``): a dying connection's un-acked deliveries are
   *discarded* instead of requeued, so messages delivered-but-unacked at
@@ -61,6 +78,8 @@ from __future__ import annotations
 
 import base64
 import json
+import logging
+import os
 import random
 import socket
 import threading
@@ -70,6 +89,8 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
+
+logger = logging.getLogger("jepsen_tpu.replication")
 
 
 # ---------------------------------------------------------------------------
@@ -161,6 +182,10 @@ class QueueMachine:
             n = len(dq) if dq else 0
             self.queues[op["q"]] = deque()
             return n
+        if k == "noop":
+            # leader-election marker (durable mode): commits prior-term
+            # entries by the counting rule without waiting for traffic
+            return None
         if k == "read_stream":
             # linearizable read: committing the read through the log IS
             # the linearization point — the returned snapshot reflects
@@ -278,6 +303,7 @@ class RaftNode:
         dead_owner_s: float = 1.5,
         seed_bug: str | None = None,
         rng_seed: int | None = None,
+        data_dir: str | None = None,
     ):
         self.name = name
         self.peers = dict(peers)
@@ -304,9 +330,19 @@ class RaftNode:
         self.blocked: set[str] = set()
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._fresh_deadline()
-        # startup grace: a memory-only node must not vote/campaign until it
-        # has heard from a live leader or sat out several timeouts
-        self._grace_until = time.monotonic() + 3 * self.eto[1]
+
+        self.data_dir = data_dir
+        self._wal_fh = None
+        if data_dir is not None:
+            self._recover()  # sets term/voted_for/log from disk
+            # a durable node's vote survived the crash: no re-vote hole,
+            # so it participates immediately (real Raft semantics)
+            self._grace_until = time.monotonic()
+        else:
+            # startup grace: a memory-only node must not vote/campaign
+            # until it has heard from a live leader or sat out several
+            # timeouts
+            self._grace_until = time.monotonic() + 3 * self.eto[1]
         self._requeued_dead: dict[str, float] = {}
 
         host, port = self.peers[name]
@@ -328,9 +364,112 @@ class RaftNode:
             self._server.close()
         except OSError:
             pass
+        with self.lock:
+            if self._wal_fh is not None:
+                try:
+                    self._wal_fh.close()
+                except OSError:
+                    pass
+                self._wal_fh = None
 
     def _fresh_deadline(self) -> float:
         return time.monotonic() + self.rng.uniform(*self.eto)
+
+    # -- durability ---------------------------------------------------------
+    # Contract (Raft §5): term/vote and log entries must be on stable
+    # storage BEFORE the node answers the RPC (or, on the leader, before
+    # the entry counts toward commit).  Callers hold self.lock.
+
+    def _recover(self) -> None:
+        os.makedirs(self.data_dir, exist_ok=True)
+        meta_p = os.path.join(self.data_dir, "meta.json")
+        try:
+            with open(meta_p) as fh:
+                meta = json.load(fh)
+            self.term = int(meta.get("term", 0))
+            self.voted_for = meta.get("voted_for")
+        except (OSError, ValueError):
+            pass
+        wal_p = os.path.join(self.data_dir, "wal.jsonl")
+        try:
+            good = 0  # byte offset of the end of the last intact record
+            with open(wal_p, "rb") as fh:
+                for raw in fh:
+                    line = raw.strip()
+                    if line:
+                        try:
+                            rec = json.loads(line.decode())
+                        except ValueError:
+                            break  # torn tail write: gone from here on
+                        if not raw.endswith(b"\n"):
+                            break  # intact JSON but no newline: still torn
+                        if "trunc" in rec:
+                            del self.log[rec["trunc"] - 1 :]
+                        else:
+                            self.log.append((rec["t"], rec["op"]))
+                    good += len(raw)
+            # drop the torn bytes NOW: later appends reopen in "a" mode,
+            # and records written after a leftover partial line would be
+            # unreadable by the next recovery (fsync'd yet lost)
+            if good < os.path.getsize(wal_p):
+                with open(wal_p, "rb+") as fh:
+                    fh.truncate(good)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+        except OSError:
+            pass
+        # recovered entries re-apply as commit_idx advances (apply is
+        # deterministic, the machine starts empty — exact replay)
+
+    def _persist_meta_locked(self) -> None:
+        if self.data_dir is None:
+            return
+        try:
+            tmp = os.path.join(self.data_dir, "meta.json.tmp")
+            with open(tmp, "w") as fh:
+                json.dump(
+                    {"term": self.term, "voted_for": self.voted_for}, fh
+                )
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, os.path.join(self.data_dir, "meta.json"))
+        except OSError as e:
+            self._fail_stop_locked("meta persist failed", e)
+
+    def _wal_write_locked(self, records: list[dict]) -> None:
+        """Append ``records`` to the WAL and fsync — unless the
+        ``ack-before-fsync`` seeded bug is on, in which case the records
+        go nowhere: the process keeps acting on its in-memory log while
+        the durable log silently falls behind (lost on SIGKILL)."""
+        if self.data_dir is None or not records:
+            return
+        if self.seed_bug == "ack-before-fsync":
+            return  # THE BUG: ack/commit proceeds, storage never told
+        try:
+            if self._wal_fh is None:
+                self._wal_fh = open(
+                    os.path.join(self.data_dir, "wal.jsonl"), "a"
+                )
+            self._wal_fh.write(
+                "".join(json.dumps(r, separators=(",", ":")) + "\n"
+                        for r in records)
+            )
+            self._wal_fh.flush()
+            os.fsync(self._wal_fh.fileno())
+        except OSError as e:
+            self._fail_stop_locked("WAL write failed", e)
+
+    def _fail_stop_locked(self, why: str, exc: OSError) -> None:
+        """A node that cannot persist must stop participating — acking
+        state that isn't on disk would be a silent durability lie, and a
+        retry of the same entries would find them already in the
+        in-memory log and ack without ever writing them (review r4
+        find).  Fail-stop is what real Raft stores do on fsync failure
+        (fsyncgate).  The raised OSError makes the in-flight RPC go
+        unanswered and the in-flight client op fail/drop."""
+        logger.error("raft %s fail-stop: %s: %s", self.name, why, exc)
+        self.stop()
+        raise OSError(f"raft {self.name} fail-stop: {why}") from exc
 
     # -- public surface -----------------------------------------------------
     def is_leader(self) -> bool:
@@ -362,6 +501,8 @@ class RaftNode:
         double-enqueue."""
         deadline = time.monotonic() + timeout_s
         while True:
+            if not self._running:
+                return False, None  # stopped (incl. fail-stop): never ack
             with self.lock:
                 leader = self.state == LEADER
                 hint = self.leader_hint
@@ -398,6 +539,7 @@ class RaftNode:
                 return "lost", None
             self.log.append((self.term, op))
             index = len(self.log)  # 1-based
+            self._wal_write_locked([{"t": self.term, "op": op}])
             if self.seed_bug == "confirm-before-quorum" and op["k"] in (
                 "enq",
                 "txn",
@@ -411,6 +553,8 @@ class RaftNode:
                 return "ok", None
             w = _Waiter(term=self.term)
             self.waiters[index] = w
+            if not self.others:
+                self._advance_commit_locked()  # 1-node: own ack is quorum
         self._replicate_once()
         w.event.wait(max(0.0, deadline - time.monotonic()))
         with self.lock:
@@ -528,6 +672,7 @@ class RaftNode:
                 if up_to_date:
                     granted = True
                     self.voted_for = msg["from"]
+                    self._persist_meta_locked()  # vote durable before reply
                     self._election_deadline = self._fresh_deadline()
             return {"term": self.term, "granted": granted}
 
@@ -550,6 +695,7 @@ class RaftNode:
             if prev > 0 and self.log[prev - 1][0] != msg["prev_term"]:
                 return {"term": self.term, "ok": False, "have": prev - 1}
             entries = [(t, op) for t, op in msg["entries"]]
+            wal: list[dict] = []
             for i, (t, op) in enumerate(entries):
                 idx = prev + i + 1  # 1-based
                 if idx <= len(self.log):
@@ -559,8 +705,12 @@ class RaftNode:
                         del self.log[idx - 1 :]
                         self._fail_waiters_from(idx)
                         self.log.append((t, op))
+                        wal.append({"trunc": idx})
+                        wal.append({"t": t, "op": op})
                 else:
                     self.log.append((t, op))
+                    wal.append({"t": t, "op": op})
+            self._wal_write_locked(wal)  # durable before the ok reply
             if msg["leader_commit"] > self.commit_idx:
                 self.commit_idx = min(msg["leader_commit"], len(self.log))
             self._apply_ready_locked()
@@ -591,15 +741,25 @@ class RaftNode:
         if term > self.term:
             self.term = term
             self.voted_for = None
+            self._persist_meta_locked()
         self.state = FOLLOWER
 
     def _become_leader_locked(self) -> None:
         self.state = LEADER
         self.leader_hint = self.name
+        if self.data_dir is not None:
+            # no-op entry (§8 / §5.4.2): recovered prior-term entries can
+            # only commit via a committed current-term entry; after a
+            # whole-cluster restart there may be no client traffic to
+            # provide one, so the leader supplies it
+            self.log.append((self.term, {"k": "noop"}))
+            self._wal_write_locked([{"t": self.term, "op": {"k": "noop"}}])
         self.next_idx = {p: len(self.log) + 1 for p in self.others}
         self.match_idx = {p: 0 for p in self.others}
         now = time.monotonic()
         self.last_peer_ok = {p: now for p in self.others}
+        if not self.others:
+            self._advance_commit_locked()  # 1-node: leader alone is quorum
 
     def _start_election(self) -> None:
         with self.lock:
@@ -609,6 +769,7 @@ class RaftNode:
             self.state = CANDIDATE
             self.term += 1
             self.voted_for = self.name
+            self._persist_meta_locked()  # durable before soliciting votes
             term = self.term
             last_term = self.log[-1][0] if self.log else 0
             req = {
@@ -621,6 +782,16 @@ class RaftNode:
             self._election_deadline = self._fresh_deadline()
         votes = [1]  # self
         done = threading.Event()
+        with self.lock:
+            # a single-node cluster is its own majority — there are no
+            # peer-reply threads to run the count below
+            if (
+                self.state == CANDIDATE
+                and self.term == term
+                and votes[0] * 2 > len(self.peers)
+            ):
+                self._become_leader_locked()
+                done.set()
 
         def ask(peer: str) -> None:
             resp = self._rpc(peer, req, timeout_s=self.eto[0])
@@ -821,6 +992,7 @@ class ReplicatedBackend:
         seed_bug: str | None = None,
         submit_timeout_s: float = 5.0,
         rng_seed: int | None = None,
+        data_dir: str | None = None,
     ):
         self.machine = QueueMachine()
         self.submit_timeout_s = submit_timeout_s
@@ -837,6 +1009,7 @@ class ReplicatedBackend:
             dead_owner_s=dead_owner_s,
             seed_bug=seed_bug,
             rng_seed=rng_seed,
+            data_dir=data_dir,
         )
 
     def stop(self) -> None:

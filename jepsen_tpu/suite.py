@@ -59,7 +59,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "time-before-partition": 10.0,
     "partition-duration": 10.0,
     "network-partition": "partition-random-halves",
-    "nemesis": "partition",  # or kill-random-node / pause-random-node
+    "nemesis": "partition",  # or kill/pause-random-node, crash-restart-cluster
     "publish-confirm-timeout": 5.0,  # seconds (5000 ms in the reference)
     # stream final read: extra empty batches confirming end-of-log when no
     # offset proof is available (the x-stream-offset="last" probe is the

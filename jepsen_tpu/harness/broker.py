@@ -1068,12 +1068,28 @@ def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
             elif req == "UNBLOCK_ALL" and broker.replication is not None:
                 broker.replication.raft.unblock_all()
                 sock.sendall(b"OK\n")
+            elif req.startswith("JOIN ") and broker.replication is not None:
+                # rabbitmqctl join_cluster mapping: ask the cluster at
+                # host:port to add this node (a real Raft AddServer
+                # committed through the log — blocks until the cfg
+                # entry replicates back, so an OK means full member)
+                host, _, port = req[len("JOIN "):].strip().rpartition(":")
+                if not host or not port.isdigit():
+                    sock.sendall(b"ERR bad JOIN address\n")
+                else:
+                    ok = broker.replication.raft.request_join(
+                        (host, int(port))
+                    )
+                    sock.sendall(b"OK\n" if ok else b"ERR join failed\n")
             elif req == "ROLE" and broker.replication is not None:
                 state, term, hint = broker.replication.raft.role()
                 sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
             else:
                 sock.sendall(b"ERR unknown\n")
-        except OSError:
+        except (OSError, ValueError):
+            # one bad request must never kill the accept loop: this
+            # port carries the drain cross-check AND the partition
+            # enforcement (BLOCK) for the rest of the run
             pass
         finally:
             try:
@@ -1099,6 +1115,10 @@ def main(argv=None) -> None:
     p.add_argument("--data-dir", default=None,
                    help="durable Raft state (WAL + term/vote) directory; "
                         "survives SIGKILL-and-restart")
+    p.add_argument("--pending-join", action="store_true",
+                   help="boot outside any cluster (self-only, no self-"
+                        "election); membership arrives via the admin "
+                        "JOIN command (rabbitmqctl join_cluster)")
     p.add_argument("--election-ms", type=int, nargs=2, default=(250, 500))
     p.add_argument("--heartbeat-ms", type=int, default=60)
     p.add_argument("--dead-owner-ms", type=int, default=1500)
@@ -1128,6 +1148,7 @@ def main(argv=None) -> None:
             seed_bug=args.seed_bug,
             submit_timeout_s=args.submit_timeout_ms / 1000.0,
             data_dir=args.data_dir,
+            bootstrap=not args.pending_join,
         )
 
     broker = MiniAmqpBroker(port=args.port, replication=replication).start()

@@ -35,8 +35,20 @@ processes:
   SIGSTOPped (the client-visible effect of a minority partition, without
   any real consensus underneath).
 
-Everything else (wget, tar, config upload, feature flags, join_cluster,
-status-dump eval) succeeds vacuously, recorded in ``log`` like
+- ``rabbitmqctl join_cluster rabbit@P`` → a REAL membership change on
+  the replicated cluster: nodes first-boot self-only (the primary
+  bootstraps a 1-node cluster, secondaries boot pending — no
+  self-election), and the join maps to the node's admin ``JOIN`` →
+  a Raft AddServer config entry committed through the log (effective
+  on append, §6), serialized one join at a time.  The cluster the
+  partitions later stress was *formed* by the same choreography the
+  reference runs.  Restarts (the kill nemesis) boot with the full
+  known config — membership is durable metadata in RabbitMQ even
+  when messages are not.  ``rm -rf`` of the install dir ("cleaning
+  previous install") forgets membership and wipes durable state.
+
+Everything else (wget, tar, config upload, feature flags, status-dump
+eval) succeeds vacuously, recorded in ``log`` like
 :class:`~jepsen_tpu.control.ssh.FakeTransport` — the choreography is
 asserted by the FakeTransport unit tests; this transport's job is making
 the *live* pieces (runner, native TCP clients, nemesis, drain, checker)
@@ -75,6 +87,11 @@ class _Node:
         self.repl_port = repl_port
         self.proc: subprocess.Popen | None = None
         self.stderr_path: str | None = None
+        #: True once this node has been a cluster member: restarts (the
+        #: kill nemesis) boot with the full peer config — membership is
+        #: durable metadata in RabbitMQ even when messages are not —
+        #: while FIRST boots start self-only and join for real
+        self.booted_once = False
 
 
 class LocalProcTransport(Transport):
@@ -170,10 +187,25 @@ class LocalProcTransport(Transport):
             return RunResult(0, "", "")
         if "list_queues" in inner:
             return self._list_queues(node)
+        if "join_cluster" in inner and self.replicated:
+            return self._join_cluster(node, inner)
         if "rabbitmqctl" in inner and " eval " in inner:
             return RunResult(0, "no_local_member", "")
+        if inner.startswith("rm -rf ") and "rabbitmq-server" in inner:
+            # "cleaning previous install": a re-setup must re-form the
+            # cluster from scratch — forget membership and durable state
+            n = self._nodes[node]
+            n.booted_once = False
+            if self._data_root is not None:
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(self._data_root, f"n{n.port}"),
+                    ignore_errors=True,
+                )
+            return RunResult(0, "", "")
         # choreography commands with no process-level meaning here:
-        # wget/tar/mkdir/rm/chmod/mv/echo/test -e/feature flags/join_cluster
+        # wget/tar/mkdir/chmod/mv/echo/test -e/feature flags/stop_app
         return RunResult(0, "", "")
 
     def put(self, node, content, remote_path):
@@ -231,8 +263,23 @@ class LocalProcTransport(Transport):
         ]
         if self.replicated:
             cmd += ["--node-id", n.name]
-            for peer in self._nodes.values():
-                cmd += ["--peer", f"{peer.name}=127.0.0.1:{peer.repl_port}"]
+            if n.booted_once:
+                # restart (kill nemesis): the node was a member, and
+                # cluster membership survives broker restarts (it is
+                # durable metadata in RabbitMQ even for transient
+                # messages) — boot with the full known config
+                for peer in self._nodes.values():
+                    cmd += [
+                        "--peer",
+                        f"{peer.name}=127.0.0.1:{peer.repl_port}",
+                    ]
+            else:
+                # FIRST boot: self-only.  The primary bootstraps a
+                # 1-node cluster; everyone else boots pending and is
+                # added by a real join_cluster → Raft AddServer commit
+                cmd += ["--peer", f"{n.name}=127.0.0.1:{n.repl_port}"]
+                if node != next(iter(self._nodes)):
+                    cmd += ["--pending-join"]
             # snappy failover relative to the suite's (possibly
             # time-scaled) partition windows.  dead-owner is deliberately
             # NOT snappy: it revokes inflight deliveries (for the mutex
@@ -264,6 +311,8 @@ class LocalProcTransport(Transport):
             try:
                 socket.create_connection(("127.0.0.1", n.port), 0.25).close()
                 self._drop_stderr(n)  # only failure paths need the tail
+                if node == next(iter(self._nodes)) or not self.replicated:
+                    n.booted_once = True  # primary: member from birth
                 return
             except OSError:
                 time.sleep(0.05)
@@ -359,15 +408,37 @@ class LocalProcTransport(Transport):
             if a not in keep_stopped:
                 self._signal(a, signal.SIGCONT)
 
-    def _admin(self, node: str, line: str) -> RunResult:
+    def _join_cluster(self, node: str, inner: str) -> RunResult:
+        """``rabbitmqctl join_cluster rabbit@<primary>`` → the node's
+        admin JOIN: a real Raft AddServer committed through the log.
+        Fails loudly (rc=1) — a vacuous join would leave the node
+        serving as its own 1-node cluster."""
+        target = inner.split("join_cluster", 1)[1].strip().split()[0]
+        pname = target[len("rabbit@"):] if target.startswith("rabbit@") \
+            else target
+        pn = self._nodes.get(pname)
+        if pn is None:
+            return RunResult(1, "", f"unknown primary {pname!r}")
+        r = self._admin(
+            node, f"JOIN 127.0.0.1:{pn.repl_port}", timeout_s=20.0
+        )
+        if r.rc == 0 and r.out.startswith("OK"):
+            self._nodes[node].booted_once = True  # member now
+            return RunResult(0, "", "")
+        return RunResult(1, r.out, r.err or "join_cluster failed")
+
+    def _admin(
+        self, node: str, line: str, timeout_s: float = 2.0
+    ) -> RunResult:
         """One-line admin query to a node; a dead node answers rc=1 —
         except for iptables mappings, which succeed vacuously (a real
         iptables rule installs fine on a host whose broker is down)."""
         n = self._nodes[node]
         try:
             with socket.create_connection(
-                ("127.0.0.1", n.admin_port), 2.0
+                ("127.0.0.1", n.admin_port), timeout_s
             ) as s:
+                s.settimeout(timeout_s)
                 s.sendall(line.encode() + b"\n")
                 out = b""
                 while chunk := s.recv(4096):

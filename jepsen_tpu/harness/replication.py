@@ -186,6 +186,10 @@ class QueueMachine:
             # leader-election marker (durable mode): commits prior-term
             # entries by the counting rule without waiting for traffic
             return None
+        if k == "cfg":
+            # membership change: consumed by the Raft layer on APPEND
+            # (§6); nothing for the queue state machine to do at commit
+            return None
         if k == "read_stream":
             # linearizable read: committing the read through the log IS
             # the linearization point — the returned snapshot reflects
@@ -304,10 +308,21 @@ class RaftNode:
         seed_bug: str | None = None,
         rng_seed: int | None = None,
         data_dir: str | None = None,
+        bootstrap: bool = True,
     ):
         self.name = name
         self.peers = dict(peers)
         self.others = [p for p in peers if p != name]
+        #: the config this node was BORN with; the live config is the
+        #: latest committed-or-appended ``cfg`` log entry, falling back
+        #: to this (recomputed on append/truncate — Raft §6: membership
+        #: changes take effect when written, not when committed)
+        self._initial_peers = dict(peers)
+        #: a node started self-only with ``bootstrap=False`` is PENDING:
+        #: it neither campaigns nor serves until a join_request lands it
+        #: in a leader's config and replication hands it the cfg entry
+        self.bootstrap = bootstrap
+        self._join_lock = threading.Lock()
         self.apply_fn = apply_fn
         self.eto = election_timeout
         self.heartbeat_s = heartbeat_s
@@ -419,7 +434,9 @@ class RaftNode:
         except OSError:
             pass
         # recovered entries re-apply as commit_idx advances (apply is
-        # deterministic, the machine starts empty — exact replay)
+        # deterministic, the machine starts empty — exact replay); a
+        # recovered cfg entry restores the cluster membership too
+        self._recompute_config_locked()
 
     def _persist_meta_locked(self) -> None:
         if self.data_dir is None:
@@ -513,7 +530,10 @@ class RaftNode:
                 if status == "timeout":
                     return False, None  # indeterminate — never retry
                 # "lost": entry definitively truncated — safe to retry
-            elif hint is not None and hint != self.name:
+            elif hint is not None and hint != self.name and (
+                hint in self.peers
+            ):  # a mid-catch-up node may know the leader's NAME before
+                # the cfg entry carrying its ADDRESS arrives
                 resp = self._rpc(
                     hint,
                     {"rpc": "client_op", "op": op, "from": self.name},
@@ -540,6 +560,8 @@ class RaftNode:
             self.log.append((self.term, op))
             index = len(self.log)  # 1-based
             self._wal_write_locked([{"t": self.term, "op": op}])
+            if op.get("k") == "cfg":
+                self._recompute_config_locked()  # effective on APPEND (§6)
             if self.seed_bug == "confirm-before-quorum" and op["k"] in (
                 "enq",
                 "txn",
@@ -565,6 +587,95 @@ class RaftNode:
             return "lost", None
         return "ok", w.result
 
+    # -- dynamic membership -------------------------------------------------
+    def _recompute_config_locked(self) -> None:
+        """Reset the live config to the latest ``cfg`` entry in the log
+        (or the initial config when none remains — e.g. after a
+        truncation removed it).  Keeps this node's actual bound address
+        and seeds replication bookkeeping for newly-learned peers."""
+        cfg = None
+        for _t, op in reversed(self.log):
+            if op.get("k") == "cfg":
+                cfg = op["peers"]
+                break
+        if cfg is not None:
+            peers = {n: (a[0], int(a[1])) for n, a in cfg.items()}
+        else:
+            peers = dict(self._initial_peers)
+        peers[self.name] = self.peers[self.name]  # our true bound port
+        self.peers = peers
+        self.others = [p for p in peers if p != self.name]
+        now = time.monotonic()
+        for p in self.others:
+            self.next_idx.setdefault(p, len(self.log) + 1)
+            self.match_idx.setdefault(p, 0)
+            self.last_peer_ok.setdefault(p, now)
+
+    def _pending_locked(self) -> bool:
+        """True while this node has no cluster: started non-bootstrap
+        with only itself — it must not campaign (a self-elected 1-node
+        'leader' would confirm unreplicated publishes)."""
+        return not self.bootstrap and len(self.peers) == 1
+
+    def request_join(
+        self, leader_addr: tuple[str, int], timeout_s: float = 12.0
+    ) -> bool:
+        """Ask the cluster at ``leader_addr`` to add us (the
+        ``rabbitmqctl join_cluster`` mapping).  Retries until the leader
+        commits the membership change AND the cfg entry has replicated
+        back to us (so a caller that proceeds to serve traffic is a real
+        member, not still pending)."""
+        host, port = self.peers[self.name]
+        msg = {
+            "rpc": "join_request",
+            "name": self.name,
+            "host": host,
+            "port": self.port,
+            "from": self.name,
+        }
+        deadline = time.monotonic() + timeout_s
+        accepted = False
+        while time.monotonic() < deadline:
+            if not accepted:
+                resp = self._rpc_addr(
+                    leader_addr, msg,
+                    timeout_s=min(5.0, deadline - time.monotonic()),
+                )
+                accepted = bool(resp and resp.get("ok"))
+                if not accepted:
+                    time.sleep(0.2)
+                    continue
+            with self.lock:
+                if len(self.peers) > 1:
+                    return True  # the cfg entry reached us: full member
+            time.sleep(0.05)
+        return False
+
+    def _on_join_request(self, msg: dict) -> dict:
+        with self.lock:
+            leader = self.state == LEADER
+            hint = self.leader_hint
+            hint_addr = self.peers.get(hint) if hint else None
+            already = msg["name"] in self.peers
+        if not leader:
+            if already:
+                # a member asking again (idempotent re-join): fine
+                return {"ok": True}
+            if hint_addr is not None and hint != self.name:
+                # proxy to the leader (the choreography talks to the
+                # PRIMARY, which is usually but not necessarily leader)
+                resp = self._rpc_addr(hint_addr, msg, timeout_s=8.0)
+                return resp if resp is not None else {"ok": False}
+            return {"ok": False}
+        with self._join_lock:  # serialize concurrent joins (§6: one at
+            with self.lock:    # a time, each from the committed config)
+                if msg["name"] in self.peers:
+                    return {"ok": True}
+                peers = {n: [a[0], a[1]] for n, a in self.peers.items()}
+            peers[msg["name"]] = [msg["host"], int(msg["port"])]
+            ok, _ = self.submit({"k": "cfg", "peers": peers}, timeout_s=8.0)
+        return {"ok": bool(ok)}
+
     # -- RPC plumbing -------------------------------------------------------
     def _rpc(
         self, peer: str, msg: dict, timeout_s: float = 0.5
@@ -572,16 +683,30 @@ class RaftNode:
         """One request/response to ``peer``.  If we block input from the
         peer, the request still goes out but the response is discarded —
         iptables INPUT-drop semantics (see module docstring)."""
-        host, port = self.peers[peer]
+        addr = self.peers.get(peer)
+        if addr is None:
+            return None  # peer left the config between check and call
+        return self._rpc_addr(addr, msg, timeout_s=timeout_s,
+                              blocked_peer=peer)
+
+    def _rpc_addr(
+        self,
+        addr: tuple[str, int],
+        msg: dict,
+        timeout_s: float = 0.5,
+        blocked_peer: str | None = None,
+    ) -> dict | None:
+        host, port = addr
         try:
             with socket.create_connection(
                 (host, port), timeout=min(0.25, timeout_s)
             ) as s:
                 s.sendall((json.dumps(msg) + "\n").encode())
-                with self.lock:
-                    drop_reply = peer in self.blocked
-                if drop_reply:
-                    return None
+                if blocked_peer is not None:
+                    with self.lock:
+                        drop_reply = blocked_peer in self.blocked
+                    if drop_reply:
+                        return None
                 s.settimeout(timeout_s)
                 buf = b""
                 while not buf.endswith(b"\n"):
@@ -636,6 +761,8 @@ class RaftNode:
             return self._on_append_entries(msg)
         if rpc == "client_op":
             return self._on_client_op(msg)
+        if rpc == "join_request":
+            return self._on_join_request(msg)
         return {"ok": False, "error": f"unknown rpc {rpc!r}"}
 
     def _on_client_op(self, msg: dict) -> dict:
@@ -696,6 +823,7 @@ class RaftNode:
                 return {"term": self.term, "ok": False, "have": prev - 1}
             entries = [(t, op) for t, op in msg["entries"]]
             wal: list[dict] = []
+            cfg_touched = False
             for i, (t, op) in enumerate(entries):
                 idx = prev + i + 1  # 1-based
                 if idx <= len(self.log):
@@ -707,10 +835,15 @@ class RaftNode:
                         self.log.append((t, op))
                         wal.append({"trunc": idx})
                         wal.append({"t": t, "op": op})
+                        cfg_touched = True  # truncation may drop a cfg
                 else:
                     self.log.append((t, op))
                     wal.append({"t": t, "op": op})
+                    if op.get("k") == "cfg":
+                        cfg_touched = True
             self._wal_write_locked(wal)  # durable before the ok reply
+            if cfg_touched:
+                self._recompute_config_locked()  # §6: effective on append
             if msg["leader_commit"] > self.commit_idx:
                 self.commit_idx = min(msg["leader_commit"], len(self.log))
             self._apply_ready_locked()
@@ -764,6 +897,11 @@ class RaftNode:
     def _start_election(self) -> None:
         with self.lock:
             if time.monotonic() < self._grace_until:
+                self._election_deadline = self._fresh_deadline()
+                return
+            if self._pending_locked():
+                # not yet a member of any cluster: self-electing would
+                # make a 1-node "quorum" that confirms unreplicated
                 self._election_deadline = self._fresh_deadline()
                 return
             self.state = CANDIDATE
@@ -993,6 +1131,7 @@ class ReplicatedBackend:
         submit_timeout_s: float = 5.0,
         rng_seed: int | None = None,
         data_dir: str | None = None,
+        bootstrap: bool = True,
     ):
         self.machine = QueueMachine()
         self.submit_timeout_s = submit_timeout_s
@@ -1010,6 +1149,7 @@ class ReplicatedBackend:
             seed_bug=seed_bug,
             rng_seed=rng_seed,
             data_dir=data_dir,
+            bootstrap=bootstrap,
         )
 
     def stop(self) -> None:

@@ -660,6 +660,7 @@ def cmd_test(args) -> int:
         "net-ticktime": args.net_ticktime,
         "quorum-initial-group-size": args.quorum_initial_group_size,
         "dead-letter": args.dead_letter,
+        "durable": args.durable,
         "seed": args.seed,
     }
     if args.archive_url:
@@ -1108,11 +1109,14 @@ def build_parser() -> argparse.ArgumentParser:
             "kill-random-node",
             "pause-random-node",
             "crash-restart-cluster",
+            "mixed",
         ),
         help="fault family: the reference's network partitions (shaped by "
-        "--network-partition), process kill/pause of a random node, or "
+        "--network-partition), process kill/pause of a random node, "
         "the whole-cluster power failure (SIGKILL every node, restart — "
-        "pair with --durable or the checker will rightly flag loss)",
+        "pair with --durable or the checker will rightly flag loss), or "
+        "mixed (the jepsen.nemesis/compose soak: each cycle randomly "
+        "picks partition/kill/pause, plus crash-restart when --durable)",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"

@@ -237,9 +237,50 @@ class CrashRestartNemesis:
             self.down = False
 
 
+class MixedNemesis:
+    """``jepsen.nemesis/compose``'s role: one nemesis that interleaves
+    several fault families over the run — each ``start`` picks one
+    member (seeded RNG) and injects its fault; the paired ``stop`` heals
+    that same member.  The reference suite only ever selects a single
+    partition strategy per run, but the jepsen *framework* composes
+    nemeses, and a soak that mixes partitions with process faults
+    stresses recovery paths no single-family run reaches (e.g. a kill
+    landing on a cluster still healing from a partition)."""
+
+    def __init__(self, members: Mapping[str, Any], seed: int | None = None):
+        if not members:
+            raise ValueError("mixed nemesis needs at least one member")
+        self.members = dict(members)
+        self.rng = random.Random(seed)
+        self.active: Any | None = None
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        for m in self.members.values():
+            m.setup(test)
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            import dataclasses
+
+            name = self.rng.choice(sorted(self.members))
+            self.active = self.members[name]
+            done = self.active.invoke(test, op)
+            return dataclasses.replace(done, value=f"{name}: {done.value}")
+        if op.f == OpF.STOP:
+            if self.active is None:
+                return op.complete(OpType.INFO, value="nothing active")
+            member, self.active = self.active, None
+            return member.invoke(test, op)
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for m in self.members.values():
+            m.teardown(test)
+
+
 NEMESES = (
     "partition", "kill-random-node", "pause-random-node",
-    "crash-restart-cluster",
+    "crash-restart-cluster", "mixed",
 )
 
 
@@ -262,6 +303,22 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         return ProcessNemesis("pause", procs, nodes, seed=seed)
     if kind == "crash-restart-cluster":
         return CrashRestartNemesis(procs, nodes)
+    if kind == "mixed":
+        # the soak composition: partitions + process faults interleaved.
+        # crash-restart joins only when the SUT is durable (a memory-only
+        # cluster correctly loses everything on a full-cluster crash, so
+        # mixing it in would red a bug-free run)
+        members: dict[str, Any] = {
+            "partition": PartitionNemesis(
+                opts["network-partition"], net, nodes, seed=seed,
+                leader_fn=leader_fn,
+            ),
+            "kill": ProcessNemesis("kill", procs, nodes, seed=seed),
+            "pause": ProcessNemesis("pause", procs, nodes, seed=seed),
+        }
+        if opts.get("durable"):
+            members["crash-restart"] = CrashRestartNemesis(procs, nodes)
+        return MixedNemesis(members, seed=seed)
     raise ValueError(f"unknown nemesis {kind!r}; one of {NEMESES}")
 
 

@@ -101,6 +101,14 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
     # a DURABLE cluster (WAL-recovered Raft) — nothing confirmed may be
     # lost.  `durable` is consumed by the --db local assembly.
     _cfg(duration=10.0, nemesis="crash-restart-cluster", durable=True),
+    # the compose soak: partitions, kills, pauses, and power failures
+    # randomly interleaved over one durable run (jepsen.nemesis/compose)
+    _cfg(
+        duration=10.0,
+        nemesis="mixed",
+        durable=True,
+        partition="random-partition-halves",
+    ),
 ]
 
 

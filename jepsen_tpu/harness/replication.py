@@ -39,6 +39,16 @@ persistence modes:
   so recovered prior-term entries commit without waiting for client
   traffic (§5.4.2's counting rule never applies to them directly).
 
+Membership is **dynamic** (Raft §6, add-only, one change at a time):
+a node started with ``bootstrap=False`` and only itself in ``peers`` is
+PENDING — it neither campaigns nor commits until a ``join_request`` RPC
+(sent by :meth:`RaftNode.request_join`, proxied to the leader if the
+contacted node isn't it) lands an AddServer ``cfg`` entry in the log.
+Config entries take effect when *appended*, not when committed; conflict
+truncation reverts them; the WAL recovers them.  This is what
+``rabbitmqctl join_cluster`` maps onto in ``--db local``
+(``rabbitmq.clj:99-119`` choreography).
+
 Partitions are **per-link and socket-level**: each node keeps a
 ``blocked`` set of peer names, mirroring an ``iptables -A INPUT -s peer``
 DROP rule (``control/net.py:59-66``): an incoming RPC from a blocked peer

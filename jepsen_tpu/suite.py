@@ -75,6 +75,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "net-ticktime": 15,
     "quorum-initial-group-size": 0,
     "dead-letter": False,
+    "durable": False,  # --db local: WAL-backed Raft logs (survive SIGKILL)
     "message-ttl": 1.0,  # dead-letter mode TTL (MESSAGE_TTL, Utils.java:55)
     "archive-url": DEFAULT_ARCHIVE_URL,
 }
@@ -377,6 +378,9 @@ def build_rabbitmq_test(
         # the local process cluster can name its Raft leader (admin ROLE);
         # an SSH transport has no hook and partition-leader stays refused
         leader_fn=getattr(transport, "leader", None),
+        # reproducible fault schedules when the run pins a seed (mixed-
+        # nemesis family picks, partition victim choices)
+        seed=(int(o["seed"]) if o.get("seed") is not None else None),
     )
     if workload == "stream":
         client = StreamClient(

@@ -148,7 +148,7 @@ class TestMatrixCliParity:
             matrix_cli_flags,
         )
 
-        assert len(CI_MATRIX) == 14 and len(EXTENDED_MATRIX) == 4
+        assert len(CI_MATRIX) == 14 and len(EXTENDED_MATRIX) == 6
         assert not any("--nemesis" in l for l in matrix_cli_flags())
         parser = build_parser()
         for cfg, line in zip(

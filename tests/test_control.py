@@ -523,3 +523,67 @@ def test_get_missing_remote_is_false_and_cleans_up(
     dest = tmp_path / "out.log"
     assert SshTransport().get("n1", "/gone", dest) is False
     assert not dest.exists()  # no empty/partial artifact left behind
+
+
+def test_mixed_nemesis_delegates_and_pairs_stop_with_start():
+    """MixedNemesis (jepsen.nemesis/compose's role): each start picks ONE
+    member and the paired stop heals that SAME member; the history value
+    names which family fired; teardown reaches every member."""
+    from jepsen_tpu.control.nemesis import MixedNemesis
+    from jepsen_tpu.history.ops import Op, OpF, OpType
+
+    class Member:
+        def __init__(self, name):
+            self.name = name
+            self.calls = []
+
+        def setup(self, test):
+            self.calls.append("setup")
+
+        def invoke(self, test, op):
+            self.calls.append("start" if op.f == OpF.START else "stop")
+            return op.complete(OpType.INFO, value=f"{self.name}-did-it")
+
+        def teardown(self, test):
+            self.calls.append("teardown")
+
+    a, b = Member("a"), Member("b")
+    nem = MixedNemesis({"alpha": a, "beta": b}, seed=7)
+    nem.setup({})
+    assert a.calls == ["setup"] and b.calls == ["setup"]
+    start = Op.invoke(OpF.START, -1)
+    stop = Op.invoke(OpF.STOP, -1)
+    for _ in range(6):  # every stop must land on the starter
+        r = nem.invoke({}, start)
+        family = r.value.split(":")[0]
+        starter = a if family == "alpha" else b
+        before = list(starter.calls)
+        nem.invoke({}, stop)
+        assert starter.calls == before + ["stop"]
+    # both families eventually fire under the seeded RNG
+    assert "start" in a.calls and "start" in b.calls
+    # a stop with nothing active is a no-op, not a crash
+    r = nem.invoke({}, stop)
+    assert r.value == "nothing active"
+    nem.teardown({})
+    assert a.calls[-1] == "teardown" and b.calls[-1] == "teardown"
+
+
+def test_make_nemesis_mixed_membership_follows_durable():
+    """--nemesis mixed composes partition/kill/pause; crash-restart joins
+    only when the SUT is durable (a memory-only cluster correctly loses
+    everything on a whole-cluster crash)."""
+    from jepsen_tpu.control.nemesis import MixedNemesis, make_nemesis
+    from jepsen_tpu.control.net import SimProcs
+
+    net = IptablesNet(FakeTransport(), NODES)
+    base = {"nemesis": "mixed", "network-partition": "partition-halves"}
+    nem = make_nemesis(base, net, SimProcs(None), NODES, seed=1)
+    assert isinstance(nem, MixedNemesis)
+    assert sorted(nem.members) == ["kill", "partition", "pause"]
+    nem2 = make_nemesis(
+        {**base, "durable": True}, net, SimProcs(None), NODES, seed=1
+    )
+    assert sorted(nem2.members) == [
+        "crash-restart", "kill", "partition", "pause",
+    ]

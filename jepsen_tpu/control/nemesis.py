@@ -289,8 +289,10 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                  leader_fn=None):
     """Build the nemesis the test opts select: ``partition`` (the
     reference's four strategies via ``network-partition``, plus the
-    targeted ``partition-leader``), or the process faults
-    ``kill-random-node`` / ``pause-random-node``."""
+    targeted ``partition-leader``), the process faults
+    ``kill-random-node`` / ``pause-random-node``, the whole-cluster
+    power failure ``crash-restart-cluster``, or ``mixed`` (the
+    compose soak interleaving the families above)."""
     kind = opts.get("nemesis", "partition")
     if kind == "partition":
         return PartitionNemesis(
@@ -308,13 +310,25 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         # crash-restart joins only when the SUT is durable (a memory-only
         # cluster correctly loses everything on a full-cluster crash, so
         # mixing it in would red a bug-free run)
+        # derived per-member seeds: reproducible under a pinned --seed
+        # WITHOUT lockstep-correlated victim streams (identical seeds
+        # would make kill and pause pick the same node sequence)
+        sub = (
+            None
+            if seed is None
+            else [seed * 4 + i + 1 for i in range(3)]
+        )
         members: dict[str, Any] = {
             "partition": PartitionNemesis(
-                opts["network-partition"], net, nodes, seed=seed,
-                leader_fn=leader_fn,
+                opts["network-partition"], net, nodes,
+                seed=sub and sub[0], leader_fn=leader_fn,
             ),
-            "kill": ProcessNemesis("kill", procs, nodes, seed=seed),
-            "pause": ProcessNemesis("pause", procs, nodes, seed=seed),
+            "kill": ProcessNemesis(
+                "kill", procs, nodes, seed=sub and sub[1]
+            ),
+            "pause": ProcessNemesis(
+                "pause", procs, nodes, seed=sub and sub[2]
+            ),
         }
         if opts.get("durable"):
             members["crash-restart"] = CrashRestartNemesis(procs, nodes)

@@ -23,6 +23,7 @@ from typing import Any, Mapping
 from jepsen_tpu.checkers.perf import Perf
 from jepsen_tpu.checkers.protocol import compose
 from jepsen_tpu.checkers.queue_lin import QueueLinearizability
+from jepsen_tpu.checkers.stats import Stats, UnhandledExceptions
 from jepsen_tpu.checkers.total_queue import TotalQueue
 from jepsen_tpu.client.protocol import QueueClient
 from jepsen_tpu.client.sim import SimCluster, sim_driver_factory
@@ -139,10 +140,20 @@ def queue_checker(
         "queue": TotalQueue(backend=backend),
         "linear": QueueLinearizability(backend=backend, delivery=delivery),
     }
-    if with_perf:
-        checkers["perf"] = Perf()
     if with_timeline:
         checkers["timeline"] = Timeline()
+    return _compose_with_defaults(checkers, with_perf)
+
+
+def _compose_with_defaults(checkers: dict, with_perf: bool = True):
+    """Compose a workload's checkers with the defaults jepsen's runner
+    adds to every test (``stats`` + ``unhandled-exceptions``, plus
+    ``perf`` unless disabled) — one place, so a new workload family
+    cannot silently ship without them."""
+    checkers["stats"] = Stats()
+    checkers["exceptions"] = UnhandledExceptions()
+    if with_perf:
+        checkers["perf"] = Perf()
     return compose(checkers)
 
 
@@ -168,9 +179,7 @@ def stream_checker(backend: str = "tpu", with_perf: bool = True):
     from jepsen_tpu.checkers.stream_lin import StreamLinearizability
 
     checkers = {"stream": StreamLinearizability(backend=backend)}
-    if with_perf:
-        checkers["perf"] = Perf()
-    return compose(checkers)
+    return _compose_with_defaults(checkers, with_perf)
 
 
 def elle_generator(opts: Mapping[str, Any], n_keys: int = 8, seed: int = 0):
@@ -223,9 +232,7 @@ def mutex_checker(backend: str = "tpu", with_perf: bool = True):
     from jepsen_tpu.checkers.wgl import MutexWgl
 
     checkers = {"mutex": MutexWgl(backend=backend)}
-    if with_perf:
-        checkers["perf"] = Perf()
-    return compose(checkers)
+    return _compose_with_defaults(checkers, with_perf)
 
 
 def elle_checker(
@@ -236,9 +243,7 @@ def elle_checker(
     from jepsen_tpu.checkers.elle import ElleListAppend
 
     checkers = {"elle": ElleListAppend(backend=backend, model=model)}
-    if with_perf:
-        checkers["perf"] = Perf()
-    return compose(checkers)
+    return _compose_with_defaults(checkers, with_perf)
 
 
 def build_sim_test(

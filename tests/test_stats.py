@@ -1,0 +1,60 @@
+"""``stats`` / ``unhandled-exceptions``: the default checkers jepsen's
+runner composes into every test (alongside the user's) — success/failure
+rates per op function and the distinct client error classes."""
+
+from jepsen_tpu.checkers.stats import Stats, UnhandledExceptions
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+
+def _h():
+    return [
+        Op(OpType.INVOKE, OpF.ENQUEUE, 0, 1),
+        Op(OpType.OK, OpF.ENQUEUE, 0, 1),
+        Op(OpType.INVOKE, OpF.ENQUEUE, 1, 2),
+        Op(OpType.FAIL, OpF.ENQUEUE, 1, 2, error="conn-reset"),
+        Op(OpType.INVOKE, OpF.DEQUEUE, 0),
+        Op(OpType.INFO, OpF.DEQUEUE, 0, error="timeout"),
+        Op(OpType.INVOKE, OpF.DEQUEUE, 1),
+        Op(OpType.FAIL, OpF.DEQUEUE, 1, error="conn-reset"),
+        # nemesis ops must not count as client outcomes
+        Op(OpType.INFO, OpF.START, NEMESIS_PROCESS, "cut"),
+        Op(OpType.INFO, OpF.STOP, NEMESIS_PROCESS, "heal"),
+    ]
+
+
+def test_stats_counts_completions_per_f():
+    r = Stats().check({}, _h())
+    assert r["valid?"] is True
+    assert r["ok-count"] == 1 and r["fail-count"] == 2
+    assert r["info-count"] == 1 and r["count"] == 4
+    assert r["by-f"]["enqueue"] == {
+        "ok-count": 1, "fail-count": 1, "info-count": 0, "count": 2,
+    }
+    assert r["by-f"]["dequeue"]["info-count"] == 1
+
+
+def test_unhandled_exceptions_groups_error_classes():
+    r = UnhandledExceptions().check({}, _h())
+    assert r["valid?"] is True
+    assert r["exception-count"] == 3
+    assert r["by-error"]["conn-reset"]["count"] == 2
+    assert r["by-error"]["conn-reset"]["example"]["f"] in (
+        "enqueue", "dequeue",
+    )
+    assert r["by-error"]["timeout"]["count"] == 1
+
+
+def test_composed_into_every_suite_checker():
+    """jepsen's runner composes these defaults into every test; the four
+    workload checker builders here do the same."""
+    from jepsen_tpu.suite import (
+        elle_checker,
+        mutex_checker,
+        queue_checker,
+        stream_checker,
+    )
+
+    for build in (queue_checker, stream_checker, elle_checker, mutex_checker):
+        composed = build(backend="cpu", with_perf=False)
+        names = set(composed.checkers)
+        assert {"stats", "exceptions"} <= names, (build.__name__, names)

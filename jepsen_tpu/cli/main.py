@@ -1109,14 +1109,17 @@ def build_parser() -> argparse.ArgumentParser:
             "kill-random-node",
             "pause-random-node",
             "crash-restart-cluster",
+            "clock-skew",
             "mixed",
         ),
         help="fault family: the reference's network partitions (shaped by "
         "--network-partition), process kill/pause of a random node, "
         "the whole-cluster power failure (SIGKILL every node, restart — "
-        "pair with --durable or the checker will rightly flag loss), or "
-        "mixed (the jepsen.nemesis/compose soak: each cycle randomly "
-        "picks partition/kill/pause, plus crash-restart when --durable)",
+        "pair with --durable or the checker will rightly flag loss), "
+        "clock-skew (bump a random node's wall clock ±0.1-3s; not --db "
+        "sim), or mixed (the jepsen.nemesis/compose soak: each cycle "
+        "randomly picks partition/kill/pause/clock-skew, plus "
+        "crash-restart when --durable)",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"

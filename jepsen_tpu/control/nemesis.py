@@ -237,6 +237,49 @@ class CrashRestartNemesis:
             self.down = False
 
 
+class ClockSkewNemesis:
+    """Bump a random node's wall clock off true on ``start`` (±0.1–3 s,
+    seeded); set every bumped clock back on ``stop``.  The
+    ``jepsen.nemesis.time`` family.  A correct quorum SUT shrugs: Raft
+    timers are monotonic, and TTL timestamps ride inside the replicated
+    log, so skew moves *when* a message expires, never *whether* the
+    drain can account for it."""
+
+    def __init__(self, clocks, nodes: Sequence[str],
+                 seed: int | None = None):
+        self.clocks = clocks
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+        self.skewed: list[str] = []
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            victim = self.rng.choice(self.nodes)
+            delta = self.rng.choice((-1, 1)) * self.rng.uniform(0.1, 3.0)
+            self.clocks.bump(victim, delta)
+            self.skewed.append(victim)
+            logger.info("nemesis: clock-bump %s %+.0fms", victim,
+                        delta * 1000)
+            return op.complete(
+                OpType.INFO, value=f"clock-bump {victim} {delta * 1000:+.0f}ms"
+            )
+        if op.f == OpF.STOP:
+            reset, self.skewed = self.skewed, []
+            for node in reset:
+                self.clocks.reset(node)
+            logger.info("nemesis: clocks reset %s", reset)
+            return op.complete(OpType.INFO, value=f"clocks-reset {reset}")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for node in self.skewed:
+            self.clocks.reset(node)
+        self.skewed = []
+
+
 class MixedNemesis:
     """``jepsen.nemesis/compose``'s role: one nemesis that interleaves
     several fault families over the run — each ``start`` picks one
@@ -280,19 +323,20 @@ class MixedNemesis:
 
 NEMESES = (
     "partition", "kill-random-node", "pause-random-node",
-    "crash-restart-cluster", "mixed",
+    "crash-restart-cluster", "clock-skew", "mixed",
 )
 
 
 def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                  nodes: Sequence[str], seed: int | None = None,
-                 leader_fn=None):
+                 leader_fn=None, clocks=None):
     """Build the nemesis the test opts select: ``partition`` (the
     reference's four strategies via ``network-partition``, plus the
     targeted ``partition-leader``), the process faults
     ``kill-random-node`` / ``pause-random-node``, the whole-cluster
-    power failure ``crash-restart-cluster``, or ``mixed`` (the
-    compose soak interleaving the families above)."""
+    power failure ``crash-restart-cluster``, ``clock-skew`` (needs a
+    ``clocks`` surface), or ``mixed`` (the compose soak interleaving
+    the families above)."""
     kind = opts.get("nemesis", "partition")
     if kind == "partition":
         return PartitionNemesis(
@@ -305,6 +349,13 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         return ProcessNemesis("pause", procs, nodes, seed=seed)
     if kind == "crash-restart-cluster":
         return CrashRestartNemesis(procs, nodes)
+    if kind == "clock-skew":
+        if clocks is None:
+            raise ValueError(
+                "clock-skew needs a clocks surface (the sim models no "
+                "wall clocks; use --db local or --db rabbitmq)"
+            )
+        return ClockSkewNemesis(clocks, nodes, seed=seed)
     if kind == "mixed":
         # the soak composition: partitions + process faults interleaved.
         # crash-restart joins only when the SUT is durable (a memory-only
@@ -316,7 +367,7 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         sub = (
             None
             if seed is None
-            else [seed * 4 + i + 1 for i in range(3)]
+            else [seed * 8 + i + 1 for i in range(4)]
         )
         members: dict[str, Any] = {
             "partition": PartitionNemesis(
@@ -330,6 +381,10 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                 "pause", procs, nodes, seed=sub and sub[2]
             ),
         }
+        if clocks is not None:
+            members["clock-skew"] = ClockSkewNemesis(
+                clocks, nodes, seed=sub and sub[3]
+            )
         if opts.get("durable"):
             members["crash-restart"] = CrashRestartNemesis(procs, nodes)
         return MixedNemesis(members, seed=seed)

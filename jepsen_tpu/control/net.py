@@ -96,6 +96,45 @@ class Procs(abc.ABC):
         """SIGCONT a paused DB process."""
 
 
+class Clocks(abc.ABC):
+    """Wall-clock fault surface (``jepsen.nemesis.time``'s role): bump a
+    node's clock off true, and set it back.  A correct quorum system
+    tolerates skew — its election timers are monotonic and its TTL
+    timestamps travel inside the replicated log — which is exactly what
+    the clock nemesis exists to demonstrate (or disprove)."""
+
+    @abc.abstractmethod
+    def bump(self, node: str, delta_s: float) -> None:
+        """Set ``node``'s wall clock to controller-now + ``delta_s``."""
+
+    @abc.abstractmethod
+    def reset(self, node: str) -> None:
+        """Set ``node``'s wall clock back to controller-now."""
+
+
+class TransportClocks(Clocks):
+    """Clock bumps over the command transport: ``date -u -s @EPOCH``
+    (the portable way to set a VM's clock; the local process cluster
+    maps the same command string onto its admin ``CLOCK_SET``)."""
+
+    def __init__(self, transport, nodes):
+        self.transport = transport
+        self.nodes = list(nodes)
+
+    def _set(self, node: str, epoch_s: float) -> None:
+        self.transport.run(node, f"sudo date -u -s @{epoch_s:.3f}")
+
+    def bump(self, node, delta_s):
+        import time as _t
+
+        self._set(node, _t.time() + delta_s)
+
+    def reset(self, node):
+        import time as _t
+
+        self._set(node, _t.time())
+
+
 class SimProcs(Procs):
     """Drives the simulator's down-node set.  Kill and pause coincide in
     the sim (a down node is simply unreachable and votes in no quorum;

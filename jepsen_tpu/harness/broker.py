@@ -1084,6 +1084,21 @@ def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
             elif req == "ROLE" and broker.replication is not None:
                 state, term, hint = broker.replication.raft.role()
                 sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
+            elif req.startswith("CLOCK_SET ") and (
+                broker.replication is not None
+            ):
+                # clock nemesis: "this node's wall clock now reads T"
+                # (epoch ms).  Only the timestamps this node stamps into
+                # replicated ops move — like real skew, monotonic timers
+                # are untouched.
+                target = float(req[len("CLOCK_SET "):])
+                broker.replication.clock_offset_ms = (
+                    target - _time.time() * 1000.0
+                )
+                sock.sendall(b"OK\n")
+            elif req == "CLOCK_GET" and broker.replication is not None:
+                off = broker.replication.clock_offset_ms
+                sock.sendall(f"{off:.3f}\n".encode())
             else:
                 sock.sendall(b"ERR unknown\n")
         except (OSError, ValueError):

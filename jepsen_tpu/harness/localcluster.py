@@ -189,6 +189,28 @@ class LocalProcTransport(Transport):
             return self._list_queues(node)
         if "join_cluster" in inner and self.replicated:
             return self._join_cluster(node, inner)
+        if "date -u -s @" in inner and not self.replicated:
+            # non-replicated mini brokers time TTL on time.monotonic():
+            # a wall-clock bump genuinely cannot reach them, so a green
+            # "tolerates skew" verdict would be a no-fault false green —
+            # same refusal rule as seed_bug on non-replicated clusters
+            return RunResult(
+                1, "",
+                "clock-skew needs a replicated cluster (non-replicated "
+                "mini brokers run TTL on the monotonic clock)",
+            )
+        if "date -u -s @" in inner and self.replicated:
+            # clock nemesis: "set this VM's wall clock to EPOCH" → the
+            # node's admin CLOCK_SET (offset applied to the timestamps
+            # it stamps into replicated ops).  Succeeds vacuously on a
+            # dead node, like iptables — a real VM's clock is settable
+            # whether or not the broker process is up (though HERE a
+            # restarted broker forgets its skew; a real VM would not)
+            epoch_s = float(inner.split("@", 1)[1].split()[0])
+            r = self._admin(node, f"CLOCK_SET {epoch_s * 1000.0:.3f}")
+            if r.rc != 0:
+                return RunResult(0, "", f"(node down: {r.err})")
+            return RunResult(0, "", "")
         if "rabbitmqctl" in inner and " eval " in inner:
             return RunResult(0, "no_local_member", "")
         if inner.startswith("rm -rf ") and "rabbitmq-server" in inner:

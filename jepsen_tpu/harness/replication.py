@@ -1145,6 +1145,14 @@ class ReplicatedBackend:
     ):
         self.machine = QueueMachine()
         self.submit_timeout_s = submit_timeout_s
+        #: wall-clock skew injected by the clock nemesis (ms added to
+        #: this node's view of "now").  Deliberately touches ONLY the
+        #: timestamps this node stamps into ops (TTL enqueue times, DEQ
+        #: expiry "now", the DEPTHS diagnostic view) — Raft election/
+        #: heartbeat timers run on time.monotonic(), which real clock
+        #: skew does not move either.  A correct quorum system tolerates
+        #: wall-clock skew; this is the knob that proves it.
+        self.clock_offset_ms: float = 0.0
         #: called (from the apply path, any thread, possibly holding raft
         #: locks — so implementations must only signal, never re-enter)
         #: whenever an applied entry may have made messages deliverable
@@ -1177,6 +1185,9 @@ class ReplicatedBackend:
             self.on_visible()
         return result
 
+    def _now_ms(self) -> float:
+        return time.time() * 1000.0 + self.clock_offset_ms
+
     # -- queue ops ----------------------------------------------------------
     def declare(self, q, qtype=None, ttl_ms=None, dlx=None) -> None:
         self.raft.submit(
@@ -1192,14 +1203,14 @@ class ReplicatedBackend:
                 "q": q,
                 "body": base64.b64encode(body).decode(),
                 "props": base64.b64encode(props).decode(),
-                "ts": time.time() * 1000.0,
+                "ts": self._now_ms(),
             },
             timeout_s=self.submit_timeout_s,
         )
         return ok
 
     def enqueue_txn(self, items: list[tuple[str, bytes, bytes]]) -> bool:
-        now = time.time() * 1000.0
+        now = self._now_ms()
         ok, _ = self.raft.submit(
             {
                 "k": "txn",
@@ -1224,7 +1235,7 @@ class ReplicatedBackend:
                 "k": "deq",
                 "q": q,
                 "owner": owner,
-                "now": time.time() * 1000.0,
+                "now": self._now_ms(),
             },
             timeout_s=self.submit_timeout_s,
         )
@@ -1288,4 +1299,4 @@ class ReplicatedBackend:
 
     # -- local reads (diagnostics only — NOT the client read path) ----------
     def counts(self) -> dict[str, int]:
-        return self.machine.counts(time.time() * 1000.0)
+        return self.machine.counts(self._now_ms())

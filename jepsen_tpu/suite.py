@@ -27,7 +27,7 @@ from jepsen_tpu.checkers.stats import Stats, UnhandledExceptions
 from jepsen_tpu.checkers.total_queue import TotalQueue
 from jepsen_tpu.client.protocol import QueueClient
 from jepsen_tpu.client.sim import SimCluster, sim_driver_factory
-from jepsen_tpu.control.net import SimNet, SimProcs
+from jepsen_tpu.control.net import SimNet, SimProcs, TransportClocks
 from jepsen_tpu.control.nemesis import make_nemesis
 from jepsen_tpu.control.runner import DB, Test
 from jepsen_tpu.generators.core import (
@@ -386,6 +386,17 @@ def build_rabbitmq_test(
         # reproducible fault schedules when the run pins a seed (mixed-
         # nemesis family picks, partition victim choices)
         seed=(int(o["seed"]) if o.get("seed") is not None else None),
+        # wall-clock fault surface (jepsen.nemesis.time): date-over-
+        # transport; the local cluster maps it to admin CLOCK_SET.
+        # A non-replicated local cluster gets NO clocks surface — its
+        # brokers time TTL monotonically, so a skew "fault" would be a
+        # silent noop and any green verdict a false one (make_nemesis
+        # then refuses clock-skew, and mixed omits the member)
+        clocks=(
+            TransportClocks(transport, nodes)
+            if getattr(transport, "replicated", True)
+            else None
+        ),
     )
     if workload == "stream":
         client = StreamClient(

@@ -1,0 +1,220 @@
+"""Clock-skew nemesis (``jepsen.nemesis.time``'s role).
+
+A correct quorum SUT tolerates wall-clock skew BY CONSTRUCTION: Raft
+election/heartbeat timers run on monotonic clocks, and TTL timestamps
+travel inside the replicated log, so skew moves *when* a message
+expires, never *whether* the drain can account for it.  These tests pin
+the mechanism at each layer and then prove the survivability claim
+end-to-end (dead-letter + skew + partitions on a live cluster).
+"""
+
+import time
+
+import pytest
+
+from jepsen_tpu.harness.replication import ReplicatedBackend
+
+
+def _backend():
+    return ReplicatedBackend(
+        "a",
+        {"a": ("127.0.0.1", 0)},
+        election_timeout=(0.05, 0.1),
+        heartbeat_s=0.02,
+    )
+
+
+def _wait_leader(b, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if b.raft.is_leader():
+            return
+        time.sleep(0.01)
+    raise AssertionError("no leader")
+
+
+def test_skew_shifts_ttl_expiry():
+    """A forward-bumped clock makes this node stamp older-looking
+    timestamps nowhere — it stamps *newer* ones; the DEQ path's skewed
+    "now" is what expires heads early.  Either way the message lands in
+    the dead-letter queue, never nowhere."""
+    b = _backend()
+    try:
+        _wait_leader(b)
+        b.declare("dlq")
+        b.declare("q", ttl_ms=60_000, dlx="dlq")
+        assert b.enqueue("q", b"x", b"") is True
+        assert b.counts()["q"] == 1  # minutes from expiring
+        b.clock_offset_ms = 120_000.0  # jump 2 minutes forward
+        assert b.counts().get("q", 0) == 0  # head expired...
+        assert b.counts()["dlq"] == 1  # ...INTO the dead-letter queue
+        assert b.dequeue("q", "a|c1") is None  # deq performs the expiry
+        m = b.dequeue("dlq", "a|c1")
+        assert m is not None and m.body == b"x"  # nothing vanished
+    finally:
+        b.stop()
+
+
+def test_transport_maps_date_command_to_clock_set(tmp_path):
+    """The exact command string ``TransportClocks`` emits lands as an
+    admin CLOCK_SET on the node's broker process."""
+    from jepsen_tpu.control.net import TransportClocks
+    from jepsen_tpu.harness.localcluster import LocalProcTransport
+
+    t = LocalProcTransport(n_nodes=1, replicated=True)
+    try:
+        node = t.nodes[0]
+        t.run(node, "/tmp/rabbitmq-server/sbin/rabbitmq-server -detached")
+        clocks = TransportClocks(t, t.nodes)
+        clocks.bump(node, 2.5)
+        off = float(t._admin(node, "CLOCK_GET").out)
+        assert 1500 < off < 3500, off  # ~+2.5s minus transit time
+        clocks.reset(node)
+        off = float(t._admin(node, "CLOCK_GET").out)
+        assert abs(off) < 1000, off
+        # a dead node: clock command succeeds vacuously (a VM's clock is
+        # settable whether or not the broker process is up)
+        t.run(node, "killall -q -9 beam.smp epmd || true")
+        r = t.run(node, "sudo date -u -s @12345.0")
+        assert r.rc == 0
+    finally:
+        t.close()
+
+
+def test_clock_skew_nemesis_bumps_and_resets():
+    from jepsen_tpu.control.nemesis import ClockSkewNemesis
+    from jepsen_tpu.history.ops import Op, OpF
+
+    class Log:
+        def __init__(self):
+            self.calls = []
+
+        def bump(self, node, delta_s):
+            self.calls.append(("bump", node, delta_s))
+
+        def reset(self, node):
+            self.calls.append(("reset", node))
+
+    clocks = Log()
+    nodes = ["n1", "n2", "n3"]
+    nem = ClockSkewNemesis(clocks, nodes, seed=5)
+    start = Op.invoke(OpF.START, -1)
+    stop = Op.invoke(OpF.STOP, -1)
+    r = nem.invoke({}, start)
+    assert r.value.startswith("clock-bump ")
+    kind, victim, delta = clocks.calls[0]
+    assert kind == "bump" and victim in nodes
+    assert 0.1 <= abs(delta) <= 3.0
+    nem.invoke({}, stop)
+    assert clocks.calls[-1] == ("reset", victim)
+    # teardown resets a skew left behind by an aborted run
+    nem.invoke({}, start)
+    nem.teardown({})
+    assert clocks.calls[-1][0] == "reset" and not nem.skewed
+
+
+def test_clock_skew_refused_without_a_clocks_surface():
+    """The sim models no wall clocks; a silently-noop clock nemesis
+    would be a false green."""
+    from jepsen_tpu.control.nemesis import make_nemesis
+
+    with pytest.raises(ValueError, match="clocks"):
+        make_nemesis({"nemesis": "clock-skew"}, None, None, ["n1"])
+
+
+def test_clock_skew_refused_on_non_replicated_local_cluster():
+    """Review r4 find: a NON-replicated local cluster times TTL
+    monotonically, so a clock bump cannot reach it — the transport must
+    refuse (rc=1) rather than silently succeed, and the suite assembly
+    must not hand such a transport a clocks surface at all."""
+    from jepsen_tpu.harness.localcluster import (
+        LocalProcTransport,
+        build_local_test,
+    )
+    from jepsen_tpu.suite import DEFAULT_OPTS
+
+    t = LocalProcTransport(n_nodes=1)  # single node: non-replicated
+    try:
+        r = t.run(t.nodes[0], "sudo date -u -s @12345.0")
+        assert r.rc == 1 and "replicated" in r.err
+    finally:
+        t.close()
+    with pytest.raises(ValueError, match="clocks"):
+        test, t2 = build_local_test(
+            {**DEFAULT_OPTS, "nemesis": "clock-skew"}, n_nodes=1,
+        )
+
+
+def test_mixed_gains_clock_member_with_surface():
+    from jepsen_tpu.control.nemesis import MixedNemesis, make_nemesis
+    from jepsen_tpu.control.net import SimProcs
+
+    class NoopClocks:
+        def bump(self, node, delta_s):
+            pass
+
+        def reset(self, node):
+            pass
+
+    nem = make_nemesis(
+        {"nemesis": "mixed", "network-partition": "partition-halves"},
+        None, SimProcs(None), ["n1", "n2"], seed=1, clocks=NoopClocks(),
+    )
+    assert isinstance(nem, MixedNemesis)
+    assert "clock-skew" in nem.members
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    from jepsen_tpu.client import native
+
+    native.load_library().amqp_set_logging(0)
+    return native
+
+
+@pytest.fixture()
+def _reset(native_lib):
+    native_lib.reset(drain_wait_ms=100)
+    yield
+    native_lib.reset(drain_wait_ms=100)
+
+
+def test_skew_survivable_end_to_end_with_dead_letter(_reset):
+    """The survivability claim, live: dead-letter mode (1s TTL — the
+    skew-sensitive config) + clock-skew nemesis on a replicated 3-node
+    cluster.  Skewed clocks move expiry times around; the checker must
+    still account for every acknowledged message (drain reads the DLQ
+    too) — valid verdict, nothing lost."""
+    import tempfile
+
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.suite import DEFAULT_OPTS
+
+    opts = {
+        **DEFAULT_OPTS,
+        "rate": 120.0,
+        "time-limit": 5.0,
+        "time-before-partition": 0.6,
+        "partition-duration": 1.0,
+        "recovery-sleep": 1.5,
+        "publish-confirm-timeout": 2.5,
+        "nemesis": "clock-skew",
+        "dead-letter": True,
+        "seed": 3,
+    }
+    test, t = build_local_test(
+        opts, n_nodes=3, concurrency=4, checker_backend="cpu",
+        store_root=tempfile.mkdtemp(), workload="queue",
+    )
+    try:
+        run = run_test(test)
+    finally:
+        t.close()
+    assert run.results["valid?"] is True, run.results
+    assert run.results["queue"]["lost-count"] == 0
+    bumps = [
+        op for op in run.history
+        if op.value is not None and "clock-bump" in str(op.value)
+    ]
+    assert bumps, "clock nemesis never fired"

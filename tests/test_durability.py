@@ -359,7 +359,9 @@ def test_mixed_fault_soak_on_durable_cluster(_reset):
     import random as _random
 
     rng = _random.Random(1)
-    fams = sorted(["partition", "kill", "pause", "crash-restart"])
+    fams = sorted(
+        ["partition", "kill", "pause", "clock-skew", "crash-restart"]
+    )
     expected = [rng.choice(fams) for _ in fired]
     assert fired and fired == expected, (fired, expected)
 

@@ -1110,6 +1110,7 @@ def build_parser() -> argparse.ArgumentParser:
             "pause-random-node",
             "crash-restart-cluster",
             "clock-skew",
+            "membership-churn",
             "mixed",
         ),
         help="fault family: the reference's network partitions (shaped by "
@@ -1117,9 +1118,11 @@ def build_parser() -> argparse.ArgumentParser:
         "the whole-cluster power failure (SIGKILL every node, restart — "
         "pair with --durable or the checker will rightly flag loss), "
         "clock-skew (bump a random node's wall clock ±0.1-3s; not --db "
-        "sim), or mixed (the jepsen.nemesis/compose soak: each cycle "
-        "randomly picks partition/kill/pause/clock-skew, plus "
-        "crash-restart when --durable)",
+        "sim), membership-churn (kill a node, forget_cluster_node it - "
+        "a real RemoveServer commit - then fresh rejoin on heal; needs "
+        ">=3 nodes), or mixed (the jepsen.nemesis/compose soak: each cycle "
+        "randomly picks partition/kill/pause/clock-skew/membership-churn, "
+        "plus crash-restart when --durable)",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"

@@ -280,6 +280,69 @@ class ClockSkewNemesis:
         self.skewed = []
 
 
+class MembershipNemesis:
+    """Membership churn: on ``start``, SIGKILL a random node and have a
+    survivor ``forget_cluster_node`` it (a real RemoveServer commit —
+    the cluster genuinely shrinks, e.g. 3→2 with a 2/2 majority); on
+    ``stop``, restart the node fresh and ``join_cluster`` it back
+    (AddServer + catch-up).  The operator's shrink/grow lifecycle,
+    exercised under load — membership change mid-traffic is a classic
+    distributed-systems bug surface the static-cluster nemeses never
+    touch.  The target is always stopped before it is forgotten, as
+    real rabbitmqctl requires (a dead node cannot disrupt elections)."""
+
+    def __init__(self, procs, membership, nodes: Sequence[str],
+                 seed: int | None = None):
+        self.procs = procs
+        self.membership = membership
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+        self.out: str | None = None  # the currently-removed node
+        self.forgotten = False
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def _survivor(self, not_node: str) -> str:
+        return next(n for n in self.nodes if n != not_node)
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            if self.out is not None:
+                return op.complete(
+                    OpType.INFO, value=f"still churning {self.out}"
+                )
+            victim = self.rng.choice(self.nodes)
+            self.procs.kill(victim)
+            self.forgotten = self.membership.forget(
+                self._survivor(victim), victim
+            )
+            self.out = victim
+            what = "removed" if self.forgotten else "killed (forget failed)"
+            logger.info("nemesis: membership %s %s", what, victim)
+            return op.complete(OpType.INFO, value=f"{what} {victim}")
+        if op.f == OpF.STOP:
+            if self.out is None:
+                return op.complete(OpType.INFO, value="nothing removed")
+            node, self.out = self.out, None
+            self.procs.restart(node)
+            joined = self.membership.join(node, self._survivor(node))
+            logger.info("nemesis: membership rejoined %s (join ok=%s)",
+                        node, joined)
+            return op.complete(
+                OpType.INFO,
+                value=f"rejoined {node}" if joined
+                else f"restarted {node} (join failed)",
+            )
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        if self.out is not None:
+            node, self.out = self.out, None
+            self.procs.restart(node)
+            self.membership.join(node, self._survivor(node))
+
+
 class MixedNemesis:
     """``jepsen.nemesis/compose``'s role: one nemesis that interleaves
     several fault families over the run — each ``start`` picks one
@@ -323,20 +386,21 @@ class MixedNemesis:
 
 NEMESES = (
     "partition", "kill-random-node", "pause-random-node",
-    "crash-restart-cluster", "clock-skew", "mixed",
+    "crash-restart-cluster", "clock-skew", "membership-churn", "mixed",
 )
 
 
 def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                  nodes: Sequence[str], seed: int | None = None,
-                 leader_fn=None, clocks=None):
+                 leader_fn=None, clocks=None, membership=None):
     """Build the nemesis the test opts select: ``partition`` (the
     reference's four strategies via ``network-partition``, plus the
     targeted ``partition-leader``), the process faults
     ``kill-random-node`` / ``pause-random-node``, the whole-cluster
     power failure ``crash-restart-cluster``, ``clock-skew`` (needs a
-    ``clocks`` surface), or ``mixed`` (the compose soak interleaving
-    the families above)."""
+    ``clocks`` surface), ``membership-churn`` (kill→forget→fresh
+    rejoin; needs a ``membership`` surface), or ``mixed`` (the compose
+    soak interleaving the families above)."""
     kind = opts.get("nemesis", "partition")
     if kind == "partition":
         return PartitionNemesis(
@@ -356,6 +420,19 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                 "wall clocks; use --db local or --db rabbitmq)"
             )
         return ClockSkewNemesis(clocks, nodes, seed=seed)
+    if kind == "membership-churn":
+        if membership is None:
+            raise ValueError(
+                "membership-churn needs a membership surface (a "
+                "replicated cluster with forget/join — use --db local "
+                "multi-node or --db rabbitmq)"
+            )
+        if len(nodes) < 3:
+            raise ValueError(
+                "membership-churn needs >=3 nodes (removing one from a "
+                "2-node cluster leaves no majority to serve)"
+            )
+        return MembershipNemesis(procs, membership, nodes, seed=seed)
     if kind == "mixed":
         # the soak composition: partitions + process faults interleaved.
         # crash-restart joins only when the SUT is durable (a memory-only
@@ -367,7 +444,7 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         sub = (
             None
             if seed is None
-            else [seed * 8 + i + 1 for i in range(4)]
+            else [seed * 8 + i + 1 for i in range(5)]
         )
         members: dict[str, Any] = {
             "partition": PartitionNemesis(
@@ -384,6 +461,10 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         if clocks is not None:
             members["clock-skew"] = ClockSkewNemesis(
                 clocks, nodes, seed=sub and sub[3]
+            )
+        if membership is not None and len(nodes) >= 3:
+            members["membership"] = MembershipNemesis(
+                procs, membership, nodes, seed=sub and sub[4]
             )
         if opts.get("durable"):
             members["crash-restart"] = CrashRestartNemesis(procs, nodes)

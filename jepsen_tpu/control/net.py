@@ -135,6 +135,46 @@ class TransportClocks(Clocks):
         self._set(node, _t.time())
 
 
+class Membership(abc.ABC):
+    """Cluster-membership fault surface: remove a (stopped) node from
+    the cluster and join a fresh one back — the ``rabbitmqctl
+    forget_cluster_node`` / ``join_cluster`` pair, which is how real
+    operators shrink and grow a RabbitMQ cluster."""
+
+    @abc.abstractmethod
+    def forget(self, via_node: str, target: str) -> bool:
+        """On surviving ``via_node``: forget stopped ``target``."""
+
+    @abc.abstractmethod
+    def join(self, node: str, via_node: str) -> bool:
+        """On freshly-booted ``node``: join ``via_node``'s cluster."""
+
+
+class TransportMembership(Membership):
+    """Membership changes as the rabbitmqctl command strings the DB
+    choreography already uses (``db_rabbitmq.py``), run over the
+    transport — the local cluster maps them to real Raft Add/Remove
+    Server commits."""
+
+    def __init__(self, transport, nodes):
+        self.transport = transport
+        self.nodes = list(nodes)
+
+    def forget(self, via_node, target):
+        r = self.transport.run(
+            via_node, f"rabbitmqctl forget_cluster_node rabbit@{target}"
+        )
+        return r.rc == 0
+
+    def join(self, node, via_node):
+        self.transport.run(node, "rabbitmqctl stop_app")
+        r = self.transport.run(
+            node, f"rabbitmqctl join_cluster rabbit@{via_node}"
+        )
+        self.transport.run(node, "rabbitmqctl start_app")
+        return r.rc == 0
+
+
 class SimProcs(Procs):
     """Drives the simulator's down-node set.  Kill and pause coincide in
     the sim (a down node is simply unreachable and votes in no quorum;

@@ -1099,6 +1099,16 @@ def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
             elif req == "CLOCK_GET" and broker.replication is not None:
                 off = broker.replication.clock_offset_ms
                 sock.sendall(f"{off:.3f}\n".encode())
+            elif req.startswith("FORGET ") and (
+                broker.replication is not None
+            ):
+                # rabbitmqctl forget_cluster_node mapping: remove a
+                # (stopped) node from the cluster — RemoveServer via a
+                # cfg entry committed through the log, forwarded to the
+                # leader by any surviving member
+                target = req[len("FORGET "):].strip()
+                ok = broker.replication.raft.request_forget(target)
+                sock.sendall(b"OK\n" if ok else b"ERR forget failed\n")
             else:
                 sock.sendall(b"ERR unknown\n")
         except (OSError, ValueError):

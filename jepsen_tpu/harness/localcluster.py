@@ -189,6 +189,8 @@ class LocalProcTransport(Transport):
             return self._list_queues(node)
         if "join_cluster" in inner and self.replicated:
             return self._join_cluster(node, inner)
+        if "forget_cluster_node" in inner and self.replicated:
+            return self._forget_cluster_node(node, inner)
         if "date -u -s @" in inner and not self.replicated:
             # non-replicated mini brokers time TTL on time.monotonic():
             # a wall-clock bump genuinely cannot reach them, so a green
@@ -448,6 +450,38 @@ class LocalProcTransport(Transport):
             self._nodes[node].booted_once = True  # member now
             return RunResult(0, "", "")
         return RunResult(1, r.out, r.err or "join_cluster failed")
+
+    def _forget_cluster_node(self, node: str, inner: str) -> RunResult:
+        """``rabbitmqctl forget_cluster_node rabbit@X`` run on a
+        SURVIVING node → its admin FORGET (RemoveServer through the
+        leader).  Like real rabbitmqctl, the target must be stopped —
+        forgetting a running node is refused (an alive removed server
+        would disrupt elections; dead ones can't).  On success the
+        target's slate is wiped: a later restart boots OUTSIDE the
+        cluster and must join_cluster afresh."""
+        target = inner.split("forget_cluster_node", 1)[1].strip().split()[0]
+        tname = target[len("rabbit@"):] if target.startswith("rabbit@") \
+            else target
+        tn = self._nodes.get(tname)
+        if tn is None:
+            return RunResult(1, "", f"unknown node {tname!r}")
+        if self.alive(tname):
+            return RunResult(
+                1, "", f"{tname} is running; stop it first "
+                "(rabbitmqctl refuses to forget a running node)"
+            )
+        r = self._admin(node, f"FORGET {tname}", timeout_s=20.0)
+        if r.rc == 0 and r.out.startswith("OK"):
+            tn.booted_once = False  # restart = fresh pending boot
+            if self._data_root is not None:
+                import shutil
+
+                shutil.rmtree(
+                    os.path.join(self._data_root, f"n{tn.port}"),
+                    ignore_errors=True,
+                )
+            return RunResult(0, "", "")
+        return RunResult(1, r.out, r.err or "forget_cluster_node failed")
 
     def _admin(
         self, node: str, line: str, timeout_s: float = 2.0

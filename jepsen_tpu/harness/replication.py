@@ -333,6 +333,7 @@ class RaftNode:
         #: in a leader's config and replication hands it the cfg entry
         self.bootstrap = bootstrap
         self._join_lock = threading.Lock()
+        self._retired = False  # set when a cfg entry removes this node
         self.apply_fn = apply_fn
         self.eto = election_timeout
         self.heartbeat_s = heartbeat_s
@@ -528,8 +529,8 @@ class RaftNode:
         double-enqueue."""
         deadline = time.monotonic() + timeout_s
         while True:
-            if not self._running:
-                return False, None  # stopped (incl. fail-stop): never ack
+            if not self._running or self._retired:
+                return False, None  # stopped/forgotten: never ack
             with self.lock:
                 leader = self.state == LEADER
                 hint = self.leader_hint
@@ -612,6 +613,12 @@ class RaftNode:
             peers = {n: (a[0], int(a[1])) for n, a in cfg.items()}
         else:
             peers = dict(self._initial_peers)
+        # a cfg that excludes US means we were forgotten (RemoveServer):
+        # retire — keep answering RPCs (the remover's commit may still
+        # need our ack under the OLD config) but never campaign or serve
+        # again.  The choreography only forgets stopped nodes, so this
+        # is defense-in-depth, and it reverses if the entry truncates.
+        self._retired = cfg is not None and self.name not in cfg
         peers[self.name] = self.peers[self.name]  # our true bound port
         self.peers = peers
         self.others = [p for p in peers if p != self.name]
@@ -622,9 +629,12 @@ class RaftNode:
             self.last_peer_ok.setdefault(p, now)
 
     def _pending_locked(self) -> bool:
-        """True while this node has no cluster: started non-bootstrap
-        with only itself — it must not campaign (a self-elected 1-node
-        'leader' would confirm unreplicated publishes)."""
+        """True while this node must not campaign: not-yet-joined
+        (non-bootstrap, self-only — a self-elected 1-node 'leader'
+        would confirm unreplicated publishes) or forgotten
+        (RemoveServer took us out of the config)."""
+        if getattr(self, "_retired", False):
+            return True
         return not self.bootstrap and len(self.peers) == 1
 
     def request_join(
@@ -683,6 +693,60 @@ class RaftNode:
                     return {"ok": True}
                 peers = {n: [a[0], a[1]] for n, a in self.peers.items()}
             peers[msg["name"]] = [msg["host"], int(msg["port"])]
+            ok, _ = self.submit({"k": "cfg", "peers": peers}, timeout_s=8.0)
+        return {"ok": bool(ok)}
+
+    def request_forget(self, target: str, timeout_s: float = 12.0) -> bool:
+        """Remove ``target`` from the cluster (``rabbitmqctl
+        forget_cluster_node`` — RemoveServer, §6).  Called on any
+        surviving member; forwarded to the leader.  The choreography
+        only forgets STOPPED nodes (as real RabbitMQ requires — a dead
+        node cannot campaign, which is what makes single-server removal
+        safe without pre-vote machinery)."""
+        msg = {"rpc": "forget_request", "name": target, "from": self.name}
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            resp = self._dispatch_forget_local_or_proxy(msg)
+            if resp.get("ok"):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def _dispatch_forget_local_or_proxy(self, msg: dict) -> dict:
+        with self.lock:
+            leader = self.state == LEADER
+            hint = self.leader_hint
+            hint_addr = self.peers.get(hint) if hint else None
+        if leader:
+            return self._on_forget_request(msg)
+        if hint_addr is not None and hint != self.name:
+            resp = self._rpc_addr(hint_addr, msg, timeout_s=8.0)
+            return resp if resp is not None else {"ok": False}
+        return {"ok": False}
+
+    def _on_forget_request(self, msg: dict) -> dict:
+        target = msg["name"]
+        with self.lock:
+            leader = self.state == LEADER
+            hint = self.leader_hint
+            hint_addr = self.peers.get(hint) if hint else None
+        if not leader:
+            if hint_addr is not None and hint != self.name:
+                resp = self._rpc_addr(hint_addr, msg, timeout_s=8.0)
+                return resp if resp is not None else {"ok": False}
+            return {"ok": False}
+        if target == self.name:
+            # real rabbitmqctl refuses too: run it from another node
+            return {"ok": False, "error": "cannot forget myself"}
+        with self._join_lock:  # same one-change-at-a-time rule as joins
+            with self.lock:
+                if target not in self.peers:
+                    return {"ok": True}  # idempotent
+                peers = {
+                    n: [a[0], a[1]]
+                    for n, a in self.peers.items()
+                    if n != target
+                }
             ok, _ = self.submit({"k": "cfg", "peers": peers}, timeout_s=8.0)
         return {"ok": bool(ok)}
 
@@ -773,6 +837,8 @@ class RaftNode:
             return self._on_client_op(msg)
         if rpc == "join_request":
             return self._on_join_request(msg)
+        if rpc == "forget_request":
+            return self._on_forget_request(msg)
         return {"ok": False, "error": f"unknown rpc {rpc!r}"}
 
     def _on_client_op(self, msg: dict) -> dict:

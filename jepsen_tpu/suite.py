@@ -27,7 +27,12 @@ from jepsen_tpu.checkers.stats import Stats, UnhandledExceptions
 from jepsen_tpu.checkers.total_queue import TotalQueue
 from jepsen_tpu.client.protocol import QueueClient
 from jepsen_tpu.client.sim import SimCluster, sim_driver_factory
-from jepsen_tpu.control.net import SimNet, SimProcs, TransportClocks
+from jepsen_tpu.control.net import (
+    SimNet,
+    SimProcs,
+    TransportClocks,
+    TransportMembership,
+)
 from jepsen_tpu.control.nemesis import make_nemesis
 from jepsen_tpu.control.runner import DB, Test
 from jepsen_tpu.generators.core import (
@@ -394,6 +399,13 @@ def build_rabbitmq_test(
         # then refuses clock-skew, and mixed omits the member)
         clocks=(
             TransportClocks(transport, nodes)
+            if getattr(transport, "replicated", True)
+            else None
+        ),
+        # membership shrink/grow (forget_cluster_node / join_cluster):
+        # same gate — only meaningful where joins are real
+        membership=(
+            TransportMembership(transport, nodes)
             if getattr(transport, "replicated", True)
             else None
         ),

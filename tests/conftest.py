@@ -26,3 +26,24 @@ def cpu_devices():
         "tests expect the 8-device virtual CPU mesh"
     )
     return devs
+
+
+@pytest.fixture(scope="session")
+def native_lib():
+    """The C++ AMQP driver, loaded once and quieted — shared by every
+    live local-cluster test file (the native-driver suites that also
+    BUILD the library define their own richer fixture, which shadows
+    this one)."""
+    from jepsen_tpu.client import native
+
+    native.load_library().amqp_set_logging(0)
+    return native
+
+
+@pytest.fixture()
+def _reset(native_lib):
+    """Fresh driver registry around each live test: the drain once-latch
+    and client list are process-global in the native layer."""
+    native_lib.reset(drain_wait_ms=100)
+    yield
+    native_lib.reset(drain_wait_ms=100)

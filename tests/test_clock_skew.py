@@ -164,19 +164,7 @@ def test_mixed_gains_clock_member_with_surface():
     assert "clock-skew" in nem.members
 
 
-@pytest.fixture(scope="session")
-def native_lib():
-    from jepsen_tpu.client import native
-
-    native.load_library().amqp_set_logging(0)
-    return native
-
-
-@pytest.fixture()
-def _reset(native_lib):
-    native_lib.reset(drain_wait_ms=100)
-    yield
-    native_lib.reset(drain_wait_ms=100)
+# native_lib / _reset fixtures come from conftest.py
 
 
 def test_skew_survivable_end_to_end_with_dead_letter(_reset):

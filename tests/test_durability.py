@@ -212,19 +212,7 @@ def test_wal_failure_fail_stops_the_node(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-@pytest.fixture(scope="session")
-def native_lib():
-    from jepsen_tpu.client import native
-
-    native.load_library().amqp_set_logging(0)
-    return native
-
-
-@pytest.fixture()
-def _reset(native_lib):
-    native_lib.reset(drain_wait_ms=100)
-    yield
-    native_lib.reset(drain_wait_ms=100)
+# native_lib / _reset fixtures come from conftest.py
 
 
 def test_kill_restart_durable_single_node(_reset, native_lib):
@@ -359,9 +347,10 @@ def test_mixed_fault_soak_on_durable_cluster(_reset):
     import random as _random
 
     rng = _random.Random(1)
-    fams = sorted(
-        ["partition", "kill", "pause", "clock-skew", "crash-restart"]
-    )
+    fams = sorted([
+        "partition", "kill", "pause", "clock-skew", "membership",
+        "crash-restart",
+    ])
     expected = [rng.choice(fams) for _ in fired]
     assert fired and fired == expected, (fired, expected)
 

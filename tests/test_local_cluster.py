@@ -23,19 +23,7 @@ from jepsen_tpu.harness.localcluster import LocalProcTransport
 from jepsen_tpu.suite import DEFAULT_OPTS, build_rabbitmq_test
 
 
-@pytest.fixture(scope="session")
-def native_lib():
-    from jepsen_tpu.client import native
-
-    native.load_library().amqp_set_logging(0)
-    return native
-
-
-@pytest.fixture()
-def _reset(native_lib):
-    native_lib.reset(drain_wait_ms=100)
-    yield
-    native_lib.reset(drain_wait_ms=100)
+# native_lib / _reset fixtures come from conftest.py
 
 
 def _fast_db(t, nodes):

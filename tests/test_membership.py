@@ -232,3 +232,194 @@ def test_localcluster_join_cluster_is_real():
         assert r2.out.split()[2] == primary  # leader hint = primary
     finally:
         t.close()
+
+
+# ---------------------------------------------------------------------------
+# RemoveServer: forget_cluster_node + the membership-churn nemesis
+# ---------------------------------------------------------------------------
+
+
+def test_forget_shrinks_cluster_and_it_still_serves():
+    """Kill one member of a joined 3-node cluster, forget it from a
+    survivor: the config commits down to {a,b} and ops keep committing
+    under the SMALLER majority (2/2)."""
+    a = _backend("a", bootstrap=True)
+    b = _backend("b", bootstrap=False)
+    c = _backend("c", bootstrap=False)
+    try:
+        _wait(lambda: a.raft.is_leader(), what="bootstrap leader")
+        addr = ("127.0.0.1", a.raft.port)
+        assert b.raft.request_join(addr) and c.raft.request_join(addr)
+        a.declare("q")
+        assert a.enqueue("q", b"1", b"") is True
+        c.stop()  # the node dies (rabbitmqctl requires it stopped)
+        assert b.raft.request_forget("c") is True  # via a FOLLOWER
+        assert set(a.raft.peers) == {"a", "b"}
+        assert set(b.raft.peers) == {"a", "b"}
+        assert a.enqueue("q", b"2", b"") is True  # 2/2 majority serves
+        # idempotent: forgetting an absent node answers ok
+        assert a.raft.request_forget("c") is True
+        # refusal: the leader will not forget itself
+        assert a.raft.request_forget("a") is False
+    finally:
+        for n in (a, b, c):
+            n.stop()
+
+
+def test_removed_node_retires_defensively():
+    """Defense-in-depth for the API-misuse path (forgetting an ALIVE
+    node): a node that appends a cfg excluding itself retires — no
+    campaigning, no acks — and un-retires if the entry truncates."""
+    n = RaftNode(
+        "a",
+        {"a": ("127.0.0.1", 0), "b": ("127.0.0.1", 1)},
+        lambda i, op: None,
+        election_timeout=(5.0, 9.0),
+    )
+    try:
+        gone = {"k": "cfg", "peers": {"b": ["127.0.0.1", 1]}}
+        n._on_append_entries({
+            "rpc": "append_entries", "term": 1, "from": "b",
+            "prev_idx": 0, "prev_term": 0,
+            "entries": [(1, gone)], "leader_commit": 0,
+        })
+        assert n._retired is True
+        ok, _ = n.submit({"k": "noop"}, timeout_s=0.2)
+        assert ok is False
+        n._on_append_entries({
+            "rpc": "append_entries", "term": 2, "from": "b",
+            "prev_idx": 0, "prev_term": 0,
+            "entries": [(2, {"k": "noop"})], "leader_commit": 0,
+        })
+        assert n._retired is False  # truncation reversed the removal
+    finally:
+        n.stop()
+
+
+def test_localcluster_forget_requires_stopped_node():
+    """The transport mirrors rabbitmqctl: forgetting a RUNNING node is
+    refused; a stopped one is removed and its slate wiped so a restart
+    boots outside the cluster."""
+    from jepsen_tpu.harness.localcluster import LocalProcTransport
+
+    t = LocalProcTransport(n_nodes=3)
+    try:
+        n1, n2, n3 = t.nodes
+        for n in (n1, n2, n3):
+            t.run(n, "/tmp/rabbitmq-server/sbin/rabbitmq-server -detached")
+        t.run(n2, f"rabbitmqctl join_cluster rabbit@{n1}")
+        t.run(n3, f"rabbitmqctl join_cluster rabbit@{n1}")
+        r = t.run(n1, f"rabbitmqctl forget_cluster_node rabbit@{n3}")
+        assert r.rc == 1 and "running" in r.err, r
+        t.run(n3, "killall -q -9 beam.smp epmd || true")
+        r = t.run(n1, f"rabbitmqctl forget_cluster_node rabbit@{n3}")
+        assert r.rc == 0, (r.out, r.err)
+        assert t._nodes[n3].booted_once is False  # fresh boot next time
+        # the survivors still serve: depth query answers on both
+        assert t._admin(n1, "DEPTHS").rc == 0
+        assert t._admin(n2, "DEPTHS").rc == 0
+    finally:
+        t.close()
+
+
+def test_membership_churn_nemesis_cycle():
+    from jepsen_tpu.control.nemesis import MembershipNemesis
+    from jepsen_tpu.history.ops import Op, OpF
+
+    class Procs:
+        def __init__(self):
+            self.calls = []
+
+        def kill(self, n):
+            self.calls.append(("kill", n))
+
+        def restart(self, n):
+            self.calls.append(("restart", n))
+
+    class Mem:
+        def __init__(self):
+            self.calls = []
+
+        def forget(self, via, target):
+            self.calls.append(("forget", via, target))
+            return True
+
+        def join(self, node, via):
+            self.calls.append(("join", node, via))
+            return True
+
+    procs, mem = Procs(), Mem()
+    nodes = ["n1", "n2", "n3"]
+    nem = MembershipNemesis(procs, mem, nodes, seed=2)
+    start = Op.invoke(OpF.START, -1)
+    stop = Op.invoke(OpF.STOP, -1)
+    r = nem.invoke({}, start)
+    assert r.value.startswith("removed ")
+    victim = r.value.split()[-1]
+    assert procs.calls == [("kill", victim)]
+    via = mem.calls[0][1]
+    assert mem.calls == [("forget", via, victim)] and via != victim
+    r = nem.invoke({}, stop)
+    assert r.value == f"rejoined {victim}"
+    assert procs.calls[-1] == ("restart", victim)
+    assert mem.calls[-1] == ("join", victim, via)
+    # teardown restores a removal left behind by an aborted run
+    nem.invoke({}, start)
+    nem.teardown({})
+    assert procs.calls[-1][0] == "restart" and nem.out is None
+
+
+def test_membership_churn_refused_without_surface_or_quorum():
+    from jepsen_tpu.control.nemesis import make_nemesis
+
+    with pytest.raises(ValueError, match="membership"):
+        make_nemesis(
+            {"nemesis": "membership-churn"}, None, None, ["a", "b", "c"]
+        )
+    with pytest.raises(ValueError, match="3 nodes"):
+        make_nemesis(
+            {"nemesis": "membership-churn"}, None, None, ["a", "b"],
+            membership=object(),
+        )
+
+
+# native_lib / _reset fixtures come from conftest.py
+
+
+def test_membership_churn_green_end_to_end(_reset):
+    """The full assembly under membership churn: nodes leave (kill +
+    forget, cluster genuinely shrinks to 2/2) and rejoin fresh
+    (AddServer + catch-up) while clients publish — valid verdict,
+    nothing lost."""
+    import tempfile
+
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.suite import DEFAULT_OPTS
+
+    opts = {
+        **DEFAULT_OPTS,
+        "rate": 120.0,
+        "time-limit": 6.0,
+        "time-before-partition": 0.8,
+        "partition-duration": 1.2,
+        "recovery-sleep": 1.5,
+        "publish-confirm-timeout": 2.5,
+        "nemesis": "membership-churn",
+        "seed": 7,
+    }
+    test, t = build_local_test(
+        opts, n_nodes=3, concurrency=4, checker_backend="cpu",
+        store_root=tempfile.mkdtemp(), workload="queue",
+    )
+    try:
+        run = run_test(test)
+    finally:
+        t.close()
+    assert run.results["valid?"] is True, run.results
+    assert run.results["queue"]["lost-count"] == 0
+    removed = [
+        op for op in run.history
+        if op.value is not None and str(op.value).startswith("removed ")
+    ]
+    assert removed, "membership churn never removed a node"

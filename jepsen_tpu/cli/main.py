@@ -765,10 +765,13 @@ def cmd_matrix(args) -> int:
         from jepsen_tpu.harness.matrix import (
             CI_MATRIX,
             EXTENDED_MATRIX,
+            LOCAL_EXTENDED_MATRIX,
             matrix_cli_flags,
         )
 
         rows = CI_MATRIX + (EXTENDED_MATRIX if args.extended else [])
+        if args.extended and args.db in ("local", "rabbitmq"):
+            rows += LOCAL_EXTENDED_MATRIX
         for line in matrix_cli_flags(rows):
             print(line)
         return 0
@@ -780,6 +783,7 @@ def cmd_matrix(args) -> int:
     from jepsen_tpu.harness.matrix import (
         CI_MATRIX,
         EXTENDED_MATRIX,
+        LOCAL_EXTENDED_MATRIX,
         MatrixRunner,
     )
     from jepsen_tpu.suite import (
@@ -857,6 +861,10 @@ def cmd_matrix(args) -> int:
         return run.results, {"jepsen.queue": cluster.queue_length()}
 
     matrix = CI_MATRIX + (EXTENDED_MATRIX if args.extended else [])
+    if args.extended and args.db in ("local", "rabbitmq"):
+        # clock-skew / membership-churn need fault surfaces the sim
+        # cannot honestly provide (matrix.py LOCAL_EXTENDED_MATRIX)
+        matrix = matrix + LOCAL_EXTENDED_MATRIX
     if args.limit:
         matrix = matrix[: args.limit]
     outcomes = MatrixRunner(run_fn, matrix).run()

@@ -152,27 +152,37 @@ class Membership(abc.ABC):
 
 class TransportMembership(Membership):
     """Membership changes as the rabbitmqctl command strings the DB
-    choreography already uses (``db_rabbitmq.py``), run over the
-    transport — the local cluster maps them to real Raft Add/Remove
-    Server commits."""
+    choreography already uses (``db_rabbitmq.py`` — the archive-path
+    ``CTL``, under ``su``, because the server is installed under /tmp
+    and not on PATH), run over the transport — the local cluster maps
+    them to real Raft Add/Remove Server commits."""
 
     def __init__(self, transport, nodes):
         self.transport = transport
         self.nodes = list(nodes)
 
+    def _ctl(self, node: str, args: str) -> bool:
+        from jepsen_tpu.control.db_rabbitmq import CTL  # lazy: no cycle
+        from jepsen_tpu.control.ssh import Control, RemoteError
+
+        try:
+            Control(self.transport, node).su().exec(shell=f"{CTL} {args}")
+            return True
+        except RemoteError:
+            return False
+
     def forget(self, via_node, target):
-        r = self.transport.run(
-            via_node, f"rabbitmqctl forget_cluster_node rabbit@{target}"
-        )
-        return r.rc == 0
+        return self._ctl(via_node, f"forget_cluster_node rabbit@{target}")
 
     def join(self, node, via_node):
-        self.transport.run(node, "rabbitmqctl stop_app")
-        r = self.transport.run(
-            node, f"rabbitmqctl join_cluster rabbit@{via_node}"
-        )
-        self.transport.run(node, "rabbitmqctl start_app")
-        return r.rc == 0
+        # the documented rejoin procedure for a node forgotten while
+        # down: stop_app → reset (clear its old cluster metadata, or
+        # real rabbitmqctl rejects the join) → join_cluster → start_app
+        self._ctl(node, "stop_app")
+        self._ctl(node, "reset")
+        ok = self._ctl(node, f"join_cluster rabbit@{via_node}")
+        self._ctl(node, "start_app")
+        return ok
 
 
 class SimProcs(Procs):

@@ -111,6 +111,19 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
     ),
 ]
 
+#: extended configs that need fault surfaces the sim cannot honestly
+#: provide (no wall clocks to skew, no real membership to churn) — run
+#: only with ``matrix --db local --extended`` (or a real cluster)
+LOCAL_EXTENDED_MATRIX: list[dict[str, Any]] = [
+    # clock skew × dead-letter: the skew-sensitive config (1 s TTL) —
+    # a correct cluster's TTL rides the replicated log, so nothing
+    # acknowledged may go missing however the clocks move
+    _cfg(duration=10.0, nemesis="clock-skew", **{"dead-letter": True}),
+    # membership churn: kill → forget_cluster_node (real RemoveServer;
+    # the cluster serves at 2/2) → fresh rejoin + catch-up, under load
+    _cfg(duration=10.0, nemesis="membership-churn"),
+]
+
 
 def matrix_opts(cfg: Mapping[str, Any]) -> dict[str, Any]:
     """Translate a matrix row into test opts.  Process-fault rows carry no

@@ -705,24 +705,20 @@ class RaftNode:
         safe without pre-vote machinery)."""
         msg = {"rpc": "forget_request", "name": target, "from": self.name}
         deadline = time.monotonic() + timeout_s
+        accepted = False
         while time.monotonic() < deadline:
-            resp = self._dispatch_forget_local_or_proxy(msg)
-            if resp.get("ok"):
-                return True
-            time.sleep(0.2)
-        return False
-
-    def _dispatch_forget_local_or_proxy(self, msg: dict) -> dict:
-        with self.lock:
-            leader = self.state == LEADER
-            hint = self.leader_hint
-            hint_addr = self.peers.get(hint) if hint else None
-        if leader:
-            return self._on_forget_request(msg)
-        if hint_addr is not None and hint != self.name:
-            resp = self._rpc_addr(hint_addr, msg, timeout_s=8.0)
-            return resp if resp is not None else {"ok": False}
-        return {"ok": False}
+            if not accepted:
+                # _on_forget_request handles both roles: submits when we
+                # are the leader, proxies to the hint when we are not
+                accepted = bool(self._on_forget_request(msg).get("ok"))
+                if not accepted:
+                    time.sleep(0.2)
+                    continue
+            with self.lock:
+                if target not in self.peers:
+                    return True  # the removal replicated back to us too
+            time.sleep(0.05)  # committed at the leader; our copy lags
+        return accepted  # committed cluster-wide even if our view lags
 
     def _on_forget_request(self, msg: dict) -> dict:
         target = msg["name"]

@@ -180,3 +180,22 @@ class TestCiDriverShell:
                 capture_output=True,
             )
             assert r.returncode == 0, (name, r.stderr)
+
+
+def test_local_extended_tier_parses_and_stays_out_of_sim():
+    """clock-skew / membership-churn configs need fault surfaces the sim
+    cannot honestly provide: they parse like every row, ship only with
+    --db local/rabbitmq, and never leak into the sim-safe tiers."""
+    from jepsen_tpu.cli.main import build_parser
+    from jepsen_tpu.harness.matrix import (
+        EXTENDED_MATRIX,
+        LOCAL_EXTENDED_MATRIX,
+        matrix_cli_flags,
+    )
+
+    assert len(LOCAL_EXTENDED_MATRIX) == 2
+    parser = build_parser()
+    for line in matrix_cli_flags(LOCAL_EXTENDED_MATRIX):
+        parser.parse_args(["test"] + line.split())
+    sim_safe = {c.get("nemesis") for c in EXTENDED_MATRIX}
+    assert not sim_safe & {"clock-skew", "membership-churn"}

@@ -1,0 +1,65 @@
+"""``jepsen.checker/log-file-pattern`` equivalent.
+
+Scans the node log files the DB collected into the store
+(``run_dir/nodes/<node>/…`` — the ``db/LogFiles`` scp,
+``control/runner.py``) for a regex that indicates the SUT itself broke
+(crash dumps, segfaults, Erlang ``CRASH REPORT``\\ s): a history can
+look perfectly consistent while a broker was dying and restarting
+underneath, and this is the checker that refuses to call such a run
+healthy.  ``valid?`` is ``False`` when the pattern matches anywhere.
+
+The reference gets this capability from ``[dep: jepsen 0.3.12]`` and
+its CI additionally greps broker logs out-of-band
+(``ci/jepsen-test.sh:126-142``); here it is a first-class opt-in
+checker (``test --log-file-pattern REGEX``).
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+
+from jepsen_tpu.checkers.protocol import Checker
+from jepsen_tpu.history.ops import Op
+
+MAX_MATCHES = 100  # keep the result map readable; count stays exact
+
+
+class LogFilePattern(Checker):
+    name = "log-file-pattern"
+
+    def __init__(self, pattern: str):
+        self.rx = re.compile(pattern)
+        self.pattern = pattern
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        root = (opts or {}).get("out_dir")  # the runner's run_dir
+        matches: list[dict[str, Any]] = []
+        count = 0
+        nodes_dir = Path(root) / "nodes" if root else None
+        if nodes_dir is not None and nodes_dir.is_dir():
+            for f in sorted(p for p in nodes_dir.rglob("*") if p.is_file()):
+                rel = f.relative_to(nodes_dir)
+                text = f.read_text(errors="replace")
+                for lineno, line in enumerate(text.splitlines(), 1):
+                    if self.rx.search(line):
+                        count += 1
+                        if len(matches) < MAX_MATCHES:
+                            matches.append({
+                                "node": rel.parts[0] if rel.parts else "?",
+                                "file": str(rel),
+                                "line": lineno,
+                                "text": line.strip()[:200],
+                            })
+        return {
+            "valid?": count == 0,
+            "pattern": self.pattern,
+            "count": count,
+            "matches": matches,
+        }

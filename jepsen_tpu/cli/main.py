@@ -194,6 +194,18 @@ def cmd_check(args) -> int:
     return _verdict_exit(result[VALID])
 
 
+def _valid_regex(s: str) -> str:
+    """argparse type for user-supplied patterns: a clean usage error
+    instead of a raw re.error traceback mid-run."""
+    import re as _re
+
+    try:
+        _re.compile(s)
+    except _re.error as e:
+        raise argparse.ArgumentTypeError(f"invalid regex {s!r}: {e}")
+    return s
+
+
 def _parse_bool_flag(s: str) -> bool:
     import argparse as _argparse
 
@@ -719,6 +731,15 @@ def cmd_test(args) -> int:
             store_root=args.store,
             workload=args.workload,
         )
+    if getattr(args, "log_file_pattern", None):
+        # jepsen.checker/log-file-pattern: scan the collected node logs
+        # for SUT-crash indicators; a match invalidates the run even
+        # when the history itself looks consistent
+        from jepsen_tpu.checkers.logpattern import LogFilePattern
+
+        test.checker.checkers["log-file-pattern"] = LogFilePattern(
+            args.log_file_pattern
+        )
     monitor = None
     if args.live_check:
         from jepsen_tpu.checkers.live import attach_live_monitor_for
@@ -1099,6 +1120,17 @@ def build_parser() -> argparse.ArgumentParser:
         "is the reference's spelling of partition-random-halves; both "
         "parse), plus the targeted partition-leader (isolate the "
         "current Raft leader; --db local)",
+    )
+    t.add_argument(
+        "--log-file-pattern",
+        default=None,
+        type=_valid_regex,
+        metavar="REGEX",
+        help="scan the node logs collected into the store for this "
+        "pattern (e.g. 'CRASH REPORT|Segmentation fault') and "
+        "invalidate the run on any match — jepsen.checker/"
+        "log-file-pattern; the SUT can be broken even when the "
+        "history looks consistent",
     )
     t.add_argument(
         "--live-check",

@@ -29,9 +29,13 @@ MAX_MATCHES = 100  # keep the result map readable; count stays exact
 class LogFilePattern(Checker):
     name = "log-file-pattern"
 
-    def __init__(self, pattern: str):
+    def __init__(self, pattern: str, out_dir: str | None = None):
         self.rx = re.compile(pattern)
         self.pattern = pattern
+        #: scan root override for re-check paths that call
+        #: ``check({}, history)`` without runner opts (``cmd_check`` —
+        #: same reason Perf/Timeline take an out_dir)
+        self.out_dir = out_dir
 
     def check(
         self,
@@ -39,24 +43,28 @@ class LogFilePattern(Checker):
         history: Sequence[Op],
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
-        root = (opts or {}).get("out_dir")  # the runner's run_dir
+        root = self.out_dir or (opts or {}).get("out_dir")
         matches: list[dict[str, Any]] = []
         count = 0
         nodes_dir = Path(root) / "nodes" if root else None
         if nodes_dir is not None and nodes_dir.is_dir():
             for f in sorted(p for p in nodes_dir.rglob("*") if p.is_file()):
                 rel = f.relative_to(nodes_dir)
-                text = f.read_text(errors="replace")
-                for lineno, line in enumerate(text.splitlines(), 1):
-                    if self.rx.search(line):
-                        count += 1
-                        if len(matches) < MAX_MATCHES:
-                            matches.append({
-                                "node": rel.parts[0] if rel.parts else "?",
-                                "file": str(rel),
-                                "line": lineno,
-                                "text": line.strip()[:200],
-                            })
+                # stream: soak-length broker logs can be huge, and this
+                # runs on the same loaded host as the run itself
+                with f.open(errors="replace") as fh:
+                    for lineno, line in enumerate(fh, 1):
+                        if self.rx.search(line):
+                            count += 1
+                            if len(matches) < MAX_MATCHES:
+                                matches.append({
+                                    "node": (
+                                        rel.parts[0] if rel.parts else "?"
+                                    ),
+                                    "file": str(rel),
+                                    "line": lineno,
+                                    "text": line.strip()[:200],
+                                })
         return {
             "valid?": count == 0,
             "pattern": self.pattern,

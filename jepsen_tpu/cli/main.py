@@ -181,6 +181,18 @@ def cmd_check(args) -> int:
     if getattr(args, "delivery", None) is None:
         args.delivery = prev.get("linear", {}).get("delivery")
     checker = _checker_for(args, out_dir=out_dir, history=history)
+    log_pat = getattr(args, "log_file_pattern", None) or prev.get(
+        "log-file-pattern", {}
+    ).get("pattern")
+    if log_pat:
+        # same no-silent-loosening rule as the levels above: a run the
+        # log scan invalidated must not re-check back to valid just
+        # because the bare re-check forgot the pattern
+        from jepsen_tpu.checkers.logpattern import LogFilePattern
+
+        checker.checkers["log-file-pattern"] = LogFilePattern(
+            log_pat, out_dir=str(out_dir)
+        )
     t0 = time.perf_counter()
     result = checker.check({}, history)
     dt = time.perf_counter() - t0
@@ -999,6 +1011,15 @@ def build_parser() -> argparse.ArgumentParser:
         "(default: the level recorded with the run's results, else "
         "serializable — so re-checking a live run that passed at its "
         "SUT's contractual level doesn't silently tighten it)",
+    )
+    c.add_argument(
+        "--log-file-pattern",
+        default=None,
+        type=_valid_regex,
+        metavar="REGEX",
+        help="re-scan the run's collected node logs for this pattern "
+        "(default: the pattern recorded with the run's results, if "
+        "any — a log-invalidated run must not re-check back to valid)",
     )
     c.add_argument(
         "--delivery",

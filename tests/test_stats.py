@@ -134,3 +134,35 @@ def test_log_file_pattern_cli_wiring(tmp_path):
     )
     assert bad.returncode == 2
     assert "invalid regex" in bad.stderr and "Traceback" not in bad.stderr
+
+
+def test_log_file_pattern_survives_recheck(tmp_path):
+    """Review r4 find: `check` must inherit the run's recorded log
+    pattern (like consistency-model/delivery) — a log-invalidated run
+    must not re-check back to valid because the bare re-check forgot
+    the pattern."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu", "test", "--db", "sim",
+         "--time-limit", "1", "--rate", "50", "--recovery-sleep", "0.2",
+         "--checker", "cpu", "--store", str(tmp_path),
+         "--log-file-pattern", "CRASH REPORT"],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    # seed a crash line into the stored run's (empty) log collection,
+    # as if a broker had crashed and its log had been scp'd in
+    run_dir = (tmp_path / "latest").resolve()
+    (run_dir / "nodes" / "n1").mkdir(parents=True)
+    (run_dir / "nodes" / "n1" / "broker.log").write_text(
+        "CRASH REPORT process exited\n"
+    )
+    chk = subprocess.run(
+        [sys.executable, "-m", "jepsen_tpu", "check", "--checker", "cpu",
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=180,
+    )
+    assert chk.returncode == 1, chk.stdout + chk.stderr  # invalid now
+    assert "Analysis invalid" in chk.stdout

@@ -412,6 +412,16 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
     if kind == "pause-random-node":
         return ProcessNemesis("pause", procs, nodes, seed=seed)
     if kind == "crash-restart-cluster":
+        from jepsen_tpu.control.net import SimProcs
+
+        if isinstance(procs, SimProcs):
+            raise ValueError(
+                "crash-restart-cluster needs real per-node durable state "
+                "(the sim's state is cluster-global, so a whole-cluster "
+                "power failure recovers vacuously — a no-op fault that "
+                "would pass the durability test without testing it); "
+                "use --db local --durable or --db rabbitmq"
+            )
         return CrashRestartNemesis(procs, nodes)
     if kind == "clock-skew":
         if clocks is None:
@@ -466,7 +476,11 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
             members["membership"] = MembershipNemesis(
                 procs, membership, nodes, seed=sub and sub[4]
             )
-        if opts.get("durable"):
+        from jepsen_tpu.control.net import SimProcs
+
+        if opts.get("durable") and not isinstance(procs, SimProcs):
+            # a sim cluster's state is cluster-global: its crash-restart
+            # recovers vacuously, so the member joins only on real procs
             members["crash-restart"] = CrashRestartNemesis(procs, nodes)
         return MixedNemesis(members, seed=seed)
     raise ValueError(f"unknown nemesis {kind!r}; one of {NEMESES}")

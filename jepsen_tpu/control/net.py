@@ -122,7 +122,18 @@ class TransportClocks(Clocks):
         self.nodes = list(nodes)
 
     def _set(self, node: str, epoch_s: float) -> None:
-        self.transport.run(node, f"sudo date -u -s @{epoch_s:.3f}")
+        r = self.transport.run(node, f"sudo date -u -s @{epoch_s:.3f}")
+        if r.rc != 0:
+            # a failed clock set (no sudo, protected clock) must never
+            # silently no-op: the run would then claim "tolerates clock
+            # skew" with no skew ever applied — the false-green-by-
+            # absent-fault class this codebase refuses elsewhere
+            # (advisor r4)
+            raise RuntimeError(
+                f"clock set on {node} failed (rc={r.rc}): "
+                f"{(r.err or r.out).strip()[:200] or 'no output'} — "
+                f"refusing to run a skew test with no actual skew"
+            )
 
     def bump(self, node, delta_s):
         import time as _t

@@ -1052,75 +1052,92 @@ def _serve_admin(broker: MiniAmqpBroker, server: "socket.socket") -> None:
     """One-line admin queries: DEPTHS (rabbitmqctl list_queues stand-in),
     and in replicated mode the per-link partition surface the control
     plane maps iptables rules onto — BLOCK <peer> / UNBLOCK_ALL — plus
-    ROLE for failover observability."""
+    ROLE for failover observability.
+
+    Each accepted connection is served on its own daemon thread: JOIN
+    blocks inside ``request_join``'s retry loop for up to 12–20 s, and a
+    serial accept loop would stall BLOCK/UNBLOCK partition enforcement,
+    DEPTHS drain cross-checks, and ROLE queries behind a mid-run
+    membership rejoin (advisor r4).  The handlers themselves are safe to
+    run concurrently — every broker/raft mutation they reach is
+    lock-protected."""
+    import threading as _threading
+
     while True:
         try:
             sock, _ = server.accept()
         except OSError:
             return
-        try:
-            req = sock.makefile("r").readline().strip()
-            if req == "DEPTHS":
-                sock.sendall(_admin_depths(broker).encode() or b"\n")
-            elif req.startswith("BLOCK ") and broker.replication is not None:
-                broker.replication.raft.block(req[len("BLOCK "):].strip())
-                sock.sendall(b"OK\n")
-            elif req == "UNBLOCK_ALL" and broker.replication is not None:
-                broker.replication.raft.unblock_all()
-                sock.sendall(b"OK\n")
-            elif req.startswith("JOIN ") and broker.replication is not None:
-                # rabbitmqctl join_cluster mapping: ask the cluster at
-                # host:port to add this node (a real Raft AddServer
-                # committed through the log — blocks until the cfg
-                # entry replicates back, so an OK means full member)
-                host, _, port = req[len("JOIN "):].strip().rpartition(":")
-                if not host or not port.isdigit():
-                    sock.sendall(b"ERR bad JOIN address\n")
-                else:
-                    ok = broker.replication.raft.request_join(
-                        (host, int(port))
-                    )
-                    sock.sendall(b"OK\n" if ok else b"ERR join failed\n")
-            elif req == "ROLE" and broker.replication is not None:
-                state, term, hint = broker.replication.raft.role()
-                sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
-            elif req.startswith("CLOCK_SET ") and (
-                broker.replication is not None
-            ):
-                # clock nemesis: "this node's wall clock now reads T"
-                # (epoch ms).  Only the timestamps this node stamps into
-                # replicated ops move — like real skew, monotonic timers
-                # are untouched.
-                target = float(req[len("CLOCK_SET "):])
-                broker.replication.clock_offset_ms = (
-                    target - _time.time() * 1000.0
-                )
-                sock.sendall(b"OK\n")
-            elif req == "CLOCK_GET" and broker.replication is not None:
-                off = broker.replication.clock_offset_ms
-                sock.sendall(f"{off:.3f}\n".encode())
-            elif req.startswith("FORGET ") and (
-                broker.replication is not None
-            ):
-                # rabbitmqctl forget_cluster_node mapping: remove a
-                # (stopped) node from the cluster — RemoveServer via a
-                # cfg entry committed through the log, forwarded to the
-                # leader by any surviving member
-                target = req[len("FORGET "):].strip()
-                ok = broker.replication.raft.request_forget(target)
-                sock.sendall(b"OK\n" if ok else b"ERR forget failed\n")
+        _threading.Thread(
+            target=_serve_admin_conn, args=(broker, sock), daemon=True
+        ).start()
+
+
+def _serve_admin_conn(broker: MiniAmqpBroker, sock: "socket.socket") -> None:
+    try:
+        req = sock.makefile("r").readline().strip()
+        if req == "DEPTHS":
+            sock.sendall(_admin_depths(broker).encode() or b"\n")
+        elif req.startswith("BLOCK ") and broker.replication is not None:
+            broker.replication.raft.block(req[len("BLOCK "):].strip())
+            sock.sendall(b"OK\n")
+        elif req == "UNBLOCK_ALL" and broker.replication is not None:
+            broker.replication.raft.unblock_all()
+            sock.sendall(b"OK\n")
+        elif req.startswith("JOIN ") and broker.replication is not None:
+            # rabbitmqctl join_cluster mapping: ask the cluster at
+            # host:port to add this node (a real Raft AddServer
+            # committed through the log — blocks until the cfg
+            # entry replicates back, so an OK means full member)
+            host, _, port = req[len("JOIN "):].strip().rpartition(":")
+            if not host or not port.isdigit():
+                sock.sendall(b"ERR bad JOIN address\n")
             else:
-                sock.sendall(b"ERR unknown\n")
-        except (OSError, ValueError):
-            # one bad request must never kill the accept loop: this
-            # port carries the drain cross-check AND the partition
-            # enforcement (BLOCK) for the rest of the run
+                ok = broker.replication.raft.request_join(
+                    (host, int(port))
+                )
+                sock.sendall(b"OK\n" if ok else b"ERR join failed\n")
+        elif req == "ROLE" and broker.replication is not None:
+            state, term, hint = broker.replication.raft.role()
+            sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
+        elif req.startswith("CLOCK_SET ") and (
+            broker.replication is not None
+        ):
+            # clock nemesis: "this node's wall clock now reads T"
+            # (epoch ms).  Only the timestamps this node stamps into
+            # replicated ops move — like real skew, monotonic timers
+            # are untouched.
+            target = float(req[len("CLOCK_SET "):])
+            broker.replication.clock_offset_ms = (
+                target - _time.time() * 1000.0
+            )
+            sock.sendall(b"OK\n")
+        elif req == "CLOCK_GET" and broker.replication is not None:
+            off = broker.replication.clock_offset_ms
+            sock.sendall(f"{off:.3f}\n".encode())
+        elif req.startswith("FORGET ") and (
+            broker.replication is not None
+        ):
+            # rabbitmqctl forget_cluster_node mapping: remove a
+            # (stopped) node from the cluster — RemoveServer via a
+            # cfg entry committed through the log, forwarded to the
+            # leader by any surviving member
+            target = req[len("FORGET "):].strip()
+            ok = broker.replication.raft.request_forget(target)
+            sock.sendall(b"OK\n" if ok else b"ERR forget failed\n")
+        else:
+            sock.sendall(b"ERR unknown\n")
+    except (OSError, ValueError):
+        # one bad request must never kill its handler thread loudly;
+        # the accept loop itself is untouched either way — this port
+        # carries the drain cross-check AND the partition enforcement
+        # (BLOCK) for the rest of the run
+        pass
+    finally:
+        try:
+            sock.close()
+        except OSError:
             pass
-        finally:
-            try:
-                sock.close()
-            except OSError:
-                pass
 
 
 def main(argv=None) -> None:

@@ -97,6 +97,21 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
         nemesis="pause-random-node",
         **{"dead-letter": True},
     ),
+]
+
+#: extended configs that need fault surfaces the sim cannot honestly
+#: provide (no wall clocks to skew, no real membership to churn, no
+#: per-node durable state for a power failure to threaten — the sim's
+#: state is cluster-global, so crash-restart would recover vacuously) —
+#: run only with ``matrix --db local --extended`` (or a real cluster)
+LOCAL_EXTENDED_MATRIX: list[dict[str, Any]] = [
+    # clock skew × dead-letter: the skew-sensitive config (1 s TTL) —
+    # a correct cluster's TTL rides the replicated log, so nothing
+    # acknowledged may go missing however the clocks move
+    _cfg(duration=10.0, nemesis="clock-skew", **{"dead-letter": True}),
+    # membership churn: kill → forget_cluster_node (real RemoveServer;
+    # the cluster serves at 2/2) → fresh rejoin + catch-up, under load
+    _cfg(duration=10.0, nemesis="membership-churn"),
     # the power-failure config: whole-cluster SIGKILL + restart against
     # a DURABLE cluster (WAL-recovered Raft) — nothing confirmed may be
     # lost.  `durable` is consumed by the --db local assembly.
@@ -109,19 +124,6 @@ EXTENDED_MATRIX: list[dict[str, Any]] = [
         durable=True,
         partition="random-partition-halves",
     ),
-]
-
-#: extended configs that need fault surfaces the sim cannot honestly
-#: provide (no wall clocks to skew, no real membership to churn) — run
-#: only with ``matrix --db local --extended`` (or a real cluster)
-LOCAL_EXTENDED_MATRIX: list[dict[str, Any]] = [
-    # clock skew × dead-letter: the skew-sensitive config (1 s TTL) —
-    # a correct cluster's TTL rides the replicated log, so nothing
-    # acknowledged may go missing however the clocks move
-    _cfg(duration=10.0, nemesis="clock-skew", **{"dead-letter": True}),
-    # membership churn: kill → forget_cluster_node (real RemoveServer;
-    # the cluster serves at 2/2) → fresh rejoin + catch-up, under load
-    _cfg(duration=10.0, nemesis="membership-churn"),
 ]
 
 

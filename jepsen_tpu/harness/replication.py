@@ -620,13 +620,26 @@ class RaftNode:
         # is defense-in-depth, and it reverses if the entry truncates.
         self._retired = cfg is not None and self.name not in cfg
         peers[self.name] = self.peers[self.name]  # our true bound port
+        prev_others = set(self.others)
         self.peers = peers
         self.others = [p for p in peers if p != self.name]
         now = time.monotonic()
         for p in self.others:
-            self.next_idx.setdefault(p, len(self.log) + 1)
-            self.match_idx.setdefault(p, 0)
-            self.last_peer_ok.setdefault(p, now)
+            if p not in prev_others:
+                # newly learned — or RE-added under the same name after a
+                # forget + wipe: its previous incarnation's match/next
+                # bookkeeping describes a log the fresh node does not
+                # have, so overwrite rather than setdefault (stale
+                # match_idx would otherwise count ghost acks toward
+                # commit, and stale next_idx costs a wasted AppendEntries
+                # round before backoff — advisor r4)
+                self.next_idx[p] = len(self.log) + 1
+                self.match_idx[p] = 0
+                self.last_peer_ok[p] = now
+            else:
+                self.next_idx.setdefault(p, len(self.log) + 1)
+                self.match_idx.setdefault(p, 0)
+                self.last_peer_ok.setdefault(p, now)
 
     def _pending_locked(self) -> bool:
         """True while this node must not campaign: not-yet-joined
@@ -671,6 +684,21 @@ class RaftNode:
             time.sleep(0.05)
         return False
 
+    def _uncommitted_cfg_locked(self) -> bool:
+        """True while a ``cfg`` entry sits appended-but-uncommitted.
+        Single-server membership changes are only safe when each change
+        is anchored to the *committed* config (the known hazard: leaders
+        of different terms appending conflicting cfg entries whose new
+        majorities are disjoint).  The per-leader ``_join_lock`` cannot
+        enforce that across a leadership change, so the Raft layer
+        itself refuses to stack a second change on an uncommitted first
+        (advisor r4); callers retry, and the retry succeeds once the
+        earlier entry commits."""
+        for idx in range(len(self.log), self.commit_idx, -1):
+            if self.log[idx - 1][1].get("k") == "cfg":
+                return True
+        return False
+
     def _on_join_request(self, msg: dict) -> dict:
         with self.lock:
             leader = self.state == LEADER
@@ -691,6 +719,8 @@ class RaftNode:
             with self.lock:    # a time, each from the committed config)
                 if msg["name"] in self.peers:
                     return {"ok": True}
+                if self._uncommitted_cfg_locked():
+                    return {"ok": False}  # retried by request_join
                 peers = {n: [a[0], a[1]] for n, a in self.peers.items()}
             peers[msg["name"]] = [msg["host"], int(msg["port"])]
             ok, _ = self.submit({"k": "cfg", "peers": peers}, timeout_s=8.0)
@@ -738,6 +768,8 @@ class RaftNode:
             with self.lock:
                 if target not in self.peers:
                     return {"ok": True}  # idempotent
+                if self._uncommitted_cfg_locked():
+                    return {"ok": False}  # retried by request_forget
                 peers = {
                     n: [a[0], a[1]]
                     for n, a in self.peers.items()

@@ -148,7 +148,7 @@ class TestMatrixCliParity:
             matrix_cli_flags,
         )
 
-        assert len(CI_MATRIX) == 14 and len(EXTENDED_MATRIX) == 6
+        assert len(CI_MATRIX) == 14 and len(EXTENDED_MATRIX) == 4
         assert not any("--nemesis" in l for l in matrix_cli_flags())
         parser = build_parser()
         for cfg, line in zip(
@@ -193,9 +193,16 @@ def test_local_extended_tier_parses_and_stays_out_of_sim():
         matrix_cli_flags,
     )
 
-    assert len(LOCAL_EXTENDED_MATRIX) == 2
+    assert len(LOCAL_EXTENDED_MATRIX) == 4
     parser = build_parser()
     for line in matrix_cli_flags(LOCAL_EXTENDED_MATRIX):
         parser.parse_args(["test"] + line.split())
+    # the sim-safe tier must carry none of the faults the sim would noop:
+    # no wall clocks (clock-skew), no real membership (churn), no per-node
+    # durable state for a power failure to threaten (crash-restart and the
+    # durable mixed soak — advisor r4: these passed vacuously on sim)
     sim_safe = {c.get("nemesis") for c in EXTENDED_MATRIX}
-    assert not sim_safe & {"clock-skew", "membership-churn"}
+    assert not sim_safe & {
+        "clock-skew", "membership-churn", "crash-restart-cluster", "mixed",
+    }
+    assert not any(c.get("durable") for c in EXTENDED_MATRIX)

@@ -571,19 +571,48 @@ def test_mixed_nemesis_delegates_and_pairs_stop_with_start():
 
 def test_make_nemesis_mixed_membership_follows_durable():
     """--nemesis mixed composes partition/kill/pause; crash-restart joins
-    only when the SUT is durable (a memory-only cluster correctly loses
-    everything on a whole-cluster crash)."""
+    only when the SUT is durable AND has real per-node state — on the sim
+    (cluster-global state) a whole-cluster crash recovers vacuously, so
+    the member stays out even under durable (advisor r4)."""
     from jepsen_tpu.control.nemesis import MixedNemesis, make_nemesis
-    from jepsen_tpu.control.net import SimProcs
+    from jepsen_tpu.control.net import Procs, SimProcs
+
+    class RealProcs(Procs):
+        def kill(self, node): pass
+        def restart(self, node): pass
+        def pause(self, node): pass
+        def resume(self, node): pass
 
     net = IptablesNet(FakeTransport(), NODES)
     base = {"nemesis": "mixed", "network-partition": "partition-halves"}
     nem = make_nemesis(base, net, SimProcs(None), NODES, seed=1)
     assert isinstance(nem, MixedNemesis)
     assert sorted(nem.members) == ["kill", "partition", "pause"]
+    # durable + sim: crash-restart must NOT join (vacuous fault)
     nem2 = make_nemesis(
         {**base, "durable": True}, net, SimProcs(None), NODES, seed=1
     )
-    assert sorted(nem2.members) == [
+    assert sorted(nem2.members) == ["kill", "partition", "pause"]
+    # durable + real procs: crash-restart joins
+    nem3 = make_nemesis(
+        {**base, "durable": True}, net, RealProcs(), NODES, seed=1
+    )
+    assert sorted(nem3.members) == [
         "crash-restart", "kill", "partition", "pause",
     ]
+
+
+def test_make_nemesis_refuses_crash_restart_on_sim():
+    """Standalone crash-restart-cluster on SimProcs raises instead of
+    running a power-failure test that cannot fail (the no-silent-noop-
+    fault rule that already gates clock-skew and membership-churn)."""
+    import pytest
+
+    from jepsen_tpu.control.nemesis import make_nemesis
+    from jepsen_tpu.control.net import SimProcs
+
+    net = IptablesNet(FakeTransport(), NODES)
+    with pytest.raises(ValueError, match="vacuously"):
+        make_nemesis(
+            {"nemesis": "crash-restart-cluster"}, net, SimProcs(None), NODES
+        )

@@ -332,3 +332,89 @@ class TestDeadOwnerRequeue:
             assert redelivered is not None and redelivered.body == b"v"
         finally:
             _shutdown(nodes)
+
+
+class TestMembershipSafety:
+    """Advisor r4: the two Raft-layer membership hardenings — re-added
+    peers must not inherit their previous incarnation's replication
+    bookkeeping, and a second cfg change must not stack on an
+    appended-but-uncommitted first (single-server-change anchoring)."""
+
+    def _node(self):
+        from jepsen_tpu.harness.replication import RaftNode
+
+        n = RaftNode(
+            "a",
+            {"a": ("127.0.0.1", 0), "b": ("127.0.0.1", 1), },
+            apply_fn=lambda i, op: None,
+        )
+        n.stop()  # pure state-machine tests: no live RPC needed
+        return n
+
+    def test_readded_peer_bookkeeping_resets(self):
+        n = self._node()
+        with n.lock:
+            # leader-side view: b fully caught up at log length 5
+            n.log = [(1, {"k": "x"})] * 5
+            n.commit_idx = 5
+            n.next_idx["b"] = 6
+            n.match_idx["b"] = 5
+            # forget b (cfg without it), then re-add a fresh b
+            n.log.append((1, {"k": "cfg", "peers": {
+                "a": ["127.0.0.1", n.port],
+            }}))
+            n._recompute_config_locked()
+            assert n.others == []
+            n.log.append((1, {"k": "cfg", "peers": {
+                "a": ["127.0.0.1", n.port], "b": ["127.0.0.1", 1],
+            }}))
+            n._recompute_config_locked()
+            assert n.others == ["b"]
+            # the wiped-and-rejoined b has NONE of our log: stale
+            # match_idx=5 would count ghost acks toward commit
+            assert n.match_idx["b"] == 0
+            assert n.next_idx["b"] == len(n.log) + 1
+
+    def test_unchanged_peer_bookkeeping_survives_recompute(self):
+        n = self._node()
+        with n.lock:
+            n.log = [(1, {"k": "x"})] * 3
+            n.next_idx["b"] = 2  # mid-backoff: must NOT reset
+            n.match_idx["b"] = 1
+            n.log.append((1, {"k": "cfg", "peers": {
+                "a": ["127.0.0.1", n.port], "b": ["127.0.0.1", 1],
+                "c": ["127.0.0.1", 2],
+            }}))
+            n._recompute_config_locked()
+            assert n.match_idx["b"] == 1 and n.next_idx["b"] == 2
+            assert n.match_idx["c"] == 0  # new peer seeded fresh
+
+    def test_uncommitted_cfg_blocks_second_change(self):
+        n = self._node()
+        with n.lock:
+            n.log = [(1, {"k": "x"}), (1, {"k": "cfg", "peers": {
+                "a": ["127.0.0.1", n.port], "b": ["127.0.0.1", 1],
+            }})]
+            n.commit_idx = 1  # the cfg entry is appended, not committed
+            assert n._uncommitted_cfg_locked()
+            n.commit_idx = 2
+            assert not n._uncommitted_cfg_locked()
+
+    def test_join_refused_while_cfg_uncommitted(self):
+        n = self._node()
+        with n.lock:
+            n.state = "leader"
+            n.log = [(1, {"k": "cfg", "peers": {
+                "a": ["127.0.0.1", n.port], "b": ["127.0.0.1", 1],
+            }})]
+            n.commit_idx = 0
+            n._recompute_config_locked()
+        resp = n._on_join_request({
+            "rpc": "join_request", "name": "c",
+            "host": "127.0.0.1", "port": 2, "from": "c",
+        })
+        assert resp == {"ok": False}
+        resp = n._on_forget_request(
+            {"rpc": "forget_request", "name": "b", "from": "a"}
+        )
+        assert resp == {"ok": False}

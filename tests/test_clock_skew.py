@@ -183,7 +183,7 @@ def test_skew_survivable_end_to_end_with_dead_letter(_reset):
     too) — valid verdict, nothing lost."""
     import tempfile
 
-    from jepsen_tpu.control.runner import run_test
+    from _live import run_live_with_triage
     from jepsen_tpu.harness.localcluster import build_local_test
     from jepsen_tpu.suite import DEFAULT_OPTS
 
@@ -199,18 +199,38 @@ def test_skew_survivable_end_to_end_with_dead_letter(_reset):
         "dead-letter": True,
         "seed": 3,
     }
-    test, t = build_local_test(
-        opts, n_nodes=3, concurrency=4, checker_backend="cpu",
-        store_root=tempfile.mkdtemp(), workload="queue",
-    )
-    try:
-        run = run_test(test)
-    finally:
-        t.close()
-    assert run.results["valid?"] is True, run.results
-    assert run.results["queue"]["lost-count"] == 0
-    bumps = [
-        op for op in run.history
-        if op.value is not None and "clock-bump" in str(op.value)
-    ]
-    assert bumps, "clock nemesis never fired"
+
+    def build():
+        return build_local_test(
+            opts, n_nodes=3, concurrency=4, checker_backend="cpu",
+            store_root=tempfile.mkdtemp(), workload="queue",
+        )
+
+    def checks(run):
+        assert run.results["queue"]["lost-count"] == 0
+        bumps = [
+            op for op in run.history
+            if op.value is not None and "clock-bump" in str(op.value)
+        ]
+        assert bumps, "clock nemesis never fired"
+
+    run_live_with_triage(build, expect="valid", checks=checks)
+
+
+def test_transport_clocks_raise_on_failed_clock_set():
+    """A failing `sudo date` (no sudo, protected clock) must never
+    silently no-op: the run would claim 'tolerates clock skew' with no
+    skew ever applied (advisor r4 — the false-green-by-absent-fault
+    class).  TransportClocks raises on nonzero rc for bump AND reset."""
+    from jepsen_tpu.control.net import TransportClocks
+    from jepsen_tpu.control.ssh import RunResult
+
+    class NoSudoTransport:
+        def run(self, node, cmd, timeout=None):
+            return RunResult(1, "", "sudo: a password is required")
+
+    clocks = TransportClocks(NoSudoTransport(), ["n1"])
+    with pytest.raises(RuntimeError, match="no actual skew"):
+        clocks.bump("n1", 2.0)
+    with pytest.raises(RuntimeError, match="no actual skew"):
+        clocks.reset("n1")

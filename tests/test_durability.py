@@ -246,10 +246,9 @@ def test_kill_restart_durable_single_node(_reset, native_lib):
 # ---------------------------------------------------------------------------
 
 
-def _crash_restart_run(seed_bug):
-    """One full suite run on a durable replicated 3-node cluster with the
-    whole-cluster crash-restart nemesis; returns (results, history)."""
-    from jepsen_tpu.control.runner import run_test
+def _crash_restart_build(seed_bug):
+    """Builder for one durable replicated 3-node cluster with the
+    whole-cluster crash-restart nemesis (fresh per triage attempt)."""
     from jepsen_tpu.harness.localcluster import build_local_test
     from jepsen_tpu.suite import DEFAULT_OPTS
 
@@ -263,7 +262,7 @@ def _crash_restart_run(seed_bug):
         "publish-confirm-timeout": 2.5,
         "nemesis": "crash-restart-cluster",
     }
-    test, t = build_local_test(
+    return build_local_test(
         opts,
         n_nodes=3,
         concurrency=4,
@@ -273,31 +272,31 @@ def _crash_restart_run(seed_bug):
         seed_bug=seed_bug,
         durable=True,
     )
-    try:
-        run = run_test(test)
-        return run.results, run.history
-    finally:
-        t.close()
 
 
 def test_cluster_power_failure_green_when_durable(_reset):
     """Jepsen's classic power-failure test: SIGKILL every node mid-run,
     restart, drain.  A durable cluster loses nothing confirmed — valid
-    verdict, zero lost."""
-    results, history = _crash_restart_run(seed_bug=None)
-    assert results["valid?"] is True, results
-    assert results["queue"]["lost-count"] == 0
-    # the crash actually happened: a nemesis START recorded the kill
-    from jepsen_tpu.history.ops import NEMESIS_PROCESS, OpF, OpType
+    verdict, zero lost.  Triage-retried (tests/_live.py)."""
+    from _live import run_live_with_triage
 
-    crashes = [
-        op for op in history
-        if op.process == NEMESIS_PROCESS
-        and op.f == OpF.START
-        and op.type == OpType.INFO
-        and "crashed" in str(op.value)
-    ]
-    assert crashes, "crash-restart nemesis never fired"
+    def checks(run):
+        assert run.results["queue"]["lost-count"] == 0
+        # the crash actually happened: a nemesis START recorded the kill
+        from jepsen_tpu.history.ops import NEMESIS_PROCESS, OpF, OpType
+
+        crashes = [
+            op for op in run.history
+            if op.process == NEMESIS_PROCESS
+            and op.f == OpF.START
+            and op.type == OpType.INFO
+            and "crashed" in str(op.value)
+        ]
+        assert crashes, "crash-restart nemesis never fired"
+
+    run_live_with_triage(
+        lambda: _crash_restart_build(None), expect="valid", checks=checks
+    )
 
 
 def test_mixed_fault_soak_on_durable_cluster(_reset):
@@ -305,8 +304,8 @@ def test_mixed_fault_soak_on_durable_cluster(_reset):
     whole-cluster power failures randomly interleaved over one run
     against a durable replicated cluster — recovery paths no
     single-family run reaches (e.g. a kill landing mid-heal).  A correct
-    durable cluster survives all of it: valid verdict, nothing lost."""
-    from jepsen_tpu.control.runner import run_test
+    durable cluster survives all of it: valid verdict, nothing lost.
+    Triage-retried (tests/_live.py)."""
     from jepsen_tpu.harness.localcluster import build_local_test
     from jepsen_tpu.history.ops import NEMESIS_PROCESS, OpF, OpType
     from jepsen_tpu.suite import DEFAULT_OPTS
@@ -323,36 +322,38 @@ def test_mixed_fault_soak_on_durable_cluster(_reset):
         "durable": True,
         "seed": 1,  # family prefix: kill, crash-restart, partition, …
     }
-    test, t = build_local_test(
-        opts, n_nodes=3, concurrency=4, checker_backend="cpu",
-        store_root=tempfile.mkdtemp(), workload="queue", durable=True,
-    )
-    try:
-        run = run_test(test)
-    finally:
-        t.close()
-    assert run.results["valid?"] is True, run.results
-    assert run.results["queue"]["lost-count"] == 0
-    fired = [
-        str(op.value).split(":")[0]
-        for op in run.history
-        if op.process == NEMESIS_PROCESS
-        and op.f == OpF.START
-        and op.type == OpType.INFO
-        and op.value is not None  # completions only (invocations pair)
-    ]
-    # the seeded family sequence is deterministic; how many cycles fit
-    # the window is wall-clock — so assert the PREFIX, not a count
-    # (review r4: a loaded host may fit a single cycle)
-    import random as _random
+    from _live import run_live_with_triage
 
-    rng = _random.Random(1)
-    fams = sorted([
-        "partition", "kill", "pause", "clock-skew", "membership",
-        "crash-restart",
-    ])
-    expected = [rng.choice(fams) for _ in fired]
-    assert fired and fired == expected, (fired, expected)
+    def build():
+        return build_local_test(
+            opts, n_nodes=3, concurrency=4, checker_backend="cpu",
+            store_root=tempfile.mkdtemp(), workload="queue", durable=True,
+        )
+
+    def checks(run):
+        assert run.results["queue"]["lost-count"] == 0
+        fired = [
+            str(op.value).split(":")[0]
+            for op in run.history
+            if op.process == NEMESIS_PROCESS
+            and op.f == OpF.START
+            and op.type == OpType.INFO
+            and op.value is not None  # completions only (invocations pair)
+        ]
+        # the seeded family sequence is deterministic; how many cycles
+        # fit the window is wall-clock — so assert the PREFIX, not a
+        # count (review r4: a loaded host may fit a single cycle)
+        import random as _random
+
+        rng = _random.Random(1)
+        fams = sorted([
+            "partition", "kill", "pause", "clock-skew", "membership",
+            "crash-restart",
+        ])
+        expected = [rng.choice(fams) for _ in fired]
+        assert fired and fired == expected, (fired, expected)
+
+    run_live_with_triage(build, expect="valid", checks=checks)
 
 
 def test_seeded_ack_before_fsync_caught_end_to_end(_reset):
@@ -361,9 +362,13 @@ def test_seeded_ack_before_fsync_caught_end_to_end(_reset):
     partition can expose this; the whole-cluster crash does — confirmed
     writes vanish on recovery and total-queue must flag them LOST,
     through the full live assembly."""
-    for _attempt in range(3):  # scheduling variance on a loaded host
-        results, _ = _crash_restart_run(seed_bug="ack-before-fsync")
-        if not results["valid?"]:
-            break
-    assert results["valid?"] is False, results
-    assert results["queue"]["lost-count"] > 0, results["queue"]
+    from _live import run_live_with_triage
+
+    def checks(run):
+        assert run.results["queue"]["lost-count"] > 0, run.results["queue"]
+
+    run_live_with_triage(
+        lambda: _crash_restart_build("ack-before-fsync"),
+        expect="invalid",
+        checks=checks,
+    )

@@ -1,0 +1,150 @@
+"""Unit tests for the live-test retry/triage harness (tests/_live.py).
+
+The harness itself must be trustworthy: flake retries may never launder
+a genuine red into a green (and vice versa), and an exhausted retry
+budget must fail loudly *naming the invalidating checker* — VERDICT r4
+weak #2's exact complaint about the bare ``assert valid?``.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from _live import describe_invalid, run_live_with_triage
+
+
+class _Run:
+    def __init__(self, results, history=()):
+        self.results = results
+        self.history = list(history)
+
+
+class _Transport:
+    def __init__(self, log):
+        self.log = log
+
+    def close(self):
+        self.log.append("close")
+
+
+def _harness(monkeypatch, outcomes):
+    """Patch run_test to pop scripted outcomes (a results map, or an
+    exception to raise); returns (build_fn, log)."""
+    import jepsen_tpu.control.runner as runner
+
+    log: list = []
+    seq = iter(outcomes)
+
+    def fake_run_test(test):
+        log.append("run")
+        out = next(seq)
+        if isinstance(out, Exception):
+            raise out
+        return _Run(out)
+
+    monkeypatch.setattr(runner, "run_test", fake_run_test)
+
+    def build():
+        log.append("build")
+        return object(), _Transport(log)
+
+    return build, log
+
+
+GREEN = {"valid?": True, "queue": {"valid?": True, "lost-count": 0,
+                                   "attempt-count": 50, "ok-count": 40}}
+RED = {"valid?": False,
+       "queue": {"valid?": False, "lost-count": 3, "attempt-count": 50,
+                 "ok-count": 40, "lost": ["q_1", "q_2", "q_3"]},
+       "stats": {"valid?": True}}
+NEVER_READ = {"valid?": False,
+              "queue": {"valid?": False, "lost-count": 50,
+                        "attempt-count": 50, "ok-count": 0}}
+
+
+def test_green_first_attempt_builds_once(monkeypatch):
+    build, log = _harness(monkeypatch, [GREEN])
+    run = run_live_with_triage(build, expect="valid")
+    assert run.results["valid?"] is True
+    assert log == ["build", "run", "close"]
+
+
+def test_flaky_red_retries_then_green(monkeypatch):
+    """The scheduler-pressure case: one invalid attempt, then green —
+    a fresh cluster per attempt, transports always closed."""
+    build, log = _harness(monkeypatch, [RED, GREEN])
+    run = run_live_with_triage(build, expect="valid")
+    assert run.results["valid?"] is True
+    assert log == ["build", "run", "close", "build", "run", "close"]
+
+
+def test_persistent_red_fails_naming_the_checker(monkeypatch):
+    """A genuine violation survives the retry budget and the failure
+    message carries the invalidating checker + anomaly counts."""
+    build, log = _harness(monkeypatch, [RED, RED, RED])
+    with pytest.raises(AssertionError) as e:
+        run_live_with_triage(build, expect="valid")
+    msg = str(e.value)
+    assert "queue" in msg and "lost-count" in msg and "3" in msg
+    assert msg.count("analysis invalid") == 3
+    assert log.count("close") == 3  # every attempt's cluster torn down
+
+
+def test_final_read_missing_retries_not_triaged_as_red(monkeypatch):
+    """'Set was never read': ok-count == 0 cannot attest loss — retry,
+    even though the verdict also says invalid (the reference's triage
+    order, matrix.py _final_read_missing)."""
+    build, log = _harness(monkeypatch, [NEVER_READ, GREEN])
+    run = run_live_with_triage(build, expect="valid")
+    assert run.results["valid?"] is True
+
+
+def test_crash_retries(monkeypatch):
+    build, log = _harness(monkeypatch, [RuntimeError("boom"), GREEN])
+    run = run_live_with_triage(build, expect="valid")
+    assert run.results["valid?"] is True
+    assert log.count("close") == 2
+
+
+def test_expect_invalid_returns_first_red(monkeypatch):
+    build, log = _harness(monkeypatch, [RED])
+    run = run_live_with_triage(build, expect="invalid")
+    assert run.results["valid?"] is False
+
+
+def test_expect_invalid_never_laundered_by_green_flake(monkeypatch):
+    """A seeded-bug test that keeps coming back green must FAIL — the
+    bug should have been caught."""
+    build, log = _harness(monkeypatch, [GREEN, GREEN, GREEN])
+    with pytest.raises(AssertionError, match="should have gone red"):
+        run_live_with_triage(build, expect="invalid")
+
+
+def test_checks_failure_is_retryable(monkeypatch):
+    build, log = _harness(monkeypatch, [GREEN, GREEN])
+    calls = []
+
+    def checks(run):
+        calls.append(1)
+        if len(calls) == 1:
+            raise AssertionError("nemesis never fired")
+
+    run = run_live_with_triage(build, expect="valid", checks=checks)
+    assert len(calls) == 2
+
+
+def test_unknown_verdict_retries(monkeypatch):
+    build, log = _harness(
+        monkeypatch, [{"valid?": "unknown", "queue": {"ok-count": 5,
+                                                      "attempt-count": 9}},
+                      GREEN],
+    )
+    run = run_live_with_triage(build, expect="valid")
+    assert run.results["valid?"] is True
+
+
+def test_describe_invalid_names_checkers_and_counts():
+    bad = describe_invalid(RED)
+    assert set(bad) == {"queue"}  # stats was valid
+    assert bad["queue"]["lost-count"] == 3
+    assert bad["queue"]["lost-len"] == 3

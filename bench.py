@@ -258,14 +258,21 @@ def _bench_stream_sized(
     )
     del variants
 
+    # CPU baseline on a pinned-floor sample: a 2-history sample made the
+    # stream_10k denominator noise (VERDICT r4 weak #6) — repeat over
+    # the base set until >= cpu_samples checks ran
+    n_cpu = 0
     t = time.perf_counter()
-    for sh in base[:cpu_samples]:
-        check_stream_lin_cpu(sh.ops)
-    cpu_rate = cpu_samples / (time.perf_counter() - t)
+    while n_cpu < cpu_samples:
+        for sh in base[: cpu_samples - n_cpu]:
+            check_stream_lin_cpu(sh.ops)
+        n_cpu += min(len(base), cpu_samples - n_cpu)
+    cpu_rate = n_cpu / (time.perf_counter() - t)
     print(
         f"# {key}: batch={big.batch} ops={n_ops} "
         f"device={rate:.0f} hist/s (best {dt * 1e3:.1f}ms) "
-        f"cpu={cpu_rate:.1f} hist/s speedup={rate / cpu_rate:.1f}x",
+        f"cpu={cpu_rate:.1f} hist/s (n={n_cpu}) "
+        f"speedup={rate / cpu_rate:.1f}x",
         file=sys.stderr,
     )
     details[key] = {
@@ -273,8 +280,30 @@ def _bench_stream_sized(
         "ops": n_ops,
         "device_histories_per_sec": round(rate, 1),
         "cpu_histories_per_sec": round(cpu_rate, 2),
+        "cpu_sample_count": n_cpu,
         "speedup": round(rate / cpu_rate, 1),
     }
+
+    # honest fresh-history rates: bytes -> explode (C++ vs Python) ->
+    # pack -> device (VERDICT r4 weak #3)
+    from jepsen_tpu.checkers.stream_lin import _stream_rows, pack_stream_rows
+    from jepsen_tpu.history.fastpack import stream_rows_file
+    from jepsen_tpu.history.store import read_history
+
+    details[key].update(_end_to_end_rates(
+        base,
+        rate,
+        native_fn=stream_rows_file,
+        python_fn=lambda p: _stream_rows(read_history(p)),
+        pack_fn=pack_stream_rows,
+    ))
+    e = details[key]
+    print(
+        f"# {key} end-to-end: "
+        f"native={e['end_to_end_histories_per_sec']:.0f} hist/s "
+        f"python={e['end_to_end_histories_per_sec_python']:.0f} hist/s",
+        file=sys.stderr,
+    )
 
 
 def _bench_stream(details: dict) -> None:
@@ -293,8 +322,71 @@ def _bench_stream_long(details: dict) -> None:
     _bench_stream_sized(
         details, "stream_10k", 10_000, STREAM_LONG_BATCH, blocks,
         base_n=1 + blocks * BLOCK_ITERS + 1,
-        cpu_samples=2,  # 10k-op CPU reference checks are slow
+        # >= 30 slow (~95 ms) checks: the 210,519x headline must not
+        # divide by a 2-sample denominator (VERDICT r4 weak #6)
+        cpu_samples=30,
     )
+
+
+def _write_tmp_histories(td: str, base) -> list[str]:
+    from jepsen_tpu.history.store import write_history_jsonl
+
+    files = []
+    for i, sh in enumerate(base):
+        p = os.path.join(td, f"h{i}.jsonl")
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+def _end_to_end_rates(
+    base, device_rate: float, native_fn, python_fn, pack_fn
+) -> dict:
+    """Honest fresh-history rates (VERDICT r4 weak #3: the device number
+    alone measured cycle-search-only, while a fresh history still pays
+    host substrate).  Measures the FULL path from history BYTES: JSONL
+    parse + inference/explosion (native C++ vs Python twin) + pack,
+    then combines with the measured per-history device cost:
+
+        end_to_end = 1 / (substrate_per_hist + pack_per_hist + 1/rate)
+
+    ``native_fn(path)``/``python_fn(path)`` produce one history's checker
+    substrate from its file; ``pack_fn(list)`` builds the device batch."""
+    import tempfile
+
+    n = len(base)
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, base)
+        t = time.perf_counter()
+        subs = [native_fn(p) for p in files]
+        t_native = time.perf_counter() - t
+        native_ok = all(s is not None for s in subs)
+        t = time.perf_counter()
+        subs_py = [python_fn(p) for p in files]
+        t_py = time.perf_counter() - t
+    if not native_ok:
+        subs = subs_py  # fallback content; rate reported as python's
+    t = time.perf_counter()
+    pack_fn(subs)
+    t_pack = time.perf_counter() - t
+    device_per = 1.0 / device_rate
+    pack_per = t_pack / n
+    e2e = lambda sub_t: 1.0 / (sub_t / n + pack_per + device_per)
+    out = {
+        "host_substrate_ms_per_history_python": round(t_py / n * 1e3, 3),
+        "end_to_end_histories_per_sec_python": round(e2e(t_py), 1),
+    }
+    if native_ok:
+        out["host_substrate_ms_per_history_native"] = round(
+            t_native / n * 1e3, 3
+        )
+        out["end_to_end_histories_per_sec"] = round(e2e(t_native), 1)
+    else:
+        out["end_to_end_histories_per_sec"] = out[
+            "end_to_end_histories_per_sec_python"
+        ]
+        out["native_substrate"] = "unavailable (fell back)"
+    return out
 
 
 def _bench_elle(details: dict) -> None:
@@ -341,6 +433,26 @@ def _bench_elle(details: dict) -> None:
         "cpu_histories_per_sec": round(cpu_rate, 2),
         "speedup": round(rate / cpu_rate, 1),
     }
+
+    # honest fresh-history rates: bytes -> infer (C++ vs Python) ->
+    # pack -> device (VERDICT r4 weak #3)
+    from jepsen_tpu.history.fastpack import elle_graph_file
+    from jepsen_tpu.history.store import read_history
+
+    details["elle"].update(_end_to_end_rates(
+        base,
+        rate,
+        native_fn=elle_graph_file,
+        python_fn=lambda p: infer_txn_graph(read_history(p)),
+        pack_fn=pack_txn_graphs,
+    ))
+    e = details["elle"]
+    print(
+        f"# elle end-to-end: native={e['end_to_end_histories_per_sec']:.0f}"
+        f" hist/s python={e['end_to_end_histories_per_sec_python']:.0f}"
+        f" hist/s (device-only {rate:.0f})",
+        file=sys.stderr,
+    )
 
 
 def _bench_mutex(details: dict) -> None:
@@ -660,8 +772,26 @@ def _watch(interval: float, budget: float) -> int:
 
 
 def _run_once() -> None:
+    from jepsen_tpu.utils.jaxenv import (
+        compile_cache_entries,
+        enable_compilation_cache,
+    )
+
     backend = _init_backend_with_retry()
     print(f"# backend ready: {backend}", file=sys.stderr)
+    # persistent compile cache — TPU-only: the CPU AOT loader rejects
+    # cached entries over machine-feature drift (jaxenv docstring)
+    cache_dir = (
+        enable_compilation_cache(
+            os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "store", "xla_cache",
+            )
+        )
+        if backend == "tpu"
+        else None
+    )
+    entries_before = compile_cache_entries(cache_dir)
     if backend != "tpu":
         _apply_cpu_scale()
         print(
@@ -671,25 +801,19 @@ def _run_once() -> None:
 
     details: dict = {"backend": backend, "provenance": _provenance(backend)}
     rate, cpu_rate = _bench_queue(details)
-
-    # secondary families — never allowed to sink the headline artifact
-    for section in (
-        _bench_stream, _bench_stream_long, _bench_elle, _bench_mutex
-    ):
-        try:
-            section(details)
-        except Exception as e:  # noqa: BLE001 - secondary, reported
-            print(
-                f"# {section.__name__} failed: {type(e).__name__}: {e}",
-                file=sys.stderr,
-            )
-
+    details["compile_cache"] = {
+        "dir": cache_dir,
+        "entries_before": entries_before,
+        "entries_after_queue": compile_cache_entries(cache_dir),
+    }
     _write_details(details)
 
-    # the headline JSON line prints BEFORE the chip-only wgl_hard rows:
-    # their worst case (compile-hang rows killed at the per-row deadline)
-    # can take tens of minutes, and a driver that times the whole run out
-    # there must still find the round's one-line artifact on stdout
+    # the headline JSON line prints the moment the queue section lands —
+    # BEFORE every secondary section (stream/stream-10k/elle/mutex) and
+    # the chip-only wgl_hard rows: any of those can outlive the driver's
+    # budget (r4: rc=124 mid-stream with a healthy chip; wgl_hard's
+    # worst case is tens of minutes), and a driver that times the run
+    # out there must already hold the round's one-line artifact
     print(
         json.dumps(
             {
@@ -706,6 +830,28 @@ def _run_once() -> None:
         ),
         flush=True,
     )
+
+    # secondary families — never allowed to sink the headline artifact;
+    # details persist after each section so a timeout after N sections
+    # still leaves N sections of fresh numbers on disk
+    for section in (
+        _bench_stream, _bench_stream_long, _bench_elle, _bench_mutex
+    ):
+        try:
+            section(details)
+        except Exception as e:  # noqa: BLE001 - secondary, reported
+            print(
+                f"# {section.__name__} failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+        _write_details(details)
+    details["compile_cache"]["entries_final"] = compile_cache_entries(
+        cache_dir
+    )
+    print(
+        f"# compile cache: {details['compile_cache']}", file=sys.stderr
+    )
+    _write_details(details)
 
     if backend == "tpu":
         # optional chip-only rows, after the details write AND the

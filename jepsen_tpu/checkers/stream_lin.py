@@ -271,7 +271,23 @@ def pack_stream_histories(
     bounded by the append count, so one width serves both scatter axes)."""
     if not histories:
         raise ValueError("cannot pack an empty batch of histories")
-    packed = [_stream_rows(h) for h in histories]
+    return pack_stream_rows(
+        [_stream_rows(h) for h in histories], length=length, space=space
+    )
+
+
+def pack_stream_rows(
+    packed: Sequence[tuple[np.ndarray, bool]],
+    length: int | None = None,
+    space: int | None = None,
+) -> StreamBatch:
+    """Pack from precomputed ``([n, 6] cols, full_read)`` pairs — the
+    ``_stream_rows`` output shape, which the native explosion
+    (``fastpack.stream_rows_file``) produces without materializing Op
+    objects (VERDICT r4 #3: honest end-to-end device rates need the
+    host substrate in the measured path)."""
+    if not packed:
+        raise ValueError("cannot pack an empty batch of histories")
     n_max = max(m.shape[0] for m, _ in packed)
     L = length if length is not None else _round_up(n_max, LANE)
     if n_max > L:

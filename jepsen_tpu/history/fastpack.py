@@ -45,6 +45,33 @@ class _JtPackResult(ctypes.Structure):
     ]
 
 
+class _JtElleResult(ctypes.Structure):
+    _fields_ = [
+        ("edges", ctypes.POINTER(ctypes.c_int32)),
+        ("n_edges", ctypes.c_int64),
+        ("txn_index", ctypes.POINTER(ctypes.c_int64)),
+        ("n_txns", ctypes.c_int32),
+        ("g1a", ctypes.POINTER(ctypes.c_int32)),
+        ("n_g1a", ctypes.c_int32),
+        ("g1b", ctypes.POINTER(ctypes.c_int32)),
+        ("n_g1b", ctypes.c_int32),
+        ("bad_keys", ctypes.POINTER(ctypes.c_int64)),
+        ("n_bad_keys", ctypes.c_int32),
+        ("err", ctypes.c_int32),
+        ("err_line", ctypes.c_int64),
+    ]
+
+
+class _JtStreamResult(ctypes.Structure):
+    _fields_ = [
+        ("cols", ctypes.POINTER(ctypes.c_int32)),
+        ("n_rows", ctypes.c_int64),
+        ("full_read", ctypes.c_int32),
+        ("err", ctypes.c_int32),
+        ("err_line", ctypes.c_int64),
+    ]
+
+
 def _load() -> ctypes.CDLL | None:
     """The packer library, building it on first use; None (sticky) when
     it cannot be built/loaded — packing then stays pure-Python."""
@@ -66,6 +93,14 @@ def _load() -> ctypes.CDLL | None:
     lib.jt_pack_file.argtypes = [ctypes.c_char_p]
     lib.jt_pack_free.restype = None
     lib.jt_pack_free.argtypes = [ctypes.POINTER(_JtPackResult)]
+    lib.jt_elle_infer_file.restype = ctypes.POINTER(_JtElleResult)
+    lib.jt_elle_infer_file.argtypes = [ctypes.c_char_p]
+    lib.jt_elle_free.restype = None
+    lib.jt_elle_free.argtypes = [ctypes.POINTER(_JtElleResult)]
+    lib.jt_stream_rows_file.restype = ctypes.POINTER(_JtStreamResult)
+    lib.jt_stream_rows_file.argtypes = [ctypes.c_char_p]
+    lib.jt_stream_free.restype = None
+    lib.jt_stream_free.argtypes = [ctypes.POINTER(_JtStreamResult)]
     _lib = lib
     return lib
 
@@ -75,16 +110,10 @@ def pack_file(jsonl_path: str | Path) -> tuple[str, np.ndarray] | None:
     or None when the fast path doesn't apply (no library, ``.edn``
     input, or anything the C parser flags) — the caller falls back to
     the Python packer and its canonical error messages."""
-    import os
-
-    if os.environ.get("JEPSEN_TPU_NO_FASTPACK"):
-        return None  # measurement/debug escape hatch: pure-Python packing
-    p = Path(jsonl_path)
-    if p.suffix == ".edn":
+    got = _gate(jsonl_path)
+    if got is None:
         return None
-    lib = _load()
-    if lib is None:
-        return None
+    lib, p = got
     res = lib.jt_pack_file(str(p).encode())
     if not res:
         return None
@@ -100,3 +129,83 @@ def pack_file(jsonl_path: str | Path) -> tuple[str, np.ndarray] | None:
         return _WORKLOADS[r.workload], rows
     finally:
         lib.jt_pack_free(res)
+
+
+def _gate(jsonl_path: str | Path):
+    """Shared fast-path gating (escape hatch / .edn / library)."""
+    import os
+
+    if os.environ.get("JEPSEN_TPU_NO_FASTPACK"):
+        return None
+    p = Path(jsonl_path)
+    if p.suffix == ".edn":
+        return None
+    lib = _load()
+    if lib is None:
+        return None
+    return lib, p
+
+
+def elle_graph_file(jsonl_path: str | Path):
+    """``TxnGraph`` for a JSONL elle history via the native inference
+    (``jt_elle_infer_file`` — the JSONL parse + ``infer_txn_graph``
+    fused into one C++ pass), or None on any fallback condition.  The
+    Python twin stays the single source of truth for error behavior;
+    the differential contract lives in tests/test_fastpack.py."""
+    got = _gate(jsonl_path)
+    if got is None:
+        return None
+    lib, p = got
+    res = lib.jt_elle_infer_file(str(p).encode())
+    if not res:
+        return None
+    try:
+        r = res.contents
+        if r.err != 0:
+            return None
+        from jepsen_tpu.checkers.elle import TxnGraph
+
+        g = TxnGraph(
+            n=int(r.n_txns),
+            txn_index=[
+                int(r.txn_index[i]) for i in range(int(r.n_txns))
+            ],
+        )
+        by_type = (g.ww, g.wr, g.rw)
+        for i in range(int(r.n_edges)):
+            et, a, b = (
+                r.edges[3 * i], r.edges[3 * i + 1], r.edges[3 * i + 2]
+            )
+            by_type[et].add((int(a), int(b)))
+        g.g1a.update(int(r.g1a[i]) for i in range(int(r.n_g1a)))
+        g.g1b.update(int(r.g1b[i]) for i in range(int(r.n_g1b)))
+        g.incompatible_order.update(
+            int(r.bad_keys[i]) for i in range(int(r.n_bad_keys))
+        )
+        return g
+    finally:
+        lib.jt_elle_free(res)
+
+
+def stream_rows_file(
+    jsonl_path: str | Path,
+) -> tuple[np.ndarray, bool] | None:
+    """``([n, 6] col matrix, full_read)`` for a JSONL stream history via
+    the native explosion (``jt_stream_rows_file`` — the JSONL parse +
+    ``_stream_rows`` fused), or None on any fallback condition."""
+    got = _gate(jsonl_path)
+    if got is None:
+        return None
+    lib, p = got
+    res = lib.jt_stream_rows_file(str(p).encode())
+    if not res:
+        return None
+    try:
+        r = res.contents
+        if r.err != 0:
+            return None
+        n = int(r.n_rows)
+        cols = np.ctypeslib.as_array(r.cols, shape=(n, 6)).copy()
+        return cols, bool(r.full_read)
+    finally:
+        lib.jt_stream_free(res)

@@ -26,6 +26,7 @@ Ops:
 from __future__ import annotations
 
 import logging
+import os
 import socketserver
 import threading
 from typing import Any
@@ -321,11 +322,18 @@ class CheckerServer(socketserver.ThreadingTCPServer):
 
 
 def serve_forever(
-    host: str = "0.0.0.0", port: int = 8640, seq: int = 1
+    host: str = "0.0.0.0",
+    port: int = 8640,
+    seq: int = 1,
+    store: str = "store",
 ) -> None:
     import jax
 
-    from jepsen_tpu.utils.jaxenv import ensure_backend, pin_cpu_platform
+    from jepsen_tpu.utils.jaxenv import (
+        enable_compilation_cache,
+        ensure_backend,
+        pin_cpu_platform,
+    )
 
     # NOTE: no opportunistic harvest here, deliberately — the sidecar
     # never exits, so a spawned harvest child could never take the
@@ -333,6 +341,10 @@ def serve_forever(
     # starve real capture windows (see utils/harvest.opportunistic).
     try:
         backend = ensure_backend()
+        if backend == "tpu":
+            # TPU-only (CPU AOT-loader feature drift, jaxenv docstring);
+            # same store-derived dir as the CLI so the two share compiles
+            enable_compilation_cache(os.path.join(store, "xla_cache"))
     except TimeoutError as e:
         # a hanging chip-plugin init must not take the sidecar down —
         # serve on CPU and say so, rather than blocking forever (safe

@@ -34,13 +34,17 @@
 // maps (rabbitmq.clj:191-215,245-248); dense-int values are what make
 // histories tensorizable (Utils.java:443,496,532,584).
 
+#include <algorithm>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <set>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
+#include <utility>
 #include <vector>
 
 namespace {
@@ -369,6 +373,281 @@ struct PerProc {
   long long last_time = -1;
 };
 
+// ---------------------------------------------------------------------------
+// Deep JSON tree (for the elle txn micro-op lists and stream read pairs,
+// whose nesting the flat JVal deliberately collapses).  Structure is kept
+// exactly as deep as the checkers inspect; strings are raw spans into the
+// line buffer — an ESCAPED string sets c.fail so the binding falls back
+// to the canonical parser (the only strings the checkers compare are
+// "append"/"r"/"full", none of which are ever escaped by the writer).
+// ---------------------------------------------------------------------------
+
+struct JNode {
+  enum K { NUL, INT, STR, LIST, OTHER } k = NUL;
+  long long i = 0;
+  const char* s = nullptr;  // STR: raw span (escape-free by construction)
+  size_t slen = 0;
+  std::vector<JNode> items;  // LIST
+
+  bool is_str(const char* lit, size_t n) const {
+    return k == STR && slen == n && std::memcmp(s, lit, n) == 0;
+  }
+};
+
+void parse_node(Cursor& c, JNode& out, int depth = 0) {
+  if (depth > 24) {  // micro-op nesting is ≤ 3; anything deeper is not ours
+    c.fail = true;
+    return;
+  }
+  skip_ws(c);
+  if (c.p >= c.end) {
+    c.fail = true;
+    return;
+  }
+  char ch = *c.p;
+  if (ch == 'n') {
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "null", 4) == 0) {
+      c.p += 4;
+      out.k = JNode::NUL;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == 't') {
+    if (c.end - c.p >= 4 && std::memcmp(c.p, "true", 4) == 0) {
+      c.p += 4;
+      out.k = JNode::INT;  // isinstance(True, int) in the Python twin
+      out.i = 1;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == 'f') {
+    if (c.end - c.p >= 5 && std::memcmp(c.p, "false", 5) == 0) {
+      c.p += 5;
+      out.k = JNode::INT;
+      out.i = 0;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  if (ch == '"') {
+    const char *s, *e;
+    if (!scan_string(c, &s, &e)) {
+      c.fail = true;
+      return;
+    }
+    if (std::memchr(s, '\\', static_cast<size_t>(e - s)) != nullptr) {
+      c.fail = true;  // escaped string: fall back (see header comment)
+      return;
+    }
+    out.k = JNode::STR;
+    out.s = s;
+    out.slen = static_cast<size_t>(e - s);
+    return;
+  }
+  if (ch == '{') {
+    parse_object(c);
+    out.k = JNode::OTHER;
+    return;
+  }
+  if (ch == '[') {
+    ++c.p;
+    out.k = JNode::LIST;
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ']') {
+      ++c.p;
+      return;
+    }
+    while (c.p < c.end && !c.fail) {
+      out.items.emplace_back();
+      parse_node(c, out.items.back(), depth + 1);
+      if (c.fail) return;
+      skip_ws(c);
+      if (c.p < c.end && *c.p == ',') {
+        ++c.p;
+        continue;
+      }
+      if (c.p < c.end && *c.p == ']') {
+        ++c.p;
+        return;
+      }
+      c.fail = true;
+      return;
+    }
+    c.fail = true;
+    return;
+  }
+  bool int_ok = false;
+  long long v = scan_number(c, &int_ok);
+  if (c.fail || c.overflow) return;
+  if (int_ok) {
+    out.k = JNode::INT;
+    out.i = v;
+  } else {
+    out.k = JNode::OTHER;  // float
+  }
+}
+
+// One parsed op line for the deep-value entry points.
+struct OpView {
+  int type = -1;
+  int f = -1;
+  long long process = -1;  // from_json's NEMESIS_PROCESS default
+  JNode value;             // NUL when absent
+  bool ok = false;
+};
+
+// Parse one op JSON object (deep value).  Mirrors the key handling of
+// jt_pack_file: escaped keys and unknown type/f names fail (the binding
+// falls back to the canonical Python parser).
+bool parse_op_deep(Cursor& c, OpView& op) {
+  skip_ws(c);
+  if (c.p >= c.end || *c.p != '{') return false;
+  ++c.p;
+  bool saw_type = false, saw_f = false;
+  skip_ws(c);
+  if (c.p < c.end && *c.p == '}') return false;  // missing "type"
+  while (c.p < c.end && !c.fail) {
+    skip_ws(c);
+    const char *ks, *ke;
+    if (!scan_string(c, &ks, &ke)) return false;
+    skip_ws(c);
+    if (c.p >= c.end || *c.p != ':') return false;
+    ++c.p;
+    size_t klen = static_cast<size_t>(ke - ks);
+    if (std::memchr(ks, '\\', klen) != nullptr) return false;
+    skip_ws(c);
+    if (klen == 4 && std::memcmp(ks, "type", 4) == 0) {
+      const char *vs, *ve;
+      if (!scan_string(c, &vs, &ve)) return false;
+      op.type = type_code(vs, static_cast<size_t>(ve - vs));
+      if (op.type < 0) return false;
+      saw_type = true;
+    } else if (klen == 1 && *ks == 'f') {
+      const char *vs, *ve;
+      if (!scan_string(c, &vs, &ve)) return false;
+      op.f = f_code(vs, static_cast<size_t>(ve - vs));
+      if (op.f < 0) return false;
+      saw_f = true;
+    } else if (klen == 7 && std::memcmp(ks, "process", 7) == 0) {
+      JVal v;
+      parse_value(c, v);
+      if (c.fail || c.overflow || v.kind != VKind::INT) return false;
+      op.process = v.i;
+    } else if (klen == 5 && std::memcmp(ks, "value", 5) == 0) {
+      op.value = JNode{};  // duplicate keys: last wins, like json.loads
+      parse_node(c, op.value);
+      if (c.fail || c.overflow) return false;
+    } else {
+      skip_value(c);  // index / time / error — unused by these checkers
+      if (c.fail || c.overflow) return false;
+    }
+    skip_ws(c);
+    if (c.p < c.end && *c.p == ',') {
+      ++c.p;
+      continue;
+    }
+    if (c.p < c.end && *c.p == '}') {
+      ++c.p;
+      skip_ws(c);
+      if (c.p != c.end) return false;  // trailing junk
+      if (!saw_type || !saw_f) return false;
+      op.ok = true;
+      return true;
+    }
+    return false;
+  }
+  return false;
+}
+
+// Streaming line iterator over a JSONL file; calls cb(op, pos) per
+// non-blank line (pos = 0-based op position, matching enumerate() over
+// read_history_jsonl).  Returns OK / ERR_* with the failing line in
+// *err_line.
+template <typename CB>
+int for_each_op(const char* path, CB&& cb, int64_t* err_line) {
+  FILE* fh = std::fopen(path, "rb");
+  if (!fh) return ERR_IO;
+  std::string buf;
+  buf.reserve(1 << 20);
+  char chunk[1 << 16];
+  size_t got;
+  int64_t line_no = 0;
+  long long pos = 0;
+  bool done_reading = false;
+  size_t cons = 0;
+  int err = OK;
+  while (true) {
+    size_t nl = buf.find('\n', cons);
+    while (nl == std::string::npos && !done_reading) {
+      if (cons > 0) {
+        buf.erase(0, cons);
+        cons = 0;
+      }
+      size_t scan_from = buf.size();
+      got = std::fread(chunk, 1, sizeof(chunk), fh);
+      if (got == 0) {
+        if (std::ferror(fh)) {
+          std::fclose(fh);
+          *err_line = line_no;
+          return ERR_IO;
+        }
+        done_reading = true;
+        break;
+      }
+      buf.append(chunk, got);
+      nl = buf.find('\n', scan_from);
+    }
+    size_t line_end = (nl == std::string::npos) ? buf.size() : nl;
+    if (line_end <= cons && done_reading) break;
+    ++line_no;
+    const char* ls = buf.data() + cons;
+    const char* le = buf.data() + line_end;
+    while (ls < le && (*ls == ' ' || *ls == '\t' || *ls == '\r')) ++ls;
+    while (le > ls && (le[-1] == ' ' || le[-1] == '\t' || le[-1] == '\r'))
+      --le;
+    if (ls < le) {
+      Cursor c{ls, le};
+      OpView op;
+      if (!parse_op_deep(c, op)) {
+        err = c.overflow ? ERR_OVERFLOW : ERR_PARSE;
+        *err_line = line_no;
+        break;
+      }
+      if (!cb(op, pos)) {
+        err = ERR_PARSE;  // structure the checker twin cannot map
+        *err_line = line_no;
+        break;
+      }
+      ++pos;
+    }
+    if (nl == std::string::npos) break;
+    cons = nl + 1;
+  }
+  std::fclose(fh);
+  return err;
+}
+
+int32_t* copy_i32(const std::vector<int32_t>& v) {
+  if (v.empty()) return nullptr;
+  auto* p = static_cast<int32_t*>(std::malloc(v.size() * sizeof(int32_t)));
+  if (p) std::memcpy(p, v.data(), v.size() * sizeof(int32_t));
+  return p;
+}
+
+int64_t* copy_i64(const std::vector<long long>& v) {
+  if (v.empty()) return nullptr;
+  auto* p = static_cast<int64_t*>(std::malloc(v.size() * sizeof(int64_t)));
+  if (p) {
+    for (size_t i = 0; i < v.size(); ++i) p[i] = v[i];
+  }
+  return p;
+}
+
 }  // namespace
 
 extern "C" {
@@ -607,6 +886,341 @@ JtPackResult* jt_pack_file(const char* path) {
 void jt_pack_free(JtPackResult* r) {
   if (!r) return;
   std::free(r->rows);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
+// Elle: history.jsonl -> inferred txn dependency graph.
+//
+// C++ twin of checkers/elle.py::infer_txn_graph composed with the JSONL
+// reader — the host-side cost that bounded the elle family's fresh-pack
+// end-to-end rate (VERDICT r4 weak #3: the device number measured
+// cycle-search-only while a fresh history still paid Python parse +
+// inference).  Differential contract in tests/test_fastpack.py: for any
+// parseable file the edge sets, anomaly sets, and txn index must equal
+// the Python twin's exactly; anything unmappable returns ERR_PARSE and
+// the binding falls back.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t* edges;      // n_edges * 3: (etype 0=ww 1=wr 2=rw, from, to)
+  int64_t n_edges;
+  int64_t* txn_index;  // history position per committed txn
+  int32_t n_txns;
+  int32_t* g1a;        // txn ids reading failed writes
+  int32_t n_g1a;
+  int32_t* g1b;        // txn ids reading intermediate versions
+  int32_t n_g1b;
+  int64_t* bad_keys;   // keys with prefix-incompatible observed orders
+  int32_t n_bad_keys;
+  int32_t err;         // Err enum; non-zero => arrays are NULL
+  int64_t err_line;
+} JtElleResult;
+
+JtElleResult* jt_elle_infer_file(const char* path) {
+  auto* res =
+      static_cast<JtElleResult*>(std::calloc(1, sizeof(JtElleResult)));
+  if (!res) return nullptr;
+
+  // micro-op view of one committed txn
+  struct Mop {
+    int kind;  // 0 append(int v), 1 read(list)
+    long long key;
+    long long v;
+    std::vector<long long> vs;  // read: int elements only (Python drops
+                                // non-ints via the isinstance filter)
+  };
+  std::vector<std::vector<Mop>> committed;
+  std::vector<long long> txn_index;
+  std::unordered_set<long long> failed_values;
+
+  // ["append", k, v] / ["r", k, [..]] with any other shape skipped —
+  // exactly the len==3 + isinstance guards of the Python twin.  A txn
+  // value that is not a list contributes no micro-ops.  Non-int keys
+  // cannot map onto this implementation's tables: signal fallback.
+  auto collect = [&](const JNode& value, std::vector<Mop>* out,
+                     bool fail_txn) -> bool {
+    if (value.k != JNode::LIST) return true;
+    for (const JNode& m : value.items) {
+      if (m.k != JNode::LIST || m.items.size() != 3) continue;
+      const JNode& f = m.items[0];
+      const JNode& key = m.items[1];
+      const JNode& val = m.items[2];
+      bool is_append = f.is_str("append", 6);
+      bool is_read = f.is_str("r", 1);
+      if (!is_append && !is_read) continue;
+      if (key.k != JNode::INT)
+        return false;  // non-int key (string/null/…): the Python twin
+                       // handles — or canonically rejects — it; either
+                       // way this table-based twin cannot, so fall back
+      if (is_append && val.k == JNode::INT) {
+        if (fail_txn) {
+          failed_values.insert(val.i);
+        } else if (out) {
+          out->push_back(Mop{0, key.i, val.i, {}});
+        }
+      } else if (is_read && val.k == JNode::LIST && !fail_txn && out) {
+        Mop r{1, key.i, 0, {}};
+        for (const JNode& e : val.items)
+          if (e.k == JNode::INT) r.vs.push_back(e.i);
+        out->push_back(std::move(r));
+      }
+    }
+    return true;
+  };
+
+  int64_t err_line = 0;
+  int err = for_each_op(
+      path,
+      [&](const OpView& op, long long pos) -> bool {
+        if (op.f != 8 /* txn */ || op.type == 0 /* invoke */) return true;
+        if (op.type == 1 /* ok */) {
+          committed.emplace_back();
+          txn_index.push_back(pos);
+          return collect(op.value, &committed.back(), false);
+        }
+        if (op.type == 2 /* fail */)
+          return collect(op.value, nullptr, true);
+        return true;  // info: indeterminate, no entries (elle's rule)
+      },
+      &err_line);
+  if (err != OK) {
+    res->err = err;
+    res->err_line = err_line;
+    return res;
+  }
+
+  const int n = static_cast<int>(committed.size());
+  std::unordered_map<long long, int> writer_of;  // value -> txn (last wins)
+  // appends_of[(t, k)] — per-txn key map
+  std::vector<std::unordered_map<long long, std::vector<long long>>>
+      appends(n);
+  for (int t = 0; t < n; ++t)
+    for (const Mop& m : committed[t])
+      if (m.kind == 0) {
+        writer_of[m.v] = t;
+        appends[t][m.key].push_back(m.v);
+      }
+
+  // normalized reads + per-key inferred order (longest observed list,
+  // first-seen wins ties — Python's strict `>` replacement)
+  struct Read {
+    int t;
+    long long key;
+    std::vector<long long> vs;
+  };
+  std::vector<Read> reads;
+  std::unordered_map<long long, std::vector<long long>> order;
+  for (int t = 0; t < n; ++t)
+    for (const Mop& m : committed[t]) {
+      if (m.kind != 1) continue;
+      std::unordered_set<long long> own;
+      auto it = appends[t].find(m.key);
+      if (it != appends[t].end())
+        own.insert(it->second.begin(), it->second.end());
+      std::vector<long long> vs = m.vs;
+      while (!vs.empty() && own.count(vs.back())) vs.pop_back();
+      auto& cur = order[m.key];
+      if (vs.size() > cur.size()) cur = vs;
+      reads.push_back(Read{t, m.key, std::move(vs)});
+    }
+
+  std::set<std::pair<int, int>> ww, wr, rw;
+  std::set<int> g1a, g1b;
+  std::set<long long> bad_keys;
+  std::vector<uint8_t> compatible(reads.size(), 0);
+  for (size_t i = 0; i < reads.size(); ++i) {
+    const Read& r = reads[i];
+    const auto& ref = order[r.key];
+    bool ok_prefix = r.vs.size() <= ref.size() &&
+                     std::equal(r.vs.begin(), r.vs.end(), ref.begin());
+    compatible[i] = ok_prefix;
+    if (!ok_prefix) bad_keys.insert(r.key);
+    for (long long v : r.vs)
+      if (failed_values.count(v)) g1a.insert(r.t);
+    if (!r.vs.empty() && ok_prefix) {
+      auto w = writer_of.find(r.vs.back());
+      if (w != writer_of.end() && w->second != r.t) {
+        auto wk = appends[w->second].find(r.key);
+        if (wk != appends[w->second].end()) {
+          const auto& lst = wk->second;
+          bool present =
+              std::find(lst.begin(), lst.end(), r.vs.back()) != lst.end();
+          if (present && r.vs.back() != lst.back()) g1b.insert(r.t);
+        }
+      }
+    }
+  }
+  for (const auto& kv : order) {
+    const auto& vs = kv.second;
+    for (size_t i = 0; i + 1 < vs.size(); ++i) {
+      auto wa = writer_of.find(vs[i]);
+      auto wb = writer_of.find(vs[i + 1]);
+      if (wa != writer_of.end() && wb != writer_of.end() &&
+          wa->second != wb->second)
+        ww.insert({wa->second, wb->second});
+    }
+  }
+  for (size_t i = 0; i < reads.size(); ++i) {
+    if (!compatible[i]) continue;
+    const Read& r = reads[i];
+    const auto& ref = order[r.key];
+    if (!r.vs.empty()) {
+      auto w = writer_of.find(r.vs.back());
+      if (w != writer_of.end() && w->second != r.t)
+        wr.insert({w->second, r.t});
+    }
+    if (r.vs.size() < ref.size()) {
+      auto w = writer_of.find(ref[r.vs.size()]);
+      if (w != writer_of.end() && w->second != r.t)
+        rw.insert({r.t, w->second});
+    }
+  }
+
+  std::vector<int32_t> edges;
+  edges.reserve((ww.size() + wr.size() + rw.size()) * 3);
+  auto emit = [&](const std::set<std::pair<int, int>>& es, int32_t et) {
+    for (const auto& e : es) {
+      edges.push_back(et);
+      edges.push_back(e.first);
+      edges.push_back(e.second);
+    }
+  };
+  emit(ww, 0);
+  emit(wr, 1);
+  emit(rw, 2);
+
+  res->edges = copy_i32(edges);
+  res->n_edges = static_cast<int64_t>(edges.size() / 3);
+  res->txn_index = copy_i64(txn_index);
+  res->n_txns = n;
+  std::vector<int32_t> va(g1a.begin(), g1a.end());
+  std::vector<int32_t> vb(g1b.begin(), g1b.end());
+  std::vector<long long> vk(bad_keys.begin(), bad_keys.end());
+  res->g1a = copy_i32(va);
+  res->n_g1a = static_cast<int32_t>(va.size());
+  res->g1b = copy_i32(vb);
+  res->n_g1b = static_cast<int32_t>(vb.size());
+  res->bad_keys = copy_i64(vk);
+  res->n_bad_keys = static_cast<int32_t>(vk.size());
+  return res;
+}
+
+void jt_elle_free(JtElleResult* r) {
+  if (!r) return;
+  std::free(r->edges);
+  std::free(r->txn_index);
+  std::free(r->g1a);
+  std::free(r->g1b);
+  std::free(r->bad_keys);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
+// Stream: history.jsonl -> the [n, 6] column matrix + full-read flag of
+// checkers/stream_lin.py::_stream_rows (type, f, value, offset, pos,
+// first) — the host explosion ahead of pack_stream_histories.  Same
+// differential/fallback contract as the elle path.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t* cols;  // n_rows * 6
+  int64_t n_rows;
+  int32_t full_read;
+  int32_t err;
+  int64_t err_line;
+} JtStreamResult;
+
+JtStreamResult* jt_stream_rows_file(const char* path) {
+  auto* res =
+      static_cast<JtStreamResult*>(std::calloc(1, sizeof(JtStreamResult)));
+  if (!res) return nullptr;
+
+  std::vector<int32_t> cols;
+  cols.reserve(1 << 14);
+  bool full = false;
+  bool range_bad = false;
+  std::unordered_set<long long> full_pending;
+
+  auto push = [&](int type, int f, long long v, long long o, long long pos,
+                  int first) {
+    // the Python twin materializes np.int32 — out-of-range values would
+    // wrap there only via astype, but _stream_rows builds from raw ints
+    // and np.asarray(np.int32) raises: treat as unmappable -> fallback
+    if (v > INT32_MAX || v < INT32_MIN || o > INT32_MAX || o < INT32_MIN ||
+        pos > INT32_MAX) {
+      range_bad = true;
+      return;
+    }
+    cols.push_back(type);
+    cols.push_back(f);
+    cols.push_back(static_cast<int32_t>(v));
+    cols.push_back(static_cast<int32_t>(o));
+    cols.push_back(static_cast<int32_t>(pos));
+    cols.push_back(first);
+  };
+
+  auto is_pair = [](const JNode& x) {
+    return x.k == JNode::LIST && x.items.size() == 2 &&
+           x.items[0].k == JNode::INT && x.items[1].k == JNode::INT;
+  };
+
+  int64_t err_line = 0;
+  int err = for_each_op(
+      path,
+      [&](const OpView& op, long long pos) -> bool {
+        if (op.f == 6 /* append */) {
+          long long v =
+              op.value.k == JNode::INT ? op.value.i : NO_VALUE;
+          push(op.type, op.f, v, -1, pos, 1);
+        } else if (op.f == 7 /* read */) {
+          if (op.type == 0 /* invoke */) {
+            full_pending.erase(op.process);
+            if (op.value.is_str("full", 4)) full_pending.insert(op.process);
+            push(op.type, op.f, NO_VALUE, -1, pos, 1);
+          } else {
+            if (op.type == 1 /* ok */ && full_pending.count(op.process))
+              full = true;
+            full_pending.erase(op.process);
+            // read_pairs: a single [o, v] pair, or a list of pairs
+            // (non-pair elements skipped), or nothing
+            std::vector<std::pair<long long, long long>> pairs;
+            if (is_pair(op.value)) {
+              pairs.push_back({op.value.items[0].i, op.value.items[1].i});
+            } else if (op.value.k == JNode::LIST) {
+              for (const JNode& p : op.value.items)
+                if (is_pair(p))
+                  pairs.push_back({p.items[0].i, p.items[1].i});
+            }
+            if (pairs.empty()) push(op.type, op.f, NO_VALUE, -1, pos, 1);
+            int first = 1;
+            for (const auto& p : pairs) {
+              push(op.type, op.f, p.second, p.first, pos, first);
+              first = 0;
+            }
+          }
+        }
+        return !range_bad;
+      },
+      &err_line);
+  if (err != OK) {
+    res->err = err;
+    res->err_line = err_line;
+    return res;
+  }
+  if (cols.empty()) {
+    // sentinel row: (INVOKE, LOG, NO_VALUE, -1, 0, 1)
+    push(0, 5, NO_VALUE, -1, 0, 1);
+  }
+  res->cols = copy_i32(cols);
+  res->n_rows = static_cast<int64_t>(cols.size() / 6);
+  res->full_read = full ? 1 : 0;
+  return res;
+}
+
+void jt_stream_free(JtStreamResult* r) {
+  if (!r) return;
+  std::free(r->cols);
   std::free(r);
 }
 

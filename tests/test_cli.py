@@ -283,3 +283,41 @@ def test_reference_ci_parameter_strings_parse_verbatim():
     # bare --dead-letter (no value) still means True; absent means False
     assert parser.parse_args(["test", "--dead-letter"]).dead_letter is True
     assert parser.parse_args(["test"]).dead_letter is False
+
+
+def test_bench_check_elle_and_stream_native_matches_python(
+    tmp_path, capsys, monkeypatch
+):
+    """The store bench routes elle/stream files through the native
+    substrates (elle_graph_file / stream_rows_file); verdict counts must
+    be identical with the native path disabled (JEPSEN_TPU_NO_FASTPACK),
+    i.e. the fast path changes the wall clock, never the verdict."""
+    main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "elle",
+            "--count", "3", "--ops", "60", "--g1c-cycle", "1",
+        ]
+    )
+    main(
+        [
+            "synth", "--store", str(tmp_path), "--workload", "stream",
+            "--count", "2", "--ops", "60", "--divergent", "1",
+        ]
+    )
+    capsys.readouterr()
+    out = {}
+    for label, env in (("native", None), ("python", "1")):
+        if env:
+            monkeypatch.setenv("JEPSEN_TPU_NO_FASTPACK", env)
+        for wl in ("elle", "stream"):
+            rc = main(
+                ["bench-check", "--histories", str(tmp_path),
+                 "--workload", wl]
+            )
+            stats = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1]
+            )
+            assert rc == 0
+            out[(label, wl)] = (stats["histories"], stats["invalid"])
+    assert out[("native", "elle")] == out[("python", "elle")] == (3, 3)
+    assert out[("native", "stream")] == out[("python", "stream")] == (2, 2)

@@ -405,3 +405,206 @@ def test_random_fuzz_identical(tmp_path):
             dicts.append(d)
         p = _write(tmp_path, dicts, name=f"fuzz{trial}.jsonl")
         _assert_identical(p)
+
+
+# ---------------------------------------------------------------------------
+# Native elle inference (jt_elle_infer_file)
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.checkers.elle import infer_txn_graph  # noqa: E402
+from jepsen_tpu.checkers.stream_lin import _stream_rows  # noqa: E402
+from jepsen_tpu.history.fastpack import (  # noqa: E402
+    elle_graph_file,
+    stream_rows_file,
+)
+
+
+def _assert_graph_identical(tmp_path, history, name="history.jsonl"):
+    p = tmp_path / name
+    write_history_jsonl(p, history)
+    g = elle_graph_file(p)
+    assert g is not None
+    ref = infer_txn_graph(read_history(p))
+    assert g.n == ref.n
+    assert g.txn_index == ref.txn_index
+    assert g.ww == ref.ww
+    assert g.wr == ref.wr
+    assert g.rw == ref.rw
+    assert g.g1a == ref.g1a
+    assert g.g1b == ref.g1b
+    assert g.incompatible_order == ref.incompatible_order
+    return g, ref
+
+
+class TestElleInferNative:
+    """The native inference must reproduce infer_txn_graph's edge and
+    anomaly sets exactly on every mappable history, and fall back (None)
+    on everything else — never a wrong graph."""
+
+    @pytest.mark.parametrize(
+        "spec_kw",
+        [
+            {},  # clean serializable
+            {"g1a": 2},
+            {"g1b": 2},
+            {"g1c_cycle": 1},
+            {"g2_cycle": 1},
+            {"g1a": 1, "g1b": 1, "g1c_cycle": 1, "g2_cycle": 1},
+            {"p_fail": 0.2, "p_info": 0.15},  # heavy abort/indeterminate
+            {"n_keys": 1, "max_micro_ops": 6},
+        ],
+    )
+    def test_differential_per_spec(self, tmp_path, spec_kw):
+        for sh in synth_elle_batch(3, ElleSynthSpec(n_txns=40), **spec_kw):
+            g, ref = _assert_graph_identical(tmp_path, sh.ops)
+
+    def test_anomalous_graph_is_actually_anomalous(self, tmp_path):
+        sh = synth_elle_batch(1, ElleSynthSpec(n_txns=40), g1a=2)[0]
+        g, _ = _assert_graph_identical(tmp_path, sh.ops)
+        assert g.g1a  # the differential test isn't comparing empties
+
+    def test_full_history_with_nemesis_ops(self, tmp_path):
+        """txn_index counts history POSITIONS over all ops, including
+        interleaved nemesis/log lines."""
+        from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+        sh = synth_elle_batch(1, ElleSynthSpec(n_txns=20))[0]
+        history = []
+        for i, op in enumerate(sh.ops):
+            if i % 5 == 0:
+                history.append(Op(
+                    type=OpType.INFO, f=OpF.START,
+                    process=NEMESIS_PROCESS, value="partition start",
+                ))
+            history.append(op)
+        _assert_graph_identical(tmp_path, history)
+
+    def test_string_key_falls_back(self, tmp_path):
+        p = _write(tmp_path, [
+            {"type": "ok", "f": "txn", "process": 0,
+             "value": [["append", "k", 1]]},
+        ])
+        assert elle_graph_file(p) is None  # Python handles string keys
+
+    def test_malformed_json_falls_back(self, tmp_path):
+        p = tmp_path / "history.jsonl"
+        p.write_text('{"type": "ok", "f": "txn", "value": [[\n')
+        assert elle_graph_file(p) is None
+
+    def test_non_list_txn_value_contributes_nothing(self, tmp_path):
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        history = [
+            Op(type=OpType.OK, f=OpF.TXN, process=0, value=7),
+            Op(type=OpType.OK, f=OpF.TXN, process=0,
+               value=[["append", 0, 1], ["r", 0, [1]]]),
+        ]
+        g, ref = _assert_graph_identical(tmp_path, history)
+        assert g.n == 2
+
+    def test_own_append_suffix_normalization(self, tmp_path):
+        """A txn reading its own staged appends after the committed
+        prefix: the suffix strips; a mid-list own value stays."""
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        history = [
+            mk([["append", 0, 1]]),
+            # reads prefix [1] + own staged [5] -> suffix strips
+            mk([["append", 0, 5], ["r", 0, [1, 5]]]),
+            # own value mid-list: a genuine misorder, stays visible
+            mk([["append", 0, 9], ["r", 0, [9, 1]]]),
+        ]
+        g, ref = _assert_graph_identical(tmp_path, history)
+        assert g.incompatible_order  # the mid-list case flagged
+
+
+# ---------------------------------------------------------------------------
+# Native stream explosion (jt_stream_rows_file)
+# ---------------------------------------------------------------------------
+
+
+def _assert_stream_identical(tmp_path, history, name="history.jsonl"):
+    p = tmp_path / name
+    write_history_jsonl(p, history)
+    got = stream_rows_file(p)
+    assert got is not None
+    cols, full = got
+    ref_cols, ref_full = _stream_rows(read_history(p))
+    np.testing.assert_array_equal(cols, ref_cols)
+    assert full == ref_full
+    return cols, full
+
+
+class TestStreamRowsNative:
+    @pytest.mark.parametrize(
+        "spec_kw",
+        [
+            {},
+            {"lost": 1, "duplicated": 1},
+            {"divergent": 1, "phantom": 1},
+            {"reorder": 1, "nonmonotonic": 1},
+            {"full_reads": False},
+            {"p_app_info": 0.2, "p_app_fail": 0.2},
+        ],
+    )
+    def test_differential_per_spec(self, tmp_path, spec_kw):
+        for sh in synth_stream_batch(
+            3, StreamSynthSpec(n_ops=60), **spec_kw
+        ):
+            _assert_stream_identical(tmp_path, sh.ops)
+
+    def test_empty_history_sentinel_row(self, tmp_path):
+        cols, full = _assert_stream_identical(tmp_path, [])
+        assert cols.shape == (1, 6) and not full
+
+    def test_non_stream_ops_are_skipped_but_counted_in_pos(self, tmp_path):
+        from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+        sh = synth_stream_batch(1, StreamSynthSpec(n_ops=40))[0]
+        history = []
+        for i, op in enumerate(sh.ops):
+            if i % 7 == 0:
+                history.append(Op(
+                    type=OpType.INFO, f=OpF.STOP,
+                    process=NEMESIS_PROCESS, value="heal",
+                ))
+            history.append(op)
+        _assert_stream_identical(tmp_path, history)
+
+    def test_value_overflow_falls_back(self, tmp_path):
+        p = _write(tmp_path, [
+            {"type": "ok", "f": "append", "process": 0,
+             "value": 2**40},
+        ])
+        assert stream_rows_file(p) is None  # np.int32 would raise
+
+    def test_weird_read_values(self, tmp_path):
+        """Null, scalar, pair, list-of-pairs, lists with non-pair noise."""
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        inv = lambda pr, v=None: Op(
+            type=OpType.INVOKE, f=OpF.READ, process=pr, value=v
+        )
+        ok = lambda pr, v: Op(type=OpType.OK, f=OpF.READ, process=pr, value=v)
+        history = [
+            inv(0), ok(0, None),
+            inv(0), ok(0, [3, 7]),                 # single pair
+            inv(1), ok(1, [[0, 5], [1, 6]]),       # list of pairs
+            inv(1), ok(1, [[0, 5], "noise", [2]]),  # noise skipped
+            inv(2), ok(2, 42),                     # scalar -> no pairs
+            inv(2, "full"), ok(2, [[0, 5]]),       # full read
+            inv(0, "full"), Op(type=OpType.FAIL, f=OpF.READ, process=0),
+        ]
+        cols, full = _assert_stream_identical(tmp_path, history)
+        assert full  # process 2's full read completed ok
+
+    def test_failed_full_read_does_not_count(self, tmp_path):
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        history = [
+            Op(type=OpType.INVOKE, f=OpF.READ, process=0, value="full"),
+            Op(type=OpType.FAIL, f=OpF.READ, process=0),
+        ]
+        cols, full = _assert_stream_identical(tmp_path, history)
+        assert not full

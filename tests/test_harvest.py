@@ -325,3 +325,84 @@ class TestWatchLoop:
         monkeypatch.setenv("JAX_PLATFORMS", "cpu")
         bench = self._bench_mod()
         assert bench._probe_chip(deadline=60.0)
+
+
+class TestHeadlineOrdering:
+    """VERDICT r4 #1: the one-line headline JSON must hit stdout BEFORE
+    any secondary section runs — four consecutive rounds of driver
+    artifacts were lost to sections that outlived the driver's budget
+    (rc=1, cpu-fallback ×2, then rc=124 mid-stream on a healthy chip)."""
+
+    def _run(self, monkeypatch, failing=()):
+        import io
+
+        bench = _load_bench("bench_headline_under_test")
+        buf = io.StringIO()
+        monkeypatch.setattr(sys, "stdout", buf)
+        events = []
+        monkeypatch.setattr(bench, "_init_backend_with_retry", lambda: "tpu")
+        monkeypatch.setattr(bench, "_provenance", lambda b: {"backend": b})
+
+        def fake_queue(details):
+            details["queue"] = {"device_histories_per_sec": 100.0}
+            return 100.0, 2.0
+
+        monkeypatch.setattr(bench, "_bench_queue", fake_queue)
+        for name in (
+            "_bench_stream", "_bench_stream_long", "_bench_elle",
+            "_bench_mutex",
+        ):
+            def fake_section(details, _n=name):
+                # record whether the headline was already on stdout when
+                # this section started — the contract under test
+                events.append((_n, '"metric"' in buf.getvalue()))
+                if _n in failing:
+                    raise RuntimeError("section blew up")
+                details[_n] = {"ok": True}
+
+            monkeypatch.setattr(bench, name, fake_section)
+        monkeypatch.setattr(
+            bench, "_bench_wgl_hard",
+            lambda details: events.append(("wgl_hard", True)),
+        )
+        written = []
+        monkeypatch.setattr(
+            bench, "_write_details", lambda d: written.append(dict(d))
+        )
+        bench._run_once()
+        return buf.getvalue(), events, written
+
+    def test_headline_prints_before_every_secondary_section(
+        self, monkeypatch
+    ):
+        out, events, written = self._run(monkeypatch)
+        headline = json.loads(out.strip().splitlines()[0])
+        assert headline["backend"] == "tpu" and not headline["fallback"]
+        assert headline["value"] == 100.0 and headline["vs_baseline"] == 50.0
+        secondary = [e for e in events if e[0] != "wgl_hard"]
+        assert len(secondary) == 4
+        assert all(seen for _, seen in secondary), (
+            "a secondary section started before the headline printed: "
+            f"{secondary}"
+        )
+
+    def test_details_persist_incrementally_per_section(self, monkeypatch):
+        out, events, written = self._run(monkeypatch)
+        # one write after the queue section, one after each of the four
+        # secondary sections (a timeout after N sections leaves N fresh),
+        # one final with the compile-cache evidence
+        assert len(written) == 6
+        assert "queue" in written[0] and "_bench_stream" not in written[0]
+        assert "_bench_mutex" in written[-1]
+        assert "entries_final" in written[-1]["compile_cache"]
+
+    def test_failing_section_never_sinks_headline_or_later_writes(
+        self, monkeypatch
+    ):
+        out, events, written = self._run(
+            monkeypatch, failing={"_bench_elle"}
+        )
+        assert '"metric"' in out
+        assert len(written) == 6  # the write still happens after a failure
+        assert "_bench_elle" not in written[-1]
+        assert "_bench_mutex" in written[-1]

@@ -68,6 +68,47 @@ def hard_history(n_ops: int, window: int, seed: int = 0):
     return ops
 
 
+def _enable_cache() -> tuple[str | None, int]:
+    """Persistent XLA compile cache under the repo store: the per-bucket
+    20–66 s WGL compile is paid once per store, and every later process
+    (including these per-row subprocesses) hits it warm (VERDICT r4
+    weak #4).  Returns (dir, entry count before compiling).  TPU-only —
+    the CPU AOT loader rejects cached entries over machine-feature
+    drift (jaxenv docstring); opt back in on CPU for cache-machinery
+    tests via JEPSEN_TPU_COMPILE_CACHE=<dir>."""
+    import jax
+
+    from jepsen_tpu.utils.jaxenv import (
+        COMPILE_CACHE_ENV,
+        compile_cache_entries,
+        enable_compilation_cache,
+    )
+
+    if (
+        jax.default_backend() != "tpu"
+        and not os.environ.get(COMPILE_CACHE_ENV)
+    ):
+        return None, 0
+    d = enable_compilation_cache(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "store", "xla_cache",
+        )
+    )
+    return d, compile_cache_entries(d)
+
+
+def _cache_evidence(row: dict, cache: tuple[str | None, int]) -> dict:
+    """compile_cache_hit: the compile added no new cache entry — XLA
+    deserialized an existing executable (the warm-cache column)."""
+    from jepsen_tpu.utils.jaxenv import compile_cache_entries
+
+    d, before = cache
+    if d is not None:
+        row["compile_cache_hit"] = compile_cache_entries(d) == before
+    return row
+
+
 def measure_hard(
     n_ops: int, window: int, batch: int, capacity: int, platform: str = ""
 ) -> dict:
@@ -77,6 +118,7 @@ def measure_hard(
 
     if platform:
         jax.config.update("jax_platforms", platform)
+    cache = _enable_cache()
 
     from jepsen_tpu.checkers.wgl import (
         check_wgl_cpu,
@@ -120,7 +162,7 @@ def measure_hard(
     classic = [check_wgl_cpu(ops, UnorderedQueue(vs)) for ops in opss]
     cpu_s = (time.perf_counter() - t2) / batch
 
-    return {
+    return _cache_evidence({
         "n_ops": n_ops,
         "window": window,
         "expected_configs": 2 ** window,
@@ -134,7 +176,7 @@ def measure_hard(
         "all_linearizable": bool(ok.all()),
         "unknown_frac": round(float(unknown.mean()), 3),
         "classic_valid": classic[0]["valid?"],
-    }
+    }, cache)
 
 
 def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
@@ -144,6 +186,7 @@ def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
         # config pin beats the sitecustomize env override (env vars alone
         # are too late once the interpreter bootstrapped the plugin path)
         jax.config.update("jax_platforms", platform)
+    cache = _enable_cache()
 
     from jepsen_tpu.checkers.wgl import (
         check_wgl_cpu,
@@ -176,7 +219,7 @@ def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
         check_wgl_cpu(ops, UnorderedQueue(vs))
     cpu_s = (time.perf_counter() - t2) / batch
 
-    return {
+    return _cache_evidence({
         "n_ops": n_ops,
         "batch": batch,
         "backend": jax.default_backend(),
@@ -186,7 +229,7 @@ def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
         "cpu_classic_per_history_ms": round(cpu_s * 1e3, 3),
         "all_linearizable": bool(ok.all()),
         "any_unknown": bool(unknown.any()),
-    }
+    }, cache)
 
 
 def main() -> None:

@@ -1,0 +1,206 @@
+"""Multi-chip readiness capture (VERDICT r4 next-step #7).
+
+``dryrun_multichip`` proves the sharding *structure* on a virtual CPU
+mesh; this tool is the inverse of the chip watcher for REAL meshes: run
+it whenever a backend with ``n_devices > 1`` appears (bench.py invokes
+it automatically after its headline when the device count allows), and
+it executes every sharded checker family — queue (total-queue +
+queue-lin over hist×seq with psum/pmin combines), stream (seq-parallel
+scan with the boundary ppermute), elle (hist-parallel MXU closure), and
+mutex (hist-parallel WGL frontier search) — on the real device mesh,
+recording a provenance-stamped ``MULTICHIP_DETAILS.json``.
+
+On a single-device backend it prints a one-line skip record (the watch
+log's proof that no multi-chip window opened) and exits 0.
+
+Reference tie-in: the capability twin of running the reference's suite
+against its 5-worker AWS topology (``ci/rabbitmq-jepsen-aws.tf``) —
+the sharded checkers are this framework's scale story (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_PATH = os.path.join(REPO, "MULTICHIP_DETAILS.json")
+
+
+def capture(out_path: str = OUT_PATH) -> dict:
+    sys.path.insert(0, REPO)
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.utils.jaxenv import ensure_backend
+
+    backend = ensure_backend()
+    n = jax.device_count()
+    base: dict = {
+        "backend": backend,
+        "n_devices": n,
+    }
+    if n < 2:
+        return {**base, "skipped": True,
+                "reason": "single-device backend — no multi-chip window"}
+
+    from jepsen_tpu.checkers.elle import infer_txn_graph, pack_txn_graphs
+    from jepsen_tpu.checkers.stream_lin import pack_stream_histories
+    from jepsen_tpu.checkers.wgl import mutex_wgl_ops, pack_wgl_batch
+    from jepsen_tpu.history.encode import pack_histories
+    from jepsen_tpu.history.synth import (
+        ElleSynthSpec,
+        MutexSynthSpec,
+        StreamSynthSpec,
+        SynthSpec,
+        synth_batch,
+        synth_elle_batch,
+        synth_mutex_batch,
+        synth_stream_batch,
+    )
+    from jepsen_tpu.models.core import OwnedMutex
+    from jepsen_tpu.parallel import (
+        checker_mesh,
+        shard_packed,
+        sharded_elle,
+        sharded_stream_lin,
+        sharded_total_queue,
+        sharded_queue_lin,
+        sharded_wgl,
+    )
+
+    seq = 2 if n % 2 == 0 else 1
+    mesh = checker_mesh(seq=seq)
+    hist = mesh.shape["hist"]
+    B = 8 * hist  # a few histories per device — readiness, not a bench
+    base["mesh"] = {k: int(v) for k, v in mesh.shape.items()}
+    families: dict = {}
+
+    def timed(name, fn):
+        t0 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        compile_and_run_s = time.perf_counter() - t0
+        t1 = time.perf_counter()
+        out = fn()
+        jax.block_until_ready(out)
+        run_s = time.perf_counter() - t1
+        return out, {
+            "compile_and_first_run_s": round(compile_and_run_s, 2),
+            "steady_run_ms": round(run_s * 1e3, 2),
+        }
+
+    # queue family
+    packed = shard_packed(
+        pack_histories(
+            [sh.ops for sh in synth_batch(B, SynthSpec(n_ops=120))],
+            length=512 * seq,
+        ),
+        mesh,
+    )
+    (tq, ql), stats = timed(
+        "queue", lambda: (
+            sharded_total_queue(packed, mesh),
+            sharded_queue_lin(packed, mesh),
+        )
+    )
+    families["queue"] = {
+        **stats,
+        "batch": B,
+        "valid_all": bool(jnp.asarray(tq.valid).all()
+                          & jnp.asarray(ql.valid).all()),
+    }
+
+    # stream family (seq-parallel when seq > 1)
+    sbatch = pack_stream_histories(
+        [sh.ops for sh in synth_stream_batch(B, StreamSynthSpec(n_ops=96))]
+    )
+    sl, stats = timed("stream", lambda: sharded_stream_lin(sbatch, mesh))
+    families["stream"] = {
+        **stats, "batch": B,
+        "valid_all": bool(jnp.asarray(sl.valid).all()),
+    }
+
+    # elle family
+    ebatch = pack_txn_graphs(
+        [
+            infer_txn_graph(sh.ops)
+            for sh in synth_elle_batch(B, ElleSynthSpec(n_txns=32))
+        ]
+    )
+    el, stats = timed("elle", lambda: sharded_elle(ebatch, mesh))
+    families["elle"] = {
+        **stats, "batch": B,
+        "valid_all": bool(jnp.asarray(el.valid).all()),
+    }
+
+    # mutex family (WGL frontier search)
+    mbatch = pack_wgl_batch(
+        [
+            mutex_wgl_ops(sh.ops)
+            for sh in synth_mutex_batch(B, MutexSynthSpec(n_ops=24))
+        ]
+    )
+    (m_ok, m_ovf), stats = timed(
+        "mutex", lambda: sharded_wgl(mbatch, mesh, (OwnedMutex, ()))
+    )
+    families["mutex"] = {
+        **stats, "batch": B,
+        "valid_all": bool(
+            jnp.asarray(m_ok).all() & ~jnp.asarray(m_ovf).any()
+        ),
+    }
+
+    out = {**base, "skipped": False, "families": families}
+
+    # provenance: same evidence block shape as BENCH_DETAILS.json
+    from jepsen_tpu.utils.harvest import _head_rev
+
+    prov = {
+        "timestamp_utc": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+    }
+    try:
+        prov["device_kind"] = jax.devices()[0].device_kind
+    except Exception as e:  # noqa: BLE001 - evidence only
+        prov["device_kind"] = f"unknown ({type(e).__name__})"
+    prov["git_rev"] = _head_rev(REPO) or "unknown"
+    out["provenance"] = prov
+
+    # a CPU-mesh run (e.g. the virtual-device mechanism test) must never
+    # clobber a real chip-mesh capture — same rule as BENCH_DETAILS.json —
+    # and must never land on the DEFAULT artifact path at all: a
+    # cpu-backend file under the multichip-evidence filename is one
+    # `git add -A` away from shipping virtual-mesh numbers as chip
+    # evidence (tests pass an explicit tmp out_path)
+    if backend != "tpu":
+        if os.path.abspath(out_path) == os.path.abspath(OUT_PATH):
+            out["not_written"] = (
+                "cpu capture refused at the default artifact path"
+            )
+            return out
+        try:
+            with open(out_path) as fh:
+                if json.load(fh).get("backend") == "tpu":
+                    out["not_written"] = "existing tpu capture kept"
+                    return out
+        except (OSError, ValueError):
+            pass
+    tmp = f"{out_path}.{os.getpid()}.tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, out_path)
+    return out
+
+
+def main() -> int:
+    out = capture()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

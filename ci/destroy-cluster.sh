@@ -11,16 +11,28 @@ if [ -n "${BINARY_URL:-}" ]; then
     branch=$(ci/extract-rabbitmq-branch-from-binary-url.sh "$BINARY_URL")
 fi
 
+destroy_ok=true
 if [ -d terraform-state ]; then
     (
         cd terraform-state &&
         terraform init &&
         terraform destroy -auto-approve -var="rabbitmq_branch=$branch"
-    ) || echo "terraform destroy failed — instances may need manual cleanup"
+    ) || {
+        echo "terraform destroy failed — instances may need manual cleanup"
+        destroy_ok=false
+    }
 fi
 if [ -n "$branch" ]; then
     aws ec2 delete-key-pair --no-cli-pager \
         --key-name "jepsen-tpu-qq-$branch-key" || true
 fi
 
-rm -rf ~/.aws terraform-state terraform.tfstate
+# credentials never survive the runner; the terraform state survives a
+# FAILED destroy — it is the only handle the advertised manual cleanup
+# has on the orphaned instances
+rm -rf ~/.aws
+if [ "$destroy_ok" = true ]; then
+    rm -rf terraform-state terraform.tfstate
+else
+    echo "keeping terraform-state/ for a manual terraform destroy"
+fi

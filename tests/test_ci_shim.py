@@ -23,6 +23,7 @@ boundary), covering:
 
 from __future__ import annotations
 
+import os
 import pathlib
 import shutil
 import subprocess
@@ -135,25 +136,36 @@ def cloud(tmp_path):
     return {"work": work, "bins": bins, "logs": logs, "home": home}
 
 
-def _run(cloud, **env_over):
-    import os
-
+def _run_script(cloud, script, env_over=None, timeout=60):
+    """Execute one ci/ script under the shim PATH + isolated HOME — the
+    single copy of the environment every shim test runs in."""
     env = {
         **os.environ,
         "PATH": f"{cloud['bins']}:{os.environ['PATH']}",
         "HOME": str(cloud["home"]),
         "SHIM_LOG": str(cloud["logs"]),
         "BINARY_URL": BINARY_URL,
-        "AWS_CONFIG": "[default]\nregion = eu-west-1",
-        "AWS_CREDENTIALS": "[default]\naws_access_key_id = AKIAFAKE",
-        **env_over,
+        **(env_over or {}),
     }
     return subprocess.run(
-        ["bash", str(cloud["work"] / "ci" / "jepsen-tpu-test.sh")],
+        ["bash", str(cloud["work"] / "ci" / script)],
         cwd=cloud["work"],
         env=env,
         capture_output=True,
         text=True,
+        timeout=timeout,
+    )
+
+
+def _run(cloud, **env_over):
+    return _run_script(
+        cloud,
+        "jepsen-tpu-test.sh",
+        env_over={
+            "AWS_CONFIG": "[default]\nregion = eu-west-1",
+            "AWS_CREDENTIALS": "[default]\naws_access_key_id = AKIAFAKE",
+            **env_over,
+        },
         timeout=120,
     )
 
@@ -260,3 +272,201 @@ class TestRedRun:
         assert "BINARY_URL" in r.stderr
         # nothing provisioned: the guard fired before any cloud call
         assert not _log(cloud, "terraform")
+
+
+# ---------------------------------------------------------------------------
+# destroy-cluster.sh — the always() teardown
+# ---------------------------------------------------------------------------
+
+DESTROY_TERRAFORM_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/terraform.log"
+[ "${FAKE_TF_DESTROY_RC:-0}" != 0 ] && [ "$1" = destroy ] && exit "$FAKE_TF_DESTROY_RC"
+exit 0
+"""
+
+
+class TestDestroyCluster:
+    def _run(self, cloud, env_over=None, make_state=True):
+        if make_state:
+            (cloud["work"] / "terraform-state").mkdir(exist_ok=True)
+            (cloud["work"] / "terraform-state" / "terraform.tfstate"
+             ).write_text("fake")
+        aws_home = cloud["home"] / ".aws"
+        aws_home.mkdir(exist_ok=True)
+        (aws_home / "credentials").write_text("secret")
+        # destroy's terraform fake must not fail on `destroy` by default
+        p = cloud["bins"] / "terraform"
+        p.write_text(DESTROY_TERRAFORM_FAKE)
+        p.chmod(0o755)
+        return _run_script(cloud, "destroy-cluster.sh", env_over)
+
+    def test_destroys_and_scrubs(self, cloud):
+        r = self._run(cloud)
+        assert r.returncode == 0, r.stderr
+        tf = _log(cloud, "terraform")
+        assert "init" in tf
+        assert "destroy -auto-approve -var=rabbitmq_branch=41" in tf
+        assert "delete-key-pair" in _log(cloud, "aws")
+        assert "jepsen-tpu-qq-41-key" in _log(cloud, "aws")
+        # credentials and state scrubbed even on success
+        assert not (cloud["home"] / ".aws").exists()
+        assert not (cloud["work"] / "terraform-state").exists()
+
+    def test_failed_destroy_scrubs_credentials_but_keeps_state(self, cloud):
+        """The always() contract: a failed terraform destroy must not
+        leave AWS credentials on the runner — but it must KEEP the
+        terraform state, which is the only handle the advertised manual
+        cleanup has on the orphaned instances (review r5 find)."""
+        r = self._run(cloud, env_over={"FAKE_TF_DESTROY_RC": "1"})
+        assert r.returncode == 0, r.stderr
+        assert "manual cleanup" in r.stdout
+        assert not (cloud["home"] / ".aws").exists()
+        assert (cloud["work"] / "terraform-state" / "terraform.tfstate"
+                ).exists()
+        assert "keeping terraform-state/" in r.stdout
+
+    def test_no_state_dir_skips_terraform_but_scrubs(self, cloud):
+        r = self._run(cloud, make_state=False)
+        assert r.returncode == 0, r.stderr
+        assert "destroy" not in _log(cloud, "terraform")
+        assert not (cloud["home"] / ".aws").exists()
+
+
+# ---------------------------------------------------------------------------
+# verify-binary-signature.sh — the GPG gate
+# ---------------------------------------------------------------------------
+
+CURL_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/curl.log"
+# last arg is the url; -o NAME names the output, -O basenames the url
+out=""
+args=("$@")
+for ((i=0; i<${#args[@]}; i++)); do
+  case "${args[$i]}" in
+    -o) out="${args[$((i+1))]}" ;;
+    -O) ;;
+    http*) url="${args[$i]}" ;;
+  esac
+done
+[ -z "$out" ] && out=$(basename "$url")
+echo "fake-content-of $url" > "$out"
+"""
+
+GPG_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/gpg.log"
+case "$1" in
+  --import) exit 0 ;;
+  --verify) exit "${FAKE_GPG_RC:-0}" ;;
+esac
+exit 0
+"""
+
+
+class TestVerifyBinarySignature:
+    def _run(self, cloud, env_over=None):
+        for name, body in (("curl", CURL_FAKE), ("gpg", GPG_FAKE)):
+            p = cloud["bins"] / name
+            p.write_text(body)
+            p.chmod(0o755)
+        return _run_script(cloud, "verify-binary-signature.sh", env_over)
+
+    def test_verifies_tarball_against_release_key(self, cloud):
+        r = self._run(cloud)
+        assert r.returncode == 0, r.stderr
+        curl = _log(cloud, "curl")
+        assert "rabbitmq-release-signing-key.asc" in curl
+        assert BINARY_URL in curl and f"{BINARY_URL}.asc" in curl
+        gpg = _log(cloud, "gpg")
+        assert "--import signing-key.asc" in gpg
+        assert f"--verify {ARCHIVE}.asc {ARCHIVE}" in gpg
+        assert "signature OK" in r.stdout
+
+    def test_bad_signature_fails_the_gate(self, cloud):
+        r = self._run(cloud, env_over={"FAKE_GPG_RC": "2"})
+        assert r.returncode != 0
+        assert "signature OK" not in r.stdout
+        # the failure came from the verify step itself, not some earlier
+        # breakage that would leave the bad-signature path untested
+        assert f"--verify {ARCHIVE}.asc {ARCHIVE}" in _log(cloud, "gpg")
+
+
+# ---------------------------------------------------------------------------
+# provision-jepsen-tpu-controller.sh — controller bring-up
+# ---------------------------------------------------------------------------
+
+SUDO_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/sudo.log"
+exit 0
+"""
+
+GIT_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/git.log"
+if [ "$1" = clone ]; then mkdir -p "${@: -1}"; fi
+exit 0
+"""
+
+PYTHON3_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/python3.log"
+if [ "$1" = -m ] && [ "$2" = venv ]; then
+  mkdir -p "$3/bin"
+  printf 'export JEPSEN_FAKE_VENV=1\\n' > "$3/bin/activate"
+fi
+exit 0
+"""
+
+PIP_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/pip.log"
+exit 0
+"""
+
+MAKE_FAKE = """#!/bin/bash
+printf '%s\\n' "$*" >> "$SHIM_LOG/make.log"
+exit 0
+"""
+
+PYTHON_FAKE = """#!/bin/bash
+printf '%s %s\\n' "$PWD" "$*" >> "$SHIM_LOG/python.log"
+exit 0
+"""
+
+
+class TestProvisionController:
+    def test_full_bring_up(self, cloud):
+        for name, body in (
+            ("sudo", SUDO_FAKE), ("git", GIT_FAKE),
+            ("python3", PYTHON3_FAKE), ("pip", PIP_FAKE),
+            ("make", MAKE_FAKE), ("python", PYTHON_FAKE),
+        ):
+            p = cloud["bins"] / name
+            p.write_text(body)
+            p.chmod(0o755)
+        env_over = {"JAX_EXTRA": "jax"}  # CPU-controller variant
+        r = _run_script(
+            cloud, "provision-jepsen-tpu-controller.sh", env_over
+        )
+        assert r.returncode == 0, r.stderr
+        assert "controller provisioned" in r.stdout
+        sudo = _log(cloud, "sudo")
+        assert "apt-get update" in sudo
+        assert "g++" in sudo and "python3-venv" in sudo
+        assert "clone" in _log(cloud, "git")
+        pip = _log(cloud, "pip")
+        assert "install jax numpy matplotlib" in pip
+        assert "install -e" in pip
+        assert "-C" in _log(cloud, "make")  # native driver built
+        # venv activation persisted for later ssh commands
+        profile = (cloud["home"] / ".profile").read_text()
+        assert "jepsen-tpu-venv/bin/activate" in profile
+        # the smoke check ran inside the repo checkout (the fake logs
+        # $PWD ahead of argv)
+        py = _log(cloud, "python")
+        assert (
+            f"{cloud['home']}/jepsen-tpu -m jepsen_tpu test --help" in py
+        )
+        # idempotence: a second run must not duplicate the profile line
+        r2 = _run_script(
+            cloud, "provision-jepsen-tpu-controller.sh", env_over
+        )
+        assert r2.returncode == 0, r2.stderr
+        profile2 = (cloud["home"] / ".profile").read_text()
+        assert profile2.count("jepsen-tpu-venv/bin/activate") == 1

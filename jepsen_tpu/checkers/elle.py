@@ -85,7 +85,13 @@ class TxnGraph:
 
 def _txn_micro_ops(op: Op) -> list[list]:
     v = op.value
-    return v if isinstance(v, (list, tuple)) else []
+    if not isinstance(v, (list, tuple)):
+        return []
+    # non-list elements are not micro-ops: skipped, same as wrong-arity
+    # or unknown-f micro-ops below (a raw TypeError out of len() on a
+    # malformed history helped nobody — found by the native-parser
+    # differential fuzz, which skips them)
+    return [m for m in v if isinstance(m, (list, tuple))]
 
 
 def infer_txn_graph(history: Sequence[Op]) -> TxnGraph:

@@ -518,6 +518,21 @@ class TestElleInferNative:
         g, ref = _assert_graph_identical(tmp_path, history)
         assert g.incompatible_order  # the mid-list case flagged
 
+    def test_scalar_micro_op_slots_are_skipped_not_crashed(self, tmp_path):
+        """Fuzz find (r5): a txn value like [7, 16, 7] made the Python
+        twin raise TypeError from len() while the native side skipped
+        the non-list elements; both now skip (the same treatment as
+        wrong-arity and unknown-f micro-ops)."""
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        history = [
+            mk([7, 16, 7]),
+            mk(["stray", ["append", 0, 1], None, ["r", 0, [1]]]),
+        ]
+        g, ref = _assert_graph_identical(tmp_path, history)
+        assert g.n == 2
+
 
 # ---------------------------------------------------------------------------
 # Native stream explosion (jt_stream_rows_file)

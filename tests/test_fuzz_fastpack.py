@@ -1,0 +1,192 @@
+"""Differential fuzz of the native JSONL substrate parsers.
+
+The C++ fast paths (``jt_pack_file``, ``jt_elle_infer_file``,
+``jt_stream_rows_file``) carry a *never-wrong, maybe-absent* contract:
+whatever they return must be bit-identical to the Python twin, and
+anything they cannot map must come back as a fallback (None), never a
+silently different result.  The structured differential tests
+(``test_fastpack.py``) pin known edge cases; this fuzz drives seeded
+random op streams with adversarial value shapes — boundary ints,
+floats, escaped/unicode strings, nested lists in and out of micro-op
+shape, objects, wrong-arity micro-ops, invalid enum names — through
+both sides and asserts:
+
+- native result present  ⇒ equals the Python twin's exactly;
+- Python twin raises     ⇒ native must NOT have produced a result.
+
+``FUZZ_N`` scales the case count (seeded: failures reproduce).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+
+import numpy as np
+import pytest
+
+FUZZ_N = int(os.environ.get("FUZZ_N", "120"))
+
+
+@pytest.fixture(autouse=True)
+def _require_native():
+    from jepsen_tpu.history import fastpack
+
+    if fastpack._load() is None:
+        pytest.skip("native rows packer unavailable")
+
+
+TYPES = ["invoke", "ok", "fail", "info"]
+FS = ["enqueue", "dequeue", "drain", "start", "stop", "log",
+      "append", "read", "txn", "acquire", "release"]
+#: mostly clean strings so files usually stay on the fast path, plus
+#: occasional escape-carrying ones (which force the deep parser's
+#: fallback — the contract under test, not the common case)
+STRINGS = ["", "full", "x", "append", "nullish", "r"]
+NASTY_STRINGS = ["with \\\\ backslash", "a\tb", '"quoted"', "unié"]
+
+
+def _value(rng: random.Random, depth: int = 0):
+    roll = rng.random()
+    if roll < 0.3:
+        # boundary/overflow ints stay rare: one per file on average, so
+        # most files exercise the agree path instead of the fallback
+        if rng.random() < 0.04:
+            return rng.choice([2**31 - 1, -(2**31), 2**31, 2**40,
+                               -(2**40)])
+        return rng.choice([0, 1, -1, 7, rng.randrange(-100, 1000)])
+    if roll < 0.36:
+        return rng.choice([0.5, -1.25, 1e10, 3.0])
+    if roll < 0.46:
+        pool = STRINGS if rng.random() < 0.9 else NASTY_STRINGS
+        return rng.choice(pool)
+    if roll < 0.54:
+        return rng.choice([True, False, None])
+    if roll < 0.62 and depth < 3:
+        return {rng.choice(STRINGS): _value(rng, depth + 1)}
+    if depth >= 3:
+        return rng.randrange(100)
+    # lists: sometimes micro-op / pair shaped, sometimes arbitrary
+    shape = rng.random()
+    if shape < 0.35:
+        return [rng.choice(["append", "r", "w", 7]),
+                rng.randrange(32) if rng.random() < 0.8
+                else _value(rng, depth + 1),
+                _value(rng, depth + 1)]
+    if shape < 0.55:
+        return [rng.randrange(32), rng.randrange(1000)]
+    return [_value(rng, depth + 1) for _ in range(rng.randrange(0, 4))]
+
+
+def _op(rng: random.Random, f_pool) -> dict:
+    d = {
+        "type": rng.choice(TYPES),
+        "f": rng.choice(f_pool),
+        "process": rng.choice([0, 1, 2, -1, rng.randrange(8)]),
+    }
+    if rng.random() < 0.85:
+        d["value"] = _value(rng)
+    if rng.random() < 0.2:
+        d["time"] = rng.choice([-1, 0, rng.randrange(10**12)])
+    if rng.random() < 0.15:
+        d["error"] = rng.choice(STRINGS + NASTY_STRINGS)
+    if rng.random() < 0.1:
+        d["index"] = rng.randrange(10**6)
+    if rng.random() < 0.005:
+        d["type"] = "bogus"  # Python raises KeyError: native must fail
+    return d
+
+
+def _write(tmp_path, rng, f_pool, n_ops=25):
+    p = tmp_path / f"fuzz{rng.randrange(10**9)}.jsonl"
+    with open(p, "w") as fh:
+        for _ in range(n_ops):
+            fh.write(json.dumps(_op(rng, f_pool)) + "\n")
+            if rng.random() < 0.05:
+                fh.write("\n")  # blank lines are skipped by both sides
+    return p
+
+
+def _python_history(p):
+    from jepsen_tpu.history.store import read_history
+
+    try:
+        return read_history(p), None
+    except Exception as e:  # noqa: BLE001 - canonical error path
+        return None, e
+
+
+def test_fuzz_pack_file(tmp_path):
+    from jepsen_tpu.history.fastpack import pack_file
+    from jepsen_tpu.history.ops import workload_of
+    from jepsen_tpu.history.rows import _rows_for
+
+    rng = random.Random(1234)
+    agreed = 0
+    for _ in range(FUZZ_N):
+        p = _write(tmp_path, rng, FS)
+        fast = pack_file(p)
+        history, err = _python_history(p)
+        if err is not None:
+            assert fast is None, (p, err)
+            continue
+        if fast is None:
+            continue  # fallback is always allowed
+        try:
+            ref = _rows_for(history)
+        except OverflowError:
+            pytest.fail(f"native accepted what Python overflows: {p}")
+        assert fast[0] == workload_of(history), p
+        np.testing.assert_array_equal(fast[1], ref, err_msg=str(p))
+        agreed += 1
+    assert agreed > FUZZ_N // 4  # the fuzz isn't all-fallback vacuous
+
+
+def test_fuzz_elle_graph_file(tmp_path):
+    from jepsen_tpu.checkers.elle import infer_txn_graph
+    from jepsen_tpu.history.fastpack import elle_graph_file
+
+    rng = random.Random(99)
+    agreed = 0
+    for _ in range(FUZZ_N):
+        p = _write(tmp_path, rng, ["txn", "log", "start"])
+        g = elle_graph_file(p)
+        history, err = _python_history(p)
+        if err is not None:
+            assert g is None, (p, err)
+            continue
+        if g is None:
+            continue
+        try:
+            ref = infer_txn_graph(history)
+        except Exception:  # noqa: BLE001 - e.g. unhashable fuzzed keys
+            pytest.fail(f"native accepted what Python rejects: {p}")
+        assert g.n == ref.n and g.txn_index == ref.txn_index, p
+        assert (g.ww, g.wr, g.rw) == (ref.ww, ref.wr, ref.rw), p
+        assert (g.g1a, g.g1b) == (ref.g1a, ref.g1b), p
+        assert g.incompatible_order == ref.incompatible_order, p
+        agreed += 1
+    assert agreed > FUZZ_N // 4
+
+
+def test_fuzz_stream_rows_file(tmp_path):
+    from jepsen_tpu.checkers.stream_lin import _stream_rows
+    from jepsen_tpu.history.fastpack import stream_rows_file
+
+    rng = random.Random(4242)
+    agreed = 0
+    for _ in range(FUZZ_N):
+        p = _write(tmp_path, rng, ["append", "read", "log", "stop"])
+        got = stream_rows_file(p)
+        history, err = _python_history(p)
+        if err is not None:
+            assert got is None, (p, err)
+            continue
+        if got is None:
+            continue
+        ref_cols, ref_full = _stream_rows(history)
+        np.testing.assert_array_equal(got[0], ref_cols, err_msg=str(p))
+        assert got[1] == ref_full, p
+        agreed += 1
+    assert agreed > FUZZ_N // 4

@@ -86,3 +86,51 @@ def test_cli_check_survives_backend_deadline(tmp_path):
     # either the warning fired (deadline hit) or the probe beat 50 ms —
     # in this environment the tunnel takes seconds, so expect the warning
     assert "falling back to the CPU backend" in (r.stdout + r.stderr)
+
+
+class TestCompilationCache:
+    def test_env_off_disables(self, tmp_path, monkeypatch):
+        from jepsen_tpu.utils import jaxenv
+
+        for off in ("0", "off", "none", ""):
+            monkeypatch.setenv(jaxenv.COMPILE_CACHE_ENV, off)
+            assert jaxenv.enable_compilation_cache(str(tmp_path)) is None
+
+    def test_env_path_overrides_argument(self, tmp_path, monkeypatch):
+        import jax
+
+        from jepsen_tpu.utils import jaxenv
+
+        prev = jax.config.jax_compilation_cache_dir
+        override = tmp_path / "elsewhere"
+        monkeypatch.setenv(jaxenv.COMPILE_CACHE_ENV, str(override))
+        try:
+            got = jaxenv.enable_compilation_cache(str(tmp_path / "arg"))
+            assert got == str(override)
+            assert override.is_dir()  # created
+            assert jax.config.jax_compilation_cache_dir == str(override)
+        finally:
+            # the tmp dir dies with the test: a dangling global cache
+            # path would soft-fail every later compile in this process
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+    def test_unusable_dir_fails_soft(self, tmp_path, monkeypatch):
+        """A missing cache must never sink a run: unusable dir -> None,
+        the caller proceeds uncached."""
+        from jepsen_tpu.utils import jaxenv
+
+        monkeypatch.delenv(jaxenv.COMPILE_CACHE_ENV, raising=False)
+        blocker = tmp_path / "f"
+        blocker.write_text("not a dir")
+        assert (
+            jaxenv.enable_compilation_cache(str(blocker / "sub")) is None
+        )
+
+    def test_entry_count(self, tmp_path):
+        from jepsen_tpu.utils.jaxenv import compile_cache_entries
+
+        assert compile_cache_entries(None) == 0
+        assert compile_cache_entries(str(tmp_path / "nope")) == 0
+        (tmp_path / "a-cache").write_text("x")
+        (tmp_path / ".hidden").write_text("x")
+        assert compile_cache_entries(str(tmp_path)) == 1

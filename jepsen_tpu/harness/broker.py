@@ -260,7 +260,8 @@ class MiniAmqpBroker:
     def _orphan_sweep_loop(self) -> None:
         if self.replication.raft.seed_bug == "drop-unacked-on-close":
             return  # seeded: the requeue machinery is broken everywhere
-        prefix = self.replication.raft.name + "|"
+        raft = self.replication.raft
+        prefix = raft.name + "|"
         machine = self.replication.machine
         suspects: set[str] = set()  # orphaned on the previous tick too
         while not self._stopped:
@@ -268,14 +269,35 @@ class MiniAmqpBroker:
             if not self._running:
                 continue
             with machine.lock:
-                owners = {
-                    o
-                    for o, _q, _m in machine.inflight.values()
-                    if o.startswith(prefix)
+                all_owners = {
+                    o for o, _q, _m in machine.inflight.values()
                 }
+            owners = {o for o in all_owners if o.startswith(prefix)}
             with self.state_lock:
                 live = {c.owner for c in self._conns}
             orphaned = owners - live
+            # departed-member sweep (r5 burn-in find, lost value 16943):
+            # inflight owned by a node that is NO LONGER IN the cluster
+            # config is nobody's responsibility — the forgotten node's
+            # own sweep cannot submit (it restarts outside the cluster,
+            # or never restarts), and the leader's dead-NODE reaper only
+            # watches CURRENT members.  Every member therefore also
+            # re-proposes requeues for departed owners (salted owner ids
+            # make this safe across fresh rejoins under the same name;
+            # requeue_owner is idempotent, so N members proposing is
+            # redundancy, not a hazard).  Skipped while this node is
+            # OUTSIDE a cluster — pending joiner or retired — whose
+            # self-only view would mark the whole world departed; a
+            # legitimately shrunk cluster (even 1-node) still sweeps.
+            with raft.lock:
+                outside = raft._pending_locked()
+                members = set(raft.peers)
+            if not outside:
+                orphaned |= {
+                    o
+                    for o in all_owners
+                    if o.split("|", 1)[0] not in members
+                }
             # two-strike grace: don't race the close handler's own sweep
             # (a double requeue is idempotent, this just avoids spurious
             # submits); re-proposing every tick until the entry leaves

@@ -57,13 +57,14 @@ def _free_port() -> int:
 
 
 class _Cluster:
-    def __init__(self, n=3, seed_bug=None):
+    def __init__(self, n=3, seed_bug=None, **overrides):
         names = [f"n{i}" for i in range(n)]
         peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
+        opts = {**FAST, **overrides}
         self.brokers: dict[str, MiniAmqpBroker] = {}
         for nm in names:
             backend = ReplicatedBackend(
-                nm, peers, seed_bug=seed_bug, **FAST
+                nm, peers, seed_bug=seed_bug, **opts
             )
             self.brokers[nm] = MiniAmqpBroker(
                 port=0, replication=backend
@@ -491,3 +492,68 @@ def test_orphaned_inflight_requeued_after_lost_close_sweep(
     assert not still, f"inflight entry stranded after lost sweep: {still}"
     assert pub.dequeue(5.0) == 55
     pub.close()
+
+
+def test_departed_member_inflight_requeued_by_survivors(native_lib):
+    """Round-5 burn-in find (10-min 5-node mixed soak, lost value 16943):
+    a consumer held an un-acked delivery on a node that was then killed,
+    FORGOTTEN (RemoveServer), and restarted OUTSIDE the cluster (its
+    rejoin failed).  Nobody requeued the inflight entry: the departed
+    node's own sweep cannot submit (no leader to forward to), and the
+    leader's dead-NODE reaper only watches current members — the message
+    sat inflight through the whole drain and total-queue flagged it
+    lost.  Every member's orphan sweep now also re-proposes requeues for
+    owners whose node has LEFT the config.
+
+    dead_owner_s is huge here so the old dead-node reaper cannot mask
+    the hole: with the departed-member sweep reverted, the entry
+    strands forever and this test fails."""
+    # a reaper that can never fire inside the test window
+    c = _Cluster(dead_owner_s=60.0)
+    try:
+        lead = c.leader()
+        victim = c.followers()[0]
+        vb = c.brokers[victim]
+
+        pub = _driver(native_lib, c.brokers[lead])
+        pub.setup()
+        cons = _driver(native_lib, vb, consumer_type="asynchronous")
+        cons.setup()
+        assert pub.enqueue(77, 5.0) is True
+
+        # wait until the replicated inflight entry is owned by victim
+        deadline = time.monotonic() + 5.0
+        prefix = victim + "|"
+        while time.monotonic() < deadline:
+            with vb.replication.machine.lock:
+                owners = {
+                    o
+                    for o, _q, _m in vb.replication.machine.inflight.values()
+                }
+            if any(o.startswith(prefix) for o in owners):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError(f"no inflight owned by {victim}: {owners}")
+
+        # SIGKILL semantics: no close handlers, no goodbye requeue —
+        # the victim's own machinery is gone for good (it "restarts
+        # outside the cluster", unable to submit anything)
+        vb.replication.requeue_owner = lambda owner: None
+        vb.stop()
+
+        # forget_cluster_node: the cluster genuinely shrinks to 2/2
+        survivor = c.brokers[lead]
+        assert survivor.replication.raft.request_forget(victim)
+
+        # the survivors' departed-member sweep must re-ready the message
+        deadline = time.monotonic() + 6.0
+        got = None
+        while time.monotonic() < deadline and got is None:
+            got = pub.dequeue(1.0)
+        assert got == 77, (
+            f"departed member's inflight delivery never requeued "
+            f"(got {got!r})"
+        )
+    finally:
+        c.stop()

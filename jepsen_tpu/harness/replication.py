@@ -352,6 +352,8 @@ class RaftNode:
         self.next_idx: dict[str, int] = {}
         self.match_idx: dict[str, int] = {}
         self.last_peer_ok: dict[str, float] = {}
+        #: peers with a catch-up loop in flight (single-flight per peer)
+        self._replicating: set[str] = set()
         self.waiters: dict[int, _Waiter] = {}
         self.blocked: set[str] = set()
         self._last_heartbeat = time.monotonic()
@@ -1069,20 +1071,92 @@ class RaftNode:
 
     def _replicate_once(self) -> None:
         """One replication round to every peer (called from the ticker and
-        immediately after a local submit)."""
+        immediately after a local submit).  Per-peer single-flight: a
+        peer already mid-catch-up gets a lightweight HEARTBEAT instead —
+        a batched catch-up RPC can outlast the follower's election
+        timeout, and a follower whose replies are slow/lost must still
+        see appends at tick rate or it starts disruptive elections the
+        one-RPC-at-a-time loop alone would cause (review r5)."""
         with self.lock:
             if self.state != LEADER:
                 return
             term = self.term
-        for peer in self.others:
+            fresh = [p for p in self.others if p not in self._replicating]
+            busy = [p for p in self.others if p in self._replicating]
+            self._replicating.update(fresh)
+        for peer in fresh:
             threading.Thread(
-                target=self._replicate_peer, args=(peer, term), daemon=True
+                target=self._replicate_peer_loop,
+                args=(peer, term),
+                daemon=True,
+            ).start()
+        for peer in busy:
+            threading.Thread(
+                target=self._heartbeat_peer,
+                args=(peer, term),
+                daemon=True,
             ).start()
 
-    def _replicate_peer(self, peer: str, term: int) -> None:
+    def _heartbeat_peer(self, peer: str, term: int) -> None:
+        """Empty AppendEntries at a known-matching point: feeds the
+        follower's election timer (its deadline resets on receipt,
+        before any log checks) without touching the catch-up loop's
+        next/match bookkeeping."""
         with self.lock:
             if self.state != LEADER or self.term != term:
                 return
+            prev = min(self.match_idx.get(peer, 0), len(self.log))
+            prev_term = self.log[prev - 1][0] if prev > 0 else 0
+            msg = {
+                "rpc": "append_entries",
+                "term": term,
+                "from": self.name,
+                "prev_idx": prev,
+                "prev_term": prev_term,
+                "entries": [],
+                "leader_commit": self.commit_idx,
+            }
+        resp = self._rpc(peer, msg, timeout_s=min(0.2, self.eto[0]))
+        if resp is None:
+            return
+        with self.lock:
+            if resp["term"] > self.term:
+                self._become_follower(resp["term"])
+            elif self.state == LEADER and self.term == term:
+                self.last_peer_ok[peer] = time.monotonic()
+
+    def _replicate_peer_loop(self, peer: str, term: int) -> None:
+        """Batches back-to-back until the peer is caught up (or stops
+        answering).  One batch per ticker tick was the round-5 burn-in's
+        failed-rejoin cause: a fresh joiner replaying a long run's log
+        (60k+ entries at 256/batch) needed hundreds of ticks — minutes —
+        while ``request_join`` waits seconds.  The loop bound is a
+        runaway backstop, not a contract; the next tick re-engages."""
+        try:
+            for _ in range(4096):
+                if not self._replicate_peer(peer, term):
+                    return
+        finally:
+            with self.lock:
+                self._replicating.discard(peer)
+                # closed race (review r5): a submit that arrived while
+                # this loop was deciding to exit had its replication
+                # kick swallowed by the single-flight skip — re-engage
+                # rather than waiting out a full tick
+                behind = (
+                    self.state == LEADER
+                    and self.term == term
+                    and self.match_idx.get(peer, 0) < len(self.log)
+                )
+            if behind:
+                self._replicate_once()
+
+    def _replicate_peer(self, peer: str, term: int) -> bool:
+        """One AppendEntries batch; True iff the peer acked AND remains
+        behind (the caller should continue immediately)."""
+        with self.lock:
+            if self.state != LEADER or self.term != term:
+                return False
             nxt = self.next_idx.get(peer, len(self.log) + 1)
             prev = nxt - 1
             prev_term = self.log[prev - 1][0] if prev > 0 else 0
@@ -1098,23 +1172,26 @@ class RaftNode:
             }
         resp = self._rpc(peer, msg, timeout_s=self.eto[0])
         if resp is None:
-            return
+            return False  # unreachable: the next tick retries
         with self.lock:
             if resp["term"] > self.term:
                 self._become_follower(resp["term"])
-                return
+                return False
             if self.state != LEADER or self.term != term:
-                return
+                return False
             self.last_peer_ok[peer] = time.monotonic()
             if resp.get("ok"):
                 self.match_idx[peer] = prev + len(entries)
                 self.next_idx[peer] = self.match_idx[peer] + 1
                 self._advance_commit_locked()
-            else:
-                # follower is behind/diverged: back off (its hint if given)
-                self.next_idx[peer] = max(
-                    1, min(resp.get("have", prev - 1) + 1, nxt - 1)
-                )
+                return self.match_idx[peer] < len(self.log)
+            # follower is behind/diverged: back off (its hint if given)
+            # and immediately probe again — convergence must not wait a
+            # tick per backoff step either
+            self.next_idx[peer] = max(
+                1, min(resp.get("have", prev - 1) + 1, nxt - 1)
+            )
+            return True
 
     def _advance_commit_locked(self) -> None:
         for idx in range(len(self.log), self.commit_idx, -1):

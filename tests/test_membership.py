@@ -484,3 +484,45 @@ def test_admin_port_serves_concurrently_past_a_stalled_connection():
             stalled.close()
     finally:
         t.close()
+
+
+def test_fresh_join_catches_up_through_a_long_log():
+    """r5 burn-in find #2: rejoins FAILED in long runs ("join ok=False")
+    because catch-up shipped one 256-entry batch per ticker tick — a
+    fresh joiner replaying a long run's log needed hundreds of ticks
+    while ``request_join`` waits seconds.  The leader now loops catch-up
+    batches back-to-back (single-flight per peer), so a 40k-entry log
+    replays within one join window."""
+    # production-like tick: catch-up speed must come from the loop, not
+    # from a fast test clock papering over one-batch-per-tick
+    mk = lambda name, boot: ReplicatedBackend(
+        name, {name: ("127.0.0.1", 0)},
+        election_timeout=(0.3, 0.6), heartbeat_s=0.1, bootstrap=boot,
+    )
+    a = mk("a", True)
+    b = mk("b", False)
+    try:
+        _wait(lambda: a.raft.is_leader(), what="bootstrap leader")
+        with a.raft.lock:
+            t = a.raft.term
+            for _ in range(150_000):
+                a.raft.log.append((t, {"k": "noop"}))
+            a.raft.commit_idx = len(a.raft.log)  # 1-node: self-quorum
+            a.raft.applied_idx = a.raft.commit_idx
+
+        # pre-fix: 150000/256 ≈ 586 batches at one per 100 ms tick is a
+        # ≥ 58 s floor BEFORE any RPC cost, so the join window expires;
+        # post-fix the batches stream back-to-back and the whole join —
+        # membership commit + full-log catch-up — fits comfortably
+        assert b.raft.request_join(
+            ("127.0.0.1", a.raft.port), timeout_s=20.0
+        )
+        _wait(
+            lambda: len(b.raft.log) >= 150_000,
+            timeout_s=10.0,
+            what="joiner log catch-up",
+        )
+        assert set(b.raft.peers) == {"a", "b"}
+    finally:
+        a.stop()
+        b.stop()

@@ -20,10 +20,18 @@ scatter/scan program, not an interleaving search:
   values — readers disagree on the log, no single order exists.
 - **duplicate** (value ``v``): ``v`` observed at two distinct offsets — a
   confirmed append materialized twice (e.g. an internal retry).
-- **phantom** (value ``v``): ``v`` read though never attempted, or though
-  every append attempt definitely failed (``fail`` = did not happen;
-  ``info`` = may have happened and is NOT a phantom — the indeterminacy
-  rule the queue checkers share).
+- **phantom** (value ``v``): ``v`` read though its append was never even
+  attempted — fabricated data, invalidating.
+- **recovered** (value ``v``): ``v`` read though every append attempt
+  completed ``fail`` — a connection-layer fail is the CLIENT's verdict,
+  not the broker's (the reference maps unexpected enqueue exceptions to
+  ``:fail``, ``rabbitmq.clj:211-213``, and its ``total-queue`` forgives
+  the materialized ones as ``:recovered``); reported, NOT invalidating —
+  the same bucket the queue checker carries.  ``info`` attempts = may
+  have happened and are neither (the indeterminacy rule the queue
+  checkers share).  Found by the r5 stream burn-in: a 29-s partition
+  stall returned ConnectionError for appends the broker had committed,
+  and the old fail-means-absent reading called them phantoms.
 - **reorder** (offset ``o``): real-time order violated — the value at some
   offset ``o' > o`` had its append *completed* (ok) before the append of
   the value at ``o`` was *invoked*.  With ``s[o]`` = append-invoke position
@@ -101,7 +109,19 @@ def read_pairs(op: Op) -> list[tuple[int, int]]:
 # ---------------------------------------------------------------------------
 
 
-def check_stream_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
+def check_stream_lin_cpu(
+    history: Sequence[Op], append_fail: str = "definite"
+) -> dict[str, Any]:
+    """``append_fail`` is the SUT's contract for a fail-typed append
+    (mirroring the queue checker's ``delivery`` scoping): ``definite``
+    (default — the sim substrate, whose False return is authoritative)
+    means a read of an all-fail value is an invalidating phantom;
+    ``indeterminate`` (real-socket SUTs, where a connection error is the
+    CLIENT's verdict, not the broker's — the reference maps unexpected
+    enqueue exceptions to ``:fail``, ``rabbitmq.clj:211-213``) bins it
+    as ``recovered``: reported, not invalidating."""
+    if append_fail not in ("definite", "indeterminate"):
+        raise ValueError(f"unknown append_fail {append_fail!r}")
     app_invokes: dict[int, int] = {}  # v -> invoke count
     app_acks: dict[int, int] = {}  # v -> ok count
     app_fails: dict[int, int] = {}  # v -> definite-fail count
@@ -145,12 +165,17 @@ def check_stream_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
 
     divergent = {o for o, vs in off_vals.items() if len(vs) > 1}
     duplicate = {v for v, os_ in read_vals.items() if len(os_) > 1}
-    phantom = {
+    all_fail = {
         v
         for v in read_vals
-        if app_invokes.get(v, 0) == 0
-        or app_fails.get(v, 0) >= app_invokes.get(v, 0)
+        if 0 < app_invokes.get(v, 0) <= app_fails.get(v, 0)
     }
+    phantom = {v for v in read_vals if app_invokes.get(v, 0) == 0}
+    if append_fail == "definite":
+        phantom |= all_fail
+        recovered: set[int] = set()
+    else:
+        recovered = all_fail
 
     # real-time order: offsets ascending, exclusive suffix-min of e.  With
     # divergent values at one offset the kernel combines across them
@@ -184,12 +209,15 @@ def check_stream_lin_cpu(history: Sequence[Op]) -> dict[str, Any]:
         "duplicate-count": len(duplicate),
         "phantom": phantom,
         "phantom-count": len(phantom),
+        "recovered": recovered,
+        "recovered-count": len(recovered),
         "reorder": reorder,
         "reorder-count": len(reorder),
         "nonmonotonic-count": nonmono,
         "lost": lost,
         "lost-count": len(lost),
         "full-read": full_read,
+        "append-fail": append_fail,
     }
 
 
@@ -336,6 +364,7 @@ class StreamLinTensors:
     divergent: jax.Array  # [B, S] bool (by offset)
     duplicate: jax.Array  # [B, S] bool (by value)
     phantom: jax.Array  # [B, S] bool (by value)
+    recovered: jax.Array  # [B, S] bool (by value; reported, not invalid)
     reorder: jax.Array  # [B, S] bool (by offset)
     nonmonotonic_count: jax.Array  # [B] i32
     lost: jax.Array  # [B, S] bool (by value)
@@ -419,14 +448,23 @@ def _stream_nonmono_local(type_, f, value, offset, mask, first):
     return nonmono.sum().astype(jnp.int32)
 
 
-def _stream_classify(stats, s_at, e_at, nonmono_count, full_read):
-    """Combined [S] stats → verdict tensors (replicated over seq)."""
+def _stream_classify(
+    stats, s_at, e_at, nonmono_count, full_read, fail_definite=True
+):
+    """Combined [S] stats → verdict tensors (replicated over seq).
+    ``fail_definite``: see ``check_stream_lin_cpu``'s ``append_fail``."""
     a, k, x, r = stats["a"], stats["k"], stats["x"], stats["r"]
     observed = stats["obs"] >= 1
     read = r >= 1
     duplicate = read & (stats["omin"] != stats["omax"])
     divergent = observed & (stats["vmin"] != stats["vmax"])
-    phantom = read & ((a == 0) | (x >= a))
+    all_fail = read & (a > 0) & (x >= a)
+    if fail_definite:
+        phantom = read & ((a == 0) | (x >= a))
+        recovered = jnp.zeros_like(all_fail)
+    else:
+        phantom = read & (a == 0)
+        recovered = all_fail
 
     # real-time order over the offset axis: an exclusive reversed
     # cumulative min finds any later-offset append that completed before
@@ -452,6 +490,7 @@ def _stream_classify(stats, s_at, e_at, nonmono_count, full_read):
         divergent=divergent,
         duplicate=duplicate,
         phantom=phantom,
+        recovered=recovered,
         reorder=reorder,
         nonmonotonic_count=nonmono_count,
         lost=lost,
@@ -461,25 +500,37 @@ def _stream_classify(stats, s_at, e_at, nonmono_count, full_read):
     )
 
 
-def _stream_lin_one(type_, f, value, offset, pos, mask, first, full_read, S):
+def _stream_lin_one(
+    type_, f, value, offset, pos, mask, first, full_read, S,
+    fail_definite=True,
+):
     stats = _stream_phase_a(type_, f, value, offset, pos, mask, S)
     s_at, e_at = _stream_phase_b(
         type_, f, value, offset, mask, stats["s_v"], stats["e_v"], S
     )
     nonmono_count = _stream_nonmono_local(type_, f, value, offset, mask, first)
-    return _stream_classify(stats, s_at, e_at, nonmono_count, full_read)
+    return _stream_classify(
+        stats, s_at, e_at, nonmono_count, full_read, fail_definite
+    )
 
 
-@functools.partial(jax.jit, static_argnames=("space",))
-def _stream_lin_batch(type_, f, value, offset, pos, mask, first, full_read, space):
+@functools.partial(
+    jax.jit, static_argnames=("space", "fail_definite")
+)
+def _stream_lin_batch(
+    type_, f, value, offset, pos, mask, first, full_read, space,
+    fail_definite=True,
+):
     return jax.vmap(
         lambda t, ff, v, o, p, m, fr, fl: _stream_lin_one(
-            t, ff, v, o, p, m, fr, fl, space
+            t, ff, v, o, p, m, fr, fl, space, fail_definite
         )
     )(type_, f, value, offset, pos, mask, first, full_read)
 
 
-def stream_lin_tensor_check(batch: StreamBatch) -> StreamLinTensors:
+def stream_lin_tensor_check(
+    batch: StreamBatch, append_fail: str = "definite"
+) -> StreamLinTensors:
     return _stream_lin_batch(
         batch.type,
         batch.f,
@@ -490,6 +541,7 @@ def stream_lin_tensor_check(batch: StreamBatch) -> StreamLinTensors:
         batch.first,
         batch.full_read,
         batch.space,
+        fail_definite=append_fail == "definite",
     )
 
 
@@ -501,6 +553,7 @@ def stream_lin_tensors_to_results(
         "divergent": np.asarray(t.divergent),
         "duplicate": np.asarray(t.duplicate),
         "phantom": np.asarray(t.phantom),
+        "recovered": np.asarray(t.recovered),
         "reorder": np.asarray(t.reorder),
         "lost": np.asarray(t.lost),
     }
@@ -529,22 +582,38 @@ def check_stream_lin_batch(
     histories: Sequence[Sequence[Op]],
     length: int | None = None,
     space: int | None = None,
+    append_fail: str = "definite",
 ) -> list[dict[str, Any]]:
     batch = pack_stream_histories(histories, length=length, space=space)
-    return stream_lin_tensors_to_results(
-        stream_lin_tensor_check(batch), np.asarray(batch.full_read).tolist()
+    out = stream_lin_tensors_to_results(
+        stream_lin_tensor_check(batch, append_fail=append_fail),
+        np.asarray(batch.full_read).tolist(),
     )
+    for r in out:
+        r["append-fail"] = append_fail
+    return out
 
 
 class StreamLinearizability(Checker):
-    """Single-partition log linearizability (BASELINE config #4)."""
+    """Single-partition log linearizability (BASELINE config #4).
+
+    ``append_fail``: the SUT's contract for fail-typed appends — see
+    :func:`check_stream_lin_cpu` (``definite`` for the sim, whose False
+    return is authoritative; ``indeterminate`` for real-socket SUTs,
+    where a connection error is the client's verdict, not the
+    broker's)."""
 
     name = "stream-linearizability"
 
-    def __init__(self, backend: str = "tpu"):
+    def __init__(
+        self, backend: str = "tpu", append_fail: str = "definite"
+    ):
         if backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {backend!r}")
+        if append_fail not in ("definite", "indeterminate"):
+            raise ValueError(f"unknown append_fail {append_fail!r}")
         self.backend = backend
+        self.append_fail = append_fail
 
     def check(
         self,
@@ -553,5 +622,9 @@ class StreamLinearizability(Checker):
         opts: Mapping[str, Any] | None = None,
     ) -> dict[str, Any]:
         if self.backend == "cpu":
-            return check_stream_lin_cpu(history)
-        return check_stream_lin_batch([history])[0]
+            return check_stream_lin_cpu(
+                history, append_fail=self.append_fail
+            )
+        return check_stream_lin_batch(
+            [history], append_fail=self.append_fail
+        )[0]

@@ -121,7 +121,11 @@ def _checker_for(args, out_dir=None, history=None):
         return compose(
             {
                 "perf": Perf(out_dir=out_dir),
-                "stream": StreamLinearizability(backend=backend),
+                "stream": StreamLinearizability(
+                    backend=backend,
+                    append_fail=getattr(args, "append_fail", None)
+                    or "definite",
+                ),
             }
         )
     if workload == "elle":
@@ -181,6 +185,8 @@ def cmd_check(args) -> int:
         )
     if getattr(args, "delivery", None) is None:
         args.delivery = prev.get("linear", {}).get("delivery")
+    if getattr(args, "append_fail", None) is None:
+        args.append_fail = prev.get("stream", {}).get("append-fail")
     checker = _checker_for(args, out_dir=out_dir, history=history)
     log_pat = getattr(args, "log_file_pattern", None) or prev.get(
         "log-file-pattern", {}
@@ -1030,6 +1036,7 @@ def cmd_synth(args) -> int:
             duplicated=args.duplicated,
             divergent=args.divergent,
             reorder=args.reorder,
+            recovered=getattr(args, "recovered", 0),
         )
     elif getattr(args, "workload", "queue") == "elle":
         from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
@@ -1112,6 +1119,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="queue histories: the SUT's delivery contract (default: the "
         "contract recorded with the run's results, else exactly-once — "
         "same no-silent-tightening rule as --consistency-model)",
+    )
+    c.add_argument(
+        "--append-fail",
+        dest="append_fail",
+        choices=("definite", "indeterminate"),
+        default=None,
+        help="stream histories: whether a fail-typed append is "
+        "authoritative (sim: definite, a read of it is a phantom) or "
+        "the client's verdict only (real sockets: indeterminate, a "
+        "materialized one is `recovered`); default: the contract "
+        "recorded with the run's results, else definite",
     )
     c.add_argument(
         "--wgl",
@@ -1414,6 +1432,12 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--duplicated", type=int, default=0)
     s.add_argument("--unexpected", type=int, default=0, help="queue workload")
     s.add_argument("--divergent", type=int, default=0, help="stream workload")
+    s.add_argument(
+        "--recovered", type=int, default=0,
+        help="stream workload: appends completed FAIL whose value is in "
+        "the log anyway (phantom under --append-fail definite, recovered "
+        "under indeterminate)",
+    )
     s.add_argument("--reorder", type=int, default=0, help="stream workload")
     s.add_argument("--g1a", type=int, default=0, help="elle workload")
     s.add_argument("--g1b", type=int, default=0, help="elle workload")

@@ -232,6 +232,10 @@ class StreamSynthSpec:
     phantom: int = 0  # read of a never-attempted value
     reorder: int = 0  # log order contradicts real-time append order
     nonmonotonic: int = 0  # a read batch going backwards
+    recovered: int = 0  # append completed FAIL yet the value is in the
+    #                     log (the connection-error-after-commit shape:
+    #                     phantom under append_fail=definite, recovered
+    #                     under indeterminate)
 
 
 @dataclass
@@ -244,9 +248,12 @@ class StreamSynthHistory:
     phantom: set[int] = field(default_factory=set)  # values
     reorder: set[int] = field(default_factory=set)  # offsets
     nonmonotonic: int = 0
+    recovered: set[int] = field(default_factory=set)  # values
 
     @property
     def clean(self) -> bool:
+        # recovered counts as unclean: under the strict (definite)
+        # contract it reads as a phantom
         return not (
             self.lost
             or self.duplicated
@@ -254,6 +261,7 @@ class StreamSynthHistory:
             or self.phantom
             or self.reorder
             or self.nonmonotonic
+            or self.recovered
         )
 
 
@@ -350,6 +358,28 @@ def synth_stream_history(spec: StreamSynthSpec) -> StreamSynthHistory:
         v = next_value + 1000 + len(out.phantom)
         log.append(v)
         out.phantom.add(v)
+    for _ in range(spec.recovered):
+        # flip an acked-and-in-log value's completion to FAIL: the
+        # connection-error-after-commit shape the r5 stream burn-in hit
+        if not mutable:
+            break
+        v = mutable.pop()
+        for i, o_ in enumerate(ops):
+            if (
+                o_.f == OpF.APPEND
+                and o_.type == OpType.OK
+                and o_.value == v
+            ):
+                ops[i] = Op(
+                    OpType.FAIL,
+                    OpF.APPEND,
+                    o_.process,
+                    v,
+                    time=o_.time,
+                    error="connection error (broker kept it)",
+                )
+                out.recovered.add(v)
+                break
     if spec.reorder:
         # move an unread acked value to the tail: every offset it jumps
         # over now holds a value invoked after the moved value completed.
@@ -367,7 +397,13 @@ def synth_stream_history(spec: StreamSynthSpec) -> StreamSynthHistory:
                     s_pos.setdefault(o_.value, pos)
                 elif o_.type == OpType.OK:
                     e_pos.setdefault(o_.value, pos)
-        movable = [v for v in log[hi : max(len(log) - 2, hi)] if v in acked_set]
+        movable = [
+            v
+            for v in log[hi : max(len(log) - 2, hi)]
+            # a recovered-flipped value has no OK completion left, so
+            # moving it would inject zero checker-visible reorder
+            if v in acked_set and v not in out.recovered
+        ]
         moved: list[int] = []
         for _ in range(spec.reorder):
             if not movable:

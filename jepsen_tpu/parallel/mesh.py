@@ -199,7 +199,7 @@ def _hist_sharded(tree, mesh: Mesh):
 
 
 @functools.lru_cache(maxsize=64)
-def _stream_lin_program(mesh: Mesh, space: int):
+def _stream_lin_program(mesh: Mesh, space: int, fail_definite: bool = True):
     from jepsen_tpu.checkers.stream_lin import (
         STREAM_COMBINE as _STREAM_COMBINE,
         _stream_classify,
@@ -253,7 +253,9 @@ def _stream_lin_program(mesh: Mesh, space: int):
         nm = jax.lax.psum(nm + boundary.astype(jnp.int32), SEQ_AXIS)
 
         return jax.vmap(
-            lambda st, sa, ea, n, fl: _stream_classify(st, sa, ea, n, fl)
+            lambda st, sa, ea, n, fl: _stream_classify(
+                st, sa, ea, n, fl, fail_definite
+            )
         )(combined, s_at, e_at, nm, full_read)
 
     from jepsen_tpu.checkers.stream_lin import StreamLinTensors
@@ -263,6 +265,7 @@ def _stream_lin_program(mesh: Mesh, space: int):
         divergent=P(HIST_AXIS, None),
         duplicate=P(HIST_AXIS, None),
         phantom=P(HIST_AXIS, None),
+        recovered=P(HIST_AXIS, None),
         reorder=P(HIST_AXIS, None),
         nonmonotonic_count=P(HIST_AXIS),
         lost=P(HIST_AXIS, None),
@@ -318,17 +321,22 @@ def shard_stream_batch(batch, mesh: Mesh):
     )
 
 
-def sharded_stream_lin(batch, mesh: Mesh):
+def sharded_stream_lin(batch, mesh: Mesh, append_fail: str = "definite"):
     """Stream-log linearizability over the mesh.  ``seq=1`` meshes take
     the zero-communication data-parallel path; larger ``seq`` runs the
     seq-parallel program above (long histories shard across chips — the
-    long-context lever, same shape as the queue family)."""
+    long-context lever, same shape as the queue family).  ``append_fail``
+    scopes fail-typed-append forgiveness (see ``check_stream_lin_cpu``)."""
     if mesh.shape[SEQ_AXIS] == 1:
         from jepsen_tpu.checkers.stream_lin import stream_lin_tensor_check
 
-        return stream_lin_tensor_check(_hist_sharded(batch, mesh))
+        return stream_lin_tensor_check(
+            _hist_sharded(batch, mesh), append_fail=append_fail
+        )
     sharded = shard_stream_batch(batch, mesh)
-    fn = _stream_lin_program(mesh, batch.space)
+    fn = _stream_lin_program(
+        mesh, batch.space, fail_definite=append_fail == "definite"
+    )
     return fn(
         sharded.type,
         sharded.f,

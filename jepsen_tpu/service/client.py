@@ -107,6 +107,7 @@ class CheckerClient:
         histories: Sequence[Sequence[Op]],
         length: int | None = None,
         space: int | None = None,
+        append_fail: str = "definite",
     ) -> list[dict[str, Any]]:
         from jepsen_tpu.checkers.stream_lin import (
             STREAM_ARRAYS,
@@ -116,7 +117,12 @@ class CheckerClient:
         batch = pack_stream_histories(histories, length=length, space=space)
         arrays = {k: np.asarray(getattr(batch, k)) for k in STREAM_ARRAYS}
         reply, _ = self._call(
-            {"op": "check-stream", "space": batch.space}, arrays
+            {
+                "op": "check-stream",
+                "space": batch.space,
+                "append-fail": append_fail,
+            },
+            arrays,
         )
         return [_desetted(r) for r in reply["results"]]
 

@@ -270,6 +270,9 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             space = int(header.get("space", 0))
             if space <= 0:
                 raise ProtocolError("space must be positive")
+            append_fail = header.get("append-fail", "definite")
+            if append_fail not in ("definite", "indeterminate"):
+                raise ProtocolError(f"unknown append-fail {append_fail!r}")
             batch, full_read = _prepare_stream_batch(arrays, space)
             with self._device_lock:
                 if self._mesh is not None:
@@ -281,7 +284,9 @@ class CheckerServer(socketserver.ThreadingTCPServer):
                     batch, nb = _pad_batch_axis(
                         batch, self._mesh.shape[HIST_AXIS]
                     )
-                    t = sharded_stream_lin(batch, self._mesh)
+                    t = sharded_stream_lin(
+                        batch, self._mesh, append_fail=append_fail
+                    )
                     full_read = np.pad(full_read, (0, batch.batch - nb))
                 else:
                     from jepsen_tpu.checkers.stream_lin import (
@@ -289,8 +294,12 @@ class CheckerServer(socketserver.ThreadingTCPServer):
                     )
 
                     nb = len(full_read)
-                    t = stream_lin_tensor_check(batch)
+                    t = stream_lin_tensor_check(
+                        batch, append_fail=append_fail
+                    )
             reply = _stream_results(t, full_read)
+            for r in reply["results"]:
+                r["stream"]["append-fail"] = append_fail
             reply["results"] = reply["results"][:nb]
             return reply
         if op == "check-elle":

@@ -180,10 +180,18 @@ def stream_generator(opts: Mapping[str, Any]):
     )
 
 
-def stream_checker(backend: str = "tpu", with_perf: bool = True):
+def stream_checker(
+    backend: str = "tpu",
+    with_perf: bool = True,
+    append_fail: str = "definite",
+):
     from jepsen_tpu.checkers.stream_lin import StreamLinearizability
 
-    checkers = {"stream": StreamLinearizability(backend=backend)}
+    checkers = {
+        "stream": StreamLinearizability(
+            backend=backend, append_fail=append_fail
+        )
+    }
     return _compose_with_defaults(checkers, with_perf)
 
 
@@ -418,7 +426,13 @@ def build_rabbitmq_test(
             full_read_confirm_empties=o["full-read-confirm-empties"],
         )
         generator = stream_generator(o)
-        checker = stream_checker(checker_backend)
+        # real sockets: a ConnectionError on append is the CLIENT's
+        # verdict, not the broker's (the reference's own :fail mapping,
+        # rabbitmq.clj:211-213) — a materialized all-fail value is
+        # `recovered`, like the queue checker's bucket (r5 burn-in find)
+        checker = stream_checker(
+            checker_backend, append_fail="indeterminate"
+        )
         name = "rabbitmq-stream-partition"
     elif workload == "elle":
         client = TxnClient(

@@ -39,6 +39,10 @@ Per family (each mirrors its post-hoc checker's classification):
   completing while another certain hold is open (no release invoked
   since that grant); see the class docstring for the soundness
   argument.
+- **fenced mutex** (:class:`LiveFencedMutex`): ``token-reuse`` — one
+  fencing token granted twice (each correct grant is a distinct,
+  strictly-increasing ownership commit).  Overlapping holds are NOT
+  flagged here: that is the revocation shape fencing tolerates.
 
 Wiring: monitors implement the runner's observer hook (``observe(op)``
 on every recorded op, in recording order — the ordering the
@@ -400,11 +404,59 @@ class LiveMutex(_LiveMonitor):
         return bool(self.double_grants)
 
 
+class LiveFencedMutex(_LiveMonitor):
+    """Monotone-anomaly monitor for the FENCED mutex workload:
+    **token reuse** — an acquire-OK carrying a fencing token some earlier
+    acquire-OK already carried.
+
+    Soundness: each correct grant is a distinct ownership commit with a
+    distinct (strictly increasing) token, so one token granted twice is
+    definitive the moment the second grant is recorded, whatever the rest
+    of the run does.  Mere non-monotonicity of *completion order* is NOT
+    flagged: two concurrent acquires can legally complete out of commit
+    order, so that shape is ambiguous mid-run and stays with the post-hoc
+    ``FencedMutex`` search.  (``LiveMutex``'s overlapping-hold rule would
+    false-positive here — overlapping beliefs of holding are exactly what
+    fencing tolerates.)"""
+
+    name = "live-fenced-mutex"
+
+    def __init__(self, on_anomaly=None):
+        super().__init__(on_anomaly)
+        self._granted: set[int] = set()
+        self.reused: set[int] = set()
+
+    def observe(self, op: Op) -> None:
+        if op.f != OpF.ACQUIRE or op.type != OpType.OK:
+            return
+        if not isinstance(op.value, int):
+            return
+        fired: list[tuple[str, int]] = []
+        with self._lock:
+            if op.value in self._granted:
+                if op.value not in self.reused:
+                    self.reused.add(op.value)
+                    fired.append(("token-reuse", op.value))
+            self._granted.add(op.value)
+            self._record(fired, op)
+        self._notify(fired, op)
+
+    def _observations(self) -> int:
+        return len(self._granted)
+
+    def _anomaly_counts(self) -> dict[str, int]:
+        return {"token-reuse": len(self.reused)}
+
+    def _violation(self) -> bool:
+        return bool(self.reused)
+
+
 LIVE_MONITORS = {
     "queue": LiveTotalQueue,
     "stream": LiveStream,
     "elle": LiveElle,
     "mutex": LiveMutex,
+    "fenced-mutex": LiveFencedMutex,
 }
 
 

@@ -69,6 +69,7 @@ from jepsen_tpu.checkers.protocol import UNKNOWN, VALID, Checker
 from jepsen_tpu.history.ops import Op, OpF, OpType
 from jepsen_tpu.models.core import (
     Call,
+    FencedMutex,
     FifoQueue,
     Model,
     OwnedMutex,
@@ -151,6 +152,58 @@ def mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
             out.append(WglOp(call, inv, pos))
         elif op.type == OpType.INFO:
             out.append(WglOp(call, inv, INF))
+    return out
+
+
+def mutex_history_is_fenced(history: Sequence[Op]) -> bool:
+    """A mutex history is FENCED when successful acquires carry integer
+    fencing tokens as their values (unfenced completions carry None)."""
+    return any(
+        op.f == OpF.ACQUIRE
+        and op.type == OpType.OK
+        and isinstance(op.value, int)
+        for op in history
+    )
+
+
+def fenced_mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
+    """Map a FENCED mutex history onto :class:`FencedMutex` calls
+    (``a0`` = process, ``a1`` = the op's fencing token from its value).
+
+    Indeterminate (info) ops are DROPPED rather than left open: a
+    timed-out acquire's token is unknown (the client never received the
+    grant header), so it cannot be modeled — and dropping is sound,
+    because an unmodeled grant only RAISES the current token, making
+    every later legality check (strictly-greater / equality against a
+    lower state) more permissive, never less.  A dropped op can
+    therefore never turn a correct history red; it only (harmlessly)
+    weakens detection of bugs that hide exactly inside an indeterminate
+    window.  Ops without an integer token (failed, or malformed) never
+    took effect and are dropped like failures."""
+    out: list[WglOp] = []
+    open_inv: dict[int, int] = {}
+    for pos, op in enumerate(history):
+        if op.f not in (OpF.ACQUIRE, OpF.RELEASE):
+            continue
+        if op.type == OpType.INVOKE:
+            open_inv[op.process] = pos
+            continue
+        inv = open_inv.pop(op.process, -1)
+        if op.type != OpType.OK or not isinstance(op.value, int):
+            continue
+        out.append(
+            WglOp(
+                Call(
+                    FencedMutex.ACQUIRE
+                    if op.f == OpF.ACQUIRE
+                    else FencedMutex.RELEASE,
+                    a0=op.process,
+                    a1=op.value,
+                ),
+                inv,
+                pos,
+            )
+        )
     return out
 
 
@@ -508,10 +561,40 @@ class FifoWgl(_WglChecker):
 
 
 class MutexWgl(_WglChecker):
-    """Knossos-style ``checker/linearizable`` over the owned-mutex model —
-    the reference's commented legacy variant (``rabbitmq_test.clj:18-44``)."""
+    """Knossos-style ``checker/linearizable`` over the mutex family —
+    the reference's commented legacy variant (``rabbitmq_test.clj:18-44``).
+
+    Model selection is part of the standard pipeline: unfenced histories
+    check against :class:`OwnedMutex` (mutual exclusion of holds);
+    FENCED histories — successful acquires carrying integer fencing
+    tokens — check against :class:`FencedMutex` (strict token order; no
+    stale-token operation ever succeeded).  ``fenced=None`` (default)
+    auto-detects from the history, so ``check``/``bench-check`` re-runs
+    pick the model the run was recorded under."""
 
     name = "mutex-wgl"
 
+    def __init__(self, backend: str = "tpu", capacity: int = 128,
+                 fenced: bool | None = None):
+        super().__init__(backend=backend, capacity=capacity)
+        self.fenced = fenced
+
+    def _is_fenced(self, history) -> bool:
+        return (
+            mutex_history_is_fenced(history)
+            if self.fenced is None
+            else self.fenced
+        )
+
     def _ops_and_model(self, history):
+        if self._is_fenced(history):
+            return fenced_mutex_wgl_ops(history), (FencedMutex, ())
         return mutex_wgl_ops(history), (OwnedMutex, ())
+
+    def check(self, test, history, opts=None):
+        r = super().check(test, history, opts)
+        # one O(n) detection scan, not a second full op-mapping pass
+        r["model"] = (
+            FencedMutex.name if self._is_fenced(history) else OwnedMutex.name
+        )
+        return r

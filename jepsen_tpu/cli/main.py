@@ -595,33 +595,53 @@ def cmd_bench_check(args) -> int:
     elif workload == "mutex":
         from jepsen_tpu.checkers.wgl import (
             check_wgl_cpu,
+            fenced_mutex_wgl_ops,
+            mutex_history_is_fenced,
             mutex_wgl_ops,
             pack_wgl_batch,
             wgl_tensor_check,
         )
-        from jepsen_tpu.models.core import OwnedMutex
+        from jepsen_tpu.models.core import FencedMutex, OwnedMutex
 
         t0 = time.perf_counter()
-        opss = [mutex_wgl_ops(h) for h in histories]
+        # per-history model selection, like the standard checker pipeline:
+        # fenced histories (token-valued acquires) check token order
+        pairs = [
+            (fenced_mutex_wgl_ops(h), FencedMutex)
+            if mutex_history_is_fenced(h)
+            else (mutex_wgl_ops(h), OwnedMutex)
+            for h in histories
+        ]
         if getattr(args, "engine", "classic") == "tensor":
             # opt-in ONLY: the batched frontier-bitset device search —
             # measured ~650x slower per history than the classic host
             # search on this family (WGL_BENCH.md re-scope); it exists
-            # for general-model correctness, not throughput
-            packed = pack_wgl_batch(opss)
+            # for general-model correctness, not throughput.  One packed
+            # batch per model — a compiled program is model-specific.
+            by_model: dict = {}
+            for ops, model in pairs:
+                by_model.setdefault(model, []).append(ops)
+            packs = {
+                m: pack_wgl_batch(opss) for m, opss in by_model.items()
+            }
             t_pack = time.perf_counter() - t0
-            wgl_tensor_check(packed, (OwnedMutex, ()))  # compile
+            for m, packed in packs.items():
+                wgl_tensor_check(packed, (m, ()))  # compile
             t1 = time.perf_counter()
-            ok, unknown = wgl_tensor_check(packed, (OwnedMutex, ()))
+            n_invalid = n_unknown = 0
+            for m, packed in packs.items():
+                ok, unknown = wgl_tensor_check(packed, (m, ()))
+                n_invalid += int((~ok & ~unknown).sum())
+                n_unknown += int(unknown.sum())
             t_check = time.perf_counter() - t1
-            n_invalid = int((~ok & ~unknown).sum())
-            n_unknown = int(unknown.sum())
         else:
             # the perf path (default): the classic Wing-Gong host search
             # wins on the mutex family at every measured configuration
             t_pack = time.perf_counter() - t0
             t1 = time.perf_counter()
-            results = [check_wgl_cpu(ops, OwnedMutex()) for ops in opss]
+            results = [
+                check_wgl_cpu(ops, model()) for ops, model in pairs
+            ]
             t_check = time.perf_counter() - t1
             # tri-state: "valid?" is True / False / the truthy string
             # "unknown" (config-cap overflow) — an undecided history is
@@ -772,6 +792,7 @@ def cmd_test(args) -> int:
         "net-ticktime": args.net_ticktime,
         "quorum-initial-group-size": args.quorum_initial_group_size,
         "dead-letter": args.dead_letter,
+        "fenced": args.fenced,
         "durable": args.durable,
         "seed": args.seed,
     }
@@ -844,7 +865,13 @@ def cmd_test(args) -> int:
     if args.live_check:
         from jepsen_tpu.checkers.live import attach_live_monitor_for
 
-        monitor = attach_live_monitor_for(test, args.workload)
+        monitor_key = args.workload
+        if args.workload == "mutex" and args.fenced:
+            # fenced runs tolerate overlapping revoked/current holds —
+            # LiveMutex's double-grant rule would false-positive; the
+            # fenced monitor watches token reuse instead
+            monitor_key = "fenced-mutex"
+        monitor = attach_live_monitor_for(test, monitor_key)
         if monitor is None:
             print(
                 f"warning: --live-check has no monitor for "
@@ -1332,6 +1359,19 @@ def build_parser() -> argparse.ArgumentParser:
         "trials should not replay identical txn programs)",
     )
     t.add_argument("--quorum-initial-group-size", type=int, default=0)
+    t.add_argument(
+        "--fenced",
+        action="store_true",
+        help="mutex workload: fencing-token mode — acquire returns a "
+        "monotonically increasing token (the Raft log index of the "
+        "grant commit), releases/protected operations carry it, and the "
+        "broker REJECTS operations bearing a superseded token.  The "
+        "same revocation schedules that double-grant the unfenced lock "
+        "(kill/pause past the dead-owner window) then soak green: the "
+        "checker verifies token order (FencedMutex model) instead of "
+        "hold exclusivity, and a revoked holder degrades to a failed "
+        "release + acquire-retry instead of split-brain",
+    )
     t.add_argument(
         "--dead-letter",
         # the reference CI passes a VALUE ("--dead-letter true",

@@ -137,10 +137,17 @@ def load_library(path: str | Path | None = None) -> ctypes.CDLL:
         ctypes.c_char_p,  # pass
         ctypes.c_int,  # quorum group size
         ctypes.c_int,  # connect retry ms
+        ctypes.c_int,  # fenced (fencing-token mode)
     ]
     lib.amqp_lock_client_setup.argtypes = [ctypes.c_void_p]
     lib.amqp_lock_acquire.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.amqp_lock_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.amqp_lock_acquire_fenced.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+    ]
+    lib.amqp_lock_release_fenced.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_longlong),
+    ]
     lib.amqp_lock_reconnect.argtypes = [ctypes.c_void_p]
     lib.amqp_lock_close.argtypes = [ctypes.c_void_p]
     lib.amqp_lock_destroy.argtypes = [ctypes.c_void_p]
@@ -405,7 +412,15 @@ class NativeMutexDriver(MutexDriver):
     holding revokes the lock broker-side (the token requeues): the driver
     surfaces that honestly — after any reconnect this client is not the
     holder — so an unfenced holder racing the next grantee shows up in the
-    history as a double grant for the linearizability checker to flag."""
+    history as a double grant for the linearizability checker to flag.
+
+    ``fenced=True`` turns on fencing-token mode: the grant carries a
+    monotonically increasing token (the Raft log index of the grant
+    commit, delivered in the ``x-fence-token`` message header), the
+    release publishes the token back bearing ``x-fence-release`` and the
+    broker REJECTS it when the token has been superseded — so a revoked
+    holder learns it is not the holder instead of silently "releasing",
+    and no stale-token operation ever succeeds."""
 
     def __init__(
         self,
@@ -415,11 +430,13 @@ class NativeMutexDriver(MutexDriver):
         password: str = "guest",
         quorum_group_size: int = 0,
         connect_retry_ms: int = 30000,
+        fenced: bool = False,
     ):
         self.lib = load_library()
+        self.fenced = fenced
         self.handle = self.lib.amqp_lock_client_create(
             node.encode(), port, user.encode(), password.encode(),
-            quorum_group_size, connect_retry_ms,
+            quorum_group_size, connect_retry_ms, 1 if fenced else 0,
         )
         if not self.handle:
             raise ConnectionError(f"amqp_lock_client_create failed for {node}")
@@ -448,6 +465,37 @@ class NativeMutexDriver(MutexDriver):
             raise DriverTimeout("release outcome unknown")
         raise ConnectionError("release failed (connection error)")
 
+    def acquire_fenced(self, timeout_s: float) -> int:
+        """Fenced acquire: the grant's fencing token (>0), or 0 when the
+        lock is busy; DriverTimeout when the outcome is unknown."""
+        tok = ctypes.c_longlong(-1)
+        r = self.lib.amqp_lock_acquire_fenced(
+            self.handle, int(timeout_s * 1000), ctypes.byref(tok)
+        )
+        if r == 1:
+            return int(tok.value)
+        if r == 0:
+            return 0
+        if r == -1:
+            raise DriverTimeout("acquire outcome unknown")
+        raise ConnectionError("acquire failed (connection error)")
+
+    def release_fenced(self, timeout_s: float) -> int:
+        """Fenced release: the released token (>0) on success, 0 when we
+        are not the holder OR the token was stale (the broker rejected
+        the release); DriverTimeout when unknown."""
+        tok = ctypes.c_longlong(-1)
+        r = self.lib.amqp_lock_release_fenced(
+            self.handle, int(timeout_s * 1000), ctypes.byref(tok)
+        )
+        if r == 1:
+            return int(tok.value)
+        if r == 0:
+            return 0
+        if r == -1:
+            raise DriverTimeout("release outcome unknown")
+        raise ConnectionError("release failed (connection error)")
+
     def reconnect(self) -> None:
         if self.lib.amqp_lock_reconnect(self.handle) != 0:
             raise ConnectionError("reconnect failed")
@@ -467,6 +515,7 @@ def native_mutex_driver_factory(port: int = 5672, **kw: Any):
             quorum_group_size=int(
                 test.get("quorum-initial-group-size", 0) or 0
             ),
+            fenced=bool(test.get("fenced")),
             **kw,
         )
 

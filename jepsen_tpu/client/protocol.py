@@ -333,6 +333,19 @@ class MutexDriver(abc.ABC):
         """True = released, False = not the holder; DriverTimeout when
         unknown."""
 
+    # ---- fencing-token mode (optional) ------------------------------------
+    def acquire_fenced(self, timeout_s: float) -> int:
+        """Fenced acquire: the grant's monotonically increasing fencing
+        token (>0), or 0 when busy; DriverTimeout when unknown.  Default:
+        the driver has no fenced mode."""
+        raise NotImplementedError(f"{type(self).__name__} is not fenced")
+
+    def release_fenced(self, timeout_s: float) -> int:
+        """Fenced release: the released token (>0), or 0 when not the
+        holder / the token was stale (the broker REJECTED the release);
+        DriverTimeout when unknown."""
+        raise NotImplementedError(f"{type(self).__name__} is not fenced")
+
     @abc.abstractmethod
     def reconnect(self) -> None: ...
 
@@ -344,15 +357,23 @@ class MutexClient(Client):
     """Lock client: acquire/release map to ok/fail; timeouts are
     indeterminate for BOTH ops (a timed-out acquire may hold the lock, a
     timed-out release may have freed it) — exactly the ambiguity the
-    linearizability checker must reason through."""
+    linearizability checker must reason through.
 
-    def __init__(self, driver_factory, op_timeout_s: float = 5.0):
+    ``fenced=True`` drives the driver's fencing-token mode: a granted
+    acquire completes OK with the token as its value, a release carries
+    the token it used, and a stale release FAILS (``stale-or-not-held``)
+    because the broker rejected it — the history then encodes exactly
+    what the fenced models verify (token order; no stale-token success)."""
+
+    def __init__(self, driver_factory, op_timeout_s: float = 5.0,
+                 fenced: bool = False):
         self.driver_factory = driver_factory
         self.op_timeout_s = op_timeout_s
+        self.fenced = fenced
         self.driver: MutexDriver | None = None
 
     def open(self, test, node):
-        c = MutexClient(self.driver_factory, self.op_timeout_s)
+        c = MutexClient(self.driver_factory, self.op_timeout_s, self.fenced)
         c.driver = self.driver_factory(test, node)
         return c
 
@@ -366,12 +387,24 @@ class MutexClient(Client):
 
         def apply() -> Op:
             if op.f == OpF.ACQUIRE:
+                if self.fenced:
+                    token = d.acquire_fenced(self.op_timeout_s)
+                    if token > 0:
+                        return op.complete(OpType.OK, value=token)
+                    return op.complete(OpType.FAIL, error="held")
                 ok = d.acquire(self.op_timeout_s)
                 return op.complete(
                     OpType.OK if ok else OpType.FAIL,
                     error=None if ok else "held",
                 )
             if op.f == OpF.RELEASE:
+                if self.fenced:
+                    token = d.release_fenced(self.op_timeout_s)
+                    if token > 0:
+                        return op.complete(OpType.OK, value=token)
+                    return op.complete(
+                        OpType.FAIL, error="stale-or-not-held"
+                    )
                 ok = d.release(self.op_timeout_s)
                 return op.complete(
                     OpType.OK if ok else OpType.FAIL,

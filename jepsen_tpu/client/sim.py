@@ -55,6 +55,8 @@ class SimCluster:
         message_ttl_s: float = 1.0,
         clock=time.monotonic,
         double_grant_every: int = 0,
+        fenced: bool = False,
+        stale_token_every: int = 0,
     ):
         self.nodes = list(nodes)
         self.lock = threading.Lock()
@@ -86,6 +88,17 @@ class SimCluster:
         self.lock_holder: int | None = None
         self.double_grant_every = double_grant_every
         self._acquires = 0
+        # fencing-token mode: every ownership transition (grant,
+        # injected revocation-regrant, release) advances the fence, and
+        # an operation bearing a superseded token is rejected — the
+        # correct-lock behavior the fenced checker verifies.
+        # stale_token_every=k injects the BUG the fenced model exists to
+        # catch: every k-th grant re-issues the previous token instead
+        # of minting a fresh one (a broker that forgot to fence).
+        self.fenced = fenced
+        self.stale_token_every = stale_token_every
+        self._fence = 0  # the current (latest-issued) token
+        self._last_granted = 0  # last token actually handed to a client
 
     # ---- network control (driven by the nemesis via SimNet) --------------
     def set_blocked(self, blocked: set[frozenset[str]]) -> None:
@@ -206,6 +219,69 @@ class SimCluster:
                 raise DriverTimeout("release timed out (minority)")
             if self.lock_holder == proc:
                 self.lock_holder = None
+                return True
+            return False
+
+    # ---- fenced mutex ops (fencing-token mode) ----------------------------
+    def _mint_locked(self) -> int:
+        self._fence += 1
+        return self._fence
+
+    def acquire_fenced(self, node: str, proc: int) -> int:
+        """Grant with a fencing token: >0 = granted token, 0 = busy.
+        An injected ``double_grant_every`` grant models a revocation +
+        re-grant — the new holder gets a FRESH (higher) token, which is
+        correct fenced behavior (the old holder's token goes stale, its
+        release will be rejected, the fenced checker stays green);
+        ``stale_token_every`` injects the actual fencing BUG: a grant
+        re-issuing an already-granted token, which the fenced model must
+        refute (no legal order admits two grants of one token)."""
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.85:
+                    raise ConnectionError("minority: request rejected")
+                # indeterminate — but unlike the unfenced sim, the grant
+                # never sticks: a fenced broker revokes a grant whose
+                # holder never showed up (dead-owner reap), and the sim
+                # has no reaper to model the revocation with, so the
+                # equivalent end state is "not granted"
+                raise DriverTimeout("acquire timed out (minority)")
+            self._acquires += 1
+            granted = self.lock_holder is None or (
+                self.double_grant_every
+                and self._acquires % self.double_grant_every == 0
+            )
+            if not granted:
+                return 0
+            self.lock_holder = proc
+            if (
+                self.stale_token_every
+                and self._acquires % self.stale_token_every == 0
+                and self._last_granted
+            ):
+                return self._last_granted  # THE BUG: token reuse
+            self._last_granted = self._mint_locked()
+            return self._last_granted
+
+    def release_fenced(self, node: str, proc: int, token: int) -> bool:
+        """True iff ``token`` is STILL the current fence and the lock is
+        held — the broker's stale-token rejection; a revoked holder's
+        release fails instead of silently succeeding."""
+        with self.lock:
+            if not self._has_majority(node):
+                if self.rng.random() < 0.85:
+                    raise ConnectionError("minority: request rejected")
+                if (
+                    self.rng.random() < 0.5
+                    and self.lock_holder is not None
+                    and token == self._fence
+                ):
+                    self.lock_holder = None
+                    self._mint_locked()
+                raise DriverTimeout("release timed out (minority)")
+            if self.lock_holder is not None and token == self._fence:
+                self.lock_holder = None
+                self._mint_locked()  # the released token goes stale NOW
                 return True
             return False
 
@@ -384,12 +460,15 @@ class SimTxnDriver(TxnDriver):
 
 class SimMutexDriver(MutexDriver):
     """Mutex-driver ABI over :class:`SimCluster` (process identity comes
-    from the factory's per-open counter — one logical holder per client)."""
+    from the factory's per-open counter — one logical holder per client).
+    Carries the fencing token across acquire→release in fenced mode,
+    exactly like the native driver."""
 
     def __init__(self, cluster: SimCluster, node: str, proc: int):
         self.cluster = cluster
         self.node = node
         self.proc = proc
+        self.token = 0  # fenced mode: the held grant's token
 
     def setup(self) -> None:
         pass
@@ -399,6 +478,20 @@ class SimMutexDriver(MutexDriver):
 
     def release(self, timeout_s: float) -> bool:
         return self.cluster.release(self.node, self.proc)
+
+    def acquire_fenced(self, timeout_s: float) -> int:
+        tok = self.cluster.acquire_fenced(self.node, self.proc)
+        if tok > 0:
+            self.token = tok
+        return tok
+
+    def release_fenced(self, timeout_s: float) -> int:
+        if not self.token:
+            return 0
+        tok = self.token
+        ok = self.cluster.release_fenced(self.node, self.proc, tok)
+        self.token = 0  # holder or not, this token is spent
+        return tok if ok else 0
 
     def reconnect(self) -> None:
         pass

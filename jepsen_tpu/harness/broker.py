@@ -123,6 +123,37 @@ class _Message:
     # header tables pass through byte-identical (the codec-fuzz chain
     # publishes through here and decodes on the far side)
     props: bytes = b""
+    # fencing token attached while this message is a granted (un-acked)
+    # delivery from a fenced queue; 0 otherwise (local mode only — the
+    # replicated twin lives on replication._RMsg)
+    fence: int = 0
+
+
+def _props_headers(props: bytes) -> dict:
+    """Parse the headers table out of raw content-header properties
+    (property-flags onward); {} when absent/malformed.  The fencing
+    extension rides message headers (``x-fence-token`` /
+    ``x-fence-release`` / ``x-fence-lock``), like RabbitMQ's own
+    ``x-stream-offset``."""
+    try:
+        r = _Reader(props)
+        flags = r.u16()
+        if flags & 0x8000:
+            r.shortstr()  # content-type
+        if flags & 0x4000:
+            r.shortstr()  # content-encoding
+        if not (flags & 0x2000):
+            return {}
+        return r.table()
+    except (IndexError, struct.error, UnicodeDecodeError):
+        return {}
+
+
+def _fence_props(token: int) -> bytes:
+    """Content-header properties (flags onward) carrying ONLY the
+    ``x-fence-token`` header — attached to fenced grant deliveries."""
+    table = _shortstr("x-fence-token") + b"l" + struct.pack(">q", token)
+    return struct.pack(">H", 0x2000) + struct.pack(">I", len(table)) + table
 
 
 @dataclass
@@ -191,6 +222,11 @@ class MiniAmqpBroker:
         self._delivered = 0
         self._appended = 0
         self._conn_seq = 0
+        # local-mode fencing state (replicated mode keeps the replicated
+        # twin in QueueMachine.fences, driven by commit indices): per-
+        # queue current fence + the monotonic token mint
+        self.fences: dict[str, int] = {}
+        self._fence_seq = 0
         self._owner_salt = f"{_random.Random().getrandbits(32):08x}-"
         # names a committed read answered "notstream" for (replicated
         # mode): later consumes of these classic queues skip the
@@ -484,6 +520,7 @@ class MiniAmqpBroker:
                             qtype=qargs.get("x-queue-type"),
                             ttl_ms=qargs.get("x-message-ttl"),
                             dlx=qargs.get("x-dead-letter-routing-key"),
+                            fenced=bool(qargs.get("x-fencing")),
                         )
                         # remember stream-ness locally for consume routing
                         if qargs.get("x-queue-type") == "stream":
@@ -500,6 +537,7 @@ class MiniAmqpBroker:
                                     "dlx_key": qargs.get(
                                         "x-dead-letter-routing-key"
                                     ),
+                                    "fenced": bool(qargs.get("x-fencing")),
                                 }
                     self._send_method(
                         conn,
@@ -635,6 +673,7 @@ class MiniAmqpBroker:
                     elif item and requeue:
                         with self.state_lock:
                             qname, msg = item
+                            self._revoke_fence_locked(qname, msg)
                             self.queues.setdefault(qname, deque()).append(msg)
                     self._deliver_all()
                 elif cls == 90 and mth == 10:  # Tx.Select (per channel)
@@ -703,6 +742,7 @@ class MiniAmqpBroker:
             else:
                 with self.state_lock:
                     for qname, msg in conn.unacked.values():
+                        self._revoke_fence_locked(qname, msg)
                         self.queues.setdefault(qname, deque()).append(msg)
                     conn.unacked.clear()
                     if conn in self._conns:
@@ -728,6 +768,22 @@ class MiniAmqpBroker:
         self, conn: _ConnState, ch: int, queue: str, body: bytes,
         props: bytes = b"",
     ):
+        if props:
+            headers = _props_headers(props)
+            if "x-fence-release" in headers:
+                self._fenced_release(
+                    conn, ch, queue, int(headers["x-fence-release"]),
+                    body,
+                )
+                return
+            if "x-fence-token" in headers and "x-fence-lock" in headers:
+                self._fenced_publish(
+                    conn, ch, queue,
+                    int(headers["x-fence-token"]),
+                    str(headers["x-fence-lock"]),
+                    body, props,
+                )
+                return
         if ch in conn.tx_channels:
             # tx publishes stay invisible until tx.commit (no confirms in
             # tx mode — the commit-ok is the acknowledgement) ... unless
@@ -740,8 +796,7 @@ class MiniAmqpBroker:
             else:
                 conn.tx_buffer.setdefault(ch, []).append((queue, body, props))
             return
-        seq = conn.publish_seq.get(ch, 0) + 1
-        conn.publish_seq[ch] = seq
+        seq = self._next_publish_seq(conn, ch)
         if self.replication is not None:
             # quorum-commit before confirm: the whole point of the
             # replicated mode (a seed_bug leader lies here — that's the
@@ -762,6 +817,121 @@ class MiniAmqpBroker:
         if ch in conn.confirm_channels and not self.drop_confirms:
             self._send_method(conn, ch, 60, 80, struct.pack(">QB", seq, 0))
         self._deliver_all()
+
+    def _next_publish_seq(self, conn: _ConnState, ch: int) -> int:
+        """Advance the channel's publisher-confirm sequence.  Every
+        received publish consumes one sequence number whether or not a
+        confirm goes out — the client's own counter advances on send,
+        and a skipped number here would desynchronize every later
+        ack/nack tag on the channel."""
+        seq = conn.publish_seq.get(ch, 0) + 1
+        conn.publish_seq[ch] = seq
+        return seq
+
+    def _confirm_fenced(
+        self, conn: _ConnState, ch: int, seq: int, ok: bool
+    ) -> None:
+        """Answer a fenced publish: basic.ack when the token was current,
+        basic.nack when it was stale (the operation was REJECTED) — the
+        stale verdict must reach the client as a definite failure, never
+        a silent drop (which would read as indeterminate)."""
+        if ch not in conn.confirm_channels or self.drop_confirms:
+            return
+        self._send_method(
+            conn, ch, 60, 80 if ok else 120, struct.pack(">QB", seq, 0)
+        )
+
+    def _fenced_release(
+        self, conn: _ConnState, ch: int, queue: str, token: int,
+        body: bytes,
+    ) -> None:
+        """Fenced lock release: publish of the token back to the lock
+        queue bearing ``x-fence-release: <token>``.  Valid only while
+        the token is the queue's current fence — a holder whose grant
+        was revoked (connection loss, dead-owner reap) gets a nack, not
+        a silent no-op the driver would report as released."""
+        seq = self._next_publish_seq(conn, ch)
+        if self.replication is not None:
+            status, mid = self.replication.fence_release(
+                queue, token, body, b""
+            )
+            if status == "noquorum":
+                return  # no confirm: the outcome is genuinely unknown
+            if status == "released" and mid is not None:
+                # scrub the settled grant from whichever local conn held
+                # it un-acked, so that conn's later death cannot requeue
+                # an already-released token (double-token hazard)
+                with self.state_lock:
+                    for c in self._conns:
+                        for tag, item in list(c.unacked.items()):
+                            if item == (queue, mid):
+                                del c.unacked[tag]
+            self._confirm_fenced(conn, ch, seq, status == "released")
+            return
+        with self.state_lock:
+            ok = self.fences.get(queue) == token
+            holder = None
+            if ok:
+                for c in self._conns:
+                    for tag, (qn, msg) in c.unacked.items():
+                        if qn == queue and msg.fence == token:
+                            holder = (c, tag)
+                            break
+                    if holder:
+                        break
+                ok = holder is not None
+            if ok:
+                hc, htag = holder
+                del hc.unacked[htag]
+                self._fence_seq += 1
+                self.fences[queue] = self._fence_seq
+                self.queues.setdefault(queue, deque()).append(
+                    _Message(body, ts=_time.monotonic())
+                )
+        self._confirm_fenced(conn, ch, seq, ok)
+        if ok:
+            self._deliver_all()
+
+    def _fenced_publish(
+        self, conn: _ConnState, ch: int, queue: str, token: int,
+        lockq: str, body: bytes, props: bytes,
+    ) -> None:
+        """Protected operation: a publish claiming to hold the lock at
+        ``lockq`` with fencing token ``token``.  A stale token (the lock
+        was revoked/re-granted since) is rejected with a nack — the
+        end-to-end fencing property: no stale-token operation ever
+        succeeds."""
+        seq = self._next_publish_seq(conn, ch)
+        if self.replication is not None:
+            status = self.replication.enqueue_fenced(
+                queue, body, props, token, lockq
+            )
+            if status == "noquorum":
+                return
+            self._confirm_fenced(conn, ch, seq, status == "ok")
+            return
+        with self.state_lock:
+            # check + apply in ONE critical section: a revocation landing
+            # between them (holder's connection dying on another thread)
+            # must not let a just-staled token's publish slip through —
+            # the replicated twin gets this atomicity from apply-time
+            # evaluation of the committed op
+            ok = self.fences.get(lockq) == token
+            if ok:
+                self._apply_publish_locked(queue, body, props)
+        self._confirm_fenced(conn, ch, seq, ok)
+        if ok:
+            self._deliver_all()
+
+    def _revoke_fence_locked(self, qname: str, msg: _Message) -> None:
+        """Local-mode revocation: requeueing a granted fenced message
+        advances the queue's fence past the holder's token (the
+        replicated twin does this at requeue-apply time).  Caller holds
+        ``state_lock``."""
+        if msg.fence:
+            self._fence_seq += 1
+            self.fences[qname] = self._fence_seq
+            msg.fence = 0
 
     def _expire_locked(self, qname: str) -> None:
         """Dead-letter expired messages (x-message-ttl + DLX routing, the
@@ -786,29 +956,37 @@ class MiniAmqpBroker:
     def _apply_publish(self, queue: str, body: bytes, props: bytes = b""):
         """Make a publish visible (fault injection applies here)."""
         with self.state_lock:
-            if queue in self.streams:
-                self._appended += 1
-                lose = (
-                    self.lose_appended_every
-                    and self._appended % self.lose_appended_every == 0
-                )
-                if not lose:
+            self._apply_publish_locked(queue, body, props)
+
+    def _apply_publish_locked(
+        self, queue: str, body: bytes, props: bytes = b""
+    ):
+        """Body of :meth:`_apply_publish`; caller holds ``state_lock``
+        (the fenced-publish path must decide token validity and apply in
+        ONE critical section)."""
+        if queue in self.streams:
+            self._appended += 1
+            lose = (
+                self.lose_appended_every
+                and self._appended % self.lose_appended_every == 0
+            )
+            if not lose:
+                self.streams[queue].append(body)
+                if (
+                    self.duplicate_append_every
+                    and self._appended % self.duplicate_append_every == 0
+                ):
                     self.streams[queue].append(body)
-                    if (
-                        self.duplicate_append_every
-                        and self._appended % self.duplicate_append_every == 0
-                    ):
-                        self.streams[queue].append(body)
-            else:
-                self._published += 1
-                lose = (
-                    self.lose_acked_every
-                    and self._published % self.lose_acked_every == 0
+        else:
+            self._published += 1
+            lose = (
+                self.lose_acked_every
+                and self._published % self.lose_acked_every == 0
+            )
+            if not lose:  # confirm-but-drop = injected data loss
+                self.queues.setdefault(queue, deque()).append(
+                    _Message(body, ts=_time.monotonic(), props=props)
                 )
-                if not lose:  # confirm-but-drop = injected data loss
-                    self.queues.setdefault(queue, deque()).append(
-                        _Message(body, ts=_time.monotonic(), props=props)
-                    )
 
     def _content_frames(self, conn, ch, body: bytes, method: bytes,
                         props: bytes = b""):
@@ -845,13 +1023,19 @@ class MiniAmqpBroker:
                 + _shortstr(qname)
                 + struct.pack(">I", 0)
             )
-            self._content_frames(conn, ch, rmsg.body, method, rmsg.props)
+            # fenced grant: the delivery carries its fencing token (the
+            # Raft commit index of the DEQ) in the x-fence-token header
+            props = (
+                _fence_props(rmsg.fence) if rmsg.fence else rmsg.props
+            )
+            self._content_frames(conn, ch, rmsg.body, method, props)
             return
         with self.state_lock:
             self._expire_locked(qname)
             q = self.queues.setdefault(qname, deque())
             if not q:
                 msg = None
+                fence = 0
             else:
                 msg = q.popleft()
                 self._delivered += 1
@@ -866,6 +1050,15 @@ class MiniAmqpBroker:
                             props=msg.props,
                         )
                     )
+                fence = 0
+                if (self.queue_meta.get(qname) or {}).get("fenced"):
+                    # local-mode grant: mint the next token and make it
+                    # the queue's current fence (mirrors the replicated
+                    # twin, where the DEQ commit index plays this role)
+                    self._fence_seq += 1
+                    fence = self._fence_seq
+                    self.fences[qname] = fence
+                    msg.fence = fence
                 tag = conn.next_tag
                 conn.next_tag += 1
                 if not no_ack:  # no-ack gets are auto-acknowledged
@@ -880,7 +1073,10 @@ class MiniAmqpBroker:
             + _shortstr(qname)
             + struct.pack(">I", 0)
         )
-        self._content_frames(conn, ch, msg.value, method, msg.props)
+        self._content_frames(
+            conn, ch, msg.value, method,
+            _fence_props(fence) if fence else msg.props,
+        )
 
     def _try_deliver(self, conn: _ConnState):
         """Push deliveries: QoS-1 (one in flight) for acking consumers;

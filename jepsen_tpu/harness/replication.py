@@ -114,6 +114,13 @@ class _RMsg:
     ts_ms: float  # leader-stamped enqueue time — drives deterministic TTL
     body: bytes
     props: bytes
+    #: fencing token carried by a granted (inflight) message from a
+    #: fenced queue: the Raft log index of the DEQ commit that granted
+    #: it.  0 = unfenced / not currently granted.  Commit indices are
+    #: strictly increasing, which is the whole point — every ownership
+    #: transition (grant, revocation-requeue, release) advances the
+    #: queue's fence, so a stale holder's token can never validate again.
+    fence: int = 0
 
 
 class QueueMachine:
@@ -134,6 +141,12 @@ class QueueMachine:
         self.meta: dict[str, dict] = {}
         # mid -> (owner, queue, _RMsg); insertion order = requeue order
         self.inflight: dict[str, tuple[str, str, _RMsg]] = {}
+        #: fenced queues only: queue -> the commit index of the latest
+        #: ownership transition (grant / revocation-requeue / release).
+        #: An operation bearing token T is valid iff T == fences[q];
+        #: indices are monotone, so every superseded token is stale
+        #: forever.  Deterministic: driven purely by committed op order.
+        self.fences: dict[str, int] = {}
         self.lock = threading.RLock()
 
     # -- apply (mutating; called with committed entries only) --------------
@@ -152,9 +165,18 @@ class QueueMachine:
                 self.meta[op["q"]] = {
                     "ttl_ms": op.get("ttl_ms"),
                     "dlx_key": op.get("dlx"),
+                    "fenced": bool(op.get("fenced")),
                 }
             return None
         if k == "enq":
+            # protected (fenced) publish: the op carries the fencing token
+            # of the lock it claims to hold; a token superseded by a later
+            # grant/revocation/release is rejected AT APPLY TIME — every
+            # replica agrees, because fences is a pure function of the
+            # committed log
+            if op.get("fence") is not None:
+                if self.fences.get(op["fence_q"], 0) != op["fence"]:
+                    return {"stale": True}
             self._enq_locked(f"m{index}", op)
             return None
         if k == "txn":
@@ -168,6 +190,14 @@ class QueueMachine:
             if not dq:
                 return None
             msg = dq.popleft()
+            if (self.meta.get(q) or {}).get("fenced"):
+                # THE GRANT: the commit index of this DEQ is the fencing
+                # token — monotonically increasing across grants by
+                # construction (log indices), and recorded as the queue's
+                # current fence so stale-token operations can be refused
+                self.fences[q] = index
+                msg = _RMsg(msg.mid, msg.ts_ms, msg.body, msg.props,
+                            fence=index)
             self.inflight[msg.mid] = (op["owner"], q, msg)
             return msg
         if k == "settle":
@@ -179,14 +209,48 @@ class QueueMachine:
             ent = self.inflight.pop(op["mid"], None)
             if ent:
                 owner, q, msg = ent
-                self.queues.setdefault(q, deque()).append(msg)
+                self.queues.setdefault(q, deque()).append(
+                    self._revoke_locked(q, msg, index)
+                )
             return None
         if k == "requeue_owner":
-            self._requeue_locked(lambda o: o == op["owner"])
+            self._requeue_locked(lambda o: o == op["owner"], index)
             return None
         if k == "requeue_node":
-            self._requeue_locked(lambda o: o.startswith(op["node"] + "|"))
+            self._requeue_locked(
+                lambda o: o.startswith(op["node"] + "|"), index
+            )
             return None
+        if k == "fence_release":
+            # fenced release: valid only while the releaser's token IS the
+            # queue's current fence AND the granted entry is still
+            # inflight (not already revoked by a requeue).  On success the
+            # grant settles atomically with the token's return — no
+            # window where two token messages can exist — and the fence
+            # advances to THIS commit, making the released token stale.
+            q, token = op["q"], op["token"]
+            ent = next(
+                (
+                    (mid, e)
+                    for mid, e in self.inflight.items()
+                    if e[1] == q and e[2].fence == token
+                ),
+                None,
+            )
+            if self.fences.get(q) != token or ent is None:
+                return {"stale": True}
+            mid, _e = ent
+            del self.inflight[mid]
+            self.fences[q] = index
+            self.queues.setdefault(q, deque()).append(
+                _RMsg(
+                    f"m{index}",
+                    op["ts"],
+                    base64.b64decode(op["body"]),
+                    base64.b64decode(op.get("props", "")),
+                )
+            )
+            return {"released": True, "mid": mid}
         if k == "purge":
             dq = self.queues.get(op["q"])
             n = len(dq) if dq else 0
@@ -223,11 +287,25 @@ class QueueMachine:
                 _RMsg(mid, op["ts"], body, props)
             )
 
-    def _requeue_locked(self, match: Callable[[str], bool]) -> None:
+    def _requeue_locked(
+        self, match: Callable[[str], bool], index: int
+    ) -> None:
         hits = [m for m, (o, _q, _msg) in self.inflight.items() if match(o)]
         for mid in hits:
             _o, q, msg = self.inflight.pop(mid)
-            self.queues.setdefault(q, deque()).append(msg)
+            self.queues.setdefault(q, deque()).append(
+                self._revoke_locked(q, msg, index)
+            )
+
+    def _revoke_locked(self, q: str, msg: _RMsg, index: int) -> _RMsg:
+        """Requeueing a granted fenced message is a REVOCATION: advance
+        the queue's fence to this requeue's commit index (the old
+        holder's token goes stale even before the next grant) and strip
+        the token from the returning message."""
+        if msg.fence:
+            self.fences[q] = index
+            return _RMsg(msg.mid, msg.ts_ms, msg.body, msg.props)
+        return msg
 
     def _expire_locked(self, qname: str, now_ms: float) -> None:
         """Dead-letter expired heads, timestamps from the log (never the
@@ -372,6 +450,12 @@ class RaftNode:
             # timeouts
             self._grace_until = time.monotonic() + 3 * self.eto[1]
         self._requeued_dead: dict[str, float] = {}
+        #: busy-peer heartbeat dispatch: the ticker deposits peers here
+        #: and one REUSABLE worker thread sends the heartbeats — a fresh
+        #: daemon thread per busy peer per tick was continuous thread
+        #: churn at tick rate during long catch-ups (advisor r5)
+        self._hb_pending: set[str] = set()
+        self._hb_event = threading.Event()
 
         host, port = self.peers[name]
         self._server = socket.create_server((host, port))
@@ -381,6 +465,7 @@ class RaftNode:
         self._threads = [
             threading.Thread(target=self._accept_loop, daemon=True),
             threading.Thread(target=self._ticker, daemon=True),
+            threading.Thread(target=self._hb_loop, daemon=True),
         ]
         for t in self._threads:
             t.start()
@@ -388,6 +473,7 @@ class RaftNode:
     # -- lifecycle ----------------------------------------------------------
     def stop(self) -> None:
         self._running = False
+        self._hb_event.set()  # unblock the heartbeat worker so it exits
         try:
             self._server.close()
         except OSError:
@@ -951,7 +1037,23 @@ class RaftNode:
             if cfg_touched:
                 self._recompute_config_locked()  # §6: effective on append
             if msg["leader_commit"] > self.commit_idx:
-                self.commit_idx = min(msg["leader_commit"], len(self.log))
+                # Raft §5.3: commit advances at most to the index of the
+                # last entry THIS RPC proved matching (prev + entries) —
+                # never to leader_commit ∩ len(log) alone.  A heartbeat at
+                # prev_idx=match_idx (0 right after election) reaching a
+                # follower that still holds an uncommitted divergent
+                # suffix from an older term must not commit that suffix:
+                # applied entries never revert, so the un-capped form
+                # turns a transient divergence into permanent
+                # state-machine divergence (advisor r5, high).
+                self.commit_idx = max(
+                    self.commit_idx,
+                    min(
+                        msg["leader_commit"],
+                        prev + len(entries),
+                        len(self.log),
+                    ),
+                )
             self._apply_ready_locked()
             return {"term": self.term, "ok": True, "have": len(self.log)}
 
@@ -1090,12 +1192,31 @@ class RaftNode:
                 args=(peer, term),
                 daemon=True,
             ).start()
-        for peer in busy:
-            threading.Thread(
-                target=self._heartbeat_peer,
-                args=(peer, term),
-                daemon=True,
-            ).start()
+        if busy:
+            # hand busy peers to the single reusable heartbeat worker —
+            # the set dedups, so a worker mid-send coalesces repeat ticks
+            # instead of queueing one heartbeat per tick per peer
+            with self.lock:
+                self._hb_pending.update(busy)
+            self._hb_event.set()
+
+    def _hb_loop(self) -> None:
+        """The reusable busy-peer heartbeat worker (see _replicate_once).
+        Serial sends are fine at this fan-in: only peers mid-catch-up
+        land here, an unreachable peer costs at most the 250 ms connect
+        clip, and a reachable one answers in microseconds locally."""
+        while self._running:
+            if not self._hb_event.wait(timeout=0.5):
+                continue
+            self._hb_event.clear()
+            while True:
+                with self.lock:
+                    if self.state != LEADER or not self._hb_pending:
+                        self._hb_pending.clear()
+                        break
+                    peer = self._hb_pending.pop()
+                    term = self.term
+                self._heartbeat_peer(peer, term)
 
     def _heartbeat_peer(self, peer: str, term: int) -> None:
         """Empty AppendEntries at a known-matching point: feeds the
@@ -1269,6 +1390,7 @@ def _encode_result(result: Any) -> Any:
             "ts": result.ts_ms,
             "body": base64.b64encode(result.body).decode(),
             "props": base64.b64encode(result.props).decode(),
+            "fence": result.fence,
         }
     if isinstance(result, list) and all(
         isinstance(x, bytes) for x in result
@@ -1286,6 +1408,7 @@ def _decode_result(result: Any) -> Any:
             result["ts"],
             base64.b64decode(result["body"]),
             base64.b64decode(result["props"]),
+            fence=int(result.get("fence", 0)),
         )
     if isinstance(result, dict) and "_blist" in result:
         return [base64.b64decode(x) for x in result["_blist"]]
@@ -1352,6 +1475,7 @@ class ReplicatedBackend:
             "requeue_one",
             "requeue_owner",
             "requeue_node",
+            "fence_release",
         ):
             self.on_visible()
         return result
@@ -1360,10 +1484,11 @@ class ReplicatedBackend:
         return time.time() * 1000.0 + self.clock_offset_ms
 
     # -- queue ops ----------------------------------------------------------
-    def declare(self, q, qtype=None, ttl_ms=None, dlx=None) -> None:
+    def declare(self, q, qtype=None, ttl_ms=None, dlx=None,
+                fenced=False) -> None:
         self.raft.submit(
             {"k": "declare", "q": q, "qtype": qtype, "ttl_ms": ttl_ms,
-             "dlx": dlx},
+             "dlx": dlx, "fenced": bool(fenced)},
             timeout_s=self.submit_timeout_s,
         )
 
@@ -1379,6 +1504,59 @@ class ReplicatedBackend:
             timeout_s=self.submit_timeout_s,
         )
         return ok
+
+    def enqueue_fenced(
+        self, q: str, body: bytes, props: bytes, fence: int, fence_q: str
+    ) -> str:
+        """Protected publish carrying a fencing token: ``"ok"`` when the
+        publish committed with a current token, ``"stale"`` when it
+        committed but the token had been superseded (the publish was
+        REJECTED deterministically on every replica), ``"noquorum"``
+        when no commit happened (the caller withholds the confirm —
+        indeterminate, the safe verdict)."""
+        ok, result = self.raft.submit(
+            {
+                "k": "enq",
+                "q": q,
+                "body": base64.b64encode(body).decode(),
+                "props": base64.b64encode(props).decode(),
+                "ts": self._now_ms(),
+                "fence": int(fence),
+                "fence_q": fence_q,
+            },
+            timeout_s=self.submit_timeout_s,
+        )
+        if not ok:
+            return "noquorum"
+        if isinstance(result, dict) and result.get("stale"):
+            return "stale"
+        return "ok"
+
+    def fence_release(
+        self, q: str, token: int, body: bytes, props: bytes = b""
+    ) -> tuple[str, str | None]:
+        """Fenced lock release: atomically settle the grant bearing
+        ``token`` and return the token message to ``q`` — iff ``token``
+        is still the queue's current fence.  Returns ``("released",
+        mid)``, ``("stale", None)`` (committed, but the token was
+        superseded — the caller is no longer the holder), or
+        ``("noquorum", None)`` (no commit; outcome unknown)."""
+        ok, result = self.raft.submit(
+            {
+                "k": "fence_release",
+                "q": q,
+                "token": int(token),
+                "body": base64.b64encode(body).decode(),
+                "props": base64.b64encode(props).decode(),
+                "ts": self._now_ms(),
+            },
+            timeout_s=self.submit_timeout_s,
+        )
+        if not ok:
+            return "noquorum", None
+        if isinstance(result, dict) and result.get("released"):
+            return "released", result.get("mid")
+        return "stale", None
 
     def enqueue_txn(self, items: list[tuple[str, bytes, bytes]]) -> bool:
         now = self._now_ms()

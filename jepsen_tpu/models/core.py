@@ -26,6 +26,10 @@ reg read       1    returned value          —
 reg cas(o,n)   2    expected (old)          new
 mutex acquire  0    —                       —
 mutex release  1    —                       —
+owned acquire  0    process                 —
+owned release  1    process                 —
+fenced acquire 0    process                 fencing token
+fenced release 1    process                 fencing token
 ============== ==== ======================= =====================
 """
 
@@ -209,6 +213,52 @@ class OwnedMutex(Model):
         owner = (a0 + 1).astype(jnp.uint32)
         legal = jnp.where(is_acq, cur == 0, cur == owner)
         new = jnp.where(is_acq, owner, jnp.uint32(0))
+        state = state.at[0].set(jnp.where(legal, new, cur))
+        return state, legal
+
+
+class FencedMutex(Model):
+    """Lock with fencing tokens (``a1`` = the token carried by the op).
+
+    The sequential spec of a CORRECT fenced lock — deliberately weaker
+    than :class:`OwnedMutex` on holds and stronger on tokens: under
+    revocation two clients may transiently both believe they hold (that
+    ambiguity is the unfenced hazard fencing exists to tolerate), so
+    "overlapping holds" alone is legal here; what must hold instead is
+    **token order** — grants carry strictly increasing tokens (each
+    grant is a later ownership commit), and an operation bearing a
+    superseded token never succeeds:
+
+    - ``acquire(token)`` is legal iff ``token > state`` (a fresh,
+      never-before-granted token); the state becomes that token.
+    - ``release(token)`` is legal iff ``token == state`` (the releaser
+      is still the current grant — a revoked/superseded holder's
+      release must have FAILED); the state is unchanged (the next grant
+      must out-rank this token anyway).
+
+    A broker that double-grants one token, or lets a stale-token
+    release/protected-op succeed after a newer grant completed, admits
+    no legal linearization — the checker goes red.  State is one uint32
+    (the current token), so the tensor step is trivial."""
+
+    name = "fenced-mutex"
+    ACQUIRE, RELEASE = 0, 1
+    state_words = 1  # current (latest granted) token; 0 = never granted
+
+    def initial(self):
+        return 0
+
+    def step(self, state, call):
+        if call.f == self.ACQUIRE:
+            return call.a1, call.a1 > state
+        return state, call.a1 == state
+
+    def tensor_step(self, state, f, a0, a1):
+        cur = state[0]
+        tok = jnp.uint32(a1)
+        is_acq = f == self.ACQUIRE
+        legal = jnp.where(is_acq, tok > cur, tok == cur)
+        new = jnp.where(is_acq, tok, cur)
         state = state.at[0].set(jnp.where(legal, new, cur))
         return state, legal
 

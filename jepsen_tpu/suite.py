@@ -81,6 +81,7 @@ DEFAULT_OPTS: dict[str, Any] = {
     "net-ticktime": 15,
     "quorum-initial-group-size": 0,
     "dead-letter": False,
+    "fenced": False,  # mutex family: fencing-token mode (--fenced)
     "durable": False,  # --db local: WAL-backed Raft logs (survive SIGKILL)
     "message-ttl": 1.0,  # dead-letter mode TTL (MESSAGE_TTL, Utils.java:55)
     "archive-url": DEFAULT_ARCHIVE_URL,
@@ -241,10 +242,17 @@ def mutex_generator(opts: Mapping[str, Any]):
     )
 
 
-def mutex_checker(backend: str = "tpu", with_perf: bool = True):
+def mutex_checker(
+    backend: str = "tpu", with_perf: bool = True,
+    fenced: bool | None = None,
+):
+    """``fenced`` pins the model the run is checked under (None =
+    auto-detect from the history): unfenced → ``OwnedMutex`` mutual
+    exclusion, fenced → ``FencedMutex`` token order (overlapping
+    revoked/current holds are legal; stale-token success is not)."""
     from jepsen_tpu.checkers.wgl import MutexWgl
 
-    checkers = {"mutex": MutexWgl(backend=backend)}
+    checkers = {"mutex": MutexWgl(backend=backend, fenced=fenced)}
     return _compose_with_defaults(checkers, with_perf)
 
 
@@ -270,6 +278,7 @@ def build_sim_test(
     drop_appended_every: int = 0,
     duplicate_append_every: int = 0,
     double_grant_every: int = 0,
+    stale_token_every: int = 0,
     store_root: str = "store",
     workload: str = "queue",
 ) -> tuple[Test, SimCluster]:
@@ -291,6 +300,8 @@ def build_sim_test(
         drop_appended_every=drop_appended_every,
         duplicate_append_every=duplicate_append_every,
         double_grant_every=double_grant_every,
+        fenced=bool(o.get("fenced")),
+        stale_token_every=stale_token_every,
         dead_letter=bool(o.get("dead-letter")),
         message_ttl_s=o.get("message-ttl", 1.0),
     )
@@ -323,13 +334,15 @@ def build_sim_test(
         from jepsen_tpu.client.protocol import MutexClient
         from jepsen_tpu.client.sim import sim_mutex_driver_factory
 
+        fenced = bool(o.get("fenced"))
         client = MutexClient(
             sim_mutex_driver_factory(cluster),
             op_timeout_s=o["publish-confirm-timeout"],
+            fenced=fenced,
         )
         generator = mutex_generator(o)
-        checker = mutex_checker(checker_backend)
-        name = "rabbitmq-mutex-sim"
+        checker = mutex_checker(checker_backend, fenced=fenced)
+        name = "rabbitmq-fenced-mutex-sim" if fenced else "rabbitmq-mutex-sim"
     elif workload == "queue":
         client = QueueClient(
             sim_driver_factory(cluster),
@@ -469,17 +482,23 @@ def build_rabbitmq_test(
         # (rabbitmq_test.clj:18-44), live: a single-token quorum-queue lock
         # (acquire = hold the token un-acked, release = reject/requeue; a
         # dropped connection revokes the grant broker-side — the unfenced-
-        # lock hazard the checker must see)
+        # lock hazard the checker must see).  --fenced turns on the
+        # fencing-token mode: grants carry the Raft commit index as a
+        # monotonically increasing token, releases/protected ops carry it
+        # back, the broker rejects stale tokens — the same revocation
+        # schedule that double-grants unfenced then soaks green.
         from jepsen_tpu.client.protocol import MutexClient
         from jepsen_tpu.client.native import native_mutex_driver_factory
 
+        fenced = bool(o.get("fenced"))
         client = MutexClient(
             native_mutex_driver_factory(),
             op_timeout_s=o["publish-confirm-timeout"],
+            fenced=fenced,
         )
         generator = mutex_generator(o)
-        checker = mutex_checker(checker_backend)
-        name = "rabbitmq-mutex"
+        checker = mutex_checker(checker_backend, fenced=fenced)
+        name = "rabbitmq-fenced-mutex" if fenced else "rabbitmq-mutex"
     else:
         raise ValueError(f"unknown workload {workload!r}")
     return Test(

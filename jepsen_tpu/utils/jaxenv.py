@@ -149,6 +149,17 @@ _probe_succeeded = False
 DEADLINE_ENV = "JEPSEN_TPU_BACKEND_DEADLINE"
 
 
+def _pins_cpu(value) -> bool:
+    """True when a platform pin (env var or config value) selects CPU as
+    the default backend.  Normalized — lower/strip, first element of a
+    comma list — so ``CPU``, `` cpu ``, and ``cpu,tpu`` all take the
+    instant CPU fast path instead of the 3×45 s subprocess probe the
+    pin exists to avoid (advisor r5)."""
+    if not value:
+        return False
+    return str(value).split(",")[0].strip().lower() == "cpu"
+
+
 def ensure_backend(deadline: float | None = None) -> str:
     """Initialize the default JAX backend with a watchdog deadline.
 
@@ -176,9 +187,8 @@ def ensure_backend(deadline: float | None = None) -> str:
             )
             deadline = 60.0
 
-    if (
-        jax.config.jax_platforms == "cpu"
-        or os.environ.get("JAX_PLATFORMS") == "cpu"
+    if _pins_cpu(jax.config.jax_platforms) or _pins_cpu(
+        os.environ.get("JAX_PLATFORMS")
     ):
         # CPU init cannot hang; also covers in-process pins that a
         # subprocess (which only inherits the env) would not see.  The

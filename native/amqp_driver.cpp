@@ -280,12 +280,23 @@ class Connection {
 
   // ---- publish + confirm -------------------------------------------------
   void enable_confirms() {
+    if (confirms_on_) return;  // idempotent: confirm mode is sticky
     auto w = amqp::method_writer(amqp::CLS_CONFIRM, amqp::M_CF_SELECT);
     w.u8(0);
     amqp::Frame f;
     if (!rpc(w, amqp::CLS_CONFIRM, amqp::M_CF_SELECT_OK, &f, 5000))
       throw std::runtime_error("confirm.select failed");
     confirms_on_ = true;
+  }
+
+  // enable_confirms without the throw: false = connection unusable
+  bool ensure_confirms() {
+    try {
+      enable_confirms();
+      return true;
+    } catch (const std::exception&) {
+      return false;
+    }
   }
 
   // ---- tx class (AMQP 0-9-1 transactions) --------------------------------
@@ -403,9 +414,10 @@ class Connection {
   }
 
   // ---- basic.get ---------------------------------------------------------
-  // 1 = message (value+tag set), 0 = empty, -1 = timeout, -2 = error
+  // 1 = message (value+tag set; *fence_out = x-fence-token header or -1),
+  // 0 = empty, -1 = timeout, -2 = error
   int basic_get(const std::string& queue, int32_t* value, uint64_t* tag,
-                int timeout_ms) {
+                int timeout_ms, int64_t* fence_out = nullptr) {
     auto w = amqp::method_writer(amqp::CLS_BASIC, amqp::M_B_GET);
     w.u16(0);
     w.shortstr(queue);
@@ -429,6 +441,7 @@ class Connection {
     if (get_have_ == 2) return 0;  // get-empty
     *value = get_value_;
     *tag = get_tag_;
+    if (fence_out) *fence_out = get_fence_;
     return 1;
   }
 
@@ -579,6 +592,7 @@ class Connection {
     ContentFor pending = ContentFor::NONE;
     uint64_t pending_tag = 0;
     int64_t pending_offset = -1;
+    int64_t pending_fence = -1;
     std::string body_acc;
     uint64_t body_expected = 0;
 
@@ -632,8 +646,10 @@ class Connection {
           body_expected = rd.u64();
           body_acc.clear();
           pending_offset = amqp::header_stream_offset(f.payload);
+          pending_fence = amqp::header_i64(f.payload, "x-fence-token");
           if (body_expected == 0) {
-            finish_content(pending, pending_tag, "", pending_offset);
+            finish_content(pending, pending_tag, "", pending_offset,
+                           pending_fence);
             pending = ContentFor::NONE;
           }
           continue;
@@ -642,7 +658,8 @@ class Connection {
           body_acc.append(reinterpret_cast<char*>(f.payload.data()),
                           f.payload.size());
           if (body_acc.size() >= body_expected) {
-            finish_content(pending, pending_tag, body_acc, pending_offset);
+            finish_content(pending, pending_tag, body_acc, pending_offset,
+                           pending_fence);
             pending = ContentFor::NONE;
           }
           continue;
@@ -729,7 +746,8 @@ class Connection {
   }
 
   void finish_content(ContentFor pending_kind, uint64_t tag,
-                      const std::string& body, int64_t offset = -1) {
+                      const std::string& body, int64_t offset = -1,
+                      int64_t fence = -1) {
     int32_t value = -1;
     try {
       if (!body.empty()) value = std::stoi(body);
@@ -743,6 +761,7 @@ class Connection {
       if (get_result_pending_) {
         get_value_ = value;
         get_tag_ = tag;
+        get_fence_ = fence;
         get_have_ = 1;
       }
     }
@@ -789,6 +808,7 @@ class Connection {
   int get_have_ = 0;  // 1 = message, 2 = empty
   int32_t get_value_ = -1;
   uint64_t get_tag_ = 0;
+  int64_t get_fence_ = -1;  // x-fence-token of the got message, -1 = none
 
   // consumer deque
   std::deque<Delivery> deliveries_;
@@ -817,6 +837,7 @@ struct ClientConfig {
   int quorum_group_size = 0;
   bool dead_letter = false;
   int connect_retry_ms = 30000;  // Utils.java:294-304
+  bool fenced = false;  // lock client: fencing-token mode
 };
 
 class Client;
@@ -1424,6 +1445,7 @@ class LockClient {
       args.put_str("x-queue-type", "quorum");
       if (cfg_.quorum_group_size > 0)
         args.put_int("x-quorum-initial-group-size", cfg_.quorum_group_size);
+      if (cfg_.fenced) args.put_bool("x-fencing", true);
       if (!c->declare_queue(LOCK_QUEUE_NAME, args))
         throw std::runtime_error("lock queue.declare failed");
       if (!c->purge_queue(LOCK_QUEUE_NAME))
@@ -1445,8 +1467,10 @@ class LockClient {
     return true;
   }
 
-  // 1 granted, 0 busy (or we already hold), -1 outcome unknown, -2 error
-  int acquire(int timeout_ms) {
+  // 1 granted, 0 busy (or we already hold), -1 outcome unknown, -2 error.
+  // In fenced mode a grant also fills *token_out with the fencing token
+  // the broker attached (the Raft log index of the grant commit).
+  int acquire(int timeout_ms, int64_t* token_out = nullptr) {
     if (!clear_poison(timeout_ms)) return -2;
     if (!initialize_if_necessary()) return -2;
     auto c = conn();
@@ -1457,11 +1481,24 @@ class LockClient {
     }
     int32_t v = 0;
     uint64_t tag = 0;
-    int r = c->basic_get(LOCK_QUEUE_NAME, &v, &tag, timeout_ms);
+    int64_t fence = -1;
+    int r = c->basic_get(LOCK_QUEUE_NAME, &v, &tag, timeout_ms, &fence);
     if (r == 1) {
+      if (cfg_.fenced && fence <= 0) {
+        // a fenced client granted a token WITHOUT a fencing header means
+        // the queue was not fenced-declared (mixed-mode misconfig):
+        // surface loudly rather than fabricate a token.  The grant is
+        // returned via reject so the lock is not silently parked.
+        c->basic_reject_requeue(tag);
+        logf("fenced acquire got no x-fence-token from %s",
+             cfg_.host.c_str());
+        return -2;
+      }
       std::lock_guard<std::mutex> lk(mu_);
       holding_ = true;
       tag_ = tag;
+      token_ = fence;
+      if (token_out) *token_out = fence;
       return 1;
     }
     if (r == 0) return 0;
@@ -1477,8 +1514,10 @@ class LockClient {
     return -2;
   }
 
-  // 1 released, 0 not the holder, -1 outcome unknown, -2 error
-  int release(int timeout_ms) {
+  // 1 released, 0 not the holder, -1 outcome unknown, -2 error.
+  // Fenced mode fills *token_out with the token the release used.
+  int release(int timeout_ms, int64_t* token_out = nullptr) {
+    if (cfg_.fenced) return release_fenced(timeout_ms, token_out);
     // reject carries no *-ok: outcome is known at send; timeout_ms only
     // bounds the poisoned-path reconnect below
     bool poisoned, holding;
@@ -1533,6 +1572,71 @@ class LockClient {
     return -1;
   }
 
+  // Fenced release: publish the token back bearing `x-fence-release:
+  // <token>`.  The broker accepts (confirm) iff the token is still the
+  // queue's current fence, atomically settling our grant and returning
+  // the token; a nack means the grant was revoked and re-granted since —
+  // we are NOT the holder, and no stale-token operation succeeded.
+  int release_fenced(int timeout_ms, int64_t* token_out) {
+    bool poisoned, holding;
+    int64_t tok;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      poisoned = poisoned_;
+      holding = holding_;
+      tok = token_;
+    }
+    if (poisoned) {
+      // an earlier acquire's outcome is unknown: whether we hold (and
+      // with which token) is unknowable — reconnect requeues any parked
+      // grant, and this release is indeterminate
+      close_connection();
+      connect(timeout_ms > 0 ? timeout_ms : 1000);
+      return -1;
+    }
+    if (!initialize_if_necessary()) return -2;
+    auto c = conn();
+    if (!c) return -2;
+    if (!holding) return 0;
+    if (!c->ensure_confirms()) return -2;
+    amqp::Writer entries;
+    entries.shortstr("x-fence-release");
+    entries.u8('l');
+    entries.u64(static_cast<uint64_t>(tok));
+    amqp::Writer props;
+    props.u16(0x2000);  // headers present
+    props.u32(static_cast<uint32_t>(entries.buf.size()));
+    props.bytes(entries.buf.data(), entries.buf.size());
+    int r = c->publish_confirm_props(
+        LOCK_QUEUE_NAME, std::to_string(LOCK_TOKEN_VALUE), &props.buf,
+        timeout_ms);
+    if (token_out) *token_out = tok;
+    if (r == 1) {
+      std::lock_guard<std::mutex> lk(mu_);
+      holding_ = false;
+      return 1;
+    }
+    if (r == 0) {
+      // stale: the broker REJECTED the release — our grant was revoked
+      // (and possibly re-granted) behind our back.  We are not the
+      // holder; the un-acked delivery our connection still parks is a
+      // settled ghost the broker has already scrubbed or will requeue
+      // harmlessly (a revoked token message carries no fence).
+      std::lock_guard<std::mutex> lk(mu_);
+      holding_ = false;
+      return 0;
+    }
+    if (r == -1) {
+      // the publish reached the wire but no confirm came: the release
+      // may or may not have committed — poison, like an indeterminate
+      // acquire, so the next op tears the connection down
+      std::lock_guard<std::mutex> lk(mu_);
+      poisoned_ = true;
+      return -1;
+    }
+    return -2;
+  }
+
   void close_connection() {
     std::shared_ptr<Connection> c;
     {
@@ -1575,6 +1679,7 @@ class LockClient {
   bool holding_ = false;
   bool poisoned_ = false;
   uint64_t tag_ = 0;
+  int64_t token_ = -1;  // fenced mode: the held grant's fencing token
 };
 
 // drain: the correctness-critical final read (Utils.java:413-470)
@@ -1826,7 +1931,7 @@ void amqp_txn_destroy(void* p) {
 
 void* amqp_lock_client_create(const char* host, int port, const char* user,
                               const char* pass, int quorum_group_size,
-                              int connect_retry_ms) {
+                              int connect_retry_ms, int fenced) {
   ClientConfig cfg;
   cfg.host = host ? host : "localhost";
   cfg.port = port;
@@ -1834,6 +1939,7 @@ void* amqp_lock_client_create(const char* host, int port, const char* user,
   if (pass) cfg.pass = pass;
   cfg.quorum_group_size = quorum_group_size;
   if (connect_retry_ms > 0) cfg.connect_retry_ms = connect_retry_ms;
+  cfg.fenced = fenced != 0;
   auto* c = new LockClient(std::move(cfg));
   if (!c->connect())
     logf("initial lock connect failed for %s", host ? host : "?");
@@ -1850,6 +1956,24 @@ int amqp_lock_acquire(void* p, int timeout_ms) {
 
 int amqp_lock_release(void* p, int timeout_ms) {
   return static_cast<LockClient*>(p)->release(timeout_ms);
+}
+
+// fenced variants: *token_out carries the fencing token on a grant /
+// the token a successful release used
+int amqp_lock_acquire_fenced(void* p, int timeout_ms,
+                             long long* token_out) {
+  int64_t tok = -1;
+  int r = static_cast<LockClient*>(p)->acquire(timeout_ms, &tok);
+  if (token_out) *token_out = tok;
+  return r;
+}
+
+int amqp_lock_release_fenced(void* p, int timeout_ms,
+                             long long* token_out) {
+  int64_t tok = -1;
+  int r = static_cast<LockClient*>(p)->release(timeout_ms, &tok);
+  if (token_out) *token_out = tok;
+  return r;
 }
 
 int amqp_lock_reconnect(void* p) {

@@ -216,10 +216,10 @@ inline void skip_field_value(Reader& r, uint8_t type) {
   }
 }
 
-// Parse a basic content header and return the long value of the
-// `x-stream-offset` message header (RabbitMQ streams deliver each record's
-// log offset this way over AMQP 0-9-1), or -1 when absent.
-inline int64_t header_stream_offset(const std::vector<uint8_t>& payload) {
+// Parse a basic content header and return the integer value of the named
+// message header, or -1 when absent/unparseable.
+inline int64_t header_i64(const std::vector<uint8_t>& payload,
+                          const char* name) {
   try {
     Reader r(payload.data(), payload.size());
     r.u16();  // class
@@ -234,9 +234,9 @@ inline int64_t header_stream_offset(const std::vector<uint8_t>& payload) {
     while (r.off < end) {
       std::string key = r.shortstr();
       uint8_t type = r.u8();
-      if (key == "x-stream-offset" && (type == 'l' || type == 'T'))
+      if (key == name && (type == 'l' || type == 'T'))
         return static_cast<int64_t>(r.u64());
-      if (key == "x-stream-offset" && (type == 'I' || type == 'i'))
+      if (key == name && (type == 'I' || type == 'i'))
         return static_cast<int64_t>(static_cast<int32_t>(r.u32()));
       skip_field_value(r, type);
     }
@@ -244,6 +244,12 @@ inline int64_t header_stream_offset(const std::vector<uint8_t>& payload) {
     return -1;
   }
   return -1;
+}
+
+// The `x-stream-offset` message header (RabbitMQ streams deliver each
+// record's log offset this way over AMQP 0-9-1), or -1 when absent.
+inline int64_t header_stream_offset(const std::vector<uint8_t>& payload) {
+  return header_i64(payload, "x-stream-offset");
 }
 
 }  // namespace amqp

@@ -632,16 +632,29 @@ int for_each_op(const char* path, CB&& cb, int64_t* err_line) {
   return err;
 }
 
+// test hook: JT_PACK_FAKE_OOM=1 makes every result-array allocation fail,
+// so the malloc-failure path (err set, Python binding falls back to the
+// pure-Python packer) is exercisable without exhausting real memory
+bool fake_oom() {
+  const char* e = std::getenv("JT_PACK_FAKE_OOM");
+  return e && *e && *e != '0';
+}
+
+void* checked_malloc(size_t n) {
+  if (fake_oom()) return nullptr;
+  return std::malloc(n);
+}
+
 int32_t* copy_i32(const std::vector<int32_t>& v) {
   if (v.empty()) return nullptr;
-  auto* p = static_cast<int32_t*>(std::malloc(v.size() * sizeof(int32_t)));
+  auto* p = static_cast<int32_t*>(checked_malloc(v.size() * sizeof(int32_t)));
   if (p) std::memcpy(p, v.data(), v.size() * sizeof(int32_t));
   return p;
 }
 
 int64_t* copy_i64(const std::vector<long long>& v) {
   if (v.empty()) return nullptr;
-  auto* p = static_cast<int64_t*>(std::malloc(v.size() * sizeof(int64_t)));
+  auto* p = static_cast<int64_t*>(checked_malloc(v.size() * sizeof(int64_t)));
   if (p) {
     for (size_t i = 0; i < v.size(); ++i) p[i] = v[i];
   }
@@ -872,9 +885,10 @@ JtPackResult* jt_pack_file(const char* path) {
   res->n_rows = static_cast<int64_t>(rows.size() / 8);
   if (res->n_rows > 0) {
     res->rows = static_cast<int32_t*>(
-        std::malloc(rows.size() * sizeof(int32_t)));
+        checked_malloc(rows.size() * sizeof(int32_t)));
     if (!res->rows) {
       res->err = ERR_IO;
+      res->n_rows = 0;
       return res;
     }
     std::memcpy(res->rows, rows.data(), rows.size() * sizeof(int32_t));
@@ -1103,6 +1117,17 @@ JtElleResult* jt_elle_infer_file(const char* path) {
   res->n_g1b = static_cast<int32_t>(vb.size());
   res->bad_keys = copy_i64(vk);
   res->n_bad_keys = static_cast<int32_t>(vk.size());
+  // allocation failure: a nullptr array with a positive count would make
+  // the Python binding walk a NULL pointer (segfault) instead of taking
+  // its None-fallback; flag the result as errored so the binding falls
+  // back to the pure-Python path (advisor r5)
+  if ((res->n_edges && !res->edges) || (res->n_txns && !res->txn_index) ||
+      (res->n_g1a && !res->g1a) || (res->n_g1b && !res->g1b) ||
+      (res->n_bad_keys && !res->bad_keys)) {
+    res->err = ERR_IO;
+    res->n_edges = res->n_txns = 0;
+    res->n_g1a = res->n_g1b = res->n_bad_keys = 0;
+  }
   return res;
 }
 
@@ -1215,6 +1240,10 @@ JtStreamResult* jt_stream_rows_file(const char* path) {
   res->cols = copy_i32(cols);
   res->n_rows = static_cast<int64_t>(cols.size() / 6);
   res->full_read = full ? 1 : 0;
+  if (res->n_rows && !res->cols) {  // malloc failure: see jt_elle note
+    res->err = ERR_IO;
+    res->n_rows = 0;
+  }
   return res;
 }
 

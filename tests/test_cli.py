@@ -321,3 +321,13 @@ def test_bench_check_elle_and_stream_native_matches_python(
             out[(label, wl)] = (stats["histories"], stats["invalid"])
     assert out[("native", "elle")] == out[("python", "elle")] == (3, 3)
     assert out[("native", "stream")] == out[("python", "stream")] == (2, 2)
+
+
+def test_fenced_flag_parses_and_defaults_off():
+    from jepsen_tpu.cli.main import build_parser
+
+    p = build_parser()
+    ns = p.parse_args(["test", "--workload", "mutex", "--fenced"])
+    assert ns.fenced is True
+    ns = p.parse_args(["test", "--workload", "mutex"])
+    assert ns.fenced is False

@@ -623,3 +623,50 @@ class TestStreamRowsNative:
         ]
         cols, full = _assert_stream_identical(tmp_path, history)
         assert not full
+
+
+# ---------------------------------------------------------------------------
+# Allocation-failure path (advisor r5): a malloc failure in the native
+# result-copy must set err (None-fallback in the binding), never hand the
+# binding a NULL pointer with positive counts (segfault)
+# ---------------------------------------------------------------------------
+
+
+class TestFakeOom:
+    @pytest.fixture(autouse=True)
+    def _oom(self, monkeypatch):
+        monkeypatch.setenv("JT_PACK_FAKE_OOM", "1")
+
+    def test_pack_file_falls_back(self, tmp_path):
+        from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+        sh = synth_history(SynthSpec(n_ops=40, seed=3))
+        p = _write(tmp_path, [op.to_json() for op in sh.ops])
+        assert pack_file(p) is None  # err surfaced -> Python fallback
+
+    def test_elle_graph_file_falls_back(self, tmp_path):
+        from jepsen_tpu.history.fastpack import elle_graph_file
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        (sh,) = synth_elle_batch(1, ElleSynthSpec(n_txns=16))
+        p = _write(tmp_path, [op.to_json() for op in sh.ops])
+        assert elle_graph_file(p) is None
+
+    def test_stream_rows_file_falls_back(self, tmp_path):
+        from jepsen_tpu.history.fastpack import stream_rows_file
+        from jepsen_tpu.history.synth import (
+            StreamSynthSpec,
+            synth_stream_batch,
+        )
+
+        (sh,) = synth_stream_batch(1, StreamSynthSpec(n_ops=40))
+        p = _write(tmp_path, [op.to_json() for op in sh.ops])
+        assert stream_rows_file(p) is None
+
+    def test_off_by_default(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("JT_PACK_FAKE_OOM", "0")
+        from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+        sh = synth_history(SynthSpec(n_ops=40, seed=3))
+        p = _write(tmp_path, [op.to_json() for op in sh.ops])
+        assert pack_file(p) is not None  # '0' does not trip the hook

@@ -134,3 +134,48 @@ class TestCompilationCache:
         (tmp_path / "a-cache").write_text("x")
         (tmp_path / ".hidden").write_text("x")
         assert compile_cache_entries(str(tmp_path)) == 1
+
+
+class TestCpuPinNormalization:
+    """Advisor r5: the CPU fast-path check must normalize the pin —
+    'CPU', ' cpu ', and 'cpu,tpu' must all skip the 3×45 s probe, while
+    non-CPU-first pins must not."""
+
+    import pytest as _pytest
+
+    @_pytest.mark.parametrize(
+        "value,expected",
+        [
+            ("cpu", True),
+            ("CPU", True),
+            (" cpu ", True),
+            ("cpu,tpu", True),
+            ("CPU,TPU", True),
+            (" Cpu , tpu", True),
+            ("tpu", False),
+            ("tpu,cpu", False),  # CPU is not the default platform here
+            ("", False),
+            (None, False),
+            ("cpux", False),
+        ],
+    )
+    def test_pins_cpu(self, value, expected):
+        from jepsen_tpu.utils.jaxenv import _pins_cpu
+
+        assert _pins_cpu(value) is expected
+
+    def test_fast_path_taken_for_mixed_case_env(self, monkeypatch):
+        """ensure_backend with JAX_PLATFORMS=CPU must return instantly
+        (config pinned to cpu) — no subprocess probe, no deadline risk."""
+        import time
+
+        from jepsen_tpu.utils import jaxenv
+
+        monkeypatch.setenv("JAX_PLATFORMS", "CPU")
+        t0 = time.monotonic()
+        # deadline far below the probe's runtime: if the fast path were
+        # missed, the probe subprocess (python -c 'import jax...') could
+        # not possibly finish in time and we'd see TimeoutError
+        backend = jaxenv.ensure_backend(deadline=120.0)
+        assert backend == "cpu"
+        assert time.monotonic() - t0 < 30.0  # no 45 s probe rounds

@@ -377,3 +377,28 @@ def test_full_mutex_run_three_node_replicated(_reset):
         expect="valid",
         checks=checks,
     )
+
+
+def test_full_fenced_mutex_run_three_node_replicated(_reset):
+    """The fenced lock across a 3-node replicated cluster with a real
+    partition: grants carry Raft-commit-index tokens, revocations (the
+    dead-owner reap that REDS the unfenced family under load) advance
+    the fence, and the run checks green against the FencedMutex model —
+    the mutex family's green ending (VERDICT r5 weak #2)."""
+    from _live import run_live_with_triage
+    from jepsen_tpu.history.ops import OpF
+
+    def checks(run):
+        assert run.results["mutex"]["model"] == "fenced-mutex"
+        assert run.results["mutex"]["configs-explored"] > 0
+        # at least one grant actually carried a token
+        assert any(
+            op.is_ok and op.f == OpF.ACQUIRE and isinstance(op.value, int)
+            for op in run.history
+        )
+
+    run_live_with_triage(
+        lambda: _three_node_build("mutex", {"rate": 40.0, "fenced": True}),
+        expect="valid",
+        checks=checks,
+    )

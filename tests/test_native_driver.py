@@ -582,3 +582,115 @@ class TestNativeMutex:
         for backend in ("cpu", "tpu"):
             r = MutexWgl(backend=backend).check({}, h)
             assert r["valid?"] is False, (backend, r)
+
+
+class TestNativeFencedMutex:
+    """Fencing-token mode end-to-end over the wire: grants carry
+    monotonically increasing tokens in the ``x-fence-token`` header,
+    releases publish the token back under ``x-fence-release``, and the
+    broker REJECTS (nacks) stale tokens — the green counterpart of the
+    unfenced revocation hazard ``TestNativeMutex`` documents."""
+
+    def _lock(self, native_lib, broker, **kw):
+        from jepsen_tpu.client.native import NativeMutexDriver
+
+        kw.setdefault("connect_retry_ms", 3000)
+        kw.setdefault("fenced", True)
+        return NativeMutexDriver("127.0.0.1", port=broker.port, **kw)
+
+    def test_tokens_strictly_increase_across_grants(self, native_lib, broker):
+        a = self._lock(native_lib, broker)
+        b = self._lock(native_lib, broker)
+        a.setup()
+        b.setup()
+        t1 = a.acquire_fenced(5.0)
+        assert t1 > 0
+        assert b.acquire_fenced(5.0) == 0  # busy
+        assert a.release_fenced(5.0) == t1
+        t2 = b.acquire_fenced(5.0)
+        assert t2 > t1
+        assert b.release_fenced(5.0) == t2
+        a.close()
+        b.close()
+
+    def test_revocation_regrant_outranks_and_stale_release_fails(
+        self, native_lib, broker
+    ):
+        """The exact shape that REDS unfenced: holder's connection blips,
+        token requeues, next contender granted.  Fenced: the re-grant's
+        token strictly outranks the revoked one, and the revoked holder's
+        release reports failure instead of success."""
+        a = self._lock(native_lib, broker)
+        b = self._lock(native_lib, broker)
+        a.setup()
+        b.setup()
+        t1 = a.acquire_fenced(5.0)
+        assert t1 > 0
+        a.reconnect()  # revocation: the broker requeues the grant
+        t2 = b.acquire_fenced(5.0)
+        assert t2 > t1  # the fence advanced past the revoked token
+        assert a.release_fenced(5.0) == 0  # not the holder any more
+        assert b.release_fenced(5.0) == t2
+        a.close()
+        b.close()
+
+    def test_wire_level_stale_release_is_nacked(self, native_lib, broker):
+        """A holder whose token was superseded while its CONNECTION
+        stayed alive (the replicated dead-owner reap shape) gets a
+        broker-side nack: the release publish travels the wire and comes
+        back REJECTED."""
+        a = self._lock(native_lib, broker)
+        a.setup()
+        t1 = a.acquire_fenced(5.0)
+        assert t1 > 0
+        # supersede the token broker-side without touching a's connection
+        with broker.state_lock:
+            broker._fence_seq += 1
+            broker.fences["jepsen.lock"] = broker._fence_seq
+        assert a.release_fenced(5.0) == 0  # nacked: stale token
+        a.close()
+
+    def test_fenced_history_through_client_is_valid_under_revocation(
+        self, native_lib, broker
+    ):
+        """The MutexClient mapping records tokens into the history; the
+        revocation double-grant shape that refutes OwnedMutex checks
+        GREEN against the auto-detected FencedMutex model."""
+        from jepsen_tpu.checkers.wgl import MutexWgl
+        from jepsen_tpu.client.native import native_mutex_driver_factory
+        from jepsen_tpu.client.protocol import MutexClient
+        from jepsen_tpu.history.ops import Op, OpF, reindex
+
+        factory = native_mutex_driver_factory(
+            port=broker.port, connect_retry_ms=3000
+        )
+        test = {"quorum-initial-group-size": 0, "fenced": True}
+        base = MutexClient(factory, op_timeout_s=2.0, fenced=True)
+        c0 = base.open(test, "127.0.0.1")
+        c1 = base.open(test, "127.0.0.1")
+        c0.setup(test)
+        c1.setup(test)
+        history = []
+
+        def run(client, proc, f):
+            inv = Op.invoke(f, proc)
+            history.append(inv)
+            history.append(client.invoke(test, inv))
+
+        run(c0, 0, OpF.ACQUIRE)          # granted, token recorded
+        assert history[-1].is_ok and isinstance(history[-1].value, int)
+        c0.driver.reconnect()            # revocation mid-hold
+        run(c1, 1, OpF.ACQUIRE)          # re-granted, higher token
+        run(c0, 0, OpF.RELEASE)          # stale: FAIL, not silent success
+        assert history[-1].is_fail
+        run(c1, 1, OpF.RELEASE)
+        c0.close(test)
+        c1.close(test)
+        h = reindex(history)
+        r = MutexWgl(backend="cpu").check({}, h)
+        assert r["model"] == "fenced-mutex"
+        assert r["valid?"] is True, r
+        # the SAME run judged unfenced (tokens ignored, holds only)
+        # shows the double grant — proof the green is fencing, not luck
+        r_unfenced = MutexWgl(backend="cpu", fenced=False).check({}, h)
+        assert r_unfenced["valid?"] is False

@@ -557,3 +557,41 @@ def test_departed_member_inflight_requeued_by_survivors(native_lib):
         )
     finally:
         c.stop()
+
+
+def test_fenced_lock_tokens_are_raft_commit_indices(native_lib, cluster):
+    """Fenced grants across the replicated cluster carry the Raft log
+    index of the grant commit — strictly increasing even across a
+    dead-owner REVOCATION (the shape that double-grants unfenced: the
+    reaped holder's token is superseded and its release is rejected)."""
+    from jepsen_tpu.client.native import NativeMutexDriver
+
+    a_node, b_node = cluster.leader(), cluster.followers()[0]
+    a = NativeMutexDriver(
+        "127.0.0.1", port=cluster.brokers[a_node].port, fenced=True,
+        connect_retry_ms=3000,
+    )
+    b = NativeMutexDriver(
+        "127.0.0.1", port=cluster.brokers[b_node].port, fenced=True,
+        connect_retry_ms=3000,
+    )
+    a.setup()
+    b.setup()
+    t1 = a.acquire_fenced(5.0)
+    assert t1 > 0
+    # the token IS the replicated fence on the leader's machine
+    lead = cluster.brokers[cluster.leader()].replication
+    assert lead.machine.fences.get("jepsen.lock") == t1
+    assert b.acquire_fenced(5.0) == 0  # busy cluster-wide
+    assert a.release_fenced(5.0) == t1
+    t2 = b.acquire_fenced(5.0)
+    assert t2 > t1
+    # revocation without the holder's consent: b's connection dies, the
+    # close sweep requeues the grant through the log (fence advances)
+    b.reconnect()
+    t3 = a.acquire_fenced(8.0)
+    assert t3 > t2
+    assert b.release_fenced(5.0) == 0  # revoked holder: not a release
+    assert a.release_fenced(5.0) == t3
+    a.close()
+    b.close()

@@ -418,3 +418,172 @@ class TestMembershipSafety:
             {"rpc": "forget_request", "name": "b", "from": "a"}
         )
         assert resp == {"ok": False}
+
+
+class TestFencingMachine:
+    """Fencing-token semantics of the replicated state machine: every
+    ownership transition (grant / revocation-requeue / release) advances
+    the queue's fence to its own commit index, and stale-token
+    operations are rejected deterministically at apply time."""
+
+    def _lock_machine(self):
+        m = QueueMachine()
+        m.apply(1, {"k": "declare", "q": "lock", "fenced": True})
+        m.apply(
+            2,
+            {"k": "enq", "q": "lock", "body": _b64(b"1"), "props": "",
+             "ts": 0.0},
+        )
+        return m
+
+    def test_grant_token_is_commit_index_and_monotonic(self):
+        m = self._lock_machine()
+        msg = m.apply(3, {"k": "deq", "q": "lock", "owner": "a|c1",
+                          "now": 0.0})
+        assert msg.fence == 3
+        assert m.fences["lock"] == 3
+        # revocation: the requeue advances the fence past the holder
+        m.apply(4, {"k": "requeue_owner", "owner": "a|c1"})
+        assert m.fences["lock"] == 4
+        # re-grant: strictly higher token, stripped of the old fence
+        msg2 = m.apply(5, {"k": "deq", "q": "lock", "owner": "b|c1",
+                           "now": 0.0})
+        assert msg2.fence == 5 > msg.fence
+
+    def test_stale_release_rejected_current_release_accepted(self):
+        m = self._lock_machine()
+        m.apply(3, {"k": "deq", "q": "lock", "owner": "a|c1", "now": 0.0})
+        m.apply(4, {"k": "requeue_owner", "owner": "a|c1"})  # revoked
+        m.apply(5, {"k": "deq", "q": "lock", "owner": "b|c1", "now": 0.0})
+        # the revoked holder's release: REJECTED (token 3 superseded)
+        r = m.apply(
+            6,
+            {"k": "fence_release", "q": "lock", "token": 3,
+             "body": _b64(b"1"), "props": "", "ts": 0.0},
+        )
+        assert r == {"stale": True}
+        assert "lock" not in {q for q, d in m.queues.items() if d} or not (
+            m.queues.get("lock")
+        )
+        # the current holder's release: grant settles atomically with the
+        # token's return, fence advances to the release commit
+        r = m.apply(
+            7,
+            {"k": "fence_release", "q": "lock", "token": 5,
+             "body": _b64(b"1"), "props": "", "ts": 0.0},
+        )
+        assert r["released"] and not m.inflight
+        assert m.fences["lock"] == 7
+        assert len(m.queues["lock"]) == 1  # exactly one token, ever
+
+    def test_fenced_protected_publish_stale_vs_current(self):
+        m = self._lock_machine()
+        msg = m.apply(3, {"k": "deq", "q": "lock", "owner": "a|c1",
+                          "now": 0.0})
+        m.apply(1000, {"k": "declare", "q": "data"})
+        # current token: the protected publish lands
+        r = m.apply(
+            1001,
+            {"k": "enq", "q": "data", "body": _b64(b"x"), "props": "",
+             "ts": 0.0, "fence": msg.fence, "fence_q": "lock"},
+        )
+        assert r is None and len(m.queues["data"]) == 1
+        # revoke + re-grant: the old token's publish is REJECTED
+        m.apply(1002, {"k": "requeue_owner", "owner": "a|c1"})
+        m.apply(1003, {"k": "deq", "q": "lock", "owner": "b|c1",
+                       "now": 0.0})
+        r = m.apply(
+            1004,
+            {"k": "enq", "q": "data", "body": _b64(b"y"), "props": "",
+             "ts": 0.0, "fence": msg.fence, "fence_q": "lock"},
+        )
+        assert r == {"stale": True}
+        assert len(m.queues["data"]) == 1  # the stale write never landed
+
+
+class TestCommitAdvanceCap:
+    """Red/green regression for the advisor-r5 high finding
+    (replication.py commit advance): an empty heartbeat at a low
+    prev_idx must never commit a follower's divergent uncommitted
+    suffix.  Pre-fix, `commit_idx = min(leader_commit, len(log))`
+    applied the divergent entry (permanently — applies never revert);
+    the §5.3 cap bounds commit at prev + len(entries)."""
+
+    def _follower(self, applied):
+        peers = {
+            "f": ("127.0.0.1", 0),
+            "l1": ("127.0.0.1", 1),  # never listening: scripted RPCs only
+            "l2": ("127.0.0.1", 2),
+        }
+        return RaftNode(
+            "f",
+            peers,
+            lambda i, op: applied.append((i, op["k"])),
+            election_timeout=(60.0, 120.0),  # never campaigns in-test
+        )
+
+    def test_heartbeat_cannot_commit_divergent_suffix(self):
+        applied = []
+        n = self._follower(applied)
+        try:
+            # term-1 leader replicates two entries; the second will turn
+            # out to be divergent (uncommitted when the leader fell)
+            r = n._on_append_entries({
+                "rpc": "append_entries", "term": 1, "from": "l1",
+                "prev_idx": 0, "prev_term": 0,
+                "entries": [[1, {"k": "noop"}], [1, {"k": "divergent"}]],
+                "leader_commit": 0,
+            })
+            assert r["ok"] and applied == []
+            # new term-2 leader (elected without entry 2), match_idx
+            # still 0: its first heartbeat carries prev_idx=0, no
+            # entries, and its own commit index 2 (noop + its no-op)
+            r = n._on_append_entries({
+                "rpc": "append_entries", "term": 2, "from": "l2",
+                "prev_idx": 0, "prev_term": 0, "entries": [],
+                "leader_commit": 2,
+            })
+            assert r["ok"]
+            # THE BUG (pre-fix): commit_idx jumped to min(2, len(log))=2
+            # and applied the divergent entry.  Post-fix: the heartbeat
+            # proved nothing past prev_idx=0 — commit must not move.
+            assert n.commit_idx == 0, (
+                "heartbeat committed past its proven-matching prefix"
+            )
+            assert ("divergent" not in [k for _i, k in applied])
+            # the repair AppendEntries truncates the divergence and
+            # carries the real entry 2; NOW commit legitimately reaches 2
+            r = n._on_append_entries({
+                "rpc": "append_entries", "term": 2, "from": "l2",
+                "prev_idx": 1, "prev_term": 1,
+                "entries": [[2, {"k": "cfg_probe"}]],
+                "leader_commit": 2,
+            })
+            assert r["ok"]
+            assert n.commit_idx == 2
+            assert applied == [(1, "noop"), (2, "cfg_probe")]
+        finally:
+            n.stop()
+
+    def test_commit_never_regresses_on_low_prev_heartbeat(self):
+        """The cap must also never move commit BACKWARD: a heartbeat at
+        prev_idx=0 arriving after entries committed must leave
+        commit_idx alone."""
+        applied = []
+        n = self._follower(applied)
+        try:
+            n._on_append_entries({
+                "rpc": "append_entries", "term": 1, "from": "l1",
+                "prev_idx": 0, "prev_term": 0,
+                "entries": [[1, {"k": "noop"}], [1, {"k": "noop"}]],
+                "leader_commit": 2,
+            })
+            assert n.commit_idx == 2
+            n._on_append_entries({
+                "rpc": "append_entries", "term": 1, "from": "l1",
+                "prev_idx": 0, "prev_term": 0, "entries": [],
+                "leader_commit": 2,
+            })
+            assert n.commit_idx == 2  # unchanged, not clamped to 0
+        finally:
+            n.stop()

@@ -544,3 +544,92 @@ class TestLiveMutexMonitor:
         run = run_test(test)
         assert run.valid
         assert m.snapshot()["violation-so-far"] is False
+
+
+def test_fenced_mutex_revocation_injection_is_valid(tmp_path):
+    """The injection that REDS the unfenced mutex family (double_grant:
+    grant-while-held) models a revocation + re-grant in fenced mode —
+    tokens keep increasing, the superseded holder's release fails, and
+    the fenced checker stays green.  The green ending the family was
+    missing (VERDICT r5 weak #2)."""
+    test, _cluster = build_sim_test(
+        opts={**FAST_OPTS, "fenced": True},
+        store_root=str(tmp_path / "store"),
+        workload="mutex",
+        double_grant_every=3,
+    )
+    run = run_test(test)
+    assert run.results["mutex"]["valid?"] is True, run.results["mutex"]
+    assert run.results["mutex"]["model"] == "fenced-mutex"
+    # tokens actually flowed into the history
+    assert any(
+        op.is_ok and op.f == OpF.ACQUIRE and isinstance(op.value, int)
+        for op in run.history
+    )
+
+
+def test_fenced_mutex_stale_token_injection_is_refuted(tmp_path):
+    """The fencing BUG (a grant re-issuing an already-granted token) is
+    a definite violation under the fenced model."""
+    test, _cluster = build_sim_test(
+        opts={**FAST_OPTS, "fenced": True},
+        store_root=str(tmp_path / "store"),
+        workload="mutex",
+        double_grant_every=3,
+        stale_token_every=2,
+    )
+    run = run_test(test)
+    assert run.results["mutex"]["valid?"] is False
+    assert run.results["mutex"]["model"] == "fenced-mutex"
+
+
+class TestLiveFencedMutexMonitor:
+    def test_unit_token_reuse_rule(self):
+        from jepsen_tpu.checkers.live import LiveFencedMutex
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        fired = []
+        m = LiveFencedMutex(on_anomaly=lambda k, v, i: fired.append((k, v)))
+        a = Op.invoke(OpF.ACQUIRE, 0)
+        m.observe(a.complete(OpType.OK, value=5))
+        # overlapping grant with a HIGHER token: the tolerated revocation
+        # shape — must NOT fire (LiveMutex would have)
+        b = Op.invoke(OpF.ACQUIRE, 1)
+        m.observe(b.complete(OpType.OK, value=9))
+        assert not fired
+        # the same token granted twice: definitive the moment it lands
+        c = Op.invoke(OpF.ACQUIRE, 2)
+        m.observe(c.complete(OpType.OK, value=9))
+        assert fired == [("token-reuse", 9)]
+        assert m.snapshot()["violation-so-far"] is True
+
+    def test_fenced_sim_run_with_revocations_stays_silent(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts={**FAST_OPTS, "fenced": True},
+            store_root=str(tmp_path / "store"),
+            workload="mutex",
+            double_grant_every=3,
+        )
+        m = attach_live_monitor_for(test, "fenced-mutex")
+        run = run_test(test)
+        assert run.results["mutex"]["valid?"] is True
+        assert m.snapshot()["violation-so-far"] is False
+
+    def test_fenced_sim_stale_tokens_flagged_mid_run(self, tmp_path):
+        from jepsen_tpu.checkers.live import attach_live_monitor_for
+
+        test, _cluster = build_sim_test(
+            opts={**FAST_OPTS, "rate": 600.0, "fenced": True},
+            store_root=str(tmp_path / "store"),
+            workload="mutex",
+            double_grant_every=2,
+            stale_token_every=2,
+        )
+        m = attach_live_monitor_for(test, "fenced-mutex")
+        run = run_test(test)
+        snap = m.snapshot()
+        assert snap["anomalies"]["token-reuse"] > 0
+        assert snap["violation-so-far"] is True
+        assert run.results["mutex"]["valid?"] is False

@@ -397,3 +397,154 @@ def test_synth_mutex_differential():
         assert cpu["valid?"] is s.clean, (i, cpu)
         if not unknown[i]:
             assert bool(ok[i]) is s.clean, i
+
+
+# ---- fenced mutex (fencing-token mode) ------------------------------------
+
+
+def _fenced_hist(events):
+    """events: (f, proc, type, token_or_None) in completion order, each
+    op invoked immediately before its completion."""
+    hist = []
+    for f, proc, typ, token in events:
+        inv = Op.invoke(f, proc)
+        hist.append(inv)
+        hist.append(inv.complete(typ, value=token))
+    return reindex(hist)
+
+
+def _both_fenced(ops):
+    from jepsen_tpu.models.core import FencedMutex
+
+    cpu = check_wgl_cpu(ops, FencedMutex())
+    batch = pack_wgl_batch([ops])
+    ok, unknown = wgl_tensor_check(batch, (FencedMutex, ()))
+    assert not unknown[0], "tensor search overflowed on a tiny history"
+    assert bool(ok[0]) == cpu["valid?"], f"cpu={cpu} tpu={bool(ok[0])}"
+    return cpu["valid?"]
+
+
+def test_fenced_model_overlapping_holds_with_increasing_tokens_legal():
+    """The revocation shape that REDS the unfenced model: two grants with
+    no release between them.  Fenced, it is the tolerated hazard — tokens
+    increased, the old holder's release FAILED — so the history is legal."""
+    from jepsen_tpu.checkers.wgl import fenced_mutex_wgl_ops
+    from jepsen_tpu.models.core import FencedMutex
+
+    h = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, 5),
+            (OpF.ACQUIRE, 1, OpType.OK, 9),   # revocation re-grant
+            (OpF.RELEASE, 0, OpType.FAIL, None),  # stale: rejected
+            (OpF.RELEASE, 1, OpType.OK, 9),
+        ]
+    )
+    ops = fenced_mutex_wgl_ops(h)
+    assert [o.call.a1 for o in ops] == [5, 9, 9]
+    assert _both_fenced(ops)
+    # the SAME shape without tokens refutes against OwnedMutex
+    from jepsen_tpu.checkers.wgl import MutexWgl
+
+    unfenced = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, None),
+            (OpF.ACQUIRE, 1, OpType.OK, None),
+        ]
+    )
+    assert MutexWgl(backend="cpu").check({}, unfenced)["valid?"] is False
+
+
+def test_fenced_model_token_reuse_refuted():
+    """One token granted twice admits no legal order: the second grant
+    can never be strictly greater."""
+    from jepsen_tpu.checkers.wgl import fenced_mutex_wgl_ops
+
+    h = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, 5),
+            (OpF.ACQUIRE, 1, OpType.OK, 5),  # THE BUG: token reuse
+        ]
+    )
+    assert not _both_fenced(fenced_mutex_wgl_ops(h))
+
+
+def test_fenced_model_stale_release_success_refuted():
+    """A stale-token release that SUCCEEDED after the superseding grant
+    completed is exactly what fencing forbids."""
+    from jepsen_tpu.checkers.wgl import fenced_mutex_wgl_ops
+
+    h = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, 5),
+            (OpF.ACQUIRE, 1, OpType.OK, 9),
+            (OpF.RELEASE, 0, OpType.OK, 5),  # broker failed to fence
+        ]
+    )
+    assert not _both_fenced(fenced_mutex_wgl_ops(h))
+
+
+def test_fenced_release_concurrent_with_regrant_is_ambiguous_hence_legal():
+    """A release overlapping the superseding grant may have linearized
+    first — the checker must find that order, not cry wolf."""
+    from jepsen_tpu.checkers.wgl import fenced_mutex_wgl_ops
+
+    hist = []
+    inv_a = Op.invoke(OpF.ACQUIRE, 0)
+    hist.append(inv_a)
+    hist.append(inv_a.complete(OpType.OK, value=5))
+    inv_r = Op.invoke(OpF.RELEASE, 0)       # release invoked...
+    hist.append(inv_r)
+    inv_b = Op.invoke(OpF.ACQUIRE, 1)       # ...concurrent with the grant
+    hist.append(inv_b)
+    hist.append(inv_b.complete(OpType.OK, value=9))
+    hist.append(inv_r.complete(OpType.OK, value=5))
+    assert _both_fenced(fenced_mutex_wgl_ops(reindex(hist)))
+
+
+def test_fenced_info_ops_are_dropped_soundly():
+    """Indeterminate ops carry no token and are dropped from the fenced
+    mapping — a correct history with timeouts sprinkled in stays green."""
+    from jepsen_tpu.checkers.wgl import fenced_mutex_wgl_ops
+
+    h = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, 3),
+            (OpF.ACQUIRE, 1, OpType.INFO, None),  # timed out: unknown
+            (OpF.RELEASE, 0, OpType.INFO, None),
+            (OpF.ACQUIRE, 2, OpType.OK, 7),
+            (OpF.RELEASE, 2, OpType.OK, 7),
+        ]
+    )
+    ops = fenced_mutex_wgl_ops(h)
+    assert len(ops) == 3  # the two info ops vanished
+    assert _both_fenced(ops)
+
+
+def test_mutex_wgl_autodetects_fenced_histories():
+    """The standard pipeline (check / bench-check re-runs) picks the
+    model from the history itself: token-valued acquires -> FencedMutex,
+    bare acquires -> OwnedMutex."""
+    from jepsen_tpu.checkers.wgl import MutexWgl, mutex_history_is_fenced
+
+    fenced = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, 5),
+            (OpF.ACQUIRE, 1, OpType.OK, 9),
+        ]
+    )
+    unfenced = _fenced_hist(
+        [
+            (OpF.ACQUIRE, 0, OpType.OK, None),
+            (OpF.RELEASE, 0, OpType.OK, None),
+        ]
+    )
+    assert mutex_history_is_fenced(fenced)
+    assert not mutex_history_is_fenced(unfenced)
+    r_f = MutexWgl(backend="cpu").check({}, fenced)
+    assert r_f["model"] == "fenced-mutex" and r_f["valid?"] is True
+    r_u = MutexWgl(backend="cpu").check({}, unfenced)
+    assert r_u["model"] == "owned-mutex" and r_u["valid?"] is True
+    # pinning the model explicitly overrides detection: the fenced
+    # history judged as an unfenced one shows its overlapping holds
+    r_pin = MutexWgl(backend="cpu", fenced=False).check({}, fenced)
+    assert r_pin["model"] == "owned-mutex" and r_pin["valid?"] is False

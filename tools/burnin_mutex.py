@@ -1,0 +1,145 @@
+"""Mutex-family burn-in driver: N-minute mixed-nemesis soaks under the
+``tests/_live.py`` triage harness.
+
+The round-5 burn-ins proved the unfenced single-token lock double-grants
+under kill/pause revocation (``store/burnin_r5_10min_5node_mutex_*_red``)
+— the detection half of the lock story.  This driver produces the other
+half: with ``--fenced``, the SAME 5-node mixed-nemesis revocation
+schedule (same ``--seed`` → same nemesis family picks and victims) must
+soak GREEN, because grants carry Raft-commit-index fencing tokens, the
+broker rejects superseded tokens, and the checker verifies token order
+instead of hold exclusivity.
+
+Run both twins with one seed and tee into ``store/``::
+
+    python tools/burnin_mutex.py --minutes 10 --seed 7 \
+        2>&1 | tee store/burnin_r6_10min_5node_mutex_unfenced_red.txt
+    python tools/burnin_mutex.py --minutes 10 --seed 7 --fenced \
+        2>&1 | tee store/burnin_r6_10min_5node_mutex_fenced_green.txt
+
+Exit code 0 = the run reached its expected verdict (invalid for
+unfenced — the documented hazard — valid for fenced) under the triage
+rules; non-zero = it never did within ``--attempts``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--minutes", type=float, default=10.0)
+    p.add_argument("--nodes", type=int, default=5)
+    p.add_argument("--seed", type=int, default=7,
+                   help="nemesis schedule seed — the SAME seed drives the "
+                        "same revocation schedule for both twins")
+    p.add_argument("--rate", type=float, default=40.0)
+    p.add_argument("--fenced", action="store_true")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="triage attempts (fresh cluster each)")
+    p.add_argument("--store", default=None,
+                   help="store root (default: a temp dir)")
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stdout,
+    )
+
+    from _live import run_live_with_triage
+
+    from jepsen_tpu.checkers.live import attach_live_monitor_for
+    from jepsen_tpu.client import native as native_mod
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.history.store import _json_default
+
+    store = args.store or tempfile.mkdtemp(prefix="burnin_mutex_")
+    opts = {
+        "rate": args.rate,
+        "time-limit": args.minutes * 60.0,
+        "time-before-partition": 2.0,
+        "partition-duration": 10.0,
+        "network-partition": "partition-random-halves",
+        "nemesis": "mixed",
+        "recovery-sleep": 20.0,
+        "publish-confirm-timeout": 5.0,
+        "durable": True,
+        "seed": args.seed,
+        "fenced": args.fenced,
+    }
+    mode = "fenced" if args.fenced else "unfenced"
+    expect = "valid" if args.fenced else "invalid"
+    print(
+        f"# mutex burn-in: {mode}, {args.nodes} nodes, "
+        f"{args.minutes:g} min mixed nemesis, seed={args.seed}, "
+        f"expect={expect}", flush=True,
+    )
+
+    monitors = []
+
+    def build():
+        native_mod.reset()
+        test, transport = build_local_test(
+            opts,
+            n_nodes=args.nodes,
+            concurrency=args.nodes,
+            checker_backend="cpu",
+            store_root=store,
+            workload="mutex",
+            durable=True,
+        )
+        m = attach_live_monitor_for(
+            test, "fenced-mutex" if args.fenced else "mutex"
+        )
+        monitors.append(m)
+        return test, transport
+
+    t0 = time.monotonic()
+    try:
+        run = run_live_with_triage(
+            build, expect=expect, max_attempts=args.attempts
+        )
+    except AssertionError as e:
+        print(f"# burn-in FAILED to reach expect={expect}: {e}", flush=True)
+        return 1
+    wall = time.monotonic() - t0
+    if monitors and monitors[-1] is not None:
+        snap = monitors[-1].snapshot()
+        counts = ", ".join(
+            f"{v} {k}" for k, v in snap["anomalies"].items()
+        )
+        print(
+            f"# live monitor ({monitors[-1].name}): {counts} "
+            f"(of {snap['observations']} observations); "
+            f"violation-so-far={snap['violation-so-far']}", flush=True,
+        )
+    print(json.dumps(run.results, indent=1, default=_json_default))
+    print(
+        f"# burn-in done in {wall:.0f}s wall ({len(run.history)} history "
+        f"ops, attempts logged above)", flush=True,
+    )
+    verdict = run.results.get("valid?")
+    if verdict is True:
+        print("Everything looks good! ヽ('ー`)ノ")
+    else:
+        print("Analysis invalid! ಠ~ಠ")
+    # the run reached the EXPECTED verdict (triage guarantees this)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

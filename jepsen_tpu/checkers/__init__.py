@@ -43,6 +43,10 @@ _LAZY = {
     "ElleListAppend": "elle",
     "check_elle_cpu": "elle",
     "elle_tensor_check": "elle",
+    "check_elle_batch": "elle",
+    "elle_mops_check": "elle",
+    "elle_infer_device": "elle",
+    "pack_elle_mops": "elle",
 }
 
 

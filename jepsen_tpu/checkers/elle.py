@@ -31,16 +31,27 @@ least one rw edge.  Aborted/intermediate reads are **G1a** (a read
 observes a value whose transaction definitely failed) and **G1b** (a read
 ends at a non-final append of some transaction's appends to that key).
 
-**The TPU part — cycle search as MXU work.**  Host-side edge inference is
-a linear parse; the expensive phase is the cycle search over the
-transaction graph.  Here it is dense boolean transitive closure by
-repeated squaring: with ``R₀ = A ∨ I``, ``⌈log₂ T⌉`` squarings give
-all-pairs reachability, and ``diag(A · R)`` marks every transaction on a
-cycle.  Each squaring is a ``[T, T]`` matmul — exactly what the MXU's
-systolic array does at peak, in bf16 with f32 accumulation (a sum of
-< 2¹⁵ ones is exactly representable, and only ``> 0`` is consulted) —
-``vmap``-batched over histories × 3 edge-type graphs.  The CPU reference
-uses iterative Tarjan SCC; both report the same on-cycle transaction sets.
+**The TPU part — cycle search as MXU work.**  The expensive phase is the
+cycle search over the transaction graph: dense boolean transitive
+closure by repeated squaring.  With ``R₀ = A ∨ I``, ``⌈log₂ T⌉``
+squarings give all-pairs reachability, and ``diag(A · R)`` marks every
+transaction on a cycle.  Each squaring is a ``[T, T]`` matmul — exactly
+what the MXU's systolic array does at peak, in bf16 with f32
+accumulation (a sum of < 2¹⁵ ones is exactly representable, and only
+``> 0`` is consulted) — ``vmap``-batched over histories × 3 edge-type
+graphs.  The CPU reference uses iterative Tarjan SCC; both report the
+same on-cycle transaction sets.
+
+**The edge inference itself also runs on device.**  ``infer_txn_graph``
+(the per-history host parse) remains the differential oracle, but the
+production path packs each history into dense micro-op cell columns
+(``elle_mops_for`` / the native ``jt_elle_mops_file``) and infers
+writers, per-key orders, prefix compatibility, G1a/G1b, and the
+ww/wr/rw adjacency with on-device scatters + one sort, fused with the
+cycle search into a single XLA program (``elle_mops_check``) — closing
+the end-to-end gap where per-history host inference capped the batched
+rate at ~half the device-only number (BENCH_r05).  See the device-
+inference section below for the encoding and its degeneracy fallback.
 """
 
 from __future__ import annotations
@@ -270,16 +281,24 @@ def _classify(
     wwr_cyc: set,
     all_cyc: set,
     model: str = "serializable",
+    edge_counts: tuple[int, int, int] | None = None,
 ) -> dict:
     """Adya classification from the three union-graph on-cycle sets
     (``ww_cyc ⊆ wwr_cyc ⊆ all_cyc`` — adding edges preserves cycles):
     G0 = ww cycle; G1c = on a ww∪wr cycle but NOT a pure ww one (needs a
     wr edge); G2 = needs at least one rw edge.  ``model`` selects which
-    classes invalidate; every class is always *reported*."""
+    classes invalidate; every class is always *reported*.
+    ``edge_counts`` overrides ``len(g.ww/wr/rw)`` — the device-inference
+    path counts edges on device instead of materializing edge sets."""
     if model not in CONSISTENCY_MODELS:
         raise ValueError(
             f"unknown consistency model {model!r}; one of {CONSISTENCY_MODELS}"
         )
+    n_ww, n_wr, n_rw = (
+        edge_counts
+        if edge_counts is not None
+        else (len(g.ww), len(g.wr), len(g.rw))
+    )
     g1c = wwr_cyc - ww_cyc
     g2 = all_cyc - wwr_cyc
     bad = bool(wwr_cyc or g.g1a or g.g1b or g.incompatible_order)
@@ -301,9 +320,9 @@ def _classify(
         "G1b-count": len(g.g1b),
         "incompatible-order": g.incompatible_order,
         "incompatible-order-count": len(g.incompatible_order),
-        "ww-edges": len(g.ww),
-        "wr-edges": len(g.wr),
-        "rw-edges": len(g.rw),
+        "ww-edges": n_ww,
+        "wr-edges": n_wr,
+        "rw-edges": n_rw,
     }
 
 
@@ -418,9 +437,18 @@ class ElleTensors:
     g2: jax.Array  # [B, T] bool — txns on a ww∪wr∪rw cycle
 
 
-@functools.partial(jax.jit, static_argnames=("n_txns",))
-def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int):
-    k = max(int(np.ceil(np.log2(max(n_txns, 2)))), 1)
+def n_squarings(n_txns: int) -> int:
+    """Squarings for full reachability over ``n_txns`` nodes (also the
+    bench roofline's matmul count: ``3 * (n_squarings + 1)`` dots)."""
+    return max(int(np.ceil(np.log2(max(n_txns, 2)))), 1)
+
+
+def _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns: int):
+    """Shared cycle-search body: union graphs → batched transitive
+    closure → per-class on-cycle masks.  Jitted by its two callers
+    (``_elle_batch`` over host-packed graphs, ``_elle_mops_program``
+    fused behind the device inference)."""
+    k = n_squarings(n_txns)
     wwr = jnp.minimum(ww + wr, jnp.bfloat16(1))
     alle = jnp.minimum(wwr + rw, jnp.bfloat16(1))
 
@@ -434,6 +462,11 @@ def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int):
     return ElleTensors(valid=valid, g0=g0, g1c=g1c, g2=g2)
 
 
+@functools.partial(jax.jit, static_argnames=("n_txns",))
+def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int):
+    return _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns)
+
+
 def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
     return _elle_batch(
         batch.ww,
@@ -445,20 +478,652 @@ def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
     )
 
 
+# ---------------------------------------------------------------------------
+# Device-side edge inference: micro-op cell columns -> adjacency on device
+#
+# ``infer_txn_graph`` above is a host-side linear parse PER HISTORY — the
+# term that capped the elle family's end-to-end rate at ~half its
+# device-only cycle-search rate (BENCH_r05: 661 vs 1,347 hist/s).  The
+# packed micro-op format below moves the inference itself onto the
+# accelerator: the host emits one dense int32 cell row per committed
+# micro-op element (a linear, dict-lookup-only pass with a native C++
+# twin, ``jt_elle_mops_file``), and the device builds writer tables,
+# per-key inferred orders, prefix-compatibility, G1a/G1b, and the
+# ww/wr/rw adjacency with segment scatters + one sort — feeding the same
+# ``_on_cycle_tensor`` closure, in one fused XLA program.
+#
+# Tensorizability rests on the workload's design fact that appended
+# values are globally unique (SURVEY.md: one incrementing counter): the
+# per-key inferred order can then be represented value-indexed
+# (``okey``/``opos``/``succ`` tables) instead of as ragged lists.  The
+# host pack detects the garbage inputs that would break that encoding
+# (a value appended twice, observed under two keys, or duplicated inside
+# one observed list) and flags the history ``degenerate`` — such
+# histories fall back to ``infer_txn_graph``, keeping the Python twin
+# the single source of truth for every input the tensor encoding cannot
+# represent.
+# ---------------------------------------------------------------------------
+
+#: cell kinds of the packed micro-op format
+KIND_APPEND, KIND_READ, KIND_EMPTY_READ, KIND_FAIL_APPEND = 0, 1, 2, 3
+
+#: columns of one packed micro-op cell row, in matrix order
+MOP_COLUMNS = ("txn", "kind", "key", "val", "rpos", "rid", "alast", "process")
+
+#: per-history cell-count cap: the device sort key is ``rid*M + rpos``
+#: in int32, so M(M+1) must stay below 2^31
+_MOPS_MAX_CELLS = 46_000
+
+_I32 = np.iinfo(np.int32)
+
+
+@dataclass
+class ElleMopsMeta:
+    """Host-side facts about one packed history that never ship to the
+    device: reporting metadata plus the ``degenerate`` fallback flag."""
+
+    n_txns: int
+    txn_index: list[int]
+    keys: list  # dense key id -> original key (reporting)
+    degenerate: bool = False
+
+
+def elle_mops_for(history: Sequence[Op]) -> tuple[np.ndarray, ElleMopsMeta]:
+    """One history → (``[M, 8]`` int32 micro-op cell matrix, meta).
+
+    A linear pass mirroring ``infer_txn_graph``'s collection phase — it
+    walks ops in history order, filters micro-ops with the same
+    ``len == 3`` / ``isinstance`` guards, and densifies keys and values
+    to per-history ids in first-encounter order (the canonical order the
+    native twin reproduces bit-identically) — but performs NO inference:
+    orders, prefix checks, and edges are the device program's job."""
+    key_id: dict = {}
+    keys: list = []
+    val_id: dict = {}
+    writer_seen: set = set()
+    read_key_of: dict = {}
+    cells: list[tuple] = []
+    txn_index: list[int] = []
+    degenerate = False
+    rid = 0
+    t = 0
+
+    def kid(k):
+        i = key_id.get(k)
+        if i is None:
+            i = key_id[k] = len(keys)
+            keys.append(k)
+        return i
+
+    def vid(v):
+        i = val_id.get(v)
+        if i is None:
+            i = val_id[v] = len(val_id)
+        return i
+
+    for pos, op in enumerate(history):
+        if op.f != OpF.TXN or op.type == OpType.INVOKE:
+            continue
+        mops = _txn_micro_ops(op)
+        proc = int(max(min(op.process, _I32.max), _I32.min))
+        if op.type == OpType.FAIL:
+            for m in mops:
+                if len(m) == 3 and m[0] == APPEND and isinstance(m[2], int):
+                    # key column unused for failed appends (the failed
+                    # table is value-indexed) — and deliberately NOT
+                    # interned: infer_txn_graph never hashes a failed
+                    # append's key, so neither may this twin
+                    cells.append(
+                        (-1, KIND_FAIL_APPEND, 0, vid(m[2]), -1, -1, 0, proc)
+                    )
+            continue
+        if op.type != OpType.OK:
+            continue  # info: indeterminate, contributes nothing
+        txn_index.append(pos)
+        last_app: dict = {}  # key -> micro-op index of t's last append
+        for i, m in enumerate(mops):
+            if len(m) == 3 and m[0] == APPEND and isinstance(m[2], int):
+                last_app[m[1]] = i
+        for i, m in enumerate(mops):
+            if len(m) != 3:
+                continue
+            if m[0] == APPEND and isinstance(m[2], int):
+                if m[2] in writer_seen:
+                    degenerate = True  # writer_of is last-wins on host
+                writer_seen.add(m[2])
+                cells.append(
+                    (
+                        t,
+                        KIND_APPEND,
+                        kid(m[1]),
+                        vid(m[2]),
+                        -1,
+                        -1,
+                        int(last_app[m[1]] == i),
+                        proc,
+                    )
+                )
+            elif m[0] == READ and isinstance(m[2], (list, tuple)):
+                k = kid(m[1])
+                vs = [v for v in m[2] if isinstance(v, int)]
+                if not vs:
+                    cells.append(
+                        (t, KIND_EMPTY_READ, k, -1, -1, rid, 0, proc)
+                    )
+                else:
+                    if len(set(vs)) != len(vs):
+                        degenerate = True  # positional encoding ambiguous
+                    for j, v in enumerate(vs):
+                        if read_key_of.setdefault(v, m[1]) != m[1]:
+                            degenerate = True  # value observed under 2 keys
+                        cells.append(
+                            (t, KIND_READ, k, vid(v), j, rid, 0, proc)
+                        )
+                rid += 1
+        t += 1
+
+    if len(cells) > _MOPS_MAX_CELLS:
+        degenerate = True  # int32 sort-key headroom (see _MOPS_MAX_CELLS)
+    mat = np.asarray(cells, np.int32).reshape(-1, len(MOP_COLUMNS))
+    return mat, ElleMopsMeta(
+        n_txns=t, txn_index=txn_index, keys=keys, degenerate=degenerate
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ElleMops:
+    """A batch of histories as packed micro-op cell columns ``[B, M]``,
+    ready for on-device edge inference.  Statics size the device-side
+    scatter tables (txn / value / key / read spaces)."""
+
+    txn: jax.Array  # [B, M] i32 — committed txn id (-1: failed append)
+    kind: jax.Array  # [B, M] i32 — KIND_* codes
+    key: jax.Array  # [B, M] i32 — dense per-history key id
+    val: jax.Array  # [B, M] i32 — dense per-history value id (-1: none)
+    rpos: jax.Array  # [B, M] i32 — position within the observed list
+    rid: jax.Array  # [B, M] i32 — dense per-history read id
+    alast: jax.Array  # [B, M] i32 — 1: txn's last append to this key
+    mask: jax.Array  # [B, M] bool
+    n_committed: jax.Array  # [B] i32
+    n_txns: int = dataclasses.field(metadata=dict(static=True), default=0)
+    n_vals: int = dataclasses.field(metadata=dict(static=True), default=0)
+    n_keys: int = dataclasses.field(metadata=dict(static=True), default=0)
+    n_reads: int = dataclasses.field(metadata=dict(static=True), default=0)
+
+    @property
+    def batch(self) -> int:
+        return self.txn.shape[0]
+
+    @property
+    def length(self) -> int:
+        return self.txn.shape[1]
+
+
+def pack_elle_mop_mats(
+    mats: Sequence[np.ndarray],
+    metas: Sequence[ElleMopsMeta],
+    n_txns: int | None = None,
+    to_device: bool = True,
+) -> ElleMops:
+    """Assemble per-history ``[M, 8]`` cell matrices into one
+    :class:`ElleMops` (pad + stack only — the split mirrors
+    ``pack_row_matrices`` so native/cached matrices skip re-emission)."""
+    from jepsen_tpu.history.encode import LANE, _round_up
+
+    if not mats:
+        raise ValueError("cannot pack an empty batch of histories")
+
+    def bucket(n: int) -> int:
+        # power-of-two shape buckets (>= LANE): the device programs jit
+        # on (T, V, K, R) and the [B, M] column shapes, so content-
+        # proportional padding would compile a fresh program per
+        # distinct history size — pow2 bucketing bounds the compile
+        # cache to log-many entries per axis.  Past the int32 sort-key
+        # cap the M bucket degrades to LANE rounding (the per-history
+        # degenerate flag keeps cells under _MOPS_MAX_CELLS anyway).
+        b = LANE
+        while b < n:
+            b <<= 1
+        return b if b <= 1 << 15 else _round_up(n, LANE)
+
+    n_max = max(g.n_txns for g in metas)
+    T = n_txns if n_txns is not None else _round_up(n_max, LANE)
+    if n_max > T:
+        raise ValueError(f"graph with {n_max} txns exceeds T={T}")
+    M = bucket(max(m.shape[0] for m in mats))
+    if M > _MOPS_MAX_CELLS + LANE:
+        raise ValueError(
+            f"packed cell axis M={M} exceeds the int32 sort-key headroom "
+            f"({_MOPS_MAX_CELLS}); such histories must be flagged "
+            "degenerate and host-inferred"
+        )
+
+    def space(col: int) -> int:
+        return bucket(
+            max(
+                (int(m[:, col].max(initial=-1)) for m in mats if m.shape[0]),
+                default=-1,
+            )
+            + 1
+        )
+
+    V, K, R = space(3), space(2), space(5)
+    B = len(mats)
+    cols = {
+        c: np.full((B, M), -1 if c in ("txn", "val", "rpos", "rid") else 0,
+                   np.int32)
+        for c in MOP_COLUMNS
+    }
+    mask = np.zeros((B, M), bool)
+    for b, m in enumerate(mats):
+        n = m.shape[0]
+        for ci, c in enumerate(MOP_COLUMNS):
+            cols[c][b, :n] = m[:, ci]
+        mask[b, :n] = True
+    conv = jnp.asarray if to_device else np.asarray
+    return ElleMops(
+        txn=conv(cols["txn"]),
+        kind=conv(cols["kind"]),
+        key=conv(cols["key"]),
+        val=conv(cols["val"]),
+        rpos=conv(cols["rpos"]),
+        rid=conv(cols["rid"]),
+        alast=conv(cols["alast"]),
+        mask=conv(mask),
+        n_committed=conv(
+            np.asarray([g.n_txns for g in metas], np.int32)
+        ),
+        n_txns=T,
+        n_vals=V,
+        n_keys=K,
+        n_reads=R,
+    )
+
+
+def pack_elle_mops(
+    histories: Sequence[Sequence[Op]], n_txns: int | None = None
+) -> tuple[ElleMops, list[ElleMopsMeta]]:
+    """Pack histories into micro-op cell columns for device inference."""
+    packed = [elle_mops_for(h) for h in histories]
+    mats = [m for m, _ in packed]
+    metas = [g for _, g in packed]
+    return pack_elle_mop_mats(mats, metas, n_txns=n_txns), metas
+
+
+def _elle_infer_one(txn, kind, key, val, rpos, rid, alast, mask, T, V, K, R):
+    """Edge inference for ONE history's cell columns (vmapped over the
+    batch).  Every stage is a masked scatter into a fixed-width table —
+    out-of-scope rows route to a dump slot (index = table size) that is
+    sliced off — except the winner-order pairing, which is one argsort.
+    The value-indexed order encoding (``okey``/``opos``/``succ``) is
+    sound because the host pack flagged any history where a value is not
+    unique per position (degenerate -> host fallback)."""
+    i32 = jnp.int32
+    M = txn.shape[0]
+    isA = mask & (kind == KIND_APPEND)
+    isRc = mask & (kind == KIND_READ)
+    isRe = mask & (kind == KIND_EMPTY_READ)
+    isF = mask & (kind == KIND_FAIL_APPEND)
+    dV, dR, dK, dT = V, R, K, T  # dump indices of the +1-sized tables
+
+    # value tables from committed / failed appends (values are unique,
+    # so scatter-max is conflict-free)
+    vA = jnp.where(isA, val, dV)
+    writer = jnp.full(V + 1, -1, i32).at[vA].max(txn)
+    wkey = jnp.full(V + 1, -1, i32).at[vA].max(key)
+    not_last = jnp.zeros(V + 1, i32).at[vA].max(1 - alast)
+    failed = jnp.zeros(V + 1, i32).at[jnp.where(isF, val, dV)].max(1)
+
+    valc = jnp.clip(val, 0, V - 1)  # gather-safe; every use is masked
+    keyc = jnp.clip(key, 0, K - 1)
+    ridc = jnp.clip(rid, 0, R - 1)
+
+    # per-read tables ([R+1]; row r of each table is read id r)
+    r_any = jnp.where(isRc | isRe, rid, dR)
+    read_txn = jnp.full(R + 1, -1, i32).at[r_any].max(txn)
+    read_key = jnp.full(R + 1, -1, i32).at[r_any].max(key)
+
+    # own-append normalization: strip the TRAILING own-suffix only (an
+    # own value mid-list stays visible to the prefix check) — keep up to
+    # the last non-own cell of each read
+    own = isRc & (writer[valc] == txn) & (wkey[valc] == key)
+    maxkeep = (
+        jnp.full(R + 1, -1, i32)
+        .at[jnp.where(isRc & ~own, rid, dR)]
+        .max(rpos)
+    )
+    kept = isRc & (rpos <= maxkeep[ridc])
+    len_eff = maxkeep + 1  # [R+1] — post-strip read length
+    vs_last = (
+        jnp.full(R + 1, -1, i32)
+        .at[jnp.where(isRc & (rpos == maxkeep[ridc]), rid, dR)]
+        .max(val)
+    )
+
+    # per-key inferred order = longest post-strip read; ties break to the
+    # smallest read id (Python's first-longest-wins `>` replacement)
+    reads_ix = jnp.arange(R + 1, dtype=i32)
+    valid_read = (read_key >= 0) & (reads_ix < R)  # excl. the dump row
+    longest = (
+        jnp.full(K + 1, -1, i32)
+        .at[jnp.where(valid_read, read_key, dK)]
+        .max(len_eff)
+    )
+    kr_c = jnp.clip(read_key, 0, K - 1)
+    is_long = valid_read & (len_eff == longest[kr_c])
+    winner = (
+        jnp.full(K + 1, R, i32)
+        .at[jnp.where(is_long, read_key, dK)]
+        .min(reads_ix)
+    )
+
+    # value-indexed order tables from the winner reads' kept cells
+    is_wc = kept & (winner[keyc] == rid)
+    vW = jnp.where(is_wc, val, dV)
+    okey = jnp.full(V + 1, -1, i32).at[vW].max(key)
+    opos = jnp.full(V + 1, -1, i32).at[vW].max(rpos)
+    first_val = (
+        jnp.full(K + 1, -1, i32)
+        .at[jnp.where(is_wc & (rpos == 0), key, dK)]
+        .max(val)
+    )
+
+    # prefix compatibility: every kept cell must sit at its value's
+    # position in its key's inferred order
+    cell_bad = kept & ((okey[valc] != key) | (opos[valc] != rpos))
+    incompat = (
+        jnp.zeros(R + 1, i32).at[jnp.where(cell_bad, rid, dR)].max(1)
+    )
+    compat = valid_read & (incompat == 0)
+    bad_keys = (
+        jnp.zeros(K + 1, i32)
+        .at[jnp.where(valid_read & (incompat > 0), read_key, dK)]
+        .max(1)[:K]
+        > 0
+    )
+
+    # G1a: a stripped read cell observes a failed-append value
+    # (compat-independent, exactly like the host loop)
+    g1a = (
+        jnp.zeros(T + 1, i32)
+        .at[jnp.where(kept & (failed[valc] > 0), txn, dT)]
+        .max(1)[:T]
+        > 0
+    )
+
+    # winner-order consecutive pairs via one sort by (read, position):
+    # kept cells of a read are positionally dense, so sort-adjacent cells
+    # of the same read are order-adjacent
+    skey = jnp.where(is_wc, rid * M + rpos, jnp.iinfo(jnp.int32).max)
+    srt = jnp.argsort(skey)
+    sv, sw, sr = val[srt], is_wc[srt], rid[srt]
+    a, b = sv[:-1], sv[1:]
+    pair = sw[:-1] & sw[1:] & (sr[:-1] == sr[1:])
+    ac = jnp.clip(a, 0, V - 1)
+    succ = (
+        jnp.full(V + 1, -1, i32)
+        .at[jnp.where(pair, ac, dV)]
+        .max(b)
+    )
+    wa, wb = writer[ac], writer[jnp.clip(b, 0, V - 1)]
+    ww_ok = pair & (wa >= 0) & (wb >= 0) & (wa != wb)
+
+    def adj(src, dst, ok):
+        return (
+            jnp.zeros((T + 1, T + 1), jnp.bfloat16)
+            .at[jnp.where(ok, src, dT), jnp.where(ok, dst, dT)]
+            .max(jnp.bfloat16(1))[:T, :T]
+        )
+
+    ww = adj(wa, wb, ww_ok)
+
+    # wr: a compatible non-empty read depends on its last value's writer
+    vlc = jnp.clip(vs_last, 0, V - 1)
+    wsrc = writer[vlc]
+    wr_ok = compat & (len_eff > 0) & (wsrc >= 0) & (wsrc != read_txn)
+    wr = adj(wsrc, read_txn, wr_ok)
+
+    # rw: the read missed the NEXT value of its key's order — the
+    # winner-read successor of its last value (or the order's first
+    # value for an empty read)
+    nxt = jnp.where(len_eff > 0, succ[vlc], first_val[kr_c])
+    wnxt = writer[jnp.clip(nxt, 0, V - 1)]
+    rw_ok = compat & (nxt >= 0) & (wnxt >= 0) & (wnxt != read_txn)
+    rw = adj(read_txn, wnxt, rw_ok)
+
+    # G1b: a compatible read ends at a non-final append of its writer's
+    # appends to this key (an intermediate version)
+    g1b_ok = (
+        wr_ok & (wkey[vlc] == read_key) & (not_last[vlc] > 0)
+    )
+    g1b = (
+        jnp.zeros(T + 1, i32)
+        .at[jnp.where(g1b_ok, read_txn, dT)]
+        .max(1)[:T]
+        > 0
+    )
+
+    count = lambda m: jnp.sum(m.astype(jnp.float32)).astype(i32)
+    return dict(
+        ww=ww,
+        wr=wr,
+        rw=rw,
+        g1a=g1a,
+        g1b=g1b,
+        bad_keys=bad_keys,
+        ww_edges=count(ww),
+        wr_edges=count(wr),
+        rw_edges=count(rw),
+    )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ElleInferred:
+    """Device-inferred graph substrate: adjacency per edge type plus the
+    non-cycle anomaly tensors that fold into the verdict.  On the
+    verdict-only fused path (``elle_mops_check`` default) the adjacency
+    fields are None — the [B, T, T] tensors stay internal to the XLA
+    program instead of being materialized as outputs (at 10k histories
+    x T=128 that is ~1 GB of HBM writes nobody reads)."""
+
+    ww: jax.Array | None  # [B, T, T] bf16
+    wr: jax.Array | None  # [B, T, T] bf16
+    rw: jax.Array | None  # [B, T, T] bf16
+    txn_mask: jax.Array  # [B, T] bool
+    g1a: jax.Array  # [B, T] bool
+    g1b: jax.Array  # [B, T] bool
+    bad_keys: jax.Array  # [B, K] bool — incompatible-order key ids
+    ww_edges: jax.Array  # [B] i32
+    wr_edges: jax.Array  # [B] i32
+    rw_edges: jax.Array  # [B] i32
+    other_bad: jax.Array  # [B] bool — any G1a/G1b/incompatible-order
+
+
+def _infer_fields(txn, kind, key, val, rpos, rid, alast, mask, n_committed,
+                  n_txns, n_vals, n_keys, n_reads):
+    d = jax.vmap(
+        lambda *cols: _elle_infer_one(
+            *cols, n_txns, n_vals, n_keys, n_reads
+        )
+    )(txn, kind, key, val, rpos, rid, alast, mask)
+    txn_mask = (
+        jnp.arange(n_txns, dtype=jnp.int32)[None, :] < n_committed[:, None]
+    )
+    other_bad = (
+        d["g1a"].any(-1) | d["g1b"].any(-1) | d["bad_keys"].any(-1)
+    )
+    return ElleInferred(
+        ww=d["ww"],
+        wr=d["wr"],
+        rw=d["rw"],
+        txn_mask=txn_mask,
+        g1a=d["g1a"],
+        g1b=d["g1b"],
+        bad_keys=d["bad_keys"],
+        ww_edges=d["ww_edges"],
+        wr_edges=d["wr_edges"],
+        rw_edges=d["rw_edges"],
+        other_bad=other_bad,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n_txns", "n_vals", "n_keys", "n_reads")
+)
+def _elle_infer_program(txn, kind, key, val, rpos, rid, alast, mask,
+                        n_committed, n_txns, n_vals, n_keys, n_reads):
+    return _infer_fields(txn, kind, key, val, rpos, rid, alast, mask,
+                         n_committed, n_txns, n_vals, n_keys, n_reads)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "n_txns", "n_vals", "n_keys", "n_reads", "with_adjacency"
+    ),
+)
+def _elle_mops_program(txn, kind, key, val, rpos, rid, alast, mask,
+                       n_committed, n_txns, n_vals, n_keys, n_reads,
+                       with_adjacency=False):
+    inf = _infer_fields(txn, kind, key, val, rpos, rid, alast, mask,
+                        n_committed, n_txns, n_vals, n_keys, n_reads)
+    tensors = _elle_cycles(
+        inf.ww, inf.wr, inf.rw, inf.txn_mask, inf.other_bad, n_txns
+    )
+    if not with_adjacency:
+        inf = dataclasses.replace(inf, ww=None, wr=None, rw=None)
+    return tensors, inf
+
+
+def _mops_args(m: ElleMops) -> tuple:
+    return (
+        m.txn, m.kind, m.key, m.val, m.rpos, m.rid, m.alast, m.mask,
+        m.n_committed, m.n_txns, m.n_vals, m.n_keys, m.n_reads,
+    )
+
+
+def elle_infer_device(mops: ElleMops) -> ElleInferred:
+    """Edge inference only (no cycle search) — the mesh path re-shards
+    the adjacency before the closure matmuls."""
+    return _elle_infer_program(*_mops_args(mops))
+
+
+def elle_mops_check(
+    mops: ElleMops, with_adjacency: bool = False
+) -> tuple[ElleTensors, ElleInferred]:
+    """The fused bytes-to-verdict device program: edge inference AND the
+    MXU cycle search in one dispatch.  By default the adjacency stays
+    internal to the program (verdicts + anomaly masks + edge counts
+    out); pass ``with_adjacency=True`` to also materialize the
+    [B, T, T] edge tensors."""
+    return _elle_mops_program(
+        *_mops_args(mops), with_adjacency=with_adjacency
+    )
+
+
+def inferred_to_batch(inf: ElleInferred, n_txns: int) -> ElleBatch:
+    """View device-inferred adjacency as an :class:`ElleBatch` (the
+    host-packed format), e.g. for the seq-sharded mesh closure."""
+    return ElleBatch(
+        ww=inf.ww,
+        wr=inf.wr,
+        rw=inf.rw,
+        txn_mask=inf.txn_mask,
+        host_bad=inf.other_bad,
+        n_txns=n_txns,
+    )
+
+
+def split_elle_mops(
+    mats_metas: Sequence[tuple[np.ndarray, ElleMopsMeta]],
+    n_txns: int | None = None,
+) -> tuple[list[int], ElleMops | None, list[int]]:
+    """THE degeneracy-splice contract, shared by every consumer
+    (``check_elle_batch``, ``device_txn_graphs``, the CLI check path):
+    partition packed histories on ``meta.degenerate`` and assemble the
+    live subset — ``(live_indices, ElleMops | None, degenerate_indices)``.
+    Degenerate histories must go through host inference; routing one
+    onto the device path silently yields a wrong verdict."""
+    live = [i for i, (_, g) in enumerate(mats_metas) if not g.degenerate]
+    degen = [i for i, (_, g) in enumerate(mats_metas) if g.degenerate]
+    mops = (
+        pack_elle_mop_mats(
+            [mats_metas[i][0] for i in live],
+            [mats_metas[i][1] for i in live],
+            n_txns=n_txns,
+        )
+        if live
+        else None
+    )
+    return live, mops, degen
+
+
+def device_txn_graphs(
+    histories: Sequence[Sequence[Op]],
+) -> tuple[list[TxnGraph], list[bool]]:
+    """``TxnGraph`` per history as the DEVICE kernel infers it (edge sets
+    materialized from the adjacency tensors) — the differential-test
+    surface against ``infer_txn_graph`` and the native
+    ``jt_elle_infer_file``.  Degenerate histories take the same host
+    fallback ``check_elle_batch`` uses; the returned flags say which."""
+    mats_metas = [elle_mops_for(h) for h in histories]
+    live, mops, degen = split_elle_mops(mats_metas)
+    flags = [bool(meta.degenerate) for _, meta in mats_metas]
+    graphs: list[TxnGraph | None] = [None] * len(histories)
+    for i in degen:
+        graphs[i] = infer_txn_graph(histories[i])
+    if live:
+        inf = elle_infer_device(mops)
+        adj = {
+            name: np.asarray(getattr(inf, name)) > 0
+            for name in ("ww", "wr", "rw")
+        }
+        g1a = np.asarray(inf.g1a)
+        g1b = np.asarray(inf.g1b)
+        bad = np.asarray(inf.bad_keys)
+        for b, i in enumerate(live):
+            meta = mats_metas[i][1]
+            g = TxnGraph(n=meta.n_txns, txn_index=list(meta.txn_index))
+            for name in ("ww", "wr", "rw"):
+                src, dst = np.nonzero(adj[name][b])
+                getattr(g, name).update(
+                    zip(src.tolist(), dst.tolist())
+                )
+            g.g1a.update(np.nonzero(g1a[b])[0].tolist())
+            g.g1b.update(np.nonzero(g1b[b])[0].tolist())
+            g.incompatible_order.update(
+                meta.keys[k] for k in np.nonzero(bad[b])[0]
+            )
+            graphs[i] = g
+    return graphs, flags
+
+
 def check_elle_batch(
     histories: Sequence[Sequence[Op]],
     n_txns: int | None = None,
     model: str = "serializable",
+    inference: str = "device",
 ) -> list[dict[str, Any]]:
-    graphs = [infer_txn_graph(h) for h in histories]
-    batch = pack_txn_graphs(graphs, n_txns=n_txns)
-    t = elle_tensor_check(batch)
-    g0 = np.asarray(t.g0)
-    g1c = np.asarray(t.g1c)
-    g2 = np.asarray(t.g2)
-    out = []
-    for b, g in enumerate(graphs):
-        out.append(
+    """Batched elle verdicts.  ``inference="device"`` (default) runs the
+    fused on-device edge inference + cycle search; histories the tensor
+    encoding cannot represent (degenerate — see ``elle_mops_for``) are
+    spliced through the host path.  ``inference="host"`` forces the
+    legacy per-history ``infer_txn_graph`` pipeline (the differential
+    oracle, and the bench's comparison point)."""
+    if inference not in ("device", "host"):
+        raise ValueError(f"unknown inference mode {inference!r}")
+    if not histories:
+        raise ValueError("cannot pack an empty batch of histories")
+    if inference == "host":
+        graphs = [infer_txn_graph(h) for h in histories]
+        batch = pack_txn_graphs(graphs, n_txns=n_txns)
+        t = elle_tensor_check(batch)
+        g0 = np.asarray(t.g0)
+        g1c = np.asarray(t.g1c)
+        g2 = np.asarray(t.g2)
+        return [
             _classify(
                 g,
                 set(np.nonzero(g0[b])[0].tolist()),
@@ -466,7 +1131,42 @@ def check_elle_batch(
                 set(np.nonzero(g2[b])[0].tolist()),
                 model=model,
             )
+            for b, g in enumerate(graphs)
+        ]
+
+    mats_metas = [elle_mops_for(h) for h in histories]
+    live, mops, degen = split_elle_mops(mats_metas, n_txns=n_txns)
+    out: list[dict[str, Any] | None] = [None] * len(histories)
+    for i in degen:
+        out[i] = check_elle_cpu(histories[i], model=model)
+    if live:
+        t, inf = elle_mops_check(mops)
+        g0 = np.asarray(t.g0)
+        g1c = np.asarray(t.g1c)
+        g2 = np.asarray(t.g2)
+        g1a = np.asarray(inf.g1a)
+        g1b = np.asarray(inf.g1b)
+        bad = np.asarray(inf.bad_keys)
+        counts = tuple(
+            np.asarray(getattr(inf, f"{n}_edges"))
+            for n in ("ww", "wr", "rw")
         )
+        for b, i in enumerate(live):
+            meta = mats_metas[i][1]
+            g = TxnGraph(n=meta.n_txns, txn_index=list(meta.txn_index))
+            g.g1a.update(np.nonzero(g1a[b])[0].tolist())
+            g.g1b.update(np.nonzero(g1b[b])[0].tolist())
+            g.incompatible_order.update(
+                meta.keys[k] for k in np.nonzero(bad[b])[0]
+            )
+            out[i] = _classify(
+                g,
+                set(np.nonzero(g0[b])[0].tolist()),
+                set(np.nonzero(g1c[b])[0].tolist()),
+                set(np.nonzero(g2[b])[0].tolist()),
+                model=model,
+                edge_counts=tuple(int(c[b]) for c in counts),
+            )
     return out
 
 

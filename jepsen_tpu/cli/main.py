@@ -285,7 +285,7 @@ def cmd_bench_check(args) -> int:
     packed_pre = None  # store-level packed cache hit (no assembly at all)
     store_cache_dst = None  # (root, paths) to save after a fresh pack
     pre_paths = None  # one store walk, reused by every branch below
-    elle_graphs = None  # native-inferred TxnGraphs (file path, no Ops)
+    elle_mops = None  # (src, cell matrix, meta) triples (device inference)
     stream_mats = None  # native-exploded stream columns (file path)
     if args.histories and workload in ("auto", "queue"):
         # store-level packed cache: one file holding the ASSEMBLED
@@ -457,29 +457,32 @@ def cmd_bench_check(args) -> int:
                 # subset would be ambiguous under --workload auto)
                 store_cache_dst = (args.histories, paths)
         elif workload == "elle":
-            # native parse + inference per file (jt_elle_infer_file):
-            # the fresh-pack path never materializes Op objects; files
-            # the native pass can't map fall back to the Python twin
-            from jepsen_tpu.checkers.elle import infer_txn_graph
-            from jepsen_tpu.history.fastpack import elle_graph_file
+            # cached / native micro-op cell emission per file
+            # (elle_mops.npz -> jt_elle_mops_file -> Python twin): the
+            # fresh-pack path never materializes Op objects, a re-check
+            # loads cells straight from the digest-keyed cache, and the
+            # edge inference itself runs ON DEVICE (checkers/elle.py)
+            from jepsen_tpu.history.storecache import elle_mops_with_cache
 
-            def _graph(p, hist):
-                if hist is not None:
-                    return infer_txn_graph(hist)
-                g = elle_graph_file(p)
-                return g if g is not None else infer_txn_graph(
-                    read_history(p)
+            n_hit = 0
+            triples = []
+            for p, kind in zip(paths, kinds):
+                if kind != workload:
+                    triples.append((kind, None))
+                    continue
+                mat, meta, hit = elle_mops_with_cache(
+                    p, history=parsed.get(p)
                 )
-
-            pairs = [
-                (kind, _graph(p, parsed.get(p)))
-                if kind == workload
-                else (kind, None)
-                for p, kind in zip(paths, kinds)
-            ]
-            elle_graphs = _select_family(pairs, workload, args.histories)
-            if elle_graphs is None:
+                n_hit += hit
+                triples.append((kind, (p, mat, meta)))
+            elle_mops = _select_family(triples, workload, args.histories)
+            if elle_mops is None:
                 return 2
+            print(
+                f"# elle cells: {n_hit} of {len(elle_mops)} histories "
+                f"from the packed-cell cache",
+                file=sys.stderr,
+            )
         elif workload == "stream":
             # native parse + row explosion per file (jt_stream_rows_file)
             from jepsen_tpu.checkers.stream_lin import _stream_rows
@@ -654,25 +657,61 @@ def cmd_bench_check(args) -> int:
         import numpy as np
 
         from jepsen_tpu.checkers.elle import (
+            elle_mops_check,
+            elle_mops_for,
             elle_tensor_check,
             infer_txn_graph,
             pack_txn_graphs,
         )
 
+        from jepsen_tpu.checkers.elle import split_elle_mops
+
         t0 = time.perf_counter()
-        packed = pack_txn_graphs(
-            elle_graphs
-            if elle_graphs is not None
-            else [infer_txn_graph(h) for h in histories]
+        if elle_mops is None:  # synthetic histories: pack in-process
+            elle_mops = [(h, *elle_mops_for(h)) for h in histories]
+        live_ix, packed_mops, degen_ix = split_elle_mops(
+            [(m, g) for _, m, g in elle_mops]
         )
+        degen = [elle_mops[i] for i in degen_ix]
+        degen_batch = None
+        if degen:
+            # tensor-unrepresentable histories (see elle_mops_for): the
+            # host inference twin stays their source of truth
+            from jepsen_tpu.history.fastpack import elle_graph_file
+
+            def _graph(src):
+                if isinstance(src, list):  # synthetic ops, no file
+                    return infer_txn_graph(src)
+                g = elle_graph_file(src)
+                return g if g is not None else infer_txn_graph(
+                    read_history(src)
+                )
+
+            degen_batch = pack_txn_graphs(
+                [_graph(src) for src, _, _ in degen]
+            )
+            print(
+                f"# {len(degen)} histories fell back to host inference "
+                f"(tensor-degenerate)",
+                file=sys.stderr,
+            )
         t_pack = time.perf_counter() - t0
-        jax.block_until_ready(elle_tensor_check(packed))  # compile
+        if packed_mops is not None:  # compile
+            jax.block_until_ready(elle_mops_check(packed_mops))
+        if degen_batch is not None:
+            jax.block_until_ready(elle_tensor_check(degen_batch))
         t1 = time.perf_counter()
-        el = elle_tensor_check(packed)
-        jax.block_until_ready(el)
+        n_invalid = 0
+        if packed_mops is not None:
+            el, _ = elle_mops_check(packed_mops)
+            jax.block_until_ready(el)
+            # ElleTensors.valid folds cycle + device-inferred anomalies
+            n_invalid += int((~np.asarray(el.valid)).sum())
+        if degen_batch is not None:
+            el = elle_tensor_check(degen_batch)
+            jax.block_until_ready(el)
+            n_invalid += int((~np.asarray(el.valid)).sum())
         t_check = time.perf_counter() - t1
-        # ElleTensors.valid folds cycle + host-inferred read anomalies
-        n_invalid = int((~np.asarray(el.valid)).sum())
     else:
         t0 = time.perf_counter()
         if packed_pre is not None:
@@ -706,8 +745,10 @@ def cmd_bench_check(args) -> int:
     # elle packs txn *graphs*, where .length is padded txn slots, not op
     # rows — report recorded op rows for every workload so the stat is
     # comparable across families
-    if workload == "elle" and elle_graphs is not None:
-        # native path: Op lists were never materialized — count ops as
+    if workload == "elle" and elle_mops is not None and not isinstance(
+        elle_mops[0][0], list
+    ):
+        # store path: Op lists were never materialized — count ops as
         # non-blank JSONL lines so the stat matches the Python path's
         # max(len(history)) exactly (same store, same number either way)
         def _op_count(p):
@@ -715,9 +756,7 @@ def cmd_bench_check(args) -> int:
                 return sum(1 for line in fh if line.strip())
 
         ops_per_history = max(
-            _op_count(p)
-            for p, kind in zip(paths, kinds)
-            if kind == workload
+            _op_count(src) for src, _, _ in elle_mops
         )
     elif workload in ("elle", "mutex"):
         ops_per_history = max(len(h) for h in histories)
@@ -728,8 +767,8 @@ def cmd_bench_check(args) -> int:
         if packed_pre is not None
         else len(mats)
         if mats is not None
-        else len(elle_graphs)
-        if elle_graphs is not None
+        else len(elle_mops)
+        if elle_mops is not None
         else len(stream_mats)
         if stream_mats is not None
         else len(histories)

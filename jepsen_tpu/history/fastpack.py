@@ -62,6 +62,20 @@ class _JtElleResult(ctypes.Structure):
     ]
 
 
+class _JtElleMopsResult(ctypes.Structure):
+    _fields_ = [
+        ("cells", ctypes.POINTER(ctypes.c_int32)),
+        ("n_cells", ctypes.c_int64),
+        ("txn_index", ctypes.POINTER(ctypes.c_int64)),
+        ("n_txns", ctypes.c_int32),
+        ("keys", ctypes.POINTER(ctypes.c_int64)),
+        ("n_keys", ctypes.c_int32),
+        ("degenerate", ctypes.c_int32),
+        ("err", ctypes.c_int32),
+        ("err_line", ctypes.c_int64),
+    ]
+
+
 class _JtStreamResult(ctypes.Structure):
     _fields_ = [
         ("cols", ctypes.POINTER(ctypes.c_int32)),
@@ -101,6 +115,14 @@ def _load() -> ctypes.CDLL | None:
     lib.jt_stream_rows_file.argtypes = [ctypes.c_char_p]
     lib.jt_stream_free.restype = None
     lib.jt_stream_free.argtypes = [ctypes.POINTER(_JtStreamResult)]
+    try:  # absent from a stale pre-mops build: the binding degrades to
+        # returning None from elle_mops_file, never breaking the others
+        lib.jt_elle_mops_file.restype = ctypes.POINTER(_JtElleMopsResult)
+        lib.jt_elle_mops_file.argtypes = [ctypes.c_char_p]
+        lib.jt_elle_mops_free.restype = None
+        lib.jt_elle_mops_free.argtypes = [ctypes.POINTER(_JtElleMopsResult)]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -185,6 +207,44 @@ def elle_graph_file(jsonl_path: str | Path):
         return g
     finally:
         lib.jt_elle_free(res)
+
+
+def elle_mops_file(jsonl_path: str | Path):
+    """``([M, 8] cell matrix, ElleMopsMeta)`` for a JSONL elle history
+    via the native cell emission (``jt_elle_mops_file`` — the JSONL
+    parse + ``elle_mops_for`` fused; the host substrate of the DEVICE-
+    side edge inference), or None on any fallback condition.  Output is
+    bit-identical to the Python twin (tests/test_fastpack.py)."""
+    got = _gate(jsonl_path)
+    if got is None:
+        return None
+    lib, p = got
+    if not hasattr(lib, "jt_elle_mops_file"):
+        return None  # stale pre-mops build (see _load)
+    res = lib.jt_elle_mops_file(str(p).encode())
+    if not res:
+        return None
+    try:
+        r = res.contents
+        if r.err != 0:
+            return None
+        from jepsen_tpu.checkers.elle import MOP_COLUMNS, ElleMopsMeta
+
+        n = int(r.n_cells)
+        w = len(MOP_COLUMNS)
+        if n == 0:
+            mat = np.zeros((0, w), np.int32)
+        else:
+            mat = np.ctypeslib.as_array(r.cells, shape=(n, w)).copy()
+        meta = ElleMopsMeta(
+            n_txns=int(r.n_txns),
+            txn_index=[int(r.txn_index[i]) for i in range(int(r.n_txns))],
+            keys=[int(r.keys[i]) for i in range(int(r.n_keys))],
+            degenerate=bool(r.degenerate),
+        )
+        return mat, meta
+    finally:
+        lib.jt_elle_mops_free(res)
 
 
 def stream_rows_file(

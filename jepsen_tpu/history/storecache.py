@@ -1,12 +1,19 @@
-"""Store-level packed-tensor cache: one file per store, zero re-assembly.
+"""Packed-tensor caches above the per-run ``rows.npz`` layer.
 
-The per-run ``rows.npz`` cache (``history/rows.py``) removed row
-explosion from re-checks; what remains of a 10k-history re-check is
-10k small npz opens (~4 s) plus the column assembly (~0.6 s).  Both are
-pure functions of the history set, so the ASSEMBLED ``PackedHistories``
-columns are persisted once per store root as ``packed_store.npz`` —
-a re-check then loads nine arrays from one file and goes straight to
-the device.
+**Store-level cache** (queue family): the per-run ``rows.npz`` cache
+(``history/rows.py``) removed row explosion from re-checks; what remains
+of a 10k-history re-check is 10k small npz opens (~4 s) plus the column
+assembly (~0.6 s).  Both are pure functions of the history set, so the
+ASSEMBLED ``PackedHistories`` columns are persisted once per store root
+as ``packed_store.npz`` — a re-check then loads nine arrays from one
+file and goes straight to the device.
+
+**Elle micro-op cache** (elle family): the packed micro-op cell matrix
+of one history (``checkers/elle.py::elle_mops_for`` — the substrate of
+the DEVICE-side elle edge inference) is persisted as ``elle_mops.npz``
+next to its ``history.jsonl``, keyed by the history digest with the same
+stat-fast-path scheme as the packed-row cache, so repeat ``check``/
+``bench-check`` runs skip host packing entirely.
 
 Freshness: the cache stamps every member ``(relpath, size, mtime_ns)``;
 a load stats the same files (cheap — no reads) and rejects the cache on
@@ -81,6 +88,128 @@ def save_packed_store_cache(
             os.unlink(tmp)
         except OSError:
             pass
+
+
+# ---------------------------------------------------------------------------
+# Elle micro-op cell cache (per run dir, like rows.npz)
+# ---------------------------------------------------------------------------
+
+ELLE_MOPS_CACHE = "elle_mops.npz"
+
+
+def elle_mops_cache_path(jsonl_path: str | Path) -> Path:
+    return Path(jsonl_path).with_name(ELLE_MOPS_CACHE)
+
+
+def save_elle_mops_cache(jsonl_path: str | Path, mat, meta) -> None:
+    """Persist one history's ``[M, 8]`` micro-op cell matrix + meta next
+    to its JSONL, stamped exactly like the packed-row cache ((size,
+    mtime_ns) AND content hash).  Atomic and best-effort; histories
+    whose keys aren't plain ints are simply not cached (the npz schema
+    is int64, and such keys only occur in synthetic/garbage input)."""
+    from jepsen_tpu.history.rows import _history_digest
+
+    jsonl_path = Path(jsonl_path)
+    target = elle_mops_cache_path(jsonl_path)
+    tmp = target.with_name(f"{ELLE_MOPS_CACHE}.{os.getpid()}.tmp")
+    try:
+        keys = np.asarray(meta.keys, np.int64)
+    except (OverflowError, TypeError, ValueError):
+        return
+    if keys.dtype != np.int64 or keys.ndim != 1:
+        return
+    try:
+        st = os.stat(jsonl_path)
+        stamp = np.array(
+            [
+                _history_digest(jsonl_path),
+                str(st.st_size),
+                str(st.st_mtime_ns),
+            ]
+        )
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                stamp=stamp,
+                mat=np.asarray(mat, np.int32),
+                n_txns=np.int64(meta.n_txns),
+                txn_index=np.asarray(meta.txn_index, np.int64),
+                keys=keys,
+                degenerate=np.int64(1 if meta.degenerate else 0),
+            )
+        os.replace(tmp, target)
+    except (OSError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_elle_mops_cache(jsonl_path: str | Path):
+    """``(mat, ElleMopsMeta)`` when a fresh cache exists; None when
+    absent, unreadable, or stale.  Same two-tier freshness as the
+    packed-row cache: a stat fast path ((size, mtime_ns) match AND cache
+    strictly newer than the JSONL), falling through to the content hash."""
+    from jepsen_tpu.checkers.elle import ElleMopsMeta
+    from jepsen_tpu.history.rows import _history_digest
+
+    jsonl_path = Path(jsonl_path)
+    target = elle_mops_cache_path(jsonl_path)
+    try:
+        cache_mtime = os.stat(target).st_mtime_ns
+        with np.load(target, allow_pickle=False) as z:
+            stamp = [str(x) for x in z["stamp"]]
+            mat = np.asarray(z["mat"], np.int32)
+            meta = ElleMopsMeta(
+                n_txns=int(z["n_txns"]),
+                txn_index=[int(x) for x in z["txn_index"]],
+                keys=[int(x) for x in z["keys"]],
+                degenerate=bool(int(z["degenerate"])),
+            )
+    except (OSError, ValueError, KeyError):
+        return None
+    if len(stamp) != 3:
+        return None
+    digest, size, mtime_ns = stamp
+    try:
+        st = os.stat(jsonl_path)
+    except OSError:
+        return None
+    if (
+        str(st.st_size) == size
+        and str(st.st_mtime_ns) == mtime_ns
+        and cache_mtime > st.st_mtime_ns
+    ):
+        return mat, meta
+    if digest != _history_digest(jsonl_path):
+        return None
+    return mat, meta
+
+
+def elle_mops_with_cache(jsonl_path: str | Path, history=None):
+    """Load-through cell cache: ``(mat, meta, was_hit)``.  A miss takes
+    the native emission (``jt_elle_mops_file``) when available, else the
+    Python twin, and leaves the cache behind for the next check.  Pass
+    ``history`` when the caller already parsed the ops."""
+    cached = load_elle_mops_cache(jsonl_path)
+    if cached is not None:
+        return (*cached, True)
+    mat = meta = None
+    if history is None:
+        from jepsen_tpu.history.fastpack import elle_mops_file
+
+        got = elle_mops_file(jsonl_path)
+        if got is not None:
+            mat, meta = got
+    if mat is None:
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.history.store import read_history
+
+        if history is None:
+            history = read_history(jsonl_path)
+        mat, meta = elle_mops_for(history)
+    save_elle_mops_cache(jsonl_path, mat, meta)
+    return mat, meta, False
 
 
 def load_packed_store_cache(

@@ -7,6 +7,7 @@ from jepsen_tpu.parallel.mesh import (  # noqa: F401
     shard_packed,
     sharded_check,
     sharded_elle,
+    sharded_elle_mops,
     sharded_queue_lin,
     sharded_stream_lin,
     sharded_total_queue,

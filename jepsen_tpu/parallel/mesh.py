@@ -405,3 +405,24 @@ def sharded_elle(batch, mesh: Mesh):
         host_bad=put(batch.host_bad, P(HIST_AXIS)),
     )
     return elle_tensor_check(sharded)
+
+
+def sharded_elle_mops(mops, mesh: Mesh):
+    """Fused device-inference elle over the mesh (micro-op cell columns
+    in, verdict tensors out — no host inference anywhere).  The
+    inference stage is per-history scatter/sort work with no cross-
+    history terms, so the ``[B, M]`` cell columns shard over ``hist``
+    with zero communication; on ``seq>1`` meshes the inferred adjacency
+    then re-shards its column axis over ``seq`` for the closure matmuls,
+    exactly like ``sharded_elle``."""
+    from jepsen_tpu.checkers.elle import (
+        elle_infer_device,
+        elle_mops_check,
+        inferred_to_batch,
+    )
+
+    sharded = _hist_sharded(mops, mesh)
+    if mesh.shape[SEQ_AXIS] == 1:
+        return elle_mops_check(sharded)[0]
+    inf = elle_infer_device(sharded)
+    return sharded_elle(inferred_to_batch(inf, mops.n_txns), mesh)

@@ -1142,6 +1142,185 @@ void jt_elle_free(JtElleResult* r) {
 }
 
 // ---------------------------------------------------------------------------
+// Elle micro-op cells: history.jsonl -> the [M, 8] int32 cell matrix of
+// checkers/elle.py::elle_mops_for — the packed substrate of the DEVICE-
+// side edge inference (the inference itself no longer runs on the host;
+// this pass only parses, filters, and densifies).  Bit-identical to the
+// Python twin (differential contract in tests/test_fastpack.py): cells
+// emit in history order, key/value ids assign in first-encounter order,
+// and the same degeneracy conditions are flagged.  Non-int keys cannot
+// map onto this twin's tables -> ERR_PARSE, binding falls back.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t* cells;      // n_cells * 8: txn kind key val rpos rid alast process
+  int64_t n_cells;
+  int64_t* txn_index;  // history position per committed txn
+  int32_t n_txns;
+  int64_t* keys;       // dense key id -> original key
+  int32_t n_keys;
+  int32_t degenerate;  // history needs host inference (see elle_mops_for)
+  int32_t err;         // Err enum; non-zero => arrays are NULL
+  int64_t err_line;
+} JtElleMopsResult;
+
+JtElleMopsResult* jt_elle_mops_file(const char* path) {
+  auto* res = static_cast<JtElleMopsResult*>(
+      std::calloc(1, sizeof(JtElleMopsResult)));
+  if (!res) return nullptr;
+
+  constexpr long long kMaxCells = 46000;  // _MOPS_MAX_CELLS (sort-key cap)
+  std::vector<int32_t> cells;
+  cells.reserve(1 << 14);
+  std::vector<long long> txn_index;
+  std::vector<long long> keys;
+  std::unordered_map<long long, int> key_id;
+  std::unordered_map<long long, int> val_id;
+  std::unordered_set<long long> writer_seen;
+  std::unordered_map<long long, long long> read_key_of;
+  bool degenerate = false;
+  int rid = 0;
+  int t = 0;
+
+  auto kid = [&](long long k) -> int {
+    auto it = key_id.find(k);
+    if (it != key_id.end()) return it->second;
+    int i = static_cast<int>(keys.size());
+    key_id.emplace(k, i);
+    keys.push_back(k);
+    return i;
+  };
+  auto vid = [&](long long v) -> int {
+    auto it = val_id.find(v);
+    if (it != val_id.end()) return it->second;
+    int i = static_cast<int>(val_id.size());
+    val_id.emplace(v, i);
+    return i;
+  };
+  auto clamp32 = [](long long v) -> int32_t {
+    if (v > INT32_MAX) return INT32_MAX;
+    if (v < INT32_MIN) return INT32_MIN;
+    return static_cast<int32_t>(v);
+  };
+  auto emit = [&](int32_t txn, int32_t kind, int32_t key, int32_t val,
+                  int32_t rpos, int32_t rd, int32_t alast, int32_t proc) {
+    cells.push_back(txn);
+    cells.push_back(kind);
+    cells.push_back(key);
+    cells.push_back(val);
+    cells.push_back(rpos);
+    cells.push_back(rd);
+    cells.push_back(alast);
+    cells.push_back(proc);
+  };
+
+  // micro-op validity mirrors _txn_micro_ops + the len==3/isinstance
+  // guards: non-list elements and wrong-arity entries are skipped
+  auto valid_append = [](const JNode& m) {
+    return m.k == JNode::LIST && m.items.size() == 3 &&
+           m.items[0].is_str("append", 6) && m.items[2].k == JNode::INT;
+  };
+  auto valid_read = [](const JNode& m) {
+    return m.k == JNode::LIST && m.items.size() == 3 &&
+           m.items[0].is_str("r", 1) && m.items[2].k == JNode::LIST;
+  };
+
+  int64_t err_line = 0;
+  int err = for_each_op(
+      path,
+      [&](const OpView& op, long long pos) -> bool {
+        if (op.f != 8 /* txn */ || op.type == 0 /* invoke */) return true;
+        int32_t proc = clamp32(op.process);
+        if (op.type == 2 /* fail */) {
+          if (op.value.k != JNode::LIST) return true;
+          for (const JNode& m : op.value.items)
+            if (valid_append(m)) {
+              // key deliberately NOT interned (the Python twin never
+              // hashes a failed append's key); column holds 0
+              emit(-1, 3, 0, vid(m.items[2].i), -1, -1, 0, proc);
+            }
+          return true;
+        }
+        if (op.type != 1 /* ok */) return true;  // info: nothing
+        txn_index.push_back(pos);
+        if (op.value.k == JNode::LIST) {
+          // last-append micro-op index per key within this txn
+          std::unordered_map<long long, size_t> last_app;
+          for (size_t i = 0; i < op.value.items.size(); ++i) {
+            const JNode& m = op.value.items[i];
+            if (valid_append(m)) {
+              if (m.items[1].k != JNode::INT) return false;  // non-int key
+              last_app[m.items[1].i] = i;
+            }
+          }
+          for (size_t i = 0; i < op.value.items.size(); ++i) {
+            const JNode& m = op.value.items[i];
+            if (valid_append(m)) {
+              long long v = m.items[2].i;
+              if (!writer_seen.insert(v).second) degenerate = true;
+              emit(t, 0, kid(m.items[1].i), vid(v), -1, -1,
+                   last_app[m.items[1].i] == i ? 1 : 0, proc);
+            } else if (valid_read(m)) {
+              if (m.items[1].k != JNode::INT) return false;  // non-int key
+              long long k = m.items[1].i;
+              int kd = kid(k);
+              std::vector<long long> vs;
+              for (const JNode& e : m.items[2].items)
+                if (e.k == JNode::INT) vs.push_back(e.i);
+              if (vs.empty()) {
+                emit(t, 2, kd, -1, -1, rid, 0, proc);
+              } else {
+                std::unordered_set<long long> in_read;
+                for (size_t j = 0; j < vs.size(); ++j) {
+                  if (!in_read.insert(vs[j]).second) degenerate = true;
+                  auto ins = read_key_of.emplace(vs[j], k);
+                  if (!ins.second && ins.first->second != k)
+                    degenerate = true;
+                  emit(t, 1, kd, vid(vs[j]), static_cast<int32_t>(j), rid,
+                       0, proc);
+                }
+              }
+              ++rid;
+            }
+          }
+        }
+        ++t;
+        return true;
+      },
+      &err_line);
+  if (err != OK) {
+    res->err = err;
+    res->err_line = err_line;
+    return res;
+  }
+  if (static_cast<long long>(cells.size() / 8) > kMaxCells)
+    degenerate = true;
+
+  res->cells = copy_i32(cells);
+  res->n_cells = static_cast<int64_t>(cells.size() / 8);
+  res->txn_index = copy_i64(txn_index);
+  res->n_txns = t;
+  res->keys = copy_i64(keys);
+  res->n_keys = static_cast<int32_t>(keys.size());
+  res->degenerate = degenerate ? 1 : 0;
+  if ((res->n_cells && !res->cells) || (res->n_txns && !res->txn_index) ||
+      (res->n_keys && !res->keys)) {  // malloc failure: see jt_elle note
+    res->err = ERR_IO;
+    res->n_cells = 0;
+    res->n_txns = res->n_keys = 0;
+  }
+  return res;
+}
+
+void jt_elle_mops_free(JtElleMopsResult* r) {
+  if (!r) return;
+  std::free(r->cells);
+  std::free(r->txn_index);
+  std::free(r->keys);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
 // Stream: history.jsonl -> the [n, 6] column matrix + full-read flag of
 // checkers/stream_lin.py::_stream_rows (type, f, value, offset, pos,
 // first) — the host explosion ahead of pack_stream_histories.  Same

@@ -208,6 +208,79 @@ def test_local_extended_tier_parses_and_stays_out_of_sim():
     assert not any(c.get("durable") for c in EXTENDED_MATRIX)
 
 
+class TestBenchElleSmoke:
+    """Offline bench gate: the elle section of ``bench.py`` at a tiny
+    batch on the CPU backend.  Packer/schema regressions in the new
+    device-inference keys (fused rate, end-to-end, roofline) must fail
+    the suite here instead of surfacing only on a chip window."""
+
+    @pytest.fixture()
+    def bench(self, monkeypatch):
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        import bench as bench_mod
+
+        # smoke scale: a handful of tiny graphs, one timed block
+        monkeypatch.setattr(bench_mod, "ELLE_BASE", 16)
+        monkeypatch.setattr(bench_mod, "ELLE_BATCH", 16)
+        monkeypatch.setattr(bench_mod, "ELLE_TXNS", 8)
+        monkeypatch.setattr(bench_mod, "BLOCKS", 1)
+        monkeypatch.setattr(bench_mod, "BLOCK_ITERS", 2)
+        monkeypatch.setattr(bench_mod, "CPU_BASELINE_SAMPLES", 2)
+        monkeypatch.setattr(bench_mod, "MUTEX_OPS", 16)
+        return bench_mod
+
+    def test_elle_section_schema(self, bench):
+        details = {}
+        bench._bench_elle(details)
+        e = details["elle"]
+        for key in (
+            "device_histories_per_sec",
+            "device_fused_histories_per_sec",
+            "end_to_end_histories_per_sec",
+            "end_to_end_histories_per_sec_python",
+            "end_to_end_vs_device_only",
+            "achieved_gbps",
+            "hbm_util",
+            "mxu_util",
+        ):
+            assert key in e, f"elle bench schema lost key {key!r}"
+        assert e["device_histories_per_sec"] > 0
+        assert e["device_fused_histories_per_sec"] > 0
+        assert e["end_to_end_histories_per_sec"] > 0
+        assert e["achieved_gbps"] > 0
+        import math
+
+        r = e["roofline"]
+        assert r["closure_dots"] == 3 * (
+            math.ceil(math.log2(max(r["txn_slots"], 2))) + 1
+        )
+        assert r["flops_per_history"] == r["closure_dots"] * 2 * r[
+            "txn_slots"
+        ] ** 3
+        # CPU backend: achieved numbers present, utils honestly None
+        assert e["hbm_util"] is None and e["mxu_util"] is None
+
+    def test_mutex_device_section_scoped_off_cpu(self, bench):
+        """The pathological CPU-backend mutex device rows (BENCH_r05:
+        36 hist/s at 1.8 s/iter vs 22,159 CPU) stay skipped: the section
+        must record the scoping note and the CPU reference only."""
+        details = {}
+        bench._bench_mutex(details)
+        m = details["mutex"]
+        assert "device_skipped" in m and "chip-only" in m["device_skipped"]
+        assert m["cpu_histories_per_sec"] > 0
+        assert "device_histories_per_sec" not in m
+
+
 class TestHclGate:
     """Offline HCL syntax gate (VERDICT r5 #7): the terraform files have
     never been parsed by any terraform binary in this image — the fake-

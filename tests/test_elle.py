@@ -169,6 +169,37 @@ def test_batch_of_mixed_histories():
         assert r == check_elle_cpu(sh.ops)
 
 
+def test_device_and_host_inference_report_identically():
+    """The two inference backends of check_elle_batch (device micro-op
+    kernel vs per-history infer_txn_graph) are interchangeable down to
+    the full result maps."""
+    shs = synth_elle_batch(3, ElleSynthSpec(n_txns=60), g1c_cycle=1)
+    shs += synth_elle_batch(2, ElleSynthSpec(n_txns=60, seed=80), g1b=1)
+    hs = [sh.ops for sh in shs]
+    assert check_elle_batch(hs, inference="device") == check_elle_batch(
+        hs, inference="host"
+    )
+
+
+def test_device_inferred_edge_counts_match_host_sets():
+    """ElleInferred's on-device edge counters equal the host twin's edge
+    set sizes (the counts feed the result maps without any [T, T]
+    device->host transfer)."""
+    import numpy as np
+
+    from jepsen_tpu.checkers.elle import elle_mops_check, pack_elle_mops
+
+    shs = synth_elle_batch(4, ElleSynthSpec(n_txns=50), g2_cycle=1)
+    mops, metas = pack_elle_mops([sh.ops for sh in shs])
+    assert not any(g.degenerate for g in metas)
+    _, inf = elle_mops_check(mops)
+    for b, sh in enumerate(shs):
+        g = infer_txn_graph(sh.ops)
+        assert int(np.asarray(inf.ww_edges)[b]) == len(g.ww)
+        assert int(np.asarray(inf.wr_edges)[b]) == len(g.wr)
+        assert int(np.asarray(inf.rw_edges)[b]) == len(g.rw)
+
+
 def test_large_history_many_txns():
     # cycle search at a scale where the closure is real MXU work
     sh = synth_elle_history(

@@ -535,6 +535,110 @@ class TestElleInferNative:
 
 
 # ---------------------------------------------------------------------------
+# Native elle micro-op cell emission (jt_elle_mops_file) — the packed
+# substrate of the DEVICE-side edge inference
+# ---------------------------------------------------------------------------
+
+from jepsen_tpu.checkers.elle import elle_mops_for  # noqa: E402
+from jepsen_tpu.history.fastpack import elle_mops_file  # noqa: E402
+
+
+def _assert_mops_identical(tmp_path, history, name="history.jsonl"):
+    p = tmp_path / name
+    write_history_jsonl(p, history)
+    got = elle_mops_file(p)
+    assert got is not None
+    mat, meta = got
+    ref_mat, ref_meta = elle_mops_for(read_history(p))
+    np.testing.assert_array_equal(mat, ref_mat)
+    assert meta.n_txns == ref_meta.n_txns
+    assert meta.txn_index == ref_meta.txn_index
+    assert meta.keys == ref_meta.keys
+    assert meta.degenerate == ref_meta.degenerate
+    return mat, meta
+
+
+class TestElleMopsNative:
+    """The native cell emission must be BIT-identical to elle_mops_for
+    on every mappable history (same cell rows, same dense id assignment
+    order, same degeneracy flags) — the device inference consumes these
+    columns verbatim, so any skew would silently change verdicts."""
+
+    @pytest.mark.parametrize(
+        "spec_kw",
+        [
+            {},
+            {"g1a": 2},
+            {"g1b": 2},
+            {"g0_cycle": 1},
+            {"g1c_cycle": 1},
+            {"g2_cycle": 1},
+            {"p_fail": 0.2, "p_info": 0.15},
+            {"n_keys": 1, "max_micro_ops": 6},
+        ],
+    )
+    def test_differential_per_spec(self, tmp_path, spec_kw):
+        for sh in synth_elle_batch(3, ElleSynthSpec(n_txns=40), **spec_kw):
+            mat, meta = _assert_mops_identical(tmp_path, sh.ops)
+            assert mat.shape[0] > 0 and not meta.degenerate
+
+    def test_degenerate_duplicate_append_flagged_identically(self, tmp_path):
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        history = [
+            mk([["append", 0, 1]]),
+            mk([["append", 0, 1]]),  # same value appended twice
+        ]
+        _, meta = _assert_mops_identical(tmp_path, history)
+        assert meta.degenerate
+
+    def test_degenerate_value_under_two_keys_flagged(self, tmp_path):
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        history = [
+            mk([["r", 0, [7]]]),
+            mk([["r", 1, [7]]]),  # 7 observed under keys 0 AND 1
+        ]
+        _, meta = _assert_mops_identical(tmp_path, history)
+        assert meta.degenerate
+
+    def test_failed_append_key_not_interned(self, tmp_path):
+        """infer_txn_graph never hashes a failed append's key, so the
+        key-id table must not contain it either (canonical id order)."""
+        from jepsen_tpu.history.ops import Op, OpF, OpType
+
+        history = [
+            Op(type=OpType.FAIL, f=OpF.TXN, process=0,
+               value=[["append", 99, 5]], error="aborted"),
+            Op(type=OpType.OK, f=OpF.TXN, process=0,
+               value=[["append", 3, 6], ["r", 3, [6]]]),
+        ]
+        mat, meta = _assert_mops_identical(tmp_path, history)
+        assert meta.keys == [3]
+
+    def test_string_key_falls_back(self, tmp_path):
+        p = _write(tmp_path, [
+            {"type": "ok", "f": "txn", "process": 0,
+             "value": [["append", "k", 1]]},
+        ])
+        assert elle_mops_file(p) is None  # Python handles string keys
+
+    def test_malformed_json_falls_back(self, tmp_path):
+        p = tmp_path / "history.jsonl"
+        p.write_text('{"type": "ok", "f": "txn", "value": [[\n')
+        assert elle_mops_file(p) is None
+
+    def test_oom_faults_err_not_segfault(self, tmp_path, monkeypatch):
+        sh = synth_elle_batch(1, ElleSynthSpec(n_txns=10))[0]
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, sh.ops)
+        monkeypatch.setenv("JT_PACK_FAKE_OOM", "1")
+        assert elle_mops_file(p) is None
+
+
+# ---------------------------------------------------------------------------
 # Native stream explosion (jt_stream_rows_file)
 # ---------------------------------------------------------------------------
 

@@ -122,6 +122,102 @@ def test_cli_bench_check_uses_cache(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
+# Elle micro-op cell cache (history/storecache.py) — the packed substrate
+# of the device-side edge inference, digest-keyed like rows.npz
+# ---------------------------------------------------------------------------
+
+
+class TestElleMopsCache:
+    def _write_elle(self, tmp_path, seed=0, **kw):
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        sh = synth_elle_batch(
+            1, ElleSynthSpec(n_txns=24, seed=seed), **kw
+        )[0]
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, sh.ops)
+        return p, sh.ops
+
+    def test_roundtrip_bitwise_identical(self, tmp_path):
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.history.storecache import (
+            load_elle_mops_cache,
+            save_elle_mops_cache,
+        )
+
+        p, h = self._write_elle(tmp_path, g1a=1)
+        mat, meta = elle_mops_for(h)
+        save_elle_mops_cache(p, mat, meta)
+        got = load_elle_mops_cache(p)
+        assert got is not None
+        cmat, cmeta = got
+        np.testing.assert_array_equal(cmat, mat)
+        assert (cmeta.n_txns, cmeta.txn_index, cmeta.keys,
+                cmeta.degenerate) == (
+            meta.n_txns, meta.txn_index, meta.keys, meta.degenerate
+        )
+
+    def test_load_through_miss_then_hit(self, tmp_path):
+        from jepsen_tpu.history.storecache import elle_mops_with_cache
+
+        p, _h = self._write_elle(tmp_path)
+        mat1, meta1, hit1 = elle_mops_with_cache(p)
+        assert not hit1
+        mat2, meta2, hit2 = elle_mops_with_cache(p)
+        assert hit2
+        np.testing.assert_array_equal(mat1, mat2)
+        assert meta1.n_txns == meta2.n_txns
+
+    def test_stale_on_history_rewrite(self, tmp_path):
+        from jepsen_tpu.history.storecache import (
+            elle_mops_with_cache,
+            load_elle_mops_cache,
+        )
+
+        p, _h = self._write_elle(tmp_path)
+        elle_mops_with_cache(p)
+        assert load_elle_mops_cache(p) is not None
+        _p, _h2 = self._write_elle(tmp_path, seed=7)  # rewrite in place
+        assert load_elle_mops_cache(p) is None
+        mat, meta, hit = elle_mops_with_cache(p)  # and re-cuts the cache
+        assert not hit and meta.n_txns > 0
+
+    def test_degenerate_flag_survives_the_cache(self, tmp_path):
+        """A cached degenerate history must STAY degenerate: losing the
+        flag would route it onto the device path with a wrong verdict."""
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+        from jepsen_tpu.history.storecache import elle_mops_with_cache
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        h = reindex([mk([["append", 0, 1]]), mk([["append", 0, 1]])])
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, h)
+        _, meta, hit = elle_mops_with_cache(p)
+        assert not hit and meta.degenerate
+        _, meta2, hit2 = elle_mops_with_cache(p)
+        assert hit2 and meta2.degenerate
+
+    def test_non_int_keys_are_not_cached(self, tmp_path):
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+        from jepsen_tpu.history.storecache import (
+            elle_mops_cache_path,
+            save_elle_mops_cache,
+        )
+
+        from jepsen_tpu.history.store import read_history
+
+        mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+        h = reindex([mk([["append", "k", 1], ["r", "k", [1]]])])
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, h)
+        mat, meta = elle_mops_for(read_history(p))
+        assert meta.keys == ["k"]
+        save_elle_mops_cache(p, mat, meta)
+        assert not elle_mops_cache_path(p).exists()
+
+
+# ---------------------------------------------------------------------------
 # Store-level packed cache (history/storecache.py)
 # ---------------------------------------------------------------------------
 

@@ -1060,6 +1060,26 @@ def split_elle_mops(
     return live, mops, degen
 
 
+def _txn_graph_from_inferred(b, meta, g1a, g1b, bad, adj=None) -> TxnGraph:
+    """``TxnGraph`` for batch row ``b`` of a device inference: the
+    G1a/G1b/incompatible-order anomaly sets (with ``meta.keys``
+    remapping), plus the ww/wr/rw edge sets when ``adj`` — the
+    materialized boolean adjacency dict — is given.  The single
+    assembly point shared by the reporting path (``check_elle_batch``)
+    and the differential-test surface (``device_txn_graphs``)."""
+    g = TxnGraph(n=meta.n_txns, txn_index=list(meta.txn_index))
+    if adj is not None:
+        for name in ("ww", "wr", "rw"):
+            src, dst = np.nonzero(adj[name][b])
+            getattr(g, name).update(zip(src.tolist(), dst.tolist()))
+    g.g1a.update(np.nonzero(g1a[b])[0].tolist())
+    g.g1b.update(np.nonzero(g1b[b])[0].tolist())
+    g.incompatible_order.update(
+        meta.keys[k] for k in np.nonzero(bad[b])[0]
+    )
+    return g
+
+
 def device_txn_graphs(
     histories: Sequence[Sequence[Op]],
 ) -> tuple[list[TxnGraph], list[bool]]:
@@ -1084,19 +1104,9 @@ def device_txn_graphs(
         g1b = np.asarray(inf.g1b)
         bad = np.asarray(inf.bad_keys)
         for b, i in enumerate(live):
-            meta = mats_metas[i][1]
-            g = TxnGraph(n=meta.n_txns, txn_index=list(meta.txn_index))
-            for name in ("ww", "wr", "rw"):
-                src, dst = np.nonzero(adj[name][b])
-                getattr(g, name).update(
-                    zip(src.tolist(), dst.tolist())
-                )
-            g.g1a.update(np.nonzero(g1a[b])[0].tolist())
-            g.g1b.update(np.nonzero(g1b[b])[0].tolist())
-            g.incompatible_order.update(
-                meta.keys[k] for k in np.nonzero(bad[b])[0]
+            graphs[i] = _txn_graph_from_inferred(
+                b, mats_metas[i][1], g1a, g1b, bad, adj=adj
             )
-            graphs[i] = g
     return graphs, flags
 
 
@@ -1152,13 +1162,7 @@ def check_elle_batch(
             for n in ("ww", "wr", "rw")
         )
         for b, i in enumerate(live):
-            meta = mats_metas[i][1]
-            g = TxnGraph(n=meta.n_txns, txn_index=list(meta.txn_index))
-            g.g1a.update(np.nonzero(g1a[b])[0].tolist())
-            g.g1b.update(np.nonzero(g1b[b])[0].tolist())
-            g.incompatible_order.update(
-                meta.keys[k] for k in np.nonzero(bad[b])[0]
-            )
+            g = _txn_graph_from_inferred(b, mats_metas[i][1], g1a, g1b, bad)
             out[i] = _classify(
                 g,
                 set(np.nonzero(g0[b])[0].tolist()),

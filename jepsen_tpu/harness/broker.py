@@ -232,6 +232,10 @@ class MiniAmqpBroker:
         # mode): later consumes of these classic queues skip the
         # committed stream-ness probe
         self._known_queues: set[str] = set()
+        # queues declared with x-fencing: push delivery would advance
+        # the fence without handing the grantee its token, so consume
+        # on these is rejected (tokens ride basic.get replies only)
+        self._fenced_queues: set[str] = set()
         self._conns: list[_ConnState] = []
         self._accept_thread: threading.Thread | None = None
         self._kick = threading.Event()
@@ -514,6 +518,15 @@ class MiniAmqpBroker:
                     qname = r.shortstr()
                     r.u8()  # durable/exclusive/... bit flags
                     qargs = r.table()
+                    with self.state_lock:
+                        if qargs.get("x-fencing"):
+                            self._fenced_queues.add(qname)
+                        else:
+                            # last declare wins (like queue_meta / the
+                            # machine's meta): a redeclare without
+                            # x-fencing must not leave this node
+                            # treating the queue as fenced forever
+                            self._fenced_queues.discard(qname)
                     if self.replication is not None:
                         self.replication.declare(
                             qname,
@@ -576,7 +589,11 @@ class MiniAmqpBroker:
                     qname = r.shortstr()
                     ctag = r.shortstr() or "ctag-1"
                     cbits = r.u8()  # no-local/no-ack/exclusive/no-wait
-                    conn.consuming_noack = bool(cbits & 2)
+                    # the ack mode is committed only on SUCCESSFUL
+                    # registration (with consuming_ch/consuming_queue,
+                    # below): a rejected fenced consume keeps the prior
+                    # subscription alive and must not clobber its mode
+                    noack_req = bool(cbits & 2)
                     cargs = r.table()
                     self._send_method(conn, ch, 60, 21, _shortstr(ctag))
                     # stream-ness + snapshot come from ONE read.  In
@@ -643,10 +660,22 @@ class MiniAmqpBroker:
                         self._stream_deliver(
                             conn, ch, qname, log, offset, ctag
                         )
+                    elif self._is_fenced_queue(qname):
+                        # push delivery carries no fencing token (only
+                        # _get mints/attaches one), and in replicated
+                        # mode the DEQ apply would still advance the
+                        # fence — the grantee would hold the lock with
+                        # no token to release it.  Reject rather than
+                        # silently diverge from the basic.get path.
+                        self._reject_fenced_consume(
+                            conn, ch, clear_subscription=False
+                        )
                     else:
                         # ch first: a concurrent kick-loop delivery keys
                         # off consuming_queue and must never observe the
-                        # default channel (advisor r3 #1)
+                        # default channel (advisor r3 #1) — nor a stale
+                        # ack mode, so noack commits before the queue
+                        conn.consuming_noack = noack_req
                         conn.consuming_ch = ch
                         conn.consuming_queue = qname
                         self._try_deliver(conn)
@@ -1078,6 +1107,81 @@ class MiniAmqpBroker:
             _fence_props(fence) if fence else msg.props,
         )
 
+    def _reject_fenced_consume(
+        self,
+        conn: _ConnState,
+        ch: int,
+        *,
+        clear_subscription: bool = True,
+    ) -> None:
+        """Loud refusal of push consumption on a fenced queue (540
+        channel close), shared by the consume-registration rejection and
+        the delivery-time re-check (a consume that raced the fenced
+        declare); the delivery paths also clear ``consuming_queue`` (the
+        fenced queue IS the subscription there) so the dead subscription
+        stops eating kicks — the registration-time rejection must NOT
+        (``consuming_queue`` still holds any pre-existing subscription
+        to a different, unfenced queue, which stays live)."""
+        if clear_subscription:
+            with self.state_lock:
+                if conn.consuming_queue is not None:
+                    conn.consuming_queue = None
+        self._send_method(
+            conn,
+            ch,
+            20,
+            40,
+            struct.pack(">H", 540)  # not-implemented
+            + _shortstr(
+                "consume on a fenced queue "
+                "(fencing tokens ride basic.get)"
+            )
+            + struct.pack(">HH", 60, 20),
+        )
+
+    def _is_fenced_queue(self, qname: str) -> bool:
+        """Committed fenced-ness of ``qname``: the declare-time flag in
+        the authoritative queue meta — the replicated machine's (which
+        survives node restarts via WAL recovery and is populated by
+        declares issued through ANY node, once applied locally), or the
+        local broker's.  When the meta has an entry it WINS in both
+        directions: a plain redeclare committed via a DIFFERENT node
+        must clear fenced-ness here even though this node's shadow set
+        still carries the stale fenced entry from the original declare.
+        Only when the meta has no entry yet (a locally-served declare
+        not applied on this replica) does the shadow set decide — and
+        never nothing: the shadow alone is empty on the nodes that
+        didn't serve the declare and after every restart, which would
+        fail open."""
+        if self.replication is not None:
+            m = self.replication.machine
+            with m.lock:
+                meta = m.meta.get(qname)
+            return self._fenced_given_meta(qname, meta)
+        with self.state_lock:
+            return self._is_fenced_queue_locked(qname)
+
+    def _fenced_given_meta(self, qname: str, meta: dict | None) -> bool:
+        """The meta-wins rule shared by every replicated-mode fenced
+        check (callers fetch ``meta`` under the machine lock they
+        already hold for other reads): a committed entry decides in
+        both directions; only a queue with no committed entry yet falls
+        back to this node's shadow declare observations."""
+        if meta is not None:
+            return bool(meta.get("fenced"))
+        with self.state_lock:
+            return qname in self._fenced_queues
+
+    def _is_fenced_queue_locked(self, qname: str) -> bool:
+        """Non-replicated fenced-ness under an already-held
+        ``state_lock`` — for the local delivery path, which must decide
+        atomically with the pop (meta entry wins; shadow set covers
+        only a queue never declared on this broker)."""
+        meta = self.queue_meta.get(qname)
+        if meta is not None:
+            return bool(meta.get("fenced"))
+        return qname in self._fenced_queues
+
     def _try_deliver(self, conn: _ConnState):
         """Push deliveries: QoS-1 (one in flight) for acking consumers;
         no-ack consumers are auto-acknowledged and drain the queue.
@@ -1108,30 +1212,46 @@ class MiniAmqpBroker:
             return
         while conn.consuming_queue is not None and conn.open:
             with self.state_lock:
-                if conn.unacked and not conn.consuming_noack:
-                    return
-                self._expire_locked(conn.consuming_queue)
-                q = self.queues.setdefault(conn.consuming_queue, deque())
-                if not q:
-                    return
-                msg = q.popleft()
-                self._delivered += 1
-                if (
-                    self.duplicate_every
-                    and self._delivered % self.duplicate_every == 0
-                ):
-                    q.append(
-                        _Message(
-                            msg.value,
-                            ts=_time.monotonic(),
-                            props=msg.props,
-                        )
+                # a consume registered before the queue's fenced
+                # declare slipped past the registration-time rejection
+                # — refuse as loudly as registration would have, never
+                # push a grant without its token.  Decided under the
+                # SAME lock acquisition as the pop: checked outside it,
+                # a fenced declare landing between check and pop would
+                # slip a tokenless grant out anyway
+                fenced = self._is_fenced_queue_locked(
+                    conn.consuming_queue
+                )
+                if not fenced:
+                    if conn.unacked and not conn.consuming_noack:
+                        return
+                    self._expire_locked(conn.consuming_queue)
+                    q = self.queues.setdefault(
+                        conn.consuming_queue, deque()
                     )
-                tag = conn.next_tag
-                conn.next_tag += 1
-                noack = conn.consuming_noack
-                if not noack:  # no-ack consumers are auto-acked
-                    conn.unacked[tag] = (conn.consuming_queue, msg)
+                    if not q:
+                        return
+                    msg = q.popleft()
+                    self._delivered += 1
+                    if (
+                        self.duplicate_every
+                        and self._delivered % self.duplicate_every == 0
+                    ):
+                        q.append(
+                            _Message(
+                                msg.value,
+                                ts=_time.monotonic(),
+                                props=msg.props,
+                            )
+                        )
+                    tag = conn.next_tag
+                    conn.next_tag += 1
+                    noack = conn.consuming_noack
+                    if not noack:  # no-ack consumers are auto-acked
+                        conn.unacked[tag] = (conn.consuming_queue, msg)
+            if fenced:
+                self._reject_fenced_consume(conn, ch)
+                return
             method = (
                 struct.pack(">HH", 60, 60)
                 + _shortstr("ctag-1")
@@ -1149,25 +1269,45 @@ class MiniAmqpBroker:
         owner id); acks settle, conn loss requeues — so leader failover
         inherits delivery state instead of losing it."""
         while conn.consuming_queue is not None and conn.open:
+            # fenced re-check FIRST (before the QoS-1 unacked return,
+            # like the local path): a consumer sitting on an unacked
+            # message from before the queue went fenced must get the
+            # loud 540 close, not a silent stall.  It rides the same
+            # machine-lock round as the local ready-check (one
+            # acquisition per kick on the hot push path); the ready
+            # probe itself avoids paying a quorum round trip for an
+            # empty-queue DEQ, which would still commit a no-op log
+            # entry on every replica (benign races both ways — a miss
+            # is repaired by the next kick).  The consume may have been
+            # registered before the fenced declare applied on this
+            # replica (cross-node declare, or a restart-recovered
+            # machine) — the registration-time rejection can't see it
+            m = self.replication.machine
+            with m.lock:
+                meta = m.meta.get(conn.consuming_queue)
+                ready = len(m.queues.get(conn.consuming_queue, ()))
+            if self._fenced_given_meta(conn.consuming_queue, meta):
+                self._reject_fenced_consume(conn, ch)
+                return
             with self.state_lock:
                 if conn.unacked and not conn.consuming_noack:
                     return  # QoS-1: one in flight
-            # local ready-check before paying a quorum round trip: an
-            # empty-queue DEQ would still commit a no-op log entry on
-            # every replica, once per consumer per kick (benign races
-            # both ways — a miss is repaired by the next kick)
-            with self.replication.machine.lock:
-                ready = len(
-                    self.replication.machine.queues.get(
-                        conn.consuming_queue, ()
-                    )
-                )
             if ready == 0:
                 return
             rmsg = self.replication.dequeue(
                 conn.consuming_queue, conn.owner
             )
             if rmsg is None:
+                return
+            if rmsg.fence:
+                # the DEQ applied on the leader's up-to-date meta and
+                # minted a grant token even though this replica's meta
+                # lagged past the check above: revoke (requeue; the
+                # fence already advanced, so the next basic.get mints a
+                # fresh higher token) rather than deliver the lock with
+                # no token attached — and close the subscription loudly
+                self.replication.requeue_one(conn.owner, rmsg.mid)
+                self._reject_fenced_consume(conn, ch)
                 return
             with self.state_lock:
                 tag = conn.next_tag

@@ -1,0 +1,470 @@
+"""Fenced queues reject ``basic.consume`` (r7 review): fencing tokens
+are minted and attached only on the ``basic.get`` reply path, and in
+replicated mode a push delivery's DEQ apply would still advance the
+fence — the consumer would hold the lock with a superseded-by-nobody
+token it never received.  The broker must refuse the consume loudly
+(channel close, 540 not-implemented) instead of silently diverging
+from the get path."""
+
+import socket
+import struct
+import time
+
+from jepsen_tpu.harness.broker import (
+    FRAME_END,
+    MiniAmqpBroker,
+    _longstr,
+    _shortstr,
+)
+from jepsen_tpu.harness.replication import ReplicatedBackend
+
+
+def _send_method(sock, ch, cls, mth, args=b""):
+    payload = struct.pack(">HH", cls, mth) + args
+    sock.sendall(
+        struct.pack(">BHI", 1, ch, len(payload))
+        + payload
+        + bytes([FRAME_END])
+    )
+
+
+def _read_frame(sock):
+    hdr = b""
+    while len(hdr) < 7:
+        hdr += sock.recv(7 - len(hdr))
+    ftype, ch, size = struct.unpack(">BHI", hdr)
+    payload = b""
+    while len(payload) < size:
+        payload += sock.recv(size - len(payload))
+    sock.recv(1)  # frame end
+    return ftype, ch, payload
+
+
+def _read_method(sock):
+    ftype, ch, payload = _read_frame(sock)
+    assert ftype == 1, f"expected a method frame, got type {ftype}"
+    cls, mth = struct.unpack(">HH", payload[:4])
+    return ch, cls, mth, payload[4:]
+
+
+def _handshake(port):
+    sock = socket.create_connection(("127.0.0.1", port), timeout=10)
+    sock.settimeout(10)
+    sock.sendall(b"AMQP\x00\x00\x09\x01")
+    assert _read_method(sock)[1:3] == (10, 10)  # Start
+    _send_method(sock, 0, 10, 11)  # Start-Ok
+    assert _read_method(sock)[1:3] == (10, 30)  # Tune
+    _send_method(sock, 0, 10, 31)  # Tune-Ok
+    _send_method(sock, 0, 10, 40)  # Open
+    assert _read_method(sock)[1:3] == (10, 41)  # Open-Ok
+    _send_method(sock, 1, 20, 10)  # Channel.Open
+    assert _read_method(sock)[1:3] == (20, 11)
+    return sock
+
+
+def _declare(sock, qname, args_table=b""):
+    _send_method(
+        sock, 1, 50, 10,
+        struct.pack(">H", 0) + _shortstr(qname) + b"\x00"
+        + _longstr(args_table),
+    )
+    assert _read_method(sock)[1:3] == (50, 11)
+
+
+_FENCING = _shortstr("x-fencing") + b"t\x01"
+
+
+def test_consume_on_fenced_queue_is_rejected():
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.lock", _FENCING)
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok
+        ch, cls, mth, args = _read_method(sock)
+        assert (cls, mth) == (20, 40), "expected Channel.Close"
+        (code,) = struct.unpack(">H", args[:2])
+        assert code == 540
+        assert b"fenced" in args
+        sock.close()
+    finally:
+        b.stop()
+
+
+def test_redeclare_without_fencing_allows_consume_again():
+    # last declare wins: the fenced observation must not stick forever
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.lock", _FENCING)
+        _declare(sock, "jepsen.lock")  # redeclared plain
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok
+        # channel survived: a get on the same channel answers get-empty
+        _send_method(
+            sock, 1, 60, 70,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock") + b"\x00",
+        )
+        assert _read_method(sock)[1:3] == (60, 72)  # Get-Empty
+        sock.close()
+    finally:
+        b.stop()
+
+
+def test_consumer_registered_before_fenced_declare_is_closed_loudly():
+    # the registration-time rejection can't see a declare that hasn't
+    # happened yet: the delivery-time re-check must refuse just as
+    # loudly (540 channel close), never stall silently or push a
+    # tokenless grant
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.lock")  # plain at consume time
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok
+        other = _handshake(b.port)
+        _declare(other, "jepsen.lock", _FENCING)  # now fenced
+        # a publish kicks the delivery loop for the waiting consumer
+        _send_method(
+            other, 1, 60, 40,
+            struct.pack(">H", 0) + _shortstr("")
+            + _shortstr("jepsen.lock") + b"\x00",
+        )
+        body = b"grant"
+        other.sendall(
+            struct.pack(">BHI", 2, 1, 14)
+            + struct.pack(">HHQH", 60, 0, len(body), 0)
+            + bytes([FRAME_END])
+        )
+        other.sendall(
+            struct.pack(">BHI", 3, 1, len(body)) + body
+            + bytes([FRAME_END])
+        )
+        ch, cls, mth, args = _read_method(sock)
+        assert (cls, mth) == (20, 40), "expected Channel.Close, not a push"
+        (code,) = struct.unpack(">H", args[:2])
+        assert code == 540
+        sock.close()
+        other.close()
+    finally:
+        b.stop()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_consume_rejected_when_declare_came_via_another_node():
+    """r7 review follow-up: the fenced-consume rejection must key off the
+    COMMITTED queue meta, not a node-local observation of the declare —
+    a broker whose serve loop never processed the queue.declare (it
+    arrived via a peer) would otherwise fail open and push tokenless
+    grants."""
+    names = ["n0", "n1"]
+    peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
+    brokers = {
+        nm: MiniAmqpBroker(
+            port=0,
+            replication=ReplicatedBackend(
+                nm, peers, election_timeout=(0.15, 0.3),
+                heartbeat_s=0.04, submit_timeout_s=2.0,
+            ),
+        ).start()
+        for nm in names
+    }
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not any(
+            b.replication.raft.is_leader() for b in brokers.values()
+        ):
+            time.sleep(0.02)
+        assert any(b.replication.raft.is_leader() for b in brokers.values())
+
+        sock_a = _handshake(brokers["n0"].port)
+        _declare(sock_a, "jepsen.lock", _FENCING)  # commits via n0
+
+        # wait for n1's replica to apply the committed declare
+        mach = brokers["n1"].replication.machine
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with mach.lock:
+                if (mach.meta.get("jepsen.lock") or {}).get("fenced"):
+                    break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("declare never applied on n1")
+        # n1's serve loop never saw the declare frame: its local
+        # observation set is empty — the committed meta must carry it
+        assert "jepsen.lock" not in brokers["n1"]._fenced_queues
+
+        sock_b = _handshake(brokers["n1"].port)
+        _send_method(
+            sock_b, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock_b)[1:3] == (60, 21)  # Consume-Ok
+        ch, cls, mth, args = _read_method(sock_b)
+        assert (cls, mth) == (20, 40), "expected Channel.Close"
+        (code,) = struct.unpack(">H", args[:2])
+        assert code == 540
+        sock_a.close()
+        sock_b.close()
+    finally:
+        for b in brokers.values():
+            b.stop()
+
+
+def test_plain_redeclare_via_another_node_clears_fencedness():
+    """Second r7 advisor pass: the committed meta must win in BOTH
+    directions.  A fenced declare served by n0 leaves a shadow entry in
+    n0's local observation set; when the queue is later redeclared
+    PLAIN via n1 (last declare wins, committed), n0's stale shadow entry
+    must not keep rejecting consumes forever."""
+    names = ["n0", "n1"]
+    peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
+    brokers = {
+        nm: MiniAmqpBroker(
+            port=0,
+            replication=ReplicatedBackend(
+                nm, peers, election_timeout=(0.15, 0.3),
+                heartbeat_s=0.04, submit_timeout_s=2.0,
+            ),
+        ).start()
+        for nm in names
+    }
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not any(
+            b.replication.raft.is_leader() for b in brokers.values()
+        ):
+            time.sleep(0.02)
+        assert any(b.replication.raft.is_leader() for b in brokers.values())
+
+        sock_a = _handshake(brokers["n0"].port)
+        _declare(sock_a, "jepsen.lock", _FENCING)   # fenced via n0
+        sock_b = _handshake(brokers["n1"].port)
+        _declare(sock_b, "jepsen.lock")             # plain via n1
+
+        # wait for n0's replica to apply the committed plain redeclare
+        mach = brokers["n0"].replication.machine
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with mach.lock:
+                meta = mach.meta.get("jepsen.lock")
+                if meta is not None and not meta.get("fenced"):
+                    break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("plain redeclare never applied on n0")
+        # n0's serve loop only ever saw the FENCED declare: its shadow
+        # set still carries the stale entry the committed meta overrides
+        assert "jepsen.lock" in brokers["n0"]._fenced_queues
+
+        _send_method(
+            sock_a, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock_a)[1:3] == (60, 21)  # Consume-Ok
+        # channel survived: a get on the same channel answers get-empty
+        _send_method(
+            sock_a, 1, 60, 70,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock") + b"\x00",
+        )
+        assert _read_method(sock_a)[1:3] == (60, 72)  # Get-Empty
+        sock_a.close()
+        sock_b.close()
+    finally:
+        for b in brokers.values():
+            b.stop()
+
+
+def test_local_meta_wins_over_stale_shadow_entry():
+    # the non-replicated helper decides under state_lock, meta entry
+    # first: a stale shadow entry (declare raced against a concurrent
+    # plain redeclare) must not override the last committed declare
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.lock")
+        with b.state_lock:
+            b._fenced_queues.add("jepsen.lock")  # stale observation
+            assert not b._is_fenced_queue_locked("jepsen.lock")
+        assert not b._is_fenced_queue("jepsen.lock")
+        # and a queue with no meta entry at all falls back to the shadow
+        with b.state_lock:
+            b._fenced_queues.add("jepsen.undeclared")
+        assert b._is_fenced_queue("jepsen.undeclared")
+        sock.close()
+    finally:
+        b.stop()
+
+
+def test_unacked_consumer_on_newly_fenced_queue_is_closed_not_stalled():
+    """Replicated push path: a consumer holding an unacked delivery from
+    before the queue went fenced must still get the loud 540 close on
+    the next kick — the QoS-1 one-in-flight return must not starve the
+    fenced re-check into a silent stall (third advisor pass)."""
+    peers = {"n0": ("127.0.0.1", _free_port())}
+    b = MiniAmqpBroker(
+        port=0,
+        replication=ReplicatedBackend(
+            "n0", peers, election_timeout=(0.15, 0.3),
+            heartbeat_s=0.04, submit_timeout_s=2.0,
+        ),
+    ).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and not b.replication.raft.is_leader():
+            time.sleep(0.02)
+        assert b.replication.raft.is_leader()
+
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.lock")  # plain at consume time
+        # subscribe, then publish one message and receive it (acking
+        # consumer: the delivery stays unacked)
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok
+        body = b"grant"
+        _send_method(
+            sock, 1, 60, 40,
+            struct.pack(">H", 0) + _shortstr("")        # default exchange
+            + _shortstr("jepsen.lock") + b"\x00",       # routing key
+        )
+        sock.sendall(
+            struct.pack(">BHI", 2, 1, 14)
+            + struct.pack(">HHQH", 60, 0, len(body), 0)
+            + bytes([FRAME_END])
+        )
+        sock.sendall(
+            struct.pack(">BHI", 3, 1, len(body)) + body
+            + bytes([FRAME_END])
+        )
+        assert _read_method(sock)[1:3] == (60, 60)  # Deliver (unacked)
+        sock.recv(4096)  # drain the content frames
+
+        other = _handshake(b.port)
+        _declare(other, "jepsen.lock", _FENCING)  # now fenced
+        # a second publish kicks the delivery loop for the consumer
+        _send_method(
+            other, 1, 60, 40,
+            struct.pack(">H", 0) + _shortstr("")
+            + _shortstr("jepsen.lock") + b"\x00",
+        )
+        other.sendall(
+            struct.pack(">BHI", 2, 1, 14)
+            + struct.pack(">HHQH", 60, 0, len(body), 0)
+            + bytes([FRAME_END])
+        )
+        other.sendall(
+            struct.pack(">BHI", 3, 1, len(body)) + body
+            + bytes([FRAME_END])
+        )
+        ch, cls, mth, args = _read_method(sock)
+        assert (cls, mth) == (20, 40), "expected Channel.Close, not a stall"
+        (code,) = struct.unpack(">H", args[:2])
+        assert code == 540
+        sock.close()
+        other.close()
+    finally:
+        b.stop()
+
+
+def test_rejected_fenced_consume_keeps_prior_subscription_alive():
+    """The registration-time rejection must not clear a pre-existing
+    subscription to a DIFFERENT, unfenced queue, nor clobber its ack
+    mode (fourth/fifth advisor passes): after the 540 close for the
+    fenced consume, pushes on the original no-ack subscription keep
+    flowing — TWO deliveries, which would stall at the QoS-1 gate had
+    the rejected consume's default-ack mode stuck."""
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.queue")
+        _declare(sock, "jepsen.lock", _FENCING)
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.queue")
+            + _shortstr("") + b"\x02" + _longstr(b""),  # no-ack
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok (plain)
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.lock")
+            + _shortstr("") + b"\x00" + _longstr(b""),  # default ack
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok (fenced)
+        ch, cls, mth, args = _read_method(sock)
+        assert (cls, mth) == (20, 40)  # the fenced consume's 540 close
+        assert struct.unpack(">H", args[:2])[0] == 540
+        # the plain no-ack subscription survived with its mode intact:
+        # two publishes both get pushed (an acking consumer that never
+        # acks would stall after the first)
+        other = _handshake(b.port)
+        body = b"msg"
+        for _ in range(2):
+            _send_method(
+                other, 1, 60, 40,
+                struct.pack(">H", 0) + _shortstr("")
+                + _shortstr("jepsen.queue") + b"\x00",
+            )
+            other.sendall(
+                struct.pack(">BHI", 2, 1, 14)
+                + struct.pack(">HHQH", 60, 0, len(body), 0)
+                + bytes([FRAME_END])
+            )
+            other.sendall(
+                struct.pack(">BHI", 3, 1, len(body)) + body
+                + bytes([FRAME_END])
+            )
+        deliveries = 0
+        while deliveries < 2:  # content frames are skipped naturally
+            ftype, _, payload = _read_frame(sock)
+            if ftype == 1 and struct.unpack(">HH", payload[:4]) == (60, 60):
+                deliveries += 1
+        sock.close()
+        other.close()
+    finally:
+        b.stop()
+
+
+def test_consume_on_plain_queue_still_works():
+    b = MiniAmqpBroker(port=0).start()
+    try:
+        sock = _handshake(b.port)
+        _declare(sock, "jepsen.queue")
+        _send_method(
+            sock, 1, 60, 20,
+            struct.pack(">H", 0) + _shortstr("jepsen.queue")
+            + _shortstr("") + b"\x00" + _longstr(b""),
+        )
+        assert _read_method(sock)[1:3] == (60, 21)  # Consume-Ok
+        # no channel close follows: basic.get on the same channel
+        # answers get-empty, proving the channel survived the consume
+        _send_method(
+            sock, 1, 60, 70,
+            struct.pack(">H", 0) + _shortstr("jepsen.queue") + b"\x00",
+        )
+        assert _read_method(sock)[1:3] == (60, 72)  # Get-Empty
+        sock.close()
+    finally:
+        b.stop()

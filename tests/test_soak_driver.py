@@ -1,0 +1,197 @@
+"""tools/soak.py — the long-soak entry point's fail-loud artifact
+capture (round-7 review: a supervisor tee'd a file-not-found error from
+a nonexistent driver path into ``store/`` evidence files; the driver
+now owns capture, and a failed run must never produce an artifact)."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SOAK_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "tools", "soak.py",
+)
+_spec = importlib.util.spec_from_file_location("soak_driver", _SOAK_PATH)
+soak = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(soak)
+
+
+def test_capture_writes_artifact_only_on_success(tmp_path):
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        print("verdict line")
+        return 0
+
+    assert soak.capture(str(out), run) == 0
+    assert "verdict line" in out.read_text()
+    assert not os.path.exists(str(out) + ".failed")
+
+
+def test_capture_failure_never_creates_the_artifact(tmp_path):
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        print("partial log before the failure")
+        return 3
+
+    assert soak.capture(str(out), run) == 3
+    assert not out.exists()
+    failed = out.with_suffix(".txt.failed")
+    assert "partial log" in failed.read_text()
+
+
+def test_capture_non_int_return_is_a_failure(tmp_path):
+    # a bare `return` from the run body must not reach sys.exit(None)
+    # (process exit 0) while the log went to .failed — the silent
+    # success-with-no-artifact shape capture() exists to prevent
+    out = tmp_path / "evidence.txt"
+    assert soak.capture(str(out), lambda: None) == 1
+    assert not out.exists()
+    assert (tmp_path / "evidence.txt.failed").exists()
+
+
+def test_capture_exception_is_fail_loud(tmp_path):
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        raise RuntimeError("cluster exploded")
+
+    assert soak.capture(str(out), run) == 1
+    assert not out.exists()
+    text = (tmp_path / "evidence.txt.failed").read_text()
+    assert "cluster exploded" in text  # traceback lands in the log
+
+
+def test_capture_bare_sys_exit_never_mints_an_artifact(tmp_path):
+    # SystemExit(None) is rc 0 by shell convention, but inside capture
+    # it is a library fatal path — treat as failure
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        sys.exit()
+
+    assert soak.capture(str(out), run) == 1
+    assert not out.exists()
+    assert (tmp_path / "evidence.txt.failed").exists()
+
+
+def test_capture_string_sys_exit_is_a_loud_failure(tmp_path):
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        sys.exit("broker never booted")
+
+    assert soak.capture(str(out), run) == 1
+    assert not out.exists()
+    assert (tmp_path / "evidence.txt.failed").exists()
+    assert not list(tmp_path.glob("*.tmp"))  # no orphaned capture file
+
+
+def test_capture_bool_success_never_mints_an_artifact(tmp_path):
+    # bool IS an int: sys.exit(False) / `return False` would pass an
+    # isinstance(int) gate and exit 0 with the artifact minted
+    out = tmp_path / "evidence.txt"
+    assert soak.capture(str(out), lambda: False) == 1
+    assert not out.exists()
+
+    def run():
+        sys.exit(False)
+
+    assert soak.capture(str(out), run) == 1
+    assert not out.exists()
+
+
+def test_capture_reraises_keyboard_interrupt_after_cleanup(tmp_path):
+    # the operator's Ctrl-C must propagate (interrupt exit status, so a
+    # supervisor doesn't retry a stopped run) AND route the log to
+    # .failed, never to the artifact
+    out = tmp_path / "evidence.txt"
+
+    def run():
+        raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        soak.capture(str(out), run)
+    assert not out.exists()
+    assert (tmp_path / "evidence.txt.failed").exists()
+    assert sys.stdout is not None and not isinstance(sys.stdout, soak._Tee)
+
+
+def test_capture_artifact_is_world_readable(tmp_path):
+    # mkstemp's 0600 must not survive into store/: evidence files are
+    # read by CI/other users like every other committed artifact
+    out = tmp_path / "evidence.txt"
+    assert soak.capture(str(out), lambda: 0) == 0
+    assert (out.stat().st_mode & 0o777) == 0o644
+
+
+def test_capture_restores_std_streams(tmp_path):
+    before = (sys.stdout, sys.stderr)
+    soak.capture(str(tmp_path / "o.txt"), lambda: 0)
+    assert (sys.stdout, sys.stderr) == before
+
+
+def test_capture_rebinds_logging_off_the_dead_tee(tmp_path):
+    # run_soak binds the root handler to the tee via basicConfig;
+    # a daemon-thread log record arriving after capture() returns
+    # must not hit the closed file
+    import logging
+
+    def run():
+        logging.basicConfig(stream=sys.stdout, force=True)
+        logging.getLogger("soak-test").info("inside the capture")
+        return 0
+
+    assert soak.capture(str(tmp_path / "o.txt"), run) == 0
+    assert not any(
+        isinstance(getattr(h, "stream", None), soak._Tee)
+        for h in logging.root.handlers
+    )
+    logging.getLogger("soak-test").info("after the capture")  # no spray
+
+
+def test_fenced_requires_mutex_workload():
+    with pytest.raises(SystemExit) as e:
+        soak.main(["--workload", "queue", "--fenced"])
+    assert e.value.code == 2
+
+
+def test_unfenced_mutex_cannot_expect_valid():
+    # the documented hazard: an unfenced lock soaking green would be
+    # luck, not evidence — the driver refuses the combination
+    with pytest.raises(SystemExit) as e:
+        soak.main(["--workload", "mutex", "--minutes", "1"])
+    assert e.value.code == 2
+
+
+def test_burnin_mutex_delegates_to_the_shared_driver(monkeypatch, tmp_path):
+    # tools/burnin_mutex.py translates its argv onto soak.py's OWN
+    # parser (one argument surface) and calls soak.main — the mutex
+    # expectation wired in per mode, capture handled by the driver
+    _bspec = importlib.util.spec_from_file_location(
+        "burnin_mutex_driver",
+        os.path.join(os.path.dirname(_SOAK_PATH), "burnin_mutex.py"),
+    )
+    burnin = importlib.util.module_from_spec(_bspec)
+    _bspec.loader.exec_module(burnin)
+
+    seen = {}
+
+    def fake_run(args):
+        seen.update(vars(args))
+        print("fake run")
+        return 0
+
+    monkeypatch.setattr(burnin.soak, "run_soak", fake_run)
+    out = tmp_path / "evidence.txt"
+    assert burnin.main(["--fenced", "--out", str(out)]) == 0
+    assert seen["workload"] == "mutex" and seen["fenced"] is True
+    assert seen["expect"] == "valid"
+    assert "fake run" in out.read_text()
+
+    seen.clear()
+    assert burnin.main([]) == 0
+    assert seen["expect"] == "invalid" and seen["fenced"] is False

@@ -10,33 +10,31 @@ soak GREEN, because grants carry Raft-commit-index fencing tokens, the
 broker rejects superseded tokens, and the checker verifies token order
 instead of hold exclusivity.
 
-Run both twins with one seed and tee into ``store/``::
+Since r7 this is a thin wrapper over ``tools/soak.py`` (one shared run
+body; the mutex expectation wired in: unfenced expects *invalid* — the
+documented hazard — fenced expects *valid*).  Capture evidence with
+``--out``, never with ``tee``: the artifact only lands when the run
+reaches its expected verdict; a failed invocation exits non-zero and
+leaves ``OUT.failed``::
 
     python tools/burnin_mutex.py --minutes 10 --seed 7 \
-        2>&1 | tee store/burnin_r6_10min_5node_mutex_unfenced_red.txt
+        --out store/burnin_r6_10min_5node_mutex_unfenced_red.txt
     python tools/burnin_mutex.py --minutes 10 --seed 7 --fenced \
-        2>&1 | tee store/burnin_r6_10min_5node_mutex_fenced_green.txt
+        --out store/burnin_r6_10min_5node_mutex_fenced_green.txt
 
-Exit code 0 = the run reached its expected verdict (invalid for
-unfenced — the documented hazard — valid for fenced) under the triage
-rules; non-zero = it never did within ``--attempts``.
+Exit code 0 = the run reached its expected verdict within
+``--attempts``; non-zero = it never did, and no artifact was written.
 """
 
 from __future__ import annotations
 
 import argparse
-import json
-import logging
 import os
 import sys
-import tempfile
-import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, REPO)
-sys.path.insert(0, os.path.join(REPO, "tests"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import soak  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -52,93 +50,29 @@ def main(argv=None) -> int:
                    help="triage attempts (fresh cluster each)")
     p.add_argument("--store", default=None,
                    help="store root (default: a temp dir)")
+    p.add_argument("--out", default=None,
+                   help="evidence file; only written when the run "
+                        "reaches its expected verdict")
     args = p.parse_args(argv)
 
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-        stream=sys.stdout,
-    )
-
-    from _live import run_live_with_triage
-
-    from jepsen_tpu.checkers.live import attach_live_monitor_for
-    from jepsen_tpu.client import native as native_mod
-    from jepsen_tpu.harness.localcluster import build_local_test
-    from jepsen_tpu.history.store import _json_default
-
-    store = args.store or tempfile.mkdtemp(prefix="burnin_mutex_")
-    opts = {
-        "rate": args.rate,
-        "time-limit": args.minutes * 60.0,
-        "time-before-partition": 2.0,
-        "partition-duration": 10.0,
-        "network-partition": "partition-random-halves",
-        "nemesis": "mixed",
-        "recovery-sleep": 20.0,
-        "publish-confirm-timeout": 5.0,
-        "durable": True,
-        "seed": args.seed,
-        "fenced": args.fenced,
-    }
-    mode = "fenced" if args.fenced else "unfenced"
-    expect = "valid" if args.fenced else "invalid"
-    print(
-        f"# mutex burn-in: {mode}, {args.nodes} nodes, "
-        f"{args.minutes:g} min mixed nemesis, seed={args.seed}, "
-        f"expect={expect}", flush=True,
-    )
-
-    monitors = []
-
-    def build():
-        native_mod.reset()
-        test, transport = build_local_test(
-            opts,
-            n_nodes=args.nodes,
-            concurrency=args.nodes,
-            checker_backend="cpu",
-            store_root=store,
-            workload="mutex",
-            durable=True,
-        )
-        m = attach_live_monitor_for(
-            test, "fenced-mutex" if args.fenced else "mutex"
-        )
-        monitors.append(m)
-        return test, transport
-
-    t0 = time.monotonic()
-    try:
-        run = run_live_with_triage(
-            build, expect=expect, max_attempts=args.attempts
-        )
-    except AssertionError as e:
-        print(f"# burn-in FAILED to reach expect={expect}: {e}", flush=True)
-        return 1
-    wall = time.monotonic() - t0
-    if monitors and monitors[-1] is not None:
-        snap = monitors[-1].snapshot()
-        counts = ", ".join(
-            f"{v} {k}" for k, v in snap["anomalies"].items()
-        )
-        print(
-            f"# live monitor ({monitors[-1].name}): {counts} "
-            f"(of {snap['observations']} observations); "
-            f"violation-so-far={snap['violation-so-far']}", flush=True,
-        )
-    print(json.dumps(run.results, indent=1, default=_json_default))
-    print(
-        f"# burn-in done in {wall:.0f}s wall ({len(run.history)} history "
-        f"ops, attempts logged above)", flush=True,
-    )
-    verdict = run.results.get("valid?")
-    if verdict is True:
-        print("Everything looks good! ヽ('ー`)ノ")
-    else:
-        print("Analysis invalid! ಠ~ಠ")
-    # the run reached the EXPECTED verdict (triage guarantees this)
-    return 0
+    # translate to soak.py's OWN argv surface (one argument parser, no
+    # hand-built Namespace to drift when the driver grows options)
+    soak_argv = [
+        "--workload", "mutex",
+        "--minutes", str(args.minutes),
+        "--nodes", str(args.nodes),
+        "--seed", str(args.seed),
+        "--rate", str(args.rate),
+        "--expect", "valid" if args.fenced else "invalid",
+        "--attempts", str(args.attempts),
+    ]
+    if args.store is not None:
+        soak_argv += ["--store", args.store]
+    if args.fenced:
+        soak_argv.append("--fenced")
+    if args.out is not None:
+        soak_argv += ["--out", args.out]
+    return soak.main(soak_argv)
 
 
 if __name__ == "__main__":

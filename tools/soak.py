@@ -1,0 +1,280 @@
+"""Long-soak driver: N-minute mixed-nemesis durable soaks for every
+workload family (VERDICT #4 "longer soaks") under the ``tests/_live.py``
+triage supervisor, with fail-loud artifact capture.
+
+Round-7 review found a supervisor tee-ing ``python tools/soak.py``'s
+*file-not-found error* into ``store/`` evidence files — a failed
+invocation masquerading as green soak evidence.  This module is that
+missing entry point, and it closes the hole structurally: with
+``--out``, the log is teed to a temp file and only renamed into place
+when the run reached its expected verdict.  A crash, a wrong verdict,
+or triage exhaustion exits non-zero and leaves ``PATH.failed`` —
+never a committed-looking artifact.
+
+How the r7 evidence pair was produced::
+
+    python tools/soak.py --workload mutex --fenced --minutes 30 \
+        --out store/soak_r7_30min_5node_mutex_fenced_supervised.txt
+    python tools/soak.py --workload queue --minutes 30 \
+        --out store/soak_r7_30min_5node_queue.txt
+
+The mutex run captured its artifact (green, one attempt).  The queue
+run exited 1 with only ``...queue.txt.failed`` — the durable queue
+lost acked messages on both triage attempts; that log was renamed to
+``store/soak_r7_30min_5node_queue_red.txt`` and indexed in PARITY.md
+as an open finding.  Expect the queue recipe to keep failing until
+the loss is fixed.
+
+Exit code 0 = the run reached its expected verdict under the triage
+rules (and the artifact, if requested, was captured); non-zero = it
+never did within ``--attempts``, and no artifact was written.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import tempfile
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+WORKLOADS = ("queue", "mutex", "stream", "elle")
+
+
+class _Tee:
+    """Mirror writes to every underlying stream (console + artifact)."""
+
+    def __init__(self, *streams):
+        self._streams = streams
+
+    def write(self, s):
+        for st in self._streams:
+            st.write(s)
+        return len(s)
+
+    def flush(self):
+        for st in self._streams:
+            st.flush()
+
+
+def capture(out_path: str, fn) -> int:
+    """Fail-loud artifact capture around ``fn() -> int``.
+
+    stdout/stderr are teed into a temp file beside ``out_path`` while
+    ``fn`` runs.  Only a 0 return renames the log into place; any other
+    return or an exception keeps it at ``out_path + ".failed"`` and
+    propagates a non-zero exit — the artifact directory never gains a
+    green-looking file from a failed invocation.
+    """
+    d = os.path.dirname(os.path.abspath(out_path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(out_path) + ".", suffix=".tmp", dir=d
+    )
+    # mkstemp's 0600 would survive os.replace — evidence files must be
+    # world-readable like every other store/ artifact
+    os.fchmod(fd, 0o644)
+    rc = 1
+    interrupted = False
+    old_out, old_err = sys.stdout, sys.stderr
+    with os.fdopen(fd, "w") as f:
+        sys.stdout = _Tee(old_out, f)
+        sys.stderr = _Tee(old_err, f)
+        try:
+            try:
+                rc = fn()
+                if not isinstance(rc, int) or isinstance(rc, bool):
+                    # a bare/odd return — including True/False, which
+                    # ARE ints — must not reach sys.exit(None)/
+                    # sys.exit(False) (exit code 0!) after the log
+                    # went to .failed
+                    rc = 1
+            except SystemExit as e:
+                # only an explicit non-bool int code carries through;
+                # a bare sys.exit(), sys.exit("message"), or
+                # sys.exit(False) from a library fatal path is a
+                # failure — it must never mint an artifact
+                explicit = isinstance(e.code, int) and not isinstance(
+                    e.code, bool
+                )
+                rc = e.code if explicit else 1
+                if not explicit and e.code is not None:
+                    print(f"soak: SystemExit: {e.code}", file=sys.stderr)
+            except KeyboardInterrupt:
+                # routed to .failed like any failure, then re-raised
+                # after cleanup: the operator's Ctrl-C must still kill
+                # the process with the interrupt status, so a
+                # supervisor retrying on "run failed" doesn't relaunch
+                # a run the operator was stopping
+                traceback.print_exc()
+                rc = 1
+                interrupted = True
+            except BaseException:
+                traceback.print_exc()
+                rc = 1
+        finally:
+            out_tee, err_tee = sys.stdout, sys.stderr
+            sys.stdout, sys.stderr = old_out, old_err
+            # run_soak's basicConfig(stream=sys.stdout) bound the root
+            # handler to the tee; rebind before the file closes so
+            # stray daemon-thread log records (unjoined cluster
+            # threads) don't hit a dead stream — each tee back onto
+            # the stream it wrapped, so stderr records stay on stderr
+            for h in logging.root.handlers:
+                if getattr(h, "stream", None) is out_tee:
+                    h.stream = old_out
+                elif getattr(h, "stream", None) is err_tee:
+                    h.stream = old_err
+    if rc == 0:
+        os.replace(tmp, out_path)
+    else:
+        failed = out_path + ".failed"
+        os.replace(tmp, failed)
+        print(
+            f"soak: run failed (rc={rc}); artifact NOT captured; "
+            f"log kept at {failed}",
+            file=sys.stderr,
+        )
+    if interrupted:
+        raise KeyboardInterrupt
+    return rc
+
+
+def run_soak(args) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stdout,
+        force=True,
+    )
+
+    from _live import run_live_with_triage
+
+    from jepsen_tpu.checkers.live import attach_live_monitor_for
+    from jepsen_tpu.client import native as native_mod
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.history.store import _json_default
+
+    store = args.store or tempfile.mkdtemp(prefix=f"soak_{args.workload}_")
+    opts = {
+        "rate": args.rate,
+        "time-limit": args.minutes * 60.0,
+        "time-before-partition": 2.0,
+        "partition-duration": 10.0,
+        "network-partition": "partition-random-halves",
+        "nemesis": "mixed",
+        "recovery-sleep": 20.0,
+        "publish-confirm-timeout": 5.0,
+        "durable": True,
+        "seed": args.seed,
+    }
+    monitor_name = args.workload
+    if args.workload == "mutex":
+        opts["fenced"] = args.fenced
+        if args.fenced:
+            monitor_name = "fenced-mutex"
+    print(
+        f"# soak: {args.workload}"
+        f"{' (fenced)' if args.workload == 'mutex' and args.fenced else ''},"
+        f" {args.nodes} nodes, {args.minutes:g} min mixed nemesis,"
+        f" durable, seed={args.seed}, expect={args.expect}",
+        flush=True,
+    )
+
+    monitors = []
+
+    def build():
+        native_mod.reset()
+        test, transport = build_local_test(
+            opts,
+            n_nodes=args.nodes,
+            concurrency=args.nodes,
+            checker_backend="cpu",
+            store_root=store,
+            workload=args.workload,
+            durable=True,
+        )
+        monitors.append(attach_live_monitor_for(test, monitor_name))
+        return test, transport
+
+    t0 = time.monotonic()
+    try:
+        run = run_live_with_triage(
+            build, expect=args.expect, max_attempts=args.attempts
+        )
+    except AssertionError as e:
+        print(f"# soak FAILED to reach expect={args.expect}: {e}", flush=True)
+        return 1
+    wall = time.monotonic() - t0
+    if monitors and monitors[-1] is not None:
+        snap = monitors[-1].snapshot()
+        counts = ", ".join(f"{v} {k}" for k, v in snap["anomalies"].items())
+        print(
+            f"# live monitor ({monitors[-1].name}): {counts} "
+            f"(of {snap['observations']} observations); "
+            f"violation-so-far={snap['violation-so-far']}",
+            flush=True,
+        )
+    print(json.dumps(run.results, indent=1, default=_json_default))
+    print(
+        f"# soak done in {wall:.0f}s wall ({len(run.history)} history "
+        f"ops, attempts logged above)",
+        flush=True,
+    )
+    if run.results.get("valid?") is True:
+        print("Everything looks good! ヽ('ー`)ノ")
+    else:
+        print("Analysis invalid! ಠ~ಠ")
+    # triage guarantees the run reached the EXPECTED verdict
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workload", required=True, choices=WORKLOADS)
+    p.add_argument("--minutes", type=float, default=30.0)
+    p.add_argument("--nodes", type=int, default=5)
+    p.add_argument("--seed", type=int, default=7,
+                   help="nemesis schedule seed")
+    p.add_argument("--rate", type=float, default=40.0)
+    p.add_argument("--fenced", action="store_true",
+                   help="mutex only: fencing-token lock mode (the "
+                        "configuration whose soak must stay green)")
+    p.add_argument("--expect", choices=("valid", "invalid"),
+                   default="valid",
+                   help="triage expectation (invalid for runs that "
+                        "exercise a documented hazard, e.g. the "
+                        "unfenced mutex)")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="triage attempts (fresh cluster each)")
+    p.add_argument("--store", default=None,
+                   help="store root (default: a temp dir)")
+    p.add_argument("--out", default=None,
+                   help="evidence file to capture the log into; only "
+                        "written when the run reaches its expected "
+                        "verdict (failure leaves OUT.failed and a "
+                        "non-zero exit)")
+    args = p.parse_args(argv)
+    if args.fenced and args.workload != "mutex":
+        p.error("--fenced only applies to --workload mutex")
+    if args.workload == "mutex" and not args.fenced \
+            and args.expect == "valid":
+        p.error("unfenced mutex soaks green only by luck — the "
+                "documented hazard expects invalid; pass --fenced "
+                "or --expect invalid explicitly")
+    if args.out is None:
+        return run_soak(args)
+    return capture(args.out, lambda: run_soak(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -298,11 +298,49 @@ def _bench_stream_sized(
         python_fn=lambda p: _stream_rows(read_history(p)),
         pack_fn=pack_stream_rows,
     ))
+    # the MEASURED bytes-to-verdict run through the pipeline executor
+    # (the formula-based keys above are kept for cross-round comparison)
+    details[key].update(_pipeline_rates(
+        base,
+        "stream",
+        rate,
+        repeat=2 if n_ops >= 10_000 else 4,
+        chunk=min(len(base), 8 if n_ops >= 10_000 else 64),
+    ))
     e = details[key]
     print(
         f"# {key} end-to-end: "
         f"native={e['end_to_end_histories_per_sec']:.0f} hist/s "
-        f"python={e['end_to_end_histories_per_sec_python']:.0f} hist/s",
+        f"python={e['end_to_end_histories_per_sec_python']:.0f} hist/s; "
+        f"pipeline={e['pipeline_e2e_histories_per_sec']:.0f} hist/s "
+        f"(device occupancy {e['pipeline_e2e_vs_device_only']:.2f}, "
+        f"overlap {e['stage_overlap_frac']:.2f}, "
+        f"idle {e['device_idle_frac']:.2f})",
+        file=sys.stderr,
+    )
+
+
+def _bench_queue_pipeline(details: dict) -> None:
+    """Queue-family bytes-to-verdict through the pipeline executor (runs
+    as a secondary section — the headline must print before any
+    file-backed measurement; see _run_once)."""
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+    n = min(BASE_HISTORIES, 64)
+    base = synth_batch(n, SynthSpec(n_ops=N_OPS, n_processes=5), lost=1)
+    details["queue"].update(_pipeline_rates(
+        base,
+        "queue",
+        details["queue"]["device_histories_per_sec"],
+        repeat=2,
+        chunk=min(n, 32),
+    ))
+    e = details["queue"]
+    print(
+        f"# queue pipeline: {e['pipeline_e2e_histories_per_sec']:.0f} "
+        f"hist/s (device occupancy "
+        f"{e['pipeline_e2e_vs_device_only']:.2f}, overlap "
+        f"{e['stage_overlap_frac']:.2f})",
         file=sys.stderr,
     )
 
@@ -388,6 +426,59 @@ def _end_to_end_rates(
         ]
         out["native_substrate"] = "unavailable (fell back)"
     return out
+
+
+def _pipeline_rates(
+    base, workload: str, device_rate: float, repeat: int, chunk: int, **opts
+) -> dict:
+    """MEASURED bytes-to-verdict wall rate through the pipeline executor
+    (``parallel/pipeline.py``) — unlike :func:`_end_to_end_rates`, which
+    combines separately-measured best-case stage costs by formula, this
+    times one real run: history files in, verdicts out, with native
+    thread-pool packing on the producer thread overlapping the device
+    dispatch.  ``use_cache=False``: every pack is a genuine parse (the
+    digest caches would turn the second timed run into a warm-path
+    measurement).
+
+    Keys:
+    - ``pipeline_e2e_histories_per_sec`` — measured wall rate;
+    - ``stage_overlap_frac`` / ``device_idle_frac`` — executor
+      utilization evidence (see PipelineStats);
+    - ``pipeline_e2e_vs_device_only`` — device-occupancy ratio
+      ``check_busy / wall`` (= 1 − device_idle_frac): the fraction of
+      the run during which the device was computing verdicts.  1.0 means
+      the host is fully hidden behind device work — the tentpole's "the
+      device never waits on the host" in one number;
+    - ``pipeline_e2e_vs_async_device`` — the same wall rate against the
+      async-dispatch device-only rate above (the r05 ratio's shape; on a
+      2-core CPU backend this is Amdahl-bound by the native substrate
+      floor, see PIPELINE.md).
+    """
+    import tempfile
+
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, base)
+        srcs = files * repeat
+        # warm the jitted chunk programs (the executor's pow2 bucketing
+        # reuses them); the timed run then measures steady state, the
+        # same compile-excluded discipline as _timed_rate
+        check_sources(workload, srcs, chunk=chunk, use_cache=False, **opts)
+        _res, stats = check_sources(
+            workload, srcs, chunk=chunk, use_cache=False, **opts
+        )
+    rate = stats.histories / max(stats.wall_s, 1e-9)
+    occupancy = 1.0 - stats.device_idle_frac
+    return {
+        "pipeline_chunk": chunk,
+        "pipeline_sources": stats.histories,
+        "pipeline_e2e_histories_per_sec": round(rate, 1),
+        "stage_overlap_frac": round(stats.stage_overlap_frac, 3),
+        "device_idle_frac": round(stats.device_idle_frac, 3),
+        "pipeline_e2e_vs_device_only": round(occupancy, 3),
+        "pipeline_e2e_vs_async_device": round(rate / device_rate, 3),
+    }
 
 
 #: peak (bf16 FLOP/s, HBM bytes/s) by jax ``device_kind`` — the roofline
@@ -556,6 +647,9 @@ def _bench_elle(details: dict) -> None:
             [m for m, _ in subs], [g for _, g in subs]
         ),
     ))
+    details["elle"].update(_pipeline_rates(
+        base, "elle", rate, repeat=2, chunk=min(len(base), 32),
+    ))
     e = details["elle"]
     e["end_to_end_vs_device_only"] = round(
         e["end_to_end_histories_per_sec"] / rate, 3
@@ -564,7 +658,9 @@ def _bench_elle(details: dict) -> None:
         f"# elle end-to-end: native={e['end_to_end_histories_per_sec']:.0f}"
         f" hist/s python={e['end_to_end_histories_per_sec_python']:.0f}"
         f" hist/s (device-only {rate:.0f}, fused {fused_rate:.0f}, "
-        f"e2e/device-only {e['end_to_end_vs_device_only']:.2f})",
+        f"e2e/device-only {e['end_to_end_vs_device_only']:.2f}); "
+        f"pipeline={e['pipeline_e2e_histories_per_sec']:.0f} hist/s "
+        f"(device occupancy {e['pipeline_e2e_vs_device_only']:.2f})",
         file=sys.stderr,
     )
 
@@ -928,17 +1024,18 @@ def _run_once() -> None:
 
     backend = _init_backend_with_retry()
     print(f"# backend ready: {backend}", file=sys.stderr)
-    # persistent compile cache — TPU-only: the CPU AOT loader rejects
-    # cached entries over machine-feature drift (jaxenv docstring)
-    cache_dir = (
-        enable_compilation_cache(
-            os.path.join(
-                os.path.dirname(os.path.abspath(__file__)),
-                "store", "xla_cache",
-            )
-        )
-        if backend == "tpu"
-        else None
+    # persistent compile cache, EVERY backend (BENCH_r05's `compile
+    # cache: entries 0` was this hole: the cache was TPU-gated while
+    # every r0x run fell back to CPU, so each bench process re-paid all
+    # compiles).  Non-TPU backends cache in a machine-fingerprinted
+    # subdirectory — the CPU AOT loader rejects entries over machine-
+    # feature drift, and the fingerprint keys them (jaxenv docstring).
+    cache_dir = enable_compilation_cache(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "store", "xla_cache",
+        ),
+        backend=backend,
     )
     entries_before = compile_cache_entries(cache_dir)
     if backend != "tpu":
@@ -984,7 +1081,8 @@ def _run_once() -> None:
     # details persist after each section so a timeout after N sections
     # still leaves N sections of fresh numbers on disk
     for section in (
-        _bench_stream, _bench_stream_long, _bench_elle, _bench_mutex
+        _bench_queue_pipeline, _bench_stream, _bench_stream_long,
+        _bench_elle, _bench_mutex,
     ):
         try:
             section(details)
@@ -994,13 +1092,26 @@ def _run_once() -> None:
                 file=sys.stderr,
             )
         _write_details(details)
-    details["compile_cache"]["entries_final"] = compile_cache_entries(
-        cache_dir
-    )
-    print(
-        f"# compile cache: {details['compile_cache']}", file=sys.stderr
-    )
+    cc = details["compile_cache"]
+    cc["entries_final"] = compile_cache_entries(cache_dir)
+    cc["warm_run"] = entries_before > 0
+    print(f"# compile cache: {cc}", file=sys.stderr)
     _write_details(details)
+    # populated-and-reused contract: with the cache enabled this run
+    # compiled (or deserialized) dozens of checker programs — a zero
+    # entry count means the cache is silently unwired again (the
+    # BENCH_r05 regression this section exists to prevent).  Asserted
+    # after the details write so the evidence survives the failure.
+    if cache_dir is not None:
+        assert cc["entries_final"] > 0, (
+            f"compile cache at {cache_dir} still empty after a full "
+            f"bench run — the persistent cache is unwired"
+        )
+        if cc["warm_run"]:
+            assert cc["entries_final"] >= entries_before, (
+                "warm-run cache shrank: "
+                f"{entries_before} -> {cc['entries_final']}"
+            )
 
     if backend == "tpu":
         _capture_multichip_if_present()

@@ -367,6 +367,15 @@ class MiniAmqpBroker:
             self._server.close()
         except OSError:
             pass
+        # unblock a pending accept(): on Linux, close() does not
+        # interrupt a thread already blocked in accept() — the in-flight
+        # syscall keeps the LISTEN socket alive, so the port would stay
+        # bound (un-rebindable by an in-process restart) until the next
+        # stray connection happened along
+        try:
+            socket.create_connection(("127.0.0.1", self.port), 0.2).close()
+        except OSError:
+            pass
         with self.state_lock:
             conns = list(self._conns)
         for c in conns:
@@ -1032,7 +1041,27 @@ class MiniAmqpBroker:
     def _handle_get(self, conn: _ConnState, ch: int, qname: str,
                     no_ack: bool = False):
         if self.replication is not None:
-            rmsg = self.replication.dequeue(qname, conn.owner)
+            status, rmsg = self.replication.dequeue_get(qname, conn.owner)
+            if status == "noquorum":
+                # the DEQ never committed: the queue's true state is
+                # UNKNOWN.  Answering Basic.Get-Empty here LIED — the r7
+                # soak's acked-loss signature was the final drain running
+                # through an election/partition window, every get
+                # answered "empty" without quorum, and hundreds of
+                # committed-ready messages counted lost.  Close the
+                # channel loudly instead (the native client marks the
+                # connection broken; the drain marks the pass dirty and
+                # retries after the settle sleep).
+                self._send_method(
+                    conn,
+                    ch,
+                    20,
+                    40,
+                    struct.pack(">H", 541)  # internal-error
+                    + _shortstr("basic.get lost quorum (state unknown)")
+                    + struct.pack(">HH", 60, 70),
+                )
+                return
             if rmsg is None:
                 self._send_method(conn, ch, 60, 72, _shortstr(""))
                 return

@@ -478,6 +478,17 @@ class RaftNode:
             self._server.close()
         except OSError:
             pass
+        # unblock a pending accept(): on Linux, close() does not
+        # interrupt a thread already blocked in accept() — the in-flight
+        # syscall keeps the LISTEN socket alive, so the port would stay
+        # bound (un-rebindable by an in-process restart) until the next
+        # stray peer RPC happened along
+        try:
+            socket.create_connection(
+                ("127.0.0.1", self.port), 0.2
+            ).close()
+        except OSError:
+            pass
         with self.lock:
             if self._wal_fh is not None:
                 try:
@@ -526,6 +537,11 @@ class RaftNode:
             # and records written after a leftover partial line would be
             # unreadable by the next recovery (fsync'd yet lost)
             if good < os.path.getsize(wal_p):
+                logger.warning(
+                    "raft %s WAL recovery: dropping %d torn tail bytes "
+                    "(recovered %d entries)",
+                    self.name, os.path.getsize(wal_p) - good, len(self.log),
+                )
                 with open(wal_p, "rb+") as fh:
                     fh.truncate(good)
                     fh.flush()
@@ -1022,6 +1038,18 @@ class RaftNode:
                     if self.log[idx - 1][0] != t:
                         # conflict: truncate ours from idx on (losing any
                         # uncommitted divergence — the seeded bug's window)
+                        if idx <= self.commit_idx:
+                            # tripwire: this must be impossible (Raft
+                            # safety — committed entries never truncate);
+                            # if it ever fires, a confirmed-write loss is
+                            # in progress and THIS is the smoking gun
+                            logger.critical(
+                                "raft %s SAFETY VIOLATION: truncating "
+                                "COMMITTED entries [%d..%d] (commit_idx="
+                                "%d) on append from %s term %d",
+                                self.name, idx, len(self.log),
+                                self.commit_idx, msg["from"], msg["term"],
+                            )
                         del self.log[idx - 1 :]
                         self._fail_waiters_from(idx)
                         self.log.append((t, op))
@@ -1579,6 +1607,19 @@ class ReplicatedBackend:
         return ok
 
     def dequeue(self, q: str, owner: str) -> _RMsg | None:
+        """Pop one message (committed DEQ).  ``None`` conflates
+        committed-empty with no-quorum — fine for the push loops (a miss
+        is retried on the next kick), NOT for ``basic.get``'s wire
+        answer: use :meth:`dequeue_get` where the caller must
+        distinguish (the r7 drain loss rode exactly that conflation)."""
+        return self.dequeue_get(q, owner)[1]
+
+    def dequeue_get(self, q: str, owner: str) -> tuple[str, _RMsg | None]:
+        """``("ok", msg)``, ``("empty", None)`` — a COMMITTED DEQ found
+        the queue empty: the authoritative get-empty answer — or
+        ``("noquorum", None)``: no commit happened (no leader, lost
+        quorum, timeout); the queue's true state is UNKNOWN and the
+        caller must not report empty."""
         ok, msg = self.raft.submit(
             {
                 "k": "deq",
@@ -1588,7 +1629,9 @@ class ReplicatedBackend:
             },
             timeout_s=self.submit_timeout_s,
         )
-        return msg if ok else None
+        if not ok:
+            return "noquorum", None
+        return ("ok", msg) if msg is not None else ("empty", None)
 
     def settle(self, owner: str, mid: str) -> None:
         self.raft.submit(

@@ -123,8 +123,37 @@ def _load() -> ctypes.CDLL | None:
         lib.jt_elle_mops_free.argtypes = [ctypes.POINTER(_JtElleMopsResult)]
     except AttributeError:
         pass
+    try:  # thread-pool multi-file entry points (pipeline host stage);
+        # absent from a stale build: callers fall back to per-file calls
+        for name, res in (
+            ("jt_pack_files", _JtPackResult),
+            ("jt_stream_rows_files", _JtStreamResult),
+            ("jt_elle_mops_files", _JtElleMopsResult),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.POINTER(ctypes.POINTER(res))
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+        lib.jt_files_free.restype = None
+        lib.jt_files_free.argtypes = [ctypes.c_void_p]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
+
+
+def _conv_pack(r) -> tuple[str, np.ndarray] | None:
+    if r.err != 0:
+        return None
+    n = int(r.n_rows)
+    if n == 0:
+        rows = np.zeros((0, 8), np.int32)
+    else:
+        rows = np.ctypeslib.as_array(r.rows, shape=(n, 8)).copy()
+    return _WORKLOADS[r.workload], rows
 
 
 def pack_file(jsonl_path: str | Path) -> tuple[str, np.ndarray] | None:
@@ -140,15 +169,7 @@ def pack_file(jsonl_path: str | Path) -> tuple[str, np.ndarray] | None:
     if not res:
         return None
     try:
-        r = res.contents
-        if r.err != 0:
-            return None
-        n = int(r.n_rows)
-        if n == 0:
-            rows = np.zeros((0, 8), np.int32)
-        else:
-            rows = np.ctypeslib.as_array(r.rows, shape=(n, 8)).copy()
-        return _WORKLOADS[r.workload], rows
+        return _conv_pack(res.contents)
     finally:
         lib.jt_pack_free(res)
 
@@ -225,26 +246,29 @@ def elle_mops_file(jsonl_path: str | Path):
     if not res:
         return None
     try:
-        r = res.contents
-        if r.err != 0:
-            return None
-        from jepsen_tpu.checkers.elle import MOP_COLUMNS, ElleMopsMeta
-
-        n = int(r.n_cells)
-        w = len(MOP_COLUMNS)
-        if n == 0:
-            mat = np.zeros((0, w), np.int32)
-        else:
-            mat = np.ctypeslib.as_array(r.cells, shape=(n, w)).copy()
-        meta = ElleMopsMeta(
-            n_txns=int(r.n_txns),
-            txn_index=[int(r.txn_index[i]) for i in range(int(r.n_txns))],
-            keys=[int(r.keys[i]) for i in range(int(r.n_keys))],
-            degenerate=bool(r.degenerate),
-        )
-        return mat, meta
+        return _conv_mops(res.contents)
     finally:
         lib.jt_elle_mops_free(res)
+
+
+def _conv_mops(r):
+    if r.err != 0:
+        return None
+    from jepsen_tpu.checkers.elle import MOP_COLUMNS, ElleMopsMeta
+
+    n = int(r.n_cells)
+    w = len(MOP_COLUMNS)
+    if n == 0:
+        mat = np.zeros((0, w), np.int32)
+    else:
+        mat = np.ctypeslib.as_array(r.cells, shape=(n, w)).copy()
+    meta = ElleMopsMeta(
+        n_txns=int(r.n_txns),
+        txn_index=[int(r.txn_index[i]) for i in range(int(r.n_txns))],
+        keys=[int(r.keys[i]) for i in range(int(r.n_keys))],
+        degenerate=bool(r.degenerate),
+    )
+    return mat, meta
 
 
 def stream_rows_file(
@@ -261,11 +285,86 @@ def stream_rows_file(
     if not res:
         return None
     try:
-        r = res.contents
-        if r.err != 0:
-            return None
-        n = int(r.n_rows)
-        cols = np.ctypeslib.as_array(r.cols, shape=(n, 6)).copy()
-        return cols, bool(r.full_read)
+        return _conv_stream(res.contents)
     finally:
         lib.jt_stream_free(res)
+
+
+def _conv_stream(r) -> tuple[np.ndarray, bool] | None:
+    if r.err != 0:
+        return None
+    n = int(r.n_rows)
+    cols = np.ctypeslib.as_array(r.cols, shape=(n, 6)).copy()
+    return cols, bool(r.full_read)
+
+
+# ---------------------------------------------------------------------------
+# Thread-pool multi-file entry points (the pipeline executor's host
+# stage): one native call packs a whole chunk of files concurrently —
+# the GIL is released for the entire batch, so the pipeline's producer
+# thread genuinely overlaps with device dispatch on the main thread.
+# ---------------------------------------------------------------------------
+
+
+def _files_multi(paths, fn_name: str, free_name: str, conv, threads: int):
+    """Shared multi-file driver: returns a list aligned with ``paths``
+    (``None`` entries where that file must fall back to the Python
+    twin), or ``None`` when the native multi-file path is unavailable
+    entirely (no library / stale build / escape hatch)."""
+    import os
+
+    if os.environ.get("JEPSEN_TPU_NO_FASTPACK"):
+        return None
+    lib = _load()
+    if (
+        lib is None
+        or not hasattr(lib, fn_name)
+        or not hasattr(lib, "jt_files_free")
+    ):
+        return None
+    out: list = [None] * len(paths)
+    idx = [i for i, p in enumerate(paths) if Path(p).suffix != ".edn"]
+    if not idx:
+        return out
+    arr = (ctypes.c_char_p * len(idx))(
+        *[str(Path(paths[i])).encode() for i in idx]
+    )
+    res = getattr(lib, fn_name)(arr, len(idx), int(threads))
+    if not res:
+        return out
+    free_one = getattr(lib, free_name)
+    try:
+        for j, i in enumerate(idx):
+            r = res[j]
+            if r:
+                try:
+                    out[i] = conv(r.contents)
+                finally:
+                    free_one(r)
+    finally:
+        lib.jt_files_free(res)
+    return out
+
+
+def pack_files(paths, threads: int = 0):
+    """Multi-file ``pack_file``: ``[(workload, rows) | None, ...]``
+    aligned with ``paths``, or None when the native path is unavailable."""
+    return _files_multi(
+        paths, "jt_pack_files", "jt_pack_free", _conv_pack, threads
+    )
+
+
+def stream_rows_files(paths, threads: int = 0):
+    """Multi-file ``stream_rows_file``: ``[(cols, full) | None, ...]``."""
+    return _files_multi(
+        paths, "jt_stream_rows_files", "jt_stream_free", _conv_stream,
+        threads,
+    )
+
+
+def elle_mops_files(paths, threads: int = 0):
+    """Multi-file ``elle_mops_file``: ``[(mat, meta) | None, ...]``."""
+    return _files_multi(
+        paths, "jt_elle_mops_files", "jt_elle_mops_free", _conv_mops,
+        threads,
+    )

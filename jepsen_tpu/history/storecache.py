@@ -212,6 +212,110 @@ def elle_mops_with_cache(jsonl_path: str | Path, history=None):
     return mat, meta, False
 
 
+# ---------------------------------------------------------------------------
+# Stream exploded-row cache (per run dir, like elle_mops.npz): one
+# history's ``[n, 6]`` column matrix + full-read flag — the substrate of
+# the stream tensor check (``stream_lin._stream_rows`` / the native
+# ``jt_stream_rows_file``), digest-keyed with the same stat fast path so
+# repeat ``check``/``bench-check`` runs skip the JSONL parse entirely.
+# ---------------------------------------------------------------------------
+
+STREAM_ROWS_CACHE = "stream_rows.npz"
+
+
+def stream_rows_cache_path(jsonl_path: str | Path) -> Path:
+    return Path(jsonl_path).with_name(STREAM_ROWS_CACHE)
+
+
+def save_stream_rows_cache(jsonl_path: str | Path, cols, full: bool) -> None:
+    """Persist one stream history's exploded columns next to its JSONL,
+    stamped like the packed-row cache.  Atomic and best-effort."""
+    from jepsen_tpu.history.rows import _history_digest
+
+    jsonl_path = Path(jsonl_path)
+    target = stream_rows_cache_path(jsonl_path)
+    tmp = target.with_name(f"{STREAM_ROWS_CACHE}.{os.getpid()}.tmp")
+    try:
+        st = os.stat(jsonl_path)
+        stamp = np.array(
+            [
+                _history_digest(jsonl_path),
+                str(st.st_size),
+                str(st.st_mtime_ns),
+            ]
+        )
+        with open(tmp, "wb") as fh:
+            np.savez(
+                fh,
+                stamp=stamp,
+                cols=np.asarray(cols, np.int32),
+                full=np.int64(1 if full else 0),
+            )
+        os.replace(tmp, target)
+    except (OSError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_stream_rows_cache(jsonl_path: str | Path):
+    """``(cols, full)`` when a fresh cache exists; None when absent,
+    unreadable, or stale (same two-tier freshness as the other caches)."""
+    from jepsen_tpu.history.rows import _history_digest
+
+    jsonl_path = Path(jsonl_path)
+    target = stream_rows_cache_path(jsonl_path)
+    try:
+        cache_mtime = os.stat(target).st_mtime_ns
+        with np.load(target, allow_pickle=False) as z:
+            stamp = [str(x) for x in z["stamp"]]
+            cols = np.asarray(z["cols"], np.int32)
+            full = bool(int(z["full"]))
+    except (OSError, ValueError, KeyError):
+        return None
+    if len(stamp) != 3 or cols.ndim != 2 or cols.shape[1] != 6:
+        return None
+    digest, size, mtime_ns = stamp
+    try:
+        st = os.stat(jsonl_path)
+    except OSError:
+        return None
+    if (
+        str(st.st_size) == size
+        and str(st.st_mtime_ns) == mtime_ns
+        and cache_mtime > st.st_mtime_ns
+    ):
+        return cols, full
+    if digest != _history_digest(jsonl_path):
+        return None
+    return cols, full
+
+
+def stream_rows_with_cache(jsonl_path: str | Path, history=None):
+    """Load-through stream-row cache: ``(cols, full, was_hit)``.  A miss
+    takes the native explosion (``jt_stream_rows_file``) when available,
+    else the Python twin, and leaves the cache behind for the next
+    check.  Pass ``history`` when the caller already parsed the ops."""
+    cached = load_stream_rows_cache(jsonl_path)
+    if cached is not None:
+        return (*cached, True)
+    got = None
+    if history is None:
+        from jepsen_tpu.history.fastpack import stream_rows_file
+
+        got = stream_rows_file(jsonl_path)
+    if got is None:
+        from jepsen_tpu.checkers.stream_lin import _stream_rows
+        from jepsen_tpu.history.store import read_history
+
+        if history is None:
+            history = read_history(jsonl_path)
+        got = _stream_rows(history)
+    save_stream_rows_cache(jsonl_path, got[0], got[1])
+    return got[0], got[1], False
+
+
 def load_packed_store_cache(
     store_root: str | Path, paths: Sequence[str | Path]
 ):

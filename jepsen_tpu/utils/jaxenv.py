@@ -87,7 +87,36 @@ def pin_cpu_platform() -> None:
 COMPILE_CACHE_ENV = "JEPSEN_TPU_COMPILE_CACHE"
 
 
-def enable_compilation_cache(cache_dir: str) -> str | None:
+def _cpu_cache_fingerprint() -> str:
+    """Short machine-feature fingerprint for the CPU cache subdir.
+
+    The CPU AOT loader refuses cached executables compiled under a
+    different machine-feature set (observed on this host's lineage:
+    "+prefer-no-scatter is not supported", with a SIGILL warning) — so
+    CPU cache entries must never be shared across hosts with different
+    CPU flags.  Keying the subdirectory by (arch, cpu-flags) hash makes
+    drift produce a fresh empty cache instead of load noise."""
+    import hashlib
+    import platform
+
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    flags = line
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(
+        (platform.machine() + "\x00" + flags).encode()
+    ).hexdigest()
+    return h[:8]
+
+
+def enable_compilation_cache(
+    cache_dir: str, backend: str | None = None
+) -> str | None:
     """Point XLA's persistent compilation cache at ``cache_dir``.
 
     The WGL engine's while_loop-in-scan nest costs 20–66 s of XLA compile
@@ -95,19 +124,28 @@ def enable_compilation_cache(cache_dir: str) -> str | None:
     (``WGL_BENCH.md``, ``BENCH_DETAILS.json`` wgl_hard) — and without a
     persistent cache every new process re-pays it, evaporating the tensor
     engine's hard-history win on first use (VERDICT r4 weak #4).  Called
-    by the CLI, the bench, and the checker sidecar with a directory under
-    the store — each only once the backend is known to be TPU: the CPU
-    AOT loader refuses cached executables over machine-feature hash
-    drift (observed on this very host: "+prefer-no-scatter is not
-    supported", with a SIGILL warning), so a CPU-backend cache is all
-    noise and risk for a compile that only costs seconds anyway.
-    Returns the effective directory, or ``None`` when disabled via env
-    or the directory is unusable (the caller proceeds uncached — a
-    missing cache must never sink a run)."""
+    by the CLI ``check``/``bench-check`` paths, the bench, and the
+    checker sidecar with a directory under the store.
+
+    ``backend="cpu"`` (or any non-TPU backend) redirects into a
+    machine-fingerprinted subdirectory (``<dir>/cpu-<fp>``): CPU cache
+    entries are valid only under the exact machine-feature set that
+    compiled them (see :func:`_cpu_cache_fingerprint`), and the TPU
+    cache layout at the directory root must stay byte-compatible with
+    every earlier round's ``store/xla_cache``.  Returns the effective
+    directory, or ``None`` when disabled via env or the directory is
+    unusable (the caller proceeds uncached — a missing cache must never
+    sink a run)."""
     env = os.environ.get(COMPILE_CACHE_ENV)
     if env is not None and env.lower() in ("0", "off", "none", ""):
         return None
     d = env or cache_dir
+    if backend is not None and backend != "tpu":
+        # fingerprinted even under the env override: a shared override
+        # dir across hosts with different CPU flags would otherwise
+        # reintroduce the exact AOT machine-feature-drift noise the
+        # fingerprint exists to prevent
+        d = os.path.join(d, f"{backend}-{_cpu_cache_fingerprint()}")
     try:
         os.makedirs(d, exist_ok=True)
         import jax

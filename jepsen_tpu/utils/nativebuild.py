@@ -15,15 +15,52 @@ from __future__ import annotations
 from pathlib import Path
 
 
+#: sources each library target actually depends on (mirrors the
+#: Makefile's rules) — staleness against unrelated sources would mark a
+#: lib permanently stale, since `make <lib>` never rebuilds it for them
+#: and so never refreshes its mtime
+_TARGET_DEPS = {
+    "librows_packer.so": ("rows_packer.cpp",),
+    "libamqp_driver.so": ("amqp_driver.cpp", "amqp_wire.hpp"),
+}
+
+
+def _stale(lib: Path) -> bool:
+    """True when a source ``lib``'s make rule depends on is newer than
+    it (unknown libs: any native source beside it)."""
+    try:
+        built = lib.stat().st_mtime_ns
+        deps = _TARGET_DEPS.get(lib.name)
+        if deps is not None:
+            srcs = [lib.parent / d for d in deps]
+        else:
+            srcs = [
+                src
+                for pat in ("*.cpp", "*.hpp", "*.c")
+                for src in lib.parent.glob(pat)
+            ]
+        return any(
+            src.exists() and src.stat().st_mtime_ns > built
+            for src in srcs
+        )
+    except OSError:
+        return False
+
+
 def ensure_built(
     lib_path: Path, target: str | None = None, timeout: float = 120.0
 ) -> str:
     """Build ``lib_path`` via ``make -C <dir> [target]`` if absent.
 
     Returns an empty string on success (or when the file already
-    exists), else a short build-error description.  Never raises."""
+    exists and is current), else a short build-error description.
+    Never raises.  A lib older than any ``.cpp``/``.hpp``/``.c``
+    source beside it is STALE (e.g. a binding grew a new entry point
+    since the last build) and rebuilds — make itself no-ops when the
+    timestamps say otherwise, so a current lib never pays more than
+    the stat."""
     p = Path(lib_path)
-    if p.exists():
+    if p.exists() and not _stale(p):
         return ""
     import fcntl
     import subprocess

@@ -1710,20 +1710,33 @@ long drain_impl(Client* self, int32_t* out, long cap) {
   // un-acked delivery, but those requeues land asynchronously (on a
   // replicated broker they are quorum commits) — a single pass that
   // happens to observe get-empty before a late requeue would leave
-  // committed messages behind and read as loss.  Repeat until a FULL
-  // pass over every host drains nothing new (settle sleep between
+  // committed messages behind and read as loss.  Repeat until a CLEAN
+  // full pass over every host drains nothing new (settle sleep between
   // passes), bounded so a live publisher can't spin us forever.
+  //
+  // CLEAN matters (the r7 soak's acked-loss signature: a large block of
+  // confirmed values "lost" while actually still READY cluster-wide):
+  // basic_get answers 0 only on an authoritative get-empty from the
+  // broker; -1 is a TIMEOUT (e.g. the cluster mid-election cannot
+  // commit the DEQ) and -2 a broken connection.  The old quiet-pass
+  // exit counted those exactly like get-empty, so a pass that never
+  // reached quorum on any node — trivially "drained nothing new" —
+  // ended the drain with committed messages still queued, and the
+  // checker read them as lost.  A pass now only ends the drain when it
+  // is quiet AND every host answered authoritatively.
   std::vector<int32_t> values;
-  for (int pass = 0; pass < 4; ++pass) {
+  for (int pass = 0; pass < 8; ++pass) {
     if (pass > 0)
       std::this_thread::sleep_for(milliseconds(g_drain_wait_ms));
     size_t before = values.size();
+    bool dirty = false;  // any unreachable host / timed-out / broken get
     for (const auto& host : hosts) {
       auto hp = split_host_port(host, self->config().port);
       Connection conn(hp.first, hp.second, self->config().user,
                       self->config().pass);
       if (!conn.open(5000)) {
         logf("drain: cannot connect to %s", host.c_str());
+        dirty = true;
         continue;
       }
       std::vector<std::string> queues = {QUEUE_NAME};
@@ -1733,14 +1746,22 @@ long drain_impl(Client* self, int32_t* out, long cap) {
           int32_t value;
           uint64_t tag;
           int r = conn.basic_get(q, &value, &tag, 5000);
-          if (r != 1) break;
-          conn.basic_ack(tag);
-          values.push_back(value);
+          if (r == 1) {
+            conn.basic_ack(tag);
+            values.push_back(value);
+            continue;
+          }
+          if (r != 0) {
+            logf("drain: get on %s gave %d (not an authoritative "
+                 "empty) — pass stays dirty", host.c_str(), r);
+            dirty = true;
+          }
+          break;
         }
       }
       conn.close();
     }
-    if (pass > 0 && values.size() == before) break;  // quiet full pass
+    if (pass > 0 && values.size() == before && !dirty) break;
   }
   {
     std::lock_guard<std::mutex> lk(g_registry_mu);

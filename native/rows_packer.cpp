@@ -35,6 +35,7 @@
 // histories tensorizable (Utils.java:443,496,532,584).
 
 #include <algorithm>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstdio>
@@ -42,6 +43,7 @@
 #include <cstring>
 #include <set>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <unordered_set>
 #include <utility>
@@ -1431,5 +1433,78 @@ void jt_stream_free(JtStreamResult* r) {
   std::free(r->cols);
   std::free(r);
 }
+
+// ---------------------------------------------------------------------------
+// Thread-pool multi-file packing (the pipeline executor's host stage):
+// K history shards packed concurrently, one result slot per input path in
+// a preallocated arena (the returned pointer array).  Workers claim paths
+// off an atomic cursor and run the existing single-file entry points, so
+// the per-file semantics (and their differential contracts) are shared
+// byte-for-byte with the serial path.  The ctypes caller holds the GIL
+// released for the whole batch, which is what buys real host/device
+// overlap on the Python side.  Elements are freed with the per-kind
+// jt_*_free; the arena itself with jt_files_free.  A slot is NULL only
+// when its result allocation itself failed (caller falls back per-file).
+// ---------------------------------------------------------------------------
+
+}  // extern "C"
+
+namespace {
+
+template <typename R, R* (*ONE)(const char*)>
+void** pack_files_pool(const char* const* paths, int32_t n,
+                       int32_t threads) {
+  if (n < 0) return nullptr;
+  auto** out = static_cast<void**>(std::calloc(
+      static_cast<size_t>(n) + 1, sizeof(void*)));
+  if (!out) return nullptr;
+  int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
+  if (threads <= 0) threads = hw > 0 ? hw : 2;
+  if (threads > n) threads = n;
+  if (threads <= 1) {
+    for (int32_t i = 0; i < n; ++i) out[i] = ONE(paths[i]);
+    return out;
+  }
+  std::atomic<int32_t> cursor{0};
+  auto worker = [&]() {
+    while (true) {
+      int32_t i = cursor.fetch_add(1, std::memory_order_relaxed);
+      if (i >= n) return;
+      out[i] = ONE(paths[i]);
+    }
+  };
+  std::vector<std::thread> pool;
+  pool.reserve(static_cast<size_t>(threads));
+  for (int32_t t = 0; t < threads; ++t) pool.emplace_back(worker);
+  for (auto& th : pool) th.join();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+JtPackResult** jt_pack_files(const char* const* paths, int32_t n,
+                             int32_t threads) {
+  return reinterpret_cast<JtPackResult**>(
+      pack_files_pool<JtPackResult, jt_pack_file>(paths, n, threads));
+}
+
+JtStreamResult** jt_stream_rows_files(const char* const* paths, int32_t n,
+                                      int32_t threads) {
+  return reinterpret_cast<JtStreamResult**>(
+      pack_files_pool<JtStreamResult, jt_stream_rows_file>(
+          paths, n, threads));
+}
+
+JtElleMopsResult** jt_elle_mops_files(const char* const* paths, int32_t n,
+                                      int32_t threads) {
+  return reinterpret_cast<JtElleMopsResult**>(
+      pack_files_pool<JtElleMopsResult, jt_elle_mops_file>(
+          paths, n, threads));
+}
+
+// frees only the pointer arena — elements are freed by jt_*_free
+void jt_files_free(void** arr) { std::free(arr); }
 
 }  // extern "C"

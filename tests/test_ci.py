@@ -281,6 +281,150 @@ class TestBenchElleSmoke:
         assert "device_histories_per_sec" not in m
 
 
+class TestBenchPipelineSmoke:
+    """Offline gate for the pipeline-utilization bench keys: the stream
+    section (tiny shapes) must report the measured bytes-to-verdict
+    executor keys next to the classic device/e2e rows, and the queue
+    pipeline section must do the same — schema regressions fail here,
+    not on a chip window."""
+
+    PIPELINE_KEYS = (
+        "pipeline_e2e_histories_per_sec",
+        "stage_overlap_frac",
+        "device_idle_frac",
+        "pipeline_e2e_vs_device_only",
+        "pipeline_e2e_vs_async_device",
+    )
+
+    @pytest.fixture()
+    def bench(self, monkeypatch):
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        import bench as bench_mod
+
+        monkeypatch.setattr(bench_mod, "BLOCKS", 1)
+        monkeypatch.setattr(bench_mod, "BLOCK_ITERS", 2)
+        monkeypatch.setattr(bench_mod, "CPU_BASELINE_SAMPLES", 2)
+        return bench_mod
+
+    def test_stream_section_reports_pipeline_keys(self, bench):
+        details = {}
+        bench._bench_stream_sized(
+            details, "stream", n_ops=40, batch=16, blocks=1,
+            base_n=8, cpu_samples=2,
+        )
+        e = details["stream"]
+        for key in self.PIPELINE_KEYS:
+            assert key in e, f"stream bench schema lost key {key!r}"
+        assert e["pipeline_e2e_histories_per_sec"] > 0
+        assert 0.0 <= e["device_idle_frac"] <= 1.0
+        assert 0.0 <= e["stage_overlap_frac"] <= 1.0
+        # the occupancy ratio is 1 - device_idle_frac by construction
+        assert abs(
+            e["pipeline_e2e_vs_device_only"]
+            - (1.0 - e["device_idle_frac"])
+        ) < 5e-3
+        # classic keys must survive alongside
+        assert "end_to_end_histories_per_sec" in e
+
+    def test_queue_pipeline_section(self, bench, monkeypatch):
+        monkeypatch.setattr(bench, "BASE_HISTORIES", 8)
+        monkeypatch.setattr(bench, "N_OPS", 40)
+        details = {"queue": {"device_histories_per_sec": 100.0}}
+        bench._bench_queue_pipeline(details)
+        for key in self.PIPELINE_KEYS:
+            assert key in details["queue"], key
+        assert details["queue"]["pipeline_e2e_histories_per_sec"] > 0
+
+
+class TestCompileCacheRoundTrip:
+    """The persistent XLA compile cache, offline: a first (cold) process
+    must POPULATE the store cache dir, a second (warm) process must find
+    it non-empty and not shrink it — the BENCH_r05 `compile cache:
+    entries 0` regression gate, CPU backend, no network."""
+
+    SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from jepsen_tpu.utils.jaxenv import (
+    compile_cache_entries, enable_compilation_cache, pin_cpu_platform,
+)
+pin_cpu_platform()
+d = enable_compilation_cache({cache!r}, backend="cpu")
+assert d is not None, "cache dir unusable"
+import jax
+# cache even instant compiles for this probe
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+import jax.numpy as jnp
+import numpy as np
+before = compile_cache_entries(d)
+f = jax.jit(lambda x: jnp.cumsum(x * 2) - jnp.sort(x))
+jax.block_until_ready(f(jnp.arange(512)))
+after = compile_cache_entries(d)
+print(f"CACHE {{d}} {{before}} {{after}}")
+"""
+
+    def _run(self, cache_dir):
+        import subprocess
+        import sys as _sys
+
+        r = subprocess.run(
+            [
+                _sys.executable,
+                "-c",
+                self.SCRIPT.format(repo=str(REPO), cache=str(cache_dir)),
+            ],
+            capture_output=True,
+            text=True,
+            env={
+                **__import__("os").environ,
+                "JAX_PLATFORMS": "cpu",
+            },
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+        line = [
+            ln for ln in r.stdout.splitlines() if ln.startswith("CACHE ")
+        ][-1]
+        _tag, d, before, after = line.rsplit(" ", 3)
+        return d.split(" ", 1)[-1], int(before), int(after)
+
+    def test_cold_populates_then_warm_reuses(self, tmp_path):
+        cache = tmp_path / "xla_cache"
+        d1, before1, after1 = self._run(cache)
+        assert before1 == 0 and after1 > 0, (
+            f"cold run never populated the cache ({before1}->{after1})"
+        )
+        d2, before2, after2 = self._run(cache)
+        assert d2 == d1
+        # the warm-run contract the bench now asserts: entries_after is
+        # NON-ZERO on a second warm run, and the same program adds no
+        # new entry (XLA deserialized the existing executable)
+        assert before2 == after1 > 0
+        assert after2 == after1, (
+            f"warm run recompiled: {after1} -> {after2} entries"
+        )
+
+    def test_cpu_cache_is_machine_fingerprinted(self, tmp_path):
+        from jepsen_tpu.utils.jaxenv import _cpu_cache_fingerprint
+
+        _d, _b, _a = self._run(tmp_path / "xla_cache")
+        sub = (
+            tmp_path / "xla_cache" / f"cpu-{_cpu_cache_fingerprint()}"
+        )
+        assert sub.is_dir(), (
+            "CPU-backend cache entries must land in the fingerprinted "
+            "subdirectory, never the TPU root layout"
+        )
+
+
 class TestHclGate:
     """Offline HCL syntax gate (VERDICT r5 #7): the terraform files have
     never been parsed by any terraform binary in this image — the fake-

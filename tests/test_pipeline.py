@@ -1,0 +1,415 @@
+"""Differential contract of the pipelined bytes-to-verdict executor.
+
+``parallel/pipeline.py`` must produce verdicts IDENTICAL to the serial
+checker paths for every family — queue (both sub-verdicts), stream
+(short and 10k-op), elle (including degenerate-history host-fallback
+splices) — from history FILES, pipelined and strictly serial, warm and
+cold caches.  Plus the crash contract: a stage failure aborts the whole
+run with ``PipelineError`` and NO verdict escapes for any batch.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.elle import check_elle_cpu
+from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+from jepsen_tpu.history.store import write_history_jsonl
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_elle_batch,
+    synth_stream_batch,
+)
+from jepsen_tpu.parallel.pipeline import (
+    PipelineError,
+    PipelineStats,
+    check_sources,
+    run_pipeline,
+)
+
+
+def _write(tmp_path, base):
+    files = []
+    for i, sh in enumerate(base):
+        p = tmp_path / f"h{i:03d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+@pytest.fixture(scope="module")
+def stream_corpus(tmp_path_factory):
+    base = synth_stream_batch(
+        14,
+        StreamSynthSpec(n_ops=40),
+        lost=2,
+        duplicated=1,
+        divergent=1,
+        reorder=1,
+        recovered=1,
+    )
+    td = tmp_path_factory.mktemp("stream")
+    return base, _write(td, base)
+
+
+@pytest.fixture(scope="module")
+def queue_corpus(tmp_path_factory):
+    base = synth_batch(
+        12, SynthSpec(n_ops=50), lost=1, duplicated=1, unexpected=1
+    )
+    td = tmp_path_factory.mktemp("queue")
+    return base, _write(td, base)
+
+
+class TestStreamDifferential:
+    def test_pipeline_equals_serial_equals_cpu(self, stream_corpus):
+        base, files = stream_corpus
+        piped, _ = check_sources("stream", files, chunk=4, depth=2)
+        serial, _ = check_sources("stream", files, chunk=4, serial=True)
+        assert piped == serial, "pipelined verdicts diverged from serial"
+        for r, sh in zip(piped, base):
+            cpu = check_stream_lin_cpu(sh.ops)
+            assert r["stream"]["valid?"] == cpu["valid?"]
+            for k in ("lost", "duplicate", "phantom", "divergent"):
+                assert r["stream"][k] == cpu[k], k
+
+    def test_warm_cache_run_identical(self, stream_corpus):
+        """Second run hits the stream_rows.npz digest cache; verdicts
+        must be byte-identical to the cold run."""
+        _base, files = stream_corpus
+        cold, _ = check_sources("stream", files, chunk=8, use_cache=True)
+        warm, _ = check_sources("stream", files, chunk=8, use_cache=True)
+        assert cold == warm
+        from jepsen_tpu.history.storecache import stream_rows_cache_path
+
+        assert stream_rows_cache_path(files[0]).exists()
+
+    def test_long_histories_chunked(self, tmp_path):
+        """The stream_10k shape (longer rows, several chunks, tail chunk
+        shorter than the pad) through the executor."""
+        base = synth_stream_batch(5, StreamSynthSpec(n_ops=400), lost=1)
+        files = _write(tmp_path, base)
+        piped, stats = check_sources("stream", files, chunk=2)
+        assert stats.histories == 5 and stats.batches == 3
+        for r, sh in zip(piped, base):
+            assert (
+                r["stream"]["valid?"]
+                == check_stream_lin_cpu(sh.ops)["valid?"]
+            )
+
+
+class TestQueueDifferential:
+    def test_pipeline_equals_serial_equals_cpu(self, queue_corpus):
+        base, files = queue_corpus
+        piped, _ = check_sources("queue", files, chunk=5)
+        serial, _ = check_sources("queue", files, chunk=5, serial=True)
+        assert piped == serial
+        for r, sh in zip(piped, base):
+            tq = check_total_queue_cpu(sh.ops)
+            ql = check_queue_lin_cpu(sh.ops)
+            assert r["queue"]["valid?"] == tq["valid?"]
+            assert r["queue"]["lost"] == tq["lost"]
+            assert r["linear"]["valid?"] == ql["valid?"]
+
+    def test_result_keys_match_serial_class_path(self, queue_corpus):
+        """Byte-identical to the SERIAL checker classes, including the
+        recorded contract level: `linear.delivery` feeds a later bare
+        re-check's no-silent-tightening inheritance (cmd_check)."""
+        from jepsen_tpu.checkers.queue_lin import check_queue_lin_batch
+        from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+
+        base, files = queue_corpus
+        piped, _ = check_sources("queue", files, chunk=4)
+        ql = check_queue_lin_batch([sh.ops for sh in base])
+        for r, serial_lin, sh in zip(piped, ql, base):
+            assert r["linear"] == serial_lin
+            tq = check_total_queue_cpu(sh.ops)
+            for k in ("valid?", "lost", "duplicated", "unexpected"):
+                assert r["queue"][k] == tq[k], k
+
+    def test_delivery_contract_threads_through(self, queue_corpus):
+        base, files = queue_corpus
+        alo, _ = check_sources(
+            "queue", files, chunk=6, delivery="at-least-once"
+        )
+        for r, sh in zip(alo, base):
+            assert (
+                r["linear"]["valid?"]
+                == check_queue_lin_cpu(sh.ops, delivery="at-least-once")[
+                    "valid?"
+                ]
+            )
+
+
+class TestElleDifferential:
+    def test_pipeline_equals_serial_equals_cpu_with_degenerates(
+        self, tmp_path
+    ):
+        """Corpus splicing tensor-checkable and DEGENERATE histories
+        (cross-key phantom collisions — the host-fallback class from the
+        elle device-inference fuzz) through one pipelined run."""
+        from test_fuzz_elle_device import fuzz_history
+
+        from jepsen_tpu.checkers.elle import elle_mops_for
+
+        class _SH:  # _write expects .ops
+            def __init__(self, ops):
+                self.ops = ops
+
+        base = [_SH(fuzz_history(seed, n_txns=12)) for seed in range(8)]
+        degen = [
+            elle_mops_for(sh.ops)[1].degenerate for sh in base
+        ]
+        assert any(degen), "corpus must exercise the degenerate fallback"
+        assert not all(degen), "corpus must exercise the device path too"
+        files = _write(tmp_path, base)
+        piped, _ = check_sources("elle", files, chunk=3)
+        serial, _ = check_sources("elle", files, chunk=3, serial=True)
+        assert piped == serial
+        for r, sh in zip(piped, base):
+            cpu = check_elle_cpu(sh.ops)
+            assert r["elle"]["valid?"] == cpu["valid?"]
+            for k in ("G0", "G1c", "G2", "G1a", "G1b",
+                      "incompatible-order"):
+                assert r["elle"][k] == cpu[k], k
+
+    def test_synthetic_anomalies(self, tmp_path):
+        base = synth_elle_batch(
+            8, ElleSynthSpec(n_txns=10), g1a=1, g1b=1, g2_cycle=1
+        )
+        files = _write(tmp_path, base)
+        piped, _ = check_sources("elle", files, chunk=4)
+        for r, sh in zip(piped, base):
+            assert r["elle"]["valid?"] == check_elle_cpu(sh.ops)["valid?"]
+
+
+class TestCrashContract:
+    def test_produce_crash_emits_no_verdicts(self):
+        """A crash in the host stage of batch k aborts the run with NO
+        results for any batch — earlier chunks' verdicts never escape."""
+        produced = []
+
+        def produce(i):
+            if i == 2:
+                raise RuntimeError("packer exploded")
+            produced.append(i)
+            return np.full((4,), i, np.int32)
+
+        import jax.numpy as jnp
+
+        with pytest.raises(PipelineError, match="produce stage crashed"):
+            run_pipeline(
+                list(range(5)), produce, lambda x: jnp.asarray(x) + 1
+            )
+        assert produced == [0, 1]
+
+    def test_check_crash_emits_no_verdicts(self):
+        def check(x):
+            if int(np.asarray(x)[0]) == 1:
+                raise ValueError("bad batch on device")
+            import jax.numpy as jnp
+
+            return jnp.asarray(x) + 1
+
+        with pytest.raises(PipelineError, match="check stage crashed"):
+            run_pipeline(
+                list(range(4)),
+                lambda i: np.full((2,), i, np.int32),
+                check,
+            )
+
+    def test_unpacked_batch_never_reaches_check(self, tmp_path):
+        """check_sources: a corrupt history file mid-corpus aborts the
+        whole run (no partial verdict list escapes)."""
+        base = synth_stream_batch(4, StreamSynthSpec(n_ops=20))
+        files = _write(tmp_path, base)
+        bad = tmp_path / "h999.jsonl"
+        bad.write_text('{"type": "not a real op"\n')  # torn JSON line
+        with pytest.raises((PipelineError, Exception)):
+            check_sources(
+                "stream", files[:2] + [bad] + files[2:], chunk=2
+            )
+
+    def test_crashed_producer_does_not_wedge(self):
+        """The bounded queue must not deadlock the producer thread when
+        the consumer dies first (abort flag re-checked on full puts)."""
+        import jax.numpy as jnp
+
+        def check(x):
+            raise ValueError("dies immediately")
+
+        with pytest.raises(PipelineError):
+            run_pipeline(
+                list(range(64)),
+                lambda i: np.full((1,), i, np.int32),
+                check,
+                depth=1,
+            )
+
+
+class TestStatsAndMesh:
+    def test_stats_schema(self, stream_corpus):
+        _base, files = stream_corpus
+        _res, stats = check_sources("stream", files, chunk=4)
+        assert isinstance(stats, PipelineStats)
+        assert stats.histories == len(files)
+        assert 0.0 <= stats.stage_overlap_frac <= 1.0
+        assert 0.0 <= stats.device_idle_frac <= 1.0
+        assert stats.wall_s > 0
+
+    @pytest.mark.parametrize("workload", ["stream", "queue", "elle"])
+    def test_mesh_dispatch_matches_single_device(
+        self, cpu_devices, tmp_path, workload
+    ):
+        """The pipeline's mesh placement (parallel/mesh.py sharded
+        dispatch) yields the same verdicts as the default placement."""
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        if workload == "stream":
+            base = synth_stream_batch(6, StreamSynthSpec(n_ops=30), lost=1)
+        elif workload == "queue":
+            base = synth_batch(6, SynthSpec(n_ops=40), lost=1)
+        else:
+            base = synth_elle_batch(6, ElleSynthSpec(n_txns=8), g1a=1)
+        files = _write(tmp_path, base)
+        mesh = checker_mesh(cpu_devices)
+        meshed, _ = check_sources(workload, files, chunk=3, mesh=mesh)
+        plain, _ = check_sources(workload, files, chunk=3)
+        assert meshed == plain
+
+    def test_mesh_elle_with_degenerate_splice(self, cpu_devices, tmp_path):
+        """A degenerate history shrinks a chunk's LIVE batch below the
+        mesh's hist divisibility: the producer must re-pad, not crash."""
+        from test_fuzz_elle_device import fuzz_history
+
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        class _SH:
+            def __init__(self, ops):
+                self.ops = ops
+
+        base = [_SH(fuzz_history(seed, n_txns=10)) for seed in range(8)]
+        assert any(
+            elle_mops_for(sh.ops)[1].degenerate for sh in base
+        ), "corpus lost its degenerate member"
+        files = _write(tmp_path, base)
+        mesh = checker_mesh(cpu_devices)
+        meshed, _ = check_sources("elle", files, chunk=4, mesh=mesh)
+        plain, _ = check_sources("elle", files, chunk=4)
+        assert meshed == plain
+
+
+class TestNativeMultiFile:
+    """Thread-pool multi-file native entry points == per-file calls."""
+
+    @pytest.fixture(autouse=True)
+    def _lib(self):
+        from jepsen_tpu.history import fastpack
+
+        if fastpack._load() is None:
+            pytest.skip("native packer unavailable")
+
+    def test_stream_rows_files(self, stream_corpus):
+        from jepsen_tpu.history.fastpack import (
+            stream_rows_file,
+            stream_rows_files,
+        )
+
+        _base, files = stream_corpus
+        multi = stream_rows_files(files, threads=3)
+        assert multi is not None
+        for p, got in zip(files, multi):
+            one = stream_rows_file(p)
+            assert (got[0] == one[0]).all() and got[1] == one[1]
+
+    def test_pack_files(self, queue_corpus):
+        from jepsen_tpu.history.fastpack import pack_file, pack_files
+
+        _base, files = queue_corpus
+        multi = pack_files(files, threads=2)
+        assert multi is not None
+        for p, got in zip(files, multi):
+            kind, rows = pack_file(p)
+            assert got[0] == kind and (got[1] == rows).all()
+
+    def test_elle_mops_files(self, tmp_path):
+        from jepsen_tpu.history.fastpack import (
+            elle_mops_file,
+            elle_mops_files,
+        )
+
+        base = synth_elle_batch(5, ElleSynthSpec(n_txns=8))
+        files = _write(tmp_path, base)
+        multi = elle_mops_files(files, threads=2)
+        assert multi is not None
+        for p, got in zip(files, multi):
+            mat, meta = elle_mops_file(p)
+            gmat, gmeta = got
+            assert (gmat == mat).all()
+            assert gmeta == meta
+
+    def test_edn_files_fall_back(self, tmp_path):
+        """.edn paths are excluded from the native call (per-slot None →
+        Python twin), not crashed on."""
+        from jepsen_tpu.history.fastpack import stream_rows_files
+
+        base = synth_stream_batch(2, StreamSynthSpec(n_ops=10))
+        files = _write(tmp_path, base)
+        edn = tmp_path / "history.edn"
+        edn.write_text("[]")
+        got = stream_rows_files([files[0], edn, files[1]], threads=2)
+        assert got is not None
+        assert got[0] is not None and got[2] is not None
+        assert got[1] is None
+
+
+class TestStreamRowsCache:
+    def test_round_trip_and_staleness(self, tmp_path):
+        from jepsen_tpu.checkers.stream_lin import _stream_rows
+        from jepsen_tpu.history.store import read_history
+        from jepsen_tpu.history.storecache import (
+            load_stream_rows_cache,
+            save_stream_rows_cache,
+            stream_rows_with_cache,
+        )
+
+        base = synth_stream_batch(1, StreamSynthSpec(n_ops=25), lost=1)
+        (p,) = _write(tmp_path, base)
+        cols, full, hit = stream_rows_with_cache(p)
+        assert not hit
+        ref_cols, ref_full = _stream_rows(read_history(p))
+        assert (cols == ref_cols).all() and full == ref_full
+        cols2, full2, hit2 = stream_rows_with_cache(p)
+        assert hit2 and (cols2 == cols).all() and full2 == full
+        # rewriting the history invalidates the cache
+        write_history_jsonl(p, base[0].ops[:10])
+        got = load_stream_rows_cache(p)
+        if got is not None:  # same-mtime-ns race: digest must catch it
+            fresh = _stream_rows(read_history(p))
+            assert (got[0] == fresh[0]).all()
+        _c3, _f3, hit3 = stream_rows_with_cache(p)
+        cols4, full4, hit4 = stream_rows_with_cache(p)
+        assert hit4
+        assert (cols4 == _stream_rows(read_history(p))[0]).all()
+
+    def test_corrupt_cache_ignored(self, tmp_path):
+        from jepsen_tpu.history.storecache import (
+            load_stream_rows_cache,
+            save_stream_rows_cache,
+            stream_rows_cache_path,
+        )
+
+        base = synth_stream_batch(1, StreamSynthSpec(n_ops=10))
+        (p,) = _write(tmp_path, base)
+        save_stream_rows_cache(
+            p, np.zeros((1, 6), np.int32), False
+        )
+        stream_rows_cache_path(p).write_bytes(b"not an npz")
+        assert load_stream_rows_cache(p) is None

@@ -1,0 +1,372 @@
+"""Deterministic-window repro driver for the open r7 durable-queue
+acked-loss (VERDICT #4 / PARITY index row for
+``store/soak_r7_30min_5node_queue_red.txt``).
+
+Replays the suspect fault window from the red soak — steady confirmed
+enqueues while the cluster takes a partition, a membership
+remove(+wipe)+rejoin, and a kill-with-durable-restart — directly against
+the in-process ``ReplicatedBackend`` layer (no AMQP sockets), then heals
+and drains.  A confirmed (acked) enqueue that is neither delivered nor
+drained is a LOSS.
+
+Usage::
+
+    python tools/repro_r7_queue_loss.py --seeds 0 19   # sweep seeds 0..19
+
+Exit 0 when no seed lost anything; 1 with a report when any did.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jepsen_tpu.harness.replication import ReplicatedBackend  # noqa: E402
+
+FAST = dict(
+    election_timeout=(0.15, 0.3),
+    heartbeat_s=0.04,
+    dead_owner_s=0.8,
+    submit_timeout_s=2.0,
+)
+
+Q = "jepsen.queue"
+
+
+class Cluster:
+    """5 durable in-process nodes with kill/restart/forget/join/partition."""
+
+    _next_port = [14000]
+
+    @classmethod
+    def _free_port(cls) -> int:
+        """A listener port OUTSIDE the ephemeral range (16000-65535 on
+        this image): kernel-assigned local ports of outbound RPC sockets
+        must never collide with a Raft port we re-bind after a kill."""
+        import socket
+
+        while cls._next_port[0] < 16000:
+            port = cls._next_port[0]
+            cls._next_port[0] += 1
+            try:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", port))
+                    return port
+            except OSError:
+                continue
+        raise RuntimeError("no free low port")
+
+    def __init__(self, root: str, n: int = 5, seed: int = 0):
+        self.root = root
+        self.names = [f"n{i}" for i in range(n)]
+        self.peers: dict[str, tuple[str, int]] = {
+            nm: ("127.0.0.1", self._free_port()) for nm in self.names
+        }
+        self.backends: dict[str, ReplicatedBackend] = {}
+        for i, nm in enumerate(self.names):
+            self.backends[nm] = ReplicatedBackend(
+                nm, self.peers, data_dir=self._dir(nm),
+                rng_seed=seed * 100 + i, **FAST,
+            )
+        self.blocked: set[frozenset] = set()
+
+    def _dir(self, nm: str) -> str:
+        return os.path.join(self.root, nm)
+
+    def leader(self, timeout=25.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for nm, b in self.backends.items():
+                if b is not None and b.raft.is_leader():
+                    return nm
+            time.sleep(0.02)
+        raise AssertionError("no leader")
+
+    def alive(self):
+        return [nm for nm, b in self.backends.items() if b is not None]
+
+    def kill(self, nm: str) -> None:
+        b = self.backends[nm]
+        if b is not None:
+            b.stop()
+        self.backends[nm] = None
+
+    def restart(self, nm: str, fresh: bool = False) -> None:
+        if fresh:
+            shutil.rmtree(self._dir(nm), ignore_errors=True)
+        for attempt in range(40):
+            try:
+                self.backends[nm] = ReplicatedBackend(
+                    nm, {nm: self.peers[nm]} if fresh else self.peers,
+                    data_dir=self._dir(nm), bootstrap=not fresh, **FAST,
+                )
+                break
+            except OSError as e:  # lingering bind from a killed incarnation
+                if attempt == 39:
+                    print(
+                        f"restart {nm} port {self.peers[nm][1]} stuck: {e}; "
+                        f"alive={self.alive()}",
+                        flush=True,
+                    )
+                    self.backends[nm] = None
+                    return
+                time.sleep(0.25)
+        self._apply_blocks()
+
+    def forget(self, nm: str, via: str) -> bool:
+        return self.backends[via].raft.request_forget(nm, timeout_s=8.0)
+
+    def join(self, nm: str, via: str) -> bool:
+        return self.backends[nm].raft.request_join(
+            self.peers[via], timeout_s=8.0
+        )
+
+    def partition(self, side_a: list[str], side_b: list[str]) -> None:
+        for a in side_a:
+            for b in side_b:
+                self.blocked.add(frozenset((a, b)))
+        self._apply_blocks()
+
+    def heal(self) -> None:
+        self.blocked.clear()
+        for b in self.backends.values():
+            if b is not None:
+                b.raft.unblock_all()
+
+    def _apply_blocks(self) -> None:
+        for nm, b in self.backends.items():
+            if b is None:
+                continue
+            b.raft.unblock_all()
+            for link in self.blocked:
+                if nm in link:
+                    (other,) = link - {nm}
+                    b.raft.block(other)
+
+    def stop(self) -> None:
+        for b in self.backends.values():
+            if b is not None:
+                b.stop()
+
+
+def run_window(seed: int, minutes: float = 0.5) -> dict:
+    import base64
+    import random
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix=f"repro_r7_{seed}_")
+    c = Cluster(root, seed=seed)
+    acked: list[int] = []
+    seen: set[int] = set()
+    stop = threading.Event()
+    next_v = [0]
+
+    def any_backend():
+        alive = [b for b in c.backends.values() if b is not None]
+        return rng.choice(alive) if alive else None
+
+    c.leader()
+    c.backends[c.names[0]].declare(Q, qtype="quorum")
+
+    def publisher():
+        while not stop.is_set():
+            b = any_backend()
+            if b is None:
+                time.sleep(0.05)
+                continue
+            v = next_v[0]
+            next_v[0] += 1
+            try:
+                if b.enqueue(Q, str(v).encode(), b""):
+                    acked.append(v)
+            except Exception:
+                pass
+
+    def consumer(i: int):
+        while not stop.is_set():
+            b = any_backend()
+            if b is None:
+                time.sleep(0.05)
+                continue
+            try:
+                owner = f"{b.raft.name}|repro-c{i}"
+                msg = b.dequeue(Q, owner)
+                if msg is not None:
+                    seen.add(int(msg.body.decode()))
+                    b.settle(owner, msg.mid)
+                else:
+                    time.sleep(0.01)
+            except Exception:
+                time.sleep(0.02)
+
+    threads = [threading.Thread(target=publisher, daemon=True)]
+    threads += [
+        threading.Thread(target=consumer, args=(i,), daemon=True)
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+
+    t_end = time.monotonic() + minutes * 60.0
+    events = []
+    try:
+        while time.monotonic() < t_end:
+            # one churn cycle mirroring the red window:
+            # partition -> heal -> remove+rejoin -> kill+restart
+            names = list(c.names)
+            rng.shuffle(names)
+            side_a, side_b = names[:2], names[2:]
+            c.partition(side_a, side_b)
+            events.append(f"partition {side_a}|{side_b}")
+            time.sleep(rng.uniform(0.5, 1.5))
+            c.heal()
+
+            victim = rng.choice([n for n in c.alive()])
+            c.kill(victim)
+            ok = False
+            for via in c.alive():
+                ok = c.forget(victim, via)
+                if ok:
+                    break
+            events.append(f"forget {victim} ok={ok}")
+            c.restart(victim, fresh=ok)
+            if ok:
+                joined = c.join(victim, rng.choice(
+                    [n for n in c.alive() if n != victim]
+                ))
+                events.append(f"join {victim} ok={joined}")
+            # kill another node mid-catch-up (the suspect moment)
+            time.sleep(rng.uniform(0.0, 0.4))
+            other = rng.choice([n for n in c.alive() if n != victim])
+            c.kill(other)
+            events.append(f"kill {other}")
+            time.sleep(rng.uniform(0.2, 1.0))
+            c.restart(other)
+            events.append(f"restart {other}")
+            time.sleep(rng.uniform(0.5, 1.0))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=2.0)
+        c.heal()
+
+    post: dict = {}
+    # drain: every acked value must eventually be deliverable.  First
+    # requeue every inflight owner — the harness has no broker-level
+    # orphan sweep, so a committed-but-unreported DEQ (consumer submit
+    # timed out, entry committed anyway) must not read as loss: the real
+    # broker's sweeps requeue those within a tick.
+    try:
+        lead = c.leader(timeout=10.0)
+        b = c.backends[lead]
+
+        def sweep() -> int:
+            # mirror the broker's continuous orphan sweep: re-propose
+            # until the entries leave the inflight map (a submit lost to
+            # an election window is retried, exactly like
+            # broker._orphan_sweep_loop)
+            with b.machine.lock:
+                owners = {
+                    o
+                    for o, _q, _m in b.machine.inflight.values()
+                    if not o.endswith("repro-drain")
+                }
+            for o in owners:
+                b.requeue_owner(o)
+            return len(owners)
+
+        empties = 0
+        deadline = time.monotonic() + 45.0
+        while empties < 30 and time.monotonic() < deadline:
+            sweep()
+            owner = f"{lead}|repro-drain"
+            msg = b.dequeue(Q, owner)
+            if msg is None:
+                empties += 1
+                time.sleep(0.1)
+                continue
+            empties = 0
+            seen.add(int(msg.body.decode()))
+            b.settle(owner, msg.mid)
+        # post-mortem evidence for any loss: is the enq still in the
+        # committed log?  still inflight?  (distinguishes a Raft-level
+        # committed-entry loss from a delivery-plane strand)
+        lost_now = sorted(set(acked) - seen)
+        post = {}
+        if lost_now:
+            with b.raft.lock:
+                log = list(b.raft.log)
+                commit = b.raft.commit_idx
+            with b.machine.lock:
+                inflight = {
+                    int(m.body.decode())
+                    for _o, _q, m in b.machine.inflight.values()
+                }
+                ready = {
+                    int(m.body.decode())
+                    for dq in b.machine.queues.values()
+                    for m in dq
+                }
+            import base64 as _b64
+
+            for v in lost_now:
+                body = _b64.b64encode(str(v).encode()).decode()
+                at = [
+                    i + 1
+                    for i, (_t, op) in enumerate(log)
+                    if op.get("k") == "enq" and op.get("body") == body
+                ]
+                post[v] = {
+                    "log_idx": at,
+                    "committed": bool(at) and at[0] <= commit,
+                    "inflight": v in inflight,
+                    "ready": v in ready,
+                }
+    finally:
+        c.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    lost = sorted(set(acked) - seen)
+    return {
+        "seed": seed,
+        "acked": len(acked),
+        "seen": len(seen),
+        "lost": lost,
+        "post": post if lost else {},
+        "events": events,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", type=int, nargs=2, default=[0, 9])
+    p.add_argument("--minutes", type=float, default=0.5)
+    args = p.parse_args()
+    bad = 0
+    for seed in range(args.seeds[0], args.seeds[1] + 1):
+        r = run_window(seed, minutes=args.minutes)
+        status = "LOST" if r["lost"] else "ok"
+        print(
+            f"seed {seed}: {status} acked={r['acked']} seen={r['seen']}"
+            + (f" lost={r['lost'][:20]}{'...' if len(r['lost']) > 20 else ''}"
+               if r["lost"] else ""),
+            flush=True,
+        )
+        if r["lost"]:
+            bad += 1
+            print(f"  post-mortem: {r['post']}")
+            for e in r["events"]:
+                print(f"  {e}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,385 @@
+"""Broker-layer repro driver for the open r7 durable-queue acked-loss
+(companion to ``repro_r7_queue_loss.py``, which exonerated the bare
+replication layer: a 20-seed window sweep with broker-faithful sweep
+draining lost nothing).
+
+This one replays the suspect window through the REAL delivery plane —
+in-process ``MiniAmqpBroker`` cluster over durable Raft backends, native
+C++ AMQP clients on real TCP sockets (confirmed publishes, asynchronous
+ack-mode consumers) — while the cluster takes partitions, a membership
+remove(+wipe)+rejoin, and kills with durable restarts; then drains.  A
+confirmed publish that no consumer ever saw and the final drain cannot
+produce is a LOSS.
+
+Usage::
+
+    python tools/repro_r7_queue_loss_broker.py --seeds 0 9 --minutes 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import socket
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from jepsen_tpu.harness.broker import MiniAmqpBroker  # noqa: E402
+from jepsen_tpu.harness.replication import ReplicatedBackend  # noqa: E402
+
+FAST = dict(
+    election_timeout=(0.15, 0.3),
+    heartbeat_s=0.04,
+    dead_owner_s=0.8,
+    submit_timeout_s=2.0,
+)
+
+
+_next_port = [14000]
+
+
+def _free_port() -> int:
+    """A listener port OUTSIDE the ephemeral range (16000-65535 on this
+    image): kernel-assigned local ports of the drivers' reconnect storms
+    must never collide with a broker/Raft port we re-bind after a kill."""
+    while _next_port[0] < 16000:
+        port = _next_port[0]
+        _next_port[0] += 1
+        try:
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", port))
+                return port
+        except OSError:
+            continue
+    raise RuntimeError("no free low port")
+
+
+class BrokerCluster:
+    def __init__(self, root: str, n: int = 5, seed: int = 0):
+        self.root = root
+        self.seed = seed
+        self.names = [f"n{i}" for i in range(n)]
+        self.repl_peers = {nm: ("127.0.0.1", _free_port())
+                           for nm in self.names}
+        self.amqp_ports = {nm: _free_port() for nm in self.names}
+        self.brokers: dict[str, MiniAmqpBroker | None] = {}
+        self.blocked: set[frozenset] = set()
+        for i, nm in enumerate(self.names):
+            self._boot(nm, fresh=False, first=True, idx=i)
+
+    def _dir(self, nm: str) -> str:
+        return os.path.join(self.root, nm)
+
+    def _boot(self, nm: str, fresh: bool, first: bool = False,
+              idx: int = 0) -> None:
+        for attempt in range(80):
+            try:
+                backend = ReplicatedBackend(
+                    nm,
+                    {nm: self.repl_peers[nm]} if fresh else self.repl_peers,
+                    data_dir=self._dir(nm),
+                    bootstrap=not fresh,
+                    rng_seed=self.seed * 100 + idx,
+                    **FAST,
+                )
+                break
+            except OSError:  # ephemeral-port collision; see sibling tool
+                if attempt == 79:
+                    raise
+                time.sleep(0.25)
+        for attempt in range(80):
+            try:
+                self.brokers[nm] = MiniAmqpBroker(
+                    port=self.amqp_ports[nm], replication=backend
+                ).start()
+                break
+            except OSError:
+                if attempt == 79:
+                    raise
+                time.sleep(0.25)
+        self._apply_blocks()
+
+    def leader(self, timeout=10.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for nm, b in self.brokers.items():
+                if b is not None and b.replication.raft.is_leader():
+                    return nm
+            time.sleep(0.02)
+        raise AssertionError("no leader")
+
+    def alive(self) -> list[str]:
+        return [nm for nm, b in self.brokers.items() if b is not None]
+
+    def kill(self, nm: str) -> None:
+        b = self.brokers[nm]
+        if b is not None:
+            b.stop()
+        self.brokers[nm] = None
+
+    def restart(self, nm: str, fresh: bool = False) -> None:
+        if fresh:
+            shutil.rmtree(self._dir(nm), ignore_errors=True)
+        self._boot(nm, fresh=fresh)
+
+    def forget(self, nm: str, via: str) -> bool:
+        ok = self.brokers[via].replication.raft.request_forget(
+            nm, timeout_s=8.0
+        )
+        if ok:
+            shutil.rmtree(self._dir(nm), ignore_errors=True)
+        return ok
+
+    def join(self, nm: str, via: str) -> bool:
+        return self.brokers[nm].replication.raft.request_join(
+            self.repl_peers[via], timeout_s=8.0
+        )
+
+    def partition(self, side_a, side_b) -> None:
+        for a in side_a:
+            for b in side_b:
+                self.blocked.add(frozenset((a, b)))
+        self._apply_blocks()
+
+    def heal(self) -> None:
+        self.blocked.clear()
+        for b in self.brokers.values():
+            if b is not None:
+                b.replication.raft.unblock_all()
+
+    def _apply_blocks(self) -> None:
+        for nm, b in self.brokers.items():
+            if b is None:
+                continue
+            b.replication.raft.unblock_all()
+            for link in self.blocked:
+                if nm in link:
+                    (other,) = link - {nm}
+                    b.replication.raft.block(other)
+
+    def stop(self) -> None:
+        for b in self.brokers.values():
+            if b is not None:
+                b.stop()
+
+
+def run_window(native, seed: int, minutes: float) -> dict:
+    import random
+
+    rng = random.Random(seed)
+    root = tempfile.mkdtemp(prefix=f"repro_r7b_{seed}_")
+    c = BrokerCluster(root, seed=seed)
+    acked: list[int] = []
+    seen: set[int] = set()
+    stop = threading.Event()
+    next_v = [0]
+
+    c.leader(timeout=30.0)
+
+    def _setup(d) -> bool:
+        for _ in range(20):
+            if stop.is_set():
+                return False
+            try:
+                d.setup()
+                return True
+            except Exception:
+                time.sleep(0.25)
+        return False
+
+    # full host:port node list, like the real localcluster: the drain
+    # choreography visits EVERY registered host
+    all_hosts = [f"127.0.0.1:{c.amqp_ports[nm]}" for nm in c.names]
+
+    def publisher(i: int):
+        nm = c.names[i % len(c.names)]
+        d = native.NativeQueueDriver(
+            all_hosts, "127.0.0.1", port=c.amqp_ports[nm],
+            connect_retry_ms=2000,
+        )
+        if not _setup(d):
+            return
+        while not stop.is_set():
+            v = next_v[0]
+            next_v[0] += 1
+            try:
+                if d.enqueue(v, 2.0) is True:
+                    acked.append(v)
+            except Exception:
+                time.sleep(0.05)
+        try:
+            d.close()
+        except Exception:
+            pass
+
+    def consumer(i: int):
+        nm = c.names[(i + 2) % len(c.names)]
+        d = native.NativeQueueDriver(
+            all_hosts, "127.0.0.1", port=c.amqp_ports[nm],
+            consumer_type="asynchronous", connect_retry_ms=2000,
+        )
+        if not _setup(d):
+            return
+        while not stop.is_set():
+            try:
+                got = d.dequeue(1.0)
+                if got is not None:
+                    seen.add(int(got))
+            except Exception:
+                time.sleep(0.05)
+        try:
+            d.close()
+        except Exception:
+            pass
+
+    threads = [
+        threading.Thread(target=publisher, args=(i,), daemon=True)
+        for i in range(2)
+    ] + [
+        threading.Thread(target=consumer, args=(i,), daemon=True)
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+
+    events = []
+    t_end = time.monotonic() + minutes * 60.0
+    try:
+        while time.monotonic() < t_end:
+            names = list(c.names)
+            rng.shuffle(names)
+            side_a, side_b = names[:2], names[2:]
+            c.partition(side_a, side_b)
+            events.append(f"partition {side_a}|{side_b}")
+            time.sleep(rng.uniform(0.5, 1.5))
+            c.heal()
+
+            victim = rng.choice(c.alive())
+            c.kill(victim)
+            ok = False
+            for via in c.alive():
+                try:
+                    ok = c.forget(victim, via)
+                except Exception:
+                    ok = False
+                if ok:
+                    break
+            events.append(f"forget {victim} ok={ok}")
+            c.restart(victim, fresh=ok)
+            if ok:
+                joined = c.join(victim, rng.choice(
+                    [n for n in c.alive() if n != victim]
+                ))
+                events.append(f"join {victim} ok={joined}")
+            time.sleep(rng.uniform(0.0, 0.4))
+            other = rng.choice([n for n in c.alive() if n != victim])
+            c.kill(other)
+            events.append(f"kill {other}")
+            time.sleep(rng.uniform(0.2, 1.0))
+            c.restart(other)
+            events.append(f"restart {other}")
+            time.sleep(rng.uniform(0.5, 1.0))
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=3.0)
+        c.heal()
+
+    post: dict = {}
+    try:
+        lead = c.leader(timeout=12.0)
+        d = native.NativeQueueDriver(
+            [f"127.0.0.1:{c.amqp_ports[nm]}" for nm in c.names],
+            "127.0.0.1", port=c.amqp_ports[lead],
+            connect_retry_ms=3000,
+        )
+        d.setup()
+        deadline = time.monotonic() + 60.0
+        stable_empty = 0
+        while stable_empty < 3 and time.monotonic() < deadline:
+            got = d.drain()
+            if got:
+                stable_empty = 0
+                seen.update(int(v) for v in got)
+            else:
+                stable_empty += 1
+                time.sleep(1.0)
+        try:
+            d.close()
+        except Exception:
+            pass
+        lost_now = sorted(set(acked) - seen)
+        if lost_now:
+            b = c.brokers[lead]
+            with b.replication.machine.lock:
+                inflight = {}
+                for mid, (o, _q, m) in b.replication.machine.inflight.items():
+                    try:
+                        inflight[int(m.body.decode())] = o
+                    except ValueError:
+                        pass
+                ready = set()
+                for dq in b.replication.machine.queues.values():
+                    for m in dq:
+                        try:
+                            ready.add(int(m.body.decode()))
+                        except ValueError:
+                            pass
+            for v in lost_now:
+                post[v] = {
+                    "inflight_owner": inflight.get(v),
+                    "ready": v in ready,
+                }
+    finally:
+        c.stop()
+        shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "seed": seed,
+        "acked": len(acked),
+        "seen": len(seen),
+        "lost": sorted(set(acked) - seen),
+        "post": post,
+        "events": events,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--seeds", type=int, nargs=2, default=[0, 9])
+    p.add_argument("--minutes", type=float, default=0.5)
+    args = p.parse_args()
+
+    from jepsen_tpu.client import native
+
+    native.load_library().amqp_set_logging(0)
+    bad = 0
+    for seed in range(args.seeds[0], args.seeds[1] + 1):
+        native.reset(drain_wait_ms=200)
+        try:
+            r = run_window(native, seed, minutes=args.minutes)
+        except Exception as e:  # noqa: BLE001 - a broken seed is reported
+            print(f"seed {seed}: HARNESS ERROR {type(e).__name__}: {e}")
+            continue
+        status = "LOST" if r["lost"] else "ok"
+        print(
+            f"seed {seed}: {status} acked={r['acked']} seen={r['seen']}"
+            + (f" lost={r['lost'][:20]}" if r["lost"] else ""),
+            flush=True,
+        )
+        if r["lost"]:
+            bad += 1
+            print(f"  post-mortem: {r['post']}")
+            for e in r["events"]:
+                print(f"  {e}")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -203,6 +203,20 @@ def run_soak(args) -> int:
             workload=args.workload,
             durable=True,
         )
+        if not args.serial:
+            # post-run analysis through the bytes-to-verdict pipeline
+            # executor (parallel/pipeline.py): the stored history.jsonl
+            # is packed by the native thread pool and checked on device,
+            # instead of re-packing 100k+ Op objects on one thread —
+            # identical verdict content (tests/test_pipeline.py), less
+            # soak wall time spent in the analysis phase
+            from jepsen_tpu.parallel.pipeline import (
+                attach_pipelined_checkers,
+            )
+
+            if attach_pipelined_checkers(test, args.workload):
+                print("# soak: pipelined analysis (pass --serial for "
+                      "the classic single-thread checkers)", flush=True)
         monitors.append(attach_live_monitor_for(test, monitor_name))
         return test, transport
 
@@ -256,6 +270,10 @@ def main(argv=None) -> int:
                         "unfenced mutex)")
     p.add_argument("--attempts", type=int, default=2,
                    help="triage attempts (fresh cluster each)")
+    p.add_argument("--serial", action="store_true",
+                   help="triage escape hatch: run the post-run analysis "
+                        "on the classic single-thread checkers instead "
+                        "of the bytes-to-verdict pipeline executor")
     p.add_argument("--store", default=None,
                    help="store root (default: a temp dir)")
     p.add_argument("--out", default=None,

@@ -481,6 +481,298 @@ def _pipeline_rates(
     }
 
 
+NORTH_STAR_HISTORIES = 10_000  # BASELINE.json: 10k x 1000-op histories
+NORTH_STAR_TARGET_S = 60.0  # ... verified in < 60 s on a v5e-8
+SCALING_DEVICE_COUNTS = (1, 2, 4, 8)
+SCALING_FILES = 96  # files per family per scaling child
+SCALING_STREAM_OPS = 200
+SCALING_ELLE_TXNS = 64
+
+
+def _bench_north_star(
+    details: dict,
+    histories: int = None,
+    base_n: int = None,
+    n_ops: int = None,
+    chunk: int = 256,
+) -> None:
+    """The BASELINE.json north-star config as ONE measured wall-time
+    row: 10k × ~1000-op-row queue histories, bytes → verdict, through
+    the meshed multi-lane pipeline with the collective verdict
+    reduction (the host receives two scalars per chunk, not per-device
+    gathers).  ``vs_baseline_target_s`` pins the 60 s v5e-8 goal so
+    every future BENCH_r*.json tracks the remaining distance directly.
+
+    The file LIST repeats a distinct synthetic base (caches off: every
+    repeat re-pays the full parse), the same protocol as the pipeline
+    sections — content repetition cannot shortcut a bytes-to-verdict
+    run whose caches are disabled."""
+    import tempfile
+
+    import jax
+
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+    from jepsen_tpu.parallel.mesh import checker_mesh
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    histories = histories or NORTH_STAR_HISTORIES
+    base_n = base_n or BASE_HISTORIES
+    n_ops = n_ops or N_OPS
+    base = synth_batch(
+        base_n, SynthSpec(n_ops=n_ops, n_processes=5), lost=1
+    )
+    mesh = checker_mesh()
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, base)
+        srcs = (files * ((histories + base_n - 1) // base_n))[:histories]
+        # warm the jitted chunk programs (compile-excluded, like every
+        # other timed section)
+        check_sources(
+            "queue", srcs[: chunk * 2], chunk=chunk, mesh=mesh, lanes=0,
+            reduce=True, use_cache=False,
+        )
+        t0 = time.perf_counter()
+        verdict, stats = check_sources(
+            "queue", srcs, chunk=chunk, mesh=mesh, lanes=0,
+            reduce=True, use_cache=False,
+        )
+        wall = time.perf_counter() - t0
+    details["north_star"] = {
+        "config": "BASELINE.json #1: 10k x 1000-op-row histories, "
+                  "bytes-to-verdict",
+        "histories": histories,
+        "invocations_per_history": n_ops,
+        "wall_s": round(wall, 2),
+        "vs_baseline_target_s": NORTH_STAR_TARGET_S,
+        "met_target": bool(wall < NORTH_STAR_TARGET_S),
+        "e2e_histories_per_sec": round(histories / wall, 1),
+        "invalid": verdict["invalid"],
+        "devices": jax.device_count(),
+        "lanes": stats.lanes,
+        "chunk": chunk,
+        "backend": jax.default_backend(),
+    }
+    print(
+        f"# north_star: {histories} histories bytes->verdict in "
+        f"{wall:.1f}s ({histories / wall:.0f} hist/s) on "
+        f"{jax.device_count()} {jax.default_backend()} device(s) — "
+        f"target {NORTH_STAR_TARGET_S:.0f}s "
+        f"({'MET' if wall < NORTH_STAR_TARGET_S else 'not met'})",
+        file=sys.stderr,
+    )
+
+
+def _bench_north_star_section(details: dict) -> None:
+    """``north_star`` for the section loop: on a chip backend the row
+    runs in-process on the real devices; on the CPU fallback it runs in
+    a subprocess pinned to 8 VIRTUAL devices — the v5e-8 mesh shape the
+    BASELINE.json target names — so the recorded distance-to-goal is
+    measured through the same 8-way meshed pipeline either way."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _bench_north_star(details)
+        return
+    child = (
+        "import json, os, sys\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "import bench\n"
+        "d = {}\n"
+        "bench._bench_north_star(d)\n"
+        "print('NORTH_STAR ' + json.dumps(d['north_star']), flush=True)\n"
+    )
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-c", child,
+            os.path.dirname(os.path.abspath(__file__)),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=3600,
+        env=env,
+    )
+    for line in r.stderr.splitlines():
+        print(line, file=sys.stderr)
+    got = None
+    for line in r.stdout.splitlines():
+        if line.startswith("NORTH_STAR "):
+            try:
+                got = json.loads(line[len("NORTH_STAR "):])
+            except ValueError:
+                pass
+    if got is None:
+        raise RuntimeError(
+            f"north_star child produced no section: "
+            f"{(r.stderr or r.stdout)[-400:]}"
+        )
+    details["north_star"] = got
+
+
+_SCALING_CHILD = r"""
+import json, os, sys, tempfile, time
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={sys.argv[1]}"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+spec = json.loads(sys.argv[2])
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, spec["repo"])
+from jepsen_tpu.history.store import write_history_jsonl
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec, StreamSynthSpec, synth_elle_batch, synth_stream_batch,
+)
+from jepsen_tpu.parallel.mesh import checker_mesh
+from jepsen_tpu.parallel.pipeline import check_sources
+from jepsen_tpu.utils.jaxenv import enable_compilation_cache
+
+if spec.get("cache_dir"):
+    enable_compilation_cache(spec["cache_dir"], backend="cpu")
+out = {"devices": jax.device_count()}
+mesh = checker_mesh()
+with tempfile.TemporaryDirectory() as td:
+    corpora = {
+        "stream": synth_stream_batch(
+            spec["files"], StreamSynthSpec(n_ops=spec["stream_ops"]), lost=1
+        ),
+        "elle": synth_elle_batch(
+            spec["files"], ElleSynthSpec(n_txns=spec["elle_txns"]),
+            g2_cycle=1,
+        ),
+    }
+    for fam, base in corpora.items():
+        paths = []
+        for i, sh in enumerate(base):
+            p = os.path.join(td, f"{fam}{i:03d}.jsonl")
+            write_history_jsonl(p, sh.ops)
+            paths.append(p)
+        srcs = paths * spec["repeat"]
+        kw = dict(
+            chunk=spec["chunk"], mesh=mesh, lanes=0, reduce=True,
+            use_cache=False,
+        )
+        check_sources(fam, srcs, **kw)  # warm the jitted programs
+        t0 = time.perf_counter()
+        verdict, stats = check_sources(fam, srcs, **kw)
+        wall = time.perf_counter() - t0
+        out[fam] = {
+            "e2e_histories_per_sec": round(len(srcs) / wall, 1),
+            "wall_s": round(wall, 3),
+            "histories": len(srcs),
+            "invalid": verdict["invalid"],
+            "lanes": stats.lanes,
+            "device_idle_frac": round(stats.device_idle_frac, 3),
+        }
+print(json.dumps(out), flush=True)
+"""
+
+
+def _bench_scaling(
+    details: dict,
+    device_counts=SCALING_DEVICE_COUNTS,
+    files: int = None,
+    repeat: int = 2,
+    chunk: int = 12,  # 192 histories -> 16 units: every lane of the
+    persist: bool = True,  # 8-device point holds >= 1 unit
+) -> None:
+    """Measured virtual-device scaling of the scale-out pipeline
+    (per-device lanes + meshed dispatch + collective verdict
+    reduction): one CPU-backend subprocess per device count — the
+    device count is an XLA init flag, so each point needs a fresh
+    process — each running the identical stream/elle bytes-to-verdict
+    corpus.  On this 2-core container the curve is Amdahl-capped by the
+    shared cores (the section documents the cap honestly); the same
+    harness runs on a real chip mesh via tools/capture_multichip.py the
+    moment a multi-chip window opens."""
+    files = files or SCALING_FILES
+    spec = {
+        "repo": os.path.dirname(os.path.abspath(__file__)),
+        "files": files,
+        "repeat": repeat,
+        "chunk": chunk,
+        "stream_ops": SCALING_STREAM_OPS,
+        "elle_txns": SCALING_ELLE_TXNS,
+        "cache_dir": os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "store", "xla_cache"
+        ),
+    }
+    rows = []
+    for d in device_counts:
+        r = subprocess.run(
+            [sys.executable, "-c", _SCALING_CHILD, str(d), json.dumps(spec)],
+            capture_output=True,
+            text=True,
+            timeout=1800,
+        )
+        got = None
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    got = json.loads(line)
+                except ValueError:
+                    pass
+        if got is None:
+            got = {
+                "devices": d,
+                "error": (r.stderr or r.stdout)[-400:],
+            }
+        rows.append(got)
+        print(f"# scaling[{d} dev]: {json.dumps(got)}", file=sys.stderr)
+        # persist after each point: a timeout mid-curve keeps the
+        # measured prefix (persist=False: the offline CI smoke must
+        # never touch the committed BENCH_DETAILS.json)
+        details["scaling"] = _scaling_summary(rows, spec)
+        if persist:
+            _write_details(details)
+
+
+def _scaling_summary(rows: list, spec: dict) -> dict:
+    out = {
+        "devices": [r.get("devices") for r in rows],
+        "families": ("stream", "elle"),
+        "histories_per_point": spec["files"] * spec["repeat"],
+        "e2e_histories_per_sec": {
+            fam: [
+                (r.get(fam) or {}).get("e2e_histories_per_sec")
+                for r in rows
+            ]
+            for fam in ("stream", "elle")
+        },
+        "mode": "mesh + per-device lanes + collective verdict reduction, "
+                "caches off",
+        "backend": "cpu",
+        "host_cores": len(os.sched_getaffinity(0)),
+        "note": "virtual CPU devices share the host cores: the curve is "
+                "bounded by host parallelism, not devices — the chip "
+                "capture (tools/capture_multichip.py) runs this harness "
+                "on real meshes",
+    }
+    for fam in ("stream", "elle"):
+        pts = [
+            (d, r)
+            for d, r in zip(
+                out["devices"], out["e2e_histories_per_sec"][fam]
+            )
+            if r
+        ]
+        # the ratio is only what its key claims when the 1-device point
+        # itself survived — a failed baseline must not silently promote
+        # the next point into the denominator
+        if len(pts) >= 2 and pts[0][0] == 1:
+            out.setdefault("speedup_vs_1dev", {})[fam] = round(
+                pts[-1][1] / pts[0][1], 2
+            )
+    return out
+
+
 #: peak (bf16 FLOP/s, HBM bytes/s) by jax ``device_kind`` — the roofline
 #: denominators.  Kinds not listed (e.g. the CPU fallback) report the
 #: achieved numbers with ``None`` utils rather than a made-up ceiling.
@@ -1082,7 +1374,8 @@ def _run_once() -> None:
     # still leaves N sections of fresh numbers on disk
     for section in (
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
-        _bench_elle, _bench_mutex,
+        _bench_elle, _bench_mutex, _bench_north_star_section,
+        _bench_scaling,
     ):
         try:
             section(details)

@@ -216,6 +216,80 @@ def _checker_for(args, out_dir=None, history=None, hpath=None):
     return compose(checkers)
 
 
+def _cmd_check_procs(args, paths, workload: str, prev: dict) -> int:
+    """``check --procs N`` over SEVERAL stored histories: the
+    multi-process checker harness (``parallel/distributed.py``) — N
+    ``jax.distributed`` worker processes (CPU workers: a local chip is
+    exclusive to one process, so the host cores are the multi-process
+    resource), deterministic size-striped file assignment, per-process
+    multi-lane pipelines, one merged verdict set from the coordinator.
+    A dead worker aborts loudly with no partial verdicts."""
+    import os as _os
+
+    from jepsen_tpu.checkers.protocol import VALID, merge_valid
+    from jepsen_tpu.parallel.distributed import run_multiprocess_check
+
+    opts: dict = {}
+    if workload == "queue":
+        opts["delivery"] = (
+            getattr(args, "delivery", None)
+            or prev.get("linear", {}).get("delivery")
+            or "exactly-once"
+        )
+    elif workload == "stream":
+        opts["append_fail"] = (
+            getattr(args, "append_fail", None)
+            or prev.get("stream", {}).get("append-fail")
+            or "definite"
+        )
+    elif workload == "elle":
+        opts["model"] = (
+            getattr(args, "consistency_model", None)
+            or prev.get("elle", {}).get("consistency-model")
+            or "serializable"
+        )
+    avail = len(_os.sched_getaffinity(0))
+    t0 = time.perf_counter()
+    results, info = run_multiprocess_check(
+        workload,
+        paths,
+        args.procs,
+        devices_per_proc=max(1, avail // args.procs),
+        mesh=True,
+        **opts,
+    )
+    dt = time.perf_counter() - t0
+    composed = []
+    for p, row in zip(paths, results):
+        result = dict(row)
+        result[VALID] = merge_valid(
+            r.get(VALID, False)
+            for r in result.values()
+            if isinstance(r, dict)
+        )
+        save_results(Path(p).parent, result)
+        composed.append(result)
+    if len(composed) == 1:
+        print(json.dumps(composed[0], indent=1, default=_json_default))
+    else:
+        print(
+            json.dumps(
+                [
+                    {"history": str(p), "valid?": r[VALID]}
+                    for p, r in zip(paths, composed)
+                ],
+                indent=1,
+                default=_json_default,
+            )
+        )
+    print(
+        f"# checked {len(paths)} histories through {info['n_procs']} "
+        f"processes in {dt:.2f} s",
+        file=sys.stderr,
+    )
+    return _verdict_exit(merge_valid(r[VALID] for r in composed))
+
+
 def cmd_check(args) -> int:
     from jepsen_tpu.checkers.protocol import VALID
 
@@ -238,6 +312,32 @@ def cmd_check(args) -> int:
         args.delivery = prev.get("linear", {}).get("delivery")
     if getattr(args, "append_fail", None) is None:
         args.append_fail = prev.get("stream", {}).get("append-fail")
+    if getattr(args, "procs", 0) and args.procs > 1:
+        workload = getattr(args, "workload", "auto")
+        if workload == "auto":
+            workload = _workload_of(history)
+        if workload not in ("queue", "stream", "elle"):
+            print(
+                f"# --procs applies to the pipelined families "
+                f"(queue/stream/elle); {workload} runs in-process",
+                file=sys.stderr,
+            )
+        else:
+            root = Path(args.history)
+            paths = (
+                _history_paths(str(root)) if root.is_dir() else [hpath]
+            )
+            if len(paths) > 1:
+                # every history in the tree, checked as one family
+                # (the resolved history's) — a mixed-family store
+                # should use bench-check --pipeline per family
+                return _cmd_check_procs(args, paths, workload, prev)
+            print(
+                "# --procs: a single history gives the worker fleet "
+                "nothing to divide — running in-process (point --procs "
+                "at a store tree to fan N histories across processes)",
+                file=sys.stderr,
+            )
     checker = _checker_for(args, out_dir=out_dir, history=history, hpath=hpath)
     log_pat = getattr(args, "log_file_pattern", None) or prev.get(
         "log-file-pattern", {}
@@ -368,25 +468,45 @@ def _cmd_bench_check_pipeline(args) -> int:
         from jepsen_tpu.parallel.mesh import checker_mesh
 
         opts["mesh"] = checker_mesh()
+    reduce = getattr(args, "reduce", False)
+    if reduce and "mesh" not in opts:
+        print(
+            "error: --reduce needs --mesh (the collective reduction "
+            "runs on the device mesh)",
+            file=sys.stderr,
+        )
+        return 2
     results, stats = check_sources(
         workload,
         keep,
         chunk=getattr(args, "chunk", None) or 64,
         serial=getattr(args, "serial", False),
+        lanes=getattr(args, "lanes", None),
+        reduce=reduce,
         **opts,
     )
-    if workload == "queue":
-        n_invalid = sum(
-            1
-            for r in results
-            if not (
-                r["queue"]["valid?"] is True
-                and r["linear"]["valid?"] is True
-            )
-        )
+    if reduce:
+        n_invalid = results["invalid"]
+        extra = {
+            "reduce": True,
+            "first_invalid": results["first_invalid"],
+        }
     else:
-        key = "stream" if workload == "stream" else "elle"
-        n_invalid = sum(1 for r in results if r[key]["valid?"] is not True)
+        extra = {}
+        if workload == "queue":
+            n_invalid = sum(
+                1
+                for r in results
+                if not (
+                    r["queue"]["valid?"] is True
+                    and r["linear"]["valid?"] is True
+                )
+            )
+        else:
+            key = "stream" if workload == "stream" else "elle"
+            n_invalid = sum(
+                1 for r in results if r[key]["valid?"] is not True
+            )
     print(
         json.dumps(
             {
@@ -394,6 +514,9 @@ def _cmd_bench_check_pipeline(args) -> int:
                 "batches": stats.batches,
                 "mode": "serial" if getattr(args, "serial", False)
                 else "pipeline",
+                "lanes": stats.lanes,
+                "dropped": stats.dropped,
+                **extra,
                 "wall_s": round(stats.wall_s, 3),
                 "pipeline_e2e_histories_per_sec": round(
                     stats.histories / max(stats.wall_s, 1e-9), 1
@@ -1383,6 +1506,19 @@ def build_parser() -> argparse.ArgumentParser:
         default="auto",
         help="checker family; auto-detected from the history's op kinds",
     )
+    c.add_argument(
+        "--procs",
+        type=int,
+        default=0,
+        help="multi-process checking of a STORE TREE: spawn N "
+        "jax.distributed worker processes (parallel/distributed.py) — "
+        "deterministic size-striped assignment of every history under "
+        "the tree, per-process multi-lane pipelines (CPU workers: a "
+        "chip is exclusive to one process, so host cores are the "
+        "multi-process resource), one merged verdict set; a dead "
+        "worker aborts the run with no partial verdicts.  A single "
+        "history falls back to the in-process pipeline",
+    )
     c.set_defaults(fn=cmd_check)
 
     b = sub.add_parser(
@@ -1445,6 +1581,25 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="with --pipeline: stage batches through the device mesh "
         "(parallel/mesh.py sharded dispatch over all devices)",
+    )
+    b.add_argument(
+        "--lanes",
+        type=int,
+        default=None,
+        metavar="N",
+        help="with --pipeline: per-device input lanes — one producer "
+        "thread + staging slot per device, size-aware largest-first "
+        "unit balancing with steal-on-idle (0 = one lane per local "
+        "device); unreadable/zero-length files are dropped loudly and "
+        "counted in the stats",
+    )
+    b.add_argument(
+        "--reduce",
+        action="store_true",
+        help="with --pipeline --mesh: collective verdict reduction — "
+        "per-shard verdicts psum/index-pmin'ed ON DEVICE, the host "
+        "receives one {invalid, first_invalid} pair per batch instead "
+        "of per-history gathers",
     )
     b.add_argument(
         "--delivery",

@@ -141,6 +141,25 @@ def _load() -> ctypes.CDLL | None:
         lib.jt_files_free.argtypes = [ctypes.c_void_p]
     except AttributeError:
         pass
+    try:  # striped-cursor variants (per-device input lanes / per-process
+        # file ranges): absent from a stale build, callers fall back to
+        # the full-scan entry points over Python-sliced sublists
+        for name, res in (
+            ("jt_pack_files_part", _JtPackResult),
+            ("jt_stream_rows_files_part", _JtStreamResult),
+            ("jt_elle_mops_files_part", _JtElleMopsResult),
+        ):
+            fn = getattr(lib, name)
+            fn.restype = ctypes.POINTER(ctypes.POINTER(res))
+            fn.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_int32,
+                ctypes.c_int32,
+            ]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
 
@@ -306,11 +325,27 @@ def _conv_stream(r) -> tuple[np.ndarray, bool] | None:
 # ---------------------------------------------------------------------------
 
 
-def _files_multi(paths, fn_name: str, free_name: str, conv, threads: int):
+def _files_multi(
+    paths,
+    fn_name: str,
+    free_name: str,
+    conv,
+    threads: int,
+    part: int = 0,
+    n_parts: int = 1,
+):
     """Shared multi-file driver: returns a list aligned with ``paths``
     (``None`` entries where that file must fall back to the Python
     twin), or ``None`` when the native multi-file path is unavailable
-    entirely (no library / stale build / escape hatch)."""
+    entirely (no library / stale build / escape hatch).
+
+    ``part``/``n_parts`` select the striped-cursor variant: only indices
+    ``i % n_parts == part`` of ``paths`` are packed (off-stripe slots
+    stay ``None`` and mean "not asked for", not "fall back") — the
+    contention-free way for N concurrent lanes/processes to divide one
+    shared path list without a shared atomic cursor.  A stale build
+    missing the ``_part`` symbols falls back to striding in Python over
+    the classic full-scan entry point."""
     import os
 
     if os.environ.get("JEPSEN_TPU_NO_FASTPACK"):
@@ -323,6 +358,49 @@ def _files_multi(paths, fn_name: str, free_name: str, conv, threads: int):
     ):
         return None
     out: list = [None] * len(paths)
+    if n_parts > 1:
+        stripe = list(range(part, len(paths), n_parts))
+        edn_free = all(Path(paths[i]).suffix != ".edn" for i in stripe)
+        if hasattr(lib, fn_name + "_part") and edn_free:
+            # the native side strides the SHARED array itself.  An .edn
+            # path anywhere in the stripe routes through the Python
+            # stride below instead: the native cursor would parse (and
+            # allocate an error result for) every residue index, so
+            # letting it touch .edn files would both waste the parse
+            # and leak the result structs the free loop never visits.
+            if not stripe:
+                return out
+            arr = (ctypes.c_char_p * len(paths))(
+                *[str(Path(p)).encode() for p in paths]
+            )
+            res = getattr(lib, fn_name + "_part")(
+                arr, len(paths), int(threads), int(part), int(n_parts)
+            )
+            if not res:
+                return out
+            free_one = getattr(lib, free_name)
+            try:
+                for i in stripe:
+                    r = res[i]
+                    if r:
+                        try:
+                            out[i] = conv(r.contents)
+                        finally:
+                            free_one(r)
+            finally:
+                lib.jt_files_free(res)
+            return out
+        # stale pre-part build (or an .edn inside the stripe): stride
+        # in Python, pack the compacted sublist through the classic
+        # entry point (which pre-filters .edn itself)
+        sub = _files_multi(
+            [paths[i] for i in stripe], fn_name, free_name, conv, threads
+        )
+        if sub is None:
+            return None
+        for j, i in enumerate(stripe):
+            out[i] = sub[j]
+        return out
     idx = [i for i, p in enumerate(paths) if Path(p).suffix != ".edn"]
     if not idx:
         return out
@@ -346,25 +424,30 @@ def _files_multi(paths, fn_name: str, free_name: str, conv, threads: int):
     return out
 
 
-def pack_files(paths, threads: int = 0):
+def pack_files(paths, threads: int = 0, part: int = 0, n_parts: int = 1):
     """Multi-file ``pack_file``: ``[(workload, rows) | None, ...]``
     aligned with ``paths``, or None when the native path is unavailable."""
     return _files_multi(
-        paths, "jt_pack_files", "jt_pack_free", _conv_pack, threads
+        paths, "jt_pack_files", "jt_pack_free", _conv_pack, threads,
+        part, n_parts,
     )
 
 
-def stream_rows_files(paths, threads: int = 0):
+def stream_rows_files(
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1
+):
     """Multi-file ``stream_rows_file``: ``[(cols, full) | None, ...]``."""
     return _files_multi(
         paths, "jt_stream_rows_files", "jt_stream_free", _conv_stream,
-        threads,
+        threads, part, n_parts,
     )
 
 
-def elle_mops_files(paths, threads: int = 0):
+def elle_mops_files(
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1
+):
     """Multi-file ``elle_mops_file``: ``[(mat, meta) | None, ...]``."""
     return _files_multi(
         paths, "jt_elle_mops_files", "jt_elle_mops_free", _conv_mops,
-        threads,
+        threads, part, n_parts,
     )

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import hashlib
 import os
+import threading
+import zipfile
 from pathlib import Path
 from typing import Sequence
 
@@ -182,7 +184,9 @@ def save_rows_cache(
     fail the run/check that tried to leave it behind."""
     jsonl_path = Path(jsonl_path)
     target = cache_path_for(jsonl_path)
-    tmp = target.with_name(f"{ROWS_CACHE}.{os.getpid()}.tmp")
+    tmp = target.with_name(
+        f"{ROWS_CACHE}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
     try:
         st = os.stat(jsonl_path)
         meta = np.array(
@@ -219,7 +223,7 @@ def _load_cache(jsonl_path: Path) -> tuple[str, np.ndarray] | None:
         with np.load(target, allow_pickle=False) as z:
             meta = [str(x) for x in z["meta"]]
             rows = z["rows"]
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         return None
     if len(meta) == 4:
         workload, digest, size, mtime_ns = meta

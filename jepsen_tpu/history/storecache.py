@@ -30,6 +30,8 @@ the per-run caches, never a source of truth.
 from __future__ import annotations
 
 import os
+import threading
+import zipfile
 from pathlib import Path
 from typing import Sequence
 
@@ -70,7 +72,7 @@ def save_packed_store_cache(
     """Persist the assembled columns for this exact file set."""
     root = Path(store_root)
     target = root / STORE_CACHE
-    tmp = root / f"{STORE_CACHE}.{os.getpid()}.tmp"
+    tmp = root / f"{STORE_CACHE}.{os.getpid()}.{threading.get_ident()}.tmp"
     try:
         arrays = {
             name: np.asarray(getattr(packed, name)) for name in _FIELDS
@@ -111,7 +113,9 @@ def save_elle_mops_cache(jsonl_path: str | Path, mat, meta) -> None:
 
     jsonl_path = Path(jsonl_path)
     target = elle_mops_cache_path(jsonl_path)
-    tmp = target.with_name(f"{ELLE_MOPS_CACHE}.{os.getpid()}.tmp")
+    tmp = target.with_name(
+        f"{ELLE_MOPS_CACHE}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
     try:
         keys = np.asarray(meta.keys, np.int64)
     except (OverflowError, TypeError, ValueError):
@@ -166,7 +170,7 @@ def load_elle_mops_cache(jsonl_path: str | Path):
                 keys=[int(x) for x in z["keys"]],
                 degenerate=bool(int(z["degenerate"])),
             )
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         return None
     if len(stamp) != 3:
         return None
@@ -234,7 +238,9 @@ def save_stream_rows_cache(jsonl_path: str | Path, cols, full: bool) -> None:
 
     jsonl_path = Path(jsonl_path)
     target = stream_rows_cache_path(jsonl_path)
-    tmp = target.with_name(f"{STREAM_ROWS_CACHE}.{os.getpid()}.tmp")
+    tmp = target.with_name(
+        f"{STREAM_ROWS_CACHE}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
     try:
         st = os.stat(jsonl_path)
         stamp = np.array(
@@ -272,7 +278,7 @@ def load_stream_rows_cache(jsonl_path: str | Path):
             stamp = [str(x) for x in z["stamp"]]
             cols = np.asarray(z["cols"], np.int32)
             full = bool(int(z["full"]))
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         return None
     if len(stamp) != 3 or cols.ndim != 2 or cols.shape[1] != 6:
         return None
@@ -345,5 +351,5 @@ def load_packed_store_cache(
             return PackedHistories(
                 **cols, value_space=int(z["value_space"])
             )
-    except (OSError, ValueError, KeyError):
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
         return None

@@ -4,12 +4,16 @@ from jepsen_tpu.parallel.mesh import (  # noqa: F401
     HIST_AXIS,
     SEQ_AXIS,
     checker_mesh,
+    reduced_verdict,
     shard_packed,
     sharded_check,
     sharded_elle,
     sharded_elle_mops,
+    sharded_elle_mops_verdict,
     sharded_queue_lin,
+    sharded_queue_verdict,
     sharded_stream_lin,
+    sharded_stream_verdict,
     sharded_total_queue,
     sharded_wgl,
 )

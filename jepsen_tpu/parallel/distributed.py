@@ -1,25 +1,55 @@
-"""Multi-host checking: ``jax.distributed`` over DCN.
+"""Multi-process checker plane: a real ``jax.distributed`` harness.
 
-The reference's distributed story is SSH + AMQP only; its analysis phase is
-single-threaded on one controller (SURVEY.md §2.4).  The TPU build scales
-the analysis plane the JAX way: every host in a pod slice calls
-``init_multihost`` (process 0 is the coordinator), after which
-``jax.devices()`` spans the whole pod and the same ``checker_mesh`` /
-``sharded_check`` programs from ``jepsen_tpu.parallel.mesh`` run
-pod-wide — the ``hist`` axis shards across hosts over DCN (zero
-cross-history communication, so DCN bandwidth doesn't matter) and the
-``seq`` axis stays within a host's ICI domain.
+The reference's distributed story is SSH + AMQP only; its analysis phase
+is single-threaded on one controller (SURVEY.md §2.4).  This module
+scales the analysis plane across OS PROCESSES the JAX way: a launcher
+(:func:`run_multiprocess_check`) spawns ``--procs N`` workers, process 0
+hosts the ``jax.distributed`` coordination service, and every worker
 
-Single-host (or single-process) use needs no initialization at all; these
-helpers are deliberately thin so the mesh-program code has exactly one code
-path for 1 chip, 8 chips, or a pod.
+1. joins the cluster (:func:`init_multihost` — process 0 is the
+   coordinator),
+2. takes its DETERMINISTIC file stripe (largest-first size ordering of
+   the launcher-stat'ed manifest, striped round-robin — the same
+   size-aware balancing rule as the in-process input lanes, so every
+   process derives the identical assignment with no coordination),
+3. runs the per-process bytes-to-verdict pipeline over its OWN local
+   devices (``parallel/pipeline.py`` lanes + local mesh — computation
+   never crosses the process boundary, which is what makes the same
+   harness run on the CPU backend, where XLA has no cross-process
+   programs, and on TPU pods, where the per-host pipelines feed the
+   hosts' ICI domains),
+4. publishes its verdicts through the coordination service's
+   key-value store, where process 0 performs the final cross-process
+   merge and emits one verdict set.
+
+Fail-loud semantics match :class:`~jepsen_tpu.parallel.pipeline.
+PipelineError`: a worker that dies (crash, kill, wedge) aborts the whole
+run — the launcher kills the survivors and raises
+:class:`DistributedCheckError` with NO partial verdicts, and the
+coordinator's blocking KV reads are deadline-bounded so a silent wedge
+cannot hang the merge forever.
+
+Pod-style use (every host one process, one global mesh over ICI+DCN)
+keeps the thin helpers below: ``init_multihost`` + ``global_checker_mesh``
+run the ``parallel/mesh.py`` programs pod-wide unchanged.
 """
 
 from __future__ import annotations
 
-import jax
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
 
-from jepsen_tpu.parallel.mesh import checker_mesh
+from jepsen_tpu.parallel.pipeline import PipelineError
+
+
+class DistributedCheckError(PipelineError):
+    """A worker process died or the merge timed out; no verdicts were
+    emitted (the multi-process twin of the pipeline crash contract)."""
 
 
 def init_multihost(
@@ -33,6 +63,8 @@ def init_multihost(
     already initialized so callers can run the same entrypoint single- and
     multi-host.
     """
+    import jax
+
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -48,7 +80,15 @@ def global_checker_mesh(seq: int = 1):
     """A ``(hist, seq)`` mesh over every device in the (possibly
     multi-host) runtime.  ``seq`` must divide the global device count; the
     ``seq`` axis is laid out innermost so it maps to intra-host ICI
-    neighbors, keeping the per-history ``psum`` combines off DCN."""
+    neighbors, keeping the per-history ``psum`` combines off DCN.
+
+    NOTE: cross-process programs need a backend with multi-process
+    execution (TPU/GPU).  The CPU backend cannot run them — that is what
+    :func:`run_multiprocess_check`'s process-local pipelines are for."""
+    import jax
+
+    from jepsen_tpu.parallel.mesh import checker_mesh
+
     devices = jax.devices()
     if len(devices) % max(seq, 1) != 0:
         raise ValueError(
@@ -59,4 +99,375 @@ def global_checker_mesh(seq: int = 1):
 
 def is_coordinator() -> bool:
     """True on the process that should write stores / print verdicts."""
+    import jax
+
     return jax.process_index() == 0
+
+
+# ---------------------------------------------------------------------------
+# Deterministic file assignment: the same largest-first round-robin
+# striping the input lanes use, over launcher-recorded sizes so every
+# process computes the identical split with no coordination.
+# ---------------------------------------------------------------------------
+
+
+def assign_stripes(sizes: list[int], n_procs: int) -> list[list[int]]:
+    """``n_procs`` lists of indices into the size list: indices sorted
+    by size descending (ties by index — fully deterministic), striped
+    round-robin, so every stripe holds a balanced byte mix."""
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    return [order[p::n_procs] for p in range(n_procs)]
+
+
+_KV_PREFIX = "jt/verdict"
+
+#: env hook for the crash-contract test: the named process exits hard
+#: mid-run (after joining the cluster, before any verdict is published)
+_DIE_ENV = "JEPSEN_TPU_DIST_DIE_PID"
+
+
+def _kv_client():
+    from jax._src.distributed import global_state
+
+    client = global_state.client
+    if client is None:
+        raise DistributedCheckError(
+            "jax.distributed is not initialized; no coordination service"
+        )
+    return client
+
+
+def worker_main(argv=None) -> int:
+    """``python -m jepsen_tpu.parallel.distributed --worker ...`` —
+    one checker process of the fleet.  The launcher provides the env
+    (JAX_PLATFORMS / XLA_FLAGS device count) BEFORE the interpreter
+    starts, so backend selection happens at import like any JAX
+    program."""
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--worker", action="store_true", required=True)
+    p.add_argument("--manifest", required=True)
+    p.add_argument("--coordinator", required=True)
+    p.add_argument("--process-id", type=int, required=True)
+    p.add_argument("--num-processes", type=int, required=True)
+    p.add_argument("--out", required=True)
+    p.add_argument("--merge-timeout-s", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    with open(args.manifest) as fh:
+        man = json.load(fh)
+
+    import jax
+
+    init_multihost(
+        args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    pid = args.process_id
+
+    from jepsen_tpu.utils.jaxenv import enable_compilation_cache
+
+    if man.get("cache_dir"):
+        enable_compilation_cache(
+            man["cache_dir"], backend=jax.default_backend()
+        )
+
+    if os.environ.get(_DIE_ENV) == str(pid):
+        # crash-contract hook: die mid-run, after joining the cluster
+        # and BEFORE publishing any verdict
+        os._exit(42)
+
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    stripes = assign_stripes(man["sizes"], args.num_processes)
+    # ascending manifest order: the reduce-mode first_invalid is the
+    # minimum over the worker's LOCAL source order, so that order must
+    # be monotone in manifest indices (the in-process lanes layer does
+    # its own size balancing; assign_stripes already balanced bytes
+    # across processes)
+    mine = sorted(stripes[pid])
+    my_paths = [man["paths"][i] for i in mine]
+
+    opts = dict(man.get("opts") or {})
+    if man.get("mesh"):
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        # the PROCESS-LOCAL mesh: each process shards its batches over
+        # its own devices; nothing crosses the process boundary
+        opts["mesh"] = checker_mesh(jax.local_devices(), seq=1)
+    reduce = bool(man.get("reduce"))
+    t0 = time.perf_counter()
+    results, stats = check_sources(
+        man["workload"],
+        my_paths,
+        chunk=int(man.get("chunk") or 64),
+        lanes=man.get("lanes"),
+        reduce=reduce,
+        **opts,
+    )
+    wall = time.perf_counter() - t0
+
+    from jepsen_tpu.history.store import _json_default
+
+    if reduce:
+        # first_invalid is an index into MY stripe; lift to the global
+        # manifest index before the merge
+        fi = results.get("first_invalid", -1)
+        results = dict(results)
+        results["first_invalid"] = mine[fi] if 0 <= fi < len(mine) else -1
+    payload = json.dumps(
+        {
+            "pid": pid,
+            "indices": mine,
+            "results": results,
+            "stats": {
+                "wall_s": stats.wall_s,
+                "histories": stats.histories,
+                "lanes": stats.lanes,
+                "dropped": stats.dropped,
+                "batches": stats.batches,
+                "device_idle_frac": stats.device_idle_frac,
+            },
+        },
+        default=_json_default,
+    )
+    client = _kv_client()
+    client.key_value_set(f"{_KV_PREFIX}/{pid}", payload)
+
+    if pid == 0:
+        # the final cross-process verdict merge, on the coordinator:
+        # deadline-bounded KV reads — a dead worker surfaces as a
+        # timeout here (and as a non-zero exit at the launcher)
+        shards = []
+        for q in range(args.num_processes):
+            raw = client.blocking_key_value_get(
+                f"{_KV_PREFIX}/{q}", int(args.merge_timeout_s * 1000)
+            )
+            shards.append(json.loads(raw))
+        merged = _merge_shards(man, shards, reduce)
+        tmp = f"{args.out}.{os.getpid()}.tmp"
+        with open(tmp, "w") as fh:
+            json.dump(merged, fh)
+        os.replace(tmp, args.out)
+    print(
+        json.dumps(
+            {"pid": pid, "checked": len(my_paths), "wall_s": round(wall, 3)}
+        ),
+        flush=True,
+    )
+    return 0
+
+
+def _merge_shards(man: dict, shards: list[dict], reduce: bool) -> dict:
+    """Assemble the per-process verdict shards into one verdict set in
+    ORIGINAL manifest order (plus the launcher-dropped entries)."""
+    per_proc = [
+        {
+            "pid": s["pid"],
+            "checked": len(s["indices"]),
+            **{k: s["stats"][k] for k in ("wall_s", "lanes", "dropped")},
+        }
+        for s in shards
+    ]
+    if reduce:
+        merged = {"histories": 0, "invalid": 0, "first_invalid": -1,
+                  "dropped": 0}
+        for s in shards:
+            r = s["results"]
+            merged["histories"] += r["histories"]
+            merged["invalid"] += r["invalid"]
+            merged["dropped"] += r.get("dropped", 0)
+            g = r.get("first_invalid", -1)
+            if g >= 0 and (
+                merged["first_invalid"] < 0 or g < merged["first_invalid"]
+            ):
+                merged["first_invalid"] = g
+        return {"reduce": True, "verdict": merged, "per_process": per_proc}
+    out: list = [None] * len(man["paths"])
+    for s in shards:
+        for i, r in zip(s["indices"], s["results"]):
+            out[i] = r
+    return {"reduce": False, "results": out, "per_process": per_proc}
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_multiprocess_check(
+    workload: str,
+    paths,
+    n_procs: int,
+    *,
+    devices_per_proc: int = 1,
+    chunk: int = 64,
+    lanes: int | None = 0,
+    mesh: bool = False,
+    reduce: bool = False,
+    timeout_s: float = 900.0,
+    cache_dir: str | None = None,
+    platform: str | None = None,
+    **opts,
+) -> tuple[list | dict, dict]:
+    """The multi-process bytes-to-verdict launcher (CLI ``check --procs``).
+
+    Spawns ``n_procs`` worker processes joined through
+    ``jax.distributed`` (worker 0 hosts the coordination service),
+    assigns every history file to exactly one worker by the
+    deterministic size-striped rule, runs the per-process pipelines,
+    and returns the coordinator's merged verdicts:
+
+    - ``reduce=False`` → ``(results, info)`` with one JSON-normalized
+      result dict per path, in order (launcher-dropped unreadable /
+      zero-length files carry explicit ``unknown`` entries);
+    - ``reduce=True`` → ``(verdict, info)`` with the collectively
+      reduced ``{"histories", "invalid", "first_invalid"}`` scalars.
+
+    A dead worker (non-zero exit, kill, timeout) aborts the whole run
+    with :class:`DistributedCheckError` and NO partial verdicts."""
+    import tempfile
+
+    from jepsen_tpu.parallel.pipeline import _lane_census
+
+    paths = [str(p) for p in paths]
+    if n_procs < 1:
+        raise ValueError(f"n_procs must be >= 1, got {n_procs}")
+    # launcher census: sizes feed the deterministic assignment, so they
+    # are stat'ed ONCE here and recorded in the manifest (workers must
+    # never re-stat — a file changing size mid-launch would desync the
+    # stripes); unreadable/zero-length files are dropped loudly — the
+    # SAME census the in-process lanes run (one policy, one code path)
+    kept, sizes, dropped = _lane_census(paths, workload)
+
+    port = _free_port()
+    with tempfile.TemporaryDirectory(prefix="jt_dist_") as td:
+        manifest = {
+            "workload": workload,
+            "paths": [paths[i] for i in kept],
+            "sizes": sizes,
+            "chunk": chunk,
+            "lanes": lanes,
+            "mesh": mesh,
+            "reduce": reduce,
+            "cache_dir": cache_dir,
+            "opts": opts,
+        }
+        mpath = os.path.join(td, "manifest.json")
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        out_path = os.path.join(td, "merged.json")
+
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = platform or "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={devices_per_proc}"
+        )
+        repo = str(Path(__file__).resolve().parent.parent.parent)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        logs = [os.path.join(td, f"worker{pid}.log") for pid in range(n_procs)]
+        procs = []
+        for pid in range(n_procs):
+            # worker output goes to files, not pipes: a chatty worker
+            # must never block on a full pipe while the launcher polls
+            lf = open(logs[pid], "w")
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "jepsen_tpu.parallel.distributed",
+                        "--worker",
+                        "--manifest", mpath,
+                        "--coordinator", f"localhost:{port}",
+                        "--process-id", str(pid),
+                        "--num-processes", str(n_procs),
+                        "--out", out_path,
+                        "--merge-timeout-s", str(min(timeout_s, 300.0)),
+                    ],
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                    cwd=repo,
+                    env=env,
+                )
+            )
+            lf.close()
+        deadline = time.monotonic() + timeout_s
+        failed: tuple[int, int | None] | None = None
+        pending = set(range(n_procs))
+        try:
+            # poll loop: the moment ANY worker dies non-zero, the run
+            # aborts — the survivors are killed rather than left to
+            # grind toward a merge that can never complete
+            while pending and failed is None:
+                for pid in sorted(pending):
+                    rc = procs[pid].poll()
+                    if rc is None:
+                        continue
+                    pending.discard(pid)
+                    if rc != 0:
+                        failed = (pid, rc)
+                        break
+                if pending and failed is None:
+                    if time.monotonic() > deadline:
+                        failed = (min(pending), None)
+                        break
+                    time.sleep(0.05)
+        finally:
+            for pr in procs:
+                if pr.poll() is None:
+                    pr.kill()
+            for pr in procs:
+                if pr.poll() is None:
+                    try:
+                        pr.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+        if failed is not None:
+            pid, rc = failed
+            try:
+                with open(logs[pid]) as fh:
+                    tail = fh.read()[-1500:]
+            except OSError:
+                tail = "<no worker log>"
+            raise DistributedCheckError(
+                f"worker {pid} of {n_procs} "
+                f"{'timed out' if rc is None else f'died (rc={rc})'} — "
+                f"aborting with no partial verdicts:\n{tail}"
+            )
+        try:
+            with open(out_path) as fh:
+                merged = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise DistributedCheckError(
+                f"coordinator produced no merged verdict file: {e}"
+            )
+    info = {
+        "n_procs": n_procs,
+        "devices_per_proc": devices_per_proc,
+        "dropped": len(dropped),
+        "per_process": merged["per_process"],
+    }
+    if reduce:
+        verdict = merged["verdict"]
+        verdict["dropped"] += len(dropped)
+        # lift kept-space counterexample index to original path space
+        if verdict["first_invalid"] >= 0:
+            verdict["first_invalid"] = kept[verdict["first_invalid"]]
+        return verdict, info
+    results: list = [None] * len(paths)
+    for j, i in enumerate(kept):
+        results[i] = merged["results"][j]
+    from jepsen_tpu.parallel.pipeline import _dropped_result
+
+    for i, reason in dropped.items():
+        results[i] = _dropped_result(workload, reason)
+    return results, info
+
+
+if __name__ == "__main__":
+    sys.exit(worker_main())

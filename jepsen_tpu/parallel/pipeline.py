@@ -75,7 +75,11 @@ class PipelineStats:
     approaching ``1 - 1/stages`` for a perfectly overlapped one.
     ``device_idle_frac``: fraction of wall clock with no device work in
     flight (the executor's target is to drive this toward 0 once the
-    first batch is staged)."""
+    first batch is staged).  Multi-lane runs (``lanes > 1``) report
+    busy seconds SUMMED across lanes, and ``device_idle_frac`` against
+    the ``lanes × wall`` device-time budget.  ``dropped`` counts
+    sources excluded by the lanes path's size census (unreadable /
+    zero-length files — each is logged; never a silent truncation)."""
 
     batches: int = 0
     histories: int = 0
@@ -85,15 +89,18 @@ class PipelineStats:
     check_busy_s: float = 0.0
     stage_overlap_frac: float = 0.0
     device_idle_frac: float = 0.0
+    lanes: int = 1
+    dropped: int = 0
 
     def finalize(self) -> "PipelineStats":
         busy = self.produce_busy_s + self.place_busy_s + self.check_busy_s
         self.stage_overlap_frac = (
             max(0.0, busy - self.wall_s) / busy if busy > 0 else 0.0
         )
+        budget = self.wall_s * max(self.lanes, 1)
         self.device_idle_frac = (
-            max(0.0, self.wall_s - self.check_busy_s) / self.wall_s
-            if self.wall_s > 0
+            max(0.0, budget - self.check_busy_s) / budget
+            if budget > 0
             else 0.0
         )
         return self
@@ -229,6 +236,103 @@ def run_pipeline(
     return results, stats.finalize()
 
 
+def run_lanes(
+    units: Sequence[Any],
+    fams: Sequence["_Family"],
+    *,
+    depth: int = 2,
+) -> tuple[list[Any], PipelineStats]:
+    """The N-lane generalization of :func:`run_pipeline`: one lane per
+    family in ``fams`` (one per addressable device), each running the
+    full produce → place → dispatch → collect loop on its own thread
+    with its own double-buffered staging slot.  Lanes claim work units
+    off ONE shared queue — an idle lane immediately takes the next
+    (largest-remaining) unit, so no device waits on another lane's
+    packing (steal-on-idle by construction).
+
+    Crash semantics match :func:`run_pipeline`: any lane failure aborts
+    the whole run with :class:`PipelineError` and NO results."""
+    import jax
+
+    n = len(units)
+    results: list[Any] = [None] * n
+    stats = PipelineStats(lanes=len(fams))
+    if n == 0:
+        return results, stats
+    abort = threading.Event()
+    failures: list[tuple[int, BaseException]] = []
+    unit_q: queue.Queue = queue.Queue()
+    for k in range(n):
+        unit_q.put(k)
+    lock = threading.Lock()
+
+    def default_collect(raw):
+        jax.block_until_ready(raw)
+        return jax.tree.map(np.asarray, raw)
+
+    def lane(i: int) -> None:
+        fam = fams[i]
+        collect = fam.collect or default_collect
+        in_flight: list[tuple[int, Any, float]] = []
+        busy = [0.0, 0.0, 0.0]  # produce, place, check
+        last_ready = time.perf_counter()
+
+        def drain_one():
+            nonlocal last_ready
+            k, raw, t_disp = in_flight.pop(0)
+            results[k] = collect(raw)
+            t_ready = time.perf_counter()
+            busy[2] += t_ready - max(t_disp, last_ready)
+            last_ready = t_ready
+
+        try:
+            while not abort.is_set():
+                try:
+                    k = unit_q.get_nowait()
+                except queue.Empty:
+                    break
+                t0 = time.perf_counter()
+                host = fam.produce(units[k])
+                busy[0] += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                placed = fam.place(host)
+                busy[1] += time.perf_counter() - t0
+                t_disp = time.perf_counter()
+                raw = fam.check(placed)
+                in_flight.append((k, raw, t_disp))
+                del placed
+                while len(in_flight) >= max(1, depth):
+                    drain_one()
+            while in_flight and not abort.is_set():
+                drain_one()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            abort.set()
+            failures.append((i, e))
+        finally:
+            with lock:
+                stats.produce_busy_s += busy[0]
+                stats.place_busy_s += busy[1]
+                stats.check_busy_s += busy[2]
+
+    t_start = time.perf_counter()
+    threads_ = [
+        threading.Thread(target=lane, args=(i,), daemon=True)
+        for i in range(len(fams))
+    ]
+    for t in threads_:
+        t.start()
+    for t in threads_:
+        t.join()
+    stats.wall_s = time.perf_counter() - t_start
+    if failures:
+        i, e = failures[0]
+        raise PipelineError(
+            f"lane {i} crashed: {type(e).__name__}: {e}"
+        ) from e
+    stats.batches = n
+    return results, stats.finalize()
+
+
 _DONATED_CACHE: dict = {}
 
 
@@ -276,8 +380,33 @@ def _chunks(seq: Sequence[Any], size: int) -> list[Sequence[Any]]:
 # ---------------------------------------------------------------------------
 
 
-def _stream_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
-    """Per-path ``(cols, full)`` stream substrates, cache → native → Python."""
+def _stripe_indices(n: int, part: int, n_parts: int) -> list[int]:
+    return list(range(part, n, n_parts))
+
+
+def _native_stripe(native_fn, paths, misses, stripe, threads, part, n_parts):
+    """Native multi-file results aligned with ``misses`` (stripe-local
+    positions).  A fully-missed stripe goes through the striped-cursor
+    native entry over the SHARED full path list (no per-lane sublist,
+    no shared cursor between concurrent lanes); partial misses (cache
+    hits in between) fall back to a compacted per-subset call."""
+    if n_parts > 1 and len(misses) == len(stripe):
+        got = native_fn(paths, threads, part=part, n_parts=n_parts)
+        if got is None:
+            return None
+        return [got[i] for i in stripe]
+    return native_fn([paths[stripe[j]] for j in misses], threads)
+
+
+def _stream_substrates(
+    paths: Sequence[Path],
+    threads: int,
+    use_cache: bool,
+    part: int = 0,
+    n_parts: int = 1,
+):
+    """``(cols, full)`` stream substrates for indices ``part::n_parts``
+    of ``paths`` (default: all), cache → native → Python."""
     from jepsen_tpu.checkers.stream_lin import _stream_rows
     from jepsen_tpu.history.fastpack import stream_rows_files
     from jepsen_tpu.history.store import read_history
@@ -286,31 +415,41 @@ def _stream_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
         save_stream_rows_cache,
     )
 
-    out: list = [None] * len(paths)
+    stripe = _stripe_indices(len(paths), part, n_parts)
+    out: list = [None] * len(stripe)
     misses = []
     if use_cache:
-        for i, p in enumerate(paths):
-            got = load_stream_rows_cache(p)
+        for j, i in enumerate(stripe):
+            got = load_stream_rows_cache(paths[i])
             if got is not None:
-                out[i] = got
+                out[j] = got
             else:
-                misses.append(i)
+                misses.append(j)
     else:
-        misses = list(range(len(paths)))
+        misses = list(range(len(stripe)))
     if misses:
-        native = stream_rows_files([paths[i] for i in misses], threads)
-        for j, i in enumerate(misses):
-            got = native[j] if native is not None else None
+        native = _native_stripe(
+            stream_rows_files, paths, misses, stripe, threads, part, n_parts
+        )
+        for k, j in enumerate(misses):
+            got = native[k] if native is not None else None
             if got is None:
-                got = _stream_rows(read_history(paths[i]))
-            out[i] = got
+                got = _stream_rows(read_history(paths[stripe[j]]))
+            out[j] = got
             if use_cache:
-                save_stream_rows_cache(paths[i], got[0], got[1])
+                save_stream_rows_cache(paths[stripe[j]], got[0], got[1])
     return out
 
 
-def _queue_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
-    """Per-path ``[n, 8]`` row matrices, cache → native → Python."""
+def _queue_substrates(
+    paths: Sequence[Path],
+    threads: int,
+    use_cache: bool,
+    part: int = 0,
+    n_parts: int = 1,
+):
+    """``[n, 8]`` row matrices for indices ``part::n_parts`` of
+    ``paths`` (default: all), cache → native → Python."""
     from jepsen_tpu.history.fastpack import pack_files
     from jepsen_tpu.history.rows import (
         load_rows_cache,
@@ -318,32 +457,42 @@ def _queue_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
         save_rows_cache,
     )
 
-    out: list = [None] * len(paths)
+    stripe = _stripe_indices(len(paths), part, n_parts)
+    out: list = [None] * len(stripe)
     misses = []
     if use_cache:
-        for i, p in enumerate(paths):
-            got = load_rows_cache(p)
+        for j, i in enumerate(stripe):
+            got = load_rows_cache(paths[i])
             if got is not None:
-                out[i] = got[1]
+                out[j] = got[1]
             else:
-                misses.append(i)
+                misses.append(j)
     else:
-        misses = list(range(len(paths)))
+        misses = list(range(len(stripe)))
     if misses:
-        native = pack_files([paths[i] for i in misses], threads)
-        for j, i in enumerate(misses):
-            got = native[j] if native is not None else None
+        native = _native_stripe(
+            pack_files, paths, misses, stripe, threads, part, n_parts
+        )
+        for k, j in enumerate(misses):
+            got = native[k] if native is not None else None
             if got is not None:
                 if use_cache:
-                    save_rows_cache(paths[i], got[0], got[1])
-                out[i] = got[1]
+                    save_rows_cache(paths[stripe[j]], got[0], got[1])
+                out[j] = got[1]
             else:
-                out[i] = rows_with_cache(paths[i])[1]
+                out[j] = rows_with_cache(paths[stripe[j]])[1]
     return out
 
 
-def _elle_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
-    """Per-path ``(mat, meta)`` elle cell substrates, cache → native →
+def _elle_substrates(
+    paths: Sequence[Path],
+    threads: int,
+    use_cache: bool,
+    part: int = 0,
+    n_parts: int = 1,
+):
+    """``(mat, meta)`` elle cell substrates for indices
+    ``part::n_parts`` of ``paths`` (default: all), cache → native →
     Python (the ``elle_mops.npz`` layer)."""
     from jepsen_tpu.checkers.elle import elle_mops_for
     from jepsen_tpu.history.fastpack import elle_mops_files
@@ -353,27 +502,79 @@ def _elle_substrates(paths: Sequence[Path], threads: int, use_cache: bool):
         save_elle_mops_cache,
     )
 
-    out: list = [None] * len(paths)
+    stripe = _stripe_indices(len(paths), part, n_parts)
+    out: list = [None] * len(stripe)
     misses = []
     if use_cache:
-        for i, p in enumerate(paths):
-            got = load_elle_mops_cache(p)
+        for j, i in enumerate(stripe):
+            got = load_elle_mops_cache(paths[i])
             if got is not None:
-                out[i] = got
+                out[j] = got
             else:
-                misses.append(i)
+                misses.append(j)
     else:
-        misses = list(range(len(paths)))
+        misses = list(range(len(stripe)))
     if misses:
-        native = elle_mops_files([paths[i] for i in misses], threads)
-        for j, i in enumerate(misses):
-            got = native[j] if native is not None else None
+        native = _native_stripe(
+            elle_mops_files, paths, misses, stripe, threads, part, n_parts
+        )
+        for k, j in enumerate(misses):
+            got = native[k] if native is not None else None
             if got is None:
-                got = elle_mops_for(read_history(paths[i]))
-            out[i] = got
+                got = elle_mops_for(read_history(paths[stripe[j]]))
+            out[j] = got
             if use_cache:
-                save_elle_mops_cache(paths[i], got[0], got[1])
+                save_elle_mops_cache(paths[stripe[j]], got[0], got[1])
     return out
+
+
+class _Stripe(Sequence):
+    """A work unit of the lanes executor: the ``part``-th residue class
+    (mod ``n_parts``) of one SHARED size-ordered path list.  Behaves
+    like the list of its paths (the family producers index and measure
+    it), while the producers' native calls stride the shared array via
+    the striped-cursor entry points instead of materializing sublists.
+    ``gids`` carries each stripe position's ORIGINAL source index (the
+    size ordering permutes them) for reduce-mode counterexamples."""
+
+    def __init__(
+        self, paths: list, part: int, n_parts: int, gids: list | None = None
+    ):
+        self.paths = paths
+        self.part = part
+        self.n_parts = n_parts
+        self._idx = _stripe_indices(len(paths), part, n_parts)
+        self.gids = gids
+
+    def indices(self) -> list[int]:
+        return self._idx
+
+    def __len__(self) -> int:
+        return len(self._idx)
+
+    def __getitem__(self, j):
+        return self.paths[self._idx[j]]
+
+
+class _Unit(list):
+    """A plain chunk that also carries its sources' global indices
+    (reduce mode: the device-side index-pmin reduces over these)."""
+
+    def __init__(self, items, gids):
+        super().__init__(items)
+        self.gids = gids
+
+
+#: int32 max — the gid of pad/sentinel batch positions (always valid,
+#: and even if one misclassified it would lose every index-pmin)
+_GID_PAD = np.iinfo(np.int32).max
+
+
+def _gids_of(chunk) -> list[int]:
+    gids = getattr(chunk, "gids", None)
+    if gids is None:
+        return list(range(len(chunk)))
+    return list(gids)
 
 
 # ---------------------------------------------------------------------------
@@ -388,6 +589,9 @@ class _Family:
     place: Callable[[Any], Any]
     convert: Callable[[Any, Any], list[dict]]  # (chunk_item, collected)
     collect: Callable[[Any], Any] | None = None  # default: block + numpy
+    # reduce mode only: (chunk_item, collected) -> {"n_invalid",
+    # "first_invalid" (chunk-local), ...} — the two-scalar batch verdict
+    reduce_convert: Callable[[Any, Any], dict] | None = None
 
 
 def _default_donate() -> bool:
@@ -424,6 +628,8 @@ def _stream_family(
     mesh=None,
     donate: bool | None = None,
     chunk_pad: int = 0,
+    device=None,
+    reduce: bool = False,
 ) -> _Family:
     import jax
 
@@ -437,11 +643,14 @@ def _stream_family(
         donate = _default_donate()
 
     def produce(chunk):
-        subs = (
-            _stream_substrates(chunk, threads, use_cache)
-            if chunk and isinstance(chunk[0], (str, Path))
-            else list(chunk)
-        )
+        if isinstance(chunk, _Stripe):
+            subs = _stream_substrates(
+                chunk.paths, threads, use_cache, chunk.part, chunk.n_parts
+            )
+        elif chunk and isinstance(chunk[0], (str, Path)):
+            subs = _stream_substrates(chunk, threads, use_cache)
+        else:
+            subs = list(chunk)
         subs = _pad_chunk(subs, chunk_pad, _STREAM_SENTINEL)
         n_max = max(m.shape[0] for m, _ in subs)
         hi = max(
@@ -458,17 +667,34 @@ def _stream_family(
 
     base_check = lambda b: stream_lin_tensor_check(b, append_fail=append_fail)
     if mesh is not None:
-        from jepsen_tpu.parallel.mesh import sharded_stream_lin
+        from jepsen_tpu.parallel.mesh import (
+            sharded_stream_lin,
+            sharded_stream_verdict,
+        )
 
-        check = lambda b: sharded_stream_lin(b, mesh, append_fail=append_fail)
+        check = lambda b: sharded_stream_lin(
+            b, mesh, append_fail=append_fail
+        )
         place = _mesh_stream_place(mesh)
     else:
+        if reduce:
+            raise ValueError("reduce mode needs a mesh")
         check = (
             donated(base_check, key=("stream", append_fail))
             if donate
             else base_check
         )
-        place = jax.device_put
+        place = _device_put_on(device)
+
+    if reduce:
+        return _reduced_family(
+            lambda chunk: produce(chunk)[0],  # drop the fulls channel
+            lambda batch: batch.type.shape[0],
+            place,
+            lambda batch, g: sharded_stream_verdict(
+                batch, mesh, append_fail=append_fail, gidx=g
+            ),
+        )
 
     def convert(item, collected):
         tensors, fulls = collected
@@ -490,7 +716,9 @@ def _stream_family(
         jax.block_until_ready(tensors)
         return jax.tree.map(np.asarray, tensors), fulls
 
-    return _Family(produce, check_pair, place_pair, convert, collect_pair)
+    return _Family(
+        produce, check_pair, place_pair, convert, collect_pair
+    )
 
 
 def _mesh_stream_place(mesh):
@@ -504,6 +732,55 @@ def _mesh_stream_place(mesh):
     return place
 
 
+def _device_put_on(device):
+    """``jax.device_put`` pinned to one lane's device (``None``: the
+    default device, the classic single-lane behavior)."""
+    import jax
+
+    if device is None:
+        return jax.device_put
+    return lambda tree: jax.device_put(tree, device)
+
+
+def _no_convert(item, collected):  # reduce-mode families have no
+    raise RuntimeError(            # per-history conversion
+        "reduce-mode family has no per-history convert"
+    )
+
+
+def _reduced_family(base_produce, batch_len, place_batch, verdict) -> _Family:
+    """The reduce-mode family shape shared by stream and queue (elle
+    adds the degenerate host-fallback fold and keeps its own): thread
+    the chunk's global-id vector (pads carry the never-wins gid) through
+    place to the family's sharded verdict, and unpack the two on-device
+    scalars.  ``verdict(host_batch, gidx)`` must return
+    ``(n_invalid, first_invalid)``."""
+
+    def produce_r(chunk):
+        host = base_produce(chunk)
+        g = np.full((batch_len(host),), _GID_PAD, np.int32)
+        gd = _gids_of(chunk)
+        g[: len(gd)] = gd
+        return host, g
+
+    def place_r(pair):
+        host, g = pair
+        return place_batch(host), g
+
+    def check_r(pair):
+        host, g = pair
+        return verdict(host, g)
+
+    def reduce_convert(item, collected):
+        n_invalid, first = collected
+        return {"n_invalid": int(n_invalid), "first_invalid": int(first)}
+
+    return _Family(
+        produce_r, check_r, place_r, _no_convert,
+        reduce_convert=reduce_convert,
+    )
+
+
 def _queue_family(
     threads: int,
     use_cache: bool,
@@ -511,6 +788,8 @@ def _queue_family(
     mesh=None,
     donate: bool | None = None,
     chunk_pad: int = 0,
+    device=None,
+    reduce: bool = False,
 ) -> _Family:
     import jax
 
@@ -523,11 +802,14 @@ def _queue_family(
         donate = _default_donate()
 
     def produce(chunk):
-        mats = (
-            _queue_substrates(chunk, threads, use_cache)
-            if chunk and isinstance(chunk[0], (str, Path))
-            else list(chunk)
-        )
+        if isinstance(chunk, _Stripe):
+            mats = _queue_substrates(
+                chunk.paths, threads, use_cache, chunk.part, chunk.n_parts
+            )
+        elif chunk and isinstance(chunk[0], (str, Path)):
+            mats = _queue_substrates(chunk, threads, use_cache)
+        else:
+            mats = list(chunk)
         mats = _pad_chunk(mats, chunk_pad, np.zeros((0, 8), np.int32))
         n_max = max(m.shape[0] for m in mats)
         vmax = max(
@@ -543,17 +825,33 @@ def _queue_family(
 
     base_check = lambda p: combined_tensor_check(p, delivery=delivery)
     if mesh is not None:
-        from jepsen_tpu.parallel.mesh import shard_packed, sharded_check
+        from jepsen_tpu.parallel.mesh import (
+            shard_packed,
+            sharded_check,
+            sharded_queue_verdict,
+        )
 
         check = lambda p: sharded_check(p, mesh, delivery=delivery)
         place = lambda p: shard_packed(p, mesh)
     else:
+        if reduce:
+            raise ValueError("reduce mode needs a mesh")
         check = (
             donated(base_check, key=("queue", delivery))
             if donate
             else base_check
         )
-        place = jax.device_put
+        place = _device_put_on(device)
+
+    if reduce:
+        return _reduced_family(
+            produce,
+            lambda packed: packed.f.shape[0],
+            lambda packed: shard_packed(packed, mesh),
+            lambda packed, g: sharded_queue_verdict(
+                packed, mesh, delivery=delivery, gidx=g
+            ),
+        )
 
     def convert(item, collected):
         tq, ql = collected
@@ -578,6 +876,8 @@ def _elle_family(
     mesh=None,
     donate: bool | None = None,
     chunk_pad: int = 0,
+    device=None,
+    reduce: bool = False,
 ) -> _Family:
     """Elle chunks carry a degenerate-history splice: tensor-
     representable histories go through the fused device inference,
@@ -610,12 +910,15 @@ def _elle_family(
         mesh_h = 1
 
     def produce(chunk):
-        from_paths = chunk and isinstance(chunk[0], (str, Path))
-        subs = (
-            _elle_substrates(chunk, threads, use_cache)
-            if from_paths
-            else [(m, g) for m, g in chunk]
-        )
+        from_paths = bool(chunk) and isinstance(chunk[0], (str, Path))
+        if isinstance(chunk, _Stripe):
+            subs = _elle_substrates(
+                chunk.paths, threads, use_cache, chunk.part, chunk.n_parts
+            )
+        elif from_paths:
+            subs = _elle_substrates(chunk, threads, use_cache)
+        else:
+            subs = [(m, g) for m, g in chunk]
         subs = _pad_chunk(subs, chunk_pad, sentinel)
         live, mops, degen = split_elle_mops(subs)
         if mesh_h > 1 and live and len(live) % mesh_h:
@@ -642,14 +945,85 @@ def _elle_family(
         return mops, metas, live, degen, degen_results
 
     if mesh is not None:
-        from jepsen_tpu.parallel.mesh import _hist_sharded
+        from jepsen_tpu.parallel.mesh import (
+            _hist_sharded,
+            sharded_elle_mops_verdict,
+        )
 
         place_mops = lambda m: _hist_sharded(m, mesh)
+        check_mops = elle_mops_check
     else:
-        place_mops = jax.device_put
-    check_mops = donated(elle_mops_check) if donate and mesh is None else (
-        elle_mops_check
-    )
+        if reduce:
+            raise ValueError("reduce mode needs a mesh")
+        place_mops = _device_put_on(device)
+        check_mops = (
+            donated(elle_mops_check) if donate else elle_mops_check
+        )
+
+    if reduce:
+        base_produce = produce
+
+        def produce_r(chunk):
+            mops, _metas, live, degen, degen_results = base_produce(chunk)
+            gd = _gids_of(chunk)
+            g = None
+            if mops is not None:
+                # device-batch position b holds chunk position live[b];
+                # sentinel pads (live[b] beyond the true chunk) carry
+                # the never-wins pad gid
+                g = np.asarray(
+                    [gd[i] if i < len(gd) else _GID_PAD for i in live],
+                    np.int32,
+                )
+            return mops, g, degen, degen_results, gd
+
+        def place_r(item):
+            mops, g, degen, degen_results, gd = item
+            if mops is not None:
+                mops = place_mops(mops)
+            return mops, g, degen, degen_results, gd
+
+        def check_r(item):
+            mops, g, degen, degen_results, gd = item
+            raw = (
+                sharded_elle_mops_verdict(mops, mesh, gidx=g)
+                if mops is not None
+                else None
+            )
+            return raw, degen, degen_results, gd
+
+        def collect_r(raw_tuple):
+            raw, degen, degen_results, gd = raw_tuple
+            if raw is not None:
+                jax.block_until_ready(raw)
+                raw = jax.tree.map(np.asarray, raw)
+            return raw, degen, degen_results, gd
+
+        def reduce_convert(chunk, collected):
+            # fold the host-fallback (degenerate) verdicts into the
+            # reduced device verdict: counts add, first-invalid takes
+            # the minimum GLOBAL source index across both populations
+            raw, degen, degen_results, gd = collected
+            n_invalid = sum(
+                1 for r in degen_results if r["valid?"] is not True
+            )
+            first = -1
+            for i, r in zip(degen, degen_results):
+                if r["valid?"] is not True and (
+                    first < 0 or gd[i] < first
+                ):
+                    first = gd[i]
+            if raw is not None:
+                nb, fdev = int(raw[0]), int(raw[1])
+                n_invalid += nb
+                if fdev >= 0 and (first < 0 or fdev < first):
+                    first = fdev
+            return {"n_invalid": n_invalid, "first_invalid": first}
+
+        return _Family(
+            produce_r, check_r, place_r, _no_convert, collect_r,
+            reduce_convert,
+        )
 
     def place(item):
         mops, metas, live, degen, degen_results = item
@@ -706,6 +1080,8 @@ def family_for(workload: str, **opts) -> _Family:
         mesh=opts.get("mesh"),
         donate=opts.get("donate"),
         chunk_pad=opts.get("chunk_pad", 0),
+        device=opts.get("device"),
+        reduce=opts.get("reduce", False),
     )
     if workload == "stream":
         return _stream_family(
@@ -734,6 +1110,195 @@ def family_for(workload: str, **opts) -> _Family:
     )
 
 
+def _pad_for(chunk: int, opts: dict) -> int:
+    pad = chunk
+    if opts.get("mesh") is not None:
+        # sharded placement needs the batch axis divisible by the mesh's
+        # hist extent; sentinel-pad each chunk up to the next multiple
+        from jepsen_tpu.parallel.mesh import HIST_AXIS
+
+        h = opts["mesh"].shape[HIST_AXIS]
+        pad = ((chunk + h - 1) // h) * h
+    return pad
+
+
+def _merge_reduced(fam: "_Family", items, collected) -> dict:
+    """Fold per-chunk two-scalar verdicts into one batch verdict dict.
+    Each chunk's ``first_invalid`` is already a GLOBAL source index
+    (the device reduction pmin-ed over the chunk's gid vector)."""
+    merged = {"histories": 0, "invalid": 0, "first_invalid": -1}
+    for it, col in zip(items, collected):
+        d = fam.reduce_convert(it, col)
+        merged["histories"] += len(it)
+        merged["invalid"] += d["n_invalid"]
+        g = d["first_invalid"]
+        if g >= 0 and (
+            merged["first_invalid"] < 0 or g < merged["first_invalid"]
+        ):
+            merged["first_invalid"] = g
+    return merged
+
+
+def _dropped_result(workload: str, reason: str) -> dict:
+    """An explicit per-source verdict for a file the lane census dropped
+    — the results list keeps one entry per source, never a silent
+    truncation."""
+    from jepsen_tpu.checkers.protocol import UNKNOWN
+
+    row = {"valid?": UNKNOWN, "error": reason}
+    if workload == "queue":
+        return {"queue": dict(row), "linear": dict(row)}
+    return {workload: dict(row)}
+
+
+def _lane_census(sources, workload):
+    """Stat every path source; split into (kept indices, sizes,
+    {dropped index: reason}).  Unreadable and zero-length files cannot
+    be size-balanced (and a 0-byte history carries no ops) — each drop
+    is LOGGED and later counted in the run's stats."""
+    import logging
+    import os
+
+    log = logging.getLogger(__name__)
+    kept, sizes, dropped = [], [], {}
+    for i, p in enumerate(sources):
+        try:
+            sz = os.stat(p).st_size
+        except OSError as e:
+            reason = f"unreadable history file: {e}"
+            log.warning(
+                "lane census: dropping %s (%s) — counted in stats.dropped",
+                p, e,
+            )
+            dropped[i] = reason
+            continue
+        if sz == 0:
+            reason = "zero-length history file"
+            log.warning(
+                "lane census: dropping zero-length %s — counted in "
+                "stats.dropped", p,
+            )
+            dropped[i] = reason
+            continue
+        kept.append(i)
+        sizes.append(sz)
+    return kept, sizes, dropped
+
+
+def _check_sources_lanes(
+    workload: str,
+    sources: list,
+    *,
+    chunk: int,
+    depth: int,
+    lanes: int,
+    reduce: bool = False,
+    **opts,
+):
+    """N-lane bytes-to-verdict: size-aware unit balancing (largest-first
+    round-robin stripes of one shared ordered path list) over per-device
+    lanes claiming units off a shared queue (steal-on-idle)."""
+    import jax
+
+    devices = jax.local_devices()
+    n_lanes = len(devices) if lanes <= 0 else max(1, min(lanes, len(devices)))
+    paths_mode = bool(sources) and all(
+        isinstance(s, (str, Path)) for s in sources
+    )
+    if paths_mode:
+        kept, sizes, dropped = _lane_census(sources, workload)
+    else:
+        kept, sizes, dropped = list(range(len(sources))), [1] * len(sources), {}
+    # largest-first ordering; round-robin striping over it yields
+    # byte-balanced units of at most ``chunk`` files each
+    order = sorted(range(len(kept)), key=lambda j: -sizes[j])
+    ordered_idx = [kept[j] for j in order]
+    ordered = [sources[i] for i in ordered_idx]
+    if not ordered:  # nothing survived the census (or empty input)
+        stats = PipelineStats(lanes=n_lanes, dropped=len(dropped))
+        if reduce:
+            return (
+                {
+                    "histories": 0,
+                    "invalid": 0,
+                    "first_invalid": -1,
+                    "dropped": len(dropped),
+                },
+                stats,
+            )
+        out = [
+            _dropped_result(workload, dropped[i])
+            for i in range(len(sources))
+        ]
+        stats.histories = len(out)
+        return out, stats
+    n_units = max(1, (len(ordered) + chunk - 1) // chunk)
+    unit_len = (len(ordered) + n_units - 1) // n_units
+    opts = dict(opts)
+    opts["reduce"] = reduce
+    opts.setdefault("chunk_pad", _pad_for(max(unit_len, 1), opts))
+    unit_indices = [
+        _stripe_indices(len(ordered), k, n_units) for k in range(n_units)
+    ]
+    if paths_mode:
+        units = [
+            _Stripe(
+                ordered, k, n_units,
+                gids=[ordered_idx[i] for i in unit_indices[k]],
+            )
+            for k in range(n_units)
+        ]
+    else:
+        units = [
+            _Unit(
+                ordered[k::n_units],
+                [ordered_idx[i] for i in unit_indices[k]],
+            )
+            for k in range(n_units)
+        ]
+    mesh = opts.get("mesh")
+    if mesh is not None:
+        # all lanes feed the shared mesh (sharded staging/dispatch);
+        # the lanes still overlap each other's host packing.  Dispatch
+        # is serialized through one gate: concurrent enqueues of
+        # collective programs from different threads interleave the
+        # per-device queues inconsistently and deadlock the CPU
+        # backend's all-reduce rendezvous (in-order in-flight programs
+        # — the single-thread pipelined shape — are safe)
+        base = family_for(workload, **opts)
+        gate = threading.Lock()
+
+        def locked_check(placed, _check=base.check):
+            with gate:
+                return _check(placed)
+
+        fams = [
+            dataclasses.replace(base, check=locked_check)
+            for _ in range(n_lanes)
+        ]
+    else:
+        fams = [
+            family_for(workload, device=devices[i], **opts)
+            for i in range(n_lanes)
+        ]
+    collected, stats = run_lanes(units, fams, depth=depth)
+    stats.dropped = len(dropped)
+    if reduce:
+        merged = _merge_reduced(fams[0], units, collected)
+        merged["dropped"] = len(dropped)
+        stats.histories = merged["histories"]
+        return merged, stats
+    out: list = [None] * len(sources)
+    for k, (unit, col) in enumerate(zip(units, collected)):
+        conv = fams[0].convert(unit, col)
+        for j, r in enumerate(conv):
+            out[ordered_idx[unit_indices[k][j]]] = r
+    for i, reason in dropped.items():
+        out[i] = _dropped_result(workload, reason)
+    stats.histories = len(out)
+    return out, stats
+
+
 def check_sources(
     workload: str,
     sources: Sequence[Any],
@@ -741,6 +1306,8 @@ def check_sources(
     chunk: int = DEFAULT_CHUNK,
     serial: bool = False,
     depth: int = 2,
+    lanes: int | None = None,
+    reduce: bool = False,
     **opts,
 ) -> tuple[list[dict], PipelineStats]:
     """Bytes-to-verdict over ``sources`` (file paths, or pre-exploded
@@ -751,18 +1318,41 @@ def check_sources(
     ``{"elle": ...}`` with exactly the serial checkers' content (the
     differential contract).  ``serial=True`` is the triage escape
     hatch: the same stages run strictly serially on the calling thread
-    — byte-identical results, no overlap."""
-    pad = chunk
-    if opts.get("mesh") is not None:
-        # sharded placement needs the batch axis divisible by the mesh's
-        # hist extent; sentinel-pad each chunk up to the next multiple
-        from jepsen_tpu.parallel.mesh import HIST_AXIS
+    — byte-identical results, no overlap.
 
-        h = opts["mesh"].shape[HIST_AXIS]
-        pad = ((chunk + h - 1) // h) * h
-    opts.setdefault("chunk_pad", pad)
+    ``lanes`` opts into the scale-out executor: one input lane (producer
+    + staging slot) per addressable device (``lanes=0``: all local
+    devices), with size-aware largest-first unit balancing and
+    steal-on-idle — see :func:`run_lanes`.  Unreadable/zero-length path
+    sources are dropped from lane balancing with a logged warning, an
+    explicit ``unknown`` verdict entry, and a ``stats.dropped`` count.
+
+    ``reduce=True`` (requires ``mesh``) returns the collective-reduced
+    batch verdict instead of per-history results: one dict
+    ``{"histories", "invalid", "first_invalid"}`` whose scalars were
+    combined ON DEVICE (psum / index-pmin) — the host never gathers the
+    per-history verdict tensors."""
+    if lanes is not None and not serial:
+        return _check_sources_lanes(
+            workload,
+            list(sources),
+            chunk=chunk,
+            depth=depth,
+            lanes=lanes,
+            reduce=reduce,
+            **opts,
+        )
+    opts = dict(opts)
+    opts["reduce"] = reduce
+    opts.setdefault("chunk_pad", _pad_for(chunk, opts))
     fam = family_for(workload, **opts)
     items = _chunks(list(sources), chunk)
+    if reduce:
+        # contiguous chunks: chunk k's gids are its source offsets
+        items = [
+            _Unit(it, list(range(k * chunk, k * chunk + len(it))))
+            for k, it in enumerate(items)
+        ]
     if serial:
         import jax
 
@@ -796,6 +1386,11 @@ def check_sources(
             collect=fam.collect,
             depth=depth,
         )
+    if reduce:
+        merged = _merge_reduced(fam, items, collected)
+        merged["dropped"] = 0
+        stats.histories = merged["histories"]
+        return merged, stats
     results: list[dict] = []
     for it, col in zip(items, collected):
         results.extend(fam.convert(it, col))
@@ -842,7 +1437,7 @@ class PipelinedChecker:
         path = self._resolve_path(opts)
         if path is not None:
             results, _ = check_sources(
-                self.workload, [path], chunk=1, **self._opts
+                self.workload, [path], chunk=1, **self._resolved_opts()
             )
         else:
             # no file (e.g. a storeless unit-test run): serial family
@@ -851,6 +1446,28 @@ class PipelinedChecker:
         if self._shared is not None:
             self._shared[self.workload] = results
         return results[0][self.subkey]
+
+    def _resolved_opts(self) -> dict:
+        """``mesh=True`` resolves lazily to a device mesh at check time
+        (checkers are wired before any device use; a mesh object must
+        not be built at soak-assembly time).  A single long soak
+        history has ONE file, so the scale-out axis is the op axis:
+        queue/stream get a seq-parallel mesh over all local devices
+        (the per-history count/scan programs shard their op blocks and
+        psum-combine); elle's seq path needs txn-lane divisibility, so
+        it keeps the plain single-device dispatch."""
+        o = dict(self._opts)
+        if o.get("mesh") is True:
+            import jax
+
+            from jepsen_tpu.parallel.mesh import checker_mesh
+
+            n = len(jax.local_devices())
+            if self.workload in ("queue", "stream") and n > 1:
+                o["mesh"] = checker_mesh(seq=n)
+            else:
+                o.pop("mesh")
+        return o
 
     def _from_ops(self, history):
         if self.workload == "stream":
@@ -873,31 +1490,36 @@ class PipelinedChecker:
                 {"elle": check_elle_batch([history], model=model)[0]}
             ]
         results, _ = check_sources(
-            self.workload, subs, chunk=1, serial=True, **self._opts
+            self.workload, subs, chunk=1, serial=True,
+            **self._resolved_opts(),
         )
         return results
 
 
-def attach_pipelined_checkers(test, workload: str) -> bool:
+def attach_pipelined_checkers(test, workload: str, **scale_opts) -> bool:
     """Swap a built test's family checkers for pipeline-backed ones
     (``tools/soak.py`` and friends: the post-run analysis then runs
     bytes-to-verdict from the stored ``history.jsonl`` through the
     executor instead of re-packing Op objects on one thread).  Contract
     levels (delivery / append-fail / consistency model) are inherited
     from the checkers being replaced, so the verdict semantics cannot
-    drift.  Returns True when the swap applied (False: family has no
+    drift.  ``scale_opts`` forward scale-out knobs (``lanes`` — 0 = one
+    lane per local device) into :func:`check_sources`.  Returns True
+    when the swap applied (False: family has no
     pipeline — e.g. mutex — or no composed checkers to swap)."""
     checkers = getattr(getattr(test, "checker", None), "checkers", None)
     if checkers is None:
         return False
     shared: dict = {}
+    scale_opts = {k: v for k, v in scale_opts.items() if v is not None}
     if workload == "queue" and {"queue", "linear"} <= set(checkers):
         delivery = getattr(
             checkers["linear"], "delivery", "exactly-once"
         )
         for sub in ("queue", "linear"):
             checkers[sub] = PipelinedChecker(
-                "queue", None, sub, shared=shared, delivery=delivery
+                "queue", None, sub, shared=shared, delivery=delivery,
+                **scale_opts,
             )
         return True
     if workload == "stream" and "stream" in checkers:
@@ -906,13 +1528,14 @@ def attach_pipelined_checkers(test, workload: str) -> bool:
         )
         checkers["stream"] = PipelinedChecker(
             "stream", None, "stream", shared=shared,
-            append_fail=append_fail,
+            append_fail=append_fail, **scale_opts,
         )
         return True
     if workload == "elle" and "elle" in checkers:
         model = getattr(checkers["elle"], "model", "serializable")
         checkers["elle"] = PipelinedChecker(
-            "elle", None, "elle", shared=shared, model=model
+            "elle", None, "elle", shared=shared, model=model,
+            **scale_opts,
         )
         return True
     return False

@@ -1451,25 +1451,35 @@ void jt_stream_free(JtStreamResult* r) {
 
 namespace {
 
+// Cursor partitioning (the scale-out input lanes): a caller owning lane
+// `part` of `n_parts` claims only indices i with i % n_parts == part, so
+// N concurrent lane/process callers can stride ONE shared path array
+// with no shared atomic cursor between them — each call's cursor walks
+// its own residue class.  part=0/n_parts=1 is the classic full scan.
 template <typename R, R* (*ONE)(const char*)>
 void** pack_files_pool(const char* const* paths, int32_t n,
-                       int32_t threads) {
-  if (n < 0) return nullptr;
+                       int32_t threads, int32_t part, int32_t n_parts) {
+  if (n < 0 || n_parts <= 0 || part < 0 || part >= n_parts) return nullptr;
   auto** out = static_cast<void**>(std::calloc(
       static_cast<size_t>(n) + 1, sizeof(void*)));
   if (!out) return nullptr;
+  // stripe size: indices part, part+n_parts, ... below n
+  int32_t n_mine = n > part ? (n - part + n_parts - 1) / n_parts : 0;
+  if (n_mine == 0) return out;
   int32_t hw = static_cast<int32_t>(std::thread::hardware_concurrency());
   if (threads <= 0) threads = hw > 0 ? hw : 2;
-  if (threads > n) threads = n;
+  if (threads > n_mine) threads = n_mine;
   if (threads <= 1) {
-    for (int32_t i = 0; i < n; ++i) out[i] = ONE(paths[i]);
+    for (int32_t k = 0; k < n_mine; ++k)
+      out[part + k * n_parts] = ONE(paths[part + k * n_parts]);
     return out;
   }
   std::atomic<int32_t> cursor{0};
   auto worker = [&]() {
     while (true) {
-      int32_t i = cursor.fetch_add(1, std::memory_order_relaxed);
-      if (i >= n) return;
+      int32_t k = cursor.fetch_add(1, std::memory_order_relaxed);
+      if (k >= n_mine) return;
+      int32_t i = part + k * n_parts;
       out[i] = ONE(paths[i]);
     }
   };
@@ -1487,21 +1497,48 @@ extern "C" {
 JtPackResult** jt_pack_files(const char* const* paths, int32_t n,
                              int32_t threads) {
   return reinterpret_cast<JtPackResult**>(
-      pack_files_pool<JtPackResult, jt_pack_file>(paths, n, threads));
+      pack_files_pool<JtPackResult, jt_pack_file>(paths, n, threads, 0, 1));
 }
 
 JtStreamResult** jt_stream_rows_files(const char* const* paths, int32_t n,
                                       int32_t threads) {
   return reinterpret_cast<JtStreamResult**>(
       pack_files_pool<JtStreamResult, jt_stream_rows_file>(
-          paths, n, threads));
+          paths, n, threads, 0, 1));
 }
 
 JtElleMopsResult** jt_elle_mops_files(const char* const* paths, int32_t n,
                                       int32_t threads) {
   return reinterpret_cast<JtElleMopsResult**>(
       pack_files_pool<JtElleMopsResult, jt_elle_mops_file>(
-          paths, n, threads));
+          paths, n, threads, 0, 1));
+}
+
+// Striped variants (per-device input lanes / per-process file ranges):
+// pack only indices i ≡ part (mod n_parts) of the SHARED path array;
+// slots outside the stripe stay NULL in the returned arena.
+JtPackResult** jt_pack_files_part(const char* const* paths, int32_t n,
+                                  int32_t threads, int32_t part,
+                                  int32_t n_parts) {
+  return reinterpret_cast<JtPackResult**>(
+      pack_files_pool<JtPackResult, jt_pack_file>(
+          paths, n, threads, part, n_parts));
+}
+
+JtStreamResult** jt_stream_rows_files_part(const char* const* paths,
+                                           int32_t n, int32_t threads,
+                                           int32_t part, int32_t n_parts) {
+  return reinterpret_cast<JtStreamResult**>(
+      pack_files_pool<JtStreamResult, jt_stream_rows_file>(
+          paths, n, threads, part, n_parts));
+}
+
+JtElleMopsResult** jt_elle_mops_files_part(const char* const* paths,
+                                           int32_t n, int32_t threads,
+                                           int32_t part, int32_t n_parts) {
+  return reinterpret_cast<JtElleMopsResult**>(
+      pack_files_pool<JtElleMopsResult, jt_elle_mops_file>(
+          paths, n, threads, part, n_parts));
 }
 
 // frees only the pointer arena — elements are freed by jt_*_free

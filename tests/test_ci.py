@@ -479,3 +479,110 @@ class TestHclGate:
         from jepsen_tpu.utils.hcl import check_hcl
 
         assert check_hcl("a =\nb = 2\n")
+
+
+class TestBenchScaleOutSmoke:
+    """Offline gates for the PR-5 scale-out bench schema: the
+    ``north_star`` wall-time row and the virtual-device ``scaling``
+    section must keep their keys (``north_star.wall_s``,
+    ``scaling.devices``, ``scaling.e2e_histories_per_sec``) — schema
+    regressions fail here, not on a chip window.  Tiny configs; the
+    scaling smoke runs two real subprocess points (1 and 2 virtual
+    devices) through the meshed multi-lane reduced pipeline."""
+
+    @pytest.fixture()
+    def bench(self):
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        import bench as bench_mod
+
+        return bench_mod
+
+    def test_north_star_section_schema(self, bench):
+        details = {}
+        bench._bench_north_star(
+            details, histories=24, base_n=8, n_ops=40, chunk=8
+        )
+        ns = details["north_star"]
+        for key in (
+            "wall_s",
+            "vs_baseline_target_s",
+            "met_target",
+            "e2e_histories_per_sec",
+            "histories",
+            "devices",
+            "lanes",
+            "backend",
+        ):
+            assert key in ns, f"north_star schema lost key {key!r}"
+        assert ns["wall_s"] > 0
+        assert ns["vs_baseline_target_s"] == 60
+        assert ns["histories"] == 24
+        assert ns["e2e_histories_per_sec"] > 0
+        # the virtual mesh the conftest pins: all 8 devices fed
+        assert ns["devices"] == 8 and ns["lanes"] == 8
+
+    def test_scaling_section_schema(self, bench):
+        details = {}
+        bench._bench_scaling(
+            details,
+            device_counts=(1, 2),
+            files=6,
+            repeat=1,
+            chunk=4,
+            persist=False,  # the smoke must never touch BENCH_DETAILS
+        )
+        sc = details["scaling"]
+        assert sc["devices"] == [1, 2]
+        for fam in ("stream", "elle"):
+            rates = sc["e2e_histories_per_sec"][fam]
+            assert len(rates) == 2
+            assert all(r and r > 0 for r in rates), (fam, sc)
+        assert "host_cores" in sc and "note" in sc
+
+
+class TestDistributedSpawnSmoke:
+    """2-process spawn smoke of the distributed checker under
+    JAX_PLATFORMS=cpu: the jax.distributed join, the deterministic
+    stripe assignment, the per-process pipelines, and the KV-store
+    verdict merge must all work without a chip — scale-out regressions
+    fail the suite here."""
+
+    def test_two_process_stream_check(self, tmp_path):
+        from jepsen_tpu.history.store import write_history_jsonl
+        from jepsen_tpu.history.synth import (
+            StreamSynthSpec,
+            synth_stream_batch,
+        )
+        from jepsen_tpu.parallel.distributed import run_multiprocess_check
+
+        base = synth_stream_batch(4, StreamSynthSpec(n_ops=20, seed=2),
+                                  lost=1)
+        files = []
+        for i, sh in enumerate(base):
+            p = tmp_path / f"h{i}.jsonl"
+            write_history_jsonl(p, sh.ops)
+            files.append(p)
+        results, info = run_multiprocess_check(
+            "stream", files, 2, devices_per_proc=1, chunk=2,
+            timeout_s=300,
+        )
+        assert info["n_procs"] == 2
+        assert sum(p["checked"] for p in info["per_process"]) == 4
+        assert len(results) == 4
+        from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+
+        for r, sh in zip(results, base):
+            assert (
+                r["stream"]["valid?"]
+                == check_stream_lin_cpu(sh.ops)["valid?"]
+            )
+        assert any(r["stream"]["valid?"] is not True for r in results)

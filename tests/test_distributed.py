@@ -1,147 +1,153 @@
-"""Multi-process checker plane: ``init_multihost`` over localhost.
+"""Multi-process checker plane: the real ``jax.distributed`` harness.
 
-``jax.distributed`` joins N OS processes (each holding its share of
-virtual CPU devices) into one 8-device runtime and the sharded
-quorum-queue check runs pod-style over the global ``(hist, seq)`` mesh.
-This is the DCN story of SURVEY.md §2.4 exercised for real — process 0
-is the coordinator — with the verdict differentially checked against the
-single-process CPU reference.  Parametrized over pod shapes: 2×4 (two
-hosts) and 4×2 (four hosts, every mesh row crossing a process
-boundary).
+``parallel/distributed.py`` spawns N worker processes joined through
+``jax.distributed`` (process 0 hosts the coordination service), assigns
+every history file to exactly one worker by the deterministic
+size-striped rule, runs per-process pipelines over each process's OWN
+local devices, and merges the verdicts through the coordination
+service's key-value store.  Computation never crosses the process
+boundary — which is why this harness runs on the CPU backend, where XLA
+has no cross-process programs (the pre-PR-5 version of this file tried
+a global mesh over virtual CPU devices and failed since seed with
+"Multiprocess computations aren't implemented on the CPU backend").
+
+Parametrized over pod shapes: 2×4 (two processes, four virtual devices
+each) and 4×2 (four processes, two devices each).  The verdicts are
+differentially checked against the serial oracle on the same files.
 """
+
+from __future__ import annotations
 
 import json
-import socket
-import subprocess
-import sys
-from pathlib import Path
-
-REPO = Path(__file__).resolve().parent.parent
-
-_WORKER = r"""
-import json, os, sys
-
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + f" --xla_force_host_platform_device_count={sys.argv[3]}"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
-port, pid, n_procs = sys.argv[1], int(sys.argv[2]), int(sys.argv[4])
-
-from jepsen_tpu.parallel.distributed import (
-    global_checker_mesh,
-    init_multihost,
-    is_coordinator,
-)
-
-init_multihost(f"localhost:{port}", num_processes=n_procs, process_id=pid)
-assert jax.process_count() == n_procs, jax.process_count()
-assert len(jax.devices()) == 8, len(jax.devices())
-assert is_coordinator() == (pid == 0)
-
-from jepsen_tpu.history.encode import pack_histories
-from jepsen_tpu.history.synth import SynthSpec, synth_batch
-from jepsen_tpu.parallel import shard_packed, sharded_total_queue
-
-# identical data on both processes (same seed) -> consistent global array
-shs = synth_batch(8, SynthSpec(n_ops=40, seed=7), lost=2)
-packed = pack_histories([s.ops for s in shs], length=128)
-mesh = global_checker_mesh(seq=2)
-assert dict(mesh.shape) == {"hist": 4, "seq": 2}
-sharded = shard_packed(packed, mesh)
-tq = sharded_total_queue(sharded, mesh)
-
-# every process sees the same global verdict via process_allgather
-from jax.experimental import multihost_utils
-
-valid = [
-    bool(v) for v in multihost_utils.process_allgather(tq.valid, tiled=True)
-]
-lost = int((multihost_utils.process_allgather(tq.lost, tiled=True) > 0).sum())
-
-# seq-parallel stream program pod-style: its phase combines and boundary
-# ppermute now cross the process boundary (the DCN path for real pods)
-from jepsen_tpu.checkers.stream_lin import pack_stream_histories
-from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
-from jepsen_tpu.parallel import sharded_stream_lin
-
-sshs = synth_stream_batch(4, StreamSynthSpec(n_ops=40, seed=3), lost=1)
-sbatch = pack_stream_histories([s.ops for s in sshs])
-st = sharded_stream_lin(sbatch, mesh)
-svalid = [
-    bool(v) for v in multihost_utils.process_allgather(st.valid, tiled=True)
-]
-print(
-    json.dumps(
-        {"pid": pid, "valid": valid, "lost": lost, "stream_valid": svalid}
-    ),
-    flush=True,
-)
-"""
-
+import os
 
 import pytest
+
+from jepsen_tpu.history.store import _json_default, write_history_jsonl
+from jepsen_tpu.history.synth import (
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_stream_batch,
+)
+from jepsen_tpu.parallel.distributed import (
+    DistributedCheckError,
+    assign_stripes,
+    run_multiprocess_check,
+)
+
+
+def _norm(x):
+    """JSON-normalize verdicts: the distributed merge round-trips JSON
+    (numpy scalars become plain ints/bools), the serial oracle doesn't."""
+    return json.loads(json.dumps(x, default=_json_default))
+
+
+def _write(tmp_path, base, tag="h"):
+    files = []
+    for i, sh in enumerate(base):
+        p = tmp_path / f"{tag}{i:03d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+def test_assign_stripes_deterministic_and_balanced():
+    sizes = [10, 500, 30, 400, 20, 300, 40, 200]
+    stripes = assign_stripes(sizes, 3)
+    # every index exactly once
+    assert sorted(i for s in stripes for i in s) == list(range(8))
+    # identical on recompute (the cross-process contract)
+    assert stripes == assign_stripes(sizes, 3)
+    # largest-first round-robin: the three biggest files land on three
+    # DIFFERENT processes
+    top3 = {1, 3, 5}
+    assert {s[0] for s in stripes} == top3
 
 
 @pytest.mark.parametrize(
     "n_procs,devices_per_proc", [(2, 4), (4, 2)],
     ids=["pod2x4", "pod4x2"],
 )
-def test_init_multihost_sharded_check(n_procs, devices_per_proc):
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
+def test_multiprocess_check_matches_serial(
+    tmp_path, n_procs, devices_per_proc
+):
+    base = synth_stream_batch(
+        10, StreamSynthSpec(n_ops=30, seed=3), lost=1, duplicated=1
+    )
+    files = _write(tmp_path, base)
+    results, info = run_multiprocess_check(
+        "stream",
+        files,
+        n_procs,
+        devices_per_proc=devices_per_proc,
+        chunk=3,
+        timeout_s=420,
+    )
+    assert info["n_procs"] == n_procs
+    # every worker checked its deterministic share, and together they
+    # covered the corpus exactly once
+    per_proc = info["per_process"]
+    assert len(per_proc) == n_procs
+    assert sum(p["checked"] for p in per_proc) == len(files)
+    assert all(p["lanes"] >= 1 for p in per_proc)
 
-    procs = [
-        subprocess.Popen(
-            [
-                sys.executable, "-c", _WORKER, str(port), str(pid),
-                str(devices_per_proc), str(n_procs),
-            ],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            cwd=REPO,
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    serial, _ = check_sources("stream", files, chunk=3, serial=True)
+    assert _norm(results) == _norm(serial)
+    # the corpus carries seeded anomalies — the merged verdicts must
+    # flag them (not just agree on all-green)
+    assert any(r["stream"]["valid?"] is not True for r in results)
+
+
+def test_multiprocess_queue_reduce_and_census(tmp_path):
+    """2-process queue family in REDUCE mode: the merged two-scalar
+    verdict matches the serial oracle's counts, launcher-dropped files
+    are counted, and both sub-checkers fold into the combined valid."""
+    base = synth_batch(8, SynthSpec(n_ops=40, seed=7), lost=1, duplicated=1)
+    files = _write(tmp_path, base)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    verdict, info = run_multiprocess_check(
+        "queue",
+        files + [empty],
+        2,
+        devices_per_proc=2,
+        chunk=3,
+        mesh=True,
+        reduce=True,
+        timeout_s=420,
+    )
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    serial, _ = check_sources("queue", files, chunk=3, serial=True)
+    invalid = [
+        not (
+            r["queue"]["valid?"] is True and r["linear"]["valid?"] is True
         )
-        for pid in range(n_procs)
+        for r in serial
     ]
-    outs = []
+    assert verdict["histories"] == len(files)
+    assert verdict["invalid"] == sum(invalid)
+    assert verdict["first_invalid"] == (
+        invalid.index(True) if any(invalid) else -1
+    )
+    assert verdict["dropped"] == 1 and info["dropped"] == 1
+
+
+def test_dead_worker_aborts_with_no_partial_verdicts(tmp_path):
+    """The crash contract, process edition: a worker killed mid-run
+    (after joining the cluster, before publishing any verdict) aborts
+    the whole run with DistributedCheckError — no merged verdicts, no
+    partial results."""
+    base = synth_stream_batch(6, StreamSynthSpec(n_ops=20, seed=5))
+    files = _write(tmp_path, base)
+    os.environ["JEPSEN_TPU_DIST_DIE_PID"] = "1"
     try:
-        for p in procs:
-            out, err = p.communicate(timeout=180)
-            assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
-            outs.append(json.loads(out.strip().splitlines()[-1]))
+        with pytest.raises(DistributedCheckError, match="worker 1"):
+            run_multiprocess_check(
+                "stream", files, 2, chunk=3, timeout_s=300
+            )
     finally:
-        # a failed/hung worker must not orphan its sibling (it would sit
-        # inside jax.distributed.initialize holding the coordinator port)
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-
-    # every process computed the same global verdict
-    for o in outs[1:]:
-        assert o["valid"] == outs[0]["valid"]
-        assert o["lost"] == outs[0]["lost"]
-        assert o["stream_valid"] == outs[0]["stream_valid"]
-
-    # stream differential (the lost append must be flagged pod-wide)
-    from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
-    from jepsen_tpu.history.synth import StreamSynthSpec, synth_stream_batch
-
-    sshs = synth_stream_batch(4, StreamSynthSpec(n_ops=40, seed=3), lost=1)
-    sref = [check_stream_lin_cpu(s.ops)["valid?"] for s in sshs]
-    assert outs[0]["stream_valid"] == sref
-    assert not all(sref)
-
-    # differential: single-process CPU reference on the same histories
-    from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
-    from jepsen_tpu.history.synth import SynthSpec, synth_batch
-
-    shs = synth_batch(8, SynthSpec(n_ops=40, seed=7), lost=2)
-    ref = [check_total_queue_cpu(s.ops) for s in shs]
-    assert outs[0]["valid"] == [r["valid?"] for r in ref]
-    assert outs[0]["lost"] == sum(r["lost-count"] for r in ref)
+        del os.environ["JEPSEN_TPU_DIST_DIE_PID"]

@@ -393,7 +393,8 @@ class TestHeadlineOrdering:
         monkeypatch.setattr(bench, "_bench_queue", fake_queue)
         for name in (
             "_bench_queue_pipeline", "_bench_stream", "_bench_stream_long",
-            "_bench_elle", "_bench_mutex",
+            "_bench_elle", "_bench_mutex", "_bench_north_star_section",
+            "_bench_scaling",
         ):
             def fake_section(details, _n=name):
                 # record whether the headline was already on stdout when
@@ -407,6 +408,13 @@ class TestHeadlineOrdering:
         monkeypatch.setattr(
             bench, "_bench_wgl_hard",
             lambda details: events.append(("wgl_hard", True)),
+        )
+        # the real multi-chip capture (and its scale-out harness) is
+        # covered by tests/test_multichip_capture.py — here it would
+        # only burn suite budget inside a mocked-section contract test
+        monkeypatch.setattr(
+            bench, "_capture_multichip_if_present",
+            lambda: events.append(("multichip", True)),
         )
         written = []
         monkeypatch.setattr(
@@ -422,8 +430,10 @@ class TestHeadlineOrdering:
         headline = json.loads(out.strip().splitlines()[0])
         assert headline["backend"] == "tpu" and not headline["fallback"]
         assert headline["value"] == 100.0 and headline["vs_baseline"] == 50.0
-        secondary = [e for e in events if e[0] != "wgl_hard"]
-        assert len(secondary) == 5
+        secondary = [
+            e for e in events if e[0] not in ("wgl_hard", "multichip")
+        ]
+        assert len(secondary) == 7
         assert all(seen for _, seen in secondary), (
             "a secondary section started before the headline printed: "
             f"{secondary}"
@@ -431,10 +441,10 @@ class TestHeadlineOrdering:
 
     def test_details_persist_incrementally_per_section(self, monkeypatch):
         out, events, written = self._run(monkeypatch)
-        # one write after the queue section, one after each of the five
+        # one write after the queue section, one after each of the seven
         # secondary sections (a timeout after N sections leaves N fresh),
         # one final with the compile-cache evidence
-        assert len(written) == 7
+        assert len(written) == 9
         assert "queue" in written[0] and "_bench_stream" not in written[0]
         assert "_bench_mutex" in written[-1]
         assert "entries_final" in written[-1]["compile_cache"]
@@ -446,6 +456,6 @@ class TestHeadlineOrdering:
             monkeypatch, failing={"_bench_elle"}
         )
         assert '"metric"' in out
-        assert len(written) == 7  # the write still happens after a failure
+        assert len(written) == 9  # the write still happens after a failure
         assert "_bench_elle" not in written[-1]
         assert "_bench_mutex" in written[-1]

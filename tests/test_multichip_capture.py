@@ -34,10 +34,22 @@ def test_capture_runs_all_families_on_virtual_mesh(tmp_path):
     assert out["skipped"] is False
     assert out["n_devices"] == 8
     assert out["mesh"] == {"hist": 4, "seq": 2}
-    assert set(out["families"]) == {"queue", "stream", "elle", "mutex"}
+    assert set(out["families"]) == {
+        "queue", "stream", "elle", "mutex", "pipeline_scaleout",
+    }
     for fam, row in out["families"].items():
+        if fam == "pipeline_scaleout":
+            continue  # scale-out schema asserted below
         assert row["valid_all"] is True, (fam, row)
         assert row["steady_run_ms"] > 0
+    # the armed scale-out harness: meshed multi-lane bytes-to-verdict
+    # with the collective reduction, per family
+    so = out["families"]["pipeline_scaleout"]
+    assert so["lanes"] == 8
+    for fam in ("stream", "elle"):
+        assert so[fam]["e2e_histories_per_sec"] > 0, so
+        assert so[fam]["invalid"] > 0  # seeded anomalies must surface
+        assert so[fam]["histories"] > 0
     assert out["provenance"]["git_rev"] != "unknown"
     # the artifact landed on disk, identically
     assert json.loads(open(out_path).read())["families"].keys() == \

@@ -1,0 +1,310 @@
+"""Scale-out pipeline differential contracts (PR 5).
+
+The per-device input lanes (``run_lanes`` / ``check_sources(lanes=)``),
+the collective verdict reduction (``reduce=True``), and the striped
+native cursors must all produce verdicts IDENTICAL to the serial oracle
+— for every pipelined family, including the degenerate-elle
+host-fallback splice crossing a shard boundary — plus the lanes-path
+honesty contracts: unreadable/zero-length files are dropped loudly
+(explicit unknown entries, ``stats.dropped``), and a crashed lane
+aborts with ``PipelineError`` and no results.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.store import write_history_jsonl
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_elle_batch,
+    synth_stream_batch,
+)
+from jepsen_tpu.parallel.pipeline import (
+    PipelineError,
+    check_sources,
+    run_lanes,
+)
+
+
+def _write(tmp_path, base, tag="h"):
+    files = []
+    for i, sh in enumerate(base):
+        p = tmp_path / f"{tag}{i:03d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+def _first_invalid(flags):
+    return flags.index(True) if any(flags) else -1
+
+
+class TestLanesDifferential:
+    """Multi-lane verdicts ≡ serial, every family."""
+
+    def test_stream(self, cpu_devices, tmp_path):
+        base = synth_stream_batch(
+            11, StreamSynthSpec(n_ops=35), lost=2, duplicated=1, reorder=1
+        )
+        files = _write(tmp_path, base)
+        serial, _ = check_sources("stream", files, chunk=4, serial=True)
+        laned, stats = check_sources("stream", files, chunk=4, lanes=0)
+        assert laned == serial
+        assert stats.lanes == len(cpu_devices)
+        assert stats.dropped == 0
+
+    def test_queue_both_subverdicts(self, cpu_devices, tmp_path):
+        base = synth_batch(
+            10, SynthSpec(n_ops=40), lost=1, duplicated=1, unexpected=1
+        )
+        files = _write(tmp_path, base)
+        serial, _ = check_sources("queue", files, chunk=3, serial=True)
+        laned, _ = check_sources("queue", files, chunk=3, lanes=4)
+        assert laned == serial
+
+    def test_elle_with_degenerate_splice(self, cpu_devices, tmp_path):
+        from test_fuzz_elle_device import fuzz_history
+
+        from jepsen_tpu.checkers.elle import elle_mops_for
+
+        class _SH:
+            def __init__(self, ops):
+                self.ops = ops
+
+        base = [_SH(fuzz_history(seed, n_txns=10)) for seed in range(8)]
+        degen = [elle_mops_for(sh.ops)[1].degenerate for sh in base]
+        assert any(degen) and not all(degen)
+        files = _write(tmp_path, base)
+        serial, _ = check_sources("elle", files, chunk=3, serial=True)
+        laned, _ = check_sources("elle", files, chunk=3, lanes=0)
+        assert laned == serial
+
+    def test_lanes_with_mesh(self, cpu_devices, tmp_path):
+        """Lanes feeding the shared mesh (serialized dispatch) ≡ serial."""
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        base = synth_stream_batch(9, StreamSynthSpec(n_ops=30), lost=1)
+        files = _write(tmp_path, base)
+        serial, _ = check_sources("stream", files, chunk=3, serial=True)
+        meshed, _ = check_sources(
+            "stream", files, chunk=3, lanes=0, mesh=checker_mesh()
+        )
+        assert meshed == serial
+
+
+class TestCollectiveReduction:
+    """reduce=True: the two-scalar on-device verdict vs the oracle."""
+
+    @pytest.mark.parametrize("lanes", [None, 0], ids=["chunked", "lanes"])
+    @pytest.mark.parametrize("workload", ["stream", "queue", "elle"])
+    def test_reduced_matches_oracle(
+        self, cpu_devices, tmp_path, workload, lanes
+    ):
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        if workload == "stream":
+            base = synth_stream_batch(
+                10, StreamSynthSpec(n_ops=30), lost=2
+            )
+        elif workload == "queue":
+            base = synth_batch(10, SynthSpec(n_ops=40), lost=1)
+        else:
+            base = synth_elle_batch(
+                10, ElleSynthSpec(n_txns=8), g1a=1, g2_cycle=1
+            )
+        files = _write(tmp_path, base)
+        serial, _ = check_sources(workload, files, chunk=4, serial=True)
+        if workload == "queue":
+            flags = [
+                not (
+                    r["queue"]["valid?"] is True
+                    and r["linear"]["valid?"] is True
+                )
+                for r in serial
+            ]
+        else:
+            flags = [r[workload]["valid?"] is not True for r in serial]
+        merged, stats = check_sources(
+            workload,
+            files,
+            chunk=4,
+            mesh=checker_mesh(),
+            lanes=lanes,
+            reduce=True,
+        )
+        assert merged["histories"] == len(files)
+        assert merged["invalid"] == sum(flags)
+        assert merged["first_invalid"] == _first_invalid(flags)
+        assert stats.histories == len(files)
+
+    def test_elle_degenerate_fallback_folds_in(self, cpu_devices, tmp_path):
+        """The reduced verdict must count host-fallback (degenerate)
+        invalids too, and first_invalid must be the minimum across the
+        device and host populations — with the splice crossing shard
+        boundaries on the 8-device mesh."""
+        from test_fuzz_elle_device import fuzz_history
+
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        class _SH:
+            def __init__(self, ops):
+                self.ops = ops
+
+        base = [_SH(fuzz_history(seed, n_txns=10)) for seed in range(10)]
+        assert any(elle_mops_for(sh.ops)[1].degenerate for sh in base)
+        files = _write(tmp_path, base)
+        serial, _ = check_sources("elle", files, chunk=4, serial=True)
+        flags = [r["elle"]["valid?"] is not True for r in serial]
+        merged, _ = check_sources(
+            "elle", files, chunk=4, mesh=checker_mesh(), lanes=0,
+            reduce=True,
+        )
+        assert merged["invalid"] == sum(flags)
+        assert merged["first_invalid"] == _first_invalid(flags)
+
+    def test_reduce_without_mesh_rejected(self, tmp_path):
+        base = synth_stream_batch(2, StreamSynthSpec(n_ops=10))
+        files = _write(tmp_path, base)
+        with pytest.raises((ValueError, PipelineError)):
+            check_sources("stream", files, reduce=True)
+
+
+class TestLaneCensus:
+    """Size-aware balancing's honest fallback: drops are loud."""
+
+    def test_dropped_files_logged_counted_and_explicit(
+        self, cpu_devices, tmp_path, caplog
+    ):
+        import logging
+
+        base = synth_stream_batch(6, StreamSynthSpec(n_ops=25), lost=1)
+        files = _write(tmp_path, base)
+        empty = tmp_path / "zero.jsonl"
+        empty.write_text("")
+        missing = tmp_path / "not" / "here.jsonl"
+        mix = files[:2] + [empty] + files[2:4] + [missing] + files[4:]
+        with caplog.at_level(logging.WARNING, "jepsen_tpu.parallel.pipeline"):
+            res, stats = check_sources("stream", mix, chunk=3, lanes=2)
+        assert stats.dropped == 2
+        # every drop named in the log — no silent truncation
+        assert "zero.jsonl" in caplog.text and "here.jsonl" in caplog.text
+        # the results list keeps one entry per source, with explicit
+        # unknown verdicts at the dropped positions
+        assert len(res) == len(mix)
+        assert res[2]["stream"]["valid?"] == "unknown"
+        assert res[5]["stream"]["valid?"] == "unknown"
+        serial, _ = check_sources("stream", files, chunk=3, serial=True)
+        assert [r for i, r in enumerate(res) if i not in (2, 5)] == serial
+
+    def test_reduce_counts_drops(self, cpu_devices, tmp_path):
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        base = synth_stream_batch(5, StreamSynthSpec(n_ops=20))
+        files = _write(tmp_path, base)
+        empty = tmp_path / "zero.jsonl"
+        empty.write_text("")
+        merged, stats = check_sources(
+            "stream", files + [empty], chunk=2, mesh=checker_mesh(),
+            lanes=0, reduce=True,
+        )
+        assert merged["dropped"] == 1 and stats.dropped == 1
+        assert merged["histories"] == len(files)
+
+
+class TestLaneCrashContract:
+    def test_crashed_lane_aborts_with_no_results(self, cpu_devices):
+        """A lane crash aborts the whole run: PipelineError, nothing
+        returned — the run_pipeline contract, N-lane edition."""
+        import dataclasses as dc
+
+        from jepsen_tpu.parallel.pipeline import _Family
+
+        def produce(unit):
+            if unit == 3:
+                raise RuntimeError("lane packer exploded")
+            return np.full((4,), unit, np.int32)
+
+        import jax.numpy as jnp
+
+        fam = _Family(
+            produce=produce,
+            check=lambda x: jnp.asarray(x) + 1,
+            place=lambda x: x,
+            convert=lambda item, col: [col],
+        )
+        fams = [dc.replace(fam) for _ in range(4)]
+        with pytest.raises(PipelineError, match="lane .* crashed"):
+            run_lanes(list(range(12)), fams, depth=2)
+
+    def test_corrupt_history_mid_lanes_aborts(self, cpu_devices, tmp_path):
+        base = synth_stream_batch(5, StreamSynthSpec(n_ops=20))
+        files = _write(tmp_path, base)
+        bad = tmp_path / "torn.jsonl"
+        bad.write_text('{"type": "not a real op"\n')  # torn JSON line
+        with pytest.raises(PipelineError):
+            check_sources(
+                "stream", files[:2] + [bad] + files[2:], chunk=2, lanes=2
+            )
+
+
+class TestNativeStripedCursors:
+    """jt_*_files_part: striped calls over ONE shared path array ==
+    the full-scan results restricted to the stripe."""
+
+    @pytest.fixture(autouse=True)
+    def _lib(self):
+        from jepsen_tpu.history import fastpack
+
+        lib = fastpack._load()
+        if lib is None:
+            pytest.skip("native packer unavailable")
+        if not hasattr(lib, "jt_stream_rows_files_part"):
+            pytest.skip("stale native build without striped cursors")
+
+    def test_stream_stripes_cover_exactly(self, tmp_path):
+        from jepsen_tpu.history.fastpack import stream_rows_files
+
+        base = synth_stream_batch(9, StreamSynthSpec(n_ops=20), lost=1)
+        files = _write(tmp_path, base)
+        full = stream_rows_files(files, threads=2)
+        for part in range(3):
+            got = stream_rows_files(files, threads=2, part=part, n_parts=3)
+            for i in range(len(files)):
+                if i % 3 == part:
+                    assert (got[i][0] == full[i][0]).all()
+                    assert got[i][1] == full[i][1]
+                else:
+                    assert got[i] is None
+
+    def test_queue_and_elle_stripes(self, tmp_path):
+        from jepsen_tpu.history.fastpack import elle_mops_files, pack_files
+
+        qfiles = _write(
+            tmp_path, synth_batch(5, SynthSpec(n_ops=30), lost=1), "q"
+        )
+        full = pack_files(qfiles, threads=2)
+        got = pack_files(qfiles, threads=2, part=1, n_parts=2)
+        for i in range(5):
+            if i % 2 == 1:
+                assert got[i][0] == full[i][0]
+                assert (got[i][1] == full[i][1]).all()
+            else:
+                assert got[i] is None
+
+        efiles = _write(
+            tmp_path, synth_elle_batch(5, ElleSynthSpec(n_txns=8)), "e"
+        )
+        full = elle_mops_files(efiles, threads=2)
+        got = elle_mops_files(efiles, threads=2, part=0, n_parts=2)
+        for i in range(5):
+            if i % 2 == 0:
+                assert (got[i][0] == full[i][0]).all()
+                assert got[i][1] == full[i][1]
+            else:
+                assert got[i] is None

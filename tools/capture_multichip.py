@@ -153,6 +153,51 @@ def capture(out_path: str = OUT_PATH) -> dict:
         ),
     }
 
+    # scale-out pipeline: the SAME harness the CPU scaling bench runs
+    # (per-device input lanes + meshed dispatch + collective verdict
+    # reduction, bytes-to-verdict from files, caches off) on the REAL
+    # mesh — armed so the moment a multi-chip tunnel window opens, the
+    # capture records the scaled end-to-end numbers, not just the
+    # per-program readiness rows above
+    import tempfile
+
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    scaleout: dict = {"lanes": n, "mode": "mesh + lanes + reduce"}
+    with tempfile.TemporaryDirectory() as td:
+        for fam, synth_base in (
+            (
+                "stream",
+                synth_stream_batch(B, StreamSynthSpec(n_ops=96), lost=1),
+            ),
+            (
+                "elle",
+                synth_elle_batch(B, ElleSynthSpec(n_txns=32), g2_cycle=1),
+            ),
+        ):
+            paths = []
+            for i, sh in enumerate(synth_base):
+                p = os.path.join(td, f"{fam}{i:03d}.jsonl")
+                write_history_jsonl(p, sh.ops)
+                paths.append(p)
+            kw = dict(
+                chunk=max(8, B // 4), mesh=mesh, lanes=0, reduce=True,
+                use_cache=False,
+            )
+            check_sources(fam, paths, **kw)  # warm the jitted programs
+            t0 = time.perf_counter()
+            verdict, stats = check_sources(fam, paths, **kw)
+            wall = time.perf_counter() - t0
+            scaleout[fam] = {
+                "e2e_histories_per_sec": round(len(paths) / wall, 1),
+                "histories": len(paths),
+                "invalid": verdict["invalid"],
+                "device_idle_frac": round(stats.device_idle_frac, 3),
+                "lanes": stats.lanes,
+            }
+    families["pipeline_scaleout"] = scaleout
+
     out = {**base, "skipped": False, "families": families}
 
     # provenance: same evidence block shape as BENCH_DETAILS.json

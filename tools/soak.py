@@ -214,9 +214,21 @@ def run_soak(args) -> int:
                 attach_pipelined_checkers,
             )
 
-            if attach_pipelined_checkers(test, args.workload):
-                print("# soak: pipelined analysis (pass --serial for "
-                      "the classic single-thread checkers)", flush=True)
+            # --lanes on a soak means "scale the analysis out": the
+            # run has ONE history file, so the scale-out axis is the
+            # op axis — mesh=True resolves (at check time) to a
+            # seq-parallel mesh over all local devices for the
+            # queue/stream families (PipelinedChecker._resolved_opts)
+            scale = {"mesh": True} if args.lanes is not None else {}
+            if attach_pipelined_checkers(
+                test, args.workload, lanes=args.lanes, **scale
+            ):
+                note = (
+                    " (seq-meshed over local devices)" if scale else ""
+                )
+                print(f"# soak: pipelined analysis{note} (pass "
+                      "--serial for the classic single-thread checkers)",
+                      flush=True)
         monitors.append(attach_live_monitor_for(test, monitor_name))
         return test, transport
 
@@ -274,6 +286,13 @@ def main(argv=None) -> int:
                    help="triage escape hatch: run the post-run analysis "
                         "on the classic single-thread checkers instead "
                         "of the bytes-to-verdict pipeline executor")
+    p.add_argument("--lanes", type=int, default=None,
+                   help="scale the post-run analysis out across local "
+                        "devices: the soak's single long history checks "
+                        "through a seq-parallel mesh (op axis sharded, "
+                        "queue/stream families), with N input lanes for "
+                        "any multi-file re-checks (0 = one per device; "
+                        "default: the classic single-lane executor)")
     p.add_argument("--store", default=None,
                    help="store root (default: a temp dir)")
     p.add_argument("--out", default=None,

@@ -1123,6 +1123,13 @@ def cmd_test(args) -> int:
         "fenced": args.fenced,
         "durable": args.durable,
         "seed": args.seed,
+        "mixed-extended": args.mixed_extended,
+        "slow-disk-mean-ms": args.slow_disk_mean_ms,
+        "slow-disk-jitter-ms": args.slow_disk_jitter_ms,
+        "wire-corrupt": args.wire_corrupt,
+        "wire-duplicate": args.wire_duplicate,
+        "wire-delay": args.wire_delay,
+        "wire-delay-ms": args.wire_delay_ms,
     }
     if args.archive_url:
         opts["archive-url"] = args.archive_url
@@ -1161,25 +1168,36 @@ def cmd_test(args) -> int:
         # through the Raft leader (stream reads commit through the log —
         # linearizable even from lagging followers)
         n = len(args.nodes.split(",")) if args.nodes else 3
-        test, local_cluster = build_local_test(
-            opts,
-            n_nodes=n,
-            concurrency=args.concurrency,
-            checker_backend=args.checker,
-            store_root=args.store,
-            workload=args.workload,
-            seed_bug=args.seed_bug,
-            durable=args.durable,
-        )
+        try:
+            test, local_cluster = build_local_test(
+                opts,
+                n_nodes=n,
+                concurrency=args.concurrency,
+                checker_backend=args.checker,
+                store_root=args.store,
+                workload=args.workload,
+                seed_bug=args.seed_bug,
+                durable=args.durable,
+            )
+        except (NotImplementedError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     else:
-        test, _cluster = build_sim_test(
-            opts=opts,
-            nodes=args.nodes.split(","),
-            concurrency=args.concurrency,
-            checker_backend=args.checker,
-            store_root=args.store,
-            workload=args.workload,
-        )
+        try:
+            test, _cluster = build_sim_test(
+                opts=opts,
+                nodes=args.nodes.split(","),
+                concurrency=args.concurrency,
+                checker_backend=args.checker,
+                store_root=args.store,
+                workload=args.workload,
+            )
+        except (NotImplementedError, ValueError) as e:
+            # e.g. an asymmetric one-way partition on the sim's
+            # symmetrizing net, or a refused nemesis/surface combo —
+            # a clean usage error, not a traceback
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if getattr(args, "log_file_pattern", None):
         # jepsen.checker/log-file-pattern: scan the collected node logs
         # for SUT-crash indicators; a match invalidates the run even
@@ -1646,6 +1664,7 @@ def build_parser() -> argparse.ArgumentParser:
             "confirm-before-quorum",
             "drop-unacked-on-close",
             "ack-before-fsync",
+            "no-wire-checksum",
         ),
         default=None,
         help="(--db local) inject a replication bug into every broker "
@@ -1655,8 +1674,10 @@ def build_parser() -> argparse.ArgumentParser:
         "deliveries instead of requeueing them (the delivery plane's "
         "loss mode); ack-before-fsync commits against the in-memory log "
         "while the WAL falls behind (needs --durable + --nemesis "
-        "crash-restart-cluster to surface) — either way the checker "
-        "must go red (lost)",
+        "crash-restart-cluster to surface); no-wire-checksum sends peer "
+        "RPC frames without the integrity CRC, so wire corruption "
+        "(--nemesis wire-chaos) is PROCESSED instead of dropped and the "
+        "replicas diverge — either way the checker must go red",
     )
     t.add_argument(
         "--durable",
@@ -1683,11 +1704,17 @@ def build_parser() -> argparse.ArgumentParser:
             "partition-majorities-ring",
             "partition-random-node",
             "partition-leader",
+            "partition-one-way-in",
+            "partition-one-way-out",
         ),
         help="the reference's four topologies (random-partition-halves "
         "is the reference's spelling of partition-random-halves; both "
-        "parse), plus the targeted partition-leader (isolate the "
-        "current Raft leader; --db local)",
+        "parse), the targeted partition-leader (isolate the current "
+        "Raft leader; --db local), plus the ASYMMETRIC pair: "
+        "partition-one-way-in (a victim hears nobody, everyone hears "
+        "it) and partition-one-way-out (nobody hears a victim, it "
+        "hears everyone) — one-way drops need a direction-honoring "
+        "net (--db local / rabbitmq; the sim symmetrizes and refuses)",
     )
     t.add_argument(
         "--log-file-pattern",
@@ -1719,6 +1746,8 @@ def build_parser() -> argparse.ArgumentParser:
             "crash-restart-cluster",
             "clock-skew",
             "membership-churn",
+            "slow-disk",
+            "wire-chaos",
             "mixed",
         ),
         help="fault family: the reference's network partitions (shaped by "
@@ -1728,9 +1757,45 @@ def build_parser() -> argparse.ArgumentParser:
         "clock-skew (bump a random node's wall clock ±0.1-3s; not --db "
         "sim), membership-churn (kill a node, forget_cluster_node it - "
         "a real RemoveServer commit - then fresh rejoin on heal; needs "
-        ">=3 nodes), or mixed (the jepsen.nemesis/compose soak: each cycle "
-        "randomly picks partition/kill/pause/clock-skew/membership-churn, "
-        "plus crash-restart when --durable)",
+        ">=3 nodes), slow-disk (fsync latency on a random node's WAL; "
+        "needs --durable), wire-chaos (corrupt/duplicate/reorder a "
+        "random node's peer frames; --db local/rabbitmq), or mixed "
+        "(the jepsen.nemesis/compose soak: each cycle randomly picks "
+        "partition/kill/pause/clock-skew/membership-churn, plus "
+        "crash-restart when --durable; --mixed-extended adds the two "
+        "new families to the draw)",
+    )
+    t.add_argument(
+        "--mixed-extended",
+        action="store_true",
+        help="--nemesis mixed: add slow-disk (when --durable) and "
+        "wire-chaos to the family draw (kept opt-in so default mixed "
+        "schedules stay comparable with committed soak evidence)",
+    )
+    t.add_argument(
+        "--slow-disk-mean-ms", type=float, default=120.0,
+        help="slow-disk nemesis: mean injected fsync latency",
+    )
+    t.add_argument(
+        "--slow-disk-jitter-ms", type=float, default=80.0,
+        help="slow-disk nemesis: uniform +/- jitter on each fsync",
+    )
+    t.add_argument(
+        "--wire-corrupt", type=float, default=0.25,
+        help="wire-chaos: per-frame corruption probability [0,1]",
+    )
+    t.add_argument(
+        "--wire-duplicate", type=float, default=0.15,
+        help="wire-chaos: per-frame duplication probability "
+        "(idempotent protocol RPCs only)",
+    )
+    t.add_argument(
+        "--wire-delay", type=float, default=0.15,
+        help="wire-chaos: per-frame delay/reorder probability",
+    )
+    t.add_argument(
+        "--wire-delay-ms", type=float, default=40.0,
+        help="wire-chaos: held-frame delay (concurrent frames overtake)",
     )
     t.add_argument(
         "--publish-confirm-timeout", type=float, default=5000.0, help="ms"
@@ -1813,7 +1878,9 @@ def build_parser() -> argparse.ArgumentParser:
     m = sub.add_parser(
         "matrix",
         help="run the CI test matrix (the reference's 14 configs; 18 with "
-        "--extended) against sim or rabbitmq",
+        "--extended, 25 with --extended --db local) against sim or "
+        "rabbitmq — or generate configs beyond any static list with "
+        "tools/fuzz_matrix.py",
     )
     m.add_argument("--limit", type=int, default=0, help="first N configs only")
     m.add_argument(

@@ -64,6 +64,27 @@ def random_node(nodes: Sequence[str], rng: random.Random):
     return complete_grudges([[lone], rest])
 
 
+def one_way_in(nodes: Sequence[str], rng: random.Random):
+    """Asymmetric: a random victim hears NOBODY (drops all input) while
+    everyone still hears it — its requests go out, every reply dies.  A
+    leader hit this way keeps suppressing elections with heartbeats the
+    followers receive, while it can never commit (no acks arrive): the
+    failure detector, not the election, has to notice."""
+    victim = rng.choice(list(nodes))
+    return {victim: {m for m in nodes if m != victim}}
+
+
+def one_way_out(nodes: Sequence[str], rng: random.Random):
+    """Asymmetric: NOBODY hears a random victim (everyone drops input
+    from it) while the victim still hears everyone.  A leader hit this
+    way sees the cluster move on without it — a new election it can
+    observe but not veto — and must truncate any unreplicated tail when
+    the new leader's appends arrive (the confirm-before-quorum seeded
+    bug's loss window, reachable without ever cutting a full link)."""
+    victim = rng.choice(list(nodes))
+    return {m: {victim} for m in nodes if m != victim}
+
+
 STRATEGIES: dict[str, Callable] = {
     "partition-random-halves": random_halves,
     # the reference's OWN spelling for the same strategy
@@ -74,7 +95,19 @@ STRATEGIES: dict[str, Callable] = {
     "partition-halves": halves,
     "partition-majorities-ring": majorities_ring,
     "partition-random-node": random_node,
+    "partition-one-way-in": one_way_in,
+    "partition-one-way-out": one_way_out,
 }
+
+#: strategies whose grudges are deliberately DIRECTED: they need a net
+#: that honors grudge direction (iptables INPUT-drop per node — the
+#: replicated local cluster and real SSH nets).  On a net that would
+#: symmetrize (the simulator's link model) they are refused: silently
+#: running the two-way version would attach this schedule's name to a
+#: different fault.
+ASYMMETRIC_STRATEGIES = frozenset(
+    {"partition-one-way-in", "partition-one-way-out"}
+)
 
 #: targeted strategy (beyond the reference's four): isolate the CURRENT
 #: consensus leader — jepsen's own nemesis library grew leader-targeting
@@ -100,6 +133,15 @@ class PartitionNemesis:
             raise ValueError(
                 "partition-leader needs a leader-discovery hook; this "
                 "cluster's transport does not provide one"
+            )
+        if strategy in ASYMMETRIC_STRATEGIES and not getattr(
+            net, "one_way", False
+        ):
+            raise ValueError(
+                f"{strategy} is a one-way partition and this net "
+                f"({type(net).__name__}) symmetrizes grudges — running "
+                f"it two-way would be a different fault; use a "
+                f"direction-honoring net (--db local / rabbitmq)"
             )
         self.strategy = strategy
         self.net = net
@@ -343,6 +385,132 @@ class MembershipNemesis:
             self.membership.join(node, self._survivor(node))
 
 
+class SlowDiskNemesis:
+    """Slow-disk / fsync-latency injection (fsyncgate-adjacent, distinct
+    from fail-stop): on ``start``, a random node's WAL device begins
+    taking mean±jitter ms per fsync; on ``stop`` every slowed disk is
+    restored.  A correct durable SUT under a slow disk confirms slower —
+    possibly timing out into indeterminate ops, which is always safe —
+    and loses nothing; the node that stays FAST under this nemesis is
+    the one lying about fsync (``ack-before-fsync``), which is exactly
+    the red/green pair's tell."""
+
+    def __init__(self, disks, nodes: Sequence[str],
+                 seed: int | None = None,
+                 mean_ms: float = 120.0, jitter_ms: float = 80.0):
+        if mean_ms <= 0.0 and jitter_ms <= 0.0:
+            raise ValueError(
+                "slow-disk with zero latency is a no-fault no-op"
+            )
+        self.disks = disks
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+        self.mean_ms = mean_ms
+        self.jitter_ms = jitter_ms
+        self.slowed: list[str] = []
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            victim = self.rng.choice(self.nodes)
+            self.disks.slow(victim, self.mean_ms, self.jitter_ms)
+            if victim not in self.slowed:
+                self.slowed.append(victim)
+            logger.info(
+                "nemesis: slow-disk %s (%g±%gms/fsync)",
+                victim, self.mean_ms, self.jitter_ms,
+            )
+            return op.complete(
+                OpType.INFO,
+                value=f"slow-disk {victim} {self.mean_ms:g}ms",
+            )
+        if op.f == OpF.STOP:
+            restored, self.slowed = self.slowed, []
+            for v in restored:
+                self.disks.reset(v)
+            logger.info("nemesis: disks restored %s", restored)
+            return op.complete(OpType.INFO, value=f"disks-ok {restored}")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for v in self.slowed:
+            try:
+                self.disks.reset(v)
+            except Exception:  # noqa: BLE001 — node may be gone at teardown
+                pass
+        self.slowed = []
+
+
+class WireChaosNemesis:
+    """Wire-layer corruption/duplication/reordering between broker
+    peers (netem's fault family): on ``start``, a random node's outgoing
+    peer frames begin taking the configured fault rates; on ``stop``
+    every chaotic wire is calmed.  A correct SUT's transport DROPS
+    corrupted frames on checksum (corruption degrades to retried loss)
+    and shrugs off duplicated/reordered protocol frames by idempotency;
+    the ``no-wire-checksum`` seeded bug processes mangled frames instead
+    and the replicas diverge — the checker must surface the resulting
+    phantom/lost values."""
+
+    def __init__(self, wire, nodes: Sequence[str],
+                 seed: int | None = None,
+                 corrupt_p: float = 0.25, duplicate_p: float = 0.15,
+                 delay_p: float = 0.15, delay_ms: float = 40.0):
+        if max(corrupt_p, duplicate_p, delay_p) <= 0.0:
+            raise ValueError(
+                "wire-chaos with all rates zero is a no-fault no-op"
+            )
+        for name, p in (("corrupt", corrupt_p),
+                        ("duplicate", duplicate_p), ("delay", delay_p)):
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"wire-chaos {name} rate {p} outside [0, 1]"
+                )
+        if delay_p > 0.0 and delay_ms <= 0.0:
+            raise ValueError(
+                "wire-chaos delay rate without delay_ms is a no-op"
+            )
+        self.wire = wire
+        self.nodes = list(nodes)
+        self.rng = random.Random(seed)
+        self.spec = (corrupt_p, duplicate_p, delay_p, delay_ms)
+        self.chaotic: list[str] = []
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        pass
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        if op.f == OpF.START:
+            victim = self.rng.choice(self.nodes)
+            self.wire.chaos(victim, *self.spec)
+            if victim not in self.chaotic:
+                self.chaotic.append(victim)
+            logger.info(
+                "nemesis: wire-chaos %s (corrupt=%g dup=%g delay=%g@%gms)",
+                victim, *self.spec,
+            )
+            return op.complete(
+                OpType.INFO, value=f"wire-chaos {victim}"
+            )
+        if op.f == OpF.STOP:
+            calmed, self.chaotic = self.chaotic, []
+            for v in calmed:
+                self.wire.calm(v)
+            logger.info("nemesis: wires calmed %s", calmed)
+            return op.complete(OpType.INFO, value=f"wires-ok {calmed}")
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for v in self.chaotic:
+            try:
+                self.wire.calm(v)
+            except Exception:  # noqa: BLE001 — node may be gone at teardown
+                pass
+        self.chaotic = []
+
+
 class MixedNemesis:
     """``jepsen.nemesis/compose``'s role: one nemesis that interleaves
     several fault families over the run — each ``start`` picks one
@@ -386,22 +554,88 @@ class MixedNemesis:
 
 NEMESES = (
     "partition", "kill-random-node", "pause-random-node",
-    "crash-restart-cluster", "clock-skew", "membership-churn", "mixed",
+    "crash-restart-cluster", "clock-skew", "membership-churn",
+    "slow-disk", "wire-chaos", "mixed",
 )
+
+#: the nemesis-shaped option keys ``make_nemesis`` consumes.  Anything
+#: ELSE in the fault namespaces (``wire-*``, ``slow-disk-*``) is
+#: rejected loudly: a typo'd tunable must not run the schedule with the
+#: default it meant to change (the silent-no-op class).
+_NEMESIS_OPT_KEYS = frozenset({
+    "nemesis", "network-partition", "mixed-extended",
+    "nemesis-schedule",  # dedicated rejection below (fuzz-runner-only)
+    "slow-disk-mean-ms", "slow-disk-jitter-ms",
+    "wire-corrupt", "wire-duplicate", "wire-delay", "wire-delay-ms",
+})
+
+
+def _validate_nemesis_opts(opts: Mapping[str, Any], kind: str) -> None:
+    unknown = sorted(
+        k for k in opts
+        if (k.startswith("wire-") or k.startswith("slow-disk-")
+            or k.startswith("nemesis-"))
+        and k not in _NEMESIS_OPT_KEYS
+    )
+    if unknown:
+        raise ValueError(
+            f"unknown nemesis option(s) {unknown}; known fault tunables: "
+            f"{sorted(k for k in _NEMESIS_OPT_KEYS if k != 'nemesis')}"
+        )
+    if opts.get("nemesis-schedule") is not None:
+        raise ValueError(
+            "nemesis-schedule (an explicit event timeline) requires the "
+            "scheduled nemesis — build the test with the fuzz runner's "
+            "nemesis_factory; the uniform-cycle nemeses here would pair "
+            "the schedule's start/stop ops with the wrong faults"
+        )
+    if kind in ("partition", "mixed") and not opts.get("network-partition"):
+        raise ValueError(
+            f"nemesis {kind!r} needs a partition strategy "
+            f"(network-partition); one of {sorted(STRATEGIES)}"
+        )
+
+
+def _slow_disk_params(opts: Mapping[str, Any]) -> tuple[float, float]:
+    return (
+        float(opts.get("slow-disk-mean-ms", 120.0)),
+        float(opts.get("slow-disk-jitter-ms", 80.0)),
+    )
+
+
+def _wire_params(opts: Mapping[str, Any]) -> dict[str, float]:
+    return {
+        "corrupt_p": float(opts.get("wire-corrupt", 0.25)),
+        "duplicate_p": float(opts.get("wire-duplicate", 0.15)),
+        "delay_p": float(opts.get("wire-delay", 0.15)),
+        "delay_ms": float(opts.get("wire-delay-ms", 40.0)),
+    }
 
 
 def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                  nodes: Sequence[str], seed: int | None = None,
-                 leader_fn=None, clocks=None, membership=None):
+                 leader_fn=None, clocks=None, membership=None,
+                 disks=None, wire=None):
     """Build the nemesis the test opts select: ``partition`` (the
-    reference's four strategies via ``network-partition``, plus the
-    targeted ``partition-leader``), the process faults
-    ``kill-random-node`` / ``pause-random-node``, the whole-cluster
-    power failure ``crash-restart-cluster``, ``clock-skew`` (needs a
-    ``clocks`` surface), ``membership-churn`` (kill→forget→fresh
-    rejoin; needs a ``membership`` surface), or ``mixed`` (the compose
-    soak interleaving the families above)."""
+    reference's four strategies via ``network-partition``, the one-way
+    asymmetric pair, plus the targeted ``partition-leader``), the
+    process faults ``kill-random-node`` / ``pause-random-node``, the
+    whole-cluster power failure ``crash-restart-cluster``,
+    ``clock-skew`` (needs a ``clocks`` surface), ``membership-churn``
+    (kill→forget→fresh rejoin; needs a ``membership`` surface),
+    ``slow-disk`` (fsync latency on the WAL; needs a ``disks`` surface
+    — durable clusters only), ``wire-chaos`` (frame corruption/
+    duplication/reordering between peers; needs a ``wire`` surface), or
+    ``mixed`` (the compose soak interleaving the families above; the
+    ``mixed-extended`` opt adds the two new families to the draw).
+
+    Unknown nemesis kinds and unknown/contradictory fault tunables
+    raise — a schedule must never silently run without the fault (or
+    with a different fault than) its name claims."""
     kind = opts.get("nemesis", "partition")
+    if kind not in NEMESES:
+        raise ValueError(f"unknown nemesis {kind!r}; one of {NEMESES}")
+    _validate_nemesis_opts(opts, kind)
     if kind == "partition":
         return PartitionNemesis(
             opts["network-partition"], net, nodes, seed=seed,
@@ -443,6 +677,33 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
                 "2-node cluster leaves no majority to serve)"
             )
         return MembershipNemesis(procs, membership, nodes, seed=seed)
+    if kind == "slow-disk":
+        if disks is None:
+            raise ValueError(
+                "slow-disk needs a disks surface (a durable replicated "
+                "cluster whose WAL the delay can reach — use --db local "
+                "--durable or --db rabbitmq)"
+            )
+        if not opts.get("durable"):
+            raise ValueError(
+                "slow-disk needs durable=True: a memory-only cluster "
+                "has no fsync to slow, so the 'fault' would be a no-op "
+                "and any green verdict a false one"
+            )
+        mean, jitter = _slow_disk_params(opts)
+        return SlowDiskNemesis(
+            disks, nodes, seed=seed, mean_ms=mean, jitter_ms=jitter
+        )
+    if kind == "wire-chaos":
+        if wire is None:
+            raise ValueError(
+                "wire-chaos needs a wire surface (a replicated cluster "
+                "whose peer RPC frames the faults can reach — use "
+                "--db local or --db rabbitmq)"
+            )
+        return WireChaosNemesis(
+            wire, nodes, seed=seed, **_wire_params(opts)
+        )
     if kind == "mixed":
         # the soak composition: partitions + process faults interleaved.
         # crash-restart joins only when the SUT is durable (a memory-only
@@ -454,7 +715,7 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
         sub = (
             None
             if seed is None
-            else [seed * 8 + i + 1 for i in range(5)]
+            else [seed * 8 + i + 1 for i in range(8)]
         )
         members: dict[str, Any] = {
             "partition": PartitionNemesis(
@@ -476,6 +737,20 @@ def make_nemesis(opts: Mapping[str, Any], net: Net, procs,
             members["membership"] = MembershipNemesis(
                 procs, membership, nodes, seed=sub and sub[4]
             )
+        if opts.get("mixed-extended"):
+            # the two new families join the draw only on request: the
+            # default mixed schedule stays comparable with the committed
+            # soak evidence (same members, same seeded family sequence)
+            if disks is not None and opts.get("durable"):
+                mean, jitter = _slow_disk_params(opts)
+                members["slow-disk"] = SlowDiskNemesis(
+                    disks, nodes, seed=sub and sub[5],
+                    mean_ms=mean, jitter_ms=jitter,
+                )
+            if wire is not None:
+                members["wire-chaos"] = WireChaosNemesis(
+                    wire, nodes, seed=sub and sub[6], **_wire_params(opts)
+                )
         from jepsen_tpu.control.net import SimProcs
 
         if opts.get("durable") and not isinstance(procs, SimProcs):

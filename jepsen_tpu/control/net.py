@@ -24,6 +24,13 @@ def undirected(grudges: dict[str, set[str]]) -> set[frozenset[str]]:
 
 
 class Net(abc.ABC):
+    #: True when this net honors the DIRECTION of a grudge (``a`` drops
+    #: input from ``b`` while ``b`` still hears ``a``).  The asymmetric
+    #: one-way partition strategies require it: a net that symmetrizes
+    #: would silently run a DIFFERENT (two-way) fault and any verdict
+    #: would describe a schedule nobody asked for.
+    one_way = False
+
     @abc.abstractmethod
     def partition(self, grudges: dict[str, set[str]]) -> None:
         """Apply blocked links (``grudges[a] ∋ b`` = a drops traffic from b)."""
@@ -34,6 +41,10 @@ class Net(abc.ABC):
 
 
 class SimNet(Net):
+    """Collapses grudges to undirected links (the simulator models links,
+    not directions) — hence ``one_way = False``: asymmetric strategies
+    are refused rather than silently symmetrized."""
+
     def __init__(self, cluster):
         self.cluster = cluster
 
@@ -47,7 +58,14 @@ class SimNet(Net):
 class IptablesNet(Net):
     """Real-cluster partitions: per-node iptables DROP rules over SSH (the
     mechanism behind ``jepsen.nemesis``'s partitioners; the docker topology
-    grants NET_ADMIN exactly for this, ``docker-compose.yml:9-10``)."""
+    grants NET_ADMIN exactly for this, ``docker-compose.yml:9-10``).
+
+    Rules are installed per grudge DIRECTION (``-A INPUT -s peer`` only on
+    the node holding the grudge), so one-way partitions are first-class:
+    the local process cluster forwards each rule to that node's Raft RPC
+    layer with the same INPUT-drop semantics (``replication.py``)."""
+
+    one_way = True
 
     def __init__(self, transport, nodes):
         from jepsen_tpu.control.ssh import Control
@@ -144,6 +162,107 @@ class TransportClocks(Clocks):
         import time as _t
 
         self._set(node, _t.time())
+
+
+class Disks(abc.ABC):
+    """Disk fault surface (the fsyncgate-adjacent one): make a node's
+    WAL device slow — every fsync stalls mean±jitter ms — and set it
+    back.  A correct durable SUT degrades gracefully (slower confirms,
+    possibly timing out into indeterminate ops, which is always safe);
+    nothing confirmed may be lost.  The node that IS fast under a slow
+    disk is the one lying about fsync (``ack-before-fsync``)."""
+
+    @abc.abstractmethod
+    def slow(self, node: str, mean_ms: float, jitter_ms: float) -> None:
+        """Inject fsync latency on ``node``'s WAL device."""
+
+    @abc.abstractmethod
+    def reset(self, node: str) -> None:
+        """Restore ``node``'s WAL device to full speed."""
+
+
+class TransportDisks(Disks):
+    """Disk delay over the command transport as the device-mapper
+    ``delay`` target an operator would use (``dmsetup``, suspending and
+    reloading the WAL volume's table); the local process cluster maps
+    the same command string onto its admin ``FSYNC_LAT``.  A failed
+    injection raises — a run must never claim "tolerates slow disks"
+    with no slow disk ever injected (the false-green-by-absent-fault
+    class, same refusal as :class:`TransportClocks`)."""
+
+    def __init__(self, transport, nodes):
+        self.transport = transport
+        self.nodes = list(nodes)
+
+    def _run(self, node: str, cmd: str) -> None:
+        r = self.transport.run(node, cmd)
+        if r.rc != 0:
+            raise RuntimeError(
+                f"disk-delay injection on {node} failed (rc={r.rc}): "
+                f"{(r.err or r.out).strip()[:200] or 'no output'} — "
+                f"refusing to run a slow-disk test with no actual delay"
+            )
+
+    def slow(self, node, mean_ms, jitter_ms):
+        self._run(
+            node,
+            f"sudo dmsetup message jt-wal-delay 0 "
+            f"delay {mean_ms:g} {jitter_ms:g}",
+        )
+
+    def reset(self, node):
+        self._run(node, "sudo dmsetup message jt-wal-delay 0 delay 0 0")
+
+
+class Wire(abc.ABC):
+    """Wire fault surface: netem-style frame corruption / duplication /
+    delay-reordering on a node's outgoing peer links, and calm again.
+    A correct SUT's transport drops corrupted frames on checksum
+    (corruption degrades to loss, which consensus retries through) and
+    tolerates duplicated/reordered protocol frames by idempotency."""
+
+    @abc.abstractmethod
+    def chaos(
+        self, node: str, corrupt_p: float, duplicate_p: float,
+        delay_p: float, delay_ms: float,
+    ) -> None:
+        """Install the fault rates on ``node``'s outgoing frames."""
+
+    @abc.abstractmethod
+    def calm(self, node: str) -> None:
+        """Remove all wire faults from ``node``."""
+
+
+class TransportWire(Wire):
+    """Wire chaos over the command transport as the real ``tc qdisc``
+    netem line an operator would run; the local process cluster maps it
+    onto its admin ``WIRE`` (rates applied inside the node's RPC layer).
+    Failure raises — same no-silent-no-op rule as the other surfaces."""
+
+    def __init__(self, transport, nodes):
+        self.transport = transport
+        self.nodes = list(nodes)
+
+    def _run(self, node: str, cmd: str) -> None:
+        r = self.transport.run(node, cmd)
+        if r.rc != 0:
+            raise RuntimeError(
+                f"wire-chaos injection on {node} failed (rc={r.rc}): "
+                f"{(r.err or r.out).strip()[:200] or 'no output'} — "
+                f"refusing to run a wire test with no actual faults"
+            )
+
+    def chaos(self, node, corrupt_p, duplicate_p, delay_p, delay_ms):
+        self._run(
+            node,
+            f"sudo tc qdisc replace dev eth0 root netem "
+            f"corrupt {corrupt_p * 100:g}% "
+            f"duplicate {duplicate_p * 100:g}% "
+            f"reorder {delay_p * 100:g}% delay {delay_ms:g}ms",
+        )
+
+    def calm(self, node):
+        self._run(node, "sudo tc qdisc del dev eth0 root netem")
 
 
 class Membership(abc.ABC):

@@ -1,0 +1,41 @@
+"""Adversarial matrix fuzzing: generated fault schedules, triage,
+auto-minimization, and deterministic repro emission.
+
+The static 22-config matrix went green and stopped finding bugs — every
+recent red came from hand-driven soaks, which bounds the bug curve by
+how many schedules a human writes.  This package turns the matrix into
+a *machine*: seeded random composition of
+
+    {workload family x nemesis schedule x durability mode x contract
+     x cluster size x membership churn}
+
+configurations, each run under the same triage rules the CI matrix and
+``tests/_live.py`` apply (crash / final-read-missing / unknown →
+retry, cannot attest; invalid → a finding), and every confirmed red
+greedily delta-debugged — nemesis events, then the op window — down to
+a minimal failing window that is emitted into ``store/`` as a
+deterministic seeded repro driver (the generated analogue of the
+hand-written ``tools/repro_r7_*`` pair) plus a pinned red/green test.
+
+Modules:
+
+- :mod:`~jepsen_tpu.fuzz.space` — the seeded config sampler
+- :mod:`~jepsen_tpu.fuzz.schedule` — explicit nemesis event schedules
+  (the delta-debuggable form) and the nemesis that replays them
+- :mod:`~jepsen_tpu.fuzz.runner` — build + run one config with triage
+- :mod:`~jepsen_tpu.fuzz.minimize` — greedy ddmin over events + window
+- :mod:`~jepsen_tpu.fuzz.emit` — repro-driver emission (fail-loud: an
+  artifact is minted only from a *confirmed* red)
+- :mod:`~jepsen_tpu.fuzz.repro` — the runtime the emitted drivers call
+  back into (spec → run → reproduced-or-not exit code)
+"""
+
+from jepsen_tpu.fuzz.schedule import NemesisEvent, ScheduledNemesis
+from jepsen_tpu.fuzz.space import FuzzConfig, sample_config
+
+__all__ = [
+    "FuzzConfig",
+    "NemesisEvent",
+    "ScheduledNemesis",
+    "sample_config",
+]

@@ -1,0 +1,141 @@
+"""Greedy delta-debugging of a failing fuzz config.
+
+Two axes, in order:
+
+1. **Nemesis events** (classic ddmin): try dropping complements at
+   doubling granularity; any subset that still reds becomes the new
+   baseline.  Event windows are ABSOLUTE offsets (``schedule._Until``),
+   so removing one event moves nothing else — one variable at a time.
+2. **Op window**: tail-trim the load window to just past the last
+   surviving event, head-shift the schedule toward t=0, then try
+   halving each survivor's duration.
+
+Every accepted shrink is verified by ``confirm`` full re-runs on fresh
+clusters (all must red).  A candidate that comes back green or
+undecided is rejected and the previous spec is kept — the minimizer
+can only ever return a spec it has *watched fail*; flake can cost
+minimality, never truth.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from jepsen_tpu.fuzz.runner import replace_events, replace_opts
+from jepsen_tpu.fuzz.schedule import NemesisEvent
+from jepsen_tpu.fuzz.space import FuzzConfig
+
+
+@dataclasses.dataclass
+class MinimizeStats:
+    runs: int = 0
+    events_before: int = 0
+    events_after: int = 0
+    window_before: float = 0.0
+    window_after: float = 0.0
+
+
+def minimize(
+    cfg: FuzzConfig,
+    oracle: Callable[[FuzzConfig], bool],
+    confirm: int = 1,
+    log: Callable[[str], None] = lambda s: None,
+) -> tuple[FuzzConfig, MinimizeStats]:
+    """Shrink ``cfg`` while ``oracle`` (one full triaged run → still
+    red?) keeps confirming.  Returns the smallest spec that failed
+    ``confirm`` times in a row, plus run accounting."""
+    stats = MinimizeStats(
+        events_before=len(cfg.events),
+        window_before=float(cfg.opts["time-limit"]),
+    )
+
+    def still_red(candidate: FuzzConfig) -> bool:
+        for _ in range(max(1, confirm)):
+            stats.runs += 1
+            if not oracle(candidate):
+                return False
+        return True
+
+    # -- 1. ddmin over events ---------------------------------------------
+    events = list(cfg.events)
+    n_chunks = 2
+    while len(events) >= 1 and n_chunks <= 2 * len(events):
+        chunk = max(1, len(events) // n_chunks)
+        shrunk = False
+        i = 0
+        while i < len(events):
+            # a zero-event candidate is legal and informative: a config
+            # that reds with NO faults either carries a seeded bug /
+            # strict contract (expected) or the harness reds a
+            # fault-free run (a harness bug worth knowing first)
+            candidate_events = events[:i] + events[i + chunk:]
+            candidate = replace_events(cfg, candidate_events)
+            log(
+                f"minimize: drop events[{i}:{i + chunk}] "
+                f"({len(candidate_events)} left)?"
+            )
+            if still_red(candidate):
+                events = candidate_events
+                cfg = candidate
+                shrunk = True
+                log(f"minimize: RED holds with {len(events)} events")
+            else:
+                i += chunk
+        if not shrunk:
+            if chunk == 1:
+                break
+            n_chunks = min(2 * n_chunks, 2 * max(1, len(events)))
+        else:
+            n_chunks = max(2, n_chunks // 2)
+    stats.events_after = len(events)
+
+    # -- 2. op-window shrink ----------------------------------------------
+    tl = float(cfg.opts["time-limit"])
+    if events:
+        tail = max(e.at_s + e.dur_s for e in events) + 1.0
+    else:
+        tail = max(2.0, tl / 4.0)
+    if tail < tl:
+        candidate = replace_opts(cfg, **{"time-limit": round(tail, 3)})
+        log(f"minimize: tail-trim window {tl:g}s -> {tail:g}s?")
+        if still_red(candidate):
+            cfg, tl = candidate, tail
+            log("minimize: RED holds after tail trim")
+    if events and events[0].at_s > 1.0:
+        shift = events[0].at_s - 0.5
+        moved = [
+            dataclasses.replace(
+                e, at_s=round(e.at_s - shift, 3)
+            )
+            for e in events
+        ]
+        candidate = replace_opts(
+            replace_events(cfg, moved),
+            **{"time-limit": round(max(1.0, tl - shift), 3)},
+        )
+        log(f"minimize: head-shift schedule by {shift:g}s?")
+        if still_red(candidate):
+            cfg = candidate
+            events = moved
+            tl = float(cfg.opts["time-limit"])
+            log("minimize: RED holds after head shift")
+    for idx, e in enumerate(list(events)):
+        if e.dur_s <= 1.0:
+            continue
+        shorter: NemesisEvent = dataclasses.replace(
+            e, dur_s=round(max(1.0, e.dur_s / 2.0), 3)
+        )
+        candidate_events = events[:idx] + [shorter] + events[idx + 1:]
+        candidate = replace_events(cfg, candidate_events)
+        log(
+            f"minimize: halve event[{idx}] ({e.family}) duration "
+            f"{e.dur_s:g}s -> {shorter.dur_s:g}s?"
+        )
+        if still_red(candidate):
+            cfg = candidate
+            events = candidate_events
+            log("minimize: RED holds with shorter event")
+
+    stats.window_after = float(cfg.opts["time-limit"])
+    return cfg, stats

@@ -1,0 +1,94 @@
+"""Runtime for emitted fuzz repro drivers.
+
+An emitted ``store/fuzz_repro_*.py`` embeds one JSON spec (the
+minimized failing config) and calls :func:`main` — rebuild the exact
+configuration, run it, and exit 0 iff the red reproduced.  The twin
+green check (same schedule, seeded bug / strict contract stripped)
+lives in the pinned test, not here: a repro driver answers exactly one
+question — "does this minimal window still fail?" — and answers it
+fail-loud (anything other than a reproduced red, including crashes and
+undecided runs, exits non-zero)."""
+
+from __future__ import annotations
+
+import json
+import tempfile
+from typing import Any, Mapping
+
+from jepsen_tpu.fuzz.runner import triage_run
+from jepsen_tpu.fuzz.space import FuzzConfig
+
+
+def run_spec(
+    spec: Mapping[str, Any],
+    store_root: str | None = None,
+    attempts: int = 2,
+):
+    """One triaged run of ``spec``.  Returns the
+    :class:`~jepsen_tpu.fuzz.runner.FuzzOutcome`."""
+    cfg = FuzzConfig.from_spec(spec)
+    store = store_root or tempfile.mkdtemp(
+        prefix=f"fuzz_repro_{cfg.seed}_"
+    )
+    return triage_run(cfg, store, attempts=attempts)
+
+
+def green_twin_spec(spec: Mapping[str, Any]) -> dict[str, Any]:
+    """The same schedule with the *cause* removed: seeded bug stripped
+    and the contract relaxed back to what the SUT claims.  The pinned
+    test runs it expecting green — proving the red is the bug's, not
+    the harness's."""
+    twin = json.loads(json.dumps(spec))  # deep copy
+    twin["seed_bug"] = None
+    twin["sim_faults"] = {}
+    if twin["workload"] == "queue" and twin["db"] == "local":
+        twin["contract"]["delivery"] = "at-least-once"
+    if twin["workload"] == "elle" and twin["db"] == "local":
+        twin["contract"]["consistency-model"] = "read-committed"
+        twin["opts"]["consistency-model"] = "read-committed"
+    if twin["workload"] == "mutex":
+        # the unfenced lock is the documented hazard (red by design);
+        # the configuration with the green ending is the fenced one
+        twin["contract"]["fenced"] = True
+        twin["opts"]["fenced"] = True
+    return twin
+
+
+def main(spec: Mapping[str, Any], argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="seeded fuzz repro driver (auto-generated)"
+    )
+    p.add_argument("--attempts", type=int, default=2,
+                   help="triage attempts (undecided runs retry)")
+    p.add_argument("--store", default=None,
+                   help="store root (default: a temp dir)")
+    p.add_argument("--green-twin", action="store_true",
+                   help="run the green twin (seeded bug / strict "
+                        "contract stripped) and expect VALID instead")
+    args = p.parse_args(argv)
+
+    run = dict(spec)
+    expect = "red"
+    if args.green_twin:
+        run = green_twin_spec(spec)
+        expect = "green"
+    cfg = FuzzConfig.from_spec(run)
+    print(f"# fuzz repro: {cfg.describe()}")
+    print(f"# expecting {expect}")
+    out = run_spec(run, store_root=args.store, attempts=args.attempts)
+    print(f"# outcome: {out.status}")
+    for n in out.notes:
+        print(f"#   {n}")
+    if out.invalidating:
+        print(f"# invalidating checkers: {out.invalidating}")
+    if out.status == expect:
+        print(f"# REPRODUCED: run is {out.status}, as pinned")
+        return 0
+    print(
+        f"# NOT reproduced: expected {expect}, got {out.status} — "
+        f"either the bug is fixed (move this driver to the fixed "
+        f"section of PARITY.md) or the window has rotted"
+    )
+    return 1

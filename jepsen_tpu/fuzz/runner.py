@@ -1,0 +1,186 @@
+"""Build and run one fuzzed configuration, with the matrix triage rules.
+
+The triage is the same classification the CI matrix
+(``harness/matrix.py``) and the live-test supervisor
+(``tests/_live.py``) apply — this module reuses their predicates
+directly rather than reimplementing them:
+
+- crash / final-read-missing / verdict ``unknown`` → **undecided**
+  (the run cannot attest either way; retried up to the attempt budget);
+- verdict valid → **green**;
+- verdict invalid → **red** — for the fuzzer this is the *finding*, so
+  unlike a CI run it is never retried away; confirmation (re-running a
+  red to make sure it isn't a load artifact) is the minimizer's job,
+  with fresh clusters per run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from jepsen_tpu.fuzz.schedule import scheduled_nemesis_factory
+from jepsen_tpu.fuzz.space import FuzzConfig
+from jepsen_tpu.harness.matrix import MatrixRunner
+
+REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def _live():
+    """The ``tests/_live.py`` triage helpers (describe_invalid): the
+    tests directory rides the repo, not the package path."""
+    tests_dir = os.path.join(REPO, "tests")
+    if tests_dir not in sys.path:
+        sys.path.insert(0, tests_dir)
+    import _live
+
+    return _live
+
+
+@dataclass
+class FuzzOutcome:
+    status: str  # "green" | "red" | "undecided"
+    results: dict[str, Any] | None = None
+    notes: list[str] = field(default_factory=list)
+    invalidating: dict[str, Any] | None = None
+    history_len: int = 0
+
+
+def build_fuzz_test(cfg: FuzzConfig, store_root: str):
+    """Assemble ``cfg`` into a runnable test.  Returns
+    ``(test, closer)`` — ``closer()`` tears the cluster down."""
+    factory = scheduled_nemesis_factory(cfg.events)
+    if cfg.db == "sim":
+        from jepsen_tpu.suite import build_sim_test
+
+        test, _cluster = build_sim_test(
+            opts=cfg.opts,
+            nodes=[f"n{i + 1}" for i in range(cfg.n_nodes)],
+            concurrency=cfg.n_nodes,
+            checker_backend="cpu",
+            sim_seed=cfg.seed,
+            store_root=store_root,
+            workload=cfg.workload,
+            nemesis_factory=factory,
+            **{f"{k}": int(v) for k, v in cfg.sim_faults.items()},
+        )
+        return test, (lambda: None)
+    if cfg.db == "local":
+        from jepsen_tpu.client import native as native_mod
+        from jepsen_tpu.harness.localcluster import build_local_test
+
+        native_mod.reset()
+        test, transport = build_local_test(
+            cfg.opts,
+            n_nodes=cfg.n_nodes,
+            concurrency=cfg.n_nodes,
+            checker_backend="cpu",
+            store_root=store_root,
+            workload=cfg.workload,
+            seed_bug=cfg.seed_bug,
+            durable=cfg.durable,
+            nemesis_factory=factory,
+        )
+        if cfg.workload == "queue" and "delivery" in cfg.contract:
+            # the contract axis: check the live queue at the sampled
+            # delivery level (strict exactly-once reds on redelivery —
+            # the relaxed-contract finding class)
+            from jepsen_tpu.suite import queue_checker
+
+            test.checker = queue_checker(
+                "cpu", delivery=cfg.contract["delivery"]
+            )
+        return test, transport.close
+    raise ValueError(f"unknown fuzz db {cfg.db!r}")
+
+
+def run_once(cfg: FuzzConfig, store_root: str) -> FuzzOutcome:
+    """One run of ``cfg`` on a fresh cluster, triaged."""
+    from jepsen_tpu.control.runner import run_test
+
+    describe_invalid = _live().describe_invalid
+    test, closer = build_fuzz_test(cfg, store_root)
+    try:
+        try:
+            run = run_test(test)
+        except Exception as e:  # noqa: BLE001 — triaged as undecided
+            return FuzzOutcome(
+                "undecided", notes=[f"crashed: {e!r}"]
+            )
+    finally:
+        closer()
+    results = run.results
+    if MatrixRunner._final_read_missing(results):
+        return FuzzOutcome(
+            "undecided",
+            results=results,
+            notes=["final read missing (drain observed nothing)"],
+            history_len=len(run.history),
+        )
+    verdict = results.get("valid?")
+    if verdict is True:
+        return FuzzOutcome(
+            "green", results=results, history_len=len(run.history)
+        )
+    if verdict is False:
+        return FuzzOutcome(
+            "red",
+            results=results,
+            invalidating=describe_invalid(results),
+            history_len=len(run.history),
+        )
+    return FuzzOutcome(
+        "undecided",
+        results=results,
+        notes=["analysis unknown"],
+        history_len=len(run.history),
+    )
+
+
+def triage_run(
+    cfg: FuzzConfig, store_root: str, attempts: int = 2
+) -> FuzzOutcome:
+    """Run ``cfg`` with the triage retry budget: undecided runs retry on
+    a fresh cluster; the first green or red is final (redness is
+    confirmed later, by the minimizer, not laundered here)."""
+    notes: list[str] = []
+    out = FuzzOutcome("undecided")
+    for attempt in range(1, attempts + 1):
+        out = run_once(cfg, store_root)
+        notes += [f"attempt {attempt}: {n}" for n in out.notes]
+        if out.status != "undecided":
+            break
+    out.notes = notes
+    return out
+
+
+def is_red(
+    cfg: FuzzConfig, store_root: str, attempts: int = 2
+) -> bool:
+    """The minimizer's oracle: does ``cfg`` still red?  Undecided runs
+    retry; an exhausted budget counts as NOT red (a shrink step that
+    turned the run flaky is rejected, keeping the last provably-red
+    spec)."""
+    return triage_run(cfg, store_root, attempts=attempts).status == "red"
+
+
+def replace_events(cfg: FuzzConfig, events) -> FuzzConfig:
+    """A copy of ``cfg`` with a new event list (opts windows re-derived
+    — the two representations must never drift apart)."""
+    import dataclasses
+
+    opts = dict(cfg.opts)
+    opts["nemesis-schedule"] = [[e.at_s, e.dur_s] for e in events]
+    return dataclasses.replace(cfg, events=list(events), opts=opts)
+
+
+def replace_opts(cfg: FuzzConfig, **changes) -> FuzzConfig:
+    import dataclasses
+
+    opts = dict(cfg.opts)
+    opts.update(changes)
+    return dataclasses.replace(cfg, opts=opts)

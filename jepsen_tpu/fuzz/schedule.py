@@ -1,0 +1,339 @@
+"""Explicit nemesis schedules: the delta-debuggable fault timeline.
+
+The default suite nemesis runs an endless uniform cycle
+(sleep → start → sleep → stop), which is perfect for soaks and useless
+for minimization — there is no unit you can *remove*.  Here a schedule
+is an explicit list of :class:`NemesisEvent` windows, each naming one
+fault family, its own RNG seed, and its [start, start+duration) window
+inside the load phase.  Dropping an event from the list drops exactly
+one fault injection and nothing else; replaying the same list replays
+the same faults (same victims, same grudges) because every event
+carries its own seed.
+
+Two pieces cooperate:
+
+- :func:`schedule_generator` builds the nemesis-side generator program
+  (START at ``at_s``, STOP at ``at_s + dur_s``, per event, in order) —
+  consumed by ``suite._four_phase`` via the ``nemesis-schedule`` opt;
+- :class:`ScheduledNemesis` receives those START/STOP ops and applies
+  the corresponding event's family: each START builds a FRESH
+  single-family nemesis seeded with the event's seed (deterministic
+  victim/grudge choice, independent of how many earlier events were
+  dropped by the minimizer), each STOP heals that same instance.
+
+Families map onto the exact same nemesis classes ``make_nemesis``
+assembles, gated by the same surfaces — a family whose surface is
+missing raises at BUILD time, never silently no-ops mid-run.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Callable, Mapping, Sequence
+
+from jepsen_tpu.control.nemesis import (
+    CrashRestartNemesis,
+    ClockSkewNemesis,
+    MembershipNemesis,
+    PartitionNemesis,
+    ProcessNemesis,
+    SlowDiskNemesis,
+    WireChaosNemesis,
+)
+from jepsen_tpu.generators.core import (
+    EXHAUSTED,
+    Generator,
+    Once,
+    OpGen,
+    Seq,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+#: every family a schedule may draw, in canonical order
+FAMILIES = (
+    "partition",
+    "kill",
+    "pause",
+    "clock-skew",
+    "membership",
+    "crash-restart",
+    "slow-disk",
+    "wire-chaos",
+)
+
+
+@dataclass
+class NemesisEvent:
+    """One fault injection window: ``family`` starts at ``at_s`` into
+    the load phase and is healed at ``at_s + dur_s``.  ``seed`` makes
+    the event self-deterministic (victim choice, grudge shuffle);
+    ``params`` carries family specifics (partition strategy, wire
+    rates)."""
+
+    at_s: float
+    dur_s: float
+    family: str
+    seed: int
+    params: dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "at_s": self.at_s,
+            "dur_s": self.dur_s,
+            "family": self.family,
+            "seed": self.seed,
+            "params": dict(self.params),
+        }
+
+    @classmethod
+    def from_json(cls, d: Mapping[str, Any]) -> "NemesisEvent":
+        return cls(
+            at_s=float(d["at_s"]),
+            dur_s=float(d["dur_s"]),
+            family=str(d["family"]),
+            seed=int(d["seed"]),
+            params=dict(d.get("params", {})),
+        )
+
+
+class _Until(Generator):
+    """Sleep until an ABSOLUTE offset into the run (vs ``Sleep``'s
+    relative-to-first-ask), so dropping an earlier event never shifts a
+    later one — minimization must change one variable at a time."""
+
+    def __init__(self, at_s: float):
+        self.at_ns = int(at_s * 1e9)
+
+    def next_for(self, ctx):
+        if ctx.time < self.at_ns:
+            from jepsen_tpu.generators.core import Pending
+
+            return Pending(self.at_ns)
+        return EXHAUSTED
+
+
+def schedule_generator(windows: Sequence[Sequence[float]]) -> Generator:
+    """The nemesis-side generator for an explicit schedule:
+    ``windows`` is ``[[at_s, dur_s], ...]`` (sorted, non-overlapping —
+    :func:`validate_events` enforces it at build time); each window
+    emits one START at ``at_s`` and one STOP at ``at_s + dur_s``."""
+    gens: list[Generator] = []
+    for at_s, dur_s in windows:
+        gens += [
+            _Until(at_s),
+            Once(OpGen(OpF.START, OpType.INFO)),
+            _Until(at_s + dur_s),
+            Once(OpGen(OpF.STOP, OpType.INFO)),
+        ]
+    return Seq(gens)
+
+
+def validate_events(
+    events: Sequence[NemesisEvent], time_limit_s: float
+) -> None:
+    """Fail loudly on a malformed schedule: unknown family, overlap,
+    out-of-window events.  A schedule that silently drops or reorders
+    events would make minimization results meaningless."""
+    prev_end = -1.0
+    for e in events:
+        if e.family not in FAMILIES:
+            raise ValueError(
+                f"unknown nemesis family {e.family!r}; one of {FAMILIES}"
+            )
+        if e.dur_s <= 0.0:
+            raise ValueError(f"event {e} has non-positive duration")
+        if e.at_s < prev_end:
+            raise ValueError(
+                f"event {e} overlaps the previous window (ends "
+                f"{prev_end:.2f}s) — scheduled faults must not overlap: "
+                f"each STOP heals exactly one START"
+            )
+        if e.at_s >= time_limit_s:
+            raise ValueError(
+                f"event {e} starts after the load window "
+                f"({time_limit_s:.2f}s) and would never fire"
+            )
+        prev_end = e.at_s + e.dur_s
+
+
+class ScheduledNemesis:
+    """Replays an explicit :class:`NemesisEvent` list: the k-th START op
+    applies the k-th event (building a fresh, event-seeded single-family
+    nemesis), the paired STOP heals it.  Surfaces are the same ones
+    ``make_nemesis`` wires; a family without its surface raises at
+    construction — the whole schedule is validated before any cluster
+    time is spent."""
+
+    def __init__(
+        self,
+        events: Sequence[NemesisEvent],
+        opts: Mapping[str, Any],
+        net,
+        procs,
+        nodes: Sequence[str],
+        leader_fn=None,
+        clocks=None,
+        membership=None,
+        disks=None,
+        wire=None,
+    ):
+        self.events = list(events)
+        self.nodes = list(nodes)
+        self.net = net
+        self._factories: dict[str, Callable[[NemesisEvent], Any]] = {}
+
+        def fam(name: str, factory: Callable[[NemesisEvent], Any]):
+            self._factories[name] = factory
+
+        fam("partition", lambda e: PartitionNemesis(
+            e.params.get(
+                "strategy", opts.get(
+                    "network-partition", "partition-random-halves"
+                )
+            ),
+            net, nodes, seed=e.seed, leader_fn=leader_fn,
+        ))
+        fam("kill", lambda e: ProcessNemesis(
+            "kill", procs, nodes, seed=e.seed
+        ))
+        fam("pause", lambda e: ProcessNemesis(
+            "pause", procs, nodes, seed=e.seed
+        ))
+        if clocks is not None:
+            fam("clock-skew", lambda e: ClockSkewNemesis(
+                clocks, nodes, seed=e.seed
+            ))
+        if membership is not None and len(nodes) >= 3:
+            fam("membership", lambda e: MembershipNemesis(
+                procs, membership, nodes, seed=e.seed
+            ))
+        if opts.get("durable"):
+            fam("crash-restart", lambda e: CrashRestartNemesis(
+                procs, nodes
+            ))
+        if disks is not None and opts.get("durable"):
+            fam("slow-disk", lambda e: SlowDiskNemesis(
+                disks, nodes, seed=e.seed,
+                mean_ms=float(e.params.get("mean_ms", 120.0)),
+                jitter_ms=float(e.params.get("jitter_ms", 80.0)),
+            ))
+        if wire is not None:
+            fam("wire-chaos", lambda e: WireChaosNemesis(
+                wire, nodes, seed=e.seed,
+                corrupt_p=float(e.params.get("corrupt_p", 0.25)),
+                duplicate_p=float(e.params.get("duplicate_p", 0.15)),
+                delay_p=float(e.params.get("delay_p", 0.15)),
+                delay_ms=float(e.params.get("delay_ms", 40.0)),
+            ))
+
+        missing = sorted(
+            {e.family for e in self.events} - set(self._factories)
+        )
+        if missing:
+            raise ValueError(
+                f"schedule names families with no fault surface on this "
+                f"cluster: {missing} (available: "
+                f"{sorted(self._factories)}) — running without them "
+                f"would be a silently different schedule"
+            )
+        # fail on malformed events up front, too (the generator side
+        # only sees [at, dur] pairs)
+        validate_events(
+            self.events, float(opts.get("time-limit", 1e9))
+        )
+        # dry-build every event's nemesis NOW: the constructors are
+        # side-effect-free validators (partition strategy vs the net's
+        # one-way capability / leader_fn, wire rates in range, slow-disk
+        # latency non-zero) — a spec that would raise at its event's
+        # START mid-run must be refused before any cluster time is spent
+        for e in self.events:
+            self._factories[e.family](e)
+        self._next = 0
+        self._active: Any | None = None
+        self._built: list[Any] = []
+
+    def setup(self, test: Mapping[str, Any]) -> None:
+        if hasattr(self.net, "heal"):
+            self.net.heal()
+
+    def invoke(self, test: Mapping[str, Any], op: Op) -> Op:
+        import dataclasses
+
+        if op.f == OpF.START:
+            if self._next >= len(self.events):
+                # a START past the schedule (generator drift) is loud in
+                # the history but harmless: nothing is injected
+                return op.complete(OpType.INFO, value="schedule-exhausted")
+            event = self.events[self._next]
+            self._next += 1
+            member = self._factories[event.family](event)
+            member.setup(test)
+            self._built.append(member)
+            self._active = member
+            done = member.invoke(test, op)
+            return dataclasses.replace(
+                done,
+                value=f"[{event.at_s:g}s {event.family}] {done.value}",
+            )
+        if op.f == OpF.STOP:
+            if self._active is None:
+                return op.complete(OpType.INFO, value="nothing active")
+            member, self._active = self._active, None
+            return member.invoke(test, op)
+        raise ValueError(f"nemesis got unexpected op {op}")
+
+    def teardown(self, test: Mapping[str, Any]) -> None:
+        for m in self._built:
+            m.teardown(test)
+        if hasattr(self.net, "heal"):
+            self.net.heal()
+
+
+def scheduled_nemesis_factory(events: Sequence[NemesisEvent]):
+    """A drop-in for ``make_nemesis`` (same keyword surface) that builds
+    a :class:`ScheduledNemesis` over ``events`` — what the fuzz runner
+    passes to ``build_*_test(nemesis_factory=...)``."""
+
+    def factory(opts, net, procs, nodes, seed=None, leader_fn=None,
+                clocks=None, membership=None, disks=None, wire=None):
+        return ScheduledNemesis(
+            events, opts, net, procs, nodes, leader_fn=leader_fn,
+            clocks=clocks, membership=membership, disks=disks, wire=wire,
+        )
+
+    return factory
+
+
+def random_events(
+    rng: random.Random,
+    time_limit_s: float,
+    families: Sequence[str],
+    strategies: Sequence[str],
+    max_events: int = 6,
+) -> list[NemesisEvent]:
+    """Sample a non-overlapping event timeline over the load window.
+    Every event gets its own derived seed so minimization subsets stay
+    byte-deterministic."""
+    events: list[NemesisEvent] = []
+    t = rng.uniform(0.5, 2.0)
+    n = rng.randint(1, max_events)
+    for _ in range(n):
+        if t >= time_limit_s - 0.5:
+            break
+        dur = rng.uniform(1.0, min(6.0, max(1.2, time_limit_s / 3.0)))
+        family = rng.choice(list(families))
+        params: dict[str, Any] = {}
+        if family == "partition":
+            params["strategy"] = rng.choice(list(strategies))
+        events.append(
+            NemesisEvent(
+                at_s=round(t, 3),
+                dur_s=round(dur, 3),
+                family=family,
+                seed=rng.randrange(2**31),
+                params=params,
+            )
+        )
+        t += dur + rng.uniform(0.5, 3.0)
+    return events

@@ -1,0 +1,236 @@
+"""The fuzzed configuration space: seeded sampling of whole test
+configs — {workload x nemesis schedule x durability x contract x
+cluster size x membership churn} — and their JSON spec round-trip (the
+form the minimizer rewrites and the emitted repro drivers embed).
+
+Honesty rules baked into the sampler:
+
+- contracts default to what the SUT actually claims (live queue is
+  at-least-once, live elle is read-committed); ``strict_contract=True``
+  deliberately samples TIGHTER contracts — a "relaxed contract" red is
+  then the *expected* finding (the checker catching the gap between
+  claim and check level), which is the fuzzer's cheapest liveness
+  proof;
+- fault families are drawn only from what the target harness can
+  honestly inject (the sim has no clocks, no real membership, no WAL,
+  no wire, and symmetrizes partitions);
+- a seeded bug (``seed_bug``) is never sampled — it is an explicit
+  caller choice (``tools/fuzz_matrix.py --seed-bug``), because a
+  fuzzer that sometimes injects bugs into its own SUT by chance would
+  make every red suspect.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+from jepsen_tpu.fuzz.schedule import NemesisEvent, random_events
+
+#: spec schema version + required keys — gated by tests/test_ci.py so a
+#: committed repro driver can always be re-parsed
+SPEC_VERSION = 1
+SPEC_KEYS = (
+    "spec_version", "seed", "db", "workload", "n_nodes", "durable",
+    "contract", "seed_bug", "sim_faults", "opts", "events",
+)
+
+#: fault families / partition strategies each harness honestly supports
+LOCAL_FAMILIES = (
+    "partition", "kill", "pause", "clock-skew", "membership",
+    "wire-chaos",
+)
+LOCAL_DURABLE_FAMILIES = LOCAL_FAMILIES + ("crash-restart", "slow-disk")
+SIM_FAMILIES = ("partition", "kill", "pause")
+
+LOCAL_STRATEGIES = (
+    "partition-random-halves", "partition-halves",
+    "partition-majorities-ring", "partition-random-node",
+    "partition-leader",
+    "partition-one-way-in", "partition-one-way-out",
+)
+SIM_STRATEGIES = (
+    "partition-random-halves", "partition-halves",
+    "partition-majorities-ring", "partition-random-node",
+)
+
+WORKLOADS = ("queue", "stream", "elle", "mutex")
+
+
+@dataclass
+class FuzzConfig:
+    """One fuzzed configuration, fully deterministic given its spec."""
+
+    seed: int
+    db: str  # "local" | "sim"
+    workload: str
+    n_nodes: int
+    durable: bool
+    contract: dict[str, Any]
+    events: list[NemesisEvent]
+    opts: dict[str, Any]
+    seed_bug: str | None = None
+    sim_faults: dict[str, int] = field(default_factory=dict)
+
+    # -- spec round-trip (what the emitted repro drivers embed) ------------
+    def to_spec(self) -> dict[str, Any]:
+        return {
+            "spec_version": SPEC_VERSION,
+            "seed": self.seed,
+            "db": self.db,
+            "workload": self.workload,
+            "n_nodes": self.n_nodes,
+            "durable": self.durable,
+            "contract": dict(self.contract),
+            "seed_bug": self.seed_bug,
+            "sim_faults": dict(self.sim_faults),
+            "opts": dict(self.opts),
+            "events": [e.to_json() for e in self.events],
+        }
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any]) -> "FuzzConfig":
+        missing = [k for k in SPEC_KEYS if k not in spec]
+        if missing:
+            raise ValueError(f"fuzz spec missing keys: {missing}")
+        if spec["spec_version"] != SPEC_VERSION:
+            raise ValueError(
+                f"fuzz spec version {spec['spec_version']} != "
+                f"{SPEC_VERSION} (this tree)"
+            )
+        return cls(
+            seed=int(spec["seed"]),
+            db=str(spec["db"]),
+            workload=str(spec["workload"]),
+            n_nodes=int(spec["n_nodes"]),
+            durable=bool(spec["durable"]),
+            contract=dict(spec["contract"]),
+            events=[NemesisEvent.from_json(e) for e in spec["events"]],
+            opts=dict(spec["opts"]),
+            seed_bug=spec["seed_bug"],
+            sim_faults={
+                k: int(v) for k, v in spec["sim_faults"].items()
+            },
+        )
+
+    def describe(self) -> str:
+        fams = [e.family for e in self.events]
+        return (
+            f"seed={self.seed} db={self.db} {self.workload} "
+            f"n={self.n_nodes}{' durable' if self.durable else ''} "
+            f"contract={self.contract} events={fams} "
+            f"window={self.opts.get('time-limit'):g}s"
+            + (f" seed_bug={self.seed_bug}" if self.seed_bug else "")
+            + (f" sim_faults={self.sim_faults}" if self.sim_faults else "")
+        )
+
+
+def _sample_contract(
+    rng: random.Random, db: str, workload: str, strict: bool
+) -> dict[str, Any]:
+    """The checking contract: by default the level the SUT claims;
+    ``strict`` samples tighter ones (the relaxed-contract red class)."""
+    c: dict[str, Any] = {}
+    if workload == "queue":
+        honest = "at-least-once" if db == "local" else "exactly-once"
+        c["delivery"] = (
+            "exactly-once" if strict and db == "local" else honest
+        )
+    elif workload == "elle":
+        honest = "read-committed" if db == "local" else "serializable"
+        c["consistency-model"] = (
+            "serializable" if strict and db == "local" else honest
+        )
+    elif workload == "mutex":
+        # fenced is the configuration with a green ending; unfenced is
+        # the documented hazard (red by design) — fuzz the green one
+        # unless strict mode asks for the hazard explicitly
+        c["fenced"] = True if not strict else rng.random() < 0.5
+    return c
+
+
+def sample_config(
+    rng: random.Random,
+    db: str = "local",
+    time_limit_s: float | None = None,
+    rate: float | None = None,
+    strict_contract: bool = False,
+    seed_bug: str | None = None,
+    sim_faults: Mapping[str, int] | None = None,
+    max_events: int = 6,
+    workload: str | None = None,
+) -> FuzzConfig:
+    """Draw one configuration.  The draw is a pure function of ``rng``'s
+    state plus the explicit knobs, so ``tools/fuzz_matrix.py --seed N``
+    enumerates the same configs forever.  ``workload`` pins the family
+    (e.g. a sim fault knob that only the queue workload consumes)."""
+    if db not in ("local", "sim"):
+        raise ValueError(f"unknown fuzz db {db!r}")
+    if workload is not None and workload not in WORKLOADS:
+        raise ValueError(f"unknown workload {workload!r}")
+    cfg_seed = rng.randrange(2**31)
+    crng = random.Random(cfg_seed)
+    workload = workload or crng.choice(list(WORKLOADS))
+    n_nodes = crng.choice((3, 5))
+    durable = db == "local" and (
+        # ack-before-fsync only exists where there is a WAL to skip
+        True if seed_bug == "ack-before-fsync" else crng.random() < 0.5
+    )
+    if db == "local":
+        families = LOCAL_DURABLE_FAMILIES if durable else LOCAL_FAMILIES
+        strategies = LOCAL_STRATEGIES
+        if n_nodes < 3:  # membership churn needs a removable majority
+            families = tuple(f for f in families if f != "membership")
+    else:
+        families, strategies = SIM_FAMILIES, SIM_STRATEGIES
+    tl = (
+        float(time_limit_s)
+        if time_limit_s is not None
+        else crng.uniform(8.0, 20.0)
+    )
+    events = random_events(
+        crng, tl, families, strategies, max_events=max_events
+    )
+    contract = _sample_contract(crng, db, workload, strict_contract)
+    opts: dict[str, Any] = {
+        "rate": float(rate) if rate is not None else crng.choice(
+            (20.0, 40.0, 60.0)
+        ),
+        "time-limit": round(tl, 3),
+        "time-before-partition": 1.0,  # unused by the schedule, kept sane
+        "partition-duration": 5.0,
+        "network-partition": "partition-random-halves",
+        "recovery-sleep": 3.0 if db == "sim" else 6.0,
+        "publish-confirm-timeout": 2.5,
+        "durable": durable,
+        "seed": cfg_seed,
+        "nemesis-schedule": [[e.at_s, e.dur_s] for e in events],
+        **contract_opts(workload, contract),
+    }
+    return FuzzConfig(
+        seed=cfg_seed,
+        db=db,
+        workload=workload,
+        n_nodes=n_nodes,
+        durable=durable,
+        contract=contract,
+        events=events,
+        opts=opts,
+        seed_bug=seed_bug,
+        # normalized to ints here so specs round-trip exactly however
+        # the knob arrived (CLI "KNOB=N" strings included)
+        sim_faults={k: int(v) for k, v in (sim_faults or {}).items()},
+    )
+
+
+def contract_opts(
+    workload: str, contract: Mapping[str, Any]
+) -> dict[str, Any]:
+    """Contract knobs as test opts (the subset the suite reads)."""
+    o: dict[str, Any] = {}
+    if workload == "elle" and "consistency-model" in contract:
+        o["consistency-model"] = contract["consistency-model"]
+    if workload == "mutex":
+        o["fenced"] = bool(contract.get("fenced", False))
+    return o

@@ -1502,6 +1502,43 @@ def _serve_admin_conn(broker: MiniAmqpBroker, sock: "socket.socket") -> None:
         elif req == "CLOCK_GET" and broker.replication is not None:
             off = broker.replication.clock_offset_ms
             sock.sendall(f"{off:.3f}\n".encode())
+        elif req.startswith("FSYNC_LAT ") and (
+            broker.replication is not None
+        ):
+            # slow-disk nemesis: "this node's WAL device now takes
+            # mean±jitter ms per fsync".  "FSYNC_LAT 0 0" heals.
+            # Refused (ERR) on a memory-only node — no WAL, no fault.
+            parts = req.split()
+            try:
+                broker.replication.raft.set_fsync_latency(
+                    float(parts[1]),
+                    float(parts[2]) if len(parts) > 2 else 0.0,
+                )
+                sock.sendall(b"OK\n")
+            except (ValueError, IndexError) as e:
+                sock.sendall(f"ERR {e}\n".encode())
+        elif req.startswith("WIRE ") and broker.replication is not None:
+            # wire-chaos nemesis: netem-shaped corrupt/duplicate/delay
+            # on this node's outgoing peer RPC frames.
+            # "WIRE <corrupt_p> <dup_p> <delay_p> <delay_ms>"; "WIRE off"
+            # heals.
+            from jepsen_tpu.harness.replication import WireFaultSpec
+
+            arg = req[len("WIRE "):].strip()
+            try:
+                if arg == "off":
+                    broker.replication.raft.set_wire_faults(None)
+                else:
+                    c, d, dp, dms = (float(x) for x in arg.split())
+                    broker.replication.raft.set_wire_faults(
+                        WireFaultSpec(
+                            corrupt_p=c, duplicate_p=d,
+                            delay_p=dp, delay_ms=dms,
+                        )
+                    )
+                sock.sendall(b"OK\n")
+            except ValueError as e:
+                sock.sendall(f"ERR {e}\n".encode())
         elif req.startswith("FORGET ") and (
             broker.replication is not None
         ):

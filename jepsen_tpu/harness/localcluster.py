@@ -213,6 +213,42 @@ class LocalProcTransport(Transport):
             if r.rc != 0:
                 return RunResult(0, "", f"(node down: {r.err})")
             return RunResult(0, "", "")
+        if "dmsetup message jt-wal-delay" in inner:
+            # slow-disk nemesis: the dm-delay table reload an operator
+            # would run → the node's admin FSYNC_LAT (fsync latency
+            # applied inside its WAL path).  Fails loudly on a dead or
+            # memory-only node: OUR delay lives in the broker process,
+            # so "installed but inert" is impossible to honor — and a
+            # silent no-op would mint tolerates-slow-disk verdicts with
+            # no fault (the TransportDisks refusal contract).
+            mean, jitter = inner.split(" delay ", 1)[1].split()[:2]
+            r = self._admin(node, f"FSYNC_LAT {mean} {jitter}")
+            if r.rc != 0 or not r.out.startswith("OK"):
+                return RunResult(1, r.out, r.err or "FSYNC_LAT refused")
+            return RunResult(0, "", "")
+        if "tc qdisc" in inner and "netem" in inner:
+            # wire-chaos nemesis: the real netem line → the node's admin
+            # WIRE (rates applied to its outgoing peer RPC frames).
+            if inner.startswith("tc qdisc del") or " del " in inner:
+                r = self._admin(node, "WIRE off")
+            else:
+                toks = inner.split()
+
+                def pct(key: str) -> float:
+                    v = toks[toks.index(key) + 1]
+                    return float(v.rstrip("%")) / 100.0
+
+                delay_ms = float(
+                    toks[toks.index("delay") + 1].rstrip("ms")
+                )
+                r = self._admin(
+                    node,
+                    f"WIRE {pct('corrupt'):g} {pct('duplicate'):g} "
+                    f"{pct('reorder'):g} {delay_ms:g}",
+                )
+            if r.rc != 0 or not r.out.startswith("OK"):
+                return RunResult(1, r.out, r.err or "WIRE refused")
+            return RunResult(0, "", "")
         if "rabbitmqctl" in inner and " eval " in inner:
             return RunResult(0, "no_local_member", "")
         if inner.startswith("rm -rf ") and "rabbitmq-server" in inner:
@@ -536,6 +572,7 @@ def build_local_test(
     replicated: bool | None = None,
     seed_bug: str | None = None,
     durable: bool = False,
+    nemesis_factory=None,
 ):
     """The dress-rehearsal assembly in one call: ``build_rabbitmq_test``
     over a fresh :class:`LocalProcTransport` with the fast-boot
@@ -562,6 +599,7 @@ def build_local_test(
             checker_backend=checker_backend,
             store_root=store_root,
             workload=workload,
+            nemesis_factory=nemesis_factory,
         )
     except BaseException:
         t.close()

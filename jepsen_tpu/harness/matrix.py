@@ -124,6 +124,20 @@ LOCAL_EXTENDED_MATRIX: list[dict[str, Any]] = [
         durable=True,
         partition="random-partition-halves",
     ),
+    # slow-disk: fsync latency on the WAL (fsyncgate-adjacent) — a
+    # correct durable cluster confirms slower and loses nothing
+    _cfg(duration=10.0, nemesis="slow-disk", durable=True),
+    # wire chaos: corrupt/duplicate/reorder peer frames — a correct
+    # transport drops corrupted frames on checksum (degrades to
+    # retried loss) and shrugs off dup/reorder by idempotency
+    _cfg(duration=10.0, nemesis="wire-chaos"),
+    # asymmetric one-way partition: nobody hears the victim while it
+    # hears everyone — the deposed-leader truncation window without a
+    # full link cut ever happening
+    _cfg(
+        duration=10.0,
+        partition="partition-one-way-out",
+    ),
 ]
 
 

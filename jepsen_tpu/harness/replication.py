@@ -82,6 +82,36 @@ exercised):
   *discarded* instead of requeued, so messages delivered-but-unacked at
   drain time vanish from the replicated inflight map's reachable set —
   the delivery/requeue plane's loss mode, also flagged by total-queue.
+- ``no-wire-checksum`` — peer RPC frames are sent WITHOUT the integrity
+  CRC and received without verification, so a wire-corrupted frame that
+  still parses as JSON is *processed* instead of dropped: a mutated
+  entry body replicates into one replica's state machine and the
+  replicas silently diverge (a client reading from the corrupted
+  replica sees a phantom value; the real one is lost).  The default
+  (checksummed) transport drops every mangled frame — corruption
+  degrades to packet loss, which Raft is built to retry through.
+
+Runtime fault hooks (driven by the nemeses through the broker admin
+port, ``control/nemesis.py``):
+
+- :meth:`RaftNode.set_fsync_latency` — slow-disk injection on the WAL:
+  every real ``fsync`` (log append, term/vote persist) stalls
+  ``mean ± jitter`` ms while holding the node lock, exactly like a
+  device-mapper ``delay`` target under the store.  Fsyncgate-adjacent
+  but distinct from the fail-stop path: the disk is *slow*, not lying —
+  a correct node's confirms get slower (possibly timing out into
+  indeterminate ops, which is always safe) and nothing confirmed may be
+  lost.  Note the ``ack-before-fsync`` bug is immune to the stall by
+  construction: a node that never tells storage is fast — that is the
+  tell the red/green pair pins.
+- :meth:`RaftNode.set_wire_faults` — wire-layer chaos on this node's
+  outgoing frames (netem's corrupt/duplicate/delay, scoped to the peer
+  RPC plane): corruption mutates one alphanumeric byte (JSON stays
+  parseable — the nasty case; structural damage is already dropped by
+  the parser), duplication re-delivers idempotent protocol RPCs
+  (append_entries / request_vote — TCP dedups client_op streams, so
+  non-idempotent forwards are never duplicated), and delay holds one
+  frame while concurrent frames overtake (reordering).
 """
 
 from __future__ import annotations
@@ -94,6 +124,7 @@ import random
 import socket
 import threading
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -378,6 +409,64 @@ class _Waiter:
     failed: bool = False
 
 
+# ---------------------------------------------------------------------------
+# Wire-layer fault injection (the netem corrupt/duplicate/delay family)
+# ---------------------------------------------------------------------------
+
+#: RPC kinds that are idempotent at the protocol level — the only ones
+#: wire duplication re-delivers.  A ``client_op`` forward rides a
+#: TCP-like stream whose transport dedups segments, and re-submitting
+#: it would fabricate an application-level duplicate no real wire can;
+#: the consensus RPCs are replayed by design, so a duplicate is a legal
+#: schedule Raft must already tolerate.
+IDEMPOTENT_RPCS = ("append_entries", "request_vote")
+
+
+@dataclass
+class WireFaultSpec:
+    """Per-node wire-fault rates, applied to frames this node SENDS
+    (its side of the wire): each outgoing frame independently risks one
+    corrupted byte, a duplicate delivery (idempotent RPCs only), and a
+    pre-send delay that lets concurrent frames overtake (reordering)."""
+
+    corrupt_p: float = 0.0
+    duplicate_p: float = 0.0
+    delay_p: float = 0.0
+    delay_ms: float = 0.0
+
+    def validate(self) -> "WireFaultSpec":
+        for name in ("corrupt_p", "duplicate_p", "delay_p"):
+            p = getattr(self, name)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(f"wire fault {name}={p} outside [0, 1]")
+        if self.delay_p > 0.0 and self.delay_ms <= 0.0:
+            raise ValueError(
+                "wire delay_p > 0 with delay_ms <= 0 is a no-fault no-op"
+            )
+        if self.delay_ms < 0.0:
+            raise ValueError(f"wire delay_ms={self.delay_ms} negative")
+        return self
+
+    def active(self) -> bool:
+        return max(self.corrupt_p, self.duplicate_p, self.delay_p) > 0.0
+
+
+def corrupt_frame(data: bytes, rng: random.Random) -> bytes:
+    """Flip ONE digit byte of a serialized frame to a different digit.
+    Digit→digit is always JSON-safe (numbers stay numbers, digits inside
+    base64 strings stay string bytes), which makes this the nasty
+    corruption class: the frame still parses, only its MEANING changed —
+    terms, indices, commit counts, payload bytes.  Structurally broken
+    frames are already rejected by the JSON parser, checksum or not.
+    The trailing newline (framing) is never touched."""
+    idxs = [i for i, b in enumerate(data) if 0x30 <= b <= 0x39]
+    if not idxs:
+        return data
+    i = rng.choice(idxs)
+    repl = rng.choice([d for d in b"0123456789" if d != data[i]])
+    return data[:i] + bytes([repl]) + data[i + 1 :]
+
+
 class RaftNode:
     """One Raft participant; RPCs are newline-delimited JSON over TCP.
 
@@ -418,6 +507,20 @@ class RaftNode:
         self.dead_owner_s = dead_owner_s
         self.seed_bug = seed_bug
         self.rng = random.Random(rng_seed)
+
+        # runtime fault hooks (nemesis-driven via the broker admin port)
+        self._fsync_delay_ms = 0.0
+        self._fsync_jitter_ms = 0.0
+        self._fault_lock = threading.Lock()
+        self._fault_rng = random.Random(rng_seed)
+        self._wire: WireFaultSpec | None = None
+        #: wire-duplication re-sends ride ONE reusable worker (started
+        #: lazily on the first duplicate) — a fresh daemon thread per
+        #: duplicated frame would be the advisor-r5 thread-churn
+        #: anti-pattern the _hb_loop worker in this file exists to avoid
+        self._dup_pending: deque[tuple[tuple[str, int], bytes]] = deque()
+        self._dup_event = threading.Event()
+        self._dup_worker_started = False
 
         self.lock = threading.RLock()
         self.state = FOLLOWER
@@ -474,6 +577,7 @@ class RaftNode:
     def stop(self) -> None:
         self._running = False
         self._hb_event.set()  # unblock the heartbeat worker so it exits
+        self._dup_event.set()  # likewise the duplicate-sender, if started
         try:
             self._server.close()
         except OSError:
@@ -563,6 +667,7 @@ class RaftNode:
                     {"term": self.term, "voted_for": self.voted_for}, fh
                 )
                 fh.flush()
+                self._fsync_stall()
                 os.fsync(fh.fileno())
             os.replace(tmp, os.path.join(self.data_dir, "meta.json"))
         except OSError as e:
@@ -587,6 +692,7 @@ class RaftNode:
                         for r in records)
             )
             self._wal_fh.flush()
+            self._fsync_stall()
             os.fsync(self._wal_fh.fileno())
         except OSError as e:
             self._fail_stop_locked("WAL write failed", e)
@@ -619,6 +725,121 @@ class RaftNode:
     def unblock_all(self) -> None:
         with self.lock:
             self.blocked.clear()
+
+    # -- runtime fault hooks ------------------------------------------------
+    def set_fsync_latency(
+        self, mean_ms: float, jitter_ms: float = 0.0
+    ) -> None:
+        """Slow-disk injection: every subsequent real fsync (WAL append,
+        term/vote persist) stalls ``mean ± jitter`` ms, like a
+        device-mapper delay target under the store.  Refused on a
+        memory-only node — with no WAL there is no fsync to slow, and a
+        silently-absent fault would let a run claim "tolerates slow
+        disks" without one (the false-green-by-absent-fault class this
+        codebase refuses everywhere)."""
+        if mean_ms < 0.0 or jitter_ms < 0.0:
+            raise ValueError("fsync latency must be non-negative")
+        if self.data_dir is None and (mean_ms or jitter_ms):
+            raise ValueError(
+                f"raft {self.name} is memory-only (no WAL): fsync "
+                f"latency would be a no-fault no-op; use durable mode"
+            )
+        with self._fault_lock:
+            self._fsync_delay_ms = float(mean_ms)
+            self._fsync_jitter_ms = float(jitter_ms)
+
+    def set_wire_faults(self, spec: WireFaultSpec | None) -> None:
+        """Install (or with ``None`` clear) this node's outgoing wire
+        fault spec — netem's corrupt/duplicate/delay on the peer RPC
+        plane."""
+        if spec is not None:
+            spec.validate()
+        with self._fault_lock:
+            self._wire = spec
+
+    def _fsync_stall(self) -> None:
+        """The slow disk itself: called immediately before each real
+        ``os.fsync``.  Stalls the calling thread (which holds the node
+        lock — a node waiting on its disk IS stalled, that is the
+        fault).  Note ``ack-before-fsync`` never reaches here: a node
+        that skips storage is fast, which is exactly the tell the
+        slow-disk red/green pair pins."""
+        with self._fault_lock:
+            mean, jit = self._fsync_delay_ms, self._fsync_jitter_ms
+            extra = self._fault_rng.uniform(-jit, jit) if jit else 0.0
+        if mean > 0.0 or jit > 0.0:
+            time.sleep(max(0.0, mean + extra) / 1000.0)
+
+    # -- frame integrity + wire mangling ------------------------------------
+    # Frame format: b"%08x " % crc32(body) + body + b"\n" — the CRC is
+    # out-of-band so the sender serializes ONCE and the receiver
+    # verifies against the raw received bytes with no re-serialization
+    # (this runs on every heartbeat/append at tick rate x peers).  The
+    # ``no-wire-checksum`` seeded bug sends the bare body instead.
+
+    def _frame(self, msg: dict) -> bytes:
+        body = json.dumps(msg).encode()
+        if self.seed_bug == "no-wire-checksum":
+            return body + b"\n"
+        return b"%08x " % zlib.crc32(body) + body + b"\n"
+
+    def _parse_frame(self, buf: bytes) -> dict | None:
+        """Parse (and with checksums on, CRC-verify) one received frame;
+        ``None`` means drop it.  A frame whose CRC prefix is absent or
+        wrong is corrupted-in-flight: corruption degrades to packet
+        loss, which the protocol already retries through.  Under the
+        seeded bug nothing is verified — a mangled frame that still
+        parses is PROCESSED (the bug)."""
+        line = buf.rstrip(b"\n")
+        if self.seed_bug == "no-wire-checksum":
+            if line[:1] != b"{" and line[8:9] == b" ":
+                line = line[9:]  # a checksummed peer's prefix, ignored
+            try:
+                msg = json.loads(line.decode())
+            except (ValueError, UnicodeDecodeError):
+                return None
+            return msg if isinstance(msg, dict) else None
+        if len(line) < 10 or line[8:9] != b" ":
+            return None  # no CRC while checksums are on: corrupted
+        body = line[9:]
+        try:
+            ok = int(line[:8], 16) == zlib.crc32(body)
+        except ValueError:
+            ok = False
+        if not ok:
+            logger.debug(
+                "raft %s: dropped corrupted frame (crc mismatch)",
+                self.name,
+            )
+            return None
+        try:
+            msg = json.loads(body.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None
+        return msg if isinstance(msg, dict) else None
+
+    def _wire_mangle(
+        self, data: bytes, rpc: str | None
+    ) -> tuple[bytes, float, bool]:
+        """Apply this node's wire spec to one outgoing frame: returns
+        ``(bytes, pre-send delay seconds, send a duplicate?)``."""
+        with self._fault_lock:
+            spec, rng = self._wire, self._fault_rng
+            if spec is None or not spec.active():
+                return data, 0.0, False
+            delay = (
+                spec.delay_ms / 1000.0
+                if spec.delay_p and rng.random() < spec.delay_p
+                else 0.0
+            )
+            dup = bool(
+                spec.duplicate_p
+                and rpc in IDEMPOTENT_RPCS
+                and rng.random() < spec.duplicate_p
+            )
+            if spec.corrupt_p and rng.random() < spec.corrupt_p:
+                data = corrupt_frame(data[:-1], rng) + b"\n"
+        return data, delay, dup
 
     def submit(self, op: dict, timeout_s: float = 5.0) -> tuple[bool, Any]:
         """Commit ``op`` and return ``(True, result)``; ``(False, None)``
@@ -903,11 +1124,27 @@ class RaftNode:
         blocked_peer: str | None = None,
     ) -> dict | None:
         host, port = addr
+        if not self._running:
+            # a stopped node is silent on the wire: lingering daemon
+            # threads (a replication loop mid-batch, a late heartbeat)
+            # must not keep speaking for a "dead" node — in-process
+            # restarts reuse the ports, and a ghost leader's appends
+            # would resurrect state a real SIGKILL would have destroyed
+            return None
+        data, delay, dup = self._wire_mangle(
+            self._frame(msg), msg.get("rpc")
+        )
+        if delay:
+            # held frame: concurrent RPCs from other threads overtake
+            # (the wire's reordering), then this one goes out late
+            time.sleep(delay)
+        if dup:
+            self._enqueue_duplicate(addr, data)
         try:
             with socket.create_connection(
                 (host, port), timeout=min(0.25, timeout_s)
             ) as s:
-                s.sendall((json.dumps(msg) + "\n").encode())
+                s.sendall(data)
                 if blocked_peer is not None:
                     with self.lock:
                         drop_reply = blocked_peer in self.blocked
@@ -920,9 +1157,45 @@ class RaftNode:
                     if not chunk:
                         return None
                     buf += chunk
-                return json.loads(buf.decode())
+                # a corrupted reply drops like a lost one (crc mismatch)
+                return self._parse_frame(buf)
         except (OSError, ValueError):
             return None
+
+    def _enqueue_duplicate(
+        self, addr: tuple[str, int], data: bytes
+    ) -> None:
+        """Hand a frame to the reusable duplicate-sender worker.  The
+        queue is bounded: under backlog a duplicate is simply not
+        re-delivered, which is a legal wire schedule (duplication is
+        best-effort chaos, never a protocol obligation)."""
+        with self._fault_lock:
+            if not self._dup_worker_started:
+                self._dup_worker_started = True
+                threading.Thread(
+                    target=self._dup_loop, daemon=True
+                ).start()
+            if len(self._dup_pending) < 64:
+                self._dup_pending.append((addr, data))
+        self._dup_event.set()
+
+    def _dup_loop(self) -> None:
+        """Fire-and-forget re-delivery of idempotent RPC frames (the
+        wire's duplication); responses are discarded."""
+        while self._running:
+            if not self._dup_event.wait(timeout=0.5):
+                continue
+            self._dup_event.clear()
+            while True:
+                with self._fault_lock:
+                    if not self._dup_pending:
+                        break
+                    addr, data = self._dup_pending.popleft()
+                try:
+                    with socket.create_connection(addr, timeout=0.25) as s:
+                        s.sendall(data)
+                except OSError:
+                    pass
 
     def _accept_loop(self) -> None:
         while self._running:
@@ -943,14 +1216,24 @@ class RaftNode:
                 if not chunk:
                     return
                 buf += chunk
-            msg = json.loads(buf.decode())
+            msg = self._parse_frame(buf)
+            if msg is None:
+                return  # corrupted in flight: dropped, like packet loss
             sender = msg.get("from")
             with self.lock:
                 if sender in self.blocked:
                     return  # INPUT DROP: never processed, never answered
             resp = self._dispatch(msg)
             if resp is not None:
-                sock.sendall((json.dumps(resp) + "\n").encode())
+                # responses ride the same wire: corrupt/delay apply
+                # (duplication on the same socket would be a no-op —
+                # the caller reads one line)
+                data, delay, _dup = self._wire_mangle(
+                    self._frame(resp), None
+                )
+                if delay:
+                    time.sleep(delay)
+                sock.sendall(data)
         except (OSError, ValueError):
             pass
         finally:

@@ -31,7 +31,9 @@ from jepsen_tpu.control.net import (
     SimNet,
     SimProcs,
     TransportClocks,
+    TransportDisks,
     TransportMembership,
+    TransportWire,
 )
 from jepsen_tpu.control.nemesis import make_nemesis
 from jepsen_tpu.control.runner import DB, Test
@@ -92,15 +94,27 @@ def _four_phase(opts: Mapping[str, Any], load, final_read_factory):
     """The shared four-phase choreography (``rabbitmq.clj:267-284``):
     rate-limited load under the nemesis cycle → heal → recovery sleep →
     one final read per thread.  ``load`` is the client op generator;
-    ``final_read_factory()`` builds each thread's phase-4 generator."""
-    nemesis_cycle = Cycle(
-        lambda: [
-            Sleep(opts["time-before-partition"]),
-            Once(OpGen(OpF.START, OpType.INFO)),
-            Sleep(opts["partition-duration"]),
-            Once(OpGen(OpF.STOP, OpType.INFO)),
-        ]
-    )
+    ``final_read_factory()`` builds each thread's phase-4 generator.
+
+    The nemesis side is the uniform start/sleep/stop cycle by default;
+    an explicit ``nemesis-schedule`` opt (a list of ``[at_s, dur_s]``
+    windows, produced by the matrix fuzzer) replaces it with start/stop
+    pairs at exactly those offsets — the delta-debuggable form: dropping
+    a window from the list drops exactly one fault injection."""
+    schedule = opts.get("nemesis-schedule")
+    if schedule is not None:
+        from jepsen_tpu.fuzz.schedule import schedule_generator
+
+        nemesis_cycle = schedule_generator(schedule)
+    else:
+        nemesis_cycle = Cycle(
+            lambda: [
+                Sleep(opts["time-before-partition"]),
+                Once(OpGen(OpF.START, OpType.INFO)),
+                Sleep(opts["partition-duration"]),
+                Once(OpGen(OpF.STOP, OpType.INFO)),
+            ]
+        )
     phase_load = TimeLimit(
         NemesisRoute(nemesis_cycle, Delay(load, 1.0 / opts["rate"])),
         opts["time-limit"],
@@ -281,10 +295,14 @@ def build_sim_test(
     stale_token_every: int = 0,
     store_root: str = "store",
     workload: str = "queue",
+    nemesis_factory=None,
 ) -> tuple[Test, SimCluster]:
     """The reference test wired to the in-process simulator.  ``workload``
     selects the queue (reference active path), stream (config #4), or
-    elle transactional (config #5) program."""
+    elle transactional (config #5) program.  ``nemesis_factory`` (same
+    keyword signature as :func:`make_nemesis`) swaps the nemesis
+    assembly — the matrix fuzzer passes its scheduled-event nemesis
+    through here."""
     from jepsen_tpu.client.protocol import StreamClient, TxnClient
     from jepsen_tpu.client.sim import (
         sim_stream_driver_factory,
@@ -305,7 +323,7 @@ def build_sim_test(
         dead_letter=bool(o.get("dead-letter")),
         message_ttl_s=o.get("message-ttl", 1.0),
     )
-    nemesis = make_nemesis(
+    nemesis = (nemesis_factory or make_nemesis)(
         o, SimNet(cluster), SimProcs(cluster), nodes, seed=sim_seed
     )
     if workload == "stream":
@@ -379,6 +397,7 @@ def build_rabbitmq_test(
     transport=None,
     workload: str = "queue",
     db=None,
+    nemesis_factory=None,
 ) -> Test:
     """The reference test against a real RabbitMQ cluster: SSH DB
     lifecycle, iptables partitions, native C++ AMQP clients.
@@ -401,7 +420,7 @@ def build_rabbitmq_test(
         user=ssh_user, private_key=ssh_private_key
     )
     db = db or RabbitMQDB(transport, nodes)
-    nemesis = make_nemesis(
+    nemesis = (nemesis_factory or make_nemesis)(
         o,
         IptablesNet(transport, nodes),
         RabbitMQProcs(transport, nodes),
@@ -427,6 +446,22 @@ def build_rabbitmq_test(
         # same gate — only meaningful where joins are real
         membership=(
             TransportMembership(transport, nodes)
+            if getattr(transport, "replicated", True)
+            else None
+        ),
+        # slow-disk (WAL fsync latency): only where there IS a WAL —
+        # a durable replicated cluster; elsewhere the surface is absent
+        # and make_nemesis refuses the family rather than no-opping it
+        disks=(
+            TransportDisks(transport, nodes)
+            if getattr(transport, "replicated", True)
+            and bool(o.get("durable"))
+            else None
+        ),
+        # wire chaos (peer-frame corrupt/duplicate/reorder): any
+        # replicated cluster's RPC plane
+        wire=(
+            TransportWire(transport, nodes)
             if getattr(transport, "replicated", True)
             else None
         ),

@@ -193,19 +193,25 @@ def test_local_extended_tier_parses_and_stays_out_of_sim():
         matrix_cli_flags,
     )
 
-    assert len(LOCAL_EXTENDED_MATRIX) == 4
+    assert len(LOCAL_EXTENDED_MATRIX) == 7
     parser = build_parser()
     for line in matrix_cli_flags(LOCAL_EXTENDED_MATRIX):
         parser.parse_args(["test"] + line.split())
     # the sim-safe tier must carry none of the faults the sim would noop:
     # no wall clocks (clock-skew), no real membership (churn), no per-node
     # durable state for a power failure to threaten (crash-restart and the
-    # durable mixed soak — advisor r4: these passed vacuously on sim)
+    # durable mixed soak — advisor r4: these passed vacuously on sim), no
+    # WAL for a slow disk to stall, no peer wire for chaos to mangle, and
+    # no direction-honoring net for a one-way partition
     sim_safe = {c.get("nemesis") for c in EXTENDED_MATRIX}
     assert not sim_safe & {
         "clock-skew", "membership-churn", "crash-restart-cluster", "mixed",
+        "slow-disk", "wire-chaos",
     }
     assert not any(c.get("durable") for c in EXTENDED_MATRIX)
+    assert not any(
+        "one-way" in str(c.get("partition", "")) for c in EXTENDED_MATRIX
+    )
 
 
 class TestBenchElleSmoke:
@@ -586,3 +592,79 @@ class TestDistributedSpawnSmoke:
                 == check_stream_lin_cpu(sh.ops)["valid?"]
             )
         assert any(r["stream"]["valid?"] is not True for r in results)
+
+
+class TestFuzzMatrixSmoke:
+    """Offline deterministic fuzzer smoke (sim harness, fixed seed,
+    tiny budget): the run/triage/minimize plumbing must round-trip —
+    a seeded-bug config is found, confirmed, shrunk to a nonempty
+    minimal window, emitted as a repro driver whose schema gates here,
+    and the emitted spec reproduces its red standalone."""
+
+    @pytest.fixture(scope="class")
+    def fuzz_run(self, tmp_path_factory):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "fuzz_matrix", REPO / "tools" / "fuzz_matrix.py"
+        )
+        fm = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fm)
+        emit_dir = tmp_path_factory.mktemp("fuzz_emit")
+        store = tmp_path_factory.mktemp("fuzz_store")
+        rc = fm.main([
+            "--seed", "3", "--budget", "2", "--db", "sim",
+            "--workload", "queue", "--time-limit", "1.5",
+            "--rate", "60", "--max-events", "2",
+            "--sim-fault", "drop_acked_every=5",
+            "--expect-red", "--stop-after-red",
+            "--confirm", "1", "--attempts", "2",
+            "--emit-dir", str(emit_dir), "--store", str(store),
+            "--quiet-cluster",
+        ])
+        return rc, emit_dir
+
+    def test_seeded_bug_found_and_minimized(self, fuzz_run):
+        rc, emit_dir = fuzz_run
+        assert rc == 0, "--expect-red exited non-zero: the seeded sim " \
+            "fault went uncaught (fuzzer liveness broken)"
+        repros = sorted(emit_dir.glob("fuzz_repro_*.py"))
+        assert len(repros) == 1, repros
+
+    def test_emitted_repro_schema_gates(self, fuzz_run):
+        from jepsen_tpu.fuzz.emit import load_spec, validate_spec
+        from jepsen_tpu.fuzz.space import SPEC_KEYS
+
+        _rc, emit_dir = fuzz_run
+        (path,) = sorted(emit_dir.glob("fuzz_repro_*.py"))
+        spec = load_spec(str(path))
+        assert set(SPEC_KEYS) <= set(spec), (
+            sorted(set(SPEC_KEYS) - set(spec))
+        )
+        cfg = validate_spec(spec)  # round-trips into a config
+        # the minimal failing window is nonempty and the sim fault that
+        # caused the red rode along into the spec
+        assert float(cfg.opts["time-limit"]) > 0.0
+        assert cfg.sim_faults.get("drop_acked_every") == 5
+        assert cfg.opts["nemesis-schedule"] == [
+            [e.at_s, e.dur_s] for e in cfg.events
+        ]
+        # the driver is executable text that calls back into the repro
+        # runtime (never a pickled blob)
+        text = path.read_text()
+        assert "jepsen_tpu.fuzz.repro" in text
+        assert "SPEC = json.loads(" in text
+
+    def test_emitted_spec_reproduces_red_and_green_twin(self, fuzz_run):
+        from jepsen_tpu.fuzz.emit import load_spec
+        from jepsen_tpu.fuzz.repro import green_twin_spec, run_spec
+
+        _rc, emit_dir = fuzz_run
+        (path,) = sorted(emit_dir.glob("fuzz_repro_*.py"))
+        spec = load_spec(str(path))
+        out = run_spec(spec, attempts=2)
+        assert out.status == "red", (out.status, out.notes)
+        twin = green_twin_spec(spec)
+        assert twin["sim_faults"] == {}
+        out2 = run_spec(twin, attempts=2)
+        assert out2.status == "green", (out2.status, out2.notes)

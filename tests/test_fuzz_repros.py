@@ -1,0 +1,87 @@
+"""Pinned red/green pairs for every committed fuzz repro driver.
+
+The matrix fuzzer (``tools/fuzz_matrix.py``, FUZZING.md) emits each
+minimized finding as ``store/fuzz_repro_*.py`` with an embedded JSON
+spec.  This module is the pinning side of that contract:
+
+- **red**: the spec reproduces its violation (the minimal window still
+  fails) — if a fix lands and this direction goes green, move the
+  driver to the fixed section of PARITY.md and flip its expectation,
+  the ``tools/repro_r7_*`` lifecycle;
+- **green twin**: the same schedule with the cause stripped (seeded
+  bug removed, contract relaxed to the SUT's claim) stays green — the
+  red is the bug's, not the harness's.
+
+Specs are parsed out of the drivers without executing them (the
+drivers are also standalone entry points; here only their SPEC
+literal is consumed)."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+STORE = REPO / "store"
+
+REPROS = sorted(STORE.glob("fuzz_repro_*.py"))
+
+
+def _spec(path):
+    from jepsen_tpu.fuzz.emit import load_spec, validate_spec
+
+    spec = load_spec(str(path))
+    validate_spec(spec)  # schema-gate every committed driver
+    return spec
+
+
+def _ids(paths):
+    return [p.stem.replace("fuzz_repro_", "") for p in paths]
+
+
+@pytest.mark.skipif(not REPROS, reason="no committed fuzz repros yet")
+@pytest.mark.parametrize("path", REPROS, ids=_ids(REPROS))
+def test_committed_repro_schema_round_trips(path):
+    from jepsen_tpu.fuzz.space import FuzzConfig
+
+    cfg = FuzzConfig.from_spec(_spec(path))
+    assert float(cfg.opts["time-limit"]) > 0.0
+    assert cfg.opts["nemesis-schedule"] == [
+        [e.at_s, e.dur_s] for e in cfg.events
+    ]
+
+
+@pytest.mark.parametrize("path", REPROS, ids=_ids(REPROS))
+def test_pinned_red_reproduces(path, tmp_path):
+    """The minimal failing window still fails."""
+    from jepsen_tpu.fuzz.repro import run_spec
+
+    out = run_spec(
+        _spec(path), store_root=str(tmp_path / "s"), attempts=2
+    )
+    assert out.status == "red", (
+        f"{path.name}: expected the pinned red to reproduce, got "
+        f"{out.status} ({out.notes}) — if the underlying bug was "
+        f"FIXED, move this driver to PARITY.md's fixed section and "
+        f"flip this pin"
+    )
+
+
+@pytest.mark.parametrize("path", REPROS, ids=_ids(REPROS))
+def test_pinned_green_twin_stays_green(path, tmp_path):
+    """Same schedule, cause stripped: the correct config is green."""
+    from jepsen_tpu.fuzz.repro import green_twin_spec, run_spec
+
+    spec = _spec(path)
+    twin = green_twin_spec(spec)
+    assert twin["seed_bug"] is None and twin["sim_faults"] == {}
+    out = run_spec(
+        twin, store_root=str(tmp_path / "s"), attempts=3
+    )
+    assert out.status == "green", (
+        f"{path.name}: the green twin went {out.status} "
+        f"({out.notes}, {out.invalidating}) — the minimal window reds "
+        f"WITHOUT its seeded cause, i.e. a real (or harness) bug"
+    )

@@ -1,0 +1,584 @@
+"""Red/green pairs for the three new fault families (slow-disk fsync
+latency, asymmetric one-way partitions, wire corruption) plus the
+``make_nemesis`` opts-validation contract.
+
+Every family proves BOTH directions at the replication layer (fast,
+in-process, seeded):
+
+- green: a correct configuration under the fault loses nothing;
+- red: the family's seeded bug (or the documented hazard) under the
+  SAME schedule produces the observable violation the checker exists
+  to flag — confirming the fault is real, not a silent no-op.
+"""
+
+from __future__ import annotations
+
+import shutil
+import socket
+import tempfile
+import time
+
+import pytest
+
+from jepsen_tpu.harness.replication import (
+    ReplicatedBackend,
+    WireFaultSpec,
+)
+
+FAST = dict(
+    election_timeout=(0.1, 0.2),
+    heartbeat_s=0.03,
+    dead_owner_s=1.0,
+    submit_timeout_s=2.5,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Cluster:
+    """In-process replication-layer cluster (the test_r7 idiom)."""
+
+    def __init__(self, n=3, seed_bug=None, root=None, **overrides):
+        self.root = root
+        self.names = [f"n{i}" for i in range(n)]
+        self.peers = {nm: ("127.0.0.1", _free_port())
+                      for nm in self.names}
+        self.seed_bug = seed_bug
+        self.opts = {**FAST, **overrides}
+        self.backends: dict[str, ReplicatedBackend] = {}
+        for i, nm in enumerate(self.names):
+            self._boot(nm, i)
+
+    def _boot(self, nm: str, idx: int) -> None:
+        self.backends[nm] = ReplicatedBackend(
+            nm,
+            self.peers,
+            seed_bug=self.seed_bug,
+            rng_seed=1000 + idx,
+            data_dir=(
+                None if self.root is None else f"{self.root}/{nm}"
+            ),
+            **self.opts,
+        )
+
+    def leader(self, timeout=8.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for nm, b in self.backends.items():
+                if b.raft.is_leader():
+                    return nm
+            time.sleep(0.02)
+        raise AssertionError("no leader")
+
+    def crash_restart_all(self) -> None:
+        """The power failure: stop every node, reboot from the WALs."""
+        assert self.root is not None, "crash-restart needs durable dirs"
+        for b in self.backends.values():
+            b.stop()
+        # ports are being rebound immediately: retry transient clashes
+        for i, nm in enumerate(self.names):
+            for attempt in range(40):
+                try:
+                    self._boot(nm, i)
+                    break
+                except OSError:
+                    if attempt == 39:
+                        raise
+                    time.sleep(0.1)
+
+    def one_way_out(self, victim: str) -> None:
+        """NOBODY hears ``victim``; it hears everyone (the
+        partition-one-way-out grudge, applied directly)."""
+        for nm, b in self.backends.items():
+            if nm != victim:
+                b.raft.block(victim)
+
+    def heal(self) -> None:
+        for b in self.backends.values():
+            b.raft.unblock_all()
+
+    def queue_bodies(self, nm: str, q: str) -> list[bytes]:
+        m = self.backends[nm].machine
+        with m.lock:
+            return [msg.body for msg in m.queues.get(q, ())]
+
+    def converged(self, q: str, timeout=8.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            views = {
+                nm: tuple(self.queue_bodies(nm, q))
+                for nm in self.names
+            }
+            if len(set(views.values())) == 1:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def stop(self) -> None:
+        for b in self.backends.values():
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Family 1: slow-disk / fsync latency
+# ---------------------------------------------------------------------------
+
+
+class TestSlowDisk:
+    def test_green_durable_cluster_survives_slow_disks_and_power_loss(
+        self, tmp_path
+    ):
+        """Fsync latency on EVERY node: confirms must actually stall
+        (the fault is real) yet everything confirmed survives a
+        whole-cluster crash-restart — the correct-durable green."""
+        c = _Cluster(root=str(tmp_path / "d"))
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            assert b.enqueue("q", b"0", b"") is True  # fast baseline
+            for nm in c.names:
+                c.backends[nm].raft.set_fsync_latency(60.0, 20.0)
+            acked = [b"0"]
+            t0 = time.monotonic()
+            for v in (b"1", b"2", b"3"):
+                if c.backends[c.leader()].enqueue("q", v, b""):
+                    acked.append(v)
+            stalled = time.monotonic() - t0
+            # 3 submits x (leader WAL + majority replication, each
+            # fsync >=40ms): well over 120ms in aggregate — proves the
+            # latency reached the write path (no-silent-no-op)
+            assert stalled > 0.12, f"fsync stall never happened ({stalled:.3f}s)"
+            assert len(acked) >= 3
+            c.crash_restart_all()
+            c.leader(timeout=12.0)
+            # recovery replays the WAL as the new leader's noop commit
+            # advances — poll until the confirmed set is back (an
+            # all-empty snapshot taken before replay proves nothing)
+            deadline = time.monotonic() + 12.0
+            recovered: set[bytes] = set()
+            while time.monotonic() < deadline and not (
+                set(acked) <= recovered
+            ):
+                recovered = set(c.queue_bodies(c.names[0], "q"))
+                time.sleep(0.05)
+            missing = set(acked) - recovered
+            assert missing == set(), (
+                f"slow disk lost confirmed values: {missing}"
+            )
+            assert c.converged("q", timeout=8.0)
+        finally:
+            c.stop()
+
+    def test_red_ack_before_fsync_under_the_same_schedule(self, tmp_path):
+        """The same slow-disk + power-loss schedule over the
+        ``ack-before-fsync`` seeded bug: the lying node is FAST (the
+        tell) and confirmed values vanish — the family's red."""
+        c = _Cluster(root=str(tmp_path / "d"), seed_bug="ack-before-fsync")
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            for nm in c.names:
+                # the seeded bug never reaches the (slowed) disk, so
+                # this latency is installed yet cannot stall anything
+                c.backends[nm].raft.set_fsync_latency(60.0, 20.0)
+            acked = []
+            t0 = time.monotonic()
+            for v in (b"1", b"2", b"3"):
+                if b.enqueue("q", v, b""):
+                    acked.append(v)
+            fast = time.monotonic() - t0
+            assert acked, "nothing confirmed"
+            # the tell: a node lying about fsync confirms at full speed
+            # under a disk that should cost >=40ms per write
+            assert fast < 1.0
+            c.crash_restart_all()
+            c.leader(timeout=12.0)
+            time.sleep(0.5)
+            recovered = set()
+            for nm in c.names:
+                recovered |= set(c.queue_bodies(nm, "q"))
+            lost = set(acked) - recovered
+            assert lost, (
+                "ack-before-fsync under the slow-disk schedule lost "
+                "nothing — the red pair no longer catches the bug"
+            )
+        finally:
+            c.stop()
+
+    def test_memory_only_node_refuses_the_fault(self):
+        """No WAL, no fault: the latency hook refuses rather than
+        silently no-opping (the false-green-by-absent-fault class)."""
+        c = _Cluster()
+        try:
+            with pytest.raises(ValueError, match="memory-only"):
+                c.backends[c.names[0]].raft.set_fsync_latency(50.0)
+        finally:
+            c.stop()
+
+
+# ---------------------------------------------------------------------------
+# Family 2: asymmetric one-way partitions
+# ---------------------------------------------------------------------------
+
+
+class TestOneWayPartition:
+    def test_green_correct_cluster_survives_one_way_out(self):
+        """Nobody hears the leader, it hears everyone: the majority
+        elects past it, the deposed leader truncates nothing committed,
+        every confirmed value survives the heal."""
+        c = _Cluster()
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            assert b.enqueue("q", b"1", b"") is True
+            c.one_way_out(lead)
+            # the old leader must NOT confirm into the void: a correct
+            # submit either times out (no acks arrive) or forwards
+            ok, _ = b.raft.submit(
+                {"k": "enq", "q": "q", "body": "Mg==", "props": "",
+                 "ts": 0.0},
+                timeout_s=1.0,
+            )
+            # a new leader rises among the majority (they stopped
+            # hearing the old one's appends)
+            deadline = time.monotonic() + 8.0
+            new_lead = None
+            while time.monotonic() < deadline and new_lead is None:
+                for nm, nb in c.backends.items():
+                    if nm != lead and nb.raft.is_leader():
+                        new_lead = nm
+                time.sleep(0.02)
+            assert new_lead, "majority never elected past the muted leader"
+            assert c.backends[new_lead].enqueue("q", b"3", b"") is True
+            c.heal()
+            assert c.converged("q", timeout=8.0)
+            bodies = set(c.queue_bodies(lead, "q"))
+            assert b"1" in bodies and b"3" in bodies
+            if ok:  # the old leader's submit may have legally forwarded
+                assert b"2" in bodies
+        finally:
+            c.stop()
+
+    def test_red_confirm_before_quorum_truncates_through_one_way_out(self):
+        """The same one-way-out window over ``confirm-before-quorum``:
+        the muted leader confirms on local append, the majority's new
+        term truncates it — a confirmed write is GONE (what the checker
+        must flag as lost)."""
+        c = _Cluster(seed_bug="confirm-before-quorum")
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            assert b.enqueue("q", b"1", b"") is True
+            time.sleep(0.2)  # let the declare+first enq replicate
+            c.one_way_out(lead)
+            # THE BUG: local-append confirm while nobody can hear it
+            assert b.enqueue("q", b"2", b"") is True
+            deadline = time.monotonic() + 8.0
+            new_lead = None
+            while time.monotonic() < deadline and new_lead is None:
+                for nm, nb in c.backends.items():
+                    if nm != lead and nb.raft.is_leader():
+                        new_lead = nm
+                time.sleep(0.02)
+            assert new_lead, "majority never elected past the muted leader"
+            c.heal()
+            assert c.converged("q", timeout=8.0)
+            bodies = set(c.queue_bodies(lead, "q"))
+            assert b"2" not in bodies, (
+                "the confirmed-without-quorum value SURVIVED — the "
+                "one-way window no longer exposes confirm-before-quorum"
+            )
+            # only the pre-window write is guaranteed: with the bug on
+            # every node, even the new leader's confirms are unsafe
+            assert b"1" in bodies
+        finally:
+            c.stop()
+
+    def test_sim_net_refuses_asymmetric_strategies(self):
+        """A net that symmetrizes grudges must refuse a one-way
+        strategy instead of silently running the two-way fault."""
+        from jepsen_tpu.control.nemesis import PartitionNemesis
+        from jepsen_tpu.control.net import SimNet
+
+        net = SimNet(cluster=None)
+        with pytest.raises(ValueError, match="one-way"):
+            PartitionNemesis(
+                "partition-one-way-out", net, ["a", "b", "c"], seed=1
+            )
+
+    def test_one_way_grudges_are_directed(self):
+        """The strategy functions themselves: exactly one direction."""
+        import random
+
+        from jepsen_tpu.control.nemesis import one_way_in, one_way_out
+
+        nodes = ["a", "b", "c"]
+        g_in = one_way_in(nodes, random.Random(0))
+        (victim,) = g_in.keys()
+        assert g_in[victim] == set(nodes) - {victim}
+        g_out = one_way_out(nodes, random.Random(0))
+        assert victim not in g_out  # the victim drops nothing
+        assert all(v == {victim} for v in g_out.values())
+
+
+# ---------------------------------------------------------------------------
+# Family 3: wire corruption / duplication / reordering
+# ---------------------------------------------------------------------------
+
+
+class TestWireChaos:
+    def _run_traffic(self, c: _Cluster, n_ops: int = 40) -> list[bytes]:
+        lead = c.leader()
+        b = c.backends[lead]
+        b.declare("q")
+        acked: list[bytes] = []
+        for i in range(n_ops):
+            v = f"{10000 + i}".encode()  # digit-rich bodies (the
+            # corruptor flips digits — payload bytes dominate real
+            # frames, and these are all payload)
+            if c.backends[c.leader()].enqueue("q", v, b""):
+                acked.append(v)
+        return acked
+
+    def test_green_checksummed_wire_drops_corruption(self):
+        """Heavy corrupt+duplicate+delay on the leader's wire: every
+        mangled frame is dropped on CRC (degrading to retried loss),
+        replicas converge byte-identically, nothing confirmed is lost,
+        nothing phantom appears."""
+        c = _Cluster()
+        try:
+            lead = c.leader()
+            spec = WireFaultSpec(
+                corrupt_p=0.5, duplicate_p=0.3, delay_p=0.2,
+                delay_ms=30.0,
+            )
+            c.backends[lead].raft.set_wire_faults(spec)
+            acked = self._run_traffic(c)
+            assert len(acked) >= 10, "chaos starved all progress"
+            c.backends[lead].raft.set_wire_faults(None)
+            assert c.converged("q", timeout=10.0), (
+                "replicas diverged UNDER CHECKSUMS"
+            )
+            bodies = set(c.queue_bodies(c.names[0], "q"))
+            assert set(acked) - bodies == set(), "confirmed value lost"
+            # no phantom: every body present was genuinely sent (an
+            # unacked-but-present value is a legal indeterminate
+            # commit; a never-sent byte pattern would be corruption
+            # applied instead of dropped)
+            sent = {f"{10000 + i}".encode() for i in range(40)}
+            assert bodies <= sent, f"phantom bodies: {bodies - sent}"
+        finally:
+            c.stop()
+
+    def test_red_no_wire_checksum_diverges_replicas(self):
+        """The same chaos over ``no-wire-checksum``: mangled-but-
+        parseable frames are PROCESSED, a corrupted entry body lands in
+        one replica's state machine, and the replicas silently diverge
+        (the phantom/lost pair a client would observe)."""
+        c = _Cluster(seed_bug="no-wire-checksum")
+        try:
+            lead = c.leader()
+            c.backends[lead].raft.set_wire_faults(
+                WireFaultSpec(corrupt_p=0.6)
+            )
+
+            def snap(nm):
+                m = c.backends[nm].machine
+                with m.lock:
+                    return [
+                        (msg.mid, msg.ts_ms, msg.body)
+                        for msg in m.queues.get("q", ())
+                    ]
+
+            def diverged() -> bool:
+                # zip-compare per position (queue order = commit order,
+                # stable under lag: a shorter replica is just behind —
+                # only a DIFFERENT entry at the same slot is divergence.
+                # Any field counts: a mutated body is a phantom value, a
+                # mutated ts diverges TTL expiry across replicas).
+                views = [snap(nm) for nm in c.names]
+                for a in views:
+                    for b2 in views:
+                        if any(x != y for x, y in zip(a, b2)):
+                            return True
+                return False
+
+            b = c.backends[lead]
+            b.declare("q")
+            deadline = time.monotonic() + 30.0
+            i = 0
+            while not diverged() and time.monotonic() < deadline:
+                v = f"{10000 + i}".encode()
+                i += 1
+                c.backends[c.leader()].enqueue("q", v, b"")
+            assert diverged(), (
+                "corruption never slipped a mangled frame through the "
+                "unchecksummed wire — the red pair no longer catches "
+                "no-wire-checksum"
+            )
+        finally:
+            c.stop()
+
+    def test_corrupt_frame_flips_exactly_one_digit(self):
+        import random
+
+        from jepsen_tpu.harness.replication import corrupt_frame
+
+        data = b'{"rpc":"append_entries","term":12,"body":"abc123"}'
+        rng = random.Random(7)
+        out = corrupt_frame(data, rng)
+        assert out != data and len(out) == len(data)
+        diffs = [
+            (a, x) for a, x in zip(data, out) if a != x
+        ]
+        assert len(diffs) == 1
+        old, new = diffs[0]
+        assert chr(old).isdigit() and chr(new).isdigit()
+        import json
+
+        json.loads(out)  # digit->digit corruption keeps JSON parseable
+
+
+# ---------------------------------------------------------------------------
+# make_nemesis opts validation: loud, never a silent no-op
+# ---------------------------------------------------------------------------
+
+
+class _StubNet:
+    one_way = True
+
+    def partition(self, grudges):
+        pass
+
+    def heal(self):
+        pass
+
+
+class _StubSurface:
+    def __getattr__(self, name):
+        return lambda *a, **k: None
+
+
+class TestMakeNemesisValidation:
+    def _mk(self, opts, **kw):
+        from jepsen_tpu.control.nemesis import make_nemesis
+
+        kw.setdefault("net", _StubNet())
+        kw.setdefault("procs", _StubSurface())
+        kw.setdefault("nodes", ["a", "b", "c"])
+        return make_nemesis(opts, kw.pop("net"), kw.pop("procs"),
+                            kw.pop("nodes"), **kw)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown nemesis"):
+            self._mk({"nemesis": "zap-the-router"})
+
+    def test_unknown_fault_tunable_rejected(self):
+        with pytest.raises(ValueError, match="unknown nemesis option"):
+            self._mk({
+                "nemesis": "wire-chaos",
+                "wire-corruptt": 0.5,  # the typo must not run defaults
+            }, wire=_StubSurface())
+
+    def test_slow_disk_needs_surface_and_durable(self):
+        with pytest.raises(ValueError, match="disks surface"):
+            self._mk({"nemesis": "slow-disk", "durable": True})
+        with pytest.raises(ValueError, match="durable"):
+            self._mk({"nemesis": "slow-disk"}, disks=_StubSurface())
+
+    def test_wire_chaos_needs_surface_and_nonzero_rates(self):
+        with pytest.raises(ValueError, match="wire surface"):
+            self._mk({"nemesis": "wire-chaos"})
+        with pytest.raises(ValueError, match="no-fault no-op"):
+            self._mk({
+                "nemesis": "wire-chaos",
+                "wire-corrupt": 0.0, "wire-duplicate": 0.0,
+                "wire-delay": 0.0,
+            }, wire=_StubSurface())
+        with pytest.raises(ValueError, match="outside"):
+            self._mk({
+                "nemesis": "wire-chaos", "wire-corrupt": 1.5,
+            }, wire=_StubSurface())
+
+    def test_partition_without_strategy_rejected(self):
+        with pytest.raises(ValueError, match="partition strategy"):
+            self._mk({"nemesis": "partition"})
+
+    def test_explicit_schedule_rejected_outside_fuzz_runner(self):
+        with pytest.raises(ValueError, match="nemesis-schedule"):
+            self._mk({
+                "nemesis": "partition",
+                "network-partition": "partition-halves",
+                "nemesis-schedule": [[1.0, 2.0]],
+            })
+
+    def test_slow_disk_zero_latency_rejected(self):
+        with pytest.raises(ValueError, match="no-fault no-op"):
+            self._mk({
+                "nemesis": "slow-disk", "durable": True,
+                "slow-disk-mean-ms": 0.0, "slow-disk-jitter-ms": 0.0,
+            }, disks=_StubSurface())
+
+
+class TestScheduledNemesis:
+    def test_schedule_validation_is_loud(self):
+        from jepsen_tpu.fuzz.schedule import (
+            NemesisEvent,
+            validate_events,
+        )
+
+        ok = [
+            NemesisEvent(1.0, 2.0, "kill", 1),
+            NemesisEvent(4.0, 1.0, "partition", 2),
+        ]
+        validate_events(ok, 10.0)
+        with pytest.raises(ValueError, match="unknown nemesis family"):
+            validate_events([NemesisEvent(1.0, 1.0, "gremlin", 1)], 10.0)
+        with pytest.raises(ValueError, match="overlaps"):
+            validate_events(
+                [NemesisEvent(1.0, 3.0, "kill", 1),
+                 NemesisEvent(2.0, 1.0, "pause", 2)], 10.0,
+            )
+        with pytest.raises(ValueError, match="never fire"):
+            validate_events([NemesisEvent(11.0, 1.0, "kill", 1)], 10.0)
+
+    def test_missing_surface_is_a_build_error(self):
+        from jepsen_tpu.fuzz.schedule import (
+            NemesisEvent,
+            ScheduledNemesis,
+        )
+
+        with pytest.raises(ValueError, match="no fault surface"):
+            ScheduledNemesis(
+                [NemesisEvent(1.0, 1.0, "slow-disk", 1)],
+                {"time-limit": 10.0},  # not durable, no disks surface
+                _StubNet(), _StubSurface(), ["a", "b", "c"],
+            )
+
+    def test_generator_emits_start_stop_at_offsets(self):
+        from jepsen_tpu.fuzz.schedule import schedule_generator
+        from jepsen_tpu.generators.core import Ctx, Pending
+        from jepsen_tpu.history.ops import OpF
+
+        gen = schedule_generator([[1.0, 2.0], [5.0, 1.0]])
+
+        def at(t_s):
+            return Ctx(time=int(t_s * 1e9), thread=-1, process=-1,
+                       n_threads=1)
+
+        got = gen.next_for(at(0.0))
+        assert isinstance(got, Pending) and got.wake == int(1e9)
+        assert gen.next_for(at(1.0)).f == OpF.START
+        assert isinstance(gen.next_for(at(1.5)), Pending)
+        assert gen.next_for(at(3.0)).f == OpF.STOP
+        assert gen.next_for(at(5.0)).f == OpF.START
+        assert gen.next_for(at(6.0)).f == OpF.STOP
+        assert gen.next_for(at(7.0)) is None

@@ -268,7 +268,26 @@ class TestSeededBug:
     def test_confirm_before_quorum_loses_confirmed_write(self):
         """The whole point of the seeded bug: a write confirmed by the
         buggy leader while isolated is truncated on heal — an
-        acknowledged-then-lost write the checker must catch."""
+        acknowledged-then-lost write the checker must catch.
+
+        Bounded retry-with-reseed (the round-4 load-flake class): under
+        full-suite scheduler pressure the isolated leader can step down
+        BEFORE this test lands its "instant" buggy confirm — a legal
+        schedule in which the bug simply was not exercised.  A fresh
+        cluster retries the window; a genuine regression (truncation
+        not happening, "doomed" surviving) still fails every attempt."""
+        from _load import scaled
+
+        last: AssertionError | None = None
+        for _attempt in range(3):
+            try:
+                self._window(scaled)
+                return
+            except AssertionError as e:
+                last = e
+        raise last
+
+    def _window(self, scaled):
         names = ["n0", "n1", "n2"]
         peers = {nm: ("127.0.0.1", _free_port()) for nm in names}
         nodes = {
@@ -287,11 +306,11 @@ class TestSeededBug:
             # step-down kicks in)
             assert lb.enqueue("q", b"doomed", b"")
             new_leader = _wait_leader(
-                {nm: nodes[nm] for nm in others}, timeout=5.0
+                {nm: nodes[nm] for nm in others}, timeout=scaled(5.0)
             )
             assert nodes[new_leader].enqueue("q", b"kept", b"")
             _heal(nodes)
-            deadline = time.monotonic() + 4.0
+            deadline = time.monotonic() + scaled(4.0)
             while time.monotonic() < deadline:
                 bodies = [
                     m.body for m in lb.machine.queues.get("q", ())

@@ -1,0 +1,250 @@
+"""Adversarial matrix fuzzer: generated configs, triage, minimization,
+and repro emission (ROADMAP direction 5 — the matrix machine).
+
+Samples seeded random {workload x nemesis schedule x durability x
+contract x cluster size x churn} configurations
+(``jepsen_tpu/fuzz/space.py``), runs each under the matrix/_live triage
+rules, and on any red:
+
+1. confirms it on a fresh cluster (``--confirm`` runs),
+2. greedily delta-debugs the schedule — nemesis events, then the op
+   window — to the minimal failing window (``fuzz/minimize.py``),
+3. emits a deterministic seeded repro driver into ``--emit-dir``
+   (``store/fuzz_repro_<tag>.py``, the generated analogue of the
+   hand-written ``tools/repro_r7_*`` pair).
+
+Liveness proof (the red/green pair for the fuzzer itself)::
+
+    # seeded bug: the fuzzer MUST find a red within the budget
+    python tools/fuzz_matrix.py --seed 7 --budget 6 --db local \\
+        --seed-bug ack-before-fsync --expect-red
+    # same seed, no bug: the same schedules must come back green
+    python tools/fuzz_matrix.py --seed 7 --budget 6 --db local
+
+Exit codes: 0 = budget completed (with ``--expect-red``: a red was
+found, minimized, and its repro emitted); 1 = ``--expect-red`` found
+nothing, or a red was found while hunting (so CI-style callers notice
+findings); 2 = usage.  ``--out`` captures the log fail-loud the way
+``tools/soak.py`` does: the artifact lands only when the run reached
+its expected ending.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import random
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _soak():
+    """tools/soak.py (the fail-loud capture contract lives there)."""
+    spec = importlib.util.spec_from_file_location(
+        "soak", os.path.join(os.path.dirname(__file__), "soak.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_fuzz(args) -> int:
+    import logging
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stdout,
+        force=True,
+    )
+    if args.quiet_cluster:
+        for name in ("jepsen_tpu.runner", "jepsen_tpu.generator"):
+            logging.getLogger(name).setLevel(logging.WARNING)
+
+    from jepsen_tpu.fuzz.emit import emit_repro
+    from jepsen_tpu.fuzz.minimize import minimize
+    from jepsen_tpu.fuzz.runner import is_red, triage_run
+    from jepsen_tpu.fuzz.space import sample_config
+
+    store = args.store or tempfile.mkdtemp(prefix="fuzz_matrix_")
+    rng = random.Random(args.seed)
+    sim_faults = (
+        dict(f.split("=") for f in args.sim_fault) if args.sim_fault
+        else None
+    )
+    print(
+        f"# fuzz: seed={args.seed} budget={args.budget} db={args.db}"
+        f"{' seed_bug=' + args.seed_bug if args.seed_bug else ''}"
+        f"{' strict-contract' if args.strict_contract else ''}"
+        f"{' sim_faults=' + str(sim_faults) if sim_faults else ''}",
+        flush=True,
+    )
+
+    found = []
+    t0 = time.monotonic()
+    for i in range(args.budget):
+        cfg = sample_config(
+            rng,
+            db=args.db,
+            time_limit_s=args.time_limit,
+            rate=args.rate,
+            strict_contract=args.strict_contract,
+            seed_bug=args.seed_bug,
+            sim_faults=sim_faults,
+            max_events=args.max_events,
+            workload=args.workload,
+        )
+        print(f"# config {i + 1}/{args.budget}: {cfg.describe()}",
+              flush=True)
+        out = triage_run(cfg, store, attempts=args.attempts)
+        print(f"# config {i + 1}: {out.status}"
+              + (f" {out.invalidating}" if out.invalidating else "")
+              + (f" {out.notes}" if out.notes else ""),
+              flush=True)
+        if out.status != "red":
+            continue
+
+        # confirm on a fresh cluster before any minting: a one-off
+        # load artifact must not become a committed finding
+        confirmed = all(
+            is_red(cfg, store, attempts=args.attempts)
+            for _ in range(max(0, args.confirm - 1))
+        )
+        if not confirmed:
+            print(f"# config {i + 1}: red did NOT confirm — discarded "
+                  f"as a load artifact (nothing emitted)", flush=True)
+            continue
+
+        print(f"# config {i + 1}: RED CONFIRMED — minimizing", flush=True)
+        mincfg, stats = minimize(
+            cfg,
+            oracle=lambda c: is_red(c, store, attempts=args.attempts),
+            confirm=args.confirm,
+            log=lambda s: print(f"#   {s}", flush=True),
+        )
+        # the emitted spec must be the exact one just confirmed red —
+        # re-run it once more to hold the outcome object for the emitter
+        final = triage_run(mincfg, store, attempts=args.attempts)
+        if final.status != "red":
+            print("# minimized spec went flaky on the emission run — "
+                  "emitting nothing (fail-loud)", flush=True)
+            continue
+        tag = f"s{args.seed}_c{cfg.seed}_{cfg.workload}"
+        path = emit_repro(
+            mincfg, final, args.emit_dir, tag, stats=stats,
+            extra_summary=(
+                f"Found by: tools/fuzz_matrix.py --seed {args.seed} "
+                f"--db {args.db}"
+                + (f" --seed-bug {args.seed_bug}" if args.seed_bug
+                   else "")
+                + (" --strict-contract" if args.strict_contract else "")
+            ),
+        )
+        print(f"# config {i + 1}: minimized "
+              f"({stats.events_before}->{stats.events_after} events, "
+              f"{stats.window_before:g}->{stats.window_after:g}s window, "
+              f"{stats.runs} runs) — repro emitted: {path}", flush=True)
+        found.append({
+            "config_seed": cfg.seed,
+            "workload": cfg.workload,
+            "invalidating": final.invalidating,
+            "repro": path,
+            "events": [e.to_json() for e in mincfg.events],
+            "window_s": mincfg.opts["time-limit"],
+        })
+        if args.stop_after_red:
+            break
+
+    wall = time.monotonic() - t0
+    print(f"# fuzz done: {len(found)} red(s) in {wall:.0f}s wall")
+    print(json.dumps({"found": found}, indent=1, default=str))
+    if args.expect_red:
+        if not found:
+            print("# FAIL: --expect-red but the budget found no red "
+                  "(the seeded bug went uncaught)", file=sys.stderr)
+            return 1
+        return 0
+    # hunting mode: findings are a non-zero exit so CI notices
+    return 1 if found else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seed", type=int, required=True,
+                   help="fuzzer seed: the entire config stream is a "
+                        "pure function of it")
+    p.add_argument("--budget", type=int, default=10,
+                   help="number of configs to sample and run")
+    p.add_argument("--db", choices=("local", "sim"), default="local",
+                   help="target harness: local broker processes "
+                        "(full fault space) or the in-process sim "
+                        "(partition/kill/pause only; CI smoke)")
+    p.add_argument("--time-limit", type=float, default=None,
+                   help="pin every config's load window (default: "
+                        "sampled 8-20s)")
+    p.add_argument("--rate", type=float, default=None,
+                   help="pin the op rate (default: sampled)")
+    p.add_argument("--max-events", type=int, default=6,
+                   help="max nemesis events per schedule")
+    p.add_argument("--workload", default=None,
+                   choices=("queue", "stream", "elle", "mutex"),
+                   help="pin the workload family (default: sampled "
+                        "per config)")
+    p.add_argument("--attempts", type=int, default=2,
+                   help="triage attempts per run (undecided retries)")
+    p.add_argument("--confirm", type=int, default=2,
+                   help="fresh-cluster confirmations a red (and every "
+                        "accepted shrink) needs before it counts")
+    p.add_argument("--seed-bug",
+                   choices=("confirm-before-quorum",
+                            "drop-unacked-on-close",
+                            "ack-before-fsync", "no-wire-checksum"),
+                   default=None,
+                   help="(--db local) inject a known bug into every "
+                        "sampled config — the fuzzer-liveness mode: "
+                        "it MUST find and minimize a red")
+    p.add_argument("--sim-fault", action="append", default=None,
+                   metavar="KNOB=N",
+                   help="(--db sim) seeded sim fault, e.g. "
+                        "drop_acked_every=5 (repeatable)")
+    p.add_argument("--strict-contract", action="store_true",
+                   help="sample contracts TIGHTER than the SUT claims "
+                        "(exactly-once on the at-least-once live "
+                        "queue, serializable elle) — the relaxed-"
+                        "contract red class")
+    p.add_argument("--expect-red", action="store_true",
+                   help="exit non-zero unless a red was found, "
+                        "minimized, and emitted (pair with --seed-bug)")
+    p.add_argument("--stop-after-red", action="store_true",
+                   help="stop the budget after the first confirmed red")
+    p.add_argument("--emit-dir", default="store",
+                   help="where minimized repro drivers land")
+    p.add_argument("--store", default=None,
+                   help="run-store root (default: a temp dir)")
+    p.add_argument("--quiet-cluster", action="store_true",
+                   help="suppress per-op runner logging")
+    p.add_argument("--out", default=None,
+                   help="evidence file for the fuzzer log; captured "
+                        "fail-loud (only on the expected ending)")
+    args = p.parse_args(argv)
+    if args.seed_bug and args.db != "local":
+        p.error("--seed-bug needs --db local (the sim injects faults "
+                "via --sim-fault instead)")
+    if args.sim_fault and args.db != "sim":
+        p.error("--sim-fault is a --db sim knob")
+    if args.out is None:
+        return run_fuzz(args)
+    return _soak().capture(args.out, lambda: run_fuzz(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,6 +176,7 @@ def run_soak(args) -> int:
         "publish-confirm-timeout": 5.0,
         "durable": True,
         "seed": args.seed,
+        "mixed-extended": args.mixed_extended,
     }
     monitor_name = args.workload
     if args.workload == "mutex":
@@ -272,6 +273,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=7,
                    help="nemesis schedule seed")
     p.add_argument("--rate", type=float, default=40.0)
+    p.add_argument("--mixed-extended", action="store_true",
+                   help="add the slow-disk and wire-chaos families to "
+                        "the mixed-nemesis draw (opt-in so default "
+                        "soak schedules stay comparable with the "
+                        "committed r7/r8 evidence)")
     p.add_argument("--fenced", action="store_true",
                    help="mutex only: fencing-token lock mode (the "
                         "configuration whose soak must stay green)")

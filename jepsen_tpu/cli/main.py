@@ -665,11 +665,13 @@ def cmd_bench_check(args, _pipeline: bool | None = None) -> int:
         if not paths:
             print(f"no histories under {args.histories}", file=sys.stderr)
             return 2
-        # packed-row store cache (VERDICT r3 #3): a fresh rows.npz beside
-        # each history.jsonl carries (workload, [n,8] rows), read ONCE
-        # per file; files without a fresh cache are parsed once and the
-        # ops reused (queue misses reuse them for the explode, non-queue
-        # families pack from them).
+        # packed-row store cache (VERDICT r3 #3; PR 7 backing): the
+        # loader consults each history's `.jtc` columnar substrate
+        # first (mmap'd column blocks, zero parse — COLUMNAR.md), then
+        # the legacy rows.npz for pre-format stores, read ONCE per
+        # file; files without a fresh cache are parsed once and the
+        # ops reused (queue misses reuse them for the explode,
+        # non-queue families pack from them).
         from jepsen_tpu.history.fastpack import pack_file as _fastpack
         from jepsen_tpu.history.rows import save_rows_cache
 

@@ -1,0 +1,566 @@
+"""Zero-copy columnar history substrate: the ``.jtc`` on-disk format.
+
+The parse-per-check model re-paid a JSONL parse (or an npz inflate) for
+every cold check: bytes on disk -> Python/C++ parse -> row explosion ->
+staging buffers.  A ``.jtc`` file is the *already exploded* int32 column
+blocks of one history, written at RECORD time (``Store.save_history``),
+by the EDN importer, or by ``tools/migrate_store.py`` — so ``check`` /
+``bench-check`` / ``tools/soak.py`` / the pipeline lanes map file bytes
+straight into staging buffers with no parse in the loop:
+
+    load = open + mmap + header check + CRC pass + ``np.frombuffer``
+
+The three legacy cache families (``rows.npz``, ``stream_rows.npz``,
+``elle_mops.npz``) are now VIEWS over this one substrate: their loaders
+consult the sibling ``.jtc`` first (``history/rows.py`` /
+``history/storecache.py``), their savers merge their section into it,
+and the npz files remain read-only fallbacks for pre-format stores.
+
+Layout (little-endian; payloads 64-byte aligned for aligned
+``np.frombuffer`` views)::
+
+    [header 96 B][section table n x 48 B][table crc32 u32][pad][payloads]
+
+    header:  magic "JTCF", version u32, workload i32, n_sections u32,
+             src_name 32s, src_size u64, src_mtime_ns i64,
+             src_sha256 32 B
+    section: kind u32, dtype u32 (0=i32 1=i64), rows u64, cols u64,
+             offset u64, length u64, crc32 u32, flags u32
+
+Section kinds: 1 = queue/generic ``[n, 8]`` row matrix (the
+``rows._rows_for`` schema), 2 = stream ``[n, 6]`` column matrix
+(flags bit 0: full-read observed), 3/4/5 = elle micro-op cells
+``[M, 8]`` (flags bit 0: degenerate) + txn index (i64, true ``n_txns``
+in flags) + dense-key table (i64).
+
+Discipline: every write goes temp -> full checksum re-verify -> rename
+(a half-written or bit-flipped substrate can never be installed), and
+every load re-verifies the CRCs — a ``.jtc`` with a flipped byte, a
+truncated tail, or a stale format version raises a loud
+:class:`ColumnarFormatError`, never a silent wrong answer.  Staleness
+(the SOURCE was rewritten) is not corruption: a stale ``.jtc`` loads as
+None and the caller re-packs, same contract as the npz caches.  The
+cache layers catch :class:`ColumnarFormatError`, LOG the reason, and
+fall back to the legacy parse — set ``JEPSEN_TPU_JTC_STRICT=1`` to make
+corruption fatal instead.  ``JEPSEN_TPU_NO_JTC=1`` disables the
+substrate entirely (Python and native readers both honor it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import mmap
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"JTCF"
+VERSION = 1
+JTC_SUFFIX = ".jtc"
+
+#: header: magic, version, workload, n_sections, src_name, src_size,
+#: src_mtime_ns, src_sha256
+_HEADER = struct.Struct("<4sIiI32sQq32s")
+#: section: kind, dtype, rows, cols, offset, length, crc32, flags
+_SECTION = struct.Struct("<IIQQQQII")
+_CRC = struct.Struct("<I")
+_ALIGN = 64
+
+SEC_QROWS = 1  # [n, 8] int32 — rows._rows_for schema (any workload)
+SEC_STREAM = 2  # [n, 6] int32 — stream_lin._stream_rows schema
+SEC_EMOPS = 3  # [M, 8] int32 — elle micro-op cells (elle_mops_for)
+SEC_EMOPS_TXN = 4  # [n] int64 — elle txn_index (true n_txns in flags)
+SEC_EMOPS_KEYS = 5  # [k] int64 — elle dense key table
+
+FLAG_STREAM_FULL = 1
+FLAG_EMOPS_DEGENERATE = 1
+
+_DTYPES = {0: np.int32, 1: np.int64}
+_DTYPE_CODES = {np.dtype(np.int32): 0, np.dtype(np.int64): 1}
+
+#: workload codes shared with the native packer / fastpack._WORKLOADS
+_WORKLOADS = ("queue", "stream", "elle", "mutex")
+
+
+class ColumnarFormatError(RuntimeError):
+    """A ``.jtc`` file is corrupt, truncated, or format-incompatible.
+
+    Deliberately LOUD: the substrate is served in place of a parse, so a
+    bad block silently re-parsed would hide real on-disk corruption.
+    Callers with a legacy fallback must log the reason before taking it.
+    """
+
+
+def jtc_path_for(src_path: str | Path) -> Path:
+    """Sibling ``.jtc`` of a history source file (``history.jsonl`` ->
+    ``history.jtc``; works for ``.edn`` sources too)."""
+    return Path(src_path).with_suffix(JTC_SUFFIX)
+
+
+def _disabled() -> bool:
+    # "0" means enabled — matching the native reader's parsing exactly,
+    # so the two sides can never split-brain on the same value
+    return os.environ.get("JEPSEN_TPU_NO_JTC", "0") not in ("", "0")
+
+
+def _strict() -> bool:
+    return os.environ.get("JEPSEN_TPU_JTC_STRICT", "0") not in ("", "0")
+
+
+def _src_digest(path: Path) -> bytes:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.digest()
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) // _ALIGN * _ALIGN
+
+
+@dataclass
+class Jtc:
+    """One loaded ``.jtc``: zero-copy numpy views over the mapped file
+    (read-only — batch assembly copies into staging buffers, the mapped
+    bytes themselves are never duplicated on the host)."""
+
+    path: Path
+    workload: str | None
+    src_name: str
+    arrays: dict = field(default_factory=dict)  # kind -> np.ndarray view
+    flags: dict = field(default_factory=dict)  # kind -> u32 flags
+
+    def rows(self) -> np.ndarray | None:
+        """The ``[n, 8]`` generic row matrix, or None if absent."""
+        return self.arrays.get(SEC_QROWS)
+
+    def stream(self):
+        """``(cols, full_read)`` for a stream history, or None."""
+        cols = self.arrays.get(SEC_STREAM)
+        if cols is None:
+            return None
+        return cols, bool(self.flags.get(SEC_STREAM, 0) & FLAG_STREAM_FULL)
+
+    def emops(self):
+        """``(cell matrix, ElleMopsMeta)`` for an elle history, or None."""
+        mat = self.arrays.get(SEC_EMOPS)
+        txn = self.arrays.get(SEC_EMOPS_TXN)
+        keys = self.arrays.get(SEC_EMOPS_KEYS)
+        if mat is None or txn is None or keys is None:
+            return None
+        from jepsen_tpu.checkers.elle import ElleMopsMeta
+
+        meta = ElleMopsMeta(
+            n_txns=int(self.flags.get(SEC_EMOPS_TXN, len(txn))),
+            txn_index=[int(x) for x in txn],
+            keys=[int(x) for x in keys],
+            degenerate=bool(
+                self.flags.get(SEC_EMOPS, 0) & FLAG_EMOPS_DEGENERATE
+            ),
+        )
+        return mat, meta
+
+    def payload_bytes(self) -> int:
+        return sum(a.nbytes for a in self.arrays.values())
+
+
+def read_jtc(path: str | Path) -> tuple[Jtc, dict]:
+    """Structurally read + CRC-verify one ``.jtc`` (NO source-freshness
+    check — that is :func:`load_jtc`'s job).  Returns ``(Jtc, stamp)``
+    where ``stamp`` holds the header's source identity fields.  Raises
+    :class:`ColumnarFormatError` on any corruption, truncation, or
+    format-version mismatch."""
+    path = Path(path)
+    try:
+        fh = open(path, "rb")
+    except OSError as e:
+        raise ColumnarFormatError(f"{path}: unreadable: {e}") from e
+    with fh:
+        try:
+            mm = mmap.mmap(fh.fileno(), 0, access=mmap.ACCESS_READ)
+        except (ValueError, OSError) as e:  # zero-length or map failure
+            raise ColumnarFormatError(
+                f"{path}: cannot map ({e}) — truncated?"
+            ) from e
+    size = len(mm)
+    if size < _HEADER.size + _CRC.size:
+        raise ColumnarFormatError(f"{path}: truncated header ({size} B)")
+    (
+        magic, version, workload_code, n_sections,
+        src_name, src_size, src_mtime_ns, src_sha,
+    ) = _HEADER.unpack_from(mm, 0)
+    if magic != MAGIC:
+        raise ColumnarFormatError(
+            f"{path}: bad magic {magic!r} (not a .jtc file)"
+        )
+    if version != VERSION:
+        raise ColumnarFormatError(
+            f"{path}: stale format version {version} (this build reads "
+            f"version {VERSION}; re-pack with tools/migrate_store.py)"
+        )
+    table_end = _HEADER.size + n_sections * _SECTION.size
+    if size < table_end + _CRC.size:
+        raise ColumnarFormatError(
+            f"{path}: truncated section table ({n_sections} sections "
+            f"declared, {size} B on disk)"
+        )
+    (stored_crc,) = _CRC.unpack_from(mm, table_end)
+    if zlib.crc32(mm[:table_end]) != stored_crc:
+        raise ColumnarFormatError(f"{path}: header checksum mismatch")
+    workload = (
+        _WORKLOADS[workload_code]
+        if 0 <= workload_code < len(_WORKLOADS)
+        else None
+    )
+    out = Jtc(
+        path=path,
+        workload=workload,
+        src_name=src_name.rstrip(b"\x00").decode("utf-8", "replace"),
+    )
+    for i in range(n_sections):
+        kind, dtype_code, nrows, ncols, off, length, crc, flags = (
+            _SECTION.unpack_from(mm, _HEADER.size + i * _SECTION.size)
+        )
+        if dtype_code not in _DTYPES:
+            raise ColumnarFormatError(
+                f"{path}: section {kind} has unknown dtype {dtype_code}"
+            )
+        if off + length > size:
+            raise ColumnarFormatError(
+                f"{path}: section {kind} extends past end of file "
+                f"(offset {off} + {length} B > {size} B) — truncated tail"
+            )
+        dt = np.dtype(_DTYPES[dtype_code])
+        if length != nrows * max(ncols, 1) * dt.itemsize:
+            raise ColumnarFormatError(
+                f"{path}: section {kind} length {length} does not match "
+                f"its declared shape ({nrows} x {ncols})"
+            )
+        if zlib.crc32(mm[off : off + length]) != crc:
+            raise ColumnarFormatError(
+                f"{path}: section {kind} checksum mismatch (bit flip or "
+                f"torn write)"
+            )
+        arr = np.frombuffer(mm, dtype=dt, count=length // dt.itemsize,
+                            offset=off)
+        if ncols > 1:
+            arr = arr.reshape(int(nrows), int(ncols))
+        out.arrays[kind] = arr
+        out.flags[kind] = flags
+    stamp = {
+        "src_name": out.src_name,
+        "src_size": src_size,
+        "src_mtime_ns": src_mtime_ns,
+        "src_sha256": src_sha,
+    }
+    return out, stamp
+
+
+def load_jtc(src_path: str | Path) -> Jtc | None:
+    """The fresh ``.jtc`` substrate for a history source, or None when
+    absent, disabled, or stale (the source was rewritten — a cache miss,
+    not an error).  Raises :class:`ColumnarFormatError` when the file
+    exists but is corrupt/truncated/format-incompatible.
+
+    Freshness is the npz caches' two-tier scheme: a stat fast path
+    ((size, mtime_ns) match the stamp AND the ``.jtc`` is strictly newer
+    than the source), falling through to the content sha256."""
+    if _disabled():
+        return None
+    src = Path(src_path)
+    target = jtc_path_for(src)
+    try:
+        jtc_mtime = os.stat(target).st_mtime_ns
+    except OSError:
+        return None  # absent: pre-format store
+    jtc, stamp = read_jtc(target)
+    if stamp["src_name"] != src.name:
+        log.debug("%s: built from %r, not %r — treating as stale",
+                  target, stamp["src_name"], src.name)
+        return None
+    try:
+        st = os.stat(src)
+    except OSError:
+        return None
+    if (
+        st.st_size == stamp["src_size"]
+        and st.st_mtime_ns == stamp["src_mtime_ns"]
+        and jtc_mtime > st.st_mtime_ns
+    ):
+        return jtc
+    if _src_digest(src) == stamp["src_sha256"]:
+        return jtc
+    return None
+
+
+# one pre-format / corruption notice per directory, not one per file —
+# loud, but not a 10k-line flood on a 10k-history pre-format store
+_noted_dirs: set = set()
+_noted_lock = threading.Lock()
+
+
+def _note_once(key: Path, level: int, msg: str, *args) -> None:
+    with _noted_lock:
+        if key in _noted_dirs:
+            return
+        _noted_dirs.add(key)
+    log.log(level, msg, *args)
+
+
+def consult(src_path: str | Path) -> Jtc | None:
+    """Policy wrapper for the cache layers: the fresh substrate or None,
+    with the mandated logging — a corrupt ``.jtc`` is WARNED about (and
+    raises under ``JEPSEN_TPU_JTC_STRICT=1``) before the caller falls
+    back to the legacy parse; an absent one notes the pre-format store
+    once per directory."""
+    src = Path(src_path)
+    try:
+        got = load_jtc(src)
+    except ColumnarFormatError as e:
+        if _strict():
+            raise
+        log.warning(
+            "corrupt columnar substrate, falling back to legacy parse "
+            "for %s: %s", src, e,
+        )
+        return None
+    if got is None and not _disabled() and not jtc_path_for(src).exists():
+        _note_once(
+            src.parent, logging.INFO,
+            "no columnar substrate (.jtc) under %s — pre-format store, "
+            "using the legacy parse/npz path (tools/migrate_store.py "
+            "rewrites a store in place)", src.parent,
+        )
+    return got
+
+
+# ---------------------------------------------------------------------------
+# Writing
+# ---------------------------------------------------------------------------
+
+
+def _coerce_sections(rows, stream, emops) -> list | None:
+    """``(kind, arr, flags)`` triples from the family substrates; None
+    when a substrate cannot be represented (e.g. non-int elle keys —
+    the same refusal as the npz saver)."""
+    secs = []
+    if rows is not None:
+        secs.append((SEC_QROWS, np.ascontiguousarray(rows, np.int32), 0))
+    if stream is not None:
+        cols, full = stream
+        secs.append((
+            SEC_STREAM,
+            np.ascontiguousarray(cols, np.int32),
+            FLAG_STREAM_FULL if full else 0,
+        ))
+    if emops is not None:
+        mat, meta = emops
+        try:
+            keys = np.ascontiguousarray(meta.keys, np.int64)
+        except (OverflowError, TypeError, ValueError):
+            return None  # non-int keys: unrepresentable, like the npz
+        if keys.dtype != np.int64 or keys.ndim != 1:
+            return None
+        secs.append((
+            SEC_EMOPS,
+            np.ascontiguousarray(mat, np.int32),
+            FLAG_EMOPS_DEGENERATE if meta.degenerate else 0,
+        ))
+        secs.append((
+            SEC_EMOPS_TXN,
+            np.ascontiguousarray(meta.txn_index, np.int64),
+            int(meta.n_txns),
+        ))
+        secs.append((SEC_EMOPS_KEYS, keys, 0))
+    return secs
+
+
+def write_jtc(
+    src_path: str | Path,
+    workload: str | None,
+    *,
+    rows: np.ndarray | None = None,
+    stream: tuple | None = None,
+    emops: tuple | None = None,
+) -> Path:
+    """Write (replace) the sibling ``.jtc`` for ``src_path`` holding the
+    given substrate sections, stamped against the source's current
+    (size, mtime_ns, sha256).
+
+    Discipline: build in memory, write to a unique temp sibling,
+    RE-READ and checksum-verify the temp, then rename into place — a
+    torn or bit-flipped write can never be installed.  Raises on any
+    failure (use :func:`update_jtc` for the best-effort cache path)."""
+    src = Path(src_path)
+    secs = _coerce_sections(rows, stream, emops)
+    if secs is None:
+        raise ValueError(f"{src}: substrate not representable as .jtc")
+    if not secs:
+        raise ValueError(f"{src}: refusing to write a section-less .jtc")
+    st = os.stat(src)
+    digest = _src_digest(src)
+    wl_code = _WORKLOADS.index(workload) if workload in _WORKLOADS else -1
+    name = src.name.encode()
+    if len(name) > 32:
+        # the loader compares the FULL basename against this stamp; a
+        # truncated stamp would never match, producing a substrate that
+        # is rewritten on every check yet never served — refuse instead
+        # (the best-effort savers fall back to the legacy npz)
+        raise ValueError(
+            f"{src}: basename exceeds the 32-byte .jtc source-name "
+            f"field; not representable"
+        )
+
+    table_end = _HEADER.size + len(secs) * _SECTION.size
+    data_off = _align(table_end + _CRC.size)
+    entries, payloads = [], []
+    for kind, arr, flags in secs:
+        raw = arr.tobytes()
+        nrows = arr.shape[0] if arr.ndim else 0
+        ncols = arr.shape[1] if arr.ndim == 2 else 1
+        entries.append(_SECTION.pack(
+            kind, _DTYPE_CODES[arr.dtype], nrows, ncols,
+            data_off, len(raw), zlib.crc32(raw), flags,
+        ))
+        payloads.append((data_off, raw))
+        data_off = _align(data_off + len(raw))
+    head = _HEADER.pack(
+        MAGIC, VERSION, wl_code, len(secs), name,
+        st.st_size, st.st_mtime_ns, digest,
+    ) + b"".join(entries)
+    buf = bytearray(data_off if payloads else table_end + _CRC.size)
+    buf[: len(head)] = head
+    _CRC.pack_into(buf, table_end, zlib.crc32(head))
+    end = table_end + _CRC.size
+    for off, raw in payloads:
+        buf[off : off + len(raw)] = raw
+        end = off + len(raw)
+    buf = bytes(buf[:end])
+
+    target = jtc_path_for(src)
+    tmp = target.with_name(
+        f"{target.name}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(buf)
+        read_jtc(tmp)  # checksum-verify what actually hit the disk
+        os.replace(tmp, target)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return target
+
+
+def update_jtc(
+    src_path: str | Path,
+    workload: str | None = None,
+    *,
+    rows: np.ndarray | None = None,
+    stream: tuple | None = None,
+    emops: tuple | None = None,
+) -> bool:
+    """Best-effort merge of sections into the sibling ``.jtc`` (the
+    unified SAVE path of the three legacy cache families): existing
+    fresh sections are preserved, the given ones replace theirs, and the
+    whole file is rewritten under the write-verify-rename discipline.
+    Never raises — a cache that cannot be written must never fail the
+    check that tried to leave it behind.  Returns True when installed."""
+    if _disabled():
+        return False
+    src = Path(src_path)
+    try:
+        existing = load_jtc(src)
+    except ColumnarFormatError as e:
+        log.warning("replacing corrupt columnar substrate for %s: %s",
+                    src, e)
+        existing = None
+    if existing is not None:
+        if rows is None:
+            rows = existing.rows()
+        if stream is None:
+            stream = existing.stream()
+        if emops is None:
+            emops = existing.emops()
+        if workload is None:
+            workload = existing.workload
+    try:
+        write_jtc(src, workload, rows=rows, stream=stream, emops=emops)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def pack_jtc(
+    src_path: str | Path, history: Sequence | None = None
+) -> Path | None:
+    """Pack one history source into its sibling ``.jtc`` — ALL sections
+    its workload carries (generic rows always; stream columns / elle
+    cells per family).  This is the record-time / migration entry point.
+
+    With ``history=None`` the substrates come from the native packer
+    where available (one C++ pass per family), else the Python twins.
+    Returns the written path, or None when the history is a mutex/queue
+    family whose rows alone could not be computed... it always computes
+    rows, so None only on unrepresentable input (non-int elle keys skip
+    just the elle sections, not the file)."""
+    src = Path(src_path)
+    rows = workload = None
+    if history is None:
+        from jepsen_tpu.history.fastpack import pack_file
+
+        got = pack_file(src)
+        if got is not None:
+            workload, rows = got
+    if rows is None:
+        from jepsen_tpu.history.ops import workload_of
+        from jepsen_tpu.history.rows import _rows_for
+        from jepsen_tpu.history.store import read_history
+
+        if history is None:
+            history = read_history(src)
+        workload = workload_of(history)
+        rows = _rows_for(history)
+    stream = emops = None
+    if workload == "stream":
+        stream = None
+        if history is None:
+            from jepsen_tpu.history.fastpack import stream_rows_file
+
+            stream = stream_rows_file(src)
+        if stream is None:
+            from jepsen_tpu.checkers.stream_lin import _stream_rows
+            from jepsen_tpu.history.store import read_history
+
+            if history is None:
+                history = read_history(src)
+            stream = _stream_rows(history)
+    elif workload == "elle":
+        emops = None
+        if history is None:
+            from jepsen_tpu.history.fastpack import elle_mops_file
+
+            emops = elle_mops_file(src)
+        if emops is None:
+            from jepsen_tpu.checkers.elle import elle_mops_for
+            from jepsen_tpu.history.store import read_history
+
+            if history is None:
+                history = read_history(src)
+            emops = elle_mops_for(history)
+        if _coerce_sections(None, None, emops) is None:
+            emops = None  # non-int keys: rows section still lands
+    return write_jtc(src, workload, rows=rows, stream=stream, emops=emops)

@@ -19,6 +19,17 @@ the offending name (a wrong guess would silently mis-classify ops), the
 ``:nemesis`` process maps to the framework's nemesis pseudo-process,
 and ops jepsen adds that have no client meaning here (``:log`` lines
 etc.) pass through via the shared name tables in ``history.ops``.
+
+Columnar substrate (PR 7): EDN sources participate in the ``.jtc``
+substrate exactly like JSONL ones — ``Store.save_history_edn`` packs a
+sibling ``history.jtc`` stamped against the EDN bytes at record time,
+``tools/migrate_store.py`` packs existing imported stores in place, a
+first ``check`` leaves one behind through the unified cache savers, and
+every later check of the ``.edn`` maps column blocks instead of
+re-running this parser (the native packer never reads EDN, so the
+substrate is what makes imported jepsen stores re-check at native
+speed).  The header's source-name stamp keeps a JSONL twin's substrate
+from ever serving for the EDN file or vice versa.
 """
 
 from __future__ import annotations

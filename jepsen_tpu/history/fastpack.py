@@ -13,11 +13,23 @@ library is unavailable or the file contains anything the C parser flags
 (malformed JSON, unknown enum names, out-of-range values) — callers then
 fall back to the Python packer, which raises the canonical error.  The
 Python path stays the single source of truth for all error behavior.
+
+``.jtc`` fast path (PR 7): every native entry point — single-file,
+thread-pool multi-file, and striped-cursor — first checks for a
+stat-fresh sibling ``.jtc`` columnar substrate (COLUMNAR.md) and serves
+its CRC-verified column blocks with NO parse at all, GIL released for
+the whole batch.  A stat-fresh but corrupt/incompatible ``.jtc``
+returns the native ``ERR_JTC`` (the binding yields None like any other
+error); the fallback then runs through the Python loaders in
+``history/columnar.py``, which re-detect the corruption and LOG it —
+the no-silent-fallback contract holds across both languages.
+``JEPSEN_TPU_NO_JTC=1`` disables the fast path on both sides.
 """
 
 from __future__ import annotations
 
 import ctypes
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -160,8 +172,42 @@ def _load() -> ctypes.CDLL | None:
             ]
     except AttributeError:
         pass
+    try:  # .jtc substrate toggle (PR 7); absent from a stale build
+        lib.jt_jtc_disable.restype = None
+        lib.jt_jtc_disable.argtypes = [ctypes.c_int32]
+    except AttributeError:
+        pass
     _lib = lib
     return lib
+
+
+#: serializes no-substrate native batch calls: a ``use_jtc=False``
+#: caller owns the process-wide toggle for its whole batch; concurrent
+#: substrate-allowed calls racing into the disabled window merely PARSE
+#: (correct, just slower) — they never serve when a no-cache caller
+#: asked for a parse
+_no_jtc_lock = threading.Lock()
+
+
+class _jtc_disabled:
+    """Context manager: disable the native ``.jtc`` fast path for one
+    batch call (no-op when the build lacks the toggle — those builds
+    also lack the fast path itself)."""
+
+    def __init__(self, lib, active: bool):
+        self.lib = lib if active and hasattr(lib, "jt_jtc_disable") else None
+
+    def __enter__(self):
+        if self.lib is not None:
+            _no_jtc_lock.acquire()
+            self.lib.jt_jtc_disable(1)
+        return self
+
+    def __exit__(self, *exc):
+        if self.lib is not None:
+            self.lib.jt_jtc_disable(0)
+            _no_jtc_lock.release()
+        return False
 
 
 def _conv_pack(r) -> tuple[str, np.ndarray] | None:
@@ -333,6 +379,7 @@ def _files_multi(
     threads: int,
     part: int = 0,
     n_parts: int = 1,
+    use_jtc: bool = True,
 ):
     """Shared multi-file driver: returns a list aligned with ``paths``
     (``None`` entries where that file must fall back to the Python
@@ -373,9 +420,10 @@ def _files_multi(
             arr = (ctypes.c_char_p * len(paths))(
                 *[str(Path(p)).encode() for p in paths]
             )
-            res = getattr(lib, fn_name + "_part")(
-                arr, len(paths), int(threads), int(part), int(n_parts)
-            )
+            with _jtc_disabled(lib, not use_jtc):
+                res = getattr(lib, fn_name + "_part")(
+                    arr, len(paths), int(threads), int(part), int(n_parts)
+                )
             if not res:
                 return out
             free_one = getattr(lib, free_name)
@@ -394,7 +442,8 @@ def _files_multi(
         # in Python, pack the compacted sublist through the classic
         # entry point (which pre-filters .edn itself)
         sub = _files_multi(
-            [paths[i] for i in stripe], fn_name, free_name, conv, threads
+            [paths[i] for i in stripe], fn_name, free_name, conv, threads,
+            use_jtc=use_jtc,
         )
         if sub is None:
             return None
@@ -407,7 +456,8 @@ def _files_multi(
     arr = (ctypes.c_char_p * len(idx))(
         *[str(Path(paths[i])).encode() for i in idx]
     )
-    res = getattr(lib, fn_name)(arr, len(idx), int(threads))
+    with _jtc_disabled(lib, not use_jtc):
+        res = getattr(lib, fn_name)(arr, len(idx), int(threads))
     if not res:
         return out
     free_one = getattr(lib, free_name)
@@ -424,30 +474,38 @@ def _files_multi(
     return out
 
 
-def pack_files(paths, threads: int = 0, part: int = 0, n_parts: int = 1):
+def pack_files(
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1,
+    use_jtc: bool = True,
+):
     """Multi-file ``pack_file``: ``[(workload, rows) | None, ...]``
-    aligned with ``paths``, or None when the native path is unavailable."""
+    aligned with ``paths``, or None when the native path is unavailable.
+    ``use_jtc=False`` disables the ``.jtc`` substrate fast path for this
+    batch (a ``check_sources(use_cache=False)`` caller asked for a
+    genuine parse — cached column blocks must not be re-served)."""
     return _files_multi(
         paths, "jt_pack_files", "jt_pack_free", _conv_pack, threads,
-        part, n_parts,
+        part, n_parts, use_jtc,
     )
 
 
 def stream_rows_files(
-    paths, threads: int = 0, part: int = 0, n_parts: int = 1
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1,
+    use_jtc: bool = True,
 ):
     """Multi-file ``stream_rows_file``: ``[(cols, full) | None, ...]``."""
     return _files_multi(
         paths, "jt_stream_rows_files", "jt_stream_free", _conv_stream,
-        threads, part, n_parts,
+        threads, part, n_parts, use_jtc,
     )
 
 
 def elle_mops_files(
-    paths, threads: int = 0, part: int = 0, n_parts: int = 1
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1,
+    use_jtc: bool = True,
 ):
     """Multi-file ``elle_mops_file``: ``[(mat, meta) | None, ...]``."""
     return _files_multi(
         paths, "jt_elle_mops_files", "jt_elle_mops_free", _conv_mops,
-        threads, part, n_parts,
+        threads, part, n_parts, use_jtc,
     )

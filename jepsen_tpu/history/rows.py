@@ -8,10 +8,14 @@ or risking a chip-plugin probe — per process.
 Also the **packed-row store cache** (VERDICT r3 #3): row explosion is
 ~95% of the batched-replay wall clock (39.7 s of the 41.6 s north star),
 and it is a pure function of ``history.jsonl`` — so the ``[n, 8]``
-matrix is persisted as ``rows.npz`` next to the history at record time
+matrix is persisted next to the history at record time
 (``Store.save_history``) or on first check, hash-guarded against the
 JSONL bytes, and every later ``check``/``bench-check`` of the same store
-loads the matrix instead of re-parsing and re-exploding.
+loads the matrix instead of re-parsing and re-exploding.  The backing
+store is the ``.jtc`` columnar substrate (``history/columnar.py``:
+mmap-able, CRC-checksummed, one file per history for ALL cache
+families); the legacy ``rows.npz`` remains readable for pre-format
+stores.
 """
 
 from __future__ import annotations
@@ -178,10 +182,30 @@ def save_rows_cache(
     workload: str,
     rows: np.ndarray,
 ) -> None:
-    """Persist the exploded ``[n, 8]`` matrix next to its JSONL, stamped
-    with the JSONL's (size, mtime_ns) AND content hash.  Atomic (tmp +
-    rename) and best-effort: a cache that cannot be written must never
-    fail the run/check that tried to leave it behind."""
+    """Persist the exploded ``[n, 8]`` matrix as the ``SEC_QROWS``
+    section of the sibling ``.jtc`` columnar substrate — the unified
+    replacement of the legacy per-file ``rows.npz`` (which stays
+    readable for pre-format stores).  One write discipline for every
+    cache family (temp -> checksum-verify -> rename,
+    ``history/columnar.py``); best-effort like the npz writer was: a
+    cache that cannot be written must never fail the run/check that
+    tried to leave it behind.  With the substrate disabled
+    (``JEPSEN_TPU_NO_JTC=1``) the legacy npz is written instead."""
+    from jepsen_tpu.history import columnar
+
+    if columnar.update_jtc(
+        jsonl_path, workload, rows=np.asarray(rows, np.int32)
+    ):
+        return
+    _save_rows_npz(jsonl_path, workload, rows)
+
+
+def _save_rows_npz(
+    jsonl_path: str | Path, workload: str, rows: np.ndarray
+) -> None:
+    """The legacy npz writer (kept for the ``JEPSEN_TPU_NO_JTC=1``
+    escape hatch): stamped with the JSONL's (size, mtime_ns) AND
+    content hash, atomic, best-effort."""
     jsonl_path = Path(jsonl_path)
     target = cache_path_for(jsonl_path)
     tmp = target.with_name(
@@ -247,8 +271,20 @@ def _load_cache(jsonl_path: Path) -> tuple[str, np.ndarray] | None:
 def load_rows_cache(
     jsonl_path: str | Path,
 ) -> tuple[str, np.ndarray] | None:
-    """``(workload, rows)`` when a fresh cache exists for this JSONL;
-    None when absent, unreadable, or stale (see ``_load_cache``)."""
+    """``(workload, rows)`` when a fresh cache exists for this source;
+    None when absent, unreadable, or stale.
+
+    Consults the ``.jtc`` columnar substrate first (zero-copy mmap view,
+    no npz inflate — ``history/columnar.py``), then the legacy
+    ``rows.npz`` for pre-format stores.  A corrupt ``.jtc`` is logged
+    loudly and treated as a miss (strict mode raises)."""
+    from jepsen_tpu.history import columnar
+
+    jtc = columnar.consult(jsonl_path)
+    if jtc is not None:
+        rows = jtc.rows()
+        if rows is not None and jtc.workload is not None:
+            return jtc.workload, rows
     got = _load_cache(Path(jsonl_path))
     if got is None:
         return None

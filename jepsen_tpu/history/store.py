@@ -93,24 +93,37 @@ class Store:
         p = run_dir / HISTORY_FILE
         write_history_jsonl(p, history)
         try:
-            # cut the packed-row cache at record time so the first
-            # re-check never pays the explode cost (best-effort — the
+            # cut the COLUMNAR substrate at record time (every section
+            # the workload carries: generic rows plus stream columns /
+            # elle cells) so the first re-check maps bytes straight into
+            # staging buffers with no parse at all (best-effort — the
             # run's history is already safely on disk)
-            from jepsen_tpu.history.ops import workload_of
-            from jepsen_tpu.history.rows import _rows_for, save_rows_cache
+            from jepsen_tpu.history.columnar import pack_jtc
 
-            save_rows_cache(p, workload_of(history), _rows_for(history))
+            pack_jtc(p, history=history)
         except Exception:  # noqa: BLE001 - cache is an optimization only
             pass
         self.link_run(run_dir.parent.name, run_dir)
         return p
 
     def save_history_edn(self, run_dir: Path, history: Sequence[Op]) -> Path:
-        """Same write-then-link choreography, jepsen's own layout."""
+        """Same write-then-link choreography, jepsen's own layout —
+        including the record-time columnar substrate, stamped against
+        the EDN bytes (an imported jepsen store re-checks without ever
+        re-parsing EDN)."""
         from jepsen_tpu.history.edn import write_history_edn
 
         p = run_dir / EDN_FILE
         write_history_edn(p, history)
+        try:
+            from jepsen_tpu.history.columnar import pack_jtc
+
+            # both layouts share the run dir's one history.jtc slot; the
+            # JSONL (preferred by load_history/_history_paths) keeps it
+            if not (run_dir / HISTORY_FILE).exists():
+                pack_jtc(p, history=history)
+        except Exception:  # noqa: BLE001 - cache is an optimization only
+            pass
         self.link_run(run_dir.parent.name, run_dir)
         return p
 

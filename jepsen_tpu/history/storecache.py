@@ -15,6 +15,16 @@ next to its ``history.jsonl``, keyed by the history digest with the same
 stat-fast-path scheme as the packed-row cache, so repeat ``check``/
 ``bench-check`` runs skip host packing entirely.
 
+**Substrate note (PR 7):** the per-run families here (``stream_rows``,
+``elle_mops``) and ``rows.npz`` are unified as sections of ONE sibling
+``.jtc`` columnar substrate per history (``history/columnar.py``:
+mmap-able, CRC-checksummed, written at record time) — the loaders below
+consult it first and fall back to the legacy npz files for pre-format
+stores; the savers merge their section into it under the shared
+write-temp-verify-rename discipline.  The store-level
+``packed_store.npz`` (assembled columns over a whole file SET) stays
+npz: it is keyed to a file set, not one history.
+
 Freshness: the cache stamps every member ``(relpath, size, mtime_ns)``;
 a load stats the same files (cheap — no reads) and rejects the cache on
 any difference, including additions, removals, and reordering — AND
@@ -104,12 +114,22 @@ def elle_mops_cache_path(jsonl_path: str | Path) -> Path:
 
 
 def save_elle_mops_cache(jsonl_path: str | Path, mat, meta) -> None:
-    """Persist one history's ``[M, 8]`` micro-op cell matrix + meta next
-    to its JSONL, stamped exactly like the packed-row cache ((size,
-    mtime_ns) AND content hash).  Atomic and best-effort; histories
-    whose keys aren't plain ints are simply not cached (the npz schema
-    is int64, and such keys only occur in synthetic/garbage input)."""
+    """Persist one history's ``[M, 8]`` micro-op cell matrix + meta into
+    the sibling ``.jtc`` columnar substrate (``SEC_EMOPS*`` sections —
+    the unified replacement of the legacy ``elle_mops.npz``; see
+    ``history/columnar.py``).  Atomic and best-effort; histories whose
+    keys aren't plain ints are simply not cached (the column schema is
+    int64, and such keys only occur in synthetic/garbage input).  With
+    the substrate disabled (``JEPSEN_TPU_NO_JTC=1``) the legacy npz is
+    written instead."""
+    from jepsen_tpu.history import columnar
     from jepsen_tpu.history.rows import _history_digest
+
+    if columnar._coerce_sections(None, None, (mat, meta)) is not None:
+        if columnar.update_jtc(jsonl_path, "elle", emops=(mat, meta)):
+            return
+    elif not columnar._disabled():
+        return  # unrepresentable keys: refused, exactly like the npz
 
     jsonl_path = Path(jsonl_path)
     target = elle_mops_cache_path(jsonl_path)
@@ -151,11 +171,21 @@ def save_elle_mops_cache(jsonl_path: str | Path, mat, meta) -> None:
 
 def load_elle_mops_cache(jsonl_path: str | Path):
     """``(mat, ElleMopsMeta)`` when a fresh cache exists; None when
-    absent, unreadable, or stale.  Same two-tier freshness as the
-    packed-row cache: a stat fast path ((size, mtime_ns) match AND cache
-    strictly newer than the JSONL), falling through to the content hash."""
+    absent, unreadable, or stale.  Consults the ``.jtc`` columnar
+    substrate first (zero-copy mmap view), then the legacy
+    ``elle_mops.npz`` for pre-format stores; same two-tier freshness as
+    the packed-row cache: a stat fast path ((size, mtime_ns) match AND
+    cache strictly newer than the JSONL), falling through to the
+    content hash."""
     from jepsen_tpu.checkers.elle import ElleMopsMeta
+    from jepsen_tpu.history import columnar
     from jepsen_tpu.history.rows import _history_digest
+
+    jtc = columnar.consult(jsonl_path)
+    if jtc is not None:
+        got = jtc.emops()
+        if got is not None:
+            return got
 
     jsonl_path = Path(jsonl_path)
     target = elle_mops_cache_path(jsonl_path)
@@ -232,9 +262,19 @@ def stream_rows_cache_path(jsonl_path: str | Path) -> Path:
 
 
 def save_stream_rows_cache(jsonl_path: str | Path, cols, full: bool) -> None:
-    """Persist one stream history's exploded columns next to its JSONL,
-    stamped like the packed-row cache.  Atomic and best-effort."""
+    """Persist one stream history's exploded columns into the sibling
+    ``.jtc`` columnar substrate (``SEC_STREAM`` — the unified
+    replacement of the legacy ``stream_rows.npz``).  Atomic and
+    best-effort; the legacy npz is written only with the substrate
+    disabled (``JEPSEN_TPU_NO_JTC=1``)."""
+    from jepsen_tpu.history import columnar
     from jepsen_tpu.history.rows import _history_digest
+
+    if columnar.update_jtc(
+        jsonl_path, "stream",
+        stream=(np.asarray(cols, np.int32), bool(full)),
+    ):
+        return
 
     jsonl_path = Path(jsonl_path)
     target = stream_rows_cache_path(jsonl_path)
@@ -267,8 +307,17 @@ def save_stream_rows_cache(jsonl_path: str | Path, cols, full: bool) -> None:
 
 def load_stream_rows_cache(jsonl_path: str | Path):
     """``(cols, full)`` when a fresh cache exists; None when absent,
-    unreadable, or stale (same two-tier freshness as the other caches)."""
+    unreadable, or stale (same two-tier freshness as the other caches).
+    Consults the ``.jtc`` columnar substrate first, then the legacy
+    ``stream_rows.npz`` for pre-format stores."""
+    from jepsen_tpu.history import columnar
     from jepsen_tpu.history.rows import _history_digest
+
+    jtc = columnar.consult(jsonl_path)
+    if jtc is not None:
+        got = jtc.stream()
+        if got is not None:
+            return got
 
     jsonl_path = Path(jsonl_path)
     target = stream_rows_cache_path(jsonl_path)

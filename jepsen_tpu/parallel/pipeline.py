@@ -374,9 +374,14 @@ def _chunks(seq: Sequence[Any], size: int) -> list[Sequence[Any]]:
 
 # ---------------------------------------------------------------------------
 # Family producers: history BYTES (file paths) -> host-packed batches.
-# Cache-first (digest-keyed per-file caches), then the native thread-pool
-# multi-file parse, then the Python twin — identical substrate contract
-# to the serial paths, differential-tested in tests/test_pipeline.py.
+# Cache-first — since PR 7 the cache IS the `.jtc` columnar substrate
+# (COLUMNAR.md: one mmap-able CRC-checksummed file per history,
+# consulted by the load_* functions below with legacy-npz fallback for
+# pre-format stores) — then the native thread-pool multi-file pass
+# (which itself serves stat-fresh `.jtc` blocks with zero parse, GIL
+# released), then the Python twin — identical substrate contract to the
+# serial paths, differential-tested in tests/test_pipeline.py and
+# tests/test_columnar.py.
 # ---------------------------------------------------------------------------
 
 
@@ -384,18 +389,27 @@ def _stripe_indices(n: int, part: int, n_parts: int) -> list[int]:
     return list(range(part, n, n_parts))
 
 
-def _native_stripe(native_fn, paths, misses, stripe, threads, part, n_parts):
+def _native_stripe(
+    native_fn, paths, misses, stripe, threads, part, n_parts,
+    use_jtc=True,
+):
     """Native multi-file results aligned with ``misses`` (stripe-local
     positions).  A fully-missed stripe goes through the striped-cursor
     native entry over the SHARED full path list (no per-lane sublist,
     no shared cursor between concurrent lanes); partial misses (cache
-    hits in between) fall back to a compacted per-subset call."""
+    hits in between) fall back to a compacted per-subset call.
+    ``use_jtc=False`` (a ``use_cache=False`` caller) disables the native
+    ``.jtc`` substrate serve so the batch genuinely parses."""
     if n_parts > 1 and len(misses) == len(stripe):
-        got = native_fn(paths, threads, part=part, n_parts=n_parts)
+        got = native_fn(
+            paths, threads, part=part, n_parts=n_parts, use_jtc=use_jtc
+        )
         if got is None:
             return None
         return [got[i] for i in stripe]
-    return native_fn([paths[stripe[j]] for j in misses], threads)
+    return native_fn(
+        [paths[stripe[j]] for j in misses], threads, use_jtc=use_jtc
+    )
 
 
 def _stream_substrates(
@@ -429,7 +443,8 @@ def _stream_substrates(
         misses = list(range(len(stripe)))
     if misses:
         native = _native_stripe(
-            stream_rows_files, paths, misses, stripe, threads, part, n_parts
+            stream_rows_files, paths, misses, stripe, threads, part,
+            n_parts, use_jtc=use_cache,
         )
         for k, j in enumerate(misses):
             got = native[k] if native is not None else None
@@ -471,7 +486,8 @@ def _queue_substrates(
         misses = list(range(len(stripe)))
     if misses:
         native = _native_stripe(
-            pack_files, paths, misses, stripe, threads, part, n_parts
+            pack_files, paths, misses, stripe, threads, part, n_parts,
+            use_jtc=use_cache,
         )
         for k, j in enumerate(misses):
             got = native[k] if native is not None else None
@@ -479,8 +495,15 @@ def _queue_substrates(
                 if use_cache:
                     save_rows_cache(paths[stripe[j]], got[0], got[1])
                 out[j] = got[1]
-            else:
+            elif use_cache:
                 out[j] = rows_with_cache(paths[stripe[j]])[1]
+            else:
+                # no-cache caller: the fallback must parse too, not
+                # sneak the substrate/npz in through the load-through
+                from jepsen_tpu.history.rows import _rows_for
+                from jepsen_tpu.history.store import read_history
+
+                out[j] = _rows_for(read_history(paths[stripe[j]]))
     return out
 
 
@@ -516,7 +539,8 @@ def _elle_substrates(
         misses = list(range(len(stripe)))
     if misses:
         native = _native_stripe(
-            elle_mops_files, paths, misses, stripe, threads, part, n_parts
+            elle_mops_files, paths, misses, stripe, threads, part,
+            n_parts, use_jtc=use_cache,
         )
         for k, j in enumerate(misses):
             got = native[k] if native is not None else None

@@ -56,7 +56,17 @@ constexpr int32_t NO_VALUE = -1;
 // OpType / OpF integer codes (ops.py enums)
 constexpr int T_INVOKE = 0;
 
-enum Err : int32_t { OK = 0, ERR_IO = 1, ERR_PARSE = 2, ERR_OVERFLOW = 3 };
+enum Err : int32_t {
+  OK = 0,
+  ERR_IO = 1,
+  ERR_PARSE = 2,
+  ERR_OVERFLOW = 3,
+  // a sibling .jtc columnar substrate exists and is stat-fresh but fails
+  // its structural/CRC validation: the binding returns None and the
+  // Python loader (history/columnar.py) re-detects the corruption and
+  // LOGS it before any legacy re-parse — never a silent fallback
+  ERR_JTC = 4,
+};
 
 enum class VKind { NONE, INT, OTHER, LIST };
 
@@ -665,6 +675,196 @@ int64_t* copy_i64(const std::vector<long long>& v) {
 
 }  // namespace
 
+// ---------------------------------------------------------------------------
+// .jtc columnar substrate fast path (history/columnar.py is the format
+// owner — layout documented there).  When a history source has a
+// stat-fresh sibling .jtc, the packers below serve its CRC-verified
+// column blocks straight into the result arena instead of parsing JSONL
+// — this is what makes the multi-file thread-pool entry points
+// (jt_*_files / jt_*_files_part) a bytes-to-staging-buffers pipe with
+// zero parse in the loop.  Freshness here is the stat fast path ONLY
+// (.jtc newer than the source AND the header (size, mtime_ns) stamp
+// matches); anything the fast path cannot prove fresh falls through to
+// the normal parse.  A fresh-but-invalid file returns ERR_JTC (loud —
+// see the Err enum).
+// ---------------------------------------------------------------------------
+
+#include <sys/stat.h>
+
+#include <array>
+
+namespace {
+
+uint32_t jtc_crc32(const uint8_t* p, size_t n) {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t crc = 0xFFFFFFFFu;
+  while (n--) crc = table[(crc ^ *p++) & 0xFFu] ^ (crc >> 8);
+  return ~crc;
+}
+
+constexpr size_t kJtcHeader = 96;
+constexpr size_t kJtcSection = 48;
+constexpr uint32_t kJtcVersion = 1;
+
+template <typename T>
+T jtc_read_le(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));  // x86/arm64 linux: little-endian
+  return v;
+}
+
+struct JtcSec {
+  uint32_t kind, dtype;
+  uint64_t rows, cols, off, len;
+  uint32_t crc, flags;
+};
+
+struct JtcView {
+  std::vector<uint8_t> buf;
+  int32_t workload = -1;
+  std::vector<JtcSec> secs;
+  const JtcSec* find(uint32_t kind) const {
+    for (const auto& s : secs)
+      if (s.kind == kind) return &s;
+    return nullptr;
+  }
+  const uint8_t* data(const JtcSec& s) const { return buf.data() + s.off; }
+};
+
+long long stat_mtime_ns(const struct stat& st) {
+  return static_cast<long long>(st.st_mtim.tv_sec) * 1000000000LL +
+         st.st_mtim.tv_nsec;
+}
+
+// per-process substrate toggle (jt_jtc_disable): the Python side sets
+// it around native batch calls whose caller asked for a genuine parse
+// (check_sources(use_cache=False)) — the env var alone is process-wide
+// and cannot express a per-call intent
+std::atomic<int32_t> g_jtc_disabled{0};
+
+// 0 = no fresh .jtc (fall through to parse), 1 = loaded + verified,
+// 2 = stat-fresh but corrupt/incompatible (caller returns ERR_JTC)
+int jtc_load(const char* src_path, JtcView* out) {
+  if (g_jtc_disabled.load(std::memory_order_relaxed)) return 0;
+  const char* no = std::getenv("JEPSEN_TPU_NO_JTC");
+  if (no && *no && *no != '0') return 0;
+  std::string src(src_path);
+  size_t slash = src.find_last_of('/');
+  size_t dot = src.find_last_of('.');
+  if (dot == std::string::npos ||
+      (slash != std::string::npos && dot < slash))
+    dot = src.size();
+  std::string jtc = src.substr(0, dot) + ".jtc";
+
+  struct stat st_src, st_jtc;
+  if (stat(src.c_str(), &st_src) != 0) return 0;
+  if (stat(jtc.c_str(), &st_jtc) != 0) return 0;
+  if (stat_mtime_ns(st_jtc) <= stat_mtime_ns(st_src)) return 0;  // stale
+
+  FILE* fh = std::fopen(jtc.c_str(), "rb");
+  if (!fh) return 0;
+  std::vector<uint8_t>& buf = out->buf;
+  buf.clear();
+  buf.reserve(static_cast<size_t>(st_jtc.st_size));
+  uint8_t chunk[1 << 16];
+  size_t got;
+  while ((got = std::fread(chunk, 1, sizeof(chunk), fh)) > 0)
+    buf.insert(buf.end(), chunk, chunk + got);
+  bool rerr = std::ferror(fh) != 0;
+  std::fclose(fh);
+  if (rerr) return 2;
+
+  if (buf.size() < kJtcHeader + 4) return 2;  // truncated header
+  if (std::memcmp(buf.data(), "JTCF", 4) != 0) return 2;
+  if (jtc_read_le<uint32_t>(buf.data() + 4) != kJtcVersion) return 2;
+  out->workload = jtc_read_le<int32_t>(buf.data() + 8);
+  uint32_t n_sections = jtc_read_le<uint32_t>(buf.data() + 12);
+  size_t table_end = kJtcHeader + kJtcSection * n_sections;
+  if (buf.size() < table_end + 4) return 2;  // truncated table
+  if (jtc_crc32(buf.data(), table_end) !=
+      jtc_read_le<uint32_t>(buf.data() + table_end))
+    return 2;  // header checksum mismatch
+
+  // source-identity stamp: size + mtime_ns must match the live source
+  // (a mismatch is staleness, not corruption — re-parse), and the
+  // basename must be the one this .jtc was packed from (jsonl vs edn
+  // twins share the sibling slot)
+  if (jtc_read_le<uint64_t>(buf.data() + 48) !=
+          static_cast<uint64_t>(st_src.st_size) ||
+      jtc_read_le<int64_t>(buf.data() + 56) != stat_mtime_ns(st_src))
+    return 0;
+  const char* base = src.c_str() + (slash == std::string::npos ? 0 : slash + 1);
+  size_t base_len = std::strlen(base);
+  if (base_len > 32) return 0;
+  char name[33] = {0};
+  std::memcpy(name, buf.data() + 16, 32);
+  if (std::strncmp(name, base, 32) != 0 ||
+      (base_len < 32 && name[base_len] != '\0'))
+    return 0;
+
+  out->secs.clear();
+  for (uint32_t i = 0; i < n_sections; ++i) {
+    const uint8_t* p = buf.data() + kJtcHeader + i * kJtcSection;
+    JtcSec s;
+    s.kind = jtc_read_le<uint32_t>(p);
+    s.dtype = jtc_read_le<uint32_t>(p + 4);
+    s.rows = jtc_read_le<uint64_t>(p + 8);
+    s.cols = jtc_read_le<uint64_t>(p + 16);
+    s.off = jtc_read_le<uint64_t>(p + 24);
+    s.len = jtc_read_le<uint64_t>(p + 32);
+    s.crc = jtc_read_le<uint32_t>(p + 40);
+    s.flags = jtc_read_le<uint32_t>(p + 44);
+    if (s.dtype > 1) return 2;
+    // overflow-proof bounds/shape validation: a hostile or buggy table
+    // (valid CRC, wild offsets/counts) must yield ERR_JTC, never a
+    // wrapped uint64 that defeats the check and dereferences wild
+    // memory (the Python reader is immune — arbitrary-precision ints)
+    uint64_t item = s.dtype == 0 ? 4 : 8;
+    uint64_t cols = s.cols > 1 ? s.cols : 1;
+    if (s.off > buf.size() || s.len > buf.size() - s.off) return 2;
+    // caps keep every product below 2^63: rows/cols are bounded by the
+    // byte length they claim to describe, which is bounded by the file
+    if (s.rows > (uint64_t{1} << 40) || cols > (uint64_t{1} << 20) ||
+        s.rows * cols * item != s.len)
+      return 2;  // truncated tail / shape mismatch
+    if (jtc_crc32(buf.data() + s.off, s.len) != s.crc)
+      return 2;  // payload bit flip
+    out->secs.push_back(s);
+  }
+  return 1;
+}
+
+// copy one int32 section into a malloc'd array (the result arena's
+// staging copy); false on allocation failure
+bool jtc_copy_i32(const JtcView& v, const JtcSec& s, int32_t** dst) {
+  *dst = nullptr;
+  if (s.len == 0) return true;
+  *dst = static_cast<int32_t*>(checked_malloc(s.len));
+  if (!*dst) return false;
+  std::memcpy(*dst, v.data(s), s.len);
+  return true;
+}
+
+bool jtc_copy_i64(const JtcView& v, const JtcSec& s, int64_t** dst) {
+  *dst = nullptr;
+  if (s.len == 0) return true;
+  *dst = static_cast<int64_t*>(checked_malloc(s.len));
+  if (!*dst) return false;
+  std::memcpy(*dst, v.data(s), s.len);
+  return true;
+}
+
+}  // namespace
+
 extern "C" {
 
 typedef struct {
@@ -676,9 +876,33 @@ typedef struct {
 } JtPackResult;
 
 // Pack one history.jsonl into rows.  Caller frees with jt_pack_free.
+// A stat-fresh sibling .jtc serves the rows with no parse at all.
 JtPackResult* jt_pack_file(const char* path) {
   auto* res = static_cast<JtPackResult*>(std::calloc(1, sizeof(JtPackResult)));
   if (!res) return nullptr;
+
+  {
+    JtcView v;
+    int r = jtc_load(path, &v);
+    if (r == 2) {
+      res->err = ERR_JTC;
+      return res;
+    }
+    if (r == 1) {
+      const JtcSec* s = v.find(1 /* SEC_QROWS */);
+      if (s && s->dtype == 0 && s->cols == 8 && v.workload >= 0 &&
+          v.workload <= 3) {
+        if (!jtc_copy_i32(v, *s, &res->rows)) {
+          res->err = ERR_IO;  // allocation failure
+          return res;
+        }
+        res->n_rows = static_cast<int64_t>(s->rows);
+        res->workload = v.workload;
+        return res;
+      }
+      // rows section absent (or unknown workload): parse normally
+    }
+  }
 
   FILE* fh = std::fopen(path, "rb");
   if (!fh) {
@@ -1171,6 +1395,42 @@ JtElleMopsResult* jt_elle_mops_file(const char* path) {
       std::calloc(1, sizeof(JtElleMopsResult)));
   if (!res) return nullptr;
 
+  {
+    JtcView v;
+    int r = jtc_load(path, &v);
+    if (r == 2) {
+      res->err = ERR_JTC;
+      return res;
+    }
+    if (r == 1) {
+      const JtcSec* cells = v.find(3 /* SEC_EMOPS */);
+      const JtcSec* txn = v.find(4 /* SEC_EMOPS_TXN */);
+      const JtcSec* keys = v.find(5 /* SEC_EMOPS_KEYS */);
+      if (cells && txn && keys && cells->dtype == 0 && cells->cols == 8 &&
+          txn->dtype == 1 && keys->dtype == 1 &&
+          txn->flags == txn->rows /* binding walks n_txns entries */) {
+        if (!jtc_copy_i32(v, *cells, &res->cells) ||
+            !jtc_copy_i64(v, *txn, &res->txn_index) ||
+            !jtc_copy_i64(v, *keys, &res->keys)) {
+          std::free(res->cells);
+          std::free(res->txn_index);
+          std::free(res->keys);
+          res->cells = nullptr;
+          res->txn_index = nullptr;
+          res->keys = nullptr;
+          res->err = ERR_IO;
+          return res;
+        }
+        res->n_cells = static_cast<int64_t>(cells->rows);
+        res->n_txns = static_cast<int32_t>(txn->flags);  // true n_txns
+        res->n_keys = static_cast<int32_t>(keys->rows);
+        res->degenerate = (cells->flags & 1) ? 1 : 0;
+        return res;
+      }
+      // elle sections absent (e.g. a queue-family .jtc): parse normally
+    }
+  }
+
   constexpr long long kMaxCells = 46000;  // _MOPS_MAX_CELLS (sort-key cap)
   std::vector<int32_t> cells;
   cells.reserve(1 << 14);
@@ -1341,6 +1601,28 @@ JtStreamResult* jt_stream_rows_file(const char* path) {
   auto* res =
       static_cast<JtStreamResult*>(std::calloc(1, sizeof(JtStreamResult)));
   if (!res) return nullptr;
+
+  {
+    JtcView v;
+    int r = jtc_load(path, &v);
+    if (r == 2) {
+      res->err = ERR_JTC;
+      return res;
+    }
+    if (r == 1) {
+      const JtcSec* s = v.find(2 /* SEC_STREAM */);
+      if (s && s->dtype == 0 && s->cols == 6) {
+        if (!jtc_copy_i32(v, *s, &res->cols)) {
+          res->err = ERR_IO;
+          return res;
+        }
+        res->n_rows = static_cast<int64_t>(s->rows);
+        res->full_read = (s->flags & 1) ? 1 : 0;
+        return res;
+      }
+      // stream section absent (non-stream .jtc): parse normally
+    }
+  }
 
   std::vector<int32_t> cols;
   cols.reserve(1 << 14);
@@ -1543,5 +1825,12 @@ JtElleMopsResult** jt_elle_mops_files_part(const char* const* paths,
 
 // frees only the pointer arena — elements are freed by jt_*_free
 void jt_files_free(void** arr) { std::free(arr); }
+
+// process-wide .jtc fast-path toggle (see g_jtc_disabled): non-zero
+// disables substrate serving so the next calls genuinely parse.  The
+// Python binding sets it around no-cache batch calls and restores it.
+void jt_jtc_disable(int32_t disabled) {
+  g_jtc_disabled.store(disabled ? 1 : 0, std::memory_order_relaxed);
+}
 
 }  // extern "C"

@@ -555,6 +555,88 @@ class TestBenchScaleOutSmoke:
         assert "host_cores" in sc and "note" in sc
 
 
+class TestBenchColdWarmSmoke:
+    """Offline gates for the PR-7 columnar-substrate bench schema: the
+    ``cold_vs_warm`` section must keep its keys (cold/warm walls, the
+    2x ratio, ``pack_bytes_per_sec`` for the columnar reader) so the
+    tentpole's claim stays a measured schema key, not prose — plus a
+    format-version round-trip smoke for the ``.jtc`` itself."""
+
+    @pytest.fixture()
+    def bench(self):
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        import bench as bench_mod
+
+        return bench_mod
+
+    def test_cold_vs_warm_section_schema(self, bench):
+        details = {}
+        bench._bench_cold_vs_warm(
+            details, histories=24, base_n=8, n_ops=40, chunk=8
+        )
+        cw = details["cold_vs_warm"]
+        for key in (
+            "legacy_cold_wall_s",
+            "record_pack_s",
+            "columnar_cold_wall_s",
+            "warm_wall_s",
+            "cold_vs_warm_ratio",
+            "within_2x",
+            "cold_speedup_vs_legacy",
+            "pack_bytes_per_sec",
+            "columnar_read_src_bytes_per_sec",
+            "jsonl_parse_python_bytes_per_sec",
+            "columnar_speedup_vs_python_parse",
+            "jsonl_parse_native_bytes_per_sec",
+            "columnar_speedup_vs_native_parse",
+            "verdicts_match",
+            "backend",
+        ):
+            assert key in cw, f"cold_vs_warm schema lost key {key!r}"
+        assert cw["histories"] == 24
+        # the DIFFERENTIAL half of the acceptance gate: all three runs
+        # (legacy parse, cold substrate, warm substrate) agreed
+        assert cw["verdicts_match"] is True
+        assert cw["pack_bytes_per_sec"] > 0
+        assert cw["columnar_speedup_vs_python_parse"] > 0
+
+    def test_jtc_format_version_roundtrip(self, tmp_path):
+        """Offline ``.jtc`` round trip under JAX_PLATFORMS=cpu: write →
+        structural read → version-bump rejection (the stale-format-
+        version corruption class)."""
+        import numpy as np
+
+        from jepsen_tpu.history.columnar import (
+            ColumnarFormatError,
+            VERSION,
+            jtc_path_for,
+            read_jtc,
+            write_jtc,
+        )
+
+        src = tmp_path / "history.jsonl"
+        src.write_text('{"type": "invoke", "f": "enqueue", "value": 1}\n')
+        rows = np.arange(16, dtype=np.int32).reshape(2, 8)
+        write_jtc(src, "queue", rows=rows)
+        jtc, stamp = read_jtc(jtc_path_for(src))
+        assert stamp["src_name"] == "history.jsonl"
+        np.testing.assert_array_equal(jtc.rows(), rows)
+        raw = bytearray(jtc_path_for(src).read_bytes())
+        raw[4] = VERSION + 1
+        jtc_path_for(src).write_bytes(raw)
+        with pytest.raises(ColumnarFormatError, match="format version"):
+            read_jtc(jtc_path_for(src))
+
+
 class TestDistributedSpawnSmoke:
     """2-process spawn smoke of the distributed checker under
     JAX_PLATFORMS=cpu: the jax.distributed join, the deterministic

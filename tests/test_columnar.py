@@ -1,0 +1,569 @@
+"""The ``.jtc`` zero-copy columnar substrate (``history/columnar.py``).
+
+Three gates:
+
+1. **Format honesty** — round-trip bit-identity, the two-tier freshness
+   contract, and the corruption classes: a flipped byte, a truncated
+   tail, or a stale format version must raise a LOUD
+   :class:`ColumnarFormatError`; the cache layers may fall back to the
+   legacy parse only with the reason logged (pinned alongside the
+   ``BadZipFile`` guards of the npz era).
+2. **Differential** — the columnar path must be verdict-identical to
+   the JSONL-parse path for all three checker families (including the
+   degenerate-elle host-fallback splice), through record→check and
+   through concurrent-lane striped reads.
+3. **Migration** — ``tools/migrate_store.py`` is idempotent and refuses
+   corrupt substrates.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import columnar
+from jepsen_tpu.history.columnar import (
+    ColumnarFormatError,
+    jtc_path_for,
+    load_jtc,
+    pack_jtc,
+    read_jtc,
+    write_jtc,
+)
+from jepsen_tpu.history.store import (
+    Store,
+    read_history,
+    write_history_jsonl,
+)
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_elle_batch,
+    synth_stream_batch,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _write(td, shs, prefix="h"):
+    files = []
+    for i, sh in enumerate(shs):
+        p = Path(td) / f"{prefix}{i:03d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+def _pack_all(files):
+    # ensure the .jtc mtime strictly exceeds the source's (same-tick
+    # writes would force the digest path — fine, but slower)
+    time.sleep(0.01)
+    for f in files:
+        pack_jtc(f)
+
+
+# ---------------------------------------------------------------------------
+# 1. Format honesty
+# ---------------------------------------------------------------------------
+
+
+class TestFormat:
+    def test_roundtrip_queue_rows_bitwise(self, tmp_path):
+        from jepsen_tpu.history.rows import _rows_for
+
+        h = synth_batch(1, SynthSpec(n_ops=40, seed=1), lost=1)[0].ops
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, h)
+        _pack_all([p])
+        jtc = load_jtc(p)
+        assert jtc is not None and jtc.workload == "queue"
+        np.testing.assert_array_equal(jtc.rows(), _rows_for(h))
+        # zero-copy contract: the view maps the file, it does not own a
+        # host copy (read-only buffer)
+        assert not jtc.rows().flags.writeable
+
+    def test_roundtrip_stream_and_elle_sections(self, tmp_path):
+        from jepsen_tpu.checkers.elle import elle_mops_for
+        from jepsen_tpu.checkers.stream_lin import _stream_rows
+
+        (ps,) = _write(
+            tmp_path, synth_stream_batch(1, StreamSynthSpec(n_ops=30)), "s"
+        )
+        (pe,) = _write(
+            tmp_path,
+            synth_elle_batch(1, ElleSynthSpec(n_txns=12), g1a=1),
+            "e",
+        )
+        _pack_all([ps, pe])
+        cols, full = load_jtc(ps).stream()
+        rc, rf = _stream_rows(read_history(ps))
+        np.testing.assert_array_equal(cols, rc)
+        assert full == rf
+        mat, meta = load_jtc(pe).emops()
+        rm, rg = elle_mops_for(read_history(pe))
+        np.testing.assert_array_equal(mat, rm)
+        assert (meta.n_txns, meta.txn_index, meta.keys, meta.degenerate) == (
+            rg.n_txns, rg.txn_index, rg.keys, rg.degenerate
+        )
+
+    def test_stale_on_source_rewrite(self, tmp_path):
+        shs = synth_batch(2, SynthSpec(n_ops=40, seed=2), lost=1)
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, shs[0].ops)
+        _pack_all([p])
+        assert load_jtc(p) is not None
+        write_history_jsonl(p, shs[1].ops)  # rewrite: substrate is stale
+        assert load_jtc(p) is None  # a MISS, not an error
+
+    def test_src_name_disambiguates_jsonl_vs_edn(self, tmp_path):
+        """jsonl and edn twins share the sibling .jtc slot; the header's
+        source-name stamp must keep one's substrate from serving the
+        other."""
+        h = synth_batch(1, SynthSpec(n_ops=20, seed=3))[0].ops
+        p = tmp_path / "history.jsonl"
+        write_history_jsonl(p, h)
+        _pack_all([p])
+        e = tmp_path / "history.edn"
+        from jepsen_tpu.history.edn import write_history_edn
+
+        write_history_edn(e, h)
+        assert load_jtc(p) is not None
+        assert load_jtc(e) is None  # packed from the jsonl, not the edn
+
+    def test_format_version_roundtrip_and_stale_version(self, tmp_path):
+        (p,) = _write(tmp_path, synth_batch(1, SynthSpec(n_ops=20)))
+        _pack_all([p])
+        target = jtc_path_for(p)
+        jtc, stamp = read_jtc(target)  # structural round trip
+        assert stamp["src_name"] == p.name
+        assert jtc.rows() is not None
+        raw = bytearray(target.read_bytes())
+        raw[4] = 99  # version field
+        target.write_bytes(raw)
+        with pytest.raises(ColumnarFormatError, match="format version"):
+            read_jtc(target)
+        with pytest.raises(ColumnarFormatError, match="format version"):
+            load_jtc(p)
+
+    def test_write_discipline_verifies_before_rename(self, tmp_path):
+        """write_jtc re-reads what hit the disk before installing; no
+        temp file survives a failure."""
+        (p,) = _write(tmp_path, synth_batch(1, SynthSpec(n_ops=20)))
+        _pack_all([p])
+        leftovers = [
+            f for f in p.parent.iterdir() if f.name.endswith(".tmp")
+        ]
+        assert leftovers == []
+        with pytest.raises(ValueError):
+            write_jtc(p, "queue")  # section-less: refused loudly
+
+
+class TestCorruptionHonesty:
+    def _packed(self, tmp_path):
+        (p,) = _write(
+            tmp_path, synth_batch(1, SynthSpec(n_ops=40, seed=4), lost=1)
+        )
+        _pack_all([p])
+        return p, jtc_path_for(p)
+
+    def test_flipped_payload_byte_raises(self, tmp_path):
+        p, t = self._packed(tmp_path)
+        raw = bytearray(t.read_bytes())
+        raw[-3] ^= 0xFF
+        t.write_bytes(raw)
+        with pytest.raises(ColumnarFormatError, match="checksum"):
+            load_jtc(p)
+
+    def test_flipped_header_byte_raises(self, tmp_path):
+        p, t = self._packed(tmp_path)
+        raw = bytearray(t.read_bytes())
+        raw[50] ^= 0xFF  # inside the source stamp
+        t.write_bytes(raw)
+        with pytest.raises(ColumnarFormatError, match="header checksum"):
+            load_jtc(p)
+
+    def test_truncated_tail_raises(self, tmp_path):
+        p, t = self._packed(tmp_path)
+        raw = t.read_bytes()
+        t.write_bytes(raw[: len(raw) - 16])
+        with pytest.raises(ColumnarFormatError):
+            load_jtc(p)
+
+    def test_empty_file_raises(self, tmp_path):
+        p, t = self._packed(tmp_path)
+        t.write_bytes(b"")
+        with pytest.raises(ColumnarFormatError):
+            load_jtc(p)
+
+    def test_fallback_is_never_silent(self, tmp_path, caplog):
+        """The cache layer falls back to the legacy parse on a corrupt
+        substrate — but ONLY with the reason logged (the satellite
+        contract: never a silent re-parse)."""
+        from jepsen_tpu.history.rows import _rows_for, load_rows_cache
+
+        p, t = self._packed(tmp_path)
+        raw = bytearray(t.read_bytes())
+        raw[-3] ^= 0xFF
+        t.write_bytes(raw)
+        with caplog.at_level(
+            logging.WARNING, "jepsen_tpu.history.columnar"
+        ):
+            assert load_rows_cache(p) is None
+        assert any(
+            "corrupt columnar substrate" in r.message
+            for r in caplog.records
+        )
+        # and the parse path still yields the right rows
+        from jepsen_tpu.history.rows import rows_with_cache
+
+        wl, rows, _hit = rows_with_cache(p)
+        assert wl == "queue"
+        np.testing.assert_array_equal(
+            rows, _rows_for(read_history(p))
+        )
+
+    def test_strict_mode_raises_through_the_cache_layer(
+        self, tmp_path, monkeypatch
+    ):
+        from jepsen_tpu.history.rows import load_rows_cache
+
+        p, t = self._packed(tmp_path)
+        raw = bytearray(t.read_bytes())
+        raw[-3] ^= 0xFF
+        t.write_bytes(raw)
+        monkeypatch.setenv("JEPSEN_TPU_JTC_STRICT", "1")
+        with pytest.raises(ColumnarFormatError):
+            load_rows_cache(p)
+
+    def test_native_reader_refuses_corrupt_substrate(self, tmp_path):
+        """The C++ fast path must also refuse (ERR_JTC -> None), never
+        serve corrupt blocks or silently re-parse them itself."""
+        from jepsen_tpu.history.fastpack import _load, pack_file
+
+        if _load() is None:
+            pytest.skip("native packer unavailable")
+        p, t = self._packed(tmp_path)
+        ref = pack_file(p)
+        assert ref is not None  # served from the fresh substrate
+        raw = bytearray(t.read_bytes())
+        raw[-3] ^= 0xFF
+        t.write_bytes(raw)
+        assert pack_file(p) is None
+
+    def test_native_serves_from_substrate(self, tmp_path):
+        """Prove the native fast path reads the .jtc, not the JSONL:
+        rewrite the source bytes in place with size+mtime restored (the
+        stat fast path still holds) — the served rows must be the
+        substrate's."""
+        from jepsen_tpu.history.fastpack import _load, pack_file
+        from jepsen_tpu.history.rows import _rows_for
+
+        if _load() is None:
+            pytest.skip("native packer unavailable")
+        p, _t = self._packed(tmp_path)
+        ref = _rows_for(read_history(p))
+        st = os.stat(p)
+        p.write_bytes(b"X" * st.st_size)  # same size, garbage bytes
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+        got = pack_file(p)
+        assert got is not None and got[0] == "queue"
+        np.testing.assert_array_equal(got[1], ref)
+
+
+class TestSubstratePolicy:
+    """The knobs that decide when the substrate may serve: the no-cache
+    contract, the env kill switch's value semantics, and the
+    name-field representability refusal (review findings, pinned)."""
+
+    def _swapped_source(self, tmp_path):
+        """A source whose .jtc is stat-fresh but holds DIFFERENT content
+        than the live bytes (same size, mtime restored) — serving vs
+        parsing is observable in the value column."""
+        l1 = '{"type": "invoke", "f": "enqueue", "value": 11, "process": 0}\n'
+        l2 = '{"type": "invoke", "f": "enqueue", "value": 22, "process": 0}\n'
+        assert len(l1) == len(l2)
+        p = tmp_path / "h.jsonl"
+        p.write_text(l1)
+        time.sleep(0.01)
+        pack_jtc(p)  # substrate: value 11
+        st = os.stat(p)
+        p.write_text(l2)  # live bytes: value 22
+        os.utime(p, ns=(st.st_atime_ns, st.st_mtime_ns))
+        return p
+
+    def test_no_cache_batch_genuinely_parses(self, tmp_path):
+        """``use_jtc=False`` (what ``check_sources(use_cache=False)``
+        passes down) must force a real parse — cached column blocks
+        must not be re-served when the caller asked for independence."""
+        from jepsen_tpu.history.fastpack import _load, pack_files
+
+        if _load() is None:
+            pytest.skip("native packer unavailable")
+        p = self._swapped_source(tmp_path)
+        (served,) = pack_files([p], use_jtc=True)
+        (parsed,) = pack_files([p], use_jtc=False)
+        assert served[1][0, 4] == 11  # the substrate's blocks
+        assert parsed[1][0, 4] == 22  # the live bytes, parsed
+
+    def test_env_value_zero_means_enabled_on_both_sides(
+        self, tmp_path, monkeypatch
+    ):
+        """`JEPSEN_TPU_NO_JTC=0` must mean ENABLED for the Python
+        loaders AND the native reader — a value-semantics split would
+        cache to two different stores in one process."""
+        from jepsen_tpu.history.fastpack import _load, pack_file
+
+        p = self._swapped_source(tmp_path)
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "0")
+        assert load_jtc(p) is not None
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")
+        assert load_jtc(p) is None
+        if _load() is not None:
+            monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "0")
+            assert pack_file(p)[1][0, 4] == 11  # served
+            monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")
+            assert pack_file(p)[1][0, 4] == 22  # parsed
+
+    def test_long_basename_refused_and_npz_fallback(self, tmp_path):
+        """A basename over the 32-byte name field is refused at write
+        (a truncated stamp would never load — the substrate would be
+        rewritten on every check yet never served); the best-effort
+        savers fall back to the legacy npz so caching still works."""
+        from jepsen_tpu.history.rows import (
+            _rows_for,
+            cache_path_for,
+            load_rows_cache,
+            save_rows_cache,
+        )
+
+        p = tmp_path / ("h" * 40 + ".jsonl")
+        write_history_jsonl(
+            p, synth_batch(1, SynthSpec(n_ops=20))[0].ops
+        )
+        with pytest.raises(ValueError, match="32-byte"):
+            write_jtc(p, "queue", rows=np.zeros((1, 8), np.int32))
+        save_rows_cache(p, "queue", _rows_for(read_history(p)))
+        assert not jtc_path_for(p).exists()
+        assert cache_path_for(p).exists()
+        got = load_rows_cache(p)
+        assert got is not None and got[0] == "queue"
+
+
+# ---------------------------------------------------------------------------
+# 2. Differential: columnar ≡ legacy parse, all families
+# ---------------------------------------------------------------------------
+
+
+def _degenerate_elle_ops():
+    from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+
+    mk = lambda v: Op(type=OpType.OK, f=OpF.TXN, process=0, value=v)
+    # the same value appended twice: elle_mops_for flags it degenerate,
+    # routing this history through the host-inference fallback splice
+    return reindex([mk([["append", 0, 1]]), mk([["append", 0, 1]])])
+
+
+class TestDifferential:
+    """Columnar and legacy paths must produce byte-identical verdicts
+    (the acceptance gate)."""
+
+    def _legacy_then_columnar(self, files, workload, monkeypatch, **opts):
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")
+        legacy, _ = check_sources(
+            workload, files, chunk=4, serial=True, use_cache=False, **opts
+        )
+        monkeypatch.delenv("JEPSEN_TPU_NO_JTC")
+        _pack_all(files)
+        columnar_r, _ = check_sources(
+            workload, files, chunk=4, use_cache=True, **opts
+        )
+        return legacy, columnar_r
+
+    def test_queue_verdicts_identical(self, tmp_path, monkeypatch):
+        base = synth_batch(
+            8, SynthSpec(n_ops=50), lost=1, duplicated=1, unexpected=1
+        )
+        files = _write(tmp_path, base)
+        legacy, col = self._legacy_then_columnar(
+            files, "queue", monkeypatch
+        )
+        assert legacy == col
+
+    def test_stream_verdicts_identical(self, tmp_path, monkeypatch):
+        base = synth_stream_batch(
+            8, StreamSynthSpec(n_ops=40), lost=1, duplicated=1
+        )
+        files = _write(tmp_path, base)
+        legacy, col = self._legacy_then_columnar(
+            files, "stream", monkeypatch
+        )
+        assert legacy == col
+
+    def test_elle_verdicts_identical_with_degenerate_splice(
+        self, tmp_path, monkeypatch
+    ):
+        base = synth_elle_batch(
+            6, ElleSynthSpec(n_txns=16), g1a=1, g2_cycle=1
+        )
+        files = _write(tmp_path, base)
+        pdeg = tmp_path / "degen.jsonl"
+        write_history_jsonl(pdeg, _degenerate_elle_ops())
+        files = files[:3] + [pdeg] + files[3:]
+        legacy, col = self._legacy_then_columnar(
+            files, "elle", monkeypatch
+        )
+        assert legacy == col
+        # the degenerate history really took the host-fallback splice
+        # through the columnar path too
+        mat, meta = load_jtc(pdeg).emops()
+        assert meta.degenerate
+
+    def test_record_to_check_roundtrip(self, tmp_path):
+        """Store.save_history cuts the substrate at record time; the
+        first re-check maps it with zero parse and agrees with the CPU
+        oracle."""
+        from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+        from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+        from jepsen_tpu.history.rows import load_rows_cache
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        store = Store(tmp_path / "s")
+        sh = synth_batch(1, SynthSpec(n_ops=40), lost=1)[0]
+        d = store.run_dir("t")
+        time.sleep(0.01)  # run-dir mkdir and history write same tick
+        p = store.save_history(d, sh.ops)
+        assert jtc_path_for(p).exists()
+        assert load_rows_cache(p) is not None  # substrate hit, no parse
+        results, _ = check_sources("queue", [p], chunk=1)
+        assert (
+            results[0]["queue"]["valid?"]
+            == check_total_queue_cpu(sh.ops)["valid?"]
+        )
+        assert (
+            results[0]["linear"]["valid?"]
+            == check_queue_lin_cpu(sh.ops)["valid?"]
+        )
+
+    def test_striped_lane_reads_equal_full_scan(self, tmp_path, monkeypatch):
+        """Concurrent-lane striped reads over the substrate ≡ the
+        serial full scan (the scale-out acceptance leg)."""
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        base = synth_batch(10, SynthSpec(n_ops=40), lost=1, duplicated=1)
+        files = _write(tmp_path, base)
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")
+        serial, _ = check_sources(
+            "queue", files, chunk=3, serial=True, use_cache=False
+        )
+        monkeypatch.delenv("JEPSEN_TPU_NO_JTC")
+        _pack_all(files)
+        laned, stats = check_sources(
+            "queue", files, chunk=3, lanes=4, use_cache=True
+        )
+        assert laned == serial
+        assert stats.lanes >= 2
+
+    def test_edn_source_substrate(self, tmp_path):
+        """An imported EDN run carries its own substrate: record-time
+        emission via save_history_edn, keyed to the EDN bytes."""
+        from jepsen_tpu.history.rows import _rows_for, load_rows_cache
+
+        store = Store(tmp_path / "s")
+        sh = synth_batch(1, SynthSpec(n_ops=30), lost=1)[0]
+        d = store.run_dir("t")
+        time.sleep(0.01)
+        p = store.save_history_edn(d, sh.ops)
+        assert p.suffix == ".edn"
+        assert jtc_path_for(p).exists()
+        got = load_rows_cache(p)
+        assert got is not None and got[0] == "queue"
+        np.testing.assert_array_equal(
+            got[1], _rows_for(read_history(p))
+        )
+
+
+# ---------------------------------------------------------------------------
+# 3. Migration tool
+# ---------------------------------------------------------------------------
+
+
+class TestMigrateStore:
+    def _mk_store(self, tmp_path, n=3):
+        root = tmp_path / "store"
+        files = []
+        for i, sh in enumerate(synth_batch(n, SynthSpec(n_ops=30), lost=1)):
+            d = root / "t" / f"run{i}"
+            d.mkdir(parents=True)
+            p = d / "history.jsonl"
+            write_history_jsonl(p, sh.ops)
+            files.append(p)
+        time.sleep(0.01)
+        return root, files
+
+    def _migrate(self, *argv):
+        sys.path.insert(0, str(REPO / "tools"))
+        try:
+            import migrate_store
+        finally:
+            sys.path.pop(0)
+        return migrate_store, migrate_store.main([str(a) for a in argv])
+
+    def test_migrates_then_idempotent(self, tmp_path, capsys):
+        import json
+
+        root, files = self._mk_store(tmp_path)
+        _m, rc = self._migrate(root)
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["migrated"] == 3 and out["fresh"] == 0
+        for p in files:
+            assert jtc_path_for(p).exists()
+            assert load_jtc(p) is not None
+        _m, rc = self._migrate(root)  # idempotent: zero work
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["migrated"] == 0 and out["fresh"] == 3
+
+    def test_refuses_corrupt_substrate(self, tmp_path, capsys):
+        import json
+
+        root, files = self._mk_store(tmp_path)
+        _m, rc = self._migrate(root)
+        assert rc == 0
+        capsys.readouterr()
+        t = jtc_path_for(files[1])
+        raw = bytearray(t.read_bytes())
+        raw[-3] ^= 0xFF
+        t.write_bytes(raw)
+        _m, rc = self._migrate(root)
+        assert rc == 3  # refused, non-zero
+        cap = capsys.readouterr()
+        assert "REFUSED" in cap.err
+        out = json.loads(cap.out.strip().splitlines()[-1])
+        assert out["corrupt_refused"] == 1
+        # the corrupt file was NOT repaved
+        assert bytes(raw) == t.read_bytes()
+        # explicit repave fixes it
+        _m, rc = self._migrate(root, "--repave-corrupt")
+        assert rc == 0
+        assert load_jtc(files[1]) is not None
+
+    def test_dry_run_writes_nothing(self, tmp_path, capsys):
+        import json
+
+        root, files = self._mk_store(tmp_path)
+        _m, rc = self._migrate(root, "--dry-run")
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert out["migrated"] == 3
+        assert not any(jtc_path_for(p).exists() for p in files)

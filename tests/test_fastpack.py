@@ -355,14 +355,17 @@ def test_edn_suffix_falls_back(tmp_path):
 
 
 def test_rows_with_cache_native_miss_then_hit(tmp_path):
-    from jepsen_tpu.history.rows import cache_path_for, rows_with_cache
+    from jepsen_tpu.history.columnar import jtc_path_for
+    from jepsen_tpu.history.rows import rows_with_cache
 
     sh = synth_batch(1, SynthSpec(n_ops=60, seed=3, lost=1))[0]
     p = tmp_path / "history.jsonl"
     write_history_jsonl(p, sh.ops)
     wl, rows, hit = rows_with_cache(p)
     assert not hit and wl == "queue"
-    assert cache_path_for(p).exists()
+    # the miss leaves the unified .jtc columnar substrate behind (the
+    # legacy rows.npz is read-only fallback territory now)
+    assert jtc_path_for(p).exists()
     np.testing.assert_array_equal(rows, _rows_for(read_history(p)))
     wl2, rows2, hit2 = rows_with_cache(p)
     assert hit2 and wl2 == wl

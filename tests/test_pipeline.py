@@ -80,15 +80,16 @@ class TestStreamDifferential:
                 assert r["stream"][k] == cpu[k], k
 
     def test_warm_cache_run_identical(self, stream_corpus):
-        """Second run hits the stream_rows.npz digest cache; verdicts
-        must be byte-identical to the cold run."""
+        """Second run hits the digest-guarded columnar substrate (the
+        ``.jtc`` that replaced ``stream_rows.npz``); verdicts must be
+        byte-identical to the cold run."""
         _base, files = stream_corpus
         cold, _ = check_sources("stream", files, chunk=8, use_cache=True)
         warm, _ = check_sources("stream", files, chunk=8, use_cache=True)
         assert cold == warm
-        from jepsen_tpu.history.storecache import stream_rows_cache_path
+        from jepsen_tpu.history.columnar import jtc_path_for
 
-        assert stream_rows_cache_path(files[0]).exists()
+        assert jtc_path_for(files[0]).exists()
 
     def test_long_histories_chunked(self, tmp_path):
         """The stream_10k shape (longer rows, several chunks, tail chunk
@@ -399,7 +400,13 @@ class TestStreamRowsCache:
         assert hit4
         assert (cols4 == _stream_rows(read_history(p))[0]).all()
 
-    def test_corrupt_cache_ignored(self, tmp_path):
+    def test_corrupt_cache_ignored(self, tmp_path, caplog):
+        """Corruption in EITHER backing store (the ``.jtc`` substrate or
+        a legacy npz) must never serve wrong data: the jtc corruption is
+        LOGGED (never a silent fallback) and the load reports a miss."""
+        import logging
+
+        from jepsen_tpu.history.columnar import jtc_path_for
         from jepsen_tpu.history.storecache import (
             load_stream_rows_cache,
             save_stream_rows_cache,
@@ -411,5 +418,12 @@ class TestStreamRowsCache:
         save_stream_rows_cache(
             p, np.zeros((1, 6), np.int32), False
         )
+        raw = bytearray(jtc_path_for(p).read_bytes())
+        raw[-1] ^= 0xFF
+        jtc_path_for(p).write_bytes(raw)
         stream_rows_cache_path(p).write_bytes(b"not an npz")
-        assert load_stream_rows_cache(p) is None
+        with caplog.at_level(logging.WARNING, "jepsen_tpu.history.columnar"):
+            assert load_stream_rows_cache(p) is None
+        assert any(
+            "corrupt columnar substrate" in r.message for r in caplog.records
+        )

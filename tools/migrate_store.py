@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Rewrite an existing run store to the ``.jtc`` columnar substrate, in
+place.
+
+Pre-format stores (``history.jsonl`` / ``history.edn`` with at most the
+legacy npz caches beside them) re-pay a parse on every cold check; this
+tool walks a store root and packs each history's sibling ``.jtc``
+(``history/columnar.py``) so every later ``check`` / ``bench-check`` /
+soak maps bytes straight into staging buffers.
+
+Contract:
+- **idempotent** — a history whose ``.jtc`` is already fresh is skipped;
+  a second run over a migrated store does zero work;
+- **refuses on checksum mismatch** — an existing ``.jtc`` that fails its
+  CRC/format validation is reported and NOT overwritten (exit 3): a
+  corrupt substrate in a store you asked to migrate is evidence of disk
+  trouble, and silently repaving it would destroy that evidence.  Pass
+  ``--repave-corrupt`` only once the corruption is understood;
+- every written file goes through the shared write-temp → checksum-verify
+  → rename discipline (a torn migration can never be installed).
+
+Usage::
+
+    python tools/migrate_store.py STORE_ROOT [--dry-run] [--repave-corrupt]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from jepsen_tpu.history import columnar  # noqa: E402
+from jepsen_tpu.history.store import EDN_FILE, HISTORY_FILE  # noqa: E402
+
+
+def history_sources(root: Path) -> list[Path]:
+    """Every history source under ``root``: each ``history.jsonl`` plus
+    EDN files that are not an exported twin of a JSONL in the same run
+    dir (the CLI's ``_history_paths`` rule)."""
+    return sorted(root.glob(f"**/{HISTORY_FILE}")) + [
+        p
+        for p in sorted(root.glob(f"**/{EDN_FILE}"))
+        if not (p.parent / HISTORY_FILE).exists()
+    ]
+
+
+def migrate(
+    root: Path, dry_run: bool = False, repave_corrupt: bool = False
+) -> dict:
+    out = {
+        "root": str(root),
+        "histories": 0,
+        "migrated": 0,
+        "fresh": 0,
+        "stale_repacked": 0,
+        "corrupt_refused": 0,
+        "errors": 0,
+    }
+    for src in history_sources(root):
+        out["histories"] += 1
+        target = columnar.jtc_path_for(src)
+        had = target.exists()
+        if had:
+            try:
+                fresh = columnar.load_jtc(src)
+            except columnar.ColumnarFormatError as e:
+                if not repave_corrupt:
+                    print(
+                        f"REFUSED (checksum/format): {target}: {e}",
+                        file=sys.stderr,
+                    )
+                    out["corrupt_refused"] += 1
+                    continue
+                print(f"# repaving corrupt {target}: {e}", file=sys.stderr)
+                fresh = None
+            if fresh is not None:
+                out["fresh"] += 1
+                continue
+        if dry_run:
+            out["migrated"] += 1
+            continue
+        try:
+            columnar.pack_jtc(src)
+        except Exception as e:  # noqa: BLE001 - per-file, reported
+            print(
+                f"ERROR packing {src}: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            out["errors"] += 1
+            continue
+        out["migrated"] += 1
+        if had:
+            out["stale_repacked"] += 1
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("root", help="store root (e.g. store/ or one run dir)")
+    p.add_argument(
+        "--dry-run", action="store_true",
+        help="report what would be packed without writing anything",
+    )
+    p.add_argument(
+        "--repave-corrupt", action="store_true",
+        help="overwrite a .jtc that fails checksum/format validation "
+        "(default: refuse and exit 3 — see the module docstring)",
+    )
+    args = p.parse_args(argv)
+    root = Path(args.root)
+    if not root.exists():
+        print(f"no such store root: {root}", file=sys.stderr)
+        return 2
+    out = migrate(
+        root, dry_run=args.dry_run, repave_corrupt=args.repave_corrupt
+    )
+    out["dry_run"] = args.dry_run
+    print(json.dumps(out))
+    if out["corrupt_refused"]:
+        return 3
+    return 1 if out["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,13 @@ the loss is fixed.
 Exit code 0 = the run reached its expected verdict under the triage
 rules (and the artifact, if requested, was captured); non-zero = it
 never did within ``--attempts``, and no artifact was written.
+
+Substrate note (PR 7): the recorded history lands with its ``.jtc``
+columnar sibling (``Store.save_history`` → COLUMNAR.md), and the
+pipelined post-run analysis (``attach_pipelined_checkers`` →
+``check_sources``) consumes it through the unified cache loaders — a
+soak's verdict pass and any later re-check map bytes straight into
+staging buffers with no JSONL re-parse.
 """
 
 from __future__ import annotations

@@ -1335,14 +1335,7 @@ def _bench_wgl_hard(details: dict) -> None:
             "--capacity", capacity, "--batch", "16", "--deadline", "1500",
         ]
         r = subprocess.run(cmd, capture_output=True, text=True)
-        got = []
-        for line in r.stdout.splitlines():
-            line = line.strip()
-            if line.startswith("{"):
-                try:
-                    got.append(json.loads(line))
-                except ValueError:
-                    pass
+        got = _scan_json_rows(r.stdout)
         if not got:
             got = [{"error": (r.stderr or r.stdout)[-300:],
                     "windows": windows, "capacity": capacity}]
@@ -1354,6 +1347,101 @@ def _bench_wgl_hard(details: dict) -> None:
         _write_details(details)
     for row in rows:
         print(f"# wgl_hard: {json.dumps(row)}", file=sys.stderr)
+
+
+def _scan_json_rows(text: str) -> list:
+    """Every parseable JSON-object line of a bench child's stdout — the
+    ONE defensive parse both WGL row harnesses use (a stray warning
+    line or empty stdout yields fewer/no rows, never an exception)."""
+    rows = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                rows.append(json.loads(line))
+            except ValueError:
+                pass
+    return rows
+
+
+#: (n_ops, window) rows of the `wgl_pcomp` section: the round-3 hard
+#: table re-run (n=200 across the measured widths) plus the 1k-op rows
+#: the ISSUE-9 done-bar names (w ≥ 6 at n_ops ≥ 1000)
+WGL_PCOMP_ROWS = (
+    (200, 0), (200, 2), (200, 4), (200, 6), (200, 8),
+    (1000, 6), (1000, 8), (1000, 10),
+)
+
+
+def _bench_wgl_pcomp(
+    details: dict,
+    rows_spec=WGL_PCOMP_ROWS,
+    batch: int = 4,
+    deadline: float = 900.0,
+    persist: bool = True,
+) -> None:
+    """P-compositional WGL vs the classic host search on partition-era
+    hard histories (`tools/bench_wgl.py --pcomp`; WGL_BENCH.md round 6).
+
+    Runs on EVERY backend — unlike the monolithic `wgl_hard` rows
+    (chip-only: host XLA loses them by construction), the decomposition
+    dissolves the 2^w blowup itself, so the crossover question is
+    answerable on the CPU backend too.  Each row runs in a subprocess
+    with a hard deadline, same harness as the --hard sweep: the classic
+    search's exponential tail at w≥8/n≥1000 must produce a timeout row,
+    never hang the bench."""
+    tool = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools", "bench_wgl.py"
+    )
+    rows = []
+    for n_ops, w in rows_spec:
+        cmd = [
+            sys.executable, tool,
+            "--one-hard", f"{n_ops},{w},0", "--pcomp",
+            "--batch", str(batch),
+        ]
+        t0 = time.perf_counter()
+        try:
+            r = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=deadline
+            )
+            # defensive parse (shared with _bench_wgl_hard): empty
+            # stdout or a stray trailing warning must yield an error
+            # ROW, never abort the section and discard measured rows
+            got = _scan_json_rows(r.stdout) if r.returncode == 0 else []
+            row = got[-1] if got else {
+                "n_ops": n_ops, "window": w,
+                "error": (r.stderr or r.stdout)[-300:],
+            }
+        except subprocess.TimeoutExpired:
+            row = {"n_ops": n_ops, "window": w, "timeout": True,
+                   "deadline_s": deadline}
+        row["wall_s"] = round(time.perf_counter() - t0, 1)
+        rows.append(row)
+        print(f"# wgl_pcomp: {json.dumps(row)}", file=sys.stderr)
+        crossover = [
+            r2 for r2 in rows
+            if r2.get("winner") == "pcomp"
+            and r2.get("n_ops", 0) >= 1000
+            and r2.get("window", 0) >= 6
+        ]
+        details["wgl_pcomp"] = {
+            "rows": rows,
+            # the ISSUE-9 done-bar, decided from measurements, not
+            # prose: pcomp beats classic per-history at n ≥ 1000, w ≥ 6
+            "crossover_met": bool(crossover),
+            "best_speedup_vs_classic": max(
+                (r2.get("speedup_vs_classic", 0.0) for r2 in rows),
+                default=0.0,
+            ),
+        }
+        # persist after EACH row (wgl_hard's per-group discipline): the
+        # classic tail at (1000, w10) alone can run minutes, and a
+        # driver timeout there must not discard the measured prefix.
+        # persist=False is the offline smoke (tests/test_ci.py), which
+        # must never touch the chip-measured BENCH_DETAILS.json
+        if persist:
+            _write_details(details)
 
 
 #: always the repo-root copy, regardless of the invoker's cwd — the
@@ -1587,8 +1675,9 @@ def _run_once() -> None:
     # still leaves N sections of fresh numbers on disk
     for section in (
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
-        _bench_elle, _bench_mutex, _bench_north_star_section,
-        _bench_cold_vs_warm_section, _bench_scaling,
+        _bench_elle, _bench_mutex, _bench_wgl_pcomp,
+        _bench_north_star_section, _bench_cold_vs_warm_section,
+        _bench_scaling,
     ):
         try:
             section(details)

@@ -31,27 +31,28 @@ Indeterminate (``info``) ops follow Knossos semantics: they may linearize
 at any point after their invocation — they join every later event's
 candidate set — or never (no return event forces them).
 
-**Backend guidance — measured, see ``WGL_BENCH.md`` (round 3 settled
-the crossover question)**: compile cost on the tunneled TPU is **flat**
-at ~20 s per shape bucket regardless of history length (the dedup
-orders frontier rows by a 64-bit row hash instead of a variadic
-lexicographic sort over every state column, which had made XLA's
-compile time linear at ~0.6 s per op row); steady-state chip run time
-beats the CPU-backend tensor engine 2.0–5.6×.  Against the classic host
-search the engine does **not** win per history — not on easy histories
-and, measured in round 3, not on partition-era hard ones either: the
-classic search's exponential tail is real (~700× from window 0→8), but
-the frontier capacity the tensor search must carry grows with the same
-2^w, and the classic engine stays 1.7–283× faster on the CPU backend at
-every measured width (WGL_BENCH.md "Partition-era hard histories").
-The engine's role is therefore: (a) the *general-model correctness
-engine* — one compiled program per model×shape for CAS/mutex/FIFO/
-unordered models, exact verdicts, honest *unknown* + CPU escape hatch
-on overflow; (b) the device path for *batched* checking of many
-histories in one dispatch (``bench-check --workload mutex``).  For the
-quorum-queue workload the TPU-fast linearizability path remains the
-per-value decomposition (``jepsen_tpu.checkers.queue_lin``,
-P-compositionality), at millions of histories/s.
+**Backend guidance — measured, see ``WGL_BENCH.md``**: compile cost on
+the tunneled TPU is **flat** at ~20 s per shape bucket regardless of
+history length (the dedup orders frontier rows by a 64-bit row hash
+instead of a variadic lexicographic sort over every state column, which
+had made XLA's compile time linear at ~0.6 s per op row); steady-state
+chip run time beats the CPU-backend tensor engine 2.0–5.6×.
+*Monolithically* the engine does not win per history against the
+classic host search on the CPU backend (round 3: classic 1.7–283×
+faster at every width; round 4: the chip wins w≥6 hard histories
+5.1–13.5×) — but since round 6 the checker wrappers run the
+**P-compositional front end** (``checkers/wgl_pcomp.py``, arXiv
+1504.00204) by default: the history splits into per-value / per-lock-key
+sub-histories and the SAME frontier search vmaps over thousands of
+narrow classes, each at a capacity sized to its measured indeterminacy
+width.  That wins partition-era hard histories on EVERY backend
+(19.5×–2393× over classic at w=6–10, CPU backend, WGL_BENCH.md round
+6) and makes cost linear in history length.  The monolithic engines
+below remain: the fallback for models whose state couples classes (CAS
+register; FIFO with pending enqueues), the ``--no-pcomp`` escape, and
+the exact semantics every decomposition is differentially gated
+against (``tests/test_wgl_pcomp.py``).  Overflow stays honest at both
+levels: *unknown* + CPU escape hatch, never a silent pass.
 """
 
 from __future__ import annotations
@@ -82,11 +83,17 @@ INF = 2**31 - 1
 @dataclass(frozen=True)
 class WglOp:
     """One operation for the search: its model call + history interval.
-    ``ret == INF`` marks an indeterminate op (open forever)."""
+    ``ret == INF`` marks an indeterminate op (open forever).
+
+    ``key`` is the op's *decomposition class* hint (the mutex lock key /
+    the queue value's class) — ignored by the monolithic engines, used
+    by the P-compositional front end (``checkers/wgl_pcomp.py``) to
+    split the history into independently-checkable sub-histories."""
 
     call: Call
     inv: int
     ret: int
+    key: int = 0
 
 
 # ---------------------------------------------------------------------------
@@ -126,6 +133,33 @@ def queue_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
     return out
 
 
+def mutex_key_token(value) -> tuple[int, int]:
+    """``(lock key, fencing token)`` of a mutex op value; ``-1`` token
+    means "none".  The value conventions, oldest first:
+
+    - ``None``          — unfenced single-lock op (key 0, no token);
+    - ``int``           — fenced single-lock op (the token; key 0);
+    - ``[key]``         — unfenced MULTI-lock op (one int);
+    - ``[key, token]``  — fenced multi-lock op (two ints).
+
+    The list forms are the multi-lock channel: a bare int key would be
+    indistinguishable from a fencing token (and flip
+    :func:`mutex_history_is_fenced`), so keyed ops always ride a list.
+    (bools count as ints, matching both json.loads-fed histories and
+    the native cell parser.)"""
+    if isinstance(value, int):
+        return 0, int(value)
+    if (
+        isinstance(value, (list, tuple))
+        and len(value) in (1, 2)
+        and all(isinstance(v, int) for v in value)
+    ):
+        if len(value) == 1:
+            return int(value[0]), -1
+        return int(value[0]), int(value[1])
+    return 0, -1
+
+
 def mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
     """Map a mutex history onto lock-model calls (the reference's legacy
     mutex variant, ``rabbitmq_test.clj:18-44``).
@@ -134,7 +168,12 @@ def mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
     - info (indeterminate) ops may have taken effect at any later point
       (``ret=INF``) — a timed-out acquire might still hold the lock;
     - failed ops never happened (the lock was busy / not held).
-    """
+
+    Multi-lock histories (``[key]`` / ``[key, token]`` values — see
+    :func:`mutex_key_token`) set each op's ``key``; the monolithic
+    engines ignore it (they judge all keys against ONE lock, the
+    single-lock semantics every recorded history has used so far), the
+    P-compositional front end splits per key."""
     out: list[WglOp] = []
     open_inv: dict[int, int] = {}
     for pos, op in enumerate(history):
@@ -148,20 +187,23 @@ def mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
             OwnedMutex.ACQUIRE if op.f == OpF.ACQUIRE else OwnedMutex.RELEASE,
             a0=op.process,
         )
+        key, _tok = mutex_key_token(op.value)
         if op.type == OpType.OK:
-            out.append(WglOp(call, inv, pos))
+            out.append(WglOp(call, inv, pos, key=key))
         elif op.type == OpType.INFO:
-            out.append(WglOp(call, inv, INF))
+            out.append(WglOp(call, inv, INF, key=key))
     return out
 
 
 def mutex_history_is_fenced(history: Sequence[Op]) -> bool:
     """A mutex history is FENCED when successful acquires carry integer
-    fencing tokens as their values (unfenced completions carry None)."""
+    fencing tokens as their values — a bare int (single lock) or a
+    ``[key, token]`` pair (multi-lock); unfenced completions carry None
+    or a one-element ``[key]``."""
     return any(
         op.f == OpF.ACQUIRE
         and op.type == OpType.OK
-        and isinstance(op.value, int)
+        and mutex_key_token(op.value)[1] >= 0
         for op in history
     )
 
@@ -179,7 +221,11 @@ def fenced_mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
     therefore never turn a correct history red; it only (harmlessly)
     weakens detection of bugs that hide exactly inside an indeterminate
     window.  Ops without an integer token (failed, or malformed) never
-    took effect and are dropped like failures."""
+    took effect and are dropped like failures.
+
+    Multi-lock histories carry ``[key, token]`` values
+    (:func:`mutex_key_token`); the key lands on ``WglOp.key`` for the
+    P-compositional front end and the token on ``a1`` as before."""
     out: list[WglOp] = []
     open_inv: dict[int, int] = {}
     for pos, op in enumerate(history):
@@ -189,7 +235,8 @@ def fenced_mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
             open_inv[op.process] = pos
             continue
         inv = open_inv.pop(op.process, -1)
-        if op.type != OpType.OK or not isinstance(op.value, int):
+        key, token = mutex_key_token(op.value)
+        if op.type != OpType.OK or token < 0:
             continue
         out.append(
             WglOp(
@@ -198,10 +245,11 @@ def fenced_mutex_wgl_ops(history: Sequence[Op]) -> list[WglOp]:
                     if op.f == OpF.ACQUIRE
                     else FencedMutex.RELEASE,
                     a0=op.process,
-                    a1=op.value,
+                    a1=token,
                 ),
                 inv,
                 pos,
+                key=key,
             )
         )
     return out
@@ -285,10 +333,23 @@ class WglBatch:
 
 
 def pack_wgl_batch(
-    batches: Sequence[Sequence[WglOp]], max_cands: int = 24
+    batches: Sequence[Sequence[WglOp]],
+    max_cands: int = 24,
+    length: int | None = None,
+    to_device: bool = True,
 ) -> WglBatch:
+    """``length`` pins the padded op extent (must cover every history):
+    the P-compositional front end packs many small sub-history batches
+    and pins ``length`` to a shared bucket so they all hit ONE compiled
+    program instead of one per distinct max-length.  ``to_device=False``
+    keeps host numpy arrays (the pipeline's producer thread packs on the
+    host; its ``place`` stage stages the batch)."""
     B = len(batches)
     N = max(1, max(len(ops) for ops in batches))
+    if length is not None:
+        if length < N:
+            raise ValueError(f"length={length} < longest history ({N} ops)")
+        N = length
     R = N
     W = max_cands
     f = np.zeros((B, N), np.int32)
@@ -316,12 +377,13 @@ def pack_wgl_batch(
                 overflow[b] = True
                 cs = cs[:W]
             cands[b, j, : len(cs)] = cs
+    conv = jnp.asarray if to_device else (lambda x: x)
     return WglBatch(
-        f=jnp.asarray(f),
-        a0=jnp.asarray(a0),
-        a1=jnp.asarray(a1),
-        ret_op=jnp.asarray(ret_op),
-        cands=jnp.asarray(cands),
+        f=conv(f),
+        a0=conv(a0),
+        a1=conv(a1),
+        ret_op=conv(ret_op),
+        cands=conv(cands),
         cand_overflow=overflow,
         n=N,
     )
@@ -456,7 +518,7 @@ def _make_wgl_program(model: Model, n_ops: int, capacity: int, n_cands: int):
     return search
 
 
-@functools.lru_cache(maxsize=32)
+@functools.lru_cache(maxsize=64)
 def _wgl_program_cached(model_key, n_ops, capacity, n_cands):
     cls, args = model_key
     search = _make_wgl_program(cls(*args), n_ops, capacity, n_cands)
@@ -485,15 +547,20 @@ def wgl_tensor_check(
 
 class _WglChecker(Checker):
     """Shared engine choreography for the WGL checker family: map the
-    history to model calls, try the TPU frontier search, and escape-hatch
-    to the exact CPU search on frontier overflow.  Subclasses supply the
-    mapping and the model."""
+    history to model calls, try the P-compositional decomposition (many
+    narrow vmapped frontiers — ``checkers/wgl_pcomp.py``), fall back to
+    the monolithic TPU frontier search where the model's state couples
+    classes, and escape-hatch to the exact CPU search on frontier
+    overflow.  Subclasses supply the mapping and the model."""
 
-    def __init__(self, backend: str = "tpu", capacity: int = 128):
+    def __init__(
+        self, backend: str = "tpu", capacity: int = 128, pcomp: bool = True
+    ):
         if backend not in ("cpu", "tpu"):
             raise ValueError(f"unknown backend {backend!r}")
         self.backend = backend
         self.capacity = capacity
+        self.pcomp = pcomp
 
     def _ops_and_model(self, history):
         """→ ``(wgl_ops, model_key)``; the model instance comes from the
@@ -509,11 +576,37 @@ class _WglChecker(Checker):
         ops, model_key = self._ops_and_model(history)
 
         if self.backend == "tpu":
+            if self.pcomp:
+                from jepsen_tpu.checkers.wgl_pcomp import (
+                    pcomp_check_cpu,
+                    pcomp_check_ops,
+                )
+
+                r = pcomp_check_ops(ops, model_key)
+                if r is not None:
+                    if not r["unknown"]:
+                        return r
+                    # a sub-history overflowed even escalated: the exact
+                    # CPU escape hatch (itself per-class) decides, the
+                    # offending class stays visible — never a silent
+                    # per-piece skip
+                    cpu = pcomp_check_cpu(ops, model_key)
+                    cpu["pcomp-overflow-class"] = r.get("overflow-class")
+                    return cpu
+                # decomposition unsound for this model/history:
+                # monolithic tensor search below
             batch = pack_wgl_batch([ops])
             ok, unknown = wgl_tensor_check(batch, model_key, self.capacity)
             if not unknown[0]:
                 return {VALID: bool(ok[0]), "unknown": False, "engine": "tpu"}
             # frontier overflow: escape-hatch to the exact CPU search
+        if self.pcomp:
+            # the CPU backend decomposes too: per-class classic searches
+            # are the correct model for multi-lock histories and dodge
+            # the 2^w global blowup on partition-era ones
+            from jepsen_tpu.checkers.wgl_pcomp import pcomp_check_cpu
+
+            return pcomp_check_cpu(ops, model_key)
         cls, args = model_key
         r = check_wgl_cpu(ops, cls(*args))
         r["engine"] = "cpu"
@@ -575,8 +668,8 @@ class MutexWgl(_WglChecker):
     name = "mutex-wgl"
 
     def __init__(self, backend: str = "tpu", capacity: int = 128,
-                 fenced: bool | None = None):
-        super().__init__(backend=backend, capacity=capacity)
+                 fenced: bool | None = None, pcomp: bool = True):
+        super().__init__(backend=backend, capacity=capacity, pcomp=pcomp)
         self.fenced = fenced
 
     def _is_fenced(self, history) -> bool:

@@ -1,0 +1,799 @@
+"""P-compositional front end for the WGL tensor engine.
+
+"Faster linearizability checking via P-compositionality" (Horn &
+Kroening, arXiv:1504.00204; PAPERS.md): when the checked object is a
+product of independent sub-objects, a history is linearizable iff each
+per-class sub-history is — so instead of ONE wide frontier search whose
+capacity must carry ~4·2^w rows for w open (indeterminate) ops, the
+engine runs THOUSANDS of narrow frontiers, one per class, each sized to
+that class's own indeterminacy width.  A w=10 partition-era history
+becomes ~n/4 independent w≈1 searches that fit in capacity 16; the
+classic host search's 2^w blowup — and the monolithic tensor frontier's
+matching capacity blowup — never happens.
+
+What decomposes (the ``decomposition_sound`` proof obligations):
+
+- **unordered queue, per value** — a multiset over distinct values is a
+  product of per-value presence bits: enqueue/dequeue legality of value
+  ``v`` reads and writes only ``v``'s bit, so the product argument of
+  the paper applies exactly.  Sound for every history.
+- **mutex family, per lock key** — independent locks are a product
+  object; an acquire/release on key ``k`` touches only lock ``k``'s
+  holder (or, fenced, key ``k``'s latest token).  Single-lock histories
+  degenerate to one class (= the monolithic search at a tighter
+  capacity), multi-lock histories split.  Sound for every history.
+- **FIFO queue, per value + pairwise order** — FIFO order couples
+  classes, so per-value feasibility alone is NOT the whole spec.  For
+  *complete* distinct-value histories the classic queue
+  characterization (Henzinger-Sezgin-Vafeiadis CONCUR'13; the bad
+  patterns are 2-value) restores completeness: per-value interval
+  feasibility on device + a host pairwise order scan (``enq(v)`` wholly
+  before ``enq(w)`` ∧ ``deq(w)`` observed ⇒ ``deq(v)`` observed and not
+  wholly after ``deq(w)``).  Histories with PENDING enqueues (or a
+  binding model capacity) fall outside the proof — those mark the
+  decomposition unsound and the caller keeps the monolithic engine.
+
+Anything else (CAS register: one shared cell couples every op) is
+unsound by construction and reported as such — the caller falls back to
+the monolithic tensor search, which falls back to the exact CPU search
+on overflow.  The fallback chain never silently skips a piece: a
+sub-history whose frontier overflows (even after one capacity
+escalation) surfaces as *unknown* for the WHOLE history with the
+offending class identified.
+
+The mutex family's host substrate is the ``[n, 8]`` WGL cell matrix
+(:func:`wgl_cells_for` — one row per acquire/release completion with
+its interval, token, and lock key), written into the ``.jtc`` columnar
+substrate at record time (``SEC_WGL``, ``history/columnar.py``) with a
+native twin (``rows_packer.cpp::jt_wgl_cells_file``), so
+``check --workload mutex`` runs bytes → staging buffers with no JSONL
+parse — the mutex family's entry into the PR-7 zero-copy substrate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import UNKNOWN, VALID
+from jepsen_tpu.checkers.wgl import (
+    INF,
+    Call,
+    WglBatch,
+    WglOp,
+    mutex_key_token,
+    pack_wgl_batch,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.models.core import (
+    FencedMutex,
+    FifoQueue,
+    Mutex,
+    OwnedMutex,
+    UnorderedQueue,
+)
+
+#: per-class value space for remapped queue classes: every class holds
+#: ONE distinct value, remapped to 0, so one uint32 state word suffices
+#: and every class shares one compiled program per shape bucket
+_CLASS_VALUE_SPACE = 32
+
+#: capacity never escalates past this; a sub-history that overflows a
+#: 1024-row frontier is *unknown* and the exact CPU search decides
+MAX_SUB_CAPACITY = 1024
+
+
+# ---------------------------------------------------------------------------
+# decomposition
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SubHist:
+    """One independently-checkable sub-history."""
+
+    ops: list  # remapped WglOps (original intervals kept — order is all
+    #            the search reads, so global positions stay valid)
+    class_id: int  # the original value / lock key
+    width: int  # measured indeterminacy width: open (ret=INF) ops
+    src_idx: list  # positions in the original op list (round-trip proof)
+    trivial: bool = False  # no return events: trivially linearizable
+
+
+@dataclass
+class Decomposition:
+    """A history split into classes, plus the soundness proof flag."""
+
+    subs: list[SubHist] = field(default_factory=list)
+    model_key: tuple | None = None  # per-sub model (shared by all subs)
+    sound: bool = False
+    kind: str = ""  # "per-value" | "per-key" | "per-value+order"
+    reason: str = ""  # why unsound (sound=False only)
+    order_ok: bool | None = None  # FIFO host pairwise verdict
+    order_violation: tuple | None = None  # (v, w) witnessing pair
+    n_ops: int = 0
+
+    @property
+    def n_trivial(self) -> int:
+        return sum(1 for s in self.subs if s.trivial)
+
+
+def _width_of(ops: Sequence[WglOp]) -> int:
+    return sum(1 for o in ops if o.ret == INF)
+
+
+def decompose_queue_ops(ops: Sequence[WglOp]) -> Decomposition:
+    """Per-value classes for the unordered-queue model (sound always:
+    the multiset over distinct values is a product object)."""
+    classes: dict[int, list[tuple[int, WglOp]]] = {}
+    for i, o in enumerate(ops):
+        classes.setdefault(o.call.a0, []).append((i, o))
+    subs = []
+    for v, members in classes.items():
+        sub_ops = [
+            WglOp(Call(o.call.f, 0), o.inv, o.ret, key=v) for _, o in members
+        ]
+        subs.append(
+            SubHist(
+                ops=sub_ops,
+                class_id=v,
+                width=_width_of(sub_ops),
+                src_idx=[i for i, _ in members],
+                trivial=all(o.ret == INF for o in sub_ops),
+            )
+        )
+    return Decomposition(
+        subs=subs,
+        model_key=(UnorderedQueue, (_CLASS_VALUE_SPACE,)),
+        sound=True,
+        kind="per-value",
+        n_ops=len(ops),
+    )
+
+
+def decompose_mutex_ops(
+    ops: Sequence[WglOp], model_cls=OwnedMutex
+) -> Decomposition:
+    """Per-lock-key classes for the mutex family (sound always:
+    independent locks — owned or fenced — are a product object; an op on
+    key ``k`` touches only lock ``k``'s holder/token state).  The
+    single-lock histories every live run records so far degenerate to
+    one class, which is the monolithic search at the measured-width
+    capacity instead of the global 128."""
+    classes: dict[int, list[tuple[int, WglOp]]] = {}
+    for i, o in enumerate(ops):
+        classes.setdefault(o.key, []).append((i, o))
+    subs = []
+    for k, members in classes.items():
+        sub_ops = [o for _, o in members]
+        subs.append(
+            SubHist(
+                ops=sub_ops,
+                class_id=k,
+                width=_width_of(sub_ops),
+                src_idx=[i for i, _ in members],
+                trivial=all(o.ret == INF for o in sub_ops),
+            )
+        )
+    return Decomposition(
+        subs=subs,
+        model_key=(model_cls, ()),
+        sound=True,
+        kind="per-key",
+        n_ops=len(ops),
+    )
+
+
+def _fifo_order_ok(ops: Sequence[WglOp]) -> tuple[bool, tuple | None]:
+    """The cross-class half of the complete-history FIFO decomposition:
+    no pair ``v, w`` with ``enq(v)`` wholly before ``enq(w)`` where
+    ``w`` was dequeued but ``v`` was not, or ``deq(w)`` completed wholly
+    before ``deq(v)`` was invoked.  Vectorized over the value pairs."""
+    enq_inv: dict[int, int] = {}
+    enq_ret: dict[int, int] = {}
+    deq_inv: dict[int, int] = {}
+    deq_ret: dict[int, int] = {}
+    for o in ops:
+        v = o.call.a0
+        if o.call.f == FifoQueue.ENQUEUE:
+            enq_inv[v], enq_ret[v] = o.inv, o.ret
+        else:
+            deq_inv[v], deq_ret[v] = o.inv, o.ret
+    vals = sorted(enq_inv)
+    if len(vals) < 2:
+        return True, None
+    ei = np.asarray([enq_inv[v] for v in vals], np.int64)
+    er = np.asarray([enq_ret[v] for v in vals], np.int64)
+    has_d = np.asarray([v in deq_inv for v in vals], bool)
+    di = np.asarray([deq_inv.get(v, 0) for v in vals], np.int64)
+    dr = np.asarray([deq_ret.get(v, 0) for v in vals], np.int64)
+    # v rows, w cols: enq(v) wholly precedes enq(w).  Linearization
+    # slots are the discrete return events with candidate windows
+    # (inv, ret], so "wholly before" is ret_v <= inv_w — v's window
+    # closes before w's opens (a strict < would miss adjacent windows:
+    # found by the randomized differential fuzz in test_wgl_pcomp.py)
+    before = er[:, None] <= ei[None, :]
+    w_deq = has_d[None, :]
+    v_not_deq = ~has_d[:, None]
+    deq_swapped = has_d[:, None] & has_d[None, :] & (
+        dr[None, :] <= di[:, None]
+    )
+    bad = before & w_deq & (v_not_deq | deq_swapped)
+    if not bad.any():
+        return True, None
+    vi, wi = np.argwhere(bad)[0]
+    return False, (vals[int(vi)], vals[int(wi)])
+
+
+def decompose_fifo_ops(
+    ops: Sequence[WglOp], capacity: int
+) -> Decomposition:
+    """FIFO queue: per-value feasibility classes + the host pairwise
+    order check — sound only for COMPLETE histories (no pending
+    enqueues) whose model capacity cannot bind (see module docstring);
+    anything else keeps the monolithic engine."""
+    n_enq = sum(1 for o in ops if o.call.f == FifoQueue.ENQUEUE)
+    if any(o.ret == INF for o in ops):
+        return Decomposition(
+            sound=False,
+            kind="per-value+order",
+            reason="pending (indeterminate) ops: the pairwise FIFO "
+            "order proof needs a complete history",
+            n_ops=len(ops),
+        )
+    enq_counts: dict[int, int] = {}
+    for o in ops:
+        if o.call.f == FifoQueue.ENQUEUE:
+            enq_counts[o.call.a0] = enq_counts.get(o.call.a0, 0) + 1
+    dup = [v for v, c in enq_counts.items() if c > 1]
+    if dup:
+        # a value enqueued twice breaks the distinct-value premise of
+        # the pairwise characterization (and the per-value order dicts
+        # would silently keep only the last interval — caught by the
+        # review's executed counterexample); unsound, keep monolithic.
+        # Duplicate DEQUEUES need no guard: their per-value class is
+        # already infeasible under the multiset step, which refutes —
+        # correctly — before order is ever consulted.
+        return Decomposition(
+            sound=False,
+            kind="per-value+order",
+            reason=f"value(s) {sorted(dup)[:3]} enqueued more than "
+            "once: the pairwise FIFO order proof needs distinct values",
+            n_ops=len(ops),
+        )
+    if n_enq > capacity:
+        return Decomposition(
+            sound=False,
+            kind="per-value+order",
+            reason=f"bounded-queue capacity {capacity} can bind "
+            f"({n_enq} enqueues): the bound is sequential spec the "
+            "per-value classes cannot see",
+            n_ops=len(ops),
+        )
+    d = decompose_queue_ops(ops)
+    ok, pair = _fifo_order_ok(ops)
+    d.kind = "per-value+order"
+    d.order_ok = ok
+    d.order_violation = pair
+    d.n_ops = len(ops)
+    return d
+
+
+def decomposition_union(d: Decomposition) -> list:
+    """Re-assemble the original op list from the sub-histories — the
+    round-trip proof that every op lands in exactly one class (pinned
+    in ``tests/test_wgl_pcomp.py``).  Per-value classes un-remap their
+    value (``class_id``) back onto ``a0``."""
+    out: list = [None] * d.n_ops
+    for s in d.subs:
+        for j, i in enumerate(s.src_idx):
+            o = s.ops[j]
+            if d.kind.startswith("per-value"):
+                o = WglOp(
+                    Call(o.call.f, s.class_id, o.call.a1), o.inv, o.ret
+                )
+            if out[i] is not None:
+                raise ValueError(f"op {i} landed in two classes")
+            out[i] = o
+    if any(o is None for o in out):
+        raise ValueError("decomposition dropped an op")
+    return out
+
+
+def decompose(ops: Sequence[WglOp], model_key) -> Decomposition:
+    """Model-dispatching decomposer.  ``sound=False`` results carry the
+    reason; their ``subs`` list is empty and the caller must keep the
+    monolithic engine."""
+    cls, args = model_key
+    if cls is UnorderedQueue:
+        return decompose_queue_ops(ops)
+    if cls is FifoQueue:
+        return decompose_fifo_ops(ops, args[0] if args else 1024)
+    if cls in (OwnedMutex, FencedMutex, Mutex):
+        return decompose_mutex_ops(ops, cls)
+    return Decomposition(
+        sound=False,
+        reason=f"{cls.__name__} state couples every op: no product "
+        "structure to decompose over",
+        n_ops=len(ops),
+    )
+
+
+# ---------------------------------------------------------------------------
+# bucketed vmapped checking
+# ---------------------------------------------------------------------------
+
+
+def _pow2ceil(n: int, floor: int = 1) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def capacity_for(width: int) -> int:
+    """Frontier capacity from the measured indeterminacy width: the
+    closure's intermediate expansion needs ~4·2^w rows (WGL_BENCH.md
+    round 3), so clean classes (w=0) compile at capacity 16 and the
+    bucket doubles per open op, clamped at :data:`MAX_SUB_CAPACITY`
+    (overflow ⇒ *unknown* ⇒ exact CPU escape hatch)."""
+    return min(MAX_SUB_CAPACITY, _pow2ceil(max(16, 4 << min(width, 8))))
+
+
+def _max_concurrency(ops: Sequence[WglOp]) -> int:
+    """Max candidate-window width across return events: the number of
+    ops whose interval covers some return position (an endpoint sweep,
+    not the packer's O(n²) scan)."""
+    rets = sorted(o.ret for o in ops if o.ret != INF)
+    if not rets:
+        return 0
+    events = []
+    for o in ops:
+        events.append((o.inv + 1, 1))  # candidate from strictly after inv
+        if o.ret != INF:
+            events.append((o.ret + 1, -1))  # …through its return event
+    events.sort()
+    best = cur = 0
+    ei = 0
+    for r in rets:
+        while ei < len(events) and events[ei][0] <= r:
+            cur += events[ei][1]
+            ei += 1
+        best = max(best, cur)
+    return best
+
+
+@dataclass
+class Bucket:
+    """One shape bucket: every sub-history sharing (model, n_ops bucket,
+    capacity bucket, candidate-width bucket) rides one packed batch
+    through ONE cached XLA program."""
+
+    model_key: tuple
+    n: int
+    capacity: int
+    cands: int
+    batch: WglBatch
+    members: list  # [(decomp_idx, sub_idx)] aligned with the batch axis
+
+
+def bucketize(
+    decomps: Sequence[Decomposition],
+    capacity_cap: int | None = None,
+    capacity_override: int | None = None,
+    pad_to: int = 1,
+    to_device: bool = True,
+) -> list[Bucket]:
+    """Pool every non-trivial sub-history of ``decomps`` into shape
+    buckets.  ``capacity_cap`` clamps the width-derived capacity (test
+    hook for the overflow contract); ``capacity_override`` pins it (the
+    escalation pass).  ``pad_to`` pads each bucket's batch axis to a
+    multiple (mesh hist-extent divisibility); pad rows are empty
+    sub-histories that check trivially valid and are never read back."""
+    groups: dict[tuple, list] = {}
+    for di, d in enumerate(decomps):
+        if not d.sound:
+            raise ValueError(
+                f"decomposition {di} is unsound ({d.reason}); the caller "
+                "must keep the monolithic engine"
+            )
+        for si, sub in enumerate(d.subs):
+            if sub.trivial:
+                continue
+            cap = (
+                capacity_override
+                if capacity_override is not None
+                else capacity_for(sub.width)
+            )
+            if capacity_cap is not None:
+                cap = min(cap, capacity_cap)
+            key = (
+                d.model_key,
+                _pow2ceil(max(len(sub.ops), 1), floor=8),
+                cap,
+                _pow2ceil(max(_max_concurrency(sub.ops), 1), floor=4),
+            )
+            groups.setdefault(key, []).append((di, si, sub))
+    out = []
+    for (model_key, n, cap, cands), members in groups.items():
+        opss = [sub.ops for _, _, sub in members]
+        if pad_to > 1 and len(opss) % pad_to:
+            opss = opss + [[]] * (pad_to - len(opss) % pad_to)
+        batch = pack_wgl_batch(
+            opss, max_cands=cands, length=n, to_device=to_device
+        )
+        out.append(
+            Bucket(
+                model_key=model_key,
+                n=n,
+                capacity=cap,
+                cands=cands,
+                batch=batch,
+                members=[(di, si) for di, si, _ in members],
+            )
+        )
+    return out
+
+
+def run_bucket(bucket: Bucket) -> tuple:
+    """Dispatch one bucket's vmapped search and return the RAW device
+    arrays ``(ok, overflow)`` — a genuinely asynchronous JAX dispatch,
+    so a loop over buckets enqueues all programs before any result is
+    needed and the pipeline family's check stage keeps its overlap
+    (``wgl_tensor_check`` would block on its numpy conversion).
+    :func:`combine_buckets` folds in the host-side ``cand_overflow``
+    flag and applies the ``ok & ~unknown`` masking."""
+    from jepsen_tpu.checkers.wgl import _wgl_program_cached
+
+    prog = _wgl_program_cached(
+        bucket.model_key,
+        bucket.batch.n,
+        bucket.capacity,
+        int(bucket.batch.cands.shape[-1]),
+    )
+    return prog(
+        bucket.batch.f,
+        bucket.batch.a0,
+        bucket.batch.a1,
+        bucket.batch.ret_op,
+        bucket.batch.cands,
+    )
+
+
+def combine_buckets(
+    decomps: Sequence[Decomposition],
+    buckets: Sequence[Bucket],
+    results: Sequence[tuple],
+) -> tuple[np.ndarray, np.ndarray, list[dict]]:
+    """Fold per-sub verdicts back into per-history ``(ok, unknown,
+    info)``.  A history is valid iff EVERY class is (plus the FIFO host
+    order check); any overflowed class makes the WHOLE history unknown
+    with that class identified — never a silent per-piece skip."""
+    B = len(decomps)
+    ok = np.ones(B, bool)
+    unknown = np.zeros(B, bool)
+    invalid = np.zeros(B, bool)
+    info: list[dict] = [
+        {
+            "subhistories": len(d.subs),
+            "trivial": d.n_trivial,
+            "max-capacity": 0,
+            "overflow-class": None,
+        }
+        for d in decomps
+    ]
+    for bucket, (b_ok_raw, b_ovf_raw) in zip(buckets, results):
+        # fold the packer's host-side candidate-truncation flag into
+        # unknown, exactly like wgl_tensor_check
+        b_ovf = np.asarray(b_ovf_raw) | np.asarray(
+            bucket.batch.cand_overflow
+        )
+        b_ok = np.asarray(b_ok_raw) & ~b_ovf
+        for row, (di, si) in enumerate(bucket.members):
+            inf = info[di]
+            inf["max-capacity"] = max(inf["max-capacity"], bucket.capacity)
+            if b_ovf[row]:
+                unknown[di] = True
+                if inf["overflow-class"] is None:
+                    inf["overflow-class"] = decomps[di].subs[si].class_id
+                # which classes overflowed — the escalation pass re-runs
+                # ONLY these (popped before info reaches callers)
+                inf.setdefault("_overflow_subs", []).append(si)
+            elif not b_ok[row]:
+                invalid[di] = True
+                inf.setdefault(
+                    "first-invalid-class", decomps[di].subs[si].class_id
+                )
+    for di, d in enumerate(decomps):
+        if d.order_ok is False:
+            invalid[di] = True
+            info[di]["order-violation"] = d.order_violation
+    # P-compositionality: ONE refuted projection refutes the whole
+    # history, regardless of other classes being undecided — a proven
+    # violation must never be downgraded to unknown by a neighboring
+    # class's overflow.  An unknown with no refuted class stays
+    # undecided (not a pass, not a violation).
+    unknown &= ~invalid
+    ok = ~invalid & ~unknown
+    return ok, unknown, info
+
+
+def finish_buckets(
+    decomps: Sequence[Decomposition],
+    buckets: Sequence[Bucket],
+    results: Sequence[tuple],
+    escalate: bool = True,
+) -> tuple[np.ndarray, np.ndarray, list[dict]]:
+    """Combine collected bucket results, then (``escalate=True``) re-run
+    overflowed sub-histories ONCE at :data:`MAX_SUB_CAPACITY` before
+    reporting unknown — the width heuristic under-sizes rare shapes
+    (e.g. dense concurrency without indeterminacy) and one retry is far
+    cheaper than the CPU fallback.  Shared by the serial
+    :func:`pcomp_tensor_check` and the pipeline family's convert stage.
+    """
+    ok, unknown, info = combine_buckets(decomps, buckets, results)
+    if escalate and unknown.any():
+        retry_cap = MAX_SUB_CAPACITY
+        retry: list[Decomposition] = []
+        index: list[int] = []
+        for di in np.nonzero(unknown)[0]:
+            di = int(di)
+            d = decomps[di]
+            if info[di]["max-capacity"] >= retry_cap:
+                continue
+            # re-run ONLY the overflowed classes — the first pass
+            # already decided the rest (all valid there: an invalid
+            # class wins outright and its history is never retried),
+            # so re-packing every class at 1024 rows would waste ~64×
+            # the frontier work and fresh compiles for nothing
+            subs = [
+                d.subs[si] for si in info[di].get("_overflow_subs", ())
+            ]
+            if not subs:
+                continue
+            retry.append(
+                Decomposition(
+                    subs=subs,
+                    model_key=d.model_key,
+                    sound=True,
+                    kind=d.kind,
+                    n_ops=d.n_ops,
+                )
+            )
+            index.append(di)
+        if retry:
+            buckets2 = bucketize(retry, capacity_override=retry_cap)
+            results2 = [run_bucket(b) for b in buckets2]
+            ok2, unknown2, info2 = combine_buckets(retry, buckets2, results2)
+            for j, di in enumerate(index):
+                ok[di] = bool(ok2[j])
+                unknown[di] = bool(unknown2[j])
+                inf = info[di]
+                inf["overflow-class"] = info2[j]["overflow-class"]
+                inf["max-capacity"] = max(
+                    inf["max-capacity"], info2[j]["max-capacity"]
+                )
+                if "first-invalid-class" in info2[j]:
+                    inf["first-invalid-class"] = info2[j][
+                        "first-invalid-class"
+                    ]
+                inf["escalated"] = True
+    for inf in info:
+        inf.pop("_overflow_subs", None)
+    return ok, unknown, info
+
+
+def pcomp_tensor_check(
+    decomps: Sequence[Decomposition],
+    capacity_cap: int | None = None,
+    escalate: bool = True,
+) -> tuple[np.ndarray, np.ndarray, list[dict]]:
+    """Check many decomposed histories at once: every sub-history of
+    every history pools into shared shape buckets, each bucket one
+    vmapped dispatch of the cached frontier program.  Returns per-
+    history ``(ok[B], unknown[B], info[B])``."""
+    buckets = bucketize(decomps, capacity_cap=capacity_cap)
+    results = [run_bucket(b) for b in buckets]  # dispatch all, then sync
+    return finish_buckets(
+        decomps, buckets, results,
+        escalate=escalate and capacity_cap is None,
+    )
+
+
+def pcomp_check_cpu(
+    ops: Sequence[WglOp], model_key, max_configs: int = 200_000
+) -> dict:
+    """Classic (exact host) search THROUGH the decomposition: the CPU
+    twin of the tensor pcomp path, and the escape hatch the tensor path
+    falls back to.  Per-class searches keep multi-lock mutex histories
+    correct (a monolithic single-lock model would read overlapping
+    holds on DIFFERENT locks as a double grant) and keep the fallback's
+    cost per-class instead of 2^w-global.  Unsound decompositions run
+    the plain monolithic classic search."""
+    from jepsen_tpu.checkers.wgl import check_wgl_cpu
+
+    d = decompose(ops, model_key)
+    if not d.sound:
+        cls, args = model_key
+        r = check_wgl_cpu(ops, cls(*args), max_configs=max_configs)
+        r["engine"] = "cpu"
+        return r
+    cls, args = d.model_key
+    explored = 0
+    capped = None  # first class whose search hit the config cap
+    for sub in d.subs:
+        if sub.trivial:
+            continue
+        r = check_wgl_cpu(sub.ops, cls(*args), max_configs=max_configs)
+        explored += r["configs-explored"]
+        if r[VALID] is False:
+            # one refuted projection refutes the whole history — even
+            # when some OTHER class's search was capped (invalid beats
+            # unknown, same rule as combine_buckets)
+            r = dict(r)
+            r["engine"] = "cpu"
+            r["decomposition"] = d.kind
+            r["configs-explored"] = explored
+            r["invalid-class"] = sub.class_id
+            return r
+        if r[VALID] is not True and capped is None:
+            capped = (dict(r), sub.class_id)
+    if capped is not None and d.order_ok is not False:
+        r, class_id = capped
+        r["engine"] = "cpu"
+        r["decomposition"] = d.kind
+        r["configs-explored"] = explored
+        r["overflow-class"] = class_id
+        return r
+    out = {
+        VALID: True,
+        "unknown": False,
+        "final-op": None,
+        "configs-explored": explored,
+        "engine": "cpu",
+        "decomposition": d.kind,
+        "subhistories": len(d.subs),
+    }
+    if d.order_ok is False:
+        out[VALID] = False
+        out["order-violation"] = list(d.order_violation or ())
+    return out
+
+
+def pcomp_result(
+    d: Decomposition, ok: bool, unknown: bool, inf: dict
+) -> dict:
+    """One history's checker-protocol result dict from its combined
+    pcomp verdict."""
+    r = {
+        VALID: UNKNOWN if unknown else bool(ok),
+        "unknown": bool(unknown),
+        "engine": "tpu-pcomp",
+        "decomposition": d.kind,
+        "subhistories": inf["subhistories"],
+        "sub-capacity": inf["max-capacity"],
+    }
+    if unknown:
+        r["overflow-class"] = inf["overflow-class"]
+    if d.order_ok is False:
+        r["order-violation"] = list(d.order_violation or ())
+    if "first-invalid-class" in inf:
+        r["invalid-class"] = inf["first-invalid-class"]
+    return r
+
+
+def pcomp_check_ops(ops: Sequence[WglOp], model_key) -> dict | None:
+    """Single-history front door for the checker wrappers: decompose,
+    check, combine.  Returns None when the decomposition is unsound for
+    this model/history (caller keeps the monolithic engine); otherwise
+    the checker-protocol result dict (``valid?`` may be ``"unknown"``
+    with the offending class identified — the caller's CPU escape
+    hatch then decides)."""
+    d = decompose(ops, model_key)
+    if not d.sound:
+        return None
+    ok, unknown, info = pcomp_tensor_check([d])
+    return pcomp_result(d, bool(ok[0]), bool(unknown[0]), info[0])
+
+
+# ---------------------------------------------------------------------------
+# mutex WGL cells: the family's zero-copy substrate (SEC_WGL in .jtc)
+# ---------------------------------------------------------------------------
+
+#: cell schema — one row per acquire/release completion that can
+#: constrain a search (OK or INFO; FAIL never happened in either model)
+CELL_COLUMNS = ("f", "process", "token", "type", "inv", "ret", "key", "pad")
+
+_I32_MIN, _I32_MAX = -(2**31), 2**31 - 1
+
+
+def wgl_cells_for(history: Sequence[Op]) -> np.ndarray | None:
+    """``[n, 8]`` int32 WGL cell matrix of a mutex history: ``(f01,
+    process, token, type, inv, ret, key, 0)`` per OK/INFO
+    acquire/release completion — enough to derive BOTH model mappings
+    (:func:`mutex_ops_from_cells`) without the Op objects.  ``token``
+    is ``-1`` when absent.  Positions count ALL history entries (the
+    same enumerate the op mappers use).  Returns None when a field
+    does not fit int32 (unrepresentable — callers keep the op path).
+    Bit-identical native twin: ``rows_packer.cpp::jt_wgl_cells_file``.
+    """
+    rows: list[tuple] = []
+    open_inv: dict[int, int] = {}
+    for pos, op in enumerate(history):
+        if op.f not in (OpF.ACQUIRE, OpF.RELEASE):
+            continue
+        if op.type == OpType.INVOKE:
+            open_inv[op.process] = pos
+            continue
+        inv = open_inv.pop(op.process, -1)
+        if op.type not in (OpType.OK, OpType.INFO):
+            continue
+        key, token = mutex_key_token(op.value)
+        row = (
+            0 if op.f == OpF.ACQUIRE else 1,
+            op.process,
+            token,
+            int(op.type),
+            inv,
+            pos,
+            key,
+            0,
+        )
+        if any(not (_I32_MIN <= v <= _I32_MAX) for v in row):
+            return None
+        rows.append(row)
+    return np.asarray(rows, np.int32).reshape(-1, len(CELL_COLUMNS))
+
+
+def cells_fenced(cells: np.ndarray) -> bool:
+    """Fenced-history detection from cells (twin of
+    ``mutex_history_is_fenced``): any OK acquire carrying a token."""
+    if cells.shape[0] == 0:
+        return False
+    return bool(
+        (
+            (cells[:, 0] == 0)
+            & (cells[:, 3] == int(OpType.OK))
+            & (cells[:, 2] >= 0)
+        ).any()
+    )
+
+
+def mutex_ops_from_cells(
+    cells: np.ndarray, fenced: bool | None = None
+) -> tuple[list[WglOp], tuple]:
+    """``(wgl_ops, model_key)`` from a cell matrix — the same ops the
+    Op-based mappers produce (differential contract in
+    ``tests/test_wgl_pcomp.py``).  ``fenced=None`` auto-detects."""
+    if fenced is None:
+        fenced = cells_fenced(cells)
+    out: list[WglOp] = []
+    for f01, proc, token, typ, inv, ret, key, _pad in cells.tolist():
+        if fenced:
+            if typ != int(OpType.OK) or token < 0:
+                continue
+            out.append(
+                WglOp(
+                    Call(
+                        FencedMutex.ACQUIRE if f01 == 0
+                        else FencedMutex.RELEASE,
+                        a0=proc,
+                        a1=token,
+                    ),
+                    inv,
+                    ret,
+                    key=key,
+                )
+            )
+        else:
+            call = Call(
+                OwnedMutex.ACQUIRE if f01 == 0 else OwnedMutex.RELEASE,
+                a0=proc,
+            )
+            if typ == int(OpType.OK):
+                out.append(WglOp(call, inv, ret, key=key))
+            elif typ == int(OpType.INFO):
+                out.append(WglOp(call, inv, INF, key=key))
+    return out, ((FencedMutex, ()) if fenced else (OwnedMutex, ()))

@@ -117,12 +117,18 @@ def _pipelined_checkers(args, workload: str, hpath) -> dict | None:
         args.checker != "tpu"
         or getattr(args, "serial", False)
         or hpath is None
-        or workload not in ("queue", "stream", "elle")
+        or workload not in ("queue", "stream", "elle", "mutex")
     ):
         return None
     from jepsen_tpu.parallel.pipeline import PipelinedChecker
 
     shared: dict = {}
+    if workload == "mutex":
+        if getattr(args, "no_pcomp", False):
+            return None  # --no-pcomp: the monolithic MutexWgl path
+        return {
+            "mutex": PipelinedChecker("mutex", hpath, "mutex", shared=shared)
+        }
     if workload == "queue":
         opts = {"delivery": getattr(args, "delivery", None) or "exactly-once"}
         return {
@@ -164,7 +170,10 @@ def _checker_for(args, out_dir=None, history=None, hpath=None):
         if workload == "queue" and getattr(args, "wgl", False):
             from jepsen_tpu.checkers.wgl import QueueWgl
 
-            checkers["wgl"] = QueueWgl(backend=backend)
+            checkers["wgl"] = QueueWgl(
+                backend=backend,
+                pcomp=not getattr(args, "no_pcomp", False),
+            )
         return compose(checkers)
     if workload == "stream":
         from jepsen_tpu.checkers.stream_lin import StreamLinearizability
@@ -198,7 +207,10 @@ def _checker_for(args, out_dir=None, history=None, hpath=None):
         return compose(
             {
                 "perf": Perf(out_dir=out_dir),
-                "mutex": MutexWgl(backend=backend),
+                "mutex": MutexWgl(
+                    backend=backend,
+                    pcomp=not getattr(args, "no_pcomp", False),
+                ),
             }
         )
     checkers = {
@@ -212,7 +224,9 @@ def _checker_for(args, out_dir=None, history=None, hpath=None):
     if getattr(args, "wgl", False):
         from jepsen_tpu.checkers.wgl import QueueWgl
 
-        checkers["wgl"] = QueueWgl(backend=backend)
+        checkers["wgl"] = QueueWgl(
+            backend=backend, pcomp=not getattr(args, "no_pcomp", False)
+        )
     return compose(checkers)
 
 
@@ -442,11 +456,15 @@ def _cmd_bench_check_pipeline(args) -> int:
     workload = getattr(args, "workload", "auto")
     if workload == "auto":
         workload = max(sorted(set(kinds)), key=kinds.count)
-    if workload == "mutex":
+    if workload == "mutex" and getattr(args, "engine", "pcomp") != "pcomp":
+        # an explicit --engine classic/tensor must be HONORED, not
+        # silently swapped for pcomp (the engine field exists so
+        # classic-vs-tensor-vs-pcomp numbers can never be conflated) —
+        # those engines run through the standard batched path
         print(
-            "# the mutex family's perf path is the classic host search "
-            "(WGL_BENCH.md); --pipeline applies to queue/stream/elle — "
-            "running the standard path",
+            f"# --pipeline runs the mutex family's pcomp engine; "
+            f"--engine {args.engine} requested — running the standard "
+            f"path instead",
             file=sys.stderr,
         )
         return cmd_bench_check(args, _pipeline=False)
@@ -454,6 +472,13 @@ def _cmd_bench_check_pipeline(args) -> int:
     if keep is None:
         return 2
     opts: dict = {}
+    if workload == "mutex" and getattr(args, "reduce", False):
+        print(
+            "error: the mutex family has no reduce mode (its device "
+            "batch axis is the sub-history axis, not the history axis)",
+            file=sys.stderr,
+        )
+        return 2
     if workload == "queue":
         opts["delivery"] = getattr(args, "delivery", None) or "exactly-once"
     elif workload == "stream":
@@ -503,7 +528,7 @@ def _cmd_bench_check_pipeline(args) -> int:
                 )
             )
         else:
-            key = "stream" if workload == "stream" else "elle"
+            key = workload  # stream / elle / mutex: one sub-verdict key
             n_invalid = sum(
                 1 for r in results if r[key]["valid?"] is not True
             )
@@ -906,7 +931,29 @@ def cmd_bench_check(args, _pipeline: bool | None = None) -> int:
             else (mutex_wgl_ops(h), OwnedMutex)
             for h in histories
         ]
-        if getattr(args, "engine", "classic") == "tensor":
+        engine = getattr(args, "engine", "pcomp")
+        if engine == "pcomp":
+            # the default: P-compositional decomposition — every
+            # history's per-class sub-histories pool into shape buckets
+            # and check as thousands of narrow vmapped frontiers
+            # (WGL_BENCH.md round 6: the measured fast path on hard
+            # histories, on BOTH backends)
+            from jepsen_tpu.checkers.wgl_pcomp import (
+                decompose,
+                pcomp_tensor_check,
+            )
+
+            decomps = [
+                decompose(ops, (model, ())) for ops, model in pairs
+            ]
+            t_pack = time.perf_counter() - t0
+            pcomp_tensor_check(decomps)  # compile
+            t1 = time.perf_counter()
+            ok, unknown, _info = pcomp_tensor_check(decomps)
+            n_invalid = int((~ok & ~unknown).sum())
+            n_unknown = int(unknown.sum())
+            t_check = time.perf_counter() - t1
+        elif engine == "tensor":
             # opt-in ONLY: the batched frontier-bitset device search —
             # measured ~650x slower per history than the classic host
             # search on this family (WGL_BENCH.md re-scope); it exists
@@ -929,8 +976,8 @@ def cmd_bench_check(args, _pipeline: bool | None = None) -> int:
                 n_unknown += int(unknown.sum())
             t_check = time.perf_counter() - t1
         else:
-            # the perf path (default): the classic Wing-Gong host search
-            # wins on the mutex family at every measured configuration
+            # the classic Wing-Gong host search — still the fastest
+            # single-history engine on easy histories (WGL_BENCH.md)
             t_pack = time.perf_counter() - t0
             t1 = time.perf_counter()
             results = [
@@ -1070,7 +1117,7 @@ def cmd_bench_check(args, _pipeline: bool | None = None) -> int:
         # neither a pass nor a violation — surface it.  The engine field
         # keeps classic-vs-tensor numbers from ever being conflated.
         stats_extra["unknown"] = n_unknown
-        stats_extra["engine"] = getattr(args, "engine", "classic")
+        stats_extra["engine"] = getattr(args, "engine", "pcomp")
     print(
         json.dumps(
             {
@@ -1517,8 +1564,18 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="triage escape hatch: check from re-packed Op objects on "
         "the calling thread instead of the bytes-to-verdict pipeline "
-        "executor (--checker tpu routes queue/stream/elle through "
+        "executor (--checker tpu routes queue/stream/elle/mutex through "
         "parallel/pipeline.py by default; results are identical)",
+    )
+    c.add_argument(
+        "--no-pcomp",
+        dest="no_pcomp",
+        action="store_true",
+        help="mutex/queue WGL: disable the P-compositional decomposition "
+        "(checkers/wgl_pcomp.py — thousands of narrow per-class "
+        "frontiers, the measured fast path) and run the monolithic "
+        "engine instead; verdicts are identical on single-lock "
+        "histories (differential gate in tests/test_wgl_pcomp.py)",
     )
     c.add_argument(
         "--workload",
@@ -1554,12 +1611,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     b.add_argument(
         "--engine",
-        choices=("classic", "tensor"),
-        default="classic",
-        help="mutex workload only: 'classic' (default) is the Wing-Gong "
-        "host search — the measured perf path for this family; 'tensor' "
-        "opts into the batched device frontier search (~650x slower per "
-        "history, kept for general-model correctness; WGL_BENCH.md)",
+        choices=("classic", "tensor", "pcomp"),
+        default="pcomp",
+        help="mutex workload only: 'pcomp' (default) decomposes each "
+        "history into per-class sub-histories and vmaps narrow frontier "
+        "searches over them (checkers/wgl_pcomp.py — the measured fast "
+        "path, WGL_BENCH.md round 6); 'classic' is the monolithic "
+        "Wing-Gong host search; 'tensor' the monolithic batched device "
+        "frontier search (kept for general-model correctness)",
     )
     b.add_argument(
         "--profile",

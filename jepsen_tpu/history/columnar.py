@@ -80,6 +80,9 @@ SEC_STREAM = 2  # [n, 6] int32 — stream_lin._stream_rows schema
 SEC_EMOPS = 3  # [M, 8] int32 — elle micro-op cells (elle_mops_for)
 SEC_EMOPS_TXN = 4  # [n] int64 — elle txn_index (true n_txns in flags)
 SEC_EMOPS_KEYS = 5  # [k] int64 — elle dense key table
+SEC_WGL = 6  # [n, 8] int32 — mutex WGL cells (wgl_pcomp.wgl_cells_for:
+#              f01/process/token/type/inv/ret/key/pad — the mutex
+#              family's substrate for the P-compositional search)
 
 FLAG_STREAM_FULL = 1
 FLAG_EMOPS_DEGENERATE = 1
@@ -150,6 +153,10 @@ class Jtc:
         if cols is None:
             return None
         return cols, bool(self.flags.get(SEC_STREAM, 0) & FLAG_STREAM_FULL)
+
+    def wgl_cells(self) -> np.ndarray | None:
+        """The ``[n, 8]`` mutex WGL cell matrix, or None if absent."""
+        return self.arrays.get(SEC_WGL)
 
     def emops(self):
         """``(cell matrix, ElleMopsMeta)`` for an elle history, or None."""
@@ -349,13 +356,15 @@ def consult(src_path: str | Path) -> Jtc | None:
 # ---------------------------------------------------------------------------
 
 
-def _coerce_sections(rows, stream, emops) -> list | None:
+def _coerce_sections(rows, stream, emops, wgl=None) -> list | None:
     """``(kind, arr, flags)`` triples from the family substrates; None
     when a substrate cannot be represented (e.g. non-int elle keys —
     the same refusal as the npz saver)."""
     secs = []
     if rows is not None:
         secs.append((SEC_QROWS, np.ascontiguousarray(rows, np.int32), 0))
+    if wgl is not None:
+        secs.append((SEC_WGL, np.ascontiguousarray(wgl, np.int32), 0))
     if stream is not None:
         cols, full = stream
         secs.append((
@@ -392,6 +401,7 @@ def write_jtc(
     rows: np.ndarray | None = None,
     stream: tuple | None = None,
     emops: tuple | None = None,
+    wgl: np.ndarray | None = None,
 ) -> Path:
     """Write (replace) the sibling ``.jtc`` for ``src_path`` holding the
     given substrate sections, stamped against the source's current
@@ -402,7 +412,7 @@ def write_jtc(
     torn or bit-flipped write can never be installed.  Raises on any
     failure (use :func:`update_jtc` for the best-effort cache path)."""
     src = Path(src_path)
-    secs = _coerce_sections(rows, stream, emops)
+    secs = _coerce_sections(rows, stream, emops, wgl)
     if secs is None:
         raise ValueError(f"{src}: substrate not representable as .jtc")
     if not secs:
@@ -472,6 +482,7 @@ def update_jtc(
     rows: np.ndarray | None = None,
     stream: tuple | None = None,
     emops: tuple | None = None,
+    wgl: np.ndarray | None = None,
 ) -> bool:
     """Best-effort merge of sections into the sibling ``.jtc`` (the
     unified SAVE path of the three legacy cache families): existing
@@ -495,10 +506,14 @@ def update_jtc(
             stream = existing.stream()
         if emops is None:
             emops = existing.emops()
+        if wgl is None:
+            wgl = existing.wgl_cells()
         if workload is None:
             workload = existing.workload
     try:
-        write_jtc(src, workload, rows=rows, stream=stream, emops=emops)
+        write_jtc(
+            src, workload, rows=rows, stream=stream, emops=emops, wgl=wgl
+        )
         return True
     except (OSError, ValueError):
         return False
@@ -534,8 +549,21 @@ def pack_jtc(
             history = read_history(src)
         workload = workload_of(history)
         rows = _rows_for(history)
-    stream = emops = None
-    if workload == "stream":
+    stream = emops = wgl = None
+    if workload == "mutex":
+        if history is None:
+            from jepsen_tpu.history.fastpack import wgl_cells_file
+
+            wgl = wgl_cells_file(src)
+        if wgl is None:
+            from jepsen_tpu.checkers.wgl_pcomp import wgl_cells_for
+            from jepsen_tpu.history.store import read_history
+
+            if history is None:
+                history = read_history(src)
+            wgl = wgl_cells_for(history)  # None: unrepresentable —
+            #                               rows section still lands
+    elif workload == "stream":
         stream = None
         if history is None:
             from jepsen_tpu.history.fastpack import stream_rows_file
@@ -563,4 +591,6 @@ def pack_jtc(
             emops = elle_mops_for(history)
         if _coerce_sections(None, None, emops) is None:
             emops = None  # non-int keys: rows section still lands
-    return write_jtc(src, workload, rows=rows, stream=stream, emops=emops)
+    return write_jtc(
+        src, workload, rows=rows, stream=stream, emops=emops, wgl=wgl
+    )

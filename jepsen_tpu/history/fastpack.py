@@ -98,6 +98,15 @@ class _JtStreamResult(ctypes.Structure):
     ]
 
 
+class _JtWglResult(ctypes.Structure):
+    _fields_ = [
+        ("cells", ctypes.POINTER(ctypes.c_int32)),
+        ("n_rows", ctypes.c_int64),
+        ("err", ctypes.c_int32),
+        ("err_line", ctypes.c_int64),
+    ]
+
+
 def _load() -> ctypes.CDLL | None:
     """The packer library, building it on first use; None (sticky) when
     it cannot be built/loaded — packing then stays pure-Python."""
@@ -175,6 +184,22 @@ def _load() -> ctypes.CDLL | None:
     try:  # .jtc substrate toggle (PR 7); absent from a stale build
         lib.jt_jtc_disable.restype = None
         lib.jt_jtc_disable.argtypes = [ctypes.c_int32]
+    except AttributeError:
+        pass
+    try:  # mutex WGL cell emission (the pcomp substrate); absent from a
+        # stale build: wgl_cells_file degrades to None (Python twin)
+        lib.jt_wgl_cells_file.restype = ctypes.POINTER(_JtWglResult)
+        lib.jt_wgl_cells_file.argtypes = [ctypes.c_char_p]
+        lib.jt_wgl_cells_free.restype = None
+        lib.jt_wgl_cells_free.argtypes = [ctypes.POINTER(_JtWglResult)]
+        lib.jt_wgl_cells_files.restype = ctypes.POINTER(
+            ctypes.POINTER(_JtWglResult)
+        )
+        lib.jt_wgl_cells_files.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
     except AttributeError:
         pass
     _lib = lib
@@ -363,6 +388,36 @@ def _conv_stream(r) -> tuple[np.ndarray, bool] | None:
     return cols, bool(r.full_read)
 
 
+def wgl_cells_file(jsonl_path: str | Path) -> np.ndarray | None:
+    """``[n, 8]`` mutex WGL cell matrix for a JSONL history via the
+    native emission (``jt_wgl_cells_file`` — the JSONL parse +
+    ``wgl_cells_for`` fused; serves a stat-fresh ``.jtc`` ``SEC_WGL``
+    block with no parse at all), or None on any fallback condition.
+    Bit-identical to the Python twin (tests/test_wgl_pcomp.py)."""
+    got = _gate(jsonl_path)
+    if got is None:
+        return None
+    lib, p = got
+    if not hasattr(lib, "jt_wgl_cells_file"):
+        return None  # stale pre-pcomp build (see _load)
+    res = lib.jt_wgl_cells_file(str(p).encode())
+    if not res:
+        return None
+    try:
+        return _conv_wgl(res.contents)
+    finally:
+        lib.jt_wgl_cells_free(res)
+
+
+def _conv_wgl(r) -> np.ndarray | None:
+    if r.err != 0:
+        return None
+    n = int(r.n_rows)
+    if n == 0:
+        return np.zeros((0, 8), np.int32)
+    return np.ctypeslib.as_array(r.cells, shape=(n, 8)).copy()
+
+
 # ---------------------------------------------------------------------------
 # Thread-pool multi-file entry points (the pipeline executor's host
 # stage): one native call packs a whole chunk of files concurrently —
@@ -507,5 +562,19 @@ def elle_mops_files(
     """Multi-file ``elle_mops_file``: ``[(mat, meta) | None, ...]``."""
     return _files_multi(
         paths, "jt_elle_mops_files", "jt_elle_mops_free", _conv_mops,
+        threads, part, n_parts, use_jtc,
+    )
+
+
+def wgl_cells_files(
+    paths, threads: int = 0, part: int = 0, n_parts: int = 1,
+    use_jtc: bool = True,
+):
+    """Multi-file ``wgl_cells_file``: ``[cells | None, ...]``.  The
+    striped-cursor variant has no native symbol (the mutex family's
+    stores are small); ``_files_multi`` strides in Python over the
+    classic thread-pool entry point instead."""
+    return _files_multi(
+        paths, "jt_wgl_cells_files", "jt_wgl_cells_free", _conv_wgl,
         threads, part, n_parts, use_jtc,
     )

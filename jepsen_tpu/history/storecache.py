@@ -371,6 +371,125 @@ def stream_rows_with_cache(jsonl_path: str | Path, history=None):
     return got[0], got[1], False
 
 
+# ---------------------------------------------------------------------------
+# Mutex WGL cell cache (per run dir): one history's ``[n, 8]`` WGL cell
+# matrix (``checkers/wgl_pcomp.wgl_cells_for`` — the substrate of the
+# P-compositional mutex search; native twin ``jt_wgl_cells_file``),
+# stored as the ``SEC_WGL`` section of the ``.jtc`` columnar substrate —
+# the mutex family's entry into the zero-copy path.  The legacy npz
+# sibling exists only for substrate-disabled runs.
+# ---------------------------------------------------------------------------
+
+WGL_CELLS_CACHE = "wgl_cells.npz"
+
+
+def wgl_cells_cache_path(jsonl_path: str | Path) -> Path:
+    return Path(jsonl_path).with_name(WGL_CELLS_CACHE)
+
+
+def save_wgl_cells_cache(jsonl_path: str | Path, cells) -> None:
+    """Persist one mutex history's WGL cell matrix into the sibling
+    ``.jtc`` (``SEC_WGL``).  Atomic and best-effort; the legacy npz is
+    written only with the substrate disabled (``JEPSEN_TPU_NO_JTC=1``)."""
+    from jepsen_tpu.history import columnar
+    from jepsen_tpu.history.rows import _history_digest
+
+    if cells is None:
+        return  # unrepresentable (out-of-int32 fields): never cached
+    if columnar.update_jtc(
+        jsonl_path, "mutex", wgl=np.asarray(cells, np.int32)
+    ):
+        return
+
+    jsonl_path = Path(jsonl_path)
+    target = wgl_cells_cache_path(jsonl_path)
+    tmp = target.with_name(
+        f"{WGL_CELLS_CACHE}.{os.getpid()}.{threading.get_ident()}.tmp"
+    )
+    try:
+        st = os.stat(jsonl_path)
+        stamp = np.array(
+            [
+                _history_digest(jsonl_path),
+                str(st.st_size),
+                str(st.st_mtime_ns),
+            ]
+        )
+        with open(tmp, "wb") as fh:
+            np.savez(fh, stamp=stamp, cells=np.asarray(cells, np.int32))
+        os.replace(tmp, target)
+    except (OSError, ValueError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_wgl_cells_cache(jsonl_path: str | Path):
+    """The ``[n, 8]`` cell matrix when a fresh cache exists; None when
+    absent, unreadable, or stale.  Consults the ``.jtc`` columnar
+    substrate first (zero-copy mmap view), then the legacy npz; same
+    two-tier freshness as the other per-run caches."""
+    from jepsen_tpu.history import columnar
+    from jepsen_tpu.history.rows import _history_digest
+
+    jtc = columnar.consult(jsonl_path)
+    if jtc is not None:
+        got = jtc.wgl_cells()
+        if got is not None:
+            return got
+
+    jsonl_path = Path(jsonl_path)
+    target = wgl_cells_cache_path(jsonl_path)
+    try:
+        cache_mtime = os.stat(target).st_mtime_ns
+        with np.load(target, allow_pickle=False) as z:
+            stamp = [str(x) for x in z["stamp"]]
+            cells = np.asarray(z["cells"], np.int32)
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        return None
+    if len(stamp) != 3 or cells.ndim != 2 or cells.shape[1] != 8:
+        return None
+    digest, size, mtime_ns = stamp
+    try:
+        st = os.stat(jsonl_path)
+    except OSError:
+        return None
+    if (
+        str(st.st_size) == size
+        and str(st.st_mtime_ns) == mtime_ns
+        and cache_mtime > st.st_mtime_ns
+    ):
+        return cells
+    if digest != _history_digest(jsonl_path):
+        return None
+    return cells
+
+
+def wgl_cells_with_cache(jsonl_path: str | Path, history=None):
+    """Load-through WGL cell cache: ``(cells, was_hit)``.  A miss takes
+    the native emission (``jt_wgl_cells_file``) when available, else
+    the Python twin, and leaves the cache behind for the next check."""
+    cached = load_wgl_cells_cache(jsonl_path)
+    if cached is not None:
+        return cached, True
+    cells = None
+    if history is None:
+        from jepsen_tpu.history.fastpack import wgl_cells_file
+
+        cells = wgl_cells_file(jsonl_path)
+    if cells is None:
+        from jepsen_tpu.checkers.wgl_pcomp import wgl_cells_for
+        from jepsen_tpu.history.store import read_history
+
+        if history is None:
+            history = read_history(jsonl_path)
+        cells = wgl_cells_for(history)
+    if cells is not None:
+        save_wgl_cells_cache(jsonl_path, cells)
+    return cells, False
+
+
 def load_packed_store_cache(
     store_root: str | Path, paths: Sequence[str | Path]
 ):

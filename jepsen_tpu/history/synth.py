@@ -713,6 +713,13 @@ class MutexSynthSpec:
     mean_latency_ns: int = 2_000_000
     seed: int = 0
     double_grant: int = 0
+    #: >1 generates a MULTI-lock history: each op targets one of
+    #: ``n_locks`` independent locks and its completions carry the
+    #: ``[key]`` value convention (checkers/wgl.py mutex_key_token) —
+    #: the shape the P-compositional decomposer splits per key.
+    #: ``n_locks=1`` keeps the classic single-lock histories (and their
+    #: None values) byte-identical.
+    n_locks: int = 1
 
 
 @dataclass
@@ -728,16 +735,22 @@ class MutexSynthHistory:
 def synth_mutex_history(spec: MutexSynthSpec) -> MutexSynthHistory:
     rng = random.Random(spec.seed)
     clock = 0
-    holder: int | None = None
+    # per-lock state (n_locks=1: one entry, identical to the classic
+    # single-lock generator — including the rng stream, which draws the
+    # lock key only when there is more than one lock to draw)
+    holder: dict[int, int | None] = {k: None for k in range(spec.n_locks)}
     # a hold is CERTAIN only when established by an OK grant by a process
-    # with NO indeterminate release anywhere in its past: a pending INFO
-    # release (ret = ∞) may linearize at ANY later point — including
-    # inside a hold its process takes afterwards — silently freeing the
-    # lock and making an injected "double grant" legally linearizable
-    # (seed-34 counterexample from review).  INFO acquires never free a
-    # lock, so they only degrade certainty when they may have TAKEN it.
-    certain = False
-    info_release_ever: set[int] = set()
+    # with NO indeterminate release anywhere in its past (on that lock):
+    # a pending INFO release (ret = ∞) may linearize at ANY later point —
+    # including inside a hold its process takes afterwards — silently
+    # freeing the lock and making an injected "double grant" legally
+    # linearizable (seed-34 counterexample from review).  INFO acquires
+    # never free a lock, so they only degrade certainty when they may
+    # have TAKEN it.
+    certain: dict[int, bool] = {k: False for k in range(spec.n_locks)}
+    info_release_ever: dict[int, set[int]] = {
+        k: set() for k in range(spec.n_locks)
+    }
     ops: list[Op] = []
     out = MutexSynthHistory(ops=ops)
     to_inject = spec.double_grant
@@ -753,48 +766,53 @@ def synth_mutex_history(spec: MutexSynthSpec) -> MutexSynthHistory:
     for _ in range(spec.n_ops):
         p = rng.randrange(spec.n_processes)
         f = rng.choice((OpF.ACQUIRE, OpF.RELEASE))
+        k = rng.randrange(spec.n_locks) if spec.n_locks > 1 else 0
+        val = [k] if spec.n_locks > 1 else None
         t0 = tick()
-        inv = Op.invoke(f, p, time=t0)
+        inv = Op.invoke(f, p, value=val, time=t0)
         ops.append(inv)
         done = t0 + lat()
         if rng.random() < spec.p_info:
             # indeterminate: the effect happens on a coin flip; either
             # way the op MIGHT have happened, so certainty degrades
             if f == OpF.ACQUIRE:
-                if holder is None:
+                if holder[k] is None:
                     if rng.random() < 0.5:
-                        holder = p
-                    certain = False
+                        holder[k] = p
+                    certain[k] = False
             else:
-                info_release_ever.add(p)
-                if holder == p:
+                info_release_ever[k].add(p)
+                if holder[k] == p:
                     if rng.random() < 0.5:
-                        holder = None
-                    certain = False
-            ops.append(inv.complete(OpType.INFO, time=done, error="timeout"))
+                        holder[k] = None
+                    certain[k] = False
+            ops.append(
+                inv.complete(OpType.INFO, value=val, time=done,
+                             error="timeout")
+            )
             continue
         if f == OpF.ACQUIRE:
-            if holder is None:
-                holder = p
-                certain = p not in info_release_ever
-                ops.append(inv.complete(OpType.OK, time=done))
-            elif to_inject > 0 and holder != p and certain:
+            if holder[k] is None:
+                holder[k] = p
+                certain[k] = p not in info_release_ever[k]
+                ops.append(inv.complete(OpType.OK, value=val, time=done))
+            elif to_inject > 0 and holder[k] != p and certain[k]:
                 # injected split-brain: granted while CERTAINLY held —
                 # guaranteed non-linearizable (no pending op can explain
                 # the overlap)
                 to_inject -= 1
                 out.double_grant += 1
-                holder = p
-                certain = p not in info_release_ever
-                ops.append(inv.complete(OpType.OK, time=done))
+                holder[k] = p
+                certain[k] = p not in info_release_ever[k]
+                ops.append(inv.complete(OpType.OK, value=val, time=done))
             else:
                 ops.append(
                     inv.complete(OpType.FAIL, time=done, error="held")
                 )
         else:
-            if holder == p:
-                holder = None
-                ops.append(inv.complete(OpType.OK, time=done))
+            if holder[k] == p:
+                holder[k] = None
+                ops.append(inv.complete(OpType.OK, value=val, time=done))
             else:
                 ops.append(
                     inv.complete(OpType.FAIL, time=done, error="not-held")
@@ -812,3 +830,42 @@ def synth_mutex_batch(
         kw = {**base.__dict__, **overrides, "seed": base.seed + i}
         out.append(synth_mutex_history(MutexSynthSpec(**kw)))
     return out
+
+
+def synth_hard_queue_history(
+    n_ops: int, window: int, seed: int = 0
+) -> list[Op]:
+    """A partition-era quorum-queue history: ``window`` indeterminate
+    enqueues (publish confirms lost in the partition) stay open for the
+    whole run while normal traffic continues.
+
+    This is the shape where the classic Wing-Gong search degrades
+    super-linearly: every one of the ``window`` open enqueues may
+    linearize at any later point or never, so the reachable
+    configuration set sustains ~2^window members through EVERY later
+    return event — the classic search re-expands them per event in
+    Python, the monolithic tensor frontier must carry the same 2^window
+    in its capacity, and the P-compositional decomposition dissolves it
+    entirely (each open enqueue is its own single-op class).  Shared by
+    ``tools/bench_wgl.py`` (the WGL_BENCH.md round-3/round-6 tables)
+    and the differential suite ``tests/test_wgl_pcomp.py``."""
+    rng = random.Random(seed)
+    ops: list[Op] = []
+
+    def t() -> int:
+        return len(ops)
+
+    for i in range(window):
+        p = 100 + i
+        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, p, i + 1, time=t()))
+        ops.append(
+            Op(OpType.INFO, OpF.ENQUEUE, p, i + 1, time=t(), error="timeout")
+        )
+    values = list(range(window + 1, window + 1 + (n_ops // 2)))
+    rng.shuffle(values)
+    for v in values:
+        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, 0, v, time=t()))
+        ops.append(Op(OpType.OK, OpF.ENQUEUE, 0, v, time=t()))
+        ops.append(Op(OpType.INVOKE, OpF.DEQUEUE, 1, None, time=t()))
+        ops.append(Op(OpType.OK, OpF.DEQUEUE, 1, v, time=t()))
+    return ops

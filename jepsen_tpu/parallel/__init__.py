@@ -16,4 +16,5 @@ from jepsen_tpu.parallel.mesh import (  # noqa: F401
     sharded_stream_verdict,
     sharded_total_queue,
     sharded_wgl,
+    sharded_wgl_pcomp,
 )

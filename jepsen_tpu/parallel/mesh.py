@@ -372,6 +372,48 @@ def sharded_wgl(batch, mesh: Mesh, model_key, capacity: int = 128):
     return ok & ~unknown, unknown
 
 
+def sharded_wgl_pcomp(decomps, mesh: Mesh, capacity_cap: int | None = None):
+    """P-compositional WGL over the mesh: the device batch axis is the
+    SUB-HISTORY axis (``checkers/wgl_pcomp.py``), so a handful of
+    histories still fans out into thousands of narrow frontiers that
+    shard over ``hist`` with zero communication — the scaling unit is
+    the class, not the history.  Buckets pad their sub axis to the
+    mesh's hist extent (pad rows are empty sub-histories, trivially
+    valid and never read back).  Returns per-HISTORY ``(ok, unknown,
+    info)`` with the same semantics as ``pcomp_tensor_check``."""
+    import dataclasses
+
+    from jepsen_tpu.checkers.wgl_pcomp import (
+        bucketize,
+        finish_buckets,
+        run_bucket,
+    )
+
+    h = mesh.shape[HIST_AXIS]
+    buckets = bucketize(
+        decomps, capacity_cap=capacity_cap, pad_to=h, to_device=False
+    )
+    placed = []
+    for b in buckets:
+        f, a0, a1, ret_op, cands = _hist_sharded(
+            (b.batch.f, b.batch.a0, b.batch.a1, b.batch.ret_op,
+             b.batch.cands),
+            mesh,
+        )
+        placed.append(
+            dataclasses.replace(
+                b,
+                batch=dataclasses.replace(
+                    b.batch, f=f, a0=a0, a1=a1, ret_op=ret_op, cands=cands
+                ),
+            )
+        )
+    results = [run_bucket(b) for b in placed]
+    return finish_buckets(
+        decomps, placed, results, escalate=capacity_cap is None
+    )
+
+
 def sharded_elle(batch, mesh: Mesh):
     """Elle cycle search over the mesh.  Histories shard over ``hist``;
     when the mesh has a ``seq`` axis the ``[T, T]`` adjacency matrices

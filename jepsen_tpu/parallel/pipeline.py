@@ -552,6 +552,53 @@ def _elle_substrates(
     return out
 
 
+def _wgl_substrates(
+    paths: Sequence[Path],
+    threads: int,
+    use_cache: bool,
+    part: int = 0,
+    n_parts: int = 1,
+):
+    """``[n, 8]`` mutex WGL cell matrices for indices ``part::n_parts``
+    of ``paths`` (default: all), cache → native → Python.  An entry may
+    be None (a history with out-of-int32 fields — unrepresentable as
+    cells); the family producer then derives the ops from the parsed
+    history instead."""
+    from jepsen_tpu.checkers.wgl_pcomp import wgl_cells_for
+    from jepsen_tpu.history.fastpack import wgl_cells_files
+    from jepsen_tpu.history.store import read_history
+    from jepsen_tpu.history.storecache import (
+        load_wgl_cells_cache,
+        save_wgl_cells_cache,
+    )
+
+    stripe = _stripe_indices(len(paths), part, n_parts)
+    out: list = [None] * len(stripe)
+    misses = []
+    if use_cache:
+        for j, i in enumerate(stripe):
+            got = load_wgl_cells_cache(paths[i])
+            if got is not None:
+                out[j] = got
+            else:
+                misses.append(j)
+    else:
+        misses = list(range(len(stripe)))
+    if misses:
+        native = _native_stripe(
+            wgl_cells_files, paths, misses, stripe, threads, part,
+            n_parts, use_jtc=use_cache,
+        )
+        for k, j in enumerate(misses):
+            got = native[k] if native is not None else None
+            if got is None:
+                got = wgl_cells_for(read_history(paths[stripe[j]]))
+            out[j] = got
+            if use_cache and got is not None:
+                save_wgl_cells_cache(paths[stripe[j]], got)
+    return out
+
+
 class _Stripe(Sequence):
     """A work unit of the lanes executor: the ``part``-th residue class
     (mod ``n_parts``) of one SHARED size-ordered path list.  Behaves
@@ -1099,6 +1146,146 @@ def _elle_family(
     return _Family(produce, check, place, convert, collect)
 
 
+def _mutex_family(
+    threads: int,
+    use_cache: bool,
+    mesh=None,
+    donate: bool | None = None,
+    chunk_pad: int = 0,
+    device=None,
+    reduce: bool = False,
+) -> _Family:
+    """The mutex/WGL family: bytes → WGL cells (``SEC_WGL`` of the
+    ``.jtc`` substrate, native ``jt_wgl_cells_files`` thread pool) →
+    P-compositional decomposition → shape-bucketed vmapped frontier
+    searches (``checkers/wgl_pcomp.py``).  An overflowed sub-history
+    surfaces as *unknown* and takes the exact CPU escape hatch inside
+    ``convert`` — the same contract as the serial ``MutexWgl`` checker,
+    never a silent per-piece skip.  ``chunk_pad``/``donate`` are
+    accepted for interface symmetry; bucket shapes are already pinned
+    by the (n_ops, capacity, cands) buckets, so chunk padding adds
+    nothing and donation would alias the shared bucket programs."""
+    import jax
+
+    from jepsen_tpu.checkers.wgl import (
+        fenced_mutex_wgl_ops,
+        mutex_history_is_fenced,
+        mutex_wgl_ops,
+    )
+    from jepsen_tpu.checkers.wgl_pcomp import (
+        bucketize,
+        decompose,
+        finish_buckets,
+        mutex_ops_from_cells,
+        pcomp_check_cpu,
+        pcomp_result,
+        run_bucket,
+    )
+    from jepsen_tpu.history.store import read_history
+    from jepsen_tpu.models.core import FencedMutex, OwnedMutex
+
+    if reduce:
+        raise ValueError(
+            "the mutex family has no reduce mode: the device batch axis "
+            "is the SUB-HISTORY axis, not the history axis, so the "
+            "collective index-pmin would name a class, not a history"
+        )
+    if mesh is not None:
+        from jepsen_tpu.parallel.mesh import HIST_AXIS, _hist_sharded
+
+        mesh_h = mesh.shape[HIST_AXIS]
+    else:
+        mesh_h = 1
+
+    def _ops_of(item):
+        """→ ``(wgl_ops, model_key)`` from a cell matrix or an Op list."""
+        if isinstance(item, np.ndarray):
+            return mutex_ops_from_cells(item)
+        if mutex_history_is_fenced(item):
+            return fenced_mutex_wgl_ops(item), (FencedMutex, ())
+        return mutex_wgl_ops(item), (OwnedMutex, ())
+
+    def produce(chunk):
+        if isinstance(chunk, _Stripe):
+            cells = _wgl_substrates(
+                chunk.paths, threads, use_cache, chunk.part, chunk.n_parts
+            )
+            items = [
+                c if c is not None else read_history(chunk[j])
+                for j, c in enumerate(cells)
+            ]
+        elif chunk and isinstance(chunk[0], (str, Path)):
+            cells = _wgl_substrates(chunk, threads, use_cache)
+            items = [
+                c if c is not None else read_history(p)
+                for c, p in zip(cells, chunk)
+            ]
+        else:
+            items = list(chunk)
+        pairs = [_ops_of(it) for it in items]
+        decomps = [decompose(ops, mk) for ops, mk in pairs]  # per-key:
+        #   always sound for the mutex family
+        buckets = bucketize(decomps, pad_to=mesh_h, to_device=False)
+        return decomps, buckets, pairs
+
+    def _place_batch(b):
+        if mesh is not None:
+            f, a0, a1, ret_op, cands = _hist_sharded(
+                (b.f, b.a0, b.a1, b.ret_op, b.cands), mesh
+            )
+        else:
+            put = _device_put_on(device)
+            f, a0, a1, ret_op, cands = put(
+                (b.f, b.a0, b.a1, b.ret_op, b.cands)
+            )
+        return dataclasses.replace(
+            b, f=f, a0=a0, a1=a1, ret_op=ret_op, cands=cands
+        )
+
+    def place(item):
+        decomps, buckets, pairs = item
+        return (
+            decomps,
+            [
+                dataclasses.replace(bk, batch=_place_batch(bk.batch))
+                for bk in buckets
+            ],
+            pairs,
+        )
+
+    def check(item):
+        decomps, buckets, pairs = item
+        raws = [run_bucket(bk) for bk in buckets]  # async dispatches
+        return decomps, buckets, pairs, raws
+
+    def collect(raw_tuple):
+        decomps, buckets, pairs, raws = raw_tuple
+        jax.block_until_ready(raws)
+        return decomps, buckets, pairs, jax.tree.map(np.asarray, raws)
+
+    def convert(chunk, collected):
+        decomps, buckets, pairs, raws = collected
+        # escalation (rare) re-dispatches on the caller's thread — plain
+        # vmapped programs, no collectives, safe outside the mesh gate
+        ok, unknown, info = finish_buckets(decomps, buckets, raws)
+        out = []
+        for i, d in enumerate(decomps):
+            cls, args = d.model_key
+            r = pcomp_result(d, bool(ok[i]), bool(unknown[i]), info[i])
+            if unknown[i]:
+                # frontier overflow even escalated: the exact CPU search
+                # (itself per-class) decides, the offending class stays
+                # visible
+                cpu = pcomp_check_cpu(pairs[i][0], d.model_key)
+                cpu["pcomp-overflow-class"] = r.get("overflow-class")
+                r = cpu
+            r["model"] = cls.name
+            out.append({"mutex": r})
+        return out[: len(chunk)]
+
+    return _Family(produce, check, place, convert, collect)
+
+
 def family_for(workload: str, **opts) -> _Family:
     common = dict(
         mesh=opts.get("mesh"),
@@ -1128,10 +1315,13 @@ def family_for(workload: str, **opts) -> _Family:
             opts.get("model", "serializable"),
             **common,
         )
-    raise ValueError(
-        f"no pipeline family for workload {workload!r} (the mutex "
-        f"family's perf path is the classic host search — WGL_BENCH.md)"
-    )
+    if workload == "mutex":
+        return _mutex_family(
+            opts.get("threads", 0),
+            opts.get("use_cache", True),
+            **common,
+        )
+    raise ValueError(f"no pipeline family for workload {workload!r}")
 
 
 def _pad_for(chunk: int, opts: dict) -> int:
@@ -1502,6 +1692,10 @@ class PipelinedChecker:
             from jepsen_tpu.history.rows import _rows_for
 
             subs = [_rows_for(history)]
+        elif self.workload == "mutex":
+            # the mutex producer takes Op lists directly (it derives the
+            # model + decomposition from them, same as from cells)
+            subs = [list(history)]
         else:
             from jepsen_tpu.checkers.elle import elle_mops_for
 
@@ -1529,8 +1723,8 @@ def attach_pipelined_checkers(test, workload: str, **scale_opts) -> bool:
     from the checkers being replaced, so the verdict semantics cannot
     drift.  ``scale_opts`` forward scale-out knobs (``lanes`` — 0 = one
     lane per local device) into :func:`check_sources`.  Returns True
-    when the swap applied (False: family has no
-    pipeline — e.g. mutex — or no composed checkers to swap)."""
+    when the swap applied (False: no composed checkers to swap, or an
+    explicitly monolithic mutex checker)."""
     checkers = getattr(getattr(test, "checker", None), "checkers", None)
     if checkers is None:
         return False
@@ -1560,6 +1754,14 @@ def attach_pipelined_checkers(test, workload: str, **scale_opts) -> bool:
         checkers["elle"] = PipelinedChecker(
             "elle", None, "elle", shared=shared, model=model,
             **scale_opts,
+        )
+        return True
+    if workload == "mutex" and "mutex" in checkers:
+        if getattr(checkers["mutex"], "pcomp", True) is False:
+            return False  # an explicitly monolithic checker stays
+        opts = {k: v for k, v in scale_opts.items() if k != "reduce"}
+        checkers["mutex"] = PipelinedChecker(
+            "mutex", None, "mutex", shared=shared, **opts
         )
         return True
     return False

@@ -1717,6 +1717,125 @@ void jt_stream_free(JtStreamResult* r) {
 }
 
 // ---------------------------------------------------------------------------
+// Mutex WGL cells: history.jsonl -> the [n, 8] cell matrix of
+// checkers/wgl_pcomp.py::wgl_cells_for (f01, process, token, type, inv,
+// ret, key, 0) — one row per OK/INFO acquire/release completion with
+// its interval, fencing token, and lock key.  The host substrate of the
+// P-compositional mutex search; served zero-parse from a stat-fresh
+// .jtc SEC_WGL block (kind 6) like the other families.  Same
+// differential/fallback contract as the elle/stream paths.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t* cells;  // n_rows * 8
+  int64_t n_rows;
+  int32_t err;
+  int64_t err_line;
+} JtWglResult;
+
+JtWglResult* jt_wgl_cells_file(const char* path) {
+  auto* res = static_cast<JtWglResult*>(std::calloc(1, sizeof(JtWglResult)));
+  if (!res) return nullptr;
+
+  {
+    JtcView v;
+    int r = jtc_load(path, &v);
+    if (r == 2) {
+      res->err = ERR_JTC;
+      return res;
+    }
+    if (r == 1) {
+      const JtcSec* s = v.find(6 /* SEC_WGL */);
+      if (s && s->dtype == 0 && s->cols == 8) {
+        if (!jtc_copy_i32(v, *s, &res->cells)) {
+          res->err = ERR_IO;
+          return res;
+        }
+        res->n_rows = static_cast<int64_t>(s->rows);
+        return res;
+      }
+      // wgl section absent (non-mutex .jtc, or one written before this
+      // section existed): parse normally
+    }
+  }
+
+  std::vector<int32_t> cells;
+  cells.reserve(1 << 12);
+  std::unordered_map<long long, long long> open_inv;
+  bool range_bad = false;
+
+  auto push = [&](long long f01, long long proc, long long token,
+                  long long typ, long long inv, long long ret,
+                  long long key) {
+    const long long vals[8] = {f01, proc, token, typ, inv, ret, key, 0};
+    for (long long v : vals)
+      if (v > INT32_MAX || v < INT32_MIN) {
+        range_bad = true;  // Python twin returns None (unrepresentable)
+        return;
+      }
+    for (long long v : vals) cells.push_back(static_cast<int32_t>(v));
+  };
+
+  int64_t err_line = 0;
+  int err = for_each_op(
+      path,
+      [&](const OpView& op, long long pos) -> bool {
+        if (op.f != 9 /* acquire */ && op.f != 10 /* release */)
+          return true;
+        if (op.type == 0 /* invoke */) {
+          open_inv[op.process] = pos;
+          return true;
+        }
+        long long inv = -1;
+        auto it = open_inv.find(op.process);
+        if (it != open_inv.end()) {
+          inv = it->second;
+          open_inv.erase(it);
+        }
+        if (op.type != 1 /* ok */ && op.type != 3 /* info */) return true;
+        // mutex_key_token twin: int -> token; [key] -> key; [key, token]
+        long long key = 0, token = -1;
+        const JNode& v = op.value;
+        if (v.k == JNode::INT) {
+          token = v.i;
+        } else if (v.k == JNode::LIST && v.items.size() == 1 &&
+                   v.items[0].k == JNode::INT) {
+          key = v.items[0].i;
+        } else if (v.k == JNode::LIST && v.items.size() == 2 &&
+                   v.items[0].k == JNode::INT &&
+                   v.items[1].k == JNode::INT) {
+          key = v.items[0].i;
+          token = v.items[1].i;
+        }
+        push(op.f == 9 ? 0 : 1, op.process, token, op.type, inv, pos, key);
+        return !range_bad;
+      },
+      &err_line);
+  if (err != OK) {
+    res->err = err;
+    res->err_line = err_line;
+    return res;
+  }
+  if (range_bad) {
+    res->err = ERR_OVERFLOW;  // binding -> None -> Python twin decides
+    return res;
+  }
+  res->cells = copy_i32(cells);
+  res->n_rows = static_cast<int64_t>(cells.size() / 8);
+  if (res->n_rows && !res->cells) {  // malloc failure: see jt_elle note
+    res->err = ERR_IO;
+    res->n_rows = 0;
+  }
+  return res;
+}
+
+void jt_wgl_cells_free(JtWglResult* r) {
+  if (!r) return;
+  std::free(r->cells);
+  std::free(r);
+}
+
+// ---------------------------------------------------------------------------
 // Thread-pool multi-file packing (the pipeline executor's host stage):
 // K history shards packed concurrently, one result slot per input path in
 // a preallocated arena (the returned pointer array).  Workers claim paths
@@ -1794,6 +1913,13 @@ JtElleMopsResult** jt_elle_mops_files(const char* const* paths, int32_t n,
   return reinterpret_cast<JtElleMopsResult**>(
       pack_files_pool<JtElleMopsResult, jt_elle_mops_file>(
           paths, n, threads, 0, 1));
+}
+
+JtWglResult** jt_wgl_cells_files(const char* const* paths, int32_t n,
+                                 int32_t threads) {
+  return reinterpret_cast<JtWglResult**>(
+      pack_files_pool<JtWglResult, jt_wgl_cells_file>(paths, n, threads,
+                                                      0, 1));
 }
 
 // Striped variants (per-device input lanes / per-process file ranges):

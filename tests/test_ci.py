@@ -609,6 +609,44 @@ class TestBenchColdWarmSmoke:
         assert cw["pack_bytes_per_sec"] > 0
         assert cw["columnar_speedup_vs_python_parse"] > 0
 
+    def test_wgl_pcomp_section_schema(self, bench):
+        """Offline gate for the ISSUE-9 ``wgl_pcomp`` bench schema: one
+        tiny real row (n=40, w=2, per-row subprocess with deadline —
+        exactly the production harness) must carry the keys the round-6
+        table and the crossover done-bar read."""
+        details = {}
+        bench._bench_wgl_pcomp(
+            details, rows_spec=((40, 2),), batch=2, deadline=240.0,
+            persist=False,  # the smoke must never touch BENCH_DETAILS
+        )
+        wp = details["wgl_pcomp"]
+        for key in ("rows", "crossover_met", "best_speedup_vs_classic"):
+            assert key in wp, f"wgl_pcomp schema lost key {key!r}"
+        assert len(wp["rows"]) == 1
+        row = wp["rows"][0]
+        for key in (
+            "n_ops",
+            "window",
+            "backend",
+            "compile_s",
+            "pcomp_per_history_ms",
+            "pcomp_subhistories",
+            "pcomp_sub_capacity",
+            "classic_per_history_ms",
+            "classic_samples",
+            "speedup_vs_classic",
+            "winner",
+            "all_linearizable",
+            "unknown_frac",
+        ):
+            assert key in row, f"wgl_pcomp row schema lost key {key!r}"
+        assert row["all_linearizable"] is True
+        assert row["unknown_frac"] == 0.0
+        assert row["winner"] in ("pcomp", "classic")
+        # a tiny easy row must not accidentally claim the ≥1k-op
+        # crossover done-bar
+        assert wp["crossover_met"] is False
+
     def test_jtc_format_version_roundtrip(self, tmp_path):
         """Offline ``.jtc`` round trip under JAX_PLATFORMS=cpu: write →
         structural read → version-bump rejection (the stale-format-

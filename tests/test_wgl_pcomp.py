@@ -1,0 +1,744 @@
+"""P-compositional WGL: decomposer round-trip, the three-engine
+differential gate (pcomp ≡ monolithic tensor ≡ classic CPU), overflow
+honesty (sub overflow ⇒ whole-history unknown with the class named),
+capacity sizing from measured width, the mutex WGL-cell substrate
+(Python ≡ native ≡ .jtc round-trip), the pipeline family, and the
+sharded sub-history axis."""
+
+import json
+import tempfile
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.wgl import (
+    INF,
+    Call,
+    FifoWgl,
+    MutexWgl,
+    QueueWgl,
+    WglOp,
+    check_wgl_cpu,
+    fenced_mutex_wgl_ops,
+    mutex_history_is_fenced,
+    mutex_key_token,
+    mutex_wgl_ops,
+    pack_wgl_batch,
+    queue_wgl_ops,
+    wgl_tensor_check,
+)
+from jepsen_tpu.checkers.wgl_pcomp import (
+    MAX_SUB_CAPACITY,
+    bucketize,
+    capacity_for,
+    cells_fenced,
+    decompose,
+    decomposition_union,
+    mutex_ops_from_cells,
+    pcomp_check_cpu,
+    pcomp_check_ops,
+    pcomp_tensor_check,
+    wgl_cells_for,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+from jepsen_tpu.history.synth import (
+    MutexSynthSpec,
+    SynthSpec,
+    synth_hard_queue_history,
+    synth_history,
+    synth_mutex_batch,
+)
+from jepsen_tpu.models.core import (
+    FencedMutex,
+    FifoQueue,
+    OwnedMutex,
+    UnorderedQueue,
+)
+
+
+def _queue_model_key(opss):
+    vs = 32 * max(
+        1,
+        (max((o.call.a0 for ops in opss for o in ops), default=0) + 32)
+        // 32,
+    )
+    return (UnorderedQueue, (vs,))
+
+
+def _write_jsonl(run_dir: Path, ops) -> Path:
+    p = run_dir / "history.jsonl"
+    with open(p, "w") as fh:
+        for op in ops:
+            row = {
+                "index": op.index,
+                "type": op.type.name.lower(),
+                "f": op.f.name.lower(),
+                "process": op.process,
+                "value": op.value,
+                "time": op.time,
+            }
+            if op.error is not None:
+                row["error"] = op.error
+            fh.write(json.dumps(row) + "\n")
+    return p
+
+
+# ---------------------------------------------------------------------------
+# decomposer
+# ---------------------------------------------------------------------------
+
+
+class TestDecomposer:
+    def test_queue_round_trip_union(self):
+        for seed in (0, 1):
+            ops = queue_wgl_ops(
+                synth_history(SynthSpec(n_ops=120, seed=seed)).ops
+            )
+            d = decompose(ops, _queue_model_key([ops]))
+            assert d.sound and d.kind == "per-value"
+            assert decomposition_union(d) == list(ops)
+
+    def test_hard_history_round_trip_union(self):
+        ops = queue_wgl_ops(synth_hard_queue_history(80, 6, seed=3))
+        d = decompose(ops, _queue_model_key([ops]))
+        assert decomposition_union(d) == list(ops)
+        # every open (indeterminate) enqueue is its own width-1 class
+        open_classes = [s for s in d.subs if s.width]
+        assert len(open_classes) == 6
+        assert all(s.width == 1 for s in open_classes)
+
+    def test_mutex_round_trip_union_multi_lock(self):
+        sh = synth_mutex_batch(
+            1, MutexSynthSpec(n_ops=100), n_locks=3
+        )[0]
+        ops = mutex_wgl_ops(sh.ops)
+        d = decompose(ops, (OwnedMutex, ()))
+        assert d.sound and d.kind == "per-key"
+        assert len(d.subs) == 3
+        assert decomposition_union(d) == list(ops)
+
+    def test_clean_subhistories_fit_capacity_16(self):
+        """Satellite contract: clean classes (width 0) compile at
+        capacity ≤ 16 — the heuristic must come from the MEASURED
+        width, never a global constant."""
+        assert capacity_for(0) == 16
+        ops = queue_wgl_ops(
+            synth_history(SynthSpec(n_ops=160, seed=5)).ops
+        )
+        d = decompose(ops, _queue_model_key([ops]))
+        buckets = bucketize([d])
+        assert buckets, "clean history produced no buckets"
+        assert all(b.capacity == 16 for b in buckets)
+
+    def test_width_scales_capacity(self):
+        assert capacity_for(1) == 16
+        assert capacity_for(2) == 16
+        assert capacity_for(3) == 32
+        assert capacity_for(8) >= 1024 or capacity_for(8) == 1024
+        assert capacity_for(40) == MAX_SUB_CAPACITY
+
+    def test_shared_program_per_bucket(self):
+        """Two different clean histories share ONE cached XLA program
+        per (model, n_ops-bucket, capacity-bucket) — the decomposition
+        must not compile per history."""
+        from jepsen_tpu.checkers.wgl import _wgl_program_cached
+
+        opss = [
+            queue_wgl_ops(synth_history(SynthSpec(n_ops=100, seed=s)).ops)
+            for s in (11, 12)
+        ]
+        mk = _queue_model_key(opss)
+        decomps = [decompose(ops, mk) for ops in opss]
+        pcomp_tensor_check([decomps[0]])
+        before = _wgl_program_cached.cache_info()
+        pcomp_tensor_check([decomps[1]])
+        after = _wgl_program_cached.cache_info()
+        assert after.misses == before.misses, (
+            "second clean history compiled a new program instead of "
+            "hitting the shared (model, n, capacity) bucket"
+        )
+
+    def test_cas_register_is_unsound(self):
+        from jepsen_tpu.models.core import CasRegister
+
+        d = decompose(
+            [WglOp(Call(0, 1), 0, 1)], (CasRegister, (0,))
+        )
+        assert not d.sound and "couple" in d.reason
+
+
+# ---------------------------------------------------------------------------
+# the differential gate: pcomp ≡ monolithic tensor ≡ classic CPU
+# ---------------------------------------------------------------------------
+
+
+class TestDifferentialGate:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_queue_corpus_three_way(self, seed):
+        sh = synth_history(
+            SynthSpec(
+                n_ops=100,
+                seed=400 + seed,
+                duplicated=seed % 2,
+                unexpected=(seed // 2) % 2,
+            )
+        )
+        ops = queue_wgl_ops(sh.ops)
+        mk = _queue_model_key([ops])
+        pc = pcomp_check_ops(ops, mk)
+        batch = pack_wgl_batch([ops])
+        ok, unknown = wgl_tensor_check(batch, mk)
+        cls, args = mk
+        cpu = check_wgl_cpu(ops, cls(*args))
+        assert not unknown[0]
+        assert pc["valid?"] == bool(ok[0]) == cpu["valid?"], (
+            pc, bool(ok[0]), cpu["valid?"],
+        )
+
+    @pytest.mark.parametrize("window", [0, 2, 4])
+    def test_hard_generator_three_way(self, window):
+        ops = queue_wgl_ops(synth_hard_queue_history(60, window, seed=7))
+        mk = _queue_model_key([ops])
+        pc = pcomp_check_ops(ops, mk)
+        batch = pack_wgl_batch([ops])
+        ok, unknown = wgl_tensor_check(batch, mk, capacity=128)
+        cls, args = mk
+        cpu = check_wgl_cpu(ops, cls(*args))
+        assert not unknown[0]
+        assert pc["valid?"] is True
+        assert pc["valid?"] == bool(ok[0]) == cpu["valid?"]
+
+    def test_hard_generator_wide_window_pcomp_vs_classic(self):
+        # w=6 at n=200: the monolithic tensor engine would need a
+        # capacity-256 compile — the classic search is the exact
+        # comparator here (the monolithic column is the round-3 table)
+        ops = queue_wgl_ops(synth_hard_queue_history(200, 6, seed=1))
+        mk = _queue_model_key([ops])
+        pc = pcomp_check_ops(ops, mk)
+        cls, args = mk
+        cpu = check_wgl_cpu(ops, cls(*args))
+        assert pc["valid?"] is True and cpu["valid?"] is True
+
+    @pytest.mark.parametrize("double_grant", [0, 1])
+    def test_mutex_corpus_three_way(self, double_grant):
+        shs = synth_mutex_batch(
+            3, MutexSynthSpec(n_ops=80), double_grant=double_grant
+        )
+        for sh in shs:
+            ops = mutex_wgl_ops(sh.ops)
+            pc = pcomp_check_ops(ops, (OwnedMutex, ()))
+            batch = pack_wgl_batch([ops])
+            ok, unknown = wgl_tensor_check(batch, (OwnedMutex, ()))
+            cpu = check_wgl_cpu(ops, OwnedMutex())
+            assert pc["valid?"] is (sh.double_grant == 0)
+            if not unknown[0]:
+                assert bool(ok[0]) == pc["valid?"]
+            assert cpu["valid?"] == pc["valid?"]
+
+    def test_double_grant_survives_multi_lock_decomposition(self):
+        """The injected split-brain grant must stay refuted when the
+        history spans several locks and the search runs per key."""
+        shs = synth_mutex_batch(
+            4, MutexSynthSpec(n_ops=120, seed=50), n_locks=3,
+            double_grant=1,
+        )
+        shs = [s for s in shs if s.double_grant == 1]
+        assert shs, "no seed injected a certain double grant"
+        for sh in shs:
+            ops = mutex_wgl_ops(sh.ops)
+            pc = pcomp_check_ops(ops, (OwnedMutex, ()))
+            assert pc["valid?"] is False, pc
+            assert "invalid-class" in pc
+            # the per-class classic twin agrees
+            cpu = pcomp_check_cpu(ops, (OwnedMutex, ()))
+            assert cpu["valid?"] is False
+
+    def test_fenced_token_order_violation_survives_decomposition(self):
+        """A token granted twice on ONE key must refute even when the
+        history spans several fenced locks (keys must not launder each
+        other's token order)."""
+        hist = []
+        for key, token in (
+            (0, 5), (1, 3), (0, 9), (1, 7), (2, 4),
+            (1, 7),  # THE BUG: token 7 re-granted on key 1
+        ):
+            inv = Op.invoke(OpF.ACQUIRE, len(hist))
+            hist.append(inv)
+            hist.append(inv.complete(OpType.OK, value=[key, token]))
+        h = reindex(hist)
+        assert mutex_history_is_fenced(h)
+        ops = fenced_mutex_wgl_ops(h)
+        pc = pcomp_check_ops(ops, (FencedMutex, ()))
+        assert pc["valid?"] is False
+        assert pc["invalid-class"] == 1
+        assert pcomp_check_cpu(ops, (FencedMutex, ()))["valid?"] is False
+        # drop the buggy grant: the same multi-key history is legal —
+        # per-key token order holds even though the GLOBAL sequence of
+        # grants (5, 3, 9, 7, 4) is not monotone
+        clean = reindex(h[:-2])
+        pc2 = pcomp_check_ops(
+            fenced_mutex_wgl_ops(clean), (FencedMutex, ())
+        )
+        assert pc2["valid?"] is True
+
+    def test_multi_lock_overlapping_holds_are_legal(self):
+        """Two concurrent holds on DIFFERENT locks are fine; the same
+        shape on one lock is the classic double grant."""
+        two_locks = reindex(
+            [
+                Op.invoke(OpF.ACQUIRE, 0, [0]),
+                Op(OpType.OK, OpF.ACQUIRE, 0, [0]),
+                Op.invoke(OpF.ACQUIRE, 1, [1]),
+                Op(OpType.OK, OpF.ACQUIRE, 1, [1]),
+            ]
+        )
+        ops = mutex_wgl_ops(two_locks)
+        assert pcomp_check_ops(ops, (OwnedMutex, ()))["valid?"] is True
+        assert pcomp_check_cpu(ops, (OwnedMutex, ()))["valid?"] is True
+        one_lock = reindex(
+            [
+                Op.invoke(OpF.ACQUIRE, 0, [0]),
+                Op(OpType.OK, OpF.ACQUIRE, 0, [0]),
+                Op.invoke(OpF.ACQUIRE, 1, [0]),
+                Op(OpType.OK, OpF.ACQUIRE, 1, [0]),
+            ]
+        )
+        ops1 = mutex_wgl_ops(one_lock)
+        assert pcomp_check_ops(ops1, (OwnedMutex, ()))["valid?"] is False
+
+    def test_checker_wrappers_use_pcomp_and_agree(self):
+        sh = synth_history(SynthSpec(n_ops=120, seed=41))
+        r = QueueWgl(backend="tpu").check({}, sh.ops)
+        assert r["valid?"] is True and r["engine"] == "tpu-pcomp"
+        r_mono = QueueWgl(backend="tpu", pcomp=False).check({}, sh.ops)
+        assert r_mono["valid?"] is True and r_mono["engine"] == "tpu"
+        bad = synth_mutex_batch(
+            1, MutexSynthSpec(n_ops=80), double_grant=1
+        )[0]
+        r2 = MutexWgl(backend="tpu").check({}, bad.ops)
+        assert r2["valid?"] is False and r2["engine"] == "tpu-pcomp"
+        assert MutexWgl(backend="cpu").check({}, bad.ops)["valid?"] is False
+
+
+# ---------------------------------------------------------------------------
+# overflow honesty
+# ---------------------------------------------------------------------------
+
+
+def _pending_pair_ops(pairs: int) -> list:
+    """``pairs`` indeterminate acquire+release pairs on ONE lock, then a
+    definite acquire: ~2^pairs configurations stay live through the one
+    return event — the shape that genuinely overflows a narrow frontier.
+    """
+    ops = []
+    for p in range(pairs):
+        ops.append(WglOp(Call(OwnedMutex.ACQUIRE, a0=p), 2 * p, INF))
+        ops.append(WglOp(Call(OwnedMutex.RELEASE, a0=p), 2 * p + 1, INF))
+    n = 2 * pairs
+    ops.append(WglOp(Call(OwnedMutex.ACQUIRE, a0=99), n, n + 1))
+    return ops
+
+
+class TestOverflowHonesty:
+    def test_sub_overflow_is_whole_history_unknown_with_class(self):
+        """A sub-history whose frontier overflows surfaces as unknown
+        for the WHOLE history with the offending class identified —
+        never a silent per-piece skip."""
+        ops = _pending_pair_ops(6)
+        d = decompose(ops, (OwnedMutex, ()))
+        ok, unknown, info = pcomp_tensor_check([d], capacity_cap=16)
+        assert unknown[0] and not ok[0]
+        assert info[0]["overflow-class"] == 0
+
+    def test_escalation_resolves_moderate_overflow(self):
+        """A dense-concurrency class (width 0 — no indeterminate ops,
+        but every interval overlapping) under-sizes the width heuristic
+        (capacity 16); one escalation to the max capacity resolves it
+        without the CPU fallback."""
+
+        def dense(m, key=0, base=0):
+            ops = []
+            n = 4 * m
+            for p in range(m):
+                ops.append(
+                    WglOp(Call(OwnedMutex.ACQUIRE, a0=p), base,
+                          base + n + 2 * p, key=key)
+                )
+                ops.append(
+                    WglOp(Call(OwnedMutex.RELEASE, a0=p), base + 1,
+                          base + n + 2 * p + 1, key=key)
+                )
+            return ops
+
+        ops = dense(6)
+        d = decompose(ops, (OwnedMutex, ()))
+        ok, unknown, info = pcomp_tensor_check([d])
+        assert ok[0] and not unknown[0]
+        assert info[0].get("escalated") is True
+        assert "_overflow_subs" not in info[0]  # private key never leaks
+        assert check_wgl_cpu(ops, OwnedMutex())["valid?"] is True
+        # a clean neighboring class keeps its first-pass verdict while
+        # ONLY the overflowed class escalates (merge correctness)
+        base = 100
+        clean = [
+            WglOp(Call(OwnedMutex.ACQUIRE, a0=7), base, base + 1, key=1),
+            WglOp(Call(OwnedMutex.RELEASE, a0=7), base + 2, base + 3,
+                  key=1),
+        ]
+        d2 = decompose(dense(6) + clean, (OwnedMutex, ()))
+        ok2, unknown2, info2 = pcomp_tensor_check([d2])
+        assert ok2[0] and not unknown2[0]
+        assert info2[0]["subhistories"] == 2
+        assert info2[0].get("escalated") is True
+        # the all-pending shape needs no escalation at all: its width
+        # (12 INF ops) sizes the first pass at the max capacity already
+        ops_p = _pending_pair_ops(6)
+        dp = decompose(ops_p, (OwnedMutex, ()))
+        okp, unkp, infp = pcomp_tensor_check([dp])
+        assert okp[0] and not unkp[0]
+        assert infp[0]["max-capacity"] == MAX_SUB_CAPACITY
+        assert check_wgl_cpu(ops_p, OwnedMutex())["valid?"] is True
+
+    def test_invalid_trumps_unknown_across_classes(self):
+        """One refuted projection refutes the WHOLE history even when a
+        neighboring class overflows: a device-proven violation must
+        never be downgraded to unknown (review finding)."""
+        # key 1: a >1024-config overflow shape; key 0: a definite
+        # double grant.  Key 1's ops come FIRST so an
+        # order-of-iteration bug would surface.
+        overflow_ops = [
+            WglOp(
+                Call(o.call.f, a0=o.call.a0), o.inv, o.ret, key=1
+            )
+            for o in _pending_pair_ops(12)
+        ]
+        base = len(overflow_ops) * 2
+        bad = [
+            WglOp(Call(OwnedMutex.ACQUIRE, a0=1), base, base + 1, key=0),
+            WglOp(
+                Call(OwnedMutex.ACQUIRE, a0=2), base + 2, base + 3, key=0
+            ),
+        ]
+        ops = overflow_ops + bad
+        d = decompose(ops, (OwnedMutex, ()))
+        ok, unknown, info = pcomp_tensor_check([d])
+        assert not ok[0] and not unknown[0]
+        assert info[0]["first-invalid-class"] == 0
+        r = pcomp_check_ops(ops, (OwnedMutex, ()))
+        assert r["valid?"] is False and r["invalid-class"] == 0
+        # the classic twin applies the same rule even when the capped
+        # class is scanned first
+        cpu = pcomp_check_cpu(ops, (OwnedMutex, ()), max_configs=64)
+        assert cpu["valid?"] is False and cpu["invalid-class"] == 0
+        # and with NO refuted class, a capped search stays undecided
+        cpu2 = pcomp_check_cpu(
+            overflow_ops, (OwnedMutex, ()), max_configs=64
+        )
+        assert cpu2["valid?"] == "unknown" and cpu2["overflow-class"] == 1
+
+    def test_checker_falls_back_to_cpu_on_true_overflow(self):
+        """Past the 1024-row escalation ceiling the checker keeps the
+        documented overflow ⇒ unknown ⇒ CPU-fallback contract, with the
+        offending class still visible in the result."""
+        ops = _pending_pair_ops(12)  # ≥ 2^12 configs > 1024 rows
+        d = decompose(ops, (OwnedMutex, ()))
+        ok, unknown, info = pcomp_tensor_check([d])
+        assert unknown[0]
+        assert info[0]["overflow-class"] == 0
+        r = pcomp_check_ops(ops, (OwnedMutex, ()))
+        assert r["valid?"] == "unknown" and r["overflow-class"] == 0
+
+        class _Chk(MutexWgl):
+            def _ops_and_model(self, history):
+                return ops, (OwnedMutex, ())
+
+        out = _Chk(backend="tpu").check({}, [])
+        assert out["engine"] == "cpu"
+        assert out["pcomp-overflow-class"] == 0
+        assert out["valid?"] is True  # the exact search decides
+
+
+# ---------------------------------------------------------------------------
+# FIFO: per-value classes + host pairwise order
+# ---------------------------------------------------------------------------
+
+
+def _random_fifo_ops(rng) -> list:
+    """Random COMPLETE distinct-value FIFO interval history: a mix of
+    honest FIFO executions and shuffled (frequently illegal) ones."""
+    n_vals = rng.randrange(2, 6)
+    events = []
+    for v in range(1, n_vals + 1):
+        events.append(("e", v))
+        if rng.random() < 0.8:
+            events.append(("d", v))
+    rng.shuffle(events)
+    t = 0
+    ops = []
+    for kind, v in events:
+        dur = rng.randrange(1, 4)
+        f = FifoQueue.ENQUEUE if kind == "e" else FifoQueue.DEQUEUE
+        ops.append(WglOp(Call(f, v), t, t + dur))
+        t += rng.randrange(1, 3)
+    return ops
+
+
+class TestFifoPcomp:
+    def test_random_differential_vs_classic(self):
+        import random
+
+        rng = random.Random(9)
+        checked = sound = 0
+        for _ in range(60):
+            ops = _random_fifo_ops(rng)
+            mk = (FifoQueue, (8,))
+            d = decompose(ops, mk)
+            cpu = check_wgl_cpu(ops, FifoQueue(8))
+            checked += 1
+            if not d.sound:
+                continue
+            sound += 1
+            ok, unknown, info = pcomp_tensor_check([d])
+            assert not unknown[0]
+            assert bool(ok[0]) == cpu["valid?"], (ops, info, cpu)
+            assert pcomp_check_cpu(ops, mk)["valid?"] == cpu["valid?"]
+        assert sound == checked, "complete histories must all be sound"
+
+    def test_pending_enqueue_is_unsound(self):
+        ops = [
+            WglOp(Call(FifoQueue.ENQUEUE, 1), 0, INF),
+            WglOp(Call(FifoQueue.DEQUEUE, 1), 2, 3),
+        ]
+        d = decompose(ops, (FifoQueue, (8,)))
+        assert not d.sound and "pending" in d.reason
+        # the checker still answers, through the monolithic engine
+        assert pcomp_check_ops(ops, (FifoQueue, (8,))) is None
+        assert check_wgl_cpu(ops, FifoQueue(8))["valid?"] is True
+
+    def test_duplicate_enqueue_is_unsound(self):
+        """Review counterexample (executed): re-enqueueing a value
+        breaks the distinct-value premise of the pairwise order proof —
+        the per-value dicts would keep only the LAST interval and pass
+        a genuinely non-FIFO history.  Must bail to the monolithic
+        engine, which refutes it."""
+        E, D = FifoQueue.ENQUEUE, FifoQueue.DEQUEUE
+        ops = [
+            WglOp(Call(E, 5), 0, 1),
+            WglOp(Call(E, 7), 2, 3),
+            WglOp(Call(D, 7), 4, 5),   # head is 5: not FIFO
+            WglOp(Call(D, 5), 6, 7),
+            WglOp(Call(E, 5), 8, 9),
+            WglOp(Call(D, 5), 10, 11),
+        ]
+        d = decompose(ops, (FifoQueue, (8,)))
+        assert not d.sound and "distinct" in d.reason
+        assert pcomp_check_ops(ops, (FifoQueue, (8,))) is None
+        assert pcomp_check_cpu(ops, (FifoQueue, (8,)))["valid?"] is False
+        assert check_wgl_cpu(ops, FifoQueue(8))["valid?"] is False
+
+    def test_binding_capacity_is_unsound(self):
+        ops = [WglOp(Call(FifoQueue.ENQUEUE, v), 2 * v, 2 * v + 1)
+               for v in range(4)]
+        d = decompose(ops, (FifoQueue, (2,)))
+        assert not d.sound and "capacity" in d.reason
+
+    def test_fifo_wgl_checker_still_correct(self):
+        hist = []
+        for v in range(6):
+            inv = Op.invoke(OpF.ENQUEUE, 0, v)
+            hist.append(inv)
+            hist.append(inv.complete(OpType.OK))
+        for v in range(6):
+            inv = Op.invoke(OpF.DEQUEUE, 0)
+            hist.append(inv)
+            hist.append(inv.complete(OpType.OK, value=v))
+        h = reindex(hist)
+        r = FifoWgl(backend="tpu").check({}, h)
+        assert r["valid?"] is True and r["engine"] == "tpu-pcomp"
+        # swapped dequeues: a genuine FIFO violation through pcomp
+        bad = list(h)
+        bad[-1] = bad[-1].complete(OpType.OK, value=0)  # re-reads head
+        r2 = FifoWgl(backend="tpu").check({}, reindex(bad[:-2]))
+        assert r2["valid?"] is True  # truncated tail stays legal
+
+
+# ---------------------------------------------------------------------------
+# mutex WGL cells: Python ≡ native ≡ .jtc (the zero-copy substrate)
+# ---------------------------------------------------------------------------
+
+
+class TestWglCells:
+    def _histories(self):
+        return (
+            synth_mutex_batch(2, MutexSynthSpec(n_ops=80), n_locks=3)
+            + synth_mutex_batch(1, MutexSynthSpec(n_ops=60))
+            + synth_mutex_batch(
+                1, MutexSynthSpec(n_ops=60), double_grant=1
+            )
+        )
+
+    def test_cells_reproduce_op_mappers(self):
+        for sh in self._histories():
+            cells = wgl_cells_for(sh.ops)
+            ops, mk = mutex_ops_from_cells(cells)
+            assert ops == mutex_wgl_ops(sh.ops)
+            assert mk == (OwnedMutex, ())
+        # fenced: tokens ride the cells too
+        hist = []
+        for tok in (5, 9):
+            inv = Op.invoke(OpF.ACQUIRE, tok)
+            hist.append(inv)
+            hist.append(inv.complete(OpType.OK, value=tok))
+        h = reindex(hist)
+        cells = wgl_cells_for(h)
+        assert cells_fenced(cells)
+        ops, mk = mutex_ops_from_cells(cells)
+        assert ops == fenced_mutex_wgl_ops(h)
+        assert mk == (FencedMutex, ())
+
+    def test_native_twin_and_jtc_round_trip(self):
+        from jepsen_tpu.history.columnar import load_jtc, pack_jtc
+        from jepsen_tpu.history.fastpack import wgl_cells_file
+        from jepsen_tpu.history.storecache import (
+            load_wgl_cells_cache,
+            save_wgl_cells_cache,
+            wgl_cells_with_cache,
+        )
+
+        with tempfile.TemporaryDirectory() as td:
+            for i, sh in enumerate(self._histories()):
+                d = Path(td) / f"run{i}"
+                d.mkdir()
+                p = _write_jsonl(d, sh.ops)
+                py = wgl_cells_for(sh.ops)
+                nat = wgl_cells_file(p)
+                if nat is not None:  # no-lib container: Python-only
+                    np.testing.assert_array_equal(nat, py)
+                # record-time substrate carries SEC_WGL for mutex
+                pack_jtc(p)
+                jtc = load_jtc(p)
+                assert jtc is not None
+                np.testing.assert_array_equal(jtc.wgl_cells(), py)
+                # cache layer round-trips through the substrate
+                got = load_wgl_cells_cache(p)
+                np.testing.assert_array_equal(got, py)
+                cells, hit = wgl_cells_with_cache(p)
+                assert hit
+                np.testing.assert_array_equal(cells, py)
+                save_wgl_cells_cache(p, py)  # idempotent merge
+
+    def test_store_records_wgl_section_at_record_time(self):
+        from jepsen_tpu.history.columnar import load_jtc
+        from jepsen_tpu.history.store import Store
+
+        sh = synth_mutex_batch(1, MutexSynthSpec(n_ops=40))[0]
+        with tempfile.TemporaryDirectory() as td:
+            store = Store(td)
+            run = store.run_dir("mutex-test")
+            p = store.save_history(run, sh.ops)
+            jtc = load_jtc(p)
+            assert jtc is not None and jtc.workload == "mutex"
+            np.testing.assert_array_equal(
+                jtc.wgl_cells(), wgl_cells_for(sh.ops)
+            )
+            # and the generic rows section rode along (PR-7 contract)
+            assert jtc.rows() is not None
+
+    def test_keyed_value_conventions(self):
+        assert mutex_key_token(None) == (0, -1)
+        assert mutex_key_token(7) == (0, 7)
+        assert mutex_key_token([3]) == (3, -1)
+        assert mutex_key_token([3, 9]) == (3, 9)
+        assert mutex_key_token("junk") == (0, -1)
+        assert mutex_key_token([1, 2, 3]) == (0, -1)
+        # [key] must NOT flip fenced detection
+        h = reindex(
+            [
+                Op.invoke(OpF.ACQUIRE, 0, [2]),
+                Op(OpType.OK, OpF.ACQUIRE, 0, [2]),
+            ]
+        )
+        assert not mutex_history_is_fenced(h)
+
+
+# ---------------------------------------------------------------------------
+# pipeline family + sharded sub-history axis
+# ---------------------------------------------------------------------------
+
+
+class TestMutexPipelineFamily:
+    @pytest.fixture(scope="class")
+    def store_paths(self, tmp_path_factory):
+        td = tmp_path_factory.mktemp("mutex_store")
+        shs = (
+            synth_mutex_batch(2, MutexSynthSpec(n_ops=60), n_locks=2)
+            + synth_mutex_batch(
+                2, MutexSynthSpec(n_ops=60), double_grant=1
+            )
+            + synth_mutex_batch(1, MutexSynthSpec(n_ops=60))
+        )
+        paths = []
+        for i, sh in enumerate(shs):
+            d = td / f"run{i}"
+            d.mkdir()
+            paths.append(str(_write_jsonl(d, sh.ops)))
+        return paths, shs
+
+    def test_pipelined_equals_serial_equals_lanes(self, store_paths):
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        paths, shs = store_paths
+        results, stats = check_sources("mutex", paths, chunk=2)
+        assert stats.histories == len(paths)
+        for r, sh in zip(results, shs):
+            serial = MutexWgl(backend="cpu").check({}, sh.ops)
+            assert (r["mutex"]["valid?"] is True) == (
+                serial["valid?"] is True
+            )
+            assert r["mutex"]["model"] == "owned-mutex"
+        serial_r, _ = check_sources("mutex", paths, chunk=2, serial=True)
+        lanes_r, _ = check_sources("mutex", paths, chunk=2, lanes=0)
+        verdicts = [r["mutex"]["valid?"] for r in results]
+        assert [r["mutex"]["valid?"] for r in serial_r] == verdicts
+        assert [r["mutex"]["valid?"] for r in lanes_r] == verdicts
+
+    def test_no_cache_still_parses(self, store_paths):
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        paths, _ = store_paths
+        results, _ = check_sources(
+            "mutex", paths, chunk=2, use_cache=False
+        )
+        assert len(results) == len(paths)
+
+    def test_reduce_mode_refused(self, store_paths):
+        from jepsen_tpu.parallel.mesh import checker_mesh
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        paths, _ = store_paths
+        with pytest.raises(Exception, match="reduce"):
+            check_sources(
+                "mutex", paths, reduce=True, mesh=checker_mesh(),
+            )
+
+
+class TestShardedPcomp:
+    def test_sharded_matches_single_device(self, cpu_devices):
+        from jepsen_tpu.parallel.mesh import checker_mesh, sharded_wgl_pcomp
+
+        mesh = checker_mesh(cpu_devices, seq=1)
+        opss = [
+            queue_wgl_ops(synth_hard_queue_history(80, w, seed=2))
+            for w in (0, 3, 5)
+        ]
+        mk = _queue_model_key(opss)
+        decomps = [decompose(ops, mk) for ops in opss]
+        ok_s, unknown_s, info_s = sharded_wgl_pcomp(decomps, mesh)
+        decomps2 = [decompose(ops, mk) for ops in opss]
+        ok, unknown, info = pcomp_tensor_check(decomps2)
+        np.testing.assert_array_equal(ok_s, ok)
+        np.testing.assert_array_equal(unknown_s, unknown)
+        assert [i["subhistories"] for i in info_s] == [
+            i["subhistories"] for i in info
+        ]

@@ -29,43 +29,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def hard_history(n_ops: int, window: int, seed: int = 0):
-    """A partition-era quorum-queue history: ``window`` indeterminate
-    enqueues (publish confirms lost in the partition) stay open for the
-    whole run while normal traffic continues.
+    """Partition-era quorum-queue history (the round-3 hard shape);
+    the generator now lives in ``jepsen_tpu.history.synth`` so the
+    differential suite (``tests/test_wgl_pcomp.py``) shares it."""
+    from jepsen_tpu.history.synth import synth_hard_queue_history
 
-    This is the shape where the classic Wing-Gong search degrades
-    super-linearly: every one of the ``window`` open enqueues may
-    linearize at any later point or never, so the reachable configuration
-    set sustains ~2^window members through EVERY later return event —
-    the classic search re-expands them per event in Python, while the
-    tensor engine's fixed-capacity frontier does the same work in one
-    compiled scan regardless (until 2^window exceeds capacity, where it
-    honestly reports *unknown* and escapes to the CPU).
-    """
-    import random
-
-    from jepsen_tpu.history.ops import Op, OpF, OpType
-
-    rng = random.Random(seed)
-    ops: list = []
-
-    def t() -> int:
-        return len(ops)
-
-    for i in range(window):
-        p = 100 + i
-        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, p, i + 1, time=t()))
-        ops.append(
-            Op(OpType.INFO, OpF.ENQUEUE, p, i + 1, time=t(), error="timeout")
-        )
-    values = list(range(window + 1, window + 1 + (n_ops // 2)))
-    rng.shuffle(values)
-    for v in values:
-        ops.append(Op(OpType.INVOKE, OpF.ENQUEUE, 0, v, time=t()))
-        ops.append(Op(OpType.OK, OpF.ENQUEUE, 0, v, time=t()))
-        ops.append(Op(OpType.INVOKE, OpF.DEQUEUE, 1, None, time=t()))
-        ops.append(Op(OpType.OK, OpF.DEQUEUE, 1, v, time=t()))
-    return ops
+    return synth_hard_queue_history(n_ops, window, seed=seed)
 
 
 def _enable_cache() -> tuple[str | None, int]:
@@ -224,6 +193,82 @@ def measure_hard(
     }, cache)
 
 
+def measure_pcomp(
+    n_ops: int, window: int, batch: int, platform: str = "",
+) -> dict:
+    """P-compositional tensor WGL vs the classic host search on the
+    partition-era hard shape (the round-6 `wgl_pcomp` table).
+
+    ``pcomp_per_history_ms`` is END-TO-END per history: decomposition +
+    bucketed packing + device check + combine (best of 3 full repeats)
+    — the honest number, since the decomposition is host work the
+    classic search does not pay.  The classic sweep measures
+    ``classic_samples`` histories (1 on shapes where its exponential
+    tail would blow the row deadline — per-history classic cost is what
+    is being measured, and at w≥8 one history is already seconds to
+    minutes)."""
+    import jax
+
+    if platform:
+        jax.config.update("jax_platforms", platform)
+    cache = _enable_cache()
+
+    from jepsen_tpu.checkers.wgl import check_wgl_cpu, queue_wgl_ops
+    from jepsen_tpu.checkers.wgl_pcomp import decompose, pcomp_tensor_check
+    from jepsen_tpu.models.core import UnorderedQueue
+
+    opss = [
+        queue_wgl_ops(hard_history(n_ops, window, seed=s))
+        for s in range(batch)
+    ]
+    vs = 32 * max(1, (max(o.call.a0 for ops in opss for o in ops) + 32) // 32)
+    model_key = (UnorderedQueue, (vs,))
+
+    t0 = time.perf_counter()
+    decomps = [decompose(ops, model_key) for ops in opss]
+    ok, unknown, info = pcomp_tensor_check(decomps)
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for _ in range(3):
+        t1 = time.perf_counter()
+        decomps = [decompose(ops, model_key) for ops in opss]
+        ok, unknown, info = pcomp_tensor_check(decomps)
+        times.append(time.perf_counter() - t1)
+    run_s = min(times)
+
+    classic_samples = 1 if (window >= 8 or n_ops >= 1000) else batch
+    t2 = time.perf_counter()
+    classic = [
+        check_wgl_cpu(ops, UnorderedQueue(vs))
+        for ops in opss[:classic_samples]
+    ]
+    cpu_s = (time.perf_counter() - t2) / classic_samples
+
+    pcomp_ms = run_s / batch * 1e3
+    classic_ms = cpu_s * 1e3
+    return _cache_evidence({
+        "engine": "pcomp",
+        "n_ops": n_ops,
+        "window": window,
+        "expected_configs": 2 ** window,
+        "batch": batch,
+        "backend": jax.default_backend(),
+        "compile_s": round(compile_s, 2),
+        "pcomp_per_history_ms": round(pcomp_ms, 3),
+        "pcomp_subhistories": info[0]["subhistories"],
+        "pcomp_sub_capacity": info[0]["max-capacity"],
+        "classic_per_history_ms": round(classic_ms, 3),
+        "classic_samples": classic_samples,
+        "classic_configs_explored": classic[0]["configs-explored"],
+        "speedup_vs_classic": round(classic_ms / pcomp_ms, 2),
+        "winner": "pcomp" if pcomp_ms < classic_ms else "classic",
+        "all_linearizable": bool(ok.all()),
+        "unknown_frac": round(float(unknown.mean()), 3),
+        "classic_valid": classic[0]["valid?"],
+    }, cache)
+
+
 def measure_one(n_ops: int, batch: int, platform: str = "") -> dict:
     import jax
 
@@ -304,13 +349,25 @@ def main() -> None:
         "with the device repeats via the pipeline executor; a CPU "
         "backend is always serial — shared cores)",
     )
+    p.add_argument(
+        "--pcomp",
+        action="store_true",
+        help="with --hard/--one-hard: measure the P-compositional "
+        "tensor engine (checkers/wgl_pcomp.py — per-class narrow "
+        "frontiers, capacity ignored/auto-sized per class) against the "
+        "classic host search instead of the monolithic tensor engine; "
+        "the WGL_BENCH.md round-6 / bench.py `wgl_pcomp` rows",
+    )
     args = p.parse_args()
 
     if args.one_hard:
         n, w, cap = (int(x) for x in args.one_hard.split(","))
-        print(json.dumps(measure_hard(
-            n, w, args.batch, cap, args.platform, serial=args.serial
-        )))
+        if args.pcomp:
+            print(json.dumps(measure_pcomp(n, w, args.batch, args.platform)))
+        else:
+            print(json.dumps(measure_hard(
+                n, w, args.batch, cap, args.platform, serial=args.serial
+            )))
         return
     if args.one:
         print(json.dumps(measure_one(args.one, args.batch, args.platform)))
@@ -323,7 +380,9 @@ def main() -> None:
                 sys.executable, __file__,
                 "--one-hard", f"{args.n_ops},{w},{args.capacity}",
                 "--batch", str(args.batch), "--platform", args.platform,
-            ] + (["--serial"] if args.serial else [])
+            ] + (["--serial"] if args.serial else []) + (
+                ["--pcomp"] if args.pcomp else []
+            )
             t0 = time.perf_counter()
             try:
                 r = subprocess.run(

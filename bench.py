@@ -827,6 +827,165 @@ def _bench_cold_vs_warm_section(details: dict) -> None:
     details["cold_vs_warm"] = got
 
 
+def _bench_obs_overhead(
+    details: dict,
+    histories: int = None,
+    base_n: int = None,
+    n_ops: int = None,
+    chunk: int = 256,
+    repeats: int = 2,
+) -> None:
+    """The flight recorder's cost, measured where it matters (ISSUE 10
+    done-bar): the full north-star config bytes-to-verdict through the
+    per-device-lane executor, tracing OFF vs tracing ON, interleaved
+    ``repeats``× with the min wall per mode (the same steady-state
+    discipline as the other timed sections; the jitted programs are
+    warmed first).  ``overhead_frac`` must stay ≤ 2% — the recorder is
+    allowed to watch the hot path, not to become it.  What toggles
+    between the arms is the SPAN RING (the tracer); the metrics-view
+    accounting (`PipelineStats.add_busy`: per-stage counters + the
+    check-latency sketch, chunk-granular) is always on by design — it
+    replaced the old private busy-second arithmetic and runs in BOTH
+    arms, so the off wall already carries it.  The ON run also yields
+    the p50/p99 per-batch check latency from the run's quantile sketch
+    — the keys the service ``/metrics`` SLO story reads.
+
+    This section OWNS the tracer: a pre-existing recording session
+    cannot survive a bench that must toggle the recorder (the ring is
+    not restorable) — it is ended with a loud note, never silently
+    traced through.
+
+    Lanes-only executor shape (no meshed collective reduction), same
+    rationale as ``cold_vs_warm``: repeated full-scale meshed runs in
+    one process re-trip the r5-documented CPU all-reduce rendezvous
+    fragility, and the overhead claim is a host-side one."""
+    import tempfile
+
+    import jax
+
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+    from jepsen_tpu.obs import trace as obs_trace
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    histories = histories or NORTH_STAR_HISTORIES
+    base_n = base_n or BASE_HISTORIES
+    n_ops = n_ops or N_OPS
+    base = synth_batch(
+        base_n, SynthSpec(n_ops=n_ops, n_processes=5), lost=1
+    )
+    kw = dict(chunk=chunk, lanes=0, use_cache=False)
+    if obs_trace.is_enabled():
+        # see docstring: the ring cannot be restored after the off/on
+        # toggling below, so a live session ends HERE, loudly — a
+        # caller tracing through this section would otherwise export
+        # an empty ring and never know why
+        print(
+            "# obs_overhead: ending the caller's live trace session "
+            "(this section owns the tracer; its ring is not restorable)",
+            file=sys.stderr,
+        )
+    obs_trace.disable()
+    off_walls: list[float] = []
+    on_walls: list[float] = []
+    spans = 0
+    on_stats = None
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, base)
+        srcs = (files * ((histories + base_n - 1) // base_n))[:histories]
+        check_sources("queue", srcs, **kw)  # warm (compile-excluded)
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            check_sources("queue", srcs, **kw)
+            off_walls.append(time.perf_counter() - t0)
+            obs_trace.enable()
+            t0 = time.perf_counter()
+            _res, on_stats = check_sources("queue", srcs, **kw)
+            on_walls.append(time.perf_counter() - t0)
+            spans = obs_trace.spans_recorded()
+            obs_trace.disable()
+    off, on = min(off_walls), min(on_walls)
+    overhead = (on - off) / max(off, 1e-9)
+    details["obs_overhead"] = {
+        "config": "BASELINE.json #1 bytes-to-verdict, per-device lanes: "
+                  "flight recorder off vs on",
+        "histories": histories,
+        "repeats": repeats,
+        "tracing_off_wall_s": round(off, 2),
+        "tracing_on_wall_s": round(on, 2),
+        "overhead_frac": round(overhead, 4),
+        "within_2pct": bool(overhead <= 0.02),
+        "spans_recorded": int(spans),
+        "check_batch_p50_ms": round(
+            on_stats.check_batch_quantile(0.50) * 1e3, 3
+        ),
+        "check_batch_p99_ms": round(
+            on_stats.check_batch_quantile(0.99) * 1e3, 3
+        ),
+        "e2e_histories_per_sec_traced": round(histories / on, 1),
+        "devices": jax.device_count(),
+        "lanes": on_stats.lanes,
+        "backend": jax.default_backend(),
+    }
+    o = details["obs_overhead"]
+    print(
+        f"# obs_overhead: off {off:.2f}s | on {on:.2f}s -> "
+        f"{overhead * 100:.2f}% ({'within' if o['within_2pct'] else 'OUTSIDE'}"
+        f" 2%); {spans} spans, check-batch p50 "
+        f"{o['check_batch_p50_ms']:.1f}ms p99 {o['check_batch_p99_ms']:.1f}ms",
+        file=sys.stderr,
+    )
+
+
+def _bench_obs_overhead_section(details: dict) -> None:
+    """``obs_overhead`` for the section loop: in-process on a chip
+    backend, in an 8-virtual-device CPU subprocess otherwise (the same
+    mesh-shape discipline as the north_star / cold_vs_warm sections)."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _bench_obs_overhead(details)
+        return
+    child = (
+        "import json, os, sys\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "import bench\n"
+        "d = {}\n"
+        "bench._bench_obs_overhead(d)\n"
+        "print('OBS_OVERHEAD ' + json.dumps(d['obs_overhead']), flush=True)\n"
+    )
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-c", child,
+            os.path.dirname(os.path.abspath(__file__)),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=3600,
+        env=env,
+    )
+    for line in r.stderr.splitlines():
+        print(line, file=sys.stderr)
+    got = None
+    for line in r.stdout.splitlines():
+        if line.startswith("OBS_OVERHEAD "):
+            try:
+                got = json.loads(line[len("OBS_OVERHEAD "):])
+            except ValueError:
+                pass
+    if got is None:
+        raise RuntimeError(
+            f"obs_overhead child produced no section: "
+            f"{(r.stderr or r.stdout)[-400:]}"
+        )
+    details["obs_overhead"] = got
+
+
 _SCALING_CHILD = r"""
 import json, os, sys, tempfile, time
 os.environ["XLA_FLAGS"] = (
@@ -1677,7 +1836,7 @@ def _run_once() -> None:
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_north_star_section, _bench_cold_vs_warm_section,
-        _bench_scaling,
+        _bench_obs_overhead_section, _bench_scaling,
     ):
         try:
             section(details)

@@ -1441,7 +1441,81 @@ def cmd_serve_checker(args) -> int:
     from jepsen_tpu.service.server import serve_forever
 
     serve_forever(
-        host=args.host, port=args.port, seq=args.seq, store=args.store
+        host=args.host, port=args.port, seq=args.seq, store=args.store,
+        metrics_port=args.metrics_port,
+    )
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """Record any CLI run through the flight recorder and export a
+    Perfetto/Chrome trace: ``jepsen-tpu trace [--out F] -- check ...``.
+
+    The wrapped command re-enters :func:`main` (so backend pinning,
+    compile-cache wiring, and the harvest hook behave exactly as in a
+    bare invocation) with the obs tracer enabled; the artifact is
+    written ONLY when the wrapped command exits 0 — the soak/fuzz
+    fail-loud capture discipline (a crashed run leaves no trace file
+    pretending to be evidence)."""
+    rest = list(args.rest)
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        print("error: trace needs a command to record, e.g. "
+              "`jepsen-tpu trace -- check --store store`",
+              file=sys.stderr)
+        return 2
+    if rest[0] == "trace":
+        print("error: trace cannot wrap itself", file=sys.stderr)
+        return 2
+
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.obs import trace as obs_trace
+
+    out = args.out
+    if out is None:
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        out = os.path.join("store", f"trace_{rest[0]}_{stamp}.json")
+
+    profile_dir = args.jax_profile
+    if profile_dir:
+        import jax
+
+        jax.profiler.start_trace(profile_dir)
+    obs_trace.enable(capacity=args.capacity)
+    try:
+        rc = main(rest)
+    finally:
+        obs_trace.disable()
+        if profile_dir:
+            import jax
+
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass  # trace never started (early arg error)
+    if rc != 0:
+        print(
+            f"# trace NOT written: wrapped command exited {rc} (an "
+            f"artifact only lands on a completed run)",
+            file=sys.stderr,
+        )
+        return rc
+    summary = obs_export.write_trace(
+        out, merge_jax_profile_dir=profile_dir or None
+    )
+    if profile_dir and summary["jax_events"] == 0:
+        print(
+            "# note: the jax.profiler capture held no Trace-Event JSON "
+            "(XSpace-only profiler build) — the trace carries host "
+            "spans only",
+            file=sys.stderr,
+        )
+    print(f"# trace: {json.dumps(summary)}")
+    print(
+        "# open it at https://ui.perfetto.dev (or chrome://tracing): "
+        f"load {summary['path']}",
+        file=sys.stderr,
     )
     return 0
 
@@ -1996,7 +2070,49 @@ def build_parser() -> argparse.ArgumentParser:
         help="store root (the persistent XLA compile cache lives under "
         "<store>/xla_cache, shared with the CLI)",
     )
+    sc.add_argument(
+        "--metrics-port",
+        type=int,
+        default=9640,
+        help="Prometheus-style text /metrics endpoint (p50/p99 check "
+        "latency from the shared obs registry); 0 = ephemeral port, "
+        "-1 = off",
+    )
     sc.set_defaults(fn=cmd_serve_checker)
+
+    tr = sub.add_parser(
+        "trace",
+        help="record any CLI run through the flight recorder and "
+        "export a Perfetto trace (obs/OBSERVABILITY.md)",
+    )
+    tr.add_argument(
+        "--out",
+        default=None,
+        help="trace artifact path (default: "
+        "store/trace_<cmd>_<utc-stamp>.json); written only when the "
+        "wrapped command exits 0",
+    )
+    tr.add_argument(
+        "--capacity",
+        type=int,
+        default=1 << 16,
+        help="span ring capacity (oldest records drop past it)",
+    )
+    tr.add_argument(
+        "--jax-profile",
+        default=None,
+        metavar="DIR",
+        help="also run jax.profiler over the wrapped command and merge "
+        "any Trace-Event JSON it leaves under DIR (profiler builds that "
+        "emit only XSpace protobufs merge 0 events, reported honestly)",
+    )
+    tr.add_argument(
+        "rest",
+        nargs=argparse.REMAINDER,
+        help="the command to record (prefix with -- to end trace's own "
+        "flags), e.g. `trace -- check --store store --checker tpu`",
+    )
+    tr.set_defaults(fn=cmd_trace)
 
     s = sub.add_parser("synth", help="generate synthetic histories into a store")
     s.add_argument(
@@ -2045,6 +2161,9 @@ def _wants_device_backend(args) -> bool:
         return False  # host-only work
     if args.command in ("bench-check", "serve-checker"):
         return True  # device-throughput measurement / checker sidecar
+    if args.command == "trace":
+        return True  # the WRAPPED command decides on re-entry; pinning
+        # here would override its choice before it parses
     if getattr(args, "print_configs", False):
         return False  # matrix introspection runs no checks
     return getattr(args, "checker", None) == "tpu"
@@ -2064,7 +2183,9 @@ def main(argv=None) -> int:
     if not _wants_device_backend(args):
         # no device compute on these paths — never touch a chip plugin
         pin_cpu_platform()
-    elif args.command != "serve-checker":  # sidecar guards its own init
+    elif args.command not in ("serve-checker", "trace"):
+        # the sidecar guards its own init; trace defers to the wrapped
+        # command's own main() pass
         try:
             backend = ensure_backend()
             # persistent XLA compile cache under the store

@@ -32,7 +32,7 @@ from typing import Any, Callable, Mapping, Sequence
 
 from jepsen_tpu.checkers.protocol import UNKNOWN, VALID, Checker
 from jepsen_tpu.generators.core import Generator, Pending, Scheduler
-from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpType
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
 from jepsen_tpu.history.store import Store
 
 logger = logging.getLogger("jepsen_tpu.runner")
@@ -219,7 +219,13 @@ def _nemesis_worker(
     recorder: _Recorder,
     barrier: threading.Barrier,
 ):
+    from jepsen_tpu.obs import trace as obs_trace
+
     nemesis = test.nemesis
+    # open fault window (flight recorder): a START completion opens it,
+    # the paired STOP closes it as one span on the "nemesis" track —
+    # the trace overlays fault windows on the checker/pipeline work
+    window: tuple[float, str] | None = None  # (t_start, label)
     try:
         if nemesis is not None:
             nemesis.setup(test_map)
@@ -249,6 +255,32 @@ def _nemesis_worker(
                 logger.exception("nemesis.invoke crashed")
                 completion = invoke.complete(OpType.INFO, error=str(e))
             recorder.record(completion)
+            if obs_trace.is_enabled():
+                if invoke.f == OpF.START:
+                    window = (
+                        _time.perf_counter(),
+                        str(completion.value)[:120],
+                    )
+                elif invoke.f == OpF.STOP and window is not None:
+                    t_start, label = window
+                    window = None
+                    obs_trace.complete(
+                        f"nemesis:{label}",
+                        t_start,
+                        _time.perf_counter(),
+                        track="nemesis",
+                        args={"heal": str(completion.value)[:120]},
+                    )
+        if window is not None:
+            # a window the schedule never closed (run end mid-fault)
+            t_start, label = window
+            obs_trace.complete(
+                f"nemesis:{label}",
+                t_start,
+                _time.perf_counter(),
+                track="nemesis",
+                args={"heal": "unclosed at run end"},
+            )
     except Exception:  # noqa: BLE001 — never leave clients waiting on us
         logger.exception("nemesis thread aborting the run")
         scheduler.abort()
@@ -283,9 +315,14 @@ def run_test(test: Test, store: Store | None = None) -> TestRun:
 def _run_test_logged(
     test: Test, test_map: dict[str, Any], st: Store, run_dir: Path
 ) -> TestRun:
+    from jepsen_tpu.obs import trace as obs_trace
+
     logger.info("setup: %d nodes", len(test.nodes))
-    with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
-        list(pool.map(lambda n: test.db.setup(test_map, n), test.nodes))
+    with obs_trace.span("run.setup", track="run"):
+        with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
+            list(
+                pool.map(lambda n: test.db.setup(test_map, n), test.nodes)
+            )
 
     start_ns = _time.monotonic_ns()
     scheduler = Scheduler(
@@ -312,19 +349,34 @@ def _run_test_logged(
         )
     )
     logger.info("run: %d workers + nemesis", test.concurrency)
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
+    with obs_trace.span(
+        "run.load",
+        track="run",
+        args=(
+            {"workers": test.concurrency, "nodes": len(test.nodes)}
+            if obs_trace.is_enabled()
+            else None
+        ),
+    ):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
 
     logger.info("teardown")
-    if test.nemesis is not None:
-        test.nemesis.teardown(test_map)
-    with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
-        list(pool.map(lambda n: test.db.teardown(test_map, n), test.nodes))
+    with obs_trace.span("run.teardown", track="run"):
+        if test.nemesis is not None:
+            test.nemesis.teardown(test_map)
+        with concurrent.futures.ThreadPoolExecutor(len(test.nodes)) as pool:
+            list(
+                pool.map(
+                    lambda n: test.db.teardown(test_map, n), test.nodes
+                )
+            )
 
     history = recorder.history
-    st.save_history(run_dir, history)
+    with obs_trace.span("run.save_history", track="run"):
+        st.save_history(run_dir, history)
 
     # collect node logs into the store (= jepsen's db/LogFiles scp)
     for node in test.nodes:
@@ -337,9 +389,18 @@ def _run_test_logged(
                 logger.exception("fetching %s from %s failed", path, node)
 
     logger.info("analysis: %d history entries", len(history))
-    results = test.checker.check(
-        test_map, history, {"out_dir": run_dir}
-    )
+    with obs_trace.span(
+        "run.analysis",
+        track="run",
+        args=(
+            {"history_ops": len(history)}
+            if obs_trace.is_enabled()
+            else None
+        ),
+    ):
+        results = test.checker.check(
+            test_map, history, {"out_dir": run_dir}
+        )
     st.save_results(run_dir, results)
     verdict = results.get(VALID)
     if verdict is True:

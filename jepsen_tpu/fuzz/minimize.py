@@ -51,11 +51,24 @@ def minimize(
     )
 
     def still_red(candidate: FuzzConfig) -> bool:
-        for _ in range(max(1, confirm)):
-            stats.runs += 1
-            if not oracle(candidate):
-                return False
-        return True
+        # one flight-recorder span per shrink probe: the candidate's
+        # shape (events, window) rides as args, the confirm re-runs as
+        # the span body — the shrink trajectory reads straight off the
+        # "fuzz" track in a trace
+        from jepsen_tpu.obs import trace as obs_trace
+
+        args = None
+        if obs_trace.is_enabled():
+            args = {
+                "events": len(candidate.events),
+                "window_s": float(candidate.opts["time-limit"]),
+            }
+        with obs_trace.span("fuzz.shrink_probe", track="fuzz", args=args):
+            for _ in range(max(1, confirm)):
+                stats.runs += 1
+                if not oracle(candidate):
+                    return False
+            return True
 
     # -- 1. ddmin over events ---------------------------------------------
     events = list(cfg.events)

@@ -330,10 +330,15 @@ def consult(src_path: str | Path) -> Jtc | None:
     raises under ``JEPSEN_TPU_JTC_STRICT=1``) before the caller falls
     back to the legacy parse; an absent one notes the pre-format store
     once per directory."""
+    from jepsen_tpu.obs.metrics import REGISTRY
+
     src = Path(src_path)
     try:
         got = load_jtc(src)
     except ColumnarFormatError as e:
+        # obs counter FIRST: the log line scrolls away, the counter is
+        # what a run/test can assert on afterwards (ISSUE 10 satellite)
+        REGISTRY.counter("jtc.fallback", reason="corrupt").inc()
         if _strict():
             raise
         log.warning(
@@ -341,13 +346,22 @@ def consult(src_path: str | Path) -> Jtc | None:
             "for %s: %s", src, e,
         )
         return None
-    if got is None and not _disabled() and not jtc_path_for(src).exists():
-        _note_once(
-            src.parent, logging.INFO,
-            "no columnar substrate (.jtc) under %s — pre-format store, "
-            "using the legacy parse/npz path (tools/migrate_store.py "
-            "rewrites a store in place)", src.parent,
-        )
+    if got is not None:
+        REGISTRY.counter("jtc.hit").inc()
+        return got
+    if not _disabled():
+        if not jtc_path_for(src).exists():
+            REGISTRY.counter("jtc.fallback", reason="absent").inc()
+            _note_once(
+                src.parent, logging.INFO,
+                "no columnar substrate (.jtc) under %s — pre-format "
+                "store, using the legacy parse/npz path "
+                "(tools/migrate_store.py rewrites a store in place)",
+                src.parent,
+            )
+        else:
+            # present but stamped for different source bytes/name
+            REGISTRY.counter("jtc.fallback", reason="stale").inc()
     return got
 
 

@@ -436,6 +436,38 @@ def _files_multi(
     n_parts: int = 1,
     use_jtc: bool = True,
 ):
+    """Trace-span wrapper over :func:`_files_multi_impl`: every native
+    pack batch is one span on the calling lane's track (args only built
+    when the recorder is on — the off path allocates nothing)."""
+    from jepsen_tpu.obs import trace as obs_trace
+
+    if not obs_trace.is_enabled():
+        return _files_multi_impl(
+            paths, fn_name, free_name, conv, threads, part, n_parts,
+            use_jtc,
+        )
+    n = len(range(part, len(paths), n_parts)) if n_parts > 1 else len(paths)
+    with obs_trace.span(
+        f"fastpack.{fn_name}",
+        args={"files": n, "part": part, "n_parts": n_parts,
+              "use_jtc": use_jtc},
+    ):
+        return _files_multi_impl(
+            paths, fn_name, free_name, conv, threads, part, n_parts,
+            use_jtc,
+        )
+
+
+def _files_multi_impl(
+    paths,
+    fn_name: str,
+    free_name: str,
+    conv,
+    threads: int,
+    part: int = 0,
+    n_parts: int = 1,
+    use_jtc: bool = True,
+):
     """Shared multi-file driver: returns a list aligned with ``paths``
     (``None`` entries where that file must fall back to the Python
     twin), or ``None`` when the native multi-file path is unavailable

@@ -388,30 +388,36 @@ def sharded_wgl_pcomp(decomps, mesh: Mesh, capacity_cap: int | None = None):
         finish_buckets,
         run_bucket,
     )
+    from jepsen_tpu.obs import trace as obs_trace
 
     h = mesh.shape[HIST_AXIS]
-    buckets = bucketize(
-        decomps, capacity_cap=capacity_cap, pad_to=h, to_device=False
-    )
-    placed = []
-    for b in buckets:
-        f, a0, a1, ret_op, cands = _hist_sharded(
-            (b.batch.f, b.batch.a0, b.batch.a1, b.batch.ret_op,
-             b.batch.cands),
-            mesh,
+    with obs_trace.span(
+        "mesh.sharded_wgl_pcomp",
+        args={"histories": len(decomps)} if obs_trace.is_enabled() else None,
+    ):
+        buckets = bucketize(
+            decomps, capacity_cap=capacity_cap, pad_to=h, to_device=False
         )
-        placed.append(
-            dataclasses.replace(
-                b,
-                batch=dataclasses.replace(
-                    b.batch, f=f, a0=a0, a1=a1, ret_op=ret_op, cands=cands
-                ),
+        placed = []
+        for b in buckets:
+            f, a0, a1, ret_op, cands = _hist_sharded(
+                (b.batch.f, b.batch.a0, b.batch.a1, b.batch.ret_op,
+                 b.batch.cands),
+                mesh,
             )
+            placed.append(
+                dataclasses.replace(
+                    b,
+                    batch=dataclasses.replace(
+                        b.batch, f=f, a0=a0, a1=a1, ret_op=ret_op,
+                        cands=cands
+                    ),
+                )
+            )
+        results = [run_bucket(b) for b in placed]
+        return finish_buckets(
+            decomps, placed, results, escalate=capacity_cap is None
         )
-    results = [run_bucket(b) for b in placed]
-    return finish_buckets(
-        decomps, placed, results, escalate=capacity_cap is None
-    )
 
 
 def sharded_elle(batch, mesh: Mesh):
@@ -511,20 +517,29 @@ def sharded_queue_verdict(
     """Both queue sub-checkers over the mesh, reduced on device to the
     two-scalar batch verdict (pad histories are synthesized valid, so
     they can never surface as counterexamples)."""
-    tq, ql = sharded_check(packed, mesh, delivery)
-    return reduced_verdict(tq.valid & ql.valid, mesh, gidx)
+    from jepsen_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("mesh.sharded_queue_verdict"):
+        tq, ql = sharded_check(packed, mesh, delivery)
+        return reduced_verdict(tq.valid & ql.valid, mesh, gidx)
 
 
 def sharded_stream_verdict(
     batch, mesh: Mesh, append_fail: str = "definite", gidx=None
 ):
-    sl = sharded_stream_lin(batch, mesh, append_fail=append_fail)
-    return reduced_verdict(sl.valid, mesh, gidx)
+    from jepsen_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("mesh.sharded_stream_verdict"):
+        sl = sharded_stream_lin(batch, mesh, append_fail=append_fail)
+        return reduced_verdict(sl.valid, mesh, gidx)
 
 
 def sharded_elle_mops_verdict(mops, mesh: Mesh, gidx=None):
-    el = sharded_elle_mops(mops, mesh)
-    return reduced_verdict(el.valid, mesh, gidx)
+    from jepsen_tpu.obs import trace as obs_trace
+
+    with obs_trace.span("mesh.sharded_elle_mops_verdict"):
+        el = sharded_elle_mops(mops, mesh)
+        return reduced_verdict(el.valid, mesh, gidx)
 
 
 def sharded_elle_mops(mops, mesh: Mesh):

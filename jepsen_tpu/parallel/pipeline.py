@@ -57,6 +57,9 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
+
 #: histories per pipeline chunk — small enough that the first chunk
 #: reaches the device quickly, large enough to amortize dispatch
 DEFAULT_CHUNK = 64
@@ -66,9 +69,30 @@ class PipelineError(RuntimeError):
     """A pipeline stage crashed; no verdicts were emitted."""
 
 
-@dataclass
+def _counter_field(name: str, cast=int, **labels):
+    """A PipelineStats attribute backed by its run-scoped registry —
+    the stats object is a VIEW; the registry is the storage."""
+
+    def get(self):
+        return cast(self.metrics.value(name, **labels))
+
+    def set(self, v):
+        self.metrics.counter(name, **labels).set(v)
+
+    return property(get, set)
+
+
 class PipelineStats:
-    """Executor timing evidence (the bench's utilization schema).
+    """Executor timing evidence (the bench's utilization schema) — a
+    VIEW over an obs metrics registry (``jepsen_tpu/obs/metrics.py``).
+    Every field except the two derived fractions is backed by a counter
+    in ``self.metrics`` (a per-run :class:`~jepsen_tpu.obs.metrics.Registry`);
+    the stage busy-seconds bookkeeping that used to live separately in
+    ``run_pipeline``, ``run_lanes``, and the serial path now goes
+    through ONE accounting point (:meth:`add_busy`), which also mirrors
+    cumulative totals into the process-global registry (the service
+    ``/metrics`` endpoint reads those) and records the stage as a trace
+    span when the flight recorder is on.
 
     ``stage_overlap_frac``: fraction of total stage busy time that ran
     concurrently with another stage — 0.0 for a strictly serial run,
@@ -79,18 +103,60 @@ class PipelineStats:
     busy seconds SUMMED across lanes, and ``device_idle_frac`` against
     the ``lanes × wall`` device-time budget.  ``dropped`` counts
     sources excluded by the lanes path's size census (unreadable /
-    zero-length files — each is logged; never a silent truncation)."""
+    zero-length files — each is logged AND counted in the global
+    ``pipeline.files_dropped`` counter; never a silent truncation)."""
 
-    batches: int = 0
-    histories: int = 0
-    wall_s: float = 0.0
-    produce_busy_s: float = 0.0
-    place_busy_s: float = 0.0
-    check_busy_s: float = 0.0
-    stage_overlap_frac: float = 0.0
-    device_idle_frac: float = 0.0
-    lanes: int = 1
-    dropped: int = 0
+    def __init__(self, lanes: int = 1, dropped: int = 0):
+        self.metrics = obs_metrics.Registry()
+        self.lanes = lanes
+        self.wall_s = 0.0
+        self.stage_overlap_frac = 0.0
+        self.device_idle_frac = 0.0
+        if dropped:
+            self.dropped = dropped
+
+    batches = _counter_field("pipeline.batches")
+    histories = _counter_field("pipeline.histories")
+    dropped = _counter_field("pipeline.files_dropped")
+    produce_busy_s = _counter_field(
+        "pipeline.stage_busy_s", cast=float, stage="produce"
+    )
+    place_busy_s = _counter_field(
+        "pipeline.stage_busy_s", cast=float, stage="place"
+    )
+    check_busy_s = _counter_field(
+        "pipeline.stage_busy_s", cast=float, stage="check"
+    )
+
+    def add_busy(
+        self, stage: str, t0: float, t1: float, track: str | None = None
+    ) -> None:
+        """THE stage accounting point (``t0``/``t1`` from
+        ``time.perf_counter()``): run-scoped counter + global cumulative
+        counter + per-batch check-latency sketch + trace span, in one
+        call, so no executor keeps private busy-second arithmetic."""
+        dt = t1 - t0
+        self.metrics.counter("pipeline.stage_busy_s", stage=stage).inc(dt)
+        obs_metrics.REGISTRY.counter(
+            "pipeline.stage_busy_s", stage=stage
+        ).inc(dt)
+        if stage == "check":
+            # the device-interval latency of one batch — the p50/p99
+            # source for obs_overhead and the stats snapshot
+            self.metrics.sketch("pipeline.check_batch_s").add(dt)
+            obs_metrics.REGISTRY.sketch("pipeline.check_batch_s").add(dt)
+        obs_trace.complete(f"pipeline.{stage}", t0, t1, track=track)
+
+    def run_stage(self, stage: str, fn, arg, track: str | None = None):
+        """Run ``fn(arg)`` as an accounted stage (busy time counted on
+        success; a crashing stage aborts the run anyway)."""
+        t0 = time.perf_counter()
+        out = fn(arg)
+        self.add_busy(stage, t0, time.perf_counter(), track=track)
+        return out
+
+    def check_batch_quantile(self, q: float) -> float:
+        return self.metrics.sketch("pipeline.check_batch_s").quantile(q)
 
     def finalize(self) -> "PipelineStats":
         busy = self.produce_busy_s + self.place_busy_s + self.check_busy_s
@@ -169,16 +235,16 @@ def run_pipeline(
             for i, item in enumerate(items):
                 if abort.is_set():
                     return
-                t0 = time.perf_counter()
-                host = produce(item)
-                stats.produce_busy_s += time.perf_counter() - t0
+                host = stats.run_stage("produce", produce, item)
                 put((i, host))
             put(_STOP)
         except BaseException as e:  # noqa: BLE001 - re-raised by consumer
             put(_Crash(i, e))
 
     t_start = time.perf_counter()
-    prod = threading.Thread(target=producer, daemon=True)
+    prod = threading.Thread(
+        target=producer, name="pipeline-producer", daemon=True
+    )
     prod.start()
 
     results: list[Any] = [None] * n
@@ -188,14 +254,12 @@ def run_pipeline(
     def drain_one() -> None:
         nonlocal last_ready
         i, raw, t_disp = in_flight.pop(0)
-        t0 = time.perf_counter()
         results[i] = collect(raw)
         t_ready = time.perf_counter()
         # device occupancy: the interval this batch actually had the
         # device, serialized against the previous batch's completion
-        stats.check_busy_s += t_ready - max(t_disp, last_ready)
+        stats.add_busy("check", max(t_disp, last_ready), t_ready)
         last_ready = t_ready
-        del t0
 
     try:
         while True:
@@ -208,9 +272,7 @@ def run_pipeline(
                     f"{got.index}: {type(got.exc).__name__}: {got.exc}"
                 ) from got.exc
             i, host = got
-            t0 = time.perf_counter()
-            placed = place(host)
-            stats.place_busy_s += time.perf_counter() - t0
+            placed = stats.run_stage("place", place, host)
             t_disp = time.perf_counter()
             raw = check(placed)
             in_flight.append((i, raw, t_disp))
@@ -264,17 +326,19 @@ def run_lanes(
     unit_q: queue.Queue = queue.Queue()
     for k in range(n):
         unit_q.put(k)
-    lock = threading.Lock()
 
     def default_collect(raw):
         jax.block_until_ready(raw)
         return jax.tree.map(np.asarray, raw)
 
     def lane(i: int) -> None:
+        # stage accounting goes straight through the shared stats view
+        # (per-metric locks; no per-lane busy arrays to merge), with
+        # each lane's spans on its own `laneN` track
         fam = fams[i]
+        track = f"lane{i}"
         collect = fam.collect or default_collect
         in_flight: list[tuple[int, Any, float]] = []
-        busy = [0.0, 0.0, 0.0]  # produce, place, check
         last_ready = time.perf_counter()
 
         def drain_one():
@@ -282,7 +346,9 @@ def run_lanes(
             k, raw, t_disp = in_flight.pop(0)
             results[k] = collect(raw)
             t_ready = time.perf_counter()
-            busy[2] += t_ready - max(t_disp, last_ready)
+            stats.add_busy(
+                "check", max(t_disp, last_ready), t_ready, track=track
+            )
             last_ready = t_ready
 
         try:
@@ -291,12 +357,12 @@ def run_lanes(
                     k = unit_q.get_nowait()
                 except queue.Empty:
                     break
-                t0 = time.perf_counter()
-                host = fam.produce(units[k])
-                busy[0] += time.perf_counter() - t0
-                t0 = time.perf_counter()
-                placed = fam.place(host)
-                busy[1] += time.perf_counter() - t0
+                host = stats.run_stage(
+                    "produce", fam.produce, units[k], track=track
+                )
+                placed = stats.run_stage(
+                    "place", fam.place, host, track=track
+                )
                 t_disp = time.perf_counter()
                 raw = fam.check(placed)
                 in_flight.append((k, raw, t_disp))
@@ -308,15 +374,12 @@ def run_lanes(
         except BaseException as e:  # noqa: BLE001 - re-raised below
             abort.set()
             failures.append((i, e))
-        finally:
-            with lock:
-                stats.produce_busy_s += busy[0]
-                stats.place_busy_s += busy[1]
-                stats.check_busy_s += busy[2]
 
     t_start = time.perf_counter()
     threads_ = [
-        threading.Thread(target=lane, args=(i,), daemon=True)
+        threading.Thread(
+            target=lane, args=(i,), name=f"lane{i}", daemon=True
+        )
         for i in range(len(fams))
     ]
     for t in threads_:
@@ -1369,7 +1432,9 @@ def _lane_census(sources, workload):
     """Stat every path source; split into (kept indices, sizes,
     {dropped index: reason}).  Unreadable and zero-length files cannot
     be size-balanced (and a 0-byte history carries no ops) — each drop
-    is LOGGED and later counted in the run's stats."""
+    is LOGGED, incremented in the global ``pipeline.files_dropped``
+    obs counter (the after-the-run countable record the log line never
+    was), and later counted in the run's stats."""
     import logging
     import os
 
@@ -1380,22 +1445,30 @@ def _lane_census(sources, workload):
             sz = os.stat(p).st_size
         except OSError as e:
             reason = f"unreadable history file: {e}"
+            kind = "unreadable"
             log.warning(
                 "lane census: dropping %s (%s) — counted in stats.dropped",
                 p, e,
             )
-            dropped[i] = reason
-            continue
-        if sz == 0:
+        else:
+            if sz > 0:
+                kept.append(i)
+                sizes.append(sz)
+                continue
             reason = "zero-length history file"
+            kind = "zero-length"
             log.warning(
                 "lane census: dropping zero-length %s — counted in "
                 "stats.dropped", p,
             )
-            dropped[i] = reason
-            continue
-        kept.append(i)
-        sizes.append(sz)
+        dropped[i] = reason
+        obs_metrics.REGISTRY.counter(
+            "pipeline.files_dropped", reason=kind
+        ).inc()
+        if obs_trace.is_enabled():
+            obs_trace.event(
+                "pipeline.file_dropped", args={"path": str(p), "reason": kind}
+            )
     return kept, sizes, dropped
 
 
@@ -1579,15 +1652,13 @@ def check_sources(
         t0 = time.perf_counter()
         collected = []
         for it in items:
-            t = time.perf_counter()
-            host = fam.produce(it)
-            stats.produce_busy_s += time.perf_counter() - t
-            t = time.perf_counter()
-            placed = fam.place(host)
-            stats.place_busy_s += time.perf_counter() - t
-            t = time.perf_counter()
-            collected.append(collect(fam.check(placed)))
-            stats.check_busy_s += time.perf_counter() - t
+            host = stats.run_stage("produce", fam.produce, it)
+            placed = stats.run_stage("place", fam.place, host)
+            collected.append(
+                stats.run_stage(
+                    "check", lambda p: collect(fam.check(p)), placed
+                )
+            )
         stats.batches = len(items)
         stats.wall_s = time.perf_counter() - t0
         stats.finalize()

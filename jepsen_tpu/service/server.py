@@ -235,7 +235,13 @@ class CheckerServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
 
-    def __init__(self, host: str = "0.0.0.0", port: int = 8640, mesh=None):
+    def __init__(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 8640,
+        mesh=None,
+        metrics_registry=None,
+    ):
         super().__init__((host, port), _Handler)
         # one device-compute at a time: connections multiplex onto the
         # accelerator serially, which is also the fastest way to use it
@@ -243,15 +249,74 @@ class CheckerServer(socketserver.ThreadingTCPServer):
         # optional (hist, seq) mesh: batches shard across every device the
         # runtime can see (a slice, or a pod via jax.distributed)
         self._mesh = mesh
+        # the shared obs metrics registry (default: the process-global
+        # one): every check op lands its wall latency in a mergeable
+        # quantile sketch, which the /metrics endpoint renders as
+        # p50/p90/p99 — the ROADMAP direction-1 latency-SLO substrate
+        from jepsen_tpu.obs import metrics as obs_metrics
+
+        self.metrics = (
+            obs_metrics.REGISTRY
+            if metrics_registry is None
+            else metrics_registry
+        )
+        self._metrics_srv = None
 
     @property
     def port(self) -> int:
         return self.server_address[1]
 
+    def start_metrics(self, host: str = "0.0.0.0", port: int = 9640):
+        """Serve the shared registry as Prometheus text on
+        ``GET http://host:port/metrics``; returns the HTTP server
+        (``.server_address[1]`` carries the bound port)."""
+        from jepsen_tpu.obs import metrics as obs_metrics
+
+        self._metrics_srv = obs_metrics.serve_metrics(
+            host, port, self.metrics
+        )
+        self._metrics_srv.start_background()
+        return self._metrics_srv
+
+    def server_close(self):
+        if self._metrics_srv is not None:
+            self._metrics_srv.shutdown()
+            self._metrics_srv.server_close()
+            self._metrics_srv = None
+        super().server_close()
+
     def dispatch(
         self, header: dict[str, Any], arrays: dict[str, np.ndarray]
     ) -> dict[str, Any]:
+        import time as _time
+
+        from jepsen_tpu.obs import trace as obs_trace
+
         op = header.get("op")
+        if op in ("check", "check-stream", "check-elle"):
+            t0 = _time.perf_counter()
+            try:
+                reply = self._dispatch(op, header, arrays)
+            except Exception:
+                self.metrics.counter("service.errors", op=op).inc()
+                raise
+            dt = _time.perf_counter() - t0
+            self.metrics.counter("service.requests", op=op).inc()
+            self.metrics.counter("service.histories", op=op).inc(
+                len(reply.get("results", ()))
+            )
+            self.metrics.sketch("service.check_latency_s", op=op).add(dt)
+            # per-thread track (the handler thread's name), NOT one
+            # shared "service" track: concurrent requests overlap in
+            # time (t0 is taken before the device lock), and overlapping
+            # spans on one tid would render as bogus nesting
+            obs_trace.complete(f"service.{op}", t0, t0 + dt)
+            return reply
+        return self._dispatch(op, header, arrays)
+
+    def _dispatch(
+        self, op, header: dict[str, Any], arrays: dict[str, np.ndarray]
+    ) -> dict[str, Any]:
         if op == "ping":
             import jax
 
@@ -335,6 +400,7 @@ def serve_forever(
     port: int = 8640,
     seq: int = 1,
     store: str = "store",
+    metrics_port: int = 9640,
 ) -> None:
     import jax
 
@@ -368,9 +434,20 @@ def serve_forever(
 
         mesh = global_checker_mesh(seq=seq)
     srv = CheckerServer(host, port, mesh=mesh)
+    metrics_note = "off"
+    if metrics_port >= 0:
+        try:
+            msrv = srv.start_metrics(host, metrics_port)
+            metrics_note = f"http://{host}:{msrv.server_address[1]}/metrics"
+        except OSError as e:
+            # a busy metrics port must not take the checker down — the
+            # sidecar's job is verdicts; scraping is best-effort
+            print(f"warning: /metrics endpoint unavailable ({e}); "
+                  f"serving checks without it")
     print(
         f"checker sidecar on {host}:{srv.port} (backend={backend}, "
-        f"mesh={dict(mesh.shape) if mesh else None})"
+        f"mesh={dict(mesh.shape) if mesh else None}, "
+        f"metrics={metrics_note})"
     )
     try:
         srv.serve_forever()

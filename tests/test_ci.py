@@ -647,6 +647,41 @@ class TestBenchColdWarmSmoke:
         # crossover done-bar
         assert wp["crossover_met"] is False
 
+    def test_obs_overhead_section_schema(self, bench):
+        """Offline gate for the ISSUE-10 ``obs_overhead`` bench schema:
+        a tiny real tracing-on-vs-off pair must carry the overhead
+        fraction, the span count, and the p50/p99 check-batch latency
+        keys the flight-recorder done-bar reads.  The fraction itself
+        is asserted only as finite here — a 24-history smoke is noise;
+        the ≤2% claim belongs to the committed full-config log."""
+        details = {}
+        bench._bench_obs_overhead(
+            details, histories=24, base_n=8, n_ops=40, chunk=8, repeats=1
+        )
+        oo = details["obs_overhead"]
+        for key in (
+            "tracing_off_wall_s",
+            "tracing_on_wall_s",
+            "overhead_frac",
+            "within_2pct",
+            "spans_recorded",
+            "check_batch_p50_ms",
+            "check_batch_p99_ms",
+            "e2e_histories_per_sec_traced",
+            "histories",
+            "devices",
+            "lanes",
+            "backend",
+        ):
+            assert key in oo, f"obs_overhead schema lost key {key!r}"
+        assert oo["histories"] == 24
+        assert oo["tracing_off_wall_s"] > 0 and oo["tracing_on_wall_s"] > 0
+        assert oo["spans_recorded"] > 0
+        assert oo["check_batch_p99_ms"] >= oo["check_batch_p50_ms"] > 0
+        assert oo["overhead_frac"] == oo["overhead_frac"]  # finite
+        # the traced run really went through the lanes executor
+        assert oo["lanes"] >= 1
+
     def test_jtc_format_version_roundtrip(self, tmp_path):
         """Offline ``.jtc`` round trip under JAX_PLATFORMS=cpu: write →
         structural read → version-bump rejection (the stale-format-

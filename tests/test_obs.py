@@ -1,0 +1,382 @@
+"""Flight recorder (jepsen_tpu/obs): tracer, metrics, export, endpoint.
+
+The ISSUE-10 test contract: trace round-trip across concurrent lanes
+(well-formed JSON, tracks don't interleave, nesting preserved),
+quantile-sketch merge correctness vs numpy percentiles, the service
+``/metrics`` scrape smoke, and the disabled tracer's zero-allocation
+off-path."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.obs import export as obs_export
+from jepsen_tpu.obs import metrics as obs_metrics
+from jepsen_tpu.obs import trace as obs_trace
+
+
+@pytest.fixture(autouse=True)
+def _tracer_off_after():
+    yield
+    obs_trace.disable()
+
+
+class TestTracerRoundTrip:
+    def _record_lanes(self, n_lanes=4, spans_per_lane=8):
+        obs_trace.enable(capacity=4096)
+
+        def lane(i: int):
+            track = f"lane{i}"
+            for k in range(spans_per_lane):
+                with obs_trace.span(
+                    "outer", track=track, args={"k": k}
+                ):
+                    with obs_trace.span("mid", track=track):
+                        with obs_trace.span("inner", track=track):
+                            pass
+                obs_trace.event("tick", track=track)
+
+        threads = [
+            threading.Thread(target=lane, args=(i,)) for i in range(n_lanes)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return n_lanes, spans_per_lane
+
+    def test_export_well_formed_tracks_and_nesting(self, tmp_path):
+        n_lanes, per = self._record_lanes()
+        out = tmp_path / "trace.json"
+        summary = obs_export.write_trace(out)
+        doc = json.loads(out.read_text())  # well-formed by parse
+        events = doc["traceEvents"]
+        assert summary["events"] == len(events)
+        assert summary["dropped"] == 0
+
+        # track metadata: one thread_name row per lane track
+        names = {
+            ev["tid"]: ev["args"]["name"]
+            for ev in events
+            if ev["ph"] == "M" and ev["name"] == "thread_name"
+        }
+        assert sorted(names.values()) == sorted(
+            f"lane{i}" for i in range(n_lanes)
+        )
+
+        # tracks don't interleave: every span carries its own lane's
+        # tid only (a lane's records never land on another track)...
+        by_tid: dict[int, list] = {}
+        for ev in events:
+            if ev["ph"] == "X":
+                by_tid.setdefault(ev["tid"], []).append(ev)
+        assert len(by_tid) == n_lanes
+        for tid, spans in by_tid.items():
+            assert len(spans) == 3 * per
+            ks = [
+                ev["args"]["k"] for ev in spans if ev["name"] == "outer"
+            ]
+            assert ks == sorted(ks)  # one thread per track: in order
+            # ...and nesting is preserved: on each track the
+            # inner/mid intervals lie within their outer span
+            outers = sorted(
+                (ev for ev in spans if ev["name"] == "outer"),
+                key=lambda e: e["ts"],
+            )
+            for name in ("mid", "inner"):
+                for ev in (e for e in spans if e["name"] == name):
+                    assert any(
+                        o["ts"] - 1e-3 <= ev["ts"]
+                        and ev["ts"] + ev["dur"] <= o["ts"] + o["dur"] + 1e-3
+                        for o in outers
+                    ), (name, ev)
+
+        # instant events present, thread-scoped
+        ticks = [ev for ev in events if ev["ph"] == "i"]
+        assert len(ticks) == n_lanes * per
+        assert all(ev["s"] == "t" for ev in ticks)
+
+    def test_snapshot_survives_disable(self):
+        self._record_lanes(n_lanes=1, spans_per_lane=2)
+        n_live = len(obs_trace.snapshot())
+        obs_trace.disable()
+        assert len(obs_trace.snapshot()) == n_live > 0
+
+    def test_ring_wrap_drops_oldest_and_reports(self):
+        obs_trace.enable(capacity=256)
+        for k in range(600):
+            obs_trace.event("e", track="t", args={"k": k})
+        recs = obs_trace.snapshot()
+        assert len(recs) == 256
+        assert obs_trace.dropped() == 600 - 256
+        # the TAIL survived (flight-recorder semantics)
+        ks = [r[5]["k"] for r in recs]
+        assert ks == list(range(600 - 256, 600))
+
+    def test_complete_records_from_perf_counter_seconds(self):
+        import time
+
+        obs_trace.enable()
+        t0 = time.perf_counter()
+        t1 = t0 + 0.25
+        obs_trace.complete("win", t0, t1, track="nemesis")
+        ((kind, name, track, t_ns, dur_ns, _args),) = obs_trace.snapshot()
+        assert (kind, name, track) == ("X", "win", "nemesis")
+        assert abs(dur_ns - 0.25e9) < 1e6
+
+
+class TestDisabledOverhead:
+    def test_disabled_span_is_shared_noop(self):
+        obs_trace.disable()
+        assert obs_trace.span("a") is obs_trace.span("b")
+        with obs_trace.span("a"):
+            with obs_trace.span("a"):  # reentrant-safe
+                pass
+        obs_trace.event("nothing")  # no-op, no error
+
+    def test_disabled_span_costs_zero_allocations(self):
+        """The off-path contract: a disabled span() call allocates
+        NOTHING (the shared no-op comes back by reference), so leaving
+        instrumentation in hot loops is free when the recorder is off."""
+        import gc
+        import sys
+
+        obs_trace.disable()
+
+        def loop(n):
+            for _ in range(n):
+                with obs_trace.span("hot"):
+                    pass
+                obs_trace.event("hot")
+
+        loop(1000)  # warm (method caches, code objects)
+        gc.collect()
+        gc.disable()
+        try:
+            before = sys.getallocatedblocks()
+            loop(10_000)
+            after = sys.getallocatedblocks()
+        finally:
+            gc.enable()
+        # zero per-span cost: the delta must not scale with the 10k
+        # iterations (a handful of blocks of interpreter noise allowed)
+        assert after - before < 50, f"{after - before} blocks for 10k spans"
+
+
+class TestQuantileSketch:
+    @pytest.mark.parametrize("dist", ["lognormal", "uniform", "exp"])
+    def test_merge_matches_numpy_percentiles(self, dist):
+        rng = np.random.default_rng(7)
+        xs = {
+            "lognormal": rng.lognormal(0.0, 1.0, 20_000),
+            "uniform": rng.uniform(0.001, 5.0, 20_000),
+            "exp": rng.exponential(0.05, 20_000),
+        }[dist]
+        shards = [obs_metrics.QuantileSketch() for _ in range(5)]
+        for i, x in enumerate(xs):
+            shards[i % 5].add(float(x))
+        merged = obs_metrics.QuantileSketch()
+        for s in shards:
+            merged.merge(s)
+        assert merged.count == len(xs)
+        assert merged.sum == pytest.approx(float(xs.sum()), rel=1e-9)
+        for q in (0.5, 0.9, 0.99):
+            got = merged.quantile(q)
+            ref = float(np.percentile(xs, q * 100))
+            # the sketch's own bound is alpha=1% relative error; allow
+            # 2% for the rank interpolation numpy applies and we don't
+            assert abs(got - ref) / ref < 0.02, (q, got, ref)
+
+    def test_merge_refuses_mismatched_alpha(self):
+        a = obs_metrics.QuantileSketch(alpha=0.01)
+        b = obs_metrics.QuantileSketch(alpha=0.05)
+        with pytest.raises(ValueError, match="alpha"):
+            a.merge(b)
+
+    def test_empty_and_zero_handling(self):
+        sk = obs_metrics.QuantileSketch()
+        assert sk.quantile(0.5) != sk.quantile(0.5)  # NaN
+        sk.add(0.0)
+        sk.add(-1.0)
+        sk.add(2.0)
+        assert sk.quantile(0.0) == 0.0
+        assert sk.quantile(1.0) == pytest.approx(2.0, rel=0.02)
+
+
+class TestRegistry:
+    def test_counters_gauges_and_labels(self):
+        reg = obs_metrics.Registry()
+        reg.counter("a.b").inc()
+        reg.counter("a.b").inc(2)
+        reg.counter("a.b", reason="x").inc()
+        reg.gauge("g").set(3.5)
+        assert reg.value("a.b") == 3
+        assert reg.value("a.b", reason="x") == 1
+        assert reg.value("g") == 3.5
+        assert reg.value("never.touched") == 0.0
+
+    def test_kind_collision_is_loud(self):
+        reg = obs_metrics.Registry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.sketch("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+    def test_prometheus_rendering(self):
+        reg = obs_metrics.Registry()
+        reg.counter("pipeline.files_dropped", reason="zero-length").inc(2)
+        sk = reg.sketch("service.check_latency_s", op="check")
+        for v in (0.01, 0.02, 0.03):
+            sk.add(v)
+        text = obs_metrics.render_prometheus(reg)
+        assert (
+            'jepsen_tpu_pipeline_files_dropped{reason="zero-length"} 2'
+            in text
+        )
+        assert "# TYPE jepsen_tpu_service_check_latency_s summary" in text
+        assert 'quantile="0.5"' in text and 'quantile="0.99"' in text
+        assert "jepsen_tpu_service_check_latency_s_count" in text
+
+
+class TestPipelineStatsView:
+    """The PipelineStats refactor contract: same fields, registry-backed."""
+
+    def test_fields_are_registry_views(self):
+        from jepsen_tpu.parallel.pipeline import PipelineStats
+
+        stats = PipelineStats(lanes=2, dropped=1)
+        stats.histories = 5
+        stats.batches = 2
+        stats.add_busy("produce", 0.0, 0.5)
+        stats.add_busy("check", 0.0, 0.25)
+        assert stats.histories == 5 and isinstance(stats.histories, int)
+        assert stats.dropped == 1
+        assert stats.produce_busy_s == pytest.approx(0.5)
+        assert stats.check_busy_s == pytest.approx(0.25)
+        # the registry IS the storage
+        assert stats.metrics.value(
+            "pipeline.stage_busy_s", stage="produce"
+        ) == pytest.approx(0.5)
+        assert stats.metrics.value("pipeline.histories") == 5
+        # per-batch check latency sketch feeds p50/p99
+        assert stats.check_batch_quantile(0.5) == pytest.approx(
+            0.25, rel=0.02
+        )
+        stats.wall_s = 0.5
+        stats.finalize()
+        assert 0.0 <= stats.stage_overlap_frac <= 1.0
+        assert 0.0 <= stats.device_idle_frac <= 1.0
+
+    def test_add_busy_mirrors_global_registry(self):
+        from jepsen_tpu.parallel.pipeline import PipelineStats
+
+        before = obs_metrics.REGISTRY.value(
+            "pipeline.stage_busy_s", stage="place"
+        )
+        PipelineStats().add_busy("place", 0.0, 0.125)
+        assert obs_metrics.REGISTRY.value(
+            "pipeline.stage_busy_s", stage="place"
+        ) == pytest.approx(before + 0.125)
+
+
+class TestMetricsEndpoint:
+    def test_scrape_smoke(self):
+        """GET /metrics serves the registry as Prometheus text."""
+        reg = obs_metrics.Registry()
+        reg.sketch("service.check_latency_s", op="check").add(0.004)
+        reg.counter("service.requests", op="check").inc()
+        srv = obs_metrics.serve_metrics("127.0.0.1", 0, reg)
+        srv.start_background()
+        try:
+            port = srv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert 'jepsen_tpu_service_requests{op="check"} 1' in body
+            assert (
+                'jepsen_tpu_service_check_latency_s{op="check",'
+                'quantile="0.99"}' in body
+            )
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/else", timeout=10
+                )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def test_checker_server_records_and_serves_latency(self):
+        """The acceptance bar: after a real check request the sidecar's
+        /metrics answers p50/p99 check latency from the SHARED registry."""
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+        from jepsen_tpu.service import CheckerClient, CheckerServer
+
+        reg = obs_metrics.Registry()
+        srv = CheckerServer(
+            host="127.0.0.1", port=0, metrics_registry=reg
+        )
+        srv.start_background()
+        msrv = srv.start_metrics("127.0.0.1", 0)
+        try:
+            shs = synth_batch(2, SynthSpec(n_ops=40))
+            with CheckerClient(port=srv.port) as client:
+                results = client.check_histories([s.ops for s in shs])
+            assert all(r["valid?"] for r in results)
+            assert reg.value("service.requests", op="check") == 1
+            assert reg.value("service.histories", op="check") == 2
+            sk = reg.sketch("service.check_latency_s", op="check")
+            assert sk.count == 1 and sk.quantile(0.99) > 0
+            port = msrv.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+            assert (
+                'jepsen_tpu_service_check_latency_s{op="check",'
+                'quantile="0.5"}' in body
+            )
+            assert (
+                'jepsen_tpu_service_check_latency_s{op="check",'
+                'quantile="0.99"}' in body
+            )
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+
+class TestNemesisWindowSpans:
+    def test_fault_windows_become_spans(self, tmp_path):
+        """A traced sim run records one span per nemesis START/STOP
+        window on the `nemesis` track, alongside the run-phase spans —
+        the two timelines red triage needs side by side."""
+        from jepsen_tpu.control.runner import run_test
+        from jepsen_tpu.suite import build_sim_test
+
+        obs_trace.enable()
+        opts = {
+            "rate": 400.0,
+            "time-limit": 1.5,
+            "time-before-partition": 0.3,
+            "partition-duration": 0.4,
+            "recovery-sleep": 0.2,
+        }
+        test, _cluster = build_sim_test(
+            opts=opts, store_root=str(tmp_path / "store")
+        )
+        run = run_test(test)
+        obs_trace.disable()
+        assert run.results.get("valid?") is True
+        recs = obs_trace.snapshot()
+        nemesis = [
+            r for r in recs
+            if r[0] == "X" and str(r[1]).startswith("nemesis:")
+        ]
+        assert nemesis, "no fault-window spans recorded"
+        assert all(r[2] == "nemesis" for r in nemesis)
+        phases = {r[1] for r in recs if r[2] == "run"}
+        assert {"run.setup", "run.load", "run.analysis"} <= phases

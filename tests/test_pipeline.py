@@ -403,7 +403,10 @@ class TestStreamRowsCache:
     def test_corrupt_cache_ignored(self, tmp_path, caplog):
         """Corruption in EITHER backing store (the ``.jtc`` substrate or
         a legacy npz) must never serve wrong data: the jtc corruption is
-        LOGGED (never a silent fallback) and the load reports a miss."""
+        LOGGED (never a silent fallback), COUNTED in the obs registry
+        (``jtc.fallback{reason=corrupt}`` — the after-the-run record the
+        scrolled-away log line never was, ISSUE 10), and the load
+        reports a miss."""
         import logging
 
         from jepsen_tpu.history.columnar import jtc_path_for
@@ -412,6 +415,7 @@ class TestStreamRowsCache:
             save_stream_rows_cache,
             stream_rows_cache_path,
         )
+        from jepsen_tpu.obs.metrics import REGISTRY
 
         base = synth_stream_batch(1, StreamSynthSpec(n_ops=10))
         (p,) = _write(tmp_path, base)
@@ -422,8 +426,12 @@ class TestStreamRowsCache:
         raw[-1] ^= 0xFF
         jtc_path_for(p).write_bytes(raw)
         stream_rows_cache_path(p).write_bytes(b"not an npz")
+        before = REGISTRY.value("jtc.fallback", reason="corrupt")
         with caplog.at_level(logging.WARNING, "jepsen_tpu.history.columnar"):
             assert load_stream_rows_cache(p) is None
         assert any(
             "corrupt columnar substrate" in r.message for r in caplog.records
         )
+        # the counter, not just the log line: triage after the run can
+        # ask the registry how many fallbacks happened and why
+        assert REGISTRY.value("jtc.fallback", reason="corrupt") >= before + 1

@@ -189,11 +189,27 @@ class TestLaneCensus:
         empty.write_text("")
         missing = tmp_path / "not" / "here.jsonl"
         mix = files[:2] + [empty] + files[2:4] + [missing] + files[4:]
+        from jepsen_tpu.obs.metrics import REGISTRY
+
+        zero_before = REGISTRY.value(
+            "pipeline.files_dropped", reason="zero-length"
+        )
+        unread_before = REGISTRY.value(
+            "pipeline.files_dropped", reason="unreadable"
+        )
         with caplog.at_level(logging.WARNING, "jepsen_tpu.parallel.pipeline"):
             res, stats = check_sources("stream", mix, chunk=3, lanes=2)
         assert stats.dropped == 2
         # every drop named in the log — no silent truncation
         assert "zero.jsonl" in caplog.text and "here.jsonl" in caplog.text
+        # ... and countable AFTER the run in the global obs registry,
+        # by reason (ISSUE 10: the log line alone was the blind spot)
+        assert REGISTRY.value(
+            "pipeline.files_dropped", reason="zero-length"
+        ) == zero_before + 1
+        assert REGISTRY.value(
+            "pipeline.files_dropped", reason="unreadable"
+        ) == unread_before + 1
         # the results list keeps one entry per source, with explicit
         # unknown verdicts at the dropped positions
         assert len(res) == len(mix)

@@ -1,0 +1,192 @@
+"""Record the ISSUE-10 flight-recorder evidence artifact: ONE Perfetto
+trace holding both timelines red triage needs side by side —
+
+1. **Live phase**: a short mixed-nemesis durable queue run on the real
+   local cluster (the soak recipe at compressed duration), under the
+   ``tests/_live.py`` triage rules.  The runner's instrumentation puts
+   every fault window on the ``nemesis`` track and the run phases
+   (setup / load / teardown / analysis) on the ``run`` track; the
+   pipelined post-run analysis (``attach_pipelined_checkers``) already
+   emits produce/place/check stage spans for the run's own history.
+2. **North-star phase**: the full BASELINE.json #1 config (10k ×
+   ~1000-op-row histories, bytes → verdict) through the meshed
+   multi-lane reduced pipeline — the PR-5 north-star run, now visible
+   as per-lane stage spans plus mesh collective-dispatch spans.
+
+Fail-loud capture discipline (tools/soak.py's rule): the artifact is
+written ONLY when the live phase reached its expected verdict, the
+north-star check completed, AND the ring actually holds both
+nemesis-window and pipeline-stage spans — anything else exits non-zero
+with no artifact.
+
+Recipe for the committed artifact (2-core CPU container, 8 virtual
+devices — the same shape the north_star bench section pins)::
+
+    python tools/record_trace.py --out store/trace_r9_northstar_nemesis.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get(
+    "XLA_FLAGS", ""
+):
+    # the v5e-8 mesh shape the north-star target names (bench.py's
+    # section discipline); must land before jax initializes
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+
+def _live_phase(args) -> None:
+    """The soak recipe at compressed duration: mixed nemesis, durable
+    queue, pipelined analysis, triage to the expected-green verdict."""
+    from _live import run_live_with_triage
+
+    from jepsen_tpu.client import native as native_mod
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.parallel.pipeline import attach_pipelined_checkers
+
+    opts = {
+        "rate": args.rate,
+        "time-limit": args.live_seconds,
+        "time-before-partition": 2.0,
+        "partition-duration": 5.0,
+        "network-partition": "partition-random-halves",
+        "nemesis": "mixed",
+        "recovery-sleep": 8.0,
+        "publish-confirm-timeout": 5.0,
+        "durable": True,
+        "seed": args.seed,
+    }
+
+    def build():
+        native_mod.reset()
+        test, transport = build_local_test(
+            opts,
+            n_nodes=args.nodes,
+            concurrency=args.nodes,
+            checker_backend="cpu",
+            store_root=args.store,
+            workload="queue",
+            durable=True,
+        )
+        attach_pipelined_checkers(test, "queue")
+        return test, transport
+
+    run = run_live_with_triage(build, expect="valid", max_attempts=2)
+    print(
+        f"# live phase: {len(run.history)} history ops, "
+        f"valid?={run.results.get('valid?')}",
+        flush=True,
+    )
+
+
+def _north_star_phase(args) -> None:
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+    from jepsen_tpu.parallel.mesh import checker_mesh
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    base = synth_batch(
+        args.base_n, SynthSpec(n_ops=args.n_ops, n_processes=5), lost=1
+    )
+    with tempfile.TemporaryDirectory() as td:
+        files = []
+        for i, sh in enumerate(base):
+            p = os.path.join(td, f"h{i}.jsonl")
+            write_history_jsonl(p, sh.ops)
+            files.append(p)
+        reps = (args.histories + args.base_n - 1) // args.base_n
+        srcs = (files * reps)[: args.histories]
+        t0 = time.perf_counter()
+        verdict, stats = check_sources(
+            "queue", srcs, chunk=args.chunk, mesh=checker_mesh(), lanes=0,
+            reduce=True, use_cache=False,
+        )
+        wall = time.perf_counter() - t0
+    print(
+        f"# north-star phase: {args.histories} histories bytes->verdict "
+        f"in {wall:.1f}s over {stats.lanes} lanes "
+        f"(invalid={verdict['invalid']})",
+        flush=True,
+    )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", required=True)
+    p.add_argument("--nodes", type=int, default=3)
+    p.add_argument("--live-seconds", type=float, default=25.0)
+    p.add_argument("--rate", type=float, default=40.0)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--histories", type=int, default=10_000)
+    p.add_argument("--base-n", type=int, default=128)
+    p.add_argument("--n-ops", type=int, default=470)
+    p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--store", default=None,
+                   help="live-phase store root (default: a temp dir)")
+    args = p.parse_args(argv)
+    if args.store is None:
+        args.store = tempfile.mkdtemp(prefix="record_trace_")
+
+    from jepsen_tpu.obs import export as obs_export
+    from jepsen_tpu.obs import trace as obs_trace
+
+    obs_trace.enable(capacity=1 << 18)
+    try:
+        with obs_trace.span("phase.live", track="phases"):
+            _live_phase(args)
+        with obs_trace.span("phase.north_star_check", track="phases"):
+            _north_star_phase(args)
+    except BaseException as e:
+        print(
+            f"# NO artifact: run did not complete "
+            f"({type(e).__name__}: {e})",
+            flush=True,
+        )
+        raise
+    finally:
+        obs_trace.disable()
+
+    recs = obs_trace.snapshot()
+    nemesis = sum(
+        1 for r in recs if r[0] == "X" and str(r[1]).startswith("nemesis:")
+    )
+    pipeline = sum(
+        1 for r in recs if r[0] == "X" and str(r[1]).startswith("pipeline.")
+    )
+    if not nemesis or not pipeline:
+        print(
+            f"# NO artifact: ring holds {nemesis} nemesis-window and "
+            f"{pipeline} pipeline-stage spans — both must be visible "
+            f"(the artifact's whole claim)",
+            flush=True,
+        )
+        return 1
+    summary = obs_export.write_trace(args.out)
+    summary["nemesis_window_spans"] = nemesis
+    summary["pipeline_stage_spans"] = pipeline
+    print(f"# trace artifact: {json.dumps(summary)}", flush=True)
+    print(
+        "# open at https://ui.perfetto.dev — nemesis windows overlay "
+        "the lane/stage work",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

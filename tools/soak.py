@@ -198,6 +198,12 @@ def run_soak(args) -> int:
         flush=True,
     )
 
+    from jepsen_tpu.obs import trace as obs_trace
+
+    if args.trace_out:
+        obs_trace.enable()
+        print(f"# soak: flight recorder on -> {args.trace_out}", flush=True)
+
     monitors = []
 
     def build():
@@ -242,9 +248,19 @@ def run_soak(args) -> int:
 
     t0 = time.monotonic()
     try:
-        run = run_live_with_triage(
-            build, expect=args.expect, max_attempts=args.attempts
-        )
+        with obs_trace.span(
+            "soak.run",
+            track="soak",
+            args=(
+                {"workload": args.workload, "minutes": args.minutes,
+                 "nodes": args.nodes, "seed": args.seed}
+                if obs_trace.is_enabled()
+                else None
+            ),
+        ):
+            run = run_live_with_triage(
+                build, expect=args.expect, max_attempts=args.attempts
+            )
     except AssertionError as e:
         print(f"# soak FAILED to reach expect={args.expect}: {e}", flush=True)
         return 1
@@ -268,7 +284,13 @@ def run_soak(args) -> int:
         print("Everything looks good! ヽ('ー`)ノ")
     else:
         print("Analysis invalid! ಠ~ಠ")
-    # triage guarantees the run reached the EXPECTED verdict
+    # triage guarantees the run reached the EXPECTED verdict — only now
+    # may the trace artifact land (the --out capture discipline)
+    if args.trace_out:
+        from jepsen_tpu.obs import export as obs_export
+
+        summary = obs_export.write_trace(args.trace_out)
+        print(f"# soak trace: {json.dumps(summary)}", flush=True)
     return 0
 
 
@@ -313,6 +335,12 @@ def main(argv=None) -> int:
                         "written when the run reaches its expected "
                         "verdict (failure leaves OUT.failed and a "
                         "non-zero exit)")
+    p.add_argument("--trace-out", default=None,
+                   help="record the soak through the flight recorder "
+                        "(jepsen_tpu/obs) and export a Perfetto trace "
+                        "here — same capture discipline as --out: the "
+                        "artifact lands only when the run reached its "
+                        "expected verdict")
     args = p.parse_args(argv)
     if args.fenced and args.workload != "mutex":
         p.error("--fenced only applies to --workload mutex")

@@ -283,6 +283,17 @@ def _cmd_check_procs(args, paths, workload: str, prev: dict) -> int:
         )
         save_results(Path(p).parent, result)
         composed.append(result)
+    if getattr(args, "report", False):
+        # per-run artifacts for the whole tree; `jepsen-tpu report`
+        # builds the cross-run index over the same pages
+        from jepsen_tpu.report.render import render_run_report
+
+        for p in paths:
+            try:
+                render_run_report(Path(p).parent)
+            except Exception as e:  # noqa: BLE001 — verdicts already saved
+                print(f"# report rendering failed for {p}: {e}",
+                      file=sys.stderr)
     if len(composed) == 1:
         print(json.dumps(composed[0], indent=1, default=_json_default))
     else:
@@ -375,6 +386,14 @@ def cmd_check(args) -> int:
         file=sys.stderr,
     )
     save_results(out_dir, result)
+    if getattr(args, "report", False):
+        from jepsen_tpu.report.render import render_run_report
+
+        paths = render_run_report(out_dir, history=history, results=result)
+        print(
+            "# report: " + " ".join(sorted(paths.values())),
+            file=sys.stderr,
+        )
     return _verdict_exit(result[VALID])
 
 
@@ -1256,6 +1275,7 @@ def cmd_test(args) -> int:
         test.checker.checkers["log-file-pattern"] = LogFilePattern(
             args.log_file_pattern
         )
+    test.report = not getattr(args, "no_report", False)
     monitor = None
     if args.live_check:
         from jepsen_tpu.checkers.live import attach_live_monitor_for
@@ -1447,6 +1467,34 @@ def cmd_serve_checker(args) -> int:
     return 0
 
 
+def cmd_report(args) -> int:
+    """``jepsen-tpu report <store-dir>``: render any missing per-run
+    reports under the tree and (re)build the cross-run ``index.html``
+    (verdict/latency-headline rows + trend sparkline).  Pointed at a
+    single run dir, it renders just that run's artifacts."""
+    from jepsen_tpu.history.store import RESULTS_FILE
+
+    root = Path(args.store)
+    if not root.is_dir():
+        print(f"error: no such store dir {root}", file=sys.stderr)
+        return 2
+    if (root / HISTORY_FILE).is_file() or (root / RESULTS_FILE).is_file():
+        from jepsen_tpu.report.render import render_run_report
+
+        paths = render_run_report(root)
+        for name in sorted(paths):
+            print(f"{name}: {paths[name]}")
+        return 0
+    from jepsen_tpu.report.index import build_store_index
+
+    idx = build_store_index(root, render_missing=not args.no_render)
+    if idx is None:
+        print(f"no runs under {root}", file=sys.stderr)
+        return 2
+    print(str(idx))
+    return 0
+
+
 def cmd_trace(args) -> int:
     """Record any CLI run through the flight recorder and export a
     Perfetto/Chrome trace: ``jepsen-tpu trace [--out F] -- check ...``.
@@ -1495,11 +1543,26 @@ def cmd_trace(args) -> int:
             except RuntimeError:
                 pass  # trace never started (early arg error)
     if rc != 0:
-        print(
-            f"# trace NOT written: wrapped command exited {rc} (an "
-            f"artifact only lands on a completed run)",
-            file=sys.stderr,
-        )
+        if getattr(args, "keep_on_failure", False):
+            # failing runs are exactly the ones whose traces matter for
+            # triage — keep the recording, but NEVER at the artifact
+            # path: `<out>.failed` cannot be mistaken for committed
+            # evidence (the soak/fuzz capture discipline)
+            summary = obs_export.write_trace(
+                f"{out}.failed", merge_jax_profile_dir=profile_dir or None
+            )
+            print(
+                f"# wrapped command exited {rc}; trace kept at "
+                f"{summary['path']} (--keep-on-failure; NOT evidence)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"# trace NOT written: wrapped command exited {rc} (an "
+                f"artifact only lands on a completed run; "
+                f"--keep-on-failure writes {out}.failed instead)",
+                file=sys.stderr,
+            )
         return rc
     summary = obs_export.write_trace(
         out, merge_jax_profile_dir=profile_dir or None
@@ -1656,6 +1719,15 @@ def build_parser() -> argparse.ArgumentParser:
         choices=("auto", "queue", "stream", "elle", "mutex"),
         default="auto",
         help="checker family; auto-detected from the history's op kinds",
+    )
+    c.add_argument(
+        "--report",
+        action="store_true",
+        help="after the check, render the per-run report artifacts into "
+        "the run dir (report.html latency/throughput panels with "
+        "nemesis windows shaded, timeline.html per-process op "
+        "timeline, forensics.html on an invalid verdict — "
+        "jepsen_tpu/report/)",
     )
     c.add_argument(
         "--procs",
@@ -1861,6 +1933,14 @@ def build_parser() -> argparse.ArgumentParser:
         "invalidate the run on any match — jepsen.checker/"
         "log-file-pattern; the SUT can be broken even when the "
         "history looks consistent",
+    )
+    t.add_argument(
+        "--no-report",
+        dest="no_report",
+        action="store_true",
+        help="skip the default-on per-run report artifacts "
+        "(report.html/timeline.html — jepsen writes store/report for "
+        "every run; this framework now does too)",
     )
     t.add_argument(
         "--live-check",
@@ -2080,6 +2160,24 @@ def build_parser() -> argparse.ArgumentParser:
     )
     sc.set_defaults(fn=cmd_serve_checker)
 
+    rp = sub.add_parser(
+        "report",
+        help="render run reports + the cross-run index.html for a "
+        "store tree (jepsen_tpu/report/; runs a single run dir too)",
+    )
+    rp.add_argument(
+        "store",
+        help="store root (index + any missing per-run reports) or a "
+        "single run dir (that run's artifacts only)",
+    )
+    rp.add_argument(
+        "--no-render",
+        action="store_true",
+        help="index only what already has a report.json; render "
+        "nothing new",
+    )
+    rp.set_defaults(fn=cmd_report)
+
     tr = sub.add_parser(
         "trace",
         help="record any CLI run through the flight recorder and "
@@ -2097,6 +2195,15 @@ def build_parser() -> argparse.ArgumentParser:
         type=int,
         default=1 << 16,
         help="span ring capacity (oldest records drop past it)",
+    )
+    tr.add_argument(
+        "--keep-on-failure",
+        dest="keep_on_failure",
+        action="store_true",
+        help="when the wrapped command exits non-zero, still export the "
+        "recording — to <out>.failed, never the artifact path "
+        "(failing runs are the ones whose traces matter; the .failed "
+        "suffix keeps the capture discipline honest)",
     )
     tr.add_argument(
         "--jax-profile",
@@ -2157,8 +2264,10 @@ def build_parser() -> argparse.ArgumentParser:
 def _wants_device_backend(args) -> bool:
     """True when the subcommand benefits from the real default backend
     (a TPU if the environment has one)."""
-    if args.command in ("synth", "serve"):
-        return False  # host-only work
+    if args.command in ("synth", "serve", "report"):
+        # host-only work (report's windowed-stats kernel is a tiny CPU
+        # dispatch; rendering must never hang on a wedged chip tunnel)
+        return False
     if args.command in ("bench-check", "serve-checker"):
         return True  # device-throughput measurement / checker sidecar
     if args.command == "trace":

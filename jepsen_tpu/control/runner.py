@@ -75,6 +75,10 @@ class Test:
     #: (invocations AND completions, in history order) — the hook behind
     #: mid-run anomaly monitoring (checkers/live.py)
     observers: list = field(default_factory=list)
+    #: render the per-run HTML report (report.html / timeline.html /
+    #: forensics.html on invalid) into the run dir after analysis —
+    #: default ON like jepsen's store/report; ``--no-report`` disables
+    report: bool = True
 
     def as_map(self) -> dict[str, Any]:
         return {
@@ -398,10 +402,30 @@ def _run_test_logged(
             else None
         ),
     ):
-        results = test.checker.check(
-            test_map, history, {"out_dir": run_dir}
-        )
+        check_opts: dict[str, Any] = {"out_dir": run_dir}
+        results = test.checker.check(test_map, history, check_opts)
     st.save_results(run_dir, results)
+    if test.report:
+        # default-on like jepsen's store/report; best-effort — a report
+        # renderer bug must never change a run's verdict or lose its
+        # recorded history (the failure is LOUD in the run log).  The
+        # WindowedPerf checker stashed its tensors into check_opts, so
+        # the render reuses them instead of re-packing the history.
+        with obs_trace.span("run.report", track="run"):
+            try:
+                from jepsen_tpu.report.perfstats import STATS_OPT
+                from jepsen_tpu.report.render import render_run_report
+
+                render_run_report(
+                    run_dir,
+                    history=history,
+                    results=results,
+                    stats=check_opts.get(STATS_OPT),
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception(
+                    "run report rendering failed (verdict unaffected)"
+                )
     verdict = results.get(VALID)
     if verdict is True:
         logger.info("Everything looks good! (%d ops)", len(history))

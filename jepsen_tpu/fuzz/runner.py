@@ -48,6 +48,9 @@ class FuzzOutcome:
     notes: list[str] = field(default_factory=list)
     invalidating: dict[str, Any] | None = None
     history_len: int = 0
+    #: the run directory behind this outcome (None when the run
+    #: crashed before recording) — forensics pages render from it
+    run_dir: Any = None
 
 
 def build_fuzz_test(cfg: FuzzConfig, store_root: str):
@@ -120,11 +123,13 @@ def run_once(cfg: FuzzConfig, store_root: str) -> FuzzOutcome:
             results=results,
             notes=["final read missing (drain observed nothing)"],
             history_len=len(run.history),
+            run_dir=run.run_dir,
         )
     verdict = results.get("valid?")
     if verdict is True:
         return FuzzOutcome(
-            "green", results=results, history_len=len(run.history)
+            "green", results=results, history_len=len(run.history),
+            run_dir=run.run_dir,
         )
     if verdict is False:
         return FuzzOutcome(
@@ -132,12 +137,14 @@ def run_once(cfg: FuzzConfig, store_root: str) -> FuzzOutcome:
             results=results,
             invalidating=describe_invalid(results),
             history_len=len(run.history),
+            run_dir=run.run_dir,
         )
     return FuzzOutcome(
         "undecided",
         results=results,
         notes=["analysis unknown"],
         history_len=len(run.history),
+        run_dir=run.run_dir,
     )
 
 

@@ -239,9 +239,41 @@ def _prom_labels(labels: tuple, extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
+def _trace_health_lines() -> list[str]:
+    """Span-ring health for the ``/metrics`` surface (ISSUE-11
+    satellite): occupancy (filled slots / capacity), TOTAL dropped
+    records (overwritten by wrap — previously visible only via
+    ``trace.dropped()`` in-process), and a per-track counter of spans
+    RECORDED (emit-time totals, maintained incrementally in
+    ``obs/trace.py`` — a scrape must never scan a 64k-slot ring under
+    the GIL of a serving sidecar)."""
+    from jepsen_tpu.obs import trace as _trace
+
+    capacity = _trace.ring_capacity()
+    recorded = _trace.spans_recorded()
+    occupancy = min(recorded, capacity) / capacity if capacity else 0.0
+    lines = [
+        "# TYPE jepsen_tpu_trace_ring_occupancy gauge",
+        f"jepsen_tpu_trace_ring_occupancy {occupancy}",
+        "# TYPE jepsen_tpu_trace_spans_dropped_total counter",
+        f"jepsen_tpu_trace_spans_dropped_total {_trace.dropped()}",
+    ]
+    by_track = _trace.track_span_counts()
+    if by_track:
+        lines.append("# TYPE jepsen_tpu_trace_spans_total counter")
+        for track in sorted(by_track):
+            lines.append(
+                f'jepsen_tpu_trace_spans_total{{track="{track}"}} '
+                f"{by_track[track]}"
+            )
+    return lines
+
+
 def render_prometheus(registry: Registry | None = None) -> str:
     """The registry in the Prometheus text exposition format (v0.0.4).
-    Sketches render as summaries with p50/p90/p99 quantile labels."""
+    Sketches render as summaries with p50/p90/p99 quantile labels;
+    the span-ring health block (:func:`_trace_health_lines`) rides
+    every render."""
     registry = registry or REGISTRY
     lines: list[str] = []
     typed: set[str] = set()
@@ -262,14 +294,21 @@ def render_prometheus(registry: Registry | None = None) -> str:
             lines.append(f"{pname}_sum{_prom_labels(labels)} {metric.sum}")
         else:
             lines.append(f"{pname}{_prom_labels(labels)} {metric.value}")
+    lines += _trace_health_lines()
     return "\n".join(lines) + "\n"
 
 
 def serve_metrics(
-    host: str = "0.0.0.0", port: int = 9640, registry: Registry | None = None
+    host: str = "0.0.0.0",
+    port: int = 9640,
+    registry: Registry | None = None,
+    store: str | None = None,
 ):
     """A stdlib HTTP server answering ``GET /metrics`` with the
     Prometheus text rendering of ``registry`` (default: the global one).
+    With ``store`` set, also answers ``GET /report/<run>`` — the per-run
+    report for a run directory under the store root, rendered on demand
+    (``jepsen_tpu/report/``) and containment-checked against the root.
     Returns the server (``.server_address`` carries the bound port;
     ``.shutdown()``/``.server_close()`` to stop); the caller starts it —
     ``threading.Thread(target=srv.serve_forever, daemon=True).start()``
@@ -277,11 +316,112 @@ def serve_metrics(
     import http.server
 
     reg = registry or REGISTRY
+    # render-on-demand serialization: the server threads requests, and
+    # two concurrent renders of one run dir would race (the writes are
+    # atomic tmp→rename, so readers are safe either way — the lock just
+    # stops redundant double renders)
+    render_lock = threading.Lock()
 
     class _Handler(http.server.BaseHTTPRequestHandler):
+        def _serve_report(self, path: str, rel: str) -> None:
+            from pathlib import Path
+            from urllib.parse import unquote
+
+            root = Path(store).resolve()
+            target = (root / unquote(rel).lstrip("/")).resolve()
+            if root not in (target, *target.parents):
+                self.send_error(403, "path escapes the store root")
+                return
+            if target.is_dir():
+                # redirect so the page's RELATIVE links (timeline,
+                # forensics) resolve inside the run dir.  Location is
+                # built from the QUERY-STRIPPED path — appending to the
+                # raw self.path would re-enter this branch forever on
+                # any /report/<run>?query URL.  A non-run directory
+                # (e.g. the store root) goes to its index.html, never
+                # to a render-on-demand that cannot succeed.
+                from jepsen_tpu.history.store import (
+                    HISTORY_FILE,
+                    RESULTS_FILE,
+                )
+
+                if (
+                    (target / HISTORY_FILE).is_file()
+                    or (target / RESULTS_FILE).is_file()
+                ):
+                    leaf = "report.html"
+                elif (target / "index.html").is_file():
+                    leaf = "index.html"
+                else:
+                    self.send_error(
+                        404,
+                        "not a run dir and no index.html (build one "
+                        "with `jepsen-tpu report <store>`)",
+                    )
+                    return
+                self.send_response(302)
+                self.send_header("Location", path.rstrip("/") + "/" + leaf)
+                self.end_headers()
+                return
+            if target.name == "report.html" and not target.is_file():
+                from jepsen_tpu.history.store import (
+                    HISTORY_FILE,
+                    RESULTS_FILE,
+                )
+
+                d = target.parent
+                if not (
+                    (d / HISTORY_FILE).is_file()
+                    or (d / RESULTS_FILE).is_file()
+                ):
+                    self.send_error(404, "no run recorded there")
+                    return
+                from jepsen_tpu.report.render import render_run_report
+
+                try:
+                    with render_lock:
+                        if not target.is_file():  # lost the race: done
+                            render_run_report(d)
+                except Exception as e:  # noqa: BLE001 — say why, in
+                    # the BODY: send_error's message lands in the HTTP
+                    # status line, where exception text (newlines,
+                    # non-latin-1) corrupts the response
+                    self.send_error(
+                        500,
+                        "report rendering failed",
+                        str(e).replace("\n", " ")[:500],
+                    )
+                    return
+            if not target.is_file() or target.suffix not in (
+                ".html", ".json", ".svg", ".png", ".txt",
+            ):
+                self.send_error(404, "no such report artifact")
+                return
+            body = target.read_bytes()
+            ctype = {
+                ".html": "text/html; charset=utf-8",
+                ".json": "application/json",
+                ".svg": "image/svg+xml",
+                ".png": "image/png",
+                ".txt": "text/plain; charset=utf-8",
+            }[target.suffix]
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_GET(self):  # noqa: N802 - stdlib API
-            if self.path.split("?", 1)[0] != "/metrics":
-                self.send_error(404, "only /metrics lives here")
+            path = self.path.split("?", 1)[0]
+            if store is not None and path.startswith("/report/"):
+                self._serve_report(path, path[len("/report/"):])
+                return
+            if path != "/metrics":
+                self.send_error(
+                    404,
+                    "only /metrics (and /report/<run>, when a store "
+                    "is wired) lives here",
+                )
                 return
             body = render_prometheus(reg).encode()
             self.send_response(200)

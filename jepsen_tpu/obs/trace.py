@@ -47,7 +47,8 @@ _DEFAULT_CAPACITY = 1 << 16
 class _State:
     """One enabled tracing session: the ring and its slot counter."""
 
-    __slots__ = ("ring", "capacity", "slots", "high", "t0_ns")
+    __slots__ = ("ring", "capacity", "slots", "high", "t0_ns",
+                 "track_spans")
 
     def __init__(self, capacity: int):
         self.capacity = max(256, int(capacity))
@@ -60,6 +61,12 @@ class _State:
         # a correctness invariant
         self.high = 0
         self.t0_ns = time.perf_counter_ns()
+        # per-track RECORDED span totals (monotonic, survive ring
+        # wrap) — maintained at emit time so the /metrics health block
+        # never has to scan the whole ring per scrape.  Same accuracy
+        # contract as `high`: unlocked read-modify-write, a rare lost
+        # increment under emitter races costs gauge accuracy only
+        self.track_spans: dict = {}
 
 
 #: None = disabled.  Read once per call; enable/disable swap the whole
@@ -102,6 +109,9 @@ def _emit(st: _State, rec: tuple) -> None:
     st.ring[i % st.capacity] = rec
     if i >= st.high:
         st.high = i + 1
+    if rec[0] == KIND_SPAN:
+        d = st.track_spans
+        d[rec[2]] = d.get(rec[2], 0) + 1
 
 
 class _Span:
@@ -232,6 +242,21 @@ def dropped() -> int:
     if st is None:
         return 0
     return max(0, st.high - st.capacity)
+
+
+def ring_capacity() -> int:
+    """The ring's slot count (live session or — after :func:`disable`
+    — the last one); 0 when no session ever ran."""
+    st = _session()
+    return st.capacity if st is not None else 0
+
+
+def track_span_counts() -> dict:
+    """``{track: spans recorded}`` for the live (or last) session —
+    monotonic emit-time totals (wrap-dropped spans stay counted), so a
+    scrape never scans the ring."""
+    st = _session()
+    return dict(st.track_spans) if st is not None else {}
 
 
 def session_t0_ns() -> int:

@@ -1,0 +1,24 @@
+"""Run reports: device-computed perf/timeline analytics, verdict
+forensics, and the cross-run trend index (ISSUE 11 / OBSERVABILITY.md
+§Run reports).
+
+The reference suite composes ``checker/perf`` (latency/rate graphs) and
+``jepsen.checker.timeline`` (per-process HTML op timelines) into every
+test; this package is that analysis-and-evidence layer for the batched
+world: the number-crunching is one vmapped XLA dispatch over the
+``.jtc`` row columns (``perfstats``), the artifacts are deterministic
+self-contained HTML with embedded SVG (``render``), invalid verdicts get
+an op-level forensics page (``forensics``), and a store full of runs
+becomes a browsable index with trend sparklines (``index``).
+"""
+
+from jepsen_tpu.report.perfstats import (  # noqa: F401
+    WindowedPerf,
+    WindowedStats,
+    sketch_from_hist,
+    windowed_stats,
+    windowed_stats_rows,
+)
+from jepsen_tpu.report.render import render_run_report  # noqa: F401
+from jepsen_tpu.report.forensics import render_forensics  # noqa: F401
+from jepsen_tpu.report.index import build_store_index  # noqa: F401

@@ -1,0 +1,221 @@
+"""Counterexample forensics: an invalid verdict becomes a readable page.
+
+A bare ``valid? false`` tells an operator nothing about WHICH ops broke
+the model.  This module extracts the violating evidence the checkers
+already computed — lost/duplicated/unexpected values for the queue
+family, the refuted projection class (double-grant / token-order /
+order-violation) the P-compositional mutex search names, divergent/
+phantom stream reads — flags every history op that touches it, and
+renders the op window around the first violation with the flagged ops
+highlighted.  When the run came from a minimized fuzz repro, the page
+links the repro driver (``emit.py`` passes it through).
+
+Same determinism + well-formed-XML contract as ``report/render.py``.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+from xml.sax.saxutils import escape, quoteattr
+
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpType
+from jepsen_tpu.report.render import (
+    COLORS,
+    FORENSICS_FILE,
+    _CSS,
+    write_artifact,
+)
+
+#: ops shown around the first violating op when the history is long
+_WINDOW = 120
+
+
+def _as_set(v) -> set:
+    """results.json round-trips checker sets as lists; live results
+    still hold sets."""
+    if v is None:
+        return set()
+    if isinstance(v, (set, frozenset)):
+        return set(v)
+    if isinstance(v, (list, tuple)):
+        return set(v)
+    return {v}
+
+
+def violating_values(results: Mapping[str, Any]) -> dict[str, set]:
+    """``{reason: values}`` extracted from every invalid sub-result —
+    the queue family's lost/duplicated/unexpected sets, the stream
+    family's anomaly sets, the mutex family's refuted class."""
+    out: dict[str, set] = {}
+
+    def add(reason: str, values) -> None:
+        vs = _as_set(values)
+        if vs:
+            out.setdefault(reason, set()).update(vs)
+
+    for name in sorted(results):
+        r = results.get(name)
+        if not isinstance(r, dict) or r.get("valid?") is not False:
+            continue
+        for reason in ("lost", "unexpected", "duplicated"):
+            add(reason, r.get(reason))
+        for reason in (
+            "divergent", "phantom", "non-monotonic", "reordered",
+            "duplicated-reads",
+        ):
+            add(reason, r.get(reason))
+        # pcomp: the refuted projection class — ('value', v) / lock key
+        cls = r.get("invalid-class")
+        if cls is not None:
+            if isinstance(cls, (list, tuple)) and len(cls) == 2:
+                add(f"refuted-class:{cls[0]}", [cls[1]])
+            else:
+                add("refuted-class", [cls])
+        ov = r.get("order-violation")
+        if ov:
+            add("order-violation", ov)
+    return out
+
+
+def _op_values(op: Op) -> set:
+    """Every scalar a history op touches (drain/read completions carry
+    lists; mutex tokens ride ``[key, token]`` pairs)."""
+    v = op.value
+    if v is None:
+        return set()
+    if isinstance(v, (list, tuple)):
+        out: set = set()
+        for x in v:
+            if isinstance(x, (list, tuple)):
+                out.update(
+                    y for y in x if isinstance(y, (int, str, float))
+                )
+            elif isinstance(x, (int, str, float)):
+                out.add(x)
+        return out
+    if isinstance(v, (int, str, float)):
+        return {v}
+    return set()
+
+
+def flag_ops(
+    history: Sequence[Op], values_by_reason: Mapping[str, set]
+) -> dict[int, list[str]]:
+    """``{history position: [reasons]}`` for every op touching a
+    violating value."""
+    flat: dict[Any, list[str]] = {}
+    for reason, vs in sorted(values_by_reason.items()):
+        for v in vs:
+            flat.setdefault(v, []).append(reason)
+    flagged: dict[int, list[str]] = {}
+    for i, op in enumerate(history):
+        if op.process == NEMESIS_PROCESS:
+            continue
+        hit = sorted(
+            {r for v in _op_values(op) for r in flat.get(v, ())}
+        )
+        if hit:
+            flagged[i] = hit
+    return flagged
+
+
+def render_forensics(
+    run_dir: str | Path,
+    history: Sequence[Op] | None = None,
+    results: Mapping[str, Any] | None = None,
+    repro_path: str | Path | None = None,
+    title: str | None = None,
+    out_path: str | Path | None = None,
+) -> Path | None:
+    """Write ``forensics.html`` for an invalid run; returns the path, or
+    None when the verdict is not invalid (a valid run has no
+    counterexample to explain — refusing keeps the page an honest
+    artifact, the soak/fuzz capture discipline)."""
+    from jepsen_tpu.history.store import RESULTS_FILE, Store
+
+    run_dir = Path(run_dir)
+    if history is None:
+        history = Store(run_dir.parent).load_history(run_dir)
+    history = list(history)
+    if results is None:
+        try:
+            results = json.loads((run_dir / RESULTS_FILE).read_text())
+        except (OSError, ValueError):
+            results = {}
+    if results.get("valid?") is not False:
+        return None
+    title = title or f"{run_dir.name} forensics"
+
+    values = violating_values(results)
+    flagged = flag_ops(history, values)
+    first = min(flagged) if flagged else 0
+    lo = max(first - _WINDOW // 2, 0)
+    hi = min(lo + _WINDOW, len(history))
+
+    invalid_names = sorted(
+        name
+        for name, r in results.items()
+        if isinstance(r, dict) and r.get("valid?") is False
+    )
+
+    reason_rows = "".join(
+        f"<tr><td>{escape(reason)}</td>"
+        f"<td>{escape(', '.join(str(v) for v in sorted(vs, key=str)))}"
+        f"</td></tr>"
+        for reason, vs in sorted(values.items())
+    )
+
+    op_rows = []
+    for i in range(lo, hi):
+        op = history[i]
+        reasons = flagged.get(i)
+        color = COLORS.get(op.type, "#cccccc")
+        style = (
+            ' style="background:#ffe0e0;font-weight:bold"'
+            if reasons
+            else ""
+        )
+        val = "" if op.value is None else str(op.value)
+        if len(val) > 80:
+            val = val[:77] + "..."
+        op_rows.append(
+            f"<tr{style}><td>{op.index}</td>"
+            f"<td>{op.time / 1e9:.3f}s</td><td>{op.process}</td>"
+            f"<td>{escape(op.f.name.lower())}</td>"
+            f'<td><span style="color:{color}">'
+            f"{escape(op.type.name.lower())}</span></td>"
+            f"<td>{escape(val)}</td>"
+            f"<td>{escape(', '.join(reasons)) if reasons else ''}</td>"
+            f"</tr>"
+        )
+
+    repro_note = ""
+    if repro_path is not None:
+        repro_note = (
+            f"<p>minimized fuzz repro: "
+            f"<a href={quoteattr(str(repro_path))}>"
+            f"{escape(Path(str(repro_path)).name)}</a></p>"
+        )
+    html = (
+        f"<html><head><title>{escape(title)}</title>"
+        f"<style>{_CSS}</style></head><body>"
+        f'<h2>{escape(title)} — <span class="verdict-false">'
+        f"valid? = False</span></h2>"
+        f"<p>invalidating checkers: "
+        f"{escape(', '.join(invalid_names) or '(none named)')} · "
+        f"{len(flagged)} of {len(history)} ops touch violating values"
+        f"</p>{repro_note}"
+        f'<div class="panel"><h3>violating values</h3><table>'
+        f"<tr><th>reason</th><th>values</th></tr>{reason_rows}"
+        f"</table></div>"
+        f'<div class="panel"><h3>op window [{lo}, {hi}) around the '
+        f"first violation (flagged rows highlighted)</h3><table>"
+        f"<tr><th>index</th><th>time</th><th>proc</th><th>f</th>"
+        f"<th>type</th><th>value</th><th>flag</th></tr>"
+        f"{''.join(op_rows)}</table></div>"
+        f"</body></html>"
+    )
+    out = Path(out_path) if out_path is not None else run_dir / FORENSICS_FILE
+    return write_artifact(out, html)

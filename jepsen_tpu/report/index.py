@@ -1,0 +1,184 @@
+"""Cross-run index: a store tree becomes one browsable page.
+
+Soak and fuzz campaigns leave dozens of run directories behind;
+``build_store_index`` walks ``store/``, reads (or renders) each run's
+``report.json``, and emits ``store/index.html`` — one row per run with
+verdict, op count, latency headline, and links to the run's report/
+timeline/forensics artifacts, plus a p50-latency trend sparkline over
+the runs in recorded order.  Deterministic: rows sort by run path, and
+the page is a pure function of the run summaries (well-formed XML, the
+``tests/test_report.py`` gate).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any
+from xml.sax.saxutils import escape, quoteattr
+
+from jepsen_tpu.history.store import HISTORY_FILE, RESULTS_FILE, EDN_FILE
+from jepsen_tpu.report.render import (
+    REPORT_FILE,
+    REPORT_JSON,
+    _CSS,
+    _verdict_class,
+)
+
+log = logging.getLogger(__name__)
+
+INDEX_FILE = "index.html"
+
+
+def _under_symlink(d: Path, root: Path) -> bool:
+    cur = d
+    while cur != root and cur != cur.parent:
+        if cur.is_symlink():
+            return True
+        cur = cur.parent
+    return False
+
+
+def run_dirs(root: str | Path) -> list[Path]:
+    """Every run directory under ``root`` (has a recorded history or a
+    results.json), sorted by path — ``latest``/``current`` symlinks
+    skipped and resolved-path deduped so no run indexes twice."""
+    root = Path(root)
+    seen: set = set()
+    out = []
+    for pat in (RESULTS_FILE, HISTORY_FILE, EDN_FILE):
+        for p in sorted(root.rglob(pat)):
+            d = p.parent
+            if _under_symlink(d, root):
+                continue
+            r = d.resolve()
+            if r in seen:
+                continue
+            seen.add(r)
+            out.append(d)
+    return sorted(out)
+
+
+def _summary_for(d: Path, render_missing: bool) -> dict[str, Any] | None:
+    rj = d / REPORT_JSON
+    if not rj.is_file() and render_missing:
+        from jepsen_tpu.report.render import render_run_report
+
+        try:
+            render_run_report(d)
+        except Exception as e:  # noqa: BLE001 — index the rest
+            log.warning("report rendering failed for %s: %s", d, e)
+    if rj.is_file():
+        try:
+            return json.loads(rj.read_text())
+        except (OSError, ValueError) as e:
+            log.warning("unreadable report.json under %s: %s", d, e)
+    # results-only row (no history to crunch): verdict still indexes
+    try:
+        results = json.loads((d / RESULTS_FILE).read_text())
+        return {"run": d.name, "valid?": results.get("valid?")}
+    except (OSError, ValueError):
+        return None
+
+
+def _sparkline(p50s: list[float | None]) -> str:
+    """Inline SVG sparkline of p50 latency across runs (recorded
+    order); gaps where a run had no measurable latency."""
+    w, h = max(16 * len(p50s), 48), 36
+    vals = [v for v in p50s if v is not None and v == v]
+    vmax = max(vals) if vals else 1.0
+    pts = []
+    for i, v in enumerate(p50s):
+        if v is None or v != v:
+            continue
+        x = 8 + i * 16
+        y = h - 6 - (h - 12) * (v / max(vmax, 1e-9))
+        pts.append(f"{x:.1f},{y:.1f}")
+    line = (
+        f'<polyline points="{" ".join(pts)}" fill="none" '
+        f'stroke="#3d405b" stroke-width="1.5"/>'
+        if len(pts) > 1
+        else ""
+    )
+    dots = "".join(
+        f'<circle cx="{p.split(",")[0]}" cy="{p.split(",")[1]}" r="2" '
+        f'fill="#3d405b"/>'
+        for p in pts
+    )
+    return (
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
+        f'height="{h}" viewBox="0 0 {w} {h}">{line}{dots}</svg>'
+    )
+
+
+def build_store_index(
+    root: str | Path, render_missing: bool = True
+) -> Path | None:
+    """Walk ``root``, render any missing per-run reports (unless
+    ``render_missing=False``), and write ``root/index.html``.  Returns
+    the index path, or None when the tree holds no runs."""
+    root = Path(root)
+    dirs = run_dirs(root)
+    rows_html = []
+    p50s: list[float | None] = []
+    n_valid = n_invalid = 0
+    for d in dirs:
+        s = _summary_for(d, render_missing)
+        if s is None:
+            continue
+        rel = d.relative_to(root)
+        v = s.get("valid?")
+        if v is True:
+            n_valid += 1
+        elif v is False:
+            n_invalid += 1
+        lat = s.get("latency-ms") or {}
+        p50 = lat.get("p50")
+        p50s.append(p50 if isinstance(p50, (int, float)) else None)
+        # quoteattr, not escape: escape() leaves double quotes alone,
+        # and a run path containing one would terminate the attribute
+        # (breaking the well-formed-XML contract)
+        report_link = (
+            f"<a href={quoteattr(f'{rel}/{REPORT_FILE}')}>report</a>"
+            if (d / REPORT_FILE).is_file()
+            else ""
+        )
+        forensics_link = (
+            f" · <a href={quoteattr(f'{rel}/forensics.html')}>"
+            f"forensics</a>"
+            if (d / "forensics.html").is_file()
+            else ""
+        )
+        nem = s.get("nemesis-windows")
+        p99 = lat.get("p99")
+        # isinstance guards on BOTH: one malformed report.json (e.g. a
+        # string "12ms" p50) must cost one cell, not the whole index
+        p50_cell = "" if not isinstance(p50, (int, float)) else f"{p50:g}"
+        p99_cell = "" if not isinstance(p99, (int, float)) else f"{p99:g}"
+        rows_html.append(
+            f"<tr><td>{escape(str(rel))}</td>"
+            f'<td class="{_verdict_class(v)}">{escape(str(v))}</td>'
+            f"<td>{s.get('ops', '')}</td>"
+            f"<td>{p50_cell}</td>"
+            f"<td>{p99_cell}</td>"
+            f"<td>{len(nem) if isinstance(nem, list) else ''}</td>"
+            f"<td>{report_link}{forensics_link}</td></tr>"
+        )
+    if not rows_html:
+        return None
+    html = (
+        f"<html><head><title>run index</title><style>{_CSS}</style>"
+        f"</head><body><h2>run index — {len(rows_html)} runs "
+        f'(<span class="verdict-true">{n_valid} valid</span> / '
+        f'<span class="verdict-false">{n_invalid} invalid</span>)</h2>'
+        f'<div class="panel"><h3>p50 latency trend (ms, run order)'
+        f"</h3>{_sparkline(p50s)}</div>"
+        f'<div class="panel"><table><tr><th>run</th><th>valid?</th>'
+        f"<th>ops</th><th>p50 ms</th><th>p99 ms</th><th>nemesis</th>"
+        f"<th>artifacts</th></tr>{''.join(rows_html)}</table></div>"
+        f"</body></html>"
+    )
+    from jepsen_tpu.report.render import write_artifact
+
+    return write_artifact(root / INDEX_FILE, html)

@@ -1,0 +1,312 @@
+"""Windowed perf statistics on the accelerator.
+
+The reference's ``checker/perf`` renders latency/rate graphs per run by
+shelling out to gnuplot over the raw history; here the statistics are
+ONE vmapped XLA dispatch over the packed ``.jtc`` row columns for a
+whole batch of histories — per-window completion rates split by op
+function and outcome, and per-window latency p50/p90/p99 read off
+log-bucketed histograms.
+
+Buckets are the PR-9 quantile-sketch geometry (``obs/metrics.py``
+DDSketch-style, relative accuracy ``ALPHA`` = 1%): value ``x`` lands in
+bucket ``k = ceil(log(x) / log(gamma))`` with
+``gamma = (1+ALPHA)/(1-ALPHA)``, bucket estimate
+``2 * gamma**k / (gamma + 1)``.  That makes every device histogram
+MERGEABLE with the host sketches by bucket addition
+(:func:`sketch_from_hist`), and pins the same accuracy bar the sketches
+carry: any quantile within ~``ALPHA`` relative error (differential gate
+vs ``np.percentile`` in ``tests/test_report.py``; the ≤2% acceptance
+bar rides the committed ``bench.py report`` section).
+
+Layout choices (why this fits one dispatch at north-star scale): the
+per-window histogram ``[W, NB]`` is reduced to ``[W, 3]`` quantiles
+*inside* the kernel, so the host receives quantiles + rates + ONE
+summed ``[NB]`` histogram per history — ~30 KB/history instead of the
+~200 KB/history the raw windowed histograms would cost.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jepsen_tpu.checkers.protocol import VALID, Checker
+from jepsen_tpu.history.encode import PackedHistories, pack_histories
+from jepsen_tpu.history.ops import Op, OpF, OpType
+
+#: windows per history (the reference's perf graphs are ~this dense)
+N_WINDOWS = 64
+
+#: sketch geometry — MUST match obs.metrics.QuantileSketch's default
+ALPHA = 0.01
+GAMMA = (1.0 + ALPHA) / (1.0 - ALPHA)
+_LOG_GAMMA = math.log(GAMMA)
+
+#: bucket 0 holds non-positive latencies (sub-ms completions round to
+#: 0 ms and report as 0.0, the sketch's zero-bucket rule); buckets
+#: ``1..NB-1`` hold ``k = i - 1`` up to ~1e7 ms (2.8 h), clipped above
+K_MAX = math.ceil(math.log(1e7) / _LOG_GAMMA)
+N_BUCKETS = K_MAX + 2
+
+QUANTILES = (0.5, 0.9, 0.99)
+
+#: OpF code -> rate-grid slot: 0 = produce-like (enqueue/append/txn/
+#: acquire), 1 = consume-like (dequeue/read/release), 2 = drain;
+#: -1 = nemesis/bookkeeping (excluded)
+_F_SLOTS = np.full(max(int(f) for f in OpF) + 1, -1, np.int32)
+for _f, _slot in (
+    (OpF.ENQUEUE, 0), (OpF.APPEND, 0), (OpF.TXN, 0), (OpF.ACQUIRE, 0),
+    (OpF.DEQUEUE, 1), (OpF.READ, 1), (OpF.RELEASE, 1),
+    (OpF.DRAIN, 2),
+):
+    _F_SLOTS[int(_f)] = _slot
+F_NAMES = ("produce", "consume", "drain")
+T_NAMES = ("ok", "fail", "info")
+
+
+def bucket_value(i: int) -> float:
+    """The latency estimate (ms) a histogram bucket reports — bucket 0
+    is the zero bucket, ``i >= 1`` is sketch bucket ``k = i - 1``."""
+    if i <= 0:
+        return 0.0
+    return 2.0 * GAMMA ** (i - 1) / (GAMMA + 1.0)
+
+
+_BUCKET_VALUES = np.array(
+    [bucket_value(i) for i in range(N_BUCKETS)], np.float32
+)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class WindowedStats:
+    """Device windowed stats for a batch of histories.
+
+    ``rates``:     [B, W, 3, 3] completions per window by (f-slot, outcome)
+    ``quantiles``: [B, W, 3]    p50/p90/p99 ok-latency (ms; -1 = empty)
+    ``hist``:      [B, NB]      whole-history ok-latency histogram
+                                (sketch-geometry buckets, mergeable)
+    ``window_ms``: [B]          window width
+    ``ok_lats``:   [B]          ok completions with a measured latency
+    """
+
+    rates: jax.Array
+    quantiles: jax.Array
+    hist: jax.Array
+    window_ms: jax.Array
+    ok_lats: jax.Array
+
+
+def _quantiles_from_cdf(cdf, total, uppers):
+    """Sketch quantile semantics on a bucket CDF: rank ``q*(count-1)``,
+    first bucket whose cumulative count exceeds the rank."""
+    qs = []
+    for q in QUANTILES:
+        rank = q * (total[..., 0] - 1)
+        idx = jnp.argmax(cdf > rank[..., None], axis=-1)
+        qs.append(jnp.where(total[..., 0] > 0, uppers[idx], -1.0))
+    return jnp.stack(qs, axis=-1)
+
+
+def _stats_one(f, type_, time_ms, latency_ms, mask, first):
+    """[L] row columns -> windowed stats for one history."""
+    f = f.astype(jnp.int32)
+    type_ = type_.astype(jnp.int32)
+    slots = jnp.asarray(_F_SLOTS)
+    fi = slots[jnp.clip(f, 0, len(_F_SLOTS) - 1)]
+    is_completion = (
+        mask
+        & first  # one count per op, not per drain-exploded row
+        & (fi >= 0)
+        & (type_ >= int(OpType.OK))
+        & (type_ <= int(OpType.INFO))
+        & (time_ms >= 0)
+    )
+    t_max = jnp.max(jnp.where(is_completion, time_ms, 0))
+    window_ms = jnp.maximum(t_max // N_WINDOWS + 1, 1)
+    win = jnp.clip(time_ms // window_ms, 0, N_WINDOWS - 1)
+
+    # rates: [W, 3 f-slots, 3 outcomes]
+    ti = type_ - int(OpType.OK)
+    flat = (win * 3 + jnp.clip(fi, 0, 2)) * 3 + jnp.clip(ti, 0, 2)
+    flat = jnp.where(is_completion, flat, N_WINDOWS * 9)
+    rates = jnp.zeros((N_WINDOWS * 9,), jnp.int32)
+    rates = rates.at[flat].add(
+        jnp.where(is_completion, 1, 0), mode="drop"
+    ).reshape(N_WINDOWS, 3, 3)
+
+    # ok-latency histogram in sketch geometry: [W, NB]
+    ok_lat = is_completion & (type_ == int(OpType.OK)) & (latency_ms >= 0)
+    lat = latency_ms.astype(jnp.float32)
+    k = jnp.ceil(jnp.log(jnp.maximum(lat, 1e-6)) / _LOG_GAMMA)
+    bucket = jnp.where(
+        lat <= 0.0,
+        0,
+        jnp.clip(k.astype(jnp.int32) + 1, 1, N_BUCKETS - 1),
+    )
+    flat = win * N_BUCKETS + bucket
+    flat = jnp.where(ok_lat, flat, N_WINDOWS * N_BUCKETS)
+    hist = jnp.zeros((N_WINDOWS * N_BUCKETS,), jnp.int32)
+    hist = hist.at[flat].add(jnp.where(ok_lat, 1, 0), mode="drop")
+    hist = hist.reshape(N_WINDOWS, N_BUCKETS)
+
+    uppers = jnp.asarray(_BUCKET_VALUES)
+    cdf = jnp.cumsum(hist, axis=-1)
+    quantiles = _quantiles_from_cdf(cdf, cdf[..., -1:], uppers)
+
+    total = hist.sum(axis=0)
+    return dict(
+        rates=rates,
+        quantiles=quantiles,
+        hist=total,
+        window_ms=window_ms,
+        ok_lats=total.sum(),
+    )
+
+
+@jax.jit
+def _stats_batch(f, type_, time_ms, latency_ms, mask, first) -> WindowedStats:
+    r = jax.vmap(_stats_one)(f, type_, time_ms, latency_ms, mask, first)
+    return WindowedStats(
+        rates=r["rates"],
+        quantiles=r["quantiles"],
+        hist=r["hist"],
+        window_ms=r["window_ms"],
+        ok_lats=r["ok_lats"],
+    )
+
+
+def windowed_stats(packed: PackedHistories) -> WindowedStats:
+    """The windowed-stats kernel over an already-packed batch — one
+    dispatch for the whole batch axis."""
+    return _stats_batch(
+        packed.f,
+        packed.type,
+        packed.time_ms,
+        packed.latency_ms,
+        packed.mask,
+        packed.first,
+    )
+
+
+def windowed_stats_rows(
+    mats: Sequence[np.ndarray], length: int | None = None
+) -> WindowedStats:
+    """Windowed stats straight from ``[n, 8]`` row matrices (the
+    ``.jtc`` ``SEC_QROWS`` payloads) — the zero-parse batch entry the
+    ``bench.py report`` section measures."""
+    from jepsen_tpu.history.encode import pack_row_matrices
+
+    packed = pack_row_matrices(mats, length=length)
+    return windowed_stats(packed)
+
+
+# ---------------------------------------------------------------------------
+# host-side views
+# ---------------------------------------------------------------------------
+
+
+def quantiles_from_hist(hist: np.ndarray, qs=QUANTILES) -> list[float]:
+    """Host twin of the in-kernel CDF walk (for whole-history quantiles
+    off the summed histogram); NaN on an empty histogram."""
+    hist = np.asarray(hist)
+    total = int(hist.sum())
+    if total == 0:
+        return [float("nan")] * len(qs)
+    cdf = np.cumsum(hist)
+    out = []
+    for q in qs:
+        rank = q * (total - 1)
+        idx = int(np.argmax(cdf > rank))
+        out.append(float(_BUCKET_VALUES[idx]))
+    return out
+
+
+def sketch_from_hist(hist: np.ndarray, alpha: float = ALPHA):
+    """Bridge a device histogram row into a PR-9
+    :class:`~jepsen_tpu.obs.metrics.QuantileSketch` — same geometry, so
+    the result MERGES with live sketches by bucket addition.  The
+    sketch's ``sum`` is estimated from bucket midpoints (quantiles never
+    read it; documented approximation)."""
+    from jepsen_tpu.obs.metrics import QuantileSketch
+
+    if abs(alpha - ALPHA) > 1e-12:
+        raise ValueError(
+            f"device histograms are cut at alpha={ALPHA}; cannot bridge "
+            f"to a sketch with alpha={alpha}"
+        )
+    hist = np.asarray(hist)
+    s = QuantileSketch(alpha=alpha)
+    s._zero = int(hist[0])
+    s._count = int(hist.sum())
+    s._sum = float((hist * _BUCKET_VALUES).sum())
+    s._buckets = {
+        i - 1: int(c) for i, c in enumerate(hist) if i >= 1 and c
+    }
+    return s
+
+
+def stats_summary(t: WindowedStats, b: int = 0) -> dict[str, Any]:
+    """Compact JSON-able headline for one history: overall quantiles,
+    completion mix, peak windowed rate — what ``results.json`` carries
+    and the index rows read."""
+    rates = np.asarray(t.rates)[b]
+    hist = np.asarray(t.hist)[b]
+    window_s = float(np.asarray(t.window_ms)[b]) / 1e3
+    q = quantiles_from_hist(hist)
+    per_window = rates.sum(axis=(1, 2))
+    mix = rates.sum(axis=0)  # [3 f-slots, 3 outcomes]
+    by_type = mix.sum(axis=0)
+    return {
+        "windows": N_WINDOWS,
+        "window-s": round(window_s, 3),
+        "completions": int(by_type.sum()),
+        "ok": int(by_type[0]),
+        "fail": int(by_type[1]),
+        "info": int(by_type[2]),
+        "latency-ms": {
+            "p50": None if q[0] != q[0] else round(q[0], 3),
+            "p90": None if q[1] != q[1] else round(q[1], 3),
+            "p99": None if q[2] != q[2] else round(q[2], 3),
+        },
+        "peak-rate-ops-per-s": round(
+            float(per_window.max()) / max(window_s, 1e-9), 1
+        ),
+    }
+
+
+#: opts key under which :class:`WindowedPerf` stashes its computed
+#: tensors for the same-run report renderer (pack + dispatch happen
+#: once per run, not once per consumer)
+STATS_OPT = "_windowed_stats"
+
+
+class WindowedPerf(Checker):
+    """``checker/perf``'s statistics half as a composable checker: the
+    device windowed-stats kernel over one history, always valid (it
+    renders evidence, it does not judge).  Composes with the family
+    checkers exactly like ``checker/compose``; the run-report renderer
+    consumes the same tensors — when ``opts`` is a mutable dict the
+    computed :class:`WindowedStats` is stashed under :data:`STATS_OPT`
+    so the runner's default-on render reuses it instead of re-packing
+    and re-dispatching the identical history."""
+
+    name = "perf"
+
+    def check(
+        self,
+        test: Mapping[str, Any],
+        history: Sequence[Op],
+        opts: Mapping[str, Any] | None = None,
+    ) -> dict[str, Any]:
+        if not len(history):
+            return {VALID: True, "completions": 0}
+        t = windowed_stats(pack_histories([list(history)]))
+        if isinstance(opts, dict):
+            opts[STATS_OPT] = t
+        return {VALID: True, **stats_summary(t, 0)}

@@ -1,0 +1,542 @@
+"""Per-run HTML report: perf panels, nemesis shading, op timeline.
+
+``store/report`` parity for one run directory: ``report.html`` (latency
+over time with percentile bands, throughput panel, nemesis fault
+windows shaded on the SAME clock as the ops — everything keys off
+``op.time`` ns-from-run-start, the clock the flight-recorder trace
+shares), ``timeline.html`` (``jepsen.checker.timeline`` parity: one row
+per process, one invoke→complete bar per op colored by outcome), and —
+for an invalid verdict — ``forensics.html`` (``report/forensics.py``).
+
+Determinism contract (pinned in ``tests/test_report.py``): the
+artifacts are a pure function of the run directory's recorded state —
+no wall clock, no dict-iteration-order leakage, fixed-precision number
+formatting — so a fixed store renders byte-identical artifacts on every
+invocation.  Every artifact is well-formed XML (no unclosed tags, no
+HTML-only entities): the test suite parses each one with
+``xml.etree.ElementTree`` as a structural gate.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from pathlib import Path
+from typing import Any, Mapping, Sequence
+from xml.sax.saxutils import escape, quoteattr
+
+import numpy as np
+
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+
+REPORT_FILE = "report.html"
+REPORT_JSON = "report.json"
+TIMELINE_FILE = "timeline.html"
+FORENSICS_FILE = "forensics.html"
+
+
+def write_artifact(path: Path, text: str) -> Path:
+    """Atomic artifact write (tmp → rename): the sidecar renders on
+    demand from concurrent handler threads, and a reader racing a
+    truncate-then-write ``write_text`` would be served a torn page
+    with a clean 200."""
+    import os
+
+    path = Path(path)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(text)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+#: outcome colors (shared by every panel; timeline.py's palette)
+COLORS = {
+    OpType.OK: "#81b29a",
+    OpType.FAIL: "#e07a5f",
+    OpType.INFO: "#f2cc8f",
+    None: "#cccccc",  # never completed
+}
+_NEMESIS_FILL = "#d7263d"
+_Q_COLORS = {"p50": "#3d405b", "p90": "#5f7fbf", "p99": "#d7263d"}
+
+_CSS = """
+body { font-family: monospace; background: #fafaf8; color: #222;
+       margin: 1.2em; }
+h2, h3 { margin: 0.4em 0; }
+.verdict-true { color: #2a7f4f; } .verdict-false { color: #c22; }
+.verdict-unknown { color: #b8860b; }
+table { border-collapse: collapse; font-size: 12px; }
+td, th { border: 1px solid #ddd; padding: 2px 8px; text-align: left; }
+.panel { margin: 1em 0; }
+a { color: #3d405b; }
+"""
+
+
+# ---------------------------------------------------------------------------
+# nemesis windows (one clock: op.time ns from run start)
+# ---------------------------------------------------------------------------
+
+
+def nemesis_windows(
+    history: Sequence[Op],
+) -> list[tuple[int, int, str]]:
+    """``(t0_ns, t1_ns, label)`` fault windows from the recorded
+    nemesis ops: a START completion opens a window, the next STOP
+    completion closes it (the same pairing the PR-9 trace spans use);
+    a window the run never healed closes at the history's end."""
+    t_max = max((op.time for op in history if op.time >= 0), default=0)
+    out: list[tuple[int, int, str]] = []
+    open_w: tuple[int, str] | None = None
+    for op in history:
+        if op.process != NEMESIS_PROCESS or op.type == OpType.INVOKE:
+            continue
+        if op.f == OpF.START and op.time >= 0:
+            label = str(op.value) if op.value is not None else "fault"
+            open_w = (op.time, label)
+        elif op.f == OpF.STOP and open_w is not None:
+            t0, label = open_w
+            open_w = None
+            out.append((t0, op.time if op.time >= 0 else t_max, label))
+    if open_w is not None:
+        out.append((open_w[0], t_max, open_w[1]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# SVG panels
+# ---------------------------------------------------------------------------
+
+_W, _H = 860, 240
+_ML, _MR, _MT, _MB = 56, 10, 10, 28  # margins
+
+
+def _xpix(t_s: float, t_max_s: float) -> float:
+    return _ML + (_W - _ML - _MR) * (t_s / max(t_max_s, 1e-9))
+
+
+def _fmt(x: float) -> str:
+    return f"{x:.2f}"
+
+
+def _svg_open(height: int = _H) -> list[str]:
+    return [
+        f'<svg xmlns="http://www.w3.org/2000/svg" width="{_W}" '
+        f'height="{height}" viewBox="0 0 {_W} {height}" '
+        f'font-family="monospace" font-size="10">',
+        f'<rect x="{_ML}" y="{_MT}" width="{_W - _ML - _MR}" '
+        f'height="{height - _MT - _MB}" fill="#ffffff" '
+        f'stroke="#cccccc"/>',
+    ]
+
+
+def _svg_nemesis(parts: list[str], windows, t_max_s: float, height: int):
+    for t0, t1, label in windows:
+        x0 = _xpix(t0 / 1e9, t_max_s)
+        x1 = _xpix(t1 / 1e9, t_max_s)
+        parts.append(
+            f'<rect x="{_fmt(x0)}" y="{_MT}" '
+            f'width="{_fmt(max(x1 - x0, 1.0))}" '
+            f'height="{height - _MT - _MB}" fill="{_NEMESIS_FILL}" '
+            f'fill-opacity="0.12"><title>'
+            f"{escape(label)} [{t0 / 1e9:.1f}s → {t1 / 1e9:.1f}s]"
+            f"</title></rect>"
+        )
+
+
+def _svg_xaxis(parts: list[str], t_max_s: float, height: int):
+    for frac in (0.0, 0.25, 0.5, 0.75, 1.0):
+        x = _ML + (_W - _ML - _MR) * frac
+        parts.append(
+            f'<text x="{_fmt(x)}" y="{height - _MB + 14}" '
+            f'text-anchor="middle" fill="#555555">'
+            f"{t_max_s * frac:.0f}s</text>"
+        )
+
+
+def latency_panel_svg(
+    quantiles: np.ndarray,  # [W, 3] ms, -1 = empty window
+    window_s: float,
+    windows_nemesis,
+    t_max_s: float,
+) -> str:
+    """Latency-over-time with a p50..p99 percentile band and the p50/
+    p90/p99 lines, log-y, nemesis windows shaded."""
+    q = np.asarray(quantiles, np.float64)
+    have = q[:, 0] >= 0  # non-empty windows (0 = sub-ms completions)
+    vmax = float(q.max()) if q.max() > 0 else 1.0
+    ymax = 10 ** math.ceil(math.log10(max(vmax, 1.0)))
+    pos = q[have]
+    pos = pos[pos > 0]
+    ymin = max(
+        10 ** math.floor(math.log10(float(pos.min()))) if pos.size else 0.1,
+        0.01,
+    )
+    if ymin >= ymax:
+        ymin = ymax / 100.0
+    lo, hi = math.log10(ymin), math.log10(ymax)
+
+    def ypix(v: float) -> float:
+        v = min(max(v, ymin), ymax)
+        return _MT + (_H - _MT - _MB) * (
+            1.0 - (math.log10(v) - lo) / (hi - lo)
+        )
+
+    xs = [(w + 0.5) * window_s for w in range(len(q))]
+    parts = _svg_open()
+    _svg_nemesis(parts, windows_nemesis, t_max_s, _H)
+    # y decade gridlines + labels
+    d = int(math.floor(lo))
+    while d <= hi:
+        v = 10.0**d
+        if ymin <= v <= ymax:
+            y = ypix(v)
+            parts.append(
+                f'<line x1="{_ML}" y1="{_fmt(y)}" x2="{_W - _MR}" '
+                f'y2="{_fmt(y)}" stroke="#eeeeee"/>'
+            )
+            parts.append(
+                f'<text x="{_ML - 4}" y="{_fmt(y + 3)}" '
+                f'text-anchor="end" fill="#555555">{v:g}ms</text>'
+            )
+        d += 1
+    # percentile band p50..p99
+    pts_band = []
+    for i in np.nonzero(have)[0]:
+        pts_band.append(
+            f"{_fmt(_xpix(xs[i], t_max_s))},{_fmt(ypix(q[i, 2]))}"
+        )
+    for i in np.nonzero(have)[0][::-1]:
+        pts_band.append(
+            f"{_fmt(_xpix(xs[i], t_max_s))},{_fmt(ypix(q[i, 0]))}"
+        )
+    if pts_band:
+        parts.append(
+            f'<polygon points="{" ".join(pts_band)}" fill="#5f7fbf" '
+            f'fill-opacity="0.15" stroke="none"/>'
+        )
+    for qi, qname in enumerate(("p50", "p90", "p99")):
+        pts = [
+            f"{_fmt(_xpix(xs[i], t_max_s))},{_fmt(ypix(q[i, qi]))}"
+            for i in np.nonzero(have)[0]
+        ]
+        if pts:
+            parts.append(
+                f'<polyline points="{" ".join(pts)}" fill="none" '
+                f'stroke="{_Q_COLORS[qname]}" stroke-width="1.2"/>'
+            )
+    _svg_xaxis(parts, t_max_s, _H)
+    for i, qname in enumerate(("p50", "p90", "p99")):
+        parts.append(
+            f'<text x="{_W - _MR - 120 + i * 40}" y="{_MT + 12}" '
+            f'fill="{_Q_COLORS[qname]}">{qname}</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def rate_panel_svg(
+    rates: np.ndarray,  # [W, 3 f-slots, 3 outcomes]
+    window_s: float,
+    windows_nemesis,
+    t_max_s: float,
+) -> str:
+    """Throughput panel: completions/s per window by outcome (ok/fail/
+    info stacked as lines), nemesis windows shaded."""
+    r = np.asarray(rates, np.float64).sum(axis=1)  # [W, 3 outcomes]
+    per_s = r / max(window_s, 1e-9)
+    vmax = max(float(per_s.max()), 1.0)
+    parts = _svg_open()
+    _svg_nemesis(parts, windows_nemesis, t_max_s, _H)
+
+    def ypix(v: float) -> float:
+        return _MT + (_H - _MT - _MB) * (1.0 - min(v, vmax) / vmax)
+
+    for frac in (0.5, 1.0):
+        parts.append(
+            f'<text x="{_ML - 4}" y="{_fmt(ypix(vmax * frac) + 3)}" '
+            f'text-anchor="end" fill="#555555">{vmax * frac:.0f}/s</text>'
+        )
+    xs = [(w + 0.5) * window_s for w in range(len(r))]
+    for ti, tname in enumerate(("ok", "fail", "info")):
+        if per_s[:, ti].sum() == 0:
+            continue
+        color = COLORS[OpType(int(OpType.OK) + ti)]
+        pts = [
+            f"{_fmt(_xpix(xs[w], t_max_s))},{_fmt(ypix(per_s[w, ti]))}"
+            for w in range(len(r))
+        ]
+        parts.append(
+            f'<polyline points="{" ".join(pts)}" fill="none" '
+            f'stroke="{color}" stroke-width="1.2"><title>{tname}'
+            f"</title></polyline>"
+        )
+    _svg_xaxis(parts, t_max_s, _H)
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# timeline.html (jepsen.checker.timeline parity, XML-well-formed)
+# ---------------------------------------------------------------------------
+
+
+def render_timeline(
+    history: Sequence[Op], out_path: str | Path, title: str = "timeline"
+) -> Path:
+    """One row per process, one invoke→complete bar per op colored
+    ok/fail/info (grey = never completed), hover details."""
+    pairs: list[tuple[Op, Op | None]] = []
+    open_by_process: dict[int, Op] = {}
+    for op in history:
+        if op.type == OpType.INVOKE:
+            open_by_process[op.process] = op
+        else:
+            inv = open_by_process.pop(op.process, None)
+            if inv is not None:
+                pairs.append((inv, op))
+    for p in sorted(open_by_process):
+        pairs.append((open_by_process[p], None))
+
+    # `or 1`: a history whose only timestamped ops sit at t=0 ns must
+    # not divide by zero (default= only covers the EMPTY generator)
+    t_max = max((op.time for op in history if op.time >= 0), default=1) or 1
+    processes = sorted(
+        {inv.process for inv, _ in pairs},
+        key=lambda p: (p == NEMESIS_PROCESS, p),
+    )
+    rows = []
+    for p in processes:
+        bars = []
+        for inv, comp in pairs:
+            if inv.process != p:
+                continue
+            left = 100.0 * max(inv.time, 0) / t_max
+            end_t = comp.time if comp is not None and comp.time >= 0 else t_max
+            width = max(100.0 * (end_t - max(inv.time, 0)) / t_max, 0.15)
+            color = COLORS[comp.type if comp is not None else None]
+            value = (
+                comp.value
+                if comp is not None and comp.value is not None
+                else inv.value
+            )
+            tip = quoteattr(
+                f"{inv.f.name.lower()} "
+                f"{value if value is not None else ''} "
+                f"[{inv.time / 1e9:.3f}s → {end_t / 1e9:.3f}s] "
+                f"{comp.type.name.lower() if comp else 'open'}"
+                + (
+                    f" {comp.error}"
+                    if comp is not None and comp.error
+                    else ""
+                )
+            )
+            bars.append(
+                f'<div class="op" title={tip} style='
+                f'"left:{left:.3f}%;width:{width:.3f}%;'
+                f'background:{color}"></div>'
+            )
+        label = "nemesis" if p == NEMESIS_PROCESS else f"proc {p}"
+        rows.append(
+            f'<div class="row"><div class="label">{label}</div>'
+            f'<div class="lane">{"".join(bars)}</div></div>'
+        )
+    style = (
+        "body { font-family: monospace; background: #fafaf8; }\n"
+        ".row { position: relative; height: 22px; "
+        "border-bottom: 1px solid #eee; }\n"
+        ".label { position: absolute; left: 0; width: 90px; "
+        "font-size: 11px; line-height: 22px; }\n"
+        ".lane { position: absolute; left: 100px; right: 0; top: 0; "
+        "bottom: 0; }\n"
+        ".op { position: absolute; height: 16px; top: 3px; "
+        "border-radius: 3px; min-width: 2px; opacity: 0.9; }\n"
+        ".op:hover { outline: 2px solid #333; z-index: 10; }\n"
+    )
+    out = write_artifact(
+        Path(out_path),
+        f"<html><head><title>{escape(title)}</title>"
+        f"<style>{style}</style></head>"
+        f"<body><h3>{escape(title)}</h3>"
+        f"<p>{len(pairs)} ops · {t_max / 1e9:.1f}s · hover for "
+        f"details · green ok / red fail / yellow info / grey open</p>"
+        f"{''.join(rows)}</body></html>",
+    )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the per-run report
+# ---------------------------------------------------------------------------
+
+
+def _verdict_class(v) -> str:
+    if v is True:
+        return "verdict-true"
+    if v is False:
+        return "verdict-false"
+    return "verdict-unknown"
+
+
+def _sub_verdict_rows(results: Mapping[str, Any]) -> str:
+    rows = []
+    for name in sorted(results):
+        r = results[name]
+        if not isinstance(r, dict) or "valid?" not in r:
+            continue
+        v = r["valid?"]
+        rows.append(
+            f'<tr><td>{escape(name)}</td><td class="{_verdict_class(v)}">'
+            f"{escape(str(v))}</td></tr>"
+        )
+    return "".join(rows)
+
+
+def render_run_report(
+    run_dir: str | Path,
+    history: Sequence[Op] | None = None,
+    results: Mapping[str, Any] | None = None,
+    title: str | None = None,
+    trace_path: str | Path | None = None,
+    stats=None,
+) -> dict[str, str]:
+    """Render ``report.html`` + ``timeline.html`` (+ ``forensics.html``
+    on an invalid verdict) + the machine-readable ``report.json`` into
+    ``run_dir``; returns ``{artifact-name: path}``.
+
+    Pure function of the run directory's recorded state; the device
+    windowed-stats kernel does the number crunching.  ``stats`` may
+    carry the :class:`~jepsen_tpu.report.perfstats.WindowedStats` the
+    run's ``WindowedPerf`` checker already computed for THIS history
+    (the runner forwards it) — pack + dispatch then happen once per
+    run.
+    """
+    from jepsen_tpu.history.encode import pack_histories
+    from jepsen_tpu.history.store import RESULTS_FILE, Store
+    from jepsen_tpu.report.perfstats import (
+        stats_summary,
+        windowed_stats,
+    )
+
+    run_dir = Path(run_dir)
+    if history is None:
+        history = Store(run_dir.parent).load_history(run_dir)
+    history = list(history)
+    if results is None:
+        try:
+            results = json.loads((run_dir / RESULTS_FILE).read_text())
+        except (OSError, ValueError):
+            results = {}
+    title = title or run_dir.name
+
+    paths: dict[str, str] = {}
+    t_max_ns = max(
+        (op.time for op in history if op.time >= 0), default=1
+    ) or 1
+    t_max_s = t_max_ns / 1e9
+    windows = nemesis_windows(history)
+
+    if history:
+        t = stats if stats is not None else windowed_stats(
+            pack_histories([history])
+        )
+        summary = stats_summary(t, 0)
+        quant = np.asarray(t.quantiles)[0]
+        rates = np.asarray(t.rates)[0]
+        window_s = summary["window-s"]
+    else:
+        summary = {"completions": 0, "windows": 0, "window-s": 0.0}
+        quant = np.full((1, 3), -1.0)
+        rates = np.zeros((1, 3, 3))
+        window_s = 1.0
+
+    verdict = results.get("valid?")
+    summary_doc = {
+        "run": run_dir.name,
+        "valid?": verdict,
+        "ops": len(history),
+        "nemesis-windows": [
+            {"t0-s": round(t0 / 1e9, 3), "t1-s": round(t1 / 1e9, 3),
+             "fault": label}
+            for t0, t1, label in windows
+        ],
+        **summary,
+    }
+    write_artifact(
+        run_dir / REPORT_JSON,
+        json.dumps(summary_doc, indent=1, sort_keys=True) + "\n",
+    )
+    paths["report-json"] = str(run_dir / REPORT_JSON)
+
+    tl = render_timeline(
+        history, run_dir / TIMELINE_FILE, title=f"{title} timeline"
+    )
+    paths["timeline"] = str(tl)
+
+    forensic_link = ""
+    if verdict is False:
+        from jepsen_tpu.report.forensics import render_forensics
+
+        fp = render_forensics(run_dir, history=history, results=results)
+        if fp is not None:
+            paths["forensics"] = str(fp)
+            forensic_link = (
+                f' · <a href="{FORENSICS_FILE}">forensics</a>'
+            )
+
+    lat_svg = latency_panel_svg(quant, window_s, windows, t_max_s)
+    rate_svg = rate_panel_svg(rates, window_s, windows, t_max_s)
+    nem_rows = "".join(
+        f"<tr><td>{escape(label)}</td><td>{t0 / 1e9:.1f}s</td>"
+        f"<td>{t1 / 1e9:.1f}s</td></tr>"
+        for t0, t1, label in windows
+    )
+    lat = summary.get("latency-ms", {})
+
+    def _ms(v) -> str:
+        return "-" if v is None else f"{v:g}"
+
+    trace_note = ""
+    if trace_path is not None:
+        trace_note = (
+            f"<p>flight-recorder trace (same clock): "
+            f"<a href={quoteattr(str(trace_path))}>"
+            f"{escape(Path(str(trace_path)).name)}</a></p>"
+        )
+    html = (
+        f"<html><head><title>{escape(title)}</title>"
+        f"<style>{_CSS}</style></head><body>"
+        f"<h2>{escape(title)} — <span class="
+        f'"{_verdict_class(verdict)}">valid? = {escape(str(verdict))}'
+        f"</span></h2>"
+        f"<p>{len(history)} ops · {t_max_s:.1f}s · "
+        f"ok {summary.get('ok', 0)} / fail {summary.get('fail', 0)} / "
+        f"info {summary.get('info', 0)} · "
+        f"latency p50 {_ms(lat.get('p50'))} / p90 {_ms(lat.get('p90'))}"
+        f" / p99 {_ms(lat.get('p99'))} ms · "
+        f'<a href="{TIMELINE_FILE}">timeline</a>{forensic_link}</p>'
+        f"{trace_note}"
+        f'<div class="panel"><h3>completion latency (percentile band '
+        f"p50..p99; shaded = nemesis fault windows)</h3>{lat_svg}</div>"
+        f'<div class="panel"><h3>throughput (completions/s: green ok / '
+        f"red fail / yellow info)</h3>{rate_svg}</div>"
+        f'<div class="panel"><h3>sub-verdicts</h3><table>'
+        f"<tr><th>checker</th><th>valid?</th></tr>"
+        f"{_sub_verdict_rows(results)}</table></div>"
+        + (
+            f'<div class="panel"><h3>nemesis windows (one clock with '
+            f"the op timeline)</h3><table><tr><th>fault</th><th>start"
+            f"</th><th>heal</th></tr>{nem_rows}</table></div>"
+            if nem_rows
+            else ""
+        )
+        + "</body></html>"
+    )
+    write_artifact(run_dir / REPORT_FILE, html)
+    paths["report"] = str(run_dir / REPORT_FILE)
+    return paths

@@ -266,14 +266,21 @@ class CheckerServer(socketserver.ThreadingTCPServer):
     def port(self) -> int:
         return self.server_address[1]
 
-    def start_metrics(self, host: str = "0.0.0.0", port: int = 9640):
+    def start_metrics(
+        self,
+        host: str = "0.0.0.0",
+        port: int = 9640,
+        store: str | None = None,
+    ):
         """Serve the shared registry as Prometheus text on
-        ``GET http://host:port/metrics``; returns the HTTP server
+        ``GET http://host:port/metrics`` — and, when ``store`` is
+        given, per-run reports on ``GET /report/<run>`` (rendered on
+        demand from the store tree); returns the HTTP server
         (``.server_address[1]`` carries the bound port)."""
         from jepsen_tpu.obs import metrics as obs_metrics
 
         self._metrics_srv = obs_metrics.serve_metrics(
-            host, port, self.metrics
+            host, port, self.metrics, store=store
         )
         self._metrics_srv.start_background()
         return self._metrics_srv
@@ -437,8 +444,11 @@ def serve_forever(
     metrics_note = "off"
     if metrics_port >= 0:
         try:
-            msrv = srv.start_metrics(host, metrics_port)
-            metrics_note = f"http://{host}:{msrv.server_address[1]}/metrics"
+            msrv = srv.start_metrics(host, metrics_port, store=store)
+            metrics_note = (
+                f"http://{host}:{msrv.server_address[1]}/metrics "
+                f"(+ /report/<run> over {store})"
+            )
         except OSError as e:
             # a busy metrics port must not take the checker down — the
             # sidecar's job is verdicts; scraping is best-effort

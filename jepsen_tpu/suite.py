@@ -169,11 +169,19 @@ def _compose_with_defaults(checkers: dict, with_perf: bool = True):
     """Compose a workload's checkers with the defaults jepsen's runner
     adds to every test (``stats`` + ``unhandled-exceptions``, plus
     ``perf`` unless disabled) — one place, so a new workload family
-    cannot silently ship without them."""
+    cannot silently ship without them.
+
+    ``perf`` is the reference-parity PNG renderer; ``perf-windowed`` is
+    the ISSUE-11 device windowed-stats kernel (``report/perfstats.py``)
+    whose summary lands in every run's ``results.json`` and whose
+    tensors back the default-on run report."""
+    from jepsen_tpu.report.perfstats import WindowedPerf
+
     checkers["stats"] = Stats()
     checkers["exceptions"] = UnhandledExceptions()
     if with_perf:
         checkers["perf"] = Perf()
+        checkers["perf-windowed"] = WindowedPerf()
     return compose(checkers)
 
 

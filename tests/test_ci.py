@@ -682,6 +682,43 @@ class TestBenchColdWarmSmoke:
         # the traced run really went through the lanes executor
         assert oo["lanes"] >= 1
 
+    def test_report_section_schema(self, bench):
+        """Offline gate for the ISSUE-11 ``report`` bench schema: a
+        tiny REAL run of the windowed-stats kernel over packed ``.jtc``
+        rows must carry the throughput keys, the ≤2% percentile
+        differential (the PR-9 sketch bar — real even at smoke scale:
+        it is a geometry bound, not noise), and proof that the report
+        artifacts were actually emitted and XML-parsed."""
+        details = {}
+        bench._bench_report(
+            details, histories=48, base_n=12, n_ops=60, chunk=16
+        )
+        r = details["report"]
+        for key in (
+            "histories",
+            "n_ops",
+            "windows",
+            "buckets",
+            "record_pack_s",
+            "wall_s",
+            "windowed_stats_histories_per_sec",
+            "quantiles_checked",
+            "max_quantile_rel_err",
+            "within_2pct",
+            "artifact_files",
+            "artifact_xml_ok",
+            "devices",
+            "backend",
+        ):
+            assert key in r, f"report schema lost key {key!r}"
+        assert r["histories"] == 48
+        assert r["windowed_stats_histories_per_sec"] > 0
+        assert r["quantiles_checked"] > 0
+        assert r["within_2pct"] is True, r["max_quantile_rel_err"]
+        assert r["artifact_xml_ok"] is True
+        for name in ("report.html", "report.json", "timeline.html"):
+            assert name in r["artifact_files"]
+
     def test_jtc_format_version_roundtrip(self, tmp_path):
         """Offline ``.jtc`` round trip under JAX_PLATFORMS=cpu: write →
         structural read → version-bump rejection (the stale-format-

@@ -1,0 +1,596 @@
+"""The run-report subsystem (ISSUE 11): device windowed stats, the
+deterministic HTML/SVG artifacts, forensics, the cross-run index, and
+the CLI/obs wiring.
+
+Determinism and well-formedness contracts pinned here:
+
+- byte-stable artifacts given a fixed store (no wall-clock, no
+  dict-order leakage);
+- every emitted artifact parses as XML (``xml.etree.ElementTree`` —
+  unclosed tags and HTML-only entities cannot ship);
+- device windowed percentiles within 2% of host ``np.percentile``
+  (the PR-9 sketch bar).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+import xml.etree.ElementTree as ET
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.protocol import compose
+from jepsen_tpu.checkers.total_queue import TotalQueue
+from jepsen_tpu.history.ops import NEMESIS_PROCESS, Op, OpF, OpType
+from jepsen_tpu.history.rows import _rows_for
+from jepsen_tpu.history.store import Store
+from jepsen_tpu.history.synth import SynthSpec, synth_batch
+from jepsen_tpu.report.forensics import (
+    flag_ops,
+    render_forensics,
+    violating_values,
+)
+from jepsen_tpu.report.index import build_store_index, run_dirs
+from jepsen_tpu.report.perfstats import (
+    ALPHA,
+    QUANTILES,
+    WindowedPerf,
+    quantiles_from_hist,
+    sketch_from_hist,
+    windowed_stats,
+    windowed_stats_rows,
+)
+from jepsen_tpu.report.render import (
+    nemesis_windows,
+    render_run_report,
+)
+
+
+def _parse_xml(path: Path) -> ET.Element:
+    return ET.fromstring(Path(path).read_text())
+
+
+def _rows_with_lats(lats: np.ndarray) -> np.ndarray:
+    """A synthetic [n, 8] row matrix of OK completions carrying the
+    given integer-ms latencies."""
+    n = len(lats)
+    rows = np.zeros((n, 8), np.int32)
+    rows[:, 0] = np.arange(n)
+    rows[:, 1] = np.arange(n) % 5
+    rows[:, 2] = int(OpType.OK)
+    rows[:, 3] = int(OpF.ENQUEUE)
+    rows[:, 4] = 1
+    rows[:, 5] = np.arange(n) % 60_000
+    rows[:, 6] = lats
+    rows[:, 7] = 1
+    return rows
+
+
+class TestWindowedStats:
+    @pytest.mark.parametrize("dist", ["uniform", "lognormal", "pareto"])
+    def test_quantiles_within_2pct_of_numpy(self, dist):
+        """The acceptance differential (the PR-9 sketch bar): device
+        whole-history percentiles vs plain host ``np.percentile`` on
+        wide continuous-ish distributions."""
+        rng = np.random.default_rng(7)
+        n = 4000
+        if dist == "uniform":
+            lats = rng.integers(1, 2000, n)
+        elif dist == "lognormal":
+            lats = np.maximum(rng.lognormal(3, 1, n).astype(int), 1)
+        else:
+            lats = np.maximum((rng.pareto(1.5, n) * 10).astype(int), 1)
+        t = windowed_stats_rows([_rows_with_lats(lats)])
+        got = quantiles_from_hist(np.asarray(t.hist)[0])
+        for q, g in zip(QUANTILES, got):
+            want = float(np.percentile(lats, q * 100))
+            assert abs(g - want) / want <= 0.02, (dist, q, g, want)
+
+    def test_rank_semantics_match_numpy_lower(self):
+        """On tiny discrete samples the kernel implements the sketch's
+        rank pick — element at floor(q*(n-1)), numpy's
+        ``method='lower'`` — within the bucket accuracy ALPHA."""
+        lats = np.array([0, 0, 1, 1, 1, 2, 4, 4, 9, 100], np.int64)
+        t = windowed_stats_rows([_rows_with_lats(lats)])
+        got = quantiles_from_hist(np.asarray(t.hist)[0])
+        for q, g in zip(QUANTILES, got):
+            want = float(np.percentile(lats, q * 100, method="lower"))
+            if want == 0.0:
+                assert g == 0.0
+            else:
+                assert abs(g - want) / want <= ALPHA + 1e-6
+
+    def test_rates_count_completions_once(self):
+        sh = synth_batch(1, SynthSpec(n_ops=200), lost=1)[0]
+        from jepsen_tpu.history.encode import pack_histories
+
+        t = windowed_stats(pack_histories([sh.ops]))
+        by_type = np.asarray(t.rates)[0].sum(axis=(0, 1))
+        want = {"ok": 0, "fail": 0, "info": 0}
+        open_ops = 0
+        for op in sh.ops:
+            if op.process == NEMESIS_PROCESS:
+                continue
+            if op.type == OpType.OK:
+                want["ok"] += 1
+            elif op.type == OpType.FAIL:
+                want["fail"] += 1
+            elif op.type == OpType.INFO:
+                want["info"] += 1
+        assert by_type.tolist() == [
+            want["ok"], want["fail"], want["info"],
+        ], (by_type, want, open_ops)
+
+    def test_hist_bridges_into_obs_sketch(self):
+        """Device histograms merge with live PR-9 sketches (same bucket
+        geometry) — merged quantiles match the combined population."""
+        from jepsen_tpu.obs.metrics import QuantileSketch
+
+        rng = np.random.default_rng(3)
+        a = np.maximum(rng.lognormal(3, 1, 1500).astype(int), 1)
+        b = np.maximum(rng.lognormal(4, 0.5, 1500).astype(int), 1)
+        t = windowed_stats_rows([_rows_with_lats(a)])
+        dev = sketch_from_hist(np.asarray(t.hist)[0])
+        live = QuantileSketch()
+        for x in b:
+            live.add(float(x))
+        live.merge(dev)
+        assert live.count == len(a) + len(b)
+        both = np.concatenate([a, b])
+        for q in (0.5, 0.99):
+            want = float(np.percentile(both, q * 100))
+            assert abs(live.quantile(q) - want) / want <= 0.02
+
+    def test_sketch_bridge_refuses_foreign_alpha(self):
+        with pytest.raises(ValueError, match="alpha"):
+            sketch_from_hist(np.zeros(8, np.int64), alpha=0.05)
+
+    def test_windowed_perf_composes_like_checker_compose(self):
+        sh = synth_batch(1, SynthSpec(n_ops=120))[0]
+        checker = compose(
+            {"perf": WindowedPerf(), "queue": TotalQueue(backend="cpu")}
+        )
+        res = checker.check({}, sh.ops)
+        assert res["valid?"] is True
+        assert res["perf"]["valid?"] is True
+        assert res["perf"]["completions"] > 0
+        assert "latency-ms" in res["perf"]
+
+
+@pytest.fixture(scope="module")
+def fixed_store(tmp_path_factory):
+    """A fixed two-run store (one green, one red) with rendered
+    reports — module-scoped so the determinism/index/XML tests share
+    one render."""
+    root = tmp_path_factory.mktemp("fixed_store")
+    st = Store(root)
+    checker = compose({"queue": TotalQueue(backend="cpu")})
+    dirs = []
+    for i, lost in enumerate((0, 1)):
+        sh = synth_batch(1, SynthSpec(n_ops=160, seed=11 + i), lost=lost)[0]
+        d = st.run_dir("fixed", f"run-{i}")
+        st.save_history(d, sh.ops)
+        res = checker.check({}, sh.ops)
+        st.save_results(d, res)
+        render_run_report(d, history=sh.ops, results=res)
+        dirs.append(d)
+    return root, dirs
+
+
+class TestRenderedArtifacts:
+    def test_every_artifact_is_well_formed_xml(self, fixed_store):
+        root, dirs = fixed_store
+        seen = 0
+        for d in dirs:
+            for p in d.glob("*.html"):
+                _parse_xml(p)
+                seen += 1
+        assert seen >= 3  # 2x report+timeline, 1x forensics
+
+    def test_byte_stable_given_fixed_store(self, fixed_store):
+        root, dirs = fixed_store
+        before = {
+            p: p.read_bytes()
+            for d in dirs
+            for p in list(d.glob("*.html")) + [d / "report.json"]
+        }
+        for d in dirs:
+            render_run_report(d)
+        for p, body in before.items():
+            assert p.read_bytes() == body, f"{p} changed across renders"
+
+    def test_report_json_headline(self, fixed_store):
+        root, (green, red) = fixed_store
+        s = json.loads((green / "report.json").read_text())
+        assert s["valid?"] is True
+        assert s["ops"] > 0
+        assert "latency-ms" in s
+        s2 = json.loads((red / "report.json").read_text())
+        assert s2["valid?"] is False
+
+    def test_invalid_run_gets_forensics_valid_does_not(self, fixed_store):
+        root, (green, red) = fixed_store
+        assert not (green / "forensics.html").exists()
+        assert (red / "forensics.html").is_file()
+
+    def test_nemesis_windows_shade_the_panels(self, tmp_path):
+        """A history with real nemesis START/STOP ops renders shaded
+        windows + the window table, on the op clock."""
+        sh = synth_batch(1, SynthSpec(n_ops=120))[0]
+        ops = list(sh.ops)
+        t0, t1 = 10_000_000, 400_000_000
+        idx = len(ops)
+        ops += [
+            Op(OpType.INVOKE, OpF.START, NEMESIS_PROCESS, None, t0, idx),
+            Op(OpType.INFO, OpF.START, NEMESIS_PROCESS,
+               "partition-halves", t0 + 1000, idx + 1),
+            Op(OpType.INVOKE, OpF.STOP, NEMESIS_PROCESS, None, t1, idx + 2),
+            Op(OpType.INFO, OpF.STOP, NEMESIS_PROCESS, "healed",
+               t1 + 1000, idx + 3),
+        ]
+        wins = nemesis_windows(ops)
+        assert len(wins) == 1
+        w0, w1, label = wins[0]
+        assert label == "partition-halves"
+        assert w0 == t0 + 1000 and w1 == t1 + 1000
+        d = tmp_path / "run"
+        d.mkdir()
+        Store(tmp_path).save_history(d, ops)
+        paths = render_run_report(
+            d, history=ops, results={"valid?": True}
+        )
+        html = Path(paths["report"]).read_text()
+        assert "partition-halves" in html
+        assert "nemesis windows" in html
+        _parse_xml(Path(paths["report"]))
+
+    def test_all_ops_at_t0_render_without_crash(self, tmp_path):
+        """A history whose only timestamps sit at t=0 ns (hand-built /
+        imported) must render, not divide by zero (review finding)."""
+        from jepsen_tpu.report.render import render_timeline
+
+        ops = [
+            Op(OpType.INVOKE, OpF.ENQUEUE, 0, 1, 0, 0),
+            Op(OpType.OK, OpF.ENQUEUE, 0, 1, 0, 1),
+        ]
+        p = render_timeline(ops, tmp_path / "t.html")
+        _parse_xml(p)
+
+    def test_unclosed_window_closes_at_history_end(self):
+        ops = [
+            Op(OpType.INVOKE, OpF.START, NEMESIS_PROCESS, None, 5, 0),
+            Op(OpType.INFO, OpF.START, NEMESIS_PROCESS, "kill", 10, 1),
+            Op(OpType.INVOKE, OpF.ENQUEUE, 0, 1, 50, 2),
+        ]
+        wins = nemesis_windows(ops)
+        assert wins == [(10, 50, "kill")]
+
+
+class TestForensics:
+    def test_lost_values_flagged(self, fixed_store):
+        root, (_, red) = fixed_store
+        results = json.loads((red / "results.json").read_text())
+        lost = set(results["queue"]["lost"])
+        assert lost
+        html = (red / "forensics.html").read_text()
+        assert "lost" in html
+        # the flagged rows carry the highlight style
+        assert "background:#ffe0e0" in html
+        history = Store(root).load_history(red)
+        flagged = flag_ops(history, violating_values(results))
+        assert flagged, "no ops flagged for a lost value"
+        flagged_vals = {
+            v
+            for i in flagged
+            for v in ([history[i].value]
+                      if not isinstance(history[i].value, (list, tuple))
+                      else history[i].value)
+        }
+        assert lost & {v for v in flagged_vals if isinstance(v, int)}
+
+    def test_valid_run_refuses_a_page(self, fixed_store):
+        root, (green, _) = fixed_store
+        assert render_forensics(green) is None
+
+    def test_pcomp_refuted_class_flagged(self, tmp_path):
+        """A mutex pcomp result naming its refuted projection class
+        flags the ops touching that class."""
+        ops = [
+            Op(OpType.INVOKE, OpF.ACQUIRE, 0, 3, 10, 0),
+            Op(OpType.OK, OpF.ACQUIRE, 0, 3, 20, 1),
+            Op(OpType.INVOKE, OpF.ACQUIRE, 1, 4, 30, 2),
+            Op(OpType.OK, OpF.ACQUIRE, 1, 4, 40, 3),
+        ]
+        results = {
+            "valid?": False,
+            "mutex": {"valid?": False, "invalid-class": ["value", 3]},
+        }
+        d = tmp_path / "run"
+        d.mkdir()
+        Store(tmp_path).save_history(d, ops)
+        p = render_forensics(d, history=ops, results=results)
+        assert p is not None
+        _parse_xml(p)
+        flagged = flag_ops(ops, violating_values(results))
+        assert set(flagged) == {0, 1}
+
+    def test_repro_link_lands_on_the_page(self, fixed_store, tmp_path):
+        root, (_, red) = fixed_store
+        history = Store(root).load_history(red)
+        out = tmp_path / "red.forensics.html"
+        p = render_forensics(
+            red,
+            history=history,
+            repro_path="fuzz_repro_x.py",
+            out_path=out,
+        )
+        assert p == out
+        html = out.read_text()
+        assert "fuzz_repro_x.py" in html
+        _parse_xml(out)
+
+
+class TestStoreIndex:
+    def test_index_rows_trend_and_links(self, fixed_store):
+        root, dirs = fixed_store
+        idx = build_store_index(root)
+        assert idx == root / "index.html"
+        _parse_xml(idx)
+        html = idx.read_text()
+        for d in dirs:
+            assert str(d.relative_to(root)) in html
+        assert "forensics" in html  # the red run's link
+        assert "<svg" in html  # the trend sparkline
+        assert "2 runs" in html
+
+    def test_index_is_byte_stable(self, fixed_store):
+        root, _ = fixed_store
+        b1 = build_store_index(root).read_bytes()
+        b2 = build_store_index(root).read_bytes()
+        assert b1 == b2
+
+    def test_symlinks_do_not_double_index(self, fixed_store):
+        root, dirs = fixed_store
+        st = Store(root)
+        st.link_run("fixed", dirs[0])  # current/latest symlinks
+        assert len(run_dirs(root)) == len(dirs)
+
+    def test_empty_store_returns_none(self, tmp_path):
+        assert build_store_index(tmp_path) is None
+
+    def test_malformed_report_json_costs_one_cell_not_the_index(
+        self, tmp_path
+    ):
+        """A hand-edited/foreign report.json with a non-numeric p50
+        must not abort the whole index build (review finding)."""
+        d = tmp_path / "runs" / "r0"
+        d.mkdir(parents=True)
+        (d / "results.json").write_text('{"valid?": true}')
+        (d / "report.json").write_text(
+            json.dumps({
+                "run": "r0", "valid?": True, "ops": 3,
+                "latency-ms": {"p50": "12ms", "p99": None},
+            })
+        )
+        idx = build_store_index(tmp_path, render_missing=False)
+        assert idx is not None
+        _parse_xml(idx)
+        assert "r0" in idx.read_text()
+
+
+class TestRunnerDefaultOn:
+    """``run`` writes the report by default, like jepsen's
+    store/report; ``report=False`` opts out."""
+
+    FAST = {
+        "rate": 400.0,
+        "time-limit": 0.8,
+        "time-before-partition": 0.2,
+        "partition-duration": 0.2,
+        "recovery-sleep": 0.1,
+    }
+
+    def _run(self, tmp_path, report=True):
+        from jepsen_tpu.control.runner import run_test
+        from jepsen_tpu.suite import build_sim_test
+
+        test, _ = build_sim_test(
+            opts=self.FAST, store_root=str(tmp_path / "store"),
+            checker_backend="cpu",
+        )
+        test.report = report
+        return run_test(test)
+
+    def test_report_rendered_by_default(self, tmp_path):
+        run = self._run(tmp_path)
+        assert (run.run_dir / "report.html").is_file()
+        assert (run.run_dir / "timeline.html").is_file()
+        assert (run.run_dir / "report.json").is_file()
+        _parse_xml(run.run_dir / "report.html")
+        # the run's results carry the device windowed-stats summary
+        assert run.results["perf-windowed"]["valid?"] is True
+        assert run.results["perf-windowed"]["completions"] > 0
+
+    def test_no_report_opts_out(self, tmp_path):
+        run = self._run(tmp_path, report=False)
+        assert not (run.run_dir / "report.html").exists()
+
+
+class TestCliWiring:
+    def _store_with_run(self, tmp_path) -> Path:
+        st = Store(tmp_path / "store")
+        sh = synth_batch(1, SynthSpec(n_ops=100), lost=1)[0]
+        d = st.run_dir("cli", "r0")
+        st.save_history(d, sh.ops)
+        return d
+
+    def test_check_report_flag(self, tmp_path, capsys):
+        from jepsen_tpu.cli.main import main
+
+        d = self._store_with_run(tmp_path)
+        rc = main(["check", str(d), "--checker", "cpu", "--report"])
+        assert rc == 1  # lost value -> invalid
+        assert (d / "report.html").is_file()
+        assert (d / "forensics.html").is_file()
+
+    def test_report_subcommand_builds_index(self, tmp_path, capsys):
+        from jepsen_tpu.cli.main import main
+
+        d = self._store_with_run(tmp_path)
+        from jepsen_tpu.history.store import save_results
+
+        save_results(d, {"valid?": True})
+        rc = main(["report", str(tmp_path / "store")])
+        assert rc == 0
+        idx = tmp_path / "store" / "index.html"
+        assert idx.is_file()
+        assert (d / "report.html").is_file()
+
+    def test_report_subcommand_single_run_dir(self, tmp_path, capsys):
+        from jepsen_tpu.cli.main import main
+
+        d = self._store_with_run(tmp_path)
+        rc = main(["report", str(d)])
+        assert rc == 0
+        assert (d / "report.html").is_file()
+
+    def test_report_subcommand_missing_dir(self, tmp_path):
+        from jepsen_tpu.cli.main import main
+
+        assert main(["report", str(tmp_path / "nope")]) == 2
+
+
+class TestTraceKeepOnFailure:
+    """ISSUE-11 satellite: ``jepsen-tpu trace`` discards the artifact
+    on non-zero exit; ``--keep-on-failure`` keeps the recording at
+    ``<out>.failed`` — never the artifact path."""
+
+    def test_failure_discards_by_default(self, tmp_path):
+        from jepsen_tpu.cli.main import main
+
+        out = tmp_path / "t.json"
+        rc = main(
+            ["trace", "--out", str(out), "--",
+             "check", str(tmp_path / "missing")]
+        )
+        assert rc == 2
+        assert not out.exists()
+        assert not Path(str(out) + ".failed").exists()
+
+    def test_keep_on_failure_writes_failed_sibling(self, tmp_path):
+        from jepsen_tpu.cli.main import main
+
+        out = tmp_path / "t.json"
+        rc = main(
+            ["trace", "--out", str(out), "--keep-on-failure", "--",
+             "check", str(tmp_path / "missing")]
+        )
+        assert rc == 2
+        assert not out.exists(), "the artifact path must stay clean"
+        failed = Path(str(out) + ".failed")
+        assert failed.is_file()
+        doc = json.loads(failed.read_text())
+        assert "traceEvents" in doc
+
+    def test_success_still_writes_the_artifact(self, tmp_path):
+        from jepsen_tpu.cli.main import main
+
+        st = Store(tmp_path / "store")
+        d = st.run_dir("t", "r0")
+        sh = synth_batch(1, SynthSpec(n_ops=60))[0]
+        st.save_history(d, sh.ops)
+        out = tmp_path / "t.json"
+        rc = main(
+            ["trace", "--out", str(out), "--keep-on-failure", "--",
+             "check", str(d), "--checker", "cpu"]
+        )
+        assert rc == 0
+        assert out.is_file()
+        assert not Path(str(out) + ".failed").exists()
+
+
+class TestObsSurface:
+    def test_metrics_render_carries_trace_health(self):
+        from jepsen_tpu.obs import trace
+        from jepsen_tpu.obs.metrics import render_prometheus
+
+        trace.enable(512)
+        try:
+            with trace.span("a", track="lane0"):
+                pass
+            out = render_prometheus()
+        finally:
+            trace.disable()
+        assert "jepsen_tpu_trace_ring_occupancy" in out
+        assert "jepsen_tpu_trace_spans_dropped_total" in out
+        assert 'jepsen_tpu_trace_spans_total{track="lane0"} 1' in out
+
+    def test_dropped_total_counts_ring_wrap(self):
+        from jepsen_tpu.obs import trace
+        from jepsen_tpu.obs.metrics import render_prometheus
+
+        trace.enable(256)  # floor capacity
+        try:
+            for _ in range(300):
+                trace.event("e")
+            out = render_prometheus()
+        finally:
+            trace.disable()
+        line = next(
+            ln for ln in out.splitlines()
+            if ln.startswith("jepsen_tpu_trace_spans_dropped_total")
+        )
+        assert int(line.split()[-1]) == 300 - 256
+
+    def test_report_route_on_metrics_server(self, tmp_path):
+        from jepsen_tpu.history.store import save_results
+        from jepsen_tpu.obs.metrics import serve_metrics
+
+        st = Store(tmp_path / "store")
+        sh = synth_batch(1, SynthSpec(n_ops=80))[0]
+        d = st.run_dir("svc", "r0")
+        st.save_history(d, sh.ops)
+        save_results(d, {"valid?": True})
+        srv = serve_metrics("127.0.0.1", 0, store=str(tmp_path / "store"))
+        srv.start_background()
+        try:
+            port = srv.server_address[1]
+            url = f"http://127.0.0.1:{port}/report/svc/r0/report.html"
+            body = urllib.request.urlopen(url, timeout=10).read()
+            assert b"<svg" in body  # rendered on demand
+            assert (d / "report.html").is_file()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/report/"
+                    f"..%2f..%2fetc%2fpasswd",
+                    timeout=10,
+                )
+            assert ei.value.code in (403, 404)
+            # a run-DIR request redirects to its report.html off the
+            # QUERY-STRIPPED path (a raw-path redirect looped forever
+            # on any ?query URL — review finding, pinned)
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/report/svc/r0?x=1",
+                timeout=10,
+            ).read()
+            assert b"<svg" in body
+            # the store ROOT is not a run dir: 404 with advice until an
+            # index.html exists, then a redirect to it — never a 500
+            # from rendering a report of the store root
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/report/", timeout=10
+                )
+            assert ei.value.code == 404
+            build_store_index(tmp_path / "store")
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/report/", timeout=10
+            ).read()
+            assert b"run index" in body
+        finally:
+            srv.shutdown()
+            srv.server_close()

@@ -70,7 +70,7 @@ def run_fuzz(args) -> int:
         for name in ("jepsen_tpu.runner", "jepsen_tpu.generator"):
             logging.getLogger(name).setLevel(logging.WARNING)
 
-    from jepsen_tpu.fuzz.emit import emit_repro
+    from jepsen_tpu.fuzz.emit import emit_forensics, emit_repro
     from jepsen_tpu.fuzz.minimize import minimize
     from jepsen_tpu.fuzz.runner import is_red, triage_run
     from jepsen_tpu.fuzz.space import sample_config
@@ -153,7 +153,12 @@ def run_fuzz(args) -> int:
               f"({stats.events_before}->{stats.events_after} events, "
               f"{stats.window_before:g}->{stats.window_after:g}s window, "
               f"{stats.runs} runs) — repro emitted: {path}", flush=True)
+        forensics = emit_forensics(final, path)
+        if forensics:
+            print(f"# config {i + 1}: forensics page: {forensics}",
+                  flush=True)
         found.append({
+            "forensics": forensics,
             "config_seed": cfg.seed,
             "workload": cfg.workload,
             "invalidating": final.invalidating,

@@ -1,0 +1,60 @@
+"""Cross-run report index for a store tree (ISSUE 11 tentpole (d)).
+
+Walks ``store/``, renders any missing per-run report artifacts
+(``report.html`` / ``timeline.html`` / ``forensics.html`` on invalid —
+``jepsen_tpu/report/``), and emits ``store/index.html``: one row per
+run with verdict, op count, latency headline (p50/p99 off the device
+windowed-stats kernel), nemesis-window count, artifact links, and a
+p50-latency trend sparkline across the runs — soak and fuzz campaigns
+become a browsable surface instead of grep'd logs.
+
+Same engine as ``jepsen-tpu report <store-dir>``; this wrapper exists
+so campaign drivers (soak supervisors, fuzz loops) can regenerate the
+index without the CLI's argv surface::
+
+    python tools/report_store.py store/
+    python tools/report_store.py store/ --no-render   # index-only
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# rendering must never hang on a wedged chip tunnel; the windowed-stats
+# kernel is a tiny dispatch, fine on the CPU backend
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("store", help="store root to walk")
+    p.add_argument(
+        "--no-render",
+        action="store_true",
+        help="index only runs that already carry a report.json; "
+        "render nothing new",
+    )
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.store):
+        print(f"error: no such store dir {args.store}", file=sys.stderr)
+        return 2
+
+    from jepsen_tpu.report.index import build_store_index
+
+    idx = build_store_index(
+        args.store, render_missing=not args.no_render
+    )
+    if idx is None:
+        print(f"no runs under {args.store}", file=sys.stderr)
+        return 2
+    print(str(idx))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -275,6 +275,32 @@ def run_soak(args) -> int:
             flush=True,
         )
     print(json.dumps(run.results, indent=1, default=_json_default))
+    # latency sketch percentiles (ISSUE-11 satellite): the wall clock
+    # alone says nothing about what the RUN felt like — print the op
+    # completion latency and the analysis check-batch latency off the
+    # PR-9 quantile sketches
+    from jepsen_tpu.history.rows import _rows_for
+    from jepsen_tpu.obs.metrics import REGISTRY, QuantileSketch
+
+    op_sketch = QuantileSketch()
+    rows = _rows_for(run.history)
+    for lat in rows[(rows[:, 7] == 1) & (rows[:, 6] >= 0), 6]:
+        op_sketch.add(float(lat))
+
+    def _pq(s, q, scale=1.0):
+        v = s.quantile(q)
+        return "-" if v != v else f"{v * scale:.1f}"
+
+    check_sketch = REGISTRY.sketch("pipeline.check_batch_s")
+    print(
+        f"# soak latency sketches: op p50 {_pq(op_sketch, 0.5)}ms / "
+        f"p99 {_pq(op_sketch, 0.99)}ms "
+        f"({op_sketch.count} completions); analysis check-batch "
+        f"p50 {_pq(check_sketch, 0.5, 1e3)}ms / "
+        f"p99 {_pq(check_sketch, 0.99, 1e3)}ms "
+        f"({check_sketch.count} batches)",
+        flush=True,
+    )
     print(
         f"# soak done in {wall:.0f}s wall ({len(run.history)} history "
         f"ops, attempts logged above)",
@@ -291,6 +317,29 @@ def run_soak(args) -> int:
 
         summary = obs_export.write_trace(args.trace_out)
         print(f"# soak trace: {json.dumps(summary)}", flush=True)
+    if getattr(args, "report", False) and run.run_dir is not None:
+        # the per-run report beside the captured log: re-rendered here
+        # (the runner's default-on pass has no trace link) with the
+        # trace artifact cross-linked on the run's own clock
+        from jepsen_tpu.report.render import render_run_report
+
+        trace_rel = (
+            os.path.relpath(
+                os.path.abspath(args.trace_out), run.run_dir
+            )
+            if args.trace_out
+            else None
+        )
+        paths = render_run_report(
+            run.run_dir,
+            history=run.history,
+            results=run.results,
+            trace_path=trace_rel,
+        )
+        print(
+            "# soak report: " + " ".join(sorted(paths.values())),
+            flush=True,
+        )
     return 0
 
 
@@ -335,6 +384,12 @@ def main(argv=None) -> int:
                         "written when the run reaches its expected "
                         "verdict (failure leaves OUT.failed and a "
                         "non-zero exit)")
+    p.add_argument("--report", action="store_true",
+                   help="emit the per-run report artifacts "
+                        "(report.html/timeline.html, trace "
+                        "cross-linked) into the run dir beside "
+                        "--out/--trace-out — same capture discipline: "
+                        "only after the expected verdict")
     p.add_argument("--trace-out", default=None,
                    help="record the soak through the flight recorder "
                         "(jepsen_tpu/obs) and export a Perfetto trace "

@@ -986,6 +986,143 @@ def _bench_obs_overhead_section(details: dict) -> None:
     details["obs_overhead"] = got
 
 
+def _bench_cluster_obs_overhead(
+    details: dict,
+    seconds: float = 20.0,
+    nodes: int = 5,
+    rate: float = 400.0,
+    repeats: int = 2,
+    seed: int = 7,
+) -> None:
+    """The cluster telemetry plane's cost, measured where it matters
+    (ISSUE 12 done-bar): the north-star live-run recipe — a REAL
+    ``nodes``-node durable replicated cluster under the seeded mixed
+    nemesis (the soak recipe's shape, short) — with the ~1 Hz poller
+    OFF vs ON, interleaved ``repeats``×, max client-op throughput per
+    mode.  ``overhead_frac`` must stay ≤ 2%: telemetry is allowed to
+    watch the cluster, not to slow it.
+
+    Throughput is measured on the OP CLOCK (completions / last-op
+    time), so post-run analysis wall — identical work in both arms but
+    the noisiest part of a 2-core box — never pollutes the comparison.
+    The node-side counters (int adds per RPC/fsync) are always on by
+    design, like the pipeline's metrics-view accounting: what toggles
+    between the arms is the poller thread + admin STATS traffic +
+    registry/gauge mirroring, the whole telemetry plane a test can
+    switch off."""
+    import tempfile
+
+    import jax
+
+    from jepsen_tpu.client import native as native_mod
+    from jepsen_tpu.control.runner import run_test
+    from jepsen_tpu.harness.localcluster import build_local_test
+    from jepsen_tpu.history.ops import OpType
+    from jepsen_tpu.obs.cluster import load_cluster_json
+
+    opts = {
+        "rate": rate,
+        "time-limit": seconds,
+        "time-before-partition": 2.0,
+        "partition-duration": 3.0,
+        "network-partition": "partition-random-halves",
+        "nemesis": "mixed",
+        "recovery-sleep": 2.0,
+        "publish-confirm-timeout": 2.5,
+        "durable": True,
+        "seed": seed,
+    }
+
+    def one(telemetry: bool):
+        native_mod.reset()
+        test, transport = build_local_test(
+            opts,
+            n_nodes=nodes,
+            concurrency=nodes,
+            checker_backend="cpu",
+            store_root=tempfile.mkdtemp(prefix="bench_cluster_obs_"),
+            workload="queue",
+            durable=True,
+        )
+        test.report = False
+        test.cluster_telemetry = telemetry
+        try:
+            run = run_test(test)
+        finally:
+            transport.close()
+        client_completions = sum(
+            1
+            for op in run.history
+            if op.process >= 0 and op.type != OpType.INVOKE
+        )
+        load_wall = max(
+            (op.time for op in run.history if op.time >= 0), default=1
+        ) / 1e9
+        return client_completions / max(load_wall, 1e-9), run
+
+    off_rates: list[float] = []
+    on_rates: list[float] = []
+    polls = node_events = samples = 0
+    for _ in range(repeats):
+        r_off, _run = one(False)
+        off_rates.append(r_off)
+        r_on, run_on = one(True)
+        on_rates.append(r_on)
+        doc = (
+            load_cluster_json(run_on.run_dir)
+            if run_on.run_dir is not None
+            else None
+        )
+        # fail-loud PER ON REPEAT: an ON arm measured without a working
+        # telemetry plane is exactly the lie this guard exists to catch
+        # (a stale doc from an earlier repeat must not cover for it)
+        if doc is None or not doc.get("samples"):
+            raise RuntimeError(
+                "telemetry-on run produced no cluster.json samples — "
+                "the poller is unwired, the overhead number would be "
+                "a lie"
+            )
+        # reported numbers are the LAST ON run's (one run's worth, not
+        # a sum across repeats)
+        polls = doc["summary"]["polls"]
+        node_events = len(doc["events"])
+        samples = len(doc["samples"])
+    off, on = max(off_rates), max(on_rates)
+    overhead = (off - on) / max(off, 1e-9)
+    details["cluster_obs_overhead"] = {
+        "config": f"{nodes}-node durable replicated cluster, mixed "
+                  f"nemesis seed {seed}, {seconds:g}s load at "
+                  f"{rate:g} ops/s: cluster telemetry poller off vs on",
+        "nodes": nodes,
+        "seconds": seconds,
+        "rate": rate,
+        "repeats": repeats,
+        "telemetry_off_ops_per_s": round(off, 1),
+        "telemetry_on_ops_per_s": round(on, 1),
+        "overhead_frac": round(overhead, 4),
+        "within_2pct": bool(overhead <= 0.02),
+        "polls": int(polls),
+        "samples": int(samples),
+        "node_events": int(node_events),
+        "backend": jax.default_backend(),
+    }
+    o = details["cluster_obs_overhead"]
+    print(
+        f"# cluster_obs_overhead: off {off:.1f} ops/s | on {on:.1f} "
+        f"ops/s -> {overhead * 100:.2f}% "
+        f"({'within' if o['within_2pct'] else 'OUTSIDE'} 2%); "
+        f"{samples} samples / {polls} polls / {node_events} node events",
+        file=sys.stderr,
+    )
+
+
+def _bench_cluster_obs_overhead_section(details: dict) -> None:
+    """``cluster_obs_overhead`` for the section loop: host-side (a live
+    local cluster — the checkers already pin to the CPU backend), so it
+    runs in-process on every backend."""
+    _bench_cluster_obs_overhead(details)
+
+
 def _bench_report(
     details: dict,
     histories: int = None,
@@ -1998,8 +2135,8 @@ def _run_once() -> None:
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_north_star_section, _bench_cold_vs_warm_section,
-        _bench_obs_overhead_section, _bench_report_section,
-        _bench_scaling,
+        _bench_obs_overhead_section, _bench_cluster_obs_overhead_section,
+        _bench_report_section, _bench_scaling,
     ):
         try:
             section(details)

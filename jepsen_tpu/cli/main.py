@@ -1276,6 +1276,9 @@ def cmd_test(args) -> int:
             args.log_file_pattern
         )
     test.report = not getattr(args, "no_report", False)
+    test.cluster_telemetry = not getattr(
+        args, "no_cluster_telemetry", False
+    )
     monitor = None
     if args.live_check:
         from jepsen_tpu.checkers.live import attach_live_monitor_for
@@ -1941,6 +1944,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="skip the default-on per-run report artifacts "
         "(report.html/timeline.html — jepsen writes store/report for "
         "every run; this framework now does too)",
+    )
+    t.add_argument(
+        "--no-cluster-telemetry",
+        dest="no_cluster_telemetry",
+        action="store_true",
+        help="skip the default-on ~1 Hz cluster telemetry poller "
+        "(per-node Raft/broker internals sampled over the admin STATS "
+        "command into cluster.json + the report's cluster panel; "
+        "jepsen_tpu/obs/cluster.py)",
     )
     t.add_argument(
         "--live-check",

@@ -79,6 +79,16 @@ class Test:
     #: forensics.html on invalid) into the run dir after analysis —
     #: default ON like jepsen's store/report; ``--no-report`` disables
     report: bool = True
+    #: cluster telemetry source (obs/cluster.py): an object with
+    #: ``poll() -> {node: snapshot | None}`` — wired by the builders
+    #: when the transport can answer the admin ``STATS`` pull; None
+    #: (e.g. SSH transports, the sim) means no telemetry plane
+    cluster_source: Any = None
+    #: sample the cluster source ~1 Hz during the run and harvest
+    #: ``cluster.json`` beside ``results.json``; ``--no-cluster-
+    #: telemetry`` disables.  With no source this is free — no poller
+    #: thread is ever built
+    cluster_telemetry: bool = True
 
     def as_map(self) -> dict[str, Any]:
         return {
@@ -352,6 +362,22 @@ def _run_test_logged(
             daemon=True,
         )
     )
+    # cluster telemetry plane (obs/cluster.py): sample per-node Raft/
+    # broker internals at ~1 Hz onto the run's op clock while the load
+    # runs.  Best-effort by construction — a telemetry bug must never
+    # change a verdict or kill a run.
+    poller = None
+    if test.cluster_telemetry and test.cluster_source is not None:
+        try:
+            from jepsen_tpu.obs.cluster import ClusterPoller
+
+            poller = ClusterPoller(
+                test.cluster_source, start_ns=start_ns
+            ).start()
+        except Exception:  # noqa: BLE001
+            logger.exception("cluster telemetry failed to start")
+            poller = None
+
     logger.info("run: %d workers + nemesis", test.concurrency)
     with obs_trace.span(
         "run.load",
@@ -366,6 +392,23 @@ def _run_test_logged(
             t.start()
         for t in threads:
             t.join()
+
+    # harvest telemetry BEFORE teardown: the final poll must still see
+    # live nodes (end-of-run snapshots are part of the contract)
+    if poller is not None:
+        try:
+            from jepsen_tpu.obs.cluster import (
+                summary_line,
+                write_cluster_json,
+            )
+
+            cluster_doc = poller.stop()
+            write_cluster_json(run_dir, cluster_doc)
+            logger.info("cluster telemetry: %s", summary_line(cluster_doc))
+        except Exception:  # noqa: BLE001
+            logger.exception(
+                "cluster telemetry harvest failed (verdict unaffected)"
+            )
 
     logger.info("teardown")
     with obs_trace.span("run.teardown", track="run"):

@@ -25,6 +25,7 @@ Fault injection mirrors what the checker must catch end-to-end:
 
 from __future__ import annotations
 
+import json as _json
 import socket
 import struct
 import random as _random
@@ -222,6 +223,11 @@ class MiniAmqpBroker:
         self._delivered = 0
         self._appended = 0
         self._conn_seq = 0
+        # cluster telemetry (ISSUE 12): loud channel-close counters —
+        # 540 = fenced-consume refusal, 541 = lost-quorum internal-error
+        # — read at poll granularity via stats_snapshot / admin STATS
+        self._chan_close_540 = 0
+        self._chan_close_541 = 0
         # local-mode fencing state (replicated mode keeps the replicated
         # twin in QueueMachine.fences, driven by commit indices): per-
         # queue current fence + the monotonic token mint
@@ -397,6 +403,58 @@ class MiniAmqpBroker:
             return len(self.replication.machine.stream_snapshot(name))
         with self.state_lock:
             return len(self.streams.get(name, ()))
+
+    def stats_snapshot(self) -> dict:
+        """Cluster-telemetry snapshot (ISSUE 12): this node's broker
+        plane (connections, ready/inflight depths, throughput counters,
+        loud 540/541 channel closes) plus — in replicated mode — the
+        Raft node's telemetry block.  JSON-safe: the admin ``STATS``
+        command ships it verbatim, and the in-process poller consumes
+        the same shape (obs/cluster.py)."""
+        with self.state_lock:
+            conns = list(self._conns)
+            local_ready = (
+                0
+                if self.replication is not None  # shadowed below; don't
+                else sum(  # walk every queue under the contended lock
+                    len(dq) for dq in self.queues.values()
+                ) + sum(len(log) for log in self.streams.values())
+            )
+        inflight = sum(len(c.unacked) for c in conns)
+        if self.replication is not None:
+            # ready = this replica's applied view; inflight = replicated
+            # deliveries OWNED by this node's connections (owner ids are
+            # "node|salt-cN" — the per-node slice of the cluster map)
+            prefix = self.replication.raft.name + "|"
+            m = self.replication.machine
+            with m.lock:
+                ready = sum(len(dq) for dq in m.queues.values()) + sum(
+                    len(log) for log in m.streams.values()
+                )
+                inflight = sum(
+                    1
+                    for owner, _q, _m in m.inflight.values()
+                    if owner.startswith(prefix)
+                )
+        else:
+            ready = local_ready
+        return {
+            "broker": {
+                "connections": len(conns),
+                "ready": ready,
+                "inflight": inflight,
+                "published": self._published,
+                "delivered": self._delivered,
+                "appended": self._appended,
+                "chan_close_540": self._chan_close_540,
+                "chan_close_541": self._chan_close_541,
+            },
+            "raft": (
+                self.replication.raft.stats_snapshot()
+                if self.replication is not None
+                else None
+            ),
+        }
 
     # ---- internals -------------------------------------------------------
     def _accept_loop(self):
@@ -641,6 +699,7 @@ class MiniAmqpBroker:
                         # close the channel so the client's read FAILS
                         # (reads are safe to fail) instead of concluding
                         # end-of-log on nothing
+                        self._chan_close_541 += 1
                         self._send_method(
                             conn,
                             ch,
@@ -1052,6 +1111,7 @@ class MiniAmqpBroker:
                 # channel loudly instead (the native client marks the
                 # connection broken; the drain marks the pass dirty and
                 # retries after the settle sleep).
+                self._chan_close_541 += 1
                 self._send_method(
                     conn,
                     ch,
@@ -1155,6 +1215,7 @@ class MiniAmqpBroker:
             with self.state_lock:
                 if conn.consuming_queue is not None:
                     conn.consuming_queue = None
+        self._chan_close_540 += 1
         self._send_method(
             conn,
             ch,
@@ -1487,6 +1548,15 @@ def _serve_admin_conn(broker: MiniAmqpBroker, sock: "socket.socket") -> None:
         elif req == "ROLE" and broker.replication is not None:
             state, term, hint = broker.replication.raft.role()
             sock.sendall(f"{state} {term} {hint or '-'}\n".encode())
+        elif req == "STATS":
+            # cluster telemetry pull (ISSUE 12): one JSON line with the
+            # node's full telemetry snapshot — role/term/commit gauges,
+            # RPC/election/wire counters, the WAL-fsync latency sketch
+            # state, broker depths.  Works in local mode too (raft block
+            # null); the runner's poller consumes it at ~1 Hz.
+            sock.sendall(
+                (_json.dumps(broker.stats_snapshot()) + "\n").encode()
+            )
         elif req.startswith("CLOCK_SET ") and (
             broker.replication is not None
         ):

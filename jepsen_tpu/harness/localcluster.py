@@ -544,6 +544,22 @@ class LocalProcTransport(Transport):
     def _list_queues(self, node: str) -> RunResult:
         return self._admin(node, "DEPTHS")
 
+    def node_stats(self, node: str, timeout_s: float = 0.5) -> dict | None:
+        """One cluster-telemetry snapshot off the node's admin ``STATS``
+        command; ``None`` when the node is dead/unreachable (a SIGSTOPped
+        node times out inside ``timeout_s`` — the poller records it as
+        down rather than stalling the sampling loop)."""
+        import json
+
+        r = self._admin(node, "STATS", timeout_s=timeout_s)
+        if r.rc != 0 or not r.out.strip():
+            return None
+        try:
+            got = json.loads(r.out)
+        except ValueError:
+            return None
+        return got if isinstance(got, dict) else None
+
     def leader(self) -> str | None:
         """The current Raft leader's node name, per the nodes' admin ROLE
         answers (None when no node claims leadership — mid-election, or a

@@ -129,9 +129,35 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from jepsen_tpu.obs.metrics import QuantileSketch
+
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
 logger = logging.getLogger("jepsen_tpu.replication")
+
+
+class NodeCounters:
+    """Per-node telemetry counters (ISSUE 12): plain int attributes
+    incremented inline on the paths they watch.  No lock — most sites
+    already hold the node lock, and the rest accept the same unlocked
+    read-modify-write accuracy contract as the tracer's per-track
+    totals (a rare lost increment costs gauge accuracy, never
+    correctness).  Read via :meth:`snapshot` (the admin ``STATS``
+    command and the in-process poller, obs/cluster.py)."""
+
+    __slots__ = (
+        "elections_started", "elections_won",
+        "rpc_sent", "rpc_recv", "rpc_dropped", "crc_rejected",
+        "wire_corrupt", "wire_duplicate", "wire_delay",
+        "safety_violations", "recoveries", "wal_bytes",
+    )
+
+    def __init__(self) -> None:
+        for name in self.__slots__:
+            setattr(self, name, 0)
+
+    def snapshot(self) -> dict:
+        return {name: getattr(self, name) for name in self.__slots__}
 
 
 # ---------------------------------------------------------------------------
@@ -508,6 +534,14 @@ class RaftNode:
         self.seed_bug = seed_bug
         self.rng = random.Random(rng_seed)
 
+        #: cluster telemetry (ISSUE 12): counters + the WAL-fsync
+        #: latency sketch, read at poll granularity (never per-op) via
+        #: stats_snapshot / the admin STATS command.  Maintaining them
+        #: is a handful of int adds per RPC/fsync — always on, like the
+        #: pipeline's metrics-view accounting.
+        self.counters = NodeCounters()
+        self._fsync_ms = QuantileSketch()
+
         # runtime fault hooks (nemesis-driven via the broker admin port)
         self._fsync_delay_ms = 0.0
         self._fsync_jitter_ms = 0.0
@@ -617,6 +651,7 @@ class RaftNode:
                 meta = json.load(fh)
             self.term = int(meta.get("term", 0))
             self.voted_for = meta.get("voted_for")
+            self.counters.recoveries += 1  # prior durable state found
         except (OSError, ValueError):
             pass
         wal_p = os.path.join(self.data_dir, "wal.jsonl")
@@ -650,6 +685,7 @@ class RaftNode:
                     fh.truncate(good)
                     fh.flush()
                     os.fsync(fh.fileno())
+            self.counters.wal_bytes = good  # recovered WAL size
         except OSError:
             pass
         # recovered entries re-apply as commit_idx advances (apply is
@@ -667,8 +703,7 @@ class RaftNode:
                     {"term": self.term, "voted_for": self.voted_for}, fh
                 )
                 fh.flush()
-                self._fsync_stall()
-                os.fsync(fh.fileno())
+                self._timed_fsync(fh.fileno())
             os.replace(tmp, os.path.join(self.data_dir, "meta.json"))
         except OSError as e:
             self._fail_stop_locked("meta persist failed", e)
@@ -687,13 +722,14 @@ class RaftNode:
                 self._wal_fh = open(
                     os.path.join(self.data_dir, "wal.jsonl"), "a"
                 )
-            self._wal_fh.write(
-                "".join(json.dumps(r, separators=(",", ":")) + "\n"
-                        for r in records)
+            data = "".join(
+                json.dumps(r, separators=(",", ":")) + "\n"
+                for r in records
             )
+            self._wal_fh.write(data)
             self._wal_fh.flush()
-            self._fsync_stall()
-            os.fsync(self._wal_fh.fileno())
+            self._timed_fsync(self._wal_fh.fileno())
+            self.counters.wal_bytes += len(data)
         except OSError as e:
             self._fail_stop_locked("WAL write failed", e)
 
@@ -717,6 +753,28 @@ class RaftNode:
     def role(self) -> tuple[str, int, str | None]:
         with self.lock:
             return self.state, self.term, self.leader_hint
+
+    def stats_snapshot(self) -> dict:
+        """One point-in-time telemetry snapshot (obs/cluster.py's raft
+        block; JSON-safe — it rides the admin ``STATS`` line).  Gauges
+        are read under the node lock; counters/sketch carry the usual
+        unlocked-accuracy contract."""
+        with self.lock:
+            state, term, hint = self.state, self.term, self.leader_hint
+            commit, applied = self.commit_idx, self.applied_idx
+            log_len = len(self.log)
+        return {
+            "name": self.name,
+            "role": state,
+            "term": term,
+            "leader_hint": hint,
+            "commit_idx": commit,
+            "applied_idx": applied,
+            "log_len": log_len,
+            "durable": self.data_dir is not None,
+            "counters": self.counters.snapshot(),
+            "fsync_ms": self._fsync_ms.state(),
+        }
 
     def block(self, peer: str) -> None:
         with self.lock:
@@ -756,6 +814,17 @@ class RaftNode:
             spec.validate()
         with self._fault_lock:
             self._wire = spec
+
+    def _timed_fsync(self, fileno: int) -> None:
+        """One real WAL/meta fsync (stall included), timed into the
+        per-node fsync latency sketch.  ``ack-before-fsync`` never
+        reaches here, so under that bug the sketch stays empty while
+        everything else proceeds — the telemetry-visible tell the
+        differential suite pins (tests/test_cluster_obs.py)."""
+        t0 = time.perf_counter()
+        self._fsync_stall()
+        os.fsync(fileno)
+        self._fsync_ms.add((time.perf_counter() - t0) * 1e3)
 
     def _fsync_stall(self) -> None:
         """The slow disk itself: called immediately before each real
@@ -800,6 +869,7 @@ class RaftNode:
                 return None
             return msg if isinstance(msg, dict) else None
         if len(line) < 10 or line[8:9] != b" ":
+            self.counters.crc_rejected += 1
             return None  # no CRC while checksums are on: corrupted
         body = line[9:]
         try:
@@ -807,6 +877,7 @@ class RaftNode:
         except ValueError:
             ok = False
         if not ok:
+            self.counters.crc_rejected += 1
             logger.debug(
                 "raft %s: dropped corrupted frame (crc mismatch)",
                 self.name,
@@ -839,6 +910,11 @@ class RaftNode:
             )
             if spec.corrupt_p and rng.random() < spec.corrupt_p:
                 data = corrupt_frame(data[:-1], rng) + b"\n"
+                self.counters.wire_corrupt += 1
+            if dup:
+                self.counters.wire_duplicate += 1
+            if delay:
+                self.counters.wire_delay += 1
         return data, delay, dup
 
     def submit(self, op: dict, timeout_s: float = 5.0) -> tuple[bool, Any]:
@@ -1145,10 +1221,12 @@ class RaftNode:
                 (host, port), timeout=min(0.25, timeout_s)
             ) as s:
                 s.sendall(data)
+                self.counters.rpc_sent += 1
                 if blocked_peer is not None:
                     with self.lock:
                         drop_reply = blocked_peer in self.blocked
                     if drop_reply:
+                        self.counters.rpc_dropped += 1
                         return None
                 s.settimeout(timeout_s)
                 buf = b""
@@ -1158,7 +1236,14 @@ class RaftNode:
                         return None
                     buf += chunk
                 # a corrupted reply drops like a lost one (crc mismatch)
-                return self._parse_frame(buf)
+                resp = self._parse_frame(buf)
+                if resp is None:
+                    self.counters.rpc_dropped += 1
+                else:
+                    # replies count as received frames too — sent and
+                    # recv stay symmetric on a healthy cluster
+                    self.counters.rpc_recv += 1
+                return resp
         except (OSError, ValueError):
             return None
 
@@ -1194,6 +1279,7 @@ class RaftNode:
                 try:
                     with socket.create_connection(addr, timeout=0.25) as s:
                         s.sendall(data)
+                        self.counters.rpc_sent += 1
                 except OSError:
                     pass
 
@@ -1218,11 +1304,14 @@ class RaftNode:
                 buf += chunk
             msg = self._parse_frame(buf)
             if msg is None:
+                self.counters.rpc_dropped += 1
                 return  # corrupted in flight: dropped, like packet loss
             sender = msg.get("from")
             with self.lock:
                 if sender in self.blocked:
+                    self.counters.rpc_dropped += 1
                     return  # INPUT DROP: never processed, never answered
+            self.counters.rpc_recv += 1
             resp = self._dispatch(msg)
             if resp is not None:
                 # responses ride the same wire: corrupt/delay apply
@@ -1234,6 +1323,7 @@ class RaftNode:
                 if delay:
                     time.sleep(delay)
                 sock.sendall(data)
+                self.counters.rpc_sent += 1
         except (OSError, ValueError):
             pass
         finally:
@@ -1326,6 +1416,7 @@ class RaftNode:
                             # safety — committed entries never truncate);
                             # if it ever fires, a confirmed-write loss is
                             # in progress and THIS is the smoking gun
+                            self.counters.safety_violations += 1
                             logger.critical(
                                 "raft %s SAFETY VIOLATION: truncating "
                                 "COMMITTED entries [%d..%d] (commit_idx="
@@ -1399,6 +1490,7 @@ class RaftNode:
     def _become_leader_locked(self) -> None:
         self.state = LEADER
         self.leader_hint = self.name
+        self.counters.elections_won += 1
         if self.data_dir is not None:
             # no-op entry (§8 / §5.4.2): recovered prior-term entries can
             # only commit via a committed current-term entry; after a
@@ -1426,6 +1518,7 @@ class RaftNode:
             self.state = CANDIDATE
             self.term += 1
             self.voted_for = self.name
+            self.counters.elections_started += 1
             self._persist_meta_locked()  # durable before soliciting votes
             term = self.term
             last_term = self.log[-1][0] if self.log else 0
@@ -1975,3 +2068,18 @@ class ReplicatedBackend:
     # -- local reads (diagnostics only — NOT the client read path) ----------
     def counts(self) -> dict[str, int]:
         return self.machine.counts(self._now_ms())
+
+    def stats_snapshot(self) -> dict:
+        """Cluster-telemetry snapshot for an in-process backend (the
+        DirectStatsSource path, obs/cluster.py): the raft block plus
+        this replica's ready/inflight depths from the local machine."""
+        m = self.machine
+        with m.lock:
+            ready = sum(len(dq) for dq in m.queues.values()) + sum(
+                len(log) for log in m.streams.values()
+            )
+            inflight = len(m.inflight)
+        return {
+            "broker": {"ready": ready, "inflight": inflight},
+            "raft": self.raft.stats_snapshot(),
+        }

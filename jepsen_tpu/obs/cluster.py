@@ -1,0 +1,494 @@
+"""Cluster telemetry plane: per-node Raft/broker internals on the one
+op clock (ISSUE 12; OBSERVABILITY.md §Cluster telemetry).
+
+Real Jepsen's window into the system under test is logs-only (the
+``db/LogFiles`` scp + post-hoc greps); this module makes the SUT a
+first-class observability citizen.  Every :class:`~jepsen_tpu.harness.
+replication.RaftNode` and :class:`~jepsen_tpu.harness.broker.
+MiniAmqpBroker` maintains cheap inline telemetry (role/term/commit
+gauges, election / RPC-frame / CRC-rejection / wire-fault / tripwire
+counters, a WAL-fsync latency sketch) and a **poller** here samples it
+batch-granular — default ~1 Hz, never per-op — into three surfaces:
+
+- **samples** on the run's op clock (``monotonic_ns - start_ns``, the
+  SAME clock history ops and nemesis windows use), harvested into a
+  ``cluster.json`` beside ``results.json`` and rendered as the report's
+  cluster panel (leader/role timeline, term staircase, commit-index
+  lag, per-node fsync p99) with the same nemesis shading;
+- **instant events** on per-node trace tracks (``node:<name>``) for
+  role flips, term bumps, recoveries, downs, and SAFETY-VIOLATION
+  tripwires — so an enabled flight recorder shows nemesis windows,
+  node role changes, and checker stages in ONE Perfetto timeline;
+- **registry gauges** with ``node=`` labels (``cluster.node_term``,
+  ``cluster.node_commit_idx``, …) so a live soak's ``/metrics`` scrape
+  sees the cluster, not just the checker.
+
+Two snapshot sources cover both deployment shapes: out-of-process
+nodes answer the admin ``STATS`` command (one JSON line —
+:class:`TransportStatsSource` over ``LocalProcTransport.node_stats``);
+in-process nodes (tests, the replication-layer differential suite) are
+read directly (:class:`DirectStatsSource` over any object with a
+``stats_snapshot()``).
+
+Free when off: the runner builds a poller only when the test opts in
+(``Test.cluster_telemetry``, default on, and a wired
+``Test.cluster_source``); with no poller the only standing cost is the
+nodes' inline int adds — the same always-on accounting contract as
+``PipelineStats``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+from jepsen_tpu.harness.replication import NodeCounters
+from jepsen_tpu.obs import metrics as _metrics
+from jepsen_tpu.obs import trace as _trace
+from jepsen_tpu.obs.metrics import QuantileSketch, sketch_state_delta
+
+logger = logging.getLogger("jepsen_tpu.obs.cluster")
+
+CLUSTER_FILE = "cluster.json"
+
+#: numeric role encoding for the Prometheus gauge (and the report's
+#: role strip): down nodes are -1 so a scrape can alert on them
+ROLE_CODE = {"down": -1, "follower": 0, "candidate": 1, "leader": 2}
+
+#: counter keys mirrored into per-node registry counters each poll —
+#: THE node counter set (a counter added to NodeCounters is mirrored
+#: and summed automatically; no hand-kept twin to drift)
+_COUNTER_KEYS = tuple(NodeCounters.__slots__)
+
+
+class DirectStatsSource:
+    """In-process nodes: ``{name: obj}`` where each ``obj`` has a
+    ``stats_snapshot()`` (MiniAmqpBroker, ReplicatedBackend, or a bare
+    RaftNode)."""
+
+    def __init__(self, nodes: Mapping[str, Any]):
+        self._nodes = dict(nodes)
+
+    @property
+    def nodes(self) -> list[str]:
+        return list(self._nodes)
+
+    def poll(self) -> dict[str, dict | None]:
+        out: dict[str, dict | None] = {}
+        for name, obj in self._nodes.items():
+            try:
+                snap = obj.stats_snapshot()
+            except Exception:  # noqa: BLE001 — a dying node reads as down
+                out[name] = None
+                continue
+            if "raft" not in snap and "broker" not in snap:
+                # a bare RaftNode snapshot: wrap into the uniform shape
+                snap = {"broker": None, "raft": snap}
+            out[name] = snap
+        return out
+
+
+class TransportStatsSource:
+    """Out-of-process nodes behind a transport exposing
+    ``node_stats(node) -> dict | None`` (the admin ``STATS`` pull —
+    ``LocalProcTransport``).  A dead or stopped node answers ``None``."""
+
+    def __init__(self, transport: Any):
+        self.transport = transport
+
+    @property
+    def nodes(self) -> list[str]:
+        return list(self.transport.nodes)
+
+    def poll(self) -> dict[str, dict | None]:
+        out: dict[str, dict | None] = {}
+        for name in self.transport.nodes:
+            try:
+                out[name] = self.transport.node_stats(name)
+            except Exception:  # noqa: BLE001 — down, not a poller crash
+                out[name] = None
+        return out
+
+
+def _raft_block(snap: dict | None) -> dict | None:
+    if not snap:
+        return None
+    return snap.get("raft")
+
+
+class ClusterPoller:
+    """The sampling thread: poll ``source`` every ``interval_s``,
+    record samples/events on the op clock, mirror gauges into
+    ``registry``, and emit trace instants on ``node:<name>`` tracks.
+
+    ``start_ns`` is the run's ``time.monotonic_ns()`` epoch (the
+    history clock); samples/events carry ``t`` in ns from it."""
+
+    def __init__(
+        self,
+        source: Any,
+        start_ns: int | None = None,
+        interval_s: float = 1.0,
+        registry: _metrics.Registry | None = None,
+    ):
+        self.source = source
+        self.interval_s = max(0.02, float(interval_s))
+        self.start_ns = (
+            start_ns if start_ns is not None else time.monotonic_ns()
+        )
+        self.registry = registry or _metrics.REGISTRY
+        self.samples: list[dict] = []
+        self.events: list[dict] = []
+        self.final: dict[str, dict | None] = {}
+        self._last: dict[str, dict | None] = {}
+        #: last NON-None snapshot per node: a node that is down at the
+        #: final poll must not lose its counters from the summary (its
+        #: tripwire/election totals are exactly what a post-mortem
+        #: needs; down-ness itself is recorded in the samples)
+        self._last_seen: dict[str, dict] = {}
+        self._fsync_prev: dict[str, dict] = {}
+        self._leader: str | None = None
+        self.leader_changes = 0
+        self.polls = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="cluster-telemetry"
+        )
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ClusterPoller":
+        self.poll_once()
+        self._thread.start()
+        return self
+
+    def stop(self) -> dict:
+        """Final poll (nodes still up — call before teardown), join the
+        thread, return the :meth:`document`."""
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        self.poll_once()
+        with self._lock:
+            # a node down at the end keeps its last live snapshot (the
+            # samples carry the down-ness; the counters must survive)
+            self.final = {
+                n: (s if s is not None else self._last_seen.get(n))
+                for n, s in self._last.items()
+            }
+        return self.document()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — telemetry must not kill runs
+                logger.exception("cluster telemetry poll failed")
+
+    # -- sampling -----------------------------------------------------------
+    def poll_once(self) -> None:
+        t = time.monotonic_ns() - self.start_ns
+        snaps = self.source.poll()
+        with self._lock:
+            self.polls += 1
+            for node, snap in snaps.items():
+                self._ingest(t, node, snap)
+            self._track_leader(t, snaps)
+
+    def _ingest(self, t: int, node: str, snap: dict | None) -> None:
+        prev = self._last.get(node)
+        raft = _raft_block(snap)
+        prev_raft = _raft_block(prev)
+        role = (raft.get("role") if raft else None) or (
+            "up" if snap else "down"
+        )
+        prev_role = (prev_raft.get("role") if prev_raft else None) or (
+            "up" if prev else "down" if node in self._last else None
+        )
+        broker = (snap or {}).get("broker") or {}
+
+        sample = {
+            "t": t,
+            "node": node,
+            "role": role,
+            "term": raft.get("term", 0) if raft else 0,
+            "commit": raft.get("commit_idx", 0) if raft else 0,
+            "applied": raft.get("applied_idx", 0) if raft else 0,
+            "log": raft.get("log_len", 0) if raft else 0,
+            "wal": (
+                (raft.get("counters") or {}).get("wal_bytes", 0)
+                if raft
+                else 0
+            ),
+            "ready": broker.get("ready", 0),
+            "inflight": broker.get("inflight", 0),
+        }
+        self.samples.append(sample)
+        self._gauges(node, sample, raft)
+        self._events(t, node, role, prev_role, raft, prev_raft)
+        self._last[node] = snap
+        if snap is not None:
+            self._last_seen[node] = snap
+
+    def _gauges(self, node: str, sample: dict, raft: dict | None) -> None:
+        reg = self.registry
+        reg.gauge("cluster.node_up", node=node).set(
+            0.0 if sample["role"] == "down" else 1.0
+        )
+        reg.gauge("cluster.node_role", node=node).set(
+            ROLE_CODE.get(sample["role"], 0)
+        )
+        for key, gname in (
+            ("term", "cluster.node_term"),
+            ("commit", "cluster.node_commit_idx"),
+            ("applied", "cluster.node_applied_idx"),
+            ("log", "cluster.node_log_len"),
+            ("wal", "cluster.node_wal_bytes"),
+            ("ready", "cluster.node_ready"),
+            ("inflight", "cluster.node_inflight"),
+        ):
+            reg.gauge(gname, node=node).set(float(sample[key]))
+        if raft:
+            counters = raft.get("counters") or {}
+            for key in _COUNTER_KEYS:
+                if key == "wal_bytes":
+                    continue  # already a gauge above
+                reg.counter(f"cluster.node_{key}", node=node).set(
+                    float(counters.get(key, 0))
+                )
+            fsync = raft.get("fsync_ms")
+            if fsync:
+                delta = sketch_state_delta(
+                    self._fsync_prev.get(node), fsync
+                )
+                self._fsync_prev[node] = fsync
+                if delta.get("count"):
+                    try:
+                        reg.sketch(
+                            "cluster.node_fsync_ms", node=node
+                        ).merge_state(delta)
+                    except (TypeError, ValueError):
+                        pass  # alpha drift across node versions: skip
+
+    def _events(
+        self,
+        t: int,
+        node: str,
+        role: str,
+        prev_role: str | None,
+        raft: dict | None,
+        prev_raft: dict | None,
+    ) -> None:
+        def emit(kind: str, **args) -> None:
+            self.events.append({"t": t, "node": node, "kind": kind, **args})
+            _trace.event(
+                f"{kind}:{args.get('to', args.get('detail', ''))}",
+                track=f"node:{node}",
+                args=(
+                    {"node": node, **{k: str(v) for k, v in args.items()}}
+                    if _trace.is_enabled()
+                    else None
+                ),
+            )
+
+        if prev_role is not None and role != prev_role:
+            emit(
+                "role",
+                frm=prev_role,
+                to=role,
+                term=raft.get("term", 0) if raft else 0,
+            )
+        if raft and prev_raft:
+            if raft.get("term", 0) > prev_raft.get("term", 0):
+                emit("term", to=raft["term"])
+            pc = prev_raft.get("counters") or {}
+            cc = raft.get("counters") or {}
+            if cc.get("safety_violations", 0) > pc.get(
+                "safety_violations", 0
+            ):
+                emit(
+                    "tripwire",
+                    detail="SAFETY-VIOLATION",
+                    total=cc["safety_violations"],
+                )
+            if cc.get("recoveries", 0) > pc.get("recoveries", 0):
+                emit("recovered", detail="wal-recovery")
+        elif raft and prev_raft is None and prev_role == "down":
+            emit("recovered", detail="node-up", term=raft.get("term", 0))
+
+    def _track_leader(
+        self, t: int, snaps: Mapping[str, dict | None]
+    ) -> None:
+        leaders = sorted(
+            n
+            for n, s in snaps.items()
+            if (_raft_block(s) or {}).get("role") == "leader"
+        )
+        leader = leaders[0] if len(leaders) == 1 else None
+        # >1 claimed leaders is a stale-answer artifact mid-election
+        # (each node is snapshotted at a slightly different instant):
+        # keep the previous leader, the next poll resolves it
+        if leader is not None and leader != self._leader:
+            self.leader_changes += 1  # the first election counts as 1
+            self._leader = leader
+
+    # -- the cluster.json document ------------------------------------------
+    def document(self) -> dict:
+        with self._lock:
+            samples = list(self.samples)
+            events = list(self.events)
+            final = {n: s for n, s in self.final.items()}
+        totals: dict[str, int] = {k: 0 for k in _COUNTER_KEYS}
+        fsync_p99: dict[str, float | None] = {}
+        for node, snap in sorted(final.items()):
+            raft = _raft_block(snap)
+            if not raft:
+                fsync_p99[node] = None
+                continue
+            for k, v in (raft.get("counters") or {}).items():
+                if k in totals:
+                    totals[k] += int(v)
+            st = raft.get("fsync_ms")
+            if st and st.get("count"):
+                p99 = QuantileSketch.from_state(st).quantile(0.99)
+                fsync_p99[node] = round(p99, 3) if p99 == p99 else None
+            else:
+                fsync_p99[node] = None
+        leaders_seen = sorted(
+            {s["node"] for s in samples if s["role"] == "leader"}
+        )
+        return {
+            "interval-s": self.interval_s,
+            "nodes": sorted(
+                set(self.source.nodes) | set(final) | {
+                    s["node"] for s in samples
+                }
+            ),
+            "samples": samples,
+            "events": events,
+            "final": final,
+            "summary": {
+                "polls": self.polls,
+                "leaders-seen": leaders_seen,
+                "leader-changes": self.leader_changes,
+                "max-term": max(
+                    (s["term"] for s in samples), default=0
+                ),
+                "elections-won": totals["elections_won"],
+                "safety-violations": totals["safety_violations"],
+                "crc-rejected": totals["crc_rejected"],
+                "wire-faults": (
+                    totals["wire_corrupt"]
+                    + totals["wire_duplicate"]
+                    + totals["wire_delay"]
+                ),
+                "fsync-p99-ms": fsync_p99,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# artifacts + downstream readers
+# ---------------------------------------------------------------------------
+
+
+def write_cluster_json(run_dir: str | Path, doc: Mapping[str, Any]) -> Path:
+    """``cluster.json`` beside ``results.json`` (tmp → rename, like
+    every artifact the sidecar may serve mid-write)."""
+    path = Path(run_dir) / CLUSTER_FILE
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    try:
+        tmp.write_text(json.dumps(doc, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def load_cluster_json(run_dir: str | Path) -> dict | None:
+    """The run's cluster telemetry document, or None when the run
+    predates the telemetry plane / ran with it off."""
+    try:
+        got = json.loads((Path(run_dir) / CLUSTER_FILE).read_text())
+    except (OSError, ValueError):
+        return None
+    return got if isinstance(got, dict) else None
+
+
+def summary_line(doc: Mapping[str, Any]) -> str:
+    """One human line for soak triage / fuzz repro metadata."""
+    s = doc.get("summary") or {}
+    fsync = {
+        n: v for n, v in (s.get("fsync-p99-ms") or {}).items()
+        if v is not None
+    }
+    fsync_part = (
+        " fsync-p99-ms " + "/".join(f"{v:g}" for _n, v in sorted(fsync.items()))
+        if fsync
+        else ""
+    )
+    return (
+        f"{s.get('polls', 0)} polls, leaders {s.get('leaders-seen', [])} "
+        f"({s.get('leader-changes', 0)} changes, "
+        f"{s.get('elections-won', 0)} elections won, max term "
+        f"{s.get('max-term', 0)}), tripwires "
+        f"{s.get('safety-violations', 0)}, crc-rejected "
+        f"{s.get('crc-rejected', 0)}, wire-faults "
+        f"{s.get('wire-faults', 0)}{fsync_part}"
+    )
+
+
+def cluster_window_summary(
+    doc: Mapping[str, Any], t0_ns: int, t1_ns: int
+) -> dict:
+    """Forensics' question answered from the samples: which node led —
+    and what was the worst commit-index lag — during ``[t0, t1]`` ns on
+    the op clock.  Window edges widen to the nearest samples outside
+    the window (a 1 Hz poll must not miss a sub-second window)."""
+    samples = list(doc.get("samples") or [])
+    by_t: dict[int, list[dict]] = {}
+    for s in samples:
+        by_t.setdefault(int(s["t"]), []).append(s)
+    ts = sorted(by_t)
+    lo = max((t for t in ts if t <= t0_ns), default=None)
+    hi = min((t for t in ts if t >= t1_ns), default=None)
+    picked = [
+        t
+        for t in ts
+        if (lo is None or t >= lo) and (hi is None or t <= hi)
+    ]
+    leaders: list[tuple[str, int]] = []
+    max_lag = None
+    tripwires = 0
+    for t in picked:
+        rows = by_t[t]
+        lead = [s for s in rows if s["role"] == "leader"]
+        for s in lead:
+            if not leaders or leaders[-1][0] != s["node"]:
+                leaders.append((s["node"], s["term"]))
+        commits = [s["commit"] for s in rows if s["role"] != "down"]
+        if lead and commits:
+            lag = max(s["commit"] for s in lead) - min(commits)
+            max_lag = lag if max_lag is None else max(max_lag, lag)
+    for ev in doc.get("events") or []:
+        if ev.get("kind") == "tripwire" and (
+            (lo is None or ev["t"] >= lo) and (hi is None or ev["t"] <= hi)
+        ):
+            tripwires += 1
+    return {
+        "leaders": [
+            {"node": n, "term": term} for n, term in leaders
+        ],
+        "max-commit-lag": max_lag,
+        "samples-in-window": sum(len(by_t[t]) for t in picked),
+        "tripwires-in-window": tripwires,
+    }

@@ -124,6 +124,45 @@ class QuantileSketch:
     def sum(self) -> float:
         return self._sum
 
+    # -- plain-data round-trip (the cluster-telemetry STATS wire form) --
+    def state(self) -> dict:
+        """Plain-JSON state: bucket counts keyed by stringified index.
+        Two states with the same alpha ADD bucket-wise, which is what
+        lets an out-of-process node ship its fsync sketch over the admin
+        ``STATS`` line and the poller merge successive deltas into a
+        live registry sketch (obs/cluster.py)."""
+        with self._lock:
+            return {
+                "alpha": self.alpha,
+                "count": self._count,
+                "sum": self._sum,
+                "zero": self._zero,
+                "buckets": {str(k): n for k, n in self._buckets.items()},
+            }
+
+    @classmethod
+    def from_state(cls, state: dict) -> "QuantileSketch":
+        s = cls(alpha=float(state.get("alpha", 0.01)))
+        s.merge_state(state)
+        return s
+
+    def merge_state(self, state: dict) -> None:
+        """Add a :meth:`state` dict into this sketch (same-alpha rule as
+        :meth:`merge`)."""
+        alpha = float(state.get("alpha", 0.01))
+        if abs(alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketch state with different alpha: "
+                f"{self.alpha} vs {alpha}"
+            )
+        with self._lock:
+            self._count += int(state.get("count", 0))
+            self._sum += float(state.get("sum", 0.0))
+            self._zero += int(state.get("zero", 0))
+            for k, n in (state.get("buckets") or {}).items():
+                k = int(k)
+                self._buckets[k] = self._buckets.get(k, 0) + int(n)
+
     def quantile(self, q: float) -> float:
         """The q-quantile (0 <= q <= 1) within relative error alpha;
         NaN on an empty sketch."""
@@ -143,6 +182,29 @@ class QuantileSketch:
                     # midpoint estimate is within alpha of any member
                     return 2.0 * self._gamma**k / (self._gamma + 1.0)
             return 2.0 * self._gamma ** max(self._buckets) / (self._gamma + 1.0)
+
+
+def sketch_state_delta(prev: dict | None, cur: dict) -> dict:
+    """``cur - prev`` for two :meth:`QuantileSketch.state` dicts from
+    the SAME monotonically-growing sketch — the increment the poller
+    merges into a live registry sketch each sample.  A count that went
+    backwards means the source restarted (fresh sketch): the whole
+    ``cur`` is the delta then."""
+    if prev is None or int(cur.get("count", 0)) < int(prev.get("count", 0)):
+        return cur
+    pb = prev.get("buckets") or {}
+    buckets = {}
+    for k, n in (cur.get("buckets") or {}).items():
+        d = int(n) - int(pb.get(k, 0))
+        if d > 0:
+            buckets[k] = d
+    return {
+        "alpha": cur.get("alpha", 0.01),
+        "count": int(cur.get("count", 0)) - int(prev.get("count", 0)),
+        "sum": float(cur.get("sum", 0.0)) - float(prev.get("sum", 0.0)),
+        "zero": int(cur.get("zero", 0)) - int(prev.get("zero", 0)),
+        "buckets": buckets,
+    }
 
 
 def _label_key(labels: dict) -> tuple:

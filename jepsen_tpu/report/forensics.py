@@ -121,6 +121,87 @@ def flag_ops(
     return flagged
 
 
+def logpattern_matches(results: Mapping[str, Any]) -> list[dict]:
+    """Every node-log line a ``log-file-pattern`` checker matched —
+    previously invisible in reports (the matches lived only in
+    results.json).  Robust to the checker's registration name: any
+    sub-result carrying ``pattern`` + ``matches`` counts."""
+    out: list[dict] = []
+    for name in sorted(results):
+        r = results.get(name)
+        if (
+            isinstance(r, dict)
+            and "pattern" in r
+            and isinstance(r.get("matches"), list)
+        ):
+            for m in r["matches"]:
+                if isinstance(m, dict):
+                    out.append({**m, "pattern": r["pattern"]})
+    return out
+
+
+def _cluster_window_html(
+    run_dir: Path, history: Sequence[Op], flagged: Mapping[int, Any]
+) -> str:
+    """The cluster-telemetry answer to "which node was leader and what
+    was commit lag during the violating window" — rendered only when
+    the run carries a cluster.json AND ops were flagged."""
+    from jepsen_tpu.obs.cluster import (
+        cluster_window_summary,
+        load_cluster_json,
+    )
+
+    doc = load_cluster_json(run_dir)
+    if not doc or not doc.get("samples") or not flagged:
+        return ""
+    times = [
+        history[i].time for i in flagged if history[i].time >= 0
+    ]
+    if not times:
+        return ""
+    t_lo, t_hi = min(times), max(times)
+    w = cluster_window_summary(doc, t_lo, t_hi)
+    leaders = ", ".join(
+        f"{entry['node']} (term {entry['term']})"
+        for entry in w["leaders"]
+    ) or "none sampled"
+    lag = (
+        str(w["max-commit-lag"])
+        if w["max-commit-lag"] is not None
+        else "-"
+    )
+    return (
+        f'<div class="panel"><h3>cluster during the violating window '
+        f"[{t_lo / 1e9:.3f}s, {t_hi / 1e9:.3f}s]</h3>"
+        f"<p>leader(s): {escape(leaders)} · max commit-index lag: "
+        f"{escape(lag)} · tripwires in window: "
+        f"{w['tripwires-in-window']} · {w['samples-in-window']} "
+        f"telemetry samples (cluster.json)</p></div>"
+    )
+
+
+def _logpattern_html(results: Mapping[str, Any]) -> str:
+    matches = logpattern_matches(results)
+    if not matches:
+        return ""
+    rows = "".join(
+        f"<tr><td>{escape(str(m.get('node', '?')))}</td>"
+        f"<td>{escape(str(m.get('file', '?')))}:{m.get('line', 0)}</td>"
+        f"<td>{escape(str(m.get('text', ''))[:200])}</td></tr>"
+        for m in matches[:50]
+    )
+    more = (
+        f"<p>… {len(matches) - 50} more matches in results.json</p>"
+        if len(matches) > 50
+        else ""
+    )
+    return (
+        f'<div class="panel"><h3>matched node-log lines '
+        f"(log-file-pattern)</h3><table><tr><th>node</th>"
+        f"<th>file:line</th><th>text</th></tr>{rows}</table>{more}</div>"
+    )
+
+
 def render_forensics(
     run_dir: str | Path,
     history: Sequence[Op] | None = None,
@@ -198,6 +279,8 @@ def render_forensics(
             f"<a href={quoteattr(str(repro_path))}>"
             f"{escape(Path(str(repro_path)).name)}</a></p>"
         )
+    cluster_html = _cluster_window_html(run_dir, history, flagged)
+    logpattern_html = _logpattern_html(results)
     html = (
         f"<html><head><title>{escape(title)}</title>"
         f"<style>{_CSS}</style></head><body>"
@@ -207,6 +290,7 @@ def render_forensics(
         f"{escape(', '.join(invalid_names) or '(none named)')} · "
         f"{len(flagged)} of {len(history)} ops touch violating values"
         f"</p>{repro_note}"
+        f"{cluster_html}{logpattern_html}"
         f'<div class="panel"><h3>violating values</h3><table>'
         f"<tr><th>reason</th><th>values</th></tr>{reason_rows}"
         f"</table></div>"

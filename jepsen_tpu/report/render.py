@@ -282,6 +282,234 @@ def rate_panel_svg(
 
 
 # ---------------------------------------------------------------------------
+# cluster telemetry panels (cluster.json — obs/cluster.py, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+#: role strip colors (cluster panel); "up" = a local-mode broker with
+#: no raft block, grey = never sampled
+ROLE_COLORS = {
+    "leader": "#2a7f4f",
+    "follower": "#5f7fbf",
+    "candidate": "#f2cc8f",
+    "down": "#e07a5f",
+    "up": "#cccccc",
+}
+
+#: per-node line colors for the commit-lag panel (cycled)
+_NODE_COLORS = (
+    "#3d405b", "#81b29a", "#e07a5f", "#5f7fbf", "#b8860b", "#d7263d",
+)
+
+
+def _cluster_by_node(doc: Mapping[str, Any]) -> dict[str, list[dict]]:
+    by_node: dict[str, list[dict]] = {}
+    for s in doc.get("samples") or []:
+        by_node.setdefault(s["node"], []).append(s)
+    for rows in by_node.values():
+        rows.sort(key=lambda s: s["t"])
+    return by_node
+
+
+def cluster_role_svg(
+    doc: Mapping[str, Any], windows_nemesis, t_max_s: float
+) -> str:
+    """Leader/role timeline strip: one row per node, colored by role
+    between consecutive samples, nemesis windows shaded — role flips
+    inside fault windows are the panel's whole point."""
+    by_node = _cluster_by_node(doc)
+    nodes = sorted(by_node)
+    row_h = 22
+    height = _MT + row_h * max(len(nodes), 1) + _MB
+    parts = _svg_open(height)
+    _svg_nemesis(parts, windows_nemesis, t_max_s, height)
+    for i, node in enumerate(nodes):
+        y = _MT + i * row_h + 3
+        parts.append(
+            f'<text x="{_ML - 4}" y="{y + 11}" text-anchor="end" '
+            f'fill="#555555">{escape(node[-9:])}</text>'
+        )
+        rows = by_node[node]
+        for j, s in enumerate(rows):
+            t0_s = s["t"] / 1e9
+            t1_s = (
+                rows[j + 1]["t"] / 1e9 if j + 1 < len(rows) else t_max_s
+            )
+            x0 = _xpix(min(t0_s, t_max_s), t_max_s)
+            x1 = _xpix(min(max(t1_s, t0_s), t_max_s), t_max_s)
+            color = ROLE_COLORS.get(s["role"], "#cccccc")
+            parts.append(
+                f'<rect x="{_fmt(x0)}" y="{y}" '
+                f'width="{_fmt(max(x1 - x0, 0.8))}" height="{row_h - 6}" '
+                f'fill="{color}"><title>{escape(node)} '
+                f"{escape(str(s['role']))} term {s['term']} commit "
+                f"{s['commit']} [{t0_s:.1f}s]</title></rect>"
+            )
+    _svg_xaxis(parts, t_max_s, height)
+    legend_x = _W - _MR - 300
+    for k, role in enumerate(("leader", "follower", "candidate", "down")):
+        parts.append(
+            f'<text x="{legend_x + k * 75}" y="{_MT - 1}" '
+            f'fill="{ROLE_COLORS[role]}">{role}</text>'
+        )
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def cluster_lag_svg(
+    doc: Mapping[str, Any], windows_nemesis, t_max_s: float
+) -> str:
+    """Term staircase (grey steps, right labels) + per-node commit-index
+    lag behind the sample's max commit (colored lines, left axis)."""
+    by_node = _cluster_by_node(doc)
+    nodes = sorted(by_node)
+    # align per poll instant: t -> {node: sample}
+    by_t: dict[int, dict[str, dict]] = {}
+    for node, rows in by_node.items():
+        for s in rows:
+            by_t.setdefault(s["t"], {})[node] = s
+    ts = sorted(by_t)
+    lags: dict[str, list[tuple[float, float]]] = {n: [] for n in nodes}
+    terms: list[tuple[float, float]] = []
+    for t in ts:
+        rows = by_t[t]
+        commits = [
+            s["commit"] for s in rows.values() if s["role"] != "down"
+        ]
+        top = max(commits, default=0)
+        terms.append((t / 1e9, max(
+            (s["term"] for s in rows.values()), default=0
+        )))
+        for node, s in rows.items():
+            if s["role"] != "down":
+                lags[node].append((t / 1e9, top - s["commit"]))
+    lag_max = max(
+        (v for pts in lags.values() for _t, v in pts), default=0.0
+    )
+    term_max = max((v for _t, v in terms), default=0.0)
+    parts = _svg_open()
+    _svg_nemesis(parts, windows_nemesis, t_max_s, _H)
+
+    def ypix(v: float, vmax: float) -> float:
+        return _MT + (_H - _MT - _MB) * (1.0 - v / max(vmax, 1.0))
+
+    # term staircase (steps between polls)
+    if terms:
+        pts = []
+        prev = terms[0][1]
+        pts.append(f"{_fmt(_xpix(terms[0][0], t_max_s))},"
+                   f"{_fmt(ypix(prev, term_max))}")
+        for t_s, v in terms[1:]:
+            x = _fmt(_xpix(t_s, t_max_s))
+            pts.append(f"{x},{_fmt(ypix(prev, term_max))}")
+            pts.append(f"{x},{_fmt(ypix(v, term_max))}")
+            prev = v
+        parts.append(
+            f'<polyline points="{" ".join(pts)}" fill="none" '
+            f'stroke="#999999" stroke-width="1.0" '
+            f'stroke-dasharray="4 2"><title>term (max '
+            f"{term_max:g})</title></polyline>"
+        )
+        parts.append(
+            f'<text x="{_W - _MR - 2}" y="{_MT + 12}" text-anchor="end" '
+            f'fill="#999999">term ≤ {term_max:g}</text>'
+        )
+    for frac in (0.5, 1.0):
+        parts.append(
+            f'<text x="{_ML - 4}" y="{_fmt(ypix(lag_max * frac, lag_max) + 3)}" '
+            f'text-anchor="end" fill="#555555">'
+            f"{lag_max * frac:.0f}</text>"
+        )
+    for i, node in enumerate(nodes):
+        pts = [
+            f"{_fmt(_xpix(t_s, t_max_s))},{_fmt(ypix(v, lag_max))}"
+            for t_s, v in lags[node]
+        ]
+        if pts:
+            color = _NODE_COLORS[i % len(_NODE_COLORS)]
+            parts.append(
+                f'<polyline points="{" ".join(pts)}" fill="none" '
+                f'stroke="{color}" stroke-width="1.2"><title>'
+                f"{escape(node)} commit lag</title></polyline>"
+            )
+    _svg_xaxis(parts, t_max_s, _H)
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def _cluster_node_rows(doc: Mapping[str, Any]) -> str:
+    """Per-node final-state table rows (role, term, commit, elections,
+    CRC rejections, wire faults, tripwires, fsync p50/p99)."""
+    from jepsen_tpu.obs.metrics import QuantileSketch
+
+    rows = []
+    final = doc.get("final") or {}
+    for node in sorted(final):
+        snap = final[node] or {}
+        raft = snap.get("raft") or {}
+        broker = snap.get("broker") or {}
+        counters = raft.get("counters") or {}
+        fsync = raft.get("fsync_ms") or {}
+        if fsync.get("count"):
+            sk = QuantileSketch.from_state(fsync)
+            p50, p99 = sk.quantile(0.50), sk.quantile(0.99)
+            fsync_txt = f"{p50:.2f} / {p99:.2f}"
+        else:
+            fsync_txt = "-"
+        role = raft.get("role") or ("up" if snap else "down")
+        wire = (
+            counters.get("wire_corrupt", 0)
+            + counters.get("wire_duplicate", 0)
+            + counters.get("wire_delay", 0)
+        )
+        rows.append(
+            f"<tr><td>{escape(node)}</td>"
+            f'<td><span style="color:'
+            f'{ROLE_COLORS.get(role, "#cccccc")}">{escape(str(role))}'
+            f"</span></td>"
+            f"<td>{raft.get('term', '-')}</td>"
+            f"<td>{raft.get('commit_idx', '-')}</td>"
+            f"<td>{counters.get('elections_won', 0)}"
+            f"/{counters.get('elections_started', 0)}</td>"
+            f"<td>{counters.get('crc_rejected', 0)}</td>"
+            f"<td>{wire}</td>"
+            f"<td>{counters.get('safety_violations', 0)}</td>"
+            f"<td>{broker.get('ready', 0)}/{broker.get('inflight', 0)}</td>"
+            f"<td>{fsync_txt}</td></tr>"
+        )
+    return "".join(rows)
+
+
+def cluster_panel_html(
+    doc: Mapping[str, Any], windows_nemesis, t_max_s: float
+) -> str:
+    """The report's cluster section: role strip, term/commit-lag panel,
+    per-node table, event count."""
+    if not doc.get("samples"):
+        return ""
+    s = doc.get("summary") or {}
+    role_svg = cluster_role_svg(doc, windows_nemesis, t_max_s)
+    lag_svg = cluster_lag_svg(doc, windows_nemesis, t_max_s)
+    return (
+        f'<div class="panel"><h3>cluster telemetry — node roles on the '
+        f"op clock (shaded = nemesis fault windows)</h3>"
+        f"<p>{s.get('polls', 0)} polls · leaders "
+        f"{escape(', '.join(s.get('leaders-seen', []) or ['-']))} · "
+        f"{s.get('leader-changes', 0)} leader changes · "
+        f"{s.get('elections-won', 0)} elections won · tripwires "
+        f"{s.get('safety-violations', 0)} · "
+        f"{len(doc.get('events') or [])} node events</p>{role_svg}</div>"
+        f'<div class="panel"><h3>commit-index lag per node (lines) + '
+        f"term staircase (dashed)</h3>{lag_svg}</div>"
+        f'<div class="panel"><h3>per-node internals (end of run)</h3>'
+        f"<table><tr><th>node</th><th>role</th><th>term</th>"
+        f"<th>commit</th><th>elections won/started</th>"
+        f"<th>crc rejected</th><th>wire faults</th><th>tripwires</th>"
+        f"<th>ready/inflight</th><th>fsync p50/p99 ms</th></tr>"
+        f"{_cluster_node_rows(doc)}</table></div>"
+    )
+
+
+# ---------------------------------------------------------------------------
 # timeline.html (jepsen.checker.timeline parity, XML-well-formed)
 # ---------------------------------------------------------------------------
 
@@ -455,6 +683,18 @@ def render_run_report(
         rates = np.zeros((1, 3, 3))
         window_s = 1.0
 
+    # cluster telemetry (obs/cluster.py): rendered when the run carries
+    # a cluster.json — runs with telemetry off (or predating it) simply
+    # have no cluster section
+    from jepsen_tpu.obs.cluster import load_cluster_json
+
+    cluster_doc = load_cluster_json(run_dir)
+    cluster_html = (
+        cluster_panel_html(cluster_doc, windows, t_max_s)
+        if cluster_doc
+        else ""
+    )
+
     verdict = results.get("valid?")
     summary_doc = {
         "run": run_dir.name,
@@ -467,6 +707,8 @@ def render_run_report(
         ],
         **summary,
     }
+    if cluster_doc:
+        summary_doc["cluster"] = cluster_doc.get("summary")
     write_artifact(
         run_dir / REPORT_JSON,
         json.dumps(summary_doc, indent=1, sort_keys=True) + "\n",
@@ -528,6 +770,7 @@ def render_run_report(
         f'<div class="panel"><h3>sub-verdicts</h3><table>'
         f"<tr><th>checker</th><th>valid?</th></tr>"
         f"{_sub_verdict_rows(results)}</table></div>"
+        + cluster_html
         + (
             f'<div class="panel"><h3>nemesis windows (one clock with '
             f"the op timeline)</h3><table><tr><th>fault</th><th>start"

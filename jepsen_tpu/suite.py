@@ -544,6 +544,15 @@ def build_rabbitmq_test(
         name = "rabbitmq-fenced-mutex" if fenced else "rabbitmq-mutex"
     else:
         raise ValueError(f"unknown workload {workload!r}")
+    # cluster telemetry plane (ISSUE 12): any transport that can answer
+    # the admin STATS pull (LocalProcTransport) gets the ~1 Hz poller;
+    # SSH transports have no STATS surface and stay logs-only (the
+    # reference's own blindness — PARITY.md names this as exceeded)
+    cluster_source = None
+    if hasattr(transport, "node_stats"):
+        from jepsen_tpu.obs.cluster import TransportStatsSource
+
+        cluster_source = TransportStatsSource(transport)
     return Test(
         name=name,
         nodes=list(nodes),
@@ -555,4 +564,5 @@ def build_rabbitmq_test(
         concurrency=concurrency,
         store_root=store_root,
         opts=o,
+        cluster_source=cluster_source,
     )

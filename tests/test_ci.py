@@ -682,6 +682,43 @@ class TestBenchColdWarmSmoke:
         # the traced run really went through the lanes executor
         assert oo["lanes"] >= 1
 
+    def test_cluster_obs_overhead_section_schema(self, bench):
+        """Offline gate for the ISSUE-12 ``cluster_obs_overhead`` bench
+        schema: a tiny REAL off-vs-on pair over a live 3-node
+        replicated cluster must carry the throughput keys, the
+        overhead fraction, and proof the telemetry poller actually
+        sampled (cluster.json polls/samples/events).  The fraction
+        itself is asserted only as finite here — a 4-second smoke is
+        noise; the ≤2% claim belongs to the committed full-recipe
+        log."""
+        details = {}
+        bench._bench_cluster_obs_overhead(
+            details, seconds=4.0, nodes=3, rate=120.0, repeats=1
+        )
+        co = details["cluster_obs_overhead"]
+        for key in (
+            "config",
+            "nodes",
+            "seconds",
+            "rate",
+            "repeats",
+            "telemetry_off_ops_per_s",
+            "telemetry_on_ops_per_s",
+            "overhead_frac",
+            "within_2pct",
+            "polls",
+            "samples",
+            "node_events",
+            "backend",
+        ):
+            assert key in co, f"cluster_obs_overhead schema lost {key!r}"
+        assert co["nodes"] == 3
+        assert co["telemetry_off_ops_per_s"] > 0
+        assert co["telemetry_on_ops_per_s"] > 0
+        assert co["overhead_frac"] == co["overhead_frac"]  # finite
+        # the ON arm really sampled the cluster (no silent no-op)
+        assert co["polls"] >= 2 and co["samples"] >= co["polls"]
+
     def test_report_section_schema(self, bench):
         """Offline gate for the ISSUE-11 ``report`` bench schema: a
         tiny REAL run of the windowed-stats kernel over packed ``.jtc``

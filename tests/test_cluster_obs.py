@@ -1,0 +1,658 @@
+"""Telemetry ≡ history differential suite (ISSUE 12): the cluster
+telemetry plane (``jepsen_tpu/obs/cluster.py`` + the RaftNode/broker
+instrumentation) against what the cluster actually did.
+
+Pinned here, as counters — not log lines:
+
+- green runs: exactly one leader per poll, elections-won ≥ observed
+  leader changes, per-node term/commit monotone across samples, the
+  SAFETY-VIOLATION tripwire counter stays 0;
+- the tripwire COUNTS when committed entries truncate (driven
+  deterministically at the RPC layer);
+- the fsync latency sketch visibly shifts under the slow-disk fault,
+  and stays EMPTY under ``ack-before-fsync`` (a node lying about
+  fsync never reaches the timed fsync — the telemetry tell);
+- wire-fault injection counters match what the wire actually did:
+  sender corrupt counts ≥ receiver CRC rejections > 0 with checksums
+  on, and receiver CRC rejections stay 0 under ``no-wire-checksum``
+  while corruption flows (the bug made visible);
+- the poller's samples/events/gauges, the report's cluster panel, the
+  forensics cluster-window answer + surfaced log-pattern matches, and
+  the end-to-end live run with ``cluster.json`` + admin ``STATS``.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import tempfile
+import time
+import xml.etree.ElementTree as ET
+from pathlib import Path
+
+import pytest
+
+from jepsen_tpu.harness.replication import (
+    NodeCounters,
+    RaftNode,
+    ReplicatedBackend,
+    WireFaultSpec,
+)
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.obs import trace as obs_trace
+from jepsen_tpu.obs.cluster import (
+    ClusterPoller,
+    DirectStatsSource,
+    cluster_window_summary,
+    load_cluster_json,
+    summary_line,
+    write_cluster_json,
+)
+from jepsen_tpu.obs.metrics import (
+    QuantileSketch,
+    Registry,
+    render_prometheus,
+    sketch_state_delta,
+)
+
+FAST = dict(
+    election_timeout=(0.1, 0.2),
+    heartbeat_s=0.03,
+    dead_owner_s=1.0,
+    submit_timeout_s=2.5,
+)
+
+_COUNTER_KEYS = tuple(NodeCounters.__slots__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class _Cluster:
+    """In-process replication-layer cluster (the test_nemesis idiom)."""
+
+    def __init__(self, n=3, seed_bug=None, root=None, **overrides):
+        self.root = root
+        self.names = [f"n{i}" for i in range(n)]
+        self.peers = {nm: ("127.0.0.1", _free_port())
+                      for nm in self.names}
+        self.seed_bug = seed_bug
+        self.opts = {**FAST, **overrides}
+        self.backends: dict[str, ReplicatedBackend] = {}
+        for i, nm in enumerate(self.names):
+            self.backends[nm] = ReplicatedBackend(
+                nm,
+                self.peers,
+                seed_bug=self.seed_bug,
+                rng_seed=1000 + i,
+                data_dir=(
+                    None if self.root is None else f"{self.root}/{nm}"
+                ),
+                **self.opts,
+            )
+
+    def leader(self, timeout=8.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for nm, b in self.backends.items():
+                if b.raft.is_leader():
+                    return nm
+            time.sleep(0.02)
+        raise AssertionError("no leader")
+
+    def stop(self) -> None:
+        for b in self.backends.values():
+            b.stop()
+
+
+# ---------------------------------------------------------------------------
+# node-level counters + sketch
+# ---------------------------------------------------------------------------
+
+
+class TestNodeTelemetry:
+    def test_green_run_counters_and_snapshot_shape(self, tmp_path):
+        c = _Cluster(root=str(tmp_path / "d"))
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            for v in (b"1", b"2", b"3"):
+                assert b.enqueue("q", v, b"") is True
+            snaps = {
+                nm: bb.stats_snapshot() for nm, bb in c.backends.items()
+            }
+            # JSON-safe (the STATS wire contract)
+            json.dumps(snaps)
+            leaders = [
+                nm for nm, s in snaps.items()
+                if s["raft"]["role"] == "leader"
+            ]
+            assert leaders == [lead]
+            won = sum(
+                s["raft"]["counters"]["elections_won"]
+                for s in snaps.values()
+            )
+            assert won >= 1
+            for nm, s in snaps.items():
+                raft = s["raft"]
+                assert set(raft["counters"]) == set(_COUNTER_KEYS)
+                assert raft["counters"]["safety_violations"] == 0
+                assert raft["commit_idx"] <= raft["log_len"]
+                # durable green: real fsyncs were timed, WAL grew
+                assert raft["counters"]["wal_bytes"] > 0, nm
+                assert raft["fsync_ms"]["count"] > 0, nm
+            assert snaps[lead]["broker"]["ready"] == 3
+        finally:
+            c.stop()
+
+    def test_fsync_sketch_shifts_under_slow_disk(self, tmp_path):
+        c = _Cluster(root=str(tmp_path / "d"))
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            b.declare("q")
+            assert b.enqueue("q", b"0", b"") is True  # fast baseline
+            before = c.backends[lead].raft._fsync_ms.state()
+            for nm in c.names:
+                c.backends[nm].raft.set_fsync_latency(60.0, 10.0)
+            for v in (b"1", b"2"):
+                c.backends[c.leader()].enqueue("q", v, b"")
+            after = c.backends[lead].raft._fsync_ms.state()
+            delta = sketch_state_delta(before, after)
+            assert delta["count"] > 0, "no fsyncs under the fault"
+            shifted = QuantileSketch.from_state(delta)
+            assert shifted.quantile(0.5) >= 40.0, (
+                "slow-disk fault did not move the fsync sketch: "
+                f"p50={shifted.quantile(0.5):.2f}ms"
+            )
+        finally:
+            c.stop()
+
+    def test_ack_before_fsync_red_is_visible_in_telemetry(self, tmp_path):
+        """The lying node confirms writes while its fsync sketch stays
+        EMPTY and its WAL byte counter stays 0 — durability theater,
+        readable straight off the telemetry."""
+        c = _Cluster(
+            root=str(tmp_path / "d"), seed_bug="ack-before-fsync"
+        )
+        try:
+            lead = c.leader()
+            b = c.backends[lead]
+            # baseline AFTER election: term/vote meta fsyncs are real
+            # even under the bug — only the WAL path lies
+            before = c.backends[lead].stats_snapshot()["raft"]
+            b.declare("q")
+            acked = [v for v in (b"1", b"2") if b.enqueue("q", v, b"")]
+            assert acked, "nothing confirmed"
+            after = c.backends[lead].stats_snapshot()["raft"]
+            assert (
+                after["fsync_ms"]["count"] == before["fsync_ms"]["count"]
+            ), "confirmed writes fsynced — the bug is gone?"
+            assert after["counters"]["wal_bytes"] == 0
+        finally:
+            c.stop()
+
+    def test_wire_fault_counters_match_injected_events(self):
+        c = _Cluster()
+        try:
+            lead = c.leader()
+            L = c.backends[lead].raft
+            L.set_wire_faults(WireFaultSpec(corrupt_p=1.0))
+            time.sleep(0.5)  # heartbeats flow at 30 ms tick
+            corrupt = L.counters.wire_corrupt
+            rejected = sum(
+                c.backends[nm].raft.counters.crc_rejected
+                for nm in c.names
+                if nm != lead
+            )
+            assert corrupt > 0, "wire fault injected nothing"
+            assert 0 < rejected <= corrupt, (corrupt, rejected)
+            # heal: the injection counter freezes
+            L.set_wire_faults(None)
+            frozen = L.counters.wire_corrupt
+            time.sleep(0.3)
+            assert L.counters.wire_corrupt == frozen
+        finally:
+            c.stop()
+
+    def test_no_wire_checksum_red_rejects_nothing(self):
+        """Under the seeded bug, corruption flows (sender counter
+        grows) while NO receiver ever rejects a frame — the telemetry
+        differential that distinguishes the bug from the correct
+        checksummed transport."""
+        c = _Cluster(seed_bug="no-wire-checksum")
+        try:
+            lead = c.leader()
+            L = c.backends[lead].raft
+            L.set_wire_faults(WireFaultSpec(corrupt_p=1.0))
+            time.sleep(0.5)
+            assert L.counters.wire_corrupt > 0
+            assert all(
+                c.backends[nm].raft.counters.crc_rejected == 0
+                for nm in c.names
+            )
+        finally:
+            c.stop()
+
+    def test_tripwire_counts_committed_truncation(self):
+        """Deterministic committed-truncation at the RPC layer: a
+        single-node leader with committed entries receives a
+        conflicting higher-term AppendEntries overlapping its committed
+        prefix — the SAFETY-VIOLATION tripwire must COUNT, not just
+        log."""
+        node = RaftNode(
+            "n0",
+            {"n0": ("127.0.0.1", _free_port())},
+            lambda i, op: None,
+            election_timeout=(0.05, 0.1),
+            heartbeat_s=0.02,
+        )
+        try:
+            deadline = time.monotonic() + 5.0
+            while not node.is_leader():
+                assert time.monotonic() < deadline, "no self-election"
+                time.sleep(0.01)
+            for _ in range(3):
+                ok, _r = node.submit({"k": "noop"}, timeout_s=2.0)
+                assert ok
+            assert node.commit_idx == 3
+            assert node.counters.safety_violations == 0
+            resp = node._on_append_entries({
+                "term": node.term + 1,
+                "from": "nX",
+                "prev_idx": 0,
+                "prev_term": 0,
+                "entries": [(node.term + 1, {"k": "noop"})],
+                "leader_commit": 0,
+            })
+            assert resp["ok"] is True
+            assert node.counters.safety_violations == 1
+        finally:
+            node.stop()
+
+
+# ---------------------------------------------------------------------------
+# the poller: samples, events, gauges, document
+# ---------------------------------------------------------------------------
+
+
+class TestClusterPoller:
+    def test_leader_change_events_gauges_and_tracks(self):
+        c = _Cluster()
+        reg = Registry()
+        obs_trace.enable()
+        try:
+            lead = c.leader()
+            poller = ClusterPoller(
+                DirectStatsSource(c.backends),
+                interval_s=0.05,
+                registry=reg,
+            ).start()
+            time.sleep(0.3)
+            for nm, bb in c.backends.items():
+                if nm != lead:
+                    bb.raft.block(lead)  # one-way-out the leader
+            new = None
+            deadline = time.monotonic() + 8.0
+            while time.monotonic() < deadline:
+                for nm, bb in c.backends.items():
+                    if nm != lead and bb.raft.is_leader():
+                        new = nm
+                if new:
+                    break
+                time.sleep(0.02)
+            assert new, "no failover"
+            time.sleep(0.3)  # let the poller observe the flip
+            doc = poller.stop()
+        finally:
+            obs_trace.disable()
+            c.stop()
+
+        s = doc["summary"]
+        assert set(s["leaders-seen"]) >= {lead, new}
+        assert s["leader-changes"] >= 2
+        assert s["elections-won"] >= s["leader-changes"]
+        assert s["safety-violations"] == 0
+        role_events = [
+            e for e in doc["events"] if e["kind"] == "role"
+        ]
+        assert any(
+            e["node"] == new and e["to"] == "leader"
+            for e in role_events
+        )
+        assert any(e["kind"] == "term" for e in doc["events"])
+        # per-node monotone invariants (telemetry ≡ history, green)
+        by_node: dict[str, list] = {}
+        for smp in doc["samples"]:
+            by_node.setdefault(smp["node"], []).append(smp)
+        for nm, rows in by_node.items():
+            rows.sort(key=lambda r: r["t"])
+            terms = [r["term"] for r in rows]
+            commits = [r["commit"] for r in rows]
+            assert terms == sorted(terms), (nm, terms)
+            assert commits == sorted(commits), (nm, commits)
+        # registry gauges carry node labels; prometheus renders them
+        prom = render_prometheus(reg)
+        assert f'jepsen_tpu_cluster_node_role{{node="{new}"}} 2' in prom
+        assert "jepsen_tpu_cluster_node_commit_idx" in prom
+        # trace instants landed on per-node tracks
+        tracks = {rec[2] for rec in obs_trace.snapshot()}
+        assert f"node:{new}" in tracks
+
+    def test_unreachable_node_samples_as_down(self):
+        """A node whose poll raises (or a dead out-of-process node
+        answering None) must read as down — role ``down``, ``node_up``
+        gauge 0 — never crash the poller."""
+        reg = Registry()
+        p = ClusterPoller(
+            DirectStatsSource({"ghost": object()}),
+            interval_s=0.05,
+            registry=reg,
+        )
+        p.poll_once()
+        p.poll_once()
+        assert p.samples and all(
+            smp["role"] == "down" for smp in p.samples
+        )
+        assert reg.value("cluster.node_up", node="ghost") == 0.0
+
+    def test_final_summary_keeps_counters_of_a_down_node(self):
+        """A node that dies before the final poll must not lose its
+        counters from the summary — its tripwire/election totals are
+        exactly what a post-mortem needs (down-ness lives in the
+        samples)."""
+        c = _Cluster(n=1)
+        try:
+            c.leader()
+            src = DirectStatsSource(c.backends)
+            p = ClusterPoller(src, interval_s=0.05, registry=Registry())
+            p.poll_once()
+            # the node dies: subsequent polls read it as down
+            src._nodes[c.names[0]] = object()
+            p.poll_once()
+            doc = p.stop()
+        finally:
+            c.stop()
+        assert doc["samples"][-1]["role"] == "down"
+        assert doc["summary"]["elections-won"] >= 1, (
+            "a down node's counters vanished from the summary"
+        )
+        assert doc["final"][c.names[0]] is not None
+
+    def test_window_summary_answers_leader_and_lag(self):
+        doc = _synth_cluster_doc(t_max_ns=4_000_000_000)
+        w = cluster_window_summary(
+            doc, 2_500_000_000, 3_500_000_000
+        )
+        assert {e["node"] for e in w["leaders"]} == {"n1"}
+        assert w["max-commit-lag"] == 3
+        assert w["samples-in-window"] > 0
+        assert w["tripwires-in-window"] == 0
+        # summary_line renders without blowing up
+        assert "leaders" in summary_line(doc)
+
+
+# ---------------------------------------------------------------------------
+# synthetic cluster.json for the render-side tests
+# ---------------------------------------------------------------------------
+
+
+def _synth_cluster_doc(t_max_ns: int) -> dict:
+    sk = QuantileSketch()
+    for v in (0.5, 1.0, 2.0, 40.0):
+        sk.add(v)
+    counters0 = {k: 0 for k in _COUNTER_KEYS}
+
+    def raft(name, role, term, commit, **extra):
+        return {
+            "name": name, "role": role, "term": term,
+            "leader_hint": None, "commit_idx": commit,
+            "applied_idx": commit, "log_len": commit, "durable": True,
+            "counters": {**counters0, **extra},
+            "fsync_ms": sk.state(),
+        }
+
+    nodes = ("n0", "n1", "n2")
+    samples, events = [], []
+    for i, t in enumerate((0, t_max_ns // 2, t_max_ns)):
+        lead = "n0" if i == 0 else "n1"
+        term = 1 if i == 0 else 2
+        for n in nodes:
+            commit = 10 * (i + 1) - (3 if n == "n2" else 0)
+            samples.append({
+                "t": t, "node": n,
+                "role": "leader" if n == lead else "follower",
+                "term": term, "commit": commit, "applied": commit,
+                "log": commit, "wal": 100 * (i + 1), "ready": 1,
+                "inflight": 0,
+            })
+    events.append({
+        "t": t_max_ns // 2, "node": "n1", "kind": "role",
+        "frm": "follower", "to": "leader", "term": 2,
+    })
+    final = {
+        n: {
+            "broker": {
+                "connections": 1, "ready": 1, "inflight": 0,
+                "published": 5, "delivered": 5, "appended": 0,
+                "chan_close_540": 0, "chan_close_541": 0,
+            },
+            "raft": raft(
+                n, "leader" if n == "n1" else "follower", 2, 30,
+                elections_won=1 if n in ("n0", "n1") else 0,
+                elections_started=1 if n in ("n0", "n1") else 0,
+            ),
+        }
+        for n in nodes
+    }
+    return {
+        "interval-s": 1.0,
+        "nodes": list(nodes),
+        "samples": samples,
+        "events": events,
+        "final": final,
+        "summary": {
+            "polls": 3, "leaders-seen": ["n0", "n1"],
+            "leader-changes": 2, "max-term": 2, "elections-won": 2,
+            "safety-violations": 0, "crc-rejected": 0,
+            "wire-faults": 0,
+            "fsync-p99-ms": {n: 40.0 for n in nodes},
+        },
+    }
+
+
+class TestReportClusterPanel:
+    def test_report_renders_cluster_panels(self, tmp_path):
+        from jepsen_tpu.history.store import Store
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+        from jepsen_tpu.report.render import render_run_report
+
+        sh = synth_batch(1, SynthSpec(n_ops=40, n_processes=3))[0]
+        d = tmp_path / "run"
+        d.mkdir()
+        st = Store(tmp_path)
+        st.save_history(d, sh.ops)
+        st.save_results(d, {"valid?": True})
+        t_max = max(op.time for op in sh.ops if op.time >= 0)
+        write_cluster_json(d, _synth_cluster_doc(t_max))
+        paths = render_run_report(d)
+        html = Path(paths["report"]).read_text()
+        ET.fromstring(html)  # well-formed XML, panels included
+        assert "cluster telemetry" in html
+        assert "commit-index lag" in html
+        assert "per-node internals" in html
+        assert "fsync p50/p99" in html
+        rj = json.loads(Path(paths["report-json"]).read_text())
+        assert rj["cluster"]["leaders-seen"] == ["n0", "n1"]
+
+    def test_report_without_cluster_json_has_no_panel(self, tmp_path):
+        from jepsen_tpu.history.store import Store
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+        from jepsen_tpu.report.render import render_run_report
+
+        sh = synth_batch(1, SynthSpec(n_ops=20, n_processes=3))[0]
+        d = tmp_path / "run"
+        d.mkdir()
+        st = Store(tmp_path)
+        st.save_history(d, sh.ops)
+        st.save_results(d, {"valid?": True})
+        paths = render_run_report(d)
+        html = Path(paths["report"]).read_text()
+        ET.fromstring(html)
+        assert "cluster telemetry" not in html
+
+
+class TestForensicsCluster:
+    def _invalid_run(self, tmp_path):
+        from jepsen_tpu.history.store import Store
+
+        ops = [
+            Op(OpType.INVOKE, OpF.ENQUEUE, 0, 3, 2_600_000_000, 0),
+            Op(OpType.OK, OpF.ENQUEUE, 0, 3, 2_700_000_000, 1),
+            Op(OpType.INVOKE, OpF.DEQUEUE, 1, None, 3_000_000_000, 2),
+            Op(OpType.FAIL, OpF.DEQUEUE, 1, None, 3_100_000_000, 3),
+        ]
+        d = tmp_path / "run"
+        d.mkdir()
+        Store(tmp_path).save_history(d, ops)
+        results = {
+            "valid?": False,
+            "queue": {"valid?": False, "lost": [3]},
+            "log-file-pattern": {
+                "valid?": False,
+                "pattern": "CRASH REPORT",
+                "count": 1,
+                "matches": [{
+                    "node": "n1",
+                    "file": "n1/broker.log",
+                    "line": 42,
+                    "text": "=CRASH REPORT==== broker died",
+                }],
+            },
+        }
+        return d, ops, results
+
+    def test_cluster_window_and_logpattern_on_the_page(self, tmp_path):
+        from jepsen_tpu.report.forensics import render_forensics
+
+        d, ops, results = self._invalid_run(tmp_path)
+        write_cluster_json(d, _synth_cluster_doc(4_000_000_000))
+        p = render_forensics(d, history=ops, results=results)
+        assert p is not None
+        html = Path(p).read_text()
+        ET.fromstring(html)
+        # the cluster answer: who led during the violating window
+        assert "cluster during the violating window" in html
+        assert "n1 (term 2)" in html
+        assert "max commit-index lag" in html
+        # the log-only blind spot, fixed: matched lines on the page
+        assert "matched node-log lines" in html
+        assert "n1/broker.log" in html and "42" in html
+        assert "CRASH REPORT==== broker died" in html
+
+    def test_page_renders_without_cluster_json(self, tmp_path):
+        from jepsen_tpu.report.forensics import render_forensics
+
+        d, ops, results = self._invalid_run(tmp_path)
+        p = render_forensics(d, history=ops, results=results)
+        html = Path(p).read_text()
+        ET.fromstring(html)
+        assert "cluster during the violating window" not in html
+        assert "matched node-log lines" in html  # logpattern still shows
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: a live local-cluster run harvests cluster.json
+# ---------------------------------------------------------------------------
+
+
+class TestLiveClusterTelemetry:
+    def test_live_run_harvests_cluster_json_and_stats_wire(self, _reset):
+        """The e2e differential: a real 3-node replicated run under the
+        partition nemesis ends with a ``cluster.json`` whose telemetry
+        agrees with the history's clock and the cluster's elections —
+        and the admin ``STATS`` wire answers the same shape live."""
+        import sys as _sys
+
+        _sys.path.insert(0, str(Path(__file__).parent))
+        from _live import run_live_with_triage
+
+        from jepsen_tpu.control.db_rabbitmq import RabbitMQDB
+        from jepsen_tpu.harness.localcluster import LocalProcTransport
+        from jepsen_tpu.suite import DEFAULT_OPTS, build_rabbitmq_test
+
+        state: dict = {}
+
+        def build():
+            t = LocalProcTransport(n_nodes=3)
+            nodes = t.nodes
+            opts = {
+                **DEFAULT_OPTS,
+                "rate": 120.0,
+                "time-limit": 3.0,
+                "time-before-partition": 0.6,
+                "partition-duration": 1.0,
+                "recovery-sleep": 0.8,
+                "publish-confirm-timeout": 1.5,
+            }
+            db = RabbitMQDB(
+                t, nodes, primary_wait_s=0.2, secondary_wait_s=0.2,
+                join_stagger_max_s=0.1,
+            )
+            test = build_rabbitmq_test(
+                opts=opts, nodes=nodes, transport=t, db=db,
+                checker_backend="cpu", store_root=tempfile.mkdtemp(),
+                workload="queue", concurrency=3,
+            )
+            assert test.cluster_source is not None, (
+                "LocalProcTransport must wire the telemetry source"
+            )
+            state["transport"], state["nodes"] = t, nodes
+            return test, t
+
+        def checks(run):
+            # live STATS wire (cluster still up): full snapshot shape
+            snap = state["transport"].node_stats(state["nodes"][0])
+            assert snap is not None and snap["raft"] is not None
+            assert set(snap["raft"]["counters"]) == set(_COUNTER_KEYS)
+            assert "fsync_ms" in snap["raft"]
+            assert {"ready", "inflight"} <= set(snap["broker"])
+
+            doc = load_cluster_json(run.run_dir)
+            assert doc is not None, "runner never harvested cluster.json"
+            s = doc["summary"]
+            assert s["polls"] >= 2
+            assert len(doc["samples"]) >= s["polls"]
+            assert set(doc["nodes"]) == set(state["nodes"])
+            # telemetry ≡ history: leader changes need elections won,
+            # the tripwire stays silent on green, terms/commits monotone
+            assert 1 <= s["leader-changes"] <= s["elections-won"]
+            assert s["safety-violations"] == 0
+            by_node: dict[str, list] = {}
+            for smp in doc["samples"]:
+                by_node.setdefault(smp["node"], []).append(smp)
+            for nm, rows in by_node.items():
+                rows.sort(key=lambda r: r["t"])
+                live = [r for r in rows if r["role"] != "down"]
+                terms = [r["term"] for r in live]
+                commits = [r["commit"] for r in live]
+                assert terms == sorted(terms), (nm, terms)
+                assert commits == sorted(commits), (nm, commits)
+            # sample clock = the op clock (ns from run start)
+            t_hist = max(op.time for op in run.history if op.time >= 0)
+            assert all(
+                -1e9 <= smp["t"] <= t_hist + 60e9
+                for smp in doc["samples"]
+            )
+            # the default-on report carries the cluster panel
+            report = Path(run.run_dir) / "report.html"
+            assert report.is_file()
+            html = report.read_text()
+            assert "cluster telemetry" in html
+            ET.fromstring(html)
+
+        run_live_with_triage(build, expect="valid", checks=checks)

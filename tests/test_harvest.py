@@ -395,8 +395,9 @@ class TestHeadlineOrdering:
             "_bench_queue_pipeline", "_bench_stream", "_bench_stream_long",
             "_bench_elle", "_bench_mutex", "_bench_wgl_pcomp",
             "_bench_north_star_section", "_bench_cold_vs_warm_section",
-            "_bench_obs_overhead_section", "_bench_report_section",
-            "_bench_scaling",
+            "_bench_obs_overhead_section",
+            "_bench_cluster_obs_overhead_section",
+            "_bench_report_section", "_bench_scaling",
         ):
             def fake_section(details, _n=name):
                 # record whether the headline was already on stdout when
@@ -435,7 +436,7 @@ class TestHeadlineOrdering:
         secondary = [
             e for e in events if e[0] not in ("wgl_hard", "multichip")
         ]
-        assert len(secondary) == 11
+        assert len(secondary) == 12
         assert all(seen for _, seen in secondary), (
             "a secondary section started before the headline printed: "
             f"{secondary}"
@@ -444,9 +445,9 @@ class TestHeadlineOrdering:
     def test_details_persist_incrementally_per_section(self, monkeypatch):
         out, events, written = self._run(monkeypatch)
         # one write after the queue section, one after each of the
-        # eleven secondary sections (a timeout after N sections leaves
+        # twelve secondary sections (a timeout after N sections leaves
         # N fresh), one final with the compile-cache evidence
-        assert len(written) == 13
+        assert len(written) == 14
         assert "queue" in written[0] and "_bench_stream" not in written[0]
         assert "_bench_mutex" in written[-1]
         assert "entries_final" in written[-1]["compile_cache"]
@@ -458,6 +459,6 @@ class TestHeadlineOrdering:
             monkeypatch, failing={"_bench_elle"}
         )
         assert '"metric"' in out
-        assert len(written) == 13  # the write still happens after a failure
+        assert len(written) == 14  # the write still happens after a failure
         assert "_bench_elle" not in written[-1]
         assert "_bench_mutex" in written[-1]

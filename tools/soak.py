@@ -301,6 +301,18 @@ def run_soak(args) -> int:
         f"({check_sketch.count} batches)",
         flush=True,
     )
+    # cluster telemetry summary (ISSUE 12): the SUT's own internals —
+    # who led, how many elections, tripwire count — beside the
+    # checker-side sketches above
+    if run.run_dir is not None:
+        from jepsen_tpu.obs.cluster import load_cluster_json, summary_line
+
+        cdoc = load_cluster_json(run.run_dir)
+        if cdoc is not None:
+            print(
+                f"# soak cluster telemetry: {summary_line(cdoc)}",
+                flush=True,
+            )
     print(
         f"# soak done in {wall:.0f}s wall ({len(run.history)} history "
         f"ops, attempts logged above)",

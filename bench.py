@@ -986,6 +986,245 @@ def _bench_obs_overhead_section(details: dict) -> None:
     details["obs_overhead"] = got
 
 
+def _bench_elastic_overhead(
+    details: dict,
+    histories: int = None,
+    base_n: int = None,
+    n_ops: int = None,
+    chunk: int = 256,
+    repeats: int = 2,
+    kill_histories: int = 180,
+    kill_base_n: int = 24,
+    kill_ops: int = 60,
+    kill_procs: int = 3,
+    kills: tuple = (0, 1),
+    timeout_s: float = 600.0,
+) -> None:
+    """The elastic failure-isolation machinery's cost and its recovery
+    behavior (ISSUE 13 done-bar), two sub-measurements:
+
+    (a) **No-fault overhead bar (≤2%)**: the full north-star config
+    bytes-to-verdict through the per-device-lane executor, elastic
+    (the PR-13 default: per-unit retry bookkeeping, quarantine guards)
+    vs ``fail_fast=True`` (the PR-4/5 abort-all executor), interleaved
+    ``repeats``× with the min wall per mode — resilience is allowed to
+    watch the hot path, not to become it.  The elastic arm must also
+    report ZERO quarantines: a no-fault run that quarantines anything
+    is a correctness bug, not overhead.
+
+    (b) **Kill-k-of-N recovery rows**: the elastic multi-process
+    launcher over a smaller corpus, killing k of ``kill_procs`` workers
+    deterministically right after they claim their first stripe (the
+    ``JEPSEN_TPU_DIST_DIE_PID`` hook — the same death point the crash
+    contract pins, so every kill row genuinely exercises the requeue
+    path).  Per-stripe recovery times (death → the stripe's verdict
+    shard landing on a survivor) feed a PR-9 ``QuantileSketch`` for the
+    p50/p99 columns.  The k=0 row is the honesty control: it must not
+    claim ANY recovery (no deaths, no requeues, no recovery keys) —
+    the CI schema gate pins that a zero-kill row can't claim recovery.
+
+    Lanes-only executor shape for (a) (no meshed collective reduction),
+    same rationale as ``cold_vs_warm``/``obs_overhead``: the overhead
+    claim is a host-side one.  (b) spawns real worker processes — the
+    wall there includes interpreter+jax start, which is why recovery is
+    measured per stripe, not as run-wall deltas."""
+    import tempfile
+
+    import jax
+
+    from jepsen_tpu.history.synth import SynthSpec, synth_batch
+    from jepsen_tpu.obs.metrics import QuantileSketch
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    histories = histories or NORTH_STAR_HISTORIES
+    base_n = base_n or BASE_HISTORIES
+    n_ops = n_ops or N_OPS
+    base = synth_batch(
+        base_n, SynthSpec(n_ops=n_ops, n_processes=5), lost=1
+    )
+    kw = dict(chunk=chunk, lanes=0, use_cache=False)
+    ff_walls: list[float] = []
+    el_walls: list[float] = []
+    el_stats = None
+    # the no-fault honesty gate sums over EVERY elastic repeat — a
+    # quarantine in any repeat (even one whose wall loses the min)
+    # must show, or the committed log could claim a clean run that
+    # silently degraded
+    el_quarantined = 0
+    el_unit_retries = 0
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, base)
+        srcs = (files * ((histories + base_n - 1) // base_n))[:histories]
+        check_sources("queue", srcs, **kw)  # warm (compile-excluded)
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            check_sources("queue", srcs, fail_fast=True, **kw)
+            ff_walls.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            _res, el_stats = check_sources("queue", srcs, **kw)
+            el_walls.append(time.perf_counter() - t0)
+            el_quarantined += el_stats.quarantined
+            el_unit_retries += el_stats.unit_retries
+    ff, el = min(ff_walls), min(el_walls)
+    overhead = (el - ff) / max(ff, 1e-9)
+
+    # -- (b) kill-k-of-N recovery rows over the elastic launcher
+    from jepsen_tpu.history.store import _json_default
+    from jepsen_tpu.parallel.distributed import run_multiprocess_check
+
+    def _norm(x):
+        return json.loads(json.dumps(x, default=_json_default))
+
+    kill_rows: list[dict] = []
+    baseline_results = None
+    kbase = synth_batch(
+        kill_base_n, SynthSpec(n_ops=kill_ops, n_processes=5), lost=1
+    )
+    with tempfile.TemporaryDirectory() as td:
+        files = _write_tmp_histories(td, kbase)
+        srcs = (
+            files * ((kill_histories + kill_base_n - 1) // kill_base_n)
+        )[:kill_histories]
+        for k in kills:
+            if k:
+                os.environ["JEPSEN_TPU_DIST_DIE_PID"] = ",".join(
+                    str(q) for q in range(1, 1 + k)
+                )
+            t0 = time.perf_counter()
+            try:
+                results, info = run_multiprocess_check(
+                    "queue", srcs, kill_procs,
+                    chunk=max(chunk // 4, 16),
+                    timeout_s=timeout_s,
+                )
+            finally:
+                os.environ.pop("JEPSEN_TPU_DIST_DIE_PID", None)
+            wall = time.perf_counter() - t0
+            deg = info["degraded"]
+            quarantined_idx = {
+                i for i, r in enumerate(results)
+                if "quarantined" in r.get("queue", {})
+            }
+            row = {
+                "kills": k,
+                "procs": kill_procs,
+                "histories": len(srcs),
+                "wall_s": round(wall, 2),
+                "dead_workers": len(deg["dead_workers"]),
+                "requeued_stripes": len(deg["requeued_stripes"]),
+                "quarantined_histories": deg["quarantined_histories"],
+                "effective_procs": deg["effective_procs"],
+            }
+            if baseline_results is None:
+                baseline_results = results
+            else:
+                row["verdicts_match_no_kill"] = all(
+                    _norm(r) == _norm(b)
+                    for i, (r, b) in enumerate(
+                        zip(results, baseline_results)
+                    )
+                    if i not in quarantined_idx
+                )
+            if k:
+                # recovery time per requeued stripe (death → shard
+                # landed), through the PR-9 sketch
+                sk = QuantileSketch()
+                for entry in deg["requeued_stripes"]:
+                    if "recovery_s" in entry:
+                        sk.add(float(entry["recovery_s"]))
+                row["recovery_count"] = sk.count
+                if sk.count:
+                    row["recovery_p50_s"] = round(sk.quantile(0.50), 3)
+                    row["recovery_p99_s"] = round(sk.quantile(0.99), 3)
+            kill_rows.append(row)
+
+    details["elastic_overhead"] = {
+        "config": "BASELINE.json #1 bytes-to-verdict, per-device lanes: "
+                  "elastic (default) vs --fail-fast; plus kill-k-of-N "
+                  "elastic-launcher recovery rows",
+        "histories": histories,
+        "repeats": repeats,
+        "fail_fast_wall_s": round(ff, 2),
+        "elastic_wall_s": round(el, 2),
+        "overhead_frac": round(overhead, 4),
+        "within_2pct": bool(overhead <= 0.02),
+        "quarantined_no_fault": el_quarantined,
+        "unit_retries_no_fault": el_unit_retries,
+        "kill_recovery": kill_rows,
+        "devices": jax.device_count(),
+        "lanes": el_stats.lanes,
+        "backend": jax.default_backend(),
+    }
+    eo = details["elastic_overhead"]
+    kr = " | ".join(
+        f"k={r['kills']}: {r['wall_s']}s"
+        + (
+            f" rec p50 {r['recovery_p50_s']}s"
+            if "recovery_p50_s" in r
+            else ""
+        )
+        for r in kill_rows
+    )
+    print(
+        f"# elastic_overhead: fail-fast {ff:.2f}s | elastic {el:.2f}s -> "
+        f"{overhead * 100:.2f}% "
+        f"({'within' if eo['within_2pct'] else 'OUTSIDE'} 2%); "
+        f"kill rows: {kr}",
+        file=sys.stderr,
+    )
+
+
+def _bench_elastic_overhead_section(details: dict) -> None:
+    """``elastic_overhead`` for the section loop: in-process on a chip
+    backend, in an 8-virtual-device CPU subprocess otherwise (the same
+    mesh-shape discipline as the north_star / obs_overhead sections)."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        _bench_elastic_overhead(details)
+        return
+    child = (
+        "import json, os, sys\n"
+        "sys.path.insert(0, sys.argv[1])\n"
+        "import bench\n"
+        "d = {}\n"
+        "bench._bench_elastic_overhead(d)\n"
+        "print('ELASTIC_OVERHEAD ' + json.dumps(d['elastic_overhead']),"
+        " flush=True)\n"
+    )
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    r = subprocess.run(
+        [
+            sys.executable, "-c", child,
+            os.path.dirname(os.path.abspath(__file__)),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=3600,
+        env=env,
+    )
+    for line in r.stderr.splitlines():
+        print(line, file=sys.stderr)
+    got = None
+    for line in r.stdout.splitlines():
+        if line.startswith("ELASTIC_OVERHEAD "):
+            try:
+                got = json.loads(line[len("ELASTIC_OVERHEAD "):])
+            except ValueError:
+                pass
+    if got is None:
+        raise RuntimeError(
+            f"elastic_overhead child produced no section: "
+            f"{(r.stderr or r.stdout)[-400:]}"
+        )
+    details["elastic_overhead"] = got
+
+
 def _bench_cluster_obs_overhead(
     details: dict,
     seconds: float = 20.0,
@@ -2135,7 +2374,8 @@ def _run_once() -> None:
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_north_star_section, _bench_cold_vs_warm_section,
-        _bench_obs_overhead_section, _bench_cluster_obs_overhead_section,
+        _bench_obs_overhead_section, _bench_elastic_overhead_section,
+        _bench_cluster_obs_overhead_section,
         _bench_report_section, _bench_scaling,
     ):
         try:

@@ -233,11 +233,13 @@ def _checker_for(args, out_dir=None, history=None, hpath=None):
 def _cmd_check_procs(args, paths, workload: str, prev: dict) -> int:
     """``check --procs N`` over SEVERAL stored histories: the
     multi-process checker harness (``parallel/distributed.py``) — N
-    ``jax.distributed`` worker processes (CPU workers: a local chip is
-    exclusive to one process, so the host cores are the multi-process
-    resource), deterministic size-striped file assignment, per-process
-    multi-lane pipelines, one merged verdict set from the coordinator.
-    A dead worker aborts loudly with no partial verdicts."""
+    worker processes (CPU workers: a local chip is exclusive to one
+    process, so the host cores are the multi-process resource),
+    deterministic size-striped file assignment, per-process multi-lane
+    pipelines, one merged verdict set.  Elastic by default: dead
+    workers degrade the run (requeue + quarantine + provenance)
+    instead of aborting it; ``--fail-fast`` restores the loud
+    no-partial-verdicts abort verbatim."""
     import os as _os
 
     from jepsen_tpu.checkers.protocol import VALID, merge_valid
@@ -270,9 +272,25 @@ def _cmd_check_procs(args, paths, workload: str, prev: dict) -> int:
         args.procs,
         devices_per_proc=max(1, avail // args.procs),
         mesh=True,
+        fail_fast=getattr(args, "fail_fast", False),
         **opts,
     )
     dt = time.perf_counter() - t0
+    from jepsen_tpu.parallel.distributed import degraded_active
+
+    degraded = info.get("degraded")
+    if not degraded_active(degraded):
+        degraded = None
+    if degraded is not None:
+        # the per-history copy stays machine-readable but drops each
+        # dead worker's log tail — replicating the same multi-KB text
+        # into every history's results.json adds nothing the pid/rc
+        # fields don't already identify
+        degraded = dict(degraded)
+        degraded["dead_workers"] = [
+            {k: v for k, v in d.items() if k != "log_tail"}
+            for d in degraded.get("dead_workers", ())
+        ]
     composed = []
     for p, row in zip(paths, results):
         result = dict(row)
@@ -281,8 +299,23 @@ def _cmd_check_procs(args, paths, workload: str, prev: dict) -> int:
             for r in result.values()
             if isinstance(r, dict)
         )
+        if degraded is not None:
+            # machine-readable batch provenance beside the verdict: the
+            # report's degraded row and any later triage read it from
+            # results.json (attached AFTER the merge — it carries no
+            # "valid?" and must never vote)
+            result["degraded"] = degraded
         save_results(Path(p).parent, result)
         composed.append(result)
+    if degraded is not None:
+        print(
+            f"# DEGRADED check: {len(degraded['dead_workers'])} dead "
+            f"worker(s), {len(degraded['requeued_stripes'])} requeued "
+            f"stripe(s), {degraded['quarantined_histories']} quarantined "
+            f"histories (verdicts at those positions are explicit "
+            f"unknowns; provenance saved in results.json)",
+            file=sys.stderr,
+        )
     if getattr(args, "report", False):
         # per-run artifacts for the whole tree; `jepsen-tpu report`
         # builds the cross-run index over the same pages
@@ -527,6 +560,7 @@ def _cmd_bench_check_pipeline(args) -> int:
         serial=getattr(args, "serial", False),
         lanes=getattr(args, "lanes", None),
         reduce=reduce,
+        fail_fast=getattr(args, "fail_fast", False),
         **opts,
     )
     if reduce:
@@ -568,6 +602,7 @@ def _cmd_bench_check_pipeline(args) -> int:
                 "stage_overlap_frac": round(stats.stage_overlap_frac, 3),
                 "device_idle_frac": round(stats.device_idle_frac, 3),
                 "invalid": n_invalid,
+                "quarantined": stats.quarantined,
                 "backend": jax.default_backend(),
             }
         )
@@ -1736,14 +1771,26 @@ def build_parser() -> argparse.ArgumentParser:
         "--procs",
         type=int,
         default=0,
-        help="multi-process checking of a STORE TREE: spawn N "
-        "jax.distributed worker processes (parallel/distributed.py) — "
-        "deterministic size-striped assignment of every history under "
-        "the tree, per-process multi-lane pipelines (CPU workers: a "
-        "chip is exclusive to one process, so host cores are the "
-        "multi-process resource), one merged verdict set; a dead "
-        "worker aborts the run with no partial verdicts.  A single "
-        "history falls back to the in-process pipeline",
+        help="multi-process checking of a STORE TREE: spawn N checker "
+        "worker processes (parallel/distributed.py) — deterministic "
+        "size-striped assignment of every history under the tree, "
+        "per-process multi-lane pipelines (CPU workers: a chip is "
+        "exclusive to one process, so host cores are the multi-process "
+        "resource), one merged verdict set.  ELASTIC by default: a "
+        "dead/wedged worker's stripes requeue onto the survivors with "
+        "bounded retry, exhausted stripes quarantine as explicit "
+        "unknowns, and the merged verdict carries machine-readable "
+        "degraded provenance.  A single history falls back to the "
+        "in-process pipeline",
+    )
+    c.add_argument(
+        "--fail-fast",
+        dest="fail_fast",
+        action="store_true",
+        help="disable elastic degradation: any stage/worker failure "
+        "aborts the whole run loudly with no partial verdicts (the "
+        "pre-PR-13 PipelineError / DistributedCheckError contract, "
+        "preserved verbatim — the triage escape hatch)",
     )
     c.set_defaults(fn=cmd_check)
 
@@ -1797,6 +1844,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="with --pipeline: run the identical stages strictly "
         "serially on the calling thread (triage twin — byte-identical "
         "results, no overlap)",
+    )
+    b.add_argument(
+        "--fail-fast",
+        dest="fail_fast",
+        action="store_true",
+        help="with --pipeline: disable the elastic per-chunk "
+        "quarantine — any stage failure aborts the whole batch with "
+        "PipelineError (the pre-PR-13 contract; also the baseline the "
+        "bench's elastic_overhead section compares against)",
     )
     b.add_argument(
         "--chunk",

@@ -22,12 +22,32 @@ hosts the ``jax.distributed`` coordination service, and every worker
    key-value store, where process 0 performs the final cross-process
    merge and emits one verdict set.
 
-Fail-loud semantics match :class:`~jepsen_tpu.parallel.pipeline.
-PipelineError`: a worker that dies (crash, kill, wedge) aborts the whole
-run — the launcher kills the survivors and raises
-:class:`DistributedCheckError` with NO partial verdicts, and the
-coordinator's blocking KV reads are deadline-bounded so a silent wedge
-cannot hang the merge forever.
+Failure semantics are ELASTIC by default (PR 13, ROADMAP direction 2's
+resilience half): the launcher's liveness poll no longer kills the
+survivors when a worker dies.  Work moves through a spool-directory
+task protocol — one task per ``assign_stripes`` stripe, claimed by
+atomic rename, results written atomically per stripe — so a
+dead/wedged worker's stripes RE-QUEUE onto the survivors with bounded
+retry + exponential backoff, a per-stripe deadline SIGKILLs a wedged
+(e.g. SIGSTOPped) claim-holder so its stripes recirculate too (an
+ACTIVE worker heartbeats its claim's mtime, so the deadline measures
+wedge, never honest long work), and a
+stripe whose retries exhaust is QUARANTINED: its histories report
+``unknown`` with the worker's death evidence while every other verdict
+survives.  The merged verdict carries machine-readable ``degraded``
+provenance (dead workers, requeued stripes, retry counts, reduced
+worker count) instead of dying.  Elastic workers do NOT join
+``jax.distributed`` — computation never crosses the process boundary,
+and coupling worker liveness through the coordination service is
+exactly what made the old contract kill-everything.
+
+``fail_fast=True`` (CLI ``check --procs --fail-fast``) preserves the
+PR-5 contract verbatim: ``jax.distributed`` join, KV-store merge on
+process 0, and a worker that dies (crash, kill, wedge) aborts the
+whole run — the launcher kills the survivors and raises
+:class:`DistributedCheckError` with NO partial verdicts; the
+coordinator's blocking KV reads stay deadline-bounded so a silent
+wedge cannot hang the merge forever.
 
 Pod-style use (every host one process, one global mesh over ICI+DCN)
 keeps the thin helpers below: ``init_multihost`` + ``global_checker_mesh``
@@ -121,9 +141,22 @@ def assign_stripes(sizes: list[int], n_procs: int) -> list[list[int]]:
 
 _KV_PREFIX = "jt/verdict"
 
-#: env hook for the crash-contract test: the named process exits hard
-#: mid-run (after joining the cluster, before any verdict is published)
+#: env hook for the crash-contract tests: the named process(es,
+#: comma-separated) exit hard mid-run — fail-fast workers before any
+#: verdict is published; elastic workers right AFTER claiming their
+#: first stripe, so the requeue path is what gets exercised
 _DIE_ENV = "JEPSEN_TPU_DIST_DIE_PID"
+
+#: env hook for the stripe-deadline tests: the named elastic worker(s)
+#: wedge (sleep forever) after claiming — the SIGSTOP shape
+_WEDGE_ENV = "JEPSEN_TPU_DIST_WEDGE_PID"
+
+
+def _hook_hit(env_name: str, pid: int) -> bool:
+    raw = os.environ.get(env_name)
+    if not raw:
+        return False
+    return str(pid) in [p.strip() for p in raw.split(",")]
 
 
 def _kv_client():
@@ -148,15 +181,28 @@ def worker_main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--worker", action="store_true", required=True)
     p.add_argument("--manifest", required=True)
-    p.add_argument("--coordinator", required=True)
+    p.add_argument("--elastic", action="store_true")
+    p.add_argument("--spool")
+    p.add_argument("--coordinator")
     p.add_argument("--process-id", type=int, required=True)
     p.add_argument("--num-processes", type=int, required=True)
-    p.add_argument("--out", required=True)
+    p.add_argument("--out")
     p.add_argument("--merge-timeout-s", type=float, default=300.0)
     args = p.parse_args(argv)
+    if args.elastic:
+        if not args.spool:
+            p.error("--spool is required with --elastic")
+    elif not (args.coordinator and args.out):
+        # the fail-fast worker joins jax.distributed and merges to a
+        # file — both flags are load-bearing there (the elastic worker
+        # needs neither, which is why they can't be required=True)
+        p.error("--coordinator and --out are required without --elastic")
 
     with open(args.manifest) as fh:
         man = json.load(fh)
+
+    if args.elastic:
+        return _elastic_worker(args, man)
 
     import jax
 
@@ -175,7 +221,7 @@ def worker_main(argv=None) -> int:
             man["cache_dir"], backend=jax.default_backend()
         )
 
-    if os.environ.get(_DIE_ENV) == str(pid):
+    if _hook_hit(_DIE_ENV, pid):
         # crash-contract hook: die mid-run, after joining the cluster
         # and BEFORE publishing any verdict
         os._exit(42)
@@ -200,12 +246,16 @@ def worker_main(argv=None) -> int:
         opts["mesh"] = checker_mesh(jax.local_devices(), seq=1)
     reduce = bool(man.get("reduce"))
     t0 = time.perf_counter()
+    # fail_fast=True: the fail-fast worker preserves the PR-5 contract
+    # verbatim — any pipeline crash kills this process, which the
+    # launcher turns into the abort-all DistributedCheckError
     results, stats = check_sources(
         man["workload"],
         my_paths,
         chunk=int(man.get("chunk") or 64),
         lanes=man.get("lanes"),
         reduce=reduce,
+        fail_fast=True,
         **opts,
     )
     wall = time.perf_counter() - t0
@@ -273,8 +323,11 @@ def _merge_shards(man: dict, shards: list[dict], reduce: bool) -> dict:
         for s in shards
     ]
     if reduce:
+        # "quarantined" is always 0 here — fail-fast workers abort the
+        # whole run rather than quarantine — but the key stays so the
+        # reduced-verdict schema is identical across both modes
         merged = {"histories": 0, "invalid": 0, "first_invalid": -1,
-                  "dropped": 0}
+                  "dropped": 0, "quarantined": 0}
         for s in shards:
             r = s["results"]
             merged["histories"] += r["histories"]
@@ -291,6 +344,185 @@ def _merge_shards(man: dict, shards: list[dict], reduce: bool) -> dict:
         for i, r in zip(s["indices"], s["results"]):
             out[i] = r
     return {"reduce": False, "results": out, "per_process": per_proc}
+
+
+# ---------------------------------------------------------------------------
+# Elastic mode: spool-directory task protocol.  The launcher writes one
+# task file per assign_stripes stripe; workers claim by atomic rename
+# (tasks/t{k}.json -> claims/t{k}.json.p{pid}), write their verdict
+# shard atomically (results/r{k}.json), and poll until the launcher's
+# `done` sentinel.  A worker that dies mid-claim leaves its claim file
+# behind — the launcher's liveness poll requeues it onto the survivors.
+# ---------------------------------------------------------------------------
+
+
+def _write_json_atomic(path: Path, doc: dict) -> None:
+    tmp = path.with_name(path.name + f".{os.getpid()}.tmp")
+    from jepsen_tpu.history.store import _json_default
+
+    tmp.write_text(json.dumps(doc, default=_json_default))
+    os.replace(tmp, path)
+
+
+def _claim_task(tasks: Path, claims: Path, pid: int):
+    """Claim one task by atomic rename, preferring this worker's OWN
+    deterministic stripe (task id == process id initially) before
+    stealing.  Tasks under a requeue backoff (``not_before``) are
+    skipped.  Returns ``(task_dict, claim_path)`` or None."""
+    now = time.time()
+    pref = tasks / f"t{pid}.json"
+    cands = [pref] if pref.exists() else []
+    cands += [p for p in sorted(tasks.glob("t*.json")) if p != pref]
+    for tf in cands:
+        try:
+            task = json.loads(tf.read_text())
+        except (OSError, ValueError):
+            continue  # lost a race with another claimer / mid-write
+        if float(task.get("not_before", 0.0)) > now:
+            continue  # backoff window: leave it for a later scan
+        dst = claims / f"{tf.name}.p{pid}"
+        try:
+            # fresh mtime BEFORE the rename: os.rename preserves the
+            # task file's write time, and the launcher's deadline scan
+            # must never catch a just-claimed stripe wearing a stale
+            # timestamp (it would SIGKILL a healthy holder)
+            os.utime(tf)
+            os.rename(tf, dst)
+        except OSError:
+            continue  # lost the claim race
+        os.utime(dst)  # claim time — the launcher's stripe deadline
+        return task, dst
+    return None
+
+
+def _claim_heartbeat(claim_path: Path, stop, period: float = 2.0) -> None:
+    """Refresh the claim file's mtime while the stripe is actively
+    being checked: the launcher's per-stripe deadline measures WEDGE
+    (a SIGSTOP freezes every thread, heartbeat included — the mtime
+    goes stale), never honest long work (a 10k-history stripe can
+    legitimately outlive any fixed deadline).  A vanished claim file
+    (requeued from under us after a presumed death) ends the beat."""
+    while not stop.wait(period):
+        try:
+            os.utime(claim_path)
+        except OSError:
+            return
+
+
+def _elastic_worker(args, man: dict) -> int:
+    """One elastic checker process: claim stripes off the spool, run
+    the per-process (elastic) pipeline over each, publish verdict
+    shards as files.  No ``jax.distributed`` join — nothing crosses
+    the process boundary, and nothing couples this worker's liveness
+    to its siblings'."""
+    import threading
+
+    import jax
+
+    pid = args.process_id
+    spool = Path(args.spool)
+    tasks, claims, resdir = (
+        spool / "tasks", spool / "claims", spool / "results",
+    )
+    done_f = spool / "done"
+
+    from jepsen_tpu.utils.jaxenv import enable_compilation_cache
+
+    if man.get("cache_dir"):
+        enable_compilation_cache(
+            man["cache_dir"], backend=jax.default_backend()
+        )
+    opts = dict(man.get("opts") or {})
+    if man.get("mesh"):
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        # the PROCESS-LOCAL mesh, exactly as in fail-fast mode
+        opts["mesh"] = checker_mesh(jax.local_devices(), seq=1)
+    reduce = bool(man.get("reduce"))
+
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    checked = 0
+    # the spawning launcher IS this process's parent; orphaning shows
+    # as a reparent AWAY from it (to init or a subreaper) — comparing
+    # against the recorded pid instead of literal 1 keeps the check
+    # honest when the launcher itself runs as PID 1 (container
+    # entrypoint)
+    launcher_pid = os.getppid()
+    while not done_f.exists():
+        if os.getppid() != launcher_pid:
+            return 3  # orphaned: the launcher is gone; don't linger
+        got = _claim_task(tasks, claims, pid)
+        if got is None:
+            time.sleep(0.05)
+            continue
+        task, claim_path = got
+        if _hook_hit(_DIE_ENV, pid):
+            # crash hook: die AFTER claiming, BEFORE any result — the
+            # launcher must requeue this stripe onto a survivor
+            os._exit(42)
+        if _hook_hit(_WEDGE_ENV, pid):
+            # wedge hook BEFORE the heartbeat starts: a real SIGSTOP
+            # freezes the beat thread too, and this hook must look the
+            # same to the launcher's stripe deadline
+            time.sleep(3600)
+        k = int(task["task"])
+        mine = sorted(task["indices"])
+        my_paths = [man["paths"][i] for i in mine]
+        hb_stop = threading.Event()
+        hb = threading.Thread(
+            target=_claim_heartbeat,
+            args=(claim_path, hb_stop),
+            name="claim-heartbeat",
+            daemon=True,
+        )
+        hb.start()
+        t0 = time.perf_counter()
+        try:
+            results, stats = check_sources(
+                man["workload"],
+                my_paths,
+                chunk=int(man.get("chunk") or 64),
+                lanes=man.get("lanes"),
+                reduce=reduce,
+                fail_fast=False,
+                **opts,
+            )
+        finally:
+            hb_stop.set()
+        wall = time.perf_counter() - t0
+        if reduce:
+            # first_invalid is an index into THIS stripe; lift to the
+            # global manifest index before the merge
+            fi = results.get("first_invalid", -1)
+            results = dict(results)
+            results["first_invalid"] = (
+                mine[fi] if 0 <= fi < len(mine) else -1
+            )
+        _write_json_atomic(
+            resdir / f"r{k}.json",
+            {
+                "task": k,
+                "pid": pid,
+                "retries": int(task.get("retries", 0)),
+                "indices": mine,
+                "results": results,
+                "stats": {
+                    "wall_s": wall,
+                    "histories": stats.histories,
+                    "lanes": stats.lanes,
+                    "dropped": stats.dropped,
+                    "batches": stats.batches,
+                    "quarantined": stats.quarantined,
+                    "unit_retries": stats.unit_retries,
+                    "device_idle_frac": stats.device_idle_frac,
+                },
+            },
+        )
+        claim_path.unlink(missing_ok=True)
+        checked += len(mine)
+    print(json.dumps({"pid": pid, "checked": checked}), flush=True)
+    return 0
 
 
 def _free_port() -> int:
@@ -312,24 +544,59 @@ def run_multiprocess_check(
     timeout_s: float = 900.0,
     cache_dir: str | None = None,
     platform: str | None = None,
+    fail_fast: bool = False,
+    stripe_timeout_s: float | None = None,
+    max_stripe_retries: int = 2,
+    _proc_hook=None,
     **opts,
 ) -> tuple[list | dict, dict]:
     """The multi-process bytes-to-verdict launcher (CLI ``check --procs``).
 
-    Spawns ``n_procs`` worker processes joined through
-    ``jax.distributed`` (worker 0 hosts the coordination service),
-    assigns every history file to exactly one worker by the
-    deterministic size-striped rule, runs the per-process pipelines,
-    and returns the coordinator's merged verdicts:
+    Spawns ``n_procs`` worker processes, assigns every history file to
+    exactly one worker by the deterministic size-striped rule
+    (:func:`assign_stripes`), runs the per-process pipelines, and
+    returns the merged verdicts:
 
     - ``reduce=False`` → ``(results, info)`` with one JSON-normalized
       result dict per path, in order (launcher-dropped unreadable /
       zero-length files carry explicit ``unknown`` entries);
     - ``reduce=True`` → ``(verdict, info)`` with the collectively
-      reduced ``{"histories", "invalid", "first_invalid"}`` scalars.
+      reduced ``{"histories", "invalid", "first_invalid",
+      "quarantined"}`` scalars.
 
-    A dead worker (non-zero exit, kill, timeout) aborts the whole run
-    with :class:`DistributedCheckError` and NO partial verdicts."""
+    ELASTIC by default: a dead/wedged worker's stripes requeue onto the
+    survivors (bounded retry + exponential backoff; ``stripe_timeout_s``
+    SIGKILLs a wedged claim-holder), exhausted stripes quarantine as
+    explicit ``unknown`` entries, and ``info["degraded"]`` carries the
+    machine-readable provenance.  Only a run with NO surviving worker
+    (or a global timeout) raises :class:`DistributedCheckError`.
+
+    ``fail_fast=True`` preserves the PR-5 contract verbatim: one
+    ``jax.distributed`` fleet, KV-store merge, and a dead worker
+    (non-zero exit, kill, timeout) aborts the whole run with
+    :class:`DistributedCheckError` and NO partial verdicts.
+
+    ``_proc_hook`` (tools/chaos_check.py) receives the worker Popen
+    list right after spawn — the handle a checker-nemesis needs to
+    SIGKILL/SIGSTOP real workers mid-check."""
+    if not fail_fast:
+        return _run_elastic_check(
+            workload,
+            paths,
+            n_procs,
+            devices_per_proc=devices_per_proc,
+            chunk=chunk,
+            lanes=lanes,
+            mesh=mesh,
+            reduce=reduce,
+            timeout_s=timeout_s,
+            cache_dir=cache_dir,
+            platform=platform,
+            stripe_timeout_s=stripe_timeout_s,
+            max_stripe_retries=max_stripe_retries,
+            _proc_hook=_proc_hook,
+            **opts,
+        )
     import tempfile
 
     from jepsen_tpu.parallel.pipeline import _lane_census
@@ -362,14 +629,8 @@ def run_multiprocess_check(
             json.dump(manifest, fh)
         out_path = os.path.join(td, "merged.json")
 
-        env = os.environ.copy()
-        env["JAX_PLATFORMS"] = platform or "cpu"
-        env["XLA_FLAGS"] = (
-            env.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={devices_per_proc}"
-        )
-        repo = str(Path(__file__).resolve().parent.parent.parent)
-        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        env = _worker_env(platform, devices_per_proc)
+        repo = env["PYTHONPATH"].split(os.pathsep)[0]
         logs = [os.path.join(td, f"worker{pid}.log") for pid in range(n_procs)]
         procs = []
         for pid in range(n_procs):
@@ -429,11 +690,7 @@ def run_multiprocess_check(
                         pass
         if failed is not None:
             pid, rc = failed
-            try:
-                with open(logs[pid]) as fh:
-                    tail = fh.read()[-1500:]
-            except OSError:
-                tail = "<no worker log>"
+            tail = _log_tail(logs[pid], 1500)
             raise DistributedCheckError(
                 f"worker {pid} of {n_procs} "
                 f"{'timed out' if rc is None else f'died (rc={rc})'} — "
@@ -467,6 +724,453 @@ def run_multiprocess_check(
     for i, reason in dropped.items():
         results[i] = _dropped_result(workload, reason)
     return results, info
+
+
+def _worker_env(platform: str | None, devices_per_proc: int) -> dict:
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = platform or "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={devices_per_proc}"
+    )
+    repo = str(Path(__file__).resolve().parent.parent.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def degraded_active(deg: dict | None) -> bool:
+    """True when a ``degraded`` provenance dict records any actual
+    degradation (deaths, requeues, quarantines, wedge kills) — the
+    no-fault elastic run carries the dict with everything empty."""
+    if not deg:
+        return False
+    return bool(
+        deg.get("dead_workers")
+        or deg.get("requeued_stripes")
+        or deg.get("quarantined_stripes")
+        or deg.get("wedged_killed")
+        or deg.get("quarantined_histories")
+    )
+
+
+def _log_tail(path: str, limit: int = 800) -> str:
+    try:
+        with open(path) as fh:
+            return fh.read()[-limit:]
+    except OSError:
+        return "<no worker log>"
+
+
+def _run_elastic_check(
+    workload: str,
+    paths,
+    n_procs: int,
+    *,
+    devices_per_proc: int,
+    chunk: int,
+    lanes: int | None,
+    mesh: bool,
+    reduce: bool,
+    timeout_s: float,
+    cache_dir: str | None,
+    platform: str | None,
+    stripe_timeout_s: float | None,
+    max_stripe_retries: int,
+    _proc_hook,
+    backoff_s: float = 0.5,
+    **opts,
+) -> tuple[list | dict, dict]:
+    """The elastic launcher: spool-directory tasks, survivor requeue,
+    per-stripe deadlines, quarantine past the retry budget, and a
+    merged verdict with ``degraded`` provenance.  See
+    :func:`run_multiprocess_check` for the contract."""
+    import signal
+    import tempfile
+
+    from jepsen_tpu.obs import metrics as obs_metrics
+    from jepsen_tpu.obs import trace as obs_trace
+    from jepsen_tpu.parallel.pipeline import _lane_census
+
+    paths = [str(p) for p in paths]
+    if n_procs < 1:
+        raise ValueError(f"n_procs must be >= 1, got {n_procs}")
+    kept, sizes, dropped = _lane_census(paths, workload)
+    if stripe_timeout_s is None:
+        stripe_timeout_s = min(timeout_s, 300.0)
+
+    with tempfile.TemporaryDirectory(prefix="jt_dist_") as td:
+        spool = Path(td)
+        tasks_d, claims_d, res_d = (
+            spool / "tasks", spool / "claims", spool / "results",
+        )
+        for d in (tasks_d, claims_d, res_d):
+            d.mkdir()
+        manifest = {
+            "workload": workload,
+            "paths": [paths[i] for i in kept],
+            "sizes": sizes,
+            "chunk": chunk,
+            "lanes": lanes,
+            "mesh": mesh,
+            "reduce": reduce,
+            "cache_dir": cache_dir,
+            "opts": opts,
+            "elastic": True,
+        }
+        mpath = spool / "manifest.json"
+        _write_json_atomic(mpath, manifest)
+        stripes = assign_stripes(sizes, n_procs)
+        stripe_indices = {p: sorted(stripes[p]) for p in range(n_procs)}
+        for p in range(n_procs):
+            _write_json_atomic(
+                tasks_d / f"t{p}.json",
+                {
+                    "task": p,
+                    "indices": stripe_indices[p],
+                    "retries": 0,
+                    "not_before": 0.0,
+                },
+            )
+
+        env = _worker_env(platform, devices_per_proc)
+        repo = env["PYTHONPATH"].split(os.pathsep)[0]
+        logs = [
+            os.path.join(td, f"worker{pid}.log") for pid in range(n_procs)
+        ]
+        procs = []
+        for pid in range(n_procs):
+            lf = open(logs[pid], "w")
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-m",
+                        "jepsen_tpu.parallel.distributed",
+                        "--worker", "--elastic",
+                        "--manifest", str(mpath),
+                        "--spool", str(spool),
+                        "--process-id", str(pid),
+                        "--num-processes", str(n_procs),
+                    ],
+                    stdout=lf,
+                    stderr=subprocess.STDOUT,
+                    cwd=repo,
+                    env=env,
+                )
+            )
+            lf.close()
+        if _proc_hook is not None:
+            _proc_hook(procs)
+
+        deaths: list[dict] = []
+        requeued: list[dict] = []
+        quarantined: dict[int, dict] = {}
+        stripe_attempts: dict[int, list[str]] = {}
+        wedged_killed: list[int] = []
+        running = set(range(n_procs))
+        have: set[int] = set()
+        task_ids = set(range(n_procs))
+        deadline = time.monotonic() + timeout_s
+        gauge = obs_metrics.REGISTRY.gauge("dist.workers_alive")
+        gauge.set(len(running))
+        timed_out = False
+        try:
+            while True:
+                now = time.monotonic()
+                # -- liveness: any exit before `done` is a death event
+                for pid in sorted(running):
+                    rc = procs[pid].poll()
+                    if rc is None:
+                        continue
+                    running.discard(pid)
+                    gauge.set(len(running))
+                    deaths.append(
+                        {
+                            "pid": pid,
+                            "rc": rc,
+                            "log_tail": _log_tail(logs[pid], 400),
+                            "_t": now,
+                        }
+                    )
+                    obs_metrics.REGISTRY.counter(
+                        "dist.worker_deaths"
+                    ).inc()
+                    if obs_trace.is_enabled():
+                        obs_trace.event(
+                            "checker.worker_death",
+                            track="dist",
+                            args={"pid": pid, "rc": rc},
+                        )
+                    # requeue the dead worker's claimed stripes
+                    for cf in sorted(claims_d.glob(f"t*.json.p{pid}")):
+                        try:
+                            k = int(cf.name[1:].split(".", 1)[0])
+                        except ValueError:
+                            continue
+                        try:
+                            task = json.loads(cf.read_text())
+                        except (OSError, ValueError):
+                            # unreadable claim content must not orphan
+                            # the stripe — its id (filename) and indices
+                            # (manifest) still fully identify the work
+                            task = {
+                                "task": k,
+                                "indices": stripe_indices[k],
+                                "retries": len(stripe_attempts.get(k, ())),
+                                "not_before": 0.0,
+                            }
+                        cf.unlink(missing_ok=True)
+                        if (res_d / f"r{k}.json").exists():
+                            continue  # the result landed before death
+                        stripe_attempts.setdefault(k, []).append(
+                            f"worker {pid} rc={rc}"
+                        )
+                        retries = int(task.get("retries", 0)) + 1
+                        if retries > max_stripe_retries:
+                            quarantined[k] = {
+                                "stage": "worker",
+                                "attempts": list(stripe_attempts[k]),
+                                "errors": [
+                                    f"stripe {k} lost its worker "
+                                    f"{retries} times (last: pid {pid} "
+                                    f"rc={rc}); retry budget "
+                                    f"({max_stripe_retries}) exhausted"
+                                ],
+                                "retries": retries,
+                            }
+                            obs_metrics.REGISTRY.counter(
+                                "dist.stripe_quarantines"
+                            ).inc()
+                        else:
+                            task["retries"] = retries
+                            task["not_before"] = (
+                                time.time()
+                                + backoff_s * 2 ** (retries - 1)
+                            )
+                            _write_json_atomic(
+                                tasks_d / f"t{k}.json", task
+                            )
+                            requeued.append(
+                                {
+                                    "stripe": k,
+                                    "retries": retries,
+                                    "from_pid": pid,
+                                    "_t": now,
+                                }
+                            )
+                            obs_metrics.REGISTRY.counter(
+                                "dist.stripe_requeues"
+                            ).inc()
+                            if obs_trace.is_enabled():
+                                obs_trace.event(
+                                    "checker.stripe_requeue",
+                                    track="dist",
+                                    args={
+                                        "stripe": k,
+                                        "retries": retries,
+                                        "from_pid": pid,
+                                    },
+                                )
+                # -- per-stripe deadline: a wedged claim-holder (e.g.
+                # SIGSTOPped) is killed so its stripes recirculate
+                for cf in list(claims_d.glob("t*.json.p*")):
+                    try:
+                        age = time.time() - cf.stat().st_mtime
+                    except OSError:
+                        continue
+                    if age <= stripe_timeout_s:
+                        continue
+                    try:
+                        holder = int(cf.name.rsplit(".p", 1)[1])
+                    except (IndexError, ValueError):
+                        continue
+                    if holder in running and procs[holder].poll() is None:
+                        try:
+                            procs[holder].send_signal(signal.SIGKILL)
+                        except OSError:
+                            pass
+                        wedged_killed.append(holder)
+                # -- results scan (+ recovery-time evidence for
+                # requeued stripes, onto the PR-9 sketches)
+                for rf in res_d.glob("r*.json"):
+                    try:
+                        k = int(rf.name[1:-5])
+                    except ValueError:
+                        continue
+                    if k in have:
+                        continue
+                    have.add(k)
+                    for entry in requeued:
+                        if entry["stripe"] == k and "recovery_s" not in entry:
+                            entry["recovery_s"] = round(now - entry["_t"], 3)
+                            obs_metrics.REGISTRY.sketch(
+                                "dist.stripe_recovery_s"
+                            ).add(entry["recovery_s"])
+                if have | set(quarantined) >= task_ids:
+                    break
+                if not running:
+                    raise DistributedCheckError(
+                        f"all {n_procs} elastic workers died with "
+                        f"{len(task_ids - have - set(quarantined))} "
+                        f"stripe(s) unfinished — nothing left to requeue "
+                        f"onto:\n{deaths[-1]['log_tail'] if deaths else ''}"
+                    )
+                if now > deadline:
+                    timed_out = True
+                    raise DistributedCheckError(
+                        f"elastic check timed out after {timeout_s:.0f}s "
+                        f"with {len(task_ids - have - set(quarantined))} "
+                        f"stripe(s) unfinished"
+                    )
+                time.sleep(0.05)
+        finally:
+            # completion (or failure): tell the workers, give the
+            # stragglers a moment, then reap
+            try:
+                (spool / "done").touch()
+            except OSError:
+                pass
+            grace = time.monotonic() + (0.0 if timed_out else 5.0)
+            while (
+                any(pr.poll() is None for pr in procs)
+                and time.monotonic() < grace
+            ):
+                time.sleep(0.05)
+            for pr in procs:
+                if pr.poll() is None:
+                    pr.kill()
+            for pr in procs:
+                if pr.poll() is None:
+                    try:
+                        pr.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+            gauge.set(0)
+
+        shard_docs: dict[int, dict] = {}
+        for k in sorted(have):
+            try:
+                shard_docs[k] = json.loads(
+                    (res_d / f"r{k}.json").read_text()
+                )
+            except (OSError, ValueError) as e:
+                raise DistributedCheckError(
+                    f"unreadable verdict shard for stripe {k}: {e}"
+                )
+        merged, per_process = _merge_elastic(
+            manifest, shard_docs, quarantined, stripe_indices, workload,
+            reduce,
+        )
+
+    for d in deaths:
+        d.pop("_t", None)
+    for entry in requeued:
+        entry.pop("_t", None)
+        entry["completed_by"] = (
+            shard_docs[entry["stripe"]]["pid"]
+            if entry["stripe"] in shard_docs
+            else None
+        )
+    worker_quarantined = sum(
+        int(doc["stats"].get("quarantined", 0))
+        for doc in shard_docs.values()
+    )
+    degraded = {
+        "elastic": True,
+        "procs": n_procs,
+        "effective_procs": n_procs - len(deaths),
+        "dead_workers": deaths,
+        "requeued_stripes": requeued,
+        "quarantined_stripes": [
+            {
+                "stripe": k,
+                "indices": [kept[i] for i in stripe_indices[k]],
+                "evidence": ev,
+            }
+            for k, ev in sorted(quarantined.items())
+        ],
+        "wedged_killed": sorted(set(wedged_killed)),
+        "quarantined_histories": worker_quarantined
+        + sum(len(stripe_indices[k]) for k in quarantined),
+    }
+    info = {
+        "n_procs": n_procs,
+        "devices_per_proc": devices_per_proc,
+        "dropped": len(dropped),
+        "per_process": per_process,
+        "elastic": True,
+        "degraded": degraded,
+    }
+    if reduce:
+        verdict = merged
+        verdict["dropped"] += len(dropped)
+        if verdict["first_invalid"] >= 0:
+            verdict["first_invalid"] = kept[verdict["first_invalid"]]
+        return verdict, info
+    results: list = [None] * len(paths)
+    from jepsen_tpu.parallel.pipeline import (
+        _dropped_result,
+        _quarantined_result,
+    )
+
+    for k, doc in shard_docs.items():
+        for i, r in zip(doc["indices"], doc["results"]):
+            results[kept[i]] = r
+    for k, ev in quarantined.items():
+        for i in stripe_indices[k]:
+            results[kept[i]] = _quarantined_result(workload, ev)
+    for i, reason in dropped.items():
+        results[i] = _dropped_result(workload, reason)
+    return results, info
+
+
+def _merge_elastic(
+    man: dict,
+    shard_docs: dict[int, dict],
+    quarantined: dict[int, dict],
+    stripe_indices: dict[int, list[int]],
+    workload: str,
+    reduce: bool,
+):
+    """Assemble per-stripe verdict shards + quarantined stripes into
+    one verdict set (kept-manifest index space) and the per-process
+    stats rows."""
+    per: dict[int, dict] = {}
+    for k, doc in sorted(shard_docs.items()):
+        row = per.setdefault(
+            doc["pid"],
+            {"pid": doc["pid"], "checked": 0, "wall_s": 0.0, "lanes": 0,
+             "dropped": 0, "quarantined": 0, "stripes": []},
+        )
+        row["checked"] += len(doc["indices"])
+        row["wall_s"] += float(doc["stats"].get("wall_s", 0.0))
+        row["lanes"] = max(
+            row["lanes"], int(doc["stats"].get("lanes", 0))
+        )
+        row["dropped"] += int(doc["stats"].get("dropped", 0))
+        row["quarantined"] += int(doc["stats"].get("quarantined", 0))
+        row["stripes"].append(k)
+    per_process = [per[p] for p in sorted(per)]
+    if not reduce:
+        return None, per_process
+    merged = {
+        "histories": 0, "invalid": 0, "first_invalid": -1,
+        "quarantined": 0, "dropped": 0,
+    }
+    for k, doc in sorted(shard_docs.items()):
+        r = doc["results"]
+        merged["histories"] += r["histories"]
+        merged["invalid"] += r["invalid"]
+        merged["quarantined"] += r.get("quarantined", 0)
+        merged["dropped"] += r.get("dropped", 0)
+        g = r.get("first_invalid", -1)
+        if g >= 0 and (
+            merged["first_invalid"] < 0 or g < merged["first_invalid"]
+        ):
+            merged["first_invalid"] = g
+    for k in quarantined:
+        merged["histories"] += len(stripe_indices[k])
+        merged["quarantined"] += len(stripe_indices[k])
+    return merged, per_process
 
 
 if __name__ == "__main__":

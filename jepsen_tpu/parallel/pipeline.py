@@ -36,13 +36,22 @@ same overlap discipline a training stack applies to data loading
   flight; the executor blocks on the OLDEST dispatch, so the device
   works through chunk k while the host packs chunk k+1.
 
-Crash semantics: a stage failure on ANY chunk aborts the whole run with
-:class:`PipelineError` — no verdict is returned for the failed chunk,
-any later chunk, or any earlier chunk (partial results never escape, so
-a caller can never mistake a crashed run's prefix for a full verdict
-set).  ``tests/test_pipeline.py`` holds the differential contract
-(pipelined ≡ serial for every family, including degenerate-history
-host-fallback splices) and the crash-mid-pipeline proof.
+Crash semantics are ELASTIC by default (PR 13): failure isolation is
+work-unit-granular, not run-granular.  A chunk whose
+produce/place/dispatch/collect raises is retried once (on another lane
+when one exists), then QUARANTINED — ``check_sources`` isolates the
+quarantined chunk per history (each member re-runs alone, so one poison
+history cannot condemn its chunk-mates) and the crasher(s) report
+``unknown`` with the captured exception as evidence while every other
+history's verdict survives.  A quarantine can never fold into ``valid``
+(the composed verdict is at best ``unknown``; ``invalid`` still trumps
+everything — the PR-8 precedence rule).  ``fail_fast=True`` restores
+the PR-4 contract verbatim: a stage failure on ANY chunk aborts the
+whole run with :class:`PipelineError` and NO verdicts — partial results
+never escape.  ``tests/test_pipeline.py`` holds the differential
+contract (pipelined ≡ serial for every family, including
+degenerate-history host-fallback splices) and both crash contracts;
+``tests/test_elastic.py`` holds the poison-history quarantine proofs.
 """
 
 from __future__ import annotations
@@ -51,6 +60,7 @@ import dataclasses
 import queue
 import threading
 import time
+import traceback
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable, Sequence
@@ -66,7 +76,59 @@ DEFAULT_CHUNK = 64
 
 
 class PipelineError(RuntimeError):
-    """A pipeline stage crashed; no verdicts were emitted."""
+    """A pipeline stage crashed; no verdicts were emitted (the
+    ``fail_fast=True`` contract — elastic runs quarantine instead)."""
+
+
+def _scrub_exc(e):
+    """Drop frame locals from a captured exception's traceback before
+    retaining it as quarantine evidence: the produce/place/check frames
+    pin whole packed batches and device trees, and the evidence only
+    ever formats the exception chain, never the frames.  Walks the
+    whole __cause__/__context__ chain — an exception raised while
+    handling another still carries the original's frames."""
+    seen: set[int] = set()
+    cur = e if isinstance(e, BaseException) else None
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        try:
+            traceback.clear_frames(cur.__traceback__)
+        except Exception:  # pragma: no cover - evidence must never raise
+            pass
+        cur = cur.__cause__ or cur.__context__
+    return e
+
+
+class Quarantined:
+    """The final 'collected result' of a work unit (or single history)
+    whose stage failures exhausted the retry budget: the executor keeps
+    going and this object carries the captured evidence in the unit's
+    result slot.  ``check_sources`` turns it into explicit
+    ``unknown``-with-evidence verdict entries — a quarantine is always
+    visible, never a silent drop, and can never fold into ``valid``."""
+
+    __slots__ = ("index", "stage", "attempts", "errors")
+
+    def __init__(self, index: int, stage: str, attempts, errors):
+        self.index = index
+        self.stage = stage
+        self.attempts = list(attempts)
+        self.errors = [_scrub_exc(e) for e in errors]
+
+    def evidence(self) -> dict:
+        return {
+            "stage": self.stage,
+            "attempts": self.attempts,
+            "errors": [
+                f"{type(e).__name__}: {e}" for e in self.errors
+            ],
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (
+            f"Quarantined(unit={self.index}, stage={self.stage!r}, "
+            f"errors={self.evidence()['errors']})"
+        )
 
 
 def _counter_field(name: str, cast=int, **labels):
@@ -118,6 +180,8 @@ class PipelineStats:
     batches = _counter_field("pipeline.batches")
     histories = _counter_field("pipeline.histories")
     dropped = _counter_field("pipeline.files_dropped")
+    quarantined = _counter_field("pipeline.quarantined")
+    unit_retries = _counter_field("pipeline.unit_retries")
     produce_busy_s = _counter_field(
         "pipeline.stage_busy_s", cast=float, stage="produce"
     )
@@ -155,6 +219,38 @@ class PipelineStats:
         self.add_busy(stage, t0, time.perf_counter(), track=track)
         return out
 
+    def note_retry(
+        self, stage: str, index: int, exc: BaseException,
+        lane: int | None = None,
+    ) -> None:
+        """One work-unit retry (elastic mode): run-scoped + global
+        counters, and a flight-recorder event when the tracer is on —
+        the requeue is countable after the run, never just a log line."""
+        self.metrics.counter("pipeline.unit_retries").inc()
+        obs_metrics.REGISTRY.counter("pipeline.unit_retries").inc()
+        if obs_trace.is_enabled():
+            obs_trace.event(
+                "checker.unit_retry",
+                args={
+                    "unit": index,
+                    "stage": stage,
+                    "lane": lane,
+                    "error": f"{type(exc).__name__}: {exc}",
+                },
+            )
+
+    def note_quarantine(self, evidence: dict, histories: int = 1) -> None:
+        """``histories`` final quarantined verdicts (elastic mode):
+        counted per HISTORY in the run-scoped and global registries,
+        plus a flight-recorder event carrying the evidence."""
+        self.metrics.counter("pipeline.quarantined").inc(histories)
+        obs_metrics.REGISTRY.counter("pipeline.quarantined").inc(histories)
+        if obs_trace.is_enabled():
+            obs_trace.event(
+                "checker.quarantine",
+                args={"histories": histories, **evidence},
+            )
+
     def check_batch_quantile(self, q: float) -> float:
         return self.metrics.sketch("pipeline.check_batch_s").quantile(q)
 
@@ -173,12 +269,32 @@ class PipelineStats:
 
 
 _STOP = object()
+_UNSET = object()
 
 
 class _Crash:
     def __init__(self, index: int, exc: BaseException):
         self.index = index
         self.exc = exc
+
+
+class _Poison:
+    """Producer → consumer marker (elastic mode): item ``index``'s
+    produce stage failed past its retry; quarantine it and keep going."""
+
+    def __init__(self, index: int, stage: str, errors):
+        self.index = index
+        self.stage = stage
+        self.errors = list(errors)
+
+
+def _default_collect(raw):
+    """The collect contract every executor and the serial oracle share:
+    block on the device tree, then convert it to host numpy."""
+    import jax
+
+    jax.block_until_ready(raw)
+    return jax.tree.map(np.asarray, raw)
 
 
 def run_pipeline(
@@ -189,6 +305,7 @@ def run_pipeline(
     place: Callable[[Any], Any] | None = None,
     collect: Callable[[Any], Any] | None = None,
     depth: int = 2,
+    fail_fast: bool = False,
 ) -> tuple[list[Any], PipelineStats]:
     """Run ``items`` through produce → place → check with overlap.
 
@@ -200,22 +317,46 @@ def run_pipeline(
     conversion), keeping at most ``depth`` dispatches outstanding.
 
     Returns ``(results, stats)`` with one collected result per item, in
-    order.  Any stage exception aborts with :class:`PipelineError` and
-    NO results (see module docstring).
+    order.  Failure isolation is per work unit by default: a stage
+    exception on item k is retried once, then item k's result slot
+    holds a :class:`Quarantined` carrying the evidence while every
+    other item completes.  ``fail_fast=True`` restores the abort-all
+    contract: any stage exception raises :class:`PipelineError` and NO
+    results escape (see module docstring).
     """
     import jax
 
     if place is None:
         place = jax.device_put
     if collect is None:
-        def collect(raw):
-            jax.block_until_ready(raw)
-            return jax.tree.map(np.asarray, raw)
+        collect = _default_collect
 
     stats = PipelineStats()
     n = len(items)
     if n == 0:
         return [], stats
+    t_start = time.perf_counter()
+    results: list[Any] = [None] * n
+    if fail_fast:
+        _run_pipeline_failfast(
+            items, produce, check, place, collect, depth, stats, results,
+            t_start,
+        )
+    else:
+        _run_pipeline_elastic(
+            items, produce, check, place, collect, depth, stats, results,
+            t_start,
+        )
+    stats.batches = n
+    stats.wall_s = time.perf_counter() - t_start
+    return results, stats.finalize()
+
+
+def _run_pipeline_failfast(
+    items, produce, check, place, collect, depth, stats, results, t_start
+) -> None:
+    """The PR-4 abort-all executor: any stage exception raises
+    :class:`PipelineError`, partial results never escape."""
     q: queue.Queue = queue.Queue(maxsize=max(1, depth))
     abort = threading.Event()
 
@@ -241,13 +382,11 @@ def run_pipeline(
         except BaseException as e:  # noqa: BLE001 - re-raised by consumer
             put(_Crash(i, e))
 
-    t_start = time.perf_counter()
     prod = threading.Thread(
         target=producer, name="pipeline-producer", daemon=True
     )
     prod.start()
 
-    results: list[Any] = [None] * n
     in_flight: list[tuple[int, Any, float]] = []  # (index, raw, dispatch_t)
     last_ready = t_start
 
@@ -293,9 +432,143 @@ def run_pipeline(
         abort.set()
         prod.join(timeout=10.0)
 
-    stats.batches = n
-    stats.wall_s = time.perf_counter() - t_start
-    return results, stats.finalize()
+
+def _run_pipeline_elastic(
+    items, produce, check, place, collect, depth, stats, results, t_start
+) -> None:
+    """Work-unit-granular failure isolation, single-lane shape: a
+    failing stage is retried once in place (one producer, one consumer
+    — there is no other lane to move to), then the item quarantines and
+    every other item's verdict survives."""
+    q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+    abort = threading.Event()
+
+    def put(obj) -> None:
+        while not abort.is_set():
+            try:
+                q.put(obj, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def producer() -> None:
+        i = 0
+        try:
+            for i, item in enumerate(items):
+                if abort.is_set():
+                    return
+                errors: list[BaseException] = []
+                host = _UNSET
+                for attempt in range(2):
+                    try:
+                        host = stats.run_stage("produce", produce, item)
+                        break
+                    except Exception as e:
+                        errors.append(e)
+                        if attempt == 0:
+                            stats.note_retry("produce", i, e)
+                if host is _UNSET:
+                    put(_Poison(i, "produce", errors))
+                else:
+                    put((i, host))
+            put(_STOP)
+        except BaseException as e:  # noqa: BLE001 - re-raised by consumer
+            # SystemExit-class: quarantine is for failures, not for
+            # cancellation — crash loud, same as the fail-fast path
+            put(_Crash(i, e))
+
+    prod = threading.Thread(
+        target=producer, name="pipeline-producer", daemon=True
+    )
+    prod.start()
+
+    in_flight: list[tuple[int, Any, float]] = []
+    last_ready = t_start
+
+    def drain_one() -> None:
+        nonlocal last_ready
+        i, raw, t_disp = in_flight.pop(0)
+        errors: list[BaseException] = []
+        got = _UNSET
+        for attempt in range(2):
+            try:
+                # a dispatch error surfaces here (async programs raise
+                # at block time)
+                got = collect(raw)
+                break
+            except Exception as e:
+                errors.append(e)
+                if attempt == 0:
+                    stats.note_retry("collect", i, e)
+                    # a failed dispatch poisons the raw tree — blocking
+                    # on it again just re-raises, so the one genuine
+                    # retry re-runs the whole chain from items[i]
+                    # (deterministic; retaining the packed host tree
+                    # for this rare path would pin `depth` full chunks
+                    # of host memory on the no-fault hot path)
+                    try:
+                        raw = check(
+                            stats.run_stage(
+                                "place",
+                                place,
+                                stats.run_stage("produce", produce, items[i]),
+                            )
+                        )
+                    except Exception as e2:
+                        errors.append(e2)
+                        break
+        if got is _UNSET:
+            results[i] = Quarantined(i, "collect", ["main"], errors)
+            last_ready = time.perf_counter()
+            return
+        results[i] = got
+        t_ready = time.perf_counter()
+        stats.add_busy("check", max(t_disp, last_ready), t_ready)
+        last_ready = t_ready
+
+    try:
+        while True:
+            got = q.get()
+            if got is _STOP:
+                break
+            if isinstance(got, _Crash):
+                raise PipelineError(
+                    f"pipeline produce stage crashed on batch "
+                    f"{got.index}: {type(got.exc).__name__}: {got.exc}"
+                ) from got.exc
+            if isinstance(got, _Poison):
+                results[got.index] = Quarantined(
+                    got.index, got.stage, ["producer"], got.errors
+                )
+                continue
+            i, host = got
+            errors = []
+            raw = _UNSET
+            stage = "place"
+            for attempt in range(2):
+                try:
+                    stage = "place"
+                    placed = stats.run_stage("place", place, host)
+                    stage = "check"
+                    t_disp = time.perf_counter()
+                    raw = check(placed)
+                    break
+                except Exception as e:
+                    errors.append(e)
+                    if attempt == 0:
+                        stats.note_retry(stage, i, e)
+            if raw is _UNSET:
+                results[i] = Quarantined(i, stage, ["main"], errors)
+                continue
+            in_flight.append((i, raw, t_disp))
+            del placed  # the staged slot recycles once check holds it
+            while len(in_flight) >= max(1, depth):
+                drain_one()
+        while in_flight:
+            drain_one()
+    finally:
+        abort.set()
+        prod.join(timeout=10.0)
 
 
 def run_lanes(
@@ -303,6 +576,7 @@ def run_lanes(
     fams: Sequence["_Family"],
     *,
     depth: int = 2,
+    fail_fast: bool = False,
 ) -> tuple[list[Any], PipelineStats]:
     """The N-lane generalization of :func:`run_pipeline`: one lane per
     family in ``fams`` (one per addressable device), each running the
@@ -312,24 +586,35 @@ def run_lanes(
     (largest-remaining) unit, so no device waits on another lane's
     packing (steal-on-idle by construction).
 
-    Crash semantics match :func:`run_pipeline`: any lane failure aborts
-    the whole run with :class:`PipelineError` and NO results."""
-    import jax
-
+    Failure isolation matches :func:`run_pipeline`: elastic by default
+    — a unit whose stage raises is retried once on ANOTHER lane (when
+    one is alive), then its result slot holds a :class:`Quarantined`
+    while every other unit completes.  ``fail_fast=True`` restores the
+    PR-5 contract: any lane failure aborts the whole run with
+    :class:`PipelineError` and NO results."""
     n = len(units)
     results: list[Any] = [None] * n
     stats = PipelineStats(lanes=len(fams))
     if n == 0:
         return results, stats
+    if not fail_fast:
+        t_start = time.perf_counter()
+        _run_lanes_elastic(units, fams, depth, stats, results)
+        stats.wall_s = time.perf_counter() - t_start
+        stats.batches = n
+        return results, stats.finalize()
+    return _run_lanes_failfast(units, fams, depth, stats, results)
+
+
+def _run_lanes_failfast(
+    units, fams, depth, stats, results
+) -> tuple[list[Any], PipelineStats]:
+    n = len(units)
     abort = threading.Event()
     failures: list[tuple[int, BaseException]] = []
     unit_q: queue.Queue = queue.Queue()
     for k in range(n):
         unit_q.put(k)
-
-    def default_collect(raw):
-        jax.block_until_ready(raw)
-        return jax.tree.map(np.asarray, raw)
 
     def lane(i: int) -> None:
         # stage accounting goes straight through the shared stats view
@@ -337,7 +622,7 @@ def run_lanes(
         # each lane's spans on its own `laneN` track
         fam = fams[i]
         track = f"lane{i}"
-        collect = fam.collect or default_collect
+        collect = fam.collect or _default_collect
         in_flight: list[tuple[int, Any, float]] = []
         last_ready = time.perf_counter()
 
@@ -394,6 +679,168 @@ def run_lanes(
         ) from e
     stats.batches = n
     return results, stats.finalize()
+
+
+def _run_lanes_elastic(units, fams, depth, stats, results) -> None:
+    """The elastic N-lane executor: units carry their attempt history
+    ``(k, attempts)`` through the shared queue; a unit that failed on
+    lane i bounces back for a DIFFERENT live lane to retry (bounded
+    bounce so the endgame cannot spin), and a second failure
+    quarantines it.  Lanes run until every unit holds a final result —
+    a lane never exits while a retried unit could still land on it."""
+    n = len(units)
+    n_lanes = len(fams)
+    lock = threading.Lock()
+    done = threading.Event()
+    state = {"completed": 0}
+    alive = set(range(n_lanes))
+    errors_by_unit: dict[int, list[BaseException]] = {}
+    bounce: dict[int, int] = {}
+    unit_q: queue.Queue = queue.Queue()
+    for k in range(n):
+        unit_q.put((k, ()))
+
+    def finalize(k: int, value) -> None:
+        with lock:
+            results[k] = value
+            state["completed"] += 1
+            if state["completed"] >= n:
+                done.set()
+
+    def fail(k: int, stage: str, attempts, e: BaseException) -> None:
+        with lock:
+            errors_by_unit.setdefault(k, []).append(_scrub_exc(e))
+            errs = list(errors_by_unit[k])
+        if len(attempts) >= 2:
+            finalize(k, Quarantined(k, stage, list(attempts), errs))
+        else:
+            stats.note_retry(
+                stage, k, e, lane=attempts[-1] if attempts else None
+            )
+            unit_q.put((k, tuple(attempts)))
+
+    def lane(i: int) -> None:
+        fam = fams[i]
+        track = f"lane{i}"
+        collect = fam.collect or _default_collect
+        in_flight: list[tuple[int, Any, float, tuple]] = []
+        last_ready = time.perf_counter()
+        # the unit this lane holds that is in NEITHER unit_q nor
+        # in_flight nor results — the lane-level crash handler must
+        # return it to the pool or the run loses it and never finishes
+        current: tuple[int, tuple] | None = None
+
+        def drain_one() -> None:
+            nonlocal last_ready, current
+            k, raw, t_disp, attempts = in_flight.pop(0)
+            current = (k, attempts)
+            try:
+                got = collect(raw)
+            except Exception as e:
+                fail(k, "collect", attempts, e)
+                current = None
+                last_ready = time.perf_counter()
+                return
+            t_ready = time.perf_counter()
+            stats.add_busy(
+                "check", max(t_disp, last_ready), t_ready, track=track
+            )
+            last_ready = t_ready
+            finalize(k, got)
+            current = None
+
+        try:
+            while True:
+                if done.is_set() and not in_flight:
+                    break
+                try:
+                    k, attempts = unit_q.get(timeout=0.05)
+                except queue.Empty:
+                    if in_flight:
+                        drain_one()
+                    continue
+                current = (k, attempts)
+                if attempts and attempts[-1] == i:
+                    # retried unit, and THIS lane failed it: hand it to
+                    # a different live lane when one exists (bounded
+                    # bounce — after that, run it here rather than spin)
+                    with lock:
+                        others = len(alive) > 1
+                        if others and bounce.get(k, 0) < 4 * n_lanes:
+                            bounce[k] = bounce.get(k, 0) + 1
+                        else:
+                            others = False
+                    if others:
+                        unit_q.put((k, attempts))
+                        current = None
+                        time.sleep(0.01)
+                        continue
+                att = attempts + (i,)
+                stage = "produce"
+                try:
+                    host = stats.run_stage(
+                        "produce", fam.produce, units[k], track=track
+                    )
+                    stage = "place"
+                    placed = stats.run_stage(
+                        "place", fam.place, host, track=track
+                    )
+                    stage = "check"
+                    t_disp = time.perf_counter()
+                    raw = fam.check(placed)
+                except Exception as e:
+                    fail(k, stage, att, e)
+                    current = None
+                    continue
+                in_flight.append((k, raw, t_disp, att))
+                current = None
+                del placed
+                while len(in_flight) >= max(1, depth):
+                    drain_one()
+            with lock:
+                alive.discard(i)
+        except BaseException as e:  # noqa: BLE001 - executor-level crash:
+            # the lane dies; its in-flight units return to the pool, and
+            # the LAST lane out quarantines whatever is still queued so
+            # the run always terminates with one result per unit
+            if current is not None:
+                ck, catt = current
+                try:
+                    fail(ck, "lane", tuple(catt) + (i,), e)
+                except BaseException:  # noqa: BLE001 - fail() itself broke
+                    finalize(
+                        ck, Quarantined(ck, "lane", list(catt) + [i], [e])
+                    )
+            for k, _raw, _t, attempts in in_flight:
+                try:
+                    fail(k, "collect", attempts, e)
+                except BaseException:  # noqa: BLE001 - fail() itself broke
+                    finalize(
+                        k, Quarantined(k, "collect", list(attempts), [e])
+                    )
+            with lock:
+                alive.discard(i)
+                last = not alive
+            if last and not done.is_set():
+                while True:
+                    try:
+                        k, attempts = unit_q.get_nowait()
+                    except queue.Empty:
+                        break
+                    finalize(
+                        k, Quarantined(k, "lane", list(attempts) + [i], [e])
+                    )
+
+    threads_ = [
+        threading.Thread(
+            target=lane, args=(i,), name=f"lane{i}", daemon=True
+        )
+        for i in range(n_lanes)
+    ]
+    for t in threads_:
+        t.start()
+    for t in threads_:
+        t.join()
 
 
 _DONATED_CACHE: dict = {}
@@ -1402,11 +1849,34 @@ def _pad_for(chunk: int, opts: dict) -> int:
 def _merge_reduced(fam: "_Family", items, collected) -> dict:
     """Fold per-chunk two-scalar verdicts into one batch verdict dict.
     Each chunk's ``first_invalid`` is already a GLOBAL source index
-    (the device reduction pmin-ed over the chunk's gid vector)."""
-    merged = {"histories": 0, "invalid": 0, "first_invalid": -1}
+    (the device reduction pmin-ed over the chunk's gid vector).
+    Quarantined members (elastic mode) are COUNTED, never silently
+    folded: ``quarantined > 0`` forces the composed verdict to at best
+    ``unknown`` (:func:`reduced_valid`)."""
+    merged = {
+        "histories": 0, "invalid": 0, "first_invalid": -1,
+        "quarantined": 0,
+    }
     for it, col in zip(items, collected):
-        d = fam.reduce_convert(it, col)
         merged["histories"] += len(it)
+        if isinstance(col, Quarantined):
+            merged["quarantined"] += len(it)
+            continue
+        if isinstance(col, _SalvagedUnit):
+            for sub, sub_col in col.members:
+                if isinstance(sub_col, Quarantined):
+                    merged["quarantined"] += 1
+                    continue
+                d = fam.reduce_convert(sub, sub_col)
+                merged["invalid"] += d["n_invalid"]
+                g = d["first_invalid"]
+                if g >= 0 and (
+                    merged["first_invalid"] < 0
+                    or g < merged["first_invalid"]
+                ):
+                    merged["first_invalid"] = g
+            continue
+        d = fam.reduce_convert(it, col)
         merged["invalid"] += d["n_invalid"]
         g = d["first_invalid"]
         if g >= 0 and (
@@ -1414,6 +1884,135 @@ def _merge_reduced(fam: "_Family", items, collected) -> dict:
         ):
             merged["first_invalid"] = g
     return merged
+
+
+def reduced_valid(merged: dict):
+    """The composed verdict of a reduce-mode batch dict under the PR-8
+    precedence rule: ``invalid`` trumps everything; any quarantined
+    history caps the verdict at ``unknown``; only a clean batch is
+    ``True``.  A quarantine can never be folded into valid."""
+    from jepsen_tpu.checkers.protocol import UNKNOWN
+
+    if merged.get("invalid", 0) > 0:
+        return False
+    if merged.get("quarantined", 0) > 0:
+        return UNKNOWN
+    return True
+
+
+class _SalvagedUnit:
+    """A quarantined unit after per-history isolation: ``members`` is
+    one ``(single_item_unit, collected_or_Quarantined)`` pair per
+    member, in unit order."""
+
+    def __init__(self, members):
+        self.members = members
+
+
+def _salvage_unit(fam: "_Family", unit, q: Quarantined) -> _SalvagedUnit:
+    """Per-history isolation of a quarantined unit: each member re-runs
+    ALONE through the same produce → place → check → collect stages
+    (chunk of one — the sentinel pad keeps the compiled batch shape),
+    so one poison history cannot condemn its chunk-mates.  Members that
+    still crash quarantine individually, carrying both the unit-level
+    and their own evidence."""
+    collect = fam.collect or _default_collect
+    gids = _gids_of(unit)
+    members = []
+    for j in range(len(unit)):
+        sub = _Unit([unit[j]], [gids[j]])
+        stage = "produce"
+        try:
+            host = fam.produce(sub)
+            stage = "place"
+            placed = fam.place(host)
+            stage = "check"
+            raw = fam.check(placed)
+            stage = "collect"
+            col = collect(raw)
+        except Exception as e:
+            members.append(
+                (
+                    sub,
+                    Quarantined(
+                        q.index, stage, q.attempts + ["salvage"],
+                        q.errors + [e],
+                    ),
+                )
+            )
+            continue
+        members.append((sub, col))
+    return _SalvagedUnit(members)
+
+
+def _resolve_quarantines(
+    fam: "_Family", items, collected, stats: PipelineStats
+) -> list:
+    """Elastic post-pass: isolate every quarantined unit per history
+    (:func:`_salvage_unit`) and count the FINAL per-history quarantines
+    into the stats/obs registries."""
+    out = list(collected)
+    for k, col in enumerate(out):
+        if not isinstance(col, Quarantined):
+            continue
+        salvaged = _salvage_unit(fam, items[k], col)
+        n_q = sum(
+            1 for _s, c in salvaged.members if isinstance(c, Quarantined)
+        )
+        if n_q:
+            stats.note_quarantine(col.evidence(), histories=n_q)
+        out[k] = salvaged
+    return out
+
+
+def _quarantined_result(workload: str, evidence: dict) -> dict:
+    """An explicit per-history ``unknown``-with-evidence verdict for a
+    quarantined history — same shape discipline as
+    :func:`_dropped_result`: one entry per source, never a silent
+    truncation, and ``unknown`` can never compose into ``valid``."""
+    from jepsen_tpu.checkers.protocol import UNKNOWN
+
+    errs = evidence.get("errors") or ["?"]
+    row = {
+        "valid?": UNKNOWN,
+        "error": f"quarantined at {evidence.get('stage')}: {errs[-1]}",
+        "quarantined": dict(evidence),
+    }
+    if workload == "queue":
+        return {"queue": dict(row), "linear": dict(row)}
+    return {workload: dict(row)}
+
+
+def _convert_unit(
+    fam: "_Family", workload: str, unit, col, stats: PipelineStats,
+    fail_fast: bool,
+) -> list[dict]:
+    """One unit's collected result → per-history result dicts, with the
+    elastic guards: a salvaged unit converts member by member, and a
+    ``convert`` crash (the last stage outside the executor) quarantines
+    the unit's histories instead of sinking the run."""
+    if isinstance(col, _SalvagedUnit):
+        out = []
+        for sub, sub_col in col.members:
+            if isinstance(sub_col, Quarantined):
+                out.append(_quarantined_result(workload, sub_col.evidence()))
+            else:
+                out.extend(
+                    _convert_unit(
+                        fam, workload, sub, sub_col, stats, fail_fast
+                    )
+                )
+        return out
+    if fail_fast:  # the PR-4 contract: a convert crash propagates raw
+        return fam.convert(unit, col)
+    try:
+        return fam.convert(unit, col)
+    except Exception as e:
+        q = Quarantined(-1, "convert", ["main"], [e])
+        stats.note_quarantine(q.evidence(), histories=len(unit))
+        return [
+            _quarantined_result(workload, q.evidence()) for _ in unit
+        ]
 
 
 def _dropped_result(workload: str, reason: str) -> dict:
@@ -1480,6 +2079,7 @@ def _check_sources_lanes(
     depth: int,
     lanes: int,
     reduce: bool = False,
+    fail_fast: bool = False,
     **opts,
 ):
     """N-lane bytes-to-verdict: size-aware unit balancing (largest-first
@@ -1509,6 +2109,7 @@ def _check_sources_lanes(
                     "histories": 0,
                     "invalid": 0,
                     "first_invalid": -1,
+                    "quarantined": 0,
                     "dropped": len(dropped),
                 },
                 stats,
@@ -1568,7 +2169,11 @@ def _check_sources_lanes(
             family_for(workload, device=devices[i], **opts)
             for i in range(n_lanes)
         ]
-    collected, stats = run_lanes(units, fams, depth=depth)
+    collected, stats = run_lanes(
+        units, fams, depth=depth, fail_fast=fail_fast
+    )
+    if not fail_fast:
+        collected = _resolve_quarantines(fams[0], units, collected, stats)
     stats.dropped = len(dropped)
     if reduce:
         merged = _merge_reduced(fams[0], units, collected)
@@ -1577,7 +2182,7 @@ def _check_sources_lanes(
         return merged, stats
     out: list = [None] * len(sources)
     for k, (unit, col) in enumerate(zip(units, collected)):
-        conv = fams[0].convert(unit, col)
+        conv = _convert_unit(fams[0], workload, unit, col, stats, fail_fast)
         for j, r in enumerate(conv):
             out[ordered_idx[unit_indices[k][j]]] = r
     for i, reason in dropped.items():
@@ -1595,6 +2200,7 @@ def check_sources(
     depth: int = 2,
     lanes: int | None = None,
     reduce: bool = False,
+    fail_fast: bool = False,
     **opts,
 ) -> tuple[list[dict], PipelineStats]:
     """Bytes-to-verdict over ``sources`` (file paths, or pre-exploded
@@ -1616,9 +2222,18 @@ def check_sources(
 
     ``reduce=True`` (requires ``mesh``) returns the collective-reduced
     batch verdict instead of per-history results: one dict
-    ``{"histories", "invalid", "first_invalid"}`` whose scalars were
-    combined ON DEVICE (psum / index-pmin) — the host never gathers the
-    per-history verdict tensors."""
+    ``{"histories", "invalid", "first_invalid", "quarantined"}`` whose
+    scalars were combined ON DEVICE (psum / index-pmin) — the host
+    never gathers the per-history verdict tensors.
+
+    Failure isolation is ELASTIC by default: a chunk whose stage
+    raises is retried once, then isolated per history — the crasher(s)
+    report ``unknown`` with the exception as evidence (``quarantined``
+    key in the result row / reduce-dict count) while every other
+    history's verdict survives.  ``fail_fast=True`` restores the
+    abort-all :class:`PipelineError` contract; the ``serial=True``
+    triage path always fails fast (it exists to surface the first
+    error loudly)."""
     if lanes is not None and not serial:
         return _check_sources_lanes(
             workload,
@@ -1627,6 +2242,7 @@ def check_sources(
             depth=depth,
             lanes=lanes,
             reduce=reduce,
+            fail_fast=fail_fast,
             **opts,
         )
     opts = dict(opts)
@@ -1643,11 +2259,7 @@ def check_sources(
     if serial:
         import jax
 
-        def default_collect(raw):
-            jax.block_until_ready(raw)
-            return jax.tree.map(np.asarray, raw)
-
-        collect = fam.collect or default_collect
+        collect = fam.collect or _default_collect
         stats = PipelineStats()
         t0 = time.perf_counter()
         collected = []
@@ -1670,7 +2282,10 @@ def check_sources(
             place=fam.place,
             collect=fam.collect,
             depth=depth,
+            fail_fast=fail_fast,
         )
+        if not fail_fast:
+            collected = _resolve_quarantines(fam, items, collected, stats)
     if reduce:
         merged = _merge_reduced(fam, items, collected)
         merged["dropped"] = 0
@@ -1678,7 +2293,9 @@ def check_sources(
         return merged, stats
     results: list[dict] = []
     for it, col in zip(items, collected):
-        results.extend(fam.convert(it, col))
+        results.extend(
+            _convert_unit(fam, workload, it, col, stats, fail_fast or serial)
+        )
     stats.histories = len(results)
     return results, stats
 

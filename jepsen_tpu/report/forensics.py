@@ -180,6 +180,43 @@ def _cluster_window_html(
     )
 
 
+def _quarantine_note_html(results: Mapping[str, Any]) -> str:
+    """PR-13 honesty note: when the violating verdict came out of a
+    degraded/quarantine-carrying check, the forensics page must say so
+    — the violating window sits NEAR evidence the checker could not
+    judge (quarantined histories are explicit unknowns, not absent),
+    and a reader weighing the counterexample needs that context."""
+    quarantined_subs = sorted(
+        name
+        for name, r in results.items()
+        if isinstance(r, dict) and r.get("quarantined")
+    )
+    deg = results.get("degraded")
+    n_q = int((deg or {}).get("quarantined_histories", 0) or 0)
+    if not quarantined_subs and not n_q:
+        return ""
+    parts = []
+    if quarantined_subs:
+        parts.append(
+            f"sub-checker(s) {', '.join(quarantined_subs)} carry "
+            f"quarantine evidence for THIS history"
+        )
+    if n_q:
+        parts.append(
+            f"{n_q} histories of the same degraded batch were "
+            f"quarantined (dead/wedged workers or poison inputs)"
+        )
+    return (
+        f'<div class="panel"><h3><span class="verdict-unknown">'
+        f"quarantine nearby</span></h3><p>This violating window sits "
+        f"near quarantined evidence: {escape('; '.join(parts))}. "
+        f"Quarantined verdicts are explicit unknowns — the violation "
+        f"shown here is real on the judged evidence, but neighboring "
+        f"histories may be missing from the batch picture "
+        f"(results.json → degraded / quarantined).</p></div>"
+    )
+
+
 def _logpattern_html(results: Mapping[str, Any]) -> str:
     matches = logpattern_matches(results)
     if not matches:
@@ -280,6 +317,7 @@ def render_forensics(
             f"{escape(Path(str(repro_path)).name)}</a></p>"
         )
     cluster_html = _cluster_window_html(run_dir, history, flagged)
+    quarantine_html = _quarantine_note_html(results)
     logpattern_html = _logpattern_html(results)
     html = (
         f"<html><head><title>{escape(title)}</title>"
@@ -290,7 +328,7 @@ def render_forensics(
         f"{escape(', '.join(invalid_names) or '(none named)')} · "
         f"{len(flagged)} of {len(history)} ops touch violating values"
         f"</p>{repro_note}"
-        f"{cluster_html}{logpattern_html}"
+        f"{quarantine_html}{cluster_html}{logpattern_html}"
         f'<div class="panel"><h3>violating values</h3><table>'
         f"<tr><th>reason</th><th>values</th></tr>{reason_rows}"
         f"</table></div>"

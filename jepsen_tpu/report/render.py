@@ -625,6 +625,55 @@ def _sub_verdict_rows(results: Mapping[str, Any]) -> str:
     return "".join(rows)
 
 
+def _degraded_panel_html(degraded: Mapping[str, Any] | None) -> str:
+    """The PR-13 degraded-provenance row: when a ``check --procs`` run
+    completed elastically past worker deaths, results.json carries the
+    machine-readable ``degraded`` dict and the report must SHOW it — a
+    degraded verdict that renders like a clean one is the silent-fold
+    failure mode the elastic contract forbids.  Inactive provenance
+    (the no-fault elastic run) renders nothing."""
+    from jepsen_tpu.parallel.distributed import degraded_active
+
+    if not degraded_active(degraded):
+        return ""
+    dead = ", ".join(
+        f"worker {d.get('pid')} (rc={d.get('rc')})"
+        for d in degraded.get("dead_workers", ())
+    ) or "none"
+    req_rows = "".join(
+        f"<tr><td>{int(r.get('stripe', -1))}</td>"
+        f"<td>{int(r.get('from_pid', -1))} → "
+        f"{escape(str(r.get('completed_by')))}</td>"
+        f"<td>{int(r.get('retries', 0))}</td>"
+        f"<td>{escape(str(r.get('recovery_s', '-')))}</td></tr>"
+        for r in degraded.get("requeued_stripes", ())
+    )
+    n_q = int(degraded.get("quarantined_histories", 0) or 0)
+    wedged = degraded.get("wedged_killed") or []
+    return (
+        f'<div class="panel"><h3><span class="verdict-unknown">DEGRADED'
+        f"</span> check (elastic recovery)</h3>"
+        f"<p>effective workers {degraded.get('effective_procs')} of "
+        f"{degraded.get('procs')} · dead: {escape(dead)} · "
+        f"wedge-killed: {escape(', '.join(str(w) for w in wedged) or 'none')}"
+        f" · quarantined histories: {n_q}"
+        + (
+            " (their verdicts are explicit unknowns — the composed "
+            "verdict can be at best unknown)"
+            if n_q
+            else ""
+        )
+        + "</p>"
+        + (
+            f"<table><tr><th>requeued stripe</th><th>worker</th>"
+            f"<th>retries</th><th>recovery s</th></tr>{req_rows}</table>"
+            if req_rows
+            else ""
+        )
+        + "</div>"
+    )
+
+
 def render_run_report(
     run_dir: str | Path,
     history: Sequence[Op] | None = None,
@@ -696,6 +745,7 @@ def render_run_report(
     )
 
     verdict = results.get("valid?")
+    degraded_html = _degraded_panel_html(results.get("degraded"))
     summary_doc = {
         "run": run_dir.name,
         "valid?": verdict,
@@ -709,6 +759,15 @@ def render_run_report(
     }
     if cluster_doc:
         summary_doc["cluster"] = cluster_doc.get("summary")
+    if degraded_html:
+        deg = results["degraded"]
+        summary_doc["degraded"] = {
+            "procs": deg.get("procs"),
+            "effective_procs": deg.get("effective_procs"),
+            "dead_workers": len(deg.get("dead_workers") or ()),
+            "requeued_stripes": len(deg.get("requeued_stripes") or ()),
+            "quarantined_histories": deg.get("quarantined_histories", 0),
+        }
     write_artifact(
         run_dir / REPORT_JSON,
         json.dumps(summary_doc, indent=1, sort_keys=True) + "\n",
@@ -767,7 +826,8 @@ def render_run_report(
         f"p50..p99; shaded = nemesis fault windows)</h3>{lat_svg}</div>"
         f'<div class="panel"><h3>throughput (completions/s: green ok / '
         f"red fail / yellow info)</h3>{rate_svg}</div>"
-        f'<div class="panel"><h3>sub-verdicts</h3><table>'
+        + degraded_html
+        + f'<div class="panel"><h3>sub-verdicts</h3><table>'
         f"<tr><th>checker</th><th>valid?</th></tr>"
         f"{_sub_verdict_rows(results)}</table></div>"
         + cluster_html

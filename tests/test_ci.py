@@ -682,6 +682,59 @@ class TestBenchColdWarmSmoke:
         # the traced run really went through the lanes executor
         assert oo["lanes"] >= 1
 
+    def test_elastic_overhead_section_schema(self, bench):
+        """Offline gate for the ISSUE-13 ``elastic_overhead`` bench
+        schema: a tiny real elastic-vs-fail-fast pair plus real
+        kill-0 / kill-1 launcher rows must carry the ≤2% no-fault bar
+        key, prove the no-fault elastic arm quarantined NOTHING, and
+        pin the honesty rule that a zero-kill row can't claim recovery
+        (no deaths, no requeues, no recovery keys) while the kill row
+        must show a real requeue.  The fraction itself is asserted only
+        as finite here — a 24-history smoke is noise; the ≤2% claim
+        belongs to the committed full-config log."""
+        details = {}
+        bench._bench_elastic_overhead(
+            details, histories=24, base_n=8, n_ops=40, chunk=8,
+            repeats=1, kill_histories=10, kill_base_n=5, kill_ops=25,
+            kill_procs=2, kills=(0, 1), timeout_s=300.0,
+        )
+        eo = details["elastic_overhead"]
+        for key in (
+            "fail_fast_wall_s",
+            "elastic_wall_s",
+            "overhead_frac",
+            "within_2pct",
+            "quarantined_no_fault",
+            "unit_retries_no_fault",
+            "kill_recovery",
+            "histories",
+            "devices",
+            "lanes",
+            "backend",
+        ):
+            assert key in eo, f"elastic_overhead schema lost key {key!r}"
+        assert eo["histories"] == 24
+        assert eo["fail_fast_wall_s"] > 0 and eo["elastic_wall_s"] > 0
+        assert eo["overhead_frac"] == eo["overhead_frac"]  # finite
+        # the no-fault elastic arm must be genuinely no-fault
+        assert eo["quarantined_no_fault"] == 0
+        assert len(eo["kill_recovery"]) == 2
+        zero, one = eo["kill_recovery"]
+        # a zero-kill row can NEVER claim recovery
+        assert zero["kills"] == 0
+        assert zero["dead_workers"] == 0
+        assert zero["requeued_stripes"] == 0
+        assert zero["quarantined_histories"] == 0
+        assert "recovery_p50_s" not in zero
+        assert "recovery_count" not in zero
+        # the kill row really exercised the requeue path
+        assert one["kills"] == 1
+        assert one["dead_workers"] >= 1
+        assert one["requeued_stripes"] >= 1
+        assert one["recovery_count"] >= 1
+        assert one["recovery_p50_s"] > 0
+        assert one["verdicts_match_no_kill"] is True
+
     def test_cluster_obs_overhead_section_schema(self, bench):
         """Offline gate for the ISSUE-12 ``cluster_obs_overhead`` bench
         schema: a tiny REAL off-vs-on pair over a live 3-node
@@ -821,6 +874,48 @@ class TestDistributedSpawnSmoke:
                 == check_stream_lin_cpu(sh.ops)["valid?"]
             )
         assert any(r["stream"]["valid?"] is not True for r in results)
+
+
+class TestChaosHarnessSmoke:
+    """The checker-chaos harness (``tools/chaos_check.py``, ROADMAP
+    direction 5(d)) must stay runnable offline: a 2-proc spawn with one
+    deterministic mid-claim death (the die-env hook — CI must not bet
+    on wall-clock kill timing) over a tiny corpus has to complete on
+    the survivor and PASS every built-in assertion (verdicts ≡ serial
+    oracle, provenance accuracy).  The full SIGKILL/SIGSTOP modes and
+    the north-star-sized differential proof are committed capture runs
+    (``store/chaos_r13_*``), not suite work."""
+
+    def test_two_proc_kill_one_die_env_green(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "chaos_check_under_test",
+            str(REPO / "tools" / "chaos_check.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(
+            [
+                "--procs", "2",
+                "--kill", "1",
+                "--mode", "die-env",
+                "--histories", "8",
+                "--base", "4",
+                "--ops", "25",
+                "--poison", "1",
+                "--chunk", "4",
+                "--timeout", "300",
+                "--out", str(tmp_path / "chaos_smoke"),
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(
+            (tmp_path / "chaos_smoke" / "results.json").read_text()
+        )
+        assert doc["pass"] is True
+        assert doc["degraded"]["dead_workers"]
+        assert (tmp_path / "chaos_smoke" / "chaos_check.log").exists()
 
 
 class TestFuzzMatrixSmoke:

@@ -14,6 +14,11 @@ a global mesh over virtual CPU devices and failed since seed with
 Parametrized over pod shapes: 2×4 (two processes, four virtual devices
 each) and 4×2 (four processes, two devices each).  The verdicts are
 differentially checked against the serial oracle on the same files.
+
+PR 13 makes the failure contract ELASTIC by default (spool-directory
+task protocol, survivor requeue, degraded provenance) with
+``fail_fast=True`` preserving the PR-5 kill-everything contract
+verbatim — both paths are pinned below.
 """
 
 from __future__ import annotations
@@ -66,12 +71,16 @@ def test_assign_stripes_deterministic_and_balanced():
 
 
 @pytest.mark.parametrize(
-    "n_procs,devices_per_proc", [(2, 4), (4, 2)],
-    ids=["pod2x4", "pod4x2"],
+    "n_procs,devices_per_proc,fail_fast",
+    [(2, 4, True), (4, 2, False)],
+    ids=["pod2x4-failfast", "pod4x2-elastic"],
 )
 def test_multiprocess_check_matches_serial(
-    tmp_path, n_procs, devices_per_proc
+    tmp_path, n_procs, devices_per_proc, fail_fast
 ):
+    """Both launcher modes, differentially: the fail-fast
+    jax.distributed KV merge and the elastic spool-task merge must
+    produce identical verdicts to the serial oracle on a no-fault run."""
     base = synth_stream_batch(
         10, StreamSynthSpec(n_ops=30, seed=3), lost=1, duplicated=1
     )
@@ -83,12 +92,18 @@ def test_multiprocess_check_matches_serial(
         devices_per_proc=devices_per_proc,
         chunk=3,
         timeout_s=420,
+        fail_fast=fail_fast,
     )
     assert info["n_procs"] == n_procs
-    # every worker checked its deterministic share, and together they
-    # covered the corpus exactly once
+    # together the workers covered the corpus exactly once; fail-fast
+    # pins one shard per process (the deterministic stripes), elastic
+    # allows a fast worker to STEAL a sibling's stripe before it spins
+    # up (work conservation is the contract, not the ownership)
     per_proc = info["per_process"]
-    assert len(per_proc) == n_procs
+    if fail_fast:
+        assert len(per_proc) == n_procs
+    else:
+        assert 1 <= len(per_proc) <= n_procs
     assert sum(p["checked"] for p in per_proc) == len(files)
     assert all(p["lanes"] >= 1 for p in per_proc)
 
@@ -136,18 +151,55 @@ def test_multiprocess_queue_reduce_and_census(tmp_path):
     assert verdict["dropped"] == 1 and info["dropped"] == 1
 
 
-def test_dead_worker_aborts_with_no_partial_verdicts(tmp_path):
-    """The crash contract, process edition: a worker killed mid-run
-    (after joining the cluster, before publishing any verdict) aborts
-    the whole run with DistributedCheckError — no merged verdicts, no
-    partial results."""
+def test_dead_worker_elastic_completes_on_survivors(tmp_path):
+    """The crash contract, ELASTIC edition (PR 13, the default): worker
+    1 of 3 is killed mid-run — right AFTER claiming its deterministic
+    stripe, before publishing any verdict — and the run COMPLETES on
+    the survivors: the dead worker's stripe requeues, the ``degraded``
+    provenance names the dead worker and its requeued stripe, and the
+    merged verdicts are identical to the serial oracle."""
+    base = synth_stream_batch(9, StreamSynthSpec(n_ops=25, seed=5), lost=1)
+    files = _write(tmp_path, base)
+    os.environ["JEPSEN_TPU_DIST_DIE_PID"] = "1"
+    try:
+        results, info = run_multiprocess_check(
+            "stream", files, 3, chunk=3, timeout_s=300
+        )
+    finally:
+        del os.environ["JEPSEN_TPU_DIST_DIE_PID"]
+    deg = info["degraded"]
+    # the dead worker and its requeued stripes, machine-readable
+    assert any(
+        d["pid"] == 1 and d["rc"] == 42 for d in deg["dead_workers"]
+    ), deg["dead_workers"]
+    requeued = [r for r in deg["requeued_stripes"] if r["stripe"] == 1]
+    assert requeued and requeued[0]["retries"] == 1
+    assert requeued[0]["from_pid"] == 1
+    assert requeued[0]["completed_by"] in (0, 2)
+    assert requeued[0]["recovery_s"] >= 0
+    assert deg["effective_procs"] == 2
+    assert not deg["quarantined_stripes"]
+    # verdict ≡ serial oracle on every history (nothing quarantined)
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    serial, _ = check_sources("stream", files, chunk=3, serial=True)
+    assert _norm(results) == _norm(serial)
+    assert any(r["stream"]["valid?"] is not True for r in results)
+
+
+def test_dead_worker_fail_fast_aborts_with_no_partial_verdicts(tmp_path):
+    """The old crash contract, preserved VERBATIM under --fail-fast: a
+    worker killed mid-run (after joining the cluster, before publishing
+    any verdict) aborts the whole run with DistributedCheckError — no
+    merged verdicts, no partial results."""
     base = synth_stream_batch(6, StreamSynthSpec(n_ops=20, seed=5))
     files = _write(tmp_path, base)
     os.environ["JEPSEN_TPU_DIST_DIE_PID"] = "1"
     try:
         with pytest.raises(DistributedCheckError, match="worker 1"):
             run_multiprocess_check(
-                "stream", files, 2, chunk=3, timeout_s=300
+                "stream", files, 2, chunk=3, timeout_s=300,
+                fail_fast=True,
             )
     finally:
         del os.environ["JEPSEN_TPU_DIST_DIE_PID"]

@@ -1,0 +1,177 @@
+"""Elastic checker resilience (PR 13): degraded-but-honest verdicts.
+
+The quarantine contract, pinned end to end: one poison history in a
+64-history batch yields EXACTLY ONE ``unknown``-with-evidence entry and
+63 verdicts identical to the serial oracle; the composed verdict is
+downgraded from valid (a quarantine can never fold into ``valid``) and
+an ``invalid`` elsewhere in the batch still trumps it (the PR-8
+precedence rule).  Plus the distributed layer's wedge path: a
+SIGSTOP-shaped worker trips the per-stripe deadline, gets killed by the
+launcher, and its stripes complete on the survivors with accurate
+``degraded`` provenance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from jepsen_tpu.checkers.protocol import UNKNOWN, merge_valid
+from jepsen_tpu.history.store import _json_default, write_history_jsonl
+from jepsen_tpu.history.synth import (
+    StreamSynthSpec,
+    SynthSpec,
+    synth_batch,
+    synth_stream_batch,
+)
+from jepsen_tpu.parallel.pipeline import (
+    check_sources,
+    reduced_valid,
+)
+
+POISON = '{"type": "not a real op"\n'  # torn JSON line
+
+
+def _write(tmp_path, base, tag="h"):
+    files = []
+    for i, sh in enumerate(base):
+        p = tmp_path / f"{tag}{i:03d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(p)
+    return files
+
+
+def _norm(x):
+    return json.loads(json.dumps(x, default=_json_default))
+
+
+class TestPoisonHistoryQuarantine:
+    def test_one_poison_in_64_batch_yields_one_unknown(self, tmp_path):
+        """63 green histories + 1 poison, ONE 64-history chunk: exactly
+        one quarantined ``unknown`` with the exception as evidence, 63
+        correct verdicts, and the composed verdict downgraded from
+        valid to unknown."""
+        base = synth_stream_batch(63, StreamSynthSpec(n_ops=15, seed=11))
+        files = _write(tmp_path, base)
+        bad = tmp_path / "poison.jsonl"
+        bad.write_text(POISON)
+        mix = files[:31] + [bad] + files[31:]
+        res, stats = check_sources("stream", mix, chunk=64)
+        assert len(res) == 64
+        quarantined = [
+            i for i, r in enumerate(res) if "quarantined" in r["stream"]
+        ]
+        assert quarantined == [31], quarantined
+        row = res[31]["stream"]
+        assert row["valid?"] == UNKNOWN
+        assert row["quarantined"]["errors"], "evidence must be captured"
+        assert "quarantined" in row["error"]
+        serial, _ = check_sources("stream", files, chunk=64, serial=True)
+        assert [r for i, r in enumerate(res) if i != 31] == serial
+        assert all(r["stream"]["valid?"] is True for r in serial)
+        assert stats.quarantined == 1
+        # downgraded from valid: 63 greens + 1 quarantine == unknown
+        assert merge_valid(r["stream"]["valid?"] for r in res) == UNKNOWN
+
+    def test_invalid_elsewhere_still_trumps_quarantine(self, tmp_path):
+        """The precedence rule: a real violation in the batch surfaces
+        as ``invalid`` even with a quarantine present."""
+        base = synth_stream_batch(
+            15, StreamSynthSpec(n_ops=20, seed=12), lost=1
+        )
+        files = _write(tmp_path, base)
+        bad = tmp_path / "poison.jsonl"
+        bad.write_text(POISON)
+        res, _stats = check_sources("stream", files + [bad], chunk=8)
+        vals = [r["stream"]["valid?"] for r in res]
+        assert UNKNOWN in vals and False in vals
+        assert merge_valid(vals) is False
+
+    def test_queue_family_poison_quarantines_both_subverdicts(
+        self, tmp_path
+    ):
+        """The queue workload surfaces as two sub-checkers; a
+        quarantined history must report unknown on BOTH (a half-judged
+        history would read as a tighter verdict than was computed)."""
+        base = synth_batch(7, SynthSpec(n_ops=30, seed=13), lost=1)
+        files = _write(tmp_path, base)
+        bad = tmp_path / "poison.jsonl"
+        bad.write_text(POISON)
+        res, _ = check_sources("queue", files + [bad], chunk=4)
+        row = res[-1]
+        assert row["queue"]["valid?"] == UNKNOWN
+        assert row["linear"]["valid?"] == UNKNOWN
+        assert row["queue"]["quarantined"]["errors"]
+        serial, _ = check_sources("queue", files, chunk=4, serial=True)
+        assert res[:-1] == serial
+
+    def test_reduce_mode_counts_quarantines(self, cpu_devices, tmp_path):
+        """Reduce mode: the quarantined member is COUNTED in the
+        on-device-reduced verdict dict and caps :func:`reduced_valid`
+        at unknown; a seeded invalid still wins."""
+        from jepsen_tpu.parallel.mesh import checker_mesh
+
+        base = synth_stream_batch(7, StreamSynthSpec(n_ops=20, seed=14))
+        files = _write(tmp_path, base)
+        bad = tmp_path / "poison.jsonl"
+        bad.write_text(POISON)
+        merged, stats = check_sources(
+            "stream", files + [bad], chunk=4, mesh=checker_mesh(),
+            lanes=0, reduce=True,
+        )
+        assert merged["histories"] == 8
+        assert merged["quarantined"] == 1
+        assert merged["invalid"] == 0
+        assert reduced_valid(merged) == UNKNOWN
+        assert stats.quarantined == 1
+        # invalid trumps: seed a lost write into a second corpus
+        base2 = synth_stream_batch(
+            6, StreamSynthSpec(n_ops=20, seed=15), lost=1
+        )
+        files2 = _write(tmp_path, base2, tag="g")
+        merged2, _ = check_sources(
+            "stream", files2 + [bad], chunk=4, mesh=checker_mesh(),
+            lanes=0, reduce=True,
+        )
+        assert merged2["invalid"] >= 1 and merged2["quarantined"] == 1
+        assert reduced_valid(merged2) is False
+
+
+class TestElasticDistributedWedge:
+    def test_wedged_worker_killed_by_stripe_deadline(self, tmp_path):
+        """The SIGSTOP shape: worker 1 wedges after claiming its
+        stripe.  The launcher's per-stripe deadline SIGKILLs it, the
+        stripe requeues onto a survivor, the run completes with
+        verdicts ≡ serial oracle, and the provenance records the wedge
+        kill + the death + the requeue."""
+        from jepsen_tpu.parallel.distributed import run_multiprocess_check
+
+        base = synth_stream_batch(
+            6, StreamSynthSpec(n_ops=20, seed=16), lost=1
+        )
+        files = _write(tmp_path, base)
+        os.environ["JEPSEN_TPU_DIST_WEDGE_PID"] = "1"
+        try:
+            results, info = run_multiprocess_check(
+                "stream", files, 2, chunk=3, timeout_s=300,
+                stripe_timeout_s=6.0,
+            )
+        finally:
+            del os.environ["JEPSEN_TPU_DIST_WEDGE_PID"]
+        deg = info["degraded"]
+        assert 1 in deg["wedged_killed"]
+        assert any(d["pid"] == 1 for d in deg["dead_workers"])
+        assert any(
+            r["from_pid"] == 1 for r in deg["requeued_stripes"]
+        )
+        serial, _ = check_sources("stream", files, chunk=3, serial=True)
+        assert _norm(results) == _norm(serial)
+
+
+class TestReducedValid:
+    def test_precedence(self):
+        assert reduced_valid({"invalid": 0, "quarantined": 0}) is True
+        assert reduced_valid({"invalid": 0, "quarantined": 3}) == UNKNOWN
+        assert reduced_valid({"invalid": 1, "quarantined": 3}) is False

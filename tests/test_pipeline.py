@@ -4,8 +4,13 @@
 checker paths for every family — queue (both sub-verdicts), stream
 (short and 10k-op), elle (including degenerate-history host-fallback
 splices) — from history FILES, pipelined and strictly serial, warm and
-cold caches.  Plus the crash contract: a stage failure aborts the whole
-run with ``PipelineError`` and NO verdict escapes for any batch.
+cold caches.  Plus both crash contracts: under ``fail_fast=True`` a
+stage failure aborts the whole run with ``PipelineError`` and NO
+verdict escapes for any batch (preserved verbatim from PR 4); under
+the elastic default a failing chunk is retried then isolated per
+history, the crasher quarantines as an explicit ``unknown`` with
+evidence, and every other verdict survives (PR 13; the deeper proofs
+live in ``tests/test_elastic.py``).
 """
 
 from __future__ import annotations
@@ -191,6 +196,10 @@ class TestElleDifferential:
 
 
 class TestCrashContract:
+    """``fail_fast=True``: the PR-4 abort-all contract, preserved
+    verbatim.  The elastic default's quarantine contract lives in
+    :class:`TestElasticQuarantine` and ``tests/test_elastic.py``."""
+
     def test_produce_crash_emits_no_verdicts(self):
         """A crash in the host stage of batch k aborts the run with NO
         results for any batch — earlier chunks' verdicts never escape."""
@@ -206,7 +215,8 @@ class TestCrashContract:
 
         with pytest.raises(PipelineError, match="produce stage crashed"):
             run_pipeline(
-                list(range(5)), produce, lambda x: jnp.asarray(x) + 1
+                list(range(5)), produce, lambda x: jnp.asarray(x) + 1,
+                fail_fast=True,
             )
         assert produced == [0, 1]
 
@@ -223,18 +233,20 @@ class TestCrashContract:
                 list(range(4)),
                 lambda i: np.full((2,), i, np.int32),
                 check,
+                fail_fast=True,
             )
 
     def test_unpacked_batch_never_reaches_check(self, tmp_path):
-        """check_sources: a corrupt history file mid-corpus aborts the
-        whole run (no partial verdict list escapes)."""
+        """check_sources --fail-fast: a corrupt history file mid-corpus
+        aborts the whole run (no partial verdict list escapes)."""
         base = synth_stream_batch(4, StreamSynthSpec(n_ops=20))
         files = _write(tmp_path, base)
         bad = tmp_path / "h999.jsonl"
         bad.write_text('{"type": "not a real op"\n')  # torn JSON line
         with pytest.raises((PipelineError, Exception)):
             check_sources(
-                "stream", files[:2] + [bad] + files[2:], chunk=2
+                "stream", files[:2] + [bad] + files[2:], chunk=2,
+                fail_fast=True,
             )
 
     def test_crashed_producer_does_not_wedge(self):
@@ -251,7 +263,64 @@ class TestCrashContract:
                 lambda i: np.full((1,), i, np.int32),
                 check,
                 depth=1,
+                fail_fast=True,
             )
+
+
+class TestElasticQuarantine:
+    """The default (PR 13) contract: work-unit isolation — a crashing
+    chunk is retried, then isolated per history; only the crasher
+    quarantines (explicit ``unknown`` with the exception as evidence)
+    and every other verdict survives ≡ serial."""
+
+    def test_produce_crash_quarantines_only_its_item(self):
+        from jepsen_tpu.parallel.pipeline import Quarantined
+
+        def produce(i):
+            if i == 2:
+                raise RuntimeError("packer exploded")
+            return np.full((4,), i, np.int32)
+
+        import jax.numpy as jnp
+
+        res, stats = run_pipeline(
+            list(range(5)), produce, lambda x: jnp.asarray(x) + 1
+        )
+        assert isinstance(res[2], Quarantined)
+        assert res[2].stage == "produce"
+        assert "packer exploded" in res[2].evidence()["errors"][-1]
+        for i in (0, 1, 3, 4):
+            assert not isinstance(res[i], Quarantined)
+            assert int(np.asarray(res[i])[0]) == i + 1
+        # the retry is counted — requeues are evidence, not log lines
+        assert stats.unit_retries >= 1
+
+    def test_corrupt_history_mid_corpus_quarantines_one(self, tmp_path):
+        """A torn-JSON history inside a chunk quarantines exactly ITSELF
+        (per-history isolation inside the failed chunk), the other
+        members' verdicts equal the serial oracle, and the composed
+        verdict can never be valid."""
+        from jepsen_tpu.checkers.protocol import merge_valid
+
+        base = synth_stream_batch(6, StreamSynthSpec(n_ops=20), lost=1)
+        files = _write(tmp_path, base)
+        bad = tmp_path / "h999.jsonl"
+        bad.write_text('{"type": "not a real op"\n')  # torn JSON line
+        mix = files[:2] + [bad] + files[2:]
+        res, stats = check_sources("stream", mix, chunk=4)
+        assert len(res) == 7
+        assert res[2]["stream"]["valid?"] == "unknown"
+        ev = res[2]["stream"]["quarantined"]
+        assert ev["errors"], "quarantine must carry the exception"
+        serial, _ = check_sources("stream", files, chunk=4, serial=True)
+        assert [r for i, r in enumerate(res) if i != 2] == serial
+        assert stats.quarantined == 1
+        # precedence: unknown can never fold into valid; the seeded
+        # lost-write invalid still trumps it
+        assert merge_valid(r["stream"]["valid?"] for r in res) is False
+        clean = [r["stream"]["valid?"] for i, r in enumerate(res)
+                 if i == 2 or r["stream"]["valid?"] is True]
+        assert merge_valid(clean) == "unknown"
 
 
 class TestStatsAndMesh:

@@ -179,7 +179,10 @@ def test_majority_side_survives_and_heals(native_lib, cluster):
     ok = False
     while time.monotonic() < deadline and not ok:
         try:
-            ok = d.enqueue(99, 1.5)
+            # per-attempt confirm window load-scaled too: the outer
+            # deadline stretched under load while each try still gave
+            # the quorum only 1.5s — the PR-11 tier-1 flake shape
+            ok = d.enqueue(99, scaled(1.5))
         except Exception:
             time.sleep(0.1)
     assert ok, "majority side never elected a working leader"
@@ -191,7 +194,7 @@ def test_majority_side_survives_and_heals(native_lib, cluster):
     got = None
     while time.monotonic() < deadline and got is None:
         try:
-            got = d2.dequeue(1.5)
+            got = d2.dequeue(scaled(1.5))
         except Exception:
             time.sleep(0.1)
     assert got == 99
@@ -257,7 +260,9 @@ def test_seeded_bug_loses_confirmed_write_over_amqp(native_lib):
         d = _driver(native_lib, c.brokers[lead])
         d.setup()
         c.isolate(lead)
-        assert d.enqueue(666, 5.0) is True  # THE LIE
+        # the buggy confirm is local (no quorum) but the broker thread
+        # still needs CPU under a loaded box — window load-scaled
+        assert d.enqueue(666, scaled(5.0)) is True  # THE LIE
         maj = [nm for nm in c.brokers if nm != lead]
         # wait for the majority side to elect before driving it
         # (deadlines load-scaled: this one flaked under a concurrent
@@ -273,7 +278,7 @@ def test_seeded_bug_loses_confirmed_write_over_amqp(native_lib):
         ok = False
         while time.monotonic() < deadline and not ok:
             try:
-                ok = dm.enqueue(1, 1.5)
+                ok = dm.enqueue(1, scaled(1.5))
             except Exception:
                 time.sleep(0.1)
         assert ok
@@ -316,7 +321,11 @@ def test_stream_append_on_one_node_read_from_lagging_other(
     follower is made to refuse AppendEntries (its local replica provably
     lacks the records) while its client-facing read still returns them,
     because the read commits through the log at the leader.  A local-
-    snapshot regression fails this test deterministically."""
+    snapshot regression fails this test deterministically.  Deadlines
+    load-scaled (the PR-11 tier-1 flake trio: this read flaked beside
+    a concurrent soak's analysis phase — the round-4 class)."""
+    from _load import scaled
+
     a, b_node = cluster.leader(), cluster.followers()[0]
     wa = _stream_driver(native_lib, cluster.brokers[a])
     rb = _stream_driver(native_lib, cluster.brokers[b_node])
@@ -336,8 +345,8 @@ def test_stream_append_on_one_node_read_from_lagging_other(
 
     raft_b.__dict__["_on_append_entries"] = refuse
     try:
-        assert wa.append(7, 5.0) is True
-        assert wa.append(9, 5.0) is True
+        assert wa.append(7, scaled(5.0)) is True
+        assert wa.append(9, scaled(5.0)) is True
         # the lag is real: b's local replica has neither record
         assert (
             cluster.brokers[b_node].replication.machine.stream_snapshot(
@@ -345,7 +354,7 @@ def test_stream_append_on_one_node_read_from_lagging_other(
             )
             == []
         )
-        vals = [v for _off, v in rb.read_from(0, 100, 3.0)]
+        vals = [v for _off, v in rb.read_from(0, 100, scaled(3.0))]
         assert vals == [7, 9]  # ...yet b's served read is complete
     finally:
         # drop the instance shadow; the class method resumes, b catches up
@@ -591,7 +600,13 @@ def test_fenced_lock_tokens_are_raft_commit_indices(native_lib, cluster):
     """Fenced grants across the replicated cluster carry the Raft log
     index of the grant commit — strictly increasing even across a
     dead-owner REVOCATION (the shape that double-grants unfenced: the
-    reaped holder's token is superseded and its release is rejected)."""
+    reaped holder's token is superseded and its release is rejected).
+
+    Acquire/release waits ride the ``scaled()`` deadline discipline:
+    under full-suite scheduler pressure a fixed 5 s grant wait can
+    expire on a healthy cluster (the round-4 load-flake class)."""
+    from _load import scaled
+
     from jepsen_tpu.client.native import NativeMutexDriver
 
     a_node, b_node = cluster.leader(), cluster.followers()[0]
@@ -605,21 +620,21 @@ def test_fenced_lock_tokens_are_raft_commit_indices(native_lib, cluster):
     )
     a.setup()
     b.setup()
-    t1 = a.acquire_fenced(5.0)
+    t1 = a.acquire_fenced(scaled(5.0))
     assert t1 > 0
     # the token IS the replicated fence on the leader's machine
     lead = cluster.brokers[cluster.leader()].replication
     assert lead.machine.fences.get("jepsen.lock") == t1
-    assert b.acquire_fenced(5.0) == 0  # busy cluster-wide
-    assert a.release_fenced(5.0) == t1
-    t2 = b.acquire_fenced(5.0)
+    assert b.acquire_fenced(scaled(5.0)) == 0  # busy cluster-wide
+    assert a.release_fenced(scaled(5.0)) == t1
+    t2 = b.acquire_fenced(scaled(5.0))
     assert t2 > t1
     # revocation without the holder's consent: b's connection dies, the
     # close sweep requeues the grant through the log (fence advances)
     b.reconnect()
-    t3 = a.acquire_fenced(8.0)
+    t3 = a.acquire_fenced(scaled(8.0))
     assert t3 > t2
-    assert b.release_fenced(5.0) == 0  # revoked holder: not a release
-    assert a.release_fenced(5.0) == t3
+    assert b.release_fenced(scaled(5.0)) == 0  # revoked holder: not a release
+    assert a.release_fenced(scaled(5.0)) == t3
     a.close()
     b.close()

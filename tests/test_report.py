@@ -334,6 +334,103 @@ class TestForensics:
         _parse_xml(out)
 
 
+class TestDegradedProvenance:
+    """PR 13: a degraded elastic check must SHOW in the artifacts — a
+    report that renders a degraded verdict like a clean one is the
+    silent-fold failure mode the elastic contract forbids."""
+
+    _DEG = {
+        "elastic": True,
+        "procs": 3,
+        "effective_procs": 2,
+        "dead_workers": [{"pid": 1, "rc": 42, "log_tail": ""}],
+        "requeued_stripes": [
+            {"stripe": 1, "retries": 1, "from_pid": 1,
+             "completed_by": 0, "recovery_s": 0.41}
+        ],
+        "quarantined_stripes": [],
+        "wedged_killed": [],
+        "quarantined_histories": 2,
+    }
+
+    def _run(self, tmp_path, results):
+        sh = synth_batch(1, SynthSpec(n_ops=80, seed=23))[0]
+        d = tmp_path / "run"
+        d.mkdir()
+        Store(tmp_path).save_history(d, sh.ops)
+        (d / "results.json").write_text(json.dumps(results))
+        return d, sh.ops
+
+    def test_degraded_row_renders_in_report(self, tmp_path):
+        results = {
+            "valid?": "unknown",
+            "queue": {"valid?": True},
+            "degraded": self._DEG,
+        }
+        d, ops = self._run(tmp_path, results)
+        render_run_report(d, history=ops, results=results)
+        html = (d / "report.html").read_text()
+        assert "DEGRADED" in html
+        assert "worker 1 (rc=42)" in html
+        assert "quarantined histories: 2" in html
+        _parse_xml(d / "report.html")
+        s = json.loads((d / "report.json").read_text())
+        assert s["degraded"]["dead_workers"] == 1
+        assert s["degraded"]["effective_procs"] == 2
+        assert s["degraded"]["quarantined_histories"] == 2
+
+    def test_inactive_degraded_renders_nothing(self, tmp_path):
+        """The no-fault elastic run's provenance (everything empty)
+        must NOT stamp a clean report as degraded."""
+        deg = {
+            **self._DEG,
+            "effective_procs": 3,
+            "dead_workers": [],
+            "requeued_stripes": [],
+            "quarantined_histories": 0,
+        }
+        results = {
+            "valid?": True, "queue": {"valid?": True}, "degraded": deg,
+        }
+        d, ops = self._run(tmp_path, results)
+        render_run_report(d, history=ops, results=results)
+        html = (d / "report.html").read_text()
+        assert "DEGRADED" not in html
+        assert "degraded" not in json.loads(
+            (d / "report.json").read_text()
+        )
+
+    def test_forensics_notes_nearby_quarantine(self, tmp_path):
+        """An invalid verdict out of a quarantine-carrying batch gets
+        the honesty note on the forensics page."""
+        results = {
+            "valid?": False,
+            "queue": {"valid?": False, "lost": [3]},
+            "degraded": self._DEG,
+        }
+        d, ops = self._run(tmp_path, results)
+        p = render_forensics(d, history=ops, results=results)
+        assert p is not None
+        html = p.read_text()
+        assert "quarantine nearby" in html
+        assert "2 histories of the same degraded batch" in html
+        _parse_xml(p)
+
+    def test_forensics_notes_sub_checker_quarantine(self, tmp_path):
+        results = {
+            "valid?": False,
+            "stream": {"valid?": False, "lost": [5]},
+            "queue": {
+                "valid?": "unknown",
+                "quarantined": {"stage": "produce", "errors": ["boom"]},
+            },
+        }
+        d, ops = self._run(tmp_path, results)
+        p = render_forensics(d, history=ops, results=results)
+        assert p is not None
+        assert "quarantine evidence for THIS history" in p.read_text()
+
+
 class TestStoreIndex:
     def test_index_rows_trend_and_links(self, fixed_store):
         root, dirs = fixed_store

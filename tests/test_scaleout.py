@@ -7,7 +7,9 @@ native cursors must all produce verdicts IDENTICAL to the serial oracle
 host-fallback splice crossing a shard boundary — plus the lanes-path
 honesty contracts: unreadable/zero-length files are dropped loudly
 (explicit unknown entries, ``stats.dropped``), and a crashed lane
-aborts with ``PipelineError`` and no results.
+aborts with ``PipelineError`` and no results under ``fail_fast=True``;
+the elastic default retries the crashed unit on another lane, then
+quarantines it while every other unit's verdict survives (PR 13).
 """
 
 from __future__ import annotations
@@ -235,8 +237,9 @@ class TestLaneCensus:
 
 class TestLaneCrashContract:
     def test_crashed_lane_aborts_with_no_results(self, cpu_devices):
-        """A lane crash aborts the whole run: PipelineError, nothing
-        returned — the run_pipeline contract, N-lane edition."""
+        """--fail-fast: a lane crash aborts the whole run —
+        PipelineError, nothing returned (the PR-5 contract, preserved
+        verbatim under the escape hatch)."""
         import dataclasses as dc
 
         from jepsen_tpu.parallel.pipeline import _Family
@@ -256,7 +259,43 @@ class TestLaneCrashContract:
         )
         fams = [dc.replace(fam) for _ in range(4)]
         with pytest.raises(PipelineError, match="lane .* crashed"):
-            run_lanes(list(range(12)), fams, depth=2)
+            run_lanes(list(range(12)), fams, depth=2, fail_fast=True)
+
+    def test_crashed_unit_retries_on_another_lane_then_quarantines(
+        self, cpu_devices
+    ):
+        """The elastic default, N-lane edition: the crashing unit is
+        retried on a DIFFERENT lane, then quarantined; every other
+        unit's result survives."""
+        import dataclasses as dc
+
+        from jepsen_tpu.parallel.pipeline import _Family, Quarantined
+
+        def produce(unit):
+            if unit == 3:
+                raise RuntimeError("lane packer exploded")
+            return np.full((4,), unit, np.int32)
+
+        import jax.numpy as jnp
+
+        fam = _Family(
+            produce=produce,
+            check=lambda x: jnp.asarray(x) + 1,
+            place=lambda x: x,
+            convert=lambda item, col: [col],
+        )
+        fams = [dc.replace(fam) for _ in range(4)]
+        res, stats = run_lanes(list(range(12)), fams, depth=2)
+        assert isinstance(res[3], Quarantined)
+        # two attempts, on two different lanes
+        assert len(res[3].attempts) == 2
+        assert res[3].attempts[0] != res[3].attempts[1]
+        assert all(
+            not isinstance(r, Quarantined)
+            for i, r in enumerate(res)
+            if i != 3
+        )
+        assert stats.unit_retries >= 1
 
     def test_corrupt_history_mid_lanes_aborts(self, cpu_devices, tmp_path):
         base = synth_stream_batch(5, StreamSynthSpec(n_ops=20))
@@ -265,8 +304,27 @@ class TestLaneCrashContract:
         bad.write_text('{"type": "not a real op"\n')  # torn JSON line
         with pytest.raises(PipelineError):
             check_sources(
-                "stream", files[:2] + [bad] + files[2:], chunk=2, lanes=2
+                "stream", files[:2] + [bad] + files[2:], chunk=2, lanes=2,
+                fail_fast=True,
             )
+
+    def test_corrupt_history_mid_lanes_quarantines_elastically(
+        self, cpu_devices, tmp_path
+    ):
+        """Elastic lanes: the torn file quarantines alone; the other
+        histories' verdicts equal the serial oracle."""
+        base = synth_stream_batch(5, StreamSynthSpec(n_ops=20))
+        files = _write(tmp_path, base)
+        bad = tmp_path / "torn.jsonl"
+        bad.write_text('{"type": "not a real op"\n')
+        res, stats = check_sources(
+            "stream", files[:2] + [bad] + files[2:], chunk=2, lanes=2
+        )
+        assert res[2]["stream"]["valid?"] == "unknown"
+        assert "quarantined" in res[2]["stream"]
+        serial, _ = check_sources("stream", files, chunk=2, serial=True)
+        assert [r for i, r in enumerate(res) if i != 2] == serial
+        assert stats.quarantined == 1
 
 
 class TestNativeStripedCursors:

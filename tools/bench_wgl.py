@@ -168,6 +168,9 @@ def measure_hard(
             check_stage,
             place=lambda x: x,
             collect=lambda x: x,
+            # a bench wants the loud abort, not elastic quarantine —
+            # the (result, dt) unpack below cannot absorb a Quarantined
+            fail_fast=True,
         )
         (ok, unknown), times = tensor_out[0]
         pairs = collected

@@ -184,6 +184,9 @@ def capture(out_path: str = OUT_PATH) -> dict:
             kw = dict(
                 chunk=max(8, B // 4), mesh=mesh, lanes=0, reduce=True,
                 use_cache=False,
+                # a captured artifact must never carry a partially-
+                # judged corpus — crash loud rather than quarantine
+                fail_fast=True,
             )
             check_sources(fam, paths, **kw)  # warm the jitted programs
             t0 = time.perf_counter()

@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Checker-chaos harness: a nemesis pointed at the CHECKER itself
+(ROADMAP direction 5(d)) — the differential proof behind PR 13's
+elastic resilience.
+
+Builds a synthetic corpus (optionally laced with poison histories —
+torn-JSON files that crash the packer), launches the elastic
+multi-process checker (``parallel/distributed.py``), and mid-check
+SIGKILLs / SIGSTOPs ``--kill`` of the ``--procs`` workers (or uses the
+deterministic die-after-claim env hook).  Then proves, fail-loud:
+
+- every NON-quarantined history's verdict is IDENTICAL to the serial
+  oracle computed before the chaos;
+- every poison history reports ``unknown`` with the captured exception
+  as evidence (never a silent drop, never a fabricated verdict);
+- the ``degraded`` provenance is accurate: the dead/wedged workers are
+  named, their stripes' requeues recorded, quarantines counted.
+
+Artifacts land in ``--out`` (e.g. ``store/chaos_r13``): a capture log
+(``chaos_check.log``) and a machine-readable ``results.json`` carrying
+the config, the degraded provenance, and the verdict summary.  Exit 0
+only if every assertion held.
+
+Examples:
+  python tools/chaos_check.py --procs 3 --kill 1 --mode sigkill \
+      --histories 200 --ops 100 --poison 2 --out store/chaos_smoke
+  python tools/chaos_check.py --procs 3 --kill 2 --mode sigkill \
+      --histories 10000 --ops 1000 --oracle pipeline --out store/chaos_ns
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+POISON_LINE = '{"type": "not a real op"\n'  # torn JSON: crashes the parse
+
+
+class _Log:
+    def __init__(self, path: Path | None):
+        self.path = path
+        self.lines: list[str] = []
+        if path is not None:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text("")
+
+    def __call__(self, msg: str) -> None:
+        line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+        self.lines.append(line)
+        print(line, flush=True)
+        if self.path is not None:
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")
+
+
+def _build_corpus(corpus_dir: Path, args, log) -> tuple[list[str], set]:
+    """Synthesize ``--base`` real history files, replicate their paths
+    to ``--histories`` sources, and splice ``--poison`` torn-JSON files
+    at spread positions.  Returns (sources, poison_positions)."""
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.history.synth import (
+        StreamSynthSpec, SynthSpec, synth_batch, synth_stream_batch,
+    )
+
+    corpus_dir.mkdir(parents=True, exist_ok=True)
+    if args.workload == "stream":
+        base = synth_stream_batch(
+            args.base, StreamSynthSpec(n_ops=args.ops, seed=args.seed),
+            lost=1, duplicated=1,
+        )
+    else:
+        base = synth_batch(
+            args.base, SynthSpec(n_ops=args.ops, seed=args.seed),
+            lost=1, duplicated=1,
+        )
+    files = []
+    for i, sh in enumerate(base):
+        p = corpus_dir / f"h{i:04d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(str(p))
+    srcs = (files * ((args.histories + args.base - 1) // args.base))[
+        : args.histories
+    ]
+    poison_pos: set = set()
+    if args.poison:
+        step = max(1, len(srcs) // (args.poison + 1))
+        for j in range(args.poison):
+            p = corpus_dir / f"poison{j:02d}.jsonl"
+            p.write_text(POISON_LINE)
+            pos = min((j + 1) * step, len(srcs))
+            srcs.insert(pos, str(p))
+            # earlier inserts shift later positions by construction:
+            # insert left-to-right and account for the offset
+        # recompute positions after all inserts
+        poison_pos = {
+            i for i, s in enumerate(srcs) if "poison" in Path(s).name
+        }
+    log(
+        f"corpus: {len(srcs)} sources ({args.base} real files x "
+        f"{args.ops} ops, {len(poison_pos)} poison) under {corpus_dir}"
+    )
+    return srcs, poison_pos
+
+
+def _oracle(args, srcs, poison_pos, log):
+    """Pre-chaos verdicts for every non-poison source.  ``--oracle
+    serial`` is the strict single-thread serial executor;
+    ``--oracle pipeline`` is the in-process fail-fast lanes executor
+    (differentially pinned ≡ serial in tests/test_pipeline.py — the
+    honest shortcut for north-star-sized corpora)."""
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    good = [s for i, s in enumerate(srcs) if i not in poison_pos]
+    t0 = time.perf_counter()
+    if args.oracle == "serial":
+        results, _ = check_sources(
+            args.workload, good, chunk=args.chunk, serial=True,
+        )
+    else:
+        results, _ = check_sources(
+            args.workload, good, chunk=args.chunk, lanes=0,
+            fail_fast=True,
+        )
+    log(
+        f"oracle ({args.oracle}): {len(good)} histories in "
+        f"{time.perf_counter() - t0:.1f}s"
+    )
+    out: dict[int, dict] = {}
+    j = 0
+    for i in range(len(srcs)):
+        if i in poison_pos:
+            continue
+        out[i] = results[j]
+        j += 1
+    return out
+
+
+def _nemesis_hook(args, log, state):
+    """The checker-nemesis: ``--kill`` workers get SIGKILL/SIGSTOP
+    ``--kill-after`` seconds after spawn — mid-check by construction on
+    any non-trivial corpus."""
+    if args.mode == "die-env" or args.kill == 0:
+        return None
+
+    sig = signal.SIGKILL if args.mode == "sigkill" else signal.SIGSTOP
+
+    def hook(procs):
+        def nemesis():
+            time.sleep(args.kill_after)
+            victims = [p for p in range(1, len(procs))][: args.kill]
+            for pid in victims:
+                if procs[pid].poll() is None:
+                    log(
+                        f"nemesis: {args.mode.upper()} worker {pid} "
+                        f"(os pid {procs[pid].pid}) at "
+                        f"t+{args.kill_after:.1f}s"
+                    )
+                    try:
+                        procs[pid].send_signal(sig)
+                        state["signalled"].append(pid)
+                    except OSError as e:
+                        log(f"nemesis: signal failed for {pid}: {e}")
+
+        threading.Thread(target=nemesis, daemon=True).start()
+
+    return hook
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+    )
+    p.add_argument("--procs", type=int, default=3)
+    p.add_argument("--kill", type=int, default=1,
+                   help="workers to kill/stop mid-check (< --procs)")
+    p.add_argument(
+        "--mode", choices=("sigkill", "sigstop", "die-env"),
+        default="sigkill",
+        help="sigkill: hard death mid-check; sigstop: wedge (the "
+        "per-stripe deadline must fire); die-env: deterministic "
+        "die-after-claim hook (CI)",
+    )
+    p.add_argument("--kill-after", type=float, default=3.0)
+    p.add_argument("--histories", type=int, default=48)
+    p.add_argument("--base", type=int, default=16,
+                   help="distinct synthesized history files")
+    p.add_argument("--ops", type=int, default=60)
+    p.add_argument("--workload", choices=("stream", "queue"),
+                   default="stream")
+    p.add_argument("--poison", type=int, default=0,
+                   help="torn-JSON poison histories spliced mid-corpus")
+    p.add_argument("--chunk", type=int, default=64)
+    p.add_argument("--seed", type=int, default=7)
+    p.add_argument("--devices-per-proc", type=int, default=1)
+    p.add_argument("--stripe-timeout", type=float, default=15.0,
+                   help="per-stripe deadline (the SIGSTOP recovery path)")
+    p.add_argument("--timeout", type=float, default=900.0)
+    p.add_argument("--oracle", choices=("serial", "pipeline"),
+                   default="serial")
+    p.add_argument("--out", default=None,
+                   help="artifact dir (e.g. store/chaos_r13)")
+    p.add_argument("--corpus-dir", default=None,
+                   help="keep the synthesized corpus here (default: a "
+                   "temp dir — the corpus is reproducible from the "
+                   "seed and never belongs beside committed artifacts)")
+    args = p.parse_args(argv)
+    if args.kill >= args.procs:
+        p.error("--kill must leave at least one survivor (< --procs)")
+
+    out_dir = Path(args.out) if args.out else None
+    log = _Log(out_dir / "chaos_check.log" if out_dir else None)
+    log(
+        f"chaos_check: procs={args.procs} kill={args.kill} "
+        f"mode={args.mode} histories={args.histories} ops={args.ops} "
+        f"poison={args.poison} workload={args.workload} "
+        f"oracle={args.oracle} seed={args.seed}"
+    )
+
+    from jepsen_tpu.history.store import _json_default
+    from jepsen_tpu.parallel.distributed import run_multiprocess_check
+
+    def norm(x):
+        return json.loads(json.dumps(x, default=_json_default))
+
+    tmp_ctx = (
+        tempfile.TemporaryDirectory(prefix="jt_chaos_")
+        if args.corpus_dir is None
+        else None
+    )
+    corpus_dir = (
+        Path(tmp_ctx.name) if tmp_ctx else Path(args.corpus_dir)
+    )
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if cond:
+            log(f"PASS  {msg}")
+        else:
+            failures.append(msg)
+            log(f"FAIL  {msg}")
+
+    try:
+        srcs, poison_pos = _build_corpus(corpus_dir, args, log)
+        oracle = _oracle(args, srcs, poison_pos, log)
+
+        state: dict = {"signalled": []}
+        if args.mode == "die-env" and args.kill:
+            os.environ["JEPSEN_TPU_DIST_DIE_PID"] = ",".join(
+                str(q) for q in range(1, 1 + args.kill)
+            )
+        hook = _nemesis_hook(args, log, state)
+        t0 = time.perf_counter()
+        try:
+            results, info = run_multiprocess_check(
+                args.workload,
+                srcs,
+                args.procs,
+                devices_per_proc=args.devices_per_proc,
+                chunk=args.chunk,
+                timeout_s=args.timeout,
+                stripe_timeout_s=args.stripe_timeout,
+                _proc_hook=hook,
+            )
+        finally:
+            os.environ.pop("JEPSEN_TPU_DIST_DIE_PID", None)
+        wall = time.perf_counter() - t0
+        deg = info["degraded"]
+        log(
+            f"elastic check completed in {wall:.1f}s: "
+            f"{len(deg['dead_workers'])} dead, "
+            f"{len(deg['requeued_stripes'])} requeued, "
+            f"{len(deg['wedged_killed'])} wedge-killed, "
+            f"{deg['quarantined_histories']} quarantined histories"
+        )
+
+        # -- the differential proof --------------------------------
+        key = "stream" if args.workload == "stream" else "queue"
+        quarantined_idx = {
+            i for i, r in enumerate(results)
+            if isinstance(r.get(key), dict) and "quarantined" in r[key]
+        }
+        mismatches = []
+        for i, want in oracle.items():
+            if i in quarantined_idx:
+                continue  # compared below as honest unknowns
+            if norm(results[i]) != norm(want):
+                mismatches.append(i)
+        check(
+            not mismatches,
+            f"elastic verdict == {args.oracle} oracle on all "
+            f"{len(oracle) - len(quarantined_idx & set(oracle))} "
+            f"non-quarantined histories"
+            + (f" (MISMATCH at {mismatches[:5]})" if mismatches else ""),
+        )
+        for i in sorted(poison_pos):
+            row = results[i].get(key, {})
+            check(
+                row.get("valid?") == "unknown"
+                and bool(
+                    (row.get("quarantined") or {}).get("errors")
+                ),
+                f"poison history at {i} reports unknown WITH evidence",
+            )
+        good_quarantined = quarantined_idx - poison_pos
+        stripe_q = {
+            i
+            for q in deg["quarantined_stripes"]
+            for i in q["indices"]
+        }
+        check(
+            good_quarantined <= stripe_q,
+            f"every quarantined GOOD history "
+            f"({len(good_quarantined)}) is accounted for by a "
+            f"quarantined stripe in the provenance",
+        )
+        check(
+            deg["quarantined_histories"] >= len(quarantined_idx),
+            "provenance quarantine count covers the observed unknowns",
+        )
+        if args.kill:
+            if args.mode == "sigstop":
+                check(
+                    len(deg["wedged_killed"]) >= 1,
+                    f"wedged worker(s) killed by the stripe deadline: "
+                    f"{deg['wedged_killed']}",
+                )
+            check(
+                len(deg["dead_workers"]) >= args.kill,
+                f"provenance names >= {args.kill} dead worker(s): "
+                f"{[(d['pid'], d['rc']) for d in deg['dead_workers']]}",
+            )
+            check(
+                len(deg["requeued_stripes"]) >= 1,
+                f"dead workers' stripes were requeued: "
+                f"{[(r['stripe'], r['from_pid'], r.get('completed_by')) for r in deg['requeued_stripes']]}",
+            )
+            check(
+                deg["effective_procs"] < args.procs,
+                f"reduced worker count recorded "
+                f"(effective_procs={deg['effective_procs']})",
+            )
+        verdict_counts: dict = {}
+        for r in results:
+            v = str(r.get(key, {}).get("valid?"))
+            verdict_counts[v] = verdict_counts.get(v, 0) + 1
+        log(f"verdicts: {verdict_counts}")
+
+        if out_dir is not None:
+            doc = {
+                "tool": "chaos_check",
+                "pass": not failures,
+                "config": {
+                    k: v for k, v in vars(args).items() if k != "out"
+                },
+                "wall_s": round(wall, 2),
+                "histories": len(srcs),
+                "poison_positions": sorted(poison_pos),
+                "verdict_counts": verdict_counts,
+                "quarantined_positions": sorted(quarantined_idx),
+                "degraded": deg,
+                "per_process": info["per_process"],
+                "oracle": args.oracle,
+                "failures": failures,
+            }
+            (out_dir / "results.json").write_text(
+                json.dumps(doc, indent=1, default=_json_default) + "\n"
+            )
+            log(f"artifacts: {out_dir}/results.json + chaos_check.log")
+    finally:
+        if tmp_ctx is not None:
+            tmp_ctx.cleanup()
+
+    if failures:
+        log(f"CHAOS FAIL ({len(failures)} failed assertions)")
+        return 1
+    log("CHAOS PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

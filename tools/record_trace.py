@@ -115,6 +115,9 @@ def _north_star_phase(args) -> None:
         verdict, stats = check_sources(
             "queue", srcs, chunk=args.chunk, mesh=checker_mesh(), lanes=0,
             reduce=True, use_cache=False,
+            # a recorded artifact must never carry a partially-judged
+            # corpus — crash loud rather than quarantine
+            fail_fast=True,
         )
         wall = time.perf_counter() - t0
     print(

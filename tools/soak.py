@@ -234,6 +234,10 @@ def run_soak(args) -> int:
             # seq-parallel mesh over all local devices for the
             # queue/stream families (PipelinedChecker._resolved_opts)
             scale = {"mesh": True} if args.lanes is not None else {}
+            if getattr(args, "fail_fast", False):
+                # the triage escape hatch: any analysis-stage failure
+                # aborts loudly (PipelineError) instead of quarantining
+                scale["fail_fast"] = True
             if attach_pipelined_checkers(
                 test, args.workload, lanes=args.lanes, **scale
             ):
@@ -301,6 +305,18 @@ def run_soak(args) -> int:
         f"({check_sketch.count} batches)",
         flush=True,
     )
+    # elastic-analysis honesty line (ISSUE 13): a quarantined chunk in
+    # the analysis phase means part of THIS soak's history went
+    # unjudged — that must never hide inside a wall-clock summary
+    n_retries = int(REGISTRY.value("pipeline.unit_retries"))
+    n_quar = int(REGISTRY.value("pipeline.quarantined"))
+    if n_retries or n_quar:
+        print(
+            f"# soak elastic analysis: {n_retries} unit retries, "
+            f"{n_quar} QUARANTINED histories (explicit unknowns — "
+            f"re-run with --serial or --fail-fast to triage)",
+            flush=True,
+        )
     # cluster telemetry summary (ISSUE 12): the SUT's own internals —
     # who led, how many elections, tripwire count — beside the
     # checker-side sketches above
@@ -378,6 +394,11 @@ def main(argv=None) -> int:
                         "unfenced mutex)")
     p.add_argument("--attempts", type=int, default=2,
                    help="triage attempts (fresh cluster each)")
+    p.add_argument("--fail-fast", dest="fail_fast", action="store_true",
+                   help="disable the elastic per-chunk quarantine in "
+                        "the pipelined analysis: any stage failure "
+                        "aborts loudly with no verdicts (the pre-PR-13 "
+                        "contract — the triage escape hatch)")
     p.add_argument("--serial", action="store_true",
                    help="triage escape hatch: run the post-run analysis "
                         "on the classic single-thread checkers instead "

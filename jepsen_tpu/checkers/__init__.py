@@ -47,6 +47,11 @@ _LAZY = {
     "elle_mops_check": "elle",
     "elle_infer_device": "elle",
     "pack_elle_mops": "elle",
+    "pack_bits": "bitset",
+    "unpack_bits": "bitset",
+    "popcount32": "bitset",
+    "bitmat_mul_packed": "bitset",
+    "closure_packed": "bitset",
 }
 
 

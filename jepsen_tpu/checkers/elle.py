@@ -31,16 +31,25 @@ least one rw edge.  Aborted/intermediate reads are **G1a** (a read
 observes a value whose transaction definitely failed) and **G1b** (a read
 ends at a non-final append of some transaction's appends to that key).
 
-**The TPU part — cycle search as MXU work.**  The expensive phase is the
-cycle search over the transaction graph: dense boolean transitive
-closure by repeated squaring.  With ``R₀ = A ∨ I``, ``⌈log₂ T⌉``
-squarings give all-pairs reachability, and ``diag(A · R)`` marks every
-transaction on a cycle.  Each squaring is a ``[T, T]`` matmul — exactly
-what the MXU's systolic array does at peak, in bf16 with f32
-accumulation (a sum of < 2¹⁵ ones is exactly representable, and only
-``> 0`` is consulted) — ``vmap``-batched over histories × 3 edge-type
-graphs.  The CPU reference uses iterative Tarjan SCC; both report the
-same on-cycle transaction sets.
+**The TPU part — cycle search as boolean-semiring work.**  The
+expensive phase is the cycle search over the transaction graph:
+boolean transitive closure by repeated squaring.  With ``R₀ = A ∨ I``,
+``⌈log₂ T⌉`` squarings give all-pairs reachability, and ``diag(A · R)``
+marks every transaction on a cycle.  Since round 14 the DEFAULT
+representation is the **packed uint32 bitplane** (BITPACK.md): each
+squaring is a Four-Russians boolean matmul over ``[T, ⌈T/32⌉]``
+operands (``checkers/bitset.py``), the three union-graph closures
+warm-start each other and exit at their fixpoints, and the on-cycle
+diagonal is an AND against the bit-transposed closure — measured 4.5×
+the bf16 path on the CPU backend at north-star shapes.  The ``dense``
+mode keeps the bf16 MXU matmuls (f32 accumulation: a sum of < 2¹⁵
+ones is exactly representable, and only ``> 0`` is consulted) as the
+differential oracle and the seq-mesh column-sharding path, and
+``int8`` is the MXU-precision flag — select per call (``closure=``) or
+per process (``JEPSEN_TPU_ELLE_CLOSURE``); every mode reports
+identical masks, ``vmap``-batched over histories × 3 edge-type graphs.
+The CPU reference uses iterative Tarjan SCC; all report the same
+on-cycle transaction sets.
 
 **The edge inference itself also runs on device.**  ``infer_txn_graph``
 (the per-history host parse) remains the differential oracle, but the
@@ -58,6 +67,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Sequence
 
@@ -65,6 +75,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jepsen_tpu.checkers.bitset import closure_on_cycle_packed, pack_bits
 from jepsen_tpu.checkers.protocol import VALID, Checker
 from jepsen_tpu.history.ops import Op, OpF, OpType
 
@@ -443,31 +454,105 @@ def n_squarings(n_txns: int) -> int:
     return max(int(np.ceil(np.log2(max(n_txns, 2)))), 1)
 
 
-def _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns: int):
+#: closure representations: ``packed`` — uint32 bitplane Four-Russians
+#: multiply with warm-started, fixpoint-exited squaring chains
+#: (``checkers/bitset.py``; the measured winner on the CPU backend,
+#: BITPACK.md); ``dense`` — the bf16 MXU repeated-squaring kernel (the
+#: pre-round-14 path, kept as the differential oracle and the seq-mesh
+#: column-sharding path); ``int8`` — the dense structure on int8
+#: operands with int32 accumulation (the MXU-precision flag the
+#: distributed-linear-algebra paper motivates; the bench measures the
+#: honest winner per backend).
+CLOSURE_MODES = ("packed", "dense", "int8")
+
+#: default closure representation; override with
+#: ``JEPSEN_TPU_ELLE_CLOSURE=dense|int8|packed``
+DEFAULT_CLOSURE = os.environ.get("JEPSEN_TPU_ELLE_CLOSURE", "packed")
+
+
+def _resolve_closure(closure: str | None) -> str:
+    mode = DEFAULT_CLOSURE if closure is None else closure
+    if mode not in CLOSURE_MODES:
+        raise ValueError(
+            f"unknown closure mode {mode!r}; one of {CLOSURE_MODES}"
+        )
+    return mode
+
+
+def _on_cycle_int8(a: jax.Array, n_squarings: int) -> jax.Array:
+    """``_on_cycle_tensor`` with int8 operands / int32 accumulation —
+    a row sum of < 2⁷ ones would overflow int8, so the accumulator
+    dtype carries the exactness argument instead of bf16's mantissa."""
+    T = a.shape[-1]
+    eye = jnp.eye(T, dtype=jnp.int8)
+    r0 = jnp.minimum(a + eye, jnp.int8(1))
+
+    def body(_, r):
+        rr = jax.lax.dot_general(
+            r,
+            r,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        return (rr > 0).astype(jnp.int8)
+
+    r = jax.lax.fori_loop(0, n_squarings, body, r0)
+    ar = jax.lax.dot_general(
+        a, r, (((1,), (0,)), ((), ())), preferred_element_type=jnp.int32
+    )
+    return jnp.diagonal(ar, axis1=-2, axis2=-1) > 0
+
+
+def _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns: int,
+                 closure: str | None = None):
     """Shared cycle-search body: union graphs → batched transitive
     closure → per-class on-cycle masks.  Jitted by its two callers
     (``_elle_batch`` over host-packed graphs, ``_elle_mops_program``
-    fused behind the device inference)."""
+    fused behind the device inference).  ``closure`` selects the
+    representation (:data:`CLOSURE_MODES`); every mode reports
+    identical masks (``tests/test_bitpack.py``)."""
+    mode = _resolve_closure(closure)
     k = n_squarings(n_txns)
-    wwr = jnp.minimum(ww + wr, jnp.bfloat16(1))
-    alle = jnp.minimum(wwr + rw, jnp.bfloat16(1))
 
-    def one(a, m):
-        return _on_cycle_tensor(a, k) & m
+    if mode == "packed":
+        def one_packed(a_ww, a_wr, a_rw, m):
+            g0, g1c, g2 = closure_on_cycle_packed(
+                pack_bits(a_ww > 0), pack_bits(a_wr > 0),
+                pack_bits(a_rw > 0), k,
+            )
+            return g0 & m, g1c & m, g2 & m
 
-    g0 = jax.vmap(one)(ww, txn_mask)
-    g1c = jax.vmap(one)(wwr, txn_mask)
-    g2 = jax.vmap(one)(alle, txn_mask)
+        g0, g1c, g2 = jax.vmap(one_packed)(ww, wr, rw, txn_mask)
+    else:
+        if mode == "int8" and ww.dtype != jnp.int8:
+            ww, wr, rw = (x.astype(jnp.int8) for x in (ww, wr, rw))
+        wwr = jnp.minimum(ww + wr, ww.dtype.type(1))
+        alle = jnp.minimum(wwr + rw, ww.dtype.type(1))
+        cyc = _on_cycle_tensor if mode == "dense" else _on_cycle_int8
+
+        def one(a, m):
+            return cyc(a, k) & m
+
+        g0 = jax.vmap(one)(ww, txn_mask)
+        g1c = jax.vmap(one)(wwr, txn_mask)
+        g2 = jax.vmap(one)(alle, txn_mask)
     valid = ~(g0.any(-1) | g1c.any(-1) | g2.any(-1) | host_bad)
     return ElleTensors(valid=valid, g0=g0, g1c=g1c, g2=g2)
 
 
-@functools.partial(jax.jit, static_argnames=("n_txns",))
-def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int):
-    return _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns)
+@functools.partial(jax.jit, static_argnames=("n_txns", "closure"))
+def _elle_batch(ww, wr, rw, txn_mask, host_bad, n_txns: int,
+                closure: str | None = None):
+    return _elle_cycles(ww, wr, rw, txn_mask, host_bad, n_txns,
+                        closure=closure)
 
 
-def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
+def elle_tensor_check(
+    batch: ElleBatch, closure: str | None = None
+) -> ElleTensors:
+    """Cycle search over a host-packed batch.  ``closure=None`` uses
+    :data:`DEFAULT_CLOSURE`; for ``int8`` the bf16 adjacency converts
+    on device (0/1 values are exact in every dtype involved)."""
     return _elle_batch(
         batch.ww,
         batch.wr,
@@ -475,6 +560,7 @@ def elle_tensor_check(batch: ElleBatch) -> ElleTensors:
         batch.txn_mask,
         batch.host_bad,
         batch.n_txns,
+        closure=_resolve_closure(closure),
     )
 
 
@@ -981,16 +1067,18 @@ def _elle_infer_program(txn, kind, key, val, rpos, rid, alast, mask,
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "n_txns", "n_vals", "n_keys", "n_reads", "with_adjacency"
+        "n_txns", "n_vals", "n_keys", "n_reads", "with_adjacency",
+        "closure",
     ),
 )
 def _elle_mops_program(txn, kind, key, val, rpos, rid, alast, mask,
                        n_committed, n_txns, n_vals, n_keys, n_reads,
-                       with_adjacency=False):
+                       with_adjacency=False, closure=None):
     inf = _infer_fields(txn, kind, key, val, rpos, rid, alast, mask,
                         n_committed, n_txns, n_vals, n_keys, n_reads)
     tensors = _elle_cycles(
-        inf.ww, inf.wr, inf.rw, inf.txn_mask, inf.other_bad, n_txns
+        inf.ww, inf.wr, inf.rw, inf.txn_mask, inf.other_bad, n_txns,
+        closure=closure,
     )
     if not with_adjacency:
         inf = dataclasses.replace(inf, ww=None, wr=None, rw=None)
@@ -1011,15 +1099,21 @@ def elle_infer_device(mops: ElleMops) -> ElleInferred:
 
 
 def elle_mops_check(
-    mops: ElleMops, with_adjacency: bool = False
+    mops: ElleMops,
+    with_adjacency: bool = False,
+    closure: str | None = None,
 ) -> tuple[ElleTensors, ElleInferred]:
-    """The fused bytes-to-verdict device program: edge inference AND the
-    MXU cycle search in one dispatch.  By default the adjacency stays
+    """The fused bytes-to-verdict device program: edge inference AND
+    the cycle search in one dispatch.  By default the adjacency stays
     internal to the program (verdicts + anomaly masks + edge counts
     out); pass ``with_adjacency=True`` to also materialize the
-    [B, T, T] edge tensors."""
+    [B, T, T] edge tensors.  ``closure`` selects the cycle-search
+    representation (:data:`CLOSURE_MODES`; None =
+    :data:`DEFAULT_CLOSURE` — packed bitplanes)."""
     return _elle_mops_program(
-        *_mops_args(mops), with_adjacency=with_adjacency
+        *_mops_args(mops),
+        with_adjacency=with_adjacency,
+        closure=_resolve_closure(closure),
     )
 
 

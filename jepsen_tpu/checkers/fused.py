@@ -43,22 +43,26 @@ def fused_tensor_check(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("value_space", "exactly_once")
+    jax.jit, static_argnames=("value_space", "exactly_once", "packed_out")
 )
 def _combined_batch(
-    f, type_, value, mask, value_space: int, exactly_once: bool = True
+    f, type_, value, mask, value_space: int, exactly_once: bool = True,
+    packed_out: bool = False,
 ):
     return (
-        _total_queue_batch(f, type_, value, mask, value_space),
+        _total_queue_batch(f, type_, value, mask, value_space,
+                           packed_out=packed_out),
         _queue_lin_batch(
             f, type_, value, mask, value_space,
-            exactly_once=exactly_once,
+            exactly_once=exactly_once, packed_out=packed_out,
         ),
     )
 
 
 def combined_tensor_check(
-    packed: PackedHistories, delivery: str = "exactly-once"
+    packed: PackedHistories,
+    delivery: str = "exactly-once",
+    packed_out: bool = False,
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
     """Both quorum-queue verdicts as ONE XLA program (the scatter path).
 
@@ -68,7 +72,12 @@ def combined_tensor_check(
     launch overhead vs calling the two jitted programs back to back.
     This is the checker the batched-replay paths should use; the Pallas
     ``fused_tensor_check`` above is the differential twin (one explicit
-    HBM pass, currently ~10× slower than XLA's fusion of this program)."""
+    HBM pass, currently ~10× slower than XLA's fusion of this program).
+
+    ``packed_out=True`` (the pipeline default since round 14) ships the
+    per-value verdict masks as uint32 presence bitplanes — 8–32× fewer
+    verdict-output bytes, rendered into IDENTICAL result maps by the
+    ``*_to_results`` converters (``tests/test_bitpack.py``)."""
     return _combined_batch(
         packed.f,
         packed.type,
@@ -76,4 +85,5 @@ def combined_tensor_check(
         packed.mask,
         packed.value_space,
         exactly_once=delivery == "exactly-once",
+        packed_out=packed_out,
     )

@@ -59,6 +59,7 @@ FIFO queues or CAS registers) lives in ``jepsen_tpu.checkers.wgl``.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Any, Mapping, Sequence
@@ -67,6 +68,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jepsen_tpu.checkers.bitset import pack_bits, unpack_bits_np
 from jepsen_tpu.checkers.protocol import VALID, Checker
 from jepsen_tpu.history.encode import PackedHistories, pack_histories
 from jepsen_tpu.history.ops import Op, OpF, OpType
@@ -160,6 +162,26 @@ class QueueLinTensors:
     read_value_count: jax.Array  # [B] i32
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class QueueLinTensorsPacked:
+    """The packed-verdict twin of :class:`QueueLinTensors`: the four
+    per-value class masks ship as uint32 bitplanes ``[B, ceil(V/32)]``
+    (bit ``v`` of plane ``v//32`` — ``checkers/bitset.py`` layout),
+    cutting the verdict-output HBM/D2H traffic 8× against the bool
+    masks.  ``value_space`` (static) is the unpack width."""
+
+    valid: jax.Array  # [B] bool
+    duplicate: jax.Array  # [B, ceil(V/32)] uint32
+    phantom: jax.Array  # [B, ceil(V/32)] uint32
+    causality: jax.Array  # [B, ceil(V/32)] uint32
+    recovered: jax.Array  # [B, ceil(V/32)] uint32
+    read_value_count: jax.Array  # [B] i32
+    value_space: int = dataclasses.field(
+        metadata=dict(static=True), default=0
+    )
+
+
 def queue_lin_count_vectors(f, type_, value, pos, mask, value_space: int):
     """Per-history ``(a, x, s, r, t)`` vectors over the value space for one
     ``[L]`` row block: enqueue-invoke count, enqueue-fail count, earliest
@@ -186,13 +208,17 @@ def queue_lin_count_vectors(f, type_, value, pos, mask, value_space: int):
     return a, x, s, r, t
 
 
-def queue_lin_classify(a, x, s, r, t, exactly_once: bool = True) -> QueueLinTensors:
+def queue_lin_classify(
+    a, x, s, r, t, exactly_once: bool = True, packed_out: bool = False
+) -> QueueLinTensors | QueueLinTensorsPacked:
     """Vectors ``[..., V]`` → results; runs on full combined vectors.
     ``exactly_once=False`` is the at-least-once delivery contract:
     duplicates are reported but do not sink ``valid``, and a read of an
     all-attempts-failed value is *recovered* (reported, never
     invalidating — a live connection-layer ``fail`` is not the broker's
-    verdict) rather than phantom."""
+    verdict) rather than phantom.  ``packed_out=True`` ships the class
+    masks as uint32 bitplanes (:class:`QueueLinTensorsPacked`) — same
+    information, 8× fewer verdict bytes."""
     read = r >= 1
     dup = r > 1
     never_attempted = read & (a == 0)
@@ -211,21 +237,33 @@ def queue_lin_classify(a, x, s, r, t, exactly_once: bool = True) -> QueueLinTens
     valid = ~(phantom.any(-1) | causal.any(-1))
     if exactly_once:
         valid &= ~dup.any(-1)
+    rvc = read.sum(-1).astype(jnp.int32)
+    if packed_out:
+        return QueueLinTensorsPacked(
+            valid=valid,
+            duplicate=pack_bits(dup),
+            phantom=pack_bits(phantom),
+            causality=pack_bits(causal),
+            recovered=pack_bits(recovered),
+            read_value_count=rvc,
+            value_space=int(r.shape[-1]),
+        )
     return QueueLinTensors(
         valid=valid,
         duplicate=dup,
         phantom=phantom,
         causality=causal,
         recovered=recovered,
-        read_value_count=read.sum(-1).astype(jnp.int32),
+        read_value_count=rvc,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("value_space", "exactly_once")
+    jax.jit, static_argnames=("value_space", "exactly_once", "packed_out")
 )
 def _queue_lin_batch(
-    f, type_, value, mask, value_space: int, exactly_once: bool = True
+    f, type_, value, mask, value_space: int, exactly_once: bool = True,
+    packed_out: bool = False,
 ):
     pos = jnp.broadcast_to(
         jnp.arange(f.shape[-1], dtype=jnp.int32), f.shape
@@ -235,12 +273,15 @@ def _queue_lin_batch(
             ff, tt, vv, pp, mm, value_space
         )
     )(f, type_, value, pos, mask)
-    return queue_lin_classify(a, x, s, r, t, exactly_once)
+    return queue_lin_classify(a, x, s, r, t, exactly_once,
+                              packed_out=packed_out)
 
 
 def queue_lin_tensor_check(
-    packed: PackedHistories, delivery: str = "exactly-once"
-) -> QueueLinTensors:
+    packed: PackedHistories,
+    delivery: str = "exactly-once",
+    packed_out: bool = False,
+) -> QueueLinTensors | QueueLinTensorsPacked:
     return _queue_lin_batch(
         packed.f,
         packed.type,
@@ -248,17 +289,28 @@ def queue_lin_tensor_check(
         packed.mask,
         packed.value_space,
         exactly_once=delivery == "exactly-once",
+        packed_out=packed_out,
     )
 
 
-def queue_lin_tensors_to_results(t: QueueLinTensors) -> list[dict[str, Any]]:
-    """Device tensors → result maps (one per history)."""
+def queue_lin_tensors_to_results(
+    t: QueueLinTensors | QueueLinTensorsPacked,
+) -> list[dict[str, Any]]:
+    """Device tensors → result maps (one per history).  Packed and
+    dense verdict tensors render IDENTICAL maps — the packed masks
+    unpack on the host (``tests/test_bitpack.py`` pins equality)."""
+    packed = isinstance(t, QueueLinTensorsPacked)
     valid = np.asarray(t.valid)
+
+    def mask_of(x):
+        arr = np.asarray(x)
+        return unpack_bits_np(arr, t.value_space) if packed else arr
+
     masks = {
-        "duplicate": np.asarray(t.duplicate),
-        "phantom": np.asarray(t.phantom),
-        "causality": np.asarray(t.causality),
-        "recovered": np.asarray(t.recovered),
+        "duplicate": mask_of(t.duplicate),
+        "phantom": mask_of(t.phantom),
+        "causality": mask_of(t.causality),
+        "recovered": mask_of(t.recovered),
     }
     rvc = np.asarray(t.read_value_count)
     out = []

@@ -37,6 +37,7 @@ differential testing.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from collections import Counter
 from dataclasses import dataclass
@@ -46,6 +47,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jepsen_tpu.checkers.bitset import pack_bits, unpack_bits_np
 from jepsen_tpu.checkers.protocol import VALID, Checker
 from jepsen_tpu.history.encode import PackedHistories, pack_histories
 from jepsen_tpu.history.ops import Op, OpF, OpType
@@ -131,6 +133,33 @@ class TotalQueueTensors:
     recovered: jax.Array  # [B, V] i32
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class TotalQueueTensorsPacked:
+    """The packed-verdict twin of :class:`TotalQueueTensors`: the class
+    totals reduce on device (``*_count``, exactly the sums the result
+    maps report) and the per-value anomaly SETS ship as uint32
+    presence bitplanes ``[B, ceil(V/32)]`` — 32× fewer verdict bytes
+    than the int32 count vectors, with no information the result maps
+    consume lost (the host only reads nonzero positions + totals)."""
+
+    valid: jax.Array  # [B] bool
+    attempt_count: jax.Array  # [B] i32
+    acknowledged_count: jax.Array  # [B] i32
+    ok_count: jax.Array  # [B] i32
+    lost_count: jax.Array  # [B] i32
+    unexpected_count: jax.Array  # [B] i32
+    duplicated_count: jax.Array  # [B] i32
+    recovered_count: jax.Array  # [B] i32
+    lost: jax.Array  # [B, ceil(V/32)] uint32 — presence bits
+    unexpected: jax.Array  # [B, ceil(V/32)] uint32
+    duplicated: jax.Array  # [B, ceil(V/32)] uint32
+    recovered: jax.Array  # [B, ceil(V/32)] uint32
+    value_space: int = dataclasses.field(
+        metadata=dict(static=True), default=0
+    )
+
+
 def total_queue_count_vectors(
     f: jax.Array,
     type_: jax.Array,
@@ -152,15 +181,33 @@ def total_queue_count_vectors(
 
 
 def total_queue_classify(
-    a: jax.Array, e: jax.Array, d: jax.Array
-) -> TotalQueueTensors:
+    a: jax.Array, e: jax.Array, d: jax.Array, packed_out: bool = False
+) -> TotalQueueTensors | TotalQueueTensorsPacked:
     """Count vectors ``[..., V]`` → results.  Nonlinear: must run on *full*
-    (already-combined) counts."""
+    (already-combined) counts.  ``packed_out=True`` reduces the class
+    totals on device and ships presence bitplanes instead of the int32
+    count vectors (:class:`TotalQueueTensorsPacked`)."""
     ok = jnp.minimum(d, a)
     unexpected = jnp.where(a == 0, d, 0)
     duplicated = jnp.where(a > 0, jnp.maximum(d - a, 0), 0)
     lost = jnp.maximum(e - d, 0)
     recovered = jnp.maximum(ok - e, 0)
+    if packed_out:
+        return TotalQueueTensorsPacked(
+            valid=(lost.sum(-1) == 0) & (unexpected.sum(-1) == 0),
+            attempt_count=a.sum(-1),
+            acknowledged_count=e.sum(-1),
+            ok_count=ok.sum(-1),
+            lost_count=lost.sum(-1),
+            unexpected_count=unexpected.sum(-1),
+            duplicated_count=duplicated.sum(-1),
+            recovered_count=recovered.sum(-1),
+            lost=pack_bits(lost > 0),
+            unexpected=pack_bits(unexpected > 0),
+            duplicated=pack_bits(duplicated > 0),
+            recovered=pack_bits(recovered > 0),
+            value_space=int(a.shape[-1]),
+        )
     return TotalQueueTensors(
         valid=(lost.sum(-1) == 0) & (unexpected.sum(-1) == 0),
         attempt_count=a.sum(-1),
@@ -173,25 +220,34 @@ def total_queue_classify(
     )
 
 
-@functools.partial(jax.jit, static_argnames=("value_space",))
+@functools.partial(jax.jit, static_argnames=("value_space", "packed_out"))
 def _total_queue_batch(
-    f, type_, value, mask, value_space: int
-) -> TotalQueueTensors:
+    f, type_, value, mask, value_space: int, packed_out: bool = False
+) -> TotalQueueTensors | TotalQueueTensorsPacked:
     a, e, d = jax.vmap(
         lambda ff, tt, vv, mm: total_queue_count_vectors(ff, tt, vv, mm, value_space)
     )(f, type_, value, mask)
-    return total_queue_classify(a, e, d)
+    return total_queue_classify(a, e, d, packed_out=packed_out)
 
 
-def total_queue_tensor_check(packed: PackedHistories) -> TotalQueueTensors:
+def total_queue_tensor_check(
+    packed: PackedHistories, packed_out: bool = False
+) -> TotalQueueTensors | TotalQueueTensorsPacked:
     """Jittable batched check over packed histories (``vmap`` across B)."""
     return _total_queue_batch(
-        packed.f, packed.type, packed.value, packed.mask, packed.value_space
+        packed.f, packed.type, packed.value, packed.mask,
+        packed.value_space, packed_out=packed_out,
     )
 
 
-def _tensors_to_results(t: TotalQueueTensors) -> list[dict[str, Any]]:
-    """Device tensors → reference-shaped result maps (one per history)."""
+def _tensors_to_results(
+    t: TotalQueueTensors | TotalQueueTensorsPacked,
+) -> list[dict[str, Any]]:
+    """Device tensors → reference-shaped result maps (one per history).
+    Packed and dense verdict tensors render IDENTICAL maps: the class
+    totals come from the count vectors (dense) or the on-device sums
+    (packed), the anomaly sets from nonzero counts / presence bits."""
+    packed = isinstance(t, TotalQueueTensorsPacked)
     valid = np.asarray(t.valid)
     scalars = {
         k: np.asarray(getattr(t, k))
@@ -201,6 +257,14 @@ def _tensors_to_results(t: TotalQueueTensors) -> list[dict[str, Any]]:
         k: np.asarray(getattr(t, k))
         for k in ("lost", "unexpected", "duplicated", "recovered")
     }
+    if packed:
+        class_counts = {
+            k: np.asarray(getattr(t, f"{k}_count")) for k in per_value
+        }
+        per_value = {
+            k: unpack_bits_np(v, t.value_space)
+            for k, v in per_value.items()
+        }
     out = []
     for b in range(valid.shape[0]):
         r: dict[str, Any] = {VALID: bool(valid[b])}
@@ -209,7 +273,9 @@ def _tensors_to_results(t: TotalQueueTensors) -> list[dict[str, Any]]:
         r["ok-count"] = int(scalars["ok_count"][b])
         for k, arr in per_value.items():
             row = arr[b]
-            r[f"{k}-count"] = int(row.sum())
+            r[f"{k}-count"] = (
+                int(class_counts[k][b]) if packed else int(row.sum())
+            )
             r[k] = set(np.nonzero(row)[0].tolist())
         out.append(r)
     return out

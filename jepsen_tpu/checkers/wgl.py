@@ -519,9 +519,16 @@ def _make_wgl_program(model: Model, n_ops: int, capacity: int, n_cands: int):
 
 
 @functools.lru_cache(maxsize=64)
-def _wgl_program_cached(model_key, n_ops, capacity, n_cands):
+def _wgl_program_cached(model_key, n_ops, capacity, n_cands,
+                        donate: bool = False):
     cls, args = model_key
     search = _make_wgl_program(cls(*args), n_ops, capacity, n_cands)
+    if donate:
+        # staged search batches are one-shot (packed per bucket/batch,
+        # never re-read): donating them completes the round-14 "every
+        # verdict program donates its staged batch" contract on
+        # backends whose runtime can use donations
+        return jax.jit(jax.vmap(search), donate_argnums=(0, 1, 2, 3, 4))
     return jax.jit(jax.vmap(search))
 
 

@@ -41,6 +41,21 @@ sub-history whose frontier overflows (even after one capacity
 escalation) surfaces as *unknown* for the WHOLE history with the
 offending class identified.
 
+**Round 14 — the packed subset-lattice frontier** (BITPACK.md): a
+per-value queue class's model state is a *function of the linearized
+set* (present = #enq − #deq on the class's one remapped value), so its
+Wing-Gong ``(set, state)`` configurations collapse to sets and the
+whole frontier becomes ONE uint32 bitset over the ``2^n`` subset
+lattice (``checkers/bitset.py``): expansion is a masked shift per
+candidate op, the returning-op cull one AND, and there is no sort, no
+dedup, and no capacity — the lattice holds every configuration, so the
+engine is exact and can never overflow.  ``bucketize`` routes eligible
+classes (≤ :data:`PACKED_SUBSET_MAX_OPS` ops) to ``engine="subset"``
+buckets; mutex classes keep the row frontier (the holder depends on
+linearization ORDER — exactly what ``(set, state)`` pairs carry).
+Measured 10.1× the row engine at the (n=1000, w=6) hard shape on the
+CPU backend (``bench.py`` ``bitpack`` section).
+
 The mutex family's host substrate is the ``[n, 8]`` WGL cell matrix
 (:func:`wgl_cells_for` — one row per acquire/release completion with
 its interval, token, and lock key), written into the ``.jtc`` columnar
@@ -52,6 +67,7 @@ parse — the mutex family's entry into the PR-7 zero-copy substrate.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import Sequence
 
@@ -83,6 +99,17 @@ _CLASS_VALUE_SPACE = 32
 #: capacity never escalates past this; a sub-history that overflows a
 #: 1024-row frontier is *unknown* and the exact CPU search decides
 MAX_SUB_CAPACITY = 1024
+
+#: per-value queue classes with at most this many ops ride the PACKED
+#: subset-lattice frontier (engine="subset", round 14): the class's
+#: model state is a function of the linearized set (present =
+#: #enq − #deq), so the whole frontier is ONE bitset over the 2^n
+#: subset lattice — 1 lane at n ≤ 5 up to 32 lanes at n = 10 — and
+#: expansion/dedup/cull become shifts and masks with no sort and no
+#: possible overflow (the lattice holds every config).  Past 10 ops
+#: the 2^n lattice outgrows the row frontier and the classic row
+#: engine keeps the bucket.
+PACKED_SUBSET_MAX_OPS = 10
 
 
 # ---------------------------------------------------------------------------
@@ -365,18 +392,199 @@ def _max_concurrency(ops: Sequence[WglOp]) -> int:
     return best
 
 
+# ---------------------------------------------------------------------------
+# packed subset-lattice frontier (per-value queue classes, round 14)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class PackedSubsetBatch:
+    """A bucket of per-value queue sub-histories for the subset-lattice
+    engine.  Ops are identified by their position (< ``n`` ≤ 32), so a
+    set of ops is one uint32 and a set of *configurations* is a bitset
+    over the ``2^n`` subset lattice.
+
+    ``cand_overflow`` keeps the :class:`WglBatch` interface (the
+    combine step folds it into *unknown*); it is always all-False here
+    — a candidate *set* is one word, there is no width to truncate."""
+
+    enq: object  # [B] uint32 — bitmask of enqueue ops
+    deq: object  # [B] uint32 — bitmask of dequeue ops
+    ret_op: object  # [B, R] int32 — op returning at event j (-1 pad)
+    cands: object  # [B, R] uint32 — candidate-op bitmask per event
+    cand_overflow: np.ndarray  # [B] bool — always False (interface)
+    n: int  # ops per sub-history (padded; ≤ PACKED_SUBSET_MAX_OPS)
+
+
+def pack_subset_batch(
+    batches: Sequence[Sequence[WglOp]], n: int, to_device: bool = True
+) -> PackedSubsetBatch:
+    """Pack per-value queue sub-histories for the subset engine.  The
+    return-event / candidate-window construction mirrors
+    :func:`jepsen_tpu.checkers.wgl.pack_wgl_batch` exactly (same
+    ``(inv, ret]`` windows, same INF-open semantics); candidates land
+    as op *bitmasks* instead of index lists."""
+    from jepsen_tpu.models.core import UnorderedQueue
+
+    B = len(batches)
+    R = n
+    enq = np.zeros((B,), np.uint32)
+    deq = np.zeros((B,), np.uint32)
+    ret_op = np.full((B, R), -1, np.int32)
+    cands = np.zeros((B, R), np.uint32)
+    for b, ops in enumerate(batches):
+        if len(ops) > n:
+            raise ValueError(f"sub-history of {len(ops)} ops exceeds n={n}")
+        for i, o in enumerate(ops):
+            if o.call.f == UnorderedQueue.ENQUEUE:
+                enq[b] |= np.uint32(1 << i)
+            else:
+                deq[b] |= np.uint32(1 << i)
+        rets = sorted(
+            (i for i in range(len(ops)) if ops[i].ret != INF),
+            key=lambda i: ops[i].ret,
+        )
+        for j, i in enumerate(rets):
+            ret_op[b, j] = i
+            r = ops[i].ret
+            for q in range(len(ops)):
+                if ops[q].inv < r and ops[q].ret >= r:
+                    cands[b, j] |= np.uint32(1 << q)
+    conv = (lambda x: x) if not to_device else None
+    if conv is None:
+        import jax.numpy as jnp
+
+        conv = jnp.asarray
+    return PackedSubsetBatch(
+        enq=conv(enq),
+        deq=conv(deq),
+        ret_op=conv(ret_op),
+        cands=conv(cands),
+        cand_overflow=np.zeros((B,), bool),
+        n=n,
+    )
+
+
+def _subset_search_fn(n: int):
+    """Build the per-sub-history subset-lattice search (vmapped by the
+    caller).  The frontier is a ``[2^n/32]`` uint32 bitset over subsets
+    of linearized ops; per return event the frontier closes under
+    single-op linearizations — ``F |= shift(F ∧ without_q ∧ legal_q,
+    2^q)`` per candidate ``q``, ``n`` passes covering any enabling
+    chain — then culls to subsets containing the returning op.  Exact:
+    the lattice holds every configuration, so overflow cannot happen
+    and the engine never reports *unknown*."""
+    import jax
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.bitset import (
+        n_words,
+        shift_bitset,
+        subset_lattice_tables,
+        subset_presence,
+    )
+
+    size = 1 << n
+    Wf = n_words(size)
+    without_np, with_np = subset_lattice_tables(n)
+
+    def search(enq, deq, ret_op, cands):
+        without = jnp.asarray(without_np)
+        with_ = jnp.asarray(with_np)
+        legal_enq, legal_deq = subset_presence(n, enq, deq)
+        f0 = jnp.zeros((Wf,), jnp.uint32).at[0].set(jnp.uint32(1))
+
+        def event(carry, inputs):
+            f, fail = carry
+            ret_q, cand = inputs
+            active = (ret_q >= 0) & ~fail
+            for _ in range(n):  # ≤ n-long enabling chains close the set
+                for q in range(n):
+                    is_cand = ((cand >> q) & 1) != 0
+                    q_enq = ((enq >> q) & 1) != 0
+                    legal = jnp.where(q_enq, legal_enq, legal_deq)
+                    src = f & without[q] & legal
+                    f = f | jnp.where(
+                        is_cand & active,
+                        shift_bitset(src, 1 << q),
+                        jnp.uint32(0),
+                    )
+            gate = with_[jnp.clip(ret_q, 0, n - 1)]
+            culled = f & gate
+            f = jnp.where(active, culled, f)
+            fail = fail | (active & ~(f != 0).any())
+            return (f, fail), None
+
+        (f, fail), _ = jax.lax.scan(
+            event, (f0, jnp.bool_(False)), (ret_op, cands)
+        )
+        # exact engine: ok, and never unknown (the False overflow keeps
+        # the (ok, overflow) contract of the row engine)
+        return ~fail, jnp.bool_(False)
+
+    return search
+
+
+@functools.lru_cache(maxsize=32)
+def _subset_program_cached(n: int, donate: bool = False):
+    import jax
+
+    fn = jax.vmap(_subset_search_fn(n))
+    if donate:
+        return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+    return jax.jit(fn)
+
+
+def _subset_eligible(model_key, kind: str, ops) -> bool:
+    """A sub-history rides the subset engine iff its model state is a
+    function of the linearized set: per-value queue classes (remapped
+    single value, presence-bit semantics) small enough for the 2^n
+    lattice.  Mutex classes never qualify — the holder depends on the
+    linearization ORDER, which is exactly what the row frontier's
+    (set, state) pairs exist to carry."""
+    from jepsen_tpu.models.core import UnorderedQueue
+
+    return (
+        model_key[0] is UnorderedQueue
+        and kind.startswith("per-value")
+        and len(ops) <= PACKED_SUBSET_MAX_OPS
+        and all(
+            o.call.f in (UnorderedQueue.ENQUEUE, UnorderedQueue.DEQUEUE)
+            and o.call.a0 == 0
+            for o in ops
+        )
+    )
+
+
+def _subset_n_bucket(n_ops: int) -> int:
+    """Lattice-size buckets: 4 / 8 / 10 ops → 16 / 256 / 1024 subsets
+    (1 / 8 / 32 frontier lanes)."""
+    if n_ops <= 4:
+        return 4
+    if n_ops <= 8:
+        return 8
+    return PACKED_SUBSET_MAX_OPS
+
+
 @dataclass
 class Bucket:
     """One shape bucket: every sub-history sharing (model, n_ops bucket,
     capacity bucket, candidate-width bucket) rides one packed batch
-    through ONE cached XLA program."""
+    through ONE cached XLA program.  ``engine`` selects the frontier
+    representation: ``"rows"`` — the classic ``[capacity, K+SW]``
+    row-frontier search (``checkers/wgl.py``); ``"subset"`` — the
+    packed subset-lattice bitset (per-value queue classes ≤
+    :data:`PACKED_SUBSET_MAX_OPS` ops; ``batch`` is then a
+    :class:`PackedSubsetBatch` and ``capacity`` is informational
+    only — the lattice is exact and cannot overflow)."""
 
     model_key: tuple
     n: int
     capacity: int
     cands: int
-    batch: WglBatch
+    batch: object  # WglBatch (rows) | PackedSubsetBatch (subset)
     members: list  # [(decomp_idx, sub_idx)] aligned with the batch axis
+    engine: str = "rows"
 
 
 def bucketize(
@@ -385,13 +593,23 @@ def bucketize(
     capacity_override: int | None = None,
     pad_to: int = 1,
     to_device: bool = True,
+    subset_engine: bool = True,
 ) -> list[Bucket]:
     """Pool every non-trivial sub-history of ``decomps`` into shape
     buckets.  ``capacity_cap`` clamps the width-derived capacity (test
     hook for the overflow contract); ``capacity_override`` pins it (the
     escalation pass).  ``pad_to`` pads each bucket's batch axis to a
     multiple (mesh hist-extent divisibility); pad rows are empty
-    sub-histories that check trivially valid and are never read back."""
+    sub-histories that check trivially valid and are never read back.
+
+    Per-value queue classes small enough for the subset lattice
+    (:func:`_subset_eligible`) ride ``engine="subset"`` buckets — the
+    packed bitset frontier, keyed by the lattice-size bucket, so
+    thousands of capacity-16-shaped classes share a couple of cached
+    programs; everything else (mutex classes, oversized classes) keeps
+    the row-frontier engine.  ``capacity`` stays the width-derived
+    row-equivalent on subset buckets for reporting symmetry — the
+    lattice itself is exact and cannot overflow."""
     groups: dict[tuple, list] = {}
     for di, d in enumerate(decomps):
         if not d.sound:
@@ -409,21 +627,37 @@ def bucketize(
             )
             if capacity_cap is not None:
                 cap = min(cap, capacity_cap)
-            key = (
-                d.model_key,
-                _pow2ceil(max(len(sub.ops), 1), floor=8),
-                cap,
-                _pow2ceil(max(_max_concurrency(sub.ops), 1), floor=4),
-            )
+            if subset_engine and _subset_eligible(d.model_key, d.kind, sub.ops):
+                key = (
+                    "subset",
+                    d.model_key,
+                    _subset_n_bucket(len(sub.ops)),
+                    cap,
+                )
+            else:
+                key = (
+                    "rows",
+                    d.model_key,
+                    _pow2ceil(max(len(sub.ops), 1), floor=8),
+                    cap,
+                    _pow2ceil(max(_max_concurrency(sub.ops), 1), floor=4),
+                )
             groups.setdefault(key, []).append((di, si, sub))
     out = []
-    for (model_key, n, cap, cands), members in groups.items():
+    for key, members in groups.items():
+        engine = key[0]
         opss = [sub.ops for _, _, sub in members]
         if pad_to > 1 and len(opss) % pad_to:
             opss = opss + [[]] * (pad_to - len(opss) % pad_to)
-        batch = pack_wgl_batch(
-            opss, max_cands=cands, length=n, to_device=to_device
-        )
+        if engine == "subset":
+            _, model_key, n, cap = key
+            cands = 0
+            batch = pack_subset_batch(opss, n, to_device=to_device)
+        else:
+            _, model_key, n, cap, cands = key
+            batch = pack_wgl_batch(
+                opss, max_cands=cands, length=n, to_device=to_device
+            )
         out.append(
             Bucket(
                 model_key=model_key,
@@ -432,19 +666,37 @@ def bucketize(
                 cands=cands,
                 batch=batch,
                 members=[(di, si) for di, si, _ in members],
+                engine=engine,
             )
         )
     return out
 
 
-def run_bucket(bucket: Bucket) -> tuple:
+def run_bucket(bucket: Bucket, donate: bool | None = None) -> tuple:
     """Dispatch one bucket's vmapped search and return the RAW device
     arrays ``(ok, overflow)`` — a genuinely asynchronous JAX dispatch,
     so a loop over buckets enqueues all programs before any result is
     needed and the pipeline family's check stage keeps its overlap
     (``wgl_tensor_check`` would block on its numpy conversion).
     :func:`combine_buckets` folds in the host-side ``cand_overflow``
-    flag and applies the ``ok & ~unknown`` masking."""
+    flag and applies the ``ok & ~unknown`` masking.
+
+    ``donate=None`` donates the bucket's staged arrays wherever the
+    runtime can use donations (non-CPU backends; the round-14 donation
+    completion — bucket batches are one-shot, so nothing ever reads
+    them after dispatch)."""
+    if donate is None:
+        from jepsen_tpu.parallel.pipeline import _default_donate
+
+        donate = _default_donate()
+    if bucket.engine == "subset":
+        prog = _subset_program_cached(bucket.batch.n, donate)
+        return prog(
+            bucket.batch.enq,
+            bucket.batch.deq,
+            bucket.batch.ret_op,
+            bucket.batch.cands,
+        )
     from jepsen_tpu.checkers.wgl import _wgl_program_cached
 
     prog = _wgl_program_cached(
@@ -452,6 +704,7 @@ def run_bucket(bucket: Bucket) -> tuple:
         bucket.batch.n,
         bucket.capacity,
         int(bucket.batch.cands.shape[-1]),
+        donate=donate,
     )
     return prog(
         bucket.batch.f,

@@ -32,11 +32,13 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from jepsen_tpu.checkers.queue_lin import (
     QueueLinTensors,
+    QueueLinTensorsPacked,
     queue_lin_classify,
     queue_lin_count_vectors,
 )
 from jepsen_tpu.checkers.total_queue import (
     TotalQueueTensors,
+    TotalQueueTensorsPacked,
     total_queue_classify,
     total_queue_count_vectors,
 )
@@ -92,22 +94,41 @@ def _vmap_counts(count_fn, value_space, *cols):
 
 
 @functools.lru_cache(maxsize=64)
-def _total_queue_program(mesh: Mesh, value_space: int):
+def _total_queue_program(mesh: Mesh, value_space: int,
+                         packed_out: bool = False):
     def body(f, ty, v, m):
         a, e, d = _vmap_counts(total_queue_count_vectors, value_space, f, ty, v, m)
         a, e, d = jax.lax.psum((a, e, d), SEQ_AXIS)
-        return total_queue_classify(a, e, d)
+        return total_queue_classify(a, e, d, packed_out=packed_out)
 
-    out_specs = TotalQueueTensors(
-        valid=P(HIST_AXIS),
-        attempt_count=P(HIST_AXIS),
-        acknowledged_count=P(HIST_AXIS),
-        ok_count=P(HIST_AXIS),
-        lost=P(HIST_AXIS, None),
-        unexpected=P(HIST_AXIS, None),
-        duplicated=P(HIST_AXIS, None),
-        recovered=P(HIST_AXIS, None),
-    )
+    scalar, mask = P(HIST_AXIS), P(HIST_AXIS, None)
+    if packed_out:
+        out_specs = TotalQueueTensorsPacked(
+            valid=scalar,
+            attempt_count=scalar,
+            acknowledged_count=scalar,
+            ok_count=scalar,
+            lost_count=scalar,
+            unexpected_count=scalar,
+            duplicated_count=scalar,
+            recovered_count=scalar,
+            lost=mask,
+            unexpected=mask,
+            duplicated=mask,
+            recovered=mask,
+            value_space=value_space,
+        )
+    else:
+        out_specs = TotalQueueTensors(
+            valid=scalar,
+            attempt_count=scalar,
+            acknowledged_count=scalar,
+            ok_count=scalar,
+            lost=mask,
+            unexpected=mask,
+            duplicated=mask,
+            recovered=mask,
+        )
     return jax.jit(
         shard_map(
             body, mesh=mesh, in_specs=(_row_spec(),) * 4, out_specs=out_specs
@@ -116,16 +137,17 @@ def _total_queue_program(mesh: Mesh, value_space: int):
 
 
 def sharded_total_queue(
-    packed: PackedHistories, mesh: Mesh
+    packed: PackedHistories, mesh: Mesh, packed_out: bool = False
 ) -> TotalQueueTensors:
     """total-queue over the mesh: local scatter → psum(seq) → classify."""
-    fn = _total_queue_program(mesh, packed.value_space)
+    fn = _total_queue_program(mesh, packed.value_space, packed_out)
     return fn(packed.f, packed.type, packed.value, packed.mask)
 
 
 @functools.lru_cache(maxsize=64)
 def _queue_lin_program(
-    mesh: Mesh, value_space: int, exactly_once: bool = True
+    mesh: Mesh, value_space: int, exactly_once: bool = True,
+    packed_out: bool = False,
 ):
     def body(f, ty, v, m):
         # global history position of each local row: shard offset + iota
@@ -140,16 +162,29 @@ def _queue_lin_program(
         a, x, r = jax.lax.psum((a, x, r), SEQ_AXIS)
         s = jax.lax.pmin(s, SEQ_AXIS)
         t = jax.lax.pmin(t, SEQ_AXIS)
-        return queue_lin_classify(a, x, s, r, t, exactly_once)
+        return queue_lin_classify(a, x, s, r, t, exactly_once,
+                                  packed_out=packed_out)
 
-    out_specs = QueueLinTensors(
-        valid=P(HIST_AXIS),
-        duplicate=P(HIST_AXIS, None),
-        phantom=P(HIST_AXIS, None),
-        causality=P(HIST_AXIS, None),
-        recovered=P(HIST_AXIS, None),
-        read_value_count=P(HIST_AXIS),
-    )
+    scalar, mask = P(HIST_AXIS), P(HIST_AXIS, None)
+    if packed_out:
+        out_specs = QueueLinTensorsPacked(
+            valid=scalar,
+            duplicate=mask,
+            phantom=mask,
+            causality=mask,
+            recovered=mask,
+            read_value_count=scalar,
+            value_space=value_space,
+        )
+    else:
+        out_specs = QueueLinTensors(
+            valid=scalar,
+            duplicate=mask,
+            phantom=mask,
+            causality=mask,
+            recovered=mask,
+            read_value_count=scalar,
+        )
     return jax.jit(
         shard_map(
             body, mesh=mesh, in_specs=(_row_spec(),) * 4, out_specs=out_specs
@@ -158,22 +193,27 @@ def _queue_lin_program(
 
 
 def sharded_queue_lin(
-    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once"
+    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once",
+    packed_out: bool = False,
 ) -> QueueLinTensors:
     """queue linearizability over the mesh: psum counts, pmin positions."""
     fn = _queue_lin_program(
-        mesh, packed.value_space, delivery == "exactly-once"
+        mesh, packed.value_space, delivery == "exactly-once", packed_out
     )
     return fn(packed.f, packed.type, packed.value, packed.mask)
 
 
 def sharded_check(
-    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once"
+    packed: PackedHistories, mesh: Mesh, delivery: str = "exactly-once",
+    packed_out: bool = False,
 ) -> tuple[TotalQueueTensors, QueueLinTensors]:
-    """The full per-history verdict (both checkers) over the mesh."""
+    """The full per-history verdict (both checkers) over the mesh.
+    ``packed_out=True`` ships the per-value class masks as uint32
+    presence bitplanes (the round-14 packed verdict buffers) — on a
+    real mesh that is 8–32× less D2H gather traffic per batch."""
     return (
-        sharded_total_queue(packed, mesh),
-        sharded_queue_lin(packed, mesh, delivery),
+        sharded_total_queue(packed, mesh, packed_out),
+        sharded_queue_lin(packed, mesh, delivery, packed_out),
     )
 
 
@@ -400,6 +440,25 @@ def sharded_wgl_pcomp(decomps, mesh: Mesh, capacity_cap: int | None = None):
         )
         placed = []
         for b in buckets:
+            if b.engine == "subset":
+                # packed subset-lattice bucket: its staged arrays are
+                # the op/candidate bitmasks, sharded over hist like any
+                # other per-sub-history column
+                enq, deq, ret_op, cands = _hist_sharded(
+                    (b.batch.enq, b.batch.deq, b.batch.ret_op,
+                     b.batch.cands),
+                    mesh,
+                )
+                placed.append(
+                    dataclasses.replace(
+                        b,
+                        batch=dataclasses.replace(
+                            b.batch, enq=enq, deq=deq, ret_op=ret_op,
+                            cands=cands
+                        ),
+                    )
+                )
+                continue
             f, a0, a1, ret_op, cands = _hist_sharded(
                 (b.batch.f, b.batch.a0, b.batch.a1, b.batch.ret_op,
                  b.batch.cands),
@@ -452,7 +511,13 @@ def sharded_elle(batch, mesh: Mesh):
         txn_mask=put(batch.txn_mask, P(HIST_AXIS, None)),
         host_bad=put(batch.host_bad, P(HIST_AXIS)),
     )
-    return elle_tensor_check(sharded)
+    # seq>1 pins the DENSE closure: the Megatron-style column sharding
+    # partitions [T, T] matmul operands over seq, which is exactly the
+    # axis the packed bitplane representation folds 32:1 — GSPMD would
+    # all-gather the lanes and silently serialize.  Bitplanes win the
+    # single-chip/hist-sharded paths (the default); graphs too large
+    # for one chip keep the MXU column-sharded program.
+    return elle_tensor_check(sharded, closure="dense")
 
 
 # ---------------------------------------------------------------------------

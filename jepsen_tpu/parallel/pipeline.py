@@ -1404,7 +1404,12 @@ def _queue_family(
             to_device=False,
         )
 
-    base_check = lambda p: combined_tensor_check(p, delivery=delivery)
+    # packed verdict buffers (round 14): the per-value class masks ship
+    # as uint32 presence bitplanes — 8–32× fewer verdict bytes per
+    # batch; the *_to_results converters render identical maps
+    base_check = lambda p: combined_tensor_check(
+        p, delivery=delivery, packed_out=True
+    )
     if mesh is not None:
         from jepsen_tpu.parallel.mesh import (
             shard_packed,
@@ -1412,13 +1417,15 @@ def _queue_family(
             sharded_queue_verdict,
         )
 
-        check = lambda p: sharded_check(p, mesh, delivery=delivery)
+        check = lambda p: sharded_check(
+            p, mesh, delivery=delivery, packed_out=True
+        )
         place = lambda p: shard_packed(p, mesh)
     else:
         if reduce:
             raise ValueError("reduce mode needs a mesh")
         check = (
-            donated(base_check, key=("queue", delivery))
+            donated(base_check, key=("queue", delivery, "packed"))
             if donate
             else base_check
         )
@@ -1674,7 +1681,9 @@ def _mutex_family(
     never a silent per-piece skip.  ``chunk_pad``/``donate`` are
     accepted for interface symmetry; bucket shapes are already pinned
     by the (n_ops, capacity, cands) buckets, so chunk padding adds
-    nothing and donation would alias the shared bucket programs."""
+    nothing — and since round 14 donation lives INSIDE ``run_bucket``
+    (the staged bucket arrays are one-shot, donated by the row and
+    subset programs alike on backends whose runtime can use it)."""
     import jax
 
     from jepsen_tpu.checkers.wgl import (
@@ -1739,18 +1748,20 @@ def _mutex_family(
         return decomps, buckets, pairs
 
     def _place_batch(b):
-        if mesh is not None:
-            f, a0, a1, ret_op, cands = _hist_sharded(
-                (b.f, b.a0, b.a1, b.ret_op, b.cands), mesh
-            )
-        else:
-            put = _device_put_on(device)
-            f, a0, a1, ret_op, cands = put(
-                (b.f, b.a0, b.a1, b.ret_op, b.cands)
-            )
-        return dataclasses.replace(
-            b, f=f, a0=a0, a1=a1, ret_op=ret_op, cands=cands
+        # mutex classes always ride the row engine (order-dependent
+        # state), but the placement stays engine-aware so a packed
+        # subset bucket placed through this family would not misroute
+        cols = (
+            ("enq", "deq", "ret_op", "cands")
+            if hasattr(b, "enq")
+            else ("f", "a0", "a1", "ret_op", "cands")
         )
+        vals = tuple(getattr(b, c) for c in cols)
+        if mesh is not None:
+            vals = _hist_sharded(vals, mesh)
+        else:
+            vals = _device_put_on(device)(vals)
+        return dataclasses.replace(b, **dict(zip(cols, vals)))
 
     def place(item):
         decomps, buckets, pairs = item

@@ -1,0 +1,726 @@
+"""Bit-packed boolean kernels: the round-14 differential suites.
+
+One substrate (``checkers/bitset.py``), three consumer families, zero
+verdict divergence allowed:
+
+- primitives — pack/unpack round trips and popcount vs numpy EXACTLY,
+  across lane boundaries (n = 31, 32, 33, 1024); the boolean-semiring
+  bitmat multiply and the warm-started fixpoint closure vs their dense
+  twins on random matrices;
+- elle — packed ≡ dense ≡ int8 verdict tensors on the synth corpus
+  (every anomaly class) and the 300-history randomized fuzz corpus
+  (tier-1 slice here, the full corpus ``slow``);
+- WGL — the subset-lattice frontier ≡ the row frontier ≡ the classic
+  CPU search on per-value queue classes (synth, hard-generator, and
+  adversarial interval fuzz); refuted double-grants, fenced token-order
+  violations, and FIFO order violations must SURVIVE packing;
+- queue — packed presence-bitplane verdict buffers render result maps
+  identical to the dense tensors for both checkers and both delivery
+  contracts;
+- donation — every ``donated()`` verdict program (and the donating WGL
+  bucket programs) marks its staged batch donated in the lowered
+  module (the no-copy pin the round-14 satellite calls for).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.bitset import (
+    bit_transpose,
+    bitmat_mul_packed,
+    closure_on_cycle_packed,
+    closure_packed,
+    identity_bits,
+    n_words,
+    pack_bits,
+    pack_bits_np,
+    popcount32,
+    popcount_bits,
+    shift_bitset,
+    subset_lattice_tables,
+    unpack_bits,
+    unpack_bits_np,
+)
+
+LANE_SIZES = (31, 32, 33, 1024)
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+
+class TestPrimitives:
+    @pytest.mark.parametrize("n", LANE_SIZES)
+    def test_pack_unpack_round_trip(self, n):
+        rng = np.random.default_rng(n)
+        bits = rng.random((5, n)) < 0.4
+        packed = np.asarray(pack_bits(bits))
+        assert packed.shape == (5, n_words(n))
+        assert packed.dtype == np.uint32
+        # jittable pack == numpy pack (the packbits little-endian layout)
+        np.testing.assert_array_equal(packed, pack_bits_np(bits))
+        # both unpackers invert it
+        np.testing.assert_array_equal(np.asarray(unpack_bits(packed, n)), bits)
+        np.testing.assert_array_equal(unpack_bits_np(packed, n), bits)
+
+    @pytest.mark.parametrize("n", LANE_SIZES)
+    def test_popcount_vs_numpy(self, n):
+        rng = np.random.default_rng(100 + n)
+        bits = rng.random((7, n)) < 0.5
+        packed = pack_bits(bits)
+        per_word = np.asarray(popcount32(packed))
+        # numpy oracle: bit_count over the packed words
+        expect = np.bitwise_count(np.asarray(packed)).astype(np.int32)
+        np.testing.assert_array_equal(per_word, expect)
+        # total popcount == the number of True bits (pad bits are zero)
+        np.testing.assert_array_equal(
+            np.asarray(popcount_bits(packed)), bits.sum(-1).astype(np.int32)
+        )
+
+    @pytest.mark.parametrize("t", (32, 104, 128))
+    def test_bitmat_mul_vs_numpy(self, t):
+        rng = np.random.default_rng(t)
+        a = rng.random((t, t)) < 0.1
+        b = rng.random((t, t)) < 0.1
+        got = np.asarray(bitmat_mul_packed(pack_bits(a), pack_bits(b)))
+        expect = pack_bits_np((a.astype(np.int32) @ b.astype(np.int32)) > 0)
+        np.testing.assert_array_equal(got, expect)
+
+    def test_bit_transpose(self):
+        rng = np.random.default_rng(7)
+        a = rng.random((96, 96)) < 0.2
+        got = np.asarray(bit_transpose(pack_bits(a), 96))
+        np.testing.assert_array_equal(got, pack_bits_np(a.T))
+
+    def test_closure_matches_dense_reachability(self):
+        rng = np.random.default_rng(11)
+        t = 64
+        a = rng.random((t, t)) < 0.05
+        r0 = np.asarray(pack_bits(a)) | identity_bits(t)
+        r = np.asarray(closure_packed(np.asarray(r0), 6))
+        # numpy oracle: boolean matrix powers to fixpoint
+        m = a | np.eye(t, dtype=bool)
+        while True:
+            m2 = (m.astype(np.int32) @ m.astype(np.int32)) > 0
+            if np.array_equal(m2, m):
+                break
+            m = m2
+        np.testing.assert_array_equal(r, pack_bits_np(m))
+
+    def test_closure_on_cycle_matches_tarjan(self):
+        from jepsen_tpu.checkers.elle import _on_cycle_nodes
+
+        rng = np.random.default_rng(13)
+        t = 64
+        for density in (0.01, 0.05):
+            ww = rng.random((t, t)) < density
+            wr = rng.random((t, t)) < density
+            rw = rng.random((t, t)) < density
+            g0, g1c, g2 = (
+                np.asarray(x)
+                for x in closure_on_cycle_packed(
+                    pack_bits(ww), pack_bits(wr), pack_bits(rw), 6
+                )
+            )
+            for got, adj in ((g0, ww), (g1c, ww | wr), (g2, ww | wr | rw)):
+                edges = {
+                    (int(i), int(j)) for i, j in zip(*np.nonzero(adj))
+                }
+                expect = _on_cycle_nodes(t, edges)
+                assert set(np.nonzero(got)[0].tolist()) == expect
+
+    def test_shift_bitset_matches_numpy(self):
+        rng = np.random.default_rng(17)
+        for n_bits, shift in ((64, 1), (64, 2), (1024, 4), (1024, 32),
+                              (1024, 256), (1024, 1024)):
+            bits = rng.random(n_bits) < 0.3
+            got = np.asarray(shift_bitset(pack_bits(bits), shift))
+            expect = np.zeros(n_bits, bool)
+            if shift < n_bits:
+                expect[shift:] = bits[: n_bits - shift]
+            np.testing.assert_array_equal(got, pack_bits_np(expect))
+
+    def test_subset_lattice_tables(self):
+        without, with_ = subset_lattice_tables(4)
+        for q in range(4):
+            w = unpack_bits_np(with_[q], 16)
+            assert set(np.nonzero(w)[0].tolist()) == {
+                s for s in range(16) if (s >> q) & 1
+            }
+            np.testing.assert_array_equal(
+                unpack_bits_np(without[q], 16), ~w
+            )
+
+
+# ---------------------------------------------------------------------------
+# elle: packed ≡ dense ≡ int8
+# ---------------------------------------------------------------------------
+
+
+def _elle_tensors_equal(mops, modes=("packed", "dense", "int8")):
+    from jepsen_tpu.checkers.elle import elle_mops_check
+
+    ref_mode = modes[0]
+    ref, _ = elle_mops_check(mops, closure=ref_mode)
+    for mode in modes[1:]:
+        got, _ = elle_mops_check(mops, closure=mode)
+        for fld in ("valid", "g0", "g1c", "g2"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, fld)),
+                np.asarray(getattr(got, fld)),
+                err_msg=f"elle {fld} diverges: {ref_mode} vs {mode}",
+            )
+
+
+class TestElleClosureParity:
+    def test_synth_corpus_all_anomaly_classes(self):
+        from jepsen_tpu.checkers.elle import pack_elle_mops
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        shs = synth_elle_batch(3, ElleSynthSpec(n_txns=60))
+        for kw in ("g0_cycle", "g1c_cycle", "g2_cycle", "g1a", "g1b"):
+            shs += synth_elle_batch(
+                2, ElleSynthSpec(n_txns=60, seed=hash(kw) % 1000), **{kw: 1}
+            )
+        mops, metas = pack_elle_mops([sh.ops for sh in shs])
+        assert not any(g.degenerate for g in metas)
+        _elle_tensors_equal(mops)
+
+    def test_fuzz_corpus_tier1_slice(self):
+        from jepsen_tpu.checkers.elle import split_elle_mops, elle_mops_for
+        from tests.test_fuzz_elle_device import fuzz_history
+
+        histories = [fuzz_history(s) for s in range(16)]
+        live, mops, degen = split_elle_mops(
+            [elle_mops_for(h) for h in histories]
+        )
+        assert live, "corpus must exercise the device path"
+        _elle_tensors_equal(mops)
+
+    def test_full_checker_verdicts_with_packed_default(self):
+        """check_elle_batch (which rides DEFAULT_CLOSURE = packed)
+        still reports maps identical to the host oracle."""
+        from jepsen_tpu.checkers.elle import (
+            DEFAULT_CLOSURE,
+            check_elle_batch,
+            check_elle_cpu,
+        )
+        from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+
+        assert DEFAULT_CLOSURE == "packed"
+        shs = synth_elle_batch(2, ElleSynthSpec(n_txns=50), g2_cycle=1)
+        shs += synth_elle_batch(2, ElleSynthSpec(n_txns=50, seed=9), g1c_cycle=1)
+        for sh, r in zip(shs, check_elle_batch([sh.ops for sh in shs])):
+            assert r == check_elle_cpu(sh.ops)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("chunk", range(6))
+    def test_fuzz_corpus_heavy(self, chunk):
+        """The 300-history randomized corpus (the ISSUE-14 slow slice):
+        packed ≡ dense ≡ int8 on every tensor-representable history."""
+        from jepsen_tpu.checkers.elle import elle_mops_for, split_elle_mops
+        from tests.test_fuzz_elle_device import fuzz_history
+
+        histories = [
+            fuzz_history(1000 + chunk * 50 + i, n_txns=40, n_keys=5)
+            for i in range(50)
+        ]
+        live, mops, _degen = split_elle_mops(
+            [elle_mops_for(h) for h in histories]
+        )
+        assert live
+        _elle_tensors_equal(mops)
+
+
+# ---------------------------------------------------------------------------
+# WGL: subset lattice ≡ row frontier ≡ classic CPU
+# ---------------------------------------------------------------------------
+
+
+def _pcomp_both_engines(decomps):
+    from jepsen_tpu.checkers.wgl_pcomp import (
+        bucketize,
+        finish_buckets,
+        run_bucket,
+    )
+
+    out = []
+    for subset in (True, False):
+        buckets = bucketize(decomps, subset_engine=subset)
+        if subset:
+            assert any(b.engine == "subset" for b in buckets), (
+                "per-value queue classes must ride the subset engine"
+            )
+        else:
+            assert all(b.engine == "rows" for b in buckets)
+        results = [run_bucket(b) for b in buckets]
+        out.append(finish_buckets(decomps, buckets, results))
+    return out
+
+
+class TestWglSubsetEngine:
+    def test_synth_corpus_engines_agree(self):
+        from jepsen_tpu.checkers.wgl import check_wgl_cpu, queue_wgl_ops
+        from jepsen_tpu.checkers.wgl_pcomp import decompose
+        from jepsen_tpu.history.synth import SynthSpec, synth_history
+        from jepsen_tpu.models.core import UnorderedQueue
+
+        opss = [
+            queue_wgl_ops(
+                synth_history(
+                    SynthSpec(
+                        n_ops=120,
+                        seed=700 + s,
+                        duplicated=s % 2,
+                        unexpected=(s // 2) % 2,
+                    )
+                ).ops
+            )
+            for s in range(4)
+        ]
+        vs = 32 * max(
+            1,
+            (max((o.call.a0 for ops in opss for o in ops), default=0) + 32)
+            // 32,
+        )
+        mk = (UnorderedQueue, (vs,))
+        decomps = [decompose(ops, mk) for ops in opss]
+        (ok_s, unk_s, _), (ok_r, unk_r, _) = _pcomp_both_engines(decomps)
+        np.testing.assert_array_equal(ok_s, ok_r)
+        np.testing.assert_array_equal(unk_s, unk_r)
+        assert not unk_s.any()
+        for ops, ok in zip(opss, ok_s):
+            assert bool(ok) == check_wgl_cpu(ops, UnorderedQueue(vs))["valid?"]
+
+    @pytest.mark.parametrize("window", [0, 2, 4, 6])
+    def test_hard_generator_engines_agree(self, window):
+        from jepsen_tpu.checkers.wgl import queue_wgl_ops
+        from jepsen_tpu.checkers.wgl_pcomp import decompose
+        from jepsen_tpu.history.synth import synth_hard_queue_history
+        from jepsen_tpu.models.core import UnorderedQueue
+
+        ops = queue_wgl_ops(synth_hard_queue_history(200, window, seed=21))
+        vs = 32 * max(
+            1, (max((o.call.a0 for o in ops), default=0) + 32) // 32
+        )
+        decomps = [decompose(ops, (UnorderedQueue, (vs,)))]
+        (ok_s, unk_s, _), (ok_r, unk_r, _) = _pcomp_both_engines(decomps)
+        assert bool(ok_s[0]) == bool(ok_r[0]) is True
+        assert not unk_s[0] and not unk_r[0]
+
+    def test_adversarial_interval_fuzz_vs_classic(self):
+        """Randomized single-class op sets — every (inv, ret] window
+        shape incl. INF-open ops, retried enqueues, duplicate dequeues
+        — through subset vs rows vs the exact classic search."""
+        import random
+
+        from jepsen_tpu.checkers.wgl import INF, Call, WglOp, check_wgl_cpu
+        from jepsen_tpu.checkers.wgl_pcomp import Decomposition, SubHist
+        from jepsen_tpu.models.core import UnorderedQueue
+
+        rng = random.Random(23)
+        mk = (UnorderedQueue, (32,))
+        n_invalid = 0
+        for trial in range(120):
+            n = rng.randint(1, 8)
+            ops = []
+            t = 0
+            for i in range(n):
+                f = rng.choice(
+                    (UnorderedQueue.ENQUEUE, UnorderedQueue.DEQUEUE)
+                )
+                inv = t
+                t += rng.randint(1, 3)
+                ret = INF if rng.random() < 0.25 else t + rng.randint(0, 4)
+                ops.append(WglOp(Call(f, 0), inv, ret))
+            sub = SubHist(
+                ops=ops, class_id=0, width=0, src_idx=list(range(n))
+            )
+            d = Decomposition(
+                subs=[sub], model_key=mk, sound=True, kind="per-value",
+                n_ops=n,
+            )
+            (ok_s, unk_s, _), (ok_r, unk_r, _) = _pcomp_both_engines([d])
+            cpu = check_wgl_cpu(ops, UnorderedQueue(32))
+            assert not unk_s[0], (trial, ops)
+            assert bool(ok_s[0]) == cpu["valid?"], (trial, ops, cpu)
+            if not unk_r[0]:
+                assert bool(ok_r[0]) == bool(ok_s[0]), (trial, ops)
+            n_invalid += not cpu["valid?"]
+        assert n_invalid > 10, "fuzz corpus must exercise refutations"
+
+    def test_duplicate_dequeue_refuted_by_subset_engine(self):
+        from jepsen_tpu.checkers.wgl import Call, WglOp
+        from jepsen_tpu.checkers.wgl_pcomp import (
+            bucketize,
+            decompose,
+            pcomp_check_ops,
+        )
+        from jepsen_tpu.models.core import UnorderedQueue
+
+        E, D = UnorderedQueue.ENQUEUE, UnorderedQueue.DEQUEUE
+        ops = [
+            WglOp(Call(E, 5), 0, 1),
+            WglOp(Call(D, 5), 2, 3),
+            WglOp(Call(D, 5), 4, 5),  # the value comes out twice
+        ]
+        mk = (UnorderedQueue, (32,))
+        d = decompose(ops, mk)
+        assert {b.engine for b in bucketize([d])} == {"subset"}
+        r = pcomp_check_ops(ops, mk)
+        assert r["valid?"] is False
+
+    def test_mutex_violations_survive_packing(self):
+        """The packed-enabled bucketizer routes mutex classes to the
+        ROW engine (state depends on linearization order, not the set)
+        and the refuted double-grant / fenced token-order corpus from
+        test_wgl_pcomp stays refuted end-to-end."""
+        from jepsen_tpu.checkers.wgl import (
+            fenced_mutex_wgl_ops,
+            mutex_wgl_ops,
+        )
+        from jepsen_tpu.checkers.wgl_pcomp import (
+            bucketize,
+            decompose,
+            pcomp_check_ops,
+        )
+        from jepsen_tpu.history.ops import Op, OpF, OpType, reindex
+        from jepsen_tpu.history.synth import MutexSynthSpec, synth_mutex_batch
+        from jepsen_tpu.models.core import FencedMutex, OwnedMutex
+
+        shs = [
+            s
+            for s in synth_mutex_batch(
+                4, MutexSynthSpec(n_ops=120, seed=50), n_locks=3,
+                double_grant=1,
+            )
+            if s.double_grant == 1
+        ]
+        assert shs
+        for sh in shs:
+            ops = mutex_wgl_ops(sh.ops)
+            d = decompose(ops, (OwnedMutex, ()))
+            assert all(b.engine == "rows" for b in bucketize([d]))
+            r = pcomp_check_ops(ops, (OwnedMutex, ()))
+            assert r["valid?"] is False and "invalid-class" in r
+
+        hist = []
+        for key, token in ((0, 5), (1, 3), (0, 9), (1, 7), (1, 7)):
+            inv = Op.invoke(OpF.ACQUIRE, len(hist))
+            hist.append(inv)
+            hist.append(inv.complete(OpType.OK, value=[key, token]))
+        r = pcomp_check_ops(
+            fenced_mutex_wgl_ops(reindex(hist)), (FencedMutex, ())
+        )
+        assert r["valid?"] is False and r["invalid-class"] == 1
+
+    def test_fifo_order_violation_survives_packing(self):
+        """FIFO per-value classes ride the subset engine; the HOST
+        pairwise-order half still refutes a non-FIFO interleaving."""
+        from jepsen_tpu.checkers.wgl import Call, WglOp
+        from jepsen_tpu.checkers.wgl_pcomp import (
+            bucketize,
+            decompose,
+            pcomp_check_ops,
+        )
+        from jepsen_tpu.models.core import FifoQueue
+
+        E, D = FifoQueue.ENQUEUE, FifoQueue.DEQUEUE
+        ops = [
+            WglOp(Call(E, 1), 0, 1),
+            WglOp(Call(E, 2), 2, 3),  # enq(1) wholly before enq(2)
+            WglOp(Call(D, 2), 4, 5),  # ...but 2 comes out, 1 never does
+        ]
+        mk = (FifoQueue, (8,))
+        d = decompose(ops, mk)
+        assert d.sound and d.order_ok is False
+        assert {b.engine for b in bucketize([d])} == {"subset"}
+        r = pcomp_check_ops(ops, mk)
+        assert r["valid?"] is False
+        assert r["order-violation"] == [1, 2]
+
+    def test_mesh_sharded_pcomp_with_subset_engine(self, cpu_devices):
+        from jepsen_tpu.checkers.wgl import queue_wgl_ops
+        from jepsen_tpu.checkers.wgl_pcomp import (
+            decompose,
+            pcomp_tensor_check,
+        )
+        from jepsen_tpu.history.synth import synth_hard_queue_history
+        from jepsen_tpu.models.core import UnorderedQueue
+        from jepsen_tpu.parallel.mesh import checker_mesh, sharded_wgl_pcomp
+
+        mesh = checker_mesh(cpu_devices, seq=1)
+        opss = [
+            queue_wgl_ops(synth_hard_queue_history(60, w, seed=5))
+            for w in (0, 2)
+        ]
+        vs = 32 * max(
+            1,
+            (max((o.call.a0 for ops in opss for o in ops), default=0) + 32)
+            // 32,
+        )
+        mk = (UnorderedQueue, (vs,))
+        ok_s, unk_s, _ = sharded_wgl_pcomp(
+            [decompose(ops, mk) for ops in opss], mesh
+        )
+        ok, unk, _ = pcomp_tensor_check(
+            [decompose(ops, mk) for ops in opss]
+        )
+        np.testing.assert_array_equal(ok_s, ok)
+        np.testing.assert_array_equal(unk_s, unk)
+
+
+# ---------------------------------------------------------------------------
+# queue: packed verdict buffers ≡ dense result maps
+# ---------------------------------------------------------------------------
+
+
+class TestQueuePackedVerdicts:
+    def _packed_histories(self):
+        from jepsen_tpu.history.encode import pack_histories
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+        shs = synth_batch(
+            8,  # divisible by the 8-device virtual mesh
+            SynthSpec(n_ops=120, n_processes=4),
+            lost=1,
+            duplicated=1,
+            unexpected=1,
+        )
+        return pack_histories([sh.ops for sh in shs])
+
+    @pytest.mark.parametrize("delivery", ["exactly-once", "at-least-once"])
+    def test_combined_check_packed_equals_dense(self, delivery):
+        from jepsen_tpu.checkers.fused import combined_tensor_check
+        from jepsen_tpu.checkers.queue_lin import (
+            QueueLinTensorsPacked,
+            queue_lin_tensors_to_results,
+        )
+        from jepsen_tpu.checkers.total_queue import (
+            TotalQueueTensorsPacked,
+            _tensors_to_results,
+        )
+
+        packed = self._packed_histories()
+        tq_d, ql_d = combined_tensor_check(packed, delivery=delivery)
+        tq_p, ql_p = combined_tensor_check(
+            packed, delivery=delivery, packed_out=True
+        )
+        assert isinstance(tq_p, TotalQueueTensorsPacked)
+        assert isinstance(ql_p, QueueLinTensorsPacked)
+        # the packed masks are genuinely 8-32x smaller
+        assert tq_p.lost.shape[-1] * 32 == np.asarray(tq_d.lost).shape[-1]
+        assert _tensors_to_results(tq_p) == _tensors_to_results(tq_d)
+        assert queue_lin_tensors_to_results(
+            ql_p
+        ) == queue_lin_tensors_to_results(ql_d)
+
+    def test_anomalies_render_identically(self):
+        """The synth ground-truth anomalies surface through the packed
+        path exactly (sets AND totals — total-queue counts are sums of
+        per-value counts, not set sizes)."""
+        from jepsen_tpu.checkers.fused import combined_tensor_check
+        from jepsen_tpu.checkers.total_queue import (
+            _tensors_to_results,
+            check_total_queue_cpu,
+        )
+        from jepsen_tpu.history.encode import pack_histories
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+
+        shs = synth_batch(
+            4, SynthSpec(n_ops=150, seed=31), lost=2, duplicated=2
+        )
+        packed = pack_histories([sh.ops for sh in shs])
+        tq_p, _ = combined_tensor_check(packed, packed_out=True)
+        for sh, r in zip(shs, _tensors_to_results(tq_p)):
+            assert r == check_total_queue_cpu(sh.ops)
+
+    def test_mesh_sharded_check_packed(self, cpu_devices):
+        from jepsen_tpu.checkers.queue_lin import queue_lin_tensors_to_results
+        from jepsen_tpu.checkers.total_queue import _tensors_to_results
+        from jepsen_tpu.parallel.mesh import (
+            checker_mesh,
+            shard_packed,
+            sharded_check,
+        )
+
+        packed = self._packed_histories()
+        mesh = checker_mesh(cpu_devices, seq=1)
+        placed = shard_packed(packed, mesh)
+        tq_p, ql_p = sharded_check(placed, mesh, packed_out=True)
+        tq_d, ql_d = sharded_check(placed, mesh, packed_out=False)
+        assert _tensors_to_results(tq_p) == _tensors_to_results(tq_d)
+        assert queue_lin_tensors_to_results(
+            ql_p
+        ) == queue_lin_tensors_to_results(ql_d)
+
+    def test_pipeline_family_serves_packed_results(self, tmp_path):
+        """The pipeline queue family rides packed verdict buffers by
+        default; bytes-to-verdict results must equal the serial dense
+        checkers'."""
+        import json
+
+        from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+        from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+        from jepsen_tpu.history.synth import SynthSpec, synth_batch
+        from jepsen_tpu.parallel.pipeline import check_sources
+
+        shs = synth_batch(4, SynthSpec(n_ops=80, seed=3), lost=1)
+        paths = []
+        for i, sh in enumerate(shs):
+            d = tmp_path / f"run{i}"
+            d.mkdir()
+            p = d / "history.jsonl"
+            with open(p, "w") as fh:
+                for op in sh.ops:
+                    row = {
+                        "index": op.index,
+                        "type": op.type.name.lower(),
+                        "f": op.f.name.lower(),
+                        "process": op.process,
+                        "value": op.value,
+                        "time": op.time,
+                    }
+                    fh.write(json.dumps(row) + "\n")
+            paths.append(str(p))
+        results, stats = check_sources("queue", paths, chunk=2)
+        assert stats.histories == len(paths)
+        for sh, r in zip(shs, results):
+            assert r["queue"] == check_total_queue_cpu(sh.ops)
+            ql = dict(check_queue_lin_cpu(sh.ops))
+            got = dict(r["linear"])
+            assert got.pop("delivery") == ql.pop("delivery")
+            assert got == ql
+
+
+# ---------------------------------------------------------------------------
+# donation: the staged batch is marked donated in the lowered module
+# ---------------------------------------------------------------------------
+
+
+def _donation_pinned(prog, *args) -> bool:
+    """True iff lowering ``prog(*args)`` proves the staged batch was
+    donated: either the lowered module carries donation metadata
+    (``tf.aliasing_output`` for aliased outputs, ``jax.buffer_donor``
+    when the runtime decides later) or jax raised its donated-buffers
+    warning (the donation was REQUESTED but this backend/shape pair
+    cannot alias it — e.g. every output smaller than every input, the
+    usual CPU case).  A program jitted WITHOUT donate_argnums produces
+    neither."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        txt = prog.lower(*args).as_text()
+    if "tf.aliasing_output" in txt or "jax.buffer_donor" in txt:
+        return True
+    return any(
+        "donated buffers were not usable" in str(w.message) for w in caught
+    )
+
+
+class TestDonation:
+    def test_donated_queue_stream_elle_programs(self):
+        from jepsen_tpu.checkers.elle import elle_mops_check, pack_elle_mops
+        from jepsen_tpu.checkers.fused import combined_tensor_check
+        from jepsen_tpu.checkers.stream_lin import (
+            pack_stream_histories,
+            stream_lin_tensor_check,
+        )
+        from jepsen_tpu.history.encode import pack_histories
+        from jepsen_tpu.history.synth import (
+            ElleSynthSpec,
+            StreamSynthSpec,
+            SynthSpec,
+            synth_batch,
+            synth_elle_batch,
+            synth_stream_batch,
+        )
+        from jepsen_tpu.parallel.pipeline import donated
+
+        q = pack_histories(
+            [sh.ops for sh in synth_batch(2, SynthSpec(n_ops=40))]
+        )
+        s = pack_stream_histories(
+            [
+                sh.ops
+                for sh in synth_stream_batch(2, StreamSynthSpec(n_ops=30))
+            ]
+        )
+        e, _metas = pack_elle_mops(
+            [
+                sh.ops
+                for sh in synth_elle_batch(2, ElleSynthSpec(n_txns=20))
+            ]
+        )
+        import jax
+
+        cases = [
+            (
+                donated(
+                    lambda p: combined_tensor_check(p, packed_out=True),
+                    key=("test", "queue-packed"),
+                ),
+                q,
+            ),
+            (
+                donated(
+                    stream_lin_tensor_check, key=("test", "stream")
+                ),
+                s,
+            ),
+            (donated(elle_mops_check, key=("test", "elle")), e),
+        ]
+        for prog, batch in cases:
+            assert _donation_pinned(prog, batch)
+        # control: an undonated jit of the same program pins NOTHING —
+        # the detector really keys on the donation
+        assert not _donation_pinned(
+            jax.jit(lambda p: combined_tensor_check(p, packed_out=True)), q
+        )
+
+    def test_wgl_bucket_programs_donate(self):
+        from jepsen_tpu.checkers.wgl import (
+            _wgl_program_cached,
+            pack_wgl_batch,
+            queue_wgl_ops,
+        )
+        from jepsen_tpu.checkers.wgl_pcomp import (
+            _subset_program_cached,
+            pack_subset_batch,
+        )
+        from jepsen_tpu.history.synth import SynthSpec, synth_history
+        from jepsen_tpu.models.core import UnorderedQueue
+
+        ops = queue_wgl_ops(synth_history(SynthSpec(n_ops=40)).ops)
+        rows = pack_wgl_batch([ops])
+        prog = _wgl_program_cached(
+            (UnorderedQueue, (32,)), rows.n, 16,
+            int(rows.cands.shape[-1]), donate=True,
+        )
+        assert _donation_pinned(
+            prog, rows.f, rows.a0, rows.a1, rows.ret_op, rows.cands
+        )
+
+        sub = pack_subset_batch([ops[:3]], 4)
+        sprog = _subset_program_cached(4, True)
+        assert _donation_pinned(
+            sprog, sub.enq, sub.deq, sub.ret_op, sub.cands
+        )
+
+    def test_donated_cache_memoizes_by_key(self):
+        from jepsen_tpu.checkers.fused import combined_tensor_check
+        from jepsen_tpu.parallel.pipeline import donated
+
+        a = donated(
+            lambda p: combined_tensor_check(p, packed_out=True),
+            key=("test-memo", "exactly-once", "packed"),
+        )
+        b = donated(
+            lambda p: combined_tensor_check(p, packed_out=True),
+            key=("test-memo", "exactly-once", "packed"),
+        )
+        assert a is b

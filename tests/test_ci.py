@@ -272,8 +272,54 @@ class TestBenchElleSmoke:
         assert r["flops_per_history"] == r["closure_dots"] * 2 * r[
             "txn_slots"
         ] ** 3
+        # round-14 roofline honesty: the row says WHICH representation
+        # was dispatched and computes bytes from ITS dtypes/shapes
+        from jepsen_tpu.checkers.elle import DEFAULT_CLOSURE
+
+        assert r["representation"] == DEFAULT_CLOSURE
+        assert e["closure"] == DEFAULT_CLOSURE
+        T = r["txn_slots"]
+        per_dot = {
+            "packed": 3 * T * ((T + 31) // 32) * 4,
+            "dense": 3 * T * T * 2,
+            "int8": 3 * T * T,
+        }[r["representation"]]
+        assert r["hbm_bytes_per_history"] == r["closure_dots"] * per_dot
         # CPU backend: achieved numbers present, utils honestly None
         assert e["hbm_util"] is None and e["mxu_util"] is None
+
+    def test_roofline_accounting_per_representation(self, bench):
+        """The packed/dense/int8 byte accounting, pinned: packed rows
+        must charge uint32-bitplane bytes (the 16× delta vs bf16 is
+        exactly the format tax the old accounting laundered), and
+        ``mxu_util`` must be None for the representation that does no
+        MXU work — packed and dense rows stay comparable because each
+        states its own traffic."""
+        import math
+
+        T = 128
+        dots = 3 * (math.ceil(math.log2(T)) + 1)
+        packed = bench._elle_roofline(T, 10.0, 10.0, representation="packed")
+        dense = bench._elle_roofline(T, 10.0, 10.0, representation="dense")
+        int8 = bench._elle_roofline(T, 10.0, 10.0, representation="int8")
+        assert packed["hbm_bytes_per_history"] == dots * 3 * T * (T // 32) * 4
+        assert dense["hbm_bytes_per_history"] == dots * 3 * T * T * 2
+        assert int8["hbm_bytes_per_history"] == dots * 3 * T * T
+        assert dense["hbm_bytes_per_history"] == (
+            16 * packed["hbm_bytes_per_history"]
+        )
+        # identical boolean-semiring op count across representations
+        assert (
+            packed["flops_per_history"]
+            == dense["flops_per_history"]
+            == int8["flops_per_history"]
+        )
+        assert "fixed-squaring upper bound" in packed["dots_note"]
+        assert "dots_note" not in dense
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError):
+            bench._elle_roofline(T, 1.0, 1.0, representation="bf8")
 
     def test_mutex_device_section_scoped_off_cpu(self, bench):
         """The pathological CPU-backend mutex device rows (BENCH_r05:
@@ -646,6 +692,47 @@ class TestBenchColdWarmSmoke:
         # a tiny easy row must not accidentally claim the ≥1k-op
         # crossover done-bar
         assert wp["crossover_met"] is False
+
+    def test_bitpack_section_schema(self, bench, monkeypatch):
+        """Offline gate for the round-14 ``bitpack`` bench schema: a
+        tiny REAL packed-vs-dense A/B per family (elle closure, queue
+        verdict buffers, wgl_pcomp engines) on the CPU backend must
+        carry the per-family rate/speedup keys and the done-bar block —
+        and, at scaled-down shapes, must be structurally UNABLE to
+        claim the ≥4× north-star done-bar no matter what ratios it
+        happens to measure."""
+        monkeypatch.setattr(bench, "ELLE_TXNS", 8)
+        monkeypatch.setattr(bench, "N_OPS", 40)
+        monkeypatch.setattr(bench, "LENGTH", 128)
+        monkeypatch.setattr(bench, "BITPACK_ELLE_BASE", 8)
+        monkeypatch.setattr(bench, "BITPACK_ELLE_BATCH", 8)
+        monkeypatch.setattr(bench, "BITPACK_QUEUE_BASE", 8)
+        monkeypatch.setattr(bench, "BITPACK_QUEUE_BATCH", 8)
+        monkeypatch.setattr(bench, "BITPACK_WGL_OPS", 60)
+        monkeypatch.setattr(bench, "BITPACK_WGL_WINDOW", 2)
+        monkeypatch.setattr(bench, "BITPACK_WGL_HISTS", 2)
+        monkeypatch.setattr(bench, "BITPACK_BLOCKS", 1)
+        monkeypatch.setattr(bench, "BITPACK_ITERS", 2)
+        details = {}
+        bench._bench_bitpack(details)
+        bp = details["bitpack"]
+        for key in ("families", "backend", "north_star", "done_bar"):
+            assert key in bp, f"bitpack schema lost key {key!r}"
+        assert set(bp["families"]) == {"elle", "queue", "wgl_pcomp"}
+        for name, row in bp["families"].items():
+            assert "error" not in row, (name, row)
+            assert row["packed_histories_per_sec"] > 0, name
+            assert row["dense_histories_per_sec"] > 0, name
+            assert row["speedup_packed_vs_dense"] > 0, name
+            assert row["winner"] in ("packed", "dense", "int8"), name
+            # the smoke runs SCALED-DOWN shapes: every row must say so
+            assert row["north_star_shape"] is False, name
+        assert "fused_speedup_packed_vs_dense" in bp["families"]["elle"]
+        db = bp["done_bar"]
+        assert db["threshold"] == 4.0 and db["families_needed"] == 2
+        # the easy-shape guarantee: no north-star row ⇒ no done-bar,
+        # regardless of the measured ratios
+        assert db["families_met"] == [] and db["met"] is False
 
     def test_obs_overhead_section_schema(self, bench):
         """Offline gate for the ISSUE-10 ``obs_overhead`` bench schema:

@@ -2476,6 +2476,230 @@ def _bench_bitpack_section(details: dict) -> None:
     _bench_bitpack(details)
 
 
+# ---------------------------------------------------------------------------
+# segmented: bounded-memory verdicts over a 1M-op history (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def _write_synth_queue_jsonl(path: str, n_ops: int, seed: int = 7) -> int:
+    """STREAM a healthy synthetic queue history of ~``n_ops`` op
+    entries to disk — the writer itself must be O(queue depth), or the
+    1M-op bench would need the very memory the segmented checker
+    exists to avoid.  Values are dense ints off one counter; every
+    acked enqueue is eventually dequeued (verdict: valid)."""
+    import random as _random
+
+    rng = _random.Random(seed)
+    nxt = 0
+    fifo: list[int] = []
+    clock = 0
+    written = 0
+    procs = 5
+    with open(path, "w") as fh:
+
+        def emit(d):
+            nonlocal written
+            fh.write(json.dumps(d) + "\n")
+            written += 1
+
+        def op(type_, f, process, value):
+            nonlocal clock
+            clock += rng.randrange(1, 2_000_000)
+            return {
+                "index": written, "type": type_, "f": f,
+                "process": process, "time": clock, "value": value,
+            }
+
+        while written < n_ops - 4:
+            p = rng.randrange(procs)
+            if fifo and (len(fifo) > 16 or rng.random() < 0.45):
+                v = fifo.pop(0)
+                emit(op("invoke", "dequeue", p, None))
+                emit(op("ok", "dequeue", p, v))
+            else:
+                v = nxt
+                nxt += 1
+                emit(op("invoke", "enqueue", p, v))
+                emit(op("ok", "enqueue", p, v))
+                fifo.append(v)
+        while fifo:  # drain so acked values are never "lost"
+            v = fifo.pop(0)
+            emit(op("invoke", "dequeue", 0, None))
+            emit(op("ok", "dequeue", 0, v))
+    return written
+
+
+def _seg_bench_child() -> None:
+    """Subprocess body for the ``segmented`` section: run one check
+    mode and report wall/RSS/verdict as a JSON line.  Modes:
+    ``seg`` (segmented engine), ``mono`` (whole-history CPU checkers),
+    optionally under an address-space cap (the refusal arm)."""
+    import resource
+
+    mode, path, segment_ops, rss_cap_mb = (
+        sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+    )
+    if rss_cap_mb:
+        cap = rss_cap_mb * (1 << 20)
+        resource.setrlimit(resource.RLIMIT_AS, (cap, cap))
+    t0 = time.perf_counter()
+    out: dict = {"mode": mode}
+    try:
+        if mode == "seg":
+            from jepsen_tpu.checkers.segmented import (
+                segmented_check_file,
+            )
+            from jepsen_tpu.obs.metrics import REGISTRY
+
+            r = segmented_check_file(
+                path, workload="queue", segment_ops=segment_ops,
+                opts={}, device=True,
+            )
+            sk = REGISTRY.sketch("segmented.segment_check_s")
+            out.update(
+                segments=r["segmented"]["segments"],
+                resumed=r["segmented"]["resumed"],
+                segment_p50_ms=sk.quantile(0.5) * 1e3,
+                segment_p99_ms=sk.quantile(0.99) * 1e3,
+            )
+            fams = {"queue": r["queue"], "linear": r["linear"]}
+        else:
+            from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+            from jepsen_tpu.checkers.total_queue import (
+                check_total_queue_cpu,
+            )
+            from jepsen_tpu.history.store import read_history
+
+            h = read_history(path)
+            fams = {
+                "queue": check_total_queue_cpu(h),
+                "linear": check_queue_lin_cpu(h),
+            }
+        from jepsen_tpu.history.store import _json_default
+
+        out["families"] = json.loads(
+            json.dumps(fams, default=_json_default)
+        )
+        out["ok"] = True
+    except MemoryError:
+        out["ok"] = False
+        out["oom"] = True
+    out["wall_s"] = time.perf_counter() - t0
+    out["maxrss_mb"] = (
+        resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
+    )
+    print("SEG_CHILD " + json.dumps(out), flush=True)
+
+
+def _seg_run_child(path, mode, segment_ops, rss_cap_mb=0, timeout=3600):
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [
+            sys.executable, "-c",
+            "import sys; sys.path.insert(0, sys.argv.pop(1));"
+            "import bench; bench._seg_bench_child()",
+            repo, mode, str(path), str(segment_ops), str(rss_cap_mb),
+        ],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    for line in r.stdout.splitlines():
+        if line.startswith("SEG_CHILD "):
+            return json.loads(line[len("SEG_CHILD "):])
+    return {
+        "ok": False,
+        "rc": r.returncode,
+        "tail": (r.stderr or r.stdout)[-300:],
+    }
+
+
+def _bench_segmented(
+    details: dict,
+    n_ops: int = 1_000_000,
+    segment_ops: int = 65536,
+    small_ops: int = 120_000,
+    seed: int = 7,
+) -> None:
+    """The ISSUE-15 acceptance measurement: a ``n_ops``-op history
+    checks end-to-end in bounded memory — peak RSS flat in history
+    length (full vs quarter-length runs compared), with verdicts
+    identical to the monolithic engine on the ``small_ops`` twin both
+    CAN run, per-segment latency p50/p99 off the PR-9 sketch, and the
+    monolithic engine REFUSING (MemoryError) under the segmented arm's
+    own memory budget.  A no-kill run must never claim a resume
+    (``resumed`` asserted False offline in tests/test_ci.py)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="jt_seg_bench_") as td:
+        big = os.path.join(td, "big.jsonl")
+        quarter = os.path.join(td, "quarter.jsonl")
+        small = os.path.join(td, "small.jsonl")
+        n_big = _write_synth_queue_jsonl(big, n_ops, seed)
+        n_quarter = _write_synth_queue_jsonl(
+            quarter, max(n_ops // 4, 2 * segment_ops), seed + 1
+        )
+        _write_synth_queue_jsonl(small, small_ops, seed + 2)
+
+        seg_big = _seg_run_child(big, "seg", segment_ops)
+        seg_quarter = _seg_run_child(quarter, "seg", segment_ops)
+        seg_small = _seg_run_child(small, "seg", segment_ops)
+        mono_small = _seg_run_child(small, "mono", segment_ops)
+        if not (seg_big.get("ok") and seg_quarter.get("ok")
+                and seg_small.get("ok") and mono_small.get("ok")):
+            raise RuntimeError(
+                f"segmented bench child failed: "
+                f"{[r for r in (seg_big, seg_quarter, seg_small, mono_small) if not r.get('ok')]}"
+            )
+        # the refusal arm: the monolithic engine under the SEGMENTED
+        # run's own peak budget (+25% headroom) must refuse the big
+        # history rather than silently thrash
+        budget_mb = int(seg_big["maxrss_mb"] * 1.25) + 1
+        mono_refused = _seg_run_child(
+            big, "mono", segment_ops, rss_cap_mb=budget_mb
+        )
+        flat = seg_big["maxrss_mb"] / max(seg_quarter["maxrss_mb"], 1e-9)
+        details["segmented"] = {
+            "backend": "cpu",  # RSS children are CPU-pinned by design
+            "n_ops": n_big,
+            "quarter_ops": n_quarter,
+            "segment_ops": segment_ops,
+            "segments": seg_big.get("segments"),
+            "seg_wall_s": round(seg_big["wall_s"], 2),
+            "seg_peak_rss_mb": round(seg_big["maxrss_mb"], 1),
+            "seg_quarter_rss_mb": round(seg_quarter["maxrss_mb"], 1),
+            "rss_flat_ratio": round(flat, 3),
+            "rss_bounded": flat <= 1.5,
+            "segment_p50_ms": round(seg_big["segment_p50_ms"], 2),
+            "segment_p99_ms": round(seg_big["segment_p99_ms"], 2),
+            "resumed": bool(seg_big.get("resumed")),
+            "verdicts_match": (
+                seg_small["families"] == mono_small["families"]
+            ),
+            "small_ops": small_ops,
+            "mono_small_rss_mb": round(mono_small["maxrss_mb"], 1),
+            "mono_small_wall_s": round(mono_small["wall_s"], 2),
+            "mono_budget_mb": budget_mb,
+            "mono_refused_under_seg_budget": bool(
+                mono_refused.get("oom")
+                or (not mono_refused.get("ok"))
+            ),
+            "seg_verdict": seg_big["families"]["queue"]["valid?"],
+        }
+    print(
+        f"# segmented: {json.dumps(details['segmented'])}",
+        file=sys.stderr,
+    )
+
+
+def _bench_segmented_section(details: dict) -> None:
+    """``segmented`` for the section loop: all measurement happens in
+    CPU-pinned RSS-metered subprocesses, so the section runs the same
+    on every backend (the segmented carry is host-side; the per-segment
+    device dispatch is the CPU backend's in these children)."""
+    _bench_segmented(details)
+
+
 #: always the repo-root copy, regardless of the invoker's cwd — the
 #: committed artifact is what harvest.needs_chip_refresh() reads
 DETAILS_PATH = os.path.join(
@@ -2708,7 +2932,7 @@ def _run_once() -> None:
     for section in (
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
-        _bench_bitpack_section,
+        _bench_bitpack_section, _bench_segmented_section,
         _bench_north_star_section, _bench_cold_vs_warm_section,
         _bench_obs_overhead_section, _bench_elastic_overhead_section,
         _bench_cluster_obs_overhead_section,

@@ -47,6 +47,9 @@ _LAZY = {
     "elle_mops_check": "elle",
     "elle_infer_device": "elle",
     "pack_elle_mops": "elle",
+    "SegmentedChecker": "segmented",
+    "segmented_check_file": "segmented",
+    "LiveSegmentChecker": "segmented",
     "pack_bits": "bitset",
     "unpack_bits": "bitset",
     "popcount32": "bitset",

@@ -55,7 +55,12 @@ import numpy as np
 #: bits per lane — the packing granule
 LANE_BITS = 32
 
-_U1 = jnp.uint32(1)
+# NOTE: a plain numpy scalar, NOT jnp.uint32(1) — a module-level jnp
+# constant materializes on the default device at IMPORT time, which
+# initializes the backend in every process that imports a queue
+# checker and breaks `jax.distributed.initialize()` in the fail-fast
+# multi-process workers ("must be called before any JAX computations")
+_U1 = np.uint32(1)
 _SHIFTS = tuple(range(LANE_BITS))
 
 

@@ -15,10 +15,23 @@ from jepsen_tpu.history.ops import (  # noqa: F401
 
 _ENCODE_NAMES = ("PackedHistories", "pack_histories", "pack_history")
 
+# the streaming segment reader (SEGMENTED.md) is jax-free like ops/store
+_SEGMENT_NAMES = (
+    "Segment",
+    "SegmentPoisonError",
+    "SourceMismatchError",
+    "iter_segments",
+    "prefix_sha256",
+)
+
 
 def __getattr__(name):
     if name in _ENCODE_NAMES:
         from jepsen_tpu.history import encode
 
         return getattr(encode, name)
+    if name in _SEGMENT_NAMES:
+        from jepsen_tpu.history import segments
+
+        return getattr(segments, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

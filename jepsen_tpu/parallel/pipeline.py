@@ -2464,3 +2464,57 @@ def attach_pipelined_checkers(test, workload: str, **scale_opts) -> bool:
         )
         return True
     return False
+
+
+# ---------------------------------------------------------------------------
+# segment-producer mode (ISSUE 15 / SEGMENTED.md)
+# ---------------------------------------------------------------------------
+
+
+def check_source_segmented(
+    workload: str,
+    src,
+    *,
+    segment_ops: int,
+    resume: bool = False,
+    carry_cap: int | None = None,
+    device: bool = True,
+    keep_checkpoint: bool = False,
+    **opts,
+) -> tuple[dict, "PipelineStats"]:
+    """The pipeline's segment-producer mode: ONE history streamed
+    through the segmented carry engine (``checkers/segmented.py``) in
+    fixed-shape segments — bounded memory regardless of history
+    length, durable per-segment checkpoints, ``resume=True`` to
+    continue a killed check from the last one.
+
+    The producer here is the op axis, not the file axis: per-segment
+    check latency lands in the run registry's
+    ``segmented.segment_check_s`` sketch (the same PR-9 substrate the
+    batch executor's ``check_batch_s`` uses) and the returned
+    :class:`PipelineStats` view reports segments as checked batches,
+    so ``bench-check``-style consumers read one accounting surface for
+    both modes.
+    """
+    from jepsen_tpu.checkers.segmented import segmented_check_file
+    from jepsen_tpu.obs.metrics import REGISTRY
+
+    stats = PipelineStats()
+    t0 = time.perf_counter()
+    before = REGISTRY.value("segmented.segments")
+    result = segmented_check_file(
+        src,
+        workload=workload,
+        segment_ops=segment_ops,
+        opts={k: v for k, v in opts.items() if v is not None},
+        resume=resume,
+        carry_cap=carry_cap,
+        device=device,
+        keep_checkpoint=keep_checkpoint,
+    )
+    t1 = time.perf_counter()
+    segs = int(REGISTRY.value("segmented.segments") - before)
+    stats.histories = 1
+    stats.batches = segs
+    stats.add_busy("check", t0, t1)
+    return result, stats

@@ -10,6 +10,8 @@ import socket
 import struct
 import time
 
+from _load import scaled
+
 from jepsen_tpu.harness.broker import (
     FRAME_END,
     MiniAmqpBroker,
@@ -187,7 +189,7 @@ def test_consume_rejected_when_declare_came_via_another_node():
         for nm in names
     }
     try:
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + scaled(5.0)
         while time.monotonic() < deadline and not any(
             b.replication.raft.is_leader() for b in brokers.values()
         ):
@@ -199,7 +201,7 @@ def test_consume_rejected_when_declare_came_via_another_node():
 
         # wait for n1's replica to apply the committed declare
         mach = brokers["n1"].replication.machine
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + scaled(5.0)
         while time.monotonic() < deadline:
             with mach.lock:
                 if (mach.meta.get("jepsen.lock") or {}).get("fenced"):
@@ -248,7 +250,7 @@ def test_plain_redeclare_via_another_node_clears_fencedness():
         for nm in names
     }
     try:
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + scaled(5.0)
         while time.monotonic() < deadline and not any(
             b.replication.raft.is_leader() for b in brokers.values()
         ):
@@ -262,7 +264,7 @@ def test_plain_redeclare_via_another_node_clears_fencedness():
 
         # wait for n0's replica to apply the committed plain redeclare
         mach = brokers["n0"].replication.machine
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + scaled(5.0)
         while time.monotonic() < deadline:
             with mach.lock:
                 meta = mach.meta.get("jepsen.lock")
@@ -329,7 +331,7 @@ def test_unacked_consumer_on_newly_fenced_queue_is_closed_not_stalled():
         ),
     ).start()
     try:
-        deadline = time.monotonic() + 5.0
+        deadline = time.monotonic() + scaled(5.0)
         while time.monotonic() < deadline and not b.replication.raft.is_leader():
             time.sleep(0.02)
         assert b.replication.raft.is_leader()

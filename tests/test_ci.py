@@ -1005,6 +1005,100 @@ class TestChaosHarnessSmoke:
         assert (tmp_path / "chaos_smoke" / "chaos_check.log").exists()
 
 
+class TestSegmentedSectionSchema:
+    """Offline gate for the ISSUE-15 ``segmented`` bench schema: a
+    tiny REAL run (RSS-metered CPU subprocesses) must carry the
+    bounded-memory keys, the verdict-equivalence flag, and pin the
+    honesty rule that a NO-KILL run can never claim a resume."""
+
+    @pytest.fixture()
+    def bench(self):
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        import bench as bench_mod
+
+        return bench_mod
+
+    def test_segmented_section_schema(self, bench):
+        details = {}
+        bench._bench_segmented(
+            details, n_ops=4000, segment_ops=512, small_ops=1200
+        )
+        sg = details["segmented"]
+        for key in (
+            "n_ops",
+            "segment_ops",
+            "segments",
+            "seg_wall_s",
+            "seg_peak_rss_mb",
+            "seg_quarter_rss_mb",
+            "rss_flat_ratio",
+            "rss_bounded",  # THE bounded-memory key
+            "segment_p50_ms",
+            "segment_p99_ms",
+            "resumed",
+            "verdicts_match",
+            "mono_small_rss_mb",
+            "mono_refused_under_seg_budget",
+            "backend",
+        ):
+            assert key in sg, f"segmented schema lost key {key!r}"
+        assert sg["segments"] >= 2
+        assert sg["seg_peak_rss_mb"] > 0
+        assert sg["rss_flat_ratio"] == sg["rss_flat_ratio"]  # finite
+        # the DIFFERENTIAL half: segmented == monolithic on the twin
+        # both engines can run
+        assert sg["verdicts_match"] is True
+        # honesty rule: a no-kill run can NEVER claim a resume
+        assert sg["resumed"] is False
+        assert "resumed_from" not in sg
+
+
+class TestSegmentedChaosSmoke:
+    """The segmented kill/resume proof harness (``tools/chaos_check.py
+    --segmented``) must stay runnable offline: the DETERMINISTIC
+    die-after-segment hook (no wall-clock kill races in CI), tiny
+    sizes, every built-in assertion green — uninterrupted oracle,
+    mid-check death leaves a durable checkpoint, resume reaches the
+    identical verdict, a torn checkpoint is refused and recovered.
+    The real-SIGKILL run at scale is a committed capture
+    (``store/chaos_r15_seg``), not suite work."""
+
+    def test_die_env_resume_green(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "chaos_check_seg_under_test",
+            str(REPO / "tools" / "chaos_check.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(
+            [
+                "--segmented",
+                "--mode", "die-env",
+                "--seg-ops", "250",
+                "--seg-history-ops", "1500",
+                "--out", str(tmp_path / "seg_chaos"),
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(
+            (tmp_path / "seg_chaos" / "results.json").read_text()
+        )
+        assert doc["pass"] is True
+        assert doc["tool"] == "chaos_check --segmented"
+        assert not doc["failures"]
+
+
 class TestFuzzMatrixSmoke:
     """Offline deterministic fuzzer smoke (sim harness, fixed seed,
     tiny budget): the run/triage/minimize plumbing must round-trip —

@@ -10,6 +10,8 @@ end-to-end (dead-letter + skew + partitions on a live cluster).
 
 import time
 
+from _load import scaled
+
 import pytest
 
 from jepsen_tpu.harness.replication import ReplicatedBackend
@@ -25,7 +27,7 @@ def _backend():
 
 
 def _wait_leader(b, timeout_s=5.0):
-    deadline = time.monotonic() + timeout_s
+    deadline = time.monotonic() + scaled(timeout_s)
     while time.monotonic() < deadline:
         if b.raft.is_leader():
             return

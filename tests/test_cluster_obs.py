@@ -27,6 +27,8 @@ import json
 import socket
 import tempfile
 import time
+
+from _load import scaled
 import xml.etree.ElementTree as ET
 from pathlib import Path
 
@@ -95,7 +97,7 @@ class _Cluster:
             )
 
     def leader(self, timeout=8.0) -> str:
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + scaled(timeout)
         while time.monotonic() < deadline:
             for nm, b in self.backends.items():
                 if b.raft.is_leader():
@@ -252,7 +254,7 @@ class TestNodeTelemetry:
             heartbeat_s=0.02,
         )
         try:
-            deadline = time.monotonic() + 5.0
+            deadline = time.monotonic() + scaled(5.0)
             while not node.is_leader():
                 assert time.monotonic() < deadline, "no self-election"
                 time.sleep(0.01)
@@ -297,7 +299,7 @@ class TestClusterPoller:
                 if nm != lead:
                     bb.raft.block(lead)  # one-way-out the leader
             new = None
-            deadline = time.monotonic() + 8.0
+            deadline = time.monotonic() + scaled(8.0)
             while time.monotonic() < deadline:
                 for nm, bb in c.backends.items():
                     if nm != lead and bb.raft.is_leader():

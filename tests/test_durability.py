@@ -21,6 +21,8 @@ import os
 import tempfile
 import time
 
+from _load import scaled
+
 import pytest
 
 from jepsen_tpu.harness.replication import RaftNode, ReplicatedBackend
@@ -38,7 +40,7 @@ def _one_node_backend(data_dir, seed_bug=None):
 
 
 def _wait_leader(backend, timeout_s=5.0):
-    deadline = time.monotonic() + timeout_s
+    deadline = time.monotonic() + scaled(timeout_s)
     while time.monotonic() < deadline:
         if backend.raft.is_leader():
             return
@@ -65,7 +67,7 @@ def test_wal_recover_roundtrip():
         try:
             _wait_leader(b2)
             # the leader's no-op commits the recovered tail
-            deadline = time.monotonic() + 5.0
+            deadline = time.monotonic() + scaled(5.0)
             while time.monotonic() < deadline:
                 if b2.counts().get("q") == 3:  # 2 ready + 1 inflight
                     break
@@ -132,7 +134,7 @@ def test_append_after_torn_tail_recovery_survives_next_crash():
         b2 = _one_node_backend(d)
         try:
             _wait_leader(b2)
-            deadline = time.monotonic() + 5.0
+            deadline = time.monotonic() + scaled(5.0)
             while time.monotonic() < deadline:
                 if b2.counts().get("q") == 1:
                     break
@@ -144,7 +146,7 @@ def test_append_after_torn_tail_recovery_survives_next_crash():
         b3 = _one_node_backend(d)  # crash #2: B must still be there
         try:
             _wait_leader(b3)
-            deadline = time.monotonic() + 5.0
+            deadline = time.monotonic() + scaled(5.0)
             while time.monotonic() < deadline:
                 if b3.counts().get("q") == 2:
                     break

@@ -18,6 +18,8 @@ import socket
 import tempfile
 import time
 
+from _load import scaled
+
 import pytest
 
 from jepsen_tpu.harness.replication import (
@@ -66,7 +68,7 @@ class _Cluster:
         )
 
     def leader(self, timeout=8.0) -> str:
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + scaled(timeout)
         while time.monotonic() < deadline:
             for nm, b in self.backends.items():
                 if b.raft.is_leader():
@@ -107,7 +109,7 @@ class _Cluster:
             return [msg.body for msg in m.queues.get(q, ())]
 
     def converged(self, q: str, timeout=8.0) -> bool:
-        deadline = time.monotonic() + timeout
+        deadline = time.monotonic() + scaled(timeout)
         while time.monotonic() < deadline:
             views = {
                 nm: tuple(self.queue_bodies(nm, q))
@@ -159,7 +161,7 @@ class TestSlowDisk:
             # recovery replays the WAL as the new leader's noop commit
             # advances — poll until the confirmed set is back (an
             # all-empty snapshot taken before replay proves nothing)
-            deadline = time.monotonic() + 12.0
+            deadline = time.monotonic() + scaled(12.0)
             recovered: set[bytes] = set()
             while time.monotonic() < deadline and not (
                 set(acked) <= recovered
@@ -248,7 +250,7 @@ class TestOneWayPartition:
             )
             # a new leader rises among the majority (they stopped
             # hearing the old one's appends)
-            deadline = time.monotonic() + 8.0
+            deadline = time.monotonic() + scaled(8.0)
             new_lead = None
             while time.monotonic() < deadline and new_lead is None:
                 for nm, nb in c.backends.items():
@@ -281,7 +283,7 @@ class TestOneWayPartition:
             c.one_way_out(lead)
             # THE BUG: local-append confirm while nobody can hear it
             assert b.enqueue("q", b"2", b"") is True
-            deadline = time.monotonic() + 8.0
+            deadline = time.monotonic() + scaled(8.0)
             new_lead = None
             while time.monotonic() < deadline and new_lead is None:
                 for nm, nb in c.backends.items():
@@ -413,7 +415,7 @@ class TestWireChaos:
 
             b = c.backends[lead]
             b.declare("q")
-            deadline = time.monotonic() + 30.0
+            deadline = time.monotonic() + scaled(30.0)
             i = 0
             while not diverged() and time.monotonic() < deadline:
                 v = f"{10000 + i}".encode()

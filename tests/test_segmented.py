@@ -1,0 +1,842 @@
+"""Differential + crash coverage for segmented online checking
+(ISSUE 15, SEGMENTED.md): segmented ≡ monolithic verdicts across
+queue/stream/elle/pcomp on the synth corpus — including violations
+that SPAN a segment boundary, the settled-value reopen path, the
+degenerate-elle splice, and the pcomp overflow→unknown carry — plus
+the checkpoint contract: kill-mid-segment resume ≡ uninterrupted run,
+torn/corrupt checkpoints refused loudly and recomputed from the
+previous one, poison quarantined as unknown-with-evidence that can
+never fold into valid."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+from jepsen_tpu.checkers.elle import check_elle_cpu
+from jepsen_tpu.checkers.queue_lin import check_queue_lin_cpu
+from jepsen_tpu.checkers.segmented import (
+    LiveSegmentChecker,
+    SegmentedChecker,
+    checkpoint_path_for,
+    read_checkpoint,
+    segmented_check_file,
+)
+from jepsen_tpu.checkers.stream_lin import check_stream_lin_cpu
+from jepsen_tpu.checkers.total_queue import check_total_queue_cpu
+from jepsen_tpu.history.ops import Op, OpF, OpType
+from jepsen_tpu.history.segments import (
+    SegmentPoisonError,
+    SourceMismatchError,
+    iter_segments,
+    prefix_sha256,
+)
+from jepsen_tpu.history.store import _json_default, write_history_jsonl
+from jepsen_tpu.history.synth import (
+    ElleSynthSpec,
+    MutexSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_elle_history,
+    synth_history,
+    synth_mutex_history,
+    synth_stream_history,
+)
+
+
+def norm(x):
+    return json.loads(json.dumps(x, default=_json_default))
+
+
+def run_segmented(workload, ops, segment_ops, opts=None, device=False,
+                  carry_cap=None):
+    eng = SegmentedChecker(
+        workload, opts=opts or {}, device=device, carry_cap=carry_cap
+    )
+    for i in range(0, len(ops), segment_ops):
+        eng.feed(ops[i : i + segment_ops])
+    return eng.finish()
+
+
+# ---------------------------------------------------------------------------
+# queue family: segmented ≡ total-queue AND queue-linearizability
+# ---------------------------------------------------------------------------
+
+QUEUE_ANOMALIES = (
+    {},
+    {"lost": 2},
+    {"duplicated": 2},
+    {"unexpected": 1},
+    {"phantom_fail": 1},
+    {"causality": 1},
+    {"lost": 1, "duplicated": 1, "unexpected": 1, "causality": 1},
+)
+
+
+class TestQueueSegmentedDifferential:
+    @pytest.mark.parametrize("kw", QUEUE_ANOMALIES)
+    @pytest.mark.parametrize("delivery", ["exactly-once", "at-least-once"])
+    def test_matches_monolithic(self, kw, delivery):
+        sh = synth_history(SynthSpec(n_ops=173, seed=5, **kw))
+        mono_q = norm(check_total_queue_cpu(sh.ops))
+        mono_l = norm(check_queue_lin_cpu(sh.ops, delivery=delivery))
+        for seg in (7, 64):
+            r = run_segmented(
+                "queue", sh.ops, seg, opts={"delivery": delivery}
+            )
+            assert norm(r["queue"]) == mono_q, f"total-queue @ seg={seg}"
+            assert norm(r["linear"]) == mono_l, f"queue-lin @ seg={seg}"
+
+    def test_device_program_matches_host_carry(self):
+        sh = synth_history(
+            SynthSpec(n_ops=173, seed=5, lost=1, duplicated=1)
+        )
+        host = run_segmented("queue", sh.ops, 50, device=False)
+        dev = run_segmented("queue", sh.ops, 50, device=True)
+        assert norm(host["queue"]) == norm(dev["queue"])
+        assert norm(host["linear"]) == norm(dev["linear"])
+        assert norm(dev["queue"]) == norm(check_total_queue_cpu(sh.ops))
+
+    def test_carry_is_residual_not_linear(self):
+        """The bounded-memory mechanism itself: on a healthy history
+        almost every value settles to one bit — the dict residue must
+        be a small fraction of the distinct-value count."""
+        sh = synth_history(SynthSpec(n_ops=2000, seed=3))
+        eng = SegmentedChecker("queue", device=False)
+        for i in range(0, len(sh.ops), 200):
+            eng.feed(sh.ops[i : i + 200])
+        carry = eng.carry.carry_size()
+        assert carry["settled"] > 300
+        assert carry["open"] + carry["reopened"] < carry["settled"] / 4
+        assert norm(eng.finish()["queue"]) == norm(
+            check_total_queue_cpu(sh.ops)
+        )
+
+
+def _op(type_, f, process, value, t):
+    return Op(OpType[type_], OpF[f], process, value, time=t)
+
+
+class TestQueueBoundarySpanning:
+    """Violations whose evidence spans a segment boundary — including
+    the settled→reopened path (the value left the residue for a
+    presence bit segments earlier)."""
+
+    def _base(self):
+        ops = []
+        t = 0
+        for v in range(6):  # six clean settled lives
+            t += 2
+            ops.append(_op("INVOKE", "ENQUEUE", v % 3, v, t))
+            ops.append(_op("OK", "ENQUEUE", v % 3, v, t + 1))
+            ops.append(_op("INVOKE", "DEQUEUE", v % 3, None, t + 2))
+            ops.append(_op("OK", "DEQUEUE", v % 3, v, t + 3))
+        return ops, t
+
+    def test_duplicate_read_of_long_settled_value(self):
+        ops, t = self._base()
+        # value 0 settled ~5 segments ago (seg=4); a second read now
+        ops.append(_op("INVOKE", "DEQUEUE", 0, None, t + 10))
+        ops.append(_op("OK", "DEQUEUE", 0, 0, t + 11))
+        for seg in (4, 5):
+            r = run_segmented("queue", ops, seg)
+            assert norm(r["queue"]) == norm(check_total_queue_cpu(ops))
+            assert norm(r["linear"]) == norm(check_queue_lin_cpu(ops))
+            assert r["queue"]["duplicated"] == {0}
+            assert r["linear"]["duplicate"] == {0}
+
+    def test_late_ack_turns_settled_value_lost(self):
+        ops, t = self._base()
+        # a duplicate ack of settled value 1, far later: e > d => lost
+        ops.append(_op("OK", "ENQUEUE", 1, 1, t + 10))
+        for seg in (4, 100):
+            r = run_segmented("queue", ops, seg)
+            assert norm(r["queue"]) == norm(check_total_queue_cpu(ops))
+            assert r["queue"]["valid?"] is False
+            assert r["queue"]["lost"] == {1}
+
+    def test_loss_across_the_whole_history(self):
+        ops, t = self._base()
+        # acked in segment 0, never read: lost only judged at the end
+        ops.insert(0, _op("OK", "ENQUEUE", 4, 99, 1))
+        ops.insert(0, _op("INVOKE", "ENQUEUE", 4, 99, 0))
+        for seg in (4, 6):
+            r = run_segmented("queue", ops, seg)
+            assert norm(r["queue"]) == norm(check_total_queue_cpu(ops))
+            assert r["queue"]["lost"] == {99}
+
+    def test_causality_pair_spanning_boundary(self):
+        ops, t = self._base()
+        # read completes now; its enqueue is only invoked segments later
+        ops.append(_op("INVOKE", "DEQUEUE", 4, None, t + 10))
+        ops.append(_op("OK", "DEQUEUE", 4, 777, t + 11))
+        for v in range(700, 706):  # filler segment between
+            ops.append(_op("INVOKE", "ENQUEUE", 3, v, t + 12))
+            ops.append(_op("OK", "ENQUEUE", 3, v, t + 13))
+            ops.append(_op("INVOKE", "DEQUEUE", 3, None, t + 14))
+            ops.append(_op("OK", "DEQUEUE", 3, v, t + 15))
+        ops.append(_op("INVOKE", "ENQUEUE", 4, 777, t + 20))
+        ops.append(_op("OK", "ENQUEUE", 4, 777, t + 21))
+        for seg in (5, 9):
+            r = run_segmented("queue", ops, seg)
+            assert norm(r["linear"]) == norm(check_queue_lin_cpu(ops))
+            assert r["linear"]["causality"] == {777}
+            assert r["linear"]["valid?"] is False
+
+
+# ---------------------------------------------------------------------------
+# stream
+# ---------------------------------------------------------------------------
+
+
+class TestStreamSegmentedDifferential:
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"lost": 1},
+            {"duplicated": 1},
+            {"phantom": 1},
+            {"reorder": 1},
+            {"divergent": 1},
+            {"nonmonotonic": 1},
+            {"recovered": 1},
+        ],
+    )
+    @pytest.mark.parametrize("append_fail", ["definite", "indeterminate"])
+    def test_matches_monolithic(self, kw, append_fail):
+        sh = synth_stream_history(
+            StreamSynthSpec(n_ops=180, seed=3, **kw)
+        )
+        mono = norm(check_stream_lin_cpu(sh.ops, append_fail=append_fail))
+        for seg in (11, 60):
+            r = run_segmented(
+                "stream", sh.ops, seg, opts={"append_fail": append_fail}
+            )
+            assert norm(r["stream"]) == mono, f"stream @ seg={seg}"
+
+    def test_full_read_pending_across_boundary(self):
+        """A full read invoked in one segment and completing two
+        segments later must still arm loss judgment."""
+        sh = synth_stream_history(StreamSynthSpec(n_ops=120, seed=9))
+        mono = norm(check_stream_lin_cpu(sh.ops))
+        r = run_segmented("stream", sh.ops, 7)
+        assert norm(r["stream"]) == mono
+        assert r["stream"]["full-read"] == mono["full-read"]
+
+
+# ---------------------------------------------------------------------------
+# elle
+# ---------------------------------------------------------------------------
+
+ELLE_ANOMALIES = (
+    {},
+    {"g1a": 1},
+    {"g1b": 1},
+    {"g0_cycle": 1},
+    {"g1c_cycle": 1},
+    {"g2_cycle": 1},
+    {"g1a": 1, "g0_cycle": 1, "g2_cycle": 1},
+)
+
+
+class TestElleSegmentedDifferential:
+    @pytest.mark.parametrize("kw", ELLE_ANOMALIES)
+    @pytest.mark.parametrize("model", ["serializable", "read-committed"])
+    def test_matches_monolithic(self, kw, model):
+        sh = synth_elle_history(ElleSynthSpec(n_txns=60, seed=4, **kw))
+        mono = norm(check_elle_cpu(sh.ops, model=model))
+        for seg in (13, 50):
+            r = run_segmented(
+                "elle", sh.ops, seg, opts={"model": model}
+            )
+            assert norm(r["elle"]) == mono, f"elle {kw} @ seg={seg}"
+
+    def test_cycle_spanning_boundary(self):
+        """A G0 cycle whose txns land in DIFFERENT segments: the
+        condensed carry (refs + writer map) must still close it."""
+        sh = synth_elle_history(
+            ElleSynthSpec(n_txns=40, seed=8, g0_cycle=1)
+        )
+        mono = norm(check_elle_cpu(sh.ops))
+        assert mono["G0-count"] >= 1
+        # segment size 3: every multi-txn structure spans boundaries
+        r = run_segmented("elle", sh.ops, 3)
+        assert norm(r["elle"]) == mono
+
+    def test_g1b_with_same_value_under_two_keys(self):
+        """Review finding: one txn appending the SAME value under two
+        keys must not mask G1b on the first key — the carry's writer
+        map keeps a per-key last-append flag, mirroring the monolithic
+        appends_of[(txn, key)] lookup."""
+        mk = lambda t, f, p, v, time_: Op(t, f, p, v, time=time_)
+        T, F = OpType, OpF
+        ops = []
+        t = 0
+        for value in (
+            # A: 5 is an INTERMEDIATE append to k1 (6 follows), but
+            # the LAST append to k2 — the k2 entry must not launder
+            # the k1 intermediate read below
+            [["append", 1, 5], ["append", 1, 6], ["append", 2, 5]],
+            [["r", 1, [5]]],  # B reads k1 -> [5]: G1b
+        ):
+            t += 2
+            ops.append(mk(T.INVOKE, F.TXN, 0, value, t))
+            ops.append(mk(T.OK, F.TXN, 0, value, t + 1))
+        mono = norm(check_elle_cpu(ops))
+        assert 1 in mono["G1b"] and mono["valid?"] is False
+        for seg in (1, 4):
+            r = run_segmented("elle", ops, seg)
+            assert norm(r["elle"]) == mono, f"G1b two-key @ seg={seg}"
+
+    def test_degenerate_splice(self):
+        """The degenerate shapes the DEVICE elle encoding refuses
+        (value appended twice, observed under two keys, duplicated in
+        one read — elle_mops_for's host-fallback cases) must check
+        identically through the segmented carry, because its finish
+        pass mirrors the host infer_txn_graph rules exactly."""
+        mk = lambda t, f, p, v, time_: Op(t, f, p, v, time=time_)
+        T, F = OpType, OpF
+        ops = []
+        t = 0
+        # txn 0 appends v=5 to key 1; txn 1 appends v=5 AGAIN (twice,
+        # once under another key); txn 2 reads [5, 5] (duplicated in
+        # one read) on key 1
+        for value in (
+            [["append", 1, 5]],
+            [["append", 1, 5], ["append", 2, 5]],
+            [["r", 1, [5, 5]]],
+            [["r", 2, [5]]],
+        ):
+            t += 2
+            ops.append(mk(T.INVOKE, F.TXN, 0, value, t))
+            ops.append(mk(T.OK, F.TXN, 0, value, t + 1))
+        mono = norm(check_elle_cpu(ops))
+        for seg in (1, 2, 8):
+            r = run_segmented("elle", ops, seg)
+            assert norm(r["elle"]) == mono, f"degenerate @ seg={seg}"
+
+
+# ---------------------------------------------------------------------------
+# mutex / pcomp
+# ---------------------------------------------------------------------------
+
+
+class TestMutexSegmented:
+    @pytest.mark.parametrize(
+        "kw",
+        [
+            {},
+            {"double_grant": 1},
+            {"n_locks": 3},
+            {"n_locks": 3, "double_grant": 2},
+        ],
+    )
+    def test_verdict_matches_monolithic(self, kw):
+        from jepsen_tpu.checkers.wgl import MutexWgl
+
+        sh = synth_mutex_history(MutexSynthSpec(n_ops=80, seed=2, **kw))
+        mono = MutexWgl(backend="tpu").check({}, sh.ops)
+        for seg in (19, 200):
+            r = run_segmented("mutex", sh.ops, seg, device=True)
+            assert r["mutex"]["valid?"] == mono["valid?"], (
+                f"mutex {kw} @ seg={seg}: {r['mutex']} vs {mono}"
+            )
+
+    def test_violation_spanning_boundary(self):
+        """A double grant whose two acquires straddle a segment
+        boundary: the open-class carry must deliver both to one
+        frontier search."""
+        mk = _op
+        ops = [
+            mk("INVOKE", "ACQUIRE", 0, None, 0),
+            mk("OK", "ACQUIRE", 0, None, 1),
+            # --- boundary lands here at seg=2 ---
+            mk("INVOKE", "ACQUIRE", 1, None, 2),
+            mk("OK", "ACQUIRE", 1, None, 3),  # split-brain grant
+            mk("INVOKE", "RELEASE", 0, None, 4),
+            mk("OK", "RELEASE", 0, None, 5),
+            mk("INVOKE", "RELEASE", 1, None, 6),
+            mk("OK", "RELEASE", 1, None, 7),
+        ]
+        from jepsen_tpu.checkers.wgl import MutexWgl
+
+        assert MutexWgl(backend="tpu").check({}, ops)["valid?"] is False
+        for seg in (2, 3):
+            r = run_segmented("mutex", ops, seg, device=True)
+            assert r["mutex"]["valid?"] is False
+
+    def test_overflow_escalates_to_unknown_with_evidence(self):
+        """pcomp overflow→unknown carry: a lock held open past the
+        carry cap must surface as unknown WITH the class named —
+        never a silent truncation, never a fabricated verdict."""
+        mk = _op
+        ops = []
+        t = 0
+        # a lock that is NEVER free at any boundary: overlapping
+        # hold chain acquire(p)->acquire(q)... with releases lagging
+        ops.append(mk("INVOKE", "ACQUIRE", 0, None, t))
+        ops.append(mk("OK", "ACQUIRE", 0, None, t + 1))
+        for i in range(30):
+            t += 2
+            p = (i + 1) % 3
+            ops.append(mk("INVOKE", "ACQUIRE", p, None, t))
+            ops.append(mk("INVOKE", "RELEASE", (i % 3), None, t + 1))
+            ops.append(mk("OK", "RELEASE", (i % 3), None, t + 2))
+            ops.append(mk("OK", "ACQUIRE", p, None, t + 3))
+        r = run_segmented("mutex", ops, 8, carry_cap=10)
+        assert r["mutex"]["valid?"] == "unknown"
+        ov = r["mutex"]["carry-overflow"]
+        assert ov["carry-cap"] == 10
+        assert ov["carried-ops"] > 10
+        assert "largest-class" in ov
+
+    def test_indeterminate_acquire_carries_to_finish(self):
+        """An info acquire never completes, so its class never closes
+        mid-stream — it must be judged at finish exactly as the
+        monolithic engine sees it (ret = INF)."""
+        from jepsen_tpu.checkers.wgl import MutexWgl
+
+        sh = synth_mutex_history(
+            MutexSynthSpec(n_ops=60, seed=6, p_info=0.3)
+        )
+        mono = MutexWgl(backend="tpu").check({}, sh.ops)
+        r = run_segmented("mutex", sh.ops, 11, device=True)
+        assert r["mutex"]["valid?"] == mono["valid?"]
+
+    def test_fenced_autodetect(self):
+        from jepsen_tpu.checkers.wgl import MutexWgl
+
+        # fenced grants carry int tokens: build a tiny fenced history
+        mk = _op
+        ops = [
+            mk("INVOKE", "ACQUIRE", 0, None, 0),
+            mk("OK", "ACQUIRE", 0, 1, 1),  # token 1
+            mk("INVOKE", "RELEASE", 0, 1, 2),
+            mk("OK", "RELEASE", 0, 1, 3),
+            mk("INVOKE", "ACQUIRE", 1, None, 4),
+            mk("OK", "ACQUIRE", 1, 2, 5),
+            mk("INVOKE", "RELEASE", 1, 2, 6),
+            mk("OK", "RELEASE", 1, 2, 7),
+        ]
+        mono = MutexWgl(backend="tpu").check({}, ops)
+        r = run_segmented("mutex", ops, 4, device=True)
+        assert r["mutex"]["valid?"] == mono["valid?"] is True
+        assert r["mutex"]["model"] == mono["model"]
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: resume ≡ uninterrupted, torn refused loudly
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def queue_history_file(tmp_path):
+    sh = synth_history(
+        SynthSpec(n_ops=400, seed=9, lost=1, duplicated=1)
+    )
+    hp = tmp_path / "history.jsonl"
+    write_history_jsonl(hp, sh.ops)
+    return hp, sh
+
+
+def _die_env_child(hpath, seg_ops, die_after, resume=False):
+    code = (
+        "import sys; sys.path.insert(0, sys.argv[1])\n"
+        "from jepsen_tpu.checkers.segmented import segmented_check_file\n"
+        f"segmented_check_file(sys.argv[2], segment_ops={seg_ops},"
+        f" device=False, resume={resume})\n"
+    )
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JEPSEN_TPU_SEG_DIE_AFTER=str(die_after),
+    )
+    return subprocess.run(
+        [sys.executable, "-c", code, str(REPO), str(hpath)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+
+
+class TestCheckpointResume:
+    def test_state_roundtrip_mid_stream(self, queue_history_file):
+        _, sh = queue_history_file
+        eng = SegmentedChecker("queue", device=False)
+        ops = sh.ops
+        for i in range(0, 300, 100):
+            eng.feed(ops[i : i + 100])
+        # serialize through JSON (exactly what the checkpoint stores)
+        state = json.loads(json.dumps(eng.state()))
+        eng2 = SegmentedChecker.from_state(state, device=False)
+        for i in range(300, len(ops), 100):
+            eng.feed(ops[i : i + 100])
+            eng2.feed(ops[i : i + 100])
+        assert norm(eng.finish()["queue"]) == norm(
+            eng2.finish()["queue"]
+        ) == norm(check_total_queue_cpu(ops))
+
+    def test_kill_mid_segment_resume_identical(self, queue_history_file):
+        hp, _ = queue_history_file
+        r0 = segmented_check_file(hp, segment_ops=100, device=False)
+        assert not checkpoint_path_for(hp).exists(), (
+            "a completed check must clear its checkpoints"
+        )
+        assert r0["segmented"]["resumed"] is False
+        p = _die_env_child(hp, 100, die_after=2)
+        assert p.returncode == 137, p.stderr[-500:]
+        cp = checkpoint_path_for(hp)
+        assert cp.exists()
+        doc = read_checkpoint(cp)  # valid CRC, anchored
+        assert doc["segment_idx"] == 2
+        assert doc["source_sha256"] == prefix_sha256(
+            hp, doc["source_bytes"]
+        )
+        r1 = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True
+        )
+        assert r1["segmented"]["resumed"] is True
+        assert r1["segmented"]["resumed_from"] == 2
+        for fam in ("queue", "linear", "valid?"):
+            assert norm(r1[fam]) == norm(r0[fam])
+
+    def test_resume_from_final_short_segment_checkpoint(self, tmp_path):
+        """A checkpoint written at the FINAL (short) segment must
+        resume cleanly to the identical verdict — the skipped prefix
+        is the whole file, which the reader must accept (review
+        finding: the full-segments assumption raised a false
+        'source truncated' SourceMismatchError here)."""
+        sh = synth_history(SynthSpec(n_ops=200, seed=4, lost=1))
+        hp = tmp_path / "history.jsonl"
+        write_history_jsonl(hp, sh.ops)
+        n_lines = sum(1 for line in hp.read_bytes().splitlines() if line)
+        seg = 100
+        last = (n_lines - 1) // seg  # index of the final, SHORT segment
+        assert n_lines % seg != 0, "fixture must end on a short segment"
+        r0 = segmented_check_file(hp, segment_ops=seg, device=False)
+        p = _die_env_child(hp, seg, die_after=last)
+        assert p.returncode == 137, p.stderr[-500:]
+        r1 = segmented_check_file(
+            hp, segment_ops=seg, device=False, resume=True
+        )
+        assert r1["segmented"]["resumed"] is True
+        assert r1["segmented"]["resumed_from"] == last
+        for fam in ("queue", "linear", "valid?"):
+            assert norm(r1[fam]) == norm(r0[fam])
+
+    def test_mismatched_config_recomputes_from_scratch(
+        self, queue_history_file
+    ):
+        hp, _ = queue_history_file
+        p = _die_env_child(hp, 100, die_after=1)
+        assert p.returncode == 137
+        # a different segment size must refuse the checkpoint (its
+        # carry is anchored to other boundaries), not graft onto it
+        r = segmented_check_file(
+            hp, segment_ops=64, device=False, resume=True
+        )
+        assert r["segmented"]["resumed"] is False
+        assert r["segmented"]["checkpoints_refused"]
+
+    def test_contract_mismatch_refused(self, queue_history_file):
+        """Review finding: resuming with a DIFFERENT checker contract
+        must refuse the checkpoint (its carry was judged under the old
+        one), not silently adopt the checkpoint's contract."""
+        hp, _ = queue_history_file
+        p = _die_env_child(hp, 100, die_after=2)
+        assert p.returncode == 137
+        r = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True,
+            opts={"delivery": "at-least-once"},
+        )
+        assert r["segmented"]["resumed"] is False
+        assert r["segmented"]["checkpoints_refused"]
+        assert r["linear"]["delivery"] == "at-least-once"
+        # same contract resumes fine
+        p = _die_env_child(hp, 100, die_after=2)
+        assert p.returncode == 137
+        r2 = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True, opts={}
+        )
+        assert r2["segmented"]["resumed"] is True
+
+    def test_source_mutation_refused(self, queue_history_file):
+        hp, sh = queue_history_file
+        p = _die_env_child(hp, 100, die_after=2)
+        assert p.returncode == 137
+        raw = hp.read_bytes()
+        hp.write_bytes(raw[:50] + b"X" + raw[51:])  # flip a prefix byte
+        with pytest.raises(SourceMismatchError):
+            segmented_check_file(
+                hp, segment_ops=100, device=False, resume=True
+            )
+
+
+class TestCheckpointIntegrity:
+    def test_torn_checkpoint_refused_falls_back_to_prev(
+        self, queue_history_file, caplog
+    ):
+        hp, _ = queue_history_file
+        r0 = segmented_check_file(hp, segment_ops=100, device=False)
+        p = _die_env_child(hp, 100, die_after=3)
+        assert p.returncode == 137
+        cp = checkpoint_path_for(hp)
+        raw = cp.read_bytes()
+        cp.write_bytes(raw[: len(raw) // 2])
+        import logging
+
+        with caplog.at_level(logging.ERROR):
+            r1 = segmented_check_file(
+                hp, segment_ops=100, device=False, resume=True
+            )
+        refusals = r1["segmented"]["checkpoints_refused"]
+        assert refusals and "torn/corrupt" in refusals[0]
+        assert any(
+            "REFUSED checkpoint" in rec.message for rec in caplog.records
+        )
+        # fell back to .prev: resumed from the previous segment
+        assert r1["segmented"]["resumed"] is True
+        assert r1["segmented"]["resumed_from"] == 2
+        for fam in ("queue", "linear"):
+            assert norm(r1[fam]) == norm(r0[fam])
+
+    def test_both_torn_recomputes_from_scratch(self, queue_history_file):
+        hp, _ = queue_history_file
+        r0 = segmented_check_file(hp, segment_ops=100, device=False)
+        p = _die_env_child(hp, 100, die_after=3)
+        assert p.returncode == 137
+        cp = checkpoint_path_for(hp)
+        cp.write_bytes(b"garbage")
+        cp.with_name(cp.name + ".prev").write_bytes(b"worse")
+        r1 = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True
+        )
+        assert len(r1["segmented"]["checkpoints_refused"]) == 2
+        assert r1["segmented"]["resumed"] is False
+        for fam in ("queue", "linear"):
+            assert norm(r1[fam]) == norm(r0[fam])
+
+
+# ---------------------------------------------------------------------------
+# the .jtc zero-parse segment producer (queue family)
+# ---------------------------------------------------------------------------
+
+
+class TestJtcSegmentProducer:
+    @pytest.fixture()
+    def recorded_run(self, tmp_path):
+        from jepsen_tpu.history.store import Store
+
+        st = Store(tmp_path)
+        rd = st.run_dir("t")
+        sh = synth_history(
+            SynthSpec(n_ops=400, seed=9, lost=1, duplicated=1)
+        )
+        hp = st.save_history(rd, sh.ops)  # leaves the .jtc sibling
+        assert hp.with_suffix(".jtc").exists()
+        return hp, sh
+
+    def test_jtc_slices_equal_jsonl_stream(
+        self, recorded_run, monkeypatch
+    ):
+        hp, sh = recorded_run
+        from jepsen_tpu.obs.metrics import REGISTRY
+
+        hits0 = REGISTRY.value("jtc.hit")
+        r_jtc = segmented_check_file(hp, segment_ops=100, device=False)
+        assert REGISTRY.value("jtc.hit") > hits0
+        assert r_jtc["segmented"]["substrate"] == "jtc"
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")
+        r_jsonl = segmented_check_file(hp, segment_ops=100, device=False)
+        assert r_jsonl["segmented"]["substrate"] == "jsonl"
+        for fam in ("queue", "linear", "valid?"):
+            assert norm(r_jtc[fam]) == norm(r_jsonl[fam])
+        assert norm(r_jtc["queue"]) == norm(
+            check_total_queue_cpu(sh.ops)
+        )
+
+    def test_jtc_kill_resume_identical(self, recorded_run):
+        hp, _ = recorded_run
+        r0 = segmented_check_file(hp, segment_ops=100, device=False)
+        p = _die_env_child(hp, 100, die_after=2)
+        assert p.returncode == 137, p.stderr[-500:]
+        doc = read_checkpoint(checkpoint_path_for(hp))
+        assert doc["substrate"] == "jtc"
+        r1 = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True
+        )
+        assert r1["segmented"]["resumed_from"] == 2
+        for fam in ("queue", "linear"):
+            assert norm(r1[fam]) == norm(r0[fam])
+
+    def test_substrate_mismatch_refused(self, recorded_run, monkeypatch):
+        """A checkpoint written on one substrate must not graft onto
+        the other's segment geometry — refuse and recompute."""
+        hp, _ = recorded_run
+        p = _die_env_child(hp, 100, die_after=2)  # jtc-substrate ckpt
+        assert p.returncode == 137
+        monkeypatch.setenv("JEPSEN_TPU_NO_JTC", "1")  # resume via jsonl
+        r = segmented_check_file(
+            hp, segment_ops=100, device=False, resume=True
+        )
+        assert r["segmented"]["resumed"] is False
+        assert r["segmented"]["checkpoints_refused"]
+
+
+# ---------------------------------------------------------------------------
+# poison: quarantine precedence (PR-13 rule)
+# ---------------------------------------------------------------------------
+
+
+class TestPoisonQuarantine:
+    def test_torn_line_quarantines_as_unknown_with_evidence(
+        self, tmp_path
+    ):
+        sh = synth_history(SynthSpec(n_ops=200, seed=9))
+        hp = tmp_path / "history.jsonl"
+        write_history_jsonl(hp, sh.ops)
+        lines = hp.read_bytes().splitlines(keepends=True)
+        hp.write_bytes(
+            b"".join(lines[:150])
+            + b'{"type": "torn mid-rec'
+            + b"".join(lines[150:])
+        )
+        r = segmented_check_file(hp, segment_ops=64, device=False)
+        assert r["valid?"] == "unknown"
+        for fam in ("queue", "linear"):
+            assert r[fam]["valid?"] == "unknown"
+            ev = r[fam]["quarantined"]["segments"]
+            assert ev and ev[0]["line"] == 151
+            assert "JSONDecodeError" in ev[0]["error"]
+
+    def test_queue_invalid_before_poison_goes_unknown(self):
+        """Queue loss is an END-state class — a prefix that LOOKS
+        invalid is not final (a later segment could deliver the
+        value), so poison caps it at unknown, never a fabricated
+        False and never valid."""
+        ops = [
+            _op("INVOKE", "ENQUEUE", 0, 1, 0),
+            _op("OK", "ENQUEUE", 0, 1, 1),
+        ]
+        eng = SegmentedChecker("queue", device=False)
+        eng.feed(ops)
+        eng.quarantine(1, "synthetic poison")
+        r = eng.finish()
+        assert r["queue"]["valid?"] == "unknown"
+        assert r["valid?"] == "unknown"
+
+    def test_mutex_prefix_invalid_survives_poison(self):
+        """Invalid trumps all — but ONLY where it is prefix-final: a
+        refuted (flushed) mutex chunk refutes every extension, so the
+        poison cannot launder it back to unknown."""
+        ops = [
+            _op("INVOKE", "ACQUIRE", 0, None, 0),
+            _op("OK", "ACQUIRE", 0, None, 1),
+            _op("INVOKE", "ACQUIRE", 1, None, 2),
+            _op("OK", "ACQUIRE", 1, None, 3),  # double grant
+            _op("INVOKE", "RELEASE", 0, None, 4),
+            _op("OK", "RELEASE", 0, None, 5),
+            _op("INVOKE", "RELEASE", 1, None, 6),
+            _op("OK", "RELEASE", 1, None, 7),
+        ]
+        eng = SegmentedChecker("mutex", device=False)
+        eng.feed(ops)  # class closes balanced -> flushes -> refuted
+        assert eng.carry.final_invalid
+        eng.quarantine(1, "synthetic poison")
+        r = eng.finish()
+        assert r["mutex"]["valid?"] is False
+        assert r["mutex"]["quarantined"]["segments"]
+        assert r["valid?"] is False
+
+    def test_feeding_stops_after_poison(self):
+        eng = SegmentedChecker("queue", device=False)
+        eng.quarantine(0, "poison first")
+        eng.feed([_op("INVOKE", "ENQUEUE", 0, 1, 0)])
+        assert eng.ops_seen == 0  # the poisoned carry never advanced
+
+
+# ---------------------------------------------------------------------------
+# live checking (the soak --live-check observer)
+# ---------------------------------------------------------------------------
+
+
+class TestLiveSegmentChecker:
+    def test_windows_and_latency_sketch(self):
+        sh = synth_history(SynthSpec(n_ops=300, seed=11))
+        lc = LiveSegmentChecker("queue", 64, device=False)
+        for op in sh.ops:
+            lc.observe(op)
+        s = lc.close()
+        assert s["windows"] >= 2
+        assert s["ops"] == len(sh.ops)
+        assert s["samples"] == len(sh.ops)
+        assert s["p99_ms"] >= s["p50_ms"] >= 0
+        assert not s["errors"]
+        assert s["verdict"] == check_total_queue_cpu(sh.ops)["valid?"]
+
+    def test_no_ops_means_no_windows(self):
+        lc = LiveSegmentChecker("queue", 64, device=False)
+        s = lc.close()
+        assert s["windows"] == 0  # the soak driver fail-louds on this
+
+
+# ---------------------------------------------------------------------------
+# the segment reader
+# ---------------------------------------------------------------------------
+
+
+class TestSegmentReader:
+    def test_anchors_and_counts(self, tmp_path):
+        sh = synth_history(SynthSpec(n_ops=100, seed=1))
+        hp = tmp_path / "h.jsonl"
+        write_history_jsonl(hp, sh.ops)
+        segs = list(iter_segments(hp, 40))
+        assert sum(len(s.ops) for s in segs) == len(sh.ops)
+        assert segs[-1].final
+        last = segs[-1]
+        assert last.byte_end == hp.stat().st_size
+        assert last.sha256 == prefix_sha256(hp, last.byte_end)
+        # mid-anchor verifies too
+        mid = segs[0]
+        assert mid.sha256 == prefix_sha256(hp, mid.byte_end)
+
+    def test_resume_skip_verifies_anchor(self, tmp_path):
+        sh = synth_history(SynthSpec(n_ops=100, seed=1))
+        hp = tmp_path / "h.jsonl"
+        write_history_jsonl(hp, sh.ops)
+        segs = list(iter_segments(hp, 40))
+        resumed = list(
+            iter_segments(
+                hp, 40, start_segment=1,
+                expect_sha256=segs[0].sha256,
+                expect_bytes=segs[0].byte_end,
+            )
+        )
+        assert [s.idx for s in resumed] == [
+            s.idx for s in segs[1:]
+        ]
+        assert [len(s.ops) for s in resumed] == [
+            len(s.ops) for s in segs[1:]
+        ]
+        with pytest.raises(SourceMismatchError):
+            list(
+                iter_segments(
+                    hp, 40, start_segment=1,
+                    expect_sha256="0" * 64,
+                    expect_bytes=segs[0].byte_end,
+                )
+            )
+
+    def test_poison_carries_line_number(self, tmp_path):
+        hp = tmp_path / "h.jsonl"
+        hp.write_text('{"type": "invoke", "f": "enqueue", "process": 0}\n'
+                      "not json at all\n")
+        with pytest.raises(SegmentPoisonError) as ei:
+            list(iter_segments(hp, 10))
+        assert ei.value.line_no == 2

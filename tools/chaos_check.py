@@ -176,6 +176,154 @@ def _nemesis_hook(args, log, state):
     return hook
 
 
+def _seg_child_cmd(hist, seg_ops, resume=False, die_after=None):
+    """One segmented check in a subprocess (the crashable unit)."""
+    code = (
+        "import sys, json; sys.path.insert(0, sys.argv[1])\n"
+        "from jepsen_tpu.checkers.segmented import segmented_check_file\n"
+        "from jepsen_tpu.history.store import _json_default\n"
+        "r = segmented_check_file(sys.argv[2], workload='queue',"
+        " segment_ops=int(sys.argv[3]), device=False,"
+        f" resume={bool(resume)})\n"
+        "print('SEG_RESULT ' + json.dumps(r, default=_json_default),"
+        " flush=True)\n"
+    )
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    if die_after is not None:
+        env["JEPSEN_TPU_SEG_DIE_AFTER"] = str(die_after)
+    else:
+        env.pop("JEPSEN_TPU_SEG_DIE_AFTER", None)
+    return (
+        [sys.executable, "-c", code, str(REPO), str(hist), str(seg_ops)],
+        env,
+    )
+
+
+def _run_seg_child(hist, seg_ops, log, resume=False, die_after=None,
+                   kill_after=None, timeout=600.0):
+    import subprocess
+
+    argv, env = _seg_child_cmd(hist, seg_ops, resume, die_after)
+    p = subprocess.Popen(
+        argv, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True,
+    )
+    if kill_after is not None:
+
+        def _killer():
+            time.sleep(kill_after)
+            if p.poll() is None:
+                log(f"nemesis: SIGKILL segmented checker (pid {p.pid}) "
+                    f"at t+{kill_after:.2f}s")
+                p.kill()
+
+        threading.Thread(target=_killer, daemon=True).start()
+    out, err = p.communicate(timeout=timeout)
+    result = None
+    for line in out.splitlines():
+        if line.startswith("SEG_RESULT "):
+            result = json.loads(line[len("SEG_RESULT "):])
+    return p.returncode, result, err
+
+
+def run_segmented_chaos(args, log, check) -> None:
+    """Kill-mid-segment / resume proofs for the SEGMENTED checker
+    (ISSUE 15): an uninterrupted oracle run, a mid-check death (real
+    SIGKILL or the deterministic die-after-segment env hook), a
+    resume that must reach the IDENTICAL verdict from the last
+    checkpoint, and a torn-checkpoint refusal that recomputes from
+    the previous one — all fail-loud."""
+    from jepsen_tpu.checkers.segmented import checkpoint_path_for
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+    corpus = Path(args.corpus_dir or tempfile.mkdtemp(prefix="jt_segchaos_"))
+    corpus.mkdir(parents=True, exist_ok=True)
+    hist = corpus / "history.jsonl"
+    sh = synth_history(
+        SynthSpec(n_ops=args.seg_history_ops, seed=args.seed,
+                  lost=1, duplicated=1)
+    )
+    write_history_jsonl(hist, sh.ops)
+    n_lines = sum(1 for _ in open(hist))
+    seg_ops = args.seg_ops
+    log(
+        f"segmented chaos: {n_lines} op lines, segment_ops={seg_ops} "
+        f"(~{n_lines // seg_ops} segments), mode={args.mode}"
+    )
+    ckpt = checkpoint_path_for(hist)
+
+    # 1. uninterrupted oracle
+    t_oracle = time.perf_counter()
+    rc, oracle, err = _run_seg_child(hist, seg_ops, log)
+    oracle_wall = time.perf_counter() - t_oracle
+    check(rc == 0 and oracle is not None,
+          f"uninterrupted segmented run completed (rc={rc})")
+    check(not ckpt.exists(),
+          "a COMPLETED run leaves no checkpoint behind")
+    check(oracle["segmented"]["resumed"] is False,
+          "an uninterrupted run never claims a resume")
+
+    # 2. kill mid-check
+    die_after = None
+    kill_after = None
+    if args.mode == "die-env":
+        die_after = max(1, (n_lines // seg_ops) // 2)
+    else:
+        # the kill must land MID-check: a fixed delay races a fast
+        # host (the r13 chaos-smoke lesson), so cap it at ~40% of the
+        # measured uninterrupted wall
+        kill_after = min(args.kill_after, max(0.2, 0.4 * oracle_wall))
+        if kill_after < args.kill_after:
+            log(
+                f"nemesis: --kill-after {args.kill_after:.1f}s would "
+                f"outlive the {oracle_wall:.1f}s check — scaled to "
+                f"{kill_after:.2f}s"
+            )
+    rc, res, err = _run_seg_child(
+        hist, seg_ops, log, die_after=die_after, kill_after=kill_after
+    )
+    check(rc != 0 and res is None,
+          f"mid-check death produced no verdict (rc={rc})")
+    check(ckpt.exists(), "the killed run left a durable checkpoint")
+
+    # 3. resume -> identical verdict
+    rc, resumed, err = _run_seg_child(hist, seg_ops, log, resume=True)
+    check(rc == 0 and resumed is not None,
+          f"resumed run completed (rc={rc})")
+    meta = (resumed or {}).get("segmented", {})
+    check(bool(meta.get("resumed")) and meta.get("resumed_from", -1) >= 0,
+          f"resume came from a checkpoint "
+          f"(resumed_from={meta.get('resumed_from')})")
+    same = all(
+        (resumed or {}).get(k) == oracle.get(k)
+        for k in ("queue", "linear", "valid?")
+    )
+    check(same, "resumed verdict IDENTICAL to the uninterrupted run")
+
+    # 4. torn checkpoint: refused loudly, recomputed from the previous
+    rc, _res, err = _run_seg_child(
+        hist, seg_ops, log, die_after=die_after, kill_after=kill_after
+    )
+    check(ckpt.exists(), "second killed run left a checkpoint to tear")
+    raw = ckpt.read_bytes()
+    ckpt.write_bytes(raw[: len(raw) // 2])  # torn mid-write
+    rc, resumed2, err = _run_seg_child(hist, seg_ops, log, resume=True)
+    meta2 = (resumed2 or {}).get("segmented", {})
+    check(
+        rc == 0 and bool(meta2.get("checkpoints_refused")),
+        f"torn checkpoint REFUSED loudly "
+        f"(refusals={meta2.get('checkpoints_refused')})",
+    )
+    same2 = all(
+        (resumed2 or {}).get(k) == oracle.get(k)
+        for k in ("queue", "linear", "valid?")
+    )
+    check(same2,
+          "torn-checkpoint recovery still reaches the identical verdict")
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -213,9 +361,24 @@ def main(argv=None) -> int:
                    help="keep the synthesized corpus here (default: a "
                    "temp dir — the corpus is reproducible from the "
                    "seed and never belongs beside committed artifacts)")
+    p.add_argument("--segmented", action="store_true",
+                   help="ISSUE-15 mode: chaos against the SEGMENTED "
+                   "checker instead of the worker fleet — one long "
+                   "history, SIGKILL (or die-after-segment hook) "
+                   "mid-check, resume from the last checkpoint, "
+                   "torn-checkpoint refusal; proves the resumed "
+                   "verdict is identical to the uninterrupted run")
+    p.add_argument("--seg-ops", type=int, default=500,
+                   help="--segmented: ops per segment")
+    p.add_argument("--seg-history-ops", type=int, default=4000,
+                   help="--segmented: synthesized history op "
+                   "invocations (the file is ~2x lines)")
     args = p.parse_args(argv)
-    if args.kill >= args.procs:
+    if not args.segmented and args.kill >= args.procs:
         p.error("--kill must leave at least one survivor (< --procs)")
+    if args.segmented and args.mode == "sigstop":
+        p.error("--segmented supports sigkill / die-env (a SIGSTOPped "
+                "single-process check has no peer to detect the wedge)")
 
     out_dir = Path(args.out) if args.out else None
     log = _Log(out_dir / "chaos_check.log" if out_dir else None)
@@ -227,6 +390,50 @@ def main(argv=None) -> int:
     )
 
     from jepsen_tpu.history.store import _json_default
+
+    if args.segmented:
+        failures: list[str] = []
+
+        def check(cond: bool, msg: str) -> None:
+            if cond:
+                log(f"PASS  {msg}")
+            else:
+                failures.append(msg)
+                log(f"FAIL  {msg}")
+
+        t0 = time.perf_counter()
+        tmp_ctx = (
+            tempfile.TemporaryDirectory(prefix="jt_segchaos_")
+            if args.corpus_dir is None
+            else None
+        )
+        if tmp_ctx is not None:
+            args.corpus_dir = tmp_ctx.name
+        try:
+            run_segmented_chaos(args, log, check)
+        finally:
+            if tmp_ctx is not None:
+                tmp_ctx.cleanup()
+        if out_dir is not None:
+            doc = {
+                "tool": "chaos_check --segmented",
+                "pass": not failures,
+                "config": {
+                    k: v for k, v in vars(args).items() if k != "out"
+                },
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "failures": failures,
+            }
+            (out_dir / "results.json").write_text(
+                json.dumps(doc, indent=1, default=_json_default) + "\n"
+            )
+            log(f"artifacts: {out_dir}/results.json + chaos_check.log")
+        if failures:
+            log(f"CHAOS FAIL ({len(failures)} failed assertions)")
+            return 1
+        log("CHAOS PASS")
+        return 0
+
     from jepsen_tpu.parallel.distributed import run_multiprocess_check
 
     def norm(x):

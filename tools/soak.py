@@ -205,6 +205,7 @@ def run_soak(args) -> int:
         print(f"# soak: flight recorder on -> {args.trace_out}", flush=True)
 
     monitors = []
+    live_checkers = []
 
     def build():
         native_mod.reset()
@@ -248,6 +249,28 @@ def run_soak(args) -> int:
                       "--serial for the classic single-thread checkers)",
                       flush=True)
         monitors.append(attach_live_monitor_for(test, monitor_name))
+        if args.live_check:
+            # segmented online checking ON the recording stream
+            # (SEGMENTED.md): an observer on the run recorder feeds
+            # full segments to the carry engine on a worker thread and
+            # reports record-to-verdict latency via the PR-9 sketches
+            from jepsen_tpu.checkers.segmented import LiveSegmentChecker
+
+            lc = LiveSegmentChecker(
+                args.workload,
+                args.live_check,
+                opts=(
+                    {"delivery": "at-least-once"}
+                    if args.workload == "queue"
+                    else {"append_fail": "indeterminate"}
+                    if args.workload == "stream"
+                    else {"model": "read-committed"}
+                    if args.workload == "elle"
+                    else {}
+                ),
+            )
+            test.observers.append(lc)
+            live_checkers.append(lc)
         return test, transport
 
     t0 = time.monotonic()
@@ -305,6 +328,37 @@ def run_soak(args) -> int:
         f"({check_sketch.count} batches)",
         flush=True,
     )
+    # live-check summary (ISSUE 15): record-to-verdict latency off the
+    # segmented engine's sketch, printed BESIDE the op-latency line —
+    # fail-loud below if live mode produced no verdict windows
+    live_summary = None
+    if args.live_check and live_checkers:
+        live_summary = live_checkers[-1].close()
+        print(
+            f"# soak live-check: {live_summary['windows']} verdict "
+            f"windows over {live_summary['ops']} recorded ops "
+            f"(segment={args.live_check}); record-to-verdict "
+            f"p50 {live_summary['p50_ms']:.1f}ms / "
+            f"p99 {live_summary['p99_ms']:.1f}ms "
+            f"({live_summary['samples']} op samples); "
+            f"live verdict-so-far={live_summary['verdict']}",
+            flush=True,
+        )
+        if live_summary.get("saturated_at_op") is not None:
+            print(
+                f"# soak live-check SATURATED at op "
+                f"{live_summary['saturated_at_op']}: the checker "
+                f"could not keep up with the recorder — "
+                f"{live_summary['ops_unverified']} ops went "
+                f"unverified live (post-run analysis still covers "
+                f"them)",
+                flush=True,
+            )
+        if live_summary["errors"]:
+            print(
+                f"# soak live-check ERRORS: {live_summary['errors']}",
+                flush=True,
+            )
     # elastic-analysis honesty line (ISSUE 13): a quarantined chunk in
     # the analysis phase means part of THIS soak's history went
     # unjudged — that must never hide inside a wall-clock summary
@@ -338,6 +392,19 @@ def run_soak(args) -> int:
         print("Everything looks good! ヽ('ー`)ノ")
     else:
         print("Analysis invalid! ಠ~ಠ")
+    if args.live_check and (
+        live_summary is None
+        or live_summary["windows"] == 0
+        or live_summary["errors"]
+    ):
+        # fail-loud: a live-check soak whose live engine never produced
+        # a verdict window (or crashed) must not mint a green artifact
+        print(
+            "# soak live-check FAILED: no verdict windows "
+            f"(summary={live_summary})",
+            flush=True,
+        )
+        return 1
     # triage guarantees the run reached the EXPECTED verdict — only now
     # may the trace artifact land (the --out capture discipline)
     if args.trace_out:
@@ -403,6 +470,18 @@ def main(argv=None) -> int:
                    help="triage escape hatch: run the post-run analysis "
                         "on the classic single-thread checkers instead "
                         "of the bytes-to-verdict pipeline executor")
+    p.add_argument("--live-check", dest="live_check", type=int,
+                   default=None, metavar="N",
+                   help="segmented ONLINE checking during the run "
+                        "(SEGMENTED.md): tail the recording stream N "
+                        "ops at a time through the segmented carry "
+                        "engine and print record-to-verdict latency "
+                        "p50/p99 (PR-9 sketches) in the triage "
+                        "summary; fail-loud if no verdict window was "
+                        "ever produced.  Live contracts: at-least-once "
+                        "delivery / indeterminate appends / "
+                        "read-committed — the levels live SUT runs "
+                        "are judged at")
     p.add_argument("--lanes", type=int, default=None,
                    help="scale the post-run analysis out across local "
                         "devices: the soak's single long history checks "

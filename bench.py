@@ -2700,6 +2700,50 @@ def _bench_segmented_section(details: dict) -> None:
     _bench_segmented(details)
 
 
+def _bench_serve_section(details: dict) -> None:
+    """``serve`` (ISSUE 16): the always-on streaming ingestion service
+    — admission throughput with p50/p99 submit→verdict sketches, the
+    content-addressed verdict cache's ≥100x hit discount, kill-a-worker
+    chaos (every surviving verdict ≡ the serial oracle, degraded
+    provenance names the dead worker, a zero-kill row can never claim
+    recovery), and loud-SATURATED saturation accounting (zero silent
+    drops, zero gapped carries).  Runs scaled down in-process via
+    tools/bench_serve.py; the full load generator is the standalone
+    tool.  Host-side by design (admission, backpressure and recovery
+    are service-plane claims; the carry engines run their numpy twins
+    so the section is identical on every backend)."""
+    import argparse
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools"),
+    )
+    import bench_serve
+
+    args = argparse.Namespace(
+        histories=12000, base=16, ops=40, workers=2, seed=16,
+        min_rate=10_000.0, cache_ops=4000, cache_reps=200,
+        chaos_streams=6, chaos_ops=1200, chaos_blocks=8, kill_block=3,
+        sat_submits=48, sat_block_delay=0.02, timeout=300.0,
+        device=False,
+    )
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    doc = bench_serve.run_all(
+        args, lambda msg: print(f"# serve: {msg}", file=sys.stderr),
+        check,
+    )
+    doc["floor_histories_per_s"] = args.min_rate
+    doc["pass"] = not failures
+    doc["failures"] = failures
+    details["serve"] = doc
+    print(f"# serve: {json.dumps(doc)}", file=sys.stderr)
+
+
 #: always the repo-root copy, regardless of the invoker's cwd — the
 #: committed artifact is what harvest.needs_chip_refresh() reads
 DETAILS_PATH = os.path.join(
@@ -2933,6 +2977,7 @@ def _run_once() -> None:
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_bitpack_section, _bench_segmented_section,
+        _bench_serve_section,
         _bench_north_star_section, _bench_cold_vs_warm_section,
         _bench_obs_overhead_section, _bench_elastic_overhead_section,
         _bench_cluster_obs_overhead_section,

@@ -1290,6 +1290,17 @@ class SegmentedChecker:
             "carry": self.carry.state(),
         }
 
+    def state_nbytes(self, state: dict | None = None) -> int:
+        """Resident carry footprint in bytes: the compact-JSON size of
+        :meth:`state` (pass an already-captured state dict to avoid
+        recomputing it).  The streaming service exports the sum across
+        live streams as the ``service.carry_bytes`` gauge — the
+        capacity signal for an always-on deployment: carry grows with
+        the in-flight value set, not the history, so a flat curve
+        under sustained load is the healthy shape."""
+        d = self.state() if state is None else state
+        return len(json.dumps(d, separators=(",", ":")).encode())
+
     @classmethod
     def from_state(cls, d: dict, device: bool = True) -> "SegmentedChecker":
         c = cls.__new__(cls)

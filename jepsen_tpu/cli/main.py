@@ -1558,7 +1558,9 @@ def cmd_serve_checker(args) -> int:
 
     serve_forever(
         host=args.host, port=args.port, seq=args.seq, store=args.store,
-        metrics_port=args.metrics_port,
+        metrics_port=args.metrics_port, workers=args.workers,
+        max_streams=args.max_streams, ingress_cap=args.ingress_cap,
+        stream_deadline_s=args.stream_deadline,
     )
     return 0
 
@@ -2317,6 +2319,34 @@ def build_parser() -> argparse.ArgumentParser:
         help="Prometheus-style text /metrics endpoint (p50/p99 check "
         "latency from the shared obs registry); 0 = ephemeral port, "
         "-1 = off",
+    )
+    sc.add_argument(
+        "--workers",
+        type=int,
+        default=2,
+        help="streaming-ingest checker workers (each runs segmented "
+        "carry engines; a dead worker's streams requeue onto survivors)",
+    )
+    sc.add_argument(
+        "--max-streams",
+        type=int,
+        default=256,
+        help="admission cap on concurrently open streams — opens past "
+        "it are rejected SATURATED, never queued silently",
+    )
+    sc.add_argument(
+        "--ingress-cap",
+        type=int,
+        default=1024,
+        help="total buffered-but-unchecked blocks across all streams; "
+        "feeds past it are rejected SATURATED (backpressure, not drop)",
+    )
+    sc.add_argument(
+        "--stream-deadline",
+        type=float,
+        default=120.0,
+        help="seconds an open stream may sit idle before it is "
+        "quarantined as overdue (unknown-with-evidence, slot freed)",
     )
     sc.set_defaults(fn=cmd_serve_checker)
 

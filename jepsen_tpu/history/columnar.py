@@ -180,6 +180,18 @@ class Jtc:
     def payload_bytes(self) -> int:
         return sum(a.nbytes for a in self.arrays.values())
 
+    def content_key(self) -> str:
+        """Content address of the substrate PAYLOAD (hex sha256 over
+        section bytes in kind order) — stable across re-packs of the
+        same history (the stamp's mtime/size never enter), so it keys
+        the service verdict cache.  For a queue-family file this equals
+        the digest a server computes over the same rows streamed as
+        contiguous block slices."""
+        h = hashlib.sha256()
+        for kind in sorted(self.arrays):
+            h.update(np.ascontiguousarray(self.arrays[kind]).tobytes())
+        return h.hexdigest()
+
 
 def read_jtc(path: str | Path) -> tuple[Jtc, dict]:
     """Structurally read + CRC-verify one ``.jtc`` (NO source-freshness
@@ -363,6 +375,29 @@ def consult(src_path: str | Path) -> Jtc | None:
             # present but stamped for different source bytes/name
             REGISTRY.counter("jtc.fallback", reason="stale").inc()
     return got
+
+
+def payload_sha256(path: str | Path) -> str:
+    """Content address of a ``.jtc`` on disk (CRC-verified read, then
+    :meth:`Jtc.content_key`) — what a client declares when asking the
+    service whether a verdict for these bytes is already cached."""
+    jtc, _stamp = read_jtc(path)
+    return jtc.content_key()
+
+
+def iter_row_blocks(rows: np.ndarray, block_rows: int):
+    """Contiguous ``(slice, n_ops)`` blocks over a ``[n, 8]`` row
+    matrix — the wire unit for streaming a queue-family substrate.
+    Slices are views (no copy); ``n_ops`` counts the distinct op
+    indices (column 0) in the slice, the carry engines' op accounting.
+    Block boundaries are arbitrary for correctness (positions are
+    global via column 0); ``block_rows`` just sets the frame size."""
+    if block_rows < 1:
+        raise ValueError("block_rows must be >= 1")
+    n = rows.shape[0]
+    for lo in range(0, n, block_rows):
+        blk = rows[lo : lo + block_rows]
+        yield blk, int(len(np.unique(blk[:, 0])))
 
 
 # ---------------------------------------------------------------------------

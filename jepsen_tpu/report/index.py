@@ -60,6 +60,45 @@ def run_dirs(root: str | Path) -> list[Path]:
     return sorted(out)
 
 
+def run_content_refs(root: str | Path):
+    """Content-addressed refs for recorded runs: yields
+    ``(digest, workload, opts, verdict, rel)`` for every run directory
+    under ``root`` holding BOTH a ``results.json`` verdict and a fresh
+    ``.jtc`` substrate (stale/corrupt/absent substrates are skipped —
+    a seed must never serve a verdict for bytes it cannot address).
+
+    ``digest`` is the substrate's payload sha256
+    (:meth:`~jepsen_tpu.history.columnar.Jtc.content_key`), ``opts``
+    the default contract (recorded runs don't persist checker options;
+    non-default contracts re-check rather than hit), and ``rel`` the
+    root-relative run directory — the ``report_ref`` a cache hit serves
+    alongside the verdict (the PR-11 ``/report/<run>`` route)."""
+    from jepsen_tpu.history.columnar import load_jtc
+
+    root = Path(root)
+    for d in run_dirs(root):
+        results_path = d / RESULTS_FILE
+        src = d / HISTORY_FILE
+        if not results_path.is_file() or not src.is_file():
+            continue
+        try:
+            jtc = load_jtc(src)
+        except Exception as e:  # noqa: BLE001 — skip, don't refuse to seed
+            log.warning("unaddressable substrate under %s: %s", d, e)
+            continue
+        if jtc is None or jtc.workload is None:
+            continue
+        try:
+            verdict = json.loads(results_path.read_text())
+        except (OSError, ValueError) as e:
+            log.warning("unreadable results.json under %s: %s", d, e)
+            continue
+        yield (
+            jtc.content_key(), jtc.workload, {}, verdict,
+            str(d.relative_to(root)),
+        )
+
+
 def _summary_for(d: Path, render_missing: bool) -> dict[str, Any] | None:
     rj = d / REPORT_JSON
     if not rj.is_file() and render_missing:

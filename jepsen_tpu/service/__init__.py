@@ -8,5 +8,9 @@ amortizing backend init and compilation across runs (north star,
 BASELINE.json: "Clojure/Python boundary via a sidecar RPC").
 """
 
-from jepsen_tpu.service.client import CheckerClient  # noqa: F401
+from jepsen_tpu.service.client import (  # noqa: F401
+    CheckerClient,
+    RetryPolicy,
+    ServiceUnavailable,
+)
 from jepsen_tpu.service.server import CheckerServer  # noqa: F401

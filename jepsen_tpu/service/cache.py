@@ -1,0 +1,141 @@
+"""Content-addressed verdict cache for the always-on service.
+
+At fleet scale many submitted histories are identical — a re-checked
+run, a fuzz shrink candidate re-confirmed, the same soak replayed by
+two controllers.  Checking is a pure function of (history bytes, model,
+contract), so a verdict can be served by hash lookup instead of a
+device dispatch: the cache key is
+
+    sha256( content_digest || workload || canonical-JSON(opts) )
+
+where ``content_digest`` is the sha256 of the history's substrate bytes
+(``columnar.payload_sha256`` for a ``.jtc``; the running digest of the
+streamed block payloads for a wire stream — the server computes its OWN
+digest over what it actually received, so a client-declared key can
+never poison the cache with a verdict for different bytes).
+
+Invalidation is structural, not temporal: the key embeds the content
+digest, so changed bytes are a different key — stale entries are never
+*wrong*, only unreachable, and the LRU bound evicts them.  Only CLEAN
+verdicts are cached: a quarantined or ``degraded`` verdict reflects
+this run's worker deaths / poison, not the history, and must be
+recomputed, never replayed (SERVICE.md §Cache).
+
+Entries may carry a ``report_ref`` (a store-relative run directory):
+cache hits for histories that already have a recorded run serve the
+PR-11 report route (``/report/<run>``) alongside the verdict —
+:func:`seed_from_store` builds those entries off the committed store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+from collections import OrderedDict
+from pathlib import Path
+from typing import Any
+
+log = logging.getLogger("jepsen_tpu.service.cache")
+
+
+def contract_key(workload: str, opts: dict | None) -> str:
+    """Canonical (model, contract) half of the cache key: the checker
+    options that change verdict semantics, JSON-canonicalized."""
+    return json.dumps(
+        [workload, dict(opts or {})], sort_keys=True, separators=(",", ":")
+    )
+
+
+def cache_key(content_digest: str, workload: str, opts: dict | None) -> str:
+    """The full content-addressed key: (substrate sha256, model,
+    contract) → one hex digest."""
+    h = hashlib.sha256()
+    h.update(content_digest.encode())
+    h.update(b"\x00")
+    h.update(contract_key(workload, opts).encode())
+    return h.hexdigest()
+
+
+class VerdictCache:
+    """Thread-safe LRU of verdicts keyed by :func:`cache_key`.
+
+    ``get``/``put`` maintain the shared obs counters
+    (``service.cache_hits`` / ``service.cache_misses``) so ``/metrics``
+    answers the hit rate live."""
+
+    def __init__(self, capacity: int = 4096, registry=None):
+        if capacity <= 0:
+            raise ValueError("cache capacity must be positive")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[str, dict] = OrderedDict()
+        if registry is None:
+            from jepsen_tpu.obs.metrics import REGISTRY as registry  # noqa: N813
+        self._hits = registry.counter("service.cache_hits")
+        self._misses = registry.counter("service.cache_misses")
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def get(self, key: str) -> dict | None:
+        """The cached entry ``{"verdict": ..., "report_ref": ...?}`` or
+        None; counts a hit/miss either way."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        if entry is None:
+            self._misses.inc()
+            return None
+        self._hits.inc()
+        return entry
+
+    def put(
+        self,
+        key: str,
+        verdict: dict[str, Any],
+        report_ref: str | None = None,
+    ) -> None:
+        entry = {"verdict": verdict}
+        if report_ref is not None:
+            entry["report_ref"] = report_ref
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            n = len(self._entries)
+        return {
+            "entries": n,
+            "capacity": self.capacity,
+            "hits": int(self._hits.value),
+            "misses": int(self._misses.value),
+        }
+
+    def seed_from_store(
+        self, store_root: str | Path, limit: int | None = None
+    ) -> int:
+        """Seed entries from recorded runs: every run directory with a
+        ``results.json`` verdict and a fresh ``.jtc`` substrate becomes
+        a cache entry whose ``report_ref`` points the hit at the PR-11
+        report route.  Returns the number of entries seeded; malformed
+        runs are skipped (a cache seed must never refuse to serve)."""
+        from jepsen_tpu.report.index import run_content_refs
+
+        seeded = 0
+        for digest, workload, opts, verdict, rel in run_content_refs(
+            Path(store_root)
+        ):
+            self.put(
+                cache_key(digest, workload, opts), verdict, report_ref=rel
+            )
+            seeded += 1
+            if limit is not None and seeded >= limit:
+                break
+        return seeded

@@ -1,15 +1,29 @@
-"""Client side of the checker sidecar: pack host-side, ship tensors."""
+"""Client side of the checker sidecar: pack host-side, ship tensors.
+
+Streaming methods (``stream_open`` / ``stream_feed_rows`` / ... /
+``submit_batch_rows``) speak the always-on ingestion surface.  Pass a
+:class:`RetryPolicy` to make transient faults the CLIENT's problem, not
+the caller's: a connection reset reconnects and resends (safe — block
+feeds are idempotent by sequence number, the server dup-acks), and a
+loud ``SATURATED`` reject backs off with exponential delay + jitter and
+re-offers.  When the budget runs out the caller gets
+:class:`ServiceUnavailable` whose ``.reason`` is machine-readable —
+never a raw socket exception, never a silently dropped block.
+"""
 
 from __future__ import annotations
 
+import random
 import socket
+import time
+from dataclasses import dataclass
 from typing import Any, Sequence
 
 import numpy as np
 
 from jepsen_tpu.history.encode import PackedHistories, pack_histories
 from jepsen_tpu.history.ops import Op
-from jepsen_tpu.service.protocol import recv_frame, send_frame
+from jepsen_tpu.service.protocol import ProtocolError, recv_frame, send_frame
 
 
 #: result-map keys that are value *sets* locally and travel as sorted lists
@@ -49,12 +63,52 @@ def _desetted(result: dict[str, Any]) -> dict[str, Any]:
     return out
 
 
+@dataclass
+class RetryPolicy:
+    """Bounded retry with exponential backoff + full jitter.
+
+    ``attempts`` bounds the TOTAL tries (first offer included); delays
+    grow ``base_s * 2**k`` capped at ``cap_s``, each multiplied by a
+    uniform jitter draw so a saturated server isn't re-hit by every
+    client on the same beat.  ``seed`` pins the draw for tests."""
+
+    attempts: int = 6
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    jitter: float = 0.5  # delay is scaled by uniform(jitter, 1.0)
+    seed: int | None = None
+
+    def delay_s(self, attempt: int, rng: random.Random) -> float:
+        d = min(self.base_s * (2.0 ** attempt), self.cap_s)
+        return d * rng.uniform(min(self.jitter, 1.0), 1.0)
+
+
+class ServiceUnavailable(RuntimeError):
+    """The retry budget is spent.  ``reason`` is machine-readable:
+
+    ``{"reason": "SATURATED"|"connection", "attempts": n,
+    "last": <final reject dict or repr of the final exception>}``"""
+
+    def __init__(self, msg: str, reason: dict[str, Any]):
+        super().__init__(msg)
+        self.reason = reason
+
+
 class CheckerClient:
     """One TCP connection to a checker sidecar; reusable across calls."""
 
     def __init__(
-        self, host: str = "127.0.0.1", port: int = 8640, timeout: float = 120.0
+        self,
+        host: str = "127.0.0.1",
+        port: int = 8640,
+        timeout: float = 120.0,
+        retry: RetryPolicy | None = None,
     ):
+        self.host = host
+        self.port = port
+        self.timeout = timeout
+        self.retry = retry
+        self._rng = random.Random(retry.seed if retry else None)
         self.sock = socket.create_connection((host, port), timeout=timeout)
 
     def close(self) -> None:
@@ -66,14 +120,64 @@ class CheckerClient:
     def __exit__(self, *exc):
         self.close()
 
+    def _reconnect(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+
     def _call(
-        self, header: dict[str, Any], arrays=None
+        self, header: dict[str, Any], arrays=None, crc: bool = False
     ) -> tuple[dict[str, Any], dict[str, np.ndarray]]:
-        send_frame(self.sock, header, arrays)
+        send_frame(self.sock, header, arrays, crc=crc)
         reply, reply_arrays = recv_frame(self.sock)
         if reply.get("op") == "error":
             raise RuntimeError(f"sidecar error: {reply.get('error')}")
         return reply, reply_arrays
+
+    def _call_robust(
+        self, header: dict[str, Any], arrays=None, crc: bool = False
+    ) -> dict[str, Any]:
+        """One streaming-surface call under the retry policy: resend on
+        connection faults (block feeds are seq-idempotent), back off and
+        re-offer on ``SATURATED``.  Without a policy, single-shot."""
+        attempts = self.retry.attempts if self.retry else 1
+        last: Any = None
+        saturated = False
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(self.retry.delay_s(attempt - 1, self._rng))
+            try:
+                reply, _ = self._call(header, arrays, crc=crc)
+            except (ConnectionError, ProtocolError, OSError) as e:
+                last, saturated = repr(e), False
+                if self.retry is None or attempt + 1 >= attempts:
+                    break
+                try:
+                    self._reconnect()
+                except OSError as e2:
+                    last = repr(e2)
+                continue
+            if (
+                reply.get("op") == "rejected"
+                and reply.get("reason") == "SATURATED"
+            ):
+                last, saturated = reply, True
+                continue
+            return reply
+        reason = {
+            "reason": "SATURATED" if saturated else "connection",
+            "attempts": attempts,
+            "last": last,
+        }
+        raise ServiceUnavailable(
+            f"service unavailable after {attempts} attempt(s): "
+            f"{reason['reason']}",
+            reason,
+        )
 
     def ping(self) -> dict[str, Any]:
         reply, _ = self._call({"op": "ping"})
@@ -135,3 +239,146 @@ class CheckerClient:
         }
         reply, _ = self._call(header)
         return [_desetted(r) for r in reply["results"]]
+
+    # -- streaming surface ------------------------------------------------
+
+    def stream_open(
+        self,
+        workload: str,
+        opts: dict | None = None,
+        content_key: str | None = None,
+        deadline_s: float | None = None,
+    ) -> dict[str, Any]:
+        """Open a stream: ``{"op": "opened", "stream": sid}``, a cached
+        verdict (when ``content_key`` hits), or raises
+        :class:`ServiceUnavailable` after the retry budget."""
+        header: dict[str, Any] = {
+            "op": "stream-open", "workload": workload, "opts": opts or {},
+        }
+        if content_key is not None:
+            header["content_key"] = content_key
+        if deadline_s is not None:
+            header["deadline_s"] = deadline_s
+        return self._call_robust(header)
+
+    def stream_feed_rows(
+        self, sid: str, seq: int, rows: np.ndarray, n_ops: int
+    ) -> dict[str, Any]:
+        """Feed one ``[n, 8]`` row block (queue family), CRC-protected
+        on the wire; seq-idempotent, so resend-after-reset is safe."""
+        return self._call_robust(
+            {"op": "stream-feed", "stream": sid, "seq": seq,
+             "n_ops": n_ops},
+            {"rows": np.ascontiguousarray(rows, np.int32)},
+            crc=True,
+        )
+
+    def stream_feed_ops(
+        self, sid: str, seq: int, ops_json: list, n_ops: int | None = None
+    ) -> dict[str, Any]:
+        """Feed one op-JSON block (stream/elle/mutex families)."""
+        return self._call_robust({
+            "op": "stream-feed", "stream": sid, "seq": seq,
+            "ops_block": ops_json,
+            "n_ops": len(ops_json) if n_ops is None else n_ops,
+        })
+
+    def stream_finish(
+        self, sid: str, timeout: float | None = None
+    ) -> dict[str, Any]:
+        header: dict[str, Any] = {"op": "stream-finish", "stream": sid}
+        if timeout is not None:
+            header["timeout"] = timeout
+        return _desetted(self._call_robust(header))
+
+    def stream_abort(self, sid: str) -> dict[str, Any]:
+        return self._call_robust({"op": "stream-abort", "stream": sid})
+
+    def submit_batch_rows(
+        self,
+        workload: str,
+        blocks: Sequence[np.ndarray],
+        n_ops: Sequence[int],
+        opts: dict | None = None,
+        content_keys: Sequence[str] | None = None,
+    ) -> dict[str, Any]:
+        """One frame, many one-shot histories (the fleet path):
+        concatenated rows + offsets; per-history admission replies in
+        order (``accepted`` with an id, ``cached``, or ``rejected``)."""
+        if not blocks:
+            return {"op": "submitted", "replies": []}
+        mats = [np.ascontiguousarray(b, np.int32) for b in blocks]
+        offsets = np.zeros(len(mats) + 1, np.int64)
+        np.cumsum([m.shape[0] for m in mats], out=offsets[1:])
+        header: dict[str, Any] = {
+            "op": "submit-batch", "workload": workload,
+            "opts": opts or {}, "n_ops": [int(n) for n in n_ops],
+        }
+        if content_keys is not None:
+            header["content_keys"] = list(content_keys)
+        return self._call_robust(
+            header,
+            {"rows": np.concatenate(mats, axis=0), "offsets": offsets},
+            crc=True,
+        )
+
+    def collect(
+        self, ids: Sequence[str], timeout: float = 0.0
+    ) -> dict[str, Any]:
+        reply = self._call_robust(
+            {"op": "collect", "ids": list(ids), "timeout": timeout}
+        )
+        if isinstance(reply.get("done"), dict):
+            reply["done"] = {
+                k: _desetted(v) if isinstance(v, dict) else v
+                for k, v in reply["done"].items()
+            }
+        return reply
+
+    def cache_get(
+        self, content_key: str, workload: str, opts: dict | None = None
+    ) -> dict[str, Any]:
+        return self._call_robust({
+            "op": "cache-get", "content_key": content_key,
+            "workload": workload, "opts": opts or {},
+        })
+
+    def service_stats(self) -> dict[str, Any]:
+        return self._call_robust({"op": "service-stats"})
+
+    def check_jtc(
+        self,
+        path,
+        block_rows: int = 512,
+        opts: dict | None = None,
+        timeout: float | None = None,
+    ) -> dict[str, Any]:
+        """Stream one ``.jtc`` substrate end-to-end: content-key lookup
+        first (a cached verdict costs a hash, not a device dispatch),
+        else open + feed row blocks + finish.  Queue-family substrates
+        only (the zero-parse wire path)."""
+        from jepsen_tpu.history.columnar import iter_row_blocks, read_jtc
+
+        jtc, _stamp = read_jtc(path)
+        rows = jtc.rows()
+        if rows is None:
+            raise ValueError(f"{path}: no row section to stream")
+        workload = jtc.workload or "queue"
+        if workload != "queue":
+            raise ValueError(
+                f"{path}: {workload} histories stream as op blocks "
+                f"(stream_feed_ops), not row blocks"
+            )
+        opened = self.stream_open(
+            workload, opts=opts, content_key=jtc.content_key()
+        )
+        if opened["op"] == "cached":
+            return opened
+        if opened["op"] != "opened":
+            return opened
+        sid = opened["stream"]
+        for seq, (blk, n) in enumerate(iter_row_blocks(rows, block_rows)):
+            fed = self.stream_feed_rows(sid, seq, blk, n)
+            if fed["op"] not in ("accepted",):
+                return fed
+        return self.stream_finish(sid, timeout=timeout)

@@ -5,6 +5,15 @@ payload.  The header describes the op and every array (name, dtype, shape,
 in order); the payload is the arrays' bytes concatenated.  Arrays travel as
 little-endian numpy buffers — the packed ``int32`` history columns go over
 the wire exactly as they'll sit in HBM, no per-op serialization.
+
+Streaming ops additionally ship a per-array ``crc32`` in the spec
+(``send_frame(..., crc=True)``): a torn or bit-flipped block is then
+detected at the RECEIVER as :class:`TornPayloadError` — raised only
+after the whole payload has been consumed, so the connection stays in
+frame-sync and the server can quarantine exactly the poisoned stream
+while continuing to serve every other one (the PR-13 precedence rule on
+the wire: unknown-with-evidence, never folded into a verdict, never a
+gapped carry).
 """
 
 from __future__ import annotations
@@ -12,6 +21,7 @@ from __future__ import annotations
 import json
 import socket
 import struct
+import zlib
 from typing import Any, Mapping
 
 import numpy as np
@@ -26,6 +36,20 @@ MAX_PAYLOAD = 1 << 30
 
 class ProtocolError(RuntimeError):
     pass
+
+
+class TornPayloadError(ProtocolError):
+    """An array's bytes failed their declared crc32.
+
+    The frame was fully consumed (the connection is still usable); the
+    parsed ``header`` identifies which op/stream the torn bytes belonged
+    to, so the receiver can quarantine that stream instead of dropping
+    the connection."""
+
+    def __init__(self, msg: str, header: dict[str, Any], torn: list[str]):
+        super().__init__(msg)
+        self.header = header
+        self.torn = torn
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -44,6 +68,7 @@ def send_frame(
     sock: socket.socket,
     header: Mapping[str, Any],
     arrays: Mapping[str, np.ndarray] | None = None,
+    crc: bool = False,
 ) -> None:
     arrays = arrays or {}
     specs = []
@@ -53,10 +78,12 @@ def send_frame(
         if a.dtype == bool:
             a = a.astype(np.uint8)
         a = a.astype(a.dtype.newbyteorder("<"), copy=False)
-        specs.append(
-            {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
-        )
-        chunks.append(a.tobytes())
+        raw = a.tobytes()
+        spec = {"name": name, "dtype": str(a.dtype), "shape": list(a.shape)}
+        if crc:
+            spec["crc32"] = zlib.crc32(raw)
+        specs.append(spec)
+        chunks.append(raw)
     hdr = dict(header)
     hdr["arrays"] = specs
     hdr_bytes = json.dumps(hdr).encode()
@@ -76,6 +103,7 @@ def recv_frame(
         raise ProtocolError(f"oversized header ({hdr_len} bytes)")
     header = json.loads(_recv_exact(sock, hdr_len))
     arrays: dict[str, np.ndarray] = {}
+    torn: list[str] = []
     total = 0
     for spec in header.get("arrays", []):
         dtype = np.dtype(spec["dtype"])
@@ -85,7 +113,20 @@ def recv_frame(
         if total > MAX_PAYLOAD:
             raise ProtocolError(f"oversized payload (> {MAX_PAYLOAD} bytes)")
         buf = _recv_exact(sock, nbytes)
+        # verify-but-keep-reading: the whole frame must be consumed
+        # before raising, or the next recv would misparse payload bytes
+        # as a frame header (losing the connection, not just the block)
+        if "crc32" in spec and zlib.crc32(buf) != spec["crc32"]:
+            torn.append(spec["name"])
+            continue
         arrays[spec["name"]] = np.frombuffer(buf, dtype=dtype).reshape(
             spec["shape"]
+        )
+    if torn:
+        raise TornPayloadError(
+            f"torn payload: crc32 mismatch on array(s) {torn} "
+            f"(op {header.get('op')!r})",
+            header=header,
+            torn=torn,
         )
     return header, arrays

@@ -35,6 +35,7 @@ import numpy as np
 
 from jepsen_tpu.service.protocol import (
     ProtocolError,
+    TornPayloadError,
     recv_frame,
     send_frame,
 )
@@ -42,6 +43,13 @@ from jepsen_tpu.service.protocol import (
 logger = logging.getLogger("jepsen_tpu.service")
 
 REQUIRED_ARRAYS = ("f", "type", "value", "mask")
+
+#: the streaming ingestion surface (service/stream.py); everything else
+#: is the original batch sidecar
+_STREAM_OPS = frozenset({
+    "stream-open", "stream-feed", "stream-finish", "stream-abort",
+    "submit-batch", "collect", "cache-get", "service-stats",
+})
 
 
 def _pad_batch_axis(tree, multiple: int):
@@ -219,6 +227,15 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 header, arrays = recv_frame(self.request)
+            except TornPayloadError as e:
+                # the frame was fully consumed (connection still in
+                # sync): quarantine exactly the poisoned stream, reply,
+                # keep serving this connection
+                try:
+                    send_frame(self.request, server.torn_reply(e))
+                except (ProtocolError, ConnectionError, OSError):
+                    return
+                continue
             except (ProtocolError, ConnectionError, OSError):
                 return
             try:
@@ -241,8 +258,19 @@ class CheckerServer(socketserver.ThreadingTCPServer):
         port: int = 8640,
         mesh=None,
         metrics_registry=None,
+        ingest_opts: dict | None = None,
+        cache_capacity: int = 4096,
+        store: str | None = None,
     ):
         super().__init__((host, port), _Handler)
+        # streaming ingestion (stream-open/feed/finish, submit/collect):
+        # built lazily on first streaming op so batch-only deployments
+        # never pay the worker pool; constructor knobs flow through
+        self._ingest = None
+        self._ingest_lock = threading.Lock()
+        self._ingest_opts = dict(ingest_opts or {})
+        self._cache_capacity = cache_capacity
+        self._store = store
         # one device-compute at a time: connections multiplex onto the
         # accelerator serially, which is also the fastest way to use it
         self._device_lock = threading.Lock()
@@ -290,7 +318,58 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             self._metrics_srv.shutdown()
             self._metrics_srv.server_close()
             self._metrics_srv = None
+        if self._ingest is not None:
+            self._ingest.close()
+            self._ingest = None
         super().server_close()
+
+    def ingest_service(self):
+        """The lazily-built streaming ingestion core (thread-safe)."""
+        if self._ingest is None:
+            with self._ingest_lock:
+                if self._ingest is None:
+                    from jepsen_tpu.service.cache import VerdictCache
+                    from jepsen_tpu.service.stream import IngestService
+
+                    cache = VerdictCache(
+                        capacity=self._cache_capacity,
+                        registry=self.metrics,
+                    )
+                    if self._store:
+                        try:
+                            n = cache.seed_from_store(self._store)
+                            if n:
+                                logger.info(
+                                    "verdict cache seeded with %d "
+                                    "recorded run(s) from %s",
+                                    n, self._store,
+                                )
+                        except Exception:  # noqa: BLE001 — serve anyway
+                            logger.exception(
+                                "cache seed from %s failed", self._store
+                            )
+                    self._ingest = IngestService(
+                        cache=cache,
+                        registry=self.metrics,
+                        **self._ingest_opts,
+                    )
+        return self._ingest
+
+    def torn_reply(self, e: TornPayloadError) -> dict[str, Any]:
+        """Map a torn frame to its stream: poison evidence quarantines
+        exactly that stream (never folded into a verdict); torn frames
+        outside a stream are a plain error reply."""
+        hdr = e.header
+        sid = hdr.get("stream")
+        if hdr.get("op") == "stream-feed" and sid is not None:
+            self.metrics.counter(
+                "service.torn_blocks", op="stream-feed"
+            ).inc()
+            return self.ingest_service().quarantine_stream(
+                str(sid),
+                f"torn block on the wire (seq {hdr.get('seq')}): {e}",
+            )
+        return {"op": "error", "error": str(e), "torn": e.torn}
 
     def dispatch(
         self, header: dict[str, Any], arrays: dict[str, np.ndarray]
@@ -319,11 +398,15 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             # spans on one tid would render as bogus nesting
             obs_trace.complete(f"service.{op}", t0, t0 + dt)
             return reply
+        if op in _STREAM_OPS:
+            self.metrics.counter("service.requests", op=op).inc()
         return self._dispatch(op, header, arrays)
 
     def _dispatch(
         self, op, header: dict[str, Any], arrays: dict[str, np.ndarray]
     ) -> dict[str, Any]:
+        if op in _STREAM_OPS:
+            return self._dispatch_stream(op, header, arrays)
         if op == "ping":
             import jax
 
@@ -396,6 +479,110 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             return _elle_results(graphs, t)
         raise ProtocolError(f"unknown op {op!r}")
 
+    def _dispatch_stream(
+        self, op, header: dict[str, Any], arrays: dict[str, np.ndarray]
+    ) -> dict[str, Any]:
+        """The always-on streaming surface: every reply is a plain
+        machine-readable dict (``opened`` / ``accepted`` / ``rejected``
+        with ``SATURATED`` / ``quarantined`` / a verdict) — admission
+        decisions are data, not exceptions."""
+        svc = self.ingest_service()
+        if op == "stream-open":
+            workload = header.get("workload")
+            if not workload:
+                raise ProtocolError("stream-open requires workload")
+            return svc.open(
+                str(workload),
+                opts=header.get("opts") or {},
+                content_key=header.get("content_key"),
+                deadline_s=header.get("deadline_s"),
+            )
+        if op == "stream-feed":
+            sid = header.get("stream")
+            seq = header.get("seq")
+            if sid is None or seq is None:
+                raise ProtocolError("stream-feed requires stream and seq")
+            if "rows" in arrays:
+                payload = arrays["rows"]
+                bkind = "rows"
+                n_ops = int(header.get("n_ops", payload.shape[0]))
+            elif "ops_block" in header:
+                payload = header["ops_block"]
+                bkind = "ops"
+                n_ops = int(header.get("n_ops", len(payload)))
+            else:
+                raise ProtocolError(
+                    "stream-feed requires a rows array or an ops_block"
+                )
+            return svc.feed(str(sid), int(seq), bkind, payload, n_ops)
+        if op == "stream-finish":
+            sid = header.get("stream")
+            if sid is None:
+                raise ProtocolError("stream-finish requires stream")
+            verdict = svc.finish(str(sid), timeout=header.get("timeout"))
+            if "op" not in verdict:
+                verdict = dict(verdict)
+                verdict["op"] = "verdict"
+            return verdict
+        if op == "stream-abort":
+            sid = header.get("stream")
+            if sid is None:
+                raise ProtocolError("stream-abort requires stream")
+            return svc.abort(str(sid))
+        if op == "submit-batch":
+            # the fleet path: one frame = many histories (concatenated
+            # rows + offsets), one admission decision each
+            workload = header.get("workload")
+            if not workload:
+                raise ProtocolError("submit-batch requires workload")
+            if "rows" not in arrays or "offsets" not in arrays:
+                raise ProtocolError(
+                    "submit-batch requires rows and offsets arrays"
+                )
+            rows = arrays["rows"]
+            offsets = np.asarray(arrays["offsets"], np.int64)
+            n_ops = header.get("n_ops") or []
+            keys = header.get("content_keys") or []
+            opts = header.get("opts") or {}
+            replies = []
+            for i in range(len(offsets) - 1):
+                blk = rows[int(offsets[i]) : int(offsets[i + 1])]
+                replies.append(svc.submit(
+                    str(workload), opts, "rows", blk,
+                    int(n_ops[i]) if i < len(n_ops) else blk.shape[0],
+                    content_key=keys[i] if i < len(keys) else None,
+                ))
+            return {"op": "submitted", "replies": replies}
+        if op == "collect":
+            ids = header.get("ids") or []
+            return svc.collect(
+                [str(i) for i in ids],
+                timeout=float(header.get("timeout", 0.0)),
+            )
+        if op == "cache-get":
+            key = header.get("content_key")
+            if not key:
+                raise ProtocolError("cache-get requires content_key")
+            if svc.cache is None:
+                return {"op": "miss"}
+            from jepsen_tpu.service.cache import cache_key
+
+            entry = svc.cache.get(cache_key(
+                str(key), str(header.get("workload", "queue")),
+                header.get("opts") or {},
+            ))
+            if entry is None:
+                return {"op": "miss"}
+            out = {"op": "cached", "verdict": entry["verdict"]}
+            if "report_ref" in entry:
+                out["report_ref"] = entry["report_ref"]
+            return out
+        if op == "service-stats":
+            stats = svc.stats()
+            stats["op"] = "stats"
+            return stats
+        raise ProtocolError(f"unknown stream op {op!r}")
+
     def start_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
@@ -408,6 +595,10 @@ def serve_forever(
     seq: int = 1,
     store: str = "store",
     metrics_port: int = 9640,
+    workers: int = 2,
+    max_streams: int = 256,
+    ingress_cap: int = 1024,
+    stream_deadline_s: float = 120.0,
 ) -> None:
     import jax
 
@@ -440,7 +631,15 @@ def serve_forever(
         from jepsen_tpu.parallel.distributed import global_checker_mesh
 
         mesh = global_checker_mesh(seq=seq)
-    srv = CheckerServer(host, port, mesh=mesh)
+    srv = CheckerServer(
+        host, port, mesh=mesh, store=store,
+        ingest_opts={
+            "workers": workers,
+            "max_streams": max_streams,
+            "ingress_cap": ingress_cap,
+            "stream_deadline_s": stream_deadline_s,
+        },
+    )
     metrics_note = "off"
     if metrics_port >= 0:
         try:

@@ -1099,6 +1099,163 @@ class TestSegmentedChaosSmoke:
         assert not doc["failures"]
 
 
+class TestServeSectionSchema:
+    """Offline gate for the ISSUE-16 ``serve`` bench schema: a tiny
+    REAL in-process run of the streaming-service arms must carry the
+    admission/latency keys, the honest-saturation accounting, and pin
+    the honesty rule that a ZERO-KILL run can never claim recovery."""
+
+    @pytest.fixture()
+    def serve_bench(self):
+        import importlib.util
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        spec = importlib.util.spec_from_file_location(
+            "bench_serve_under_test",
+            str(REPO / "tools" / "bench_serve.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @staticmethod
+    def _ns(**over):
+        import argparse as _ap
+
+        base = dict(
+            histories=400, base=8, ops=40, workers=2, seed=16,
+            min_rate=0.0, cache_ops=600, cache_reps=40,
+            chaos_streams=4, chaos_ops=600, chaos_blocks=6,
+            kill_block=2, sat_submits=24, sat_block_delay=0.02,
+            timeout=120.0, device=False,
+        )
+        base.update(over)
+        return _ap.Namespace(**base)
+
+    def test_throughput_schema(self, serve_bench):
+        out = serve_bench.arm_throughput(self._ns(), lambda m: None)
+        for key in (
+            "histories",
+            "ops_per_history",
+            "workers",
+            "admit_wall_s",
+            "admitted_per_s",  # THE acceptance-floor key
+            "wall_s",
+            "completed_per_s",
+            "submit_rejects_retried",
+            "p50_ms",
+            "p99_ms",
+            "verdicts",
+        ):
+            assert key in out, f"serve throughput schema lost {key!r}"
+        assert out["admitted_per_s"] > 0
+        assert out["p99_ms"] >= out["p50_ms"]
+        # no silent drops hiding behind the admission rate
+        assert out["verdicts"] == out["histories"]
+
+    def test_saturation_books_balance(self, serve_bench):
+        failures = []
+
+        def check(cond, msg):
+            if not cond:
+                failures.append(msg)
+
+        out = serve_bench.arm_saturation(
+            self._ns(), lambda m: None, check
+        )
+        for key in (
+            "submitted",
+            "accepted",
+            "rejected_saturated",
+            "verdicts",
+            "quarantines",
+            "gapped_carries",
+            "silent_drops",
+            "admission_rejects",
+        ):
+            assert key in out, f"serve saturation schema lost {key!r}"
+        assert not failures, failures
+        # honest saturation: loud rejects, exact books, no fabricated
+        # gapped carries and no quarantines from mere overload
+        assert out["rejected_saturated"] > 0
+        assert out["silent_drops"] == 0
+        assert out["gapped_carries"] == 0
+        assert out["quarantines"] == 0
+        assert (
+            out["submitted"]
+            == out["verdicts"] + out["rejected_saturated"]
+        )
+
+    def test_zero_kill_cannot_claim_recovery(self, serve_bench):
+        failures = []
+
+        def check(cond, msg):
+            if not cond:
+                failures.append(msg)
+
+        out = serve_bench.arm_chaos(self._ns(), lambda m: None, check)
+        assert not failures, failures
+        zk = out["zero_kill"]
+        # honesty rule: an unkilled run may never wear the recovery
+        # story — no deaths, no degraded provenance, oracle-identical
+        assert zk["worker_deaths"] == 0
+        assert zk["claims_recovery"] is False
+        assert zk["verdicts_match"] is True
+        kill = out["kill"]
+        assert kill["worker_deaths"] >= 1
+        assert kill["oracle_mismatches"] == 0
+        assert kill["degraded_streams"] >= 1
+
+
+class TestServeChaosSmoke:
+    """The streaming-service chaos harness (``tools/chaos_check.py
+    --serve``) must stay runnable offline: deterministic die-hook
+    (worker 0 dies mid-feed of its Nth block), tiny sizes, every
+    built-in assertion green — zero-kill honesty row, surviving
+    verdicts ≡ the serial oracle, degraded provenance names the dead
+    worker, saturation books balance.  The at-scale capture is a
+    committed artifact (``store/chaos_r16_serve``), not suite work."""
+
+    def test_serve_chaos_green(self, tmp_path):
+        import importlib.util
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip("offline CPU gate")
+        spec = importlib.util.spec_from_file_location(
+            "chaos_check_serve_under_test",
+            str(REPO / "tools" / "chaos_check.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(
+            [
+                "--serve",
+                "--procs", "2",
+                "--histories", "4",
+                "--serve-ops", "600",
+                "--serve-kill-block", "2",
+                "--out", str(tmp_path / "serve_chaos"),
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(
+            (tmp_path / "serve_chaos" / "results.json").read_text()
+        )
+        assert doc["pass"] is True
+        assert doc["tool"] == "chaos_check --serve"
+        assert not doc["failures"]
+
+
 class TestFuzzMatrixSmoke:
     """Offline deterministic fuzzer smoke (sim harness, fixed seed,
     tiny budget): the run/triage/minimize plumbing must round-trip —

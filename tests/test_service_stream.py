@@ -1,0 +1,526 @@
+"""Always-on streaming ingestion service (ISSUE 16): admission,
+backpressure, torn-block quarantine, kill-mid-stream recovery — all
+differential against the serial :class:`SegmentedChecker` oracle."""
+
+import hashlib
+import json
+import socket
+import struct
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.segmented import SegmentedChecker
+from jepsen_tpu.history.columnar import iter_row_blocks
+from jepsen_tpu.history.rows import _rows_for
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+from jepsen_tpu.obs.metrics import Registry
+from jepsen_tpu.service import (
+    CheckerClient,
+    CheckerServer,
+    RetryPolicy,
+    ServiceUnavailable,
+)
+from jepsen_tpu.service.cache import VerdictCache, cache_key, contract_key
+from jepsen_tpu.service.protocol import (
+    MAGIC,
+    TornPayloadError,
+    recv_frame,
+    send_frame,
+)
+from jepsen_tpu.service.stream import SATURATED, IngestService, _wire_safe
+
+
+def _history(n_ops=400, seed=3, **anoms):
+    sh = synth_history(SynthSpec(n_ops=n_ops, seed=seed, **anoms))
+    return _rows_for(sh.ops), len(sh.ops)
+
+
+def _oracle(rows, n_ops):
+    eng = SegmentedChecker("queue", device=False)
+    eng.feed_rows(rows, n_ops)
+    return eng.finish()
+
+
+def _families_equal(served, oracle):
+    """Wire verdicts carry sorted lists for value sets; normalize BOTH
+    sides through ``_wire_safe`` so direct (sets), wire-raw (lists) and
+    client-desetted (sets again) verdicts all compare."""
+    o = _wire_safe(oracle)
+    keys = set(o) - {"segmented"}
+    s = _wire_safe({k: served.get(k) for k in keys})
+    return s == {k: o[k] for k in keys}
+
+
+def _svc(**kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("device", False)
+    kw.setdefault("registry", Registry())
+    return IngestService(**kw)
+
+
+def _feed_stream(svc, rows, n_ops, block_rows=128):
+    r = svc.open("queue", None, kind="stream", deadline_s=60.0)
+    assert r["op"] == "opened"
+    sid = r["stream"]
+    for seq, (blk, b_ops) in enumerate(iter_row_blocks(rows, block_rows)):
+        rep = svc.feed(sid, seq, "rows", blk, b_ops)
+        assert rep["op"] == "accepted", rep
+    return sid
+
+
+class TestIngestCore:
+    def test_stream_verdict_equals_oracle(self):
+        rows, n_ops = _history(lost=1, duplicated=1)
+        svc = _svc()
+        try:
+            sid = _feed_stream(svc, rows, n_ops)
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        assert _families_equal(v, _oracle(rows, n_ops))
+        assert v["provenance"]["ops"] >= n_ops
+        assert "degraded" not in v  # zero-kill: no recovery story
+
+    def test_submit_collect_verdicts_equal_oracle(self):
+        corpus = [_history(n_ops=120, seed=s, lost=s % 2) for s in range(5)]
+        svc = _svc()
+        try:
+            ids = []
+            for rows, n_ops in corpus:
+                rep = svc.submit("queue", None, "rows", rows, n_ops)
+                assert rep["op"] == "accepted"
+                ids.append(rep["id"])
+            got = svc.collect(ids, timeout=30)
+        finally:
+            svc.close()
+        assert not got["pending"]
+        for sid, (rows, n_ops) in zip(ids, corpus):
+            assert _families_equal(got["done"][sid], _oracle(rows, n_ops))
+
+    def test_sequence_gap_quarantines_never_gapped_carry(self):
+        rows, n_ops = _history()
+        svc = _svc()
+        try:
+            r = svc.open("queue", None, kind="stream")
+            sid = r["stream"]
+            blocks = list(iter_row_blocks(rows, 128))
+            svc.feed(sid, 0, "rows", *blocks[0])
+            rep = svc.feed(sid, 2, "rows", *blocks[2])  # hole at seq 1
+            assert rep["op"] == "quarantined"
+            assert rep["expected"] == 1 and rep["got"] == 2
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        # unknown WITH the gap as evidence — a carry fed around a hole
+        # would have fabricated a verdict
+        assert v["valid?"] == "unknown"
+        assert "gap in block sequence" in json.dumps(v)
+
+    def test_dup_seq_is_idempotent_ack(self):
+        rows, n_ops = _history()
+        svc = _svc()
+        try:
+            r = svc.open("queue", None, kind="stream")
+            sid = r["stream"]
+            blocks = list(iter_row_blocks(rows, 128))
+            for seq, (blk, b_ops) in enumerate(blocks):
+                svc.feed(sid, seq, "rows", blk, b_ops)
+            # a client resend after a reset: acked, never double-fed
+            rep = svc.feed(sid, 0, "rows", *blocks[0])
+            assert rep["op"] == "accepted" and rep["dup"] is True
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        assert _families_equal(v, _oracle(rows, n_ops))
+
+    def test_abort_frees_admission_slot(self):
+        rows, n_ops = _history(n_ops=80)
+        svc = _svc(max_streams=1)
+        try:
+            sid = svc.open("queue", None, kind="stream")["stream"]
+            rej = svc.open("queue", None, kind="stream")
+            assert rej["op"] == "rejected" and rej["reason"] == SATURATED
+            assert svc.abort(sid)["op"] == "aborted"
+            again = svc.open("queue", None, kind="stream")
+            assert again["op"] == "opened"
+        finally:
+            svc.close()
+
+    def test_bad_workload_is_a_loud_error(self):
+        svc = _svc()
+        try:
+            r = svc.open("nonesuch", None)
+            assert r["op"] == "error" and r["reason"] == "bad-workload"
+        finally:
+            svc.close()
+
+
+class TestAdmissionControl:
+    def test_stream_cap_rejects_saturated(self):
+        svc = _svc(max_streams=2)
+        try:
+            for _ in range(2):
+                assert svc.open("queue", None)["op"] == "opened"
+            rej = svc.open("queue", None)
+            assert rej["op"] == "rejected"
+            assert rej["reason"] == SATURATED
+            assert rej["saturated"] == "streams"
+        finally:
+            svc.close()
+
+    def test_ingress_cap_rejects_block_not_consumed(self):
+        rows, n_ops = _history(n_ops=120)
+        blocks = list(iter_row_blocks(rows, 64))
+        svc = _svc(workers=1, ingress_cap=2, block_delay_s=0.2)
+        try:
+            sid = svc.open("queue", None, kind="stream")["stream"]
+            rejects = 0
+            for seq, (blk, b_ops) in enumerate(blocks):
+                # the honest client: a SATURATED block was NOT consumed
+                # — re-offer the SAME seq until the queue drains
+                while True:
+                    rep = svc.feed(sid, seq, "rows", blk, b_ops)
+                    if rep["op"] == "accepted":
+                        break
+                    assert rep["op"] == "rejected"
+                    assert rep["reason"] == SATURATED
+                    rejects += 1
+                    time.sleep(0.05)
+            assert rejects > 0  # the tiny queue really overflowed
+            v = svc.finish(sid, timeout=60)
+        finally:
+            svc.close()
+        # zero silent drops: after honest re-offers the verdict is the
+        # oracle's, every block accounted for
+        assert _families_equal(v, _oracle(rows, n_ops))
+        assert v["provenance"]["blocks"] == len(blocks)
+
+    def test_saturation_accounting_balances(self):
+        corpus = [_history(n_ops=60, seed=s) for s in range(24)]
+        svc = _svc(workers=1, ingress_cap=2, block_delay_s=0.05)
+        try:
+            ids, rejects = [], 0
+            for rows, n_ops in corpus:
+                rep = svc.submit("queue", None, "rows", rows, n_ops)
+                if rep["op"] == "accepted":
+                    ids.append(rep["id"])
+                else:
+                    assert rep["op"] == "rejected"
+                    rejects += 1
+            got = svc.collect(ids, timeout=60)
+        finally:
+            svc.close()
+        assert not got["pending"]
+        assert len(corpus) == len(got["done"]) + rejects  # books balance
+        assert rejects > 0
+
+
+class TestChaosRecovery:
+    def test_kill_mid_stream_verdicts_equal_oracle(self):
+        """Worker 0 dies MID-FEED (after the engine mutation, before
+        the ack) under concurrent streams: the PR-13 requeue protocol
+        restores from the post-block snapshot and every verdict must
+        still equal the serial oracle, with the dead worker named."""
+        corpus = [
+            _history(n_ops=300, seed=s, duplicated=s % 2)
+            for s in range(4)
+        ]
+        svc = _svc(die_after=(0, 3))
+        try:
+            sids = [_feed_stream(svc, r, n, block_rows=64) for r, n in corpus]
+            verdicts = [svc.finish(s, timeout=60) for s in sids]
+            stats = svc.stats()
+        finally:
+            svc.close()
+        assert stats["worker_deaths"] == 1
+        degraded = [v for v in verdicts if "degraded" in v]
+        assert len(degraded) >= 1
+        assert degraded[0]["degraded"]["dead_workers"] == ["svcworker0"]
+        assert degraded[0]["degraded"]["requeued_blocks"]
+        for v, (rows, n_ops) in zip(verdicts, corpus):
+            assert _families_equal(v, _oracle(rows, n_ops))
+
+    def test_all_workers_dead_fails_loud_not_silent(self):
+        rows, n_ops = _history(n_ops=200)
+        svc = _svc(workers=1, die_after=(0, 1))
+        try:
+            sid = _feed_stream(svc, rows, n_ops, block_rows=64)
+            v = svc.finish(sid, timeout=30)
+            rej = svc.open("queue", None)
+        finally:
+            svc.close()
+        assert v["valid?"] == "unknown"
+        assert "quarantined" in json.dumps(v)
+        assert rej["op"] == "rejected"
+        assert rej["saturated"] == "no-live-workers"
+
+    def test_zero_kill_run_claims_no_recovery(self):
+        rows, n_ops = _history(n_ops=200)
+        svc = _svc()
+        try:
+            sid = _feed_stream(svc, rows, n_ops)
+            v = svc.finish(sid, timeout=30)
+            stats = svc.stats()
+        finally:
+            svc.close()
+        assert stats["worker_deaths"] == 0
+        assert stats["block_requeues"] == 0
+        assert "degraded" not in v
+
+
+class TestVerdictCache:
+    def test_content_addressed_hit_roundtrip(self):
+        rows, n_ops = _history(n_ops=200, lost=1)
+        key = hashlib.sha256(
+            np.ascontiguousarray(rows).tobytes()
+        ).hexdigest()
+        reg = Registry()
+        svc = _svc(cache=VerdictCache(8, registry=reg), registry=reg)
+        try:
+            rep = svc.submit("queue", None, "rows", rows, n_ops)
+            got = svc.collect([rep["id"]], timeout=30)
+            cold = got["done"][rep["id"]]
+            hit = svc.open("queue", None, content_key=key)
+        finally:
+            svc.close()
+        assert hit["op"] == "cached"
+        assert hit["verdict"]["valid?"] == cold["valid?"]
+
+    def test_degraded_verdicts_never_cached(self):
+        """Replaying a verdict that reflects THIS run's faults would
+        make transient damage permanent."""
+        rows, n_ops = _history(n_ops=200)
+        key = hashlib.sha256(
+            np.ascontiguousarray(rows).tobytes()
+        ).hexdigest()
+        reg = Registry()
+        # one worker: its death is deterministic and the fail-all path
+        # quarantines the stream — the faulted verdict must not land in
+        # the cache either way
+        svc = _svc(
+            cache=VerdictCache(8, registry=reg), registry=reg,
+            workers=1, die_after=(0, 2),
+        )
+        try:
+            sid = _feed_stream(svc, rows, n_ops, block_rows=64)
+            v = svc.finish(sid, timeout=30)
+            miss = svc.open("queue", None, content_key=key)
+            if miss["op"] == "opened":
+                svc.abort(miss["stream"])
+        finally:
+            svc.close()
+        assert "degraded" in v or v["valid?"] == "unknown"
+        assert miss["op"] != "cached"
+
+    def test_cache_key_separates_contracts(self):
+        k1 = cache_key("c" * 64, "queue", {})
+        k2 = cache_key("c" * 64, "queue", {"delivery": "at-least-once"})
+        k3 = cache_key("c" * 64, "stream", {})
+        assert len({k1, k2, k3}) == 3
+        assert contract_key("queue", {"a": 1}) == contract_key(
+            "queue", {"a": 1}
+        )
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = CheckerServer(host="127.0.0.1", port=0)
+    srv.start_background()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def client(server):
+    with CheckerClient(port=server.port) as c:
+        yield c
+
+
+class TestWireStreaming:
+    def test_wire_stream_equals_oracle_with_sets(self, client):
+        rows, n_ops = _history(n_ops=300, lost=1)
+        sid = client.stream_open("queue")["stream"]
+        for seq, (blk, b_ops) in enumerate(iter_row_blocks(rows, 128)):
+            rep = client.stream_feed_rows(sid, seq, blk, b_ops)
+            assert rep["op"] == "accepted"
+        v = client.stream_finish(sid, timeout=30)
+        oracle = _oracle(rows, n_ops)
+        keys = set(oracle) - {"segmented"}
+        assert {k: v.get(k) for k in keys} == {
+            k: oracle[k] for k in keys
+        }  # incl. value SETS restored client-side
+
+    def test_submit_batch_and_collect(self, client):
+        corpus = [_history(n_ops=100, seed=s) for s in range(3)]
+        rep = client.submit_batch_rows(
+            "queue", [r for r, _ in corpus], [n for _, n in corpus]
+        )
+        assert rep["op"] == "submitted"
+        ids = [r["id"] for r in rep["replies"]]
+        got = client.collect(ids, timeout=30)
+        assert not got["pending"]
+        for sid, (rows, n_ops) in zip(ids, corpus):
+            assert _families_equal(got["done"][sid], _oracle(rows, n_ops))
+
+    def test_torn_block_quarantines_stream_connection_survives(
+        self, server, client
+    ):
+        """A CRC-failed block poisons exactly ITS stream (unknown with
+        the torn evidence) — the frame stays in sync, the connection
+        and every other stream keep working."""
+        rows, n_ops = _history(n_ops=200)
+        blocks = list(iter_row_blocks(rows, 128))
+        sid = client.stream_open("queue")["stream"]
+        client.stream_feed_rows(sid, 0, blocks[0][0], blocks[0][1])
+
+        blk = np.ascontiguousarray(blocks[1][0], np.int32)
+        raw = blk.astype(blk.dtype.newbyteorder("<"), copy=False).tobytes()
+        hdr = {
+            "op": "stream-feed", "stream": sid, "seq": 1,
+            "n_ops": blocks[1][1],
+            "arrays": [{
+                "name": "rows", "dtype": str(blk.dtype),
+                "shape": list(blk.shape),
+                "crc32": zlib.crc32(raw) ^ 0xDEADBEEF,  # torn
+            }],
+        }
+        hb = json.dumps(hdr).encode()
+        client.sock.sendall(
+            struct.pack(">4sI", MAGIC, len(hb)) + hb + raw
+        )
+        reply, _ = recv_frame(client.sock)
+        assert reply["op"] == "quarantined"
+        assert "torn" in reply["error"]
+
+        v = client.stream_finish(sid, timeout=30)
+        assert v["valid?"] == "unknown"
+        assert "torn" in json.dumps(v, default=sorted)
+        # connection still in frame-sync; an unrelated stream is clean
+        assert client.ping()["op"] == "pong"
+        rows2, n2 = _history(n_ops=100, seed=9)
+        sid2 = client.stream_open("queue")["stream"]
+        client.stream_feed_rows(sid2, 0, rows2, n2)
+        v2 = client.stream_finish(sid2, timeout=30)
+        assert _families_equal(v2, _oracle(rows2, n2))
+
+    def test_service_stats_over_wire(self, client):
+        stats = client.service_stats()
+        assert stats["op"] == "stats"
+        assert "workers_alive" in stats and "admission_rejects" in stats
+
+
+class TestClientRetry:
+    def test_retry_policy_delays_bounded_and_growing(self):
+        rp = RetryPolicy(attempts=5, base_s=0.1, cap_s=1.0, jitter=0.5,
+                         seed=7)
+        rng = __import__("random").Random(7)
+        delays = [rp.delay_s(k, rng) for k in range(6)]
+        assert all(d <= 1.0 for d in delays)
+        assert delays[0] <= 0.1  # jittered below base
+        assert max(delays[3:]) >= 0.4  # grew toward the cap
+
+    def test_budget_exhaustion_machine_readable(self, server):
+        """A saturated server plus a spent retry budget surfaces as
+        ServiceUnavailable with a machine-readable reason — never a raw
+        socket error, never a silent drop."""
+        svc = server.ingest_service()
+        # wedge admission: fill every stream slot
+        held = []
+        while True:
+            r = svc.open("queue", None, kind="stream")
+            if r["op"] != "opened":
+                break
+            held.append(r["stream"])
+        try:
+            with CheckerClient(
+                port=server.port,
+                retry=RetryPolicy(attempts=3, base_s=0.01, cap_s=0.02,
+                                  seed=1),
+            ) as c:
+                with pytest.raises(ServiceUnavailable) as ei:
+                    c.stream_open("queue")
+            reason = ei.value.reason
+            assert reason["reason"] == SATURATED
+            assert reason["attempts"] == 3
+            assert reason["last"]["saturated"] == "streams"
+        finally:
+            for sid in held:
+                svc.abort(sid)
+
+
+class TestProtocolTorn:
+    def test_torn_error_carries_header_and_names(self):
+        a, b = socket.socketpair()
+        try:
+            arr = np.arange(8, dtype=np.int32)
+            raw = arr.tobytes()
+            hdr = {
+                "op": "stream-feed", "stream": "s9", "seq": 4,
+                "arrays": [{"name": "rows", "dtype": "int32",
+                            "shape": [8], "crc32": zlib.crc32(raw) ^ 1}],
+            }
+            hb = json.dumps(hdr).encode()
+            a.sendall(struct.pack(">4sI", MAGIC, len(hb)) + hb + raw)
+            send_frame(a, {"op": "ping"})  # next frame, same socket
+            with pytest.raises(TornPayloadError) as ei:
+                recv_frame(b)
+            assert ei.value.header["stream"] == "s9"
+            assert ei.value.torn == ["rows"]
+            # the torn frame was fully consumed: the NEXT frame parses
+            header, _ = recv_frame(b)
+            assert header["op"] == "ping"
+        finally:
+            a.close()
+            b.close()
+
+    def test_crc_optin_roundtrip_clean(self):
+        a, b = socket.socketpair()
+        try:
+            arr = np.arange(6, dtype=np.int32).reshape(2, 3)
+            send_frame(a, {"op": "stream-feed"}, {"rows": arr}, crc=True)
+            header, arrays = recv_frame(b)
+            assert header["arrays"][0]["crc32"] == zlib.crc32(
+                arr.tobytes()
+            )
+            np.testing.assert_array_equal(arrays["rows"], arr)
+        finally:
+            a.close()
+            b.close()
+
+
+class TestColumnarHelpers:
+    def test_iter_row_blocks_covers_and_counts(self):
+        rows, n_ops = _history(n_ops=150)
+        blocks = list(iter_row_blocks(rows, 64))
+        np.testing.assert_array_equal(
+            np.concatenate([b for b, _ in blocks]), rows
+        )
+        assert all(n >= 1 for _, n in blocks)
+        with pytest.raises(ValueError):
+            list(iter_row_blocks(rows, 0))
+
+    def test_streamed_digest_equals_jtc_content_key(self, tmp_path):
+        """The client's block-wise sha256 must equal the server's and
+        the ``.jtc`` file's content key — one address, three sites."""
+        from jepsen_tpu.history.columnar import (
+            payload_sha256,
+            read_jtc,
+            write_jtc,
+        )
+        from jepsen_tpu.history.store import write_history_jsonl
+
+        sh = synth_history(SynthSpec(n_ops=150, seed=4))
+        src = tmp_path / "h.jsonl"
+        write_history_jsonl(src, sh.ops)
+        jtc_path = write_jtc(src, "queue", rows=_rows_for(sh.ops))
+        jtc, _ = read_jtc(jtc_path)
+        key = jtc.content_key()
+        assert payload_sha256(jtc_path) == key
+        h = hashlib.sha256()
+        for kind in sorted(jtc.arrays):
+            h.update(np.ascontiguousarray(jtc.arrays[kind]).tobytes())
+        assert h.hexdigest() == key

@@ -1,0 +1,483 @@
+#!/usr/bin/env python
+"""Load generator + chaos proofs for the always-on verification
+service (ISSUE 16): the availability story, measured fail-loud.
+
+Four arms, each against a fresh in-process :class:`IngestService`
+(the wire adds a socket hop; admission, backpressure, carry and
+recovery semantics — the claims under test — live in the core):
+
+- **throughput** (cache OFF): ``--histories`` one-shot submissions
+  through the streaming admission path, reporting admitted
+  histories/s and p50/p99 submit→verdict latency off the PR-9
+  mergeable quantile sketches (``service.submit_to_verdict_s``).
+- **cache**: one history submitted cold, then re-requested by its
+  content key; the content-addressed verdict cache must answer
+  ``--cache-reps`` lookups at ≥100x below the cold check cost.
+- **chaos**: a zero-kill honesty row first (``worker_deaths == 0``
+  and NO verdict claims recovery), then the deterministic die-hook
+  kills worker 0 mid-feed under concurrent streams: every
+  non-quarantined verdict must be IDENTICAL to the serial
+  :class:`SegmentedChecker` oracle and the affected stream's
+  ``degraded`` provenance must name the dead worker.
+- **saturation**: a deliberately tiny service (1 slow worker, ingress
+  cap 4) under a burst; every refused submit must be a loud
+  ``SATURATED`` reject and the books must balance exactly:
+  ``submitted == verdicts + rejects`` with zero quarantines, zero
+  gapped carries, zero silent drops.
+
+Artifacts land in ``--out``: ``bench_serve.log`` + ``results.json``
+(the committed evidence for the round).  Exit 0 only if every
+assertion held.  ``bench.py`` runs a scaled-down pass as its
+``serve`` section (offline-schema-gated in tests/test_ci.py).
+
+Examples:
+  JAX_PLATFORMS=cpu python tools/bench_serve.py --out store/bench_r16_serve
+  JAX_PLATFORMS=cpu python tools/bench_serve.py --histories 20000 \
+      --workers 4 --out /tmp/serve_big
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+class _Log:
+    def __init__(self, path: Path | None):
+        self.path = path
+        if path is not None:
+            path.parent.mkdir(parents=True, exist_ok=True)
+            path.write_text("")
+
+    def __call__(self, msg: str) -> None:
+        line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+        print(line, flush=True)
+        if self.path is not None:
+            with open(self.path, "a") as fh:
+                fh.write(line + "\n")
+
+
+def _corpus_rows(n_histories: int, n_base: int, n_ops: int, seed: int):
+    """``n_base`` distinct synthesized queue histories (one laced with
+    a known loss so the corpus carries a real invalid verdict),
+    replicated to ``n_histories`` row blocks."""
+    from jepsen_tpu.history.rows import _rows_for
+    from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+    base = []
+    for i in range(n_base):
+        h = synth_history(
+            SynthSpec(n_ops=n_ops, seed=seed + i, lost=1 if i == 0 else 0)
+        )
+        base.append((_rows_for(h.ops), len(h.ops)))
+    return [base[i % n_base] for i in range(n_histories)]
+
+
+def _oracle_verdict(rows: np.ndarray, n_ops: int) -> dict:
+    from jepsen_tpu.checkers.segmented import SegmentedChecker
+
+    eng = SegmentedChecker("queue", device=False)
+    eng.feed_rows(rows, n_ops)
+    return eng.finish()
+
+
+def _families_equal(served: dict, oracle: dict) -> bool:
+    """Wire verdicts carry sorted lists where the engine carries sets;
+    compare on the wire-normalized shape, families + validity only
+    (provenance/degraded/segmented metadata legitimately differ)."""
+    from jepsen_tpu.service.stream import _wire_safe
+
+    o = _wire_safe(oracle)
+    keys = set(o) - {"segmented"}
+    s = {k: served.get(k) for k in keys}
+    return s == {k: o[k] for k in keys}
+
+
+def _new_service(registry, **kw):
+    from jepsen_tpu.service.stream import IngestService
+
+    kw.setdefault("device", False)  # CPU numpy twins: the bench must
+    # measure the service, not per-block dispatch overhead on the
+    # CPU backend (chip runs flip this via --device)
+    return IngestService(registry=registry, **kw)
+
+
+def _drain_submits(svc, ids, timeout_s: float) -> dict:
+    got = svc.collect(ids, timeout=timeout_s)
+    if got["pending"]:
+        raise RuntimeError(
+            f"{len(got['pending'])} submissions never completed "
+            f"within {timeout_s}s"
+        )
+    return got["done"]
+
+
+# -- arms -----------------------------------------------------------------
+
+
+def arm_throughput(args, log) -> dict:
+    from jepsen_tpu.obs.metrics import Registry
+
+    corpus = _corpus_rows(args.histories, args.base, args.ops, args.seed)
+    reg = Registry()
+    svc = _new_service(
+        reg, workers=args.workers, max_streams=args.histories + 8,
+        ingress_cap=args.histories + 8, cache=None, device=args.device,
+    )
+    try:
+        t0 = time.perf_counter()
+        ids = []
+        rejects = 0
+        for rows, n_ops in corpus:
+            while True:
+                rep = svc.submit("queue", None, "rows", rows, n_ops)
+                if rep["op"] == "accepted":
+                    ids.append(rep["id"])
+                    break
+                rejects += 1  # honest backpressure: re-offer
+                time.sleep(0.001)
+        admit_wall = time.perf_counter() - t0
+        verdicts = _drain_submits(svc, ids, args.timeout)
+        wall = time.perf_counter() - t0
+    finally:
+        svc.close()
+    sk = reg.sketch("service.submit_to_verdict_s")
+    out = {
+        "histories": len(corpus),
+        "ops_per_history": args.ops,
+        "workers": args.workers,
+        # two rates, both real: ADMISSION is the subsystem under test
+        # (the acceptance floor); verdict completion is engine-bound
+        # (the host numpy twins here — chip runs batch the per-block
+        # dispatch) and governed by backpressure, never a silent queue
+        "admit_wall_s": round(admit_wall, 3),
+        "admitted_per_s": round(len(corpus) / admit_wall, 1),
+        "wall_s": round(wall, 3),
+        "completed_per_s": round(len(verdicts) / wall, 1),
+        "submit_rejects_retried": rejects,
+        "p50_ms": round(sk.quantile(0.5) * 1e3, 3),
+        "p99_ms": round(sk.quantile(0.99) * 1e3, 3),
+        "verdicts": len(verdicts),
+    }
+    log(f"throughput: {json.dumps(out)}")
+    return out
+
+
+def arm_cache(args, log) -> dict:
+    from jepsen_tpu.obs.metrics import Registry
+    from jepsen_tpu.service.cache import VerdictCache
+
+    rows, n_ops = _corpus_rows(1, 1, args.cache_ops, args.seed + 100)[0]
+    key = hashlib.sha256(
+        np.ascontiguousarray(rows).tobytes()
+    ).hexdigest()
+    reg = Registry()
+    svc = _new_service(
+        reg, workers=1, cache=VerdictCache(64, registry=reg),
+        device=args.device,
+    )
+    try:
+        t0 = time.perf_counter()
+        rep = svc.submit("queue", None, "rows", rows, n_ops)
+        assert rep["op"] == "accepted", rep
+        verdicts = _drain_submits(svc, [rep["id"]], args.timeout)
+        cold_s = time.perf_counter() - t0
+        cold = verdicts[rep["id"]]
+
+        t1 = time.perf_counter()
+        hits = 0
+        for _ in range(args.cache_reps):
+            r = svc.open("queue", None, content_key=key)
+            assert r["op"] == "cached", (
+                f"content-addressed lookup missed: {r}"
+            )
+            hits += 1
+        hit_s = (time.perf_counter() - t1) / max(hits, 1)
+    finally:
+        svc.close()
+    assert _families_equal(r["verdict"], cold), (
+        "cached verdict drifted from the served one"
+    )
+    out = {
+        "ops": args.cache_ops,
+        "cold_check_s": round(cold_s, 4),
+        "cached_lookup_s": round(hit_s, 7),
+        "reps": args.cache_reps,
+        "speedup": round(cold_s / max(hit_s, 1e-9), 1),
+        "speedup_ge_100x": cold_s / max(hit_s, 1e-9) >= 100.0,
+        "cache": svc.cache.stats(),
+    }
+    log(f"cache: {json.dumps(out)}")
+    return out
+
+
+def _run_streams(svc, corpus, block_rows: int, timeout_s: float):
+    """Feed each history as a multi-block stream (re-offering on
+    SATURATED), then finish all.  Returns [(sid, verdict, oracle)]."""
+    from jepsen_tpu.history.columnar import iter_row_blocks
+
+    opened = []
+    for rows, n_ops in corpus:
+        r = svc.open("queue", None, kind="stream", deadline_s=timeout_s)
+        assert r["op"] == "opened", r
+        opened.append((r["stream"], rows, n_ops))
+    for sid, rows, n_ops in opened:
+        for seq, (blk, b_ops) in enumerate(
+            iter_row_blocks(rows, block_rows)
+        ):
+            while True:
+                rep = svc.feed(sid, seq, "rows", blk, b_ops)
+                if rep["op"] != "rejected":
+                    break
+                time.sleep(0.002)  # honest backpressure
+            assert rep["op"] == "accepted", rep
+    return [
+        (sid, svc.finish(sid, timeout=timeout_s),
+         _oracle_verdict(rows, n_ops))
+        for sid, rows, n_ops in opened
+    ]
+
+
+def arm_chaos(args, log, check) -> dict:
+    from jepsen_tpu.obs.metrics import Registry
+
+    corpus = _corpus_rows(
+        args.chaos_streams, min(args.base, args.chaos_streams),
+        args.chaos_ops, args.seed + 200,
+    )
+    block_rows = max(64, (2 * args.chaos_ops) // args.chaos_blocks)
+
+    # honesty row: an UNKILLED run may never wear the recovery story
+    reg0 = Registry()
+    svc0 = _new_service(reg0, workers=args.workers, device=args.device)
+    try:
+        clean = _run_streams(svc0, corpus, block_rows, args.timeout)
+        stats0 = svc0.stats()
+    finally:
+        svc0.close()
+    zero_kill = {
+        "streams": len(clean),
+        "worker_deaths": stats0["worker_deaths"],
+        "block_requeues": stats0["block_requeues"],
+        "claims_recovery": any("degraded" in v for _s, v, _o in clean),
+        "verdicts_match": all(
+            _families_equal(v, o) for _s, v, o in clean
+        ),
+    }
+    check(zero_kill["worker_deaths"] == 0,
+          "zero-kill run recorded zero worker deaths")
+    check(not zero_kill["claims_recovery"],
+          "zero-kill run claims NO recovery (no degraded verdicts)")
+    check(zero_kill["verdicts_match"],
+          "zero-kill verdicts identical to the serial oracle")
+
+    # the kill: worker 0 dies mid-feed of its Nth block, concurrent
+    # streams in flight — the spool/requeue protocol under live load
+    reg = Registry()
+    svc = _new_service(
+        reg, workers=args.workers, device=args.device,
+        die_after=(0, args.kill_block),
+    )
+    try:
+        served = _run_streams(svc, corpus, block_rows, args.timeout)
+        stats = svc.stats()
+    finally:
+        svc.close()
+    quarantined = [
+        (s, v) for s, v, _o in served if v.get("valid?") == "unknown"
+        and "quarantined" in str(v)
+    ]
+    survivors = [
+        (s, v, o) for s, v, o in served if (s, v) not in quarantined
+    ]
+    mism = [s for s, v, o in survivors if not _families_equal(v, o)]
+    degraded = [
+        (s, v["degraded"]) for s, v, _o in served if "degraded" in v
+    ]
+    check(stats["worker_deaths"] >= 1,
+          f"die-hook fired (worker_deaths={stats['worker_deaths']})")
+    check(not mism,
+          f"every non-quarantined verdict identical to the oracle "
+          f"(mismatches: {mism or 'none'})")
+    check(len(degraded) >= 1,
+          f"killed stream(s) carry degraded provenance "
+          f"({len(degraded)} streams)")
+    check(
+        all(d.get("dead_workers") for _s, d in degraded),
+        "degraded provenance NAMES the dead worker",
+    )
+    out = {
+        "zero_kill": zero_kill,
+        "kill": {
+            "streams": len(served),
+            "kill_block": args.kill_block,
+            "worker_deaths": stats["worker_deaths"],
+            "block_requeues": stats["block_requeues"],
+            "workers_alive": stats["workers_alive"],
+            "quarantined": len(quarantined),
+            "degraded_streams": len(degraded),
+            "degraded_example": degraded[0][1] if degraded else None,
+            "oracle_mismatches": len(mism),
+        },
+    }
+    log(f"chaos: {json.dumps(out)}")
+    return out
+
+
+def arm_saturation(args, log, check) -> dict:
+    from jepsen_tpu.obs.metrics import Registry
+
+    corpus = _corpus_rows(
+        args.sat_submits, 4, args.ops, args.seed + 300
+    )
+    reg = Registry()
+    svc = _new_service(
+        reg, workers=1, max_streams=args.sat_submits + 4, ingress_cap=4,
+        block_delay_s=args.sat_block_delay, cache=None,
+        device=args.device,
+    )
+    try:
+        ids, rejects = [], 0
+        for rows, n_ops in corpus:  # a burst, no pacing, no retries
+            rep = svc.submit("queue", None, "rows", rows, n_ops)
+            if rep["op"] == "accepted":
+                ids.append(rep["id"])
+            else:
+                assert rep["op"] == "rejected" and rep["reason"], rep
+                rejects += 1
+        verdicts = _drain_submits(svc, ids, args.timeout)
+        stats = svc.stats()
+    finally:
+        svc.close()
+    gapped = sum(
+        1 for v in verdicts.values() if "gap" in str(v.get("queue", ""))
+        or "gap" in str(v.get("quarantined", ""))
+    )
+    quar = sum(
+        1 for v in verdicts.values() if v.get("valid?") == "unknown"
+    )
+    out = {
+        "submitted": len(corpus),
+        "accepted": len(ids),
+        "rejected_saturated": rejects,
+        "verdicts": len(verdicts),
+        "quarantines": quar,
+        "gapped_carries": gapped,
+        "silent_drops": len(corpus) - len(verdicts) - rejects,
+        "ingress_cap": 4,
+        "admission_rejects": stats["admission_rejects"],
+    }
+    check(rejects > 0,
+          f"the burst actually saturated ({rejects} SATURATED rejects)")
+    check(out["silent_drops"] == 0,
+          "books balance: submitted == verdicts + rejects "
+          f"({out['submitted']} == {out['verdicts']} + "
+          f"{out['rejected_saturated']})")
+    check(out["gapped_carries"] == 0, "zero gapped carries")
+    check(out["quarantines"] == 0,
+          "saturation produced rejects, never quarantines")
+    log(f"saturation: {json.dumps(out)}")
+    return out
+
+
+# -- entry points ---------------------------------------------------------
+
+
+def run_all(args, log, check) -> dict:
+    doc: dict = {"tool": "bench_serve", "backend": "cpu"}
+    doc["throughput"] = arm_throughput(args, log)
+    check(
+        doc["throughput"]["admitted_per_s"] >= args.min_rate,
+        f"admitted rate {doc['throughput']['admitted_per_s']}/s >= "
+        f"{args.min_rate}/s floor",
+    )
+    check(
+        doc["throughput"]["verdicts"] == doc["throughput"]["histories"],
+        "every admitted history produced a verdict (no silent drops "
+        "behind the admission rate)",
+    )
+    doc["cache"] = arm_cache(args, log)
+    check(doc["cache"]["speedup_ge_100x"],
+          f"cache hit {doc['cache']['speedup']}x cheaper than a check")
+    doc["chaos"] = arm_chaos(args, log, check)
+    doc["saturation"] = arm_saturation(args, log, check)
+    return doc
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--histories", type=int, default=12000,
+                   help="throughput-arm one-shot submissions")
+    p.add_argument("--base", type=int, default=16,
+                   help="distinct synthesized histories in the corpus")
+    p.add_argument("--ops", type=int, default=40,
+                   help="op invocations per throughput history")
+    p.add_argument("--workers", type=int, default=2)
+    p.add_argument("--seed", type=int, default=16)
+    p.add_argument("--min-rate", type=float, default=10_000.0,
+                   help="acceptance floor, admitted histories/s")
+    p.add_argument("--cache-ops", type=int, default=4000,
+                   help="cache-arm history size (the cold cost)")
+    p.add_argument("--cache-reps", type=int, default=200)
+    p.add_argument("--chaos-streams", type=int, default=6)
+    p.add_argument("--chaos-ops", type=int, default=1200)
+    p.add_argument("--chaos-blocks", type=int, default=8,
+                   help="approximate blocks per chaos stream")
+    p.add_argument("--kill-block", type=int, default=3,
+                   help="worker 0 dies mid-feed of its Nth block")
+    p.add_argument("--sat-submits", type=int, default=64)
+    p.add_argument("--sat-block-delay", type=float, default=0.02,
+                   help="per-block brake that forces the tiny ingress "
+                   "queue to overflow")
+    p.add_argument("--timeout", type=float, default=300.0)
+    p.add_argument("--device", action="store_true", default=False,
+                   help="per-block device dispatch in the carry engines "
+                   "(chip runs; default CPU numpy twins)")
+    p.add_argument("--out", default=None,
+                   help="artifact dir (e.g. store/bench_r16_serve)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    out_dir = Path(args.out) if args.out else None
+    log = _Log(out_dir / "bench_serve.log" if out_dir else None)
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if cond:
+            log(f"PASS  {msg}")
+        else:
+            failures.append(msg)
+            log(f"FAIL  {msg}")
+
+    t0 = time.perf_counter()
+    doc = run_all(args, log, check)
+    doc["wall_s"] = round(time.perf_counter() - t0, 2)
+    doc["pass"] = not failures
+    doc["failures"] = failures
+    doc["config"] = {k: v for k, v in vars(args).items() if k != "out"}
+    if out_dir is not None:
+        out_dir.mkdir(parents=True, exist_ok=True)
+        (out_dir / "results.json").write_text(
+            json.dumps(doc, indent=1) + "\n"
+        )
+        log(f"artifacts: {out_dir}/results.json + bench_serve.log")
+    if failures:
+        log(f"SERVE BENCH FAIL ({len(failures)} failed assertions)")
+        return 1
+    log("SERVE BENCH PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

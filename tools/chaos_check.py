@@ -42,6 +42,9 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
+# --serve reuses the bench_serve arms; resolvable even when this file
+# is loaded as a module rather than run as a script from tools/
+sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 POISON_LINE = '{"type": "not a real op"\n'  # torn JSON: crashes the parse
 
@@ -324,6 +327,31 @@ def run_segmented_chaos(args, log, check) -> None:
           "torn-checkpoint recovery still reaches the identical verdict")
 
 
+def run_serve_chaos(args, log, check) -> dict:
+    """ISSUE-16 mode: the nemesis pointed at the always-on streaming
+    SERVICE — a zero-kill honesty row, the die-hook killing checker
+    worker 0 mid-feed under concurrent streams (surviving verdicts ≡
+    the serial oracle, degraded provenance names the corpse), and a
+    saturation burst whose books must balance exactly (loud SATURATED,
+    zero silent drops, zero gapped carries).  Reuses the arms of
+    tools/bench_serve.py so the chaos artifact and the bench measure
+    the same code paths."""
+    import bench_serve
+
+    ns = argparse.Namespace(
+        histories=0, base=8, ops=args.ops, workers=args.procs,
+        seed=args.seed, min_rate=0.0, cache_ops=0, cache_reps=0,
+        chaos_streams=max(args.histories, 4), chaos_ops=args.serve_ops,
+        chaos_blocks=8, kill_block=args.serve_kill_block,
+        sat_submits=64, sat_block_delay=0.02, timeout=args.timeout,
+        device=False,
+    )
+    return {
+        "chaos": bench_serve.arm_chaos(ns, log, check),
+        "saturation": bench_serve.arm_saturation(ns, log, check),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -373,8 +401,20 @@ def main(argv=None) -> int:
     p.add_argument("--seg-history-ops", type=int, default=4000,
                    help="--segmented: synthesized history op "
                    "invocations (the file is ~2x lines)")
+    p.add_argument("--serve", action="store_true",
+                   help="ISSUE-16 mode: chaos against the always-on "
+                   "streaming SERVICE (service/stream.py) — a "
+                   "zero-kill honesty row, a checker-worker death "
+                   "mid-feed under concurrent streams, and a "
+                   "saturation burst with exact loud-reject "
+                   "accounting; --procs is the worker pool size")
+    p.add_argument("--serve-ops", type=int, default=1200,
+                   help="--serve: ops per streamed history")
+    p.add_argument("--serve-kill-block", type=int, default=3,
+                   help="--serve: worker 0 dies mid-feed of its Nth "
+                   "block")
     args = p.parse_args(argv)
-    if not args.segmented and args.kill >= args.procs:
+    if not (args.segmented or args.serve) and args.kill >= args.procs:
         p.error("--kill must leave at least one survivor (< --procs)")
     if args.segmented and args.mode == "sigstop":
         p.error("--segmented supports sigkill / die-env (a SIGSTOPped "
@@ -390,6 +430,40 @@ def main(argv=None) -> int:
     )
 
     from jepsen_tpu.history.store import _json_default
+
+    if args.serve:
+        failures: list[str] = []
+
+        def scheck(cond: bool, msg: str) -> None:
+            if cond:
+                log(f"PASS  {msg}")
+            else:
+                failures.append(msg)
+                log(f"FAIL  {msg}")
+
+        t0 = time.perf_counter()
+        arms = run_serve_chaos(args, log, scheck)
+        if out_dir is not None:
+            doc = {
+                "tool": "chaos_check --serve",
+                "pass": not failures,
+                "config": {
+                    k: v for k, v in vars(args).items() if k != "out"
+                },
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "failures": failures,
+                **arms,
+            }
+            out_dir.mkdir(parents=True, exist_ok=True)
+            (out_dir / "results.json").write_text(
+                json.dumps(doc, indent=1, default=_json_default) + "\n"
+            )
+            log(f"artifacts: {out_dir}/results.json + chaos_check.log")
+        if failures:
+            log(f"CHAOS FAIL ({len(failures)} failed assertions)")
+            return 1
+        log("CHAOS PASS")
+        return 0
 
     if args.segmented:
         failures: list[str] = []

@@ -2744,6 +2744,52 @@ def _bench_serve_section(details: dict) -> None:
     print(f"# serve: {json.dumps(doc)}", file=sys.stderr)
 
 
+def _bench_campaign_section(details: dict) -> None:
+    """``campaign`` (ISSUE 17): the continuous campaign's record→verdict
+    PUSH latency — per-block p50/p99 from feed to the pushed verdict
+    window, measured by the campaign supervisor itself over the real
+    wire, under a no-fault arm vs the checker-side nemesis arm
+    (worker kill + torn subscription).  In-process faults only (the
+    service-restart arm's subprocess spawns belong to chaos_check
+    --campaign, not a bench loop); host-side by design — admission and
+    push are service-plane, the engines run CPU twins."""
+    import tempfile
+
+    from jepsen_tpu.campaign.supervisor import CampaignSupervisor
+
+    doc: dict = {}
+    failures: list[str] = []
+    for arm, faults in (
+        ("no_fault", ("none",)),
+        ("fault", ("kill-worker", "torn-subscription")),
+    ):
+        with tempfile.TemporaryDirectory(prefix="jt_benchcamp_") as td:
+            sup = CampaignSupervisor(
+                td, seed=16, trials=3, n_base=2, n_ops=160,
+                faults=faults, log=lambda s: None,
+            )
+            t0 = time.perf_counter()
+            s = sup.run()
+            doc[arm] = {
+                "faults": list(faults),
+                "trials": s["completed"],
+                "reds": s["reds"],
+                "oracle_matches": s["oracle_matches"],
+                "books_balanced": s["books_balanced"],
+                "windows_pushed": s["windows_pushed"],
+                "record_to_verdict_ms": s["record_to_verdict_ms"],
+                "wall_s": round(time.perf_counter() - t0, 2),
+            }
+            if s["reds"] or s["oracle_matches"] != s["completed"]:
+                failures.append(f"{arm}: campaign not green ({s})")
+            if not s["windows_pushed"]:
+                failures.append(f"{arm}: no verdict window pushed")
+    doc["pass"] = not failures
+    doc["failures"] = failures
+    details["campaign"] = doc
+    print(f"# campaign: {json.dumps(doc)}", file=sys.stderr)
+
+
 #: always the repo-root copy, regardless of the invoker's cwd — the
 #: committed artifact is what harvest.needs_chip_refresh() reads
 DETAILS_PATH = os.path.join(
@@ -2977,7 +3023,7 @@ def _run_once() -> None:
         _bench_queue_pipeline, _bench_stream, _bench_stream_long,
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_bitpack_section, _bench_segmented_section,
-        _bench_serve_section,
+        _bench_serve_section, _bench_campaign_section,
         _bench_north_star_section, _bench_cold_vs_warm_section,
         _bench_obs_overhead_section, _bench_elastic_overhead_section,
         _bench_cluster_obs_overhead_section,

@@ -1,0 +1,25 @@
+"""Continuous campaigns: close the record→stream→verdict loop under fire.
+
+The campaign package is the always-running layer above the PR-16
+checker service: a supervisor (``supervisor.py``) samples service
+trials across {stream rate × admission pressure × checker-side fault},
+drives each trial over the real wire against a live service, compares
+every verdict to a serial post-hoc oracle, and journals each completed
+trial to a durable ledger (``ledger.py``, the PR-15 checkpoint
+discipline lifted one level up) so a SIGKILLed supervisor resumes to
+the identical verdict set.  ``tail.py`` is the live-run side of the
+loop: it tails a recording run's op blocks straight into the service
+(no recorded-file intermediary) and subscribes to pushed verdict
+windows.  Any unexpected red is minimized and pinned into the matrix's
+auto-grown regression corpus (``jepsen_tpu/fuzz/pins.py``).
+"""
+
+from jepsen_tpu.campaign.ledger import (  # noqa: F401
+    LEDGER_FORMAT,
+    LedgerError,
+    clear_ledger,
+    load_ledger_chain,
+    read_ledger,
+    write_ledger,
+)
+from jepsen_tpu.campaign.tail import LiveStreamTailer  # noqa: F401

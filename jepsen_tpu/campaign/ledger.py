@@ -1,0 +1,123 @@
+"""Durable campaign ledger: the checkpoint discipline, one level up.
+
+PR-15 made a single checker run crash-recoverable by journaling segment
+checkpoints tmp → fsync → rename; the campaign supervisor needs the
+same property for the CAMPAIGN — a SIGKILLed supervisor must resume to
+the identical verdict set, trial for trial.  This module lifts that
+exact discipline (format tag, CRC-over-canonical-JSON, pid-suffixed
+tmp, fsync, ``.prev`` rotation, loud refusals) from
+``jepsen_tpu/checkers/segmented.py`` to the campaign level.
+
+The ledger document::
+
+    {"format": 1, "campaign_id": "...", "config": {...},
+     "trials": [{"trial": 0, "spec": {...},
+                 "fingerprints": {...}, "books": {...}, ...}, ...],
+     "crc32": <crc of everything above>}
+
+``campaign_id`` binds a ledger to ONE campaign configuration (seed,
+corpus, trial plan): resume refuses a ledger minted by a different
+campaign rather than silently splicing two verdict sets together.
+
+A torn main ledger (crash mid-replace, torn write, wrong format) is a
+loud :class:`LedgerError`; :func:`load_ledger_chain` then falls back to
+``.prev`` — losing at most the LAST journaled trial, never corrupting
+an earlier one — and reports every refusal so the resume log shows
+exactly what was recovered from where.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from pathlib import Path
+from typing import Any
+
+LEDGER_FORMAT = 1
+
+
+class LedgerError(RuntimeError):
+    """A ledger that cannot be trusted (torn, corrupt, wrong format,
+    wrong campaign).  Always loud: resuming from a bad ledger would
+    silently fork the verdict set."""
+
+
+def _ledger_crc(doc: dict[str, Any]) -> int:
+    body = {k: v for k, v in doc.items() if k != "crc32"}
+    return zlib.crc32(
+        json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+    )
+
+
+def write_ledger(path: str | Path, doc: dict[str, Any]) -> None:
+    """Atomically persist the ledger: pid-suffixed tmp → fsync →
+    rotate the existing ledger to ``.prev`` → ``os.replace``.  After
+    this returns, a SIGKILL at ANY instruction leaves either the new
+    ledger, the old one, or the old one under ``.prev`` — never a torn
+    main file that parses."""
+    path = Path(path)
+    out = dict(doc)
+    out["format"] = LEDGER_FORMAT
+    out["crc32"] = _ledger_crc(out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + f".{os.getpid()}.tmp")
+    with open(tmp, "w") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    if path.exists():
+        os.replace(path, path.with_name(path.name + ".prev"))
+    os.replace(tmp, path)
+
+
+def read_ledger(path: str | Path) -> dict[str, Any]:
+    path = Path(path)
+    try:
+        doc = json.loads(path.read_text())
+    except OSError as e:
+        raise LedgerError(f"{path}: unreadable ledger: {e}") from e
+    except ValueError as e:
+        raise LedgerError(f"{path}: torn/corrupt ledger JSON: {e}") from e
+    if not isinstance(doc, dict) or doc.get("format") != LEDGER_FORMAT:
+        raise LedgerError(
+            f"{path}: unknown ledger format "
+            f"{doc.get('format') if isinstance(doc, dict) else type(doc)}"
+        )
+    if _ledger_crc(doc) != doc.get("crc32"):
+        raise LedgerError(
+            f"{path}: ledger CRC mismatch (torn write or bit rot) — "
+            f"refusing to resume from it"
+        )
+    return doc
+
+
+def load_ledger_chain(
+    path: str | Path,
+) -> tuple[dict[str, Any] | None, list[str]]:
+    """Best trusted ledger along ``path`` → ``path.prev``.
+
+    Returns ``(doc, refusals)``: ``doc`` is None when neither file
+    yields a trustworthy ledger (fresh start); ``refusals`` lists every
+    candidate that was REJECTED and why, so the supervisor's resume log
+    says what was lost, not just what was kept."""
+    refusals: list[str] = []
+    path = Path(path)
+    for cand in (path, path.with_name(path.name + ".prev")):
+        if not cand.exists():
+            continue
+        try:
+            return read_ledger(cand), refusals
+        except LedgerError as e:
+            refusals.append(str(e))
+    return None, refusals
+
+
+def clear_ledger(path: str | Path) -> None:
+    path = Path(path)
+    for cand in (path, path.with_name(path.name + ".prev")):
+        try:
+            cand.unlink()
+        except FileNotFoundError:
+            pass

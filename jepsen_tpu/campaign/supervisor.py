@@ -1,0 +1,632 @@
+"""The campaign supervisor: continuous trials with the nemesis ON the
+checker, journaled so a SIGKILL resumes to the identical verdict set.
+
+One campaign is a deterministic plan of service trials
+(``jepsen_tpu.fuzz.space.sample_service_trial``): each trial pushes a
+corpus history through a LIVE checker service over the real wire while
+varying {stream rate × admission pressure × checker-side fault} and
+holds the pushed verdict to a serial post-hoc oracle.  The fault
+vocabulary is ``tools/chaos_check.py``'s (worker kill) plus two
+campaign-new ones:
+
+- **service-restart** — the service PROCESS is SIGKILLed mid-stream and
+  restarted on the same port; the interrupted history is replayed from
+  seq 0 as a NEW stream (a fresh service knows nothing of old sids —
+  continuing an old seq would fabricate continuity, and a reopened
+  stream fed at seq > 0 quarantines with gap evidence by design).
+- **torn-subscription** — the verdict-push connection is torn after N
+  frames by the server-side chaos hook; the subscriber must reconnect
+  and replay EXACTLY the missed windows (contiguity is enforced
+  client-side: any hole raises instead of resuming silently).
+
+After every completed trial the supervisor journals {spec, verdict
+fingerprint, books, pushed-window count, latency} to the durable ledger
+(``ledger.py`` — tmp → fsync → rename, the PR-15 checkpoint discipline
+one level up).  A SIGKILLed supervisor resumed with ``--resume`` skips
+exactly the journaled prefix and MUST land on the same verdict set —
+``tests/test_campaign.py`` pins kill→resume ≡ one uninterrupted run.
+
+Any unexpected red (verdict ≠ oracle, or unbalanced books) is greedily
+minimized over the trial dimensions and pinned into the matrix's
+auto-grown regression corpus (``jepsen_tpu/fuzz/pins.py``), so a
+campaign finding becomes a replayable row, not a log line.
+
+Chaos hooks (tests and ``tools/chaos_check.py --campaign`` only):
+
+- ``JEPSEN_TPU_CAMPAIGN_DIE_AFTER=n`` — ``os._exit(137)`` right after
+  journaling trial ``n`` (the deterministic supervisor-SIGKILL).
+- ``JEPSEN_TPU_CAMPAIGN_FORCE_RED=n`` — trial ``n``'s served
+  fingerprint is deliberately corrupted, proving the red → minimize →
+  pin pipeline end-to-end without needing a real service bug.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+from jepsen_tpu.campaign.ledger import (
+    LedgerError,
+    load_ledger_chain,
+    write_ledger,
+)
+from jepsen_tpu.fuzz.space import (
+    PRESSURES,
+    SERVICE_FAULTS,
+    ServiceTrialConfig,
+    sample_service_trial,
+)
+
+DIE_AFTER_ENV = "JEPSEN_TPU_CAMPAIGN_DIE_AFTER"
+FORCE_RED_ENV = "JEPSEN_TPU_CAMPAIGN_FORCE_RED"
+LEDGER_FILE = "campaign_ledger.json"
+
+#: keys that legitimately differ between a served verdict and the
+#: serial oracle (recovery provenance, shard metadata, wire framing) —
+#: everything else is the verdict and must fingerprint identically
+VOLATILE_VERDICT_KEYS = frozenset(
+    {"op", "stream", "segmented", "provenance", "degraded", "arrays"}
+)
+
+
+def _corpus(n_base: int, n_ops: int, seed: int):
+    """``n_base`` distinct synthesized queue histories, one laced with
+    a known loss so the corpus carries a real invalid verdict (matching
+    the tools/bench_serve.py corpus discipline)."""
+    from jepsen_tpu.history.rows import _rows_for
+    from jepsen_tpu.history.synth import SynthSpec, synth_history
+
+    out = []
+    for i in range(n_base):
+        h = synth_history(
+            SynthSpec(n_ops=n_ops, seed=seed + i, lost=1 if i == 0 else 0)
+        )
+        out.append((_rows_for(h.ops), len(h.ops)))
+    return out
+
+
+def oracle_verdict(rows, n_ops: int) -> dict:
+    """The post-hoc serial truth: one uninterrupted CPU engine."""
+    from jepsen_tpu.checkers.segmented import SegmentedChecker
+
+    eng = SegmentedChecker("queue", device=False)
+    eng.feed_rows(rows, n_ops)
+    return eng.finish()
+
+
+def verdict_fingerprint(verdict: dict) -> str:
+    """Canonical hash of a verdict's FAMILIES (wire-normalized, minus
+    the keys that legitimately differ between served and oracle runs).
+    Two verdicts agree iff their fingerprints agree — this is what the
+    ledger journals and what resume-equivalence is proved over."""
+    from jepsen_tpu.service.stream import _wire_safe
+
+    v = _wire_safe(verdict)
+    body = {
+        k: v[k] for k in sorted(v) if k not in VOLATILE_VERDICT_KEYS
+    }
+    return hashlib.sha256(
+        json.dumps(body, sort_keys=True, separators=(",", ":"),
+                   default=str).encode()
+    ).hexdigest()[:16]
+
+
+def _pct(sorted_s: list[float], p: float):
+    if not sorted_s:
+        return None
+    return sorted_s[min(len(sorted_s) - 1, int(p * len(sorted_s)))]
+
+
+class _WindowCollector(threading.Thread):
+    """Subscribes to one stream's pushed verdict windows on a DEDICATED
+    client and credits record(feed)→verdict latency per block as the
+    window that folded it arrives."""
+
+    def __init__(self, host: str, port: int, sid: str, feed_times: dict):
+        super().__init__(name="campaign-subscriber", daemon=True)
+        from jepsen_tpu.service.client import CheckerClient, RetryPolicy
+
+        self._client = CheckerClient(
+            host, port, retry=RetryPolicy(seed=0)
+        )
+        self._sid = sid
+        self._feed_times = feed_times
+        self.windows = 0
+        self.credited = 0
+        self.latency_s: list[float] = []
+        self.final_verdict: dict | None = None
+        self.error: str | None = None
+
+    def run(self) -> None:
+        try:
+            for w in self._client.subscribe_windows(self._sid):
+                now = time.monotonic()
+                self.windows += 1
+                for i in range(self.credited, int(w.get("blocks", 0))):
+                    t0 = self._feed_times.get(i)
+                    if t0 is not None:
+                        self.latency_s.append(now - t0)
+                self.credited = max(
+                    self.credited, int(w.get("blocks", 0))
+                )
+                if w.get("final"):
+                    self.final_verdict = w.get("verdict")
+        except Exception as e:  # noqa: BLE001 — surfaced via .error
+            self.error = repr(e)
+        finally:
+            self._client.close()
+
+
+class CampaignSupervisor:
+    """One campaign run (fresh or resumed) against one output dir."""
+
+    def __init__(
+        self,
+        out_dir: str | Path,
+        seed: int = 17,
+        trials: int = 8,
+        n_base: int = 4,
+        n_ops: int = 160,
+        faults: tuple[str, ...] = SERVICE_FAULTS,
+        pins_dir: str | None = None,
+        resume: bool = False,
+        log=print,
+    ):
+        import random
+
+        self.out_dir = Path(out_dir)
+        self.out_dir.mkdir(parents=True, exist_ok=True)
+        self.seed = seed
+        self.n_base = n_base
+        self.n_ops = n_ops
+        self.faults = tuple(faults)
+        self.pins_dir = pins_dir
+        self.resume = resume
+        self.log = log
+        self.ledger_path = self.out_dir / LEDGER_FILE
+
+        # the deterministic trial plan: a pure function of the campaign
+        # knobs, recomputed identically on resume
+        rng = random.Random(seed)
+        plan = [
+            sample_service_trial(rng, n_base, faults=self.faults)
+            for _ in range(trials)
+        ]
+        # coverage floor: the first len(faults) trials walk the fault
+        # vocabulary deterministically, so every enabled fault fires at
+        # least once regardless of the draw (other dims stay sampled)
+        for i, f in enumerate(self.faults[: len(plan)]):
+            plan[i] = dataclasses.replace(plan[i], fault=f)
+        self.plan = plan
+        self.campaign_id = hashlib.sha256(json.dumps({
+            "seed": seed, "trials": trials, "n_base": n_base,
+            "n_ops": n_ops, "faults": list(self.faults),
+            "plan": [c.to_spec() for c in plan],
+        }, sort_keys=True).encode()).hexdigest()[:16]
+
+        self.corpus = _corpus(n_base, n_ops, seed)
+        self.oracle_fps = [
+            verdict_fingerprint(oracle_verdict(rows, n))
+            for rows, n in self.corpus
+        ]
+
+        die = os.environ.get(DIE_AFTER_ENV)
+        self._die_after = int(die) if die else None
+        force = os.environ.get(FORCE_RED_ENV)
+        self._force_red_seed = (
+            plan[int(force)].seed
+            if force is not None and force != ""
+            and int(force) < len(plan) else None
+        )
+
+    # -- trial drivers ----------------------------------------------------
+
+    def _trial_inproc(self, cfg: ServiceTrialConfig) -> dict[str, Any]:
+        """none / kill-worker / torn-subscription: a fresh in-process
+        server per trial (still over the real wire), torn down after."""
+        from jepsen_tpu.obs.metrics import Registry
+        from jepsen_tpu.service.server import CheckerServer
+
+        ingest_opts: dict[str, Any] = {
+            "device": False, **PRESSURES[cfg.pressure],
+        }
+        if cfg.fault == "kill-worker":
+            ingest_opts["die_after"] = (0, cfg.fault_at)
+            # the kill exercises the requeue-onto-survivors protocol;
+            # a one-worker pool has no survivor and quarantines instead
+            # (that story is the restart arm's, not this one's)
+            ingest_opts["workers"] = max(
+                2, int(ingest_opts.get("workers", 2))
+            )
+        srv = CheckerServer(
+            host="127.0.0.1", port=0, metrics_registry=Registry(),
+            ingest_opts=ingest_opts,
+        )
+        srv.start_background()
+        if cfg.fault == "torn-subscription":
+            # arm the one-shot tear directly (same hook the env sets)
+            srv._sub_drop = cfg.fault_at
+        try:
+            return self._drive_stream(("127.0.0.1", srv.port), cfg)
+        finally:
+            srv.shutdown()
+            srv.server_close()
+
+    def _trial_restart(self, cfg: ServiceTrialConfig) -> dict[str, Any]:
+        """service-restart: a real service SUBPROCESS, SIGKILLed after
+        ``fault_at`` fed blocks, restarted on the same port; the
+        interrupted history replays from seq 0 as a NEW stream."""
+        port = _free_port()
+        store = str(self.out_dir / "svc_store")
+        pidfile = self.out_dir / "svc.pid"
+        proc = _spawn_service(port, store, pidfile=pidfile)
+        interrupted = 0
+        try:
+            try:
+                self._feed_partial(("127.0.0.1", port), cfg,
+                                   stop_after=cfg.fault_at)
+                interrupted = 1
+            finally:
+                # the fault: SIGKILL mid-stream, no goodbye
+                proc.kill()
+                proc.wait(timeout=30)
+            self.log(f"  service SIGKILLed (pid {proc.pid}); "
+                     f"restarting on :{port}")
+            proc = _spawn_service(port, store, pidfile=pidfile)
+            out = self._drive_stream(("127.0.0.1", port), cfg)
+            out["books"]["interrupted"] = interrupted
+            out["books"]["submitted"] += interrupted
+            out["restarted"] = True
+            return out
+        finally:
+            proc.kill()
+            proc.wait(timeout=30)
+            pidfile.unlink(missing_ok=True)
+
+    def _feed_partial(
+        self, addr: tuple[str, int], cfg: ServiceTrialConfig,
+        stop_after: int,
+    ) -> None:
+        """Open + feed the first ``stop_after`` blocks, then leave the
+        stream HANGING (the restart arm's victim)."""
+        from jepsen_tpu.history.columnar import iter_row_blocks
+        from jepsen_tpu.service.client import CheckerClient, RetryPolicy
+
+        rows, _n = self.corpus[cfg.history]
+        with CheckerClient(
+            *addr, retry=RetryPolicy(seed=cfg.seed)
+        ) as client:
+            opened = client.stream_open("queue")
+            if opened.get("op") != "opened":
+                raise RuntimeError(f"victim stream refused: {opened}")
+            sid = opened["stream"]
+            for seq, (blk, b_ops) in enumerate(
+                iter_row_blocks(rows, cfg.block_rows)
+            ):
+                if seq >= stop_after:
+                    return
+                rep = client.stream_feed_rows(sid, seq, blk, b_ops)
+                if rep.get("op") != "accepted":
+                    raise RuntimeError(f"victim feed refused: {rep}")
+
+    def _drive_stream(
+        self, addr: tuple[str, int], cfg: ServiceTrialConfig
+    ) -> dict[str, Any]:
+        """The whole loop for one history: open, subscribe, feed every
+        block seq-numbered (client retry absorbs SATURATED), finish,
+        join the collector.  Returns the trial record body."""
+        from jepsen_tpu.history.columnar import iter_row_blocks
+        from jepsen_tpu.service.client import CheckerClient, RetryPolicy
+
+        rows, n_ops = self.corpus[cfg.history]
+        feed_times: dict[int, float] = {}
+        books = {"submitted": 1, "verdicts": 0, "rejects": 0,
+                 "interrupted": 0}
+        with CheckerClient(
+            *addr, retry=RetryPolicy(seed=cfg.seed)
+        ) as client:
+            opened = client.stream_open("queue")
+            if opened.get("op") != "opened":
+                raise RuntimeError(f"stream-open refused: {opened}")
+            sid = opened["stream"]
+            collector = _WindowCollector(addr[0], addr[1], sid,
+                                         feed_times)
+            collector.start()
+            for seq, (blk, b_ops) in enumerate(
+                iter_row_blocks(rows, cfg.block_rows)
+            ):
+                # stamp BEFORE the send: the verdict window for this
+                # block can race ahead of the feed reply, and a stamp
+                # taken after the reply would silently miss the credit
+                feed_times[seq] = time.monotonic()
+                rep = client.stream_feed_rows(sid, seq, blk, b_ops)
+                if rep.get("op") != "accepted":
+                    books["rejects"] += 1
+                    raise RuntimeError(f"feed refused: {rep}")
+                if cfg.feed_delay_s:
+                    time.sleep(cfg.feed_delay_s)
+            verdict = client.stream_finish(sid, timeout=120)
+            books["verdicts"] += 1
+            stats = client.service_stats()
+        collector.join(timeout=120)
+        lat = sorted(collector.latency_s)
+        fp = verdict_fingerprint(verdict)
+        if (self._force_red_seed is not None
+                and cfg.seed == self._force_red_seed):
+            fp = "forced-red-" + fp[:6]
+        return {
+            "stream": sid,
+            "fingerprint": fp,
+            "windows_pushed": collector.windows,
+            "push_final_seen": collector.final_verdict is not None,
+            "push_matches_finish": (
+                collector.final_verdict is None
+                or verdict_fingerprint(collector.final_verdict) == fp
+                or fp.startswith("forced-red-")
+            ),
+            "subscriber_error": collector.error,
+            "books": books,
+            "latency_ms": {
+                "p50": round(_pct(lat, 0.5) * 1e3, 3) if lat else None,
+                "p99": round(_pct(lat, 0.99) * 1e3, 3) if lat else None,
+                "samples": len(lat),
+            },
+            "service": {
+                "admission_rejects": stats.get("admission_rejects"),
+                "worker_deaths": stats.get("worker_deaths"),
+                "block_requeues": stats.get("block_requeues"),
+                "quarantined": stats.get("quarantined"),
+            },
+        }
+
+    def run_trial(self, cfg: ServiceTrialConfig) -> dict[str, Any]:
+        if cfg.fault == "service-restart":
+            body = self._trial_restart(cfg)
+        else:
+            body = self._trial_inproc(cfg)
+        body["oracle_fp"] = self.oracle_fps[cfg.history]
+        body["oracle_match"] = (
+            body["fingerprint"] == body["oracle_fp"]
+        )
+        body["books_balanced"] = (
+            body["books"]["submitted"]
+            == body["books"]["verdicts"] + body["books"]["rejects"]
+            + body["books"]["interrupted"]
+        )
+        return body
+
+    # -- red handling ------------------------------------------------------
+
+    def _minimize_red(self, cfg: ServiceTrialConfig) -> ServiceTrialConfig:
+        """Greedy single-pass over the trial dimensions: drop each to
+        its simplest value and keep the drop iff the trial stays red —
+        the ddmin shape on a 4-knob space."""
+        current = cfg
+
+        def still_red(c: ServiceTrialConfig) -> bool:
+            try:
+                body = self.run_trial(c)
+            except Exception:  # noqa: BLE001 — a crash is still red
+                return True
+            return not (body["oracle_match"] and body["books_balanced"])
+
+        for field, simplest in (
+            ("fault", "none"), ("pressure", "none"),
+            ("feed_delay_s", 0.0), ("block_rows", 64),
+        ):
+            if getattr(current, field) == simplest:
+                continue
+            cand = dataclasses.replace(current, **{field: simplest})
+            if still_red(cand):
+                self.log(f"  minimize: {field} -> {simplest!r} "
+                         f"(still red)")
+                current = cand
+        return current
+
+    def _pin_red(self, idx: int, cfg: ServiceTrialConfig,
+                 body: dict[str, Any]) -> dict[str, Any]:
+        invalidating = []
+        if not body["oracle_match"]:
+            invalidating.append("service-divergence")
+        if not body["books_balanced"]:
+            invalidating.append("books-imbalance")
+        mincfg = self._minimize_red(cfg)
+        red: dict[str, Any] = {
+            "invalidating": invalidating,
+            "minimized_spec": mincfg.to_spec(),
+        }
+        if self.pins_dir:
+            from jepsen_tpu.fuzz.pins import append_pin
+
+            path, added = append_pin(
+                self.pins_dir, mincfg.to_spec(), invalidating,
+                source=f"campaign {self.campaign_id} trial {idx}",
+                kind="campaign",
+            )
+            red["pinned"] = str(path)
+            red["pin_added"] = added
+            self.log(f"  RED {'pinned' if added else 're-found'}: "
+                     f"{invalidating} -> {path}")
+        return red
+
+    # -- the campaign loop -------------------------------------------------
+
+    def run(self) -> dict[str, Any]:
+        # a SIGKILLed supervisor can orphan its service subprocess
+        # mid-restart-trial; the pidfile outlives the parent, so reap
+        # it here before the (re)run leaks a listener per crash
+        _reap_stale_service(self.out_dir / "svc.pid", self.log)
+        doc, refusals = (None, [])
+        if self.resume:
+            doc, refusals = load_ledger_chain(self.ledger_path)
+            for note in refusals:
+                self.log(f"ledger refusal: {note}")
+        if doc is not None:
+            if doc.get("campaign_id") != self.campaign_id:
+                raise LedgerError(
+                    f"{self.ledger_path}: ledger belongs to campaign "
+                    f"{doc.get('campaign_id')}, this plan is "
+                    f"{self.campaign_id} — refusing to splice two "
+                    f"verdict sets (use a fresh --out or --fresh)"
+                )
+            trials = list(doc["trials"])
+            # defense in depth: the journaled prefix must BE the plan
+            for t in trials:
+                want = self.plan[t["trial"]].to_spec()
+                if t["spec"] != want:
+                    raise LedgerError(
+                        f"{self.ledger_path}: trial {t['trial']} spec "
+                        f"drifted from the deterministic plan"
+                    )
+            self.log(f"resume: {len(trials)} journaled trial(s) "
+                     f"skipped (campaign {self.campaign_id})")
+        else:
+            trials = []
+
+        for idx in range(len(trials), len(self.plan)):
+            cfg = self.plan[idx]
+            self.log(f"trial {idx + 1}/{len(self.plan)}: "
+                     f"{cfg.describe()}")
+            body = self.run_trial(cfg)
+            entry: dict[str, Any] = {
+                "trial": idx, "spec": cfg.to_spec(), **body,
+            }
+            if not (body["oracle_match"] and body["books_balanced"]):
+                entry["red"] = self._pin_red(idx, cfg, body)
+            trials.append(entry)
+            write_ledger(self.ledger_path, {
+                "campaign_id": self.campaign_id,
+                "config": {
+                    "seed": self.seed, "n_base": self.n_base,
+                    "n_ops": self.n_ops,
+                    "faults": list(self.faults),
+                    "planned": len(self.plan),
+                },
+                "trials": trials,
+            })
+            self.log(
+                f"trial {idx + 1}: fp={body['fingerprint']} "
+                f"oracle={'OK' if body['oracle_match'] else 'MISMATCH'}"
+                f" windows={body['windows_pushed']} "
+                f"books={body['books']} p99="
+                f"{body['latency_ms']['p99']}ms"
+            )
+            if self._die_after is not None and idx >= self._die_after:
+                self.log(f"die-hook: os._exit(137) after journaling "
+                         f"trial {idx}")
+                os._exit(137)
+
+        # the campaign headline: median of per-trial p50s, max-ish of
+        # per-trial p99s (raw samples live in each trial's ledger entry)
+        p50s = sorted(t["latency_ms"]["p50"] for t in trials
+                      if t["latency_ms"]["p50"] is not None)
+        p99s = sorted(t["latency_ms"]["p99"] for t in trials
+                      if t["latency_ms"]["p99"] is not None)
+        summary = {
+            "campaign_id": self.campaign_id,
+            "planned": len(self.plan),
+            "completed": len(trials),
+            "reds": sum(1 for t in trials if "red" in t),
+            "oracle_matches": sum(
+                1 for t in trials if t["oracle_match"]
+            ),
+            "books_balanced": all(
+                t["books_balanced"] for t in trials
+            ),
+            "windows_pushed": sum(
+                t["windows_pushed"] for t in trials
+            ),
+            "faults_fired": sorted(
+                {t["spec"]["fault"] for t in trials}
+            ),
+            "record_to_verdict_ms": {
+                "p50": _pct(p50s, 0.5),
+                "p99": _pct(p99s, 0.99) if p99s else None,
+            },
+            "resume_refusals": refusals,
+            "resumed_from": (
+                len(doc["trials"]) if doc is not None else 0
+            ),
+            "ledger": str(self.ledger_path),
+        }
+        self.log(f"campaign done: {json.dumps(summary)}")
+        return summary
+
+
+# -- process plumbing ------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _reap_stale_service(pidfile: Path, log=print) -> bool:
+    """Kill the serve-checker orphaned by a SIGKILLed supervisor: the
+    pidfile outlives the parent, and /proc's cmdline gates against pid
+    reuse so an innocent process is never signalled."""
+    try:
+        pid = int(pidfile.read_text().strip())
+    except (OSError, ValueError):
+        return False
+    try:
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes()
+    except OSError:
+        cmdline = b""
+    if b"serve-checker" in cmdline:
+        log(f"reaping orphaned service (pid {pid}) from a killed run")
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    pidfile.unlink(missing_ok=True)
+    return True
+
+
+def _spawn_service(
+    port: int, store: str, timeout_s: float = 90.0,
+    pidfile: Path | None = None,
+) -> subprocess.Popen:
+    """A real checker-service subprocess on ``port``; returns once it
+    answers ping.  CPU-pinned: the campaign measures the service loop,
+    not device dispatch."""
+    from jepsen_tpu.service.client import CheckerClient
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop(DIE_AFTER_ENV, None)  # the supervisor's hook, not the svc's
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu", "serve-checker",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--store", store, "--metrics-port", "-1"],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.STDOUT,
+        env=env,
+    )
+    if pidfile is not None:
+        pidfile.write_text(str(proc.pid))
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"service subprocess died at startup "
+                f"(rc {proc.returncode})"
+            )
+        try:
+            with CheckerClient("127.0.0.1", port, timeout=5) as c:
+                c.ping()
+            return proc
+        except OSError:
+            time.sleep(0.25)
+    proc.kill()
+    raise RuntimeError(f"service on :{port} never became ready")

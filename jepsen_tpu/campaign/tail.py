@@ -1,0 +1,235 @@
+"""Live tailing: a recording run's blocks, straight into the service.
+
+:class:`LiveStreamTailer` closes the record → stream → verdict loop
+with NO recorded-file intermediary: it rides a run as an observer
+(``tools/soak.py --live-stream``), buffers each completed op into
+fixed-size blocks, and a feeder thread ships every block to the PR-16
+ingest service seq-numbered (``stream-feed``) WHILE the run is still
+producing — so verdict windows form ON the live stream, pushed back
+over the subscription surface, not polled after the fact.
+
+The shape deliberately mirrors ``LiveSegmentChecker`` (the in-process
+live path): same bounded hand-off queue, same honest saturation story —
+when the service cannot keep up and the queue fills, the tailer FREEZES
+further tailing and says exactly how many trailing ops went unverified,
+rather than silently dropping blocks (which would fabricate a clean
+verdict over a gapped stream).  A second thread subscribes to the
+stream's pushed verdict windows and credits record→verdict latency per
+block the moment the window that folded it arrives — the measured
+"loop closure" number the campaign reports as p50/p99.
+
+Threads and sockets: the feeder OWNS the client's request socket (the
+main thread only touches it again in :meth:`close`, after joining the
+feeder); the subscriber runs on its own dedicated connection
+(``subscribe_windows``), so pushes never interleave with feeds.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any
+
+#: blocks buffered between the recording run and the feeder before the
+#: tailer declares saturation (same bound as LiveSegmentChecker)
+MAX_PENDING_BLOCKS = 16
+
+
+class LiveStreamTailer:
+    """Observer that tails a live run's ops into the checker service.
+
+    Wire into a runner as an observer: call :meth:`observe` with each
+    completed :class:`~jepsen_tpu.history.ops.Op`; call :meth:`close`
+    after the run to flush, finish the stream, and collect the summary
+    (verdict, pushed-window count, record→verdict latency sketch).
+    Construction is loud: no service ⇒ the constructor raises, the run
+    does not silently proceed untailed."""
+
+    def __init__(
+        self,
+        host: str,
+        port: int,
+        workload: str,
+        opts: dict | None = None,
+        block_ops: int = 32,
+        retry=None,
+    ):
+        from jepsen_tpu.service.client import CheckerClient, RetryPolicy
+
+        self.workload = workload
+        self.block_ops = int(block_ops)
+        self._client = CheckerClient(
+            host, port, retry=retry or RetryPolicy(seed=0)
+        )
+        opened = self._client.stream_open(workload, opts=opts or {})
+        if opened.get("op") != "opened":
+            self._client.close()
+            raise RuntimeError(
+                f"live tail: stream-open refused: {opened}"
+            )
+        self.sid = opened["stream"]
+
+        self._lock = threading.Lock()
+        self._buf: list[dict] = []
+        self._pending: queue.Queue = queue.Queue(MAX_PENDING_BLOCKS)
+        self._next_block = 0
+        self._block_times: dict[int, float] = {}
+        self._credited = 0
+        self._latency_s: list[float] = []
+        self.ops_seen = 0
+        self.blocks_fed = 0
+        self.ops_fed = 0
+        self.windows_pushed = 0
+        self.verdict: dict[str, Any] | None = None
+        self.errors: list[str] = []
+        self._saturated_at: int | None = None
+        self._closed = False
+
+        self._feeder = threading.Thread(
+            target=self._feed_loop, name="live-tail-feeder", daemon=True
+        )
+        self._subscriber = threading.Thread(
+            target=self._subscribe_loop, name="live-tail-subscriber",
+            daemon=True,
+        )
+        self._feeder.start()
+        self._subscriber.start()
+
+    # -- recording side ---------------------------------------------------
+
+    def observe(self, op) -> None:
+        """Buffer one completed op; a full block is handed to the
+        feeder.  After saturation this is a frozen no-op — the summary
+        carries ``saturated_at_op`` + ``ops_unverified`` instead of a
+        fabricated full-coverage verdict."""
+        with self._lock:
+            if self._saturated_at is not None or self._closed:
+                return
+            self.ops_seen += 1
+            self._buf.append(op.to_json())
+            if len(self._buf) < self.block_ops:
+                return
+            block, self._buf = self._buf, []
+            idx = self._next_block
+            self._next_block += 1
+            self._block_times[idx] = time.monotonic()
+            try:
+                self._pending.put_nowait((idx, block))
+            except queue.Full:
+                # honest saturation: freeze, don't drop-and-pretend
+                self._saturated_at = self.ops_seen
+                self._next_block = idx  # block never queued
+                del self._block_times[idx]
+
+    # -- service side -----------------------------------------------------
+
+    def _feed_loop(self) -> None:
+        while True:
+            item = self._pending.get()
+            if item is None:
+                return
+            idx, block = item
+            try:
+                fed = self._client.stream_feed_ops(self.sid, idx, block)
+            except Exception as e:  # noqa: BLE001 — recorded, run goes on
+                self.errors.append(f"feed block {idx}: {e!r}")
+                return
+            if fed.get("op") not in ("accepted",):
+                self.errors.append(f"feed block {idx}: {fed}")
+                return
+            self.blocks_fed += 1
+            self.ops_fed += len(block)
+
+    def _subscribe_loop(self) -> None:
+        from jepsen_tpu.service.client import (
+            ServiceUnavailable,
+            SubscriptionGap,
+        )
+
+        try:
+            for w in self._client.subscribe_windows(self.sid):
+                now = time.monotonic()
+                with self._lock:
+                    self.windows_pushed += 1
+                    # credit record→verdict latency for every block this
+                    # window newly folded
+                    for i in range(self._credited, int(w.get("blocks", 0))):
+                        t0 = self._block_times.get(i)
+                        if t0 is not None:
+                            self._latency_s.append(now - t0)
+                    self._credited = max(
+                        self._credited, int(w.get("blocks", 0))
+                    )
+                    if w.get("final"):
+                        self.verdict = w.get("verdict")
+        except SubscriptionGap as e:
+            self.errors.append(f"subscription gap: {e.gap}")
+        except ServiceUnavailable as e:
+            self.errors.append(f"subscription unavailable: {e.reason}")
+        except Exception as e:  # noqa: BLE001 — recorded, not raised
+            self.errors.append(f"subscription: {e!r}")
+
+    # -- teardown ---------------------------------------------------------
+
+    def close(self, timeout: float = 120.0) -> dict[str, Any]:
+        """Flush the partial tail block, finish the stream, join both
+        threads, and return the summary."""
+        with self._lock:
+            self._closed = True
+            block, self._buf = self._buf, []
+            if block and self._saturated_at is None:
+                idx = self._next_block
+                self._next_block += 1
+                self._block_times[idx] = time.monotonic()
+                try:
+                    self._pending.put_nowait((idx, block))
+                except queue.Full:
+                    self._saturated_at = self.ops_seen
+                    self._next_block = idx
+                    del self._block_times[idx]
+        self._pending.put(None)
+        self._feeder.join(timeout=timeout)
+        finish_err = None
+        try:
+            # the feeder has exited: the request socket is ours again
+            reply = self._client.stream_finish(self.sid, timeout=timeout)
+            if self.verdict is None and reply.get("op") == "verdict":
+                self.verdict = {
+                    k: v for k, v in reply.items() if k != "op"
+                }
+        except Exception as e:  # noqa: BLE001
+            finish_err = repr(e)
+            self.errors.append(f"finish: {finish_err}")
+        self._subscriber.join(timeout=timeout)
+        if self._subscriber.is_alive():
+            self.errors.append("subscriber did not drain in time")
+        self._client.close()
+
+        lat = sorted(self._latency_s)
+
+        def _pct(p: float) -> float | None:
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(p * len(lat)))]
+
+        out: dict[str, Any] = {
+            "stream": self.sid,
+            "ops": self.ops_seen,
+            "blocks_fed": self.blocks_fed,
+            "ops_fed": self.ops_fed,
+            "windows_pushed": self.windows_pushed,
+            "verdict": self.verdict,
+            "errors": list(self.errors),
+            "record_to_verdict_p50_ms": (
+                round(_pct(0.50) * 1e3, 3) if lat else None
+            ),
+            "record_to_verdict_p99_ms": (
+                round(_pct(0.99) * 1e3, 3) if lat else None
+            ),
+            "latency_samples": len(lat),
+        }
+        if self._saturated_at is not None:
+            out["saturated_at_op"] = self._saturated_at
+            out["ops_unverified"] = self.ops_seen - self.ops_fed
+        return out

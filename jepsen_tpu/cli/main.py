@@ -1538,10 +1538,25 @@ def cmd_matrix(args) -> int:
         }
         for o in outcomes
     ]
+    ok = all(o.status == "valid" for o in outcomes)
+    if args.pins:
+        # auto-grown regression rows: replay every pinned red the
+        # fuzzer/campaign minted and hold it to its recorded
+        # expectation — a pin flipping green is a LOUD failure here
+        # (delete the row once the fix is confirmed deliberate)
+        from jepsen_tpu.fuzz.pins import replay_pins
+
+        pin_results = replay_pins(
+            args.pins, store_root=args.store,
+            log=lambda s: print(s, file=sys.stderr, flush=True),
+        )
+        summary.append({"pins": pin_results})
+        ok = ok and all(
+            r.get("matched", True) for r in pin_results
+        )
     # stdout is exactly the JSON summary (the CI driver tees it into
     # matrix-summary.json); the banner goes to stderr
     print(json.dumps(summary, indent=1))
-    ok = all(o.status == "valid" for o in outcomes)
     print(GOOD_BANNER if ok else INVALID_BANNER, file=sys.stderr)
     return 0 if ok else 1
 
@@ -1563,6 +1578,36 @@ def cmd_serve_checker(args) -> int:
         stream_deadline_s=args.stream_deadline,
     )
     return 0
+
+
+def cmd_campaign(args) -> int:
+    """``jepsen-tpu campaign``: the continuous-campaign supervisor
+    (trial plan, live services, oracle comparison, durable ledger);
+    stdout is the JSON summary, the banner goes to stderr."""
+    from jepsen_tpu.campaign.supervisor import CampaignSupervisor
+
+    sup = CampaignSupervisor(
+        args.out,
+        seed=args.seed,
+        trials=args.trials,
+        n_base=args.base,
+        n_ops=args.ops,
+        faults=tuple(
+            f.strip() for f in args.faults.split(",") if f.strip()
+        ),
+        pins_dir=args.pins_dir,
+        resume=args.resume,
+        log=lambda s: print(s, file=sys.stderr, flush=True),
+    )
+    summary = sup.run()
+    print(json.dumps(summary, indent=1))
+    complete = summary["completed"] == summary["planned"]
+    if args.expect_red:
+        ok = complete and summary["reds"] > 0
+    else:
+        ok = complete and summary["reds"] == 0
+    print(GOOD_BANNER if ok else INVALID_BANNER, file=sys.stderr)
+    return 0 if ok else 1
 
 
 def cmd_report(args) -> int:
@@ -2285,6 +2330,15 @@ def build_parser() -> argparse.ArgumentParser:
         help="append the extended configs (process-fault nemeses) to the "
         "reference's 14",
     )
+    m.add_argument(
+        "--pins",
+        default=None,
+        metavar="DIR",
+        help="also replay the auto-grown regression corpus "
+        "(fuzz_pins.json in DIR — rows minted by tools/fuzz_matrix.py "
+        "and the campaign supervisor) and hold each pin to its "
+        "recorded expectation",
+    )
     m.set_defaults(fn=cmd_matrix)
 
     w = sub.add_parser("serve", help="browse recorded runs over the web")
@@ -2349,6 +2403,43 @@ def build_parser() -> argparse.ArgumentParser:
         "quarantined as overdue (unknown-with-evidence, slot freed)",
     )
     sc.set_defaults(fn=cmd_serve_checker)
+
+    cp = sub.add_parser(
+        "campaign",
+        help="run a crash-recoverable continuous campaign: service "
+        "trials over {stream rate x admission pressure x checker-side "
+        "fault}, every verdict held to a serial oracle, journaled to a "
+        "durable ledger so SIGKILL -> --resume lands on the identical "
+        "verdict set",
+    )
+    cp.add_argument("--out", required=True,
+                    help="campaign dir (ledger + per-service stores)")
+    cp.add_argument("--seed", type=int, default=17)
+    cp.add_argument("--trials", type=int, default=8)
+    cp.add_argument("--base", type=int, default=4,
+                    help="distinct corpus histories (one carries a "
+                    "known loss)")
+    cp.add_argument("--ops", type=int, default=160,
+                    help="ops per corpus history")
+    cp.add_argument(
+        "--faults",
+        default=",".join(
+            ("none", "kill-worker", "service-restart",
+             "torn-subscription")
+        ),
+        help="comma list of checker-side faults the plan samples "
+        "(drop service-restart for subprocess-free smoke runs)",
+    )
+    cp.add_argument("--pins-dir", default=None,
+                    help="pin any minimized red into this dir's "
+                    "fuzz_pins.json (the matrix replays it)")
+    cp.add_argument("--resume", action="store_true",
+                    help="resume from the ledger in --out (skips the "
+                    "journaled prefix; refuses a foreign campaign)")
+    cp.add_argument("--expect-red", action="store_true",
+                    help="exit non-zero unless a red was found and "
+                    "pinned (pair with the force-red chaos hook)")
+    cp.set_defaults(fn=cmd_campaign)
 
     rp = sub.add_parser(
         "report",

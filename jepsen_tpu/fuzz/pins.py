@@ -1,0 +1,163 @@
+"""Pinned repro rows: the matrix's auto-grown regression corpus.
+
+Every confirmed-minimized red the fuzzer (``tools/fuzz_matrix.py``) or
+the continuous campaign (``jepsen_tpu/campaign``) finds is appended
+here as one JSON row — the minimized spec plus the expectation it was
+minted with — and the static matrix replays the rows alongside its
+named configs (``jepsen-tpu matrix --pins DIR``).  A finding therefore
+stays executable forever, not just documented.
+
+Dedup is by FINDING IDENTITY, not by sample: the key hashes
+``{db, workload, seed_bug, sim_faults, contract, invalidating
+checkers}`` — the axes that name a bug class — so ten fuzzer seeds
+rediscovering the same loss do not grow ten rows.  The minimized
+schedule itself is deliberately NOT in the key (two minimizations of
+one bug rarely shrink to byte-identical windows).
+
+Rows carry ``expect: "red"``: a pin is a bug that reproduced when
+minted, and the replay fails LOUDLY the day the run flips green — the
+moment to either delete the row (bug fixed) or investigate a flaky
+repro.  The file is written atomically (tmp → ``os.replace``) so a
+crashed append never leaves a torn corpus.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from pathlib import Path
+from typing import Any, Mapping
+
+PINS_FILE = "fuzz_pins.json"
+PINS_FORMAT = 1
+
+
+def pins_path(dir_: str | Path) -> Path:
+    return Path(dir_) / PINS_FILE
+
+
+def pin_key(spec: Mapping[str, Any], invalidating) -> str:
+    """The finding-identity hash (see module docstring)."""
+    ident = {
+        "db": spec.get("db"),
+        "workload": spec.get("workload"),
+        "seed_bug": spec.get("seed_bug"),
+        "sim_faults": dict(spec.get("sim_faults") or {}),
+        "contract": dict(spec.get("contract") or {}),
+        "invalidating": sorted(invalidating or []),
+    }
+    if "fault" in spec:
+        # campaign service-trial specs: the bug class is named by the
+        # service-side dimensions, not the cluster axes above
+        ident["service_trial"] = {
+            "history": spec.get("history"),
+            "fault": spec.get("fault"),
+            "pressure": spec.get("pressure"),
+        }
+    return hashlib.sha256(
+        json.dumps(ident, sort_keys=True, separators=(",", ":")).encode()
+    ).hexdigest()[:16]
+
+
+def load_pins(dir_: str | Path) -> list[dict[str, Any]]:
+    """The pinned rows (empty list when no corpus exists yet); a torn
+    or wrong-format file raises ``ValueError`` — a regression corpus
+    that silently loads as empty would un-pin every finding."""
+    path = pins_path(dir_)
+    if not path.exists():
+        return []
+    try:
+        doc = json.loads(path.read_text())
+    except ValueError as e:
+        raise ValueError(f"{path}: torn/corrupt pins file: {e}") from e
+    if not isinstance(doc, dict) or doc.get("format") != PINS_FORMAT:
+        raise ValueError(
+            f"{path}: unknown pins format "
+            f"{doc.get('format') if isinstance(doc, dict) else type(doc)}"
+        )
+    return list(doc.get("pins", []))
+
+
+def append_pin(
+    dir_: str | Path,
+    spec: Mapping[str, Any],
+    invalidating,
+    source: str,
+    kind: str = "fuzz",
+) -> tuple[Path, bool]:
+    """Append one minimized red as a pinned row (atomic, deduped).
+
+    Returns ``(path, added)`` — ``added`` is False when a row with the
+    same finding identity already exists (re-found reds don't multiply
+    rows; the existing row's ``refound`` counter is bumped instead so
+    the corpus still records that the class keeps biting)."""
+    path = pins_path(dir_)
+    pins = load_pins(dir_)
+    key = pin_key(spec, invalidating)
+    added = False
+    for row in pins:
+        if row.get("key") == key:
+            row["refound"] = int(row.get("refound", 0)) + 1
+            row["last_refound_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+            break
+    else:
+        pins.append({
+            "key": key,
+            "kind": kind,
+            "expect": "red",
+            "source": source,
+            "minted_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "invalidating": sorted(invalidating or []),
+            "spec": json.loads(json.dumps(dict(spec))),
+            "refound": 0,
+        })
+        added = True
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + f".{os.getpid()}.tmp")
+    tmp.write_text(json.dumps(
+        {"format": PINS_FORMAT, "pins": pins}, indent=1
+    ) + "\n")
+    os.replace(tmp, path)
+    return path, added
+
+
+def replay_pins(
+    dir_: str | Path,
+    store_root: str | None = None,
+    attempts: int = 2,
+    log=print,
+) -> list[dict[str, Any]]:
+    """Replay every pinned row against its recorded expectation.
+
+    A ``fuzz`` pin re-runs its spec through the triage runner and
+    matches when the red still reproduces; a row that flips green is a
+    loud mismatch (fix landed → delete the row, or the repro went
+    flaky → investigate).  ``campaign`` pins carry service-trial specs
+    with no cluster to re-run here; they are reported ``skipped`` (the
+    campaign supervisor replays them itself)."""
+    results = []
+    for row in load_pins(dir_):
+        key = row.get("key", "?")
+        if row.get("kind") != "fuzz":
+            log(f"# pin {key}: kind={row.get('kind')} — skipped "
+                f"(replayed by its own driver, not the matrix)")
+            results.append({"key": key, "status": "skipped",
+                            "kind": row.get("kind")})
+            continue
+        from jepsen_tpu.fuzz.repro import run_spec
+
+        out = run_spec(row["spec"], store_root=store_root,
+                       attempts=attempts)
+        matched = (out.status == "red") == (row.get("expect") == "red")
+        log(f"# pin {key}: {out.status} (expect {row.get('expect')}) "
+            f"{'OK' if matched else 'MISMATCH'}")
+        results.append({
+            "key": key,
+            "status": out.status,
+            "expect": row.get("expect"),
+            "matched": matched,
+            "invalidating": out.invalidating,
+        })
+    return results

@@ -234,3 +234,110 @@ def contract_opts(
     if workload == "mutex":
         o["fenced"] = bool(contract.get("fenced", False))
     return o
+
+
+# -- service trials (the campaign supervisor's dimension space) -----------
+#
+# A service trial is NOT a cluster run: the history is fixed (drawn from
+# the campaign's pre-synthesized corpus, so a serial oracle exists), and
+# what varies is how it is PUSHED through the checker service — stream
+# rate, admission pressure, and which checker-side fault fires mid-
+# stream.  The nemesis is on the checker here, not the SUT.
+
+TRIAL_SPEC_VERSION = 1
+TRIAL_SPEC_KEYS = (
+    "trial_spec_version", "seed", "history", "block_rows", "feed_delay_s",
+    "pressure", "fault", "fault_at",
+)
+
+#: checker-side faults a trial can fire (the chaos_check vocabulary plus
+#: the two campaign-new ones: a full service restart mid-campaign and a
+#: torn subscription forced to reconnect-with-replay)
+SERVICE_FAULTS = (
+    "none", "kill-worker", "service-restart", "torn-subscription",
+)
+
+#: admission-pressure tiers → ingest knobs (tight = 1 worker and a
+#: shallow ingress queue, so SATURATED rejects + client backoff actually
+#: exercise under load; books must still balance)
+PRESSURES = {
+    "none": {},
+    "tight": {"workers": 1, "ingress_cap": 4},
+}
+
+
+@dataclass
+class ServiceTrialConfig:
+    """One campaign trial, fully deterministic given its spec."""
+
+    seed: int
+    history: int  # corpus index (the oracle is per-history)
+    block_rows: int
+    feed_delay_s: float  # inter-block sleep = the stream-rate dial
+    pressure: str  # key into PRESSURES
+    fault: str  # one of SERVICE_FAULTS
+    fault_at: int  # block index / pushed-frame count the fault fires at
+
+    def to_spec(self) -> dict[str, Any]:
+        return {
+            "trial_spec_version": TRIAL_SPEC_VERSION,
+            "seed": self.seed,
+            "history": self.history,
+            "block_rows": self.block_rows,
+            "feed_delay_s": self.feed_delay_s,
+            "pressure": self.pressure,
+            "fault": self.fault,
+            "fault_at": self.fault_at,
+        }
+
+    @classmethod
+    def from_spec(cls, spec: Mapping[str, Any]) -> "ServiceTrialConfig":
+        missing = [k for k in TRIAL_SPEC_KEYS if k not in spec]
+        if missing:
+            raise ValueError(f"trial spec missing keys: {missing}")
+        if spec["trial_spec_version"] != TRIAL_SPEC_VERSION:
+            raise ValueError(
+                f"trial spec version {spec['trial_spec_version']} != "
+                f"{TRIAL_SPEC_VERSION} (this tree)"
+            )
+        return cls(
+            seed=int(spec["seed"]),
+            history=int(spec["history"]),
+            block_rows=int(spec["block_rows"]),
+            feed_delay_s=float(spec["feed_delay_s"]),
+            pressure=str(spec["pressure"]),
+            fault=str(spec["fault"]),
+            fault_at=int(spec["fault_at"]),
+        )
+
+    def describe(self) -> str:
+        return (
+            f"h{self.history} blk={self.block_rows} "
+            f"delay={self.feed_delay_s:g}s pressure={self.pressure} "
+            f"fault={self.fault}"
+            + (f"@{self.fault_at}" if self.fault != "none" else "")
+        )
+
+
+def sample_service_trial(
+    rng: random.Random,
+    n_histories: int,
+    faults: tuple[str, ...] = SERVICE_FAULTS,
+) -> ServiceTrialConfig:
+    """Draw one service trial — a pure function of ``rng``'s state, so
+    a campaign seed enumerates the same trial plan forever (which is
+    what makes SIGKILL→resume ≡ fresh-run provable)."""
+    bad = [f for f in faults if f not in SERVICE_FAULTS]
+    if bad:
+        raise ValueError(f"unknown service fault(s) {bad}")
+    seed = rng.randrange(2**31)
+    trng = random.Random(seed)
+    return ServiceTrialConfig(
+        seed=seed,
+        history=trng.randrange(max(1, n_histories)),
+        block_rows=trng.choice((16, 32, 64)),
+        feed_delay_s=trng.choice((0.0, 0.002, 0.01)),
+        pressure=trng.choice(tuple(PRESSURES)),
+        fault=trng.choice(tuple(faults)),
+        fault_at=trng.randrange(1, 5),
+    )

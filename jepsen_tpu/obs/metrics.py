@@ -365,12 +365,19 @@ def serve_metrics(
     port: int = 9640,
     registry: Registry | None = None,
     store: str | None = None,
+    cache=None,
 ):
     """A stdlib HTTP server answering ``GET /metrics`` with the
     Prometheus text rendering of ``registry`` (default: the global one).
     With ``store`` set, also answers ``GET /report/<run>`` — the per-run
     report for a run directory under the store root, rendered on demand
     (``jepsen_tpu/report/``) and containment-checked against the root.
+    With ``cache`` set (a VerdictCache, or a zero-arg callable
+    returning one — the service builds its ingest core lazily), also
+    answers ``GET /report/by-key/<cache-key>``: a read-only lookup in
+    the content-addressed verdict cache that 302s to the entry's
+    recorded ``report_ref`` run — verdicts become browsable by content
+    hash without touching cache state (``peek``, never ``get``).
     Returns the server (``.server_address`` carries the bound port;
     ``.shutdown()``/``.server_close()`` to stop); the caller starts it —
     ``threading.Thread(target=srv.serve_forever, daemon=True).start()``
@@ -385,6 +392,35 @@ def serve_metrics(
     render_lock = threading.Lock()
 
     class _Handler(http.server.BaseHTTPRequestHandler):
+        def _serve_report_by_key(self, key: str) -> None:
+            """Content-hash → recorded report: peek the verdict cache
+            (read-only — browsing must never reorder the LRU or skew
+            hit rates) and 302 to the entry's ``report_ref`` run under
+            ``/report/``, which containment-checks the target."""
+            vc = cache() if callable(cache) else cache
+            if vc is None:
+                self.send_error(
+                    503, "verdict cache not wired on this sidecar"
+                )
+                return
+            entry = vc.peek(key.strip("/"))
+            if entry is None:
+                self.send_error(404, "no cached verdict under that key")
+                return
+            ref = entry.get("report_ref")
+            if not ref:
+                self.send_error(
+                    404,
+                    "cached verdict has no recorded run to browse "
+                    "(served from the wire, not the store)",
+                )
+                return
+            self.send_response(302)
+            self.send_header(
+                "Location", "/report/" + str(ref).strip("/") + "/"
+            )
+            self.end_headers()
+
         def _serve_report(self, path: str, rel: str) -> None:
             from pathlib import Path
             from urllib.parse import unquote
@@ -475,6 +511,11 @@ def serve_metrics(
 
         def do_GET(self):  # noqa: N802 - stdlib API
             path = self.path.split("?", 1)[0]
+            if cache is not None and path.startswith("/report/by-key/"):
+                self._serve_report_by_key(
+                    path[len("/report/by-key/"):]
+                )
+                return
             if store is not None and path.startswith("/report/"):
                 self._serve_report(path, path[len("/report/"):])
                 return

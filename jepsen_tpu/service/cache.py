@@ -93,6 +93,13 @@ class VerdictCache:
         self._hits.inc()
         return entry
 
+    def peek(self, key: str) -> dict | None:
+        """Read-only lookup: no LRU reorder, no hit/miss accounting.
+        The observability surface (``/report/by-key/<key>``) uses this
+        so browsing NEVER changes cache state or skews the hit rate."""
+        with self._lock:
+            return self._entries.get(key)
+
     def put(
         self,
         key: str,

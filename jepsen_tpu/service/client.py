@@ -94,6 +94,20 @@ class ServiceUnavailable(RuntimeError):
         self.reason = reason
 
 
+class SubscriptionGap(RuntimeError):
+    """A subscription cannot be made whole.  ``gap`` is machine-readable:
+    either the server's retained window log no longer reaches back to
+    the requested window (``{"requested": k, "floor": f,
+    "missed_windows": n}``) or the push sequence itself skipped
+    (``{"expected": k, "got": g}``).  The subscriber KNOWS exactly which
+    windows it can never see — a silent resume would fabricate a
+    contiguous verdict history around a hole."""
+
+    def __init__(self, msg: str, gap: dict[str, Any]):
+        super().__init__(msg)
+        self.gap = gap
+
+
 class CheckerClient:
     """One TCP connection to a checker sidecar; reusable across calls."""
 
@@ -345,6 +359,123 @@ class CheckerClient:
 
     def service_stats(self) -> dict[str, Any]:
         return self._call_robust({"op": "service-stats"})
+
+    def subscribe_windows(
+        self, sid: str, from_window: int = 0,
+        timeout: float | None = None,
+    ):
+        """Generator over a stream's PUSHED verdict windows (the
+        poll-free path): yields contiguous ``verdict-window`` dicts from
+        ``from_window`` until the terminal ``final`` window.
+
+        Runs on a DEDICATED connection (push frames must not interleave
+        with this client's request→reply calls).  A torn push connection
+        reconnects under the retry policy and re-subscribes from the
+        first window not yet yielded — the server replays the missed
+        windows from its retained log, and duplicates below the resume
+        point are dropped here, so the caller sees each window exactly
+        once.  When the story cannot be made whole (the server's
+        retained floor moved past the resume point, or the push sequence
+        itself skipped), raises :class:`SubscriptionGap` with the
+        machine-readable hole; when the budget is spent, raises
+        :class:`ServiceUnavailable`."""
+        next_window = from_window
+        attempts = self.retry.attempts if self.retry else 1
+        failures = 0
+        last: Any = None
+        sock: socket.socket | None = None
+
+        def _drop(s):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+        try:
+            while True:
+                if sock is None:
+                    if failures:
+                        time.sleep(
+                            self.retry.delay_s(failures - 1, self._rng)
+                        )
+                    try:
+                        sock = socket.create_connection(
+                            (self.host, self.port),
+                            timeout=(timeout if timeout is not None
+                                     else self.timeout),
+                        )
+                        send_frame(sock, {
+                            "op": "stream-subscribe", "stream": sid,
+                            "from_window": next_window,
+                        })
+                        ack, _ = recv_frame(sock)
+                    except (ConnectionError, ProtocolError, OSError) as e:
+                        if sock is not None:
+                            _drop(sock)
+                            sock = None
+                        last = repr(e)
+                        failures += 1
+                        if failures >= attempts:
+                            raise ServiceUnavailable(
+                                f"subscription unavailable after "
+                                f"{failures} attempt(s)",
+                                {"reason": "connection",
+                                 "attempts": failures, "last": last},
+                            ) from e
+                        continue
+                    if ack.get("op") == "error":
+                        raise RuntimeError(
+                            f"sidecar error: {ack.get('error')}"
+                        )
+                    if "gap" in ack:
+                        g = ack["gap"]
+                        raise SubscriptionGap(
+                            f"window(s) "
+                            f"[{g['requested']}, {g['floor']}) fell off "
+                            f"the server's retained log",
+                            gap=g,
+                        )
+                try:
+                    frame, _ = recv_frame(sock)
+                except (ConnectionError, ProtocolError, OSError) as e:
+                    _drop(sock)
+                    sock = None
+                    last = repr(e)
+                    failures += 1
+                    if failures >= attempts:
+                        raise ServiceUnavailable(
+                            f"subscription torn and not recoverable "
+                            f"after {failures} attempt(s)",
+                            {"reason": "connection",
+                             "attempts": failures, "last": last},
+                        ) from e
+                    continue
+                failures = 0  # progress renews the budget
+                op = frame.get("op")
+                if op in ("subscribe-done", "subscribe-timeout"):
+                    return
+                if op != "verdict-window":
+                    raise ProtocolError(
+                        f"unexpected push frame {op!r} on subscription"
+                    )
+                w = int(frame.get("window", -1))
+                if w < next_window:
+                    continue  # replayed duplicate: already yielded
+                if w > next_window:
+                    raise SubscriptionGap(
+                        f"push sequence skipped: expected window "
+                        f"{next_window}, got {w}",
+                        gap={"expected": next_window, "got": w},
+                    )
+                next_window = w + 1
+                if isinstance(frame.get("verdict"), dict):
+                    frame["verdict"] = _desetted(frame["verdict"])
+                yield frame
+                if frame.get("final"):
+                    return
+        finally:
+            if sock is not None:
+                _drop(sock)
 
     def check_jtc(
         self,

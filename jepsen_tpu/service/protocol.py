@@ -14,6 +14,14 @@ frame-sync and the server can quarantine exactly the poisoned stream
 while continuing to serve every other one (the PR-13 precedence rule on
 the wire: unknown-with-evidence, never folded into a verdict, never a
 gapped carry).
+
+The framing is symmetric, which is what makes subscription push
+possible without a second wire format: after a ``stream-subscribe``
+request the server INVERTS the rhythm on that connection and sends
+:data:`PUSH_OPS` frames (``verdict-window`` deltas, then terminal
+``subscribe-done`` / ``subscribe-timeout`` markers) until the stream's
+final window — every push frame is an ordinary ``send_frame`` the
+client reads with an ordinary ``recv_frame``.
 """
 
 from __future__ import annotations
@@ -32,6 +40,15 @@ _HDR = struct.Struct(">4sI")  # magic, header-json length
 #: hard cap on a single frame's payload (1 GiB) — a corrupt length prefix
 #: must not make the receiver try to allocate arbitrary memory
 MAX_PAYLOAD = 1 << 30
+
+#: the op that flips a connection into push mode (server → client frames)
+SUBSCRIBE_OP = "stream-subscribe"
+
+#: frames the SERVER originates on a subscribed connection; everything
+#: else on the wire stays strict request → reply
+PUSH_OPS = frozenset({
+    "verdict-window", "subscribe-done", "subscribe-timeout",
+})
 
 
 class ProtocolError(RuntimeError):

@@ -51,6 +51,16 @@ _STREAM_OPS = frozenset({
     "submit-batch", "collect", "cache-get", "service-stats",
 })
 
+#: chaos hook (tools/chaos_check.py vocabulary): ``"<n>"`` — the FIRST
+#: subscription on this server is torn (socket closed abruptly) after
+#: pushing n verdict-window frames; consumed once, so the client's
+#: reconnect-with-replay lands on a healthy push loop
+SUB_DROP_ENV = "JEPSEN_TPU_SERVE_SUB_DROP_AFTER"
+
+#: bound on how long a push loop waits for the NEXT window before
+#: answering with a machine-readable timeout frame (never a silent hang)
+SUBSCRIBE_IDLE_TIMEOUT_S = 120.0
+
 
 def _pad_batch_axis(tree, multiple: int):
     """Zero/False-pad every leaf's axis 0 to a multiple (padded histories
@@ -238,6 +248,16 @@ class _Handler(socketserver.BaseRequestHandler):
                 continue
             except (ProtocolError, ConnectionError, OSError):
                 return
+            if header.get("op") == "stream-subscribe":
+                # push mode: the reply rhythm inverts — the server sends
+                # verdict-window frames as segments close, until the
+                # terminal window (or the chaos tear) ends the loop
+                try:
+                    if not self._handle_subscribe(server, header):
+                        return
+                    continue
+                except (ProtocolError, ConnectionError, OSError):
+                    return
             try:
                 reply = server.dispatch(header, arrays)
                 send_frame(self.request, reply)
@@ -246,6 +266,81 @@ class _Handler(socketserver.BaseRequestHandler):
             except Exception as e:  # noqa: BLE001 — report, keep serving
                 logger.exception("check failed")
                 send_frame(self.request, {"op": "error", "error": repr(e)})
+
+    def _handle_subscribe(self, server: "CheckerServer", header) -> bool:
+        """Run one subscription push loop.  Returns True to keep the
+        connection (back to the request rhythm after the terminal
+        window), False to close it (chaos tear / dead subscriber)."""
+        import queue as queue_mod
+
+        server.metrics.counter(
+            "service.requests", op="stream-subscribe"
+        ).inc()
+        svc = server.ingest_service()
+        sid = str(header.get("stream"))
+        if header.get("stream") is None:
+            raise ProtocolError("stream-subscribe requires stream")
+        from_window = int(header.get("from_window", 0))
+        ack, replay, q = svc.subscribe(sid, from_window)
+        if ack.get("op") != "subscribed":
+            send_frame(self.request, ack)
+            return True
+        drop_after = server.take_sub_drop()
+        pushed = 0
+        final_seen = False
+        try:
+            send_frame(self.request, ack)
+            for w in replay:
+                send_frame(self.request, w)
+                pushed += 1
+                final_seen = final_seen or bool(w.get("final"))
+                if drop_after is not None and pushed >= drop_after:
+                    logger.error(
+                        "%s hook: tearing subscription on %s after %d "
+                        "window(s)", SUB_DROP_ENV, sid, pushed,
+                    )
+                    return False
+            if final_seen or q is None:
+                if not final_seen:
+                    # stream already done but the terminal window fell
+                    # outside the replay range: say so, never hang
+                    send_frame(self.request, {
+                        "op": "subscribe-done", "stream": sid,
+                        "pushed": pushed,
+                    })
+                return True
+            deadline = None
+            while True:
+                try:
+                    w = q.get(timeout=0.5)
+                except queue_mod.Empty:
+                    import time as _time
+
+                    if deadline is None:
+                        deadline = (
+                            _time.monotonic() + SUBSCRIBE_IDLE_TIMEOUT_S
+                        )
+                    elif _time.monotonic() > deadline:
+                        send_frame(self.request, {
+                            "op": "subscribe-timeout", "stream": sid,
+                            "idle_s": SUBSCRIBE_IDLE_TIMEOUT_S,
+                            "pushed": pushed,
+                        })
+                        return True
+                    continue
+                deadline = None
+                send_frame(self.request, w)
+                pushed += 1
+                if drop_after is not None and pushed >= drop_after:
+                    logger.error(
+                        "%s hook: tearing subscription on %s after %d "
+                        "window(s)", SUB_DROP_ENV, sid, pushed,
+                    )
+                    return False
+                if w.get("final"):
+                    return True
+        finally:
+            svc.unsubscribe(sid, q)
 
 
 class CheckerServer(socketserver.ThreadingTCPServer):
@@ -289,6 +384,22 @@ class CheckerServer(socketserver.ThreadingTCPServer):
             else metrics_registry
         )
         self._metrics_srv = None
+        # chaos: arm the one-shot subscription tear from the env
+        self._sub_drop: int | None = None
+        spec = os.environ.get(SUB_DROP_ENV)
+        if spec:
+            try:
+                self._sub_drop = int(spec)
+            except ValueError:
+                logger.error("%s=%r malformed (want int); ignoring",
+                             SUB_DROP_ENV, spec)
+
+    def take_sub_drop(self) -> int | None:
+        """Consume the one-shot torn-subscription chaos hook (the first
+        subscriber gets torn; its reconnect must find a healthy loop)."""
+        with self._ingest_lock:
+            n, self._sub_drop = self._sub_drop, None
+            return n
 
     @property
     def port(self) -> int:
@@ -303,12 +414,19 @@ class CheckerServer(socketserver.ThreadingTCPServer):
         """Serve the shared registry as Prometheus text on
         ``GET http://host:port/metrics`` — and, when ``store`` is
         given, per-run reports on ``GET /report/<run>`` (rendered on
-        demand from the store tree); returns the HTTP server
-        (``.server_address[1]`` carries the bound port)."""
+        demand from the store tree) plus ``GET /report/by-key/<key>``
+        (content-addressed verdict-cache lookup, 302 to the recorded
+        run); returns the HTTP server (``.server_address[1]`` carries
+        the bound port)."""
         from jepsen_tpu.obs import metrics as obs_metrics
 
         self._metrics_srv = obs_metrics.serve_metrics(
-            host, port, self.metrics, store=store
+            host, port, self.metrics, store=store,
+            # lazy: the ingest core (and with it the cache) may not be
+            # built yet when the metrics endpoint comes up
+            cache=lambda: (
+                self._ingest.cache if self._ingest is not None else None
+            ),
         )
         self._metrics_srv.start_background()
         return self._metrics_srv

@@ -53,6 +53,7 @@ import hashlib
 import json
 import logging
 import os
+import queue as queue_mod
 import threading
 import time
 from collections import deque
@@ -73,6 +74,12 @@ DIE_AFTER_ENV = "JEPSEN_TPU_SERVE_DIE_AFTER"
 #: a block that sees this many worker deaths is poison: quarantine the
 #: stream (PR-13 precedence — never foldable into valid), stop killing
 MAX_BLOCK_RETRIES = 2
+
+#: per-stream window-log retention for subscribers (ISSUE 17): a push
+#: connection that drops and reconnects with ``from_window`` gets the
+#: missed windows REPLAYED from this log; asking below the retained
+#: floor is answered with a machine-readable gap, never a silent skip
+WINDOW_LOG_CAP = 64
 
 SATURATED = "SATURATED"
 
@@ -104,6 +111,7 @@ class _Stream:
         "busy", "scheduled", "verdict", "done", "done_at",
         "created", "t0", "deadline", "digest", "content_key",
         "dead_workers", "carry_nbytes",
+        "windows", "window_base", "subscribers",
     )
 
     def __init__(self, sid, workload, opts, engine, kind, deadline_s):
@@ -134,6 +142,10 @@ class _Stream:
         self.digest = hashlib.sha256()
         self.content_key: str | None = None
         self.carry_nbytes = 0  # last snapshot's footprint (gauge share)
+        # -- subscription push (ISSUE 17) --
+        self.windows: deque = deque()  # retained verdict windows (replay)
+        self.window_base = 0  # window index of windows[0] (the floor)
+        self.subscribers: list = []  # live SimpleQueue sinks
 
 
 def _wire_safe(v):
@@ -221,6 +233,9 @@ class IngestService:
         self._c_blocks = registry.counter("service.blocks")
         self._c_deaths = registry.counter("service.worker_deaths")
         self._c_requeues = registry.counter("service.block_requeues")
+        self._c_windows = registry.counter("service.verdict_windows")
+        self._g_subs = registry.gauge("service.subscribers")
+        self._subs_total = 0
         self._s_verdict = registry.sketch("service.submit_to_verdict_s")
         self._s_block = registry.sketch("service.block_check_s")
 
@@ -410,6 +425,8 @@ class IngestService:
                 self._g_carry.set(self._carry_total)
                 st.quarantined = True  # a racing worker drops the claim
                 st.done.set()
+                # subscribers must see a terminal window, never hang
+                self._emit_window_locked(st, "aborted", final=True)
         return {"op": "aborted", "stream": sid}
 
     def finish(self, sid: str, timeout: float | None = None) -> dict:
@@ -504,6 +521,103 @@ class IngestService:
             time.sleep(0.002)
         return {"op": "collected", "done": done, "pending": pending}
 
+    # -- subscription push (ISSUE 17) --------------------------------------
+
+    def subscribe(self, sid: str, from_window: int = 0):
+        """Register a push subscriber on a stream's verdict windows.
+
+        Returns ``(ack, replay, queue)``: the machine-readable ack (with
+        a ``gap`` entry when the retained window floor has moved past
+        ``from_window`` — the subscriber KNOWS which windows it can never
+        see, instead of silently resuming), the retained windows at or
+        above ``from_window`` to replay, and a live queue for windows
+        emitted after this call (``None`` when the stream is already
+        done — the replay then already ends in the terminal window)."""
+        with self._lock:
+            st = self._streams.get(sid)
+            if st is None:
+                return (
+                    {"op": "error", "error": f"unknown stream {sid!r}",
+                     "reason": "unknown-stream"},
+                    [], None,
+                )
+            floor = st.window_base
+            replay = [w for w in st.windows if w["window"] >= from_window]
+            ack = {
+                "op": "subscribed",
+                "stream": sid,
+                "from_window": from_window,
+                "window_floor": floor,
+                "next_window": st.window_base + len(st.windows),
+                "replay": len(replay),
+                "done": st.done.is_set(),
+            }
+            if from_window < floor:
+                ack["gap"] = {
+                    "requested": from_window,
+                    "floor": floor,
+                    "missed_windows": floor - from_window,
+                }
+            q = None
+            if not st.done.is_set():
+                q = queue_mod.SimpleQueue()
+                st.subscribers.append(q)
+                self._subs_total += 1
+                self._g_subs.set(self._subs_total)
+        return ack, replay, q
+
+    def unsubscribe(self, sid: str, q) -> None:
+        if q is None:
+            return
+        with self._lock:
+            st = self._streams.get(sid)
+            if st is not None and q in st.subscribers:
+                st.subscribers.remove(q)
+            self._subs_total = max(0, self._subs_total - 1)
+            self._g_subs.set(self._subs_total)
+
+    def _valid_so_far(self, st: _Stream):
+        """Per-window partial verdict, O(carry) only (the PR-15
+        ``_partial_summary`` rule): queue and mutex carries answer per
+        window; elle/stream would re-run their finish-time graph
+        analysis per WINDOW, so they say ``"deferred"`` and deliver
+        their one real verdict in the final window."""
+        if st.workload not in ("queue", "mutex"):
+            return "deferred"
+        try:
+            return st.engine.verdict_so_far().get(VALID)
+        except Exception as e:  # noqa: BLE001 — must not sink the drain
+            return f"error: {type(e).__name__}: {e}"
+
+    def _emit_window_locked(
+        self, st: _Stream, valid_so_far, final: bool = False,
+        verdict: dict | None = None,
+    ) -> None:
+        """Append one verdict window to the stream's bounded retained
+        log and push it to live subscribers (caller holds the lock).
+        The newest ``WINDOW_LOG_CAP`` windows are replayable; trimming
+        advances ``window_base`` so a reconnect below the floor gets a
+        machine-readable gap, never a silent skip."""
+        w = {
+            "op": "verdict-window",
+            "stream": st.sid,
+            "window": st.window_base + len(st.windows),
+            "blocks": st.blocks_fed,
+            "ops": st.ops_fed,
+            "quarantined": st.quarantined,
+            "final": final,
+            "valid_so_far": _wire_safe(valid_so_far),
+        }
+        if verdict is not None:
+            w["verdict"] = verdict  # already wire-safe on this path
+        st.windows.append(w)
+        while len(st.windows) > WINDOW_LOG_CAP:
+            st.windows.popleft()
+            st.window_base += 1
+        self._c_windows.inc()
+        for q in st.subscribers:
+            q.put(w)
+
     def stats(self) -> dict:
         with self._lock:
             out = {
@@ -520,6 +634,8 @@ class IngestService:
         out["blocks"] = int(self._c_blocks.value)
         out["worker_deaths"] = int(self._c_deaths.value)
         out["block_requeues"] = int(self._c_requeues.value)
+        out["verdict_windows"] = int(self._c_windows.value)
+        out["subscribers"] = self._subs_total
         rejects = {}
         for name, labels, metric in self.metrics.items():
             if name == "service.admission_rejects":
@@ -654,6 +770,7 @@ class IngestService:
             dt = time.perf_counter() - t0
             self._s_block.add(dt)
             self._c_blocks.inc()
+            vsf = self._valid_so_far(st)
             with self._lock:
                 if st.pending:  # a racing abort() may have cleared it
                     st.pending.popleft()
@@ -663,6 +780,9 @@ class IngestService:
                     self._carry_total += nb - st.carry_nbytes
                     st.carry_nbytes = nb
                     self._g_carry.set(self._carry_total)
+                    # one verdict window per closed segment, pushed to
+                    # subscribers the moment the block lands (ISSUE 17)
+                    self._emit_window_locked(st, vsf)
         if st.finish_requested and not st.done.is_set():
             # the engine belongs to this worker (single-claimer): run
             # the heavy finish outside the service lock
@@ -763,6 +883,11 @@ class IngestService:
         self._g_carry.set(self._carry_total)
         if st.quarantined:
             self._g_quar.inc()
+        # terminal window: carries the FULL verdict so a subscriber
+        # needs no follow-up poll to learn how the stream ended
+        self._emit_window_locked(
+            st, verdict.get(VALID), final=True, verdict=verdict
+        )
         now = time.perf_counter()
         self._s_verdict.add(now - st.t0)
         obs_trace.complete(

@@ -1099,6 +1099,54 @@ class TestSegmentedChaosSmoke:
         assert not doc["failures"]
 
 
+class TestCampaignChaosSmoke:
+    """The campaign-supervisor proof harness (``tools/chaos_check.py
+    --campaign``, ISSUE 17) must stay runnable offline: the
+    DETERMINISTIC die-after-trial hook (no wall-clock kill races in
+    CI), in-process faults only (no serve-checker subprocess spawns —
+    the service-restart arm belongs to the committed capture,
+    ``store/campaign_r17``), every built-in assertion green —
+    uninterrupted oracle campaign, mid-campaign death leaves a durable
+    ledger, resume lands on the identical fingerprint set, verdict
+    windows PUSHED, record→verdict p50/p99 measured."""
+
+    def test_die_env_resume_green(self, tmp_path):
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "chaos_check_campaign_under_test",
+            str(REPO / "tools" / "chaos_check.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        rc = mod.main(
+            [
+                "--campaign",
+                "--mode", "die-env",
+                "--seed", "17",
+                "--campaign-trials", "3",
+                "--campaign-ops", "120",
+                "--campaign-faults",
+                "none,kill-worker,torn-subscription",
+                "--timeout", "300",
+                "--out", str(tmp_path / "camp_chaos"),
+            ]
+        )
+        assert rc == 0
+        doc = json.loads(
+            (tmp_path / "camp_chaos" / "results.json").read_text()
+        )
+        assert doc["pass"] is True
+        assert doc["tool"] == "chaos_check --campaign"
+        assert not doc["failures"]
+        camp = doc["campaign"]
+        assert camp["oracle"]["windows_pushed"] >= 3
+        assert camp["oracle"]["record_to_verdict_ms"]["p50"] is not None
+        assert 0 < camp["journaled_at_kill"] < 3
+        assert camp["resumed"]["resumed_from"] == camp["journaled_at_kill"]
+        assert len(camp["fingerprints"]) == 3
+
+
 class TestServeSectionSchema:
     """Offline gate for the ISSUE-16 ``serve`` bench schema: a tiny
     REAL in-process run of the streaming-service arms must carry the

@@ -352,6 +352,247 @@ def run_serve_chaos(args, log, check) -> dict:
     }
 
 
+def _run_campaign_child(args, out_dir, log, extra=(), env_extra=None,
+                        kill_after=None, timeout=1800.0):
+    """One ``python -m jepsen_tpu campaign`` subprocess (the crashable
+    unit of the ISSUE-17 mode).  Returns (rc, summary|None, stderr) —
+    the campaign CLI prints its summary JSON alone on stdout."""
+    import subprocess
+
+    argv = [
+        sys.executable, "-m", "jepsen_tpu", "campaign",
+        "--out", str(out_dir), "--seed", str(args.seed),
+        "--trials", str(args.campaign_trials),
+        "--ops", str(args.campaign_ops),
+        "--faults", args.campaign_faults,
+    ] + list(extra)
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("JEPSEN_TPU_CAMPAIGN_DIE_AFTER", None)
+    env.pop("JEPSEN_TPU_CAMPAIGN_FORCE_RED", None)
+    env.update(env_extra or {})
+    p = subprocess.Popen(
+        argv, cwd=str(REPO), env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    if kill_after is not None:
+
+        def _killer():
+            time.sleep(kill_after)
+            if p.poll() is None:
+                log(f"nemesis: SIGKILL campaign supervisor "
+                    f"(pid {p.pid}) at t+{kill_after:.2f}s")
+                p.kill()
+
+        threading.Thread(target=_killer, daemon=True).start()
+    out, err = p.communicate(timeout=timeout)
+    summary = None
+    try:
+        summary = json.loads(out)
+    except ValueError:
+        pass
+    return p.returncode, summary, err
+
+
+def run_campaign_chaos(args, log, check) -> dict:
+    """ISSUE-17 mode: the nemesis pointed at the CAMPAIGN SUPERVISOR —
+    an uninterrupted oracle campaign (every served verdict ≡ the serial
+    oracle, books balanced, verdict windows PUSHED, record→verdict
+    p50/p99 measured), then a real supervisor SIGKILL mid-campaign (or
+    the deterministic die-after-trial env hook) whose ``--resume`` must
+    land on the IDENTICAL fingerprint set, and optionally a live-tailed
+    soak (``--campaign-live``) closing record→stream→verdict with no
+    recorded file in between.  The campaign itself already contains the
+    service-SIGKILL+restart and torn-subscription arms."""
+    from jepsen_tpu.campaign.ledger import read_ledger
+
+    out_root = Path(args.corpus_dir)
+    faults = [f for f in args.campaign_faults.split(",") if f.strip()]
+
+    # 1. the uninterrupted oracle campaign
+    t0 = time.perf_counter()
+    rc, oracle, err = _run_campaign_child(
+        args, out_root / "oracle", log, timeout=args.timeout
+    )
+    oracle_wall = time.perf_counter() - t0
+    check(rc == 0 and oracle is not None,
+          f"uninterrupted campaign completed green (rc={rc})")
+    if oracle is None:
+        log(f"campaign stderr tail:\n{err[-2000:]}")
+        return {}
+    check(oracle["completed"] == oracle["planned"],
+          f"all {oracle['planned']} planned trials completed")
+    check(oracle["reds"] == 0, "zero unexpected reds")
+    check(
+        oracle["oracle_matches"] == oracle["completed"],
+        f"every served verdict ≡ post-hoc serial oracle "
+        f"({oracle['oracle_matches']}/{oracle['completed']})",
+    )
+    check(bool(oracle["books_balanced"]),
+          "books balance exactly on every trial "
+          "(submitted == verdicts + rejects + interrupted)")
+    check(
+        oracle["windows_pushed"] >= oracle["completed"],
+        f"verdict windows PUSHED before stream finish "
+        f"({oracle['windows_pushed']} across "
+        f"{oracle['completed']} trials)",
+    )
+    check(
+        set(faults) <= set(oracle["faults_fired"]),
+        f"every enabled fault fired: {oracle['faults_fired']}",
+    )
+    p50 = oracle["record_to_verdict_ms"]["p50"]
+    p99 = oracle["record_to_verdict_ms"]["p99"]
+    check(p50 is not None and p99 is not None,
+          f"record-to-verdict latency measured: "
+          f"p50={p50}ms p99={p99}ms")
+    odoc = read_ledger(out_root / "oracle" / "campaign_ledger.json")
+    ofps = [t["fingerprint"] for t in odoc["trials"]]
+    if "service-restart" in faults:
+        restarted = [t for t in odoc["trials"]
+                     if t["spec"]["fault"] == "service-restart"]
+        check(
+            bool(restarted) and all(
+                t.get("restarted") and t["books"]["interrupted"] >= 1
+                for t in restarted
+            ),
+            f"service-restart arm: {len(restarted)} real service "
+            f"SIGKILL+restart(s), interrupted stream accounted in "
+            f"books",
+        )
+    if "torn-subscription" in faults:
+        torn = [t for t in odoc["trials"]
+                if t["spec"]["fault"] == "torn-subscription"]
+        check(
+            bool(torn) and all(
+                t["subscriber_error"] is None
+                and t["windows_pushed"] > 0
+                for t in torn
+            ),
+            "torn-subscription arm: subscriber reconnected and "
+            "replayed the missed windows (no residual error, windows "
+            "complete)",
+        )
+
+    # 2. kill the supervisor MID-campaign
+    chaos_out = out_root / "chaos"
+    if args.mode == "die-env":
+        die_n = max(0, args.campaign_trials // 2 - 1)
+        log(f"nemesis: die-after-trial hook armed at trial {die_n}")
+        rc, _s, err = _run_campaign_child(
+            args, chaos_out, log,
+            env_extra={"JEPSEN_TPU_CAMPAIGN_DIE_AFTER": str(die_n)},
+            timeout=args.timeout,
+        )
+        check(rc == 137,
+              f"die-hook supervisor exited 137 mid-campaign (rc={rc})")
+    else:
+        kill_after = max(args.kill_after, 0.45 * oracle_wall)
+        if kill_after > args.kill_after:
+            log(f"nemesis: --kill-after {args.kill_after:.1f}s would "
+                f"land before the first journaled trial — scaled to "
+                f"{kill_after:.1f}s (45% of the {oracle_wall:.1f}s "
+                f"oracle wall)")
+        rc, _s, err = _run_campaign_child(
+            args, chaos_out, log, kill_after=kill_after,
+            timeout=args.timeout,
+        )
+        check(rc != 0, f"SIGKILLed supervisor died loudly (rc={rc})")
+    ledger_path = chaos_out / "campaign_ledger.json"
+    check(ledger_path.exists(),
+          "the killed supervisor left a durable ledger behind")
+    journaled = (
+        len(read_ledger(ledger_path)["trials"])
+        if ledger_path.exists() else 0
+    )
+    check(
+        0 < journaled < args.campaign_trials,
+        f"the kill landed MID-campaign "
+        f"({journaled}/{args.campaign_trials} trials journaled)",
+    )
+
+    # 3. resume: the journaled prefix is skipped, the verdict set is
+    # IDENTICAL to the uninterrupted run's
+    rc, resumed, err = _run_campaign_child(
+        args, chaos_out, log, extra=["--resume"], timeout=args.timeout
+    )
+    check(rc == 0 and resumed is not None,
+          f"resumed campaign completed green (rc={rc})")
+    if resumed is not None:
+        check(
+            resumed["resumed_from"] == journaled,
+            f"resume skipped exactly the journaled prefix "
+            f"({resumed['resumed_from']} == {journaled})",
+        )
+        check(resumed["completed"] == resumed["planned"]
+              and resumed["reds"] == 0
+              and bool(resumed["books_balanced"]),
+              "resumed campaign: all trials green, books balanced")
+    rfps = [t["fingerprint"]
+            for t in read_ledger(ledger_path)["trials"]]
+    check(
+        rfps == ofps,
+        f"kill→resume verdict fingerprints IDENTICAL to the "
+        f"uninterrupted campaign ({len(rfps)} trials)",
+    )
+
+    result = {
+        "oracle": oracle,
+        "oracle_wall_s": round(oracle_wall, 2),
+        "journaled_at_kill": journaled,
+        "resumed": resumed,
+        "fingerprints": rfps,
+    }
+
+    # 4. optional: the live-tailing leg — a soak whose op blocks go
+    # STRAIGHT into a real service subprocess, verdict formed on the
+    # live stream (tools/soak.py --live-stream, campaign tentpole (a))
+    if args.campaign_live:
+        import subprocess
+
+        from jepsen_tpu.campaign.supervisor import (
+            _free_port, _spawn_service,
+        )
+
+        port = _free_port()
+        svc = _spawn_service(port, str(out_root / "live_store"))
+        try:
+            log(f"live-tail: soak --live-stream 127.0.0.1:{port} "
+                f"({args.campaign_live_minutes} min)")
+            p = subprocess.run(
+                [sys.executable, "tools/soak.py", "--workload",
+                 "queue", "--minutes",
+                 str(args.campaign_live_minutes),
+                 "--live-stream", f"127.0.0.1:{port}"],
+                cwd=str(REPO),
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                capture_output=True, text=True,
+                timeout=args.timeout,
+            )
+            tail_lines = [
+                ln for ln in p.stdout.splitlines()
+                if "PUSHED" in ln or "record-to-verdict" in ln
+            ]
+            for ln in tail_lines:
+                log(f"live-tail: {ln.strip()}")
+            check(
+                p.returncode == 0 and any(
+                    "PUSHED" in ln for ln in tail_lines
+                ),
+                f"live-tailed soak green with pushed verdict windows "
+                f"(rc={p.returncode})",
+            )
+            result["live_tail"] = {
+                "rc": p.returncode,
+                "summary_lines": [ln.strip() for ln in tail_lines],
+            }
+        finally:
+            svc.kill()
+            svc.wait(timeout=30)
+
+    return {"campaign": result}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -413,12 +654,40 @@ def main(argv=None) -> int:
     p.add_argument("--serve-kill-block", type=int, default=3,
                    help="--serve: worker 0 dies mid-feed of its Nth "
                    "block")
+    p.add_argument("--campaign", action="store_true",
+                   help="ISSUE-17 mode: chaos against the CAMPAIGN "
+                   "SUPERVISOR (campaign/supervisor.py) — an "
+                   "uninterrupted oracle campaign (which itself "
+                   "contains the service-SIGKILL+restart and "
+                   "torn-subscription arms), a real supervisor "
+                   "SIGKILL mid-campaign (or the die-after-trial "
+                   "hook under --mode die-env), and a --resume that "
+                   "must land on the identical fingerprint set")
+    p.add_argument("--campaign-trials", type=int, default=6,
+                   help="--campaign: trials per campaign run")
+    p.add_argument("--campaign-ops", type=int, default=160,
+                   help="--campaign: ops per corpus history")
+    p.add_argument("--campaign-faults",
+                   default="none,kill-worker,service-restart,"
+                   "torn-subscription",
+                   help="--campaign: fault vocabulary (comma list)")
+    p.add_argument("--campaign-live", action="store_true",
+                   help="--campaign: add the live-tailing leg — a "
+                   "soak whose op blocks stream STRAIGHT into a real "
+                   "service subprocess (tools/soak.py --live-stream)")
+    p.add_argument("--campaign-live-minutes", type=float, default=0.2,
+                   help="--campaign-live: soak duration in minutes")
     args = p.parse_args(argv)
-    if not (args.segmented or args.serve) and args.kill >= args.procs:
+    if (not (args.segmented or args.serve or args.campaign)
+            and args.kill >= args.procs):
         p.error("--kill must leave at least one survivor (< --procs)")
     if args.segmented and args.mode == "sigstop":
         p.error("--segmented supports sigkill / die-env (a SIGSTOPped "
                 "single-process check has no peer to detect the wedge)")
+    if args.campaign and args.mode == "sigstop":
+        p.error("--campaign supports sigkill / die-env (a SIGSTOPped "
+                "supervisor is a hung client, not a crash — the "
+                "resume story needs a corpse)")
 
     out_dir = Path(args.out) if args.out else None
     log = _Log(out_dir / "chaos_check.log" if out_dir else None)
@@ -430,6 +699,51 @@ def main(argv=None) -> int:
     )
 
     from jepsen_tpu.history.store import _json_default
+
+    if args.campaign:
+        failures: list[str] = []
+
+        def ccheck(cond: bool, msg: str) -> None:
+            if cond:
+                log(f"PASS  {msg}")
+            else:
+                failures.append(msg)
+                log(f"FAIL  {msg}")
+
+        t0 = time.perf_counter()
+        tmp_ctx = (
+            tempfile.TemporaryDirectory(prefix="jt_campchaos_")
+            if args.corpus_dir is None
+            else None
+        )
+        if tmp_ctx is not None:
+            args.corpus_dir = tmp_ctx.name
+        try:
+            arms = run_campaign_chaos(args, log, ccheck)
+        finally:
+            if tmp_ctx is not None:
+                tmp_ctx.cleanup()
+        if out_dir is not None:
+            doc = {
+                "tool": "chaos_check --campaign",
+                "pass": not failures,
+                "config": {
+                    k: v for k, v in vars(args).items() if k != "out"
+                },
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "failures": failures,
+                **arms,
+            }
+            out_dir.mkdir(parents=True, exist_ok=True)
+            (out_dir / "results.json").write_text(
+                json.dumps(doc, indent=1, default=_json_default) + "\n"
+            )
+            log(f"artifacts: {out_dir}/results.json + chaos_check.log")
+        if failures:
+            log(f"CHAOS FAIL ({len(failures)} failed assertions)")
+            return 1
+        log("CHAOS PASS")
+        return 0
 
     if args.serve:
         failures: list[str] = []

@@ -157,6 +157,19 @@ def run_fuzz(args) -> int:
         if forensics:
             print(f"# config {i + 1}: forensics page: {forensics}",
                   flush=True)
+        # matrix auto-grow: the minimized red becomes a pinned row the
+        # static matrix replays (deduped by finding identity, so a
+        # re-found red bumps the existing row instead of multiplying)
+        if args.pins_dir:
+            from jepsen_tpu.fuzz.pins import append_pin
+
+            ppath, added = append_pin(
+                args.pins_dir, mincfg.to_spec(), final.invalidating,
+                source=f"fuzz_matrix --seed {args.seed} c{cfg.seed}",
+            )
+            print(f"# config {i + 1}: "
+                  f"{'pinned' if added else 're-found pin bumped'} in "
+                  f"{ppath}", flush=True)
         found.append({
             "forensics": forensics,
             "config_seed": cfg.seed,
@@ -233,6 +246,12 @@ def main(argv=None) -> int:
                    help="stop the budget after the first confirmed red")
     p.add_argument("--emit-dir", default="store",
                    help="where minimized repro drivers land")
+    p.add_argument("--pins-dir", default="store",
+                   help="where the auto-grown regression corpus "
+                        "(fuzz_pins.json) lives; every confirmed-"
+                        "minimized red is appended as a pinned row "
+                        "the static matrix replays (empty string "
+                        "disables pinning)")
     p.add_argument("--store", default=None,
                    help="run-store root (default: a temp dir)")
     p.add_argument("--quiet-cluster", action="store_true",

@@ -206,6 +206,7 @@ def run_soak(args) -> int:
 
     monitors = []
     live_checkers = []
+    live_tailers = []
 
     def build():
         native_mod.reset()
@@ -271,6 +272,35 @@ def run_soak(args) -> int:
             )
             test.observers.append(lc)
             live_checkers.append(lc)
+        if args.live_stream:
+            # live tailing (ISSUE 17): the run's op blocks go straight
+            # into the checker SERVICE as they are recorded (no
+            # recorded-file intermediary) and verdict windows come BACK
+            # pushed over the subscription surface — the full
+            # record -> stream -> verdict loop closed on a live run
+            from jepsen_tpu.campaign.tail import LiveStreamTailer
+
+            host, _, port = args.live_stream.rpartition(":")
+            tailer = LiveStreamTailer(
+                host or "127.0.0.1",
+                int(port),
+                args.workload,
+                opts=(
+                    {"delivery": "at-least-once"}
+                    if args.workload == "queue"
+                    else {"append_fail": "indeterminate"}
+                    if args.workload == "stream"
+                    else {"model": "read-committed"}
+                    if args.workload == "elle"
+                    else {}
+                ),
+                block_ops=args.live_stream_block,
+            )
+            print(f"# soak: live-tailing into {args.live_stream} "
+                  f"(stream {tailer.sid}, {args.live_stream_block} "
+                  f"ops/block)", flush=True)
+            test.observers.append(tailer)
+            live_tailers.append(tailer)
         return test, transport
 
     t0 = time.monotonic()
@@ -359,6 +389,40 @@ def run_soak(args) -> int:
                 f"# soak live-check ERRORS: {live_summary['errors']}",
                 flush=True,
             )
+    # live-stream summary (ISSUE 17): the service's pushed verdict
+    # windows beside the in-process live-check line — fail-loud below
+    # if the loop never closed (zero pushed windows, or tail errors)
+    tail_summary = None
+    if args.live_stream and live_tailers:
+        tail_summary = live_tailers[-1].close()
+        p50 = tail_summary["record_to_verdict_p50_ms"]
+        p99 = tail_summary["record_to_verdict_p99_ms"]
+        print(
+            f"# soak live-stream: {tail_summary['windows_pushed']} "
+            f"verdict windows PUSHED over "
+            f"{tail_summary['blocks_fed']} fed blocks "
+            f"({tail_summary['ops_fed']}/{tail_summary['ops']} ops); "
+            f"record-to-verdict "
+            f"p50 {p50 if p50 is not None else '-'}ms / "
+            f"p99 {p99 if p99 is not None else '-'}ms "
+            f"({tail_summary['latency_samples']} block samples); "
+            f"service verdict={tail_summary['verdict']}",
+            flush=True,
+        )
+        if tail_summary.get("saturated_at_op") is not None:
+            print(
+                f"# soak live-stream SATURATED at op "
+                f"{tail_summary['saturated_at_op']}: the service could "
+                f"not keep up — {tail_summary['ops_unverified']} ops "
+                f"went unverified live (post-run analysis still covers "
+                f"them)",
+                flush=True,
+            )
+        if tail_summary["errors"]:
+            print(
+                f"# soak live-stream ERRORS: {tail_summary['errors']}",
+                flush=True,
+            )
     # elastic-analysis honesty line (ISSUE 13): a quarantined chunk in
     # the analysis phase means part of THIS soak's history went
     # unjudged — that must never hide inside a wall-clock summary
@@ -402,6 +466,19 @@ def run_soak(args) -> int:
         print(
             "# soak live-check FAILED: no verdict windows "
             f"(summary={live_summary})",
+            flush=True,
+        )
+        return 1
+    if args.live_stream and (
+        tail_summary is None
+        or tail_summary["windows_pushed"] == 0
+        or tail_summary["errors"]
+    ):
+        # fail-loud: a live-stream soak that never saw a PUSHED window
+        # (or whose tail errored) must not mint a green artifact
+        print(
+            "# soak live-stream FAILED: loop never closed "
+            f"(summary={tail_summary})",
             flush=True,
         )
         return 1
@@ -482,6 +559,20 @@ def main(argv=None) -> int:
                         "delivery / indeterminate appends / "
                         "read-committed — the levels live SUT runs "
                         "are judged at")
+    p.add_argument("--live-stream", dest="live_stream", default=None,
+                   metavar="HOST:PORT",
+                   help="tail the run's op blocks STRAIGHT into a "
+                        "running checker service (jepsen-tpu "
+                        "serve-checker) as they are recorded — no "
+                        "recorded-file intermediary — and subscribe to "
+                        "its pushed verdict windows; prints "
+                        "record-to-verdict p50/p99 and fails loud if "
+                        "zero windows were ever pushed.  Same live "
+                        "contracts as --live-check")
+    p.add_argument("--live-stream-block", dest="live_stream_block",
+                   type=int, default=32, metavar="N",
+                   help="ops per tailed block on the wire "
+                        "(--live-stream)")
     p.add_argument("--lanes", type=int, default=None,
                    help="scale the post-run analysis out across local "
                         "devices: the soak's single long history checks "

@@ -163,36 +163,43 @@ def _combo_mask() -> np.ndarray:
 
 def bitmat_mul_packed(a: jax.Array, b: jax.Array) -> jax.Array:
     """Boolean-semiring matmul on bitplanes: ``c[i,j] = OR_k a[i,k] ∧
-    b[k,j]`` with every matrix packed ``[T, ceil(T/32)] uint32`` along
-    its column axis.  ``T`` must be a multiple of 8.
+    b[k,j]`` with both matrices packed along their column axis —
+    ``a: [T, ceil(K/32)]``, ``b: [K, Wb]`` → ``c: [T, Wb]``.  ``K``
+    (the contraction extent, ``b``'s row count) must be a multiple
+    of 8.  The square case ``K = T, Wb = ceil(T/32)`` is the single-
+    chip closure kernel; a COLUMN SHARD of ``b`` (``Wb < ceil(K/32)``,
+    the multi-chip closure's per-device plane block) produces the
+    matching column shard of ``c`` with no change to the contraction —
+    exactly the Megatron column-parallel decomposition, on bitplanes.
 
     Blocked Four-Russians: for each 8-row group of ``b``, the 256
-    subset-ORs are materialized once (``[256, W, 8]`` select + an
+    subset-ORs are materialized once (``[256, Wb, 8]`` select + an
     OR-reduce over the minor axis — one fused vectorized loop under
     XLA) and every output row gathers its byte-indexed entry; the
-    accumulator lives word-major ``[W, T]`` so the OR runs over the
+    accumulator lives word-major ``[Wb, T]`` so the OR runs over the
     full row axis.  ``T³`` MACs become ``T³/32`` word-ops amortized
     8-fold by table reuse — measured 3.5× the bf16 MXU-shaped dot on
     the CPU backend per multiply (BITPACK.md)."""
-    T, W = a.shape
-    assert T % 8 == 0, f"bitmat T={T} must be a multiple of 8"
+    T, _ = a.shape
+    K, Wb = b.shape
+    assert K % 8 == 0, f"bitmat contraction extent K={K} must be a multiple of 8"
     a_bytes = _byte_columns(a, T)
-    b_wm = b.T  # [W, T] word-major
+    b_wm = b.T  # [Wb, K] word-major
     combos = jnp.asarray(_combo_mask())
 
     def per_group(g, acc):
-        rows = jax.lax.dynamic_slice(b_wm, (0, g * 8), (W, 8))  # [W, 8]
+        rows = jax.lax.dynamic_slice(b_wm, (0, g * 8), (Wb, 8))  # [Wb, 8]
         sel = jnp.where(
             combos[:, None, :], rows[None, :, :], jnp.uint32(0)
-        )  # [256, W, 8]
+        )  # [256, Wb, 8]
         tbl = jax.lax.reduce(
             sel, jnp.uint32(0), jax.lax.bitwise_or, (2,)
-        )  # [256, W]
+        )  # [256, Wb]
         idx = jax.lax.dynamic_slice(a_bytes, (0, g), (T, 1))[:, 0]
         return acc | tbl[idx].T
 
     acc = jax.lax.fori_loop(
-        0, T // 8, per_group, jnp.zeros((W, T), jnp.uint32)
+        0, K // 8, per_group, jnp.zeros((Wb, T), jnp.uint32)
     )
     return acc.T
 
@@ -265,6 +272,93 @@ def closure_on_cycle_packed(
         on_cycle_packed(ww, r_ww, T),
         on_cycle_packed(wwr, r_wwr, T),
         on_cycle_packed(alle, r_all, T),
+    )
+
+
+# ---------------------------------------------------------------------------
+# multi-chip closure: column-sharded packed kernels (arXiv 2112.09017)
+# ---------------------------------------------------------------------------
+
+
+def identity_bits_shard(T: int, W_loc: int, axis_name: str) -> jax.Array:
+    """This device's ``[T, W_loc]`` column block of the packed identity,
+    selected by ``axis_index(axis_name)`` — the reflexive seed for a
+    sharded closure.  Requires the full plane axis to divide evenly
+    (``W_loc * axis_size == n_words(T)``), which the mesh layer checks
+    before lowering."""
+    ident = jnp.asarray(identity_bits(T))
+    k = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice(ident, (0, k * W_loc), (T, W_loc))
+
+
+def closure_packed_sharded(
+    r0_shard: jax.Array, max_squarings: int, axis_name: str
+) -> jax.Array:
+    """Transitive closure by repeated squaring with the ``ceil(T/32)``
+    plane axis COLUMN-SHARDED over mesh axis ``axis_name`` — the packed
+    multi-chip closure.  ``r0_shard: [T, W_loc]`` is this device's
+    plane block (reflexive bits already in).
+
+    Per squaring, each device ``all_gather``s the full left operand
+    (``[T, W]`` — byte indices over the contraction axis) and multiplies
+    it into its LOCAL column block via the rectangular
+    :func:`bitmat_mul_packed` — the Megatron column-parallel split,
+    exact on the boolean semiring because each output column depends on
+    all of ``a`` but only its own columns of ``b``.  Fixpoint detection
+    is a ``psum`` of per-shard change flags, so every device exits the
+    ``while_loop`` on the same iteration (a collective predicate —
+    divergent exits would deadlock the next ``all_gather``)."""
+
+    def cond(c):
+        r, changed, i = c
+        return (i < max_squarings) & (changed > 0)
+
+    def body(c):
+        r, _, i = c
+        r_full = jax.lax.all_gather(r, axis_name, axis=1, tiled=True)
+        new = bitmat_mul_packed(r_full, r)
+        changed = jax.lax.psum(
+            jnp.any(new != r).astype(jnp.int32), axis_name
+        )
+        return new, changed, i + 1
+
+    r, _, _ = jax.lax.while_loop(
+        cond, body, (r0_shard, jnp.int32(1), jnp.int32(0))
+    )
+    return r
+
+
+def closure_on_cycle_packed_sharded(
+    ww: jax.Array,
+    wr: jax.Array,
+    rw: jax.Array,
+    max_squarings: int,
+    axis_name: str,
+):
+    """Sharded twin of :func:`closure_on_cycle_packed`: the three-graph
+    warm-started closure chain with every packed operand column-sharded
+    ``[T, W_loc]`` over ``axis_name``.  The warm start survives the
+    sharding unchanged — ``closure(A∪B) = closure(closure(A)|B)`` is a
+    statement about the full matrices, and ORing the column shards IS
+    ORing the full matrices columnwise.  The on-cycle masks need the
+    bit-transposed full closure, so each graph pays one final
+    ``all_gather`` before the ``n²/32`` diagonal AND; the returned
+    ``[T]`` masks are replicated across the axis."""
+    T, W_loc = ww.shape
+    id_shard = identity_bits_shard(T, W_loc, axis_name)
+    wwr = ww | wr
+    alle = wwr | rw
+    r_ww = closure_packed_sharded(ww | id_shard, max_squarings, axis_name)
+    r_wwr = closure_packed_sharded(r_ww | wr, max_squarings, axis_name)
+    r_all = closure_packed_sharded(r_wwr | rw, max_squarings, axis_name)
+
+    def full(x):
+        return jax.lax.all_gather(x, axis_name, axis=1, tiled=True)
+
+    return (
+        on_cycle_packed(full(ww), full(r_ww), T),
+        on_cycle_packed(full(wwr), full(r_wwr), T),
+        on_cycle_packed(full(alle), full(r_all), T),
     )
 
 

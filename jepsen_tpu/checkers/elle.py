@@ -751,10 +751,18 @@ def pack_elle_mop_mats(
     metas: Sequence[ElleMopsMeta],
     n_txns: int | None = None,
     to_device: bool = True,
+    at_least: tuple[int, int, int, int] | None = None,
 ) -> ElleMops:
     """Assemble per-history ``[M, 8]`` cell matrices into one
     :class:`ElleMops` (pad + stack only — the split mirrors
-    ``pack_row_matrices`` so native/cached matrices skip re-emission)."""
+    ``pack_row_matrices`` so native/cached matrices skip re-emission).
+
+    ``at_least`` — optional raw ``(cells, val, key, rpos)`` maxima to
+    fold into the bucket computation alongside the local batch's own.
+    Cooperating global-mesh lanes each pack only their row block but
+    must agree on every static shape; exchanging the raw fleet-wide
+    maxima and bucketing them identically here yields byte-identical
+    layouts without shipping any cell data between hosts."""
     from jepsen_tpu.history.encode import LANE, _round_up
 
     if not mats:
@@ -777,7 +785,8 @@ def pack_elle_mop_mats(
     T = n_txns if n_txns is not None else _round_up(n_max, LANE)
     if n_max > T:
         raise ValueError(f"graph with {n_max} txns exceeds T={T}")
-    M = bucket(max(m.shape[0] for m in mats))
+    floor_m, floor_v, floor_k, floor_r = at_least or (0, -1, -1, -1)
+    M = bucket(max(max(m.shape[0] for m in mats), floor_m))
     if M > _MOPS_MAX_CELLS + LANE:
         raise ValueError(
             f"packed cell axis M={M} exceeds the int32 sort-key headroom "
@@ -785,16 +794,19 @@ def pack_elle_mop_mats(
             "degenerate and host-inferred"
         )
 
-    def space(col: int) -> int:
+    def space(col: int, floor: int) -> int:
         return bucket(
             max(
-                (int(m[:, col].max(initial=-1)) for m in mats if m.shape[0]),
-                default=-1,
+                max(
+                    (int(m[:, col].max(initial=-1)) for m in mats if m.shape[0]),
+                    default=-1,
+                ),
+                floor,
             )
             + 1
         )
 
-    V, K, R = space(3), space(2), space(5)
+    V, K, R = space(3, floor_v), space(2, floor_k), space(5, floor_r)
     B = len(mats)
     cols = {
         c: np.full((B, M), -1 if c in ("txn", "val", "rpos", "rid") else 0,

@@ -182,6 +182,7 @@ def worker_main(argv=None) -> int:
     p.add_argument("--worker", action="store_true", required=True)
     p.add_argument("--manifest", required=True)
     p.add_argument("--elastic", action="store_true")
+    p.add_argument("--global-mesh", action="store_true")
     p.add_argument("--spool")
     p.add_argument("--coordinator")
     p.add_argument("--process-id", type=int, required=True)
@@ -189,7 +190,11 @@ def worker_main(argv=None) -> int:
     p.add_argument("--out")
     p.add_argument("--merge-timeout-s", type=float, default=300.0)
     args = p.parse_args(argv)
-    if args.elastic:
+    if args.global_mesh:
+        if not (args.spool and args.coordinator):
+            p.error("--spool and --coordinator are required with "
+                    "--global-mesh")
+    elif args.elastic:
         if not args.spool:
             p.error("--spool is required with --elastic")
     elif not (args.coordinator and args.out):
@@ -201,6 +206,8 @@ def worker_main(argv=None) -> int:
     with open(args.manifest) as fh:
         man = json.load(fh)
 
+    if args.global_mesh:
+        return _global_mesh_worker(args, man)
     if args.elastic:
         return _elastic_worker(args, man)
 
@@ -547,6 +554,8 @@ def run_multiprocess_check(
     fail_fast: bool = False,
     stripe_timeout_s: float | None = None,
     max_stripe_retries: int = 2,
+    global_mesh: bool = False,
+    seq: int = 1,
     _proc_hook=None,
     **opts,
 ) -> tuple[list | dict, dict]:
@@ -576,9 +585,40 @@ def run_multiprocess_check(
     (non-zero exit, kill, timeout) aborts the whole run with
     :class:`DistributedCheckError` and NO partial verdicts.
 
+    ``global_mesh=True`` is the third mode (PR 18): the N processes
+    join ONE ``jax.distributed`` fleet and run the SAME shard_map
+    verdict programs over one global ``(hist, seq)`` mesh — collectives
+    cross the host boundary (gloo on CPU), each process feeds its own
+    input lane, failures degrade by generation restart (see
+    :func:`_run_global_mesh_check`).  Requires ``reduce=True``;
+    ``seq>1`` shards the packed closure's plane axis ACROSS hosts.
+
     ``_proc_hook`` (tools/chaos_check.py) receives the worker Popen
     list right after spawn — the handle a checker-nemesis needs to
     SIGKILL/SIGSTOP real workers mid-check."""
+    # workers run with cwd=repo (PYTHONPATH root), so a caller's
+    # relative source paths must be anchored to THIS process's cwd
+    # before they enter the manifest
+    paths = [os.path.abspath(p) for p in paths]
+    if cache_dir is not None:
+        cache_dir = os.path.abspath(cache_dir)
+    if global_mesh:
+        return _run_global_mesh_check(
+            workload,
+            paths,
+            n_procs,
+            devices_per_proc=devices_per_proc,
+            chunk=chunk,
+            seq=seq,
+            reduce=reduce,
+            timeout_s=timeout_s,
+            cache_dir=cache_dir,
+            platform=platform,
+            stripe_timeout_s=stripe_timeout_s,
+            max_stripe_retries=max_stripe_retries,
+            _proc_hook=_proc_hook,
+            **opts,
+        )
     if not fail_fast:
         return _run_elastic_check(
             workload,
@@ -1171,6 +1211,725 @@ def _merge_elastic(
         merged["histories"] += len(stripe_indices[k])
         merged["quarantined"] += len(stripe_indices[k])
     return merged, per_process
+
+
+# ---------------------------------------------------------------------------
+# Global-mesh mode (PR 18, ROADMAP direction 2's collective half): N
+# processes join ONE jax.distributed fleet and run the SAME shard_map
+# verdict programs over one global (hist, seq) mesh — the collectives
+# (the packed multi-chip closure's all_gather/psum, the verdict
+# reduction's psum/pmin) cross the host boundary for real, instead of
+# each process reducing privately and merging through the KV store.
+# Each process owns one Podracer-style input lane (census → stripes →
+# pack → stage; pipeline.gm_* helpers) and feeds exactly its contiguous
+# row block of every global batch via make_array_from_process_local_data;
+# one small KV exchange of raw pack maxima per chunk keeps the jitted
+# program shapes identical on every host.  On the CPU backend the
+# cross-process collectives run over gloo.
+#
+# Failure semantics are GENERATION-elastic: lockstep collectives mean a
+# dead host wedges its survivors inside a psum, so the launcher's
+# liveness poll kills the whole generation on the first death and
+# respawns N-1 processes on a fresh coordinator — completed stripes are
+# skipped (results/r{k}.json is the ledger, exactly the PR-13 spool
+# shape), unfinished stripes requeue, and stripes whose generation
+# retries exhaust quarantine.  The merged verdict carries the same
+# machine-readable `degraded` provenance as elastic mode.
+# ---------------------------------------------------------------------------
+
+_GM_KV = "jt/gm"
+
+
+def _enable_cpu_collectives() -> None:
+    """The CPU backend needs a cross-process collectives implementation
+    configured BEFORE ``jax.distributed.initialize`` — gloo ships with
+    jaxlib and turns multi-process ``shard_map`` collectives into real
+    socket traffic between the worker processes."""
+    import jax
+
+    if (os.environ.get("JAX_PLATFORMS") or "").strip().lower().startswith(
+        "cpu"
+    ):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
+def build_global_mesh(seq: int = 1):
+    """The fleet-wide ``(hist, seq)`` mesh.
+
+    ``seq == 1``: devices process-major down the hist axis — process p's
+    devices own a contiguous block of history rows, so its input lane
+    feeds its local shard directly and the cross-host collective is the
+    verdict reduction's psum/pmin.
+
+    ``seq > 1``: ``seq`` must be a multiple of the process count; each
+    process contributes ``k = seq/N`` adjacent seq columns, so the
+    column (plane) axis of the packed closure spans ALL processes and
+    every ``all_gather`` of the packed left operand crosses the host
+    boundary — the arXiv 2112.09017 block distribution, with hosts as
+    the outer block grid.  Every process then holds a column slice of
+    EVERY history row (one shared lane), the fat-history regime where
+    device work dominates ingest."""
+    import numpy as _np
+
+    import jax
+
+    from jepsen_tpu.parallel.mesh import HIST_AXIS, SEQ_AXIS
+
+    devs = sorted(jax.devices(), key=lambda d: d.id)
+    n = jax.process_count()
+    total = len(devs)
+    d_local = total // n
+    for j, d in enumerate(devs):
+        if d.process_index != j // d_local:
+            raise DistributedCheckError(
+                "device ids are not process-major; the lane-per-host row "
+                "blocks would not be contiguous"
+            )
+    from jax.sharding import Mesh
+
+    if seq <= 1:
+        arr = _np.array(devs).reshape(total, 1)
+    else:
+        if seq % n:
+            raise ValueError(
+                f"global-mesh seq={seq} must be a multiple of the process "
+                f"count {n} so the plane-axis collectives cross hosts"
+            )
+        k = seq // n
+        if d_local % k:
+            raise ValueError(
+                f"each process contributes seq/N={k} seq columns, which "
+                f"must divide its local device count {d_local}"
+            )
+        hist = d_local // k
+        arr = (
+            _np.array(devs)
+            .reshape(n, hist, k)
+            .transpose(1, 0, 2)
+            .reshape(hist, seq)
+        )
+    return Mesh(arr, (HIST_AXIS, SEQ_AXIS))
+
+
+def _process_block(sharding, shape) -> tuple:
+    """The contiguous index box of the global array that THIS process's
+    devices own under ``sharding`` — the block its input lane must
+    produce.  Raises when the process's shards don't tile one box (a
+    layout this feeding scheme can't serve)."""
+    import jax
+
+    imap = sharding.devices_indices_map(tuple(shape))
+    pidx = jax.process_index()
+    local = [idx for d, idx in imap.items() if d.process_index == pidx]
+    if not local:
+        raise DistributedCheckError(
+            "process owns no shard of the global batch"
+        )
+    norm = {
+        tuple(
+            (s.start or 0, shape[a] if s.stop is None else s.stop)
+            for a, s in enumerate(idx)
+        )
+        for idx in local
+    }
+    box = tuple(
+        slice(min(b[a][0] for b in norm), max(b[a][1] for b in norm))
+        for a in range(len(shape))
+    )
+    one = next(iter(norm))
+    shard_vol = 1
+    for a in range(len(shape)):
+        shard_vol *= one[a][1] - one[a][0]
+    box_vol = 1
+    for s in box:
+        box_vol *= s.stop - s.start
+    if shard_vol * len(norm) != box_vol:
+        raise DistributedCheckError(
+            "process-local shards do not tile a contiguous block under "
+            "this mesh layout"
+        )
+    return box
+
+
+def _feed_global(lane_np, lane_row0: int, mesh, spec, global_shape):
+    """One lane's host block -> a global sharded array.  ``lane_np``
+    holds rows ``[lane_row0, lane_row0 + lane_rows)`` of the global row
+    axis (axis 0) and ALL columns; the process block is cut out of it
+    and handed to ``make_array_from_process_local_data`` — no host ever
+    materializes another host's rows."""
+    import numpy as _np
+
+    import jax
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    box = _process_block(sh, global_shape)
+    rel = (
+        slice(box[0].start - lane_row0, box[0].stop - lane_row0),
+    ) + tuple(box[1:])
+    block = _np.ascontiguousarray(lane_np[rel])
+    return jax.make_array_from_process_local_data(
+        sh, block, tuple(global_shape)
+    )
+
+
+def _gm_exchange(kvp: str, pid: int, n: int, payload: dict, kv_ms: int):
+    """Publish this process's chunk facts and read everyone's — the
+    per-chunk shape-agreement barrier.  A sibling that died before
+    publishing surfaces as a deadline timeout here, which exits this
+    worker non-zero and lets the launcher restart the generation."""
+    kv = _kv_client()
+    kv.key_value_set(f"{kvp}/{pid}", json.dumps(payload))
+    docs = []
+    for q in range(n):
+        raw = kv.blocking_key_value_get(f"{kvp}/{q}", kv_ms)
+        docs.append(json.loads(raw))
+    return docs
+
+
+def _gm_queue_chunk(
+    man: dict, mesh, lanes: int, quantum: int, pid: int, n: int,
+    idxs: list[int], kvp: str, kv_ms: int,
+) -> tuple[int, int]:
+    """One queue chunk over the global mesh: stage my lane's rows, agree
+    on (L, V), feed my row/column block, run the sharded verdict with
+    cross-host reduction.  Returns ``(n_invalid, first_invalid)`` in
+    kept-manifest gid space."""
+    import dataclasses
+
+    import numpy as _np
+
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu.parallel.mesh import (
+        HIST_AXIS,
+        SEQ_AXIS,
+        sharded_queue_verdict,
+    )
+    from jepsen_tpu.parallel.pipeline import (
+        _GID_PAD,
+        _pow2_bucket,
+        gm_lane_plan,
+        gm_pack_queue_lane,
+        gm_stage_queue_lane,
+    )
+
+    B = len(idxs)
+    b_l, bounds = gm_lane_plan(B, lanes, quantum)
+    lane = pid if lanes > 1 else 0
+    lo, hi = bounds[lane]
+    mats, (n_max, vmax) = gm_stage_queue_lane(
+        [man["paths"][i] for i in idxs[lo:hi]],
+        use_cache=bool((man.get("opts") or {}).get("use_cache", True)),
+    )
+    docs = _gm_exchange(kvp, pid, n, {"n": n_max, "v": vmax}, kv_ms)
+    length = _pow2_bucket(max(max(d["n"] for d in docs), 1))
+    space = _pow2_bucket(max(d["v"] for d in docs) + 1)
+    packed = gm_pack_queue_lane(mats, b_l, length, space)
+
+    b_pad = lanes * b_l
+    gidx = _np.full(b_l, _GID_PAD, _np.int32)
+    gidx[: hi - lo] = _np.asarray(idxs[lo:hi], _np.int32)
+    row0 = lane * b_l
+    row = P(HIST_AXIS, SEQ_AXIS)
+
+    def feed2(x):
+        return _feed_global(_np.asarray(x), row0, mesh, row, (b_pad, length))
+
+    packed_g = dataclasses.replace(
+        packed,
+        **{
+            f: feed2(getattr(packed, f))
+            for f in ("index", "process", "type", "f", "value", "time_ms",
+                      "latency_ms", "mask", "first")
+        },
+    )
+    gidx_g = _feed_global(gidx, row0, mesh, P(HIST_AXIS), (b_pad,))
+    delivery = (man.get("opts") or {}).get("delivery", "exactly-once")
+    nb, first = sharded_queue_verdict(
+        packed_g, mesh, delivery=delivery, gidx=gidx_g
+    )
+    return int(_np.asarray(nb)), int(_np.asarray(first))
+
+
+def _gm_elle_chunk(
+    man: dict, mesh, lanes: int, quantum: int, pid: int, n: int,
+    idxs: list[int], kvp: str, kv_ms: int,
+) -> tuple[int, int]:
+    """One elle chunk over the global mesh: stage my lane's micro-op
+    substrates, splice degenerate rows through MY host's oracle (the
+    shard-boundary fallback splice), agree on (T, M, V, K, R), feed my
+    block of the live batch, and run fused device inference + the
+    packed multi-chip closure with its plane axis sharded across hosts.
+    Returns ``(n_invalid, first_invalid)`` in kept-manifest gid space."""
+    import dataclasses
+    import math
+
+    import numpy as _np
+
+    from jax.sharding import PartitionSpec as P
+
+    from jepsen_tpu.checkers.elle import check_elle_cpu
+    from jepsen_tpu.history.encode import LANE, _round_up
+    from jepsen_tpu.history.store import read_history
+    from jepsen_tpu.parallel.mesh import (
+        HIST_AXIS,
+        SEQ_AXIS,
+        sharded_elle_mops_verdict,
+    )
+    from jepsen_tpu.parallel.pipeline import (
+        _GID_PAD,
+        gm_lane_plan,
+        gm_pack_elle_lane,
+        gm_stage_elle_lane,
+    )
+
+    model = (man.get("opts") or {}).get("model", "serializable")
+    B = len(idxs)
+    b_l, bounds = gm_lane_plan(B, lanes, quantum)
+    lane = pid if lanes > 1 else 0
+    lo, hi = bounds[lane]
+    mm, live, degen, maxima = gm_stage_elle_lane(
+        [man["paths"][i] for i in idxs[lo:hi]],
+        use_cache=bool((man.get("opts") or {}).get("use_cache", True)),
+    )
+    # degenerate rows: host-oracle fallback on the lane that owns them
+    # (the splice boundary IS the shard boundary); the per-lane fold is
+    # exchanged so every process derives the identical chunk verdict
+    di, df = 0, -1
+    for i in degen:
+        r = check_elle_cpu(read_history(man["paths"][idxs[lo + i]]),
+                           model=model)
+        if r["valid?"] is not True:
+            di += 1
+            g = idxs[lo + i]
+            df = g if df < 0 else min(df, g)
+    docs = _gm_exchange(
+        kvp, pid, n,
+        {"x": list(maxima), "live": len(live), "di": di, "df": df},
+        kv_ms,
+    )
+    # one doc per LANE (for the shared-lane seq>1 layout every process
+    # published the same facts; fold lane 0's only)
+    lane_docs = docs[:lanes]
+    n_invalid = sum(d["di"] for d in lane_docs)
+    first = min((d["df"] for d in lane_docs if d["df"] >= 0), default=-1)
+
+    live_max = max(d["live"] for d in lane_docs)
+    t_glob = max(d["x"][0] for d in lane_docs)
+    if live_max == 0 or t_glob == 0:
+        return n_invalid, first
+    n_seq = mesh.shape[SEQ_AXIS]
+    # T granule: the lane width AND whole uint32 plane words per seq
+    # shard, so the packed multi-chip closure lowers (no silent dense
+    # fallback) and n_txns % seq holds
+    granule = math.lcm(LANE, 32 * n_seq) if n_seq > 1 else LANE
+    t_pad = _round_up(t_glob, granule)
+    at_least = tuple(int(max(d["x"][j] for d in lane_docs))
+                     for j in range(1, 5))
+    b_live = _round_up(live_max, quantum)
+    mops = gm_pack_elle_lane(mm, live, b_live, t_pad, at_least)
+
+    b_pad = lanes * b_live
+    gidx = _np.full(b_live, _GID_PAD, _np.int32)
+    gidx[: len(live)] = _np.asarray(
+        [idxs[lo + i] for i in live], _np.int32
+    )
+    row0 = lane * b_live
+    m_cells = mops.txn.shape[1]
+
+    def feed2(x):
+        return _feed_global(
+            _np.asarray(x), row0, mesh, P(HIST_AXIS, None),
+            (b_pad, m_cells),
+        )
+
+    def feed1(x):
+        return _feed_global(
+            _np.asarray(x), row0, mesh, P(HIST_AXIS), (b_pad,)
+        )
+
+    mops_g = dataclasses.replace(
+        mops,
+        **{
+            f: feed2(getattr(mops, f))
+            for f in ("txn", "kind", "key", "val", "rpos", "rid", "alast",
+                      "mask")
+        },
+        n_committed=feed1(mops.n_committed),
+    )
+    gidx_g = feed1(gidx)
+    nb, fdev = sharded_elle_mops_verdict(mops_g, mesh, gidx=gidx_g)
+    nb, fdev = int(_np.asarray(nb)), int(_np.asarray(fdev))
+    n_invalid += nb
+    if fdev >= 0 and (first < 0 or fdev < first):
+        first = fdev
+    return n_invalid, first
+
+
+def _global_mesh_worker(args, man: dict) -> int:
+    """One process of the global-mesh fleet.  No task claiming: every
+    worker walks the SAME stripe list in the same order (skipping
+    stripes whose result existed when this generation started — the
+    crash-recovery ledger), because the collectives need every process
+    in every program.  Process 0 writes the per-stripe verdict docs."""
+    import jax
+
+    _enable_cpu_collectives()
+    init_multihost(
+        args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+    assert jax.process_count() == args.num_processes, jax.process_count()
+    pid = args.process_id
+    n = args.num_processes
+
+    from jepsen_tpu.utils.jaxenv import enable_compilation_cache
+
+    if man.get("cache_dir"):
+        enable_compilation_cache(
+            man["cache_dir"], backend=jax.default_backend()
+        )
+
+    seq = int(man.get("seq") or 1)
+    mesh = build_global_mesh(seq)
+    from jepsen_tpu.parallel.mesh import HIST_AXIS
+    from jepsen_tpu.parallel.pipeline import _chunks
+
+    d_local = len(jax.devices()) // n
+    lanes = n if seq <= 1 else 1
+    # rows-per-process granule of the global hist axis: lane heights
+    # must be multiples of it so every lane block is whole device shards
+    quantum = d_local if seq <= 1 else mesh.shape[HIST_AXIS]
+
+    resdir = Path(args.spool) / "results"
+    done0 = {int(f.name[1:-5]) for f in resdir.glob("r*.json")}
+    stripes = [sorted(s) for s in man["stripes"]]
+    chunk = int(man.get("chunk") or 64)
+    kv_ms = int(man.get("kv_timeout_ms") or 120_000)
+    run_chunk = (
+        _gm_queue_chunk if man["workload"] == "queue" else _gm_elle_chunk
+    )
+
+    checked = 0
+    first_done = False
+    for k, stripe in enumerate(stripes):
+        if k in done0:
+            continue
+        if first_done and _hook_hit(_DIE_ENV, pid):
+            # crash-contract hook: die between stripes, AFTER completing
+            # one — the restart generation must skip the finished stripe
+            # and redo only the rest
+            os._exit(42)
+        if _hook_hit(_WEDGE_ENV, pid):
+            time.sleep(3600)
+        t0 = time.perf_counter()
+        total_invalid, total_first, histories = 0, -1, 0
+        for ci, cidx in enumerate(_chunks(stripe, chunk)):
+            cidx = list(cidx)
+            inv, first = run_chunk(
+                man, mesh, lanes, quantum, pid, n, cidx,
+                f"{_GM_KV}/t{k}/c{ci}", kv_ms,
+            )
+            total_invalid += inv
+            histories += len(cidx)
+            if first >= 0 and (total_first < 0 or first < total_first):
+                total_first = first
+        wall = time.perf_counter() - t0
+        if pid == 0:
+            _write_json_atomic(
+                resdir / f"r{k}.json",
+                {
+                    "pid": pid,
+                    "task": k,
+                    "indices": stripe,
+                    "results": {
+                        "histories": histories,
+                        "invalid": total_invalid,
+                        "first_invalid": total_first,
+                        "quarantined": 0,
+                        "dropped": 0,
+                    },
+                    "stats": {
+                        "wall_s": wall,
+                        "histories": histories,
+                        "lanes": lanes,
+                        "dropped": 0,
+                        "quarantined": 0,
+                    },
+                },
+            )
+        checked += len(stripe)
+        first_done = True
+    print(json.dumps({"pid": pid, "checked": checked}), flush=True)
+    return 0
+
+
+def _run_global_mesh_check(
+    workload: str,
+    paths,
+    n_procs: int,
+    *,
+    devices_per_proc: int = 1,
+    chunk: int = 64,
+    seq: int = 1,
+    reduce: bool = True,
+    timeout_s: float = 900.0,
+    cache_dir: str | None = None,
+    platform: str | None = None,
+    stripe_timeout_s: float | None = None,
+    max_stripe_retries: int = 2,
+    _proc_hook=None,
+    **opts,
+) -> tuple[dict, dict]:
+    """Launcher for the global-mesh fleet (see the section comment):
+    generation-elastic — the first worker death kills the generation
+    (survivors are wedged inside collectives, not salvageable) and
+    respawns N-1 on a fresh coordinator; completed stripes are skipped
+    via the results ledger, exhausted stripes quarantine.  Returns the
+    reduced verdict + info with ``degraded`` provenance."""
+    import tempfile
+
+    from jepsen_tpu.parallel.pipeline import _lane_census
+
+    if workload not in ("queue", "elle"):
+        raise ValueError(
+            "global-mesh mode runs the queue and elle collective verdict "
+            f"programs; workload {workload!r} is not wired yet"
+        )
+    if not reduce:
+        raise ValueError(
+            "global-mesh mode reduces on device (two scalars cross D2H); "
+            "pass reduce=True"
+        )
+    if n_procs < 1:
+        raise ValueError(f"n_procs must be >= 1, got {n_procs}")
+    if seq > 1:
+        if seq % n_procs:
+            raise ValueError(
+                f"seq={seq} must be a multiple of n_procs={n_procs}"
+            )
+        if devices_per_proc % (seq // n_procs):
+            raise ValueError(
+                f"seq/N={seq // n_procs} seq columns per process must "
+                f"divide devices_per_proc={devices_per_proc}"
+            )
+    paths = [str(p) for p in paths]
+    kept, sizes, dropped = _lane_census(paths, workload)
+    n_tasks = max(1, min(n_procs, len(kept)))
+    stripes = [sorted(s) for s in assign_stripes(sizes, n_tasks)]
+
+    with tempfile.TemporaryDirectory(prefix="jt_gmesh_") as td:
+        spool = Path(td) / "spool"
+        resdir = spool / "results"
+        resdir.mkdir(parents=True)
+        manifest = {
+            "workload": workload,
+            "paths": [paths[i] for i in kept],
+            "sizes": sizes,
+            "chunk": chunk,
+            "seq": seq,
+            "reduce": True,
+            "cache_dir": cache_dir,
+            "opts": opts,
+            "stripes": stripes,
+            "global_mesh": True,
+        }
+        mpath = os.path.join(td, "manifest.json")
+        with open(mpath, "w") as fh:
+            json.dump(manifest, fh)
+        env = _worker_env(platform, devices_per_proc)
+        repo = env["PYTHONPATH"].split(os.pathsep)[0]
+
+        deadline = time.monotonic() + timeout_s
+        fleet = n_procs
+        gen = 0
+        deaths: list[int] = []
+        requeued: list[int] = []
+        wedged_killed = 0
+        retries: dict[int, int] = {}
+        quarantined: dict[int, dict] = {}
+        last_log = ""
+
+        def seq_for_fleet(n: int) -> int:
+            # the widest seq axis a generation of n processes can still
+            # factor: seq' = n * k with k | devices_per_proc, capped at
+            # the requested seq.  A shrunken fleet keeps verifying on a
+            # NARROWER mesh (seq is a layout, not a semantic: verdicts
+            # are seq-invariant by the differential pins) rather than
+            # dying forever on an unbuildable one.
+            best = 1
+            k = 1
+            while n * k <= seq:
+                if devices_per_proc % k == 0:
+                    best = n * k
+                k += 1
+            return min(best, seq) if seq > 1 else seq
+
+        man_seq = seq
+
+        def results_done() -> set[int]:
+            return {int(f.name[1:-5]) for f in resdir.glob("r*.json")}
+
+        while True:
+            done = results_done()
+            todo = [
+                k for k in range(n_tasks)
+                if k not in done and k not in quarantined
+            ]
+            if not todo:
+                break
+            eff_seq = seq_for_fleet(fleet)
+            if eff_seq != man_seq:
+                man_seq = eff_seq
+                manifest["seq"] = eff_seq
+                with open(mpath, "w") as fh:
+                    json.dump(manifest, fh)
+            port = _free_port()
+            logs = [
+                os.path.join(td, f"g{gen}_w{i}.log") for i in range(fleet)
+            ]
+            procs = []
+            for i in range(fleet):
+                lf = open(logs[i], "w")
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable, "-m",
+                            "jepsen_tpu.parallel.distributed",
+                            "--worker", "--global-mesh",
+                            "--manifest", mpath,
+                            "--spool", str(spool),
+                            "--coordinator", f"127.0.0.1:{port}",
+                            "--process-id", str(i),
+                            "--num-processes", str(fleet),
+                        ],
+                        stdout=lf,
+                        stderr=subprocess.STDOUT,
+                        cwd=repo,
+                        env=env,
+                    )
+                )
+                lf.close()
+            if _proc_hook is not None:
+                _proc_hook(procs)
+            failed: tuple[int, int | None] | None = None
+            wedged = False
+            pending = set(range(fleet))
+            n_done_seen = len(done)
+            progress_t = time.monotonic()
+            try:
+                while pending and failed is None:
+                    for i in sorted(pending):
+                        rc = procs[i].poll()
+                        if rc is None:
+                            continue
+                        pending.discard(i)
+                        if rc != 0:
+                            failed = (i, rc)
+                            break
+                    if not pending or failed is not None:
+                        break
+                    now = time.monotonic()
+                    if now > deadline:
+                        for pr in procs:
+                            pr.kill()
+                        raise DistributedCheckError(
+                            f"global-mesh run timed out after {timeout_s}s "
+                            f"(generation {gen}):\n"
+                            + _log_tail(logs[0], 1500)
+                        )
+                    if stripe_timeout_s is not None:
+                        nd = len(results_done())
+                        if nd > n_done_seen:
+                            n_done_seen, progress_t = nd, now
+                        elif now - progress_t > stripe_timeout_s:
+                            # no stripe landed for a full deadline: a
+                            # wedged (e.g. SIGSTOPped) member has the
+                            # fleet stuck in a collective — kill the
+                            # generation and restart
+                            failed = (-1, None)
+                            wedged = True
+                            break
+                    time.sleep(0.05)
+            finally:
+                for pr in procs:
+                    if pr.poll() is None:
+                        pr.kill()
+                for pr in procs:
+                    try:
+                        pr.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        pass
+            if failed is None:
+                continue  # clean generation; loop re-checks the ledger
+            fpid, _rc = failed
+            last_log = _log_tail(logs[max(fpid, 0)], 1500)
+            done2 = results_done()
+            lost = [k for k in todo if k not in done2]
+            if wedged:
+                wedged_killed += 1
+            else:
+                deaths.append(fpid)
+                fleet = max(1, fleet - 1)
+            for k in lost:
+                retries[k] = retries.get(k, 0) + 1
+                if retries[k] > max_stripe_retries:
+                    quarantined[k] = {
+                        "reason": "generation retries exhausted",
+                        "retries": retries[k],
+                    }
+                else:
+                    requeued.append(k)
+            gen += 1
+            if all(
+                k in quarantined or k in done2 for k in range(n_tasks)
+            ):
+                break
+            time.sleep(min(0.2 * gen, 1.0))
+
+        shard_docs = {}
+        for f in sorted(resdir.glob("r*.json")):
+            with open(f) as fh:
+                shard_docs[int(f.name[1:-5])] = json.load(fh)
+        if not shard_docs and quarantined and len(quarantined) == n_tasks:
+            raise DistributedCheckError(
+                "global-mesh fleet never completed a stripe "
+                f"({len(deaths)} deaths, {wedged_killed} wedge kills):\n"
+                + last_log
+            )
+        stripe_indices = {k: stripes[k] for k in range(n_tasks)}
+        merged, per_process = _merge_elastic(
+            manifest, shard_docs, quarantined, stripe_indices, workload,
+            True,
+        )
+        verdict = merged
+        verdict["dropped"] += len(dropped)
+        if verdict["first_invalid"] >= 0:
+            verdict["first_invalid"] = kept[verdict["first_invalid"]]
+        degraded = {
+            "dead_workers": deaths,
+            "requeued_stripes": sorted(set(requeued)),
+            "quarantined_stripes": sorted(quarantined),
+            "wedged_killed": wedged_killed,
+            "quarantined_histories": sum(
+                len(stripe_indices[k]) for k in quarantined
+            ),
+            "final_procs": fleet,
+            "generations": gen + 1,
+            "seq_final": man_seq,
+        }
+        info = {
+            "n_procs": n_procs,
+            "devices_per_proc": devices_per_proc,
+            "dropped": len(dropped),
+            "per_process": per_process,
+            "global_mesh": True,
+            "seq": seq,
+            "degraded": degraded,
+        }
+        return verdict, info
 
 
 if __name__ == "__main__":

@@ -230,10 +230,32 @@ def sharded_check(
 # ---------------------------------------------------------------------------
 
 
+@functools.lru_cache(maxsize=256)
+def _reshard_fn(sh):
+    return jax.jit(lambda v: v, out_shardings=sh)
+
+
+def _global_put(x, mesh: Mesh, spec):
+    """Place ``x`` under ``NamedSharding(mesh, spec)`` — multi-process
+    safe.  A host-side ``device_put`` cannot retarget a global (non-
+    fully-addressable) array: each process only holds its own shards.
+    For those, a compiled identity with an output-sharding constraint
+    does the move instead — the GSPMD partitioner lowers it to on-device
+    collectives, which is exactly how the seq>1 global mesh re-shards an
+    inferred adjacency's column axis across hosts."""
+    sh = NamedSharding(mesh, spec)
+    cur = getattr(x, "sharding", None)
+    if cur is not None and not x.is_fully_addressable:
+        if cur == sh:
+            return x
+        return _reshard_fn(sh)(x)
+    return jax.device_put(x, sh)
+
+
 def _hist_sharded(tree, mesh: Mesh):
     def put(x):
         spec = P(HIST_AXIS, *([None] * (x.ndim - 1)))
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _global_put(x, mesh, spec)
 
     return jax.tree.map(put, tree)
 
@@ -479,29 +501,141 @@ def sharded_wgl_pcomp(decomps, mesh: Mesh, capacity_cap: int | None = None):
         )
 
 
-def sharded_elle(batch, mesh: Mesh):
+#: reasons already logged for dense-closure fallbacks (log once per
+#: run/process; the counter keeps the cumulative tally for /metrics)
+_dense_fallback_seen: set[str] = set()
+
+
+def _note_dense_fallback(reason: str) -> None:
+    """Account an honest dense fallback: bump the
+    ``mesh.closure_dense_fallbacks`` counter on ``/metrics`` and log the
+    reason the packed multi-chip path was refused — once per distinct
+    reason per run, so a 10k-chunk campaign doesn't spam the log."""
+    from jepsen_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter("mesh.closure_dense_fallbacks").inc()
+    if reason not in _dense_fallback_seen:
+        _dense_fallback_seen.add(reason)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "elle seq-mesh closure falling back to DENSE: %s", reason
+        )
+
+
+def _packed_shard_refusal(batch, n_seq: int) -> str | None:
+    """Why the packed multi-chip closure cannot lower for this batch on
+    an ``n_seq``-way seq mesh, or ``None`` if it can.  The plane axis
+    ``ceil(T/32)`` must split into whole uint32 words per device — a
+    shard boundary inside a word would make the local ``pack_bits`` of a
+    column block disagree with the global plane shard."""
+    from jepsen_tpu.checkers.bitset import LANE_BITS
+
+    T = int(batch.ww.shape[-1])
+    if T % (LANE_BITS * n_seq):
+        return (
+            f"padded txn axis T={T} does not split into whole uint32 "
+            f"plane words across seq={n_seq} (needs T % {LANE_BITS * n_seq}"
+            " == 0); overflow buckets with odd pad widths take this path"
+        )
+    return None
+
+
+@functools.lru_cache(maxsize=32)
+def _elle_packed_sharded_program(mesh: Mesh, n_txns: int):
+    """The packed multi-chip closure program: adjacency column blocks
+    arrive dense ``[B/h, T, T/s]`` per device, pack to their plane
+    shard locally (the refusal check guarantees the shard boundary sits
+    on a word boundary, so local ``pack_bits`` IS the global column
+    shard), and the warm-started three-graph closure chain runs with
+    its ``ceil(T/32)`` plane axis sharded over ``seq`` — per squaring
+    one ``all_gather`` of the packed left operand and a local blocked
+    Four-Russians multiply, fixpoint by ``psum``'d change flags
+    (``closure_on_cycle_packed_sharded``).  This is the composition the
+    DENSE pin forbade: the 4.64× packed-representation win and the
+    multi-chip column split now multiply instead of excluding each
+    other."""
+    from jepsen_tpu.checkers.bitset import (
+        closure_on_cycle_packed_sharded,
+        pack_bits,
+    )
+    from jepsen_tpu.checkers.elle import ElleTensors, n_squarings
+
+    k = n_squarings(n_txns)
+
+    def body(ww, wr, rw, txn_mask, host_bad):
+        def one(a_ww, a_wr, a_rw, m):
+            g0, g1c, g2 = closure_on_cycle_packed_sharded(
+                pack_bits(a_ww > 0),
+                pack_bits(a_wr > 0),
+                pack_bits(a_rw > 0),
+                k,
+                SEQ_AXIS,
+            )
+            return g0 & m, g1c & m, g2 & m
+
+        g0, g1c, g2 = jax.vmap(one)(ww, wr, rw, txn_mask)
+        valid = ~(g0.any(-1) | g1c.any(-1) | g2.any(-1) | host_bad)
+        return ElleTensors(valid=valid, g0=g0, g1c=g1c, g2=g2)
+
+    col = P(HIST_AXIS, None, SEQ_AXIS)
+    row = P(HIST_AXIS, None)
+    out_specs = ElleTensors(valid=P(HIST_AXIS), g0=row, g1c=row, g2=row)
+    return jax.jit(
+        shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(col, col, col, row, P(HIST_AXIS)),
+            out_specs=out_specs,
+            check_rep=False,
+        )
+    )
+
+
+def sharded_elle(batch, mesh: Mesh, closure: str | None = None):
     """Elle cycle search over the mesh.  Histories shard over ``hist``;
     when the mesh has a ``seq`` axis the ``[T, T]`` adjacency matrices
-    additionally shard their column axis over it and the log-squaring
-    closure matmuls run Megatron-style — annotate the shardings and let
-    GSPMD insert the collectives (the scaling lever for transaction
-    graphs too large for one chip's MXU pass)."""
+    additionally shard their column axis over it.  The default closure
+    is the **packed multi-chip** program (see
+    ``_elle_packed_sharded_program``): uint32 bitplanes column-sharded
+    over ``seq`` with explicit ``all_gather``/``psum`` collectives —
+    the Four-Russians representation win and the Megatron column split
+    compose.  When the packed path is refused (plane axis not word-
+    divisible) or a non-packed mode is forced, the bf16 MXU column-
+    sharded GSPMD program runs instead; refusals are logged once per
+    run and counted on ``/metrics``
+    (``mesh.closure_dense_fallbacks``)."""
     import dataclasses
 
-    from jepsen_tpu.checkers.elle import elle_tensor_check
+    from jepsen_tpu.checkers.elle import _resolve_closure, elle_tensor_check
 
     if mesh.shape[SEQ_AXIS] == 1:
-        return elle_tensor_check(_hist_sharded(batch, mesh))
+        return elle_tensor_check(_hist_sharded(batch, mesh), closure=closure)
 
-    if batch.n_txns % mesh.shape[SEQ_AXIS]:
+    n_seq = mesh.shape[SEQ_AXIS]
+    if batch.n_txns % n_seq:
         raise ValueError(
-            f"seq={mesh.shape[SEQ_AXIS]} must divide n_txns="
+            f"seq={n_seq} must divide n_txns="
             f"{batch.n_txns} (pack_txn_graphs pads to the lane width, "
             "so any power-of-two seq up to the lane size divides it)"
         )
 
     def put(x, spec):
-        return jax.device_put(x, NamedSharding(mesh, spec))
+        return _global_put(x, mesh, spec)
+
+    mode = _resolve_closure(closure)
+    if mode == "packed":
+        refusal = _packed_shard_refusal(batch, n_seq)
+        if refusal is None:
+            fn = _elle_packed_sharded_program(mesh, batch.n_txns)
+            return fn(
+                put(batch.ww, P(HIST_AXIS, None, SEQ_AXIS)),
+                put(batch.wr, P(HIST_AXIS, None, SEQ_AXIS)),
+                put(batch.rw, P(HIST_AXIS, None, SEQ_AXIS)),
+                put(batch.txn_mask, P(HIST_AXIS, None)),
+                put(batch.host_bad, P(HIST_AXIS)),
+            )
+        _note_dense_fallback(refusal)
 
     sharded = dataclasses.replace(
         batch,
@@ -511,13 +645,9 @@ def sharded_elle(batch, mesh: Mesh):
         txn_mask=put(batch.txn_mask, P(HIST_AXIS, None)),
         host_bad=put(batch.host_bad, P(HIST_AXIS)),
     )
-    # seq>1 pins the DENSE closure: the Megatron-style column sharding
-    # partitions [T, T] matmul operands over seq, which is exactly the
-    # axis the packed bitplane representation folds 32:1 — GSPMD would
-    # all-gather the lanes and silently serialize.  Bitplanes win the
-    # single-chip/hist-sharded paths (the default); graphs too large
-    # for one chip keep the MXU column-sharded program.
-    return elle_tensor_check(sharded, closure="dense")
+    return elle_tensor_check(
+        sharded, closure="dense" if mode == "packed" else mode
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -1159,6 +1159,121 @@ def _gids_of(chunk) -> list[int]:
 
 
 # ---------------------------------------------------------------------------
+# Global-mesh lane staging (PIPELINE.md §Global mesh): each cooperating
+# process owns one Podracer-style input lane — census → stripes → pack →
+# stage — and produces exactly its contiguous row block of every global
+# device batch.  These helpers are the cross-host SHAPE CONTRACT: all
+# lanes must agree on the padded lane height and on every packed static
+# (L/V for queue, T/M/V/K/R for elle), or the processes would jit
+# different programs and the collectives would deadlock.  Agreement
+# costs one small KV exchange of raw maxima per chunk (never cell
+# data); `pack_row_matrices`/`pack_elle_mop_mats` then bucket those
+# maxima identically on every host.
+# ---------------------------------------------------------------------------
+
+
+def gm_lane_plan(
+    n_rows: int, lanes: int, quantum: int
+) -> tuple[int, list[tuple[int, int]]]:
+    """``(b_l, bounds)`` — the common padded lane height (a multiple of
+    ``quantum``, the rows-per-device granule of the global hist axis)
+    and each lane's real-row interval ``[lo, hi)`` of the chunk.  Lane
+    blocks are contiguous in chunk order, so one lane's parse output IS
+    its process-local block of the global batch — no row shuffling
+    between hosts."""
+    import math
+
+    from jepsen_tpu.history.encode import _round_up
+
+    b_l = _round_up(math.ceil(n_rows / max(1, lanes)), quantum)
+    bounds = [
+        (min(p * b_l, n_rows), min((p + 1) * b_l, n_rows))
+        for p in range(lanes)
+    ]
+    return b_l, bounds
+
+
+def gm_stage_queue_lane(paths, threads: int = 0, use_cache: bool = True):
+    """Stage one lane's queue rows (cache → native → Python, exactly the
+    serial substrate contract) and report the raw pack maxima the lanes
+    must exchange: ``(mats, (n_max, vmax))``."""
+    mats = (
+        _queue_substrates([Path(p) for p in paths], threads, use_cache)
+        if paths
+        else []
+    )
+    n_max = max((m.shape[0] for m in mats), default=0)
+    vmax = max(
+        (int(m[:, 4].max(initial=0)) for m in mats if m.shape[0]), default=0
+    )
+    return mats, (n_max, vmax)
+
+
+def gm_pack_queue_lane(mats, b_l: int, length: int, value_space: int):
+    """Pack one lane's row matrices — sentinel-padded to the agreed lane
+    height ``b_l`` — into host-side ``PackedHistories`` columns with the
+    fleet-agreed ``(L, V)`` statics (pad rows are all-masked, synthesized
+    valid by every checker)."""
+    from jepsen_tpu.history.encode import pack_row_matrices
+
+    empty = np.zeros((0, 8), np.int32)
+    mats = list(mats) + [empty] * (b_l - len(mats))
+    return pack_row_matrices(
+        mats, length=length, value_space=value_space, to_device=False
+    )
+
+
+def gm_stage_elle_lane(paths, threads: int = 0, use_cache: bool = True):
+    """Stage one lane's elle micro-op substrates and split them on THE
+    degeneracy contract (``split_elle_mops`` semantics): returns
+    ``(mats_metas, live, degen, maxima)`` where ``live``/``degen`` are
+    lane-local row positions and ``maxima`` is the raw ``(n_txns, cells,
+    val, key, rpos)`` tuple the lanes exchange to agree on packed
+    statics.  Degenerate rows stay on THIS lane's host for the oracle
+    fallback — the splice point is the lane (= shard) boundary."""
+    mm = (
+        _elle_substrates([Path(p) for p in paths], threads, use_cache)
+        if paths
+        else []
+    )
+    live = [i for i, (_, g) in enumerate(mm) if not g.degenerate]
+    degen = [i for i, (_, g) in enumerate(mm) if g.degenerate]
+    t_max = max((mm[i][1].n_txns for i in live), default=0)
+    m_max = max((mm[i][0].shape[0] for i in live), default=0)
+
+    def col(c: int) -> int:
+        return max(
+            (
+                int(mm[i][0][:, c].max(initial=-1))
+                for i in live
+                if mm[i][0].shape[0]
+            ),
+            default=-1,
+        )
+
+    return mm, live, degen, (t_max, m_max, col(3), col(2), col(5))
+
+
+def gm_pack_elle_lane(mats_metas, live, b_live: int, n_txns: int, at_least):
+    """Pack one lane's LIVE elle rows — sentinel-padded to the agreed
+    live lane height — with fleet-agreed statics: ``n_txns`` (= T) plus
+    the raw ``(cells, val, key, rpos)`` fleet maxima folded into the
+    pow2 buckets by ``pack_elle_mop_mats(at_least=...)``."""
+    from jepsen_tpu.checkers.elle import ElleMopsMeta, pack_elle_mop_mats
+
+    mats = [mats_metas[i][0] for i in live]
+    metas = [mats_metas[i][1] for i in live]
+    pad = b_live - len(mats)
+    mats += [np.zeros((0, 8), np.int32)] * pad
+    metas += [
+        ElleMopsMeta(n_txns=0, txn_index=[], keys=[], degenerate=False)
+    ] * pad
+    return pack_elle_mop_mats(
+        mats, metas, n_txns=n_txns, to_device=False, at_least=tuple(at_least)
+    )
+
+
+# ---------------------------------------------------------------------------
 # Family pipelines: produce / place / check / convert per family.
 # ---------------------------------------------------------------------------
 

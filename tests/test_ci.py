@@ -582,6 +582,39 @@ class TestBenchScaleOutSmoke:
         # the virtual mesh the conftest pins: all 8 devices fed
         assert ns["devices"] == 8 and ns["lanes"] == 8
 
+    def test_north_star_100k_section_schema_and_gm_smoke(self, bench):
+        """The ISSUE-18 bench gate AND the offline 2-process global-mesh
+        smoke in one: a tiny config through the REAL
+        ``_bench_north_star_100k`` section spawns both fleet sizes (1
+        and 2 processes joined into one ``jax.distributed`` mesh under
+        ``JAX_PLATFORMS=cpu``), so a schema regression or a broken
+        cross-process collective path fails here, not on a chip
+        window."""
+        details = {}
+        bench._bench_north_star_100k(
+            details, histories=16, base_n=8, n_ops=40, chunk=8,
+            timeout_s=420,
+        )
+        ns = details["north_star_100k"]
+        for key in (
+            "histories",
+            "rows",
+            "verdicts_match",
+            "scaling_2proc_vs_1",
+            "host_cores",
+            "scaling_note",
+            "collectives",
+        ):
+            assert key in ns, f"north_star_100k schema lost key {key!r}"
+        assert ns["histories"] == 16
+        assert [r["procs"] for r in ns["rows"]] == [1, 2]
+        assert all(r["wall_s"] > 0 for r in ns["rows"])
+        assert all(r["dead_workers"] == 0 for r in ns["rows"])
+        # the acceptance criterion in miniature: the 2-proc global mesh
+        # reproduces the 1-proc verdict exactly
+        assert ns["verdicts_match"] is True
+        assert ns["scaling_2proc_vs_1"] > 0
+
     def test_scaling_section_schema(self, bench):
         details = {}
         bench._bench_scaling(

@@ -203,3 +203,187 @@ def test_dead_worker_fail_fast_aborts_with_no_partial_verdicts(tmp_path):
             )
     finally:
         del os.environ["JEPSEN_TPU_DIST_DIE_PID"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 18: the TRUE global mesh — N processes joined into ONE
+# jax.distributed mesh running the SAME collective verdict program, with
+# collectives (gloo on CPU) crossing the host boundary.  Each process
+# stages its own input lane and feeds its local shard; the launcher's
+# generation-elastic story covers worker death mid-collective.
+# ---------------------------------------------------------------------------
+
+
+def _queue_flags(serial):
+    return [
+        not (r["queue"]["valid?"] is True and r["linear"]["valid?"] is True)
+        for r in serial
+    ]
+
+
+@pytest.mark.parametrize(
+    "workload,n_procs,devices_per_proc,seq",
+    [("queue", 2, 1, 1), ("elle", 2, 2, 2)],
+    ids=["queue-2proc-lanes", "elle-2proc-seq2-packed-closure"],
+)
+def test_global_mesh_matches_serial_oracle(
+    tmp_path, workload, n_procs, devices_per_proc, seq
+):
+    """The tentpole differential: the reduced verdict computed by TWO
+    cooperating processes on one global mesh must equal the serial
+    oracle.  The elle seq=2 case lowers the packed multi-chip closure
+    with its plane axis split ACROSS the process boundary (all_gather /
+    psum through gloo) — the composition the per-process harness could
+    never express."""
+    from jepsen_tpu.history.synth import ElleSynthSpec, synth_elle_batch
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    if workload == "queue":
+        base = synth_batch(
+            9, SynthSpec(n_ops=40, seed=7), lost=1, duplicated=1
+        )
+    else:
+        base = synth_elle_batch(
+            6, ElleSynthSpec(n_txns=24, seed=3), g2_cycle=1
+        ) + synth_elle_batch(3, ElleSynthSpec(n_txns=24, seed=11))
+    files = _write(tmp_path, base)
+    serial, _ = check_sources(workload, files, chunk=4, serial=True)
+    if workload == "queue":
+        flags = _queue_flags(serial)
+    else:
+        flags = [r["elle"]["valid?"] is not True for r in serial]
+
+    verdict, info = run_multiprocess_check(
+        workload, files, n_procs,
+        devices_per_proc=devices_per_proc, chunk=4, reduce=True,
+        global_mesh=True, seq=seq, timeout_s=420,
+    )
+    assert verdict["histories"] == len(files)
+    assert verdict["invalid"] == sum(flags)
+    assert verdict["first_invalid"] == (
+        flags.index(True) if any(flags) else -1
+    )
+    assert info["global_mesh"] is True
+    deg = info["degraded"]
+    assert deg["dead_workers"] == [] and deg["generations"] == 1
+    assert deg["quarantined_histories"] == 0
+
+
+def test_global_mesh_elle_degenerate_splice_at_lane_boundary(tmp_path):
+    """A degenerate elle history (host-oracle fallback) placed EXACTLY
+    at the lane boundary — the first index of lane 1's block, which is
+    also a device-shard boundary of the global batch — must fold its
+    host verdict into the collective reduction on the process that owns
+    it, and the merged verdict must still equal the serial oracle."""
+    from test_fuzz_elle_device import fuzz_history
+
+    from jepsen_tpu.checkers.elle import elle_mops_for
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    class _SH:
+        def __init__(self, ops):
+            self.ops = ops
+
+    pool = [fuzz_history(seed, n_txns=10) for seed in range(24)]
+    degen = [ops for ops in pool if elle_mops_for(ops)[1].degenerate]
+    live = [ops for ops in pool if not elle_mops_for(ops)[1].degenerate]
+    assert degen and len(live) >= 5
+    # 6 sources, chunk=8 → one chunk, 2 lanes of b_l=3: index 3 is the
+    # first row of lane 1's block (the shard boundary)
+    base = [_SH(o) for o in (live[:3] + [degen[0]] + live[3:5])]
+    files = _write(tmp_path, base, tag="e")
+    serial, _ = check_sources("elle", files, chunk=8, serial=True)
+    flags = [r["elle"]["valid?"] is not True for r in serial]
+    verdict, info = run_multiprocess_check(
+        "elle", files, 2, devices_per_proc=1, chunk=8, reduce=True,
+        global_mesh=True, timeout_s=420,
+    )
+    assert verdict["histories"] == len(files)
+    assert verdict["invalid"] == sum(flags)
+    assert verdict["first_invalid"] == (
+        flags.index(True) if any(flags) else -1
+    )
+
+
+def test_global_mesh_dead_worker_generation_respawn(tmp_path):
+    """Host death mid-run on the GLOBAL mesh: worker 1 of 2 dies, which
+    wedges the survivor inside collectives — the launcher kills the
+    generation, respawns a 1-process fleet on a fresh coordinator,
+    skips the ledgered stripe, and the final verdict equals the
+    no-fault oracle with the degradation named in the provenance."""
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    base = synth_batch(8, SynthSpec(n_ops=30, seed=5), lost=1)
+    files = _write(tmp_path, base)
+    serial, _ = check_sources("queue", files, chunk=4, serial=True)
+    flags = _queue_flags(serial)
+    os.environ["JEPSEN_TPU_DIST_DIE_PID"] = "1"
+    try:
+        verdict, info = run_multiprocess_check(
+            "queue", files, 2, devices_per_proc=1, chunk=4, reduce=True,
+            global_mesh=True, timeout_s=420,
+        )
+    finally:
+        del os.environ["JEPSEN_TPU_DIST_DIE_PID"]
+    deg = info["degraded"]
+    assert deg["dead_workers"] == [1]
+    assert deg["generations"] >= 2
+    assert deg["final_procs"] == 1
+    assert deg["requeued_stripes"] and not deg["quarantined_stripes"]
+    assert deg["quarantined_histories"] == 0
+    assert verdict["histories"] == len(files)
+    assert verdict["invalid"] == sum(flags)
+    assert verdict["first_invalid"] == (
+        flags.index(True) if any(flags) else -1
+    )
+
+
+def test_global_mesh_rejects_bad_configs(tmp_path):
+    """Loud validation: global-mesh mode requires the collective
+    reduction, a workload with a wired collective program, and a seq
+    axis that divides across the fleet."""
+    base = synth_batch(4, SynthSpec(n_ops=20, seed=5))
+    files = _write(tmp_path, base)
+    with pytest.raises(ValueError, match="reduce"):
+        run_multiprocess_check(
+            "queue", files, 2, global_mesh=True, reduce=False
+        )
+    with pytest.raises(ValueError, match="workload"):
+        run_multiprocess_check(
+            "stream", files, 2, global_mesh=True, reduce=True
+        )
+    with pytest.raises(ValueError, match="multiple"):
+        run_multiprocess_check(
+            "queue", files, 2, global_mesh=True, reduce=True, seq=3
+        )
+    with pytest.raises(ValueError, match="seq"):
+        run_multiprocess_check(
+            "queue", files, 2, devices_per_proc=1, global_mesh=True,
+            reduce=True, seq=4,
+        )
+
+
+def test_relative_source_paths_resolve_in_workers(tmp_path, monkeypatch):
+    """Workers run with cwd=repo, so a caller's RELATIVE store paths
+    (the CLI invoked from inside a store tree) must be anchored to the
+    launcher's cwd before they enter the manifest — in both the
+    elastic and global-mesh modes.  Pre-fix the elastic run silently
+    quarantined everything to unknown and the global mesh crashed."""
+    from jepsen_tpu.parallel.pipeline import check_sources
+
+    base = synth_batch(4, SynthSpec(n_ops=30, seed=11), lost=1)
+    files = _write(tmp_path, base)
+    serial, _ = check_sources("queue", files, chunk=2, serial=True)
+    flags = _queue_flags(serial)
+    monkeypatch.chdir(tmp_path)
+    rel = sorted(
+        os.path.join(".", f) for f in os.listdir(".") if f.endswith(".jsonl")
+    )
+    for mode_kw in ({"mesh": True}, {"global_mesh": True}):
+        verdict, info = run_multiprocess_check(
+            "queue", rel, 2, chunk=2, reduce=True, timeout_s=300,
+            **mode_kw,
+        )
+        assert verdict["histories"] == len(base)
+        assert verdict.get("quarantined", 0) == 0
+        assert verdict["invalid"] == flags.count(True), mode_kw

@@ -36,12 +36,24 @@ def test_capture_runs_all_families_on_virtual_mesh(tmp_path):
     assert out["mesh"] == {"hist": 4, "seq": 2}
     assert set(out["families"]) == {
         "queue", "stream", "elle", "mutex", "pipeline_scaleout",
+        "global_mesh",
     }
     for fam, row in out["families"].items():
-        if fam == "pipeline_scaleout":
-            continue  # scale-out schema asserted below
+        if fam in ("pipeline_scaleout", "global_mesh"):
+            continue  # their schemas are asserted below
         assert row["valid_all"] is True, (fam, row)
         assert row["steady_run_ms"] > 0
+    # the ISSUE-18 closure provenance: on the seq=2 virtual mesh the
+    # packed multi-chip path must LOWER, not fall back
+    assert out["families"]["elle"]["closure"] == "packed-sharded"
+    assert out["families"]["elle"]["dense_fallbacks"] == 0
+    # the armed global-mesh arm: a real 2-process fleet on one
+    # jax.distributed mesh, outcome recorded either way — on the
+    # virtual CPU mesh it must succeed cleanly
+    gm = out["families"]["global_mesh"]
+    assert gm["ok"] is True, gm
+    assert gm["procs"] == 2 and gm["verdict"]["histories"] > 0
+    assert gm["degraded"]["dead_workers"] == []
     # the armed scale-out harness: meshed multi-lane bytes-to-verdict
     # with the collective reduction, per family
     so = out["families"]["pipeline_scaleout"]

@@ -593,6 +593,209 @@ def run_campaign_chaos(args, log, check) -> dict:
     return {"campaign": result}
 
 
+def run_global_mesh_chaos(args, log, check) -> dict:
+    """ISSUE-18 mode: the nemesis pointed at the GLOBAL-MESH fleet —
+    N processes joined into ONE ``jax.distributed`` mesh running the
+    collective verdict program, a worker SIGKILLed (or wedged, or the
+    deterministic die-between-stripes hook) MID-CLOSURE.  A dead member
+    wedges the survivors inside collectives, so the proof is the
+    generation story: the launcher kills the generation, respawns N-1
+    on a fresh coordinator, skips ledgered stripes, and the final
+    reduced verdict must equal the elastic single-process oracle (an
+    independent execution path: per-process mesh, no cross-host
+    collectives) — or quarantine loudly, never fabricate."""
+    from jepsen_tpu.history.store import write_history_jsonl
+    from jepsen_tpu.history.synth import (
+        ElleSynthSpec, SynthSpec, synth_batch, synth_elle_batch,
+    )
+    from jepsen_tpu.parallel.distributed import (
+        degraded_active, run_multiprocess_check,
+    )
+
+    corpus = Path(args.corpus_dir)
+    corpus.mkdir(parents=True, exist_ok=True)
+    if args.workload == "elle":
+        base = synth_elle_batch(
+            max(1, args.base - 2),
+            ElleSynthSpec(n_txns=args.ops, seed=args.seed), g2_cycle=1,
+        ) + synth_elle_batch(
+            2, ElleSynthSpec(n_txns=args.ops, seed=args.seed + 1)
+        )
+    else:
+        base = synth_batch(
+            args.base, SynthSpec(n_ops=args.ops, seed=args.seed),
+            lost=1, duplicated=1,
+        )
+    files = []
+    for i, sh in enumerate(base):
+        p = corpus / f"h{i:04d}.jsonl"
+        write_history_jsonl(p, sh.ops)
+        files.append(str(p))
+    srcs = (files * ((args.histories + len(files) - 1) // len(files)))[
+        : args.histories
+    ]
+    unit = "txns" if args.workload == "elle" else "ops"
+    log(
+        f"global-mesh corpus: {len(srcs)} sources ({len(files)} real "
+        f"files x {args.ops} {unit}), workload={args.workload} "
+        f"seq={args.gm_seq}"
+    )
+
+    def vkeys(v):
+        return {k: v[k] for k in ("histories", "invalid", "first_invalid")}
+
+    # 1. the oracle: the ELASTIC single-process meshed reduction — an
+    # independent execution path (per-process mesh, no cross-host
+    # collectives) already differentially pinned to serial in tests
+    t0 = time.perf_counter()
+    oracle, _oinfo = run_multiprocess_check(
+        args.workload, srcs, 1, devices_per_proc=args.devices_per_proc,
+        chunk=args.chunk, mesh=True, reduce=True, timeout_s=args.timeout,
+    )
+    log(
+        f"oracle (elastic 1-proc reduced): {vkeys(oracle)} in "
+        f"{time.perf_counter() - t0:.1f}s"
+    )
+
+    # 2. the no-kill honesty row: the global mesh must agree BEFORE any
+    # chaos and report a clean provenance
+    t0 = time.perf_counter()
+    clean, cinfo = run_multiprocess_check(
+        args.workload, srcs, args.procs,
+        devices_per_proc=args.devices_per_proc, chunk=args.chunk,
+        reduce=True, global_mesh=True, seq=args.gm_seq,
+        timeout_s=args.timeout,
+    )
+    nokill_wall = time.perf_counter() - t0
+    log(
+        f"no-kill global mesh ({args.procs} procs): {vkeys(clean)} in "
+        f"{nokill_wall:.1f}s"
+    )
+    check(
+        vkeys(clean) == vkeys(oracle),
+        f"no-kill global-mesh verdict == elastic oracle ({vkeys(clean)})",
+    )
+    check(
+        not degraded_active(cinfo["degraded"]),
+        "no-kill run reports a clean degraded provenance",
+    )
+
+    # 3. kill --kill of --procs mid-closure (first generation only —
+    # the respawned generation must be left alone to finish)
+    state: dict = {"signalled": []}
+    hook = None
+    if args.mode == "die-env":
+        os.environ["JEPSEN_TPU_DIST_DIE_PID"] = ",".join(
+            str(q) for q in range(1, 1 + args.kill)
+        )
+        log(
+            "nemesis: die-between-stripes hook armed for pid(s) "
+            f"{os.environ['JEPSEN_TPU_DIST_DIE_PID']}"
+        )
+    else:
+        sig = signal.SIGKILL if args.mode == "sigkill" else signal.SIGSTOP
+        kill_after = min(args.kill_after, max(0.3, 0.45 * nokill_wall))
+        if kill_after < args.kill_after:
+            log(
+                f"nemesis: --kill-after {args.kill_after:.1f}s would "
+                f"outlive the {nokill_wall:.1f}s run — scaled to "
+                f"{kill_after:.2f}s"
+            )
+        fired = {"done": False}
+
+        def hook(procs):
+            if fired["done"]:
+                return
+            fired["done"] = True
+
+            def nemesis():
+                time.sleep(kill_after)
+                for pid in range(1, 1 + args.kill):
+                    if pid < len(procs) and procs[pid].poll() is None:
+                        log(
+                            f"nemesis: {args.mode.upper()} worker {pid} "
+                            f"(os pid {procs[pid].pid}) at "
+                            f"t+{kill_after:.2f}s — mid-closure"
+                        )
+                        try:
+                            procs[pid].send_signal(sig)
+                            state["signalled"].append(pid)
+                        except OSError as e:
+                            log(f"nemesis: signal failed for {pid}: {e}")
+
+            threading.Thread(target=nemesis, daemon=True).start()
+
+    t0 = time.perf_counter()
+    try:
+        results, info = run_multiprocess_check(
+            args.workload, srcs, args.procs,
+            devices_per_proc=args.devices_per_proc, chunk=args.chunk,
+            reduce=True, global_mesh=True, seq=args.gm_seq,
+            timeout_s=args.timeout,
+            stripe_timeout_s=(
+                args.stripe_timeout if args.mode == "sigstop" else None
+            ),
+            _proc_hook=hook,
+        )
+    finally:
+        os.environ.pop("JEPSEN_TPU_DIST_DIE_PID", None)
+    wall = time.perf_counter() - t0
+    deg = info["degraded"]
+    log(
+        f"chaos global mesh: {vkeys(results)} in {wall:.1f}s; "
+        f"degraded={deg}"
+    )
+
+    if args.mode == "sigstop":
+        check(
+            deg["wedged_killed"] >= 1,
+            f"wedged generation killed by the stripe deadline "
+            f"(wedged_killed={deg['wedged_killed']})",
+        )
+    else:
+        check(
+            len(deg["dead_workers"]) >= 1,
+            f"provenance names the dead worker(s): {deg['dead_workers']}",
+        )
+        check(
+            deg["final_procs"] < args.procs,
+            f"fleet shrank after the death "
+            f"(final_procs={deg['final_procs']})",
+        )
+    check(
+        deg["generations"] >= 2,
+        f"the death forced a generation respawn "
+        f"(generations={deg['generations']})",
+    )
+    check(
+        results["histories"] + deg["quarantined_histories"]
+        == oracle["histories"],
+        "every history accounted for: verdict + quarantined == corpus",
+    )
+    if deg["quarantined_histories"] == 0:
+        check(
+            vkeys(results) == vkeys(oracle),
+            f"post-chaos verdict == elastic oracle ({vkeys(results)})",
+        )
+    else:
+        log(
+            f"note: {deg['quarantined_histories']} histories "
+            f"quarantined after retries — verdict covers the remainder"
+        )
+    return {
+        "oracle": vkeys(oracle),
+        "nokill": {
+            "verdict": vkeys(clean),
+            "wall_s": round(nokill_wall, 2),
+        },
+        "chaos": {
+            "verdict": vkeys(results),
+            "wall_s": round(wall, 2),
+            "degraded": deg,
+        },
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -612,7 +815,7 @@ def main(argv=None) -> int:
     p.add_argument("--base", type=int, default=16,
                    help="distinct synthesized history files")
     p.add_argument("--ops", type=int, default=60)
-    p.add_argument("--workload", choices=("stream", "queue"),
+    p.add_argument("--workload", choices=("stream", "queue", "elle"),
                    default="stream")
     p.add_argument("--poison", type=int, default=0,
                    help="torn-JSON poison histories spliced mid-corpus")
@@ -677,7 +880,23 @@ def main(argv=None) -> int:
                    "service subprocess (tools/soak.py --live-stream)")
     p.add_argument("--campaign-live-minutes", type=float, default=0.2,
                    help="--campaign-live: soak duration in minutes")
+    p.add_argument("--global-mesh", action="store_true",
+                   help="ISSUE-18 mode: chaos against the GLOBAL-MESH "
+                   "fleet — N processes joined into one "
+                   "jax.distributed mesh running the collective "
+                   "verdict program, --kill of them SIGKILLed "
+                   "mid-closure (or wedged under --mode sigstop, or "
+                   "the deterministic die-between-stripes hook under "
+                   "--mode die-env); proves the generation respawn "
+                   "reaches the elastic oracle's verdict")
+    p.add_argument("--gm-seq", type=int, default=1,
+                   help="--global-mesh: sequence-axis width of the "
+                   "global mesh (must divide into --procs x "
+                   "--devices-per-proc; seq>1 shards the packed "
+                   "closure's plane axis across hosts)")
     args = p.parse_args(argv)
+    if args.workload == "elle" and not args.global_mesh:
+        p.error("--workload elle is wired for --global-mesh mode")
     if (not (args.segmented or args.serve or args.campaign)
             and args.kill >= args.procs):
         p.error("--kill must leave at least one survivor (< --procs)")
@@ -812,6 +1031,51 @@ def main(argv=None) -> int:
                 "wall_s": round(time.perf_counter() - t0, 2),
                 "failures": failures,
             }
+            (out_dir / "results.json").write_text(
+                json.dumps(doc, indent=1, default=_json_default) + "\n"
+            )
+            log(f"artifacts: {out_dir}/results.json + chaos_check.log")
+        if failures:
+            log(f"CHAOS FAIL ({len(failures)} failed assertions)")
+            return 1
+        log("CHAOS PASS")
+        return 0
+
+    if args.global_mesh:
+        failures: list[str] = []
+
+        def gcheck(cond: bool, msg: str) -> None:
+            if cond:
+                log(f"PASS  {msg}")
+            else:
+                failures.append(msg)
+                log(f"FAIL  {msg}")
+
+        t0 = time.perf_counter()
+        tmp_ctx = (
+            tempfile.TemporaryDirectory(prefix="jt_gmchaos_")
+            if args.corpus_dir is None
+            else None
+        )
+        if tmp_ctx is not None:
+            args.corpus_dir = tmp_ctx.name
+        try:
+            arms = run_global_mesh_chaos(args, log, gcheck)
+        finally:
+            if tmp_ctx is not None:
+                tmp_ctx.cleanup()
+        if out_dir is not None:
+            doc = {
+                "tool": "chaos_check --global-mesh",
+                "pass": not failures,
+                "config": {
+                    k: v for k, v in vars(args).items() if k != "out"
+                },
+                "wall_s": round(time.perf_counter() - t0, 2),
+                "failures": failures,
+                **arms,
+            }
+            out_dir.mkdir(parents=True, exist_ok=True)
             (out_dir / "results.json").write_text(
                 json.dumps(doc, indent=1, default=_json_default) + "\n"
             )

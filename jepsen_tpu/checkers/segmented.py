@@ -1396,11 +1396,21 @@ def load_checkpoint_chain(path: Path) -> tuple[dict | None, list[str]]:
 
 
 def clear_checkpoints(path: Path) -> None:
+    """Remove a check's local checkpoint, its ``.prev`` rotation, and
+    any stale ``.tmp`` leftovers from a crashed writer.  Fleet prefix-
+    index entries are NOT touched: those are keyed by content hash
+    (``history/prefix_index.py``), so a leftover can never be matched
+    against a different source that merely shares a basename."""
     for p in (path, path.with_name(path.name + ".prev")):
         try:
             p.unlink()
         except OSError:
             pass
+    try:
+        for p in path.parent.glob(path.name + ".*.tmp"):
+            p.unlink()
+    except OSError:
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -1430,6 +1440,28 @@ def _peek_workload(path: Path, n: int = 256) -> str:
     return workload_of(ops)
 
 
+def _coerce_prefix_index(prefix_index: Any):
+    """A path-ish value becomes a :class:`PrefixCheckpointIndex`; an
+    index instance (anything with publish/lookup) passes through."""
+    if prefix_index is None:
+        return None
+    if hasattr(prefix_index, "lookup") and hasattr(prefix_index, "publish"):
+        return prefix_index
+    from jepsen_tpu.history.prefix_index import PrefixCheckpointIndex
+
+    return PrefixCheckpointIndex(prefix_index)
+
+
+def _publish_quiet(pindex, doc: dict) -> None:
+    """Index publication must never sink a check: the local checkpoint
+    is already durable; a failed fleet publish costs future reuse, not
+    this verdict."""
+    try:
+        pindex.publish(doc)
+    except Exception as e:  # noqa: BLE001 - reuse is best-effort
+        logger.warning("prefix index publish failed: %s", e)
+
+
 def segmented_check_file(
     src: str | Path,
     workload: str | None = None,
@@ -1441,6 +1473,7 @@ def segmented_check_file(
     carry_cap: int | None = None,
     keep_checkpoint: bool = False,
     checkpoint: bool = True,
+    prefix_index: Any = None,
 ) -> dict[str, Any]:
     """Check one recorded history through the segmented engine:
     bounded memory, durable per-segment checkpoints, resume.
@@ -1451,6 +1484,17 @@ def segmented_check_file(
     the identical verdict (``tools/chaos_check.py --segmented``).
     A successful complete check removes its checkpoints unless
     ``keep_checkpoint``.
+
+    ``prefix_index`` (a directory path or a
+    :class:`~jepsen_tpu.history.prefix_index.PrefixCheckpointIndex`)
+    turns on **fleet prefix resume** (SEGMENTED.md §Prefix resume):
+    every checkpoint is also published under its content anchor, and a
+    history sharing a verified prefix with anything already published
+    resumes from the deepest matching anchor — verdict provably ≡ the
+    from-zero check (``tests/test_fleet_memory.py``), with the anchor
+    served recorded in ``result["segmented"]["resumed_from_prefix"]``.
+    A valid *local* checkpoint (``resume=True``) wins over the fleet
+    index: it is at least as deep for the same source.
     """
     from jepsen_tpu.obs import trace as obs_trace
     from jepsen_tpu.obs.metrics import REGISTRY
@@ -1460,6 +1504,7 @@ def segmented_check_file(
     if workload in (None, "auto"):
         workload = _peek_workload(src)
     opts = dict(opts or {})
+    pindex = _coerce_prefix_index(prefix_index)
 
     if workload == "queue":
         # the zero-parse path: queue-family segments served straight
@@ -1471,11 +1516,13 @@ def segmented_check_file(
                 src, rows, segment_ops=segment_ops, opts=opts,
                 resume=resume, cpath=cpath, device=device,
                 keep_checkpoint=keep_checkpoint, checkpoint=checkpoint,
+                pindex=pindex,
             )
 
     engine: SegmentedChecker | None = None
     start_segment = 0
     expect_sha = expect_bytes = None
+    prefix_prov: dict | None = None
     refusals: list[str] = []
     if resume:
         doc, refusals = load_checkpoint_chain(cpath)
@@ -1507,6 +1554,28 @@ def segmented_check_file(
                 expect_sha = doc["source_sha256"]
                 expect_bytes = int(doc["source_bytes"])
                 REGISTRY.counter("segmented.resumes").inc()
+    if engine is None and pindex is not None:
+        # fleet prefix resume: the deepest published anchor whose
+        # (offset, sha256) matches THIS file's own bytes — a divergent
+        # byte before an anchor simply unmatches it, so the shallower
+        # matching anchor serves instead (never a stale carry)
+        t_lk = time.perf_counter()
+        hit = pindex.lookup(
+            src, workload=workload, segment_ops=segment_ops, opts=opts
+        )
+        REGISTRY.sketch("prefix_index.lookup_s").add(
+            time.perf_counter() - t_lk
+        )
+        if hit is not None:
+            engine = SegmentedChecker.from_state(
+                hit.doc["state"], device=device
+            )
+            engine.resumed_from = int(hit.doc["segment_idx"])
+            start_segment = engine.resumed_from + 1
+            expect_sha = hit.sha256
+            expect_bytes = hit.offset
+            prefix_prov = hit.provenance()
+            REGISTRY.counter("segmented.prefix_resumes").inc()
     if engine is None:
         engine = SegmentedChecker(
             workload, opts=opts, device=device, carry_cap=carry_cap
@@ -1547,22 +1616,25 @@ def segmented_check_file(
         sketch.add(time.perf_counter() - t0)
         seg_counter.inc()
         if checkpoint and (seg.ops or not seg.final):
-            write_checkpoint(
-                cpath,
-                {
-                    "format": CKPT_FORMAT,
-                    "substrate": "jsonl",
-                    "workload": workload,
-                    "segment_ops": segment_ops,
-                    "segment_idx": seg.idx,
-                    "source": src.name,
-                    "source_bytes": seg.byte_end,
-                    "source_sha256": seg.sha256,
-                    "opts": opts,
-                    "partial": _partial_summary(engine),
-                    "state": engine.state(),
-                },
-            )
+            doc = {
+                "format": CKPT_FORMAT,
+                "substrate": "jsonl",
+                "workload": workload,
+                "segment_ops": segment_ops,
+                "segment_idx": seg.idx,
+                "source": src.name,
+                "source_bytes": seg.byte_end,
+                "source_sha256": seg.sha256,
+                "opts": opts,
+                "partial": _partial_summary(engine),
+                "state": engine.state(),
+            }
+            write_checkpoint(cpath, doc)
+            # fleet anchors only at FULL segment boundaries: a parent's
+            # final short segment refills in an extended file, so its
+            # anchor would misalign every later segment index
+            if pindex is not None and len(seg.ops) == segment_ops:
+                _publish_quiet(pindex, doc)
             if die_after is not None and seg.idx >= die_after:
                 logger.error(
                     "segmented check: %s=%d hook firing after segment "
@@ -1577,6 +1649,8 @@ def segmented_check_file(
     result["segmented"]["segment_ops"] = segment_ops
     result["segmented"]["source"] = str(src)
     result["segmented"]["substrate"] = "jsonl"
+    if prefix_prov is not None:
+        result["segmented"]["resumed_from_prefix"] = prefix_prov
     if refusals:
         result["segmented"]["checkpoints_refused"] = refusals
         REGISTRY.counter("segmented.ckpt_refused").inc(len(refusals))
@@ -1615,13 +1689,17 @@ def _segmented_check_rows(
     device: bool,
     keep_checkpoint: bool,
     checkpoint: bool,
+    pindex: Any = None,
 ) -> dict[str, Any]:
     """The ``.jtc`` segment producer: fixed-count op segments are
     ``searchsorted`` slices of the mmap'd row matrix (column 0 = the
     recorder-assigned op index, monotone), fed to the queue carry with
-    no parse and no ``Op`` objects.  The checkpoint anchors on the
-    WHOLE source digest (the substrate is already stamped against the
-    source bytes; prefix offsets are a JSONL-stream concept)."""
+    no parse and no ``Op`` objects.  The *local* checkpoint anchors on
+    the WHOLE source digest (the substrate is already stamped against
+    the source bytes); the *fleet* anchor is the row prefix —
+    ``(prefix_rows, sha256 of the first prefix_rows rows)`` — so
+    shrink candidates re-packed to ``.jtc`` share anchors exactly
+    where their sources share op prefixes."""
     from jepsen_tpu.obs import trace as obs_trace
     from jepsen_tpu.obs.metrics import REGISTRY
 
@@ -1632,6 +1710,7 @@ def _segmented_check_rows(
 
     engine: SegmentedChecker | None = None
     start_segment = 0
+    prefix_prov: dict | None = None
     refusals: list[str] = []
     if resume:
         doc, refusals = load_checkpoint_chain(cpath)
@@ -1658,6 +1737,22 @@ def _segmented_check_rows(
                 engine.resumed_from = int(doc["segment_idx"])
                 start_segment = engine.resumed_from + 1
                 REGISTRY.counter("segmented.resumes").inc()
+    if engine is None and pindex is not None:
+        t_lk = time.perf_counter()
+        hit = pindex.lookup_rows(
+            rows, workload="queue", segment_ops=segment_ops, opts=opts
+        )
+        REGISTRY.sketch("prefix_index.lookup_s").add(
+            time.perf_counter() - t_lk
+        )
+        if hit is not None:
+            engine = SegmentedChecker.from_state(
+                hit.doc["state"], device=device
+            )
+            engine.resumed_from = int(hit.doc["segment_idx"])
+            start_segment = engine.resumed_from + 1
+            prefix_prov = hit.provenance()
+            REGISTRY.counter("segmented.prefix_resumes").inc()
     if engine is None:
         engine = SegmentedChecker("queue", opts=opts, device=device)
 
@@ -1665,6 +1760,12 @@ def _segmented_check_rows(
     die_after = int(die_after) if die_after else None
     sketch = REGISTRY.sketch("segmented.segment_check_s")
     seg_counter = REGISTRY.counter("segmented.segments")
+    # the fleet anchor's running row-prefix hasher: rebuilt over the
+    # skipped prefix on any resume so published anchors stay exact
+    row_hash = hashlib.sha256()
+    if start_segment:
+        hi0 = int(np.searchsorted(idx_col, start_segment * segment_ops))
+        row_hash.update(np.ascontiguousarray(rows[:hi0]).tobytes())
     for k in range(start_segment, n_segments):
         t0 = time.perf_counter()
         lo = int(np.searchsorted(idx_col, k * segment_ops))
@@ -1682,23 +1783,26 @@ def _segmented_check_rows(
             engine.feed_rows(rows[lo:hi], n_ops)
         sketch.add(time.perf_counter() - t0)
         seg_counter.inc()
+        row_hash.update(np.ascontiguousarray(rows[lo:hi]).tobytes())
         if checkpoint:
-            write_checkpoint(
-                cpath,
-                {
-                    "format": CKPT_FORMAT,
-                    "substrate": "jtc",
-                    "workload": "queue",
-                    "segment_ops": segment_ops,
-                    "segment_idx": k,
-                    "source": src.name,
-                    "source_bytes": src.stat().st_size,
-                    "source_sha256": digest,
-                    "opts": opts,
-                    "partial": _partial_summary(engine),
-                    "state": engine.state(),
-                },
-            )
+            doc = {
+                "format": CKPT_FORMAT,
+                "substrate": "jtc",
+                "workload": "queue",
+                "segment_ops": segment_ops,
+                "segment_idx": k,
+                "source": src.name,
+                "source_bytes": src.stat().st_size,
+                "source_sha256": digest,
+                "prefix_rows": hi,
+                "prefix_sha256": row_hash.hexdigest(),
+                "opts": opts,
+                "partial": _partial_summary(engine),
+                "state": engine.state(),
+            }
+            write_checkpoint(cpath, doc)
+            if pindex is not None and n_ops == segment_ops:
+                _publish_quiet(pindex, doc)
             if die_after is not None and k >= die_after:
                 logger.error(
                     "segmented check: %s=%d hook firing after segment "
@@ -1711,6 +1815,8 @@ def _segmented_check_rows(
     result["segmented"]["segment_ops"] = segment_ops
     result["segmented"]["source"] = str(src)
     result["segmented"]["substrate"] = "jtc"
+    if prefix_prov is not None:
+        result["segmented"]["resumed_from_prefix"] = prefix_prov
     if refusals:
         result["segmented"]["checkpoints_refused"] = refusals
         REGISTRY.counter("segmented.ckpt_refused").inc(len(refusals))

@@ -508,6 +508,7 @@ def _cmd_check_segmented(args, hpath: Path, out_dir: Path) -> int:
         resume=getattr(args, "resume", False),
         carry_cap=getattr(args, "carry_cap", None),
         device=args.checker == "tpu",
+        prefix_index=getattr(args, "prefix_index", None),
         **opts,
     )
     dt = time.perf_counter() - t0
@@ -519,6 +520,14 @@ def _cmd_check_segmented(args, hpath: Path, out_dir: Path) -> int:
         if meta.get("resumed")
         else ""
     )
+    pfx = meta.get("resumed_from_prefix")
+    if pfx:
+        print(
+            f"# fleet memory: resumed from prefix anchor @ segment "
+            f"{pfx['segment_idx']} (offset {pfx['offset']}, "
+            f"{pfx['substrate']})",
+            file=sys.stderr,
+        )
     print(
         f"# segmented check: {meta['ops']} ops in {meta['segments']} "
         f"segments of {meta['segment_ops']} in {dt:.2f} s "
@@ -1683,6 +1692,20 @@ def cmd_report(args) -> int:
         print(f"no runs under {root}", file=sys.stderr)
         return 2
     print(str(idx))
+    # fleet memory: the index pass refreshed <store>/baselines.json —
+    # surface its regression flags here so a terminal-only consumer
+    # sees the drift without opening index.html
+    try:
+        doc = json.loads((root / "baselines.json").read_text())
+        for f in doc.get("flags") or []:
+            print(
+                f"# REGRESSION: {f['series']} last={f.get('last')} "
+                f"baseline={f.get('baseline')} "
+                f"delta={f.get('delta_pct')}%",
+                file=sys.stderr,
+            )
+    except (OSError, ValueError):
+        pass
     return 0
 
 
@@ -1998,6 +2021,19 @@ def build_parser() -> argparse.ArgumentParser:
         "open-class carry; a class that outgrows the cap escalates "
         "the verdict to unknown with the class named (the PR-8 "
         "honesty rule — never a silent truncation)",
+    )
+    c.add_argument(
+        "--prefix-index",
+        dest="prefix_index",
+        default=None,
+        metavar="DIR",
+        help="with --segment-ops: fleet memory (SEGMENTED.md §Prefix "
+        "resume) — publish every full-segment checkpoint into a "
+        "content-hash-keyed index under DIR, and resume a "
+        "re-submitted history from the deepest anchor whose "
+        "(prefix sha256, offset) matches its bytes; the verdict is "
+        "identical to from-zero, with resumed_from_prefix provenance "
+        "in the result",
     )
     c.set_defaults(fn=cmd_check)
 

@@ -152,3 +152,33 @@ def minimize(
 
     stats.window_after = float(cfg.opts["time-limit"])
     return cfg, stats
+
+
+def minimize_recorded(
+    history_path,
+    workdir,
+    *,
+    workload: str | None = None,
+    segment_ops: int = 512,
+    opts: dict | None = None,
+    prefix_index=None,
+    confirm: int = 1,
+    log: Callable[[str], None] = lambda s: None,
+):
+    """Phase 3 of minimization, on the EVIDENCE instead of the config:
+    the shortest op prefix of a confirmed red's recorded history that
+    still checks invalid (``fuzz/replay.py``).  Unlike phases 1–2,
+    every probe here is a deterministic re-CHECK of recorded bytes —
+    no cluster, no flake — and with ``prefix_index`` set each probe
+    resumes from the deepest fleet checkpoint anchor it shares with
+    earlier probes (tail-trim candidates share their whole head by
+    construction), so a hundred-probe ddmin re-confirmation pays for
+    tails, not histories.  Returns
+    :class:`~jepsen_tpu.fuzz.replay.ReplayStats`."""
+    from jepsen_tpu.fuzz.replay import shrink_window
+
+    return shrink_window(
+        history_path, workdir, workload=workload,
+        segment_ops=segment_ops, opts=opts,
+        prefix_index=prefix_index, confirm=confirm, log=log,
+    )

@@ -1,0 +1,204 @@
+"""Recorded-candidate shrink replay: ddmin over the op window of a
+RECORDED red history, with every re-confirmation CHECK routed through
+fleet prefix-resume (SEGMENTED.md §Prefix resume).
+
+The live minimizer (``fuzz/minimize.py``) shrinks the *config* — every
+probe runs a fresh cluster, so its verification cost is the cluster's,
+not the checker's.  This module shrinks the *evidence*: given the
+recorded history of a confirmed red, find the shortest op **prefix**
+that still refutes, by checking candidate prefixes through the
+segmented engine.  Tail-trim candidates share their entire byte prefix
+with the parent — and with each other — *by construction*, so with a
+:class:`~jepsen_tpu.history.prefix_index.PrefixCheckpointIndex` each
+probe resumes from the deepest fleet anchor instead of op 0 and pays
+only for its unshared tail.  The campaign-replay speedup this buys is
+the ``bench.py fleet_memory`` section's headline (≥5× on the committed
+corpus, verdicts identical to the from-zero arm).
+
+Honesty rules carried over from the minimizer: a probe only counts as
+red when the check's verdict is *invalid* (``valid? is False``) —
+unknown/quarantined never shrinks the window; the returned window was
+**watched fail** on its own bytes, and the final candidate is
+re-confirmed ``confirm`` times.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from pathlib import Path
+from typing import Any, Callable
+
+#: families whose refutation decides "still red" for a recorded check
+_VERDICT_KEYS = ("queue", "linear", "stream", "elle", "mutex")
+
+
+@dataclasses.dataclass
+class ReplayProbe:
+    """One re-confirmation check of a candidate prefix."""
+
+    n_ops: int
+    red: bool
+    wall_s: float
+    resumed: bool  # served by a fleet prefix anchor?
+    resume_offset: int  # bytes of carry reused (0 when cold)
+    segments: int  # segments actually fed this probe
+
+
+@dataclasses.dataclass
+class ReplayStats:
+    probes: list[ReplayProbe] = dataclasses.field(default_factory=list)
+    wall_s: float = 0.0
+    min_red_ops: int | None = None
+    n_ops: int = 0
+
+    @property
+    def resumed_probes(self) -> int:
+        return sum(1 for p in self.probes if p.resumed)
+
+    def as_dict(self) -> dict[str, Any]:
+        return {
+            "n_ops": self.n_ops,
+            "min_red_ops": self.min_red_ops,
+            "probes": len(self.probes),
+            "resumed_probes": self.resumed_probes,
+            "wall_s": round(self.wall_s, 4),
+            "rows": [dataclasses.asdict(p) for p in self.probes],
+        }
+
+
+def write_prefix_ops(src: str | Path, out: str | Path, n_ops: int) -> int:
+    """The first ``n_ops`` JSONL lines of ``src``, byte-exact (the
+    candidate must share the parent's byte prefix for anchors to
+    match).  Returns ops actually written (≤ ``n_ops``)."""
+    written = 0
+    with open(src, "rb") as fh, open(out, "wb") as oh:
+        for line in fh:
+            if written >= n_ops:
+                break
+            oh.write(line)
+            written += 1
+    return written
+
+
+def is_invalid(result: dict[str, Any]) -> bool:
+    """Red ⇔ some checked family's verdict is *invalid* (False).
+    Unknown (quarantine, carry-cap escalation) is NOT red — the
+    shrink-window contract only ever returns evidence it watched
+    fail."""
+    for fam in _VERDICT_KEYS:
+        v = result.get(fam)
+        if isinstance(v, dict) and v.get("valid?") is False:
+            return True
+    return False
+
+
+def check_recorded(
+    path: str | Path,
+    *,
+    workload: str | None = None,
+    segment_ops: int = 512,
+    opts: dict | None = None,
+    prefix_index: Any = None,
+    device: bool = False,
+) -> dict[str, Any]:
+    """One segmented check of a recorded candidate, fleet-aware when
+    ``prefix_index`` is given.  Checkpoints are kept OUT of the
+    candidate's directory contract by always clearing on success (the
+    default), while fleet anchors persist in the index."""
+    from jepsen_tpu.checkers.segmented import segmented_check_file
+
+    return segmented_check_file(
+        path, workload=workload, segment_ops=segment_ops,
+        opts=opts, device=device, prefix_index=prefix_index,
+    )
+
+
+def _probe(
+    parent: Path,
+    workdir: Path,
+    n_ops: int,
+    stats: ReplayStats,
+    *,
+    workload: str | None,
+    segment_ops: int,
+    opts: dict | None,
+    prefix_index: Any,
+    device: bool,
+    log: Callable[[str], None],
+) -> bool:
+    cand = workdir / f"cand_{n_ops}.jsonl"
+    write_prefix_ops(parent, cand, n_ops)
+    t0 = time.perf_counter()
+    r = check_recorded(
+        cand, workload=workload, segment_ops=segment_ops, opts=opts,
+        prefix_index=prefix_index, device=device,
+    )
+    dt = time.perf_counter() - t0
+    prov = r["segmented"].get("resumed_from_prefix")
+    red = is_invalid(r)
+    stats.probes.append(ReplayProbe(
+        n_ops=n_ops, red=red, wall_s=round(dt, 4),
+        resumed=prov is not None,
+        resume_offset=int(prov["offset"]) if prov else 0,
+        segments=int(r["segmented"]["segments"]),
+    ))
+    stats.wall_s += dt
+    log(
+        f"replay: prefix {n_ops} ops -> "
+        f"{'RED' if red else 'green'}"
+        + (f" (resumed @ {prov['offset']} B)" if prov else " (cold)")
+    )
+    return red
+
+
+def shrink_window(
+    src: str | Path,
+    workdir: str | Path,
+    *,
+    workload: str | None = None,
+    segment_ops: int = 512,
+    opts: dict | None = None,
+    prefix_index: Any = None,
+    device: bool = False,
+    confirm: int = 1,
+    log: Callable[[str], None] = lambda s: None,
+) -> ReplayStats:
+    """Shortest op prefix of recorded history ``src`` that still
+    checks invalid — bisection over prefix length (refutation by a
+    prefix is monotone in the prefix: every longer prefix contains the
+    same violating window), each probe a full segmented re-check, the
+    accepted minimum re-confirmed ``confirm`` times.  Raises
+    ``ValueError`` when the full history does not check invalid (there
+    is nothing to shrink — never "shrink" a green)."""
+    src = Path(src)
+    workdir = Path(workdir)
+    workdir.mkdir(parents=True, exist_ok=True)
+    n_total = sum(1 for _ in open(src, "rb"))
+    stats = ReplayStats(n_ops=n_total)
+
+    kw = dict(
+        workload=workload, segment_ops=segment_ops, opts=opts,
+        prefix_index=prefix_index, device=device, log=log,
+    )
+    if not _probe(src, workdir, n_total, stats, **kw):
+        raise ValueError(
+            f"{src}: full history checks green/unknown — refusing to "
+            f"shrink a non-red"
+        )
+    lo, hi = 1, n_total  # hi always red, lo-1 ... unknown, probe down
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if _probe(src, workdir, mid, stats, **kw):
+            hi = mid
+        else:
+            lo = mid + 1
+    for _ in range(max(0, confirm - 1)):
+        if not _probe(src, workdir, hi, stats, **kw):
+            raise ValueError(
+                f"{src}: minimal window {hi} went flaky on re-check — "
+                f"a deterministic re-check can only do this if the "
+                f"bytes changed under us"
+            )
+    stats.min_red_ops = hi
+    return stats

@@ -1,0 +1,452 @@
+"""Content-addressed section store: structural dedup for ``.jtc``
+substrates (COLUMNAR.md §Content-addressed sections).
+
+Shrink candidates and soak extensions share long op prefixes, so their
+packed substrates share long *row* prefixes — but as whole files they
+dedupe to nothing.  This store splits every section payload into
+**row-aligned chunks** (``DEFAULT_CHUNK_ROWS`` rows each), addresses
+each chunk by its sha256, and keeps one copy per distinct chunk under
+``<root>/objects/<aa>/<sha256>``.  A published file is replaced by a
+**manifest** (``<jtc>.casman.json``) recording the section table and
+each section's chunk list — enough to rebuild the original ``.jtc``
+**bit-exactly** (``materialize`` re-runs the same deterministic
+builder with the manifest's source stamp; pinned in
+``tests/test_fleet_memory.py``).
+
+Reference semantics are hardlinks: ``refs/<ref>/<seq>-<sha>`` links to
+the object, so an object's link count IS its refcount — ``st_nlink ==
+1`` means unreferenced and collectible.  ``tools/store_gc.py`` reports
+the dedup ratio honestly (logical bytes across manifests / unique
+object bytes on disk; 1.0 when nothing dedupes) and **refuses** to
+collect a referenced object, even when asked to.
+
+The verdict cache (``service/cache.py``) shares this storage:
+``content_key_from_manifest`` streams the chunk objects in section
+order to reproduce :meth:`Jtc.content_key` without materializing the
+file, so a CAS-deduped run still seeds cache hits
+(``report/index.py::run_content_refs``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import os
+import re
+import shutil
+from pathlib import Path
+from typing import Any, Iterable
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+#: conventional CAS location under a store tree
+DEFAULT_CAS_DIR = "cas"
+
+MANIFEST_SUFFIX = ".casman.json"
+MANIFEST_FORMAT = 1
+
+#: rows per chunk: large enough that chunk overhead stays <1% of int32
+#: row bytes, small enough that a few-thousand-op shrink candidate
+#: still spans multiple chunks and can share its head
+DEFAULT_CHUNK_ROWS = 2048
+
+OBJECTS_DIR = "objects"
+REFS_DIR = "refs"
+
+_SHA_RE = re.compile(r"^[0-9a-f]{64}$")
+
+
+class CasError(Exception):
+    """A CAS object is missing, corrupt, or would be unsafely removed."""
+
+
+def _safe_ref(ref: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", ref)[:120] or "_"
+
+
+class SectionStore:
+    """One content-addressed chunk store rooted at ``root``."""
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+
+    @classmethod
+    def for_manifest(
+        cls, manifest_path: str | Path, doc: dict | None = None
+    ) -> "SectionStore":
+        """The store a manifest's chunks live in: its recorded
+        ``cas_root`` resolved relative to the manifest's directory
+        (manifests are portable with their store tree, not pinned to
+        an absolute path)."""
+        manifest_path = Path(manifest_path)
+        if doc is None:
+            doc = cls._read_manifest(manifest_path)
+        rel = doc.get("cas_root", DEFAULT_CAS_DIR)
+        return cls((manifest_path.parent / rel).resolve())
+
+    # -- objects ----------------------------------------------------------
+
+    def object_path(self, sha: str) -> Path:
+        if not _SHA_RE.match(sha):
+            raise CasError(f"not a sha256 address: {sha!r}")
+        return self.root / OBJECTS_DIR / sha[:2] / sha
+
+    def put(self, data: bytes) -> tuple[str, bool]:
+        """Store one chunk; returns ``(sha, newly_written)``.  Atomic
+        via link-from-temp: two concurrent writers of the same content
+        both succeed, and a torn write can never occupy an address."""
+        sha = hashlib.sha256(data).hexdigest()
+        obj = self.object_path(sha)
+        if obj.exists():
+            return sha, False
+        obj.parent.mkdir(parents=True, exist_ok=True)
+        tmp = obj.parent / f".{sha}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        try:
+            os.link(tmp, obj)
+            new = True
+        except FileExistsError:
+            new = False
+        finally:
+            os.unlink(tmp)
+        return sha, new
+
+    def get(self, sha: str) -> bytes:
+        obj = self.object_path(sha)
+        try:
+            data = obj.read_bytes()
+        except OSError as e:
+            raise CasError(f"missing object {sha}: {e}") from e
+        if hashlib.sha256(data).hexdigest() != sha:
+            raise CasError(f"object {sha} is corrupt (content drift)")
+        return data
+
+    def refcount(self, sha: str) -> int:
+        """Live references to an object (hardlink count minus the
+        object file itself)."""
+        try:
+            return os.stat(self.object_path(sha)).st_nlink - 1
+        except OSError:
+            return 0
+
+    # -- refs -------------------------------------------------------------
+
+    def add_ref(self, ref: str, seq: int, sha: str) -> None:
+        d = self.root / REFS_DIR / _safe_ref(ref)
+        d.mkdir(parents=True, exist_ok=True)
+        link = d / f"{seq:06d}-{sha}"
+        if link.exists():
+            return
+        try:
+            os.link(self.object_path(sha), link)
+        except FileExistsError:
+            pass
+
+    def drop_ref(self, ref: str) -> int:
+        """Remove one named reference set; returns links dropped."""
+        d = self.root / REFS_DIR / _safe_ref(ref)
+        if not d.is_dir():
+            return 0
+        n = sum(1 for _ in d.iterdir())
+        shutil.rmtree(d)
+        return n
+
+    def refs(self) -> list[str]:
+        d = self.root / REFS_DIR
+        if not d.is_dir():
+            return []
+        return sorted(p.name for p in d.iterdir() if p.is_dir())
+
+    # -- publish / materialize -------------------------------------------
+
+    def publish_jtc(
+        self,
+        jtc_path: str | Path,
+        ref: str | None = None,
+        chunk_rows: int = DEFAULT_CHUNK_ROWS,
+        drop_original: bool = False,
+    ) -> dict[str, Any]:
+        """Content-address one ``.jtc``: every section split into
+        row-aligned chunks, chunks stored (dedup against everything
+        already in the store), and the manifest written beside the
+        file.  With ``drop_original`` the ``.jtc`` itself is removed —
+        the manifest + store now carry the bytes.  Returns honest
+        accounting: ``new_bytes`` actually written vs ``dup_bytes``
+        shared with prior publishes."""
+        from jepsen_tpu.history.columnar import read_jtc, section_digests
+
+        jtc_path = Path(jtc_path)
+        jtc, stamp = read_jtc(jtc_path)  # CRC-verified
+        try:
+            digests = section_digests(jtc_path)
+        except Exception:  # noqa: BLE001 - legacy/corrupt footer
+            digests = None
+        digest_by_kind = dict(digests or [])
+        ref = ref if ref is not None else jtc_path.name
+        sections = []
+        new_bytes = dup_bytes = 0
+        seq = 0
+        # table order is load-bearing: materialize must rebuild the
+        # original section sequence bit-exactly
+        for kind, arr in jtc.arrays.items():
+            raw = np.ascontiguousarray(arr).tobytes()
+            nrows = arr.shape[0] if arr.ndim else 0
+            ncols = arr.shape[1] if arr.ndim == 2 else 1
+            row_bytes = (len(raw) // nrows) if nrows else len(raw)
+            step = max(1, chunk_rows) * row_bytes if row_bytes else len(raw)
+            chunks = []
+            for off in range(0, len(raw), step) if raw else []:
+                blk = raw[off : off + step]
+                sha, new = self.put(blk)
+                if new:
+                    new_bytes += len(blk)
+                else:
+                    dup_bytes += len(blk)
+                self.add_ref(ref, seq, sha)
+                seq += 1
+                chunks.append({"sha": sha, "length": len(blk)})
+            sections.append({
+                "kind": int(kind),
+                "dtype": str(arr.dtype),
+                "rows": int(nrows),
+                "cols": int(ncols),
+                "flags": int(jtc.flags.get(kind, 0)),
+                "sha256": digest_by_kind.get(
+                    kind, hashlib.sha256(raw).hexdigest()
+                ),
+                "chunks": chunks,
+            })
+        manifest_path = jtc_path.with_name(jtc_path.name + MANIFEST_SUFFIX)
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "workload": jtc.workload,
+            "src_name": stamp["src_name"],
+            "src_size": int(stamp["src_size"]),
+            "src_mtime_ns": int(stamp["src_mtime_ns"]),
+            "src_sha256": bytes(stamp["src_sha256"]).hex(),
+            "ref": ref,
+            "cas_root": os.path.relpath(self.root, manifest_path.parent),
+            "logical_bytes": int(sum(
+                c["length"] for s in sections for c in s["chunks"]
+            )),
+            "sections": sections,
+        }
+        tmp = manifest_path.with_name(manifest_path.name + ".tmp")
+        tmp.write_text(json.dumps(manifest, separators=(",", ":")))
+        os.replace(tmp, manifest_path)
+        if drop_original:
+            jtc_path.unlink()
+        from jepsen_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.counter("cas.publishes").inc()
+        REGISTRY.counter("cas.new_bytes").inc(new_bytes)
+        REGISTRY.counter("cas.dup_bytes").inc(dup_bytes)
+        return {
+            "manifest": str(manifest_path),
+            "ref": ref,
+            "sections": len(sections),
+            "chunks": seq,
+            "logical_bytes": manifest["logical_bytes"],
+            "new_bytes": new_bytes,
+            "dup_bytes": dup_bytes,
+        }
+
+    def materialize(
+        self, manifest_path: str | Path, out_path: str | Path | None = None
+    ) -> Path:
+        """Rebuild the ORIGINAL ``.jtc`` bit-exactly from its manifest:
+        chunks are fetched (content-verified), sections reassembled in
+        table order, and the deterministic builder re-run with the
+        manifest's source stamp.  Default target: the manifest path
+        minus its suffix (the original ``.jtc`` slot)."""
+        from jepsen_tpu.history.columnar import build_jtc_bytes
+
+        manifest_path = Path(manifest_path)
+        manifest = self._read_manifest(manifest_path)
+        secs = []
+        for s in manifest["sections"]:
+            raw = b"".join(self.get(c["sha"]) for c in s["chunks"])
+            want = s.get("sha256")
+            if want and hashlib.sha256(raw).hexdigest() != want:
+                raise CasError(
+                    f"{manifest_path}: section {s['kind']} reassembled "
+                    f"to the wrong content (chunk drift)"
+                )
+            arr = np.frombuffer(raw, dtype=np.dtype(s["dtype"]))
+            if s["cols"] > 1:
+                arr = arr.reshape(int(s["rows"]), int(s["cols"]))
+            secs.append((int(s["kind"]), arr, int(s["flags"])))
+        buf = build_jtc_bytes(
+            secs,
+            manifest["workload"],
+            manifest["src_name"].encode(),
+            manifest["src_size"],
+            manifest["src_mtime_ns"],
+            bytes.fromhex(manifest["src_sha256"]),
+        )
+        if out_path is None:
+            name = manifest_path.name
+            if not name.endswith(MANIFEST_SUFFIX):
+                raise CasError(
+                    f"{manifest_path}: cannot infer target (not a "
+                    f"{MANIFEST_SUFFIX} name); pass out_path"
+                )
+            out_path = manifest_path.with_name(
+                name[: -len(MANIFEST_SUFFIX)]
+            )
+        out_path = Path(out_path)
+        tmp = out_path.with_name(out_path.name + f".{os.getpid()}.tmp")
+        tmp.write_bytes(buf)
+        os.replace(tmp, out_path)
+        return out_path
+
+    def content_key_from_manifest(
+        self, manifest_path: str | Path
+    ) -> str:
+        """:meth:`Jtc.content_key` straight off the CAS — sha256 over
+        section bytes in sorted-kind order, streamed from the chunk
+        objects without materializing the file.  This is how a deduped
+        run still seeds the verdict cache."""
+        manifest = self._read_manifest(Path(manifest_path))
+        h = hashlib.sha256()
+        for s in sorted(manifest["sections"], key=lambda s: s["kind"]):
+            for c in s["chunks"]:
+                h.update(self.get(c["sha"]))
+        return h.hexdigest()
+
+    @staticmethod
+    def _read_manifest(path: Path) -> dict:
+        try:
+            manifest = json.loads(path.read_text())
+        except (OSError, ValueError) as e:
+            raise CasError(f"{path}: unreadable manifest: {e}") from e
+        if manifest.get("format") != MANIFEST_FORMAT:
+            raise CasError(
+                f"{path}: unknown manifest format {manifest.get('format')}"
+            )
+        return manifest
+
+    # -- accounting / GC --------------------------------------------------
+
+    def iter_objects(self) -> Iterable[tuple[str, Path, int, int]]:
+        """``(sha, path, size, nlink)`` for every stored object."""
+        d = self.root / OBJECTS_DIR
+        if not d.is_dir():
+            return
+        for sub in sorted(d.iterdir()):
+            if not sub.is_dir():
+                continue
+            for p in sorted(sub.iterdir()):
+                if not _SHA_RE.match(p.name):
+                    continue
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                yield p.name, p, st.st_size, st.st_nlink
+
+    def stats(self) -> dict[str, Any]:
+        objects = unique_bytes = referenced = 0
+        for _sha, _p, size, nlink in self.iter_objects():
+            objects += 1
+            unique_bytes += size
+            if nlink > 1:
+                referenced += 1
+        return {
+            "root": str(self.root),
+            "objects": objects,
+            "unique_bytes": unique_bytes,
+            "referenced_objects": referenced,
+            "refs": len(self.refs()),
+        }
+
+    def gc(self, force: bool = False) -> dict[str, Any]:
+        """Collect UNREFERENCED objects only (``st_nlink == 1``).
+        ``force`` does not override that: a referenced object is live
+        data and the store refuses to break a manifest under any flag —
+        the refusal is counted, loudly."""
+        collected = collected_bytes = refused = 0
+        for sha, p, size, nlink in list(self.iter_objects()):
+            if nlink > 1:
+                if force:
+                    refused += 1
+                    logger.error(
+                        "store gc: REFUSING to collect %s (%d live "
+                        "reference(s)) despite --force", sha, nlink - 1,
+                    )
+                continue
+            try:
+                p.unlink()
+                collected += 1
+                collected_bytes += size
+            except OSError as e:
+                logger.warning("store gc: could not remove %s: %s", sha, e)
+        return {
+            "collected": collected,
+            "collected_bytes": collected_bytes,
+            "refused_live": refused,
+        }
+
+
+def find_manifests(store_root: str | Path) -> list[Path]:
+    return sorted(Path(store_root).rglob(f"*{MANIFEST_SUFFIX}"))
+
+
+def find_run_manifest(run_dir: str | Path) -> Path | None:
+    """The run directory's substrate manifest, if its ``.jtc`` has
+    been dehydrated into the section store: first ``*.casman.json``
+    directly in the directory (sorted, so deterministic when several
+    substrates were published)."""
+    d = Path(run_dir)
+    try:
+        cands = sorted(d.glob(f"*{MANIFEST_SUFFIX}"))
+    except OSError:
+        return None
+    return cands[0] if cands else None
+
+
+def dedup_stats(
+    store_root: str | Path, cas: SectionStore | None = None
+) -> dict[str, Any]:
+    """The honest dedup ratio for a store tree: logical bytes addressed
+    by every manifest vs unique object bytes on disk.  ``ratio`` is 1.0
+    when nothing is shared and the function never rounds it up; a tree
+    with no manifests reports ratio 1.0 with zero logical bytes."""
+    store_root = Path(store_root)
+    if cas is None:
+        cas = SectionStore(store_root / DEFAULT_CAS_DIR)
+    logical = 0
+    manifests = find_manifests(store_root)
+    shas: set[str] = set()
+    for m in manifests:
+        try:
+            doc = SectionStore._read_manifest(m)
+        except CasError as e:
+            logger.warning("dedup stats: skipping %s: %s", m, e)
+            continue
+        logical += int(doc.get("logical_bytes", 0))
+        for s in doc.get("sections", []):
+            for c in s.get("chunks", []):
+                shas.add(c["sha"])
+    addressed_bytes = 0
+    missing = 0
+    for sha in shas:
+        try:
+            addressed_bytes += os.stat(cas.object_path(sha)).st_size
+        except OSError:
+            missing += 1
+    st = cas.stats()
+    ratio = (logical / addressed_bytes) if addressed_bytes else 1.0
+    return {
+        "manifests": len(manifests),
+        "logical_bytes": logical,
+        "addressed_bytes": addressed_bytes,
+        "unique_objects": len(shas),
+        "missing_objects": missing,
+        "ratio": round(ratio, 4),
+        "store": st,
+    }

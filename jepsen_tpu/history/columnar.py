@@ -75,6 +75,11 @@ _SECTION = struct.Struct("<IIQQQQII")
 _CRC = struct.Struct("<I")
 _ALIGN = 64
 
+#: trailing section-digest footer (per-section sha256; version stays 1
+#: because section offsets are explicit and readers ignore tail bytes)
+DIGEST_MAGIC = b"JTCD"
+_DIGEST_HEAD = struct.Struct("<4sI")
+
 SEC_QROWS = 1  # [n, 8] int32 — rows._rows_for schema (any workload)
 SEC_STREAM = 2  # [n, 6] int32 — stream_lin._stream_rows schema
 SEC_EMOPS = 3  # [M, 8] int32 — elle micro-op cells (elle_mops_for)
@@ -443,6 +448,97 @@ def _coerce_sections(rows, stream, emops, wgl=None) -> list | None:
     return secs
 
 
+def build_jtc_bytes(
+    secs: list,
+    workload: str | None,
+    name: bytes,
+    src_size: int,
+    src_mtime_ns: int,
+    src_sha256: bytes,
+) -> bytes:
+    """The complete on-disk image of a ``.jtc`` — a pure deterministic
+    function of the sections and the source stamp, shared between
+    :func:`write_jtc` and CAS materialization
+    (``history/cas.py``): re-building from content-addressed chunks
+    with the manifest's stamp reproduces the ORIGINAL file bit-exactly.
+
+    The image ends with the **section digest footer** (COLUMNAR.md
+    §Content-addressed sections): ``b"JTCD"``, a section count, one
+    raw 32-byte sha256 per section in table order, and a CRC over the
+    footer.  Version stays 1 — section offsets/lengths are explicit,
+    so both the Python and native readers ignore trailing bytes; the
+    footer is how per-section content addresses travel *inside* the
+    file without breaking the zero-parse contract."""
+    wl_code = _WORKLOADS.index(workload) if workload in _WORKLOADS else -1
+    table_end = _HEADER.size + len(secs) * _SECTION.size
+    data_off = _align(table_end + _CRC.size)
+    entries, payloads, digests = [], [], []
+    for kind, arr, flags in secs:
+        raw = arr.tobytes()
+        nrows = arr.shape[0] if arr.ndim else 0
+        ncols = arr.shape[1] if arr.ndim == 2 else 1
+        entries.append(_SECTION.pack(
+            kind, _DTYPE_CODES[arr.dtype], nrows, ncols,
+            data_off, len(raw), zlib.crc32(raw), flags,
+        ))
+        payloads.append((data_off, raw))
+        digests.append(hashlib.sha256(raw).digest())
+        data_off = _align(data_off + len(raw))
+    head = _HEADER.pack(
+        MAGIC, VERSION, wl_code, len(secs), name,
+        src_size, src_mtime_ns, src_sha256,
+    ) + b"".join(entries)
+    buf = bytearray(data_off if payloads else table_end + _CRC.size)
+    buf[: len(head)] = head
+    _CRC.pack_into(buf, table_end, zlib.crc32(head))
+    end = table_end + _CRC.size
+    for off, raw in payloads:
+        buf[off : off + len(raw)] = raw
+        end = off + len(raw)
+    foot = _DIGEST_HEAD.pack(DIGEST_MAGIC, len(secs)) + b"".join(digests)
+    foot += _CRC.pack(zlib.crc32(foot))
+    return bytes(buf[:end]) + foot
+
+
+def section_digests(path: str | Path) -> list[tuple[int, str]] | None:
+    """Per-section ``(kind, hex sha256)`` in table order from a
+    ``.jtc``'s digest footer, CRC-verified — or None when the file
+    predates the footer (legacy packs stay readable; content addressing
+    falls back to hashing the payloads).  Raises
+    :class:`ColumnarFormatError` only on a *present but corrupt*
+    footer."""
+    path = Path(path)
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if len(data) < _HEADER.size + _CRC.size:
+        raise ColumnarFormatError(f"{path}: truncated header")
+    n_sections = _HEADER.unpack_from(data, 0)[3]
+    foot_len = _DIGEST_HEAD.size + 32 * n_sections + _CRC.size
+    if len(data) < foot_len:
+        return None
+    foot = data[-foot_len:]
+    magic, count = _DIGEST_HEAD.unpack_from(foot, 0)
+    if magic != DIGEST_MAGIC:
+        return None
+    if count != n_sections:
+        raise ColumnarFormatError(
+            f"{path}: digest footer counts {count} sections, header "
+            f"declares {n_sections}"
+        )
+    (crc,) = _CRC.unpack_from(foot, foot_len - _CRC.size)
+    if zlib.crc32(foot[: foot_len - _CRC.size]) != crc:
+        raise ColumnarFormatError(f"{path}: digest footer CRC mismatch")
+    kinds = [
+        _SECTION.unpack_from(data, _HEADER.size + i * _SECTION.size)[0]
+        for i in range(n_sections)
+    ]
+    out = []
+    for i, kind in enumerate(kinds):
+        off = _DIGEST_HEAD.size + 32 * i
+        out.append((kind, foot[off : off + 32].hex()))
+    return out
+
+
 def write_jtc(
     src_path: str | Path,
     workload: str | None,
@@ -468,7 +564,6 @@ def write_jtc(
         raise ValueError(f"{src}: refusing to write a section-less .jtc")
     st = os.stat(src)
     digest = _src_digest(src)
-    wl_code = _WORKLOADS.index(workload) if workload in _WORKLOADS else -1
     name = src.name.encode()
     if len(name) > 32:
         # the loader compares the FULL basename against this stamp; a
@@ -479,32 +574,9 @@ def write_jtc(
             f"{src}: basename exceeds the 32-byte .jtc source-name "
             f"field; not representable"
         )
-
-    table_end = _HEADER.size + len(secs) * _SECTION.size
-    data_off = _align(table_end + _CRC.size)
-    entries, payloads = [], []
-    for kind, arr, flags in secs:
-        raw = arr.tobytes()
-        nrows = arr.shape[0] if arr.ndim else 0
-        ncols = arr.shape[1] if arr.ndim == 2 else 1
-        entries.append(_SECTION.pack(
-            kind, _DTYPE_CODES[arr.dtype], nrows, ncols,
-            data_off, len(raw), zlib.crc32(raw), flags,
-        ))
-        payloads.append((data_off, raw))
-        data_off = _align(data_off + len(raw))
-    head = _HEADER.pack(
-        MAGIC, VERSION, wl_code, len(secs), name,
-        st.st_size, st.st_mtime_ns, digest,
-    ) + b"".join(entries)
-    buf = bytearray(data_off if payloads else table_end + _CRC.size)
-    buf[: len(head)] = head
-    _CRC.pack_into(buf, table_end, zlib.crc32(head))
-    end = table_end + _CRC.size
-    for off, raw in payloads:
-        buf[off : off + len(raw)] = raw
-        end = off + len(raw)
-    buf = bytes(buf[:end])
+    buf = build_jtc_bytes(
+        secs, workload, name, st.st_size, st.st_mtime_ns, digest
+    )
 
     target = jtc_path_for(src)
     tmp = target.with_name(

@@ -2595,13 +2595,18 @@ def check_source_segmented(
     carry_cap: int | None = None,
     device: bool = True,
     keep_checkpoint: bool = False,
+    prefix_index=None,
     **opts,
 ) -> tuple[dict, "PipelineStats"]:
     """The pipeline's segment-producer mode: ONE history streamed
     through the segmented carry engine (``checkers/segmented.py``) in
     fixed-shape segments — bounded memory regardless of history
     length, durable per-segment checkpoints, ``resume=True`` to
-    continue a killed check from the last one.
+    continue a killed check from the last one.  ``prefix_index`` (a
+    directory path or :class:`~jepsen_tpu.history.prefix_index.
+    PrefixCheckpointIndex`) arms fleet memory: a re-submitted history
+    resumes from the deepest published anchor whose content hash
+    matches its bytes (SEGMENTED.md §Prefix resume).
 
     The producer here is the op axis, not the file axis: per-segment
     check latency lands in the run registry's
@@ -2626,6 +2631,7 @@ def check_source_segmented(
         carry_cap=carry_cap,
         device=device,
         keep_checkpoint=keep_checkpoint,
+        prefix_index=prefix_index,
     )
     t1 = time.perf_counter()
     segs = int(REGISTRY.value("segmented.segments") - before)

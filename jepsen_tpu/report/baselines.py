@@ -1,0 +1,319 @@
+"""Per-config performance baselines and drift flags (fleet memory,
+ROADMAP direction 3).
+
+The store remembers what "normal" looks like: every committed
+``BENCH_r*.json`` headline and every ``report.json`` under a store
+tree becomes a point in a per-config series (bench metrics keyed by
+``bench:<metric>@<backend>``, run metrics keyed by the run group —
+``campaign_r17/run_0003`` contributes to series ``campaign_r17``).
+``collect_baselines`` fits a robust baseline to each series (median +
+MAD band — one outlier shifts nothing) and compares the NEWEST point
+against the band fitted to the points before it, with direction sense:
+a latency that rises or a throughput that falls is a **regression**
+and is flagged loudly; movement the other way is recorded as an
+improvement, not a flag.  A series shorter than ``min_points`` gets no
+baseline and can never flag — silence over noise.
+
+Outputs:
+
+* ``store/baselines.json`` — the full per-series doc (points,
+  baseline, band, last value, delta, flag), written atomically.
+* registry gauges ``fleet.regression_flags`` /
+  ``fleet.baseline_series`` and a per-flag
+  ``fleet.regression_delta_pct{series=...,metric=...}`` gauge, plus a
+  ``fleet.fault_window_s`` quantile sketch fed from every run's
+  nemesis windows — all visible on ``/metrics`` through the shared
+  registry (``jepsen_tpu/obs/metrics.py``).
+* a loud panel in ``index.html`` (``jepsen_tpu/report/index.py``).
+
+Deterministic: the doc is a pure function of the artifact set; points
+are ordered by artifact name (bench rounds / run paths sort
+chronologically by construction in this repo).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from pathlib import Path
+from typing import Any
+
+BASELINES_FILE = "baselines.json"
+BASELINES_FORMAT = 1
+
+#: fewest points before a series grows a baseline (the band is fitted
+#: to n-1 priors; below this, "drift" is indistinguishable from noise)
+MIN_POINTS = 4
+
+#: relative half-width floor of the acceptance band — a robust spread
+#: of zero (constant priors) must not turn float jitter into a flag
+REL_TOL = 0.25
+
+_RUN_SUFFIX_RE = re.compile(r"[/_-](run|r|iter|probe)?[_-]?\d+$")
+
+#: metric-name → direction sense ("higher" / "lower" is better)
+_LOWER_BETTER = ("latency", "_ms", "_s", "wall", "recovery", "p50",
+                 "p90", "p99")
+_HIGHER_BETTER = ("per_sec", "per_s", "rate", "throughput", "hist",
+                  "valid", "speedup", "ops")
+
+
+def metric_sense(name: str) -> str | None:
+    """"higher"/"lower"-is-better by metric name; None when the name
+    says neither (such a metric can drift but never "regress")."""
+    low = name.lower()
+    for tok in _LOWER_BETTER:
+        if tok in low:
+            return "lower"
+    for tok in _HIGHER_BETTER:
+        if tok in low:
+            return "higher"
+    return None
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def series_key_for_run(rel: str) -> str:
+    """Run directory → series name: the run group.  Numbered members
+    of a campaign (``campaign_r17/run_0003``, ``soak/iter-12``) fold
+    into their parent series; a top-level one-off run is its own
+    series of one (and therefore never baselines — honestly)."""
+    rel = rel.strip("/")
+    if "/" in rel:
+        return rel.split("/", 1)[0]
+    return _RUN_SUFFIX_RE.sub("", rel) or rel
+
+
+def bench_series(repo_root: str | Path) -> dict[str, list[dict]]:
+    """Headline points from committed ``BENCH_r*.json`` rounds, keyed
+    ``bench:<metric>@<backend>`` — rounds sort by filename, which is
+    their recording order."""
+    out: dict[str, list[dict]] = {}
+    root = Path(repo_root)
+    for p in sorted(root.glob("BENCH_r*.json")):
+        try:
+            doc = json.loads(p.read_text())
+        except (OSError, ValueError):
+            continue
+        parsed = doc.get("parsed")
+        if not isinstance(parsed, dict):
+            continue
+        metric = parsed.get("metric")
+        value = parsed.get("value")
+        if not isinstance(metric, str) or not isinstance(
+            value, (int, float)
+        ):
+            continue
+        key = f"bench:{metric}@{parsed.get('backend', '?')}"
+        out.setdefault(key, []).append({
+            "source": p.name,
+            "metric": metric,
+            "value": float(value),
+            "fallback": bool(parsed.get("fallback")),
+        })
+    return out
+
+
+def run_series(
+    store_root: str | Path,
+) -> tuple[dict[str, dict[str, list[dict]]], list[float]]:
+    """Per-group metric series from every ``report.json`` under the
+    store, plus the pooled fault-window durations (the recovery-time
+    sketch's feed).  Returns ``({group: {metric: [points]}},
+    window_durations_s)``."""
+    from jepsen_tpu.report.index import run_dirs
+    from jepsen_tpu.report.render import REPORT_JSON
+
+    root = Path(store_root)
+    groups: dict[str, dict[str, list[dict]]] = {}
+    windows: list[float] = []
+    for d in run_dirs(root):
+        rj = d / REPORT_JSON
+        if not rj.is_file():
+            continue
+        try:
+            s = json.loads(rj.read_text())
+        except (OSError, ValueError):
+            continue
+        rel = str(d.relative_to(root))
+        group = series_key_for_run(rel)
+        g = groups.setdefault(group, {})
+        lat = s.get("latency-ms") or {}
+        v = s.get("valid?")
+        point_metrics: dict[str, Any] = {
+            "latency_p50_ms": lat.get("p50"),
+            "latency_p99_ms": lat.get("p99"),
+            "peak_rate_ops_per_s": s.get("peak-rate-ops-per-s"),
+            # verdict-class rate rides as a 0/1 series: a config whose
+            # priors were unanimously valid flags loudly on the first
+            # invalid (MAD 0 -> band is the REL_TOL floor, |0-1| >> it)
+            "valid_rate": (
+                1.0 if v is True else 0.0 if v is False else None
+            ),
+        }
+        for metric, value in point_metrics.items():
+            if isinstance(value, (int, float)):
+                g.setdefault(metric, []).append({
+                    "source": rel, "metric": metric,
+                    "value": float(value),
+                })
+        for w in s.get("nemesis-windows") or []:
+            if isinstance(w, dict):
+                t0, t1 = w.get("t0-s"), w.get("t1-s")
+                if isinstance(t0, (int, float)) and isinstance(
+                    t1, (int, float)
+                ) and t1 >= t0:
+                    windows.append(float(t1 - t0))
+    return groups, windows
+
+
+def fit_series(
+    points: list[dict], sense: str | None, min_points: int = MIN_POINTS
+) -> dict[str, Any]:
+    """Baseline the priors, judge the last point.  ``flag`` is
+    ``"regression"`` (loud), ``"improvement"``, ``"drift"`` (moved,
+    direction sense unknown), or None (in band / too few points)."""
+    vals = [p["value"] for p in points]
+    doc: dict[str, Any] = {
+        "points": len(vals),
+        "last": vals[-1] if vals else None,
+        "sense": sense,
+        "flag": None,
+    }
+    if len(vals) < min_points:
+        doc["why"] = f"needs >= {min_points} points to baseline"
+        return doc
+    priors, last = vals[:-1], vals[-1]
+    med = _median(priors)
+    mad = _median([abs(x - med) for x in priors])
+    band = max(3.0 * mad, REL_TOL * abs(med), 1e-9)
+    delta = last - med
+    doc.update({
+        "baseline": round(med, 6),
+        "band": round(band, 6),
+        "delta": round(delta, 6),
+        "delta_pct": (
+            round(100.0 * delta / med, 2) if med else None
+        ),
+    })
+    if abs(delta) <= band:
+        return doc
+    if sense == "higher":
+        doc["flag"] = "regression" if delta < 0 else "improvement"
+    elif sense == "lower":
+        doc["flag"] = "regression" if delta > 0 else "improvement"
+    else:
+        doc["flag"] = "drift"
+    return doc
+
+
+def collect_baselines(
+    store_root: str | Path,
+    repo_root: str | Path | None = None,
+    *,
+    min_points: int = MIN_POINTS,
+    registry: Any = None,
+) -> dict[str, Any]:
+    """The store's full baseline doc: every bench-headline and run-
+    group series fitted, regressions pulled into a flat ``flags`` list
+    (most negative delta first), gauges set on ``registry`` (the
+    shared obs registry by default; pass ``registry=False`` for a
+    pure-function call)."""
+    store_root = Path(store_root)
+    if repo_root is None:
+        repo_root = store_root.parent
+    series: dict[str, dict[str, Any]] = {}
+
+    for key, pts in sorted(bench_series(repo_root).items()):
+        fitted = fit_series(pts, metric_sense(key), min_points)
+        fitted["sources"] = [p["source"] for p in pts]
+        fitted["values"] = [p["value"] for p in pts]
+        series[key] = fitted
+
+    groups, windows = run_series(store_root)
+    for group in sorted(groups):
+        for metric in sorted(groups[group]):
+            pts = groups[group][metric]
+            key = f"run:{group}:{metric}"
+            fitted = fit_series(pts, metric_sense(metric), min_points)
+            fitted["sources"] = [p["source"] for p in pts]
+            fitted["values"] = [p["value"] for p in pts]
+            series[key] = fitted
+
+    flags = [
+        {"series": k, **{f: v[f] for f in
+                         ("last", "baseline", "band", "delta",
+                          "delta_pct", "sense", "flag")
+                         if f in v}}
+        for k, v in series.items() if v.get("flag") == "regression"
+    ]
+    flags.sort(key=lambda f: (f.get("delta_pct") is None,
+                              -abs(f.get("delta_pct") or 0.0)))
+    drifts = sum(
+        1 for v in series.values()
+        if v.get("flag") in ("drift", "improvement")
+    )
+    doc = {
+        "format": BASELINES_FORMAT,
+        "min_points": min_points,
+        "series": series,
+        "flags": flags,
+        "n_series": len(series),
+        "n_flags": len(flags),
+        "n_drifts": drifts,
+        "fault_windows": {
+            "count": len(windows),
+            "p50_s": round(_median(windows), 3) if windows else None,
+            "max_s": round(max(windows), 3) if windows else None,
+        },
+    }
+    if registry is not False:
+        _export_gauges(doc, windows, registry)
+    return doc
+
+
+def _export_gauges(
+    doc: dict[str, Any], windows: list[float], registry: Any
+) -> None:
+    try:
+        if registry is None:
+            from jepsen_tpu.obs.metrics import REGISTRY as registry
+        registry.gauge("fleet.regression_flags").set(doc["n_flags"])
+        registry.gauge("fleet.baseline_series").set(doc["n_series"])
+        for f in doc["flags"]:
+            if isinstance(f.get("delta_pct"), (int, float)):
+                registry.gauge(
+                    "fleet.regression_delta_pct", series=f["series"]
+                ).set(f["delta_pct"])
+        sk = registry.sketch("fleet.fault_window_s", alpha=0.02)
+        for w in windows:
+            sk.add(w)
+    except Exception:  # noqa: BLE001 — gauges are best-effort telemetry
+        pass
+
+
+def write_baselines(
+    store_root: str | Path,
+    repo_root: str | Path | None = None,
+    *,
+    min_points: int = MIN_POINTS,
+    registry: Any = None,
+) -> tuple[Path, dict[str, Any]]:
+    """Collect and persist ``<store>/baselines.json`` atomically.
+    Returns ``(path, doc)``."""
+    store_root = Path(store_root)
+    doc = collect_baselines(
+        store_root, repo_root, min_points=min_points, registry=registry
+    )
+    path = store_root / BASELINES_FILE
+    tmp = path.with_name(path.name + ".tmp")
+    store_root.mkdir(parents=True, exist_ok=True)
+    tmp.write_text(json.dumps(doc, indent=1, sort_keys=True))
+    os.replace(tmp, path)
+    return path, doc

@@ -72,31 +72,80 @@ def run_content_refs(root: str | Path):
     the default contract (recorded runs don't persist checker options;
     non-default contracts re-check rather than hit), and ``rel`` the
     root-relative run directory — the ``report_ref`` a cache hit serves
-    alongside the verdict (the PR-11 ``/report/<run>`` route)."""
+    alongside the verdict (the PR-11 ``/report/<run>`` route).
+
+    When the substrate has been dehydrated into the content-addressed
+    section store (COLUMNAR.md §Content-addressed sections) — the
+    ``.jtc`` is gone but a ``<history>.casman.json`` manifest sits
+    next to the verdict — the content key is reproduced straight from
+    the manifest's chunk digests, so CAS'd runs keep seeding the
+    verdict cache without re-materializing a byte."""
     from jepsen_tpu.history.columnar import load_jtc
 
     root = Path(root)
     for d in run_dirs(root):
         results_path = d / RESULTS_FILE
+        if not results_path.is_file():
+            continue
         src = d / HISTORY_FILE
-        if not results_path.is_file() or not src.is_file():
-            continue
-        try:
-            jtc = load_jtc(src)
-        except Exception as e:  # noqa: BLE001 — skip, don't refuse to seed
-            log.warning("unaddressable substrate under %s: %s", d, e)
-            continue
-        if jtc is None or jtc.workload is None:
+        key = workload = None
+        if src.is_file():
+            try:
+                jtc = load_jtc(src)
+            except Exception as e:  # noqa: BLE001 — skip, don't refuse
+                log.warning("unaddressable substrate under %s: %s", d, e)
+                continue
+            if jtc is not None and jtc.workload is not None:
+                key, workload = jtc.content_key(), jtc.workload
+        if key is None:
+            # no loadable .jtc — the substrate may live only in the
+            # section store (dehydrated run); seed from its manifest
+            key, workload = _manifest_content_ref(d)
+        if key is None or workload is None:
             continue
         try:
             verdict = json.loads(results_path.read_text())
         except (OSError, ValueError) as e:
             log.warning("unreadable results.json under %s: %s", d, e)
             continue
-        yield (
-            jtc.content_key(), jtc.workload, {}, verdict,
-            str(d.relative_to(root)),
+        yield (key, workload, {}, verdict, str(d.relative_to(root)))
+
+
+def _manifest_content_ref(d: Path):
+    """(content_key, workload) for a run whose substrate lives only in
+    the section store, or (None, None).  A manifest pointing at
+    missing/corrupt objects is skipped with a warning, never guessed
+    at — the cache must not serve verdicts for bytes it can't prove.
+    If the source history still exists on disk it must match the
+    manifest's recorded stamp (same staleness rule as ``load_jtc``)."""
+    from jepsen_tpu.history.cas import SectionStore, find_run_manifest
+
+    man = find_run_manifest(d)
+    if man is None:
+        return None, None
+    try:
+        doc = json.loads(man.read_text())
+        src = d / str(doc.get("src_name") or HISTORY_FILE)
+        if src.is_file():
+            import hashlib
+
+            if (
+                src.stat().st_size != doc.get("src_size")
+                or hashlib.sha256(src.read_bytes()).hexdigest()
+                != doc.get("src_sha256")
+            ):
+                log.warning(
+                    "CAS manifest %s is stale for %s: run not seeded",
+                    man, src,
+                )
+                return None, None
+        cas = SectionStore.for_manifest(man, doc)
+        return cas.content_key_from_manifest(man), doc.get("workload")
+    except Exception as e:  # noqa: BLE001 — skip, don't refuse to seed
+        log.warning(
+            "CAS manifest %s unusable (%s): run not seeded", man, e
         )
+        return None, None
 
 
 def _summary_for(d: Path, render_missing: bool) -> dict[str, Any] | None:
@@ -148,6 +197,44 @@ def _sparkline(p50s: list[float | None]) -> str:
     return (
         f'<svg xmlns="http://www.w3.org/2000/svg" width="{w}" '
         f'height="{h}" viewBox="0 0 {w} {h}">{line}{dots}</svg>'
+    )
+
+
+def _baseline_panel(root: Path) -> str:
+    """The fleet-memory regression panel: refresh
+    ``<root>/baselines.json`` (``jepsen_tpu/report/baselines.py``) and
+    render its flags LOUDLY — a red banner row per regressed series —
+    or a one-line all-clear.  A baselining failure costs the panel,
+    never the index."""
+    try:
+        from jepsen_tpu.report.baselines import write_baselines
+
+        _path, doc = write_baselines(root)
+    except Exception as e:  # noqa: BLE001 — the index must still build
+        log.warning("baseline pass failed for %s: %s", root, e)
+        return ""
+    flags = doc.get("flags") or []
+    if not flags:
+        return (
+            f'<div class="panel"><h3>baselines</h3>'
+            f'<p class="verdict-true">no regressions flagged '
+            f"({doc.get('n_series', 0)} series baselined, "
+            f"{doc.get('n_drifts', 0)} non-regression drifts)</p></div>"
+        )
+    rows = "".join(
+        f'<tr class="verdict-false"><td>{escape(str(f["series"]))}</td>'
+        f"<td>{f.get('baseline', '')}</td><td>{f.get('last', '')}</td>"
+        f"<td>{f.get('delta_pct', '')}%</td>"
+        f"<td>{escape(str(f.get('sense', '')))}</td></tr>"
+        for f in flags
+    )
+    return (
+        f'<div class="panel"><h3 class="verdict-false">'
+        f"&#9888; {len(flags)} PERFORMANCE REGRESSION(S) FLAGGED</h3>"
+        f"<table><tr><th>series</th><th>baseline</th><th>last</th>"
+        f"<th>delta</th><th>sense</th></tr>{rows}</table>"
+        f"<p>full doc: <a href={quoteattr('baselines.json')}>"
+        f"baselines.json</a></p></div>"
     )
 
 
@@ -206,11 +293,13 @@ def build_store_index(
         )
     if not rows_html:
         return None
+    baseline_panel = _baseline_panel(root)
     html = (
         f"<html><head><title>run index</title><style>{_CSS}</style>"
         f"</head><body><h2>run index — {len(rows_html)} runs "
         f'(<span class="verdict-true">{n_valid} valid</span> / '
         f'<span class="verdict-false">{n_invalid} invalid</span>)</h2>'
+        f"{baseline_panel}"
         f'<div class="panel"><h3>p50 latency trend (ms, run order)'
         f"</h3>{_sparkline(p50s)}</div>"
         f'<div class="panel"><table><tr><th>run</th><th>valid?</th>'

@@ -466,12 +466,52 @@ class CheckerServer(socketserver.ThreadingTCPServer):
                             logger.exception(
                                 "cache seed from %s failed", self._store
                             )
+                        self._export_fleet_gauges()
                     self._ingest = IngestService(
                         cache=cache,
                         registry=self.metrics,
                         **self._ingest_opts,
                     )
         return self._ingest
+
+    def _export_fleet_gauges(self) -> None:
+        """Fleet-memory state of the backing store, as gauges on the
+        service registry (visible on ``/metrics``): CAS dedup ratio,
+        prefix-checkpoint index size, and per-config regression flags
+        (``jepsen_tpu/report/baselines.py``).  Pure telemetry — any
+        failure here costs a gauge, never the service."""
+        try:
+            from jepsen_tpu.history.cas import dedup_stats
+
+            ds = dedup_stats(self._store)
+            self.metrics.gauge("fleet.cas_dedup_ratio").set(ds["ratio"])
+            self.metrics.gauge("fleet.cas_objects").set(
+                ds.get("unique_objects", 0)
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            logger.debug("cas dedup gauge skipped", exc_info=True)
+        try:
+            import os as _os
+
+            from jepsen_tpu.history.prefix_index import (
+                DEFAULT_INDEX_DIR,
+                PrefixCheckpointIndex,
+            )
+
+            st = PrefixCheckpointIndex(
+                _os.path.join(self._store, DEFAULT_INDEX_DIR)
+            ).stats()
+            self.metrics.gauge("fleet.prefix_index_entries").set(
+                st["entries"]
+            )
+        except Exception:  # noqa: BLE001 — telemetry only
+            logger.debug("prefix index gauge skipped", exc_info=True)
+        try:
+            from jepsen_tpu.report.baselines import collect_baselines
+
+            collect_baselines(self._store, registry=self.metrics)
+        except Exception:  # noqa: BLE001 — telemetry only
+            logger.debug("baseline gauges skipped", exc_info=True)
 
     def torn_reply(self, e: TornPayloadError) -> dict[str, Any]:
         """Map a torn frame to its stream: poison evidence quarantines

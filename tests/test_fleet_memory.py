@@ -1,0 +1,629 @@
+"""Fleet memory (ISSUE 19): prefix-resume ≡ from-zero differentially,
+content-addressed section dedup, and the per-config baseline layer.
+
+The prefix-checkpoint index (``history/prefix_index.py``) lets a
+re-submitted history resume its segmented check from the deepest
+published anchor whose ``(prefix_sha256, offset)`` matches the new
+file's own bytes.  Everything here is differential: a fleet-resumed
+check must reach the BYTE-IDENTICAL per-family verdict of a from-zero
+check of the same file — including when the shared prefix already
+refutes, and when the file diverges one op after the deepest anchor
+(the resume must fall back to the shallower match, never serve a
+stale carry).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.checkers.segmented import segmented_check_file  # noqa: E402
+from jepsen_tpu.history.prefix_index import (  # noqa: E402
+    PrefixCheckpointIndex,
+)
+from jepsen_tpu.history.store import (  # noqa: E402
+    _json_default,
+    write_history_jsonl,
+)
+from jepsen_tpu.history.synth import (  # noqa: E402
+    ElleSynthSpec,
+    StreamSynthSpec,
+    SynthSpec,
+    synth_elle_history,
+    synth_history,
+    synth_stream_history,
+)
+
+SEG = 100
+
+_FAMS = ("queue", "linear", "stream", "elle", "mutex", "valid?")
+
+
+def norm(x):
+    return json.loads(json.dumps(x, default=_json_default))
+
+
+def verdicts(result):
+    return {f: norm(result[f]) for f in _FAMS if f in result}
+
+
+def write_corpus(workload, path, n=400, seed=5, **anomalies):
+    if workload == "queue":
+        sh = synth_history(SynthSpec(n_ops=n, seed=seed, **anomalies))
+    elif workload == "stream":
+        sh = synth_stream_history(
+            StreamSynthSpec(n_ops=n, seed=seed, **anomalies)
+        )
+    else:
+        sh = synth_elle_history(
+            ElleSynthSpec(n_txns=max(40, n // 3), seed=seed, **anomalies)
+        )
+    path.parent.mkdir(parents=True, exist_ok=True)
+    write_history_jsonl(path, sh.ops)
+    return path
+
+
+def check(path, idx=None, **kw):
+    return segmented_check_file(
+        path, segment_ops=SEG, device=False, prefix_index=idx, **kw
+    )
+
+
+# ---------------------------------------------------------------------------
+# prefix-resume ≡ from-zero, per family
+# ---------------------------------------------------------------------------
+
+
+class TestPrefixResumeDifferential:
+    @pytest.mark.parametrize("workload", ["queue", "stream", "elle"])
+    def test_resubmitted_history_resumes_and_verdicts_match(
+        self, tmp_path, workload
+    ):
+        hp = write_corpus(workload, tmp_path / "history.jsonl")
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        r_zero = check(hp)  # from-zero pin, no fleet involvement
+        r_arm = check(hp, idx)  # publishes anchors
+        assert "resumed_from_prefix" not in r_arm["segmented"]
+        r_fleet = check(hp, idx)  # the re-submission
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        assert prov["offset"] > 0
+        assert verdicts(r_fleet) == verdicts(r_zero) == verdicts(r_arm)
+
+    @pytest.mark.parametrize(
+        "workload,anomalies",
+        [
+            ("queue", {"lost": 1, "unexpected": 1}),
+            ("stream", {"lost": 1, "divergent": 1}),
+            ("elle", {"g1c_cycle": 1}),
+        ],
+    )
+    def test_invalid_history_resumes_to_identical_refutation(
+        self, tmp_path, workload, anomalies
+    ):
+        hp = write_corpus(
+            workload, tmp_path / "history.jsonl", **anomalies
+        )
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        r_zero = check(hp)
+        assert r_zero["valid?"] is False
+        check(hp, idx)
+        r_fleet = check(hp, idx)
+        assert r_fleet["segmented"]["resumed_from_prefix"] is not None
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_extension_resumes_from_parents_anchors(self, tmp_path):
+        """A child history that extends a checked parent byte-for-byte
+        resumes from the parent's deepest FULL-segment anchor."""
+        parent = write_corpus("queue", tmp_path / "parent.jsonl", n=300)
+        child = tmp_path / "child.jsonl"
+        extra = synth_history(SynthSpec(n_ops=80, seed=77)).ops
+        base = parent.read_bytes()
+        with open(child, "wb") as fh:
+            fh.write(base)
+            for op in extra:
+                fh.write((json.dumps(op.to_json()) + "\n").encode())
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        check(parent, idx)
+        r_zero = check(child)
+        r_fleet = check(child, idx)
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        # anchored strictly inside the shared parent bytes
+        assert 0 < prov["offset"] <= len(base)
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_invalid_shared_prefix_still_refutes_extension(
+        self, tmp_path
+    ):
+        """The carry must preserve refutation across a resume: a child
+        extending an already-invalid parent prefix with healthy ops
+        checks invalid, via the fleet anchor, with the identical
+        verdict to from-zero."""
+        parent = write_corpus(
+            "queue", tmp_path / "parent.jsonl", n=300, unexpected=1
+        )
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        r_parent = check(parent, idx)
+        assert r_parent["valid?"] is False
+        child = tmp_path / "child.jsonl"
+        healthy_tail = synth_history(SynthSpec(n_ops=60, seed=31)).ops
+        with open(child, "wb") as fh:
+            fh.write(parent.read_bytes())
+            for op in healthy_tail:
+                fh.write(
+                    (json.dumps(norm_op(op)) + "\n").encode()
+                )
+        r_zero = check(child)
+        assert r_zero["valid?"] is False
+        r_fleet = check(child, idx)
+        assert r_fleet["segmented"]["resumed_from_prefix"] is not None
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_divergence_after_deepest_anchor_falls_back(self, tmp_path):
+        """A file sharing the parent's bytes only up to segment j must
+        resume from segment j's anchor, not the deeper ones published
+        past the divergence point — and never serve a stale carry."""
+        parent = write_corpus("queue", tmp_path / "parent.jsonl", n=400)
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        check(parent, idx)
+
+        # find segment boundaries by line count: SEG lines per segment
+        lines = parent.read_bytes().splitlines(keepends=True)
+        shared = b"".join(lines[: 3 * SEG + 1])  # one op past seg 2
+        child = tmp_path / "child.jsonl"
+        tail = synth_history(SynthSpec(n_ops=150, seed=99)).ops
+        with open(child, "wb") as fh:
+            fh.write(shared)
+            for op in tail:
+                fh.write((json.dumps(norm_op(op)) + "\n").encode())
+        r_zero = check(child)
+        r_fleet = check(child, idx)
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        # deepest SERVABLE anchor is segment 2 (bytes diverge inside
+        # segment 3): offset is exactly the 3*SEG-line boundary
+        boundary = len(b"".join(lines[: 3 * SEG]))
+        assert prov["offset"] == boundary
+        assert prov["segment_idx"] == 2
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_divergent_byte_refuses_deeper_anchor_entirely(
+        self, tmp_path
+    ):
+        """Mutating a byte INSIDE the deepest anchored prefix must
+        unmatch that anchor (hash pass sees different bytes) and serve
+        a shallower one — the served offset always hash-matches the
+        new file's own bytes."""
+        parent = write_corpus("queue", tmp_path / "parent.jsonl", n=400)
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        check(parent, idx)
+        raw = bytearray(parent.read_bytes())
+        lines = bytes(raw).splitlines(keepends=True)
+        boundary2 = len(b"".join(lines[: 2 * SEG]))
+        # flip a digit inside segment 2 (between anchors 1 and 2),
+        # keeping JSON valid: find a "time" digit after boundary2
+        child = tmp_path / "child.jsonl"
+        mut = bytes(raw[:boundary2]) + b"".join(
+            _bump_time(ln) if i == 0 else ln
+            for i, ln in enumerate(lines[2 * SEG:])
+        )
+        child.write_bytes(mut)
+        r_zero = check(child)
+        r_fleet = check(child, idx)
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        assert prov["offset"] == boundary2
+        assert prov["segment_idx"] == 1
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_local_checkpoint_wins_over_fleet_index(self, tmp_path):
+        """resume=True with a valid local checkpoint must use it (it
+        is at least as deep for the same source) — fleet provenance
+        absent, classic ``resumed`` provenance present.  The dying
+        child runs against a COLD index so its own publishes are the
+        only anchors: local checkpoint and fleet anchor sit at the
+        same depth and the local one must win."""
+        import subprocess
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=400)
+        idx_dir = tmp_path / "idx"
+        idx = PrefixCheckpointIndex(idx_dir)
+        code = (
+            "import sys; sys.path.insert(0, sys.argv[1])\n"
+            "from jepsen_tpu.checkers.segmented import "
+            "segmented_check_file\n"
+            f"segmented_check_file(sys.argv[2], segment_ops={SEG}, "
+            f"device=False, prefix_index=sys.argv[3])\n"
+        )
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            JEPSEN_TPU_SEG_DIE_AFTER="2",
+        )
+        p = subprocess.run(
+            [sys.executable, "-c", code, str(REPO), str(hp),
+             str(idx_dir)],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert p.returncode == 137, p.stderr[-500:]
+        r = check(hp, idx, resume=True)
+        assert r["segmented"]["resumed"] is True
+        assert "resumed_from_prefix" not in r["segmented"]
+
+    def test_contract_mismatch_never_served(self, tmp_path):
+        """Anchors are contract-scoped: different opts or segment_ops
+        must miss the index entirely."""
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=400)
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        check(hp, idx)
+        r_opts = segmented_check_file(
+            hp, segment_ops=SEG, device=False, prefix_index=idx,
+            opts={"delivery": "at-least-once"},
+        )
+        assert "resumed_from_prefix" not in r_opts["segmented"]
+        r_seg = segmented_check_file(
+            hp, segment_ops=50, device=False, prefix_index=idx,
+        )
+        assert "resumed_from_prefix" not in r_seg["segmented"]
+
+    def test_torn_index_entry_falls_back_to_next_deepest(
+        self, tmp_path
+    ):
+        """A torn fleet entry is refused loudly and the next-deepest
+        valid anchor serves — provenance records the refusal."""
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=400)
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        check(hp, idx)
+        entries = sorted(
+            (tmp_path / "idx").rglob("*.json"), key=lambda p: p.name
+        )
+        assert len(entries) >= 2
+        deepest = entries[-1]
+        deepest.write_bytes(deepest.read_bytes()[:40])  # tear it
+        r_zero = check(hp)
+        r_fleet = check(hp, idx)
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        assert prov.get("refused_deeper")
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+    def test_jtc_rows_substrate_resumes_by_row_prefix(self, tmp_path):
+        """The queue family's zero-parse ``.jtc`` path uses row-prefix
+        anchors: a re-check over the packed substrate resumes and
+        reaches the identical verdict."""
+        from jepsen_tpu.history.columnar import pack_jtc
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=400)
+        assert pack_jtc(hp) is not None
+        idx = PrefixCheckpointIndex(tmp_path / "idx")
+        r_zero = check(hp)
+        assert r_zero["segmented"]["substrate"] == "jtc"
+        check(hp, idx)
+        r_fleet = check(hp, idx)
+        prov = r_fleet["segmented"]["resumed_from_prefix"]
+        assert prov is not None
+        assert prov["substrate"] == "jtc"
+        assert verdicts(r_fleet) == verdicts(r_zero)
+
+
+def norm_op(op):
+    """An Op as its JSONL dict (the store's writer shape)."""
+    return op.to_json()
+
+
+def _bump_time(line: bytes) -> bytes:
+    d = json.loads(line)
+    d["time"] = int(d.get("time") or 0) + 1
+    return json.dumps(d).encode() + b"\n"
+
+
+# ---------------------------------------------------------------------------
+# content-addressed sections: round-trip, dedup, GC refusal
+# ---------------------------------------------------------------------------
+
+
+class TestSectionStore:
+    def _pack(self, path):
+        from jepsen_tpu.history.columnar import jtc_path_for, pack_jtc
+
+        assert pack_jtc(path) is not None
+        return jtc_path_for(path)
+
+    def test_publish_materialize_bit_exact(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=300)
+        jtc = self._pack(hp)
+        original = jtc.read_bytes()
+        cas = SectionStore(tmp_path / "cas")
+        acc = cas.publish_jtc(jtc, ref="run0")
+        assert acc["sections"] >= 1
+        man = jtc.with_name(jtc.name + ".casman.json")
+        assert man.is_file()
+        jtc.unlink()  # dehydrate
+        out = cas.materialize(man)
+        assert hashlib.sha256(out.read_bytes()).hexdigest() == \
+            hashlib.sha256(original).hexdigest()
+
+    def test_content_key_from_manifest_matches_jtc(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore
+        from jepsen_tpu.history.columnar import read_jtc
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=300)
+        jtc = self._pack(hp)
+        key = read_jtc(jtc)[0].content_key()
+        cas = SectionStore(tmp_path / "cas")
+        cas.publish_jtc(jtc, ref="run0")
+        man = jtc.with_name(jtc.name + ".casman.json")
+        assert cas.content_key_from_manifest(man) == key
+
+    def test_shared_prefix_corpus_dedups(self, tmp_path):
+        """Two substrates sharing a long byte prefix (parent + its
+        extension) share chunk objects: honest ratio > 1."""
+        from jepsen_tpu.history.cas import SectionStore, dedup_stats
+
+        parent = write_corpus(
+            "queue", tmp_path / "a" / "history.jsonl", n=9000
+        )
+        child_dir = tmp_path / "b"
+        child_dir.mkdir()
+        child = child_dir / "history.jsonl"
+        with open(child, "wb") as fh:
+            fh.write(parent.read_bytes())
+            for op in synth_history(SynthSpec(n_ops=40, seed=2)).ops:
+                fh.write((json.dumps(norm_op(op)) + "\n").encode())
+        cas = SectionStore(tmp_path / "cas")
+        for i, p in enumerate((parent, child)):
+            cas.publish_jtc(self._pack(p), ref=f"run{i}")
+        dd = dedup_stats(tmp_path, cas)
+        assert dd["manifests"] == 2
+        assert dd["ratio"] > 1.0
+        assert dd["logical_bytes"] > dd["addressed_bytes"]
+        assert dd["missing_objects"] == 0
+
+    def test_unrelated_corpus_reports_honest_one(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore, dedup_stats
+
+        a = write_corpus(
+            "queue", tmp_path / "a" / "history.jsonl", n=200, seed=1
+        )
+        b = write_corpus(
+            "queue", tmp_path / "b" / "history.jsonl", n=200, seed=2
+        )
+        cas = SectionStore(tmp_path / "cas")
+        for i, p in enumerate((a, b)):
+            cas.publish_jtc(self._pack(p), ref=f"run{i}")
+        dd = dedup_stats(tmp_path, cas)
+        assert dd["ratio"] == pytest.approx(1.0, abs=0.01)
+
+    def test_gc_refuses_live_refs_even_forced(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=300)
+        cas = SectionStore(tmp_path / "cas")
+        cas.publish_jtc(self._pack(hp), ref="live")
+        live = cas.stats()["objects"]
+        assert live > 0
+        out = cas.gc(force=True)
+        assert out["collected"] == 0
+        assert out["refused_live"] == live
+        assert cas.stats()["objects"] == live
+        # dropping the ref releases them for a normal collect
+        cas.drop_ref("live")
+        out2 = cas.gc()
+        assert out2["collected"] == live
+        assert cas.stats()["objects"] == 0
+
+    def test_store_gc_cli_reports_and_refuses(self, tmp_path):
+        import subprocess
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=300)
+        from jepsen_tpu.history.cas import SectionStore
+
+        cas = SectionStore(tmp_path / "cas")
+        cas.publish_jtc(self._pack(hp), ref="live")
+        p = subprocess.run(
+            [sys.executable, str(REPO / "tools" / "store_gc.py"),
+             str(tmp_path), "--collect", "--force", "--verify"],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+        )
+        assert p.returncode == 0, p.stderr[-500:]
+        out = json.loads(p.stdout)
+        assert out["dedup"]["manifests"] == 1
+        assert out["verify"]["ok"] is True
+        assert out["gc"]["collected"] == 0
+        assert out["gc"]["refused_live"] > 0
+
+
+# ---------------------------------------------------------------------------
+# shrink replay over the fleet index
+# ---------------------------------------------------------------------------
+
+
+class TestShrinkReplay:
+    def test_shrink_window_finds_tail_cycle(self, tmp_path):
+        from jepsen_tpu.fuzz.replay import shrink_window
+
+        hp = tmp_path / "history.jsonl"
+        h = synth_elle_history(
+            ElleSynthSpec(n_txns=150, seed=7, g1c_cycle=1)
+        )
+        write_history_jsonl(hp, h.ops)
+        n = sum(1 for _ in open(hp, "rb"))
+        stats = shrink_window(
+            hp, tmp_path / "work", workload="elle", segment_ops=50,
+            opts={}, prefix_index=str(tmp_path / "idx"), confirm=2,
+        )
+        assert stats.n_ops == n
+        # the g1c cycle sits at the tail: the minimal red prefix is
+        # nearly the whole history, and bisection proved it
+        assert stats.min_red_ops > n // 2
+        assert stats.resumed_probes > 0
+        assert all(
+            p.red for p in stats.probes if p.n_ops >= stats.min_red_ops
+        )
+
+    def test_shrink_window_refuses_green(self, tmp_path):
+        from jepsen_tpu.fuzz.replay import shrink_window
+
+        hp = write_corpus("queue", tmp_path / "history.jsonl", n=200)
+        with pytest.raises(ValueError):
+            shrink_window(
+                hp, tmp_path / "work", workload="queue",
+                segment_ops=50, opts={},
+            )
+
+
+# ---------------------------------------------------------------------------
+# baselines: seeded regression flags, flat series stays quiet
+# ---------------------------------------------------------------------------
+
+
+class TestBaselines:
+    def _store(self, tmp_path, p50s, p99_mult=3.0):
+        import shutil
+
+        root = tmp_path / "store"
+        if root.exists():
+            shutil.rmtree(root)
+        for i, p50 in enumerate(p50s):
+            d = root / "camp" / f"run_{i:04d}"
+            d.mkdir(parents=True)
+            (d / "results.json").write_text(json.dumps({"valid?": True}))
+            (d / "report.json").write_text(json.dumps({
+                "run": d.name, "valid?": True, "ops": 10,
+                "latency-ms": {"p50": p50, "p99": p50 * p99_mult},
+            }))
+        return root
+
+    def test_seeded_regression_flags_loudly(self, tmp_path):
+        from jepsen_tpu.obs.metrics import Registry
+        from jepsen_tpu.report.baselines import collect_baselines
+        from jepsen_tpu.report.index import build_store_index
+
+        root = self._store(tmp_path, [4.0, 4.1, 3.9, 4.0, 14.0])
+        reg = Registry()
+        doc = collect_baselines(root, registry=reg)
+        assert doc["n_flags"] >= 1
+        assert any(
+            f["flag"] == "regression"
+            and "latency_p50_ms" in f["series"]
+            for f in doc["flags"]
+        )
+        assert reg.value("fleet.regression_flags") >= 1
+        idx = build_store_index(root, render_missing=False)
+        html = idx.read_text()
+        assert "REGRESSION" in html
+        assert (root / "baselines.json").is_file()
+
+    def test_flat_series_never_flags(self, tmp_path):
+        from jepsen_tpu.report.baselines import collect_baselines
+
+        root = self._store(tmp_path, [4.0, 4.0, 4.0, 4.0, 4.0])
+        doc = collect_baselines(root, registry=False)
+        assert doc["n_flags"] == 0
+
+    def test_improvement_is_not_a_regression(self, tmp_path):
+        from jepsen_tpu.report.baselines import collect_baselines
+
+        root = self._store(tmp_path, [4.0, 4.1, 3.9, 4.0, 1.0])
+        doc = collect_baselines(root, registry=False)
+        assert doc["n_flags"] == 0
+        assert any(
+            v.get("flag") == "improvement"
+            for v in doc["series"].values()
+        )
+
+    def test_short_series_never_baselines(self, tmp_path):
+        from jepsen_tpu.report.baselines import collect_baselines
+
+        root = self._store(tmp_path, [4.0, 40.0])
+        doc = collect_baselines(root, registry=False)
+        assert doc["n_flags"] == 0
+
+    def test_valid_rate_flip_flags(self, tmp_path):
+        """A config whose priors were unanimously valid flags loudly
+        on the first invalid run."""
+        from jepsen_tpu.report.baselines import collect_baselines
+
+        root = self._store(tmp_path, [4.0, 4.0, 4.0, 4.0, 4.0])
+        last = root / "camp" / "run_0004"
+        (last / "report.json").write_text(json.dumps({
+            "run": "run_0004", "valid?": False, "ops": 10,
+            "latency-ms": {"p50": 4.0, "p99": 12.0},
+        }))
+        (last / "results.json").write_text(
+            json.dumps({"valid?": False})
+        )
+        doc = collect_baselines(root, registry=False)
+        assert any(
+            f["flag"] == "regression" and "valid_rate" in f["series"]
+            for f in doc["flags"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# the verdict cache seeds from CAS manifests (dehydrated runs)
+# ---------------------------------------------------------------------------
+
+
+class TestCasSeeding:
+    def test_dehydrated_run_still_seeds_content_refs(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore
+        from jepsen_tpu.history.columnar import (
+            jtc_path_for,
+            pack_jtc,
+            read_jtc,
+        )
+        from jepsen_tpu.report.index import run_content_refs
+
+        d = tmp_path / "run0"
+        d.mkdir()
+        hp = write_corpus("queue", d / "history.jsonl", n=200)
+        (d / "results.json").write_text(json.dumps({"valid?": True}))
+        assert pack_jtc(hp) is not None
+        jtc = jtc_path_for(hp)
+        key = read_jtc(jtc)[0].content_key()
+        cas = SectionStore(tmp_path / "cas")
+        cas.publish_jtc(jtc, ref="run0")
+        # dehydrate: the .jtc AND the raw history leave disk
+        jtc.unlink()
+        hp.unlink()
+        refs = list(run_content_refs(tmp_path))
+        assert len(refs) == 1
+        got_key, workload, _opts, verdict, rel = refs[0]
+        assert got_key == key
+        assert workload == "queue"
+        assert verdict["valid?"] is True
+        assert rel == "run0"
+
+    def test_stale_manifest_never_seeds(self, tmp_path):
+        from jepsen_tpu.history.cas import SectionStore
+        from jepsen_tpu.history.columnar import jtc_path_for, pack_jtc
+        from jepsen_tpu.report.index import run_content_refs
+
+        d = tmp_path / "run0"
+        d.mkdir()
+        hp = write_corpus("queue", d / "history.jsonl", n=200)
+        (d / "results.json").write_text(json.dumps({"valid?": True}))
+        assert pack_jtc(hp) is not None
+        jtc = jtc_path_for(hp)
+        cas = SectionStore(tmp_path / "cas")
+        cas.publish_jtc(jtc, ref="run0")
+        jtc.unlink()
+        # the source is REWRITTEN after dehydration: the manifest's
+        # stamp no longer matches and the run must not seed
+        write_corpus("queue", hp, n=220, seed=9)
+        refs = list(run_content_refs(tmp_path))
+        assert refs == []

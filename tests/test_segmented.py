@@ -26,6 +26,7 @@ from jepsen_tpu.checkers.segmented import (
     LiveSegmentChecker,
     SegmentedChecker,
     checkpoint_path_for,
+    clear_checkpoints,
     read_checkpoint,
     segmented_check_file,
 )
@@ -840,3 +841,75 @@ class TestSegmentReader:
         with pytest.raises(SegmentPoisonError) as ei:
             list(iter_segments(hp, 10))
         assert ei.value.line_no == 2
+
+
+# ---------------------------------------------------------------------------
+# checkpoint identity: content hash, never basename (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointCollision:
+    def test_same_basename_checkpoint_never_serves_stale_carry(
+        self, tmp_path
+    ):
+        """Two different histories that share a BASENAME (the store's
+        run dirs all call theirs ``history.jsonl``): a checkpoint from
+        one copied beside the other (dir clone, rsync of a crashed
+        run) passes every name/config gate, so the content anchor is
+        the only thing standing between the resume and a stale carry —
+        it must refuse loudly (SourceMismatchError), never check the
+        wrong file quietly."""
+        a_dir, b_dir = tmp_path / "a", tmp_path / "b"
+        a_dir.mkdir(), b_dir.mkdir()
+        ha, hb = a_dir / "history.jsonl", b_dir / "history.jsonl"
+        write_history_jsonl(
+            ha, synth_history(SynthSpec(n_ops=400, seed=9)).ops
+        )
+        write_history_jsonl(
+            hb, synth_history(SynthSpec(n_ops=400, seed=10, lost=1)).ops
+        )
+        p = _die_env_child(ha, 100, die_after=2)
+        assert p.returncode == 137
+        cpa, cpb = checkpoint_path_for(ha), checkpoint_path_for(hb)
+        cpb.write_bytes(cpa.read_bytes())  # the collision
+        with pytest.raises(SourceMismatchError):
+            segmented_check_file(
+                hb, segment_ops=100, device=False, resume=True
+            )
+        # and the honest path: clearing the foreign checkpoint yields
+        # b's own from-scratch verdict
+        clear_checkpoints(cpb)
+        r = segmented_check_file(hb, segment_ops=100, device=False)
+        assert r["segmented"]["resumed"] is False
+
+    def test_clear_checkpoints_sweeps_tmp_not_fleet_entries(
+        self, tmp_path
+    ):
+        """``clear_checkpoints`` removes the checkpoint, its ``.prev``
+        rotation, AND crashed-writer ``.tmp`` leftovers — but never
+        fleet prefix-index entries, which are keyed by content hash
+        and can serve any future file sharing those bytes."""
+        from jepsen_tpu.history.prefix_index import PrefixCheckpointIndex
+
+        hp = tmp_path / "history.jsonl"
+        write_history_jsonl(
+            hp, synth_history(SynthSpec(n_ops=300, seed=3)).ops
+        )
+        idx = PrefixCheckpointIndex(tmp_path / "ckpt_index")
+        r = segmented_check_file(
+            hp, segment_ops=100, device=False, prefix_index=idx,
+            keep_checkpoint=True,
+        )
+        assert r["segmented"]["resumed"] is False
+        entries_before = idx.stats()["entries"]
+        assert entries_before > 0
+        cp = checkpoint_path_for(hp)
+        assert cp.exists()
+        cp.with_name(cp.name + ".prev").write_bytes(b"{}")
+        stale_tmp = cp.with_name(cp.name + ".12345.tmp")
+        stale_tmp.write_bytes(b"torn")
+        clear_checkpoints(cp)
+        assert not cp.exists()
+        assert not cp.with_name(cp.name + ".prev").exists()
+        assert not stale_tmp.exists()
+        assert idx.stats()["entries"] == entries_before

@@ -157,6 +157,37 @@ def run_fuzz(args) -> int:
         if forensics:
             print(f"# config {i + 1}: forensics page: {forensics}",
                   flush=True)
+        # evidence-level shrink (fleet memory): the recorded red's
+        # minimal op window, every re-confirmation CHECK routed through
+        # the store's prefix-checkpoint index so tail-trim probes pay
+        # for their unshared tails, not whole histories
+        hist = (
+            os.path.join(str(final.run_dir), "history.jsonl")
+            if final.run_dir else None
+        )
+        if hist and os.path.isfile(hist):
+            try:
+                from jepsen_tpu.fuzz.minimize import minimize_recorded
+
+                rs = minimize_recorded(
+                    hist,
+                    os.path.join(store, "shrink_replay"),
+                    prefix_index=os.path.join(store, "ckpt_index"),
+                    confirm=args.confirm,
+                    log=lambda s: print(f"#   {s}", flush=True),
+                )
+                print(
+                    f"# config {i + 1}: recorded window "
+                    f"{rs.n_ops} -> {rs.min_red_ops} ops "
+                    f"({len(rs.probes)} probes, "
+                    f"{rs.resumed_probes} prefix-resumed, "
+                    f"{rs.wall_s:.2f}s)", flush=True,
+                )
+            except ValueError as e:
+                # a red whose invalidity needs the FULL history (e.g.
+                # end-state loss) has no smaller window — report, keep
+                print(f"# config {i + 1}: recorded-window shrink "
+                      f"skipped: {e}", flush=True)
         # matrix auto-grow: the minimized red becomes a pinned row the
         # static matrix replays (deduped by finding identity, so a
         # re-found red bumps the existing row instead of multiplying)

@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Store CAS accounting and garbage collection (COLUMNAR.md
+§Content-addressed sections).
+
+Default is read-only: report the store's honest dedup ratio — logical
+bytes addressed by every ``.casman.json`` manifest under the store
+tree vs unique content-addressed object bytes on disk (1.0 means
+nothing is shared; the tool never inflates).  ``--collect`` removes
+UNREFERENCED objects only (hardlink count 1); a referenced object is
+live manifest data and is refused loudly even under ``--force`` — the
+flag exists so the refusal is observable, not so it can be overridden.
+
+    python tools/store_gc.py store/             # dedup report (JSON)
+    python tools/store_gc.py store/ --collect   # drop unreferenced
+    python tools/store_gc.py store/ --verify    # re-hash every object
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from jepsen_tpu.history.cas import (  # noqa: E402
+    DEFAULT_CAS_DIR,
+    SectionStore,
+    dedup_stats,
+)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("root", help="store tree holding manifests + cas/")
+    ap.add_argument(
+        "--cas", default=None,
+        help=f"CAS directory (default: <root>/{DEFAULT_CAS_DIR})",
+    )
+    ap.add_argument(
+        "--collect", action="store_true",
+        help="remove unreferenced objects (nlink == 1)",
+    )
+    ap.add_argument(
+        "--force", action="store_true",
+        help="does NOT collect referenced objects — it makes each "
+             "refusal explicit in the report",
+    )
+    ap.add_argument(
+        "--verify", action="store_true",
+        help="re-hash every object and report corruption",
+    )
+    args = ap.parse_args(argv)
+
+    cas = SectionStore(
+        args.cas if args.cas else os.path.join(args.root, DEFAULT_CAS_DIR)
+    )
+    out = {"dedup": dedup_stats(args.root, cas)}
+    if args.verify:
+        bad = []
+        for sha, _p, _size, _nlink in cas.iter_objects():
+            try:
+                cas.get(sha)
+            except Exception as e:  # noqa: BLE001 - reported, not fatal
+                bad.append({"sha": sha, "error": str(e)})
+        out["verify"] = {"corrupt": bad, "ok": not bad}
+    if args.collect:
+        out["gc"] = cas.gc(force=args.force)
+    print(json.dumps(out, indent=2))
+    if args.verify and out["verify"]["corrupt"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

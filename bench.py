@@ -3040,6 +3040,47 @@ def _bench_serve_section(details: dict) -> None:
     print(f"# serve: {json.dumps(doc)}", file=sys.stderr)
 
 
+def _bench_serve_batching_section(details: dict) -> None:
+    """``serve_batching`` (ISSUE 20): the continuous batcher —
+    cross-stream coalescing ON vs OFF at {1, 8, N} concurrent
+    small-segment streams, admitted→verdict throughput, p50/p99 added
+    latency off the coalesce sketch, batch fill fraction, warmup hit
+    on first dispatch, zero verdict divergence vs the serial oracle.
+    Scaled down in-process (the ≥2x/fill/p99 perf gates arm only at
+    the standalone evidence scale via --bat-gate-streams); both arms
+    pay real per-segment device dispatch, so the section exercises the
+    actual under-batching failure mode on every backend."""
+    import argparse
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools"),
+    )
+    import bench_serve
+
+    args = argparse.Namespace(
+        base=8, workers=2, seed=16, timeout=300.0,
+        bat_streams=16, bat_blocks=24, bat_block_rows=64,
+        target_batch=16, max_batch_wait_ms=25.0,
+        bat_min_speedup=2.0, bat_probe_load=0.6, bat_gate_streams=64,
+    )
+    failures: list[str] = []
+
+    def check(cond: bool, msg: str) -> None:
+        if not cond:
+            failures.append(msg)
+
+    doc = bench_serve.run_batching(
+        args,
+        lambda msg: print(f"# serve_batching: {msg}", file=sys.stderr),
+        check,
+    )
+    doc["pass"] = not failures
+    doc["failures"] = failures
+    details["serve_batching"] = doc
+    print(f"# serve_batching: {json.dumps(doc)}", file=sys.stderr)
+
+
 def _bench_campaign_section(details: dict) -> None:
     """``campaign`` (ISSUE 17): the continuous campaign's record→verdict
     PUSH latency — per-block p50/p99 from feed to the pushed verdict
@@ -3320,7 +3361,8 @@ def _run_once() -> None:
         _bench_elle, _bench_mutex, _bench_wgl_pcomp,
         _bench_bitpack_section, _bench_segmented_section,
         _bench_fleet_memory_section,
-        _bench_serve_section, _bench_campaign_section,
+        _bench_serve_section, _bench_serve_batching_section,
+        _bench_campaign_section,
         _bench_north_star_section, _bench_north_star_100k_section,
         _bench_cold_vs_warm_section,
         _bench_obs_overhead_section, _bench_elastic_overhead_section,

@@ -196,15 +196,16 @@ def _queue_segment_stats_np(rows: np.ndarray, pos: np.ndarray):
     return u, a, e, x, d, s, t
 
 
-def _queue_segment_stats_device(rows: np.ndarray, pos: np.ndarray):
-    """Per-segment stats through the EXISTING device kernels: values
-    remap to a dense local id space (the fixed-shape trick — the
-    global value space grows with history length, the per-segment
-    space is bounded by the segment), the scatter programs run at one
-    bucketed ``(L, V)`` shape per size class, and the host merges the
-    ``[V]`` count/min vectors into the residue."""
-    import jax.numpy as jnp
-
+def queue_prepare_rows(rows: np.ndarray, pos: np.ndarray):
+    """Host half of the device segment program, split out so the
+    service batcher can coalesce it: explode one segment's rows into
+    the padded fixed-shape arrays the scatter programs want, plus the
+    local→global value map.  Returns ``None`` when the segment has no
+    queue-relevant rows.  The ``(L, V)`` pair is the coalescing bucket
+    key — prepared segments with equal buckets stack on a leading
+    history axis and dispatch as ONE vmapped program
+    (:func:`seg_queue_batch_program`), regardless of which stream each
+    came from."""
     f = rows[:, 3]
     typ = rows[:, 2]
     val = rows[:, 4].astype(np.int64)
@@ -215,8 +216,7 @@ def _queue_segment_stats_device(rows: np.ndarray, pos: np.ndarray):
         | (f == int(OpF.DRAIN))
     )
     if not rel.any():
-        z = np.zeros(0, np.int64)
-        return z, z, z, z, z, z, z
+        return None
     u, local = np.unique(val[rel], return_inverse=True)
     n_rel = int(rel.sum())
     L = _pow2ceil(n_rel)
@@ -231,10 +231,34 @@ def _queue_segment_stats_device(rows: np.ndarray, pos: np.ndarray):
     vb[:n_rel] = local
     pb[:n_rel] = pos[rel]
     mb[:n_rel] = True
+    return {
+        "u": u, "f": fb, "typ": tb, "val": vb, "pos": pb, "mask": mb,
+        "L": L, "V": V, "n_rel": n_rel,
+    }
+
+
+def _queue_segment_stats_device(rows: np.ndarray, pos: np.ndarray):
+    """Per-segment stats through the EXISTING device kernels: values
+    remap to a dense local id space (the fixed-shape trick — the
+    global value space grows with history length, the per-segment
+    space is bounded by the segment), the scatter programs run at one
+    bucketed ``(L, V)`` shape per size class, and the host merges the
+    ``[V]`` count/min vectors into the residue."""
+    import jax.numpy as jnp
+
+    prep = queue_prepare_rows(rows, pos)
+    if prep is None:
+        z = np.zeros(0, np.int64)
+        return z, z, z, z, z, z, z
     a, e, x, d, s, t = _seg_queue_program(
-        jnp.asarray(fb), jnp.asarray(tb), jnp.asarray(vb),
-        jnp.asarray(pb), jnp.asarray(mb), V,
+        jnp.asarray(prep["f"]), jnp.asarray(prep["typ"]),
+        jnp.asarray(prep["val"]), jnp.asarray(prep["pos"]),
+        jnp.asarray(prep["mask"]), prep["V"],
     )
+    return _trim_queue_stats(prep["u"], a, e, x, d, s, t)
+
+
+def _trim_queue_stats(u, a, e, x, d, s, t):
     k = len(u)
     return (
         u,
@@ -245,6 +269,24 @@ def _queue_segment_stats_device(rows: np.ndarray, pos: np.ndarray):
         np.asarray(s)[:k].astype(np.int64),
         np.asarray(t)[:k].astype(np.int64),
     )
+
+
+def queue_stats_from_prepared(prep: dict):
+    """Single-segment stats straight off a prepared dict — the
+    batcher's per-entry SALVAGE path when a coalesced dispatch fails:
+    each entry retries alone so one poison segment quarantines one
+    stream, not its batch-mates."""
+    import jax.numpy as jnp
+
+    a, e, x, d, s, t = _seg_queue_program(
+        jnp.asarray(prep["f"]), jnp.asarray(prep["typ"]),
+        jnp.asarray(prep["val"]), jnp.asarray(prep["pos"]),
+        jnp.asarray(prep["mask"]), prep["V"],
+    )
+    return _trim_queue_stats(prep["u"], a, e, x, d, s, t)
+
+
+EMPTY_QUEUE_STATS = tuple(np.zeros(0, np.int64) for _ in range(7))
 
 
 @functools.cache
@@ -265,6 +307,66 @@ def _seg_queue_program_jit():
 
 def _seg_queue_program(f, typ, val, pos, mask, V):
     return _seg_queue_program_jit()(f, typ, val, pos, mask, V)
+
+
+@functools.cache
+def _seg_queue_batch_jit(V: int, donate: bool):
+    """Batched (vmapped) twin of the per-segment queue program: one
+    launch over ``[B, L]`` stacks covers B same-bucket segments from
+    (potentially) B different streams.  Carry state never enters the
+    program — it is pure per-segment stats; the service batcher
+    demuxes row i back to stream i's residue merge, in that stream's
+    seq order.  ``donate`` hands the staged stacks to XLA (the PR-14
+    staging-ring contract) — chip-path only, the CPU runtime leaves
+    donations unusable."""
+    import jax
+
+    from jepsen_tpu.checkers.queue_lin import queue_lin_count_vectors
+    from jepsen_tpu.checkers.total_queue import total_queue_count_vectors
+
+    def one(f, typ, val, pos, mask):
+        a, e, d = total_queue_count_vectors(f, typ, val, mask, V)
+        _, x, s, _r, t = queue_lin_count_vectors(f, typ, val, pos, mask, V)
+        return a, e, x, d, s, t
+
+    batched = jax.vmap(one)
+    if donate:
+        return jax.jit(batched, donate_argnums=(0, 1, 2, 3, 4))
+    return jax.jit(batched)
+
+
+def seg_queue_batch_program(f, typ, val, pos, mask, V, donate=False):
+    """Dispatch one coalesced super-batch: ``[B, L]`` int32 stacks
+    (``mask`` bool), dense-local value ids < ``V``.  Returns the six
+    ``[B, V]`` stat planes (device arrays; the caller trims row i to
+    its entry's ``len(u)``)."""
+    return _seg_queue_batch_jit(int(V), bool(donate))(f, typ, val, pos, mask)
+
+
+def warmup_queue_buckets(
+    buckets, batch: int, donate: bool = False
+) -> int:
+    """AOT-precompile the batched queue program for each ``(L, V)``
+    bucket at batch width ``batch`` — ``serve-checker --warmup``.  Both
+    halves of the warmup contract: ``lower().compile()`` populates the
+    persistent XLA compile cache (when enabled), and one throwaway
+    execution primes the jit dispatch cache in THIS process, so the
+    first real super-batch of a warmed bucket never eats a compile on
+    the latency path.  Returns the number of programs warmed."""
+    import jax
+    import jax.numpy as jnp
+
+    warmed = 0
+    for L, V in buckets:
+        fn = _seg_queue_batch_jit(int(V), bool(donate))
+        i32 = jax.ShapeDtypeStruct((batch, L), jnp.int32)
+        msk = jax.ShapeDtypeStruct((batch, L), jnp.bool_)
+        fn.lower(i32, i32, i32, i32, msk).compile()
+        z = jnp.zeros((batch, L), jnp.int32)
+        out = fn(z, z, z, z, jnp.zeros((batch, L), bool))
+        jax.block_until_ready(out)
+        warmed += 1
+    return warmed
 
 
 class QueueCarry:
@@ -294,7 +396,15 @@ class QueueCarry:
             if self.device
             else _queue_segment_stats_np(rows, pos)
         )
-        u, a, e, x, d, s, t = stats
+        self.merge_stats(*stats)
+
+    def merge_stats(self, u, a, e, x, d, s, t) -> None:
+        """Fold one segment's per-value stats sextet into the residue.
+        NOT order-independent across segments of one stream: settling
+        forgets ``(s, t)`` and a reopen pins ``causal=False``, so the
+        caller (the worker drain, or the batcher's demux) must merge a
+        stream's segments in seq order — the carry-isolation invariant
+        the coalesced service path preserves by construction."""
         self.attempt_count += int(a.sum())
         self.ack_count += int(e.sum())
         open_, reopened, settled = self.open, self.reopened, self.settled
@@ -1197,6 +1307,26 @@ class SegmentedChecker:
             return
         try:
             self.carry.feed_rows(rows, rows[:, 0].astype(np.int64))
+        except Exception as e:  # noqa: BLE001 - quarantined as evidence
+            self.quarantine(self.segments, f"{type(e).__name__}: {e}")
+        self.segments += 1
+        self.ops_seen += n_ops
+
+    def merge_queue_stats(self, stats, n_ops: int) -> None:
+        """Demux half of the coalesced service step: fold one
+        pre-computed per-segment stats sextet (from the batched device
+        program) into the queue carry — ≡ :meth:`feed_rows` on the
+        rows those stats were prepared from, provided the caller
+        merges this stream's segments in seq order."""
+        if self.workload != "queue":
+            raise ValueError(
+                "batched stats are the queue family's substrate; "
+                f"{self.workload} streams ops"
+            )
+        if self.quarantines:
+            return
+        try:
+            self.carry.merge_stats(*stats)
         except Exception as e:  # noqa: BLE001 - quarantined as evidence
             self.quarantine(self.segments, f"{type(e).__name__}: {e}")
         self.segments += 1

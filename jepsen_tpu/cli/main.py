@@ -1628,11 +1628,21 @@ def cmd_serve(args) -> int:
 def cmd_serve_checker(args) -> int:
     from jepsen_tpu.service.server import serve_forever
 
+    buckets = []
+    for part in str(args.warmup_buckets).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        length, space = part.split(":", 1)
+        buckets.append((int(length), int(space)))
     serve_forever(
         host=args.host, port=args.port, seq=args.seq, store=args.store,
         metrics_port=args.metrics_port, workers=args.workers,
         max_streams=args.max_streams, ingress_cap=args.ingress_cap,
         stream_deadline_s=args.stream_deadline,
+        batch=args.batch, target_batch=args.target_batch,
+        max_batch_wait_ms=args.max_batch_wait_ms,
+        warmup=args.warmup, warmup_buckets=tuple(buckets),
     )
     return 0
 
@@ -2505,6 +2515,44 @@ def build_parser() -> argparse.ArgumentParser:
         default=120.0,
         help="seconds an open stream may sit idle before it is "
         "quarantined as overdue (unknown-with-evidence, slot freed)",
+    )
+    sc.add_argument(
+        "--batch",
+        action="store_true",
+        help="continuous batching: coalesce ready segments across ALL "
+        "admitted streams into full shape-bucketed super-batches "
+        "(carries never mix — batching crosses streams only on the "
+        "history axis), dispatched at target size or the latency "
+        "budget, whichever first",
+    )
+    sc.add_argument(
+        "--target-batch",
+        type=int,
+        default=32,
+        help="--batch: segments per coalesced super-batch (the device "
+        "batch width is the next pow2)",
+    )
+    sc.add_argument(
+        "--max-batch-wait-ms",
+        type=float,
+        default=25.0,
+        help="--batch: latency budget — a bucket's oldest parked "
+        "segment never waits longer than this before dispatch, even "
+        "in a partial batch (deadline-aware, never starvation)",
+    )
+    sc.add_argument(
+        "--warmup",
+        action="store_true",
+        help="--batch: AOT-precompile the configured bucket set at "
+        "service start (into the persistent XLA compile cache where "
+        "enabled) so a cold bucket's first super-batch pays no "
+        "compile on the latency path; hits/misses on /metrics",
+    )
+    sc.add_argument(
+        "--warmup-buckets",
+        default="128:128,256:256",
+        help="--warmup: comma-separated L:V shape buckets to "
+        "precompile (pow2 row/value size classes)",
     )
     sc.set_defaults(fn=cmd_serve_checker)
 

@@ -251,6 +251,7 @@ def read_jtc(path: str | Path) -> tuple[Jtc, dict]:
         workload=workload,
         src_name=src_name.rstrip(b"\x00").decode("utf-8", "replace"),
     )
+    data_end = table_end + _CRC.size
     for i in range(n_sections):
         kind, dtype_code, nrows, ncols, off, length, crc, flags = (
             _SECTION.unpack_from(mm, _HEADER.size + i * _SECTION.size)
@@ -281,6 +282,31 @@ def read_jtc(path: str | Path) -> tuple[Jtc, dict]:
             arr = arr.reshape(int(nrows), int(ncols))
         out.arrays[kind] = arr
         out.flags[kind] = flags
+        data_end = max(data_end, off + length)
+    # Trailing bytes after the last payload must be exactly the digest
+    # footer (DIGEST_MAGIC + count + sha256s + CRC): a flip or tear in
+    # the footer region is corruption like any other, never "padding".
+    # Legacy pre-footer packs end at the last payload and skip this.
+    if size > data_end:
+        foot_len = _DIGEST_HEAD.size + 32 * n_sections + _CRC.size
+        if size - data_end != foot_len:
+            raise ColumnarFormatError(
+                f"{path}: {size - data_end} trailing B after sections "
+                f"(digest footer is {foot_len} B) — truncated tail"
+            )
+        foot = mm[data_end:size]
+        magic_f, count = _DIGEST_HEAD.unpack_from(foot, 0)
+        if magic_f != DIGEST_MAGIC or count != n_sections:
+            raise ColumnarFormatError(
+                f"{path}: digest footer checksum mismatch (bad magic or "
+                f"section count)"
+            )
+        (foot_crc,) = _CRC.unpack_from(foot, foot_len - _CRC.size)
+        if zlib.crc32(foot[: foot_len - _CRC.size]) != foot_crc:
+            raise ColumnarFormatError(
+                f"{path}: digest footer checksum mismatch (bit flip or "
+                f"torn write)"
+            )
     stamp = {
         "src_name": out.src_name,
         "src_size": src_size,

@@ -877,6 +877,78 @@ def _pow2_bucket(n: int, floor: int = 128) -> int:
     return b
 
 
+class StagingRing:
+    """Donation-aware staging-buffer ring for one coalescing bucket
+    (ISSUE 20): ``depth`` recycled slots of preallocated host arrays at
+    the bucket's fixed ``[batch, length]`` shape, so steady-state
+    dispatch allocates NOTHING on the host side — entries are copied
+    into a recycled slot, staged to the device in one put per plane,
+    and the device copies are donated to the batched program
+    (:func:`jepsen_tpu.checkers.segmented.seg_queue_batch_program`).
+
+    Discipline: the dispatcher ``acquire``s a slot, fills it, and
+    launches; the collector ``release``s it only AFTER materializing
+    the results (``np.asarray`` blocks on the computation), so a slot
+    is never overwritten while a launch could still read it.  ``depth``
+    is the dispatch pipelining bound — with depth 2 the next
+    super-batch stages while the previous one computes."""
+
+    def __init__(self, batch: int, length: int, depth: int = 2):
+        self.batch = batch
+        self.length = length
+        self._free: queue.Queue = queue.Queue()
+        for _ in range(max(1, depth)):
+            self._free.put({
+                "f": np.full((batch, length), -1, np.int32),
+                "typ": np.full((batch, length), -1, np.int32),
+                "val": np.zeros((batch, length), np.int32),
+                "pos": np.zeros((batch, length), np.int32),
+                "mask": np.zeros((batch, length), bool),
+            })
+
+    def acquire(self, timeout: float | None = None):
+        try:
+            return self._free.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def release(self, slot) -> None:
+        self._free.put(slot)
+
+    def fill(self, slot, preps) -> None:
+        """Copy ``len(preps)`` prepared segments into the slot's rows;
+        rows past the fill are masked out (the program sees them as
+        empty segments), so every launch runs at the ONE compiled
+        ``[batch, length]`` shape the warmup covered."""
+        n = len(preps)
+        for i, p in enumerate(preps):
+            slot["f"][i] = p["f"]
+            slot["typ"][i] = p["typ"]
+            slot["val"][i] = p["val"]
+            slot["pos"][i] = p["pos"]
+            slot["mask"][i] = p["mask"]
+        if n < self.batch:
+            slot["mask"][n:] = False
+
+
+def dispatch_coalesced(slot, V: int, donate: bool | None = None):
+    """Stage one filled ring slot and launch the batched queue program
+    — the pre-coalesced-bucket dispatch entry the service batcher
+    calls.  Returns the six ``[batch, V]`` device stat planes (async on
+    real accelerators; the caller demuxes after materializing)."""
+    import jax.numpy as jnp
+
+    from jepsen_tpu.checkers.segmented import seg_queue_batch_program
+
+    if donate is None:
+        donate = _default_donate()
+    return seg_queue_batch_program(
+        jnp.asarray(slot["f"]), jnp.asarray(slot["typ"]),
+        jnp.asarray(slot["val"]), jnp.asarray(slot["pos"]),
+        jnp.asarray(slot["mask"]), int(V), donate=donate,
+    )
+
+
 def _chunks(seq: Sequence[Any], size: int) -> list[Sequence[Any]]:
     size = max(1, size)
     return [seq[i : i + size] for i in range(0, len(seq), size)]

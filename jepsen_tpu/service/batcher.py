@@ -1,0 +1,555 @@
+"""Continuous batching for the verification service (ISSUE 20).
+
+The PR-16 service dispatches device work at CLAIM granularity: a
+worker drains whatever its claimed stream has ready and launches the
+verdict program on that fragment.  Under many concurrent small
+streams the device runs short, padding-heavy batches at low occupancy
+— the under-batching failure mode inference servers solve with
+continuous batching.  This module is that scheduler, sitting between
+stream ingestion (:class:`~jepsen_tpu.service.stream.IngestService`)
+and the PR-15 segmented carry engines:
+
+- **Cross-stream coalescing.**  Every accepted queue-family rows
+  block is host-prepared (:func:`queue_prepare_rows`) on the feeding
+  connection's thread and parked in a per-shape-bucket queue keyed
+  ``(L, V)`` — the same pow2 size classes the per-segment program
+  compiles at.  A bucket launches when it reaches the target batch
+  size OR its oldest entry exceeds the latency budget
+  (``max_batch_wait_ms``) — size-or-deadline, never starvation.
+
+- **Carry isolation.**  Batching crosses streams ONLY on the history
+  axis: the batched program
+  (:func:`~jepsen_tpu.checkers.segmented.seg_queue_batch_program`) is
+  pure per-segment stats — no carry state ever enters a launch.
+  Results demux back to each stream through a per-stream reorder
+  buffer and merge into that stream's residue strictly in seq order
+  (``QueueCarry.merge_stats`` is NOT order-independent: settling
+  forgets ``(s, t)`` and a reopen pins ``causal=False``), so every
+  verdict and every carry is ≡ the per-stream serial oracle.
+
+- **Donation-aware staging ring.**  Each bucket owns a
+  :class:`~jepsen_tpu.parallel.pipeline.StagingRing` of
+  ``dispatch_depth`` recycled host slots at the one compiled
+  ``[batch, L]`` shape; steady-state dispatch allocates nothing, and
+  the staged device copies are donated on backends where donation is
+  usable.
+
+- **Backpressure.**  Parked entries stay counted in the service's
+  ``_queued_blocks`` ingress bound, so a full coalescing queue counts
+  against admission — the batcher can never buffer unboundedly behind
+  a loud ``SATURATED`` front door.  Entries whose stream dies
+  (abort / quarantine / deadline reap) are evicted and surfaced as
+  ``service.batcher_evictions{reason}``; a parked-age bound
+  (``park_max_s``) force-dispatches anything the size-or-deadline
+  loop could not move (e.g. behind a wedged ring), so a ``SATURATED``
+  reject mid-coalesce never strands a stream's partial segments.
+
+Locking: the batcher shares the service's lock (one lock, two
+condition variables) — every queue mutation happens under it, so the
+service's abort/quarantine/reap paths purge parked entries without
+lock-order hazards.  The engine itself is only ever touched by the
+collector thread (under ``st.busy``, the same single-claimer
+discipline workers use), or by a worker running ``finish()`` after
+the in-flight count drains to zero.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue as queue_mod
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+logger = logging.getLogger("jepsen_tpu.service.batcher")
+
+#: bucket pseudo-keys for entries that never reach the device program
+EMPTY_BUCKET = ("empty",)  # rows with no queue-relevant ops
+PASS_BUCKET = ("pass",)  # ops-JSON blocks on a queue stream
+
+
+def _pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b <<= 1
+    return b
+
+
+class ContinuousBatcher:
+    """The admission-to-dispatch scheduler.  Constructed by
+    :class:`IngestService` when batching is enabled; all knobs are
+    constructor-explicit so tests and the bench pin tiny bounds."""
+
+    def __init__(
+        self,
+        service,
+        target_batch: int = 32,
+        max_wait_ms: float = 25.0,
+        dispatch_depth: int = 2,
+        park_max_s: float = 5.0,
+        donate: bool | None = None,
+        registry=None,
+    ):
+        from jepsen_tpu.parallel.pipeline import _default_donate
+
+        self.svc = service
+        self.target = max(1, int(target_batch))
+        self.batch = _pow2(self.target)  # the ONE compiled batch width
+        self.wait_s = max(0.0, float(max_wait_ms) / 1000.0)
+        self.depth = max(1, int(dispatch_depth))
+        # the stranding backstop is ABSOLUTE: it must fire even when
+        # the coalescing deadline is configured far beyond it
+        self.park_max_s = max(0.05, float(park_max_s))
+        self.donate = _default_donate() if donate is None else bool(donate)
+
+        self._lock = service._lock  # ONE lock with the service
+        self._cond = threading.Condition(self._lock)
+        self._buckets: dict[tuple, deque] = {}
+        self._rings: dict[tuple, object] = {}
+        self._warmed: set[tuple] = set()
+        self._seen: set[tuple] = set()  # buckets that already dispatched
+        self._closing = False
+        self._collect_q: queue_mod.Queue = queue_mod.Queue(
+            maxsize=self.depth
+        )
+        self._idle_since = time.perf_counter()
+
+        if registry is None:
+            registry = service.metrics
+        self.metrics = registry
+        self._c_batches = registry.counter("service.batches")
+        self._c_blocks = registry.counter("service.batched_blocks")
+        self._c_salvage = registry.counter("service.batch_salvages")
+        self._c_whit = registry.counter("service.warmup_hits")
+        self._c_wmiss = registry.counter("service.warmup_misses")
+        self._s_fill = registry.sketch("service.batch_fill")
+        self._s_waste = registry.sketch("service.batch_pad_waste")
+        self._s_coalesce = registry.sketch("service.batch_coalesce_s")
+        self._s_dispatch = registry.sketch("service.batch_dispatch_s")
+        self._s_occupancy = registry.sketch("service.batch_occupancy")
+
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="svc-batcher", daemon=True
+        )
+        self._collector = threading.Thread(
+            target=self._collect_loop, name="svc-batch-collect", daemon=True
+        )
+        self._dispatcher.start()
+        self._collector.start()
+
+    # -- warmup ------------------------------------------------------------
+
+    def warmup(self, buckets) -> int:
+        """AOT-precompile the batched program for each ``(L, V)``
+        bucket at this batcher's batch width (``serve-checker
+        --warmup``): the first super-batch of a warmed bucket pays no
+        compile on the latency path, counted as ``service.warmup_hits``
+        when it lands."""
+        from jepsen_tpu.checkers.segmented import warmup_queue_buckets
+
+        keys = [(int(L), int(V)) for L, V in buckets]
+        n = warmup_queue_buckets(keys, batch=self.batch, donate=self.donate)
+        self._warmed.update(keys)
+        logger.info(
+            "batcher warmup: %d bucket program(s) compiled at batch %d",
+            n, self.batch,
+        )
+        return n
+
+    # -- ingestion side ----------------------------------------------------
+
+    def offer(self, st, seq: int, block_kind: str, payload,
+              n_ops: int) -> None:
+        """Park one accepted block (called WITHOUT the service lock —
+        host prep runs on the feeding connection's thread, so prep
+        parallelizes across clients instead of serializing the
+        dispatcher).  The service already counted the block against
+        the ingress bound and the stream's in-flight count."""
+        entry = {
+            "sid": st.sid, "seq": int(seq), "n_ops": int(n_ops),
+            "t_enq": time.monotonic(), "prep": None, "payload": None,
+            "err": None, "stats": None,
+        }
+        if block_kind == "rows":
+            from jepsen_tpu.checkers.segmented import (
+                EMPTY_QUEUE_STATS,
+                queue_prepare_rows,
+            )
+
+            rows = np.asarray(payload, np.int32)
+            if rows.ndim != 2 or rows.shape[1] != 8:
+                entry["err"] = f"malformed rows block: shape {rows.shape}"
+                key = EMPTY_BUCKET
+            else:
+                prep = queue_prepare_rows(
+                    rows, rows[:, 0].astype(np.int64)
+                )
+                if prep is None:
+                    entry["stats"] = EMPTY_QUEUE_STATS
+                    key = EMPTY_BUCKET
+                else:
+                    entry["prep"] = prep
+                    key = (prep["L"], prep["V"])
+        else:
+            entry["payload"] = (block_kind, payload)
+            key = PASS_BUCKET
+        with self._lock:
+            cur = self.svc._streams.get(st.sid)
+            if cur is not st or st.done.is_set() or st.quarantined:
+                # the stream died between accept and park: the block
+                # was counted — release it loudly, never strand it
+                self._evict_locked(st, 1, "dead-stream")
+                return
+            self._buckets.setdefault(key, deque()).append(entry)
+            self._cond.notify()
+
+    def purge_stream_locked(self, st, reason: str) -> None:
+        """Drop every parked entry and pending demux result of one
+        stream (caller holds the lock) — the abort / quarantine /
+        deadline-reap hook.  In-flight launches containing the stream
+        are unaffected; the collector drops their rows on landing.
+        Batch-mates are untouched either way."""
+        dropped = 0
+        for dq in self._buckets.values():
+            if not dq:
+                continue
+            keep = [e for e in dq if e["sid"] != st.sid]
+            if len(keep) != len(dq):
+                dropped += len(dq) - len(keep)
+                dq.clear()
+                dq.extend(keep)
+        dropped += len(st.batch_results)
+        st.batch_results.clear()
+        if dropped:
+            self._evict_locked(st, dropped, reason)
+
+    def _evict_locked(self, st, n: int, reason: str) -> None:
+        svc = self.svc
+        svc._queued_blocks = max(0, svc._queued_blocks - n)
+        svc._g_depth.set(svc._queued_blocks)
+        st.batch_inflight = max(0, st.batch_inflight - n)
+        self.metrics.counter(
+            "service.batcher_evictions", reason=reason
+        ).inc(n)
+
+    def parked_locked(self) -> int:
+        return sum(len(dq) for dq in self._buckets.values())
+
+    def close_locked(self) -> None:
+        self._closing = True
+        self._cond.notify_all()
+
+    def join(self, timeout: float = 2.0) -> None:
+        self._dispatcher.join(timeout=timeout)
+        self._collector.join(timeout=timeout)
+
+    # -- dispatch loop -----------------------------------------------------
+
+    def _ready_key_locked(self, now: float):
+        """Size-or-deadline: a bucket at target size dispatches NOW; a
+        bucket whose oldest entry exceeded the budget dispatches
+        partial (never starvation).  Overdue-past-park-bound buckets
+        trump everything (the stranded-segment backstop).  A bucket
+        holding a finish-requested stream's entries is drained
+        immediately — close must not ride out the coalescing deadline."""
+        best, best_age = None, -1.0
+        streams = self.svc._streams
+        for key, dq in self._buckets.items():
+            if not dq:
+                continue
+            age = now - dq[0]["t_enq"]
+            if age >= self.park_max_s:
+                return key
+            ready = len(dq) >= self.target or age >= self.wait_s
+            if not ready:
+                ready = any(
+                    (s := streams.get(e["sid"])) is not None
+                    and s.finish_requested
+                    for e in dq
+                )
+            if ready and age > best_age:
+                best, best_age = key, age
+        return best
+
+    def hurry_locked(self) -> None:
+        """Wake the dispatcher out of its deadline sleep (caller holds
+        the lock) — the finish() drain hook."""
+        self._cond.notify()
+
+    def _next_deadline_locked(self, now: float) -> float:
+        dt = 0.25
+        for dq in self._buckets.values():
+            if dq:
+                dt = min(dt, max(0.0, self.wait_s
+                                 - (now - dq[0]["t_enq"])))
+        return dt
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            key = entries = None
+            with self._cond:
+                while True:
+                    if self._closing or not self.svc._running:
+                        break
+                    now = time.monotonic()
+                    key = self._ready_key_locked(now)
+                    if key is not None:
+                        dq = self._buckets[key]
+                        entries = []
+                        while dq and len(entries) < self.target:
+                            e = dq.popleft()
+                            st = self.svc._streams.get(e["sid"])
+                            if (st is None or st.done.is_set()
+                                    or st.quarantined):
+                                if st is not None:
+                                    self._evict_locked(
+                                        st, 1, "dead-stream"
+                                    )
+                                else:
+                                    self.svc._queued_blocks = max(
+                                        0, self.svc._queued_blocks - 1
+                                    )
+                                    self.svc._g_depth.set(
+                                        self.svc._queued_blocks
+                                    )
+                                    self.metrics.counter(
+                                        "service.batcher_evictions",
+                                        reason="dead-stream",
+                                    ).inc()
+                                continue
+                            entries.append(e)
+                        if entries:
+                            break
+                        entries = None
+                        continue  # bucket drained by evictions: rescan
+                    self._cond.wait(
+                        timeout=self._next_deadline_locked(now)
+                    )
+            if entries is None:
+                # closing: sentinel goes out OUTSIDE the lock (the
+                # bounded collect queue must never block a lock holder)
+                self._collect_q.put(None)
+                return
+            t0 = time.perf_counter()
+            try:
+                self._launch(key, entries)
+            except Exception:  # noqa: BLE001 — salvage already tried
+                logger.exception("batcher: launch of %s failed", key)
+                for e in entries:
+                    e["err"] = e["err"] or "batched dispatch failed"
+                self._collect_q.put((None, None, entries, None, t0))
+            t1 = time.perf_counter()
+            idle = max(0.0, t0 - self._idle_since)
+            busy = t1 - t0
+            if busy + idle > 0:
+                self._s_occupancy.add(busy / (busy + idle))
+            self._idle_since = t1
+
+    def _ring(self, key):
+        ring = self._rings.get(key)
+        if ring is None:
+            from jepsen_tpu.parallel.pipeline import StagingRing
+
+            L, _V = key
+            ring = self._rings[key] = StagingRing(
+                self.batch, L, depth=self.depth
+            )
+        return ring
+
+    def _launch(self, key, entries) -> None:
+        now = time.monotonic()
+        for e in entries:
+            self._s_coalesce.add(now - e["t_enq"])
+        self._c_batches.inc()
+        self._c_blocks.inc(len(entries))
+        if key in (EMPTY_BUCKET, PASS_BUCKET):
+            # nothing for the device: straight to the demux, keeping
+            # the per-stream seq order the reorder buffer enforces
+            self._collect_q.put(
+                (None, None, entries, None, time.perf_counter())
+            )
+            return
+        L, V = key
+        if key not in self._seen:
+            self._seen.add(key)
+            (self._c_whit if key in self._warmed
+             else self._c_wmiss).inc()
+        self._s_fill.add(len(entries) / self.batch)
+        used = sum(e["prep"]["n_rel"] for e in entries)
+        self._s_waste.add(1.0 - used / float(self.batch * L))
+        ring = self._ring(key)
+        while True:
+            slot = ring.acquire(timeout=0.5)
+            if slot is not None:
+                break
+            if self._closing or not self.svc._running:
+                raise RuntimeError("batcher closing with ring busy")
+        t0 = time.perf_counter()
+        try:
+            from jepsen_tpu.parallel.pipeline import dispatch_coalesced
+
+            ring.fill(slot, [e["prep"] for e in entries])
+            dev = dispatch_coalesced(slot, V, donate=self.donate)
+        except Exception as err:  # noqa: BLE001 — salvage per entry
+            ring.release(slot)
+            logger.warning(
+                "batcher: coalesced dispatch %s failed (%s); "
+                "salvaging per entry", key, err,
+            )
+            self._salvage(entries)
+            self._collect_q.put((None, None, entries, None, t0))
+            return
+        self._collect_q.put((key, slot, entries, dev, t0))
+
+    def _salvage(self, entries) -> None:
+        """Per-entry serial retry after a failed coalesced launch: one
+        poison segment quarantines ONE stream, not its batch-mates."""
+        from jepsen_tpu.checkers.segmented import queue_stats_from_prepared
+
+        self._c_salvage.inc()
+        for e in entries:
+            try:
+                e["stats"] = queue_stats_from_prepared(e["prep"])
+            except Exception as err:  # noqa: BLE001 — that entry only
+                e["err"] = (
+                    f"segment failed batched AND solo dispatch: "
+                    f"{type(err).__name__}: {err}"
+                )
+
+    # -- collect / demux ---------------------------------------------------
+
+    def _collect_loop(self) -> None:
+        from jepsen_tpu.obs import trace as obs_trace
+
+        while True:
+            item = self._collect_q.get()
+            if item is None:
+                return
+            key, slot, entries, dev, t0 = item
+            if dev is not None:
+                from jepsen_tpu.checkers.segmented import _trim_queue_stats
+
+                planes = [np.asarray(p) for p in dev]  # blocks on device
+                for i, e in enumerate(entries):
+                    e["stats"] = _trim_queue_stats(
+                        e["prep"]["u"], *(p[i] for p in planes)
+                    )
+                ring = self._rings[key]
+                ring.release(slot)
+            t1 = time.perf_counter()
+            self._s_dispatch.add(t1 - t0)
+            if obs_trace.is_enabled():
+                obs_trace.complete(
+                    "service.batch", t0, t1, track="service",
+                    args={
+                        "bucket": "x".join(str(k) for k in (key or ())),
+                        "entries": len(entries),
+                    },
+                )
+            try:
+                self._demux(entries)
+            except Exception:  # noqa: BLE001 — must not kill the loop
+                logger.exception("batcher: demux failed")
+
+    def _demux(self, entries) -> None:
+        """Hand every landed entry to its stream's reorder buffer and
+        merge each stream's contiguous run IN SEQ ORDER — the other
+        half of the carry-isolation invariant."""
+        svc = self.svc
+        runs: dict[str, tuple] = {}  # sid -> (st, [entry, ...])
+        with self._lock:
+            for e in entries:
+                st = svc._streams.get(e["sid"])
+                if st is None or st.done.is_set() or st.quarantined:
+                    if st is not None:
+                        self._evict_locked(st, 1, "dead-stream")
+                    else:
+                        svc._queued_blocks = max(
+                            0, svc._queued_blocks - 1
+                        )
+                        svc._g_depth.set(svc._queued_blocks)
+                        self.metrics.counter(
+                            "service.batcher_evictions",
+                            reason="dead-stream",
+                        ).inc()
+                    continue
+                st.batch_results[e["seq"]] = e
+                if e["sid"] not in runs:
+                    runs[e["sid"]] = (st, [])
+            for sid, (st, run) in list(runs.items()):
+                while st.batch_next_merge in st.batch_results:
+                    run.append(st.batch_results.pop(st.batch_next_merge))
+                    st.batch_next_merge += 1
+                if not run:
+                    del runs[sid]
+                else:
+                    # single-claimer: workers cannot hold a stream with
+                    # in-flight batched blocks (finish is gated), so
+                    # busy is free to take here
+                    st.busy = True
+        for st, run in runs.values():
+            try:
+                self._merge_run(st, run)
+            except Exception as err:  # noqa: BLE001 — that stream only
+                logger.exception(
+                    "batcher: merge into %s failed", st.sid
+                )
+                with self._lock:
+                    self._evict_locked(st, len(run), "demux-error")
+                    st.busy = False
+                    svc._quarantine_locked(
+                        st,
+                        f"batched demux error: {type(err).__name__}: "
+                        f"{err}",
+                        finalize_if_free=st.finish_requested,
+                    )
+
+    def _merge_run(self, st, run) -> None:
+        """Fold one stream's contiguous landed run into its engine
+        (outside the lock — single-claimer via ``st.busy``), then book
+        the blocks, emit verdict windows, and release the claim."""
+        svc = self.svc
+        merged = []
+        error = None
+        for e in run:
+            if e["err"] is not None:
+                st.engine.quarantine(st.engine.segments, e["err"])
+                error = e["err"]
+            elif e["payload"] is not None:
+                bkind, payload = e["payload"]
+                svc._feed_engine(st, bkind, payload, e["n_ops"])
+            else:
+                st.engine.merge_queue_stats(e["stats"], e["n_ops"])
+            if st.engine.quarantines:
+                st.quarantined = True
+            merged.append((e, svc._valid_so_far(st)))
+        nb = st.carry_nbytes
+        if st.kind == "stream" and not st.quarantined:
+            # one footprint refresh per landed run (amortized over
+            # the batch, vs the worker path's per-block snapshot)
+            nb = st.engine.state_nbytes()
+        with self._lock:
+            for e, vsf in merged:
+                st.blocks_fed += 1
+                st.ops_fed += e["n_ops"]
+                svc._queued_blocks = max(0, svc._queued_blocks - 1)
+                st.batch_inflight = max(0, st.batch_inflight - 1)
+                svc._c_blocks.inc()
+                if not st.done.is_set():
+                    svc._emit_window_locked(st, vsf)
+            svc._g_depth.set(svc._queued_blocks)
+            if not st.done.is_set():
+                svc._carry_total += nb - st.carry_nbytes
+                st.carry_nbytes = nb
+                svc._g_carry.set(svc._carry_total)
+            st.busy = False
+            if st.quarantined:
+                svc._quarantine_locked(
+                    st,
+                    error or "segment quarantined in batched merge",
+                    finalize_if_free=st.finish_requested,
+                )
+            elif st.finish_requested and st.batch_inflight == 0:
+                svc._schedule_locked(st)

@@ -107,9 +107,16 @@ class VerdictCache:
         report_ref: str | None = None,
     ) -> None:
         entry = {"verdict": verdict}
-        if report_ref is not None:
-            entry["report_ref"] = report_ref
         with self._lock:
+            if report_ref is None:
+                # a live-stream re-verification of a seeded history
+                # must not orphan its recorded run: the refreshed entry
+                # keeps serving the PR-11 report route for hits
+                prev = self._entries.get(key)
+                if prev is not None:
+                    report_ref = prev.get("report_ref")
+            if report_ref is not None:
+                entry["report_ref"] = report_ref
             self._entries[key] = entry
             self._entries.move_to_end(key)
             while len(self._entries) > self.capacity:

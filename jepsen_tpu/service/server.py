@@ -757,6 +757,11 @@ def serve_forever(
     max_streams: int = 256,
     ingress_cap: int = 1024,
     stream_deadline_s: float = 120.0,
+    batch: bool = False,
+    target_batch: int = 32,
+    max_batch_wait_ms: float = 25.0,
+    warmup: bool = False,
+    warmup_buckets=((128, 128), (256, 256)),
 ) -> None:
     import jax
 
@@ -796,8 +801,20 @@ def serve_forever(
             "max_streams": max_streams,
             "ingress_cap": ingress_cap,
             "stream_deadline_s": stream_deadline_s,
+            # continuous batching (ISSUE 20): cross-stream coalescing
+            # with AOT bucket warmup off the latency path
+            "batch": batch,
+            "target_batch": target_batch,
+            "max_batch_wait_ms": max_batch_wait_ms,
+            "warmup": warmup,
+            "warmup_buckets": tuple(warmup_buckets),
         },
     )
+    if batch and warmup:
+        # the batcher (and its AOT warmup) is built lazily with the
+        # ingest core — force it NOW so the compile happens at service
+        # start, not on the first admitted stream's latency path
+        srv.ingest_service()
     metrics_note = "off"
     if metrics_port >= 0:
         try:

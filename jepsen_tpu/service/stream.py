@@ -112,6 +112,7 @@ class _Stream:
         "created", "t0", "deadline", "digest", "content_key",
         "dead_workers", "carry_nbytes",
         "windows", "window_base", "subscribers",
+        "batch_inflight", "batch_next_merge", "batch_results",
     )
 
     def __init__(self, sid, workload, opts, engine, kind, deadline_s):
@@ -146,6 +147,10 @@ class _Stream:
         self.windows: deque = deque()  # retained verdict windows (replay)
         self.window_base = 0  # window index of windows[0] (the floor)
         self.subscribers: list = []  # live SimpleQueue sinks
+        # -- continuous batching (ISSUE 20) --
+        self.batch_inflight = 0  # accepted, not yet merged/evicted
+        self.batch_next_merge = 0  # next seq the demux may fold in
+        self.batch_results: dict = {}  # seq -> landed entry (reorder)
 
 
 def _wire_safe(v):
@@ -194,6 +199,15 @@ class IngestService:
         block_delay_s: float = 0.0,
         die_after: tuple[int, int] | None = None,
         done_ttl_s: float = 300.0,
+        batch: bool = False,
+        target_batch: int = 32,
+        max_batch_wait_ms: float = 25.0,
+        dispatch_depth: int = 2,
+        park_max_s: float = 5.0,
+        warmup: bool = False,
+        warmup_buckets: Sequence[tuple[int, int]] = (
+            (128, 128), (256, 256),
+        ),
     ):
         if workers < 1:
             raise ValueError("need at least one checker worker")
@@ -248,6 +262,23 @@ class IngestService:
             self._workers.append(t)
             t.start()
         self._g_alive.set(workers)
+        # continuous batching (ISSUE 20): opt-in cross-stream
+        # coalescing of queue-family rows blocks into full
+        # shape-bucketed super-batches, bounded by a latency budget
+        self._batcher = None
+        if batch:
+            from jepsen_tpu.service.batcher import ContinuousBatcher
+
+            self._batcher = ContinuousBatcher(
+                self,
+                target_batch=target_batch,
+                max_wait_ms=max_batch_wait_ms,
+                dispatch_depth=dispatch_depth,
+                park_max_s=park_max_s,
+                registry=registry,
+            )
+            if warmup:
+                self._batcher.warmup(warmup_buckets)
         self._reaper = threading.Thread(
             target=self._reap, name="svc-reaper", daemon=True
         )
@@ -376,11 +407,24 @@ class IngestService:
             block = (seq, block_kind, payload, n_ops)
             if st.shape is None:
                 st.shape = _block_shape(st.workload, block)
-            st.pending.append(block)
+            batched = (
+                self._batcher is not None and st.workload == "queue"
+            )
             self._queued_blocks += 1
             self._g_depth.set(self._queued_blocks)
-            self._schedule_locked(st)
+            if batched:
+                # the coalescing path: parked entries stay counted in
+                # the ingress bound above, so a full coalescing queue
+                # counts against admission — never unbounded buffering
+                st.batch_inflight += 1
+            else:
+                st.pending.append(block)
+                self._schedule_locked(st)
             depth = self._queued_blocks
+        if batched:
+            # host prep + parking run on THIS connection's thread (the
+            # lock is released): prep parallelizes across clients
+            self._batcher.offer(st, seq, block_kind, payload, n_ops)
         if self.cache is not None:
             # content digest feeds ONLY the verdict cache key — with no
             # cache attached it is pure submit-path overhead (measured
@@ -417,6 +461,8 @@ class IngestService:
                 self._queued_blocks -= len(st.pending)
                 st.pending.clear()
                 self._g_depth.set(self._queued_blocks)
+            if self._batcher is not None:
+                self._batcher.purge_stream_locked(st, "aborted")
             if not st.done.is_set():
                 self._active -= 1
                 self._g_active.set(self._active)
@@ -440,6 +486,10 @@ class IngestService:
                 return {"op": "error", "error": f"unknown stream {sid!r}"}
             st.finish_requested = True
             self._schedule_locked(st)
+            if self._batcher is not None:
+                # drain: parked entries of a closing stream dispatch
+                # now instead of riding out the coalescing deadline
+                self._batcher.hurry_locked()
         limit = timeout if timeout is not None else max(
             0.0, st.deadline - time.monotonic()
         ) + 1.0
@@ -493,6 +543,8 @@ class IngestService:
             if st is not None:
                 st.finish_requested = True
                 self._schedule_locked(st)
+                if self._batcher is not None:
+                    self._batcher.hurry_locked()
         return {"op": "accepted", "id": sid}
 
     def collect(self, ids: Sequence[str], timeout: float = 0.0) -> dict:
@@ -631,16 +683,46 @@ class IngestService:
                 "coalesced_claims": self._coalesced,
                 "carry_bytes": self._carry_total,
             }
+            if self._batcher is not None:
+                out["batcher"] = {
+                    "parked": self._batcher.parked_locked(),
+                    "target_batch": self._batcher.target,
+                    "batch": self._batcher.batch,
+                    "max_wait_ms": self._batcher.wait_s * 1000.0,
+                    "warmed_buckets": sorted(self._batcher._warmed),
+                }
         out["blocks"] = int(self._c_blocks.value)
         out["worker_deaths"] = int(self._c_deaths.value)
         out["block_requeues"] = int(self._c_requeues.value)
         out["verdict_windows"] = int(self._c_windows.value)
         out["subscribers"] = self._subs_total
         rejects = {}
+        evictions = {}
         for name, labels, metric in self.metrics.items():
             if name == "service.admission_rejects":
                 rejects[dict(labels).get("reason", "")] = int(metric.value)
+            elif name == "service.batcher_evictions":
+                evictions[dict(labels).get("reason", "")] = int(
+                    metric.value
+                )
         out["admission_rejects"] = rejects
+        if self._batcher is not None:
+            out["batcher"]["launches"] = int(
+                self._batcher._c_batches.value
+            )
+            out["batcher"]["batched_blocks"] = int(
+                self._batcher._c_blocks.value
+            )
+            out["batcher"]["salvages"] = int(
+                self._batcher._c_salvage.value
+            )
+            out["batcher"]["warmup_hits"] = int(
+                self._batcher._c_whit.value
+            )
+            out["batcher"]["warmup_misses"] = int(
+                self._batcher._c_wmiss.value
+            )
+            out["batcher"]["evictions"] = evictions
         if self.cache is not None:
             out["cache"] = self.cache.stats()
         return out
@@ -649,8 +731,12 @@ class IngestService:
         with self._lock:
             self._running = False
             self._cond.notify_all()
+            if self._batcher is not None:
+                self._batcher.close_locked()
         for t in self._workers:
             t.join(timeout=2.0)
+        if self._batcher is not None:
+            self._batcher.join(timeout=2.0)
 
     # -- internals --------------------------------------------------------
 
@@ -661,6 +747,11 @@ class IngestService:
         if st.scheduled or st.busy or st.done.is_set():
             return
         if not st.pending and not st.finish_requested:
+            return
+        if st.batch_inflight > 0:
+            # batched blocks still in flight: the finish claim waits
+            # until the demux drains them (it re-schedules at zero) —
+            # a finish over unmerged blocks would fabricate a verdict
             return
         st.scheduled = True
         self._tokens.append((st.sid, st.shape or (st.workload, 0)))
@@ -830,6 +921,10 @@ class IngestService:
         worker observes ``quarantined`` and finalizes after its
         current block."""
         st.quarantined = True
+        if self._batcher is not None:
+            # parked coalescing entries of a poisoned stream evict
+            # (service.batcher_evictions) — batch-mates are untouched
+            self._batcher.purge_stream_locked(st, "quarantined")
         if not st.engine.quarantines:
             # appending evidence is safe concurrently (list append);
             # the carry itself is never touched here
@@ -991,10 +1086,17 @@ class IngestService:
             if st.pending:
                 self._queued_blocks -= len(st.pending)
                 st.pending.clear()
-            st.busy = False
+            if self._batcher is not None:
+                self._batcher.purge_stream_locked(st, "failed")
             st.quarantined = True
             if not st.engine.quarantines:
                 st.engine.quarantine(st.engine.segments, error)
+            if st.busy and st.batch_inflight > 0:
+                # the batch collector is mid-merge on this engine:
+                # leave finalization to its pass (or the finish-path
+                # wedge fallback) rather than racing the merge
+                continue
+            st.busy = False
             self._finalize_locked(st)
         self._g_depth.set(self._queued_blocks)
 

@@ -68,8 +68,8 @@ def ensure_built(
     try:
         with open(p.parent / ".build.lock", "w") as lk:
             fcntl.flock(lk, fcntl.LOCK_EX)
-            if p.exists():  # a peer built it while we waited
-                return ""
+            if p.exists() and not _stale(p):
+                return ""  # a peer built it while we waited
             cmd = ["make", "-C", str(p.parent)]
             if target:
                 cmd.append(target)

@@ -812,6 +812,7 @@ int jtc_load(const char* src_path, JtcView* out) {
     return 0;
 
   out->secs.clear();
+  size_t data_end = table_end + 4;
   for (uint32_t i = 0; i < n_sections; ++i) {
     const uint8_t* p = buf.data() + kJtcHeader + i * kJtcSection;
     JtcSec s;
@@ -838,7 +839,23 @@ int jtc_load(const char* src_path, JtcView* out) {
       return 2;  // truncated tail / shape mismatch
     if (jtc_crc32(buf.data() + s.off, s.len) != s.crc)
       return 2;  // payload bit flip
+    size_t sec_end = static_cast<size_t>(s.off + s.len);
+    if (sec_end > data_end) data_end = sec_end;
     out->secs.push_back(s);
+  }
+  // trailing bytes after the last payload must be exactly the digest
+  // footer ("JTCD" + count + 32-byte sha256 per section + CRC); a flip
+  // or tear in the footer region is corruption, never padding (legacy
+  // pre-footer packs end at the last payload and skip this)
+  if (buf.size() > data_end) {
+    size_t foot_len = 8 + 32 * static_cast<size_t>(n_sections) + 4;
+    if (buf.size() - data_end != foot_len) return 2;
+    const uint8_t* f = buf.data() + data_end;
+    if (std::memcmp(f, "JTCD", 4) != 0) return 2;
+    if (jtc_read_le<uint32_t>(f + 4) != n_sections) return 2;
+    if (jtc_crc32(f, foot_len - 4) !=
+        jtc_read_le<uint32_t>(f + foot_len - 4))
+      return 2;  // digest footer bit flip
   }
   return 1;
 }

@@ -1296,6 +1296,99 @@ class TestServeSectionSchema:
         assert kill["degraded_streams"] >= 1
 
 
+class TestServeBatchingSchema:
+    """Offline gate for the ISSUE-20 ``serve_batching`` bench schema:
+    a tiny REAL coalescing run under the CPU backend must carry the
+    ON/OFF level schema, actually batch (ON's mean blocks-per-launch
+    beats OFF's degenerate one-per-dispatch), hit the warmed bucket on
+    first dispatch, and serve every verdict identical to the serial
+    oracle.  Perf gates (≥2x, fill ≥ 0.8, p99 ≤ budget) arm only at
+    the standalone evidence scale — never in a tiny CI run."""
+
+    @pytest.fixture()
+    def serve_bench(self):
+        import importlib.util
+        import sys as _sys
+
+        import jax
+
+        if jax.default_backend() != "cpu":
+            pytest.skip(
+                "the smoke gates the offline CPU path; chip windows "
+                "measure through bench.py itself"
+            )
+        _sys.path.insert(0, str(REPO))
+        spec = importlib.util.spec_from_file_location(
+            "bench_serve_under_test",
+            str(REPO / "tools" / "bench_serve.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    @staticmethod
+    def _ns(**over):
+        import argparse as _ap
+
+        base = dict(
+            base=4, workers=2, seed=16, timeout=120.0,
+            bat_streams=8, bat_blocks=12, bat_block_rows=64,
+            target_batch=8, max_batch_wait_ms=25.0,
+            bat_min_speedup=2.0, bat_probe_load=0.6,
+            bat_gate_streams=10**9,
+        )
+        base.update(over)
+        return _ap.Namespace(**base)
+
+    def test_batching_schema_and_correctness(self, serve_bench):
+        failures = []
+
+        def check(cond, msg):
+            if not cond:
+                failures.append(msg)
+
+        doc = serve_bench.run_batching(
+            self._ns(), lambda m: None, check
+        )
+        assert not failures, failures
+        for key in (
+            "target_batch", "max_batch_wait_ms", "block_rows", "levels",
+        ):
+            assert key in doc, f"serve_batching schema lost {key!r}"
+        assert [lv["streams"] for lv in doc["levels"]] == [1, 8]
+        for lv in doc["levels"]:
+            for arm in ("off", "on"):
+                for key in (
+                    "blocks", "wall_s", "blocks_per_s",
+                    "oracle_mismatches", "quarantines",
+                ):
+                    assert key in lv[arm], (
+                        f"serve_batching {arm} schema lost {key!r}"
+                    )
+                # the differential core: zero verdict divergence
+                assert lv[arm]["oracle_mismatches"] == 0
+            on = lv["on"]
+            for key in (
+                "launches", "batched_blocks", "salvages",
+                "warmup_hits", "warmup_misses", "fill_fraction",
+                "added_p50_ms", "added_p99_ms",
+            ):
+                assert key in on, f"serve_batching ON schema lost {key!r}"
+            # every block went through the coalesced path, warmed
+            assert on["batched_blocks"] == on["blocks"]
+            assert on["salvages"] == 0
+            assert on["warmup_hits"] >= 1
+        # coalescing-ON fill beats OFF's degenerate one-block-per-
+        # dispatch: mean entries per launch strictly above 1
+        top = doc["levels"][-1]["on"]
+        batch_w = 1
+        while batch_w < 8:
+            batch_w *= 2
+        assert top["fill_fraction"] * batch_w > 1.0, (
+            f"coalescing never actually batched: {top}"
+        )
+
+
 class TestServeChaosSmoke:
     """The streaming-service chaos harness (``tools/chaos_check.py
     --serve``) must stay runnable offline: deterministic die-hook

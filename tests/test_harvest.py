@@ -396,7 +396,8 @@ class TestHeadlineOrdering:
             "_bench_elle", "_bench_mutex", "_bench_wgl_pcomp",
             "_bench_bitpack_section", "_bench_segmented_section",
             "_bench_fleet_memory_section",
-            "_bench_serve_section", "_bench_campaign_section",
+            "_bench_serve_section", "_bench_serve_batching_section",
+            "_bench_campaign_section",
             "_bench_north_star_section", "_bench_north_star_100k_section",
             "_bench_cold_vs_warm_section",
             "_bench_obs_overhead_section",
@@ -441,7 +442,7 @@ class TestHeadlineOrdering:
         secondary = [
             e for e in events if e[0] not in ("wgl_hard", "multichip")
         ]
-        assert len(secondary) == 19
+        assert len(secondary) == 20
         assert all(seen for _, seen in secondary), (
             "a secondary section started before the headline printed: "
             f"{secondary}"
@@ -450,9 +451,9 @@ class TestHeadlineOrdering:
     def test_details_persist_incrementally_per_section(self, monkeypatch):
         out, events, written = self._run(monkeypatch)
         # one write after the queue section, one after each of the
-        # nineteen secondary sections (a timeout after N sections leaves
+        # twenty secondary sections (a timeout after N sections leaves
         # N fresh), one final with the compile-cache evidence
-        assert len(written) == 21
+        assert len(written) == 22
         assert "queue" in written[0] and "_bench_stream" not in written[0]
         assert "_bench_mutex" in written[-1]
         assert "entries_final" in written[-1]["compile_cache"]
@@ -464,6 +465,6 @@ class TestHeadlineOrdering:
             monkeypatch, failing={"_bench_elle"}
         )
         assert '"metric"' in out
-        assert len(written) == 21  # the write still happens after a failure
+        assert len(written) == 22  # the write still happens after a failure
         assert "_bench_elle" not in written[-1]
         assert "_bench_mutex" in written[-1]

@@ -1,0 +1,346 @@
+"""Continuous batching for the verification service (ISSUE 20): the
+cross-stream coalescing scheduler differential against the per-stream
+serial oracle — verdicts identical on every stream, carry isolation
+preserved (batching crosses streams only on the history axis), a
+stream dying mid-coalesce quarantined with evidence while its
+batch-mates are untouched, parked segments bounded and evicted loudly,
+warmup AOT counted honestly, and the verdict cache's ``report_ref``
+surviving re-puts (the ``GET /report/<run>`` satellite)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checkers.segmented import (
+    SegmentedChecker,
+    queue_prepare_rows,
+)
+from jepsen_tpu.history.columnar import iter_row_blocks
+from jepsen_tpu.history.rows import _rows_for
+from jepsen_tpu.history.synth import SynthSpec, synth_history
+from jepsen_tpu.obs.metrics import Registry
+from jepsen_tpu.service.cache import VerdictCache
+from jepsen_tpu.service.stream import IngestService, _wire_safe
+
+
+def _history(n_ops=400, seed=3, **anoms):
+    sh = synth_history(SynthSpec(n_ops=n_ops, seed=seed, **anoms))
+    return _rows_for(sh.ops), len(sh.ops)
+
+
+def _oracle(rows, n_ops):
+    eng = SegmentedChecker("queue", device=False)
+    eng.feed_rows(rows, n_ops)
+    return eng.finish()
+
+
+def _families_equal(served, oracle):
+    o = _wire_safe(oracle)
+    keys = set(o) - {"segmented"}
+    s = _wire_safe({k: served.get(k) for k in keys})
+    return s == {k: o[k] for k in keys}
+
+
+def _svc(**kw):
+    kw.setdefault("workers", 2)
+    kw.setdefault("device", False)
+    kw.setdefault("registry", Registry())
+    kw.setdefault("batch", True)
+    kw.setdefault("target_batch", 8)
+    kw.setdefault("max_batch_wait_ms", 25.0)
+    return IngestService(**kw)
+
+
+def _open_stream(svc, deadline_s=60.0):
+    r = svc.open("queue", None, kind="stream", deadline_s=deadline_s)
+    assert r["op"] == "opened", r
+    return r["stream"]
+
+
+def _feed_interleaved(svc, streams, block_rows=96):
+    """Round-robin blocks across streams so the coalescer genuinely
+    sees cross-stream material in every bucket."""
+    plans = []
+    for sid, (rows, n_ops) in streams:
+        plans.append((sid, list(iter_row_blocks(rows, block_rows)), [0]))
+    fed = True
+    while fed:
+        fed = False
+        for sid, blocks, cur in plans:
+            if cur[0] >= len(blocks):
+                continue
+            blk, b_ops = blocks[cur[0]]
+            rep = svc.feed(sid, cur[0], "rows", blk, b_ops)
+            assert rep["op"] == "accepted", rep
+            cur[0] += 1
+            fed = True
+
+
+class TestCoalescedDifferential:
+    def test_cross_stream_batching_equals_serial_oracle(self):
+        """The core differential: six concurrent streams with varied
+        sizes and anomalies, fed round-robin through the coalescer —
+        every verdict must be identical to that stream's serial
+        oracle, with real batching (fewer launches than blocks)."""
+        corpus = [
+            _history(n_ops=160 + 40 * i, seed=i,
+                     lost=i % 2, duplicated=(i + 1) % 2)
+            for i in range(6)
+        ]
+        reg = Registry()
+        svc = _svc(registry=reg)
+        try:
+            streams = [(_open_stream(svc), hv) for hv in corpus]
+            _feed_interleaved(svc, streams)
+            verdicts = [
+                (svc.finish(sid, timeout=30), rows, n_ops)
+                for sid, (rows, n_ops) in streams
+            ]
+            stats = svc.stats()
+        finally:
+            svc.close()
+        for v, rows, n_ops in verdicts:
+            assert _families_equal(v, _oracle(rows, n_ops)), v
+            assert "degraded" not in v
+        bat = stats["batcher"]
+        assert bat["batched_blocks"] > 0
+        assert bat["salvages"] == 0
+        # coalescing happened: strictly fewer launches than blocks
+        assert 0 < bat["launches"] < bat["batched_blocks"]
+
+    def test_mixed_bucket_stream_merges_in_seq_order(self):
+        """One stream whose blocks alternate between two shape buckets
+        (single vs concatenated-pair blocks): super-batches land out
+        of order across buckets, and the per-stream reorder buffer
+        must still merge in seq order — the carry is NOT
+        order-independent, so any reordering shows up as a verdict
+        diff against the oracle."""
+        rows, n_ops = _history(n_ops=900, seed=11, lost=2, duplicated=2)
+        small = list(iter_row_blocks(rows, 64))
+        blocks, i = [], 0
+        while i < len(small):
+            if i % 3 == 2 or i + 1 >= len(small):
+                blocks.append(small[i])
+                i += 1
+            else:  # a double-width block: a different (L, V) bucket
+                (b1, n1), (b2, n2) = small[i], small[i + 1]
+                blocks.append((np.concatenate([b1, b2]), n1 + n2))
+                i += 2
+        svc = _svc(target_batch=4, max_batch_wait_ms=10.0)
+        try:
+            sid = _open_stream(svc)
+            for seq, (blk, b_ops) in enumerate(blocks):
+                rep = svc.feed(sid, seq, "rows", blk, b_ops)
+                assert rep["op"] == "accepted", rep
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        assert _families_equal(v, _oracle(rows, n_ops)), v
+
+    def test_ops_json_blocks_interleave_with_coalesced_rows(self):
+        """Ops-JSON blocks on a queue stream can't join a rows
+        super-batch; they ride the pass-through bucket and must still
+        merge at their seq turn, between coalesced rows blocks."""
+        sh = synth_history(SynthSpec(n_ops=240, seed=7, lost=1))
+        rows = _rows_for(sh.ops)
+        n_ops = len(sh.ops)
+        row_blocks = list(iter_row_blocks(rows, 96))
+        mid = len(sh.ops) // 2
+        svc = _svc(target_batch=4)
+        try:
+            # stream A: rows / ops-json / rows interleaved by seq
+            sid = _open_stream(svc)
+            ops_payload = [op.to_json() for op in sh.ops[:mid]]
+            rest = _rows_for(sh.ops[mid:])
+            rep = svc.feed(sid, 0, "ops", ops_payload, mid)
+            assert rep["op"] == "accepted", rep
+            rep = svc.feed(sid, 1, "rows", rest, n_ops - mid)
+            assert rep["op"] == "accepted", rep
+            # stream B: plain rows, the coalescing batch-mate
+            sid_b = _open_stream(svc)
+            for seq, (blk, b_ops) in enumerate(row_blocks):
+                svc.feed(sid_b, seq, "rows", blk, b_ops)
+            v = svc.finish(sid, timeout=30)
+            v_b = svc.finish(sid_b, timeout=30)
+        finally:
+            svc.close()
+        oracle = _oracle(rows, n_ops)
+        assert _families_equal(v, oracle), v
+        assert _families_equal(v_b, oracle), v_b
+
+
+class TestMidCoalesceDeath:
+    def test_abort_mid_coalesce_leaves_batch_mates_unaffected(self):
+        """A stream aborted while its segments sit parked in the
+        coalescing queue: its entries are evicted (counted on
+        ``service.batcher_evictions``), accounting is released, and
+        the surviving batch-mates' verdicts are oracle-identical."""
+        corpus = [_history(n_ops=200, seed=20 + i) for i in range(3)]
+        reg = Registry()
+        # a target far above supply + a long budget: everything parks
+        svc = _svc(registry=reg, target_batch=64,
+                   max_batch_wait_ms=30_000.0, park_max_s=60.0)
+        try:
+            streams = [(_open_stream(svc), hv) for hv in corpus]
+            _feed_interleaved(svc, streams, block_rows=96)
+            victim = streams[1][0]
+            assert svc.abort(victim)["op"] == "aborted"
+            evicted = reg.value(
+                "service.batcher_evictions", reason="aborted"
+            )
+            survivors = [
+                (svc.finish(sid, timeout=30), rows, n_ops)
+                for sid, (rows, n_ops) in streams
+                if sid != victim
+            ]
+        finally:
+            svc.close()
+        assert evicted > 0, "parked entries of the aborted stream " \
+            "were not evicted"
+        for v, rows, n_ops in survivors:
+            assert _families_equal(v, _oracle(rows, n_ops)), v
+
+    def test_gap_quarantine_mid_coalesce_keeps_evidence(self):
+        """A sequence gap quarantines the stream while earlier blocks
+        are still parked: the verdict is unknown WITH the gap as
+        evidence, the parked entries are evicted, and the batch-mate
+        stream is untouched."""
+        rows, n_ops = _history(n_ops=300, seed=31)
+        mate_rows, mate_ops = _history(n_ops=300, seed=32, lost=1)
+        reg = Registry()
+        svc = _svc(registry=reg, target_batch=64,
+                   max_batch_wait_ms=30_000.0, park_max_s=60.0)
+        try:
+            sid = _open_stream(svc)
+            mate = _open_stream(svc)
+            blocks = list(iter_row_blocks(rows, 96))
+            for seq, (blk, b_ops) in enumerate(
+                iter_row_blocks(mate_rows, 96)
+            ):
+                svc.feed(mate, seq, "rows", blk, b_ops)
+            svc.feed(sid, 0, "rows", *blocks[0])
+            rep = svc.feed(sid, 2, "rows", *blocks[2])  # hole at seq 1
+            assert rep["op"] == "quarantined"
+            v = svc.finish(sid, timeout=30)
+            v_mate = svc.finish(mate, timeout=30)
+            evicted = reg.value(
+                "service.batcher_evictions", reason="quarantined"
+            )
+        finally:
+            svc.close()
+        assert v["valid?"] == "unknown"
+        assert "gap in block sequence" in json.dumps(v)
+        assert evicted > 0
+        assert _families_equal(v_mate, _oracle(mate_rows, mate_ops))
+
+
+class TestParkingBounds:
+    def test_park_age_bound_dispatches_undersized_bucket(self):
+        """The stranded-segment backstop (ISSUE 20 satellite): a
+        bucket that never reaches target and whose deadline is far
+        away still dispatches once its oldest entry exceeds
+        ``park_max_s`` — no finish() required, nothing parked
+        forever."""
+        rows, n_ops = _history(n_ops=160, seed=40)
+        svc = _svc(target_batch=64, max_batch_wait_ms=600_000.0,
+                   park_max_s=0.3)
+        try:
+            sid = _open_stream(svc)
+            for seq, (blk, b_ops) in enumerate(iter_row_blocks(rows, 96)):
+                svc.feed(sid, seq, "rows", blk, b_ops)
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                stats = svc.stats()
+                bat = stats["batcher"]
+                if bat["parked"] == 0 and bat["launches"] >= 1:
+                    break
+                time.sleep(0.05)
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        assert bat["parked"] == 0 and bat["launches"] >= 1, bat
+        assert _families_equal(v, _oracle(rows, n_ops))
+
+    def test_parked_entries_count_against_admission(self):
+        """Backpressure composes: blocks parked in the coalescing
+        queue stay counted in the ingress bound, so a full coalescing
+        queue rejects new feeds loudly (SATURATED) instead of
+        buffering without bound — and finish() drains the parked
+        entries so the stream still completes."""
+        rows, n_ops = _history(n_ops=400, seed=41)
+        svc = _svc(ingress_cap=4, target_batch=64,
+                   max_batch_wait_ms=30_000.0, park_max_s=60.0)
+        try:
+            sid = _open_stream(svc)
+            blocks = list(iter_row_blocks(rows, 64))
+            assert len(blocks) > 5
+            rejected = None
+            fed = 0
+            for seq, (blk, b_ops) in enumerate(blocks):
+                rep = svc.feed(sid, seq, "rows", blk, b_ops)
+                if rep["op"] == "rejected":
+                    rejected = rep
+                    break
+                fed += 1
+            assert rejected is not None, (
+                "parked blocks never saturated the ingress bound"
+            )
+            assert rejected["reason"]  # loud, named reject
+            # the finish-drain: parked entries dispatch immediately
+            v = svc.finish(sid, timeout=30)
+        finally:
+            svc.close()
+        oracle_rows = np.concatenate([b for b, _n in blocks[:fed]])
+        oracle_ops = sum(n for _b, n in blocks[:fed])
+        assert _families_equal(v, _oracle(oracle_rows, oracle_ops)), v
+
+
+class TestWarmup:
+    def test_warmup_hit_and_cold_miss_counters(self):
+        rows, n_ops = _history(n_ops=200, seed=50)
+        blk, b_ops = next(iter_row_blocks(rows, 96))
+        prep = queue_prepare_rows(blk, blk[:, 0].astype(np.int64))
+        bucket = (int(prep["L"]), int(prep["V"]))
+
+        def run(**kw):
+            reg = Registry()
+            svc = _svc(registry=reg, target_batch=4, **kw)
+            try:
+                sid = _open_stream(svc)
+                for seq, b in enumerate(iter_row_blocks(rows, 96)):
+                    svc.feed(sid, seq, "rows", *b)
+                v = svc.finish(sid, timeout=30)
+                stats = svc.stats()
+            finally:
+                svc.close()
+            assert _families_equal(v, _oracle(rows, n_ops))
+            return stats["batcher"]
+
+        warm = run(warmup=True, warmup_buckets=(bucket,))
+        assert warm["warmup_hits"] >= 1
+        assert warm["warmup_misses"] == 0
+        assert bucket in [tuple(b) for b in warm["warmed_buckets"]]
+        cold = run(warmup=False)
+        assert cold["warmup_hits"] == 0
+        assert cold["warmup_misses"] >= 1
+
+
+class TestReportRefSurvival:
+    def test_reput_without_ref_preserves_recorded_run(self):
+        """The ``GET /report/<run>`` satellite: a live-stream
+        re-verification of a seeded history re-puts the verdict
+        without a ``report_ref`` — the recorded-run pointer must
+        survive, or cache hits lose their report route."""
+        cache = VerdictCache(capacity=8, registry=Registry())
+        cache.put("k1", {"valid?": True}, report_ref="runs/r0001")
+        cache.put("k1", {"valid?": True})  # live re-verification
+        got = cache.get("k1")
+        assert got["report_ref"] == "runs/r0001"
+        # an explicit new ref still wins
+        cache.put("k1", {"valid?": True}, report_ref="runs/r0002")
+        assert cache.get("k1")["report_ref"] == "runs/r0002"
+        # and a fresh key without any ref stays ref-less
+        cache.put("k2", {"valid?": True})
+        assert "report_ref" not in cache.get("k2")
